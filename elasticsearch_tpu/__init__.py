@@ -1,0 +1,16 @@
+"""elasticsearch_tpu — a TPU-native distributed search engine.
+
+A from-scratch re-design of the capabilities of Elasticsearch
+(reference: tonycrosby/elasticsearch @ 8.0.0-SNAPSHOT) for TPU hardware:
+
+- Data plane: immutable, padded, device-resident segment arrays scored by
+  JAX/XLA/Pallas kernels (BM25 with block-max pruning, dense-vector kNN,
+  sparse rank-features, hybrid rank fusion) over a ``jax.sharding.Mesh``.
+- Control plane: host-side Python (cluster state + Raft-like coordination,
+  seqno replication, recovery, snapshots, REST API), mirroring the
+  reference's layer map (see SURVEY.md §1) without porting its code.
+"""
+
+from elasticsearch_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,21 @@
+from elasticsearch_tpu.analysis.analyzers import (
+    Analyzer,
+    AnalysisRegistry,
+    BUILTIN_ANALYZERS,
+    ENGLISH,
+    KEYWORD,
+    STANDARD,
+    Token,
+)
+from elasticsearch_tpu.analysis.porter import porter_stem
+
+__all__ = [
+    "Analyzer",
+    "AnalysisRegistry",
+    "BUILTIN_ANALYZERS",
+    "ENGLISH",
+    "KEYWORD",
+    "STANDARD",
+    "Token",
+    "porter_stem",
+]

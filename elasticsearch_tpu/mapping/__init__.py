@@ -1,0 +1,17 @@
+from elasticsearch_tpu.mapping.mappers import (
+    FieldMapper,
+    MapperService,
+    ParsedDocument,
+    ParsedField,
+    build_mapper,
+    parse_date_millis,
+)
+
+__all__ = [
+    "FieldMapper",
+    "MapperService",
+    "ParsedDocument",
+    "ParsedField",
+    "build_mapper",
+    "parse_date_millis",
+]

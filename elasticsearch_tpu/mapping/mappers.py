@@ -1,0 +1,533 @@
+"""Field mappings and document parsing.
+
+Mirrors the role of the reference's mapper layer —
+``MapperService`` (index/mapper/MapperService.java:75),
+``DocumentParser`` (index/mapper/DocumentParser.java:44) and the 29 field
+mappers (index/mapper/*FieldMapper.java) plus the x-pack ``dense_vector``
+(x-pack/plugin/vectors/.../mapper/DenseVectorFieldMapper.java) and
+``rank_features`` (modules/mapper-extras/.../RankFeaturesFieldMapper.java) —
+re-designed for a segment model where parsing produces *typed columns*
+(terms with positions, numeric doc values, vectors) destined for padded
+device arrays rather than a Lucene document.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.analysis import AnalysisRegistry, Token
+from elasticsearch_tpu.utils.errors import IllegalArgumentError, MapperParsingError
+
+# Max vector dims, mirroring the reference's cap
+# (x-pack/plugin/vectors/.../mapper/DenseVectorFieldMapper.java:45 — MAX_DIMS_COUNT=2048).
+MAX_VECTOR_DIMS = 4096
+
+
+@dataclass
+class ParsedField:
+    """One field's parsed contribution to a document."""
+    name: str
+    kind: str                                   # 'terms' | 'numeric' | 'vector' | 'features' | 'bool' | 'geo'
+    terms: Optional[List[Token]] = None         # text: analyzed tokens with positions
+    exact_terms: Optional[List[str]] = None     # keyword: untokenized values
+    numeric: Optional[List[float]] = None       # numeric/date doc values
+    vector: Optional[List[float]] = None        # dense_vector
+    features: Optional[Dict[str, float]] = None # rank_features sparse weights
+    geo: Optional[Tuple[float, float]] = None   # (lat, lon)
+
+
+@dataclass
+class ParsedDocument:
+    doc_id: str
+    source: Dict[str, Any]
+    fields: Dict[str, ParsedField] = field(default_factory=dict)
+    routing: Optional[str] = None
+
+
+class FieldMapper:
+    """Base field mapper. Subclasses parse one JSON value into a ParsedField."""
+
+    type_name = "unknown"
+    searchable = True
+    has_doc_values = False
+
+    def __init__(self, name: str, params: Dict[str, Any], analysis: AnalysisRegistry):
+        self.name = name
+        self.params = params
+
+    def parse(self, value: Any) -> ParsedField:
+        raise NotImplementedError
+
+    def to_mapping(self) -> Dict[str, Any]:
+        out = {"type": self.type_name}
+        out.update(self.params)
+        return out
+
+
+class TextFieldMapper(FieldMapper):
+    """Analyzed full-text field (reference: index/mapper/TextFieldMapper.java)."""
+
+    type_name = "text"
+
+    def __init__(self, name: str, params: Dict[str, Any], analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        self.analyzer = analysis.get(params.get("analyzer", "standard"))
+        self.search_analyzer = analysis.get(
+            params.get("search_analyzer", params.get("analyzer", "standard")))
+
+    def parse(self, value: Any) -> ParsedField:
+        if isinstance(value, list):
+            tokens: List[Token] = []
+            pos_base = 0
+            for v in value:
+                toks = self.analyzer.analyze(str(v))
+                for t in toks:
+                    t.position += pos_base
+                tokens.extend(toks)
+                # position gap of 100 between array values, like Lucene's
+                # default; every value advances the base, even empty ones
+                pos_base = (toks[-1].position if toks else pos_base) + 100
+            return ParsedField(self.name, "terms", terms=tokens)
+        return ParsedField(self.name, "terms", terms=self.analyzer.analyze(str(value)))
+
+
+class KeywordFieldMapper(FieldMapper):
+    """Exact-value field (reference: index/mapper/KeywordFieldMapper.java)."""
+
+    type_name = "keyword"
+    has_doc_values = True
+
+    def __init__(self, name: str, params: Dict[str, Any], analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        self.ignore_above = params.get("ignore_above")
+
+    def parse(self, value: Any) -> ParsedField:
+        values = value if isinstance(value, list) else [value]
+        out = []
+        for v in values:
+            s = str(v)
+            if self.ignore_above is not None and len(s) > self.ignore_above:
+                continue
+            out.append(s)
+        return ParsedField(self.name, "terms", exact_terms=out)
+
+
+_INT_RANGES = {
+    "byte": (-(1 << 7), (1 << 7) - 1),
+    "short": (-(1 << 15), (1 << 15) - 1),
+    "integer": (-(1 << 31), (1 << 31) - 1),
+    "long": (-(1 << 63), (1 << 63) - 1),
+}
+
+
+class NumberFieldMapper(FieldMapper):
+    """Numeric types (reference: index/mapper/NumberFieldMapper.java)."""
+
+    has_doc_values = True
+
+    def __init__(self, name: str, params: Dict[str, Any], analysis: AnalysisRegistry,
+                 type_name: str = "long"):
+        super().__init__(name, params, analysis)
+        self.type_name = type_name
+        self.scaling_factor = params.get("scaling_factor")
+        if type_name == "scaled_float" and not self.scaling_factor:
+            raise MapperParsingError(f"scaled_float [{name}] requires [scaling_factor]")
+
+    def parse(self, value: Any) -> ParsedField:
+        values = value if isinstance(value, list) else [value]
+        out = []
+        for v in values:
+            if self.type_name in _INT_RANGES:
+                # parse integral types exactly (no float round-trip, which
+                # corrupts values above 2^53 and mis-ranges values near 2^63)
+                try:
+                    i = int(v) if not isinstance(v, float) else int(round(v))
+                except (TypeError, ValueError):
+                    raise MapperParsingError(
+                        f"failed to parse field [{self.name}] of type [{self.type_name}]: [{v}]")
+                lo, hi = _INT_RANGES[self.type_name]
+                if not (lo <= i <= hi):
+                    raise MapperParsingError(
+                        f"value [{v}] out of range for field [{self.name}] of type [{self.type_name}]")
+                # keep exact int (segment builder stores integral doc values
+                # as int64 columns; float64 would corrupt above 2^53)
+                out.append(i)
+                continue
+            try:
+                f = float(v)
+            except (TypeError, ValueError):
+                raise MapperParsingError(
+                    f"failed to parse field [{self.name}] of type [{self.type_name}]: [{v}]")
+            if self.type_name == "scaled_float":
+                out.append(round(f * self.scaling_factor) / self.scaling_factor)
+            else:
+                out.append(f)
+        return ParsedField(self.name, "numeric", numeric=out)
+
+
+class BooleanFieldMapper(FieldMapper):
+    type_name = "boolean"
+    has_doc_values = True
+
+    def parse(self, value: Any) -> ParsedField:
+        values = value if isinstance(value, list) else [value]
+        out = []
+        for v in values:
+            if isinstance(v, bool):
+                out.append(1.0 if v else 0.0)
+            elif v in ("true", "True"):
+                out.append(1.0)
+            elif v in ("false", "False"):
+                out.append(0.0)
+            else:
+                raise MapperParsingError(f"cannot parse boolean [{v}] for [{self.name}]")
+        return ParsedField(self.name, "numeric", numeric=out)
+
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+_DATE_FORMATS = [
+    "%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z", "%Y-%m-%dT%H:%M:%S.%f",
+    "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d", "%Y/%m/%d",
+]
+
+
+def parse_date_millis(value: Any) -> float:
+    """Parse a date to epoch millis. Accepts epoch numbers and common ISO formats.
+
+    Reference analog: DateFieldMapper with 'strict_date_optional_time||epoch_millis'.
+    """
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    s = str(value)
+    if s.endswith("Z"):
+        s = s[:-1] + "+0000"
+    for fmt in _DATE_FORMATS:
+        try:
+            dt = _dt.datetime.strptime(s, fmt)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=_dt.timezone.utc)
+            return (dt - _EPOCH).total_seconds() * 1000.0
+        except ValueError:
+            continue
+    try:
+        return float(s)  # epoch millis as string
+    except ValueError:
+        raise MapperParsingError(f"failed to parse date [{value}]")
+
+
+class DateFieldMapper(FieldMapper):
+    type_name = "date"
+    has_doc_values = True
+
+    def parse(self, value: Any) -> ParsedField:
+        values = value if isinstance(value, list) else [value]
+        return ParsedField(self.name, "numeric", numeric=[parse_date_millis(v) for v in values])
+
+
+class DenseVectorFieldMapper(FieldMapper):
+    """Dense float vector for kNN (reference: x-pack DenseVectorFieldMapper).
+
+    Unlike the reference (which stores vectors in binary doc values and scores
+    them via painless script loops), vectors here become rows of an
+    HBM-resident matrix scored with a tiled MXU matmul (ops/knn.py).
+    """
+
+    type_name = "dense_vector"
+
+    def __init__(self, name: str, params: Dict[str, Any], analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        self.dims = int(params.get("dims", 0))
+        if not (0 < self.dims <= MAX_VECTOR_DIMS):
+            raise MapperParsingError(
+                f"dense_vector [{name}] requires 0 < dims <= {MAX_VECTOR_DIMS}, got {self.dims}")
+        self.similarity = params.get("similarity", "cosine")
+        if self.similarity not in ("cosine", "dot_product", "l2_norm"):
+            raise MapperParsingError(f"unknown similarity [{self.similarity}] for [{name}]")
+        self.index_options = params.get("index_options")  # e.g. {'type': 'ivf', 'nlist': 1024}
+
+    def parse(self, value: Any) -> ParsedField:
+        if not isinstance(value, list) or len(value) != self.dims:
+            raise MapperParsingError(
+                f"dense_vector [{self.name}] expects {self.dims} dims, "
+                f"got {len(value) if isinstance(value, list) else type(value).__name__}")
+        try:
+            vec = [float(x) for x in value]
+        except (TypeError, ValueError):
+            raise MapperParsingError(
+                f"dense_vector [{self.name}] contains non-numeric values")
+        if any(math.isnan(x) or math.isinf(x) for x in vec):
+            raise MapperParsingError(f"dense_vector [{self.name}] contains non-finite values")
+        return ParsedField(self.name, "vector", vector=vec)
+
+
+class RankFeaturesFieldMapper(FieldMapper):
+    """Sparse weighted features (reference: RankFeaturesFieldMapper.java).
+
+    The substrate for learned sparse retrieval (ELSER-style text_expansion):
+    a document maps feature names to positive weights; queries score with a
+    sparse dot product kernel (ops/sparse.py).
+    """
+
+    type_name = "rank_features"
+
+    def parse(self, value: Any) -> ParsedField:
+        if not isinstance(value, dict):
+            raise MapperParsingError(f"rank_features [{self.name}] expects an object")
+        feats = {}
+        for k, v in value.items():
+            try:
+                w = float(v)
+            except (TypeError, ValueError):
+                raise MapperParsingError(
+                    f"rank_features [{self.name}] has non-numeric weight for [{k}]")
+            if w < 0:
+                raise MapperParsingError(
+                    f"rank_features [{self.name}] weights must be >= 0, got {w} for [{k}]")
+            feats[str(k)] = w
+        return ParsedField(self.name, "features", features=feats)
+
+
+class RankFeatureFieldMapper(FieldMapper):
+    """Single named feature (reference: RankFeatureFieldMapper.java)."""
+
+    type_name = "rank_feature"
+
+    def __init__(self, name: str, params: Dict[str, Any], analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        self.positive_score_impact = bool(params.get("positive_score_impact", True))
+
+    def parse(self, value: Any) -> ParsedField:
+        w = float(value)
+        if w < 0:
+            raise MapperParsingError(f"rank_feature [{self.name}] must be >= 0")
+        return ParsedField(self.name, "features", features={self.name: w})
+
+
+class GeoPointFieldMapper(FieldMapper):
+    type_name = "geo_point"
+    has_doc_values = True
+
+    def parse(self, value: Any) -> ParsedField:
+        try:
+            if isinstance(value, dict):
+                lat, lon = float(value["lat"]), float(value["lon"])
+            elif isinstance(value, str):
+                parts = value.split(",")
+                if len(parts) != 2:
+                    raise ValueError("expected 'lat,lon'")
+                lat, lon = float(parts[0]), float(parts[1])
+            elif isinstance(value, list) and len(value) == 2:
+                lon, lat = float(value[0]), float(value[1])  # GeoJSON order
+            else:
+                raise ValueError(f"unsupported geo_point format {type(value).__name__}")
+        except (KeyError, ValueError, TypeError) as e:
+            raise MapperParsingError(f"cannot parse geo_point [{value}] for [{self.name}]: {e}")
+        if not (-90 <= lat <= 90) or not (-180 <= lon <= 180):
+            raise MapperParsingError(f"geo_point [{value}] out of range for [{self.name}]")
+        return ParsedField(self.name, "geo", geo=(lat, lon))
+
+
+_MAPPER_TYPES = {
+    "text": TextFieldMapper,
+    "keyword": KeywordFieldMapper,
+    "boolean": BooleanFieldMapper,
+    "date": DateFieldMapper,
+    "dense_vector": DenseVectorFieldMapper,
+    "rank_features": RankFeaturesFieldMapper,
+    "rank_feature": RankFeatureFieldMapper,
+    "geo_point": GeoPointFieldMapper,
+}
+for _num in ("long", "integer", "short", "byte", "double", "float", "half_float", "scaled_float"):
+    _MAPPER_TYPES[_num] = _num  # sentinel; handled in build_mapper
+
+NUMERIC_TYPES = frozenset(
+    ("long", "integer", "short", "byte", "double", "float", "half_float",
+     "scaled_float", "date", "boolean"))
+
+
+def build_mapper(name: str, spec: Dict[str, Any], analysis: AnalysisRegistry) -> FieldMapper:
+    spec = dict(spec)
+    type_name = spec.pop("type", "object")
+    factory = _MAPPER_TYPES.get(type_name)
+    if factory is None:
+        raise MapperParsingError(f"no handler for type [{type_name}] on field [{name}]")
+    if isinstance(factory, str):
+        return NumberFieldMapper(name, spec, analysis, type_name=factory)
+    return factory(name, spec, analysis)
+
+
+class MapperService:
+    """Per-index schema: field name → mapper; parses documents; merges mapping updates.
+
+    Reference analog: index/mapper/MapperService.java:75 (+ DocumentParser.java:44).
+    Supports dynamic mapping: unseen fields get a type inferred from the JSON value
+    (string → text with .keyword subfield, number → long/double, bool, date-ish → date).
+    """
+
+    def __init__(self, mapping: Optional[Dict[str, Any]] = None,
+                 analysis: Optional[AnalysisRegistry] = None,
+                 dynamic: Any = True):
+        self.analysis = analysis or AnalysisRegistry()
+        # tri-state like the reference: True (map new fields), False (ignore
+        # them, still store in _source), "strict" (reject the document)
+        self.dynamic = _parse_dynamic(dynamic)
+        self._mappers: Dict[str, FieldMapper] = {}
+        if mapping:
+            self.merge(mapping)
+
+    def merge(self, mapping: Dict[str, Any]) -> None:
+        props = mapping.get("properties", mapping)
+        self._merge_props("", props)
+        if "dynamic" in mapping:
+            self.dynamic = _parse_dynamic(mapping["dynamic"])
+
+    def _merge_props(self, prefix: str, props: Dict[str, Any]) -> None:
+        for name, spec in props.items():
+            full = f"{prefix}{name}"
+            if "properties" in spec and "type" not in spec:
+                self._merge_props(f"{full}.", spec["properties"])
+                continue
+            new = build_mapper(full, spec, self.analysis)
+            existing = self._mappers.get(full)
+            if existing is not None and existing.type_name != new.type_name:
+                raise MapperParsingError(
+                    f"mapper [{full}] cannot change type from "
+                    f"[{existing.type_name}] to [{new.type_name}]")
+            self._mappers[full] = new
+            # text fields get an automatic .keyword subfield unless disabled,
+            # mirroring ES dynamic-template default behavior
+            for sub, subspec in spec.get("fields", {}).items():
+                self._mappers[f"{full}.{sub}"] = build_mapper(f"{full}.{sub}", subspec, self.analysis)
+
+    def mapper(self, field_name: str) -> Optional[FieldMapper]:
+        return self._mappers.get(field_name)
+
+    def field_type(self, field_name: str) -> Optional[str]:
+        m = self._mappers.get(field_name)
+        return m.type_name if m else None
+
+    def field_names(self) -> List[str]:
+        return sorted(self._mappers.keys())
+
+    def to_mapping(self) -> Dict[str, Any]:
+        props: Dict[str, Any] = {}
+        for name, m in sorted(self._mappers.items()):
+            node = props
+            parts = name.split(".")
+            # .keyword-style subfields render under 'fields'
+            parent = ".".join(parts[:-1])
+            if parent in self._mappers and self._mappers[parent].type_name == "text":
+                parent_spec = _descend(props, parent.split("."))
+                parent_spec.setdefault("fields", {})[parts[-1]] = m.to_mapping()
+                continue
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node[parts[-1]] = m.to_mapping()
+        return {"properties": props}
+
+    def _infer(self, name: str, value: Any) -> Optional[FieldMapper]:
+        if isinstance(value, bool):
+            spec: Dict[str, Any] = {"type": "boolean"}
+        elif isinstance(value, int):
+            spec = {"type": "long"}
+        elif isinstance(value, float):
+            spec = {"type": "double"}
+        elif isinstance(value, str):
+            spec = {"type": "text"}
+            if _looks_like_date(value):
+                try:
+                    parse_date_millis(value)
+                    spec = {"type": "date"}
+                except MapperParsingError:
+                    pass
+        elif isinstance(value, dict):
+            return None  # object: recurse in parse
+        elif isinstance(value, list):
+            return self._infer(name, value[0]) if value else None
+        else:
+            return None
+        self._mappers[name] = build_mapper(name, spec, self.analysis)
+        if spec["type"] == "text":
+            self._mappers[f"{name}.keyword"] = build_mapper(
+                f"{name}.keyword", {"type": "keyword", "ignore_above": 256}, self.analysis)
+        return self._mappers[name]
+
+    def parse_document(self, doc_id: str, source: Dict[str, Any],
+                       routing: Optional[str] = None) -> ParsedDocument:
+        doc = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
+        self._parse_obj("", source, doc)
+        return doc
+
+    def _parse_obj(self, prefix: str, obj: Dict[str, Any], doc: ParsedDocument) -> None:
+        for key, value in obj.items():
+            name = f"{prefix}{key}"
+            if value is None:
+                continue
+            mapper = self._mappers.get(name)
+            if mapper is None:
+                if isinstance(value, dict):
+                    self._parse_obj(f"{name}.", value, doc)
+                    continue
+                if self.dynamic == "strict":
+                    raise MapperParsingError(
+                        f"mapping set to strict, dynamic introduction of [{name}] is not allowed")
+                if self.dynamic is False:
+                    continue  # ignore unmapped field; it stays in _source only
+                mapper = self._infer(name, value)
+                if mapper is None:
+                    if isinstance(value, list) and value and isinstance(value[0], dict):
+                        for item in value:
+                            self._parse_obj(f"{name}.", item, doc)
+                    continue
+            parsed = mapper.parse(value)
+            if name in doc.fields:
+                _merge_parsed(doc.fields[name], parsed)
+            else:
+                doc.fields[name] = parsed
+            # feed text.keyword subfields
+            kw = self._mappers.get(f"{name}.keyword")
+            if kw is not None and mapper.type_name == "text":
+                sub = kw.parse(value)
+                subname = f"{name}.keyword"
+                if subname in doc.fields:
+                    _merge_parsed(doc.fields[subname], sub)
+                else:
+                    doc.fields[subname] = sub
+
+
+def _merge_parsed(into: ParsedField, other: ParsedField) -> None:
+    for attr in ("terms", "exact_terms", "numeric"):
+        a, b = getattr(into, attr), getattr(other, attr)
+        if b:
+            setattr(into, attr, (a or []) + b)
+    if other.features:
+        into.features = {**(into.features or {}), **other.features}
+    if other.vector:
+        into.vector = other.vector
+    if other.geo:
+        into.geo = other.geo
+
+
+def _descend(props: Dict[str, Any], parts: List[str]) -> Dict[str, Any]:
+    node = props
+    for p in parts[:-1]:
+        node = node[p]["properties"]
+    return node[parts[-1]]
+
+
+def _parse_dynamic(value: Any) -> Any:
+    if value in ("strict",):
+        return "strict"
+    if value in (False, "false"):
+        return False
+    return True
+
+
+def _looks_like_date(s: str) -> bool:
+    if len(s) < 8 or not s[:4].isdigit():
+        return False
+    return s[4] in "-/" and any(c.isdigit() for c in s[5:7])

@@ -1,0 +1,18 @@
+from elasticsearch_tpu.utils.settings import (
+    Property,
+    Scope,
+    Setting,
+    Settings,
+    SettingsRegistry,
+)
+from elasticsearch_tpu.utils.murmur3 import murmur3_32, shard_id_for
+
+__all__ = [
+    "Property",
+    "Scope",
+    "Setting",
+    "Settings",
+    "SettingsRegistry",
+    "murmur3_32",
+    "shard_id_for",
+]

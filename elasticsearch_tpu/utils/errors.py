@@ -1,0 +1,151 @@
+"""Exception hierarchy.
+
+Mirrors the role of ``org.elasticsearch.ElasticsearchException`` and friends
+(reference: server/src/main/java/org/elasticsearch/ElasticsearchException.java):
+every error carries an HTTP status so the REST layer can map failures
+uniformly, and errors serialize to/from JSON for transport.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class SearchEngineError(Exception):
+    """Base for all engine errors. Carries an HTTP status code."""
+
+    status = 500
+
+    def __init__(self, message: str, **metadata: Any):
+        super().__init__(message)
+        self.message = message
+        self.metadata: Dict[str, Any] = metadata
+
+    @property
+    def error_type(self) -> str:
+        # e.g. IndexNotFoundError -> index_not_found_exception (ES-compatible suffix)
+        name = type(self).__name__
+        if name.endswith("Error"):
+            name = name[: -len("Error")]
+        out = []
+        for i, ch in enumerate(name):
+            if ch.isupper() and i > 0:
+                out.append("_")
+            out.append(ch.lower())
+        return "".join(out) + "_exception"
+
+    def to_json(self) -> Dict[str, Any]:
+        body: Dict[str, Any] = {"type": self.error_type, "reason": self.message}
+        body.update(self.metadata)
+        return body
+
+
+class IndexNotFoundError(SearchEngineError):
+    status = 404
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+        self.index = index
+
+
+class IndexAlreadyExistsError(SearchEngineError):
+    status = 400
+
+    def __init__(self, index: str):
+        super().__init__(f"index [{index}] already exists", index=index)
+
+
+class DocumentMissingError(SearchEngineError):
+    status = 404
+
+    def __init__(self, index: str, doc_id: str):
+        super().__init__(f"[{index}][{doc_id}]: document missing", index=index)
+
+
+class ShardNotFoundError(SearchEngineError):
+    status = 404
+
+
+class MapperParsingError(SearchEngineError):
+    status = 400
+
+
+class IllegalArgumentError(SearchEngineError):
+    status = 400
+
+
+class QueryParsingError(SearchEngineError):
+    status = 400
+
+
+class VersionConflictError(SearchEngineError):
+    """Optimistic-concurrency failure (seq_no/primary_term or version mismatch).
+
+    Reference analog: VersionConflictEngineException
+    (server/.../index/engine/VersionConflictEngineException.java).
+    """
+
+    status = 409
+
+
+class CircuitBreakingError(SearchEngineError):
+    """Memory budget exceeded; request rejected instead of OOMing the device.
+
+    Reference analog: common/breaker/CircuitBreakingException.java.
+    """
+
+    status = 429
+
+
+class RejectedExecutionError(SearchEngineError):
+    """Executor queue full. Reference analog: EsRejectedExecutionException."""
+
+    status = 429
+
+
+class ClusterBlockError(SearchEngineError):
+    """Operation blocked by cluster-level block (e.g. no master, read-only).
+
+    Reference analog: cluster/block/ClusterBlockException.java.
+    """
+
+    status = 503
+
+
+class NotMasterError(SearchEngineError):
+    status = 503
+
+
+class TaskCancelledError(SearchEngineError):
+    status = 400
+
+
+class TransportError(SearchEngineError):
+    status = 500
+
+
+class NodeDisconnectedError(TransportError):
+    status = 500
+
+
+class ReceiveTimeoutError(TransportError):
+    status = 500
+
+
+class SettingsError(IllegalArgumentError):
+    status = 400
+
+
+class SnapshotError(SearchEngineError):
+    status = 500
+
+
+class RecoveryFailedError(SearchEngineError):
+    status = 500
+
+
+def error_from_json(body: Dict[str, Any]) -> SearchEngineError:
+    """Rehydrate an error from its JSON form (transport deserialization)."""
+    err = SearchEngineError(body.get("reason", "unknown"))
+    err.metadata = {k: v for k, v in body.items() if k not in ("type", "reason")}
+    return err

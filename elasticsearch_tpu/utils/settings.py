@@ -1,0 +1,304 @@
+"""Typed settings registry.
+
+Mirrors the semantics of the reference's setting infrastructure —
+``Setting<T>`` (common/settings/Setting.java:87), ``ClusterSettings``
+(common/settings/ClusterSettings.java:125) and ``IndexScopedSettings``
+(common/settings/IndexScopedSettings.java:56) — re-expressed in Python:
+
+- every setting is declared once, typed, with scope + dynamicity + validator;
+- unknown settings are rejected at registration time (the registry doubles
+  as documentation and validation, like the reference);
+- dynamic updates flow through registered update-consumers.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Callable, Dict, Generic, Iterable, List, Mapping, Optional, TypeVar
+
+from elasticsearch_tpu.utils.errors import SettingsError
+
+T = TypeVar("T")
+
+
+class Scope(enum.Enum):
+    NODE = "node"          # static, from config file / env only
+    CLUSTER = "cluster"    # cluster-wide, possibly dynamic
+    INDEX = "index"        # per-index, validated against IndexScopedSettings
+
+
+class Property(enum.Flag):
+    NONE = 0
+    DYNAMIC = enum.auto()       # updatable at runtime
+    FINAL = enum.auto()         # may never change after creation
+    DEPRECATED = enum.auto()
+
+
+class Setting(Generic[T]):
+    """A single typed setting declaration."""
+
+    def __init__(
+        self,
+        key: str,
+        default: Any,
+        parser: Callable[[Any], T],
+        scope: Scope = Scope.NODE,
+        properties: Property = Property.NONE,
+        validator: Optional[Callable[[T], None]] = None,
+    ):
+        self.key = key
+        self._default = default  # value, or callable(settings) -> value
+        self.parser = parser
+        self.scope = scope
+        self.properties = properties
+        self.validator = validator
+
+    @property
+    def dynamic(self) -> bool:
+        return bool(self.properties & Property.DYNAMIC)
+
+    def default(self, settings: "Settings") -> T:
+        raw = self._default(settings) if callable(self._default) else self._default
+        return self.parse(raw)
+
+    def parse(self, raw: Any) -> T:
+        try:
+            value = self.parser(raw)
+        except (ValueError, TypeError) as e:
+            raise SettingsError(f"failed to parse setting [{self.key}] with value [{raw}]: {e}")
+        if self.validator is not None:
+            self.validator(value)
+        return value
+
+    def get(self, settings: "Settings") -> T:
+        raw = settings.raw_get(self.key)
+        if raw is None:
+            return self.default(settings)
+        return self.parse(raw)
+
+    def exists(self, settings: "Settings") -> bool:
+        return settings.raw_get(self.key) is not None
+
+    # ---- convenience constructors -------------------------------------
+    @staticmethod
+    def int_setting(key: str, default: int, min_value: Optional[int] = None,
+                    max_value: Optional[int] = None, scope: Scope = Scope.NODE,
+                    properties: Property = Property.NONE) -> "Setting[int]":
+        def validate(v: int) -> None:
+            if min_value is not None and v < min_value:
+                raise SettingsError(f"[{key}] must be >= {min_value}, got {v}")
+            if max_value is not None and v > max_value:
+                raise SettingsError(f"[{key}] must be <= {max_value}, got {v}")
+        return Setting(key, default, int, scope, properties, validate)
+
+    @staticmethod
+    def float_setting(key: str, default: float, min_value: Optional[float] = None,
+                      scope: Scope = Scope.NODE,
+                      properties: Property = Property.NONE) -> "Setting[float]":
+        def validate(v: float) -> None:
+            if min_value is not None and v < min_value:
+                raise SettingsError(f"[{key}] must be >= {min_value}, got {v}")
+        return Setting(key, default, float, scope, properties, validate)
+
+    @staticmethod
+    def bool_setting(key: str, default: bool, scope: Scope = Scope.NODE,
+                     properties: Property = Property.NONE) -> "Setting[bool]":
+        def parse(v: Any) -> bool:
+            if isinstance(v, bool):
+                return v
+            s = str(v).lower()
+            if s in ("true", "1", "yes"):
+                return True
+            if s in ("false", "0", "no"):
+                return False
+            raise ValueError(f"cannot parse boolean [{v}]")
+        return Setting(key, default, parse, scope, properties)
+
+    @staticmethod
+    def str_setting(key: str, default: str, scope: Scope = Scope.NODE,
+                    properties: Property = Property.NONE,
+                    choices: Optional[Iterable[str]] = None) -> "Setting[str]":
+        validator = None
+        if choices is not None:
+            allowed = set(choices)
+
+            def validator(v: str) -> None:
+                if v not in allowed:
+                    raise SettingsError(f"[{key}] must be one of {sorted(allowed)}, got [{v}]")
+        return Setting(key, default, str, scope, properties, validator)
+
+    @staticmethod
+    def time_setting(key: str, default: str, scope: Scope = Scope.NODE,
+                     properties: Property = Property.NONE) -> "Setting[float]":
+        """Time value in seconds; accepts '30s', '1m', '500ms', '2h', or a number."""
+        return Setting(key, default, parse_time_to_seconds, scope, properties)
+
+    @staticmethod
+    def bytes_setting(key: str, default: str, scope: Scope = Scope.NODE,
+                      properties: Property = Property.NONE) -> "Setting[int]":
+        """Byte size; accepts '512mb', '1gb', '10%' is NOT supported here, or int bytes."""
+        return Setting(key, default, parse_bytes, scope, properties)
+
+
+def parse_time_to_seconds(raw: Any) -> float:
+    if isinstance(raw, (int, float)):
+        return float(raw)
+    s = str(raw).strip().lower()
+    for suffix, mult in (("ms", 1e-3), ("s", 1.0), ("m", 60.0), ("h", 3600.0), ("d", 86400.0)):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * mult
+    return float(s)
+
+
+def parse_bytes(raw: Any) -> int:
+    if isinstance(raw, int):
+        return raw
+    s = str(raw).strip().lower()
+    for suffix, mult in (("kb", 1 << 10), ("mb", 1 << 20), ("gb", 1 << 30), ("tb", 1 << 40), ("b", 1)):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(s)
+
+
+class Settings:
+    """An immutable bag of raw setting values (string/number keyed by dotted key)."""
+
+    EMPTY: "Settings"
+
+    def __init__(self, values: Optional[Mapping[str, Any]] = None):
+        self._values: Dict[str, Any] = dict(_flatten(values or {}))
+
+    def raw_get(self, key: str) -> Any:
+        return self._values.get(key)
+
+    def keys(self) -> Iterable[str]:
+        return self._values.keys()
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def with_overrides(self, overrides: Mapping[str, Any]) -> "Settings":
+        merged = dict(self._values)
+        merged.update(_flatten(overrides))
+        # None value means "reset to default" (like ES null in settings update)
+        return Settings({k: v for k, v in merged.items() if v is not None})
+
+    def filter_prefix(self, prefix: str) -> "Settings":
+        return Settings({k: v for k, v in self._values.items() if k.startswith(prefix)})
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Settings) and self._values == other._values
+
+    def __repr__(self) -> str:
+        return f"Settings({self._values!r})"
+
+
+def _flatten(values: Mapping[str, Any], prefix: str = "") -> Dict[str, Any]:
+    """Accept nested dicts ({'index': {'number_of_shards': 2}}) or dotted keys."""
+    out: Dict[str, Any] = {}
+    for k, v in values.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, Mapping):
+            out.update(_flatten(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+Settings.EMPTY = Settings()
+
+
+class SettingsRegistry:
+    """Registry of declared settings for one scope; validates and dispatches updates.
+
+    Reference analog: AbstractScopedSettings / ClusterSettings
+    (common/settings/ClusterSettings.java:125).
+    """
+
+    def __init__(self, settings: Settings, declared: Iterable[Setting[Any]], scope: Scope):
+        self.scope = scope
+        self._declared: Dict[str, Setting[Any]] = {}
+        for s in declared:
+            if s.key in self._declared:
+                raise SettingsError(f"duplicate setting registration [{s.key}]")
+            self._declared[s.key] = s
+        self._lock = threading.Lock()
+        self._settings = settings
+        self._consumers: List[tuple] = []  # (setting, callback)
+        self.validate(settings)
+
+    @property
+    def current(self) -> Settings:
+        return self._settings
+
+    def register(self, setting: Setting[Any]) -> None:
+        """Late registration (plugins contribute settings)."""
+        with self._lock:
+            if setting.key in self._declared:
+                raise SettingsError(f"duplicate setting registration [{setting.key}]")
+            self._declared[setting.key] = setting
+
+    def get(self, setting: Setting[T]) -> T:
+        return setting.get(self._settings)
+
+    def get_by_key(self, key: str) -> Any:
+        s = self._declared.get(key)
+        if s is None:
+            raise SettingsError(f"unknown setting [{key}]")
+        return s.get(self._settings)
+
+    def validate(self, settings: Settings, allow_unknown_prefixes: Iterable[str] = ()) -> None:
+        """Unknown settings fail, like the reference's startup validation."""
+        for key in settings.keys():
+            if key in self._declared:
+                self._declared[key].parse(settings.raw_get(key))
+                continue
+            if any(key.startswith(p) for p in allow_unknown_prefixes):
+                continue
+            suggestion = _closest(key, self._declared.keys())
+            hint = f", did you mean [{suggestion}]?" if suggestion else ""
+            raise SettingsError(f"unknown setting [{key}]{hint}")
+
+    def add_settings_update_consumer(self, setting: Setting[T],
+                                     consumer: Callable[[T], None]) -> None:
+        if not setting.dynamic:
+            raise SettingsError(f"setting [{setting.key}] is not dynamic")
+        self._consumers.append((setting, consumer))
+
+    def apply_update(self, overrides: Mapping[str, Any]) -> Settings:
+        """Apply a dynamic settings update; rejects non-dynamic keys; fires consumers."""
+        flat = _flatten(overrides)
+        for key in flat:
+            s = self._declared.get(key)
+            if s is None:
+                raise SettingsError(f"unknown setting [{key}]")
+            if not s.dynamic:
+                raise SettingsError(f"setting [{key}] is not dynamically updateable")
+        with self._lock:
+            new_settings = self._settings.with_overrides(flat)
+            self.validate(new_settings)
+            old = self._settings
+            self._settings = new_settings
+        for setting, consumer in self._consumers:
+            new_val = setting.get(new_settings)
+            if setting.get(old) != new_val:
+                consumer(new_val)
+        return new_settings
+
+
+def _closest(key: str, candidates: Iterable[str]) -> Optional[str]:
+    """Cheap typo suggestion: smallest prefix-distance candidate."""
+    best, best_score = None, 0
+    for c in candidates:
+        score = len(_common_prefix(key, c))
+        if score > best_score:
+            best, best_score = c, score
+    return best if best_score >= 3 else None
+
+
+def _common_prefix(a: str, b: str) -> str:
+    i = 0
+    while i < min(len(a), len(b)) and a[i] == b[i]:
+        i += 1
+    return a[:i]

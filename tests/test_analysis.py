@@ -1,0 +1,133 @@
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry, porter_stem
+from elasticsearch_tpu.analysis.analyzers import (
+    ENGLISH, KEYWORD, SIMPLE, STANDARD, WHITESPACE,
+    make_edge_ngram_filter, make_shingle_filter, make_stop_filter,
+    standard_tokenizer,
+)
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+def test_standard_analyzer():
+    assert STANDARD.terms("The Quick Brown-Fox, 42 jumps!") == \
+        ["the", "quick", "brown", "fox", "42", "jumps"]
+
+
+def test_positions_and_offsets():
+    toks = standard_tokenizer("foo bar baz")
+    assert [t.position for t in toks] == [0, 1, 2]
+    assert (toks[1].start_offset, toks[1].end_offset) == (4, 7)
+
+
+def test_whitespace_and_keyword():
+    assert WHITESPACE.terms("Foo Bar") == ["Foo", "Bar"]
+    assert KEYWORD.terms("Foo Bar") == ["Foo Bar"]
+    assert SIMPLE.terms("a1b2") == ["a", "b"]
+
+
+def test_stopwords_preserve_positions():
+    toks = ENGLISH.analyze("the quick fox")
+    assert [t.term for t in toks] == ["quick", "fox"]
+    assert [t.position for t in toks] == [1, 2]  # hole at position 0
+
+
+def test_porter_stemmer():
+    cases = {
+        "caresses": "caress", "ponies": "poni", "cats": "cat",
+        "agreed": "agre", "plastered": "plaster", "motoring": "motor",
+        "conflated": "conflat", "happy": "happi", "relational": "relat",
+        "conditional": "condit", "vietnamization": "vietnam",
+        "adoption": "adopt", "formality": "formal", "probate": "probat",
+        "rate": "rate", "controlling": "control",
+    }
+    for word, stem in cases.items():
+        assert porter_stem(word) == stem, word
+
+
+def test_english_analyzer():
+    assert ENGLISH.terms("The running foxes jumped") == ["run", "fox", "jump"]
+
+
+def test_shingle_filter():
+    toks = standard_tokenizer("a b c")
+    out = make_shingle_filter(2, 2)(list(toks))
+    assert [t.term for t in out] == ["a", "a b", "b", "b c", "c"]
+
+
+def test_edge_ngram_filter():
+    toks = standard_tokenizer("fox")
+    out = make_edge_ngram_filter(1, 3)(list(toks))
+    assert [t.term for t in out] == ["f", "fo", "fox"]
+
+
+def test_custom_analyzer_from_settings():
+    reg = AnalysisRegistry({
+        "analyzer": {
+            "my_shingles": {
+                "type": "custom",
+                "tokenizer": "standard",
+                "filter": ["lowercase", "my_stop"],
+            },
+        },
+        "filter": {
+            "my_stop": {"type": "stop", "stopwords": ["foo"]},
+        },
+    })
+    assert reg.get("my_shingles").terms("Foo Bar") == ["bar"]
+    assert reg.get("standard").terms("X y") == ["x", "y"]
+
+
+def test_synonym_filter():
+    reg = AnalysisRegistry({
+        "analyzer": {
+            "syn": {"type": "custom", "tokenizer": "standard",
+                    "filter": ["lowercase", "my_syn"]},
+        },
+        "filter": {
+            "my_syn": {"type": "synonym", "synonyms": ["tv => television", "car, auto"]},
+        },
+    })
+    assert "television" in reg.get("syn").terms("TV")
+    terms = reg.get("syn").terms("car")
+    assert "car" in terms and "auto" in terms
+
+
+def test_html_strip_char_filter():
+    reg = AnalysisRegistry({
+        "analyzer": {
+            "html": {"type": "custom", "tokenizer": "standard",
+                     "filter": ["lowercase"], "char_filter": ["html_strip"]},
+        },
+    })
+    assert reg.get("html").terms("<b>Bold</b> text") == ["bold", "text"]
+
+
+def test_unknown_analyzer_raises():
+    with pytest.raises(IllegalArgumentError):
+        AnalysisRegistry().get("nope")
+
+
+def test_porter_single_rule_per_step4():
+    # 'professional' -> step2 gives 'profession'; the 'ion' special case must
+    # NOT fire a second time within step 4
+    assert porter_stem("professional") == "profession"
+    assert porter_stem("adoption") == "adopt"  # ion rule still fires alone
+
+
+def test_missing_type_in_custom_component_spec():
+    with pytest.raises(IllegalArgumentError, match="must declare a \\[type\\]"):
+        AnalysisRegistry({
+            "analyzer": {"a": {"type": "custom", "tokenizer": "mytok"}},
+            "tokenizer": {"mytok": {"min_gram": 1}},
+        })
+
+
+def test_builtin_analyzer_with_stopwords_param():
+    reg = AnalysisRegistry({"analyzer": {"b": {"type": "standard", "stopwords": ["x"]}}})
+    assert reg.get("b").terms("x y") == ["y"]
+
+
+def test_builtin_analyzer_rejects_unknown_params():
+    with pytest.raises(IllegalArgumentError, match="does not support parameters"):
+        AnalysisRegistry({"analyzer": {"b": {"type": "keyword", "whatever": 1}}})

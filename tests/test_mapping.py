@@ -1,0 +1,146 @@
+import pytest
+
+from elasticsearch_tpu.mapping import MapperService, parse_date_millis
+from elasticsearch_tpu.utils.errors import MapperParsingError
+
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text", "analyzer": "english"},
+        "tags": {"type": "keyword"},
+        "views": {"type": "long"},
+        "score": {"type": "double"},
+        "published": {"type": "date"},
+        "active": {"type": "boolean"},
+        "embedding": {"type": "dense_vector", "dims": 4, "similarity": "cosine"},
+        "expansion": {"type": "rank_features"},
+        "location": {"type": "geo_point"},
+        "author": {"properties": {"name": {"type": "keyword"}}},
+    }
+}
+
+
+def make_service():
+    return MapperService(MAPPING)
+
+
+def test_field_types():
+    svc = make_service()
+    assert svc.field_type("title") == "text"
+    assert svc.field_type("author.name") == "keyword"
+    assert svc.field_type("embedding") == "dense_vector"
+
+
+def test_parse_document_all_fields():
+    svc = make_service()
+    doc = svc.parse_document("1", {
+        "title": "The Running Foxes",
+        "tags": ["news", "animals"],
+        "views": 42,
+        "published": "2024-03-01T12:00:00Z",
+        "active": True,
+        "embedding": [0.1, 0.2, 0.3, 0.4],
+        "expansion": {"fox": 1.5, "animal": 0.7},
+        "location": {"lat": 40.7, "lon": -74.0},
+        "author": {"name": "alice"},
+    })
+    assert [t.term for t in doc.fields["title"].terms] == ["run", "fox"]
+    assert doc.fields["tags"].exact_terms == ["news", "animals"]
+    assert doc.fields["views"].numeric == [42.0]
+    assert doc.fields["active"].numeric == [1.0]
+    assert doc.fields["embedding"].vector == [0.1, 0.2, 0.3, 0.4]
+    assert doc.fields["expansion"].features == {"fox": 1.5, "animal": 0.7}
+    assert doc.fields["location"].geo == (40.7, -74.0)
+    assert doc.fields["author.name"].exact_terms == ["alice"]
+
+
+def test_dense_vector_dim_check():
+    svc = make_service()
+    with pytest.raises(MapperParsingError, match="expects 4 dims"):
+        svc.parse_document("1", {"embedding": [0.1, 0.2]})
+
+
+def test_rank_features_negative_weight_rejected():
+    svc = make_service()
+    with pytest.raises(MapperParsingError, match=">= 0"):
+        svc.parse_document("1", {"expansion": {"bad": -1.0}})
+
+
+def test_integer_range_enforced():
+    svc = MapperService({"properties": {"b": {"type": "byte"}}})
+    with pytest.raises(MapperParsingError, match="out of range"):
+        svc.parse_document("1", {"b": 1000})
+
+
+def test_dynamic_mapping_inference():
+    svc = MapperService()
+    doc = svc.parse_document("1", {"name": "bob", "age": 30, "ratio": 0.5,
+                                   "ok": True, "when": "2024-01-02"})
+    assert svc.field_type("name") == "text"
+    assert svc.field_type("name.keyword") == "keyword"
+    assert svc.field_type("age") == "long"
+    assert svc.field_type("ratio") == "double"
+    assert svc.field_type("ok") == "boolean"
+    assert svc.field_type("when") == "date"
+    assert doc.fields["name.keyword"].exact_terms == ["bob"]
+
+
+def test_strict_mapping_rejects_new_fields():
+    svc = MapperService({"properties": {"a": {"type": "keyword"}}}, dynamic="strict")
+    with pytest.raises(MapperParsingError, match="strict"):
+        svc.parse_document("1", {"b": "x"})
+
+
+def test_dynamic_false_ignores_new_fields():
+    svc = MapperService({"properties": {"a": {"type": "keyword"}}}, dynamic=False)
+    doc = svc.parse_document("1", {"a": "v", "b": "ignored"})
+    assert "b" not in doc.fields          # not indexed
+    assert doc.source["b"] == "ignored"   # still in _source
+    assert svc.field_type("b") is None
+
+
+def test_long_precision_preserved():
+    svc = MapperService({"properties": {"n": {"type": "long"}}})
+    big = 2**53 + 1
+    assert svc.parse_document("1", {"n": big}).fields["n"].numeric == [big]
+    assert svc.parse_document("1", {"n": 2**63 - 1}).fields["n"].numeric == [2**63 - 1]
+    with pytest.raises(MapperParsingError, match="out of range"):
+        svc.parse_document("1", {"n": 2**63})
+
+
+def test_bad_input_raises_mapper_parsing_not_raw():
+    svc = make_service()
+    with pytest.raises(MapperParsingError):
+        svc.parse_document("1", {"location": "12.3"})       # no comma
+    with pytest.raises(MapperParsingError):
+        svc.parse_document("1", {"embedding": ["a", "b", "c", "d"]})
+    with pytest.raises(MapperParsingError):
+        svc.parse_document("1", {"expansion": {"k": "not-a-number"}})
+
+
+def test_type_conflict_on_merge():
+    svc = MapperService({"properties": {"f": {"type": "keyword"}}})
+    with pytest.raises(MapperParsingError, match="cannot change type"):
+        svc.merge({"properties": {"f": {"type": "long"}}})
+
+
+def test_mapping_roundtrip():
+    svc = make_service()
+    out = svc.to_mapping()["properties"]
+    assert out["title"]["type"] == "text"
+    assert out["author"]["properties"]["name"]["type"] == "keyword"
+    assert out["embedding"]["dims"] == 4
+
+
+def test_date_parsing():
+    assert parse_date_millis(1700000000000) == 1700000000000.0
+    assert parse_date_millis("1970-01-01") == 0.0
+    assert parse_date_millis("1970-01-01T00:00:01Z") == 1000.0
+
+
+def test_multi_value_text_position_gap():
+    svc = MapperService({"properties": {"t": {"type": "text"}}})
+    doc = svc.parse_document("1", {"t": ["a b", "c"]})
+    positions = [t.position for t in doc.fields["t"].terms]
+    assert positions[0] == 0 and positions[1] == 1
+    assert positions[2] >= 100  # gap between array entries

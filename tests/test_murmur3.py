@@ -1,0 +1,33 @@
+from collections import Counter
+
+from elasticsearch_tpu.utils.murmur3 import murmur3_32, shard_id_for
+
+
+def test_known_vectors():
+    # Public murmur3_x86_32 test vectors (seed 0)
+    assert murmur3_32(b"") == 0
+    assert murmur3_32(b"a") == 0x3C2569B2
+    assert murmur3_32(b"abc") == 0xB3DD93FA
+    assert murmur3_32(b"hello") == 0x248BFA47
+    assert murmur3_32(b"hello, world", 0) == 345750399
+
+
+def test_seeded():
+    assert murmur3_32(b"", 1) == 0x514E28B7
+
+
+def test_stability():
+    assert shard_id_for("doc-1", 5) == shard_id_for("doc-1", 5)
+
+
+def test_distribution_uniformity():
+    n_shards = 8
+    counts = Counter(shard_id_for(f"doc-{i}", n_shards) for i in range(8000))
+    assert set(counts) == set(range(n_shards))
+    for c in counts.values():
+        assert 800 < c < 1200  # roughly uniform
+
+
+def test_routing_partition():
+    ids = {shard_id_for("same-key", 16, routing_partition_size=4) for _ in range(3)}
+    assert len(ids) == 1  # deterministic

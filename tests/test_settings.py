@@ -1,0 +1,76 @@
+import pytest
+
+from elasticsearch_tpu.utils.errors import SettingsError
+from elasticsearch_tpu.utils.settings import (
+    Property, Scope, Setting, Settings, SettingsRegistry,
+    parse_bytes, parse_time_to_seconds,
+)
+
+
+INT = Setting.int_setting("pool.size", 4, min_value=1, scope=Scope.CLUSTER,
+                          properties=Property.DYNAMIC)
+NAME = Setting.str_setting("node.name", "node-0")
+FLAG = Setting.bool_setting("search.cache.enabled", True, properties=Property.DYNAMIC)
+TIMEOUT = Setting.time_setting("ping.timeout", "30s")
+MEM = Setting.bytes_setting("buffer.size", "512mb")
+
+
+def make_registry(values=None):
+    return SettingsRegistry(Settings(values or {}), [INT, NAME, FLAG, TIMEOUT, MEM],
+                            Scope.CLUSTER)
+
+
+def test_defaults():
+    reg = make_registry()
+    assert reg.get(INT) == 4
+    assert reg.get(NAME) == "node-0"
+    assert reg.get(FLAG) is True
+    assert reg.get(TIMEOUT) == 30.0
+    assert reg.get(MEM) == 512 * 1024 * 1024
+
+
+def test_values_and_nested_flattening():
+    reg = make_registry({"pool": {"size": "8"}, "node.name": "n1"})
+    assert reg.get(INT) == 8
+    assert reg.get(NAME) == "n1"
+
+
+def test_unknown_setting_rejected_with_suggestion():
+    with pytest.raises(SettingsError, match="unknown setting"):
+        make_registry({"pool.siez": 8})
+
+
+def test_validator_enforced():
+    with pytest.raises(SettingsError, match="must be >= 1"):
+        make_registry({"pool.size": 0})
+
+
+def test_dynamic_update_fires_consumer():
+    reg = make_registry()
+    seen = []
+    reg.add_settings_update_consumer(INT, seen.append)
+    reg.apply_update({"pool.size": 16})
+    assert seen == [16]
+    assert reg.get(INT) == 16
+
+
+def test_non_dynamic_update_rejected():
+    reg = make_registry()
+    with pytest.raises(SettingsError, match="not dynamically updateable"):
+        reg.apply_update({"node.name": "other"})
+
+
+def test_null_resets_to_default():
+    reg = make_registry({"pool.size": 8})
+    assert reg.get(INT) == 8
+    reg.apply_update({"pool.size": None})
+    assert reg.get(INT) == 4
+
+
+def test_time_and_bytes_parsing():
+    assert parse_time_to_seconds("500ms") == 0.5
+    assert parse_time_to_seconds("2m") == 120
+    assert parse_time_to_seconds("1h") == 3600
+    assert parse_bytes("2kb") == 2048
+    assert parse_bytes("1gb") == 1 << 30
+    assert parse_bytes(42) == 42
