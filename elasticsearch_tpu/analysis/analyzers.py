@@ -249,10 +249,25 @@ def html_strip_char_filter(text: str) -> str:
 
 
 def make_mapping_char_filter(mappings: Dict[str, str]) -> Callable[[str], str]:
+    """Single left-to-right pass, longest key first; replacements are never
+    re-matched (reference MappingCharFilter semantics — {'a':'b','b':'c'}
+    maps 'a' to 'b', not 'c')."""
+    keys = sorted(mappings, key=len, reverse=True)
+
     def apply(text: str) -> str:
-        for k, v in mappings.items():
-            text = text.replace(k, v)
-        return text
+        out: List[str] = []
+        i = 0
+        n = len(text)
+        while i < n:
+            for k in keys:
+                if k and text.startswith(k, i):
+                    out.append(mappings[k])
+                    i += len(k)
+                    break
+            else:
+                out.append(text[i])
+                i += 1
+        return "".join(out)
 
     return apply
 

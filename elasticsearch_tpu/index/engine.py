@@ -1,0 +1,431 @@
+"""The per-shard storage engine.
+
+Reference analog: ``InternalEngine`` (index/engine/InternalEngine.java:121):
+- ``index()`` (:831): version-conflict plan via a live version map (:879),
+  write into the in-memory buffer (≈ indexIntoLucene :1030) and the translog
+  (:899);
+- ``refresh()`` (:1533): buffered docs become a new immutable searchable
+  segment; queued update/delete tombstones flip live bits on older segments
+  (Lucene delete-by-term at refresh);
+- ``flush()`` (:489): refresh + persist segments + commit point + translog
+  generation rollover/trim;
+- merges: background-policy'd re-pack of small segments purging deletes.
+
+TPU divergence: a "Lucene document write" is a host-side parsed-columns
+append; device arrays are built lazily per segment by the search layer, so
+indexing never blocks on device work.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.index.segment import Segment, SegmentBuilder, merge_segments
+from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
+from elasticsearch_tpu.index.store import Store
+from elasticsearch_tpu.index.translog import Translog, TranslogOp
+from elasticsearch_tpu.mapping import MapperService, ParsedDocument
+from elasticsearch_tpu.utils.errors import VersionConflictError
+
+
+@dataclass
+class VersionEntry:
+    seqno: int
+    primary_term: int
+    version: int
+    deleted: bool = False
+
+
+@dataclass
+class EngineResult:
+    doc_id: str
+    seqno: int
+    primary_term: int
+    version: int
+    result: str               # 'created' | 'updated' | 'deleted' | 'noop' | 'not_found'
+
+
+class Reader:
+    """An immutable point-in-time view of the searchable segments.
+
+    Reference analog: the Lucene ``IndexReader`` acquired per search from the
+    engine (Engine.acquireSearcher). Live masks are snapshotted so concurrent
+    deletes don't shift results mid-search (scroll contexts hold Readers).
+    """
+
+    def __init__(self, segments: List[Segment]):
+        self.segments = list(segments)
+        self.live_masks = [seg.live.copy() for seg in segments]
+
+    @property
+    def doc_count(self) -> int:
+        return int(sum(m.sum() for m in self.live_masks))
+
+    def get(self, doc_id: str) -> Optional[Tuple[Segment, int]]:
+        # newest segment wins (an id can appear in older segments as a
+        # tombstoned entry)
+        for seg, mask in zip(reversed(self.segments), reversed(self.live_masks)):
+            d = seg.id_to_doc.get(doc_id)
+            if d is not None and mask[d]:
+                return seg, d
+        return None
+
+
+class InternalEngine:
+    def __init__(self, mapper_service: MapperService,
+                 store: Optional[Store] = None,
+                 translog: Optional[Translog] = None,
+                 primary_term: int = 1,
+                 shard_label: str = "shard0"):
+        self.mappers = mapper_service
+        self.store = store
+        self.translog = translog
+        self.primary_term = primary_term
+        self.shard_label = shard_label
+        self.tracker = LocalCheckpointTracker()
+
+        self._lock = threading.RLock()
+        self.segments: List[Segment] = []
+        self._buffer: Dict[str, Tuple[ParsedDocument, int, int, int]] = {}  # id -> (doc, seqno, version, primary_term)
+        self._buffer_order: List[str] = []
+        self._version_map: Dict[str, VersionEntry] = {}
+        # deletes that must be applied to already-searchable segments at refresh
+        self._pending_tombstones: List[str] = []
+        self._segment_counter = 0
+        self._commit_generation = 0
+        self._dirty_live: set = set()   # segments whose live mask changed since last flush
+        self.refresh_listeners: List[Callable[[], None]] = []
+
+    # ------------------------------------------------------------------
+    # write path
+    # ------------------------------------------------------------------
+
+    def index(self, doc_id: str, source: Dict[str, Any],
+              routing: Optional[str] = None,
+              op_type: str = "index",
+              if_seq_no: Optional[int] = None,
+              if_primary_term: Optional[int] = None,
+              seqno: Optional[int] = None,
+              version: Optional[int] = None,
+              primary_term: Optional[int] = None) -> EngineResult:
+        """Index a document. Primary path assigns seqno/version; replica path
+        (seqno/version given) applies without conflict checks, mirroring
+        TransportShardBulkAction primary vs replica ops
+        (action/bulk/TransportShardBulkAction.java:141,410)."""
+        with self._lock:
+            is_replica = seqno is not None
+            existing = self._version_map.get(doc_id)
+            if not is_replica:
+                if op_type == "create" and existing is not None and not existing.deleted:
+                    raise VersionConflictError(
+                        f"[{doc_id}]: version conflict, document already exists "
+                        f"(current version [{existing.version}])")
+                if if_seq_no is not None or if_primary_term is not None:
+                    if existing is None or existing.deleted:
+                        raise VersionConflictError(
+                            f"[{doc_id}]: version conflict, document does not exist")
+                    if (if_seq_no is not None and existing.seqno != if_seq_no) or \
+                       (if_primary_term is not None and existing.primary_term != if_primary_term):
+                        raise VersionConflictError(
+                            f"[{doc_id}]: version conflict, required seqno [{if_seq_no}], "
+                            f"primary term [{if_primary_term}], "
+                            f"current document has seqNo [{existing.seqno}] and "
+                            f"primary term [{existing.primary_term}]")
+                seqno = self.tracker.generate_seqno()
+                # version continues past delete tombstones (ES semantics:
+                # index v1,v2, delete v3, re-index -> v4)
+                version = (existing.version + 1) if existing is not None else 1
+                primary_term = self.primary_term
+            else:
+                primary_term = primary_term or self.primary_term
+                version = version or 1
+
+            created = existing is None or existing.deleted
+            parsed = self.mappers.parse_document(doc_id, source, routing)
+
+            if self.translog is not None:
+                self.translog.add(TranslogOp("index", seqno, primary_term,
+                                             doc_id=doc_id, source=source,
+                                             routing=routing, version=version))
+
+            if doc_id not in self._buffer:
+                self._buffer_order.append(doc_id)
+                if existing is not None and not existing.deleted:
+                    # live copy exists in a searchable segment: tombstone at refresh
+                    self._pending_tombstones.append(doc_id)
+            self._buffer[doc_id] = (parsed, seqno, version, primary_term)
+            self._version_map[doc_id] = VersionEntry(seqno, primary_term, version)
+            self.tracker.mark_processed(seqno)
+            return EngineResult(doc_id, seqno, primary_term, version,
+                                "created" if created else "updated")
+
+    def delete(self, doc_id: str,
+               if_seq_no: Optional[int] = None,
+               if_primary_term: Optional[int] = None,
+               seqno: Optional[int] = None,
+               version: Optional[int] = None,
+               primary_term: Optional[int] = None) -> EngineResult:
+        with self._lock:
+            is_replica = seqno is not None
+            existing = self._version_map.get(doc_id)
+            if not is_replica:
+                if if_seq_no is not None or if_primary_term is not None:
+                    if existing is None or existing.deleted:
+                        raise VersionConflictError(
+                            f"[{doc_id}]: version conflict, document does not exist")
+                    if (if_seq_no is not None and existing.seqno != if_seq_no) or \
+                       (if_primary_term is not None and existing.primary_term != if_primary_term):
+                        raise VersionConflictError(f"[{doc_id}]: version conflict on delete")
+                seqno = self.tracker.generate_seqno()
+                version = (existing.version + 1) if existing is not None else 1
+                primary_term = self.primary_term
+            else:
+                primary_term = primary_term or self.primary_term
+                version = version or 1
+
+            found = existing is not None and not existing.deleted
+            if self.translog is not None:
+                self.translog.add(TranslogOp("delete", seqno, primary_term,
+                                             doc_id=doc_id, version=version))
+            if doc_id in self._buffer:
+                del self._buffer[doc_id]
+                self._buffer_order.remove(doc_id)
+            if found:
+                self._pending_tombstones.append(doc_id)
+            self._version_map[doc_id] = VersionEntry(seqno, primary_term, version, deleted=True)
+            self.tracker.mark_processed(seqno)
+            return EngineResult(doc_id, seqno, primary_term, version,
+                                "deleted" if found else "not_found")
+
+    def noop(self, seqno: int, reason: str = "") -> None:
+        """Fill a seqno hole (primary failover safety), reference: Engine.noOp."""
+        with self._lock:
+            if self.translog is not None:
+                self.translog.add(TranslogOp("noop", seqno, self.primary_term, reason=reason))
+            self.tracker.mark_processed(seqno)
+
+    # ------------------------------------------------------------------
+    # read path
+    # ------------------------------------------------------------------
+
+    def get(self, doc_id: str, realtime: bool = True) -> Optional[Dict[str, Any]]:
+        """Realtime get: buffer first (translog-get analog), then segments."""
+        with self._lock:
+            entry = self._version_map.get(doc_id)
+            if entry is not None and entry.deleted:
+                return None
+            if realtime and doc_id in self._buffer:
+                parsed, seqno, version, term = self._buffer[doc_id]
+                return {"_id": doc_id, "_source": parsed.source,
+                        "_seq_no": seqno, "_version": version,
+                        "_primary_term": term}
+            reader = self.acquire_reader()
+        hit = reader.get(doc_id)
+        if hit is None:
+            return None
+        seg, d = hit
+        return {"_id": doc_id, "_source": seg.sources[d],
+                "_seq_no": int(seg.seqnos[d]) if len(seg.seqnos) > d else 0,
+                "_version": int(seg.versions[d]) if len(seg.versions) > d else 1,
+                "_primary_term": int(seg.primary_terms[d]) if len(seg.primary_terms) > d else 1}
+
+    def acquire_reader(self) -> Reader:
+        with self._lock:
+            return Reader(self.segments)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def refresh(self) -> bool:
+        """Make buffered writes searchable. Returns True if anything changed."""
+        with self._lock:
+            if not self._buffer and not self._pending_tombstones:
+                return False
+            # apply tombstones to existing segments (newest copy wins search)
+            for doc_id in self._pending_tombstones:
+                for seg in self.segments:
+                    d = seg.id_to_doc.get(doc_id)
+                    if d is not None and seg.live[d]:
+                        seg.delete_doc(d)
+                        self._dirty_live.add(seg.name)
+            self._pending_tombstones.clear()
+
+            if self._buffer:
+                self._segment_counter += 1
+                builder = SegmentBuilder(
+                    f"{self.shard_label}_seg{self._segment_counter}", self.mappers)
+                for doc_id in self._buffer_order:
+                    parsed, seqno, version, term = self._buffer[doc_id]
+                    builder.add(parsed, seqno, version, term)
+                self.segments.append(builder.build())
+                self._buffer.clear()
+                self._buffer_order.clear()
+            listeners = list(self.refresh_listeners)
+        for fn in listeners:
+            fn()
+        return True
+
+    def flush(self) -> None:
+        """Commit: refresh, persist, roll translog. Reference: InternalEngine.flush:489."""
+        with self._lock:
+            self.refresh()
+            if self.store is None:
+                return
+            committed = set()
+            commit = self.store.read_latest_commit()
+            if commit:
+                committed = set(commit["segments"])
+            for seg in self.segments:
+                if seg.name not in committed:
+                    self.store.write_segment(seg)
+                elif seg.name in self._dirty_live:
+                    self.store.write_live_mask(seg)
+            self._dirty_live.clear()
+            translog_gen = self.translog.rollover() if self.translog is not None else 0
+            self._commit_generation += 1
+            self.store.write_commit(
+                self._commit_generation,
+                [seg.name for seg in self.segments],
+                self.tracker.max_seqno,
+                self.tracker.checkpoint,
+                translog_gen,
+            )
+            if self.translog is not None:
+                self.translog.trim_below(translog_gen)
+            # remove orphaned segment files from superseded merges
+            on_disk = set(self.store.list_segment_files())
+            current = {seg.name for seg in self.segments}
+            for name in on_disk - current:
+                self.store.delete_segment(name)
+
+    def maybe_merge(self, max_segments: int = 8) -> bool:
+        """Tiered-lite merge policy: when segment count exceeds the budget,
+        merge the smallest half into one (purging deletes)."""
+        with self._lock:
+            if len(self.segments) <= max_segments:
+                return False
+            by_size = sorted(self.segments, key=lambda s: s.live_count)
+            to_merge = by_size[: len(by_size) // 2 + 1]
+            return self._merge(to_merge)
+
+    def force_merge(self, max_num_segments: int = 1) -> bool:
+        with self._lock:
+            if len(self.segments) <= max_num_segments and not any(
+                    not seg.live.all() for seg in self.segments):
+                return False
+            return self._merge(list(self.segments))
+
+    def _merge(self, to_merge: List[Segment]) -> bool:
+        self._segment_counter += 1
+        merged = merge_segments(
+            f"{self.shard_label}_seg{self._segment_counter}", to_merge, self.mappers)
+        self.segments = _insert_merged(merged, self.segments, to_merge)
+        return True
+
+    # ------------------------------------------------------------------
+    # recovery
+    # ------------------------------------------------------------------
+
+    def recover_from_store(self) -> int:
+        """Open the last commit and replay the translog tail.
+
+        Reference analog: InternalEngine opening the last Lucene commit and
+        replaying translog ops > local_checkpoint (crash recovery, §5.4).
+        Returns the number of replayed ops.
+        """
+        with self._lock:
+            commit = self.store.read_latest_commit() if self.store else None
+            if commit:
+                for name in commit["segments"]:
+                    seg = self.store.read_segment(name)
+                    liv = self.store.read_live_mask(name)
+                    if liv is not None:
+                        seg.live = liv
+                    self.segments.append(seg)
+                    num = int(name.rsplit("_seg", 1)[1]) if "_seg" in name else 0
+                    self._segment_counter = max(self._segment_counter, num)
+                self._commit_generation = commit["generation"]
+                self.tracker = LocalCheckpointTracker(
+                    commit["max_seqno"], commit["local_checkpoint"])
+                # mark seqnos persisted in segments as processed
+                for seg in self.segments:
+                    for s in seg.seqnos:
+                        self.tracker.mark_processed(int(s))
+            # rebuild version map from segments (newest segment wins)
+            for seg in self.segments:
+                for doc_id, d in seg.id_to_doc.items():
+                    if seg.live[d]:
+                        self._version_map[doc_id] = VersionEntry(
+                            int(seg.seqnos[d]) if len(seg.seqnos) > d else 0,
+                            int(seg.primary_terms[d]) if len(seg.primary_terms) > d else 1,
+                            int(seg.versions[d]) if len(seg.versions) > d else 1)
+
+            replayed = 0
+            if self.translog is not None:
+                start = self.tracker.checkpoint + 1
+                # snapshot before replaying: _replay re-logs each op into the
+                # current generation, which read_all would otherwise also see
+                ops = list(self.translog.read_all(min_seqno=start))
+                for op in ops:
+                    self._replay(op)
+                    replayed += 1
+            # commit the replayed state so the translog is trimmed; otherwise
+            # every crash/recover cycle doubles the translog (replayed ops are
+            # re-logged into the new generation)
+            if self.store is not None:
+                self.flush()
+            else:
+                self.refresh()
+            return replayed
+
+    def _replay(self, op: TranslogOp) -> None:
+        if op.op_type == "index":
+            self.index(op.doc_id, op.source, routing=op.routing,
+                       seqno=op.seqno, version=op.version, primary_term=op.primary_term)
+        elif op.op_type == "delete":
+            self.delete(op.doc_id, seqno=op.seqno, version=op.version,
+                        primary_term=op.primary_term)
+        elif op.op_type == "noop":
+            self.tracker.mark_processed(op.seqno)
+
+    # ------------------------------------------------------------------
+
+    @property
+    def doc_count(self) -> int:
+        """Searchable doc count (buffer not visible until refresh)."""
+        with self._lock:
+            return sum(seg.live_count for seg in self.segments)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "num_segments": len(self.segments),
+                "doc_count": self.doc_count,
+                "buffered_docs": len(self._buffer),
+                "max_seqno": self.tracker.max_seqno,
+                "local_checkpoint": self.tracker.checkpoint,
+                "translog_ops": self.translog.total_ops if self.translog else 0,
+            }
+
+    def close(self) -> None:
+        if self.translog is not None:
+            self.translog.close()
+
+
+def _insert_merged(merged: Segment, original: List[Segment],
+                   merged_from: List[Segment]) -> List[Segment]:
+    """Place the merged segment at the position of its oldest constituent so
+    newest-wins id lookups (Reader.get) stay correct."""
+    out: List[Segment] = []
+    inserted = False
+    for seg in original:
+        if seg in merged_from:
+            if not inserted:
+                out.append(merged)
+                inserted = True
+            continue
+        out.append(seg)
+    return out

@@ -1,0 +1,182 @@
+"""Sequence numbers, checkpoints, retention leases.
+
+Reference analogs:
+- LocalCheckpointTracker (index/seqno/LocalCheckpointTracker.java:31): issues
+  seqnos on the primary and tracks the highest contiguous persisted seqno
+  (the local checkpoint) on every copy.
+- ReplicationTracker (index/seqno/ReplicationTracker.java:80): primary-side
+  knowledge of every in-sync copy's local checkpoint; the global checkpoint is
+  the minimum across the in-sync set; retention leases
+  (ReplicationTracker.java:511) keep translog history for cheap ops-based
+  re-sync of temporarily departed replicas.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+NO_OPS_PERFORMED = -1
+UNASSIGNED_SEQ_NO = -2
+
+
+class LocalCheckpointTracker:
+    """Tracks processed seqnos; checkpoint = highest n with all of [0..n] processed."""
+
+    def __init__(self, max_seqno: int = NO_OPS_PERFORMED,
+                 local_checkpoint: int = NO_OPS_PERFORMED):
+        self._max_seqno = max_seqno
+        self._checkpoint = local_checkpoint
+        self._pending: Set[int] = set()  # processed seqnos above the checkpoint
+
+    def generate_seqno(self) -> int:
+        self._max_seqno += 1
+        return self._max_seqno
+
+    def advance_max_seqno(self, seqno: int) -> None:
+        """A replica observed a primary-assigned seqno."""
+        if seqno > self._max_seqno:
+            self._max_seqno = seqno
+
+    def mark_processed(self, seqno: int) -> None:
+        if seqno <= self._checkpoint:
+            return
+        self.advance_max_seqno(seqno)
+        self._pending.add(seqno)
+        while (self._checkpoint + 1) in self._pending:
+            self._checkpoint += 1
+            self._pending.discard(self._checkpoint)
+
+    @property
+    def checkpoint(self) -> int:
+        return self._checkpoint
+
+    @property
+    def max_seqno(self) -> int:
+        return self._max_seqno
+
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+
+@dataclass
+class RetentionLease:
+    id: str
+    retaining_seqno: int
+    timestamp: float
+    source: str
+
+
+class ReplicationTracker:
+    """Primary-side replication group bookkeeping.
+
+    in_sync allocation ids contribute to the global checkpoint; tracked-but-
+    not-in-sync copies (recovering) are observed but don't hold it back until
+    they finish recovery and are marked in-sync.
+    """
+
+    def __init__(self, shard_allocation_id: str, local_tracker: LocalCheckpointTracker,
+                 lease_retention_seconds: float = 12 * 3600):
+        self.allocation_id = shard_allocation_id
+        self.local = local_tracker
+        self._in_sync: Set[str] = {shard_allocation_id}
+        self._tracked: Set[str] = {shard_allocation_id}
+        self._checkpoints: Dict[str, int] = {shard_allocation_id: NO_OPS_PERFORMED}
+        self._global_checkpoint = NO_OPS_PERFORMED
+        self._leases: Dict[str, RetentionLease] = {}
+        self._lease_retention = lease_retention_seconds
+        self.primary_mode = True
+
+    # -- membership ------------------------------------------------------
+
+    def init_tracking(self, allocation_id: str) -> None:
+        """A new copy starts recovery: track it, not yet in-sync."""
+        self._tracked.add(allocation_id)
+        self._checkpoints.setdefault(allocation_id, NO_OPS_PERFORMED)
+
+    def mark_in_sync(self, allocation_id: str, local_checkpoint: int) -> None:
+        """Promote a tracked copy to in-sync. The copy must have caught up to
+        the global checkpoint first (recovery finalization waits for this in
+        the reference, RecoverySourceHandler.finalizeRecovery) — otherwise
+        acknowledged writes above its checkpoint could be lost on failover."""
+        if local_checkpoint < self._global_checkpoint:
+            raise ValueError(
+                f"cannot mark [{allocation_id}] in sync: its local checkpoint "
+                f"[{local_checkpoint}] is below the global checkpoint "
+                f"[{self._global_checkpoint}]")
+        self._checkpoints[allocation_id] = local_checkpoint
+        self._tracked.add(allocation_id)
+        self._in_sync.add(allocation_id)
+        self._recompute_global()
+
+    def remove_copy(self, allocation_id: str) -> None:
+        if allocation_id == self.allocation_id:
+            return
+        self._in_sync.discard(allocation_id)
+        self._tracked.discard(allocation_id)
+        self._checkpoints.pop(allocation_id, None)
+        self._recompute_global()
+
+    @property
+    def in_sync_ids(self) -> Set[str]:
+        return set(self._in_sync)
+
+    # -- checkpoints -----------------------------------------------------
+
+    def update_local_checkpoint(self, allocation_id: str, checkpoint: int) -> None:
+        prev = self._checkpoints.get(allocation_id, NO_OPS_PERFORMED)
+        if checkpoint > prev:
+            self._checkpoints[allocation_id] = checkpoint
+            self._recompute_global()
+
+    def _recompute_global(self) -> None:
+        self._checkpoints[self.allocation_id] = self.local.checkpoint
+        if not self._in_sync:
+            return
+        new_global = min(self._checkpoints.get(a, NO_OPS_PERFORMED) for a in self._in_sync)
+        if new_global > self._global_checkpoint:
+            self._global_checkpoint = new_global
+
+    @property
+    def global_checkpoint(self) -> int:
+        self._recompute_global()
+        return self._global_checkpoint
+
+    def update_global_checkpoint_on_replica(self, checkpoint: int) -> None:
+        """Replica learns the global checkpoint from the primary's piggyback."""
+        if checkpoint > self._global_checkpoint:
+            self._global_checkpoint = checkpoint
+
+    # -- retention leases ------------------------------------------------
+
+    def add_lease(self, lease_id: str, retaining_seqno: int, source: str) -> RetentionLease:
+        lease = RetentionLease(lease_id, retaining_seqno, time.monotonic(), source)
+        self._leases[lease_id] = lease
+        return lease
+
+    def renew_lease(self, lease_id: str, retaining_seqno: int) -> None:
+        lease = self._leases.get(lease_id)
+        if lease is not None:
+            lease.retaining_seqno = max(lease.retaining_seqno, retaining_seqno)
+            lease.timestamp = time.monotonic()
+
+    def remove_lease(self, lease_id: str) -> None:
+        self._leases.pop(lease_id, None)
+
+    def expire_leases(self, now: Optional[float] = None) -> List[str]:
+        now = time.monotonic() if now is None else now
+        expired = [lid for lid, l in self._leases.items()
+                   if now - l.timestamp > self._lease_retention]
+        for lid in expired:
+            del self._leases[lid]
+        return expired
+
+    def min_retained_seqno(self) -> int:
+        """History below this may be discarded (translog trim / merge purge)."""
+        if self._leases:
+            return min(l.retaining_seqno for l in self._leases.values())
+        return self.global_checkpoint + 1
+
+    def leases(self) -> List[RetentionLease]:
+        return list(self._leases.values())

@@ -1,0 +1,255 @@
+"""On-disk segment format and commit points.
+
+Reference analog: index/store/Store.java:130 + Lucene's segment files and
+commit points (segments_N). Layout per shard directory:
+
+    segments/<name>.npz        all numpy arrays, path-keyed
+    segments/<name>.meta.json  dicts (term tables), ids, sources, field meta
+    commit-<gen>.json          commit point: segment list, seqno watermarks
+    translog/                  WAL (translog.py)
+
+Arrays and metadata are written to temp files and atomically renamed; a
+commit point only references fully-written segments (write-once, like
+Lucene's flush-then-commit discipline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.index.segment import (
+    DocValuesField, FeaturesField, KeywordField, PostingsField, Segment, VectorField,
+)
+
+
+class Store:
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        (self.path / "segments").mkdir(parents=True, exist_ok=True)
+
+    # -- segments --------------------------------------------------------
+
+    def write_segment(self, seg: Segment) -> None:
+        arrays: Dict[str, np.ndarray] = {}
+        meta: Dict[str, Any] = {
+            "name": seg.name, "n_docs": seg.n_docs,
+            "ids": seg.ids, "sources": seg.sources,
+            "fields": {"postings": {}, "keywords": {}, "doc_values": {},
+                       "vectors": {}, "features": {}, "geo": []},
+        }
+        arrays["live"] = seg.live
+        arrays["seqnos"] = seg.seqnos
+        arrays["versions"] = seg.versions
+        arrays["primary_terms"] = seg.primary_terms
+
+        for fname, pf in seg.postings.items():
+            k = f"p.{fname}"
+            term_list = [""] * len(pf.terms)
+            for t, tid in pf.terms.items():
+                term_list[tid] = t
+            meta["fields"]["postings"][fname] = {
+                "terms": term_list, "sum_doc_len": pf.sum_doc_len}
+            arrays[f"{k}.block_docs"] = pf.block_docs
+            arrays[f"{k}.block_tfs"] = pf.block_tfs
+            arrays[f"{k}.block_term"] = pf.block_term
+            arrays[f"{k}.block_max_tf"] = pf.block_max_tf
+            arrays[f"{k}.term_block_start"] = pf.term_block_start
+            arrays[f"{k}.term_block_count"] = pf.term_block_count
+            arrays[f"{k}.doc_freq"] = pf.doc_freq
+            arrays[f"{k}.doc_lens"] = pf.doc_lens
+            arrays[f"{k}.pos_offsets"] = pf.pos_offsets
+            arrays[f"{k}.pos_flat"] = pf.pos_flat
+
+        for fname, kf in seg.keywords.items():
+            k = f"k.{fname}"
+            meta["fields"]["keywords"][fname] = {"terms": kf.term_list}
+            arrays[f"{k}.ord_values"] = kf.ord_values
+            arrays[f"{k}.ord_offsets"] = kf.ord_offsets
+            arrays[f"{k}.doc_freq"] = kf.doc_freq
+
+        for fname, dv in seg.doc_values.items():
+            k = f"d.{fname}"
+            meta["fields"]["doc_values"][fname] = {
+                "multi": {str(i): v for i, v in dv.multi.items()}}
+            arrays[f"{k}.values"] = dv.values
+            arrays[f"{k}.exists"] = dv.exists
+
+        for fname, vf in seg.vectors.items():
+            k = f"v.{fname}"
+            meta["fields"]["vectors"][fname] = {"similarity": vf.similarity, "dims": vf.dims}
+            arrays[f"{k}.matrix"] = vf.matrix
+            arrays[f"{k}.exists"] = vf.exists
+            arrays[f"{k}.norms"] = vf.norms
+
+        for fname, ff in seg.features.items():
+            k = f"f.{fname}"
+            feat_list = [""] * len(ff.features)
+            for t, fid in ff.features.items():
+                feat_list[fid] = t
+            meta["fields"]["features"][fname] = {"features": feat_list}
+            arrays[f"{k}.block_docs"] = ff.block_docs
+            arrays[f"{k}.block_weights"] = ff.block_weights
+            arrays[f"{k}.block_max_weight"] = ff.block_max_weight
+            arrays[f"{k}.feat_block_start"] = ff.feat_block_start
+            arrays[f"{k}.feat_block_count"] = ff.feat_block_count
+            arrays[f"{k}.doc_freq"] = ff.doc_freq
+
+        for fname, arr in seg.geo.items():
+            meta["fields"]["geo"].append(fname)
+            arrays[f"g.{fname}"] = arr
+
+        seg_dir = self.path / "segments"
+        npz_tmp = seg_dir / f".{seg.name}.npz.tmp"
+        with open(npz_tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        meta_tmp = seg_dir / f".{seg.name}.meta.json.tmp"
+        with open(meta_tmp, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(npz_tmp, seg_dir / f"{seg.name}.npz")
+        os.replace(meta_tmp, seg_dir / f"{seg.name}.meta.json")
+
+    def read_segment(self, name: str) -> Segment:
+        seg_dir = self.path / "segments"
+        with open(seg_dir / f"{name}.meta.json") as f:
+            meta = json.load(f)
+        data = np.load(seg_dir / f"{name}.npz")
+
+        seg = Segment(meta["name"], meta["n_docs"])
+        seg.ids = meta["ids"]
+        seg.sources = meta["sources"]
+        seg.id_to_doc = {doc_id: i for i, doc_id in enumerate(seg.ids)}
+        seg.live = data["live"]
+        seg.seqnos = data["seqnos"]
+        seg.versions = data["versions"] if "versions" in data else np.ones(seg.n_docs, np.int64)
+        seg.primary_terms = (data["primary_terms"] if "primary_terms" in data
+                             else np.ones(seg.n_docs, np.int64))
+
+        for fname, fmeta in meta["fields"]["postings"].items():
+            k = f"p.{fname}"
+            seg.postings[fname] = PostingsField(
+                terms={t: i for i, t in enumerate(fmeta["terms"])},
+                block_docs=data[f"{k}.block_docs"],
+                block_tfs=data[f"{k}.block_tfs"],
+                block_term=data[f"{k}.block_term"],
+                block_max_tf=data[f"{k}.block_max_tf"],
+                term_block_start=data[f"{k}.term_block_start"],
+                term_block_count=data[f"{k}.term_block_count"],
+                doc_freq=data[f"{k}.doc_freq"],
+                doc_lens=data[f"{k}.doc_lens"],
+                sum_doc_len=fmeta["sum_doc_len"],
+                pos_offsets=data[f"{k}.pos_offsets"],
+                pos_flat=data[f"{k}.pos_flat"],
+            )
+        for fname, fmeta in meta["fields"]["keywords"].items():
+            k = f"k.{fname}"
+            seg.keywords[fname] = KeywordField(
+                terms={t: i for i, t in enumerate(fmeta["terms"])},
+                ord_values=data[f"{k}.ord_values"],
+                ord_offsets=data[f"{k}.ord_offsets"],
+                doc_freq=data[f"{k}.doc_freq"],
+                term_list=fmeta["terms"],
+            )
+        for fname, fmeta in meta["fields"]["doc_values"].items():
+            k = f"d.{fname}"
+            seg.doc_values[fname] = DocValuesField(
+                values=data[f"{k}.values"],
+                exists=data[f"{k}.exists"],
+                multi={int(i): v for i, v in fmeta["multi"].items()},
+            )
+        for fname, fmeta in meta["fields"]["vectors"].items():
+            k = f"v.{fname}"
+            seg.vectors[fname] = VectorField(
+                matrix=data[f"{k}.matrix"],
+                exists=data[f"{k}.exists"],
+                norms=data[f"{k}.norms"],
+                similarity=fmeta["similarity"],
+                dims=fmeta["dims"],
+            )
+        for fname, fmeta in meta["fields"]["features"].items():
+            k = f"f.{fname}"
+            seg.features[fname] = FeaturesField(
+                features={t: i for i, t in enumerate(fmeta["features"])},
+                block_docs=data[f"{k}.block_docs"],
+                block_weights=data[f"{k}.block_weights"],
+                block_max_weight=data[f"{k}.block_max_weight"],
+                feat_block_start=data[f"{k}.feat_block_start"],
+                feat_block_count=data[f"{k}.feat_block_count"],
+                doc_freq=data[f"{k}.doc_freq"],
+            )
+        for fname in meta["fields"]["geo"]:
+            seg.geo[fname] = data[f"g.{fname}"]
+        return seg
+
+    def delete_segment(self, name: str) -> None:
+        (self.path / "segments" / f"{name}.npz").unlink(missing_ok=True)
+        (self.path / "segments" / f"{name}.meta.json").unlink(missing_ok=True)
+        (self.path / "segments" / f"{name}.liv.npy").unlink(missing_ok=True)
+
+    def write_live_mask(self, seg: Segment) -> None:
+        """Persist only the live-docs mask (deletes), like Lucene .liv files."""
+        liv_tmp = self.path / "segments" / f".{seg.name}.liv.tmp"
+        with open(liv_tmp, "wb") as f:
+            np.save(f, seg.live)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(liv_tmp, self.path / "segments" / f"{seg.name}.liv.npy")
+
+    def read_live_mask(self, name: str) -> Optional[np.ndarray]:
+        p = self.path / "segments" / f"{name}.liv.npy"
+        if p.exists():
+            return np.load(p)
+        return None
+
+    # -- commit points ---------------------------------------------------
+
+    def write_commit(self, generation: int, segment_names: List[str],
+                     max_seqno: int, local_checkpoint: int,
+                     translog_generation: int,
+                     extra: Optional[Dict[str, Any]] = None) -> None:
+        commit = {
+            "generation": generation,
+            "segments": segment_names,
+            "max_seqno": max_seqno,
+            "local_checkpoint": local_checkpoint,
+            "translog_generation": translog_generation,
+            "extra": extra or {},
+        }
+        tmp = self.path / f".commit-{generation}.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump(commit, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path / f"commit-{generation}.json")
+        # prune older commit points
+        for p in self.path.glob("commit-*.json"):
+            try:
+                gen = int(p.stem.split("-")[1])
+            except (IndexError, ValueError):
+                continue
+            if gen < generation:
+                p.unlink(missing_ok=True)
+
+    def read_latest_commit(self) -> Optional[Dict[str, Any]]:
+        commits = []
+        for p in self.path.glob("commit-*.json"):
+            try:
+                commits.append((int(p.stem.split("-")[1]), p))
+            except (IndexError, ValueError):
+                continue
+        if not commits:
+            return None
+        _, path = max(commits)
+        with open(path) as f:
+            return json.load(f)
+
+    def list_segment_files(self) -> List[str]:
+        return sorted(p.stem for p in (self.path / "segments").glob("*.npz"))

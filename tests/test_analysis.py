@@ -128,6 +128,15 @@ def test_builtin_analyzer_with_stopwords_param():
     assert reg.get("b").terms("x y") == ["y"]
 
 
+def test_mapping_char_filter_single_pass():
+    from elasticsearch_tpu.analysis.analyzers import make_mapping_char_filter
+    f = make_mapping_char_filter({"a": "b", "b": "c"})
+    assert f("a") == "b"        # replacement is not re-matched
+    assert f("ab") == "bc"
+    g = make_mapping_char_filter({"&": " and ", "aa": "X", "a": "y"})
+    assert g("aa&a") == "X and y"  # longest key wins
+
+
 def test_builtin_analyzer_rejects_unknown_params():
     with pytest.raises(IllegalArgumentError, match="does not support parameters"):
         AnalysisRegistry({"analyzer": {"b": {"type": "keyword", "whatever": 1}}})

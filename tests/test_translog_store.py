@@ -1,0 +1,114 @@
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index import SegmentBuilder, Store, Translog, TranslogOp
+from elasticsearch_tpu.index.translog import TranslogCorruptedError
+from elasticsearch_tpu.mapping import MapperService
+
+
+def test_translog_roundtrip(tmp_path):
+    tl = Translog(tmp_path)
+    tl.add(TranslogOp("index", 0, doc_id="a", source={"x": 1}))
+    tl.add(TranslogOp("delete", 1, doc_id="a", version=2))
+    tl.add(TranslogOp("noop", 2, reason="fill"))
+    ops = list(tl.read_all())
+    assert [o.op_type for o in ops] == ["index", "delete", "noop"]
+    assert ops[0].source == {"x": 1}
+    assert list(tl.read_all(min_seqno=1))[0].seqno == 1
+    tl.close()
+
+
+def test_translog_generations_and_trim(tmp_path):
+    tl = Translog(tmp_path)
+    tl.add(TranslogOp("index", 0, doc_id="a", source={}))
+    gen2 = tl.rollover()
+    tl.add(TranslogOp("index", 1, doc_id="b", source={}))
+    assert len(list(tl.read_all())) == 2
+    tl.trim_below(gen2)
+    assert [o.seqno for o in tl.read_all()] == [1]
+    tl.close()
+
+
+def test_translog_survives_reopen(tmp_path):
+    tl = Translog(tmp_path)
+    tl.add(TranslogOp("index", 0, doc_id="a", source={"v": 1}))
+    tl.close()
+    tl2 = Translog(tmp_path)
+    assert [o.doc_id for o in tl2.read_all()] == ["a"]
+    tl2.close()
+
+
+def test_translog_torn_tail_tolerated(tmp_path):
+    tl = Translog(tmp_path)
+    tl.add(TranslogOp("index", 0, doc_id="a", source={}))
+    path = tl._gen_path(tl.generation)
+    tl.close()
+    with open(path, "ab") as f:
+        f.write(b"\x50\x00\x00\x00")  # truncated header+body
+    tl2 = Translog(tmp_path)
+    assert len(list(tl2.read_all())) == 1  # torn tail ignored
+    tl2.close()
+
+
+def test_translog_corruption_detected(tmp_path):
+    tl = Translog(tmp_path)
+    tl.add(TranslogOp("index", 0, doc_id="a", source={"k": "v"}))
+    path = tl._gen_path(tl.generation)
+    tl.close()
+    data = bytearray(path.read_bytes())
+    data[12] ^= 0xFF  # flip a payload byte
+    path.write_bytes(bytes(data))
+    tl2 = Translog(tmp_path)
+    with pytest.raises(TranslogCorruptedError):
+        list(tl2.read_all())
+    tl2.close()
+
+
+def test_store_segment_roundtrip(tmp_path):
+    svc = MapperService({"properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "double"},
+        "v": {"type": "dense_vector", "dims": 2},
+        "f": {"type": "rank_features"},
+        "loc": {"type": "geo_point"},
+    }})
+    b = SegmentBuilder("seg_a", svc)
+    b.add(svc.parse_document("1", {
+        "body": "round trip test", "tag": "t", "n": 1.5,
+        "v": [0.6, 0.8], "f": {"feat": 2.0}, "loc": {"lat": 1.0, "lon": 2.0},
+    }), seqno=0)
+    b.add(svc.parse_document("2", {"body": "second doc"}), seqno=1)
+    seg = b.build()
+    seg.delete_doc(1)
+
+    store = Store(tmp_path)
+    store.write_segment(seg)
+    store.write_live_mask(seg)
+    loaded = store.read_segment("seg_a")
+    loaded.live = store.read_live_mask("seg_a")
+
+    assert loaded.ids == ["1", "2"]
+    assert loaded.live.tolist() == [True, False]
+    docs, tfs = loaded.postings["body"].postings_for("trip")
+    assert docs.tolist() == [0]
+    assert loaded.postings["body"].positions_for("trip", 0).tolist() == [1]
+    assert loaded.keywords["tag"].docs_with_term("t").tolist() == [0]
+    assert loaded.doc_values["n"].values[0] == 1.5
+    assert loaded.vectors["v"].matrix[0].tolist() == pytest.approx([0.6, 0.8])
+    assert loaded.vectors["v"].norms[0] == pytest.approx(1.0)
+    assert loaded.features["f"].feature_blocks("feat")[1] == 1
+    assert loaded.geo["loc"][0].tolist() == [1.0, 2.0]
+    assert loaded.sources[0]["body"] == "round trip test"
+
+
+def test_commit_points(tmp_path):
+    store = Store(tmp_path)
+    store.write_commit(1, ["s1"], 5, 5, 2)
+    store.write_commit(2, ["s1", "s2"], 9, 8, 3)
+    commit = store.read_latest_commit()
+    assert commit["generation"] == 2
+    assert commit["segments"] == ["s1", "s2"]
+    assert commit["local_checkpoint"] == 8
+    # old commit pruned
+    assert not (tmp_path / "commit-1.json").exists()
