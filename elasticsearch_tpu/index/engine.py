@@ -312,11 +312,22 @@ class InternalEngine:
             return self._merge(to_merge)
 
     def force_merge(self, max_num_segments: int = 1) -> bool:
+        """Merge down to at most max_num_segments (ES _forcemerge contract).
+        Segments with deletes are rewritten even if the count already fits."""
         with self._lock:
-            if len(self.segments) <= max_num_segments and not any(
-                    not seg.live.all() for seg in self.segments):
+            has_deletes = any(not seg.live.all() for seg in self.segments)
+            if len(self.segments) <= max_num_segments and not has_deletes:
                 return False
-            return self._merge(list(self.segments))
+            if len(self.segments) > max_num_segments:
+                # merge the oldest segments together until the count fits
+                n_to_merge = len(self.segments) - max_num_segments + 1
+                merged_any = self._merge(self.segments[:n_to_merge])
+            else:
+                merged_any = False
+            # rewrite any remaining segment that still carries deletes
+            for seg in [s for s in self.segments if not s.live.all()]:
+                merged_any = self._merge([seg]) or merged_any
+            return merged_any
 
     def _merge(self, to_merge: List[Segment]) -> bool:
         self._segment_counter += 1

@@ -121,8 +121,11 @@ class Store:
         seg_dir = self.path / "segments"
         with open(seg_dir / f"{name}.meta.json") as f:
             meta = json.load(f)
-        data = np.load(seg_dir / f"{name}.npz")
+        with np.load(seg_dir / f"{name}.npz") as data:
+            return self._segment_from(meta, data)
 
+    @staticmethod
+    def _segment_from(meta: Dict[str, Any], data) -> Segment:
         seg = Segment(meta["name"], meta["n_docs"])
         seg.ids = meta["ids"]
         seg.sources = meta["sources"]

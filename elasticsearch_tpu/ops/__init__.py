@@ -1,0 +1,32 @@
+from elasticsearch_tpu.ops.bm25 import Bm25Executor, bm25_block_scores, bm25_topk, idf
+from elasticsearch_tpu.ops.device_segment import (
+    DeviceFeatures,
+    DevicePostings,
+    DeviceVectors,
+    device_live_mask,
+    gather_query_blocks,
+)
+from elasticsearch_tpu.ops.fusion import linear_fuse, rrf_fuse
+from elasticsearch_tpu.ops.knn import KnnExecutor, knn_topk, knn_topk_batch, vector_scores
+from elasticsearch_tpu.ops.sparse import SparseExecutor, sparse_scores, sparse_topk
+
+__all__ = [
+    "Bm25Executor",
+    "DeviceFeatures",
+    "DevicePostings",
+    "DeviceVectors",
+    "KnnExecutor",
+    "SparseExecutor",
+    "bm25_block_scores",
+    "bm25_topk",
+    "device_live_mask",
+    "gather_query_blocks",
+    "idf",
+    "knn_topk",
+    "knn_topk_batch",
+    "linear_fuse",
+    "rrf_fuse",
+    "sparse_scores",
+    "sparse_topk",
+    "vector_scores",
+]

@@ -1,0 +1,127 @@
+"""BM25 scoring on device.
+
+Replaces the reference's hot loop — Lucene BulkScorer over postings with
+LegacyBM25Similarity (search/query/QueryPhase.java:331,
+index/similarity/SimilarityService.java:60) and TopScoreDocCollector top-k
+(search/query/TopDocsCollectorContext.java:215) — with a block-at-a-time
+device program:
+
+1. host: resolve query terms -> posting-block indices + per-term idf
+   (gather_query_blocks);
+2. device: gather blocks, compute per-entry BM25 contributions on the VPU,
+   scatter-add into a dense per-doc score vector, top-k.
+
+Everything is static-shaped: block count and doc count are padded to pow2
+buckets, so one compiled program serves many queries.
+
+idf follows the reference's BM25: ln(1 + (N - df + 0.5) / (df + 0.5)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.index.segment import PostingsField, next_pow2
+from elasticsearch_tpu.ops.device_segment import DevicePostings, gather_query_blocks
+
+DEFAULT_K1 = 1.2
+DEFAULT_B = 0.75
+
+
+def idf(doc_count: int, doc_freq: int) -> float:
+    """Reference BM25 idf (Lucene BM25Similarity.idfExplain)."""
+    return float(np.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5)))
+
+
+@partial(jax.jit, static_argnames=("n_docs_pad", "k1", "b"))
+def bm25_block_scores(block_docs: jnp.ndarray,     # [NB, BLOCK] int32, -1 pad
+                      block_tfs: jnp.ndarray,      # [NB, BLOCK] f32
+                      block_idx: jnp.ndarray,      # [QB] int32 gather indices
+                      block_weight: jnp.ndarray,   # [QB] f32 (idf * query boost)
+                      doc_lens: jnp.ndarray,       # [n_docs_pad] f32
+                      avgdl: jnp.ndarray,          # scalar f32
+                      n_docs_pad: int,
+                      k1: float = DEFAULT_K1,
+                      b: float = DEFAULT_B) -> jnp.ndarray:
+    """Dense BM25 scores [n_docs_pad] for one query over one segment."""
+    docs = block_docs[block_idx]            # [QB, BLOCK]
+    tfs = block_tfs[block_idx]              # [QB, BLOCK]
+    valid = docs >= 0
+    safe_docs = jnp.where(valid, docs, 0)
+    dl = doc_lens[safe_docs]                # [QB, BLOCK]
+    norm = k1 * (1.0 - b + b * dl / avgdl)
+    contrib = block_weight[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+    contrib = jnp.where(valid, contrib, 0.0)
+    scores = jnp.zeros((n_docs_pad,), jnp.float32)
+    scores = scores.at[safe_docs.reshape(-1)].add(
+        contrib.reshape(-1), mode="drop")
+    return scores
+
+
+@partial(jax.jit, static_argnames=("n_docs_pad", "k1", "b", "k"))
+def bm25_topk(block_docs, block_tfs, block_idx, block_weight, doc_lens, avgdl,
+              live, n_docs_pad: int, k: int,
+              k1: float = DEFAULT_K1, b: float = DEFAULT_B
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused BM25 scoring + live-mask + top-k. Returns (scores[k], docs[k]);
+    empty slots have score -inf."""
+    scores = bm25_block_scores(block_docs, block_tfs, block_idx, block_weight,
+                               doc_lens, avgdl, n_docs_pad, k1=k1, b=b)
+    scores = jnp.where(live & (scores > 0.0), scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+class Bm25Executor:
+    """Per-(segment, field) BM25 query executor with host-side query prep."""
+
+    def __init__(self, device_postings: DevicePostings, host_postings: PostingsField,
+                 total_doc_count: Optional[int] = None):
+        self.dev = device_postings
+        self.host = host_postings
+        # doc count for idf; a coordinator may override with corpus-wide
+        # counts (the DFS phase analog, search/dfs/DfsPhase.java:43)
+        self.doc_count = total_doc_count or device_postings.n_docs
+
+    def query_weights(self, terms, boost: float = 1.0, df_override=None):
+        """(term, idf*boost) pairs; df_override maps term -> corpus-wide df
+        (the DFS-phase analog). Falls back to segment-local df per term."""
+        out = []
+        for t in terms:
+            tid = self.host.terms.get(t)
+            df = None
+            if df_override is not None:
+                df = df_override.get(t)
+            if df is None:
+                df = int(self.host.doc_freq[tid]) if tid is not None else 0
+            if df <= 0 or tid is None:
+                continue  # term absent from this segment: no blocks to score
+            out.append((t, idf(self.doc_count, df) * boost))
+        return out
+
+    def scores(self, terms, live: jnp.ndarray, boost: float = 1.0,
+               df_override=None, k1: float = DEFAULT_K1, b: float = DEFAULT_B
+               ) -> jnp.ndarray:
+        """Dense masked scores for the query terms (used when composing
+        inside bool queries)."""
+        tw = self.query_weights(terms, boost, df_override)
+        block_idx, block_w = gather_query_blocks(self.host, tw)
+        s = bm25_block_scores(self.dev.block_docs, self.dev.block_tfs,
+                              jnp.asarray(block_idx), jnp.asarray(block_w),
+                              self.dev.doc_lens, jnp.float32(self.dev.avgdl),
+                              self.dev.n_docs_pad, k1=k1, b=b)
+        return jnp.where(live, s, 0.0)
+
+    def top_k(self, terms, live: jnp.ndarray, k: int, boost: float = 1.0,
+              df_override=None, k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+        tw = self.query_weights(terms, boost, df_override)
+        block_idx, block_w = gather_query_blocks(self.host, tw)
+        return bm25_topk(self.dev.block_docs, self.dev.block_tfs,
+                         jnp.asarray(block_idx), jnp.asarray(block_w),
+                         self.dev.doc_lens, jnp.float32(self.dev.avgdl),
+                         live, self.dev.n_docs_pad, k, k1=k1, b=b)

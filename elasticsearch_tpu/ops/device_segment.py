@@ -1,0 +1,132 @@
+"""Device mirrors of segment data.
+
+Each searchable segment gets lazily-built, cached device arrays with
+power-of-two padded shapes (bucketing keeps the jit cache warm across
+segment growth/merge — SURVEY.md §7 hard part #3). The host Segment stays
+the source of truth; device mirrors are pure caches.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.index.segment import (
+    BLOCK, FeaturesField, PostingsField, Segment, VectorField, next_pow2,
+)
+
+
+class DevicePostings:
+    """Device-resident postings for one text field of one segment."""
+
+    def __init__(self, pf: PostingsField, n_docs: int):
+        self.n_docs = n_docs
+        self.n_docs_pad = next_pow2(max(n_docs, 1), minimum=BLOCK)
+        n_blocks = pf.block_docs.shape[0]
+        self.n_blocks_pad = next_pow2(n_blocks)
+        # pad blocks with an empty sentinel block (all -1 docs)
+        pad = self.n_blocks_pad - n_blocks
+        block_docs = np.pad(pf.block_docs, ((0, pad), (0, 0)), constant_values=-1)
+        block_tfs = np.pad(pf.block_tfs, ((0, pad), (0, 0)))
+        self.block_docs = jnp.asarray(block_docs)
+        self.block_tfs = jnp.asarray(block_tfs)
+        doc_lens = np.zeros(self.n_docs_pad, np.float32)
+        doc_lens[: len(pf.doc_lens)] = pf.doc_lens
+        self.doc_lens = jnp.asarray(doc_lens)
+        self.avgdl = float(pf.sum_doc_len / max(1, (pf.doc_lens > 0).sum()))
+        self.block_max_tf = jnp.asarray(
+            np.pad(pf.block_max_tf, (0, pad)))
+
+    @staticmethod
+    def for_segment(seg: Segment, field_name: str) -> Optional["DevicePostings"]:
+        pf = seg.postings.get(field_name)
+        if pf is None:
+            return None
+        return seg.device(("postings", field_name),
+                          lambda: DevicePostings(pf, seg.n_docs))
+
+
+class DeviceVectors:
+    """Device-resident dense-vector matrix for one field of one segment."""
+
+    def __init__(self, vf: VectorField, n_docs: int):
+        self.n_docs = n_docs
+        self.n_docs_pad = next_pow2(max(n_docs, 1), minimum=BLOCK)
+        self.dims = vf.dims
+        pad = self.n_docs_pad - vf.matrix.shape[0]
+        self.matrix = jnp.asarray(np.pad(vf.matrix, ((0, pad), (0, 0))))
+        norms = np.pad(vf.norms, (0, pad))
+        self.norms = jnp.asarray(norms)
+        exists = np.zeros(self.n_docs_pad, bool)
+        exists[: len(vf.exists)] = vf.exists
+        self.exists = jnp.asarray(exists)
+        self.similarity = vf.similarity
+
+    @staticmethod
+    def for_segment(seg: Segment, field_name: str) -> Optional["DeviceVectors"]:
+        vf = seg.vectors.get(field_name)
+        if vf is None:
+            return None
+        return seg.device(("vectors", field_name),
+                          lambda: DeviceVectors(vf, seg.n_docs))
+
+
+class DeviceFeatures:
+    """Device-resident rank_features blocks for one field of one segment."""
+
+    def __init__(self, ff: FeaturesField, n_docs: int):
+        self.n_docs = n_docs
+        self.n_docs_pad = next_pow2(max(n_docs, 1), minimum=BLOCK)
+        n_blocks = ff.block_docs.shape[0]
+        self.n_blocks_pad = next_pow2(n_blocks)
+        pad = self.n_blocks_pad - n_blocks
+        self.block_docs = jnp.asarray(
+            np.pad(ff.block_docs, ((0, pad), (0, 0)), constant_values=-1))
+        self.block_weights = jnp.asarray(np.pad(ff.block_weights, ((0, pad), (0, 0))))
+
+    @staticmethod
+    def for_segment(seg: Segment, field_name: str) -> Optional["DeviceFeatures"]:
+        ff = seg.features.get(field_name)
+        if ff is None:
+            return None
+        return seg.device(("features", field_name),
+                          lambda: DeviceFeatures(ff, seg.n_docs))
+
+
+def device_live_mask(seg: Segment) -> jnp.ndarray:
+    """Live mask padded to the doc bucket (True = scoreable)."""
+    n_pad = next_pow2(max(seg.n_docs, 1), minimum=BLOCK)
+
+    def build():
+        m = np.zeros(n_pad, bool)
+        m[: seg.n_docs] = seg.live
+        return jnp.asarray(m)
+
+    return seg.device("live", build)
+
+
+def gather_query_blocks(pf: PostingsField, terms_with_weights, n_blocks_bucket_min: int = 8
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side prep for a query: list every posting block of every query
+    term, with its per-block weight (e.g. idf). Returns (block_indices int32
+    [QB_pad], block_weights float32 [QB_pad]) padded to a pow2 bucket so the
+    device gather has a bucketed static shape. Padding uses block 0 with
+    weight 0 (contributes nothing)."""
+    idx: list = []
+    w: list = []
+    for term, weight in terms_with_weights:
+        start, count = pf.term_blocks(term)
+        for b in range(start, start + count):
+            idx.append(b)
+            w.append(weight)
+    qb = max(len(idx), 1)
+    qb_pad = next_pow2(qb, minimum=n_blocks_bucket_min)
+    out_idx = np.zeros(qb_pad, np.int32)
+    out_w = np.zeros(qb_pad, np.float32)
+    out_idx[: len(idx)] = idx
+    out_w[: len(w)] = w
+    return out_idx, out_w

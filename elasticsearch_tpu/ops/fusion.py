@@ -1,0 +1,58 @@
+"""Hybrid rank fusion on device.
+
+RRF (reciprocal rank fusion) is a BASELINE.json capability absent from the
+reference snapshot (BASELINE.md config #4 — "RRF not present in reference";
+the reference only has query rescoring, search/rescore/QueryRescorer.java).
+Designed device-first: each retriever contributes its ranked doc list; RRF
+scores are scatter-added into a dense array and re-top-k'd — one fused
+program, no host round-trip between retrievers.
+
+Also provides linear score fusion (normalized weighted sum), the other
+common hybrid.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n_docs_pad", "k", "rank_constant"))
+def rrf_fuse(doc_lists: jnp.ndarray,   # [R, K] int32 per-retriever ranked docs (-1 pad)
+             n_docs_pad: int, k: int,
+             rank_constant: int = 60) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """score(d) = sum_r 1 / (rank_constant + rank_r(d)); rank is 1-based.
+    Returns (scores [k], docs [k])."""
+    R, K = doc_lists.shape
+    ranks = jnp.arange(1, K + 1, dtype=jnp.float32)[None, :]      # [1, K]
+    contrib = 1.0 / (rank_constant + ranks)                       # [1, K]
+    contrib = jnp.broadcast_to(contrib, (R, K))
+    valid = doc_lists >= 0
+    safe = jnp.where(valid, doc_lists, 0)
+    contrib = jnp.where(valid, contrib, 0.0)
+    scores = jnp.zeros((n_docs_pad,), jnp.float32)
+    scores = scores.at[safe.reshape(-1)].add(contrib.reshape(-1), mode="drop")
+    top = jnp.where(scores > 0.0, scores, -jnp.inf)
+    return jax.lax.top_k(top, k)
+
+
+@partial(jax.jit, static_argnames=("k", "normalize"))
+def linear_fuse(score_arrays: jnp.ndarray,   # [R, N_pad] dense scores per retriever
+                weights: jnp.ndarray,        # [R]
+                live: jnp.ndarray,           # [N_pad] bool
+                k: int,
+                normalize: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Weighted sum of (optionally min-max normalized) retriever scores."""
+    s = score_arrays
+    if normalize:
+        mx = jnp.max(s, axis=1, keepdims=True)
+        mn = jnp.min(jnp.where(s > 0, s, jnp.inf), axis=1, keepdims=True)
+        mn = jnp.where(jnp.isfinite(mn), mn, 0.0)
+        rng = jnp.maximum(mx - mn, 1e-9)
+        s = jnp.where(s > 0, (s - mn) / rng, 0.0)
+    fused = jnp.einsum("rn,r->n", s, weights)
+    fused = jnp.where(live & (fused > 0), fused, -jnp.inf)
+    return jax.lax.top_k(fused, k)

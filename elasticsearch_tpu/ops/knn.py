@@ -1,0 +1,107 @@
+"""Dense-vector similarity on device.
+
+Replaces the reference's script_score vector loops —
+ScoreScriptUtils.cosineSimilarity / dotProduct / l2norm iterating binary doc
+values per document (x-pack/plugin/vectors/.../query/ScoreScriptUtils.java:132,151)
+— with a tiled MXU matmul over the HBM-resident, segment-padded vector matrix,
+fused with top-k. Scores use the same positive-score transforms ES applies:
+
+  cosine:      (1 + cos) / 2
+  dot_product: sigmoid-free 0.5 + dot/2 for normalized vectors is ES 8.x;
+               this snapshot's painless returned raw dot — we use the
+               standard modern transform for ranking stability
+  l2_norm:     1 / (1 + dist)
+
+bf16 is used for the multiply (MXU native) with f32 accumulation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops.device_segment import DeviceVectors
+
+
+@partial(jax.jit, static_argnames=("similarity",))
+def vector_scores(matrix: jnp.ndarray,     # [N_pad, D] f32
+                  norms: jnp.ndarray,      # [N_pad] f32
+                  exists: jnp.ndarray,     # [N_pad] bool
+                  query: jnp.ndarray,      # [D] f32
+                  similarity: str = "cosine") -> jnp.ndarray:
+    """Dense similarity scores [N_pad]; missing vectors score 0."""
+    q = query.astype(jnp.bfloat16)
+    m = matrix.astype(jnp.bfloat16)
+    dots = jax.lax.dot_general(
+        m, q[:, None],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, 0]                                                    # [N_pad] f32
+    if similarity == "dot_product":
+        scores = 0.5 + dots / 2.0
+    elif similarity == "cosine":
+        qn = jnp.linalg.norm(query) + 1e-30
+        cos = dots / (norms * qn + 1e-30)
+        scores = (1.0 + cos) / 2.0
+    else:  # l2_norm
+        q2 = jnp.sum(query * query)
+        d2 = norms * norms + q2 - 2.0 * dots
+        d2 = jnp.maximum(d2, 0.0)
+        scores = 1.0 / (1.0 + jnp.sqrt(d2))
+    return jnp.where(exists, scores, 0.0)
+
+
+@partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_topk(matrix, norms, exists, live, query, k: int,
+             similarity: str = "cosine") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    scores = vector_scores(matrix, norms, exists, query, similarity)
+    scores = jnp.where(live & exists, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_topk_batch(matrix, norms, exists, live, queries, k: int,
+                   similarity: str = "cosine") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched kNN: queries [B, D] -> (scores [B, k], docs [B, k]).
+
+    One big [B, D] x [D, N] MXU matmul — the throughput shape for the
+    SIFT1M-style benchmark."""
+    q = queries.astype(jnp.bfloat16)
+    m = matrix.astype(jnp.bfloat16)
+    dots = jax.lax.dot_general(
+        q, m,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                          # [B, N_pad]
+    if similarity == "dot_product":
+        scores = 0.5 + dots / 2.0
+    elif similarity == "cosine":
+        qn = jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
+        scores = (1.0 + dots / (norms[None, :] * qn + 1e-30)) / 2.0
+    else:
+        q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
+        d2 = jnp.maximum(norms[None, :] ** 2 + q2 - 2.0 * dots, 0.0)
+        scores = 1.0 / (1.0 + jnp.sqrt(d2))
+    scores = jnp.where((live & exists)[None, :], scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+class KnnExecutor:
+    """Per-(segment, field) exact kNN executor."""
+
+    def __init__(self, device_vectors: DeviceVectors):
+        self.dev = device_vectors
+
+    def top_k(self, query, live, k: int):
+        q = jnp.asarray(query, jnp.float32)
+        return knn_topk(self.dev.matrix, self.dev.norms, self.dev.exists,
+                        live, q, k, self.dev.similarity)
+
+    def scores(self, query, live) -> jnp.ndarray:
+        q = jnp.asarray(query, jnp.float32)
+        s = vector_scores(self.dev.matrix, self.dev.norms, self.dev.exists,
+                          q, self.dev.similarity)
+        return jnp.where(live, s, 0.0)

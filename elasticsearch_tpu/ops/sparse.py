@@ -1,0 +1,108 @@
+"""Sparse rank_features / learned-sparse (ELSER-style) scoring on device.
+
+Reference substrate: rank_feature(s) field types scored with saturation /
+log / sigmoid / linear functions
+(modules/mapper-extras/.../RankFeatureFieldMapper.java, the rank_feature
+query) — the storage model ELSER's text_expansion builds on. Query = a bag of
+(feature, weight); document score = sum over matching features of
+f(doc_weight) * query_weight.
+
+Same block-gather + scatter-add shape as BM25 (ops/bm25.py), with the score
+transform selected statically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.index.segment import FeaturesField, next_pow2
+from elasticsearch_tpu.ops.device_segment import DeviceFeatures
+
+
+@partial(jax.jit, static_argnames=("n_docs_pad", "function", "k"))
+def sparse_topk(block_docs, block_weights, block_idx, query_weight,
+                pivot, exponent, live, n_docs_pad: int, k: int,
+                function: str = "saturation") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    scores = sparse_scores(block_docs, block_weights, block_idx, query_weight,
+                           pivot, exponent, n_docs_pad, function)
+    scores = jnp.where(live & (scores > 0.0), scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnames=("n_docs_pad", "function"))
+def sparse_scores(block_docs,      # [NB, BLOCK] int32
+                  block_weights,   # [NB, BLOCK] f32
+                  block_idx,       # [QB] int32
+                  query_weight,    # [QB] f32 (0 = padding)
+                  pivot,           # scalar f32 (saturation/sigmoid pivot; log scaling factor)
+                  exponent,        # scalar f32 (sigmoid exponent; unused otherwise)
+                  n_docs_pad: int,
+                  function: str = "saturation") -> jnp.ndarray:
+    docs = block_docs[block_idx]
+    w = block_weights[block_idx]
+    valid = docs >= 0
+    safe_docs = jnp.where(valid, docs, 0)
+    if function == "saturation":
+        f = w / (w + pivot)
+    elif function == "log":
+        f = jnp.log(pivot + w)          # reference: log(scaling_factor + S)
+    elif function == "sigmoid":
+        # reference: S^a / (S^a + pivot^a)
+        wa = jnp.power(jnp.maximum(w, 0.0), exponent)
+        f = wa / (wa + jnp.power(pivot, exponent))
+    else:  # linear
+        f = w
+    contrib = query_weight[:, None] * f
+    contrib = jnp.where(valid, contrib, 0.0)
+    scores = jnp.zeros((n_docs_pad,), jnp.float32)
+    return scores.at[safe_docs.reshape(-1)].add(contrib.reshape(-1), mode="drop")
+
+
+def gather_feature_blocks(ff: FeaturesField, features_with_weights,
+                          bucket_min: int = 8) -> Tuple[np.ndarray, np.ndarray]:
+    """Host prep: (block_indices, query_weights) padded to a pow2 bucket."""
+    idx, w = [], []
+    for name, weight in features_with_weights:
+        start, count = ff.feature_blocks(name)
+        for bidx in range(start, start + count):
+            idx.append(bidx)
+            w.append(weight)
+    qb_pad = next_pow2(max(len(idx), 1), minimum=bucket_min)
+    out_idx = np.zeros(qb_pad, np.int32)
+    out_w = np.zeros(qb_pad, np.float32)
+    out_idx[: len(idx)] = idx
+    out_w[: len(w)] = w
+    return out_idx, out_w
+
+
+class SparseExecutor:
+    """Per-(segment, field) sparse retrieval executor (text_expansion analog)."""
+
+    def __init__(self, device_features: DeviceFeatures, host_features: FeaturesField):
+        self.dev = device_features
+        self.host = host_features
+
+    def scores(self, features_with_weights, live,
+               function: str = "linear", pivot: float = 1.0,
+               exponent: float = 1.0) -> jnp.ndarray:
+        block_idx, qw = gather_feature_blocks(self.host, features_with_weights)
+        s = sparse_scores(self.dev.block_docs, self.dev.block_weights,
+                          jnp.asarray(block_idx), jnp.asarray(qw),
+                          jnp.float32(pivot), jnp.float32(exponent),
+                          self.dev.n_docs_pad, function)
+        return jnp.where(live, s, 0.0)
+
+    def top_k(self, features_with_weights, live, k: int,
+              function: str = "linear", pivot: float = 1.0,
+              exponent: float = 1.0):
+        block_idx, qw = gather_feature_blocks(self.host, features_with_weights)
+        return sparse_topk(self.dev.block_docs, self.dev.block_weights,
+                           jnp.asarray(block_idx), jnp.asarray(qw),
+                           jnp.float32(pivot), jnp.float32(exponent),
+                           live, self.dev.n_docs_pad, k, function)

@@ -142,6 +142,23 @@ def test_merge_policy():
     assert eng.get("7", realtime=False)["_source"]["body"] == "doc 7"
 
 
+def test_force_merge_respects_max_num_segments():
+    eng = make_engine()
+    for i in range(6):
+        eng.index(str(i), {"body": f"doc {i}"})
+        eng.refresh()
+    assert len(eng.segments) == 6
+    eng.force_merge(max_num_segments=3)
+    assert len(eng.segments) == 3
+    assert eng.doc_count == 6
+    # merging down to fewer also rewrites delete-carrying segments
+    eng.delete("5")
+    eng.refresh()
+    eng.force_merge(max_num_segments=3)
+    assert all(seg.live.all() for seg in eng.segments)
+    assert eng.doc_count == 5
+
+
 def test_reader_snapshot_isolated_from_deletes():
     eng = make_engine()
     eng.index("1", {"body": "x"})

@@ -1,0 +1,274 @@
+"""Kernel parity tests vs numpy oracles (CPU, virtual 8-device platform)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.ops import (
+    Bm25Executor, DeviceFeatures, DevicePostings, DeviceVectors, KnnExecutor,
+    SparseExecutor, device_live_mask, idf, knn_topk_batch, linear_fuse, rrf_fuse,
+)
+from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1
+
+
+MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "v": {"type": "dense_vector", "dims": 8, "similarity": "cosine"},
+        "feats": {"type": "rank_features"},
+    }
+}
+
+
+def bm25_oracle(docs_terms, query_terms, k1=DEFAULT_K1, b=DEFAULT_B):
+    """Plain numpy BM25 over tokenized docs."""
+    N = len(docs_terms)
+    dls = np.array([len(d) for d in docs_terms], float)
+    avgdl = dls[dls > 0].mean() if (dls > 0).any() else 1.0
+    scores = np.zeros(N)
+    for t in set(query_terms):
+        df = sum(1 for d in docs_terms if t in d)
+        if df == 0:
+            continue
+        w = np.log(1 + (N - df + 0.5) / (df + 0.5))
+        for i, d in enumerate(docs_terms):
+            tf = d.count(t)
+            if tf:
+                scores[i] += w * tf * (k1 + 1) / (tf + k1 * (1 - b + b * dls[i] / avgdl))
+    return scores
+
+
+def build_corpus(rng, n_docs=300, vocab=50, with_vectors=True):
+    svc = MapperService(MAPPING)
+    b = SegmentBuilder("s", svc)
+    docs_terms = []
+    vectors = []
+    for i in range(n_docs):
+        n_terms = rng.integers(3, 20)
+        terms = [f"t{rng.integers(0, vocab)}" for _ in range(n_terms)]
+        docs_terms.append(terms)
+        src = {"body": " ".join(terms)}
+        if with_vectors:
+            vec = rng.normal(size=8).astype(float).tolist()
+            vectors.append(np.asarray(vec, np.float32))
+            src["v"] = vec
+        b.add(svc.parse_document(str(i), src), seqno=i)
+    return b.build(), docs_terms, (np.stack(vectors) if with_vectors else None)
+
+
+def test_bm25_matches_oracle(rng):
+    seg, docs_terms, _ = build_corpus(rng)
+    dev = DevicePostings.for_segment(seg, "body")
+    live = device_live_mask(seg)
+    ex = Bm25Executor(dev, seg.postings["body"])
+
+    query = ["t1", "t7", "t33"]
+    scores = np.asarray(ex.scores(query, live))[: seg.n_docs]
+    oracle = bm25_oracle(docs_terms, query)
+    np.testing.assert_allclose(scores, oracle, rtol=1e-4, atol=1e-5)
+
+
+def test_bm25_topk_order_and_mask(rng):
+    seg, docs_terms, _ = build_corpus(rng)
+    dev = DevicePostings.for_segment(seg, "body")
+    ex = Bm25Executor(dev, seg.postings["body"])
+    query = ["t3", "t5"]
+    oracle = bm25_oracle(docs_terms, query)
+
+    # delete the oracle's best doc; it must vanish from results
+    best = int(np.argmax(oracle))
+    seg.delete_doc(best)
+    live = device_live_mask(seg)
+    scores, docs = ex.top_k(query, live, k=10)
+    docs = np.asarray(docs)
+    scores = np.asarray(scores)
+    assert best not in docs[scores > -np.inf]
+    oracle[best] = -np.inf
+    expect_top = np.argsort(-oracle)[:5]
+    valid = docs[scores > -np.inf]
+    assert set(expect_top[:3]).issubset(set(valid[:5].tolist()))
+
+
+def test_bm25_missing_term_and_empty_query(rng):
+    seg, docs_terms, _ = build_corpus(rng)
+    dev = DevicePostings.for_segment(seg, "body")
+    live = device_live_mask(seg)
+    ex = Bm25Executor(dev, seg.postings["body"])
+    scores, docs = ex.top_k(["zzz_not_a_term"], live, k=5)
+    assert np.all(np.asarray(scores) == -np.inf)
+    scores2, _ = ex.top_k([], live, k=5)
+    assert np.all(np.asarray(scores2) == -np.inf)
+
+
+def test_bm25_multiblock_term(rng):
+    # term with > 128 postings spans multiple blocks
+    svc = MapperService(MAPPING)
+    b = SegmentBuilder("s", svc)
+    docs_terms = []
+    for i in range(400):
+        terms = ["common"] + (["rare"] if i == 37 else [])
+        docs_terms.append(terms)
+        b.add(svc.parse_document(str(i), {"body": " ".join(terms)}), seqno=i)
+    seg = b.build()
+    dev = DevicePostings.for_segment(seg, "body")
+    ex = Bm25Executor(dev, seg.postings["body"])
+    live = device_live_mask(seg)
+    scores = np.asarray(ex.scores(["common", "rare"], live))[:400]
+    oracle = bm25_oracle(docs_terms, ["common", "rare"])
+    np.testing.assert_allclose(scores, oracle, rtol=1e-4, atol=1e-5)
+
+
+def test_knn_cosine_matches_oracle(rng):
+    seg, _, vectors = build_corpus(rng)
+    dev = DeviceVectors.for_segment(seg, "v")
+    live = device_live_mask(seg)
+    ex = KnnExecutor(dev)
+    q = rng.normal(size=8).astype(np.float32)
+
+    scores, docs = ex.top_k(q, live, k=10)
+    sims = vectors @ q / (np.linalg.norm(vectors, axis=1) * np.linalg.norm(q) + 1e-30)
+    oracle_scores = (1 + sims) / 2
+    oracle_top = np.argsort(-oracle_scores)[:10]
+    # bf16 matmul: allow small score tolerance but require top-10 overlap >= 8
+    overlap = len(set(np.asarray(docs).tolist()) & set(oracle_top.tolist()))
+    assert overlap >= 8
+    np.testing.assert_allclose(
+        np.asarray(scores)[0], oracle_scores[oracle_top[0]], rtol=2e-2)
+
+
+def test_knn_l2_and_dot(rng):
+    svc = MapperService({"properties": {
+        "v": {"type": "dense_vector", "dims": 4, "similarity": "l2_norm"}}})
+    b = SegmentBuilder("s", svc)
+    vecs = [[1, 0, 0, 0], [0, 1, 0, 0], [0.9, 0.1, 0, 0]]
+    for i, v in enumerate(vecs):
+        b.add(svc.parse_document(str(i), {"v": v}), seqno=i)
+    seg = b.build()
+    dev = DeviceVectors.for_segment(seg, "v")
+    ex = KnnExecutor(dev)
+    live = device_live_mask(seg)
+    scores, docs = ex.top_k([1, 0, 0, 0], live, k=3)
+    assert np.asarray(docs)[0] == 0
+    assert np.asarray(scores)[0] == pytest.approx(1.0, abs=1e-3)
+    assert np.asarray(docs)[1] == 2
+
+
+def test_knn_batch(rng):
+    seg, _, vectors = build_corpus(rng)
+    dev = DeviceVectors.for_segment(seg, "v")
+    live = device_live_mask(seg)
+    queries = rng.normal(size=(4, 8)).astype(np.float32)
+    scores, docs = knn_topk_batch(dev.matrix, dev.norms, dev.exists, live,
+                                  jnp.asarray(queries), 5, "cosine")
+    assert scores.shape == (4, 5) and docs.shape == (4, 5)
+    for bi in range(4):
+        sims = vectors @ queries[bi] / (
+            np.linalg.norm(vectors, axis=1) * np.linalg.norm(queries[bi]) + 1e-30)
+        oracle_top = set(np.argsort(-(1 + sims) / 2)[:5].tolist())
+        got = set(np.asarray(docs[bi]).tolist())
+        assert len(got & oracle_top) >= 4
+
+
+def test_knn_missing_vectors_excluded(rng):
+    svc = MapperService({"properties": {
+        "v": {"type": "dense_vector", "dims": 2}, "x": {"type": "keyword"}}})
+    b = SegmentBuilder("s", svc)
+    b.add(svc.parse_document("0", {"v": [1, 0]}), seqno=0)
+    b.add(svc.parse_document("1", {"x": "novec"}), seqno=1)
+    seg = b.build()
+    dev = DeviceVectors.for_segment(seg, "v")
+    ex = KnnExecutor(dev)
+    scores, docs = ex.top_k([1, 0], device_live_mask(seg), k=2)
+    s = np.asarray(scores)
+    assert s[0] > -np.inf and s[1] == -np.inf  # only doc 0 has a vector
+
+
+def test_sparse_scoring(rng):
+    svc = MapperService(MAPPING)
+    b = SegmentBuilder("s", svc)
+    b.add(svc.parse_document("0", {"feats": {"a": 2.0, "b": 1.0}}), seqno=0)
+    b.add(svc.parse_document("1", {"feats": {"a": 0.5}}), seqno=1)
+    b.add(svc.parse_document("2", {"feats": {"c": 3.0}}), seqno=2)
+    seg = b.build()
+    dev = DeviceFeatures.for_segment(seg, "feats")
+    ex = SparseExecutor(dev, seg.features["feats"])
+    live = device_live_mask(seg)
+
+    # linear: score = sum qw * w
+    scores = np.asarray(ex.scores([("a", 2.0), ("b", 1.0)], live, "linear"))[:3]
+    np.testing.assert_allclose(scores, [2 * 2.0 + 1 * 1.0, 2 * 0.5, 0.0], rtol=1e-6)
+
+    # saturation: w/(w+pivot)
+    scores = np.asarray(ex.scores([("a", 1.0)], live, "saturation", pivot=1.0))[:3]
+    np.testing.assert_allclose(scores, [2 / 3, 0.5 / 1.5, 0.0], rtol=1e-6)
+
+    s, d = ex.top_k([("a", 1.0)], live, k=2, function="linear")
+    assert np.asarray(d)[0] == 0
+
+    # sigmoid: w^a / (w^a + pivot^a)
+    scores = np.asarray(ex.scores([("a", 1.0)], live, "sigmoid",
+                                  pivot=2.0, exponent=0.5))[:3]
+    w = np.array([2.0, 0.5, 0.0])
+    expect = np.where(w > 0, np.sqrt(w) / (np.sqrt(w) + np.sqrt(2.0)), 0.0)
+    np.testing.assert_allclose(scores, expect, rtol=1e-4)
+
+    # log: log(scaling_factor + w)
+    scores = np.asarray(ex.scores([("a", 1.0)], live, "log", pivot=3.0))[:3]
+    np.testing.assert_allclose(scores[:2], np.log(3.0 + w[:2]), rtol=1e-4)
+
+
+def test_bm25_empty_df_override(rng):
+    seg, _, _ = build_corpus(rng, n_docs=20)
+    dev = DevicePostings.for_segment(seg, "body")
+    ex = Bm25Executor(dev, seg.postings["body"])
+    # empty override dict meaning "no overrides" must not crash on missing terms
+    assert ex.query_weights(["zzz_missing"], df_override={}) == []
+
+
+def test_linear_fuse_no_normalize():
+    bm25 = np.zeros(8, np.float32); bm25[1] = 0.3
+    knn = np.zeros(8, np.float32); knn[2] = 0.9
+    live = jnp.ones(8, bool)
+    scores, docs = linear_fuse(jnp.asarray(np.stack([bm25, knn])),
+                               jnp.asarray([1.0, 1.0]), live, k=2, normalize=False)
+    assert np.asarray(docs)[0] == 2  # raw scores, knn wins
+
+
+def test_rrf_fusion():
+    # retriever 1 ranks [3,1,2]; retriever 2 ranks [2,3,9]
+    lists = jnp.asarray(np.array([[3, 1, 2], [2, 3, 9]], np.int32))
+    scores, docs = rrf_fuse(lists, n_docs_pad=16, k=4, rank_constant=60)
+    docs = np.asarray(docs)
+    scores = np.asarray(scores)
+    # doc 3: 1/61 + 1/62 ; doc 2: 1/63 + 1/61 ; doc 1: 1/62 ; doc 9: 1/63
+    expect = {3: 1/61 + 1/62, 2: 1/63 + 1/61, 1: 1/62, 9: 1/63}
+    assert docs[0] == 3
+    assert docs[1] == 2
+    for doc, sc in zip(docs, scores):
+        if sc > -np.inf:
+            assert sc == pytest.approx(expect[int(doc)], rel=1e-5)
+
+
+def test_rrf_ignores_padding():
+    lists = jnp.asarray(np.array([[5, -1, -1], [5, -1, -1]], np.int32))
+    scores, docs = rrf_fuse(lists, n_docs_pad=8, k=3)
+    assert np.asarray(docs)[0] == 5
+    assert np.asarray(scores)[1] == -np.inf  # padding didn't leak into doc 0
+
+
+def test_linear_fusion():
+    bm25 = np.zeros(8, np.float32); bm25[1] = 10.0; bm25[2] = 5.0; bm25[4] = 1.0
+    knn = np.zeros(8, np.float32); knn[2] = 0.9; knn[3] = 0.8
+    live = jnp.ones(8, bool)
+    scores, docs = linear_fuse(jnp.asarray(np.stack([bm25, knn])),
+                               jnp.asarray([0.5, 0.5]), live, k=3)
+    # doc2 appears in both -> should win after normalization
+    assert np.asarray(docs)[0] == 2
+
+
+def test_idf_formula():
+    assert idf(1000, 10) == pytest.approx(np.log(1 + 990.5 / 10.5))
+    assert idf(10, 10) > 0  # never negative (ES BM25 property)
