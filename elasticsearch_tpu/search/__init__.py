@@ -1,0 +1,25 @@
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.execute import SegmentContext, execute
+from elasticsearch_tpu.search.fetch import fetch_hits, filter_source
+from elasticsearch_tpu.search.phase import (
+    ShardDoc,
+    ShardQueryResult,
+    SortSpec,
+    parse_sort,
+    query_shard,
+)
+from elasticsearch_tpu.search.service import SearchService
+
+__all__ = [
+    "SearchService",
+    "SegmentContext",
+    "ShardDoc",
+    "ShardQueryResult",
+    "SortSpec",
+    "dsl",
+    "execute",
+    "fetch_hits",
+    "filter_source",
+    "parse_sort",
+    "query_shard",
+]

@@ -1,0 +1,750 @@
+"""Per-segment query execution: Query tree -> (scores, mask) device programs.
+
+The analog of the reference's rewrite+createWeight+BulkScorer pipeline
+(index/query/*.java building Lucene Queries, executed by QueryPhase
+search/query/QueryPhase.java:171) re-shaped for SPMD: every query node
+compiles to a dense score vector [n_docs_pad] and a boolean match mask, which
+compose on device (bool = masked sums, dis_max = masked max, …). Structural
+filters (term/range/exists/ids) build their masks host-side from columnar doc
+values — the cacheable "filter context" of the reference — while scoring
+clauses (match/knn/sparse) run the ops/ kernels.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.index.segment import Segment, next_pow2, BLOCK
+from elasticsearch_tpu.mapping import MapperService, parse_date_millis
+from elasticsearch_tpu.ops import (
+    Bm25Executor, DeviceFeatures, DevicePostings, DeviceVectors, KnnExecutor,
+    SparseExecutor, device_live_mask,
+)
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.utils.errors import QueryParsingError
+from elasticsearch_tpu.mapping.mappers import NUMERIC_TYPES
+
+
+@dataclass
+class SegmentContext:
+    """Execution context for one segment of one shard."""
+    segment: Segment
+    mappers: MapperService
+    segment_idx: int = 0
+    # shard- or corpus-wide stats for idf (DFS analog); None = segment-local
+    doc_count_override: Optional[int] = None
+    df_overrides: Optional[Dict[str, Dict[str, int]]] = None  # field -> term -> df
+    _filter_cache: Dict[Any, np.ndarray] = field(default_factory=dict)
+
+    @property
+    def n_docs(self) -> int:
+        return self.segment.n_docs
+
+    @property
+    def n_docs_pad(self) -> int:
+        return next_pow2(max(self.segment.n_docs, 1), minimum=BLOCK)
+
+    @property
+    def live(self) -> jnp.ndarray:
+        return device_live_mask(self.segment)
+
+    def to_device_mask(self, host_mask: np.ndarray) -> jnp.ndarray:
+        out = np.zeros(self.n_docs_pad, bool)
+        out[: len(host_mask)] = host_mask
+        return jnp.asarray(out)
+
+    def zeros(self) -> jnp.ndarray:
+        return jnp.zeros(self.n_docs_pad, jnp.float32)
+
+    def none_mask(self) -> jnp.ndarray:
+        return jnp.zeros(self.n_docs_pad, bool)
+
+    def all_mask(self) -> jnp.ndarray:
+        return self.live
+
+    def search_analyzer(self, field_name: str):
+        mapper = self.mappers.mapper(field_name)
+        if mapper is not None and hasattr(mapper, "search_analyzer"):
+            return mapper.search_analyzer
+        from elasticsearch_tpu.analysis import STANDARD
+        return STANDARD
+
+    def doc_count_for_idf(self) -> int:
+        return self.doc_count_override or max(self.segment.live_count, 1)
+
+    def df_for(self, field_name: str) -> Optional[Dict[str, int]]:
+        if self.df_overrides is None:
+            return None
+        return self.df_overrides.get(field_name)
+
+
+Result = Tuple[jnp.ndarray, jnp.ndarray]   # (scores f32 [n_pad], mask bool [n_pad])
+
+
+def execute(q: dsl.Query, ctx: SegmentContext) -> Result:
+    handler = _HANDLERS.get(type(q))
+    if handler is None:
+        raise QueryParsingError(f"unsupported query node [{type(q).__name__}]")
+    return handler(q, ctx)
+
+
+# ---------------------------------------------------------------------------
+# host-side mask builders (filter context)
+# ---------------------------------------------------------------------------
+
+def _term_mask_host(ctx: SegmentContext, field_name: str, value: Any) -> np.ndarray:
+    """Docs containing the exact term/value in keyword/numeric/text field."""
+    seg = ctx.segment
+    n = seg.n_docs
+    mask = np.zeros(n, bool)
+    if field_name == "_id":
+        d = seg.id_to_doc.get(str(value))
+        if d is not None:
+            mask[d] = True
+        return mask
+    kf = seg.keywords.get(field_name)
+    if kf is not None:
+        mask[kf.docs_with_term(str(value))] = True
+        return mask
+    dv = seg.doc_values.get(field_name)
+    if dv is not None:
+        v = _coerce_numeric(ctx, field_name, value)
+        np.equal(dv.values, v, out=mask, where=dv.exists)
+        mask &= dv.exists
+        # multi-valued docs match if ANY value matches
+        for doc, extra in dv.multi.items():
+            if not mask[doc] and any(x == v for x in extra):
+                mask[doc] = True
+        return mask
+    pf = seg.postings.get(field_name)
+    if pf is not None:
+        docs, _ = pf.postings_for(str(value))
+        mask[docs] = True
+        return mask
+    return mask
+
+
+def _coerce_numeric(ctx: SegmentContext, field_name: str, value: Any) -> float:
+    t = ctx.mappers.field_type(field_name)
+    try:
+        if t == "date":
+            return parse_date_millis(value)
+        if t == "boolean":
+            if isinstance(value, bool):
+                return 1.0 if value else 0.0
+            return 1.0 if str(value).lower() == "true" else 0.0
+        return float(value)
+    except (TypeError, ValueError):
+        raise QueryParsingError(
+            f"failed to parse value [{value}] for field [{field_name}]")
+
+
+def _range_mask_host(ctx: SegmentContext, q: dsl.Range) -> np.ndarray:
+    seg = ctx.segment
+    dv = seg.doc_values.get(q.field)
+    if dv is None:
+        # range over keyword terms (lexicographic)
+        kf = seg.keywords.get(q.field)
+        mask = np.zeros(seg.n_docs, bool)
+        if kf is None:
+            return mask
+        for term in kf.terms:
+            ok = True
+            if q.gt is not None and not term > str(q.gt):
+                ok = False
+            if q.gte is not None and not term >= str(q.gte):
+                ok = False
+            if q.lt is not None and not term < str(q.lt):
+                ok = False
+            if q.lte is not None and not term <= str(q.lte):
+                ok = False
+            if ok:
+                mask[kf.docs_with_term(term)] = True
+        return mask
+    vals = dv.values
+    mask = dv.exists.copy()
+    if q.gt is not None:
+        mask &= vals > _coerce_numeric(ctx, q.field, q.gt)
+    if q.gte is not None:
+        mask &= vals >= _coerce_numeric(ctx, q.field, q.gte)
+    if q.lt is not None:
+        mask &= vals < _coerce_numeric(ctx, q.field, q.lt)
+    if q.lte is not None:
+        mask &= vals <= _coerce_numeric(ctx, q.field, q.lte)
+    # multi-valued docs match if ANY value matches
+    for doc, extra in dv.multi.items():
+        if mask[doc]:
+            continue
+        for v in extra:
+            ok = True
+            if q.gt is not None and not v > _coerce_numeric(ctx, q.field, q.gt):
+                ok = False
+            if q.gte is not None and not v >= _coerce_numeric(ctx, q.field, q.gte):
+                ok = False
+            if q.lt is not None and not v < _coerce_numeric(ctx, q.field, q.lt):
+                ok = False
+            if q.lte is not None and not v <= _coerce_numeric(ctx, q.field, q.lte):
+                ok = False
+            if ok:
+                mask[doc] = True
+                break
+    return mask
+
+
+def _exists_mask_host(ctx: SegmentContext, field_name: str) -> np.ndarray:
+    seg = ctx.segment
+    n = seg.n_docs
+    if field_name in seg.doc_values:
+        return seg.doc_values[field_name].exists.copy()
+    if field_name in seg.keywords:
+        kf = seg.keywords[field_name]
+        return (np.diff(kf.ord_offsets) > 0)
+    if field_name in seg.postings:
+        return seg.postings[field_name].doc_lens > 0
+    if field_name in seg.vectors:
+        return seg.vectors[field_name].exists.copy()
+    if field_name in seg.features:
+        ff = seg.features[field_name]
+        mask = np.zeros(n, bool)
+        docs = ff.block_docs.reshape(-1)
+        mask[docs[docs >= 0]] = True
+        return mask
+    if field_name in seg.geo:
+        return ~np.isnan(seg.geo[field_name][:, 0])
+    return np.zeros(n, bool)
+
+
+def _expand_terms(ctx: SegmentContext, field_name: str, predicate) -> List[str]:
+    """All index terms of a field matching a predicate (prefix/wildcard/regexp/fuzzy)."""
+    seg = ctx.segment
+    terms: List[str] = []
+    kf = seg.keywords.get(field_name)
+    if kf is not None:
+        terms = [t for t in kf.terms if predicate(t)]
+    pf = seg.postings.get(field_name)
+    if pf is not None:
+        terms += [t for t in pf.terms if predicate(t)]
+    return terms
+
+
+def _multi_term_mask(ctx: SegmentContext, field_name: str, terms: List[str]) -> np.ndarray:
+    mask = np.zeros(ctx.segment.n_docs, bool)
+    for t in terms:
+        mask |= _term_mask_host(ctx, field_name, t)
+    return mask
+
+
+def _cached_filter(ctx: SegmentContext, key, build) -> np.ndarray:
+    """Per-segment filter cache (reference: IndicesQueryCache.java:53)."""
+    if key not in ctx._filter_cache:
+        ctx._filter_cache[key] = build()
+    return ctx._filter_cache[key]
+
+
+# ---------------------------------------------------------------------------
+# handlers
+# ---------------------------------------------------------------------------
+
+def _h_match_all(q: dsl.MatchAll, ctx: SegmentContext) -> Result:
+    return jnp.full(ctx.n_docs_pad, q.boost, jnp.float32), ctx.all_mask()
+
+
+def _h_match_none(q: dsl.MatchNone, ctx: SegmentContext) -> Result:
+    return ctx.zeros(), ctx.none_mask()
+
+
+def _bm25_executor(ctx: SegmentContext, field_name: str) -> Optional[Bm25Executor]:
+    dev = DevicePostings.for_segment(ctx.segment, field_name)
+    if dev is None:
+        return None
+    return Bm25Executor(dev, ctx.segment.postings[field_name],
+                        total_doc_count=ctx.doc_count_for_idf())
+
+
+def _h_match(q: dsl.Match, ctx: SegmentContext) -> Result:
+    analyzer = ctx.search_analyzer(q.field)
+    terms = analyzer.terms(q.text)
+    if not terms:
+        return ctx.zeros(), ctx.none_mask()
+    ex = _bm25_executor(ctx, q.field)
+    if ex is None:
+        # not a text field: fall back to term-equality semantics
+        return _h_term(dsl.Term(field=q.field, value=q.text, boost=q.boost), ctx)
+    scores = ex.scores(terms, ctx.live, boost=q.boost, df_override=ctx.df_for(q.field))
+    mask = scores > 0.0
+    msm = dsl.resolve_minimum_should_match(q.minimum_should_match, len(set(terms)))
+    if q.operator == "and" or msm > 1:
+        need = len(terms) if q.operator == "and" else msm
+        count = np.zeros(ctx.segment.n_docs, np.int32)
+        pf = ctx.segment.postings[q.field]
+        for t in set(terms):
+            docs, _ = pf.postings_for(t)
+            count[docs] += 1
+        mask = mask & ctx.to_device_mask(count >= min(need, len(set(terms))))
+    return jnp.where(mask, scores, 0.0), mask
+
+
+def _h_multi_match(q: dsl.MultiMatch, ctx: SegmentContext) -> Result:
+    results = []
+    for f in q.fields:
+        fname, _, fboost = f.partition("^")
+        boost = q.boost * (float(fboost) if fboost else 1.0)
+        results.append(execute(dsl.Match(field=fname, text=q.text,
+                                         operator=q.operator, boost=boost), ctx))
+    if not results:
+        return ctx.zeros(), ctx.none_mask()
+    scores = jnp.stack([r[0] for r in results])
+    masks = jnp.stack([r[1] for r in results])
+    any_mask = jnp.any(masks, axis=0)
+    if q.type == "most_fields":
+        total = jnp.sum(scores, axis=0)
+    else:  # best_fields
+        total = jnp.max(scores, axis=0)
+    return jnp.where(any_mask, total, 0.0), any_mask
+
+
+def _h_match_phrase(q: dsl.MatchPhrase, ctx: SegmentContext) -> Result:
+    analyzer = ctx.search_analyzer(q.field)
+    tokens = analyzer.analyze(q.text)
+    if not tokens:
+        return ctx.zeros(), ctx.none_mask()
+    pf = ctx.segment.postings.get(q.field)
+    if pf is None:
+        return ctx.zeros(), ctx.none_mask()
+    # candidates: docs containing all terms (host AND of postings)
+    cand: Optional[np.ndarray] = None
+    for tok in tokens:
+        docs, _ = pf.postings_for(tok.term)
+        s = set(docs.tolist())
+        cand = s if cand is None else (cand & s)
+        if not cand:
+            break
+    matched = []
+    if cand:
+        # verify positions host-side (fetch-sized candidate sets)
+        rel = [t.position - tokens[0].position for t in tokens]
+        for doc in cand:
+            first = pf.positions_for(tokens[0].term, doc)
+            ok = False
+            for p0 in first:
+                if all(_has_position(pf, t.term, doc, p0 + r, q.slop)
+                       for t, r in zip(tokens[1:], rel[1:])):
+                    ok = True
+                    break
+            if ok:
+                matched.append(doc)
+    mask_host = np.zeros(ctx.segment.n_docs, bool)
+    mask_host[matched] = True
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    # score matched docs with the BM25 of the phrase terms (documented
+    # divergence: the reference scores by phrase frequency)
+    ex = _bm25_executor(ctx, q.field)
+    scores = ex.scores([t.term for t in tokens], ctx.live, boost=q.boost,
+                       df_override=ctx.df_for(q.field))
+    return jnp.where(mask, scores, 0.0), mask
+
+
+def _has_position(pf, term: str, doc: int, want: int, slop: int) -> bool:
+    pos = pf.positions_for(term, doc)
+    if slop == 0:
+        return bool(np.any(pos == want))
+    return bool(np.any(np.abs(pos - want) <= slop))
+
+
+def _h_term(q: dsl.Term, ctx: SegmentContext) -> Result:
+    key = ("term", q.field, str(q.value))
+    mask_host = _cached_filter(ctx, key, lambda: _term_mask_host(ctx, q.field, q.value))
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_terms(q: dsl.Terms, ctx: SegmentContext) -> Result:
+    key = ("terms", q.field, tuple(str(v) for v in q.values))
+    mask_host = _cached_filter(
+        ctx, key, lambda: np.logical_or.reduce(
+            [_term_mask_host(ctx, q.field, v) for v in q.values])
+        if q.values else np.zeros(ctx.segment.n_docs, bool))
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_range(q: dsl.Range, ctx: SegmentContext) -> Result:
+    key = ("range", q.field, str(q.gt), str(q.gte), str(q.lt), str(q.lte))
+    mask_host = _cached_filter(ctx, key, lambda: _range_mask_host(ctx, q))
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_exists(q: dsl.Exists, ctx: SegmentContext) -> Result:
+    mask_host = _cached_filter(ctx, ("exists", q.field),
+                               lambda: _exists_mask_host(ctx, q.field))
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_ids(q: dsl.Ids, ctx: SegmentContext) -> Result:
+    mask_host = np.zeros(ctx.segment.n_docs, bool)
+    for doc_id in q.values:
+        d = ctx.segment.id_to_doc.get(doc_id)
+        if d is not None:
+            mask_host[d] = True
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_prefix(q: dsl.Prefix, ctx: SegmentContext) -> Result:
+    terms = _expand_terms(ctx, q.field, lambda t: t.startswith(q.value))
+    mask = ctx.to_device_mask(_multi_term_mask(ctx, q.field, terms)) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_wildcard(q: dsl.Wildcard, ctx: SegmentContext) -> Result:
+    rx = re.compile(fnmatch.translate(q.value))
+    terms = _expand_terms(ctx, q.field, lambda t: rx.match(t) is not None)
+    mask = ctx.to_device_mask(_multi_term_mask(ctx, q.field, terms)) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_regexp(q: dsl.Regexp, ctx: SegmentContext) -> Result:
+    rx = re.compile(q.value)
+    terms = _expand_terms(ctx, q.field, lambda t: rx.fullmatch(t) is not None)
+    mask = ctx.to_device_mask(_multi_term_mask(ctx, q.field, terms)) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_fuzzy(q: dsl.Fuzzy, ctx: SegmentContext) -> Result:
+    max_edits = _fuzziness_to_edits(q.fuzziness, q.value)
+    terms = _expand_terms(
+        ctx, q.field, lambda t: _levenshtein_within(t, q.value, max_edits))
+    mask = ctx.to_device_mask(_multi_term_mask(ctx, q.field, terms)) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _fuzziness_to_edits(fuzziness: Any, value: str) -> int:
+    if isinstance(fuzziness, int):
+        return fuzziness
+    s = str(fuzziness).upper()
+    if s == "AUTO":
+        n = len(value)
+        return 0 if n <= 2 else (1 if n <= 5 else 2)
+    return int(s)
+
+
+def _levenshtein_within(a: str, b: str, k: int) -> bool:
+    if abs(len(a) - len(b)) > k:
+        return False
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb)))
+        if min(cur) > k:
+            return False
+        prev = cur
+    return prev[-1] <= k
+
+
+def _h_bool(q: dsl.Bool, ctx: SegmentContext) -> Result:
+    scores = ctx.zeros()
+    mask = None
+
+    for clause in q.must:
+        s, m = execute(clause, ctx)
+        scores = scores + s
+        mask = m if mask is None else (mask & m)
+    for clause in q.filter:
+        _, m = execute(clause, ctx)
+        mask = m if mask is None else (mask & m)
+
+    if q.should:
+        should_scores = ctx.zeros()
+        should_count = jnp.zeros(ctx.n_docs_pad, jnp.int32)
+        for clause in q.should:
+            s, m = execute(clause, ctx)
+            should_scores = should_scores + jnp.where(m, s, 0.0)
+            should_count = should_count + m.astype(jnp.int32)
+        if q.minimum_should_match is None:
+            # should is optional when must/filter exist; required otherwise
+            msm = 0 if (q.must or q.filter) else 1
+        else:
+            msm = dsl.resolve_minimum_should_match(
+                q.minimum_should_match, len(q.should))
+        if msm > 0:
+            should_mask = should_count >= msm
+            mask = should_mask if mask is None else (mask & should_mask)
+        scores = scores + should_scores
+
+    if mask is None:
+        mask = ctx.all_mask()
+    for clause in q.must_not:
+        _, m = execute(clause, ctx)
+        mask = mask & ~m
+
+    mask = mask & ctx.live
+    return jnp.where(mask, scores * q.boost, 0.0), mask
+
+
+def _h_constant_score(q: dsl.ConstantScore, ctx: SegmentContext) -> Result:
+    _, mask = execute(q.filter, ctx)
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_dis_max(q: dsl.DisMax, ctx: SegmentContext) -> Result:
+    if not q.queries:
+        return ctx.zeros(), ctx.none_mask()
+    results = [execute(c, ctx) for c in q.queries]
+    scores = jnp.stack([r[0] for r in results])
+    masks = jnp.stack([r[1] for r in results])
+    best = jnp.max(scores, axis=0)
+    rest = jnp.sum(scores, axis=0) - best
+    total = best + q.tie_breaker * rest
+    mask = jnp.any(masks, axis=0)
+    return jnp.where(mask, total * q.boost, 0.0), mask
+
+
+def _h_boosting(q: dsl.Boosting, ctx: SegmentContext) -> Result:
+    pos_s, pos_m = execute(q.positive, ctx)
+    _, neg_m = execute(q.negative, ctx)
+    scores = jnp.where(neg_m, pos_s * q.negative_boost, pos_s)
+    return jnp.where(pos_m, scores, 0.0), pos_m
+
+
+@dataclass
+class KnnBound(dsl.Query):
+    """A Knn node rewritten to its shard-global top-k doc set.
+
+    Mirrors Lucene's KnnVectorQuery rewrite: per-leaf top-k, merged to a
+    global k, then executed as an exact doc-id/score set. Built by
+    rewrite_knn() in the shard query phase."""
+    per_segment: Dict[int, Tuple[np.ndarray, np.ndarray]] = None  # si -> (docs, scores)
+    boost: float = 1.0
+
+
+def rewrite_knn(q: dsl.Query, segment_ctxs: List["SegmentContext"]) -> dsl.Query:
+    """Replace every Knn node with a KnnBound node holding the shard-global
+    top-k (merged across segments)."""
+    if isinstance(q, dsl.Knn):
+        per_seg_hits: List[Tuple[int, int, float]] = []
+        for ctx in segment_ctxs:
+            dev = DeviceVectors.for_segment(ctx.segment, q.field)
+            if dev is None:
+                continue
+            live = ctx.live
+            if q.filter is not None:
+                _, fmask = execute(q.filter, ctx)
+                live = live & fmask
+            ex = KnnExecutor(dev)
+            k = min(q.k, ctx.n_docs_pad)
+            ts, td = ex.top_k(q.query_vector, live, k)
+            ts, td = np.asarray(ts), np.asarray(td)
+            for s, d in zip(ts, td):
+                if s > -np.inf:
+                    per_seg_hits.append((ctx.segment_idx, int(d), float(s)))
+        per_seg_hits.sort(key=lambda x: -x[2])
+        winners = per_seg_hits[: q.k]
+        per_segment: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        for si, d, s in winners:
+            docs, scores = per_segment.setdefault(
+                si, ([], []))  # type: ignore[assignment]
+            docs.append(d)
+            scores.append(s * q.boost)
+        per_segment = {si: (np.asarray(d, np.int64), np.asarray(s, np.float32))
+                       for si, (d, s) in per_segment.items()}
+        return KnnBound(per_segment=per_segment, boost=q.boost)
+    # recurse into compound nodes
+    if isinstance(q, dsl.Bool):
+        return dsl.Bool(must=[rewrite_knn(c, segment_ctxs) for c in q.must],
+                        should=[rewrite_knn(c, segment_ctxs) for c in q.should],
+                        must_not=[rewrite_knn(c, segment_ctxs) for c in q.must_not],
+                        filter=[rewrite_knn(c, segment_ctxs) for c in q.filter],
+                        minimum_should_match=q.minimum_should_match, boost=q.boost)
+    if isinstance(q, dsl.DisMax):
+        return dsl.DisMax(queries=[rewrite_knn(c, segment_ctxs) for c in q.queries],
+                          tie_breaker=q.tie_breaker, boost=q.boost)
+    if isinstance(q, dsl.ConstantScore) and q.filter is not None:
+        return dsl.ConstantScore(filter=rewrite_knn(q.filter, segment_ctxs),
+                                 boost=q.boost)
+    if isinstance(q, dsl.FunctionScore) and q.query is not None:
+        return dsl.FunctionScore(query=rewrite_knn(q.query, segment_ctxs),
+                                 functions=q.functions, boost_mode=q.boost_mode,
+                                 score_mode=q.score_mode, boost=q.boost)
+    return q
+
+
+def _h_knn_bound(q: KnnBound, ctx: SegmentContext) -> Result:
+    entry = (q.per_segment or {}).get(ctx.segment_idx)
+    if entry is None:
+        return ctx.zeros(), ctx.none_mask()
+    docs, doc_scores = entry
+    scores_host = np.zeros(ctx.n_docs_pad, np.float32)
+    mask_host = np.zeros(ctx.n_docs_pad, bool)
+    scores_host[docs] = doc_scores
+    mask_host[docs] = True
+    return jnp.asarray(scores_host), jnp.asarray(mask_host)
+
+
+def _h_knn(q: dsl.Knn, ctx: SegmentContext) -> Result:
+    """Direct (single-segment) execution; the shard phase normally rewrites
+    Knn to KnnBound first for shard-global k semantics."""
+    bound = rewrite_knn(q, [ctx])
+    return _h_knn_bound(bound, ctx)
+
+
+def _h_rank_feature(q: dsl.RankFeature, ctx: SegmentContext) -> Result:
+    # rank_feature targets a single feature inside a rank_features field, or a
+    # standalone rank_feature field (stored as a single-feature field).
+    fname, _, feat = q.field.partition(".")
+    if feat and fname in ctx.segment.features:
+        field_name, feature = fname, feat
+    elif q.field in ctx.segment.features:
+        field_name, feature = q.field, q.field
+    else:
+        return ctx.zeros(), ctx.none_mask()
+    dev = DeviceFeatures.for_segment(ctx.segment, field_name)
+    ex = SparseExecutor(dev, ctx.segment.features[field_name])
+    pivot = q.scaling_factor if q.function == "log" else q.pivot
+    scores = ex.scores([(feature, q.boost)], ctx.live,
+                       function=q.function, pivot=pivot, exponent=q.exponent)
+    return scores, scores > 0.0
+
+
+def _h_text_expansion(q: dsl.TextExpansion, ctx: SegmentContext) -> Result:
+    dev = DeviceFeatures.for_segment(ctx.segment, q.field)
+    if dev is None:
+        return ctx.zeros(), ctx.none_mask()
+    ex = SparseExecutor(dev, ctx.segment.features[q.field])
+    scores = ex.scores([(t, w * q.boost) for t, w in q.tokens.items()],
+                       ctx.live, function="linear")
+    return scores, scores > 0.0
+
+
+_VECTOR_FN = re.compile(
+    r"(cosineSimilarity|dotProduct|l2norm)\s*\(\s*params\.(\w+)\s*,\s*'?\"?([\w.]+)'?\"?\s*\)")
+
+
+def _h_script_score(q: dsl.ScriptScore, ctx: SegmentContext) -> Result:
+    """Supports the reference's vector score functions
+    (ScoreScriptUtils.java:132,151) plus '+ N' offsets — the dominant
+    script_score use in the vector-search benchmark configs."""
+    _, base_mask = execute(q.query, ctx)
+    m = _VECTOR_FN.search(q.source)
+    if not m:
+        raise QueryParsingError(
+            f"unsupported script_score source [{q.source}]; supported: "
+            "cosineSimilarity/dotProduct/l2norm(params.<v>, '<field>') [+ N]")
+    fn, param, field_name = m.groups()
+    vec = q.params.get(param)
+    if vec is None:
+        raise QueryParsingError(f"missing script param [{param}]")
+    dev = DeviceVectors.for_segment(ctx.segment, field_name)
+    if dev is None:
+        return ctx.zeros(), ctx.none_mask()
+    from elasticsearch_tpu.ops.knn import vector_scores
+    qv = jnp.asarray(np.asarray(vec, np.float32))
+    if fn == "cosineSimilarity":
+        raw = vector_scores(dev.matrix, dev.norms, dev.exists, qv, "cosine")
+        raw = raw * 2.0 - 1.0          # undo (1+cos)/2 -> raw cosine
+    elif fn == "dotProduct":
+        raw = vector_scores(dev.matrix, dev.norms, dev.exists, qv, "dot_product")
+        raw = (raw - 0.5) * 2.0        # raw dot
+    else:
+        raw = vector_scores(dev.matrix, dev.norms, dev.exists, qv, "l2_norm")
+        raw = 1.0 / raw - 1.0          # undo 1/(1+d) -> distance
+    offset = 0.0
+    m_off = re.search(r"\+\s*([\d.]+)\s*$", q.source)
+    if m_off:
+        offset = float(m_off.group(1))
+    scores = (raw + offset) * q.boost
+    mask = base_mask & dev.exists & ctx.live
+    return jnp.where(mask, scores, 0.0), mask
+
+
+def _h_function_score(q: dsl.FunctionScore, ctx: SegmentContext) -> Result:
+    scores, mask = execute(q.query, ctx)
+    fn_vals: List[jnp.ndarray] = []
+    for f in q.functions:
+        if "weight" in f and len(f) == 1:
+            fn_vals.append(jnp.full(ctx.n_docs_pad, float(f["weight"])))
+        elif "field_value_factor" in f:
+            spec = f["field_value_factor"]
+            dv = ctx.segment.doc_values.get(spec["field"])
+            vals = np.full(ctx.n_docs_pad, spec.get("missing", 1.0), np.float32)
+            if dv is not None:
+                v = dv.values.astype(np.float64) * spec.get("factor", 1.0)
+                mod = spec.get("modifier", "none")
+                if mod == "log1p":
+                    v = np.log1p(np.maximum(v, 0))
+                elif mod == "sqrt":
+                    v = np.sqrt(np.maximum(v, 0))
+                elif mod == "square":
+                    v = v * v
+                vals[: len(v)][dv.exists] = v[dv.exists]
+            w = float(f.get("weight", 1.0))
+            fn_vals.append(jnp.asarray(vals) * w)
+        elif "random_score" in f:
+            seed = int(f["random_score"].get("seed", 42))
+            rng = np.random.default_rng(seed)
+            fn_vals.append(jnp.asarray(rng.random(ctx.n_docs_pad, np.float32))
+                           * float(f.get("weight", 1.0)))
+        else:
+            raise QueryParsingError(f"unsupported function_score function {list(f)}")
+    if fn_vals:
+        stack = jnp.stack(fn_vals)
+        if q.score_mode == "multiply":
+            fn_total = jnp.prod(stack, axis=0)
+        elif q.score_mode == "max":
+            fn_total = jnp.max(stack, axis=0)
+        elif q.score_mode == "min":
+            fn_total = jnp.min(stack, axis=0)
+        elif q.score_mode == "avg":
+            fn_total = jnp.mean(stack, axis=0)
+        else:
+            fn_total = jnp.sum(stack, axis=0)
+        if q.boost_mode == "multiply":
+            scores = scores * fn_total
+        elif q.boost_mode == "replace":
+            scores = fn_total
+        elif q.boost_mode == "sum":
+            scores = scores + fn_total
+        elif q.boost_mode == "avg":
+            scores = (scores + fn_total) / 2.0
+        elif q.boost_mode == "max":
+            scores = jnp.maximum(scores, fn_total)
+        elif q.boost_mode == "min":
+            scores = jnp.minimum(scores, fn_total)
+    return jnp.where(mask, scores * q.boost, 0.0), mask
+
+
+_HANDLERS = {
+    KnnBound: _h_knn_bound,
+    dsl.MatchAll: _h_match_all,
+    dsl.MatchNone: _h_match_none,
+    dsl.Match: _h_match,
+    dsl.MultiMatch: _h_multi_match,
+    dsl.MatchPhrase: _h_match_phrase,
+    dsl.Term: _h_term,
+    dsl.Terms: _h_terms,
+    dsl.Range: _h_range,
+    dsl.Exists: _h_exists,
+    dsl.Ids: _h_ids,
+    dsl.Prefix: _h_prefix,
+    dsl.Wildcard: _h_wildcard,
+    dsl.Regexp: _h_regexp,
+    dsl.Fuzzy: _h_fuzzy,
+    dsl.Bool: _h_bool,
+    dsl.ConstantScore: _h_constant_score,
+    dsl.DisMax: _h_dis_max,
+    dsl.Boosting: _h_boosting,
+    dsl.Knn: _h_knn,
+    dsl.RankFeature: _h_rank_feature,
+    dsl.TextExpansion: _h_text_expansion,
+    dsl.ScriptScore: _h_script_score,
+    dsl.FunctionScore: _h_function_score,
+}

@@ -1,0 +1,377 @@
+"""Shard-level query phase.
+
+The analog of SearchService.executeQueryPhase + QueryPhase.executeInternal
+(search/SearchService.java:366, search/query/QueryPhase.java:171): runs the
+compiled query over every segment of a shard snapshot, applies sort /
+pagination / search_after / total-hits tracking, and returns light-weight doc
+references (fetch happens in a separate phase, like the reference's
+query_then_fetch).
+
+Shard-level term statistics: per-segment idf would skew scores across
+segments, so we aggregate df over all live segments first — the same
+mechanism scales up to the cross-shard DFS phase (search/dfs/DfsPhase.java:43).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.index.engine import Reader
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.execute import SegmentContext, execute
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+DEFAULT_TRACK_TOTAL_HITS = 10_000
+
+
+@dataclass
+class SortSpec:
+    field: str                  # "_score", "_doc", or a doc-values field
+    order: str = "desc"         # asc | desc
+    missing: Any = None
+
+
+@dataclass
+class ShardDoc:
+    segment_idx: int
+    doc: int                    # local doc id within segment
+    score: float
+    sort_values: Tuple = ()
+
+
+@dataclass
+class ShardQueryResult:
+    docs: List[ShardDoc]
+    total_hits: int
+    total_relation: str         # "eq" | "gte"
+    max_score: Optional[float]
+    # per-field term stats used (exposed for the coordinator's DFS merge)
+    doc_count: int = 0
+    dfs: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+
+def parse_sort(sort_body: Any) -> List[SortSpec]:
+    if sort_body is None:
+        return [SortSpec("_score")]
+    if isinstance(sort_body, (str, dict)):
+        sort_body = [sort_body]
+    out: List[SortSpec] = []
+    for entry in sort_body:
+        if isinstance(entry, str):
+            out.append(SortSpec(entry, "asc" if entry not in ("_score",) else "desc"))
+        elif isinstance(entry, dict):
+            (fname, spec), = entry.items()
+            if isinstance(spec, str):
+                out.append(SortSpec(fname, spec))
+            else:
+                out.append(SortSpec(fname, spec.get("order", "asc"),
+                                    spec.get("missing")))
+        else:
+            raise IllegalArgumentError(f"bad sort entry {entry!r}")
+    return out
+
+
+def collect_query_terms(q: dsl.Query) -> Dict[str, List[str]]:
+    """Walk the tree for (field -> analyzed terms) needing df stats."""
+    from elasticsearch_tpu.analysis import STANDARD
+    out: Dict[str, List[str]] = {}
+
+    def walk(node, mappers=None):
+        if isinstance(node, dsl.Match):
+            out.setdefault(node.field, []).append(node.text)
+        elif isinstance(node, dsl.MatchPhrase):
+            out.setdefault(node.field, []).append(node.text)
+        elif isinstance(node, dsl.MultiMatch):
+            for f in node.fields:
+                out.setdefault(f.partition("^")[0], []).append(node.text)
+        elif isinstance(node, dsl.Bool):
+            for c in node.must + node.should + node.must_not + node.filter:
+                walk(c)
+        elif isinstance(node, (dsl.ConstantScore,)):
+            walk(node.filter)
+        elif isinstance(node, dsl.DisMax):
+            for c in node.queries:
+                walk(c)
+        elif isinstance(node, dsl.Boosting):
+            walk(node.positive)
+            walk(node.negative)
+        elif isinstance(node, (dsl.ScriptScore, dsl.FunctionScore, dsl.Nested)):
+            if node.query is not None:
+                walk(node.query)
+        elif isinstance(node, dsl.Knn) and node.filter is not None:
+            walk(node.filter)
+
+    walk(q)
+    return out
+
+
+def shard_term_stats(reader: Reader, mappers: MapperService,
+                     q: dsl.Query) -> Tuple[int, Dict[str, Dict[str, int]]]:
+    """(live doc count, field -> term -> df) aggregated over segments."""
+    doc_count = reader.doc_count
+    field_texts = collect_query_terms(q)
+    dfs: Dict[str, Dict[str, int]] = {}
+    for fname, texts in field_texts.items():
+        mapper = mappers.mapper(fname)
+        analyzer = getattr(mapper, "search_analyzer", None)
+        if analyzer is None:
+            from elasticsearch_tpu.analysis import STANDARD
+            analyzer = STANDARD
+        terms = set()
+        for t in texts:
+            terms.update(analyzer.terms(t))
+        per_term: Dict[str, int] = {}
+        for term in terms:
+            df = 0
+            for seg in reader.segments:
+                pf = seg.postings.get(fname)
+                if pf is not None:
+                    tid = pf.terms.get(term)
+                    if tid is not None:
+                        df += int(pf.doc_freq[tid])
+            if df:
+                per_term[term] = df
+        dfs[fname] = per_term
+    return doc_count, dfs
+
+
+def query_shard(reader: Reader,
+                mappers: MapperService,
+                query: dsl.Query,
+                size: int = 10,
+                from_: int = 0,
+                sort: Optional[List[SortSpec]] = None,
+                search_after: Optional[Sequence[Any]] = None,
+                track_total_hits: Any = DEFAULT_TRACK_TOTAL_HITS,
+                min_score: Optional[float] = None,
+                doc_count_override: Optional[int] = None,
+                df_overrides: Optional[Dict[str, Dict[str, int]]] = None,
+                collectors: Optional[List] = None) -> ShardQueryResult:
+    """Execute one query over all segments of a shard snapshot.
+
+    ``collectors``: optional aggregation collectors, each called with
+    (ctx, segment_idx, scores, mask) per segment (two-level agg model).
+    """
+    sort = sort or [SortSpec("_score")]
+    doc_count, dfs = shard_term_stats(reader, mappers, query)
+    if doc_count_override is not None:
+        doc_count = doc_count_override
+    if df_overrides is not None:
+        merged = {f: dict(v) for f, v in dfs.items()}
+        for f, terms in df_overrides.items():
+            merged.setdefault(f, {}).update(terms)
+        dfs = merged
+
+    want = from_ + size
+    total_hits = 0
+    exact_total = track_total_hits is True or (
+        isinstance(track_total_hits, int) and track_total_hits > 0)
+    track_limit = (1 << 62) if track_total_hits is True else (
+        int(track_total_hits) if track_total_hits else 0)
+
+    candidates: List[ShardDoc] = []
+    score_sort = sort[0].field == "_score"
+    score_asc = score_sort and sort[0].order == "asc"
+
+    ctxs = [SegmentContext(seg, mappers, segment_idx=si,
+                           doc_count_override=doc_count, df_overrides=dfs)
+            for si, seg in enumerate(reader.segments)]
+    # Lucene-style kNN rewrite: per-segment top-k merged to shard-global k
+    from elasticsearch_tpu.search.execute import rewrite_knn
+    query = rewrite_knn(query, ctxs)
+
+    for si, (ctx, live_host) in enumerate(zip(ctxs, reader.live_masks)):
+        seg = ctx.segment
+        # the reader's snapshot mask governs visibility, not the segment's
+        # current mask
+        snap = np.zeros(ctx.n_docs_pad, bool)
+        snap[: len(live_host)] = live_host
+        scores, mask = execute(query, ctx)
+        mask = mask & jnp.asarray(snap)
+        if min_score is not None:
+            mask = mask & (scores >= min_score)
+        scores = jnp.where(mask, scores, -jnp.inf)
+
+        total_hits += int(jnp.sum(mask))
+
+        if score_sort and search_after is not None:
+            # the cursor must cut BEFORE per-segment top-k, or deeper docs in
+            # a segment whose best hit was already returned would be lost
+            a_score = float(search_after[0])
+            a_si = int(search_after[1]) if len(search_after) > 2 else -1
+            a_doc = int(search_after[2]) if len(search_after) > 2 else -1
+            doc_idx = jnp.arange(ctx.n_docs_pad)
+            before = (scores > a_score) if score_asc else (scores < a_score)
+            at = scores == a_score
+            if si < a_si:
+                allowed = before
+            elif si == a_si:
+                allowed = before | (at & (doc_idx > a_doc))
+            else:
+                allowed = before | at
+            scores = jnp.where(allowed, scores, -jnp.inf)
+
+        if score_sort:
+            k = min(max(want, 1), ctx.n_docs_pad)
+            if score_asc:
+                # ascending: select the LOWEST scores among matches
+                neg = jnp.where(jnp.isfinite(scores), -scores, -jnp.inf)
+                top_s, top_d = _topk(neg, k)
+                top_s = -np.asarray(top_s)
+                top_d = np.asarray(top_d)
+                finite = np.isfinite(top_s)
+                top_s, top_d = top_s[finite], top_d[finite]
+            else:
+                top_s, top_d = _topk(scores, k)
+                top_s = np.asarray(top_s)
+                top_d = np.asarray(top_d)
+            for s, d in zip(top_s, top_d):
+                if s == -np.inf:
+                    break
+                candidates.append(ShardDoc(si, int(d), float(s), (float(s),)))
+        else:
+            mask_host = np.asarray(mask)[: seg.n_docs]
+            matched = np.nonzero(mask_host)[0]
+            if len(matched) == 0:
+                continue
+            scores_host = np.asarray(scores)[: seg.n_docs]
+            keys = _sort_keys(ctx, sort, matched, scores_host)
+            for row, d in enumerate(matched):
+                candidates.append(ShardDoc(si, int(d), float(scores_host[d]),
+                                           tuple(k[row] for k in keys)))
+
+        for collector in (collectors or []):
+            collector.collect(ctx, si, scores, mask)
+
+    # order candidates by the sort spec, (segment, doc) as final tiebreak
+    reverse = [s.order == "desc" for s in sort]
+    if score_sort:
+        candidates.sort(key=lambda c: (-c.score if reverse[0] else c.score,
+                                       c.segment_idx, c.doc))
+    else:
+        import functools
+        candidates.sort(key=functools.cmp_to_key(
+            lambda a, b: _compare(a, b, reverse)))
+
+    if search_after is not None:
+        candidates = [c for c in candidates
+                      if _after(c, search_after, sort, reverse)]
+
+    window = candidates[from_: from_ + size]
+    max_score = None
+    if candidates and score_sort:
+        max_score = max(c.score for c in candidates)
+
+    relation = "eq"
+    if exact_total and track_limit < (1 << 62) and total_hits > track_limit:
+        relation = "gte"
+        total_hits = track_limit
+    return ShardQueryResult(window, total_hits, relation, max_score,
+                            doc_count=doc_count, dfs=dfs)
+
+
+def _topk(scores: jnp.ndarray, k: int):
+    import jax
+    return jax.lax.top_k(scores, k)
+
+
+def _sort_keys(ctx: SegmentContext, sort: List[SortSpec],
+               matched: np.ndarray, scores_host: np.ndarray) -> List[list]:
+    """Per-spec key columns. Numeric keys are floats, keyword keys are
+    strings, missing values are None (sorted last like the reference's
+    default _last, unless spec.missing overrides)."""
+    keys = []
+    for spec in sort:
+        if spec.field == "_score":
+            keys.append([float(scores_host[d]) for d in matched])
+        elif spec.field == "_doc":
+            keys.append([float(d) for d in matched])
+        elif spec.field in ctx.segment.keywords:
+            kf = ctx.segment.keywords[spec.field]
+            col = []
+            for d in matched:
+                ords = kf.ord_values[kf.ord_offsets[d]: kf.ord_offsets[d + 1]]
+                if len(ords) == 0:
+                    col.append(spec.missing if spec.missing is not None else None)
+                else:
+                    terms = sorted(kf.term_list[int(o)] for o in ords)
+                    # multi-valued: min for asc, max for desc (ES default mode)
+                    col.append(terms[0] if spec.order == "asc" else terms[-1])
+            keys.append(col)
+        else:
+            dv = ctx.segment.doc_values.get(spec.field)
+            if dv is None:
+                fill = float(spec.missing) if spec.missing is not None else None
+                keys.append([fill] * len(matched))
+            else:
+                col = []
+                for d in matched:
+                    if dv.exists[d]:
+                        vals = dv.multi.get(int(d), [dv.values[d]])
+                        v = (min(vals) if spec.order == "asc" else max(vals))
+                        col.append(float(v))
+                    elif spec.missing is not None:
+                        col.append(float(spec.missing))
+                    else:
+                        col.append(None)
+                keys.append(col)
+    return keys
+
+
+def _cmp_values(a, b, rev: bool) -> int:
+    """Element compare with None (missing) always last."""
+    if a is None and b is None:
+        return 0
+    if a is None:
+        return 1
+    if b is None:
+        return -1
+    if a == b:
+        return 0
+    lt = a < b
+    if rev:
+        return 1 if lt else -1
+    return -1 if lt else 1
+
+
+def _compare(a: ShardDoc, b: ShardDoc, reverse: List[bool]) -> int:
+    for av, bv, rev in zip(a.sort_values, b.sort_values, reverse):
+        c = _cmp_values(av, bv, rev)
+        if c:
+            return c
+    if (a.segment_idx, a.doc) < (b.segment_idx, b.doc):
+        return -1
+    if (a.segment_idx, a.doc) > (b.segment_idx, b.doc):
+        return 1
+    return 0
+
+
+def _after(c: ShardDoc, after: Sequence[Any], sort: List[SortSpec],
+           reverse: List[bool]) -> bool:
+    """True if candidate sorts strictly after the cursor. Internal cursors
+    (scroll) append (segment_idx, doc) beyond the user sort values; ties on
+    user values then break on that, so scroll never drops tied docs."""
+    if sort[0].field == "_score":
+        a_score = float(after[0])
+        if c.score != a_score:
+            asc = sort[0].order == "asc"
+            return (c.score > a_score) if asc else (c.score < a_score)
+        if len(after) >= 3:
+            return (c.segment_idx, c.doc) > (int(after[1]), int(after[2]))
+        return False
+    n = len(sort)
+    for v, a, rev in zip(c.sort_values, after[:n], reverse):
+        av = a if (isinstance(a, str) or a is None or v is None
+                   or isinstance(v, str)) else float(a)
+        cmp = _cmp_values(v, av, rev)
+        if cmp:
+            return cmp > 0
+    if len(after) >= n + 2:
+        return (c.segment_idx, c.doc) > (int(after[n]), int(after[n + 1]))
+    return False

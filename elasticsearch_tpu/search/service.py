@@ -1,0 +1,169 @@
+"""SearchService: request body -> phases -> response.
+
+Reference analog: search/SearchService.java:136 (phase dispatch, scroll
+context registry at :203 with keep-alive reaping at :230). One instance per
+shard engine; the distributed coordinator (action layer) talks to many.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.index.engine import InternalEngine, Reader
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.fetch import fetch_hits
+from elasticsearch_tpu.search.phase import (
+    ShardQueryResult, SortSpec, parse_sort, query_shard,
+)
+from elasticsearch_tpu.utils.errors import IllegalArgumentError, SearchEngineError
+
+
+class SearchContextMissingError(SearchEngineError):
+    status = 404
+
+
+@dataclass
+class ScrollContext:
+    scroll_id: str
+    reader: Reader
+    body: Dict[str, Any]
+    sort: List[SortSpec]
+    last_sort_values: Optional[List[Any]]
+    keep_alive_until: float
+    index_name: str
+
+
+class SearchService:
+    def __init__(self, engine: InternalEngine, index_name: str = "index"):
+        self.engine = engine
+        self.index_name = index_name
+        self._scrolls: Dict[str, ScrollContext] = {}
+        self._last_result: Optional[ShardQueryResult] = None
+
+    # ------------------------------------------------------------------
+
+    def search(self, body: Optional[Dict[str, Any]] = None,
+               scroll_keep_alive: Optional[float] = None,
+               reader: Optional[Reader] = None,
+               doc_count_override: Optional[int] = None,
+               df_overrides: Optional[Dict[str, Dict[str, int]]] = None,
+               collectors: Optional[List] = None) -> Dict[str, Any]:
+        body = body or {}
+        t0 = time.monotonic()
+        self.reap_scrolls()
+        reader = reader or self.engine.acquire_reader()
+        query = dsl.parse_query(body.get("query"))
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        sort = parse_sort(body.get("sort"))
+        search_after = body.get("search_after")
+        track = body.get("track_total_hits", 10_000)
+
+        result = query_shard(
+            reader, self.engine.mappers, query,
+            size=size, from_=from_, sort=sort,
+            search_after=search_after,
+            track_total_hits=track,
+            min_score=body.get("min_score"),
+            doc_count_override=doc_count_override,
+            df_overrides=df_overrides,
+            collectors=collectors,
+        )
+
+        include_sort = body.get("sort") is not None or search_after is not None
+        hits = fetch_hits(
+            reader, self.engine.mappers, result.docs, self.index_name,
+            query=query,
+            source_filter=body.get("_source", True),
+            docvalue_fields=body.get("docvalue_fields"),
+            highlight=body.get("highlight"),
+            include_sort=include_sort,
+            seq_no_primary_term=bool(body.get("seq_no_primary_term")),
+            include_version=bool(body.get("version")),
+        )
+
+        response: Dict[str, Any] = {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": result.total_hits, "relation": result.total_relation},
+                "max_score": result.max_score,
+                "hits": hits,
+            },
+        }
+
+        if scroll_keep_alive:
+            scroll_id = uuid.uuid4().hex
+            self._scrolls[scroll_id] = ScrollContext(
+                scroll_id, reader, dict(body), sort,
+                self._cursor_of(body, result),
+                time.monotonic() + scroll_keep_alive, self.index_name)
+            response["_scroll_id"] = scroll_id
+        self._last_result = result
+        return response
+
+    @staticmethod
+    def _cursor_of(body: Dict[str, Any], result: ShardQueryResult):
+        """Cursor for the next scroll page. Field sorts use the hit's sort
+        values; the default score sort uses (score, segment, doc) — the
+        internal tiebreak understood by phase._after."""
+        if not result.docs:
+            return None
+        last = result.docs[-1]
+        if body.get("sort") is not None:
+            # append (segment, doc) tiebreak so tied sort keys never repeat
+            # or drop across pages (phase._after understands the extension)
+            return list(last.sort_values) + [last.segment_idx, last.doc]
+        return [last.score, last.segment_idx, last.doc]
+
+    # ------------------------------------------------------------------
+
+    def scroll(self, scroll_id: str, keep_alive: Optional[float] = None
+               ) -> Dict[str, Any]:
+        self.reap_scrolls()
+        sc = self._scrolls.get(scroll_id)
+        if sc is None:
+            raise SearchContextMissingError(f"No search context found for id [{scroll_id}]")
+        if sc.last_sort_values is None:
+            return self._empty_page(scroll_id)   # exhausted
+        body = dict(sc.body)
+        body.pop("from", None)
+        body["search_after"] = sc.last_sort_values
+        response = self.search(body, reader=sc.reader)
+        sc.last_sort_values = self._cursor_of(body, self._last_result)
+        if keep_alive:
+            sc.keep_alive_until = time.monotonic() + keep_alive
+        response["_scroll_id"] = scroll_id
+        return response
+
+    def clear_scroll(self, scroll_id: str) -> bool:
+        return self._scrolls.pop(scroll_id, None) is not None
+
+    def reap_scrolls(self) -> None:
+        now = time.monotonic()
+        for sid in [s for s, c in self._scrolls.items() if c.keep_alive_until < now]:
+            del self._scrolls[sid]
+
+    def _empty_page(self, scroll_id: str) -> Dict[str, Any]:
+        return {
+            "took": 0, "timed_out": False, "_scroll_id": scroll_id,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+            "hits": {"total": {"value": 0, "relation": "eq"},
+                     "max_score": None, "hits": []},
+        }
+
+    # ------------------------------------------------------------------
+
+    def count(self, body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        body = body or {}
+        reader = self.engine.acquire_reader()
+        query = dsl.parse_query(body.get("query"))
+        result = query_shard(reader, self.engine.mappers, query,
+                             size=0, track_total_hits=True)
+        return {"count": result.total_hits,
+                "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0}}

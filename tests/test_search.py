@@ -1,0 +1,392 @@
+"""End-to-end single-shard search tests: index -> refresh -> query DSL -> hits."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index import InternalEngine
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import SearchService
+from elasticsearch_tpu.utils.errors import QueryParsingError
+
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text", "analyzer": "english"},
+        "tag": {"type": "keyword"},
+        "views": {"type": "long"},
+        "price": {"type": "double"},
+        "published": {"type": "date"},
+        "active": {"type": "boolean"},
+        "vec": {"type": "dense_vector", "dims": 4, "similarity": "cosine"},
+        "expansion": {"type": "rank_features"},
+    }
+}
+
+DOCS = [
+    {"title": "quick brown fox", "body": "The quick brown fox jumps over the lazy dog",
+     "tag": ["animal", "story"], "views": 100, "price": 9.99,
+     "published": "2024-01-01", "active": True, "vec": [1, 0, 0, 0],
+     "expansion": {"fox": 2.0, "animal": 1.0}},
+    {"title": "lazy dog sleeps", "body": "A lazy dog sleeps all day long",
+     "tag": "animal", "views": 50, "price": 19.99,
+     "published": "2024-02-01", "active": False, "vec": [0, 1, 0, 0],
+     "expansion": {"dog": 1.5}},
+    {"title": "quick start guide", "body": "A quick start guide to searching",
+     "tag": "docs", "views": 500, "price": 0.0,
+     "published": "2024-03-01", "active": True, "vec": [0.9, 0.1, 0, 0],
+     "expansion": {"guide": 3.0, "search": 1.0}},
+    {"title": "brown bear country", "body": "Brown bears roam the quick rivers",
+     "tag": ["animal"], "views": 200, "price": 5.0,
+     "published": "2023-06-15", "active": True, "vec": [0, 0, 1, 0],
+     "expansion": {"animal": 2.5, "bear": 2.0}},
+]
+
+
+@pytest.fixture(scope="module")
+def svc():
+    engine = InternalEngine(MapperService(MAPPING), shard_label="t")
+    for i, d in enumerate(DOCS):
+        engine.index(str(i), d)
+        if i == 1:
+            engine.refresh()   # force two segments to exercise multi-segment merge
+    engine.refresh()
+    return SearchService(engine, index_name="test")
+
+
+def ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+def test_match_all(svc):
+    r = svc.search({"query": {"match_all": {}}})
+    assert r["hits"]["total"]["value"] == 4
+    assert len(r["hits"]["hits"]) == 4
+
+
+def test_match_ranks_relevant_first(svc):
+    r = svc.search({"query": {"match": {"title": "quick fox"}}})
+    assert ids(r)[0] == "0"               # has both terms
+    assert set(ids(r)) == {"0", "2"}      # docs with quick or fox in title
+    assert r["hits"]["max_score"] == r["hits"]["hits"][0]["_score"]
+
+
+def test_match_operator_and(svc):
+    r = svc.search({"query": {"match": {"title": {"query": "quick fox",
+                                                  "operator": "and"}}}})
+    assert ids(r) == ["0"]
+
+
+def test_match_with_analyzer_stemming(svc):
+    # english analyzer: 'jumping' stems to match 'jumps'
+    r = svc.search({"query": {"match": {"body": "jumping"}}})
+    assert ids(r) == ["0"]
+
+
+def test_match_phrase(svc):
+    r = svc.search({"query": {"match_phrase": {"body": "lazy dog"}}})
+    assert set(ids(r)) == {"0", "1"}
+    r = svc.search({"query": {"match_phrase": {"body": "dog lazy"}}})
+    assert ids(r) == []
+
+
+def test_term_and_terms(svc):
+    r = svc.search({"query": {"term": {"tag": "docs"}}})
+    assert ids(r) == ["2"]
+    r = svc.search({"query": {"terms": {"tag": ["docs", "story"]}}})
+    assert set(ids(r)) == {"0", "2"}
+
+
+def test_term_on_numeric_and_bool(svc):
+    r = svc.search({"query": {"term": {"views": 500}}})
+    assert ids(r) == ["2"]
+    r = svc.search({"query": {"term": {"active": True}}})
+    assert set(ids(r)) == {"0", "2", "3"}
+
+
+def test_range_numeric_and_date(svc):
+    r = svc.search({"query": {"range": {"views": {"gte": 100, "lt": 500}}}})
+    assert set(ids(r)) == {"0", "3"}
+    r = svc.search({"query": {"range": {"published": {"gte": "2024-01-01"}}}})
+    assert set(ids(r)) == {"0", "1", "2"}
+
+
+def test_exists(svc):
+    r = svc.search({"query": {"exists": {"field": "vec"}}})
+    assert r["hits"]["total"]["value"] == 4
+
+
+def test_ids_query(svc):
+    r = svc.search({"query": {"ids": {"values": ["1", "3", "nope"]}}})
+    assert set(ids(r)) == {"1", "3"}
+
+
+def test_prefix_wildcard_regexp_fuzzy(svc):
+    assert set(ids(svc.search({"query": {"prefix": {"title": "qui"}}}))) == {"0", "2"}
+    assert set(ids(svc.search({"query": {"wildcard": {"tag": "ani*"}}}))) == {"0", "1", "3"}
+    assert set(ids(svc.search({"query": {"regexp": {"tag": "doc.?"}}}))) == {"2"}
+    assert set(ids(svc.search({"query": {"fuzzy": {"title": "quik"}}}))) == {"0", "2"}
+
+
+def test_bool_combination(svc):
+    r = svc.search({"query": {"bool": {
+        "must": [{"match": {"title": "quick"}}],
+        "filter": [{"term": {"active": True}}],
+        "must_not": [{"term": {"tag": "docs"}}],
+    }}})
+    assert ids(r) == ["0"]
+
+
+def test_bool_should_minimum_should_match(svc):
+    r = svc.search({"query": {"bool": {
+        "should": [{"term": {"tag": "animal"}}, {"range": {"views": {"gte": 150}}}],
+        "minimum_should_match": 2,
+    }}})
+    assert ids(r) == ["3"]      # animal AND views>=150
+
+
+def test_constant_score_and_dis_max(svc):
+    r = svc.search({"query": {"constant_score": {
+        "filter": {"term": {"tag": "animal"}}, "boost": 3.0}}})
+    assert all(h["_score"] == 3.0 for h in r["hits"]["hits"])
+    r = svc.search({"query": {"dis_max": {"queries": [
+        {"match": {"title": "quick"}}, {"match": {"body": "bears"}}]}}})
+    assert set(ids(r)) == {"0", "2", "3"}
+
+
+def test_knn_query(svc):
+    r = svc.search({"query": {"knn": {"field": "vec",
+                                      "query_vector": [1, 0, 0, 0], "k": 2}}})
+    assert ids(r)[0] == "0"
+    assert len(ids(r)) == 2
+    assert ids(r)[1] == "2"   # 0.9,0.1 is next closest
+
+
+def test_knn_with_filter(svc):
+    r = svc.search({"query": {"knn": {"field": "vec", "query_vector": [1, 0, 0, 0],
+                                      "k": 2, "filter": {"term": {"tag": "animal"}}}}})
+    assert ids(r)[0] == "0"
+    assert "2" not in ids(r)   # filtered out (tag=docs)
+
+
+def test_script_score_cosine(svc):
+    r = svc.search({"query": {"script_score": {
+        "query": {"match_all": {}},
+        "script": {"source": "cosineSimilarity(params.qv, 'vec') + 1.0",
+                   "params": {"qv": [1, 0, 0, 0]}}}}})
+    assert ids(r)[0] == "0"
+    assert r["hits"]["hits"][0]["_score"] == pytest.approx(2.0, abs=2e-2)
+
+
+def test_rank_feature_and_text_expansion(svc):
+    r = svc.search({"query": {"rank_feature": {"field": "expansion.animal"}}})
+    assert set(ids(r)) == {"0", "3"}
+    assert ids(r)[0] == "3"   # higher weight
+
+    r = svc.search({"query": {"text_expansion": {"expansion": {
+        "tokens": {"fox": 1.0, "guide": 1.0}}}}})
+    assert set(ids(r)) == {"0", "2"}
+    assert ids(r)[0] == "2"   # guide weight 3.0 > fox 2.0
+
+
+def test_function_score_field_value_factor(svc):
+    r = svc.search({"query": {"function_score": {
+        "query": {"term": {"tag": "animal"}},
+        "functions": [{"field_value_factor": {"field": "views", "modifier": "log1p"}}],
+        "boost_mode": "replace"}}})
+    assert ids(r)[0] == "3"   # highest views among animal docs
+
+
+def test_sort_by_field(svc):
+    r = svc.search({"query": {"match_all": {}}, "sort": [{"views": "desc"}]})
+    assert ids(r) == ["2", "3", "0", "1"]
+    assert r["hits"]["hits"][0]["sort"] == [500.0]
+    r = svc.search({"query": {"match_all": {}}, "sort": [{"price": "asc"}]})
+    assert ids(r) == ["2", "3", "0", "1"]
+
+
+def test_pagination_from_size(svc):
+    r = svc.search({"query": {"match_all": {}}, "sort": [{"views": "desc"}],
+                    "size": 2, "from": 1})
+    assert ids(r) == ["3", "0"]
+
+
+def test_search_after(svc):
+    r1 = svc.search({"query": {"match_all": {}}, "sort": [{"views": "desc"}], "size": 2})
+    assert ids(r1) == ["2", "3"]
+    after = r1["hits"]["hits"][-1]["sort"]
+    r2 = svc.search({"query": {"match_all": {}}, "sort": [{"views": "desc"}],
+                     "size": 2, "search_after": after})
+    assert ids(r2) == ["0", "1"]
+
+
+def test_scroll(svc):
+    r1 = svc.search({"query": {"match_all": {}}, "sort": [{"views": "asc"}],
+                     "size": 2}, scroll_keep_alive=60)
+    sid = r1["_scroll_id"]
+    assert ids(r1) == ["1", "0"]
+    r2 = svc.scroll(sid)
+    assert ids(r2) == ["3", "2"]
+    r3 = svc.scroll(sid)
+    assert ids(r3) == []
+    assert svc.clear_scroll(sid)
+
+
+def test_scroll_score_sort(svc):
+    r1 = svc.search({"query": {"match": {"body": "quick"}}, "size": 1},
+                    scroll_keep_alive=60)
+    seen = set(ids(r1))
+    sid = r1["_scroll_id"]
+    while True:
+        r = svc.scroll(sid)
+        page = ids(r)
+        if not page:
+            break
+        assert not (set(page) & seen)   # no duplicates across pages
+        seen.update(page)
+    assert len(seen) == 3  # docs 0, 2, 3 contain 'quick'
+
+
+def test_source_filtering(svc):
+    r = svc.search({"query": {"ids": {"values": ["0"]}},
+                    "_source": {"includes": ["title", "views"]}})
+    src = r["hits"]["hits"][0]["_source"]
+    assert set(src.keys()) == {"title", "views"}
+    r = svc.search({"query": {"ids": {"values": ["0"]}}, "_source": False})
+    assert "_source" not in r["hits"]["hits"][0]
+
+
+def test_docvalue_fields_and_version(svc):
+    r = svc.search({"query": {"ids": {"values": ["0"]}},
+                    "docvalue_fields": ["views", "tag"],
+                    "version": True, "seq_no_primary_term": True})
+    h = r["hits"]["hits"][0]
+    assert h["fields"]["views"] == [100]
+    assert set(h["fields"]["tag"]) == {"animal", "story"}
+    assert h["_version"] == 1
+    assert h["_seq_no"] == 0
+
+
+def test_highlight(svc):
+    r = svc.search({"query": {"match": {"body": "fox"}},
+                    "highlight": {"fields": {"body": {}}}})
+    frags = r["hits"]["hits"][0]["highlight"]["body"]
+    assert any("<em>fox</em>" in f for f in frags)
+
+
+def test_min_score(svc):
+    r = svc.search({"query": {"constant_score": {
+        "filter": {"match_all": {}}, "boost": 0.5}}, "min_score": 1.0})
+    assert r["hits"]["total"]["value"] == 0
+
+
+def test_track_total_hits_cap(svc):
+    r = svc.search({"query": {"match_all": {}}, "track_total_hits": 2})
+    assert r["hits"]["total"] == {"value": 2, "relation": "gte"}
+
+
+def test_count(svc):
+    assert svc.count({"query": {"term": {"tag": "animal"}}})["count"] == 3
+    assert svc.count()["count"] == 4
+
+
+def test_unknown_query_type(svc):
+    with pytest.raises(QueryParsingError, match="unknown query type"):
+        svc.search({"query": {"zmatch": {"title": "x"}}})
+
+
+def test_multi_match(svc):
+    r = svc.search({"query": {"multi_match": {
+        "query": "quick guide", "fields": ["title^2", "body"]}}})
+    assert ids(r)[0] == "2"
+
+
+def test_minimum_should_match_string_forms(svc):
+    base = {"bool": {"should": [{"term": {"tag": "animal"}},
+                                {"range": {"views": {"gte": 150}}}]}}
+    for form in ("2", "100%", 2):
+        q = {"bool": {**base["bool"], "minimum_should_match": form}}
+        assert ids(svc.search({"query": q})) == ["3"]
+    q = {"bool": {**base["bool"], "minimum_should_match": "-0%"}}
+    r = svc.search({"query": q})
+    assert r["hits"]["total"]["value"] >= 3
+
+
+def test_sort_score_asc(svc):
+    r = svc.search({"query": {"match": {"body": "quick"}},
+                    "sort": [{"_score": "asc"}]})
+    scores = [h["_score"] for h in r["hits"]["hits"]]
+    assert scores == sorted(scores)
+    assert len(scores) == 3
+
+
+def test_sort_by_keyword(svc):
+    r = svc.search({"query": {"match_all": {}}, "sort": [{"tag": "asc"}]})
+    keys = [h["sort"][0] for h in r["hits"]["hits"]]
+    assert keys == sorted(keys)
+    r = svc.search({"query": {"match_all": {}}, "sort": [{"tag": "desc"}]})
+    keys = [h["sort"][0] for h in r["hits"]["hits"]]
+    assert keys == sorted(keys, reverse=True)
+
+
+def test_scroll_with_tied_sort_keys():
+    engine = InternalEngine(MapperService(MAPPING), shard_label="tied")
+    for i in range(6):
+        engine.index(str(i), {"title": "x", "views": 5 if i < 4 else 100 + i})
+    engine.refresh()
+    s = SearchService(engine, "tied")
+    r = s.search({"query": {"match_all": {}}, "sort": [{"views": "asc"}],
+                  "size": 2}, scroll_keep_alive=60)
+    seen = list(ids(r))
+    sid = r["_scroll_id"]
+    while True:
+        page = ids(s.scroll(sid))
+        if not page:
+            break
+        seen.extend(page)
+    assert sorted(seen) == [str(i) for i in range(6)]   # no tied doc lost
+    assert len(seen) == len(set(seen))
+
+
+def test_term_on_multivalued_numeric():
+    engine = InternalEngine(MapperService(MAPPING), shard_label="mv")
+    engine.index("a", {"views": [100, 200]})
+    engine.index("b", {"views": 300})
+    engine.refresh()
+    s = SearchService(engine, "mv")
+    assert ids(s.search({"query": {"term": {"views": 200}}})) == ["a"]
+    assert ids(s.search({"query": {"term": {"views": 100}}})) == ["a"]
+
+
+def test_missing_sort_value_serializes_as_null():
+    import json
+    engine = InternalEngine(MapperService(MAPPING), shard_label="miss")
+    engine.index("a", {"views": 10})
+    engine.index("b", {"title": "no views here"})
+    engine.refresh()
+    s = SearchService(engine, "miss")
+    r = s.search({"query": {"match_all": {}}, "sort": [{"views": "asc"}]})
+    json.dumps(r, allow_nan=False)   # must be valid strict JSON
+    assert ids(r) == ["a", "b"]      # missing sorts last
+
+
+def test_boost_honored_on_multi_term_queries(svc):
+    r1 = svc.search({"query": {"prefix": {"title": {"value": "qui", "boost": 3.0}}}})
+    r2 = svc.search({"query": {"prefix": {"title": "qui"}}})
+    assert r1["hits"]["hits"][0]["_score"] == pytest.approx(
+        3.0 * r2["hits"]["hits"][0]["_score"])
+
+
+def test_update_visible_after_refresh(svc):
+    eng = svc.engine
+    eng.index("0", {**DOCS[0], "title": "renamed fox story"})
+    r = svc.search({"query": {"match": {"title": "renamed"}}})
+    assert r["hits"]["total"]["value"] == 0      # not yet refreshed
+    eng.refresh()
+    r = svc.search({"query": {"match": {"title": "renamed"}}})
+    assert ids(r) == ["0"]
+    r = svc.search({"query": {"match_all": {}}})
+    assert r["hits"]["total"]["value"] == 4      # still 4 docs, no dup
+    # restore for other tests (module-scoped fixture)
+    eng.index("0", DOCS[0])
+    eng.refresh()
