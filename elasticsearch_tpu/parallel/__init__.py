@@ -1,0 +1,19 @@
+from elasticsearch_tpu.parallel.mesh import make_mesh, replicated, shard_spec
+from elasticsearch_tpu.parallel.sharded_search import (
+    ShardedTextIndex,
+    ShardedVectorIndex,
+    make_sharded_bm25,
+    make_sharded_hybrid,
+    make_sharded_knn,
+)
+
+__all__ = [
+    "ShardedTextIndex",
+    "ShardedVectorIndex",
+    "make_mesh",
+    "make_sharded_bm25",
+    "make_sharded_hybrid",
+    "make_sharded_knn",
+    "replicated",
+    "shard_spec",
+]

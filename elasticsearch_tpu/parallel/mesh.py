@@ -1,0 +1,39 @@
+"""Device mesh construction.
+
+The data-plane analog of the reference's node topology: an index's shards map
+onto the ``shard`` mesh axis (each device slice holds a doc partition, like
+an ES shard on a data node), while the ``dp`` axis replicates the corpus for
+query-batch throughput (like ES replicas serving reads,
+README.asciidoc:13). Collectives ride ICI inside the mesh — the data-plane
+half of the two-plane split (SURVEY.md §5.8 TPU-native equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_shards: Optional[int] = None, n_dp: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create a (dp, shard) mesh. Defaults to all devices on the shard axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_shards is None:
+        n_shards = len(devices) // n_dp
+    if n_dp * n_shards != len(devices):
+        raise ValueError(
+            f"dp({n_dp}) x shard({n_shards}) != device count {len(devices)}")
+    arr = np.asarray(devices).reshape(n_dp, n_shards)
+    return Mesh(arr, ("dp", "shard"))
+
+
+def shard_spec(mesh: Mesh, *axes: Optional[str]) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,363 @@
+"""SPMD distributed search: one pjit program replaces scatter-gather RPC.
+
+The reference fans a query out over shards with per-shard RPCs and merges
+top-k on a coordinator (action/search/AbstractSearchAsyncAction.java:156,214;
+SearchPhaseController.sortDocs:160 + TopDocs.merge). Here the whole
+scatter-gather is ONE compiled program over a (dp, shard) mesh:
+
+  local score -> local top-k -> all_gather(top-k over 'shard') -> global top-k
+
+The all_gather moves only k (score, id) pairs per shard — the wire-efficient
+merge the reference gets from query_then_fetch — but over ICI inside the
+compiled program instead of TCP between processes. DFS-style global term
+stats (search/dfs/DfsPhase.java:43) become a host-side df sum (or a psum)
+before weight computation.
+
+Layouts (S = number of shards on the mesh axis):
+  postings: block_docs/tfs [S, NB, BLOCK] sharded on axis 0; local doc ids
+  vectors:  matrix [S, N, D] sharded on axis 0
+  queries:  [B, ...] sharded on 'dp'
+Global doc id = shard_idx * N_per_shard + local id.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from elasticsearch_tpu.index.segment import BLOCK, next_pow2
+from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1, idf as idf_fn
+
+
+# ---------------------------------------------------------------------------
+# sharded kNN
+# ---------------------------------------------------------------------------
+
+def make_sharded_knn(mesh: Mesh, n_per_shard: int, dims: int, k: int,
+                     similarity: str = "cosine"):
+    """Compile the distributed kNN program for the given shapes.
+
+    Returns fn(matrix [S,N,D], norms [S,N], valid [S,N], queries [B,D])
+    -> (scores [B,k], global_ids [B,k]).
+    """
+
+    def local_search(matrix, norms, valid, queries):
+        # per-device blocks: matrix [1, N, D], queries [B_local, D]
+        m = matrix[0]
+        dots = jax.lax.dot_general(
+            queries.astype(jnp.bfloat16), m.astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [B, N]
+        if similarity == "cosine":
+            qn = jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
+            scores = (1.0 + dots / (norms[0][None, :] * qn + 1e-30)) / 2.0
+        elif similarity == "dot_product":
+            scores = 0.5 + dots / 2.0
+        else:  # l2_norm
+            q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
+            d2 = jnp.maximum(norms[0][None, :] ** 2 + q2 - 2.0 * dots, 0.0)
+            scores = 1.0 / (1.0 + jnp.sqrt(d2))
+        scores = jnp.where(valid[0][None, :], scores, -jnp.inf)
+        local_s, local_i = jax.lax.top_k(scores, k)         # [B, k]
+        shard_idx = jax.lax.axis_index("shard")
+        global_i = local_i + shard_idx * n_per_shard
+        # gather each shard's top-k, then reduce to the global top-k
+        all_s = jax.lax.all_gather(local_s, "shard", axis=0)   # [S, B, k]
+        all_i = jax.lax.all_gather(global_i, "shard", axis=0)
+        S = all_s.shape[0]
+        B = all_s.shape[1]
+        flat_s = jnp.transpose(all_s, (1, 0, 2)).reshape(B, S * k)
+        flat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(B, S * k)
+        g_s, pos = jax.lax.top_k(flat_s, k)
+        g_i = jnp.take_along_axis(flat_i, pos, axis=1)
+        return g_s, g_i
+
+    fn = shard_map(
+        local_search, mesh=mesh,
+        in_specs=(P("shard", None, None), P("shard", None), P("shard", None),
+                  P("dp", None)),
+        out_specs=(P("dp", None), P("dp", None)),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+class ShardedVectorIndex:
+    """Corpus of vectors partitioned over the mesh 'shard' axis."""
+
+    def __init__(self, mesh: Mesh, vectors: np.ndarray,
+                 similarity: str = "cosine",
+                 n_per_shard: Optional[int] = None):
+        self.mesh = mesh
+        n_shards = mesh.shape["shard"]
+        n, d = vectors.shape
+        self.n_docs = n
+        per = n_per_shard or next_pow2(max(-(-n // n_shards), 1), minimum=8)
+        self.n_per_shard = per
+        mat = np.zeros((n_shards, per, d), np.float32)
+        valid = np.zeros((n_shards, per), bool)
+        for s in range(n_shards):
+            lo, hi = s * per, min((s + 1) * per, n)
+            if hi > lo:
+                mat[s, : hi - lo] = vectors[lo:hi]
+                valid[s, : hi - lo] = True
+        norms = np.linalg.norm(mat, axis=2).astype(np.float32)
+        self.matrix = jax.device_put(mat, NamedSharding(mesh, P("shard", None, None)))
+        self.norms = jax.device_put(norms, NamedSharding(mesh, P("shard", None)))
+        self.valid = jax.device_put(valid, NamedSharding(mesh, P("shard", None)))
+        self.similarity = similarity
+        self._compiled: Dict[int, callable] = {}
+
+    def search(self, queries: np.ndarray, k: int):
+        """queries [B, D] -> (scores [B, k], global doc ids [B, k])."""
+        fn = self._compiled.get(k)
+        if fn is None:
+            fn = make_sharded_knn(self.mesh, self.n_per_shard,
+                                  queries.shape[1], k, self.similarity)
+            self._compiled[k] = fn
+        q = jax.device_put(jnp.asarray(queries, jnp.float32),
+                           NamedSharding(self.mesh, P("dp", None)))
+        return fn(self.matrix, self.norms, self.valid, q)
+
+
+# ---------------------------------------------------------------------------
+# sharded BM25
+# ---------------------------------------------------------------------------
+
+def make_sharded_bm25(mesh: Mesh, n_per_shard: int, k: int,
+                      k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+    """Compile the distributed BM25 program.
+
+    fn(block_docs [S,NB,BLOCK], block_tfs [S,NB,BLOCK], doc_lens [S,N],
+       avgdl scalar, block_idx [S,QB], block_w [S,QB])
+    -> (scores [k], global ids [k])  (single query; batch via host loop or vmap)
+    """
+
+    def local_search(block_docs, block_tfs, doc_lens, avgdl, block_idx, block_w):
+        docs = block_docs[0][block_idx[0]]        # [QB, BLOCK]
+        tfs = block_tfs[0][block_idx[0]]
+        valid = docs >= 0
+        safe = jnp.where(valid, docs, 0)
+        dl = doc_lens[0][safe]
+        norm = k1 * (1.0 - b + b * dl / avgdl)
+        contrib = block_w[0][:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+        contrib = jnp.where(valid, contrib, 0.0)
+        scores = jnp.zeros((n_per_shard,), jnp.float32)
+        scores = scores.at[safe.reshape(-1)].add(contrib.reshape(-1), mode="drop")
+        scores = jnp.where(scores > 0, scores, -jnp.inf)
+        local_s, local_i = jax.lax.top_k(scores, k)
+        shard_idx = jax.lax.axis_index("shard")
+        global_i = local_i + shard_idx * n_per_shard
+        all_s = jax.lax.all_gather(local_s, "shard", axis=0).reshape(-1)
+        all_i = jax.lax.all_gather(global_i, "shard", axis=0).reshape(-1)
+        g_s, pos = jax.lax.top_k(all_s, k)
+        return g_s, all_i[pos]
+
+    fn = shard_map(
+        local_search, mesh=mesh,
+        in_specs=(P("shard", None, None), P("shard", None, None),
+                  P("shard", None), P(), P("shard", None), P("shard", None)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+class ShardedTextIndex:
+    """Text corpus partitioned by doc over the mesh 'shard' axis, with one
+    GLOBAL term vocabulary so per-shard block tables share term ids.
+
+    The reference routes docs to shards by murmur3 and each shard builds its
+    own Lucene index; idf consistency comes from the optional DFS phase. Here
+    the vocabulary is corpus-wide (built at load), per-shard dfs are summed
+    host-side for exact global idf, and the per-query host prep emits one
+    gather list per shard.
+    """
+
+    def __init__(self, mesh: Mesh, docs_terms: Sequence[Sequence[str]],
+                 qb_bucket_min: int = 8):
+        self.mesh = mesh
+        n_shards = mesh.shape["shard"]
+        self.n_shards = n_shards
+        n = len(docs_terms)
+        self.n_docs = n
+        per = next_pow2(max(-(-n // n_shards), 1), minimum=BLOCK)
+        self.n_per_shard = per
+        self.vocab: Dict[str, int] = {}
+        self.df: Dict[str, int] = {}
+
+        # per-shard postings: term -> [(local_doc, tf)]
+        shard_postings: List[Dict[str, Dict[int, int]]] = [dict() for _ in range(n_shards)]
+        doc_lens = np.zeros((n_shards, per), np.float32)
+        for g, terms in enumerate(docs_terms):
+            s, local = divmod(g, per)
+            doc_lens[s, local] = len(terms)
+            seen = set()
+            for t in terms:
+                self.vocab.setdefault(t, len(self.vocab))
+                shard_postings[s].setdefault(t, {})
+                shard_postings[s][t][local] = shard_postings[s][t].get(local, 0) + 1
+                if t not in seen:
+                    self.df[t] = self.df.get(t, 0) + 1
+                    seen.add(t)
+
+        # pack per-shard blocks; all shards padded to the same block count
+        packed = []
+        for s in range(n_shards):
+            blocks_d, blocks_t = [], []
+            index: Dict[str, Tuple[int, int]] = {}
+            for t, posting in shard_postings[s].items():
+                entries = sorted(posting.items())
+                nb = max(1, -(-len(entries) // BLOCK))
+                index[t] = (len(blocks_d), nb)
+                d = np.full(nb * BLOCK, -1, np.int32)
+                f = np.zeros(nb * BLOCK, np.float32)
+                d[: len(entries)] = [e[0] for e in entries]
+                f[: len(entries)] = [e[1] for e in entries]
+                blocks_d.extend(d.reshape(nb, BLOCK))
+                blocks_t.extend(f.reshape(nb, BLOCK))
+            if not blocks_d:
+                blocks_d = [np.full(BLOCK, -1, np.int32).reshape(1, BLOCK)[0]]
+                blocks_t = [np.zeros(BLOCK, np.float32)]
+            packed.append((np.stack(blocks_d), np.stack(blocks_t), index))
+
+        nb_max = next_pow2(max(p[0].shape[0] for p in packed))
+        bd = np.full((n_shards, nb_max, BLOCK), -1, np.int32)
+        bt = np.zeros((n_shards, nb_max, BLOCK), np.float32)
+        self.term_index: List[Dict[str, Tuple[int, int]]] = []
+        for s, (d, t, index) in enumerate(packed):
+            bd[s, : d.shape[0]] = d
+            bt[s, : t.shape[0]] = t
+            self.term_index.append(index)
+
+        self.block_docs = jax.device_put(bd, NamedSharding(mesh, P("shard", None, None)))
+        self.block_tfs = jax.device_put(bt, NamedSharding(mesh, P("shard", None, None)))
+        self.doc_lens = jax.device_put(doc_lens, NamedSharding(mesh, P("shard", None)))
+        total_len = float(doc_lens.sum())
+        self.avgdl = total_len / max(1, n)
+        self.qb_bucket_min = qb_bucket_min
+        self._compiled: Dict[Tuple[int, int], callable] = {}
+
+    def prep_query(self, terms: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        """Host prep: per-shard gather indices + per-block weights from
+        GLOBAL df (exact idf, no DFS round needed)."""
+        per_shard_idx: List[List[int]] = [[] for _ in range(self.n_shards)]
+        per_shard_w: List[List[float]] = [[] for _ in range(self.n_shards)]
+        for t in set(terms):
+            df = self.df.get(t, 0)
+            if df <= 0:
+                continue
+            w = idf_fn(self.n_docs, df)
+            for s in range(self.n_shards):
+                entry = self.term_index[s].get(t)
+                if entry is None:
+                    continue
+                start, count = entry
+                for b_ in range(start, start + count):
+                    per_shard_idx[s].append(b_)
+                    per_shard_w[s].append(w)
+        qb = max(max((len(x) for x in per_shard_idx), default=1), 1)
+        qb_pad = next_pow2(qb, minimum=self.qb_bucket_min)
+        idx = np.zeros((self.n_shards, qb_pad), np.int32)
+        w = np.zeros((self.n_shards, qb_pad), np.float32)
+        for s in range(self.n_shards):
+            idx[s, : len(per_shard_idx[s])] = per_shard_idx[s]
+            w[s, : len(per_shard_w[s])] = per_shard_w[s]
+        return idx, w
+
+    def search(self, terms: Sequence[str], k: int):
+        idx, w = self.prep_query(terms)
+        key = (k, idx.shape[1])
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = make_sharded_bm25(self.mesh, self.n_per_shard, k)
+            self._compiled[key] = fn
+        sh = NamedSharding(self.mesh, P("shard", None))
+        return fn(self.block_docs, self.block_tfs, self.doc_lens,
+                  jnp.float32(self.avgdl),
+                  jax.device_put(idx, sh), jax.device_put(w, sh))
+
+
+# ---------------------------------------------------------------------------
+# fused hybrid (BM25 + kNN + RRF) — one program, no host round-trips
+# ---------------------------------------------------------------------------
+
+def make_sharded_hybrid(mesh: Mesh, n_per_shard: int, k: int,
+                        rank_constant: int = 60,
+                        similarity: str = "cosine",
+                        k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+    """Distributed hybrid retrieval: BM25 and kNN branches execute locally,
+    each produces a global top-k via all_gather, and RRF fuses on device —
+    the BASELINE config-4 path as a single compiled program."""
+
+    def local(block_docs, block_tfs, doc_lens, avgdl, block_idx, block_w,
+              matrix, norms, valid, qvec):
+        # --- BM25 branch
+        docs = block_docs[0][block_idx[0]]
+        tfs = block_tfs[0][block_idx[0]]
+        pvalid = docs >= 0
+        safe = jnp.where(pvalid, docs, 0)
+        dl = doc_lens[0][safe]
+        norm = k1 * (1.0 - b + b * dl / avgdl)
+        contrib = block_w[0][:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+        contrib = jnp.where(pvalid, contrib, 0.0)
+        bscores = jnp.zeros((n_per_shard,), jnp.float32)
+        bscores = bscores.at[safe.reshape(-1)].add(contrib.reshape(-1), mode="drop")
+        bscores = jnp.where(bscores > 0, bscores, -jnp.inf)
+
+        # --- kNN branch
+        m = matrix[0]
+        dots = jax.lax.dot_general(
+            qvec[None, :].astype(jnp.bfloat16), m.astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)[0]
+        if similarity == "cosine":
+            qn = jnp.linalg.norm(qvec) + 1e-30
+            vscores = (1.0 + dots / (norms[0] * qn + 1e-30)) / 2.0
+        else:
+            vscores = 0.5 + dots / 2.0
+        vscores = jnp.where(valid[0], vscores, -jnp.inf)
+
+        shard_idx = jax.lax.axis_index("shard")
+
+        def global_topk(scores):
+            ls, li = jax.lax.top_k(scores, k)
+            gi = li + shard_idx * n_per_shard
+            as_ = jax.lax.all_gather(ls, "shard", axis=0).reshape(-1)
+            ai = jax.lax.all_gather(gi, "shard", axis=0).reshape(-1)
+            gs, pos = jax.lax.top_k(as_, k)
+            return gs, ai[pos]
+
+        _, bm25_ids = global_topk(bscores)
+        _, knn_ids = global_topk(vscores)
+
+        # --- RRF fuse on the (replicated) global id lists
+        ranks = jnp.arange(1, k + 1, dtype=jnp.float32)
+        rrf = jnp.zeros((2 * k,), jnp.float32)
+        ids = jnp.concatenate([bm25_ids, knn_ids])
+        contrib_r = jnp.concatenate([1.0 / (rank_constant + ranks)] * 2)
+        # dedupe: score(id) = sum of contributions where ids match
+        eq = ids[:, None] == ids[None, :]
+        fused = eq.astype(jnp.float32) @ contrib_r
+        first = jnp.argmax(eq, axis=1) == jnp.arange(2 * k)  # keep first occurrence
+        fused = jnp.where(first, fused, -jnp.inf)
+        fs, fpos = jax.lax.top_k(fused, k)
+        return fs, ids[fpos]
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("shard", None, None), P("shard", None, None),
+                  P("shard", None), P(), P("shard", None), P("shard", None),
+                  P("shard", None, None), P("shard", None), P("shard", None),
+                  P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)

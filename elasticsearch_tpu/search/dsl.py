@@ -312,8 +312,9 @@ def _parse_rank_feature(spec):
         scaling = float((spec["log"] or {}).get("scaling_factor", 1.0))
     elif "sigmoid" in spec:
         function = "sigmoid"
-        pivot = float(spec["sigmoid"].get("pivot", 1.0))
-        exponent = float(spec["sigmoid"].get("exponent", 1.0))
+        sig = spec["sigmoid"] or {}
+        pivot = float(sig.get("pivot", 1.0))
+        exponent = float(sig.get("exponent", 1.0))
     elif "linear" in spec:
         function = "linear"
     return RankFeature(field=fname, function=function, pivot=pivot,

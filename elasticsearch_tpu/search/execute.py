@@ -41,7 +41,9 @@ class SegmentContext:
     # shard- or corpus-wide stats for idf (DFS analog); None = segment-local
     doc_count_override: Optional[int] = None
     df_overrides: Optional[Dict[str, Dict[str, int]]] = None  # field -> term -> df
-    _filter_cache: Dict[Any, np.ndarray] = field(default_factory=dict)
+    # point-in-time live mask (a Reader snapshot); when set it REPLACES the
+    # segment's current mask so mid-scroll deletes stay invisible
+    live_override: Optional[jnp.ndarray] = None
 
     @property
     def n_docs(self) -> int:
@@ -53,6 +55,8 @@ class SegmentContext:
 
     @property
     def live(self) -> jnp.ndarray:
+        if self.live_override is not None:
+            return self.live_override
         return device_live_mask(self.segment)
 
     def to_device_mask(self, host_mask: np.ndarray) -> jnp.ndarray:
@@ -77,7 +81,8 @@ class SegmentContext:
         return STANDARD
 
     def doc_count_for_idf(self) -> int:
-        return self.doc_count_override or max(self.segment.live_count, 1)
+        # includes deleted docs, like Lucene stats (df may count tombstones)
+        return self.doc_count_override or max(self.segment.n_docs, 1)
 
     def df_for(self, field_name: str) -> Optional[Dict[str, int]]:
         if self.df_overrides is None:
@@ -242,10 +247,9 @@ def _multi_term_mask(ctx: SegmentContext, field_name: str, terms: List[str]) -> 
 
 
 def _cached_filter(ctx: SegmentContext, key, build) -> np.ndarray:
-    """Per-segment filter cache (reference: IndicesQueryCache.java:53)."""
-    if key not in ctx._filter_cache:
-        ctx._filter_cache[key] = build()
-    return ctx._filter_cache[key]
+    """Filter cache living on the immutable segment itself, so cached masks
+    survive across queries (reference: IndicesQueryCache.java:53)."""
+    return ctx.segment.device(("filter",) + key, build)
 
 
 # ---------------------------------------------------------------------------
@@ -571,6 +575,13 @@ def rewrite_knn(q: dsl.Query, segment_ctxs: List["SegmentContext"]) -> dsl.Query
     if isinstance(q, dsl.ConstantScore) and q.filter is not None:
         return dsl.ConstantScore(filter=rewrite_knn(q.filter, segment_ctxs),
                                  boost=q.boost)
+    if isinstance(q, dsl.Boosting):
+        return dsl.Boosting(positive=rewrite_knn(q.positive, segment_ctxs),
+                            negative=rewrite_knn(q.negative, segment_ctxs),
+                            negative_boost=q.negative_boost, boost=q.boost)
+    if isinstance(q, dsl.ScriptScore) and q.query is not None:
+        return dsl.ScriptScore(query=rewrite_knn(q.query, segment_ctxs),
+                               source=q.source, params=q.params, boost=q.boost)
     if isinstance(q, dsl.FunctionScore) and q.query is not None:
         return dsl.FunctionScore(query=rewrite_knn(q.query, segment_ctxs),
                                  functions=q.functions, boost_mode=q.boost_mode,
@@ -675,16 +686,27 @@ def _h_function_score(q: dsl.FunctionScore, ctx: SegmentContext) -> Result:
         elif "field_value_factor" in f:
             spec = f["field_value_factor"]
             dv = ctx.segment.doc_values.get(spec["field"])
-            vals = np.full(ctx.n_docs_pad, spec.get("missing", 1.0), np.float32)
-            if dv is not None:
-                v = dv.values.astype(np.float64) * spec.get("factor", 1.0)
+
+            def apply_factor(raw):
+                v = raw * spec.get("factor", 1.0)
                 mod = spec.get("modifier", "none")
                 if mod == "log1p":
                     v = np.log1p(np.maximum(v, 0))
+                elif mod == "log2p":
+                    v = np.log2(np.maximum(v, 0) + 2)
                 elif mod == "sqrt":
                     v = np.sqrt(np.maximum(v, 0))
                 elif mod == "square":
                     v = v * v
+                elif mod == "reciprocal":
+                    v = 1.0 / np.maximum(v, 1e-9)
+                return v
+
+            # ES applies factor+modifier to `missing` as if read from the doc
+            missing_val = float(apply_factor(np.float64(spec.get("missing", 1.0))))
+            vals = np.full(ctx.n_docs_pad, missing_val, np.float32)
+            if dv is not None:
+                v = apply_factor(dv.values.astype(np.float64))
                 vals[: len(v)][dv.exists] = v[dv.exists]
             w = float(f.get("weight", 1.0))
             fn_vals.append(jnp.asarray(vals) * w)

@@ -22,6 +22,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from elasticsearch_tpu.index.engine import Reader
+from elasticsearch_tpu.index.segment import BLOCK, next_pow2
 from elasticsearch_tpu.mapping import MapperService
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.execute import SegmentContext, execute
@@ -113,8 +114,12 @@ def collect_query_terms(q: dsl.Query) -> Dict[str, List[str]]:
 
 def shard_term_stats(reader: Reader, mappers: MapperService,
                      q: dsl.Query) -> Tuple[int, Dict[str, Dict[str, int]]]:
-    """(live doc count, field -> term -> df) aggregated over segments."""
-    doc_count = reader.doc_count
+    """(doc count, field -> term -> df) aggregated over segments.
+
+    Both counts INCLUDE deleted docs, like Lucene's docFreq/docCount —
+    postings still contain tombstoned entries until a merge purges them, and
+    df <= doc_count must hold or idf goes negative."""
+    doc_count = sum(seg.n_docs for seg in reader.segments)
     field_texts = collect_query_terms(q)
     dfs: Dict[str, Dict[str, int]] = {}
     for fname, texts in field_texts.items():
@@ -176,24 +181,29 @@ def query_shard(reader: Reader,
         int(track_total_hits) if track_total_hits else 0)
 
     candidates: List[ShardDoc] = []
-    score_sort = sort[0].field == "_score"
+    # device top-k fast path only for a pure score sort; secondary tiebreak
+    # keys require the host path so they actually participate in ordering
+    score_sort = sort[0].field == "_score" and len(sort) == 1
     score_asc = score_sort and sort[0].order == "asc"
 
-    ctxs = [SegmentContext(seg, mappers, segment_idx=si,
-                           doc_count_override=doc_count, df_overrides=dfs)
-            for si, seg in enumerate(reader.segments)]
+    # the reader's snapshot mask governs visibility (point-in-time reads),
+    # not the segment's current mask — deletes after snapshot stay invisible
+    ctxs = []
+    for si, (seg, live_host) in enumerate(zip(reader.segments, reader.live_masks)):
+        n_pad = next_pow2(max(seg.n_docs, 1), minimum=BLOCK)
+        snap = np.zeros(n_pad, bool)
+        snap[: len(live_host)] = live_host
+        ctxs.append(SegmentContext(seg, mappers, segment_idx=si,
+                                   doc_count_override=doc_count,
+                                   df_overrides=dfs,
+                                   live_override=jnp.asarray(snap)))
     # Lucene-style kNN rewrite: per-segment top-k merged to shard-global k
     from elasticsearch_tpu.search.execute import rewrite_knn
     query = rewrite_knn(query, ctxs)
 
     for si, (ctx, live_host) in enumerate(zip(ctxs, reader.live_masks)):
         seg = ctx.segment
-        # the reader's snapshot mask governs visibility, not the segment's
-        # current mask
-        snap = np.zeros(ctx.n_docs_pad, bool)
-        snap[: len(live_host)] = live_host
         scores, mask = execute(query, ctx)
-        mask = mask & jnp.asarray(snap)
         if min_score is not None:
             mask = mask & (scores >= min_score)
         scores = jnp.where(mask, scores, -jnp.inf)
@@ -367,8 +377,10 @@ def _after(c: ShardDoc, after: Sequence[Any], sort: List[SortSpec],
         return False
     n = len(sort)
     for v, a, rev in zip(c.sort_values, after[:n], reverse):
-        av = a if (isinstance(a, str) or a is None or v is None
-                   or isinstance(v, str)) else float(a)
+        if isinstance(v, str) and not isinstance(a, (str, type(None))):
+            raise IllegalArgumentError(
+                f"search_after value [{a}] does not match keyword sort field type")
+        av = a if (isinstance(a, str) or a is None or v is None) else float(a)
         cmp = _cmp_values(v, av, rev)
         if cmp:
             return cmp > 0

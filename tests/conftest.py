@@ -12,12 +12,18 @@ happens at conftest import time, before any test module imports jax.
 import os
 import random
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"   # the env presets a TPU platform
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# The preinstalled TPU PJRT plugin registers itself regardless of
+# JAX_PLATFORMS; the config knob (applied before first backend init) does win.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

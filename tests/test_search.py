@@ -377,6 +377,48 @@ def test_boost_honored_on_multi_term_queries(svc):
         3.0 * r2["hits"]["hits"][0]["_score"])
 
 
+def test_scroll_snapshot_survives_delete():
+    engine = InternalEngine(MapperService(MAPPING), shard_label="pit")
+    for i in range(4):
+        engine.index(str(i), {"title": "snapshot doc", "views": i})
+    engine.refresh()
+    s = SearchService(engine, "pit")
+    r1 = s.search({"query": {"match": {"title": "snapshot"}}, "size": 2},
+                  scroll_keep_alive=60)
+    sid = r1["_scroll_id"]
+    # delete a doc AFTER the scroll snapshot; trigger current-view query too
+    engine.delete("3")
+    engine.refresh()
+    assert s.search({"query": {"match": {"title": "snapshot"}}})[
+        "hits"]["total"]["value"] == 3
+    seen = set(ids(r1))
+    while True:
+        page = ids(s.scroll(sid))
+        if not page:
+            break
+        seen.update(page)
+    assert seen == {"0", "1", "2", "3"}   # point-in-time view intact
+
+
+def test_secondary_sort_after_score():
+    engine = InternalEngine(MapperService(MAPPING), shard_label="sec")
+    engine.index("a", {"tag": "x", "price": 9.0})
+    engine.index("b", {"tag": "x", "price": 1.0})
+    engine.index("c", {"tag": "x", "price": 5.0})
+    engine.refresh()
+    s = SearchService(engine, "sec")
+    # constant_score: all tie on score; price decides
+    r = s.search({"query": {"constant_score": {"filter": {"term": {"tag": "x"}}}},
+                  "sort": ["_score", {"price": "asc"}]})
+    assert ids(r) == ["b", "c", "a"]
+
+
+def test_rank_feature_null_function_spec(svc):
+    r = svc.search({"query": {"rank_feature": {"field": "expansion.animal",
+                                               "sigmoid": None}}})
+    assert len(ids(r)) > 0  # defaults, no crash
+
+
 def test_update_visible_after_refresh(svc):
     eng = svc.engine
     eng.index("0", {**DOCS[0], "title": "renamed fox story"})
