@@ -26,6 +26,7 @@ while segments grow/merge (SURVEY.md §7 hard part #3).
 from __future__ import annotations
 
 import math
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -191,6 +192,7 @@ class Segment:
         # live docs mask — the ONLY mutable piece (Lucene liveDocs analog)
         self.live: np.ndarray = np.ones(n_docs, bool)
         self._device_cache: Dict[Any, Any] = {}
+        self._filter_cache: "OrderedDict[Any, Any]" = OrderedDict()
 
     @property
     def live_count(self) -> int:
@@ -212,6 +214,21 @@ class Segment:
         if key not in self._device_cache:
             self._device_cache[key] = build()
         return self._device_cache[key]
+
+    # Filter masks are keyed by query VALUE (e.g. ("term", field, value)), so
+    # high-cardinality workloads would grow without bound; the reference's
+    # query cache is LRU-bounded (IndicesQueryCache.java:53). Cap + evict.
+    FILTER_CACHE_CAP = 256
+
+    def cached_filter(self, key: Any, build) -> Any:
+        if key in self._filter_cache:
+            self._filter_cache.move_to_end(key)
+            return self._filter_cache[key]
+        value = build()
+        self._filter_cache[key] = value
+        while len(self._filter_cache) > self.FILTER_CACHE_CAP:
+            self._filter_cache.popitem(last=False)
+        return value
 
 
 class SegmentBuilder:

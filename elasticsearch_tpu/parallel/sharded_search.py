@@ -37,6 +37,83 @@ from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1, idf as idf_fn
 
 
 # ---------------------------------------------------------------------------
+# shared local scoring bodies (used by the standalone AND hybrid programs —
+# one definition so kernel fixes can't drift between them)
+# ---------------------------------------------------------------------------
+
+def _local_bm25_scores(block_docs, block_tfs, doc_lens, avgdl,
+                       block_idx, block_w, n_per_shard: int,
+                       k1: float, b: float):
+    """Per-shard BM25: gather query blocks, score, scatter-add into doc
+    space. Returns dense scores [n_per_shard] with -inf for non-matches."""
+    docs = block_docs[block_idx]              # [QB, BLOCK]
+    tfs = block_tfs[block_idx]
+    valid = docs >= 0
+    safe = jnp.where(valid, docs, 0)
+    dl = doc_lens[safe]
+    norm = k1 * (1.0 - b + b * dl / avgdl)
+    contrib = block_w[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+    contrib = jnp.where(valid, contrib, 0.0)
+    scores = jnp.zeros((n_per_shard,), jnp.float32)
+    scores = scores.at[safe.reshape(-1)].add(contrib.reshape(-1), mode="drop")
+    return jnp.where(scores > 0, scores, -jnp.inf)
+
+
+def _local_knn_scores(m, norms, valid, queries, similarity: str):
+    """Per-shard kNN: MXU matmul + similarity transform.
+    queries [B, D] -> scores [B, N] with -inf for missing vectors.
+
+    cosine/dot run in bf16 (the dot IS the score — bf16 relative error is
+    fine). l2 runs the dot in f32: the ||m||^2 + ||q||^2 - 2<q,m>
+    cancellation turns bf16 rounding into large absolute error exactly at
+    small distances, where ranking is decided.
+    """
+    dot_dtype = jnp.float32 if similarity == "l2_norm" else jnp.bfloat16
+    dots = jax.lax.dot_general(
+        queries.astype(dot_dtype), m.astype(dot_dtype),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [B, N]
+    if similarity == "cosine":
+        qn = jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
+        scores = (1.0 + dots / (norms[None, :] * qn + 1e-30)) / 2.0
+    elif similarity == "dot_product":
+        scores = 0.5 + dots / 2.0
+    elif similarity == "l2_norm":
+        q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
+        d2 = jnp.maximum(norms[None, :] ** 2 + q2 - 2.0 * dots, 0.0)
+        scores = 1.0 / (1.0 + jnp.sqrt(d2))
+    else:
+        raise ValueError(f"unknown similarity {similarity!r}")
+    return jnp.where(valid[None, :], scores, -jnp.inf)
+
+
+def _topk_padded(scores, k: int):
+    """top_k that clamps to the axis size and pads back out to k with
+    (-inf, -1) — ES clamps size to available hits instead of erroring."""
+    n = scores.shape[-1]
+    kk = min(k, n)
+    s, i = jax.lax.top_k(scores, kk)
+    if kk < k:
+        pad = [(0, 0)] * (scores.ndim - 1) + [(0, k - kk)]
+        s = jnp.pad(s, pad, constant_values=-jnp.inf)
+        i = jnp.pad(i, pad, constant_values=-1)
+    return s, i
+
+
+def _global_topk_1d(scores, k: int, n_per_shard: int):
+    """Per-shard [N] scores -> global (scores [k], ids [k]) via all_gather
+    over 'shard'. Ids of -inf slots are masked to -1 so downstream fusion
+    can't credit phantom/padding docs."""
+    ls, li = _topk_padded(scores, k)
+    shard_idx = jax.lax.axis_index("shard")
+    gi = jnp.where(jnp.isfinite(ls), li + shard_idx * n_per_shard, -1)
+    all_s = jax.lax.all_gather(ls, "shard", axis=0).reshape(-1)
+    all_i = jax.lax.all_gather(gi, "shard", axis=0).reshape(-1)
+    gs, pos = jax.lax.top_k(all_s, k)
+    return gs, all_i[pos]
+
+
+# ---------------------------------------------------------------------------
 # sharded kNN
 # ---------------------------------------------------------------------------
 
@@ -50,24 +127,12 @@ def make_sharded_knn(mesh: Mesh, n_per_shard: int, dims: int, k: int,
 
     def local_search(matrix, norms, valid, queries):
         # per-device blocks: matrix [1, N, D], queries [B_local, D]
-        m = matrix[0]
-        dots = jax.lax.dot_general(
-            queries.astype(jnp.bfloat16), m.astype(jnp.bfloat16),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [B, N]
-        if similarity == "cosine":
-            qn = jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
-            scores = (1.0 + dots / (norms[0][None, :] * qn + 1e-30)) / 2.0
-        elif similarity == "dot_product":
-            scores = 0.5 + dots / 2.0
-        else:  # l2_norm
-            q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
-            d2 = jnp.maximum(norms[0][None, :] ** 2 + q2 - 2.0 * dots, 0.0)
-            scores = 1.0 / (1.0 + jnp.sqrt(d2))
-        scores = jnp.where(valid[0][None, :], scores, -jnp.inf)
-        local_s, local_i = jax.lax.top_k(scores, k)         # [B, k]
+        scores = _local_knn_scores(matrix[0], norms[0], valid[0], queries,
+                                   similarity)
+        local_s, local_i = _topk_padded(scores, k)          # [B, k]
         shard_idx = jax.lax.axis_index("shard")
-        global_i = local_i + shard_idx * n_per_shard
+        global_i = jnp.where(jnp.isfinite(local_s),
+                             local_i + shard_idx * n_per_shard, -1)
         # gather each shard's top-k, then reduce to the global top-k
         all_s = jax.lax.all_gather(local_s, "shard", axis=0)   # [S, B, k]
         all_i = jax.lax.all_gather(global_i, "shard", axis=0)
@@ -116,15 +181,25 @@ class ShardedVectorIndex:
         self._compiled: Dict[int, callable] = {}
 
     def search(self, queries: np.ndarray, k: int):
-        """queries [B, D] -> (scores [B, k], global doc ids [B, k])."""
+        """queries [B, D] -> (scores [B, k], global doc ids [B, k]).
+
+        B need not divide the dp axis: the batch is padded to a multiple of
+        n_dp for the sharded device_put and the pad rows dropped on return.
+        """
         fn = self._compiled.get(k)
         if fn is None:
             fn = make_sharded_knn(self.mesh, self.n_per_shard,
                                   queries.shape[1], k, self.similarity)
             self._compiled[k] = fn
-        q = jax.device_put(jnp.asarray(queries, jnp.float32),
+        b = queries.shape[0]
+        n_dp = self.mesh.shape["dp"]
+        b_pad = -(-b // n_dp) * n_dp
+        q = np.zeros((b_pad, queries.shape[1]), np.float32)
+        q[:b] = queries
+        q = jax.device_put(jnp.asarray(q),
                            NamedSharding(self.mesh, P("dp", None)))
-        return fn(self.matrix, self.norms, self.valid, q)
+        s, i = fn(self.matrix, self.norms, self.valid, q)
+        return s[:b], i[:b]
 
 
 # ---------------------------------------------------------------------------
@@ -141,24 +216,10 @@ def make_sharded_bm25(mesh: Mesh, n_per_shard: int, k: int,
     """
 
     def local_search(block_docs, block_tfs, doc_lens, avgdl, block_idx, block_w):
-        docs = block_docs[0][block_idx[0]]        # [QB, BLOCK]
-        tfs = block_tfs[0][block_idx[0]]
-        valid = docs >= 0
-        safe = jnp.where(valid, docs, 0)
-        dl = doc_lens[0][safe]
-        norm = k1 * (1.0 - b + b * dl / avgdl)
-        contrib = block_w[0][:, None] * tfs * (k1 + 1.0) / (tfs + norm)
-        contrib = jnp.where(valid, contrib, 0.0)
-        scores = jnp.zeros((n_per_shard,), jnp.float32)
-        scores = scores.at[safe.reshape(-1)].add(contrib.reshape(-1), mode="drop")
-        scores = jnp.where(scores > 0, scores, -jnp.inf)
-        local_s, local_i = jax.lax.top_k(scores, k)
-        shard_idx = jax.lax.axis_index("shard")
-        global_i = local_i + shard_idx * n_per_shard
-        all_s = jax.lax.all_gather(local_s, "shard", axis=0).reshape(-1)
-        all_i = jax.lax.all_gather(global_i, "shard", axis=0).reshape(-1)
-        g_s, pos = jax.lax.top_k(all_s, k)
-        return g_s, all_i[pos]
+        scores = _local_bm25_scores(block_docs[0], block_tfs[0], doc_lens[0],
+                                    avgdl, block_idx[0], block_w[0],
+                                    n_per_shard, k1, b)
+        return _global_topk_1d(scores, k, n_per_shard)
 
     fn = shard_map(
         local_search, mesh=mesh,
@@ -171,14 +232,14 @@ def make_sharded_bm25(mesh: Mesh, n_per_shard: int, k: int,
 
 
 class ShardedTextIndex:
-    """Text corpus partitioned by doc over the mesh 'shard' axis, with one
-    GLOBAL term vocabulary so per-shard block tables share term ids.
+    """Text corpus partitioned by doc over the mesh 'shard' axis, with
+    corpus-GLOBAL document frequencies so every shard scores with the same
+    idf.
 
     The reference routes docs to shards by murmur3 and each shard builds its
     own Lucene index; idf consistency comes from the optional DFS phase. Here
-    the vocabulary is corpus-wide (built at load), per-shard dfs are summed
-    host-side for exact global idf, and the per-query host prep emits one
-    gather list per shard.
+    per-shard dfs are summed host-side at build time for exact global idf,
+    and the per-query host prep emits one gather list per shard.
     """
 
     def __init__(self, mesh: Mesh, docs_terms: Sequence[Sequence[str]],
@@ -190,7 +251,6 @@ class ShardedTextIndex:
         self.n_docs = n
         per = next_pow2(max(-(-n // n_shards), 1), minimum=BLOCK)
         self.n_per_shard = per
-        self.vocab: Dict[str, int] = {}
         self.df: Dict[str, int] = {}
 
         # per-shard postings: term -> [(local_doc, tf)]
@@ -201,7 +261,6 @@ class ShardedTextIndex:
             doc_lens[s, local] = len(terms)
             seen = set()
             for t in terms:
-                self.vocab.setdefault(t, len(self.vocab))
                 shard_postings[s].setdefault(t, {})
                 shard_postings[s][t][local] = shard_postings[s][t].get(local, 0) + 1
                 if t not in seen:
@@ -299,57 +358,29 @@ def make_sharded_hybrid(mesh: Mesh, n_per_shard: int, k: int,
 
     def local(block_docs, block_tfs, doc_lens, avgdl, block_idx, block_w,
               matrix, norms, valid, qvec):
-        # --- BM25 branch
-        docs = block_docs[0][block_idx[0]]
-        tfs = block_tfs[0][block_idx[0]]
-        pvalid = docs >= 0
-        safe = jnp.where(pvalid, docs, 0)
-        dl = doc_lens[0][safe]
-        norm = k1 * (1.0 - b + b * dl / avgdl)
-        contrib = block_w[0][:, None] * tfs * (k1 + 1.0) / (tfs + norm)
-        contrib = jnp.where(pvalid, contrib, 0.0)
-        bscores = jnp.zeros((n_per_shard,), jnp.float32)
-        bscores = bscores.at[safe.reshape(-1)].add(contrib.reshape(-1), mode="drop")
-        bscores = jnp.where(bscores > 0, bscores, -jnp.inf)
+        bscores = _local_bm25_scores(block_docs[0], block_tfs[0], doc_lens[0],
+                                     avgdl, block_idx[0], block_w[0],
+                                     n_per_shard, k1, b)
+        vscores = _local_knn_scores(matrix[0], norms[0], valid[0],
+                                    qvec[None, :], similarity)[0]
 
-        # --- kNN branch
-        m = matrix[0]
-        dots = jax.lax.dot_general(
-            qvec[None, :].astype(jnp.bfloat16), m.astype(jnp.bfloat16),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)[0]
-        if similarity == "cosine":
-            qn = jnp.linalg.norm(qvec) + 1e-30
-            vscores = (1.0 + dots / (norms[0] * qn + 1e-30)) / 2.0
-        else:
-            vscores = 0.5 + dots / 2.0
-        vscores = jnp.where(valid[0], vscores, -jnp.inf)
+        _, bm25_ids = _global_topk_1d(bscores, k, n_per_shard)
+        _, knn_ids = _global_topk_1d(vscores, k, n_per_shard)
 
-        shard_idx = jax.lax.axis_index("shard")
-
-        def global_topk(scores):
-            ls, li = jax.lax.top_k(scores, k)
-            gi = li + shard_idx * n_per_shard
-            as_ = jax.lax.all_gather(ls, "shard", axis=0).reshape(-1)
-            ai = jax.lax.all_gather(gi, "shard", axis=0).reshape(-1)
-            gs, pos = jax.lax.top_k(as_, k)
-            return gs, ai[pos]
-
-        _, bm25_ids = global_topk(bscores)
-        _, knn_ids = global_topk(vscores)
-
-        # --- RRF fuse on the (replicated) global id lists
+        # --- RRF fuse on the (replicated) global id lists; -1 ids mark
+        # below-threshold slots and must not earn rank credit
         ranks = jnp.arange(1, k + 1, dtype=jnp.float32)
-        rrf = jnp.zeros((2 * k,), jnp.float32)
         ids = jnp.concatenate([bm25_ids, knn_ids])
         contrib_r = jnp.concatenate([1.0 / (rank_constant + ranks)] * 2)
+        present = ids >= 0
+        contrib_r = jnp.where(present, contrib_r, 0.0)
         # dedupe: score(id) = sum of contributions where ids match
         eq = ids[:, None] == ids[None, :]
         fused = eq.astype(jnp.float32) @ contrib_r
         first = jnp.argmax(eq, axis=1) == jnp.arange(2 * k)  # keep first occurrence
-        fused = jnp.where(first, fused, -jnp.inf)
+        fused = jnp.where(first & present, fused, -jnp.inf)
         fs, fpos = jax.lax.top_k(fused, k)
-        return fs, ids[fpos]
+        return fs, jnp.where(jnp.isfinite(fs), ids[fpos], -1)
 
     fn = shard_map(
         local, mesh=mesh,

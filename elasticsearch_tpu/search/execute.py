@@ -248,8 +248,9 @@ def _multi_term_mask(ctx: SegmentContext, field_name: str, terms: List[str]) -> 
 
 def _cached_filter(ctx: SegmentContext, key, build) -> np.ndarray:
     """Filter cache living on the immutable segment itself, so cached masks
-    survive across queries (reference: IndicesQueryCache.java:53)."""
-    return ctx.segment.device(("filter",) + key, build)
+    survive across queries; LRU-bounded like the reference's
+    IndicesQueryCache.java:53."""
+    return ctx.segment.cached_filter(key, build)
 
 
 # ---------------------------------------------------------------------------
@@ -690,10 +691,21 @@ def _h_function_score(q: dsl.FunctionScore, ctx: SegmentContext) -> Result:
             def apply_factor(raw):
                 v = raw * spec.get("factor", 1.0)
                 mod = spec.get("modifier", "none")
-                if mod == "log1p":
-                    v = np.log1p(np.maximum(v, 0))
+                # ES modifiers are base-10 logs (FieldValueFactorFunction.java:
+                # LOG=log10(v), LOG1P=log10(v+1), LOG2P=log10(v+2)); the LN
+                # family is natural log.
+                if mod == "log":
+                    v = np.log10(np.maximum(v, 1e-9))
+                elif mod == "log1p":
+                    v = np.log10(np.maximum(v, 0) + 1)
                 elif mod == "log2p":
-                    v = np.log2(np.maximum(v, 0) + 2)
+                    v = np.log10(np.maximum(v, 0) + 2)
+                elif mod == "ln":
+                    v = np.log(np.maximum(v, 1e-9))
+                elif mod == "ln1p":
+                    v = np.log1p(np.maximum(v, 0))
+                elif mod == "ln2p":
+                    v = np.log(np.maximum(v, 0) + 2)
                 elif mod == "sqrt":
                     v = np.sqrt(np.maximum(v, 0))
                 elif mod == "square":

@@ -201,7 +201,7 @@ def query_shard(reader: Reader,
     from elasticsearch_tpu.search.execute import rewrite_knn
     query = rewrite_knn(query, ctxs)
 
-    for si, (ctx, live_host) in enumerate(zip(ctxs, reader.live_masks)):
+    for si, ctx in enumerate(ctxs):
         seg = ctx.segment
         scores, mask = execute(query, ctx)
         if min_score is not None:
@@ -380,7 +380,11 @@ def _after(c: ShardDoc, after: Sequence[Any], sort: List[SortSpec],
         if isinstance(v, str) and not isinstance(a, (str, type(None))):
             raise IllegalArgumentError(
                 f"search_after value [{a}] does not match keyword sort field type")
-        av = a if (isinstance(a, str) or a is None or v is None) else float(a)
+        try:
+            av = a if (isinstance(a, str) or a is None or v is None) else float(a)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                f"search_after value [{a}] does not match numeric sort field type")
         cmp = _cmp_values(v, av, rev)
         if cmp:
             return cmp > 0

@@ -133,3 +133,68 @@ def test_sharded_hybrid_rrf(rng):
     # all returned ids valid and unique
     valid = ids[scores > -np.inf]
     assert len(set(valid.tolist())) == len(valid)
+
+
+def test_sharded_knn_batch_not_divisible_by_dp(rng):
+    # B=1 on a dp=2 mesh: batch is padded internally, pad rows dropped
+    mesh = make_mesh(n_shards=4, n_dp=2)
+    vectors = rng.normal(size=(100, 8)).astype(np.float32)
+    idx = ShardedVectorIndex(mesh, vectors, "cosine")
+    scores, ids = idx.search(vectors[42:43], k=5)
+    assert scores.shape == (1, 5) and ids.shape == (1, 5)
+    assert 42 in np.asarray(ids)[0].tolist()
+
+
+def test_sharded_knn_l2_norm(rng):
+    mesh = make_mesh(n_shards=8, n_dp=1)
+    vectors = rng.normal(size=(64, 8)).astype(np.float32)
+    idx = ShardedVectorIndex(mesh, vectors, "l2_norm")
+    scores, ids = idx.search(vectors[9:10], k=3)
+    assert np.asarray(ids)[0][0] == 9           # zero distance to itself
+    assert np.isclose(np.asarray(scores)[0][0], 1.0, atol=1e-5)
+
+
+def test_sharded_hybrid_l2_and_phantom_masking(rng):
+    """Few matches (< k) must not leak phantom ids into the RRF fusion,
+    and l2_norm must use the real l2 formula in the hybrid kernel too."""
+    mesh = make_mesh(n_shards=4, n_dp=1, devices=jax.devices()[:4])
+    # only 2 docs contain the query term -> 8 of 10 bm25 slots are -inf
+    docs_terms = [["rare"] if i in (5, 40) else ["common"] for i in range(64)]
+    text = ShardedTextIndex(mesh, docs_terms)
+    vectors = rng.normal(size=(64, 8)).astype(np.float32)
+    vec = ShardedVectorIndex(mesh, vectors, "l2_norm",
+                             n_per_shard=text.n_per_shard)
+    k = 10
+    fn = make_sharded_hybrid(mesh, text.n_per_shard, k, similarity="l2_norm")
+    bidx, bw = text.prep_query(["rare"])
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax.numpy as jnp
+    sh = NamedSharding(mesh, P("shard", None))
+    scores, ids = fn(text.block_docs, text.block_tfs, text.doc_lens,
+                     jnp.float32(text.avgdl),
+                     jax.device_put(bidx, sh), jax.device_put(bw, sh),
+                     vec.matrix, vec.norms, vec.valid,
+                     jnp.asarray(vectors[5]))
+    ids = np.asarray(ids)
+    scores = np.asarray(scores)
+    # every finite-scored id is a real doc (no padding ids >= 64, none < 0)
+    finite = ids[np.isfinite(scores)]
+    assert finite.min() >= 0 and finite.max() < 64
+    # doc 5 matched both retrievers (rare term + its own vector) -> winner
+    assert ids[0] == 5
+
+
+def test_sharded_knn_k_exceeds_per_shard(rng):
+    # 100 docs over 4 shards (n_per_shard=32) with k=40: per-shard top_k
+    # clamps and pads; results still cover the corpus-wide top 40
+    mesh = make_mesh(n_shards=4, n_dp=1, devices=jax.devices()[:4])
+    vectors = rng.normal(size=(100, 8)).astype(np.float32)
+    idx = ShardedVectorIndex(mesh, vectors, "cosine")
+    scores, ids = idx.search(vectors[7:8], k=40)
+    ids = np.asarray(ids)[0]
+    scores = np.asarray(scores)[0]
+    assert ids.shape == (40,)
+    assert ids[0] == 7
+    finite = ids[np.isfinite(scores)]
+    assert finite.min() >= 0
+    assert len(set(finite.tolist())) == len(finite)

@@ -432,3 +432,28 @@ def test_update_visible_after_refresh(svc):
     # restore for other tests (module-scoped fixture)
     eng.index("0", DOCS[0])
     eng.refresh()
+
+
+def test_function_score_log_modifiers_base10(svc):
+    """ES modifiers log/log1p/log2p are base-10 (FieldValueFactorFunction.java:
+    LOG1P = log10(v+1)); ln-family is natural log."""
+    import math
+    for mod, expect in [("log1p", math.log10(501)), ("log2p", math.log10(502)),
+                        ("ln1p", math.log(501)), ("log", math.log10(500)),
+                        ("ln", math.log(500))]:
+        r = svc.search({"query": {"function_score": {
+            "query": {"term": {"_id": "2"}},   # views = 500
+            "functions": [{"field_value_factor": {"field": "views",
+                                                  "modifier": mod}}],
+            "boost_mode": "replace"}}})
+        got = r["hits"]["hits"][0]["_score"]
+        assert abs(got - expect) < 1e-3, (mod, got, expect)
+
+
+def test_filter_cache_is_bounded(svc):
+    from elasticsearch_tpu.index.segment import Segment
+    seg = svc.engine.acquire_reader().segments[0]
+    for i in range(Segment.FILTER_CACHE_CAP + 50):
+        svc.search({"query": {"bool": {"filter": [
+            {"term": {"tag": f"nonexistent-{i}"}}]}}})
+    assert len(seg._filter_cache) <= Segment.FILTER_CACHE_CAP
