@@ -32,40 +32,47 @@ def main() -> None:
     corpus = rng.standard_normal((n_docs, dims)).astype(np.float32)
     queries = rng.standard_normal((n_queries, dims)).astype(np.float32)
 
-    # ---- device path: bf16 MXU matmul + fp32 top-k (ops/knn.py kernel shape)
+    # ---- device path: the SHIPPED batched kernel (ops/knn.py), so the
+    # headline number tracks the code users actually run
+    from elasticsearch_tpu.ops.knn import knn_topk_batch
+
     matrix = jnp.asarray(corpus)
     norms = jnp.linalg.norm(matrix, axis=1)
-
-    @jax.jit
-    def knn(queries_d):
-        dots = jax.lax.dot_general(
-            queries_d.astype(jnp.bfloat16), matrix.astype(jnp.bfloat16),
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [B, N]
-        qn = jnp.linalg.norm(queries_d, axis=1, keepdims=True) + 1e-30
-        scores = dots / (norms[None, :] * qn + 1e-30)
-        return jax.lax.top_k(scores, k)
-
+    exists = jnp.ones((n_docs,), bool)
+    live = jnp.ones((n_docs,), bool)
     q_dev = jnp.asarray(queries)
-    s_dev, i_dev = jax.block_until_ready(knn(q_dev))     # compile + warmup
+
+    s_dev, i_dev = jax.block_until_ready(
+        knn_topk_batch(matrix, norms, exists, live, q_dev, k, "cosine"))
 
     iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
-        s_dev, i_dev = knn(q_dev)
+        s_dev, i_dev = knn_topk_batch(matrix, norms, exists, live, q_dev,
+                                      k, "cosine")
     jax.block_until_ready((s_dev, i_dev))
     device_qps = iters * n_queries / (time.perf_counter() - t0)
 
-    # ---- CPU oracle (float64 exact): recall ground truth + CPU QPS baseline
+    # ---- fair CPU baseline: float32 BLAS matmul + O(N) argpartition,
+    # precomputed norms, conversions OUTSIDE the timed region
+    c_norms = np.linalg.norm(corpus, axis=1)
+    q_norms = np.linalg.norm(queries, axis=1)
     t0 = time.perf_counter()
-    c64 = corpus.astype(np.float64)
-    q64 = queries.astype(np.float64)
-    dots = q64 @ c64.T
-    scores = dots / (np.linalg.norm(c64, axis=1)[None, :]
-                     * np.linalg.norm(q64, axis=1)[:, None] + 1e-30)
-    truth = np.argsort(-scores, axis=1)[:, :k]
+    dots32 = queries @ corpus.T
+    scores32 = dots32 / (c_norms[None, :] * q_norms[:, None] + 1e-30)
+    part = np.argpartition(-scores32, k, axis=1)[:, :k]
+    rows = np.arange(n_queries)[:, None]
+    order = np.argsort(-scores32[rows, part], axis=1)
+    _cpu_topk = part[rows, order]
     cpu_elapsed = time.perf_counter() - t0
     cpu_qps = n_queries / cpu_elapsed
+
+    # ---- float64 oracle (untimed): recall ground truth only
+    c64 = corpus.astype(np.float64)
+    q64 = queries.astype(np.float64)
+    scores = (q64 @ c64.T) / (np.linalg.norm(c64, axis=1)[None, :]
+                              * np.linalg.norm(q64, axis=1)[:, None] + 1e-30)
+    truth = np.argsort(-scores, axis=1)[:, :k]
 
     got = np.asarray(i_dev)
     recall = np.mean([len(set(got[i]) & set(truth[i])) / k

@@ -1,0 +1,199 @@
+"""Shard allocation: decide which node gets each shard copy.
+
+Reference: cluster/routing/allocation/AllocationService.java:70 (reroute on
+every membership/metadata change), BalancedShardsAllocator.java:82 (weighted
+least-loaded placement) and the pluggable decider chain (decider/ — same-
+shard, filters, throttling). Pure functions ClusterState -> ClusterState;
+the master runs them inside state updates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from elasticsearch_tpu.cluster.routing import (
+    IndexRoutingTable, RoutingTable, ShardRouting, ShardState,
+)
+from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode
+
+
+class Decision:
+    YES = "YES"
+    NO = "NO"
+    THROTTLE = "THROTTLE"
+
+
+class AllocationDecider:
+    def can_allocate(self, shard: ShardRouting, node: DiscoveryNode,
+                     state: ClusterState) -> str:
+        return Decision.YES
+
+
+class SameShardDecider(AllocationDecider):
+    """No two copies of the same shard on one node
+    (decider/SameShardAllocationDecider.java)."""
+
+    def can_allocate(self, shard, node, state):
+        for sr in state.routing_table.shards_on_node(node.node_id):
+            if sr.index == shard.index and sr.shard_id == shard.shard_id:
+                return Decision.NO
+        return Decision.YES
+
+
+class FilterDecider(AllocationDecider):
+    """index.routing.allocation.{require,include,exclude}._name
+    (decider/FilterAllocationDecider.java), matched on node names."""
+
+    def can_allocate(self, shard, node, state):
+        try:
+            settings = state.metadata.index(shard.index).settings
+        except Exception:  # noqa: BLE001 — index gone: no constraint
+            return Decision.YES
+        name = node.name or node.node_id
+        req = settings.get("index.routing.allocation.require._name")
+        if req and name != req:
+            return Decision.NO
+        inc = settings.get("index.routing.allocation.include._name")
+        if inc and name not in str(inc).split(","):
+            return Decision.NO
+        exc = settings.get("index.routing.allocation.exclude._name")
+        if exc and name in str(exc).split(","):
+            return Decision.NO
+        return Decision.YES
+
+
+class ThrottlingDecider(AllocationDecider):
+    """Bound concurrent recoveries per node
+    (decider/ThrottlingAllocationDecider.java)."""
+
+    def __init__(self, max_initializing_per_node: int = 4) -> None:
+        self.max_initializing = max_initializing_per_node
+
+    def can_allocate(self, shard, node, state):
+        initializing = sum(
+            1 for sr in state.routing_table.shards_on_node(node.node_id)
+            if sr.state == ShardState.INITIALIZING)
+        if initializing >= self.max_initializing:
+            return Decision.THROTTLE
+        return Decision.YES
+
+
+DEFAULT_DECIDERS: Sequence[AllocationDecider] = (
+    SameShardDecider(), FilterDecider(), ThrottlingDecider(),
+)
+
+
+class AllocationService:
+    def __init__(self, deciders: Sequence[AllocationDecider] = DEFAULT_DECIDERS):
+        self.deciders = list(deciders)
+
+    # -- decision ------------------------------------------------------------
+
+    def decide(self, shard: ShardRouting, node: DiscoveryNode,
+               state: ClusterState) -> str:
+        worst = Decision.YES
+        for d in self.deciders:
+            verdict = d.can_allocate(shard, node, state)
+            if verdict == Decision.NO:
+                return Decision.NO
+            if verdict == Decision.THROTTLE:
+                worst = Decision.THROTTLE
+        return worst
+
+    # -- reroute -------------------------------------------------------------
+
+    def reroute(self, state: ClusterState) -> ClusterState:
+        """Assign unassigned shards (primaries first) to the least-loaded
+        eligible data node. Idempotent; no-op returns the same state."""
+        data_nodes = state.data_nodes()
+        if not data_nodes:
+            return state
+        loads: Dict[str, int] = {
+            nid: len(state.routing_table.shards_on_node(nid))
+            for nid in data_nodes}
+        routing = state.routing_table
+        changed = False
+        unassigned = sorted(
+            (sr for sr in routing.all_shards()
+             if sr.state == ShardState.UNASSIGNED),
+            key=lambda sr: (not sr.primary, sr.index, sr.shard_id))
+        for shard in unassigned:
+            # replicas wait for an active primary to recover from
+            if not shard.primary:
+                primary = routing.index(shard.index).primary(shard.shard_id)
+                if not primary.active:
+                    continue
+            candidates = []
+            st = state.next_version(routing_table=routing) if changed else state
+            for nid, node in data_nodes.items():
+                if self.decide(shard, node, st) == Decision.YES:
+                    candidates.append(nid)
+            if not candidates:
+                continue
+            target = min(candidates, key=lambda nid: (loads[nid], nid))
+            new_shard = shard.initialize(target)
+            routing = routing.put_index(
+                routing.index(shard.index).replace_shard(shard, new_shard))
+            loads[target] += 1
+            changed = True
+        if not changed:
+            return state
+        return state.next_version(routing_table=routing)
+
+    # -- lifecycle events ----------------------------------------------------
+
+    def apply_started_shards(self, state: ClusterState,
+                             started: Iterable[ShardRouting]) -> ClusterState:
+        routing = state.routing_table
+        changed = False
+        for shard in started:
+            irt = routing.index(shard.index)
+            current = next((sr for sr in irt.shard_group(shard.shard_id)
+                            if sr.allocation_id == shard.allocation_id), None)
+            if current is None or current.state != ShardState.INITIALIZING:
+                continue
+            routing = routing.put_index(
+                irt.replace_shard(current, current.start()))
+            changed = True
+        if not changed:
+            return state
+        return self.reroute(state.next_version(routing_table=routing))
+
+    def apply_failed_shard(self, state: ClusterState,
+                           failed: ShardRouting) -> ClusterState:
+        """Failed primary: promote an active replica, then schedule a new
+        replica copy; failed replica: back to unassigned (reference:
+        NodeRemovalClusterStateTaskExecutor → AllocationService.reroute)."""
+        routing = state.routing_table
+        irt = routing.index(failed.index)
+        current = next((sr for sr in irt.shard_group(failed.shard_id)
+                        if sr.allocation_id == failed.allocation_id and
+                        sr.allocation_id is not None), None)
+        if current is None:
+            return state
+        irt = irt.replace_shard(current, current.fail())
+        if current.primary:
+            replicas = [sr for sr in irt.shard_group(failed.shard_id)
+                        if not sr.primary and sr.active]
+            if replicas:
+                promoted = replicas[0]
+                irt = irt.replace_shard(promoted, promoted.promote_to_primary())
+                demoted = next(sr for sr in irt.shard_group(failed.shard_id)
+                               if sr.primary and sr.state == ShardState.UNASSIGNED)
+                irt = irt.replace_shard(
+                    demoted, ShardRouting(index=failed.index,
+                                          shard_id=failed.shard_id,
+                                          primary=False))
+        routing = routing.put_index(irt)
+        return self.reroute(state.next_version(routing_table=routing))
+
+    def disassociate_dead_nodes(self, state: ClusterState,
+                                dead: Iterable[str]) -> ClusterState:
+        dead_set = set(dead)
+        out = state
+        for nid in dead_set:
+            for shard in list(out.routing_table.shards_on_node(nid)):
+                if shard.node_id in dead_set:
+                    out = self.apply_failed_shard(out, shard)
+        return out

@@ -1,0 +1,154 @@
+"""Index + cluster metadata: the schema half of cluster state.
+
+Reference: cluster/metadata/IndexMetadata.java:84 and Metadata. Immutable;
+every mutation returns a new object with a bumped version. Serialization is
+dict-shaped (the control plane's JSON wire).
+"""
+
+from __future__ import annotations
+
+import uuid as uuid_mod
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError, IndexAlreadyExistsError, IndexNotFoundError,
+)
+
+
+@dataclass(frozen=True)
+class IndexMetadata:
+    name: str
+    uuid: str
+    number_of_shards: int = 1
+    number_of_replicas: int = 0
+    version: int = 1
+    state: str = "open"                       # open | close
+    mappings: Mapping[str, Any] = field(default_factory=dict)
+    settings: Mapping[str, Any] = field(default_factory=dict)
+    aliases: Tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        if self.number_of_shards < 1:
+            raise IllegalArgumentError("number_of_shards must be >= 1")
+        if self.number_of_replicas < 0:
+            raise IllegalArgumentError("number_of_replicas must be >= 0")
+
+    @staticmethod
+    def create(name: str, number_of_shards: int = 1,
+               number_of_replicas: int = 0,
+               mappings: Optional[Mapping[str, Any]] = None,
+               settings: Optional[Mapping[str, Any]] = None) -> "IndexMetadata":
+        return IndexMetadata(name=name, uuid=uuid_mod.uuid4().hex,
+                             number_of_shards=number_of_shards,
+                             number_of_replicas=number_of_replicas,
+                             mappings=dict(mappings or {}),
+                             settings=dict(settings or {}))
+
+    def with_mappings(self, mappings: Mapping[str, Any]) -> "IndexMetadata":
+        return replace(self, mappings=dict(mappings), version=self.version + 1)
+
+    def with_replicas(self, n: int) -> "IndexMetadata":
+        return replace(self, number_of_replicas=n, version=self.version + 1)
+
+    def with_settings(self, settings: Mapping[str, Any]) -> "IndexMetadata":
+        merged = {**self.settings, **settings}
+        return replace(self, settings=merged, version=self.version + 1)
+
+    def with_aliases(self, aliases: Tuple[str, ...]) -> "IndexMetadata":
+        return replace(self, aliases=tuple(aliases), version=self.version + 1)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name, "uuid": self.uuid,
+            "number_of_shards": self.number_of_shards,
+            "number_of_replicas": self.number_of_replicas,
+            "version": self.version, "state": self.state,
+            "mappings": dict(self.mappings), "settings": dict(self.settings),
+            "aliases": list(self.aliases),
+        }
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "IndexMetadata":
+        return IndexMetadata(
+            name=d["name"], uuid=d["uuid"],
+            number_of_shards=d["number_of_shards"],
+            number_of_replicas=d["number_of_replicas"],
+            version=d.get("version", 1), state=d.get("state", "open"),
+            mappings=dict(d.get("mappings", {})),
+            settings=dict(d.get("settings", {})),
+            aliases=tuple(d.get("aliases", ())))
+
+
+@dataclass(frozen=True)
+class Metadata:
+    """All cluster-wide persistent metadata (indices, templates, settings)."""
+
+    indices: Mapping[str, IndexMetadata] = field(default_factory=dict)
+    templates: Mapping[str, Any] = field(default_factory=dict)
+    persistent_settings: Mapping[str, Any] = field(default_factory=dict)
+    version: int = 0
+
+    def index(self, name: str) -> IndexMetadata:
+        # alias resolution: a name may be an alias for exactly one index
+        if name in self.indices:
+            return self.indices[name]
+        matches = [im for im in self.indices.values() if name in im.aliases]
+        if len(matches) == 1:
+            return matches[0]
+        if len(matches) > 1:
+            raise IllegalArgumentError(
+                f"alias [{name}] has more than one index associated")
+        raise IndexNotFoundError(f"no such index [{name}]")
+
+    def has_index(self, name: str) -> bool:
+        try:
+            self.index(name)
+            return True
+        except IndexNotFoundError:
+            return False
+
+    def put_index(self, im: IndexMetadata) -> "Metadata":
+        if im.name in self.indices:
+            raise IndexAlreadyExistsError(
+                f"index [{im.name}] already exists")
+        return Metadata(indices={**self.indices, im.name: im},
+                        templates=self.templates,
+                        persistent_settings=self.persistent_settings,
+                        version=self.version + 1)
+
+    def update_index(self, im: IndexMetadata) -> "Metadata":
+        if im.name not in self.indices:
+            raise IndexNotFoundError(f"no such index [{im.name}]")
+        return Metadata(indices={**self.indices, im.name: im},
+                        templates=self.templates,
+                        persistent_settings=self.persistent_settings,
+                        version=self.version + 1)
+
+    def remove_index(self, name: str) -> "Metadata":
+        if name not in self.indices:
+            raise IndexNotFoundError(f"no such index [{name}]")
+        indices = {k: v for k, v in self.indices.items() if k != name}
+        return Metadata(indices=indices, templates=self.templates,
+                        persistent_settings=self.persistent_settings,
+                        version=self.version + 1)
+
+    def with_persistent_settings(self, settings: Mapping[str, Any]) -> "Metadata":
+        merged = {**self.persistent_settings, **settings}
+        return Metadata(indices=self.indices, templates=self.templates,
+                        persistent_settings=merged, version=self.version + 1)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"indices": {k: v.to_dict() for k, v in self.indices.items()},
+                "templates": dict(self.templates),
+                "persistent_settings": dict(self.persistent_settings),
+                "version": self.version}
+
+    @staticmethod
+    def from_dict(d: Mapping[str, Any]) -> "Metadata":
+        return Metadata(
+            indices={k: IndexMetadata.from_dict(v)
+                     for k, v in d.get("indices", {}).items()},
+            templates=dict(d.get("templates", {})),
+            persistent_settings=dict(d.get("persistent_settings", {})),
+            version=d.get("version", 0))

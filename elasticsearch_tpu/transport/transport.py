@@ -1,0 +1,216 @@
+"""TransportService: action-name-dispatched request/response RPC.
+
+Reference: transport/TransportService.java:72 (handler registry, timeouts,
+local short-circuit) over TcpTransport framing. Here the wire is pluggable:
+InMemoryTransport delivers between in-process nodes through the scheduler,
+with per-link disruption rules (drop/delay/partition) subsuming the
+reference's MockTransportService/DisruptableMockTransport test doubles.
+Requests/responses are plain dicts (already JSON-shaped, like the
+reference's Writeable DTOs are wire-shaped).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from elasticsearch_tpu.transport.scheduler import Cancellable, Scheduler
+from elasticsearch_tpu.utils.errors import (
+    NodeDisconnectedError, ReceiveTimeoutError, TransportError,
+)
+
+
+class ConnectTransportError(TransportError):
+    """Link-level failure: node unreachable/disconnected."""
+
+
+class NodeNotConnectedError(ConnectTransportError):
+    pass
+
+
+class RemoteTransportError(TransportError):
+    """The remote handler raised; wraps the original error by name."""
+
+    def __init__(self, node_id: str, action: str, cause: str) -> None:
+        super().__init__(f"[{node_id}][{action}] remote error: {cause}")
+        self.node_id = node_id
+        self.action = action
+        self.cause = cause
+
+
+Handler = Callable[[Dict[str, Any], str], Dict[str, Any]]
+ResponseCallback = Callable[[Optional[Dict[str, Any]], Optional[Exception]], None]
+
+
+@dataclass
+class _Rule:
+    """Disruption rule for a directed link (or wildcard '*')."""
+    drop: bool = False
+    delay: float = 0.0
+
+
+class InMemoryTransport:
+    """Delivers messages between TransportServices through the scheduler.
+
+    One instance per simulated network. Per-link latency plus disruption
+    rules; every delivery is a scheduled task, so under the deterministic
+    scheduler the full cluster interleaving is seed-reproducible.
+    """
+
+    def __init__(self, scheduler: Scheduler, default_latency: float = 0.001):
+        self.scheduler = scheduler
+        self.default_latency = default_latency
+        self._nodes: Dict[str, "TransportService"] = {}
+        self._rules: Dict[Tuple[str, str], _Rule] = {}
+
+    # -- membership ----------------------------------------------------------
+
+    def attach(self, service: "TransportService") -> None:
+        self._nodes[service.node_id] = service
+
+    def detach(self, node_id: str) -> None:
+        self._nodes.pop(node_id, None)
+
+    def connected(self, node_id: str) -> bool:
+        return node_id in self._nodes
+
+    # -- disruption (NetworkDisruption / MockTransportService analogs) -------
+
+    def add_rule(self, sender: str, receiver: str,
+                 drop: bool = False, delay: float = 0.0) -> None:
+        self._rules[(sender, receiver)] = _Rule(drop=drop, delay=delay)
+
+    def clear_rules(self) -> None:
+        self._rules.clear()
+
+    def partition(self, side_a, side_b) -> None:
+        """Two-way partition between node-id groups."""
+        for a in side_a:
+            for b in side_b:
+                self.add_rule(a, b, drop=True)
+                self.add_rule(b, a, drop=True)
+
+    def heal(self) -> None:
+        self.clear_rules()
+
+    def _rule(self, sender: str, receiver: str) -> Optional[_Rule]:
+        for key in ((sender, receiver), (sender, "*"), ("*", receiver)):
+            if key in self._rules:
+                return self._rules[key]
+        return None
+
+    # -- delivery ------------------------------------------------------------
+
+    def deliver(self, sender: str, receiver: str,
+                fn: Callable[["TransportService"], None],
+                on_undeliverable: Callable[[], None]) -> None:
+        rule = self._rule(sender, receiver)
+        if rule is not None and rule.drop:
+            return  # silently dropped: timeout handles it, like a real network
+        latency = self.default_latency + (rule.delay if rule else 0.0)
+
+        def run() -> None:
+            target = self._nodes.get(receiver)
+            if target is None:
+                on_undeliverable()
+            else:
+                fn(target)
+
+        self.scheduler.schedule(latency, run)
+
+
+class TransportService:
+    """Per-node RPC endpoint: handler registry + async request/response.
+
+    Handlers are ``fn(request: dict, sender_node_id: str) -> dict`` and run
+    on the scheduler's dispatch context. Responses (or remote exceptions)
+    come back through the caller's callback. Local sends short-circuit but
+    still go through the scheduler, preserving async semantics
+    (TransportService.java local-node optimization).
+    """
+
+    def __init__(self, node_id: str, transport: InMemoryTransport):
+        self.node_id = node_id
+        self.transport = transport
+        self._handlers: Dict[str, Handler] = {}
+        self._next_request_id = 0
+        self.stats = {"sent": 0, "received": 0, "timeouts": 0}
+        transport.attach(self)
+
+    # -- registry ------------------------------------------------------------
+
+    def register_handler(self, action: str, handler: Handler) -> None:
+        if action in self._handlers:
+            raise ValueError(f"handler already registered for [{action}]")
+        self._handlers[action] = handler
+
+    # -- sending -------------------------------------------------------------
+
+    DEFAULT_TIMEOUT = 30.0
+
+    def send_request(self, node_id: str, action: str, request: Dict[str, Any],
+                     on_response: ResponseCallback,
+                     timeout: Optional[float] = None) -> None:
+        """Fire the request; exactly one callback invocation is guaranteed
+        (response, remote error, undeliverable, or timeout). timeout=None
+        means DEFAULT_TIMEOUT — a silently-dropped message (partition rule)
+        must still resolve the callback, so every request has a timeout."""
+        if timeout is None:
+            timeout = self.DEFAULT_TIMEOUT
+        self.stats["sent"] += 1
+        done = {"flag": False}
+        timeout_handle: Optional[Cancellable] = None
+
+        def finish(resp: Optional[Dict[str, Any]],
+                   err: Optional[Exception]) -> None:
+            if done["flag"]:
+                return
+            done["flag"] = True
+            if timeout_handle is not None:
+                timeout_handle.cancel()
+            on_response(resp, err)
+
+        if timeout is not None:
+            def on_timeout() -> None:
+                self.stats["timeouts"] += 1
+                finish(None, ReceiveTimeoutError(
+                    f"[{node_id}][{action}] request timed out after {timeout}s"))
+            timeout_handle = self.transport.scheduler.schedule(
+                timeout, on_timeout)
+
+        # snapshot the payload: sender-side mutation after send must not be
+        # visible remotely (the wire would have serialized it)
+        payload = copy.deepcopy(request)
+
+        def handle_at(target: "TransportService") -> None:
+            target.stats["received"] += 1
+            handler = target._handlers.get(action)
+            if handler is None:
+                reply_err(f"no handler for action [{action}]")
+                return
+            try:
+                response = handler(payload, self.node_id)
+            except Exception as e:  # noqa: BLE001 — becomes a remote error
+                reply_err(f"{type(e).__name__}: {e}")
+                return
+            response = copy.deepcopy(response if response is not None else {})
+            self.transport.deliver(
+                node_id, self.node_id,
+                lambda _me: finish(response, None),
+                on_undeliverable=lambda: None)  # sender gone: nothing to do
+
+        def reply_err(cause: str) -> None:
+            self.transport.deliver(
+                node_id, self.node_id,
+                lambda _me: finish(None, RemoteTransportError(
+                    node_id, action, cause)),
+                on_undeliverable=lambda: None)
+
+        self.transport.deliver(
+            self.node_id, node_id, handle_at,
+            on_undeliverable=lambda: finish(None, NodeNotConnectedError(
+                f"node [{node_id}] is not connected")))
+
+    def close(self) -> None:
+        self.transport.detach(self.node_id)

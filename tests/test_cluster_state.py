@@ -1,0 +1,193 @@
+"""Cluster state model: immutability, diffs, routing, allocation."""
+
+import pytest
+
+from elasticsearch_tpu.cluster import (
+    AllocationService, ClusterState, DiscoveryNode, IndexMetadata,
+    IndexRoutingTable, Metadata, Roles, RoutingTable, ShardRouting, ShardState,
+)
+from elasticsearch_tpu.cluster.allocation import Decision, ThrottlingDecider
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError, IndexAlreadyExistsError, IndexNotFoundError,
+)
+
+
+def nodes(*ids, roles=None):
+    return {i: DiscoveryNode(node_id=i,
+                             roles=frozenset(roles or Roles.ALL))
+            for i in ids}
+
+
+def state_with(n_shards=2, n_replicas=1, node_ids=("n1", "n2", "n3")):
+    im = IndexMetadata.create("idx", n_shards, n_replicas)
+    md = Metadata().put_index(im)
+    rt = RoutingTable().put_index(
+        IndexRoutingTable.new("idx", n_shards, n_replicas))
+    return ClusterState(nodes=nodes(*node_ids), master_node_id=node_ids[0],
+                        metadata=md, routing_table=rt)
+
+
+# -- metadata ----------------------------------------------------------------
+
+def test_index_metadata_versioning_and_validation():
+    im = IndexMetadata.create("a", 2, 1)
+    im2 = im.with_replicas(3)
+    assert im.number_of_replicas == 1 and im2.number_of_replicas == 3
+    assert im2.version == im.version + 1
+    with pytest.raises(IllegalArgumentError):
+        IndexMetadata.create("bad", 0)
+
+
+def test_metadata_put_update_remove():
+    md = Metadata().put_index(IndexMetadata.create("a"))
+    with pytest.raises(IndexAlreadyExistsError):
+        md.put_index(IndexMetadata.create("a"))
+    md2 = md.remove_index("a")
+    assert not md2.has_index("a") and md.has_index("a")
+    with pytest.raises(IndexNotFoundError):
+        md2.index("a")
+
+
+def test_alias_resolution():
+    md = Metadata().put_index(
+        IndexMetadata.create("logs-1").with_aliases(("logs",)))
+    assert md.index("logs").name == "logs-1"
+    md = md.put_index(IndexMetadata.create("logs-2").with_aliases(("logs",)))
+    with pytest.raises(IllegalArgumentError):
+        md.index("logs")      # ambiguous alias
+
+
+# -- state + diffs -----------------------------------------------------------
+
+def test_cluster_state_roundtrip_and_diff():
+    s0 = state_with()
+    s1 = s0.with_metadata(
+        s0.metadata.update_index(s0.metadata.index("idx").with_replicas(2)))
+    assert s1.version == s0.version + 1
+
+    # full serialization roundtrip
+    restored = ClusterState.from_dict(s1.to_dict())
+    assert restored.version == s1.version
+    assert restored.metadata.index("idx").number_of_replicas == 2
+
+    # diff applies on matching base, rejects wrong base
+    diff = s1.diff_from(s0)
+    assert "metadata" in diff and "routing_table" not in diff
+    applied = s0.apply_diff(diff)
+    assert applied.state_uuid == s1.state_uuid
+    assert applied.metadata.index("idx").number_of_replicas == 2
+    from elasticsearch_tpu.cluster.state import IncompatibleClusterStateError
+    with pytest.raises(IncompatibleClusterStateError):
+        s1.apply_diff(diff)
+
+
+# -- allocation --------------------------------------------------------------
+
+def test_reroute_assigns_primaries_then_replicas():
+    svc = AllocationService()
+    s = svc.reroute(state_with(n_shards=2, n_replicas=1))
+    irt = s.routing_table.index("idx")
+    for sid in (0, 1):
+        assert irt.primary(sid).state == ShardState.INITIALIZING
+        replicas = [sr for sr in irt.shard_group(sid) if not sr.primary]
+        assert all(sr.state == ShardState.UNASSIGNED for sr in replicas)
+
+    # start primaries -> replicas get allocated
+    started = [irt.primary(sid) for sid in (0, 1)]
+    s = svc.apply_started_shards(s, started)
+    irt = s.routing_table.index("idx")
+    for sid in (0, 1):
+        assert irt.primary(sid).state == ShardState.STARTED
+        replicas = [sr for sr in irt.shard_group(sid) if not sr.primary]
+        assert all(sr.state == ShardState.INITIALIZING for sr in replicas)
+        # same-shard decider: replica on a different node than primary
+        assert replicas[0].node_id != irt.primary(sid).node_id
+
+
+def test_reroute_balances_by_load():
+    svc = AllocationService()
+    s = svc.reroute(state_with(n_shards=4, n_replicas=0,
+                               node_ids=("n1", "n2")))
+    per_node = {}
+    for sr in s.routing_table.all_shards():
+        per_node[sr.node_id] = per_node.get(sr.node_id, 0) + 1
+    assert per_node == {"n1": 2, "n2": 2}
+
+
+def test_failed_primary_promotes_replica():
+    svc = AllocationService()
+    s = svc.reroute(state_with(n_shards=1, n_replicas=1))
+    irt = s.routing_table.index("idx")
+    s = svc.apply_started_shards(s, [irt.primary(0)])
+    irt = s.routing_table.index("idx")
+    replica = next(sr for sr in irt.shard_group(0) if not sr.primary)
+    s = svc.apply_started_shards(s, [replica])
+    irt = s.routing_table.index("idx")
+    old_primary = irt.primary(0)
+    replica = next(sr for sr in irt.shard_group(0) if not sr.primary)
+
+    s = svc.apply_failed_shard(s, old_primary)
+    irt = s.routing_table.index("idx")
+    new_primary = irt.primary(0)
+    assert new_primary.allocation_id == replica.allocation_id
+    assert new_primary.state == ShardState.STARTED
+    # a fresh replica copy is initializing somewhere else
+    new_replica = next(sr for sr in irt.shard_group(0) if not sr.primary)
+    assert new_replica.state == ShardState.INITIALIZING
+    assert new_replica.node_id != new_primary.node_id
+
+
+def test_dead_node_disassociation():
+    svc = AllocationService()
+    s = svc.reroute(state_with(n_shards=2, n_replicas=1))
+    s = svc.apply_started_shards(
+        s, [s.routing_table.index("idx").primary(sid) for sid in (0, 1)])
+    s = svc.apply_started_shards(
+        s, [sr for sr in s.routing_table.index("idx").all_shards()
+            if not sr.primary])
+    victim = s.routing_table.index("idx").primary(0).node_id
+    survivors = {n for n in s.nodes if n != victim}
+    s = s.with_nodes({n: s.nodes[n] for n in survivors},
+                     master_node_id=next(iter(survivors)))
+    s = svc.disassociate_dead_nodes(s, [victim])
+    assert s.routing_table.shards_on_node(victim) == []
+    # every shard group still has exactly one primary and it is not on victim
+    for sid in (0, 1):
+        p = s.routing_table.index("idx").primary(sid)
+        assert p.node_id != victim
+
+
+def test_filter_decider_require_name():
+    svc = AllocationService()
+    im = IndexMetadata.create("idx", 1, 0, settings={
+        "index.routing.allocation.require._name": "n2"})
+    md = Metadata().put_index(im)
+    rt = RoutingTable().put_index(IndexRoutingTable.new("idx", 1, 0))
+    s = ClusterState(nodes=nodes("n1", "n2"), master_node_id="n1",
+                     metadata=md, routing_table=rt)
+    s = svc.reroute(s)
+    assert s.routing_table.index("idx").primary(0).node_id == "n2"
+
+
+def test_throttling_decider():
+    svc = AllocationService(deciders=[ThrottlingDecider(2)])
+    s = svc.reroute(state_with(n_shards=5, n_replicas=0, node_ids=("n1",)))
+    irt = s.routing_table.index("idx")
+    initializing = [sr for sr in irt.all_shards()
+                    if sr.state == ShardState.INITIALIZING]
+    assert len(initializing) == 2     # throttled at 2 concurrent recoveries
+    # starting them frees slots; reroute continues
+    s = svc.apply_started_shards(s, initializing)
+    irt = s.routing_table.index("idx")
+    assert sum(1 for sr in irt.all_shards()
+               if sr.state == ShardState.INITIALIZING) == 2
+
+
+def test_no_data_nodes_leaves_unassigned():
+    svc = AllocationService()
+    s = state_with(node_ids=("m1",))
+    s = s.with_nodes({"m1": DiscoveryNode("m1", roles=frozenset({Roles.MASTER}))},
+                     master_node_id="m1")
+    s2 = svc.reroute(s)
+    assert all(sr.state == ShardState.UNASSIGNED
+               for sr in s2.routing_table.all_shards())

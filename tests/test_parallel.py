@@ -42,10 +42,12 @@ def test_sharded_knn_matches_oracle(rng):
 def test_sharded_knn_dot_product(rng):
     mesh = make_mesh(n_shards=8, n_dp=1)
     vectors = rng.normal(size=(64, 8)).astype(np.float32)
+    # dot_product isn't self-maximal for arbitrary vectors; give doc 17 a
+    # dominant norm so it must win by dot score
+    vectors[17] *= 10.0
     idx = ShardedVectorIndex(mesh, vectors, "dot_product")
-    q = vectors[17:18]  # nearest by dot should include itself
-    scores, ids = idx.search(q, k=5)
-    assert 17 in np.asarray(ids)[0].tolist()
+    scores, ids = idx.search(vectors[17:18], k=5)
+    assert np.asarray(ids)[0][0] == 17
 
 
 def bm25_oracle(docs_terms, query_terms, k1=DEFAULT_K1, b=DEFAULT_B):
@@ -151,7 +153,9 @@ def test_sharded_knn_l2_norm(rng):
     idx = ShardedVectorIndex(mesh, vectors, "l2_norm")
     scores, ids = idx.search(vectors[9:10], k=3)
     assert np.asarray(ids)[0][0] == 9           # zero distance to itself
-    assert np.isclose(np.asarray(scores)[0][0], 1.0, atol=1e-5)
+    # f32 residual of ||m||^2+||q||^2-2<q,m> is ~1e-6, sqrt-amplified to
+    # ~1e-3 in the score; ranking is exact, the self-score nearly 1
+    assert np.isclose(np.asarray(scores)[0][0], 1.0, atol=1e-2)
 
 
 def test_sharded_hybrid_l2_and_phantom_masking(rng):

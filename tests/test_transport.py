@@ -1,0 +1,197 @@
+"""Transport layer: deterministic delivery, timeouts, disruption rules."""
+
+import pytest
+
+from elasticsearch_tpu.transport import (
+    DeterministicScheduler, InMemoryTransport, NodeNotConnectedError,
+    ReceiveTimeoutError, RemoteTransportError, TransportService,
+)
+
+
+@pytest.fixture
+def net():
+    sched = DeterministicScheduler(seed=0)
+    return sched, InMemoryTransport(sched)
+
+
+def test_request_response_roundtrip(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    b = TransportService("b", transport)
+    b.register_handler("echo", lambda req, sender: {"echo": req["msg"],
+                                                    "from": sender})
+    got = {}
+    a.send_request("b", "echo", {"msg": "hi"},
+                   lambda resp, err: got.update(resp=resp, err=err))
+    sched.run_until_idle()
+    assert got["err"] is None
+    assert got["resp"] == {"echo": "hi", "from": "a"}
+
+
+def test_local_send_short_circuits_but_stays_async(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    a.register_handler("ping", lambda req, sender: {"pong": True})
+    got = {}
+    a.send_request("a", "ping", {}, lambda r, e: got.update(r=r))
+    assert "r" not in got          # async even locally
+    sched.run_until_idle()
+    assert got["r"] == {"pong": True}
+
+
+def test_remote_handler_exception_wrapped(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    b = TransportService("b", transport)
+
+    def boom(req, sender):
+        raise ValueError("bad request")
+    b.register_handler("boom", boom)
+    got = {}
+    a.send_request("b", "boom", {}, lambda r, e: got.update(err=e))
+    sched.run_until_idle()
+    assert isinstance(got["err"], RemoteTransportError)
+    assert "ValueError" in str(got["err"])
+
+
+def test_unknown_action_is_remote_error(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    TransportService("b", transport)
+    got = {}
+    a.send_request("b", "nope", {}, lambda r, e: got.update(err=e))
+    sched.run_until_idle()
+    assert isinstance(got["err"], RemoteTransportError)
+
+
+def test_unconnected_node_fails_fast(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    got = {}
+    a.send_request("ghost", "x", {}, lambda r, e: got.update(err=e))
+    sched.run_until_idle()
+    assert isinstance(got["err"], NodeNotConnectedError)
+
+
+def test_timeout_fires_when_dropped(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    b = TransportService("b", transport)
+    b.register_handler("x", lambda req, sender: {})
+    transport.add_rule("a", "b", drop=True)
+    got = {}
+    a.send_request("b", "x", {}, lambda r, e: got.update(err=e), timeout=5.0)
+    sched.run_for(4.9)
+    assert "err" not in got
+    sched.run_for(0.2)
+    assert isinstance(got["err"], ReceiveTimeoutError)
+    assert a.stats["timeouts"] == 1
+
+
+def test_timeout_cancelled_on_success(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    b = TransportService("b", transport)
+    b.register_handler("x", lambda req, sender: {"ok": 1})
+    calls = []
+    a.send_request("b", "x", {}, lambda r, e: calls.append((r, e)),
+                   timeout=5.0)
+    sched.run_until_idle()
+    sched.run_for(10.0)
+    assert calls == [({"ok": 1}, None)]   # exactly one callback
+
+
+def test_partition_and_heal(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    b = TransportService("b", transport)
+    b.register_handler("x", lambda req, sender: {"ok": 1})
+    transport.partition(["a"], ["b"])
+    got = {}
+    a.send_request("b", "x", {}, lambda r, e: got.update(err=e), timeout=1.0)
+    sched.run_for(2.0)
+    assert isinstance(got["err"], ReceiveTimeoutError)
+    transport.heal()
+    got2 = {}
+    a.send_request("b", "x", {}, lambda r, e: got2.update(r=r), timeout=1.0)
+    sched.run_until_idle()
+    assert got2["r"] == {"ok": 1}
+
+
+def test_delay_rule_defers_delivery(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    b = TransportService("b", transport)
+    b.register_handler("x", lambda req, sender: {"ok": 1})
+    transport.add_rule("a", "b", delay=3.0)
+    got = {}
+    a.send_request("b", "x", {}, lambda r, e: got.update(r=r))
+    sched.run_for(2.0)
+    assert "r" not in got
+    sched.run_for(2.0)
+    assert got["r"] == {"ok": 1}
+
+
+def test_request_payload_isolated_from_sender_mutation(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    b = TransportService("b", transport)
+    seen = {}
+    b.register_handler("x", lambda req, sender: seen.update(req) or {})
+    req = {"items": [1, 2]}
+    a.send_request("b", "x", req, lambda r, e: None)
+    req["items"].append(3)          # after send, before delivery
+    sched.run_until_idle()
+    assert seen["items"] == [1, 2]  # wire snapshot, not shared reference
+
+
+def test_deterministic_scheduler_reproducible():
+    def run(seed):
+        sched = DeterministicScheduler(seed=seed)
+        transport = InMemoryTransport(sched)
+        order = []
+        nodes = [TransportService(f"n{i}", transport) for i in range(3)]
+        for n in nodes:
+            n.register_handler("t", lambda req, sender, n=n:
+                               order.append((n.node_id, req["i"])) or {})
+        for i in range(5):
+            nodes[i % 3].send_request(f"n{(i + 1) % 3}", "t", {"i": i},
+                                      lambda r, e: None)
+        sched.run_until_idle()
+        return order
+    assert run(7) == run(7)
+
+
+def test_scheduler_livelock_guard():
+    sched = DeterministicScheduler()
+
+    def reschedule():
+        sched.schedule(0.0, reschedule)
+    sched.schedule(0.0, reschedule)
+    with pytest.raises(RuntimeError):
+        sched.run_until_idle(max_tasks=100)
+
+
+def test_run_until_ignores_cancelled_heads():
+    sched = DeterministicScheduler()
+    early = sched.schedule(5.0, lambda: None)
+    fired = []
+    sched.schedule(100.0, lambda: fired.append(1))
+    early.cancel()
+    sched.run_until(10.0)        # must NOT run the t=100 task
+    assert fired == []
+    assert sched.now() == 10.0
+    sched.run_until(100.0)
+    assert fired == [1]
+
+
+def test_default_timeout_resolves_dropped_requests(net):
+    sched, transport = net
+    a = TransportService("a", transport)
+    b = TransportService("b", transport)
+    b.register_handler("x", lambda req, sender: {})
+    transport.add_rule("a", "b", drop=True)
+    got = []
+    a.send_request("b", "x", {}, lambda r, e: got.append(e))  # no timeout arg
+    sched.run_for(TransportService.DEFAULT_TIMEOUT + 1.0)
+    assert len(got) == 1 and isinstance(got[0], ReceiveTimeoutError)
