@@ -1,0 +1,380 @@
+"""Master-level admin actions + broadcast shard maintenance actions.
+
+Reference analogs: MetadataCreateIndexService.java:113 (create index
+through a master state update), TransportDeleteIndexAction,
+TransportPutMappingAction, TransportUpdateSettingsAction, the shard-state
+listeners (ShardStateAction started/failed handlers), cluster health
+(cluster/health/ClusterHealthResponse semantics), and broadcast actions
+(refresh/flush/forcemerge over all shards, TransportBroadcastAction).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.cluster.allocation import AllocationService
+from elasticsearch_tpu.cluster.coordination import Coordinator
+from elasticsearch_tpu.cluster.metadata import IndexMetadata
+from elasticsearch_tpu.cluster.routing import (
+    IndexRoutingTable, ShardRouting, ShardState,
+)
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.indices.cluster_state_service import (
+    SHARD_FAILED, SHARD_STARTED,
+)
+from elasticsearch_tpu.indices.indices_service import IndicesService
+from elasticsearch_tpu.transport.transport import Deferred, TransportService
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError, IndexNotFoundError, NotMasterError,
+)
+
+CREATE_INDEX = "indices:admin/create"
+DELETE_INDEX = "indices:admin/delete"
+PUT_MAPPING = "indices:admin/mapping/put"
+UPDATE_SETTINGS = "indices:admin/settings/update"
+UPDATE_ALIASES = "indices:admin/aliases"
+CLUSTER_UPDATE_SETTINGS = "cluster:admin/settings/update"
+REFRESH_SHARD = "indices:admin/refresh[s]"
+FLUSH_SHARD = "indices:admin/flush[s]"
+FORCEMERGE_SHARD = "indices:admin/forcemerge[s]"
+
+MASTER_RETRY_DELAY = 0.2
+MASTER_TIMEOUT = 30.0
+
+
+class MasterActions:
+    """Handlers that only the elected master executes; callers route via
+    ``MasterClient`` which retries on NotMaster/no-master."""
+
+    def __init__(self, coordinator: Coordinator,
+                 allocation: AllocationService, ts: TransportService):
+        self.coordinator = coordinator
+        self.allocation = allocation
+        for action, handler in [
+            (CREATE_INDEX, self._on_create_index),
+            (DELETE_INDEX, self._on_delete_index),
+            (PUT_MAPPING, self._on_put_mapping),
+            (UPDATE_SETTINGS, self._on_update_settings),
+            (UPDATE_ALIASES, self._on_update_aliases),
+            (CLUSTER_UPDATE_SETTINGS, self._on_cluster_settings),
+            (SHARD_STARTED, self._on_shard_started),
+            (SHARD_FAILED, self._on_shard_failed),
+        ]:
+            ts.register_handler(action, handler)
+
+    def _submit(self, description: str,
+                update: Callable[[ClusterState], ClusterState]) -> Deferred:
+        deferred = Deferred()
+
+        def done(err: Optional[Exception]) -> None:
+            if err is not None:
+                deferred.reject(err)
+            else:
+                deferred.resolve({"acknowledged": True})
+        self.coordinator.submit_state_update(description, update, done)
+        return deferred
+
+    # -- index admin ----------------------------------------------------
+
+    def _on_create_index(self, req: Dict[str, Any], sender: str) -> Deferred:
+        name = req["index"]
+        settings = dict(req.get("settings") or {})
+        n_shards = int(settings.pop("number_of_shards",
+                                    settings.pop("index.number_of_shards", 1)))
+        n_replicas = int(settings.pop(
+            "number_of_replicas", settings.pop("index.number_of_replicas", 1)))
+        mappings = req.get("mappings") or {}
+        if not name or name.startswith("_") or name != name.lower() \
+                or any(c in name for c in ' ,"*\\<>|?/'):
+            raise IllegalArgumentError(f"invalid index name [{name}]")
+
+        def update(state: ClusterState) -> ClusterState:
+            if state.metadata.has_index(name):
+                if req.get("ignore_existing"):
+                    return state
+                raise IllegalArgumentError(
+                    f"index [{name}] already exists")
+            meta = IndexMetadata.create(
+                name, number_of_shards=n_shards,
+                number_of_replicas=n_replicas,
+                mappings=mappings, settings=settings)
+            new = state.next_version(
+                metadata=state.metadata.put_index(meta),
+                routing_table=state.routing_table.put_index(
+                    IndexRoutingTable.new(name, n_shards, n_replicas)))
+            return self.allocation.reroute(new)
+        return self._submit(f"create-index [{name}]", update)
+
+    def _on_delete_index(self, req: Dict[str, Any], sender: str) -> Deferred:
+        name = req["index"]
+
+        def update(state: ClusterState) -> ClusterState:
+            resolved = state.metadata.index(name).name   # raises if missing
+            return state.next_version(
+                metadata=state.metadata.remove_index(resolved),
+                routing_table=state.routing_table.remove_index(resolved))
+        return self._submit(f"delete-index [{name}]", update)
+
+    def _on_put_mapping(self, req: Dict[str, Any], sender: str) -> Deferred:
+        name = req["index"]
+        mappings = req.get("mappings") or {}
+
+        def update(state: ClusterState) -> ClusterState:
+            meta = state.metadata.index(name)
+            merged = dict(meta.mappings)
+            props = dict(merged.get("properties", {}))
+            props.update(mappings.get("properties", {}))
+            merged["properties"] = props
+            return state.next_version(metadata=state.metadata.update_index(
+                meta.with_mappings(merged)))
+        return self._submit(f"put-mapping [{name}]", update)
+
+    def _on_update_settings(self, req: Dict[str, Any], sender: str
+                            ) -> Deferred:
+        name = req["index"]
+        settings = dict(req.get("settings") or {})
+
+        def update(state: ClusterState) -> ClusterState:
+            meta = state.metadata.index(name)
+            n_replicas = settings.pop(
+                "number_of_replicas",
+                settings.pop("index.number_of_replicas", None))
+            new_meta = meta.with_settings(settings) if settings else meta
+            routing = state.routing_table
+            if n_replicas is not None and \
+                    int(n_replicas) != meta.number_of_replicas:
+                n_replicas = int(n_replicas)
+                new_meta = new_meta.with_replicas(n_replicas)
+                routing = routing.put_index(_resize_replicas(
+                    routing.index(meta.name), n_replicas))
+            new = state.next_version(
+                metadata=state.metadata.update_index(new_meta),
+                routing_table=routing)
+            return self.allocation.reroute(new)
+        return self._submit(f"update-settings [{name}]", update)
+
+    def _on_update_aliases(self, req: Dict[str, Any], sender: str
+                           ) -> Deferred:
+        actions = req.get("actions", [])
+
+        def update(state: ClusterState) -> ClusterState:
+            metadata = state.metadata
+            for action in actions:
+                kind = next(iter(action))
+                spec = action[kind]
+                meta = metadata.index(spec["index"])
+                aliases = set(meta.aliases)
+                if kind == "add":
+                    aliases.add(spec["alias"])
+                elif kind == "remove":
+                    aliases.discard(spec["alias"])
+                else:
+                    raise IllegalArgumentError(
+                        f"unknown alias action [{kind}]")
+                metadata = metadata.update_index(
+                    meta.with_aliases(tuple(sorted(aliases))))
+            return state.next_version(metadata=metadata)
+        return self._submit("update-aliases", update)
+
+    def _on_cluster_settings(self, req: Dict[str, Any], sender: str
+                             ) -> Deferred:
+        persistent = req.get("persistent") or {}
+
+        def update(state: ClusterState) -> ClusterState:
+            return state.next_version(
+                metadata=state.metadata.with_persistent_settings(persistent))
+        return self._submit("cluster-update-settings", update)
+
+    # -- shard state ----------------------------------------------------
+
+    def _on_shard_started(self, req: Dict[str, Any], sender: str) -> Deferred:
+        sr = ShardRouting.from_dict(req["shard"])
+
+        def update(state: ClusterState) -> ClusterState:
+            return self.allocation.apply_started_shards(state, [sr])
+        return self._submit(f"shard-started {sr.index}[{sr.shard_id}]",
+                            update)
+
+    def _on_shard_failed(self, req: Dict[str, Any], sender: str) -> Deferred:
+        sr = ShardRouting.from_dict(req["shard"])
+
+        def update(state: ClusterState) -> ClusterState:
+            return self.allocation.apply_failed_shard(state, sr)
+        return self._submit(f"shard-failed {sr.index}[{sr.shard_id}]",
+                            update)
+
+
+class MasterClient:
+    """Coordinator-side: route a request to the elected master, retrying
+    through elections (TransportMasterNodeAction's retry-on-master-change)."""
+
+    def __init__(self, ts: TransportService, coordinator: Coordinator):
+        self.ts = ts
+        self.coordinator = coordinator
+
+    def execute(self, action: str, request: Dict[str, Any],
+                on_done: Callable[[Optional[Dict[str, Any]],
+                                   Optional[Exception]], None],
+                timeout: float = MASTER_TIMEOUT) -> None:
+        scheduler = self.coordinator.scheduler
+        deadline = scheduler.now() + timeout
+
+        def attempt() -> None:
+            master = self.coordinator.applied_state.master_node_id
+            if self.coordinator.mode == "LEADER":
+                master = self.coordinator.node.node_id
+            if master is None:
+                retry(NotMasterError("no elected master"))
+                return
+            self.ts.send_request(master, action, request, on_response,
+                                 timeout=timeout)
+
+        def on_response(resp, err) -> None:
+            from elasticsearch_tpu.transport.transport import (
+                NodeNotConnectedError,
+            )
+            if err is not None and (
+                    "NotMasterError" in str(err)
+                    or isinstance(err, NodeNotConnectedError)):
+                # stale master pointer or mid-election: keep retrying until
+                # a new master commits (TransportMasterNodeAction retry)
+                retry(err)
+                return
+            on_done(resp, err)
+
+        def retry(err) -> None:
+            if scheduler.now() >= deadline:
+                on_done(None, err if isinstance(err, Exception)
+                        else NotMasterError(str(err)))
+            else:
+                scheduler.schedule(MASTER_RETRY_DELAY, attempt)
+
+        attempt()
+
+
+class BroadcastActions:
+    """Refresh / flush / force-merge across every shard copy of an index
+    (TransportBroadcastReplicationAction family)."""
+
+    def __init__(self, node_id: str, indices: IndicesService,
+                 ts: TransportService,
+                 state_supplier: Callable[[], ClusterState]):
+        self.node_id = node_id
+        self.indices = indices
+        self.ts = ts
+        self.state = state_supplier
+        ts.register_handler(REFRESH_SHARD, self._on_refresh)
+        ts.register_handler(FLUSH_SHARD, self._on_flush)
+        ts.register_handler(FORCEMERGE_SHARD, self._on_forcemerge)
+
+    def _on_refresh(self, req, sender):
+        self.indices.shard(req["index"], req["shard"]).engine.refresh()
+        return {"ok": True}
+
+    def _on_flush(self, req, sender):
+        self.indices.shard(req["index"], req["shard"]).engine.flush()
+        return {"ok": True}
+
+    def _on_forcemerge(self, req, sender):
+        self.indices.shard(req["index"], req["shard"]).engine.force_merge(
+            req.get("max_num_segments", 1))
+        return {"ok": True}
+
+    def broadcast(self, action: str, index_expression: str,
+                  on_done: Callable[[Dict[str, Any]], None],
+                  extra: Optional[Dict[str, Any]] = None) -> None:
+        state = self.state()
+        targets: List[ShardRouting] = []
+        names = ([n for n in state.metadata.indices]
+                 if index_expression in ("_all", "*", "", None)
+                 else [state.metadata.index(n.strip()).name
+                       for n in index_expression.split(",")])
+        for name in names:
+            if not state.routing_table.has_index(name):
+                continue
+            for sr in state.routing_table.index(name).all_shards():
+                if sr.active and sr.node_id is not None:
+                    targets.append(sr)
+        result = {"total": len(targets), "successful": 0, "failed": 0}
+        if not targets:
+            on_done({"_shards": result})
+            return
+        pending = {"n": len(targets)}
+
+        def one(sr: ShardRouting) -> None:
+            req = {"index": sr.index, "shard": sr.shard_id}
+            req.update(extra or {})
+
+            def cb(resp, err):
+                if err is None:
+                    result["successful"] += 1
+                else:
+                    result["failed"] += 1
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    on_done({"_shards": result})
+            self.ts.send_request(sr.node_id, action, req, cb, timeout=60.0)
+        for sr in targets:
+            one(sr)
+
+
+def _resize_replicas(irt: IndexRoutingTable, n_replicas: int
+                     ) -> IndexRoutingTable:
+    shards = {}
+    for sid, group in irt.shards.items():
+        primaries = [sr for sr in group if sr.primary]
+        replicas = [sr for sr in group if not sr.primary]
+        # keep assigned replicas first (drop surplus), add fresh unassigned
+        # slots for any shortfall
+        replicas.sort(key=lambda sr: not sr.assigned)
+        keep: List[ShardRouting] = list(primaries) + replicas[:n_replicas]
+        while len(keep) - len(primaries) < n_replicas:
+            keep.append(ShardRouting(index=irt.index, shard_id=sid,
+                                     primary=False))
+        shards[sid] = tuple(keep)
+    return IndexRoutingTable(index=irt.index, shards=shards)
+
+
+def cluster_health(state: ClusterState,
+                   index: Optional[str] = None) -> Dict[str, Any]:
+    """green: all copies active; yellow: all primaries active; red: some
+    primary inactive (ClusterHealthStatus semantics)."""
+    routing = state.routing_table
+    names = ([state.metadata.index(index).name] if index
+             else list(routing.indices))
+    active_primary = 0
+    active_total = 0
+    unassigned = 0
+    initializing = 0
+    relocating = 0
+    status = "green"
+    for name in names:
+        if not routing.has_index(name):
+            continue
+        for sr in routing.index(name).all_shards():
+            if sr.state == ShardState.UNASSIGNED:
+                unassigned += 1
+                status = "red" if sr.primary else (
+                    "yellow" if status == "green" else status)
+            elif sr.state == ShardState.INITIALIZING:
+                initializing += 1
+                status = "red" if sr.primary else (
+                    "yellow" if status == "green" else status)
+            else:
+                active_total += 1
+                if sr.primary:
+                    active_primary += 1
+                if sr.state == ShardState.RELOCATING:
+                    relocating += 1
+    return {
+        "cluster_name": state.cluster_name,
+        "status": status,
+        "number_of_nodes": len(state.nodes),
+        "number_of_data_nodes": len(state.data_nodes()),
+        "active_primary_shards": active_primary,
+        "active_shards": active_total,
+        "relocating_shards": relocating,
+        "initializing_shards": initializing,
+        "unassigned_shards": unassigned,
+        "timed_out": False,
+    }

@@ -1,0 +1,145 @@
+"""Bulk coordination: group items by shard, auto-create indices, fan out.
+
+Reference analog: action/bulk/TransportBulkAction.java:98 — auto-create
+missing indices through the master (:235), group items by
+``OperationRouting.generateShardId`` (murmur3, :415), fan each group to its
+primary via the shard bulk action, and reassemble responses in request
+order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.action.replication import TransportShardBulkAction
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.utils.murmur3 import shard_id_for
+
+
+CreateIndexFn = Callable[[str, Callable[[Optional[Exception]], None]], None]
+
+
+class TransportBulkAction:
+    def __init__(self, shard_bulk: TransportShardBulkAction,
+                 state_supplier: Callable[[], ClusterState],
+                 create_index: CreateIndexFn):
+        self.shard_bulk = shard_bulk
+        self.state = state_supplier
+        self.create_index = create_index
+
+    def execute(self, items: List[Dict[str, Any]],
+                on_done: Callable[[Dict[str, Any]], None]) -> None:
+        """items: [{action, index, id, source?, routing?, if_seq_no?, ...}]"""
+        state = self.state()
+        missing = sorted({item["index"] for item in items
+                          if not state.metadata.has_index(item["index"])})
+        pending = {"n": len(missing)}
+        if not missing:
+            self._run(items, on_done)
+            return
+
+        def created(err: Optional[Exception]) -> None:
+            # racing creates are fine: "already exists" is success here
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                self._run(items, on_done)
+
+        for name in missing:
+            self.create_index(name, created)
+
+    def _run(self, items: List[Dict[str, Any]],
+             on_done: Callable[[Dict[str, Any]], None]) -> None:
+        state = self.state()
+        groups: Dict[Tuple[str, int], List[Tuple[int, Dict[str, Any]]]] = {}
+        responses: List[Optional[Dict[str, Any]]] = [None] * len(items)
+        for pos, item in enumerate(items):
+            index = item["index"]
+            try:
+                meta = state.metadata.index(index)
+            except Exception as e:  # noqa: BLE001 — per-item failure
+                responses[pos] = _item_error(item, e)
+                continue
+            routing_key = item.get("routing") or item["id"]
+            shard = shard_id_for(routing_key, meta.number_of_shards)
+            groups.setdefault((meta.name, shard), []).append((pos, item))
+
+        pending = {"n": len(groups)}
+        if not groups:
+            on_done(_bulk_response(responses))
+            return
+
+        def group_done(key: Tuple[str, int],
+                       positions: List[int]) -> Callable:
+            def cb(resp: Optional[Dict[str, Any]],
+                   err: Optional[Exception]) -> None:
+                if err is not None:
+                    for pos in positions:
+                        responses[pos] = _item_error(items[pos], err)
+                else:
+                    for pos, result in zip(positions, resp["items"]):
+                        result = dict(result)
+                        result["_index"] = key[0]
+                        responses[pos] = result
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    on_done(_bulk_response(responses))
+            return cb
+
+        for key, group in groups.items():
+            positions = [pos for pos, _ in group]
+            group_items = [item for _, item in group]
+            self.shard_bulk.execute(key[0], key[1], group_items,
+                                    group_done(key, positions))
+
+
+def _item_error(item: Dict[str, Any], err: Exception) -> Dict[str, Any]:
+    status = getattr(err, "status", 500)
+    return {"action": item.get("action", "index"), "id": item.get("id"),
+            "_index": item.get("index"),
+            "error": {"type": type(err).__name__, "reason": str(err)},
+            "status": status}
+
+
+def _bulk_response(responses: List[Optional[Dict[str, Any]]]
+                   ) -> Dict[str, Any]:
+    items = []
+    errors = False
+    for r in responses:
+        r = r or {"error": {"type": "internal", "reason": "missing"},
+                  "status": 500}
+        action = r.pop("action", "index")
+        errors = errors or "error" in r
+        items.append({action: r})
+    return {"errors": errors, "items": items}
+
+
+def parse_bulk_body(lines: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """NDJSON action/source pairs -> normalized item dicts (the REST wire
+    form of _bulk, RestBulkAction)."""
+    items: List[Dict[str, Any]] = []
+    i = 0
+    n_auto = 0
+    while i < len(lines):
+        header = lines[i]
+        action = next(iter(header))
+        meta = header[action] or {}
+        item: Dict[str, Any] = {
+            "action": action,
+            "index": meta.get("_index"),
+            "id": meta.get("_id"),
+            "routing": meta.get("routing"),
+        }
+        if meta.get("if_seq_no") is not None:
+            item["if_seq_no"] = meta["if_seq_no"]
+        if meta.get("if_primary_term") is not None:
+            item["if_primary_term"] = meta["if_primary_term"]
+        if item["id"] is None:
+            import uuid as uuid_mod
+            item["id"] = uuid_mod.uuid4().hex[:20]
+            n_auto += 1
+        i += 1
+        if action in ("index", "create", "update"):
+            item["source"] = lines[i]
+            i += 1
+        items.append(item)
+    return items

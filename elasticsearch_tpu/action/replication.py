@@ -1,0 +1,275 @@
+"""Primary→replica replication for shard-level write batches.
+
+Reference analog: action/support/replication/TransportReplicationAction.java
+(ReroutePhase :625 — resolve the primary from cluster state and retry on
+stale routing; AsyncPrimaryAction :284) and ReplicationOperation.java:110 —
+execute on the primary, fan out concurrently to every assigned replica
+copy, ack the caller only when all copies respond (failed copies are
+reported to the master for removal, ShardStateAction analog). The primary's
+global checkpoint rides on every replica request, and replica local
+checkpoints ride back (GlobalCheckpointSyncAction piggyback).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.cluster.routing import ShardRouting, ShardState
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.index.shard import IndexShard
+from elasticsearch_tpu.indices.cluster_state_service import SHARD_FAILED
+from elasticsearch_tpu.indices.indices_service import IndicesService
+from elasticsearch_tpu.transport.scheduler import Scheduler
+from elasticsearch_tpu.transport.transport import Deferred, TransportService
+from elasticsearch_tpu.utils.errors import (
+    SearchEngineError, UnavailableShardsError, VersionConflictError,
+)
+
+SHARD_BULK_PRIMARY = "indices:data/write/bulk[s][p]"
+SHARD_BULK_REPLICA = "indices:data/write/bulk[s][r]"
+
+RETRY_DELAY = 0.2
+REROUTE_TIMEOUT = 30.0
+
+
+def _is_retryable(err: Any) -> bool:
+    """True only when the op provably did not execute on a current primary:
+    connection refused before delivery, or stale-routing rejections."""
+    from elasticsearch_tpu.transport.transport import NodeNotConnectedError
+    if isinstance(err, (NodeNotConnectedError, UnavailableShardsError)):
+        return True
+    text = str(err)
+    return ("UnavailableShardsError" in text
+            or "ShardNotFoundError" in text
+            or "IndexNotFoundError" in text)
+
+
+class TransportShardBulkAction:
+    """One shard's slice of a bulk request, executed with replication."""
+
+    def __init__(self, node_id: str, indices: IndicesService,
+                 ts: TransportService, scheduler: Scheduler,
+                 state_supplier: Callable[[], ClusterState]):
+        self.node_id = node_id
+        self.indices = indices
+        self.ts = ts
+        self.scheduler = scheduler
+        self.state = state_supplier
+        ts.register_handler(SHARD_BULK_PRIMARY, self._on_primary)
+        ts.register_handler(SHARD_BULK_REPLICA, self._on_replica)
+
+    # ------------------------------------------------------------------
+    # coordinator side: route to the primary, retrying on stale routing
+    # ------------------------------------------------------------------
+
+    def execute(self, index: str, shard_id: int, items: List[Dict[str, Any]],
+                on_done: Callable[[Optional[Dict[str, Any]],
+                                   Optional[Exception]], None]) -> None:
+        deadline = self.scheduler.now() + REROUTE_TIMEOUT
+
+        def attempt() -> None:
+            state = self.state()
+            try:
+                primary = state.routing_table.index(index).primary(shard_id)
+            except SearchEngineError as e:
+                retry_or_fail(e)
+                return
+            if not primary.active or primary.node_id is None:
+                retry_or_fail(UnavailableShardsError(
+                    f"primary shard [{index}][{shard_id}] is not active"))
+                return
+            self.ts.send_request(
+                primary.node_id, SHARD_BULK_PRIMARY,
+                {"index": index, "shard": shard_id, "items": items},
+                on_response, timeout=REROUTE_TIMEOUT)
+
+        def on_response(resp, err) -> None:
+            if err is not None and _is_retryable(err):
+                # stale routing (shard moved / promoted elsewhere) or the
+                # request provably never reached the primary: safe to retry
+                retry_or_fail(err)
+                return
+            if err is not None:
+                # timeouts/unknown remote errors are NOT retried: the
+                # primary may have applied the ops, and re-sending would
+                # duplicate writes (the reference surfaces these too)
+                on_done(None, err)
+                return
+            on_done(resp, None)
+
+        def retry_or_fail(err) -> None:
+            if self.scheduler.now() >= deadline:
+                on_done(None, err if isinstance(err, Exception)
+                        else UnavailableShardsError(str(err)))
+            else:
+                self.scheduler.schedule(RETRY_DELAY, attempt)
+
+        attempt()
+
+    # ------------------------------------------------------------------
+    # primary side
+    # ------------------------------------------------------------------
+
+    def _on_primary(self, req: Dict[str, Any], sender: str) -> Deferred:
+        index, shard_id = req["index"], req["shard"]
+        shard = self.indices.shard(index, shard_id)
+        if not shard.primary:
+            raise UnavailableShardsError(
+                f"shard [{index}][{shard_id}] on [{self.node_id}] "
+                f"is not the primary")
+        results: List[Dict[str, Any]] = []
+        ops: List[Dict[str, Any]] = []
+        for item in req["items"]:
+            results.append(self._execute_item(shard, item, ops))
+
+        deferred = Deferred()
+        state = self.state()
+        replicas = [
+            sr for sr in
+            state.routing_table.index(index).shard_group(shard_id)
+            if not sr.primary and sr.assigned and sr.node_id != self.node_id
+            and sr.state in (ShardState.INITIALIZING, ShardState.STARTED,
+                             ShardState.RELOCATING)]
+        pending = {"n": len(replicas)}
+        if not ops or not replicas:
+            deferred.resolve(self._primary_response(shard, results))
+            return deferred
+
+        payload = {"index": index, "shard": shard_id, "ops": ops,
+                   "global_checkpoint": shard.global_checkpoint,
+                   "primary_term": shard.primary_term}
+
+        def one_done() -> None:
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                deferred.resolve(self._primary_response(shard, results))
+
+        for replica in replicas:
+            def on_ack(resp, err, sr: ShardRouting = replica) -> None:
+                if err is not None:
+                    # replica could not apply acknowledged writes: it must
+                    # leave the in-sync set before we ack the client
+                    self._fail_replica(sr, str(err), one_done)
+                    return
+                if shard.tracker is not None and sr.allocation_id:
+                    shard.tracker.update_local_checkpoint(
+                        sr.allocation_id, resp.get("local_checkpoint", -1))
+                one_done()
+            self.ts.send_request(replica.node_id, SHARD_BULK_REPLICA,
+                                 payload, on_ack, timeout=30.0)
+        return deferred
+
+    def _execute_item(self, shard: IndexShard, item: Dict[str, Any],
+                      ops: List[Dict[str, Any]]) -> Dict[str, Any]:
+        action = item["action"]
+        try:
+            if action in ("index", "create"):
+                result = shard.apply_index_on_primary(
+                    item["id"], item["source"], routing=item.get("routing"),
+                    op_type="create" if action == "create" else "index",
+                    if_seq_no=item.get("if_seq_no"),
+                    if_primary_term=item.get("if_primary_term"))
+                ops.append(IndexShard.replicated_op(
+                    result, "index", source=item["source"],
+                    routing=item.get("routing")))
+            elif action == "update":
+                # primary-side get+merge+index (UpdateHelper analog): safe
+                # against concurrent writers because the whole item runs
+                # inside the primary's handler dispatch
+                body = item.get("source") or {}
+                current = shard.engine.get(item["id"], realtime=True)
+                if current is None:
+                    if "upsert" in body:
+                        new_source = dict(body["upsert"])
+                    elif body.get("doc_as_upsert") and "doc" in body:
+                        new_source = dict(body["doc"])
+                    else:
+                        from elasticsearch_tpu.utils.errors import (
+                            DocumentMissingError,
+                        )
+                        raise DocumentMissingError(
+                            f"[{item['id']}]: document missing")
+                else:
+                    new_source = dict(current["_source"])
+                    if "doc" in body:
+                        _deep_merge(new_source, body["doc"])
+                    elif "script" in body:
+                        from elasticsearch_tpu.script.engine import (
+                            execute_update_script,
+                        )
+                        merged = execute_update_script(new_source,
+                                                       body["script"])
+                        if merged is None:    # ctx.op = 'delete'
+                            result = shard.apply_delete_on_primary(item["id"])
+                            ops.append(IndexShard.replicated_op(
+                                result, "delete"))
+                            return {"action": action, "id": result.doc_id,
+                                    "result": "deleted",
+                                    "_seq_no": result.seqno,
+                                    "_primary_term": result.primary_term,
+                                    "_version": result.version,
+                                    "status": 200}
+                        new_source = merged
+                result = shard.apply_index_on_primary(
+                    item["id"], new_source, routing=item.get("routing"))
+                ops.append(IndexShard.replicated_op(
+                    result, "index", source=new_source,
+                    routing=item.get("routing")))
+            elif action == "delete":
+                result = shard.apply_delete_on_primary(
+                    item["id"],
+                    if_seq_no=item.get("if_seq_no"),
+                    if_primary_term=item.get("if_primary_term"))
+                ops.append(IndexShard.replicated_op(result, "delete"))
+            else:
+                raise ValueError(f"unknown bulk action [{action}]")
+        except VersionConflictError as e:
+            return {"action": action, "id": item.get("id"), "error": {
+                "type": "version_conflict_engine_exception",
+                "reason": str(e)}, "status": 409}
+        except Exception as e:  # noqa: BLE001 — per-item failure, not fatal
+            return {"action": action, "id": item.get("id"), "error": {
+                "type": type(e).__name__, "reason": str(e)}, "status": 400}
+        return {"action": action, "id": result.doc_id,
+                "result": result.result, "_seq_no": result.seqno,
+                "_primary_term": result.primary_term,
+                "_version": result.version,
+                "status": 201 if result.result == "created" else 200}
+
+    @staticmethod
+    def _primary_response(shard: IndexShard,
+                          results: List[Dict[str, Any]]) -> Dict[str, Any]:
+        return {"items": results,
+                "global_checkpoint": shard.global_checkpoint,
+                "local_checkpoint": shard.local_checkpoint}
+
+    def _fail_replica(self, sr: ShardRouting, reason: str,
+                      done: Callable[[], None]) -> None:
+        state = self.state()
+        master = state.master_node_id
+        if master is None:
+            done()
+            return
+        self.ts.send_request(master, SHARD_FAILED,
+                             {"shard": sr.to_dict(),
+                              "reason": f"replication failed: {reason}"},
+                             lambda r, e: done(), timeout=30.0)
+
+    # ------------------------------------------------------------------
+    # replica side
+    # ------------------------------------------------------------------
+
+    def _on_replica(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
+        shard = self.indices.shard(req["index"], req["shard"])
+        for op in req["ops"]:
+            shard.apply_op_on_replica(op)
+        shard.update_global_checkpoint_on_replica(req["global_checkpoint"])
+        return {"local_checkpoint": shard.local_checkpoint}
+
+
+def _deep_merge(into: Dict[str, Any], other: Dict[str, Any]) -> None:
+    for k, v in other.items():
+        if isinstance(v, dict) and isinstance(into.get(k), dict):
+            _deep_merge(into[k], v)
+        else:
+            into[k] = v

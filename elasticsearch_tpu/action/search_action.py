@@ -1,0 +1,487 @@
+"""Distributed search: scatter-gather coordination over shard copies.
+
+Reference analogs: action/search/TransportSearchAction.java:88 (resolve
+indices → shard iterators → async phases), AbstractSearchAsyncAction.java:68
+(fan-out with per-shard failure accounting), CanMatchPreFilterSearchPhase.java:57
+(cheap pre-filter skipping non-matching shards), SearchPhaseController.java:160
+(k-way merge of per-shard top docs), DfsPhase.java:43 (global term stats),
+FetchSearchPhase (doc fetch from winning shards only), and the per-phase wire
+actions of SearchTransportService.java:72-79. Reader contexts pin a
+point-in-time view between query and fetch (SearchService contexts :203).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+import uuid as uuid_mod
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.index.engine import Reader
+from elasticsearch_tpu.indices.indices_service import IndicesService
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.fetch import fetch_hits
+from elasticsearch_tpu.search.phase import (
+    ShardDoc, collect_query_terms, parse_sort, query_shard, shard_term_stats,
+)
+from elasticsearch_tpu.transport.transport import TransportService
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError, IndexNotFoundError, SearchEngineError,
+)
+
+SEARCH_CAN_MATCH = "indices:data/read/search[can_match]"
+SEARCH_DFS = "indices:data/read/search[phase/dfs]"
+SEARCH_QUERY = "indices:data/read/search[phase/query]"
+SEARCH_FETCH = "indices:data/read/search[phase/fetch]"
+
+CONTEXT_KEEP_ALIVE = 60.0
+
+DoneFn = Callable[[Optional[Dict[str, Any]], Optional[Exception]], None]
+
+
+class SearchTransportService:
+    """Data-node side: executes the per-shard search phases."""
+
+    def __init__(self, node_id: str, indices: IndicesService,
+                 ts: TransportService):
+        self.node_id = node_id
+        self.indices = indices
+        self.ts = ts
+        self._contexts: Dict[str, Tuple[Reader, float]] = {}
+        ts.register_handler(SEARCH_CAN_MATCH, self._on_can_match)
+        ts.register_handler(SEARCH_DFS, self._on_dfs)
+        ts.register_handler(SEARCH_QUERY, self._on_query)
+        ts.register_handler(SEARCH_FETCH, self._on_fetch)
+
+    def _now(self) -> float:
+        # scheduler time, so virtual-time simulations reap deterministically
+        return self.ts.transport.scheduler.now()
+
+    def _reap(self) -> None:
+        now = self._now()
+        for cid in [c for c, (_, exp) in self._contexts.items() if exp < now]:
+            del self._contexts[cid]
+
+    # ------------------------------------------------------------------
+
+    def _on_can_match(self, req: Dict[str, Any], sender: str
+                      ) -> Dict[str, Any]:
+        shard = self.indices.shard(req["index"], req["shard"])
+        query = dsl.parse_query(req.get("body", {}).get("query"))
+        if not collect_query_terms(query):
+            return {"can_match": True}
+        reader = shard.engine.acquire_reader()
+        # a shard can produce hits only if at least one (analyzed) query
+        # term exists in its term dictionaries — df aggregation gives us
+        # exactly that, cheaply (no scoring)
+        _, dfs = shard_term_stats(reader, shard.engine.mappers, query)
+        can = any(df > 0 for termmap in dfs.values()
+                  for df in termmap.values())
+        # buffered docs aren't searchable; a refresh may change the answer,
+        # but false negatives are impossible for *searchable* data
+        return {"can_match": bool(can)}
+
+    def _on_dfs(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
+        shard = self.indices.shard(req["index"], req["shard"])
+        reader = shard.engine.acquire_reader()
+        query = dsl.parse_query(req.get("body", {}).get("query"))
+        doc_count, dfs = shard_term_stats(reader, shard.engine.mappers,
+                                          query)
+        return {"doc_count": doc_count, "dfs": dfs}
+
+    def _on_query(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
+        self._reap()
+        shard = self.indices.shard(req["index"], req["shard"])
+        body = req.get("body", {})
+        reader = shard.engine.acquire_reader()
+        query = dsl.parse_query(body.get("query"))
+        sort = parse_sort(body.get("sort"))
+        result = query_shard(
+            reader, shard.engine.mappers, query,
+            size=req["window"], from_=0, sort=sort,
+            search_after=body.get("search_after"),
+            track_total_hits=body.get("track_total_hits", 10_000),
+            min_score=body.get("min_score"),
+            doc_count_override=req.get("doc_count_override"),
+            df_overrides=req.get("df_overrides"))
+        context_id = None
+        if req["window"] > 0:
+            # size=0 (count) searches never fetch: don't pin a reader
+            context_id = uuid_mod.uuid4().hex
+            self._contexts[context_id] = (reader,
+                                          self._now() + CONTEXT_KEEP_ALIVE)
+        return {
+            "context_id": context_id,
+            "total": result.total_hits,
+            "relation": result.total_relation,
+            "max_score": result.max_score,
+            "docs": [{"segment": d.segment_idx, "doc": d.doc,
+                      "score": d.score, "sort": list(d.sort_values)}
+                     for d in result.docs],
+        }
+
+    def _on_fetch(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
+        self._reap()
+        # fetch is the context's last use: release it (the reference frees
+        # query contexts once the fetch phase completes)
+        entry = self._contexts.pop(req["context_id"], None)
+        if entry is not None:
+            reader = entry[0]
+        else:
+            # context expired: re-acquire (results may shift post-merge;
+            # the reference fails the request — we degrade gracefully)
+            shard_obj = self.indices.shard(req["index"], req["shard"])
+            reader = shard_obj.engine.acquire_reader()
+        shard = self.indices.shard(req["index"], req["shard"])
+        body = req.get("body", {})
+        docs = [ShardDoc(d["segment"], d["doc"], d["score"],
+                         tuple(d.get("sort", ())))
+                for d in req["docs"]]
+        query = dsl.parse_query(body.get("query"))
+        hits = fetch_hits(
+            reader, shard.engine.mappers, docs, req["index"],
+            query=query,
+            source_filter=body.get("_source", True),
+            docvalue_fields=body.get("docvalue_fields"),
+            highlight=body.get("highlight"),
+            include_sort=body.get("sort") is not None
+            or body.get("search_after") is not None,
+            seq_no_primary_term=bool(body.get("seq_no_primary_term")),
+            include_version=bool(body.get("version")),
+        )
+        # script fields run host-side per fetched doc (FieldScript context)
+        script_fields = body.get("script_fields")
+        if script_fields:
+            from elasticsearch_tpu.script.engine import execute_field_script
+            for hit, doc in zip(hits, docs):
+                fields = hit.setdefault("fields", {})
+                for fname, spec in script_fields.items():
+                    src = hit.get("_source") or {}
+                    value = execute_field_script(
+                        spec.get("script", spec), src, src)
+                    fields[fname] = [value]
+        return {"hits": hits}
+
+
+class TransportSearchAction:
+    """Coordinating-node side: resolve → (can_match) → (dfs) → query →
+    merge → fetch → respond."""
+
+    def __init__(self, node_id: str, ts: TransportService,
+                 state_supplier: Callable[[], ClusterState]):
+        self.node_id = node_id
+        self.ts = ts
+        self.state = state_supplier
+        self._rr = 0
+
+    # ------------------------------------------------------------------
+    # index/shard resolution
+    # ------------------------------------------------------------------
+
+    def _resolve_indices(self, expression: str,
+                         state: ClusterState) -> List[str]:
+        """Comma lists, `*` wildcards, `_all`, aliases
+        (IndexNameExpressionResolver analog)."""
+        names = set()
+        metadata = state.metadata
+        all_names = list(metadata.indices)
+        alias_map: Dict[str, List[str]] = {}
+        for im in metadata.indices.values():
+            for alias in im.aliases:
+                alias_map.setdefault(alias, []).append(im.name)
+        for part in (expression or "_all").split(","):
+            part = part.strip()
+            if part in ("_all", "*", ""):
+                names.update(all_names)
+            elif "*" in part:
+                import fnmatch
+                matched = [n for n in all_names if fnmatch.fnmatch(n, part)]
+                matched += [n for a, targets in alias_map.items()
+                            if fnmatch.fnmatch(a, part) for n in targets]
+                names.update(matched)
+            elif part in metadata.indices:
+                names.add(part)
+            elif part in alias_map:
+                names.update(alias_map[part])
+            else:
+                raise IndexNotFoundError(f"no such index [{part}]")
+        return sorted(names)
+
+    def _shard_targets(self, indices: List[str], state: ClusterState
+                       ) -> List[Dict[str, Any]]:
+        """One target per shard with an ordered list of copies to try —
+        the shard iterator (GroupShardsIterator): a failed copy fails over
+        to the next (AbstractSearchAsyncAction.onShardFailure)."""
+        targets = []
+        for index in indices:
+            if not state.routing_table.has_index(index):
+                continue
+            irt = state.routing_table.index(index)
+            for sid in sorted(irt.shards):
+                copies = [sr.node_id for sr in irt.shard_group(sid)
+                          if sr.active and sr.node_id is not None]
+                if not copies:
+                    raise SearchEngineError(
+                        f"no active copy for [{index}][{sid}]")
+                self._rr += 1
+                rot = self._rr % len(copies)
+                copies = copies[rot:] + copies[:rot]
+                targets.append({"index": index, "shard": sid,
+                                "node": copies[0], "copies": copies})
+        return targets
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+
+    def execute(self, index_expression: str, body: Dict[str, Any],
+                on_done: DoneFn, search_type: str = "query_then_fetch"
+                ) -> None:
+        t0 = time.monotonic()
+        state = self.state()
+        body = body or {}
+        try:
+            indices = self._resolve_indices(index_expression, state)
+            targets = self._shard_targets(indices, state)
+        except SearchEngineError as e:
+            on_done(None, e)
+            return
+        if not targets:
+            on_done(self._empty_response(t0, 0), None)
+            return
+
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        window = size + from_
+
+        phase_state = {
+            "skipped": 0, "failed": 0,
+            "failures": [],
+        }
+
+        def after_can_match(live_targets: List[Dict[str, Any]]) -> None:
+            if not live_targets:
+                on_done(self._finalize(t0, [], body, phase_state,
+                                       len(targets), total=0,
+                                       relation="eq", max_score=None,
+                                       hits=[]), None)
+                return
+            if search_type == "dfs_query_then_fetch":
+                self._dfs_phase(live_targets, body,
+                                lambda overrides: self._query_phase(
+                                    t0, live_targets, body, window, from_,
+                                    size, phase_state, len(targets), on_done,
+                                    overrides))
+            else:
+                self._query_phase(t0, live_targets, body, window, from_,
+                                  size, phase_state, len(targets), on_done,
+                                  None)
+
+        self._can_match_phase(targets, body, phase_state, after_can_match)
+
+    # -- can_match ------------------------------------------------------
+
+    def _can_match_phase(self, targets, body, phase_state, next_phase):
+        query = body.get("query")
+        has_terms = False
+        if query is not None:
+            try:
+                has_terms = bool(collect_query_terms(dsl.parse_query(query)))
+            except SearchEngineError:
+                has_terms = False
+        if len(targets) <= 1 or not has_terms:
+            next_phase(targets)
+            return
+        live: List[Dict[str, Any]] = []
+        pending = {"n": len(targets)}
+
+        def one(target):
+            def cb(resp, err):
+                if err is None and resp is not None and resp["can_match"]:
+                    live.append(target)
+                elif err is not None:
+                    live.append(target)   # fail open: let query phase decide
+                else:
+                    phase_state["skipped"] += 1
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    live.sort(key=lambda t: (t["index"], t["shard"]))
+                    next_phase(live)
+            self.ts.send_request(target["node"], SEARCH_CAN_MATCH,
+                                 {"index": target["index"],
+                                  "shard": target["shard"], "body": body},
+                                 cb, timeout=10.0)
+        for target in targets:
+            one(target)
+
+    # -- dfs ------------------------------------------------------------
+
+    def _dfs_phase(self, targets, body, next_phase):
+        doc_count = {"n": 0}
+        dfs: Dict[str, Dict[str, int]] = {}
+        pending = {"n": len(targets)}
+
+        def one(target):
+            def cb(resp, err):
+                if err is None and resp is not None:
+                    doc_count["n"] += resp["doc_count"]
+                    for field, termmap in resp["dfs"].items():
+                        agg = dfs.setdefault(field, {})
+                        for term, df in termmap.items():
+                            agg[term] = agg.get(term, 0) + df
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    next_phase({"doc_count_override": doc_count["n"],
+                                "df_overrides": dfs})
+            self.ts.send_request(target["node"], SEARCH_DFS,
+                                 {"index": target["index"],
+                                  "shard": target["shard"], "body": body},
+                                 cb, timeout=30.0)
+        for target in targets:
+            one(target)
+
+    # -- query ----------------------------------------------------------
+
+    def _query_phase(self, t0, targets, body, window, from_, size,
+                     phase_state, n_total_shards, on_done, dfs_overrides):
+        results: List[Optional[Dict[str, Any]]] = [None] * len(targets)
+        pending = {"n": len(targets)}
+
+        def one(i: int, target, copy_idx: int = 0) -> None:
+            req = {"index": target["index"], "shard": target["shard"],
+                   "body": body, "window": window}
+            if dfs_overrides:
+                req.update(dfs_overrides)
+            copies = target.get("copies", [target["node"]])
+            node = copies[copy_idx]
+
+            def cb(resp, err):
+                if err is not None:
+                    if copy_idx + 1 < len(copies):
+                        # fail over to the next copy of this shard
+                        one(i, target, copy_idx + 1)
+                        return
+                    phase_state["failed"] += 1
+                    phase_state["failures"].append({
+                        "shard": target["shard"], "index": target["index"],
+                        "reason": str(err)})
+                else:
+                    target["node"] = node   # fetch goes where query ran
+                    results[i] = resp
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    self._merge_and_fetch(t0, targets, results, body, from_,
+                                          size, phase_state, n_total_shards,
+                                          on_done)
+            self.ts.send_request(node, SEARCH_QUERY, req, cb, timeout=60.0)
+        for i, target in enumerate(targets):
+            one(i, target)
+
+    # -- merge + fetch ---------------------------------------------------
+
+    def _merge_and_fetch(self, t0, targets, results, body, from_, size,
+                         phase_state, n_total_shards, on_done):
+        sort_specified = body.get("sort") is not None
+        total = 0
+        relation = "eq"
+        max_score: Optional[float] = None
+        entries: List[Tuple[int, Dict[str, Any]]] = []  # (target_idx, doc)
+        for i, result in enumerate(results):
+            if result is None:
+                continue
+            total += result["total"]
+            if result["relation"] == "gte":
+                relation = "gte"
+            if result["max_score"] is not None:
+                max_score = (result["max_score"] if max_score is None
+                             else max(max_score, result["max_score"]))
+            for doc in result["docs"]:
+                entries.append((i, doc))
+
+        if sort_specified:
+            from elasticsearch_tpu.search.phase import _cmp_values
+            sort_specs = parse_sort(body.get("sort"))
+
+            def cmp(a, b):
+                for pos, spec in enumerate(sort_specs):
+                    c = _cmp_values(a[1]["sort"][pos], b[1]["sort"][pos],
+                                    spec.order == "desc")
+                    if c:
+                        return c
+                return (a[0] - b[0]) or (a[1]["doc"] - b[1]["doc"])
+            entries.sort(key=functools.cmp_to_key(cmp))
+        else:
+            entries.sort(key=lambda e: (-e[1]["score"], e[0],
+                                        e[1]["segment"], e[1]["doc"]))
+
+        winners = entries[from_:from_ + size]
+        if not winners:
+            on_done(self._finalize(t0, targets, body, phase_state,
+                                   n_total_shards, total, relation,
+                                   max_score, []), None)
+            return
+
+        # group winners per shard for fetch
+        by_target: Dict[int, List[Tuple[int, Dict[str, Any]]]] = {}
+        for order, (tidx, doc) in enumerate(winners):
+            by_target.setdefault(tidx, []).append((order, doc))
+
+        hits_out: List[Optional[Dict[str, Any]]] = [None] * len(winners)
+        pending = {"n": len(by_target)}
+
+        def one(tidx: int, docs: List[Tuple[int, Dict[str, Any]]]) -> None:
+            target = targets[tidx]
+            req = {"index": target["index"], "shard": target["shard"],
+                   "context_id": results[tidx]["context_id"],
+                   "docs": [d for _, d in docs], "body": body}
+
+            def cb(resp, err):
+                if err is None and resp is not None:
+                    for (order, _), hit in zip(docs, resp["hits"]):
+                        hits_out[order] = hit
+                else:
+                    phase_state["failed"] += 1
+                    phase_state["failures"].append({
+                        "shard": target["shard"], "index": target["index"],
+                        "reason": f"fetch: {err}"})
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    hits = [h for h in hits_out if h is not None]
+                    on_done(self._finalize(t0, targets, body, phase_state,
+                                           n_total_shards, total, relation,
+                                           max_score, hits), None)
+            self.ts.send_request(target["node"], SEARCH_FETCH, req, cb,
+                                 timeout=60.0)
+        for tidx, docs in by_target.items():
+            one(tidx, docs)
+
+    # -- response --------------------------------------------------------
+
+    def _finalize(self, t0, targets, body, phase_state, n_total_shards,
+                  total, relation, max_score, hits) -> Dict[str, Any]:
+        successful = n_total_shards - phase_state["failed"] \
+            - phase_state["skipped"]
+        resp = {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": n_total_shards,
+                        "successful": max(successful, 0),
+                        "skipped": phase_state["skipped"],
+                        "failed": phase_state["failed"]},
+            "hits": {"total": {"value": total, "relation": relation},
+                     "max_score": max_score, "hits": hits},
+        }
+        if phase_state["failures"]:
+            resp["_shards"]["failures"] = phase_state["failures"]
+        return resp
+
+    def _empty_response(self, t0, n_shards) -> Dict[str, Any]:
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": n_shards, "successful": n_shards,
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": {"value": 0, "relation": "eq"},
+                     "max_score": None, "hits": []},
+        }

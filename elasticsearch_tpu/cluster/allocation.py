@@ -173,7 +173,13 @@ class AllocationService:
         if current is None:
             return state
         irt = irt.replace_shard(current, current.fail())
+        metadata = state.metadata
         if current.primary:
+            # every primary failure bumps the shard's primary term so stale
+            # primaries can be fenced (IndexMetadata primaryTerms semantics)
+            metadata = metadata.update_index(
+                metadata.index(failed.index)
+                .with_primary_term_bump(failed.shard_id))
             replicas = [sr for sr in irt.shard_group(failed.shard_id)
                         if not sr.primary and sr.active]
             if replicas:
@@ -186,7 +192,8 @@ class AllocationService:
                                           shard_id=failed.shard_id,
                                           primary=False))
         routing = routing.put_index(irt)
-        return self.reroute(state.next_version(routing_table=routing))
+        return self.reroute(state.next_version(routing_table=routing,
+                                               metadata=metadata))
 
     def disassociate_dead_nodes(self, state: ClusterState,
                                 dead: Iterable[str]) -> ClusterState:

@@ -27,12 +27,27 @@ class IndexMetadata:
     mappings: Mapping[str, Any] = field(default_factory=dict)
     settings: Mapping[str, Any] = field(default_factory=dict)
     aliases: Tuple[str, ...] = ()
+    # per-shard primary term, bumped on every primary failover
+    # (IndexMetadata.java primaryTerms[]; carried by every replicated op)
+    primary_terms: Tuple[int, ...] = ()
 
     def __post_init__(self) -> None:
         if self.number_of_shards < 1:
             raise IllegalArgumentError("number_of_shards must be >= 1")
         if self.number_of_replicas < 0:
             raise IllegalArgumentError("number_of_replicas must be >= 0")
+        if not self.primary_terms:
+            object.__setattr__(self, "primary_terms",
+                               tuple([1] * self.number_of_shards))
+
+    def primary_term(self, shard: int) -> int:
+        return self.primary_terms[shard]
+
+    def with_primary_term_bump(self, shard: int) -> "IndexMetadata":
+        terms = list(self.primary_terms)
+        terms[shard] += 1
+        return replace(self, primary_terms=tuple(terms),
+                       version=self.version + 1)
 
     @staticmethod
     def create(name: str, number_of_shards: int = 1,
@@ -66,6 +81,7 @@ class IndexMetadata:
             "version": self.version, "state": self.state,
             "mappings": dict(self.mappings), "settings": dict(self.settings),
             "aliases": list(self.aliases),
+            "primary_terms": list(self.primary_terms),
         }
 
     @staticmethod
@@ -77,7 +93,8 @@ class IndexMetadata:
             version=d.get("version", 1), state=d.get("state", "open"),
             mappings=dict(d.get("mappings", {})),
             settings=dict(d.get("settings", {})),
-            aliases=tuple(d.get("aliases", ())))
+            aliases=tuple(d.get("aliases", ())),
+            primary_terms=tuple(d.get("primary_terms", ())))
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,178 @@
+"""IndexShard: the per-shard facade over engine + replication tracking.
+
+Reference analog: index/shard/IndexShard.java — the write entry points
+``applyIndexOperationOnPrimary`` (:747) vs ``applyIndexOperationOnReplica``
+(:756), primary-term checks, and the shard's ReplicationTracker ownership
+(primary mode). Search goes through the shard's SearchService the way the
+reference acquires searchers through the shard's engine.
+"""
+
+from __future__ import annotations
+
+import uuid as uuid_mod
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.index.engine import EngineResult, InternalEngine
+from elasticsearch_tpu.index.seqno import ReplicationTracker
+from elasticsearch_tpu.index.store import Store
+from elasticsearch_tpu.index.translog import Translog
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+class ShardId:
+    __slots__ = ("index", "shard")
+
+    def __init__(self, index: str, shard: int) -> None:
+        self.index = index
+        self.shard = shard
+
+    def __eq__(self, other: object) -> bool:
+        return (isinstance(other, ShardId) and other.index == self.index
+                and other.shard == self.shard)
+
+    def __hash__(self) -> int:
+        return hash((self.index, self.shard))
+
+    def __repr__(self) -> str:
+        return f"[{self.index}][{self.shard}]"
+
+
+class IndexShard:
+    """One shard copy living on one node.
+
+    primary=True copies own a ReplicationTracker (primary mode,
+    ReplicationTracker.java:80); replicas only track their local checkpoint
+    and learn the global checkpoint from the primary's piggyback.
+    """
+
+    def __init__(self, shard_id: ShardId, mapper_service: MapperService,
+                 primary: bool, primary_term: int = 1,
+                 allocation_id: Optional[str] = None,
+                 store: Optional[Store] = None,
+                 translog: Optional[Translog] = None):
+        self.shard_id = shard_id
+        self.primary = primary
+        self.primary_term = primary_term
+        self.allocation_id = allocation_id or uuid_mod.uuid4().hex
+        self.engine = InternalEngine(
+            mapper_service, store=store, translog=translog,
+            primary_term=primary_term,
+            shard_label=f"{shard_id.index}_{shard_id.shard}")
+        self.search = SearchService(self.engine, index_name=shard_id.index)
+        self.tracker: Optional[ReplicationTracker] = None
+        if primary:
+            self._enter_primary_mode()
+        self._global_checkpoint_replica = -1
+
+    def _enter_primary_mode(self) -> None:
+        self.primary = True
+        self.tracker = ReplicationTracker(self.allocation_id,
+                                          self.engine.tracker)
+
+    # ------------------------------------------------------------------
+    # write path
+    # ------------------------------------------------------------------
+
+    def apply_index_on_primary(self, doc_id: str, source: Dict[str, Any],
+                               **kw: Any) -> EngineResult:
+        assert self.primary, f"{self.shard_id} is not a primary"
+        return self.engine.index(doc_id, source, **kw)
+
+    def apply_delete_on_primary(self, doc_id: str, **kw: Any) -> EngineResult:
+        assert self.primary, f"{self.shard_id} is not a primary"
+        return self.engine.delete(doc_id, **kw)
+
+    def apply_op_on_replica(self, op: Dict[str, Any]) -> EngineResult:
+        """Apply a primary-assigned operation. op is the replicated wire
+        form: {op_type, doc_id, source?, routing?, seqno, version,
+        primary_term}."""
+        if op["primary_term"] < self.primary_term:
+            raise IllegalArgumentError(
+                f"op primary term [{op['primary_term']}] is below the shard's "
+                f"[{self.primary_term}]")
+        self.primary_term = max(self.primary_term, op["primary_term"])
+        self.engine.primary_term = self.primary_term
+        if op["op_type"] == "index":
+            return self.engine.index(
+                op["doc_id"], op["source"], routing=op.get("routing"),
+                seqno=op["seqno"], version=op["version"],
+                primary_term=op["primary_term"])
+        if op["op_type"] == "delete":
+            return self.engine.delete(
+                op["doc_id"], seqno=op["seqno"], version=op["version"],
+                primary_term=op["primary_term"])
+        if op["op_type"] == "noop":
+            self.engine.noop(op["seqno"])
+            return EngineResult(op.get("doc_id", ""), op["seqno"],
+                                op["primary_term"], 0, "noop")
+        raise IllegalArgumentError(f"unknown op_type [{op['op_type']}]")
+
+    @staticmethod
+    def replicated_op(result: EngineResult, op_type: str,
+                      source: Optional[Dict[str, Any]] = None,
+                      routing: Optional[str] = None) -> Dict[str, Any]:
+        """Wire form of a completed primary op for replica fan-out."""
+        op: Dict[str, Any] = {
+            "op_type": op_type, "doc_id": result.doc_id,
+            "seqno": result.seqno, "version": result.version,
+            "primary_term": result.primary_term,
+        }
+        if op_type == "index":
+            op["source"] = source
+            op["routing"] = routing
+        return op
+
+    # ------------------------------------------------------------------
+    # checkpoints
+    # ------------------------------------------------------------------
+
+    @property
+    def local_checkpoint(self) -> int:
+        return self.engine.tracker.checkpoint
+
+    @property
+    def max_seqno(self) -> int:
+        return self.engine.tracker.max_seqno
+
+    @property
+    def global_checkpoint(self) -> int:
+        if self.tracker is not None:
+            return self.tracker.global_checkpoint
+        return self._global_checkpoint_replica
+
+    def update_global_checkpoint_on_replica(self, checkpoint: int) -> None:
+        if checkpoint > self._global_checkpoint_replica:
+            self._global_checkpoint_replica = checkpoint
+
+    # ------------------------------------------------------------------
+    # failover
+    # ------------------------------------------------------------------
+
+    def promote_to_primary(self, new_primary_term: int) -> None:
+        """Replica → primary on failover. Bumps the primary term and fills
+        seqno holes with no-ops so the checkpoint can advance
+        (IndexShard's primary-replica resync analog)."""
+        self.primary_term = new_primary_term
+        self.engine.primary_term = new_primary_term
+        self._enter_primary_mode()
+        tracker = self.engine.tracker
+        for seqno in range(tracker.checkpoint + 1, tracker.max_seqno + 1):
+            self.engine.noop(seqno, reason="primary promotion hole fill")
+
+    # ------------------------------------------------------------------
+
+    def doc_stats(self) -> Dict[str, Any]:
+        stats = self.engine.stats()
+        stats.update({
+            "shard": repr(self.shard_id),
+            "primary": self.primary,
+            "primary_term": self.primary_term,
+            "allocation_id": self.allocation_id,
+            "global_checkpoint": self.global_checkpoint,
+        })
+        return stats
+
+    def close(self) -> None:
+        self.engine.close()

@@ -1,0 +1,4 @@
+from elasticsearch_tpu.indices.indices_service import IndexService, IndicesService
+from elasticsearch_tpu.indices.cluster_state_service import IndicesClusterStateService
+
+__all__ = ["IndexService", "IndicesService", "IndicesClusterStateService"]

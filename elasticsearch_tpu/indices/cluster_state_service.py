@@ -1,0 +1,214 @@
+"""The reconciler: diff committed cluster state against local shards.
+
+Reference analog: indices/cluster/IndicesClusterStateService.java:210
+(applyClusterState) — on every committed state, each node creates shards
+newly routed to it, removes shards routed away or deleted, starts peer
+recoveries for initializing replicas, and reports shard-started /
+shard-failed back to the master (ShardStateAction analog). Peer recovery
+follows indices/recovery/RecoverySourceHandler.java:144's shape collapsed
+to one round-trip: snapshot of live ops (phase1+phase2 merged — segments
+here are already op-shaped), then mark-in-sync on the source.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from elasticsearch_tpu.cluster.routing import ShardRouting, ShardState
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.indices.indices_service import IndicesService
+from elasticsearch_tpu.transport.transport import TransportService
+
+SHARD_STARTED = "cluster/shard_started"
+SHARD_FAILED = "cluster/shard_failed"
+RECOVERY_START = "indices/recovery/start"
+
+
+class IndicesClusterStateService:
+    def __init__(self, node_id: str, indices_service: IndicesService,
+                 transport_service: TransportService):
+        self.node_id = node_id
+        self.indices = indices_service
+        self.ts = transport_service
+        self.last_applied: Optional[ClusterState] = None
+        # shards this node is currently recovering (avoid double-starting)
+        self._recovering: set = set()
+        self.ts.register_handler(RECOVERY_START, self._on_recovery_start)
+
+    # ------------------------------------------------------------------
+    # apply
+    # ------------------------------------------------------------------
+
+    def apply_cluster_state(self, state: ClusterState) -> None:
+        self.last_applied = state
+        self._remove_stale_local_shards(state)
+        self._update_index_metadata(state)
+        self._create_or_recover_shards(state)
+
+    def _remove_stale_local_shards(self, state: ClusterState) -> None:
+        for index_name in list(self.indices.indices):
+            if not state.metadata.has_index(index_name):
+                # index deleted cluster-wide: drop data too
+                self.indices.remove_index(index_name, delete_data=True)
+                continue
+            service = self.indices.indices[index_name]
+            if not state.routing_table.has_index(index_name):
+                continue
+            irt = state.routing_table.index(index_name)
+            for sid in list(service.shards):
+                local = service.shards[sid]
+                routed_here = [
+                    sr for sr in irt.shard_group(sid)
+                    if sr.node_id == self.node_id and
+                    sr.allocation_id == local.allocation_id]
+                if not routed_here:
+                    service.remove_shard(sid)
+                    self._recovering.discard((index_name, sid))
+
+    def _update_index_metadata(self, state: ClusterState) -> None:
+        for index_name, service in self.indices.indices.items():
+            if state.metadata.has_index(index_name):
+                service.update_metadata(state.metadata.index(index_name))
+
+    def _create_or_recover_shards(self, state: ClusterState) -> None:
+        for sr in state.routing_table.shards_on_node(self.node_id):
+            if sr.node_id != self.node_id:
+                continue   # relocation target handled via its own routing
+            key = (sr.index, sr.shard_id)
+            local_exists = self.indices.has_shard(sr.index, sr.shard_id)
+            if sr.state == ShardState.INITIALIZING and not local_exists \
+                    and key not in self._recovering:
+                self._recovering.add(key)
+                self._start_recovery(state, sr)
+            elif sr.state == ShardState.STARTED and local_exists:
+                shard = self.indices.shard(sr.index, sr.shard_id)
+                term = state.metadata.index(sr.index).primary_term(sr.shard_id)
+                if sr.primary and not shard.primary:
+                    # replica promoted on failover
+                    shard.promote_to_primary(term)
+
+    # ------------------------------------------------------------------
+    # recovery
+    # ------------------------------------------------------------------
+
+    def _start_recovery(self, state: ClusterState, sr: ShardRouting) -> None:
+        metadata = state.metadata.index(sr.index)
+        service = self.indices.create_index(metadata)
+        term = metadata.primary_term(sr.shard_id)
+
+        if sr.primary:
+            # primary: recover from the local store (gateway allocation path)
+            shard = service.create_shard(sr.shard_id, primary=True,
+                                         primary_term=term,
+                                         allocation_id=sr.allocation_id)
+            try:
+                if shard.engine.store is not None:
+                    shard.engine.recover_from_store()
+            except Exception as e:  # noqa: BLE001 — reported to master
+                self._shard_failed(sr, f"store recovery failed: {e}")
+                return
+            self._shard_started(sr)
+            return
+
+        # replica: peer recovery from the active primary's node
+        irt = state.routing_table.index(sr.index)
+        primary = irt.primary(sr.shard_id)
+        if not primary.active or primary.node_id is None:
+            self._recovering.discard((sr.index, sr.shard_id))
+            return   # retried on a later state where the primary is active
+        shard = service.create_shard(sr.shard_id, primary=False,
+                                     primary_term=term,
+                                     allocation_id=sr.allocation_id)
+
+        def on_response(resp: Optional[Dict[str, Any]],
+                        err: Optional[Exception]) -> None:
+            if err is not None or resp is None:
+                service.remove_shard(sr.shard_id)
+                self._recovering.discard((sr.index, sr.shard_id))
+                self._shard_failed(sr, f"peer recovery failed: {err}")
+                return
+            try:
+                for op in resp["ops"]:
+                    shard.apply_op_on_replica(op)
+                # fill seqno holes (overwritten/deleted history not shipped)
+                for seqno in range(shard.engine.tracker.checkpoint + 1,
+                                   resp["max_seqno"] + 1):
+                    shard.engine.noop(seqno, reason="recovery hole fill")
+                shard.update_global_checkpoint_on_replica(
+                    resp["global_checkpoint"])
+                shard.engine.refresh()
+            except Exception as e:  # noqa: BLE001 — reported to master
+                service.remove_shard(sr.shard_id)
+                self._recovering.discard((sr.index, sr.shard_id))
+                self._shard_failed(sr, f"recovery apply failed: {e}")
+                return
+            self._shard_started(sr)
+
+        self.ts.send_request(primary.node_id, RECOVERY_START, {
+            "index": sr.index, "shard": sr.shard_id,
+            "allocation_id": sr.allocation_id,
+        }, on_response, timeout=60.0)
+
+    def _on_recovery_start(self, req: Dict[str, Any], sender: str
+                           ) -> Dict[str, Any]:
+        """Primary side: snapshot live ops + register the recovering copy.
+
+        Runs atomically within one handler dispatch, so the snapshot and
+        in-sync registration can't interleave with a concurrent write; ops
+        after this point reach the new copy through normal replica fan-out
+        (the retention-lease ops-based path of RecoverySourceHandler)."""
+        shard = self.indices.shard(req["index"], req["shard"])
+        assert shard.primary and shard.tracker is not None
+        reader = shard.engine.acquire_reader()
+        ops = []
+        for seg, mask in zip(reader.segments, reader.live_masks):
+            for doc_id, d in seg.id_to_doc.items():
+                if mask[d]:
+                    ops.append({
+                        "op_type": "index", "doc_id": doc_id,
+                        "source": seg.sources[d],
+                        "routing": None,
+                        "seqno": int(seg.seqnos[d]),
+                        "version": int(seg.versions[d]),
+                        "primary_term": int(seg.primary_terms[d]),
+                    })
+        # buffered (not yet refreshed) docs ride along too
+        for doc_id in shard.engine._buffer_order:
+            parsed, seqno, version, term = shard.engine._buffer[doc_id]
+            ops.append({"op_type": "index", "doc_id": doc_id,
+                        "source": parsed.source, "routing": None,
+                        "seqno": seqno, "version": version,
+                        "primary_term": term})
+        ops.sort(key=lambda op: op["seqno"])
+        max_seqno = shard.max_seqno
+        shard.tracker.init_tracking(req["allocation_id"])
+        shard.tracker.mark_in_sync(req["allocation_id"], max_seqno)
+        return {"ops": ops, "max_seqno": max_seqno,
+                "global_checkpoint": shard.global_checkpoint,
+                "primary_term": shard.primary_term}
+
+    # ------------------------------------------------------------------
+    # master notifications
+    # ------------------------------------------------------------------
+
+    def _master_id(self) -> Optional[str]:
+        state = self.last_applied
+        return state.master_node_id if state is not None else None
+
+    def _shard_started(self, sr: ShardRouting) -> None:
+        self._recovering.discard((sr.index, sr.shard_id))
+        master = self._master_id()
+        if master is None:
+            return
+        self.ts.send_request(master, SHARD_STARTED,
+                             {"shard": sr.to_dict()},
+                             lambda r, e: None, timeout=30.0)
+
+    def _shard_failed(self, sr: ShardRouting, reason: str) -> None:
+        self._recovering.discard((sr.index, sr.shard_id))
+        master = self._master_id()
+        if master is None:
+            return
+        self.ts.send_request(master, SHARD_FAILED,
+                             {"shard": sr.to_dict(), "reason": reason},
+                             lambda r, e: None, timeout=30.0)

@@ -1,0 +1,133 @@
+"""Node-level registry of index services and their shards.
+
+Reference analog: indices/IndicesService.java:176 — creates/deletes
+``IndexService`` instances as cluster state demands; each IndexService owns
+that node's shard copies of one index (index/IndexService.java). Storage
+paths hang off the node's data directory (env/NodeEnvironment analog).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, Iterable, Optional
+
+from elasticsearch_tpu.cluster.metadata import IndexMetadata
+from elasticsearch_tpu.index.shard import IndexShard, ShardId
+from elasticsearch_tpu.index.store import Store
+from elasticsearch_tpu.index.translog import Translog
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.utils.errors import (
+    IndexNotFoundError, ShardNotFoundError,
+)
+
+
+class IndexService:
+    """This node's view of one index: mapper service + local shard copies."""
+
+    def __init__(self, metadata: IndexMetadata,
+                 data_path: Optional[str] = None):
+        self.metadata = metadata
+        self.mapper_service = MapperService(dict(metadata.mappings) or None)
+        self.shards: Dict[int, IndexShard] = {}
+        self.data_path = data_path
+
+    def _shard_paths(self, shard: int):
+        if self.data_path is None:
+            return None, None
+        base = os.path.join(self.data_path, self.metadata.uuid, str(shard))
+        os.makedirs(base, exist_ok=True)
+        return (Store(os.path.join(base, "index")),
+                Translog(os.path.join(base, "translog")))
+
+    def create_shard(self, shard: int, primary: bool, primary_term: int = 1,
+                     allocation_id: Optional[str] = None) -> IndexShard:
+        if shard in self.shards:
+            raise ValueError(f"shard [{self.metadata.name}][{shard}] "
+                             f"already exists on this node")
+        store, translog = self._shard_paths(shard)
+        index_shard = IndexShard(
+            ShardId(self.metadata.name, shard), self.mapper_service,
+            primary=primary, primary_term=primary_term,
+            allocation_id=allocation_id, store=store, translog=translog)
+        self.shards[shard] = index_shard
+        return index_shard
+
+    def shard(self, shard: int) -> IndexShard:
+        if shard not in self.shards:
+            raise ShardNotFoundError(
+                f"shard [{self.metadata.name}][{shard}] not on this node")
+        return self.shards[shard]
+
+    def remove_shard(self, shard: int, delete_data: bool = False) -> None:
+        index_shard = self.shards.pop(shard, None)
+        if index_shard is not None:
+            index_shard.close()
+        if delete_data and self.data_path is not None:
+            path = os.path.join(self.data_path, self.metadata.uuid, str(shard))
+            shutil.rmtree(path, ignore_errors=True)
+
+    def update_metadata(self, metadata: IndexMetadata) -> None:
+        if metadata.mappings and metadata.version > self.metadata.version:
+            self.mapper_service.merge(dict(metadata.mappings))
+        self.metadata = metadata
+
+    def close(self) -> None:
+        for shard in self.shards.values():
+            shard.close()
+        self.shards.clear()
+
+
+class IndicesService:
+    def __init__(self, data_path: Optional[str] = None):
+        self.indices: Dict[str, IndexService] = {}
+        self.data_path = data_path
+
+    def create_index(self, metadata: IndexMetadata) -> IndexService:
+        if metadata.name in self.indices:
+            return self.indices[metadata.name]
+        service = IndexService(metadata, data_path=self.data_path)
+        self.indices[metadata.name] = service
+        return service
+
+    def index_service(self, name: str) -> IndexService:
+        if name not in self.indices:
+            raise IndexNotFoundError(f"no such index [{name}] on this node")
+        return self.indices[name]
+
+    def has_index(self, name: str) -> bool:
+        return name in self.indices
+
+    def shard(self, index: str, shard: int) -> IndexShard:
+        return self.index_service(index).shard(shard)
+
+    def has_shard(self, index: str, shard: int) -> bool:
+        return index in self.indices and shard in self.indices[index].shards
+
+    def remove_index(self, name: str, delete_data: bool = False) -> None:
+        service = self.indices.pop(name, None)
+        if service is None:
+            return
+        uuid = service.metadata.uuid
+        service.close()
+        if delete_data and self.data_path is not None:
+            shutil.rmtree(os.path.join(self.data_path, uuid),
+                          ignore_errors=True)
+
+    def all_shards(self) -> Iterable[IndexShard]:
+        for service in self.indices.values():
+            yield from service.shards.values()
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "indices": {
+                name: {str(sid): shard.doc_stats()
+                       for sid, shard in svc.shards.items()}
+                for name, svc in self.indices.items()
+            }
+        }
+
+    def close(self) -> None:
+        for service in self.indices.values():
+            service.close()
+        self.indices.clear()

@@ -1,0 +1,3 @@
+from elasticsearch_tpu.node.node import Node, NodeClient
+
+__all__ = ["Node", "NodeClient"]

@@ -1,0 +1,307 @@
+"""Node composition: wire every service together and manage lifecycle.
+
+Reference analog: node/Node.java:273 (constructor builds ~60 services) and
+Node.start():708 (ordered startup: indices → transport → discovery/
+coordination → API). The NodeClient mirrors client/node/NodeClient.java:43 —
+the typed in-process facade the REST layer calls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.action.admin import (
+    BroadcastActions, CLUSTER_UPDATE_SETTINGS, CREATE_INDEX, DELETE_INDEX,
+    FLUSH_SHARD, FORCEMERGE_SHARD, MasterActions, MasterClient, PUT_MAPPING,
+    REFRESH_SHARD, UPDATE_ALIASES, UPDATE_SETTINGS, cluster_health,
+)
+from elasticsearch_tpu.action.bulk import TransportBulkAction
+from elasticsearch_tpu.action.document import (
+    TransportGetAction, TransportUpdateAction,
+)
+from elasticsearch_tpu.action.replication import TransportShardBulkAction
+from elasticsearch_tpu.action.search_action import (
+    SearchTransportService, TransportSearchAction,
+)
+from elasticsearch_tpu.cluster.allocation import AllocationService
+from elasticsearch_tpu.cluster.coordination import (
+    Coordinator, CoordinatorSettings, Mode,
+)
+from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode, Roles
+from elasticsearch_tpu.indices.cluster_state_service import (
+    IndicesClusterStateService,
+)
+from elasticsearch_tpu.indices.indices_service import IndicesService
+from elasticsearch_tpu.transport.scheduler import Scheduler
+from elasticsearch_tpu.transport.transport import (
+    InMemoryTransport, TransportService,
+)
+from elasticsearch_tpu.utils.errors import SearchEngineError
+
+
+class Node:
+    def __init__(self, node_id: str, transport: InMemoryTransport,
+                 scheduler: Scheduler,
+                 seed_peers: Optional[List[str]] = None,
+                 roles: Optional[List[str]] = None,
+                 data_path: Optional[str] = None,
+                 initial_state: Optional[ClusterState] = None,
+                 coordinator_settings: Optional[CoordinatorSettings] = None):
+        self.node_id = node_id
+        self.scheduler = scheduler
+        self.discovery_node = DiscoveryNode(
+            node_id=node_id, name=node_id,
+            roles=frozenset(roles) if roles else frozenset(Roles.ALL))
+
+        self.transport_service = TransportService(node_id, transport)
+        self.indices_service = IndicesService(data_path=data_path)
+        self.allocation_service = AllocationService()
+
+        initial_state = initial_state or ClusterState()
+        self.coordinator = Coordinator(
+            self.discovery_node, self.transport_service, scheduler,
+            initial_state, settings=coordinator_settings,
+            seed_peers=seed_peers, on_committed=self._on_committed)
+
+        self.reconciler = IndicesClusterStateService(
+            node_id, self.indices_service, self.transport_service)
+        self.master_actions = MasterActions(
+            self.coordinator, self.allocation_service, self.transport_service)
+        self.master_client = MasterClient(self.transport_service,
+                                          self.coordinator)
+
+        self.shard_bulk = TransportShardBulkAction(
+            node_id, self.indices_service, self.transport_service, scheduler,
+            self._applied_state)
+        self.bulk_action = TransportBulkAction(
+            self.shard_bulk, self._applied_state, self._auto_create_index)
+        self.get_action = TransportGetAction(
+            node_id, self.indices_service, self.transport_service,
+            self._applied_state)
+        self.update_action = TransportUpdateAction(self.get_action,
+                                                   self.bulk_action)
+        self.search_transport = SearchTransportService(
+            node_id, self.indices_service, self.transport_service)
+        self.search_action = TransportSearchAction(
+            node_id, self.transport_service, self._applied_state)
+        self.broadcast_actions = BroadcastActions(
+            node_id, self.indices_service, self.transport_service,
+            self._applied_state)
+
+        self.client = NodeClient(self)
+
+    # ------------------------------------------------------------------
+
+    def _applied_state(self) -> ClusterState:
+        return self.coordinator.applied_state
+
+    def _on_committed(self, state: ClusterState) -> None:
+        self.reconciler.apply_cluster_state(state)
+        self._master_housekeeping(state)
+
+    def _master_housekeeping(self, state: ClusterState) -> None:
+        """On the elected master: clean up routing after membership changes
+        (the reference couples this via NodeRemovalClusterStateTaskExecutor
+        and reroute listeners)."""
+        if self.coordinator.mode != Mode.LEADER:
+            return
+        dead = {sr.node_id for sr in state.routing_table.all_shards()
+                if sr.node_id is not None and sr.node_id not in state.nodes}
+        dead |= {sr.relocating_node_id
+                 for sr in state.routing_table.all_shards()
+                 if sr.relocating_node_id is not None
+                 and sr.relocating_node_id not in state.nodes}
+        needs_reroute = any(
+            sr.state.value == "UNASSIGNED"
+            for sr in state.routing_table.all_shards())
+        if not dead and not needs_reroute:
+            return
+
+        def update(current: ClusterState) -> ClusterState:
+            out = current
+            dead_now = {sr.node_id
+                        for sr in out.routing_table.all_shards()
+                        if sr.node_id is not None
+                        and sr.node_id not in out.nodes}
+            if dead_now:
+                out = self.allocation_service.disassociate_dead_nodes(
+                    out, dead_now)
+            return self.allocation_service.reroute(out)
+        self.coordinator.submit_state_update("housekeeping-reroute", update)
+
+    def _auto_create_index(self, name: str,
+                           on_done: Callable[[Optional[Exception]], None]
+                           ) -> None:
+        def cb(resp, err):
+            on_done(err)
+        self.master_client.execute(
+            CREATE_INDEX, {"index": name, "ignore_existing": True,
+                           "settings": {"number_of_replicas": 1}}, cb)
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self.coordinator.start()
+
+    def stop(self) -> None:
+        self.coordinator.stop()
+        self.transport_service.close()
+        self.indices_service.close()
+
+
+class NodeClient:
+    """Typed in-process API facade — what the REST layer dispatches to.
+
+    Every method is callback-style ``(args..., on_done(resp, err))`` so the
+    same code runs under the deterministic scheduler and production.
+    """
+
+    def __init__(self, node: Node):
+        self.node = node
+
+    # -- index admin ----------------------------------------------------
+
+    def create_index(self, name: str, body: Optional[Dict[str, Any]],
+                     on_done) -> None:
+        body = body or {}
+        self.node.master_client.execute(CREATE_INDEX, {
+            "index": name,
+            "settings": body.get("settings") or {},
+            "mappings": body.get("mappings") or {},
+        }, on_done)
+
+    def delete_index(self, name: str, on_done) -> None:
+        self.node.master_client.execute(DELETE_INDEX, {"index": name},
+                                        on_done)
+
+    def put_mapping(self, name: str, mappings: Dict[str, Any],
+                    on_done) -> None:
+        self.node.master_client.execute(
+            PUT_MAPPING, {"index": name, "mappings": mappings}, on_done)
+
+    def update_settings(self, name: str, settings: Dict[str, Any],
+                        on_done) -> None:
+        self.node.master_client.execute(
+            UPDATE_SETTINGS, {"index": name, "settings": settings}, on_done)
+
+    def update_aliases(self, actions: List[Dict[str, Any]], on_done) -> None:
+        self.node.master_client.execute(
+            UPDATE_ALIASES, {"actions": actions}, on_done)
+
+    def cluster_update_settings(self, body: Dict[str, Any], on_done) -> None:
+        self.node.master_client.execute(CLUSTER_UPDATE_SETTINGS, body,
+                                        on_done)
+
+    def get_mapping(self, name: str):
+        state = self.node._applied_state()
+        meta = state.metadata.index(name)
+        return {meta.name: {"mappings": dict(meta.mappings)}}
+
+    # -- documents ------------------------------------------------------
+
+    def index_doc(self, index: str, doc_id: str, source: Dict[str, Any],
+                  on_done, routing: Optional[str] = None,
+                  op_type: str = "index",
+                  if_seq_no: Optional[int] = None,
+                  if_primary_term: Optional[int] = None) -> None:
+        item = {"action": "create" if op_type == "create" else "index",
+                "index": index, "id": doc_id, "source": source,
+                "routing": routing}
+        if if_seq_no is not None:
+            item["if_seq_no"] = if_seq_no
+        if if_primary_term is not None:
+            item["if_primary_term"] = if_primary_term
+        self._single_item_bulk(item, index, on_done)
+
+    def delete_doc(self, index: str, doc_id: str, on_done,
+                   routing: Optional[str] = None) -> None:
+        self._single_item_bulk(
+            {"action": "delete", "index": index, "id": doc_id,
+             "routing": routing}, index, on_done)
+
+    def _single_item_bulk(self, item, index, on_done) -> None:
+        def cb(resp: Dict[str, Any]) -> None:
+            result = next(iter(resp["items"][0].values()))
+            if "error" in result:
+                status = result.get("status", 500)
+                err = SearchEngineError(result["error"]["reason"])
+                err.status = status
+                on_done(result, err)
+            else:
+                result["_index"] = index
+                result["_id"] = result.pop("id", item["id"])
+                on_done(result, None)
+        self.node.bulk_action.execute([item], cb)
+
+    def bulk(self, items: List[Dict[str, Any]], on_done) -> None:
+        self.node.bulk_action.execute(
+            items, lambda resp: on_done(resp, None))
+
+    def get(self, index: str, doc_id: str, on_done,
+            routing: Optional[str] = None, realtime: bool = True) -> None:
+        self.node.get_action.execute(index, doc_id, on_done,
+                                     routing=routing, realtime=realtime)
+
+    def update(self, index: str, doc_id: str, body: Dict[str, Any],
+               on_done, routing: Optional[str] = None,
+               retry_on_conflict: int = 3) -> None:
+        self.node.update_action.execute(index, doc_id, body, on_done,
+                                        routing=routing,
+                                        retry_on_conflict=retry_on_conflict)
+
+    # -- search ---------------------------------------------------------
+
+    def search(self, index_expression: str, body: Optional[Dict[str, Any]],
+               on_done, search_type: str = "query_then_fetch") -> None:
+        self.node.search_action.execute(index_expression, body or {},
+                                        on_done, search_type=search_type)
+
+    def count(self, index_expression: str, body: Optional[Dict[str, Any]],
+              on_done) -> None:
+        body = dict(body or {})
+        body["size"] = 0
+        body["track_total_hits"] = True
+
+        def cb(resp, err):
+            if err is not None:
+                on_done(None, err)
+            else:
+                on_done({"count": resp["hits"]["total"]["value"],
+                         "_shards": resp["_shards"]}, None)
+        self.search(index_expression, body, cb)
+
+    # -- maintenance ----------------------------------------------------
+
+    def refresh(self, index_expression: str, on_done) -> None:
+        self.node.broadcast_actions.broadcast(
+            REFRESH_SHARD, index_expression, lambda r: on_done(r, None))
+
+    def flush(self, index_expression: str, on_done) -> None:
+        self.node.broadcast_actions.broadcast(
+            FLUSH_SHARD, index_expression, lambda r: on_done(r, None))
+
+    def force_merge(self, index_expression: str, on_done,
+                    max_num_segments: int = 1) -> None:
+        self.node.broadcast_actions.broadcast(
+            FORCEMERGE_SHARD, index_expression, lambda r: on_done(r, None),
+            extra={"max_num_segments": max_num_segments})
+
+    # -- cluster --------------------------------------------------------
+
+    def cluster_health(self, index: Optional[str] = None) -> Dict[str, Any]:
+        return cluster_health(self.node._applied_state(), index)
+
+    def cluster_state(self) -> Dict[str, Any]:
+        return self.node._applied_state().to_dict()
+
+    def nodes_stats(self) -> Dict[str, Any]:
+        return {
+            "nodes": {
+                self.node.node_id: {
+                    "name": self.node.node_id,
+                    "indices": self.node.indices_service.stats(),
+                    "transport": dict(
+                        self.node.transport_service.stats),
+                }
+            }
+        }

@@ -1,0 +1,7 @@
+from elasticsearch_tpu.script.engine import (
+    CompiledScript, ScriptEngine, ScriptException, default_engine,
+    execute_update_script,
+)
+
+__all__ = ["CompiledScript", "ScriptEngine", "ScriptException",
+           "default_engine", "execute_update_script"]

@@ -43,6 +43,46 @@ Handler = Callable[[Dict[str, Any], str], Dict[str, Any]]
 ResponseCallback = Callable[[Optional[Dict[str, Any]], Optional[Exception]], None]
 
 
+class Deferred:
+    """Async handler response: a handler may return one of these instead of
+    a dict and resolve/reject it later (the reference's handlers respond
+    through an async TransportChannel, TcpTransportChannel.sendResponse)."""
+
+    def __init__(self) -> None:
+        self._on_value: Optional[Callable[[Dict[str, Any]], None]] = None
+        self._on_error: Optional[Callable[[str], None]] = None
+        self._value: Optional[Dict[str, Any]] = None
+        self._error: Optional[str] = None
+        self._done = False
+
+    def resolve(self, value: Optional[Dict[str, Any]] = None) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._value = value if value is not None else {}
+        if self._on_value is not None:
+            self._on_value(self._value)
+
+    def reject(self, cause: Any) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._error = (f"{type(cause).__name__}: {cause}"
+                       if isinstance(cause, Exception) else str(cause))
+        if self._on_error is not None:
+            self._on_error(self._error)
+
+    def _subscribe(self, on_value: Callable[[Dict[str, Any]], None],
+                   on_error: Callable[[str], None]) -> None:
+        self._on_value = on_value
+        self._on_error = on_error
+        if self._done:
+            if self._error is not None:
+                on_error(self._error)
+            else:
+                on_value(self._value or {})
+
+
 @dataclass
 class _Rule:
     """Disruption rule for a directed link (or wildcard '*')."""
@@ -194,11 +234,18 @@ class TransportService:
             except Exception as e:  # noqa: BLE001 — becomes a remote error
                 reply_err(f"{type(e).__name__}: {e}")
                 return
-            response = copy.deepcopy(response if response is not None else {})
-            self.transport.deliver(
-                node_id, self.node_id,
-                lambda _me: finish(response, None),
-                on_undeliverable=lambda: None)  # sender gone: nothing to do
+
+            def send_reply(resp: Optional[Dict[str, Any]]) -> None:
+                resp = copy.deepcopy(resp if resp is not None else {})
+                self.transport.deliver(
+                    node_id, self.node_id,
+                    lambda _me: finish(resp, None),
+                    on_undeliverable=lambda: None)  # sender gone
+
+            if isinstance(response, Deferred):
+                response._subscribe(send_reply, reply_err)
+            else:
+                send_reply(response)
 
         def reply_err(cause: str) -> None:
             self.transport.deliver(

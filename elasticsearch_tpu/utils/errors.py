@@ -144,6 +144,12 @@ class RecoveryFailedError(SearchEngineError):
     status = 500
 
 
+class UnavailableShardsError(SearchEngineError):
+    """No active copy available to execute the operation
+    (action/UnavailableShardsException.java)."""
+    status = 503
+
+
 def error_from_json(body: Dict[str, Any]) -> SearchEngineError:
     """Rehydrate an error from its JSON form (transport deserialization)."""
     err = SearchEngineError(body.get("reason", "unknown"))

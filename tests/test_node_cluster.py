@@ -1,0 +1,295 @@
+"""End-to-end multi-node cluster tests over the deterministic harness.
+
+The analog of the reference's ESIntegTestCase suites: real Nodes, in-memory
+transport, virtual time (test/framework InternalTestCluster.java:175).
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=3, seed=7)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def test_cluster_forms_and_elects_master(cluster):
+    assert cluster.master() is not None
+    state = cluster.master().coordinator.applied_state
+    assert len(state.nodes) == 3
+
+
+def test_create_index_goes_green_with_replicas(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda cb: client.create_index(
+        "logs", {"settings": {"number_of_shards": 3,
+                              "number_of_replicas": 1}}, cb))
+    _ok(resp, err)
+    cluster.ensure_green("logs")
+    health = cluster.master().client.cluster_health("logs")
+    assert health["active_shards"] == 6
+    assert health["active_primary_shards"] == 3
+
+
+def test_index_get_search_roundtrip(cluster):
+    client = cluster.client()
+    cluster.call(lambda cb: client.create_index(
+        "docs", {"settings": {"number_of_shards": 2,
+                              "number_of_replicas": 1}}, cb))
+    cluster.ensure_green("docs")
+
+    for i in range(20):
+        resp, err = cluster.call(lambda cb, i=i: client.index_doc(
+            "docs", f"d{i}", {"title": f"hello world {i}", "n": i}, cb))
+        _ok(resp, err)
+        assert resp["result"] == "created"
+
+    # realtime get before any refresh
+    resp, err = cluster.call(lambda cb: client.get("docs", "d7", cb))
+    _ok(resp, err)
+    assert resp["found"] and resp["_source"]["n"] == 7
+
+    cluster.call(lambda cb: client.refresh("docs", cb))
+
+    # search from a NON-master node: full scatter-gather
+    other = cluster.client("node2")
+    resp, err = cluster.call(lambda cb: other.search(
+        "docs", {"query": {"match": {"title": "hello"}}, "size": 5}, cb))
+    _ok(resp, err)
+    assert resp["hits"]["total"]["value"] == 20
+    assert len(resp["hits"]["hits"]) == 5
+    assert resp["_shards"]["total"] == 2
+
+    resp, err = cluster.call(lambda cb: other.count(
+        "docs", {"query": {"term": {"n": 3}}}, cb))
+    _ok(resp, err)
+    assert resp["count"] == 1
+
+
+def test_bulk_and_update_and_delete(cluster):
+    client = cluster.client()
+    items = [{"action": "index", "index": "acc", "id": f"a{i}",
+              "source": {"balance": 100 + i}} for i in range(10)]
+    resp, err = cluster.call(lambda cb: client.bulk(items, cb))
+    _ok(resp, err)
+    assert resp["errors"] is False
+    assert len(resp["items"]) == 10
+
+    # scripted update (painless-compatible idiom)
+    resp, err = cluster.call(lambda cb: client.update(
+        "acc", "a3", {"script": {
+            "source": "ctx._source.balance += params.amount",
+            "params": {"amount": 50}}}, cb))
+    _ok(resp, err)
+    resp, err = cluster.call(lambda cb: client.get("acc", "a3", cb))
+    assert resp["_source"]["balance"] == 153
+
+    # partial-doc update
+    cluster.call(lambda cb: client.update(
+        "acc", "a4", {"doc": {"owner": "kim"}}, cb))
+    resp, err = cluster.call(lambda cb: client.get("acc", "a4", cb))
+    assert resp["_source"] == {"balance": 104, "owner": "kim"}
+
+    # upsert on missing doc
+    cluster.call(lambda cb: client.update(
+        "acc", "new1", {"doc": {"balance": 1}, "doc_as_upsert": True}, cb))
+    resp, err = cluster.call(lambda cb: client.get("acc", "new1", cb))
+    assert resp["found"]
+
+    # delete
+    resp, err = cluster.call(lambda cb: client.delete_doc("acc", "a5", cb))
+    _ok(resp, err)
+    resp, err = cluster.call(lambda cb: client.get("acc", "a5", cb))
+    assert resp["found"] is False
+
+    # bulk update items execute on the primary (UpdateHelper analog)
+    resp, err = cluster.call(lambda cb: client.bulk(
+        [{"action": "update", "index": "acc", "id": "a6",
+          "source": {"doc": {"flag": True}}},
+         {"action": "update", "index": "acc", "id": "missing1",
+          "source": {"upsert": {"balance": 0}}}], cb))
+    _ok(resp, err)
+    assert resp["errors"] is False
+    resp, err = cluster.call(lambda cb: client.get("acc", "a6", cb))
+    assert resp["_source"]["flag"] is True
+    resp, err = cluster.call(lambda cb: client.get("acc", "missing1", cb))
+    assert resp["found"]
+
+
+def test_version_conflict_on_create(cluster):
+    client = cluster.client()
+    cluster.call(lambda cb: client.index_doc("idx", "x", {"v": 1}, cb))
+    resp, err = cluster.call(lambda cb: client.index_doc(
+        "idx", "x", {"v": 2}, cb, op_type="create"))
+    assert err is not None
+    assert getattr(err, "status", None) == 409 or resp["status"] == 409
+
+
+def test_primary_failover_preserves_data(cluster):
+    client = cluster.client()
+    cluster.call(lambda cb: client.create_index(
+        "ha", {"settings": {"number_of_shards": 1,
+                            "number_of_replicas": 1}}, cb))
+    cluster.ensure_green("ha")
+    for i in range(15):
+        cluster.call(lambda cb, i=i: client.index_doc(
+            "ha", f"k{i}", {"i": i}, cb))
+    cluster.call(lambda cb: client.refresh("ha", cb))
+
+    # find and kill the node holding the primary
+    state = cluster.master().coordinator.applied_state
+    primary = state.routing_table.index("ha").primary(0)
+    victim = primary.node_id
+    survivors = [nid for nid in cluster.nodes if nid != victim]
+    cluster.kill_node(victim)
+
+    # BEFORE failure detection: the scatter phase fails over to live copies
+    early = cluster.client(survivors[0])
+    resp, err = cluster.call(lambda cb: early.search(
+        "ha", {"size": 0, "track_total_hits": True}, cb))
+    _ok(resp, err)
+    assert resp["hits"]["total"]["value"] == 15
+
+    # surviving nodes detect the death, promote the replica, go yellow+
+    cluster.await_node_count(2)
+    cluster.ensure_yellow("ha", max_time=300.0)
+    surviving_client = cluster.client(survivors[0])
+    resp, err = cluster.call(lambda cb: surviving_client.search(
+        "ha", {"query": {"match_all": {}}, "size": 0,
+               "track_total_hits": True}, cb))
+    _ok(resp, err)
+    assert resp["hits"]["total"]["value"] == 15
+
+    # writes keep working after failover
+    resp, err = cluster.call(lambda cb: surviving_client.index_doc(
+        "ha", "after", {"i": 99}, cb))
+    _ok(resp, err)
+
+
+def test_replica_recovery_copies_existing_data(cluster):
+    client = cluster.client()
+    # start with zero replicas, index, then scale up to 1 replica
+    cluster.call(lambda cb: client.create_index(
+        "scale", {"settings": {"number_of_shards": 1,
+                               "number_of_replicas": 0}}, cb))
+    cluster.ensure_green("scale")
+    for i in range(12):
+        cluster.call(lambda cb, i=i: client.index_doc(
+            "scale", f"s{i}", {"i": i}, cb))
+    cluster.call(lambda cb: client.refresh("scale", cb))
+
+    resp, err = cluster.call(lambda cb: client.update_settings(
+        "scale", {"number_of_replicas": 1}, cb))
+    _ok(resp, err)
+    cluster.ensure_green("scale", max_time=300.0)
+
+    # the replica must hold all docs: search hitting either copy agrees
+    totals = set()
+    for nid in cluster.nodes:
+        resp, err = cluster.call(lambda cb, nid=nid: cluster.client(nid).search(
+            "scale", {"size": 0, "track_total_hits": True}, cb))
+        _ok(resp, err)
+        totals.add(resp["hits"]["total"]["value"])
+    assert totals == {12}
+
+
+def test_dfs_query_then_fetch_globalizes_idf(cluster):
+    client = cluster.client()
+    cluster.call(lambda cb: client.create_index(
+        "dfs", {"settings": {"number_of_shards": 3,
+                             "number_of_replicas": 0}}, cb))
+    cluster.ensure_green("dfs")
+    for i in range(30):
+        cluster.call(lambda cb, i=i: client.index_doc(
+            "dfs", f"t{i}", {"body": "common term" if i % 3 else "rare gem"},
+            cb))
+    cluster.call(lambda cb: client.refresh("dfs", cb))
+    resp, err = cluster.call(lambda cb: client.search(
+        "dfs", {"query": {"match": {"body": "rare"}}},
+        cb, search_type="dfs_query_then_fetch"))
+    _ok(resp, err)
+    assert resp["hits"]["total"]["value"] == 10
+
+
+def test_can_match_skips_shards_without_terms(cluster):
+    client = cluster.client()
+    cluster.call(lambda cb: client.create_index(
+        "cm", {"settings": {"number_of_shards": 4,
+                            "number_of_replicas": 0}}, cb))
+    cluster.ensure_green("cm")
+    cluster.call(lambda cb: client.index_doc(
+        "cm", "only", {"f": "zebra"}, cb))
+    cluster.call(lambda cb: client.refresh("cm", cb))
+    resp, err = cluster.call(lambda cb: client.search(
+        "cm", {"query": {"match": {"f": "zebra"}}}, cb))
+    _ok(resp, err)
+    assert resp["hits"]["total"]["value"] == 1
+    # 3 of 4 shards have no 'zebra' postings -> skipped by can_match
+    assert resp["_shards"]["skipped"] >= 1
+
+
+def test_aliases_and_wildcards(cluster):
+    client = cluster.client()
+    cluster.call(lambda cb: client.create_index(
+        "app-1", {"settings": {"number_of_replicas": 0}}, cb))
+    cluster.call(lambda cb: client.create_index(
+        "app-2", {"settings": {"number_of_replicas": 0}}, cb))
+    cluster.ensure_green()
+    cluster.call(lambda cb: client.index_doc("app-1", "1", {"x": 1}, cb))
+    cluster.call(lambda cb: client.index_doc("app-2", "2", {"x": 2}, cb))
+    cluster.call(lambda cb: client.refresh("*", cb))
+
+    resp, err = cluster.call(lambda cb: client.search("app-*", {}, cb))
+    _ok(resp, err)
+    assert resp["hits"]["total"]["value"] == 2
+
+    resp, err = cluster.call(lambda cb: client.update_aliases(
+        [{"add": {"index": "app-1", "alias": "apps"}}], cb))
+    _ok(resp, err)
+    resp, err = cluster.call(lambda cb: client.search("apps", {}, cb))
+    _ok(resp, err)
+    assert resp["hits"]["total"]["value"] == 1
+
+
+def test_delete_index_removes_shards_everywhere(cluster):
+    client = cluster.client()
+    cluster.call(lambda cb: client.create_index(
+        "gone", {"settings": {"number_of_shards": 2,
+                              "number_of_replicas": 1}}, cb))
+    cluster.ensure_green("gone")
+    resp, err = cluster.call(lambda cb: client.delete_index("gone", cb))
+    _ok(resp, err)
+    cluster.run_until(
+        lambda: all(not n.indices_service.has_index("gone")
+                    for n in cluster.nodes.values()), 60.0)
+
+
+def test_sorted_search_across_shards(cluster):
+    client = cluster.client()
+    cluster.call(lambda cb: client.create_index(
+        "sortme", {"settings": {"number_of_shards": 3,
+                                "number_of_replicas": 0}}, cb))
+    cluster.ensure_green("sortme")
+    import random
+    rng = random.Random(3)
+    values = list(range(40))
+    rng.shuffle(values)
+    items = [{"action": "index", "index": "sortme", "id": f"v{v}",
+              "source": {"rank": v}} for v in values]
+    cluster.call(lambda cb: client.bulk(items, cb))
+    cluster.call(lambda cb: client.refresh("sortme", cb))
+    resp, err = cluster.call(lambda cb: client.search(
+        "sortme", {"sort": [{"rank": "asc"}], "size": 10,
+                   "from": 5}, cb))
+    _ok(resp, err)
+    ranks = [h["_source"]["rank"] for h in resp["hits"]["hits"]]
+    assert ranks == list(range(5, 15))

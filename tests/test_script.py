@@ -1,0 +1,108 @@
+"""Script engine (painless analog) tests — sandboxing, contexts, idioms."""
+
+import pytest
+
+from elasticsearch_tpu.script.engine import (
+    ScriptEngine, ScriptException, execute_field_script,
+    execute_score_script, execute_update_script,
+)
+
+
+@pytest.fixture()
+def engine():
+    return ScriptEngine()
+
+
+def test_basic_arithmetic(engine):
+    assert engine.execute("1 + 2 * 3", {}) is None  # statements, no return
+    assert engine.execute("return 1 + 2 * 3", {}) == 7
+
+
+def test_painless_update_idiom():
+    source = {"counter": 5}
+    out = execute_update_script(
+        source, {"source": "ctx._source.counter += params.count",
+                 "params": {"count": 4}})
+    assert out["counter"] == 9
+
+
+def test_painless_separators_and_literals():
+    out = execute_update_script(
+        {}, {"source": "ctx._source.a = 1; ctx._source.b = true && false"})
+    assert out == {"a": 1, "b": False}
+
+
+def test_string_literals_not_rewritten():
+    # ';', 'null', 'true' inside string literals must survive verbatim
+    out = execute_update_script(
+        {}, {"source": "ctx._source.tag = 'null'; ctx._source.m = 'a;b'"})
+    assert out == {"tag": "null", "m": "a;b"}
+
+
+def test_ctx_op_delete():
+    out = execute_update_script(
+        {"x": 1}, {"source": "ctx.op = 'delete'"})
+    assert out is None
+
+
+def test_doc_value_idiom():
+    assert execute_field_script(
+        {"source": "doc['price'].value * 2"}, {"price": 5}, {}) == 10
+    assert execute_field_script(
+        {"source": "doc['tags'].value"}, {"tags": ["a", "b"]}, {}) == "a"
+    assert execute_field_script(
+        {"source": "doc['tags'].values"}, {"tags": ["a", "b"]}, {}) == ["a", "b"]
+
+
+def test_score_script():
+    got = execute_score_script(
+        {"source": "_score * params.boost + doc['rank'].value",
+         "params": {"boost": 2}},
+        {"rank": 3}, 1.5)
+    assert got == 6.0
+
+
+def test_math_namespace(engine):
+    assert engine.execute("return Math.sqrt(16)", {}) == 4.0
+    assert engine.execute("return Math.max(3, 7)", {}) == 7
+
+
+def test_loops_and_conditionals(engine):
+    src = """
+total = 0
+for x in values:
+    if x % 2 == 0:
+        total += x
+return total
+"""
+    assert engine.execute(src, {"values": [1, 2, 3, 4, 5, 6]}) == 12
+
+
+def test_sandbox_rejects_imports(engine):
+    with pytest.raises(ScriptException):
+        engine.execute("import os", {})
+    with pytest.raises(ScriptException):
+        engine.execute("__import__('os')", {})
+    with pytest.raises(ScriptException):
+        engine.execute("open('/etc/passwd')", {})
+
+
+def test_runaway_loop_budget(engine):
+    with pytest.raises(ScriptException):
+        engine.execute("while True:\n    x = 1", {})
+
+
+def test_compile_cache(engine):
+    engine.execute("return 1", {})
+    engine.execute("return 1", {})
+    assert engine.stats["compilations"] == 1
+    assert engine.stats["executions"] == 2
+
+
+def test_string_methods(engine):
+    assert engine.execute(
+        "return name.toUpperCase()", {"name": "kim"}) == "KIM"
+    assert engine.execute(
+        "return name.substring(1, 3)", {"name": "hello"}) == "el"
+    assert engine.execute(
+        "return name.indexOf('l')", {"name": "hello"}) == 2
