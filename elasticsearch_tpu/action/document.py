@@ -114,8 +114,7 @@ class TransportUpdateAction:
                 elif body.get("doc_as_upsert") and "doc" in body:
                     new_source = dict(body["doc"])
                 else:
-                    on_done(None, DocumentMissingError(
-                        f"[{doc_id}]: document missing"))
+                    on_done(None, DocumentMissingError(index, doc_id))
                     return
                 item = {"action": "create", "index": index, "id": doc_id,
                         "source": new_source, "routing": routing}

@@ -188,7 +188,7 @@ class TransportShardBulkAction:
                             DocumentMissingError,
                         )
                         raise DocumentMissingError(
-                            f"[{item['id']}]: document missing")
+                            shard.shard_id.index, item["id"])
                 else:
                     new_source = dict(current["_source"])
                     if "doc" in body:

@@ -205,7 +205,7 @@ class TransportSearchAction:
             elif part in alias_map:
                 names.update(alias_map[part])
             else:
-                raise IndexNotFoundError(f"no such index [{part}]")
+                raise IndexNotFoundError(part)
         return sorted(names)
 
     def _shard_targets(self, indices: List[str], state: ClusterState

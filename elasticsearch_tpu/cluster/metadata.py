@@ -116,7 +116,7 @@ class Metadata:
         if len(matches) > 1:
             raise IllegalArgumentError(
                 f"alias [{name}] has more than one index associated")
-        raise IndexNotFoundError(f"no such index [{name}]")
+        raise IndexNotFoundError(name)
 
     def has_index(self, name: str) -> bool:
         try:
@@ -136,7 +136,7 @@ class Metadata:
 
     def update_index(self, im: IndexMetadata) -> "Metadata":
         if im.name not in self.indices:
-            raise IndexNotFoundError(f"no such index [{im.name}]")
+            raise IndexNotFoundError(im.name)
         return Metadata(indices={**self.indices, im.name: im},
                         templates=self.templates,
                         persistent_settings=self.persistent_settings,
@@ -144,7 +144,7 @@ class Metadata:
 
     def remove_index(self, name: str) -> "Metadata":
         if name not in self.indices:
-            raise IndexNotFoundError(f"no such index [{name}]")
+            raise IndexNotFoundError(name)
         indices = {k: v for k, v in self.indices.items() if k != name}
         return Metadata(indices=indices, templates=self.templates,
                         persistent_settings=self.persistent_settings,

@@ -340,6 +340,33 @@ class InternalEngine:
     # recovery
     # ------------------------------------------------------------------
 
+    def snapshot_ops(self) -> Tuple[List[Dict[str, Any]], int]:
+        """(live ops sorted by seqno, max_seqno) — the recovery source's
+        phase1+phase2 payload (every live doc as an index op with its
+        original seqno/version/term; history holes are the target's to
+        fill). Atomic under the engine lock."""
+        with self._lock:
+            ops: List[Dict[str, Any]] = []
+            reader = Reader(self.segments)
+            for seg, mask in zip(reader.segments, reader.live_masks):
+                for doc_id, d in seg.id_to_doc.items():
+                    if mask[d]:
+                        ops.append({
+                            "op_type": "index", "doc_id": doc_id,
+                            "source": seg.sources[d], "routing": None,
+                            "seqno": int(seg.seqnos[d]),
+                            "version": int(seg.versions[d]),
+                            "primary_term": int(seg.primary_terms[d]),
+                        })
+            for doc_id in self._buffer_order:
+                parsed, seqno, version, term = self._buffer[doc_id]
+                ops.append({"op_type": "index", "doc_id": doc_id,
+                            "source": parsed.source, "routing": None,
+                            "seqno": seqno, "version": version,
+                            "primary_term": term})
+            ops.sort(key=lambda op: op["seqno"])
+            return ops, self.tracker.max_seqno
+
     def recover_from_store(self) -> int:
         """Open the last commit and replay the translog tail.
 

@@ -159,28 +159,7 @@ class IndicesClusterStateService:
         (the retention-lease ops-based path of RecoverySourceHandler)."""
         shard = self.indices.shard(req["index"], req["shard"])
         assert shard.primary and shard.tracker is not None
-        reader = shard.engine.acquire_reader()
-        ops = []
-        for seg, mask in zip(reader.segments, reader.live_masks):
-            for doc_id, d in seg.id_to_doc.items():
-                if mask[d]:
-                    ops.append({
-                        "op_type": "index", "doc_id": doc_id,
-                        "source": seg.sources[d],
-                        "routing": None,
-                        "seqno": int(seg.seqnos[d]),
-                        "version": int(seg.versions[d]),
-                        "primary_term": int(seg.primary_terms[d]),
-                    })
-        # buffered (not yet refreshed) docs ride along too
-        for doc_id in shard.engine._buffer_order:
-            parsed, seqno, version, term = shard.engine._buffer[doc_id]
-            ops.append({"op_type": "index", "doc_id": doc_id,
-                        "source": parsed.source, "routing": None,
-                        "seqno": seqno, "version": version,
-                        "primary_term": term})
-        ops.sort(key=lambda op: op["seqno"])
-        max_seqno = shard.max_seqno
+        ops, max_seqno = shard.engine.snapshot_ops()
         shard.tracker.init_tracking(req["allocation_id"])
         shard.tracker.mark_in_sync(req["allocation_id"], max_seqno)
         return {"ops": ops, "max_seqno": max_seqno,

@@ -92,7 +92,7 @@ class IndicesService:
 
     def index_service(self, name: str) -> IndexService:
         if name not in self.indices:
-            raise IndexNotFoundError(f"no such index [{name}] on this node")
+            raise IndexNotFoundError(name)
         return self.indices[name]
 
     def has_index(self, name: str) -> bool:

@@ -1,0 +1,4 @@
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.rest.routes import build_controller
+
+__all__ = ["RestController", "RestRequest", "build_controller"]

@@ -1,0 +1,430 @@
+"""The REST API surface: route registrations mapping URLs to NodeClient.
+
+Reference analog: the ~180 Rest*Action handlers under rest/action/ plus the
+rest-api-spec JSON endpoint specs (143 files). Routes and parameter names
+follow the reference's specs so existing clients' muscle memory works:
+document CRUD, _bulk NDJSON, _search/_count, index admin, _cluster/*,
+_cat/* human tables, _nodes, _aliases.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid as uuid_mod
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.action.bulk import parse_bulk_body
+from elasticsearch_tpu.node.node import NodeClient
+from elasticsearch_tpu.rest.controller import (
+    RestController, RestRequest, respond_error, wrap_client_cb,
+)
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+from elasticsearch_tpu.version import __version__
+
+DoneFn = Callable[[int, Any], None]
+
+
+def build_controller(client: NodeClient) -> RestController:
+    rc = RestController()
+    r = rc.register
+
+    # -- root ------------------------------------------------------------
+    def root(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        done(200, {
+            "name": client.node.node_id,
+            "cluster_name": state.cluster_name,
+            "version": {"number": __version__,
+                        "build_flavor": "tpu-native"},
+            "tagline": "You Know, for Search",
+        })
+    r("GET", "/", root)
+
+    # -- document CRUD ----------------------------------------------------
+
+    def doc_index(req: RestRequest, done: DoneFn) -> None:
+        doc_id = req.params.get("id") or uuid_mod.uuid4().hex[:20]
+        op_type = req.param("op_type", "index")
+        refresh = req.query.get("refresh")
+
+        def cb(resp, err=None):
+            if err is not None:
+                respond_error(done, err)
+                return
+            result = dict(resp)
+            status = result.pop("status", 200)
+            if refresh in ("true", "wait_for", ""):
+                client.refresh(req.params["index"],
+                               lambda _r, _e=None: done(status, result))
+            else:
+                done(status, result)
+        client.index_doc(req.params["index"], doc_id, req.body or {}, cb,
+                         routing=req.query.get("routing"),
+                         op_type=op_type,
+                         if_seq_no=_int_or_none(req.query.get("if_seq_no")),
+                         if_primary_term=_int_or_none(
+                             req.query.get("if_primary_term")))
+
+    def doc_create(req: RestRequest, done: DoneFn) -> None:
+        req.query["op_type"] = "create"
+        doc_index(req, done)
+
+    r("PUT", "/{index}/_doc/{id}", doc_index)
+    r("POST", "/{index}/_doc/{id}", doc_index)
+    r("POST", "/{index}/_doc", doc_index)
+    r("PUT", "/{index}/_create/{id}", doc_create)
+    r("POST", "/{index}/_create/{id}", doc_create)
+
+    def doc_get(req: RestRequest, done: DoneFn) -> None:
+        def cb(resp, err=None):
+            if err is not None:
+                respond_error(done, err)
+            elif not resp.get("found"):
+                done(404, resp)
+            else:
+                done(200, resp)
+        client.get(req.params["index"], req.params["id"], cb,
+                   routing=req.query.get("routing"),
+                   realtime=req.flag("realtime", True))
+    r("GET", "/{index}/_doc/{id}", doc_get)
+
+    def doc_source(req: RestRequest, done: DoneFn) -> None:
+        def cb(resp, err=None):
+            if err is not None:
+                respond_error(done, err)
+            elif not resp.get("found"):
+                done(404, {})
+            else:
+                done(200, resp["_source"])
+        client.get(req.params["index"], req.params["id"], cb)
+    r("GET", "/{index}/_source/{id}", doc_source)
+
+    def doc_delete(req: RestRequest, done: DoneFn) -> None:
+        def cb(resp, err=None):
+            if err is not None:
+                respond_error(done, err)
+                return
+            status = 200 if resp.get("result") == "deleted" else 404
+            resp.pop("status", None)
+            done(status, resp)
+        client.delete_doc(req.params["index"], req.params["id"], cb,
+                          routing=req.query.get("routing"))
+    r("DELETE", "/{index}/_doc/{id}", doc_delete)
+
+    def doc_update(req: RestRequest, done: DoneFn) -> None:
+        def cb(resp, err=None):
+            if err is not None:
+                respond_error(done, err)
+            else:
+                resp = dict(resp)
+                resp.pop("status", None)
+                done(200, resp)
+        client.update(req.params["index"], req.params["id"], req.body or {},
+                      cb, routing=req.query.get("routing"),
+                      retry_on_conflict=int(
+                          req.query.get("retry_on_conflict", 3)))
+    r("POST", "/{index}/_update/{id}", doc_update)
+
+    # -- bulk -------------------------------------------------------------
+
+    def bulk(req: RestRequest, done: DoneFn) -> None:
+        default_index = req.params.get("index")
+        lines = []
+        for line in req.raw_body.decode("utf-8").splitlines():
+            line = line.strip()
+            if line:
+                lines.append(json.loads(line))
+        items = parse_bulk_body(lines)
+        for item in items:
+            if item["index"] is None:
+                item["index"] = default_index
+            if item["index"] is None:
+                raise IllegalArgumentError(
+                    "explicit index in bulk is required")
+
+        def cb(resp, err=None):
+            if err is not None:
+                respond_error(done, err)
+                return
+            if req.query.get("refresh") in ("true", "wait_for", ""):
+                indices = ",".join({i["index"] for i in items})
+                client.refresh(indices,
+                               lambda _r, _e=None: done(200, resp))
+            else:
+                done(200, resp)
+        client.bulk(items, cb)
+    r("POST", "/_bulk", bulk)
+    r("PUT", "/_bulk", bulk)
+    r("POST", "/{index}/_bulk", bulk)
+
+    # -- search -----------------------------------------------------------
+
+    def search(req: RestRequest, done: DoneFn) -> None:
+        index = req.params.get("index", "_all")
+        body = dict(req.body or {})
+        if "size" in req.query:
+            body["size"] = int(req.query["size"])
+        if "from" in req.query:
+            body["from"] = int(req.query["from"])
+        q = req.query.get("q")
+        if q:
+            body["query"] = _uri_query(q)
+        if "sort" in req.query:
+            body["sort"] = [
+                ({part.split(":")[0]: part.split(":")[1]}
+                 if ":" in part else part)
+                for part in req.query["sort"].split(",")]
+        search_type = req.query.get("search_type", "query_then_fetch")
+        client.search(index, body, wrap_client_cb(done),
+                      search_type=search_type)
+    r("GET", "/_search", search)
+    r("POST", "/_search", search)
+    r("GET", "/{index}/_search", search)
+    r("POST", "/{index}/_search", search)
+
+    def count(req: RestRequest, done: DoneFn) -> None:
+        index = req.params.get("index", "_all")
+        body = dict(req.body or {})
+        q = req.query.get("q")
+        if q:
+            body["query"] = _uri_query(q)
+        client.count(index, body, wrap_client_cb(done))
+    r("GET", "/_count", count)
+    r("POST", "/_count", count)
+    r("GET", "/{index}/_count", count)
+    r("POST", "/{index}/_count", count)
+
+    def msearch(req: RestRequest, done: DoneFn) -> None:
+        lines = [json.loads(ln) for ln in
+                 req.raw_body.decode("utf-8").splitlines() if ln.strip()]
+        pairs = []
+        i = 0
+        while i + 1 <= len(lines) - 1:
+            header, body = lines[i], lines[i + 1]
+            pairs.append((header.get("index",
+                                     req.params.get("index", "_all")), body))
+            i += 2
+        responses: List[Optional[Dict[str, Any]]] = [None] * len(pairs)
+        if not pairs:
+            done(200, {"responses": []})
+            return
+        pending = {"n": len(pairs)}
+
+        def one(pos: int, index: str, body: Dict[str, Any]) -> None:
+            def cb(resp, err=None):
+                responses[pos] = (resp if err is None else
+                                  {"error": {"type": type(err).__name__,
+                                             "reason": str(err)},
+                                   "status": getattr(err, "status", 500)})
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    done(200, {"responses": responses})
+            client.search(index, body, cb)
+        for pos, (index, body) in enumerate(pairs):
+            one(pos, index, body)
+    r("POST", "/_msearch", msearch)
+    r("GET", "/_msearch", msearch)
+    r("POST", "/{index}/_msearch", msearch)
+
+    # -- index admin ------------------------------------------------------
+
+    def index_create(req: RestRequest, done: DoneFn) -> None:
+        def cb(resp, err=None):
+            if err is not None:
+                respond_error(done, err)
+            else:
+                done(200, {"acknowledged": True,
+                           "shards_acknowledged": True,
+                           "index": req.params["index"]})
+        client.create_index(req.params["index"], req.body or {}, cb)
+    r("PUT", "/{index}", index_create)
+
+    def index_delete(req: RestRequest, done: DoneFn) -> None:
+        client.delete_index(req.params["index"], wrap_client_cb(done))
+    r("DELETE", "/{index}", index_delete)
+
+    def index_get(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        meta = state.metadata.index(req.params["index"])
+        done(200, {meta.name: {
+            "aliases": {a: {} for a in meta.aliases},
+            "mappings": dict(meta.mappings),
+            "settings": {"index": {
+                "number_of_shards": str(meta.number_of_shards),
+                "number_of_replicas": str(meta.number_of_replicas),
+                "uuid": meta.uuid, **dict(meta.settings)}},
+        }})
+    r("GET", "/{index}", index_get)
+
+    def mapping_put(req: RestRequest, done: DoneFn) -> None:
+        client.put_mapping(req.params["index"], req.body or {},
+                           wrap_client_cb(done))
+    r("PUT", "/{index}/_mapping", mapping_put)
+    r("POST", "/{index}/_mapping", mapping_put)
+
+    def mapping_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.get_mapping(req.params["index"]))
+    r("GET", "/{index}/_mapping", mapping_get)
+
+    def settings_put(req: RestRequest, done: DoneFn) -> None:
+        body = req.body or {}
+        settings = body.get("index", body.get("settings", body))
+        client.update_settings(req.params["index"], settings,
+                               wrap_client_cb(done))
+    r("PUT", "/{index}/_settings", settings_put)
+
+    def settings_get(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        meta = state.metadata.index(req.params["index"])
+        done(200, {meta.name: {"settings": {"index": {
+            "number_of_shards": str(meta.number_of_shards),
+            "number_of_replicas": str(meta.number_of_replicas),
+            "uuid": meta.uuid, **dict(meta.settings)}}}})
+    r("GET", "/{index}/_settings", settings_get)
+
+    def aliases_post(req: RestRequest, done: DoneFn) -> None:
+        client.update_aliases((req.body or {}).get("actions", []),
+                              wrap_client_cb(done))
+    r("POST", "/_aliases", aliases_post)
+
+    def alias_get(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        out: Dict[str, Any] = {}
+        for meta in state.metadata.indices.values():
+            if meta.aliases:
+                out[meta.name] = {"aliases": {a: {} for a in meta.aliases}}
+        done(200, out)
+    r("GET", "/_alias", alias_get)
+
+    def refresh(req: RestRequest, done: DoneFn) -> None:
+        client.refresh(req.params.get("index", "_all"),
+                       wrap_client_cb(done))
+    r("POST", "/_refresh", refresh)
+    r("POST", "/{index}/_refresh", refresh)
+    r("GET", "/{index}/_refresh", refresh)
+
+    def flush(req: RestRequest, done: DoneFn) -> None:
+        client.flush(req.params.get("index", "_all"), wrap_client_cb(done))
+    r("POST", "/_flush", flush)
+    r("POST", "/{index}/_flush", flush)
+
+    def forcemerge(req: RestRequest, done: DoneFn) -> None:
+        client.force_merge(
+            req.params.get("index", "_all"), wrap_client_cb(done),
+            max_num_segments=int(req.query.get("max_num_segments", 1)))
+    r("POST", "/_forcemerge", forcemerge)
+    r("POST", "/{index}/_forcemerge", forcemerge)
+
+    def index_stats(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.nodes_stats())
+    r("GET", "/{index}/_stats", index_stats)
+    r("GET", "/_stats", index_stats)
+
+    # -- cluster ----------------------------------------------------------
+
+    def health(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.cluster_health(req.params.get("index")))
+    r("GET", "/_cluster/health", health)
+    r("GET", "/_cluster/health/{index}", health)
+
+    def cluster_state(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.cluster_state())
+    r("GET", "/_cluster/state", cluster_state)
+
+    def cluster_settings_put(req: RestRequest, done: DoneFn) -> None:
+        client.cluster_update_settings(req.body or {}, wrap_client_cb(done))
+    r("PUT", "/_cluster/settings", cluster_settings_put)
+
+    def cluster_settings_get(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        done(200, {"persistent": dict(state.metadata.persistent_settings),
+                   "transient": {}})
+    r("GET", "/_cluster/settings", cluster_settings_get)
+
+    def nodes(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        done(200, {"_nodes": {"total": len(state.nodes)},
+                   "cluster_name": state.cluster_name,
+                   "nodes": {nid: n.to_dict()
+                             for nid, n in state.nodes.items()}})
+    r("GET", "/_nodes", nodes)
+
+    def nodes_stats(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.nodes_stats())
+    r("GET", "/_nodes/stats", nodes_stats)
+
+    # -- cat (human tables) ----------------------------------------------
+
+    def cat_indices(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        rows = []
+        for meta in state.metadata.indices.values():
+            h = client.cluster_health(meta.name)
+            rows.append([h["status"], "open", meta.name, meta.uuid,
+                         str(meta.number_of_shards),
+                         str(meta.number_of_replicas)])
+        done(200, _cat(req, ["health", "status", "index", "uuid",
+                             "pri", "rep"], rows))
+    r("GET", "/_cat/indices", cat_indices)
+
+    def cat_health(req: RestRequest, done: DoneFn) -> None:
+        h = client.cluster_health()
+        done(200, _cat(req, ["cluster", "status", "node.total",
+                             "shards", "pri", "unassign"],
+                       [[h["cluster_name"], h["status"],
+                         str(h["number_of_nodes"]),
+                         str(h["active_shards"]),
+                         str(h["active_primary_shards"]),
+                         str(h["unassigned_shards"])]]))
+    r("GET", "/_cat/health", cat_health)
+
+    def cat_shards(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        rows = []
+        for sr in state.routing_table.all_shards():
+            rows.append([sr.index, str(sr.shard_id),
+                         "p" if sr.primary else "r",
+                         sr.state.value, sr.node_id or "-"])
+        done(200, _cat(req, ["index", "shard", "prirep", "state", "node"],
+                       rows))
+    r("GET", "/_cat/shards", cat_shards)
+
+    def cat_nodes(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        rows = []
+        for nid, n in state.nodes.items():
+            roles = "".join(sorted(role[0] for role in n.roles))
+            master = "*" if nid == state.master_node_id else "-"
+            rows.append([nid, roles, master, n.name or nid])
+        done(200, _cat(req, ["id", "node.role", "master", "name"], rows))
+    r("GET", "/_cat/nodes", cat_nodes)
+
+    return rc
+
+
+def _int_or_none(v: Optional[str]) -> Optional[int]:
+    return int(v) if v is not None else None
+
+
+def _uri_query(q: str) -> Dict[str, Any]:
+    """?q= URI search: 'field:value' → match on field; bare text → multi
+    match over all text fields (query_string-lite)."""
+    if ":" in q:
+        field, _, text = q.partition(":")
+        return {"match": {field.strip(): text.strip()}}
+    return {"multi_match": {"query": q, "fields": ["*"]}}
+
+
+def _cat(req: RestRequest, headers: List[str],
+         rows: List[List[str]]) -> str:
+    """Fixed-width text table; ?v adds the header row (cat API contract)."""
+    show_header = req.flag("v")
+    table = ([headers] if show_header else []) + rows
+    if not table:
+        return ""
+    widths = [max(len(str(row[i])) for row in table)
+              for i in range(len(headers))]
+    lines = [" ".join(str(cell).ljust(w)
+                      for cell, w in zip(row, widths)).rstrip()
+             for row in table]
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,199 @@
+"""HTTP server: the user-facing port.
+
+Reference analog: http/AbstractHttpServerTransport + the Netty4 impl
+(modules/transport-netty4/.../Netty4HttpServerTransport.java:87). Here the
+event loop is asyncio (the control plane is host-side Python by design,
+SURVEY.md §7); request handling bridges to the node's scheduler thread and
+resolves back through the loop.
+
+Run a single-node dev cluster:  python -m elasticsearch_tpu.rest.server
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import signal
+import sys
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qsl, urlsplit
+
+from elasticsearch_tpu.node.node import NodeClient
+from elasticsearch_tpu.rest.controller import RestController, RestRequest
+from elasticsearch_tpu.rest.routes import build_controller
+
+MAX_BODY = 100 * 1024 * 1024   # http.max_content_length default (100mb)
+
+
+class _BadRequest(Exception):
+    """Malformed HTTP request: answered with a 400, then the connection
+    closes (the HTTP pipeline can't resync after a framing error)."""
+
+
+class HttpServer:
+    def __init__(self, client: NodeClient, host: str = "127.0.0.1",
+                 port: int = 9200):
+        self.client = client
+        self.controller: RestController = build_controller(client)
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------------
+
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    request = await self._read_request(reader)
+                except _BadRequest as e:
+                    await self._write_response(
+                        writer, 400,
+                        {"error": {"type": "illegal_argument_exception",
+                                   "reason": str(e)}, "status": 400})
+                    break
+                if request is None:
+                    break
+                status, body = await self._dispatch(request)
+                await self._write_response(writer, status, body,
+                                           head=request.method == "HEAD")
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    async def _read_request(self, reader: asyncio.StreamReader
+                            ) -> Optional[RestRequest]:
+        try:
+            request_line = await reader.readline()
+        except (ConnectionError, asyncio.LimitOverrunError):
+            return None
+        if not request_line:
+            return None
+        parts = request_line.decode("latin-1").strip().split()
+        if len(parts) < 2:
+            return None
+        method, target = parts[0].upper(), parts[1]
+        headers: Dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            key, _, value = line.decode("latin-1").partition(":")
+            headers[key.strip().lower()] = value.strip()
+        try:
+            length = int(headers.get("content-length", 0))
+        except ValueError:
+            raise _BadRequest("invalid Content-Length header")
+        if length > MAX_BODY:
+            raise _BadRequest(
+                f"request body larger than http.max_content_length "
+                f"[{MAX_BODY}]")
+        raw = await reader.readexactly(length) if length else b""
+        split = urlsplit(target)
+        query = dict(parse_qsl(split.query, keep_blank_values=True))
+        body: Any = None
+        if raw and "json" in headers.get("content-type", "json"):
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                body = None
+        return RestRequest(method=method, path=split.path, query=query,
+                           body=body, raw_body=raw)
+
+    async def _dispatch(self, request: RestRequest) -> Tuple[int, Any]:
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+
+        def on_done(status: int, body: Any) -> None:
+            # handlers complete on the node's scheduler thread
+            loop.call_soon_threadsafe(
+                lambda: future.done() or future.set_result((status, body)))
+
+        # dispatch on the scheduler thread so all node-internal callbacks
+        # stay single-threaded (the applier-thread discipline)
+        self.client.node.scheduler.submit(
+            lambda: self.controller.dispatch(request, on_done))
+        return await future
+
+    async def _write_response(self, writer: asyncio.StreamWriter,
+                              status: int, body: Any,
+                              head: bool = False) -> None:
+        if isinstance(body, str):
+            payload = body.encode("utf-8")
+            ctype = "text/plain; charset=UTF-8"
+        else:
+            payload = json.dumps(body).encode("utf-8")
+            ctype = "application/json; charset=UTF-8"
+        reason = {200: "OK", 201: "Created", 404: "Not Found",
+                  400: "Bad Request", 405: "Method Not Allowed",
+                  409: "Conflict", 429: "Too Many Requests",
+                  500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(status, "OK")
+        head_lines = (f"HTTP/1.1 {status} {reason}\r\n"
+                      f"content-type: {ctype}\r\n"
+                      f"content-length: {len(payload)}\r\n"
+                      f"\r\n").encode("latin-1")
+        writer.write(head_lines + (b"" if head else payload))
+        await writer.drain()
+
+
+def run_single_node(host: str = "127.0.0.1", port: int = 9200,
+                    data_path: Optional[str] = None) -> None:
+    """Boot a one-node cluster on the threaded scheduler and serve HTTP
+    (bootstrap/Elasticsearch.main analog for the dev distribution)."""
+    import time
+
+    from elasticsearch_tpu.cluster.state import ClusterState
+    from elasticsearch_tpu.node.node import Node
+    from elasticsearch_tpu.transport.scheduler import ThreadedScheduler
+    from elasticsearch_tpu.transport.transport import InMemoryTransport
+
+    scheduler = ThreadedScheduler()
+    transport = InMemoryTransport(scheduler, default_latency=0.0)
+    node = Node("node0", transport, scheduler, seed_peers=["node0"],
+                data_path=data_path,
+                initial_state=ClusterState(voting_config=frozenset(["node0"])))
+    node.start()
+    deadline = time.monotonic() + 30
+    while node.coordinator.mode != "LEADER":
+        if time.monotonic() > deadline:
+            raise RuntimeError("single node failed to elect itself")
+        time.sleep(0.05)
+
+    server = HttpServer(node.client, host, port)
+
+    async def main() -> None:
+        await server.start()
+        print(f"elasticsearch_tpu node listening on http://{host}:{port}")
+        stop = asyncio.Event()
+        try:
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGINT, stop.set)
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGTERM, stop.set)
+        except NotImplementedError:
+            pass
+        await stop.wait()
+        await server.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        node.stop()
+
+
+if __name__ == "__main__":
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 9200
+    data = sys.argv[2] if len(sys.argv) > 2 else None
+    run_single_node(port=port, data_path=data)

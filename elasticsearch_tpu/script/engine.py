@@ -51,10 +51,22 @@ _ALLOWED_NODES = (
     ast.GeneratorExp, ast.JoinedStr, ast.FormattedValue,
 )
 
+_MAX_RANGE = 1_000_000      # largest range() a script may materialize
+_MAX_SEQ = 1_000_000        # largest string/list a script op may build
+
+
+def _bounded_range(*a: Any) -> range:
+    r = range(*(int(x) for x in a))
+    if len(r) > _MAX_RANGE:
+        raise CircuitBreakingScriptError(
+            f"range of {len(r)} exceeds the script limit [{_MAX_RANGE}]")
+    return r
+
+
 _SAFE_BUILTINS: Dict[str, Any] = {
     "abs": abs, "min": min, "max": max, "len": len, "round": round,
     "sum": sum, "sorted": sorted, "float": float, "int": int, "str": str,
-    "bool": bool, "range": lambda *a: range(*(int(x) for x in a)),
+    "bool": bool, "range": _bounded_range,
     "list": list, "dict": dict, "set": set,
 }
 
@@ -475,10 +487,22 @@ class _Interpreter:
     def _binop(self, op: ast.operator, left: Any, right: Any) -> Any:
         left, right = _unwrap(left), _unwrap(right)
         if isinstance(op, ast.Add):
+            if isinstance(left, (str, list, tuple)) and \
+                    len(left) + (len(right) if hasattr(right, "__len__")
+                                 else 0) > _MAX_SEQ:
+                raise CircuitBreakingScriptError(
+                    "script concatenation exceeds the size limit")
             return left + right
         if isinstance(op, ast.Sub):
             return left - right
         if isinstance(op, ast.Mult):
+            # a single 'x' * 10**9 costs one interpreter step but unbounded
+            # memory: bound sequence repetition explicitly
+            for seq, n in ((left, right), (right, left)):
+                if isinstance(seq, (str, list, tuple)) and \
+                        isinstance(n, int) and len(seq) * max(n, 0) > _MAX_SEQ:
+                    raise CircuitBreakingScriptError(
+                        "script repetition exceeds the size limit")
             return left * right
         if isinstance(op, ast.Div):
             return left / right
@@ -487,6 +511,11 @@ class _Interpreter:
         if isinstance(op, ast.Mod):
             return left % right
         if isinstance(op, ast.Pow):
+            # bigint pow bombs (9**9**9) are one step yet unbounded compute
+            if isinstance(left, int) and isinstance(right, int) and \
+                    abs(left) > 1 and abs(right) > 4096:
+                raise CircuitBreakingScriptError(
+                    "script exponent exceeds the limit [4096]")
             return left ** right
         raise ScriptException(f"unsupported operator [{type(op).__name__}]")
 

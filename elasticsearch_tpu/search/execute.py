@@ -298,9 +298,22 @@ def _h_match(q: dsl.Match, ctx: SegmentContext) -> Result:
 
 def _h_multi_match(q: dsl.MultiMatch, ctx: SegmentContext) -> Result:
     results = []
+    expanded: dict = {}   # field -> boost; dedup keeps the highest boost
     for f in q.fields:
         fname, _, fboost = f.partition("^")
         boost = q.boost * (float(fboost) if fboost else 1.0)
+        if "*" in fname:
+            # wildcard field patterns expand to matching text-ish fields
+            # (QueryParserHelper.resolveMappingFields analog; resolved
+            # fields are DEDUPED so most_fields never double-counts)
+            for name in ctx.mappers.field_names():
+                if fnmatch.fnmatch(name, fname) and \
+                        ctx.mappers.field_type(name) in (
+                            "text", "keyword", "search_as_you_type"):
+                    expanded[name] = max(expanded.get(name, 0.0), boost)
+        else:
+            expanded[fname] = max(expanded.get(fname, 0.0), boost)
+    for fname, boost in expanded.items():
         results.append(execute(dsl.Match(field=fname, text=q.text,
                                          operator=q.operator, boost=boost), ctx))
     if not results:

@@ -89,23 +89,25 @@ class InProcessCluster:
 
     def ensure_green(self, index: Optional[str] = None,
                      max_time: float = 120.0) -> None:
-        def green() -> bool:
-            master = self.master()
-            if master is None:
-                return False
-            health = master.client.cluster_health(index)
-            return health["status"] == "green"
-        self.run_until(green, max_time)
+        self._ensure_status(("green",), index, max_time)
 
     def ensure_yellow(self, index: Optional[str] = None,
                       max_time: float = 120.0) -> None:
-        def at_least_yellow() -> bool:
+        self._ensure_status(("yellow", "green"), index, max_time)
+
+    def _ensure_status(self, ok, index, max_time) -> None:
+        def ready() -> bool:
             master = self.master()
             if master is None:
                 return False
-            return master.client.cluster_health(index)["status"] in (
-                "yellow", "green")
-        self.run_until(at_least_yellow, max_time)
+            if master.client.cluster_health(index)["status"] not in ok:
+                return False
+            # every node must have APPLIED the state it's judged by —
+            # clients read their local node's applied state
+            version = master.coordinator.applied_state.version
+            return all(n.coordinator.applied_state.version >= version
+                       for n in self.nodes.values())
+        self.run_until(ready, max_time)
 
     def await_node_count(self, n: int, max_time: float = 300.0) -> None:
         """Wait until the master's committed membership has exactly n nodes

@@ -30,13 +30,23 @@ class NodeNotConnectedError(ConnectTransportError):
 
 
 class RemoteTransportError(TransportError):
-    """The remote handler raised; wraps the original error by name."""
+    """The remote handler raised; wraps the original error by name and
+    rehydrates its HTTP status so the REST layer maps it faithfully
+    (ElasticsearchException wire serialization analog)."""
 
     def __init__(self, node_id: str, action: str, cause: str) -> None:
         super().__init__(f"[{node_id}][{action}] remote error: {cause}")
         self.node_id = node_id
         self.action = action
         self.cause = cause
+        self.cause_type, _, self.cause_reason = cause.partition(": ")
+        from elasticsearch_tpu.utils import errors as _errors
+        original = getattr(_errors, self.cause_type, None)
+        if isinstance(original, type) and \
+                issubclass(original, _errors.SearchEngineError):
+            self.status = original.status
+        else:
+            self.cause_type = ""
 
 
 Handler = Callable[[Dict[str, Any], str], Dict[str, Any]]

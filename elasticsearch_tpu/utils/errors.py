@@ -11,6 +11,21 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 
+def exception_type_name(class_name: str) -> str:
+    """CamelCase class name -> snake_case '_exception' wire name, e.g.
+    IndexNotFoundError -> index_not_found_exception (ES-compatible)."""
+    if class_name.endswith("Error"):
+        class_name = class_name[: -len("Error")]
+    elif class_name.endswith("Exception"):
+        class_name = class_name[: -len("Exception")]
+    out = []
+    for i, ch in enumerate(class_name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out) + "_exception"
+
+
 class SearchEngineError(Exception):
     """Base for all engine errors. Carries an HTTP status code."""
 
@@ -23,16 +38,7 @@ class SearchEngineError(Exception):
 
     @property
     def error_type(self) -> str:
-        # e.g. IndexNotFoundError -> index_not_found_exception (ES-compatible suffix)
-        name = type(self).__name__
-        if name.endswith("Error"):
-            name = name[: -len("Error")]
-        out = []
-        for i, ch in enumerate(name):
-            if ch.isupper() and i > 0:
-                out.append("_")
-            out.append(ch.lower())
-        return "".join(out) + "_exception"
+        return exception_type_name(type(self).__name__)
 
     def to_json(self) -> Dict[str, Any]:
         body: Dict[str, Any] = {"type": self.error_type, "reason": self.message}

@@ -1,0 +1,243 @@
+"""REST layer tests: routing, handlers, error mapping, HTTP round-trip.
+
+The controller-level tests run over the deterministic cluster (the YAML-ish
+black-box style of the reference's rest-api-spec tests); the HTTP test
+boots a real single-node server on the threaded scheduler.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from elasticsearch_tpu.rest.controller import RestRequest
+from elasticsearch_tpu.rest.routes import build_controller
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=2, seed=5)
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def rest(cluster):
+    controller = build_controller(cluster.client())
+
+    def do(method, path, body=None, query=None, raw=None):
+        req = RestRequest(
+            method=method, path=path, query=dict(query or {}),
+            body=body,
+            raw_body=(raw.encode() if isinstance(raw, str) else (raw or b"")))
+        out = []
+        controller.dispatch(req, lambda s, b: out.append((s, b)))
+        cluster.run_until(lambda: bool(out), 120.0)
+        return out[0]
+    return do
+
+
+def test_root(rest):
+    status, body = rest("GET", "/")
+    assert status == 200
+    assert body["tagline"] == "You Know, for Search"
+
+
+def test_index_lifecycle(rest):
+    status, body = rest("PUT", "/books", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"title": {"type": "text"}}}})
+    assert status == 200 and body["acknowledged"]
+
+    status, body = rest("GET", "/books")
+    assert status == 200
+    assert body["books"]["settings"]["index"]["number_of_shards"] == "2"
+
+    status, body = rest("PUT", "/books", {})
+    assert status == 400   # already exists
+
+    status, body = rest("DELETE", "/books")
+    assert status == 200 and body["acknowledged"]
+
+    status, body = rest("GET", "/books")
+    assert status == 404
+    assert body["error"]["type"] == "index_not_found_exception"
+
+
+def test_doc_crud_and_search(rest):
+    rest("PUT", "/lib", {"settings": {"number_of_replicas": 0}})
+    status, body = rest("PUT", "/lib/_doc/1",
+                        {"title": "the jax book", "pages": 300})
+    assert status == 201 and body["result"] == "created"
+
+    status, body = rest("GET", "/lib/_doc/1")
+    assert status == 200 and body["_source"]["pages"] == 300
+
+    status, body = rest("GET", "/lib/_source/1")
+    assert status == 200 and body == {"title": "the jax book", "pages": 300}
+
+    status, body = rest("POST", "/lib/_update/1",
+                        {"doc": {"pages": 301}})
+    assert status == 200
+
+    rest("POST", "/lib/_refresh")
+    status, body = rest("GET", "/lib/_search",
+                        query={"q": "title:jax"})
+    assert status == 200
+    assert body["hits"]["total"]["value"] == 1
+    assert body["hits"]["hits"][0]["_source"]["pages"] == 301
+
+    # bare q searches all text fields
+    status, body = rest("GET", "/lib/_search", query={"q": "jax"})
+    assert status == 200 and body["hits"]["total"]["value"] == 1
+
+    status, body = rest("DELETE", "/lib/_doc/1")
+    assert status == 200 and body["result"] == "deleted"
+    status, body = rest("GET", "/lib/_doc/1")
+    assert status == 404
+
+
+def test_bulk_ndjson(rest):
+    ndjson = "\n".join([
+        json.dumps({"index": {"_index": "bulk1", "_id": "a"}}),
+        json.dumps({"n": 1}),
+        json.dumps({"create": {"_index": "bulk1", "_id": "b"}}),
+        json.dumps({"n": 2}),
+        json.dumps({"update": {"_index": "bulk1", "_id": "a"}}),
+        json.dumps({"doc": {"extra": True}}),
+        json.dumps({"delete": {"_index": "bulk1", "_id": "missing"}}),
+    ]) + "\n"
+    status, body = rest("POST", "/_bulk", raw=ndjson,
+                        query={"refresh": "true"})
+    assert status == 200
+    kinds = [next(iter(item)) for item in body["items"]]
+    assert kinds == ["index", "create", "update", "delete"]
+    assert body["items"][0]["index"]["result"] == "created"
+    assert body["items"][2]["update"]["result"] == "updated"
+    assert body["items"][3]["delete"]["result"] == "not_found"
+
+    status, body = rest("GET", "/bulk1/_count")
+    assert body["count"] == 2
+
+
+def test_msearch(rest):
+    rest("PUT", "/m1", {"settings": {"number_of_replicas": 0}})
+    rest("PUT", "/m1/_doc/1", {"x": "alpha"}, query={"refresh": "true"})
+    raw = "\n".join([
+        json.dumps({"index": "m1"}),
+        json.dumps({"query": {"match": {"x": "alpha"}}}),
+        json.dumps({"index": "m1"}),
+        json.dumps({"query": {"match": {"x": "beta"}}}),
+    ]) + "\n"
+    status, body = rest("POST", "/_msearch", raw=raw)
+    assert status == 200
+    assert body["responses"][0]["hits"]["total"]["value"] == 1
+    assert body["responses"][1]["hits"]["total"]["value"] == 0
+
+
+def test_cluster_and_cat(rest, cluster):
+    rest("PUT", "/cat1", {"settings": {"number_of_replicas": 0}})
+    cluster.ensure_green("cat1")
+    status, body = rest("GET", "/_cluster/health")
+    assert status == 200 and body["status"] in ("green", "yellow")
+
+    status, body = rest("GET", "/_cat/indices", query={"v": "true"})
+    assert status == 200 and "cat1" in body and body.startswith("health")
+
+    status, body = rest("GET", "/_cat/nodes")
+    assert status == 200 and "node0" in body
+
+    status, body = rest("GET", "/_nodes")
+    assert body["_nodes"]["total"] == 2
+
+    status, body = rest("PUT", "/_cluster/settings",
+                        {"persistent": {"my.flag": "on"}})
+    assert status == 200
+    status, body = rest("GET", "/_cluster/settings")
+    assert body["persistent"]["my.flag"] == "on"
+
+
+def test_error_shapes(rest):
+    status, body = rest("GET", "/nope/_doc/1")
+    assert status == 404
+    assert body["error"]["type"] == "index_not_found_exception"
+
+    # matches the /{index} wildcard without a POST handler, like the
+    # reference's trie (405, not 404)
+    status, body = rest("POST", "/_no_such_endpoint")
+    assert status == 405
+
+    status, body = rest("POST", "/a/b/c/d/e")
+    assert status == 404
+    assert "no handler" in body["error"]["reason"]
+
+    status, body = rest("DELETE", "/_search")
+    assert status == 405
+
+
+def test_http_server_round_trip(tmp_path):
+    """Real sockets: boot a single node + HTTP server, speak HTTP/1.1."""
+    import threading
+    import time as time_mod
+
+    from elasticsearch_tpu.cluster.state import ClusterState
+    from elasticsearch_tpu.node.node import Node
+    from elasticsearch_tpu.rest.server import HttpServer
+    from elasticsearch_tpu.transport.scheduler import ThreadedScheduler
+    from elasticsearch_tpu.transport.transport import InMemoryTransport
+
+    scheduler = ThreadedScheduler()
+    transport = InMemoryTransport(scheduler, default_latency=0.0)
+    node = Node("node0", transport, scheduler, seed_peers=["node0"],
+                initial_state=ClusterState(
+                    voting_config=frozenset(["node0"])))
+    node.start()
+    deadline = time_mod.monotonic() + 30
+    while node.coordinator.mode != "LEADER":
+        assert time_mod.monotonic() < deadline, "no election"
+        time_mod.sleep(0.02)
+
+    async def scenario():
+        server = HttpServer(node.client, host="127.0.0.1", port=0)
+        await server.start()
+        port = server._server.sockets[0].getsockname()[1]
+
+        async def call(method, target, payload=None):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            data = json.dumps(payload).encode() if payload is not None else b""
+            writer.write(
+                f"{method} {target} HTTP/1.1\r\n"
+                f"content-type: application/json\r\n"
+                f"content-length: {len(data)}\r\n\r\n".encode() + data)
+            await writer.drain()
+            status_line = await reader.readline()
+            status = int(status_line.split()[1])
+            length = 0
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n"):
+                    break
+                if line.lower().startswith(b"content-length"):
+                    length = int(line.split(b":")[1])
+            body = await reader.readexactly(length)
+            writer.close()
+            return status, json.loads(body) if body else None
+
+        status, body = await call("GET", "/")
+        assert status == 200 and "tagline" in body
+        status, body = await call("PUT", "/web", {
+            "settings": {"number_of_replicas": 0}})
+        assert status == 200, body
+        status, body = await call("PUT", "/web/_doc/1?refresh=true",
+                                  {"msg": "hello tpu"})
+        assert status == 201, body
+        status, body = await call("GET", "/web/_search?q=msg:hello")
+        assert status == 200 and body["hits"]["total"]["value"] == 1
+        await server.stop()
+
+    try:
+        asyncio.run(asyncio.wait_for(scenario(), timeout=60))
+    finally:
+        node.stop()
