@@ -14,7 +14,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 from elasticsearch_tpu.cluster.allocation import AllocationService
 from elasticsearch_tpu.cluster.coordination import Coordinator
-from elasticsearch_tpu.cluster.metadata import IndexMetadata
+from elasticsearch_tpu.cluster.metadata import (
+    IndexMetadata, resolve_index_expression,
+)
 from elasticsearch_tpu.cluster.routing import (
     IndexRoutingTable, ShardRouting, ShardState,
 )
@@ -37,6 +39,7 @@ CLUSTER_UPDATE_SETTINGS = "cluster:admin/settings/update"
 REFRESH_SHARD = "indices:admin/refresh[s]"
 FLUSH_SHARD = "indices:admin/flush[s]"
 FORCEMERGE_SHARD = "indices:admin/forcemerge[s]"
+STATS_SHARD = "indices:monitor/stats[s]"
 
 MASTER_RETRY_DELAY = 0.2
 MASTER_TIMEOUT = 30.0
@@ -266,6 +269,7 @@ class BroadcastActions:
         ts.register_handler(REFRESH_SHARD, self._on_refresh)
         ts.register_handler(FLUSH_SHARD, self._on_flush)
         ts.register_handler(FORCEMERGE_SHARD, self._on_forcemerge)
+        ts.register_handler(STATS_SHARD, self._on_stats)
 
     def _on_refresh(self, req, sender):
         self.indices.shard(req["index"], req["shard"]).engine.refresh()
@@ -280,15 +284,22 @@ class BroadcastActions:
             req.get("max_num_segments", 1))
         return {"ok": True}
 
+    def _on_stats(self, req, sender):
+        shard = self.indices.shard(req["index"], req["shard"])
+        stats = shard.engine.stats()
+        return {"primary": shard.primary,
+                "docs": stats.get("doc_count", 0),
+                "segments": stats.get("num_segments", 0),
+                "translog_ops": stats.get("translog_ops", 0)}
+
     def broadcast(self, action: str, index_expression: str,
                   on_done: Callable[[Dict[str, Any]], None],
-                  extra: Optional[Dict[str, Any]] = None) -> None:
+                  extra: Optional[Dict[str, Any]] = None,
+                  names: Optional[List[str]] = None) -> None:
         state = self.state()
         targets: List[ShardRouting] = []
-        names = ([n for n in state.metadata.indices]
-                 if index_expression in ("_all", "*", "", None)
-                 else [state.metadata.index(n.strip()).name
-                       for n in index_expression.split(",")])
+        if names is None:
+            names = resolve_index_expression(index_expression, state.metadata)
         for name in names:
             if not state.routing_table.has_index(name):
                 continue
@@ -296,8 +307,9 @@ class BroadcastActions:
                 if sr.active and sr.node_id is not None:
                     targets.append(sr)
         result = {"total": len(targets), "successful": 0, "failed": 0}
+        payloads: List[Dict[str, Any]] = []
         if not targets:
-            on_done({"_shards": result})
+            on_done({"_shards": result, "payloads": payloads})
             return
         pending = {"n": len(targets)}
 
@@ -308,11 +320,13 @@ class BroadcastActions:
             def cb(resp, err):
                 if err is None:
                     result["successful"] += 1
+                    payloads.append({"index": sr.index,
+                                     "shard": sr.shard_id, **resp})
                 else:
                     result["failed"] += 1
                 pending["n"] -= 1
                 if pending["n"] == 0:
-                    on_done({"_shards": result})
+                    on_done({"_shards": result, "payloads": payloads})
             self.ts.send_request(sr.node_id, action, req, cb, timeout=60.0)
         for sr in targets:
             one(sr)

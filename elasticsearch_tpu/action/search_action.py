@@ -183,30 +183,10 @@ class TransportSearchAction:
                          state: ClusterState) -> List[str]:
         """Comma lists, `*` wildcards, `_all`, aliases
         (IndexNameExpressionResolver analog)."""
-        names = set()
-        metadata = state.metadata
-        all_names = list(metadata.indices)
-        alias_map: Dict[str, List[str]] = {}
-        for im in metadata.indices.values():
-            for alias in im.aliases:
-                alias_map.setdefault(alias, []).append(im.name)
-        for part in (expression or "_all").split(","):
-            part = part.strip()
-            if part in ("_all", "*", ""):
-                names.update(all_names)
-            elif "*" in part:
-                import fnmatch
-                matched = [n for n in all_names if fnmatch.fnmatch(n, part)]
-                matched += [n for a, targets in alias_map.items()
-                            if fnmatch.fnmatch(a, part) for n in targets]
-                names.update(matched)
-            elif part in metadata.indices:
-                names.add(part)
-            elif part in alias_map:
-                names.update(alias_map[part])
-            else:
-                raise IndexNotFoundError(part)
-        return sorted(names)
+        from elasticsearch_tpu.cluster.metadata import (
+            resolve_index_expression,
+        )
+        return resolve_index_expression(expression, state.metadata)
 
     def _shard_targets(self, indices: List[str], state: ClusterState
                        ) -> List[Dict[str, Any]]:

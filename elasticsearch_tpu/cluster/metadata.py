@@ -169,3 +169,35 @@ class Metadata:
             templates=dict(d.get("templates", {})),
             persistent_settings=dict(d.get("persistent_settings", {})),
             version=d.get("version", 0))
+
+
+def resolve_index_expression(expression: Optional[str],
+                             metadata: "Metadata") -> list:
+    """Resolve comma lists, ``*`` wildcards, ``_all`` and aliases to concrete
+    index names (IndexNameExpressionResolver analog,
+    cluster/metadata/IndexNameExpressionResolver.java). Unknown concrete
+    names raise IndexNotFoundError; unmatched wildcards resolve empty."""
+    import fnmatch
+
+    names = set()
+    all_names = list(metadata.indices)
+    alias_map: Dict[str, list] = {}
+    for im in metadata.indices.values():
+        for alias in im.aliases:
+            alias_map.setdefault(alias, []).append(im.name)
+    for part in (expression or "_all").split(","):
+        part = part.strip()
+        if part in ("_all", "*", ""):
+            names.update(all_names)
+        elif "*" in part:
+            matched = [n for n in all_names if fnmatch.fnmatch(n, part)]
+            matched += [n for a, targets in alias_map.items()
+                        if fnmatch.fnmatch(a, part) for n in targets]
+            names.update(matched)
+        elif part in metadata.indices:
+            names.add(part)
+        elif part in alias_map:
+            names.update(alias_map[part])
+        else:
+            raise IndexNotFoundError(part)
+    return sorted(names)

@@ -13,7 +13,8 @@ from typing import Any, Callable, Dict, List, Optional
 from elasticsearch_tpu.action.admin import (
     BroadcastActions, CLUSTER_UPDATE_SETTINGS, CREATE_INDEX, DELETE_INDEX,
     FLUSH_SHARD, FORCEMERGE_SHARD, MasterActions, MasterClient, PUT_MAPPING,
-    REFRESH_SHARD, UPDATE_ALIASES, UPDATE_SETTINGS, cluster_health,
+    REFRESH_SHARD, STATS_SHARD, UPDATE_ALIASES, UPDATE_SETTINGS,
+    cluster_health,
 )
 from elasticsearch_tpu.action.bulk import TransportBulkAction
 from elasticsearch_tpu.action.document import (
@@ -27,6 +28,7 @@ from elasticsearch_tpu.cluster.allocation import AllocationService
 from elasticsearch_tpu.cluster.coordination import (
     Coordinator, CoordinatorSettings, Mode,
 )
+from elasticsearch_tpu.cluster.metadata import resolve_index_expression
 from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode, Roles
 from elasticsearch_tpu.indices.cluster_state_service import (
     IndicesClusterStateService,
@@ -274,17 +276,62 @@ class NodeClient:
 
     def refresh(self, index_expression: str, on_done) -> None:
         self.node.broadcast_actions.broadcast(
-            REFRESH_SHARD, index_expression, lambda r: on_done(r, None))
+            REFRESH_SHARD, index_expression,
+            lambda r: on_done(_shards_only(r), None))
 
     def flush(self, index_expression: str, on_done) -> None:
         self.node.broadcast_actions.broadcast(
-            FLUSH_SHARD, index_expression, lambda r: on_done(r, None))
+            FLUSH_SHARD, index_expression,
+            lambda r: on_done(_shards_only(r), None))
 
     def force_merge(self, index_expression: str, on_done,
                     max_num_segments: int = 1) -> None:
         self.node.broadcast_actions.broadcast(
-            FORCEMERGE_SHARD, index_expression, lambda r: on_done(r, None),
+            FORCEMERGE_SHARD, index_expression,
+            lambda r: on_done(_shards_only(r), None),
             extra={"max_num_segments": max_num_segments})
+
+    def index_stats(self, index_expression: str, on_done) -> None:
+        """Per-index doc/segment stats aggregated over primary shards
+        (TransportIndicesStatsAction analog)."""
+        state = self.node._applied_state()
+        try:
+            names = resolve_index_expression(index_expression,
+                                             state.metadata)
+        except Exception as e:  # IndexNotFoundError → caller maps to 404
+            on_done(None, e)
+            return
+
+        def cb(r: Dict[str, Any]) -> None:
+            per_index: Dict[str, Dict[str, int]] = {
+                n: {"docs": 0, "segments": 0, "translog_ops": 0}
+                for n in names}
+            for p in r.get("payloads", []):
+                if not p.get("primary"):
+                    continue
+                agg = per_index.setdefault(
+                    p["index"],
+                    {"docs": 0, "segments": 0, "translog_ops": 0})
+                agg["docs"] += p.get("docs", 0)
+                agg["segments"] += p.get("segments", 0)
+                agg["translog_ops"] += p.get("translog_ops", 0)
+            indices_out = {}
+            total_docs = 0
+            for n in names:
+                agg = per_index[n]
+                total_docs += agg["docs"]
+                prim = {"docs": {"count": agg["docs"], "deleted": 0},
+                        "segments": {"count": agg["segments"]},
+                        "translog": {"operations": agg["translog_ops"]}}
+                indices_out[n] = {
+                    "uuid": state.metadata.index(n).uuid,
+                    "primaries": prim, "total": prim}
+            total = {"docs": {"count": total_docs, "deleted": 0}}
+            on_done({"_shards": r["_shards"],
+                     "_all": {"primaries": total, "total": total},
+                     "indices": indices_out}, None)
+        self.node.broadcast_actions.broadcast(STATS_SHARD, index_expression,
+                                              cb, names=names)
 
     # -- cluster --------------------------------------------------------
 
@@ -305,3 +352,7 @@ class NodeClient:
                 }
             }
         }
+
+
+def _shards_only(r: Dict[str, Any]) -> Dict[str, Any]:
+    return {"_shards": r["_shards"]}

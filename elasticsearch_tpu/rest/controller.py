@@ -115,12 +115,8 @@ class RestController:
                 f"allowed: {sorted(node.handlers)}", 405))
             return
         request.params.update(params)
-
-        def safe_done(status: int, body: Any) -> None:
-            on_done(status, body)
-
         try:
-            handler(request, safe_done)
+            handler(request, on_done)
         except SearchEngineError as e:
             on_done(e.status, _error_body(_error_type(e), str(e), e.status))
         except Exception as e:  # noqa: BLE001 — uniform 500 mapping

@@ -61,9 +61,9 @@ def build_controller(client: NodeClient) -> RestController:
         client.index_doc(req.params["index"], doc_id, req.body or {}, cb,
                          routing=req.query.get("routing"),
                          op_type=op_type,
-                         if_seq_no=_int_or_none(req.query.get("if_seq_no")),
-                         if_primary_term=_int_or_none(
-                             req.query.get("if_primary_term")))
+                         if_seq_no=_int_param(req, "if_seq_no", None),
+                         if_primary_term=_int_param(
+                             req, "if_primary_term", None))
 
     def doc_create(req: RestRequest, done: DoneFn) -> None:
         req.query["op_type"] = "create"
@@ -121,8 +121,8 @@ def build_controller(client: NodeClient) -> RestController:
                 done(200, resp)
         client.update(req.params["index"], req.params["id"], req.body or {},
                       cb, routing=req.query.get("routing"),
-                      retry_on_conflict=int(
-                          req.query.get("retry_on_conflict", 3)))
+                      retry_on_conflict=_int_param(
+                          req, "retry_on_conflict", 3))
     r("POST", "/{index}/_update/{id}", doc_update)
 
     # -- bulk -------------------------------------------------------------
@@ -163,9 +163,9 @@ def build_controller(client: NodeClient) -> RestController:
         index = req.params.get("index", "_all")
         body = dict(req.body or {})
         if "size" in req.query:
-            body["size"] = int(req.query["size"])
+            body["size"] = _int_param(req, "size")
         if "from" in req.query:
-            body["from"] = int(req.query["from"])
+            body["from"] = _int_param(req, "from")
         q = req.query.get("q")
         if q:
             body["query"] = _uri_query(q)
@@ -212,10 +212,14 @@ def build_controller(client: NodeClient) -> RestController:
 
         def one(pos: int, index: str, body: Dict[str, Any]) -> None:
             def cb(resp, err=None):
-                responses[pos] = (resp if err is None else
-                                  {"error": {"type": type(err).__name__,
-                                             "reason": str(err)},
-                                   "status": getattr(err, "status", 500)})
+                if err is None:
+                    responses[pos] = resp
+                else:
+                    # same wire shape (type rehydration incl.) as the
+                    # top-level error path
+                    respond_error(
+                        lambda _s, ebody: responses.__setitem__(pos, ebody),
+                        err)
                 pending["n"] -= 1
                 if pending["n"] == 0:
                     done(200, {"responses": responses})
@@ -311,12 +315,13 @@ def build_controller(client: NodeClient) -> RestController:
     def forcemerge(req: RestRequest, done: DoneFn) -> None:
         client.force_merge(
             req.params.get("index", "_all"), wrap_client_cb(done),
-            max_num_segments=int(req.query.get("max_num_segments", 1)))
+            max_num_segments=_int_param(req, "max_num_segments", 1))
     r("POST", "/_forcemerge", forcemerge)
     r("POST", "/{index}/_forcemerge", forcemerge)
 
     def index_stats(req: RestRequest, done: DoneFn) -> None:
-        done(200, client.nodes_stats())
+        client.index_stats(req.params.get("index", "_all"),
+                           wrap_client_cb(done))
     r("GET", "/{index}/_stats", index_stats)
     r("GET", "/_stats", index_stats)
 
@@ -402,8 +407,16 @@ def build_controller(client: NodeClient) -> RestController:
     return rc
 
 
-def _int_or_none(v: Optional[str]) -> Optional[int]:
-    return int(v) if v is not None else None
+def _int_param(req: RestRequest, name: str,
+               default: Optional[int] = None) -> Optional[int]:
+    v = req.query.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise IllegalArgumentError(
+            f"Failed to parse int parameter [{name}] with value [{v}]")
 
 
 def _uri_query(q: str) -> Dict[str, Any]:

@@ -76,17 +76,24 @@ class HttpServer:
                             ) -> Optional[RestRequest]:
         try:
             request_line = await reader.readline()
-        except (ConnectionError, asyncio.LimitOverrunError):
+        except ConnectionError:
             return None
+        except ValueError:
+            # StreamReader.readline wraps LimitOverrunError in ValueError
+            # for over-limit lines
+            raise _BadRequest("request line too long")
         if not request_line:
             return None
         parts = request_line.decode("latin-1").strip().split()
         if len(parts) < 2:
-            return None
+            raise _BadRequest("invalid HTTP request line")
         method, target = parts[0].upper(), parts[1]
         headers: Dict[str, str] = {}
         while True:
-            line = await reader.readline()
+            try:
+                line = await reader.readline()
+            except ValueError:
+                raise _BadRequest("header line too long")
             if line in (b"\r\n", b"\n", b""):
                 break
             key, _, value = line.decode("latin-1").partition(":")
@@ -94,6 +101,8 @@ class HttpServer:
         try:
             length = int(headers.get("content-length", 0))
         except ValueError:
+            raise _BadRequest("invalid Content-Length header")
+        if length < 0:
             raise _BadRequest("invalid Content-Length header")
         if length > MAX_BODY:
             raise _BadRequest(

@@ -473,12 +473,41 @@ class _Interpreter:
                   for kw in node.keywords if kw.arg}
         if not callable(fn):
             raise ScriptException(f"[{fn!r}] is not callable")
+        self._guard_amplifying_call(fn, args)
         try:
             return fn(*args, **kwargs)
         except (ScriptException, CircuitBreakingScriptError):
             raise
         except Exception as e:  # noqa: BLE001 — surfaced as script error
             raise ScriptException(f"script runtime error: {e}") from e
+
+    @staticmethod
+    def _guard_amplifying_call(fn: Any, args: List[Any]) -> None:
+        """Native str methods can amplify a bounded input into an unbounded
+        allocation in ONE interpreter step, sidestepping the per-op breaker
+        on Add/Mult — bound their result size before the call runs."""
+        name = getattr(fn, "__name__", "")
+        owner = getattr(fn, "__self__", None)
+        if name == "replace" and isinstance(owner, str) and len(args) >= 2 \
+                and isinstance(args[0], str) and isinstance(args[1], str):
+            occurrences = len(owner) // max(len(args[0]), 1) + 1
+            if len(args) >= 3 and isinstance(args[2], int) and args[2] >= 0:
+                occurrences = min(occurrences, args[2])
+            worst = len(owner) + occurrences * len(args[1])
+            if worst > _MAX_SEQ:
+                raise CircuitBreakingScriptError(
+                    "script replace() result exceeds the size limit")
+        elif name == "join" and isinstance(owner, str) and args:
+            try:
+                items = list(args[0])
+            except TypeError:
+                return
+            args[0] = items   # measured once, consumed once
+            total = sum(len(x) if isinstance(x, str) else 32 for x in items)
+            total += len(owner) * max(len(items) - 1, 0)
+            if total > _MAX_SEQ:
+                raise CircuitBreakingScriptError(
+                    "script join() result exceeds the size limit")
 
     @staticmethod
     def _truth(v: Any) -> bool:

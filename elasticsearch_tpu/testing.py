@@ -102,11 +102,16 @@ class InProcessCluster:
                 return False
             if master.client.cluster_health(index)["status"] not in ok:
                 return False
-            # every node must have APPLIED the state it's judged by —
-            # clients read their local node's applied state
+            # every node still in the master's view must have APPLIED the
+            # state it's judged by — clients read their local node's applied
+            # state. Nodes the master has dropped (or that are partitioned
+            # away, hence absent from its membership) can never catch up and
+            # must not hold green/yellow hostage.
             version = master.coordinator.applied_state.version
+            members = master.coordinator.applied_state.nodes
             return all(n.coordinator.applied_state.version >= version
-                       for n in self.nodes.values())
+                       for n in self.nodes.values()
+                       if n.node_id in members)
         self.run_until(ready, max_time)
 
     def await_node_count(self, n: int, max_time: float = 300.0) -> None:

@@ -235,9 +235,57 @@ def test_http_server_round_trip(tmp_path):
         assert status == 201, body
         status, body = await call("GET", "/web/_search?q=msg:hello")
         assert status == 200 and body["hits"]["total"]["value"] == 1
+
+        # malformed framing gets a graceful 400, never a dropped connection
+        async def raw_call(request_bytes):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(request_bytes)
+            await writer.drain()
+            status_line = await reader.readline()
+            writer.close()
+            return int(status_line.split()[1])
+
+        assert await raw_call(
+            b"GET / HTTP/1.1\r\ncontent-length: -5\r\n\r\n") == 400
+        assert await raw_call(b"GET\r\n\r\n") == 400
+        assert await raw_call(
+            b"GET /" + b"x" * (70 * 1024) + b" HTTP/1.1\r\n\r\n") == 400
+        assert await raw_call(
+            b"GET / HTTP/1.1\r\nh: " + b"y" * (70 * 1024) + b"\r\n\r\n"
+        ) == 400
         await server.stop()
 
     try:
         asyncio.run(asyncio.wait_for(scenario(), timeout=60))
     finally:
         node.stop()
+
+
+def test_bad_int_param_is_400(rest):
+    status, body = rest("GET", "/_search", query={"size": "abc"})
+    assert status == 400
+    assert body["error"]["type"] == "illegal_argument_exception"
+    status, _ = rest("POST", "/_forcemerge",
+                     query={"max_num_segments": "x"})
+    assert status == 400
+
+
+def test_msearch_item_error_shape(rest):
+    raw = ('{"index": "no_such_index"}\n{"query": {"match_all": {}}}\n')
+    status, body = rest("POST", "/_msearch", raw=raw)
+    assert status == 200
+    item = body["responses"][0]
+    assert item["error"]["type"] == "index_not_found_exception"
+    assert item["status"] == 404
+
+
+def test_index_stats_shape(rest):
+    rest("PUT", "/books", {"settings": {"number_of_shards": 1,
+                                        "number_of_replicas": 0}})
+    rest("PUT", "/books/_doc/1", {"title": "a"}, query={"refresh": "true"})
+    status, body = rest("GET", "/books/_stats")
+    assert status == 200
+    assert body["indices"]["books"]["primaries"]["docs"]["count"] == 1
+    assert body["_all"]["total"]["docs"]["count"] == 1
+    status, body = rest("GET", "/no_such/_stats")
+    assert status == 404

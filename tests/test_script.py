@@ -106,3 +106,24 @@ def test_string_methods(engine):
         "return name.substring(1, 3)", {"name": "hello"}) == "el"
     assert engine.execute(
         "return name.indexOf('l')", {"name": "hello"}) == 2
+
+
+def test_amplifying_native_methods_tripped(engine):
+    from elasticsearch_tpu.script.engine import CircuitBreakingScriptError
+
+    # replace(): both operands individually under the limit, product not
+    with pytest.raises(CircuitBreakingScriptError):
+        engine.execute(
+            "x = 'x' * 100000\ny = 'y' * 100000\nreturn x.replace('x', y)",
+            {})
+    # join(): per-item sizes bounded, total not
+    with pytest.raises(CircuitBreakingScriptError):
+        engine.execute(
+            "sep = 's' * 900000\nreturn sep.join(['a', 'b', 'c'])", {})
+    # bounded uses still work
+    assert engine.execute("return 'a-b'.replace('-', '+')", {}) == "a+b"
+    assert engine.execute("return ','.join(['a', 'b'])", {}) == "a,b"
+    # a count argument bounds the worst case: must NOT trip
+    out = engine.execute(
+        "x = 'x' * 900000\nreturn x.replace('x', 'yy', 1)", {})
+    assert len(out) == 900001
