@@ -97,6 +97,15 @@ class SearchTransportService:
         reader = shard.engine.acquire_reader()
         query = dsl.parse_query(body.get("query"))
         sort = parse_sort(body.get("sort"))
+
+        aggregator = None
+        agg_body = body.get("aggs", body.get("aggregations"))
+        if agg_body:
+            from elasticsearch_tpu.search.aggregations import (
+                ShardAggregator, parse_aggs,
+            )
+            aggregator = ShardAggregator(parse_aggs(agg_body))
+
         result = query_shard(
             reader, shard.engine.mappers, query,
             size=req["window"], from_=0, sort=sort,
@@ -104,7 +113,8 @@ class SearchTransportService:
             track_total_hits=body.get("track_total_hits", 10_000),
             min_score=body.get("min_score"),
             doc_count_override=req.get("doc_count_override"),
-            df_overrides=req.get("df_overrides"))
+            df_overrides=req.get("df_overrides"),
+            collectors=[aggregator] if aggregator else None)
         context_id = None
         if req["window"] > 0:
             # size=0 (count) searches never fetch: don't pin a reader
@@ -119,6 +129,7 @@ class SearchTransportService:
             "docs": [{"segment": d.segment_idx, "doc": d.doc,
                       "score": d.score, "sort": list(d.sort_values)}
                      for d in result.docs],
+            "aggs_partial": aggregator.partial() if aggregator else None,
         }
 
     def _on_fetch(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
@@ -270,7 +281,8 @@ class TransportSearchAction:
                 has_terms = bool(collect_query_terms(dsl.parse_query(query)))
             except SearchEngineError:
                 has_terms = False
-        if len(targets) <= 1 or not has_terms:
+        if len(targets) <= 1 or not has_terms or \
+                _aggs_must_visit_all(body):
             next_phase(targets)
             return
         live: List[Dict[str, Any]] = []
@@ -399,7 +411,7 @@ class TransportSearchAction:
         if not winners:
             on_done(self._finalize(t0, targets, body, phase_state,
                                    n_total_shards, total, relation,
-                                   max_score, []), None)
+                                   max_score, [], results=results), None)
             return
 
         # group winners per shard for fetch
@@ -430,7 +442,8 @@ class TransportSearchAction:
                     hits = [h for h in hits_out if h is not None]
                     on_done(self._finalize(t0, targets, body, phase_state,
                                            n_total_shards, total, relation,
-                                           max_score, hits), None)
+                                           max_score, hits,
+                                           results=results), None)
             self.ts.send_request(target["node"], SEARCH_FETCH, req, cb,
                                  timeout=60.0)
         for tidx, docs in by_target.items():
@@ -439,7 +452,8 @@ class TransportSearchAction:
     # -- response --------------------------------------------------------
 
     def _finalize(self, t0, targets, body, phase_state, n_total_shards,
-                  total, relation, max_score, hits) -> Dict[str, Any]:
+                  total, relation, max_score, hits,
+                  results=None) -> Dict[str, Any]:
         successful = n_total_shards - phase_state["failed"] \
             - phase_state["skipped"]
         resp = {
@@ -452,6 +466,17 @@ class TransportSearchAction:
             "hits": {"total": {"value": total, "relation": relation},
                      "max_score": max_score, "hits": hits},
         }
+        agg_body = body.get("aggs", body.get("aggregations"))
+        if agg_body:
+            # coordinator-side reduce of per-shard partials
+            # (InternalAggregation.reduce analog)
+            from elasticsearch_tpu.search.aggregations import (
+                parse_aggs, reduce_aggs,
+            )
+            partials = [r.get("aggs_partial") for r in (results or [])
+                        if r is not None]
+            resp["aggregations"] = reduce_aggs(parse_aggs(agg_body),
+                                               partials)
         if phase_state["failures"]:
             resp["_shards"]["failures"] = phase_state["failures"]
         return resp
@@ -465,3 +490,25 @@ class TransportSearchAction:
             "hits": {"total": {"value": 0, "relation": "eq"},
                      "max_score": None, "hits": []},
         }
+
+
+def _aggs_must_visit_all(body: Dict[str, Any]) -> bool:
+    """A ``global`` agg anywhere in the tree must see every live doc, so
+    can_match shard skipping would silently drop its counts (the reference
+    disables the match-none skip when an agg mustVisitAllDocs)."""
+    agg_body = body.get("aggs", body.get("aggregations"))
+    if not agg_body:
+        return False
+
+    def walk(entries) -> bool:
+        if not isinstance(entries, dict):
+            return False
+        for entry in entries.values():
+            if not isinstance(entry, dict):
+                continue
+            if "global" in entry:
+                return True
+            if walk(entry.get("aggs", entry.get("aggregations") or {})):
+                return True
+        return False
+    return walk(agg_body)

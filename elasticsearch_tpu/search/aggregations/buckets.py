@@ -1,0 +1,606 @@
+"""Bucket aggregations: doc partitioning + sub-aggregation recursion.
+
+Reference analog: search/aggregations/bucket/ — terms, histogram,
+date_histogram, range, filter(s), global, missing. Buckets are computed as
+segment-level masks from columnar values (not per-doc collector callbacks);
+sub-aggs recurse with the intersected mask. Partials keep EVERY bucket (no
+shard-side trimming), so the coordinator reduce is exact and
+doc_count_error_upper_bound is always 0 — a deliberate divergence from the
+reference's shard_size approximation, affordable because partials are
+columnar and cheap to ship.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.search.aggregations.spec import AggSpec
+from elasticsearch_tpu.search.aggregations.values import (
+    field_kind, keyword_occurrences, numeric_occurrences,
+)
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+def _collect_subs(spec: AggSpec, ctx, mask: np.ndarray, scores
+                  ) -> Dict[str, Any]:
+    from elasticsearch_tpu.search.aggregations.engine import collect_one
+    return {sub.name: collect_one(sub, ctx, mask, scores)
+            for sub in spec.subs if not sub.is_pipeline}
+
+
+def _merge_subs(spec: AggSpec, a: Dict[str, Any], b: Dict[str, Any]
+                ) -> Dict[str, Any]:
+    from elasticsearch_tpu.search.aggregations.engine import merge_one
+    out = dict(a)
+    for sub in spec.subs:
+        if sub.is_pipeline:
+            continue
+        if sub.name in a and sub.name in b:
+            out[sub.name] = merge_one(sub, a[sub.name], b[sub.name])
+        elif sub.name in b:
+            out[sub.name] = b[sub.name]
+    return out
+
+
+def _finalize_subs(spec: AggSpec, subs: Dict[str, Any]) -> Dict[str, Any]:
+    from elasticsearch_tpu.search.aggregations.engine import (
+        collect_one, empty_partial, finalize_one,
+    )
+    out: Dict[str, Any] = {}
+    for sub in spec.subs:
+        if sub.is_pipeline:
+            continue
+        partial = subs.get(sub.name)
+        if partial is None:
+            partial = empty_partial(sub)
+        out[sub.name] = finalize_one(sub, partial)
+    return out
+
+
+def _doc_count(mask: np.ndarray) -> int:
+    return int(mask.sum())
+
+
+# ---------------------------------------------------------------------------
+# single-bucket aggs: filter / global / missing
+# ---------------------------------------------------------------------------
+
+def collect_filter(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
+    fmask = _filter_mask(ctx, spec.params)
+    m = mask & fmask
+    return {"doc_count": _doc_count(m),
+            "subs": _collect_subs(spec, ctx, m, scores)}
+
+
+def _filter_mask(ctx, query_body: Any) -> np.ndarray:
+    from elasticsearch_tpu.search import dsl
+    from elasticsearch_tpu.search.execute import execute
+    q = dsl.parse_query(query_body)
+    _, qmask = execute(q, ctx)
+    return np.asarray(qmask)[: ctx.segment.n_docs]
+
+
+def collect_global(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
+    # ignores the query entirely: every live doc in the snapshot
+    m = np.asarray(ctx.live)[: ctx.segment.n_docs].astype(bool)
+    return {"doc_count": _doc_count(m),
+            "subs": _collect_subs(spec, ctx, m, scores)}
+
+
+def collect_missing(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
+    fname = spec.params.get("field")
+    if fname is None:
+        raise IllegalArgumentError(
+            f"aggregation [{spec.name}] requires a [field]")
+    n = ctx.segment.n_docs
+    have = np.zeros(n, bool)
+    kind = field_kind(ctx, fname)
+    if kind == "keyword":
+        owners, _, _ = keyword_occurrences(ctx, fname)
+        have[owners] = True
+    elif kind == "numeric":
+        owners, _ = numeric_occurrences(ctx, fname)
+        have[owners] = True
+    m = mask & ~have
+    return {"doc_count": _doc_count(m),
+            "subs": _collect_subs(spec, ctx, m, scores)}
+
+
+def merge_single(spec: AggSpec, a, b) -> Dict[str, Any]:
+    return {"doc_count": a["doc_count"] + b["doc_count"],
+            "subs": _merge_subs(spec, a["subs"], b["subs"])}
+
+
+def finalize_single(spec: AggSpec, p) -> Dict[str, Any]:
+    out = {"doc_count": p["doc_count"]}
+    out.update(_finalize_subs(spec, p["subs"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# filters (named or anonymous)
+# ---------------------------------------------------------------------------
+
+def collect_filters(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
+    filters = spec.params.get("filters")
+    if filters is None:
+        raise IllegalArgumentError(
+            f"aggregation [{spec.name}] requires [filters]")
+    if isinstance(filters, list):
+        entries = [(str(i), f) for i, f in enumerate(filters)]
+        keyed = False
+    else:
+        entries = list(filters.items())
+        keyed = True
+    buckets = {}
+    for key, fbody in entries:
+        m = mask & _filter_mask(ctx, fbody)
+        buckets[key] = {"key": key, "doc_count": _doc_count(m),
+                        "subs": _collect_subs(spec, ctx, m, scores)}
+    return {"buckets": buckets, "keyed": keyed,
+            "order": [k for k, _ in entries]}
+
+
+# ---------------------------------------------------------------------------
+# terms
+# ---------------------------------------------------------------------------
+
+def collect_terms(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
+    fname = spec.params.get("field")
+    if fname is None and spec.params.get("script") is None:
+        raise IllegalArgumentError(
+            f"aggregation [{spec.name}] requires a [field] or [script]")
+    kind = field_kind(ctx, fname) if fname else "numeric"
+    buckets: Dict[str, Dict[str, Any]] = {}
+    missing = spec.params.get("missing")
+    seen_docs = np.zeros(ctx.segment.n_docs, bool)
+
+    if fname and kind == "keyword":
+        owners, ords, term_list = keyword_occurrences(ctx, fname)
+        keep = mask[owners]
+        owners, ords = owners[keep], ords[keep]
+        seen_docs[owners] = True
+        if len(owners):
+            # dedup (doc, ord): a doc counts once per term
+            pair = owners.astype(np.int64) * max(len(term_list), 1) + ords
+            _, first = np.unique(pair, return_index=True)
+            owners, ords = owners[first], ords[first]
+            counts = np.bincount(ords, minlength=len(term_list))
+            for tid in np.nonzero(counts)[0]:
+                key = term_list[tid]
+                bmask = np.zeros(ctx.segment.n_docs, bool)
+                bmask[owners[ords == tid]] = True
+                buckets[str(key)] = {
+                    "key": key, "doc_count": int(counts[tid]),
+                    "subs": _collect_subs(spec, ctx, bmask, scores)}
+    else:
+        from elasticsearch_tpu.search.aggregations.values import (
+            resolve_numeric,
+        )
+        params = dict(spec.params)
+        params.pop("missing", None)   # handled below as its own bucket
+        owners, values = resolve_numeric(ctx, params, spec.name)
+        keep = mask[owners]
+        owners, values = owners[keep], values[keep]
+        seen_docs[owners] = True
+        if len(owners):
+            uniq = np.unique(values)
+            for v in uniq:
+                sel = values == v
+                docs = np.unique(owners[sel])
+                bmask = np.zeros(ctx.segment.n_docs, bool)
+                bmask[docs] = True
+                key = int(v) if float(v).is_integer() else float(v)
+                buckets[str(key)] = {
+                    "key": key, "doc_count": int(len(docs)),
+                    "subs": _collect_subs(spec, ctx, bmask, scores)}
+
+    if missing is not None:
+        m = mask & ~seen_docs
+        n = _doc_count(m)
+        if n:
+            buckets[str(missing)] = {
+                "key": missing, "doc_count": n,
+                "subs": _collect_subs(spec, ctx, m, scores)}
+    return {"buckets": buckets}
+
+
+# ---------------------------------------------------------------------------
+# histogram / date_histogram
+# ---------------------------------------------------------------------------
+
+_UNIT_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+            "d": 86_400_000}
+
+# coordinator-side bucket ceiling (search.max_buckets default,
+# MultiBucketConsumerService)
+MAX_BUCKETS = 65536
+
+
+def _check_max_buckets(n: float, spec: AggSpec) -> None:
+    if n > MAX_BUCKETS:
+        raise IllegalArgumentError(
+            f"aggregation [{spec.name}] would create more than "
+            f"[{MAX_BUCKETS}] buckets; raise the interval or set "
+            f"min_doc_count > 0")
+
+
+def parse_interval_ms(expr: Any) -> float:
+    if isinstance(expr, (int, float)):
+        return float(expr)
+    expr = str(expr).strip()
+    for unit in sorted(_UNIT_MS, key=len, reverse=True):
+        if expr.endswith(unit):
+            try:
+                return float(expr[: -len(unit)]) * _UNIT_MS[unit]
+            except ValueError:
+                break
+    raise IllegalArgumentError(f"failed to parse interval [{expr}]")
+
+
+_CALENDAR = {"minute", "1m", "hour", "1h", "day", "1d", "week", "1w",
+             "month", "1M", "quarter", "1q", "year", "1y"}
+
+
+def _calendar_floor(values: np.ndarray, unit: str) -> np.ndarray:
+    """Floor epoch-millis to calendar bucket starts (UTC)."""
+    ms = values.astype(np.int64)
+    if unit in ("minute", "1m"):
+        return (ms // 60_000) * 60_000
+    if unit in ("hour", "1h"):
+        return (ms // 3_600_000) * 3_600_000
+    if unit in ("day", "1d"):
+        return (ms // 86_400_000) * 86_400_000
+    if unit in ("week", "1w"):
+        days = ms // 86_400_000
+        monday = days - ((days + 3) % 7)   # 1970-01-01 is a Thursday
+        return monday * 86_400_000
+    dt = ms.astype("datetime64[ms]")
+    months = dt.astype("datetime64[M]")
+    if unit in ("month", "1M"):
+        return months.astype("datetime64[ms]").astype(np.int64)
+    if unit in ("quarter", "1q"):
+        mi = months.astype(np.int64)       # months since epoch
+        return ((mi // 3) * 3).astype("datetime64[M]").astype(
+            "datetime64[ms]").astype(np.int64)
+    if unit in ("year", "1y"):
+        return dt.astype("datetime64[Y]").astype("datetime64[ms]").astype(
+            np.int64)
+    raise IllegalArgumentError(f"unknown calendar interval [{unit}]")
+
+
+def format_date_key(ms: float) -> str:
+    dt = np.datetime64(int(ms), "ms")
+    return str(dt) + "Z"
+
+
+def collect_histogram(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
+    fname = spec.params.get("field")
+    if fname is None:
+        raise IllegalArgumentError(
+            f"aggregation [{spec.name}] requires a [field]")
+    owners, values = numeric_occurrences(ctx, fname)
+    missing = spec.params.get("missing")
+    if missing is not None:
+        have = np.zeros(ctx.segment.n_docs, bool)
+        have[owners] = True
+        absent = np.nonzero(~have)[0].astype(np.int32)
+        owners = np.concatenate([owners, absent])
+        values = np.concatenate([values,
+                                 np.full(len(absent), float(missing))])
+    keep = mask[owners]
+    owners, values = owners[keep], values[keep]
+
+    is_date = spec.type == "date_histogram"
+    calendar = spec.params.get("calendar_interval")
+    if is_date and calendar is not None and \
+            str(calendar) not in ("", None):
+        if str(calendar) not in _CALENDAR:
+            raise IllegalArgumentError(
+                f"unknown calendar interval [{calendar}]")
+        keys = (_calendar_floor(values, str(calendar)).astype(np.float64)
+                if len(values) else values)
+    else:
+        interval = (parse_interval_ms(
+            spec.params.get("fixed_interval",
+                            spec.params.get("interval", "1d")))
+            if is_date else float(spec.params.get("interval", 0)))
+        if interval <= 0:
+            raise IllegalArgumentError(
+                f"[interval] must be >0 for histogram [{spec.name}]")
+        offset = float(spec.params.get("offset", 0) or 0)
+        keys = np.floor((values - offset) / interval) * interval + offset
+
+    buckets: Dict[str, Dict[str, Any]] = {}
+    for k in np.unique(keys) if len(keys) else []:
+        sel = keys == k
+        docs = np.unique(owners[sel])
+        bmask = np.zeros(ctx.segment.n_docs, bool)
+        bmask[docs] = True
+        key = float(k)
+        buckets[repr(key)] = {
+            "key": key, "doc_count": int(len(docs)),
+            "subs": _collect_subs(spec, ctx, bmask, scores)}
+    return {"buckets": buckets}
+
+
+# ---------------------------------------------------------------------------
+# range / date_range
+# ---------------------------------------------------------------------------
+
+def collect_range(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
+    fname = spec.params.get("field")
+    ranges = spec.params.get("ranges")
+    if fname is None or not ranges:
+        raise IllegalArgumentError(
+            f"aggregation [{spec.name}] requires [field] and [ranges]")
+    owners, values = numeric_occurrences(ctx, fname)
+    keep = mask[owners]
+    owners, values = owners[keep], values[keep]
+    buckets = {}
+    order = []
+    for rng in ranges:
+        lo = rng.get("from")
+        hi = rng.get("to")
+        lo_f = float(lo) if lo is not None else -np.inf
+        hi_f = float(hi) if hi is not None else np.inf
+        key = rng.get("key") or _range_key(lo, hi)
+        sel = (values >= lo_f) & (values < hi_f)
+        docs = np.unique(owners[sel])
+        bmask = np.zeros(ctx.segment.n_docs, bool)
+        bmask[docs] = True
+        bucket = {"key": key, "doc_count": int(len(docs)),
+                  "subs": _collect_subs(spec, ctx, bmask, scores)}
+        if lo is not None:
+            bucket["from"] = float(lo)
+        if hi is not None:
+            bucket["to"] = float(hi)
+        buckets[key] = bucket
+        order.append(key)
+    return {"buckets": buckets, "order": order}
+
+
+def _range_key(lo, hi) -> str:
+    lo_s = "*" if lo is None else _num_s(lo)
+    hi_s = "*" if hi is None else _num_s(hi)
+    return f"{lo_s}-{hi_s}"
+
+
+def _num_s(v) -> str:
+    return f"{float(v):g}" if float(v) != int(float(v)) \
+        else f"{float(v):.1f}"
+
+
+# ---------------------------------------------------------------------------
+# shared multi-bucket merge / finalize
+# ---------------------------------------------------------------------------
+
+def merge_multi(spec: AggSpec, a, b) -> Dict[str, Any]:
+    out = dict(a)
+    # carry structural keys (keyed, order) from whichever side has them —
+    # an empty-shard partial is just {"buckets": {}}
+    for k, v in b.items():
+        if k not in out:
+            out[k] = v
+    buckets = dict(a["buckets"])
+    for bk, bucket in b["buckets"].items():
+        if bk in buckets:
+            prev = buckets[bk]
+            buckets[bk] = {
+                **prev,
+                "doc_count": prev["doc_count"] + bucket["doc_count"],
+                "subs": _merge_subs(spec, prev["subs"], bucket["subs"]),
+            }
+        else:
+            buckets[bk] = bucket
+    out["buckets"] = buckets
+    if "order" in b and len(b.get("order", [])) > len(a.get("order", [])):
+        out["order"] = b["order"]
+    return out
+
+
+def finalize_terms(spec: AggSpec, p) -> Dict[str, Any]:
+    buckets = list(p["buckets"].values())
+    size = int(spec.params.get("size", 10))
+    min_doc_count = int(spec.params.get("min_doc_count", 1))
+    order = spec.params.get("order", {"_count": "desc"})
+    if isinstance(order, list):
+        order = order[0] if order else {"_count": "desc"}
+    (okey, odir), = order.items() if order else (("_count", "desc"),)
+    reverse = str(odir).lower() == "desc"
+
+    def sort_value(bucket):
+        if okey == "_count":
+            return bucket["doc_count"]
+        if okey == "_key" or okey == "_term":
+            return bucket["key"]
+        return _subagg_sort_value(spec, bucket, okey)
+
+    buckets = [bkt for bkt in buckets
+               if bkt["doc_count"] >= min_doc_count]
+    # ties broken by key ascending, like the reference (stable sort keeps
+    # the key order for equal primary values even under reverse)
+    buckets.sort(key=lambda bkt: bkt["key"] if isinstance(
+        bkt["key"], str) else str(bkt["key"]))
+    if okey == "_count":
+        buckets.sort(key=lambda bkt: bkt["doc_count"],
+                     reverse=reverse)
+    else:
+        buckets.sort(key=sort_value, reverse=reverse)
+    total = sum(bkt["doc_count"] for bkt in buckets)
+    selected = buckets[:size]
+    out_buckets = []
+    for bkt in selected:
+        node = {"key": bkt["key"], "doc_count": bkt["doc_count"]}
+        if isinstance(bkt["key"], bool):
+            node["key"] = 1 if bkt["key"] else 0
+        node.update(_finalize_subs(spec, bkt["subs"]))
+        out_buckets.append(node)
+    return {
+        "doc_count_error_upper_bound": 0,
+        "sum_other_doc_count": total - sum(
+            bkt["doc_count"] for bkt in selected),
+        "buckets": out_buckets,
+    }
+
+
+def _subagg_sort_value(spec: AggSpec, bucket, path: str):
+    from elasticsearch_tpu.search.aggregations.engine import finalize_one
+    agg_name, _, metric = path.partition(".")
+    sub = next((s for s in spec.subs if s.name == agg_name), None)
+    if sub is None:
+        raise IllegalArgumentError(
+            f"unknown order path [{path}] in terms [{spec.name}]")
+    node = finalize_one(sub, bucket["subs"][sub.name])
+    v = node.get(metric or "value")
+    return v if v is not None else -np.inf
+
+
+def finalize_histogram(spec: AggSpec, p) -> Dict[str, Any]:
+    buckets = sorted(p["buckets"].values(), key=lambda bkt: bkt["key"])
+    min_doc_count = int(spec.params.get("min_doc_count", 0))
+    is_date = spec.type == "date_histogram"
+
+    # gap filling for min_doc_count=0 (the reference's empty-bucket fill),
+    # capped like search.max_buckets so a sparse range with a tiny interval
+    # cannot generate unbounded empty buckets
+    if min_doc_count == 0 and buckets:
+        calendar = spec.params.get("calendar_interval") if is_date else None
+        if calendar is None:
+            interval = (parse_interval_ms(
+                spec.params.get("fixed_interval",
+                                spec.params.get("interval", "1d")))
+                if is_date else float(spec.params.get("interval")))
+            span = buckets[-1]["key"] - buckets[0]["key"]
+            _check_max_buckets(span / interval, spec)
+            keys_have = {bkt["key"] for bkt in buckets}
+            k = buckets[0]["key"]
+            fill = []
+            while k < buckets[-1]["key"]:
+                if k not in keys_have:
+                    fill.append({"key": k, "doc_count": 0, "subs": {}})
+                k += interval
+            buckets = sorted(buckets + fill, key=lambda bkt: bkt["key"])
+        else:
+            unit = str(calendar)
+            min_step = {
+                "minute": 60_000, "1m": 60_000,
+                "hour": 3_600_000, "1h": 3_600_000,
+                "day": 86_400_000, "1d": 86_400_000,
+                "week": 604_800_000, "1w": 604_800_000,
+                "month": 28 * 86_400_000, "1M": 28 * 86_400_000,
+                "quarter": 89 * 86_400_000, "1q": 89 * 86_400_000,
+                "year": 365 * 86_400_000, "1y": 365 * 86_400_000,
+            }.get(unit, 86_400_000)
+            span = buckets[-1]["key"] - buckets[0]["key"]
+            _check_max_buckets(span / min_step, spec)
+            buckets = _fill_calendar(buckets, unit)
+    buckets = [bkt for bkt in buckets
+               if bkt["doc_count"] >= min_doc_count]
+    out = []
+    for bkt in buckets:
+        node = {"key": bkt["key"], "doc_count": bkt["doc_count"]}
+        if is_date:
+            node["key_as_string"] = format_date_key(bkt["key"])
+        node.update(_finalize_subs(spec, bkt.get("subs", {})))
+        out.append(node)
+    return {"buckets": out}
+
+
+def _fill_calendar(buckets, unit: str):
+    """Fill empty calendar buckets by stepping bucket starts."""
+    have = {bkt["key"] for bkt in buckets}
+    first, last = buckets[0]["key"], buckets[-1]["key"]
+    fill = []
+    k = first
+    while k < last:
+        nxt = _next_calendar(k, unit)
+        if nxt == k:
+            break
+        k = nxt
+        if k < last and k not in have:
+            fill.append({"key": float(k), "doc_count": 0, "subs": {}})
+    return sorted(buckets + fill, key=lambda bkt: bkt["key"])
+
+
+def _next_calendar(ms: float, unit: str) -> float:
+    arr = np.asarray([ms])
+    if unit in ("minute", "1m", "hour", "1h", "day", "1d", "week", "1w"):
+        step = {"minute": 60_000, "1m": 60_000,
+                "hour": 3_600_000, "1h": 3_600_000,
+                "day": 86_400_000, "1d": 86_400_000,
+                "week": 604_800_000, "1w": 604_800_000}[unit]
+        return float(ms + step)
+    months = np.asarray([int(ms)], np.int64).astype(
+        "datetime64[ms]").astype("datetime64[M]").astype(np.int64)
+    step = {"month": 1, "1M": 1, "quarter": 3, "1q": 3,
+            "year": 12, "1y": 12}[unit]
+    return float((months + step).astype("datetime64[M]").astype(
+        "datetime64[ms]").astype(np.int64)[0])
+
+
+def finalize_range(spec: AggSpec, p) -> Dict[str, Any]:
+    order = p.get("order") or list(p["buckets"])
+    keyed = bool(spec.params.get("keyed"))
+    out = []
+    for key in order:
+        bkt = p["buckets"][key]
+        node = {"key": bkt["key"], "doc_count": bkt["doc_count"]}
+        for side in ("from", "to"):
+            if side in bkt:
+                node[side] = bkt[side]
+                if spec.type == "date_range":
+                    node[f"{side}_as_string"] = format_date_key(bkt[side])
+        node.update(_finalize_subs(spec, bkt["subs"]))
+        out.append(node)
+    if keyed:
+        return {"buckets": {n["key"]: {k: v for k, v in n.items()
+                                       if k != "key"} for n in out}}
+    return {"buckets": out}
+
+
+def finalize_filters(spec: AggSpec, p) -> Dict[str, Any]:
+    order = p.get("order") or list(p["buckets"])
+    nodes = {}
+    for key in order:
+        bkt = p["buckets"][key]
+        node = {"doc_count": bkt["doc_count"]}
+        node.update(_finalize_subs(spec, bkt["subs"]))
+        nodes[key] = node
+    if p.get("keyed", True):
+        return {"buckets": nodes}
+    return {"buckets": [{"key": k, **nodes[k]} for k in order]}
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+BUCKET_COLLECT = {
+    "terms": collect_terms,
+    "range": collect_range,
+    "date_range": collect_range,
+    "histogram": collect_histogram,
+    "date_histogram": collect_histogram,
+    "filter": collect_filter,
+    "filters": collect_filters,
+    "global": collect_global,
+    "missing": collect_missing,
+}
+BUCKET_MERGE = {
+    "terms": merge_multi, "range": merge_multi, "date_range": merge_multi,
+    "histogram": merge_multi, "date_histogram": merge_multi,
+    "filters": merge_multi,
+    "filter": merge_single, "global": merge_single,
+    "missing": merge_single,
+}
+BUCKET_FINALIZE = {
+    "terms": finalize_terms,
+    "range": finalize_range, "date_range": finalize_range,
+    "histogram": finalize_histogram, "date_histogram": finalize_histogram,
+    "filter": finalize_single, "global": finalize_single,
+    "missing": finalize_single,
+    "filters": finalize_filters,
+}

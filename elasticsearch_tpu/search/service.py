@@ -57,6 +57,17 @@ class SearchService:
         self.reap_scrolls()
         reader = reader or self.engine.acquire_reader()
         query = dsl.parse_query(body.get("query"))
+
+        agg_specs = None
+        aggregator = None
+        agg_body = body.get("aggs", body.get("aggregations"))
+        if agg_body:
+            from elasticsearch_tpu.search.aggregations import (
+                ShardAggregator, parse_aggs,
+            )
+            agg_specs = parse_aggs(agg_body)
+            aggregator = ShardAggregator(agg_specs)
+            collectors = list(collectors or []) + [aggregator]
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         sort = parse_sort(body.get("sort"))
@@ -96,6 +107,11 @@ class SearchService:
                 "hits": hits,
             },
         }
+
+        if aggregator is not None:
+            from elasticsearch_tpu.search.aggregations import reduce_aggs
+            response["aggregations"] = reduce_aggs(
+                agg_specs, [aggregator.partial()])
 
         if scroll_keep_alive:
             scroll_id = uuid.uuid4().hex

@@ -1,0 +1,407 @@
+"""Aggregation tests: metrics, buckets, sub-aggs, pipelines, distributed
+reduce (mirrors the reference's agg test strategy: exact expectations over
+a small corpus, multi-segment + multi-shard merges)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index import InternalEngine
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import SearchService
+
+MAPPING = {
+    "properties": {
+        "genre": {"type": "keyword"},
+        "title": {"type": "text"},
+        "price": {"type": "double"},
+        "stock": {"type": "long"},
+        "sold": {"type": "date"},
+        "tags": {"type": "keyword"},
+    }
+}
+
+DOCS = [
+    {"genre": "scifi",   "title": "dune",        "price": 10.0, "stock": 3,
+     "sold": "2024-01-05", "tags": ["a", "b"]},
+    {"genre": "scifi",   "title": "foundation",  "price": 20.0, "stock": 1,
+     "sold": "2024-01-20", "tags": ["a"]},
+    {"genre": "fantasy", "title": "hobbit",      "price": 30.0, "stock": 7,
+     "sold": "2024-02-10", "tags": ["b"]},
+    {"genre": "fantasy", "title": "mistborn",    "price": 40.0, "stock": 2,
+     "sold": "2024-03-01", "tags": ["c"]},
+    {"genre": "crime",   "title": "gone girl",   "price": 15.0, "stock": 5,
+     "sold": "2024-03-15"},
+    {"title": "untagged", "price": 5.0, "stock": 0, "sold": "2024-01-31"},
+]
+
+
+@pytest.fixture(scope="module")
+def svc():
+    engine = InternalEngine(MapperService(MAPPING), shard_label="agg")
+    for i, d in enumerate(DOCS):
+        engine.index(str(i), d)
+        if i == 2:
+            engine.refresh()   # two segments: exercise segment-level merge
+    engine.refresh()
+    return SearchService(engine, index_name="books")
+
+
+def agg(svc, body, query=None):
+    full = {"size": 0, "aggs": body}
+    if query is not None:
+        full["query"] = query
+    return svc.search(full)["aggregations"]
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_basic_metrics(svc):
+    out = agg(svc, {
+        "p_avg": {"avg": {"field": "price"}},
+        "p_sum": {"sum": {"field": "price"}},
+        "p_min": {"min": {"field": "price"}},
+        "p_max": {"max": {"field": "price"}},
+        "p_count": {"value_count": {"field": "price"}},
+    })
+    assert out["p_avg"]["value"] == pytest.approx(20.0)
+    assert out["p_sum"]["value"] == pytest.approx(120.0)
+    assert out["p_min"]["value"] == 5.0
+    assert out["p_max"]["value"] == 40.0
+    assert out["p_count"]["value"] == 6
+
+
+def test_stats_and_extended(svc):
+    out = agg(svc, {"s": {"stats": {"field": "price"}},
+                    "e": {"extended_stats": {"field": "price"}}})
+    assert out["s"] == {"count": 6, "min": 5.0, "max": 40.0,
+                        "avg": pytest.approx(20.0), "sum": 120.0}
+    vals = np.array([10, 20, 30, 40, 15, 5.0])
+    assert out["e"]["variance"] == pytest.approx(vals.var())
+    assert out["e"]["std_deviation"] == pytest.approx(vals.std())
+
+
+def test_metrics_respect_query_mask(svc):
+    out = agg(svc, {"p": {"avg": {"field": "price"}}},
+              query={"term": {"genre": "scifi"}})
+    assert out["p"]["value"] == pytest.approx(15.0)
+
+
+def test_missing_param_and_empty(svc):
+    out = agg(svc, {"g": {"avg": {"field": "absent", "missing": 7}}})
+    assert out["g"]["value"] == pytest.approx(7.0)
+    out = agg(svc, {"g": {"avg": {"field": "absent"}}})
+    assert out["g"]["value"] is None
+
+
+def test_cardinality(svc):
+    out = agg(svc, {"genres": {"cardinality": {"field": "genre"}},
+                    "prices": {"cardinality": {"field": "price"}}})
+    assert out["genres"]["value"] == 3
+    assert out["prices"]["value"] == 6
+
+
+def test_cardinality_hll_estimate():
+    from elasticsearch_tpu.search.aggregations.metrics import (
+        _hash_value, _hll_from_hashes, finalize_cardinality,
+    )
+    from elasticsearch_tpu.search.aggregations.spec import AggSpec
+    hashes = {_hash_value(i) for i in range(20000)}
+    spec = AggSpec("c", "cardinality", {})
+    est = finalize_cardinality(
+        spec, {"kind": "hll", "registers": _hll_from_hashes(hashes)})
+    assert abs(est["value"] - 20000) / 20000 < 0.1   # ~2-3% typical for p=11
+
+
+def test_percentiles_and_mad(svc):
+    out = agg(svc, {
+        "p": {"percentiles": {"field": "price", "percents": [50, 99]}},
+        "r": {"percentile_ranks": {"field": "price", "values": [20]}},
+        "m": {"median_absolute_deviation": {"field": "price"}},
+    })
+    assert out["p"]["values"]["50.0"] == pytest.approx(17.5)
+    assert out["r"]["values"]["20.0"] == pytest.approx(100 * 4 / 6)
+    vals = np.array([10, 20, 30, 40, 15, 5.0])
+    assert out["m"]["value"] == pytest.approx(
+        np.median(np.abs(vals - np.median(vals))))
+
+
+def test_weighted_avg(svc):
+    out = agg(svc, {"w": {"weighted_avg": {
+        "value": {"field": "price"}, "weight": {"field": "stock"}}}})
+    expected = sum(d["price"] * d["stock"] for d in DOCS) / \
+        sum(d["stock"] for d in DOCS)
+    assert out["w"]["value"] == pytest.approx(expected)
+
+
+def test_top_hits(svc):
+    out = agg(svc, {"genres": {
+        "terms": {"field": "genre"},
+        "aggs": {"top": {"top_hits": {"size": 1}}}}},
+        query={"match": {"title": "dune foundation hobbit"}})
+    scifi = next(b for b in out["genres"]["buckets"]
+                 if b["key"] == "scifi")
+    assert scifi["top"]["hits"]["hits"][0]["_source"]["title"] in (
+        "dune", "foundation")
+
+
+# -- buckets ---------------------------------------------------------------
+
+def test_terms_keyword(svc):
+    out = agg(svc, {"g": {"terms": {"field": "genre"}}})
+    buckets = out["g"]["buckets"]
+    assert [(b["key"], b["doc_count"]) for b in buckets] == [
+        ("fantasy", 2), ("scifi", 2), ("crime", 1)]
+    assert out["g"]["sum_other_doc_count"] == 0
+    assert out["g"]["doc_count_error_upper_bound"] == 0
+
+
+def test_terms_multivalued_and_missing(svc):
+    out = agg(svc, {"t": {"terms": {"field": "tags", "missing": "none"}}})
+    counts = {b["key"]: b["doc_count"] for b in out["t"]["buckets"]}
+    assert counts == {"a": 2, "b": 2, "c": 1, "none": 2}
+
+
+def test_terms_order_and_size(svc):
+    out = agg(svc, {"g": {"terms": {
+        "field": "genre", "size": 2, "order": {"_key": "asc"}}}})
+    assert [b["key"] for b in out["g"]["buckets"]] == ["crime", "fantasy"]
+    assert out["g"]["sum_other_doc_count"] == 2
+    out = agg(svc, {"g": {"terms": {
+        "field": "genre", "order": {"avg_price": "desc"},
+    }, "aggs": {"avg_price": {"avg": {"field": "price"}}}}})
+    # crime and scifi tie at avg 15.0; ties resolve by key ascending
+    assert [b["key"] for b in out["g"]["buckets"]] == [
+        "fantasy", "crime", "scifi"]
+
+
+def test_terms_numeric(svc):
+    out = agg(svc, {"s": {"terms": {"field": "stock"}}})
+    counts = {b["key"]: b["doc_count"] for b in out["s"]["buckets"]}
+    assert counts == {0: 1, 1: 1, 2: 1, 3: 1, 5: 1, 7: 1}
+    assert all(isinstance(b["key"], int) for b in out["s"]["buckets"])
+
+
+def test_histogram_gap_fill(svc):
+    out = agg(svc, {"h": {"histogram": {"field": "price", "interval": 10}}})
+    assert [(b["key"], b["doc_count"]) for b in out["h"]["buckets"]] == [
+        (0.0, 1), (10.0, 2), (20.0, 1), (30.0, 1), (40.0, 1)]
+    out = agg(svc, {"h": {"histogram": {
+        "field": "price", "interval": 10, "min_doc_count": 1}}},
+        query={"terms": {"genre": ["scifi", "fantasy"]}})
+    assert [(b["key"], b["doc_count"]) for b in out["h"]["buckets"]] == [
+        (10.0, 1), (20.0, 1), (30.0, 1), (40.0, 1)]
+
+
+def test_date_histogram_calendar_month(svc):
+    out = agg(svc, {"m": {"date_histogram": {
+        "field": "sold", "calendar_interval": "month"}}})
+    buckets = out["m"]["buckets"]
+    assert [b["key_as_string"][:7] for b in buckets] == [
+        "2024-01", "2024-02", "2024-03"]
+    assert [b["doc_count"] for b in buckets] == [3, 1, 2]
+
+
+def test_date_histogram_fixed(svc):
+    out = agg(svc, {"d": {"date_histogram": {
+        "field": "sold", "fixed_interval": "30d", "min_doc_count": 1}}})
+    assert sum(b["doc_count"] for b in out["d"]["buckets"]) == 6
+
+
+def test_range_agg(svc):
+    out = agg(svc, {"r": {"range": {"field": "price", "ranges": [
+        {"to": 15}, {"from": 15, "to": 30}, {"from": 30, "key": "big"}]}}})
+    buckets = out["r"]["buckets"]
+    assert [(b["key"], b["doc_count"]) for b in buckets] == [
+        ("*-15.0", 2), ("15.0-30.0", 2), ("big", 2)]
+
+
+def test_filter_filters_global_missing(svc):
+    out = agg(svc, {
+        "cheap": {"filter": {"range": {"price": {"lt": 16}}},
+                  "aggs": {"a": {"avg": {"field": "price"}}}},
+        "by": {"filters": {"filters": {
+            "s": {"term": {"genre": "scifi"}},
+            "f": {"term": {"genre": "fantasy"}}}}},
+        "all_docs": {"global": {},
+                     "aggs": {"n": {"value_count": {"field": "price"}}}},
+        "no_genre": {"missing": {"field": "genre"}},
+    }, query={"term": {"genre": "scifi"}})
+    assert out["cheap"]["doc_count"] == 1
+    assert out["cheap"]["a"]["value"] == pytest.approx(10.0)
+    assert out["by"]["buckets"]["s"]["doc_count"] == 2
+    assert out["by"]["buckets"]["f"]["doc_count"] == 0
+    assert out["all_docs"]["doc_count"] == 6      # global ignores query
+    assert out["all_docs"]["n"]["value"] == 6
+    assert out["no_genre"]["doc_count"] == 0      # scifi docs have genre
+
+
+def test_nested_bucket_in_bucket(svc):
+    out = agg(svc, {"g": {"terms": {"field": "genre"}, "aggs": {
+        "h": {"histogram": {"field": "price", "interval": 20},
+              "aggs": {"mx": {"max": {"field": "stock"}}}}}}})
+    fantasy = next(b for b in out["g"]["buckets"] if b["key"] == "fantasy")
+    assert [(b["key"], b["doc_count"]) for b in fantasy["h"]["buckets"]] \
+        == [(20.0, 1), (40.0, 1)]
+    assert fantasy["h"]["buckets"][0]["mx"]["value"] == 7.0
+
+
+# -- pipelines -------------------------------------------------------------
+
+def test_sibling_pipelines(svc):
+    out = agg(svc, {
+        "m": {"date_histogram": {"field": "sold",
+                                 "calendar_interval": "month"},
+              "aggs": {"rev": {"sum": {"field": "price"}}}},
+        "avg_rev": {"avg_bucket": {"buckets_path": "m>rev"}},
+        "max_rev": {"max_bucket": {"buckets_path": "m>rev"}},
+        "total": {"sum_bucket": {"buckets_path": "m>_count"}},
+    })
+    month_rev = [35.0, 30.0, 55.0]
+    assert out["avg_rev"]["value"] == pytest.approx(np.mean(month_rev))
+    assert out["max_rev"]["value"] == pytest.approx(55.0)
+    assert out["total"]["value"] == 6
+
+
+def test_parent_pipelines(svc):
+    out = agg(svc, {"m": {
+        "date_histogram": {"field": "sold", "calendar_interval": "month"},
+        "aggs": {
+            "rev": {"sum": {"field": "price"}},
+            "cum": {"cumulative_sum": {"buckets_path": "rev"}},
+            "diff": {"derivative": {"buckets_path": "rev"}},
+            "per_doc": {"bucket_script": {
+                "buckets_path": {"r": "rev", "n": "_count"},
+                "script": "r / n"}},
+        }}})
+    buckets = out["m"]["buckets"]
+    assert [b["cum"]["value"] for b in buckets] == [35.0, 65.0, 120.0]
+    assert "diff" not in buckets[0]
+    assert buckets[1]["diff"]["value"] == pytest.approx(-5.0)
+    assert buckets[0]["per_doc"]["value"] == pytest.approx(35.0 / 3)
+
+
+def test_bucket_selector_and_sort(svc):
+    out = agg(svc, {"m": {
+        "date_histogram": {"field": "sold", "calendar_interval": "month"},
+        "aggs": {
+            "rev": {"sum": {"field": "price"}},
+            "keep": {"bucket_selector": {
+                "buckets_path": {"r": "rev"}, "script": "r > 31"}},
+        }}})
+    assert [b["rev"]["value"] for b in out["m"]["buckets"]] == [35.0, 55.0]
+
+    out = agg(svc, {"m": {
+        "date_histogram": {"field": "sold", "calendar_interval": "month"},
+        "aggs": {
+            "rev": {"sum": {"field": "price"}},
+            "by_rev": {"bucket_sort": {
+                "sort": [{"rev": {"order": "desc"}}], "size": 2}},
+        }}})
+    assert [b["rev"]["value"] for b in out["m"]["buckets"]] == [55.0, 35.0]
+
+
+# -- distributed reduce ----------------------------------------------------
+
+def test_aggs_across_shards():
+    from elasticsearch_tpu.testing import InProcessCluster
+    c = InProcessCluster(n_nodes=2, seed=11)
+    c.start()
+    try:
+        client = c.client()
+        c.call(lambda done: client.create_index(
+            "sales", {"settings": {"number_of_shards": 3,
+                                   "number_of_replicas": 0},
+                      "mappings": MAPPING}, done))
+        c.ensure_green("sales")
+        items = [{"action": "index", "index": "sales", "id": str(i),
+                  "source": d} for i, d in enumerate(DOCS)]
+        resp, err = c.call(lambda done: client.bulk(items, done))
+        assert err is None and not resp.get("errors"), resp
+        c.call(lambda done: client.refresh("sales", done))
+        resp, err = c.call(lambda done: client.search("sales", {
+            "size": 0, "aggs": {
+                "g": {"terms": {"field": "genre"},
+                      "aggs": {"p": {"avg": {"field": "price"}}}},
+                "c": {"cardinality": {"field": "genre"}},
+                "s": {"stats": {"field": "price"}},
+            }}, done))
+        assert err is None, err
+        out = resp["aggregations"]
+        assert {b["key"]: b["doc_count"] for b in out["g"]["buckets"]} \
+            == {"scifi": 2, "fantasy": 2, "crime": 1}
+        scifi = next(b for b in out["g"]["buckets"]
+                     if b["key"] == "scifi")
+        assert scifi["p"]["value"] == pytest.approx(15.0)
+        assert out["c"]["value"] == 3
+        assert out["s"]["count"] == 6
+        assert out["s"]["sum"] == pytest.approx(120.0)
+    finally:
+        c.stop()
+
+
+def test_max_buckets_cap(svc):
+    from elasticsearch_tpu.utils.errors import IllegalArgumentError
+    with pytest.raises(IllegalArgumentError):
+        agg(svc, {"h": {"date_histogram": {
+            "field": "sold", "fixed_interval": "1s"}}})
+
+
+def test_filters_anonymous_shape_survives_empty_merge():
+    from elasticsearch_tpu.search.aggregations import parse_aggs, reduce_aggs
+    from elasticsearch_tpu.search.aggregations.engine import empty_partial
+    specs = parse_aggs({"f": {"filters": {"filters": [
+        {"term": {"genre": "scifi"}}]}}})
+    full = {"f": {"buckets": {"0": {"key": "0", "doc_count": 2,
+                                    "subs": {}}},
+                  "keyed": False, "order": ["0"]}}
+    empty = {"f": empty_partial(specs[0])}
+    # empty shard merged FIRST must not flip the response to keyed
+    out = reduce_aggs(specs, [empty, full])
+    assert isinstance(out["f"]["buckets"], list)
+    assert out["f"]["buckets"][0] == {"key": "0", "doc_count": 2}
+
+
+def test_bucket_selector_bad_request_is_400(svc):
+    from elasticsearch_tpu.utils.errors import IllegalArgumentError
+    with pytest.raises(IllegalArgumentError):
+        agg(svc, {"m": {
+            "date_histogram": {"field": "sold",
+                               "calendar_interval": "month"},
+            "aggs": {"keep": {"bucket_selector": {
+                "buckets_path": "rev", "script": "x > 0"}}}}})
+    with pytest.raises(IllegalArgumentError):
+        agg(svc, {"m": {
+            "date_histogram": {"field": "sold",
+                               "calendar_interval": "month"},
+            "aggs": {"keep": {"bucket_script": {
+                "buckets_path": {"x": "_count"}}}}}})
+
+
+def test_global_agg_disables_can_match():
+    from elasticsearch_tpu.testing import InProcessCluster
+    c = InProcessCluster(n_nodes=2, seed=13)
+    c.start()
+    try:
+        client = c.client()
+        c.call(lambda done: client.create_index(
+            "g", {"settings": {"number_of_shards": 2,
+                               "number_of_replicas": 0},
+                  "mappings": {"properties": {
+                      "t": {"type": "text"}}}}, done))
+        c.ensure_green("g")
+        # place docs so the query term exists on only one shard
+        items = [{"action": "index", "index": "g", "id": str(i),
+                  "source": {"t": "unique_zebra" if i == 0 else "common"}}
+                 for i in range(8)]
+        c.call(lambda done: client.bulk(items, done))
+        c.call(lambda done: client.refresh("g", done))
+        resp, err = c.call(lambda done: client.search("g", {
+            "size": 0, "query": {"match": {"t": "unique_zebra"}},
+            "aggs": {"all": {"global": {}}}}, done))
+        assert err is None, err
+        # the global agg must see all 8 docs even though can_match would
+        # normally skip the shard(s) lacking the term
+        assert resp["aggregations"]["all"]["doc_count"] == 8
+        assert resp["hits"]["total"]["value"] == 1
+    finally:
+        c.stop()
