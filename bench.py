@@ -78,6 +78,46 @@ def main() -> None:
     recall = np.mean([len(set(got[i]) & set(truth[i])) / k
                       for i in range(n_queries)])
 
+    # ---- ANN path (BASELINE config #3 class): IVF with an nprobe sweep
+    # to the recall@10 >= 0.95 operating point (the config's "ef_search
+    # sweep" analog). Real-feature corpora (GIST) are clustered, so the
+    # ANN corpus is a mixture of gaussians; iid noise is the adversarial
+    # no-structure case where every ANN method degrades to scanning.
+    from elasticsearch_tpu.ops.ivf import IVFIndex
+
+    n_clusters = 1024
+    means = rng.standard_normal((n_clusters, dims)).astype(np.float32)
+    which = rng.integers(0, n_clusters, n_docs)
+    ann_corpus = means[which] + \
+        0.35 * rng.standard_normal((n_docs, dims)).astype(np.float32)
+    ann_queries = ann_corpus[rng.integers(0, n_docs, n_queries)] + \
+        0.05 * rng.standard_normal((n_queries, dims)).astype(np.float32)
+    a64 = ann_corpus.astype(np.float64)
+    aq64 = ann_queries.astype(np.float64)
+    ascores = (aq64 @ a64.T) / (
+        np.linalg.norm(a64, axis=1)[None, :]
+        * np.linalg.norm(aq64, axis=1)[:, None] + 1e-30)
+    ann_truth = np.argsort(-ascores, axis=1)[:, :k]
+
+    index = IVFIndex.build(ann_corpus, similarity="cosine", seed=7)
+    aq_dev = jnp.asarray(ann_queries)
+    ann_qps = ann_recall = 0.0
+    nprobe = 0
+    for nprobe in (16, 32, 64, 128, 256):
+        s_a, i_a = index.search(ann_queries, k, nprobe=nprobe)
+        ann_recall = np.mean([len(set(i_a[i]) & set(ann_truth[i])) / k
+                              for i in range(n_queries)])
+        # warm the EXACT kernel the timed loop runs (Q=256 shape)
+        jax.block_until_ready(
+            index.search_device(aq_dev, k, nprobe=nprobe))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ds, di = index.search_device(aq_dev, k, nprobe=nprobe)
+        jax.block_until_ready((ds, di))
+        ann_qps = iters * n_queries / (time.perf_counter() - t0)
+        if ann_recall >= 0.95:
+            break
+
     target_qps = 5.0 * cpu_qps
     print(json.dumps({
         "metric": "knn_qps",
@@ -85,6 +125,9 @@ def main() -> None:
         "unit": "qps",
         "vs_baseline": round(float(device_qps / target_qps), 3),
         "recall_at_10": round(float(recall), 4),
+        "ann_qps": round(float(ann_qps), 2),
+        "ann_recall_at_10": round(float(ann_recall), 4),
+        "ann_nprobe": nprobe,
         "cpu_qps": round(float(cpu_qps), 2),
         "n_docs": n_docs,
         "dims": dims,

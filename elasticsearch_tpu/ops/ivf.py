@@ -1,0 +1,267 @@
+"""IVF approximate nearest neighbour — the TPU-native ANN index.
+
+The reference snapshot has no ANN at all (no HNSW, SURVEY.md version note);
+the capability target is BASELINE.json config #3 (HNSW-class recall/QPS).
+Graph-walk ANN (HNSW) is hostile to SPMD — data-dependent traversal, scalar
+hops, dynamic shapes — so this is an IVF/ScaNN-style design instead, which
+maps onto the MXU as two batched matmuls:
+
+  1. score queries against the [nlist, D] centroid matrix, take top-nprobe
+  2. gather those lists' padded vector blocks [nprobe, L, D] and score
+     exactly, masked top-k over the probed candidates
+
+Everything is static-shape: lists are padded to a common length L with a
+validity mask, so XLA compiles one kernel per (nprobe, k) and the cache
+stays warm. Build (k-means) also runs on device: Lloyd iterations are a
+distance matmul + argmin + segment-sum.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# k-means (device)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("nlist",))
+def _assign(x: jnp.ndarray, centroids: jnp.ndarray, nlist: int
+            ) -> jnp.ndarray:
+    # ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2 ; ||x||^2 constant per row
+    dots = jax.lax.dot_general(
+        x.astype(jnp.bfloat16), centroids.astype(jnp.bfloat16).T,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    return jnp.argmin(c2[None, :] - 2.0 * dots, axis=1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("nlist",))
+def _update(x: jnp.ndarray, assign: jnp.ndarray, centroids: jnp.ndarray,
+            nlist: int) -> jnp.ndarray:
+    sums = jax.ops.segment_sum(x, assign, num_segments=nlist)
+    counts = jax.ops.segment_sum(jnp.ones((x.shape[0],), jnp.float32),
+                                 assign, num_segments=nlist)
+    fresh = sums / jnp.maximum(counts, 1.0)[:, None]
+    # empty clusters keep their previous centroid
+    return jnp.where((counts > 0)[:, None], fresh, centroids)
+
+
+@partial(jax.jit, static_argnames=("nlist",))
+def _farthest_point_init(x: jnp.ndarray, first: jnp.ndarray,
+                         nlist: int) -> jnp.ndarray:
+    """Deterministic k-center seeding: repeatedly take the point farthest
+    from every centroid so far. One fori_loop kernel — n*d work per step —
+    and far more robust than random init (random seeds from one dense
+    region collapse neighbouring clusters into local optima)."""
+    n, d = x.shape
+    cents0 = jnp.zeros((nlist, d), x.dtype).at[0].set(x[first])
+    d20 = jnp.sum((x - x[first]) ** 2, axis=1)
+
+    def step(i, state):
+        cents, d2 = state
+        idx = jnp.argmax(d2)
+        c = x[idx]
+        cents = cents.at[i].set(c)
+        return cents, jnp.minimum(d2, jnp.sum((x - c) ** 2, axis=1))
+    cents, _ = jax.lax.fori_loop(1, nlist, step, (cents0, d20))
+    return cents
+
+
+def kmeans(vectors: np.ndarray, nlist: int, iters: int = 10,
+           seed: int = 17) -> np.ndarray:
+    """Farthest-point init + Lloyd's on device; [nlist, D] f32 centroids."""
+    n, d = vectors.shape
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(vectors, jnp.float32)
+    if n <= nlist:
+        reps = np.resize(vectors.astype(np.float32), (nlist, d))
+        return reps
+    # seed on a subsample to bound init cost at ~25*nlist points
+    cap = min(n, max(25 * nlist, 2 * nlist))
+    sample = (np.arange(n) if n <= cap
+              else rng.choice(n, size=cap, replace=False))
+    c = _farthest_point_init(x[jnp.asarray(sample)],
+                             jnp.asarray(rng.integers(len(sample))),
+                             nlist)
+    for _ in range(iters):
+        c = _update(x, _assign(x, c, nlist), c, nlist)
+    return np.asarray(c)
+
+
+# ---------------------------------------------------------------------------
+# index build (host packing, device math)
+# ---------------------------------------------------------------------------
+
+class IVFIndex:
+    """Padded inverted-file index over one vector corpus.
+
+    lists:  [nlist, L, D] float32 (zero-padded)
+    valid:  [nlist, L]    bool
+    ids:    [nlist, L]    int32 (-1 where padded) — original row indices
+    """
+
+    def __init__(self, centroids, lists, valid, ids, similarity: str,
+                 norms):
+        self.centroids = centroids
+        self.lists = lists
+        self.valid = valid
+        self.ids = ids
+        self.similarity = similarity
+        self.norms = norms           # [nlist, L] doc norms (cosine/l2)
+        self.nlist = int(centroids.shape[0])
+        self.list_len = int(lists.shape[1])
+
+    @staticmethod
+    def build(vectors: np.ndarray, nlist: Optional[int] = None,
+              similarity: str = "cosine", iters: int = 10,
+              slack: float = 1.5, seed: int = 17) -> "IVFIndex":
+        n, d = vectors.shape
+        if n == 0:
+            raise ValueError("cannot build an IVF index over zero vectors")
+        if nlist is None:
+            nlist = max(1, min(n, int(4 * np.sqrt(n))))
+        nlist = max(1, min(nlist, n))
+        vectors = np.asarray(vectors, np.float32)
+        cents = kmeans(vectors, nlist, iters=iters, seed=seed)
+        assign = np.asarray(_assign(jnp.asarray(vectors),
+                                    jnp.asarray(cents), nlist))
+        cap = max(1, int(np.ceil(n / nlist * slack)))
+        # balanced packing: overflow spills to the next-nearest centroid
+        order = np.argsort(assign, kind="stable")
+        buckets: list = [[] for _ in range(nlist)]
+        spilled = []
+        for row in order:
+            a = assign[row]
+            if len(buckets[a]) < cap:
+                buckets[a].append(row)
+            else:
+                spilled.append(row)
+        if spilled:
+            x = vectors[np.asarray(spilled)]
+            dots = x @ cents.T
+            c2 = (cents * cents).sum(axis=1)
+            dist = c2[None, :] - 2 * dots
+            ranked = np.argsort(dist, axis=1)
+            for i, row in enumerate(spilled):
+                placed = False
+                for c_idx in ranked[i]:
+                    if len(buckets[c_idx]) < cap:
+                        buckets[c_idx].append(row)
+                        placed = True
+                        break
+                if not placed:   # all full (can't happen with slack > 1)
+                    buckets[int(ranked[i][0])].append(row)
+        L = max(cap, max(len(b) for b in buckets))
+        lists = np.zeros((nlist, L, d), np.float32)
+        valid = np.zeros((nlist, L), bool)
+        ids = np.full((nlist, L), -1, np.int32)
+        for ci, rows in enumerate(buckets):
+            m = len(rows)
+            if m:
+                lists[ci, :m] = vectors[rows]
+                valid[ci, :m] = True
+                ids[ci, :m] = rows
+        norms = np.linalg.norm(lists, axis=2).astype(np.float32)
+        return IVFIndex(jnp.asarray(cents), jnp.asarray(lists),
+                        jnp.asarray(valid), jnp.asarray(ids),
+                        similarity, jnp.asarray(norms))
+
+    # -- search ----------------------------------------------------------
+
+    # HBM budget for the [chunk, nprobe, L, D] gather the probe phase
+    # materializes; the query chunk adapts to it (pow-2 so the XLA compile
+    # cache stays warm) — big chunks matter because each kernel call pays
+    # a dispatch round-trip
+    GATHER_BYTES_BUDGET = 1 << 30
+
+    def _chunk_for(self, nprobe: int) -> int:
+        dims = int(self.lists.shape[2])
+        per_query = nprobe * self.list_len * dims * 4
+        chunk = max(1, self.GATHER_BYTES_BUDGET // max(per_query, 1))
+        chunk = min(chunk, 256)
+        return 1 << (chunk.bit_length() - 1)      # floor to pow-2
+
+    def search_device(self, q_dev: jnp.ndarray, k: int, nprobe: int = 8
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Device-in/device-out single-kernel search (no host sync): the
+        serving path — callers pipeline batches without paying a dispatch
+        round-trip per batch."""
+        nprobe = max(1, min(int(nprobe), self.nlist))
+        k = max(1, min(int(k), nprobe * self.list_len))
+        return _ivf_search(q_dev, self.centroids, self.lists, self.valid,
+                           self.ids, self.norms, k, nprobe,
+                           self.similarity)
+
+    def search(self, queries: np.ndarray, k: int, nprobe: int = 8
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched ANN: (scores [Q, k], ids [Q, k]); ids -1 past matches.
+        Scores use the same positive transforms as ops/knn.py."""
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        nprobe = max(1, min(int(nprobe), self.nlist))
+        # k cannot exceed the probed candidate pool (top_k width)
+        k = max(1, min(int(k), nprobe * self.list_len))
+        nq = q.shape[0]
+        chunk = self._chunk_for(nprobe)
+        if nq <= chunk:
+            padded = np.zeros((chunk, q.shape[1]), np.float32)
+            padded[:nq] = q
+            s, i = _ivf_search(jnp.asarray(padded), self.centroids,
+                               self.lists, self.valid, self.ids,
+                               self.norms, k, nprobe, self.similarity)
+            return np.asarray(s)[:nq], np.asarray(i)[:nq]
+        out_s = np.empty((nq, k), np.float32)
+        out_i = np.empty((nq, k), np.int32)
+        for lo in range(0, nq, chunk):
+            hi = min(lo + chunk, nq)
+            s, i = self.search(q[lo:hi], k, nprobe)
+            out_s[lo:hi], out_i[lo:hi] = s, i
+        return out_s, out_i
+
+
+@partial(jax.jit, static_argnames=("k", "nprobe", "similarity"))
+def _ivf_search(q, centroids, lists, valid, ids, norms, k: int,
+                nprobe: int, similarity: str):
+    qb = q.astype(jnp.bfloat16)
+    cscores = jax.lax.dot_general(
+        qb, centroids.astype(jnp.bfloat16).T,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [Q, nlist]
+    if similarity == "l2_norm":
+        c2 = jnp.sum(centroids * centroids, axis=1)
+        cscores = 2.0 * cscores - c2[None, :]        # -dist^2 + const
+    _, probes = jax.lax.top_k(cscores, nprobe)       # [Q, nprobe]
+
+    blocks = lists[probes]                           # [Q, nprobe, L, D]
+    dots = jnp.einsum("qd,qpld->qpl", qb, blocks.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+    bnorms = norms[probes]                           # [Q, nprobe, L]
+    if similarity == "dot_product":
+        scores = 0.5 + dots / 2.0
+    elif similarity == "cosine":
+        qn = jnp.linalg.norm(q, axis=1) + 1e-30      # [Q]
+        cos = dots / (bnorms * qn[:, None, None] + 1e-30)
+        scores = (1.0 + cos) / 2.0
+    else:  # l2_norm
+        q2 = jnp.sum(q * q, axis=1)                  # [Q]
+        d2 = jnp.maximum(bnorms * bnorms + q2[:, None, None] - 2.0 * dots,
+                         0.0)
+        scores = 1.0 / (1.0 + jnp.sqrt(d2))
+    scores = jnp.where(valid[probes], scores, -jnp.inf)
+
+    flat = scores.reshape(scores.shape[0], -1)
+    flat_ids = ids[probes].reshape(scores.shape[0], -1)
+    top_s, pos = jax.lax.top_k(flat, k)
+    top_i = jnp.take_along_axis(flat_ids, pos, axis=1)
+    top_i = jnp.where(jnp.isfinite(top_s), top_i, -1)
+    top_s = jnp.where(jnp.isfinite(top_s), top_s, 0.0)
+    return top_s, top_i

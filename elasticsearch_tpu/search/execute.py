@@ -545,12 +545,74 @@ class KnnBound(dsl.Query):
     boost: float = 1.0
 
 
+# segments at or above this size use the IVF ANN path by default (below it
+# exact brute force is both faster and perfectly accurate)
+ANN_DEFAULT_MIN_DOCS = 65536
+
+
+def _ann_segment_topk(ctx: "SegmentContext", q: dsl.Knn
+                      ) -> Optional[List[Tuple[int, int, float]]]:
+    """IVF path for one segment, or None to fall back to exact.
+
+    Used when the mapping opts in (index_options {"type": "ivf"}) or the
+    segment is large enough that brute force wastes FLOPs. Deleted docs are
+    filtered after probing (the Lucene-HNSW-style post-filter), with
+    oversampling to keep k results available."""
+    if q.filter is not None:
+        return None       # filtered kNN stays exact (correctness first)
+    seg = ctx.segment
+    vf = seg.vectors.get(q.field)
+    if vf is None:
+        return None
+    mapper = ctx.mappers.mapper(q.field)
+    opts = getattr(mapper, "index_options", None) or {}
+    wants_ivf = opts.get("type") == "ivf"
+    if not wants_ivf and seg.n_docs < ANN_DEFAULT_MIN_DOCS:
+        return None
+    if opts.get("type") not in (None, "ivf"):
+        return None       # unknown index type: exact
+    from elasticsearch_tpu.ops.ivf import IVFIndex
+
+    def build():
+        rows = np.nonzero(vf.exists)[0]
+        if len(rows) == 0:
+            return None, rows.astype(np.int64)
+        index = IVFIndex.build(vf.matrix[rows],
+                               nlist=opts.get("nlist"),
+                               similarity=vf.similarity)
+        return index, rows.astype(np.int64)
+    index, rows = seg.device(("ivf", q.field), build)
+    if index is None:
+        return []         # field present but no vectors in this segment
+
+    oversample = min(max(2 * q.k, q.k + 16), len(rows))
+    nprobe = opts.get("nprobe") or max(
+        1, int(np.ceil(q.num_candidates / max(index.list_len, 1))))
+    scores, ids = index.search(np.asarray(q.query_vector, np.float32),
+                               oversample, nprobe=nprobe)
+    live = np.asarray(ctx.live)[: seg.n_docs]
+    out: List[Tuple[int, int, float]] = []
+    for s, i in zip(scores[0], ids[0]):
+        if i < 0:
+            continue
+        doc = int(rows[i])
+        if doc < len(live) and live[doc]:
+            out.append((ctx.segment_idx, doc, float(s)))
+        if len(out) >= q.k:
+            break
+    return out
+
+
 def rewrite_knn(q: dsl.Query, segment_ctxs: List["SegmentContext"]) -> dsl.Query:
     """Replace every Knn node with a KnnBound node holding the shard-global
     top-k (merged across segments)."""
     if isinstance(q, dsl.Knn):
         per_seg_hits: List[Tuple[int, int, float]] = []
         for ctx in segment_ctxs:
+            ann = _ann_segment_topk(ctx, q)
+            if ann is not None:
+                per_seg_hits.extend(ann)
+                continue
             dev = DeviceVectors.for_segment(ctx.segment, q.field)
             if dev is None:
                 continue
