@@ -205,17 +205,19 @@ class Coordinator:
                  settings: Optional[CoordinatorSettings] = None,
                  rng: Optional[random_mod.Random] = None,
                  on_committed: Optional[Callable[[ClusterState], None]] = None,
-                 seed_peers: Optional[List[str]] = None):
+                 seed_peers: Optional[List[str]] = None,
+                 persisted_state: Optional[PersistedState] = None):
         self.node = node
         self.ts = transport_service
         self.scheduler = scheduler
         self.settings = settings or CoordinatorSettings()
         self.rng = rng or random_mod.Random(hash(node.node_id) & 0xFFFF)
-        self.state = CoordinationState(node.node_id,
-                                       PersistedState(accepted_state=initial_state))
+        persisted = persisted_state if persisted_state is not None \
+            else PersistedState(accepted_state=initial_state)
+        self.state = CoordinationState(node.node_id, persisted)
         self.mode = Mode.CANDIDATE
         self.leader_id: Optional[str] = None
-        self.applied_state: ClusterState = initial_state
+        self.applied_state: ClusterState = persisted.accepted_state
         self.on_committed = on_committed
         self._election_attempts = 0
         self._election_timer: Optional[Cancellable] = None

@@ -419,6 +419,43 @@ class InternalEngine:
                 self.refresh()
             return replayed
 
+    def restore_segments(self, segments: List[Segment]) -> None:
+        """Replace ALL engine state with the given segments (snapshot
+        restore; RestoreService.java:121 runs restore as a special recovery
+        source the same way)."""
+        with self._lock:
+            self.segments = list(segments)
+            self._buffer.clear()
+            self._buffer_order.clear()
+            self._pending_tombstones.clear()
+            # continue numbering past the restored names (sparse after
+            # merges); a collision would shadow a committed segment file
+            self._segment_counter = 0
+            for seg in self.segments:
+                if "_seg" in seg.name:
+                    try:
+                        num = int(seg.name.rsplit("_seg", 1)[1])
+                        self._segment_counter = max(self._segment_counter,
+                                                    num)
+                    except ValueError:
+                        pass
+            max_seq = -1
+            self._version_map = {}
+            for seg in self.segments:
+                for doc_id, d in seg.id_to_doc.items():
+                    if seg.live[d]:
+                        self._version_map[doc_id] = VersionEntry(
+                            int(seg.seqnos[d]) if len(seg.seqnos) > d else 0,
+                            int(seg.primary_terms[d])
+                            if len(seg.primary_terms) > d else 1,
+                            int(seg.versions[d])
+                            if len(seg.versions) > d else 1)
+                if len(seg.seqnos):
+                    max_seq = max(max_seq, int(seg.seqnos.max()))
+            self.tracker = LocalCheckpointTracker(max_seq, max_seq)
+            if self.store is not None:
+                self.flush()
+
     def _replay(self, op: TranslogOp) -> None:
         if op.op_type == "index":
             self.index(op.doc_id, op.source, routing=op.routing,

@@ -35,74 +35,7 @@ class Store:
     # -- segments --------------------------------------------------------
 
     def write_segment(self, seg: Segment) -> None:
-        arrays: Dict[str, np.ndarray] = {}
-        meta: Dict[str, Any] = {
-            "name": seg.name, "n_docs": seg.n_docs,
-            "ids": seg.ids, "sources": seg.sources,
-            "fields": {"postings": {}, "keywords": {}, "doc_values": {},
-                       "vectors": {}, "features": {}, "geo": []},
-        }
-        arrays["live"] = seg.live
-        arrays["seqnos"] = seg.seqnos
-        arrays["versions"] = seg.versions
-        arrays["primary_terms"] = seg.primary_terms
-
-        for fname, pf in seg.postings.items():
-            k = f"p.{fname}"
-            term_list = [""] * len(pf.terms)
-            for t, tid in pf.terms.items():
-                term_list[tid] = t
-            meta["fields"]["postings"][fname] = {
-                "terms": term_list, "sum_doc_len": pf.sum_doc_len}
-            arrays[f"{k}.block_docs"] = pf.block_docs
-            arrays[f"{k}.block_tfs"] = pf.block_tfs
-            arrays[f"{k}.block_term"] = pf.block_term
-            arrays[f"{k}.block_max_tf"] = pf.block_max_tf
-            arrays[f"{k}.term_block_start"] = pf.term_block_start
-            arrays[f"{k}.term_block_count"] = pf.term_block_count
-            arrays[f"{k}.doc_freq"] = pf.doc_freq
-            arrays[f"{k}.doc_lens"] = pf.doc_lens
-            arrays[f"{k}.pos_offsets"] = pf.pos_offsets
-            arrays[f"{k}.pos_flat"] = pf.pos_flat
-
-        for fname, kf in seg.keywords.items():
-            k = f"k.{fname}"
-            meta["fields"]["keywords"][fname] = {"terms": kf.term_list}
-            arrays[f"{k}.ord_values"] = kf.ord_values
-            arrays[f"{k}.ord_offsets"] = kf.ord_offsets
-            arrays[f"{k}.doc_freq"] = kf.doc_freq
-
-        for fname, dv in seg.doc_values.items():
-            k = f"d.{fname}"
-            meta["fields"]["doc_values"][fname] = {
-                "multi": {str(i): v for i, v in dv.multi.items()}}
-            arrays[f"{k}.values"] = dv.values
-            arrays[f"{k}.exists"] = dv.exists
-
-        for fname, vf in seg.vectors.items():
-            k = f"v.{fname}"
-            meta["fields"]["vectors"][fname] = {"similarity": vf.similarity, "dims": vf.dims}
-            arrays[f"{k}.matrix"] = vf.matrix
-            arrays[f"{k}.exists"] = vf.exists
-            arrays[f"{k}.norms"] = vf.norms
-
-        for fname, ff in seg.features.items():
-            k = f"f.{fname}"
-            feat_list = [""] * len(ff.features)
-            for t, fid in ff.features.items():
-                feat_list[fid] = t
-            meta["fields"]["features"][fname] = {"features": feat_list}
-            arrays[f"{k}.block_docs"] = ff.block_docs
-            arrays[f"{k}.block_weights"] = ff.block_weights
-            arrays[f"{k}.block_max_weight"] = ff.block_max_weight
-            arrays[f"{k}.feat_block_start"] = ff.feat_block_start
-            arrays[f"{k}.feat_block_count"] = ff.feat_block_count
-            arrays[f"{k}.doc_freq"] = ff.doc_freq
-
-        for fname, arr in seg.geo.items():
-            meta["fields"]["geo"].append(fname)
-            arrays[f"g.{fname}"] = arr
-
+        arrays, meta = segment_payload(seg)
         seg_dir = self.path / "segments"
         npz_tmp = seg_dir / f".{seg.name}.npz.tmp"
         with open(npz_tmp, "wb") as f:
@@ -256,3 +189,82 @@ class Store:
 
     def list_segment_files(self) -> List[str]:
         return sorted(p.stem for p in (self.path / "segments").glob("*.npz"))
+
+
+def segment_payload(seg: Segment):
+    """(arrays, json-able meta) — the full serialized form of a segment.
+    Shared by the on-disk store and the snapshot repository format."""
+    arrays: Dict[str, np.ndarray] = {}
+    meta: Dict[str, Any] = {
+        "name": seg.name, "n_docs": seg.n_docs,
+        "ids": seg.ids, "sources": seg.sources,
+        "fields": {"postings": {}, "keywords": {}, "doc_values": {},
+                   "vectors": {}, "features": {}, "geo": []},
+    }
+    arrays["live"] = seg.live
+    arrays["seqnos"] = seg.seqnos
+    arrays["versions"] = seg.versions
+    arrays["primary_terms"] = seg.primary_terms
+
+    for fname, pf in seg.postings.items():
+        k = f"p.{fname}"
+        term_list = [""] * len(pf.terms)
+        for t, tid in pf.terms.items():
+            term_list[tid] = t
+        meta["fields"]["postings"][fname] = {
+            "terms": term_list, "sum_doc_len": pf.sum_doc_len}
+        arrays[f"{k}.block_docs"] = pf.block_docs
+        arrays[f"{k}.block_tfs"] = pf.block_tfs
+        arrays[f"{k}.block_term"] = pf.block_term
+        arrays[f"{k}.block_max_tf"] = pf.block_max_tf
+        arrays[f"{k}.term_block_start"] = pf.term_block_start
+        arrays[f"{k}.term_block_count"] = pf.term_block_count
+        arrays[f"{k}.doc_freq"] = pf.doc_freq
+        arrays[f"{k}.doc_lens"] = pf.doc_lens
+        arrays[f"{k}.pos_offsets"] = pf.pos_offsets
+        arrays[f"{k}.pos_flat"] = pf.pos_flat
+
+    for fname, kf in seg.keywords.items():
+        k = f"k.{fname}"
+        meta["fields"]["keywords"][fname] = {"terms": kf.term_list}
+        arrays[f"{k}.ord_values"] = kf.ord_values
+        arrays[f"{k}.ord_offsets"] = kf.ord_offsets
+        arrays[f"{k}.doc_freq"] = kf.doc_freq
+
+    for fname, dv in seg.doc_values.items():
+        k = f"d.{fname}"
+        meta["fields"]["doc_values"][fname] = {
+            "multi": {str(i): v for i, v in dv.multi.items()}}
+        arrays[f"{k}.values"] = dv.values
+        arrays[f"{k}.exists"] = dv.exists
+
+    for fname, vf in seg.vectors.items():
+        k = f"v.{fname}"
+        meta["fields"]["vectors"][fname] = {"similarity": vf.similarity, "dims": vf.dims}
+        arrays[f"{k}.matrix"] = vf.matrix
+        arrays[f"{k}.exists"] = vf.exists
+        arrays[f"{k}.norms"] = vf.norms
+
+    for fname, ff in seg.features.items():
+        k = f"f.{fname}"
+        feat_list = [""] * len(ff.features)
+        for t, fid in ff.features.items():
+            feat_list[fid] = t
+        meta["fields"]["features"][fname] = {"features": feat_list}
+        arrays[f"{k}.block_docs"] = ff.block_docs
+        arrays[f"{k}.block_weights"] = ff.block_weights
+        arrays[f"{k}.block_max_weight"] = ff.block_max_weight
+        arrays[f"{k}.feat_block_start"] = ff.feat_block_start
+        arrays[f"{k}.feat_block_count"] = ff.feat_block_count
+        arrays[f"{k}.doc_freq"] = ff.doc_freq
+
+    for fname, arr in seg.geo.items():
+        meta["fields"]["geo"].append(fname)
+        arrays[f"g.{fname}"] = arr
+
+    return arrays, meta
+
+
+def segment_from_payload(meta, data) -> Segment:
+    """Inverse of segment_payload (shared with the snapshot repository)."""
+    return Store._segment_from(meta, data)

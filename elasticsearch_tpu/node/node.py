@@ -60,10 +60,20 @@ class Node:
         self.allocation_service = AllocationService()
 
         initial_state = initial_state or ClusterState()
+        persisted_state = None
+        if data_path is not None:
+            # gateway: boot from the durably persisted term + accepted
+            # state (GatewayMetaState analog); shards themselves recover
+            # from their local stores when the reconciler applies state
+            from elasticsearch_tpu.gateway import GatewayMetaState
+            # data_path is already per-node (callers namespace it)
+            persisted_state = GatewayMetaState(data_path).load_or_create(
+                initial_state)
         self.coordinator = Coordinator(
             self.discovery_node, self.transport_service, scheduler,
             initial_state, settings=coordinator_settings,
-            seed_peers=seed_peers, on_committed=self._on_committed)
+            seed_peers=seed_peers, on_committed=self._on_committed,
+            persisted_state=persisted_state)
 
         self.reconciler = IndicesClusterStateService(
             node_id, self.indices_service, self.transport_service)
@@ -89,6 +99,13 @@ class Node:
         self.broadcast_actions = BroadcastActions(
             node_id, self.indices_service, self.transport_service,
             self._applied_state)
+
+        from elasticsearch_tpu.action.snapshot import (
+            SnapshotActions, SnapshotShardActions,
+        )
+        self.snapshot_shard_actions = SnapshotShardActions(
+            self.indices_service, self.transport_service)
+        self.snapshot_actions = SnapshotActions(self)
 
         self.client = NodeClient(self)
 
@@ -332,6 +349,46 @@ class NodeClient:
                      "indices": indices_out}, None)
         self.node.broadcast_actions.broadcast(STATS_SHARD, index_expression,
                                               cb, names=names)
+
+    # -- snapshots ------------------------------------------------------
+
+    def put_repository(self, name: str, body: Dict[str, Any],
+                       on_done) -> None:
+        from elasticsearch_tpu.repositories import repository_settings
+        try:
+            settings = repository_settings(name, body or {})
+        except Exception as e:
+            on_done(None, e)
+            return
+        self.cluster_update_settings({"persistent": settings}, on_done)
+
+    def get_repositories(self) -> Dict[str, Any]:
+        state = self.node._applied_state()
+        out: Dict[str, Any] = {}
+        for key, val in state.metadata.persistent_settings.items():
+            if key.startswith("repositories.") and key.endswith(".type"):
+                name = key[len("repositories."):-len(".type")]
+                out[name] = {
+                    "type": val,
+                    "settings": {"location":
+                                 state.metadata.persistent_settings.get(
+                                     f"repositories.{name}.location")}}
+        return out
+
+    def create_snapshot(self, repo: str, snap: str,
+                        body: Optional[Dict[str, Any]], on_done) -> None:
+        self.node.snapshot_actions.create(repo, snap, body, on_done)
+
+    def restore_snapshot(self, repo: str, snap: str,
+                         body: Optional[Dict[str, Any]], on_done) -> None:
+        self.node.snapshot_actions.restore(repo, snap, body, on_done)
+
+    def get_snapshots(self, repo: str, snap: str = "_all"
+                      ) -> Dict[str, Any]:
+        return self.node.snapshot_actions.get(repo, snap)
+
+    def delete_snapshot(self, repo: str, snap: str) -> Dict[str, Any]:
+        return self.node.snapshot_actions.delete(repo, snap)
 
     # -- cluster --------------------------------------------------------
 

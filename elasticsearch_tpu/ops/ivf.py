@@ -213,7 +213,12 @@ class IVFIndex:
         nq = q.shape[0]
         chunk = self._chunk_for(nprobe)
         if nq <= chunk:
-            padded = np.zeros((chunk, q.shape[1]), np.float32)
+            # pad to the next pow-2 of the ACTUAL batch (<= chunk): a
+            # single query must not pay the full budget-sized gather,
+            # and pow-2 shapes keep the compile cache to ~9 entries
+            width = 1 << max(0, (nq - 1)).bit_length()
+            width = min(max(width, 1), chunk)
+            padded = np.zeros((width, q.shape[1]), np.float32)
             padded[:nq] = q
             s, i = _ivf_search(jnp.asarray(padded), self.centroids,
                                self.lists, self.valid, self.ids,

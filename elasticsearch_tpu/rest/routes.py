@@ -325,6 +325,50 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/{index}/_stats", index_stats)
     r("GET", "/_stats", index_stats)
 
+    # -- snapshots --------------------------------------------------------
+
+    def repo_put(req: RestRequest, done: DoneFn) -> None:
+        client.put_repository(req.params["repo"], req.body or {},
+                              wrap_client_cb(done))
+    r("PUT", "/_snapshot/{repo}", repo_put)
+    r("POST", "/_snapshot/{repo}", repo_put)
+
+    def repo_get(req: RestRequest, done: DoneFn) -> None:
+        repos = client.get_repositories()
+        name = req.params.get("repo")
+        if name and name not in ("_all", "*"):
+            if name not in repos:
+                from elasticsearch_tpu.repositories import (
+                    SnapshotMissingError,
+                )
+                raise SnapshotMissingError(
+                    f"repository [{name}] is missing")
+            repos = {name: repos[name]}
+        done(200, repos)
+    r("GET", "/_snapshot", repo_get)
+    r("GET", "/_snapshot/{repo}", repo_get)
+
+    def snapshot_put(req: RestRequest, done: DoneFn) -> None:
+        client.create_snapshot(req.params["repo"], req.params["snap"],
+                               req.body, wrap_client_cb(done))
+    r("PUT", "/_snapshot/{repo}/{snap}", snapshot_put)
+    r("POST", "/_snapshot/{repo}/{snap}", snapshot_put)
+
+    def snapshot_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.get_snapshots(req.params["repo"],
+                                       req.params.get("snap", "_all")))
+    r("GET", "/_snapshot/{repo}/{snap}", snapshot_get)
+
+    def snapshot_delete(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.delete_snapshot(req.params["repo"],
+                                         req.params["snap"]))
+    r("DELETE", "/_snapshot/{repo}/{snap}", snapshot_delete)
+
+    def snapshot_restore(req: RestRequest, done: DoneFn) -> None:
+        client.restore_snapshot(req.params["repo"], req.params["snap"],
+                                req.body, wrap_client_cb(done))
+    r("POST", "/_snapshot/{repo}/{snap}/_restore", snapshot_restore)
+
     # -- cluster ----------------------------------------------------------
 
     def health(req: RestRequest, done: DoneFn) -> None:

@@ -1,0 +1,218 @@
+"""Snapshot/restore + gateway persistence tests (SnapshotsService /
+BlobStoreRepository / GatewayMetaState analogs)."""
+
+import json
+import os
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = InProcessCluster(n_nodes=2, seed=21,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    yield c
+    c.stop()
+
+
+def put_docs(c, client, index, docs, shards=2):
+    c.call(lambda done: client.create_index(index, {
+        "settings": {"number_of_shards": shards,
+                     "number_of_replicas": 0},
+        "mappings": {"properties": {"t": {"type": "text"},
+                                    "n": {"type": "long"}}}}, done))
+    c.ensure_green(index)
+    items = [{"action": "index", "index": index, "id": str(i),
+              "source": d} for i, d in enumerate(docs)]
+    resp, err = c.call(lambda done: client.bulk(items, done))
+    assert err is None and not resp.get("errors"), resp
+    c.call(lambda done: client.refresh(index, done))
+
+
+def test_snapshot_restore_round_trip(cluster, tmp_path):
+    client = cluster.client()
+    docs = [{"t": f"doc number {i}", "n": i} for i in range(20)]
+    put_docs(cluster, client, "src", docs)
+
+    resp, err = cluster.call(lambda done: client.put_repository(
+        "repo1", {"type": "fs",
+                  "settings": {"location": str(tmp_path / "repo")}}, done))
+    assert err is None, err
+
+    resp, err = cluster.call(lambda done: client.create_snapshot(
+        "repo1", "snap1", {"indices": "src"}, done))
+    assert err is None, err
+    assert resp["snapshot"]["state"] == "SUCCESS"
+    assert resp["snapshot"]["indices"] == ["src"]
+
+    # list + get
+    got = client.get_snapshots("repo1")
+    assert [s["snapshot"] for s in got["snapshots"]] == ["snap1"]
+
+    # restore under a new name
+    resp, err = cluster.call(lambda done: client.restore_snapshot(
+        "repo1", "snap1", {"indices": "src",
+                           "rename_pattern": "src",
+                           "rename_replacement": "restored"}, done),
+        max_time=120.0)
+    assert err is None, err
+    assert resp["indices"] == ["restored"]
+    cluster.ensure_green("restored")
+
+    resp, err = cluster.call(lambda done: client.search(
+        "restored", {"query": {"match": {"t": "doc"}},
+                     "track_total_hits": True, "size": 0}, done))
+    assert err is None, err
+    assert resp["hits"]["total"]["value"] == 20
+
+
+def test_snapshot_incremental_blobs(cluster, tmp_path):
+    client = cluster.client()
+    docs = [{"t": f"words here {i}", "n": i} for i in range(10)]
+    put_docs(cluster, client, "inc", docs, shards=1)
+    cluster.call(lambda done: client.put_repository(
+        "r", {"type": "fs",
+              "settings": {"location": str(tmp_path / "r")}}, done))
+    resp, err = cluster.call(lambda done: client.create_snapshot(
+        "r", "s1", {"indices": "inc"}, done))
+    assert err is None, err
+    blob_dir = tmp_path / "r" / "blobs"
+    n_before = len(list(blob_dir.glob("*.npz")))
+    # second snapshot with NO changes must add no new blobs
+    resp, err = cluster.call(lambda done: client.create_snapshot(
+        "r", "s2", {"indices": "inc"}, done))
+    assert err is None, err
+    assert len(list(blob_dir.glob("*.npz"))) == n_before
+
+    # deleting one snapshot keeps shared blobs, deleting both gcs them
+    client.delete_snapshot("r", "s1")
+    assert len(list(blob_dir.glob("*.npz"))) == n_before
+    client.delete_snapshot("r", "s2")
+    assert len(list(blob_dir.glob("*.npz"))) == 0
+
+
+def test_missing_repo_and_snapshot_404(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.create_snapshot(
+        "nope", "s", None, done))
+    assert err is not None and getattr(err, "status", None) == 404
+    with pytest.raises(Exception) as ei:
+        client.get_snapshots("nope")
+    assert getattr(ei.value, "status", None) == 404
+
+
+def test_gateway_survives_restart(tmp_path):
+    """Kill the whole cluster; a fresh cluster over the same data paths
+    must recover cluster metadata (gateway) and shard data (store)."""
+    data = str(tmp_path / "data")
+    c = InProcessCluster(n_nodes=1, seed=31, data_path=data)
+    c.start()
+    try:
+        client = c.client()
+        put_docs(c, client, "persist",
+                 [{"t": f"persistent doc {i}", "n": i} for i in range(8)],
+                 shards=1)
+        c.call(lambda done: client.flush("persist", done))
+    finally:
+        c.stop()
+
+    c2 = InProcessCluster(n_nodes=1, seed=32, data_path=data)
+    c2.start()
+    try:
+        client = c2.client()
+        c2.ensure_green("persist", max_time=120.0)
+        resp, err = c2.call(lambda done: client.search(
+            "persist", {"query": {"match_all": {}},
+                        "track_total_hits": True, "size": 0}, done))
+        assert err is None, err
+        assert resp["hits"]["total"]["value"] == 8
+        # the index metadata came from the gateway, not a fresh create
+        state = client.node._applied_state()
+        assert "persist" in state.metadata.indices
+    finally:
+        c2.stop()
+
+
+def test_restore_with_replicas_populates_them(cluster, tmp_path):
+    client = cluster.client()
+    put_docs(cluster, client, "rsrc",
+             [{"t": f"replica test {i}", "n": i} for i in range(12)],
+             shards=1)
+    cluster.call(lambda done: client.put_repository(
+        "rr", {"type": "fs",
+               "settings": {"location": str(tmp_path / "rr")}}, done))
+    resp, err = cluster.call(lambda done: client.create_snapshot(
+        "rr", "s", {"indices": "rsrc"}, done))
+    assert err is None and resp["snapshot"]["state"] == "SUCCESS"
+    cluster.call(lambda done: client.delete_index("rsrc", done))
+
+    # manifest says replicas=0; force 1 replica via the restore body? The
+    # manifest drives it — snapshot an index WITH a replica instead.
+    resp, err = cluster.call(lambda done: client.restore_snapshot(
+        "rr", "s", {"rename_pattern": "rsrc",
+                    "rename_replacement": "rdst"}, done),
+        max_time=120.0)
+    assert err is None, err
+    cluster.ensure_green("rdst")
+    resp, err = cluster.call(lambda done: client.search(
+        "rdst", {"size": 0, "track_total_hits": True}, done))
+    assert resp["hits"]["total"]["value"] == 12
+
+    # now add a replica AFTER restore and check it serves the data too
+    cluster.call(lambda done: client.update_settings(
+        "rdst", {"number_of_replicas": 1}, done))
+    cluster.ensure_green("rdst", max_time=120.0)
+    state = client.node._applied_state()
+    replicas = [sr for sr in
+                state.routing_table.index("rdst").all_shards()
+                if not sr.primary]
+    assert replicas and all(sr.active for sr in replicas)
+    rnode = cluster.nodes[replicas[0].node_id]
+    rshard = rnode.indices_service.shard("rdst", replicas[0].shard_id)
+    assert rshard.engine.doc_count == 12
+
+
+def test_partial_snapshot_restore_refused(cluster, tmp_path):
+    client = cluster.client()
+    put_docs(cluster, client, "p1", [{"t": "x", "n": 1}], shards=1)
+    cluster.call(lambda done: client.put_repository(
+        "pr", {"type": "fs",
+               "settings": {"location": str(tmp_path / "pr")}}, done))
+    # doctor a PARTIAL manifest
+    from elasticsearch_tpu.repositories import FsRepository
+    repo = FsRepository(str(tmp_path / "pr"))
+    resp, err = cluster.call(lambda done: client.create_snapshot(
+        "pr", "sp", {"indices": "p1"}, done))
+    m = repo.read_snapshot("sp")
+    m["state"] = "PARTIAL"
+    repo.write_snapshot("sp", m)
+    resp, err = cluster.call(lambda done: client.restore_snapshot(
+        "pr", "sp", {"rename_pattern": "p1",
+                     "rename_replacement": "p2"}, done))
+    assert err is not None and "PARTIAL" in str(err)
+    # explicit opt-in works
+    resp, err = cluster.call(lambda done: client.restore_snapshot(
+        "pr", "sp", {"partial": True, "rename_pattern": "p1",
+                     "rename_replacement": "p2"}, done),
+        max_time=120.0)
+    assert err is None, err
+
+
+def test_restore_wildcard_indices(cluster, tmp_path):
+    client = cluster.client()
+    put_docs(cluster, client, "wa1", [{"t": "a", "n": 1}], shards=1)
+    put_docs(cluster, client, "wb1", [{"t": "b", "n": 2}], shards=1)
+    cluster.call(lambda done: client.put_repository(
+        "wr", {"type": "fs",
+               "settings": {"location": str(tmp_path / "wr")}}, done))
+    cluster.call(lambda done: client.create_snapshot(
+        "wr", "ws", {"indices": "wa1,wb1"}, done))
+    resp, err = cluster.call(lambda done: client.restore_snapshot(
+        "wr", "ws", {"indices": "wa*", "rename_pattern": "^w",
+                     "rename_replacement": "x"}, done),
+        max_time=120.0)
+    assert err is None, err
+    assert resp["indices"] == ["xa1"]
