@@ -22,17 +22,23 @@ CreateIndexFn = Callable[[str, Callable[[Optional[Exception]], None]], None]
 class TransportBulkAction:
     def __init__(self, shard_bulk: TransportShardBulkAction,
                  state_supplier: Callable[[], ClusterState],
-                 create_index: CreateIndexFn):
+                 create_index: CreateIndexFn,
+                 ingest_service=None):
         self.shard_bulk = shard_bulk
         self.state = state_supplier
         self.create_index = create_index
+        self.ingest = ingest_service
 
     def execute(self, items: List[Dict[str, Any]],
                 on_done: Callable[[Dict[str, Any]], None]) -> None:
-        """items: [{action, index, id, source?, routing?, if_seq_no?, ...}]"""
+        """items: [{action, index, id, source?, routing?, pipeline?,
+        if_seq_no?, ...}]"""
         state = self.state()
+        items = self._run_pipelines(state, items)
         missing = sorted({item["index"] for item in items
-                          if not state.metadata.has_index(item["index"])})
+                          if not item.get("_dropped")
+                          and "_ingest_error" not in item
+                          and not state.metadata.has_index(item["index"])})
         pending = {"n": len(missing)}
         if not missing:
             self._run(items, on_done)
@@ -47,12 +53,60 @@ class TransportBulkAction:
         for name in missing:
             self.create_index(name, created)
 
+    def _run_pipelines(self, state: ClusterState,
+                       items: List[Dict[str, Any]]
+                       ) -> List[Dict[str, Any]]:
+        """Transform items through ingest pipelines before routing
+        (IngestService.executeBulkRequest analog). Dropped items are
+        marked, not removed — responses stay positional."""
+        if self.ingest is None:
+            return items
+        out = []
+        for item in items:
+            pipeline = item.get("pipeline")
+            if pipeline is None and item.get("action") in ("index",
+                                                           "create"):
+                meta = (state.metadata.indices.get(item["index"])
+                        if item.get("index") else None)
+                if meta is not None:
+                    pipeline = meta.settings.get(
+                        "default_pipeline",
+                        meta.settings.get("index.default_pipeline"))
+            if not pipeline or pipeline == "_none" or \
+                    item.get("action") not in ("index", "create"):
+                out.append(item)
+                continue
+            try:
+                processed = self.ingest.process_item(pipeline, item)
+            except Exception as e:  # noqa: BLE001 — per-item failure
+                item = dict(item)
+                item["_ingest_error"] = e
+                out.append(item)
+                continue
+            if processed is None:
+                item = dict(item)
+                item["_dropped"] = True
+                out.append(item)
+            else:
+                out.append(processed)
+        return out
+
     def _run(self, items: List[Dict[str, Any]],
              on_done: Callable[[Dict[str, Any]], None]) -> None:
         state = self.state()
         groups: Dict[Tuple[str, int], List[Tuple[int, Dict[str, Any]]]] = {}
         responses: List[Optional[Dict[str, Any]]] = [None] * len(items)
         for pos, item in enumerate(items):
+            if item.get("_dropped"):
+                # ingest drop processor: acknowledged, never indexed
+                responses[pos] = {"action": item.get("action", "index"),
+                                  "_index": item.get("index"),
+                                  "id": item.get("id"),
+                                  "result": "noop", "status": 200}
+                continue
+            if "_ingest_error" in item:
+                responses[pos] = _item_error(item, item["_ingest_error"])
+                continue
             index = item["index"]
             try:
                 meta = state.metadata.index(index)
@@ -129,6 +183,8 @@ def parse_bulk_body(lines: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "id": meta.get("_id"),
             "routing": meta.get("routing"),
         }
+        if meta.get("pipeline") is not None:
+            item["pipeline"] = meta["pipeline"]
         if meta.get("if_seq_no") is not None:
             item["if_seq_no"] = meta["if_seq_no"]
         if meta.get("if_primary_term") is not None:

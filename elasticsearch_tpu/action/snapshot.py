@@ -30,10 +30,6 @@ RESTORE_SHARD = "cluster:admin/snapshot/restore[s]"
 DoneFn = Callable[[Optional[Dict[str, Any]], Optional[Exception]], None]
 
 
-class SnapshotInProgressError(SearchEngineError):
-    status = 503
-
-
 class SnapshotShardActions:
     """Data-node side: upload / download one shard's segments."""
 
@@ -227,9 +223,16 @@ class SnapshotActions:
                 # replicas are added AFTER the primaries hold the restored
                 # data, so peer recovery copies real segments — a replica
                 # recovered from a still-empty primary would stay empty
+                def replicas_set(_r, err3=None):
+                    if err3 is not None:
+                        on_done(None, SearchEngineError(
+                            f"restored [{target}] but failed to raise "
+                            f"replicas to {replicas}: {err3}"))
+                        return
+                    next_index()
                 self.node.client.update_settings(
                     target, {"number_of_replicas": replicas},
-                    lambda _r, _e=None: next_index())
+                    replicas_set)
             else:
                 next_index()
 

@@ -151,7 +151,10 @@ class Metadata:
                         version=self.version + 1)
 
     def with_persistent_settings(self, settings: Mapping[str, Any]) -> "Metadata":
+        # a None value unsets the key (the reference's null-reset semantics
+        # for PUT _cluster/settings)
         merged = {**self.persistent_settings, **settings}
+        merged = {k: v for k, v in merged.items() if v is not None}
         return Metadata(indices=self.indices, templates=self.templates,
                         persistent_settings=merged, version=self.version + 1)
 

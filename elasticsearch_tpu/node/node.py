@@ -38,7 +38,9 @@ from elasticsearch_tpu.transport.scheduler import Scheduler
 from elasticsearch_tpu.transport.transport import (
     InMemoryTransport, TransportService,
 )
-from elasticsearch_tpu.utils.errors import SearchEngineError
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError, SearchEngineError,
+)
 
 
 class Node:
@@ -82,11 +84,15 @@ class Node:
         self.master_client = MasterClient(self.transport_service,
                                           self.coordinator)
 
+        from elasticsearch_tpu.ingest import IngestService
+        self.ingest_service = IngestService(self._applied_state)
+
         self.shard_bulk = TransportShardBulkAction(
             node_id, self.indices_service, self.transport_service, scheduler,
             self._applied_state)
         self.bulk_action = TransportBulkAction(
-            self.shard_bulk, self._applied_state, self._auto_create_index)
+            self.shard_bulk, self._applied_state, self._auto_create_index,
+            ingest_service=self.ingest_service)
         self.get_action = TransportGetAction(
             node_id, self.indices_service, self.transport_service,
             self._applied_state)
@@ -222,10 +228,13 @@ class NodeClient:
                   on_done, routing: Optional[str] = None,
                   op_type: str = "index",
                   if_seq_no: Optional[int] = None,
-                  if_primary_term: Optional[int] = None) -> None:
+                  if_primary_term: Optional[int] = None,
+                  pipeline: Optional[str] = None) -> None:
         item = {"action": "create" if op_type == "create" else "index",
                 "index": index, "id": doc_id, "source": source,
                 "routing": routing}
+        if pipeline is not None:
+            item["pipeline"] = pipeline
         if if_seq_no is not None:
             item["if_seq_no"] = if_seq_no
         if if_primary_term is not None:
@@ -349,6 +358,86 @@ class NodeClient:
                      "indices": indices_out}, None)
         self.node.broadcast_actions.broadcast(STATS_SHARD, index_expression,
                                               cb, names=names)
+
+    # -- ingest pipelines ----------------------------------------------
+
+    def put_pipeline(self, pipeline_id: str, body: Dict[str, Any],
+                     on_done) -> None:
+        from elasticsearch_tpu.ingest import (
+            PIPELINE_SETTING_PREFIX, IngestService,
+        )
+        try:
+            IngestService.validate(body or {})
+        except Exception as e:
+            on_done(None, e)
+            return
+        self.cluster_update_settings(
+            {"persistent": {PIPELINE_SETTING_PREFIX + pipeline_id:
+                            body or {}}}, on_done)
+
+    def get_pipeline(self, pipeline_id: Optional[str] = None
+                     ) -> Dict[str, Any]:
+        from elasticsearch_tpu.utils.errors import ResourceNotFoundError
+        pipelines = self.node.ingest_service.list_pipelines()
+        if pipeline_id in (None, "*", "_all"):
+            return pipelines
+        if pipeline_id not in pipelines:
+            raise ResourceNotFoundError(
+                f"pipeline [{pipeline_id}] does not exist")
+        return {pipeline_id: pipelines[pipeline_id]}
+
+    def delete_pipeline(self, pipeline_id: str, on_done) -> None:
+        from elasticsearch_tpu.ingest import PIPELINE_SETTING_PREFIX
+        from elasticsearch_tpu.utils.errors import ResourceNotFoundError
+        if pipeline_id not in self.node.ingest_service.list_pipelines():
+            on_done(None, ResourceNotFoundError(
+                f"pipeline [{pipeline_id}] does not exist"))
+            return
+        self.cluster_update_settings(
+            {"persistent": {PIPELINE_SETTING_PREFIX + pipeline_id: None}},
+            on_done)
+
+    def simulate_pipeline(self, body: Dict[str, Any],
+                          pipeline_id: Optional[str] = None
+                          ) -> Dict[str, Any]:
+        """POST _ingest/pipeline/[{id}/]_simulate"""
+        service = self.node.ingest_service
+        if pipeline_id is None:
+            inline = (body or {}).get("pipeline")
+            if inline is None:
+                raise IllegalArgumentError(
+                    "simulate requires a [pipeline] definition or id")
+            procs = [service.compile_processor(p)
+                     for p in inline.get("processors", [])]
+
+            def run_pipeline(doc):
+                for p in procs:
+                    doc = p.run(doc)
+                    if doc is None:
+                        return None
+                return doc
+        else:
+            def run_pipeline(doc):
+                return service.execute_pipeline(pipeline_id, doc)
+        docs_out = []
+        for entry in (body or {}).get("docs", []):
+            doc = {"_source": dict(entry.get("_source") or {}),
+                   "_index": entry.get("_index", "_index"),
+                   "_id": entry.get("_id", "_id"),
+                   "_routing": entry.get("_routing")}
+            try:
+                result = run_pipeline(doc)
+                if result is None:
+                    docs_out.append({"doc": None})
+                else:
+                    docs_out.append({"doc": {
+                        "_index": result["_index"],
+                        "_id": result["_id"],
+                        "_source": result["_source"]}})
+            except Exception as e:  # noqa: BLE001 — per-doc result
+                docs_out.append({"error": {
+                    "type": type(e).__name__, "reason": str(e)}})
+        return {"docs": docs_out}
 
     # -- snapshots ------------------------------------------------------
 

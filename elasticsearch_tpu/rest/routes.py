@@ -63,7 +63,8 @@ def build_controller(client: NodeClient) -> RestController:
                          op_type=op_type,
                          if_seq_no=_int_param(req, "if_seq_no", None),
                          if_primary_term=_int_param(
-                             req, "if_primary_term", None))
+                             req, "if_primary_term", None),
+                         pipeline=req.query.get("pipeline"))
 
     def doc_create(req: RestRequest, done: DoneFn) -> None:
         req.query["op_type"] = "create"
@@ -135,9 +136,12 @@ def build_controller(client: NodeClient) -> RestController:
             if line:
                 lines.append(json.loads(line))
         items = parse_bulk_body(lines)
+        default_pipeline = req.query.get("pipeline")
         for item in items:
             if item["index"] is None:
                 item["index"] = default_index
+            if default_pipeline and "pipeline" not in item:
+                item["pipeline"] = default_pipeline
             if item["index"] is None:
                 raise IllegalArgumentError(
                     "explicit index in bulk is required")
@@ -324,6 +328,29 @@ def build_controller(client: NodeClient) -> RestController:
                            wrap_client_cb(done))
     r("GET", "/{index}/_stats", index_stats)
     r("GET", "/_stats", index_stats)
+
+    # -- ingest pipelines -------------------------------------------------
+
+    def pipeline_put(req: RestRequest, done: DoneFn) -> None:
+        client.put_pipeline(req.params["id"], req.body or {},
+                            wrap_client_cb(done))
+    r("PUT", "/_ingest/pipeline/{id}", pipeline_put)
+
+    def pipeline_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.get_pipeline(req.params.get("id")))
+    r("GET", "/_ingest/pipeline", pipeline_get)
+    r("GET", "/_ingest/pipeline/{id}", pipeline_get)
+
+    def pipeline_delete(req: RestRequest, done: DoneFn) -> None:
+        client.delete_pipeline(req.params["id"], wrap_client_cb(done))
+    r("DELETE", "/_ingest/pipeline/{id}", pipeline_delete)
+
+    def pipeline_simulate(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.simulate_pipeline(req.body or {},
+                                           req.params.get("id")))
+    r("POST", "/_ingest/pipeline/_simulate", pipeline_simulate)
+    r("GET", "/_ingest/pipeline/_simulate", pipeline_simulate)
+    r("POST", "/_ingest/pipeline/{id}/_simulate", pipeline_simulate)
 
     # -- snapshots --------------------------------------------------------
 

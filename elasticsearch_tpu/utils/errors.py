@@ -76,6 +76,10 @@ class MapperParsingError(SearchEngineError):
     status = 400
 
 
+class ResourceNotFoundError(SearchEngineError):
+    status = 404
+
+
 class IllegalArgumentError(SearchEngineError):
     status = 400
 

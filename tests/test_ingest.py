@@ -1,0 +1,220 @@
+"""Ingest pipeline tests: processors, conditionals, on_failure, registry,
+bulk integration, default_pipeline, simulate (ingest/IngestService +
+modules/ingest-common analogs)."""
+
+import pytest
+
+from elasticsearch_tpu.ingest import IngestService
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+class _FakeState:
+    def __init__(self, pipelines):
+        from types import SimpleNamespace
+        self.metadata = SimpleNamespace(persistent_settings={
+            f"pipeline.{k}": v for k, v in pipelines.items()})
+
+
+def run(pipelines, pipeline_id, source, **meta):
+    svc = IngestService(lambda: _FakeState(pipelines))
+    doc = {"_source": dict(source), "_index": meta.get("index", "i"),
+           "_id": meta.get("id", "1"), "_routing": meta.get("routing")}
+    return svc.execute_pipeline(pipeline_id, doc)
+
+
+def one(processors, source, **meta):
+    return run({"p": {"processors": processors}}, "p", source, **meta)
+
+
+def test_set_remove_rename_append():
+    out = one([
+        {"set": {"field": "a", "value": 1}},
+        {"set": {"field": "nested.b", "value": "{{a}}-x"}},
+        {"rename": {"field": "old", "target_field": "new"}},
+        {"remove": {"field": "gone"}},
+        {"append": {"field": "tags", "value": ["t2", "t3"]}},
+    ], {"old": 5, "gone": True, "tags": ["t1"]})
+    assert out["_source"] == {"a": 1, "nested": {"b": "1-x"}, "new": 5,
+                              "tags": ["t1", "t2", "t3"]}
+
+
+def test_convert_and_numeric_ops():
+    out = one([
+        {"convert": {"field": "n", "type": "integer"}},
+        {"convert": {"field": "f", "type": "float"}},
+        {"convert": {"field": "b", "type": "boolean"}},
+        {"convert": {"field": "auto", "type": "auto"}},
+        {"bytes": {"field": "size"}},
+    ], {"n": "42", "f": "2.5", "b": "TRUE", "auto": "3.14",
+        "size": "2kb"})
+    assert out["_source"] == {"n": 42, "f": 2.5, "b": True, "auto": 3.14,
+                              "size": 2048}
+
+
+def test_string_processors():
+    out = one([
+        {"lowercase": {"field": "a"}},
+        {"uppercase": {"field": "b"}},
+        {"trim": {"field": "c"}},
+        {"split": {"field": "d", "separator": ","}},
+        {"join": {"field": "e", "separator": "-"}},
+        {"gsub": {"field": "f", "pattern": "0+", "replacement": "0"}},
+        {"html_strip": {"field": "g"}},
+    ], {"a": "ABC", "b": "abc", "c": "  x  ", "d": "1,2,3",
+        "e": ["x", "y"], "f": "1000200", "g": "<b>hi</b> there"})
+    s = out["_source"]
+    assert s["a"] == "abc" and s["b"] == "ABC" and s["c"] == "x"
+    assert s["d"] == ["1", "2", "3"] and s["e"] == "x-y"
+    assert s["f"] == "1020" and s["g"] == "hi there"
+
+
+def test_date_processor():
+    out = one([{"date": {"field": "ts", "formats": ["ISO8601"]}}],
+              {"ts": "2024-03-05T12:30:00Z"})
+    assert out["_source"]["@timestamp"].startswith("2024-03-05T12:30:00")
+    out = one([{"date": {"field": "ts", "formats": ["UNIX"],
+                         "target_field": "when"}}], {"ts": 1700000000})
+    assert out["_source"]["when"].startswith("2023-11-14")
+
+
+def test_json_kv():
+    out = one([
+        {"json": {"field": "payload"}},
+        {"kv": {"field": "qs", "field_split": "&", "value_split": "="}},
+    ], {"payload": '{"x": 1}', "qs": "a=1&b=two"})
+    assert out["_source"]["payload"] == {"x": 1}
+    assert out["_source"]["a"] == "1" and out["_source"]["b"] == "two"
+
+
+def test_dissect():
+    out = one([{"dissect": {
+        "field": "msg",
+        "pattern": "%{client} - %{verb} %{path} took %{ms}ms"}}],
+        {"msg": "1.2.3.4 - GET /index.html took 42ms"})
+    s = out["_source"]
+    assert s["client"] == "1.2.3.4" and s["verb"] == "GET"
+    assert s["path"] == "/index.html" and s["ms"] == "42"
+
+
+def test_grok():
+    out = one([{"grok": {
+        "field": "line",
+        "patterns": ["%{IP:client} %{WORD:method} %{URIPATH:path} "
+                     "%{NUMBER:bytes} %{LOGLEVEL:level}"]}}],
+        {"line": "10.0.0.1 POST /api/v1/thing 512 ERROR"})
+    s = out["_source"]
+    assert s == {"line": "10.0.0.1 POST /api/v1/thing 512 ERROR",
+                 "client": "10.0.0.1", "method": "POST",
+                 "path": "/api/v1/thing", "bytes": "512",
+                 "level": "ERROR"}
+
+
+def test_script_drop_fail():
+    out = one([{"script": {"source":
+                           "ctx._source.total = ctx._source.a + 1"}}],
+              {"a": 2})
+    assert out["_source"]["total"] == 3
+    assert one([{"drop": {}}], {"a": 1}) is None
+    with pytest.raises(Exception) as ei:
+        one([{"fail": {"message": "bad doc {{a}}"}}], {"a": 9})
+    assert "bad doc 9" in str(ei.value)
+
+
+def test_conditional_and_on_failure():
+    out = one([
+        {"set": {"field": "big", "value": True,
+                 "if": "ctx._source.n > 10"}},
+    ], {"n": 5})
+    assert "big" not in out["_source"]
+    out = one([
+        {"set": {"field": "big", "value": True,
+                 "if": "ctx._source.n > 10"}},
+    ], {"n": 50})
+    assert out["_source"]["big"] is True
+
+    out = one([
+        {"convert": {"field": "n", "type": "integer",
+                     "on_failure": [{"set": {"field": "bad",
+                                             "value": True}}]}},
+    ], {"n": "not-a-number"})
+    assert out["_source"]["bad"] is True
+
+    out = one([
+        {"remove": {"field": "missing", "ignore_failure": True}},
+        {"set": {"field": "ok", "value": 1}},
+    ], {})
+    assert out["_source"]["ok"] == 1
+
+
+def test_pipeline_processor_and_unknown_type():
+    out = run({
+        "outer": {"processors": [
+            {"set": {"field": "o", "value": 1}},
+            {"pipeline": {"name": "inner"}}]},
+        "inner": {"processors": [{"set": {"field": "i", "value": 2}}]},
+    }, "outer", {})
+    assert out["_source"] == {"o": 1, "i": 2}
+    with pytest.raises(IllegalArgumentError):
+        IngestService.validate({"processors": [{"nope": {}}]})
+
+
+def test_bulk_integration_and_default_pipeline():
+    from elasticsearch_tpu.testing import InProcessCluster
+    c = InProcessCluster(n_nodes=2, seed=41)
+    c.start()
+    try:
+        client = c.client()
+        resp, err = c.call(lambda done: client.put_pipeline("enrich", {
+            "processors": [
+                {"set": {"field": "seen", "value": True}},
+                {"drop": {"if": "ctx._source.skip == True"}},
+            ]}, done))
+        assert err is None, err
+        c.call(lambda done: client.create_index("logs", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0,
+                         "default_pipeline": "enrich"},
+            "mappings": {"properties": {"m": {"type": "text"}}}}, done))
+        c.ensure_green("logs")
+        items = [
+            {"action": "index", "index": "logs", "id": "1",
+             "source": {"m": "keep me"}},
+            {"action": "index", "index": "logs", "id": "2",
+             "source": {"m": "drop me", "skip": True}},
+        ]
+        resp, err = c.call(lambda done: client.bulk(items, done))
+        assert err is None and not resp.get("errors"), resp
+        assert resp["items"][1]["index"]["result"] == "noop"
+        c.call(lambda done: client.refresh("logs", done))
+        resp, err = c.call(lambda done: client.search(
+            "logs", {"query": {"match_all": {}}}, done))
+        assert resp["hits"]["total"]["value"] == 1
+        hit = resp["hits"]["hits"][0]
+        assert hit["_id"] == "1" and hit["_source"]["seen"] is True
+
+        # registry CRUD
+        assert "enrich" in client.get_pipeline()
+        resp, err = c.call(lambda done: client.delete_pipeline(
+            "enrich", done))
+        assert err is None
+        with pytest.raises(Exception):
+            client.get_pipeline("enrich")
+    finally:
+        c.stop()
+
+
+def test_simulate():
+    from elasticsearch_tpu.testing import InProcessCluster
+    c = InProcessCluster(n_nodes=1, seed=43)
+    c.start()
+    try:
+        client = c.client()
+        out = client.simulate_pipeline({
+            "pipeline": {"processors": [
+                {"uppercase": {"field": "w"}}]},
+            "docs": [{"_source": {"w": "hello"}},
+                     {"_source": {"x": 1}}],
+        })
+        assert out["docs"][0]["doc"]["_source"]["w"] == "HELLO"
+        assert "error" in out["docs"][1]
+    finally:
+        c.stop()
