@@ -44,10 +44,11 @@ class SearchTransportService:
     """Data-node side: executes the per-shard search phases."""
 
     def __init__(self, node_id: str, indices: IndicesService,
-                 ts: TransportService):
+                 ts: TransportService, task_manager=None):
         self.node_id = node_id
         self.indices = indices
         self.ts = ts
+        self.task_manager = task_manager
         self._contexts: Dict[str, Tuple[Reader, float]] = {}
         ts.register_handler(SEARCH_CAN_MATCH, self._on_can_match)
         ts.register_handler(SEARCH_DFS, self._on_dfs)
@@ -106,15 +107,28 @@ class SearchTransportService:
             )
             aggregator = ShardAggregator(parse_aggs(agg_body))
 
-        result = query_shard(
-            reader, shard.engine.mappers, query,
-            size=req["window"], from_=0, sort=sort,
-            search_after=body.get("search_after"),
-            track_total_hits=body.get("track_total_hits", 10_000),
-            min_score=body.get("min_score"),
-            doc_count_override=req.get("doc_count_override"),
-            df_overrides=req.get("df_overrides"),
-            collectors=[aggregator] if aggregator else None)
+        shard_task = None
+        if self.task_manager is not None:
+            shard_task = self.task_manager.register(
+                "indices:data/read/search[phase/query]",
+                f"shard query [{req['index']}][{req['shard']}]",
+                cancellable=True,
+                parent_task_id=req.get("task_id"))
+        try:
+            result = query_shard(
+                reader, shard.engine.mappers, query,
+                size=req["window"], from_=0, sort=sort,
+                search_after=body.get("search_after"),
+                track_total_hits=body.get("track_total_hits", 10_000),
+                min_score=body.get("min_score"),
+                doc_count_override=req.get("doc_count_override"),
+                df_overrides=req.get("df_overrides"),
+                collectors=[aggregator] if aggregator else None,
+                cancel_check=(shard_task.ensure_not_cancelled
+                              if shard_task else None))
+        finally:
+            if shard_task is not None:
+                self.task_manager.unregister(shard_task)
         context_id = None
         if req["window"] > 0:
             # size=0 (count) searches never fetch: don't pin a reader
@@ -180,10 +194,12 @@ class TransportSearchAction:
     merge → fetch → respond."""
 
     def __init__(self, node_id: str, ts: TransportService,
-                 state_supplier: Callable[[], ClusterState]):
+                 state_supplier: Callable[[], ClusterState],
+                 task_manager=None):
         self.node_id = node_id
         self.ts = ts
         self.state = state_supplier
+        self.task_manager = task_manager
         self._rr = 0
 
     # ------------------------------------------------------------------
@@ -232,6 +248,18 @@ class TransportSearchAction:
         t0 = time.monotonic()
         state = self.state()
         body = body or {}
+
+        task = None
+        if self.task_manager is not None:
+            task = self.task_manager.register(
+                "indices:data/read/search",
+                f"search [{index_expression}]", cancellable=True)
+            inner = on_done
+
+            def on_done(resp, err):   # noqa: F811 — task-scoped wrapper
+                self.task_manager.unregister(task)
+                inner(resp, err)
+
         try:
             indices = self._resolve_indices(index_expression, state)
             targets = self._shard_targets(indices, state)
@@ -249,6 +277,7 @@ class TransportSearchAction:
         phase_state = {
             "skipped": 0, "failed": 0,
             "failures": [],
+            "task_id": task.task_id if task is not None else None,
         }
 
         def after_can_match(live_targets: List[Dict[str, Any]]) -> None:
@@ -343,13 +372,25 @@ class TransportSearchAction:
         def one(i: int, target, copy_idx: int = 0) -> None:
             req = {"index": target["index"], "shard": target["shard"],
                    "body": body, "window": window}
+            if phase_state.get("task_id"):
+                req["task_id"] = phase_state["task_id"]
             if dfs_overrides:
                 req.update(dfs_overrides)
             copies = target.get("copies", [target["node"]])
             node = copies[copy_idx]
 
             def cb(resp, err):
+                if phase_state.get("aborted"):
+                    return
                 if err is not None:
+                    # a cancelled task must abort the whole search, not
+                    # fail over to replicas (cancellation is not a fault)
+                    if getattr(err, "cause_type", "") == \
+                            "TaskCancelledError" or \
+                            type(err).__name__ == "TaskCancelledError":
+                        phase_state["aborted"] = True
+                        on_done(None, err)
+                        return
                     if copy_idx + 1 < len(copies):
                         # fail over to the next copy of this shard
                         one(i, target, copy_idx + 1)

@@ -87,6 +87,11 @@ class Node:
         from elasticsearch_tpu.ingest import IngestService
         self.ingest_service = IngestService(self._applied_state)
 
+        from elasticsearch_tpu.tasks import TaskManager
+        self.task_manager = TaskManager(
+            node_id, now_ms=lambda: scheduler.now() * 1000)
+        self.task_results: Dict[str, Any] = {}
+
         self.shard_bulk = TransportShardBulkAction(
             node_id, self.indices_service, self.transport_service, scheduler,
             self._applied_state)
@@ -99,9 +104,11 @@ class Node:
         self.update_action = TransportUpdateAction(self.get_action,
                                                    self.bulk_action)
         self.search_transport = SearchTransportService(
-            node_id, self.indices_service, self.transport_service)
+            node_id, self.indices_service, self.transport_service,
+            task_manager=self.task_manager)
         self.search_action = TransportSearchAction(
-            node_id, self.transport_service, self._applied_state)
+            node_id, self.transport_service, self._applied_state,
+            task_manager=self.task_manager)
         self.broadcast_actions = BroadcastActions(
             node_id, self.indices_service, self.transport_service,
             self._applied_state)
@@ -112,6 +119,12 @@ class Node:
         self.snapshot_shard_actions = SnapshotShardActions(
             self.indices_service, self.transport_service)
         self.snapshot_actions = SnapshotActions(self)
+
+        from elasticsearch_tpu.tasks import TaskActions
+        self.task_actions = TaskActions(self)
+
+        from elasticsearch_tpu.action.reindex import ReindexActions
+        self.reindex_actions = ReindexActions(self)
 
         self.client = NodeClient(self)
 
@@ -358,6 +371,39 @@ class NodeClient:
                      "indices": indices_out}, None)
         self.node.broadcast_actions.broadcast(STATS_SHARD, index_expression,
                                               cb, names=names)
+
+    # -- reindex family -------------------------------------------------
+
+    def reindex(self, body: Dict[str, Any], on_done,
+                wait_for_completion: bool = True) -> None:
+        self.node.reindex_actions.reindex(
+            body, on_done, wait_for_completion=wait_for_completion)
+
+    def update_by_query(self, index: str, body: Dict[str, Any], on_done,
+                        wait_for_completion: bool = True) -> None:
+        self.node.reindex_actions.update_by_query(
+            index, body, on_done,
+            wait_for_completion=wait_for_completion)
+
+    def delete_by_query(self, index: str, body: Dict[str, Any], on_done,
+                        wait_for_completion: bool = True) -> None:
+        self.node.reindex_actions.delete_by_query(
+            index, body, on_done,
+            wait_for_completion=wait_for_completion)
+
+    # -- tasks ----------------------------------------------------------
+
+    def list_tasks(self, on_done, actions: Optional[str] = None) -> None:
+        self.node.task_actions.list_tasks(on_done, actions=actions)
+
+    def get_task(self, task_id: str, on_done) -> None:
+        """Resolved on the task's owning node (cross-node by id prefix)."""
+        self.node.task_actions.get_task(task_id, on_done)
+
+    def cancel_tasks(self, on_done, task_id: Optional[str] = None,
+                     actions: Optional[str] = None) -> None:
+        self.node.task_actions.cancel_tasks(on_done, task_id=task_id,
+                                            actions=actions)
 
     # -- ingest pipelines ----------------------------------------------
 

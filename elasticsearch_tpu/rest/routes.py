@@ -329,6 +329,44 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/{index}/_stats", index_stats)
     r("GET", "/_stats", index_stats)
 
+    # -- reindex family ---------------------------------------------------
+
+    def reindex(req: RestRequest, done: DoneFn) -> None:
+        client.reindex(req.body or {}, wrap_client_cb(done),
+                       wait_for_completion=req.flag(
+                           "wait_for_completion", True))
+    r("POST", "/_reindex", reindex)
+
+    def update_by_query(req: RestRequest, done: DoneFn) -> None:
+        client.update_by_query(
+            req.params["index"], req.body or {}, wrap_client_cb(done),
+            wait_for_completion=req.flag("wait_for_completion", True))
+    r("POST", "/{index}/_update_by_query", update_by_query)
+
+    def delete_by_query(req: RestRequest, done: DoneFn) -> None:
+        client.delete_by_query(
+            req.params["index"], req.body or {}, wrap_client_cb(done),
+            wait_for_completion=req.flag("wait_for_completion", True))
+    r("POST", "/{index}/_delete_by_query", delete_by_query)
+
+    # -- tasks ------------------------------------------------------------
+
+    def tasks_list(req: RestRequest, done: DoneFn) -> None:
+        client.list_tasks(wrap_client_cb(done),
+                          actions=req.query.get("actions"))
+    r("GET", "/_tasks", tasks_list)
+
+    def task_get(req: RestRequest, done: DoneFn) -> None:
+        client.get_task(req.params["task_id"], wrap_client_cb(done))
+    r("GET", "/_tasks/{task_id}", task_get)
+
+    def tasks_cancel(req: RestRequest, done: DoneFn) -> None:
+        client.cancel_tasks(wrap_client_cb(done),
+                            task_id=req.params.get("task_id"),
+                            actions=req.query.get("actions"))
+    r("POST", "/_tasks/_cancel", tasks_cancel)
+    r("POST", "/_tasks/{task_id}/_cancel", tasks_cancel)
+
     # -- ingest pipelines -------------------------------------------------
 
     def pipeline_put(req: RestRequest, done: DoneFn) -> None:

@@ -21,7 +21,7 @@ from __future__ import annotations
 import ast
 import math
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.utils.errors import SearchEngineError
 
@@ -602,6 +602,24 @@ def execute_update_script(source: Dict[str, Any],
     if ctx.get("op") == "none" or ctx.get("op") == "noop":
         return source
     return ctx["_source"]
+
+
+def execute_op_script(source: Dict[str, Any], script: Any
+                      ) -> Tuple[str, Dict[str, Any]]:
+    """Update-context script returning the op verdict explicitly:
+    ('index' | 'noop' | 'delete', new_source). Reindex and
+    update-by-query need the tri-state (the reference's
+    AbstractAsyncBulkByScrollAction op switch)."""
+    spec = _normalize(script)
+    ctx = {"_source": source, "op": "index"}
+    variables = {"ctx": ctx, "params": spec.get("params", {})}
+    default_engine.execute(spec["source"], variables)
+    op = ctx.get("op", "index")
+    if op in ("none", "noop"):
+        op = "noop"
+    elif op != "delete":
+        op = "index"
+    return op, ctx["_source"]
 
 
 def execute_field_script(script: Any, doc: Dict[str, Any],

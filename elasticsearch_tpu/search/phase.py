@@ -157,8 +157,13 @@ def query_shard(reader: Reader,
                 min_score: Optional[float] = None,
                 doc_count_override: Optional[int] = None,
                 df_overrides: Optional[Dict[str, Dict[str, int]]] = None,
-                collectors: Optional[List] = None) -> ShardQueryResult:
+                collectors: Optional[List] = None,
+                cancel_check: Optional[Any] = None) -> ShardQueryResult:
     """Execute one query over all segments of a shard snapshot.
+
+    ``cancel_check``: zero-arg callable raising TaskCancelledError —
+    invoked between segments (the reference checks inside the Lucene
+    collection loop, search/query/QueryPhase.java:115).
 
     ``collectors``: optional aggregation collectors, each called with
     (ctx, segment_idx, scores, mask) per segment (two-level agg model).
@@ -202,6 +207,8 @@ def query_shard(reader: Reader,
     query = rewrite_knn(query, ctxs)
 
     for si, ctx in enumerate(ctxs):
+        if cancel_check is not None:
+            cancel_check()
         seg = ctx.segment
         scores, mask = execute(query, ctx)
         if min_score is not None:
