@@ -133,6 +133,13 @@ class ReindexActions:
             on_done(None, IllegalArgumentError(
                 "reindex requires source.index and dest.index"))
             return None
+        if src_index == dst_index:
+            # writing into the index being paged breaks the
+            # never-self-mutated-source invariant from/size relies on
+            on_done(None, IllegalArgumentError(
+                "reindex cannot write into an index it is reading from "
+                f"[{src_index}]"))
+            return None
         query = source.get("query", {"match_all": {}})
         batch = int(source.get("size", DEFAULT_BATCH))
         max_docs = body.get("max_docs")

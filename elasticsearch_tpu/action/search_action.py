@@ -144,6 +144,9 @@ class SearchTransportService:
                       "score": d.score, "sort": list(d.sort_values)}
                      for d in result.docs],
             "aggs_partial": aggregator.partial() if aggregator else None,
+            "suggest_partial": (
+                _suggest_partial(reader, shard.engine.mappers, body)
+                if body.get("suggest") else None),
         }
 
     def _on_fetch(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
@@ -311,7 +314,7 @@ class TransportSearchAction:
             except SearchEngineError:
                 has_terms = False
         if len(targets) <= 1 or not has_terms or \
-                _aggs_must_visit_all(body):
+                _must_visit_all_shards(body):
             next_phase(targets)
             return
         live: List[Dict[str, Any]] = []
@@ -518,6 +521,11 @@ class TransportSearchAction:
                         if r is not None]
             resp["aggregations"] = reduce_aggs(parse_aggs(agg_body),
                                                partials)
+        if body.get("suggest"):
+            from elasticsearch_tpu.search.suggest import merge_suggestions
+            resp["suggest"] = merge_suggestions(
+                [r.get("suggest_partial") for r in (results or [])
+                 if r is not None])
         if phase_state["failures"]:
             resp["_shards"]["failures"] = phase_state["failures"]
         return resp
@@ -533,10 +541,14 @@ class TransportSearchAction:
         }
 
 
-def _aggs_must_visit_all(body: Dict[str, Any]) -> bool:
-    """A ``global`` agg anywhere in the tree must see every live doc, so
-    can_match shard skipping would silently drop its counts (the reference
-    disables the match-none skip when an agg mustVisitAllDocs)."""
+def _must_visit_all_shards(body: Dict[str, Any]) -> bool:
+    """A ``global`` agg anywhere in the tree must see every live doc, and
+    suggesters read term dictionaries unrelated to the query — in both
+    cases can_match shard skipping would silently drop results (the
+    reference disables the match-none skip for mustVisitAllDocs aggs and
+    suggest-bearing requests)."""
+    if body.get("suggest"):
+        return True
     agg_body = body.get("aggs", body.get("aggregations"))
     if not agg_body:
         return False
@@ -553,3 +565,8 @@ def _aggs_must_visit_all(body: Dict[str, Any]) -> bool:
                 return True
         return False
     return walk(agg_body)
+
+
+def _suggest_partial(reader, mappers, body):
+    from elasticsearch_tpu.search.suggest import build_suggestions
+    return build_suggestions(reader, mappers, body["suggest"])

@@ -331,9 +331,30 @@ class GeoPointFieldMapper(FieldMapper):
         return ParsedField(self.name, "geo", geo=(lat, lon))
 
 
+class CompletionFieldMapper(FieldMapper):
+    """Auto-complete inputs (reference: index/mapper/CompletionFieldMapper).
+
+    The reference builds an FST; here inputs live in the keyword term
+    dictionary and suggest does a prefix scan over it (search/suggest.py).
+    Option scoring uses document frequency (per-doc weights are accepted
+    in the input shape but not yet ranked on)."""
+
+    type_name = "completion"
+    has_doc_values = True
+
+    def parse(self, value: Any) -> ParsedField:
+        inputs = value.get("input", []) if isinstance(value, dict) \
+            else value
+        if not isinstance(inputs, list):
+            inputs = [inputs]
+        return ParsedField(self.name, "terms",
+                           exact_terms=[str(v) for v in inputs])
+
+
 _MAPPER_TYPES = {
     "text": TextFieldMapper,
     "keyword": KeywordFieldMapper,
+    "completion": CompletionFieldMapper,
     "boolean": BooleanFieldMapper,
     "date": DateFieldMapper,
     "dense_vector": DenseVectorFieldMapper,

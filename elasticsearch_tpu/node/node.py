@@ -126,6 +126,12 @@ class Node:
         from elasticsearch_tpu.action.reindex import ReindexActions
         self.reindex_actions = ReindexActions(self)
 
+        from elasticsearch_tpu.action.misc import MiscReadActions
+        self.misc_actions = MiscReadActions(self)
+
+        from elasticsearch_tpu.rankeval import RankEvalAction
+        self.rank_eval_action = RankEvalAction(self)
+
         self.client = NodeClient(self)
 
     # ------------------------------------------------------------------
@@ -371,6 +377,77 @@ class NodeClient:
                      "indices": indices_out}, None)
         self.node.broadcast_actions.broadcast(STATS_SHARD, index_expression,
                                               cb, names=names)
+
+    # -- misc read APIs -------------------------------------------------
+
+    def mget(self, body: Dict[str, Any], on_done,
+             index: Optional[str] = None) -> None:
+        self.node.misc_actions.mget(body, index, on_done)
+
+    def termvectors(self, index: str, doc_id: str, on_done,
+                    fields: Optional[List[str]] = None,
+                    routing: Optional[str] = None) -> None:
+        self.node.misc_actions.termvectors(index, doc_id, on_done,
+                                           fields=fields, routing=routing)
+
+    def explain(self, index: str, doc_id: str, body: Dict[str, Any],
+                on_done, routing: Optional[str] = None) -> None:
+        self.node.misc_actions.explain(index, doc_id, body, on_done,
+                                       routing=routing)
+
+    def field_caps(self, index_expression: str,
+                   fields: Optional[str] = None) -> Dict[str, Any]:
+        return self.node.misc_actions.field_caps(index_expression, fields)
+
+    def analyze(self, body: Dict[str, Any],
+                index: Optional[str] = None) -> Dict[str, Any]:
+        return self.node.misc_actions.analyze(body, index=index)
+
+    def rank_eval(self, index: str, body: Dict[str, Any], on_done) -> None:
+        self.node.rank_eval_action.execute(index, body, on_done)
+
+    # -- stored scripts / search templates ------------------------------
+
+    def put_stored_script(self, script_id: str, body: Dict[str, Any],
+                          on_done) -> None:
+        from elasticsearch_tpu.script.mustache import STORED_SCRIPT_PREFIX
+        script = (body or {}).get("script", body or {})
+        self.cluster_update_settings(
+            {"persistent": {STORED_SCRIPT_PREFIX + script_id: script}},
+            on_done)
+
+    def get_stored_script(self, script_id: str) -> Optional[Dict[str, Any]]:
+        from elasticsearch_tpu.script.mustache import STORED_SCRIPT_PREFIX
+        state = self.node._applied_state()
+        return state.metadata.persistent_settings.get(
+            STORED_SCRIPT_PREFIX + script_id)
+
+    def delete_stored_script(self, script_id: str, on_done) -> None:
+        from elasticsearch_tpu.script.mustache import STORED_SCRIPT_PREFIX
+        from elasticsearch_tpu.utils.errors import ResourceNotFoundError
+        if self.get_stored_script(script_id) is None:
+            on_done(None, ResourceNotFoundError(
+                f"stored script [{script_id}] does not exist"))
+            return
+        self.cluster_update_settings(
+            {"persistent": {STORED_SCRIPT_PREFIX + script_id: None}},
+            on_done)
+
+    def search_template(self, index_expression: str,
+                        template: Dict[str, Any], on_done) -> None:
+        from elasticsearch_tpu.script.mustache import render_search_body
+        try:
+            body = render_search_body(template or {},
+                                      self.get_stored_script)
+        except Exception as e:
+            on_done(None, e)
+            return
+        self.search(index_expression, body, on_done)
+
+    def render_template(self, template: Dict[str, Any]) -> Dict[str, Any]:
+        from elasticsearch_tpu.script.mustache import render_search_body
+        return {"template_output": render_search_body(
+            template or {}, self.get_stored_script)}
 
     # -- reindex family -------------------------------------------------
 

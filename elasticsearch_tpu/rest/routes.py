@@ -329,6 +329,104 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/{index}/_stats", index_stats)
     r("GET", "/_stats", index_stats)
 
+    # -- misc read APIs ---------------------------------------------------
+
+    def mget(req: RestRequest, done: DoneFn) -> None:
+        client.mget(req.body or {}, wrap_client_cb(done),
+                    index=req.params.get("index"))
+    r("POST", "/_mget", mget)
+    r("GET", "/_mget", mget)
+    r("POST", "/{index}/_mget", mget)
+    r("GET", "/{index}/_mget", mget)
+
+    def termvectors(req: RestRequest, done: DoneFn) -> None:
+        fields = req.query.get("fields")
+        client.termvectors(
+            req.params["index"], req.params["id"], wrap_client_cb(done),
+            fields=fields.split(",") if fields else
+            (req.body or {}).get("fields"),
+            routing=req.query.get("routing"))
+    r("GET", "/{index}/_termvectors/{id}", termvectors)
+    r("POST", "/{index}/_termvectors/{id}", termvectors)
+
+    def explain(req: RestRequest, done: DoneFn) -> None:
+        body = dict(req.body or {})
+        q = req.query.get("q")
+        if q:
+            body["query"] = _uri_query(q)
+        client.explain(req.params["index"], req.params["id"], body,
+                       wrap_client_cb(done),
+                       routing=req.query.get("routing"))
+    r("GET", "/{index}/_explain/{id}", explain)
+    r("POST", "/{index}/_explain/{id}", explain)
+
+    def field_caps(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.field_caps(req.params.get("index", "_all"),
+                                    req.query.get("fields")))
+    r("GET", "/_field_caps", field_caps)
+    r("POST", "/_field_caps", field_caps)
+    r("GET", "/{index}/_field_caps", field_caps)
+    r("POST", "/{index}/_field_caps", field_caps)
+
+    def analyze(req: RestRequest, done: DoneFn) -> None:
+        body = dict(req.body or {})
+        for key in ("text", "analyzer", "field"):
+            if key in req.query and key not in body:
+                body[key] = req.query[key]
+        done(200, client.analyze(body, index=req.params.get("index")))
+    r("GET", "/_analyze", analyze)
+    r("POST", "/_analyze", analyze)
+    r("GET", "/{index}/_analyze", analyze)
+    r("POST", "/{index}/_analyze", analyze)
+
+    def rank_eval(req: RestRequest, done: DoneFn) -> None:
+        client.rank_eval(req.params.get("index", "_all"),
+                         req.body or {}, wrap_client_cb(done))
+    r("GET", "/{index}/_rank_eval", rank_eval)
+    r("POST", "/{index}/_rank_eval", rank_eval)
+    r("GET", "/_rank_eval", rank_eval)
+    r("POST", "/_rank_eval", rank_eval)
+
+    # -- stored scripts / templates ---------------------------------------
+
+    def script_put(req: RestRequest, done: DoneFn) -> None:
+        client.put_stored_script(req.params["id"], req.body or {},
+                                 wrap_client_cb(done))
+    r("PUT", "/_scripts/{id}", script_put)
+    r("POST", "/_scripts/{id}", script_put)
+
+    def script_get(req: RestRequest, done: DoneFn) -> None:
+        script = client.get_stored_script(req.params["id"])
+        if script is None:
+            done(404, {"_id": req.params["id"], "found": False})
+        else:
+            done(200, {"_id": req.params["id"], "found": True,
+                       "script": script})
+    r("GET", "/_scripts/{id}", script_get)
+
+    def script_delete(req: RestRequest, done: DoneFn) -> None:
+        client.delete_stored_script(req.params["id"],
+                                    wrap_client_cb(done))
+    r("DELETE", "/_scripts/{id}", script_delete)
+
+    def search_template(req: RestRequest, done: DoneFn) -> None:
+        client.search_template(req.params.get("index", "_all"),
+                               req.body or {}, wrap_client_cb(done))
+    r("GET", "/_search/template", search_template)
+    r("POST", "/_search/template", search_template)
+    r("GET", "/{index}/_search/template", search_template)
+    r("POST", "/{index}/_search/template", search_template)
+
+    def render_template(req: RestRequest, done: DoneFn) -> None:
+        body = dict(req.body or {})
+        if req.params.get("id") and "id" not in body:
+            body["id"] = req.params["id"]
+        done(200, client.render_template(body))
+    r("GET", "/_render/template", render_template)
+    r("POST", "/_render/template", render_template)
+    r("GET", "/_render/template/{id}", render_template)
+    r("POST", "/_render/template/{id}", render_template)
+
     # -- reindex family ---------------------------------------------------
 
     def reindex(req: RestRequest, done: DoneFn) -> None:

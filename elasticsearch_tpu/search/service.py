@@ -113,6 +113,13 @@ class SearchService:
             response["aggregations"] = reduce_aggs(
                 agg_specs, [aggregator.partial()])
 
+        if body.get("suggest"):
+            from elasticsearch_tpu.search.suggest import (
+                build_suggestions, merge_suggestions,
+            )
+            response["suggest"] = merge_suggestions([build_suggestions(
+                reader, self.engine.mappers, body["suggest"])])
+
         if scroll_keep_alive:
             scroll_id = uuid.uuid4().hex
             self._scrolls[scroll_id] = ScrollContext(

@@ -1,0 +1,255 @@
+"""Suggesters: term (spell correction), phrase, completion.
+
+Reference analog: search/suggest/ — TermSuggester (per-token candidates
+from the term dictionary within an edit-distance budget, scored by doc
+frequency), PhraseSuggester (whole-phrase candidates from per-token
+corrections), CompletionSuggester (FST prefix lookup; here a scan over the
+sorted keyword term dictionary). Suggestions are built per shard and
+merged at the coordinator (same two-level shape as aggregations).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+def _levenshtein_within(a: str, b: str, k: int) -> bool:
+    from elasticsearch_tpu.search.execute import _levenshtein_within as lv
+    return lv(a, b, k)
+
+
+def _field_terms_with_df(reader, field: str) -> Dict[str, int]:
+    """term -> total doc freq across segments (postings or keywords)."""
+    out: Dict[str, int] = {}
+    for seg in reader.segments:
+        pf = seg.postings.get(field)
+        if pf is not None:
+            for term, tid in pf.terms.items():
+                out[term] = out.get(term, 0) + int(pf.doc_freq[tid])
+            continue
+        kf = seg.keywords.get(field)
+        if kf is not None:
+            for tid, term in enumerate(kf.term_list):
+                out[term] = out.get(term, 0) + int(kf.doc_freq[tid])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shard-side
+# ---------------------------------------------------------------------------
+
+def build_suggestions(reader, mappers, suggest_body: Dict[str, Any]
+                      ) -> Dict[str, Any]:
+    """Per-shard suggestion partials for every named suggester."""
+    out: Dict[str, Any] = {}
+    global_text = suggest_body.get("text")
+    for name, spec in suggest_body.items():
+        if name == "text":
+            continue
+        if not isinstance(spec, dict):
+            raise IllegalArgumentError(f"bad suggester [{name}]")
+        text = spec.get("text", global_text)
+        if "term" in spec:
+            out[name] = _term_suggest(reader, mappers, text,
+                                      spec["term"])
+        elif "phrase" in spec:
+            out[name] = _phrase_suggest(reader, mappers, text,
+                                        spec["phrase"])
+        elif "completion" in spec:
+            # prefix lives at the suggester level (like `text`)
+            out[name] = _completion_suggest(
+                reader, spec.get("prefix", text), spec["completion"])
+        else:
+            raise IllegalArgumentError(
+                f"suggester [{name}] requires term, phrase or completion")
+    return out
+
+
+def _analyzed_tokens(mappers, field: str, text: str) -> List[str]:
+    mapper = mappers.mapper(field)
+    analyzer = getattr(mapper, "search_analyzer", None)
+    if analyzer is None:
+        from elasticsearch_tpu.analysis import STANDARD
+        analyzer = STANDARD
+    return [t.term for t in analyzer.analyze(text)]
+
+
+def _term_candidates(terms_df: Dict[str, int], token: str,
+                     max_edits: int, max_terms: int
+                     ) -> List[Tuple[str, int, int]]:
+    """[(term, df, distance)] within the edit budget, best first."""
+    cands = []
+    for term, df in terms_df.items():
+        if term == token:
+            continue
+        if abs(len(term) - len(token)) > max_edits:
+            continue
+        for d in range(1, max_edits + 1):
+            if _levenshtein_within(token, term, d):
+                cands.append((term, df, d))
+                break
+    cands.sort(key=lambda c: (c[2], -c[1], c[0]))
+    return cands[:max_terms]
+
+
+def _term_suggest(reader, mappers, text: Optional[str],
+                  spec: Dict[str, Any]) -> Dict[str, Any]:
+    field = spec.get("field")
+    if field is None or text is None:
+        raise IllegalArgumentError(
+            "term suggester requires [field] and [text]")
+    max_edits = int(spec.get("max_edits", 2))
+    size = int(spec.get("size", 5))
+    suggest_mode = spec.get("suggest_mode", "missing")
+    terms_df = _field_terms_with_df(reader, field)
+    entries = []
+    offset = 0
+    for token in _analyzed_tokens(mappers, field, text):
+        df = terms_df.get(token, 0)
+        options: List[Dict[str, Any]] = []
+        if suggest_mode == "always" or df == 0 or \
+                suggest_mode == "popular":
+            for term, cdf, dist in _term_candidates(terms_df, token,
+                                                    max_edits, size * 4):
+                if suggest_mode == "popular" and cdf <= df:
+                    continue
+                options.append({"text": term, "freq": cdf,
+                                "score": round(1.0 - dist / max(
+                                    len(token), 1), 4)})
+        entries.append({"text": token, "offset": offset,
+                        "length": len(token), "options": options[:size]})
+        offset += len(token) + 1
+    return {"kind": "term", "size": size, "entries": entries}
+
+
+def _phrase_suggest(reader, mappers, text: Optional[str],
+                    spec: Dict[str, Any]) -> Dict[str, Any]:
+    field = spec.get("field")
+    if field is None or text is None:
+        raise IllegalArgumentError(
+            "phrase suggester requires [field] and [text]")
+    size = int(spec.get("size", 5))
+    max_edits = 2
+    terms_df = _field_terms_with_df(reader, field)
+    tokens = _analyzed_tokens(mappers, field, text)
+    # best per-token correction (identity when the token exists)
+    per_token: List[List[Tuple[str, int]]] = []
+    for token in tokens:
+        df = terms_df.get(token, 0)
+        choices = [(token, df)] if df else []
+        for term, cdf, _ in _term_candidates(terms_df, token, max_edits,
+                                             3):
+            choices.append((term, cdf))
+        per_token.append(choices or [(token, 0)])
+    # greedy best phrase + runner-ups by varying one token at a time
+    best = [c[0][0] for c in per_token]
+    options = []
+    seen = set()
+
+    def add(phrase_tokens):
+        phrase = " ".join(phrase_tokens)
+        if phrase in seen or phrase == " ".join(tokens):
+            return
+        seen.add(phrase)
+        score = 1.0
+        for t in phrase_tokens:
+            score *= (terms_df.get(t, 0) + 0.5)
+        options.append({"text": phrase, "score": score})
+    add(best)
+    for i, choices in enumerate(per_token):
+        for alt, _df in choices[1:]:
+            cand = list(best)
+            cand[i] = alt
+            add(cand)
+    norm = max((o["score"] for o in options), default=1.0) or 1.0
+    for o in options:
+        o["score"] = round(o["score"] / norm, 6)
+    options.sort(key=lambda o: -o["score"])
+    return {"kind": "phrase", "size": size,
+            "entries": [{"text": text, "offset": 0, "length": len(text),
+                         "options": options[:size]}]}
+
+
+def _completion_suggest(reader, text: Optional[str],
+                        spec: Dict[str, Any]) -> Dict[str, Any]:
+    field = spec.get("field")
+    prefix = spec.get("prefix", text)
+    if field is None or prefix is None:
+        raise IllegalArgumentError(
+            "completion suggester requires [field] and [prefix]")
+    size = int(spec.get("size", 5))
+    skip_duplicates = bool(spec.get("skip_duplicates", False))
+    lowered = prefix.lower()
+    scored: Dict[str, int] = {}
+    for seg in reader.segments:
+        kf = seg.keywords.get(field)
+        if kf is None:
+            continue
+        for tid, term in enumerate(kf.term_list):
+            if term.lower().startswith(lowered):
+                scored[term] = scored.get(term, 0) + \
+                    int(kf.doc_freq[tid])
+    options = [{"text": term, "score": float(df)}
+               for term, df in scored.items()]
+    options.sort(key=lambda o: (-o["score"], o["text"]))
+    if skip_duplicates:
+        pass   # term keys are already unique
+    return {"kind": "completion", "size": size,
+            "entries": [{"text": prefix, "offset": 0,
+                         "length": len(prefix),
+                         "options": options[:size]}]}
+
+
+# ---------------------------------------------------------------------------
+# coordinator-side merge
+# ---------------------------------------------------------------------------
+
+def merge_suggestions(partials: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-shard suggestion partials into the response's `suggest`
+    section (SuggestPhase reduce analog)."""
+    merged: Dict[str, Any] = {}
+    for partial in partials:
+        if not partial:
+            continue
+        for name, sugg in partial.items():
+            if name not in merged:
+                merged[name] = {"kind": sugg["kind"],
+                                "size": sugg["size"],
+                                "entries": [dict(e, options=list(
+                                    e["options"]))
+                                    for e in sugg["entries"]]}
+                continue
+            tgt = merged[name]
+            for entry in sugg["entries"]:
+                # (text, offset): a repeated token is a distinct entry
+                slot = next((e for e in tgt["entries"]
+                             if e["text"] == entry["text"]
+                             and e.get("offset") == entry.get("offset")),
+                            None)
+                if slot is None:
+                    tgt["entries"].append(
+                        dict(entry, options=list(entry["options"])))
+                    continue
+                by_text = {o["text"]: o for o in slot["options"]}
+                for opt in entry["options"]:
+                    cur = by_text.get(opt["text"])
+                    if cur is None:
+                        slot["options"].append(dict(opt))
+                        by_text[opt["text"]] = slot["options"][-1]
+                    else:
+                        if "freq" in opt:
+                            cur["freq"] = cur.get("freq", 0) + \
+                                opt["freq"]
+                        cur["score"] = max(cur["score"], opt["score"])
+    out = {}
+    for name, sugg in merged.items():
+        for entry in sugg["entries"]:
+            entry["options"].sort(
+                key=lambda o: (-o["score"], -o.get("freq", 0),
+                               o["text"]))
+            entry["options"] = entry["options"][: sugg["size"]]
+        out[name] = [{k: v for k, v in e.items()}
+                     for e in sugg["entries"]]
+    return out

@@ -1,0 +1,232 @@
+"""mget / termvectors / explain / field_caps / _analyze / suggesters /
+rank_eval / search templates tests."""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = InProcessCluster(n_nodes=2, seed=61)
+    c.start()
+    client = c.client()
+    c.call(lambda done: client.create_index("lib", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "tag": {"type": "keyword"},
+            "n": {"type": "long"},
+            "sugg": {"type": "completion"},
+        }}}, done))
+    c.ensure_green("lib")
+    docs = [
+        {"title": "the quick brown fox", "tag": "animal", "n": 1,
+         "sugg": ["quick fox", "quantum"]},
+        {"title": "quick silver lining", "tag": "idiom", "n": 2,
+         "sugg": "quicksilver"},
+        {"title": "slow brown bear", "tag": "animal", "n": 3,
+         "sugg": {"input": ["slow bear"]}},
+    ]
+    items = [{"action": "index", "index": "lib", "id": str(i),
+              "source": d} for i, d in enumerate(docs)]
+    c.call(lambda done: client.bulk(items, done))
+    c.call(lambda done: client.refresh("lib", done))
+    yield c
+    c.stop()
+
+
+def test_mget(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.mget(
+        {"docs": [{"_id": "0"}, {"_id": "2"}, {"_id": "99"}]}, done,
+        index="lib"))
+    assert err is None
+    docs = resp["docs"]
+    assert docs[0]["found"] and docs[0]["_source"]["n"] == 1
+    assert docs[1]["found"] and docs[1]["_source"]["n"] == 3
+    assert docs[2]["found"] is False
+
+
+def test_termvectors(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.termvectors(
+        "lib", "0", done, fields=["title"]))
+    assert err is None and resp["found"]
+    terms = resp["term_vectors"]["title"]["terms"]
+    assert "quick" in terms and terms["quick"]["term_freq"] == 1
+    assert terms["quick"]["doc_freq"] >= 1
+    assert terms["brown"]["tokens"][0]["position"] == 2
+
+
+def test_explain(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.explain(
+        "lib", "0", {"query": {"match": {"title": "quick"}}}, done))
+    assert err is None
+    assert resp["matched"] is True
+    assert resp["explanation"]["value"] > 0
+    resp, err = cluster.call(lambda done: client.explain(
+        "lib", "2", {"query": {"match": {"title": "quick"}}}, done))
+    assert resp["matched"] is False
+
+
+def test_field_caps(cluster):
+    client = cluster.client()
+    caps = client.field_caps("lib")
+    assert caps["fields"]["n"]["long"]["aggregatable"] is True
+    assert caps["fields"]["title"]["text"]["searchable"] is True
+    caps = client.field_caps("lib", fields="t*")
+    assert "n" not in caps["fields"] and "tag" in caps["fields"]
+
+
+def test_analyze(cluster):
+    client = cluster.client()
+    out = client.analyze({"analyzer": "standard",
+                          "text": "The Quick Fox!"})
+    assert [t["token"] for t in out["tokens"]] == ["the", "quick", "fox"]
+    assert out["tokens"][1]["position"] == 1
+
+
+def test_term_suggester(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.search("lib", {
+        "size": 0,
+        "suggest": {"fix": {"text": "quik browm",
+                            "term": {"field": "title"}}}}, done))
+    assert err is None, err
+    entries = resp["suggest"]["fix"]
+    assert entries[0]["text"] == "quik"
+    assert entries[0]["options"][0]["text"] == "quick"
+    assert "brown" in [o["text"] for o in entries[1]["options"]]
+
+
+def test_phrase_suggester(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.search("lib", {
+        "size": 0,
+        "suggest": {"p": {"text": "quick browm fox",
+                          "phrase": {"field": "title"}}}}, done))
+    assert err is None, err
+    options = resp["suggest"]["p"][0]["options"]
+    assert any(o["text"] == "quick brown fox" for o in options)
+
+
+def test_completion_suggester(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.search("lib", {
+        "size": 0,
+        "suggest": {"c": {"prefix": "qui",
+                          "completion": {"field": "sugg"}}}}, done))
+    assert err is None, err
+    texts = [o["text"] for o in resp["suggest"]["c"][0]["options"]]
+    assert "quick fox" in texts and "quicksilver" in texts
+    assert "slow bear" not in texts
+
+
+def test_rank_eval(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.rank_eval("lib", {
+        "requests": [{
+            "id": "q1",
+            "request": {"query": {"match": {"title": "quick"}}},
+            "ratings": [{"_index": "lib", "_id": "0", "rating": 1},
+                        {"_index": "lib", "_id": "1", "rating": 1}],
+        }],
+        "metric": {"recall": {"k": 5}},
+    }, done))
+    assert err is None, err
+    assert resp["metric_score"] == 1.0
+    assert resp["details"]["q1"]["metric_score"] == 1.0
+
+    resp, err = cluster.call(lambda done: client.rank_eval("lib", {
+        "requests": [{
+            "id": "q2",
+            "request": {"query": {"match": {"title": "brown"}}},
+            "ratings": [{"_index": "lib", "_id": "0", "rating": 3}],
+        }],
+        "metric": {"dcg": {"k": 5, "normalize": True}},
+    }, done))
+    assert err is None
+    assert 0 < resp["metric_score"] <= 1.0
+
+
+def test_search_template_and_stored_scripts(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.search_template(
+        "lib", {"source": {"query": {"match": {"title": "{{word}}"}},
+                           "size": "{{size}}"},
+                "params": {"word": "quick", "size": 2}}, done))
+    assert err is None, err
+    assert resp["hits"]["total"]["value"] == 2
+
+    resp, err = cluster.call(lambda done: client.put_stored_script(
+        "my-template", {"script": {
+            "lang": "mustache",
+            "source": '{"query": {"term": {"tag": "{{t}}"}}}'}}, done))
+    assert err is None
+    resp, err = cluster.call(lambda done: client.search_template(
+        "lib", {"id": "my-template", "params": {"t": "animal"}}, done))
+    assert err is None and resp["hits"]["total"]["value"] == 2
+
+    out = client.render_template(
+        {"id": "my-template", "params": {"t": "x"}})
+    assert out["template_output"] == {"query": {"term": {"tag": "x"}}}
+
+    resp, err = cluster.call(lambda done: client.delete_stored_script(
+        "my-template", done))
+    assert err is None
+    assert client.get_stored_script("my-template") is None
+
+
+def test_mustache_sections():
+    from elasticsearch_tpu.script.mustache import render
+    out = render('{"q": "{{a.b}}"{{#flag}}, "x": 1{{/flag}}'
+                 '{{^flag}}, "y": 2{{/flag}}}',
+                 {"a": {"b": "hello"}, "flag": True})
+    assert out == '{"q": "hello", "x": 1}'
+    out = render('[{{#items}}{"v": {{.}}},{{/items}}]', {"items": [1, 2]})
+    assert out == '[{"v": 1},{"v": 2},]'
+    out = render('{{#toJson}}obj{{/toJson}}', {"obj": {"k": [1, 2]}})
+    assert out == '{"k": [1, 2]}'
+
+
+def test_suggest_with_query_visits_all_shards(cluster):
+    """can_match must not skip shards for suggest-bearing requests."""
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.search("lib", {
+        "size": 0, "query": {"match": {"title": "silver"}},
+        "suggest": {"c": {"prefix": "slo",
+                          "completion": {"field": "sugg"}}}}, done))
+    assert err is None, err
+    texts = [o["text"] for o in resp["suggest"]["c"][0]["options"]]
+    assert "slow bear" in texts
+
+
+def test_rank_eval_bad_metric_is_400(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.rank_eval("lib", {
+        "requests": [{"id": "q", "request": {}, "ratings": []}],
+        "metric": {"bogus": {}}}, done))
+    assert err is not None and getattr(err, "status", None) == 400
+
+
+def test_rank_eval_bad_template_is_request_failure(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.rank_eval("lib", {
+        "requests": [
+            {"id": "ok", "request": {"query": {"match_all": {}}},
+             "ratings": []},
+            {"id": "bad", "template_id": "no_such", "ratings": []},
+        ],
+        "metric": {"precision": {"k": 2}}}, done))
+    assert err is None, err
+    assert "bad" in resp["failures"]
+    assert "ok" in resp["details"]
+
+
+def test_reindex_rejects_self(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.reindex(
+        {"source": {"index": "lib"}, "dest": {"index": "lib"}}, done))
+    assert err is not None and "reading from" in str(err)
