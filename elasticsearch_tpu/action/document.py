@@ -40,40 +40,11 @@ class TransportGetAction:
     def execute(self, index: str, doc_id: str, on_done: DoneFn,
                 routing: Optional[str] = None,
                 realtime: bool = True, prefer_primary: bool = False) -> None:
-        state = self.state()
-        try:
-            meta = state.metadata.index(index)
-        except IndexNotFoundError as e:
-            on_done(None, e)
-            return
-        shard = shard_id_for(routing or doc_id, meta.number_of_shards)
-        group = [sr for sr in
-                 state.routing_table.index(meta.name).shard_group(shard)
-                 if sr.active and sr.node_id is not None]
-        if realtime or prefer_primary:
-            # realtime get must see unrefreshed writes: only the primary
-            # (and in-sync replicas') buffers are guaranteed current; route
-            # to the primary like the reference's preference _primary path
-            group = [sr for sr in group if sr.primary] or group
-        if not group:
-            on_done(None, UnavailableShardsError(
-                f"no active copy of [{meta.name}][{shard}]"))
-            return
         self._rr += 1
-        rot = self._rr % len(group)
-        copies = group[rot:] + group[:rot]
-        req = {"index": meta.name, "shard": shard, "id": doc_id,
-               "realtime": realtime}
-
-        def attempt(idx: int) -> None:
-            def cb(resp, err):
-                if err is not None and idx + 1 < len(copies):
-                    attempt(idx + 1)    # fail over to the next copy
-                else:
-                    on_done(resp, err)
-            self.ts.send_request(copies[idx].node_id, GET_SHARD, req, cb,
-                                 timeout=30.0)
-        attempt(0)
+        routed_shard_request(
+            self.ts, self.state(), GET_SHARD, index, doc_id, on_done,
+            routing=routing, extra={"realtime": realtime},
+            prefer_primary=realtime or prefer_primary, rotate=self._rr)
 
     def _on_get(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
         shard = self.indices.shard(req["index"], req["shard"])
@@ -167,3 +138,49 @@ def _apply_script(source: Dict[str, Any],
     script engine is the sandboxed painless-lite evaluator)."""
     from elasticsearch_tpu.script.engine import execute_update_script
     return execute_update_script(source, script)
+
+
+def routed_shard_request(ts: TransportService, state: ClusterState,
+                         action: str, index: str, doc_id: str,
+                         on_done: DoneFn,
+                         routing: Optional[str] = None,
+                         extra: Optional[Dict[str, Any]] = None,
+                         prefer_primary: bool = False,
+                         rotate: int = 0,
+                         timeout: float = 30.0) -> None:
+    """Shared routing state machine for single-document reads: resolve
+    the owning shard via murmur3 routing, pick copies (primary-first when
+    the caller needs unrefreshed visibility, else round-robin by
+    ``rotate``), and fail over sequentially (TransportSingleShardAction
+    analog — get, termvectors, and explain all ride this)."""
+    try:
+        meta = state.metadata.index(index)
+    except IndexNotFoundError as e:
+        on_done(None, e)
+        return
+    shard = shard_id_for(routing or doc_id, meta.number_of_shards)
+    group = [sr for sr in
+             state.routing_table.index(meta.name).shard_group(shard)
+             if sr.active and sr.node_id is not None]
+    if prefer_primary:
+        # realtime reads must see unrefreshed writes: only the primary's
+        # buffers are guaranteed current (the reference's _primary path)
+        group = [sr for sr in group if sr.primary] or group
+    if not group:
+        on_done(None, UnavailableShardsError(
+            f"no active copy of [{meta.name}][{shard}]"))
+        return
+    rot = rotate % len(group)
+    copies = group[rot:] + group[:rot]
+    req = {"index": meta.name, "shard": shard, "id": doc_id,
+           **(extra or {})}
+
+    def attempt(idx: int) -> None:
+        def cb(resp, err):
+            if err is not None and idx + 1 < len(copies):
+                attempt(idx + 1)    # fail over to the next copy
+            else:
+                on_done(resp, err)
+        ts.send_request(copies[idx].node_id, action, req, cb,
+                        timeout=timeout)
+    attempt(0)

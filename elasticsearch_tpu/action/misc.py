@@ -127,9 +127,16 @@ class MiscReadActions:
                          "start_offset": tok.start_offset,
                          "end_offset": tok.end_offset})
             for term, entry in terms.items():
-                tid = pf.terms.get(term)
-                entry["doc_freq"] = int(pf.doc_freq[tid]) \
-                    if tid is not None else 0
+                # shard-level df: sum over every live segment, not just
+                # the one holding this doc
+                df = 0
+                for s in reader.segments:
+                    spf = s.postings.get(fname)
+                    if spf is not None:
+                        tid = spf.terms.get(term)
+                        if tid is not None:
+                            df += int(spf.doc_freq[tid])
+                entry["doc_freq"] = df
             if terms:
                 tv[fname] = {"terms": terms}
         return {"_index": req["index"], "_id": req["id"], "found": True,
@@ -226,16 +233,22 @@ class MiscReadActions:
             raise IllegalArgumentError("_analyze requires [text]")
         texts = text if isinstance(text, list) else [text]
 
+        from elasticsearch_tpu.analysis import AnalysisRegistry
         analyzer = None
         if index is not None and body.get("field"):
-            svc = self.node.indices_service.indices.get(index)
-            if svc is not None:
-                shard = next(iter(svc.shards.values()), None)
-                if shard is not None:
-                    mapper = shard.engine.mappers.mapper(body["field"])
-                    analyzer = getattr(mapper, "analyzer", None)
+            # derive from cluster-state mappings (field_caps-style), NOT
+            # from a locally hosted shard — every node must answer the
+            # same way regardless of shard placement
+            state = self.node._applied_state()
+            meta = state.metadata.index(index)
+            registry = AnalysisRegistry(
+                (meta.settings or {}).get("analysis"))
+            spec = dict(
+                _walk_fields((meta.mappings or {}).get("properties", {}))
+            ).get(body["field"])
+            name = (spec or {}).get("analyzer", "standard")
+            analyzer = registry.get(name)
         if analyzer is None:
-            from elasticsearch_tpu.analysis import AnalysisRegistry
             registry = AnalysisRegistry()
             analyzer = registry.get(body.get("analyzer", "standard"))
         tokens = []
@@ -256,34 +269,12 @@ class MiscReadActions:
                            routing: Optional[str],
                            extra: Dict[str, Any], on_done: DoneFn
                            ) -> None:
-        state = self.node._applied_state()
-        try:
-            meta = state.metadata.index(index)
-        except IndexNotFoundError as e:
-            on_done(None, e)
-            return
-        shard = shard_id_for(routing or doc_id, meta.number_of_shards)
-        group = [sr for sr in
-                 state.routing_table.index(meta.name).shard_group(shard)
-                 if sr.active and sr.node_id is not None]
-        if not group:
-            from elasticsearch_tpu.utils.errors import (
-                UnavailableShardsError,
-            )
-            on_done(None, UnavailableShardsError(
-                f"no active copy of [{meta.name}][{shard}]"))
-            return
-        req = {"index": meta.name, "shard": shard, "id": doc_id, **extra}
-
-        def attempt(idx: int) -> None:
-            def cb(resp, err):
-                if err is not None and idx + 1 < len(group):
-                    attempt(idx + 1)
-                else:
-                    on_done(resp, err)
-            self.node.transport_service.send_request(
-                group[idx].node_id, action, req, cb, timeout=30.0)
-        attempt(0)
+        from elasticsearch_tpu.action.document import routed_shard_request
+        self._rr = getattr(self, "_rr", 0) + 1
+        routed_shard_request(
+            self.node.transport_service, self.node._applied_state(),
+            action, index, doc_id, on_done, routing=routing, extra=extra,
+            rotate=self._rr)
 
 
 def _walk_fields(props: Dict[str, Any], prefix: str = ""):
@@ -291,7 +282,8 @@ def _walk_fields(props: Dict[str, Any], prefix: str = ""):
         if not isinstance(spec, dict):
             continue
         full = f"{prefix}{fname}"
-        if "properties" in spec and "type" not in spec:
+        if "properties" in spec and spec.get("type") in (None, "object",
+                                                         "nested"):
             yield from _walk_fields(spec["properties"], f"{full}.")
         else:
             yield full, spec

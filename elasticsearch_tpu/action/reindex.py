@@ -133,7 +133,23 @@ class ReindexActions:
             on_done(None, IllegalArgumentError(
                 "reindex requires source.index and dest.index"))
             return None
-        if src_index == dst_index:
+        # resolve aliases/wildcards before the self-write check — an alias
+        # of the source must not slip past it
+        from elasticsearch_tpu.cluster.metadata import (
+            resolve_index_expression,
+        )
+        state = self.node._applied_state()
+        try:
+            src_concrete = set(resolve_index_expression(
+                src_index, state.metadata))
+        except Exception:
+            src_concrete = {src_index}
+        try:
+            dst_concrete = set(resolve_index_expression(
+                dst_index, state.metadata))
+        except Exception:   # dest may not exist yet: fine
+            dst_concrete = {dst_index}
+        if src_index == dst_index or (src_concrete & dst_concrete):
             # writing into the index being paged breaks the
             # never-self-mutated-source invariant from/size relies on
             on_done(None, IllegalArgumentError(

@@ -46,7 +46,16 @@ def _regex_tokenize(text: str, pattern: re.Pattern) -> List[Token]:
 
 
 def standard_tokenizer(text: str) -> List[Token]:
-    """Unicode word-boundary tokenizer (reference: StandardTokenizer)."""
+    """Unicode word-boundary tokenizer (reference: StandardTokenizer).
+
+    Pure-ASCII text takes the native C++ scanner
+    (elasticsearch_tpu/native/fast.cpp — the indexing host path's hot
+    loop); anything else falls back to the equivalent unicode regex."""
+    from elasticsearch_tpu import native
+    spans = native.tokenize_standard_ascii(text)
+    if spans is not None:
+        return [Token(text[s:e], pos, s, e)
+                for pos, (s, e) in enumerate(spans)]
     return _regex_tokenize(text, _WORD_RE)
 
 

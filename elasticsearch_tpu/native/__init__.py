@@ -1,0 +1,109 @@
+"""Native fast paths: on-demand g++ build + ctypes loading.
+
+The shared library is compiled once from fast.cpp into a per-version
+cache directory and loaded via ctypes (no pybind11 in this image —
+SURVEY.md environment notes). Every entry point has a pure-Python
+fallback, so a missing toolchain only costs speed, never behavior:
+
+    tokenize_standard_ascii(text) -> list[(start, end)] | None
+    murmur3_32(data, seed)        -> int | None  (via available())
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+_SRC = Path(__file__).with_name("fast.cpp")
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return Path(base) / "elasticsearch_tpu"
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    src = _SRC.read_bytes()
+    digest = hashlib.sha256(src).hexdigest()[:16]
+    out_dir = _cache_dir()
+    out_dir.mkdir(parents=True, exist_ok=True)
+    so_path = out_dir / f"fast-{digest}.so"
+    if not so_path.exists():
+        tmp = so_path.with_name(f".{so_path.name}.{os.getpid()}.tmp")
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+               str(_SRC), "-o", str(tmp)]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        os.replace(tmp, so_path)
+    try:
+        lib = ctypes.CDLL(str(so_path))
+    except OSError:
+        return None
+    lib.tokenize_standard_ascii.restype = ctypes.c_int
+    lib.tokenize_standard_ascii.argtypes = [
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int]
+    lib.murmur3_32.restype = ctypes.c_uint32
+    lib.murmur3_32.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                               ctypes.c_uint32]
+    lib.shard_ids_for.restype = None
+    lib.shard_ids_for.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+    return lib
+
+
+def _get() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is None and not _build_failed:
+            _lib = _build()
+            if _lib is None:
+                _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def tokenize_standard_ascii(text: str
+                            ) -> Optional[List[Tuple[int, int]]]:
+    """Token (start, end) offsets, or None when the native path can't be
+    used (non-ASCII text or no library) — caller falls back to the regex.
+    """
+    lib = _get()
+    if lib is None or not text.isascii():
+        return None
+    raw = text.encode("ascii")
+    cap = max(16, len(raw) // 2 + 1)
+    starts = (ctypes.c_int32 * cap)()
+    ends = (ctypes.c_int32 * cap)()
+    n = lib.tokenize_standard_ascii(raw, len(raw), starts, ends, cap)
+    if n < 0:   # can't happen (cap >= max possible tokens), but be safe
+        return None
+    return list(zip(starts[:n], ends[:n]))
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> Optional[int]:
+    lib = _get()
+    if lib is None:
+        return None
+    return int(lib.murmur3_32(data, len(data), seed & 0xFFFFFFFF))

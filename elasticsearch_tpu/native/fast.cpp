@@ -1,0 +1,108 @@
+// Native host-path kernels for elasticsearch_tpu.
+//
+// The reference keeps its whole host path in Java (SURVEY.md: the only
+// native compute is x-pack ML's external C++ processes); here the hot
+// host-side loops — tokenization during bulk indexing and murmur3 routing
+// — get C++ fast paths, loaded via ctypes with pure-Python fallbacks
+// (elasticsearch_tpu/native/__init__.py builds this file on demand).
+//
+// Contracts (MUST match the Python implementations bit-for-bit):
+//   tokenize_standard_ascii: the standard tokenizer regex
+//       [^\W_]+(?:['’][^\W_]+)*   restricted to pure-ASCII input, where
+//       a word char is [0-9A-Za-z] and only ' can join (’ is non-ASCII).
+//   murmur3_32: MurmurHash3_x86_32 over raw bytes
+//       (elasticsearch_tpu/utils/murmur3.py).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static inline bool is_word(unsigned char c) {
+    return (c >= '0' && c <= '9') || (c >= 'A' && c <= 'Z') ||
+           (c >= 'a' && c <= 'z');
+}
+
+// Writes token [start, end) offset pairs; returns the token count, or
+// -1 if max_tokens would be exceeded (caller falls back / regrows).
+int tokenize_standard_ascii(const char* text, int len,
+                            int32_t* starts, int32_t* ends,
+                            int max_tokens) {
+    int n = 0;
+    int i = 0;
+    while (i < len) {
+        if (!is_word((unsigned char)text[i])) { i++; continue; }
+        int start = i;
+        while (i < len && is_word((unsigned char)text[i])) i++;
+        // apostrophe continuation: 'word joins only when followed by a
+        // word char (regex: (?:'[^\W_]+)*)
+        while (i + 1 < len && text[i] == '\'' &&
+               is_word((unsigned char)text[i + 1])) {
+            i++;
+            while (i < len && is_word((unsigned char)text[i])) i++;
+        }
+        if (n >= max_tokens) return -1;
+        starts[n] = start;
+        ends[n] = i;
+        n++;
+    }
+    return n;
+}
+
+// Lowercase ASCII bytes in place (the lowercase token filter fast path).
+void lowercase_ascii(char* text, int len) {
+    for (int i = 0; i < len; i++) {
+        char c = text[i];
+        if (c >= 'A' && c <= 'Z') text[i] = c + 32;
+    }
+}
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+uint32_t murmur3_32(const uint8_t* data, int len, uint32_t seed) {
+    const uint32_t c1 = 0xcc9e2d51u;
+    const uint32_t c2 = 0x1b873593u;
+    uint32_t h = seed;
+    const int nblocks = len / 4;
+    for (int i = 0; i < nblocks; i++) {
+        uint32_t k;
+        std::memcpy(&k, data + i * 4, 4);   // little-endian hosts only
+        k *= c1;
+        k = rotl32(k, 15);
+        k *= c2;
+        h ^= k;
+        h = rotl32(h, 13);
+        h = h * 5 + 0xe6546b64u;
+    }
+    const uint8_t* tail = data + nblocks * 4;
+    uint32_t k = 0;
+    switch (len & 3) {
+        case 3: k ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+        case 2: k ^= (uint32_t)tail[1] << 8;  [[fallthrough]];
+        case 1: k ^= tail[0];
+                k *= c1; k = rotl32(k, 15); k *= c2; h ^= k;
+    }
+    h ^= (uint32_t)len;
+    h ^= h >> 16;
+    h *= 0x85ebca6bu;
+    h ^= h >> 13;
+    h *= 0xc2b2ae35u;
+    h ^= h >> 16;
+    return h;
+}
+
+// Batched routing: hash n UTF-8 keys (concatenated, with offsets) to
+// shard ids in one call — the per-doc Python call overhead dominates
+// pure-Python murmur3 during bulk indexing.
+void shard_ids_for(const uint8_t* blob, const int32_t* offsets, int n,
+                   int32_t n_shards, int32_t* out) {
+    for (int i = 0; i < n; i++) {
+        uint32_t h = murmur3_32(blob + offsets[i],
+                                offsets[i + 1] - offsets[i], 0);
+        out[i] = (int32_t)(h % (uint32_t)n_shards);
+    }
+}
+
+}  // extern "C"

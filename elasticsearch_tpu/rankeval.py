@@ -73,6 +73,10 @@ class RankEvalAction:
             on_done(None, IllegalArgumentError(
                 "_rank_eval requires [requests] and [metric]"))
             return
+        if not isinstance(metric_spec, dict) or len(metric_spec) != 1:
+            on_done(None, IllegalArgumentError(
+                "_rank_eval requires exactly one metric"))
+            return
         (metric_name, metric_params), = metric_spec.items()
         metric_params = metric_params or {}
         if metric_name not in ("precision", "recall",

@@ -34,7 +34,8 @@ def _lookup(params: Any, path: str) -> Any:
 
 
 _SECTION = re.compile(
-    r"\{\{([#^])\s*([\w.]+)\s*\}\}(.*?)\{\{/\s*\2\s*\}\}", re.DOTALL)
+    r"\{\{([#^])\s*(?!toJson\b)([\w.]+)\s*\}\}(.*?)\{\{/\s*\2\s*\}\}",
+    re.DOTALL)
 _TOJSON = re.compile(
     r"\{\{#toJson\}\}\s*([\w.]+)\s*\{\{/toJson\}\}")
 _TRIPLE_VAR = re.compile(r"\{\{\{\s*([\w.]+)\s*\}\}\}")
@@ -45,9 +46,6 @@ def render(source: str, params: Optional[Dict[str, Any]]) -> str:
     params = params or {}
 
     def render_part(tmpl: str, scope: Any) -> str:
-        tmpl = _TOJSON.sub(
-            lambda m: json.dumps(_lookup(scope, m.group(1))), tmpl)
-
         def do_section(m: re.Match) -> str:
             kind, path, body = m.group(1), m.group(2), m.group(3)
             value = _lookup(scope, path)
@@ -62,6 +60,9 @@ def render(source: str, params: Optional[Dict[str, Any]]) -> str:
                 return render_part(body, value)
             return render_part(body, scope)
         tmpl = _SECTION.sub(do_section, tmpl)
+        # toJson AFTER section expansion so per-item scopes resolve
+        tmpl = _TOJSON.sub(
+            lambda m: json.dumps(_lookup(scope, m.group(1))), tmpl)
 
         def do_var(m: re.Match) -> str:
             v = _lookup(scope, m.group(1))
@@ -71,6 +72,10 @@ def render(source: str, params: Optional[Dict[str, Any]]) -> str:
                 return "true" if v else "false"
             if isinstance(v, (dict, list)):
                 return json.dumps(v)
+            if isinstance(v, str):
+                # JSON-escape (bodies are JSON): quotes/backslashes/
+                # newlines in params must not break the render
+                return json.dumps(v)[1:-1]
             return str(v)
         # triple-stache first, or its braces bleed into the JSON around it
         tmpl = _TRIPLE_VAR.sub(do_var, tmpl)

@@ -19,7 +19,15 @@ def _rotl32(x: int, r: int) -> int:
 
 
 def murmur3_32(data: bytes, seed: int = 0) -> int:
-    """MurmurHash3_x86_32 over ``data``; returns unsigned 32-bit int."""
+    """MurmurHash3_x86_32 over ``data``; returns unsigned 32-bit int.
+
+    Uses the native C++ implementation when built (native/fast.cpp);
+    this pure-Python body is the fallback and the reference semantics."""
+    from elasticsearch_tpu import native
+    if native.available():
+        h = native.murmur3_32(data, seed)
+        if h is not None:
+            return h
     h = seed & _MASK
     n = len(data)
     nblocks = n // 4

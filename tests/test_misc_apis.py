@@ -230,3 +230,47 @@ def test_reindex_rejects_self(cluster):
     resp, err = cluster.call(lambda done: client.reindex(
         {"source": {"index": "lib"}, "dest": {"index": "lib"}}, done))
     assert err is not None and "reading from" in str(err)
+
+
+def test_mustache_escaping_and_scoped_tojson():
+    from elasticsearch_tpu.script.mustache import render, render_search_body
+    body = render_search_body(
+        {"source": '{"query": {"match": {"t": "{{w}}"}}}',
+         "params": {"w": 'say "hi"\nplease'}}, lambda _i: None)
+    assert body["query"]["match"]["t"] == 'say "hi"\nplease'
+    out = render('{{#items}}[{{#toJson}}v{{/toJson}}]{{/items}}',
+                 {"items": [{"v": 1}, {"v": [2, 3]}]})
+    assert out == "[1][[2, 3]]"
+
+
+def test_field_caps_object_subfields(cluster):
+    client = cluster.client()
+    cluster.call(lambda done: client.create_index("objmap", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {"addr": {
+            "type": "object",
+            "properties": {"city": {"type": "keyword"}}}}}}, done))
+    caps = client.field_caps("objmap")
+    assert "addr.city" in caps["fields"]
+
+
+def test_rank_eval_two_metrics_is_400(cluster):
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.rank_eval("lib", {
+        "requests": [{"id": "q", "request": {}, "ratings": []}],
+        "metric": {"precision": {}, "recall": {}}}, done))
+    assert err is not None and getattr(err, "status", None) == 400
+
+
+def test_reindex_rejects_alias_of_source(cluster):
+    client = cluster.client()
+    cluster.call(lambda done: client.update_aliases(
+        [{"add": {"index": "lib", "alias": "lib-alias"}}], done))
+    # the master ack precedes local state application: wait until the
+    # coordinating node sees the alias before resolving through it
+    cluster.run_until(lambda: "lib-alias" in client.node._applied_state()
+                      .metadata.index("lib").aliases, 60.0)
+    resp, err = cluster.call(lambda done: client.reindex(
+        {"source": {"index": "lib"}, "dest": {"index": "lib-alias"}},
+        done))
+    assert err is not None and "reading from" in str(err)
