@@ -25,6 +25,7 @@ from elasticsearch_tpu.indices.cluster_state_service import (
     SHARD_FAILED, SHARD_STARTED,
 )
 from elasticsearch_tpu.indices.indices_service import IndicesService
+from elasticsearch_tpu.mapping import MapperService
 from elasticsearch_tpu.transport.transport import Deferred, TransportService
 from elasticsearch_tpu.utils.errors import (
     IllegalArgumentError, IndexNotFoundError, NotMasterError,
@@ -42,6 +43,14 @@ FORCEMERGE_SHARD = "indices:admin/forcemerge[s]"
 STATS_SHARD = "indices:monitor/stats[s]"
 
 MASTER_RETRY_DELAY = 0.2
+
+
+def _validate_mappings(mappings: Dict[str, Any]) -> None:
+    """Build a throwaway MapperService exactly as the applier will
+    (indices_service.py IndexService.__init__), surfacing MapperParsingError
+    to the API caller instead of to every node's applier post-commit."""
+    if mappings:
+        MapperService(dict(mappings))
 MASTER_TIMEOUT = 30.0
 
 
@@ -90,6 +99,11 @@ class MasterActions:
         if not name or name.startswith("_") or name != name.lower() \
                 or any(c in name for c in ' ,"*\\<>|?/'):
             raise IllegalArgumentError(f"invalid index name [{name}]")
+        # validate the mapping BEFORE it enters the cluster state: once
+        # committed, every node's applier would fail on it and the index
+        # would never assign (MetadataCreateIndexService validates the same
+        # way by building a MapperService up front)
+        _validate_mappings(mappings)
 
         def update(state: ClusterState) -> ClusterState:
             if state.metadata.has_index(name):
@@ -128,6 +142,7 @@ class MasterActions:
             props = dict(merged.get("properties", {}))
             props.update(mappings.get("properties", {}))
             merged["properties"] = props
+            _validate_mappings(merged)   # reject before commit, not on apply
             return state.next_version(metadata=state.metadata.update_index(
                 meta.with_mappings(merged)))
         return self._submit(f"put-mapping [{name}]", update)

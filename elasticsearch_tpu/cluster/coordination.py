@@ -21,8 +21,10 @@ virtual time (AbstractCoordinatorTestCase.java:143 analog).
 
 from __future__ import annotations
 
+import logging
 import random as random_mod
 import uuid as uuid_mod
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -31,6 +33,8 @@ from elasticsearch_tpu.cluster.state import IncompatibleClusterStateError
 from elasticsearch_tpu.transport.scheduler import Cancellable, Scheduler
 from elasticsearch_tpu.transport.transport import TransportService
 from elasticsearch_tpu.utils.errors import NotMasterError
+
+logger = logging.getLogger(__name__)
 
 
 # transport action names (reference registers these in Coordinator's ctor)
@@ -211,7 +215,10 @@ class Coordinator:
         self.ts = transport_service
         self.scheduler = scheduler
         self.settings = settings or CoordinatorSettings()
-        self.rng = rng or random_mod.Random(hash(node.node_id) & 0xFFFF)
+        # stable across processes: hash() of str is randomized per process
+        # (PYTHONHASHSEED), which silently destroyed cross-run determinism
+        self.rng = rng or random_mod.Random(
+            zlib.crc32(node.node_id.encode()) & 0xFFFF)
         persisted = persisted_state if persisted_state is not None \
             else PersistedState(accepted_state=initial_state)
         self.state = CoordinationState(node.node_id, persisted)
@@ -525,7 +532,16 @@ class Coordinator:
             return
         self.applied_state = state
         if self.on_committed is not None:
-            self.on_committed(state)
+            # An applier failure must never wedge the master-service queue:
+            # the state IS committed cluster-wide regardless of what one
+            # node's appliers do with it (ClusterApplierService.java:74
+            # catches applier exceptions the same way). The local index
+            # error surfaces through shard-level failure, not here.
+            try:
+                self.on_committed(state)
+            except Exception:  # noqa: BLE001
+                logger.exception("cluster state applier failed on %s v%s",
+                                 self.node.node_id, state.version)
         self._on_applied_for_updates(state)
 
     # -- MasterService role ---------------------------------------------------

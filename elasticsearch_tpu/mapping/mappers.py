@@ -351,6 +351,12 @@ class CompletionFieldMapper(FieldMapper):
                            exact_terms=[str(v) for v in inputs])
 
 
+# root-level mapping keys that are configuration, never field names
+# (index/mapper/DocumentMapperParser root handlers analog)
+_ROOT_MAPPING_KEYS = frozenset(
+    ("dynamic", "dynamic_templates", "date_detection",
+     "numeric_detection", "runtime"))
+
 _MAPPER_TYPES = {
     "text": TextFieldMapper,
     "keyword": KeywordFieldMapper,
@@ -397,11 +403,21 @@ class MapperService:
         # them, still store in _source), "strict" (reject the document)
         self.dynamic = _parse_dynamic(dynamic)
         self._mappers: Dict[str, FieldMapper] = {}
+        # container paths: full path -> "object" | "nested". Nested paths
+        # additionally gate nested-query semantics (parity work pending).
+        self._object_types: Dict[str, str] = {}
         if mapping:
             self.merge(mapping)
 
     def merge(self, mapping: Dict[str, Any]) -> None:
-        props = mapping.get("properties", mapping)
+        props = mapping.get("properties")
+        if props is None:
+            # bare-props convenience form: everything that looks like a
+            # field spec; root mapping keys (dynamic, _source, _meta, ...)
+            # are not fields
+            props = {k: v for k, v in mapping.items()
+                     if isinstance(v, dict) and not k.startswith("_")
+                     and k not in _ROOT_MAPPING_KEYS}
         self._merge_props("", props)
         if "dynamic" in mapping:
             self.dynamic = _parse_dynamic(mapping["dynamic"])
@@ -409,8 +425,33 @@ class MapperService:
     def _merge_props(self, prefix: str, props: Dict[str, Any]) -> None:
         for name, spec in props.items():
             full = f"{prefix}{name}"
-            if "properties" in spec and "type" not in spec:
-                self._merge_props(f"{full}.", spec["properties"])
+            if not isinstance(spec, dict):
+                raise MapperParsingError(
+                    f"expected map for property [{full}] but got "
+                    f"[{type(spec).__name__}]")
+            # inner objects: implicit (properties, no type) or explicit
+            # object/nested (ObjectMapper/NestedObjectMapper analog) —
+            # recurse, record the container kind, no leaf mapper
+            if spec.get("type") in ("object", "nested") or \
+                    ("properties" in spec and "type" not in spec):
+                existing = self._mappers.get(full)
+                if existing is not None:
+                    raise MapperParsingError(
+                        f"mapper [{full}] cannot change type from "
+                        f"[{existing.type_name}] to [object]")
+                prior_kind = self._object_types.get(full)
+                if "type" in spec:
+                    # explicit object<->nested change is rejected (ES:
+                    # "can't merge a non object mapping ... nested")
+                    if prior_kind is not None and prior_kind != spec["type"]:
+                        raise MapperParsingError(
+                            f"mapper [{full}] cannot change type from "
+                            f"[{prior_kind}] to [{spec['type']}]")
+                    self._object_types[full] = spec["type"]
+                elif prior_kind is None:
+                    # implicit properties-only spec keeps an existing kind
+                    self._object_types[full] = "object"
+                self._merge_props(f"{full}.", spec.get("properties", {}))
                 continue
             new = build_mapper(full, spec, self.analysis)
             existing = self._mappers.get(full)
@@ -418,6 +459,10 @@ class MapperService:
                 raise MapperParsingError(
                     f"mapper [{full}] cannot change type from "
                     f"[{existing.type_name}] to [{new.type_name}]")
+            if full in self._object_types:
+                raise MapperParsingError(
+                    f"mapper [{full}] cannot change type from "
+                    f"[{self._object_types[full]}] to [{new.type_name}]")
             self._mappers[full] = new
             # text fields get an automatic .keyword subfield unless disabled,
             # mirroring ES dynamic-template default behavior
@@ -448,6 +493,16 @@ class MapperService:
             for p in parts[:-1]:
                 node = node.setdefault(p, {}).setdefault("properties", {})
             node[parts[-1]] = m.to_mapping()
+        # explicit nested containers keep their type on round-trip (the
+        # container node may not exist yet if it holds no leaf fields)
+        for path, kind in self._object_types.items():
+            if kind != "nested":
+                continue
+            node = props
+            parts = path.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node.setdefault(parts[-1], {})["type"] = "nested"
         return {"properties": props}
 
     def _infer(self, name: str, value: Any) -> Optional[FieldMapper]:
@@ -471,6 +526,13 @@ class MapperService:
             return self._infer(name, value[0]) if value else None
         else:
             return None
+        if name in self._object_types:
+            # a scalar arriving at an object/nested container path is a
+            # document error, not a mapping update (DocumentParser rejects
+            # "tried to parse field [x] as object" the same way)
+            raise MapperParsingError(
+                f"object mapping for [{name}] tried to parse value as "
+                f"{self._object_types[name]}, got a concrete value")
         self._mappers[name] = build_mapper(name, spec, self.analysis)
         if spec["type"] == "text":
             self._mappers[f"{name}.keyword"] = build_mapper(

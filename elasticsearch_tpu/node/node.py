@@ -8,6 +8,7 @@ the typed in-process facade the REST layer calls.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional
 
 from elasticsearch_tpu.action.admin import (
@@ -41,6 +42,8 @@ from elasticsearch_tpu.transport.transport import (
 from elasticsearch_tpu.utils.errors import (
     IllegalArgumentError, SearchEngineError,
 )
+
+logger = logging.getLogger(__name__)
 
 
 class Node:
@@ -140,8 +143,17 @@ class Node:
         return self.coordinator.applied_state
 
     def _on_committed(self, state: ClusterState) -> None:
-        self.reconciler.apply_cluster_state(state)
-        self._master_housekeeping(state)
+        # appliers are isolated from each other: a reconciler failure (e.g. a
+        # shard that can't initialize) must not skip master housekeeping, and
+        # vice versa (ClusterApplierService catches per-applier the same way)
+        for applier in (self.reconciler.apply_cluster_state,
+                        self._master_housekeeping):
+            try:
+                applier(state)
+            except Exception:  # noqa: BLE001
+                logger.exception("applier %s failed for state v%s on %s",
+                                 getattr(applier, "__name__", applier),
+                                 state.version, self.node_id)
 
     def _master_housekeeping(self, state: ClusterState) -> None:
         """On the elected master: clean up routing after membership changes

@@ -266,3 +266,35 @@ def test_concurrent_state_updates_both_complete():
     for c in cl.coords.values():
         assert "block-a" in c.applied_state.blocks
         assert "block-b" in c.applied_state.blocks
+
+
+def test_applier_failure_does_not_wedge_master():
+    """A raising on_committed applier must not leak the in-flight update
+    slot: the state is committed cluster-wide regardless of one node's
+    applier (ClusterApplierService.java:74 catches the same way).
+
+    Regression: an applier exception on the master skipped
+    _on_applied_for_updates, so every subsequent update queued forever."""
+    cl = Cluster(3, seed=11)
+    cl.start()
+    cl.run(30.0)
+    leader = cl.leader()
+    blowups = {"n": 0}
+
+    def exploding_applier(state):
+        blowups["n"] += 1
+        raise RuntimeError("applier boom")
+
+    prior = leader.on_committed
+    leader.on_committed = exploding_applier
+    done = []
+    leader.submit_state_update("a", lambda s: s.with_block("block-a"),
+                               on_done=lambda e: done.append(("a", e)))
+    cl.run(30.0)
+    assert done == [("a", None)] and blowups["n"] >= 1
+    leader.on_committed = prior
+    # and the queue still drains afterwards
+    leader.submit_state_update("b", lambda s: s.with_block("block-b"),
+                               on_done=lambda e: done.append(("b", e)))
+    cl.run(30.0)
+    assert done == [("a", None), ("b", None)]

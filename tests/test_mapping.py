@@ -144,3 +144,66 @@ def test_multi_value_text_position_gap():
     positions = [t.position for t in doc.fields["t"].terms]
     assert positions[0] == 0 and positions[1] == 1
     assert positions[2] >= 100  # gap between array entries
+
+
+def test_explicit_object_type():
+    """Explicit "type": "object" recurses like implicit properties-only.
+
+    Regression: build_mapper had no object handler, so applying a cluster
+    state carrying such a mapping raised on the data node — and the raise
+    inside the applier wedged the master-service queue (see
+    test_applier_failure_does_not_wedge_master in test_coordination.py).
+    """
+    svc = MapperService({"properties": {"addr": {
+        "type": "object",
+        "properties": {"city": {"type": "keyword"},
+                       "geo": {"type": "object",
+                               "properties": {"zip": {"type": "keyword"}}}}}}})
+    assert svc.mapper("addr.city").type_name == "keyword"
+    assert svc.mapper("addr.geo.zip").type_name == "keyword"
+    # bare object with no properties is legal and maps nothing
+    MapperService({"properties": {"meta": {"type": "object"}}})
+
+
+def test_leaf_object_type_conflicts_rejected():
+    svc = MapperService({"properties": {"a": {"type": "keyword"}}})
+    with pytest.raises(MapperParsingError):
+        svc.merge({"properties": {"a": {
+            "type": "object", "properties": {"b": {"type": "keyword"}}}}})
+    svc2 = MapperService({"properties": {"a": {
+        "type": "object", "properties": {"b": {"type": "keyword"}}}}})
+    with pytest.raises(MapperParsingError):
+        svc2.merge({"properties": {"a": {"type": "keyword"}}})
+
+
+def test_nested_type_maps_subfields_and_roundtrips():
+    svc = MapperService({"properties": {"n": {
+        "type": "nested", "properties": {"x": {"type": "keyword"}}}}})
+    assert svc.mapper("n.x").type_name == "keyword"
+    out = svc.to_mapping()["properties"]
+    assert out["n"]["type"] == "nested"
+    assert out["n"]["properties"]["x"]["type"] == "keyword"
+
+
+def test_scalar_at_container_path_rejected():
+    svc = MapperService({"properties": {"n": {
+        "type": "nested", "properties": {"x": {"type": "keyword"}}}}})
+    with pytest.raises(MapperParsingError, match="tried to parse"):
+        svc.parse_document("1", {"n": "oops"})
+
+
+def test_container_kind_preserved_and_explicit_change_rejected():
+    svc = MapperService({"properties": {"n": {
+        "type": "nested", "properties": {"x": {"type": "keyword"}}}}})
+    # implicit properties-only merge keeps nested
+    svc.merge({"properties": {"n": {"properties": {"y": {"type": "keyword"}}}}})
+    assert svc.to_mapping()["properties"]["n"]["type"] == "nested"
+    with pytest.raises(MapperParsingError, match="cannot change type"):
+        svc.merge({"properties": {"n": {"type": "object"}}})
+
+
+def test_properties_less_root_mapping_ok():
+    svc = MapperService({"dynamic": "strict"})
+    assert svc.field_names() == []
+    with pytest.raises(MapperParsingError, match="expected map"):
+        MapperService({"properties": {"f": "not-a-map"}})

@@ -274,3 +274,18 @@ def test_reindex_rejects_alias_of_source(cluster):
         {"source": {"index": "lib"}, "dest": {"index": "lib-alias"}},
         done))
     assert err is not None and "reading from" in str(err)
+
+
+def test_create_index_bad_mapping_rejected_before_commit(cluster):
+    """An unmappable mapping must fail the API call, not poison the cluster
+    state (validation at MetadataCreateIndexService altitude)."""
+    client = cluster.client()
+    resp, err = cluster.call(lambda done: client.create_index("badmap", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {"f": {"type": "no_such_type"}}}}, done))
+    assert err is not None and "no_such_type" in str(err)
+    assert not cluster.master().coordinator.applied_state.metadata.has_index("badmap")
+    # the cluster still processes subsequent updates (no queue wedge)
+    resp, err = cluster.call(lambda done: client.create_index("goodmap", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0}}, done))
+    assert err is None
