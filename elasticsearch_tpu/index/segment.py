@@ -73,6 +73,10 @@ class PostingsField:
     # Host-only; used for phrase verification (padding entries are empty).
     pos_offsets: np.ndarray = field(default_factory=lambda: np.zeros(1, np.int32))
     pos_flat: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
+    # derived, lazily computed: (k1, b) -> per-block max impact (see
+    # block_max_impact); never persisted
+    _impact_cache: Dict[Tuple[float, float], np.ndarray] = field(
+        default_factory=dict, repr=False, compare=False)
 
     @property
     def n_terms(self) -> int:
@@ -100,6 +104,29 @@ class PostingsField:
         tfs = self.block_tfs[start : start + count].reshape(-1)
         mask = docs >= 0
         return docs[mask], tfs[mask]
+
+    def block_max_impact(self, k1: float, b: float) -> np.ndarray:
+        """Per-block upper bound of tf/(tf + k1*(1-b+b*dl/avgdl)) — the
+        block-max WAND bound (BMW's precomputed per-block max impact;
+        reference consumes it via Lucene's block-max scorers behind
+        search/query/TopDocsCollectorContext.java:215). Multiplying by
+        idf*boost*(k1+1) gives the max BM25 contribution any doc in the
+        block can receive from its term. Exact (per-entry, using true doc
+        lengths), cached per (k1, b)."""
+        key = (float(k1), float(b))
+        cached = self._impact_cache.get(key)
+        if cached is not None:
+            return cached
+        avgdl = float(self.sum_doc_len / max(1, (self.doc_lens > 0).sum()))
+        docs = self.block_docs
+        tfs = self.block_tfs
+        valid = docs >= 0
+        dl = self.doc_lens[np.where(valid, docs, 0)]
+        norm = k1 * (1.0 - b + b * dl / max(avgdl, 1e-9))
+        impact = np.where(valid, tfs / np.maximum(tfs + norm, 1e-9), 0.0)
+        out = impact.max(axis=1).astype(np.float32)
+        self._impact_cache[key] = out
+        return out
 
     def positions_for(self, term: str, doc: int) -> np.ndarray:
         tid = self.terms.get(term)
@@ -398,6 +425,70 @@ class SegmentBuilder:
             if pf is not None and pf.geo is not None:
                 arr[local] = pf.geo
         return arr
+
+
+def postings_from_token_matrix(tokens: np.ndarray,
+                               term_names: Optional[List[str]] = None
+                               ) -> PostingsField:
+    """Vectorized bulk construction of a PostingsField from a dense token
+    matrix [n_docs, doc_len] of term ids (negative = padding/no token).
+
+    Used by benchmarks and bulk loads where per-document analysis is the
+    bottleneck: equivalent to feeding each row through SegmentBuilder.add
+    (index/engine/InternalEngine.java:1030's indexIntoLucene analog), but
+    built with numpy sorts instead of per-token dict updates."""
+    n_docs, _L = tokens.shape
+    valid = tokens >= 0
+    doc_lens = valid.sum(axis=1).astype(np.float32)
+    t = tokens[valid].astype(np.int64)
+    d = np.repeat(np.arange(n_docs, dtype=np.int64),
+                  valid.sum(axis=1))
+    # aggregate tf per (term, doc), ordered by term then doc — exactly the
+    # posting order the block layout wants
+    key = t * n_docs + d
+    uniq, counts = np.unique(key, return_counts=True)
+    u_term = (uniq // n_docs).astype(np.int64)
+    u_doc = (uniq % n_docs).astype(np.int32)
+    tfs = counts.astype(np.float32)
+
+    # per-term posting ranges
+    term_ids, term_first, term_postings = np.unique(
+        u_term, return_index=True, return_counts=True)
+    n_terms = int(tokens[valid].max()) + 1 if t.size else 0
+    doc_freq = np.zeros(max(n_terms, 1), np.int32)
+    doc_freq[term_ids] = term_postings
+    nb_per_term = np.zeros(max(n_terms, 1), np.int64)
+    nb_per_term[term_ids] = -(-term_postings // BLOCK)
+    nb_per_term = np.maximum(nb_per_term, 1)     # every term >= 1 block
+    term_block_start = np.zeros(max(n_terms, 1), np.int64)
+    term_block_start[1:] = np.cumsum(nb_per_term)[:-1]
+    n_blocks = int(nb_per_term.sum())
+
+    block_docs = np.full((n_blocks, BLOCK), -1, np.int32)
+    block_tfs = np.zeros((n_blocks, BLOCK), np.float32)
+    block_term = np.repeat(np.arange(max(n_terms, 1)), nb_per_term)
+    # flat entry index of each posting: entries of term tid start at
+    # term_block_start[tid]*BLOCK and are consecutive
+    entry_base = term_block_start[u_term] * BLOCK
+    within = np.arange(len(u_term)) - term_first[
+        np.searchsorted(term_ids, u_term)]
+    flat = entry_base + within
+    block_docs.reshape(-1)[flat] = u_doc
+    block_tfs.reshape(-1)[flat] = tfs
+
+    names = term_names or [f"t{i}" for i in range(max(n_terms, 1))]
+    return PostingsField(
+        terms={name: i for i, name in enumerate(names)},
+        block_docs=block_docs,
+        block_tfs=block_tfs,
+        block_term=block_term.astype(np.int32),
+        block_max_tf=block_tfs.max(axis=1).astype(np.float32),
+        term_block_start=term_block_start.astype(np.int32),
+        term_block_count=nb_per_term.astype(np.int32),
+        doc_freq=doc_freq,
+        doc_lens=doc_lens,
+        sum_doc_len=float(doc_lens.sum()),
+    )
 
 
 def _pack_postings(terms: Dict[str, int], tf_map: List[Dict[int, int]],

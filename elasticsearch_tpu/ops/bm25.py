@@ -77,6 +77,235 @@ def bm25_topk(block_docs, block_tfs, block_idx, block_weight, doc_lens, avgdl,
     return jax.lax.top_k(scores, k)
 
 
+@partial(jax.jit, static_argnames=("n_docs_pad", "k1", "b", "k"))
+def bm25_topk_batch(block_docs, block_tfs,
+                    block_idx,        # [Q, QB] int32
+                    block_weight,     # [Q, QB] f32
+                    doc_lens, avgdl, live, n_docs_pad: int, k: int,
+                    k1: float = DEFAULT_K1, b: float = DEFAULT_B
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched BM25 + top-k: Q queries in one dispatch (the knn_topk_batch
+    analog — amortizes host->device dispatch across the batch)."""
+
+    def one(bi, bw):
+        s = bm25_block_scores(block_docs, block_tfs, bi, bw,
+                              doc_lens, avgdl, n_docs_pad, k1=k1, b=b)
+        s = jnp.where(live & (s > 0.0), s, -jnp.inf)
+        return jax.lax.top_k(s, k)
+
+    return jax.vmap(one)(block_idx, block_weight)
+
+
+# number of highest-upper-bound blocks scored in phase 1 of the pruned
+# path to establish the top-k score floor (theta)
+P1_BUCKET = 32
+
+
+def qb_bucket(n: int, minimum: int = 32) -> int:
+    """Gather-list bucket size: a coarse x8 ladder instead of pow2.
+
+    Every distinct gather shape costs a full XLA compile (~seconds); pow2
+    buckets churn with each query batch. The x8 ladder wastes at most 8x
+    gather padding (device cost: <1ms) to cap the shape space at ~5
+    compiles total — compile amortization dominates padding waste."""
+    b = max(minimum, 1)
+    while b < n:
+        b *= 8
+    return b
+
+
+class QueryPlan:
+    """Host-side per-query block plan with block-max upper bounds.
+
+    For each candidate posting block: its gather index, weight (idf*boost),
+    and ub = weight*(k1+1)*block_max_impact — the max BM25 contribution any
+    doc in the block can receive from its term. other_ub is the sum of the
+    OTHER query terms' global per-doc bounds, so ub + other_ub bounds the
+    total score of every doc in the block (the WAND invariant)."""
+
+    __slots__ = ("idx", "w", "ub", "other_ub")
+
+    def __init__(self, idx, w, ub, other_ub):
+        self.idx = np.asarray(idx, np.int32)
+        self.w = np.asarray(w, np.float32)
+        self.ub = np.asarray(ub, np.float64)
+        self.other_ub = np.asarray(other_ub, np.float64)
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.idx)
+
+    def survivors(self, theta: float) -> "QueryPlan":
+        """Blocks whose docs could still reach the top-k given score floor
+        theta. Sound: a doc in a dropped block scores at most ub + other_ub
+        < theta, so it provably cannot enter the final top-k. The small
+        slack absorbs f32-vs-f64 rounding between device scores and host
+        bounds."""
+        if not np.isfinite(theta):
+            return self
+        keep = (self.ub + self.other_ub) >= (theta - 1e-4)
+        return QueryPlan(self.idx[keep], self.w[keep], self.ub[keep],
+                         self.other_ub[keep])
+
+    def top_by_ub(self, m: int) -> "QueryPlan":
+        if self.n_blocks <= m:
+            return self
+        order = np.argsort(-self.ub, kind="stable")[:m]
+        return QueryPlan(self.idx[order], self.w[order], self.ub[order],
+                         self.other_ub[order])
+
+
+# doc-space granularity of the range-partitioned WAND bound: other-term
+# maxima are tracked per GRID-doc cell, so a stopword block only inherits a
+# rare term's bound if the rare term actually has postings in the block's
+# doc range (BMW's aligned block maxima, re-expressed on a fixed grid for
+# vectorized host planning)
+WAND_GRID = 256
+
+
+class _RangeMax:
+    """Sparse-table max over a per-term coarse doc-range array: build
+    O(R log R), vectorized O(1) range-max queries."""
+
+    def __init__(self, cell_ub: np.ndarray):
+        self.levels = [cell_ub]
+        r = len(cell_ub)
+        span = 1
+        while span * 2 <= r:
+            prev = self.levels[-1]
+            self.levels.append(np.maximum(prev[: r - span * 2 + 1],
+                                          prev[span : r - span + 1]))
+            span *= 2
+
+    def query(self, lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+        """Elementwise max over cells [lo_i, hi_i] (inclusive, lo <= hi)."""
+        length = hi - lo + 1
+        j = np.maximum(np.int64(np.log2(np.maximum(length, 1))), 0)
+        out = np.zeros(len(lo), np.float64)
+        for jj in np.unique(j):
+            lvl = self.levels[min(int(jj), len(self.levels) - 1)]
+            m = j == jj
+            span = 1 << min(int(jj), len(self.levels) - 1)
+            a = lvl[np.minimum(lo[m], len(lvl) - 1)]
+            b_ = lvl[np.minimum(np.maximum(hi[m] - span + 1, 0),
+                                len(lvl) - 1)]
+            out[m] = np.maximum(a, b_)
+        return out
+
+
+class TermCellIndex:
+    """Per-term posting-level WAND bound index, built lazily once per term.
+
+    For a term, records which WAND_GRID-doc cells hold any of its postings
+    and the max impact within each (exact, from true tfs and doc lengths),
+    compressed to touched cells with a sparse table for O(1) range-max.
+    Query-independent: multiply by idf*boost at query time."""
+
+    def __init__(self, block_docs: np.ndarray, block_tfs: np.ndarray,
+                 doc_lens: np.ndarray, avgdl: float,
+                 k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+        self.block_docs = block_docs
+        self.block_tfs = block_tfs
+        self.doc_lens = doc_lens
+        self.avgdl = max(avgdl, 1e-9)
+        self.k1 = k1
+        self.b = b
+        self._cache: dict = {}
+
+    def term_cells(self, start: int, count: int):
+        """(touched cells ascending [int64], RangeMax over their impacts)."""
+        got = self._cache.get(start)
+        if got is not None:
+            return got
+        docs = self.block_docs[start : start + count].reshape(-1)
+        tfs = self.block_tfs[start : start + count].reshape(-1)
+        valid = docs >= 0
+        d = docs[valid].astype(np.int64)
+        f = tfs[valid].astype(np.float64)
+        dl = self.doc_lens[d]
+        norm = self.k1 * (1.0 - self.b + self.b * dl / self.avgdl)
+        imp = f / np.maximum(f + norm, 1e-9)
+        cells = d // WAND_GRID               # ascending: docs are sorted
+        uniq, first = np.unique(cells, return_index=True)
+        cmax = np.maximum.reduceat(imp, first) if len(first) else \
+            np.zeros(0, np.float64)
+        got = (uniq, _RangeMax(cmax))
+        self._cache[start] = got
+        return got
+
+
+def build_query_plan(terms_with_weights, term_blocks_fn, block_max_impact,
+                     block_min_doc, block_max_doc,
+                     cell_index: Optional[TermCellIndex] = None,
+                     k1: float = DEFAULT_K1) -> QueryPlan:
+    """Shared host prep for the pruned BM25 path.
+
+    terms_with_weights: [(term, idf*boost)];
+    term_blocks_fn(term) -> (start, count) into the block arrays;
+    block_max_impact: f32 [n_blocks] (PostingsField.block_max_impact);
+    block_min_doc/block_max_doc: int32 [n_blocks] doc range per block.
+
+    other_ub for a block is the sum, over the query's OTHER terms, of that
+    term's max possible contribution among its actual postings within the
+    block's doc range (via cell_index) — the aligned block-max WAND bound.
+    Cell granularity only loosens the bound (still sound). Without a
+    cell_index the bound falls back to the terms' global maxima."""
+    per_term = []     # (start, count, weight, bounds, cell_lo, cell_hi)
+    for term, weight in terms_with_weights:
+        start, count = term_blocks_fn(term)
+        if count == 0:
+            continue
+        impacts = block_max_impact[start : start + count]
+        bounds = weight * (k1 + 1.0) * impacts.astype(np.float64)
+        mins = np.maximum(block_min_doc[start : start + count], 0)
+        maxs = np.maximum(block_max_doc[start : start + count], 0)
+        per_term.append((start, count, weight, bounds,
+                         mins // WAND_GRID, maxs // WAND_GRID))
+    if not per_term:
+        return QueryPlan([], [], [], [])
+
+    idx_parts = []
+    w_parts = []
+    ub_parts = []
+    other_parts = []
+    for t_i, (start, count, weight, bounds, c_lo, c_hi) in enumerate(per_term):
+        idx_parts.append(np.arange(start, start + count, dtype=np.int32))
+        w_parts.append(np.full(count, weight, np.float32))
+        ub_parts.append(bounds)
+        o = np.zeros(count, np.float64)
+        for t_j, (s_j, cnt_j, w_j, bounds_j, _lo, _hi) in enumerate(per_term):
+            if t_j == t_i:
+                continue
+            if cell_index is None:
+                o += float(bounds_j.max())
+                continue
+            cells_j, table_j = cell_index.term_cells(s_j, cnt_j)
+            lo = np.searchsorted(cells_j, c_lo, side="left")
+            hi = np.searchsorted(cells_j, c_hi, side="right") - 1
+            has = hi >= lo
+            if has.any():
+                contrib = np.zeros(count, np.float64)
+                contrib[has] = table_j.query(lo[has], hi[has]) \
+                    * (w_j * (k1 + 1.0))
+                o += contrib
+        other_parts.append(o)
+    return QueryPlan(np.concatenate(idx_parts), np.concatenate(w_parts),
+                     np.concatenate(ub_parts), np.concatenate(other_parts))
+
+
+def pad_plans(plans, qb_pad: int):
+    """Stack per-query plans into [Q, qb_pad] gather arrays (block 0 with
+    weight 0 as padding — contributes nothing)."""
+    q = len(plans)
+    idx = np.zeros((q, qb_pad), np.int32)
+    w = np.zeros((q, qb_pad), np.float32)
+    for i, p in enumerate(plans):
+        n = min(p.n_blocks, qb_pad)
+        idx[i, :n] = p.idx[:n]
+        w[i, :n] = p.w[:n]
+    return idx, w
+
+
 class Bm25Executor:
     """Per-(segment, field) BM25 query executor with host-side query prep."""
 
@@ -125,3 +354,65 @@ class Bm25Executor:
                          jnp.asarray(block_idx), jnp.asarray(block_w),
                          self.dev.doc_lens, jnp.float32(self.dev.avgdl),
                          live, self.dev.n_docs_pad, k, k1=k1, b=b)
+
+    def top_k_batch(self, queries, live: jnp.ndarray, k: int,
+                    boost: float = 1.0, df_override=None,
+                    k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+                    prune: bool = True):
+        """Batched, block-max-pruned BM25 over Q queries (each a term list).
+
+        Two phases, each ONE device dispatch for the whole batch:
+          1. score only each query's P1_BUCKET highest-upper-bound blocks;
+             the k-th partial score is a floor (theta) on the true k-th
+             score — partial sums only underestimate;
+          2. re-score exactly, but only blocks whose WAND bound
+             (ub + other-term bounds) clears theta. Zipfian stopword
+             blocks never get gathered — this is where the HBM-traffic
+             saving is (TopDocsCollectorContext.java:215's block-max WAND
+             early termination, re-expressed as static-shape phases).
+        Returns (scores [Q, k], doc ids [Q, k]); also records
+        last_prune_stats = (blocks_total, blocks_scored)."""
+        cells_key = (k1, b)
+        cache = getattr(self, "_wand_cache", None)
+        if cache is None or cache[0] != cells_key:
+            # per-block doc ranges + per-term cell index for the aligned
+            # WAND bound (within a term, blocks are doc-sorted; entry 0 of
+            # every block is always valid)
+            hp = self.host
+            avgdl = float(hp.sum_doc_len / max(1, (hp.doc_lens > 0).sum()))
+            cache = (cells_key,
+                     hp.block_docs[:, 0], hp.block_docs.max(axis=1),
+                     TermCellIndex(hp.block_docs, hp.block_tfs, hp.doc_lens,
+                                   avgdl, k1=k1, b=b))
+            self._wand_cache = cache
+        _, bmin, bmax, cell_index = cache
+        plans = []
+        for terms in queries:
+            tw = self.query_weights(terms, boost, df_override)
+            plans.append(build_query_plan(
+                tw, self.host.term_blocks,
+                self.host.block_max_impact(k1, b), bmin, bmax,
+                cell_index, k1=k1))
+        total_blocks = sum(p.n_blocks for p in plans)
+        args = (self.dev.block_docs, self.dev.block_tfs)
+        tail = (self.dev.doc_lens, jnp.float32(self.dev.avgdl), live,
+                self.dev.n_docs_pad, k)
+        qb_pad = qb_bucket(max((p.n_blocks for p in plans), default=1))
+        if not prune or qb_pad <= P1_BUCKET:
+            idx, w = pad_plans(plans, qb_pad)
+            self.last_prune_stats = (total_blocks, total_blocks)
+            return bm25_topk_batch(*args, jnp.asarray(idx), jnp.asarray(w),
+                                   *tail, k1=k1, b=b)
+        p1 = [p.top_by_ub(P1_BUCKET) for p in plans]
+        idx1, w1 = pad_plans(p1, P1_BUCKET)
+        s1, _ = bm25_topk_batch(*args, jnp.asarray(idx1), jnp.asarray(w1),
+                                *tail, k1=k1, b=b)
+        theta = np.asarray(s1)[:, k - 1]          # -inf when < k matches
+        p2 = [p.survivors(float(t)) for p, t in zip(plans, theta)]
+        scored = sum(p.n_blocks for p in p2)
+        p1_cost = sum(p.n_blocks for p in p1)
+        self.last_prune_stats = (total_blocks, scored + p1_cost)
+        qb2 = qb_bucket(max((p.n_blocks for p in p2), default=1))
+        idx2, w2 = pad_plans(p2, qb2)
+        return bm25_topk_batch(*args, jnp.asarray(idx2), jnp.asarray(w2),
+                               *tail, k1=k1, b=b)

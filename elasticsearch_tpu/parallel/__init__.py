@@ -3,8 +3,10 @@ from elasticsearch_tpu.parallel.sharded_search import (
     ShardedTextIndex,
     ShardedVectorIndex,
     make_sharded_bm25,
+    make_sharded_bm25_batch,
     make_sharded_hybrid,
     make_sharded_knn,
+    to_original_ids,
 )
 
 __all__ = [
@@ -12,8 +14,10 @@ __all__ = [
     "ShardedVectorIndex",
     "make_mesh",
     "make_sharded_bm25",
+    "make_sharded_bm25_batch",
     "make_sharded_hybrid",
     "make_sharded_knn",
     "replicated",
     "shard_spec",
+    "to_original_ids",
 ]

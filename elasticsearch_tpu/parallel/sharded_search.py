@@ -17,7 +17,11 @@ Layouts (S = number of shards on the mesh axis):
   postings: block_docs/tfs [S, NB, BLOCK] sharded on axis 0; local doc ids
   vectors:  matrix [S, N, D] sharded on axis 0
   queries:  [B, ...] sharded on 'dp'
-Global doc id = shard_idx * N_per_shard + local id.
+Docs are placed round-robin (doc g -> shard g % S, local g // S) so load
+balances regardless of pow2 padding (the murmur3-routing analog for a
+monotonically-assigned corpus). Inside a program a doc is addressed by its
+mesh-global id shard_idx * N_per_shard + local; search APIs translate back
+to original corpus ids before returning (to_original_ids).
 """
 
 from __future__ import annotations
@@ -33,7 +37,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from elasticsearch_tpu.index.segment import BLOCK, next_pow2
-from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1, idf as idf_fn
+from elasticsearch_tpu.ops.bm25 import (
+    DEFAULT_B, DEFAULT_K1, P1_BUCKET, QueryPlan, TermCellIndex,
+    build_query_plan, idf as idf_fn, pad_plans, qb_bucket,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -85,6 +92,15 @@ def _local_knn_scores(m, norms, valid, queries, similarity: str):
     else:
         raise ValueError(f"unknown similarity {similarity!r}")
     return jnp.where(valid[None, :], scores, -jnp.inf)
+
+
+def to_original_ids(ids, n_shards: int, n_per_shard: int):
+    """Mesh-global ids (shard*per + local) -> original corpus ids under the
+    round-robin placement; -1 (empty slot) passes through."""
+    ids = np.asarray(ids)
+    return np.where(ids >= 0,
+                    (ids % n_per_shard) * n_shards + ids // n_per_shard,
+                    -1)
 
 
 def _topk_padded(scores, k: int):
@@ -169,10 +185,9 @@ class ShardedVectorIndex:
         mat = np.zeros((n_shards, per, d), np.float32)
         valid = np.zeros((n_shards, per), bool)
         for s in range(n_shards):
-            lo, hi = s * per, min((s + 1) * per, n)
-            if hi > lo:
-                mat[s, : hi - lo] = vectors[lo:hi]
-                valid[s, : hi - lo] = True
+            orig = np.arange(s, n, n_shards)     # round-robin placement
+            mat[s, : len(orig)] = vectors[orig]
+            valid[s, : len(orig)] = True
         norms = np.linalg.norm(mat, axis=2).astype(np.float32)
         self.matrix = jax.device_put(mat, NamedSharding(mesh, P("shard", None, None)))
         self.norms = jax.device_put(norms, NamedSharding(mesh, P("shard", None)))
@@ -199,7 +214,8 @@ class ShardedVectorIndex:
         q = jax.device_put(jnp.asarray(q),
                            NamedSharding(self.mesh, P("dp", None)))
         s, i = fn(self.matrix, self.norms, self.valid, q)
-        return s[:b], i[:b]
+        return s[:b], to_original_ids(i[:b], self.mesh.shape["shard"],
+                                      self.n_per_shard)
 
 
 # ---------------------------------------------------------------------------
@@ -225,6 +241,47 @@ def make_sharded_bm25(mesh: Mesh, n_per_shard: int, k: int,
         local_search, mesh=mesh,
         in_specs=(P("shard", None, None), P("shard", None, None),
                   P("shard", None), P(), P("shard", None), P("shard", None)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def make_sharded_bm25_batch(mesh: Mesh, n_per_shard: int, k: int,
+                            k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+    """Compile the BATCHED distributed BM25 program: Q queries per dispatch
+    (the knn batched-program analog — BM25 was previously dispatch-bound at
+    one compiled call per query).
+
+    fn(block_docs [S,NB,BLOCK], block_tfs [S,NB,BLOCK], doc_lens [S,N],
+       avgdl scalar, block_idx [S,Q,QB], block_w [S,Q,QB])
+    -> (scores [Q,k], global ids [Q,k])
+    """
+
+    def local_search(block_docs, block_tfs, doc_lens, avgdl,
+                     block_idx, block_w):
+        def one(bi, bw):
+            return _local_bm25_scores(block_docs[0], block_tfs[0],
+                                      doc_lens[0], avgdl, bi, bw,
+                                      n_per_shard, k1, b)
+        scores = jax.vmap(one)(block_idx[0], block_w[0])       # [Q, N]
+        local_s, local_i = _topk_padded(scores, k)             # [Q, k]
+        shard_idx = jax.lax.axis_index("shard")
+        global_i = jnp.where(jnp.isfinite(local_s),
+                             local_i + shard_idx * n_per_shard, -1)
+        all_s = jax.lax.all_gather(local_s, "shard", axis=0)   # [S, Q, k]
+        all_i = jax.lax.all_gather(global_i, "shard", axis=0)
+        S, Q = all_s.shape[0], all_s.shape[1]
+        flat_s = jnp.transpose(all_s, (1, 0, 2)).reshape(Q, S * k)
+        flat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(Q, S * k)
+        g_s, pos = jax.lax.top_k(flat_s, k)
+        return g_s, jnp.take_along_axis(flat_i, pos, axis=1)
+
+    fn = shard_map(
+        local_search, mesh=mesh,
+        in_specs=(P("shard", None, None), P("shard", None, None),
+                  P("shard", None), P(), P("shard", None, None),
+                  P("shard", None, None)),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -257,7 +314,7 @@ class ShardedTextIndex:
         shard_postings: List[Dict[str, Dict[int, int]]] = [dict() for _ in range(n_shards)]
         doc_lens = np.zeros((n_shards, per), np.float32)
         for g, terms in enumerate(docs_terms):
-            s, local = divmod(g, per)
+            s, local = g % n_shards, g // n_shards   # round-robin placement
             doc_lens[s, local] = len(terms)
             seen = set()
             for t in terms:
@@ -301,8 +358,25 @@ class ShardedTextIndex:
         self.doc_lens = jax.device_put(doc_lens, NamedSharding(mesh, P("shard", None)))
         total_len = float(doc_lens.sum())
         self.avgdl = total_len / max(1, n)
+        # per-shard block-max impact bounds for WAND pruning (host-side,
+        # default similarity params — PostingsField.block_max_impact analog)
+        self._impacts = np.zeros((n_shards, nb_max), np.float32)
+        for s in range(n_shards):
+            v = bd[s] >= 0
+            dl = doc_lens[s][np.where(v, bd[s], 0)]
+            norm = DEFAULT_K1 * (1.0 - DEFAULT_B + DEFAULT_B * dl /
+                                 max(self.avgdl, 1e-9))
+            x = np.where(v, bt[s] / np.maximum(bt[s] + norm, 1e-9), 0.0)
+            self._impacts[s] = x.max(axis=1)
+        self._block_min = bd[:, :, 0]            # [S, NB] doc-range lows
+        self._block_max = bd.max(axis=2)         # [S, NB] doc-range highs
+        self._cell_indexes = [
+            TermCellIndex(bd[s], bt[s], doc_lens[s], self.avgdl)
+            for s in range(n_shards)]
         self.qb_bucket_min = qb_bucket_min
         self._compiled: Dict[Tuple[int, int], callable] = {}
+        self._compiled_batch: Dict[int, callable] = {}
+        self.last_prune_stats: Tuple[int, int] = (0, 0)
 
     def prep_query(self, terms: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
         """Host prep: per-shard gather indices + per-block weights from
@@ -339,9 +413,77 @@ class ShardedTextIndex:
             fn = make_sharded_bm25(self.mesh, self.n_per_shard, k)
             self._compiled[key] = fn
         sh = NamedSharding(self.mesh, P("shard", None))
+        s, i = fn(self.block_docs, self.block_tfs, self.doc_lens,
+                  jnp.float32(self.avgdl),
+                  jax.device_put(idx, sh), jax.device_put(w, sh))
+        return s, to_original_ids(i, self.n_shards, self.n_per_shard)
+
+    # -- batched + block-max-pruned path ------------------------------------
+
+    def _plans(self, terms: Sequence[str]) -> List[QueryPlan]:
+        """One WAND block plan per shard for one query (global idf)."""
+        tw = []
+        for t in dict.fromkeys(terms):          # dedupe, keep order
+            df = self.df.get(t, 0)
+            if df > 0:
+                tw.append((t, idf_fn(self.n_docs, df)))
+        out = []
+        for s in range(self.n_shards):
+            out.append(build_query_plan(
+                tw, lambda t, s=s: self.term_index[s].get(t, (0, 0)),
+                self._impacts[s], self._block_min[s], self._block_max[s],
+                self._cell_indexes[s]))
+        return out
+
+    def _batch_fn(self, k: int):
+        fn = self._compiled_batch.get(k)
+        if fn is None:
+            fn = make_sharded_bm25_batch(self.mesh, self.n_per_shard, k)
+            self._compiled_batch[k] = fn
+        return fn
+
+    def _run_batch(self, fn, plans: List[List[QueryPlan]], qb_pad: int):
+        """plans[q][s] -> one batched dispatch over all (query, shard)."""
+        n_q = len(plans)
+        idx = np.zeros((self.n_shards, n_q, qb_pad), np.int32)
+        w = np.zeros((self.n_shards, n_q, qb_pad), np.float32)
+        for q, per_shard in enumerate(plans):
+            for s, p in enumerate(per_shard):
+                idx[s, q, : p.n_blocks] = p.idx
+                w[s, q, : p.n_blocks] = p.w
+        sh = NamedSharding(self.mesh, P("shard", None, None))
         return fn(self.block_docs, self.block_tfs, self.doc_lens,
                   jnp.float32(self.avgdl),
                   jax.device_put(idx, sh), jax.device_put(w, sh))
+
+    def search_batch(self, queries: Sequence[Sequence[str]], k: int,
+                     prune: bool = True):
+        """Q queries -> (scores [Q,k], global doc ids [Q,k]) in two device
+        dispatches (phase-1 theta + phase-2 exact over surviving blocks).
+        See ops/bm25.py Bm25Executor.top_k_batch for the soundness
+        argument; here phase-1 theta comes from the GLOBAL top-k across
+        shards, so pruning tightens with every shard's evidence."""
+        plans = [self._plans(t) for t in queries]
+        fn = self._batch_fn(k)
+        total = sum(p.n_blocks for per in plans for p in per)
+        qb_max = max((p.n_blocks for per in plans for p in per), default=1)
+        qb_pad = qb_bucket(max(qb_max, 1))
+        if not prune or qb_pad <= P1_BUCKET:
+            self.last_prune_stats = (total, total)
+            s, i = self._run_batch(fn, plans, qb_pad)
+            return s, to_original_ids(i, self.n_shards, self.n_per_shard)
+        p1 = [[p.top_by_ub(P1_BUCKET) for p in per] for per in plans]
+        s1, _ = self._run_batch(fn, p1, P1_BUCKET)
+        theta = np.asarray(s1)[:, k - 1]
+        p2 = [[p.survivors(float(theta[q])) for p in per]
+              for q, per in enumerate(plans)]
+        scored = sum(p.n_blocks for per in p2 for p in per)
+        p1_cost = sum(p.n_blocks for per in p1 for p in per)
+        self.last_prune_stats = (total, scored + p1_cost)
+        qb2_max = max((p.n_blocks for per in p2 for p in per), default=1)
+        qb2 = qb_bucket(max(qb2_max, 1))
+        s, i = self._run_batch(fn, p2, qb2)
+        return s, to_original_ids(i, self.n_shards, self.n_per_shard)
 
 
 # ---------------------------------------------------------------------------

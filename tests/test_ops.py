@@ -11,7 +11,7 @@ from elasticsearch_tpu.ops import (
     Bm25Executor, DeviceFeatures, DevicePostings, DeviceVectors, KnnExecutor,
     SparseExecutor, device_live_mask, idf, knn_topk_batch, linear_fuse, rrf_fuse,
 )
-from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1
+from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1, P1_BUCKET
 
 
 MAPPING = {
@@ -33,7 +33,10 @@ def bm25_oracle(docs_terms, query_terms, k1=DEFAULT_K1, b=DEFAULT_B):
         df = sum(1 for d in docs_terms if t in d)
         if df == 0:
             continue
-        w = np.log(1 + (N - df + 0.5) / (df + 0.5))
+        # a term repeated in the query is a repeated clause (ES match
+        # semantics): its contribution scales with query multiplicity
+        qtf = query_terms.count(t)
+        w = qtf * np.log(1 + (N - df + 0.5) / (df + 0.5))
         for i, d in enumerate(docs_terms):
             tf = d.count(t)
             if tf:
@@ -272,3 +275,66 @@ def test_linear_fusion():
 def test_idf_formula():
     assert idf(1000, 10) == pytest.approx(np.log(1 + 990.5 / 10.5))
     assert idf(10, 10) > 0  # never negative (ES BM25 property)
+
+
+def _zipf_corpus(rng, n_docs=900, n_terms=60):
+    """Zipfian corpus: t0/t1 are stopword-common (many posting blocks),
+    high-numbered terms are rare — the shape block-max pruning exists for."""
+    svc = MapperService(MAPPING)
+    b = SegmentBuilder("s", svc)
+    docs_terms = []
+    for i in range(n_docs):
+        n = int(rng.integers(4, 16))
+        terms = [f"t{min(int(rng.zipf(1.3)) - 1, n_terms - 1)}"
+                 for _ in range(n)]
+        docs_terms.append(terms)
+        b.add(svc.parse_document(str(i), {"body": " ".join(terms)}), seqno=i)
+    return b.build(), docs_terms
+
+
+def test_bm25_batch_matches_single(rng):
+    seg, docs_terms, _ = build_corpus(rng)
+    dev = DevicePostings.for_segment(seg, "body")
+    live = device_live_mask(seg)
+    ex = Bm25Executor(dev, seg.postings["body"])
+    queries = [["t1", "t7"], ["t3"], ["zzz_nope"], ["t5", "t9", "t12"]]
+    bs, bd = ex.top_k_batch(queries, live, k=8, prune=False)
+    for q, terms in enumerate(queries):
+        ss, sd = ex.top_k(terms, live, k=8)
+        np.testing.assert_allclose(np.asarray(bs)[q], np.asarray(ss),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_bm25_pruned_exact_parity(rng):
+    """Block-max pruning must return EXACTLY the unpruned top-k scores —
+    it is an early-termination optimization, not an approximation."""
+    seg, docs_terms = _zipf_corpus(rng)
+    dev = DevicePostings.for_segment(seg, "body")
+    live = device_live_mask(seg)
+    ex = Bm25Executor(dev, seg.postings["body"])
+    queries = [["t0", "t25", "t40"], ["t0", "t1"], ["t50"],
+               ["t2", "t30"], ["t0", "t0", "t33"]]
+    ps, pd = ex.top_k_batch(queries, live, k=10, prune=True)
+    us, ud = ex.top_k_batch(queries, live, k=10, prune=False)
+    np.testing.assert_allclose(np.asarray(ps), np.asarray(us),
+                               rtol=1e-5, atol=1e-6)
+    # and the oracle agrees on the top scores
+    for q, terms in enumerate(queries):
+        oracle = bm25_oracle(docs_terms, terms)
+        want = np.sort(oracle[oracle > 0])[::-1][:10]
+        got = np.asarray(ps)[q]
+        got = got[np.isfinite(got)]
+        np.testing.assert_allclose(got, want[: len(got)], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_bm25_pruning_actually_prunes(rng):
+    seg, docs_terms = _zipf_corpus(rng, n_docs=20000)
+    dev = DevicePostings.for_segment(seg, "body")
+    live = device_live_mask(seg)
+    ex = Bm25Executor(dev, seg.postings["body"])
+    # rare term dominates theta; the stopword's many blocks get skipped
+    ex.top_k_batch([["t0", "t55"]], live, k=5, prune=True)
+    total, scored = ex.last_prune_stats
+    assert total > P1_BUCKET            # the corpus really is multi-block
+    assert scored < total               # and pruning really skipped some

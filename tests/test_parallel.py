@@ -7,6 +7,7 @@ import jax
 
 from elasticsearch_tpu.parallel import (
     ShardedTextIndex, ShardedVectorIndex, make_mesh, make_sharded_hybrid,
+    to_original_ids,
 )
 from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1
 
@@ -109,7 +110,10 @@ def test_sharded_bm25_global_idf_consistency(rng):
 
 def test_sharded_hybrid_rrf(rng):
     mesh = make_mesh(n_shards=4, n_dp=2)
-    docs_terms = [["alpha"] if i % 3 == 0 else ["beta"] for i in range(200)]
+    # doc 3 repeats alpha -> strictly best BM25 score (tf edge), so the
+    # dual-retriever winner is deterministic under any shard layout
+    docs_terms = [["alpha", "alpha"] if i == 3 else
+                  (["alpha"] if i % 3 == 0 else ["beta"]) for i in range(200)]
     text = ShardedTextIndex(mesh, docs_terms)
     vectors = rng.normal(size=(200, 8)).astype(np.float32)
     vec = ShardedVectorIndex(mesh, vectors, "cosine",
@@ -127,7 +131,7 @@ def test_sharded_hybrid_rrf(rng):
                      jnp.float32(text.avgdl),
                      jax.device_put(bidx, sh), jax.device_put(bw, sh),
                      vec.matrix, vec.norms, vec.valid, qvec)
-    ids = np.asarray(ids)
+    ids = to_original_ids(ids, 4, text.n_per_shard)
     scores = np.asarray(scores)
     # doc 3: top kNN hit (query == its vector) and alpha match -> RRF winner
     assert ids[0] == 3
@@ -179,7 +183,7 @@ def test_sharded_hybrid_l2_and_phantom_masking(rng):
                      jax.device_put(bidx, sh), jax.device_put(bw, sh),
                      vec.matrix, vec.norms, vec.valid,
                      jnp.asarray(vectors[5]))
-    ids = np.asarray(ids)
+    ids = to_original_ids(ids, 4, text.n_per_shard)
     scores = np.asarray(scores)
     # every finite-scored id is a real doc (no padding ids >= 64, none < 0)
     finite = ids[np.isfinite(scores)]
@@ -202,3 +206,31 @@ def test_sharded_knn_k_exceeds_per_shard(rng):
     finite = ids[np.isfinite(scores)]
     assert finite.min() >= 0
     assert len(set(finite.tolist())) == len(finite)
+
+
+def test_sharded_bm25_batch_pruned_parity(rng):
+    """Batched+pruned sharded BM25 must equal the unpruned single-query
+    program exactly (pruning is early termination, not approximation)."""
+    mesh = make_mesh(n_shards=4, n_dp=2)
+    docs_terms = []
+    for i in range(40000):
+        n = int(rng.integers(3, 12))
+        docs_terms.append(
+            [f"t{min(int(rng.zipf(1.3)) - 1, 499)}" for _ in range(n)])
+    idx = ShardedTextIndex(mesh, docs_terms)
+    queries = [["t0", "t300", "t400"], ["t0", "t1"], ["t480"],
+               ["t5", "t200"]]
+    ps, pids = idx.search_batch(queries, k=10, prune=True)
+    us, uids = idx.search_batch(queries, k=10, prune=False)
+    np.testing.assert_allclose(np.asarray(ps), np.asarray(us),
+                               rtol=1e-5, atol=1e-6)
+    # a selective query (stopword + rare terms) must actually skip the
+    # stopword's blocks; stopword-only queries legitimately cannot prune
+    idx.search_batch([["t0", "t300", "t400"]], k=10, prune=True)
+    total, scored = idx.last_prune_stats
+    assert scored < total
+    # single-query program agrees too
+    for q, terms in enumerate(queries):
+        ss, sids = idx.search(terms, k=10)
+        np.testing.assert_allclose(np.asarray(ps)[q], np.asarray(ss),
+                                   rtol=1e-5, atol=1e-6)

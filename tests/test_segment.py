@@ -133,3 +133,30 @@ def test_merge_purges_deletes_and_remaps():
     # positions survive merge
     assert pf.positions_for("three", 1).tolist() == [1]
     assert merged.seqnos.tolist() == [0, 2]
+
+
+def test_postings_from_token_matrix_matches_builder():
+    """Vectorized bulk postings == per-doc SegmentBuilder postings."""
+    import numpy as np
+    from elasticsearch_tpu.index.segment import (
+        SegmentBuilder, postings_from_token_matrix,
+    )
+    from elasticsearch_tpu.mapping import MapperService
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, 30, size=(500, 9)).astype(np.int64)
+    tokens[rng.random(size=tokens.shape) < 0.2] = -1   # ragged doc lengths
+    pf = postings_from_token_matrix(tokens)
+
+    svc = MapperService({"properties": {"body": {"type": "text"}}})
+    b = SegmentBuilder("s", svc)
+    for i, row in enumerate(tokens):
+        body = " ".join(f"t{z}" for z in row if z >= 0) or "tpad"
+        b.add(svc.parse_document(str(i), {"body": body}), seqno=i)
+    ref = b.build().postings["body"]
+    for term in [f"t{i}" for i in range(30)]:
+        d1, f1 = pf.postings_for(term)
+        d2, f2 = ref.postings_for(term)
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(f1, f2)
+    assert pf.doc_freq[:30].tolist() == ref.doc_freq[
+        [ref.terms[f"t{i}"] for i in range(30)]].tolist()
