@@ -26,6 +26,7 @@ from elasticsearch_tpu.indices.cluster_state_service import (
 )
 from elasticsearch_tpu.indices.indices_service import IndicesService
 from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.mapping.mappers import _ROOT_MAPPING_KEYS
 from elasticsearch_tpu.transport.transport import Deferred, TransportService
 from elasticsearch_tpu.utils.errors import (
     IllegalArgumentError, IndexNotFoundError, NotMasterError,
@@ -45,12 +46,20 @@ STATS_SHARD = "indices:monitor/stats[s]"
 MASTER_RETRY_DELAY = 0.2
 
 
-def _validate_mappings(mappings: Dict[str, Any]) -> None:
-    """Build a throwaway MapperService exactly as the applier will
-    (indices_service.py IndexService.__init__), surfacing MapperParsingError
-    to the API caller instead of to every node's applier post-commit."""
+def _validate_mappings(mappings: Dict[str, Any],
+                       existing: Optional[Dict[str, Any]] = None
+                       ) -> MapperService:
+    """Validate a mapping update the way the appliers will consume it.
+
+    Mirrors PutMappingExecutor: build a throwaway MapperService from the
+    EXISTING mapping and merge the new one into it, so merge conflicts
+    (e.g. changing a field type text->keyword) are rejected at the API
+    instead of poisoning every node's applier post-commit. Returns the
+    merged service so put_mapping can commit its serialized form."""
+    service = MapperService(dict(existing)) if existing else MapperService()
     if mappings:
-        MapperService(dict(mappings))
+        service.merge(dict(mappings))
+    return service
 MASTER_TIMEOUT = 30.0
 
 
@@ -138,11 +147,20 @@ class MasterActions:
 
         def update(state: ClusterState) -> ClusterState:
             meta = state.metadata.index(name)
-            merged = dict(meta.mappings)
-            props = dict(merged.get("properties", {}))
-            props.update(mappings.get("properties", {}))
-            merged["properties"] = props
-            _validate_mappings(merged)   # reject before commit, not on apply
+            # merge into the EXISTING mapping the way every applier will
+            # (PutMappingExecutor): conflicts (type changes etc.) are
+            # rejected here, and the COMMITTED mapping is the serialized
+            # result of that same deep merge — so validation and commit
+            # cannot diverge (a shallow properties update would silently
+            # erase sibling sub-fields of nested objects)
+            service = _validate_mappings(mappings, existing=meta.mappings)
+            merged = service.to_mapping()
+            # root-level keys (dynamic, _source, _meta, ...) carry forward,
+            # new request winning over the existing mapping
+            for src in (meta.mappings, mappings):
+                for k, v in (src or {}).items():
+                    if k.startswith("_") or k in _ROOT_MAPPING_KEYS:
+                        merged[k] = v
             return state.next_version(metadata=state.metadata.update_index(
                 meta.with_mappings(merged)))
         return self._submit(f"put-mapping [{name}]", update)

@@ -12,12 +12,15 @@ here are already op-shaped), then mark-in-sync on the source.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, Optional
 
 from elasticsearch_tpu.cluster.routing import ShardRouting, ShardState
 from elasticsearch_tpu.cluster.state import ClusterState
 from elasticsearch_tpu.indices.indices_service import IndicesService
 from elasticsearch_tpu.transport.transport import TransportService
+
+logger = logging.getLogger(__name__)
 
 SHARD_STARTED = "cluster/shard_started"
 SHARD_FAILED = "cluster/shard_failed"
@@ -66,26 +69,53 @@ class IndicesClusterStateService:
                     self._recovering.discard((index_name, sid))
 
     def _update_index_metadata(self, state: ClusterState) -> None:
-        for index_name, service in self.indices.indices.items():
-            if state.metadata.has_index(index_name):
-                service.update_metadata(state.metadata.index(index_name))
+        # per-index isolation, like the reference reconciler: one index's
+        # bad metadata must not abort the apply pass for every other index
+        # (IndicesClusterStateService catches per-index and fails shards)
+        for index_name, service in list(self.indices.indices.items()):
+            if not state.metadata.has_index(index_name):
+                continue
+            meta = state.metadata.index(index_name)
+            try:
+                service.update_metadata(meta)
+            except Exception as e:  # noqa: BLE001 — isolate the index
+                # A node whose mapper diverged from committed metadata must
+                # not keep serving the shards: fail this node's copies
+                # LOUDLY and drop the poisoned IndexService entirely, so a
+                # reassignment back to this node rebuilds a fresh
+                # MapperService from the committed metadata instead of
+                # silently reusing the diverged one.
+                logger.error(
+                    "[%s] failed to apply mapping update on [%s]: %s",
+                    self.node_id, index_name, e)
+                for sr in state.routing_table.shards_on_node(self.node_id):
+                    if sr.index == index_name and \
+                            sr.node_id == self.node_id and \
+                            self.indices.has_shard(sr.index, sr.shard_id):
+                        self._shard_failed(
+                            sr, f"mapping update failed to apply: {e}")
+                self.indices.remove_index(index_name, delete_data=False)
 
     def _create_or_recover_shards(self, state: ClusterState) -> None:
         for sr in state.routing_table.shards_on_node(self.node_id):
             if sr.node_id != self.node_id:
                 continue   # relocation target handled via its own routing
             key = (sr.index, sr.shard_id)
-            local_exists = self.indices.has_shard(sr.index, sr.shard_id)
-            if sr.state == ShardState.INITIALIZING and not local_exists \
-                    and key not in self._recovering:
-                self._recovering.add(key)
-                self._start_recovery(state, sr)
-            elif sr.state == ShardState.STARTED and local_exists:
-                shard = self.indices.shard(sr.index, sr.shard_id)
-                term = state.metadata.index(sr.index).primary_term(sr.shard_id)
-                if sr.primary and not shard.primary:
-                    # replica promoted on failover
-                    shard.promote_to_primary(term)
+            try:
+                local_exists = self.indices.has_shard(sr.index, sr.shard_id)
+                if sr.state == ShardState.INITIALIZING and not local_exists \
+                        and key not in self._recovering:
+                    self._recovering.add(key)
+                    self._start_recovery(state, sr)
+                elif sr.state == ShardState.STARTED and local_exists:
+                    shard = self.indices.shard(sr.index, sr.shard_id)
+                    term = state.metadata.index(sr.index).primary_term(
+                        sr.shard_id)
+                    if sr.primary and not shard.primary:
+                        # replica promoted on failover
+                        shard.promote_to_primary(term)
+            except Exception as e:  # noqa: BLE001 — fail just this shard
+                self._shard_failed(sr, f"shard apply failed: {e}")
 
     # ------------------------------------------------------------------
     # recovery

@@ -412,12 +412,19 @@ class MapperService:
     def merge(self, mapping: Dict[str, Any]) -> None:
         props = mapping.get("properties")
         if props is None:
-            # bare-props convenience form: everything that looks like a
-            # field spec; root mapping keys (dynamic, _source, _meta, ...)
-            # are not fields
-            props = {k: v for k, v in mapping.items()
-                     if isinstance(v, dict) and not k.startswith("_")
-                     and k not in _ROOT_MAPPING_KEYS}
+            # bare-props convenience form: everything except known root
+            # mapping keys (dynamic, _source, _meta, ...) is a field spec.
+            # Malformed (non-dict) specs fail loudly here exactly as they
+            # would under an explicit "properties" key.
+            props = {}
+            for k, v in mapping.items():
+                if k.startswith("_") or k in _ROOT_MAPPING_KEYS:
+                    continue
+                if not isinstance(v, dict):
+                    raise MapperParsingError(
+                        f"expected map for property [{k}] but got "
+                        f"[{type(v).__name__}]")
+                props[k] = v
         self._merge_props("", props)
         if "dynamic" in mapping:
             self.dynamic = _parse_dynamic(mapping["dynamic"])
@@ -493,16 +500,18 @@ class MapperService:
             for p in parts[:-1]:
                 node = node.setdefault(p, {}).setdefault("properties", {})
             node[parts[-1]] = m.to_mapping()
-        # explicit nested containers keep their type on round-trip (the
-        # container node may not exist yet if it holds no leaf fields)
+        # container types survive the round-trip: nested always explicitly,
+        # and empty object containers too (else serialize->reparse would
+        # silently drop them and a later put_mapping could repurpose the
+        # path as a leaf field, diverging from live mappers)
         for path, kind in self._object_types.items():
-            if kind != "nested":
-                continue
             node = props
             parts = path.split(".")
             for p in parts[:-1]:
                 node = node.setdefault(p, {}).setdefault("properties", {})
-            node.setdefault(parts[-1], {})["type"] = "nested"
+            leaf = node.setdefault(parts[-1], {})
+            if kind == "nested" or not leaf:
+                leaf["type"] = kind
         return {"properties": props}
 
     def _infer(self, name: str, value: Any) -> Optional[FieldMapper]:

@@ -143,7 +143,10 @@ class QueryPlan:
         bounds."""
         if not np.isfinite(theta):
             return self
-        keep = (self.ub + self.other_ub) >= (theta - 1e-4)
+        # slack scales with |theta| so accumulated f32 scatter-add error on
+        # large scores can't unsoundly drop a block holding a true top-k doc
+        slack = max(1e-4, 1e-5 * abs(theta))
+        keep = (self.ub + self.other_ub) >= (theta - slack)
         return QueryPlan(self.idx[keep], self.w[keep], self.ub[keep],
                          self.other_ub[keep])
 

@@ -26,6 +26,7 @@ to original corpus ids before returning (to_original_ids).
 
 from __future__ import annotations
 
+from collections import Counter
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -383,11 +384,15 @@ class ShardedTextIndex:
         GLOBAL df (exact idf, no DFS round needed)."""
         per_shard_idx: List[List[int]] = [[] for _ in range(self.n_shards)]
         per_shard_w: List[List[float]] = [[] for _ in range(self.n_shards)]
-        for t in set(terms):
+        # dedupe but keep ES match semantics: a repeated query term is a
+        # repeated bool clause, so its weight scales with multiplicity (qtf)
+        # — same scoring as Bm25Executor.query_weights on the segment path
+        counts = Counter(terms)
+        for t, qtf in counts.items():
             df = self.df.get(t, 0)
             if df <= 0:
                 continue
-            w = idf_fn(self.n_docs, df)
+            w = idf_fn(self.n_docs, df) * qtf
             for s in range(self.n_shards):
                 entry = self.term_index[s].get(t)
                 if entry is None:
@@ -423,10 +428,13 @@ class ShardedTextIndex:
     def _plans(self, terms: Sequence[str]) -> List[QueryPlan]:
         """One WAND block plan per shard for one query (global idf)."""
         tw = []
-        for t in dict.fromkeys(terms):          # dedupe, keep order
+        # dedupe keeping order, weight scaled by query-term multiplicity
+        # (qtf) to match the repeated-bool-clause semantics of the segment
+        # executor (see prep_query)
+        for t, qtf in Counter(terms).items():
             df = self.df.get(t, 0)
             if df > 0:
-                tw.append((t, idf_fn(self.n_docs, df)))
+                tw.append((t, idf_fn(self.n_docs, df) * qtf))
         out = []
         for s in range(self.n_shards):
             out.append(build_query_plan(

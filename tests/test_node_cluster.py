@@ -293,3 +293,53 @@ def test_sorted_search_across_shards(cluster):
     _ok(resp, err)
     ranks = [h["_source"]["rank"] for h in resp["hits"]["hits"]]
     assert ranks == list(range(5, 15))
+
+
+def test_put_mapping_type_conflict_rejected_at_api(cluster):
+    """A put_mapping that changes an existing field's type must be rejected
+    at the API (PutMappingExecutor-style merge validation), not committed
+    and left to poison every node's cluster-state applier."""
+    client = cluster.client()
+    cluster.call(lambda cb: client.create_index(
+        "conf", {"settings": {"number_of_shards": 1,
+                              "number_of_replicas": 0},
+                 "mappings": {"properties": {
+                     "title": {"type": "text"}}}}, cb))
+    cluster.ensure_green("conf")
+
+    resp, err = cluster.call(lambda cb: client.put_mapping(
+        "conf", {"properties": {"title": {"type": "keyword"}}}, cb))
+    assert err is not None, "type-changing put_mapping must fail"
+
+    # the cluster must remain fully usable afterwards: the bad mapping was
+    # never committed, so appliers keep working and new indices still assign
+    resp, err = cluster.call(lambda cb: client.index_doc(
+        "conf", "d1", {"title": "still works"}, cb))
+    _ok(resp, err)
+    cluster.call(lambda cb: client.create_index("after", None, cb))
+    cluster.ensure_green("after")
+
+    # additive put_mapping still succeeds
+    resp, err = cluster.call(lambda cb: client.put_mapping(
+        "conf", {"properties": {"body": {"type": "text"}}}, cb))
+    _ok(resp, err)
+
+
+def test_put_mapping_nested_addition_preserves_siblings(cluster):
+    """Adding a sub-field under an object must not erase sibling sub-fields
+    in the COMMITTED metadata (deep merge, not shallow properties update)."""
+    client = cluster.client()
+    cluster.call(lambda cb: client.create_index(
+        "deep", {"settings": {"number_of_shards": 1,
+                              "number_of_replicas": 0},
+                 "mappings": {"properties": {"user": {"properties": {
+                     "name": {"type": "text"}}}}}}, cb))
+    cluster.ensure_green("deep")
+    resp, err = cluster.call(lambda cb: client.put_mapping(
+        "deep", {"properties": {"user": {"properties": {
+            "age": {"type": "long"}}}}}, cb))
+    _ok(resp, err)
+    committed = cluster.master().coordinator.applied_state \
+        .metadata.index("deep").mappings
+    props = committed["properties"]["user"]["properties"]
+    assert "name" in props and "age" in props, committed
