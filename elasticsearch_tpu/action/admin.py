@@ -323,7 +323,8 @@ class BroadcastActions:
         return {"primary": shard.primary,
                 "docs": stats.get("doc_count", 0),
                 "segments": stats.get("num_segments", 0),
-                "translog_ops": stats.get("translog_ops", 0)}
+                "translog_ops": stats.get("translog_ops", 0),
+                "search": dict(shard.search_stats)}
 
     def broadcast(self, action: str, index_expression: str,
                   on_done: Callable[[Dict[str, Any]], None],

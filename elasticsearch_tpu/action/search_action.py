@@ -129,6 +129,12 @@ class SearchTransportService:
         finally:
             if shard_task is not None:
                 self.task_manager.unregister(shard_task)
+        stats = shard.search_stats
+        stats["query_total"] += 1
+        if result.collector == "wand_topk" and result.prune_stats:
+            stats["wand_queries"] += 1
+            stats["wand_blocks_total"] += result.prune_stats[0]
+            stats["wand_blocks_scored"] += result.prune_stats[1]
         context_id = None
         if req["window"] > 0:
             # size=0 (count) searches never fetch: don't pin a reader
@@ -140,6 +146,8 @@ class SearchTransportService:
             "total": result.total_hits,
             "relation": result.total_relation,
             "max_score": result.max_score,
+            "collector": result.collector,
+            "prune": list(result.prune_stats) if result.prune_stats else None,
             "docs": [{"segment": d.segment_idx, "doc": d.doc,
                       "score": d.score, "sort": list(d.sort_values)}
                      for d in result.docs],
@@ -198,11 +206,17 @@ class TransportSearchAction:
 
     def __init__(self, node_id: str, ts: TransportService,
                  state_supplier: Callable[[], ClusterState],
-                 task_manager=None):
+                 task_manager=None, indices: Optional[IndicesService] = None,
+                 mesh_plane=None):
         self.node_id = node_id
         self.ts = ts
         self.state = state_supplier
         self.task_manager = task_manager
+        # SPMD fast path (parallel/mesh_plane.py): when this node drives a
+        # multi-device mesh and holds every shard of the index, eligible
+        # queries run as ONE compiled program instead of the RPC fan-out
+        self.indices = indices
+        self.mesh_plane = mesh_plane
         self._rr = 0
 
     # ------------------------------------------------------------------
@@ -283,6 +297,10 @@ class TransportSearchAction:
             "task_id": task.task_id if task is not None else None,
         }
 
+        if self._try_mesh_path(t0, indices, targets, body, window, from_,
+                               size, phase_state, on_done):
+            return
+
         def after_can_match(live_targets: List[Dict[str, Any]]) -> None:
             if not live_targets:
                 on_done(self._finalize(t0, [], body, phase_state,
@@ -302,6 +320,63 @@ class TransportSearchAction:
                                   None)
 
         self._can_match_phase(targets, body, phase_state, after_can_match)
+
+    # -- mesh one-program path ------------------------------------------
+
+    def _try_mesh_path(self, t0, indices, targets, body, window, from_,
+                       size, phase_state, on_done) -> bool:
+        """Route the whole-index query through the SPMD mesh program when
+        possible (parallel/mesh_plane.py); True = handled. Conditions: one
+        index, every shard locally present, eligible query shape, mesh
+        available. Any failure falls back to the RPC scatter-gather."""
+        if self.mesh_plane is None or self.indices is None:
+            return False
+        if len(indices) != 1:
+            return False
+        from elasticsearch_tpu.parallel.mesh_plane import mesh_eligible
+        field = mesh_eligible(body)
+        if field is None or not self.mesh_plane.available:
+            return False
+        index = indices[0]
+        shards: Dict[int, Any] = {}
+        for target in targets:
+            if target["index"] != index or \
+                    not self.indices.has_shard(index, target["shard"]):
+                return False
+            shards[target["shard"]] = self.indices.shard(
+                index, target["shard"])
+        try:
+            mappers = self.indices.index_service(index).mapper_service
+            if mappers.field_type(field) not in ("text",
+                                                 "search_as_you_type"):
+                return False
+            hits = self.mesh_plane.search_text(index, field, shards, body,
+                                               mappers)
+        except Exception:  # noqa: BLE001 — RPC path reports real errors
+            return False
+        if hits is None:
+            return False
+        phase_state["data_plane"] = "mesh"
+        # synthesize per-shard query results so merge+fetch run unchanged
+        # (the mesh program already IS the global merge; per-shard splits
+        # only route the fetch phase)
+        by_shard: Dict[int, List[Dict[str, Any]]] = {}
+        for h in hits[:window]:
+            by_shard.setdefault(h["shard"], []).append(
+                {"segment": h["segment"], "doc": h["doc"],
+                 "score": h["score"], "sort": h["sort"]})
+        results: List[Optional[Dict[str, Any]]] = []
+        for target in targets:
+            target["node"] = self.node_id    # fetch runs locally
+            docs = by_shard.get(target["shard"], [])
+            results.append({
+                "context_id": None, "total": len(docs),
+                "relation": "gte",
+                "max_score": max((d["score"] for d in docs), default=None),
+                "docs": docs})
+        self._merge_and_fetch(t0, targets, results, body, from_, size,
+                              phase_state, len(targets), on_done)
+        return True
 
     # -- can_match ------------------------------------------------------
 
@@ -528,6 +603,8 @@ class TransportSearchAction:
                  if r is not None])
         if phase_state["failures"]:
             resp["_shards"]["failures"] = phase_state["failures"]
+        if phase_state.get("data_plane"):
+            resp["_data_plane"] = phase_state["data_plane"]
         return resp
 
     def _empty_response(self, t0, n_shards) -> Dict[str, Any]:

@@ -65,6 +65,11 @@ class IndexShard:
         if primary:
             self._enter_primary_mode()
         self._global_checkpoint_replica = -1
+        # shard-level search stats (index/search/stats/SearchStats analog);
+        # wand_* track the pruned collector's block-skipping effectiveness
+        self.search_stats: Dict[str, int] = {
+            "query_total": 0, "wand_queries": 0,
+            "wand_blocks_total": 0, "wand_blocks_scored": 0}
 
     def _enter_primary_mode(self) -> None:
         self.primary = True

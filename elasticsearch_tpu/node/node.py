@@ -53,7 +53,8 @@ class Node:
                  roles: Optional[List[str]] = None,
                  data_path: Optional[str] = None,
                  initial_state: Optional[ClusterState] = None,
-                 coordinator_settings: Optional[CoordinatorSettings] = None):
+                 coordinator_settings: Optional[CoordinatorSettings] = None,
+                 mesh_data_plane: bool = False):
         self.node_id = node_id
         self.scheduler = scheduler
         self.discovery_node = DiscoveryNode(
@@ -109,9 +110,17 @@ class Node:
         self.search_transport = SearchTransportService(
             node_id, self.indices_service, self.transport_service,
             task_manager=self.task_manager)
+        self.mesh_plane = None
+        if mesh_data_plane:
+            # SPMD data plane over the local device mesh (SURVEY §5.8's
+            # two-plane split): eligible whole-index searches run as one
+            # pjit program, RPC scatter-gather stays the fallback
+            from elasticsearch_tpu.parallel.mesh_plane import MeshDataPlane
+            self.mesh_plane = MeshDataPlane()
         self.search_action = TransportSearchAction(
             node_id, self.transport_service, self._applied_state,
-            task_manager=self.task_manager)
+            task_manager=self.task_manager, indices=self.indices_service,
+            mesh_plane=self.mesh_plane)
         self.broadcast_actions = BroadcastActions(
             node_id, self.indices_service, self.transport_service,
             self._applied_state)
@@ -359,19 +368,24 @@ class NodeClient:
             on_done(None, e)
             return
 
+        search_keys = ("query_total", "wand_queries",
+                       "wand_blocks_total", "wand_blocks_scored")
+
+        def _zero() -> Dict[str, Any]:
+            return {"docs": 0, "segments": 0, "translog_ops": 0,
+                    "search": {k: 0 for k in search_keys}}
+
         def cb(r: Dict[str, Any]) -> None:
-            per_index: Dict[str, Dict[str, int]] = {
-                n: {"docs": 0, "segments": 0, "translog_ops": 0}
-                for n in names}
+            per_index: Dict[str, Dict[str, Any]] = {n: _zero() for n in names}
             for p in r.get("payloads", []):
                 if not p.get("primary"):
                     continue
-                agg = per_index.setdefault(
-                    p["index"],
-                    {"docs": 0, "segments": 0, "translog_ops": 0})
+                agg = per_index.setdefault(p["index"], _zero())
                 agg["docs"] += p.get("docs", 0)
                 agg["segments"] += p.get("segments", 0)
                 agg["translog_ops"] += p.get("translog_ops", 0)
+                for k in search_keys:
+                    agg["search"][k] += p.get("search", {}).get(k, 0)
             indices_out = {}
             total_docs = 0
             for n in names:
@@ -379,7 +393,8 @@ class NodeClient:
                 total_docs += agg["docs"]
                 prim = {"docs": {"count": agg["docs"], "deleted": 0},
                         "segments": {"count": agg["segments"]},
-                        "translog": {"operations": agg["translog_ops"]}}
+                        "translog": {"operations": agg["translog_ops"]},
+                        "search": agg["search"]}
                 indices_out[n] = {
                     "uuid": state.metadata.index(n).uuid,
                     "primaries": prim, "total": prim}

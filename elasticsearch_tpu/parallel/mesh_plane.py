@@ -1,0 +1,170 @@
+"""Mesh data plane: the SPMD one-program search path behind the node.
+
+When one node process drives a multi-device mesh (a TPU slice) and holds
+every active shard of an index locally, eligible whole-index top-k queries
+skip the per-shard RPC fan-out entirely: the corpus lives sharded over the
+mesh and the query runs as ONE pjit program — local score -> local top-k ->
+all_gather merge (parallel/sharded_search.py). This collapses the
+reference's scatter-gather (action/search/AbstractSearchAsyncAction.java:156
+fan-out + SearchPhaseController.java:160 merge) into compiled collectives
+over ICI, per SURVEY §5.8's two-plane design; the host RPC path remains the
+fallback for everything else (multi-node topologies, aggs, filters, exact
+counts).
+
+The mesh copy is rebuilt lazily per (index, field) whenever the underlying
+shard readers change (segment set or live-doc count), and is born merged:
+tombstoned docs are dropped at build time, so totals/idf reflect live docs
+only — the same scores the RPC path produces after a force-merge.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.search import dsl
+
+__all__ = ["MeshDataPlane", "mesh_eligible"]
+
+
+def mesh_eligible(body: Dict[str, Any]) -> Optional[str]:
+    """Return the match field if the request can run as one mesh program.
+
+    Mirrors choose_collector_context's WAND conditions (pure score-sorted
+    top-k text query, totals disabled) plus mesh-specific ones (no
+    highlight-independent phases that need per-shard readers during query).
+    """
+    if body.get("aggs") or body.get("aggregations") or body.get("suggest"):
+        return None
+    if body.get("sort") is not None or body.get("search_after") is not None:
+        return None
+    if body.get("min_score") is not None:
+        return None
+    if not (body.get("track_total_hits") is False
+            or body.get("track_total_hits") == 0):
+        return None
+    if int(body.get("size", 10)) <= 0:
+        return None
+    try:
+        q = dsl.parse_query(body.get("query"))
+    except Exception:  # noqa: BLE001 — let the RPC path raise the real error
+        return None
+    if not isinstance(q, dsl.Match):
+        return None
+    if q.operator == "and" or q.minimum_should_match is not None:
+        return None
+    return q.field
+
+
+class MeshDataPlane:
+    """Owns the device mesh and per-index mesh-resident search structures."""
+
+    def __init__(self, mesh=None, min_devices: int = 2):
+        self._mesh = mesh
+        self._min_devices = min_devices
+        self._tried_default = False
+        # (index, field) -> (freshness_key, ShardedTextIndex, id_map arrays)
+        self._text: Dict[Tuple[str, str], Tuple[Any, Any, Any]] = {}
+        self.stats: Dict[str, int] = {
+            "mesh_queries": 0, "mesh_builds": 0,
+            "wand_blocks_total": 0, "wand_blocks_scored": 0}
+
+    # ------------------------------------------------------------------
+
+    @property
+    def mesh(self):
+        if self._mesh is None and not self._tried_default:
+            self._tried_default = True
+            import jax
+            from jax.sharding import Mesh
+            devices = jax.devices()
+            if len(devices) >= self._min_devices:
+                self._mesh = Mesh(np.array(devices), ("shard",))
+        return self._mesh
+
+    @property
+    def available(self) -> bool:
+        return self.mesh is not None
+
+    # ------------------------------------------------------------------
+    # build / cache
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _freshness_key(readers) -> Tuple:
+        # identity of the segment set + live count per segment: any refresh,
+        # merge, or delete changes it and invalidates the mesh copy
+        return tuple(
+            (sid, tuple(id(seg) for seg in reader.segments),
+             int(sum(int(np.asarray(m).sum()) for m in reader.live_masks)))
+            for sid, reader in readers)
+
+    def _text_index(self, index_name: str, field: str, readers):
+        key = self._freshness_key(readers)
+        got = self._text.get((index_name, field))
+        if got is not None and got[0] == key:
+            return got[1], got[2]
+        from elasticsearch_tpu.parallel.sharded_search import ShardedTextIndex
+        sources = []
+        id_shard: List[int] = []
+        id_segment: List[int] = []
+        id_doc: List[int] = []
+        for sid, reader in readers:
+            for si, (seg, live) in enumerate(
+                    zip(reader.segments, reader.live_masks)):
+                sources.append((seg.postings.get(field), live, seg.n_docs))
+                id_shard.extend([sid] * seg.n_docs)
+                id_segment.extend([si] * seg.n_docs)
+                id_doc.extend(range(seg.n_docs))
+        tindex = ShardedTextIndex.from_postings_sources(self.mesh, sources)
+        id_map = (np.asarray(id_shard, np.int32),
+                  np.asarray(id_segment, np.int32),
+                  np.asarray(id_doc, np.int32))
+        self._text[(index_name, field)] = (key, tindex, id_map)
+        self.stats["mesh_builds"] += 1
+        return tindex, id_map
+
+    # ------------------------------------------------------------------
+    # search
+    # ------------------------------------------------------------------
+
+    def search_text(self, index_name: str, field: str, shards,
+                    body: Dict[str, Any], mappers
+                    ) -> Optional[List[Dict[str, Any]]]:
+        """Run the one-program path; returns per-hit dicts
+        {shard, segment, doc, score} globally sorted, or None if the field
+        isn't analyzable here (caller falls back to RPC)."""
+        if not self.available:
+            return None
+        mapper = mappers.mapper(field)
+        analyzer = getattr(mapper, "search_analyzer", None)
+        if analyzer is None:
+            return None
+        q = dsl.parse_query(body.get("query"))
+        terms = analyzer.terms(q.text)
+        if not terms:
+            return []
+        readers = [(sid, shard.engine.acquire_reader())
+                   for sid, shard in sorted(shards.items())]
+        tindex, id_map = self._text_index(index_name, field, readers)
+        want = int(body.get("size", 10)) + int(body.get("from", 0))
+        k = max(1, min(want, tindex.n_docs if tindex.n_docs else 1))
+        scores, ids = tindex.search_batch([terms], k)
+        t, g = tindex.last_prune_stats
+        self.stats["mesh_queries"] += 1
+        self.stats["wand_blocks_total"] += t
+        self.stats["wand_blocks_scored"] += g
+        s0 = np.asarray(scores[0])
+        i0 = np.asarray(ids[0])
+        out: List[Dict[str, Any]] = []
+        boost = q.boost
+        for sc, gid in zip(s0, i0):
+            if not np.isfinite(sc) or gid < 0:
+                break
+            out.append({"shard": int(id_map[0][gid]),
+                        "segment": int(id_map[1][gid]),
+                        "doc": int(id_map[2][gid]),
+                        "score": float(sc) * boost,
+                        "sort": [float(sc) * boost]})
+        return out

@@ -302,18 +302,14 @@ class ShardedTextIndex:
 
     def __init__(self, mesh: Mesh, docs_terms: Sequence[Sequence[str]],
                  qb_bucket_min: int = 8):
-        self.mesh = mesh
         n_shards = mesh.shape["shard"]
-        self.n_shards = n_shards
         n = len(docs_terms)
-        self.n_docs = n
         per = next_pow2(max(-(-n // n_shards), 1), minimum=BLOCK)
-        self.n_per_shard = per
-        self.df: Dict[str, int] = {}
 
-        # per-shard postings: term -> [(local_doc, tf)]
+        # per-shard postings: term -> {local_doc: tf}
         shard_postings: List[Dict[str, Dict[int, int]]] = [dict() for _ in range(n_shards)]
         doc_lens = np.zeros((n_shards, per), np.float32)
+        df: Dict[str, int] = {}
         for g, terms in enumerate(docs_terms):
             s, local = g % n_shards, g // n_shards   # round-robin placement
             doc_lens[s, local] = len(terms)
@@ -322,8 +318,65 @@ class ShardedTextIndex:
                 shard_postings[s].setdefault(t, {})
                 shard_postings[s][t][local] = shard_postings[s][t].get(local, 0) + 1
                 if t not in seen:
-                    self.df[t] = self.df.get(t, 0) + 1
+                    df[t] = df.get(t, 0) + 1
                     seen.add(t)
+        self._finish_init(mesh, n, per, shard_postings, doc_lens, df,
+                          qb_bucket_min)
+
+    @classmethod
+    def from_postings_sources(cls, mesh: Mesh, sources,
+                              qb_bucket_min: int = 8) -> "ShardedTextIndex":
+        """Build directly from already-indexed postings instead of raw docs.
+
+        ``sources``: ordered [(postings_field_or_None, live_mask, n_docs)]
+        — one entry per source segment, concatenated into a global doc
+        space (global id g = segment base + local doc). Tombstoned docs are
+        dropped at build time (the mesh copy is born merged), so df and
+        doc_lens reflect live docs only."""
+        obj = cls.__new__(cls)
+        n_shards = mesh.shape["shard"]
+        n = sum(n_docs for _, _, n_docs in sources)
+        per = next_pow2(max(-(-n // n_shards), 1), minimum=BLOCK)
+        shard_postings: List[Dict[str, Dict[int, int]]] = \
+            [dict() for _ in range(n_shards)]
+        doc_lens = np.zeros((n_shards, per), np.float32)
+        df: Dict[str, int] = {}
+        base = 0
+        for pf, live, n_docs in sources:
+            if pf is None or n_docs == 0:
+                base += n_docs
+                continue
+            live = np.asarray(live[:n_docs], bool)
+            g = base + np.arange(n_docs)
+            s_arr, local_arr = g % n_shards, g // n_shards
+            lens = np.where(live, pf.doc_lens[:n_docs], 0.0)
+            doc_lens[s_arr, local_arr] = lens
+            for term in pf.terms:
+                docs, tfs = pf.postings_for(term)
+                keep = live[docs]
+                docs, tfs = docs[keep], tfs[keep]
+                if len(docs) == 0:
+                    continue
+                df[term] = df.get(term, 0) + len(docs)
+                gg = base + docs
+                for gdoc, tf in zip(gg.tolist(), tfs.tolist()):
+                    sp = shard_postings[gdoc % n_shards].setdefault(term, {})
+                    sp[gdoc // n_shards] = int(tf)
+            base += n_docs
+        obj._finish_init(mesh, n, per, shard_postings, doc_lens, df,
+                         qb_bucket_min)
+        return obj
+
+    def _finish_init(self, mesh: Mesh, n: int, per: int,
+                     shard_postings: List[Dict[str, Dict[int, int]]],
+                     doc_lens: np.ndarray, df: Dict[str, int],
+                     qb_bucket_min: int) -> None:
+        self.mesh = mesh
+        n_shards = mesh.shape["shard"]
+        self.n_shards = n_shards
+        self.n_docs = n
+        self.n_per_shard = per
+        self.df = df
 
         # pack per-shard blocks; all shards padded to the same block count
         packed = []

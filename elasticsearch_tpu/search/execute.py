@@ -266,11 +266,18 @@ def _h_match_none(q: dsl.MatchNone, ctx: SegmentContext) -> Result:
 
 
 def _bm25_executor(ctx: SegmentContext, field_name: str) -> Optional[Bm25Executor]:
+    """Executor cached on the (immutable) segment so its WAND planning
+    tables (TermCellIndex / block bounds) survive across queries; the idf
+    doc count is refreshed per query since shard-level stats change as
+    sibling segments come and go."""
     dev = DevicePostings.for_segment(ctx.segment, field_name)
     if dev is None:
         return None
-    return Bm25Executor(dev, ctx.segment.postings[field_name],
-                        total_doc_count=ctx.doc_count_for_idf())
+    ex = ctx.segment.device(
+        ("bm25_exec", field_name),
+        lambda: Bm25Executor(dev, ctx.segment.postings[field_name]))
+    ex.doc_count = ctx.doc_count_for_idf()
+    return ex
 
 
 def _h_match(q: dsl.Match, ctx: SegmentContext) -> Result:

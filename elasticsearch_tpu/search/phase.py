@@ -55,6 +55,10 @@ class ShardQueryResult:
     # per-field term stats used (exposed for the coordinator's DFS merge)
     doc_count: int = 0
     dfs: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # which collector context ran (TopDocsCollectorContext analog) and, for
+    # the pruned path, (posting blocks total, posting blocks scored)
+    collector: str = "dense"
+    prune_stats: Optional[Tuple[int, int]] = None
 
 
 def parse_sort(sort_body: Any) -> List[SortSpec]:
@@ -146,6 +150,90 @@ def shard_term_stats(reader: Reader, mappers: MapperService,
     return doc_count, dfs
 
 
+def choose_collector_context(query: dsl.Query,
+                             mappers: MapperService,
+                             sort: List[SortSpec],
+                             search_after: Optional[Sequence[Any]],
+                             min_score: Optional[float],
+                             collectors: Optional[List],
+                             track_total_hits: Any,
+                             size: int) -> str:
+    """Pick the shard collector the way TopDocsCollectorContext.java:215
+    does: a pure score-sorted top-k text query with no aggregations and no
+    exact-hit-count demand runs through the block-max-pruned batched device
+    executor ("wand_topk"); everything else takes the dense score-vector
+    path ("dense").
+
+    The exact-count condition is structural on this static-shape machine:
+    the reference's collector counts hits until the threshold then starts
+    skipping, but a WAND phase that never gathers a block cannot count its
+    docs — so the pruned context requires the caller to have opted out of
+    totals (track_total_hits: false), the same setting Rally uses to
+    benchmark Lucene's WAND path."""
+    if size <= 0 or collectors or min_score is not None:
+        return "dense"
+    if search_after is not None:
+        return "dense"
+    if not (len(sort) == 1 and sort[0].field == "_score"
+            and sort[0].order == "desc"):
+        return "dense"
+    if not (track_total_hits is False or track_total_hits == 0):
+        return "dense"
+    if not isinstance(query, dsl.Match):
+        return "dense"
+    if query.operator == "and" or query.minimum_should_match is not None:
+        return "dense"
+    # only analyzed text fields score through postings; anything else falls
+    # back to term-equality semantics in the dense handler
+    if mappers.field_type(query.field) not in ("text", "search_as_you_type"):
+        return "dense"
+    return "wand_topk"
+
+
+def _wand_topk_shard(ctxs: List[SegmentContext], query: "dsl.Match",
+                     want: int, cancel_check) -> Tuple[
+                         List[ShardDoc], int, Optional[float],
+                         Tuple[int, int]]:
+    """Pruned top-k over the shard's segments via Bm25Executor.top_k_batch.
+
+    Returns (candidates, hits_found, max_score, prune_stats). hits_found is
+    a LOWER bound on matching docs (only gathered blocks are observed)."""
+    from elasticsearch_tpu.search.execute import _bm25_executor
+    candidates: List[ShardDoc] = []
+    hits = 0
+    max_score: Optional[float] = None
+    blocks_total = 0
+    blocks_scored = 0
+    for ctx in ctxs:
+        if cancel_check is not None:
+            cancel_check()
+        analyzer = ctx.search_analyzer(query.field)
+        terms = analyzer.terms(query.text)
+        if not terms:
+            continue
+        ex = _bm25_executor(ctx, query.field)
+        if ex is None:
+            continue   # field has no postings in this segment
+        k = min(max(want, 1), ctx.n_docs_pad)
+        s, d = ex.top_k_batch([terms], ctx.live, k, boost=query.boost,
+                              df_override=ctx.df_for(query.field))
+        t, g = getattr(ex, "last_prune_stats", (0, 0))
+        blocks_total += t
+        blocks_scored += g
+        s0 = np.asarray(s[0])
+        d0 = np.asarray(d[0])
+        for sc, doc in zip(s0, d0):
+            if sc == -np.inf:
+                break
+            candidates.append(
+                ShardDoc(ctx.segment_idx, int(doc), float(sc), (float(sc),)))
+            hits += 1
+            if max_score is None or sc > max_score:
+                max_score = float(sc)
+    candidates.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+    return candidates, hits, max_score, (blocks_total, blocks_scored)
+
+
 def query_shard(reader: Reader,
                 mappers: MapperService,
                 query: dsl.Query,
@@ -202,6 +290,20 @@ def query_shard(reader: Reader,
                                    doc_count_override=doc_count,
                                    df_overrides=dfs,
                                    live_override=jnp.asarray(snap)))
+    # collector-context dispatch (TopDocsCollectorContext.java:215 analog):
+    # pure score-sorted top-k text queries with totals disabled skip the
+    # dense score vector entirely and run block-max-pruned device top-k
+    collector = choose_collector_context(
+        query, mappers, sort, search_after, min_score, collectors,
+        track_total_hits, size)
+    if collector == "wand_topk":
+        candidates, hits, max_score, prune = _wand_topk_shard(
+            ctxs, query, want, cancel_check)
+        return ShardQueryResult(
+            candidates[from_: from_ + size], hits, "gte", max_score,
+            doc_count=doc_count, dfs=dfs,
+            collector="wand_topk", prune_stats=prune)
+
     # Lucene-style kNN rewrite: per-segment top-k merged to shard-global k
     from elasticsearch_tpu.search.execute import rewrite_knn
     query = rewrite_knn(query, ctxs)

@@ -20,7 +20,8 @@ from elasticsearch_tpu.transport.transport import InMemoryTransport
 
 class InProcessCluster:
     def __init__(self, n_nodes: int = 3, seed: int = 0,
-                 data_path: Optional[str] = None):
+                 data_path: Optional[str] = None,
+                 mesh_data_plane: bool = False):
         self.scheduler = DeterministicScheduler(seed=seed)
         self.transport = InMemoryTransport(self.scheduler)
         self.data_path = data_path
@@ -35,7 +36,8 @@ class InProcessCluster:
                 seed_peers=node_ids,
                 data_path=(f"{data_path}/{nid}" if data_path else None),
                 initial_state=initial,
-                coordinator_settings=CoordinatorSettings())
+                coordinator_settings=CoordinatorSettings(),
+                mesh_data_plane=mesh_data_plane)
 
     # ------------------------------------------------------------------
 

@@ -1,0 +1,119 @@
+"""Collector-context dispatch: the pruned WAND path vs the dense path.
+
+The served query phase must choose the block-max-pruned batched executor
+for pure score-sorted top-k text queries with totals disabled
+(TopDocsCollectorContext.java:215 analog), and its results must agree with
+the dense scoring path bit-for-bit on ranking.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index import InternalEngine
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import SearchService, dsl
+from elasticsearch_tpu.search.phase import (
+    choose_collector_context, parse_sort, query_shard,
+)
+
+RNG = np.random.default_rng(42)
+VOCAB = [f"w{i}" for i in range(80)]
+# zipf-ish frequencies so WAND has stopword-like blocks to prune
+WEIGHTS = 1.0 / np.arange(1, len(VOCAB) + 1)
+WEIGHTS /= WEIGHTS.sum()
+
+
+def _doc():
+    n = int(RNG.integers(5, 40))
+    return " ".join(RNG.choice(VOCAB, size=n, p=WEIGHTS))
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = InternalEngine(
+        MapperService({"properties": {"body": {"type": "text"}}}),
+        shard_label="cc")
+    for i in range(600):
+        eng.index(str(i), {"body": _doc()})
+        if i in (199, 399):
+            eng.refresh()   # multiple segments
+    eng.refresh()
+    return eng
+
+
+def _run(engine, body):
+    reader = engine.acquire_reader()
+    q = dsl.parse_query(body["query"])
+    return query_shard(
+        reader, engine.mappers, q,
+        size=body.get("size", 10),
+        sort=parse_sort(body.get("sort")),
+        track_total_hits=body.get("track_total_hits", 10_000))
+
+
+def test_chooser_picks_wand_only_when_eligible(engine):
+    mappers = engine.mappers
+    sort = parse_sort(None)
+    ok = dict(mappers=mappers, sort=sort, search_after=None, min_score=None,
+              collectors=None, track_total_hits=False, size=10)
+    q = dsl.parse_query({"match": {"body": "w3 w7"}})
+    assert choose_collector_context(q, **ok) == "wand_topk"
+    # any exact-count demand forces dense
+    assert choose_collector_context(
+        q, **{**ok, "track_total_hits": 10_000}) == "dense"
+    assert choose_collector_context(
+        q, **{**ok, "track_total_hits": True}) == "dense"
+    # aggs force dense
+    assert choose_collector_context(
+        q, **{**ok, "collectors": [object()]}) == "dense"
+    # field sort forces dense
+    assert choose_collector_context(
+        q, **{**ok, "sort": parse_sort([{"body": "asc"}])}) == "dense"
+    # operator=and forces dense
+    q_and = dsl.parse_query({"match": {"body": {"query": "w3 w7",
+                                                "operator": "and"}}})
+    assert choose_collector_context(q_and, **ok) == "dense"
+    # bool query forces dense
+    q_bool = dsl.parse_query({"bool": {"must": [{"match": {"body": "w3"}}]}})
+    assert choose_collector_context(q_bool, **ok) == "dense"
+
+
+@pytest.mark.parametrize("text", [
+    "w0 w1", "w3 w40 w77", "w10", "w0 w0 w5", "w60 w61 w62 w63",
+])
+def test_wand_parity_with_dense(engine, text):
+    body = {"query": {"match": {"body": text}}, "size": 10}
+    dense = _run(engine, body)
+    wand = _run(engine, {**body, "track_total_hits": False})
+    assert dense.collector == "dense"
+    assert wand.collector == "wand_topk"
+    assert [(d.segment_idx, d.doc) for d in wand.docs] == \
+        [(d.segment_idx, d.doc) for d in dense.docs]
+    np.testing.assert_allclose([d.score for d in wand.docs],
+                               [d.score for d in dense.docs],
+                               rtol=1e-5, atol=1e-5)
+    # the pruned path's total is a sound lower bound
+    assert wand.total_relation == "gte"
+    assert wand.total_hits <= dense.total_hits
+
+
+def test_wand_actually_prunes(engine):
+    # common + rare terms: phase-1 theta should let phase 2 skip most of
+    # the common term's blocks
+    res = _run(engine, {"query": {"match": {"body": "w0 w1 w2 w79"}},
+                        "size": 5, "track_total_hits": False})
+    assert res.prune_stats is not None
+    total, scored = res.prune_stats
+    assert total > 0
+    assert scored <= total
+
+
+def test_served_search_uses_wand_and_counts_stats(engine):
+    svc = SearchService(engine, index_name="cc")
+    resp = svc.search({"query": {"match": {"body": "w2 w9"}},
+                       "track_total_hits": False, "size": 5})
+    assert len(resp["hits"]["hits"]) == 5
+    assert resp["hits"]["total"]["relation"] == "gte"
+    dense = svc.search({"query": {"match": {"body": "w2 w9"}}, "size": 5})
+    assert [h["_id"] for h in resp["hits"]["hits"]] == \
+        [h["_id"] for h in dense["hits"]["hits"]]
