@@ -23,7 +23,8 @@ from elasticsearch_tpu.indices.indices_service import IndicesService
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.fetch import fetch_hits
 from elasticsearch_tpu.search.phase import (
-    ShardDoc, collect_query_terms, parse_sort, query_shard, shard_term_stats,
+    ShardDoc, collect_query_terms, parse_sort, query_shard,
+    shard_field_stats, shard_term_stats,
 )
 from elasticsearch_tpu.transport.transport import TransportService
 from elasticsearch_tpu.utils.errors import (
@@ -89,7 +90,9 @@ class SearchTransportService:
         query = dsl.parse_query(req.get("body", {}).get("query"))
         doc_count, dfs = shard_term_stats(reader, shard.engine.mappers,
                                           query)
-        return {"doc_count": doc_count, "dfs": dfs}
+        field_stats = shard_field_stats(reader, shard.engine.mappers, query)
+        return {"doc_count": doc_count, "dfs": dfs,
+                "field_stats": field_stats}
 
     def _on_query(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
         self._reap()
@@ -123,6 +126,7 @@ class SearchTransportService:
                 min_score=body.get("min_score"),
                 doc_count_override=req.get("doc_count_override"),
                 df_overrides=req.get("df_overrides"),
+                field_stats_overrides=req.get("field_stats_overrides"),
                 collectors=[aggregator] if aggregator else None,
                 cancel_check=(shard_task.ensure_not_cancelled
                               if shard_task else None))
@@ -419,6 +423,7 @@ class TransportSearchAction:
     def _dfs_phase(self, targets, body, next_phase):
         doc_count = {"n": 0}
         dfs: Dict[str, Dict[str, int]] = {}
+        field_stats: Dict[str, Any] = {}   # field -> [sum_doc_len, n_docs]
         pending = {"n": len(targets)}
 
         def one(target):
@@ -429,10 +434,16 @@ class TransportSearchAction:
                         agg = dfs.setdefault(field, {})
                         for term, df in termmap.items():
                             agg[term] = agg.get(term, 0) + df
+                    for field, (sum_len, n) in (
+                            resp.get("field_stats") or {}).items():
+                        cur = field_stats.setdefault(field, [0.0, 0])
+                        cur[0] += float(sum_len)
+                        cur[1] += int(n)
                 pending["n"] -= 1
                 if pending["n"] == 0:
                     next_phase({"doc_count_override": doc_count["n"],
-                                "df_overrides": dfs})
+                                "df_overrides": dfs,
+                                "field_stats_overrides": field_stats})
             self.ts.send_request(target["node"], SEARCH_DFS,
                                  {"index": target["index"],
                                   "shard": target["shard"], "body": body},

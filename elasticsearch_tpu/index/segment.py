@@ -105,19 +105,23 @@ class PostingsField:
         mask = docs >= 0
         return docs[mask], tfs[mask]
 
-    def block_max_impact(self, k1: float, b: float) -> np.ndarray:
+    def block_max_impact(self, k1: float, b: float,
+                         avgdl: float | None = None) -> np.ndarray:
         """Per-block upper bound of tf/(tf + k1*(1-b+b*dl/avgdl)) — the
         block-max WAND bound (BMW's precomputed per-block max impact;
         reference consumes it via Lucene's block-max scorers behind
         search/query/TopDocsCollectorContext.java:215). Multiplying by
         idf*boost*(k1+1) gives the max BM25 contribution any doc in the
         block can receive from its term. Exact (per-entry, using true doc
-        lengths), cached per (k1, b)."""
-        key = (float(k1), float(b))
+        lengths), cached per (k1, b, avgdl); ``avgdl`` lets a DFS
+        coordinator substitute the corpus-wide value so the bound stays
+        sound against globally-normed scores."""
+        if avgdl is None:
+            avgdl = float(self.sum_doc_len / max(1, (self.doc_lens > 0).sum()))
+        key = (float(k1), float(b), float(avgdl))
         cached = self._impact_cache.get(key)
         if cached is not None:
             return cached
-        avgdl = float(self.sum_doc_len / max(1, (self.doc_lens > 0).sum()))
         docs = self.block_docs
         tfs = self.block_tfs
         valid = docs >= 0

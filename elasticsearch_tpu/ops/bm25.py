@@ -336,32 +336,44 @@ class Bm25Executor:
             out.append((t, idf(self.doc_count, df) * boost))
         return out
 
+    def _avgdl(self, avgdl_override=None) -> float:
+        """Effective average doc length: a coordinator may override with the
+        corpus-wide value (the CollectionStatistics half of the DFS phase —
+        search/dfs/DfsPhase.java:43 ships sumTotalTermFreq/docCount so every
+        shard norms against the same global avgdl)."""
+        if avgdl_override is not None and avgdl_override > 0:
+            return float(avgdl_override)
+        return float(self.dev.avgdl)
+
     def scores(self, terms, live: jnp.ndarray, boost: float = 1.0,
-               df_override=None, k1: float = DEFAULT_K1, b: float = DEFAULT_B
-               ) -> jnp.ndarray:
+               df_override=None, k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+               avgdl_override=None) -> jnp.ndarray:
         """Dense masked scores for the query terms (used when composing
         inside bool queries)."""
         tw = self.query_weights(terms, boost, df_override)
         block_idx, block_w = gather_query_blocks(self.host, tw)
         s = bm25_block_scores(self.dev.block_docs, self.dev.block_tfs,
                               jnp.asarray(block_idx), jnp.asarray(block_w),
-                              self.dev.doc_lens, jnp.float32(self.dev.avgdl),
+                              self.dev.doc_lens,
+                              jnp.float32(self._avgdl(avgdl_override)),
                               self.dev.n_docs_pad, k1=k1, b=b)
         return jnp.where(live, s, 0.0)
 
     def top_k(self, terms, live: jnp.ndarray, k: int, boost: float = 1.0,
-              df_override=None, k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+              df_override=None, k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+              avgdl_override=None):
         tw = self.query_weights(terms, boost, df_override)
         block_idx, block_w = gather_query_blocks(self.host, tw)
         return bm25_topk(self.dev.block_docs, self.dev.block_tfs,
                          jnp.asarray(block_idx), jnp.asarray(block_w),
-                         self.dev.doc_lens, jnp.float32(self.dev.avgdl),
+                         self.dev.doc_lens,
+                         jnp.float32(self._avgdl(avgdl_override)),
                          live, self.dev.n_docs_pad, k, k1=k1, b=b)
 
     def top_k_batch(self, queries, live: jnp.ndarray, k: int,
                     boost: float = 1.0, df_override=None,
                     k1: float = DEFAULT_K1, b: float = DEFAULT_B,
-                    prune: bool = True):
+                    prune: bool = True, avgdl_override=None):
         """Batched, block-max-pruned BM25 over Q queries (each a term list).
 
         Two phases, each ONE device dispatch for the whole batch:
@@ -375,14 +387,14 @@ class Bm25Executor:
              early termination, re-expressed as static-shape phases).
         Returns (scores [Q, k], doc ids [Q, k]); also records
         last_prune_stats = (blocks_total, blocks_scored)."""
-        cells_key = (k1, b)
+        avgdl = self._avgdl(avgdl_override)
+        cells_key = (k1, b, avgdl)
         cache = getattr(self, "_wand_cache", None)
         if cache is None or cache[0] != cells_key:
             # per-block doc ranges + per-term cell index for the aligned
             # WAND bound (within a term, blocks are doc-sorted; entry 0 of
             # every block is always valid)
             hp = self.host
-            avgdl = float(hp.sum_doc_len / max(1, (hp.doc_lens > 0).sum()))
             cache = (cells_key,
                      hp.block_docs[:, 0], hp.block_docs.max(axis=1),
                      TermCellIndex(hp.block_docs, hp.block_tfs, hp.doc_lens,
@@ -394,11 +406,11 @@ class Bm25Executor:
             tw = self.query_weights(terms, boost, df_override)
             plans.append(build_query_plan(
                 tw, self.host.term_blocks,
-                self.host.block_max_impact(k1, b), bmin, bmax,
+                self.host.block_max_impact(k1, b, avgdl), bmin, bmax,
                 cell_index, k1=k1))
         total_blocks = sum(p.n_blocks for p in plans)
         args = (self.dev.block_docs, self.dev.block_tfs)
-        tail = (self.dev.doc_lens, jnp.float32(self.dev.avgdl), live,
+        tail = (self.dev.doc_lens, jnp.float32(avgdl), live,
                 self.dev.n_docs_pad, k)
         qb_pad = qb_bucket(max((p.n_blocks for p in plans), default=1))
         if not prune or qb_pad <= P1_BUCKET:

@@ -256,7 +256,12 @@ def make_sharded_bm25_batch(mesh: Mesh, n_per_shard: int, k: int,
 
     fn(block_docs [S,NB,BLOCK], block_tfs [S,NB,BLOCK], doc_lens [S,N],
        avgdl scalar, block_idx [S,Q,QB], block_w [S,Q,QB])
-    -> (scores [Q,k], global ids [Q,k])
+    -> (scores [Q,k], ORIGINAL corpus doc ids [Q,k])
+
+    Ties at equal score break by ascending original id — the same
+    (shard, segment, doc) order the host-RPC coordinator merge uses
+    (SearchPhaseController.java:160 analog), so both data planes return
+    identical hit sets at tie boundaries.
     """
 
     def local_search(block_docs, block_tfs, doc_lens, avgdl,
@@ -268,15 +273,23 @@ def make_sharded_bm25_batch(mesh: Mesh, n_per_shard: int, k: int,
         scores = jax.vmap(one)(block_idx[0], block_w[0])       # [Q, N]
         local_s, local_i = _topk_padded(scores, k)             # [Q, k]
         shard_idx = jax.lax.axis_index("shard")
-        global_i = jnp.where(jnp.isfinite(local_s),
-                             local_i + shard_idx * n_per_shard, -1)
+        n_shards = jax.lax.axis_size("shard")
+        # round-robin placement: original id = local * S + shard; empty
+        # slots get an out-of-range id so the lexsort puts them last
+        orig_i = jnp.where(jnp.isfinite(local_s),
+                           local_i * n_shards + shard_idx,
+                           n_shards * n_per_shard)
         all_s = jax.lax.all_gather(local_s, "shard", axis=0)   # [S, Q, k]
-        all_i = jax.lax.all_gather(global_i, "shard", axis=0)
+        all_i = jax.lax.all_gather(orig_i, "shard", axis=0)
         S, Q = all_s.shape[0], all_s.shape[1]
         flat_s = jnp.transpose(all_s, (1, 0, 2)).reshape(Q, S * k)
         flat_i = jnp.transpose(all_i, (1, 0, 2)).reshape(Q, S * k)
-        g_s, pos = jax.lax.top_k(flat_s, k)
-        return g_s, jnp.take_along_axis(flat_i, pos, axis=1)
+        # lexicographic (descending score, ascending original id)
+        srt_neg, srt_i = jax.lax.sort((-flat_s, flat_i), dimension=1,
+                                      num_keys=2)
+        g_s = -srt_neg[:, :k]
+        g_i = jnp.where(jnp.isfinite(g_s), srt_i[:, :k], -1)
+        return g_s, g_i
 
     fn = shard_map(
         local_search, mesh=mesh,
@@ -531,8 +544,7 @@ class ShardedTextIndex:
         qb_pad = qb_bucket(max(qb_max, 1))
         if not prune or qb_pad <= P1_BUCKET:
             self.last_prune_stats = (total, total)
-            s, i = self._run_batch(fn, plans, qb_pad)
-            return s, to_original_ids(i, self.n_shards, self.n_per_shard)
+            return self._run_batch(fn, plans, qb_pad)
         p1 = [[p.top_by_ub(P1_BUCKET) for p in per] for per in plans]
         s1, _ = self._run_batch(fn, p1, P1_BUCKET)
         theta = np.asarray(s1)[:, k - 1]
@@ -543,8 +555,7 @@ class ShardedTextIndex:
         self.last_prune_stats = (total, scored + p1_cost)
         qb2_max = max((p.n_blocks for per in p2 for p in per), default=1)
         qb2 = qb_bucket(max(qb2_max, 1))
-        s, i = self._run_batch(fn, p2, qb2)
-        return s, to_original_ids(i, self.n_shards, self.n_per_shard)
+        return self._run_batch(fn, p2, qb2)
 
 
 # ---------------------------------------------------------------------------

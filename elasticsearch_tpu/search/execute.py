@@ -41,6 +41,9 @@ class SegmentContext:
     # shard- or corpus-wide stats for idf (DFS analog); None = segment-local
     doc_count_override: Optional[int] = None
     df_overrides: Optional[Dict[str, Dict[str, int]]] = None  # field -> term -> df
+    # field -> (sum_doc_len, docs_with_field): corpus-wide collection stats
+    # (the CollectionStatistics half of DFS) so norms use one global avgdl
+    field_stats_overrides: Optional[Dict[str, Tuple[float, int]]] = None
     # point-in-time live mask (a Reader snapshot); when set it REPLACES the
     # segment's current mask so mid-scroll deletes stay invisible
     live_override: Optional[jnp.ndarray] = None
@@ -88,6 +91,18 @@ class SegmentContext:
         if self.df_overrides is None:
             return None
         return self.df_overrides.get(field_name)
+
+    def avgdl_for(self, field_name: str) -> Optional[float]:
+        """Corpus-wide avgdl for the field, if a DFS coordinator shared it."""
+        if self.field_stats_overrides is None:
+            return None
+        got = self.field_stats_overrides.get(field_name)
+        if not got:
+            return None
+        sum_len, n_docs = got
+        if n_docs <= 0:
+            return None
+        return float(sum_len) / float(n_docs)
 
 
 Result = Tuple[jnp.ndarray, jnp.ndarray]   # (scores f32 [n_pad], mask bool [n_pad])
@@ -289,7 +304,9 @@ def _h_match(q: dsl.Match, ctx: SegmentContext) -> Result:
     if ex is None:
         # not a text field: fall back to term-equality semantics
         return _h_term(dsl.Term(field=q.field, value=q.text, boost=q.boost), ctx)
-    scores = ex.scores(terms, ctx.live, boost=q.boost, df_override=ctx.df_for(q.field))
+    scores = ex.scores(terms, ctx.live, boost=q.boost,
+                       df_override=ctx.df_for(q.field),
+                       avgdl_override=ctx.avgdl_for(q.field))
     mask = scores > 0.0
     msm = dsl.resolve_minimum_should_match(q.minimum_should_match, len(set(terms)))
     if q.operator == "and" or msm > 1:
@@ -372,7 +389,8 @@ def _h_match_phrase(q: dsl.MatchPhrase, ctx: SegmentContext) -> Result:
     # divergence: the reference scores by phrase frequency)
     ex = _bm25_executor(ctx, q.field)
     scores = ex.scores([t.term for t in tokens], ctx.live, boost=q.boost,
-                       df_override=ctx.df_for(q.field))
+                       df_override=ctx.df_for(q.field),
+                       avgdl_override=ctx.avgdl_for(q.field))
     return jnp.where(mask, scores, 0.0), mask
 
 

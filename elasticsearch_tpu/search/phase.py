@@ -150,6 +150,26 @@ def shard_term_stats(reader: Reader, mappers: MapperService,
     return doc_count, dfs
 
 
+def shard_field_stats(reader: Reader, mappers: MapperService,
+                      q: dsl.Query) -> Dict[str, Tuple[float, int]]:
+    """field -> (sum_doc_len, docs_with_field) over segments — the
+    CollectionStatistics half of the DFS phase (search/dfs/DfsPhase.java:43
+    ships sumTotalTermFreq + docCount so every shard norms with one global
+    avgdl)."""
+    out: Dict[str, Tuple[float, int]] = {}
+    for fname in collect_query_terms(q):
+        sum_len = 0.0
+        n_docs = 0
+        for seg in reader.segments:
+            pf = seg.postings.get(fname)
+            if pf is not None:
+                sum_len += float(pf.sum_doc_len)
+                n_docs += int((pf.doc_lens > 0).sum())
+        if n_docs:
+            out[fname] = (sum_len, n_docs)
+    return out
+
+
 def choose_collector_context(query: dsl.Query,
                              mappers: MapperService,
                              sort: List[SortSpec],
@@ -216,7 +236,8 @@ def _wand_topk_shard(ctxs: List[SegmentContext], query: "dsl.Match",
             continue   # field has no postings in this segment
         k = min(max(want, 1), ctx.n_docs_pad)
         s, d = ex.top_k_batch([terms], ctx.live, k, boost=query.boost,
-                              df_override=ctx.df_for(query.field))
+                              df_override=ctx.df_for(query.field),
+                              avgdl_override=ctx.avgdl_for(query.field))
         t, g = getattr(ex, "last_prune_stats", (0, 0))
         blocks_total += t
         blocks_scored += g
@@ -245,6 +266,8 @@ def query_shard(reader: Reader,
                 min_score: Optional[float] = None,
                 doc_count_override: Optional[int] = None,
                 df_overrides: Optional[Dict[str, Dict[str, int]]] = None,
+                field_stats_overrides: Optional[
+                    Dict[str, Tuple[float, int]]] = None,
                 collectors: Optional[List] = None,
                 cancel_check: Optional[Any] = None) -> ShardQueryResult:
     """Execute one query over all segments of a shard snapshot.
@@ -289,6 +312,7 @@ def query_shard(reader: Reader,
         ctxs.append(SegmentContext(seg, mappers, segment_idx=si,
                                    doc_count_override=doc_count,
                                    df_overrides=dfs,
+                                   field_stats_overrides=field_stats_overrides,
                                    live_override=jnp.asarray(snap)))
     # collector-context dispatch (TopDocsCollectorContext.java:215 analog):
     # pure score-sorted top-k text queries with totals disabled skip the
