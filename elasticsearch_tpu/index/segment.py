@@ -73,9 +73,11 @@ class PostingsField:
     # Host-only; used for phrase verification (padding entries are empty).
     pos_offsets: np.ndarray = field(default_factory=lambda: np.zeros(1, np.int32))
     pos_flat: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
-    # derived, lazily computed: (k1, b) -> per-block max impact (see
-    # block_max_impact); never persisted
-    _impact_cache: Dict[Tuple[float, float], np.ndarray] = field(
+    # derived, lazily computed: (k1, b, avgdl) -> per-block max impact (see
+    # block_max_impact); never persisted. avgdl drifts continuously under a
+    # DFS coordinator while indexing proceeds, so the cache is bounded
+    # (FIFO) to stop unbounded growth on long-lived segments.
+    _impact_cache: Dict[Tuple[float, float, float], np.ndarray] = field(
         default_factory=dict, repr=False, compare=False)
 
     @property
@@ -129,6 +131,8 @@ class PostingsField:
         norm = k1 * (1.0 - b + b * dl / max(avgdl, 1e-9))
         impact = np.where(valid, tfs / np.maximum(tfs + norm, 1e-9), 0.0)
         out = impact.max(axis=1).astype(np.float32)
+        while len(self._impact_cache) >= 8:   # bound: FIFO-evict oldest
+            self._impact_cache.pop(next(iter(self._impact_cache)))
         self._impact_cache[key] = out
         return out
 

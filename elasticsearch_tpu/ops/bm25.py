@@ -388,19 +388,28 @@ class Bm25Executor:
         Returns (scores [Q, k], doc ids [Q, k]); also records
         last_prune_stats = (blocks_total, blocks_scored)."""
         avgdl = self._avgdl(avgdl_override)
+        hp = self.host
+        # per-block doc ranges: avgdl-independent, computed once
+        ranges = getattr(self, "_block_ranges", None)
+        if ranges is None:
+            ranges = (hp.block_docs[:, 0], hp.block_docs.max(axis=1))
+            self._block_ranges = ranges
+        bmin, bmax = ranges
+        # per-term cell index for the aligned WAND bound (within a term,
+        # blocks are doc-sorted; entry 0 of every block is always valid).
+        # Keyed by (k1, b, avgdl) in a small FIFO-bounded dict so DFS
+        # (global avgdl) and plain (segment avgdl) traffic interleave
+        # without rebuilding each other's lazily-filled cell tables.
         cells_key = (k1, b, avgdl)
-        cache = getattr(self, "_wand_cache", None)
-        if cache is None or cache[0] != cells_key:
-            # per-block doc ranges + per-term cell index for the aligned
-            # WAND bound (within a term, blocks are doc-sorted; entry 0 of
-            # every block is always valid)
-            hp = self.host
-            cache = (cells_key,
-                     hp.block_docs[:, 0], hp.block_docs.max(axis=1),
-                     TermCellIndex(hp.block_docs, hp.block_tfs, hp.doc_lens,
-                                   avgdl, k1=k1, b=b))
-            self._wand_cache = cache
-        _, bmin, bmax, cell_index = cache
+        cells = getattr(self, "_cell_cache", None)
+        if cells is None:
+            cells = self._cell_cache = {}
+        cell_index = cells.get(cells_key)
+        if cell_index is None:
+            while len(cells) >= 4:
+                cells.pop(next(iter(cells)))
+            cell_index = cells[cells_key] = TermCellIndex(
+                hp.block_docs, hp.block_tfs, hp.doc_lens, avgdl, k1=k1, b=b)
         plans = []
         for terms in queries:
             tw = self.query_weights(terms, boost, df_override)

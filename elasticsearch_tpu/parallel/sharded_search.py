@@ -20,8 +20,10 @@ Layouts (S = number of shards on the mesh axis):
 Docs are placed round-robin (doc g -> shard g % S, local g // S) so load
 balances regardless of pow2 padding (the murmur3-routing analog for a
 monotonically-assigned corpus). Inside a program a doc is addressed by its
-mesh-global id shard_idx * N_per_shard + local; search APIs translate back
-to original corpus ids before returning (to_original_ids).
+mesh-global id shard_idx * N_per_shard + local. The batched BM25 program
+emits ORIGINAL corpus ids directly (tie-break by ascending original id is
+baked into its device-side lexsort); the single-query and kNN paths still
+return mesh-global ids that search APIs translate via to_original_ids.
 """
 
 from __future__ import annotations
@@ -532,8 +534,8 @@ class ShardedTextIndex:
 
     def search_batch(self, queries: Sequence[Sequence[str]], k: int,
                      prune: bool = True):
-        """Q queries -> (scores [Q,k], global doc ids [Q,k]) in two device
-        dispatches (phase-1 theta + phase-2 exact over surviving blocks).
+        """Q queries -> (scores [Q,k], original corpus doc ids [Q,k]) in two
+        device dispatches (phase-1 theta + phase-2 exact over survivors).
         See ops/bm25.py Bm25Executor.top_k_batch for the soundness
         argument; here phase-1 theta comes from the GLOBAL top-k across
         shards, so pruning tightens with every shard's evidence."""
