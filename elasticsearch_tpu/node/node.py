@@ -54,14 +54,19 @@ class Node:
                  data_path: Optional[str] = None,
                  initial_state: Optional[ClusterState] = None,
                  coordinator_settings: Optional[CoordinatorSettings] = None,
-                 mesh_data_plane: bool = False):
+                 mesh_data_plane: bool = False,
+                 transport_service=None):
         self.node_id = node_id
         self.scheduler = scheduler
         self.discovery_node = DiscoveryNode(
             node_id=node_id, name=node_id,
             roles=frozenset(roles) if roles else frozenset(Roles.ALL))
 
-        self.transport_service = TransportService(node_id, transport)
+        # the wire is pluggable: in-memory (simulation / single process) or
+        # an injected TcpTransportService (transport/tcp.py) for clusters
+        # spanning OS processes — both honor the same service contract
+        self.transport_service = transport_service or \
+            TransportService(node_id, transport)
         self.indices_service = IndicesService(data_path=data_path)
         self.allocation_service = AllocationService()
 

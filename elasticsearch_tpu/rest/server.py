@@ -157,11 +157,29 @@ class HttpServer:
         await writer.drain()
 
 
+def _apply_platform_env() -> None:
+    """Make JAX_PLATFORMS effective: the preinstalled TPU PJRT plugin
+    registers itself regardless of the env var; only the config knob
+    (applied before first backend init) reliably wins. Lets operators and
+    tests pin node processes to CPU (e.g. many nodes sharing one host
+    can't all own the TPU)."""
+    import os
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            import jax
+            jax.config.update("jax_platforms", want)
+        except Exception:  # noqa: BLE001 — backend already up; best effort
+            pass
+
+
 def run_single_node(host: str = "127.0.0.1", port: int = 9200,
                     data_path: Optional[str] = None) -> None:
     """Boot a one-node cluster on the threaded scheduler and serve HTTP
     (bootstrap/Elasticsearch.main analog for the dev distribution)."""
     import time
+
+    _apply_platform_env()
 
     from elasticsearch_tpu.cluster.state import ClusterState
     from elasticsearch_tpu.node.node import Node
@@ -202,7 +220,77 @@ def run_single_node(host: str = "127.0.0.1", port: int = 9200,
         node.stop()
 
 
+def run_tcp_node(node_id: str, http_port: int, tcp_port: int,
+                 peers: dict, host: str = "127.0.0.1",
+                 data_path: Optional[str] = None) -> None:
+    """Boot one member of a multi-process cluster over the TCP transport
+    (bootstrap/Elasticsearch.main + discovery.seed_hosts analog).
+
+    ``peers``: node_id -> (host, tcp_port) for EVERY cluster member,
+    including this one — the static address book that stands in for
+    seed-hosts discovery.
+    """
+    _apply_platform_env()
+    from elasticsearch_tpu.cluster.state import ClusterState
+    from elasticsearch_tpu.node.node import Node
+    from elasticsearch_tpu.transport.scheduler import ThreadedScheduler
+    from elasticsearch_tpu.transport.tcp import TcpTransport, TcpTransportService
+
+    scheduler = ThreadedScheduler()
+    tcp = TcpTransport(scheduler, node_id, (host, tcp_port),
+                       {n: tuple(a) for n, a in peers.items()})
+    tcp.start()
+    service = TcpTransportService(node_id, tcp)
+    node = Node(node_id, None, scheduler,
+                seed_peers=sorted(peers),
+                data_path=data_path,
+                initial_state=ClusterState(
+                    voting_config=frozenset(peers)),
+                transport_service=service)
+    node.start()
+
+    server = HttpServer(node.client, host, http_port)
+
+    async def main() -> None:
+        await server.start()
+        print(f"elasticsearch_tpu node {node_id} http://{host}:{http_port} "
+              f"tcp:{tcp_port}", flush=True)
+        stop = asyncio.Event()
+        try:
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGINT, stop.set)
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGTERM, stop.set)
+        except NotImplementedError:
+            pass
+        await stop.wait()
+        await server.stop()
+
+    try:
+        asyncio.run(main())
+    finally:
+        node.stop()
+
+
+def _parse_peers(spec: str) -> dict:
+    """"n1=127.0.0.1:9301,n2=127.0.0.1:9302" -> {id: (host, port)}."""
+    out = {}
+    for part in spec.split(","):
+        nid, _, addr = part.partition("=")
+        h, _, p = addr.rpartition(":")
+        out[nid.strip()] = (h, int(p))
+    return out
+
+
 if __name__ == "__main__":
-    port = int(sys.argv[1]) if len(sys.argv) > 1 else 9200
-    data = sys.argv[2] if len(sys.argv) > 2 else None
-    run_single_node(port=port, data_path=data)
+    if len(sys.argv) > 1 and all("=" in a for a in sys.argv[1:]):
+        # multi-process form:
+        #   python -m elasticsearch_tpu.rest.server node=n1 http=9200 \
+        #       tcp=9301 peers=n1=127.0.0.1:9301,n2=... [data=/path]
+        kv = dict(a.split("=", 1) for a in sys.argv[1:])
+        run_tcp_node(kv["node"], int(kv["http"]), int(kv["tcp"]),
+                     _parse_peers(kv["peers"]), data_path=kv.get("data"))
+    else:
+        port = int(sys.argv[1]) if len(sys.argv) > 1 else 9200
+        data = sys.argv[2] if len(sys.argv) > 2 else None
+        run_single_node(port=port, data_path=data)

@@ -1,0 +1,407 @@
+"""TCP transport: the control plane across OS processes.
+
+Reference: transport/TcpTransport.java:96 (framed wire, connection profile,
+handshake) + TransportService.java:72 (request-id correlation, timeouts).
+The in-memory transport simulates a network inside one process for
+deterministic tests; this module is the production wire with the SAME
+service contract (register_handler / send_request / close + the
+one-callback guarantee), so every action and the coordinator run unchanged
+over real sockets.
+
+Wire format: 4-byte big-endian length prefix + UTF-8 JSON document.
+Messages:
+  {"t": "hs",  "node": sender_id}                      connection handshake
+  {"t": "req", "id": N, "action": a, "sender": s, "body": {...}}
+  {"t": "res", "id": N, "body": {...}}                 handler success
+  {"t": "res", "id": N, "error": "Type: reason"}       handler failure
+
+Concurrency model: socket reader threads only parse frames and hand them to
+the scheduler; ALL handler execution happens on the scheduler's single
+dispatch thread — the same ordering discipline as the in-memory transport
+(and the reference's transport worker -> generic threadpool handoff).
+Outbound writes run on one writer thread per peer so a blocked/slow peer
+never stalls dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from elasticsearch_tpu.transport.scheduler import Cancellable, Scheduler
+from elasticsearch_tpu.transport.transport import (
+    Deferred, NodeNotConnectedError, RemoteTransportError,
+)
+from elasticsearch_tpu.utils.errors import ReceiveTimeoutError
+
+__all__ = ["TcpTransport", "TcpTransportService"]
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def _jsonable(obj: Any) -> Any:
+    """Last-resort converter so numpy scalars etc. survive serialization."""
+    for attr in ("item",):
+        if hasattr(obj, attr):
+            try:
+                return getattr(obj, attr)()
+            except Exception:  # noqa: BLE001
+                pass
+    if isinstance(obj, (set, frozenset, tuple)):
+        return list(obj)
+    return str(obj)
+
+
+def _encode_frame(msg: Dict[str, Any]) -> bytes:
+    payload = json.dumps(msg, default=_jsonable).encode("utf-8")
+    return _LEN.pack(len(payload)) + payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (length,) = _LEN.unpack(head)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame of {length} bytes exceeds limit")
+    body = _recv_exact(sock, length)
+    if body is None:
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+class _Peer:
+    """Outbound connection to one node: a queue drained by a writer thread.
+
+    Connect happens lazily on the writer thread (never on dispatch). On any
+    send/connect failure the queued message's on_fail fires and the
+    connection resets — the next message retries from scratch. Request
+    timeouts remain the end-to-end guarantee.
+    """
+
+    def __init__(self, my_id: str, address: Tuple[str, int],
+                 on_fail_dispatch: Callable[[Callable[[], None]], None]):
+        self.my_id = my_id
+        self.address = address
+        self._q: "queue.Queue" = queue.Queue()
+        self._sock: Optional[socket.socket] = None
+        self._closed = False
+        self._dispatch = on_fail_dispatch
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"tcp-out-{address[1]}")
+        self._thread.start()
+
+    def send(self, frame: bytes,
+             on_fail: Optional[Callable[[], None]] = None) -> None:
+        """``frame`` is already encoded — serialization happens at send
+        time on the caller's thread, so later mutation of the request dict
+        can't leak onto the wire (the in-memory transport's deepcopy-at-send
+        snapshot semantics, transport.py)."""
+        self._q.put((frame, on_fail))
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self.address, timeout=5.0)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        sock.sendall(_encode_frame({"t": "hs", "node": self.my_id}))
+        return sock
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            frame, on_fail = item
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                self._sock.sendall(frame)
+            except Exception:  # noqa: BLE001 — the writer must survive any
+                # failure or the peer wedges silently for the node's life
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                if on_fail is not None:
+                    self._dispatch(on_fail)
+
+    def close(self) -> None:
+        self._closed = True
+        self._q.put(None)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+class TcpTransport:
+    """Listening socket + peer address book + outbound connection pool."""
+
+    def __init__(self, scheduler: Scheduler, node_id: str,
+                 bind: Tuple[str, int],
+                 address_book: Dict[str, Tuple[str, int]]):
+        self.scheduler = scheduler
+        self.node_id = node_id
+        self.bind_address = bind
+        self.address_book = dict(address_book)
+        self._peers: Dict[str, _Peer] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[socket.socket] = None
+        self._inbound: set = set()
+        self._closed = False
+        # set by TcpTransportService: fn(msg: dict) on the dispatch thread
+        self.on_message: Optional[Callable[[Dict[str, Any]], None]] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(self.bind_address)
+        srv.listen(64)
+        self._server = srv
+        # rebinding port 0 resolves the ephemeral port for the address book
+        self.bind_address = srv.getsockname()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"tcp-accept-{self.bind_address[1]}").start()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        with self._lock:
+            for peer in self._peers.values():
+                peer.close()
+            self._peers.clear()
+            for conn in list(self._inbound):
+                try:
+                    conn.close()   # unblocks reader threads stuck in recv
+                except OSError:
+                    pass
+            self._inbound.clear()
+
+    # -- inbound -------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._server is not None
+        while not self._closed:
+            try:
+                conn, _addr = self._server.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._inbound.add(conn)
+            threading.Thread(target=self._read_loop, args=(conn,),
+                             daemon=True, name="tcp-read").start()
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        try:
+            hs = _recv_frame(conn)
+            if not hs or hs.get("t") != "hs":
+                return
+            while not self._closed:
+                msg = _recv_frame(conn)
+                if msg is None:
+                    return
+                cb = self.on_message
+                if cb is not None:
+                    # parse on the reader thread, execute on dispatch
+                    self.scheduler.submit(lambda m=msg: cb(m))
+        except (OSError, ValueError):
+            return
+        finally:
+            with self._lock:
+                self._inbound.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- outbound ------------------------------------------------------------
+
+    def send(self, node_id: str, msg: Dict[str, Any],
+             on_fail: Optional[Callable[[], None]] = None) -> None:
+        addr = self.address_book.get(node_id)
+        if addr is None or self._closed:
+            if on_fail is not None:
+                self.scheduler.submit(on_fail)
+            return
+        try:
+            frame = _encode_frame(msg)   # snapshot NOW, on the caller thread
+        except Exception:  # noqa: BLE001 — unserializable payload
+            if on_fail is not None:
+                self.scheduler.submit(on_fail)
+            return
+        with self._lock:
+            if self._closed:
+                peer = None
+            else:
+                peer = self._peers.get(node_id)
+                if peer is None:
+                    peer = self._peers[node_id] = _Peer(
+                        self.node_id, tuple(addr), self.scheduler.submit)
+        if peer is None:
+            if on_fail is not None:
+                self.scheduler.submit(on_fail)
+            return
+        peer.send(frame, on_fail)
+
+
+class TcpTransportService:
+    """TransportService contract over TcpTransport.
+
+    Same guarantees as the in-memory service: handlers are
+    ``fn(request, sender_id) -> dict | Deferred`` running on the dispatch
+    thread; send_request invokes its callback exactly once (response,
+    remote error, undeliverable, or timeout). Local sends short-circuit
+    through the scheduler without touching a socket
+    (TransportService.java's local-node optimization).
+    """
+
+    DEFAULT_TIMEOUT = 30.0
+
+    def __init__(self, node_id: str, transport: TcpTransport):
+        self.node_id = node_id
+        self.transport = transport
+        self._handlers: Dict[str, Callable] = {}
+        self._pending: Dict[int, Callable[[Optional[dict], Optional[Exception]], None]] = {}
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self.stats = {"sent": 0, "received": 0, "timeouts": 0}
+        transport.on_message = self._on_message
+
+    # -- registry ------------------------------------------------------------
+
+    def register_handler(self, action: str, handler: Callable) -> None:
+        if action in self._handlers:
+            raise ValueError(f"handler already registered for [{action}]")
+        self._handlers[action] = handler
+
+    # -- sending -------------------------------------------------------------
+
+    def send_request(self, node_id: str, action: str, request: Dict[str, Any],
+                     on_response, timeout: Optional[float] = None) -> None:
+        if timeout is None:
+            timeout = self.DEFAULT_TIMEOUT
+        self.stats["sent"] += 1
+        with self._id_lock:
+            self._next_id += 1
+            req_id = self._next_id
+        done = {"flag": False}
+        timeout_handle: Optional[Cancellable] = None
+
+        def finish(resp, err) -> None:
+            if done["flag"]:
+                return
+            done["flag"] = True
+            self._pending.pop(req_id, None)
+            if timeout_handle is not None:
+                timeout_handle.cancel()
+            on_response(resp, err)
+
+        def on_timeout() -> None:
+            self.stats["timeouts"] += 1
+            finish(None, ReceiveTimeoutError(
+                f"[{node_id}][{action}] request timed out after {timeout}s"))
+
+        timeout_handle = self.transport.scheduler.schedule(timeout, on_timeout)
+        self._pending[req_id] = finish
+
+        if node_id == self.node_id:
+            # local short-circuit, still async through the scheduler; the
+            # JSON round-trip reproduces the wire's copy semantics
+            payload = json.loads(json.dumps(request, default=_jsonable))
+            self.transport.scheduler.submit(
+                lambda: self._handle_request(
+                    {"id": req_id, "action": action, "sender": self.node_id,
+                     "body": payload}, local_finish=finish))
+            return
+
+        self.transport.send(
+            node_id,
+            {"t": "req", "id": req_id, "action": action,
+             "sender": self.node_id, "body": request},
+            on_fail=lambda: finish(None, NodeNotConnectedError(
+                f"node [{node_id}] is not connected")))
+
+    # -- receiving -----------------------------------------------------------
+
+    def _on_message(self, msg: Dict[str, Any]) -> None:
+        t = msg.get("t")
+        if t == "req":
+            self._handle_request(msg)
+        elif t == "res":
+            finish = self._pending.get(msg.get("id"))
+            if finish is None:
+                return  # timed out / duplicate — late response dropped
+            err = msg.get("error")
+            if err is not None:
+                finish(None, RemoteTransportError(
+                    msg.get("sender", "?"), msg.get("action", "?"), err))
+            else:
+                finish(msg.get("body") or {}, None)
+
+    def _handle_request(self, msg: Dict[str, Any],
+                        local_finish=None) -> None:
+        self.stats["received"] += 1
+        req_id = msg["id"]
+        action = msg["action"]
+        sender = msg["sender"]
+
+        def reply_ok(body: Optional[Dict[str, Any]]) -> None:
+            if local_finish is not None:
+                body = json.loads(json.dumps(body if body is not None else {},
+                                             default=_jsonable))
+                local_finish(body, None)
+            else:
+                self.transport.send(sender, {
+                    "t": "res", "id": req_id, "sender": self.node_id,
+                    "action": action, "body": body if body is not None else {}})
+
+        def reply_err(cause: str) -> None:
+            if local_finish is not None:
+                local_finish(None, RemoteTransportError(
+                    self.node_id, action, cause))
+            else:
+                self.transport.send(sender, {
+                    "t": "res", "id": req_id, "sender": self.node_id,
+                    "action": action, "error": cause})
+
+        handler = self._handlers.get(action)
+        if handler is None:
+            reply_err(f"no handler for action [{action}]")
+            return
+        try:
+            response = handler(msg.get("body") or {}, sender)
+        except Exception as e:  # noqa: BLE001 — becomes a remote error
+            reply_err(f"{type(e).__name__}: {e}")
+            return
+        if isinstance(response, Deferred):
+            response._subscribe(reply_ok, reply_err)
+        else:
+            reply_ok(response)
+
+    def close(self) -> None:
+        self.transport.close()

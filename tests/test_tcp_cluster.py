@@ -1,0 +1,127 @@
+"""A real cluster across OS processes over the TCP transport.
+
+VERDICT r2 #3: three separate Python processes (framed-JSON sockets,
+transport/tcp.py) must elect a master, replicate an index, serve search,
+and survive a master kill — the TcpTransport.java:96 capability the
+in-memory wire cannot demonstrate.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _req(port, method, path, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers={"content-type": "application/json"})
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _wait(predicate, deadline_s, interval=0.25, desc="condition"):
+    deadline = time.monotonic() + deadline_s
+    last_err = None
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return
+        except (urllib.error.URLError, ConnectionError, OSError,
+                TimeoutError) as e:
+            last_err = e
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {desc}: {last_err}")
+
+
+@pytest.fixture()
+def three_process_cluster(tmp_path):
+    http = _free_ports(3)
+    tcp = _free_ports(3)
+    ids = ["n1", "n2", "n3"]
+    peers = ",".join(f"{n}=127.0.0.1:{p}" for n, p in zip(ids, tcp))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    procs = []
+    for i, nid in enumerate(ids):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "elasticsearch_tpu.rest.server",
+             f"node={nid}", f"http={http[i]}", f"tcp={tcp[i]}",
+             f"peers={peers}", f"data={tmp_path / nid}"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    try:
+        yield ids, http, procs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_three_processes_elect_index_search_failover(three_process_cluster):
+    ids, http, procs = three_process_cluster
+
+    # -- the three processes discover each other and elect one master
+    def formed():
+        st = _req(http[0], "GET", "/_cluster/state")
+        return st.get("master_node") and len(st.get("nodes", {})) == 3
+    _wait(formed, 120, desc="3-node cluster formation")
+
+    # -- create a replicated index and wait for green
+    _req(http[0], "PUT", "/docs", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 1}})
+
+    def green():
+        h = _req(http[1], "GET", "/_cluster/health/docs")
+        return h["status"] == "green"
+    _wait(green, 60, desc="index green")
+
+    # -- index through one node, read through another
+    for i in range(12):
+        _req(http[i % 3], "PUT", f"/docs/_doc/d{i}",
+             {"body": f"alpha beta w{i}", "n": i})
+    _req(http[0], "POST", "/docs/_refresh")
+    res = _req(http[2], "POST", "/docs/_search",
+               {"query": {"match": {"body": "alpha"}}, "size": 20})
+    assert res["hits"]["total"]["value"] == 12
+
+    # -- kill the master process; the survivors elect a new one and the
+    # replicated data stays searchable
+    st = _req(http[0], "GET", "/_cluster/state")
+    master = st["master_node"]
+    assert master in ids
+    procs[ids.index(master)].kill()
+    survivors = [http[i] for i, n in enumerate(ids) if n != master]
+
+    def new_master():
+        s = _req(survivors[0], "GET", "/_cluster/state", timeout=5)
+        return s.get("master_node") and s["master_node"] != master
+    _wait(new_master, 90, desc="re-election after master kill")
+
+    def searchable():
+        r = _req(survivors[1], "POST", "/docs/_search",
+                 {"query": {"match": {"body": "alpha"}}, "size": 20},
+                 timeout=5)
+        return r["hits"]["total"]["value"] == 12
+    _wait(searchable, 90, desc="search after failover")
