@@ -61,7 +61,8 @@ class TransportBulkAction:
         marked, not removed — responses stay positional."""
         if self.ingest is None:
             return items
-        out = []
+        resolved: List[tuple] = []   # (item, pipeline_or_None)
+        by_pipeline: Dict[str, List[Dict[str, Any]]] = {}
         for item in items:
             pipeline = item.get("pipeline")
             if pipeline is None and item.get("action") in ("index",
@@ -74,6 +75,17 @@ class TransportBulkAction:
                         meta.settings.get("index.default_pipeline"))
             if not pipeline or pipeline == "_none" or \
                     item.get("action") not in ("index", "create"):
+                resolved.append((item, None))
+            else:
+                resolved.append((item, pipeline))
+                by_pipeline.setdefault(pipeline, []).append(item)
+        # inference processors expand the whole chunk in one device
+        # dispatch up front; the per-item run below hits the model cache
+        for pipeline, group in by_pipeline.items():
+            self.ingest.prewarm_inference(pipeline, group)
+        out = []
+        for item, pipeline in resolved:
+            if pipeline is None:
                 out.append(item)
                 continue
             try:

@@ -284,6 +284,13 @@ class TransportSearchAction:
         try:
             indices = self._resolve_indices(index_expression, state)
             targets = self._shard_targets(indices, state)
+            # coordinator-side inference rewrite: text_expansion model_text
+            # becomes tokens ONCE per request (one batched device dispatch),
+            # never per shard/segment — TextExpansionQueryBuilder.doRewrite
+            from elasticsearch_tpu.ml.text_expansion import (
+                rewrite_body_expansions,
+            )
+            body = rewrite_body_expansions(body)
         except SearchEngineError as e:
             on_done(None, e)
             return
@@ -487,7 +494,8 @@ class TransportSearchAction:
                     phase_state["failed"] += 1
                     phase_state["failures"].append({
                         "shard": target["shard"], "index": target["index"],
-                        "reason": str(err)})
+                        "reason": str(err),
+                        "status": getattr(err, "status", 500)})
                 else:
                     target["node"] = node   # fetch goes where query ran
                     results[i] = resp
@@ -539,9 +547,10 @@ class TransportSearchAction:
 
         winners = entries[from_:from_ + size]
         if not winners:
-            on_done(self._finalize(t0, targets, body, phase_state,
-                                   n_total_shards, total, relation,
-                                   max_score, [], results=results), None)
+            self._complete(self._finalize(t0, targets, body, phase_state,
+                                          n_total_shards, total, relation,
+                                          max_score, [], results=results),
+                           on_done)
             return
 
         # group winners per shard for fetch
@@ -566,20 +575,45 @@ class TransportSearchAction:
                     phase_state["failed"] += 1
                     phase_state["failures"].append({
                         "shard": target["shard"], "index": target["index"],
-                        "reason": f"fetch: {err}"})
+                        "reason": f"fetch: {err}",
+                        "status": getattr(err, "status", 500)})
                 pending["n"] -= 1
                 if pending["n"] == 0:
                     hits = [h for h in hits_out if h is not None]
-                    on_done(self._finalize(t0, targets, body, phase_state,
-                                           n_total_shards, total, relation,
-                                           max_score, hits,
-                                           results=results), None)
+                    self._complete(
+                        self._finalize(t0, targets, body, phase_state,
+                                       n_total_shards, total, relation,
+                                       max_score, hits, results=results),
+                        on_done)
             self.ts.send_request(target["node"], SEARCH_FETCH, req, cb,
                                  timeout=60.0)
         for tidx, docs in by_target.items():
             one(tidx, docs)
 
     # -- response --------------------------------------------------------
+
+    def _complete(self, resp: Dict[str, Any], on_done) -> None:
+        """Deliver the merged response — unless EVERY shard failed, in
+        which case the whole search fails with the dominant cause's status
+        (SearchPhaseExecutionException.status() analog: an all-shards 429
+        is a request-wide 429, not a 200 with empty hits)."""
+        shards = resp["_shards"]
+        # skipped shards count as successful ops (the reference's skipShard
+        # calls successfulShardExecution): only fail the request when every
+        # NON-skipped shard failed and at least one did
+        if shards["total"] > 0 and shards["successful"] == 0 \
+                and shards["skipped"] == 0 and shards["failed"] > 0:
+            from elasticsearch_tpu.utils.errors import (
+                SearchPhaseExecutionError,
+            )
+            failures = shards.get("failures") or []
+            statuses = [f.get("status", 500) for f in failures]
+            cause_status = max(statuses, default=503)
+            reason = failures[0]["reason"] if failures else "all shards failed"
+            on_done(None, SearchPhaseExecutionError(
+                f"all shards failed: {reason}", cause_status=cause_status))
+            return
+        on_done(resp, None)
 
     def _finalize(self, t0, targets, body, phase_state, n_total_shards,
                   total, relation, max_score, hits,

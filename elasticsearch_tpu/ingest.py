@@ -528,6 +528,32 @@ def _req(cfg: Dict[str, Any], proc: str, key: str):
     return v
 
 
+def _p_inference(cfg: Dict[str, Any]) -> Processor:
+    """Learned sparse expansion at ingest time (InferenceProcessor analog,
+    x-pack/plugin/ml/.../inference/ingest/InferenceProcessor.java): runs
+    the text_expansion model on a source text field and writes the
+    (feature, weight) map to a rank_features target — the document half of
+    the ELSER pipeline. The bulk path prewarms the model's expansion cache
+    with ONE batched device dispatch for the whole chunk
+    (IngestService.prewarm_inference), so the per-document run here is a
+    cache hit; standalone (simulate / single doc) it dispatches once."""
+    field = _req(cfg, "inference", "field")
+    target = cfg.get("target_field", "ml.tokens")
+    model_id = cfg.get("model_id")
+    ignore_missing = cfg.get("ignore_missing", False)
+
+    def run(doc):
+        v = get_field(doc, field)
+        if v is None:
+            if ignore_missing:
+                return doc
+            raise IngestProcessorError(f"field [{field}] not present")
+        from elasticsearch_tpu.ml import get_model
+        set_field(doc, target, get_model(model_id).expand(str(v)))
+        return doc
+    return run
+
+
 PROCESSORS: Dict[str, Callable[[Dict[str, Any]], Processor]] = {
     "set": _p_set, "remove": _p_remove, "rename": _p_rename,
     "append": _p_append, "convert": _p_convert, "date": _p_date,
@@ -536,7 +562,7 @@ PROCESSORS: Dict[str, Callable[[Dict[str, Any]], Processor]] = {
     "fail": _p_fail, "drop": _p_drop, "trim": _p_trim,
     "lowercase": _p_lowercase, "uppercase": _p_uppercase,
     "html_strip": _p_html_strip, "bytes": _p_bytes,
-    "dissect": _p_dissect, "grok": _p_grok,
+    "dissect": _p_dissect, "grok": _p_grok, "inference": _p_inference,
 }
 
 
@@ -548,6 +574,7 @@ class CompiledProcessor:
     def __init__(self, ptype: str, cfg: Dict[str, Any],
                  service: "IngestService"):
         self.ptype = ptype
+        self.cfg = cfg
         self.tag = cfg.get("tag")
         self.condition = cfg.get("if")
         self.ignore_failure = cfg.get("ignore_failure", False)
@@ -652,6 +679,37 @@ class IngestService:
             if doc is None:
                 return None
         return doc
+
+    def prewarm_inference(self, pipeline_id: str,
+                          items: List[Dict[str, Any]]) -> None:
+        """Batch half of the inference processor: expand every item's text
+        in ONE device dispatch and prime the model's expansion cache, so
+        the per-document processor run is a host-side cache hit. Best
+        effort — the per-doc path stays correct without it."""
+        try:
+            procs = [p for p in self._compiled(pipeline_id)
+                     if p.ptype == "inference"]
+        except Exception:  # noqa: BLE001 — unknown pipeline errors later
+            return
+        if not procs:
+            return
+        from elasticsearch_tpu.ml import get_model
+        for proc in procs:
+            field = proc.cfg.get("field")
+            if not field:
+                continue
+            texts = []
+            for item in items:
+                doc = {"_source": item.get("source") or {}}
+                v = get_field(doc, field)
+                if v is not None:
+                    texts.append(str(v))
+            if texts:
+                try:
+                    get_model(proc.cfg.get("model_id")).expand_batch(
+                        sorted(set(texts)))
+                except Exception:  # noqa: BLE001 — surfaces per-doc later
+                    return
 
     def process_item(self, pipeline_id: str, item: Dict[str, Any]
                      ) -> Optional[Dict[str, Any]]:

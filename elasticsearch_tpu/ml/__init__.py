@@ -1,0 +1,17 @@
+"""Machine-learned inference, TPU-native.
+
+The reference runs learned models (ELSER text expansion among them) in a
+separate native process managed over named pipes
+(x-pack/plugin/ml/.../process/NativeController.java:29) and routes
+inference through dedicated ml nodes. Here the accelerator IS the local
+device: models are jitted JAX programs invoked in-process, and the
+"native boundary" disappears into an XLA dispatch.
+"""
+
+from elasticsearch_tpu.ml.text_expansion import (
+    TextExpansionModel, get_model, register_model, rewrite_body_expansions,
+    DEFAULT_MODEL_ID,
+)
+
+__all__ = ["TextExpansionModel", "get_model", "register_model",
+           "rewrite_body_expansions", "DEFAULT_MODEL_ID"]

@@ -643,6 +643,7 @@ class NodeClient:
         return self.node._applied_state().to_dict()
 
     def nodes_stats(self) -> Dict[str, Any]:
+        from elasticsearch_tpu.indices.breaker import BREAKERS
         return {
             "nodes": {
                 self.node.node_id: {
@@ -650,6 +651,7 @@ class NodeClient:
                     "indices": self.node.indices_service.stats(),
                     "transport": dict(
                         self.node.transport_service.stats),
+                    "breakers": BREAKERS.stats(),
                 }
             }
         }

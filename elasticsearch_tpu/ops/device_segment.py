@@ -32,14 +32,19 @@ class DevicePostings:
         pad = self.n_blocks_pad - n_blocks
         block_docs = np.pad(pf.block_docs, ((0, pad), (0, 0)), constant_values=-1)
         block_tfs = np.pad(pf.block_tfs, ((0, pad), (0, 0)))
-        self.block_docs = jnp.asarray(block_docs)
-        self.block_tfs = jnp.asarray(block_tfs)
         doc_lens = np.zeros(self.n_docs_pad, np.float32)
         doc_lens[: len(pf.doc_lens)] = pf.doc_lens
+        block_max_tf = np.pad(pf.block_max_tf, (0, pad))
+        # budget check BEFORE the HBM upload (breaker must gate, not observe)
+        from elasticsearch_tpu.indices.breaker import account_device_arrays
+        account_device_arrays(
+            self, (block_docs, block_tfs, doc_lens, block_max_tf),
+            "postings")
+        self.block_docs = jnp.asarray(block_docs)
+        self.block_tfs = jnp.asarray(block_tfs)
         self.doc_lens = jnp.asarray(doc_lens)
         self.avgdl = float(pf.sum_doc_len / max(1, (pf.doc_lens > 0).sum()))
-        self.block_max_tf = jnp.asarray(
-            np.pad(pf.block_max_tf, (0, pad)))
+        self.block_max_tf = jnp.asarray(block_max_tf)
 
     @staticmethod
     def for_segment(seg: Segment, field_name: str) -> Optional["DevicePostings"]:
@@ -58,11 +63,14 @@ class DeviceVectors:
         self.n_docs_pad = next_pow2(max(n_docs, 1), minimum=BLOCK)
         self.dims = vf.dims
         pad = self.n_docs_pad - vf.matrix.shape[0]
-        self.matrix = jnp.asarray(np.pad(vf.matrix, ((0, pad), (0, 0))))
+        matrix = np.pad(vf.matrix, ((0, pad), (0, 0)))
         norms = np.pad(vf.norms, (0, pad))
-        self.norms = jnp.asarray(norms)
         exists = np.zeros(self.n_docs_pad, bool)
         exists[: len(vf.exists)] = vf.exists
+        from elasticsearch_tpu.indices.breaker import account_device_arrays
+        account_device_arrays(self, (matrix, norms, exists), "vectors")
+        self.matrix = jnp.asarray(matrix)
+        self.norms = jnp.asarray(norms)
         self.exists = jnp.asarray(exists)
         self.similarity = vf.similarity
 
@@ -84,9 +92,13 @@ class DeviceFeatures:
         n_blocks = ff.block_docs.shape[0]
         self.n_blocks_pad = next_pow2(n_blocks)
         pad = self.n_blocks_pad - n_blocks
-        self.block_docs = jnp.asarray(
-            np.pad(ff.block_docs, ((0, pad), (0, 0)), constant_values=-1))
-        self.block_weights = jnp.asarray(np.pad(ff.block_weights, ((0, pad), (0, 0))))
+        block_docs = np.pad(ff.block_docs, ((0, pad), (0, 0)),
+                            constant_values=-1)
+        block_weights = np.pad(ff.block_weights, ((0, pad), (0, 0)))
+        from elasticsearch_tpu.indices.breaker import account_device_arrays
+        account_device_arrays(self, (block_docs, block_weights), "features")
+        self.block_docs = jnp.asarray(block_docs)
+        self.block_weights = jnp.asarray(block_weights)
 
     @staticmethod
     def for_segment(seg: Segment, field_name: str) -> Optional["DeviceFeatures"]:

@@ -174,9 +174,16 @@ class RankFeature(Query):
 
 @dataclass
 class TextExpansion(Query):
-    """Learned-sparse query over a rank_features field (ELSER analog)."""
+    """Learned-sparse query over a rank_features field (ELSER analog).
+
+    Either ``tokens`` carries precomputed inference output, or
+    ``model_text`` triggers on-device expansion through the registered
+    model at query time (TextExpansionQueryBuilder's inference rewrite,
+    re-done as a local jitted program — ml/text_expansion.py)."""
     field: str
-    tokens: Dict[str, float] = field(default_factory=dict)
+    tokens: Optional[Dict[str, float]] = None
+    model_id: Optional[str] = None
+    model_text: Optional[str] = None
     boost: float = 1.0
 
 
@@ -325,11 +332,18 @@ def _parse_rank_feature(spec):
 def _parse_text_expansion(spec):
     fname, opts = _field_spec(spec, "model_text")
     tokens = opts.get("tokens")
-    if tokens is None:
+    model_text = opts.get("model_text")
+    if tokens is None and model_text is None:
         raise QueryParsingError(
-            "text_expansion requires [tokens] (inference output weights)")
-    return TextExpansion(field=fname, tokens={str(k): float(v) for k, v in tokens.items()},
-                         boost=float(opts.get("boost", 1.0)))
+            "text_expansion requires [tokens] (precomputed inference "
+            "output) or [model_text] (on-device expansion)")
+    return TextExpansion(
+        field=fname,
+        tokens=({str(k): float(v) for k, v in tokens.items()}
+                if tokens is not None else None),
+        model_id=opts.get("model_id"),
+        model_text=model_text,
+        boost=float(opts.get("boost", 1.0)))
 
 
 def _parse_script_score(spec):

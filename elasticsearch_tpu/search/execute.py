@@ -731,8 +731,15 @@ def _h_text_expansion(q: dsl.TextExpansion, ctx: SegmentContext) -> Result:
     dev = DeviceFeatures.for_segment(ctx.segment, q.field)
     if dev is None:
         return ctx.zeros(), ctx.none_mask()
+    tokens = q.tokens
+    if tokens is None:
+        # raw query text: run the expansion model on device at query time
+        # (the x-pack inference rewrite, NativeController.java:29 analog,
+        # collapsed into a local jitted dispatch)
+        from elasticsearch_tpu.ml import get_model
+        tokens = get_model(q.model_id).expand(q.model_text or "")
     ex = SparseExecutor(dev, ctx.segment.features[q.field])
-    scores = ex.scores([(t, w * q.boost) for t, w in q.tokens.items()],
+    scores = ex.scores([(t, w * q.boost) for t, w in tokens.items()],
                        ctx.live, function="linear")
     return scores, scores > 0.0
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from elasticsearch_tpu.index.engine import Reader
 from elasticsearch_tpu.index.segment import BLOCK, next_pow2
+from elasticsearch_tpu.ops.bm25 import P1_BUCKET
 from elasticsearch_tpu.mapping import MapperService
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.execute import SegmentContext, execute
@@ -320,9 +321,16 @@ def query_shard(reader: Reader,
     collector = choose_collector_context(
         query, mappers, sort, search_after, min_score, collectors,
         track_total_hits, size)
+    from elasticsearch_tpu.indices.breaker import BREAKERS
+    request_breaker = BREAKERS.breaker("request")
     if collector == "wand_topk":
-        candidates, hits, max_score, prune = _wand_topk_shard(
-            ctxs, query, want, cancel_check)
+        # transient: per-segment phase gathers + top-k outputs, NOT a dense
+        # score vector — pruning is precisely what keeps this small
+        transient = sum(
+            (P1_BUCKET * BLOCK * 8) + want * 8 for _ in ctxs)
+        with request_breaker.limit_scope(transient, "wand_topk"):
+            candidates, hits, max_score, prune = _wand_topk_shard(
+                ctxs, query, want, cancel_check)
         return ShardQueryResult(
             candidates[from_: from_ + size], hits, "gte", max_score,
             doc_count=doc_count, dfs=dfs,
@@ -332,6 +340,26 @@ def query_shard(reader: Reader,
     from elasticsearch_tpu.search.execute import rewrite_knn
     query = rewrite_knn(query, ctxs)
 
+    # transient HBM estimate for the dense path: one f32 score vector plus
+    # mask/where temporaries per segment (HierarchyCircuitBreakerService
+    # request-breaker analog, applied to device memory) — released when the
+    # shard query completes; an over-budget query 429s instead of OOMing
+    transient = sum(8 * ctx.n_docs_pad for ctx in ctxs)
+    request_breaker.add_estimate(transient, "dense_query")
+    try:
+        return _query_shard_dense(
+            ctxs, reader, mappers, query, sort, size, from_, want,
+            search_after, min_score, exact_total, track_limit, total_hits,
+            score_sort, score_asc, collectors, cancel_check, doc_count, dfs,
+            candidates)
+    finally:
+        request_breaker.release(transient)
+
+
+def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
+                       search_after, min_score, exact_total, track_limit,
+                       total_hits, score_sort, score_asc, collectors,
+                       cancel_check, doc_count, dfs, candidates):
     for si, ctx in enumerate(ctxs):
         if cancel_check is not None:
             cancel_check()

@@ -56,6 +56,11 @@ class SearchService:
         t0 = time.monotonic()
         self.reap_scrolls()
         reader = reader or self.engine.acquire_reader()
+        if "text_expansion" in str(body.get("query", "")):
+            from elasticsearch_tpu.ml.text_expansion import (
+                rewrite_body_expansions,
+            )
+            body = rewrite_body_expansions(body)
         query = dsl.parse_query(body.get("query"))
 
         agg_specs = None

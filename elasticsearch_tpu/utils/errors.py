@@ -113,6 +113,23 @@ class RejectedExecutionError(SearchEngineError):
     status = 429
 
 
+class SearchPhaseExecutionError(SearchEngineError):
+    """Every shard of a search failed — the whole request fails with the
+    underlying cause's status (a request-wide 429 when breakers tripped
+    everywhere, not a 200 with empty hits).
+
+    Reference analog: action/search/SearchPhaseExecutionException.java
+    (status() derives from the grouped shard failures' causes).
+    """
+
+    status = 503
+
+    def __init__(self, message: str, cause_status: int = 503,
+                 **metadata):
+        super().__init__(message, **metadata)
+        self.status = cause_status
+
+
 class ClusterBlockError(SearchEngineError):
     """Operation blocked by cluster-level block (e.g. no master, read-only).
 

@@ -1,0 +1,109 @@
+"""Learned sparse expansion (ELSER analog): model, ingest, query.
+
+Reference boundary being re-done TPU-native:
+x-pack/plugin/ml/.../process/NativeController.java:29 (native inference
+process) + TextExpansionQueryBuilder (query-side rewrite) +
+InferenceProcessor (ingest-side). Here inference is a local jitted JAX
+program (ml/text_expansion.py).
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.ml import DEFAULT_MODEL_ID, get_model
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+def test_expansion_is_deterministic_and_anchored():
+    m = get_model()
+    a = m.expand("quick brown fox")
+    b = m.expand("quick brown fox")
+    assert a == b and len(a) > 0
+    # lexical anchoring: the same tokens dominate regardless of context,
+    # so texts sharing words share features
+    c = m.expand("quick red fox")
+    shared = set(a) & set(c)
+    assert len(shared) >= 2   # 'quick' and 'fox' anchors at least
+    # unrelated text shares (almost) nothing of the anchor mass
+    d = m.expand("zebra umbrella")
+    top_a = sorted(a, key=a.get, reverse=True)[:3]
+    assert not (set(top_a) & set(sorted(d, key=d.get, reverse=True)[:3]))
+
+
+def test_expansion_batch_matches_single():
+    m = get_model()
+    texts = ["alpha beta", "gamma delta epsilon", "alpha"]
+    batch = m.expand_batch(texts)
+    assert batch == [m.expand(t) for t in texts]
+
+
+def test_registry_returns_same_instance():
+    assert get_model() is get_model(DEFAULT_MODEL_ID)
+
+
+def test_unknown_model_id_is_404():
+    from elasticsearch_tpu.utils.errors import ResourceNotFoundError
+    with pytest.raises(ResourceNotFoundError):
+        get_model(".elser-typo-9")
+
+
+def test_register_model_deploys():
+    from elasticsearch_tpu.ml import TextExpansionModel, register_model
+    m = TextExpansionModel(model_id="custom-1", vocab_size=512,
+                           hidden=32, n_hash=1 << 10)
+    register_model(m)
+    assert get_model("custom-1") is m
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=1, seed=5)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def test_text_expansion_serving_path(cluster):
+    """Raw text in, on-device inference at ingest AND query time."""
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.put_pipeline("elser", {
+        "processors": [{"inference": {
+            "field": "body", "target_field": "ml.tokens"}}]}, cb)))
+    _ok(*cluster.call(lambda cb: client.create_index("sparse", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "body": {"type": "text"},
+            "ml.tokens": {"type": "rank_features"}}}}, cb)))
+    cluster.ensure_green("sparse")
+    docs = {
+        "d1": "the quick brown fox jumps",
+        "d2": "a lazy dog sleeps in the sun",
+        "d3": "foxes are quick clever animals",
+    }
+    for did, body in docs.items():
+        _ok(*cluster.call(lambda cb, did=did, body=body: client.index_doc(
+            "sparse", did, {"body": body}, cb, pipeline="elser")))
+    cluster.call(lambda cb: client.refresh("sparse", cb))
+
+    # query by RAW TEXT — no precomputed tokens anywhere in the request
+    res = _ok(*cluster.call(lambda cb: client.search("sparse", {
+        "query": {"text_expansion": {"ml.tokens": {
+            "model_text": "quick fox"}}}}, cb)))
+    ids = [h["_id"] for h in res["hits"]["hits"]]
+    assert ids and ids[0] in ("d1", "d3")
+    assert "d2" not in ids[:1]
+
+    # precomputed-tokens form still works and agrees with model output
+    tokens = get_model().expand("quick fox")
+    res2 = _ok(*cluster.call(lambda cb: client.search("sparse", {
+        "query": {"text_expansion": {"ml.tokens": {
+            "tokens": tokens}}}}, cb)))
+    assert [h["_id"] for h in res2["hits"]["hits"]] == ids
+    np.testing.assert_allclose(
+        [h["_score"] for h in res2["hits"]["hits"]],
+        [h["_score"] for h in res["hits"]["hits"]], rtol=1e-6)
