@@ -38,12 +38,28 @@ PUT_MAPPING = "indices:admin/mapping/put"
 UPDATE_SETTINGS = "indices:admin/settings/update"
 UPDATE_ALIASES = "indices:admin/aliases"
 CLUSTER_UPDATE_SETTINGS = "cluster:admin/settings/update"
+PUT_TEMPLATE = "indices:admin/index_template/put"
+DELETE_TEMPLATE = "indices:admin/index_template/delete"
+PUT_ILM_POLICY = "cluster:admin/ilm/put"
+DELETE_ILM_POLICY = "cluster:admin/ilm/delete"
+ROLLOVER = "indices:admin/rollover"
 REFRESH_SHARD = "indices:admin/refresh[s]"
 FLUSH_SHARD = "indices:admin/flush[s]"
 FORCEMERGE_SHARD = "indices:admin/forcemerge[s]"
 STATS_SHARD = "indices:monitor/stats[s]"
 
 MASTER_RETRY_DELAY = 0.2
+
+
+def next_rollover_name(name: str) -> str:
+    """logs-000003 -> logs-000004; unsuffixed names start at -000001
+    (MetadataRolloverService.generateRolloverIndexName analog)."""
+    import re
+    m = re.match(r"^(.*)-(\d+)$", name)
+    if m:
+        prefix, digits = m.groups()
+        return f"{prefix}-{int(digits) + 1:0{len(digits)}d}"
+    return f"{name}-000001"
 
 
 def _validate_mappings(mappings: Dict[str, Any],
@@ -78,6 +94,11 @@ class MasterActions:
             (UPDATE_SETTINGS, self._on_update_settings),
             (UPDATE_ALIASES, self._on_update_aliases),
             (CLUSTER_UPDATE_SETTINGS, self._on_cluster_settings),
+            (PUT_TEMPLATE, self._on_put_template),
+            (DELETE_TEMPLATE, self._on_delete_template),
+            (PUT_ILM_POLICY, self._on_put_ilm_policy),
+            (DELETE_ILM_POLICY, self._on_delete_ilm_policy),
+            (ROLLOVER, self._on_rollover),
             (SHARD_STARTED, self._on_shard_started),
             (SHARD_FAILED, self._on_shard_failed),
         ]:
@@ -99,20 +120,16 @@ class MasterActions:
 
     def _on_create_index(self, req: Dict[str, Any], sender: str) -> Deferred:
         name = req["index"]
-        settings = dict(req.get("settings") or {})
-        n_shards = int(settings.pop("number_of_shards",
-                                    settings.pop("index.number_of_shards", 1)))
-        n_replicas = int(settings.pop(
-            "number_of_replicas", settings.pop("index.number_of_replicas", 1)))
-        mappings = req.get("mappings") or {}
+        req_settings = dict(req.get("settings") or {})
+        req_mappings = req.get("mappings") or {}
         if not name or name.startswith("_") or name != name.lower() \
                 or any(c in name for c in ' ,"*\\<>|?/'):
             raise IllegalArgumentError(f"invalid index name [{name}]")
-        # validate the mapping BEFORE it enters the cluster state: once
-        # committed, every node's applier would fail on it and the index
-        # would never assign (MetadataCreateIndexService validates the same
-        # way by building a MapperService up front)
-        _validate_mappings(mappings)
+        # validate the request mapping BEFORE it enters the cluster state:
+        # once committed, every node's applier would fail on it and the
+        # index would never assign (MetadataCreateIndexService validates
+        # the same way by building a MapperService up front)
+        _validate_mappings(req_mappings)
 
         def update(state: ClusterState) -> ClusterState:
             if state.metadata.has_index(name):
@@ -120,16 +137,58 @@ class MasterActions:
                     return state
                 raise IllegalArgumentError(
                     f"index [{name}] already exists")
-            meta = IndexMetadata.create(
-                name, number_of_shards=n_shards,
-                number_of_replicas=n_replicas,
-                mappings=mappings, settings=settings)
-            new = state.next_version(
-                metadata=state.metadata.put_index(meta),
-                routing_table=state.routing_table.put_index(
-                    IndexRoutingTable.new(name, n_shards, n_replicas)))
-            return self.allocation.reroute(new)
+            return self._create_into(state, name, req_settings, req_mappings)
         return self._submit(f"create-index [{name}]", update)
+
+    def _create_into(self, state: ClusterState, name: str,
+                     req_settings: Dict[str, Any],
+                     req_mappings: Dict[str, Any]) -> ClusterState:
+        """Create ``name`` in ``state`` with matching composable templates
+        applied — lowest priority first, the explicit request winning
+        (MetadataCreateIndexService.applyCreateIndexRequestWithV2Template).
+        Shared by create-index and the atomic half of rollover."""
+        settings: Dict[str, Any] = {}
+        aliases: list = []
+        service = MapperService()
+        # only the single highest-priority matching template applies
+        # (findV2Template: composable templates are winner-takes-all, so
+        # two individually-valid templates can never produce an unmergeable
+        # combined mapping that wedges creation)
+        layers = [t.get("template") or {}
+                  for _n, t in state.metadata.matching_templates(name)[:1]]
+        for tmpl in layers:
+            settings.update(tmpl.get("settings") or {})
+            a = tmpl.get("aliases") or {}
+            aliases.extend(a if isinstance(a, (list, tuple)) else a.keys())
+            if tmpl.get("mappings"):
+                service.merge(dict(tmpl["mappings"]))
+        if req_mappings:
+            service.merge(dict(req_mappings))
+        mappings = service.to_mapping()
+        for src in [t.get("mappings") or {} for t in layers] + [req_mappings]:
+            for k, v in src.items():
+                if k.startswith("_") or k in _ROOT_MAPPING_KEYS:
+                    mappings[k] = v
+        settings.update(req_settings)
+        n_shards = int(settings.pop(
+            "number_of_shards", settings.pop("index.number_of_shards", 1)))
+        n_replicas = int(settings.pop(
+            "number_of_replicas",
+            settings.pop("index.number_of_replicas", 1)))
+        # creation timestamp for age-based rollover/ILM conditions —
+        # PERSISTED, so it must be epoch time, not the monotonic clock
+        settings.setdefault("index.creation_date",
+                            int(self.coordinator.scheduler.wall_now() * 1000))
+        meta = IndexMetadata.create(
+            name, number_of_shards=n_shards, number_of_replicas=n_replicas,
+            mappings=mappings, settings=settings)
+        if aliases:
+            meta = meta.with_aliases(tuple(dict.fromkeys(aliases)))
+        new = state.next_version(
+            metadata=state.metadata.put_index(meta),
+            routing_table=state.routing_table.put_index(
+                IndexRoutingTable.new(name, n_shards, n_replicas)))
+        return self.allocation.reroute(new)
 
     def _on_delete_index(self, req: Dict[str, Any], sender: str) -> Deferred:
         name = req["index"]
@@ -220,6 +279,119 @@ class MasterActions:
             return state.next_version(
                 metadata=state.metadata.with_persistent_settings(persistent))
         return self._submit("cluster-update-settings", update)
+
+    # -- index templates (MetadataIndexTemplateService analog) ----------
+
+    def _on_put_template(self, req: Dict[str, Any], sender: str) -> Deferred:
+        name = req["name"]
+        body = dict(req.get("body") or {})
+        patterns = body.get("index_patterns")
+        if not patterns or not isinstance(patterns, (list, tuple)):
+            raise IllegalArgumentError(
+                "index template requires [index_patterns]")
+        # reject broken template mappings at the API, not at create time
+        _validate_mappings((body.get("template") or {}).get("mappings") or {})
+
+        def update(state: ClusterState) -> ClusterState:
+            return state.next_version(
+                metadata=state.metadata.with_template(name, body))
+        return self._submit(f"put-template [{name}]", update)
+
+    def _on_delete_template(self, req: Dict[str, Any],
+                            sender: str) -> Deferred:
+        name = req["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            if name not in state.metadata.templates:
+                from elasticsearch_tpu.utils.errors import (
+                    ResourceNotFoundError,
+                )
+                raise ResourceNotFoundError(
+                    f"index template [{name}] not found")
+            return state.next_version(
+                metadata=state.metadata.with_template(name, None))
+        return self._submit(f"delete-template [{name}]", update)
+
+    # -- ILM policies (IndexLifecycleService metadata half) --------------
+
+    def _on_put_ilm_policy(self, req: Dict[str, Any],
+                           sender: str) -> Deferred:
+        name = req["name"]
+        policy = dict(req.get("policy") or {})
+
+        def update(state: ClusterState) -> ClusterState:
+            return state.next_version(
+                metadata=state.metadata.with_ilm_policy(name, policy))
+        return self._submit(f"put-ilm-policy [{name}]", update)
+
+    def _on_delete_ilm_policy(self, req: Dict[str, Any],
+                              sender: str) -> Deferred:
+        name = req["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            if name not in state.metadata.ilm_policies:
+                from elasticsearch_tpu.utils.errors import (
+                    ResourceNotFoundError,
+                )
+                raise ResourceNotFoundError(f"policy [{name}] not found")
+            return state.next_version(
+                metadata=state.metadata.with_ilm_policy(name, None))
+        return self._submit(f"delete-ilm-policy [{name}]", update)
+
+    # -- rollover (TransportRolloverAction's atomic state half) ----------
+
+    def _on_rollover(self, req: Dict[str, Any], sender: str) -> Deferred:
+        """Atomically create the next index in the series and swap the
+        write alias. Condition evaluation (doc counts, age) happens on the
+        coordinator BEFORE this is sent; this handler is the single
+        cluster-state update (MetadataRolloverService.rolloverClusterState)."""
+        alias = req["alias"]
+
+        def update(state: ClusterState) -> ClusterState:
+            sources = [im for im in state.metadata.indices.values()
+                       if alias in im.aliases]
+            if len(sources) != 1:
+                raise IllegalArgumentError(
+                    f"rollover alias [{alias}] must point to exactly one "
+                    f"index, found {len(sources)}")
+            old = sources[0]
+            # the coordinator resolves new_index BEFORE sending, so a
+            # MasterClient retry after a lost response fails here with
+            # "already exists" instead of silently rolling twice
+            new_name = req.get("new_index") or next_rollover_name(old.name)
+            if state.metadata.has_index(new_name):
+                raise IllegalArgumentError(
+                    f"rollover target [{new_name}] already exists")
+            state = self._create_into(state, new_name,
+                                      dict(req.get("settings") or {}),
+                                      dict(req.get("mappings") or {}))
+            metadata = state.metadata
+            now_ms = int(self.coordinator.scheduler.wall_now() * 1000)
+            old_meta = metadata.index(old.name)
+            metadata = metadata.update_index(old_meta.with_aliases(
+                tuple(a for a in old_meta.aliases if a != alias)
+            ).with_settings({"index.rollover_date": now_ms}))
+            new_meta = metadata.index(new_name)
+            metadata = metadata.update_index(new_meta.with_aliases(
+                tuple(dict.fromkeys(list(new_meta.aliases) + [alias]))))
+            return state.next_version(metadata=metadata)
+
+        deferred = Deferred()
+
+        def done(err: Optional[Exception]) -> None:
+            if err is not None:
+                deferred.reject(err)
+            else:
+                # report what the committed state actually did
+                state = self.coordinator.applied_state
+                targets = [im.name for im in state.metadata.indices.values()
+                           if alias in im.aliases]
+                deferred.resolve({
+                    "acknowledged": True, "rolled_over": True,
+                    "new_index": targets[0] if targets else None})
+        self.coordinator.submit_state_update(
+            f"rollover [{alias}]", update, done)
+        return deferred
 
     # -- shard state ----------------------------------------------------
 

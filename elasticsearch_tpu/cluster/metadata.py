@@ -102,7 +102,13 @@ class Metadata:
     """All cluster-wide persistent metadata (indices, templates, settings)."""
 
     indices: Mapping[str, IndexMetadata] = field(default_factory=dict)
+    # composable index templates: name -> {index_patterns, priority,
+    # template: {settings, mappings, aliases}}
+    # (cluster/metadata/ComposableIndexTemplate.java analog)
     templates: Mapping[str, Any] = field(default_factory=dict)
+    # ILM policies: name -> {phases: {hot: {...}, delete: {...}}}
+    # (x-pack/plugin/core/.../ilm/LifecyclePolicy.java analog)
+    ilm_policies: Mapping[str, Any] = field(default_factory=dict)
     persistent_settings: Mapping[str, Any] = field(default_factory=dict)
     version: int = 0
 
@@ -129,38 +135,61 @@ class Metadata:
         if im.name in self.indices:
             raise IndexAlreadyExistsError(
                 f"index [{im.name}] already exists")
-        return Metadata(indices={**self.indices, im.name: im},
-                        templates=self.templates,
-                        persistent_settings=self.persistent_settings,
-                        version=self.version + 1)
+        return replace(self, indices={**self.indices, im.name: im},
+                       version=self.version + 1)
 
     def update_index(self, im: IndexMetadata) -> "Metadata":
         if im.name not in self.indices:
             raise IndexNotFoundError(im.name)
-        return Metadata(indices={**self.indices, im.name: im},
-                        templates=self.templates,
-                        persistent_settings=self.persistent_settings,
-                        version=self.version + 1)
+        return replace(self, indices={**self.indices, im.name: im},
+                       version=self.version + 1)
 
     def remove_index(self, name: str) -> "Metadata":
         if name not in self.indices:
             raise IndexNotFoundError(name)
         indices = {k: v for k, v in self.indices.items() if k != name}
-        return Metadata(indices=indices, templates=self.templates,
-                        persistent_settings=self.persistent_settings,
-                        version=self.version + 1)
+        return replace(self, indices=indices, version=self.version + 1)
+
+    def with_template(self, name: str,
+                      template: Optional[Mapping[str, Any]]) -> "Metadata":
+        """Put (or with None, delete) one composable index template."""
+        templates = {k: v for k, v in self.templates.items() if k != name}
+        if template is not None:
+            templates[name] = dict(template)
+        return replace(self, templates=templates, version=self.version + 1)
+
+    def with_ilm_policy(self, name: str,
+                        policy: Optional[Mapping[str, Any]]) -> "Metadata":
+        policies = {k: v for k, v in self.ilm_policies.items() if k != name}
+        if policy is not None:
+            policies[name] = dict(policy)
+        return replace(self, ilm_policies=policies,
+                       version=self.version + 1)
 
     def with_persistent_settings(self, settings: Mapping[str, Any]) -> "Metadata":
         # a None value unsets the key (the reference's null-reset semantics
         # for PUT _cluster/settings)
         merged = {**self.persistent_settings, **settings}
         merged = {k: v for k, v in merged.items() if v is not None}
-        return Metadata(indices=self.indices, templates=self.templates,
-                        persistent_settings=merged, version=self.version + 1)
+        return replace(self, persistent_settings=merged,
+                       version=self.version + 1)
+
+    def matching_templates(self, index_name: str) -> list:
+        """Templates whose index_patterns match, highest priority first
+        (MetadataIndexTemplateService.findV2Template analog)."""
+        import fnmatch
+        hits = []
+        for name, t in self.templates.items():
+            if any(fnmatch.fnmatch(index_name, p)
+                   for p in t.get("index_patterns", [])):
+                hits.append((int(t.get("priority", 0)), name, t))
+        hits.sort(key=lambda h: (-h[0], h[1]))
+        return [(name, t) for _, name, t in hits]
 
     def to_dict(self) -> Dict[str, Any]:
         return {"indices": {k: v.to_dict() for k, v in self.indices.items()},
                 "templates": dict(self.templates),
+                "ilm_policies": dict(self.ilm_policies),
                 "persistent_settings": dict(self.persistent_settings),
                 "version": self.version}
 
@@ -170,6 +199,7 @@ class Metadata:
             indices={k: IndexMetadata.from_dict(v)
                      for k, v in d.get("indices", {}).items()},
             templates=dict(d.get("templates", {})),
+            ilm_policies=dict(d.get("ilm_policies", {})),
             persistent_settings=dict(d.get("persistent_settings", {})),
             version=d.get("version", 0))
 

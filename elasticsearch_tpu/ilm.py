@@ -1,0 +1,131 @@
+"""Index lifecycle management (ILM-lite): hot -> rollover, then delete.
+
+Reference: x-pack/plugin/ilm/.../IndexLifecycleService.java:53 — a
+master-side periodic service that walks indices carrying an
+``index.lifecycle.name`` setting and advances them through their policy's
+phases. This build implements the two phases that cover the dominant
+time-series workflow:
+
+  hot:    {actions: {rollover: {max_age, max_docs}}}  — roll the write
+          alias (``index.lifecycle.rollover_alias``) when a condition
+          trips; the rollover API applies matching index templates to the
+          new index, so the series keeps its mappings.
+  delete: {min_age: "30d", ...}                       — delete an index
+          once it has been rolled over (or created) ``min_age`` ago.
+
+The loop only acts while this node is the elected master (the reference
+gates on the same condition), and every action goes through the normal
+master APIs — ILM is policy over the existing primitives, not a second
+control plane.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from elasticsearch_tpu.utils.settings import parse_time_to_seconds
+
+logger = logging.getLogger(__name__)
+
+POLL_INTERVAL_SETTING = "indices.lifecycle.poll_interval"
+DEFAULT_POLL_INTERVAL = 10.0
+
+
+class IndexLifecycleService:
+    def __init__(self, node) -> None:
+        self.node = node
+        self._running = False
+        self._timer = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self._schedule()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._timer is not None:
+            self._timer.cancel()
+
+    def _poll_interval(self) -> float:
+        state = self.node._applied_state()
+        raw = state.metadata.persistent_settings.get(
+            POLL_INTERVAL_SETTING, DEFAULT_POLL_INTERVAL)
+        try:
+            return max(0.5, parse_time_to_seconds(raw))
+        except (TypeError, ValueError):
+            return DEFAULT_POLL_INTERVAL
+
+    def _schedule(self) -> None:
+        if not self._running:
+            return
+        self._timer = self.node.scheduler.schedule(
+            self._poll_interval(), self._tick)
+
+    # -- the loop --------------------------------------------------------
+
+    def _tick(self) -> None:
+        if not self._running:
+            return
+        try:
+            if self.node.coordinator.mode == "LEADER":
+                self.run_once()
+        except Exception:  # noqa: BLE001 — the loop must survive anything
+            logger.exception("ilm tick failed")
+        self._schedule()
+
+    def run_once(self) -> None:
+        """One pass over managed indices (triggerPolicies analog). Public
+        so tests and an explicit API can step the lifecycle without
+        waiting for the poll timer."""
+        state = self.node._applied_state()
+        now_ms = self.node.scheduler.wall_now() * 1000
+        for meta in list(state.metadata.indices.values()):
+            policy_name = meta.settings.get("index.lifecycle.name")
+            if not policy_name:
+                continue
+            policy = state.metadata.ilm_policies.get(policy_name)
+            if not policy:
+                continue
+            phases = policy.get("phases") or {}
+            try:
+                self._advance(meta, phases, now_ms)
+            except Exception:  # noqa: BLE001 — one index must not stall ILM
+                logger.exception("ilm advance failed for [%s]", meta.name)
+
+    def _advance(self, meta, phases: Dict[str, Any], now_ms: float) -> None:
+        rolled_ms = meta.settings.get("index.rollover_date")
+        delete_phase = phases.get("delete") or {}
+        hot = (phases.get("hot") or {}).get("actions") or {}
+        rollover = hot.get("rollover")
+
+        # delete-phase age origin: the rollover when one happened; for a
+        # policy WITHOUT a rollover action, the creation date — an index
+        # that is still this series' write target (rollover pending) is
+        # never deleted out from under the writers
+        origin_ms = None
+        if rolled_ms is not None:
+            origin_ms = int(rolled_ms)
+        elif rollover is None:
+            origin_ms = int(meta.settings.get("index.creation_date", 0)
+                            or 0) or None
+        if delete_phase and origin_ms is not None:
+            min_age_s = parse_time_to_seconds(
+                delete_phase.get("min_age", 0))
+            if now_ms - origin_ms >= min_age_s * 1000:
+                logger.info("ilm: deleting [%s] (delete phase)", meta.name)
+                self.node.client.delete_index(meta.name, _log_err)
+            return
+
+        alias = meta.settings.get("index.lifecycle.rollover_alias")
+        if rollover is not None and alias and alias in meta.aliases:
+            self.node.client.rollover(
+                alias, {"conditions": dict(rollover)}, _log_err)
+
+
+def _log_err(_resp: Optional[Dict[str, Any]], err: Optional[Exception]
+             ) -> None:
+    if err is not None:
+        logger.warning("ilm action failed: %s", err)

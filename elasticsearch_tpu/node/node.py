@@ -151,6 +151,9 @@ class Node:
 
         self.client = NodeClient(self)
 
+        from elasticsearch_tpu.ilm import IndexLifecycleService
+        self.ilm_service = IndexLifecycleService(self)
+
     # ------------------------------------------------------------------
 
     def _applied_state(self) -> ClusterState:
@@ -212,8 +215,10 @@ class Node:
 
     def start(self) -> None:
         self.coordinator.start()
+        self.ilm_service.start()
 
     def stop(self) -> None:
+        self.ilm_service.stop()
         self.coordinator.stop()
         self.transport_service.close()
         self.indices_service.close()
@@ -266,6 +271,121 @@ class NodeClient:
         state = self.node._applied_state()
         meta = state.metadata.index(name)
         return {meta.name: {"mappings": dict(meta.mappings)}}
+
+    # -- index templates / ILM / rollover -------------------------------
+
+    def put_index_template(self, name: str, body: Dict[str, Any],
+                           on_done) -> None:
+        from elasticsearch_tpu.action.admin import PUT_TEMPLATE
+        self.node.master_client.execute(
+            PUT_TEMPLATE, {"name": name, "body": body}, on_done)
+
+    def delete_index_template(self, name: str, on_done) -> None:
+        from elasticsearch_tpu.action.admin import DELETE_TEMPLATE
+        self.node.master_client.execute(
+            DELETE_TEMPLATE, {"name": name}, on_done)
+
+    def get_index_templates(self, name: Optional[str] = None
+                            ) -> Dict[str, Any]:
+        templates = self.node._applied_state().metadata.templates
+        if name is not None:
+            import fnmatch
+            templates = {k: v for k, v in templates.items()
+                         if fnmatch.fnmatch(k, name)}
+        return {"index_templates": [
+            {"name": k, "index_template": dict(v)}
+            for k, v in sorted(templates.items())]}
+
+    def put_ilm_policy(self, name: str, body: Dict[str, Any],
+                       on_done) -> None:
+        from elasticsearch_tpu.action.admin import PUT_ILM_POLICY
+        self.node.master_client.execute(
+            PUT_ILM_POLICY,
+            {"name": name, "policy": (body or {}).get("policy", body)},
+            on_done)
+
+    def delete_ilm_policy(self, name: str, on_done) -> None:
+        from elasticsearch_tpu.action.admin import DELETE_ILM_POLICY
+        self.node.master_client.execute(
+            DELETE_ILM_POLICY, {"name": name}, on_done)
+
+    def get_ilm_policies(self) -> Dict[str, Any]:
+        return {k: {"policy": dict(v)} for k, v in sorted(
+            self.node._applied_state().metadata.ilm_policies.items())}
+
+    def rollover(self, alias: str, body: Optional[Dict[str, Any]],
+                 on_done) -> None:
+        """Coordinator half of rollover (TransportRolloverAction): evaluate
+        conditions against live stats, then submit the atomic state update.
+        No conditions means roll unconditionally."""
+        from elasticsearch_tpu.action.admin import (
+            ROLLOVER, next_rollover_name,
+        )
+        from elasticsearch_tpu.utils.errors import IllegalArgumentError
+        body = body or {}
+        conditions = body.get("conditions") or {}
+        unknown = set(conditions) - {"max_age", "max_docs"}
+        if unknown:
+            # silently ignoring a condition would mean "never rolls" with
+            # no signal — reject like an unknown request parameter
+            on_done(None, IllegalArgumentError(
+                f"unknown rollover conditions {sorted(unknown)}; "
+                "supported: max_age, max_docs"))
+            return
+        state = self.node._applied_state()
+        try:
+            source = state.metadata.index(alias)   # exactly-one resolution
+        except Exception as e:  # noqa: BLE001 — not-found / ambiguous
+            on_done(None, e)
+            return
+        if alias not in source.aliases:
+            on_done(None, IllegalArgumentError(
+                f"rollover target [{alias}] is a concrete index, not an "
+                "alias"))
+            return
+        new_index = body.get("new_index") or next_rollover_name(source.name)
+
+        def proceed(met: Dict[str, bool]) -> None:
+            if conditions and not any(met.values()):
+                on_done({"acknowledged": False, "rolled_over": False,
+                         "dry_run": bool(body.get("dry_run")),
+                         "conditions": met}, None)
+                return
+            if body.get("dry_run"):
+                on_done({"acknowledged": False, "rolled_over": False,
+                         "dry_run": True, "conditions": met}, None)
+                return
+            self.node.master_client.execute(ROLLOVER, {
+                "alias": alias,
+                "new_index": new_index,
+                "settings": body.get("settings") or {},
+                "mappings": body.get("mappings") or {},
+            }, lambda resp, err: on_done(
+                {**(resp or {}), "old_index": source.name,
+                 "conditions": met} if err is None else None, err))
+
+        met: Dict[str, bool] = {}
+        if "max_age" in conditions:
+            created = int(source.settings.get("index.creation_date", 0))
+            age_ms = self.node.scheduler.wall_now() * 1000 - created
+            from elasticsearch_tpu.utils.settings import (
+                parse_time_to_seconds,
+            )
+            met[f"[max_age: {conditions['max_age']}]"] = \
+                age_ms >= parse_time_to_seconds(conditions["max_age"]) * 1000
+        if "max_docs" in conditions:
+            def with_stats(resp, err=None):
+                docs = 0
+                if resp is not None:
+                    idx = resp.get("indices", {}).get(source.name, {})
+                    docs = idx.get("primaries", {}).get(
+                        "docs", {}).get("count", 0)
+                met[f"[max_docs: {conditions['max_docs']}]"] = \
+                    docs >= int(conditions["max_docs"])
+                proceed(met)
+            self.index_stats(source.name, with_stats)
+            return
+        proceed(met)
 
     # -- documents ------------------------------------------------------
 

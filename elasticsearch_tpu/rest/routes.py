@@ -295,6 +295,52 @@ def build_controller(client: NodeClient) -> RestController:
                               wrap_client_cb(done))
     r("POST", "/_aliases", aliases_post)
 
+    # -- index templates / ILM / rollover --------------------------------
+
+    def template_put(req: RestRequest, done: DoneFn) -> None:
+        client.put_index_template(req.params["name"], req.body or {},
+                                  wrap_client_cb(done))
+    r("PUT", "/_index_template/{name}", template_put)
+    r("POST", "/_index_template/{name}", template_put)
+
+    def template_delete(req: RestRequest, done: DoneFn) -> None:
+        client.delete_index_template(req.params["name"],
+                                     wrap_client_cb(done))
+    r("DELETE", "/_index_template/{name}", template_delete)
+
+    def template_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.get_index_templates(req.params.get("name")))
+    r("GET", "/_index_template", template_get)
+    r("GET", "/_index_template/{name}", template_get)
+
+    def ilm_put(req: RestRequest, done: DoneFn) -> None:
+        client.put_ilm_policy(req.params["name"], req.body or {},
+                              wrap_client_cb(done))
+    r("PUT", "/_ilm/policy/{name}", ilm_put)
+
+    def ilm_delete(req: RestRequest, done: DoneFn) -> None:
+        client.delete_ilm_policy(req.params["name"], wrap_client_cb(done))
+    r("DELETE", "/_ilm/policy/{name}", ilm_delete)
+
+    def ilm_get(req: RestRequest, done: DoneFn) -> None:
+        policies = client.get_ilm_policies()
+        name = req.params.get("name")
+        if name is not None:
+            if name not in policies:
+                from elasticsearch_tpu.utils.errors import (
+                    ResourceNotFoundError,
+                )
+                raise ResourceNotFoundError(f"policy [{name}] not found")
+            policies = {k: v for k, v in policies.items() if k == name}
+        done(200, policies)
+    r("GET", "/_ilm/policy", ilm_get)
+    r("GET", "/_ilm/policy/{name}", ilm_get)
+
+    def rollover_post(req: RestRequest, done: DoneFn) -> None:
+        client.rollover(req.params["index"], req.body or {},
+                        wrap_client_cb(done))
+    r("POST", "/{index}/_rollover", rollover_post)
+
     def alias_get(req: RestRequest, done: DoneFn) -> None:
         state = client.node._applied_state()
         out: Dict[str, Any] = {}

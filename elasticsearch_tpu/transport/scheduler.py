@@ -38,6 +38,13 @@ class Scheduler:
     def now(self) -> float:
         raise NotImplementedError
 
+    def wall_now(self) -> float:
+        """Epoch seconds for PERSISTED timestamps (index creation/rollover
+        dates). now() is monotonic in production and resets per process —
+        anything written into durable cluster state must use this instead.
+        The deterministic scheduler's virtual time doubles as its epoch."""
+        return self.now()
+
     def schedule(self, delay: float, fn: Callable[[], None]) -> Cancellable:
         raise NotImplementedError
 
@@ -132,6 +139,9 @@ class ThreadedScheduler(Scheduler):
 
     def now(self) -> float:
         return time.monotonic()
+
+    def wall_now(self) -> float:
+        return time.time()
 
     def schedule(self, delay: float, fn: Callable[[], None]) -> Cancellable:
         handle = Cancellable()

@@ -1,0 +1,165 @@
+"""Index templates, rollover, and ILM-lite.
+
+Reference: cluster/metadata/MetadataIndexTemplateService.java (composable
+templates, apply-on-create), MetadataRolloverService (atomic create+swap),
+x-pack/plugin/ilm/.../IndexLifecycleService.java:53 (hot->delete loop).
+"""
+
+import pytest
+
+from elasticsearch_tpu.action.admin import next_rollover_name
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=1, seed=4)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def test_next_rollover_name():
+    assert next_rollover_name("logs-000001") == "logs-000002"
+    assert next_rollover_name("logs-000999") == "logs-001000"
+    assert next_rollover_name("logs") == "logs-000001"
+    assert next_rollover_name("a-1") == "a-2"
+
+
+def test_template_applied_on_create(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.put_index_template("logs-t", {
+        "index_patterns": ["logs-*"], "priority": 10,
+        "template": {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"properties": {"msg": {"type": "text"},
+                                        "level": {"type": "keyword"}}},
+            "aliases": {"logs-read": {}},
+        }}, cb)))
+    # higher-priority template wins on overlap
+    _ok(*cluster.call(lambda cb: client.put_index_template("logs-hot", {
+        "index_patterns": ["logs-hot-*"], "priority": 20,
+        "template": {"settings": {"number_of_shards": 1,
+                                  "number_of_replicas": 0}}}, cb)))
+
+    _ok(*cluster.call(lambda cb: client.create_index("logs-000001", {}, cb)))
+    cluster.ensure_green("logs-000001")
+    state = cluster.master()._applied_state()
+    meta = state.metadata.index("logs-000001")
+    assert meta.number_of_shards == 2
+    assert meta.mappings["properties"]["level"]["type"] == "keyword"
+    assert "logs-read" in meta.aliases
+
+    _ok(*cluster.call(lambda cb: client.create_index("logs-hot-1", {}, cb)))
+    assert cluster.master()._applied_state().metadata.index(
+        "logs-hot-1").number_of_shards == 1
+
+    # request wins over template
+    _ok(*cluster.call(lambda cb: client.create_index(
+        "logs-explicit", {"settings": {"number_of_shards": 3,
+                                       "number_of_replicas": 0}}, cb)))
+    assert cluster.master()._applied_state().metadata.index(
+        "logs-explicit").number_of_shards == 3
+
+    got = client.get_index_templates("logs-*")
+    assert {t["name"] for t in got["index_templates"]} == \
+        {"logs-t", "logs-hot"}
+    _ok(*cluster.call(lambda cb: client.delete_index_template("logs-hot",
+                                                              cb)))
+    assert len(client.get_index_templates()["index_templates"]) == 1
+
+
+def test_rollover_swaps_write_alias(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.put_index_template("series", {
+        "index_patterns": ["series-*"],
+        "template": {"settings": {"number_of_replicas": 0},
+                     "mappings": {"properties": {
+                         "msg": {"type": "text"}}}}}, cb)))
+    _ok(*cluster.call(lambda cb: client.create_index(
+        "series-000001", {"aliases": None}, cb)))
+    _ok(*cluster.call(lambda cb: client.update_aliases(
+        [{"add": {"index": "series-000001", "alias": "series-write"}}], cb)))
+    cluster.ensure_green("series-000001")
+
+    for i in range(5):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "series-write", f"d{i}", {"msg": f"m{i}"}, cb)))
+    cluster.call(lambda cb: client.refresh("series-000001", cb))
+
+    # unmet conditions: no rollover
+    resp = _ok(*cluster.call(lambda cb: client.rollover(
+        "series-write", {"conditions": {"max_docs": 100}}, cb)))
+    assert resp["rolled_over"] is False
+
+    # met conditions: atomic create + alias swap, template applied
+    resp = _ok(*cluster.call(lambda cb: client.rollover(
+        "series-write", {"conditions": {"max_docs": 3}}, cb)))
+    assert resp["rolled_over"] is True
+    assert resp["new_index"] == "series-000002"
+    cluster.ensure_green("series-000002")
+    state = cluster.master()._applied_state()
+    assert "series-write" in state.metadata.index("series-000002").aliases
+    assert "series-write" not in state.metadata.indices[
+        "series-000001"].aliases
+    assert state.metadata.index("series-000002").mappings[
+        "properties"]["msg"]["type"] == "text"
+    # writes through the alias land in the new index
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "series-write", "fresh", {"msg": "new"}, cb)))
+    cluster.call(lambda cb: client.refresh("series-000002", cb))
+    res = _ok(*cluster.call(lambda cb: client.search(
+        "series-000002", {"query": {"match_all": {}}}, cb)))
+    assert res["hits"]["total"]["value"] == 1
+
+
+def test_ilm_hot_rollover_then_delete(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.put_ilm_policy("ts", {
+        "policy": {"phases": {
+            "hot": {"actions": {"rollover": {"max_docs": 2}}},
+            "delete": {"min_age": "1h"},
+        }}}, cb)))
+    _ok(*cluster.call(lambda cb: client.put_index_template("ts-t", {
+        "index_patterns": ["ts-*"],
+        "template": {"settings": {
+            "number_of_replicas": 0,
+            "index.lifecycle.name": "ts",
+            "index.lifecycle.rollover_alias": "ts-write"}}}, cb)))
+    _ok(*cluster.call(lambda cb: client.create_index("ts-000001", {}, cb)))
+    _ok(*cluster.call(lambda cb: client.update_aliases(
+        [{"add": {"index": "ts-000001", "alias": "ts-write"}}], cb)))
+    cluster.ensure_green("ts-000001")
+    for i in range(3):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "ts-write", f"d{i}", {"n": i}, cb)))
+    cluster.call(lambda cb: client.refresh("ts-000001", cb))
+
+    # one lifecycle pass: hot-phase rollover fires (max_docs=2 exceeded)
+    cluster.master().ilm_service.run_once()
+    cluster.scheduler.run_for(5.0)
+    state = cluster.master()._applied_state()
+    assert state.metadata.has_index("ts-000002"), \
+        sorted(state.metadata.indices)
+    assert "ts-write" in state.metadata.index("ts-000002").aliases
+    # the new index inherited the policy via the template
+    assert state.metadata.index("ts-000002").settings[
+        "index.lifecycle.name"] == "ts"
+
+    # not yet old enough for the delete phase
+    cluster.master().ilm_service.run_once()
+    cluster.scheduler.run_for(5.0)
+    assert cluster.master()._applied_state().metadata.has_index("ts-000001")
+
+    # advance virtual time past min_age: the rolled index is deleted
+    cluster.scheduler.run_for(3700.0)
+    cluster.master().ilm_service.run_once()
+    cluster.scheduler.run_for(5.0)
+    state = cluster.master()._applied_state()
+    assert not state.metadata.has_index("ts-000001")
+    assert state.metadata.has_index("ts-000002")
