@@ -289,6 +289,12 @@ class MasterActions:
         if not patterns or not isinstance(patterns, (list, tuple)):
             raise IllegalArgumentError(
                 "index template requires [index_patterns]")
+        try:
+            int(body.get("priority", 0))
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                f"template [priority] must be an integer, got "
+                f"[{body.get('priority')!r}]")
         # reject broken template mappings at the API, not at create time
         _validate_mappings((body.get("template") or {}).get("mappings") or {})
 

@@ -128,6 +128,9 @@ class SearchTransportService:
                 df_overrides=req.get("df_overrides"),
                 field_stats_overrides=req.get("field_stats_overrides"),
                 collectors=[aggregator] if aggregator else None,
+                rescore=body.get("rescore"),
+                collapse=body.get("collapse"),
+                slice_spec=body.get("slice"),
                 cancel_check=(shard_task.ensure_not_cancelled
                               if shard_task else None))
         finally:
@@ -153,7 +156,8 @@ class SearchTransportService:
             "collector": result.collector,
             "prune": list(result.prune_stats) if result.prune_stats else None,
             "docs": [{"segment": d.segment_idx, "doc": d.doc,
-                      "score": d.score, "sort": list(d.sort_values)}
+                      "score": d.score, "sort": list(d.sort_values),
+                      **({"ckey": d.ckey} if d.ckey is not None else {})}
                      for d in result.docs],
             "aggs_partial": aggregator.partial() if aggregator else None,
             "suggest_partial": (
@@ -545,6 +549,20 @@ class TransportSearchAction:
             entries.sort(key=lambda e: (-e[1]["score"], e[0],
                                         e[1]["segment"], e[1]["doc"]))
 
+        if body.get("collapse"):
+            # cross-shard collapse: keep the best hit per key
+            # (SearchPhaseController merge of CollapseTopFieldDocs)
+            from elasticsearch_tpu.search.phase import collapse_marker
+            seen: set = set()
+            deduped = []
+            for e in entries:
+                marker = collapse_marker(e[1].get("ckey"))
+                if marker in seen:
+                    continue
+                seen.add(marker)
+                deduped.append(e)
+            entries = deduped
+
         winners = entries[from_:from_ + size]
         if not winners:
             self._complete(self._finalize(t0, targets, body, phase_state,
@@ -569,7 +587,11 @@ class TransportSearchAction:
 
             def cb(resp, err):
                 if err is None and resp is not None:
-                    for (order, _), hit in zip(docs, resp["hits"]):
+                    cfield = (body.get("collapse") or {}).get("field")
+                    for (order, d), hit in zip(docs, resp["hits"]):
+                        if cfield and d.get("ckey") is not None:
+                            hit.setdefault("fields", {})[cfield] = \
+                                [d["ckey"]]
                         hits_out[order] = hit
                 else:
                     phase_state["failed"] += 1

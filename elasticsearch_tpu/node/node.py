@@ -375,11 +375,15 @@ class NodeClient:
                 age_ms >= parse_time_to_seconds(conditions["max_age"]) * 1000
         if "max_docs" in conditions:
             def with_stats(resp, err=None):
-                docs = 0
-                if resp is not None:
-                    idx = resp.get("indices", {}).get(source.name, {})
-                    docs = idx.get("primaries", {}).get(
-                        "docs", {}).get("count", 0)
+                if err is not None or resp is None:
+                    # a stats failure must NOT read as "condition unmet" —
+                    # that would silently stop a series from ever rolling
+                    on_done(None, err or SearchEngineError(
+                        f"stats unavailable for [{source.name}]"))
+                    return
+                idx = resp.get("indices", {}).get(source.name, {})
+                docs = idx.get("primaries", {}).get(
+                    "docs", {}).get("count", 0)
                 met[f"[max_docs: {conditions['max_docs']}]"] = \
                     docs >= int(conditions["max_docs"])
                 proceed(met)

@@ -41,6 +41,8 @@ def mesh_eligible(body: Dict[str, Any]) -> Optional[str]:
         return None
     if body.get("min_score") is not None:
         return None
+    if body.get("rescore") or body.get("collapse") or body.get("slice"):
+        return None
     if not (body.get("track_total_hits") is False
             or body.get("track_total_hits") == 0):
         return None

@@ -211,6 +211,9 @@ class Nested(Query):
     path: str = ""
     query: Query = None
     score_mode: str = "avg"
+    # inner_hits spec ({} = defaults): the fetch phase returns the matching
+    # nested objects per hit (InnerHitsPhase analog)
+    inner_hits: Optional[Dict[str, Any]] = None
     boost: float = 1.0
 
 
@@ -391,6 +394,11 @@ _PARSERS = {
         negative_boost=float(spec.get("negative_boost", 0.5)),
         boost=float(spec.get("boost", 1.0))),
     "knn": _parse_knn,
+    "nested": lambda spec: Nested(
+        path=spec["path"], query=parse_query(spec.get("query")),
+        score_mode=spec.get("score_mode", "avg"),
+        inner_hits=spec.get("inner_hits"),
+        boost=float(spec.get("boost", 1.0))),
     "rank_feature": _parse_rank_feature,
     "text_expansion": _parse_text_expansion,
     "script_score": _parse_script_score,

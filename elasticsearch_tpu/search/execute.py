@@ -744,6 +744,38 @@ def _h_text_expansion(q: dsl.TextExpansion, ctx: SegmentContext) -> Result:
     return scores, scores > 0.0
 
 
+def _h_nested(q: dsl.Nested, ctx: SegmentContext) -> Result:
+    """Per-object nested matching over _source (search/nested.py).
+
+    The device columns flatten nested arrays — precisely the cross-object
+    false match the nested type exists to prevent — so the object-scoped
+    constraint runs host-side against the stored sources, like the
+    reference's hidden sub-document join (NestedQueryBuilder). Matching
+    docs score a constant boost (documented divergence: no per-child BM25)."""
+    from elasticsearch_tpu.search.nested import (
+        match_object, nested_objects,
+    )
+    seg = ctx.segment
+
+    def build():
+        mask_host = np.zeros(seg.n_docs, bool)
+        for d in range(seg.n_docs):
+            for obj in nested_objects(seg.sources[d] or {}, q.path):
+                if match_object(obj, q.query, q.path):
+                    mask_host[d] = True
+                    break
+        return ctx.to_device_mask(mask_host)
+
+    # the per-object scan is Python-over-_source: cache the mask per
+    # (path, query) on the immutable segment so repeated nested queries
+    # pay it once (segments never mutate; the LRU-capped filter cache
+    # already holds exactly this class of value)
+    mask = seg.cached_filter(("nested", q.path, repr(q.query)), build) \
+        & ctx.live
+    scores = jnp.where(mask, jnp.float32(q.boost), 0.0)
+    return scores, mask
+
+
 _VECTOR_FN = re.compile(
     r"(cosineSimilarity|dotProduct|l2norm)\s*\(\s*params\.(\w+)\s*,\s*'?\"?([\w.]+)'?\"?\s*\)")
 
@@ -884,6 +916,7 @@ _HANDLERS = {
     dsl.DisMax: _h_dis_max,
     dsl.Boosting: _h_boosting,
     dsl.Knn: _h_knn,
+    dsl.Nested: _h_nested,
     dsl.RankFeature: _h_rank_feature,
     dsl.TextExpansion: _h_text_expansion,
     dsl.ScriptScore: _h_script_score,

@@ -18,6 +18,7 @@ from elasticsearch_tpu.index.engine import Reader
 from elasticsearch_tpu.mapping import MapperService
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.phase import ShardDoc
+from elasticsearch_tpu.utils.errors import QueryParsingError
 
 
 def filter_source(source: Dict[str, Any], includes: Sequence[str],
@@ -193,6 +194,9 @@ def fetch_hits(reader: Reader,
             fragment_size=int(highlight.get("fragment_size", 100)),
             number_of_fragments=int(highlight.get("number_of_fragments", 5)))
 
+    # inner-hit specs are constant per query: collect once, not per hit
+    inner_specs = _collect_inner_hit_specs(query) if query is not None else []
+
     hits = []
     for sd in docs:
         seg = reader.segments[sd.segment_idx]
@@ -237,8 +241,76 @@ def fetch_hits(reader: Reader,
                 hit["highlight"] = hl_out
         if include_sort and sd.sort_values:
             hit["sort"] = [_jsonify(v) for v in sd.sort_values]
+        if inner_specs:
+            inner = _inner_hits(src, inner_specs, index_name,
+                                seg.ids[sd.doc])
+            if inner:
+                hit["inner_hits"] = inner
         hits.append(hit)
     return hits
+
+
+def _collect_inner_hit_specs(q: Optional[dsl.Query]) -> list:
+    """Every Nested node in the tree carrying an inner_hits spec."""
+    out: list = []
+
+    def walk(node):
+        if node is None:
+            return
+        if isinstance(node, dsl.Nested):
+            if node.inner_hits is not None:
+                out.append(node)
+            walk(node.query)
+        elif isinstance(node, dsl.Bool):
+            for c in node.must + node.should + node.must_not + node.filter:
+                walk(c)
+        elif isinstance(node, dsl.ConstantScore):
+            walk(node.filter)
+        elif isinstance(node, dsl.DisMax):
+            for c in node.queries:
+                walk(c)
+        elif isinstance(node, dsl.Boosting):
+            walk(node.positive)
+            walk(node.negative)
+        elif isinstance(node, (dsl.ScriptScore, dsl.FunctionScore)):
+            if node.query is not None:
+                walk(node.query)
+    walk(q)
+    return out
+
+
+def _inner_hits(src: Dict[str, Any], specs: list,
+                index_name: str, doc_id: str) -> Dict[str, Any]:
+    """Matching nested objects per hit (InnerHitsPhase.java analog): for
+    each nested clause with inner_hits, re-run the per-object match over
+    the hit's _source and emit a mini hits block keyed by the path (or
+    the spec's explicit name)."""
+    from elasticsearch_tpu.search.nested import (
+        matching_offsets, nested_objects,
+    )
+    out: Dict[str, Any] = {}
+    for node in specs:
+        spec = node.inner_hits or {}
+        name = spec.get("name", node.path)
+        if name in out:
+            # the reference rejects this at parse time
+            raise QueryParsingError(
+                f"[inner_hits] already contains an entry for key [{name}]")
+        size = int(spec.get("size", 3))
+        offsets = matching_offsets(src, node.query, node.path)
+        objs = nested_objects(src, node.path)
+        sub_hits = [{
+            "_index": index_name,
+            "_id": doc_id,
+            "_nested": {"field": node.path, "offset": off},
+            "_score": 1.0,
+            "_source": objs[off],
+        } for off in offsets[:size]]
+        out[name] = {"hits": {
+            "total": {"value": len(offsets), "relation": "eq"},
+            "max_score": 1.0 if offsets else None,
+            "hits": sub_hits}}
+    return out
 
 
 def _jsonify(v):

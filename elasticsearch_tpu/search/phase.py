@@ -45,6 +45,9 @@ class ShardDoc:
     doc: int                    # local doc id within segment
     score: float
     sort_values: Tuple = ()
+    # collapse key (CollapseBuilder analog): set when the request collapses
+    # on a field; the coordinator merge dedups across shards by this value
+    ckey: Any = None
 
 
 @dataclass
@@ -270,6 +273,9 @@ def query_shard(reader: Reader,
                 field_stats_overrides: Optional[
                     Dict[str, Tuple[float, int]]] = None,
                 collectors: Optional[List] = None,
+                rescore: Any = None,
+                collapse: Optional[Dict[str, Any]] = None,
+                slice_spec: Optional[Dict[str, Any]] = None,
                 cancel_check: Optional[Any] = None) -> ShardQueryResult:
     """Execute one query over all segments of a shard snapshot.
 
@@ -321,6 +327,22 @@ def query_shard(reader: Reader,
     collector = choose_collector_context(
         query, mappers, sort, search_after, min_score, collectors,
         track_total_hits, size)
+    if rescore is not None or collapse is not None or slice_spec is not None:
+        # these phases need the full candidate set / extra doc context —
+        # always the dense collector (the reference likewise disables
+        # early termination when rescoring or collapsing)
+        collector = "dense"
+    if rescore is not None:
+        if not (len(sort) == 1 and sort[0].field == "_score"):
+            # the reference rejects rescore+sort explicitly; silently
+            # returning unrescored hits would be worse than the error
+            raise IllegalArgumentError(
+                "cannot use [rescore] in combination with [sort]")
+        # the first pass must COLLECT at least the rescore window, or docs
+        # a rescorer would promote are cut by base score before it runs
+        # (SearchService.java sizes the query phase to max(size, window))
+        specs = rescore if isinstance(rescore, list) else [rescore]
+        want = max(want, max(int(s.get("window_size", 10)) for s in specs))
     from elasticsearch_tpu.indices.breaker import BREAKERS
     request_breaker = BREAKERS.breaker("request")
     if collector == "wand_topk":
@@ -351,20 +373,122 @@ def query_shard(reader: Reader,
             ctxs, reader, mappers, query, sort, size, from_, want,
             search_after, min_score, exact_total, track_limit, total_hits,
             score_sort, score_asc, collectors, cancel_check, doc_count, dfs,
-            candidates)
+            candidates, rescore, collapse, slice_spec)
     finally:
         request_breaker.release(transient)
+
+
+def _slice_mask(ctx: SegmentContext, slice_spec: Dict[str, Any]) -> np.ndarray:
+    """Host mask for sliced scroll: murmur3(_id) % max == id, the
+    reference's default _id-based slicing (search/slice/SliceBuilder.java)."""
+    from elasticsearch_tpu.utils.murmur3 import hash_routing
+    sid = int(slice_spec.get("id", 0))
+    smax = int(slice_spec.get("max", 1))
+    if not (0 <= sid < smax):
+        raise IllegalArgumentError(
+            f"slice id [{sid}] must be in [0, max={smax})")
+    mask = np.zeros(ctx.segment.n_docs, bool)
+    for doc_id, local in ctx.segment.id_to_doc.items():
+        if hash_routing(doc_id) % smax == sid:
+            mask[local] = True
+    return mask
+
+
+def collapse_marker(key: Any) -> Any:
+    """Hashable group identity for a collapse key. Docs missing the field
+    form one null group (CollapseTopFieldDocs semantics); JSON round-trips
+    may turn tuples into lists, so normalize. Shared by the shard-level
+    and coordinator-level dedup so their semantics cannot drift."""
+    if key is None:
+        return ("__missing__",)
+    return tuple(key) if isinstance(key, list) else key
+
+
+def _collapse_keys(ctx: SegmentContext, field_name: str,
+                   docs: np.ndarray) -> list:
+    """One collapse key per doc from keyword ords or numeric doc values."""
+    seg = ctx.segment
+    kf = seg.keywords.get(field_name)
+    if kf is not None:
+        out = []
+        for d in docs:
+            ords = kf.ord_values[kf.ord_offsets[d]: kf.ord_offsets[d + 1]]
+            out.append(kf.term_list[int(ords[0])] if len(ords) else None)
+        return out
+    dv = seg.doc_values.get(field_name)
+    if dv is not None:
+        return [float(dv.values[d]) if dv.exists[d] else None for d in docs]
+    return [None] * len(docs)
+
+
+def _apply_rescore(ctxs, candidates, rescore_body, cancel_check):
+    """Window re-scoring over the shard's top candidates
+    (search/rescore/QueryRescorer.java): combined = query_weight * first +
+    rescore_query_weight * second for docs matching the rescore query."""
+    from elasticsearch_tpu.search.execute import execute as _execute
+    specs = rescore_body if isinstance(rescore_body, list) else [rescore_body]
+    for spec in specs:
+        window = int(spec.get("window_size", 10))
+        q = spec.get("query") or {}
+        rq = dsl.parse_query(q.get("rescore_query"))
+        qw = float(q.get("query_weight", 1.0))
+        rqw = float(q.get("rescore_query_weight", 1.0))
+        mode = q.get("score_mode", "total")
+        head, tail = candidates[:window], candidates[window:]
+        by_segment: Dict[int, list] = {}
+        for i, c in enumerate(head):
+            by_segment.setdefault(c.segment_idx, []).append(i)
+        for si, idxs in by_segment.items():
+            if cancel_check is not None:
+                cancel_check()
+            scores, mask = _execute(rq, ctxs[si])
+            s_host = np.asarray(scores)
+            m_host = np.asarray(mask)
+            for i in idxs:
+                c = head[i]
+                first = c.score
+                if not m_host[c.doc]:
+                    # Lucene's QueryRescorer.combine: a windowed doc the
+                    # rescore query does NOT match scores qw * first
+                    combined = qw * first
+                    head[i] = ShardDoc(c.segment_idx, c.doc, combined,
+                                       (combined,), c.ckey)
+                    continue
+                second = float(s_host[c.doc])
+                if mode == "total":
+                    combined = qw * first + rqw * second
+                elif mode == "multiply":
+                    combined = first * rqw * second
+                elif mode == "avg":
+                    combined = (qw * first + rqw * second) / 2.0
+                elif mode == "max":
+                    combined = max(qw * first, rqw * second)
+                elif mode == "min":
+                    combined = min(qw * first, rqw * second)
+                else:
+                    raise IllegalArgumentError(
+                        f"unknown rescore score_mode [{mode}]")
+                head[i] = ShardDoc(c.segment_idx, c.doc, combined,
+                                   (combined,), c.ckey)
+        head.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+        candidates = head + tail
+    return candidates
 
 
 def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
                        search_after, min_score, exact_total, track_limit,
                        total_hits, score_sort, score_asc, collectors,
-                       cancel_check, doc_count, dfs, candidates):
+                       cancel_check, doc_count, dfs, candidates,
+                       rescore=None, collapse=None, slice_spec=None):
     for si, ctx in enumerate(ctxs):
         if cancel_check is not None:
             cancel_check()
         seg = ctx.segment
         scores, mask = execute(query, ctx)
+        if slice_spec is not None:
+            # sliced scroll: this slice only sees docs whose _id hashes
+            # into its partition (SliceBuilder.java's _id slicing)
+            mask = mask & ctx.to_device_mask(_slice_mask(ctx, slice_spec))
         if min_score is not None:
             mask = mask & (scores >= min_score)
         scores = jnp.where(mask, scores, -jnp.inf)
@@ -388,7 +512,7 @@ def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
                 allowed = before | at
             scores = jnp.where(allowed, scores, -jnp.inf)
 
-        if score_sort:
+        if score_sort and collapse is None:
             k = min(max(want, 1), ctx.n_docs_pad)
             if score_asc:
                 # ascending: select the LOWEST scores among matches
@@ -407,6 +531,10 @@ def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
                     break
                 candidates.append(ShardDoc(si, int(d), float(s), (float(s),)))
         else:
+            # field sorts — and collapse, which must see EVERY matching doc
+            # so no group's best hit can be cut by a top-k window (the
+            # reference's grouping collector guarantees top-N distinct
+            # groups; a heuristic over-collect cannot under key skew)
             mask_host = np.asarray(mask)[: seg.n_docs]
             matched = np.nonzero(mask_host)[0]
             if len(matched) == 0:
@@ -433,6 +561,35 @@ def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
     if search_after is not None:
         candidates = [c for c in candidates
                       if _after(c, search_after, sort, reverse)]
+
+    if rescore is not None and score_sort:
+        candidates = _apply_rescore(ctxs, candidates, rescore, cancel_check)
+
+    if collapse is not None:
+        field_name = collapse.get("field")
+        if not field_name:
+            raise IllegalArgumentError("collapse requires [field]")
+        by_seg: Dict[int, list] = {}
+        for i, c in enumerate(candidates):
+            by_seg.setdefault(c.segment_idx, []).append(i)
+        for si, idxs in by_seg.items():
+            keys = _collapse_keys(
+                ctxs[si], field_name,
+                np.asarray([candidates[i].doc for i in idxs], np.int64))
+            for i, key in zip(idxs, keys):
+                c = candidates[i]
+                candidates[i] = ShardDoc(c.segment_idx, c.doc, c.score,
+                                         c.sort_values, key)
+        # keep the best hit per key
+        seen: set = set()
+        deduped = []
+        for c in candidates:
+            marker = collapse_marker(c.ckey)
+            if marker in seen:
+                continue
+            seen.add(marker)
+            deduped.append(c)
+        candidates = deduped
 
     window = candidates[from_: from_ + size]
     max_score = None

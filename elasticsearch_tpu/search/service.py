@@ -88,6 +88,9 @@ class SearchService:
             doc_count_override=doc_count_override,
             df_overrides=df_overrides,
             collectors=collectors,
+            rescore=body.get("rescore"),
+            collapse=body.get("collapse"),
+            slice_spec=body.get("slice"),
         )
 
         include_sort = body.get("sort") is not None or search_after is not None
@@ -101,6 +104,11 @@ class SearchService:
             seq_no_primary_term=bool(body.get("seq_no_primary_term")),
             include_version=bool(body.get("version")),
         )
+        cfield = (body.get("collapse") or {}).get("field")
+        if cfield:
+            for hit, d in zip(hits, result.docs):
+                if d.ckey is not None:
+                    hit.setdefault("fields", {})[cfield] = [d.ckey]
 
         response: Dict[str, Any] = {
             "took": int((time.monotonic() - t0) * 1000),

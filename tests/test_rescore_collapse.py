@@ -1,0 +1,174 @@
+"""Rescore, field collapse, sliced scroll, nested query + inner hits.
+
+Reference: search/rescore/QueryRescorer.java, search/collapse/
+CollapseBuilder.java, search/slice/SliceBuilder.java,
+index/query/NestedQueryBuilder.java + fetch/subphase/InnerHitsPhase.java.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import MapperService
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def svc():
+    mappers = MapperService({"properties": {
+        "body": {"type": "text"},
+        "brand": {"type": "keyword"},
+        "price": {"type": "integer"},
+        "comments": {"type": "nested", "properties": {
+            "author": {"type": "keyword"},
+            "stars": {"type": "integer"},
+            "text": {"type": "text"}}},
+    }})
+    engine = InternalEngine(mappers)
+    docs = [
+        ("p1", {"body": "red shoe sale", "brand": "acme", "price": 10,
+                "comments": [{"author": "amy", "stars": 5,
+                              "text": "great shoe"},
+                             {"author": "bob", "stars": 1,
+                              "text": "bad fit"}]}),
+        ("p2", {"body": "red shoe", "brand": "acme", "price": 30,
+                "comments": [{"author": "amy", "stars": 2,
+                              "text": "meh quality"}]}),
+        ("p3", {"body": "blue shoe sale", "brand": "zorro", "price": 20,
+                "comments": [{"author": "cid", "stars": 5,
+                              "text": "love the blue"}]}),
+        ("p4", {"body": "red boot", "brand": "zorro", "price": 40}),
+    ]
+    for did, src in docs:
+        engine.index(did, src)
+    engine.refresh()
+    return SearchService(engine, index_name="shop")
+
+
+def test_rescore_reorders_window(svc):
+    base = svc.search({"query": {"match": {"body": "red shoe"}},
+                       "size": 4})
+    base_ids = [h["_id"] for h in base["hits"]["hits"]]
+    assert set(base_ids) >= {"p1", "p2"}
+    # boost expensive products inside the rescore window
+    res = svc.search({
+        "query": {"match": {"body": "red shoe"}},
+        "size": 4,
+        "rescore": {"window_size": 10, "query": {
+            "rescore_query": {"range": {"price": {"gte": 25}}},
+            "query_weight": 0.0001,
+            "rescore_query_weight": 100.0,
+            "score_mode": "total"}}})
+    ids = [h["_id"] for h in res["hits"]["hits"]]
+    assert ids[0] == "p2"           # only red-shoe match with price >= 25
+    assert set(ids) == set(base_ids)  # rescore reorders, never adds/drops
+
+
+def test_rescore_score_modes(svc):
+    for mode in ("total", "multiply", "avg", "max", "min"):
+        res = svc.search({
+            "query": {"match": {"body": "shoe"}},
+            "rescore": {"window_size": 5, "query": {
+                "rescore_query": {"match": {"body": "sale"}},
+                "score_mode": mode}}})
+        assert res["hits"]["hits"], mode
+
+
+def test_collapse_keeps_best_per_key(svc):
+    res = svc.search({"query": {"match": {"body": "shoe"}},
+                      "collapse": {"field": "brand"}, "size": 10})
+    hits = res["hits"]["hits"]
+    brands = [h["fields"]["brand"][0] for h in hits]
+    assert sorted(brands) == ["acme", "zorro"]   # one hit per brand
+    # the kept hit is each brand's best-scoring doc
+    assert all(h["_score"] is not None for h in hits)
+
+
+def test_sliced_scroll_partitions_exactly(svc):
+    n_slices = 3
+    seen = []
+    for sid in range(n_slices):
+        res = svc.search({"query": {"match_all": {}},
+                          "slice": {"id": sid, "max": n_slices},
+                          "size": 10})
+        seen.extend(h["_id"] for h in res["hits"]["hits"])
+    # disjoint and complete across slices
+    assert sorted(seen) == ["p1", "p2", "p3", "p4"]
+
+
+def test_slice_id_validation(svc):
+    from elasticsearch_tpu.utils.errors import IllegalArgumentError
+    with pytest.raises(IllegalArgumentError):
+        svc.search({"query": {"match_all": {}},
+                    "slice": {"id": 5, "max": 3}})
+
+
+def test_nested_per_object_semantics(svc):
+    # amy gave 5 stars only on p1; flattened fields would also match p2
+    # (amy exists + a 5-star comment by someone else would cross-match)
+    res = svc.search({"query": {"nested": {
+        "path": "comments",
+        "query": {"bool": {"must": [
+            {"term": {"comments.author": "amy"}},
+            {"range": {"comments.stars": {"gte": 5}}}]}}}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["p1"]
+
+
+def test_nested_inner_hits(svc):
+    res = svc.search({"query": {"nested": {
+        "path": "comments",
+        "query": {"range": {"comments.stars": {"gte": 5}}},
+        "inner_hits": {}}}})
+    ids = {h["_id"] for h in res["hits"]["hits"]}
+    assert ids == {"p1", "p3"}
+    for h in res["hits"]["hits"]:
+        block = h["inner_hits"]["comments"]["hits"]
+        assert block["total"]["value"] == 1
+        inner = block["hits"][0]
+        assert inner["_nested"]["field"] == "comments"
+        assert inner["_source"]["stars"] == 5
+        if h["_id"] == "p1":
+            assert inner["_nested"]["offset"] == 0
+            assert inner["_source"]["author"] == "amy"
+
+
+def test_distributed_collapse_and_rescore():
+    c = InProcessCluster(n_nodes=2, seed=6)
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.create_index("d", {
+            "settings": {"number_of_shards": 3, "number_of_replicas": 0},
+            "mappings": {"properties": {
+                "body": {"type": "text"},
+                "group": {"type": "keyword"},
+                "rank": {"type": "integer"}}}}, cb))
+        assert e is None, e
+        c.ensure_green("d")
+        for i in range(24):
+            r, e = c.call(lambda cb, i=i: client.index_doc(
+                "d", f"x{i}", {"body": "alpha " * (1 + i % 3),
+                               "group": f"g{i % 4}", "rank": i}, cb))
+            assert e is None, e
+        c.call(lambda cb: client.refresh("d", cb))
+
+        res, e = c.call(lambda cb: client.search("d", {
+            "query": {"match": {"body": "alpha"}},
+            "collapse": {"field": "group"}, "size": 10}, cb))
+        assert e is None, e
+        groups = [h["fields"]["group"][0] for h in res["hits"]["hits"]]
+        assert sorted(groups) == ["g0", "g1", "g2", "g3"]
+        assert len(groups) == len(set(groups))
+
+        res, e = c.call(lambda cb: client.search("d", {
+            "query": {"match": {"body": "alpha"}}, "size": 5,
+            "rescore": {"window_size": 30, "query": {
+                "rescore_query": {"range": {"rank": {"gte": 20}}},
+                "query_weight": 0.001, "rescore_query_weight": 50.0}}},
+            cb))
+        assert e is None, e
+        top_ids = {h["_id"] for h in res["hits"]["hits"][:4]}
+        assert top_ids == {"x20", "x21", "x22", "x23"}
+    finally:
+        c.stop()
