@@ -1,0 +1,66 @@
+"""Device-side aggregation collection: segment-sum kernels.
+
+Reference shape: search/aggregations/AggregationPhase.java:40 collects by
+iterating matching docs per segment in Java. Here the per-segment
+collection for the bucket workhorses (terms over keyword ordinals,
+numeric/date histograms) is ONE scatter-add dispatch over device-resident
+columns — the "device partial-agg + host reduce" split (SURVEY §7 step 8):
+the device turns [n_docs] masks into [n_buckets] partial count/sum/min/max
+vectors, the host keeps the map-shaped merge/finalize it already had.
+
+Bucket-id computation happens on device too (floor((v - base)/interval)),
+so the only host↔device traffic per (segment, agg) is the final
+[n_buckets] partials.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ordinal_counts", "histogram_partials"]
+
+
+@partial(jax.jit, static_argnames=("n_buckets",))
+def ordinal_counts(ords: jnp.ndarray,     # [E] int32 bucket ids (-1 pad)
+                   owner_ok: jnp.ndarray,  # [E] bool: owner doc matched
+                   n_buckets: int) -> jnp.ndarray:
+    """Counts per ordinal from a (doc, ord) occurrence table already
+    deduped per doc — the terms-agg device half."""
+    valid = owner_ok & (ords >= 0)
+    safe = jnp.where(valid, ords, 0)
+    return jnp.zeros((n_buckets,), jnp.int32).at[safe].add(
+        valid.astype(jnp.int32), mode="drop")
+
+
+@partial(jax.jit, static_argnames=("n_buckets",))
+def histogram_partials(values: jnp.ndarray,   # [N_pad] f32 column
+                       exists: jnp.ndarray,   # [N_pad] bool
+                       mask: jnp.ndarray,     # [N_pad] bool query matches
+                       base: jnp.ndarray,     # scalar f32 (first bucket key)
+                       interval: jnp.ndarray,  # scalar f32
+                       n_buckets: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                  jnp.ndarray, jnp.ndarray]:
+    """(counts, sums, mins, maxs) per histogram bucket in one dispatch.
+
+    The sum/min/max vectors come free with the same scatter pass, so
+    metric sub-aggs on the SAME field reduce without a second pass."""
+    ok = exists & mask
+    ids = jnp.floor((values - base) / interval).astype(jnp.int32)
+    ok = ok & (ids >= 0) & (ids < n_buckets)
+    safe = jnp.where(ok, ids, 0)
+    okf = ok.astype(jnp.float32)
+    counts = jnp.zeros((n_buckets,), jnp.int32).at[safe].add(
+        ok.astype(jnp.int32), mode="drop")
+    sums = jnp.zeros((n_buckets,), jnp.float32).at[safe].add(
+        jnp.where(ok, values, 0.0), mode="drop")
+    mins = jnp.full((n_buckets,), jnp.inf, jnp.float32).at[safe].min(
+        jnp.where(ok, values, jnp.inf), mode="drop")
+    maxs = jnp.full((n_buckets,), -jnp.inf, jnp.float32).at[safe].max(
+        jnp.where(ok, values, -jnp.inf), mode="drop")
+    del okf
+    return counts, sums, mins, maxs

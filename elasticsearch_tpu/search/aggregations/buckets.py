@@ -63,6 +63,15 @@ def _doc_count(mask: np.ndarray) -> int:
     return int(mask.sum())
 
 
+def _dedup_doc_ord(owners: np.ndarray, ords: np.ndarray, n_terms: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Unique (doc, ord) pairs — a doc counts once per term even when the
+    stored array repeats a value. Shared by every ordinal counter."""
+    pair = owners.astype(np.int64) * max(n_terms, 1) + ords
+    _, first = np.unique(pair, return_index=True)
+    return owners[first], ords[first]
+
+
 # ---------------------------------------------------------------------------
 # single-bucket aggs: filter / global / missing
 # ---------------------------------------------------------------------------
@@ -147,11 +156,61 @@ def collect_filters(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
 # terms
 # ---------------------------------------------------------------------------
 
+def _device_terms(spec: AggSpec, ctx, mask) -> Optional[Dict[str, Any]]:
+    """One-dispatch device collection for sub-less keyword terms: the
+    deduped (doc, ord) occurrence table lives on device, the query's
+    device mask gates owners, ordinal_counts scatter-adds — only the
+    [n_terms] count vector crosses back to the host."""
+    fname = spec.params.get("field")
+    if fname is None or spec.subs or \
+            spec.params.get("missing") is not None or \
+            spec.params.get("script") is not None:
+        return None
+    seg = ctx.segment
+    if fname not in getattr(seg, "keywords", {}):
+        return None
+    dev_mask = getattr(ctx, "_agg_device_mask", None)
+    if dev_mask is None or \
+            getattr(ctx, "_agg_top_host_mask", None) is not mask:
+        # a sub-aggregation context hands us its bucket-intersected host
+        # mask; the device copy is the TOP-LEVEL query mask — decline
+        return None
+    import jax.numpy as jnp
+    from elasticsearch_tpu.index.segment import next_pow2
+    from elasticsearch_tpu.ops.aggs import ordinal_counts
+    owners, ords, term_list = keyword_occurrences(ctx, fname)
+    if not len(term_list):
+        return {"buckets": {}}
+
+    def build():
+        o, r = _dedup_doc_ord(owners, ords, len(term_list))
+        e_pad = next_pow2(max(len(o), 1), minimum=8)
+        ow = np.zeros(e_pad, np.int32)
+        od = np.full(e_pad, -1, np.int32)
+        ow[: len(o)] = o
+        od[: len(o)] = r
+        return jnp.asarray(ow), jnp.asarray(od)
+
+    owners_dev, ords_dev = seg.device(("agg_kw_dev", fname), build)
+    nb_pad = next_pow2(max(len(term_list), 1), minimum=8)
+    counts = np.asarray(ordinal_counts(
+        ords_dev, dev_mask[owners_dev], nb_pad))[: len(term_list)]
+    buckets: Dict[str, Dict[str, Any]] = {}
+    for tid in np.nonzero(counts)[0]:
+        key = term_list[int(tid)]
+        buckets[str(key)] = {"key": key, "doc_count": int(counts[tid]),
+                             "subs": {}}
+    return {"buckets": buckets}
+
+
 def collect_terms(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
     fname = spec.params.get("field")
     if fname is None and spec.params.get("script") is None:
         raise IllegalArgumentError(
             f"aggregation [{spec.name}] requires a [field] or [script]")
+    device = _device_terms(spec, ctx, mask)
+    if device is not None:
+        return device
     kind = field_kind(ctx, fname) if fname else "numeric"
     buckets: Dict[str, Dict[str, Any]] = {}
     missing = spec.params.get("missing")
@@ -163,10 +222,7 @@ def collect_terms(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
         owners, ords = owners[keep], ords[keep]
         seen_docs[owners] = True
         if len(owners):
-            # dedup (doc, ord): a doc counts once per term
-            pair = owners.astype(np.int64) * max(len(term_list), 1) + ords
-            _, first = np.unique(pair, return_index=True)
-            owners, ords = owners[first], ords[first]
+            owners, ords = _dedup_doc_ord(owners, ords, len(term_list))
             counts = np.bincount(ords, minlength=len(term_list))
             for tid in np.nonzero(counts)[0]:
                 key = term_list[tid]
@@ -276,7 +332,115 @@ def format_date_key(ms: float) -> str:
     return str(dt) + "Z"
 
 
+_DEVICE_SUB_TYPES = {"sum", "avg", "min", "max", "value_count"}
+
+
+def _device_metric_subs(spec: AggSpec, fname: str) -> bool:
+    """Can every sub-agg be answered from the kernel's fused per-bucket
+    count/sum/min/max over the SAME field?"""
+    for sub in spec.subs:
+        if sub.is_pipeline:
+            continue
+        if sub.type not in _DEVICE_SUB_TYPES or sub.subs or \
+                sub.params.get("field") != fname or \
+                sub.params.get("missing") is not None or \
+                sub.params.get("script") is not None:
+            return False
+    return True
+
+
+def _sub_partial_from_stats(sub: AggSpec, count: int, total: float,
+                            vmin: float, vmax: float) -> Dict[str, Any]:
+    return {"count": count, "sum": total,
+            "min": vmin if count else None,
+            "max": vmax if count else None, "sum_sq": 0.0}
+
+
+def _device_histogram(spec: AggSpec, ctx, mask, scores
+                      ) -> Optional[Dict[str, Any]]:
+    """One-dispatch device collection (ops/aggs.py) for the common
+    histogram shape: single-valued numeric column, fixed interval, subs
+    absent or metric-on-same-field. Returns None to fall back host-side."""
+    fname = spec.params.get("field")
+    if fname is None or spec.params.get("missing") is not None or \
+            spec.params.get("offset") or spec.params.get("extended_bounds"):
+        return None
+    if not _device_metric_subs(spec, fname):
+        return None
+    if getattr(ctx, "_agg_top_host_mask", None) is not mask:
+        # sub-aggregation context: the device mask is the top-level one,
+        # not this bucket's — decline (see _device_terms)
+        return None
+    seg = ctx.segment
+    dv = seg.doc_values.get(fname)
+    if dv is None or dv.multi:
+        return None
+    if spec.type == "date_histogram":
+        if spec.params.get("calendar_interval"):
+            return None
+        interval = parse_interval_ms(spec.params.get(
+            "fixed_interval", spec.params.get("interval", "1d")))
+    else:
+        interval = float(spec.params.get("interval", 0))
+    if interval <= 0:
+        return None
+    dev_mask = getattr(ctx, "_agg_device_mask", None)
+    if dev_mask is None:
+        return None
+    docs = np.nonzero(dv.exists)[0]
+    if len(docs) == 0:
+        return {"buckets": {}}
+    import jax.numpy as jnp
+    from elasticsearch_tpu.index.segment import next_pow2
+    from elasticsearch_tpu.ops.aggs import histogram_partials
+    vmin = float(dv.values[docs].min())
+    vmax = float(dv.values[docs].max())
+    if max(abs(vmin), abs(vmax)) >= 2 ** 24:
+        # the device column is f32; values beyond the exact-integer range
+        # (epoch-millis dates above all) could misbucket at boundaries —
+        # exactness wins, fall back to the host collector
+        return None
+    base = float(np.floor(vmin / interval) * interval)
+    n_buckets = int(np.floor(vmax / interval)
+                    - np.floor(vmin / interval)) + 1
+    if n_buckets > MAX_BUCKETS:
+        return None
+    nb_pad = next_pow2(n_buckets, minimum=8)   # bucketed: caps compiles
+
+    def build():
+        values = np.zeros(ctx.n_docs_pad, np.float32)
+        values[: seg.n_docs] = dv.values.astype(np.float32)
+        exists = np.zeros(ctx.n_docs_pad, bool)
+        exists[: seg.n_docs] = dv.exists
+        return jnp.asarray(values), jnp.asarray(exists)
+
+    values_dev, exists_dev = seg.device(("agg_dv", fname), build)
+    counts, sums, mins, maxs = histogram_partials(
+        values_dev, exists_dev, dev_mask, jnp.float32(base),
+        jnp.float32(interval), nb_pad)
+    counts = np.asarray(counts)[:n_buckets]
+    sums = np.asarray(sums)[:n_buckets]
+    mins = np.asarray(mins)[:n_buckets]
+    maxs = np.asarray(maxs)[:n_buckets]
+    buckets: Dict[str, Dict[str, Any]] = {}
+    for i in np.nonzero(counts)[0]:
+        # IDENTICAL key derivation to the host path (float key, repr'd
+        # bucket id) or segments served by different paths would merge
+        # into separate buckets for the same key
+        key = float(base + float(i) * interval)
+        subs = {sub.name: _sub_partial_from_stats(
+                    sub, int(counts[i]), float(sums[i]),
+                    float(mins[i]), float(maxs[i]))
+                for sub in spec.subs if not sub.is_pipeline}
+        buckets[repr(key)] = {"key": key, "doc_count": int(counts[i]),
+                              "subs": subs}
+    return {"buckets": buckets}
+
+
 def collect_histogram(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
+    device = _device_histogram(spec, ctx, mask, scores)
+    if device is not None:
+        return device
     fname = spec.params.get("field")
     if fname is None:
         raise IllegalArgumentError(
@@ -578,6 +742,217 @@ def finalize_filters(spec: AggSpec, p) -> Dict[str, Any]:
 # registry
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# composite (bucket/composite/CompositeAggregationBuilder analog)
+# ---------------------------------------------------------------------------
+
+def _composite_sources(spec: AggSpec) -> List[Tuple[str, str, Dict[str, Any]]]:
+    out = []
+    for src in spec.params.get("sources") or []:
+        (sname, body), = src.items()
+        (stype, cfg), = body.items()
+        if stype not in ("terms", "histogram", "date_histogram"):
+            raise IllegalArgumentError(
+                f"unsupported composite source type [{stype}]")
+        out.append((sname, stype, cfg))
+    if not out:
+        raise IllegalArgumentError(
+            f"composite [{spec.name}] requires [sources]")
+    return out
+
+
+def collect_composite(spec: AggSpec, ctx, mask, scores) -> Dict[str, Any]:
+    """Cartesian bucket keys per doc. Shards keep EVERY bucket (exact
+    framework semantics); pagination (after/size) applies at finalize."""
+    import json
+    sources = _composite_sources(spec)
+    n = ctx.segment.n_docs
+    cols: List[Optional[list]] = []
+    for _sname, stype, cfg in sources:
+        f = cfg.get("field")
+        col: List[Any] = [None] * n
+        if stype == "terms" and field_kind(ctx, f) == "keyword":
+            owners, ords, term_list = keyword_occurrences(ctx, f)
+            # first value per doc, vectorized (occurrences are doc-sorted)
+            uniq, first = np.unique(owners, return_index=True)
+            for o, i in zip(uniq, first):
+                col[int(o)] = term_list[int(ords[i])]
+        else:
+            owners, values = numeric_occurrences(ctx, f)
+            if stype == "histogram":
+                interval = float(cfg.get("interval", 1))
+                values = np.floor(values / interval) * interval
+            elif stype == "date_histogram":
+                cal = cfg.get("calendar_interval")
+                if cal:
+                    values = _calendar_floor(values, str(cal)).astype(
+                        np.float64)
+                else:
+                    interval = parse_interval_ms(cfg.get(
+                        "fixed_interval", cfg.get("interval", "1d")))
+                    values = np.floor(values / interval) * interval
+            uniq, first = np.unique(owners, return_index=True)
+            for o, i in zip(uniq, first):
+                key = float(values[i])
+                col[int(o)] = int(key) if key.is_integer() else key
+        cols.append(col)
+    buckets: Dict[str, Dict[str, Any]] = {}
+    groups: Dict[str, list] = {}
+    for d in np.nonzero(mask[:n])[0]:
+        key_vals = [col[d] for col in cols]
+        if any(v is None for v in key_vals):
+            continue   # a doc missing any source value is skipped
+        key = {sname: v for (sname, _t, _c), v in zip(sources, key_vals)}
+        bk = json.dumps(key, sort_keys=True)
+        groups.setdefault(bk, []).append(d)
+        if bk not in buckets:
+            buckets[bk] = {"key": key, "doc_count": 0, "subs": {}}
+        buckets[bk]["doc_count"] += 1
+    for bk, docs in groups.items():
+        if spec.subs:
+            bmask = np.zeros(n, bool)
+            bmask[docs] = True
+            buckets[bk]["subs"] = _collect_subs(spec, ctx, bmask, scores)
+    return {"buckets": buckets}
+
+
+def _composite_cmp(sources):
+    """Composite key comparator honoring each source's asc/desc order
+    (the reference's per-source comparators). Numbers sort before strings
+    within a source (type-stable)."""
+    def cmp(a: Dict[str, Any], b: Dict[str, Any]) -> int:
+        for sname, _t, cfg in sources:
+            va, vb = a.get(sname), b.get(sname)
+            ka = ((0, float(va)) if isinstance(va, (int, float))
+                  else (1, str(va)))
+            kb = ((0, float(vb)) if isinstance(vb, (int, float))
+                  else (1, str(vb)))
+            if ka != kb:
+                c = -1 if ka < kb else 1
+                if str(cfg.get("order", "asc")).lower() == "desc":
+                    c = -c
+                return c
+        return 0
+    return cmp
+
+
+def finalize_composite(spec: AggSpec, p) -> Dict[str, Any]:
+    import functools
+    sources = _composite_sources(spec)
+    size = int(spec.params.get("size", 10))
+    after = spec.params.get("after")
+    cmp = _composite_cmp(sources)
+    items = sorted(p["buckets"].values(),
+                   key=functools.cmp_to_key(
+                       lambda x, y: cmp(x["key"], y["key"])))
+    if after is not None:
+        items = [b for b in items if cmp(b["key"], after) > 0]
+    selected = items[:size]
+    out_buckets = []
+    for b in selected:
+        from elasticsearch_tpu.search.aggregations.engine import finalize_one
+        entry = {"key": b["key"], "doc_count": b["doc_count"]}
+        for sub in spec.subs:
+            if not sub.is_pipeline and sub.name in b.get("subs", {}):
+                entry[sub.name] = finalize_one(sub, b["subs"][sub.name])
+        out_buckets.append(entry)
+    out: Dict[str, Any] = {"buckets": out_buckets}
+    if out_buckets:
+        out["after_key"] = out_buckets[-1]["key"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# significant_terms (bucket/terms/SignificantTermsAggregationBuilder analog;
+# JLH significance heuristic)
+# ---------------------------------------------------------------------------
+
+def collect_significant_terms(spec: AggSpec, ctx, mask, scores
+                              ) -> Dict[str, Any]:
+    fname = spec.params.get("field")
+    if fname is None:
+        raise IllegalArgumentError(
+            f"aggregation [{spec.name}] requires a [field]")
+    n = ctx.segment.n_docs
+    live = np.zeros(n, bool)
+    live[: len(ctx.segment.live)] = ctx.segment.live
+    fg_total = int(np.count_nonzero(mask[:n]))
+    bg_total = int(np.count_nonzero(live))
+    buckets: Dict[str, Dict[str, Any]] = {}
+    if field_kind(ctx, fname) == "keyword":
+        owners, ords, term_list = keyword_occurrences(ctx, fname)
+        owners, ords = _dedup_doc_ord(owners, ords, len(term_list))
+        bg = np.bincount(ords[live[owners]], minlength=len(term_list))
+        fg = np.bincount(ords[mask[owners]], minlength=len(term_list))
+        for tid in np.nonzero(fg)[0]:
+            key = term_list[int(tid)]
+            bmask = np.zeros(n, bool)
+            bmask[owners[(ords == tid)]] = True
+            buckets[str(key)] = {
+                "key": key, "doc_count": int(fg[tid]),
+                "bg_count": int(bg[tid]),
+                "subs": _collect_subs(spec, ctx, bmask & mask, scores)}
+    else:
+        owners, values = numeric_occurrences(ctx, fname)
+        for v in np.unique(values):
+            sel = owners[values == v]
+            docs = np.unique(sel)
+            fg_n = int(np.count_nonzero(mask[docs]))
+            if not fg_n:
+                continue
+            bmask = np.zeros(n, bool)
+            bmask[docs] = True
+            key = int(v) if float(v).is_integer() else float(v)
+            buckets[str(key)] = {
+                "key": key, "doc_count": fg_n,
+                "bg_count": int(np.count_nonzero(live[docs])),
+                "subs": _collect_subs(spec, ctx, bmask & mask, scores)}
+    return {"buckets": buckets, "fg_total": fg_total, "bg_total": bg_total}
+
+
+def merge_significant(spec: AggSpec, a, b) -> Dict[str, Any]:
+    out = merge_multi(spec, a, b)
+    for bk, bucket in b["buckets"].items():
+        if bk in a["buckets"]:
+            out["buckets"][bk]["bg_count"] = \
+                a["buckets"][bk]["bg_count"] + bucket["bg_count"]
+    out["fg_total"] = a.get("fg_total", 0) + b.get("fg_total", 0)
+    out["bg_total"] = a.get("bg_total", 0) + b.get("bg_total", 0)
+    return out
+
+
+def finalize_significant(spec: AggSpec, p) -> Dict[str, Any]:
+    """JLH score: (fg_rate - bg_rate) * (fg_rate / bg_rate) for terms
+    overrepresented in the foreground (SignificantTermsHeuristic JLH)."""
+    from elasticsearch_tpu.search.aggregations.engine import finalize_one
+    fg_total = max(int(p.get("fg_total", 0)), 1)
+    bg_total = max(int(p.get("bg_total", 0)), 1)
+    size = int(spec.params.get("size", 10))
+    min_doc = int(spec.params.get("min_doc_count", 3))
+    scored = []
+    for b in p["buckets"].values():
+        if b["doc_count"] < min_doc:
+            continue
+        fg_rate = b["doc_count"] / fg_total
+        bg_rate = max(b["bg_count"], 1) / bg_total
+        if fg_rate <= bg_rate:
+            continue   # not overrepresented in the foreground
+        score = (fg_rate - bg_rate) * (fg_rate / bg_rate)
+        scored.append((score, b))
+    scored.sort(key=lambda sb: (-sb[0], str(sb[1]["key"])))
+    out_buckets = []
+    for score, b in scored[:size]:
+        entry = {"key": b["key"], "doc_count": b["doc_count"],
+                 "bg_count": b["bg_count"], "score": round(score, 6)}
+        for sub in spec.subs:
+            if not sub.is_pipeline and sub.name in b.get("subs", {}):
+                entry[sub.name] = finalize_one(sub, b["subs"][sub.name])
+        out_buckets.append(entry)
+    return {"doc_count": int(p.get("fg_total", 0)),
+            "bg_count": int(p.get("bg_total", 0)),
+            "buckets": out_buckets}
+
+
 BUCKET_COLLECT = {
     "terms": collect_terms,
     "range": collect_range,
@@ -588,6 +963,8 @@ BUCKET_COLLECT = {
     "filters": collect_filters,
     "global": collect_global,
     "missing": collect_missing,
+    "composite": collect_composite,
+    "significant_terms": collect_significant_terms,
 }
 BUCKET_MERGE = {
     "terms": merge_multi, "range": merge_multi, "date_range": merge_multi,
@@ -595,6 +972,8 @@ BUCKET_MERGE = {
     "filters": merge_multi,
     "filter": merge_single, "global": merge_single,
     "missing": merge_single,
+    "composite": merge_multi,
+    "significant_terms": merge_significant,
 }
 BUCKET_FINALIZE = {
     "terms": finalize_terms,
@@ -603,4 +982,6 @@ BUCKET_FINALIZE = {
     "filter": finalize_single, "global": finalize_single,
     "missing": finalize_single,
     "filters": finalize_filters,
+    "composite": finalize_composite,
+    "significant_terms": finalize_significant,
 }

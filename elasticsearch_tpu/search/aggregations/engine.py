@@ -75,7 +75,16 @@ class ShardAggregator:
 
     def collect(self, ctx, segment_idx: int, scores, mask) -> None:
         n = ctx.segment.n_docs
+        # the device mask stays visible to collectors with a device fast
+        # path (buckets.py device partial-agg) — host conversion is for
+        # the host-side collectors only. The host twin is stashed so the
+        # fast paths can verify BY IDENTITY that the mask they were handed
+        # is the top-level query mask: a sub-aggregation passes its
+        # bucket-intersected mask, which only exists on the host, and the
+        # device path must then decline
+        ctx._agg_device_mask = mask
         mask_host = np.asarray(mask)[:n].astype(bool)
+        ctx._agg_top_host_mask = mask_host
         scores_host = np.asarray(scores)[:n]
         for spec in self.specs:
             partial = collect_one(spec, ctx, mask_host, scores_host)

@@ -19,7 +19,8 @@ METRIC_TYPES = {
 }
 BUCKET_TYPES = {
     "terms", "range", "date_range", "histogram", "date_histogram",
-    "filter", "filters", "global", "missing",
+    "filter", "filters", "global", "missing", "composite",
+    "significant_terms",
 }
 PIPELINE_TYPES = {
     "avg_bucket", "sum_bucket", "min_bucket", "max_bucket", "stats_bucket",

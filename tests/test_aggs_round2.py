@@ -1,0 +1,152 @@
+"""Composite pagination, significant_terms, and device partial-agg.
+
+Reference: search/aggregations/bucket/composite/ (after-key pagination),
+bucket/terms/SignificantTermsAggregationBuilder (JLH heuristic), and the
+device half of SURVEY §7 step 8 (segment-sum kernels in ops/aggs.py).
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import MapperService
+from elasticsearch_tpu.search.service import SearchService
+
+
+@pytest.fixture()
+def svc():
+    mappers = MapperService({"properties": {
+        "body": {"type": "text"},
+        "color": {"type": "keyword"},
+        "size": {"type": "keyword"},
+        "price": {"type": "integer"},
+    }})
+    engine = InternalEngine(mappers)
+    colors = ["red", "blue", "green"]
+    sizes = ["s", "m"]
+    for i in range(30):
+        engine.index(f"d{i}", {
+            "body": ("sale fox" if i % 5 == 0 else "plain item"),
+            "color": colors[i % 3], "size": sizes[i % 2],
+            "price": (i % 6) * 10})
+    engine.refresh()
+    return SearchService(engine, index_name="shop")
+
+
+def test_composite_pages_through_all_buckets(svc):
+    seen = []
+    after = None
+    while True:
+        params = {"sources": [
+            {"col": {"terms": {"field": "color"}}},
+            {"sz": {"terms": {"field": "size"}}}], "size": 2}
+        if after is not None:
+            params["after"] = after
+        res = svc.search({"size": 0, "aggs": {
+            "grid": {"composite": params}}})
+        buckets = res["aggregations"]["grid"]["buckets"]
+        if not buckets:
+            break
+        seen.extend((b["key"]["col"], b["key"]["sz"], b["doc_count"])
+                    for b in buckets)
+        after = res["aggregations"]["grid"].get("after_key")
+        if after is None:
+            break
+    assert len(seen) == 6                       # 3 colors x 2 sizes
+    assert len({(c, s) for c, s, _ in seen}) == 6
+    assert sum(n for _, _, n in seen) == 30
+    # ordered ascending by (col, sz)
+    assert seen == sorted(seen, key=lambda t: (t[0], t[1]))
+
+
+def test_composite_histogram_source_and_subs(svc):
+    res = svc.search({"size": 0, "aggs": {"grid": {
+        "composite": {
+            "sources": [{"p": {"histogram": {"field": "price",
+                                             "interval": 20}}}],
+            "size": 10},
+        "aggs": {"avg_price": {"avg": {"field": "price"}}}}}})
+    buckets = res["aggregations"]["grid"]["buckets"]
+    assert [b["key"]["p"] for b in buckets] == [0, 20, 40]
+    for b in buckets:
+        assert b["key"]["p"] <= b["avg_price"]["value"] < b["key"]["p"] + 20
+
+
+def test_significant_terms_finds_overrepresented(svc):
+    # docs with "sale fox" are exactly the i % 5 == 0 docs: colors cycle
+    # with period 3, so color red (i % 3 == 0) hits i in {0, 15} of the 6
+    # foreground docs vs 10/30 background — overrepresentation varies by
+    # color; at minimum the response must be well-formed and scored
+    res = svc.search({
+        "query": {"match": {"body": "sale"}},
+        "size": 0,
+        "aggs": {"sig": {"significant_terms": {
+            "field": "color", "min_doc_count": 1}}}})
+    sig = res["aggregations"]["sig"]
+    assert sig["doc_count"] == 6                # foreground size
+    assert sig["bg_count"] == 30
+    for b in sig["buckets"]:
+        fg_rate = b["doc_count"] / sig["doc_count"]
+        bg_rate = b["bg_count"] / sig["bg_count"]
+        assert fg_rate > bg_rate                # only overrepresented kept
+        assert b["score"] > 0
+
+
+def test_significant_terms_signal_detection():
+    mappers = MapperService({"properties": {
+        "body": {"type": "text"}, "tag": {"type": "keyword"}}})
+    engine = InternalEngine(mappers)
+    # "crash" docs are overwhelmingly tagged "bug"; background is uniform
+    for i in range(60):
+        is_crash = i < 12
+        engine.index(f"d{i}", {
+            "body": "crash report" if is_crash else "feature request",
+            "tag": ("bug" if is_crash and i % 12 < 10 else
+                    ["ui", "api", "docs"][i % 3])})
+    engine.refresh()
+    svc = SearchService(engine, index_name="t")
+    res = svc.search({"query": {"match": {"body": "crash"}}, "size": 0,
+                      "aggs": {"sig": {"significant_terms": {
+                          "field": "tag", "min_doc_count": 2}}}})
+    buckets = res["aggregations"]["sig"]["buckets"]
+    assert buckets and buckets[0]["key"] == "bug"
+
+
+def test_device_terms_matches_host_path(svc):
+    # sub-less keyword terms takes the device kernel; with a sub-agg the
+    # host path runs — both must produce identical bucket counts
+    fast = svc.search({"size": 0, "aggs": {
+        "c": {"terms": {"field": "color"}}}})
+    slow = svc.search({"size": 0, "aggs": {
+        "c": {"terms": {"field": "color"},
+              "aggs": {"m": {"max": {"field": "price"}}}}}})
+    f = {b["key"]: b["doc_count"]
+         for b in fast["aggregations"]["c"]["buckets"]}
+    s = {b["key"]: b["doc_count"]
+         for b in slow["aggregations"]["c"]["buckets"]}
+    assert f == s == {"red": 10, "blue": 10, "green": 10}
+
+
+def test_device_histogram_fused_metric_subs(svc):
+    # histogram + same-field metric subs rides the fused device kernel
+    res = svc.search({"size": 0, "aggs": {"h": {
+        "histogram": {"field": "price", "interval": 20},
+        "aggs": {"s": {"sum": {"field": "price"}},
+                 "mx": {"max": {"field": "price"}},
+                 "avg": {"avg": {"field": "price"}}}}}})
+    buckets = res["aggregations"]["h"]["buckets"]
+    assert [b["key"] for b in buckets] == [0, 20, 40]
+    assert [b["doc_count"] for b in buckets] == [10, 10, 10]
+    assert buckets[0]["s"]["value"] == 5 * 0 + 5 * 10
+    assert buckets[2]["mx"]["value"] == 50
+    assert buckets[1]["avg"]["value"] == pytest.approx(25.0)
+
+
+def test_device_histogram_respects_query_mask(svc):
+    res = svc.search({"query": {"match": {"body": "sale"}},
+                      "size": 0, "aggs": {"h": {
+                          "histogram": {"field": "price",
+                                        "interval": 20}}}})
+    total = sum(b["doc_count"]
+                for b in res["aggregations"]["h"]["buckets"])
+    assert total == 6
