@@ -1,0 +1,83 @@
+"""Adaptive replica selection: rank shard copies by observed performance.
+
+Reference: node/ResponseCollectorService.java:179 + the C3 ranking used by
+OperationRouting.searchShards — the coordinator keeps an EWMA of each data
+node's service time and queue depth and prefers the copy expected to
+respond fastest, instead of blind round-robin.
+
+Here the observed signal is the coordinator-side round-trip of shard
+query requests (queueing + network + execution — exactly the latency a
+future request will experience), plus the coordinator's own count of
+in-flight requests per node as the queue-size proxy.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+ALPHA = 0.3          # EWMA smoothing (ResponseCollectorService.ALPHA)
+
+
+class NodeStatistics:
+    __slots__ = ("ewma_ms", "outstanding", "observations")
+
+    def __init__(self) -> None:
+        self.ewma_ms: Optional[float] = None
+        self.outstanding = 0
+        self.observations = 0
+
+
+class ResponseCollectorService:
+    def __init__(self) -> None:
+        self._nodes: Dict[str, NodeStatistics] = {}
+        self._lock = threading.Lock()
+
+    def _stats(self, node_id: str) -> NodeStatistics:
+        stats = self._nodes.get(node_id)
+        if stats is None:
+            stats = self._nodes[node_id] = NodeStatistics()
+        return stats
+
+    # -- observation ------------------------------------------------------
+
+    def on_send(self, node_id: str) -> None:
+        with self._lock:
+            self._stats(node_id).outstanding += 1
+
+    def on_response(self, node_id: str, took_s: float,
+                    failed: bool = False) -> None:
+        with self._lock:
+            stats = self._stats(node_id)
+            stats.outstanding = max(0, stats.outstanding - 1)
+            if failed:
+                # a failure reads as a slow response so the ranking backs
+                # off the node without a separate penalty channel
+                took_s = max(took_s, 1.0) * 2
+            ms = took_s * 1000.0
+            stats.ewma_ms = ms if stats.ewma_ms is None else \
+                ALPHA * ms + (1 - ALPHA) * stats.ewma_ms
+            stats.observations += 1
+
+    # -- ranking ----------------------------------------------------------
+
+    def rank(self, node_id: str) -> float:
+        """Lower is better. Unknown nodes rank best (0) so new/idle nodes
+        get probed, like the reference's optimistic default."""
+        with self._lock:
+            stats = self._nodes.get(node_id)
+            if stats is None or stats.ewma_ms is None:
+                return 0.0
+            # C3-lite: expected latency scaled by the queue estimate
+            return stats.ewma_ms * (1.0 + stats.outstanding)
+
+    def order_copies(self, copies: list) -> list:
+        """Stable sort of candidate nodes, best expected first."""
+        return sorted(copies, key=self.rank)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {nid: {"ewma_ms": round(stats.ewma_ms or 0.0, 3),
+                          "outstanding": stats.outstanding,
+                          "observations": stats.observations}
+                    for nid, stats in self._nodes.items()}

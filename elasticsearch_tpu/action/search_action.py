@@ -15,7 +15,10 @@ from __future__ import annotations
 import functools
 import time
 import uuid as uuid_mod
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from elasticsearch_tpu.cluster.state import ClusterState
 from elasticsearch_tpu.index.engine import Reader
@@ -51,6 +54,11 @@ class SearchTransportService:
         self.ts = ts
         self.task_manager = task_manager
         self._contexts: Dict[str, Tuple[Reader, float]] = {}
+        # shard request cache (indices/IndicesRequestCache.java:69):
+        # request-bytes-keyed size=0 results, invalidated by the reader's
+        # freshness key (any refresh/merge/delete changes it). LRU-bounded.
+        self._request_cache: "OrderedDict[Tuple, Dict[str, Any]]" = \
+            OrderedDict()
         ts.register_handler(SEARCH_CAN_MATCH, self._on_can_match)
         ts.register_handler(SEARCH_DFS, self._on_dfs)
         ts.register_handler(SEARCH_QUERY, self._on_query)
@@ -94,11 +102,39 @@ class SearchTransportService:
         return {"doc_count": doc_count, "dfs": dfs,
                 "field_stats": field_stats}
 
+    REQUEST_CACHE_CAP = 256
+
+    def _request_cache_key(self, req: Dict[str, Any], reader) -> Optional[Tuple]:
+        """Cacheable iff the request cannot pin per-request state: size=0
+        (no fetch context) and no slice. The reader freshness component
+        (segment identity + live counts) makes every refresh/delete a
+        natural invalidation, like the cache's reader-close listener."""
+        body = req.get("body", {})
+        if req.get("window", 0) > 0 or body.get("slice") or \
+                body.get("profile"):
+            return None
+        import json as _json
+        freshness = tuple(
+            (seg.uid, int(np.asarray(m).sum()))
+            for seg, m in zip(reader.segments, reader.live_masks))
+        return (req["index"], req["shard"], freshness,
+                _json.dumps(body, sort_keys=True, default=str),
+                _json.dumps(req.get("df_overrides"), sort_keys=True),
+                req.get("doc_count_override"))
+
     def _on_query(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
         self._reap()
         shard = self.indices.shard(req["index"], req["shard"])
         body = req.get("body", {})
         reader = shard.engine.acquire_reader()
+        cache_key = self._request_cache_key(req, reader)
+        if cache_key is not None:
+            cached = self._request_cache.get(cache_key)
+            if cached is not None:
+                self._request_cache.move_to_end(cache_key)
+                shard.search_stats["request_cache_hits"] += 1
+                return cached
+            shard.search_stats["request_cache_misses"] += 1
         query = dsl.parse_query(body.get("query"))
         sort = parse_sort(body.get("sort"))
 
@@ -131,6 +167,7 @@ class SearchTransportService:
                 rescore=body.get("rescore"),
                 collapse=body.get("collapse"),
                 slice_spec=body.get("slice"),
+                profile=bool(body.get("profile")),
                 cancel_check=(shard_task.ensure_not_cancelled
                               if shard_task else None))
         finally:
@@ -148,7 +185,7 @@ class SearchTransportService:
             context_id = uuid_mod.uuid4().hex
             self._contexts[context_id] = (reader,
                                           self._now() + CONTEXT_KEEP_ALIVE)
-        return {
+        response = {
             "context_id": context_id,
             "total": result.total_hits,
             "relation": result.total_relation,
@@ -163,7 +200,13 @@ class SearchTransportService:
             "suggest_partial": (
                 _suggest_partial(reader, shard.engine.mappers, body)
                 if body.get("suggest") else None),
+            "profile": result.profile,
         }
+        if cache_key is not None:
+            while len(self._request_cache) >= self.REQUEST_CACHE_CAP:
+                self._request_cache.popitem(last=False)
+            self._request_cache[cache_key] = response
+        return response
 
     def _on_fetch(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
         self._reap()
@@ -226,6 +269,12 @@ class TransportSearchAction:
         self.indices = indices
         self.mesh_plane = mesh_plane
         self._rr = 0
+        # adaptive replica selection (ResponseCollectorService.java:179):
+        # rank copies by observed EWMA round-trip + in-flight count
+        from elasticsearch_tpu.action.response_collector import (
+            ResponseCollectorService,
+        )
+        self.response_collector = ResponseCollectorService()
 
     # ------------------------------------------------------------------
     # index/shard resolution
@@ -256,9 +305,12 @@ class TransportSearchAction:
                 if not copies:
                     raise SearchEngineError(
                         f"no active copy for [{index}][{sid}]")
+                # round-robin rotation first (fairness among equals), then
+                # the adaptive rank reorders once real observations exist
                 self._rr += 1
                 rot = self._rr % len(copies)
                 copies = copies[rot:] + copies[:rot]
+                copies = self.response_collector.order_copies(copies)
                 targets.append({"index": index, "shard": sid,
                                 "node": copies[0], "copies": copies})
         return targets
@@ -478,8 +530,12 @@ class TransportSearchAction:
                 req.update(dfs_overrides)
             copies = target.get("copies", [target["node"]])
             node = copies[copy_idx]
+            t_sent = time.monotonic()
+            self.response_collector.on_send(node)
 
             def cb(resp, err):
+                self.response_collector.on_response(
+                    node, time.monotonic() - t_sent, failed=err is not None)
                 if phase_state.get("aborted"):
                     return
                 if err is not None:
@@ -672,6 +728,16 @@ class TransportSearchAction:
             resp["_shards"]["failures"] = phase_state["failures"]
         if phase_state.get("data_plane"):
             resp["_data_plane"] = phase_state["data_plane"]
+        if body.get("profile"):
+            shards_profile = []
+            for target, r in zip(targets, results or []):
+                if r is None or r.get("profile") is None:
+                    continue
+                shards_profile.append({
+                    "id": f"[{target.get('node')}][{target['index']}]"
+                          f"[{target['shard']}]",
+                    "searches": [r["profile"]]})
+            resp["profile"] = {"shards": shards_profile}
         return resp
 
     def _empty_response(self, t0, n_shards) -> Dict[str, Any]:

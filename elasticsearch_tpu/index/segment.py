@@ -25,6 +25,7 @@ while segments grow/merge (SURVEY.md §7 hard part #3).
 
 from __future__ import annotations
 
+import itertools
 import math
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -206,12 +207,18 @@ class FeaturesField:
         return int(self.feat_block_start[fid]), int(self.feat_block_count[fid])
 
 
+_SEGMENT_UID = itertools.count(1)
+
+
 class Segment:
     """One immutable segment: all fields' columnar data + _source + id map."""
 
     def __init__(self, name: str, n_docs: int):
         self.name = name
         self.n_docs = n_docs
+        # process-unique identity for cache freshness keys — id() would be
+        # reused by the allocator after a dead segment is collected
+        self.uid = next(_SEGMENT_UID)
         self.postings: Dict[str, PostingsField] = {}
         self.keywords: Dict[str, KeywordField] = {}
         self.doc_values: Dict[str, DocValuesField] = {}

@@ -69,7 +69,8 @@ class IndexShard:
         # wand_* track the pruned collector's block-skipping effectiveness
         self.search_stats: Dict[str, int] = {
             "query_total": 0, "wand_queries": 0,
-            "wand_blocks_total": 0, "wand_blocks_scored": 0}
+            "wand_blocks_total": 0, "wand_blocks_scored": 0,
+            "request_cache_hits": 0, "request_cache_misses": 0}
 
     def _enter_primary_mode(self) -> None:
         self.primary = True

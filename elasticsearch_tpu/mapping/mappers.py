@@ -264,6 +264,31 @@ class DenseVectorFieldMapper(FieldMapper):
         return ParsedField(self.name, "vector", vector=vec)
 
 
+class PercolatorFieldMapper(FieldMapper):
+    """Stored-query field (modules/percolator PercolatorFieldMapper
+    analog): the value is a query body, validated by parsing at INDEX
+    time so a broken alert query is rejected when registered, not
+    silently skipped at percolation time. The body itself stays in
+    _source; percolation evaluates it against a one-doc memory index
+    (search/percolate.py)."""
+
+    type_name = "percolator"
+    searchable = False
+
+    def parse(self, value: Any) -> ParsedField:
+        from elasticsearch_tpu.search import dsl
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"percolator [{self.name}] expects a query object")
+        try:
+            dsl.parse_query(value)
+        except Exception as e:  # noqa: BLE001 — surface as a mapping error
+            raise MapperParsingError(
+                f"percolator [{self.name}] failed to parse query: {e}")
+        # source-only: no columnar contribution
+        return ParsedField(self.name, "terms", terms=[])
+
+
 class RankFeaturesFieldMapper(FieldMapper):
     """Sparse weighted features (reference: RankFeaturesFieldMapper.java).
 
@@ -364,6 +389,7 @@ _MAPPER_TYPES = {
     "boolean": BooleanFieldMapper,
     "date": DateFieldMapper,
     "dense_vector": DenseVectorFieldMapper,
+    "percolator": PercolatorFieldMapper,
     "rank_features": RankFeaturesFieldMapper,
     "rank_feature": RankFeatureFieldMapper,
     "geo_point": GeoPointFieldMapper,

@@ -498,7 +498,8 @@ class NodeClient:
             return
 
         search_keys = ("query_total", "wand_queries",
-                       "wand_blocks_total", "wand_blocks_scored")
+                       "wand_blocks_total", "wand_blocks_scored",
+                       "request_cache_hits", "request_cache_misses")
 
         def _zero() -> Dict[str, Any]:
             return {"docs": 0, "segments": 0, "translog_ops": 0,
@@ -776,6 +777,8 @@ class NodeClient:
                     "transport": dict(
                         self.node.transport_service.stats),
                     "breakers": BREAKERS.stats(),
+                    "adaptive_selection":
+                        self.node.search_action.response_collector.stats(),
                 }
             }
         }

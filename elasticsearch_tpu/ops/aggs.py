@@ -37,30 +37,33 @@ def ordinal_counts(ords: jnp.ndarray,     # [E] int32 bucket ids (-1 pad)
 
 
 @partial(jax.jit, static_argnames=("n_buckets",))
-def histogram_partials(values: jnp.ndarray,   # [N_pad] f32 column
+def histogram_partials(values: jnp.ndarray,   # [N_pad] int32 column
                        exists: jnp.ndarray,   # [N_pad] bool
                        mask: jnp.ndarray,     # [N_pad] bool query matches
-                       base: jnp.ndarray,     # scalar f32 (first bucket key)
-                       interval: jnp.ndarray,  # scalar f32
+                       base: jnp.ndarray,     # scalar int32 (min bucket id)
+                       interval: jnp.ndarray,  # scalar int32
                        n_buckets: int
                        ) -> Tuple[jnp.ndarray, jnp.ndarray,
                                   jnp.ndarray, jnp.ndarray]:
     """(counts, sums, mins, maxs) per histogram bucket in one dispatch.
 
-    The sum/min/max vectors come free with the same scatter pass, so
-    metric sub-aggs on the SAME field reduce without a second pass."""
+    Bucketing is INTEGER floor-division — exact, so a segment served by
+    this kernel and one served by the host collector (float64
+    floor(v/interval)) always agree on bucket keys; the caller gates on
+    integral columns and intervals. The sum/min/max vectors come free
+    with the same scatter pass, so metric sub-aggs on the SAME field
+    reduce without a second pass."""
     ok = exists & mask
-    ids = jnp.floor((values - base) / interval).astype(jnp.int32)
+    ids = jnp.floor_divide(values, interval) - base
     ok = ok & (ids >= 0) & (ids < n_buckets)
     safe = jnp.where(ok, ids, 0)
-    okf = ok.astype(jnp.float32)
+    vf = values.astype(jnp.float32)          # exact: caller gates |v|<2^24
     counts = jnp.zeros((n_buckets,), jnp.int32).at[safe].add(
         ok.astype(jnp.int32), mode="drop")
     sums = jnp.zeros((n_buckets,), jnp.float32).at[safe].add(
-        jnp.where(ok, values, 0.0), mode="drop")
+        jnp.where(ok, vf, 0.0), mode="drop")
     mins = jnp.full((n_buckets,), jnp.inf, jnp.float32).at[safe].min(
-        jnp.where(ok, values, jnp.inf), mode="drop")
+        jnp.where(ok, vf, jnp.inf), mode="drop")
     maxs = jnp.full((n_buckets,), -jnp.inf, jnp.float32).at[safe].max(
-        jnp.where(ok, values, -jnp.inf), mode="drop")
-    del okf
+        jnp.where(ok, vf, -jnp.inf), mode="drop")
     return counts, sums, mins, maxs

@@ -98,7 +98,7 @@ class MeshDataPlane:
         # identity of the segment set + live count per segment: any refresh,
         # merge, or delete changes it and invalidates the mesh copy
         return tuple(
-            (sid, tuple(id(seg) for seg in reader.segments),
+            (sid, tuple(seg.uid for seg in reader.segments),
              int(sum(int(np.asarray(m).sum()) for m in reader.live_masks)))
             for sid, reader in readers)
 

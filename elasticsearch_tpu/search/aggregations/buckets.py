@@ -382,8 +382,13 @@ def _device_histogram(spec: AggSpec, ctx, mask, scores
             "fixed_interval", spec.params.get("interval", "1d")))
     else:
         interval = float(spec.params.get("interval", 0))
-    if interval <= 0:
+    # the device kernel buckets by INTEGER floor-division for exact parity
+    # with the host's float64 floor(v/interval): only integral columns and
+    # intervals qualify (f32 division could misbucket at boundaries)
+    if interval <= 0 or not float(interval).is_integer() or \
+            dv.values.dtype.kind != "i":
         return None
+    interval = int(interval)
     dev_mask = getattr(ctx, "_agg_device_mask", None)
     if dev_mask is None:
         return None
@@ -393,31 +398,29 @@ def _device_histogram(spec: AggSpec, ctx, mask, scores
     import jax.numpy as jnp
     from elasticsearch_tpu.index.segment import next_pow2
     from elasticsearch_tpu.ops.aggs import histogram_partials
-    vmin = float(dv.values[docs].min())
-    vmax = float(dv.values[docs].max())
+    vmin = int(dv.values[docs].min())
+    vmax = int(dv.values[docs].max())
     if max(abs(vmin), abs(vmax)) >= 2 ** 24:
-        # the device column is f32; values beyond the exact-integer range
-        # (epoch-millis dates above all) could misbucket at boundaries —
-        # exactness wins, fall back to the host collector
+        # int32-safe AND f32-exact for the fused sum/min/max vectors;
+        # epoch-millis dates exceed this and fall back to the host path
         return None
-    base = float(np.floor(vmin / interval) * interval)
-    n_buckets = int(np.floor(vmax / interval)
-                    - np.floor(vmin / interval)) + 1
+    base_div = vmin // interval
+    n_buckets = vmax // interval - base_div + 1
     if n_buckets > MAX_BUCKETS:
         return None
     nb_pad = next_pow2(n_buckets, minimum=8)   # bucketed: caps compiles
 
     def build():
-        values = np.zeros(ctx.n_docs_pad, np.float32)
-        values[: seg.n_docs] = dv.values.astype(np.float32)
+        values = np.zeros(ctx.n_docs_pad, np.int32)
+        values[: seg.n_docs] = dv.values.astype(np.int32)
         exists = np.zeros(ctx.n_docs_pad, bool)
         exists[: seg.n_docs] = dv.exists
         return jnp.asarray(values), jnp.asarray(exists)
 
-    values_dev, exists_dev = seg.device(("agg_dv", fname), build)
+    values_dev, exists_dev = seg.device(("agg_dv_i32", fname), build)
     counts, sums, mins, maxs = histogram_partials(
-        values_dev, exists_dev, dev_mask, jnp.float32(base),
-        jnp.float32(interval), nb_pad)
+        values_dev, exists_dev, dev_mask, jnp.int32(base_div),
+        jnp.int32(interval), nb_pad)
     counts = np.asarray(counts)[:n_buckets]
     sums = np.asarray(sums)[:n_buckets]
     mins = np.asarray(mins)[:n_buckets]
@@ -427,7 +430,7 @@ def _device_histogram(spec: AggSpec, ctx, mask, scores
         # IDENTICAL key derivation to the host path (float key, repr'd
         # bucket id) or segments served by different paths would merge
         # into separate buckets for the same key
-        key = float(base + float(i) * interval)
+        key = float((int(i) + base_div) * interval)
         subs = {sub.name: _sub_partial_from_stats(
                     sub, int(counts[i]), float(sums[i]),
                     float(mins[i]), float(maxs[i]))
@@ -886,12 +889,15 @@ def collect_significant_terms(spec: AggSpec, ctx, mask, scores
         fg = np.bincount(ords[mask[owners]], minlength=len(term_list))
         for tid in np.nonzero(fg)[0]:
             key = term_list[int(tid)]
-            bmask = np.zeros(n, bool)
-            bmask[owners[(ords == tid)]] = True
+            subs: Dict[str, Any] = {}
+            if spec.subs:
+                # only pay the per-term O(n_docs) mask when there are subs
+                bmask = np.zeros(n, bool)
+                bmask[owners[(ords == tid)]] = True
+                subs = _collect_subs(spec, ctx, bmask & mask, scores)
             buckets[str(key)] = {
                 "key": key, "doc_count": int(fg[tid]),
-                "bg_count": int(bg[tid]),
-                "subs": _collect_subs(spec, ctx, bmask & mask, scores)}
+                "bg_count": int(bg[tid]), "subs": subs}
     else:
         owners, values = numeric_occurrences(ctx, fname)
         for v in np.unique(values):

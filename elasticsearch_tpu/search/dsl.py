@@ -207,6 +207,17 @@ class FunctionScore(Query):
 
 
 @dataclass
+class Percolate(Query):
+    """Reverse search: which stored queries match this document
+    (modules/percolator PercolateQueryBuilder analog)."""
+    # NOTE: ``documents`` must precede ``field`` — the attribute named
+    # "field" shadows dataclasses.field for the rest of the class body
+    documents: List[Dict[str, Any]] = field(default_factory=list)
+    field: str = "query"
+    boost: float = 1.0
+
+
+@dataclass
 class Nested(Query):
     path: str = ""
     query: Query = None
@@ -394,6 +405,11 @@ _PARSERS = {
         negative_boost=float(spec.get("negative_boost", 0.5)),
         boost=float(spec.get("boost", 1.0))),
     "knn": _parse_knn,
+    "percolate": lambda spec: Percolate(
+        field=spec.get("field", "query"),
+        documents=(spec.get("documents")
+                   or ([spec["document"]] if "document" in spec else [])),
+        boost=float(spec.get("boost", 1.0))),
     "nested": lambda spec: Nested(
         path=spec["path"], query=parse_query(spec.get("query")),
         score_mode=spec.get("score_mode", "avg"),

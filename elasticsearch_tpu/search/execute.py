@@ -744,6 +744,20 @@ def _h_text_expansion(q: dsl.TextExpansion, ctx: SegmentContext) -> Result:
     return scores, scores > 0.0
 
 
+def _h_percolate(q: dsl.Percolate, ctx: SegmentContext) -> Result:
+    """Reverse search over stored queries (search/percolate.py). Matching
+    stored queries score a constant boost (the reference scores with the
+    stored query's own score against the document; documented
+    divergence)."""
+    from elasticsearch_tpu.search.percolate import percolate_segment
+    if not q.documents:
+        raise QueryParsingError(
+            "percolate requires [document] or [documents]")
+    mask_host = percolate_segment(ctx, q.field, q.documents)
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
 def _h_nested(q: dsl.Nested, ctx: SegmentContext) -> Result:
     """Per-object nested matching over _source (search/nested.py).
 
@@ -917,6 +931,7 @@ _HANDLERS = {
     dsl.Boosting: _h_boosting,
     dsl.Knn: _h_knn,
     dsl.Nested: _h_nested,
+    dsl.Percolate: _h_percolate,
     dsl.RankFeature: _h_rank_feature,
     dsl.TextExpansion: _h_text_expansion,
     dsl.ScriptScore: _h_script_score,

@@ -1,0 +1,78 @@
+"""Percolator-lite: reverse search — which stored queries match this doc?
+
+Reference: modules/percolator/ (PercolateQueryBuilder + the percolator
+field type). Queries are indexed as documents (their body lives in
+_source under a ``percolator`` field); a percolate query carries a
+DOCUMENT, builds a one-doc in-memory index from it, and matches every
+stored query against that mini index — the same "memory index" strategy
+as the reference's MemoryIndex verification phase, but re-using this
+build's ordinary segment + execute machinery so every supported query
+type percolates with identical semantics.
+
+The per-(segment, document) result mask is cached on the immutable
+segment, so repeated percolation of the same document (alert fan-out)
+pays the stored-query scan once.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["build_document_ctx", "percolate_segment"]
+
+
+def build_document_ctx(documents: List[Dict[str, Any]], mappers):
+    """SegmentContext over an in-memory segment holding the percolated
+    document(s) (MemoryIndex analog).
+
+    The candidate document is parsed with a THROWAWAY copy of the shard's
+    mapper service: dynamic inference on unmapped fields must map them for
+    this percolation only — mutating the live service from a search would
+    poison later indexing (and dynamic:strict would otherwise reject the
+    whole search)."""
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.mapping.mappers import MapperService
+    from elasticsearch_tpu.search.execute import SegmentContext
+    scratch = MapperService(mappers.to_mapping(), analysis=mappers.analysis,
+                            dynamic=True)
+    builder = SegmentBuilder("_percolate_doc", scratch)
+    for i, document in enumerate(documents):
+        builder.add(scratch.parse_document(f"_doc_{i}", document), seqno=i)
+    return SegmentContext(builder.build(), scratch)
+
+
+def percolate_segment(ctx, field_name: str,
+                      documents: List[Dict[str, Any]]) -> np.ndarray:
+    """Mask over the percolator segment's docs: True where the stored
+    query under ``field_name`` matches ANY of the candidate documents."""
+    from elasticsearch_tpu.search import dsl
+    from elasticsearch_tpu.search.execute import execute
+
+    seg = ctx.segment
+    key = ("percolate", field_name,
+           json.dumps(documents, sort_keys=True, default=str))
+
+    def build():
+        doc_ctx = build_document_ctx(documents, ctx.mappers)
+        n_cand = len(documents)
+        mask = np.zeros(seg.n_docs, bool)
+        for d in range(seg.n_docs):
+            src = seg.sources[d] or {}
+            body = src.get(field_name)
+            if body is None:
+                continue
+            try:
+                stored = dsl.parse_query(body)
+                _, m = execute(stored, doc_ctx)
+                mask[d] = bool(np.asarray(m)[:n_cand].any())
+            except Exception:  # noqa: BLE001 — a malformed stored query
+                # (indexed before the mapping validated, or using an
+                # unsupported type) simply never matches, like the
+                # reference's query-parse failure policy at search time
+                continue
+        return mask
+
+    return seg.cached_filter(key, build)

@@ -63,6 +63,9 @@ class ShardQueryResult:
     # the pruned path, (posting blocks total, posting blocks scored)
     collector: str = "dense"
     prune_stats: Optional[Tuple[int, int]] = None
+    # per-shard profile block when the request set "profile": true
+    # (search/profile/query/QueryProfiler analog)
+    profile: Optional[Dict[str, Any]] = None
 
 
 def parse_sort(sort_body: Any) -> List[SortSpec]:
@@ -276,6 +279,7 @@ def query_shard(reader: Reader,
                 rescore: Any = None,
                 collapse: Optional[Dict[str, Any]] = None,
                 slice_spec: Optional[Dict[str, Any]] = None,
+                profile: bool = False,
                 cancel_check: Optional[Any] = None) -> ShardQueryResult:
     """Execute one query over all segments of a shard snapshot.
 
@@ -343,6 +347,27 @@ def query_shard(reader: Reader,
         # (SearchService.java sizes the query phase to max(size, window))
         specs = rescore if isinstance(rescore, list) else [rescore]
         want = max(want, max(int(s.get("window_size", 10)) for s in specs))
+    import time as _time
+    t_query_start = _time.perf_counter_ns()
+
+    def _profile_block(collector_name: str, reason: str) -> Dict[str, Any]:
+        """QueryProfiler-shaped block: one entry for the query tree, one
+        for the collector, timed wall-to-wall per shard."""
+        elapsed = _time.perf_counter_ns() - t_query_start
+        return {
+            "query": [{
+                "type": type(query).__name__,
+                "description": repr(query),
+                "time_in_nanos": elapsed,
+            }],
+            "collector": [{
+                "name": collector_name,
+                "reason": reason,
+                "time_in_nanos": elapsed,
+            }],
+            "segments": len(ctxs),
+        }
+
     from elasticsearch_tpu.indices.breaker import BREAKERS
     request_breaker = BREAKERS.breaker("request")
     if collector == "wand_topk":
@@ -356,7 +381,10 @@ def query_shard(reader: Reader,
         return ShardQueryResult(
             candidates[from_: from_ + size], hits, "gte", max_score,
             doc_count=doc_count, dfs=dfs,
-            collector="wand_topk", prune_stats=prune)
+            collector="wand_topk", prune_stats=prune,
+            profile=(_profile_block(
+                "WandTopKCollector", "search_top_hits (block-max pruned)")
+                if profile else None))
 
     # Lucene-style kNN rewrite: per-segment top-k merged to shard-global k
     from elasticsearch_tpu.search.execute import rewrite_knn
@@ -369,11 +397,20 @@ def query_shard(reader: Reader,
     transient = sum(8 * ctx.n_docs_pad for ctx in ctxs)
     request_breaker.add_estimate(transient, "dense_query")
     try:
-        return _query_shard_dense(
+        result = _query_shard_dense(
             ctxs, reader, mappers, query, sort, size, from_, want,
             search_after, min_score, exact_total, track_limit, total_hits,
             score_sort, score_asc, collectors, cancel_check, doc_count, dfs,
             candidates, rescore, collapse, slice_spec)
+        if profile:
+            name = ("SimpleFieldCollector" if not score_sort
+                    else "SimpleTopScoreDocCollector")
+            reason = "search_top_hits"
+            if collectors:
+                name = f"MultiCollector [{name}, aggregations]"
+                reason = "search_multi"
+            result.profile = _profile_block(name, reason)
+        return result
     finally:
         request_breaker.release(transient)
 

@@ -91,6 +91,7 @@ class SearchService:
             rescore=body.get("rescore"),
             collapse=body.get("collapse"),
             slice_spec=body.get("slice"),
+            profile=bool(body.get("profile")),
         )
 
         include_sort = body.get("sort") is not None or search_after is not None
@@ -132,6 +133,11 @@ class SearchService:
             )
             response["suggest"] = merge_suggestions([build_suggestions(
                 reader, self.engine.mappers, body["suggest"])])
+
+        if result.profile is not None:
+            response["profile"] = {"shards": [{
+                "id": f"[_local][{self.index_name}][0]",
+                "searches": [result.profile]}]}
 
         if scroll_keep_alive:
             scroll_id = uuid.uuid4().hex

@@ -1,0 +1,116 @@
+"""Adaptive replica selection + shard request cache.
+
+Reference: node/ResponseCollectorService.java:179 (EWMA/C3 copy ranking)
+and indices/IndicesRequestCache.java:69 (size=0 shard result cache with
+reader-identity invalidation).
+"""
+
+import pytest
+
+from elasticsearch_tpu.action.response_collector import (
+    ResponseCollectorService,
+)
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+def test_collector_prefers_faster_node():
+    rc = ResponseCollectorService()
+    for _ in range(5):
+        rc.on_send("fast")
+        rc.on_response("fast", 0.010)
+        rc.on_send("slow")
+        rc.on_response("slow", 0.200)
+    assert rc.order_copies(["slow", "fast"]) == ["fast", "slow"]
+    assert rc.rank("fast") < rc.rank("slow")
+
+
+def test_collector_unknown_node_ranks_best():
+    rc = ResponseCollectorService()
+    rc.on_send("seen")
+    rc.on_response("seen", 0.05)
+    assert rc.order_copies(["seen", "new"]) == ["new", "seen"]
+
+
+def test_collector_failure_backs_off():
+    rc = ResponseCollectorService()
+    rc.on_send("flaky")
+    rc.on_response("flaky", 0.01, failed=True)
+    rc.on_send("ok")
+    rc.on_response("ok", 0.5)
+    assert rc.rank("flaky") > rc.rank("ok")
+
+
+def test_collector_queue_pressure_raises_rank():
+    rc = ResponseCollectorService()
+    for node in ("a", "b"):
+        rc.on_send(node)
+        rc.on_response(node, 0.05)
+    rc.on_send("a")   # a now has one in-flight request
+    assert rc.rank("a") > rc.rank("b")
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=1, seed=13)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def test_request_cache_hits_and_invalidates(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("rc", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {"body": {"type": "text"},
+                                    "tag": {"type": "keyword"}}}}, cb)))
+    cluster.ensure_green("rc")
+    for i in range(10):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "rc", f"d{i}", {"body": "alpha", "tag": f"t{i % 2}"}, cb)))
+    cluster.call(lambda cb: client.refresh("rc", cb))
+
+    body = {"size": 0, "query": {"match": {"body": "alpha"}},
+            "aggs": {"t": {"terms": {"field": "tag"}}}}
+    r1 = _ok(*cluster.call(lambda cb: client.search("rc", body, cb)))
+    r2 = _ok(*cluster.call(lambda cb: client.search("rc", body, cb)))
+    assert r1["aggregations"] == r2["aggregations"]
+    node = cluster.master()
+    stats = node.indices_service.shard("rc", 0).search_stats
+    assert stats["request_cache_hits"] == 1
+    assert stats["request_cache_misses"] == 1
+
+    # size>0 requests bypass the cache entirely
+    _ok(*cluster.call(lambda cb: client.search(
+        "rc", {"size": 5, "query": {"match": {"body": "alpha"}}}, cb)))
+    assert stats["request_cache_hits"] == 1
+    assert stats["request_cache_misses"] == 1
+
+    # a refresh after new writes changes the reader freshness: miss, and
+    # the fresh result reflects the new doc
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "rc", "new", {"body": "alpha", "tag": "t0"}, cb)))
+    cluster.call(lambda cb: client.refresh("rc", cb))
+    r3 = _ok(*cluster.call(lambda cb: client.search("rc", body, cb)))
+    assert stats["request_cache_misses"] == 2
+    counts = {b["key"]: b["doc_count"]
+              for b in r3["aggregations"]["t"]["buckets"]}
+    assert counts["t0"] == 6
+
+
+def test_ars_surfaces_in_nodes_stats(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("a", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0}}, cb)))
+    cluster.ensure_green("a")
+    _ok(*cluster.call(lambda cb: client.index_doc("a", "x", {"v": 1}, cb)))
+    cluster.call(lambda cb: client.refresh("a", cb))
+    _ok(*cluster.call(lambda cb: client.search(
+        "a", {"query": {"match_all": {}}}, cb)))
+    stats = cluster.master().client.nodes_stats()
+    sel = next(iter(stats["nodes"].values()))["adaptive_selection"]
+    assert sel and all("ewma_ms" in s for s in sel.values())

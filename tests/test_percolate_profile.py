@@ -1,0 +1,112 @@
+"""Percolator-lite + search profile API.
+
+Reference: modules/percolator/ (stored queries, reverse search) and
+search/profile/ (per-shard query/collector timing blocks).
+"""
+
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import MapperService
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.testing import InProcessCluster
+from elasticsearch_tpu.utils.errors import MapperParsingError
+
+
+@pytest.fixture()
+def alerts():
+    mappers = MapperService({"properties": {
+        "query": {"type": "percolator"},
+        "label": {"type": "keyword"},
+    }})
+    engine = InternalEngine(mappers)
+    engine.index("q1", {"label": "shoes",
+                        "query": {"match": {"body": "shoe"}}})
+    engine.index("q2", {"label": "cheap",
+                        "query": {"range": {"price": {"lte": 20}}}})
+    engine.index("q3", {"label": "red-shoes",
+                        "query": {"bool": {"must": [
+                            {"match": {"body": "shoe"}},
+                            {"term": {"color": "red"}}]}}})
+    engine.refresh()
+    return SearchService(engine, index_name="alerts")
+
+
+def test_percolate_matches_stored_queries(alerts):
+    res = alerts.search({"query": {"percolate": {
+        "field": "query",
+        "document": {"body": "a red shoe", "color": "red",
+                     "price": 50}}}})
+    ids = sorted(h["_id"] for h in res["hits"]["hits"])
+    assert ids == ["q1", "q3"]
+
+    res = alerts.search({"query": {"percolate": {
+        "field": "query",
+        "document": {"body": "blue boot", "price": 10}}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["q2"]
+
+
+def test_percolate_multiple_documents_any_match(alerts):
+    res = alerts.search({"query": {"percolate": {
+        "field": "query",
+        "documents": [{"body": "sandal", "price": 99},
+                      {"body": "running shoe", "price": 99}]}}})
+    ids = sorted(h["_id"] for h in res["hits"]["hits"])
+    assert ids == ["q1"]
+
+
+def test_percolator_mapping_rejects_broken_query():
+    mappers = MapperService({"properties": {
+        "query": {"type": "percolator"}}})
+    with pytest.raises(MapperParsingError):
+        mappers.parse_document("bad", {
+            "query": {"definitely_not_a_query": {}}})
+
+
+def test_profile_single_shard(alerts):
+    res = alerts.search({"query": {"match": {"label": "shoes"}},
+                         "profile": True})
+    shards = res["profile"]["shards"]
+    assert len(shards) == 1
+    search = shards[0]["searches"][0]
+    assert search["query"][0]["type"] == "Match"
+    assert search["query"][0]["time_in_nanos"] > 0
+    assert search["collector"][0]["name"]
+    # profile off by default
+    res2 = alerts.search({"query": {"match": {"label": "shoes"}}})
+    assert "profile" not in res2
+
+
+def test_profile_distributed_and_wand_collector():
+    c = InProcessCluster(n_nodes=1, seed=17)
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.create_index("p", {
+            "settings": {"number_of_shards": 2,
+                         "number_of_replicas": 0}}, cb))
+        assert e is None, e
+        c.ensure_green("p")
+        for i in range(8):
+            r, e = c.call(lambda cb, i=i: client.index_doc(
+                "p", f"d{i}", {"body": f"alpha w{i}"}, cb))
+            assert e is None, e
+        c.call(lambda cb: client.refresh("p", cb))
+        res, e = c.call(lambda cb: client.search("p", {
+            "query": {"match": {"body": "alpha"}}, "profile": True}, cb))
+        assert e is None, e
+        shards = res["profile"]["shards"]
+        assert len(shards) == 2
+        for s in shards:
+            assert s["id"].startswith("[node0][p][")
+            assert s["searches"][0]["collector"][0]["name"]
+        # the pruned collector identifies itself in the profile
+        res, e = c.call(lambda cb: client.search("p", {
+            "query": {"match": {"body": "alpha"}},
+            "track_total_hits": False, "profile": True}, cb))
+        assert e is None, e
+        names = {s["searches"][0]["collector"][0]["name"]
+                 for s in res["profile"]["shards"]}
+        assert names == {"WandTopKCollector"}
+    finally:
+        c.stop()
