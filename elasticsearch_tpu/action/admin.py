@@ -43,6 +43,8 @@ DELETE_TEMPLATE = "indices:admin/index_template/delete"
 PUT_ILM_POLICY = "cluster:admin/ilm/put"
 DELETE_ILM_POLICY = "cluster:admin/ilm/delete"
 ROLLOVER = "indices:admin/rollover"
+PUT_SECURITY = "cluster:admin/xpack/security/put"
+DELETE_SECURITY = "cluster:admin/xpack/security/delete"
 REFRESH_SHARD = "indices:admin/refresh[s]"
 FLUSH_SHARD = "indices:admin/flush[s]"
 FORCEMERGE_SHARD = "indices:admin/forcemerge[s]"
@@ -99,6 +101,8 @@ class MasterActions:
             (PUT_ILM_POLICY, self._on_put_ilm_policy),
             (DELETE_ILM_POLICY, self._on_delete_ilm_policy),
             (ROLLOVER, self._on_rollover),
+            (PUT_SECURITY, self._on_put_security),
+            (DELETE_SECURITY, self._on_delete_security),
             (SHARD_STARTED, self._on_shard_started),
             (SHARD_FAILED, self._on_shard_failed),
         ]:
@@ -343,6 +347,35 @@ class MasterActions:
             return state.next_version(
                 metadata=state.metadata.with_ilm_policy(name, None))
         return self._submit(f"delete-ilm-policy [{name}]", update)
+
+    # -- security entities (native realm's .security index analog) -------
+
+    def _on_put_security(self, req: Dict[str, Any], sender: str) -> Deferred:
+        kind, name = req["kind"], req["name"]
+        if kind not in ("users", "roles"):
+            raise IllegalArgumentError(f"unknown security kind [{kind}]")
+        body = dict(req.get("body") or {})
+
+        def update(state: ClusterState) -> ClusterState:
+            return state.next_version(
+                metadata=state.metadata.with_security_entity(
+                    kind, name, body))
+        return self._submit(f"put-security-{kind} [{name}]", update)
+
+    def _on_delete_security(self, req: Dict[str, Any],
+                            sender: str) -> Deferred:
+        kind, name = req["kind"], req["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            if name not in state.metadata.security.get(kind, {}):
+                from elasticsearch_tpu.utils.errors import (
+                    ResourceNotFoundError,
+                )
+                raise ResourceNotFoundError(f"{kind[:-1]} [{name}] not found")
+            return state.next_version(
+                metadata=state.metadata.with_security_entity(
+                    kind, name, None))
+        return self._submit(f"delete-security-{kind} [{name}]", update)
 
     # -- rollover (TransportRolloverAction's atomic state half) ----------
 

@@ -109,6 +109,9 @@ class Metadata:
     # ILM policies: name -> {phases: {hot: {...}, delete: {...}}}
     # (x-pack/plugin/core/.../ilm/LifecyclePolicy.java analog)
     ilm_policies: Mapping[str, Any] = field(default_factory=dict)
+    # security entities: {"users": {name: {hash, salt, roles}},
+    # "roles": {name: {cluster, indices}}} — the .security index analog
+    security: Mapping[str, Any] = field(default_factory=dict)
     persistent_settings: Mapping[str, Any] = field(default_factory=dict)
     version: int = 0
 
@@ -166,6 +169,17 @@ class Metadata:
         return replace(self, ilm_policies=policies,
                        version=self.version + 1)
 
+    def with_security_entity(self, kind: str, name: str,
+                             body: Optional[Mapping[str, Any]]
+                             ) -> "Metadata":
+        """Put (or with None, delete) one user/role under security[kind]."""
+        section = {k: v for k, v in
+                   dict(self.security.get(kind, {})).items() if k != name}
+        if body is not None:
+            section[name] = dict(body)
+        return replace(self, security={**self.security, kind: section},
+                       version=self.version + 1)
+
     def with_persistent_settings(self, settings: Mapping[str, Any]) -> "Metadata":
         # a None value unsets the key (the reference's null-reset semantics
         # for PUT _cluster/settings)
@@ -190,6 +204,7 @@ class Metadata:
         return {"indices": {k: v.to_dict() for k, v in self.indices.items()},
                 "templates": dict(self.templates),
                 "ilm_policies": dict(self.ilm_policies),
+                "security": dict(self.security),
                 "persistent_settings": dict(self.persistent_settings),
                 "version": self.version}
 
@@ -200,6 +215,7 @@ class Metadata:
                      for k, v in d.get("indices", {}).items()},
             templates=dict(d.get("templates", {})),
             ilm_policies=dict(d.get("ilm_policies", {})),
+            security=dict(d.get("security", {})),
             persistent_settings=dict(d.get("persistent_settings", {})),
             version=d.get("version", 0))
 

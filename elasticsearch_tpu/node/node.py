@@ -154,6 +154,9 @@ class Node:
         from elasticsearch_tpu.ilm import IndexLifecycleService
         self.ilm_service = IndexLifecycleService(self)
 
+        from elasticsearch_tpu.xpack.security import SecurityService
+        self.security = SecurityService(self)
+
     # ------------------------------------------------------------------
 
     def _applied_state(self) -> ClusterState:
@@ -312,6 +315,77 @@ class NodeClient:
     def get_ilm_policies(self) -> Dict[str, Any]:
         return {k: {"policy": dict(v)} for k, v in sorted(
             self.node._applied_state().metadata.ilm_policies.items())}
+
+    # -- security ---------------------------------------------------------
+
+    def put_security_user(self, name: str, body: Dict[str, Any],
+                          on_done) -> None:
+        from elasticsearch_tpu.action.admin import PUT_SECURITY
+        from elasticsearch_tpu.xpack.security import hash_password
+        raw = dict(body or {})
+        password = raw.pop("password", None)
+        if password is None:
+            # pre-hashed credentials are NOT accepted (the reference's
+            # API doesn't either): a stored malformed hash/salt pair
+            # would crash every later verification for that user
+            on_done(None, IllegalArgumentError(
+                f"user [{name}] requires [password]"))
+            return
+        roles = raw.get("roles", [])
+        if not isinstance(roles, list) or \
+                not all(isinstance(r, str) for r in roles):
+            on_done(None, IllegalArgumentError(
+                f"user [{name}] [roles] must be a list of role names"))
+            return
+        entity = {"roles": roles, **hash_password(str(password))}
+        if "full_name" in raw:
+            entity["full_name"] = str(raw["full_name"])
+        self.node.master_client.execute(
+            PUT_SECURITY, {"kind": "users", "name": name, "body": entity},
+            on_done)
+
+    def put_security_role(self, name: str, body: Dict[str, Any],
+                          on_done) -> None:
+        from elasticsearch_tpu.action.admin import PUT_SECURITY
+        from elasticsearch_tpu.xpack.security import (
+            CLUSTER_PRIVILEGES, INDEX_PRIVILEGES,
+        )
+        body = dict(body or {})
+        bad = set(body.get("cluster", [])) - CLUSTER_PRIVILEGES
+        if bad:
+            on_done(None, IllegalArgumentError(
+                f"unknown cluster privileges {sorted(bad)}"))
+            return
+        for grant in body.get("indices", []):
+            names = grant.get("names")
+            if not isinstance(names, list) or not names:
+                on_done(None, IllegalArgumentError(
+                    "role index grants require [names] as a list"))
+                return
+            bad = set(grant.get("privileges", [])) - INDEX_PRIVILEGES
+            if bad:
+                on_done(None, IllegalArgumentError(
+                    f"unknown index privileges {sorted(bad)}"))
+                return
+        self.node.master_client.execute(
+            PUT_SECURITY, {"kind": "roles", "name": name,
+                           "body": body}, on_done)
+
+    def delete_security_entity(self, kind: str, name: str, on_done) -> None:
+        from elasticsearch_tpu.action.admin import DELETE_SECURITY
+        self.node.master_client.execute(
+            DELETE_SECURITY, {"kind": kind, "name": name}, on_done)
+
+    def get_security_entities(self, kind: str,
+                              name: Optional[str] = None) -> Dict[str, Any]:
+        section = dict(self.node._applied_state()
+                       .metadata.security.get(kind, {}))
+        if name is not None:
+            section = {k: v for k, v in section.items() if k == name}
+        # never expose hashes over the API
+        return {k: {kk: vv for kk, vv in v.items()
+                    if kk not in ("hash", "salt")}
+                for k, v in section.items()}
 
     def rollover(self, alias: str, body: Optional[Dict[str, Any]],
                  on_done) -> None:

@@ -25,6 +25,7 @@ class RestRequest:
     query: Dict[str, str] = field(default_factory=dict)    # ?k=v
     body: Any = None                                       # parsed JSON
     raw_body: bytes = b""
+    headers: Dict[str, str] = field(default_factory=dict)  # lowercased keys
 
     def param(self, name: str, default: Optional[str] = None) -> Optional[str]:
         return self.params.get(name, self.query.get(name, default))

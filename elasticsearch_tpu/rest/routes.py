@@ -341,6 +341,52 @@ def build_controller(client: NodeClient) -> RestController:
                         wrap_client_cb(done))
     r("POST", "/{index}/_rollover", rollover_post)
 
+    # -- security (x-pack/plugin/security REST surface) -------------------
+
+    def user_put(req: RestRequest, done: DoneFn) -> None:
+        client.put_security_user(req.params["name"], req.body or {},
+                                 wrap_client_cb(done))
+    r("PUT", "/_security/user/{name}", user_put)
+    r("POST", "/_security/user/{name}", user_put)
+
+    def role_put(req: RestRequest, done: DoneFn) -> None:
+        client.put_security_role(req.params["name"], req.body or {},
+                                 wrap_client_cb(done))
+    r("PUT", "/_security/role/{name}", role_put)
+    r("POST", "/_security/role/{name}", role_put)
+
+    def user_delete(req: RestRequest, done: DoneFn) -> None:
+        client.delete_security_entity("users", req.params["name"],
+                                      wrap_client_cb(done))
+    r("DELETE", "/_security/user/{name}", user_delete)
+
+    def role_delete(req: RestRequest, done: DoneFn) -> None:
+        client.delete_security_entity("roles", req.params["name"],
+                                      wrap_client_cb(done))
+    r("DELETE", "/_security/role/{name}", role_delete)
+
+    def user_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.get_security_entities(
+            "users", req.params.get("name")))
+    r("GET", "/_security/user", user_get)
+    r("GET", "/_security/user/{name}", user_get)
+
+    def role_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.get_security_entities(
+            "roles", req.params.get("name")))
+    r("GET", "/_security/role", role_get)
+    r("GET", "/_security/role/{name}", role_get)
+
+    def authenticate(req: RestRequest, done: DoneFn) -> None:
+        user = client.node.security.authenticate(req.headers or {})
+        if user is None:
+            done(401, {"error": {"type": "security_exception",
+                                 "reason": "missing or invalid credentials"},
+                       "status": 401})
+            return
+        done(200, {"username": user["username"], "roles": user["roles"]})
+    r("GET", "/_security/_authenticate", authenticate)
+
     def alias_get(req: RestRequest, done: DoneFn) -> None:
         state = client.node._applied_state()
         out: Dict[str, Any] = {}
@@ -586,7 +632,8 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/_cluster/health/{index}", health)
 
     def cluster_state(req: RestRequest, done: DoneFn) -> None:
-        done(200, client.cluster_state())
+        from elasticsearch_tpu.xpack.security import redact_state
+        done(200, redact_state(client.cluster_state()))
     r("GET", "/_cluster/state", cluster_state)
 
     def cluster_settings_put(req: RestRequest, done: DoneFn) -> None:
@@ -594,9 +641,11 @@ def build_controller(client: NodeClient) -> RestController:
     r("PUT", "/_cluster/settings", cluster_settings_put)
 
     def cluster_settings_get(req: RestRequest, done: DoneFn) -> None:
+        from elasticsearch_tpu.xpack.security import redact_settings
         state = client.node._applied_state()
-        done(200, {"persistent": dict(state.metadata.persistent_settings),
-                   "transient": {}})
+        done(200, {"persistent": redact_settings(
+            dict(state.metadata.persistent_settings)),
+            "transient": {}})
     r("GET", "/_cluster/settings", cluster_settings_get)
 
     def nodes(req: RestRequest, done: DoneFn) -> None:

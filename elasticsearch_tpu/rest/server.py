@@ -118,7 +118,7 @@ class HttpServer:
             except json.JSONDecodeError:
                 body = None
         return RestRequest(method=method, path=split.path, query=query,
-                           body=body, raw_body=raw)
+                           body=body, raw_body=raw, headers=headers)
 
     async def _dispatch(self, request: RestRequest) -> Tuple[int, Any]:
         loop = asyncio.get_running_loop()
@@ -131,8 +131,26 @@ class HttpServer:
 
         # dispatch on the scheduler thread so all node-internal callbacks
         # stay single-threaded (the applier-thread discipline)
-        self.client.node.scheduler.submit(
-            lambda: self.controller.dispatch(request, on_done))
+        def run() -> None:
+            # SecurityRestFilter analog: authn/authz before any handler.
+            # A filter exception must resolve the request (500), or the
+            # awaiting future — and the client connection — hang forever.
+            try:
+                security = getattr(self.client.node, "security", None)
+                if security is not None:
+                    denied = security.check(request)
+                    if denied is not None:
+                        on_done(*denied)
+                        return
+            except Exception as e:  # noqa: BLE001
+                on_done(500, {"error": {
+                    "type": "security_exception",
+                    "reason": f"authentication filter failed: {e}"},
+                    "status": 500})
+                return
+            self.controller.dispatch(request, on_done)
+
+        self.client.node.scheduler.submit(run)
         return await future
 
     async def _write_response(self, writer: asyncio.StreamWriter,

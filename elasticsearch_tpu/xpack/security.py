@@ -1,0 +1,305 @@
+"""Security: authentication (basic auth) + role-based authorization.
+
+Reference: x-pack/plugin/security/ — Realms (native realm backed by the
+.security index), Role/RoleDescriptor with cluster and index privileges,
+and the REST filter that authenticates every request
+(SecurityRestFilter). Re-designed for this build: users and roles live
+in cluster-state metadata (replicated + persisted like every other
+entity here), passwords hash with PBKDF2-HMAC-SHA256, and enforcement
+wraps the REST dispatch — the same boundary the reference filters.
+
+Security is OFF until the dynamic cluster setting
+``xpack.security.enabled`` is true. When it turns on, the built-in
+``elastic`` superuser authenticates with the bootstrap password from
+``xpack.security.bootstrap_password`` (no silent default: enabling
+without a bootstrap password and without any stored user locks the
+cluster open only for _security/_cluster-settings management from
+localhost-less anonymous, i.e. nothing — so the enable call should set
+both together).
+"""
+
+from __future__ import annotations
+
+import base64
+import fnmatch
+import hashlib
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+PBKDF2_ITERATIONS = 120_000
+
+CLUSTER_PRIVILEGES = {"all", "monitor", "manage", "manage_security"}
+INDEX_PRIVILEGES = {"all", "read", "write", "create_index", "delete_index",
+                    "manage", "monitor"}
+
+SUPERUSER_ROLE = {"cluster": ["all"],
+                  "indices": [{"names": ["*"], "privileges": ["all"]}]}
+BUILTIN_ROLES = {"superuser": SUPERUSER_ROLE}
+
+
+def hash_password(password: str, salt: Optional[bytes] = None
+                  ) -> Dict[str, str]:
+    salt = salt if salt is not None else os.urandom(16)
+    digest = hashlib.pbkdf2_hmac("sha256", password.encode("utf-8"), salt,
+                                 PBKDF2_ITERATIONS)
+    return {"salt": salt.hex(), "hash": digest.hex()}
+
+
+def verify_password(password: str, entry: Dict[str, Any]) -> bool:
+    digest = hashlib.pbkdf2_hmac(
+        "sha256", password.encode("utf-8"), bytes.fromhex(entry["salt"]),
+        PBKDF2_ITERATIONS)
+    import hmac
+    return hmac.compare_digest(digest.hex(), entry["hash"])
+
+
+# ---------------------------------------------------------------------------
+# route -> required privilege classification (the action-name mapping the
+# reference derives from TransportAction names)
+# ---------------------------------------------------------------------------
+
+READ_ENDPOINTS = {"_search", "_count", "_doc", "_source", "_mget",
+                  "_termvectors", "_explain", "_msearch", "_rank_eval",
+                  "_search_template", "_scripts", "_analyze",
+                  "_field_caps", "_validate"}
+WRITE_ENDPOINTS = {"_doc", "_create", "_update", "_bulk", "_delete_by_query",
+                   "_update_by_query", "_reindex", "_rollover"}
+MANAGE_ENDPOINTS = {"_settings", "_mapping", "_mappings", "_aliases",
+                    "_open", "_close", "_forcemerge", "_flush", "_refresh",
+                    "_cache", "_snapshot"}
+
+
+def required_privilege(method: str, path: str
+                       ) -> Tuple[str, str, Optional[str]]:
+    """(scope, privilege, index) for a REST call; scope is 'cluster',
+    'index', or 'authenticated' (identity-only endpoints)."""
+    segs = [s for s in path.split("/") if s]
+    if not segs:
+        return ("cluster", "monitor", None)          # GET /
+    first = segs[0]
+    if first.startswith("_") and first != "_all":
+        if path.rstrip("/") == "/_security/_authenticate":
+            # any authenticated principal may ask who it is (the
+            # reference's _authenticate requires no privileges)
+            return ("authenticated", "", None)
+        if first == "_security":
+            return ("cluster", "manage_security", None)
+        if first in ("_bulk", "_reindex", "_mget", "_msearch", "_search"):
+            # request-body APIs spanning indices: classified by verb
+            if method == "GET" or first in ("_mget", "_msearch", "_search"):
+                return ("index", "read", "*")
+            return ("index", "write", "*")
+        if method in ("GET", "HEAD"):
+            return ("cluster", "monitor", None)
+        return ("cluster", "manage", None)
+    # "_all" is an index EXPRESSION, not a cluster endpoint: classify it
+    # like any other index path or index-level authorization is bypassed
+    index = "*" if first == "_all" else first
+    endpoint = next((s for s in segs[1:] if s.startswith("_")), None)
+    if endpoint is None:
+        # index create/delete/exists
+        if method in ("GET", "HEAD"):
+            return ("index", "monitor", index)
+        if method == "DELETE":
+            return ("index", "delete_index", index)
+        return ("index", "create_index", index)
+    if endpoint in WRITE_ENDPOINTS and method in ("POST", "PUT", "DELETE"):
+        return ("index", "write", index)
+    if endpoint in READ_ENDPOINTS:
+        return ("index", "read", index)
+    if endpoint in MANAGE_ENDPOINTS and method in ("POST", "PUT", "DELETE"):
+        return ("index", "manage", index)
+    if method in ("GET", "HEAD"):
+        return ("index", "monitor", index)
+    return ("index", "manage", index)
+
+
+# ---------------------------------------------------------------------------
+# service
+# ---------------------------------------------------------------------------
+
+_SECRET_MARKERS = ("password", "secret", "token")
+
+
+def redact_settings(settings: Dict[str, Any]) -> Dict[str, Any]:
+    """Mask secret-bearing settings in API output (the reference keeps
+    such values in the keystore and never serves them; here they live in
+    cluster state so the REST boundary must redact)."""
+    return {k: ("::es_redacted::" if any(m in k.lower()
+                                         for m in _SECRET_MARKERS) else v)
+            for k, v in settings.items()}
+
+
+def redact_state(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Cluster-state API output with credentials stripped: password
+    hashes/salts and secret settings must not reach monitor-level users
+    (they'd enable offline cracking / bootstrap-password takeover)."""
+    out = dict(state_dict)
+    meta = dict(out.get("metadata") or {})
+    if meta.get("security"):
+        security = {k: dict(v) for k, v in meta["security"].items()}
+        users = {name: {kk: vv for kk, vv in u.items()
+                        if kk not in ("hash", "salt")}
+                 for name, u in security.get("users", {}).items()}
+        if users:
+            security["users"] = users
+        meta["security"] = security
+    if meta.get("persistent_settings"):
+        meta["persistent_settings"] = redact_settings(
+            meta["persistent_settings"])
+    out["metadata"] = meta
+    return out
+
+
+class SecurityService:
+    """Authenticates and authorizes REST requests against cluster state."""
+
+    AUTH_CACHE_CAP = 256
+
+    def __init__(self, node) -> None:
+        self.node = node
+        # (username, sha256(password), metadata.version) -> user record;
+        # the KDF is deliberately slow, so successful verifications are
+        # cached until the next cluster-state change (the reference's
+        # realm cache with its security-index invalidation)
+        self._auth_cache: Dict[Any, Dict[str, Any]] = {}
+
+    # -- state ------------------------------------------------------------
+
+    def _settings(self) -> Dict[str, Any]:
+        return dict(self.node._applied_state()
+                    .metadata.persistent_settings)
+
+    def enabled(self) -> bool:
+        v = self._settings().get("xpack.security.enabled", False)
+        return str(v).lower() in ("true", "1", "yes")
+
+    def _users(self) -> Dict[str, Any]:
+        return dict(self.node._applied_state()
+                    .metadata.security.get("users", {}))
+
+    def _roles(self) -> Dict[str, Any]:
+        stored = dict(self.node._applied_state()
+                      .metadata.security.get("roles", {}))
+        return {**BUILTIN_ROLES, **stored}
+
+    # -- authn ------------------------------------------------------------
+
+    def authenticate(self, headers: Dict[str, str]
+                     ) -> Optional[Dict[str, Any]]:
+        """The authenticated user record, or None for bad/missing creds."""
+        auth = headers.get("authorization", "")
+        if not auth.lower().startswith("basic "):
+            return None
+        try:
+            decoded = base64.b64decode(auth.split(None, 1)[1]).decode("utf-8")
+            username, _, password = decoded.partition(":")
+        except Exception:  # noqa: BLE001 — malformed header = unauthenticated
+            return None
+        user = self._users().get(username)
+        if user is None and username == "elastic":
+            boot = self._settings().get("xpack.security.bootstrap_password")
+            if boot is not None and password == str(boot):
+                return {"username": "elastic", "roles": ["superuser"]}
+            return None
+        if user is None:
+            return None
+        cache_key = (username,
+                     hashlib.sha256(password.encode("utf-8")).hexdigest(),
+                     self.node._applied_state().metadata.version)
+        hit = self._auth_cache.get(cache_key)
+        if hit is not None:
+            return dict(hit)
+        if not verify_password(password, user):
+            return None
+        record = {"username": username,
+                  "roles": list(user.get("roles", []))}
+        if len(self._auth_cache) >= self.AUTH_CACHE_CAP:
+            self._auth_cache.clear()
+        self._auth_cache[cache_key] = record
+        return dict(record)
+
+    # -- authz ------------------------------------------------------------
+
+    def _resolve_targets(self, expression: str) -> List[str]:
+        """The CONCRETE indices a request expression reaches — commas
+        split, wildcards and aliases expand — so authorization judges what
+        the request actually touches, never the raw string (a grant on
+        'logs-*' must not fnmatch-authorize 'logs-1,secrets')."""
+        if expression == "*":
+            return ["*"]   # body-level APIs: demand the catch-all grant
+        from elasticsearch_tpu.cluster.metadata import (
+            resolve_index_expression,
+        )
+        metadata = self.node._applied_state().metadata
+        try:
+            resolved = resolve_index_expression(expression, metadata)
+        except Exception:  # noqa: BLE001 — unknown names authz as literal
+            resolved = [p.strip() for p in expression.split(",") if p.strip()]
+        return resolved or [expression]
+
+    def authorize(self, user: Dict[str, Any], method: str,
+                  path: str) -> bool:
+        scope, privilege, index = required_privilege(method, path)
+        if scope == "authenticated":
+            return True
+        roles = [r for name in user.get("roles", [])
+                 if (r := self._roles().get(name)) is not None]
+        if any("all" in set(r.get("cluster", [])) for r in roles):
+            return True
+        if scope == "cluster":
+            for role in roles:
+                cluster = set(role.get("cluster", []))
+                if privilege in cluster or \
+                        (privilege == "monitor" and "manage" in cluster):
+                    return True
+            return False
+        # index scope: EVERY concrete index the expression reaches must be
+        # covered by some grant
+        for target in self._resolve_targets(index or "*"):
+            ok = False
+            for role in roles:
+                for grant in role.get("indices", []):
+                    names = grant.get("names", [])
+                    if isinstance(names, str):
+                        names = [names]
+                    privs = set(grant.get("privileges", []))
+                    if target == "*":
+                        if "*" not in names:
+                            continue
+                    elif not any(fnmatch.fnmatch(target, p)
+                                 for p in names):
+                        continue
+                    if "all" in privs or privilege in privs or \
+                            (privilege == "monitor" and
+                             privs & {"manage", "read"}):
+                        ok = True
+                        break
+                if ok:
+                    break
+            if not ok:
+                return False
+        return True
+
+    # -- the REST filter ----------------------------------------------------
+
+    def check(self, request) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """None = allowed; else (status, error body). SecurityRestFilter
+        analog, invoked before dispatch."""
+        if not self.enabled():
+            return None
+        user = self.authenticate(request.headers or {})
+        if user is None:
+            return 401, {"error": {
+                "type": "security_exception",
+                "reason": "missing or invalid credentials",
+                "header": {"WWW-Authenticate": 'Basic realm="security"'}},
+                "status": 401}
+        if not self.authorize(user, request.method, request.path):
+            return 403, {"error": {
+                "type": "security_exception",
+                "reason": f"action [{request.method} {request.path}] is "
+                          f"unauthorized for user [{user['username']}]"},
+                "status": 403}
+        request.params["_authenticated_user"] = user["username"]
+        return None

@@ -1,0 +1,211 @@
+"""Security: basic-auth realm + role-based authorization.
+
+Reference: x-pack/plugin/security/ (native realm, RoleDescriptor,
+SecurityRestFilter). Enforcement wraps REST dispatch; users/roles
+replicate through cluster-state metadata.
+"""
+
+import base64
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.xpack.security import (
+    hash_password, required_privilege, verify_password,
+)
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+def test_password_hashing_roundtrip():
+    entry = hash_password("s3cret")
+    assert verify_password("s3cret", entry)
+    assert not verify_password("wrong", entry)
+    # unique salt per hash
+    assert hash_password("s3cret")["hash"] != entry["hash"]
+
+
+def test_route_privilege_classification():
+    assert required_privilege("POST", "/logs/_search") == \
+        ("index", "read", "logs")
+    assert required_privilege("PUT", "/logs/_doc/1") == \
+        ("index", "write", "logs")
+    assert required_privilege("PUT", "/logs") == \
+        ("index", "create_index", "logs")
+    assert required_privilege("DELETE", "/logs") == \
+        ("index", "delete_index", "logs")
+    assert required_privilege("PUT", "/logs/_settings") == \
+        ("index", "manage", "logs")
+    assert required_privilege("GET", "/_cluster/health") == \
+        ("cluster", "monitor", None)
+    assert required_privilege("PUT", "/_cluster/settings") == \
+        ("cluster", "manage", None)
+    assert required_privilege("PUT", "/_security/user/bob") == \
+        ("cluster", "manage_security", None)
+    assert required_privilege("POST", "/_bulk") == ("index", "write", "*")
+    # _all is an index EXPRESSION, never a cluster endpoint
+    assert required_privilege("GET", "/_all/_search") == \
+        ("index", "read", "*")
+    assert required_privilege("GET", "/_security/_authenticate") == \
+        ("authenticated", "", None)
+
+
+def test_authorize_role_grants():
+    c = InProcessCluster(n_nodes=1, seed=23)
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.put_security_role("reader", {
+            "cluster": ["monitor"],
+            "indices": [{"names": ["logs-*"], "privileges": ["read"]}]}, cb))
+        assert e is None, e
+        r, e = c.call(lambda cb: client.put_security_user("bob", {
+            "password": "bobpass", "roles": ["reader"]}, cb))
+        assert e is None, e
+
+        sec = c.master().security
+        auth = {"authorization": "Basic " + base64.b64encode(
+            b"bob:bobpass").decode()}
+        user = sec.authenticate(auth)
+        assert user == {"username": "bob", "roles": ["reader"]}
+        assert sec.authenticate({"authorization": "Basic " +
+                                 base64.b64encode(b"bob:nope").decode()}) \
+            is None
+        assert sec.authorize(user, "GET", "/logs-2026/_search")
+        assert sec.authorize(user, "GET", "/_cluster/health")
+        assert not sec.authorize(user, "PUT", "/logs-2026/_doc/1")
+        assert not sec.authorize(user, "GET", "/secrets/_search")
+        assert not sec.authorize(user, "PUT", "/_security/user/eve")
+
+        # API responses never leak hashes
+        users = client.get_security_entities("users")
+        assert "hash" not in users["bob"] and "salt" not in users["bob"]
+
+        # wildcard-grant cannot be tricked by comma lists or _all: create
+        # a granted and an ungranted index; any expression reaching the
+        # ungranted one is denied
+        for idx in ("logs-1", "secrets"):
+            r, e = c.call(lambda cb, idx=idx: client.create_index(idx, {
+                "settings": {"number_of_replicas": 0}}, cb))
+            assert e is None, e
+        assert sec.authorize(user, "GET", "/logs-1/_search")
+        assert not sec.authorize(user, "GET", "/logs-1,secrets/_search")
+        assert not sec.authorize(user, "GET", "/_all/_search")
+        assert not sec.authorize(user, "GET", "/*/_search")
+
+        # malformed role/user bodies are rejected at the API
+        r, e = c.call(lambda cb: client.put_security_role(
+            "bad", {"cluster": ["monitr"]}, cb))
+        assert e is not None
+        r, e = c.call(lambda cb: client.put_security_user(
+            "prehashed", {"hash": "deadbeef"}, cb))
+        assert e is not None
+
+        # state/settings APIs redact credentials
+        from elasticsearch_tpu.xpack.security import (
+            redact_settings, redact_state,
+        )
+        state = redact_state(client.cluster_state())
+        stored = state["metadata"]["security"]["users"]["bob"]
+        assert "hash" not in stored and "salt" not in stored
+        masked = redact_settings(
+            {"xpack.security.bootstrap_password": "pw", "a.b": 1})
+        assert masked["xpack.security.bootstrap_password"] \
+            == "::es_redacted::"
+        assert masked["a.b"] == 1
+    finally:
+        c.stop()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _req(port, method, path, body=None, user=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    headers = {"content-type": "application/json"}
+    if user:
+        headers["Authorization"] = "Basic " + base64.b64encode(
+            user.encode()).decode()
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}", data=data,
+                               method=method, headers=headers)
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_http_auth_end_to_end(tmp_path):
+    port = _free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "elasticsearch_tpu.rest.server", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                _req(port, "GET", "/_cluster/health")
+                break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+
+        # anonymous works while security is off; then flip it on with a
+        # bootstrap password in the same call
+        _req(port, "PUT", "/_cluster/settings", {"persistent": {
+            "xpack.security.enabled": True,
+            "xpack.security.bootstrap_password": "bootpw"}})
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req(port, "GET", "/_cluster/health")
+        assert e.value.code == 401
+
+        status, body = _req(port, "GET", "/_security/_authenticate",
+                            user="elastic:bootpw")
+        assert body["username"] == "elastic"
+
+        # elastic creates a limited user; the user can read but not write
+        _req(port, "PUT", "/_security/role/logread", {
+            "indices": [{"names": ["logs*"], "privileges": ["read"]}]},
+            user="elastic:bootpw")
+        _req(port, "PUT", "/_security/user/amy", {
+            "password": "amypw", "roles": ["logread"]},
+            user="elastic:bootpw")
+        _req(port, "PUT", "/logs", {"settings": {
+            "number_of_replicas": 0}}, user="elastic:bootpw")
+        _req(port, "PUT", "/logs/_doc/1", {"body": "hello"},
+             user="elastic:bootpw")
+        _req(port, "POST", "/logs/_refresh", None, user="elastic:bootpw")
+
+        # a non-admin user can ask who it is (no privileges required)
+        status, body = _req(port, "GET", "/_security/_authenticate",
+                            user="amy:amypw")
+        assert body == {"username": "amy", "roles": ["logread"]}
+
+        status, body = _req(port, "POST", "/logs/_search",
+                            {"query": {"match_all": {}}}, user="amy:amypw")
+        assert body["hits"]["total"]["value"] == 1
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req(port, "PUT", "/logs/_doc/2", {"body": "nope"},
+                 user="amy:amypw")
+        assert e.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _req(port, "POST", "/logs/_search", {}, user="amy:wrongpw")
+        assert e.value.code == 401
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
