@@ -157,6 +157,12 @@ class Node:
         from elasticsearch_tpu.xpack.security import SecurityService
         self.security = SecurityService(self)
 
+        from elasticsearch_tpu.xpack.async_search import AsyncSearchService
+        self.async_search = AsyncSearchService(self)
+
+        from elasticsearch_tpu.xpack.sql import SqlService
+        self.sql = SqlService(self)
+
     # ------------------------------------------------------------------
 
     def _applied_state(self) -> ClusterState:

@@ -377,6 +377,40 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/_security/role", role_get)
     r("GET", "/_security/role/{name}", role_get)
 
+    # -- async search (x-pack/plugin/async-search REST surface) -----------
+
+    def async_submit(req: RestRequest, done: DoneFn) -> None:
+        client.node.async_search.submit(
+            req.params["index"], req.body or {}, wrap_client_cb(done),
+            wait_for_completion=req.query.get(
+                "wait_for_completion_timeout"),
+            keep_alive=req.query.get("keep_alive"),
+            owner=req.params.get("_authenticated_user"))
+    r("POST", "/{index}/_async_search", async_submit)
+
+    def async_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.node.async_search.get(
+            req.params["id"], owner=req.params.get("_authenticated_user")))
+    r("GET", "/_async_search/{id}", async_get)
+
+    def async_delete(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.node.async_search.delete(
+            req.params["id"], owner=req.params.get("_authenticated_user")))
+    r("DELETE", "/_async_search/{id}", async_delete)
+
+    # -- SQL (x-pack/plugin/sql REST surface) -----------------------------
+
+    def sql_query(req: RestRequest, done: DoneFn) -> None:
+        client.node.sql.query((req.body or {}).get("query", ""),
+                              wrap_client_cb(done))
+    r("POST", "/_sql", sql_query)
+    r("GET", "/_sql", sql_query)
+
+    def sql_translate(req: RestRequest, done: DoneFn) -> None:
+        from elasticsearch_tpu.xpack.sql import parse_sql, translate
+        done(200, translate(parse_sql((req.body or {}).get("query", ""))))
+    r("POST", "/_sql/translate", sql_translate)
+
     def authenticate(req: RestRequest, done: DoneFn) -> None:
         user = client.node.security.authenticate(req.headers or {})
         if user is None:

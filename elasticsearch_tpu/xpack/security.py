@@ -61,7 +61,7 @@ def verify_password(password: str, entry: Dict[str, Any]) -> bool:
 READ_ENDPOINTS = {"_search", "_count", "_doc", "_source", "_mget",
                   "_termvectors", "_explain", "_msearch", "_rank_eval",
                   "_search_template", "_scripts", "_analyze",
-                  "_field_caps", "_validate"}
+                  "_field_caps", "_validate", "_async_search"}
 WRITE_ENDPOINTS = {"_doc", "_create", "_update", "_bulk", "_delete_by_query",
                    "_update_by_query", "_reindex", "_rollover"}
 MANAGE_ENDPOINTS = {"_settings", "_mapping", "_mappings", "_aliases",
@@ -82,6 +82,14 @@ def required_privilege(method: str, path: str
             # any authenticated principal may ask who it is (the
             # reference's _authenticate requires no privileges)
             return ("authenticated", "", None)
+        if first == "_async_search":
+            # get/delete by id: authentication plus the service's own
+            # per-owner check (ids carry stored search RESULTS)
+            return ("authenticated", "", None)
+        if first == "_sql":
+            # index-read against the FROM target, resolved from the body
+            # by SecurityService.check (the path alone names no index)
+            return ("index", "read", "_sql_body")
         if first == "_security":
             return ("cluster", "manage_security", None)
         if first in ("_bulk", "_reindex", "_mget", "_msearch", "_search"):
@@ -283,6 +291,20 @@ class SecurityService:
 
     # -- the REST filter ----------------------------------------------------
 
+    def _authorize_request(self, user: Dict[str, Any], request) -> bool:
+        scope, privilege, index = required_privilege(
+            request.method, request.path)
+        if index == "_sql_body":
+            # /_sql: the target index lives in the SQL text, not the path
+            from elasticsearch_tpu.xpack.sql import parse_sql
+            try:
+                target = parse_sql(
+                    (request.body or {}).get("query", ""))["index"]
+            except Exception:  # noqa: BLE001 — parse errors 400 later
+                return True
+            return self.authorize(user, "GET", f"/{target}/_search")
+        return self.authorize(user, request.method, request.path)
+
     def check(self, request) -> Optional[Tuple[int, Dict[str, Any]]]:
         """None = allowed; else (status, error body). SecurityRestFilter
         analog, invoked before dispatch."""
@@ -295,7 +317,7 @@ class SecurityService:
                 "reason": "missing or invalid credentials",
                 "header": {"WWW-Authenticate": 'Basic realm="security"'}},
                 "status": 401}
-        if not self.authorize(user, request.method, request.path):
+        if not self._authorize_request(user, request):
             return 403, {"error": {
                 "type": "security_exception",
                 "reason": f"action [{request.method} {request.path}] is "

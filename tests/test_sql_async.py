@@ -1,0 +1,190 @@
+"""SQL subset + async search.
+
+Reference: x-pack/plugin/sql (parser -> QueryContainer -> search),
+x-pack/plugin/async-search (submit/poll/delete with keep-alive expiry).
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError, ResourceNotFoundError,
+)
+from elasticsearch_tpu.xpack.sql import parse_sql, translate
+
+
+def test_sql_translate_where_clauses():
+    body = translate(parse_sql(
+        "SELECT name, price FROM products WHERE price >= 10 AND "
+        "(brand = 'acme' OR brand = 'zorro') AND name LIKE 'sh%' "
+        "ORDER BY price DESC LIMIT 5"))
+    assert body["size"] == 5
+    assert body["sort"] == [{"price": "desc"}]
+    assert body["_source"] == ["name", "price"]
+    must = body["query"]["bool"]["must"]
+    assert {"range": {"price": {"gte": 10}}} in \
+        [must[0]["bool"]["must"][0]] + must
+    flat = str(body["query"])
+    assert "wildcard" in flat and "sh*" in flat
+
+
+def test_sql_parse_errors():
+    with pytest.raises(IllegalArgumentError):
+        parse_sql("SELECT FROM x")
+    with pytest.raises(IllegalArgumentError):
+        parse_sql("SELECT a FROM x HAVING b > 1")
+    with pytest.raises(IllegalArgumentError):
+        parse_sql("SELECT a FROM x WHERE a ~ 3")
+    # mixing aggregates and plain columns without GROUP BY
+    with pytest.raises(IllegalArgumentError):
+        translate(parse_sql("SELECT a, COUNT(*) FROM x"))
+    # ORDER BY validated before execution for grouped queries
+    with pytest.raises(IllegalArgumentError):
+        translate(parse_sql(
+            "SELECT a, COUNT(*) AS n FROM x GROUP BY a ORDER BY nope"))
+
+
+def test_sql_like_escapes_literal_metachars():
+    body = translate(parse_sql("SELECT a FROM x WHERE a LIKE '10*_%'"))
+    assert body["query"]["wildcard"]["a"]["value"] == "10[*]?*"
+
+
+def test_sql_count_col_uses_value_count():
+    body = translate(parse_sql(
+        "SELECT b, COUNT(s) AS c FROM x GROUP BY b"))
+    assert body["aggs"]["groups"]["aggs"]["c"] == \
+        {"value_count": {"field": "s"}}
+
+
+def test_sql_security_classification():
+    from elasticsearch_tpu.xpack.security import required_privilege
+    assert required_privilege("POST", "/_sql") == \
+        ("index", "read", "_sql_body")
+    assert required_privilege("POST", "/logs/_async_search") == \
+        ("index", "read", "logs")
+    assert required_privilege("GET", "/_async_search/abc") == \
+        ("authenticated", "", None)
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=1, seed=29)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+@pytest.fixture()
+def products(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("products", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "name": {"type": "keyword"}, "brand": {"type": "keyword"},
+            "price": {"type": "integer"},
+            "stock": {"type": "integer"}}}}, cb)))
+    cluster.ensure_green("products")
+    rows = [("shoe-a", "acme", 10, 5), ("shoe-b", "acme", 30, 0),
+            ("boot-c", "zorro", 20, 2), ("boot-d", "zorro", 40, 9),
+            ("sock-e", "acme", 5, 100)]
+    for name, brand, price, stock in rows:
+        _ok(*cluster.call(lambda cb, d=(name, brand, price, stock):
+            client.index_doc("products", d[0], {
+                "name": d[0], "brand": d[1], "price": d[2],
+                "stock": d[3]}, cb)))
+    cluster.call(lambda cb: client.refresh("products", cb))
+    return cluster
+
+
+def test_sql_select_where_order_limit(products):
+    cluster = products
+    res = _ok(*cluster.call(lambda cb: cluster.master().sql.query(
+        "SELECT name, price FROM products WHERE price > 5 "
+        "ORDER BY price DESC LIMIT 3", cb)))
+    assert [c["name"] for c in res["columns"]] == ["name", "price"]
+    assert res["rows"] == [["boot-d", 40], ["shoe-b", 30], ["boot-c", 20]]
+
+
+def test_sql_like_in_between(products):
+    cluster = products
+    res = _ok(*cluster.call(lambda cb: cluster.master().sql.query(
+        "SELECT name FROM products WHERE name LIKE 'shoe%' "
+        "AND price BETWEEN 5 AND 30 ORDER BY name", cb)))
+    assert [r[0] for r in res["rows"]] == ["shoe-a", "shoe-b"]
+    res = _ok(*cluster.call(lambda cb: cluster.master().sql.query(
+        "SELECT name FROM products WHERE brand IN ('zorro') "
+        "ORDER BY name", cb)))
+    assert [r[0] for r in res["rows"]] == ["boot-c", "boot-d"]
+
+
+def test_sql_group_by_aggregates(products):
+    cluster = products
+    res = _ok(*cluster.call(lambda cb: cluster.master().sql.query(
+        "SELECT brand, COUNT(*) AS n, SUM(price) AS total, "
+        "MAX(price) AS top FROM products GROUP BY brand "
+        "ORDER BY total DESC", cb)))
+    assert [c["name"] for c in res["columns"]] == \
+        ["brand", "n", "total", "top"]
+    assert res["rows"] == [["zorro", 2, 60.0, 40.0],
+                           ["acme", 3, 45.0, 30.0]]
+
+
+def test_sql_implicit_global_aggregates(products):
+    cluster = products
+    res = _ok(*cluster.call(lambda cb: cluster.master().sql.query(
+        "SELECT COUNT(*) AS n, MAX(price) AS top, AVG(price) AS avgp "
+        "FROM products WHERE brand = 'acme'", cb)))
+    assert res["rows"] == [[3, 30.0, 15.0]]
+
+
+def test_async_search_ownership(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("own", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0}}, cb)))
+    cluster.ensure_green("own")
+    node = cluster.master()
+    res = _ok(*cluster.call(lambda cb: node.async_search.submit(
+        "own", {"query": {"match_all": {}}}, cb, owner="amy")))
+    assert node.async_search.get(res["id"], owner="amy")
+    with pytest.raises(ResourceNotFoundError):
+        node.async_search.get(res["id"], owner="bob")
+    with pytest.raises(ResourceNotFoundError):
+        node.async_search.delete(res["id"], owner=None)
+
+
+def test_async_search_lifecycle(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("a", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0}}, cb)))
+    cluster.ensure_green("a")
+    for i in range(6):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "a", f"d{i}", {"n": i}, cb)))
+    cluster.call(lambda cb: client.refresh("a", cb))
+
+    node = cluster.master()
+    # fast path: completes within the wait window
+    res = _ok(*cluster.call(lambda cb: node.async_search.submit(
+        "a", {"query": {"match_all": {}}}, cb)))
+    assert res["is_running"] is False and res["is_partial"] is False
+    assert res["response"]["hits"]["total"]["value"] == 6
+
+    # polling path: id remains fetchable until deleted
+    sid = res["id"]
+    got = node.async_search.get(sid)
+    assert got["response"]["hits"]["total"]["value"] == 6
+    assert node.async_search.delete(sid) == {"acknowledged": True}
+    with pytest.raises(ResourceNotFoundError):
+        node.async_search.get(sid)
+
+    # keep-alive expiry reaps entries
+    res = _ok(*cluster.call(lambda cb: node.async_search.submit(
+        "a", {"query": {"match_all": {}}}, cb, keep_alive="1s")))
+    cluster.scheduler.run_for(5.0)
+    with pytest.raises(ResourceNotFoundError):
+        node.async_search.get(res["id"])
