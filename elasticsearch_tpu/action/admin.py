@@ -45,6 +45,9 @@ DELETE_ILM_POLICY = "cluster:admin/ilm/delete"
 ROLLOVER = "indices:admin/rollover"
 PUT_SECURITY = "cluster:admin/xpack/security/put"
 DELETE_SECURITY = "cluster:admin/xpack/security/delete"
+PUT_CUSTOM = "cluster:admin/xpack/custom/put"
+DELETE_CUSTOM = "cluster:admin/xpack/custom/delete"
+REROUTE = "cluster:admin/reroute"
 REFRESH_SHARD = "indices:admin/refresh[s]"
 FLUSH_SHARD = "indices:admin/flush[s]"
 FORCEMERGE_SHARD = "indices:admin/forcemerge[s]"
@@ -103,6 +106,9 @@ class MasterActions:
             (ROLLOVER, self._on_rollover),
             (PUT_SECURITY, self._on_put_security),
             (DELETE_SECURITY, self._on_delete_security),
+            (PUT_CUSTOM, self._on_put_custom),
+            (DELETE_CUSTOM, self._on_delete_custom),
+            (REROUTE, self._on_reroute),
             (SHARD_STARTED, self._on_shard_started),
             (SHARD_FAILED, self._on_shard_failed),
         ]:
@@ -377,6 +383,35 @@ class MasterActions:
                     kind, name, None))
         return self._submit(f"delete-security-{kind} [{name}]", update)
 
+    # -- custom metadata sections (Metadata.Custom CRUD: transforms,
+    # watches, ...) ------------------------------------------------------
+
+    def _on_put_custom(self, req: Dict[str, Any], sender: str) -> Deferred:
+        section, name = req["section"], req["name"]
+        body = dict(req.get("body") or {})
+
+        def update(state: ClusterState) -> ClusterState:
+            return state.next_version(
+                metadata=state.metadata.with_custom_entry(
+                    section, name, body))
+        return self._submit(f"put-{section} [{name}]", update)
+
+    def _on_delete_custom(self, req: Dict[str, Any],
+                          sender: str) -> Deferred:
+        section, name = req["section"], req["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            if name not in state.metadata.custom.get(section, {}):
+                from elasticsearch_tpu.utils.errors import (
+                    ResourceNotFoundError,
+                )
+                raise ResourceNotFoundError(
+                    f"{section} entry [{name}] not found")
+            return state.next_version(
+                metadata=state.metadata.with_custom_entry(
+                    section, name, None))
+        return self._submit(f"delete-{section} [{name}]", update)
+
     # -- rollover (TransportRolloverAction's atomic state half) ----------
 
     def _on_rollover(self, req: Dict[str, Any], sender: str) -> Deferred:
@@ -431,6 +466,91 @@ class MasterActions:
         self.coordinator.submit_state_update(
             f"rollover [{alias}]", update, done)
         return deferred
+
+    # -- reroute (TransportClusterRerouteAction analog) ------------------
+
+    def _on_reroute(self, req: Dict[str, Any], sender: str) -> Deferred:
+        """Explicit shard-movement commands + a reallocation pass. With no
+        commands this is the bare "kick the allocator" call."""
+        commands = req.get("commands") or []
+
+        def update(state: ClusterState) -> ClusterState:
+            routing = state.routing_table
+            for command in commands:
+                try:
+                    (kind, spec), = command.items()
+                    index, sid = spec["index"], int(spec["shard"])
+                except (ValueError, KeyError, TypeError) as e:
+                    # malformed client input is a 400, not a 500
+                    raise IllegalArgumentError(
+                        f"malformed reroute command {command!r}: {e}")
+                irt = routing.index(index)
+                group = irt.shard_group(sid)
+                if kind == "cancel":
+                    node = spec["node"]
+                    target = next((sr for sr in group
+                                   if sr.node_id == node), None)
+                    if target is None:
+                        raise IllegalArgumentError(
+                            f"no copy of [{index}][{sid}] on [{node}]")
+                    if target.primary and not spec.get("allow_primary"):
+                        raise IllegalArgumentError(
+                            "cancelling a primary requires "
+                            "[allow_primary: true]")
+                    state = self.allocation.apply_failed_shard(state, target)
+                    routing = state.routing_table
+                elif kind == "move":
+                    try:
+                        from_node, to_node = \
+                            spec["from_node"], spec["to_node"]
+                    except KeyError as e:
+                        raise IllegalArgumentError(
+                            f"move requires [from_node]/[to_node]: {e}")
+                    target = next((sr for sr in group
+                                   if sr.node_id == from_node), None)
+                    if target is None:
+                        raise IllegalArgumentError(
+                            f"no copy of [{index}][{sid}] on [{from_node}]")
+                    if target.primary:
+                        raise IllegalArgumentError(
+                            "moving a primary is not supported; cancel a "
+                            "replica or use replica count changes")
+                    if to_node not in state.nodes:
+                        raise IllegalArgumentError(
+                            f"unknown node [{to_node}]")
+                    # explicit commands must uphold the SameShardDecider
+                    # invariant the allocator enforces everywhere else
+                    if any(sr.node_id == to_node for sr in group):
+                        raise IllegalArgumentError(
+                            f"node [{to_node}] already holds a copy of "
+                            f"[{index}][{sid}]")
+                    moved = target.fail().initialize(to_node)
+                    routing = routing.put_index(
+                        irt.replace_shard(target, moved))
+                    state = state.next_version(routing_table=routing)
+                elif kind == "allocate_replica":
+                    node = spec.get("node")
+                    if node not in state.nodes:
+                        raise IllegalArgumentError(
+                            f"unknown node [{node}]")
+                    if any(sr.node_id == node for sr in group):
+                        raise IllegalArgumentError(
+                            f"node [{node}] already holds a copy of "
+                            f"[{index}][{sid}]")
+                    target = next(
+                        (sr for sr in group
+                         if not sr.primary and not sr.assigned), None)
+                    if target is None:
+                        raise IllegalArgumentError(
+                            f"no unassigned replica of [{index}][{sid}]")
+                    routing = routing.put_index(
+                        irt.replace_shard(target, target.initialize(node)))
+                    state = state.next_version(routing_table=routing)
+                else:
+                    raise IllegalArgumentError(
+                        f"unknown reroute command [{kind}]")
+            return self.allocation.reroute(state)
+        return self._submit("cluster-reroute", update)
 
     # -- shard state ----------------------------------------------------
 

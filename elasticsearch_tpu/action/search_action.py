@@ -44,6 +44,11 @@ CONTEXT_KEEP_ALIVE = 60.0
 DoneFn = Callable[[Optional[Dict[str, Any]], Optional[Exception]], None]
 
 
+import logging
+
+_slowlog = logging.getLogger("index.search.slowlog")
+
+
 class SearchTransportService:
     """Data-node side: executes the per-shard search phases."""
 
@@ -122,7 +127,29 @@ class SearchTransportService:
                 _json.dumps(req.get("df_overrides"), sort_keys=True),
                 req.get("doc_count_override"))
 
+    def _slow_log(self, req: Dict[str, Any], took_s: float) -> None:
+        """Per-index search slow log (index/SearchSlowLog.java:43 analog):
+        thresholds come from dynamic index settings."""
+        try:
+            settings = self.indices.index_service(
+                req["index"]).metadata.settings
+        except Exception:  # noqa: BLE001 — logging must never fail a query
+            return
+        from elasticsearch_tpu.utils.settings import parse_time_to_seconds
+        for level in ("warn", "info"):
+            raw = settings.get(
+                f"index.search.slowlog.threshold.query.{level}")
+            if raw is None:
+                continue
+            if took_s >= parse_time_to_seconds(raw):
+                getattr(_slowlog, "warning" if level == "warn" else "info")(
+                    "[%s][%s] took[%.1fms], source[%s]",
+                    req["index"], req["shard"], took_s * 1e3,
+                    str(req.get("body", {}))[:512])
+                return
+
     def _on_query(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
+        t_query = time.monotonic()
         self._reap()
         shard = self.indices.shard(req["index"], req["shard"])
         body = req.get("body", {})
@@ -206,6 +233,7 @@ class SearchTransportService:
             while len(self._request_cache) >= self.REQUEST_CACHE_CAP:
                 self._request_cache.popitem(last=False)
             self._request_cache[cache_key] = response
+        self._slow_log(req, time.monotonic() - t_query)
         return response
 
     def _on_fetch(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:

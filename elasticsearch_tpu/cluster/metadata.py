@@ -112,6 +112,9 @@ class Metadata:
     # security entities: {"users": {name: {hash, salt, roles}},
     # "roles": {name: {cluster, indices}}} — the .security index analog
     security: Mapping[str, Any] = field(default_factory=dict)
+    # named custom sections (Metadata.Custom analog): transforms, watches,
+    # ... — each a {name: body} map owned by one service
+    custom: Mapping[str, Any] = field(default_factory=dict)
     persistent_settings: Mapping[str, Any] = field(default_factory=dict)
     version: int = 0
 
@@ -180,6 +183,16 @@ class Metadata:
         return replace(self, security={**self.security, kind: section},
                        version=self.version + 1)
 
+    def with_custom_entry(self, section: str, name: str,
+                          body: Optional[Mapping[str, Any]]) -> "Metadata":
+        """Put (or with None, delete) one entry of a custom section."""
+        entries = {k: v for k, v in
+                   dict(self.custom.get(section, {})).items() if k != name}
+        if body is not None:
+            entries[name] = dict(body)
+        return replace(self, custom={**self.custom, section: entries},
+                       version=self.version + 1)
+
     def with_persistent_settings(self, settings: Mapping[str, Any]) -> "Metadata":
         # a None value unsets the key (the reference's null-reset semantics
         # for PUT _cluster/settings)
@@ -205,6 +218,7 @@ class Metadata:
                 "templates": dict(self.templates),
                 "ilm_policies": dict(self.ilm_policies),
                 "security": dict(self.security),
+                "custom": dict(self.custom),
                 "persistent_settings": dict(self.persistent_settings),
                 "version": self.version}
 
@@ -216,6 +230,7 @@ class Metadata:
             templates=dict(d.get("templates", {})),
             ilm_policies=dict(d.get("ilm_policies", {})),
             security=dict(d.get("security", {})),
+            custom=dict(d.get("custom", {})),
             persistent_settings=dict(d.get("persistent_settings", {})),
             version=d.get("version", 0))
 

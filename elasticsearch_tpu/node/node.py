@@ -163,6 +163,12 @@ class Node:
         from elasticsearch_tpu.xpack.sql import SqlService
         self.sql = SqlService(self)
 
+        from elasticsearch_tpu.xpack.transform import TransformService
+        self.transform_service = TransformService(self)
+
+        from elasticsearch_tpu.xpack.watcher import WatcherService
+        self.watcher_service = WatcherService(self)
+
     # ------------------------------------------------------------------
 
     def _applied_state(self) -> ClusterState:
@@ -225,8 +231,12 @@ class Node:
     def start(self) -> None:
         self.coordinator.start()
         self.ilm_service.start()
+        self.transform_service.start()
+        self.watcher_service.start()
 
     def stop(self) -> None:
+        self.watcher_service.stop()
+        self.transform_service.stop()
         self.ilm_service.stop()
         self.coordinator.stop()
         self.transport_service.close()

@@ -377,6 +377,72 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/_security/role", role_get)
     r("GET", "/_security/role/{name}", role_get)
 
+    # -- transforms (x-pack/plugin/transform REST surface) ----------------
+
+    def transform_put(req: RestRequest, done: DoneFn) -> None:
+        client.node.transform_service.put(
+            req.params["id"], req.body or {}, wrap_client_cb(done))
+    r("PUT", "/_transform/{id}", transform_put)
+
+    def transform_delete(req: RestRequest, done: DoneFn) -> None:
+        client.node.transform_service.delete(req.params["id"],
+                                             wrap_client_cb(done))
+    r("DELETE", "/_transform/{id}", transform_delete)
+
+    def transform_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.node.transform_service.get(req.params.get("id")))
+    r("GET", "/_transform", transform_get)
+    r("GET", "/_transform/{id}", transform_get)
+
+    def transform_start(req: RestRequest, done: DoneFn) -> None:
+        client.node.transform_service.set_started(
+            req.params["id"], True, wrap_client_cb(done))
+    r("POST", "/_transform/{id}/_start", transform_start)
+
+    def transform_stop(req: RestRequest, done: DoneFn) -> None:
+        client.node.transform_service.set_started(
+            req.params["id"], False, wrap_client_cb(done))
+    r("POST", "/_transform/{id}/_stop", transform_stop)
+
+    # -- watcher (x-pack/plugin/watcher REST surface) ---------------------
+
+    def watch_put(req: RestRequest, done: DoneFn) -> None:
+        client.node.watcher_service.put(req.params["id"], req.body or {},
+                                        wrap_client_cb(done))
+    r("PUT", "/_watcher/watch/{id}", watch_put)
+
+    def watch_delete(req: RestRequest, done: DoneFn) -> None:
+        client.node.watcher_service.delete(req.params["id"],
+                                           wrap_client_cb(done))
+    r("DELETE", "/_watcher/watch/{id}", watch_delete)
+
+    def watch_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.node.watcher_service.get(req.params["id"]))
+    r("GET", "/_watcher/watch/{id}", watch_get)
+
+    # -- observability: hot threads + explicit reroute --------------------
+
+    def hot_threads(req: RestRequest, done: DoneFn) -> None:
+        import sys
+        import threading
+        import traceback
+        lines = [f"::: {client.node.node_id}"]
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            lines.append(f"\n   {names.get(tid, '?')} (tid={tid}):")
+            lines.extend("     " + ln for entry in
+                         traceback.format_stack(frame)
+                         for ln in entry.rstrip().splitlines())
+        done(200, "\n".join(lines) + "\n")
+    r("GET", "/_nodes/hot_threads", hot_threads)
+
+    def reroute_post(req: RestRequest, done: DoneFn) -> None:
+        from elasticsearch_tpu.action.admin import REROUTE
+        client.node.master_client.execute(
+            REROUTE, {"commands": (req.body or {}).get("commands", [])},
+            wrap_client_cb(done))
+    r("POST", "/_cluster/reroute", reroute_post)
+
     # -- async search (x-pack/plugin/async-search REST surface) -----------
 
     def async_submit(req: RestRequest, done: DoneFn) -> None:

@@ -303,7 +303,43 @@ class SecurityService:
             except Exception:  # noqa: BLE001 — parse errors 400 later
                 return True
             return self.authorize(user, "GET", f"/{target}/_search")
-        return self.authorize(user, request.method, request.path)
+        allowed = self.authorize(user, request.method, request.path)
+        if allowed and request.method in ("PUT", "POST"):
+            # definitions that later run AS THE SYSTEM (transforms read
+            # source and write dest; watches read inputs and write action
+            # targets) are authorized against the registering user at PUT
+            # time, or cluster-manage would be an index-privilege
+            # escalation channel
+            allowed = self._authorize_body_indices(user, request)
+        return allowed
+
+    def _authorize_body_indices(self, user: Dict[str, Any],
+                                request) -> bool:
+        body = request.body or {}
+        path = request.path
+        reads: List[str] = []
+        writes: List[str] = []
+        if path.startswith("/_transform/"):
+            src = (body.get("source") or {}).get("index")
+            dst = (body.get("dest") or {}).get("index")
+            reads += [src] if src else []
+            writes += [dst] if dst else []
+        elif path.startswith("/_watcher/watch/"):
+            request_spec = ((body.get("input") or {}).get("search") or {}) \
+                .get("request") or {}
+            indices = request_spec.get("indices") or []
+            reads += indices if isinstance(indices, list) else [indices]
+            for action in (body.get("actions") or {}).values():
+                dest = (action.get("index") or {}).get("index")
+                if dest:
+                    writes.append(dest)
+        for target in reads:
+            if not self.authorize(user, "GET", f"/{target}/_search"):
+                return False
+        for target in writes:
+            if not self.authorize(user, "PUT", f"/{target}/_doc/x"):
+                return False
+        return True
 
     def check(self, request) -> Optional[Tuple[int, Dict[str, Any]]]:
         """None = allowed; else (status, error body). SecurityRestFilter

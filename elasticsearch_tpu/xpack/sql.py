@@ -342,7 +342,12 @@ def translate(plan: Dict[str, Any]) -> Dict[str, Any]:
     if cols:
         body["_source"] = cols
     if plan["order_by"]:
-        body["sort"] = [{c: d} for c, d in plan["order_by"]]
+        # ORDER BY a SELECT alias sorts the underlying field; anything
+        # else passes through as a document field name
+        aliases = {i["name"]: i["col"] for i in plan["select"]
+                   if i["kind"] == "col"}
+        body["sort"] = [{aliases.get(c, c): d}
+                        for c, d in plan["order_by"]]
     return body
 
 

@@ -1,0 +1,165 @@
+"""Transforms (pivot/rollup), watcher, reroute, slow log, hot threads.
+
+Reference: x-pack/plugin/transform, x-pack/plugin/watcher,
+TransportClusterRerouteAction, index/SearchSlowLog.java:43,
+monitor/jvm/HotThreads.java:41.
+"""
+
+import logging
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+from elasticsearch_tpu.xpack.watcher import evaluate_condition
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=2, seed=31)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def test_watch_condition_evaluation():
+    payload = {"hits": {"total": {"value": 7}}}
+    assert evaluate_condition(None, payload)
+    assert evaluate_condition({"always": {}}, payload)
+    assert not evaluate_condition({"never": {}}, payload)
+    assert evaluate_condition(
+        {"compare": {"ctx.payload.hits.total.value": {"gt": 5}}}, payload)
+    assert not evaluate_condition(
+        {"compare": {"ctx.payload.hits.total.value": {"gte": 8}}}, payload)
+    assert not evaluate_condition(
+        {"compare": {"ctx.payload.missing": {"eq": 1}}}, payload)
+    with pytest.raises(IllegalArgumentError):
+        evaluate_condition({"script": {}}, payload)
+
+
+def test_transform_pivot_writes_dest(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("orders", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "sku": {"type": "keyword"},
+            "amount": {"type": "integer"}}}}, cb)))
+    cluster.ensure_green("orders")
+    rows = [("a", 10), ("a", 20), ("b", 5), ("b", 7), ("c", 1)]
+    for i, (sku, amount) in enumerate(rows):
+        _ok(*cluster.call(lambda cb, i=i, s=sku, a=amount: client.index_doc(
+            "orders", f"o{i}", {"sku": s, "amount": a}, cb)))
+    cluster.call(lambda cb: client.refresh("orders", cb))
+
+    node = cluster.master()
+    _ok(*cluster.call(lambda cb: node.transform_service.put("totals", {
+        "source": {"index": "orders"},
+        "dest": {"index": "sku_totals"},
+        "pivot": {
+            "group_by": {"sku": {"terms": {"field": "sku"}}},
+            "aggregations": {"total": {"sum": {"field": "amount"}},
+                             "n": {"value_count": {"field": "amount"}}},
+        }}, cb)))
+    _ok(*cluster.call(lambda cb: node.transform_service.set_started(
+        "totals", True, cb)))
+    cluster.scheduler.run_for(10.0)
+    cluster.call(lambda cb: client.refresh("sku_totals", cb))
+    res = _ok(*cluster.call(lambda cb: client.search(
+        "sku_totals", {"query": {"match_all": {}},
+                       "sort": [{"sku": "asc"}], "size": 10}, cb)))
+    docs = [h["_source"] for h in res["hits"]["hits"]]
+    assert [(d["sku"], d["total"], d["n"], d["_transform_doc_count"])
+            for d in docs] == [("a", 30.0, 2.0, 2), ("b", 12.0, 2.0, 2),
+                               ("c", 1.0, 1.0, 1)]
+    got = node.transform_service.get("totals")
+    assert got["transforms"][0]["stats"]["documents_indexed"] == 3
+    # idempotent re-run: stable doc ids overwrite, not duplicate
+    node.transform_service.run_one(
+        "totals", got["transforms"][0], lambda r, e: None)
+    cluster.scheduler.run_for(5.0)
+    cluster.call(lambda cb: client.refresh("sku_totals", cb))
+    res = _ok(*cluster.call(lambda cb: client.search(
+        "sku_totals", {"query": {"match_all": {}}, "size": 10}, cb)))
+    assert res["hits"]["total"]["value"] == 3
+
+    resp, err = cluster.call(lambda cb: node.transform_service.put(
+        "bad", {"source": {}, "dest": {}}, cb))
+    assert isinstance(err, IllegalArgumentError)
+
+
+def test_watcher_fires_and_indexes_alert(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("logs", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {"level": {"type": "keyword"}}}}, cb)))
+    cluster.ensure_green("logs")
+    node = cluster.master()
+    _ok(*cluster.call(lambda cb: node.watcher_service.put("errs", {
+        "trigger": {"schedule": {"interval": "2s"}},
+        "input": {"search": {"request": {
+            "indices": ["logs"],
+            "body": {"query": {"term": {"level": "error"}},
+                     "size": 0}}}},
+        "condition": {"compare": {
+            "ctx.payload.hits.total.value": {"gt": 0}}},
+        "actions": {"store": {"index": {"index": "alerts"}}},
+    }, cb)))
+
+    # no errors yet: watch checks but never fires
+    cluster.scheduler.run_for(6.0)
+    status = node.watcher_service.get("errs")["status"]
+    assert status["executions"] >= 1 and status["fired"] == 0
+
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "logs", "e1", {"level": "error"}, cb)))
+    cluster.call(lambda cb: client.refresh("logs", cb))
+    cluster.scheduler.run_for(6.0)
+    status = node.watcher_service.get("errs")["status"]
+    assert status["fired"] >= 1
+    cluster.call(lambda cb: client.refresh("alerts", cb))
+    res = _ok(*cluster.call(lambda cb: client.search(
+        "alerts", {"query": {"match_all": {}}}, cb)))
+    assert res["hits"]["total"]["value"] >= 1
+    assert res["hits"]["hits"][0]["_source"]["watch_id"] == "errs"
+
+
+def test_slow_log_emits_on_threshold(cluster, caplog):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("slow", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0,
+                     "index.search.slowlog.threshold.query.warn": "0ms"},
+    }, cb)))
+    cluster.ensure_green("slow")
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "slow", "d1", {"x": 1}, cb)))
+    cluster.call(lambda cb: client.refresh("slow", cb))
+    with caplog.at_level(logging.WARNING, logger="index.search.slowlog"):
+        _ok(*cluster.call(lambda cb: client.search(
+            "slow", {"query": {"match_all": {}}}, cb)))
+    assert any("[slow][0]" in r.getMessage()
+               for r in caplog.records), caplog.records
+
+
+def test_reroute_cancel_replica_and_bare_kick(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("rr", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1}}, cb)))
+    cluster.ensure_green("rr")
+    from elasticsearch_tpu.action.admin import REROUTE
+    node = cluster.master()
+    state = node._applied_state()
+    replica = next(sr for sr in state.routing_table.index("rr")
+                   .shard_group(0) if not sr.primary)
+    _ok(*cluster.call(lambda cb: node.master_client.execute(REROUTE, {
+        "commands": [{"cancel": {"index": "rr", "shard": 0,
+                                 "node": replica.node_id}}]}, cb)))
+    # allocator reassigns; cluster converges back to green
+    cluster.ensure_green("rr")
+    # bare reroute (no commands) acknowledges
+    _ok(*cluster.call(lambda cb: node.master_client.execute(
+        REROUTE, {"commands": []}, cb)))
