@@ -403,15 +403,21 @@ def main() -> None:
     t_start = time.perf_counter()
     try:
         backend, err = probe_backend()
+        force_cpu = False
         if backend is None:
             # one retry, then force CPU so the round still records numbers
             time.sleep(5)
             backend, err2 = probe_backend()
             if backend is None:
                 result["errors"]["backend"] = f"probe1: {err}; probe2: {err2}"
+                force_cpu = True
                 os.environ["JAX_PLATFORMS"] = "cpu"
 
         import jax
+        if force_cpu:
+            # the TPU PJRT plugin registers regardless of the env var;
+            # only the config knob (before first backend init) wins
+            jax.config.update("jax_platforms", "cpu")
         import jax.numpy as jnp
         import numpy as np
         result["backend"] = jax.default_backend()

@@ -207,6 +207,153 @@ class FunctionScore(Query):
 
 
 @dataclass
+class MatchPhrasePrefix(Query):
+    """Phrase with the LAST term as a prefix (search-as-you-type;
+    index/query/MatchPhrasePrefixQueryBuilder analog)."""
+    field: str = ""
+    text: str = ""
+    max_expansions: int = 50
+    boost: float = 1.0
+
+
+@dataclass
+class MoreLikeThis(Query):
+    """Find docs similar to free text: top tf-idf terms become a should
+    query (index/query/MoreLikeThisQueryBuilder analog)."""
+    fields: List[str] = field(default_factory=list)
+    like: List[str] = field(default_factory=list)
+    max_query_terms: int = 25
+    min_term_freq: int = 2      # MoreLikeThisQueryBuilder defaults
+    min_doc_freq: int = 5
+    boost: float = 1.0
+
+
+@dataclass
+class GeoDistance(Query):
+    """Docs whose geo_point lies within ``distance`` meters of a center
+    (index/query/GeoDistanceQueryBuilder analog)."""
+    field: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance_m: float = 0.0
+    boost: float = 1.0
+
+
+@dataclass
+class GeoBoundingBox(Query):
+    """Docs whose geo_point lies inside the box
+    (index/query/GeoBoundingBoxQueryBuilder analog)."""
+    field: str = ""
+    top: float = 0.0
+    left: float = 0.0
+    bottom: float = 0.0
+    right: float = 0.0
+    boost: float = 1.0
+
+
+_DISTANCE_UNITS = (   # longest suffix first so 'nmi' wins over 'mi'/'m'
+    ("nmi", 1852.0), ("km", 1000.0), ("cm", 0.01), ("mm", 0.001),
+    ("mi", 1609.344), ("yd", 0.9144), ("ft", 0.3048), ("in", 0.0254),
+    ("nm", 1852.0), ("m", 1.0),
+)
+
+
+def parse_distance_m(raw: Any) -> float:
+    """ES distance expression -> meters ('10km', '3mi', '500ft', number)."""
+    if isinstance(raw, (int, float)):
+        return float(raw)
+    s = str(raw).strip().lower()
+    try:
+        for suffix, mult in _DISTANCE_UNITS:
+            if s.endswith(suffix):
+                return float(s[: -len(suffix)]) * mult
+        return float(s)
+    except (TypeError, ValueError):
+        raise QueryParsingError(f"failed to parse distance [{raw!r}]")
+
+
+def _parse_geo_point(spec: Any) -> Tuple[float, float]:
+    if isinstance(spec, dict):
+        return float(spec["lat"]), float(spec["lon"])
+    if isinstance(spec, (list, tuple)) and len(spec) == 2:
+        return float(spec[1]), float(spec[0])     # [lon, lat] GeoJSON order
+    if isinstance(spec, str):
+        lat, _, lon = spec.partition(",")
+        return float(lat), float(lon)
+    raise QueryParsingError(f"cannot parse geo point [{spec!r}]")
+
+
+def _parse_geo_distance(spec: Dict[str, Any]) -> GeoDistance:
+    opts = {k: v for k, v in spec.items()
+            if k not in ("distance", "boost", "distance_type",
+                         "validation_method")}
+    if len(opts) != 1 or "distance" not in spec:
+        raise QueryParsingError(
+            "geo_distance requires [distance] and exactly one field")
+    (fname, point), = opts.items()
+    lat, lon = _parse_geo_point(point)
+    return GeoDistance(field=fname, lat=lat, lon=lon,
+                       distance_m=parse_distance_m(spec["distance"]),
+                       boost=float(spec.get("boost", 1.0)))
+
+
+def _parse_geo_bounding_box(spec: Dict[str, Any]) -> GeoBoundingBox:
+    opts = {k: v for k, v in spec.items()
+            if k not in ("boost", "validation_method", "type")}
+    if len(opts) != 1:
+        raise QueryParsingError(
+            "geo_bounding_box requires exactly one field")
+    (fname, box), = opts.items()
+    try:
+        if "top_left" in box and "bottom_right" in box:
+            top, left = _parse_geo_point(box["top_left"])
+            bottom, right = _parse_geo_point(box["bottom_right"])
+        elif "top_right" in box and "bottom_left" in box:
+            top, right = _parse_geo_point(box["top_right"])
+            bottom, left = _parse_geo_point(box["bottom_left"])
+        elif {"top", "left", "bottom", "right"} <= set(box):
+            top, left = float(box["top"]), float(box["left"])
+            bottom, right = float(box["bottom"]), float(box["right"])
+        else:
+            raise QueryParsingError(
+                "geo_bounding_box requires corner points "
+                "(top_left/bottom_right, top_right/bottom_left, or "
+                "top/left/bottom/right)")
+    except (KeyError, TypeError, ValueError) as e:
+        raise QueryParsingError(
+            f"failed to parse geo_bounding_box [{fname}]: {e}")
+    return GeoBoundingBox(field=fname, top=top, left=left, bottom=bottom,
+                          right=right,
+                          boost=float(spec.get("boost", 1.0)))
+
+
+def _parse_match_phrase_prefix(spec: Dict[str, Any]) -> MatchPhrasePrefix:
+    fname, opts = _field_spec(spec, "query")
+    return MatchPhrasePrefix(
+        field=fname, text=str(opts.get("query", "")),
+        max_expansions=int(opts.get("max_expansions", 50)),
+        boost=float(opts.get("boost", 1.0)))
+
+
+def _parse_more_like_this(spec: Dict[str, Any]) -> MoreLikeThis:
+    like = spec.get("like")
+    if like is None:
+        raise QueryParsingError("more_like_this requires [like]")
+    likes = like if isinstance(like, list) else [like]
+    texts = [x for x in likes if isinstance(x, str)]
+    if not texts:
+        raise QueryParsingError(
+            "more_like_this supports free-text [like] values")
+    return MoreLikeThis(
+        fields=list(spec.get("fields", [])),
+        like=texts,
+        max_query_terms=int(spec.get("max_query_terms", 25)),
+        min_term_freq=int(spec.get("min_term_freq", 2)),
+        min_doc_freq=int(spec.get("min_doc_freq", 5)),
+        boost=float(spec.get("boost", 1.0)))
+
+
+@dataclass
 class Percolate(Query):
     """Reverse search: which stored queries match this document
     (modules/percolator PercolateQueryBuilder analog)."""
@@ -381,6 +528,10 @@ _PARSERS = {
     "match_none": lambda spec: MatchNone(),
     "match": _parse_match,
     "match_phrase": _parse_match_phrase,
+    "match_phrase_prefix": _parse_match_phrase_prefix,
+    "more_like_this": _parse_more_like_this,
+    "geo_distance": _parse_geo_distance,
+    "geo_bounding_box": _parse_geo_bounding_box,
     "multi_match": _parse_multi_match,
     "term": _parse_term,
     "terms": _parse_terms,

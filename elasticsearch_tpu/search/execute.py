@@ -394,6 +394,157 @@ def _h_match_phrase(q: dsl.MatchPhrase, ctx: SegmentContext) -> Result:
     return jnp.where(mask, scores, 0.0), mask
 
 
+def _h_match_phrase_prefix(q: dsl.MatchPhrasePrefix,
+                           ctx: SegmentContext) -> Result:
+    """Phrase match with the last term prefix-expanded against the term
+    dictionary (MatchPhrasePrefixQueryBuilder's MultiPhrasePrefixQuery,
+    capped at max_expansions)."""
+    analyzer = ctx.search_analyzer(q.field)
+    tokens = analyzer.analyze(q.text)
+    if not tokens:
+        return ctx.zeros(), ctx.none_mask()
+    pf = ctx.segment.postings.get(q.field)
+    if pf is None:
+        return ctx.zeros(), ctx.none_mask()
+    prefix = tokens[-1].term
+    expansions = sorted(t for t in pf.terms
+                        if t.startswith(prefix))[: q.max_expansions]
+    if not expansions:
+        return ctx.zeros(), ctx.none_mask()
+    head = tokens[:-1]
+    # candidates: docs with all head terms AND any expansion
+    cand: Optional[set] = None
+    for tok in head:
+        docs, _ = pf.postings_for(tok.term)
+        s = set(docs.tolist())
+        cand = s if cand is None else (cand & s)
+        if not cand:
+            break
+    exp_docs: set = set()
+    for term in expansions:
+        docs, _ = pf.postings_for(term)
+        exp_docs.update(docs.tolist())
+    cand = exp_docs if cand is None else (cand & exp_docs)
+    matched = []
+    rel = [t.position - tokens[0].position for t in tokens]
+    for doc in cand or ():
+        starts = (pf.positions_for(head[0].term, doc)
+                  if head else pf.positions_for(expansions[0], doc))
+        ok = False
+        if not head:
+            ok = True   # single prefix term: presence is a match
+        else:
+            for p0 in starts:
+                if all(_has_position(pf, t.term, doc, p0 + r, 0)
+                       for t, r in zip(head[1:], rel[1:-1])):
+                    if any(_has_position(pf, e, doc, p0 + rel[-1], 0)
+                           for e in expansions):
+                        ok = True
+                        break
+        if ok:
+            matched.append(doc)
+    mask_host = np.zeros(ctx.segment.n_docs, bool)
+    mask_host[matched] = True
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    # score matched docs with BM25 over the head terms + expansions, the
+    # same analog _h_match_phrase documents (constant scoring would rank
+    # many-occurrence docs identically to one-occurrence docs)
+    ex = _bm25_executor(ctx, q.field)
+    score_terms = [t.term for t in head] + expansions[:1]
+    scores = ex.scores(score_terms, ctx.live, boost=q.boost,
+                       df_override=ctx.df_for(q.field),
+                       avgdl_override=ctx.avgdl_for(q.field))
+    return jnp.where(mask, scores, 0.0), mask
+
+
+def _h_more_like_this(q: dsl.MoreLikeThis, ctx: SegmentContext) -> Result:
+    """Top tf-idf terms from the like-texts scored as a bag of shoulds
+    (MoreLikeThisQueryBuilder's term selection, per field)."""
+    from collections import Counter
+    total_scores = None
+    any_mask = None
+    fields = q.fields or [
+        name for name in ctx.mappers.field_names()
+        if ctx.mappers.field_type(name) == "text"]
+    for fname in fields:
+        pf = ctx.segment.postings.get(fname)
+        ex = _bm25_executor(ctx, fname)
+        if pf is None or ex is None:
+            continue
+        analyzer = ctx.search_analyzer(fname)
+        tf = Counter(t for text in q.like for t in analyzer.terms(text))
+        doc_count = ctx.doc_count_for_idf()
+        scored = []
+        for term, freq in tf.items():
+            if freq < q.min_term_freq:
+                continue
+            tid = pf.terms.get(term)
+            df = int(pf.doc_freq[tid]) if tid is not None else 0
+            if df < q.min_doc_freq:
+                continue
+            idf = np.log(1.0 + (doc_count - df + 0.5) / (df + 0.5))
+            scored.append((freq * idf, term))
+        scored.sort(reverse=True)
+        terms = [t for _s, t in scored[: q.max_query_terms]]
+        if not terms:
+            continue
+        scores = ex.scores(terms, ctx.live, boost=q.boost,
+                           df_override=ctx.df_for(fname),
+                           avgdl_override=ctx.avgdl_for(fname))
+        mask = scores > 0.0
+        total_scores = scores if total_scores is None \
+            else total_scores + scores
+        any_mask = mask if any_mask is None else (any_mask | mask)
+    if total_scores is None:
+        return ctx.zeros(), ctx.none_mask()
+    return jnp.where(any_mask, total_scores, 0.0), any_mask
+
+
+EARTH_RADIUS_M = 6_371_000.0
+
+
+def _geo_column(ctx: SegmentContext, field_name: str) -> np.ndarray:
+    arr = ctx.segment.geo.get(field_name)
+    if arr is None:
+        return np.full((ctx.segment.n_docs, 2), np.nan)
+    return arr
+
+
+def _h_geo_distance(q: dsl.GeoDistance, ctx: SegmentContext) -> Result:
+    def build():
+        pts = _geo_column(ctx, q.field)
+        lat = np.radians(pts[:, 0])
+        lon = np.radians(pts[:, 1])
+        qlat, qlon = np.radians(q.lat), np.radians(q.lon)
+        # haversine (GeoDistance.ARC)
+        a = np.sin((lat - qlat) / 2) ** 2 + \
+            np.cos(lat) * np.cos(qlat) * np.sin((lon - qlon) / 2) ** 2
+        d = 2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+        mask = np.nan_to_num(d, nan=np.inf) <= q.distance_m
+        return ctx.to_device_mask(mask)
+    mask = ctx.segment.cached_filter(
+        ("geo_distance", q.field, q.lat, q.lon, q.distance_m), build) \
+        & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_geo_bounding_box(q: dsl.GeoBoundingBox, ctx: SegmentContext) -> Result:
+    def build():
+        pts = _geo_column(ctx, q.field)
+        lat, lon = pts[:, 0], pts[:, 1]
+        # NaN (missing field) compares False on both sides: excluded
+        in_lat = (lat <= q.top) & (lat >= q.bottom)
+        if q.left <= q.right:
+            in_lon = (lon >= q.left) & (lon <= q.right)
+        else:   # box crossing the antimeridian
+            in_lon = (lon >= q.left) | (lon <= q.right)
+        return ctx.to_device_mask(in_lat & in_lon)
+    mask = ctx.segment.cached_filter(
+        ("geo_bbox", q.field, q.top, q.left, q.bottom, q.right), build) \
+        & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
 def _has_position(pf, term: str, doc: int, want: int, slop: int) -> bool:
     pos = pf.positions_for(term, doc)
     if slop == 0:
@@ -916,6 +1067,10 @@ _HANDLERS = {
     dsl.Match: _h_match,
     dsl.MultiMatch: _h_multi_match,
     dsl.MatchPhrase: _h_match_phrase,
+    dsl.MatchPhrasePrefix: _h_match_phrase_prefix,
+    dsl.MoreLikeThis: _h_more_like_this,
+    dsl.GeoDistance: _h_geo_distance,
+    dsl.GeoBoundingBox: _h_geo_bounding_box,
     dsl.Term: _h_term,
     dsl.Terms: _h_terms,
     dsl.Range: _h_range,

@@ -92,8 +92,14 @@ class Highlighter:
         def walk(node):
             if isinstance(node, dsl.Match) and node.field == field:
                 terms.update(self._analyze(field, node.text))
-            elif isinstance(node, dsl.MatchPhrase) and node.field == field:
+            elif isinstance(node, (dsl.MatchPhrase,
+                                   dsl.MatchPhrasePrefix)) and \
+                    node.field == field:
                 terms.update(self._analyze(field, node.text))
+            elif isinstance(node, dsl.MoreLikeThis) and \
+                    (not node.fields or field in node.fields):
+                for text in node.like:
+                    terms.update(self._analyze(field, text))
             elif isinstance(node, dsl.MultiMatch):
                 for f in node.fields:
                     if f.partition("^")[0] == field:

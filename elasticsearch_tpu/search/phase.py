@@ -97,8 +97,12 @@ def collect_query_terms(q: dsl.Query) -> Dict[str, List[str]]:
     def walk(node, mappers=None):
         if isinstance(node, dsl.Match):
             out.setdefault(node.field, []).append(node.text)
-        elif isinstance(node, dsl.MatchPhrase):
+        elif isinstance(node, (dsl.MatchPhrase, dsl.MatchPhrasePrefix)):
             out.setdefault(node.field, []).append(node.text)
+        elif isinstance(node, dsl.MoreLikeThis):
+            for f in node.fields:
+                for text in node.like:
+                    out.setdefault(f, []).append(text)
         elif isinstance(node, dsl.MultiMatch):
             for f in node.fields:
                 out.setdefault(f.partition("^")[0], []).append(node.text)

@@ -1,0 +1,106 @@
+"""match_phrase_prefix, more_like_this, and geo queries.
+
+Reference: index/query/MatchPhrasePrefixQueryBuilder,
+MoreLikeThisQueryBuilder, GeoDistanceQueryBuilder,
+GeoBoundingBoxQueryBuilder.
+"""
+
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import MapperService
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.search.dsl import parse_distance_m
+
+
+@pytest.fixture()
+def svc():
+    mappers = MapperService({"properties": {
+        "body": {"type": "text"},
+        "loc": {"type": "geo_point"},
+    }})
+    engine = InternalEngine(mappers)
+    docs = [
+        ("d1", {"body": "quick brown fox jumps",
+                "loc": {"lat": 48.8566, "lon": 2.3522}}),      # Paris
+        ("d2", {"body": "quick brown foal sleeps",
+                "loc": {"lat": 51.5074, "lon": -0.1278}}),     # London
+        ("d3", {"body": "brown quick fox",                      # reversed
+                "loc": {"lat": 40.7128, "lon": -74.006}}),     # NYC
+        ("d4", {"body": "slow green turtle crawls on and on",
+                "loc": {"lat": 48.85, "lon": 2.35}}),          # Paris-ish
+        ("d5", {"body": "the quick brown fox jumps over the lazy dog "
+                        "while another fox watches the brown field"}),
+    ]
+    for did, src in docs:
+        engine.index(did, src)
+    engine.refresh()
+    return SearchService(engine, index_name="t")
+
+
+def test_match_phrase_prefix(svc):
+    # "quick brown fo" matches fox AND foal via the prefix expansion,
+    # in phrase order only (d3 has the words out of order)
+    res = svc.search({"query": {"match_phrase_prefix": {
+        "body": "quick brown fo"}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == \
+        ["d1", "d2", "d5"]
+    # max_expansions=0-like narrowing: a longer prefix excludes foal
+    res = svc.search({"query": {"match_phrase_prefix": {
+        "body": {"query": "quick brown fox"}}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["d1", "d5"]
+    # single bare prefix
+    res = svc.search({"query": {"match_phrase_prefix": {"body": "turt"}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["d4"]
+
+
+def test_more_like_this(svc):
+    res = svc.search({"query": {"more_like_this": {
+        "fields": ["body"],
+        "like": "quick brown fox",
+        "min_term_freq": 1, "min_doc_freq": 1}}})
+    ids = [h["_id"] for h in res["hits"]["hits"]]
+    assert set(ids) >= {"d1", "d5"}
+    assert "d4" not in ids
+    # min_doc_freq filters rare terms out of the selection
+    res = svc.search({"query": {"more_like_this": {
+        "fields": ["body"], "like": "turtle",
+        "min_term_freq": 1, "min_doc_freq": 2}}})
+    assert res["hits"]["total"]["value"] == 0
+
+
+def test_geo_distance(svc):
+    assert parse_distance_m("10km") == 10_000
+    assert parse_distance_m("3mi") == pytest.approx(4828.032)
+    # 5km around Paris center: d1 and d4 only
+    res = svc.search({"query": {"geo_distance": {
+        "distance": "5km", "loc": {"lat": 48.8566, "lon": 2.3522}}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["d1", "d4"]
+    # 500km pulls in London
+    res = svc.search({"query": {"geo_distance": {
+        "distance": "500km", "loc": {"lat": 48.8566, "lon": 2.3522}}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == \
+        ["d1", "d2", "d4"]
+
+
+def test_geo_bounding_box(svc):
+    # box around western Europe: Paris + London, not NYC
+    res = svc.search({"query": {"geo_bounding_box": {
+        "loc": {"top_left": {"lat": 60.0, "lon": -10.0},
+                "bottom_right": {"lat": 40.0, "lon": 10.0}}}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == \
+        ["d1", "d2", "d4"]
+    # docs without the field never match
+    res = svc.search({"query": {"geo_bounding_box": {
+        "loc": {"top_left": {"lat": 90.0, "lon": -180.0},
+                "bottom_right": {"lat": -90.0, "lon": 180.0}}}}})
+    assert "d5" not in [h["_id"] for h in res["hits"]["hits"]]
+
+
+def test_geo_in_bool_filter(svc):
+    res = svc.search({"query": {"bool": {
+        "must": [{"match": {"body": "quick"}}],
+        "filter": [{"geo_distance": {
+            "distance": "5km",
+            "loc": {"lat": 48.8566, "lon": 2.3522}}}]}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["d1"]
