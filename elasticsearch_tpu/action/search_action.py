@@ -84,7 +84,12 @@ class SearchTransportService:
                       ) -> Dict[str, Any]:
         shard = self.indices.shard(req["index"], req["shard"])
         query = dsl.parse_query(req.get("body", {}).get("query"))
-        if not collect_query_terms(query):
+        from elasticsearch_tpu.search.phase import contains_term_expansion
+        if not collect_query_terms(query) or \
+                contains_term_expansion(query):
+            # dictionary-expanded queries (prefix etc.) can match terms
+            # their literal text never names — df pre-filtering would
+            # produce false negatives
             return {"can_match": True}
         reader = shard.engine.acquire_reader()
         # a shard can produce hits only if at least one (analyzed) query

@@ -309,6 +309,49 @@ class Analyzer:
         return [t.term for t in self.analyze(text)]
 
 
+def cjk_bigram_tokenizer(text: str) -> List[Token]:
+    """CJK-aware tokenization (analysis-common CJKBigramFilter analog):
+    runs of Han/Hiragana/Katakana/Hangul become overlapping bigrams
+    (unigram when the run is a single char); everything else tokenizes
+    like the standard tokenizer."""
+    def is_cjk(ch: str) -> bool:
+        cp = ord(ch)
+        return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF or
+                0x3040 <= cp <= 0x30FF or 0xAC00 <= cp <= 0xD7AF or
+                0xF900 <= cp <= 0xFAFF)
+
+    tokens: List[Token] = []
+    position = 0
+    i = 0
+    n = len(text)
+    while i < n:
+        ch = text[i]
+        if is_cjk(ch):
+            j = i
+            while j < n and is_cjk(text[j]):
+                j += 1
+            run = text[i:j]
+            if len(run) == 1:
+                tokens.append(Token(run, position, i, i + 1))
+                position += 1
+            else:
+                for k in range(len(run) - 1):
+                    tokens.append(Token(run[k: k + 2], position,
+                                        i + k, i + k + 2))
+                    position += 1
+            i = j
+        elif ch.isalnum():
+            j = i
+            while j < n and (text[j].isalnum() and not is_cjk(text[j])):
+                j += 1
+            tokens.append(Token(text[i:j], position, i, j))
+            position += 1
+            i = j
+        else:
+            i += 1
+    return tokens
+
+
 STANDARD = Analyzer("standard", standard_tokenizer, [lowercase_filter])
 SIMPLE = Analyzer("simple", letter_tokenizer, [lowercase_filter])
 WHITESPACE = Analyzer("whitespace", whitespace_tokenizer)
@@ -318,9 +361,11 @@ ENGLISH = Analyzer(
     "english", standard_tokenizer,
     [lowercase_filter, make_stop_filter(), porter_stem_filter],
 )
+CJK = Analyzer("cjk", cjk_bigram_tokenizer, [lowercase_filter])
 
 BUILTIN_ANALYZERS: Dict[str, Analyzer] = {
-    a.name: a for a in (STANDARD, SIMPLE, WHITESPACE, KEYWORD, STOP, ENGLISH)
+    a.name: a for a in (STANDARD, SIMPLE, WHITESPACE, KEYWORD, STOP,
+                        ENGLISH, CJK)
 }
 
 _TOKENIZERS: Dict[str, Callable[..., Any]] = {

@@ -291,7 +291,11 @@ def _parse_geo_distance(spec: Dict[str, Any]) -> GeoDistance:
         raise QueryParsingError(
             "geo_distance requires [distance] and exactly one field")
     (fname, point), = opts.items()
-    lat, lon = _parse_geo_point(point)
+    try:
+        lat, lon = _parse_geo_point(point)
+    except (KeyError, TypeError, ValueError) as e:
+        raise QueryParsingError(
+            f"failed to parse geo point for [{fname}]: {e}")
     return GeoDistance(field=fname, lat=lat, lon=lon,
                        distance_m=parse_distance_m(spec["distance"]),
                        boost=float(spec.get("boost", 1.0)))
@@ -341,9 +345,15 @@ def _parse_more_like_this(spec: Dict[str, Any]) -> MoreLikeThis:
         raise QueryParsingError("more_like_this requires [like]")
     likes = like if isinstance(like, list) else [like]
     texts = [x for x in likes if isinstance(x, str)]
+    if len(texts) != len(likes):
+        # silently narrowing {"_index","_id"} doc references to only the
+        # text likes would return different hits with no signal
+        raise QueryParsingError(
+            "more_like_this supports free-text [like] values only; "
+            "document references are not supported")
     if not texts:
         raise QueryParsingError(
-            "more_like_this supports free-text [like] values")
+            "more_like_this requires at least one [like] text")
     return MoreLikeThis(
         fields=list(spec.get("fields", [])),
         like=texts,

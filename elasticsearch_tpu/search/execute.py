@@ -450,7 +450,7 @@ def _h_match_phrase_prefix(q: dsl.MatchPhrasePrefix,
     # same analog _h_match_phrase documents (constant scoring would rank
     # many-occurrence docs identically to one-occurrence docs)
     ex = _bm25_executor(ctx, q.field)
-    score_terms = [t.term for t in head] + expansions[:1]
+    score_terms = [t.term for t in head] + expansions
     scores = ex.scores(score_terms, ctx.live, boost=q.boost,
                        df_override=ctx.df_for(q.field),
                        avgdl_override=ctx.avgdl_for(q.field))

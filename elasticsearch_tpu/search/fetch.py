@@ -86,16 +86,26 @@ class Highlighter:
         self.fragment_size = fragment_size
         self.n_fragments = number_of_fragments
 
-    def query_terms_for_field(self, q: dsl.Query, field: str) -> set:
+    def query_terms_for_field(self, q: dsl.Query, field: str
+                              ) -> "tuple[set, set]":
+        """(exact terms, prefixes): a doc token highlights when it equals
+        an exact term OR starts with a prefix (match_phrase_prefix)."""
         terms = set()
+        prefixes = set()
 
         def walk(node):
             if isinstance(node, dsl.Match) and node.field == field:
                 terms.update(self._analyze(field, node.text))
-            elif isinstance(node, (dsl.MatchPhrase,
-                                   dsl.MatchPhrasePrefix)) and \
-                    node.field == field:
+            elif isinstance(node, dsl.MatchPhrase) and node.field == field:
                 terms.update(self._analyze(field, node.text))
+            elif isinstance(node, dsl.MatchPhrasePrefix) and \
+                    node.field == field:
+                toks = list(self._analyze(field, node.text))
+                if toks:
+                    # the last token is a PREFIX: expose it via the
+                    # prefix marker so doc tokens it expands to highlight
+                    terms.update(toks[:-1])
+                    prefixes.add(toks[-1])
             elif isinstance(node, dsl.MoreLikeThis) and \
                     (not node.fields or field in node.fields):
                 for text in node.like:
@@ -119,7 +129,7 @@ class Highlighter:
                     walk(node.query)
 
         walk(q)
-        return terms
+        return terms, prefixes
 
     def _analyze(self, field: str, text: str):
         mapper = self.mappers.mapper(field)
@@ -130,8 +140,8 @@ class Highlighter:
         return analyzer.terms(text)
 
     def highlight_field(self, q: dsl.Query, field: str, text: str) -> List[str]:
-        terms = self.query_terms_for_field(q, field)
-        if not terms:
+        terms, prefixes = self.query_terms_for_field(q, field)
+        if not terms and not prefixes:
             return []
         mapper = self.mappers.mapper(field)
         analyzer = getattr(mapper, "analyzer", None)
@@ -139,7 +149,9 @@ class Highlighter:
             from elasticsearch_tpu.analysis import STANDARD
             analyzer = STANDARD
         tokens = analyzer.analyze(text)
-        matches = [(t.start_offset, t.end_offset) for t in tokens if t.term in terms]
+        matches = [(t.start_offset, t.end_offset) for t in tokens
+                   if t.term in terms or
+                   any(t.term.startswith(p) for p in prefixes)]
         if not matches:
             return []
         fragments: List[str] = []

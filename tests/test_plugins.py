@@ -1,0 +1,116 @@
+"""Plugin SPI + CJK analysis.
+
+Reference: plugins/SearchPlugin.java:67 (queries/aggs extension points),
+IngestPlugin, AnalysisPlugin; analysis-common's CJK bigram handling.
+"""
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticsearch_tpu import plugins
+from elasticsearch_tpu.analysis import BUILTIN_ANALYZERS
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import MapperService
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+
+def test_cjk_bigram_analyzer():
+    cjk = BUILTIN_ANALYZERS["cjk"]
+    assert cjk.terms("東京都") == ["東京", "京都"]
+    assert cjk.terms("Tokyo 東京 2026") == ["tokyo", "東京", "2026"]
+    assert cjk.terms("中") == ["中"]
+
+
+def test_cjk_search_end_to_end():
+    mappers = MapperService({"properties": {
+        "body": {"type": "text", "analyzer": "cjk"}}})
+    engine = InternalEngine(mappers)
+    engine.index("d1", {"body": "東京都は大きい"})
+    engine.index("d2", {"body": "京都は静かだ"})
+    engine.refresh()
+    svc = SearchService(engine, index_name="cjk")
+    res = svc.search({"query": {"match": {"body": "京都"}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["d1", "d2"]
+    res = svc.search({"query": {"match": {"body": "東京"}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["d1"]
+
+
+@dataclass
+class EvenDocsQuery(dsl.Query):
+    """Example extension: matches docs whose numeric field is even."""
+    field: str = ""
+    boost: float = 1.0
+
+
+def _parse_even(spec):
+    return EvenDocsQuery(field=spec["field"],
+                         boost=float(spec.get("boost", 1.0)))
+
+
+def _handle_even(q, ctx):
+    dv = ctx.segment.doc_values.get(q.field)
+    mask_host = np.zeros(ctx.segment.n_docs, bool)
+    if dv is not None:
+        vals = dv.values.astype(np.int64)
+        mask_host = dv.exists & (vals % 2 == 0)
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+class ExamplePlugin(plugins.Plugin):
+    name = "example"
+
+    def install(self) -> None:
+        plugins.register_query("even_docs", EvenDocsQuery,
+                               _parse_even, _handle_even)
+        plugins.register_ingest_processor(
+            "shout", lambda cfg: _shout_factory(cfg))
+
+
+def _shout_factory(cfg):
+    field = cfg["field"]
+
+    def run(doc):
+        doc["_source"][field] = str(doc["_source"].get(field, "")).upper()
+        return doc
+    return run
+
+
+def test_plugin_registers_query_and_processor():
+    installed = plugins.load_plugins(["tests.test_plugins:ExamplePlugin"])
+    assert installed == ["example"] or installed == []   # idempotent reruns
+
+    mappers = MapperService({"properties": {"n": {"type": "integer"}}})
+    engine = InternalEngine(mappers)
+    for i in range(6):
+        engine.index(f"d{i}", {"n": i})
+    engine.refresh()
+    svc = SearchService(engine, index_name="p")
+    res = svc.search({"query": {"even_docs": {"field": "n"}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == \
+        ["d0", "d2", "d4"]
+
+    from elasticsearch_tpu.ingest import IngestService
+    service = IngestService(lambda: None)
+    proc = service.compile_processor({"shout": {"field": "msg"}})
+    doc = proc.run({"_source": {"msg": "hello"}})
+    assert doc["_source"]["msg"] == "HELLO"
+
+    # double registration is rejected
+    with pytest.raises(IllegalArgumentError):
+        plugins.register_query("even_docs", EvenDocsQuery,
+                               _parse_even, _handle_even)
+    with pytest.raises(IllegalArgumentError):
+        plugins.register_analyzer("standard", BUILTIN_ANALYZERS["cjk"])
+
+
+def test_plugin_descriptor_errors():
+    with pytest.raises(IllegalArgumentError):
+        plugins.load_plugins(["no.such.module:Nope"])
+    with pytest.raises(IllegalArgumentError):
+        plugins.load_plugins(["tests.test_plugins:EvenDocsQuery"])
