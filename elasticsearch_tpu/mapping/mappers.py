@@ -264,6 +264,46 @@ class DenseVectorFieldMapper(FieldMapper):
         return ParsedField(self.name, "vector", vector=vec)
 
 
+class JoinFieldMapper(FieldMapper):
+    """Parent-child relations within one index
+    (modules/parent-join ParentJoinFieldMapper analog).
+
+    A parent doc stores the relation name; a child doc stores
+    {"name": <child_rel>, "parent": <parent_id>} and must be routed by the
+    parent id so both land on the same shard. The relation name indexes as
+    a keyword on this field; the parent id indexes on a companion
+    ``<field>#parent`` keyword column the join queries read."""
+
+    type_name = "join"
+    has_doc_values = False
+
+    def __init__(self, name: str, params: Dict[str, Any],
+                 analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        relations = params.get("relations") or {}
+        self.parents = set(relations.keys())
+        self.children = set()
+        for kids in relations.values():
+            self.children.update(kids if isinstance(kids, list) else [kids])
+
+    def parse(self, value: Any) -> ParsedField:
+        if isinstance(value, str):
+            rel, parent = value, None
+        elif isinstance(value, dict):
+            rel = value.get("name")
+            parent = value.get("parent")
+        else:
+            raise MapperParsingError(
+                f"join [{self.name}] expects a relation name or object")
+        if rel not in self.parents | self.children:
+            raise MapperParsingError(
+                f"unknown join relation [{rel}] for [{self.name}]")
+        if rel in self.children and parent is None:
+            raise MapperParsingError(
+                f"join relation [{rel}] requires [parent]")
+        return ParsedField(self.name, "terms", exact_terms=[str(rel)])
+
+
 class PercolatorFieldMapper(FieldMapper):
     """Stored-query field (modules/percolator PercolatorFieldMapper
     analog): the value is a query body, validated by parsing at INDEX
@@ -389,6 +429,7 @@ _MAPPER_TYPES = {
     "boolean": BooleanFieldMapper,
     "date": DateFieldMapper,
     "dense_vector": DenseVectorFieldMapper,
+    "join": JoinFieldMapper,
     "percolator": PercolatorFieldMapper,
     "rank_features": RankFeaturesFieldMapper,
     "rank_feature": RankFeatureFieldMapper,
@@ -454,6 +495,14 @@ class MapperService:
         self._merge_props("", props)
         if "dynamic" in mapping:
             self.dynamic = _parse_dynamic(mapping["dynamic"])
+        # every join field gets an internal keyword companion carrying the
+        # parent id (never serialized; join queries read it)
+        for name, m in list(self._mappers.items()):
+            if m.type_name == "join":
+                companion = f"{name}#parent"
+                if companion not in self._mappers:
+                    self._mappers[companion] = KeywordFieldMapper(
+                        companion, {}, self.analysis)
 
     def _merge_props(self, prefix: str, props: Dict[str, Any]) -> None:
         for name, spec in props.items():
@@ -515,6 +564,8 @@ class MapperService:
     def to_mapping(self) -> Dict[str, Any]:
         props: Dict[str, Any] = {}
         for name, m in sorted(self._mappers.items()):
+            if "#" in name:
+                continue   # internal companion columns (join#parent)
             node = props
             parts = name.split(".")
             # .keyword-style subfields render under 'fields'
@@ -578,6 +629,19 @@ class MapperService:
                        routing: Optional[str] = None) -> ParsedDocument:
         doc = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
         self._parse_obj("", source, doc)
+        # a child join doc MUST be routed (by its parent id) or it can land
+        # on a different shard than the parent and every join query would
+        # silently miss it (the reference's RoutingMissingException)
+        for name, mapper in self._mappers.items():
+            if getattr(mapper, "type_name", "") != "join":
+                continue
+            parsed = doc.fields.get(name)
+            if parsed is not None and parsed.exact_terms and \
+                    parsed.exact_terms[0] in mapper.children and \
+                    routing is None:
+                raise MapperParsingError(
+                    f"routing is required for join child documents "
+                    f"([{name}] relation [{parsed.exact_terms[0]}])")
         return doc
 
     def _parse_obj(self, prefix: str, obj: Dict[str, Any], doc: ParsedDocument) -> None:
@@ -606,6 +670,13 @@ class MapperService:
                 _merge_parsed(doc.fields[name], parsed)
             else:
                 doc.fields[name] = parsed
+            # feed the join parent-id companion column
+            if mapper.type_name == "join" and isinstance(value, dict) and \
+                    value.get("parent") is not None:
+                comp = f"{name}#parent"
+                companion = self._mappers.get(comp)
+                if companion is not None:
+                    doc.fields[comp] = companion.parse(str(value["parent"]))
             # feed text.keyword subfields
             kw = self._mappers.get(f"{name}.keyword")
             if kw is not None and mapper.type_name == "text":

@@ -207,6 +207,33 @@ class FunctionScore(Query):
 
 
 @dataclass
+class HasChild(Query):
+    """Parents with at least one matching child
+    (modules/parent-join HasChildQueryBuilder analog)."""
+    child_type: str = ""
+    query: Query = None
+    min_children: int = 1
+    boost: float = 1.0
+
+
+@dataclass
+class HasParent(Query):
+    """Children whose parent matches
+    (modules/parent-join HasParentQueryBuilder analog)."""
+    parent_type: str = ""
+    query: Query = None
+    boost: float = 1.0
+
+
+@dataclass
+class ParentId(Query):
+    """Children of one specific parent (ParentIdQueryBuilder analog)."""
+    child_type: str = ""
+    id: str = ""
+    boost: float = 1.0
+
+
+@dataclass
 class MatchPhrasePrefix(Query):
     """Phrase with the LAST term as a prefix (search-as-you-type;
     index/query/MatchPhrasePrefixQueryBuilder analog)."""
@@ -566,6 +593,17 @@ _PARSERS = {
         negative_boost=float(spec.get("negative_boost", 0.5)),
         boost=float(spec.get("boost", 1.0))),
     "knn": _parse_knn,
+    "has_child": lambda spec: HasChild(
+        child_type=spec["type"], query=parse_query(spec.get("query")),
+        min_children=int(spec.get("min_children", 1)),
+        boost=float(spec.get("boost", 1.0))),
+    "has_parent": lambda spec: HasParent(
+        parent_type=spec["parent_type"],
+        query=parse_query(spec.get("query")),
+        boost=float(spec.get("boost", 1.0))),
+    "parent_id": lambda spec: ParentId(
+        child_type=spec["type"], id=str(spec["id"]),
+        boost=float(spec.get("boost", 1.0))),
     "percolate": lambda spec: Percolate(
         field=spec.get("field", "query"),
         documents=(spec.get("documents")

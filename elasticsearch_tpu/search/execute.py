@@ -47,6 +47,10 @@ class SegmentContext:
     # point-in-time live mask (a Reader snapshot); when set it REPLACES the
     # segment's current mask so mid-scroll deletes stay invisible
     live_override: Optional[jnp.ndarray] = None
+    # the whole shard snapshot this segment belongs to: join queries
+    # (has_child/has_parent) must see sibling segments, since parents and
+    # children share a shard but not necessarily a segment
+    reader: Any = None
 
     @property
     def n_docs(self) -> int:
@@ -895,6 +899,160 @@ def _h_text_expansion(q: dsl.TextExpansion, ctx: SegmentContext) -> Result:
     return scores, scores > 0.0
 
 
+def _join_field(ctx: SegmentContext) -> Optional[str]:
+    """The index's single join field name, from the mappers."""
+    for name in ctx.mappers.field_names():
+        mapper = ctx.mappers.mapper(name)
+        if getattr(mapper, "type_name", "") == "join":
+            return name
+    return None
+
+
+def _shard_ctxs(ctx: SegmentContext):
+    """SegmentContexts for EVERY segment of the shard snapshot — join
+    queries span segments (parents and children share a shard, not a
+    segment). Sibling contexts carry the READER's live masks (the
+    point-in-time snapshot), not the segments' current masks, so
+    mid-scroll deletes stay invisible exactly as in query_shard. Falls
+    back to just this segment without a reader."""
+    if ctx.reader is None:
+        return [ctx]
+    out = []
+    for si, (seg, live_host) in enumerate(
+            zip(ctx.reader.segments, ctx.reader.live_masks)):
+        if seg is ctx.segment:
+            out.append(ctx)
+            continue
+        n_pad = next_pow2(max(seg.n_docs, 1), minimum=BLOCK)
+        snap = np.zeros(n_pad, bool)
+        snap[: len(live_host)] = live_host
+        out.append(SegmentContext(
+            seg, ctx.mappers, segment_idx=si,
+            doc_count_override=ctx.doc_count_override,
+            df_overrides=ctx.df_overrides,
+            field_stats_overrides=ctx.field_stats_overrides,
+            live_override=jnp.asarray(snap), reader=ctx.reader))
+    return out
+
+
+def _join_cache(ctx: SegmentContext, key: Tuple, build):
+    """Shard-level cache for join pre-passes: the wanted-parent/child set
+    is identical for every segment of the shard, so compute it once per
+    (snapshot, query) instead of O(segments^2) inner executions. Lives on
+    the snapshot's first segment, keyed by every segment's uid + live
+    count so any refresh/delete invalidates."""
+    if ctx.reader is None:
+        return build()
+    snapshot = tuple((seg.uid, int(np.asarray(m).sum())) for seg, m in
+                     zip(ctx.reader.segments, ctx.reader.live_masks))
+    return ctx.reader.segments[0].cached_filter(key + (snapshot,), build)
+
+
+def _relation_mask(seg, join_field: str, relation: str) -> np.ndarray:
+    mask = np.zeros(seg.n_docs, bool)
+    kf = seg.keywords.get(join_field)
+    if kf is not None:
+        mask[kf.docs_with_term(relation)] = True
+    return mask
+
+
+def _parent_ids_of(seg, join_field: str, docs: np.ndarray) -> list:
+    kf = seg.keywords.get(f"{join_field}#parent")
+    out = []
+    if kf is None:
+        return out
+    for d in docs:
+        ords = kf.ord_values[kf.ord_offsets[d]: kf.ord_offsets[d + 1]]
+        out.extend(kf.term_list[int(o)] for o in ords)
+    return out
+
+
+def _h_has_child(q: dsl.HasChild, ctx: SegmentContext) -> Result:
+    """Parents with >= min_children matching children. Children live in
+    the same SHARD (routed by parent id) but possibly other segments, so
+    the child pass runs over the whole shard snapshot. Matching parents
+    score a constant boost (score_mode none — documented divergence from
+    the reference's child-score aggregation modes)."""
+    join_field = _join_field(ctx)
+    if join_field is None:
+        return ctx.zeros(), ctx.none_mask()
+
+    def build():
+        from collections import Counter
+        counts: Counter = Counter()
+        for other in _shard_ctxs(ctx):
+            seg = other.segment
+            child_mask = _relation_mask(seg, join_field, q.child_type)
+            if not child_mask.any():
+                continue
+            _, inner_mask = execute(q.query, other)
+            live = np.asarray(other.live)[: seg.n_docs]
+            matched = np.asarray(inner_mask)[: seg.n_docs] \
+                & child_mask & live
+            counts.update(_parent_ids_of(seg, join_field,
+                                         np.nonzero(matched)[0]))
+        return frozenset(pid for pid, n in counts.items()
+                         if n >= q.min_children)
+
+    wanted = _join_cache(
+        ctx, ("has_child", q.child_type, q.min_children, repr(q.query)),
+        build)
+    mask_host = np.zeros(ctx.segment.n_docs, bool)
+    for pid in wanted:
+        d = ctx.segment.id_to_doc.get(pid)
+        if d is not None:
+            mask_host[d] = True
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_has_parent(q: dsl.HasParent, ctx: SegmentContext) -> Result:
+    """Children whose parent matches the inner query."""
+    join_field = _join_field(ctx)
+    if join_field is None:
+        return ctx.zeros(), ctx.none_mask()
+
+    def build():
+        matching: set = set()
+        for other in _shard_ctxs(ctx):
+            seg = other.segment
+            parent_mask = _relation_mask(seg, join_field, q.parent_type)
+            if not parent_mask.any():
+                continue
+            _, inner_mask = execute(q.query, other)
+            live = np.asarray(other.live)[: seg.n_docs]
+            matched = np.asarray(inner_mask)[: seg.n_docs] \
+                & parent_mask & live
+            matching.update(seg.ids[d] for d in np.nonzero(matched)[0])
+        return frozenset(matching)
+
+    matching_parents = _join_cache(
+        ctx, ("has_parent", q.parent_type, repr(q.query)), build)
+    seg = ctx.segment
+    kf = seg.keywords.get(f"{join_field}#parent")
+    mask_host = np.zeros(seg.n_docs, bool)
+    if kf is not None:
+        for pid in matching_parents:
+            mask_host[kf.docs_with_term(pid)] = True
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_parent_id(q: dsl.ParentId, ctx: SegmentContext) -> Result:
+    join_field = _join_field(ctx)
+    if join_field is None:
+        return ctx.zeros(), ctx.none_mask()
+    seg = ctx.segment
+    child_mask = _relation_mask(seg, join_field, q.child_type)
+    kf = seg.keywords.get(f"{join_field}#parent")
+    mask_host = np.zeros(seg.n_docs, bool)
+    if kf is not None:
+        mask_host[kf.docs_with_term(q.id)] = True
+    mask_host &= child_mask
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
 def _h_percolate(q: dsl.Percolate, ctx: SegmentContext) -> Result:
     """Reverse search over stored queries (search/percolate.py). Matching
     stored queries score a constant boost (the reference scores with the
@@ -1086,6 +1244,9 @@ _HANDLERS = {
     dsl.Boosting: _h_boosting,
     dsl.Knn: _h_knn,
     dsl.Nested: _h_nested,
+    dsl.HasChild: _h_has_child,
+    dsl.HasParent: _h_has_parent,
+    dsl.ParentId: _h_parent_id,
     dsl.Percolate: _h_percolate,
     dsl.RankFeature: _h_rank_feature,
     dsl.TextExpansion: _h_text_expansion,

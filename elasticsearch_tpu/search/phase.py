@@ -360,7 +360,8 @@ def query_shard(reader: Reader,
                                    doc_count_override=doc_count,
                                    df_overrides=dfs,
                                    field_stats_overrides=field_stats_overrides,
-                                   live_override=jnp.asarray(snap)))
+                                   live_override=jnp.asarray(snap),
+                                   reader=reader))
     # collector-context dispatch (TopDocsCollectorContext.java:215 analog):
     # pure score-sorted top-k text queries with totals disabled skip the
     # dense score vector entirely and run block-max-pruned device top-k

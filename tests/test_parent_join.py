@@ -1,0 +1,86 @@
+"""Parent-join field + has_child/has_parent/parent_id queries.
+
+Reference: modules/parent-join (ParentJoinFieldMapper,
+HasChildQueryBuilder, HasParentQueryBuilder, ParentIdQueryBuilder).
+"""
+
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import MapperService, \
+    MapperParsingError
+from elasticsearch_tpu.search.service import SearchService
+
+
+@pytest.fixture()
+def svc():
+    mappers = MapperService({"properties": {
+        "text": {"type": "text"},
+        "stars": {"type": "integer"},
+        "jf": {"type": "join", "relations": {"question": "answer"}},
+    }})
+    engine = InternalEngine(mappers)
+    engine.index("q1", {"text": "how to join", "jf": "question"})
+    engine.index("q2", {"text": "why tpus", "jf": "question"})
+    engine.index("a1", {"text": "use the join field", "stars": 5,
+                        "jf": {"name": "answer", "parent": "q1"}},
+                 routing="q1")
+    engine.index("a2", {"text": "irrelevant", "stars": 1,
+                        "jf": {"name": "answer", "parent": "q1"}},
+                 routing="q1")
+    engine.refresh()   # segment 1: q1, q2, a1, a2
+    engine.index("a3", {"text": "matrix units", "stars": 4,
+                        "jf": {"name": "answer", "parent": "q2"}},
+                 routing="q2")
+    engine.refresh()   # segment 2: a3 — cross-segment join coverage
+    return SearchService(engine, index_name="qa")
+
+
+def test_join_mapping_validation():
+    mappers = MapperService({"properties": {
+        "jf": {"type": "join", "relations": {"q": "a"}}}})
+    with pytest.raises(MapperParsingError):
+        mappers.parse_document("x", {"jf": "nope"})          # unknown rel
+    with pytest.raises(MapperParsingError):
+        mappers.parse_document("x", {"jf": {"name": "a", "parent": "p"}},
+                               routing=None)   # child without routing
+    with pytest.raises(MapperParsingError):
+        mappers.parse_document("x", {"jf": {"name": "a"}}, routing="p")
+    # the internal companion column never serializes
+    assert "#" not in str(mappers.to_mapping())
+
+
+def test_has_child(svc):
+    res = svc.search({"query": {"has_child": {
+        "type": "answer", "query": {"range": {"stars": {"gte": 4}}}}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["q1", "q2"]
+    res = svc.search({"query": {"has_child": {
+        "type": "answer", "query": {"match": {"text": "join"}}}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["q1"]
+    # min_children
+    res = svc.search({"query": {"has_child": {
+        "type": "answer", "query": {"match_all": {}},
+        "min_children": 2}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["q1"]
+
+
+def test_has_parent(svc):
+    res = svc.search({"query": {"has_parent": {
+        "parent_type": "question",
+        "query": {"match": {"text": "tpus"}}}}})
+    # a3 is q2's child and lives in ANOTHER segment than q2
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["a3"]
+
+
+def test_parent_id(svc):
+    res = svc.search({"query": {"parent_id": {
+        "type": "answer", "id": "q1"}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["a1", "a2"]
+
+
+def test_join_with_bool_combination(svc):
+    res = svc.search({"query": {"bool": {
+        "must": [{"has_child": {"type": "answer",
+                                "query": {"match_all": {}}}}],
+        "filter": [{"term": {"jf": "question"}}]}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["q1", "q2"]
