@@ -173,7 +173,10 @@ class MiscReadActions:
         ctxs = []
         seg_idx = None
         for si, s in enumerate(reader.segments):
-            ctxs.append(SegmentContext(s, engine.mappers, segment_idx=si))
+            # reader= so join queries (has_child/has_parent) see sibling
+            # segments, exactly as in the served query phase
+            ctxs.append(SegmentContext(s, engine.mappers, segment_idx=si,
+                                       reader=reader))
             if s is seg:
                 seg_idx = si
         query = rewrite_knn(query, ctxs)

@@ -264,6 +264,65 @@ class DenseVectorFieldMapper(FieldMapper):
         return ParsedField(self.name, "vector", vector=vec)
 
 
+RANGE_TYPES = {"integer_range", "long_range", "float_range",
+               "double_range", "date_range"}
+
+
+class RangeFieldMapper(FieldMapper):
+    """Interval-valued fields (index/mapper/RangeFieldMapper.java):
+    a document stores {gte/gt, lte/lt}; queries test interval relations
+    (intersects/contains/within). Bounds live on internal ``#lo``/``#hi``
+    numeric companion columns (the same pattern as join's parent id)."""
+
+    has_doc_values = False
+
+    def __init__(self, name: str, params: Dict[str, Any],
+                 analysis: AnalysisRegistry, type_name: str = "long_range"):
+        super().__init__(name, params, analysis)
+        self.type_name = type_name
+
+    def _coerce(self, v: Any) -> float:
+        if self.type_name == "date_range":
+            return float(parse_date_millis(v))
+        return float(v)
+
+    def _one_bounds(self, value: Any) -> Tuple[float, float]:
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"range field [{self.name}] expects an object with "
+                f"gte/gt/lte/lt bounds")
+        try:
+            if "gte" in value:
+                lo = self._coerce(value["gte"])
+            elif "gt" in value:
+                lo = self._coerce(value["gt"])   # open bound approximated
+            else:
+                lo = -math.inf
+            if "lte" in value:
+                hi = self._coerce(value["lte"])
+            elif "lt" in value:
+                hi = self._coerce(value["lt"])
+            else:
+                hi = math.inf
+        except (TypeError, ValueError) as e:
+            raise MapperParsingError(
+                f"failed to parse range field [{self.name}]: {e}")
+        if lo > hi:
+            raise MapperParsingError(
+                f"range field [{self.name}] has gte > lte")
+        return lo, hi
+
+    def bounds(self, value: Any) -> Tuple[List[float], List[float]]:
+        """([lo...], [hi...]) — a doc may carry several ranges."""
+        values = value if isinstance(value, list) else [value]
+        pairs = [self._one_bounds(v) for v in values]
+        return [p[0] for p in pairs], [p[1] for p in pairs]
+
+    def parse(self, value: Any) -> ParsedField:
+        self.bounds(value)   # validate; companions store the numbers
+        return ParsedField(self.name, "terms", exact_terms=[])
+
+
 class JoinFieldMapper(FieldMapper):
     """Parent-child relations within one index
     (modules/parent-join ParentJoinFieldMapper analog).
@@ -437,6 +496,8 @@ _MAPPER_TYPES = {
 }
 for _num in ("long", "integer", "short", "byte", "double", "float", "half_float", "scaled_float"):
     _MAPPER_TYPES[_num] = _num  # sentinel; handled in build_mapper
+for _rng in RANGE_TYPES:
+    _MAPPER_TYPES[_rng] = _rng  # sentinel; handled in build_mapper
 
 NUMERIC_TYPES = frozenset(
     ("long", "integer", "short", "byte", "double", "float", "half_float",
@@ -450,6 +511,9 @@ def build_mapper(name: str, spec: Dict[str, Any], analysis: AnalysisRegistry) ->
     if factory is None:
         raise MapperParsingError(f"no handler for type [{type_name}] on field [{name}]")
     if isinstance(factory, str):
+        if factory in RANGE_TYPES:
+            return RangeFieldMapper(name, spec, analysis,
+                                    type_name=factory)
         return NumberFieldMapper(name, spec, analysis, type_name=factory)
     return factory(name, spec, analysis)
 
@@ -495,14 +559,21 @@ class MapperService:
         self._merge_props("", props)
         if "dynamic" in mapping:
             self.dynamic = _parse_dynamic(mapping["dynamic"])
-        # every join field gets an internal keyword companion carrying the
-        # parent id (never serialized; join queries read it)
+        # internal companion columns (never serialized): join parent ids,
+        # and range bounds as two numeric doc-value columns
         for name, m in list(self._mappers.items()):
             if m.type_name == "join":
                 companion = f"{name}#parent"
                 if companion not in self._mappers:
                     self._mappers[companion] = KeywordFieldMapper(
                         companion, {}, self.analysis)
+            elif m.type_name in RANGE_TYPES:
+                for suffix in ("#lo", "#hi"):
+                    companion = f"{name}{suffix}"
+                    if companion not in self._mappers:
+                        self._mappers[companion] = NumberFieldMapper(
+                            companion, {}, self.analysis,
+                            type_name="double")
 
     def _merge_props(self, prefix: str, props: Dict[str, Any]) -> None:
         for name, spec in props.items():
@@ -677,6 +748,16 @@ class MapperService:
                 companion = self._mappers.get(comp)
                 if companion is not None:
                     doc.fields[comp] = companion.parse(str(value["parent"]))
+            # feed range bound companions (lists align: lo[i] pairs with
+            # hi[i]; unbounded sides store +-inf, comparable like the
+            # query side's open bounds)
+            if mapper.type_name in RANGE_TYPES:
+                los, his = mapper.bounds(value)
+                for suffix, bound_list in (("#lo", los), ("#hi", his)):
+                    comp = self._mappers.get(f"{name}{suffix}")
+                    if comp is not None:
+                        doc.fields[f"{name}{suffix}"] = \
+                            comp.parse(bound_list)
             # feed text.keyword subfields
             kw = self._mappers.get(f"{name}.keyword")
             if kw is not None and mapper.type_name == "text":

@@ -77,6 +77,9 @@ class Range(Query):
     gte: Optional[Any] = None
     lt: Optional[Any] = None
     lte: Optional[Any] = None
+    # interval relation against RANGE fields (RangeFieldMapper):
+    # intersects (default) | within | contains
+    relation: str = "intersects"
     boost: float = 1.0
 
 
@@ -479,8 +482,13 @@ def _parse_terms(spec):
 
 def _parse_range(spec):
     fname, opts = _field_spec(spec, "gte")
+    relation = str(opts.get("relation", "intersects")).lower()
+    if relation not in ("intersects", "within", "contains"):
+        raise QueryParsingError(
+            f"unknown range relation [{relation}]")
     return Range(field=fname, gt=opts.get("gt"), gte=opts.get("gte"),
                  lt=opts.get("lt"), lte=opts.get("lte"),
+                 relation=relation,
                  boost=float(opts.get("boost", 1.0)))
 
 

@@ -29,7 +29,7 @@ from elasticsearch_tpu.ops import (
 )
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.utils.errors import QueryParsingError
-from elasticsearch_tpu.mapping.mappers import NUMERIC_TYPES
+from elasticsearch_tpu.mapping.mappers import NUMERIC_TYPES, RANGE_TYPES
 
 
 @dataclass
@@ -225,6 +225,10 @@ def _range_mask_host(ctx: SegmentContext, q: dsl.Range) -> np.ndarray:
 def _exists_mask_host(ctx: SegmentContext, field_name: str) -> np.ndarray:
     seg = ctx.segment
     n = seg.n_docs
+    # range fields store nothing under their own name — existence lives
+    # on the #lo bound companion column
+    if f"{field_name}#lo" in seg.doc_values:
+        return seg.doc_values[f"{field_name}#lo"].exists.copy()
     if field_name in seg.doc_values:
         return seg.doc_values[field_name].exists.copy()
     if field_name in seg.keywords:
@@ -573,7 +577,59 @@ def _h_terms(q: dsl.Terms, ctx: SegmentContext) -> Result:
     return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
 
 
+def _range_field_mask(ctx: SegmentContext, q: dsl.Range,
+                      mapper) -> np.ndarray:
+    """Interval relations against a RANGE field: the doc's [lo, hi]
+    bounds live on the #lo/#hi companion columns
+    (RangeFieldMapper.RangeType query semantics)."""
+    seg = ctx.segment
+    coerce = mapper._coerce
+    qlo = coerce(q.gte) if q.gte is not None else (
+        coerce(q.gt) if q.gt is not None else -np.inf)
+    qhi = coerce(q.lte) if q.lte is not None else (
+        coerce(q.lt) if q.lt is not None else np.inf)
+    lo_dv = seg.doc_values.get(f"{q.field}#lo")
+    hi_dv = seg.doc_values.get(f"{q.field}#hi")
+    if lo_dv is None or hi_dv is None:
+        return np.zeros(seg.n_docs, bool)
+
+    def relate(lo, hi) -> bool:
+        if q.relation == "within":
+            return lo >= qlo and hi <= qhi
+        if q.relation == "contains":
+            return lo <= qlo and hi >= qhi
+        return lo <= qhi and hi >= qlo   # intersects
+
+    lo = lo_dv.values.astype(np.float64)
+    hi = hi_dv.values.astype(np.float64)
+    exists = lo_dv.exists & hi_dv.exists
+    if q.relation == "within":
+        rel = (lo >= qlo) & (hi <= qhi)
+    elif q.relation == "contains":
+        rel = (lo <= qlo) & (hi >= qhi)
+    else:   # intersects
+        rel = (lo <= qhi) & (hi >= qlo)
+    mask = exists & rel
+    # multi-valued docs: ANY of the doc's ranges may satisfy the relation
+    # (lo.multi[d][i] pairs with hi.multi[d][i])
+    for d in set(lo_dv.multi) | set(hi_dv.multi):
+        los = lo_dv.multi.get(d, [lo[d]])
+        his = hi_dv.multi.get(d, [hi[d]])
+        mask[d] = any(relate(float(a), float(b))
+                      for a, b in zip(los, his))
+    return mask
+
+
 def _h_range(q: dsl.Range, ctx: SegmentContext) -> Result:
+    mapper = ctx.mappers.mapper(q.field)
+    if mapper is not None and \
+            getattr(mapper, "type_name", "") in RANGE_TYPES:
+        key = ("range_field", q.field, str(q.gt), str(q.gte), str(q.lt),
+               str(q.lte), q.relation)
+        mask_host = _cached_filter(
+            ctx, key, lambda: _range_field_mask(ctx, q, mapper))
+        mask = ctx.to_device_mask(mask_host) & ctx.live
+        return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
     key = ("range", q.field, str(q.gt), str(q.gte), str(q.lt), str(q.lte))
     mask_host = _cached_filter(ctx, key, lambda: _range_mask_host(ctx, q))
     mask = ctx.to_device_mask(mask_host) & ctx.live
@@ -1029,11 +1085,25 @@ def _h_has_parent(q: dsl.HasParent, ctx: SegmentContext) -> Result:
     matching_parents = _join_cache(
         ctx, ("has_parent", q.parent_type, repr(q.query)), build)
     seg = ctx.segment
-    kf = seg.keywords.get(f"{join_field}#parent")
-    mask_host = np.zeros(seg.n_docs, bool)
-    if kf is not None:
-        for pid in matching_parents:
-            mask_host[kf.docs_with_term(pid)] = True
+
+    def project():
+        # one CSR pass: docs whose #parent ordinal names a matching parent
+        kf = seg.keywords.get(f"{join_field}#parent")
+        mask_host = np.zeros(seg.n_docs, bool)
+        if kf is not None and matching_parents:
+            wanted = np.asarray(
+                [tid for term, tid in kf.terms.items()
+                 if term in matching_parents], np.int64)
+            if len(wanted):
+                counts = np.diff(kf.ord_offsets)
+                owner = np.repeat(np.arange(len(counts)), counts)
+                hit = np.isin(kf.ord_values, wanted)
+                mask_host[owner[hit]] = True
+        return mask_host
+
+    mask_host = _cached_filter(
+        ctx, ("has_parent_proj", join_field,
+              tuple(sorted(matching_parents))), project)
     mask = ctx.to_device_mask(mask_host) & ctx.live
     return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
 

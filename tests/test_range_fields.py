@@ -1,0 +1,99 @@
+"""Range field types (integer_range/date_range/...) + interval relations.
+
+Reference: index/mapper/RangeFieldMapper.java + range-query relation
+semantics (intersects/within/contains).
+"""
+
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import (
+    MapperParsingError, MapperService,
+)
+from elasticsearch_tpu.search.service import SearchService
+
+
+@pytest.fixture()
+def svc():
+    mappers = MapperService({"properties": {
+        "age": {"type": "integer_range"},
+        "when": {"type": "date_range"},
+    }})
+    engine = InternalEngine(mappers)
+    engine.index("r1", {"age": {"gte": 10, "lte": 20}})
+    engine.index("r2", {"age": {"gte": 15, "lte": 30}})
+    engine.index("r3", {"age": {"gte": 40, "lte": 50}})
+    engine.index("r4", {"when": {"gte": "2026-01-01T00:00:00Z",
+                                 "lte": "2026-06-30T00:00:00Z"}})
+    engine.refresh()
+    return SearchService(engine, index_name="ranges")
+
+
+def test_range_mapping_validation():
+    mappers = MapperService({"properties": {
+        "age": {"type": "integer_range"}}})
+    with pytest.raises(MapperParsingError):
+        mappers.parse_document("x", {"age": 5})           # not an object
+    with pytest.raises(MapperParsingError):
+        mappers.parse_document("x", {"age": {"gte": 9, "lte": 3}})
+    assert "#" not in str(mappers.to_mapping())
+
+
+def test_range_intersects_default(svc):
+    res = svc.search({"query": {"range": {"age": {"gte": 18,
+                                                  "lte": 25}}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["r1", "r2"]
+
+
+def test_range_within_and_contains(svc):
+    res = svc.search({"query": {"range": {"age": {
+        "gte": 5, "lte": 35, "relation": "within"}}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["r1", "r2"]
+    res = svc.search({"query": {"range": {"age": {
+        "gte": 16, "lte": 18, "relation": "contains"}}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == ["r1", "r2"]
+    res = svc.search({"query": {"range": {"age": {
+        "gte": 11, "lte": 14, "relation": "contains"}}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["r1"]
+
+
+def test_date_range_field(svc):
+    res = svc.search({"query": {"range": {"when": {
+        "gte": "2026-03-01T00:00:00Z", "lte": "2026-03-31T00:00:00Z"}}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["r4"]
+    res = svc.search({"query": {"range": {"when": {
+        "gte": "2027-01-01T00:00:00Z"}}}})
+    assert res["hits"]["total"]["value"] == 0
+
+
+def test_unbounded_side(svc):
+    mappers = MapperService({"properties": {
+        "v": {"type": "long_range"}}})
+    engine = InternalEngine(mappers)
+    engine.index("open", {"v": {"gte": 100}})   # unbounded above
+    engine.refresh()
+    s = SearchService(engine, index_name="u")
+    res = s.search({"query": {"range": {"v": {"gte": 1_000_000}}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["open"]
+    # an unbounded stored side satisfies contains with an unbounded query
+    res = s.search({"query": {"range": {"v": {
+        "gte": 200, "relation": "contains"}}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["open"]
+
+
+def test_exists_and_multi_valued_ranges(svc):
+    res = svc.search({"query": {"exists": {"field": "age"}}})
+    assert sorted(h["_id"] for h in res["hits"]["hits"]) == \
+        ["r1", "r2", "r3"]
+
+    mappers = MapperService({"properties": {
+        "v": {"type": "integer_range"}}})
+    engine = InternalEngine(mappers)
+    engine.index("m", {"v": [{"gte": 1, "lte": 2}, {"gte": 50, "lte": 60}]})
+    engine.refresh()
+    s = SearchService(engine, index_name="mv")
+    # matches via the SECOND range; the envelope gap [3, 49] must NOT match
+    res = s.search({"query": {"range": {"v": {"gte": 55, "lte": 58}}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["m"]
+    res = s.search({"query": {"range": {"v": {"gte": 10, "lte": 20}}}})
+    assert res["hits"]["total"]["value"] == 0
