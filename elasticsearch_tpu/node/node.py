@@ -169,6 +169,10 @@ class Node:
         from elasticsearch_tpu.xpack.watcher import WatcherService
         self.watcher_service = WatcherService(self)
 
+        from elasticsearch_tpu.xpack.ccr import CcrService, CcrShardActions
+        self.ccr_shard_actions = CcrShardActions(self)
+        self.ccr_service = CcrService(self)
+
     # ------------------------------------------------------------------
 
     def _applied_state(self) -> ClusterState:
@@ -233,8 +237,10 @@ class Node:
         self.ilm_service.start()
         self.transform_service.start()
         self.watcher_service.start()
+        self.ccr_service.start()
 
     def stop(self) -> None:
+        self.ccr_service.stop()
         self.watcher_service.stop()
         self.transform_service.stop()
         self.ilm_service.stop()

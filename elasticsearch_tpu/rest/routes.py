@@ -420,6 +420,23 @@ def build_controller(client: NodeClient) -> RestController:
         done(200, client.node.watcher_service.get(req.params["id"]))
     r("GET", "/_watcher/watch/{id}", watch_get)
 
+    # -- CCR (x-pack/plugin/ccr REST surface) -----------------------------
+
+    def ccr_follow(req: RestRequest, done: DoneFn) -> None:
+        client.node.ccr_service.follow(req.params["index"], req.body or {},
+                                       wrap_client_cb(done))
+    r("PUT", "/{index}/_ccr/follow", ccr_follow)
+
+    def ccr_unfollow(req: RestRequest, done: DoneFn) -> None:
+        client.node.ccr_service.unfollow(req.params["index"],
+                                         wrap_client_cb(done))
+    r("POST", "/{index}/_ccr/unfollow", ccr_unfollow)
+
+    def ccr_stats(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.node.ccr_service.stats(req.params.get("index")))
+    r("GET", "/_ccr/stats", ccr_stats)
+    r("GET", "/{index}/_ccr/stats", ccr_stats)
+
     # -- observability: hot threads + explicit reroute --------------------
 
     def hot_threads(req: RestRequest, done: DoneFn) -> None:

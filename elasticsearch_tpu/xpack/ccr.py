@@ -1,0 +1,393 @@
+"""CCR-lite: a follower index continuously replicating a leader index.
+
+Reference: x-pack/plugin/ccr — ShardFollowNodeTask reads batches of
+translog operations from the leader shard (by seqno range) and replays
+them on the follower. This build implements the same shape within one
+cluster's transport (the remote-cluster hop is a documented limitation —
+the TCP address book would carry it, but cross-cluster connection
+registration is not built):
+
+  1. PUT /{follower}/_ccr/follow creates the follower from the leader's
+     mappings/settings and registers the follow in cluster-state custom
+     metadata. The elected master's poll loop then BOOTSTRAPS: refresh
+     the leader (buffered ops must become segment-visible), capture each
+     leader shard's max seqno, and copy every live doc shard-by-shard
+     through a cursor-paged transport scan (translogs trim on flush, so
+     history alone cannot rebuild a shard).
+  2. after bootstrap the loop fetches translog ops above each shard
+     checkpoint from the node holding the leader primary and replays
+     index/delete ops through the ordinary bulk path (idempotent by id).
+     Checkpoints only advance after a batch applies.
+  3. if the leader trimmed past a checkpoint (flush between polls), the
+     fetch reports the gap and the follower re-bootstraps — debounced to
+     one re-bootstrap at a time — instead of silently diverging.
+
+Runtime state (checkpoints, counters) is master-local like the
+reference's persistent-task state; a master failover restarts from a
+fresh bootstrap.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError, ResourceNotFoundError,
+)
+
+logger = logging.getLogger(__name__)
+
+SECTION = "ccr_follows"
+POLL_INTERVAL = 2.0
+BATCH_OPS = 1000
+SCAN_BATCH = 1000
+
+CCR_FETCH = "indices:data/read/ccr/fetch_ops"
+CCR_SCAN = "indices:data/read/ccr/scan"
+
+
+class CcrShardActions:
+    """Data-node side: translog ops by seqno + cursor-paged doc scans."""
+
+    def __init__(self, node) -> None:
+        self.node = node
+        node.transport_service.register_handler(CCR_FETCH, self._on_fetch)
+        node.transport_service.register_handler(CCR_SCAN, self._on_scan)
+
+    def _on_fetch(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
+        shard = self.node.indices_service.shard(req["index"], req["shard"])
+        from_seqno = int(req["from_seqno"])
+        translog = shard.engine.translog
+        max_seq = shard.engine.tracker.max_seqno
+        ops: List[Dict[str, Any]] = []
+        if translog is not None:
+            ops = sorted((op.to_json()
+                          for op in translog.read_all(min_seqno=from_seqno)),
+                         key=lambda o: o["seqno"])[:BATCH_OPS]
+        # seqnos are DENSE per shard (every op is logged), so history is
+        # complete iff the first retained op is exactly from_seqno
+        gap = from_seqno <= max_seq and (
+            not ops or ops[0]["seqno"] > from_seqno)
+        return {"ops": ops, "max_seq_no": max_seq, "gap": gap}
+
+    def _on_scan(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
+        """Live docs in (segment, doc) order from a cursor — the
+        bootstrap copy (RecoverySourceHandler's phase-1 analog, shipping
+        _source instead of segment files)."""
+        shard = self.node.indices_service.shard(req["index"], req["shard"])
+        reader = shard.engine.acquire_reader()
+        after_seg, after_doc = req.get("cursor") or [0, -1]
+        batch = int(req.get("batch", SCAN_BATCH))
+        docs: List[Dict[str, Any]] = []
+        cursor = None
+        for si in range(int(after_seg), len(reader.segments)):
+            seg = reader.segments[si]
+            live = reader.live_masks[si]
+            start = int(after_doc) + 1 if si == int(after_seg) else 0
+            for d in range(start, seg.n_docs):
+                if not live[d]:
+                    continue
+                if len(docs) >= batch:
+                    cursor = [si, d - 1]
+                    break
+                docs.append({"id": seg.ids[d],
+                             "source": seg.sources[d] or {}})
+            if cursor is not None:
+                break
+        if cursor is None and docs and len(docs) >= batch:
+            cursor = [len(reader.segments), -1]
+        return {"docs": docs, "cursor": cursor}
+
+
+class CcrService:
+    """Master-side follow coordinator (ShardFollowNodeTask analog)."""
+
+    def __init__(self, node) -> None:
+        self.node = node
+        self._running = False
+        self._timer = None
+        # follower -> {"checkpoints": {shard: seqno}, "bootstrapping",
+        # "ops", "bootstraps"} — master-local runtime state
+        self._state: Dict[str, Dict[str, Any]] = {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self._schedule()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._timer is not None:
+            self._timer.cancel()
+
+    def _schedule(self) -> None:
+        if not self._running:
+            return
+        self._timer = self.node.scheduler.schedule(POLL_INTERVAL, self._tick)
+
+    def _tick(self) -> None:
+        if not self._running:
+            return
+        try:
+            if self.node.coordinator.mode == "LEADER":
+                self.poll_all()
+        except Exception:  # noqa: BLE001
+            logger.exception("ccr tick failed")
+        self._schedule()
+
+    def _defs(self) -> Dict[str, Any]:
+        return dict(self.node._applied_state()
+                    .metadata.custom.get(SECTION, {}))
+
+    # -- API --------------------------------------------------------------
+
+    def follow(self, follower_index: str, body: Dict[str, Any],
+               on_done) -> None:
+        leader = (body or {}).get("leader_index")
+        if not leader:
+            on_done(None, IllegalArgumentError(
+                "follow requires [leader_index]"))
+            return
+        state = self.node._applied_state()
+        try:
+            leader_meta = state.metadata.index(leader)
+        except Exception as e:  # noqa: BLE001
+            on_done(None, e)
+            return
+        settings = {k: v for k, v in dict(leader_meta.settings).items()
+                    if not k.startswith("index.lifecycle")}
+        settings["number_of_shards"] = leader_meta.number_of_shards
+        settings["number_of_replicas"] = int(
+            (body or {}).get("replicas", 0))
+        settings["index.ccr.following"] = leader
+
+        def created(_resp, err):
+            if err is not None:
+                on_done(None, err)
+                return
+            from elasticsearch_tpu.action.admin import PUT_CUSTOM
+            self.node.master_client.execute(
+                PUT_CUSTOM, {"section": SECTION, "name": follower_index,
+                             "body": {"leader_index": leader_meta.name,
+                                      "paused": False}},
+                lambda resp, err2: on_done(
+                    {"acknowledged": True,
+                     "follower_index": follower_index}
+                    if err2 is None else None, err2))
+        # the MASTER's poll loop bootstraps (its state is authoritative;
+        # bootstrapping here would populate the wrong node's checkpoints
+        # when the REST call lands on a non-master)
+        self.node.client.create_index(follower_index, {
+            "settings": settings,
+            "mappings": dict(leader_meta.mappings)}, created)
+
+    def unfollow(self, follower_index: str, on_done) -> None:
+        from elasticsearch_tpu.action.admin import DELETE_CUSTOM
+        self._state.pop(follower_index, None)
+        self.node.master_client.execute(
+            DELETE_CUSTOM, {"section": SECTION, "name": follower_index},
+            on_done)
+
+    def stats(self, follower_index: Optional[str] = None) -> Dict[str, Any]:
+        defs = self._defs()
+        if follower_index is not None and follower_index not in defs:
+            raise ResourceNotFoundError(
+                f"no follow for index [{follower_index}]")
+        out = []
+        for fid, d in sorted(defs.items()):
+            if follower_index is not None and fid != follower_index:
+                continue
+            st = self._state.get(fid, {})
+            out.append({"follower_index": fid, **d,
+                        "checkpoints": dict(st.get("checkpoints", {})),
+                        "ops_replayed": st.get("ops", 0),
+                        "bootstraps": st.get("bootstraps", 0),
+                        "bootstrapping": bool(st.get("bootstrapping"))})
+        return {"follows": out}
+
+    # -- replication ------------------------------------------------------
+
+    def _following(self, follower: str) -> bool:
+        """Guards every async callback: unfollow may land mid-flight."""
+        return follower in self._defs()
+
+    def poll_all(self) -> None:
+        for follower, d in self._defs().items():
+            if d.get("paused"):
+                continue
+            st = self._state.get(follower)
+            if st is None or st.get("bootstrapping"):
+                if st is None:
+                    self._bootstrap(follower, d["leader_index"])
+                continue
+            self._poll_follow(follower, d["leader_index"])
+
+    def _leader_primary_node(self, leader: str, sid: int) -> Optional[str]:
+        state = self.node._applied_state()
+        try:
+            sr = state.routing_table.index(leader).primary(sid)
+        except Exception:  # noqa: BLE001
+            return None
+        return sr.node_id if sr.active else None
+
+    # -- bootstrap --------------------------------------------------------
+
+    def _bootstrap(self, follower: str, leader: str) -> None:
+        """Refresh leader -> capture checkpoints -> cursor-scan every
+        shard into the follower. Checkpoints COMMIT only on success; one
+        bootstrap at a time per follow (gap storms debounce here)."""
+        st = self._state.setdefault(follower, {})
+        if st.get("bootstrapping"):
+            return
+        st["bootstrapping"] = True
+        st["bootstraps"] = st.get("bootstraps", 0) + 1
+        state = self.node._applied_state()
+        if not state.metadata.has_index(leader):
+            st["bootstrapping"] = False
+            return
+        n_shards = state.metadata.index(leader).number_of_shards
+
+        def fail(reason: Any) -> None:
+            logger.warning("ccr bootstrap [%s] failed: %s", follower, reason)
+            st["bootstrapping"] = False   # poll retries via gap detection
+
+        def refreshed(_resp, err=None):
+            if err is not None:
+                fail(err)
+                return
+            self._fetch_max_seqnos(leader, n_shards, with_maxes)
+
+        def with_maxes(maxes: Dict[int, int]) -> None:
+            if any(v is None for v in maxes.values()):
+                fail("max seqno unavailable")
+                return
+            self._scan_shards(follower, leader, n_shards, 0, {}, maxes)
+
+        self.node.client.refresh(leader, refreshed)
+
+    def _fetch_max_seqnos(self, leader: str, n_shards: int, cb) -> None:
+        maxes: Dict[int, Optional[int]] = {}
+        pending = {"n": n_shards}
+        for sid in range(n_shards):
+            node_id = self._leader_primary_node(leader, sid)
+
+            def one(resp, err, sid=sid):
+                maxes[sid] = None if err or resp is None \
+                    else int(resp.get("max_seq_no", -1))
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    cb(maxes)
+            if node_id is None:
+                one(None, IllegalArgumentError("no primary"))
+                continue
+            self.node.transport_service.send_request(
+                node_id, CCR_FETCH,
+                {"index": leader, "shard": sid, "from_seqno": 1 << 62},
+                one, timeout=30.0)
+
+    def _scan_shards(self, follower: str, leader: str, n_shards: int,
+                     sid: int, cursor_state: Dict[str, Any],
+                     maxes: Dict[int, int]) -> None:
+        st = self._state.get(follower)
+        if st is None or not self._following(follower):
+            return   # unfollowed mid-bootstrap
+        if sid >= n_shards:
+            # COMMIT: every shard copied; ops from here replay via polls
+            st["checkpoints"] = {str(s): m for s, m in maxes.items()}
+            st["bootstrapping"] = False
+            return
+        node_id = self._leader_primary_node(leader, sid)
+        if node_id is None:
+            st["bootstrapping"] = False
+            return
+        cursor = cursor_state.get("cursor")
+
+        def on_page(resp, err):
+            if err is not None or resp is None:
+                st["bootstrapping"] = False
+                logger.warning("ccr bootstrap [%s] scan failed: %s",
+                               follower, err)
+                return
+            docs = resp.get("docs", [])
+            items = [{"action": "index", "index": follower,
+                      "id": d["id"], "source": d["source"]}
+                     for d in docs]
+
+            def advance(_bulk=None) -> None:
+                nxt = resp.get("cursor")
+                if nxt is None:
+                    self._scan_shards(follower, leader, n_shards,
+                                      sid + 1, {}, maxes)
+                else:
+                    self._scan_shards(follower, leader, n_shards, sid,
+                                      {"cursor": nxt}, maxes)
+            if items:
+                self.node.bulk_action.execute(items, advance)
+            else:
+                advance()
+        self.node.transport_service.send_request(
+            node_id, CCR_SCAN,
+            {"index": leader, "shard": sid, "cursor": cursor,
+             "batch": SCAN_BATCH}, on_page, timeout=60.0)
+
+    # -- incremental polls -------------------------------------------------
+
+    def _poll_follow(self, follower: str, leader: str) -> None:
+        state = self.node._applied_state()
+        if not state.metadata.has_index(leader) or \
+                not state.metadata.has_index(follower):
+            return
+        n_shards = state.metadata.index(leader).number_of_shards
+        st = self._state[follower]
+        checkpoints = st.setdefault("checkpoints", {})
+        for sid in range(n_shards):
+            node_id = self._leader_primary_node(leader, sid)
+            if node_id is None:
+                continue
+            ckpt = int(checkpoints.get(str(sid), -1))
+
+            def on_ops(resp, err, sid=sid):
+                if err is not None or resp is None or \
+                        not self._following(follower) or \
+                        st.get("bootstrapping"):
+                    return
+                if resp.get("gap"):
+                    logger.warning(
+                        "ccr follow [%s] shard %s: history gap, "
+                        "re-bootstrapping", follower, sid)
+                    self._bootstrap(follower, leader)
+                    return
+                ops = resp.get("ops", [])
+                if not ops:
+                    return
+                items = []
+                top = int(checkpoints.get(str(sid), -1))
+                for op in ops:
+                    top = max(top, int(op["seqno"]))
+                    if op["op"] == "index":
+                        items.append({"action": "index",
+                                      "index": follower,
+                                      "id": op["id"],
+                                      "source": op.get("source") or {},
+                                      "routing": op.get("routing")})
+                    elif op["op"] == "delete":
+                        items.append({"action": "delete",
+                                      "index": follower,
+                                      "id": op["id"],
+                                      "routing": op.get("routing")})
+
+                def applied(_resp) -> None:
+                    # checkpoint advances only after the batch APPLIED
+                    if self._following(follower):
+                        checkpoints[str(sid)] = top
+                        st["ops"] = st.get("ops", 0) + len(items)
+                if items:
+                    self.node.bulk_action.execute(items, applied)
+                else:
+                    checkpoints[str(sid)] = top
+            self.node.transport_service.send_request(
+                node_id, CCR_FETCH,
+                {"index": leader, "shard": sid, "from_seqno": ckpt + 1},
+                on_ops, timeout=30.0)

@@ -1,0 +1,87 @@
+"""CCR-lite: follower index replicating a leader.
+
+Reference: x-pack/plugin/ccr (ShardFollowNodeTask translog-ops
+replication with bootstrap + gap recovery).
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    # a data path gives shards real translogs — the history CCR reads
+    c = InProcessCluster(n_nodes=2, seed=37, data_path=str(tmp_path))
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _search_ids(cluster, client, index):
+    cluster.call(lambda cb: client.refresh(index, cb))
+    res, err = cluster.call(lambda cb: client.search(
+        index, {"query": {"match_all": {}}, "size": 100}, cb))
+    assert err is None, err
+    return sorted(h["_id"] for h in res["hits"]["hits"])
+
+
+def test_follow_bootstraps_and_replicates(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("leader", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"v": {"type": "integer"}}}}, cb)))
+    cluster.ensure_green("leader")
+    for i in range(6):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "leader", f"d{i}", {"v": i}, cb)))
+    cluster.call(lambda cb: client.refresh("leader", cb))
+
+    node = cluster.master()
+    resp = _ok(*cluster.call(lambda cb: node.ccr_service.follow(
+        "copy", {"leader_index": "leader"}, cb)))
+    assert resp == {"acknowledged": True, "follower_index": "copy"}
+    cluster.ensure_green("copy")
+    # the master's poll loop bootstraps asynchronously
+    cluster.scheduler.run_for(10.0)
+    assert _search_ids(cluster, client, "copy") == \
+        [f"d{i}" for i in range(6)]
+    assert node.ccr_service.stats("copy")["follows"][0]["bootstraps"] == 1
+    # follower inherited the leader's mapping
+    meta = node._applied_state().metadata.index("copy")
+    assert meta.mappings["properties"]["v"]["type"] == "integer"
+    assert meta.settings["index.ccr.following"] == "leader"
+
+    # continuous: new writes and deletes flow through the poll loop
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "leader", "d6", {"v": 6}, cb)))
+    _ok(*cluster.call(lambda cb: client.delete_doc("leader", "d0", cb)))
+    cluster.scheduler.run_for(10.0)
+    assert _search_ids(cluster, client, "copy") == \
+        [f"d{i}" for i in range(1, 7)]
+
+    stats = node.ccr_service.stats("copy")["follows"][0]
+    assert stats["leader_index"] == "leader"
+    assert stats["ops_replayed"] >= 2
+
+    # unfollow stops replication
+    _ok(*cluster.call(lambda cb: node.ccr_service.unfollow("copy", cb)))
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "leader", "d7", {"v": 7}, cb)))
+    cluster.scheduler.run_for(10.0)
+    assert "d7" not in _search_ids(cluster, client, "copy")
+
+
+def test_follow_missing_leader_errors(cluster):
+    node = cluster.master()
+    resp, err = cluster.call(lambda cb: node.ccr_service.follow(
+        "f", {"leader_index": "nope"}, cb))
+    assert err is not None
+    resp, err = cluster.call(lambda cb: node.ccr_service.follow(
+        "f", {}, cb))
+    assert err is not None
