@@ -39,6 +39,14 @@ def idf(doc_count: int, doc_freq: int) -> float:
     return float(np.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5)))
 
 
+# gather-chunk size: block lists longer than this are processed by a scan
+# accumulating into the dense score vector, bounding HLO temps to
+# CHUNK x BLOCK per step instead of QB x BLOCK for the whole query (a
+# 64-query batch over a 1M-doc segment otherwise materializes ~17GB of
+# gather temps and OOMs HBM)
+GATHER_CHUNK = 4096
+
+
 @partial(jax.jit, static_argnames=("n_docs_pad", "k1", "b"))
 def bm25_block_scores(block_docs: jnp.ndarray,     # [NB, BLOCK] int32, -1 pad
                       block_tfs: jnp.ndarray,      # [NB, BLOCK] f32
@@ -50,17 +58,35 @@ def bm25_block_scores(block_docs: jnp.ndarray,     # [NB, BLOCK] int32, -1 pad
                       k1: float = DEFAULT_K1,
                       b: float = DEFAULT_B) -> jnp.ndarray:
     """Dense BM25 scores [n_docs_pad] for one query over one segment."""
-    docs = block_docs[block_idx]            # [QB, BLOCK]
-    tfs = block_tfs[block_idx]              # [QB, BLOCK]
-    valid = docs >= 0
-    safe_docs = jnp.where(valid, docs, 0)
-    dl = doc_lens[safe_docs]                # [QB, BLOCK]
-    norm = k1 * (1.0 - b + b * dl / avgdl)
-    contrib = block_weight[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
-    contrib = jnp.where(valid, contrib, 0.0)
+
+    def score_chunk(scores, chunk):
+        bi, bw = chunk
+        docs = block_docs[bi]               # [C, BLOCK]
+        tfs = block_tfs[bi]                 # [C, BLOCK]
+        valid = docs >= 0
+        safe_docs = jnp.where(valid, docs, 0)
+        dl = doc_lens[safe_docs]            # [C, BLOCK]
+        norm = k1 * (1.0 - b + b * dl / avgdl)
+        contrib = bw[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+        contrib = jnp.where(valid, contrib, 0.0)
+        return scores.at[safe_docs.reshape(-1)].add(
+            contrib.reshape(-1), mode="drop")
+
+    qb = block_idx.shape[0]
     scores = jnp.zeros((n_docs_pad,), jnp.float32)
-    scores = scores.at[safe_docs.reshape(-1)].add(
-        contrib.reshape(-1), mode="drop")
+    if qb <= GATHER_CHUNK:
+        return score_chunk(scores, (block_idx, block_weight))
+    # qb buckets above GATHER_CHUNK are multiples of it (pow2 / x8 ladder)
+    n_chunks = qb // GATHER_CHUNK
+    idx_c = block_idx[: n_chunks * GATHER_CHUNK].reshape(
+        n_chunks, GATHER_CHUNK)
+    w_c = block_weight[: n_chunks * GATHER_CHUNK].reshape(
+        n_chunks, GATHER_CHUNK)
+    scores, _ = jax.lax.scan(
+        lambda s, c: (score_chunk(s, c), None), scores, (idx_c, w_c))
+    rem = qb - n_chunks * GATHER_CHUNK
+    if rem:
+        scores = score_chunk(scores, (block_idx[-rem:], block_weight[-rem:]))
     return scores
 
 
@@ -102,15 +128,17 @@ P1_BUCKET = 32
 
 
 def qb_bucket(n: int, minimum: int = 32) -> int:
-    """Gather-list bucket size: a coarse x8 ladder instead of pow2.
+    """Gather-list bucket size: a coarse x8 ladder, x2 above 16K.
 
     Every distinct gather shape costs a full XLA compile (~seconds); pow2
     buckets churn with each query batch. The x8 ladder wastes at most 8x
-    gather padding (device cost: <1ms) to cap the shape space at ~5
-    compiles total — compile amortization dominates padding waste."""
+    gather padding (device cost: <1ms) to cap the shape space at ~4
+    compiles; above 16K blocks the padding waste dominates compile
+    amortization (scan steps are real work), so the ladder tightens to
+    x2. All rungs stay multiples of GATHER_CHUNK for the scan reshape."""
     b = max(minimum, 1)
     while b < n:
-        b *= 8
+        b *= 8 if b < 16384 else 2
     return b
 
 

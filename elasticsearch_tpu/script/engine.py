@@ -427,8 +427,13 @@ class _Interpreter:
             if isinstance(obj, (list, tuple)):
                 return list(obj)
             return [obj]
-        if attr in ("length", "size") and hasattr(obj, "__len__"):
+        if attr == "length" and hasattr(obj, "__len__"):
+            # painless: .length is a PROPERTY on arrays/strings
             return len(obj)
+        if attr == "size" and hasattr(obj, "__len__"):
+            # painless: .size() is a METHOD on collections — return it
+            # bound so `doc['f'].size()` calls it instead of calling an int
+            return lambda: len(obj)
         if attr in _VALUE_METHODS:
             return self._method(obj, attr)
         raise ScriptException(f"unknown attribute [{attr}]")

@@ -10,7 +10,7 @@ text_expansion, rank_feature) the reference snapshot lacks.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from elasticsearch_tpu.utils.errors import QueryParsingError
 
@@ -415,6 +415,158 @@ class Nested(Query):
     boost: float = 1.0
 
 
+# ---------------------------------------------------------------------------
+# span family (index/query/Span*QueryBuilder analogs) — position-based
+# matching evaluated by search/spans.py
+# ---------------------------------------------------------------------------
+
+class SpanQuery(Query):
+    """Base for span nodes; every span node names exactly one field."""
+
+
+@dataclass
+class SpanTerm(SpanQuery):
+    field: str = ""
+    value: str = ""
+    boost: float = 1.0
+
+
+@dataclass
+class SpanNear(SpanQuery):
+    clauses: List[SpanQuery] = field(default_factory=list)
+    slop: int = 0
+    in_order: bool = True
+    boost: float = 1.0
+
+
+@dataclass
+class SpanOr(SpanQuery):
+    clauses: List[SpanQuery] = field(default_factory=list)
+    boost: float = 1.0
+
+
+@dataclass
+class SpanNot(SpanQuery):
+    include: SpanQuery = None
+    exclude: SpanQuery = None
+    pre: int = 0
+    post: int = 0
+    boost: float = 1.0
+
+
+@dataclass
+class SpanFirst(SpanQuery):
+    match: SpanQuery = None
+    end: int = 0
+    boost: float = 1.0
+
+
+@dataclass
+class SpanContaining(SpanQuery):
+    big: SpanQuery = None
+    little: SpanQuery = None
+    boost: float = 1.0
+
+
+@dataclass
+class SpanWithin(SpanQuery):
+    big: SpanQuery = None
+    little: SpanQuery = None
+    boost: float = 1.0
+
+
+@dataclass
+class SpanMulti(SpanQuery):
+    """Wraps a multi-term query (prefix/wildcard/regexp/fuzzy) as spans
+    (SpanMultiTermQueryWrapper analog)."""
+    match: Query = None
+    boost: float = 1.0
+
+
+@dataclass
+class Intervals(Query):
+    """Minimal-interval matching (index/query/IntervalQueryBuilder analog).
+    ``rule`` is the raw source tree (match/any_of/all_of/prefix/wildcard
+    with max_gaps/ordered/filter), interpreted by search/spans.py."""
+    # NOTE: rule must precede the attribute named "field" (it shadows
+    # dataclasses.field for the rest of the class body)
+    rule: Dict[str, Any] = field(default_factory=dict)
+    field: str = ""
+    boost: float = 1.0
+
+
+@dataclass
+class QueryString(Query):
+    """Lucene-syntax query string (QueryStringQueryBuilder analog). Parsed
+    into a Query tree at rewrite time by search/querystring.py."""
+    query: str = ""
+    default_field: Optional[str] = None
+    fields: List[str] = field(default_factory=list)
+    default_operator: str = "or"
+    boost: float = 1.0
+
+
+@dataclass
+class SimpleQueryString(Query):
+    """Fault-tolerant simplified syntax (SimpleQueryStringBuilder analog)."""
+    query: str = ""
+    fields: List[str] = field(default_factory=list)
+    default_operator: str = "or"
+    boost: float = 1.0
+
+
+@dataclass
+class TermsSet(Query):
+    """Docs matching >= N of the terms, N read per-doc from
+    minimum_should_match_field or computed by a script
+    (TermsSetQueryBuilder analog)."""
+    # terms must precede the "field" attribute (dataclasses.field shadow)
+    terms: List[Any] = field(default_factory=list)
+    field: str = ""
+    minimum_should_match_field: Optional[str] = None
+    minimum_should_match_script: Optional[str] = None
+    boost: float = 1.0
+
+
+@dataclass
+class DistanceFeature(Query):
+    """Score decays with distance from an origin on a date or geo_point
+    field: boost * pivot / (pivot + distance)
+    (DistanceFeatureQueryBuilder analog)."""
+    field: str = ""
+    origin: Any = None
+    pivot: Any = None
+    boost: float = 1.0
+
+
+@dataclass
+class Pinned(Query):
+    """Promoted ids rank first, organic results after
+    (x-pack search-business-rules PinnedQueryBuilder analog)."""
+    ids: List[str] = field(default_factory=list)
+    organic: Query = None
+    boost: float = 1.0
+
+
+@dataclass
+class ScriptQuery(Query):
+    """Filter context scripted per document over doc values
+    (index/query/ScriptQueryBuilder analog)."""
+    source: str = ""
+    params: Dict[str, Any] = field(default_factory=dict)
+    boost: float = 1.0
+
+
+@dataclass
+class GeoPolygon(Query):
+    """Docs whose geo_point lies inside the closed polygon
+    (GeoPolygonQueryBuilder analog)."""
+    # points must precede the "field" attribute (dataclasses.field shadow)
+    points: List[Tuple[float, float]] = field(default_factory=list)  # (lat, lon)
+    field: str = ""
+    boost: float = 1.0
+
+
 def parse_query(body: Any) -> Query:
     """Parse the object under "query" into a Query tree."""
     if body is None:
@@ -626,7 +778,126 @@ _PARSERS = {
     "text_expansion": _parse_text_expansion,
     "script_score": _parse_script_score,
     "function_score": _parse_function_score,
+    "span_term": lambda spec: _parse_span_term(spec),
+    "span_near": lambda spec: SpanNear(
+        clauses=[_parse_span(c) for c in spec.get("clauses", [])],
+        slop=int(spec.get("slop", 0)),
+        in_order=bool(spec.get("in_order", True)),
+        boost=float(spec.get("boost", 1.0))),
+    "span_or": lambda spec: SpanOr(
+        clauses=[_parse_span(c) for c in spec.get("clauses", [])],
+        boost=float(spec.get("boost", 1.0))),
+    "span_not": lambda spec: SpanNot(
+        include=_parse_span(spec["include"]),
+        exclude=_parse_span(spec["exclude"]),
+        pre=int(spec.get("pre", spec.get("dist", 0))),
+        post=int(spec.get("post", spec.get("dist", 0))),
+        boost=float(spec.get("boost", 1.0))),
+    "span_first": lambda spec: SpanFirst(
+        match=_parse_span(spec["match"]),
+        end=int(spec.get("end", 0)),
+        boost=float(spec.get("boost", 1.0))),
+    "span_containing": lambda spec: SpanContaining(
+        big=_parse_span(spec["big"]), little=_parse_span(spec["little"]),
+        boost=float(spec.get("boost", 1.0))),
+    "span_within": lambda spec: SpanWithin(
+        big=_parse_span(spec["big"]), little=_parse_span(spec["little"]),
+        boost=float(spec.get("boost", 1.0))),
+    "span_multi": lambda spec: SpanMulti(
+        match=parse_query(spec["match"]),
+        boost=float(spec.get("boost", 1.0))),
+    "intervals": lambda spec: _parse_intervals(spec),
+    "query_string": lambda spec: QueryString(
+        query=str(spec.get("query", "")),
+        default_field=spec.get("default_field"),
+        fields=list(spec.get("fields", [])),
+        default_operator=str(spec.get("default_operator", "or")).lower(),
+        boost=float(spec.get("boost", 1.0))),
+    "simple_query_string": lambda spec: SimpleQueryString(
+        query=str(spec.get("query", "")),
+        fields=list(spec.get("fields", [])),
+        default_operator=str(spec.get("default_operator", "or")).lower(),
+        boost=float(spec.get("boost", 1.0))),
+    "terms_set": lambda spec: _parse_terms_set(spec),
+    "distance_feature": lambda spec: DistanceFeature(
+        field=spec["field"], origin=spec.get("origin"),
+        pivot=spec.get("pivot"),
+        boost=float(spec.get("boost", 1.0))),
+    "pinned": lambda spec: Pinned(
+        ids=[str(i) for i in spec.get("ids", [])],
+        organic=parse_query(spec.get("organic")),
+        boost=float(spec.get("boost", 1.0))),
+    "script": lambda spec: ScriptQuery(
+        source=(spec.get("script") or {}).get("source", "")
+        if isinstance(spec.get("script"), dict) else str(spec.get("script", "")),
+        params=((spec.get("script") or {}).get("params", {})
+                if isinstance(spec.get("script"), dict) else {}),
+        boost=float(spec.get("boost", 1.0))),
+    "wrapper": lambda spec: _parse_wrapper(spec),
+    "geo_polygon": lambda spec: _parse_geo_polygon(spec),
 }
+
+
+def _parse_span_term(spec) -> SpanTerm:
+    fname, opts = _field_spec(spec, "value")
+    return SpanTerm(field=fname, value=str(opts.get("value", "")),
+                    boost=float(opts.get("boost", 1.0)))
+
+
+def _parse_span(body: Any) -> SpanQuery:
+    q = parse_query(body)
+    if not isinstance(q, (SpanQuery,)):
+        raise QueryParsingError(
+            f"expected a span query, got [{type(q).__name__}]")
+    return q
+
+
+def _parse_intervals(spec) -> Intervals:
+    fname, rule = _field_spec(spec, "match")
+    boost = float(rule.pop("boost", 1.0)) if isinstance(rule, dict) else 1.0
+    if not isinstance(rule, dict) or len(rule) != 1:
+        raise QueryParsingError(
+            "intervals requires exactly one rule (match/any_of/all_of/"
+            "prefix/wildcard)")
+    return Intervals(field=fname, rule=rule, boost=boost)
+
+
+def _parse_terms_set(spec) -> TermsSet:
+    fname, opts = _field_spec(spec, "terms")
+    script = opts.get("minimum_should_match_script")
+    if isinstance(script, dict):
+        script = script.get("source", "")
+    return TermsSet(
+        field=fname, terms=list(opts.get("terms", [])),
+        minimum_should_match_field=opts.get("minimum_should_match_field"),
+        minimum_should_match_script=script,
+        boost=float(opts.get("boost", 1.0)))
+
+
+def _parse_wrapper(spec) -> Query:
+    import base64
+    import json as _json
+    raw = spec.get("query")
+    if raw is None:
+        raise QueryParsingError("wrapper requires [query]")
+    try:
+        body = _json.loads(base64.b64decode(raw))
+    except Exception as e:  # noqa: BLE001 — surface as a parse error
+        raise QueryParsingError(f"failed to decode wrapper query: {e}")
+    return parse_query(body)
+
+
+def _parse_geo_polygon(spec) -> GeoPolygon:
+    opts = {k: v for k, v in spec.items()
+            if k not in ("boost", "validation_method")}
+    if len(opts) != 1:
+        raise QueryParsingError("geo_polygon requires exactly one field")
+    (fname, poly), = opts.items()
+    pts = [_parse_geo_point(p) for p in (poly or {}).get("points", [])]
+    if len(pts) < 3:
+        raise QueryParsingError("geo_polygon requires at least 3 points")
+    return GeoPolygon(field=fname, points=pts,
+                      boost=float(spec.get("boost", 1.0)))
 
 
 def _field_value(spec, key):

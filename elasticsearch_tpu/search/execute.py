@@ -1288,8 +1288,282 @@ def _h_function_score(q: dsl.FunctionScore, ctx: SegmentContext) -> Result:
     return jnp.where(mask, scores * q.boost, 0.0), mask
 
 
+def _h_span(q: dsl.SpanQuery, ctx: SegmentContext) -> Result:
+    """Position-based span matching (search/spans.py); matched docs score
+    a constant boost (documented divergence: the reference scores spans
+    with a sloppy-freq similarity)."""
+    from elasticsearch_tpu.search.spans import span_field, span_match_mask
+    fname = span_field(q)
+    pf = ctx.segment.postings.get(fname) if fname else None
+    if pf is None:
+        return ctx.zeros(), ctx.none_mask()
+    mask_host = _cached_filter(
+        ctx, ("span", repr(q)), lambda: span_match_mask(
+            q, pf, ctx.segment.n_docs))
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_intervals(q: dsl.Intervals, ctx: SegmentContext) -> Result:
+    from elasticsearch_tpu.search.spans import intervals_match_mask
+    pf = ctx.segment.postings.get(q.field)
+    if pf is None:
+        return ctx.zeros(), ctx.none_mask()
+    analyzer = ctx.search_analyzer(q.field)
+    mask_host = _cached_filter(
+        ctx, ("intervals", q.field, repr(q.rule)),
+        lambda: intervals_match_mask(q, pf, analyzer, ctx.segment.n_docs))
+    mask = ctx.to_device_mask(mask_host) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_query_string(q: dsl.QueryString, ctx: SegmentContext) -> Result:
+    from elasticsearch_tpu.search.querystring import parse_query_string
+    return execute(parse_query_string(q), ctx)
+
+
+def _h_simple_query_string(q: dsl.SimpleQueryString,
+                           ctx: SegmentContext) -> Result:
+    from elasticsearch_tpu.search.querystring import (
+        parse_simple_query_string,
+    )
+    return execute(parse_simple_query_string(q), ctx)
+
+
+def _h_terms_set(q: dsl.TermsSet, ctx: SegmentContext) -> Result:
+    """Count matching terms per doc; require >= the per-doc threshold from
+    minimum_should_match_field, or from the script evaluated with
+    params.num_terms (TermsSetQueryBuilder analog)."""
+    seg = ctx.segment
+
+    def build():
+        count = np.zeros(seg.n_docs, np.int32)
+        for v in q.terms:
+            count += _term_mask_host(ctx, q.field, v).astype(np.int32)
+        if q.minimum_should_match_field:
+            dv = seg.doc_values.get(q.minimum_should_match_field)
+            if dv is None:
+                return np.zeros(seg.n_docs, bool)
+            required = dv.values.astype(np.int64)
+            mask = dv.exists & (count >= np.maximum(required, 1)) \
+                & (required > 0)
+        elif q.minimum_should_match_script is not None:
+            from elasticsearch_tpu.script import default_engine
+            src = q.minimum_should_match_script
+            if "return" not in src:
+                # expression-style scripts implicitly return their value
+                # in this context (TermsSetQueryBuilder script contract)
+                src = f"return ({src})"
+            val = default_engine.execute(
+                src, {"params": {"num_terms": len(q.terms)}})
+            required = max(int(val), 1)
+            mask = count >= required
+        else:
+            mask = count >= 1
+        return mask
+
+    key = ("terms_set", q.field, tuple(map(str, q.terms)),
+           q.minimum_should_match_field, q.minimum_should_match_script)
+    mask = ctx.to_device_mask(_cached_filter(ctx, key, build)) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+def _h_distance_feature(q: dsl.DistanceFeature, ctx: SegmentContext) -> Result:
+    """score = boost * pivot / (pivot + distance(doc, origin)) over a date
+    or geo_point field (DistanceFeatureQueryBuilder analog)."""
+    seg = ctx.segment
+    if q.origin is None or q.pivot is None:
+        raise QueryParsingError("distance_feature requires [origin] and [pivot]")
+    t = ctx.mappers.field_type(q.field)
+    if t == "geo_point":
+        pts = _geo_column(ctx, q.field)
+        lat = np.radians(pts[:, 0])
+        lon = np.radians(pts[:, 1])
+        qlat, qlon = np.radians(dsl._parse_geo_point(q.origin))
+        a = np.sin((lat - qlat) / 2) ** 2 + \
+            np.cos(lat) * np.cos(qlat) * np.sin((lon - qlon) / 2) ** 2
+        dist = 2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+        pivot = dsl.parse_distance_m(q.pivot)
+        exists = ~np.isnan(dist)
+        dist = np.nan_to_num(dist, nan=np.inf)
+    else:
+        dv = seg.doc_values.get(q.field)
+        if dv is None:
+            return ctx.zeros(), ctx.none_mask()
+        origin = parse_date_millis(q.origin) if t == "date" \
+            else float(q.origin)
+        pivot = _parse_time_millis(q.pivot) if t == "date" \
+            else float(q.pivot)
+        dist = np.abs(dv.values.astype(np.float64) - origin)
+        exists = dv.exists
+    scores_host = np.zeros(ctx.n_docs_pad, np.float32)
+    vals = q.boost * pivot / (pivot + dist)
+    scores_host[: seg.n_docs][exists[: seg.n_docs]] = \
+        vals[: seg.n_docs][exists[: seg.n_docs]]
+    mask = ctx.to_device_mask(exists[: seg.n_docs]) & ctx.live
+    return jnp.where(mask, jnp.asarray(scores_host), 0.0), mask
+
+
+_TIME_UNITS_MS = {"d": 86_400_000.0, "h": 3_600_000.0, "m": 60_000.0,
+                  "s": 1000.0, "ms": 1.0, "w": 7 * 86_400_000.0}
+
+
+def _parse_time_millis(raw: Any) -> float:
+    if isinstance(raw, (int, float)):
+        return float(raw)
+    s = str(raw).strip().lower()
+    for suffix in ("ms", "w", "d", "h", "m", "s"):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * _TIME_UNITS_MS[suffix]
+    return float(s)
+
+
+def _h_pinned(q: dsl.Pinned, ctx: SegmentContext) -> Result:
+    """Pinned ids rank first in list order, above every organic hit
+    (x-pack PinnedQueryBuilder: promoted docs get descending constant
+    scores above the organic score ceiling)."""
+    scores, mask = execute(q.organic, ctx) if q.organic is not None \
+        else (ctx.zeros(), ctx.none_mask())
+    # cap organic scores below the pinned band; the rank step must exceed
+    # the float32 ulp at PIN_BASE (~2.4e31) or ranks collapse together
+    PIN_BASE = np.float32(2e38)
+    PIN_STEP = np.float32(1e32)
+    scores = jnp.minimum(scores, jnp.float32(1e38))
+    pin_scores = np.zeros(ctx.n_docs_pad, np.float32)
+    pin_mask = np.zeros(ctx.n_docs_pad, bool)
+    for rank, doc_id in enumerate(q.ids):
+        d = ctx.segment.id_to_doc.get(doc_id)
+        if d is not None:
+            pin_scores[d] = PIN_BASE - rank * PIN_STEP
+            pin_mask[d] = True
+    pin_mask_dev = jnp.asarray(pin_mask) & ctx.live
+    # boost applies to the organic half only: multiplying the pin band
+    # would overflow f32 (2e38 * boost > max) and collapse pin ordering
+    scores = jnp.where(pin_mask_dev, jnp.asarray(pin_scores),
+                       scores * q.boost)
+    return scores, mask | pin_mask_dev
+
+
+def _h_script_query(q: dsl.ScriptQuery, ctx: SegmentContext) -> Result:
+    """Filter-context script per live doc with doc-values access
+    (ScriptQueryBuilder analog; scripts run in the sandboxed host
+    interpreter, so the mask is cached hard on the segment)."""
+    from elasticsearch_tpu.script import default_engine
+    seg = ctx.segment
+
+    def build():
+        engine = default_engine
+        src = q.source
+        if "return" not in src and ";" not in src:
+            # expression-style filter scripts implicitly return their value
+            src = f"return ({src})"
+        compiled = engine.compile(src)
+        mask = np.zeros(seg.n_docs, bool)
+        columns = {name: dv for name, dv in seg.doc_values.items()}
+        for d in range(seg.n_docs):
+            doc = _ScriptDocView(seg, columns, d)
+            try:
+                mask[d] = bool(compiled.execute(
+                    {"doc": doc, "params": dict(q.params)}))
+            except Exception:  # noqa: BLE001 — a failing doc just no-matches
+                mask[d] = False
+        return mask
+
+    key = ("script_query", q.source, repr(sorted(q.params.items())))
+    mask = ctx.to_device_mask(_cached_filter(ctx, key, build)) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
+class _ScriptDocView:
+    """doc['field'].value / doc['field'].values view over segment columns."""
+
+    class _Field:
+        __slots__ = ("values",)
+
+        def __init__(self, values):
+            self.values = values
+
+        @property
+        def value(self):
+            return self.values[0] if self.values else None
+
+        @property
+        def empty(self):
+            return not self.values
+
+        def size(self):
+            return len(self.values)
+
+        def __len__(self):
+            return len(self.values)
+
+        def __getitem__(self, i):
+            return self.values[i]
+
+    def __init__(self, seg, columns, d: int):
+        self._seg = seg
+        self._columns = columns
+        self._d = d
+
+    def __getitem__(self, name: str):
+        dv = self._columns.get(name)
+        if dv is not None and dv.exists[self._d]:
+            # dv.multi holds the FULL value list for multi-valued docs
+            # (values[d] is its first entry), matching phase.py/fetch.py
+            multi = dv.multi.get(self._d)
+            vals = [float(x) for x in multi] if multi is not None \
+                else [float(dv.values[self._d])]
+            return self._Field(vals)
+        kf = self._seg.keywords.get(name)
+        if kf is not None:
+            ords = kf.ord_values[kf.ord_offsets[self._d]:
+                                 kf.ord_offsets[self._d + 1]]
+            return self._Field([kf.term_list[int(o)] for o in ords])
+        return self._Field([])
+
+    def containsKey(self, name: str) -> bool:  # noqa: N802 — painless API
+        return len(self[name].values) > 0
+
+
+def _h_geo_polygon(q: dsl.GeoPolygon, ctx: SegmentContext) -> Result:
+    def build():
+        pts = _geo_column(ctx, q.field)
+        lat, lon = pts[:, 0], pts[:, 1]
+        n = len(q.points)
+        inside = np.zeros(len(lat), bool)
+        # ray casting; NaN rows compare False throughout and stay outside
+        j = n - 1
+        for i in range(n):
+            yi, xi = q.points[i]
+            yj, xj = q.points[j]
+            cond = ((yi > lat) != (yj > lat)) & \
+                (lon < (xj - xi) * (lat - yi) / ((yj - yi) + 1e-12) + xi)
+            inside ^= np.where(np.isnan(lat), False, cond)
+            j = i
+        return ctx.to_device_mask(inside)
+    mask = ctx.segment.cached_filter(
+        ("geo_polygon", q.field, tuple(q.points)), build) & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
 _HANDLERS = {
     KnnBound: _h_knn_bound,
+    dsl.SpanTerm: _h_span,
+    dsl.SpanNear: _h_span,
+    dsl.SpanOr: _h_span,
+    dsl.SpanNot: _h_span,
+    dsl.SpanFirst: _h_span,
+    dsl.SpanContaining: _h_span,
+    dsl.SpanWithin: _h_span,
+    dsl.SpanMulti: _h_span,
+    dsl.Intervals: _h_intervals,
+    dsl.QueryString: _h_query_string,
+    dsl.SimpleQueryString: _h_simple_query_string,
+    dsl.TermsSet: _h_terms_set,
+    dsl.DistanceFeature: _h_distance_feature,
+    dsl.Pinned: _h_pinned,
+    dsl.ScriptQuery: _h_script_query,
+    dsl.GeoPolygon: _h_geo_polygon,
     dsl.MatchAll: _h_match_all,
     dsl.MatchNone: _h_match_none,
     dsl.Match: _h_match,

@@ -1,0 +1,449 @@
+"""Lucene query-string syntax -> Query tree.
+
+The analog of the reference's QueryStringQueryBuilder
+(server/src/main/java/org/elasticsearch/index/query/QueryStringQueryBuilder.java,
+backed by Lucene's classic QueryParser) and SimpleQueryStringBuilder
+(SimpleQueryStringBuilder.java, backed by SimpleQueryParser). The reference
+delegates to ANTLR/JavaCC grammars compiling to Lucene Queries; here a small
+recursive-descent parser compiles directly to the dsl.Query tree the device
+executor already understands.
+
+Supported query_string syntax: field:term, AND/OR/NOT/&&/||/!, +/- clause
+prefixes, (grouping), "phrases"[~slop], term^boost, term~[edits],
+wild*cards, prefix*, /regex/, [a TO b] and {a TO b} ranges, field:>=N
+shorthands, _exists_:field, and multi-field expansion with per-field boosts
+("title^2"). default_operator applies between bare adjacent clauses.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Tuple
+
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.utils.errors import QueryParsingError
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RX = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<and>AND\b|&&)
+  | (?P<or>OR\b|\|\|)
+  | (?P<not>NOT\b|!)
+  | (?P<to>TO\b)
+  | (?P<lparen>\()
+  | (?P<rparen>\))
+  | (?P<lbracket>\[)
+  | (?P<rbracket>\])
+  | (?P<lbrace>\{)
+  | (?P<rbrace>\})
+  | (?P<plus>\+)
+  | (?P<minus>-)
+  | (?P<phrase>"(?:\\.|[^"\\])*")
+  | (?P<regex>/(?:\\.|[^/\\])+/)
+  # '-' negates only at clause START; inside a term it is literal text
+  # (dates 2020-01-01, compounds), so the first char excludes '-' and the
+  # rest allow it
+  | (?P<term>(?:\\.|[^\s()\[\]{}"+\-!^~:])(?:\\.|[^\s()\[\]{}"+!^~:])*)
+  | (?P<colon>:)
+  | (?P<caret>\^)
+  | (?P<tilde>~)
+""", re.VERBOSE)
+
+
+class _Tok:
+    __slots__ = ("kind", "text")
+
+    def __init__(self, kind: str, text: str):
+        self.kind = kind
+        self.text = text
+
+    def __repr__(self):  # pragma: no cover — debug aid
+        return f"{self.kind}({self.text!r})"
+
+
+def _tokenize(s: str) -> List[_Tok]:
+    out: List[_Tok] = []
+    i = 0
+    while i < len(s):
+        m = _TOKEN_RX.match(s, i)
+        if m is None:
+            raise QueryParsingError(
+                f"cannot parse query string at offset {i}: {s[i:i+10]!r}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        out.append(_Tok(kind, m.group()))
+    return out
+
+
+def _unescape(s: str) -> str:
+    return re.sub(r"\\(.)", r"\1", s)
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+class _Parser:
+    def __init__(self, toks: List[_Tok], fields: List[str],
+                 default_operator: str):
+        self.toks = toks
+        self.i = 0
+        self.fields = fields                   # ["title^2", "body"]
+        self.default_operator = default_operator
+
+    def peek(self) -> Optional[_Tok]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> _Tok:
+        t = self.peek()
+        if t is None:
+            raise QueryParsingError("unexpected end of query string")
+        self.i += 1
+        return t
+
+    # query := clause ((AND|OR|bare) clause)*
+    # Classic-QueryParser operator folding: AND promotes BOTH neighbors to
+    # required; OR demotes its left neighbor only if the default operator
+    # (not an explicit AND or +) made it required.
+    def parse_query(self) -> dsl.Query:
+        # items: [occur, query, explicit] — explicit marks +/-/AND-promoted
+        items: List[List[Any]] = []
+        pending: Optional[str] = None
+
+        while True:
+            t = self.peek()
+            if t is None or t.kind == "rparen":
+                break
+            if t.kind == "and":
+                self.next()
+                pending = "and"
+                if items and items[-1][0] == "should":
+                    items[-1][0] = "must"
+                    items[-1][2] = True
+                continue
+            if t.kind == "or":
+                self.next()
+                pending = "or"
+                if items and items[-1][0] == "must" and not items[-1][2]:
+                    items[-1][0] = "should"
+                continue
+            if t.kind == "not":
+                self.next()
+                q = self.parse_clause()[0]
+                items.append(["must_not", q, True])
+                pending = None
+                continue
+            q, occur = self.parse_clause()
+            explicit = occur != "should"
+            if occur == "should":
+                op = pending or self.default_operator
+                if op == "and":
+                    occur = "must"
+                    explicit = pending == "and"
+            items.append([occur, q, explicit])
+            pending = None
+
+        must = [q for o, q, _ in items if o == "must"]
+        should = [q for o, q, _ in items if o == "should"]
+        must_not = [q for o, q, _ in items if o == "must_not"]
+        if len(must) == 1 and not should and not must_not:
+            return must[0]
+        if len(should) == 1 and not must and not must_not:
+            return should[0]
+        if not must and not should and not must_not:
+            return dsl.MatchAll()
+        return dsl.Bool(must=must, should=should, must_not=must_not)
+
+    # clause := [+|-] [field:] atom [^boost] [~fuzz]
+    def parse_clause(self) -> Tuple[dsl.Query, str]:
+        occur = "should"
+        t = self.peek()
+        if t is not None and t.kind == "plus":
+            self.next()
+            occur = "must"
+        elif t is not None and t.kind == "minus":
+            self.next()
+            occur = "must_not"
+
+        field: Optional[str] = None
+        t = self.peek()
+        if t is not None and t.kind == "term" and \
+                self.i + 1 < len(self.toks) and \
+                self.toks[self.i + 1].kind == "colon":
+            field = _unescape(self.next().text)
+            self.next()                         # consume ':'
+
+        q = self.parse_atom(field)
+        q = self.parse_suffixes(q)
+        return q, occur
+
+    def parse_suffixes(self, q: dsl.Query) -> dsl.Query:
+        while True:
+            t = self.peek()
+            if t is not None and t.kind == "caret":
+                self.next()
+                b = self.next()
+                try:
+                    q.boost = q.boost * float(b.text)
+                except ValueError:
+                    raise QueryParsingError(f"bad boost [{b.text}]")
+            elif t is not None and t.kind == "tilde":
+                self.next()
+                edits: Any = "AUTO"
+                nxt = self.peek()
+                if nxt is not None and nxt.kind == "term" and \
+                        re.fullmatch(r"\d+(\.\d+)?", nxt.text):
+                    edits = int(float(self.next().text))
+                if isinstance(q, dsl.Term):
+                    q = dsl.Fuzzy(field=q.field, value=str(q.value),
+                                  fuzziness=edits, boost=q.boost)
+                elif isinstance(q, dsl.Match):
+                    q = dsl.Fuzzy(field=q.field, value=q.text,
+                                  fuzziness=edits, boost=q.boost)
+                elif isinstance(q, dsl.MatchPhrase):
+                    q.slop = edits if isinstance(edits, int) else 0
+                elif isinstance(q, (dsl.Bool, dsl.DisMax)):
+                    pass                        # slop on groups: ignore
+            else:
+                return q
+
+    def _field_specs(self, field: Optional[str]) -> List[Tuple[str, float]]:
+        """Target (field, boost) pairs for an unqualified or qualified atom."""
+        if field is not None:
+            return [(field, 1.0)]
+        if self.fields:
+            out = []
+            for f in self.fields:
+                name, _, b = f.partition("^")
+                out.append((name, float(b) if b else 1.0))
+            return out
+        return [("*", 1.0)]     # all-fields fallback (resolved at execute)
+
+    def _leaf(self, make) -> dsl.Query:
+        """Build the leaf over every target field, dis_max over many.
+        Match against the all-fields fallback "*" becomes a wildcard
+        multi_match (QueryParserHelper.resolveMappingFields analog)."""
+        specs = self.current_specs
+        leaves = [make(name, boost) for name, boost in specs]
+        leaves = [dsl.MultiMatch(fields=["*"], text=leaf.text,
+                                 boost=leaf.boost)
+                  if isinstance(leaf, dsl.Match) and leaf.field == "*"
+                  else leaf
+                  for leaf in leaves]
+        if len(leaves) == 1:
+            return leaves[0]
+        return dsl.DisMax(queries=leaves, tie_breaker=0.0)
+
+    def _range_bound(self) -> str:
+        """One range endpoint; a leading '-' token means a negative bound."""
+        t = self.next()
+        neg = ""
+        if t.kind == "minus":
+            neg = "-"
+            t = self.next()
+        if t.kind != "term":
+            raise QueryParsingError(
+                f"expected range bound, got {t!r}")
+        return neg + _unescape(t.text)
+
+    def parse_atom(self, field: Optional[str]) -> dsl.Query:
+        self.current_specs = self._field_specs(field)
+        t = self.next()
+        if t.kind == "lparen":
+            # field:(a b) — scoped group: parse with narrowed fields
+            saved = self.fields
+            if field is not None:
+                self.fields = [field]
+            try:
+                q = self.parse_query()
+            finally:
+                self.fields = saved
+            t = self.peek()
+            if t is None or t.kind != "rparen":
+                raise QueryParsingError("missing closing parenthesis")
+            self.next()
+            return q
+        if t.kind == "phrase":
+            text = _unescape(t.text[1:-1])
+            return self._leaf(lambda f, b: dsl.MatchPhrase(
+                field=f, text=text, boost=b))
+        if t.kind == "regex":
+            pattern = _unescape(t.text[1:-1])
+            return self._leaf(lambda f, b: dsl.Regexp(
+                field=f, value=pattern, boost=b))
+        if t.kind in ("lbracket", "lbrace"):
+            lo_incl = t.kind == "lbracket"
+            lo = self._range_bound()
+            to = self.next()
+            if to.kind != "to":
+                raise QueryParsingError("range requires TO")
+            hi = self._range_bound()
+            close = self.next()
+            if close.kind not in ("rbracket", "rbrace"):
+                raise QueryParsingError("unterminated range")
+            hi_incl = close.kind == "rbracket"
+            fname = self.current_specs[0][0]
+            kw = {}
+            if lo != "*":
+                kw["gte" if lo_incl else "gt"] = lo
+            if hi != "*":
+                kw["lte" if hi_incl else "lt"] = hi
+            return dsl.Range(field=fname, **kw)
+        if t.kind == "term":
+            raw = t.text
+            # field:>=10 shorthands
+            m = re.match(r"^(>=|<=|>|<)(.+)$", raw)
+            if m and field is not None:
+                op, val = m.groups()
+                kw = {{">": "gt", ">=": "gte", "<": "lt", "<=": "lte"}[op]:
+                      _unescape(val)}
+                return dsl.Range(field=field, **kw)
+            text = _unescape(raw)
+            if field == "_exists_":
+                return dsl.Exists(field=text)
+            if "*" in raw or "?" in raw:
+                if raw.endswith("*") and "*" not in raw[:-1] and \
+                        "?" not in raw:
+                    prefix = text[:-1]
+                    return self._leaf(lambda f, b: dsl.Prefix(
+                        field=f, value=prefix, boost=b))
+                return self._leaf(lambda f, b: dsl.Wildcard(
+                    field=f, value=text, boost=b))
+            return self._leaf(lambda f, b: dsl.Match(
+                field=f, text=text, boost=b))
+        raise QueryParsingError(f"unexpected token {t!r} in query string")
+
+
+def parse_query_string(q: "dsl.QueryString") -> dsl.Query:
+    fields = list(q.fields)
+    if q.default_field and not fields:
+        fields = [q.default_field]
+    toks = _tokenize(q.query)
+    if not toks:
+        return dsl.MatchNone()
+    parser = _Parser(toks, fields, q.default_operator)
+    parsed = parser.parse_query()
+    if parser.peek() is not None:
+        raise QueryParsingError(
+            f"trailing input in query string at token {parser.peek()!r}")
+    parsed.boost = parsed.boost * q.boost
+    return parsed
+
+
+# ---------------------------------------------------------------------------
+# simple_query_string — never raises on malformed input (lenient grammar)
+# ---------------------------------------------------------------------------
+
+_SIMPLE_RX = re.compile(r"""
+    (?P<phrase>"(?:\\.|[^"\\])*"(?:~\d+)?)
+  | (?P<lparen>\()
+  | (?P<rparen>\))
+  | (?P<pipe>\|)
+  | (?P<plus>\+)
+  | (?P<minus>-)
+  | (?P<term>[^\s()|+\-"]+)
+  | (?P<ws>\s+)
+""", re.VERBOSE)
+
+
+def parse_simple_query_string(q: "dsl.SimpleQueryString") -> dsl.Query:
+    """+ (AND), | (OR), - (NOT), "phrase", prefix*, ( ) grouping; any
+    syntax error degrades to treating the offending character as text
+    (SimpleQueryParser's defining behavior)."""
+    fields = q.fields or ["*"]
+
+    def leaf(text: str) -> dsl.Query:
+        specs = []
+        for f in fields:
+            name, _, b = f.partition("^")
+            specs.append((name, float(b) if b else 1.0))
+
+        def make(name: str, boost: float) -> dsl.Query:
+            slop = 0
+            if text.startswith('"'):
+                body = text[1:]
+                m = re.search(r'"(?:~(\d+))?$', text)
+                body = re.sub(r'"(?:~\d+)?$', "", body)
+                if m and m.group(1):
+                    slop = int(m.group(1))
+                return dsl.MatchPhrase(field=name, text=_unescape(body),
+                                       slop=slop, boost=boost)
+            if text.endswith("*"):
+                return dsl.Prefix(field=name, value=_unescape(text[:-1]),
+                                  boost=boost)
+            return dsl.Match(field=name, text=_unescape(text), boost=boost)
+
+        leaves = [make(n, b) for n, b in specs]
+        if len(leaves) == 1:
+            return leaves[0]
+        return dsl.DisMax(queries=leaves)
+
+    tokens: List[Tuple[str, str]] = []
+    for m in _SIMPLE_RX.finditer(q.query):
+        if m.lastgroup != "ws":
+            tokens.append((m.lastgroup, m.group()))
+
+    def parse_group(i: int) -> Tuple[dsl.Query, int]:
+        must: List[dsl.Query] = []
+        should: List[dsl.Query] = []
+        must_not: List[dsl.Query] = []
+        negate_next = False
+        require_next = False
+        or_pending = False
+
+        def commit(node: dsl.Query) -> None:
+            nonlocal negate_next, require_next, or_pending
+            if negate_next:
+                must_not.append(node)
+            elif require_next or (q.default_operator == "and"
+                                  and not or_pending):
+                must.append(node)
+            else:
+                should.append(node)
+            negate_next = require_next = False
+            or_pending = False
+
+        while i < len(tokens):
+            kind, text = tokens[i]
+            if kind == "rparen":
+                i += 1
+                break
+            if kind == "lparen":
+                node, i = parse_group(i + 1)
+                commit(node)
+                continue
+            if kind == "pipe":
+                or_pending = True
+                # a | b: demote the left neighbor required by default-AND
+                if must and q.default_operator == "and":
+                    should.append(must.pop())
+                i += 1
+                continue
+            if kind == "plus":
+                require_next = True
+                i += 1
+                continue
+            if kind == "minus":
+                negate_next = True
+                i += 1
+                continue
+            commit(leaf(text))
+            i += 1
+
+        if len(must) == 1 and not should and not must_not:
+            return must[0], i
+        if len(should) == 1 and not must and not must_not:
+            return should[0], i
+        if not must and not should and not must_not:
+            return dsl.MatchAll(), i
+        return dsl.Bool(must=must, should=should, must_not=must_not), i
+
+    parsed, _ = parse_group(0)
+    parsed.boost = parsed.boost * q.boost
+    return parsed

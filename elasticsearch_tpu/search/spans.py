@@ -1,0 +1,373 @@
+"""Span and interval matching over positional postings.
+
+The analog of Lucene's SpanQuery family (reference
+server/src/main/java/org/elasticsearch/index/query/SpanNearQueryBuilder.java
+and friends) and the minimal-interval queries
+(index/query/IntervalQueryBuilder.java). Lucene streams spans through
+iterator chains; here segments are immutable columnar arrays and candidate
+sets are tiny after the host-side postings AND, so each doc's spans are
+materialized as (start, end) lists — end exclusive — and combined
+structurally. The per-(query, segment) match mask is cached on the segment
+like every other filter.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.utils.errors import QueryParsingError
+
+Span = Tuple[int, int]          # (start, end) — end exclusive
+
+# product cap for near-combination enumeration; beyond it we fall back to a
+# greedy scan which can only under-match pathological position patterns
+_MAX_COMBOS = 100_000
+
+
+# ---------------------------------------------------------------------------
+# span tree evaluation
+# ---------------------------------------------------------------------------
+
+def span_field(q: dsl.SpanQuery) -> Optional[str]:
+    """The single field a span tree targets (all clauses must agree)."""
+    if isinstance(q, dsl.SpanTerm):
+        return q.field
+    if isinstance(q, dsl.SpanNear):
+        for c in q.clauses:
+            f = span_field(c)
+            if f:
+                return f
+    if isinstance(q, dsl.SpanOr):
+        for c in q.clauses:
+            f = span_field(c)
+            if f:
+                return f
+    if isinstance(q, dsl.SpanNot):
+        return span_field(q.include)
+    if isinstance(q, dsl.SpanFirst):
+        return span_field(q.match)
+    if isinstance(q, (dsl.SpanContaining, dsl.SpanWithin)):
+        return span_field(q.little) or span_field(q.big)
+    if isinstance(q, dsl.SpanMulti):
+        inner = q.match
+        return getattr(inner, "field", None)
+    return None
+
+
+def _expand_multi(q: dsl.Query, pf) -> List[str]:
+    """Expand the multi-term query inside span_multi against the term dict."""
+    if isinstance(q, dsl.Prefix):
+        return [t for t in pf.terms if t.startswith(q.value)]
+    if isinstance(q, dsl.Wildcard):
+        rx = re.compile(fnmatch.translate(q.value))
+        return [t for t in pf.terms if rx.match(t)]
+    if isinstance(q, dsl.Regexp):
+        rx = re.compile(q.value)
+        return [t for t in pf.terms if rx.fullmatch(t)]
+    if isinstance(q, dsl.Fuzzy):
+        from elasticsearch_tpu.search.execute import (
+            _fuzziness_to_edits, _levenshtein_within,
+        )
+        k = _fuzziness_to_edits(q.fuzziness, q.value)
+        return [t for t in pf.terms if _levenshtein_within(t, q.value, k)]
+    raise QueryParsingError(
+        f"span_multi supports prefix/wildcard/regexp/fuzzy, got "
+        f"[{type(q).__name__}]")
+
+
+def candidate_docs(q: dsl.SpanQuery, pf) -> Set[int]:
+    """Docs that could possibly match — a superset, built from postings."""
+    if isinstance(q, dsl.SpanTerm):
+        docs, _ = pf.postings_for(q.value)
+        return set(docs.tolist())
+    if isinstance(q, dsl.SpanNear):
+        cand: Optional[Set[int]] = None
+        for c in q.clauses:
+            s = candidate_docs(c, pf)
+            cand = s if cand is None else (cand & s)
+            if not cand:
+                return set()
+        return cand or set()
+    if isinstance(q, dsl.SpanOr):
+        out: Set[int] = set()
+        for c in q.clauses:
+            out |= candidate_docs(c, pf)
+        return out
+    if isinstance(q, dsl.SpanNot):
+        return candidate_docs(q.include, pf)
+    if isinstance(q, dsl.SpanFirst):
+        return candidate_docs(q.match, pf)
+    if isinstance(q, dsl.SpanContaining):
+        return candidate_docs(q.big, pf) & candidate_docs(q.little, pf)
+    if isinstance(q, dsl.SpanWithin):
+        return candidate_docs(q.big, pf) & candidate_docs(q.little, pf)
+    if isinstance(q, dsl.SpanMulti):
+        out = set()
+        for t in _expand_multi(q.match, pf):
+            docs, _ = pf.postings_for(t)
+            out.update(docs.tolist())
+        return out
+    raise QueryParsingError(f"unsupported span node [{type(q).__name__}]")
+
+
+def spans_for(q: dsl.SpanQuery, pf, doc: int) -> List[Span]:
+    """All matching (start, end) spans of the node in one document."""
+    if isinstance(q, dsl.SpanTerm):
+        return [(int(p), int(p) + 1) for p in pf.positions_for(q.value, doc)]
+    if isinstance(q, dsl.SpanNear):
+        per_clause = [spans_for(c, pf, doc) for c in q.clauses]
+        if any(not s for s in per_clause):
+            return []
+        return _near_spans(per_clause, q.slop, q.in_order)
+    if isinstance(q, dsl.SpanOr):
+        out: List[Span] = []
+        for c in q.clauses:
+            out.extend(spans_for(c, pf, doc))
+        return sorted(set(out))
+    if isinstance(q, dsl.SpanNot):
+        inc = spans_for(q.include, pf, doc)
+        exc = spans_for(q.exclude, pf, doc)
+        out = []
+        for s, e in inc:
+            lo, hi = s - q.pre, e + q.post
+            if not any(xs < hi and xe > lo for xs, xe in exc):
+                out.append((s, e))
+        return out
+    if isinstance(q, dsl.SpanFirst):
+        return [(s, e) for s, e in spans_for(q.match, pf, doc) if e <= q.end]
+    if isinstance(q, dsl.SpanContaining):
+        big = spans_for(q.big, pf, doc)
+        little = spans_for(q.little, pf, doc)
+        return [(s, e) for s, e in big
+                if any(s <= ls and le <= e for ls, le in little)]
+    if isinstance(q, dsl.SpanWithin):
+        big = spans_for(q.big, pf, doc)
+        little = spans_for(q.little, pf, doc)
+        return [(ls, le) for ls, le in little
+                if any(s <= ls and le <= e for s, e in big)]
+    if isinstance(q, dsl.SpanMulti):
+        out = []
+        for t in _expand_multi(q.match, pf):
+            out.extend((int(p), int(p) + 1)
+                       for p in pf.positions_for(t, doc))
+        return sorted(set(out))
+    raise QueryParsingError(f"unsupported span node [{type(q).__name__}]")
+
+
+def _near_spans(per_clause: List[List[Span]], slop: int,
+                in_order: bool) -> List[Span]:
+    """Combine one span per clause into enclosing spans within slop.
+
+    slop counts the positions NOT covered by the sub-spans inside the
+    enclosing span (Lucene NearSpans semantics): width - sum(lengths).
+    """
+    total = 1
+    for s in per_clause:
+        total *= len(s)
+        if total > _MAX_COMBOS:
+            return _near_spans_greedy(per_clause, slop, in_order)
+    out: Set[Span] = set()
+
+    def rec(idx: int, chosen: List[Span]) -> None:
+        if idx == len(per_clause):
+            if in_order:
+                for a, b in zip(chosen, chosen[1:]):
+                    if b[0] < a[1]:
+                        return
+            lo = min(s for s, _ in chosen)
+            hi = max(e for _, e in chosen)
+            covered = sum(e - s for s, e in chosen)
+            if (hi - lo) - covered <= slop:
+                out.add((lo, hi))
+            return
+        for sp in per_clause[idx]:
+            rec(idx + 1, chosen + [sp])
+
+    rec(0, [])
+    return sorted(out)
+
+
+def _near_spans_greedy(per_clause: List[List[Span]], slop: int,
+                       in_order: bool) -> List[Span]:
+    """Bounded fallback: for each span of the first clause, greedily pick
+    the nearest span of each later clause. Sound (never false-positives),
+    may under-match adversarial layouts."""
+    out: Set[Span] = set()
+    for first in per_clause[0]:
+        chosen = [first]
+        ok = True
+        for spans in per_clause[1:]:
+            if in_order:
+                nxt = [s for s in spans if s[0] >= chosen[-1][1]]
+                if not nxt:
+                    ok = False
+                    break
+                chosen.append(min(nxt, key=lambda s: s[0]))
+            else:
+                anchor = chosen[0][0]
+                chosen.append(min(spans, key=lambda s: abs(s[0] - anchor)))
+        if not ok:
+            continue
+        lo = min(s for s, _ in chosen)
+        hi = max(e for _, e in chosen)
+        covered = sum(e - s for s, e in chosen)
+        if (hi - lo) - covered <= slop:
+            out.add((lo, hi))
+    return sorted(out)
+
+
+def span_match_mask(q: dsl.SpanQuery, pf, n_docs: int) -> np.ndarray:
+    mask = np.zeros(n_docs, bool)
+    for doc in candidate_docs(q, pf):
+        if doc < n_docs and spans_for(q, pf, doc):
+            mask[doc] = True
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# intervals (IntervalsSourceProvider analogs)
+# ---------------------------------------------------------------------------
+
+def _interval_terms(rule: Dict[str, Any], analyzer) -> List[str]:
+    return analyzer.terms(str(rule.get("query", "")))
+
+
+def interval_candidates(rule: Dict[str, Any], pf, analyzer) -> Set[int]:
+    (kind, spec), = rule.items()
+    if kind == "match":
+        cand: Optional[Set[int]] = None
+        for t in _interval_terms(spec, analyzer):
+            docs, _ = pf.postings_for(t)
+            s = set(docs.tolist())
+            cand = s if cand is None else (cand & s)
+            if not cand:
+                return set()
+        return cand or set()
+    if kind == "any_of":
+        out: Set[int] = set()
+        for sub in spec.get("intervals", []):
+            out |= interval_candidates(sub, pf, analyzer)
+        return out
+    if kind == "all_of":
+        cand = None
+        for sub in spec.get("intervals", []):
+            s = interval_candidates(sub, pf, analyzer)
+            cand = s if cand is None else (cand & s)
+            if not cand:
+                return set()
+        return cand or set()
+    if kind == "prefix":
+        out = set()
+        prefix = str(spec.get("prefix", ""))
+        for t in pf.terms:
+            if t.startswith(prefix):
+                docs, _ = pf.postings_for(t)
+                out.update(docs.tolist())
+        return out
+    if kind == "wildcard":
+        rx = re.compile(fnmatch.translate(str(spec.get("pattern", ""))))
+        out = set()
+        for t in pf.terms:
+            if rx.match(t):
+                docs, _ = pf.postings_for(t)
+                out.update(docs.tolist())
+        return out
+    raise QueryParsingError(f"unsupported intervals rule [{kind}]")
+
+
+def intervals_for(rule: Dict[str, Any], pf, analyzer,
+                  doc: int) -> List[Span]:
+    """Matching intervals of the rule in one doc, (start, end) exclusive."""
+    (kind, spec), = rule.items()
+    if kind == "match":
+        terms = _interval_terms(spec, analyzer)
+        if not terms:
+            return []
+        per_term: List[List[Span]] = []
+        for t in terms:
+            pos = pf.positions_for(t, doc)
+            if len(pos) == 0:
+                return []
+            per_term.append([(int(p), int(p) + 1) for p in pos])
+        max_gaps = int(spec.get("max_gaps", -1))
+        ordered = bool(spec.get("ordered", False))
+        slop = max_gaps if max_gaps >= 0 else 1 << 30
+        iv = _near_spans(per_term, slop, ordered)
+        return _apply_interval_filter(iv, spec.get("filter"), pf, analyzer,
+                                      doc)
+    if kind == "any_of":
+        out: List[Span] = []
+        for sub in spec.get("intervals", []):
+            out.extend(intervals_for(sub, pf, analyzer, doc))
+        return _apply_interval_filter(sorted(set(out)), spec.get("filter"),
+                                      pf, analyzer, doc)
+    if kind == "all_of":
+        per_sub = [intervals_for(sub, pf, analyzer, doc)
+                   for sub in spec.get("intervals", [])]
+        if any(not s for s in per_sub):
+            return []
+        max_gaps = int(spec.get("max_gaps", -1))
+        ordered = bool(spec.get("ordered", False))
+        slop = max_gaps if max_gaps >= 0 else 1 << 30
+        iv = _near_spans(per_sub, slop, ordered)
+        return _apply_interval_filter(iv, spec.get("filter"), pf, analyzer,
+                                      doc)
+    if kind in ("prefix", "wildcard"):
+        sub = {kind: spec}
+        terms = []
+        if kind == "prefix":
+            prefix = str(spec.get("prefix", ""))
+            terms = [t for t in pf.terms if t.startswith(prefix)]
+        else:
+            rx = re.compile(fnmatch.translate(str(spec.get("pattern", ""))))
+            terms = [t for t in pf.terms if rx.match(t)]
+        out = []
+        for t in terms:
+            out.extend((int(p), int(p) + 1) for p in pf.positions_for(t, doc))
+        return sorted(set(out))
+    raise QueryParsingError(f"unsupported intervals rule [{kind}]")
+
+
+def _apply_interval_filter(iv: List[Span], filt: Optional[Dict[str, Any]],
+                           pf, analyzer, doc: int) -> List[Span]:
+    if not filt or not iv:
+        return iv
+    out = iv
+    for relation, sub_rule in filt.items():
+        ref = intervals_for(sub_rule, pf, analyzer, doc)
+        if relation == "containing":
+            out = [(s, e) for s, e in out
+                   if any(s <= rs and re_ <= e for rs, re_ in ref)]
+        elif relation == "contained_by":
+            out = [(s, e) for s, e in out
+                   if any(rs <= s and e <= re_ for rs, re_ in ref)]
+        elif relation == "not_containing":
+            out = [(s, e) for s, e in out
+                   if not any(s <= rs and re_ <= e for rs, re_ in ref)]
+        elif relation == "not_contained_by":
+            out = [(s, e) for s, e in out
+                   if not any(rs <= s and e <= re_ for rs, re_ in ref)]
+        elif relation == "before":
+            out = [(s, e) for s, e in out
+                   if any(e <= rs for rs, _ in ref)]
+        elif relation == "after":
+            out = [(s, e) for s, e in out
+                   if any(s >= re_ for _, re_ in ref)]
+        else:
+            raise QueryParsingError(
+                f"unsupported intervals filter [{relation}]")
+    return out
+
+
+def intervals_match_mask(q: "dsl.Intervals", pf, analyzer,
+                         n_docs: int) -> np.ndarray:
+    mask = np.zeros(n_docs, bool)
+    for doc in interval_candidates(q.rule, pf, analyzer):
+        if doc < n_docs and intervals_for(q.rule, pf, analyzer, doc):
+            mask[doc] = True
+    return mask

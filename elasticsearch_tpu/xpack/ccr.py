@@ -30,6 +30,8 @@ fresh bootstrap.
 from __future__ import annotations
 
 import logging
+import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.utils.errors import (
@@ -46,12 +48,22 @@ SCAN_BATCH = 1000
 CCR_FETCH = "indices:data/read/ccr/fetch_ops"
 CCR_SCAN = "indices:data/read/ccr/scan"
 
+# a paged bootstrap scan holds its reader snapshot this long between pages;
+# an abandoned scan (master died mid-bootstrap) expires and frees the reader
+SCAN_TTL = 120.0
+
 
 class CcrShardActions:
     """Data-node side: translog ops by seqno + cursor-paged doc scans."""
 
     def __init__(self, node) -> None:
         self.node = node
+        # scan_id -> (reader, expiry): the cursor is POSITIONAL
+        # (segment index, doc), so every page of one scan must see the
+        # same reader snapshot — a merge between pages would re-pack
+        # segments and silently skip docs (the scroll-context discipline,
+        # SearchService.java:203, applied to the recovery-style scan)
+        self._scans: Dict[str, Any] = {}
         node.transport_service.register_handler(CCR_FETCH, self._on_fetch)
         node.transport_service.register_handler(CCR_SCAN, self._on_scan)
 
@@ -75,8 +87,18 @@ class CcrShardActions:
         """Live docs in (segment, doc) order from a cursor — the
         bootstrap copy (RecoverySourceHandler's phase-1 analog, shipping
         _source instead of segment files)."""
-        shard = self.node.indices_service.shard(req["index"], req["shard"])
-        reader = shard.engine.acquire_reader()
+        now = time.monotonic()
+        for k in [k for k, (_r, exp) in self._scans.items() if exp < now]:
+            self._scans.pop(k, None)
+        scan_id = req.get("scan_id")
+        entry = self._scans.get(scan_id) if scan_id else None
+        if entry is not None:
+            reader = entry[0]
+        else:
+            shard = self.node.indices_service.shard(
+                req["index"], req["shard"])
+            reader = shard.engine.acquire_reader()
+            scan_id = uuid.uuid4().hex
         after_seg, after_doc = req.get("cursor") or [0, -1]
         batch = int(req.get("batch", SCAN_BATCH))
         docs: List[Dict[str, Any]] = []
@@ -97,7 +119,11 @@ class CcrShardActions:
                 break
         if cursor is None and docs and len(docs) >= batch:
             cursor = [len(reader.segments), -1]
-        return {"docs": docs, "cursor": cursor}
+        if cursor is None:
+            self._scans.pop(scan_id, None)
+        else:
+            self._scans[scan_id] = (reader, now + SCAN_TTL)
+        return {"docs": docs, "cursor": cursor, "scan_id": scan_id}
 
 
 class CcrService:
@@ -171,6 +197,13 @@ class CcrService:
             self.node.master_client.execute(
                 PUT_CUSTOM, {"section": SECTION, "name": follower_index,
                              "body": {"leader_index": leader_meta.name,
+                                      # fresh uid per follow creation: a
+                                      # master whose local runtime state
+                                      # carries a different uid (stale
+                                      # from an earlier follow of the
+                                      # same name) must re-bootstrap,
+                                      # not resume old checkpoints
+                                      "uid": uuid.uuid4().hex,
                                       "paused": False}},
                 lambda resp, err2: on_done(
                     {"acknowledged": True,
@@ -214,13 +247,24 @@ class CcrService:
         return follower in self._defs()
 
     def poll_all(self) -> None:
-        for follower, d in self._defs().items():
+        defs = self._defs()
+        # prune runtime state for unfollowed indices (the unfollow REST
+        # call may have landed on another node, popping only ITS state)
+        for stale in [f for f in self._state if f not in defs]:
+            self._state.pop(stale, None)
+        for follower, d in defs.items():
             if d.get("paused"):
                 continue
             st = self._state.get(follower)
+            if st is not None and st.get("uid") != d.get("uid"):
+                # same follower name, different follow: old checkpoints
+                # would silently skip the new follower's bootstrap
+                self._state.pop(follower, None)
+                st = None
             if st is None or st.get("bootstrapping"):
                 if st is None:
-                    self._bootstrap(follower, d["leader_index"])
+                    self._bootstrap(follower, d["leader_index"],
+                                    d.get("uid"))
                 continue
             self._poll_follow(follower, d["leader_index"])
 
@@ -234,11 +278,16 @@ class CcrService:
 
     # -- bootstrap --------------------------------------------------------
 
-    def _bootstrap(self, follower: str, leader: str) -> None:
+    def _bootstrap(self, follower: str, leader: str,
+                   uid: Optional[str] = None) -> None:
         """Refresh leader -> capture checkpoints -> cursor-scan every
         shard into the follower. Checkpoints COMMIT only on success; one
         bootstrap at a time per follow (gap storms debounce here)."""
         st = self._state.setdefault(follower, {})
+        if uid is not None:
+            st["uid"] = uid
+        elif "uid" not in st:
+            st["uid"] = self._defs().get(follower, {}).get("uid")
         if st.get("bootstrapping"):
             return
         st["bootstrapping"] = True
@@ -321,8 +370,10 @@ class CcrService:
                     self._scan_shards(follower, leader, n_shards,
                                       sid + 1, {}, maxes)
                 else:
-                    self._scan_shards(follower, leader, n_shards, sid,
-                                      {"cursor": nxt}, maxes)
+                    self._scan_shards(
+                        follower, leader, n_shards, sid,
+                        {"cursor": nxt, "scan_id": resp.get("scan_id")},
+                        maxes)
             if items:
                 self.node.bulk_action.execute(items, advance)
             else:
@@ -330,6 +381,7 @@ class CcrService:
         self.node.transport_service.send_request(
             node_id, CCR_SCAN,
             {"index": leader, "shard": sid, "cursor": cursor,
+             "scan_id": cursor_state.get("scan_id"),
              "batch": SCAN_BATCH}, on_page, timeout=60.0)
 
     # -- incremental polls -------------------------------------------------

@@ -1,0 +1,318 @@
+"""Span family, intervals, query_string, simple_query_string, terms_set,
+distance_feature, pinned, script filter, wrapper, and geo_polygon queries.
+
+Reference: index/query/Span*QueryBuilder, IntervalQueryBuilder,
+QueryStringQueryBuilder, SimpleQueryStringBuilder, TermsSetQueryBuilder,
+DistanceFeatureQueryBuilder, ScriptQueryBuilder, WrapperQueryBuilder,
+GeoPolygonQueryBuilder; x-pack search-business-rules PinnedQueryBuilder.
+"""
+
+import base64
+import json
+
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import MapperService
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.utils.errors import QueryParsingError
+
+
+@pytest.fixture()
+def svc():
+    mappers = MapperService({"properties": {
+        "body": {"type": "text"},
+        "title": {"type": "text"},
+        "tags": {"type": "keyword"},
+        "required_matches": {"type": "integer"},
+        "count": {"type": "integer"},
+        "ts": {"type": "date"},
+        "loc": {"type": "geo_point"},
+    }})
+    engine = InternalEngine(mappers)
+    docs = [
+        ("d1", {"body": "the quick brown fox jumps over the lazy dog",
+                "title": "quick fox", "tags": ["a", "b"],
+                "required_matches": 2, "count": 3,
+                "ts": "2024-01-10T00:00:00Z",
+                "loc": {"lat": 48.8566, "lon": 2.3522}}),      # Paris
+        ("d2", {"body": "sphinx of black quartz judge my vow",
+                "title": "black sphinx", "tags": ["b", "c"],
+                "required_matches": 1, "count": 10,
+                "ts": "2024-01-01T00:00:00Z",
+                "loc": {"lat": 51.5074, "lon": -0.1278}}),     # London
+        ("d3", {"body": "the lazy dog sleeps while the quick fox runs",
+                "title": "lazy dog", "tags": ["c"],
+                "required_matches": 3, "count": 7,
+                "ts": "2024-01-09T00:00:00Z",
+                "loc": {"lat": 40.7128, "lon": -74.006}}),     # NYC
+        ("d4", {"body": "brown dogs and brown foxes play in brown dirt",
+                "title": "brown things", "tags": ["a"],
+                "required_matches": 1, "count": 1,
+                "ts": "2023-06-01T00:00:00Z",
+                "loc": {"lat": 48.85, "lon": 2.35}}),          # Paris-ish
+    ]
+    for did, src in docs:
+        engine.index(did, src)
+    engine.refresh()
+    return SearchService(engine, index_name="t")
+
+
+def ids(res):
+    return sorted(h["_id"] for h in res["hits"]["hits"])
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_term_and_near_ordered(svc):
+    res = svc.search({"query": {"span_term": {"body": "fox"}}})
+    assert ids(res) == ["d1", "d3"]
+    # quick ... dog within slop 10, in order: only d1 has quick before dog
+    res = svc.search({"query": {"span_near": {
+        "clauses": [{"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "dog"}}],
+        "slop": 10, "in_order": True}}})
+    assert ids(res) == ["d1"]
+    # unordered matches d3 too (dog ... quick)
+    res = svc.search({"query": {"span_near": {
+        "clauses": [{"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "dog"}}],
+        "slop": 10, "in_order": False}}})
+    assert ids(res) == ["d1", "d3"]
+    # tight slop drops d1 (quick->dog distance is 6 gaps)
+    res = svc.search({"query": {"span_near": {
+        "clauses": [{"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "dog"}}],
+        "slop": 2, "in_order": True}}})
+    assert ids(res) == []
+
+
+def test_span_first_or_not(svc):
+    # "quick" within the first 2 positions: d1 only ("the quick ...")
+    res = svc.search({"query": {"span_first": {
+        "match": {"span_term": {"body": "quick"}}, "end": 2}}})
+    assert ids(res) == ["d1"]
+    res = svc.search({"query": {"span_or": {"clauses": [
+        {"span_term": {"body": "sphinx"}},
+        {"span_term": {"body": "dirt"}}]}}})
+    assert ids(res) == ["d2", "d4"]
+    # "fox" not preceded within 1 position by "brown": d1's fox is right
+    # after brown (excluded), d3's fox follows "quick" (kept)
+    res = svc.search({"query": {"span_not": {
+        "include": {"span_term": {"body": "fox"}},
+        "exclude": {"span_term": {"body": "brown"}},
+        "pre": 1}}})
+    assert ids(res) == ["d3"]
+
+
+def test_span_containing_within_multi(svc):
+    near = {"span_near": {
+        "clauses": [{"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "jumps"}}],
+        "slop": 5, "in_order": True}}
+    res = svc.search({"query": {"span_containing": {
+        "big": near, "little": {"span_term": {"body": "brown"}}}}})
+    assert ids(res) == ["d1"]
+    res = svc.search({"query": {"span_within": {
+        "big": near, "little": {"span_term": {"body": "brown"}}}}})
+    assert ids(res) == ["d1"]
+    res = svc.search({"query": {"span_multi": {
+        "match": {"prefix": {"body": {"value": "fo"}}}}}})
+    assert ids(res) == ["d1", "d3", "d4"]
+
+
+# ---------------------------------------------------------------------------
+# intervals
+# ---------------------------------------------------------------------------
+
+def test_intervals_match_ordered_gaps(svc):
+    res = svc.search({"query": {"intervals": {"body": {
+        "match": {"query": "quick dog", "max_gaps": 10, "ordered": True}}}}})
+    assert ids(res) == ["d1"]
+    res = svc.search({"query": {"intervals": {"body": {
+        "match": {"query": "quick dog", "max_gaps": 10,
+                  "ordered": False}}}}})
+    assert ids(res) == ["d1", "d3"]
+    res = svc.search({"query": {"intervals": {"body": {
+        "match": {"query": "quick dog", "max_gaps": 1,
+                  "ordered": True}}}}})
+    assert ids(res) == []
+
+
+def test_intervals_any_all_filter(svc):
+    res = svc.search({"query": {"intervals": {"body": {
+        "any_of": {"intervals": [
+            {"match": {"query": "sphinx"}},
+            {"match": {"query": "dirt"}}]}}}}})
+    assert ids(res) == ["d2", "d4"]
+    # all_of ordered: quartz then vow
+    res = svc.search({"query": {"intervals": {"body": {
+        "all_of": {"ordered": True, "intervals": [
+            {"match": {"query": "quartz"}},
+            {"match": {"query": "vow"}}]}}}}})
+    assert ids(res) == ["d2"]
+    # filter not_containing
+    res = svc.search({"query": {"intervals": {"body": {
+        "match": {"query": "the dog", "max_gaps": 3, "ordered": True,
+                  "filter": {"not_containing": {
+                      "match": {"query": "lazy"}}}}}}}})
+    assert ids(res) == []
+
+
+# ---------------------------------------------------------------------------
+# query_string / simple_query_string
+# ---------------------------------------------------------------------------
+
+def test_query_string_basics(svc):
+    res = svc.search({"query": {"query_string": {
+        "query": "quick AND fox", "default_field": "body"}}})
+    assert ids(res) == ["d1", "d3"]
+    res = svc.search({"query": {"query_string": {
+        "query": "sphinx OR dirt", "default_field": "body"}}})
+    assert ids(res) == ["d2", "d4"]
+    res = svc.search({"query": {"query_string": {
+        "query": "brown -lazy", "default_field": "body",
+        "default_operator": "and"}}})
+    assert ids(res) == ["d4"]
+    res = svc.search({"query": {"query_string": {
+        "query": 'body:"lazy dog"'}}})
+    assert ids(res) == ["d1", "d3"]
+    res = svc.search({"query": {"query_string": {
+        "query": "count:[5 TO 20]"}}})
+    assert ids(res) == ["d2", "d3"]
+    res = svc.search({"query": {"query_string": {"query": "count:>=7"}}})
+    assert ids(res) == ["d2", "d3"]
+    res = svc.search({"query": {"query_string": {
+        "query": "title:(quick OR black)"}}})
+    assert ids(res) == ["d1", "d2"]
+    res = svc.search({"query": {"query_string": {
+        "query": "_exists_:tags AND tags:c"}}})
+    assert ids(res) == ["d2", "d3"]
+    res = svc.search({"query": {"query_string": {
+        "query": "spinx~1", "default_field": "body"}}})
+    assert ids(res) == ["d2"]
+    res = svc.search({"query": {"query_string": {
+        "query": "qu?ck", "default_field": "body"}}})
+    assert ids(res) == ["d1", "d3"]
+
+
+def test_query_string_date_and_negative_ranges(svc):
+    # '-' inside range bounds (dates) and negative bounds must tokenize
+    res = svc.search({"query": {"query_string": {
+        "query": "ts:[2024-01-05 TO 2024-01-15]"}}})
+    assert ids(res) == ["d1", "d3"]
+    res = svc.search({"query": {"query_string": {
+        "query": "count:[-5 TO 5]"}}})
+    assert ids(res) == ["d1", "d4"]
+    res = svc.search({"query": {"query_string": {
+        "query": "ts:>=2024-01-01"}}})
+    assert ids(res) == ["d1", "d2", "d3"]
+
+
+def test_pinned_boost_keeps_order(svc):
+    # boost > 1.7 used to overflow the f32 pin band to inf
+    res = svc.search({"query": {"pinned": {
+        "ids": ["d3", "d2"], "boost": 4.0,
+        "organic": {"match": {"body": "brown fox"}}}}, "size": 4})
+    got = [h["_id"] for h in res["hits"]["hits"]]
+    assert got[:2] == ["d3", "d2"]
+
+
+def test_script_query_multivalue_doc(svc):
+    # doc['tags'] view must expose the FULL value list once each
+    res = svc.search({"query": {"bool": {"filter": [{"script": {"script": {
+        "source": "doc['tags'].size() == 2"}}}]}}})
+    assert ids(res) == ["d1", "d2"]
+
+
+def test_query_string_multifield_and_errors(svc):
+    res = svc.search({"query": {"query_string": {
+        "query": "quick", "fields": ["title^2", "body"]}}})
+    assert ids(res) == ["d1", "d3"]
+    with pytest.raises(QueryParsingError):
+        from elasticsearch_tpu.search.querystring import parse_query_string
+        from elasticsearch_tpu.search import dsl
+        parse_query_string(dsl.QueryString(query="(unclosed"))
+
+
+def test_simple_query_string(svc):
+    res = svc.search({"query": {"simple_query_string": {
+        "query": "quick +fox", "fields": ["body"]}}})
+    assert ids(res) == ["d1", "d3"]
+    res = svc.search({"query": {"simple_query_string": {
+        "query": '"lazy dog" -sleeps', "fields": ["body"]}}})
+    assert ids(res) == ["d1"]
+    res = svc.search({"query": {"simple_query_string": {
+        "query": "sphinx | dirt", "fields": ["body"],
+        "default_operator": "and"}}})
+    assert ids(res) == ["d2", "d4"]
+    # malformed input degrades instead of raising
+    res = svc.search({"query": {"simple_query_string": {
+        "query": "qui(ck", "fields": ["body"]}}})
+    assert res["hits"]["total"]["value"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# terms_set / distance_feature / pinned / script / wrapper / geo_polygon
+# ---------------------------------------------------------------------------
+
+def test_terms_set(svc):
+    res = svc.search({"query": {"terms_set": {"tags": {
+        "terms": ["a", "b", "c"],
+        "minimum_should_match_field": "required_matches"}}}})
+    # d1 needs 2 has 2; d2 needs 1 has 2; d3 needs 3 has 1; d4 needs 1 has 1
+    assert ids(res) == ["d1", "d2", "d4"]
+    res = svc.search({"query": {"terms_set": {"tags": {
+        "terms": ["a", "b", "c"],
+        "minimum_should_match_script": {
+            "source": "Math.min(params.num_terms, 2)"}}}}})
+    assert ids(res) == ["d1", "d2"]
+
+
+def test_distance_feature_date_and_geo(svc):
+    res = svc.search({"query": {"distance_feature": {
+        "field": "ts", "origin": "2024-01-10T00:00:00Z",
+        "pivot": "7d"}}, "size": 4})
+    got = [h["_id"] for h in res["hits"]["hits"]]
+    assert got[0] == "d1"            # exact origin scores highest
+    assert got[1] == "d3"            # one day off
+    assert got[-1] == "d4"           # months away scores lowest
+    res = svc.search({"query": {"distance_feature": {
+        "field": "loc", "origin": {"lat": 48.8566, "lon": 2.3522},
+        "pivot": "100km"}}, "size": 4})
+    got = [h["_id"] for h in res["hits"]["hits"]]
+    assert got[0] == "d1" and got[1] == "d4"
+
+
+def test_pinned(svc):
+    res = svc.search({"query": {"pinned": {
+        "ids": ["d3", "d2"],
+        "organic": {"match": {"body": "brown fox"}}}}, "size": 4})
+    got = [h["_id"] for h in res["hits"]["hits"]]
+    assert got[:2] == ["d3", "d2"]   # pinned order, ahead of organic
+    assert set(got[2:]) <= {"d1", "d4"}
+
+
+def test_script_query(svc):
+    res = svc.search({"query": {"bool": {"filter": [{"script": {"script": {
+        "source": "doc['count'].value > params.threshold",
+        "params": {"threshold": 5}}}}]}}})
+    assert ids(res) == ["d2", "d3"]
+
+
+def test_wrapper(svc):
+    inner = base64.b64encode(
+        json.dumps({"term": {"tags": "a"}}).encode()).decode()
+    res = svc.search({"query": {"wrapper": {"query": inner}}})
+    assert ids(res) == ["d1", "d4"]
+
+
+def test_geo_polygon(svc):
+    # triangle around western Europe: Paris + London in, NYC out
+    res = svc.search({"query": {"geo_polygon": {"loc": {"points": [
+        {"lat": 60.0, "lon": -5.0},
+        {"lat": 40.0, "lon": -8.0},
+        {"lat": 50.0, "lon": 15.0}]}}}})
+    assert ids(res) == ["d1", "d2", "d4"]
