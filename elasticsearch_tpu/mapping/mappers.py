@@ -475,6 +475,215 @@ class CompletionFieldMapper(FieldMapper):
                            exact_terms=[str(v) for v in inputs])
 
 
+class IpFieldMapper(FieldMapper):
+    """IPv4/IPv6 field (index/mapper/IpFieldMapper.java analog).
+
+    Values index as canonical address strings in the keyword term dict;
+    CIDR term queries and IP ranges are handled ip-aware at query time
+    (search/execute.py) by testing the segment's term dictionary, which
+    stays small relative to doc count."""
+
+    type_name = "ip"
+    has_doc_values = True
+
+    def parse(self, value: Any) -> ParsedField:
+        import ipaddress
+        values = value if isinstance(value, list) else [value]
+        out = []
+        for v in values:
+            try:
+                out.append(str(ipaddress.ip_address(str(v))))
+            except ValueError:
+                raise MapperParsingError(
+                    f"failed to parse ip [{v}] for field [{self.name}]")
+        return ParsedField(self.name, "terms", exact_terms=out)
+
+
+class BinaryFieldMapper(FieldMapper):
+    """Base64 blob stored in _source only, not searchable
+    (index/mapper/BinaryFieldMapper.java analog)."""
+
+    type_name = "binary"
+    searchable = False
+
+    def parse(self, value: Any) -> ParsedField:
+        import base64
+        import binascii
+        values = value if isinstance(value, list) else [value]
+        for v in values:
+            try:
+                base64.b64decode(str(v), validate=True)
+            except (binascii.Error, ValueError):
+                raise MapperParsingError(
+                    f"failed to parse base64 for binary field [{self.name}]")
+        return ParsedField(self.name, "terms", exact_terms=[])
+
+
+class TokenCountFieldMapper(FieldMapper):
+    """Stores the analyzed token count of its input as a numeric column
+    (modules/mapper-extras TokenCountFieldMapper analog)."""
+
+    type_name = "token_count"
+    has_doc_values = True
+
+    def __init__(self, name: str, params: Dict[str, Any],
+                 analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        self.analyzer = analysis.get(params.get("analyzer", "standard"))
+
+    def parse(self, value: Any) -> ParsedField:
+        values = value if isinstance(value, list) else [value]
+        return ParsedField(self.name, "numeric", numeric=[
+            float(len(self.analyzer.analyze(str(v)))) for v in values])
+
+
+class SearchAsYouTypeFieldMapper(TextFieldMapper):
+    """Text field with shingle + prefix companions for type-ahead
+    (modules/mapper-extras SearchAsYouTypeFieldMapper analog): indexing
+    feeds ``._2gram`` / ``._3gram`` shingle subfields and an
+    ``._index_prefix`` edge-ngram subfield; multi_match type bool_prefix
+    targets the set."""
+
+    type_name = "search_as_you_type"
+
+    def __init__(self, name: str, params: Dict[str, Any],
+                 analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        self.max_shingle_size = int(params.get("max_shingle_size", 3))
+
+
+class AliasFieldMapper(FieldMapper):
+    """Alternate name for an existing field
+    (index/mapper/FieldAliasMapper.java analog). Queries resolve the
+    alias to its path before execution."""
+
+    type_name = "alias"
+    searchable = False
+
+    def __init__(self, name: str, params: Dict[str, Any],
+                 analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        self.path = params.get("path")
+        if not self.path:
+            raise MapperParsingError(
+                f"alias field [{name}] requires [path]")
+
+    def parse(self, value: Any) -> ParsedField:
+        raise MapperParsingError(
+            f"field alias [{self.name}] cannot hold a value")
+
+
+class ConstantKeywordFieldMapper(FieldMapper):
+    """One value shared by every document of the index
+    (x-pack ConstantKeywordFieldMapper analog): term queries for the
+    value match ALL docs, handled at query time."""
+
+    type_name = "constant_keyword"
+
+    def __init__(self, name: str, params: Dict[str, Any],
+                 analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        self.value = params.get("value")
+
+    def parse(self, value: Any) -> ParsedField:
+        if self.value is None:
+            self.value = str(value)      # first seen value pins the constant
+            self.params["value"] = self.value
+        elif str(value) != self.value:
+            raise MapperParsingError(
+                f"constant_keyword [{self.name}] only accepts "
+                f"[{self.value}], got [{value}]")
+        return ParsedField(self.name, "terms", exact_terms=[self.value])
+
+
+# separator between path and leaf value in flattened field terms —
+# chosen outside the printable range so user values cannot collide
+FLATTENED_SEP = "\x1f"
+
+
+class FlattenedFieldMapper(FieldMapper):
+    """Whole-object-as-keywords field (x-pack FlattenedFieldMapper
+    analog): every leaf value indexes under the root name, and keyed
+    lookups (``field.key``) resolve via path-prefixed terms without new
+    per-key mappings."""
+
+    type_name = "flattened"
+
+    def parse(self, value: Any) -> ParsedField:
+        if not isinstance(value, dict):
+            raise MapperParsingError(
+                f"flattened field [{self.name}] expects an object")
+        terms: List[str] = []
+
+        def walk(prefix: str, obj: Any) -> None:
+            if isinstance(obj, dict):
+                for k, v in obj.items():
+                    walk(f"{prefix}.{k}" if prefix else str(k), v)
+            elif isinstance(obj, list):
+                for v in obj:
+                    walk(prefix, v)
+            elif obj is not None:
+                leaf = str(obj).lower() if isinstance(obj, bool) else str(obj)
+                terms.append(leaf)
+                terms.append(f"{prefix}{FLATTENED_SEP}{leaf}")
+
+        walk("", value)
+        return ParsedField(self.name, "terms", exact_terms=terms)
+
+
+class WildcardFieldMapper(KeywordFieldMapper):
+    """Keyword variant optimized for wildcard/regexp matching in the
+    reference (x-pack WildcardFieldMapper's ngram acceleration); here the
+    term dictionary scan already serves those queries, so the type is
+    behaviorally a keyword without length limits."""
+
+    type_name = "wildcard"
+
+    def __init__(self, name: str, params: Dict[str, Any],
+                 analysis: AnalysisRegistry):
+        super().__init__(name, params, analysis)
+        self.ignore_above = None
+
+
+def parse_date_nanos_millis(value: Any) -> float:
+    """Date with nanosecond precision -> fractional epoch millis
+    (DateFieldMapper.Resolution.NANOSECONDS analog; %f caps at 6 digits
+    so the 9-digit fraction parses separately)."""
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        return float(value)
+    s = str(value)
+    import re as _re
+    m = _re.match(r"^(.*?)\.(\d{7,9})(Z|[+-]\d{2}:?\d{2})?$", s)
+    if m:
+        base, frac, tz = m.groups()
+        millis = parse_date_millis(base + (tz or "Z"))
+        return millis + float(f"0.{frac}") * 1000.0
+    return parse_date_millis(value)
+
+
+class DateNanosFieldMapper(DateFieldMapper):
+    type_name = "date_nanos"
+
+    def parse(self, value: Any) -> ParsedField:
+        values = value if isinstance(value, list) else [value]
+        return ParsedField(self.name, "numeric", numeric=[
+            parse_date_nanos_millis(v) for v in values])
+
+
+class Murmur3FieldMapper(FieldMapper):
+    """Stores the murmur3 hash of values as a numeric column for cheap
+    cardinality aggregation (plugins/mapper-murmur3 analog)."""
+
+    type_name = "murmur3"
+    has_doc_values = True
+
+    def parse(self, value: Any) -> ParsedField:
+        from elasticsearch_tpu.utils.murmur3 import murmur3_32
+        values = value if isinstance(value, list) else [value]
+        return ParsedField(self.name, "numeric", numeric=[
+            float(murmur3_32(str(v).encode("utf-8"))) for v in values])
+
+
 # root-level mapping keys that are configuration, never field names
 # (index/mapper/DocumentMapperParser root handlers analog)
 _ROOT_MAPPING_KEYS = frozenset(
@@ -487,12 +696,22 @@ _MAPPER_TYPES = {
     "completion": CompletionFieldMapper,
     "boolean": BooleanFieldMapper,
     "date": DateFieldMapper,
+    "date_nanos": DateNanosFieldMapper,
     "dense_vector": DenseVectorFieldMapper,
     "join": JoinFieldMapper,
     "percolator": PercolatorFieldMapper,
     "rank_features": RankFeaturesFieldMapper,
     "rank_feature": RankFeatureFieldMapper,
     "geo_point": GeoPointFieldMapper,
+    "ip": IpFieldMapper,
+    "binary": BinaryFieldMapper,
+    "token_count": TokenCountFieldMapper,
+    "search_as_you_type": SearchAsYouTypeFieldMapper,
+    "alias": AliasFieldMapper,
+    "constant_keyword": ConstantKeywordFieldMapper,
+    "flattened": FlattenedFieldMapper,
+    "wildcard": WildcardFieldMapper,
+    "murmur3": Murmur3FieldMapper,
 }
 for _num in ("long", "integer", "short", "byte", "double", "float", "half_float", "scaled_float"):
     _MAPPER_TYPES[_num] = _num  # sentinel; handled in build_mapper
@@ -574,6 +793,41 @@ class MapperService:
                         self._mappers[companion] = NumberFieldMapper(
                             companion, {}, self.analysis,
                             type_name="double")
+            elif m.type_name == "search_as_you_type":
+                self._make_sayt_companions(name, m)
+
+    def _make_sayt_companions(self, name: str,
+                              m: "SearchAsYouTypeFieldMapper") -> None:
+        """._2gram/._3gram shingles + ._index_prefix edge-ngrams."""
+        from elasticsearch_tpu.analysis.analyzers import (
+            Analyzer, lowercase_filter, make_edge_ngram_filter,
+            make_shingle_filter, standard_tokenizer,
+        )
+        for n in range(2, m.max_shingle_size + 1):
+            sub = f"{name}._{n}gram"
+            if sub in self._mappers:
+                continue
+            sh = Analyzer(f"__sayt_{n}gram", standard_tokenizer,
+                          [lowercase_filter,
+                           make_shingle_filter(n, n,
+                                               output_unigrams=False)])
+            mapper = TextFieldMapper(sub, {}, self.analysis)
+            mapper.analyzer = sh
+            mapper.search_analyzer = sh
+            self._mappers[sub] = mapper
+        sub = f"{name}._index_prefix"
+        if sub not in self._mappers:
+            pre = Analyzer(
+                "__sayt_prefix", standard_tokenizer,
+                [lowercase_filter,
+                 make_shingle_filter(1, m.max_shingle_size),
+                 make_edge_ngram_filter(1, 20)])
+            mapper = TextFieldMapper(sub, {}, self.analysis)
+            mapper.analyzer = pre
+            # queries send the literal prefix; only indexing expands ngrams
+            from elasticsearch_tpu.analysis import STANDARD
+            mapper.search_analyzer = STANDARD
+            self._mappers[sub] = mapper
 
     def _merge_props(self, prefix: str, props: Dict[str, Any]) -> None:
         for name, spec in props.items():
@@ -622,11 +876,22 @@ class MapperService:
             for sub, subspec in spec.get("fields", {}).items():
                 self._mappers[f"{full}.{sub}"] = build_mapper(f"{full}.{sub}", subspec, self.analysis)
 
+    def resolve_field(self, field_name: str) -> str:
+        """Follow a field alias to its target path (FieldAliasMapper
+        analog); non-aliases resolve to themselves."""
+        m = self._mappers.get(field_name)
+        if m is not None and m.type_name == "alias":
+            return m.path
+        return field_name
+
     def mapper(self, field_name: str) -> Optional[FieldMapper]:
-        return self._mappers.get(field_name)
+        m = self._mappers.get(field_name)
+        if m is not None and m.type_name == "alias":
+            return self._mappers.get(m.path)
+        return m
 
     def field_type(self, field_name: str) -> Optional[str]:
-        m = self._mappers.get(field_name)
+        m = self.mapper(field_name)
         return m.type_name if m else None
 
     def field_names(self) -> List[str]:
@@ -767,6 +1032,19 @@ class MapperService:
                     _merge_parsed(doc.fields[subname], sub)
                 else:
                     doc.fields[subname] = sub
+            # feed search_as_you_type shingle/prefix companions
+            if mapper.type_name == "search_as_you_type":
+                for suffix in ([f"._{n}gram" for n in range(2, 10)]
+                               + ["._index_prefix"]):
+                    comp = self._mappers.get(f"{name}{suffix}")
+                    if comp is None:
+                        continue
+                    sub = comp.parse(value)
+                    subname = f"{name}{suffix}"
+                    if subname in doc.fields:
+                        _merge_parsed(doc.fields[subname], sub)
+                    else:
+                        doc.fields[subname] = sub
 
 
 def _merge_parsed(into: ParsedField, other: ParsedField) -> None:

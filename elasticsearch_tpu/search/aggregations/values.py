@@ -20,6 +20,7 @@ from elasticsearch_tpu.utils.errors import IllegalArgumentError
 def numeric_occurrences(ctx, field_name: str
                         ) -> Tuple[np.ndarray, np.ndarray]:
     """(owners int32, values float64) for a numeric/date field."""
+    field_name = ctx.mappers.resolve_field(field_name)
     seg = ctx.segment
 
     def build():
@@ -44,6 +45,7 @@ def numeric_occurrences(ctx, field_name: str
 def keyword_occurrences(ctx, field_name: str
                         ) -> Tuple[np.ndarray, np.ndarray, list]:
     """(owners int32, ords int32, term_list) for a keyword field."""
+    field_name = ctx.mappers.resolve_field(field_name)
     seg = ctx.segment
 
     def build():
@@ -59,6 +61,7 @@ def keyword_occurrences(ctx, field_name: str
 
 def field_kind(ctx, field_name: str) -> Optional[str]:
     """'numeric' | 'keyword' | None, judged by what this segment stores."""
+    field_name = ctx.mappers.resolve_field(field_name)
     seg = ctx.segment
     if field_name in seg.doc_values:
         return "numeric"

@@ -112,6 +112,46 @@ class SegmentContext:
 Result = Tuple[jnp.ndarray, jnp.ndarray]   # (scores f32 [n_pad], mask bool [n_pad])
 
 
+def resolve_aliases(q: dsl.Query, mappers: MapperService) -> dsl.Query:
+    """Rewrite field aliases to their target paths throughout a query
+    tree (FieldAliasMapper resolution, applied once per shard query).
+    Generic over the dataclass nodes: any attribute named ``field`` is
+    resolved; ``fields`` lists resolve per entry (keeping ^boosts);
+    nested Query attributes and lists recurse. Nodes without alias
+    references are returned unchanged (no copy)."""
+    import dataclasses
+
+    def resolve_name(name: str) -> str:
+        base, _, boost = name.partition("^")
+        resolved = mappers.resolve_field(base)
+        return f"{resolved}^{boost}" if boost else resolved
+
+    if not dataclasses.is_dataclass(q):
+        return q
+    changes = {}
+    for f in dataclasses.fields(q):
+        v = getattr(q, f.name)
+        if f.name == "field" and isinstance(v, str) and v:
+            r = mappers.resolve_field(v)
+            if r != v:
+                changes[f.name] = r
+        elif f.name == "fields" and isinstance(v, list):
+            r2 = [resolve_name(x) if isinstance(x, str) else x for x in v]
+            if r2 != v:
+                changes[f.name] = r2
+        elif isinstance(v, dsl.Query):
+            r3 = resolve_aliases(v, mappers)
+            if r3 is not v:
+                changes[f.name] = r3
+        elif isinstance(v, list) and v and isinstance(v[0], dsl.Query):
+            r4 = [resolve_aliases(c, mappers) for c in v]
+            if any(a is not b for a, b in zip(r4, v)):
+                changes[f.name] = r4
+    if not changes:
+        return q
+    return dataclasses.replace(q, **changes)
+
+
 def execute(q: dsl.Query, ctx: SegmentContext) -> Result:
     handler = _HANDLERS.get(type(q))
     if handler is None:
@@ -133,6 +173,52 @@ def _term_mask_host(ctx: SegmentContext, field_name: str, value: Any) -> np.ndar
         if d is not None:
             mask[d] = True
         return mask
+    ftype = ctx.mappers.field_type(field_name)
+    if ftype == "constant_keyword":
+        # every doc of the index carries the constant — a matching term
+        # matches ALL docs, even ones that omitted the field
+        const = getattr(ctx.mappers.mapper(field_name), "value", None)
+        if const is not None and str(value) == const:
+            mask[:] = True
+        return mask
+    if ftype == "ip":
+        import ipaddress
+        if "/" in str(value):
+            # CIDR term query over the (small) ip term dictionary
+            try:
+                net = ipaddress.ip_network(str(value), strict=False)
+            except ValueError:
+                raise QueryParsingError(f"failed to parse CIDR [{value}]")
+
+            def in_net(t: str) -> bool:
+                try:
+                    return ipaddress.ip_address(t) in net
+                except ValueError:
+                    return False
+            kf_ip = seg.keywords.get(field_name)
+            if kf_ip is not None:
+                for t in kf_ip.terms:
+                    if in_net(t):
+                        mask[kf_ip.docs_with_term(t)] = True
+            return mask
+        # exact: canonicalize the query value like the mapper canonicalized
+        # the indexed form ('2001:0DB8::1' must find '2001:db8::1')
+        try:
+            value = str(ipaddress.ip_address(str(value)))
+        except ValueError:
+            pass   # non-address text simply matches nothing below
+    if ftype is None and "." in field_name:
+        # keyed lookup into a flattened field: flat.key -> path-prefixed
+        # term on the root column (x-pack FlattenedFieldMapper keyed terms)
+        root, _, keypath = field_name.partition(".")
+        root_m = ctx.mappers.mapper(root)
+        if root_m is not None and root_m.type_name == "flattened":
+            from elasticsearch_tpu.mapping.mappers import FLATTENED_SEP
+            kf_flat = seg.keywords.get(root)
+            if kf_flat is not None:
+                mask[kf_flat.docs_with_term(
+                    f"{keypath}{FLATTENED_SEP}{value}")] = True
+            return mask
     kf = seg.keywords.get(field_name)
     if kf is not None:
         mask[kf.docs_with_term(str(value))] = True
@@ -172,6 +258,31 @@ def _coerce_numeric(ctx: SegmentContext, field_name: str, value: Any) -> float:
 
 def _range_mask_host(ctx: SegmentContext, q: dsl.Range) -> np.ndarray:
     seg = ctx.segment
+    if ctx.mappers.field_type(q.field) == "ip":
+        # numeric address-space comparison, not lexicographic strings
+        import ipaddress
+        mask = np.zeros(seg.n_docs, bool)
+        kf = seg.keywords.get(q.field)
+        if kf is None:
+            return mask
+
+        def addr(v):
+            return int(ipaddress.ip_address(str(v)))
+        try:
+            for term in kf.terms:
+                a = addr(term)
+                if q.gt is not None and not a > addr(q.gt):
+                    continue
+                if q.gte is not None and not a >= addr(q.gte):
+                    continue
+                if q.lt is not None and not a < addr(q.lt):
+                    continue
+                if q.lte is not None and not a <= addr(q.lte):
+                    continue
+                mask[kf.docs_with_term(term)] = True
+        except ValueError as e:
+            raise QueryParsingError(f"failed to parse ip range: {e}")
+        return mask
     dv = seg.doc_values.get(q.field)
     if dv is None:
         # range over keyword terms (lexicographic)
@@ -346,6 +457,22 @@ def _h_multi_match(q: dsl.MultiMatch, ctx: SegmentContext) -> Result:
         else:
             expanded[fname] = max(expanded.get(fname, 0.0), boost)
     for fname, boost in expanded.items():
+        if q.type == "bool_prefix":
+            # search-as-you-type: every term matches normally, the LAST
+            # term matches as a prefix (MultiMatchQueryBuilder
+            # Type.BOOL_PREFIX / MatchBoolPrefixQueryBuilder analog)
+            toks = ctx.search_analyzer(fname).terms(q.text)
+            if not toks:
+                continue
+            head = " ".join(toks[:-1])
+            clauses: List[dsl.Query] = []
+            if head:
+                clauses.append(dsl.Match(field=fname, text=head,
+                                         operator="and"))
+            clauses.append(dsl.Prefix(field=fname, value=toks[-1]))
+            results.append(execute(
+                dsl.Bool(must=clauses, boost=boost), ctx))
+            continue
         results.append(execute(dsl.Match(field=fname, text=q.text,
                                          operator=q.operator, boost=boost), ctx))
     if not results:
@@ -1317,9 +1444,26 @@ def _h_intervals(q: dsl.Intervals, ctx: SegmentContext) -> Result:
     return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
 
 
+def _parsed_string_query(q, ctx: SegmentContext, parse) -> dsl.Query:
+    """Parse once per (node, mapper service) and memoize on the node —
+    the same QueryString node executes against every segment of the
+    shard, and re-parsing per segment is pure waste. Field names inside
+    the string surface only after parsing, so aliases resolve on the
+    parsed tree; leaves left on the "*" fallback expand to the index's
+    searchable fields."""
+    from elasticsearch_tpu.search.querystring import expand_star_fields
+    cached = getattr(q, "_parsed_cache", None)
+    if cached is not None and cached[0] is ctx.mappers:
+        return cached[1]
+    tree = expand_star_fields(
+        resolve_aliases(parse(q), ctx.mappers), ctx.mappers)
+    q._parsed_cache = (ctx.mappers, tree)
+    return tree
+
+
 def _h_query_string(q: dsl.QueryString, ctx: SegmentContext) -> Result:
     from elasticsearch_tpu.search.querystring import parse_query_string
-    return execute(parse_query_string(q), ctx)
+    return execute(_parsed_string_query(q, ctx, parse_query_string), ctx)
 
 
 def _h_simple_query_string(q: dsl.SimpleQueryString,
@@ -1327,7 +1471,18 @@ def _h_simple_query_string(q: dsl.SimpleQueryString,
     from elasticsearch_tpu.search.querystring import (
         parse_simple_query_string,
     )
-    return execute(parse_simple_query_string(q), ctx)
+    return execute(
+        _parsed_string_query(q, ctx, parse_simple_query_string), ctx)
+
+
+def _implicit_return(src: str) -> str:
+    """Expression-style scripts implicitly return their value in filter
+    and terms_set contexts. A WORD-boundary check (not substring — a
+    field named 'return_count' must not defeat the wrap) on single
+    expressions only (wrapping 'a; b' would be invalid syntax)."""
+    if ";" not in src and not re.search(r"\breturn\b", src):
+        return f"return ({src})"
+    return src
 
 
 def _h_terms_set(q: dsl.TermsSet, ctx: SegmentContext) -> Result:
@@ -1349,13 +1504,9 @@ def _h_terms_set(q: dsl.TermsSet, ctx: SegmentContext) -> Result:
                 & (required > 0)
         elif q.minimum_should_match_script is not None:
             from elasticsearch_tpu.script import default_engine
-            src = q.minimum_should_match_script
-            if "return" not in src:
-                # expression-style scripts implicitly return their value
-                # in this context (TermsSetQueryBuilder script contract)
-                src = f"return ({src})"
             val = default_engine.execute(
-                src, {"params": {"num_terms": len(q.terms)}})
+                _implicit_return(q.minimum_should_match_script),
+                {"params": {"num_terms": len(q.terms)}})
             required = max(int(val), 1)
             mask = count >= required
         else:
@@ -1453,11 +1604,7 @@ def _h_script_query(q: dsl.ScriptQuery, ctx: SegmentContext) -> Result:
 
     def build():
         engine = default_engine
-        src = q.source
-        if "return" not in src and ";" not in src:
-            # expression-style filter scripts implicitly return their value
-            src = f"return ({src})"
-        compiled = engine.compile(src)
+        compiled = engine.compile(_implicit_return(q.source))
         mask = np.zeros(seg.n_docs, bool)
         columns = {name: dv for name, dv in seg.doc_values.items()}
         for d in range(seg.n_docs):

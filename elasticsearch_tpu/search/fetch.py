@@ -234,16 +234,20 @@ def fetch_hits(reader: Reader,
         if docvalue_fields:
             fields: Dict[str, List[Any]] = {}
             for f in docvalue_fields:
-                fname = f if isinstance(f, str) else f.get("field")
+                req_name = f if isinstance(f, str) else f.get("field")
+                # columns live under the alias target; the response keys
+                # by the REQUESTED name like the reference
+                fname = mappers.resolve_field(req_name)
                 dv = seg.doc_values.get(fname)
                 if dv is not None and dv.exists[sd.doc]:
                     vals = dv.multi.get(sd.doc, [dv.values[sd.doc]])
-                    fields[fname] = [_jsonify(v) for v in vals]
+                    fields[req_name] = [_jsonify(v) for v in vals]
                 elif fname in seg.keywords:
                     kf = seg.keywords[fname]
                     ords = kf.ord_values[kf.ord_offsets[sd.doc]: kf.ord_offsets[sd.doc + 1]]
                     if len(ords):
-                        fields[fname] = [kf.term_list[int(o)] for o in ords]
+                        fields[req_name] = [kf.term_list[int(o)]
+                                            for o in ords]
             if fields:
                 hit["fields"] = fields
         if highlighter is not None and query is not None:

@@ -122,6 +122,23 @@ def collect_query_terms(q: dsl.Query) -> Dict[str, List[str]]:
                 walk(node.query)
         elif isinstance(node, dsl.Knn) and node.filter is not None:
             walk(node.filter)
+        elif isinstance(node, dsl.SpanTerm):
+            out.setdefault(node.field, []).append(node.value)
+        elif isinstance(node, dsl.SpanNear):
+            for c in node.clauses:
+                walk(c)
+        elif isinstance(node, dsl.SpanOr):
+            for c in node.clauses:
+                walk(c)
+        elif isinstance(node, dsl.SpanNot):
+            walk(node.include)
+        elif isinstance(node, dsl.SpanFirst):
+            walk(node.match)
+        elif isinstance(node, (dsl.SpanContaining, dsl.SpanWithin)):
+            walk(node.big)
+            walk(node.little)
+        elif isinstance(node, dsl.Pinned) and node.organic is not None:
+            walk(node.organic)
 
     walk(q)
     return out
@@ -137,7 +154,17 @@ def contains_term_expansion(q: dsl.Query) -> bool:
     def walk(node):
         if isinstance(node, (dsl.MatchPhrasePrefix, dsl.Prefix,
                              dsl.Wildcard, dsl.Regexp, dsl.Fuzzy,
-                             dsl.MoreLikeThis)):
+                             dsl.MoreLikeThis, dsl.SpanMulti,
+                             dsl.Intervals, dsl.QueryString,
+                             dsl.SimpleQueryString, dsl.TermsSet,
+                             dsl.DistanceFeature, dsl.ScriptQuery,
+                             dsl.GeoPolygon, dsl.Percolate)):
+            # expanded/derived matching: literal query text existing as a
+            # term is NOT a precondition for hits, so can_match must not
+            # prune on df. (query_string/simple_query_string parse to
+            # plain trees only at execute time, so they are conservative
+            # here; span/intervals leaves DO contribute literal terms via
+            # collect_query_terms but their structural nodes stay lenient.)
             found[0] = True
         elif isinstance(node, dsl.Bool):
             for c in node.must + node.should + node.must_not + node.filter:
@@ -154,6 +181,9 @@ def contains_term_expansion(q: dsl.Query) -> bool:
                                dsl.Nested)):
             if node.query is not None:
                 walk(node.query)
+        elif isinstance(node, dsl.Pinned):
+            # pinned ids match regardless of the organic clause's terms
+            found[0] = True
 
     walk(q)
     return found[0]
@@ -327,6 +357,14 @@ def query_shard(reader: Reader,
     (ctx, segment_idx, scores, mask) per segment (two-level agg model).
     """
     sort = sort or [SortSpec("_score")]
+    from elasticsearch_tpu.search.execute import resolve_aliases
+    query = resolve_aliases(query, mappers)
+    # sort keys read segment columns directly — resolve aliases here too
+    sort = [SortSpec(mappers.resolve_field(s.field), s.order, s.missing)
+            if not s.field.startswith("_") else s for s in sort]
+    if collapse and isinstance(collapse.get("field"), str):
+        collapse = {**collapse,
+                    "field": mappers.resolve_field(collapse["field"])}
     doc_count, dfs = shard_term_stats(reader, mappers, query)
     if doc_count_override is not None:
         doc_count = doc_count_override
@@ -481,6 +519,7 @@ def collapse_marker(key: Any) -> Any:
 def _collapse_keys(ctx: SegmentContext, field_name: str,
                    docs: np.ndarray) -> list:
     """One collapse key per doc from keyword ords or numeric doc values."""
+    field_name = ctx.mappers.resolve_field(field_name)
     seg = ctx.segment
     kf = seg.keywords.get(field_name)
     if kf is not None:

@@ -320,6 +320,43 @@ class _Parser:
         raise QueryParsingError(f"unexpected token {t!r} in query string")
 
 
+def expand_star_fields(q: dsl.Query, mappers) -> dsl.Query:
+    """Expand leaves left on the all-fields fallback "*" into a dis_max
+    over the index's searchable string fields (QueryParserHelper
+    resolveMappingFields analog). Match leaves were already rewritten to
+    MultiMatch at parse time; this covers phrase/prefix/wildcard/regexp/
+    fuzzy leaves, which otherwise look up a literal "*" column and
+    silently match nothing."""
+    import dataclasses
+
+    star_types = (dsl.MatchPhrase, dsl.Prefix, dsl.Wildcard, dsl.Regexp,
+                  dsl.Fuzzy)
+    if isinstance(q, star_types) and getattr(q, "field", None) == "*":
+        names = [n for n in mappers.field_names()
+                 if "#" not in n and mappers.field_type(n) in
+                 ("text", "keyword", "search_as_you_type", "wildcard")]
+        if not names:
+            return dsl.MatchNone()
+        leaves = [dataclasses.replace(q, field=n) for n in names]
+        if len(leaves) == 1:
+            return leaves[0]
+        return dsl.DisMax(queries=leaves)
+    if not dataclasses.is_dataclass(q):
+        return q
+    changes = {}
+    for f in dataclasses.fields(q):
+        v = getattr(q, f.name)
+        if isinstance(v, dsl.Query):
+            r = expand_star_fields(v, mappers)
+            if r is not v:
+                changes[f.name] = r
+        elif isinstance(v, list) and v and isinstance(v[0], dsl.Query):
+            r2 = [expand_star_fields(c, mappers) for c in v]
+            if any(a is not b for a, b in zip(r2, v)):
+                changes[f.name] = r2
+    return dataclasses.replace(q, **changes) if changes else q
+
+
 def parse_query_string(q: "dsl.QueryString") -> dsl.Query:
     fields = list(q.fields)
     if q.default_field and not fields:

@@ -173,6 +173,21 @@ def _near_spans(per_clause: List[List[Span]], slop: int,
             return _near_spans_greedy(per_clause, slop, in_order)
     out: Set[Span] = set()
 
+    def union_len(chosen: List[Span]) -> int:
+        """Length of the union of the chosen intervals — overlapping
+        sub-spans must not double-count covered positions (that made
+        width - covered negative and defeated the slop check)."""
+        merged = 0
+        last_end = -1
+        for s, e in sorted(chosen):
+            if s >= last_end:
+                merged += e - s
+                last_end = e
+            elif e > last_end:
+                merged += e - last_end
+                last_end = e
+        return merged
+
     def rec(idx: int, chosen: List[Span]) -> None:
         if idx == len(per_clause):
             if in_order:
@@ -181,11 +196,14 @@ def _near_spans(per_clause: List[List[Span]], slop: int,
                         return
             lo = min(s for s, _ in chosen)
             hi = max(e for _, e in chosen)
-            covered = sum(e - s for s, e in chosen)
-            if (hi - lo) - covered <= slop:
+            if (hi - lo) - union_len(chosen) <= slop:
                 out.add((lo, hi))
             return
         for sp in per_clause[idx]:
+            # one occurrence cannot satisfy two clauses: a repeated term
+            # ("big big") must find two distinct positions
+            if sp in chosen:
+                continue
             rec(idx + 1, chosen + [sp])
 
     rec(0, [])

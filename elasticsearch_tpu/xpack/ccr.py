@@ -94,6 +94,11 @@ class CcrShardActions:
         entry = self._scans.get(scan_id) if scan_id else None
         if entry is not None:
             reader = entry[0]
+        elif scan_id:
+            # the scan context expired: applying the positional cursor to
+            # a FRESH reader would be the exact merge-skip hazard the
+            # context exists to prevent — fail so the caller re-bootstraps
+            return {"expired": True}
         else:
             shard = self.node.indices_service.shard(
                 req["index"], req["shard"])
@@ -354,10 +359,11 @@ class CcrService:
         cursor = cursor_state.get("cursor")
 
         def on_page(resp, err):
-            if err is not None or resp is None:
+            if err is not None or resp is None or resp.get("expired"):
                 st["bootstrapping"] = False
                 logger.warning("ccr bootstrap [%s] scan failed: %s",
-                               follower, err)
+                               follower,
+                               "scan context expired" if resp else err)
                 return
             docs = resp.get("docs", [])
             items = [{"action": "index", "index": follower,

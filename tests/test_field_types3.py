@@ -1,0 +1,172 @@
+"""ip, binary, token_count, search_as_you_type, alias, constant_keyword,
+flattened, wildcard, date_nanos, and murmur3 field types.
+
+Reference: index/mapper/IpFieldMapper, BinaryFieldMapper,
+FieldAliasMapper; modules/mapper-extras TokenCountFieldMapper,
+SearchAsYouTypeFieldMapper; x-pack ConstantKeywordFieldMapper,
+FlattenedFieldMapper, WildcardFieldMapper; plugins/mapper-murmur3.
+"""
+
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import (
+    MapperService, parse_date_nanos_millis,
+)
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.utils.errors import MapperParsingError
+
+
+@pytest.fixture()
+def svc():
+    mappers = MapperService({"properties": {
+        "addr": {"type": "ip"},
+        "blob": {"type": "binary"},
+        "body": {"type": "text"},
+        "body_words": {"type": "token_count", "analyzer": "standard"},
+        "title": {"type": "search_as_you_type"},
+        "note": {"type": "alias", "path": "body"},
+        "env": {"type": "constant_keyword"},
+        "labels": {"type": "flattened"},
+        "pattern": {"type": "wildcard"},
+        "ts": {"type": "date_nanos"},
+        "h": {"type": "murmur3"},
+    }})
+    engine = InternalEngine(mappers)
+    docs = [
+        ("d1", {"addr": "192.168.1.10", "blob": "aGVsbG8=",
+                "body": "quick brown fox", "body_words": "quick brown fox",
+                "title": "quick brown fox", "env": "prod",
+                "labels": {"priority": "urgent", "release": {"tag": "v1"}},
+                "pattern": "server-log-2024.txt",
+                "ts": "2024-01-01T00:00:00.123456789Z", "h": "alpha"}),
+        ("d2", {"addr": "192.168.2.20",
+                "body": "lazy dog", "body_words": "lazy dog",
+                "title": "quiet brown field", "env": "prod",
+                "labels": {"priority": "low"},
+                "pattern": "client-log-2024.txt",
+                "ts": "2024-01-01T00:00:00.123456000Z", "h": "beta"}),
+        ("d3", {"addr": "10.0.0.1",
+                "body": "slow turtle", "body_words": "slow turtle",
+                "title": "brown quilt", "env": "prod",
+                "pattern": "metrics.csv",
+                "ts": "2024-01-02T00:00:00Z", "h": "alpha"}),
+    ]
+    for did, src in docs:
+        engine.index(did, src)
+    engine.refresh()
+    return SearchService(engine, index_name="t")
+
+
+def ids(res):
+    return sorted(h["_id"] for h in res["hits"]["hits"])
+
+
+def test_ip_exact_cidr_range(svc):
+    res = svc.search({"query": {"term": {"addr": "10.0.0.1"}}})
+    assert ids(res) == ["d3"]
+    res = svc.search({"query": {"term": {"addr": "192.168.0.0/16"}}})
+    assert ids(res) == ["d1", "d2"]
+    res = svc.search({"query": {"range": {"addr": {
+        "gte": "192.168.1.0", "lt": "192.168.2.0"}}}})
+    assert ids(res) == ["d1"]
+
+
+def test_ip_rejects_garbage():
+    m = MapperService({"properties": {"addr": {"type": "ip"}}})
+    with pytest.raises(MapperParsingError):
+        m.parse_document("x", {"addr": "not-an-ip"})
+
+
+def test_binary_validates_and_not_searchable(svc):
+    with pytest.raises(MapperParsingError):
+        MapperService({"properties": {"b": {"type": "binary"}}}) \
+            .parse_document("x", {"b": "!!!not-base64!!!"})
+    # stored in _source
+    res = svc.search({"query": {"term": {"_id": "d1"}}})
+    assert res["hits"]["hits"][0]["_source"]["blob"] == "aGVsbG8="
+
+
+def test_token_count(svc):
+    res = svc.search({"query": {"range": {"body_words": {"gte": 3}}}})
+    assert ids(res) == ["d1"]
+    res = svc.search({"query": {"term": {"body_words": 2}}})
+    assert ids(res) == ["d2", "d3"]
+
+
+def test_search_as_you_type_bool_prefix(svc):
+    res = svc.search({"query": {"multi_match": {
+        "query": "quick bro",
+        "type": "bool_prefix",
+        "fields": ["title", "title._2gram", "title._3gram"]}}})
+    got = [h["_id"] for h in res["hits"]["hits"]]
+    assert got[0] == "d1"            # full shingle match ranks first
+    assert "d3" not in got           # 'brown quilt' lacks the quick prefix
+    # shingle subfield matches phrase-order pairs only
+    res = svc.search({"query": {"match": {"title._2gram": "quick brown"}}})
+    assert ids(res) == ["d1"]
+
+
+def test_field_alias(svc):
+    res = svc.search({"query": {"match": {"note": "fox"}}})
+    assert ids(res) == ["d1"]
+    res = svc.search({"query": {"query_string": {
+        "query": "note:turtle"}}})
+    assert ids(res) == ["d3"]
+    # writing to an alias is rejected
+    with pytest.raises(MapperParsingError):
+        MapperService({"properties": {
+            "a": {"type": "alias", "path": "b"},
+            "b": {"type": "keyword"}}}).parse_document("x", {"a": "v"})
+
+
+def test_constant_keyword(svc):
+    # matches ALL docs — including d3 which omitted the field? No: all
+    # docs here carry it; the match-all semantics show on the term query
+    res = svc.search({"query": {"term": {"env": "prod"}}})
+    assert ids(res) == ["d1", "d2", "d3"]
+    res = svc.search({"query": {"term": {"env": "staging"}}})
+    assert ids(res) == []
+    with pytest.raises(MapperParsingError):
+        MapperService({"properties": {
+            "e": {"type": "constant_keyword", "value": "a"}}}) \
+            .parse_document("x", {"e": "b"})
+
+
+def test_flattened(svc):
+    # keyed lookup
+    res = svc.search({"query": {"term": {"labels.priority": "urgent"}}})
+    assert ids(res) == ["d1"]
+    res = svc.search({"query": {"term": {"labels.release.tag": "v1"}}})
+    assert ids(res) == ["d1"]
+    # root lookup matches any leaf value
+    res = svc.search({"query": {"term": {"labels": "low"}}})
+    assert ids(res) == ["d2"]
+    res = svc.search({"query": {"exists": {"field": "labels"}}})
+    assert ids(res) == ["d1", "d2"]
+
+
+def test_wildcard_field(svc):
+    res = svc.search({"query": {"wildcard": {"pattern": {
+        "value": "*log-2024*"}}}})
+    assert ids(res) == ["d1", "d2"]
+    res = svc.search({"query": {"term": {"pattern": "metrics.csv"}}})
+    assert ids(res) == ["d3"]
+
+
+def test_date_nanos(svc):
+    # nanosecond fraction parses and preserves sub-millisecond ordering
+    a = parse_date_nanos_millis("2024-01-01T00:00:00.123456789Z")
+    b = parse_date_nanos_millis("2024-01-01T00:00:00.123456000Z")
+    assert a > b
+    assert a == pytest.approx(1704067200123.456789, abs=1e-6)
+    res = svc.search({"query": {"match_all": {}},
+                      "sort": [{"ts": "desc"}], "size": 3})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["d3", "d1", "d2"]
+
+
+def test_murmur3_hashes(svc):
+    # equal inputs hash equal; cardinality-style distinctness preserved
+    res = svc.search({"size": 0, "aggs": {
+        "u": {"cardinality": {"field": "h"}}}})
+    assert res["aggregations"]["u"]["value"] == 2
