@@ -39,14 +39,6 @@ def idf(doc_count: int, doc_freq: int) -> float:
     return float(np.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5)))
 
 
-# gather-chunk size: block lists longer than this are processed by a scan
-# accumulating into the dense score vector, bounding HLO temps to
-# CHUNK x BLOCK per step instead of QB x BLOCK for the whole query (a
-# 64-query batch over a 1M-doc segment otherwise materializes ~17GB of
-# gather temps and OOMs HBM)
-GATHER_CHUNK = 4096
-
-
 @partial(jax.jit, static_argnames=("n_docs_pad", "k1", "b"))
 def bm25_block_scores(block_docs: jnp.ndarray,     # [NB, BLOCK] int32, -1 pad
                       block_tfs: jnp.ndarray,      # [NB, BLOCK] f32
@@ -58,35 +50,17 @@ def bm25_block_scores(block_docs: jnp.ndarray,     # [NB, BLOCK] int32, -1 pad
                       k1: float = DEFAULT_K1,
                       b: float = DEFAULT_B) -> jnp.ndarray:
     """Dense BM25 scores [n_docs_pad] for one query over one segment."""
-
-    def score_chunk(scores, chunk):
-        bi, bw = chunk
-        docs = block_docs[bi]               # [C, BLOCK]
-        tfs = block_tfs[bi]                 # [C, BLOCK]
-        valid = docs >= 0
-        safe_docs = jnp.where(valid, docs, 0)
-        dl = doc_lens[safe_docs]            # [C, BLOCK]
-        norm = k1 * (1.0 - b + b * dl / avgdl)
-        contrib = bw[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
-        contrib = jnp.where(valid, contrib, 0.0)
-        return scores.at[safe_docs.reshape(-1)].add(
-            contrib.reshape(-1), mode="drop")
-
-    qb = block_idx.shape[0]
+    docs = block_docs[block_idx]            # [QB, BLOCK]
+    tfs = block_tfs[block_idx]              # [QB, BLOCK]
+    valid = docs >= 0
+    safe_docs = jnp.where(valid, docs, 0)
+    dl = doc_lens[safe_docs]                # [QB, BLOCK]
+    norm = k1 * (1.0 - b + b * dl / avgdl)
+    contrib = block_weight[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+    contrib = jnp.where(valid, contrib, 0.0)
     scores = jnp.zeros((n_docs_pad,), jnp.float32)
-    if qb <= GATHER_CHUNK:
-        return score_chunk(scores, (block_idx, block_weight))
-    # qb buckets above GATHER_CHUNK are multiples of it (pow2 / x8 ladder)
-    n_chunks = qb // GATHER_CHUNK
-    idx_c = block_idx[: n_chunks * GATHER_CHUNK].reshape(
-        n_chunks, GATHER_CHUNK)
-    w_c = block_weight[: n_chunks * GATHER_CHUNK].reshape(
-        n_chunks, GATHER_CHUNK)
-    scores, _ = jax.lax.scan(
-        lambda s, c: (score_chunk(s, c), None), scores, (idx_c, w_c))
-    rem = qb - n_chunks * GATHER_CHUNK
-    if rem:
-        scores = score_chunk(scores, (block_idx[-rem:], block_weight[-rem:]))
+    scores = scores.at[safe_docs.reshape(-1)].add(
+        contrib.reshape(-1), mode="drop")
     return scores
 
 
@@ -126,6 +100,12 @@ def bm25_topk_batch(block_docs, block_tfs,
 # path to establish the top-k score floor (theta)
 P1_BUCKET = 32
 
+# per-dispatch ceiling on Q x qb_pad: each device temp is
+# Q*qb*BLOCK*4 bytes ([Q, QB, 128] f32 gathers), and the program holds
+# ~4 of them live — 4M cells = ~2GB/temp, safely inside a 16G HBM chip.
+# Larger batches split into query chunks (one compile per chunk shape).
+MAX_BATCH_CELLS = 4_000_000
+
 
 def qb_bucket(n: int, minimum: int = 32) -> int:
     """Gather-list bucket size: a coarse x8 ladder, x2 above 16K.
@@ -134,8 +114,7 @@ def qb_bucket(n: int, minimum: int = 32) -> int:
     buckets churn with each query batch. The x8 ladder wastes at most 8x
     gather padding (device cost: <1ms) to cap the shape space at ~4
     compiles; above 16K blocks the padding waste dominates compile
-    amortization (scan steps are real work), so the ladder tightens to
-    x2. All rungs stay multiples of GATHER_CHUNK for the scan reshape."""
+    amortization, so the ladder tightens to x2."""
     b = max(minimum, 1)
     while b < n:
         b *= 8 if b < 16384 else 2
@@ -451,10 +430,8 @@ class Bm25Executor:
                 self.dev.n_docs_pad, k)
         qb_pad = qb_bucket(max((p.n_blocks for p in plans), default=1))
         if not prune or qb_pad <= P1_BUCKET:
-            idx, w = pad_plans(plans, qb_pad)
             self.last_prune_stats = (total_blocks, total_blocks)
-            return bm25_topk_batch(*args, jnp.asarray(idx), jnp.asarray(w),
-                                   *tail, k1=k1, b=b)
+            return self._dispatch_chunked(plans, args, tail, k1, b)
         p1 = [p.top_by_ub(P1_BUCKET) for p in plans]
         idx1, w1 = pad_plans(p1, P1_BUCKET)
         s1, _ = bm25_topk_batch(*args, jnp.asarray(idx1), jnp.asarray(w1),
@@ -464,7 +441,33 @@ class Bm25Executor:
         scored = sum(p.n_blocks for p in p2)
         p1_cost = sum(p.n_blocks for p in p1)
         self.last_prune_stats = (total_blocks, scored + p1_cost)
-        qb2 = qb_bucket(max((p.n_blocks for p in p2), default=1))
-        idx2, w2 = pad_plans(p2, qb2)
-        return bm25_topk_batch(*args, jnp.asarray(idx2), jnp.asarray(w2),
-                               *tail, k1=k1, b=b)
+        return self._dispatch_chunked(p2, args, tail, k1, b)
+
+    def _dispatch_chunked(self, plans, args, tail, k1, b):
+        """Dispatch the batched program in query chunks bounded by
+        MAX_BATCH_CELLS so gather temps never exceed HBM. Chunks use one
+        fixed Q (padded with empty plans) so each qb rung compiles one
+        program shape, not one per remainder size."""
+        qb_pad = qb_bucket(max((p.n_blocks for p in plans), default=1))
+        q_max = max(1, MAX_BATCH_CELLS // qb_pad)
+        if len(plans) <= q_max:
+            idx, w = pad_plans(plans, qb_pad)
+            return bm25_topk_batch(*args, jnp.asarray(idx), jnp.asarray(w),
+                                   *tail, k1=k1, b=b)
+        empty = QueryPlan([], [], [], [])
+        out_s = []
+        out_d = []
+        for i in range(0, len(plans), q_max):
+            chunk = plans[i : i + q_max]
+            n_real = len(chunk)
+            if n_real < q_max:
+                chunk = chunk + [empty] * (q_max - n_real)
+            # chunk-local bucket: a chunk of small plans skips the big rung
+            qb_c = qb_bucket(max((p.n_blocks for p in chunk), default=1))
+            idx, w = pad_plans(chunk, qb_c)
+            s, d = bm25_topk_batch(*args, jnp.asarray(idx), jnp.asarray(w),
+                                   *tail, k1=k1, b=b)
+            out_s.append(np.asarray(s)[:n_real])
+            out_d.append(np.asarray(d)[:n_real])
+        return (jnp.asarray(np.concatenate(out_s)),
+                jnp.asarray(np.concatenate(out_d)))

@@ -20,6 +20,8 @@ from elasticsearch_tpu.search.aggregations.spec import AggSpec, parse_aggs
 from elasticsearch_tpu.search.aggregations.engine import (
     ShardAggregator, merge_partials, reduce_aggs,
 )
+# importing extra registers the round-3 agg types into the maps
+from elasticsearch_tpu.search.aggregations import extra  # noqa: F401,E402
 
 __all__ = [
     "AggSpec", "parse_aggs", "ShardAggregator", "merge_partials",

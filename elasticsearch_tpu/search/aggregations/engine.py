@@ -45,11 +45,13 @@ def finalize_one(spec: AggSpec, partial: Any) -> Dict[str, Any]:
 def empty_partial(spec: AggSpec) -> Any:
     """A neutral partial for shards/segments that produced nothing."""
     if spec.type in BUCKET_COLLECT:
-        if spec.type in ("filter", "global", "missing"):
+        if spec.type in ("filter", "global", "missing", "nested",
+                         "reverse_nested", "sampler",
+                         "diversified_sampler"):
             return {"doc_count": 0, "subs": {}}
         return {"buckets": {}}
     if spec.type in ("percentiles", "percentile_ranks",
-                     "median_absolute_deviation"):
+                     "median_absolute_deviation", "boxplot"):
         return {"samples": [], "count": 0}
     if spec.type == "cardinality":
         return {"kind": "exact", "hashes": []}
@@ -57,6 +59,20 @@ def empty_partial(spec: AggSpec) -> Any:
         return {"hits": [], "total": 0}
     if spec.type == "weighted_avg":
         return {"wsum": 0.0, "w": 0.0}
+    if spec.type == "geo_bounds":
+        return {"top": None, "bottom": None, "left": None, "right": None}
+    if spec.type == "geo_centroid":
+        return {"sum_lat": 0.0, "sum_lon": 0.0, "count": 0}
+    if spec.type == "string_stats":
+        return {"count": 0, "len_sum": 0, "min_len": None,
+                "max_len": None, "chars": {}}
+    if spec.type == "top_metrics":
+        return {"rows": [], "order": "asc"}
+    if spec.type == "matrix_stats":
+        return {"n": 0, "fields": [], "m1": {}, "m2": {}, "m3": {},
+                "m4": {}, "cross": {}}
+    if spec.type == "scripted_metric":
+        return {"states": []}
     return {"count": 0, "sum": 0.0, "min": None, "max": None,
             "sum_sq": 0.0}
 

@@ -77,6 +77,11 @@ def run_pipelines(pipelines: List[AggSpec], out: Dict[str, Any]) -> None:
             else:
                 out[spec.name] = {"count": 0, "min": None, "max": None,
                                   "avg": None, "sum": 0.0}
+        elif spec.type == "percentiles_bucket":
+            from elasticsearch_tpu.search.aggregations.extra import (
+                sibling_percentiles_bucket,
+            )
+            out[spec.name] = sibling_percentiles_bucket(spec, values)
         else:
             raise IllegalArgumentError(
                 f"[{spec.type}] is not a sibling pipeline aggregation")
@@ -108,6 +113,11 @@ def run_parent_pipelines(pipelines: List[AggSpec], parent: AggSpec,
             blist = _bucket_sort(spec, blist)
             if isinstance(buckets, list):
                 node["buckets"] = blist
+        elif spec.type == "serial_diff":
+            from elasticsearch_tpu.search.aggregations.extra import (
+                parent_serial_diff,
+            )
+            parent_serial_diff(spec, blist)
         else:
             raise IllegalArgumentError(
                 f"[{spec.type}] is not a parent pipeline aggregation")

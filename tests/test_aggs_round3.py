@@ -1,0 +1,186 @@
+"""Round-3 aggregations: nested, sampler, adjacency_matrix, rare_terms,
+auto_date_histogram, geo buckets/metrics, analytics metrics,
+scripted_metric, and the percentiles_bucket / serial_diff pipelines.
+
+Reference: search/aggregations/bucket/{nested,sampler,adjacency,geogrid},
+metrics/{GeoBounds,GeoCentroid,ScriptedMetric}, modules/aggs-matrix-stats,
+x-pack analytics (string_stats, boxplot, top_metrics), pipeline/.
+"""
+
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import MapperService
+from elasticsearch_tpu.search.service import SearchService
+
+
+@pytest.fixture()
+def svc():
+    mappers = MapperService({"properties": {
+        "cat": {"type": "keyword"},
+        "price": {"type": "double"},
+        "qty": {"type": "integer"},
+        "ts": {"type": "date"},
+        "loc": {"type": "geo_point"},
+        "comments": {"type": "nested", "properties": {
+            "stars": {"type": "integer"},
+            "author": {"type": "keyword"}}},
+    }})
+    engine = InternalEngine(mappers)
+    docs = [
+        ("d1", {"cat": "a", "price": 10.0, "qty": 1,
+                "ts": "2024-01-01T00:00:00Z",
+                "loc": {"lat": 48.85, "lon": 2.35},
+                "comments": [{"stars": 5, "author": "kim"},
+                             {"stars": 3, "author": "lee"}]}),
+        ("d2", {"cat": "a", "price": 20.0, "qty": 2,
+                "ts": "2024-01-01T01:00:00Z",
+                "loc": {"lat": 48.86, "lon": 2.36},
+                "comments": [{"stars": 4, "author": "kim"}]}),
+        ("d3", {"cat": "b", "price": 30.0, "qty": 3,
+                "ts": "2024-01-01T02:00:00Z",
+                "loc": {"lat": 51.5, "lon": -0.12}}),
+        ("d4", {"cat": "c", "price": 40.0, "qty": 4,
+                "ts": "2024-01-02T00:00:00Z",
+                "loc": {"lat": 40.71, "lon": -74.0}}),
+    ]
+    for did, src in docs:
+        engine.index(did, src)
+    engine.refresh()
+    return SearchService(engine, index_name="t")
+
+
+def agg(svc, body):
+    return svc.search({"size": 0, "aggs": body})["aggregations"]
+
+
+def test_nested_agg(svc):
+    out = agg(svc, {"c": {"nested": {"path": "comments"}, "aggs": {
+        "avg_stars": {"avg": {"field": "comments.stars"}},
+        "authors": {"terms": {"field": "comments.author"}},
+        "back": {"reverse_nested": {}}}}})
+    assert out["c"]["doc_count"] == 3          # 3 comment objects
+    assert out["c"]["avg_stars"]["value"] == pytest.approx(4.0)
+    authors = {b["key"]: b["doc_count"]
+               for b in out["c"]["authors"]["buckets"]}
+    assert authors == {"kim": 2, "lee": 1}
+    assert out["c"]["back"]["doc_count"] == 2  # parent docs with comments
+
+
+def test_sampler_and_diversified(svc):
+    out = agg(svc, {"s": {"sampler": {"shard_size": 2}, "aggs": {
+        "mx": {"max": {"field": "price"}}}}})
+    assert out["s"]["doc_count"] == 2
+    out = agg(svc, {"s": {"diversified_sampler": {
+        "shard_size": 3, "field": "cat", "max_docs_per_value": 1},
+        "aggs": {"n": {"value_count": {"field": "price"}}}}})
+    assert out["s"]["doc_count"] == 3          # one per distinct cat
+
+
+def test_adjacency_matrix(svc):
+    out = agg(svc, {"adj": {"adjacency_matrix": {"filters": {
+        "cheap": {"range": {"price": {"lte": 20}}},
+        "few": {"range": {"qty": {"lte": 2}}}}}}})
+    got = {b["key"]: b["doc_count"] for b in out["adj"]["buckets"]}
+    assert got == {"cheap": 2, "few": 2, "cheap&few": 2}
+
+
+def test_rare_terms(svc):
+    out = agg(svc, {"r": {"rare_terms": {
+        "field": "cat", "max_doc_count": 1}}})
+    assert [b["key"] for b in out["r"]["buckets"]] == ["b", "c"]
+
+
+def test_auto_date_histogram(svc):
+    out = agg(svc, {"h": {"auto_date_histogram": {
+        "field": "ts", "buckets": 3}}})
+    bks = out["h"]["buckets"]
+    assert sum(b["doc_count"] for b in bks) == 4
+    assert 1 <= len(bks) <= 3
+    assert out["h"]["interval"]
+
+
+def test_geo_distance_agg(svc):
+    out = agg(svc, {"g": {"geo_distance": {
+        "field": "loc", "origin": {"lat": 48.85, "lon": 2.35},
+        "unit": "km",
+        "ranges": [{"to": 100}, {"from": 100, "to": 1000},
+                   {"from": 1000}]}}})
+    by_key = {b["key"]: b["doc_count"] for b in out["g"]["buckets"]}
+    assert by_key["0-100"] == 2                # both Paris docs
+    assert by_key["100-1000"] == 1             # London
+    assert by_key["1000-*"] == 1               # NYC
+
+
+def test_geo_grids_and_metrics(svc):
+    out = agg(svc, {"gh": {"geohash_grid": {"field": "loc",
+                                            "precision": 3}}})
+    total = sum(b["doc_count"] for b in out["gh"]["buckets"])
+    assert total == 4
+    out = agg(svc, {"gt": {"geotile_grid": {"field": "loc",
+                                            "precision": 6}}})
+    assert all(b["key"].startswith("6/") for b in out["gt"]["buckets"])
+    out = agg(svc, {"b": {"geo_bounds": {"field": "loc"}},
+                    "c": {"geo_centroid": {"field": "loc"}}})
+    bounds = out["b"]["bounds"]
+    assert bounds["top_left"]["lat"] == pytest.approx(51.5)
+    assert bounds["top_left"]["lon"] == pytest.approx(-74.0)
+    assert out["c"]["count"] == 4
+
+
+def test_string_stats(svc):
+    out = agg(svc, {"s": {"string_stats": {"field": "cat",
+                                           "show_distribution": True}}})
+    s = out["s"]
+    assert s["count"] == 4 and s["min_length"] == 1 and \
+        s["max_length"] == 1
+    assert s["avg_length"] == 1.0
+    assert s["distribution"]["a"] == pytest.approx(0.5)
+
+
+def test_boxplot_and_top_metrics(svc):
+    out = agg(svc, {"b": {"boxplot": {"field": "price"}}})
+    b = out["b"]
+    assert b["min"] == 10.0 and b["max"] == 40.0 and b["q2"] == 25.0
+    out = agg(svc, {"t": {"top_metrics": {
+        "metrics": {"field": "price"},
+        "sort": {"qty": "desc"}}}})
+    top = out["t"]["top"][0]
+    assert top["sort"] == [4.0] and top["metrics"]["price"] == 40.0
+
+
+def test_matrix_stats(svc):
+    out = agg(svc, {"m": {"matrix_stats": {"fields": ["price", "qty"]}}})
+    fields = {f["name"]: f for f in out["m"]["fields"]}
+    assert out["m"]["doc_count"] == 4
+    assert fields["price"]["mean"] == pytest.approx(25.0)
+    # price and qty are perfectly correlated in the fixture
+    assert fields["price"]["correlation"]["qty"] == pytest.approx(1.0)
+
+
+def test_scripted_metric(svc):
+    out = agg(svc, {"s": {"scripted_metric": {
+        "init_script": "state['total'] = 0",
+        "map_script": "state['total'] = state['total'] + doc['qty'].value",
+        "combine_script": "state['total']",
+        "reduce_script": "sum(states)" if False else
+            "total = 0\nfor s in states:\n    total = total + s\nreturn total",
+    }}})
+    assert out["s"]["value"] == 10.0
+
+
+def test_percentiles_bucket_and_serial_diff(svc):
+    out = agg(svc, {
+        "per_cat": {"terms": {"field": "cat"},
+                    "aggs": {"p": {"sum": {"field": "price"}}}},
+        "pct": {"percentiles_bucket": {"buckets_path": "per_cat>p",
+                                       "percents": [50.0]}}})
+    assert out["pct"]["values"]["50.0"] == 30.0
+    out = agg(svc, {"h": {
+        "date_histogram": {"field": "ts", "fixed_interval": "1h"},
+        "aggs": {"s": {"sum": {"field": "price"}},
+                 "d": {"serial_diff": {"buckets_path": "s", "lag": 1}}}}})
+    bks = out["h"]["buckets"]
+    diffs = [b.get("d", {}).get("value") for b in bks]
+    assert diffs[0] is None or "d" not in bks[0]
+    assert diffs[1] == pytest.approx(10.0)     # 20 - 10
