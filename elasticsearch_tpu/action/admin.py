@@ -49,6 +49,7 @@ PUT_CUSTOM = "cluster:admin/xpack/custom/put"
 DELETE_CUSTOM = "cluster:admin/xpack/custom/delete"
 REROUTE = "cluster:admin/reroute"
 REFRESH_SHARD = "indices:admin/refresh[s]"
+NODE_STATS_ACTION = "cluster:monitor/nodes/stats[n]"
 FLUSH_SHARD = "indices:admin/flush[s]"
 FORCEMERGE_SHARD = "indices:admin/forcemerge[s]"
 STATS_SHARD = "indices:monitor/stats[s]"
@@ -669,7 +670,14 @@ class BroadcastActions:
             if not state.routing_table.has_index(name):
                 continue
             for sr in state.routing_table.index(name).all_shards():
-                if sr.active and sr.node_id is not None:
+                # ALL assigned copies, not just active ones: an
+                # INITIALIZING replica already receives write fan-out (it
+                # is in-sync), so skipping it here would leave acked docs
+                # invisible on it after it starts — the
+                # TransportBroadcastReplicationAction family refreshes
+                # through the whole replication group for the same reason.
+                # A copy whose shard isn't ready yet just counts failed.
+                if sr.assigned and sr.node_id is not None:
                     targets.append(sr)
         result = {"total": len(targets), "successful": 0, "failed": 0}
         payloads: List[Dict[str, Any]] = []

@@ -584,6 +584,22 @@ class CompiledProcessor:
             ref = _req(cfg, "pipeline", "name")
             self.run_inner: Processor = \
                 lambda doc: service.execute_pipeline(ref, doc)
+        elif ptype == "enrich":
+            # joins against the node's executed policy tables
+            # (x-pack/plugin/enrich MatchProcessor analog). Config shape
+            # validates even without a node (the static validate() path);
+            # only RUNNING requires the cluster context.
+            from elasticsearch_tpu.xpack.enrich import (
+                make_enrich_processor, validate_enrich_config,
+            )
+            validate_enrich_config(cfg)
+            if service.node is not None:
+                self.run_inner = make_enrich_processor(service.node, cfg)
+            else:
+                def _no_cluster(_doc):
+                    raise IllegalArgumentError(
+                        "[enrich] processor requires a cluster context")
+                self.run_inner = _no_cluster
         else:
             factory = PROCESSORS.get(ptype)
             if factory is None:
@@ -626,8 +642,11 @@ class IngestService:
     """Compiles + caches pipelines from cluster-state settings and runs
     them over bulk items before routing."""
 
-    def __init__(self, state_supplier: Callable[[], Any]):
+    def __init__(self, state_supplier: Callable[[], Any], node: Any = None):
         self.state = state_supplier
+        # the owning node, for processors that join against cluster-level
+        # lookups (enrich); None in standalone pipeline tests
+        self.node = node
         self._cache: Dict[str, Any] = {}   # id -> (raw_def, [processors])
 
     # -- registry --------------------------------------------------------

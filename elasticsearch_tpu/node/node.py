@@ -13,7 +13,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from elasticsearch_tpu.action.admin import (
     BroadcastActions, CLUSTER_UPDATE_SETTINGS, CREATE_INDEX, DELETE_INDEX,
-    FLUSH_SHARD, FORCEMERGE_SHARD, MasterActions, MasterClient, PUT_MAPPING,
+    FLUSH_SHARD, FORCEMERGE_SHARD, MasterActions, MasterClient,
+    NODE_STATS_ACTION, PUT_MAPPING,
     REFRESH_SHARD, STATS_SHARD, UPDATE_ALIASES, UPDATE_SETTINGS,
     cluster_health,
 )
@@ -94,7 +95,7 @@ class Node:
                                           self.coordinator)
 
         from elasticsearch_tpu.ingest import IngestService
-        self.ingest_service = IngestService(self._applied_state)
+        self.ingest_service = IngestService(self._applied_state, node=self)
 
         from elasticsearch_tpu.tasks import TaskManager
         self.task_manager = TaskManager(
@@ -173,10 +174,41 @@ class Node:
         self.ccr_shard_actions = CcrShardActions(self)
         self.ccr_service = CcrService(self)
 
+        from elasticsearch_tpu.xpack.eql import EqlService
+        self.eql = EqlService(self)
+
+        from elasticsearch_tpu.xpack.rollup import RollupService
+        self.rollup_service = RollupService(self)
+
+        from elasticsearch_tpu.xpack.enrich import EnrichService
+        self.enrich_service = EnrichService(self)
+
+        from elasticsearch_tpu.xpack.graph import GraphService
+        self.graph_service = GraphService(self)
+
+        from elasticsearch_tpu.xpack.monitoring import MonitoringService
+        self.monitoring_service = MonitoringService(self)
+
+        # per-node stats endpoint (TransportNodesStatsAction node-level
+        # handler): the coordinating node fans `_nodes/stats` out here
+        self.transport_service.register_handler(
+            NODE_STATS_ACTION, lambda req, sender: self.local_node_stats())
+
     # ------------------------------------------------------------------
 
     def _applied_state(self) -> ClusterState:
         return self.coordinator.applied_state
+
+    def local_node_stats(self) -> Dict[str, Any]:
+        from elasticsearch_tpu.indices.breaker import BREAKERS
+        return {
+            "name": self.node_id,
+            "indices": self.indices_service.stats(),
+            "transport": dict(self.transport_service.stats),
+            "breakers": BREAKERS.stats(),
+            "adaptive_selection":
+                self.search_action.response_collector.stats(),
+        }
 
     def _on_committed(self, state: ClusterState) -> None:
         # appliers are isolated from each other: a reconciler failure (e.g. a
@@ -238,8 +270,12 @@ class Node:
         self.transform_service.start()
         self.watcher_service.start()
         self.ccr_service.start()
+        self.rollup_service.start()
+        self.monitoring_service.start()
 
     def stop(self) -> None:
+        self.monitoring_service.stop()
+        self.rollup_service.stop()
         self.ccr_service.stop()
         self.watcher_service.stop()
         self.transform_service.stop()
@@ -864,20 +900,35 @@ class NodeClient:
         return self.node._applied_state().to_dict()
 
     def nodes_stats(self) -> Dict[str, Any]:
-        from elasticsearch_tpu.indices.breaker import BREAKERS
-        return {
-            "nodes": {
-                self.node.node_id: {
-                    "name": self.node.node_id,
-                    "indices": self.node.indices_service.stats(),
-                    "transport": dict(
-                        self.node.transport_service.stats),
-                    "breakers": BREAKERS.stats(),
-                    "adaptive_selection":
-                        self.node.search_action.response_collector.stats(),
-                }
-            }
-        }
+        """Local node's stats only (the historical sync form)."""
+        return {"nodes": {self.node.node_id: self.node.local_node_stats()}}
+
+    def nodes_stats_all(self, on_done) -> None:
+        """Every cluster node's stats, gathered over transport
+        (TransportNodesStatsAction fan-out)."""
+        state = self.node._applied_state()
+        node_ids = sorted(state.nodes)
+        out: Dict[str, Any] = {}
+        pending = {"n": len(node_ids)}
+        if not node_ids:
+            on_done({"nodes": {}}, None)
+            return
+        for nid in node_ids:
+            def cb(resp, err, nid=nid):
+                if err is None and resp is not None:
+                    out[nid] = resp
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    on_done({"_nodes": {"total": len(node_ids),
+                                        "successful": len(out),
+                                        "failed":
+                                            len(node_ids) - len(out)},
+                             "nodes": out}, None)
+            if nid == self.node.node_id:
+                cb(self.node.local_node_stats(), None)
+            else:
+                self.node.transport_service.send_request(
+                    nid, NODE_STATS_ACTION, {}, cb, timeout=30.0)
 
 
 def _shards_only(r: Dict[str, Any]) -> Dict[str, Any]:

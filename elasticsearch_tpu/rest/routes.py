@@ -494,6 +494,111 @@ def build_controller(client: NodeClient) -> RestController:
         done(200, translate(parse_sql((req.body or {}).get("query", ""))))
     r("POST", "/_sql/translate", sql_translate)
 
+    # -- EQL (x-pack/plugin/eql REST surface) -----------------------------
+
+    def eql_search(req: RestRequest, done: DoneFn) -> None:
+        client.node.eql.search(req.params["index"], req.body or {},
+                               wrap_client_cb(done))
+    r("POST", "/{index}/_eql/search", eql_search)
+    r("GET", "/{index}/_eql/search", eql_search)
+
+    # -- rollup (x-pack/plugin/rollup REST surface) -----------------------
+
+    def rollup_put(req: RestRequest, done: DoneFn) -> None:
+        client.node.rollup_service.put_job(
+            req.params["id"], req.body or {}, wrap_client_cb(done))
+    r("PUT", "/_rollup/job/{id}", rollup_put)
+
+    def rollup_delete(req: RestRequest, done: DoneFn) -> None:
+        client.node.rollup_service.delete_job(
+            req.params["id"], wrap_client_cb(done))
+    r("DELETE", "/_rollup/job/{id}", rollup_delete)
+
+    def rollup_start(req: RestRequest, done: DoneFn) -> None:
+        client.node.rollup_service.set_started(
+            req.params["id"], True, wrap_client_cb(done))
+    r("POST", "/_rollup/job/{id}/_start", rollup_start)
+
+    def rollup_stop(req: RestRequest, done: DoneFn) -> None:
+        client.node.rollup_service.set_started(
+            req.params["id"], False, wrap_client_cb(done))
+    r("POST", "/_rollup/job/{id}/_stop", rollup_stop)
+
+    def rollup_jobs(req: RestRequest, done: DoneFn) -> None:
+        out = client.node.rollup_service.jobs()
+        job_id = req.params.get("id")
+        if job_id is not None:
+            out = {"jobs": [j for j in out["jobs"]
+                            if j["config"]["id"] == job_id]}
+            if not out["jobs"]:
+                done(404, {"error": {
+                    "type": "resource_not_found_exception",
+                    "reason": f"rollup job [{job_id}] not found"}})
+                return
+        done(200, out)
+    r("GET", "/_rollup/job", rollup_jobs)
+    r("GET", "/_rollup/job/{id}", rollup_jobs)
+
+    def rollup_search(req: RestRequest, done: DoneFn) -> None:
+        client.node.rollup_service.rollup_search(
+            req.params["index"], req.body or {}, wrap_client_cb(done))
+    r("POST", "/{index}/_rollup_search", rollup_search)
+    r("GET", "/{index}/_rollup_search", rollup_search)
+
+    # -- enrich (x-pack/plugin/enrich REST surface) -----------------------
+
+    def enrich_put(req: RestRequest, done: DoneFn) -> None:
+        client.node.enrich_service.put_policy(
+            req.params["name"], req.body or {}, wrap_client_cb(done))
+    r("PUT", "/_enrich/policy/{name}", enrich_put)
+
+    def enrich_delete(req: RestRequest, done: DoneFn) -> None:
+        client.node.enrich_service.delete_policy(
+            req.params["name"], wrap_client_cb(done))
+    r("DELETE", "/_enrich/policy/{name}", enrich_delete)
+
+    def enrich_execute(req: RestRequest, done: DoneFn) -> None:
+        client.node.enrich_service.execute_policy(
+            req.params["name"], wrap_client_cb(done))
+    r("PUT", "/_enrich/policy/{name}/_execute", enrich_execute)
+    r("POST", "/_enrich/policy/{name}/_execute", enrich_execute)
+
+    def enrich_list(req: RestRequest, done: DoneFn) -> None:
+        out = client.node.enrich_service.policies()
+        name = req.params.get("name")
+        if name is not None:
+            out = {"policies": [
+                p for p in out["policies"]
+                if any(cfg.get("name") == name
+                       for cfg in p["config"].values())]}
+            if not out["policies"]:
+                done(404, {"error": {
+                    "type": "resource_not_found_exception",
+                    "reason": f"enrich policy [{name}] not found"}})
+                return
+        done(200, out)
+    r("GET", "/_enrich/policy", enrich_list)
+    r("GET", "/_enrich/policy/{name}", enrich_list)
+
+    # -- graph (x-pack/plugin/graph REST surface) -------------------------
+
+    def graph_explore(req: RestRequest, done: DoneFn) -> None:
+        client.node.graph_service.explore(
+            req.params["index"], req.body or {}, wrap_client_cb(done))
+    r("POST", "/{index}/_graph/explore", graph_explore)
+    r("GET", "/{index}/_graph/explore", graph_explore)
+
+    # -- monitoring (x-pack/plugin/monitoring, local-exporter shape) ------
+
+    def monitoring_stats(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.node.monitoring_service.stats())
+    r("GET", "/_monitoring/stats", monitoring_stats)
+
+    def monitoring_collect(req: RestRequest, done: DoneFn) -> None:
+        client.node.monitoring_service.collect_now()
+        done(200, {"acknowledged": True})
+    r("POST", "/_monitoring/_collect", monitoring_collect)
+
     def authenticate(req: RestRequest, done: DoneFn) -> None:
         user = client.node.security.authenticate(req.headers or {})
         if user is None:
@@ -774,7 +879,7 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/_nodes", nodes)
 
     def nodes_stats(req: RestRequest, done: DoneFn) -> None:
-        done(200, client.nodes_stats())
+        client.nodes_stats_all(wrap_client_cb(done))
     r("GET", "/_nodes/stats", nodes_stats)
 
     # -- cat (human tables) ----------------------------------------------

@@ -1,0 +1,254 @@
+"""EQL, rollup, enrich, graph explore, and monitoring.
+
+Reference: x-pack/plugin/eql (parser + sequence TumblingWindow),
+x-pack/plugin/rollup (RollupIndexer + rollup_search translation),
+x-pack/plugin/enrich (policy runner + MatchProcessor),
+x-pack/plugin/graph (TransportGraphExploreAction),
+x-pack/plugin/monitoring (collectors + local exporter).
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+from elasticsearch_tpu.xpack.eql import parse_eql
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=2, seed=11)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _seed_events(cluster, client):
+    _ok(*cluster.call(lambda cb: client.create_index("logs", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "event.category": {"type": "keyword"},
+            "@timestamp": {"type": "date"},
+            "user": {"type": "keyword"},
+            "proc": {"type": "keyword"},
+            "bytes": {"type": "integer"}}}}, cb)))
+    cluster.ensure_green("logs")
+    events = [
+        ("e1", "process", "2024-01-01T00:00:01Z", "alice", "bash", 10),
+        ("e2", "network", "2024-01-01T00:00:02Z", "alice", "curl", 200),
+        ("e3", "process", "2024-01-01T00:00:03Z", "bob", "zsh", 5),
+        ("e4", "network", "2024-01-01T00:00:10Z", "bob", "wget", 999),
+        ("e5", "process", "2024-01-01T00:01:00Z", "alice", "bash", 7),
+        ("e6", "network", "2024-01-01T00:05:00Z", "alice", "nc", 1),
+    ]
+    for eid, cat, ts, user, proc, nbytes in events:
+        _ok(*cluster.call(lambda cb, e=(eid, cat, ts, user, proc, nbytes):
+                          client.index_doc("logs", e[0], {
+                              "event.category": e[1], "@timestamp": e[2],
+                              "user": e[3], "proc": e[4], "bytes": e[5]},
+                              cb)))
+    cluster.call(lambda cb: client.refresh("logs", cb))
+
+
+# ---------------------------------------------------------------------------
+# EQL
+# ---------------------------------------------------------------------------
+
+def test_eql_parse_shapes():
+    p = parse_eql('process where proc == "bash" and bytes > 5')
+    assert p["kind"] == "event"
+    p = parse_eql('sequence by user with maxspan=30s '
+                  '[process where true] [network where bytes > 100]')
+    assert p["kind"] == "sequence" and p["by"] == ["user"]
+    assert p["maxspan_ms"] == 30_000
+    with pytest.raises(IllegalArgumentError):
+        parse_eql("sequence [proc where a == 1]")   # one stage
+    with pytest.raises(IllegalArgumentError):
+        parse_eql("process where ???")
+
+
+def test_eql_event_query(cluster):
+    client = cluster.client()
+    _seed_events(cluster, client)
+    node = cluster.master()
+    resp = _ok(*cluster.call(lambda cb: node.eql.search("logs", {
+        "query": 'process where proc in ("bash", "zsh") and bytes >= 5'},
+        cb)))
+    ids = [e["_id"] for e in resp["hits"]["events"]]
+    assert ids == ["e1", "e3", "e5"]           # time ascending
+    # pipes
+    resp = _ok(*cluster.call(lambda cb: node.eql.search("logs", {
+        "query": 'any where bytes > 0 | tail 2'}, cb)))
+    assert [e["_id"] for e in resp["hits"]["events"]] == ["e5", "e6"]
+
+
+def test_eql_sequence(cluster):
+    client = cluster.client()
+    _seed_events(cluster, client)
+    node = cluster.master()
+    resp = _ok(*cluster.call(lambda cb: node.eql.search("logs", {
+        "query": 'sequence by user with maxspan=30s '
+                 '[process where bytes >= 5] [network where bytes > 100]'},
+        cb)))
+    seqs = resp["hits"]["sequences"]
+    # alice: e1(00:01)->e2(00:02, 200 bytes) within 30s; bob: e3->e4 within
+    # 7s (999 bytes). alice's e5->e6 pair fails the bytes filter.
+    got = {tuple(s["join_keys"]): [e["_id"] for e in s["events"]]
+           for s in seqs}
+    assert got == {("alice",): ["e1", "e2"], ("bob",): ["e3", "e4"]}
+    # maxspan excludes pairs spread too far apart
+    resp = _ok(*cluster.call(lambda cb: node.eql.search("logs", {
+        "query": 'sequence by user with maxspan=1s '
+                 '[process where true] [network where true]'}, cb)))
+    got = {tuple(s["join_keys"]) for s in resp["hits"]["sequences"]}
+    assert got == {("alice",)}                 # only e1->e2 is within 1s
+
+
+# ---------------------------------------------------------------------------
+# rollup
+# ---------------------------------------------------------------------------
+
+def test_rollup_job_and_search(cluster):
+    client = cluster.client()
+    _seed_events(cluster, client)
+    node = cluster.master()
+    _ok(*cluster.call(lambda cb: node.rollup_service.put_job("j1", {
+        "index_pattern": "logs", "rollup_index": "logs_rollup",
+        "groups": {
+            "date_histogram": {"field": "@timestamp",
+                               "fixed_interval": "1m"},
+            "terms": {"fields": ["user"]}},
+        "metrics": [{"field": "bytes",
+                     "metrics": ["sum", "max", "value_count"]}]}, cb)))
+    _ok(*cluster.call(lambda cb: node.rollup_service.set_started(
+        "j1", True, cb)))
+    cluster.run_until(
+        lambda: node.rollup_service._state.get("j1", {}).get("docs", 0) > 0,
+        max_time=120.0)
+    cluster.call(lambda cb: client.refresh("logs_rollup", cb))
+    jobs = node.rollup_service.jobs()
+    assert jobs["jobs"][0]["status"]["job_state"] == "started"
+    assert jobs["jobs"][0]["stats"]["documents_processed"] >= 3
+
+    resp = _ok(*cluster.call(lambda cb: node.rollup_service.rollup_search(
+        "logs_rollup", {"aggs": {
+            "per_user": {"terms": {"field": "user"},
+                         "aggs": {"total": {"sum": {"field": "bytes"}}}}}},
+        cb)))
+    by_user = {b["key"]: b["total"]["value"]
+               for b in resp["aggregations"]["per_user"]["buckets"]}
+    assert by_user == {"alice": 218.0, "bob": 1004.0}
+
+
+# ---------------------------------------------------------------------------
+# enrich
+# ---------------------------------------------------------------------------
+
+def test_enrich_policy_and_processor(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("users", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "email": {"type": "keyword"},
+            "name": {"type": "keyword"},
+            "dept": {"type": "keyword"}}}}, cb)))
+    cluster.ensure_green("users")
+    for i, (email, name, dept) in enumerate([
+            ("a@x.com", "Alice", "eng"), ("b@x.com", "Bob", "ops")]):
+        _ok(*cluster.call(lambda cb, d=(email, name, dept), i=i:
+                          client.index_doc("users", f"u{i}", {
+                              "email": d[0], "name": d[1], "dept": d[2]},
+                              cb)))
+    cluster.call(lambda cb: client.refresh("users", cb))
+    node = cluster.master()
+    _ok(*cluster.call(lambda cb: node.enrich_service.put_policy("users-p", {
+        "match": {"indices": "users", "match_field": "email",
+                  "enrich_fields": ["name", "dept"]}}, cb)))
+    resp = _ok(*cluster.call(
+        lambda cb: node.enrich_service.execute_policy("users-p", cb)))
+    assert resp["entries"] == 2
+    # ingest pipeline with the enrich processor
+    _ok(*cluster.call(lambda cb: client.put_pipeline("enrich-pipe", {
+        "processors": [{"enrich": {
+            "policy_name": "users-p", "field": "email",
+            "target_field": "user_info"}}]}, cb)))
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "events2", "d1", {"email": "a@x.com", "msg": "hi"},
+        cb, pipeline="enrich-pipe")))
+    cluster.call(lambda cb: client.refresh("events2", cb))
+    res, err = cluster.call(lambda cb: client.search(
+        "events2", {"query": {"match_all": {}}}, cb))
+    assert err is None
+    src = res["hits"]["hits"][0]["_source"]
+    assert src["user_info"] == {"name": "Alice", "dept": "eng"}
+
+
+# ---------------------------------------------------------------------------
+# graph + monitoring
+# ---------------------------------------------------------------------------
+
+def test_graph_explore(cluster):
+    client = cluster.client()
+    _seed_events(cluster, client)
+    node = cluster.master()
+    resp = _ok(*cluster.call(lambda cb: node.graph_service.explore("logs", {
+        "query": {"match_all": {}},
+        "controls": {"use_significance": False},
+        "vertices": [{"field": "user", "size": 5},
+                     {"field": "proc", "size": 5}]}, cb)))
+    fields = {v["field"] for v in resp["vertices"]}
+    assert fields == {"user", "proc"}
+    # alice co-occurs with bash (2 docs)
+    vmap = {i: v for i, v in enumerate(resp["vertices"])}
+    pairs = {(vmap[c["source"]]["term"], vmap[c["target"]]["term"]):
+             c["doc_count"] for c in resp["connections"]}
+    assert any({"alice", "bash"} == set(p) and n == 2
+               for p, n in pairs.items())
+
+
+def test_refresh_reaches_initializing_replicas(cluster):
+    """Write -> refresh -> search must see the doc even when a replica
+    was INITIALIZING at refresh time: in-sync initializing copies receive
+    write fan-out, so the refresh broadcast must cover them too
+    (TransportBroadcastReplicationAction semantics). Regression: the
+    broadcast used to target only ACTIVE copies."""
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("fast", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+        "mappings": {"properties": {"v": {"type": "keyword"}}}}, cb)))
+    # deliberately no ensure_green: the replica may still be initializing
+    cluster.ensure_yellow("fast")
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "fast", "d1", {"v": "x"}, cb)))
+    cluster.call(lambda cb: client.refresh("fast", cb))
+    res, err = cluster.call(lambda cb: client.search(
+        "fast", {"query": {"match_all": {}}}, cb))
+    assert err is None
+    assert res["hits"]["total"]["value"] == 1
+
+
+def test_monitoring_collection(cluster):
+    client = cluster.client()
+    _seed_events(cluster, client)
+    node = cluster.master()
+    node.monitoring_service.collect_now()
+    cluster.run_until(
+        lambda: node._applied_state().metadata.has_index(".monitoring-es"),
+        max_time=60.0)
+    cluster.ensure_yellow(".monitoring-es")
+    # the bulk's doc writes land in events after the index creation —
+    # drain the scheduler before refreshing
+    with pytest.raises(TimeoutError):
+        cluster.run_until(lambda: False, max_time=5.0)
+    cluster.call(lambda cb: client.refresh(".monitoring-es", cb))
+    res, err = cluster.call(lambda cb: client.search(
+        ".monitoring-es",
+        {"query": {"term": {"type.keyword": "cluster_stats"}}}, cb))
+    assert err is None
+    hit = res["hits"]["hits"][0]["_source"]
+    assert hit["nodes"] == 2 and hit["indices"] >= 1
+    assert node.monitoring_service.stats()["collections"] == 1
