@@ -66,7 +66,8 @@ class HttpServer:
                     break
                 status, body = await self._dispatch(request)
                 await self._write_response(writer, status, body,
-                                           head=request.method == "HEAD")
+                                           head=request.method == "HEAD",
+                                           request=request)
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -112,11 +113,24 @@ class HttpServer:
         split = urlsplit(target)
         query = dict(parse_qsl(split.query, keep_blank_values=True))
         body: Any = None
-        if raw and "json" in headers.get("content-type", "json"):
-            try:
-                body = json.loads(raw)
-            except json.JSONDecodeError:
-                body = None
+        ctype = headers.get("content-type", "")
+        if raw and "x-ndjson" not in ctype:
+            # multi-format body parsing (libs/x-content XContentFactory
+            # analog): JSON / YAML / CBOR / SMILE by content-type, with
+            # leading-byte sniffing when absent. NDJSON (bulk) stays
+            # raw. YAML is only parsed when DECLARED — sniffing it would
+            # turn arbitrary plain-text bodies into scalar strings that
+            # handlers expecting dict-or-None would 500 on.
+            from elasticsearch_tpu.utils import xcontent
+            declared = xcontent.format_from_content_type(ctype or None)
+            fmt = declared or xcontent.sniff_format(raw)
+            if fmt != xcontent.YAML or declared == xcontent.YAML:
+                try:
+                    parsed = xcontent.loads(raw, xcontent.CONTENT_TYPES[fmt])
+                    if isinstance(parsed, (dict, list)):
+                        body = parsed
+                except Exception:  # noqa: BLE001 — handlers 400 on None
+                    body = None
         return RestRequest(method=method, path=split.path, query=query,
                            body=body, raw_body=raw, headers=headers)
 
@@ -155,13 +169,27 @@ class HttpServer:
 
     async def _write_response(self, writer: asyncio.StreamWriter,
                               status: int, body: Any,
-                              head: bool = False) -> None:
+                              head: bool = False,
+                              request: Optional[RestRequest] = None
+                              ) -> None:
         if isinstance(body, str):
             payload = body.encode("utf-8")
             ctype = "text/plain; charset=UTF-8"
         else:
-            payload = json.dumps(body).encode("utf-8")
-            ctype = "application/json; charset=UTF-8"
+            # response format mirrors the request body format unless
+            # Accept overrides (RestRequest.getResponseContentType)
+            from elasticsearch_tpu.utils import xcontent
+            req_fmt = None
+            accept = None
+            if request is not None:
+                accept = (request.headers or {}).get("accept")
+                req_fmt = xcontent.format_from_content_type(
+                    (request.headers or {}).get("content-type"))
+            fmt = xcontent.response_format(accept, req_fmt)
+            payload = xcontent.dumps(body, fmt)
+            ctype = (f"{xcontent.CONTENT_TYPES[fmt]}; charset=UTF-8"
+                     if fmt in (xcontent.JSON, xcontent.YAML)
+                     else xcontent.CONTENT_TYPES[fmt])
         reason = {200: "OK", 201: "Created", 404: "Not Found",
                   400: "Bad Request", 405: "Method Not Allowed",
                   409: "Conflict", 429: "Too Many Requests",

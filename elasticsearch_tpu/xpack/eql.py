@@ -356,9 +356,13 @@ class EqlService:
                 on_done(None, err)
                 return
             hits = resp["hits"]["hits"]
+            # a tail over a window that filled up may be missing the true
+            # latest events — report partiality like the sequence path
+            truncated = any(k == "tail" for k, _n in plan["pipes"]) \
+                and len(hits) >= SWEEP_SIZE
             hits = self._apply_pipes(hits, plan["pipes"])[:size]
             on_done({
-                "is_partial": False, "timed_out": False,
+                "is_partial": truncated, "timed_out": False,
                 "hits": {"total": resp["hits"]["total"],
                          "events": [self._event(h) for h in hits]}}, None)
         self.node.search_action.execute(index, {

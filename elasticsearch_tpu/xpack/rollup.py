@@ -201,15 +201,21 @@ class RollupService:
                     return
                 comp = (resp.get("aggregations") or {}).get("r") or {}
                 buckets = comp.get("buckets", [])
+                page_max_ts = None
                 for b in buckets:
                     ts = b["key"].get("ts")
                     if ts is not None:
-                        st["ckpt"] = max(st.get("ckpt") or ts, ts)
+                        page_max_ts = ts if page_max_ts is None \
+                            else max(page_max_ts, ts)
                 items = []
                 dh = d["groups"]["date_histogram"]
                 for b in buckets:
                     key = b["key"]
-                    doc_id = f"{job_id}${'_'.join(str(v) for v in sorted(map(str, key.values())))}"
+                    # id carries key NAMES: value-only ids collide when
+                    # two group fields swap values ({user:a, host:b} vs
+                    # {user:b, host:a})
+                    doc_id = f"{job_id}$" + "_".join(
+                        f"{k}={key[k]}" for k in sorted(key))
                     src: Dict[str, Any] = {
                         "_rollup.id": job_id,
                         f"{dh['field']}.date_histogram.timestamp":
@@ -229,13 +235,19 @@ class RollupService:
                     items.append({"action": "index",
                                   "index": d["rollup_index"],
                                   "id": doc_id, "source": src})
-                def bulked(_r=None):
-                    # counters advance only after the bulk APPLIED, so
-                    # progress observers never race the written docs
+                def bulked(bulk_resp=None):
+                    # counters AND the checkpoint advance only after the
+                    # bulk APPLIED cleanly — a failed write must be
+                    # re-rolled on the next incremental run, not skipped
                     st["pages"] = st.get("pages", 0) + 1
-                    st["docs"] = st.get("docs", 0) + len(items)
+                    ok = not (bulk_resp or {}).get("errors")
+                    if ok:
+                        st["docs"] = st.get("docs", 0) + len(items)
+                        if page_max_ts is not None:
+                            st["ckpt"] = max(st.get("ckpt") or page_max_ts,
+                                             page_max_ts)
                     after_key = comp.get("after_key")
-                    if after_key and len(buckets) >= PAGE:
+                    if ok and after_key and len(buckets) >= PAGE:
                         page(after_key)
                     else:
                         st["busy"] = False
@@ -262,8 +274,9 @@ class RollupService:
         body = dict(body or {})
         aggs = body.get("aggs") or body.get("aggregations") or {}
         rewritten, post = self._rewrite_aggs(aggs)
-        req = {"size": 0, "query": body.get("query", {"match_all": {}}),
-               "aggs": rewritten}
+        query = self._rewrite_query(
+            index, body.get("query", {"match_all": {}}))
+        req = {"size": 0, "query": query, "aggs": rewritten}
 
         def cb(resp, err):
             if err is not None:
@@ -275,6 +288,44 @@ class RollupService:
                               "hits": []},
                      "aggregations": post(out)}, None)
         self.node.search_action.execute(index, req, cb)
+
+    def _rewrite_query(self, index: str, query: Any) -> Any:
+        """Field names in the user's query refer to SOURCE fields; rolled
+        docs store them under .date_histogram.timestamp / .terms.value,
+        so leaves rewrite against the rollup index's actual mappings
+        (RollupRequestTranslator's query rewrite)."""
+        try:
+            props = dict(self.node._applied_state().metadata
+                         .index(index).mappings
+                         .get("properties", {}))
+        except Exception:  # noqa: BLE001 — unknown index: pass through
+            props = {}
+
+        def rolled_name(f: str) -> str:
+            for suffix in (".date_histogram.timestamp", ".terms.value"):
+                if f"{f}{suffix}" in props:
+                    return f"{f}{suffix}"
+            return f
+
+        def walk(q: Any) -> Any:
+            if not isinstance(q, dict) or len(q) != 1:
+                return q
+            (kind, spec), = q.items()
+            if kind == "bool":
+                return {"bool": {
+                    occur: ([walk(c) for c in clauses]
+                            if isinstance(clauses, list) else walk(clauses))
+                    if occur in ("must", "should", "must_not", "filter")
+                    else clauses
+                    for occur, clauses in spec.items()}}
+            if kind in ("term", "terms", "range", "match") and \
+                    isinstance(spec, dict) and len(spec) >= 1:
+                out = {}
+                for f, v in spec.items():
+                    out[rolled_name(f) if isinstance(f, str) else f] = v
+                return {kind: out}
+            return q
+        return walk(query)
 
     def _rewrite_aggs(self, aggs: Dict[str, Any]):
         rewritten: Dict[str, Any] = {}
