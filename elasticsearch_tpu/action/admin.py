@@ -472,11 +472,22 @@ class MasterActions:
 
     def _on_reroute(self, req: Dict[str, Any], sender: str) -> Deferred:
         """Explicit shard-movement commands + a reallocation pass. With no
-        commands this is the bare "kick the allocator" call."""
+        commands this is the bare "kick the allocator" call;
+        ?retry_failed resets MaxRetryDecider's failure streaks
+        (AllocationService.reroute retryFailed analog)."""
         commands = req.get("commands") or []
+        retry_failed = bool(req.get("retry_failed"))
 
         def update(state: ClusterState) -> ClusterState:
             routing = state.routing_table
+            if retry_failed:
+                from dataclasses import replace as _replace
+                for sr in list(routing.all_shards()):
+                    if sr.failed_attempts and not sr.assigned:
+                        irt0 = routing.index(sr.index)
+                        routing = routing.put_index(irt0.replace_shard(
+                            sr, _replace(sr, failed_attempts=0)))
+                state = state.next_version(routing_table=routing)
             for command in commands:
                 try:
                     (kind, spec), = command.items()

@@ -9,7 +9,7 @@ the master runs them inside state updates.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Dict, Iterable, List, Optional, Sequence
 
 from elasticsearch_tpu.cluster.routing import (
@@ -79,8 +79,79 @@ class ThrottlingDecider(AllocationDecider):
         return Decision.YES
 
 
+class MaxRetryDecider(AllocationDecider):
+    """Stop retry storms: a shard that failed allocation too many times
+    stays unassigned until an explicit reroute with retry_failed
+    (decider/MaxRetryAllocationDecider.java)."""
+
+    def __init__(self, max_retries: int = 5) -> None:
+        self.max_retries = max_retries
+
+    def can_allocate(self, shard, node, state):
+        if shard.failed_attempts >= self.max_retries:
+            return Decision.NO
+        return Decision.YES
+
+
+class AwarenessDecider(AllocationDecider):
+    """Spread copies of a shard across values of the awareness attributes
+    (decider/AwarenessAllocationDecider.java): a node whose attribute
+    value already holds its fair share of this shard's copies is
+    rejected. Attributes come from the dynamic cluster setting
+    cluster.routing.allocation.awareness.attributes."""
+
+    def can_allocate(self, shard, node, state):
+        attrs_setting = state.metadata.persistent_settings.get(
+            "cluster.routing.allocation.awareness.attributes")
+        if not attrs_setting:
+            return Decision.YES
+        group = state.routing_table.index(shard.index) \
+            .shard_group(shard.shard_id) \
+            if state.routing_table.has_index(shard.index) else ()
+        n_copies = max(len(group), 1)
+        for attr in str(attrs_setting).split(","):
+            attr = attr.strip()
+            if not attr:
+                continue
+            values = {n.attr(attr) for n in state.data_nodes().values()
+                      if n.attr(attr) is not None}
+            if not values:
+                continue
+            my_value = node.attr(attr)
+            per_value_cap = -(-n_copies // len(values))  # ceil
+            assigned_here = sum(
+                1 for sr in group
+                if sr.assigned and sr.node_id in state.nodes
+                and state.nodes[sr.node_id].attr(attr) == my_value)
+            if assigned_here >= per_value_cap:
+                return Decision.NO
+        return Decision.YES
+
+
+class DiskThresholdDecider(AllocationDecider):
+    """Keep shards off nodes past the low watermark
+    (decider/DiskThresholdDecider.java). Usage comes from the cluster
+    info the master refreshes from node stats
+    (InternalClusterInfoService analog); absent info allows."""
+
+    def __init__(self, low_watermark: float = 0.85) -> None:
+        self.low_watermark = low_watermark
+        # node_id -> (used_bytes, total_bytes)
+        self.usages: Dict[str, tuple] = {}
+
+    def can_allocate(self, shard, node, state):
+        got = self.usages.get(node.node_id)
+        if not got:
+            return Decision.YES
+        used, total = got
+        if total > 0 and used / total >= self.low_watermark:
+            return Decision.NO
+        return Decision.YES
+
+
 DEFAULT_DECIDERS: Sequence[AllocationDecider] = (
     SameShardDecider(), FilterDecider(), ThrottlingDecider(),
+    MaxRetryDecider(), AwarenessDecider(), DiskThresholdDecider(),
 )
 
 
@@ -103,17 +174,62 @@ class AllocationService:
 
     # -- reroute -------------------------------------------------------------
 
-    def reroute(self, state: ClusterState) -> ClusterState:
-        """Assign unassigned shards (primaries first) to the least-loaded
-        eligible data node. Idempotent; no-op returns the same state."""
+    # BalancedShardsAllocator weight factors
+    # (cluster.routing.allocation.balance.shard / .index defaults)
+    SHARD_BALANCE = 0.45
+    INDEX_BALANCE = 0.55
+    REBALANCE_THRESHOLD = 1.0
+
+    def _weight(self, loads: Dict[str, int],
+                index_loads: Dict[str, Dict[str, int]],
+                nid: str, index: str, n_nodes: int,
+                total_shards: int, index_total: int) -> float:
+        """BalancedShardsAllocator.WeightFunction: a node is attractive
+        for a shard of [index] when it holds fewer shards overall AND
+        fewer shards of that index than its fair share."""
+        avg_shards = total_shards / n_nodes
+        avg_index = index_total / n_nodes
+        return (self.SHARD_BALANCE * (loads[nid] - avg_shards)
+                + self.INDEX_BALANCE *
+                (index_loads[nid].get(index, 0) - avg_index))
+
+    def reroute(self, state: ClusterState,
+                rebalance: bool = True) -> ClusterState:
+        """Assign unassigned shards (primaries first) to the
+        minimum-weight eligible node, then move replicas off overloaded
+        nodes when the weight spread exceeds the threshold. Idempotent;
+        no-op returns the same state."""
         data_nodes = state.data_nodes()
         if not data_nodes:
             return state
-        loads: Dict[str, int] = {
-            nid: len(state.routing_table.shards_on_node(nid))
-            for nid in data_nodes}
         routing = state.routing_table
+        loads: Dict[str, int] = {
+            nid: len(routing.shards_on_node(nid)) for nid in data_nodes}
+        index_loads: Dict[str, Dict[str, int]] = {
+            nid: {} for nid in data_nodes}
+        for nid in data_nodes:
+            for sr in routing.shards_on_node(nid):
+                index_loads[nid][sr.index] = \
+                    index_loads[nid].get(sr.index, 0) + 1
+        index_totals: Dict[str, int] = {}
+        for sr in routing.all_shards():
+            if sr.assigned:
+                index_totals[sr.index] = index_totals.get(sr.index, 0) + 1
+        n_nodes = len(data_nodes)
         changed = False
+
+        def place(shard: ShardRouting, target: str) -> None:
+            nonlocal routing, changed
+            new_shard = shard.initialize(target)
+            routing = routing.put_index(
+                routing.index(shard.index).replace_shard(shard, new_shard))
+            loads[target] += 1
+            index_loads[target][shard.index] = \
+                index_loads[target].get(shard.index, 0) + 1
+            index_totals[shard.index] = \
+                index_totals.get(shard.index, 0) + 1
+            changed = True
+
         unassigned = sorted(
             (sr for sr in routing.all_shards()
              if sr.state == ShardState.UNASSIGNED),
@@ -124,22 +240,84 @@ class AllocationService:
                 primary = routing.index(shard.index).primary(shard.shard_id)
                 if not primary.active:
                     continue
-            candidates = []
             st = state.next_version(routing_table=routing) if changed else state
-            for nid, node in data_nodes.items():
-                if self.decide(shard, node, st) == Decision.YES:
-                    candidates.append(nid)
+            candidates = [
+                nid for nid, node in data_nodes.items()
+                if self.decide(shard, node, st) == Decision.YES]
             if not candidates:
                 continue
-            target = min(candidates, key=lambda nid: (loads[nid], nid))
-            new_shard = shard.initialize(target)
-            routing = routing.put_index(
-                routing.index(shard.index).replace_shard(shard, new_shard))
-            loads[target] += 1
-            changed = True
+            total = sum(loads.values())
+            target = min(candidates, key=lambda nid: (
+                self._weight(loads, index_loads, nid, shard.index, n_nodes,
+                             total, index_totals.get(shard.index, 0)), nid))
+            place(shard, target)
+
+        if rebalance:
+            rebalanced = self._rebalance(
+                state, routing, data_nodes, loads, index_loads,
+                index_totals)
+            if rebalanced is not None:
+                routing = rebalanced
+                changed = True
+
         if not changed:
             return state
         return state.next_version(routing_table=routing)
+
+    def _rebalance(self, state, routing, data_nodes, loads, index_loads,
+                   index_totals) -> Optional[RoutingTable]:
+        """Move STARTED replicas from max-weight to min-weight nodes while
+        the spread exceeds the threshold (BalancedShardsAllocator.balance).
+        Replica moves are drop-and-recover — the copy rebuilds from the
+        primary on the target (a documented divergence from the
+        reference's live relocation handoff; primaries never move).
+        Returns the rebalanced routing table, or None for no change."""
+        if len(data_nodes) < 2:
+            return None
+        # only rebalance a green cluster (ClusterRebalanceAllocationDecider
+        # indices_all_active default)
+        if any(not sr.active for sr in routing.all_shards()):
+            return None
+        changed = False
+        for _round in range(8):            # bounded passes per reroute
+            heavy = max(data_nodes, key=lambda nid: (loads[nid], nid))
+            light = min(data_nodes, key=lambda nid: (loads[nid], nid))
+            # move while the shard-count spread exceeds the threshold
+            # (one move per pass converges to a <=1 spread)
+            if loads[heavy] - loads[light] <= self.REBALANCE_THRESHOLD:
+                break
+            movable = [
+                sr for sr in routing.shards_on_node(heavy)
+                if not sr.primary and sr.state == ShardState.STARTED]
+            moved = False
+            for sr in movable:
+                target_node = data_nodes[light]
+                probe = sr.fail()
+                st = state.next_version(routing_table=routing)
+                if self.decide(replace(probe, failed_attempts=0),
+                               target_node, st) != Decision.YES:
+                    continue
+                # drop the copy on the heavy node; allocate on the light
+                irt = routing.index(sr.index)
+                irt = irt.replace_shard(
+                    sr, ShardRouting(index=sr.index, shard_id=sr.shard_id,
+                                     primary=False))
+                fresh = next(s for s in irt.shard_group(sr.shard_id)
+                             if s.state == ShardState.UNASSIGNED)
+                irt = irt.replace_shard(fresh, fresh.initialize(light))
+                routing = routing.put_index(irt)
+                loads[heavy] -= 1
+                loads[light] += 1
+                index_loads[heavy][sr.index] = \
+                    index_loads[heavy].get(sr.index, 1) - 1
+                index_loads[light][sr.index] = \
+                    index_loads[light].get(sr.index, 0) + 1
+                moved = True
+                changed = True
+                break
+            if not moved:
+                break
+        return routing if changed else None
 
     # -- lifecycle events ----------------------------------------------------
 

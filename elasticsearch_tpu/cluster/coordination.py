@@ -240,7 +240,7 @@ class Coordinator:
         self.seed_peers = list(seed_peers or [])
         self._join_nodes: Dict[str, Dict[str, Any]] = {}
         self._inflight_update: Optional[
-            Tuple[int, Callable[[Optional[Exception]], None]]] = None
+            Tuple[int, Callable[[Optional[Exception]], None], str]] = None
 
         for action, handler in [
             (PRE_VOTE, self._on_pre_vote),
@@ -581,7 +581,7 @@ class Coordinator:
         # completion fires on the commit of exactly this version — or on
         # failure via _fail_queued_updates when we step down
         version = new_state.version
-        self._inflight_update = (version, on_done)
+        self._inflight_update = (version, on_done, description)
         self._publish(new_state)
 
     def _on_applied_for_updates(self, state: ClusterState) -> None:

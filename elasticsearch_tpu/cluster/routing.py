@@ -33,6 +33,10 @@ class ShardRouting:
     node_id: Optional[str] = None
     relocating_node_id: Optional[str] = None
     allocation_id: Optional[str] = None       # identity of this shard copy
+    # consecutive allocation failures (UnassignedInfo.getNumFailedAllocations
+    # analog) — MaxRetryDecider stops retry storms; reset by an explicit
+    # reroute with retry_failed
+    failed_attempts: int = 0
 
     @property
     def active(self) -> bool:
@@ -49,7 +53,10 @@ class ShardRouting:
 
     def start(self) -> "ShardRouting":
         assert self.state == ShardState.INITIALIZING
-        return replace(self, state=ShardState.STARTED)
+        # a successful start clears the failure streak: MaxRetryDecider
+        # counts CONSECUTIVE failures (UnassignedInfo is discarded once a
+        # shard starts in the reference)
+        return replace(self, state=ShardState.STARTED, failed_attempts=0)
 
     def relocate(self, target_node: str) -> "ShardRouting":
         assert self.state == ShardState.STARTED
@@ -58,7 +65,8 @@ class ShardRouting:
 
     def fail(self) -> "ShardRouting":
         return ShardRouting(index=self.index, shard_id=self.shard_id,
-                            primary=self.primary)
+                            primary=self.primary,
+                            failed_attempts=self.failed_attempts + 1)
 
     def promote_to_primary(self) -> "ShardRouting":
         return replace(self, primary=True)
@@ -68,7 +76,8 @@ class ShardRouting:
                 "primary": self.primary, "state": self.state.value,
                 "node": self.node_id,
                 "relocating_node": self.relocating_node_id,
-                "allocation_id": self.allocation_id}
+                "allocation_id": self.allocation_id,
+                "failed_attempts": self.failed_attempts}
 
     @staticmethod
     def from_dict(d: Mapping[str, Any]) -> "ShardRouting":
@@ -77,7 +86,8 @@ class ShardRouting:
                             state=ShardState(d["state"]),
                             node_id=d.get("node"),
                             relocating_node_id=d.get("relocating_node"),
-                            allocation_id=d.get("allocation_id"))
+                            allocation_id=d.get("allocation_id"),
+                            failed_attempts=d.get("failed_attempts", 0))
 
 
 @dataclass(frozen=True)

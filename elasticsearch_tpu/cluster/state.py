@@ -29,6 +29,15 @@ class DiscoveryNode:
     name: str = ""
     roles: FrozenSet[str] = field(default_factory=lambda: frozenset(Roles.ALL))
     address: str = "local"
+    # node attributes for awareness/filter allocation (node.attr.* —
+    # DiscoveryNode.getAttributes analog); frozen tuple of (key, value)
+    attrs: Tuple[Tuple[str, str], ...] = ()
+
+    def attr(self, key: str) -> Optional[str]:
+        for k, v in self.attrs:
+            if k == key:
+                return v
+        return None
 
     @property
     def is_master_eligible(self) -> bool:
@@ -39,14 +48,19 @@ class DiscoveryNode:
         return Roles.DATA in self.roles
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"id": self.node_id, "name": self.name or self.node_id,
-                "roles": sorted(self.roles), "address": self.address}
+        out = {"id": self.node_id, "name": self.name or self.node_id,
+               "roles": sorted(self.roles), "address": self.address}
+        if self.attrs:
+            out["attributes"] = dict(self.attrs)
+        return out
 
     @staticmethod
     def from_dict(d: Mapping[str, Any]) -> "DiscoveryNode":
         return DiscoveryNode(node_id=d["id"], name=d.get("name", ""),
                              roles=frozenset(d.get("roles", Roles.ALL)),
-                             address=d.get("address", "local"))
+                             address=d.get("address", "local"),
+                             attrs=tuple(sorted(
+                                 d.get("attributes", {}).items())))
 
 
 @dataclass(frozen=True)

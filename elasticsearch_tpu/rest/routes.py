@@ -14,6 +14,7 @@ import uuid as uuid_mod
 from typing import Any, Callable, Dict, List, Optional
 
 from elasticsearch_tpu.action.bulk import parse_bulk_body
+from elasticsearch_tpu.cluster.routing import ShardState
 from elasticsearch_tpu.node.node import NodeClient
 from elasticsearch_tpu.rest.controller import (
     RestController, RestRequest, respond_error, wrap_client_cb,
@@ -456,7 +457,8 @@ def build_controller(client: NodeClient) -> RestController:
     def reroute_post(req: RestRequest, done: DoneFn) -> None:
         from elasticsearch_tpu.action.admin import REROUTE
         client.node.master_client.execute(
-            REROUTE, {"commands": (req.body or {}).get("commands", [])},
+            REROUTE, {"commands": (req.body or {}).get("commands", []),
+                      "retry_failed": req.flag("retry_failed")},
             wrap_client_cb(done))
     r("POST", "/_cluster/reroute", reroute_post)
 
@@ -882,6 +884,80 @@ def build_controller(client: NodeClient) -> RestController:
         client.nodes_stats_all(wrap_client_cb(done))
     r("GET", "/_nodes/stats", nodes_stats)
 
+    def allocation_explain(req: RestRequest, done: DoneFn) -> None:
+        """Why is a shard where it is / unassigned
+        (ClusterAllocationExplainAction analog): runs every decider
+        against every data node and reports the verdicts."""
+        from elasticsearch_tpu.cluster.allocation import Decision
+        node = client.node
+        state = node._applied_state()
+        body = req.body or {}
+        target = None
+        if body.get("index") is not None:
+            want_primary = bool(body.get("primary", True))
+            sid = int(body.get("shard", 0))
+            if state.routing_table.has_index(body["index"]):
+                for sr in state.routing_table.index(
+                        body["index"]).shard_group(sid):
+                    if sr.primary == want_primary:
+                        target = sr
+                        break
+        else:
+            target = next(
+                (sr for sr in state.routing_table.all_shards()
+                 if not sr.assigned), None)
+        if target is None:
+            done(400, {"error": {
+                "type": "illegal_argument_exception",
+                "reason": "unable to find any unassigned shards to "
+                          "explain (pass index/shard/primary to explain "
+                          "an assigned shard)"}})
+            return
+        decisions = []
+        for nid, dnode in sorted(state.data_nodes().items()):
+            verdict = node.allocation_service.decide(target, dnode, state)
+            per_decider = [
+                {"decider": type(d).__name__,
+                 "decision": d.can_allocate(target, dnode, state)}
+                for d in node.allocation_service.deciders]
+            decisions.append({
+                "node_id": nid, "node_name": dnode.name or nid,
+                "node_decision":
+                    "yes" if verdict == Decision.YES else
+                    ("throttled" if verdict == Decision.THROTTLE
+                     else "no"),
+                "deciders": [d for d in per_decider
+                             if d["decision"] != Decision.YES] or
+                            per_decider[:1]})
+        done(200, {
+            "index": target.index, "shard": target.shard_id,
+            "primary": target.primary,
+            "current_state": target.state.value.lower(),
+            "current_node": ({"id": target.node_id}
+                             if target.node_id else None),
+            "can_allocate":
+                "yes" if any(d["node_decision"] == "yes"
+                             for d in decisions) else "no",
+            "node_allocation_decisions": decisions})
+    r("GET", "/_cluster/allocation/explain", allocation_explain)
+    r("POST", "/_cluster/allocation/explain", allocation_explain)
+
+    def pending_tasks(req: RestRequest, done: DoneFn) -> None:
+        """Queued master state-update tasks (PendingClusterTasksAction)."""
+        coord = client.node.coordinator
+        queue = list(getattr(coord, "_update_queue", []))
+        tasks = [{"insert_order": i, "priority": "NORMAL",
+                  "source": desc, "executing": False}
+                 for i, (desc, _fn, _cb) in enumerate(queue)]
+        inflight = getattr(coord, "_inflight_update", None)
+        if inflight is not None:
+            source = inflight[2] if isinstance(inflight, tuple) \
+                and len(inflight) > 2 else "inflight"
+            tasks.insert(0, {"insert_order": -1, "priority": "URGENT",
+                             "source": source, "executing": True})
+        done(200, {"tasks": tasks})
+    r("GET", "/_cluster/pending_tasks", pending_tasks)
+
     # -- cat (human tables) ----------------------------------------------
 
     def cat_indices(req: RestRequest, done: DoneFn) -> None:
@@ -906,6 +982,103 @@ def build_controller(client: NodeClient) -> RestController:
                          str(h["active_primary_shards"]),
                          str(h["unassigned_shards"])]]))
     r("GET", "/_cat/health", cat_health)
+
+    def cat_allocation(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        rows = []
+        for nid in sorted(state.data_nodes()):
+            n = len(state.routing_table.shards_on_node(nid))
+            rows.append([str(n), nid])
+        unassigned = sum(1 for sr in state.routing_table.all_shards()
+                         if not sr.assigned)
+        if unassigned:
+            rows.append([str(unassigned), "UNASSIGNED"])
+        done(200, _cat(req, ["shards", "node"], rows))
+    r("GET", "/_cat/allocation", cat_allocation)
+
+    def cat_aliases(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        rows = []
+        for meta in state.metadata.indices.values():
+            for alias in sorted(meta.aliases):
+                rows.append([alias, meta.name])
+        done(200, _cat(req, ["alias", "index"], rows))
+    r("GET", "/_cat/aliases", cat_aliases)
+
+    def cat_count(req: RestRequest, done: DoneFn) -> None:
+        index = req.params.get("index", "_all")
+
+        def cb(resp, err):
+            if err is not None:
+                done(404, {"error": {"type": "index_not_found_exception",
+                                     "reason": str(err)}})
+                return
+            done(200, _cat(req, ["epoch", "timestamp", "count"],
+                           [["-", "-",
+                             str(resp["hits"]["total"]["value"])]]))
+        client.search(index, {"size": 0,
+                              "track_total_hits": True,
+                              "query": {"match_all": {}}}, cb)
+    r("GET", "/_cat/count", cat_count)
+    r("GET", "/_cat/count/{index}", cat_count)
+
+    def cat_templates(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        rows = []
+        for name, t in sorted(
+                (state.metadata.templates or {}).items()):
+            patterns = ",".join(t.get("index_patterns", []))
+            rows.append([name, f"[{patterns}]",
+                         str(t.get("priority", 0))])
+        done(200, _cat(req, ["name", "index_patterns", "order"], rows))
+    r("GET", "/_cat/templates", cat_templates)
+
+    def cat_segments(req: RestRequest, done: DoneFn) -> None:
+        rows = []
+        for iname, svc in sorted(
+                client.node.indices_service.indices.items()):
+            for sid, shard in sorted(svc.shards.items()):
+                try:
+                    reader = shard.engine.acquire_reader()
+                except Exception:  # noqa: BLE001
+                    continue
+                for gi, seg in enumerate(reader.segments):
+                    rows.append([iname, str(sid),
+                                 "p" if shard.primary else "r",
+                                 f"_{gi}", str(seg.n_docs)])
+        done(200, _cat(req, ["index", "shard", "prirep", "segment",
+                             "docs.count"], rows))
+    r("GET", "/_cat/segments", cat_segments)
+
+    def cat_plugins(req: RestRequest, done: DoneFn) -> None:
+        from elasticsearch_tpu import plugins as plugin_mod
+        rows = [[client.node.node_id, descriptor, "external"]
+                for descriptor in sorted(
+                    getattr(plugin_mod, "_loaded", []))]
+        done(200, _cat(req, ["name", "component", "version"], rows))
+    r("GET", "/_cat/plugins", cat_plugins)
+
+    def cat_recovery(req: RestRequest, done: DoneFn) -> None:
+        state = client.node._applied_state()
+        rows = []
+        for sr in state.routing_table.all_shards():
+            if sr.state == ShardState.INITIALIZING:
+                rows.append([sr.index, str(sr.shard_id), "peer",
+                             "init", sr.node_id or "-"])
+            elif sr.active:
+                rows.append([sr.index, str(sr.shard_id), "existing_store",
+                             "done", sr.node_id or "-"])
+        done(200, _cat(req, ["index", "shard", "type", "stage", "node"],
+                       rows))
+    r("GET", "/_cat/recovery", cat_recovery)
+
+    def cat_pending_tasks(req: RestRequest, done: DoneFn) -> None:
+        queue = list(getattr(client.node.coordinator,
+                             "_update_queue", []))
+        rows = [[str(i), "NORMAL", desc]
+                for i, (desc, _f, _cb) in enumerate(queue)]
+        done(200, _cat(req, ["insertOrder", "priority", "source"], rows))
+    r("GET", "/_cat/pending_tasks", cat_pending_tasks)
 
     def cat_shards(req: RestRequest, done: DoneFn) -> None:
         state = client.node._applied_state()

@@ -186,7 +186,16 @@ class HttpServer:
                 req_fmt = xcontent.format_from_content_type(
                     (request.headers or {}).get("content-type"))
             fmt = xcontent.response_format(accept, req_fmt)
-            payload = xcontent.dumps(body, fmt)
+            try:
+                payload = xcontent.dumps(body, fmt)
+            except Exception as e:  # noqa: BLE001
+                # a serialization failure must produce a 500, not kill
+                # the connection with zero bytes written
+                status = 500
+                fmt = xcontent.JSON
+                payload = xcontent.dumps({"error": {
+                    "type": "serialization_exception",
+                    "reason": str(e)}, "status": 500}, fmt)
             ctype = (f"{xcontent.CONTENT_TYPES[fmt]}; charset=UTF-8"
                      if fmt in (xcontent.JSON, xcontent.YAML)
                      else xcontent.CONTENT_TYPES[fmt])

@@ -79,12 +79,29 @@ def loads(raw: bytes, content_type: Optional[str] = None) -> Any:
     raise IllegalArgumentError(f"unsupported content format [{fmt}]")
 
 
+def _b64_bytes(o: Any) -> Any:
+    """Text formats carry binary as base64 (the reference's JSON/YAML
+    rendering of binary fields)."""
+    import base64
+    if isinstance(o, bytes):
+        return base64.b64encode(o).decode("ascii")
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")
+
+
 def dumps(value: Any, fmt: str = JSON) -> bytes:
     if fmt == JSON:
-        return json.dumps(value).encode("utf-8")
+        return json.dumps(value, default=_b64_bytes).encode("utf-8")
     if fmt == YAML:
+        import base64
         import yaml
-        return yaml.safe_dump(value, sort_keys=False).encode("utf-8")
+
+        class _Dumper(yaml.SafeDumper):
+            pass
+        _Dumper.add_representer(
+            bytes, lambda dumper, data: dumper.represent_str(
+                base64.b64encode(data).decode("ascii")))
+        return yaml.dump(value, Dumper=_Dumper,
+                         sort_keys=False).encode("utf-8")
     if fmt == CBOR:
         out = bytearray()
         _cbor_encode(value, out)

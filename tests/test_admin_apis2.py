@@ -1,0 +1,93 @@
+"""_cluster/allocation/explain, _cluster/pending_tasks, and the extended
+_cat surface.
+
+Reference: action/admin/cluster/allocation/ClusterAllocationExplainAction,
+cluster/PendingClusterTasksAction, rest/action/cat/.
+"""
+
+import pytest
+
+from elasticsearch_tpu.rest.controller import RestRequest
+from elasticsearch_tpu.rest.routes import build_controller
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=2, seed=5)
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def rest(cluster):
+    controller = build_controller(cluster.client())
+
+    def do(method, path, body=None, query=None):
+        req = RestRequest(method=method, path=path,
+                          query=dict(query or {}), body=body, raw_body=b"")
+        out = []
+        controller.dispatch(req, lambda s, b: out.append((s, b)))
+        cluster.run_until(lambda: bool(out), 120.0)
+        return out[0]
+    return do
+
+
+def _seed(cluster, rest):
+    s, _ = rest("PUT", "/idx", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"v": {"type": "keyword"}}}})
+    assert s == 200
+    cluster.ensure_green("idx")
+    for i in range(3):
+        rest("PUT", f"/idx/_doc/d{i}", {"v": f"x{i}"})
+    rest("POST", "/idx/_refresh")
+
+
+def test_allocation_explain_assigned(cluster, rest):
+    _seed(cluster, rest)
+    s, body = rest("POST", "/_cluster/allocation/explain",
+                   {"index": "idx", "shard": 0, "primary": True})
+    assert s == 200
+    assert body["index"] == "idx" and body["primary"] is True
+    assert body["current_state"] == "STARTED".lower()
+    assert len(body["node_allocation_decisions"]) == 2
+    # the node already holding the copy is rejected by SameShardDecider
+    holder = body["current_node"]["id"]
+    by_node = {d["node_id"]: d for d in body["node_allocation_decisions"]}
+    assert by_node[holder]["node_decision"] == "no"
+
+
+def test_allocation_explain_no_unassigned(cluster, rest):
+    _seed(cluster, rest)
+    s, body = rest("GET", "/_cluster/allocation/explain")
+    assert s == 400           # nothing unassigned to explain
+
+
+def test_pending_tasks_shape(cluster, rest):
+    s, body = rest("GET", "/_cluster/pending_tasks")
+    assert s == 200 and "tasks" in body
+
+
+def test_cat_surface(cluster, rest):
+    _seed(cluster, rest)
+    rest("POST", "/_aliases", {"actions": [
+        {"add": {"index": "idx", "alias": "books"}}]})
+    for path, expect in [
+            ("/_cat/allocation", "node"),
+            ("/_cat/aliases", "books"),
+            ("/_cat/count/idx", "3"),
+            ("/_cat/templates", ""),
+            ("/_cat/segments", "segment"),   # node-local view; the
+                                             # coordinating node may hold
+                                             # no shard of idx
+            ("/_cat/recovery", "done"),
+            ("/_cat/pending_tasks", ""),
+            ("/_cat/plugins", ""),
+    ]:
+        s, body = rest("GET", path, query={"v": "true"})
+        assert s == 200, path
+        assert isinstance(body, str), path
+        if expect:
+            assert expect in body, (path, body)

@@ -1,0 +1,136 @@
+"""Weighted balancing, awareness, max-retry, disk threshold, rebalance.
+
+Reference: cluster/routing/allocation/allocator/BalancedShardsAllocator,
+decider/{AwarenessAllocationDecider, MaxRetryAllocationDecider,
+DiskThresholdDecider}.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import (
+    AllocationService, AwarenessDecider, Decision, DiskThresholdDecider,
+    MaxRetryDecider,
+)
+from elasticsearch_tpu.cluster.metadata import IndexMetadata, Metadata
+from elasticsearch_tpu.cluster.routing import (
+    IndexRoutingTable, RoutingTable, ShardRouting, ShardState,
+)
+from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode
+
+
+def make_state(n_nodes=3, indices=(("idx", 2, 1),), attrs=None,
+               settings=None):
+    nodes = {}
+    for i in range(n_nodes):
+        nid = f"n{i}"
+        node_attrs = tuple(sorted((attrs or {}).get(nid, {}).items()))
+        nodes[nid] = DiscoveryNode(node_id=nid, name=nid, attrs=node_attrs)
+    metadata = Metadata()
+    routing = RoutingTable()
+    for name, shards, replicas in indices:
+        metadata = metadata.put_index(IndexMetadata(
+            name=name, uuid=f"uuid-{name}", number_of_shards=shards,
+            number_of_replicas=replicas))
+        groups = {}
+        for sid in range(shards):
+            copies = [ShardRouting(index=name, shard_id=sid, primary=True)]
+            copies += [ShardRouting(index=name, shard_id=sid,
+                                    primary=False)
+                       for _ in range(replicas)]
+            groups[sid] = tuple(copies)
+        routing = routing.put_index(IndexRoutingTable(name, groups))
+    state = ClusterState(nodes=nodes, metadata=metadata,
+                         routing_table=routing)
+    if settings:
+        state = state.next_version(
+            metadata=metadata.with_persistent_settings(settings))
+    return state
+
+
+def start_all(svc, state):
+    """Run reroute + start cycles until no shard is initializing."""
+    for _ in range(10):
+        state = svc.reroute(state)
+        init = [sr for sr in state.routing_table.all_shards()
+                if sr.state == ShardState.INITIALIZING]
+        if not init:
+            break
+        state = svc.apply_started_shards(state, init)
+    return state
+
+
+def test_weighted_placement_balances_nodes():
+    svc = AllocationService()
+    state = make_state(n_nodes=3, indices=(("a", 3, 1), ("b", 3, 1)))
+    state = start_all(svc, state)
+    per_node = {f"n{i}": len(state.routing_table.shards_on_node(f"n{i}"))
+                for i in range(3)}
+    assert sum(per_node.values()) == 12
+    assert max(per_node.values()) - min(per_node.values()) <= 1
+    # index balance: no node hoards one index's shards
+    for nid in per_node:
+        a_here = sum(1 for sr in state.routing_table.shards_on_node(nid)
+                     if sr.index == "a")
+        assert a_here <= 3
+
+
+def test_awareness_spreads_across_zones():
+    svc = AllocationService()
+    state = make_state(
+        n_nodes=4, indices=(("idx", 1, 1),),
+        attrs={"n0": {"zone": "z1"}, "n1": {"zone": "z1"},
+               "n2": {"zone": "z2"}, "n3": {"zone": "z2"}},
+        settings={"cluster.routing.allocation.awareness.attributes":
+                  "zone"})
+    state = start_all(svc, state)
+    zones = set()
+    for sr in state.routing_table.all_shards():
+        assert sr.active
+        zone = state.nodes[sr.node_id].attr("zone")
+        zones.add(zone)
+    assert zones == {"z1", "z2"}       # copies land in different zones
+
+
+def test_max_retry_stops_allocation():
+    svc = AllocationService()
+    state = make_state(n_nodes=2, indices=(("idx", 1, 0),))
+    state = svc.reroute(state)
+    sr = next(iter(state.routing_table.all_shards()))
+    # fail it past the retry budget
+    for _ in range(5):
+        state = svc.apply_failed_shard(
+            state, next(s for s in state.routing_table.all_shards()
+                        if s.assigned))
+        state = svc.reroute(state)
+    remaining = next(iter(state.routing_table.all_shards()))
+    assert remaining.state == ShardState.UNASSIGNED
+    assert remaining.failed_attempts >= 5
+
+
+def test_disk_threshold_excludes_full_nodes():
+    disk = DiskThresholdDecider()
+    svc = AllocationService(deciders=(disk,))
+    disk.usages = {"n0": (95, 100), "n1": (10, 100)}
+    state = make_state(n_nodes=2, indices=(("idx", 2, 0),))
+    state = start_all(svc, state)
+    for sr in state.routing_table.all_shards():
+        assert sr.node_id == "n1"      # n0 is past the watermark
+
+
+def test_rebalance_moves_replicas_to_new_node():
+    svc = AllocationService()
+    # form on 2 nodes, then a third joins empty
+    state = make_state(n_nodes=2, indices=(("a", 3, 1),))
+    state = start_all(svc, state)
+    nodes = dict(state.nodes)
+    nodes["n2"] = DiscoveryNode(node_id="n2", name="n2")
+    state = state.next_version(nodes=nodes)
+    state = start_all(svc, state)
+    per_node = {nid: len(state.routing_table.shards_on_node(nid))
+                for nid in ("n0", "n1", "n2")}
+    assert per_node["n2"] >= 1         # the empty node received shards
+    assert all(sr.active for sr in state.routing_table.all_shards())
+    # primaries never move during rebalance
+    for sr in state.routing_table.all_shards():
+        if sr.primary:
+            assert sr.node_id in ("n0", "n1")
