@@ -509,7 +509,10 @@ class MasterActions:
                         raise IllegalArgumentError(
                             "cancelling a primary requires "
                             "[allow_primary: true]")
-                    state = self.allocation.apply_failed_shard(state, target)
+                    # operator cancels must not consume the
+                    # MaxRetryDecider failure budget
+                    state = self.allocation.apply_failed_shard(
+                        state, target, count_failure=False)
                     routing = state.routing_table
                 elif kind == "move":
                     try:

@@ -23,16 +23,46 @@ class TransportBulkAction:
     def __init__(self, shard_bulk: TransportShardBulkAction,
                  state_supplier: Callable[[], ClusterState],
                  create_index: CreateIndexFn,
-                 ingest_service=None):
+                 ingest_service=None, thread_pool=None):
         self.shard_bulk = shard_bulk
         self.state = state_supplier
         self.create_index = create_index
         self.ingest = ingest_service
+        # indexing-pressure accounting (IndexingPressure.java analog);
+        # None in unit tests that exercise the bulk path alone
+        self.thread_pool = thread_pool
 
     def execute(self, items: List[Dict[str, Any]],
                 on_done: Callable[[Dict[str, Any]], None]) -> None:
         """items: [{action, index, id, source?, routing?, pipeline?,
         if_seq_no?, ...}]"""
+        if self.thread_pool is not None:
+            import json as _json
+            est_bytes = sum(
+                len(_json.dumps(item.get("source") or {}, default=str))
+                + 64 for item in items)
+            try:
+                self.thread_pool.acquire_write_bytes(est_bytes)
+            except Exception as e:  # noqa: BLE001 — backpressure, not fault
+                # per-item rejection entries so single-doc callers
+                # (NodeClient._single_item_bulk reads items[0]) surface
+                # the 429 instead of crashing on an empty list
+                on_done({"errors": True, "rejected": True,
+                         "status": 429,
+                         "items": [{item.get("action", "index"): {
+                             "id": item.get("id"),
+                             "status": 429,
+                             "error": {
+                                 "type":
+                                     "es_rejected_execution_exception",
+                                 "reason": str(e)}}}
+                             for item in items]})
+                return
+            inner = on_done
+
+            def on_done(resp):  # noqa: F811 — release wraps completion
+                self.thread_pool.release_write_bytes(est_bytes)
+                inner(resp)
         state = self.state()
         items = self._run_pipelines(state, items)
         missing = sorted({item["index"] for item in items

@@ -37,6 +37,27 @@ from elasticsearch_tpu.utils.errors import (
 SEARCH_CAN_MATCH = "indices:data/read/search[can_match]"
 SEARCH_DFS = "indices:data/read/search[phase/dfs]"
 SEARCH_QUERY = "indices:data/read/search[phase/query]"
+
+# per-search bound on in-flight shard query requests
+# (SearchRequest.DEFAULT_MAX_CONCURRENT_SHARD_REQUESTS)
+DEFAULT_MAX_CONCURRENT_SHARD_REQUESTS = 5
+
+
+def _parse_max_concurrent(raw) -> Optional[int]:
+    """Validated at request entry: junk must 400, and a non-positive
+    value must not wedge the fan-out into dispatching nothing."""
+    if raw is None:
+        return None
+    try:
+        value = int(raw)
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"[max_concurrent_shard_requests] must be a positive "
+            f"integer, got [{raw!r}]")
+    if value < 1:
+        raise IllegalArgumentError(
+            "[max_concurrent_shard_requests] must be >= 1")
+    return value
 SEARCH_FETCH = "indices:data/read/search[phase/fetch]"
 
 CONTEXT_KEEP_ALIVE = 60.0
@@ -371,6 +392,8 @@ class TransportSearchAction:
                 inner(resp, err)
 
         try:
+            max_concurrent = _parse_max_concurrent(
+                body.get("max_concurrent_shard_requests"))
             indices = self._resolve_indices(index_expression, state)
             targets = self._shard_targets(indices, state)
             # coordinator-side inference rewrite: text_expansion model_text
@@ -395,6 +418,7 @@ class TransportSearchAction:
             "skipped": 0, "failed": 0,
             "failures": [],
             "task_id": task.task_id if task is not None else None,
+            "max_concurrent_shard_requests": max_concurrent,
         }
 
         if self._try_mesh_path(t0, indices, targets, body, window, from_,
@@ -597,9 +621,33 @@ class TransportSearchAction:
                     self._merge_and_fetch(t0, targets, results, body, from_,
                                           size, phase_state, n_total_shards,
                                           on_done)
+                else:
+                    # a completion frees a fan-out slot
+                    pump = phase_state.get("_dispatch_next")
+                    if pump is not None:
+                        pump()
             self.ts.send_request(node, SEARCH_QUERY, req, cb, timeout=60.0)
-        for i, target in enumerate(targets):
-            one(i, target)
+
+        # bounded fan-out: at most max_concurrent_shard_requests shard
+        # queries in flight per search; the next shard dispatches as each
+        # completes (AbstractSearchAsyncAction's bounded concurrency).
+        # phase_state["_dispatch_next"] is invoked from cb's completion
+        # accounting; replica failovers re-use their slot.
+        max_concurrent = int(
+            phase_state.get("max_concurrent_shard_requests") or
+            DEFAULT_MAX_CONCURRENT_SHARD_REQUESTS)
+        cursor = {"i": 0}
+
+        def dispatch_next() -> None:
+            done = len(targets) - pending["n"]
+            while cursor["i"] < len(targets) and \
+                    (cursor["i"] - done) < max_concurrent:
+                i = cursor["i"]
+                cursor["i"] += 1
+                one(i, targets[i])
+                done = len(targets) - pending["n"]
+        phase_state["_dispatch_next"] = dispatch_next
+        dispatch_next()
 
     # -- merge + fetch ---------------------------------------------------
 

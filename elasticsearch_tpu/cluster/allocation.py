@@ -149,15 +149,26 @@ class DiskThresholdDecider(AllocationDecider):
         return Decision.YES
 
 
-DEFAULT_DECIDERS: Sequence[AllocationDecider] = (
-    SameShardDecider(), FilterDecider(), ThrottlingDecider(),
-    MaxRetryDecider(), AwarenessDecider(), DiskThresholdDecider(),
-)
+def default_deciders() -> Sequence[AllocationDecider]:
+    """Fresh decider instances per service: DiskThresholdDecider carries
+    mutable usage state, so sharing one module-level tuple would leak
+    decisions across nodes (and across tests)."""
+    return (SameShardDecider(), FilterDecider(), ThrottlingDecider(),
+            MaxRetryDecider(), AwarenessDecider(), DiskThresholdDecider())
 
 
 class AllocationService:
-    def __init__(self, deciders: Sequence[AllocationDecider] = DEFAULT_DECIDERS):
-        self.deciders = list(deciders)
+    def __init__(self,
+                 deciders: Optional[Sequence[AllocationDecider]] = None):
+        self.deciders = list(deciders if deciders is not None
+                             else default_deciders())
+
+    def disk_threshold(self) -> Optional["DiskThresholdDecider"]:
+        """The service's disk decider, for cluster-info refreshes."""
+        for d in self.deciders:
+            if isinstance(d, DiskThresholdDecider):
+                return d
+        return None
 
     # -- decision ------------------------------------------------------------
 
@@ -339,10 +350,13 @@ class AllocationService:
         return self.reroute(state.next_version(routing_table=routing))
 
     def apply_failed_shard(self, state: ClusterState,
-                           failed: ShardRouting) -> ClusterState:
+                           failed: ShardRouting,
+                           count_failure: bool = True) -> ClusterState:
         """Failed primary: promote an active replica, then schedule a new
         replica copy; failed replica: back to unassigned (reference:
-        NodeRemovalClusterStateTaskExecutor → AllocationService.reroute)."""
+        NodeRemovalClusterStateTaskExecutor → AllocationService.reroute).
+        ``count_failure=False`` for operator-initiated cancels, which must
+        not consume the MaxRetryDecider budget."""
         routing = state.routing_table
         irt = routing.index(failed.index)
         current = next((sr for sr in irt.shard_group(failed.shard_id)
@@ -350,7 +364,11 @@ class AllocationService:
                         sr.allocation_id is not None), None)
         if current is None:
             return state
-        irt = irt.replace_shard(current, current.fail())
+        dropped = current.fail()
+        if not count_failure:
+            dropped = replace(dropped,
+                              failed_attempts=current.failed_attempts)
+        irt = irt.replace_shard(current, dropped)
         metadata = state.metadata
         if current.primary:
             # every primary failure bumps the shard's primary term so stale

@@ -102,12 +102,16 @@ class Node:
             node_id, now_ms=lambda: scheduler.now() * 1000)
         self.task_results: Dict[str, Any] = {}
 
+        from elasticsearch_tpu.utils.threadpool import ThreadPoolService
+        self.thread_pool = ThreadPoolService()
+
         self.shard_bulk = TransportShardBulkAction(
             node_id, self.indices_service, self.transport_service, scheduler,
             self._applied_state)
         self.bulk_action = TransportBulkAction(
             self.shard_bulk, self._applied_state, self._auto_create_index,
-            ingest_service=self.ingest_service)
+            ingest_service=self.ingest_service,
+            thread_pool=self.thread_pool)
         self.get_action = TransportGetAction(
             node_id, self.indices_service, self.transport_service,
             self._applied_state)
@@ -206,6 +210,7 @@ class Node:
             "indices": self.indices_service.stats(),
             "transport": dict(self.transport_service.stats),
             "breakers": BREAKERS.stats(),
+            "thread_pool": self.thread_pool.stats(),
             "adaptive_selection":
                 self.search_action.response_collector.stats(),
         }

@@ -1080,6 +1080,19 @@ def build_controller(client: NodeClient) -> RestController:
         done(200, _cat(req, ["insertOrder", "priority", "source"], rows))
     r("GET", "/_cat/pending_tasks", cat_pending_tasks)
 
+    def cat_thread_pool(req: RestRequest, done: DoneFn) -> None:
+        rows = []
+        stats = client.node.thread_pool.stats()
+        for name in sorted(stats):
+            if name == "indexing_pressure":
+                continue
+            p = stats[name]
+            rows.append([client.node.node_id, name, str(p["active"]),
+                         str(p["queue"]), str(p["rejected"])])
+        done(200, _cat(req, ["node_name", "name", "active", "queue",
+                             "rejected"], rows))
+    r("GET", "/_cat/thread_pool", cat_thread_pool)
+
     def cat_shards(req: RestRequest, done: DoneFn) -> None:
         state = client.node._applied_state()
         rows = []

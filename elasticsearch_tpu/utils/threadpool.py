@@ -1,0 +1,118 @@
+"""Named execution pools with bounded admission — the backpressure layer.
+
+The reference sizes real thread pools per workload (threadpool/
+ThreadPool.java:69-130: search, write, get, management, ... each with a
+queue bound) and rejects work beyond the queue with
+EsRejectedExecutionException → HTTP 429. This build's node is an
+event-loop, so the analog is ADMISSION control across async boundaries:
+a pool grants in-flight slots (acquire at request entry, release at
+completion), queues a bounded overflow, and rejects the rest. The write
+pool additionally accounts in-flight request BYTES — the reference's
+indexing-pressure limit (IndexingPressure.java) that stops a node from
+buffering unbounded bulk payloads.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Deque, Dict, Optional
+
+from elasticsearch_tpu.utils.errors import RejectedExecutionError
+
+
+class Pool:
+    def __init__(self, name: str, size: int, queue_size: int):
+        self.name = name
+        self.size = size
+        self.queue_size = queue_size
+        self.active = 0
+        self.queue: Deque[Callable[[], None]] = deque()
+        self.completed = 0
+        self.rejected = 0
+        self.largest_queue = 0
+
+    def stats(self) -> Dict[str, Any]:
+        return {"threads": self.size, "active": self.active,
+                "queue": len(self.queue), "queue_size": self.queue_size,
+                "completed": self.completed, "rejected": self.rejected,
+                "largest": self.largest_queue}
+
+
+# reference pool sizing shape (ThreadPool.java:166-177), scaled to the
+# event-loop model: "size" = concurrent in-flight operations
+DEFAULT_POOLS = {
+    "search": (16, 1000),
+    "write": (8, 200),
+    "get": (16, 1000),
+    "management": (4, 100),
+    "generic": (32, 500),
+}
+
+# indexing-pressure byte limit for in-flight write payloads
+# (IndexingPressure MAX_INDEXING_BYTES analog: 10% of heap; fixed here)
+WRITE_BYTES_LIMIT = 64 * 1024 * 1024
+
+
+class ThreadPoolService:
+    """Per-node admission pools + write-bytes accounting."""
+
+    def __init__(self, pools: Optional[Dict[str, tuple]] = None):
+        self.pools: Dict[str, Pool] = {
+            name: Pool(name, size, queue)
+            for name, (size, queue) in (pools or DEFAULT_POOLS).items()}
+        self.write_bytes_in_flight = 0
+        self.write_bytes_limit = WRITE_BYTES_LIMIT
+        self.write_bytes_rejections = 0
+
+    def pool(self, name: str) -> Pool:
+        return self.pools[name]
+
+    # -- slot admission ---------------------------------------------------
+
+    def submit(self, name: str, task: Callable[[], None]) -> None:
+        """Run task now if a slot is free, queue it within bounds, reject
+        beyond them. The task MUST arrange for release(name) exactly once
+        when its work (including async continuations) completes."""
+        pool = self.pools[name]
+        if pool.active < pool.size:
+            pool.active += 1
+            task()
+            return
+        if len(pool.queue) >= pool.queue_size:
+            pool.rejected += 1
+            raise RejectedExecutionError(
+                f"rejected execution on [{name}]: queue capacity "
+                f"[{pool.queue_size}] reached")
+        pool.queue.append(task)
+        pool.largest_queue = max(pool.largest_queue, len(pool.queue))
+
+    def release(self, name: str) -> None:
+        pool = self.pools[name]
+        pool.active -= 1
+        pool.completed += 1
+        while pool.queue and pool.active < pool.size:
+            pool.active += 1
+            pool.queue.popleft()()
+
+    # -- write-bytes accounting (indexing pressure) -----------------------
+
+    def acquire_write_bytes(self, n: int) -> None:
+        if self.write_bytes_in_flight + n > self.write_bytes_limit:
+            self.write_bytes_rejections += 1
+            raise RejectedExecutionError(
+                f"rejected execution: in-flight indexing bytes "
+                f"[{self.write_bytes_in_flight + n}] would exceed "
+                f"[{self.write_bytes_limit}]")
+        self.write_bytes_in_flight += n
+
+    def release_write_bytes(self, n: int) -> None:
+        self.write_bytes_in_flight = max(0, self.write_bytes_in_flight - n)
+
+    def stats(self) -> Dict[str, Any]:
+        out = {name: pool.stats() for name, pool in self.pools.items()}
+        out["indexing_pressure"] = {
+            "current_bytes": self.write_bytes_in_flight,
+            "limit_bytes": self.write_bytes_limit,
+            "rejections": self.write_bytes_rejections,
+        }
+        return out

@@ -1,0 +1,107 @@
+"""Thread-pool admission, indexing pressure, and bounded search fan-out.
+
+Reference: threadpool/ThreadPool.java (pool sizing + rejection),
+index/IndexingPressure.java (in-flight write bytes -> 429),
+action/search/AbstractSearchAsyncAction (max_concurrent_shard_requests).
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+from elasticsearch_tpu.utils.errors import RejectedExecutionError
+from elasticsearch_tpu.utils.threadpool import ThreadPoolService
+
+
+def test_pool_slots_queue_and_reject():
+    svc = ThreadPoolService({"p": (2, 3)})
+    ran = []
+    for i in range(5):
+        svc.submit("p", lambda i=i: ran.append(i))
+    # 2 run, 3 queued
+    assert ran == [0, 1]
+    assert svc.pool("p").stats()["queue"] == 3
+    with pytest.raises(RejectedExecutionError):
+        svc.submit("p", lambda: ran.append(99))
+    assert svc.pool("p").stats()["rejected"] == 1
+    # releases drain the queue in order
+    svc.release("p")
+    assert ran == [0, 1, 2]
+    svc.release("p")
+    svc.release("p")
+    svc.release("p")
+    svc.release("p")
+    assert ran == [0, 1, 2, 3, 4]
+    assert svc.pool("p").stats()["completed"] == 5
+    assert svc.pool("p").stats()["active"] == 0
+
+
+def test_write_bytes_pressure():
+    svc = ThreadPoolService()
+    svc.write_bytes_limit = 1000
+    svc.acquire_write_bytes(600)
+    with pytest.raises(RejectedExecutionError):
+        svc.acquire_write_bytes(500)
+    assert svc.stats()["indexing_pressure"]["rejections"] == 1
+    svc.release_write_bytes(600)
+    svc.acquire_write_bytes(900)      # fits after release
+
+
+def test_bulk_rejects_with_429_over_pressure_limit():
+    c = InProcessCluster(n_nodes=1, seed=2)
+    c.start()
+    try:
+        client = c.client()
+        node = c.master()
+        node.thread_pool.write_bytes_limit = 200
+        items = [{"action": "index", "index": "t", "id": f"d{i}",
+                  "source": {"pad": "x" * 200}} for i in range(4)]
+        resp, _err = c.call(lambda cb: node.bulk_action.execute(
+            items, lambda r: cb(r, None)))
+        assert resp.get("rejected") and resp.get("status") == 429
+        # pressure releases fully after rejection; a small bulk succeeds
+        small = [{"action": "index", "index": "t", "id": "ok",
+                  "source": {"v": 1}}]
+        resp, _err = c.call(lambda cb: node.bulk_action.execute(
+            small, lambda r: cb(r, None)))
+        assert not resp.get("errors")
+        assert node.thread_pool.write_bytes_in_flight == 0
+    finally:
+        c.stop()
+
+
+def test_search_bounded_fanout_still_complete():
+    """A 6-shard search with max_concurrent_shard_requests=1 completes
+    with every shard's hits (the window just serializes dispatch)."""
+    c = InProcessCluster(n_nodes=2, seed=4)
+    c.start()
+    try:
+        client = c.client()
+        resp, err = c.call(lambda cb: client.create_index("wide", {
+            "settings": {"number_of_shards": 6,
+                         "number_of_replicas": 0}}, cb))
+        assert err is None
+        c.ensure_green("wide")
+        for i in range(12):
+            resp, err = c.call(lambda cb, i=i: client.index_doc(
+                "wide", f"d{i}", {"v": i}, cb))
+            assert err is None
+        c.call(lambda cb: client.refresh("wide", cb))
+        resp, err = c.call(lambda cb: client.search("wide", {
+            "query": {"match_all": {}}, "size": 20,
+            "max_concurrent_shard_requests": 1}, cb))
+        assert err is None
+        assert resp["hits"]["total"]["value"] == 12
+        assert resp["_shards"]["successful"] == 6
+    finally:
+        c.stop()
+
+
+def test_thread_pool_in_node_stats():
+    c = InProcessCluster(n_nodes=1, seed=3)
+    c.start()
+    try:
+        stats = c.master().local_node_stats()
+        assert "search" in stats["thread_pool"]
+        assert "indexing_pressure" in stats["thread_pool"]
+    finally:
+        c.stop()
