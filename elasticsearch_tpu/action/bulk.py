@@ -51,6 +51,7 @@ class TransportBulkAction:
                          "status": 429,
                          "items": [{item.get("action", "index"): {
                              "id": item.get("id"),
+                             "_index": item.get("index"),
                              "status": 429,
                              "error": {
                                  "type":
@@ -64,7 +65,30 @@ class TransportBulkAction:
                 self.thread_pool.release_write_bytes(est_bytes)
                 inner(resp)
         state = self.state()
-        items = self._run_pipelines(state, items)
+        # fresh list: positional edits below must not mutate the caller's
+        # (ingest-less _run_pipelines returns its input unchanged)
+        items = list(self._run_pipelines(state, items))
+        # index.blocks.write (mounted searchable snapshots, frozen
+        # indices, read-only settings) rejects writes with 403
+        # (ClusterBlockException analog); checked AFTER pipelines since a
+        # processor may redirect the item's target index
+        from elasticsearch_tpu.utils.errors import ClusterBlockError
+        for pos, item in enumerate(items):
+            name = item.get("index")
+            if name and "_ingest_error" not in item and \
+                    not item.get("_dropped") and \
+                    state.metadata.has_index(name) and \
+                    state.metadata.index(name).settings.get(
+                        "index.blocks.write"):
+                block_err = ClusterBlockError(
+                    f"index [{name}] blocked by: "
+                    f"[FORBIDDEN/8/index write (api)]")
+                # FORBIDDEN blocks are 403; the class default (503) is
+                # for no-master/not-recovered blocks
+                block_err.status = 403
+                # copy before mutating: without pipelines the list holds
+                # the CALLER's dicts, which must not accrete error state
+                items[pos] = {**item, "_ingest_error": block_err}
         missing = sorted({item["index"] for item in items
                           if not item.get("_dropped")
                           and "_ingest_error" not in item

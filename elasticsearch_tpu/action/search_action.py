@@ -74,11 +74,15 @@ class SearchTransportService:
     """Data-node side: executes the per-shard search phases."""
 
     def __init__(self, node_id: str, indices: IndicesService,
-                 ts: TransportService, task_manager=None):
+                 ts: TransportService, task_manager=None,
+                 state_supplier=None):
         self.node_id = node_id
         self.indices = indices
         self.ts = ts
         self.task_manager = task_manager
+        # cluster-state access for index-level settings (frozen checks);
+        # None in unit tests driving the shard phases directly
+        self.state = state_supplier
         self._contexts: Dict[str, Tuple[Reader, float]] = {}
         # shard request cache (indices/IndicesRequestCache.java:69):
         # request-bytes-keyed size=0 results, invalidated by the reader's
@@ -260,6 +264,15 @@ class SearchTransportService:
                 self._request_cache.popitem(last=False)
             self._request_cache[cache_key] = response
         self._slow_log(req, time.monotonic() - t_query)
+        # frozen index: device/HBM residency lasts one search — evict the
+        # segment caches rebuilt during this query (FrozenEngine's
+        # per-search reader analog)
+        from elasticsearch_tpu.xpack.searchable_snapshots import (
+            evict_device_caches, is_frozen,
+        )
+        if self.state is not None and \
+                is_frozen(self.state(), req["index"]):
+            evict_device_caches(reader)
         return response
 
     def _on_fetch(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
@@ -312,11 +325,13 @@ class TransportSearchAction:
     def __init__(self, node_id: str, ts: TransportService,
                  state_supplier: Callable[[], ClusterState],
                  task_manager=None, indices: Optional[IndicesService] = None,
-                 mesh_plane=None):
+                 mesh_plane=None, thread_pool=None):
         self.node_id = node_id
         self.ts = ts
         self.state = state_supplier
         self.task_manager = task_manager
+        # coordinator-side search admission (None in unit tests)
+        self.thread_pool = thread_pool
         # SPMD fast path (parallel/mesh_plane.py): when this node drives a
         # multi-device mesh and holds every shard of the index, eligible
         # queries run as ONE compiled program instead of the RPC fan-out
@@ -335,13 +350,26 @@ class TransportSearchAction:
     # ------------------------------------------------------------------
 
     def _resolve_indices(self, expression: str,
-                         state: ClusterState) -> List[str]:
+                         state: ClusterState,
+                         ignore_throttled: bool = True) -> List[str]:
         """Comma lists, `*` wildcards, `_all`, aliases
-        (IndexNameExpressionResolver analog)."""
+        (IndexNameExpressionResolver analog). Frozen indices are excluded
+        from WILDCARD expansion unless ignore_throttled=false — explicit
+        names always resolve (the reference's search-time default)."""
         from elasticsearch_tpu.cluster.metadata import (
             resolve_index_expression,
         )
-        return resolve_index_expression(expression, state.metadata)
+        names = resolve_index_expression(expression, state.metadata)
+        has_wildcard = (not expression or "*" in expression
+                        or expression == "_all")
+        if ignore_throttled and has_wildcard:
+            from elasticsearch_tpu.xpack.searchable_snapshots import (
+                is_frozen,
+            )
+            explicit = {p.strip() for p in (expression or "").split(",")}
+            names = [n for n in names
+                     if n in explicit or not is_frozen(state, n)]
+        return names
 
     def _shard_targets(self, indices: List[str], state: ClusterState
                        ) -> List[Dict[str, Any]]:
@@ -376,6 +404,34 @@ class TransportSearchAction:
     def execute(self, index_expression: str, body: Dict[str, Any],
                 on_done: DoneFn, search_type: str = "query_then_fetch"
                 ) -> None:
+        # coordinator-side admission: the whole async search occupies one
+        # "search" pool slot — runs inline when a slot is free, queues
+        # within bounds, 429s beyond them (ThreadPool search-pool
+        # rejection analog)
+        if self.thread_pool is None:
+            self._execute_admitted(index_expression, body, on_done,
+                                   search_type)
+            return
+        released = {"done": False}
+        inner_admit = on_done
+
+        def releasing_done(resp, err):
+            if not released["done"]:
+                released["done"] = True
+                self.thread_pool.release("search")
+            inner_admit(resp, err)
+
+        try:
+            self.thread_pool.submit(
+                "search",
+                lambda: self._execute_admitted(
+                    index_expression, body, releasing_done, search_type))
+        except Exception as e:  # noqa: BLE001 — backpressure
+            inner_admit(None, e)
+
+    def _execute_admitted(self, index_expression: str,
+                          body: Dict[str, Any], on_done: DoneFn,
+                          search_type: str = "query_then_fetch") -> None:
         t0 = time.monotonic()
         state = self.state()
         body = body or {}
@@ -394,7 +450,9 @@ class TransportSearchAction:
         try:
             max_concurrent = _parse_max_concurrent(
                 body.get("max_concurrent_shard_requests"))
-            indices = self._resolve_indices(index_expression, state)
+            indices = self._resolve_indices(
+                index_expression, state,
+                ignore_throttled=body.get("ignore_throttled", True))
             targets = self._shard_targets(indices, state)
             # coordinator-side inference rewrite: text_expansion model_text
             # becomes tokens ONCE per request (one batched device dispatch),

@@ -119,7 +119,8 @@ class Node:
                                                    self.bulk_action)
         self.search_transport = SearchTransportService(
             node_id, self.indices_service, self.transport_service,
-            task_manager=self.task_manager)
+            task_manager=self.task_manager,
+            state_supplier=self._applied_state)
         self.mesh_plane = None
         if mesh_data_plane:
             # SPMD data plane over the local device mesh (SURVEY §5.8's
@@ -130,7 +131,7 @@ class Node:
         self.search_action = TransportSearchAction(
             node_id, self.transport_service, self._applied_state,
             task_manager=self.task_manager, indices=self.indices_service,
-            mesh_plane=self.mesh_plane)
+            mesh_plane=self.mesh_plane, thread_pool=self.thread_pool)
         self.broadcast_actions = BroadcastActions(
             node_id, self.indices_service, self.transport_service,
             self._applied_state)
@@ -192,6 +193,11 @@ class Node:
 
         from elasticsearch_tpu.xpack.monitoring import MonitoringService
         self.monitoring_service = MonitoringService(self)
+
+        from elasticsearch_tpu.xpack.searchable_snapshots import (
+            SearchableSnapshotsService,
+        )
+        self.searchable_snapshots = SearchableSnapshotsService(self)
 
         # per-node stats endpoint (TransportNodesStatsAction node-level
         # handler): the coordinating node fans `_nodes/stats` out here

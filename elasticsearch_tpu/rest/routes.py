@@ -151,6 +151,11 @@ def build_controller(client: NodeClient) -> RestController:
             if err is not None:
                 respond_error(done, err)
                 return
+            if resp.get("rejected"):
+                # indexing-pressure rejection surfaces as HTTP 429 so
+                # client backoff logic keyed on status codes engages
+                done(429, resp)
+                return
             if req.query.get("refresh") in ("true", "wait_for", ""):
                 indices = ",".join({i["index"] for i in items})
                 client.refresh(indices,
@@ -179,6 +184,13 @@ def build_controller(client: NodeClient) -> RestController:
                 ({part.split(":")[0]: part.split(":")[1]}
                  if ":" in part else part)
                 for part in req.query["sort"].split(",")]
+        if "ignore_throttled" in req.query:
+            body["ignore_throttled"] = \
+                req.query["ignore_throttled"] not in ("false", "0")
+        if "max_concurrent_shard_requests" in req.query:
+            # passed through raw; the action layer validates and 400s
+            body["max_concurrent_shard_requests"] = \
+                req.query["max_concurrent_shard_requests"]
         search_type = req.query.get("search_type", "query_then_fetch")
         client.search(index, body, wrap_client_cb(done),
                       search_type=search_type)
@@ -589,6 +601,24 @@ def build_controller(client: NodeClient) -> RestController:
             req.params["index"], req.body or {}, wrap_client_cb(done))
     r("POST", "/{index}/_graph/explore", graph_explore)
     r("GET", "/{index}/_graph/explore", graph_explore)
+
+    # -- searchable snapshots + frozen indices ----------------------------
+
+    def mount_snapshot(req: RestRequest, done: DoneFn) -> None:
+        client.node.searchable_snapshots.mount(
+            req.params["repo"], req.params["snap"], req.body or {},
+            wrap_client_cb(done))
+    r("POST", "/_snapshot/{repo}/{snap}/_mount", mount_snapshot)
+
+    def freeze_index(req: RestRequest, done: DoneFn) -> None:
+        client.node.searchable_snapshots.set_frozen(
+            req.params["index"], True, wrap_client_cb(done))
+    r("POST", "/{index}/_freeze", freeze_index)
+
+    def unfreeze_index(req: RestRequest, done: DoneFn) -> None:
+        client.node.searchable_snapshots.set_frozen(
+            req.params["index"], False, wrap_client_cb(done))
+    r("POST", "/{index}/_unfreeze", unfreeze_index)
 
     # -- monitoring (x-pack/plugin/monitoring, local-exporter shape) ------
 

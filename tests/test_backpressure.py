@@ -105,3 +105,28 @@ def test_thread_pool_in_node_stats():
         assert "indexing_pressure" in stats["thread_pool"]
     finally:
         c.stop()
+
+
+def test_search_pool_accounts_admissions():
+    """Every coordinated search consumes (and releases) a search-pool
+    slot, so the pool's completed counter moves — the stats operators
+    read during overload are live, not decorative."""
+    c = InProcessCluster(n_nodes=1, seed=8)
+    c.start()
+    try:
+        client = c.client()
+        node = c.master()
+        resp, err = c.call(lambda cb: client.create_index("p", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb))
+        assert err is None
+        c.ensure_green("p")
+        before = node.thread_pool.pool("search").completed
+        resp, err = c.call(lambda cb: client.search(
+            "p", {"query": {"match_all": {}}}, cb))
+        assert err is None
+        after = node.thread_pool.pool("search").completed
+        assert after == before + 1
+        assert node.thread_pool.pool("search").active == 0
+    finally:
+        c.stop()

@@ -1,0 +1,114 @@
+"""Searchable snapshots (mount) and frozen indices.
+
+Reference: x-pack/plugin/searchable-snapshots
+(SearchableSnapshotDirectory, MountSearchableSnapshotAction),
+x-pack frozen-indices (FrozenEngine, TransportFreezeIndexAction).
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = InProcessCluster(n_nodes=2, seed=21, data_path=str(tmp_path))
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _seed(cluster, client, tmp_path):
+    _ok(*cluster.call(lambda cb: client.create_index("src", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {"v": {"type": "keyword"}}}}, cb)))
+    cluster.ensure_green("src")
+    for i in range(4):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "src", f"d{i}", {"v": f"x{i}"}, cb)))
+    cluster.call(lambda cb: client.refresh("src", cb))
+    cluster.call(lambda cb: client.flush("src", cb))
+    _ok(*cluster.call(lambda cb: client.put_repository(
+        "repo1", {"type": "fs", "settings": {
+            "location": str(tmp_path / "repo")}}, cb)))
+    node = cluster.master()
+    _ok(*cluster.call(lambda cb: node.snapshot_actions.create(
+        "repo1", "snap1", {"indices": "src"},
+        lambda r, e=None: cb(r, e))))
+
+
+def test_mount_searchable_snapshot(cluster, tmp_path):
+    client = cluster.client()
+    _seed(cluster, client, tmp_path)
+    node = cluster.master()
+    resp = _ok(*cluster.call(lambda cb: node.searchable_snapshots.mount(
+        "repo1", "snap1", {"index": "src", "renamed_index": "mounted"},
+        cb)))
+    assert resp["snapshot"]["indices"] == ["mounted"]
+    cluster.ensure_yellow("mounted")
+    cluster.call(lambda cb: client.refresh("mounted", cb))
+    res, err = cluster.call(lambda cb: client.search(
+        "mounted", {"query": {"match_all": {}}}, cb))
+    assert err is None and res["hits"]["total"]["value"] == 4
+    # mounted indices are write-blocked with 403
+    resp, err = cluster.call(lambda cb: client.index_doc(
+        "mounted", "new", {"v": "nope"}, cb))
+    assert err is not None and getattr(err, "status", None) == 403
+
+
+def test_freeze_excludes_from_wildcards_but_not_explicit(cluster,
+                                                         tmp_path):
+    client = cluster.client()
+    _seed(cluster, client, tmp_path)
+    node = cluster.master()
+    _ok(*cluster.call(lambda cb: node.searchable_snapshots.set_frozen(
+        "src", True, cb)))
+    # explicit name still searches
+    res, err = cluster.call(lambda cb: client.search(
+        "src", {"query": {"match_all": {}}}, cb))
+    assert err is None and res["hits"]["total"]["value"] == 4
+    # wildcard search skips the frozen index
+    res, err = cluster.call(lambda cb: client.search(
+        "_all", {"query": {"match_all": {}}}, cb))
+    assert err is None and res["hits"]["total"]["value"] == 0
+    # ...unless ignore_throttled=false
+    res, err = cluster.call(lambda cb: client.search(
+        "_all", {"query": {"match_all": {}},
+                 "ignore_throttled": False}, cb))
+    assert err is None and res["hits"]["total"]["value"] == 4
+    # frozen indices reject writes
+    resp, err = cluster.call(lambda cb: client.index_doc(
+        "src", "new", {"v": "no"}, cb))
+    assert err is not None and getattr(err, "status", None) == 403
+    # unfreeze restores both
+    _ok(*cluster.call(lambda cb: node.searchable_snapshots.set_frozen(
+        "src", False, cb)))
+    res, err = cluster.call(lambda cb: client.search(
+        "_all", {"query": {"match_all": {}}}, cb))
+    assert err is None and res["hits"]["total"]["value"] == 4
+
+
+def test_frozen_search_evicts_device_caches(cluster, tmp_path):
+    client = cluster.client()
+    _seed(cluster, client, tmp_path)
+    node = cluster.master()
+    _ok(*cluster.call(lambda cb: node.searchable_snapshots.set_frozen(
+        "src", True, cb)))
+    res, err = cluster.call(lambda cb: client.search(
+        "src", {"query": {"term": {"v": "x1"}}}, cb))
+    assert err is None and res["hits"]["total"]["value"] == 1
+    # after the search, no segment holds device arrays or filter masks
+    for nid, n in cluster.nodes.items():
+        try:
+            shard = n.indices_service.shard("src", 0)
+        except Exception:
+            continue
+        reader = shard.engine.acquire_reader()
+        for seg in reader.segments:
+            assert not seg._device_cache
+            assert not seg._filter_cache
