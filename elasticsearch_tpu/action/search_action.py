@@ -366,7 +366,19 @@ class TransportSearchAction:
             from elasticsearch_tpu.xpack.searchable_snapshots import (
                 is_frozen,
             )
-            explicit = {p.strip() for p in (expression or "").split(",")}
+            # explicit parts protect their targets — including indices
+            # reached through an explicitly named ALIAS
+            explicit: set = set()
+            for part in (expression or "").split(","):
+                part = part.strip()
+                if not part or "*" in part or part == "_all":
+                    continue
+                explicit.add(part)
+                try:
+                    explicit.update(resolve_index_expression(
+                        part, state.metadata))
+                except Exception:  # noqa: BLE001 — unknown part: skip
+                    pass
             names = [n for n in names
                      if n in explicit or not is_frozen(state, n)]
         return names
@@ -421,11 +433,17 @@ class TransportSearchAction:
                 self.thread_pool.release("search")
             inner_admit(resp, err)
 
+        def admitted_task() -> None:
+            # the slot is held from here: ANY synchronous escape must
+            # release it through releasing_done or the pool wedges
+            try:
+                self._execute_admitted(index_expression, body,
+                                       releasing_done, search_type)
+            except Exception as e:  # noqa: BLE001
+                releasing_done(None, e)
+
         try:
-            self.thread_pool.submit(
-                "search",
-                lambda: self._execute_admitted(
-                    index_expression, body, releasing_done, search_type))
+            self.thread_pool.submit("search", admitted_task)
         except Exception as e:  # noqa: BLE001 — backpressure
             inner_admit(None, e)
 

@@ -199,6 +199,9 @@ class Node:
         )
         self.searchable_snapshots = SearchableSnapshotsService(self)
 
+        from elasticsearch_tpu.xpack.ml_jobs import MlJobService
+        self.ml_jobs = MlJobService(self)
+
         # per-node stats endpoint (TransportNodesStatsAction node-level
         # handler): the coordinating node fans `_nodes/stats` out here
         self.transport_service.register_handler(
@@ -283,8 +286,10 @@ class Node:
         self.ccr_service.start()
         self.rollup_service.start()
         self.monitoring_service.start()
+        self.ml_jobs.start()
 
     def stop(self) -> None:
+        self.ml_jobs.stop()
         self.monitoring_service.stop()
         self.rollup_service.stop()
         self.ccr_service.stop()

@@ -602,6 +602,40 @@ def build_controller(client: NodeClient) -> RestController:
     r("POST", "/{index}/_graph/explore", graph_explore)
     r("GET", "/{index}/_graph/explore", graph_explore)
 
+    # -- ML anomaly detection (x-pack/plugin/ml REST surface) -------------
+
+    def ml_put_job(req: RestRequest, done: DoneFn) -> None:
+        client.node.ml_jobs.put_job(req.params["id"], req.body or {},
+                                    wrap_client_cb(done))
+    r("PUT", "/_ml/anomaly_detectors/{id}", ml_put_job)
+
+    def ml_delete_job(req: RestRequest, done: DoneFn) -> None:
+        client.node.ml_jobs.delete_job(req.params["id"],
+                                       wrap_client_cb(done))
+    r("DELETE", "/_ml/anomaly_detectors/{id}", ml_delete_job)
+
+    def ml_open(req: RestRequest, done: DoneFn) -> None:
+        client.node.ml_jobs.set_opened(req.params["id"], True,
+                                       wrap_client_cb(done))
+    r("POST", "/_ml/anomaly_detectors/{id}/_open", ml_open)
+
+    def ml_close(req: RestRequest, done: DoneFn) -> None:
+        client.node.ml_jobs.set_opened(req.params["id"], False,
+                                       wrap_client_cb(done))
+    r("POST", "/_ml/anomaly_detectors/{id}/_close", ml_close)
+
+    def ml_get_jobs(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.node.ml_jobs.jobs(req.params.get("id")))
+    r("GET", "/_ml/anomaly_detectors", ml_get_jobs)
+    r("GET", "/_ml/anomaly_detectors/{id}", ml_get_jobs)
+
+    def ml_records(req: RestRequest, done: DoneFn) -> None:
+        min_score = float(req.query.get("record_score", 0.0))
+        client.node.ml_jobs.records(req.params["id"],
+                                    wrap_client_cb(done),
+                                    min_score=min_score)
+    r("GET", "/_ml/anomaly_detectors/{id}/results/records", ml_records)
+
     # -- searchable snapshots + frozen indices ----------------------------
 
     def mount_snapshot(req: RestRequest, done: DoneFn) -> None:

@@ -107,6 +107,33 @@ def test_thread_pool_in_node_stats():
         c.stop()
 
 
+def test_search_pool_slot_released_on_malformed_request():
+    """A synchronous non-SearchEngineError inside the admitted search
+    (e.g. size='ten') must still release its pool slot — regression:
+    16 malformed requests used to wedge all search traffic."""
+    c = InProcessCluster(n_nodes=1, seed=9)
+    c.start()
+    try:
+        client = c.client()
+        node = c.master()
+        resp, err = c.call(lambda cb: client.create_index("s", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb))
+        assert err is None
+        c.ensure_green("s")
+        for _ in range(3):
+            resp, err = c.call(lambda cb: client.search(
+                "s", {"query": {"match_all": {}}, "size": "ten"}, cb))
+            assert err is not None
+        assert node.thread_pool.pool("search").active == 0
+        # the pool still serves good requests
+        resp, err = c.call(lambda cb: client.search(
+            "s", {"query": {"match_all": {}}}, cb))
+        assert err is None
+    finally:
+        c.stop()
+
+
 def test_search_pool_accounts_admissions():
     """Every coordinated search consumes (and releases) a search-pool
     slot, so the pool's completed counter moves — the stats operators

@@ -1,0 +1,104 @@
+"""Anomaly detection jobs: baseline model, bucket processing, records.
+
+Reference: x-pack/plugin/ml (autodetect + datafeeds, collapsed into the
+node's own aggregation path — see xpack/ml_jobs.py docstring).
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+from elasticsearch_tpu.xpack.ml_jobs import _Baseline
+
+
+def test_baseline_scores_outliers_not_steady_state():
+    b = _Baseline()
+    for v in [10.0, 11.0, 9.0, 10.5, 10.0, 9.5]:
+        assert b.score(v) < 20.0           # steady state stays quiet
+        b.update(v)
+    spike = b.score(100.0)
+    assert spike > 80.0                     # a 10x spike screams
+    # one-sided scoring ignores the wrong direction
+    assert b.score(0.0, sided="high") == 0.0
+    assert b.score(0.0, sided="low") > 50.0
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=2, seed=13)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def test_job_lifecycle_and_anomaly_records(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("metrics", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "@timestamp": {"type": "date"},
+            "latency": {"type": "double"},
+            "svc": {"type": "keyword"}}}}, cb)))
+    cluster.ensure_green("metrics")
+    # 10 quiet minutes then one catastrophic bucket, then a cooldown
+    # bucket (the last bucket is held back as still-filling)
+    base = 1_700_000_000_000
+    minute = 60_000
+    doc = 0
+    for m in range(12):
+        value = 1000.0 if m == 10 else 10.0 + (m % 3)
+        for k in range(3):
+            _ok(*cluster.call(lambda cb, m=m, k=k, value=value, d=doc:
+                              client.index_doc("metrics", f"e{d}", {
+                                  "@timestamp": base + m * minute
+                                  + k * 1000,
+                                  "latency": value, "svc": "api"}, cb)))
+            doc += 1
+    cluster.call(lambda cb: client.refresh("metrics", cb))
+
+    node = cluster.master()
+    _ok(*cluster.call(lambda cb: node.ml_jobs.put_job("lat-job", {
+        "analysis_config": {
+            "bucket_span": "1m",
+            "detectors": [{"function": "high_mean",
+                           "field_name": "latency"}]},
+        "data_description": {"time_field": "@timestamp"},
+        "datafeed_config": {"indices": "metrics"}}, cb)))
+    _ok(*cluster.call(lambda cb: node.ml_jobs.set_opened(
+        "lat-job", True, cb)))
+    cluster.run_until(
+        lambda: node.ml_jobs._state.get("lat-job", {})
+        .get("buckets", 0) >= 11, max_time=300.0)
+    cluster.run_until(
+        lambda: not node.ml_jobs._state["lat-job"].get("busy"),
+        max_time=60.0)
+    cluster.call(lambda cb: client.refresh(".ml-anomalies-lat-job", cb))
+    resp = _ok(*cluster.call(lambda cb: node.ml_jobs.records(
+        "lat-job", cb)))
+    assert resp["count"] >= 1
+    spike = resp["records"][0]
+    assert spike["record_score"] > 75.0
+    assert spike["actual"] == pytest.approx(1000.0)
+    assert spike["typical"] < 20.0
+    # date_histogram keys floor to the epoch-aligned minute
+    assert spike["timestamp"] == (base + 10 * minute) // minute * minute
+    # job listing reflects processed buckets
+    jobs = node.ml_jobs.jobs("lat-job")
+    assert jobs["jobs"][0]["state"] == "opened"
+    assert jobs["jobs"][0]["data_counts"]["processed_bucket_count"] >= 11
+
+
+def test_job_validation(cluster):
+    node = cluster.master()
+    resp, err = cluster.call(lambda cb: node.ml_jobs.put_job("bad", {
+        "analysis_config": {"detectors": [{"function": "exotic"}]},
+        "datafeed_config": {"indices": "x"}}, cb))
+    assert err is not None
+    resp, err = cluster.call(lambda cb: node.ml_jobs.put_job("bad", {
+        "analysis_config": {"detectors": [{"function": "mean"}]},
+        "datafeed_config": {"indices": "x"}}, cb))
+    assert err is not None                  # mean requires field_name
