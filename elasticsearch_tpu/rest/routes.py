@@ -630,10 +630,21 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/_ml/anomaly_detectors/{id}", ml_get_jobs)
 
     def ml_records(req: RestRequest, done: DoneFn) -> None:
-        min_score = float(req.query.get("record_score", 0.0))
-        client.node.ml_jobs.records(req.params["id"],
-                                    wrap_client_cb(done),
-                                    min_score=min_score)
+        def fparam(name, default):
+            raw = req.query.get(name)
+            if raw is None:
+                return default
+            try:
+                return float(raw)
+            except ValueError:
+                raise IllegalArgumentError(
+                    f"[{name}] must be a number, got [{raw}]")
+        client.node.ml_jobs.records(
+            req.params["id"], wrap_client_cb(done),
+            min_score=fparam("record_score", 0.0),
+            from_=int(fparam("from", 0)),
+            size=int(fparam("size", 100)),
+            desc=req.query.get("desc") in ("true", "1"))
     r("GET", "/_ml/anomaly_detectors/{id}/results/records", ml_records)
 
     # -- searchable snapshots + frozen indices ----------------------------

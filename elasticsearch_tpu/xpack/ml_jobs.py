@@ -51,7 +51,11 @@ class _Baseline:
         """Anomaly score 0..100 BEFORE updating with x."""
         if self.n < MIN_BUCKETS_TO_SCORE:
             return 0.0
-        std = math.sqrt(max(self.var, 1e-12))
+        # scale-relative variance floor: a perfectly constant metric must
+        # not turn a one-unit fluctuation into z=1e6 (an absolute 1e-12
+        # floor made every steady gauge a false-positive generator)
+        floor = max((0.05 * abs(self.mean)) ** 2, 1e-9)
+        std = math.sqrt(max(self.var, floor))
         z = (x - self.mean) / std if std > 0 else 0.0
         if sided == "high":
             z = max(z, 0.0)
@@ -124,7 +128,13 @@ class MlJobService:
             return
         try:
             if self.node.coordinator.mode == "LEADER":
-                for job_id, d in self._defs().items():
+                defs = self._defs()
+                # prune runtime state of deleted jobs — the DELETE may
+                # have landed on another node, and a recreated job with
+                # the same id must not inherit dead baselines/ckpt
+                for stale in [j for j in self._state if j not in defs]:
+                    self._state.pop(stale, None)
+                for job_id, d in defs.items():
                     st = self._state.setdefault(job_id, {})
                     if d.get("opened") and not st.get("busy"):
                         self._process(job_id, d)
@@ -223,7 +233,8 @@ class MlJobService:
         return {"count": len(out), "jobs": out}
 
     def records(self, job_id: str, on_done: Callable,
-                min_score: float = 0.0) -> None:
+                min_score: float = 0.0, from_: int = 0,
+                size: int = 100, desc: bool = False) -> None:
         def cb(resp, err):
             if err is not None:
                 from elasticsearch_tpu.utils.errors import (
@@ -237,11 +248,14 @@ class MlJobService:
                     on_done(None, err)
                 return
             records = [h["_source"] for h in resp["hits"]["hits"]]
-            on_done({"count": len(records), "records": records}, None)
+            on_done({"count": resp["hits"]["total"]["value"],
+                     "records": records}, None)
         self.node.search_action.execute(
             f".ml-anomalies-{job_id}",
             {"query": {"range": {"record_score": {"gte": min_score}}},
-             "size": 1000, "sort": [{"timestamp": "asc"}]}, cb)
+             "from": int(from_), "size": min(int(size), 1000),
+             "track_total_hits": True,
+             "sort": [{"timestamp": "desc" if desc else "asc"}]}, cb)
 
     # -- bucket processing -------------------------------------------------
 
@@ -281,9 +295,13 @@ class MlJobService:
                 "aggs": aggs}}}
         ckpt = st.get("ckpt")
         if ckpt is not None:
+            # ckpt is the START of the first UNPROCESSED bucket (the one
+            # held back as still-filling), so gte re-forms exactly it and
+            # later data — never a bucket whose baseline update already
+            # happened (baseline updates are not idempotent)
             body["query"] = {"bool": {"filter": [
                 body["query"],
-                {"range": {time_field: {"gt": ckpt}}}]}}
+                {"range": {time_field: {"gte": ckpt}}}]}}
 
         def cb(resp, err):
             if err is not None:
@@ -291,15 +309,15 @@ class MlJobService:
                                job_id, err)
                 st["busy"] = False
                 return
-            buckets = ((resp.get("aggregations") or {})
-                       .get("buckets") or {}).get("buckets", [])
-            # the LAST bucket may still be filling: hold it back
-            if buckets:
-                buckets = buckets[:-1]
+            all_buckets = ((resp.get("aggregations") or {})
+                           .get("buckets") or {}).get("buckets", [])
+            # the LAST bucket may still be filling: hold it back; its
+            # start key becomes the next run's resume point
+            buckets = all_buckets[:-1]
             records = self._score_buckets(job_id, d, st, detectors,
                                           buckets)
             if buckets:
-                st["ckpt"] = buckets[-1]["key"]
+                st["ckpt"] = all_buckets[-1]["key"]
                 st["buckets"] = st.get("buckets", 0) + len(buckets)
 
             def written(_r=None):

@@ -1,0 +1,46 @@
+"""Run the declarative YAML REST specs against an in-process cluster.
+
+Reference: rest-api-spec/src/main/resources/rest-api-spec/test/** executed
+by ESClientYamlSuiteTestCase — the do/match/set/length step vocabulary,
+shared across official clients. Specs live in tests/rest_specs/.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from elasticsearch_tpu.rest.controller import RestRequest
+from elasticsearch_tpu.rest.routes import build_controller
+from elasticsearch_tpu.testing import InProcessCluster
+
+from tests.yaml_runner import YamlSpecRunner, load_specs
+
+SPEC_DIR = Path(__file__).parent / "rest_specs"
+SPECS = load_specs(SPEC_DIR)
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=2, seed=29)
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.mark.parametrize(
+    "name,steps", SPECS, ids=[name for name, _ in SPECS])
+def test_yaml_spec(cluster, name, steps):
+    controller = build_controller(cluster.client())
+
+    def do_request(method, path, body=None, query=None):
+        req = RestRequest(method=method, path=path,
+                          query=dict(query or {}), body=body,
+                          raw_body=b"")
+        out = []
+        controller.dispatch(req, lambda s, b: out.append((s, b)))
+        cluster.run_until(lambda: bool(out), 120.0)
+        return out[0]
+
+    runner = YamlSpecRunner(do_request)
+    for step in steps:
+        runner.run_step(step)

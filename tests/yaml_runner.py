@@ -1,0 +1,249 @@
+"""Declarative YAML REST test runner.
+
+Reference: rest-api-spec YAML behavior tests executed by
+ESClientYamlSuiteTestCase (test/framework/.../test/rest/yaml/) — ~900
+specs shared by every official client. This runner executes the same
+do/match/set/length/is_true/is_false/gt/lt step vocabulary against an
+in-process cluster's REST controller, so specs written for the reference
+shape port over directly (tests/rest_specs/*.yml).
+
+Spec format (one document per test):
+    "test name":
+      - do:
+          search:
+            index: idx
+            body: {...}
+      - match: {hits.total.value: 3}
+      - length: {hits.hits: 3}
+      - set: {hits.hits.0._id: doc_id}
+      - match: {$doc_id: "d1"}      # stashed values
+      - is_true: acknowledged
+      - gt: {took: -1}
+"""
+
+from __future__ import annotations
+
+import numbers
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+# "api name" -> (method, path template); path params fill from the call
+# body's top-level keys, remaining keys become query/body
+_API_TABLE = {
+    "indices.create": ("PUT", "/{index}"),
+    "indices.delete": ("DELETE", "/{index}"),
+    "indices.refresh": ("POST", "/{index}/_refresh"),
+    "indices.put_mapping": ("PUT", "/{index}/_mapping"),
+    "indices.get_mapping": ("GET", "/{index}/_mapping"),
+    "indices.put_settings": ("PUT", "/{index}/_settings"),
+    "indices.exists": ("HEAD", "/{index}"),
+    "indices.put_alias": ("PUT", "/{index}/_alias/{name}"),
+    "index": ("PUT", "/{index}/_doc/{id}"),
+    "create": ("PUT", "/{index}/_create/{id}"),
+    "get": ("GET", "/{index}/_doc/{id}"),
+    "delete": ("DELETE", "/{index}/_doc/{id}"),
+    "update": ("POST", "/{index}/_update/{id}"),
+    "search": ("POST", "/{index}/_search"),
+    "count": ("POST", "/{index}/_count"),
+    "bulk": ("POST", "/_bulk"),
+    "mget": ("POST", "/{index}/_mget"),
+    "cluster.health": ("GET", "/_cluster/health"),
+    "cluster.put_settings": ("PUT", "/_cluster/settings"),
+    "cat.indices": ("GET", "/_cat/indices"),
+    "cat.count": ("GET", "/_cat/count/{index}"),
+    "ingest.put_pipeline": ("PUT", "/_ingest/pipeline/{id}"),
+    "ingest.simulate": ("POST", "/_ingest/pipeline/_simulate"),
+}
+
+
+class YamlSpecFailure(AssertionError):
+    pass
+
+
+class YamlSpecRunner:
+    def __init__(self, do_request):
+        """do_request(method, path, body=None, query=None) ->
+        (status, body)"""
+        self.do_request = do_request
+        self.stash: Dict[str, Any] = {}
+        self.last_response: Any = None
+        self.last_status: int = 0
+
+    # -- value plumbing ----------------------------------------------------
+
+    def _resolve_stash(self, value: Any) -> Any:
+        if isinstance(value, str) and value.startswith("$"):
+            return self.stash[value[1:]]
+        if isinstance(value, dict):
+            return {k: self._resolve_stash(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [self._resolve_stash(v) for v in value]
+        return value
+
+    def _lookup(self, path: str) -> Any:
+        """Dotted path into the last response; $stash refs resolve;
+        escaped dots (a\\.b) address literal dotted keys; numeric parts
+        index arrays."""
+        if path.startswith("$"):
+            return self.stash[path[1:]]
+        node = self.last_response
+        parts = [p.replace("\0", ".")
+                 for p in path.replace("\\.", "\0").split(".")]
+        for part in parts:
+            if part == "":
+                continue
+            if isinstance(node, list):
+                node = node[int(part)]
+            elif isinstance(node, dict):
+                if part in node:
+                    node = node[part]
+                else:
+                    raise YamlSpecFailure(
+                        f"path [{path}]: missing key [{part}] in "
+                        f"{sorted(node)[:12]}")
+            else:
+                raise YamlSpecFailure(
+                    f"path [{path}]: cannot descend [{part}] into "
+                    f"{type(node).__name__}")
+        return node
+
+    # -- steps -------------------------------------------------------------
+
+    def run_step(self, step: Dict[str, Any]) -> None:
+        (kind, spec), = step.items()
+        handler = getattr(self, f"step_{kind}", None)
+        if handler is None:
+            raise YamlSpecFailure(f"unsupported step [{kind}]")
+        handler(spec)
+
+    def step_do(self, spec: Dict[str, Any]) -> None:
+        spec = dict(spec)
+        catch = spec.pop("catch", None)
+        (api, params), = spec.items()
+        params = dict(self._resolve_stash(params or {}))
+        if api == "raw":
+            method = params.pop("method")
+            path = params.pop("path")
+            body = params.pop("body", None)
+            query = params
+        else:
+            entry = _API_TABLE.get(api)
+            if entry is None:
+                raise YamlSpecFailure(f"unknown API [{api}]")
+            method, template = entry
+            body = params.pop("body", None)
+            path = template
+            for name in re.findall(r"{(\w+)}", template):
+                if name in params:
+                    path = path.replace(f"{{{name}}}",
+                                        str(params.pop(name)))
+                elif name == "index":
+                    path = path.replace("/{index}", "")
+                else:
+                    raise YamlSpecFailure(
+                        f"API [{api}] requires [{name}]")
+            query = {k: str(v) for k, v in params.items()}
+        status, resp = self.do_request(method, path, body=body,
+                                       query=query)
+        self.last_status = status
+        self.last_response = resp
+        if catch is not None:
+            self._check_catch(catch, status, resp)
+        elif status >= 400:
+            raise YamlSpecFailure(
+                f"[{api}] failed with {status}: {resp}")
+
+    def _check_catch(self, catch: str, status: int, resp: Any) -> None:
+        expectations = {
+            "missing": lambda: status == 404,
+            "conflict": lambda: status == 409,
+            "forbidden": lambda: status == 403,
+            "bad_request": lambda: status == 400,
+            "request": lambda: status >= 400,
+        }
+        if catch.startswith("/") and catch.endswith("/"):
+            ok = status >= 400 and re.search(catch[1:-1], str(resp))
+        else:
+            check = expectations.get(catch)
+            if check is None:
+                raise YamlSpecFailure(f"unsupported catch [{catch}]")
+            ok = check()
+        if not ok:
+            raise YamlSpecFailure(
+                f"expected catch [{catch}], got {status}: {resp}")
+
+    def step_match(self, spec: Dict[str, Any]) -> None:
+        for path, expected in spec.items():
+            actual = self._lookup(path)
+            expected = self._resolve_stash(expected)
+            if isinstance(expected, str) and len(expected) > 2 and \
+                    expected.startswith("/") and expected.endswith("/"):
+                if not re.search(expected[1:-1].strip(), str(actual)):
+                    raise YamlSpecFailure(
+                        f"match [{path}]: {actual!r} !~ {expected}")
+                continue
+            if isinstance(expected, numbers.Number) and \
+                    isinstance(actual, numbers.Number):
+                if float(actual) != float(expected):
+                    raise YamlSpecFailure(
+                        f"match [{path}]: {actual!r} != {expected!r}")
+                continue
+            if actual != expected:
+                raise YamlSpecFailure(
+                    f"match [{path}]: {actual!r} != {expected!r}")
+
+    def step_length(self, spec: Dict[str, Any]) -> None:
+        for path, expected in spec.items():
+            actual = self._lookup(path)
+            if len(actual) != int(expected):
+                raise YamlSpecFailure(
+                    f"length [{path}]: {len(actual)} != {expected}")
+
+    def step_set(self, spec: Dict[str, Any]) -> None:
+        for path, name in spec.items():
+            self.stash[name] = self._lookup(path)
+
+    def step_is_true(self, path: str) -> None:
+        value = self._lookup(path)
+        if not value:
+            raise YamlSpecFailure(f"is_true [{path}]: {value!r}")
+
+    def step_is_false(self, path: str) -> None:
+        value = self._lookup(path)
+        if value:
+            raise YamlSpecFailure(f"is_false [{path}]: {value!r}")
+
+    def step_gt(self, spec: Dict[str, Any]) -> None:
+        for path, bound in spec.items():
+            actual = self._lookup(path)
+            if not actual > self._resolve_stash(bound):
+                raise YamlSpecFailure(f"gt [{path}]: {actual} <= {bound}")
+
+    def step_lt(self, spec: Dict[str, Any]) -> None:
+        for path, bound in spec.items():
+            actual = self._lookup(path)
+            if not actual < self._resolve_stash(bound):
+                raise YamlSpecFailure(f"lt [{path}]: {actual} >= {bound}")
+
+    def step_gte(self, spec: Dict[str, Any]) -> None:
+        for path, bound in spec.items():
+            actual = self._lookup(path)
+            if not actual >= self._resolve_stash(bound):
+                raise YamlSpecFailure(f"gte [{path}]: {actual} < {bound}")
+
+
+def load_specs(directory: Path) -> List[Tuple[str, List[Dict[str, Any]]]]:
+    """(test name, steps) for every YAML doc in every spec file."""
+    out: List[Tuple[str, List[Dict[str, Any]]]] = []
+    for path in sorted(directory.glob("*.yml")):
+        for doc in yaml.safe_load_all(path.read_text()):
+            if not doc:
+                continue
+            for name, steps in doc.items():
+                if name in ("setup", "teardown"):
+                    continue
+                out.append((f"{path.stem}/{name}", steps))
+    return out
