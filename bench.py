@@ -433,6 +433,11 @@ def main() -> None:
         import jax.numpy as jnp
         import numpy as np
         result["backend"] = jax.default_backend()
+        if result["backend"] != "tpu" and not CPU_SCALED:
+            # any non-TPU backend (probe succeeded on a CPU-only jax)
+            # still needs the smaller corpora to finish
+            globals()["CPU_SCALED"] = True
+            result["cpu_scaled"] = True
 
         knn_corpus = None
         bm25_ctx = None

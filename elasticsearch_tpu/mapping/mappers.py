@@ -455,6 +455,26 @@ class GeoPointFieldMapper(FieldMapper):
         return ParsedField(self.name, "geo", geo=(lat, lon))
 
 
+class GeoShapeFieldMapper(FieldMapper):
+    """GeoJSON geometries (index/mapper/GeoShapeFieldMapper analog).
+
+    The reference triangulates into a BKD tree; here the shape stays in
+    _source (validated at index time) and geo_shape queries evaluate
+    relations host-side over candidate docs (search/geoshape.py). A
+    centroid lands in the geo column so existence and bbox prefilters
+    stay columnar."""
+
+    type_name = "geo_shape"
+
+    def parse(self, value: Any) -> ParsedField:
+        from elasticsearch_tpu.search.geoshape import parse_shape
+        shape = parse_shape(value)          # validates or raises
+        min_lon, min_lat, max_lon, max_lat = shape.bbox()
+        return ParsedField(self.name, "geo",
+                           geo=((min_lat + max_lat) / 2.0,
+                                (min_lon + max_lon) / 2.0))
+
+
 class CompletionFieldMapper(FieldMapper):
     """Auto-complete inputs (reference: index/mapper/CompletionFieldMapper).
 
@@ -703,6 +723,7 @@ _MAPPER_TYPES = {
     "rank_features": RankFeaturesFieldMapper,
     "rank_feature": RankFeatureFieldMapper,
     "geo_point": GeoPointFieldMapper,
+    "geo_shape": GeoShapeFieldMapper,
     "ip": IpFieldMapper,
     "binary": BinaryFieldMapper,
     "token_count": TokenCountFieldMapper,

@@ -202,6 +202,9 @@ class Node:
         from elasticsearch_tpu.xpack.ml_jobs import MlJobService
         self.ml_jobs = MlJobService(self)
 
+        from elasticsearch_tpu.xpack.autoscaling import AutoscalingService
+        self.autoscaling = AutoscalingService(self)
+
         # per-node stats endpoint (TransportNodesStatsAction node-level
         # handler): the coordinating node fans `_nodes/stats` out here
         self.transport_service.register_handler(

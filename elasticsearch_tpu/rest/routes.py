@@ -602,6 +602,29 @@ def build_controller(client: NodeClient) -> RestController:
     r("POST", "/{index}/_graph/explore", graph_explore)
     r("GET", "/{index}/_graph/explore", graph_explore)
 
+    # -- deprecation info (x-pack/plugin/deprecation) ---------------------
+
+    def migration_deprecations(req: RestRequest, done: DoneFn) -> None:
+        from elasticsearch_tpu.xpack.deprecation import deprecations
+        done(200, deprecations(client.node._applied_state()))
+    r("GET", "/_migration/deprecations", migration_deprecations)
+
+    # -- autoscaling (x-pack/plugin/autoscaling) --------------------------
+
+    def autoscaling_put(req: RestRequest, done: DoneFn) -> None:
+        client.node.autoscaling.put_policy(
+            req.params["name"], req.body or {}, wrap_client_cb(done))
+    r("PUT", "/_autoscaling/policy/{name}", autoscaling_put)
+
+    def autoscaling_delete(req: RestRequest, done: DoneFn) -> None:
+        client.node.autoscaling.delete_policy(
+            req.params["name"], wrap_client_cb(done))
+    r("DELETE", "/_autoscaling/policy/{name}", autoscaling_delete)
+
+    def autoscaling_capacity(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.node.autoscaling.capacity())
+    r("GET", "/_autoscaling/capacity", autoscaling_capacity)
+
     # -- ML anomaly detection (x-pack/plugin/ml REST surface) -------------
 
     def ml_put_job(req: RestRequest, done: DoneFn) -> None:
@@ -644,7 +667,7 @@ def build_controller(client: NodeClient) -> RestController:
             min_score=fparam("record_score", 0.0),
             from_=int(fparam("from", 0)),
             size=int(fparam("size", 100)),
-            desc=req.query.get("desc") in ("true", "1"))
+            desc=req.flag("desc"))
     r("GET", "/_ml/anomaly_detectors/{id}/results/records", ml_records)
 
     # -- searchable snapshots + frozen indices ----------------------------

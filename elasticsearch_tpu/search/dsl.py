@@ -558,6 +558,17 @@ class ScriptQuery(Query):
 
 
 @dataclass
+class GeoShape(Query):
+    """Docs whose geo_shape relates to the query geometry
+    (GeoShapeQueryBuilder analog)."""
+    # shape must precede the "field" attribute (dataclasses.field shadow)
+    shape: Dict[str, Any] = field(default_factory=dict)
+    field: str = ""
+    relation: str = "intersects"
+    boost: float = 1.0
+
+
+@dataclass
 class GeoPolygon(Query):
     """Docs whose geo_point lies inside the closed polygon
     (GeoPolygonQueryBuilder analog)."""
@@ -835,7 +846,24 @@ _PARSERS = {
         boost=float(spec.get("boost", 1.0))),
     "wrapper": lambda spec: _parse_wrapper(spec),
     "geo_polygon": lambda spec: _parse_geo_polygon(spec),
+    "geo_shape": lambda spec: _parse_geo_shape(spec),
 }
+
+
+def _parse_geo_shape(spec) -> GeoShape:
+    opts = {k: v for k, v in spec.items()
+            if k not in ("boost", "ignore_unmapped")}
+    if len(opts) != 1:
+        raise QueryParsingError("geo_shape requires exactly one field")
+    (fname, body), = opts.items()
+    if not isinstance(body, dict) or "shape" not in body:
+        raise QueryParsingError("geo_shape requires [shape]")
+    relation = str(body.get("relation", "intersects")).lower()
+    if relation not in ("intersects", "disjoint", "within", "contains"):
+        raise QueryParsingError(
+            f"unknown geo_shape relation [{relation}]")
+    return GeoShape(field=fname, shape=body["shape"], relation=relation,
+                    boost=float(spec.get("boost", 1.0)))
 
 
 def _parse_span_term(spec) -> SpanTerm:

@@ -1672,6 +1672,44 @@ class _ScriptDocView:
         return len(self[name].values) > 0
 
 
+def _h_geo_shape(q: dsl.GeoShape, ctx: SegmentContext) -> Result:
+    """Relation test against stored GeoJSON shapes (GeoShapeQueryBuilder
+    analog): candidate docs from the columnar centroid-existence check,
+    exact relations host-side from _source (search/geoshape.py)."""
+    from elasticsearch_tpu.search.geoshape import (
+        parse_shape, relation_matches,
+    )
+    try:
+        query_shape = parse_shape(q.shape)
+    except Exception as e:  # noqa: BLE001 — malformed query geometry
+        raise QueryParsingError(f"failed to parse geo_shape query: {e}")
+    seg = ctx.segment
+
+    from elasticsearch_tpu.search.fetch import _field_from_source
+
+    def build():
+        # every relation is exists-gated: docs without the field match
+        # nothing, including disjoint (the reference's semantics)
+        mask = np.zeros(seg.n_docs, bool)
+        has = _exists_mask_host(ctx, q.field)
+        for d in np.nonzero(has)[0]:
+            raw = _field_from_source(seg.sources[d] or {}, q.field)
+            if raw is None:
+                continue
+            try:
+                doc_shape = parse_shape(raw)
+            except Exception:  # noqa: BLE001 — unparseable stored shape
+                continue
+            if relation_matches(doc_shape, query_shape, q.relation):
+                mask[d] = True
+        return ctx.to_device_mask(mask)
+
+    mask = seg.cached_filter(
+        ("geo_shape", q.field, repr(q.shape), q.relation), build) \
+        & ctx.live
+    return jnp.where(mask, jnp.float32(q.boost), 0.0), mask
+
+
 def _h_geo_polygon(q: dsl.GeoPolygon, ctx: SegmentContext) -> Result:
     def build():
         pts = _geo_column(ctx, q.field)
@@ -1711,6 +1749,7 @@ _HANDLERS = {
     dsl.Pinned: _h_pinned,
     dsl.ScriptQuery: _h_script_query,
     dsl.GeoPolygon: _h_geo_polygon,
+    dsl.GeoShape: _h_geo_shape,
     dsl.MatchAll: _h_match_all,
     dsl.MatchNone: _h_match_none,
     dsl.Match: _h_match,

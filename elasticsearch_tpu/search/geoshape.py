@@ -1,0 +1,236 @@
+"""geo_shape geometry: GeoJSON parsing and spatial relations.
+
+Reference: libs/geo + server geo_shape mapping/query
+(index/mapper/GeoShapeFieldMapper, index/query/GeoShapeQueryBuilder) and
+x-pack spatial. The reference triangulates shapes into a BKD tree; here
+shapes live beside _source and relations evaluate host-side per
+candidate doc with exact exterior-ring math (holes are accepted on
+parse but ignored for relations — documented divergence).
+
+Supported GeoJSON: Point, MultiPoint, LineString, MultiLineString,
+Polygon, MultiPolygon, Envelope (ES extension: [[minLon, maxLat],
+[maxLon, minLat]]). Relations: intersects, disjoint, within, contains.
+Coordinates are (lon, lat) per GeoJSON; math is planar (adequate for
+the non-polar, non-antimeridian cases the tests and common usage hit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from elasticsearch_tpu.utils.errors import (
+    IllegalArgumentError, MapperParsingError,
+)
+
+Point = Tuple[float, float]            # (lon, lat)
+Ring = List[Point]
+
+
+class Shape:
+    """Normalized geometry: a bag of points, segments, and polygon
+    exterior rings (closed, first point repeated)."""
+
+    __slots__ = ("points", "lines", "rings")
+
+    def __init__(self, points: List[Point], lines: List[List[Point]],
+                 rings: List[Ring]):
+        self.points = points
+        self.lines = lines
+        self.rings = rings
+
+    def bbox(self) -> Tuple[float, float, float, float]:
+        xs = [p[0] for p in self.points]
+        ys = [p[1] for p in self.points]
+        for line in self.lines:
+            xs += [p[0] for p in line]
+            ys += [p[1] for p in line]
+        for ring in self.rings:
+            xs += [p[0] for p in ring]
+            ys += [p[1] for p in ring]
+        if not xs:
+            raise IllegalArgumentError("empty geometry")
+        return min(xs), min(ys), max(xs), max(ys)
+
+    def vertices(self) -> List[Point]:
+        out = list(self.points)
+        for line in self.lines:
+            out.extend(line)
+        for ring in self.rings:
+            out.extend(ring[:-1])
+        return out
+
+    def segments(self) -> List[Tuple[Point, Point]]:
+        out: List[Tuple[Point, Point]] = []
+        for line in self.lines:
+            out.extend(zip(line, line[1:]))
+        for ring in self.rings:
+            out.extend(zip(ring, ring[1:]))
+        return out
+
+
+def parse_shape(spec: Any) -> Shape:
+    """GeoJSON (or WKT-free ES envelope) -> Shape."""
+    if not isinstance(spec, dict) or "type" not in spec:
+        raise MapperParsingError(f"cannot parse geo_shape [{spec!r}]")
+    gtype = str(spec["type"]).lower()
+    coords = spec.get("coordinates")
+
+    def pt(c) -> Point:
+        return (float(c[0]), float(c[1]))
+
+    def ring(c) -> Ring:
+        r = [pt(p) for p in c]
+        if len(r) < 4 or r[0] != r[-1]:
+            raise MapperParsingError(
+                "polygon ring must be closed with >= 4 points")
+        return r
+
+    if gtype == "point":
+        return Shape([pt(coords)], [], [])
+    if gtype == "multipoint":
+        return Shape([pt(c) for c in coords], [], [])
+    if gtype == "linestring":
+        return Shape([], [[pt(c) for c in coords]], [])
+    if gtype == "multilinestring":
+        return Shape([], [[pt(c) for c in line] for line in coords], [])
+    if gtype == "polygon":
+        return Shape([], [], [ring(coords[0])])   # exterior only
+    if gtype == "multipolygon":
+        return Shape([], [], [ring(poly[0]) for poly in coords])
+    if gtype == "envelope":
+        (min_lon, max_lat), (max_lon, min_lat) = coords
+        r: Ring = [(float(min_lon), float(min_lat)),
+                   (float(max_lon), float(min_lat)),
+                   (float(max_lon), float(max_lat)),
+                   (float(min_lon), float(max_lat)),
+                   (float(min_lon), float(min_lat))]
+        return Shape([], [], [r])
+    if gtype == "geometrycollection":
+        points: List[Point] = []
+        lines: List[List[Point]] = []
+        rings: List[Ring] = []
+        for g in spec.get("geometries", []):
+            s = parse_shape(g)
+            points += s.points
+            lines += s.lines
+            rings += s.rings
+        return Shape(points, lines, rings)
+    raise MapperParsingError(f"unsupported geo_shape type [{gtype}]")
+
+
+# ---------------------------------------------------------------------------
+# planar predicates
+# ---------------------------------------------------------------------------
+
+def _point_in_ring(p: Point, ring: Ring) -> bool:
+    x, y = p
+    inside = False
+    for (x1, y1), (x2, y2) in zip(ring, ring[1:]):
+        if (y1 > y) != (y2 > y):
+            xi = x1 + (y - y1) * (x2 - x1) / ((y2 - y1) or 1e-300)
+            if x < xi:
+                inside = not inside
+            elif x == xi:
+                return True               # on the boundary counts as in
+    return inside
+
+
+def _point_in_shape_area(p: Point, shape: Shape) -> bool:
+    return any(_point_in_ring(p, r) for r in shape.rings)
+
+
+def _orient(a: Point, b: Point, c: Point) -> float:
+    return (b[0] - a[0]) * (c[1] - a[1]) - (b[1] - a[1]) * (c[0] - a[0])
+
+
+def _on_segment(a: Point, b: Point, p: Point) -> bool:
+    return (min(a[0], b[0]) <= p[0] <= max(a[0], b[0]) and
+            min(a[1], b[1]) <= p[1] <= max(a[1], b[1]))
+
+
+def _segments_cross(a: Point, b: Point, c: Point, d: Point) -> bool:
+    o1, o2 = _orient(a, b, c), _orient(a, b, d)
+    o3, o4 = _orient(c, d, a), _orient(c, d, b)
+    if ((o1 > 0) != (o2 > 0)) and ((o3 > 0) != (o4 > 0)):
+        return True
+    # collinear touches
+    if o1 == 0 and _on_segment(a, b, c):
+        return True
+    if o2 == 0 and _on_segment(a, b, d):
+        return True
+    if o3 == 0 and _on_segment(c, d, a):
+        return True
+    if o4 == 0 and _on_segment(c, d, b):
+        return True
+    return False
+
+
+def intersects(a: Shape, b: Shape) -> bool:
+    ax1, ay1, ax2, ay2 = a.bbox()
+    bx1, by1, bx2, by2 = b.bbox()
+    if ax2 < bx1 or bx2 < ax1 or ay2 < by1 or by2 < ay1:
+        return False                       # disjoint bboxes: cheap exit
+    # any point of one inside the other's area
+    for p in a.vertices():
+        if _point_in_shape_area(p, b):
+            return True
+    for p in b.vertices():
+        if _point_in_shape_area(p, a):
+            return True
+    # point-on-point / point-on-line equality
+    bpts = set(b.points)
+    if any(p in bpts for p in a.points):
+        return True
+    # any segments crossing
+    segs_b = b.segments()
+    for s1, s2 in a.segments():
+        for t1, t2 in segs_b:
+            if _segments_cross(s1, s2, t1, t2):
+                return True
+    # points lying exactly on the other's segments
+    for p in a.points:
+        for t1, t2 in segs_b:
+            if _orient(t1, t2, p) == 0 and _on_segment(t1, t2, p):
+                return True
+    for p in b.points:
+        for s1, s2 in a.segments():
+            if _orient(s1, s2, p) == 0 and _on_segment(s1, s2, p):
+                return True
+    return False
+
+
+def within(inner: Shape, outer: Shape) -> bool:
+    """Every part of ``inner`` lies inside ``outer``'s area."""
+    if not outer.rings:
+        return False
+    verts = inner.vertices()
+    if not verts:
+        return False
+    if not all(_point_in_shape_area(p, outer) for p in verts):
+        return False
+    # no inner edge may cross an outer ring boundary (a vertex-inside test
+    # alone misses edges that dip out and back in)
+    outer_segs = outer.segments()
+    for s1, s2 in inner.segments():
+        for t1, t2 in outer_segs:
+            if _segments_cross(s1, s2, t1, t2) and \
+                    not (s1 in (t1, t2) or s2 in (t1, t2)):
+                # touching the boundary is allowed; crossing is not —
+                # distinguish by midpoint containment
+                mid = ((s1[0] + s2[0]) / 2, (s1[1] + s2[1]) / 2)
+                if not _point_in_shape_area(mid, outer):
+                    return False
+    return True
+
+
+def relation_matches(doc_shape: Shape, query_shape: Shape,
+                     relation: str) -> bool:
+    if relation == "intersects":
+        return intersects(doc_shape, query_shape)
+    if relation == "disjoint":
+        return not intersects(doc_shape, query_shape)
+    if relation == "within":
+        return within(doc_shape, query_shape)
+    if relation == "contains":
+        return within(query_shape, doc_shape)
+    raise IllegalArgumentError(f"unknown geo_shape relation [{relation}]")

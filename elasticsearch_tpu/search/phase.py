@@ -158,7 +158,8 @@ def contains_term_expansion(q: dsl.Query) -> bool:
                              dsl.Intervals, dsl.QueryString,
                              dsl.SimpleQueryString, dsl.TermsSet,
                              dsl.DistanceFeature, dsl.ScriptQuery,
-                             dsl.GeoPolygon, dsl.Percolate)):
+                             dsl.GeoPolygon, dsl.GeoShape,
+                             dsl.Percolate)):
             # expanded/derived matching: literal query text existing as a
             # term is NOT a precondition for hits, so can_match must not
             # prune on df. (query_string/simple_query_string parse to

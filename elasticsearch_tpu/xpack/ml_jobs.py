@@ -51,11 +51,14 @@ class _Baseline:
         """Anomaly score 0..100 BEFORE updating with x."""
         if self.n < MIN_BUCKETS_TO_SCORE:
             return 0.0
-        # scale-relative variance floor: a perfectly constant metric must
-        # not turn a one-unit fluctuation into z=1e6 (an absolute 1e-12
-        # floor made every steady gauge a false-positive generator)
-        floor = max((0.05 * abs(self.mean)) ** 2, 1e-9)
-        std = math.sqrt(max(self.var, floor))
+        # variance floor engages ONLY for degenerate (near-constant)
+        # baselines: a steady gauge must not score one-unit blips as
+        # z=1e6, but a genuinely learned tight variance (mean 1000,
+        # std 10) must keep its full sensitivity
+        if self.var < 1e-9:
+            std = math.sqrt(max((0.05 * abs(self.mean)) ** 2, 1e-9))
+        else:
+            std = math.sqrt(self.var)
         z = (x - self.mean) / std if std > 0 else 0.0
         if sided == "high":
             z = max(z, 0.0)

@@ -1,0 +1,74 @@
+"""Deprecation info API and autoscaling policies/capacity.
+
+Reference: x-pack/plugin/deprecation (DeprecationInfoAction checks),
+x-pack/plugin/autoscaling (policies + capacity decisions).
+"""
+
+import pytest
+
+from elasticsearch_tpu.rest.controller import RestRequest
+from elasticsearch_tpu.rest.routes import build_controller
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=2, seed=31)
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def rest(cluster):
+    controller = build_controller(cluster.client())
+
+    def do(method, path, body=None, query=None):
+        req = RestRequest(method=method, path=path,
+                          query=dict(query or {}), body=body, raw_body=b"")
+        out = []
+        controller.dispatch(req, lambda s, b: out.append((s, b)))
+        cluster.run_until(lambda: bool(out), 120.0)
+        return out[0]
+    return do
+
+
+def test_deprecations_flag_risky_indices(cluster, rest):
+    s, _ = rest("PUT", "/risky", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0,
+        "index.translog.durability": "async"}})
+    assert s == 200
+    s, _ = rest("PUT", "/greedy", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 5}})
+    assert s == 200
+    s, body = rest("GET", "/_migration/deprecations")
+    assert s == 200
+    risky = {i["message"] for i in body["index_settings"]["risky"]}
+    assert any("replicas" in m for m in risky)          # 0 replicas
+    assert any("durability" in m for m in risky)        # async translog
+    greedy = {i["message"] for i in body["index_settings"]["greedy"]}
+    assert any("can ever be assigned" in m for m in greedy)
+
+
+def test_autoscaling_policy_and_capacity(cluster, rest):
+    s, body = rest("PUT", "/_autoscaling/policy/data-tier",
+                   {"roles": ["data"]})
+    assert s == 200 and body["acknowledged"]
+    # a policy without roles is rejected
+    s, _ = rest("PUT", "/_autoscaling/policy/bad", {})
+    assert s == 400
+    s, _ = rest("PUT", "/idx", {"settings": {
+        "number_of_shards": 2, "number_of_replicas": 0}})
+    cluster.ensure_green("idx")
+    s, body = rest("GET", "/_autoscaling/capacity")
+    assert s == 200
+    pol = body["policies"]["data-tier"]
+    assert pol["current_capacity"]["total"]["nodes"] == 2
+    assert pol["required_capacity"]["total"]["nodes"] >= 1
+    assert pol["deciders"]["shard_density"]["assigned_shards"] == 2
+    s, body = rest("DELETE", "/_autoscaling/policy/data-tier")
+    assert s == 200
+    s, body = rest("GET", "/_autoscaling/capacity")
+    assert body["policies"] == {}
+    s, _ = rest("DELETE", "/_autoscaling/policy/data-tier")
+    assert s == 404

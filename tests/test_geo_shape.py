@@ -1,0 +1,95 @@
+"""geo_shape field + query: GeoJSON parsing and spatial relations.
+
+Reference: index/mapper/GeoShapeFieldMapper,
+index/query/GeoShapeQueryBuilder, libs/geo.
+"""
+
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapping.mappers import MapperService
+from elasticsearch_tpu.search.geoshape import (
+    intersects, parse_shape, relation_matches, within,
+)
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.utils.errors import MapperParsingError
+
+
+def sq(x1, y1, x2, y2):
+    return {"type": "polygon", "coordinates": [[
+        [x1, y1], [x2, y1], [x2, y2], [x1, y2], [x1, y1]]]}
+
+
+def test_geometry_predicates():
+    a = parse_shape(sq(0, 0, 10, 10))
+    b = parse_shape(sq(5, 5, 15, 15))
+    c = parse_shape(sq(20, 20, 30, 30))
+    inner = parse_shape(sq(2, 2, 4, 4))
+    pt = parse_shape({"type": "point", "coordinates": [3, 3]})
+    line = parse_shape({"type": "linestring",
+                        "coordinates": [[-5, 3], [25, 25]]})
+    assert intersects(a, b) and not intersects(a, c)
+    assert within(inner, a) and not within(b, a)
+    assert intersects(pt, a) and not intersects(pt, c)
+    assert intersects(line, a) and intersects(line, c)
+    assert relation_matches(a, c, "disjoint")
+    assert relation_matches(a, inner, "contains")
+    # envelope form: [[minLon, maxLat], [maxLon, minLat]]
+    env = parse_shape({"type": "envelope",
+                       "coordinates": [[0, 10], [10, 0]]})
+    assert within(inner, env)
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(MapperParsingError):
+        parse_shape({"type": "polygon", "coordinates": [[[0, 0], [1, 1]]]})
+    with pytest.raises(MapperParsingError):
+        parse_shape({"nope": 1})
+
+
+@pytest.fixture()
+def svc():
+    mappers = MapperService({"properties": {
+        "area": {"type": "geo_shape"},
+        "name": {"type": "keyword"},
+    }})
+    engine = InternalEngine(mappers)
+    engine.index("paris_zone", {"name": "paris",
+                                "area": sq(2.2, 48.7, 2.5, 49.0)})
+    engine.index("london_zone", {"name": "london",
+                                 "area": sq(-0.3, 51.3, 0.2, 51.7)})
+    engine.index("europe", {"name": "europe",
+                            "area": sq(-10.0, 35.0, 30.0, 60.0)})
+    engine.index("route", {"name": "route", "area": {
+        "type": "linestring",
+        "coordinates": [[2.3, 48.8], [-0.1, 51.5]]}})
+    engine.refresh()
+    return SearchService(engine, index_name="t")
+
+
+def ids(res):
+    return sorted(h["_id"] for h in res["hits"]["hits"])
+
+
+def test_geo_shape_query_relations(svc):
+    france_ish = sq(-5.0, 42.0, 8.0, 51.0)
+    res = svc.search({"query": {"geo_shape": {"area": {
+        "shape": france_ish, "relation": "intersects"}}}})
+    assert ids(res) == ["europe", "paris_zone", "route"]
+    res = svc.search({"query": {"geo_shape": {"area": {
+        "shape": france_ish, "relation": "within"}}}})
+    assert ids(res) == ["paris_zone"]
+    res = svc.search({"query": {"geo_shape": {"area": {
+        "shape": france_ish, "relation": "disjoint"}}}})
+    assert ids(res) == ["london_zone"]
+    # contains: which docs fully contain a small Paris box
+    res = svc.search({"query": {"geo_shape": {"area": {
+        "shape": sq(2.3, 48.8, 2.4, 48.9), "relation": "contains"}}}})
+    assert ids(res) == ["europe", "paris_zone"]
+
+
+def test_geo_shape_rejects_bad_doc():
+    m = MapperService({"properties": {"a": {"type": "geo_shape"}}})
+    with pytest.raises(MapperParsingError):
+        m.parse_document("x", {"a": {"type": "polygon",
+                                     "coordinates": [[[0, 0]]]}})
