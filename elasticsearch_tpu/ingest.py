@@ -554,6 +554,118 @@ def _p_inference(cfg: Dict[str, Any]) -> Processor:
     return run
 
 
+_UA_BROWSERS = [
+    # (name, regex with version groups) — order matters: specific first
+    # (modules/ingest-user-agent UserAgentParser's regexes, distilled to
+    # the dominant families)
+    ("Edge", r"Edg(?:e|A|iOS)?/(\d+)(?:\.(\d+))?"),
+    ("Opera", r"OPR/(\d+)(?:\.(\d+))?"),
+    ("Chrome", r"Chrome/(\d+)(?:\.(\d+))?"),
+    ("Firefox", r"Firefox/(\d+)(?:\.(\d+))?"),
+    ("Safari", r"Version/(\d+)(?:\.(\d+))?.*Safari"),
+    ("IE", r"MSIE (\d+)(?:\.(\d+))?|Trident/.*rv:(\d+)"),
+    ("curl", r"curl/(\d+)(?:\.(\d+))?"),
+]
+
+_UA_OS = [
+    ("Windows", r"Windows NT (\d+)(?:\.(\d+))?"),
+    ("iOS", r"iPhone OS (\d+)(?:[._](\d+))?"),
+    ("Mac OS X", r"Mac OS X (\d+)(?:[._](\d+))?"),
+    ("Android", r"Android (\d+)(?:\.(\d+))?"),
+    ("Linux", r"Linux"),
+]
+
+_UA_DEVICE = [("iPhone", r"iPhone"), ("iPad", r"iPad"),
+              ("Mobile", r"Mobile|Android"),
+              ("Spider", r"bot|crawler|spider")]
+
+
+def _p_user_agent(cfg):
+    """modules/ingest-user-agent UserAgentProcessor analog: parse a UA
+    string into name/version/os/device fields."""
+    import re as _re
+    field = _req(cfg, "user_agent", "field")
+    target = cfg.get("target_field", "user_agent")
+    ignore_missing = cfg.get("ignore_missing", False)
+
+    def run(doc):
+        ua = get_field(doc, field)
+        if ua is None:
+            if ignore_missing:
+                return doc
+            raise IngestProcessorError(f"field [{field}] not present")
+        ua = str(ua)
+        out: Dict[str, Any] = {"name": "Other", "original": ua}
+        for name, rx in _UA_BROWSERS:
+            m = _re.search(rx, ua)
+            if m:
+                out["name"] = name
+                groups = [g for g in m.groups() if g]
+                if groups:
+                    out["version"] = ".".join(groups[:2])
+                    out["major"] = groups[0]
+                break
+        for name, rx in _UA_OS:
+            m = _re.search(rx, ua)
+            if m:
+                os_out: Dict[str, Any] = {"name": name}
+                groups = [g for g in m.groups() if g]
+                if groups:
+                    os_out["version"] = ".".join(groups[:2])
+                    os_out["full"] = f"{name} {os_out['version']}"
+                out["os"] = os_out
+                break
+        for name, rx in _UA_DEVICE:
+            if _re.search(rx, ua, _re.IGNORECASE):
+                out["device"] = {"name": name}
+                break
+        else:
+            out["device"] = {"name": "Other"}
+        set_field(doc, target, out)
+        return doc
+    return run
+
+
+def _p_geoip(cfg):
+    """modules/ingest-geoip GeoIpProcessor analog. The reference reads
+    MaxMind .mmdb databases shipped with the plugin; this image carries
+    none, so lookups run against (a) a user-supplied CIDR table in the
+    processor config ("database": {"10.0.0.0/8": {...geo fields...}})
+    and (b) a tiny built-in table for well-known test ranges. Unmatched
+    addresses are a no-op like the reference's missing-database case."""
+    import ipaddress as _ip
+    field = _req(cfg, "geoip", "field")
+    target = cfg.get("target_field", "geoip")
+    ignore_missing = cfg.get("ignore_missing", False)
+    table = []
+    builtin = {
+        "127.0.0.0/8": {"country_iso_code": "XX",
+                        "country_name": "Loopback"},
+    }
+    for cidr, geo in {**builtin, **(cfg.get("database") or {})}.items():
+        table.append((_ip.ip_network(cidr), dict(geo)))
+    # longest prefix first so specific entries win
+    table.sort(key=lambda e: -e[0].prefixlen)
+
+    def run(doc):
+        raw = get_field(doc, field)
+        if raw is None:
+            if ignore_missing:
+                return doc
+            raise IngestProcessorError(f"field [{field}] not present")
+        try:
+            addr = _ip.ip_address(str(raw))
+        except ValueError:
+            raise IngestProcessorError(
+                f"[{raw}] is not a valid ip address")
+        for net, geo in table:
+            if addr in net:
+                set_field(doc, target, dict(geo))
+                break
+        return doc
+    return run
+
+
 PROCESSORS: Dict[str, Callable[[Dict[str, Any]], Processor]] = {
     "set": _p_set, "remove": _p_remove, "rename": _p_rename,
     "append": _p_append, "convert": _p_convert, "date": _p_date,
@@ -563,6 +675,7 @@ PROCESSORS: Dict[str, Callable[[Dict[str, Any]], Processor]] = {
     "lowercase": _p_lowercase, "uppercase": _p_uppercase,
     "html_strip": _p_html_strip, "bytes": _p_bytes,
     "dissect": _p_dissect, "grok": _p_grok, "inference": _p_inference,
+    "user_agent": _p_user_agent, "geoip": _p_geoip,
 }
 
 

@@ -468,8 +468,14 @@ class GeoShapeFieldMapper(FieldMapper):
 
     def parse(self, value: Any) -> ParsedField:
         from elasticsearch_tpu.search.geoshape import parse_shape
-        shape = parse_shape(value)          # validates or raises
-        min_lon, min_lat, max_lon, max_lat = shape.bbox()
+        try:
+            shape = parse_shape(value)      # validates or raises
+            min_lon, min_lat, max_lon, max_lat = shape.bbox()
+        except MapperParsingError:
+            raise
+        except (TypeError, ValueError, KeyError, IndexError) as e:
+            raise MapperParsingError(
+                f"failed to parse geo_shape [{self.name}]: {e}")
         return ParsedField(self.name, "geo",
                            geo=((min_lat + max_lat) / 2.0,
                                 (min_lon + max_lon) / 2.0))

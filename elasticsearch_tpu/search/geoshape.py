@@ -28,17 +28,24 @@ Ring = List[Point]
 
 class Shape:
     """Normalized geometry: a bag of points, segments, and polygon
-    exterior rings (closed, first point repeated)."""
+    exterior rings (closed, first point repeated). Derived views
+    (bbox/vertices/segments) memoize — relation tests walk the same
+    query shape once per candidate doc."""
 
-    __slots__ = ("points", "lines", "rings")
+    __slots__ = ("points", "lines", "rings", "_bbox", "_verts", "_segs")
 
     def __init__(self, points: List[Point], lines: List[List[Point]],
                  rings: List[Ring]):
         self.points = points
         self.lines = lines
         self.rings = rings
+        self._bbox: Optional[Tuple[float, float, float, float]] = None
+        self._verts: Optional[List[Point]] = None
+        self._segs: Optional[List[Tuple[Point, Point]]] = None
 
     def bbox(self) -> Tuple[float, float, float, float]:
+        if self._bbox is not None:
+            return self._bbox
         xs = [p[0] for p in self.points]
         ys = [p[1] for p in self.points]
         for line in self.lines:
@@ -49,23 +56,28 @@ class Shape:
             ys += [p[1] for p in ring]
         if not xs:
             raise IllegalArgumentError("empty geometry")
-        return min(xs), min(ys), max(xs), max(ys)
+        self._bbox = (min(xs), min(ys), max(xs), max(ys))
+        return self._bbox
 
     def vertices(self) -> List[Point]:
-        out = list(self.points)
-        for line in self.lines:
-            out.extend(line)
-        for ring in self.rings:
-            out.extend(ring[:-1])
-        return out
+        if self._verts is None:
+            out = list(self.points)
+            for line in self.lines:
+                out.extend(line)
+            for ring in self.rings:
+                out.extend(ring[:-1])
+            self._verts = out
+        return self._verts
 
     def segments(self) -> List[Tuple[Point, Point]]:
-        out: List[Tuple[Point, Point]] = []
-        for line in self.lines:
-            out.extend(zip(line, line[1:]))
-        for ring in self.rings:
-            out.extend(zip(ring, ring[1:]))
-        return out
+        if self._segs is None:
+            out: List[Tuple[Point, Point]] = []
+            for line in self.lines:
+                out.extend(zip(line, line[1:]))
+            for ring in self.rings:
+                out.extend(zip(ring, ring[1:]))
+            self._segs = out
+        return self._segs
 
 
 def parse_shape(spec: Any) -> Shape:
@@ -124,14 +136,18 @@ def parse_shape(spec: Any) -> Shape:
 
 def _point_in_ring(p: Point, ring: Ring) -> bool:
     x, y = p
+    # boundary points count as inside on EVERY edge (the bare ray cast is
+    # half-open, which made within(shape, itself) false and excluded
+    # geometry touching the max-y/max-x edges)
+    for a, b in zip(ring, ring[1:]):
+        if _orient(a, b, p) == 0 and _on_segment(a, b, p):
+            return True
     inside = False
     for (x1, y1), (x2, y2) in zip(ring, ring[1:]):
         if (y1 > y) != (y2 > y):
             xi = x1 + (y - y1) * (x2 - x1) / ((y2 - y1) or 1e-300)
             if x < xi:
                 inside = not inside
-            elif x == xi:
-                return True               # on the boundary counts as in
     return inside
 
 

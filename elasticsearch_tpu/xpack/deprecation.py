@@ -20,10 +20,6 @@ def _issue(level: str, message: str, details: str) -> Dict[str, Any]:
 
 # -- cluster-level checks ----------------------------------------------------
 
-def _check_monitoring_enabled_without_interval(state) -> Optional[Dict]:
-    return None
-
-
 def _check_awareness_without_attrs(state) -> Optional[Dict]:
     settings = state.metadata.persistent_settings
     attrs = settings.get("cluster.routing.allocation.awareness.attributes")

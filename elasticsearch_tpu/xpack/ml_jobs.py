@@ -51,14 +51,13 @@ class _Baseline:
         """Anomaly score 0..100 BEFORE updating with x."""
         if self.n < MIN_BUCKETS_TO_SCORE:
             return 0.0
-        # variance floor engages ONLY for degenerate (near-constant)
-        # baselines: a steady gauge must not score one-unit blips as
-        # z=1e6, but a genuinely learned tight variance (mean 1000,
-        # std 10) must keep its full sensitivity
-        if self.var < 1e-9:
-            std = math.sqrt(max((0.05 * abs(self.mean)) ** 2, 1e-9))
-        else:
-            std = math.sqrt(self.var)
+        # variance floor at 1% of the mean: near-constant gauges (var ~ 0
+        # or float jitter) must not score one-unit blips as z=1e6, while
+        # a learned std of >=1% of the mean keeps its full sensitivity
+        # (the autodetect process applies a comparable minimum variance
+        # scale). 1e-9 guards zero-mean count streams.
+        floor = max((0.01 * abs(self.mean)) ** 2, 1e-9)
+        std = math.sqrt(max(self.var, floor))
         z = (x - self.mean) / std if std > 0 else 0.0
         if sided == "high":
             z = max(z, 0.0)

@@ -218,3 +218,42 @@ def test_simulate():
         assert "error" in out["docs"][1]
     finally:
         c.stop()
+
+
+def test_user_agent_processor():
+    from elasticsearch_tpu.ingest import PROCESSORS
+    run = PROCESSORS["user_agent"]({"field": "ua"})
+    doc = {"_source": {"ua": "Mozilla/5.0 (Windows NT 10.0; Win64; x64) "
+                             "AppleWebKit/537.36 (KHTML, like Gecko) "
+                             "Chrome/120.0.0.0 Safari/537.36"}}
+    out = run(doc)["_source"]["user_agent"]
+    assert out["name"] == "Chrome"
+    assert out["major"] == "120"
+    assert out["os"]["name"] == "Windows"
+    assert out["os"]["version"] == "10.0"
+    run = PROCESSORS["user_agent"]({"field": "ua"})
+    doc = {"_source": {"ua": "Mozilla/5.0 (iPhone; CPU iPhone OS 17_1 "
+                             "like Mac OS X) AppleWebKit/605.1.15 "
+                             "(KHTML, like Gecko) Version/17.1 Mobile/15E148 "
+                             "Safari/604.1"}}
+    out = run(doc)["_source"]["user_agent"]
+    assert out["name"] == "Safari"
+    assert out["os"]["name"] == "iOS"
+    assert out["device"]["name"] == "iPhone"
+
+
+def test_geoip_processor():
+    from elasticsearch_tpu.ingest import PROCESSORS, IngestProcessorError
+    run = PROCESSORS["geoip"]({"field": "ip", "database": {
+        "203.0.113.0/24": {"country_iso_code": "AU",
+                           "city_name": "Sydney"}}})
+    doc = {"_source": {"ip": "203.0.113.7"}}
+    out = run(doc)["_source"]["geoip"]
+    assert out == {"country_iso_code": "AU", "city_name": "Sydney"}
+    # unmatched address: no-op
+    doc = {"_source": {"ip": "8.8.8.8"}}
+    assert "geoip" not in run(doc)["_source"]
+    # invalid address raises
+    import pytest as _pytest
+    with _pytest.raises(IngestProcessorError):
+        run({"_source": {"ip": "not-an-ip"}})
