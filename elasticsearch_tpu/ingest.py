@@ -575,9 +575,11 @@ _UA_OS = [
     ("Linux", r"Linux"),
 ]
 
-_UA_DEVICE = [("iPhone", r"iPhone"), ("iPad", r"iPad"),
-              ("Mobile", r"Mobile|Android"),
-              ("Spider", r"bot|crawler|spider")]
+# Spider FIRST: smartphone-crawler UAs carry both "Android/Mobile" and
+# "bot" markers and must classify as Spider (ingest-user-agent parity)
+_UA_DEVICE = [("Spider", r"bot|crawler|spider"),
+              ("iPhone", r"iPhone"), ("iPad", r"iPad"),
+              ("Mobile", r"Mobile|Android")]
 
 
 def _p_user_agent(cfg):
@@ -643,7 +645,13 @@ def _p_geoip(cfg):
                         "country_name": "Loopback"},
     }
     for cidr, geo in {**builtin, **(cfg.get("database") or {})}.items():
-        table.append((_ip.ip_network(cidr), dict(geo)))
+        try:
+            # strict=False tolerates host bits (203.0.113.7/24), an easy
+            # config mistake the reference's CIDR parsing also accepts
+            table.append((_ip.ip_network(cidr, strict=False), dict(geo)))
+        except ValueError as e:
+            raise IllegalArgumentError(
+                f"[geoip] invalid database CIDR [{cidr}]: {e}")
     # longest prefix first so specific entries win
     table.sort(key=lambda e: -e[0].prefixlen)
 

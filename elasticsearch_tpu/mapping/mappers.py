@@ -473,7 +473,9 @@ class GeoShapeFieldMapper(FieldMapper):
             min_lon, min_lat, max_lon, max_lat = shape.bbox()
         except MapperParsingError:
             raise
-        except (TypeError, ValueError, KeyError, IndexError) as e:
+        except (TypeError, ValueError, KeyError, IndexError,
+                IllegalArgumentError) as e:
+            # IllegalArgumentError covers empty geometries from bbox()
             raise MapperParsingError(
                 f"failed to parse geo_shape [{self.name}]: {e}")
         return ParsedField(self.name, "geo",

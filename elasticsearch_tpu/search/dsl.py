@@ -847,7 +847,19 @@ _PARSERS = {
     "wrapper": lambda spec: _parse_wrapper(spec),
     "geo_polygon": lambda spec: _parse_geo_polygon(spec),
     "geo_shape": lambda spec: _parse_geo_shape(spec),
+    # match_bool_prefix: every term matches normally, the last as a
+    # prefix (MatchBoolPrefixQueryBuilder) — the single-field form of
+    # multi_match type bool_prefix
+    "match_bool_prefix": lambda spec: _parse_match_bool_prefix(spec),
 }
+
+
+def _parse_match_bool_prefix(spec) -> MultiMatch:
+    fname, opts = _field_spec(spec, "query")
+    return MultiMatch(fields=[fname], text=str(opts.get("query", "")),
+                      type="bool_prefix",
+                      operator=str(opts.get("operator", "or")).lower(),
+                      boost=float(opts.get("boost", 1.0)))
 
 
 def _parse_geo_shape(spec) -> GeoShape:

@@ -459,8 +459,10 @@ def _h_multi_match(q: dsl.MultiMatch, ctx: SegmentContext) -> Result:
     for fname, boost in expanded.items():
         if q.type == "bool_prefix":
             # search-as-you-type: every term matches normally, the LAST
-            # term matches as a prefix (MultiMatchQueryBuilder
-            # Type.BOOL_PREFIX / MatchBoolPrefixQueryBuilder analog)
+            # term matches as a prefix, joined by the OPERATOR (default
+            # OR — any clause suffices; "and" requires all), per
+            # MultiMatchQueryBuilder Type.BOOL_PREFIX /
+            # MatchBoolPrefixQueryBuilder
             toks = ctx.search_analyzer(fname).terms(q.text)
             if not toks:
                 continue
@@ -468,10 +470,14 @@ def _h_multi_match(q: dsl.MultiMatch, ctx: SegmentContext) -> Result:
             clauses: List[dsl.Query] = []
             if head:
                 clauses.append(dsl.Match(field=fname, text=head,
-                                         operator="and"))
+                                         operator=q.operator))
             clauses.append(dsl.Prefix(field=fname, value=toks[-1]))
-            results.append(execute(
-                dsl.Bool(must=clauses, boost=boost), ctx))
+            if q.operator == "and":
+                node = dsl.Bool(must=clauses, boost=boost)
+            else:
+                node = dsl.Bool(should=clauses,
+                                minimum_should_match=1, boost=boost)
+            results.append(execute(node, ctx))
             continue
         results.append(execute(dsl.Match(field=fname, text=q.text,
                                          operator=q.operator, boost=boost), ctx))

@@ -51,13 +51,15 @@ class _Baseline:
         """Anomaly score 0..100 BEFORE updating with x."""
         if self.n < MIN_BUCKETS_TO_SCORE:
             return 0.0
-        # variance floor at 1% of the mean: near-constant gauges (var ~ 0
-        # or float jitter) must not score one-unit blips as z=1e6, while
-        # a learned std of >=1% of the mean keeps its full sensitivity
-        # (the autodetect process applies a comparable minimum variance
-        # scale). 1e-9 guards zero-mean count streams.
-        floor = max((0.01 * abs(self.mean)) ** 2, 1e-9)
-        std = math.sqrt(max(self.var, floor))
+        # variance floor = minimum detectable unit: 0.1% of the mean or
+        # 0.5 absolute, whichever is larger. Near-constant gauges stay
+        # quiet on sub-unit jitter and one-unit count blips score
+        # moderately (z=2), while a learned std down to 0.1% of the mean
+        # keeps its sensitivity (a tighter floor re-created the
+        # noise-on-big-gauge false-positive generator; a looser one
+        # suppressed genuine spikes on tight baselines).
+        floor_std = max(0.001 * abs(self.mean), 0.5)
+        std = math.sqrt(max(self.var, floor_std * floor_std))
         z = (x - self.mean) / std if std > 0 else 0.0
         if sided == "high":
             z = max(z, 0.0)

@@ -101,7 +101,15 @@ def test_search_as_you_type_bool_prefix(svc):
         "fields": ["title", "title._2gram", "title._3gram"]}}})
     got = [h["_id"] for h in res["hits"]["hits"]]
     assert got[0] == "d1"            # full shingle match ranks first
-    assert "d3" not in got           # 'brown quilt' lacks the quick prefix
+    # default operator is OR: d3 ("brown quilt") matches via the "bro"
+    # prefix alone, below d1
+    assert "d3" in got and got.index("d3") > 0
+    # operator=and requires the "quick" term too
+    res = svc.search({"query": {"multi_match": {
+        "query": "quick bro", "type": "bool_prefix",
+        "operator": "and", "fields": ["title"]}}})
+    got = [h["_id"] for h in res["hits"]["hits"]]
+    assert got and "d3" not in got
     # shingle subfield matches phrase-order pairs only
     res = svc.search({"query": {"match": {"title._2gram": "quick brown"}}})
     assert ids(res) == ["d1"]

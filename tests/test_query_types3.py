@@ -316,3 +316,14 @@ def test_geo_polygon(svc):
         {"lat": 40.0, "lon": -8.0},
         {"lat": 50.0, "lon": 15.0}]}}}})
     assert ids(res) == ["d1", "d2", "d4"]
+
+
+def test_match_bool_prefix(svc):
+    # single-field type-ahead form of multi_match bool_prefix
+    res = svc.search({"query": {"match_bool_prefix": {
+        "body": "quick bro"}}})
+    got = sorted(h["_id"] for h in res["hits"]["hits"])
+    assert "d1" in got                   # "quick brown fox..."
+    res = svc.search({"query": {"match_bool_prefix": {
+        "body": {"query": "sphinx qua"}}}})
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["d2"]
