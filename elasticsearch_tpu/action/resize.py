@@ -1,0 +1,156 @@
+"""Resize APIs: shrink, split, and clone an index.
+
+Reference: action/admin/indices/shrink (TransportResizeAction,
+MetadataCreateIndexService resize path, ResizeAllocationDecider): the
+target index is created with the new shard count and recovers from the
+source's segments via hard links. Here segments are immutable device
+arrays, not files — the target is created with the new shard count and
+every live doc streams from a source snapshot through the ordinary bulk
+path, re-routed by murmur3 onto the new shard space (same documents,
+ids, and sources; the hard-link optimization is a documented
+divergence). The reference's preconditions hold: the source must be
+write-blocked, and split/shrink factors must divide evenly.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Optional
+
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
+logger = logging.getLogger(__name__)
+
+SCAN_BATCH = 500
+
+
+class ResizeActions:
+    def __init__(self, node) -> None:
+        self.node = node
+
+    def resize(self, kind: str, source: str, target: str,
+               body: Optional[Dict[str, Any]], on_done: Callable) -> None:
+        state = self.node._applied_state()
+        try:
+            src_meta = state.metadata.index(source)
+        except Exception as e:  # noqa: BLE001 — unknown source: 404
+            on_done(None, e)
+            return
+        if not src_meta.settings.get("index.blocks.write"):
+            on_done(None, IllegalArgumentError(
+                f"index [{source}] must be write-blocked before "
+                f"{kind} (set index.blocks.write=true)"))
+            return
+        body = body or {}
+        settings = dict(body.get("settings") or {})
+        n_src = src_meta.number_of_shards
+        n_target = int(settings.pop("index.number_of_shards",
+                                    settings.pop("number_of_shards",
+                                                 0)) or 0)
+        if kind == "clone":
+            n_target = n_target or n_src
+            if n_target != n_src:
+                on_done(None, IllegalArgumentError(
+                    "clone must keep the source's shard count"))
+                return
+        elif kind == "shrink":
+            n_target = n_target or 1
+            if n_src % n_target != 0 or n_target > n_src:
+                on_done(None, IllegalArgumentError(
+                    f"shrink target shards [{n_target}] must evenly "
+                    f"divide source shards [{n_src}]"))
+                return
+        elif kind == "split":
+            if not n_target:
+                on_done(None, IllegalArgumentError(
+                    "split requires [index.number_of_shards]"))
+                return
+            if n_target % n_src != 0 or n_target < n_src:
+                on_done(None, IllegalArgumentError(
+                    f"split target shards [{n_target}] must be an even "
+                    f"multiple of source shards [{n_src}]"))
+                return
+        else:
+            on_done(None, IllegalArgumentError(
+                f"unknown resize kind [{kind}]"))
+            return
+
+        replicas = settings.pop(
+            "index.number_of_replicas",
+            settings.pop("number_of_replicas",
+                         body.get("number_of_replicas", 0)))
+        create_settings = {
+            **{k: v for k, v in dict(src_meta.settings).items()
+               if not k.startswith("index.blocks")
+               and k not in ("number_of_shards", "number_of_replicas")},
+            **settings,
+            "number_of_shards": n_target,
+            "number_of_replicas": int(replicas),
+            "index.resize.source_name": source,
+        }
+
+        def created(_resp, err):
+            if err is not None:
+                on_done(None, err)
+                return
+            self._copy_shard(source, target, src_meta, 0, None, 0,
+                             on_done)
+        self.node.client.create_index(target, {
+            "settings": create_settings,
+            "mappings": dict(src_meta.mappings)}, created)
+
+    def _copy_shard(self, source: str, target: str, src_meta,
+                    sid: int, cursor_state, copied: int,
+                    on_done: Callable) -> None:
+        """Stream one source shard's live docs into the target through
+        the shared scan pager + bulk, preserving custom routing. A bulk
+        failure fails the whole resize — a one-shot copy must never
+        report success over silently lost documents."""
+        from elasticsearch_tpu.action.scan_copy import stream_shard
+        if sid >= src_meta.number_of_shards:
+            on_done({"acknowledged": True, "shards_acknowledged": True,
+                     "index": target, "copied_docs": copied}, None)
+            return
+        state = self.node._applied_state()
+        try:
+            sr = state.routing_table.index(source).primary(sid)
+        except Exception as e:  # noqa: BLE001
+            on_done(None, e)
+            return
+        if not sr.active or sr.node_id is None:
+            on_done(None, IllegalArgumentError(
+                f"source shard [{source}][{sid}] has no active primary"))
+            return
+        counter = {"n": copied}
+
+        def on_page(docs, proceed):
+            items = [{"action": "index", "index": target,
+                      "id": d["id"], "source": d["source"],
+                      "routing": d.get("routing")}
+                     for d in docs]
+
+            def bulked(bulk_resp=None):
+                if bulk_resp is not None and bulk_resp.get("errors"):
+                    failed = [i for i in bulk_resp.get("items", [])
+                              if "error" in next(iter(i.values()))]
+                    on_done(None, IllegalArgumentError(
+                        f"resize copy into [{target}] failed for "
+                        f"{len(failed)} documents: "
+                        f"{failed[:1]}"))
+                    return
+                counter["n"] += len(items)
+                proceed()
+            if items:
+                self.node.bulk_action.execute(items, bulked)
+            else:
+                proceed()
+
+        stream_shard(
+            self.node, source, sid, sr.node_id, SCAN_BATCH,
+            on_page,
+            on_done=lambda: self._copy_shard(
+                source, target, src_meta, sid + 1, None, counter["n"],
+                on_done),
+            on_error=lambda err: on_done(None, err or
+                                         IllegalArgumentError(
+                                             "resize scan failed")))

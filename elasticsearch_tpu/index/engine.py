@@ -353,7 +353,10 @@ class InternalEngine:
                     if mask[d]:
                         ops.append({
                             "op_type": "index", "doc_id": doc_id,
-                            "source": seg.sources[d], "routing": None,
+                            "source": seg.sources[d],
+                            "routing": (seg.routings[d]
+                                        if d < len(seg.routings)
+                                        else None),
                             "seqno": int(seg.seqnos[d]),
                             "version": int(seg.versions[d]),
                             "primary_term": int(seg.primary_terms[d]),
@@ -361,7 +364,8 @@ class InternalEngine:
             for doc_id in self._buffer_order:
                 parsed, seqno, version, term = self._buffer[doc_id]
                 ops.append({"op_type": "index", "doc_id": doc_id,
-                            "source": parsed.source, "routing": None,
+                            "source": parsed.source,
+                            "routing": parsed.routing,
                             "seqno": seqno, "version": version,
                             "primary_term": term})
             ops.sort(key=lambda op: op["seqno"])

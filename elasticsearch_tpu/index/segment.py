@@ -227,6 +227,9 @@ class Segment:
         self.geo: Dict[str, np.ndarray] = {}          # float64 [n_docs, 2] (lat, lon), NaN missing
         self.sources: List[Optional[Dict[str, Any]]] = []
         self.ids: List[str] = []
+        # per-doc custom routing key (None = routed by id); must survive
+        # segment rebuilds so CCR/resize re-route correctly
+        self.routings: List[Optional[str]] = []
         self.id_to_doc: Dict[str, int] = {}
         self.seqnos: np.ndarray = np.empty(0, np.int64)   # seqno per doc
         self.versions: np.ndarray = np.empty(0, np.int64) # _version per doc
@@ -306,6 +309,7 @@ class SegmentBuilder:
         seg = Segment(self.name, n)
         seg.sources = [d.source for d in self.docs]
         seg.ids = [d.doc_id for d in self.docs]
+        seg.routings = [d.routing for d in self.docs]
         seg.seqnos = np.asarray(self.seqnos, np.int64)
         seg.versions = np.asarray(self.versions, np.int64)
         seg.primary_terms = np.asarray(self.primary_terms, np.int64)
@@ -651,6 +655,7 @@ def merge_segments(name: str, segments: Sequence[Segment],
     out.live = np.ones(total, bool)
 
     ids: List[str] = [""] * total
+    routings: List[Optional[str]] = [None] * total
     sources: List[Optional[Dict[str, Any]]] = [None] * total
     seqnos = np.zeros(total, np.int64)
     versions = np.ones(total, np.int64)
@@ -659,11 +664,14 @@ def merge_segments(name: str, segments: Sequence[Segment],
         for old, new in enumerate(m):
             if new >= 0:
                 ids[new] = seg.ids[old]
+                routings[new] = (seg.routings[old]
+                                 if old < len(seg.routings) else None)
                 sources[new] = seg.sources[old]
                 seqnos[new] = seg.seqnos[old] if len(seg.seqnos) > old else 0
                 versions[new] = seg.versions[old] if len(seg.versions) > old else 1
                 primary_terms[new] = seg.primary_terms[old] if len(seg.primary_terms) > old else 1
     out.ids = ids
+    out.routings = routings
     out.sources = sources
     out.seqnos = seqnos
     out.versions = versions

@@ -61,6 +61,7 @@ class Store:
     def _segment_from(meta: Dict[str, Any], data) -> Segment:
         seg = Segment(meta["name"], meta["n_docs"])
         seg.ids = meta["ids"]
+        seg.routings = meta.get("routings") or [None] * seg.n_docs
         seg.sources = meta["sources"]
         seg.id_to_doc = {doc_id: i for i, doc_id in enumerate(seg.ids)}
         seg.live = data["live"]
@@ -198,6 +199,7 @@ def segment_payload(seg: Segment):
     meta: Dict[str, Any] = {
         "name": seg.name, "n_docs": seg.n_docs,
         "ids": seg.ids, "sources": seg.sources,
+        "routings": seg.routings,
         "fields": {"postings": {}, "keywords": {}, "doc_values": {},
                    "vectors": {}, "features": {}, "geo": []},
     }

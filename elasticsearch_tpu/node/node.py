@@ -205,6 +205,9 @@ class Node:
         from elasticsearch_tpu.xpack.autoscaling import AutoscalingService
         self.autoscaling = AutoscalingService(self)
 
+        from elasticsearch_tpu.action.resize import ResizeActions
+        self.resize_actions = ResizeActions(self)
+
         # per-node stats endpoint (TransportNodesStatsAction node-level
         # handler): the coordinating node fans `_nodes/stats` out here
         self.transport_service.register_handler(

@@ -602,6 +602,21 @@ def build_controller(client: NodeClient) -> RestController:
     r("POST", "/{index}/_graph/explore", graph_explore)
     r("GET", "/{index}/_graph/explore", graph_explore)
 
+    # -- resize family (action/admin/indices/shrink) ----------------------
+
+    def _resize(kind):
+        def handler(req: RestRequest, done: DoneFn) -> None:
+            client.node.resize_actions.resize(
+                kind, req.params["index"], req.params["target"],
+                req.body or {}, wrap_client_cb(done))
+        return handler
+    r("PUT", "/{index}/_shrink/{target}", _resize("shrink"))
+    r("POST", "/{index}/_shrink/{target}", _resize("shrink"))
+    r("PUT", "/{index}/_split/{target}", _resize("split"))
+    r("POST", "/{index}/_split/{target}", _resize("split"))
+    r("PUT", "/{index}/_clone/{target}", _resize("clone"))
+    r("POST", "/{index}/_clone/{target}", _resize("clone"))
+
     # -- deprecation info (x-pack/plugin/deprecation) ---------------------
 
     def migration_deprecations(req: RestRequest, done: DoneFn) -> None:

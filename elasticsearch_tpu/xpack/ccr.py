@@ -119,7 +119,10 @@ class CcrShardActions:
                     cursor = [si, d - 1]
                     break
                 docs.append({"id": seg.ids[d],
-                             "source": seg.sources[d] or {}})
+                             "source": seg.sources[d] or {},
+                             "routing": (seg.routings[d]
+                                         if d < len(seg.routings)
+                                         else None)})
             if cursor is not None:
                 break
         if cursor is None and docs and len(docs) >= batch:
@@ -356,39 +359,31 @@ class CcrService:
         if node_id is None:
             st["bootstrapping"] = False
             return
-        cursor = cursor_state.get("cursor")
+        from elasticsearch_tpu.action.scan_copy import stream_shard
 
-        def on_page(resp, err):
-            if err is not None or resp is None or resp.get("expired"):
-                st["bootstrapping"] = False
-                logger.warning("ccr bootstrap [%s] scan failed: %s",
-                               follower,
-                               "scan context expired" if resp else err)
-                return
-            docs = resp.get("docs", [])
+        def on_page(docs, proceed) -> None:
+            if not self._following(follower):
+                return   # unfollowed mid-bootstrap: stop quietly
             items = [{"action": "index", "index": follower,
-                      "id": d["id"], "source": d["source"]}
+                      "id": d["id"], "source": d["source"],
+                      "routing": d.get("routing")}
                      for d in docs]
-
-            def advance(_bulk=None) -> None:
-                nxt = resp.get("cursor")
-                if nxt is None:
-                    self._scan_shards(follower, leader, n_shards,
-                                      sid + 1, {}, maxes)
-                else:
-                    self._scan_shards(
-                        follower, leader, n_shards, sid,
-                        {"cursor": nxt, "scan_id": resp.get("scan_id")},
-                        maxes)
             if items:
-                self.node.bulk_action.execute(items, advance)
+                self.node.bulk_action.execute(items,
+                                              lambda _r=None: proceed())
             else:
-                advance()
-        self.node.transport_service.send_request(
-            node_id, CCR_SCAN,
-            {"index": leader, "shard": sid, "cursor": cursor,
-             "scan_id": cursor_state.get("scan_id"),
-             "batch": SCAN_BATCH}, on_page, timeout=60.0)
+                proceed()
+
+        def on_error(err) -> None:
+            st["bootstrapping"] = False
+            logger.warning("ccr bootstrap [%s] scan failed: %s",
+                           follower, err)
+
+        stream_shard(
+            self.node, leader, sid, node_id, SCAN_BATCH, on_page,
+            on_done=lambda: self._scan_shards(
+                follower, leader, n_shards, sid + 1, {}, maxes),
+            on_error=on_error)
 
     # -- incremental polls -------------------------------------------------
 

@@ -51,14 +51,15 @@ class _Baseline:
         """Anomaly score 0..100 BEFORE updating with x."""
         if self.n < MIN_BUCKETS_TO_SCORE:
             return 0.0
-        # variance floor = minimum detectable unit: 0.1% of the mean or
-        # 0.5 absolute, whichever is larger. Near-constant gauges stay
-        # quiet on sub-unit jitter and one-unit count blips score
-        # moderately (z=2), while a learned std down to 0.1% of the mean
-        # keeps its sensitivity (a tighter floor re-created the
-        # noise-on-big-gauge false-positive generator; a looser one
-        # suppressed genuine spikes on tight baselines).
-        floor_std = max(0.001 * abs(self.mean), 0.5)
+        # variance floor: 0.1% of the mean, RELATIVE only. This keeps
+        # float jitter on large gauges quiet (jitter scales with the
+        # mean) while sub-unit-scale metrics (rates in 0..1) keep full
+        # sensitivity — an absolute floor blinded them entirely. A
+        # perfectly constant stream that suddenly steps DOES score
+        # maximally; that is deliberate: deviation from a zero-variance
+        # baseline is the strongest possible anomaly signal (the
+        # reference's autodetect flags it the same way).
+        floor_std = max(0.001 * abs(self.mean), 1e-9)
         std = math.sqrt(max(self.var, floor_std * floor_std))
         z = (x - self.mean) / std if std > 0 else 0.0
         if sided == "high":

@@ -1,0 +1,110 @@
+"""Shrink / split / clone resize APIs.
+
+Reference: action/admin/indices/shrink (TransportResizeAction,
+ResizeAllocationDecider preconditions).
+"""
+
+import pytest
+
+from elasticsearch_tpu.rest.controller import RestRequest
+from elasticsearch_tpu.rest.routes import build_controller
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=2, seed=43)
+    c.start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def rest(cluster):
+    controller = build_controller(cluster.client())
+
+    def do(method, path, body=None, query=None):
+        req = RestRequest(method=method, path=path,
+                          query=dict(query or {}), body=body, raw_body=b"")
+        out = []
+        controller.dispatch(req, lambda s, b: out.append((s, b)))
+        cluster.run_until(lambda: bool(out), 180.0)
+        return out[0]
+    return do
+
+
+def _seed(cluster, rest, shards=4, n=12):
+    s, _ = rest("PUT", "/src", {"settings": {
+        "number_of_shards": shards, "number_of_replicas": 0},
+        "mappings": {"properties": {"v": {"type": "integer"}}}})
+    assert s == 200
+    cluster.ensure_green("src")
+    for i in range(n):
+        s, _ = rest("PUT", f"/src/_doc/d{i}", {"v": i})
+        assert s in (200, 201)
+    rest("POST", "/src/_refresh")
+
+
+def _block(rest):
+    s, _ = rest("PUT", "/src/_settings",
+                {"index.blocks.write": True})
+    assert s == 200
+
+
+def _total(cluster, rest, index):
+    cluster.ensure_yellow(index)
+    rest("POST", f"/{index}/_refresh")
+    s, body = rest("POST", f"/{index}/_search", {
+        "query": {"match_all": {}}, "size": 50})
+    assert s == 200
+    return sorted(h["_id"] for h in body["hits"]["hits"])
+
+
+def test_shrink_requires_write_block(cluster, rest):
+    _seed(cluster, rest)
+    s, body = rest("POST", "/src/_shrink/small", {
+        "settings": {"index.number_of_shards": 2}})
+    assert s == 400
+    assert "write-blocked" in body["error"]["reason"]
+
+
+def test_shrink_split_clone_preserve_docs(cluster, rest):
+    _seed(cluster, rest, shards=4, n=12)
+    _block(rest)
+    all_ids = [f"d{i}" for i in range(12)]
+
+    s, body = rest("POST", "/src/_shrink/small", {
+        "settings": {"index.number_of_shards": 2}})
+    assert s == 200 and body["copied_docs"] == 12
+    assert _total(cluster, rest, "small") == sorted(all_ids)
+    state = cluster.master()._applied_state()
+    assert state.metadata.index("small").number_of_shards == 2
+    # target is writable (blocks not inherited)
+    s, _ = rest("PUT", "/small/_doc/extra", {"v": 99})
+    assert s in (200, 201)
+
+    s, body = rest("POST", "/src/_split/wide", {
+        "settings": {"index.number_of_shards": 8}})
+    assert s == 200
+    assert _total(cluster, rest, "wide") == sorted(all_ids)
+    assert state.metadata.has_index("src")   # source untouched
+
+    s, body = rest("POST", "/src/_clone/copy", {})
+    assert s == 200
+    assert _total(cluster, rest, "copy") == sorted(all_ids)
+    state = cluster.master()._applied_state()
+    assert state.metadata.index("copy").number_of_shards == 4
+
+
+def test_resize_factor_validation(cluster, rest):
+    _seed(cluster, rest, shards=4, n=2)
+    _block(rest)
+    s, body = rest("POST", "/src/_shrink/bad", {
+        "settings": {"index.number_of_shards": 3}})
+    assert s == 400 and "evenly divide" in body["error"]["reason"]
+    s, body = rest("POST", "/src/_split/bad", {
+        "settings": {"index.number_of_shards": 6}})
+    assert s == 400 and "even multiple" in body["error"]["reason"]
+    s, body = rest("POST", "/src/_clone/bad", {
+        "settings": {"index.number_of_shards": 2}})
+    assert s == 400
