@@ -148,12 +148,16 @@ class MasterActions:
                     return state
                 raise IllegalArgumentError(
                     f"index [{name}] already exists")
-            return self._create_into(state, name, req_settings, req_mappings)
+            return self._create_into(state, name, req_settings,
+                                     req_mappings,
+                                     ignore_templates=req.get(
+                                         "ignore_templates", False))
         return self._submit(f"create-index [{name}]", update)
 
     def _create_into(self, state: ClusterState, name: str,
                      req_settings: Dict[str, Any],
-                     req_mappings: Dict[str, Any]) -> ClusterState:
+                     req_mappings: Dict[str, Any],
+                     ignore_templates: bool = False) -> ClusterState:
         """Create ``name`` in ``state`` with matching composable templates
         applied — lowest priority first, the explicit request winning
         (MetadataCreateIndexService.applyCreateIndexRequestWithV2Template).
@@ -165,8 +169,11 @@ class MasterActions:
         # (findV2Template: composable templates are winner-takes-all, so
         # two individually-valid templates can never produce an unmergeable
         # combined mapping that wedges creation)
-        layers = [t.get("template") or {}
-                  for _n, t in state.metadata.matching_templates(name)[:1]]
+        # resize targets must be EXACT copies: templates bypassed
+        # (MetadataCreateIndexService resize path sets no templates)
+        layers = [] if ignore_templates else [
+            t.get("template") or {}
+            for _n, t in state.metadata.matching_templates(name)[:1]]
         for tmpl in layers:
             settings.update(tmpl.get("settings") or {})
             a = tmpl.get("aliases") or {}

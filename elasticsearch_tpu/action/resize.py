@@ -75,14 +75,20 @@ class ResizeActions:
                 f"unknown resize kind [{kind}]"))
             return
 
+        # replicas: request (either spelling) > source's count — the
+        # target must not silently drop redundancy
         replicas = settings.pop(
             "index.number_of_replicas",
             settings.pop("number_of_replicas",
-                         body.get("number_of_replicas", 0)))
+                         body.get("number_of_replicas",
+                                  src_meta.number_of_replicas)))
         create_settings = {
             **{k: v for k, v in dict(src_meta.settings).items()
                if not k.startswith("index.blocks")
-               and k not in ("number_of_shards", "number_of_replicas")},
+               # the target is NEW: it must get its own creation date or
+               # age-based ILM/rollover fires immediately
+               and k not in ("number_of_shards", "number_of_replicas",
+                             "index.creation_date")},
             **settings,
             "number_of_shards": n_target,
             "number_of_replicas": int(replicas),
@@ -93,32 +99,40 @@ class ResizeActions:
             if err is not None:
                 on_done(None, err)
                 return
-            self._copy_shard(source, target, src_meta, 0, None, 0,
-                             on_done)
+            self._copy_shard(source, target, src_meta, 0, 0, on_done)
+        # templates bypassed: the target must be an EXACT copy of the
+        # source's mappings (the reference's resize sets no templates)
         self.node.client.create_index(target, {
             "settings": create_settings,
-            "mappings": dict(src_meta.mappings)}, created)
+            "mappings": dict(src_meta.mappings)}, created,
+            ignore_templates=True)
 
     def _copy_shard(self, source: str, target: str, src_meta,
-                    sid: int, cursor_state, copied: int,
-                    on_done: Callable) -> None:
+                    sid: int, copied: int, on_done: Callable) -> None:
         """Stream one source shard's live docs into the target through
         the shared scan pager + bulk, preserving custom routing. A bulk
-        failure fails the whole resize — a one-shot copy must never
-        report success over silently lost documents."""
+        failure (including a backpressure rejection) fails the resize
+        AND deletes the partial target so the operation is retryable —
+        a one-shot copy must never report success over lost documents
+        nor leave a half-index squatting on the target name."""
         from elasticsearch_tpu.action.scan_copy import stream_shard
         if sid >= src_meta.number_of_shards:
             on_done({"acknowledged": True, "shards_acknowledged": True,
                      "index": target, "copied_docs": copied}, None)
             return
         state = self.node._applied_state()
+
+        def fail(err: Any) -> None:
+            self.node.client.delete_index(
+                target, lambda _r, _e=None: on_done(None, err))
+
         try:
             sr = state.routing_table.index(source).primary(sid)
         except Exception as e:  # noqa: BLE001
-            on_done(None, e)
+            fail(e)
             return
         if not sr.active or sr.node_id is None:
-            on_done(None, IllegalArgumentError(
+            fail(IllegalArgumentError(
                 f"source shard [{source}][{sid}] has no active primary"))
             return
         counter = {"n": copied}
@@ -131,12 +145,15 @@ class ResizeActions:
 
             def bulked(bulk_resp=None):
                 if bulk_resp is not None and bulk_resp.get("errors"):
-                    failed = [i for i in bulk_resp.get("items", [])
-                              if "error" in next(iter(i.values()))]
-                    on_done(None, IllegalArgumentError(
-                        f"resize copy into [{target}] failed for "
-                        f"{len(failed)} documents: "
-                        f"{failed[:1]}"))
+                    if bulk_resp.get("rejected"):
+                        reason = "indexing backpressure (429); retry"
+                    else:
+                        failed = [i for i in bulk_resp.get("items", [])
+                                  if "error" in next(iter(i.values()))]
+                        reason = (f"{len(failed)} documents failed: "
+                                  f"{failed[:1]}")
+                    fail(IllegalArgumentError(
+                        f"resize copy into [{target}] failed — {reason}"))
                     return
                 counter["n"] += len(items)
                 proceed()
@@ -149,8 +166,7 @@ class ResizeActions:
             self.node, source, sid, sr.node_id, SCAN_BATCH,
             on_page,
             on_done=lambda: self._copy_shard(
-                source, target, src_meta, sid + 1, None, counter["n"],
+                source, target, src_meta, sid + 1, counter["n"],
                 on_done),
-            on_error=lambda err: on_done(None, err or
-                                         IllegalArgumentError(
-                                             "resize scan failed")))
+            on_error=lambda err: fail(err or IllegalArgumentError(
+                "resize scan failed")))

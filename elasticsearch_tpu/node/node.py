@@ -320,12 +320,13 @@ class NodeClient:
     # -- index admin ----------------------------------------------------
 
     def create_index(self, name: str, body: Optional[Dict[str, Any]],
-                     on_done) -> None:
+                     on_done, ignore_templates: bool = False) -> None:
         body = body or {}
         self.node.master_client.execute(CREATE_INDEX, {
             "index": name,
             "settings": body.get("settings") or {},
             "mappings": body.get("mappings") or {},
+            "ignore_templates": ignore_templates,
         }, on_done)
 
     def delete_index(self, name: str, on_done) -> None:

@@ -602,6 +602,67 @@ def build_controller(client: NodeClient) -> RestController:
     r("POST", "/{index}/_graph/explore", graph_explore)
     r("GET", "/{index}/_graph/explore", graph_explore)
 
+    def validate_query(req: RestRequest, done: DoneFn) -> None:
+        """_validate/query (ValidateQueryAction analog): parse without
+        executing; ?explain adds the parsed representation."""
+        from elasticsearch_tpu.search import dsl as _dsl
+        body = req.body or {}
+        index = req.params.get("index", "_all")
+        try:
+            parsed = _dsl.parse_query(body.get("query"))
+            out: Dict[str, Any] = {"valid": True,
+                                   "_shards": {"total": 1,
+                                               "successful": 1,
+                                               "failed": 0}}
+            if req.flag("explain"):
+                out["explanations"] = [{
+                    "index": index, "valid": True,
+                    "explanation": repr(parsed)}]
+            done(200, out)
+        except Exception as e:  # noqa: BLE001 — invalid is a RESULT
+            out = {"valid": False,
+                   "_shards": {"total": 1, "successful": 1, "failed": 0}}
+            if req.flag("explain"):
+                out["error"] = str(e)
+            done(200, out)
+    r("GET", "/_validate/query", validate_query)
+    r("POST", "/_validate/query", validate_query)
+    r("GET", "/{index}/_validate/query", validate_query)
+    r("POST", "/{index}/_validate/query", validate_query)
+
+    def search_shards(req: RestRequest, done: DoneFn) -> None:
+        """_search_shards (ClusterSearchShardsAction analog): which shard
+        copies a search would fan out to."""
+        from elasticsearch_tpu.cluster.metadata import (
+            resolve_index_expression,
+        )
+        state = client.node._applied_state()
+        try:
+            names = resolve_index_expression(
+                req.params.get("index", "_all"), state.metadata)
+        except Exception as e:  # noqa: BLE001 — unknown index: 404
+            done(404, {"error": {"type": "index_not_found_exception",
+                                 "reason": str(e)}})
+            return
+        shards = []
+        for name in names:
+            if not state.routing_table.has_index(name):
+                continue
+            irt = state.routing_table.index(name)
+            for sid in sorted(irt.shards):
+                group = [sr.to_dict() for sr in irt.shard_group(sid)
+                         if sr.assigned]
+                if group:
+                    shards.append(group)
+        done(200, {"nodes": {nid: {"name": n.name or nid}
+                             for nid, n in state.nodes.items()},
+                   "indices": {name: {} for name in names},
+                   "shards": shards})
+    r("GET", "/_search_shards", search_shards)
+    r("POST", "/_search_shards", search_shards)
+    r("GET", "/{index}/_search_shards", search_shards)
+    r("POST", "/{index}/_search_shards", search_shards)
+
     # -- resize family (action/admin/indices/shrink) ----------------------
 
     def _resize(kind):

@@ -96,6 +96,26 @@ def test_shrink_split_clone_preserve_docs(cluster, rest):
     assert state.metadata.index("copy").number_of_shards == 4
 
 
+def test_clone_inherits_replicas_and_fresh_creation_date(cluster, rest):
+    s, _ = rest("PUT", "/src2", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 1}})
+    assert s == 200
+    cluster.ensure_green("src2")
+    rest("PUT", "/src2/_doc/a", {"v": 1})
+    rest("POST", "/src2/_refresh")
+    rest("PUT", "/src2/_settings", {"index.blocks.write": True})
+    s, _ = rest("POST", "/src2/_clone/copy2", {})
+    assert s == 200
+    state = cluster.master()._applied_state()
+    meta = state.metadata.index("copy2")
+    # redundancy inherited, identity fresh
+    assert meta.number_of_replicas == 1
+    src_meta = state.metadata.index("src2")
+    assert meta.settings.get("index.creation_date") != \
+        src_meta.settings.get("index.creation_date") or \
+        src_meta.settings.get("index.creation_date") is None
+
+
 def test_resize_factor_validation(cluster, rest):
     _seed(cluster, rest, shards=4, n=2)
     _block(rest)
