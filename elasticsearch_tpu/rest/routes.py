@@ -608,6 +608,17 @@ def build_controller(client: NodeClient) -> RestController:
         from elasticsearch_tpu.search import dsl as _dsl
         body = req.body or {}
         index = req.params.get("index", "_all")
+        # an unknown index is a 404, not a vacuous "valid"
+        from elasticsearch_tpu.cluster.metadata import (
+            resolve_index_expression,
+        )
+        try:
+            resolve_index_expression(
+                index, client.node._applied_state().metadata)
+        except Exception as e:  # noqa: BLE001
+            done(404, {"error": {"type": "index_not_found_exception",
+                                 "reason": str(e)}})
+            return
         try:
             parsed = _dsl.parse_query(body.get("query"))
             out: Dict[str, Any] = {"valid": True,
@@ -650,8 +661,10 @@ def build_controller(client: NodeClient) -> RestController:
                 continue
             irt = state.routing_table.index(name)
             for sid in sorted(irt.shards):
+                # ACTIVE copies only — the coordinator never fans out to
+                # an INITIALIZING copy, so neither should this preview
                 group = [sr.to_dict() for sr in irt.shard_group(sid)
-                         if sr.assigned]
+                         if sr.active]
                 if group:
                     shards.append(group)
         done(200, {"nodes": {nid: {"name": n.name or nid}
@@ -1025,7 +1038,27 @@ def build_controller(client: NodeClient) -> RestController:
     # -- cluster ----------------------------------------------------------
 
     def health(req: RestRequest, done: DoneFn) -> None:
-        done(200, client.cluster_health(req.params.get("index")))
+        """?wait_for_status=yellow|green polls until the status is at
+        least that good or the timeout lapses, reporting timed_out like
+        the reference (ClusterHealthRequest.waitForStatus)."""
+        index = req.params.get("index")
+        want = req.query.get("wait_for_status")
+        if want not in ("yellow", "green"):
+            done(200, client.cluster_health(index))
+            return
+        rank = {"red": 0, "yellow": 1, "green": 2}
+        deadline = client.node.scheduler.now() + float(
+            str(req.query.get("timeout", "30")).rstrip("s") or 30)
+
+        def poll() -> None:
+            h = client.cluster_health(index)
+            if rank.get(h["status"], 0) >= rank[want]:
+                done(200, {**h, "timed_out": False})
+            elif client.node.scheduler.now() >= deadline:
+                done(200, {**h, "timed_out": True})
+            else:
+                client.node.scheduler.schedule(0.1, poll)
+        poll()
     r("GET", "/_cluster/health", health)
     r("GET", "/_cluster/health/{index}", health)
 
@@ -1033,6 +1066,56 @@ def build_controller(client: NodeClient) -> RestController:
         from elasticsearch_tpu.xpack.security import redact_state
         done(200, redact_state(client.cluster_state()))
     r("GET", "/_cluster/state", cluster_state)
+
+    def cluster_stats(req: RestRequest, done: DoneFn) -> None:
+        """_cluster/stats (ClusterStatsAction analog): cluster-wide
+        index/shard/doc totals + node membership summary."""
+        state = client.node._applied_state()
+        n_indices = len(state.metadata.indices)
+        all_shards = list(state.routing_table.all_shards())
+        primaries = sum(1 for sr in all_shards if sr.primary and sr.active)
+        total_active = sum(1 for sr in all_shards if sr.active)
+        role_counts: Dict[str, int] = {}
+        for n in state.nodes.values():
+            for role in n.roles:
+                role_counts[role] = role_counts.get(role, 0) + 1
+
+        def with_docs(resp, _err=None):
+            docs = 0
+            for payload in (resp or {}).get("payloads", []):
+                if payload.get("primary"):
+                    docs += int(payload.get("docs", 0))
+            shard_stats = (resp or {}).get("_shards", {})
+            h = client.cluster_health()
+            done(200, {
+                "cluster_name": state.cluster_name,
+                "status": h["status"],
+                # partial stat collection must be VISIBLE: failed > 0
+                # means docs.count undercounts
+                "_shards": {"total": shard_stats.get("total", 0),
+                            "successful": shard_stats.get("successful", 0),
+                            "failed": shard_stats.get("failed", 0)},
+                "indices": {
+                    "count": n_indices,
+                    "shards": {"total": total_active,
+                               "primaries": primaries,
+                               "replication":
+                                   ((total_active - primaries) /
+                                    primaries) if primaries else 0.0},
+                    "docs": {"count": docs},
+                },
+                "nodes": {
+                    "count": {"total": len(state.nodes), **role_counts},
+                    "versions": [__version__],
+                },
+            })
+        if n_indices:
+            from elasticsearch_tpu.action.admin import STATS_SHARD
+            client.node.broadcast_actions.broadcast(
+                STATS_SHARD, "_all", with_docs)
+        else:
+            with_docs({"payloads": []})
+    r("GET", "/_cluster/stats", cluster_stats)
 
     def cluster_settings_put(req: RestRequest, done: DoneFn) -> None:
         client.cluster_update_settings(req.body or {}, wrap_client_cb(done))
