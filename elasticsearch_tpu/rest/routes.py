@@ -10,6 +10,7 @@ _cat/* human tables, _nodes, _aliases.
 from __future__ import annotations
 
 import json
+import re
 import uuid as uuid_mod
 from typing import Any, Callable, Dict, List, Optional
 
@@ -1047,8 +1048,19 @@ def build_controller(client: NodeClient) -> RestController:
             done(200, client.cluster_health(index))
             return
         rank = {"red": 0, "yellow": 1, "green": 2}
-        deadline = client.node.scheduler.now() + float(
-            str(req.query.get("timeout", "30")).rstrip("s") or 30)
+
+        def duration_s(raw: str) -> float:
+            """ES duration expression -> seconds (30s, 1m, 500ms, 2h)."""
+            m = re.match(r"^(\d+(?:\.\d+)?)(ms|s|m|h)?$", str(raw))
+            if not m:
+                raise IllegalArgumentError(
+                    f"failed to parse timeout [{raw}]")
+            n = float(m.group(1))
+            return n * {"ms": 0.001, "s": 1.0, "m": 60.0,
+                        "h": 3600.0}.get(m.group(2) or "s", 1.0)
+
+        deadline = client.node.scheduler.now() + duration_s(
+            req.query.get("timeout", "30s"))
 
         def poll() -> None:
             h = client.cluster_health(index)
@@ -1081,11 +1093,10 @@ def build_controller(client: NodeClient) -> RestController:
                 role_counts[role] = role_counts.get(role, 0) + 1
 
         def with_docs(resp, _err=None):
-            docs = 0
-            for payload in (resp or {}).get("payloads", []):
-                if payload.get("primary"):
-                    docs += int(payload.get("docs", 0))
-            shard_stats = (resp or {}).get("_shards", {})
+            resp = resp or {}
+            docs = ((resp.get("_all") or {}).get("primaries") or {}) \
+                .get("docs", {}).get("count", 0)
+            shard_stats = resp.get("_shards", {})
             h = client.cluster_health()
             done(200, {
                 "cluster_name": state.cluster_name,
@@ -1110,11 +1121,11 @@ def build_controller(client: NodeClient) -> RestController:
                 },
             })
         if n_indices:
-            from elasticsearch_tpu.action.admin import STATS_SHARD
-            client.node.broadcast_actions.broadcast(
-                STATS_SHARD, "_all", with_docs)
+            # one aggregation path: index_stats already sums primary
+            # docs and carries the _shards success/failure counts
+            client.index_stats("_all", with_docs)
         else:
-            with_docs({"payloads": []})
+            with_docs({})
     r("GET", "/_cluster/stats", cluster_stats)
 
     def cluster_settings_put(req: RestRequest, done: DoneFn) -> None:

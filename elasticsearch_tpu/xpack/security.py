@@ -37,6 +37,11 @@ SUPERUSER_ROLE = {"cluster": ["all"],
 BUILTIN_ROLES = {"superuser": SUPERUSER_ROLE}
 
 
+class IllegalSecurityScope(Exception):
+    """A request's targets cannot be covered by one DLS wrap; fails
+    closed with 403."""
+
+
 def hash_password(password: str, salt: Optional[bytes] = None
                   ) -> Dict[str, str]:
     salt = salt if salt is not None else os.urandom(16)
@@ -341,6 +346,97 @@ class SecurityService:
                 return False
         return True
 
+    def dls_filter(self, user: Dict[str, Any],
+                   index_expression: str) -> Optional[Dict[str, Any]]:
+        """Document-level security filter for the user over the target
+        indices (SecurityIndexSearcherWrapper analog): each index grant
+        may carry a "query"; a grant WITHOUT one makes that INDEX
+        unrestricted; role queries on one index OR together. One filter
+        wraps the whole request, so heterogeneous targets — mixing
+        restricted and unrestricted indices, or restricted indices with
+        DIFFERENT filters — fail CLOSED (the reference applies DLS
+        per-shard; that granularity is a documented divergence)."""
+        import json as _json
+        roles = [r for name in user.get("roles", [])
+                 if (r := self._roles().get(name)) is not None]
+        if any("all" in set(r.get("cluster", [])) for r in roles):
+            return None
+        targets = self._resolve_targets(index_expression or "*")
+        per_target: List[Optional[tuple]] = []
+        for target in targets:
+            queries: List[Dict[str, Any]] = []
+            unrestricted = False
+            for role in roles:
+                for grant in role.get("indices", []):
+                    names = grant.get("names", [])
+                    if isinstance(names, str):
+                        names = [names]
+                    if target != "*" and not any(
+                            fnmatch.fnmatch(target, p) for p in names):
+                        continue
+                    q = grant.get("query")
+                    if q is None:
+                        unrestricted = True
+                    else:
+                        queries.append(q)
+            if unrestricted or not queries:
+                per_target.append(None)
+            else:
+                per_target.append(tuple(
+                    _json.dumps(q, sort_keys=True) for q in queries))
+        restricted = {p for p in per_target if p is not None}
+        if not restricted:
+            return None
+        if len(restricted) > 1 or any(p is None for p in per_target):
+            raise IllegalSecurityScope(
+                "document-level security filters differ across the "
+                "requested indices; query them individually")
+        queries = [_json.loads(q) for q in next(iter(restricted))]
+        if len(queries) == 1:
+            return queries[0]
+        return {"bool": {"should": queries, "minimum_should_match": 1}}
+
+    # APIs whose body query DLS can wrap
+    _DLS_PATHS = ("_search", "_count", "_async_search", "_eql",
+                  "_rank_eval", "_graph", "_validate")
+    # read APIs DLS CANNOT filter (raw-body or direct doc reads): when a
+    # filter applies these fail closed rather than leak hidden docs
+    _DLS_BLOCKED = ("_doc", "_source", "_mget", "_msearch",
+                    "_termvectors", "_explain", "_sql", "_knn_search")
+
+    def _apply_dls(self, user: Dict[str, Any], request) -> None:
+        """Wrap the request query with the user's role filters for the
+        APIs that accept one; deny filtered users the doc-read APIs the
+        wrap cannot protect."""
+        parts = [p for p in request.path.split("/") if p]
+        if not parts:
+            return
+        api = next((p for p in parts if p.startswith("_")), None)
+        if api is None:
+            return
+        wrappable = any(api.startswith(p) for p in self._DLS_PATHS)
+        blocked = any(api.startswith(p) for p in self._DLS_BLOCKED)
+        if not wrappable and not blocked:
+            return
+        index = parts[0] if not parts[0].startswith("_") else "_all"
+        filt = self.dls_filter(user, index)
+        if filt is None:
+            return
+        if blocked:
+            raise IllegalSecurityScope(
+                f"[{api}] cannot apply this user's document-level "
+                f"security filters; use _search")
+        body = dict(request.body or {})
+        # a ?q= URI query must fold in BEFORE wrapping, or the handler's
+        # later body["query"] = q overwrite would discard the filter
+        q_param = (request.query or {}).pop("q", None)
+        if q_param:
+            from elasticsearch_tpu.rest.routes import _uri_query
+            body["query"] = _uri_query(q_param)
+        original = body.get("query", {"match_all": {}})
+        body["query"] = {"bool": {"must": [original], "filter": [filt]}}
+        request.body = body
+
     def check(self, request) -> Optional[Tuple[int, Dict[str, Any]]]:
         """None = allowed; else (status, error body). SecurityRestFilter
         analog, invoked before dispatch."""
@@ -358,6 +454,17 @@ class SecurityService:
                 "type": "security_exception",
                 "reason": f"action [{request.method} {request.path}] is "
                           f"unauthorized for user [{user['username']}]"},
+                "status": 403}
+        try:
+            self._apply_dls(user, request)
+        except IllegalSecurityScope as e:
+            return 403, {"error": {
+                "type": "security_exception", "reason": str(e)},
+                "status": 403}
+        except Exception:  # noqa: BLE001 — a DLS failure must fail CLOSED
+            return 403, {"error": {
+                "type": "security_exception",
+                "reason": "failed to apply document-level security"},
                 "status": 403}
         request.params["_authenticated_user"] = user["username"]
         return None

@@ -209,3 +209,141 @@ def test_http_auth_end_to_end(tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_document_level_security():
+    """Role index grants with a "query" restrict which docs a user's
+    searches see (SecurityIndexSearcherWrapper analog)."""
+    from elasticsearch_tpu.rest.controller import RestRequest
+    from elasticsearch_tpu.rest.routes import build_controller
+    c = InProcessCluster(n_nodes=1, seed=53)
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.create_index("docs", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {
+                "team": {"type": "keyword"},
+                "body": {"type": "text"}}}}, cb))
+        assert e is None
+        c.ensure_green("docs")
+        for i, team in enumerate(["red", "red", "blue"]):
+            r, e = c.call(lambda cb, i=i, t=team: client.index_doc(
+                "docs", f"d{i}", {"team": t, "body": "hello"}, cb))
+            assert e is None
+        c.call(lambda cb: client.refresh("docs", cb))
+        r, e = c.call(lambda cb: client.put_security_role("red-only", {
+            "indices": [{"names": ["docs"], "privileges": ["read"],
+                         "query": {"term": {"team": "red"}}}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("amy", {
+            "password": "amypass", "roles": ["red-only"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True}}, cb))
+        assert e is None
+
+        controller = build_controller(client)
+        auth = {"authorization": "Basic " + base64.b64encode(
+            b"amy:amypass").decode()}
+
+        def do(method, path, body=None, headers=None):
+            req = RestRequest(method=method, path=path, query={},
+                              body=body, raw_body=b"",
+                              headers=dict(headers or {}))
+            node = c.master()
+            denied = node.security.check(req)
+            if denied is not None:
+                return denied
+            out = []
+            controller.dispatch(req, lambda s, b: out.append((s, b)))
+            c.run_until(lambda: bool(out), 120.0)
+            return out[0]
+
+        s, body = do("POST", "/docs/_search",
+                     {"query": {"match_all": {}}}, auth)
+        assert s == 200
+        assert body["hits"]["total"]["value"] == 2      # blue doc hidden
+        teams = {h["_source"]["team"] for h in body["hits"]["hits"]}
+        assert teams == {"red"}
+        # count is filtered the same way
+        s, body = do("POST", "/docs/_count",
+                     {"query": {"match_all": {}}}, auth)
+        assert s == 200 and body["count"] == 2
+    finally:
+        c.stop()
+
+
+def test_dls_blocked_apis_and_heterogeneous_targets():
+    """DLS fails CLOSED on the doc-read APIs the query wrap cannot
+    protect, and on multi-index requests with differing filters."""
+    c = InProcessCluster(n_nodes=1, seed=57)
+    c.start()
+    try:
+        client = c.client()
+        for name in ("secret", "open"):
+            r, e = c.call(lambda cb, n=name: client.create_index(n, {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": {"properties": {
+                    "team": {"type": "keyword"}}}}, cb))
+            assert e is None
+        c.ensure_green("secret")
+        r, e = c.call(lambda cb: client.index_doc(
+            "secret", "s1", {"team": "blue"}, cb))
+        assert e is None
+        c.call(lambda cb: client.refresh("secret", cb))
+        r, e = c.call(lambda cb: client.put_security_role("mixed", {
+            "indices": [
+                {"names": ["secret"], "privileges": ["read"],
+                 "query": {"term": {"team": "red"}}},
+                {"names": ["open"], "privileges": ["read"]}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("zed", {
+            "password": "zedpass", "roles": ["mixed"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True}}, cb))
+        assert e is None
+
+        node = c.master()
+        auth = {"authorization": "Basic " + base64.b64encode(
+            b"zed:zedpass").decode()}
+        from elasticsearch_tpu.rest.controller import RestRequest
+
+        def check(method, path, body=None):
+            return node.security.check(RestRequest(
+                method=method, path=path, query={}, body=body,
+                raw_body=b"", headers=dict(auth)))
+
+        # direct doc read on the filtered index: 403, never a leak
+        denied = check("GET", "/secret/_doc/s1")
+        assert denied is not None and denied[0] == 403
+        # mget/msearch likewise
+        assert check("POST", "/secret/_mget",
+                     {"ids": ["s1"]})[0] == 403
+        # mixed restricted+unrestricted expression: 403 (one wrap cannot
+        # express per-index filters)
+        assert check("POST", "/secret,open/_search",
+                     {"query": {"match_all": {}}})[0] == 403
+        # the unrestricted index alone passes untouched
+        assert check("POST", "/open/_search",
+                     {"query": {"match_all": {}}}) is None
+        # the restricted index alone gets wrapped, not denied
+        req = RestRequest(method="POST", path="/secret/_search",
+                          query={}, body={"query": {"match_all": {}}},
+                          raw_body=b"", headers=dict(auth))
+        assert node.security.check(req) is None
+        assert "filter" in req.body["query"]["bool"] and \
+            req.body["query"]["bool"]["filter"] == [
+                {"term": {"team": "red"}}]
+        # ?q= folds into the wrap instead of clobbering it
+        req = RestRequest(method="GET", path="/secret/_search",
+                          query={"q": "team:blue"}, body=None,
+                          raw_body=b"", headers=dict(auth))
+        assert node.security.check(req) is None
+        assert "q" not in req.query
+        assert req.body["query"]["bool"]["filter"] == [
+            {"term": {"team": "red"}}]
+    finally:
+        c.stop()
