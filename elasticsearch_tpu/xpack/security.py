@@ -374,6 +374,11 @@ class SecurityService:
                     if target != "*" and not any(
                             fnmatch.fnmatch(target, p) for p in names):
                         continue
+                    # only READ-capable grants shape read filtering — a
+                    # write-only grant must not unrestrict searches
+                    privs = set(grant.get("privileges", []))
+                    if not privs & {"all", "read"}:
+                        continue
                     q = grant.get("query")
                     if q is None:
                         unrestricted = True
@@ -396,45 +401,174 @@ class SecurityService:
             return queries[0]
         return {"bool": {"should": queries, "minimum_should_match": 1}}
 
-    # APIs whose body query DLS can wrap
-    _DLS_PATHS = ("_search", "_count", "_async_search", "_eql",
-                  "_rank_eval", "_graph", "_validate")
-    # read APIs DLS CANNOT filter (raw-body or direct doc reads): when a
+    def fls_fields(self, user: Dict[str, Any],
+                   index_expression: str) -> Optional[List[str]]:
+        """Field-level security: the union of granted field patterns for
+        the user over the targets, or None for unrestricted
+        (FieldPermissions analog). Heterogeneous targets fail closed
+        like DLS."""
+        roles = [r for name in user.get("roles", [])
+                 if (r := self._roles().get(name)) is not None]
+        if any("all" in set(r.get("cluster", [])) for r in roles):
+            return None
+        targets = self._resolve_targets(index_expression or "*")
+        per_target: List[Optional[tuple]] = []
+        for target in targets:
+            grants: List[str] = []
+            unrestricted = False
+            for role in roles:
+                for grant in role.get("indices", []):
+                    names = grant.get("names", [])
+                    if isinstance(names, str):
+                        names = [names]
+                    if target != "*" and not any(
+                            fnmatch.fnmatch(target, p) for p in names):
+                        continue
+                    privs = set(grant.get("privileges", []))
+                    if not privs & {"all", "read"}:
+                        continue
+                    fs = grant.get("field_security")
+                    if fs is None:
+                        unrestricted = True
+                    else:
+                        grants.extend(fs.get("grant", []))
+            if unrestricted:
+                per_target.append(None)
+            else:
+                per_target.append(tuple(sorted(set(grants))))
+        restricted = {p for p in per_target if p is not None}
+        if not restricted:
+            return None
+        if len(restricted) > 1 or any(p is None for p in per_target):
+            raise IllegalSecurityScope(
+                "field-level security grants differ across the "
+                "requested indices; query them individually")
+        return list(next(iter(restricted)))
+
+    # APIs whose body query DLS can wrap (plain search-shaped bodies)
+    _DLS_PATHS = ("_search", "_count", "_graph", "_validate",
+                  "_async_search")
+    # read APIs one wrap CANNOT protect (raw/ndjson bodies, per-spec
+    # sub-requests, non-DSL query languages, direct doc reads): when a
     # filter applies these fail closed rather than leak hidden docs
-    _DLS_BLOCKED = ("_doc", "_source", "_mget", "_msearch",
-                    "_termvectors", "_explain", "_sql", "_knn_search")
+    _DLS_BLOCKED_ALWAYS = ("_mget", "_msearch", "_termvectors",
+                           "_explain", "_sql", "_knn_search",
+                           "_rank_eval", "_eql")
+    # doc APIs blocked only for READS — writes through them leak nothing
+    _DLS_BLOCKED_READS = ("_doc", "_source")
+
+    @staticmethod
+    def _referenced_fields(node: Any) -> List[str]:
+        """Every \"field\"-valued name plus sort keys in a request body —
+        the surfaces that can leak restricted values via aggs/sort."""
+        out: List[str] = []
+
+        def walk(n: Any) -> None:
+            if isinstance(n, dict):
+                for k, v in n.items():
+                    if k == "field" and isinstance(v, str):
+                        out.append(v)
+                    elif k in ("docvalue_fields", "stored_fields",
+                               "fields") and isinstance(v, list):
+                        out.extend(x if isinstance(x, str)
+                                   else x.get("field", "")
+                                   for x in v)
+                    elif k == "sort":
+                        entries = v if isinstance(v, list) else [v]
+                        for e in entries:
+                            if isinstance(e, str):
+                                out.append(e)
+                            elif isinstance(e, dict):
+                                out.extend(e.keys())
+                    else:
+                        walk(v)
+            elif isinstance(n, list):
+                for item in n:
+                    walk(item)
+        walk(node)
+        return [f for f in out if f and not f.startswith("_")]
 
     def _apply_dls(self, user: Dict[str, Any], request) -> None:
         """Wrap the request query with the user's role filters for the
-        APIs that accept one; deny filtered users the doc-read APIs the
+        APIs that accept one; deny filtered users every read path the
         wrap cannot protect."""
         parts = [p for p in request.path.split("/") if p]
         if not parts:
             return
+        # id-based async-search get/delete is owner-checked by the
+        # service and names no index — nothing to wrap or block
+        if parts[0] == "_async_search":
+            return
         api = next((p for p in parts if p.startswith("_")), None)
         if api is None:
             return
-        wrappable = any(api.startswith(p) for p in self._DLS_PATHS)
-        blocked = any(api.startswith(p) for p in self._DLS_BLOCKED)
+        # search templates rebuild the body from source+params,
+        # discarding any injected query — treat as unprotectable
+        templated = "template" in parts or api == "_render"
+        wrappable = (not templated and
+                     any(api.startswith(p) for p in self._DLS_PATHS))
+        blocked = templated or \
+            any(api.startswith(p) for p in self._DLS_BLOCKED_ALWAYS) or \
+            (api in self._DLS_BLOCKED_READS and
+             request.method in ("GET", "HEAD"))
         if not wrappable and not blocked:
+            if api == "_field_caps":
+                # schema disclosure matters only under FLS
+                index = parts[0] if not parts[0].startswith("_") \
+                    else "_all"
+                if self.fls_fields(user, index) is not None:
+                    raise IllegalSecurityScope(
+                        "[_field_caps] is unavailable under "
+                        "field-level security")
             return
         index = parts[0] if not parts[0].startswith("_") else "_all"
         filt = self.dls_filter(user, index)
-        if filt is None:
+        fields = self.fls_fields(user, index)
+        if filt is None and fields is None:
             return
         if blocked:
             raise IllegalSecurityScope(
-                f"[{api}] cannot apply this user's document-level "
-                f"security filters; use _search")
+                f"[{api}] cannot apply this user's document/field-level "
+                f"security; use _search")
         body = dict(request.body or {})
-        # a ?q= URI query must fold in BEFORE wrapping, or the handler's
-        # later body["query"] = q overwrite would discard the filter
-        q_param = (request.query or {}).pop("q", None)
-        if q_param:
-            from elasticsearch_tpu.rest.routes import _uri_query
-            body["query"] = _uri_query(q_param)
-        original = body.get("query", {"match_all": {}})
-        body["query"] = {"bool": {"must": [original], "filter": [filt]}}
+        if filt is not None:
+            # a ?q= URI query must fold in BEFORE wrapping, or the
+            # handler's later body["query"] = q overwrite would discard
+            # the filter
+            q_param = (request.query or {}).pop("q", None)
+            if q_param:
+                from elasticsearch_tpu.rest.routes import _uri_query
+                body["query"] = _uri_query(q_param)
+            original = body.get("query", {"match_all": {}})
+            body["query"] = {"bool": {"must": [original],
+                                      "filter": [filt]}}
+        if fields is not None:
+            # aggs/sort/docvalue_fields surface raw values outside
+            # _source: every referenced field must be granted
+            outside = {k: body[k] for k in
+                       ("aggs", "aggregations", "sort",
+                        "docvalue_fields", "stored_fields",
+                        "script_fields", "highlight", "collapse")
+                       if k in body}
+            for ref in self._referenced_fields(outside):
+                if not any(fnmatch.fnmatch(ref, g) for g in fields):
+                    raise IllegalSecurityScope(
+                        f"field [{ref}] is not granted by this user's "
+                        f"field-level security")
+            if "script_fields" in body:
+                raise IllegalSecurityScope(
+                    "[script_fields] is unavailable under field-level "
+                    "security")
+            # FLS via _source includes: granted patterns intersected
+            # with whatever the request asked for
+            requested = body.get("_source")
+            if isinstance(requested, list):
+                includes = [f for f in requested
+                            if any(fnmatch.fnmatch(f, g)
+                                   for g in fields)]
+                body["_source"] = includes or ["__fls_nothing__"]
+            else:
+                body["_source"] = list(fields) or ["__fls_nothing__"]
         request.body = body
 
     def check(self, request) -> Optional[Tuple[int, Dict[str, Any]]]:

@@ -347,3 +347,126 @@ def test_dls_blocked_apis_and_heterogeneous_targets():
             {"term": {"team": "red"}}]
     finally:
         c.stop()
+
+
+def test_field_level_security():
+    """field_security grants limit which _source fields search responses
+    carry (FieldPermissions analog via _source includes)."""
+    c = InProcessCluster(n_nodes=1, seed=61)
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.create_index("people", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {
+                "name": {"type": "keyword"},
+                "ssn": {"type": "keyword"}}}}, cb))
+        assert e is None
+        c.ensure_green("people")
+        r, e = c.call(lambda cb: client.index_doc(
+            "people", "p1", {"name": "Amy", "ssn": "123-45-6789"}, cb))
+        assert e is None
+        c.call(lambda cb: client.refresh("people", cb))
+        r, e = c.call(lambda cb: client.put_security_role("no-pii", {
+            "indices": [{"names": ["people"], "privileges": ["read"],
+                         "field_security": {"grant": ["name"]}}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("viewer", {
+            "password": "viewpass", "roles": ["no-pii"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True}}, cb))
+        assert e is None
+
+        node = c.master()
+        from elasticsearch_tpu.rest.controller import RestRequest
+        from elasticsearch_tpu.rest.routes import build_controller
+        controller = build_controller(client)
+        auth = {"authorization": "Basic " + base64.b64encode(
+            b"viewer:viewpass").decode()}
+        req = RestRequest(method="POST", path="/people/_search",
+                          query={}, body={"query": {"match_all": {}}},
+                          raw_body=b"", headers=dict(auth))
+        assert node.security.check(req) is None
+        out = []
+        controller.dispatch(req, lambda s, b: out.append((s, b)))
+        c.run_until(lambda: bool(out), 120.0)
+        s, body = out[0]
+        assert s == 200
+        src = body["hits"]["hits"][0]["_source"]
+        assert src == {"name": "Amy"}          # ssn stripped
+        # direct doc read fails closed for FLS users too
+        denied = node.security.check(RestRequest(
+            method="GET", path="/people/_doc/p1", query={}, body=None,
+            raw_body=b"", headers=dict(auth)))
+        assert denied is not None and denied[0] == 403
+    finally:
+        c.stop()
+
+
+def test_dls_bypass_vectors_fail_closed():
+    """Templates, rank_eval, EQL, and write-only grants must not punch
+    holes in DLS/FLS; _doc WRITES stay allowed."""
+    c = InProcessCluster(n_nodes=1, seed=67)
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.create_index("docs", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {
+                "team": {"type": "keyword"},
+                "ssn": {"type": "keyword"}}}}, cb))
+        assert e is None
+        c.ensure_green("docs")
+        r, e = c.call(lambda cb: client.put_security_role("filtered", {
+            "indices": [
+                {"names": ["docs"], "privileges": ["read", "write"],
+                 "query": {"term": {"team": "red"}},
+                 "field_security": {"grant": ["team"]}},
+                # a WRITE-ONLY unrestricted grant must not unrestrict
+                # the read path
+                {"names": ["docs"], "privileges": ["write"]}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("kim", {
+            "password": "kimpass", "roles": ["filtered"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True}}, cb))
+        assert e is None
+
+        node = c.master()
+        auth = {"authorization": "Basic " + base64.b64encode(
+            b"kim:kimpass").decode()}
+        from elasticsearch_tpu.rest.controller import RestRequest
+
+        def check(method, path, body=None):
+            return node.security.check(RestRequest(
+                method=method, path=path, query={}, body=body,
+                raw_body=b"", headers=dict(auth)))
+
+        # templates, rank_eval, eql: unprotectable -> 403
+        assert check("POST", "/docs/_search/template",
+                     {"source": {"query": {"match_all": {}}}})[0] == 403
+        assert check("POST", "/docs/_rank_eval",
+                     {"requests": []})[0] == 403
+        assert check("POST", "/docs/_eql/search",
+                     {"query": "any where true"})[0] == 403
+        # FLS: non-granted agg field -> 403; _field_caps -> 403
+        assert check("POST", "/docs/_search", {
+            "size": 0, "aggs": {"x": {"terms": {"field": "ssn"}}}}
+            )[0] == 403
+        assert check("GET", "/docs/_field_caps")[0] == 403
+        # granted agg field passes (wrapped)
+        req = RestRequest(method="POST", path="/docs/_search", query={},
+                          body={"size": 0, "aggs": {
+                              "x": {"terms": {"field": "team"}}}},
+                          raw_body=b"", headers=dict(auth))
+        assert node.security.check(req) is None
+        # write-only grant did NOT unrestrict reads: the filter applies
+        assert "filter" in req.body["query"]["bool"]
+        # _doc WRITES are not read-leaks: allowed
+        assert check("PUT", "/docs/_doc/w1", {"team": "red"}) is None
+        # _doc READ stays blocked
+        assert check("GET", "/docs/_doc/w1")[0] == 403
+    finally:
+        c.stop()
