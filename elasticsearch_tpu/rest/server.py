@@ -32,16 +32,27 @@ class _BadRequest(Exception):
 
 class HttpServer:
     def __init__(self, client: NodeClient, host: str = "127.0.0.1",
-                 port: int = 9200):
+                 port: int = 9200,
+                 ssl_certfile: Optional[str] = None,
+                 ssl_keyfile: Optional[str] = None):
         self.client = client
         self.controller: RestController = build_controller(client)
         self.host = host
         self.port = port
+        # TLS (xpack.security.http.ssl analog): serve HTTPS when a cert +
+        # key are supplied
+        self.ssl_certfile = ssl_certfile
+        self.ssl_keyfile = ssl_keyfile
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
+        ssl_ctx = None
+        if self.ssl_certfile:
+            import ssl as ssl_mod
+            ssl_ctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
         self._server = await asyncio.start_server(
-            self._handle_connection, self.host, self.port)
+            self._handle_connection, self.host, self.port, ssl=ssl_ctx)
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -292,8 +303,18 @@ def run_tcp_node(node_id: str, http_port: int, tcp_port: int,
     from elasticsearch_tpu.transport.tcp import TcpTransport, TcpTransportService
 
     scheduler = ThreadedScheduler()
+    # TLS from env (elasticsearch.yml analog): ESTPU_TRANSPORT_SSL_CERT/
+    # _KEY/_CA enable mutual transport TLS; ESTPU_HTTP_SSL_CERT/_KEY
+    # serve HTTPS
+    import os as _os
     tcp = TcpTransport(scheduler, node_id, (host, tcp_port),
-                       {n: tuple(a) for n, a in peers.items()})
+                       {n: tuple(a) for n, a in peers.items()},
+                       ssl_certfile=_os.environ.get(
+                           "ESTPU_TRANSPORT_SSL_CERT"),
+                       ssl_keyfile=_os.environ.get(
+                           "ESTPU_TRANSPORT_SSL_KEY"),
+                       ssl_cafile=_os.environ.get(
+                           "ESTPU_TRANSPORT_SSL_CA"))
     tcp.start()
     service = TcpTransportService(node_id, tcp)
     node = Node(node_id, None, scheduler,
@@ -304,7 +325,11 @@ def run_tcp_node(node_id: str, http_port: int, tcp_port: int,
                 transport_service=service)
     node.start()
 
-    server = HttpServer(node.client, host, http_port)
+    server = HttpServer(node.client, host, http_port,
+                        ssl_certfile=_os.environ.get(
+                            "ESTPU_HTTP_SSL_CERT"),
+                        ssl_keyfile=_os.environ.get(
+                            "ESTPU_HTTP_SSL_KEY"))
 
     async def main() -> None:
         await server.start()

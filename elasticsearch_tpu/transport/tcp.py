@@ -95,9 +95,11 @@ class _Peer:
     """
 
     def __init__(self, my_id: str, address: Tuple[str, int],
-                 on_fail_dispatch: Callable[[Callable[[], None]], None]):
+                 on_fail_dispatch: Callable[[Callable[[], None]], None],
+                 ssl_context=None):
         self.my_id = my_id
         self.address = address
+        self._ssl_context = ssl_context
         self._q: "queue.Queue" = queue.Queue()
         self._sock: Optional[socket.socket] = None
         self._closed = False
@@ -117,6 +119,9 @@ class _Peer:
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self.address, timeout=5.0)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._ssl_context is not None:
+            sock = self._ssl_context.wrap_socket(
+                sock, server_hostname=self.address[0])
         sock.settimeout(None)
         sock.sendall(_encode_frame({"t": "hs", "node": self.my_id}))
         return sock
@@ -157,11 +162,21 @@ class TcpTransport:
 
     def __init__(self, scheduler: Scheduler, node_id: str,
                  bind: Tuple[str, int],
-                 address_book: Dict[str, Tuple[str, int]]):
+                 address_book: Dict[str, Tuple[str, int]],
+                 ssl_certfile: Optional[str] = None,
+                 ssl_keyfile: Optional[str] = None,
+                 ssl_cafile: Optional[str] = None):
         self.scheduler = scheduler
         self.node_id = node_id
         self.bind_address = bind
         self.address_book = dict(address_book)
+        # transport TLS (xpack.security.transport.ssl analog): when a
+        # cert+key are supplied the listener wraps inbound sockets and
+        # outbound connections verify against ca (or the same cert for
+        # the self-signed single-CA deployment shape)
+        self.ssl_certfile = ssl_certfile
+        self.ssl_keyfile = ssl_keyfile
+        self.ssl_cafile = ssl_cafile or ssl_certfile
         self._peers: Dict[str, _Peer] = {}
         self._lock = threading.Lock()
         self._server: Optional[socket.socket] = None
@@ -172,11 +187,40 @@ class TcpTransport:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _build_ssl_contexts(self) -> None:
+        """Built ONCE: contexts are shared by every peer/connection (a
+        per-peer rebuild re-read certs from disk under the lock). The
+        server context REQUIRES client certificates — transport TLS is
+        mutual or it is authentication theater: without it any reachable
+        attacker could handshake and inject forged frames."""
+        self._server_ctx = None
+        self._client_ctx = None
+        if not self.ssl_certfile:
+            return
+        import ssl as ssl_mod
+        sctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_SERVER)
+        sctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
+        sctx.verify_mode = ssl_mod.CERT_REQUIRED
+        sctx.load_verify_locations(self.ssl_cafile)
+        self._server_ctx = sctx
+        cctx = ssl_mod.SSLContext(ssl_mod.PROTOCOL_TLS_CLIENT)
+        cctx.load_verify_locations(self.ssl_cafile)
+        cctx.check_hostname = False    # node certs carry ids, not hosts
+        cctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
+        self._client_ctx = cctx
+
+    def _client_ssl_context(self):
+        return self._client_ctx
+
     def start(self) -> None:
+        self._build_ssl_contexts()
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(self.bind_address)
         srv.listen(64)
+        # the listener is NOT wrapped: accept() must never run a TLS
+        # handshake (a stalled or plaintext client would block or kill
+        # the accept loop) — each connection wraps on its reader thread
         self._server = srv
         # rebinding port 0 resolves the ephemeral port for the address book
         self.bind_address = srv.getsockname()
@@ -218,6 +262,27 @@ class TcpTransport:
 
     def _read_loop(self, conn: socket.socket) -> None:
         try:
+            if self._server_ctx is not None:
+                # per-connection handshake OFF the accept thread, with a
+                # deadline so a stalled client costs one reader thread,
+                # not cluster availability; failures close only this conn
+                raw = conn
+                raw.settimeout(10.0)
+                try:
+                    conn = self._server_ctx.wrap_socket(raw,
+                                                        server_side=True)
+                except (OSError, ValueError):
+                    with self._lock:
+                        self._inbound.discard(raw)
+                    try:
+                        raw.close()
+                    except OSError:
+                        pass
+                    return
+                conn.settimeout(None)
+                with self._lock:
+                    self._inbound.discard(raw)
+                    self._inbound.add(conn)
             hs = _recv_frame(conn)
             if not hs or hs.get("t") != "hs":
                 return
@@ -261,7 +326,8 @@ class TcpTransport:
                 peer = self._peers.get(node_id)
                 if peer is None:
                     peer = self._peers[node_id] = _Peer(
-                        self.node_id, tuple(addr), self.scheduler.submit)
+                        self.node_id, tuple(addr), self.scheduler.submit,
+                        ssl_context=self._client_ssl_context())
         if peer is None:
             if on_fail is not None:
                 self.scheduler.submit(on_fail)

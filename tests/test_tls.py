@@ -1,0 +1,135 @@
+"""TLS on the HTTP server and the TCP transport.
+
+Reference: x-pack security TLS everywhere —
+xpack.security.http.ssl (Netty pipeline SSL handler) and
+xpack.security.transport.ssl (node-to-node encryption).
+"""
+
+import asyncio
+import json
+import ssl
+import subprocess
+import time as time_mod
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert = d / "node.crt"
+    key = d / "node.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=node"],
+        check=True, capture_output=True)
+    return str(cert), str(key)
+
+
+def test_https_round_trip(certs):
+    from elasticsearch_tpu.cluster.state import ClusterState
+    from elasticsearch_tpu.node.node import Node
+    from elasticsearch_tpu.rest.server import HttpServer
+    from elasticsearch_tpu.transport.scheduler import ThreadedScheduler
+    from elasticsearch_tpu.transport.transport import InMemoryTransport
+
+    certfile, keyfile = certs
+    scheduler = ThreadedScheduler()
+    transport = InMemoryTransport(scheduler, default_latency=0.0)
+    node = Node("node0", transport, scheduler, seed_peers=["node0"],
+                initial_state=ClusterState(
+                    voting_config=frozenset(["node0"])))
+    node.start()
+    deadline = time_mod.monotonic() + 30
+    while node.coordinator.mode != "LEADER":
+        assert time_mod.monotonic() < deadline
+        time_mod.sleep(0.02)
+
+    async def scenario():
+        server = HttpServer(node.client, host="127.0.0.1", port=0,
+                            ssl_certfile=certfile, ssl_keyfile=keyfile)
+        await server.start()
+        port = server._server.sockets[0].getsockname()[1]
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(certfile)
+        ctx.check_hostname = False
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", port, ssl=ctx)
+        payload = json.dumps({"settings": {
+            "number_of_shards": 1, "number_of_replicas": 0}}).encode()
+        writer.write((f"PUT /tls-idx HTTP/1.1\r\nhost: x\r\n"
+                      f"content-type: application/json\r\n"
+                      f"content-length: {len(payload)}\r\n\r\n"
+                      ).encode() + payload)
+        await writer.drain()
+        status = int((await reader.readline()).split()[1])
+        assert status == 200
+        # plaintext against the TLS port must NOT work
+        r2, w2 = await asyncio.open_connection("127.0.0.1", port)
+        w2.write(b"GET / HTTP/1.1\r\nhost: x\r\n\r\n")
+        await w2.drain()
+        line = await asyncio.wait_for(r2.readline(), timeout=5)
+        assert not line.startswith(b"HTTP/1.1 200")
+        writer.close()
+        w2.close()
+        await server.stop()
+
+    try:
+        asyncio.run(scenario())
+    finally:
+        node.stop()
+        scheduler.close()
+
+
+def test_tcp_transport_tls(certs):
+    """Two TcpTransport endpoints talk over TLS; a plaintext client is
+    rejected by the handshake."""
+    import socket
+
+    from elasticsearch_tpu.transport.scheduler import ThreadedScheduler
+    from elasticsearch_tpu.transport.tcp import TcpTransport
+
+    certfile, keyfile = certs
+    sched = ThreadedScheduler()
+    a = TcpTransport(sched, "a", ("127.0.0.1", 0), {},
+                     ssl_certfile=certfile, ssl_keyfile=keyfile)
+    b = TcpTransport(sched, "b", ("127.0.0.1", 0), {},
+                     ssl_certfile=certfile, ssl_keyfile=keyfile)
+    got = []
+    a.on_message = lambda msg: got.append(msg)
+    b.on_message = lambda msg: None
+    a.start()
+    b.start()
+    try:
+        b.address_book["a"] = a.bind_address
+        b.send("a", {"kind": "request", "action": "ping", "id": 1,
+                     "payload": {}})
+        deadline = time_mod.monotonic() + 10
+        while not got and time_mod.monotonic() < deadline:
+            time_mod.sleep(0.05)
+        assert got and got[0]["action"] == "ping"
+        # a plaintext connection cannot complete a frame exchange
+        raw = socket.create_connection(a.bind_address, timeout=5)
+        raw.sendall(b"\x00\x00\x00\x04junk")
+        raw.settimeout(5)
+        try:
+            data = raw.recv(64)
+            assert data == b"" or not data.startswith(b"ES")
+        except (ConnectionError, socket.timeout, OSError):
+            pass
+        finally:
+            raw.close()
+        # the plaintext probe must not have killed the accept loop:
+        # TLS traffic still flows afterwards
+        got.clear()
+        b.send("a", {"kind": "request", "action": "ping2", "id": 2,
+                     "payload": {}})
+        deadline = time_mod.monotonic() + 10
+        while not got and time_mod.monotonic() < deadline:
+            time_mod.sleep(0.05)
+        assert got and got[0]["action"] == "ping2"
+    finally:
+        a.close()
+        b.close()
+        sched.close()
