@@ -277,15 +277,26 @@ class MasterActions:
                 spec = action[kind]
                 meta = metadata.index(spec["index"])
                 aliases = set(meta.aliases)
+                configs = dict(meta.alias_configs)
                 if kind == "add":
                     aliases.add(spec["alias"])
+                    # add REPLACES the alias config entirely (ES alias
+                    # add semantics: re-adding without a filter clears
+                    # the old filter)
+                    props = {k: spec[k] for k in
+                             ("filter", "routing", "is_write_index")
+                             if k in spec}
+                    configs.pop(spec["alias"], None)
+                    if props:
+                        configs[spec["alias"]] = props
                 elif kind == "remove":
                     aliases.discard(spec["alias"])
+                    configs.pop(spec["alias"], None)
                 else:
                     raise IllegalArgumentError(
                         f"unknown alias action [{kind}]")
                 metadata = metadata.update_index(
-                    meta.with_aliases(tuple(sorted(aliases))))
+                    meta.with_aliases(tuple(sorted(aliases)), configs))
             return state.next_version(metadata=metadata)
         return self._submit("update-aliases", update)
 
@@ -432,11 +443,28 @@ class MasterActions:
         def update(state: ClusterState) -> ClusterState:
             sources = [im for im in state.metadata.indices.values()
                        if alias in im.aliases]
-            if len(sources) != 1:
+            if len(sources) > 1:
+                # the canonical is_write_index pattern: roll the single
+                # write index; the others stay read members of the alias
+                # (MetadataRolloverService write-alias rollover)
+                writers = [im for im in sources
+                           if (im.alias_configs.get(alias) or {})
+                           .get("is_write_index")]
+                if len(writers) != 1:
+                    raise IllegalArgumentError(
+                        f"rollover alias [{alias}] points to "
+                        f"{len(sources)} indices without a single "
+                        f"is_write_index")
+                sources = writers
+            if not sources:
                 raise IllegalArgumentError(
-                    f"rollover alias [{alias}] must point to exactly one "
-                    f"index, found {len(sources)}")
+                    f"rollover alias [{alias}] matches no index")
             old = sources[0]
+            # explicit is_write_index => write-alias pattern: the old
+            # generation stays a read member and only the flag moves
+            # (MetadataRolloverService keys on the same distinction)
+            multi_alias = bool((old.alias_configs.get(alias) or {})
+                               .get("is_write_index"))
             # the coordinator resolves new_index BEFORE sending, so a
             # MasterClient retry after a lost response fails here with
             # "already exists" instead of silently rolling twice
@@ -449,13 +477,31 @@ class MasterActions:
                                       dict(req.get("mappings") or {}))
             metadata = state.metadata
             now_ms = int(self.coordinator.scheduler.wall_now() * 1000)
-            old_meta = metadata.index(old.name)
-            metadata = metadata.update_index(old_meta.with_aliases(
-                tuple(a for a in old_meta.aliases if a != alias)
-            ).with_settings({"index.rollover_date": now_ms}))
-            new_meta = metadata.index(new_name)
+            old_meta = metadata.indices[old.name]
+            if multi_alias:
+                # write-alias rollover: the old index KEEPS the alias as
+                # a read member; only the write flag moves
+                old_configs = dict(old_meta.alias_configs)
+                old_configs[alias] = {
+                    k: v for k, v in
+                    (old_configs.get(alias) or {}).items()
+                    if k != "is_write_index"}
+                if not old_configs[alias]:
+                    old_configs.pop(alias)
+                metadata = metadata.update_index(old_meta.with_aliases(
+                    old_meta.aliases, old_configs
+                ).with_settings({"index.rollover_date": now_ms}))
+            else:
+                metadata = metadata.update_index(old_meta.with_aliases(
+                    tuple(a for a in old_meta.aliases if a != alias)
+                ).with_settings({"index.rollover_date": now_ms}))
+            new_meta = metadata.indices[new_name]
+            new_configs = dict(new_meta.alias_configs)
+            if multi_alias:
+                new_configs[alias] = {"is_write_index": True}
             metadata = metadata.update_index(new_meta.with_aliases(
-                tuple(dict.fromkeys(list(new_meta.aliases) + [alias]))))
+                tuple(dict.fromkeys(list(new_meta.aliases) + [alias])),
+                new_configs))
             return state.next_version(metadata=metadata)
 
         deferred = Deferred()

@@ -179,6 +179,13 @@ class TransportBulkAction:
             except Exception as e:  # noqa: BLE001 — per-item failure
                 responses[pos] = _item_error(item, e)
                 continue
+            # alias routing (AliasMetadata.indexRouting): writes through
+            # an alias that declares routing use it unless the item
+            # carries its own
+            alias_routing = (meta.alias_configs.get(index) or {}) \
+                .get("routing")
+            if alias_routing and not item.get("routing"):
+                item = {**item, "routing": alias_routing}
             routing_key = item.get("routing") or item["id"]
             shard = shard_id_for(routing_key, meta.number_of_shards)
             groups.setdefault((meta.name, shard), []).append((pos, item))

@@ -471,7 +471,28 @@ class TransportSearchAction:
             indices = self._resolve_indices(
                 index_expression, state,
                 ignore_throttled=body.get("ignore_throttled", True))
+            # filtered aliases (AliasMetadata.filter): applied PER
+            # TARGET INDEX like the reference — a shard of a filtered
+            # index sees its alias filter(s) OR'ed; shards of plain
+            # indices in the same expression stay unfiltered. An index
+            # reached BOTH through a filtered alias and by its own name
+            # stays unfiltered (the name grants full access).
+            filters_by_index: Dict[str, List[Dict[str, Any]]] = {}
+            direct = {p.strip() for p in
+                      (index_expression or "").split(",")}
+            for _alias, iname, filt in state.metadata.alias_filters(
+                    index_expression):
+                if iname in direct:
+                    continue
+                filters_by_index.setdefault(iname, []).append(filt)
             targets = self._shard_targets(indices, state)
+            for target in targets:
+                filters = filters_by_index.get(target["index"])
+                if filters:
+                    target["alias_filter"] = filters[0] \
+                        if len(filters) == 1 else \
+                        {"bool": {"should": filters,
+                                  "minimum_should_match": 1}}
             # coordinator-side inference rewrite: text_expansion model_text
             # becomes tokens ONCE per request (one batched device dispatch),
             # never per shard/segment — TextExpansionQueryBuilder.doRewrite
@@ -655,8 +676,14 @@ class TransportSearchAction:
         pending = {"n": len(targets)}
 
         def one(i: int, target, copy_idx: int = 0) -> None:
+            shard_body = body
+            if target.get("alias_filter") is not None:
+                # filtered alias: wrap for THIS shard's index only
+                shard_body = {**body, "query": {"bool": {
+                    "must": [body.get("query", {"match_all": {}})],
+                    "filter": [target["alias_filter"]]}}}
             req = {"index": target["index"], "shard": target["shard"],
-                   "body": body, "window": window}
+                   "body": shard_body, "window": window}
             if phase_state.get("task_id"):
                 req["task_id"] = phase_state["task_id"]
             if dfs_overrides:

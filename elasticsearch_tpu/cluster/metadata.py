@@ -27,6 +27,10 @@ class IndexMetadata:
     mappings: Mapping[str, Any] = field(default_factory=dict)
     settings: Mapping[str, Any] = field(default_factory=dict)
     aliases: Tuple[str, ...] = ()
+    # alias name -> properties: {"filter": query?, "routing": str?,
+    # "is_write_index": bool?} (AliasMetadata analog). aliases keeps the
+    # plain name tuple for cheap membership; configs carry the rest.
+    alias_configs: Mapping[str, Any] = field(default_factory=dict)
     # per-shard primary term, bumped on every primary failover
     # (IndexMetadata.java primaryTerms[]; carried by every replicated op)
     primary_terms: Tuple[int, ...] = ()
@@ -70,8 +74,14 @@ class IndexMetadata:
         merged = {**self.settings, **settings}
         return replace(self, settings=merged, version=self.version + 1)
 
-    def with_aliases(self, aliases: Tuple[str, ...]) -> "IndexMetadata":
-        return replace(self, aliases=tuple(aliases), version=self.version + 1)
+    def with_aliases(self, aliases: Tuple[str, ...],
+                     alias_configs: Optional[Mapping[str, Any]] = None
+                     ) -> "IndexMetadata":
+        configs = dict(alias_configs if alias_configs is not None
+                       else self.alias_configs)
+        configs = {k: v for k, v in configs.items() if k in aliases}
+        return replace(self, aliases=tuple(aliases),
+                       alias_configs=configs, version=self.version + 1)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -81,6 +91,7 @@ class IndexMetadata:
             "version": self.version, "state": self.state,
             "mappings": dict(self.mappings), "settings": dict(self.settings),
             "aliases": list(self.aliases),
+            "alias_configs": dict(self.alias_configs),
             "primary_terms": list(self.primary_terms),
         }
 
@@ -94,6 +105,7 @@ class IndexMetadata:
             mappings=dict(d.get("mappings", {})),
             settings=dict(d.get("settings", {})),
             aliases=tuple(d.get("aliases", ())),
+            alias_configs=dict(d.get("alias_configs", {})),
             primary_terms=tuple(d.get("primary_terms", ())))
 
 
@@ -119,16 +131,46 @@ class Metadata:
     version: int = 0
 
     def index(self, name: str) -> IndexMetadata:
-        # alias resolution: a name may be an alias for exactly one index
+        # alias resolution: a name may be an alias for exactly one index,
+        # or for several when exactly one carries is_write_index
+        # (AliasOrIndex.Alias.getWriteIndex semantics)
         if name in self.indices:
             return self.indices[name]
         matches = [im for im in self.indices.values() if name in im.aliases]
         if len(matches) == 1:
             return matches[0]
         if len(matches) > 1:
+            writers = [im for im in matches
+                       if (im.alias_configs.get(name) or {})
+                       .get("is_write_index")]
+            if len(writers) == 1:
+                return writers[0]
             raise IllegalArgumentError(
-                f"alias [{name}] has more than one index associated")
+                f"alias [{name}] has more than one index associated "
+                f"and no single is_write_index")
         raise IndexNotFoundError(name)
+
+    def alias_filters(self, expression: str) -> list:
+        """Query filters attached to aliases the expression reaches —
+        LITERALLY or via a wildcard part matching the ALIAS name (the
+        access path determines filtering; `_all`/bare wildcards over
+        index names do not route through aliases).
+        Returns [(alias, index, filter), ...]."""
+        import fnmatch as _fn
+        out = []
+        for part in (expression or "").split(","):
+            part = part.strip()
+            if not part or part in self.indices or part == "_all":
+                continue
+            for im in self.indices.values():
+                for alias in im.aliases:
+                    if alias == part or ("*" in part and
+                                         _fn.fnmatch(alias, part)):
+                        filt = (im.alias_configs.get(alias)
+                                or {}).get("filter")
+                        if filt is not None:
+                            out.append((alias, im.name, filt))
+        return out
 
     def has_index(self, name: str) -> bool:
         try:

@@ -580,7 +580,9 @@ class NodeClient:
                 err.status = status
                 on_done(result, err)
             else:
-                result["_index"] = index
+                # keep the CONCRETE index the bulk path resolved (an
+                # aliased write reports its write index, not the alias)
+                result.setdefault("_index", index)
                 result["_id"] = result.pop("id", item["id"])
                 on_done(result, None)
         self.node.bulk_action.execute([item], cb)

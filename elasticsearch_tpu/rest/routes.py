@@ -269,7 +269,8 @@ def build_controller(client: NodeClient) -> RestController:
         state = client.node._applied_state()
         meta = state.metadata.index(req.params["index"])
         done(200, {meta.name: {
-            "aliases": {a: {} for a in meta.aliases},
+            "aliases": {a: dict(meta.alias_configs.get(a, {}))
+                        for a in meta.aliases},
             "mappings": dict(meta.mappings),
             "settings": {"index": {
                 "number_of_shards": str(meta.number_of_shards),
@@ -804,7 +805,9 @@ def build_controller(client: NodeClient) -> RestController:
         out: Dict[str, Any] = {}
         for meta in state.metadata.indices.values():
             if meta.aliases:
-                out[meta.name] = {"aliases": {a: {} for a in meta.aliases}}
+                out[meta.name] = {"aliases": {
+                    a: dict(meta.alias_configs.get(a, {}))
+                    for a in meta.aliases}}
         done(200, out)
     r("GET", "/_alias", alias_get)
 

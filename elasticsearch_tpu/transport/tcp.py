@@ -282,6 +282,14 @@ class TcpTransport:
                 conn.settimeout(None)
                 with self._lock:
                     self._inbound.discard(raw)
+                    if self._closed:
+                        # close() ran mid-handshake: the wrapped socket
+                        # must not outlive the transport
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        return
                     self._inbound.add(conn)
             hs = _recv_frame(conn)
             if not hs or hs.get("t") != "hs":

@@ -91,3 +91,103 @@ def test_cat_surface(cluster, rest):
         assert isinstance(body, str), path
         if expect:
             assert expect in body, (path, body)
+
+
+def test_filtered_alias_and_write_index(cluster, rest):
+    s, _ = rest("PUT", "/events", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {"level": {"type": "keyword"}}}})
+    assert s == 200
+    cluster.ensure_green("events")
+    for i, level in enumerate(["error", "info", "error"]):
+        rest("PUT", f"/events/_doc/e{i}", {"level": level})
+    rest("POST", "/events/_refresh")
+    # filtered alias only sees matching docs
+    s, _ = rest("POST", "/_aliases", {"actions": [{"add": {
+        "index": "events", "alias": "errors",
+        "filter": {"term": {"level": "error"}}}}]})
+    assert s == 200
+    s, body = rest("POST", "/errors/_search",
+                   {"query": {"match_all": {}}})
+    assert s == 200 and body["hits"]["total"]["value"] == 2
+    levels = {h["_source"]["level"] for h in body["hits"]["hits"]}
+    assert levels == {"error"}
+    # the plain index still sees everything
+    s, body = rest("POST", "/events/_search",
+                   {"query": {"match_all": {}}})
+    assert body["hits"]["total"]["value"] == 3
+
+    # is_write_index steers writes on a multi-index alias
+    s, _ = rest("PUT", "/events2", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    cluster.ensure_green("events2")
+    s, _ = rest("POST", "/_aliases", {"actions": [
+        {"add": {"index": "events", "alias": "stream"}},
+        {"add": {"index": "events2", "alias": "stream",
+                 "is_write_index": True}}]})
+    assert s == 200
+    s, body = rest("PUT", "/stream/_doc/w1", {"level": "info"})
+    assert s in (200, 201)
+    assert body["_index"] == "events2"       # routed to the write index
+
+
+def test_alias_routing_add_replace_and_write_rollover(cluster, rest):
+    s, _ = rest("PUT", "/r1", {"settings": {
+        "number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"v": {"type": "keyword"}}}})
+    assert s == 200
+    cluster.ensure_green("r1")
+    # alias with routing: writes through it land on one shard
+    s, _ = rest("POST", "/_aliases", {"actions": [{"add": {
+        "index": "r1", "alias": "pinned", "routing": "zoneA"}}]})
+    assert s == 200
+    for i in range(4):
+        rest("PUT", f"/pinned/_doc/p{i}", {"v": str(i)})
+    rest("POST", "/r1/_refresh")
+    node = cluster.master()
+    from elasticsearch_tpu.utils.murmur3 import shard_id_for
+    want = shard_id_for("zoneA", 2)
+    import numpy as np
+    for nid, n in cluster.nodes.items():
+        try:
+            other = n.indices_service.shard("r1", 1 - want)
+            rdr = other.engine.acquire_reader()
+            assert sum(int(np.asarray(m).sum())
+                       for m in rdr.live_masks) == 0
+        except Exception:
+            pass
+    # re-add without props clears the old config
+    s, _ = rest("POST", "/_aliases", {"actions": [{"add": {
+        "index": "r1", "alias": "pinned"}}]})
+    assert s == 200
+    state = node._applied_state()
+    assert "pinned" not in state.metadata.index("r1").alias_configs
+    # GET index surfaces alias configs
+    s, _ = rest("POST", "/_aliases", {"actions": [{"add": {
+        "index": "r1", "alias": "filtered",
+        "filter": {"term": {"v": "1"}}}}]})
+    s, body = rest("GET", "/r1")
+    assert body["r1"]["aliases"]["filtered"]["filter"] == \
+        {"term": {"v": "1"}}
+
+    # rollover over a write alias moves only the flag
+    s, _ = rest("PUT", "/logs-000001", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    cluster.ensure_green("logs-000001")
+    s, _ = rest("POST", "/_aliases", {"actions": [{"add": {
+        "index": "logs-000001", "alias": "logs",
+        "is_write_index": True}}]})
+    assert s == 200
+    s, body = rest("POST", "/logs/_rollover", {})
+    assert s == 200, body
+    state = node._applied_state()
+    # both generations carry the alias; only the new one writes
+    assert "logs" in state.metadata.index("logs-000001").aliases
+    new_meta = state.metadata.index("logs-000002")
+    assert "logs" in new_meta.aliases
+    assert new_meta.alias_configs["logs"]["is_write_index"]
+    assert not state.metadata.indices["logs-000001"] \
+        .alias_configs.get("logs", {}).get("is_write_index")
+    # writes through the alias hit the new generation
+    s, body = rest("PUT", "/logs/_doc/n1", {"v": "x"})
+    assert body["_index"] == "logs-000002"
