@@ -34,6 +34,8 @@ from elasticsearch_tpu.utils.errors import (
 
 CREATE_INDEX = "indices:admin/create"
 DELETE_INDEX = "indices:admin/delete"
+OPEN_INDEX = "indices:admin/open"
+CLOSE_INDEX = "indices:admin/close"
 PUT_MAPPING = "indices:admin/mapping/put"
 UPDATE_SETTINGS = "indices:admin/settings/update"
 UPDATE_ALIASES = "indices:admin/aliases"
@@ -96,6 +98,8 @@ class MasterActions:
         for action, handler in [
             (CREATE_INDEX, self._on_create_index),
             (DELETE_INDEX, self._on_delete_index),
+            (OPEN_INDEX, self._on_open_index),
+            (CLOSE_INDEX, self._on_close_index),
             (PUT_MAPPING, self._on_put_mapping),
             (UPDATE_SETTINGS, self._on_update_settings),
             (UPDATE_ALIASES, self._on_update_aliases),
@@ -265,6 +269,30 @@ class MasterActions:
                 routing_table=routing)
             return self.allocation.reroute(new)
         return self._submit(f"update-settings [{name}]", update)
+
+    def _set_index_state(self, name: str, new_state: str) -> Deferred:
+        """open <-> close (MetadataIndexStateService analog): a closed
+        index keeps its shards and data but rejects reads and writes."""
+        from dataclasses import replace as _replace
+
+        def update(state: ClusterState) -> ClusterState:
+            from elasticsearch_tpu.cluster.metadata import (
+                resolve_index_expression,
+            )
+            metadata = state.metadata
+            for concrete in resolve_index_expression(name, metadata):
+                meta = metadata.indices[concrete]
+                if meta.state != new_state:
+                    metadata = metadata.update_index(_replace(
+                        meta, state=new_state, version=meta.version + 1))
+            return state.next_version(metadata=metadata)
+        return self._submit(f"{new_state}-index [{name}]", update)
+
+    def _on_open_index(self, req: Dict[str, Any], sender: str) -> Deferred:
+        return self._set_index_state(req["index"], "open")
+
+    def _on_close_index(self, req: Dict[str, Any], sender: str) -> Deferred:
+        return self._set_index_state(req["index"], "close")
 
     def _on_update_aliases(self, req: Dict[str, Any], sender: str
                            ) -> Deferred:

@@ -75,17 +75,27 @@ class TransportBulkAction:
         from elasticsearch_tpu.utils.errors import ClusterBlockError
         for pos, item in enumerate(items):
             name = item.get("index")
-            if name and "_ingest_error" not in item and \
-                    not item.get("_dropped") and \
-                    state.metadata.has_index(name) and \
-                    state.metadata.index(name).settings.get(
-                        "index.blocks.write"):
+            if not name or "_ingest_error" in item or \
+                    item.get("_dropped"):
+                continue
+            try:
+                meta = state.metadata.index(name)   # resolves aliases
+            except Exception:  # noqa: BLE001 — auto-create handles it
+                continue
+            block_err = None
+            if meta.state == "close":
+                block_err = ClusterBlockError(
+                    f"index [{name}] is closed "
+                    f"(index_closed_exception)")
+                block_err.status = 400
+            elif meta.settings.get("index.blocks.write"):
                 block_err = ClusterBlockError(
                     f"index [{name}] blocked by: "
                     f"[FORBIDDEN/8/index write (api)]")
                 # FORBIDDEN blocks are 403; the class default (503) is
                 # for no-master/not-recovered blocks
                 block_err.status = 403
+            if block_err is not None:
                 # copy before mutating: without pipelines the list holds
                 # the CALLER's dicts, which must not accrete error state
                 items[pos] = {**item, "_ingest_error": block_err}

@@ -40,9 +40,24 @@ class TransportGetAction:
     def execute(self, index: str, doc_id: str, on_done: DoneFn,
                 routing: Optional[str] = None,
                 realtime: bool = True, prefer_primary: bool = False) -> None:
+        state = self.state()
+        # a closed index rejects point reads too
+        # (IndexClosedException semantics)
+        try:
+            if state.metadata.index(index).state == "close":
+                from elasticsearch_tpu.utils.errors import (
+                    IllegalArgumentError,
+                )
+                err = IllegalArgumentError(
+                    f"closed index [{index}] cannot serve gets "
+                    f"(index_closed_exception)")
+                on_done(None, err)
+                return
+        except Exception:  # noqa: BLE001 — missing index 404s below
+            pass
         self._rr += 1
         routed_shard_request(
-            self.ts, self.state(), GET_SHARD, index, doc_id, on_done,
+            self.ts, state, GET_SHARD, index, doc_id, on_done,
             routing=routing, extra={"realtime": realtime},
             prefer_primary=realtime or prefer_primary, rotate=self._rr)
 

@@ -362,6 +362,30 @@ class TransportSearchAction:
         names = resolve_index_expression(expression, state.metadata)
         has_wildcard = (not expression or "*" in expression
                         or expression == "_all")
+        # closed indices: skipped by wildcard parts, a 400 when reached
+        # through an EXPLICIT part — even in a mixed expression
+        # (IndexClosedException semantics; same per-part discipline as
+        # the frozen filter below)
+        explicit_concrete: set = set()
+        for part in (expression or "").split(","):
+            part = part.strip()
+            if not part or "*" in part or part == "_all":
+                continue
+            try:
+                explicit_concrete.update(resolve_index_expression(
+                    part, state.metadata))
+            except Exception:  # noqa: BLE001 — unknown part
+                pass
+        open_names = []
+        for n in names:
+            if state.metadata.indices[n].state == "close":
+                if n in explicit_concrete or not has_wildcard:
+                    raise IllegalArgumentError(
+                        f"closed index [{n}] cannot be searched "
+                        f"(index_closed_exception)")
+                continue
+            open_names.append(n)
+        names = open_names
         if ignore_throttled and has_wildcard:
             from elasticsearch_tpu.xpack.searchable_snapshots import (
                 is_frozen,

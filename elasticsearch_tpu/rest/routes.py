@@ -678,6 +678,20 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/{index}/_search_shards", search_shards)
     r("POST", "/{index}/_search_shards", search_shards)
 
+    def open_index(req: RestRequest, done: DoneFn) -> None:
+        from elasticsearch_tpu.action.admin import OPEN_INDEX
+        client.node.master_client.execute(
+            OPEN_INDEX, {"index": req.params["index"]},
+            wrap_client_cb(done))
+    r("POST", "/{index}/_open", open_index)
+
+    def close_index(req: RestRequest, done: DoneFn) -> None:
+        from elasticsearch_tpu.action.admin import CLOSE_INDEX
+        client.node.master_client.execute(
+            CLOSE_INDEX, {"index": req.params["index"]},
+            wrap_client_cb(done))
+    r("POST", "/{index}/_close", close_index)
+
     # -- resize family (action/admin/indices/shrink) ----------------------
 
     def _resize(kind):

@@ -191,3 +191,49 @@ def test_alias_routing_add_replace_and_write_rollover(cluster, rest):
     # writes through the alias hit the new generation
     s, body = rest("PUT", "/logs/_doc/n1", {"v": "x"})
     assert body["_index"] == "logs-000002"
+
+
+def test_open_close_index(cluster, rest):
+    s, _ = rest("PUT", "/oc", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    assert s == 200
+    cluster.ensure_green("oc")
+    rest("PUT", "/oc/_doc/d1", {"v": 1})
+    rest("POST", "/oc/_refresh")
+    s, _ = rest("POST", "/oc/_close")
+    assert s == 200
+    # explicit search on a closed index: 400
+    s, body = rest("POST", "/oc/_search", {"query": {"match_all": {}}})
+    assert s == 400 and "closed" in body["error"]["reason"]
+    # wildcard searches skip it quietly
+    s, body = rest("POST", "/_all/_search", {"query": {"match_all": {}}})
+    assert s == 200
+    # writes rejected with the closed error
+    s, body = rest("PUT", "/oc/_doc/d2", {"v": 2})
+    assert s == 400
+    # reopen restores everything
+    s, _ = rest("POST", "/oc/_open")
+    assert s == 200
+    s, body = rest("POST", "/oc/_search", {"query": {"match_all": {}}})
+    assert s == 200 and body["hits"]["total"]["value"] == 1
+    s, _ = rest("PUT", "/oc/_doc/d2", {"v": 2})
+    assert s in (200, 201)
+
+
+def test_closed_index_edges(cluster, rest):
+    s, _ = rest("PUT", "/ce", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    cluster.ensure_green("ce")
+    rest("PUT", "/ce/_doc/d", {"v": 1})
+    rest("POST", "/ce/_refresh")
+    rest("POST", "/ce/_close")
+    # point GET rejected too
+    s, body = rest("GET", "/ce/_doc/d")
+    assert s == 400
+    # explicit name in a MIXED expression still 400s
+    s, _ = rest("PUT", "/other", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    cluster.ensure_yellow("other")
+    s, body = rest("POST", "/ce,oth*/_search",
+                   {"query": {"match_all": {}}})
+    assert s == 400 and "closed" in body["error"]["reason"]
