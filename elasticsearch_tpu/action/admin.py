@@ -285,6 +285,8 @@ class MasterActions:
                 if meta.state != new_state:
                     metadata = metadata.update_index(_replace(
                         meta, state=new_state, version=meta.version + 1))
+            if metadata is state.metadata:
+                return state      # no-op: don't publish a new version
             return state.next_version(metadata=metadata)
         return self._submit(f"{new_state}-index [{name}]", update)
 

@@ -273,9 +273,24 @@ class MiscReadActions:
                            extra: Dict[str, Any], on_done: DoneFn
                            ) -> None:
         from elasticsearch_tpu.action.document import routed_shard_request
+        state = self.node._applied_state()
+        # closed indices reject ALL point reads (termvectors/explain
+        # included — the search/get paths enforce the same)
+        try:
+            if state.metadata.index(index).state == "close":
+                from elasticsearch_tpu.utils.errors import (
+                    IllegalArgumentError,
+                )
+                err = IllegalArgumentError(
+                    f"closed index [{index}] cannot serve reads "
+                    f"(index_closed_exception)")
+                on_done(None, err)
+                return
+        except Exception:  # noqa: BLE001 — missing index 404s below
+            pass
         self._rr = getattr(self, "_rr", 0) + 1
         routed_shard_request(
-            self.node.transport_service, self.node._applied_state(),
+            self.node.transport_service, state,
             action, index, doc_id, on_done, routing=routing, extra=extra,
             rotate=self._rr)
 

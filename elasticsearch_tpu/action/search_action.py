@@ -360,22 +360,24 @@ class TransportSearchAction:
             resolve_index_expression,
         )
         names = resolve_index_expression(expression, state.metadata)
-        has_wildcard = (not expression or "*" in expression
-                        or expression == "_all")
-        # closed indices: skipped by wildcard parts, a 400 when reached
-        # through an EXPLICIT part — even in a mixed expression
-        # (IndexClosedException semantics; same per-part discipline as
-        # the frozen filter below)
+        # per-part discipline, computed ONCE and shared by the closed
+        # and frozen filters: a part is wildcard-like when it expands
+        # (*, _all, empty); explicit parts protect/indict their targets
+        parts = [p.strip() for p in (expression or "").split(",")]
+        has_wildcard = any(not p or "*" in p or p == "_all"
+                           for p in parts) or not expression
         explicit_concrete: set = set()
-        for part in (expression or "").split(","):
-            part = part.strip()
+        for part in parts:
             if not part or "*" in part or part == "_all":
                 continue
+            explicit_concrete.add(part)
             try:
                 explicit_concrete.update(resolve_index_expression(
                     part, state.metadata))
             except Exception:  # noqa: BLE001 — unknown part
                 pass
+        # closed indices: skipped by wildcard-like parts, a 400 when
+        # reached through an EXPLICIT part (IndexClosedException)
         open_names = []
         for n in names:
             if state.metadata.indices[n].state == "close":
@@ -391,20 +393,10 @@ class TransportSearchAction:
                 is_frozen,
             )
             # explicit parts protect their targets — including indices
-            # reached through an explicitly named ALIAS
-            explicit: set = set()
-            for part in (expression or "").split(","):
-                part = part.strip()
-                if not part or "*" in part or part == "_all":
-                    continue
-                explicit.add(part)
-                try:
-                    explicit.update(resolve_index_expression(
-                        part, state.metadata))
-                except Exception:  # noqa: BLE001 — unknown part: skip
-                    pass
+            # reached through an explicitly named ALIAS (shared
+            # explicit_concrete set computed above)
             names = [n for n in names
-                     if n in explicit or not is_frozen(state, n)]
+                     if n in explicit_concrete or not is_frozen(state, n)]
         return names
 
     def _shard_targets(self, indices: List[str], state: ClusterState

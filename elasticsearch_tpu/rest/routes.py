@@ -678,6 +678,42 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/{index}/_search_shards", search_shards)
     r("POST", "/{index}/_search_shards", search_shards)
 
+    def field_mapping(req: RestRequest, done: DoneFn) -> None:
+        """GET /{index}/_mapping/field/{field} — per-field mapping lookup
+        with wildcard support (TransportGetFieldMappingsAction analog)."""
+        import fnmatch as _fn
+        state = client.node._applied_state()
+        from elasticsearch_tpu.cluster.metadata import (
+            resolve_index_expression,
+        )
+        try:
+            names = resolve_index_expression(
+                req.params.get("index", "_all"), state.metadata)
+        except Exception as e:  # noqa: BLE001
+            done(404, {"error": {"type": "index_not_found_exception",
+                                 "reason": str(e)}})
+            return
+        patterns = req.params["field"].split(",")
+        out: Dict[str, Any] = {}
+        for name in names:
+            meta = state.metadata.indices[name]
+            from elasticsearch_tpu.mapping import MapperService
+            service = MapperService(dict(meta.mappings))
+            fields = {}
+            for fname in service.field_names():
+                if "#" in fname:
+                    continue
+                if any(_fn.fnmatch(fname, p) for p in patterns):
+                    mapper = service.mapper(fname)
+                    leaf = fname.rsplit(".", 1)[-1]
+                    fields[fname] = {
+                        "full_name": fname,
+                        "mapping": {leaf: mapper.to_mapping()}}
+            out[name] = {"mappings": fields}
+        done(200, out)
+    r("GET", "/{index}/_mapping/field/{field}", field_mapping)
+    r("GET", "/_mapping/field/{field}", field_mapping)
+
     def open_index(req: RestRequest, done: DoneFn) -> None:
         from elasticsearch_tpu.action.admin import OPEN_INDEX
         client.node.master_client.execute(

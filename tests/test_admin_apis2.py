@@ -237,3 +237,24 @@ def test_closed_index_edges(cluster, rest):
     s, body = rest("POST", "/ce,oth*/_search",
                    {"query": {"match_all": {}}})
     assert s == 400 and "closed" in body["error"]["reason"]
+
+
+def test_closed_index_termvectors_and_all_in_comma(cluster, rest):
+    s, _ = rest("PUT", "/tvx", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {"t": {"type": "text"}}}})
+    cluster.ensure_green("tvx")
+    rest("PUT", "/tvx/_doc/d", {"t": "hello"})
+    rest("POST", "/tvx/_refresh")
+    rest("POST", "/tvx/_close")
+    # termvectors/explain respect the close
+    s, _ = rest("GET", "/tvx/_termvectors/d")
+    assert s == 400
+    # _all inside a comma expression behaves like a wildcard: the closed
+    # index it reaches is skipped, not fatal
+    s, _ = rest("PUT", "/tv-open", {"settings": {
+        "number_of_shards": 1, "number_of_replicas": 0}})
+    cluster.ensure_yellow("tv-open")
+    s, body = rest("POST", "/tv-open,_all/_search",
+                   {"query": {"match_all": {}}})
+    assert s == 200
