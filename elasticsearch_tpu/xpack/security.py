@@ -23,6 +23,7 @@ from __future__ import annotations
 import base64
 import fnmatch
 import hashlib
+import hmac
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -54,7 +55,6 @@ def verify_password(password: str, entry: Dict[str, Any]) -> bool:
     digest = hashlib.pbkdf2_hmac(
         "sha256", password.encode("utf-8"), bytes.fromhex(entry["salt"]),
         PBKDF2_ITERATIONS)
-    import hmac
     return hmac.compare_digest(digest.hex(), entry["hash"])
 
 
@@ -66,7 +66,13 @@ def verify_password(password: str, entry: Dict[str, Any]) -> bool:
 READ_ENDPOINTS = {"_search", "_count", "_doc", "_source", "_mget",
                   "_termvectors", "_explain", "_msearch", "_rank_eval",
                   "_search_template", "_scripts", "_analyze",
-                  "_field_caps", "_validate", "_async_search"}
+                  "_field_caps", "_validate", "_async_search",
+                  # data-returning x-pack search APIs: read on both GET and
+                  # POST (the reference classifies these as read actions;
+                  # 'manage'/'monitor' here was an authz bypass for
+                  # monitor-only users)
+                  "_eql", "_graph", "_rollup_search", "_knn_search",
+                  "_terms_enum"}
 WRITE_ENDPOINTS = {"_doc", "_create", "_update", "_bulk", "_delete_by_query",
                    "_update_by_query", "_reindex", "_rollover"}
 MANAGE_ENDPOINTS = {"_settings", "_mapping", "_mappings", "_aliases",
@@ -97,6 +103,10 @@ def required_privilege(method: str, path: str
             return ("index", "read", "_sql_body")
         if first == "_security":
             return ("cluster", "manage_security", None)
+        if first == "_cat" and len(segs) >= 2 and segs[1] == "count":
+            # _cat/count serves per-index doc counts — an index READ in
+            # the reference, not a cluster monitor action
+            return ("index", "read", segs[2] if len(segs) > 2 else "*")
         if first in ("_bulk", "_reindex", "_mget", "_msearch", "_search"):
             # request-body APIs spanning indices: classified by verb
             if method == "GET" or first in ("_mget", "_msearch", "_search"):
@@ -212,7 +222,8 @@ class SecurityService:
         user = self._users().get(username)
         if user is None and username == "elastic":
             boot = self._settings().get("xpack.security.bootstrap_password")
-            if boot is not None and password == str(boot):
+            if boot is not None and hmac.compare_digest(
+                    password.encode("utf-8"), str(boot).encode("utf-8")):
                 return {"username": "elastic", "roles": ["superuser"]}
             return None
         if user is None:
@@ -453,7 +464,7 @@ class SecurityService:
     # filter applies these fail closed rather than leak hidden docs
     _DLS_BLOCKED_ALWAYS = ("_mget", "_msearch", "_termvectors",
                            "_explain", "_sql", "_knn_search",
-                           "_rank_eval", "_eql")
+                           "_rank_eval", "_eql", "_rollup_search")
     # doc APIs blocked only for READS — writes through them leak nothing
     _DLS_BLOCKED_READS = ("_doc", "_source")
 
@@ -473,6 +484,13 @@ class SecurityService:
                         out.extend(x if isinstance(x, str)
                                    else x.get("field", "")
                                    for x in v)
+                    elif k == "fields" and isinstance(v, dict):
+                        # highlight-style {field_name: options}: the KEYS
+                        # are field references (highlighting reads stored
+                        # source, a prime FLS exfiltration surface)
+                        out.extend(v.keys())
+                        for vv in v.values():
+                            walk(vv)
                     elif k == "sort":
                         entries = v if isinstance(v, list) else [v]
                         for e in entries:
@@ -488,6 +506,58 @@ class SecurityService:
         walk(node)
         return [f for f in out if f and not f.startswith("_")]
 
+    @staticmethod
+    def _query_fields(query_body: Any) -> Optional[List[str]]:
+        """Field names a request query reads, via the parsed DSL tree —
+        the FieldSubsetReader analog: a term/range query on an ungranted
+        field is a match oracle on its values, so FLS must see every
+        query-referenced field. Returns None when the query cannot be
+        parsed (caller fails CLOSED). query_string without explicit
+        fields searches all fields and reports the catch-all "*"."""
+        import dataclasses
+        from elasticsearch_tpu.search import dsl as _dsl
+        try:
+            tree = _dsl.parse_query(query_body)
+        except Exception:  # noqa: BLE001 — unparseable = unprovable
+            return None
+        out: List[str] = []
+
+        def walk(node: Any) -> None:
+            if isinstance(node, (_dsl.QueryString, _dsl.SimpleQueryString)) \
+                    and not (node.fields or getattr(node, "default_field",
+                                                    None)):
+                out.append("*")   # unscoped: searches every field
+            if isinstance(node, (_dsl.ScriptQuery, _dsl.ScriptScore)):
+                # scripts read doc values of ANY field — a complete FLS
+                # oracle; demand the catch-all grant
+                out.append("*")
+            if dataclasses.is_dataclass(node) and not isinstance(node, type):
+                for f in dataclasses.fields(node):
+                    v = getattr(node, f.name)
+                    if f.name in ("field", "default_field", "path",
+                                  "minimum_should_match_field") and \
+                            isinstance(v, str) and v:
+                        out.append(v)
+                    elif f.name == "fields" and isinstance(v, list):
+                        out.extend(x.partition("^")[0] for x in v
+                                   if isinstance(x, str))
+                    else:
+                        walk(v)
+            elif isinstance(node, list):
+                for x in node:
+                    walk(x)
+            elif isinstance(node, dict):
+                for k, v in node.items():
+                    if k == "field" and isinstance(v, str):
+                        out.append(v)   # raw sub-dicts (function_score etc.)
+                    elif k == "script":
+                        out.append("*")   # scripts read any field
+                        walk(v)
+                    else:
+                        walk(v)
+        walk(tree)
+        return [f for f in out if f and not f.startswith("_")]
+
     def _apply_dls(self, user: Dict[str, Any], request) -> None:
         """Wrap the request query with the user's role filters for the
         APIs that accept one; deny filtered users every read path the
@@ -498,6 +568,17 @@ class SecurityService:
         # id-based async-search get/delete is owner-checked by the
         # service and names no index — nothing to wrap or block
         if parts[0] == "_async_search":
+            return
+        if parts[0] == "_cat":
+            if len(parts) >= 2 and parts[1] == "count":
+                # _cat/count's internal search cannot be DLS-wrapped (no
+                # body); a filtered user would learn exact hidden-doc
+                # counts, so it fails closed
+                index = parts[2] if len(parts) > 2 else "_all"
+                if self.dls_filter(user, index) is not None:
+                    raise IllegalSecurityScope(
+                        "[_cat/count] cannot apply this user's "
+                        "document-level security; use _count")
             return
         api = next((p for p in parts if p.startswith("_")), None)
         if api is None:
@@ -531,6 +612,11 @@ class SecurityService:
                 f"[{api}] cannot apply this user's document/field-level "
                 f"security; use _search")
         body = dict(request.body or {})
+        # the user's ORIGINAL query, captured before any DLS wrap: FLS
+        # validates what the user asked to search, not the injected role
+        # filter (which legitimately references restricted fields)
+        user_query = body.get("query")
+        had_q_param = bool((request.query or {}).get("q"))
         if filt is not None:
             # a ?q= URI query must fold in BEFORE wrapping, or the
             # handler's later body["query"] = q overwrite would discard
@@ -548,9 +634,24 @@ class SecurityService:
             outside = {k: body[k] for k in
                        ("aggs", "aggregations", "sort",
                         "docvalue_fields", "stored_fields",
-                        "script_fields", "highlight", "collapse")
+                        "script_fields", "highlight", "collapse",
+                        # graph explore: vertices[].field values become
+                        # terms aggs over raw field values
+                        "vertices", "connections")
                        if k in body}
-            for ref in self._referenced_fields(outside):
+            refs = self._referenced_fields(outside)
+            if user_query is not None:
+                qf = self._query_fields(user_query)
+                if qf is None:
+                    raise IllegalSecurityScope(
+                        "cannot verify query fields under this user's "
+                        "field-level security")
+                refs = refs + qf
+            if had_q_param:
+                # ?q= lucene syntax may address any field — demand the
+                # catch-all grant
+                refs = refs + ["*"]
+            for ref in refs:
                 if not any(fnmatch.fnmatch(ref, g) for g in fields):
                     raise IllegalSecurityScope(
                         f"field [{ref}] is not granted by this user's "

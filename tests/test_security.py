@@ -470,3 +470,106 @@ def test_dls_bypass_vectors_fail_closed():
         assert check("GET", "/docs/_doc/w1")[0] == 403
     finally:
         c.stop()
+
+
+def test_r4_privilege_reclassification():
+    """Round-3 advisor: data-returning x-pack endpoints are index READ
+    actions on both verbs, and _cat/count is an index read."""
+    for method in ("GET", "POST"):
+        assert required_privilege(method, "/logs/_eql/search") == \
+            ("index", "read", "logs")
+        assert required_privilege(method, "/logs/_graph/explore") == \
+            ("index", "read", "logs")
+        assert required_privilege(method, "/logs/_rollup_search") == \
+            ("index", "read", "logs")
+    assert required_privilege("GET", "/_cat/count/logs") == \
+        ("index", "read", "logs")
+    assert required_privilege("GET", "/_cat/count") == \
+        ("index", "read", "*")
+
+
+def test_r4_fls_query_and_highlight_oracle_closed():
+    """FLS must validate query-clause field references (term/range on an
+    ungranted field is a value oracle) and highlight field keys (highlight
+    reads raw stored source)."""
+    c = InProcessCluster(n_nodes=1, seed=71)
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.create_index("docs", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {
+                "team": {"type": "keyword"},
+                "ssn": {"type": "keyword"}}}}, cb))
+        assert e is None
+        c.ensure_green("docs")
+        r, e = c.call(lambda cb: client.put_security_role("no-pii", {
+            "indices": [{"names": ["docs"], "privileges": ["read"],
+                         "field_security": {"grant": ["team"]}}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("viewer", {
+            "password": "viewpass", "roles": ["no-pii"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True}}, cb))
+        assert e is None
+
+        node = c.master()
+        auth = {"authorization": "Basic " + base64.b64encode(
+            b"viewer:viewpass").decode()}
+        from elasticsearch_tpu.rest.controller import RestRequest
+
+        def check(body, query=None):
+            return node.security.check(RestRequest(
+                method="POST", path="/docs/_search", query=dict(query or {}),
+                body=body, raw_body=b"", headers=dict(auth)))
+
+        # term query on ungranted field: denied (match oracle)
+        assert check({"query": {"term": {"ssn": "123-45-6789"}}})[0] == 403
+        # range probe too
+        assert check({"query": {"range": {"ssn": {"gte": "1"}}}})[0] == 403
+        # bool-nested reference is found
+        assert check({"query": {"bool": {"filter": [
+            {"term": {"ssn": "x"}}]}}})[0] == 403
+        # unscoped query_string may touch any field: denied
+        assert check({"query": {"query_string": {"query": "123"}}})[0] == 403
+        # ?q= under FLS: denied without a catch-all grant
+        assert check({"query": {"match_all": {}}}, query={"q": "x"})[0] == 403
+        # highlight on an ungranted field: denied (raw-source exfiltration)
+        assert check({"query": {"term": {"team": "red"}},
+                      "highlight": {"fields": {"ssn": {}}}})[0] == 403
+        # granted field everywhere: allowed
+        assert check({"query": {"term": {"team": "red"}},
+                      "highlight": {"fields": {"team": {}}}}) is None
+        # script queries read any doc value: denied without catch-all
+        assert check({"query": {"script": {"script": {
+            "source": "doc['ssn'].value == '123'"}}}})[0] == 403
+        # graph explore vertices on an ungranted field: denied
+        denied = node.security.check(RestRequest(
+            method="POST", path="/docs/_graph/explore", query={},
+            body={"query": {"match_all": {}},
+                  "vertices": [{"field": "ssn"}]},
+            raw_body=b"", headers=dict(auth)))
+        assert denied is not None and denied[0] == 403
+        # rollup_search cannot be wrapped: fails closed under FLS/DLS
+        denied = node.security.check(RestRequest(
+            method="POST", path="/docs/_rollup_search", query={},
+            body={"aggs": {}}, raw_body=b"", headers=dict(auth)))
+        assert denied is not None and denied[0] == 403
+
+        # monitor-only index grant no longer reads via EQL/graph/rollup
+        r, e = c.call(lambda cb: client.put_security_role("mon", {
+            "indices": [{"names": ["docs"],
+                         "privileges": ["monitor"]}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("watcher", {
+            "password": "watchpass", "roles": ["mon"]}, cb))
+        assert e is None
+        sec = node.security
+        mon_user = {"username": "watcher", "roles": ["mon"]}
+        assert not sec.authorize(mon_user, "GET", "/docs/_eql/search")
+        assert not sec.authorize(mon_user, "POST", "/docs/_graph/explore")
+        assert not sec.authorize(mon_user, "GET", "/docs/_rollup_search")
+        assert not sec.authorize(mon_user, "GET", "/_cat/count/docs")
+    finally:
+        c.stop()
