@@ -175,10 +175,14 @@ def cfg_bm25(np, jax, jnp, result):
     t_dense = timed(lambda: run_batch(queries[:batch], False), 4, block)
     dense_qps = 4 * batch / t_dense
 
-    # single-query latency percentiles through the pruned path
+    # single-query latency percentiles through the pruned path.
+    # Warm pass first: each distinct (n_q=1, FB-rung) shape compiles once;
+    # the measured pass then reflects steady-state serving latency, not
+    # one-time XLA compiles (r3's p99 was 33x p50 purely from compile
+    # churn on first-seen shapes).
+    for q in queries[64:192]:
+        block(run_batch([q], True))
     lats = []
-    run_batch([queries[0]], True)
-    block(run_batch([queries[1]], True))
     for q in queries[64:192]:
         t0 = time.perf_counter()
         block(run_batch([q], True))
@@ -265,7 +269,9 @@ def cfg_knn(np, jax, jnp, result):
 def cfg_ivf(np, jax, jnp, result):
     from elasticsearch_tpu.ops.ivf import IVFIndex
 
-    n_docs, dims, n_q = scaled(1 << 18), 960, 128
+    # full scale = the GIST1M envelope (1M x 960 f32 = 3.7GB, HBM-resident
+    # on one chip); CPU fallback shrinks 32x to keep the oracle tractable
+    n_docs, dims, n_q = scaled(1 << 20, factor=32), 960, 128
     n_clusters = 1024
     rng = np.random.default_rng(SEED)
     means = rng.standard_normal((n_clusters, dims)).astype(np.float32)
@@ -296,7 +302,7 @@ def cfg_ivf(np, jax, jnp, result):
         t = timed(lambda: index.search_device(q_dev, K, nprobe=nprobe),
                   5, block)
         qps = 5 * n_q / t
-        if recall >= 0.95:
+        if recall >= 0.96:   # BASELINE bar is 0.95; take it with margin
             break
     result["configs"]["ivf"] = {
         "qps": round(float(qps), 2),

@@ -571,9 +571,10 @@ class TransportSearchAction:
         if len(indices) != 1:
             return False
         from elasticsearch_tpu.parallel.mesh_plane import mesh_eligible
-        field = mesh_eligible(body)
-        if field is None or not self.mesh_plane.available:
+        spec = mesh_eligible(body)
+        if spec is None or not self.mesh_plane.available:
             return False
+        field = spec["field"]
         index = indices[0]
         shards: Dict[int, Any] = {}
         for target in targets:
@@ -584,11 +585,27 @@ class TransportSearchAction:
                 index, target["shard"])
         try:
             mappers = self.indices.index_service(index).mapper_service
-            if mappers.field_type(field) not in ("text",
-                                                 "search_as_you_type"):
+            kind = spec["kind"]
+            if kind == "text":
+                if mappers.field_type(field) not in ("text",
+                                                     "search_as_you_type"):
+                    return False
+                hits = self.mesh_plane.search_text(
+                    index, field, shards, body, mappers,
+                    clauses=spec["clauses"])
+            elif kind == "knn":
+                if mappers.field_type(field) != "dense_vector":
+                    return False
+                hits = self.mesh_plane.search_knn(index, field, shards,
+                                                  body, spec["query"])
+            elif kind == "sparse":
+                if mappers.field_type(field) not in ("rank_features",
+                                                     "rank_feature"):
+                    return False
+                hits = self.mesh_plane.search_sparse(index, field, shards,
+                                                     body, spec["query"])
+            else:
                 return False
-            hits = self.mesh_plane.search_text(index, field, shards, body,
-                                               mappers)
         except Exception:  # noqa: BLE001 — RPC path reports real errors
             return False
         if hits is None:
@@ -788,6 +805,14 @@ class TransportSearchAction:
                              else max(max_score, result["max_score"]))
             for doc in result["docs"]:
                 entries.append((i, doc))
+        # the coordinator re-clips the summed total at the request's
+        # threshold (SearchPhaseController's TotalHits merge): each shard
+        # counts up to the limit independently, so the raw sum can reach
+        # n_shards * limit
+        tth = body.get("track_total_hits", 10_000)
+        if tth is not True and tth is not False and tth and total > int(tth):
+            total = int(tth)
+            relation = "gte"
 
         if sort_specified:
             from elasticsearch_tpu.search.phase import _cmp_values

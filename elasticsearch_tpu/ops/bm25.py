@@ -100,11 +100,84 @@ def bm25_topk_batch(block_docs, block_tfs,
 # path to establish the top-k score floor (theta)
 P1_BUCKET = 32
 
-# per-dispatch ceiling on Q x qb_pad: each device temp is
-# Q*qb*BLOCK*4 bytes ([Q, QB, 128] f32 gathers), and the program holds
-# ~4 of them live — 4M cells = ~2GB/temp, safely inside a 16G HBM chip.
+# per-dispatch ceiling on the FLAT block count: each device temp is
+# FB*BLOCK*4 bytes ([FB, 128] f32 gathers), and the program holds ~4 of
+# them live — 4M cells = ~2GB/temp, safely inside a 16G HBM chip.
 # Larger batches split into query chunks (one compile per chunk shape).
 MAX_BATCH_CELLS = 4_000_000
+
+
+@partial(jax.jit,
+         static_argnames=("n_docs_pad", "n_q", "k", "k1", "b", "counted"))
+def _bm25_flat_kernel(block_docs, block_tfs,
+                      flat_idx,    # [FB] int32 block gather ids (0 pad)
+                      flat_w,      # [FB] f32 idf*boost (0 pad)
+                      flat_q,      # [FB] int32 query id (0 pad)
+                      doc_lens, avgdl, live,
+                      n_docs_pad: int, n_q: int, k: int,
+                      k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+                      counted: bool = False):
+    """Flat batched BM25 + top-k: the whole batch's blocks in ONE gather +
+    scatter-add, each block tagged with its query id.
+
+    This replaces the padded [Q, QB] layout whose per-query gather lists
+    all padded to the LARGEST plan in the batch — on zipfian query mixes
+    that wasted >10x the gather/scatter work (r3 bench: 1,048,576 padded
+    cells for 79,743 real survivor blocks). Here device work is
+    proportional to the batch's ACTUAL block count, padded only up to one
+    pow-ladder bucket.
+
+    With ``counted`` the kernel also returns hits[n_q] = #docs with
+    score > 0, read off the score plane it already computed. The count is
+    EXACT for the blocks gathered: unpruned dispatches count all hits;
+    pruned dispatches yield a LOWER bound (dropped blocks aren't
+    observed) — the counts-then-skip collector
+    (TopDocsCollectorContext.java:215) uses it to prove
+    'total >= track_total_hits' without a dense pass."""
+    docs = block_docs[flat_idx]             # [FB, BLOCK]
+    tfs = block_tfs[flat_idx]               # [FB, BLOCK]
+    valid = docs >= 0
+    safe = jnp.where(valid, docs, 0)
+    dl = doc_lens[safe]
+    norm = k1 * (1.0 - b + b * dl / avgdl)
+    contrib = flat_w[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+    contrib = jnp.where(valid, contrib, 0.0)
+    # scatter into a [n_q, n_docs_pad] score plane via flattened targets
+    tgt = flat_q[:, None] * n_docs_pad + safe
+    scores = jnp.zeros((n_q * n_docs_pad,), jnp.float32)
+    scores = scores.at[tgt.reshape(-1)].add(contrib.reshape(-1),
+                                            mode="drop")
+    scores = scores.reshape(n_q, n_docs_pad)
+    matched = live[None, :] & (scores > 0.0)
+    scores = jnp.where(matched, scores, -jnp.inf)
+    s, d = jax.lax.top_k(scores, k)
+    if counted:
+        return s, d, jnp.sum(matched, axis=1, dtype=jnp.int32)
+    return s, d
+
+
+def bm25_topk_flat(*args, **kw):
+    return _bm25_flat_kernel(*args, **kw, counted=False)
+
+
+def bm25_topk_flat_counted(*args, **kw):
+    return _bm25_flat_kernel(*args, **kw, counted=True)
+
+
+def flatten_plans(plans, fb_pad: int):
+    """Concatenate per-query plans into flat (idx, w, qid) arrays of
+    length fb_pad (block 0 / weight 0 / query 0 as padding)."""
+    idx = np.zeros(fb_pad, np.int32)
+    w = np.zeros(fb_pad, np.float32)
+    qid = np.zeros(fb_pad, np.int32)
+    off = 0
+    for i, p in enumerate(plans):
+        n = p.n_blocks
+        idx[off : off + n] = p.idx
+        w[off : off + n] = p.w
+        qid[off : off + n] = i
+        off += n
+    return idx, w, qid
 
 
 def qb_bucket(n: int, minimum: int = 32) -> int:
@@ -211,6 +284,8 @@ class TermCellIndex:
     compressed to touched cells with a sparse table for O(1) range-max.
     Query-independent: multiply by idf*boost at query time."""
 
+    PAIR_CACHE_CAP = 8192
+
     def __init__(self, block_docs: np.ndarray, block_tfs: np.ndarray,
                  doc_lens: np.ndarray, avgdl: float,
                  k1: float = DEFAULT_K1, b: float = DEFAULT_B):
@@ -221,6 +296,8 @@ class TermCellIndex:
         self.k1 = k1
         self.b = b
         self._cache: dict = {}
+        self._range_cache: dict = {}
+        self._pair_cache: dict = {}
 
     def term_cells(self, start: int, count: int):
         """(touched cells ascending [int64], RangeMax over their impacts)."""
@@ -243,9 +320,44 @@ class TermCellIndex:
         self._cache[start] = got
         return got
 
+    def term_cell_ranges(self, start: int, count: int):
+        """(c_lo, c_hi) cell range per block of the term at ``start``."""
+        got = self._range_cache.get(start)
+        if got is None:
+            blk = self.block_docs[start : start + count]
+            mins = np.maximum(blk[:, 0], 0)
+            maxs = np.maximum(blk.max(axis=1), 0)
+            got = (mins // WAND_GRID, maxs // WAND_GRID)
+            self._range_cache[start] = got
+        return got
+
+    def pair_bound(self, start_i: int, count_i: int,
+                   start_j: int, count_j: int) -> np.ndarray:
+        """Unweighted max impact of term_j's actual postings within each
+        of term_i's block doc-ranges (len count_i). Cached per
+        (start_i, start_j): zipfian query mixes repeat frequent-term pairs
+        constantly, so the per-pair range queries — the dominant host
+        planning cost — amortize across the whole query stream."""
+        key = (start_i, start_j)
+        got = self._pair_cache.get(key)
+        if got is not None:
+            return got
+        c_lo, c_hi = self.term_cell_ranges(start_i, count_i)
+        cells_j, table_j = self.term_cells(start_j, count_j)
+        lo = np.searchsorted(cells_j, c_lo, side="left")
+        hi = np.searchsorted(cells_j, c_hi, side="right") - 1
+        has = hi >= lo
+        out = np.zeros(count_i, np.float64)
+        if has.any():
+            out[has] = table_j.query(lo[has], hi[has])
+        while len(self._pair_cache) >= self.PAIR_CACHE_CAP:
+            self._pair_cache.pop(next(iter(self._pair_cache)))
+        self._pair_cache[key] = out
+        return out
+
 
 def build_query_plan(terms_with_weights, term_blocks_fn, block_max_impact,
-                     block_min_doc, block_max_doc,
+                     block_min_doc=None, block_max_doc=None,
                      cell_index: Optional[TermCellIndex] = None,
                      k1: float = DEFAULT_K1) -> QueryPlan:
     """Shared host prep for the pruned BM25 path.
@@ -253,24 +365,23 @@ def build_query_plan(terms_with_weights, term_blocks_fn, block_max_impact,
     terms_with_weights: [(term, idf*boost)];
     term_blocks_fn(term) -> (start, count) into the block arrays;
     block_max_impact: f32 [n_blocks] (PostingsField.block_max_impact);
-    block_min_doc/block_max_doc: int32 [n_blocks] doc range per block.
+    block_min_doc/block_max_doc are vestigial (kept for call-site
+    compatibility) — per-block doc ranges now come from the cell_index's
+    own cached tables.
 
     other_ub for a block is the sum, over the query's OTHER terms, of that
     term's max possible contribution among its actual postings within the
     block's doc range (via cell_index) — the aligned block-max WAND bound.
     Cell granularity only loosens the bound (still sound). Without a
     cell_index the bound falls back to the terms' global maxima."""
-    per_term = []     # (start, count, weight, bounds, cell_lo, cell_hi)
+    per_term = []     # (start, count, weight, bounds)
     for term, weight in terms_with_weights:
         start, count = term_blocks_fn(term)
         if count == 0:
             continue
         impacts = block_max_impact[start : start + count]
         bounds = weight * (k1 + 1.0) * impacts.astype(np.float64)
-        mins = np.maximum(block_min_doc[start : start + count], 0)
-        maxs = np.maximum(block_max_doc[start : start + count], 0)
-        per_term.append((start, count, weight, bounds,
-                         mins // WAND_GRID, maxs // WAND_GRID))
+        per_term.append((start, count, weight, bounds))
     if not per_term:
         return QueryPlan([], [], [], [])
 
@@ -278,26 +389,19 @@ def build_query_plan(terms_with_weights, term_blocks_fn, block_max_impact,
     w_parts = []
     ub_parts = []
     other_parts = []
-    for t_i, (start, count, weight, bounds, c_lo, c_hi) in enumerate(per_term):
+    for t_i, (start, count, weight, bounds) in enumerate(per_term):
         idx_parts.append(np.arange(start, start + count, dtype=np.int32))
         w_parts.append(np.full(count, weight, np.float32))
         ub_parts.append(bounds)
         o = np.zeros(count, np.float64)
-        for t_j, (s_j, cnt_j, w_j, bounds_j, _lo, _hi) in enumerate(per_term):
+        for t_j, (s_j, cnt_j, w_j, bounds_j) in enumerate(per_term):
             if t_j == t_i:
                 continue
             if cell_index is None:
                 o += float(bounds_j.max())
                 continue
-            cells_j, table_j = cell_index.term_cells(s_j, cnt_j)
-            lo = np.searchsorted(cells_j, c_lo, side="left")
-            hi = np.searchsorted(cells_j, c_hi, side="right") - 1
-            has = hi >= lo
-            if has.any():
-                contrib = np.zeros(count, np.float64)
-                contrib[has] = table_j.query(lo[has], hi[has]) \
-                    * (w_j * (k1 + 1.0))
-                o += contrib
+            o += cell_index.pair_bound(start, count, s_j, cnt_j) \
+                * (w_j * (k1 + 1.0))
         other_parts.append(o)
     return QueryPlan(np.concatenate(idx_parts), np.concatenate(w_parts),
                      np.concatenate(ub_parts), np.concatenate(other_parts))
@@ -329,9 +433,16 @@ class Bm25Executor:
 
     def query_weights(self, terms, boost: float = 1.0, df_override=None):
         """(term, idf*boost) pairs; df_override maps term -> corpus-wide df
-        (the DFS-phase analog). Falls back to segment-local df per term."""
+        (the DFS-phase analog). Falls back to segment-local df per term.
+        ``terms`` entries may be plain strings or (term, per_term_boost)
+        pairs — the latter carries bool/should per-clause boosts into the
+        WAND path."""
         out = []
         for t in terms:
+            tb = boost
+            if isinstance(t, tuple):
+                t, clause_boost = t
+                tb = boost * float(clause_boost)
             tid = self.host.terms.get(t)
             df = None
             if df_override is not None:
@@ -340,7 +451,7 @@ class Bm25Executor:
                 df = int(self.host.doc_freq[tid]) if tid is not None else 0
             if df <= 0 or tid is None:
                 continue  # term absent from this segment: no blocks to score
-            out.append((t, idf(self.doc_count, df) * boost))
+            out.append((t, idf(self.doc_count, df) * tb))
         return out
 
     def _avgdl(self, avgdl_override=None) -> float:
@@ -380,7 +491,8 @@ class Bm25Executor:
     def top_k_batch(self, queries, live: jnp.ndarray, k: int,
                     boost: float = 1.0, df_override=None,
                     k1: float = DEFAULT_K1, b: float = DEFAULT_B,
-                    prune: bool = True, avgdl_override=None):
+                    prune: bool = True, avgdl_override=None,
+                    count_hits: bool = False):
         """Batched, block-max-pruned BM25 over Q queries (each a term list).
 
         Two phases, each ONE device dispatch for the whole batch:
@@ -392,16 +504,32 @@ class Bm25Executor:
              blocks never get gathered — this is where the HBM-traffic
              saving is (TopDocsCollectorContext.java:215's block-max WAND
              early termination, re-expressed as static-shape phases).
-        Returns (scores [Q, k], doc ids [Q, k]); also records
+        Returns (scores [Q, k], doc ids [Q, k]) — plus hits [Q] when
+        ``count_hits`` — and records
         last_prune_stats = (blocks_total, blocks_scored)."""
         avgdl = self._avgdl(avgdl_override)
+        plans = self.build_plans(queries, boost, df_override, k1, b, avgdl)
+        total_blocks = sum(p.n_blocks for p in plans)
+        max_blocks = max((p.n_blocks for p in plans), default=1)
+        if not prune or max_blocks <= P1_BUCKET:
+            # every block is gathered — counts (if asked) are EXACT
+            self.last_prune_stats = (total_blocks, total_blocks)
+            self.last_hits_exact = True
+            return self._dispatch_flat(plans, live, k, k1, b, avgdl,
+                                       counted=count_hits)
+        p1 = [p.top_by_ub(P1_BUCKET) for p in plans]
+        s1, _ = self._dispatch_flat(p1, live, k, k1, b, avgdl)
+        theta = np.asarray(s1)[:, k - 1]          # -inf when < k matches
+        return self.finish_pruned(plans, theta, live, k, k1, b, avgdl,
+                                  count_hits)
+
+    def build_plans(self, queries, boost: float = 1.0, df_override=None,
+                    k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+                    avgdl: Optional[float] = None):
+        """Host planning for a batch: one WAND block plan per query."""
+        if avgdl is None:
+            avgdl = self._avgdl(None)
         hp = self.host
-        # per-block doc ranges: avgdl-independent, computed once
-        ranges = getattr(self, "_block_ranges", None)
-        if ranges is None:
-            ranges = (hp.block_docs[:, 0], hp.block_docs.max(axis=1))
-            self._block_ranges = ranges
-        bmin, bmax = ranges
         # per-term cell index for the aligned WAND bound (within a term,
         # blocks are doc-sorted; entry 0 of every block is always valid).
         # Keyed by (k1, b, avgdl) in a small FIFO-bounded dict so DFS
@@ -422,52 +550,93 @@ class Bm25Executor:
             tw = self.query_weights(terms, boost, df_override)
             plans.append(build_query_plan(
                 tw, self.host.term_blocks,
-                self.host.block_max_impact(k1, b, avgdl), bmin, bmax,
-                cell_index, k1=k1))
-        total_blocks = sum(p.n_blocks for p in plans)
-        args = (self.dev.block_docs, self.dev.block_tfs)
-        tail = (self.dev.doc_lens, jnp.float32(avgdl), live,
-                self.dev.n_docs_pad, k)
-        qb_pad = qb_bucket(max((p.n_blocks for p in plans), default=1))
-        if not prune or qb_pad <= P1_BUCKET:
-            self.last_prune_stats = (total_blocks, total_blocks)
-            return self._dispatch_chunked(plans, args, tail, k1, b)
+                self.host.block_max_impact(k1, b, avgdl),
+                cell_index=cell_index, k1=k1))
+        return plans
+
+    def phase1(self, plans, live: jnp.ndarray, k: int,
+               k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+               avgdl: Optional[float] = None):
+        """Dispatch phase 1 (top-ub blocks) and return the DEVICE scores
+        [Q, k] without syncing — a multi-segment shard launches every
+        segment's phase 1 before blocking once for all thetas."""
+        if avgdl is None:
+            avgdl = self._avgdl(None)
         p1 = [p.top_by_ub(P1_BUCKET) for p in plans]
-        idx1, w1 = pad_plans(p1, P1_BUCKET)
-        s1, _ = bm25_topk_batch(*args, jnp.asarray(idx1), jnp.asarray(w1),
-                                *tail, k1=k1, b=b)
-        theta = np.asarray(s1)[:, k - 1]          # -inf when < k matches
+        s1, _ = self._dispatch_flat(p1, live, k, k1, b, avgdl)
+        return s1
+
+    def finish_pruned(self, plans, theta, live: jnp.ndarray, k: int,
+                      k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+                      avgdl: Optional[float] = None,
+                      count_hits: bool = False):
+        """Phase 2: drop blocks whose WAND bound misses theta (one theta
+        per query — possibly a shard-global one tighter than this
+        segment's own) and score the survivors exactly."""
+        if avgdl is None:
+            avgdl = self._avgdl(None)
+        total_blocks = sum(p.n_blocks for p in plans)
         p2 = [p.survivors(float(t)) for p, t in zip(plans, theta)]
         scored = sum(p.n_blocks for p in p2)
-        p1_cost = sum(p.n_blocks for p in p1)
-        self.last_prune_stats = (total_blocks, scored + p1_cost)
-        return self._dispatch_chunked(p2, args, tail, k1, b)
+        p1_cost = sum(min(p.n_blocks, P1_BUCKET) for p in plans)
+        self.last_prune_stats = (total_blocks,
+                                 min(scored + p1_cost, total_blocks))
+        # pruned counts observe only survivor blocks: a LOWER bound
+        self.last_hits_exact = scored >= total_blocks
+        return self._dispatch_flat(p2, live, k, k1, b, avgdl,
+                                   counted=count_hits)
 
-    def _dispatch_chunked(self, plans, args, tail, k1, b):
-        """Dispatch the batched program in query chunks bounded by
-        MAX_BATCH_CELLS so gather temps never exceed HBM. Chunks use one
-        fixed Q (padded with empty plans) so each qb rung compiles one
-        program shape, not one per remainder size."""
-        qb_pad = qb_bucket(max((p.n_blocks for p in plans), default=1))
-        q_max = max(1, MAX_BATCH_CELLS // qb_pad)
-        if len(plans) <= q_max:
-            idx, w = pad_plans(plans, qb_pad)
-            return bm25_topk_batch(*args, jnp.asarray(idx), jnp.asarray(w),
-                                   *tail, k1=k1, b=b)
-        empty = QueryPlan([], [], [], [])
-        out_s = []
-        out_d = []
-        for i in range(0, len(plans), q_max):
-            chunk = plans[i : i + q_max]
+    # per-dispatch ceiling on the query dimension: the score plane is
+    # n_q * n_docs_pad f32 — 64 queries over a 16M-doc pad is 4GB, so
+    # bigger batches split (and phase-1 theta syncs once per chunk)
+    MAX_CHUNK_Q = 64
+
+    def _dispatch_flat(self, plans, live, k, k1, b, avgdl, counted=False):
+        """Flat-dispatch the batch: device work scales with the ACTUAL
+        total block count (one pow-ladder bucket of padding), never with
+        Q x max-plan as the padded layout did. Chunks bound both the
+        gather temp (MAX_BATCH_CELLS) and the score plane (MAX_CHUNK_Q);
+        n_q pads to a pow2 bucket so shapes stay bucketed."""
+        args = (self.dev.block_docs, self.dev.block_tfs)
+        chunks: list = []
+        cur: list = []
+        cells = 0
+        for p in plans:
+            nb = max(p.n_blocks, 1)
+            if cur and (len(cur) >= self.MAX_CHUNK_Q
+                        or cells + nb > MAX_BATCH_CELLS):
+                chunks.append(cur)
+                cur, cells = [], 0
+            cur.append(p)
+            cells += nb
+        if cur:
+            chunks.append(cur)
+        kern = bm25_topk_flat_counted if counted else bm25_topk_flat
+        out_s, out_d, out_h = [], [], []
+        for chunk in chunks:
             n_real = len(chunk)
-            if n_real < q_max:
-                chunk = chunk + [empty] * (q_max - n_real)
-            # chunk-local bucket: a chunk of small plans skips the big rung
-            qb_c = qb_bucket(max((p.n_blocks for p in chunk), default=1))
-            idx, w = pad_plans(chunk, qb_c)
-            s, d = bm25_topk_batch(*args, jnp.asarray(idx), jnp.asarray(w),
-                                   *tail, k1=k1, b=b)
+            n_q = next_pow2(n_real, minimum=1)
+            fb = qb_bucket(max(sum(p.n_blocks for p in chunk), 1))
+            idx, w, qid = flatten_plans(chunk, fb)
+            got = kern(
+                *args, jnp.asarray(idx), jnp.asarray(w), jnp.asarray(qid),
+                self.dev.doc_lens, jnp.float32(avgdl), live,
+                self.dev.n_docs_pad, n_q, k, k1=k1, b=b)
+            if len(chunks) == 1:
+                if counted:
+                    s, d, h = got
+                    return s[:n_real], d[:n_real], np.asarray(h)[:n_real]
+                s, d = got
+                return s[:n_real], d[:n_real]
+            if counted:
+                s, d, h = got
+                out_h.append(np.asarray(h)[:n_real])
+            else:
+                s, d = got
             out_s.append(np.asarray(s)[:n_real])
             out_d.append(np.asarray(d)[:n_real])
-        return (jnp.asarray(np.concatenate(out_s)),
-                jnp.asarray(np.concatenate(out_d)))
+        s = jnp.asarray(np.concatenate(out_s))
+        d = jnp.asarray(np.concatenate(out_d))
+        if counted:
+            return s, d, np.concatenate(out_h)
+        return s, d

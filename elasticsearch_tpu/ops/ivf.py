@@ -43,6 +43,31 @@ def _assign(x: jnp.ndarray, centroids: jnp.ndarray, nlist: int
     return jnp.argmin(c2[None, :] - 2.0 * dots, axis=1).astype(jnp.int32)
 
 
+# rows per assignment dispatch: the [chunk, nlist] distance plane at a
+# GIST1M-class build (nlist=4000) is 2GB at 128K rows — an unchunked
+# 1M-row assign would materialize 16GB and OOM the chip
+ASSIGN_CHUNK = 1 << 17
+
+
+def assign_chunked(x: jnp.ndarray, centroids: jnp.ndarray, nlist: int
+                   ) -> jnp.ndarray:
+    """_assign in fixed-size row chunks (tail zero-padded so every
+    dispatch reuses one compiled shape)."""
+    n = x.shape[0]
+    if n <= ASSIGN_CHUNK:
+        return _assign(x, centroids, nlist)
+    outs = []
+    for i in range(0, n, ASSIGN_CHUNK):
+        chunk = x[i : i + ASSIGN_CHUNK]
+        short = ASSIGN_CHUNK - chunk.shape[0]
+        if short > 0:
+            chunk = jnp.pad(chunk, ((0, short), (0, 0)))
+            outs.append(_assign(chunk, centroids, nlist)[:-short])
+        else:
+            outs.append(_assign(chunk, centroids, nlist))
+    return jnp.concatenate(outs)
+
+
 @partial(jax.jit, static_argnames=("nlist",))
 def _update(x: jnp.ndarray, assign: jnp.ndarray, centroids: jnp.ndarray,
             nlist: int) -> jnp.ndarray:
@@ -92,7 +117,7 @@ def kmeans(vectors: np.ndarray, nlist: int, iters: int = 10,
                              jnp.asarray(rng.integers(len(sample))),
                              nlist)
     for _ in range(iters):
-        c = _update(x, _assign(x, c, nlist), c, nlist)
+        c = _update(x, assign_chunked(x, c, nlist), c, nlist)
     return np.asarray(c)
 
 
@@ -131,8 +156,8 @@ class IVFIndex:
         nlist = max(1, min(nlist, n))
         vectors = np.asarray(vectors, np.float32)
         cents = kmeans(vectors, nlist, iters=iters, seed=seed)
-        assign = np.asarray(_assign(jnp.asarray(vectors),
-                                    jnp.asarray(cents), nlist))
+        assign = np.asarray(assign_chunked(jnp.asarray(vectors),
+                                           jnp.asarray(cents), nlist))
         cap = max(1, int(np.ceil(n / nlist * slack)))
         # balanced packing: overflow spills to the next-nearest centroid
         order = np.argsort(assign, kind="stable")

@@ -28,13 +28,22 @@ from elasticsearch_tpu.search import dsl
 __all__ = ["MeshDataPlane", "mesh_eligible"]
 
 
-def mesh_eligible(body: Dict[str, Any]) -> Optional[str]:
-    """Return the match field if the request can run as one mesh program.
+def mesh_eligible(body: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Describe how the request can run as one mesh program, or None.
 
-    Mirrors choose_collector_context's WAND conditions (pure score-sorted
-    top-k text query, totals disabled) plus mesh-specific ones (no
-    highlight-independent phases that need per-shard readers during query).
-    """
+    Returns {"kind": "text", "field", "clauses"} for disjunctive text
+    queries (Match, or bool of only-should Matches on one field — the
+    same shapes the shard WAND collector serves),
+    {"kind": "knn", "field", "query"} for unfiltered kNN queries, or
+    {"kind": "sparse", "field", "query"} for text_expansion /
+    rank-features queries. Structural conditions mirror
+    choose_collector_context plus mesh-specific ones (no phases needing
+    per-shard readers during query).
+
+    Totals: text requires totals disabled (the one-program path has no
+    counts-then-skip kernel yet); knn/sparse are top-k-exact by
+    construction (total = k, relation eq), so any finite threshold is
+    servable."""
     if body.get("aggs") or body.get("aggregations") or body.get("suggest"):
         return None
     if body.get("sort") is not None or body.get("search_after") is not None:
@@ -43,20 +52,29 @@ def mesh_eligible(body: Dict[str, Any]) -> Optional[str]:
         return None
     if body.get("rescore") or body.get("collapse") or body.get("slice"):
         return None
-    if not (body.get("track_total_hits") is False
-            or body.get("track_total_hits") == 0):
-        return None
     if int(body.get("size", 10)) <= 0:
         return None
     try:
         q = dsl.parse_query(body.get("query"))
     except Exception:  # noqa: BLE001 — let the RPC path raise the real error
         return None
-    if not isinstance(q, dsl.Match):
+    totals_off = (body.get("track_total_hits") is False
+                  or body.get("track_total_hits") == 0)
+    if isinstance(q, dsl.Knn) and q.filter is None:
+        if body.get("track_total_hits") is True:
+            return None
+        return {"kind": "knn", "field": q.field, "query": q}
+    if isinstance(q, dsl.TextExpansion):
+        if body.get("track_total_hits") is True:
+            return None
+        return {"kind": "sparse", "field": q.field, "query": q}
+    if not totals_off:
         return None
-    if q.operator == "and" or q.minimum_should_match is not None:
+    got = dsl.disjunctive_clauses(q)
+    if got is None:
         return None
-    return q.field
+    field, clauses = got
+    return {"kind": "text", "field": field, "clauses": clauses}
 
 
 class MeshDataPlane:
@@ -66,8 +84,11 @@ class MeshDataPlane:
         self._mesh = mesh
         self._min_devices = min_devices
         self._tried_default = False
-        # (index, field) -> (freshness_key, ShardedTextIndex, id_map arrays)
+        # (index, field) -> (freshness_key, Sharded*Index, id_map arrays)
         self._text: Dict[Tuple[str, str], Tuple[Any, Any, Any]] = {}
+        self._vec: Dict[Tuple[str, str], Tuple[Any, Any, Any]] = {}
+        self._feat: Dict[Tuple[str, str], Tuple[Any, Any, Any]] = {}
+        self._mesh2d = None
         self.stats: Dict[str, int] = {
             "mesh_queries": 0, "mesh_builds": 0,
             "wand_blocks_total": 0, "wand_blocks_scored": 0}
@@ -88,6 +109,18 @@ class MeshDataPlane:
     @property
     def available(self) -> bool:
         return self.mesh is not None
+
+    @property
+    def mesh2d(self):
+        """(shard, dp=1) view over the same devices — the vector program's
+        expected axes (queries ride the dp axis)."""
+        if self._mesh2d is None and self.mesh is not None:
+            import numpy as _np
+            from jax.sharding import Mesh
+            self._mesh2d = Mesh(
+                _np.asarray(self.mesh.devices).reshape(-1, 1),
+                ("shard", "dp"))
+        return self._mesh2d
 
     # ------------------------------------------------------------------
     # build / cache
@@ -131,9 +164,99 @@ class MeshDataPlane:
     # search
     # ------------------------------------------------------------------
 
+    def _vector_index(self, index_name: str, field: str, readers):
+        key = self._freshness_key(readers)
+        got = self._vec.get((index_name, field))
+        if got is not None and got[0] == key:
+            return got[1], got[2]
+        from elasticsearch_tpu.parallel.sharded_search import (
+            ShardedVectorIndex,
+        )
+        rows = []
+        id_shard: List[int] = []
+        id_segment: List[int] = []
+        id_doc: List[int] = []
+        similarity = "cosine"
+        for sid, reader in readers:
+            for si, (seg, live) in enumerate(
+                    zip(reader.segments, reader.live_masks)):
+                vf = seg.vectors.get(field)
+                if vf is None:
+                    continue
+                similarity = vf.similarity
+                live = np.asarray(live[: seg.n_docs], bool)
+                keep = np.nonzero(vf.exists[: seg.n_docs] & live)[0]
+                if len(keep) == 0:
+                    continue
+                rows.append(vf.matrix[keep])
+                id_shard.extend([sid] * len(keep))
+                id_segment.extend([si] * len(keep))
+                id_doc.extend(keep.tolist())
+        if not rows:
+            return None, None
+        matrix = np.concatenate(rows).astype(np.float32)
+        vindex = ShardedVectorIndex(self.mesh2d, matrix,
+                                    similarity=similarity)
+        id_map = (np.asarray(id_shard, np.int32),
+                  np.asarray(id_segment, np.int32),
+                  np.asarray(id_doc, np.int32))
+        self._vec[(index_name, field)] = (key, vindex, id_map)
+        self.stats["mesh_builds"] += 1
+        return vindex, id_map
+
+    def _features_index(self, index_name: str, field: str, readers):
+        key = self._freshness_key(readers)
+        got = self._feat.get((index_name, field))
+        if got is not None and got[0] == key:
+            return got[1], got[2]
+        from elasticsearch_tpu.parallel.sharded_search import (
+            ShardedFeaturesIndex,
+        )
+        sources = []
+        id_shard: List[int] = []
+        id_segment: List[int] = []
+        id_doc: List[int] = []
+        for sid, reader in readers:
+            for si, (seg, live) in enumerate(
+                    zip(reader.segments, reader.live_masks)):
+                sources.append((seg.features.get(field), live, seg.n_docs))
+                id_shard.extend([sid] * seg.n_docs)
+                id_segment.extend([si] * seg.n_docs)
+                id_doc.extend(range(seg.n_docs))
+        findex = ShardedFeaturesIndex.from_features_sources(self.mesh,
+                                                            sources)
+        id_map = (np.asarray(id_shard, np.int32),
+                  np.asarray(id_segment, np.int32),
+                  np.asarray(id_doc, np.int32))
+        self._feat[(index_name, field)] = (key, findex, id_map)
+        self.stats["mesh_builds"] += 1
+        return findex, id_map
+
+    # ------------------------------------------------------------------
+    # search
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _want(body: Dict[str, Any], n: int) -> int:
+        want = int(body.get("size", 10)) + int(body.get("from", 0))
+        return max(1, min(want, n if n else 1))
+
+    def _emit(self, scores, ids, id_map, boost: float
+              ) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for sc, gid in zip(np.asarray(scores), np.asarray(ids)):
+            if not np.isfinite(sc) or gid < 0:
+                break
+            out.append({"shard": int(id_map[0][gid]),
+                        "segment": int(id_map[1][gid]),
+                        "doc": int(id_map[2][gid]),
+                        "score": float(sc) * boost,
+                        "sort": [float(sc) * boost]})
+        return out
+
     def search_text(self, index_name: str, field: str, shards,
-                    body: Dict[str, Any], mappers
-                    ) -> Optional[List[Dict[str, Any]]]:
+                    body: Dict[str, Any], mappers,
+                    clauses=None) -> Optional[List[Dict[str, Any]]]:
         """Run the one-program path; returns per-hit dicts
         {shard, segment, doc, score} globally sorted, or None if the field
         isn't analyzable here (caller falls back to RPC)."""
@@ -143,30 +266,64 @@ class MeshDataPlane:
         analyzer = getattr(mapper, "search_analyzer", None)
         if analyzer is None:
             return None
-        q = dsl.parse_query(body.get("query"))
-        terms = analyzer.terms(q.text)
+        if clauses is None:
+            q = dsl.parse_query(body.get("query"))
+            clauses = [(q.text, q.boost)]
+        terms: List[Any] = []
+        for text, boost in clauses:
+            terms.extend((t, float(boost)) for t in analyzer.terms(text))
         if not terms:
             return []
         readers = [(sid, shard.engine.acquire_reader())
                    for sid, shard in sorted(shards.items())]
         tindex, id_map = self._text_index(index_name, field, readers)
-        want = int(body.get("size", 10)) + int(body.get("from", 0))
-        k = max(1, min(want, tindex.n_docs if tindex.n_docs else 1))
+        k = self._want(body, tindex.n_docs)
         scores, ids = tindex.search_batch([terms], k)
         t, g = tindex.last_prune_stats
         self.stats["mesh_queries"] += 1
         self.stats["wand_blocks_total"] += t
         self.stats["wand_blocks_scored"] += g
-        s0 = np.asarray(scores[0])
-        i0 = np.asarray(ids[0])
-        out: List[Dict[str, Any]] = []
-        boost = q.boost
-        for sc, gid in zip(s0, i0):
-            if not np.isfinite(sc) or gid < 0:
-                break
-            out.append({"shard": int(id_map[0][gid]),
-                        "segment": int(id_map[1][gid]),
-                        "doc": int(id_map[2][gid]),
-                        "score": float(sc) * boost,
-                        "sort": [float(sc) * boost]})
-        return out
+        return self._emit(scores[0], ids[0], id_map, 1.0)
+
+    def search_knn(self, index_name: str, field: str, shards,
+                   body: Dict[str, Any], query: "dsl.Knn"
+                   ) -> Optional[List[Dict[str, Any]]]:
+        """Unfiltered exact kNN as one mesh program (the
+        parallel/sharded_search.py kNN kernel behind the REST surface)."""
+        if not self.available:
+            return None
+        readers = [(sid, shard.engine.acquire_reader())
+                   for sid, shard in sorted(shards.items())]
+        vindex, id_map = self._vector_index(index_name, field, readers)
+        if vindex is None:
+            return None
+        k = min(self._want(body, vindex.n_docs), max(int(query.k), 1))
+        qv = np.asarray(query.query_vector, np.float32)[None, :]
+        scores, ids = vindex.search(qv, k)
+        self.stats["mesh_queries"] += 1
+        return self._emit(scores[0], ids[0], id_map, query.boost)
+
+    def search_sparse(self, index_name: str, field: str, shards,
+                      body: Dict[str, Any], query: "dsl.TextExpansion"
+                      ) -> Optional[List[Dict[str, Any]]]:
+        """text_expansion / learned-sparse retrieval as one mesh program:
+        expansion tokens (from the on-device model when not precomputed)
+        score linearly against the sharded rank-features blocks."""
+        if not self.available:
+            return None
+        tokens = query.tokens
+        if tokens is None:
+            from elasticsearch_tpu.ml import get_model
+            tokens = get_model(query.model_id).expand(query.model_text or "")
+        if not tokens:
+            return []
+        readers = [(sid, shard.engine.acquire_reader())
+                   for sid, shard in sorted(shards.items())]
+        findex, id_map = self._features_index(index_name, field, readers)
+        if findex is None or findex.n_docs == 0:
+            return []
+        k = self._want(body, findex.n_docs)
+        expansion = [(t, float(w) * query.boost) for t, w in tokens.items()]
+        scores, ids = findex.search_batch([expansion], k)
+        self.stats["mesh_queries"] += 1
+        return self._emit(scores[0], ids[0], id_map, 1.0)

@@ -454,13 +454,17 @@ class ShardedTextIndex:
         per_shard_w: List[List[float]] = [[] for _ in range(self.n_shards)]
         # dedupe but keep ES match semantics: a repeated query term is a
         # repeated bool clause, so its weight scales with multiplicity (qtf)
-        # — same scoring as Bm25Executor.query_weights on the segment path
+        # — same scoring as Bm25Executor.query_weights on the segment path.
+        # Entries may be (term, boost) pairs (bool/should clause boosts).
         counts = Counter(terms)
         for t, qtf in counts.items():
+            boost = 1.0
+            if isinstance(t, tuple):
+                t, boost = t
             df = self.df.get(t, 0)
             if df <= 0:
                 continue
-            w = idf_fn(self.n_docs, df) * qtf
+            w = idf_fn(self.n_docs, df) * qtf * float(boost)
             for s in range(self.n_shards):
                 entry = self.term_index[s].get(t)
                 if entry is None:
@@ -498,11 +502,14 @@ class ShardedTextIndex:
         tw = []
         # dedupe keeping order, weight scaled by query-term multiplicity
         # (qtf) to match the repeated-bool-clause semantics of the segment
-        # executor (see prep_query)
+        # executor (see prep_query); entries may be (term, boost) pairs
         for t, qtf in Counter(terms).items():
+            boost = 1.0
+            if isinstance(t, tuple):
+                t, boost = t
             df = self.df.get(t, 0)
             if df > 0:
-                tw.append((t, idf_fn(self.n_docs, df) * qtf))
+                tw.append((t, idf_fn(self.n_docs, df) * qtf * float(boost)))
         out = []
         for s in range(self.n_shards):
             out.append(build_query_plan(
@@ -558,6 +565,166 @@ class ShardedTextIndex:
         qb2_max = max((p.n_blocks for per in p2 for p in per), default=1)
         qb2 = qb_bucket(max(qb2_max, 1))
         return self._run_batch(fn, p2, qb2)
+
+
+# ---------------------------------------------------------------------------
+# sharded sparse (rank_features / text_expansion)
+# ---------------------------------------------------------------------------
+
+def _local_sparse_scores(block_docs, block_weights, block_idx, qw,
+                         n_per_shard: int):
+    """Per-shard linear sparse scoring: gather feature blocks, contrib =
+    query_weight * stored_weight, scatter-add (the text_expansion scoring
+    of execute._h_text_expansion, distributed)."""
+    docs = block_docs[block_idx]              # [QB, BLOCK]
+    w = block_weights[block_idx]
+    valid = docs >= 0
+    safe = jnp.where(valid, docs, 0)
+    contrib = qw[:, None] * w
+    contrib = jnp.where(valid, contrib, 0.0)
+    scores = jnp.zeros((n_per_shard,), jnp.float32)
+    scores = scores.at[safe.reshape(-1)].add(contrib.reshape(-1),
+                                             mode="drop")
+    return jnp.where(scores > 0, scores, -jnp.inf)
+
+
+def make_sharded_sparse(mesh: Mesh, n_per_shard: int, k: int):
+    """Compile the distributed sparse-retrieval program:
+    fn(block_docs [S,NB,B], block_weights [S,NB,B], block_idx [S,QB],
+    qw [S,QB]) -> (scores [k], global ids [k])."""
+
+    def local(block_docs, block_weights, block_idx, qw):
+        s = _local_sparse_scores(block_docs[0], block_weights[0],
+                                 block_idx[0], qw[0], n_per_shard)
+        return _global_topk_1d(s, k, n_per_shard)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P("shard", None, None), P("shard", None, None),
+                  P("shard", None), P("shard", None)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+class ShardedFeaturesIndex:
+    """rank_features corpus partitioned by doc over the mesh 'shard' axis
+    (the text_expansion serving substrate — ShardedTextIndex's layout with
+    stored weights instead of tfs and linear scoring)."""
+
+    @classmethod
+    def from_features_sources(cls, mesh: Mesh, sources,
+                              qb_bucket_min: int = 8
+                              ) -> "ShardedFeaturesIndex":
+        """``sources``: ordered [(features_field_or_None, live, n_docs)]
+        concatenated into a global doc space; tombstones dropped at build
+        time."""
+        obj = cls.__new__(cls)
+        n_shards = mesh.shape["shard"]
+        n = sum(n_docs for _, _, n_docs in sources)
+        per = next_pow2(max(-(-n // max(n_shards, 1)), 1), minimum=BLOCK)
+        shard_postings: List[Dict[str, Dict[int, float]]] = \
+            [dict() for _ in range(n_shards)]
+        base = 0
+        for ff, live, n_docs in sources:
+            if ff is None or n_docs == 0:
+                base += n_docs
+                continue
+            live = np.asarray(live[:n_docs], bool)
+            for feat, fid in ff.features.items():
+                s0 = int(ff.feat_block_start[fid])
+                cnt = int(ff.feat_block_count[fid])
+                docs = ff.block_docs[s0 : s0 + cnt].reshape(-1)
+                ws = ff.block_weights[s0 : s0 + cnt].reshape(-1)
+                m = docs >= 0
+                docs, ws = docs[m], ws[m]
+                m = (docs < n_docs) & live[np.minimum(docs, n_docs - 1)]
+                for d, wv in zip((base + docs[m]).tolist(),
+                                 ws[m].tolist()):
+                    sp = shard_postings[d % n_shards].setdefault(feat, {})
+                    sp[d // n_shards] = float(wv)
+            base += n_docs
+
+        packed = []
+        for s in range(n_shards):
+            blocks_d, blocks_w = [], []
+            index: Dict[str, Tuple[int, int]] = {}
+            for t, posting in shard_postings[s].items():
+                entries = sorted(posting.items())
+                nb = max(1, -(-len(entries) // BLOCK))
+                index[t] = (len(blocks_d), nb)
+                d = np.full(nb * BLOCK, -1, np.int32)
+                w = np.zeros(nb * BLOCK, np.float32)
+                d[: len(entries)] = [e[0] for e in entries]
+                w[: len(entries)] = [e[1] for e in entries]
+                blocks_d.extend(d.reshape(nb, BLOCK))
+                blocks_w.extend(w.reshape(nb, BLOCK))
+            if not blocks_d:
+                blocks_d = [np.full(BLOCK, -1, np.int32)]
+                blocks_w = [np.zeros(BLOCK, np.float32)]
+            packed.append((np.stack(blocks_d), np.stack(blocks_w), index))
+
+        nb_max = next_pow2(max(p[0].shape[0] for p in packed))
+        bd = np.full((n_shards, nb_max, BLOCK), -1, np.int32)
+        bw = np.zeros((n_shards, nb_max, BLOCK), np.float32)
+        obj.term_index = []
+        for s, (d, w, index) in enumerate(packed):
+            bd[s, : d.shape[0]] = d
+            bw[s, : w.shape[0]] = w
+            obj.term_index.append(index)
+        obj.mesh = mesh
+        obj.n_shards = n_shards
+        obj.n_docs = n
+        obj.n_per_shard = per
+        obj.qb_bucket_min = qb_bucket_min
+        obj.block_docs = jax.device_put(
+            bd, NamedSharding(mesh, P("shard", None, None)))
+        obj.block_weights = jax.device_put(
+            bw, NamedSharding(mesh, P("shard", None, None)))
+        obj._compiled = {}
+        return obj
+
+    def _prep(self, expansion) -> Tuple[np.ndarray, np.ndarray]:
+        per_idx: List[List[int]] = [[] for _ in range(self.n_shards)]
+        per_w: List[List[float]] = [[] for _ in range(self.n_shards)]
+        for feat, weight in expansion:
+            for s in range(self.n_shards):
+                entry = self.term_index[s].get(feat)
+                if entry is None:
+                    continue
+                start, count = entry
+                for b_ in range(start, start + count):
+                    per_idx[s].append(b_)
+                    per_w[s].append(float(weight))
+        qb = max(max((len(x) for x in per_idx), default=1), 1)
+        qb_pad = next_pow2(qb, minimum=self.qb_bucket_min)
+        idx = np.zeros((self.n_shards, qb_pad), np.int32)
+        w = np.zeros((self.n_shards, qb_pad), np.float32)
+        for s in range(self.n_shards):
+            idx[s, : len(per_idx[s])] = per_idx[s]
+            w[s, : len(per_w[s])] = per_w[s]
+        return idx, w
+
+    def search_batch(self, expansions, k: int):
+        """[(feature, weight)] expansions -> (scores [Q, k], original ids
+        [Q, k]); one compiled dispatch per query (expansions are tens of
+        features — the gather is tiny)."""
+        out_s, out_i = [], []
+        for expansion in expansions:
+            idx, w = self._prep(expansion)
+            key = (k, idx.shape[1])
+            fn = self._compiled.get(key)
+            if fn is None:
+                fn = make_sharded_sparse(self.mesh, self.n_per_shard, k)
+                self._compiled[key] = fn
+            sh = NamedSharding(self.mesh, P("shard", None))
+            s, i = fn(self.block_docs, self.block_weights,
+                      jax.device_put(idx, sh), jax.device_put(w, sh))
+            out_s.append(np.asarray(s))
+            out_i.append(to_original_ids(i, self.n_shards,
+                                         self.n_per_shard))
+        return np.stack(out_s), np.stack(out_i)
 
 
 # ---------------------------------------------------------------------------

@@ -945,6 +945,44 @@ def _field_value(spec, key):
     return fname, str(opts.get(key, "")), float(opts.get("boost", 1.0))
 
 
+def disjunctive_clauses(q: Query
+                        ) -> Optional[Tuple[str, List[Tuple[str, float]]]]:
+    """(field, [(text, boost)]) when the query is a pure disjunctive
+    text-scoring shape — a Match with OR semantics, or a Bool of ONLY
+    should Match clauses (default/1 minimum_should_match) on one field.
+    Returns None otherwise.
+
+    ONE definition shared by the shard WAND collector
+    (search/phase.py wand_clauses) and the mesh one-program path
+    (parallel/mesh_plane.py mesh_eligible) so their eligibility rules
+    cannot drift. Field-type checks stay with the callers (they own the
+    mappers)."""
+    if isinstance(q, Match):
+        if q.operator == "and" or q.minimum_should_match is not None:
+            return None
+        return q.field, [(q.text, q.boost)]
+    if isinstance(q, Bool):
+        if q.must or q.must_not or q.filter or not q.should:
+            return None
+        if q.minimum_should_match not in (None, 0, 1, "1"):
+            return None
+        field: Optional[str] = None
+        clauses: List[Tuple[str, float]] = []
+        for c in q.should:
+            if not isinstance(c, Match) or c.operator == "and" \
+                    or c.minimum_should_match is not None:
+                return None
+            if field is None:
+                field = c.field
+            elif field != c.field:
+                return None   # one postings executor per (segment, field)
+            clauses.append((c.text, c.boost * q.boost))
+        if field is None:
+            return None
+        return field, clauses
+    return None
+
+
 def resolve_minimum_should_match(msm: Any, n_clauses: int) -> int:
     """ES minimum_should_match forms: 3, "3", "-1", "75%", "-25%"."""
     if msm is None:

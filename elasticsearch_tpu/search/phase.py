@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from elasticsearch_tpu.index.engine import Reader
 from elasticsearch_tpu.index.segment import BLOCK, next_pow2
-from elasticsearch_tpu.ops.bm25 import P1_BUCKET
+from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1, P1_BUCKET
 from elasticsearch_tpu.mapping import MapperService
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.execute import SegmentContext, execute
@@ -244,6 +244,28 @@ def shard_field_stats(reader: Reader, mappers: MapperService,
     return out
 
 
+def wand_clauses(query: dsl.Query, mappers: MapperService
+                 ) -> Optional[Tuple[str, List[Tuple[str, float]]]]:
+    """(field, [(text, boost)]) if the query is a pure disjunctive text
+    scoring query the WAND executor can serve; None otherwise.
+
+    Eligible shapes (the actual weak-AND use cases,
+    TopDocsCollectorContext.java:127): a Match with OR semantics, or a
+    Bool of ONLY should Match clauses on the SAME analyzed text field —
+    per-clause boosts ride into the block weights. Shape extraction is
+    shared with the mesh plane (dsl.disjunctive_clauses); this adds the
+    field-type check. (Term clauses stay dense: this build's dense
+    handler scores term-on-text as constant boost, and collector choice
+    must never change scores.)"""
+    got = dsl.disjunctive_clauses(query)
+    if got is None:
+        return None
+    field, clauses = got
+    if mappers.field_type(field) not in ("text", "search_as_you_type"):
+        return None
+    return field, clauses
+
+
 def choose_collector_context(query: dsl.Query,
                              mappers: MapperService,
                              sort: List[SortSpec],
@@ -253,17 +275,20 @@ def choose_collector_context(query: dsl.Query,
                              track_total_hits: Any,
                              size: int) -> str:
     """Pick the shard collector the way TopDocsCollectorContext.java:215
-    does: a pure score-sorted top-k text query with no aggregations and no
-    exact-hit-count demand runs through the block-max-pruned batched device
-    executor ("wand_topk"); everything else takes the dense score-vector
-    path ("dense").
+    does: a pure score-sorted top-k disjunctive text query with no
+    aggregations runs through the block-max-pruned batched device executor
+    ("wand_topk"); everything else takes the dense score-vector path
+    ("dense").
 
-    The exact-count condition is structural on this static-shape machine:
-    the reference's collector counts hits until the threshold then starts
-    skipping, but a WAND phase that never gathers a block cannot count its
-    docs — so the pruned context requires the caller to have opted out of
-    totals (track_total_hits: false), the same setting Rally uses to
-    benchmark Lucene's WAND path."""
+    Totals semantics on this static-shape machine
+    (counts-then-skip, the reference's collector behavior): with a finite
+    track_total_hits threshold (the default 10,000 included) the pruned
+    path counts matching docs from the score plane of the blocks it
+    gathers — if the observed count reaches the threshold the response is
+    ("gte", threshold), exactly the reference's early-termination
+    contract; if not, the shard re-scores unpruned (cheap: few blocks) for
+    an exact count. Only track_total_hits: true (exact count, unbounded)
+    forces the dense path."""
     if size <= 0 or collectors or min_score is not None:
         return "dense"
     if search_after is not None:
@@ -271,50 +296,115 @@ def choose_collector_context(query: dsl.Query,
     if not (len(sort) == 1 and sort[0].field == "_score"
             and sort[0].order == "desc"):
         return "dense"
-    if not (track_total_hits is False or track_total_hits == 0):
+    if track_total_hits is True:
         return "dense"
-    if not isinstance(query, dsl.Match):
-        return "dense"
-    if query.operator == "and" or query.minimum_should_match is not None:
-        return "dense"
-    # only analyzed text fields score through postings; anything else falls
-    # back to term-equality semantics in the dense handler
-    if mappers.field_type(query.field) not in ("text", "search_as_you_type"):
+    if wand_clauses(query, mappers) is None:
         return "dense"
     return "wand_topk"
 
 
-def _wand_topk_shard(ctxs: List[SegmentContext], query: "dsl.Match",
-                     want: int, cancel_check) -> Tuple[
-                         List[ShardDoc], int, Optional[float],
+def _wand_topk_shard(ctxs: List[SegmentContext], field: str,
+                     clauses: List[Tuple[str, float]],
+                     want: int, cancel_check,
+                     track_limit: int) -> Tuple[
+                         List[ShardDoc], int, str, Optional[float],
                          Tuple[int, int]]:
-    """Pruned top-k over the shard's segments via Bm25Executor.top_k_batch.
+    """Pruned top-k over the shard's segments with ONE shard-global theta.
 
-    Returns (candidates, hits_found, max_score, prune_stats). hits_found is
-    a LOWER bound on matching docs (only gathered blocks are observed)."""
+    Three-stage shape (2 host sync barriers per shard, not per segment —
+    the r3 build dispatched batch-of-one per segment with a theta sync
+    each):
+      1. launch every segment's phase 1 (top-upper-bound blocks), then
+         block ONCE for all partial scores; the want-th best across ALL
+         segments is the shard-global theta — tighter than any per-segment
+         floor, so segments prune each other;
+      2. launch every segment's phase 2 with that theta; block once.
+
+    Totals (counts-then-skip, TopDocsCollectorContext.java:215): with a
+    finite ``track_limit`` the phase-2 kernel counts matching docs from
+    the score plane it already computed. Observed >= limit proves
+    ("gte", limit). Otherwise the shard re-scores unpruned for an exact
+    count — but when the df upper bound already shows total <= limit the
+    first pass runs unpruned+counted directly and no second pass exists.
+    track_limit 0 = totals disabled (report candidates found, "gte")."""
     from elasticsearch_tpu.search.execute import _bm25_executor
-    candidates: List[ShardDoc] = []
-    hits = 0
-    max_score: Optional[float] = None
-    blocks_total = 0
-    blocks_scored = 0
+    count = track_limit > 0
+    per_seg = []          # (ctx, ex, plans, k_seg, avgdl)
+    seen_terms: Dict[str, float] = {}
     for ctx in ctxs:
         if cancel_check is not None:
             cancel_check()
-        analyzer = ctx.search_analyzer(query.field)
-        terms = analyzer.terms(query.text)
+        analyzer = ctx.search_analyzer(field)
+        terms: List[Tuple[str, float]] = []
+        for text, boost in clauses:
+            terms.extend((t, boost) for t in analyzer.terms(text))
         if not terms:
             continue
-        ex = _bm25_executor(ctx, query.field)
+        ex = _bm25_executor(ctx, field)
         if ex is None:
             continue   # field has no postings in this segment
-        k = min(max(want, 1), ctx.n_docs_pad)
-        s, d = ex.top_k_batch([terms], ctx.live, k, boost=query.boost,
-                              df_override=ctx.df_for(query.field),
-                              avgdl_override=ctx.avgdl_for(query.field))
-        t, g = getattr(ex, "last_prune_stats", (0, 0))
-        blocks_total += t
-        blocks_scored += g
+        df_map = ctx.df_for(field) or {}
+        for t, _b in terms:
+            if t not in seen_terms:
+                seen_terms[t] = float(df_map.get(t, 0))
+        k_seg = min(max(want, 1), ctx.n_docs_pad)
+        avgdl = ex._avgdl(ctx.avgdl_for(field))
+        plans = ex.build_plans([terms], df_override=df_map or None,
+                               avgdl=avgdl)
+        per_seg.append((ctx, ex, plans, k_seg, avgdl))
+    if not per_seg:
+        return [], 0, "eq", None, (0, 0)
+
+    # df-based upper bound on shard hits (shard-level df includes deletes,
+    # so it only overcounts — safe as an upper bound)
+    hits_upper = int(sum(seen_terms.values()))
+    exact_mode = count and hits_upper <= track_limit
+    blocks_total = 0
+    blocks_scored = 0
+    results = []
+    if exact_mode:
+        # few enough postings that pruning cannot pay: one unpruned
+        # counted pass, exact totals for free
+        for ctx, ex, plans, k_seg, avgdl in per_seg:
+            total = sum(p.n_blocks for p in plans)
+            blocks_total += total
+            blocks_scored += total
+            results.append(ex._dispatch_flat(plans, ctx.live, k_seg,
+                                             DEFAULT_K1, DEFAULT_B, avgdl,
+                                             counted=True))
+        hits_exact = True
+    else:
+        # barrier 1: all segments' phase-1 partials -> shard-global theta
+        s1_dev = [ex.phase1(plans, ctx.live, k_seg, avgdl=avgdl)
+                  for ctx, ex, plans, k_seg, avgdl in per_seg]
+        all_s1 = np.concatenate([np.asarray(s)[0] for s in s1_dev])
+        finite = all_s1[np.isfinite(all_s1)]
+        if len(finite) >= want:
+            theta = float(np.sort(finite)[-want])
+        else:
+            theta = -np.inf
+        hits_exact = True
+        for ctx, ex, plans, k_seg, avgdl in per_seg:
+            if cancel_check is not None:
+                cancel_check()
+            results.append(ex.finish_pruned(plans, [theta], ctx.live,
+                                            k_seg, avgdl=avgdl,
+                                            count_hits=count))
+            t, g = ex.last_prune_stats
+            blocks_total += t
+            blocks_scored += g
+            hits_exact = hits_exact and ex.last_hits_exact
+
+    # barrier 2: collect candidates (+ counts)
+    candidates: List[ShardDoc] = []
+    max_score: Optional[float] = None
+    hits_seen = 0
+    for (ctx, ex, plans, k_seg, avgdl), got in zip(per_seg, results):
+        if count:
+            s, d, h = got
+            hits_seen += int(h[0])
+        else:
+            s, d = got
         s0 = np.asarray(s[0])
         d0 = np.asarray(d[0])
         for sc, doc in zip(s0, d0):
@@ -322,11 +412,27 @@ def _wand_topk_shard(ctxs: List[SegmentContext], query: "dsl.Match",
                 break
             candidates.append(
                 ShardDoc(ctx.segment_idx, int(doc), float(sc), (float(sc),)))
-            hits += 1
             if max_score is None or sc > max_score:
                 max_score = float(sc)
     candidates.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
-    return candidates, hits, max_score, (blocks_total, blocks_scored)
+    prune = (blocks_total, blocks_scored)
+
+    if not count:
+        return candidates, len(candidates), "gte", max_score, prune
+    if hits_seen >= track_limit:
+        return candidates, track_limit, "gte", max_score, prune
+    if hits_exact:
+        return candidates, hits_seen, "eq", max_score, prune
+    # observed < limit but pruning may have hidden hits: one exact
+    # unpruned counted pass for the true total (scores already final)
+    exact_hits = 0
+    for ctx, ex, plans, k_seg, avgdl in per_seg:
+        _s, _d, h = ex._dispatch_flat(plans, ctx.live, 1, DEFAULT_K1,
+                                      DEFAULT_B, avgdl, counted=True)
+        exact_hits += int(h[0])
+    if exact_hits > track_limit:
+        return candidates, track_limit, "gte", max_score, prune
+    return candidates, exact_hits, "eq", max_score, prune
 
 
 def query_shard(reader: Reader,
@@ -447,15 +553,19 @@ def query_shard(reader: Reader,
     from elasticsearch_tpu.indices.breaker import BREAKERS
     request_breaker = BREAKERS.breaker("request")
     if collector == "wand_topk":
+        wc = wand_clauses(query, mappers)
+        assert wc is not None   # choose_collector_context guarantees it
+        w_field, w_clauses = wc
         # transient: per-segment phase gathers + top-k outputs, NOT a dense
         # score vector — pruning is precisely what keeps this small
         transient = sum(
             (P1_BUCKET * BLOCK * 8) + want * 8 for _ in ctxs)
         with request_breaker.limit_scope(transient, "wand_topk"):
-            candidates, hits, max_score, prune = _wand_topk_shard(
-                ctxs, query, want, cancel_check)
+            candidates, hits, relation, max_score, prune = _wand_topk_shard(
+                ctxs, w_field, w_clauses, want, cancel_check,
+                track_limit if exact_total else 0)
         return ShardQueryResult(
-            candidates[from_: from_ + size], hits, "gte", max_score,
+            candidates[from_: from_ + size], hits, relation, max_score,
             doc_count=doc_count, dfs=dfs,
             collector="wand_topk", prune_stats=prune,
             profile=(_profile_block(

@@ -1,9 +1,11 @@
 """Collector-context dispatch: the pruned WAND path vs the dense path.
 
 The served query phase must choose the block-max-pruned batched executor
-for pure score-sorted top-k text queries with totals disabled
-(TopDocsCollectorContext.java:215 analog), and its results must agree with
-the dense scoring path bit-for-bit on ranking.
+for pure score-sorted top-k disjunctive text queries
+(TopDocsCollectorContext.java:215 analog) — including the DEFAULT request
+shape (track_total_hits: 10,000) via counts-then-skip — and its results
+must agree with the dense scoring path bit-for-bit on ranking and on
+total-hits semantics.
 """
 
 import numpy as np
@@ -13,7 +15,7 @@ from elasticsearch_tpu.index import InternalEngine
 from elasticsearch_tpu.mapping import MapperService
 from elasticsearch_tpu.search import SearchService, dsl
 from elasticsearch_tpu.search.phase import (
-    choose_collector_context, parse_sort, query_shard,
+    choose_collector_context, parse_sort, query_shard, wand_clauses,
 )
 
 RNG = np.random.default_rng(42)
@@ -58,9 +60,11 @@ def test_chooser_picks_wand_only_when_eligible(engine):
               collectors=None, track_total_hits=False, size=10)
     q = dsl.parse_query({"match": {"body": "w3 w7"}})
     assert choose_collector_context(q, **ok) == "wand_topk"
-    # any exact-count demand forces dense
+    # counts-then-skip: the DEFAULT finite threshold stays on the pruned
+    # path (r3 required track_total_hits: false — the opt-in is gone)
     assert choose_collector_context(
-        q, **{**ok, "track_total_hits": 10_000}) == "dense"
+        q, **{**ok, "track_total_hits": 10_000}) == "wand_topk"
+    # unbounded exact counting still forces dense
     assert choose_collector_context(
         q, **{**ok, "track_total_hits": True}) == "dense"
     # aggs force dense
@@ -73,9 +77,36 @@ def test_chooser_picks_wand_only_when_eligible(engine):
     q_and = dsl.parse_query({"match": {"body": {"query": "w3 w7",
                                                 "operator": "and"}}})
     assert choose_collector_context(q_and, **ok) == "dense"
-    # bool query forces dense
+    # bool with must forces dense; bool of only-should Matches is served
     q_bool = dsl.parse_query({"bool": {"must": [{"match": {"body": "w3"}}]}})
     assert choose_collector_context(q_bool, **ok) == "dense"
+    q_should = dsl.parse_query({"bool": {"should": [
+        {"match": {"body": "w3"}}, {"match": {"body": "w40"}}]}})
+    assert choose_collector_context(q_should, **ok) == "wand_topk"
+    # term-on-text scores as constant boost in the dense handler, so a
+    # term clause keeps the bool dense (parity over speed)
+    q_term = dsl.parse_query({"bool": {"should": [
+        {"match": {"body": "w3"}}, {"term": {"body": "w40"}}]}})
+    assert choose_collector_context(q_term, **ok) == "dense"
+    # mixed fields cannot share one executor
+    q_mixed = dsl.parse_query({"bool": {"should": [
+        {"match": {"body": "w3"}}, {"match": {"other": "x"}}]}})
+    assert choose_collector_context(q_mixed, **ok) == "dense"
+    # minimum_should_match > 1 changes matching semantics
+    q_msm = dsl.parse_query({"bool": {"should": [
+        {"match": {"body": "w3"}}, {"match": {"body": "w4"}}],
+        "minimum_should_match": 2}})
+    assert choose_collector_context(q_msm, **ok) == "dense"
+
+
+def test_wand_clauses_extraction(engine):
+    f, cl = wand_clauses(
+        dsl.parse_query({"bool": {"should": [
+            {"match": {"body": {"query": "w3 w5", "boost": 2.0}}},
+            {"match": {"body": {"query": "w40", "boost": 0.5}}}],
+            "boost": 3.0}}), engine.mappers)
+    assert f == "body"
+    assert cl == [("w3 w5", 6.0), ("w40", 1.5)]
 
 
 @pytest.mark.parametrize("text", [
@@ -83,18 +114,58 @@ def test_chooser_picks_wand_only_when_eligible(engine):
 ])
 def test_wand_parity_with_dense(engine, text):
     body = {"query": {"match": {"body": text}}, "size": 10}
-    dense = _run(engine, body)
-    wand = _run(engine, {**body, "track_total_hits": False})
+    dense = _run(engine, {**body, "track_total_hits": True})
+    wand = _run(engine, body)                          # default totals
+    wand_nc = _run(engine, {**body, "track_total_hits": False})
     assert dense.collector == "dense"
+    assert wand.collector == "wand_topk"
+    assert wand_nc.collector == "wand_topk"
+    for got in (wand, wand_nc):
+        assert [(d.segment_idx, d.doc) for d in got.docs] == \
+            [(d.segment_idx, d.doc) for d in dense.docs]
+        np.testing.assert_allclose([d.score for d in got.docs],
+                                   [d.score for d in dense.docs],
+                                   rtol=1e-5, atol=1e-5)
+    # counts-then-skip: below the threshold the count is EXACT and equals
+    # the dense path's
+    assert wand.total_relation == "eq"
+    assert wand.total_hits == dense.total_hits
+    # totals disabled: sound lower bound
+    assert wand_nc.total_relation == "gte"
+    assert wand_nc.total_hits <= dense.total_hits
+
+
+def test_counts_then_skip_threshold(engine):
+    """Totals clip at the threshold with relation gte — the reference's
+    counts-until-threshold contract — while ranking stays exact."""
+    body = {"query": {"match": {"body": "w0 w1"}}, "size": 5}
+    dense = _run(engine, {**body, "track_total_hits": True})
+    assert dense.total_hits > 7   # corpus sanity
+    limited = _run(engine, {**body, "track_total_hits": 7})
+    assert limited.collector == "wand_topk"
+    assert limited.total_relation == "gte"
+    assert limited.total_hits == 7
+    assert [(d.segment_idx, d.doc) for d in limited.docs] == \
+        [(d.segment_idx, d.doc) for d in dense.docs]
+
+
+def test_bool_should_wand_parity(engine):
+    """Multi-clause should with boosts: pruned path ranks identically to
+    dense."""
+    body = {"query": {"bool": {"should": [
+        {"match": {"body": {"query": "w0 w2", "boost": 1.5}}},
+        {"match": {"body": "w33"}}]}}, "size": 10}
+    dense = _run(engine, {**body, "track_total_hits": True})
+    assert dense.collector == "dense"
+    wand = _run(engine, body)
     assert wand.collector == "wand_topk"
     assert [(d.segment_idx, d.doc) for d in wand.docs] == \
         [(d.segment_idx, d.doc) for d in dense.docs]
     np.testing.assert_allclose([d.score for d in wand.docs],
                                [d.score for d in dense.docs],
                                rtol=1e-5, atol=1e-5)
-    # the pruned path's total is a sound lower bound
-    assert wand.total_relation == "gte"
-    assert wand.total_hits <= dense.total_hits
+    assert wand.total_hits == dense.total_hits
+    assert wand.total_relation == "eq"
 
 
 def test_wand_actually_prunes(engine):
@@ -114,6 +185,43 @@ def test_served_search_uses_wand_and_counts_stats(engine):
                        "track_total_hits": False, "size": 5})
     assert len(resp["hits"]["hits"]) == 5
     assert resp["hits"]["total"]["relation"] == "gte"
-    dense = svc.search({"query": {"match": {"body": "w2 w9"}}, "size": 5})
+    dense = svc.search({"query": {"match": {"body": "w2 w9"}},
+                        "track_total_hits": True, "size": 5})
     assert [h["_id"] for h in resp["hits"]["hits"]] == \
         [h["_id"] for h in dense["hits"]["hits"]]
+    # the DEFAULT request shape is served by the pruned path with exact
+    # small-corpus totals
+    default = svc.search({"query": {"match": {"body": "w2 w9"}}, "size": 5})
+    assert default["hits"]["total"] == dense["hits"]["total"]
+
+
+def test_total_hits_clip_across_shards():
+    """Each shard counts up to the threshold independently; the
+    coordinator re-clips the sum (SearchPhaseController TotalHits merge) —
+    without it a 2-shard index reports up to 2x the threshold."""
+    from elasticsearch_tpu.testing import InProcessCluster
+    c = InProcessCluster(n_nodes=1, seed=9)
+    c.start()
+    try:
+        client = c.client()
+        c.call(lambda cb: client.create_index("tt", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"properties": {"body": {"type": "text"}}}}, cb))
+        c.ensure_green("tt")
+        for i in range(12):
+            r, e = c.call(lambda cb, i=i: client.index_doc(
+                "tt", f"d{i}", {"body": "common word"}, cb))
+            assert e is None
+        c.call(lambda cb: client.refresh("tt", cb))
+        r, e = c.call(lambda cb: client.search(
+            "tt", {"query": {"match": {"body": "common"}},
+                   "track_total_hits": 3, "size": 2}, cb))
+        assert e is None
+        assert r["hits"]["total"] == {"value": 3, "relation": "gte"}
+        # under the threshold: exact
+        r, e = c.call(lambda cb: client.search(
+            "tt", {"query": {"match": {"body": "common"}}, "size": 2}, cb))
+        assert e is None
+        assert r["hits"]["total"] == {"value": 12, "relation": "eq"}
+    finally:
+        c.stop()
