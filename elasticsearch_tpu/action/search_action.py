@@ -59,6 +59,10 @@ def _parse_max_concurrent(raw) -> Optional[int]:
             "[max_concurrent_shard_requests] must be >= 1")
     return value
 SEARCH_FETCH = "indices:data/read/search[phase/fetch]"
+# cross-cluster search: a remote coordinator executes the whole search
+# for its clusters' indices and returns the final response
+# (RemoteClusterService.java:65 + SearchResponseMerger.java)
+SEARCH_CCS = "indices:data/read/search[ccs]"
 
 CONTEXT_KEEP_ALIVE = 60.0
 
@@ -325,11 +329,15 @@ class TransportSearchAction:
     def __init__(self, node_id: str, ts: TransportService,
                  state_supplier: Callable[[], ClusterState],
                  task_manager=None, indices: Optional[IndicesService] = None,
-                 mesh_plane=None, thread_pool=None):
+                 mesh_plane=None, thread_pool=None, remote_clusters=None):
         self.node_id = node_id
         self.ts = ts
         self.state = state_supplier
         self.task_manager = task_manager
+        self.remote_clusters = remote_clusters
+        if remote_clusters is not None:
+            # serve CCS requests arriving FROM other clusters
+            ts.register_handler(SEARCH_CCS, self._on_ccs)
         # coordinator-side search admission (None in unit tests)
         self.thread_pool = thread_pool
         # SPMD fast path (parallel/mesh_plane.py): when this node drives a
@@ -469,6 +477,12 @@ class TransportSearchAction:
         t0 = time.monotonic()
         state = self.state()
         body = body or {}
+
+        if ":" in (index_expression or "") and \
+                self.remote_clusters is not None:
+            self._execute_ccs(t0, index_expression, body, on_done,
+                              search_type)
+            return
 
         task = None
         if self.task_manager is not None:
@@ -619,13 +633,17 @@ class TransportSearchAction:
             by_shard.setdefault(h["shard"], []).append(
                 {"segment": h["segment"], "doc": h["doc"],
                  "score": h["score"], "sort": h["sort"]})
+        # totals: the text program observes only gathered blocks (lower
+        # bound, "gte" — eligibility requires totals disabled); knn/sparse
+        # are top-k-exact retrievals whose hit set IS the result ("eq")
+        relation = "gte" if kind == "text" else "eq"
         results: List[Optional[Dict[str, Any]]] = []
         for target in targets:
             target["node"] = self.node_id    # fetch runs locally
             docs = by_shard.get(target["shard"], [])
             results.append({
                 "context_id": None, "total": len(docs),
-                "relation": "gte",
+                "relation": relation,
                 "max_score": max((d["score"] for d in docs), default=None),
                 "docs": docs})
         self._merge_and_fetch(t0, targets, results, body, from_, size,
@@ -784,6 +802,146 @@ class TransportSearchAction:
                 done = len(targets) - pending["n"]
         phase_state["_dispatch_next"] = dispatch_next
         dispatch_next()
+
+    # -- cross-cluster search --------------------------------------------
+
+    def _on_ccs(self, req: Dict[str, Any], sender: str):
+        """Serve a search arriving FROM another cluster's coordinator:
+        run it fully here (this node is the remote's gateway) and return
+        the final response over the reply channel."""
+        from elasticsearch_tpu.transport.transport import Deferred
+        deferred = Deferred()
+
+        def done(resp, err):
+            if err is not None:
+                deferred.reject(err)
+            else:
+                deferred.resolve(resp)
+
+        self.execute(req.get("indices", ""), req.get("body") or {}, done,
+                     search_type=req.get("search_type",
+                                         "query_then_fetch"))
+        return deferred
+
+    def _execute_ccs(self, t0, expression: str, body: Dict[str, Any],
+                     on_done: DoneFn, search_type: str) -> None:
+        """Coordinator side of cross-cluster search: split the expression
+        into local + per-alias remote groups, fan the search out (each
+        remote coordinator runs it end-to-end, ccs_minimize_roundtrips
+        style), and merge the final responses
+        (action/search/SearchResponseMerger.java)."""
+        from elasticsearch_tpu.transport.remote import (
+            split_remote_expression,
+        )
+        local_parts, remote_groups = split_remote_expression(expression)
+        for clause in ("aggs", "aggregations", "suggest", "collapse",
+                       "rescore"):
+            if body.get(clause):
+                on_done(None, IllegalArgumentError(
+                    f"[{clause}] is not supported with remote cluster "
+                    f"indices; query each cluster individually"))
+                return
+        unknown = [a for a in remote_groups
+                   if a not in self.remote_clusters.seeds()]
+        if unknown:
+            on_done(None, IllegalArgumentError(
+                f"no such remote cluster: [{unknown[0]}]"))
+            return
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        # every cluster returns its own top (from+size); the merge
+        # re-slices — SearchResponseMerger's from+size over-fetch
+        fan_body = {**body, "from": 0, "size": size + from_}
+        keys = (["(local)"] if local_parts else []) + sorted(remote_groups)
+        results: Dict[str, Dict[str, Any]] = {}
+        errors: list = []
+        pending = {"n": len(keys)}
+
+        def complete() -> None:
+            if errors:
+                on_done(None, errors[0][1])
+                return
+            on_done(self._merge_ccs(t0, body, results, from_, size), None)
+
+        def collect(key: str):
+            def cb(resp, err) -> None:
+                if err is not None:
+                    errors.append((key, err))
+                else:
+                    results[key] = resp or {}
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    complete()
+            return cb
+
+        if local_parts:
+            self._execute_admitted(",".join(local_parts), fan_body,
+                                   collect("(local)"), search_type)
+        for alias in sorted(remote_groups):
+            self.remote_clusters.send(
+                alias, SEARCH_CCS,
+                {"indices": ",".join(remote_groups[alias]),
+                 "body": fan_body, "search_type": search_type},
+                collect(alias), timeout=60.0)
+
+    def _merge_ccs(self, t0, body: Dict[str, Any],
+                   results: Dict[str, Dict[str, Any]],
+                   from_: int, size: int) -> Dict[str, Any]:
+        sort_specified = body.get("sort") is not None
+        entries: list = []
+        total = 0
+        relation = "eq"
+        max_score: Optional[float] = None
+        shards = {"total": 0, "successful": 0, "skipped": 0, "failed": 0}
+        for key, resp in results.items():
+            h = resp.get("hits") or {}
+            tot = h.get("total") or {}
+            total += int(tot.get("value", 0))
+            if tot.get("relation") == "gte":
+                relation = "gte"
+            ms = h.get("max_score")
+            if ms is not None:
+                max_score = ms if max_score is None else max(max_score, ms)
+            sh = resp.get("_shards") or {}
+            for f in shards:
+                shards[f] += int(sh.get(f, 0))
+            for hit in h.get("hits", []):
+                if key != "(local)":
+                    # remote hits carry the alias-qualified index name
+                    hit = {**hit, "_index": f"{key}:{hit.get('_index')}"}
+                entries.append(hit)
+        tth = body.get("track_total_hits", 10_000)
+        if tth is not True and tth is not False and tth \
+                and total > int(tth):
+            total = int(tth)
+            relation = "gte"
+        if sort_specified:
+            import functools
+            from elasticsearch_tpu.search.phase import _cmp_values
+            specs = parse_sort(body.get("sort"))
+            reverse = [s.order == "desc" for s in specs]
+
+            def cmp(a, b) -> int:
+                for av, bv, rev in zip(a.get("sort") or [],
+                                       b.get("sort") or [], reverse):
+                    c = _cmp_values(av, bv, rev)
+                    if c:
+                        return c
+                return 0
+
+            entries.sort(key=functools.cmp_to_key(cmp))
+        else:
+            entries.sort(key=lambda hh: -(hh.get("_score") or 0.0))
+        return {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "_shards": shards,
+            "_clusters": {"total": len(results),
+                          "successful": len(results), "skipped": 0},
+            "hits": {"total": {"value": total, "relation": relation},
+                     "max_score": max_score,
+                     "hits": entries[from_: from_ + size]},
+        }
 
     # -- merge + fetch ---------------------------------------------------
 

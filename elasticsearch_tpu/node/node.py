@@ -128,10 +128,13 @@ class Node:
             # pjit program, RPC scatter-gather stays the fallback
             from elasticsearch_tpu.parallel.mesh_plane import MeshDataPlane
             self.mesh_plane = MeshDataPlane()
+        from elasticsearch_tpu.transport.remote import RemoteClusterService
+        self.remote_clusters = RemoteClusterService(self)
         self.search_action = TransportSearchAction(
             node_id, self.transport_service, self._applied_state,
             task_manager=self.task_manager, indices=self.indices_service,
-            mesh_plane=self.mesh_plane, thread_pool=self.thread_pool)
+            mesh_plane=self.mesh_plane, thread_pool=self.thread_pool,
+            remote_clusters=self.remote_clusters)
         self.broadcast_actions = BroadcastActions(
             node_id, self.indices_service, self.transport_service,
             self._applied_state)
@@ -159,6 +162,8 @@ class Node:
 
         from elasticsearch_tpu.ilm import IndexLifecycleService
         self.ilm_service = IndexLifecycleService(self)
+        from elasticsearch_tpu.xpack.slm import SnapshotLifecycleService
+        self.slm_service = SnapshotLifecycleService(self)
 
         from elasticsearch_tpu.xpack.security import SecurityService
         self.security = SecurityService(self)
@@ -287,6 +292,7 @@ class Node:
     def start(self) -> None:
         self.coordinator.start()
         self.ilm_service.start()
+        self.slm_service.start()
         self.transform_service.start()
         self.watcher_service.start()
         self.ccr_service.start()
@@ -302,6 +308,7 @@ class Node:
         self.watcher_service.stop()
         self.transform_service.stop()
         self.ilm_service.stop()
+        self.slm_service.stop()
         self.coordinator.stop()
         self.transport_service.close()
         self.indices_service.close()
@@ -397,6 +404,31 @@ class NodeClient:
         return {k: {"policy": dict(v)} for k, v in sorted(
             self.node._applied_state().metadata.ilm_policies.items())}
 
+    def put_slm_policy(self, policy_id: str, body: Dict[str, Any],
+                       on_done) -> None:
+        from elasticsearch_tpu.action.admin import PUT_CUSTOM
+        from elasticsearch_tpu.xpack.slm import SECTION, \
+            SnapshotLifecycleService
+        body = dict(body or {})
+        try:
+            SnapshotLifecycleService.validate(body)
+        except Exception as e:  # noqa: BLE001 — report as 400
+            on_done(None, e)
+            return
+        # preserve scheduler bookkeeping across policy updates
+        prior = self.node.slm_service.policies().get(policy_id, {})
+        for k in ("_counter", "_last_run_ms", "_last_success"):
+            if k in prior:
+                body.setdefault(k, prior[k])
+        self.node.master_client.execute(PUT_CUSTOM, {
+            "section": SECTION, "name": policy_id, "body": body}, on_done)
+
+    def delete_slm_policy(self, policy_id: str, on_done) -> None:
+        from elasticsearch_tpu.action.admin import DELETE_CUSTOM
+        from elasticsearch_tpu.xpack.slm import SECTION
+        self.node.master_client.execute(DELETE_CUSTOM, {
+            "section": SECTION, "name": policy_id}, on_done)
+
     # -- security ---------------------------------------------------------
 
     def put_security_user(self, name: str, body: Dict[str, Any],
@@ -468,6 +500,48 @@ class NodeClient:
                     if kk not in ("hash", "salt")}
                 for k, v in section.items()}
 
+    def create_data_stream(self, name: str, on_done) -> None:
+        from elasticsearch_tpu.action.admin import CREATE_DATA_STREAM
+        self.node.master_client.execute(CREATE_DATA_STREAM,
+                                        {"name": name}, on_done)
+
+    def delete_data_stream(self, name: str, on_done) -> None:
+        from elasticsearch_tpu.action.admin import DELETE_DATA_STREAM
+        self.node.master_client.execute(DELETE_DATA_STREAM,
+                                        {"name": name}, on_done)
+
+    def get_data_streams(self, name: Optional[str] = None
+                         ) -> Dict[str, Any]:
+        """GET /_data_stream[/{name}] shape (GetDataStreamAction)."""
+        import fnmatch as _fn
+        state = self.node._applied_state()
+        streams = state.metadata.data_streams
+        if name and "*" not in name:
+            if name not in streams:
+                from elasticsearch_tpu.utils.errors import (
+                    IndexNotFoundError,
+                )
+                raise IndexNotFoundError(name)
+            chosen = {name: streams[name]}
+        elif name:
+            chosen = {k: v for k, v in streams.items()
+                      if _fn.fnmatch(k, name)}
+        else:
+            chosen = streams
+        out = []
+        for ds_name in sorted(chosen):
+            ds = chosen[ds_name]
+            out.append({
+                "name": ds_name,
+                "timestamp_field": ds.get("timestamp_field",
+                                          {"name": "@timestamp"}),
+                "generation": ds.get("generation", 1),
+                "indices": [{"index_name": n}
+                            for n in ds.get("indices", [])],
+                "status": "GREEN",
+            })
+        return {"data_streams": out}
+
     def rollover(self, alias: str, body: Optional[Dict[str, Any]],
                  on_done) -> None:
         """Coordinator half of rollover (TransportRolloverAction): evaluate
@@ -488,17 +562,24 @@ class NodeClient:
                 "supported: max_age, max_docs"))
             return
         state = self.node._applied_state()
+        data_stream = state.metadata.data_streams.get(alias)
         try:
             source = state.metadata.index(alias)   # exactly-one resolution
         except Exception as e:  # noqa: BLE001 — not-found / ambiguous
             on_done(None, e)
             return
-        if alias not in source.aliases:
+        if data_stream is None and alias not in source.aliases:
             on_done(None, IllegalArgumentError(
                 f"rollover target [{alias}] is a concrete index, not an "
-                "alias"))
+                "alias or data stream"))
             return
-        new_index = body.get("new_index") or next_rollover_name(source.name)
+        if data_stream is not None:
+            from elasticsearch_tpu.action.admin import backing_index_name
+            new_index = body.get("new_index") or backing_index_name(
+                alias, int(data_stream.get("generation", 1)) + 1)
+        else:
+            new_index = body.get("new_index") or \
+                next_rollover_name(source.name)
 
         def proceed(met: Dict[str, bool]) -> None:
             if conditions and not any(met.values()):
@@ -510,12 +591,17 @@ class NodeClient:
                 on_done({"acknowledged": False, "rolled_over": False,
                          "dry_run": True, "conditions": met}, None)
                 return
-            self.node.master_client.execute(ROLLOVER, {
-                "alias": alias,
+            request = {
                 "new_index": new_index,
                 "settings": body.get("settings") or {},
                 "mappings": body.get("mappings") or {},
-            }, lambda resp, err: on_done(
+            }
+            if data_stream is not None:
+                request["data_stream"] = alias
+            else:
+                request["alias"] = alias
+            self.node.master_client.execute(ROLLOVER, request,
+                                            lambda resp, err: on_done(
                 {**(resp or {}), "old_index": source.name,
                  "conditions": met} if err is None else None, err))
 

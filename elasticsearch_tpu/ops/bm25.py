@@ -77,25 +77,6 @@ def bm25_topk(block_docs, block_tfs, block_idx, block_weight, doc_lens, avgdl,
     return jax.lax.top_k(scores, k)
 
 
-@partial(jax.jit, static_argnames=("n_docs_pad", "k1", "b", "k"))
-def bm25_topk_batch(block_docs, block_tfs,
-                    block_idx,        # [Q, QB] int32
-                    block_weight,     # [Q, QB] f32
-                    doc_lens, avgdl, live, n_docs_pad: int, k: int,
-                    k1: float = DEFAULT_K1, b: float = DEFAULT_B
-                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Batched BM25 + top-k: Q queries in one dispatch (the knn_topk_batch
-    analog — amortizes host->device dispatch across the batch)."""
-
-    def one(bi, bw):
-        s = bm25_block_scores(block_docs, block_tfs, bi, bw,
-                              doc_lens, avgdl, n_docs_pad, k1=k1, b=b)
-        s = jnp.where(live & (s > 0.0), s, -jnp.inf)
-        return jax.lax.top_k(s, k)
-
-    return jax.vmap(one)(block_idx, block_weight)
-
-
 # number of highest-upper-bound blocks scored in phase 1 of the pruned
 # path to establish the top-k score floor (theta)
 P1_BUCKET = 32
@@ -357,7 +338,6 @@ class TermCellIndex:
 
 
 def build_query_plan(terms_with_weights, term_blocks_fn, block_max_impact,
-                     block_min_doc=None, block_max_doc=None,
                      cell_index: Optional[TermCellIndex] = None,
                      k1: float = DEFAULT_K1) -> QueryPlan:
     """Shared host prep for the pruned BM25 path.
@@ -365,9 +345,7 @@ def build_query_plan(terms_with_weights, term_blocks_fn, block_max_impact,
     terms_with_weights: [(term, idf*boost)];
     term_blocks_fn(term) -> (start, count) into the block arrays;
     block_max_impact: f32 [n_blocks] (PostingsField.block_max_impact);
-    block_min_doc/block_max_doc are vestigial (kept for call-site
-    compatibility) — per-block doc ranges now come from the cell_index's
-    own cached tables.
+    per-block doc ranges come from the cell_index's own cached tables.
 
     other_ub for a block is the sum, over the query's OTHER terms, of that
     term's max possible contribution among its actual postings within the
@@ -405,19 +383,6 @@ def build_query_plan(terms_with_weights, term_blocks_fn, block_max_impact,
         other_parts.append(o)
     return QueryPlan(np.concatenate(idx_parts), np.concatenate(w_parts),
                      np.concatenate(ub_parts), np.concatenate(other_parts))
-
-
-def pad_plans(plans, qb_pad: int):
-    """Stack per-query plans into [Q, qb_pad] gather arrays (block 0 with
-    weight 0 as padding — contributes nothing)."""
-    q = len(plans)
-    idx = np.zeros((q, qb_pad), np.int32)
-    w = np.zeros((q, qb_pad), np.float32)
-    for i, p in enumerate(plans):
-        n = min(p.n_blocks, qb_pad)
-        idx[i, :n] = p.idx[:n]
-        w[i, :n] = p.w[:n]
-    return idx, w
 
 
 class Bm25Executor:

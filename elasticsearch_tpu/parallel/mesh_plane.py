@@ -297,7 +297,11 @@ class MeshDataPlane:
         vindex, id_map = self._vector_index(index_name, field, readers)
         if vindex is None:
             return None
-        k = min(self._want(body, vindex.n_docs), max(int(query.k), 1))
+        # size+from bounds the result like the RPC path's shard collection
+        # window (query.k bounds PER-SHARD collection there, so clamping
+        # the global mesh result by it would return fewer hits than the
+        # RPC path on multi-shard indices)
+        k = self._want(body, vindex.n_docs)
         qv = np.asarray(query.query_vector, np.float32)[None, :]
         scores, ids = vindex.search(qv, k)
         self.stats["mesh_queries"] += 1

@@ -42,7 +42,7 @@ from jax import shard_map
 from elasticsearch_tpu.index.segment import BLOCK, next_pow2
 from elasticsearch_tpu.ops.bm25 import (
     DEFAULT_B, DEFAULT_K1, P1_BUCKET, QueryPlan, TermCellIndex,
-    build_query_plan, idf as idf_fn, pad_plans, qb_bucket,
+    build_query_plan, idf as idf_fn, qb_bucket,
 )
 
 
@@ -437,8 +437,6 @@ class ShardedTextIndex:
                                  max(self.avgdl, 1e-9))
             x = np.where(v, bt[s] / np.maximum(bt[s] + norm, 1e-9), 0.0)
             self._impacts[s] = x.max(axis=1)
-        self._block_min = bd[:, :, 0]            # [S, NB] doc-range lows
-        self._block_max = bd.max(axis=2)         # [S, NB] doc-range highs
         self._cell_indexes = [
             TermCellIndex(bd[s], bt[s], doc_lens[s], self.avgdl)
             for s in range(n_shards)]
@@ -514,8 +512,7 @@ class ShardedTextIndex:
         for s in range(self.n_shards):
             out.append(build_query_plan(
                 tw, lambda t, s=s: self.term_index[s].get(t, (0, 0)),
-                self._impacts[s], self._block_min[s], self._block_max[s],
-                self._cell_indexes[s]))
+                self._impacts[s], cell_index=self._cell_indexes[s]))
         return out
 
     def _batch_fn(self, k: int):

@@ -328,6 +328,46 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/_index_template", template_get)
     r("GET", "/_index_template/{name}", template_get)
 
+    def slm_put(req: RestRequest, done: DoneFn) -> None:
+        client.put_slm_policy(req.params["name"], req.body or {},
+                              wrap_client_cb(done))
+    r("PUT", "/_slm/policy/{name}", slm_put)
+
+    def slm_get(req: RestRequest, done: DoneFn) -> None:
+        try:
+            done(200, client.node.slm_service.get(req.params.get("name")))
+        except Exception as e:  # noqa: BLE001 — unknown policy: 404
+            done(404, {"error": {"type": "resource_not_found_exception",
+                                 "reason": str(e)}, "status": 404})
+    r("GET", "/_slm/policy", slm_get)
+    r("GET", "/_slm/policy/{name}", slm_get)
+
+    def slm_delete(req: RestRequest, done: DoneFn) -> None:
+        client.delete_slm_policy(req.params["name"], wrap_client_cb(done))
+    r("DELETE", "/_slm/policy/{name}", slm_delete)
+
+    def slm_execute(req: RestRequest, done: DoneFn) -> None:
+        client.node.slm_service.execute(req.params["name"],
+                                        wrap_client_cb(done))
+    r("POST", "/_slm/policy/{name}/_execute", slm_execute)
+
+    def slm_stats(req: RestRequest, done: DoneFn) -> None:
+        done(200, dict(client.node.slm_service.stats))
+    r("GET", "/_slm/stats", slm_stats)
+
+    def data_stream_put(req: RestRequest, done: DoneFn) -> None:
+        client.create_data_stream(req.params["name"], wrap_client_cb(done))
+    r("PUT", "/_data_stream/{name}", data_stream_put)
+
+    def data_stream_delete(req: RestRequest, done: DoneFn) -> None:
+        client.delete_data_stream(req.params["name"], wrap_client_cb(done))
+    r("DELETE", "/_data_stream/{name}", data_stream_delete)
+
+    def data_stream_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.get_data_streams(req.params.get("name")))
+    r("GET", "/_data_stream", data_stream_get)
+    r("GET", "/_data_stream/{name}", data_stream_get)
+
     def ilm_put(req: RestRequest, done: DoneFn) -> None:
         client.put_ilm_policy(req.params["name"], req.body or {},
                               wrap_client_cb(done))
@@ -1126,6 +1166,13 @@ def build_controller(client: NodeClient) -> RestController:
         poll()
     r("GET", "/_cluster/health", health)
     r("GET", "/_cluster/health/{index}", health)
+
+    def remote_info(req: RestRequest, done: DoneFn) -> None:
+        """Configured remote clusters (RestRemoteClusterInfoAction)."""
+        svc = getattr(client.node, "remote_clusters", None)
+        done(200, svc.info() if svc is not None else {})
+
+    r("GET", "/_remote/info", remote_info)
 
     def cluster_state(req: RestRequest, done: DoneFn) -> None:
         from elasticsearch_tpu.xpack.security import redact_state

@@ -96,7 +96,7 @@ class _Peer:
 
     def __init__(self, my_id: str, address: Tuple[str, int],
                  on_fail_dispatch: Callable[[Callable[[], None]], None],
-                 ssl_context=None):
+                 ssl_context=None, on_message=None):
         self.my_id = my_id
         self.address = address
         self._ssl_context = ssl_context
@@ -104,6 +104,10 @@ class _Peer:
         self._sock: Optional[socket.socket] = None
         self._closed = False
         self._dispatch = on_fail_dispatch
+        # responses may ride back on THIS socket (the reference's
+        # TcpTransportChannel replies on the inbound channel): a reader
+        # thread per live connection feeds them to the transport
+        self._on_message = on_message
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"tcp-out-{address[1]}")
         self._thread.start()
@@ -124,7 +128,25 @@ class _Peer:
                 sock, server_hostname=self.address[0])
         sock.settimeout(None)
         sock.sendall(_encode_frame({"t": "hs", "node": self.my_id}))
+        if self._on_message is not None:
+            threading.Thread(target=self._read_responses, args=(sock,),
+                             daemon=True,
+                             name=f"tcp-out-read-{self.address[1]}").start()
         return sock
+
+    def _read_responses(self, sock: socket.socket) -> None:
+        """Drain frames the peer writes back on the outbound socket (reply
+        channel); ends silently when the connection resets."""
+        try:
+            while not self._closed:
+                msg = _recv_frame(sock)
+                if msg is None:
+                    return
+                cb = self._on_message
+                if cb is not None:
+                    self._dispatch(lambda m=msg: cb(m, None))
+        except (OSError, ValueError):
+            return
 
     def _loop(self) -> None:
         while True:
@@ -182,8 +204,14 @@ class TcpTransport:
         self._server: Optional[socket.socket] = None
         self._inbound: set = set()
         self._closed = False
-        # set by TcpTransportService: fn(msg: dict) on the dispatch thread
-        self.on_message: Optional[Callable[[Dict[str, Any]], None]] = None
+        # set by TcpTransportService: fn(msg, reply_conn) on the dispatch
+        # thread; reply_conn (when not None) is the socket the request
+        # arrived on — the reply channel
+        self.on_message: Optional[Callable] = None
+        # replies over inbound sockets drain through ONE writer queue PER
+        # connection (created lazily): a stalled peer wedges only its own
+        # channel, never the dispatch thread or other peers' replies
+        self._reply_channels: Dict[int, "queue.Queue"] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -229,6 +257,9 @@ class TcpTransport:
 
     def close(self) -> None:
         self._closed = True
+        with self._lock:
+            for q in self._reply_channels.values():
+                q.put(None)
         if self._server is not None:
             try:
                 self._server.close()
@@ -244,6 +275,74 @@ class TcpTransport:
                 except OSError:
                     pass
             self._inbound.clear()
+
+    # -- reply channel -------------------------------------------------------
+
+    def reply_via(self, conn, msg: Dict[str, Any],
+                  on_fail: Optional[Callable[[], None]] = None) -> None:
+        """Send a response over the socket its request arrived on (the
+        TcpTransportChannel analog) — the only route back to callers that
+        are NOT in this cluster's address book (cross-cluster search)."""
+        try:
+            frame = _encode_frame(msg)
+        except Exception:  # noqa: BLE001 — unserializable payload
+            if on_fail is not None:
+                self.scheduler.submit(on_fail)
+            return
+        key = id(conn)
+        with self._lock:
+            if self._closed:
+                q = None
+            else:
+                q = self._reply_channels.get(key)
+                if q is None:
+                    q = self._reply_channels[key] = queue.Queue()
+                    threading.Thread(
+                        target=self._reply_loop, args=(key, conn, q),
+                        daemon=True,
+                        name=f"tcp-reply-{self.bind_address[1]}").start()
+                # enqueue UNDER the lock: the idle-exit check below also
+                # holds it, so a frame can never land on a queue whose
+                # drainer already decided to exit
+                q.put((frame, on_fail))
+        if q is None and on_fail is not None:
+            self.scheduler.submit(on_fail)
+
+    def _drop_channel(self, key: int, q: "queue.Queue") -> None:
+        with self._lock:
+            if self._reply_channels.get(key) is q:
+                del self._reply_channels[key]
+
+    def _reply_loop(self, key: int, conn, q: "queue.Queue") -> None:
+        """Drain one connection's replies; exits (and fails the rest of
+        its queue) on the first write error so a dead peer's channel
+        disappears instead of accumulating."""
+        while True:
+            try:
+                item = q.get(timeout=60.0)
+            except queue.Empty:
+                # idle: exit unless a racing reply_via just enqueued
+                with self._lock:
+                    if q.empty():
+                        if self._reply_channels.get(key) is q:
+                            del self._reply_channels[key]
+                        return
+                continue
+            if item is None:
+                self._drop_channel(key, q)
+                return
+            frame, on_fail = item
+            try:
+                conn.sendall(frame)
+            except OSError:
+                if on_fail is not None:
+                    self.scheduler.submit(on_fail)
+                while not q.empty():
+                    leftover = q.get_nowait()
+                    if leftover and leftover[1] is not None:
+                        self.scheduler.submit(leftover[1])
+                self._drop_channel(key, q)
+                return
 
     # -- inbound -------------------------------------------------------------
 
@@ -300,8 +399,9 @@ class TcpTransport:
                     return
                 cb = self.on_message
                 if cb is not None:
-                    # parse on the reader thread, execute on dispatch
-                    self.scheduler.submit(lambda m=msg: cb(m))
+                    # parse on the reader thread, execute on dispatch;
+                    # the conn rides along as the reply channel
+                    self.scheduler.submit(lambda m=msg, c=conn: cb(m, c))
         except (OSError, ValueError):
             return
         finally:
@@ -313,6 +413,13 @@ class TcpTransport:
                 pass
 
     # -- outbound ------------------------------------------------------------
+
+    def _peer_message(self, msg: Dict[str, Any], conn) -> None:
+        """Frames a peer wrote back on OUR outbound socket (its reply
+        channel); already on the dispatch thread."""
+        cb = self.on_message
+        if cb is not None:
+            cb(msg, conn)
 
     def send(self, node_id: str, msg: Dict[str, Any],
              on_fail: Optional[Callable[[], None]] = None) -> None:
@@ -335,7 +442,8 @@ class TcpTransport:
                 if peer is None:
                     peer = self._peers[node_id] = _Peer(
                         self.node_id, tuple(addr), self.scheduler.submit,
-                        ssl_context=self._client_ssl_context())
+                        ssl_context=self._client_ssl_context(),
+                        on_message=self._peer_message)
         if peer is None:
             if on_fail is not None:
                 self.scheduler.submit(on_fail)
@@ -422,10 +530,10 @@ class TcpTransportService:
 
     # -- receiving -----------------------------------------------------------
 
-    def _on_message(self, msg: Dict[str, Any]) -> None:
+    def _on_message(self, msg: Dict[str, Any], reply_conn=None) -> None:
         t = msg.get("t")
         if t == "req":
-            self._handle_request(msg)
+            self._handle_request(msg, reply_conn=reply_conn)
         elif t == "res":
             finish = self._pending.get(msg.get("id"))
             if finish is None:
@@ -438,11 +546,23 @@ class TcpTransportService:
                 finish(msg.get("body") or {}, None)
 
     def _handle_request(self, msg: Dict[str, Any],
-                        local_finish=None) -> None:
+                        local_finish=None, reply_conn=None) -> None:
         self.stats["received"] += 1
         req_id = msg["id"]
         action = msg["action"]
         sender = msg["sender"]
+
+        def _send_response(payload: Dict[str, Any]) -> None:
+            # prefer the socket the request arrived on (the reference's
+            # TcpTransportChannel): the ONLY route to cross-cluster
+            # callers outside this cluster's address book, and a saved
+            # reverse connection otherwise. Fallback: address-book send.
+            if reply_conn is not None:
+                self.transport.reply_via(
+                    reply_conn, payload,
+                    on_fail=lambda: self.transport.send(sender, payload))
+            else:
+                self.transport.send(sender, payload)
 
         def reply_ok(body: Optional[Dict[str, Any]]) -> None:
             if local_finish is not None:
@@ -450,7 +570,7 @@ class TcpTransportService:
                                              default=_jsonable))
                 local_finish(body, None)
             else:
-                self.transport.send(sender, {
+                _send_response({
                     "t": "res", "id": req_id, "sender": self.node_id,
                     "action": action, "body": body if body is not None else {}})
 
@@ -459,7 +579,7 @@ class TcpTransportService:
                 local_finish(None, RemoteTransportError(
                     self.node_id, action, cause))
             else:
-                self.transport.send(sender, {
+                _send_response({
                     "t": "res", "id": req_id, "sender": self.node_id,
                     "action": action, "error": cause})
 

@@ -97,8 +97,8 @@ def test_tcp_transport_tls(certs):
     b = TcpTransport(sched, "b", ("127.0.0.1", 0), {},
                      ssl_certfile=certfile, ssl_keyfile=keyfile)
     got = []
-    a.on_message = lambda msg: got.append(msg)
-    b.on_message = lambda msg: None
+    a.on_message = lambda msg, conn=None: got.append(msg)
+    b.on_message = lambda msg, conn=None: None
     a.start()
     b.start()
     try:
