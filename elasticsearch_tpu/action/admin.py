@@ -45,6 +45,8 @@ DELETE_TEMPLATE = "indices:admin/index_template/delete"
 PUT_ILM_POLICY = "cluster:admin/ilm/put"
 DELETE_ILM_POLICY = "cluster:admin/ilm/delete"
 ROLLOVER = "indices:admin/rollover"
+CREATE_DATA_STREAM = "indices:admin/data_stream/create"
+DELETE_DATA_STREAM = "indices:admin/data_stream/delete"
 PUT_SECURITY = "cluster:admin/xpack/security/put"
 DELETE_SECURITY = "cluster:admin/xpack/security/delete"
 PUT_CUSTOM = "cluster:admin/xpack/custom/put"
@@ -68,6 +70,12 @@ def next_rollover_name(name: str) -> str:
         prefix, digits = m.groups()
         return f"{prefix}-{int(digits) + 1:0{len(digits)}d}"
     return f"{name}-000001"
+
+
+def backing_index_name(stream: str, generation: int) -> str:
+    """.ds-<stream>-NNNNNN (DataStream.getDefaultBackingIndexName analog,
+    minus the date component — generations alone keep names unique)."""
+    return f".ds-{stream}-{generation:06d}"
 
 
 def _validate_mappings(mappings: Dict[str, Any],
@@ -109,6 +117,8 @@ class MasterActions:
             (PUT_ILM_POLICY, self._on_put_ilm_policy),
             (DELETE_ILM_POLICY, self._on_delete_ilm_policy),
             (ROLLOVER, self._on_rollover),
+            (CREATE_DATA_STREAM, self._on_create_data_stream),
+            (DELETE_DATA_STREAM, self._on_delete_data_stream),
             (PUT_SECURITY, self._on_put_security),
             (DELETE_SECURITY, self._on_delete_security),
             (PUT_CUSTOM, self._on_put_custom),
@@ -161,11 +171,15 @@ class MasterActions:
     def _create_into(self, state: ClusterState, name: str,
                      req_settings: Dict[str, Any],
                      req_mappings: Dict[str, Any],
-                     ignore_templates: bool = False) -> ClusterState:
+                     ignore_templates: bool = False,
+                     template_for: Optional[str] = None) -> ClusterState:
         """Create ``name`` in ``state`` with matching composable templates
         applied — lowest priority first, the explicit request winning
         (MetadataCreateIndexService.applyCreateIndexRequestWithV2Template).
-        Shared by create-index and the atomic half of rollover."""
+        Shared by create-index and the atomic half of rollover.
+        ``template_for``: match templates against this name instead of the
+        index's own (data-stream backing indices match their STREAM name,
+        never the .ds-* backing name)."""
         settings: Dict[str, Any] = {}
         aliases: list = []
         service = MapperService()
@@ -177,7 +191,8 @@ class MasterActions:
         # (MetadataCreateIndexService resize path sets no templates)
         layers = [] if ignore_templates else [
             t.get("template") or {}
-            for _n, t in state.metadata.matching_templates(name)[:1]]
+            for _n, t in state.metadata.matching_templates(
+                template_for or name)[:1]]
         for tmpl in layers:
             settings.update(tmpl.get("settings") or {})
             a = tmpl.get("aliases") or {}
@@ -217,8 +232,17 @@ class MasterActions:
 
         def update(state: ClusterState) -> ClusterState:
             resolved = state.metadata.index(name).name   # raises if missing
+            md = state.metadata.remove_index(resolved)
+            # a deleted backing index leaves its data stream's list, or
+            # the stream would resolve to a ghost (ILM deletes aged
+            # backing indices out of live streams)
+            for ds_name, ds in md.data_streams.items():
+                if resolved in ds.get("indices", []):
+                    md = md.with_data_stream(ds_name, {
+                        **ds, "indices": [n for n in ds["indices"]
+                                          if n != resolved]})
             return state.next_version(
-                metadata=state.metadata.remove_index(resolved),
+                metadata=md,
                 routing_table=state.routing_table.remove_index(resolved))
         return self._submit(f"delete-index [{name}]", update)
 
@@ -463,11 +487,121 @@ class MasterActions:
 
     # -- rollover (TransportRolloverAction's atomic state half) ----------
 
+    def _on_create_data_stream(self, req: Dict[str, Any],
+                               sender: str) -> Deferred:
+        """Create a data stream + its first backing index atomically
+        (CreateDataStreamAction.java:47 / MetadataCreateDataStreamService).
+        Requires a matching composable template that DECLARES data_stream —
+        the template supplies the backing indices' mappings/settings."""
+        name = req["name"]
+        if not name or name.startswith((".", "_")) or name != name.lower() \
+                or any(c in name for c in ' ,"*\\<>|?/:'):
+            raise IllegalArgumentError(f"invalid data stream name [{name}]")
+
+        def update(state: ClusterState) -> ClusterState:
+            md = state.metadata
+            if md.has_index(name) or name in md.data_streams:
+                raise IllegalArgumentError(
+                    f"data stream or index [{name}] already exists")
+            ds_spec = None
+            for _n, t in md.matching_templates(name):
+                if "data_stream" in t:
+                    ds_spec = t.get("data_stream") or {}
+                    break
+            if ds_spec is None:
+                raise IllegalArgumentError(
+                    f"no matching index template with a data_stream "
+                    f"definition for [{name}]")
+            ts_field = (ds_spec.get("timestamp_field") or {}) \
+                .get("name", "@timestamp")
+            backing = backing_index_name(name, 1)
+            state = self._create_into(state, backing,
+                                      {"index.hidden": True}, {},
+                                      template_for=name)
+            md = state.metadata.with_data_stream(name, {
+                "name": name,
+                "timestamp_field": {"name": ts_field},
+                "generation": 1,
+                "indices": [backing]})
+            return state.next_version(metadata=md)
+        return self._submit(f"create-data-stream [{name}]", update)
+
+    def _on_delete_data_stream(self, req: Dict[str, Any],
+                               sender: str) -> Deferred:
+        """Delete a data stream and EVERY backing index
+        (DeleteDataStreamAction analog)."""
+        name = req["name"]
+
+        def update(state: ClusterState) -> ClusterState:
+            ds = state.metadata.data_streams.get(name)
+            if ds is None:
+                raise IndexNotFoundError(name)
+            md = state.metadata
+            rt = state.routing_table
+            for backing in ds.get("indices", []):
+                if backing in md.indices:
+                    md = md.remove_index(backing)
+                    rt = rt.remove_index(backing)
+            md = md.with_data_stream(name, None)
+            return state.next_version(metadata=md, routing_table=rt)
+        return self._submit(f"delete-data-stream [{name}]", update)
+
+    def _rollover_data_stream(self, req: Dict[str, Any]) -> Deferred:
+        """Data-stream rollover: next backing index, generation bump —
+        one atomic state update (MetadataRolloverService's data-stream
+        branch)."""
+        ds_name = req["data_stream"]
+
+        def update(state: ClusterState) -> ClusterState:
+            md = state.metadata
+            ds = md.data_streams.get(ds_name)
+            if ds is None:
+                raise IndexNotFoundError(ds_name)
+            gen = int(ds.get("generation", 1)) + 1
+            new_name = req.get("new_index") or \
+                backing_index_name(ds_name, gen)
+            if md.has_index(new_name):
+                raise IllegalArgumentError(
+                    f"rollover target [{new_name}] already exists")
+            state = self._create_into(state, new_name,
+                                      {"index.hidden": True,
+                                       **dict(req.get("settings") or {})},
+                                      dict(req.get("mappings") or {}),
+                                      template_for=ds_name)
+            md = state.metadata
+            now_ms = int(self.coordinator.scheduler.wall_now() * 1000)
+            old_name = ds["indices"][-1] if ds.get("indices") else None
+            if old_name and old_name in md.indices:
+                md = md.update_index(md.indices[old_name].with_settings(
+                    {"index.rollover_date": now_ms}))
+            md = md.with_data_stream(ds_name, {
+                **ds, "generation": gen,
+                "indices": list(ds.get("indices", [])) + [new_name]})
+            return state.next_version(metadata=md)
+
+        deferred = Deferred()
+
+        def done(err: Optional[Exception]) -> None:
+            if err is not None:
+                deferred.reject(err)
+            else:
+                state = self.coordinator.applied_state
+                ds = state.metadata.data_streams.get(ds_name) or {}
+                indices = ds.get("indices") or [None]
+                deferred.resolve({
+                    "acknowledged": True, "rolled_over": True,
+                    "new_index": indices[-1]})
+        self.coordinator.submit_state_update(
+            f"rollover-data-stream [{ds_name}]", update, done)
+        return deferred
+
     def _on_rollover(self, req: Dict[str, Any], sender: str) -> Deferred:
         """Atomically create the next index in the series and swap the
         write alias. Condition evaluation (doc counts, age) happens on the
         coordinator BEFORE this is sent; this handler is the single
         cluster-state update (MetadataRolloverService.rolloverClusterState)."""
+        if req.get("data_stream"):
+            return self._rollover_data_stream(req)
         alias = req["alias"]
 
         def update(state: ClusterState) -> ClusterState:

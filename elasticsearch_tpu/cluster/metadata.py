@@ -130,12 +130,31 @@ class Metadata:
     persistent_settings: Mapping[str, Any] = field(default_factory=dict)
     version: int = 0
 
+    @property
+    def data_streams(self) -> Dict[str, Any]:
+        """name -> {timestamp_field, generation, indices: [backing...]}
+        (cluster/metadata/DataStream.java analog, stored as a custom
+        section so it replicates/persists like all metadata)."""
+        return dict(self.custom.get("data_streams", {}))
+
+    def with_data_stream(self, name: str,
+                         body: Optional[Mapping[str, Any]]) -> "Metadata":
+        return self.with_custom_entry("data_streams", name, body)
+
     def index(self, name: str) -> IndexMetadata:
         # alias resolution: a name may be an alias for exactly one index,
         # or for several when exactly one carries is_write_index
         # (AliasOrIndex.Alias.getWriteIndex semantics)
         if name in self.indices:
             return self.indices[name]
+        ds = self.custom.get("data_streams", {}).get(name)
+        if ds and ds.get("indices"):
+            # a data stream resolves to its WRITE index (the latest
+            # backing index) for single-index operations
+            backing = ds["indices"][-1]
+            if backing not in self.indices:
+                raise IndexNotFoundError(backing)
+            return self.indices[backing]
         matches = [im for im in self.indices.values() if name in im.aliases]
         if len(matches) == 1:
             return matches[0]
@@ -291,6 +310,7 @@ def resolve_index_expression(expression: Optional[str],
     for im in metadata.indices.values():
         for alias in im.aliases:
             alias_map.setdefault(alias, []).append(im.name)
+    streams = metadata.custom.get("data_streams", {})
     for part in (expression or "_all").split(","):
         part = part.strip()
         if part in ("_all", "*", ""):
@@ -299,11 +319,20 @@ def resolve_index_expression(expression: Optional[str],
             matched = [n for n in all_names if fnmatch.fnmatch(n, part)]
             matched += [n for a, targets in alias_map.items()
                         if fnmatch.fnmatch(a, part) for n in targets]
+            # a wildcard over data-stream NAMES reaches all their backing
+            # indices (IndexNameExpressionResolver's data-stream aware
+            # wildcard resolution)
+            for ds_name, ds in streams.items():
+                if fnmatch.fnmatch(ds_name, part):
+                    matched += list(ds.get("indices", []))
             names.update(matched)
         elif part in metadata.indices:
             names.add(part)
         elif part in alias_map:
             names.update(alias_map[part])
+        elif part in streams:
+            # searching a data stream searches EVERY backing index
+            names.update(streams[part].get("indices", []))
         else:
             raise IndexNotFoundError(part)
     return sorted(names)

@@ -1,22 +1,34 @@
-"""Index lifecycle management (ILM-lite): hot -> rollover, then delete.
+"""Index lifecycle management: hot -> warm -> cold -> delete.
 
 Reference: x-pack/plugin/ilm/.../IndexLifecycleService.java:53 — a
 master-side periodic service that walks indices carrying an
 ``index.lifecycle.name`` setting and advances them through their policy's
-phases. This build implements the two phases that cover the dominant
-time-series workflow:
+phases. Phases and actions implemented:
 
-  hot:    {actions: {rollover: {max_age, max_docs}}}  — roll the write
-          alias (``index.lifecycle.rollover_alias``) when a condition
-          trips; the rollover API applies matching index templates to the
-          new index, so the series keeps its mappings.
-  delete: {min_age: "30d", ...}                       — delete an index
-          once it has been rolled over (or created) ``min_age`` ago.
+  hot:    {actions: {rollover: {max_age, max_docs}}} — roll the write
+          alias (``index.lifecycle.rollover_alias``) or the data stream
+          the index backs; rollover applies matching templates so the
+          series keeps its mappings.
+  warm:   {min_age, actions: {readonly: {}, forcemerge:
+          {max_num_segments}, shrink: {number_of_shards}}} — write-block,
+          merge segments, and optionally shrink to fewer shards (the
+          shrunken index REPLACES the original in its aliases/data
+          stream, then the original is deleted — ShrinkStep +
+          ShrinkSetAliasStep semantics).
+  cold:   {min_age, actions: {searchable_snapshot:
+          {snapshot_repository}}} — snapshot the index into the repo,
+          mount it back as a repo-backed searchable index replacing the
+          original (MountSnapshotStep).
+  delete: {min_age} — delete the index (and drop it from its stream).
 
-The loop only acts while this node is the elected master (the reference
-gates on the same condition), and every action goes through the normal
-master APIs — ILM is policy over the existing primitives, not a second
-control plane.
+The age origin is the rollover date when the index has been rolled (or
+creation date for policies without a rollover action) — an index that is
+still its series' write target is never advanced past hot. Steps are
+idempotent and marked in index settings ("index.lifecycle.*"), so a
+master failover resumes mid-phase work from the replicated state. The
+loop only acts while this node is the elected master, and every action
+goes through the normal master APIs — ILM is policy over the existing
+primitives, not a second control plane.
 """
 
 from __future__ import annotations
@@ -30,6 +42,8 @@ logger = logging.getLogger(__name__)
 
 POLL_INTERVAL_SETTING = "indices.lifecycle.poll_interval"
 DEFAULT_POLL_INTERVAL = 10.0
+
+PHASE_ORDER = ("hot", "warm", "cold", "delete")
 
 
 class IndexLifecycleService:
@@ -79,9 +93,16 @@ class IndexLifecycleService:
     def run_once(self) -> None:
         """One pass over managed indices (triggerPolicies analog). Public
         so tests and an explicit API can step the lifecycle without
-        waiting for the poll timer."""
+        waiting for the poll timer. Each pass advances each index by at
+        most one step — repeated passes converge."""
         state = self.node._applied_state()
         now_ms = self.node.scheduler.wall_now() * 1000
+        # backing index -> (stream name, is_write_index)
+        stream_of: Dict[str, tuple] = {}
+        for ds_name, ds in state.metadata.data_streams.items():
+            indices = ds.get("indices", [])
+            for i, backing in enumerate(indices):
+                stream_of[backing] = (ds_name, i == len(indices) - 1)
         for meta in list(state.metadata.indices.values()):
             policy_name = meta.settings.get("index.lifecycle.name")
             if not policy_name:
@@ -91,38 +112,187 @@ class IndexLifecycleService:
                 continue
             phases = policy.get("phases") or {}
             try:
-                self._advance(meta, phases, now_ms)
+                self._advance(meta, phases, now_ms,
+                              stream_of.get(meta.name))
             except Exception:  # noqa: BLE001 — one index must not stall ILM
                 logger.exception("ilm advance failed for [%s]", meta.name)
 
-    def _advance(self, meta, phases: Dict[str, Any], now_ms: float) -> None:
-        rolled_ms = meta.settings.get("index.rollover_date")
-        delete_phase = phases.get("delete") or {}
+    # -- per-index step machine ------------------------------------------
+
+    def _advance(self, meta, phases: Dict[str, Any], now_ms: float,
+                 stream: Optional[tuple]) -> None:
         hot = (phases.get("hot") or {}).get("actions") or {}
         rollover = hot.get("rollover")
 
-        # delete-phase age origin: the rollover when one happened; for a
-        # policy WITHOUT a rollover action, the creation date — an index
-        # that is still this series' write target (rollover pending) is
-        # never deleted out from under the writers
-        origin_ms = None
+        # age origin (delete/warm/cold phases): the rollover when one
+        # happened; for a policy WITHOUT a rollover action, the creation
+        # date — an index that is still this series' write target
+        # (rollover pending) is never advanced out from under the writers
+        rolled_ms = meta.settings.get("index.rollover_date")
+        origin_ms: Optional[float] = None
         if rolled_ms is not None:
             origin_ms = int(rolled_ms)
         elif rollover is None:
             origin_ms = int(meta.settings.get("index.creation_date", 0)
                             or 0) or None
-        if delete_phase and origin_ms is not None:
-            min_age_s = parse_time_to_seconds(
-                delete_phase.get("min_age", 0))
-            if now_ms - origin_ms >= min_age_s * 1000:
-                logger.info("ilm: deleting [%s] (delete phase)", meta.name)
-                self.node.client.delete_index(meta.name, _log_err)
-            return
 
+        if origin_ms is not None:
+            age_ms = now_ms - origin_ms
+            for phase_name in ("delete", "cold", "warm"):
+                phase = phases.get(phase_name)
+                if phase is None:
+                    continue
+                min_age_s = parse_time_to_seconds(phase.get("min_age", 0))
+                if age_ms >= min_age_s * 1000:
+                    getattr(self, f"_run_{phase_name}")(
+                        meta, phase.get("actions") or {}, stream)
+                    return
+
+        # hot: rollover the alias or data stream this index writes for
         alias = meta.settings.get("index.lifecycle.rollover_alias")
         if rollover is not None and alias and alias in meta.aliases:
             self.node.client.rollover(
                 alias, {"conditions": dict(rollover)}, _log_err)
+        elif rollover is not None and stream is not None and stream[1]:
+            self.node.client.rollover(
+                stream[0], {"conditions": dict(rollover)}, _log_err)
+
+    def _run_delete(self, meta, _actions, _stream) -> None:
+        logger.info("ilm: deleting [%s] (delete phase)", meta.name)
+        self.node.client.delete_index(meta.name, _log_err)
+
+    def _run_warm(self, meta, actions: Dict[str, Any], stream) -> None:
+        """One warm step per pass: readonly -> forcemerge -> shrink."""
+        client = self.node.client
+        if "readonly" in actions and \
+                not meta.settings.get("index.blocks.write"):
+            client.update_settings(meta.name,
+                                   {"index.blocks.write": True}, _log_err)
+            return
+        if "forcemerge" in actions and \
+                not meta.settings.get("index.lifecycle.forcemerged"):
+            segs = int((actions.get("forcemerge") or {})
+                       .get("max_num_segments", 1))
+
+            def mark(_r, err):
+                if err is None:
+                    client.update_settings(
+                        meta.name,
+                        {"index.lifecycle.forcemerged": True}, _log_err)
+                else:
+                    _log_err(None, err)
+            client.force_merge(meta.name, mark, max_num_segments=segs)
+            return
+        if "shrink" in actions and \
+                not meta.settings.get("index.lifecycle.shrink_source"):
+            target = f"shrink-{meta.name}"
+            state = self.node._applied_state()
+            if not meta.settings.get("index.blocks.write"):
+                # shrink requires the write block even without readonly
+                client.update_settings(
+                    meta.name, {"index.blocks.write": True}, _log_err)
+                return
+            if state.metadata.has_index(target):
+                self._swap_references(meta, target, stream)
+                return
+            n = int((actions.get("shrink") or {})
+                    .get("number_of_shards", 1))
+            self.node.resize_actions.resize(
+                "shrink", meta.name, target,
+                {"settings": {
+                    "index.number_of_shards": n,
+                    # the target inherits the policy at the WARM phase
+                    # with shrink already done (marker below)
+                    "index.lifecycle.name":
+                        meta.settings.get("index.lifecycle.name"),
+                    "index.lifecycle.shrink_source": meta.name,
+                    "index.rollover_date":
+                        meta.settings.get("index.rollover_date"),
+                    "index.lifecycle.forcemerged": True,
+                }}, _log_err)
+            return
+
+    def _run_cold(self, meta, actions: Dict[str, Any], stream) -> None:
+        """Cold: snapshot + mount back as a searchable-snapshot index
+        replacing the original."""
+        spec = actions.get("searchable_snapshot")
+        if spec is None:
+            # cold without searchable_snapshot: just ensure read-only
+            if not meta.settings.get("index.blocks.write"):
+                self.node.client.update_settings(
+                    meta.name, {"index.blocks.write": True}, _log_err)
+            return
+        if meta.settings.get("index.store.snapshot.repository_name"):
+            return   # already mounted (this IS the restored index)
+        repo = spec.get("snapshot_repository")
+        if not repo:
+            return
+        client = self.node.client
+        snap = f"ilm-{meta.name}"
+        target = f"restored-{meta.name}"
+        state = self.node._applied_state()
+        if state.metadata.has_index(target):
+            self._swap_references(meta, target, stream)
+            return
+        if not meta.settings.get("index.lifecycle.snapshot_started"):
+            def started(_r, err):
+                if err is None:
+                    client.update_settings(
+                        meta.name,
+                        {"index.lifecycle.snapshot_started": snap},
+                        _log_err)
+                else:
+                    _log_err(None, err)
+            client.create_snapshot(repo, snap,
+                                   {"indices": meta.name}, started)
+            return
+        # snapshot taken: mount it back under the restored name, keeping
+        # the policy so the delete phase still applies to the mount
+        self.node.searchable_snapshots.mount(repo, snap, {
+            "index": meta.name, "renamed_index": target,
+            "index_settings": {
+                "index.lifecycle.name":
+                    meta.settings.get("index.lifecycle.name"),
+                "index.rollover_date":
+                    meta.settings.get("index.rollover_date"),
+            }}, _log_err)
+
+    def _swap_references(self, old_meta, target: str, stream) -> None:
+        """The transformed index replaces the original in its data stream
+        or aliases, then the original is deleted (ShrinkSetAliasStep /
+        SwapAliasesAndDeleteSourceIndexStep)."""
+        client = self.node.client
+        if stream is not None:
+            ds_name = stream[0]
+            state = self.node._applied_state()
+            ds = state.metadata.data_streams.get(ds_name)
+            if ds is not None:
+                from elasticsearch_tpu.action.admin import PUT_CUSTOM
+                indices = [target if n == old_meta.name else n
+                           for n in ds.get("indices", [])]
+
+                def then_delete(_r, err):
+                    if err is None:
+                        client.delete_index(old_meta.name, _log_err)
+                    else:
+                        _log_err(None, err)
+                self.node.master_client.execute(PUT_CUSTOM, {
+                    "section": "data_streams", "name": ds_name,
+                    "body": {**ds, "indices": indices}}, then_delete)
+                return
+        aliases = list(old_meta.aliases)
+        if aliases:
+            actions = [{"add": {"index": target, "alias": a}}
+                       for a in aliases]
+
+            def then_delete(_r, err):
+                if err is None:
+                    client.delete_index(old_meta.name, _log_err)
+                else:
+                    _log_err(None, err)
+            client.update_aliases(actions, then_delete)
+            return
+        client.delete_index(old_meta.name, _log_err)
 
 
 def _log_err(_resp: Optional[Dict[str, Any]], err: Optional[Exception]

@@ -1,0 +1,218 @@
+"""Data streams + full-lifecycle ILM (warm/cold phases) + SLM.
+
+Reference: action/admin/indices/datastream/CreateDataStreamAction.java:47,
+cluster/metadata/DataStream.java (write-index routing, backing-index
+resolution), IndexLifecycleService.java warm/cold actions, and
+slm/SnapshotLifecycleService.java:43. VERDICT r3 missing #2 / next #5.
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = InProcessCluster(n_nodes=1, seed=31, data_path=str(tmp_path))
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _setup_stream(cluster, client, name="logs", policy=None):
+    template = {
+        "index_patterns": [f"{name}*"],
+        "data_stream": {},
+        "template": {"settings": {"number_of_replicas": 0,
+                                  **(policy or {})},
+                     "mappings": {"properties": {
+                         "@timestamp": {"type": "date"},
+                         "msg": {"type": "text"}}}}}
+    _ok(*cluster.call(lambda cb: client.put_index_template(
+        f"{name}-t", template, cb)))
+    _ok(*cluster.call(lambda cb: client.create_data_stream(name, cb)))
+    cluster.ensure_green(f".ds-{name}-000001")
+
+
+def test_data_stream_crud_write_search_rollover(cluster):
+    client = cluster.client()
+
+    # creation without a data_stream template is rejected
+    _, err = cluster.call(lambda cb: client.create_data_stream("nope", cb))
+    assert err is not None and "data_stream" in str(err)
+
+    _setup_stream(cluster, client)
+    state = cluster.master()._applied_state()
+    ds = state.metadata.data_streams["logs"]
+    assert ds["generation"] == 1
+    assert ds["indices"] == [".ds-logs-000001"]
+    # template mappings applied to the backing index
+    assert state.metadata.index(".ds-logs-000001").mappings[
+        "properties"]["msg"]["type"] == "text"
+
+    # writes to the STREAM name land in the write backing index
+    for i in range(3):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "logs", f"d{i}", {"@timestamp": i, "msg": f"alpha {i}"}, cb)))
+    cluster.call(lambda cb: client.refresh(".ds-logs-000001", cb))
+
+    # manual rollover: next generation becomes the write index
+    resp = _ok(*cluster.call(lambda cb: client.rollover("logs", {}, cb)))
+    assert resp["rolled_over"] is True
+    assert resp["new_index"] == ".ds-logs-000002"
+    cluster.ensure_green(".ds-logs-000002")
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "logs", "d3", {"@timestamp": 3, "msg": "alpha 3"}, cb)))
+    cluster.call(lambda cb: client.refresh(".ds-logs-000002", cb))
+    state = cluster.master()._applied_state()
+    assert state.metadata.index(".ds-logs-000002").mappings[
+        "properties"]["msg"]["type"] == "text"   # template reapplied
+
+    # searching the stream name spans ALL backing generations
+    res = _ok(*cluster.call(lambda cb: client.search(
+        "logs", {"query": {"match": {"msg": "alpha"}}, "size": 10}, cb)))
+    assert res["hits"]["total"]["value"] == 4
+
+    # GET shape
+    got = client.get_data_streams("logs")
+    assert got["data_streams"][0]["generation"] == 2
+    assert [i["index_name"] for i in got["data_streams"][0]["indices"]] \
+        == [".ds-logs-000001", ".ds-logs-000002"]
+
+    # DELETE removes the stream and every backing index
+    _ok(*cluster.call(lambda cb: client.delete_data_stream("logs", cb)))
+    state = cluster.master()._applied_state()
+    assert "logs" not in state.metadata.data_streams
+    assert not state.metadata.has_index(".ds-logs-000001")
+    assert not state.metadata.has_index(".ds-logs-000002")
+
+
+def test_data_stream_ages_through_full_lifecycle(cluster):
+    """The VERDICT r3 'done' criterion: a data stream ages
+    hot -> warm (readonly+forcemerge) -> cold (searchable snapshot)
+    -> delete in a virtual-clock test."""
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.put_repository(
+        "backups", {"type": "fs", "settings": {
+            "location": cluster.data_path + "/repo"}}, cb)))
+    _ok(*cluster.call(lambda cb: client.put_ilm_policy("full", {
+        "policy": {"phases": {
+            "hot": {"actions": {"rollover": {"max_docs": 2}}},
+            "warm": {"min_age": "10m", "actions": {
+                "readonly": {}, "forcemerge": {"max_num_segments": 1}}},
+            "cold": {"min_age": "1h", "actions": {
+                "searchable_snapshot": {
+                    "snapshot_repository": "backups"}}},
+            "delete": {"min_age": "24h"},
+        }}}, cb)))
+    _setup_stream(cluster, client, name="metrics",
+                  policy={"index.lifecycle.name": "full"})
+
+    for i in range(3):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "metrics", f"m{i}", {"@timestamp": i, "msg": "x"}, cb)))
+    cluster.call(lambda cb: client.refresh(".ds-metrics-000001", cb))
+
+    def tick(times=1):
+        for _ in range(times):
+            cluster.master().ilm_service.run_once()
+            cluster.scheduler.run_for(5.0)
+
+    # hot: rollover fires on the stream (max_docs=2 exceeded)
+    tick()
+    state = cluster.master()._applied_state()
+    ds = state.metadata.data_streams["metrics"]
+    assert ds["generation"] == 2
+    assert ds["indices"][-1] == ".ds-metrics-000002"
+    gen1 = ".ds-metrics-000001"
+
+    # warm after 10m: readonly, then forcemerge marker
+    cluster.scheduler.run_for(601.0)
+    tick(2)
+    state = cluster.master()._applied_state()
+    meta = state.metadata.indices[gen1]
+    assert meta.settings.get("index.blocks.write")
+    assert meta.settings.get("index.lifecycle.forcemerged")
+
+    # cold after 1h: snapshot + mount replaces gen1 in the stream
+    cluster.scheduler.run_for(3600.0)
+    tick(3)
+    state = cluster.master()._applied_state()
+    ds = state.metadata.data_streams["metrics"]
+    assert f"restored-{gen1}" in ds["indices"], ds
+    assert not state.metadata.has_index(gen1)
+    mounted = state.metadata.indices[f"restored-{gen1}"]
+    assert mounted.settings.get(
+        "index.store.snapshot.repository_name") == "backups"
+    # the stream stays searchable across the swap
+    res = _ok(*cluster.call(lambda cb: client.search(
+        "metrics", {"query": {"match_all": {}}, "size": 10}, cb)))
+    assert res["hits"]["total"]["value"] == 3
+
+    # delete after 24h: the mounted index leaves the stream and cluster
+    cluster.scheduler.run_for(24 * 3600.0)
+    tick(2)
+    state = cluster.master()._applied_state()
+    assert not state.metadata.has_index(f"restored-{gen1}")
+    assert f"restored-{gen1}" not in \
+        state.metadata.data_streams["metrics"]["indices"]
+    # the live write index survives
+    assert state.metadata.has_index(".ds-metrics-000002")
+
+
+def test_slm_scheduled_snapshots_and_retention(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.put_repository(
+        "backups", {"type": "fs", "settings": {
+            "location": cluster.data_path + "/slmrepo"}}, cb)))
+    _ok(*cluster.call(lambda cb: client.create_index(
+        "docs", {"settings": {"number_of_replicas": 0}}, cb)))
+    cluster.ensure_green("docs")
+    _ok(*cluster.call(lambda cb: client.index_doc(
+        "docs", "1", {"x": 1}, cb)))
+
+    # malformed policy rejected
+    _, err = cluster.call(lambda cb: client.put_slm_policy(
+        "bad", {"name": "s"}, cb))
+    assert err is not None
+
+    _ok(*cluster.call(lambda cb: client.put_slm_policy("nightly", {
+        "schedule": {"interval": "30m"},
+        "name": "snap", "repository": "backups",
+        "config": {"indices": "docs"},
+        "retention": {"expire_after": "2h", "min_count": 1,
+                      "max_count": 2}}, cb)))
+
+    slm = cluster.master().slm_service
+    # scheduler fires on interval boundaries (virtual clock)
+    slm.run_once()
+    cluster.scheduler.run_for(5.0)
+    snaps = client.get_snapshots("backups")
+    assert [s["snapshot"] for s in snaps["snapshots"]] == ["snap-000001"]
+
+    # within the interval: no second snapshot
+    slm.run_once()
+    cluster.scheduler.run_for(5.0)
+    assert len(client.get_snapshots("backups")["snapshots"]) == 1
+
+    # two more intervals -> two more snapshots, but max_count=2 prunes
+    for _ in range(2):
+        cluster.scheduler.run_for(1801.0)
+        slm.run_once()
+        cluster.scheduler.run_for(5.0)
+    names = sorted(s["snapshot"]
+                   for s in client.get_snapshots("backups")["snapshots"])
+    assert names == ["snap-000002", "snap-000003"]   # oldest pruned
+
+    # explicit execute API
+    resp = {}
+    slm.execute("nightly",
+                lambda r, e: resp.update(r or {"err": e}))
+    cluster.scheduler.run_for(5.0)
+    assert resp.get("snapshot_name") == "snap-000004"
+    assert slm.get("nightly")["nightly"]["last_success"] == "snap-000004"
