@@ -232,6 +232,17 @@ class MasterActions:
 
         def update(state: ClusterState) -> ClusterState:
             resolved = state.metadata.index(name).name   # raises if missing
+            # the WRITE index of a data stream cannot be deleted directly
+            # (and DELETE /<stream> resolves to it): the stream would be
+            # corrupted — the _data_stream API owns that operation. Aged
+            # NON-write backing indices delete normally (ILM does).
+            for ds_name, ds in state.metadata.data_streams.items():
+                indices = ds.get("indices", [])
+                if indices and resolved == indices[-1]:
+                    raise IllegalArgumentError(
+                        f"index [{resolved}] is the write index of data "
+                        f"stream [{ds_name}]; delete the data stream via "
+                        f"DELETE /_data_stream/{ds_name}")
             md = state.metadata.remove_index(resolved)
             # a deleted backing index leaves its data stream's list, or
             # the stream would resolve to a ghost (ILM deletes aged
@@ -431,7 +442,7 @@ class MasterActions:
 
     def _on_put_security(self, req: Dict[str, Any], sender: str) -> Deferred:
         kind, name = req["kind"], req["name"]
-        if kind not in ("users", "roles"):
+        if kind not in ("users", "roles", "api_keys"):
             raise IllegalArgumentError(f"unknown security kind [{kind}]")
         body = dict(req.get("body") or {})
 

@@ -167,6 +167,13 @@ class Node:
 
         from elasticsearch_tpu.xpack.security import SecurityService
         self.security = SecurityService(self)
+        from elasticsearch_tpu.utils.resource_watcher import (
+            ResourceWatcherService,
+        )
+        self.resource_watcher = ResourceWatcherService(self.scheduler)
+        if self.security.file_realm.path:
+            self.resource_watcher.watch(self.security.file_realm.path,
+                                        self.security.file_realm.reload)
 
         from elasticsearch_tpu.xpack.async_search import AsyncSearchService
         self.async_search = AsyncSearchService(self)
@@ -293,6 +300,7 @@ class Node:
         self.coordinator.start()
         self.ilm_service.start()
         self.slm_service.start()
+        self.resource_watcher.start()
         self.transform_service.start()
         self.watcher_service.start()
         self.ccr_service.start()
@@ -309,6 +317,7 @@ class Node:
         self.transform_service.stop()
         self.ilm_service.stop()
         self.slm_service.stop()
+        self.resource_watcher.stop()
         self.coordinator.stop()
         self.transport_service.close()
         self.indices_service.close()

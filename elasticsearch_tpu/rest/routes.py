@@ -432,6 +432,31 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/_security/role", role_get)
     r("GET", "/_security/role/{name}", role_get)
 
+    def _caller(req: RestRequest):
+        """The authenticated principal record the security filter stashed
+        (api-key endpoints are owner-scoped, not path-privileged)."""
+        got = req.params.get("_authenticated_record")
+        if got is None:
+            # security disabled: act as the anonymous superuser
+            got = {"username": "_anonymous", "roles": ["superuser"]}
+        return got
+
+    def api_key_create(req: RestRequest, done: DoneFn) -> None:
+        client.node.security.create_api_key(
+            _caller(req), req.body or {}, wrap_client_cb(done))
+    r("POST", "/_security/api_key", api_key_create)
+    r("PUT", "/_security/api_key", api_key_create)
+
+    def api_key_get(req: RestRequest, done: DoneFn) -> None:
+        done(200, client.node.security.get_api_keys(
+            _caller(req), (req.query or {}).get("id")))
+    r("GET", "/_security/api_key", api_key_get)
+
+    def api_key_invalidate(req: RestRequest, done: DoneFn) -> None:
+        client.node.security.invalidate_api_keys(
+            _caller(req), req.body or {}, wrap_client_cb(done))
+    r("DELETE", "/_security/api_key", api_key_invalidate)
+
     # -- transforms (x-pack/plugin/transform REST surface) ----------------
 
     def transform_put(req: RestRequest, done: DoneFn) -> None:
@@ -491,6 +516,26 @@ def build_controller(client: NodeClient) -> RestController:
         done(200, client.node.ccr_service.stats(req.params.get("index")))
     r("GET", "/_ccr/stats", ccr_stats)
     r("GET", "/{index}/_ccr/stats", ccr_stats)
+
+    def ccr_auto_follow_put(req: RestRequest, done: DoneFn) -> None:
+        client.node.ccr_service.put_auto_follow(
+            req.params["name"], req.body or {}, wrap_client_cb(done))
+    r("PUT", "/_ccr/auto_follow/{name}", ccr_auto_follow_put)
+
+    def ccr_auto_follow_delete(req: RestRequest, done: DoneFn) -> None:
+        client.node.ccr_service.delete_auto_follow(
+            req.params["name"], wrap_client_cb(done))
+    r("DELETE", "/_ccr/auto_follow/{name}", ccr_auto_follow_delete)
+
+    def ccr_auto_follow_get(req: RestRequest, done: DoneFn) -> None:
+        try:
+            done(200, client.node.ccr_service.get_auto_follow(
+                req.params.get("name")))
+        except Exception as e:  # noqa: BLE001 — unknown pattern: 404
+            done(404, {"error": {"type": "resource_not_found_exception",
+                                 "reason": str(e)}, "status": 404})
+    r("GET", "/_ccr/auto_follow", ccr_auto_follow_get)
+    r("GET", "/_ccr/auto_follow/{name}", ccr_auto_follow_get)
 
     # -- observability: hot threads + explicit reroute --------------------
 

@@ -41,6 +41,7 @@ from elasticsearch_tpu.utils.errors import (
 logger = logging.getLogger(__name__)
 
 SECTION = "ccr_follows"
+AUTO_FOLLOW_SECTION = "ccr_auto_follow"
 POLL_INTERVAL = 2.0
 BATCH_OPS = 1000
 SCAN_BATCH = 1000
@@ -144,6 +145,10 @@ class CcrService:
         # follower -> {"checkpoints": {shard: seqno}, "bootstrapping",
         # "ops", "bootstraps"} — master-local runtime state
         self._state: Dict[str, Dict[str, Any]] = {}
+        # followers whose auto-follow creation is in flight (debounces
+        # duplicate creations between poll ticks; master-local, like the
+        # reference's AutoFollowCoordinator in-progress tracking)
+        self._auto_inflight: set = set()
 
     # -- lifecycle --------------------------------------------------------
 
@@ -248,6 +253,94 @@ class CcrService:
                         "bootstrapping": bool(st.get("bootstrapping"))})
         return {"follows": out}
 
+    # -- auto-follow (AutoFollowCoordinator.java:72 analog) ----------------
+
+    def _auto_patterns(self) -> Dict[str, Any]:
+        return dict(self.node._applied_state()
+                    .metadata.custom.get(AUTO_FOLLOW_SECTION, {}))
+
+    def put_auto_follow(self, name: str, body: Dict[str, Any],
+                        on_done) -> None:
+        """PUT /_ccr/auto_follow/{name}: new leader indices matching any
+        pattern get followers automatically. The registry replicates
+        through cluster state, so it survives master failover."""
+        from elasticsearch_tpu.action.admin import PUT_CUSTOM
+        body = dict(body or {})
+        patterns = body.get("leader_index_patterns")
+        if not patterns or not isinstance(patterns, list):
+            on_done(None, IllegalArgumentError(
+                "auto-follow requires [leader_index_patterns] as a list"))
+            return
+        entry = {
+            "leader_index_patterns": [str(p) for p in patterns],
+            "follow_index_pattern": str(
+                body.get("follow_index_pattern",
+                         "{{leader_index}}-follower")),
+            "replicas": int(body.get("replicas", 0)),
+        }
+        self.node.master_client.execute(
+            PUT_CUSTOM, {"section": AUTO_FOLLOW_SECTION, "name": name,
+                         "body": entry}, on_done)
+
+    def delete_auto_follow(self, name: str, on_done) -> None:
+        from elasticsearch_tpu.action.admin import DELETE_CUSTOM
+        self.node.master_client.execute(
+            DELETE_CUSTOM, {"section": AUTO_FOLLOW_SECTION, "name": name},
+            on_done)
+
+    def get_auto_follow(self, name: Optional[str] = None) -> Dict[str, Any]:
+        patterns = self._auto_patterns()
+        if name is not None and name not in patterns:
+            raise ResourceNotFoundError(
+                f"no auto-follow pattern [{name}]")
+        return {"patterns": [
+            {"name": n, "pattern": dict(p)}
+            for n, p in sorted(patterns.items())
+            if name is None or n == name]}
+
+    def _check_auto_follow(self, defs: Dict[str, Any]) -> None:
+        """One coordinator pass: follow every unfollowed leader index
+        matching a registered pattern."""
+        import fnmatch
+        patterns = self._auto_patterns()
+        if not patterns:
+            return
+        state = self.node._applied_state()
+        followed_leaders = {d.get("leader_index") for d in defs.values()}
+        for meta in list(state.metadata.indices.values()):
+            if meta.settings.get("index.ccr.following"):
+                continue   # never follow a follower (cycle)
+            if meta.name.startswith("."):
+                continue   # system/backing indices are not auto-followed
+            if meta.name in followed_leaders:
+                continue
+            for pat in patterns.values():
+                if not any(fnmatch.fnmatch(meta.name, p)
+                           for p in pat.get("leader_index_patterns", [])):
+                    continue
+                follower = pat.get(
+                    "follow_index_pattern",
+                    "{{leader_index}}-follower").replace(
+                        "{{leader_index}}", meta.name)
+                if follower in defs or \
+                        state.metadata.has_index(follower) or \
+                        follower in self._auto_inflight:
+                    break
+                self._auto_inflight.add(follower)
+                logger.info("ccr auto-follow: following [%s] as [%s]",
+                            meta.name, follower)
+
+                def created(_resp, err, follower=follower):
+                    self._auto_inflight.discard(follower)
+                    if err is not None:
+                        logger.warning(
+                            "ccr auto-follow for [%s] failed: %s",
+                            follower, err)
+                self.follow(follower,
+                            {"leader_index": meta.name,
+                             "replicas": pat.get("replicas", 0)}, created)
+                break
+
     # -- replication ------------------------------------------------------
 
     def _following(self, follower: str) -> bool:
@@ -256,6 +349,7 @@ class CcrService:
 
     def poll_all(self) -> None:
         defs = self._defs()
+        self._check_auto_follow(defs)
         # prune runtime state for unfollowed indices (the unfollow REST
         # call may have landed on another node, popping only ITS state)
         for stale in [f for f in self._state if f not in defs]:

@@ -93,6 +93,11 @@ def required_privilege(method: str, path: str
             # any authenticated principal may ask who it is (the
             # reference's _authenticate requires no privileges)
             return ("authenticated", "", None)
+        if path.rstrip("/") == "/_security/api_key":
+            # create/get/invalidate own keys needs only authentication
+            # (manage_own_api_key); cross-user access is enforced inside
+            # the handlers (owner checks / manage_security)
+            return ("authenticated", "", None)
         if first == "_async_search":
             # get/delete by id: authentication plus the service's own
             # per-owner check (ids carry stored search RESULTS)
@@ -161,17 +166,103 @@ def redact_state(state_dict: Dict[str, Any]) -> Dict[str, Any]:
     meta = dict(out.get("metadata") or {})
     if meta.get("security"):
         security = {k: dict(v) for k, v in meta["security"].items()}
-        users = {name: {kk: vv for kk, vv in u.items()
-                        if kk not in ("hash", "salt")}
-                 for name, u in security.get("users", {}).items()}
-        if users:
-            security["users"] = users
+        for kind in ("users", "api_keys"):
+            redacted = {name: {kk: vv for kk, vv in u.items()
+                               if kk not in ("hash", "salt")}
+                        for name, u in security.get(kind, {}).items()}
+            if redacted:
+                security[kind] = redacted
         meta["security"] = security
     if meta.get("persistent_settings"):
         meta["persistent_settings"] = redact_settings(
             meta["persistent_settings"])
     out["metadata"] = meta
     return out
+
+
+class AuditTrail:
+    """Append-only audit log of authn/authz decisions
+    (x-pack/plugin/security/.../audit/logfile/LoggingAuditTrail.java).
+
+    Events append to ``<data_path>/audit.log`` as JSON lines (and to a
+    bounded in-memory ring for tests/introspection). Off until
+    ``xpack.security.audit.enabled`` is true."""
+
+    RING_CAP = 1000
+
+    def __init__(self, node) -> None:
+        self.node = node
+        self.events: List[Dict[str, Any]] = []
+
+    def _enabled(self) -> bool:
+        v = dict(self.node._applied_state().metadata.persistent_settings
+                 ).get("xpack.security.audit.enabled", False)
+        return str(v).lower() in ("true", "1", "yes")
+
+    def log(self, event_type: str, user: Optional[str], realm: str,
+            method: str, path: str, reason: Optional[str] = None) -> None:
+        if not self._enabled():
+            return
+        import json as _json
+        record = {
+            "@timestamp": self.node.scheduler.wall_now(),
+            "event.type": event_type,
+            "user.name": user,
+            "realm": realm,
+            "http.method": method,
+            "url.path": path,
+        }
+        if reason:
+            record["reason"] = reason
+        self.events.append(record)
+        if len(self.events) > self.RING_CAP:
+            del self.events[: len(self.events) - self.RING_CAP]
+        data_path = getattr(self.node.indices_service, "data_path", None)
+        if data_path:
+            try:
+                with open(f"{data_path}/audit.log", "a",
+                          encoding="utf-8") as fh:
+                    fh.write(_json.dumps(record) + "\n")
+            except OSError:
+                pass   # auditing must never fail the request
+
+
+class FileRealm:
+    """File-backed users: ``<data_path>/config/users.json`` holding
+    {username: {hash, salt, roles}} — hot-reloaded on change via the
+    resource watcher (the reference's file realm +
+    ResourceWatcherService)."""
+
+    def __init__(self, node) -> None:
+        self.node = node
+        self._users: Dict[str, Any] = {}
+        # bumped on every reload so cached verifications die with the file
+        self.generation = 0
+        data_path = getattr(node.indices_service, "data_path", None)
+        self.path = f"{data_path}/config/users.json" if data_path else None
+        if self.path:
+            self.reload(self.path)
+
+    def reload(self, _path: str) -> None:
+        import json as _json
+        if not self.path:
+            return
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                loaded = _json.load(fh)
+            self._users = {str(k): dict(v) for k, v in loaded.items()} \
+                if isinstance(loaded, dict) else {}
+            self.generation += 1
+        except FileNotFoundError:
+            self._users = {}
+            self.generation += 1
+        except (OSError, ValueError):
+            # a malformed file keeps the LAST GOOD realm contents (the
+            # reference logs and keeps serving) — never lock everyone out
+            pass
+
+    def get(self, username: str) -> Optional[Dict[str, Any]]:
+        return self._users.get(username)
 
 
 class SecurityService:
@@ -186,6 +277,8 @@ class SecurityService:
         # cached until the next cluster-state change (the reference's
         # realm cache with its security-index invalidation)
         self._auth_cache: Dict[Any, Dict[str, Any]] = {}
+        self.audit = AuditTrail(node)
+        self.file_realm = FileRealm(node)
 
     # -- state ------------------------------------------------------------
 
@@ -210,8 +303,12 @@ class SecurityService:
 
     def authenticate(self, headers: Dict[str, str]
                      ) -> Optional[Dict[str, Any]]:
-        """The authenticated user record, or None for bad/missing creds."""
+        """The authenticated user record, or None for bad/missing creds.
+        Realm chain: API keys, then the file realm, then the native
+        (cluster-state) realm — the reference's realm ordering."""
         auth = headers.get("authorization", "")
+        if auth.lower().startswith("apikey "):
+            return self._authenticate_api_key(auth)
         if not auth.lower().startswith("basic "):
             return None
         try:
@@ -219,6 +316,24 @@ class SecurityService:
             username, _, password = decoded.partition(":")
         except Exception:  # noqa: BLE001 — malformed header = unauthenticated
             return None
+        file_user = self.file_realm.get(username)
+        if file_user is not None:
+            cache_key = ("file", username,
+                         hashlib.sha256(password.encode()).hexdigest(),
+                         self.file_realm.generation)
+            record = {"username": username,
+                      "roles": list(file_user.get("roles", [])),
+                      "realm": "file"}
+            if cache_key in self._auth_cache:
+                return dict(record)
+            try:
+                if verify_password(password, file_user):
+                    if len(self._auth_cache) >= self.AUTH_CACHE_CAP:
+                        self._auth_cache.clear()
+                    self._auth_cache[cache_key] = {"ok": True}
+                    return record
+            except (KeyError, ValueError):
+                pass   # malformed file entry: fall through to native
         user = self._users().get(username)
         if user is None and username == "elastic":
             boot = self._settings().get("xpack.security.bootstrap_password")
@@ -243,7 +358,198 @@ class SecurityService:
         self._auth_cache[cache_key] = record
         return dict(record)
 
+    # -- api keys ----------------------------------------------------------
+
+    def _api_keys(self) -> Dict[str, Any]:
+        return dict(self.node._applied_state()
+                    .metadata.security.get("api_keys", {}))
+
+    def _authenticate_api_key(self, auth: str
+                              ) -> Optional[Dict[str, Any]]:
+        """ApiKey base64(id:secret) -> the key's principal with its
+        privilege layers attached (ApiKeyService.java:108)."""
+        try:
+            decoded = base64.b64decode(auth.split(None, 1)[1]).decode("utf-8")
+            key_id, _, secret = decoded.partition(":")
+        except Exception:  # noqa: BLE001 — malformed = unauthenticated
+            return None
+        entry = self._api_keys().get(key_id)
+        if entry is None or entry.get("invalidated"):
+            return None
+        # the KDF is deliberately slow: cache verified secrets until the
+        # next cluster-state change, like the native realm's _auth_cache
+        cache_key = ("apikey", key_id,
+                     hashlib.sha256(secret.encode("utf-8")).hexdigest(),
+                     self.node._applied_state().metadata.version)
+        if cache_key not in self._auth_cache:
+            if not verify_password(secret, entry):
+                return None
+            if len(self._auth_cache) >= self.AUTH_CACHE_CAP:
+                self._auth_cache.clear()
+            self._auth_cache[cache_key] = {"ok": True}
+        exp = entry.get("expiration_ms")
+        if exp is not None and \
+                self.node.scheduler.wall_now() * 1000 >= float(exp):
+            return None
+        chain = entry.get("limited_by_chain")
+        if chain is None:   # entries written before chains existed
+            chain = [entry.get("limited_by") or {}]
+        return {"username": entry.get("creator", "_api_key"),
+                "roles": [],
+                "realm": "_es_api_key",
+                "api_key": {
+                    "id": key_id,
+                    "name": entry.get("name"),
+                    "role_descriptors": entry.get("role_descriptors") or {},
+                    "limited_by_chain": [dict(c) for c in chain]}}
+
+    def create_api_key(self, user: Dict[str, Any], body: Dict[str, Any],
+                       on_done) -> None:
+        """POST /_security/api_key: derive a credential from the CALLER.
+
+        The key's effective privileges are the INTERSECTION of the
+        requested role_descriptors and a snapshot of the caller's roles
+        at creation time (limited_by) — a key can only narrow, never
+        escalate. The secret is returned ONCE and stored hashed."""
+        from elasticsearch_tpu.action.admin import PUT_SECURITY
+        from elasticsearch_tpu.utils.settings import parse_time_to_seconds
+        body = dict(body or {})
+        name = body.get("name")
+        if not name:
+            on_done(None, ValueError("api key requires [name]"))
+            return
+        key_id = os.urandom(10).hex()
+        secret = os.urandom(18).hex()
+        # the limiting CHAIN: every layer constraining the creator also
+        # constrains the child key — a key created by a narrow key keeps
+        # the narrow layer AND the original snapshot, so the chain's
+        # intersection can only shrink
+        if user.get("api_key") is not None:
+            parent = user["api_key"]
+            chain = [dict(layer) for layer in
+                     (parent.get("limited_by_chain") or
+                      ([parent["limited_by"]] if parent.get("limited_by")
+                       else []))]
+            rd = parent.get("role_descriptors") or {}
+            if rd:
+                chain.append(dict(rd))
+        else:
+            chain = [{rname: dict(r) for rname in user.get("roles", [])
+                      if (r := self._roles().get(rname)) is not None}]
+        expiration_ms = None
+        if body.get("expiration"):
+            expiration_ms = self.node.scheduler.wall_now() * 1000 + \
+                parse_time_to_seconds(body["expiration"]) * 1000
+        entry = {
+            "name": str(name),
+            "creator": user["username"],
+            "creation_ms": int(self.node.scheduler.wall_now() * 1000),
+            "expiration_ms": expiration_ms,
+            "invalidated": False,
+            "role_descriptors": dict(body.get("role_descriptors") or {}),
+            "limited_by_chain": chain,
+            **hash_password(secret),
+        }
+
+        def stored(resp, err):
+            if err is not None:
+                on_done(None, err)
+                return
+            self.audit.log("create_api_key", user["username"], "native",
+                           "PUT", f"/_security/api_key [{name}]")
+            encoded = base64.b64encode(
+                f"{key_id}:{secret}".encode()).decode()
+            on_done({"id": key_id, "name": str(name),
+                     "api_key": secret, "encoded": encoded}, None)
+
+        self.node.master_client.execute(PUT_SECURITY, {
+            "kind": "api_keys", "name": key_id, "body": entry}, stored)
+
+    def get_api_keys(self, user: Dict[str, Any],
+                     key_id: Optional[str] = None) -> Dict[str, Any]:
+        """Own keys for everyone; every key for manage_security holders.
+        Secrets (hash/salt) never leave."""
+        can_manage = self.authorize(user, "PUT", "/_security/user/x")
+        out = []
+        for kid, entry in self._api_keys().items():
+            if key_id is not None and kid != key_id:
+                continue
+            if not can_manage and entry.get("creator") != user["username"]:
+                continue
+            out.append({"id": kid,
+                        "name": entry.get("name"),
+                        "creation": entry.get("creation_ms"),
+                        "expiration": entry.get("expiration_ms"),
+                        "invalidated": bool(entry.get("invalidated")),
+                        "username": entry.get("creator")})
+        return {"api_keys": out}
+
+    def invalidate_api_keys(self, user: Dict[str, Any],
+                            body: Dict[str, Any], on_done) -> None:
+        """DELETE /_security/api_key {ids: [...]} | {name: ...}: flips
+        ``invalidated`` (keys never silently vanish — the audit trail and
+        GET still show them)."""
+        from elasticsearch_tpu.action.admin import PUT_SECURITY
+        body = dict(body or {})
+        ids = list(body.get("ids") or ([body["id"]] if body.get("id")
+                                       else []))
+        name = body.get("name")
+        can_manage = self.authorize(user, "PUT", "/_security/user/x")
+        keys = self._api_keys()
+        targets = []
+        for kid, entry in keys.items():
+            if (kid in ids) or (name and entry.get("name") == name):
+                if not can_manage and \
+                        entry.get("creator") != user["username"]:
+                    continue   # not yours, not an admin: skipped
+                targets.append((kid, entry))
+        if not targets:
+            on_done({"invalidated_api_keys": [],
+                     "error_count": len(ids)}, None)
+            return
+        pending = {"n": len(targets)}
+        done_ids: List[str] = []
+
+        def one(kid, entry):
+            def cb(_r, err):
+                if err is None:
+                    done_ids.append(kid)
+                    self.audit.log("invalidate_api_key",
+                                   user["username"], "native",
+                                   "DELETE", f"/_security/api_key [{kid}]")
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    on_done({"invalidated_api_keys": sorted(done_ids),
+                             "error_count": 0}, None)
+            self.node.master_client.execute(PUT_SECURITY, {
+                "kind": "api_keys", "name": kid,
+                "body": {**entry, "invalidated": True}}, cb)
+
+        for kid, entry in targets:
+            one(kid, entry)
+
     # -- authz ------------------------------------------------------------
+
+    def _role_descriptors(self, user: Dict[str, Any]
+                          ) -> List[List[Dict[str, Any]]]:
+        """Privilege layers: a request is allowed only if EVERY layer
+        allows it. Normal users have one layer (their roles); API keys
+        have the assigned role_descriptors AND the creator snapshot
+        (limited_by) — the reference's intersection semantics."""
+        key = user.get("api_key")
+        if key is None:
+            return [[r for rname in user.get("roles", [])
+                     if (r := self._roles().get(rname)) is not None]]
+        layers = []
+        rd = key.get("role_descriptors") or {}
+        if rd:
+            layers.append([dict(v) for v in rd.values()])
+        chain = key.get("limited_by_chain")
+        if chain is None:
+            chain = [key.get("limited_by") or {}]
+        for link in chain:
+            layers.append([dict(v) for v in link.values()])
+        return layers
 
     def _resolve_targets(self, expression: str) -> List[str]:
         """The CONCRETE indices a request expression reaches — commas
@@ -260,6 +566,15 @@ class SecurityService:
             resolved = resolve_index_expression(expression, metadata)
         except Exception:  # noqa: BLE001 — unknown names authz as literal
             resolved = [p.strip() for p in expression.split(",") if p.strip()]
+        # grants name data STREAMS, not their .ds-* internals: a backing
+        # index authorizes as its stream (the reference's data-stream
+        # aware authorization); direct .ds-* access not belonging to any
+        # stream stays literal
+        backing_of = {b: ds_name
+                      for ds_name, ds in metadata.data_streams.items()
+                      for b in ds.get("indices", [])}
+        resolved = list(dict.fromkeys(
+            backing_of.get(n, n) for n in resolved))
         return resolved or [expression]
 
     def authorize(self, user: Dict[str, Any], method: str,
@@ -267,8 +582,11 @@ class SecurityService:
         scope, privilege, index = required_privilege(method, path)
         if scope == "authenticated":
             return True
-        roles = [r for name in user.get("roles", [])
-                 if (r := self._roles().get(name)) is not None]
+        return all(self._layer_allows(layer, scope, privilege, index)
+                   for layer in self._role_descriptors(user))
+
+    def _layer_allows(self, roles: List[Dict[str, Any]], scope: str,
+                      privilege: str, index: Optional[str]) -> bool:
         if any("all" in set(r.get("cluster", [])) for r in roles):
             return True
         if scope == "cluster":
@@ -360,16 +678,28 @@ class SecurityService:
     def dls_filter(self, user: Dict[str, Any],
                    index_expression: str) -> Optional[Dict[str, Any]]:
         """Document-level security filter for the user over the target
-        indices (SecurityIndexSearcherWrapper analog): each index grant
-        may carry a "query"; a grant WITHOUT one makes that INDEX
-        unrestricted; role queries on one index OR together. One filter
-        wraps the whole request, so heterogeneous targets — mixing
-        restricted and unrestricted indices, or restricted indices with
-        DIFFERENT filters — fail CLOSED (the reference applies DLS
-        per-shard; that granularity is a documented divergence)."""
+        indices (SecurityIndexSearcherWrapper analog). For API keys, the
+        assigned-descriptor AND creator-snapshot layers' filters BOTH
+        apply (intersection: a key can only narrow visibility)."""
+        filters = [f for layer in self._role_descriptors(user)
+                   if (f := self._layer_dls(layer,
+                                            index_expression)) is not None]
+        if not filters:
+            return None
+        if len(filters) == 1:
+            return filters[0]
+        return {"bool": {"filter": filters}}
+
+    def _layer_dls(self, roles: List[Dict[str, Any]],
+                   index_expression: str) -> Optional[Dict[str, Any]]:
+        """One layer's DLS filter: each index grant may carry a "query";
+        a grant WITHOUT one makes that INDEX unrestricted; role queries
+        on one index OR together. One filter wraps the whole request, so
+        heterogeneous targets — mixing restricted and unrestricted
+        indices, or restricted indices with DIFFERENT filters — fail
+        CLOSED (the reference applies DLS per-shard; that granularity is
+        a documented divergence)."""
         import json as _json
-        roles = [r for name in user.get("roles", [])
-                 if (r := self._roles().get(name)) is not None]
         if any("all" in set(r.get("cluster", [])) for r in roles):
             return None
         targets = self._resolve_targets(index_expression or "*")
@@ -414,12 +744,27 @@ class SecurityService:
 
     def fls_fields(self, user: Dict[str, Any],
                    index_expression: str) -> Optional[List[str]]:
-        """Field-level security: the union of granted field patterns for
-        the user over the targets, or None for unrestricted
-        (FieldPermissions analog). Heterogeneous targets fail closed
-        like DLS."""
-        roles = [r for name in user.get("roles", [])
-                 if (r := self._roles().get(name)) is not None]
+        """Field-level security patterns, or None for unrestricted
+        (FieldPermissions analog). For API keys both layers apply: when
+        only one restricts, its grants rule; when BOTH restrict, the
+        effective grant is the (conservative) literal intersection —
+        patterns of the first layer that the second also covers."""
+        layers = [f for layer in self._role_descriptors(user)
+                  if (f := self._layer_fls(layer,
+                                           index_expression)) is not None]
+        if not layers:
+            return None
+        effective = layers[0]
+        for nxt in layers[1:]:
+            effective = [g for g in effective
+                         if g in nxt or any(fnmatch.fnmatch(g, h)
+                                            for h in nxt)]
+        return effective
+
+    def _layer_fls(self, roles: List[Dict[str, Any]],
+                   index_expression: str) -> Optional[List[str]]:
+        """One layer's union of granted field patterns over the targets.
+        Heterogeneous targets fail closed like DLS."""
         if any("all" in set(r.get("cluster", [])) for r in roles):
             return None
         targets = self._resolve_targets(index_expression or "*")
@@ -679,12 +1024,17 @@ class SecurityService:
             return None
         user = self.authenticate(request.headers or {})
         if user is None:
+            self.audit.log("authentication_failed", None, "-",
+                           request.method, request.path)
             return 401, {"error": {
                 "type": "security_exception",
                 "reason": "missing or invalid credentials",
                 "header": {"WWW-Authenticate": 'Basic realm="security"'}},
                 "status": 401}
+        realm = user.get("realm", "native")
         if not self._authorize_request(user, request):
+            self.audit.log("access_denied", user["username"], realm,
+                           request.method, request.path)
             return 403, {"error": {
                 "type": "security_exception",
                 "reason": f"action [{request.method} {request.path}] is "
@@ -693,13 +1043,21 @@ class SecurityService:
         try:
             self._apply_dls(user, request)
         except IllegalSecurityScope as e:
+            self.audit.log("access_denied", user["username"], realm,
+                           request.method, request.path, reason=str(e))
             return 403, {"error": {
                 "type": "security_exception", "reason": str(e)},
                 "status": 403}
         except Exception:  # noqa: BLE001 — a DLS failure must fail CLOSED
+            self.audit.log("access_denied", user["username"], realm,
+                           request.method, request.path,
+                           reason="dls failure")
             return 403, {"error": {
                 "type": "security_exception",
                 "reason": "failed to apply document-level security"},
                 "status": 403}
+        self.audit.log("access_granted", user["username"], realm,
+                       request.method, request.path)
         request.params["_authenticated_user"] = user["username"]
+        request.params["_authenticated_record"] = user
         return None

@@ -85,3 +85,64 @@ def test_follow_missing_leader_errors(cluster):
     resp, err = cluster.call(lambda cb: node.ccr_service.follow(
         "f", {}, cb))
     assert err is not None
+
+
+def test_auto_follow_patterns(cluster):
+    """AutoFollowCoordinator.java:72 analog: new leader indices matching
+    a registered pattern get followers automatically; the registry lives
+    in cluster state so it survives master failover."""
+    client = cluster.client()
+    node = cluster.master()
+    svc = node.ccr_service
+
+    # malformed pattern rejected
+    _, err = cluster.call(lambda cb: svc.put_auto_follow("bad", {}, cb))
+    assert err is not None
+
+    _ok(*cluster.call(lambda cb: svc.put_auto_follow("logs", {
+        "leader_index_patterns": ["logs-*"],
+        "follow_index_pattern": "{{leader_index}}-copy"}, cb)))
+    got = svc.get_auto_follow("logs")
+    assert got["patterns"][0]["pattern"]["leader_index_patterns"] == \
+        ["logs-*"]
+
+    # a new matching leader: follower appears + replicates automatically
+    _ok(*cluster.call(lambda cb: client.create_index("logs-2026", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0}}, cb)))
+    cluster.ensure_green("logs-2026")
+    for i in range(3):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "logs-2026", f"d{i}", {"n": i}, cb)))
+    cluster.call(lambda cb: client.refresh("logs-2026", cb))
+    cluster.scheduler.run_for(15.0)
+    state = node._applied_state()
+    assert state.metadata.has_index("logs-2026-copy"), \
+        sorted(state.metadata.indices)
+    assert _search_ids(cluster, client, "logs-2026-copy") == \
+        ["d0", "d1", "d2"]
+    # the follower is never itself auto-followed (no cascade)
+    assert not state.metadata.has_index("logs-2026-copy-copy")
+
+    # non-matching indices are ignored
+    _ok(*cluster.call(lambda cb: client.create_index("metrics-1", {
+        "settings": {"number_of_replicas": 0}}, cb)))
+    cluster.scheduler.run_for(8.0)
+    assert not node._applied_state().metadata.has_index("metrics-1-copy")
+
+    # a second matching leader created LATER is picked up too
+    _ok(*cluster.call(lambda cb: client.create_index("logs-2027", {
+        "settings": {"number_of_replicas": 0}}, cb)))
+    cluster.ensure_green("logs-2027")
+    cluster.scheduler.run_for(15.0)
+    assert node._applied_state().metadata.has_index("logs-2027-copy")
+
+    # the pattern replicates through cluster state (failover-safe) and
+    # deleting it stops new auto-follows
+    for n in cluster.nodes.values():
+        assert "logs" in n._applied_state().metadata.custom.get(
+            "ccr_auto_follow", {})
+    _ok(*cluster.call(lambda cb: svc.delete_auto_follow("logs", cb)))
+    _ok(*cluster.call(lambda cb: client.create_index("logs-2028", {
+        "settings": {"number_of_replicas": 0}}, cb)))
+    cluster.scheduler.run_for(8.0)
+    assert not node._applied_state().metadata.has_index("logs-2028-copy")

@@ -573,3 +573,225 @@ def test_r4_fls_query_and_highlight_oracle_closed():
         assert not sec.authorize(mon_user, "GET", "/_cat/count/docs")
     finally:
         c.stop()
+
+
+def test_api_keys_lifecycle_and_intersection(tmp_path):
+    """API keys (ApiKeyService.java:108 analog): derived credentials with
+    role intersection, invalidation, owner-scoped listing, expiration."""
+    c = InProcessCluster(n_nodes=1, seed=83, data_path=str(tmp_path))
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.create_index("logs-1", {
+            "settings": {"number_of_replicas": 0}}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.create_index("secrets", {
+            "settings": {"number_of_replicas": 0}}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_role("writer", {
+            "indices": [{"names": ["logs-*"],
+                         "privileges": ["read", "write"]}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("amy", {
+            "password": "amypw", "roles": ["writer"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True,
+                            "xpack.security.audit.enabled": True}}, cb))
+        assert e is None
+
+        sec = c.master().security
+        amy = {"username": "amy", "roles": ["writer"]}
+
+        # create a key with narrower descriptors (read-only)
+        created = {}
+        sec.create_api_key(amy, {
+            "name": "ro-key",
+            "role_descriptors": {"ro": {"indices": [
+                {"names": ["logs-*"], "privileges": ["read"]}]}}},
+            lambda resp, err: created.update(resp or {"err": err}))
+        c.run_until(lambda: bool(created), 30.0)
+        assert "err" not in created
+        assert created["id"] and created["api_key"]
+
+        import base64 as b64
+        header = {"authorization":
+                  "ApiKey " + b64.b64encode(
+                      f"{created['id']}:{created['api_key']}"
+                      .encode()).decode()}
+        key_user = sec.authenticate(header)
+        assert key_user is not None
+        assert key_user["username"] == "amy"
+        # key allows read on logs-*, but write (in limited_by, NOT in the
+        # key's descriptors) is denied — intersection semantics
+        assert sec.authorize(key_user, "GET", "/logs-1/_search")
+        assert not sec.authorize(key_user, "PUT", "/logs-1/_doc/1")
+        # neither layer grants secrets
+        assert not sec.authorize(key_user, "GET", "/secrets/_search")
+        # a wide descriptor cannot ESCALATE beyond the creator snapshot
+        wide = {}
+        sec.create_api_key(amy, {
+            "name": "wide-key",
+            "role_descriptors": {"all": {"indices": [
+                {"names": ["*"], "privileges": ["all"]}]}}},
+            lambda resp, err: wide.update(resp or {"err": err}))
+        c.run_until(lambda: bool(wide), 30.0)
+        wide_user = sec.authenticate({"authorization":
+            "ApiKey " + b64.b64encode(
+                f"{wide['id']}:{wide['api_key']}".encode()).decode()})
+        assert sec.authorize(wide_user, "GET", "/logs-1/_search")
+        assert not sec.authorize(wide_user, "GET", "/secrets/_search")
+
+        # wrong secret / unknown id: unauthenticated
+        assert sec.authenticate({"authorization":
+            "ApiKey " + b64.b64encode(
+                f"{created['id']}:wrong".encode()).decode()}) is None
+
+        # owner-scoped listing; no secrets in the listing
+        listing = sec.get_api_keys(amy)
+        names = {k["name"] for k in listing["api_keys"]}
+        assert names == {"ro-key", "wide-key"}
+        assert all("hash" not in k and "api_key" not in k
+                   for k in listing["api_keys"])
+
+        # invalidation flips the key off without deleting it
+        inv = {}
+        sec.invalidate_api_keys(amy, {"ids": [created["id"]]},
+                                lambda resp, err: inv.update(resp or {}))
+        c.run_until(lambda: bool(inv), 30.0)
+        assert inv["invalidated_api_keys"] == [created["id"]]
+        assert sec.authenticate(header) is None
+        listing = sec.get_api_keys(amy, created["id"])
+        assert listing["api_keys"][0]["invalidated"] is True
+
+        # audit trail recorded authn/authz events + key lifecycle
+        kinds = {ev["event.type"] for ev in sec.audit.events}
+        assert "create_api_key" in kinds
+        assert "invalidate_api_key" in kinds
+    finally:
+        c.stop()
+
+
+def test_file_realm_hot_reload(tmp_path):
+    """File realm users hot-reload on change (ResourceWatcherService
+    analog): adding a user to users.json grants access without restart;
+    removing revokes it."""
+    import json as _json
+    import os
+    from elasticsearch_tpu.xpack.security import hash_password
+
+    c = InProcessCluster(n_nodes=1, seed=89, data_path=str(tmp_path))
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True}}, cb))
+        assert e is None
+        node = c.master()
+        sec = node.security
+        auth = {"authorization": "Basic " + base64.b64encode(
+            b"filed:fpw").decode()}
+        assert sec.authenticate(auth) is None
+
+        path = sec.file_realm.path
+        assert path is not None
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            _json.dump({"filed": {**hash_password("fpw"),
+                                  "roles": ["superuser"]}}, fh)
+        # the watcher notices the change on its next poll tick
+        node.resource_watcher.check_now()
+        user = sec.authenticate(auth)
+        assert user == {"username": "filed", "roles": ["superuser"],
+                        "realm": "file"}
+        assert sec.authorize(user, "GET", "/_cluster/health")
+
+        # removal revokes
+        with open(path, "w") as fh:
+            _json.dump({}, fh)
+        node.resource_watcher.check_now()
+        assert sec.authenticate(auth) is None
+    finally:
+        c.stop()
+
+
+def test_api_key_chain_cannot_escalate(tmp_path):
+    """A key minted BY a narrow key keeps the narrow layer in its
+    limiting chain — the round-4 review's escalation scenario."""
+    c = InProcessCluster(n_nodes=1, seed=97, data_path=str(tmp_path))
+    c.start()
+    try:
+        client = c.client()
+        for idx in ("logs-1", "secrets"):
+            r, e = c.call(lambda cb, idx=idx: client.create_index(idx, {
+                "settings": {"number_of_replicas": 0}}, cb))
+            assert e is None
+        r, e = c.call(lambda cb: client.put_security_role("admin-ish", {
+            "indices": [{"names": ["*"], "privileges": ["all"]}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("root", {
+            "password": "rootpw", "roles": ["admin-ish"]}, cb))
+        assert e is None
+
+        sec = c.master().security
+        root = {"username": "root", "roles": ["admin-ish"]}
+        narrow = {}
+        sec.create_api_key(root, {
+            "name": "narrow",
+            "role_descriptors": {"ro": {"indices": [
+                {"names": ["logs-*"], "privileges": ["read"]}]}}},
+            lambda resp, err: narrow.update(resp or {"err": err}))
+        c.run_until(lambda: bool(narrow), 30.0)
+        import base64 as b64
+        narrow_user = sec.authenticate({"authorization":
+            "ApiKey " + b64.b64encode(
+                f"{narrow['id']}:{narrow['api_key']}".encode()).decode()})
+        assert not sec.authorize(narrow_user, "GET", "/secrets/_search")
+
+        # the narrow key mints a child with NO descriptors: the child
+        # must NOT regain root's wide snapshot
+        child = {}
+        sec.create_api_key(narrow_user, {"name": "child"},
+                           lambda resp, err: child.update(
+                               resp or {"err": err}))
+        c.run_until(lambda: bool(child), 30.0)
+        child_user = sec.authenticate({"authorization":
+            "ApiKey " + b64.b64encode(
+                f"{child['id']}:{child['api_key']}".encode()).decode()})
+        assert sec.authorize(child_user, "GET", "/logs-1/_search")
+        assert not sec.authorize(child_user, "GET", "/secrets/_search")
+        assert not sec.authorize(child_user, "PUT", "/logs-1/_doc/x")
+    finally:
+        c.stop()
+
+
+def test_data_stream_grants_match_stream_name(tmp_path):
+    """Index grants name the STREAM, not .ds-* internals: authorization
+    maps backing indices back to their stream before matching."""
+    c = InProcessCluster(n_nodes=1, seed=101, data_path=str(tmp_path))
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.put_index_template("logs-t", {
+            "index_patterns": ["logs*"], "data_stream": {},
+            "template": {"settings": {"number_of_replicas": 0}}}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.create_data_stream("logs", cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_role("logreader", {
+            "indices": [{"names": ["logs*"],
+                         "privileges": ["read"]}]}, cb))
+        assert e is None
+        sec = c.master().security
+        user = {"username": "u", "roles": ["logreader"]}
+        assert sec.authorize(user, "GET", "/logs/_search")
+        assert not sec.authorize(user, "PUT", "/logs/_doc/1")
+
+        # the write backing index cannot be deleted out of the stream
+        r, e = c.call(lambda cb: client.delete_index(
+            ".ds-logs-000001", cb))
+        assert e is not None and "write index" in str(e)
+        r, e = c.call(lambda cb: client.delete_index("logs", cb))
+        assert e is not None
+    finally:
+        c.stop()
