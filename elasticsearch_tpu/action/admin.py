@@ -685,13 +685,21 @@ class MasterActions:
             if err is not None:
                 deferred.reject(err)
             else:
-                # report what the committed state actually did
+                # report what the committed state actually did; under the
+                # write-alias pattern BOTH generations hold the alias, so
+                # the new index is the one carrying is_write_index
                 state = self.coordinator.applied_state
-                targets = [im.name for im in state.metadata.indices.values()
+                targets = [im for im in state.metadata.indices.values()
                            if alias in im.aliases]
+                writers = [im.name for im in targets
+                           if (im.alias_configs.get(alias) or {})
+                           .get("is_write_index")]
+                new = req.get("new_index") or (
+                    writers[0] if writers else
+                    (targets[0].name if targets else None))
                 deferred.resolve({
                     "acknowledged": True, "rolled_over": True,
-                    "new_index": targets[0] if targets else None})
+                    "new_index": new})
         self.coordinator.submit_state_update(
             f"rollover [{alias}]", update, done)
         return deferred

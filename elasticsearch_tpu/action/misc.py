@@ -237,22 +237,26 @@ class MiscReadActions:
         texts = text if isinstance(text, list) else [text]
 
         from elasticsearch_tpu.analysis import AnalysisRegistry
-        analyzer = None
-        if index is not None and body.get("field"):
-            # derive from cluster-state mappings (field_caps-style), NOT
-            # from a locally hosted shard — every node must answer the
-            # same way regardless of shard placement
+        # the INDEX's analysis settings back both field-derived and
+        # explicitly named analyzers (custom analyzers registered at
+        # creation); cluster-state derived, NOT from a locally hosted
+        # shard — every node must answer the same way
+        if index is not None:
             state = self.node._applied_state()
             meta = state.metadata.index(index)
             registry = AnalysisRegistry(
                 (meta.settings or {}).get("analysis"))
+        else:
+            meta = None
+            registry = AnalysisRegistry()
+        analyzer = None
+        if meta is not None and body.get("field"):
             spec = dict(
                 _walk_fields((meta.mappings or {}).get("properties", {}))
             ).get(body["field"])
             name = (spec or {}).get("analyzer", "standard")
             analyzer = registry.get(name)
         if analyzer is None:
-            registry = AnalysisRegistry()
             analyzer = registry.get(body.get("analyzer", "standard"))
         tokens = []
         for t in texts:

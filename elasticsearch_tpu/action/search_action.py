@@ -229,6 +229,7 @@ class SearchTransportService:
                 collapse=body.get("collapse"),
                 slice_spec=body.get("slice"),
                 profile=bool(body.get("profile")),
+                terminate_after=body.get("terminate_after"),
                 cancel_check=(shard_task.ensure_not_cancelled
                               if shard_task else None))
         finally:
@@ -257,6 +258,7 @@ class SearchTransportService:
                       "score": d.score, "sort": list(d.sort_values),
                       **({"ckey": d.ckey} if d.ckey is not None else {})}
                      for d in result.docs],
+            "terminated": result.terminated_early,
             "aggs_partial": aggregator.partial() if aggregator else None,
             "suggest_partial": (
                 _suggest_partial(reader, shard.engine.mappers, body)
@@ -319,7 +321,46 @@ class SearchTransportService:
                     value = execute_field_script(
                         spec.get("script", spec), src, src)
                     fields[fname] = [value]
+        # matched_queries (MatchedQueriesPhase.java:43): every _name-tagged
+        # clause runs once per segment; each hit reports the names whose
+        # mask covers it
+        named = dsl.collect_named_queries(body.get("query"))
+        if named:
+            self._annotate_matched_queries(reader, shard, named, docs,
+                                           hits)
         return {"hits": hits}
+
+    def _annotate_matched_queries(self, reader, shard, named, docs,
+                                  hits) -> None:
+        from elasticsearch_tpu.search.execute import (
+            SegmentContext, execute,
+        )
+        needed = {d.segment_idx for d in docs}
+        parsed = []
+        for name, clause in named:
+            try:
+                parsed.append((name, dsl.parse_query(clause)))
+            except Exception:  # noqa: BLE001 — a clause that cannot
+                # parse standalone just never matches
+                continue
+        masks: Dict[Tuple[int, str], np.ndarray] = {}
+        for si in needed:
+            seg = reader.segments[si]
+            ctx = SegmentContext(seg, shard.engine.mappers,
+                                 segment_idx=si, reader=reader)
+            for name, q in parsed:
+                try:
+                    _, m = execute(q, ctx)
+                    masks[(si, name)] = np.asarray(m)
+                except Exception:  # noqa: BLE001 — execution quirk:
+                    # the clause never matches in this segment
+                    continue
+        for hit, doc in zip(hits, docs):
+            matched = [name for name, _c in named
+                       if (doc.segment_idx, name) in masks
+                       and bool(masks[(doc.segment_idx, name)][doc.doc])]
+            if matched:
+                hit["matched_queries"] = matched
 
 
 class TransportSearchAction:
@@ -958,6 +999,8 @@ class TransportSearchAction:
             total += result["total"]
             if result["relation"] == "gte":
                 relation = "gte"
+            if result.get("terminated"):
+                phase_state["terminated_early"] = True
             if result["max_score"] is not None:
                 max_score = (result["max_score"] if max_score is None
                              else max(max_score, result["max_score"]))
@@ -1091,6 +1134,8 @@ class TransportSearchAction:
             "hits": {"total": {"value": total, "relation": relation},
                      "max_score": max_score, "hits": hits},
         }
+        if phase_state.get("terminated_early"):
+            resp["terminated_early"] = True
         agg_body = body.get("aggs", body.get("aggregations"))
         if agg_body:
             # coordinator-side reduce of per-shard partials

@@ -1508,14 +1508,28 @@ def build_controller(client: NodeClient) -> RestController:
 
     def cat_shards(req: RestRequest, done: DoneFn) -> None:
         state = client.node._applied_state()
+        only = req.params.get("index")
+        allowed = None
+        if only:
+            from elasticsearch_tpu.cluster.metadata import (
+                resolve_index_expression,
+            )
+            try:
+                allowed = set(resolve_index_expression(
+                    only, state.metadata))
+            except Exception:  # noqa: BLE001 — unknown name: empty table
+                allowed = {only}
         rows = []
         for sr in state.routing_table.all_shards():
+            if allowed is not None and sr.index not in allowed:
+                continue
             rows.append([sr.index, str(sr.shard_id),
                          "p" if sr.primary else "r",
                          sr.state.value, sr.node_id or "-"])
         done(200, _cat(req, ["index", "shard", "prirep", "state", "node"],
                        rows))
     r("GET", "/_cat/shards", cat_shards)
+    r("GET", "/_cat/shards/{index}", cat_shards)
 
     def cat_nodes(req: RestRequest, done: DoneFn) -> None:
         state = client.node._applied_state()
@@ -1552,8 +1566,11 @@ def _uri_query(q: str) -> Dict[str, Any]:
 
 
 def _cat(req: RestRequest, headers: List[str],
-         rows: List[List[str]]) -> str:
-    """Fixed-width text table; ?v adds the header row (cat API contract)."""
+         rows: List[List[str]]):
+    """Fixed-width text table; ?v adds the header row; ?format=json
+    returns the row objects instead (the cat API contract)."""
+    if (req.query or {}).get("format") == "json":
+        return [dict(zip(headers, [str(c) for c in row])) for row in rows]
     show_header = req.flag("v")
     table = ([headers] if show_header else []) + rows
     if not table:

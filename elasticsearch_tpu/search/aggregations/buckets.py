@@ -959,6 +959,43 @@ def finalize_significant(spec: AggSpec, p) -> Dict[str, Any]:
             "buckets": out_buckets}
 
 
+def collect_significant_text(spec: AggSpec, ctx, mask, scores
+                             ) -> Dict[str, Any]:
+    """significant_terms over an ANALYZED text field's postings
+    (SignificantTextAggregationBuilder analog): foreground = matched
+    docs containing each term, background = live docs containing it.
+    Produces the same partial shape as significant_terms so the merge/
+    finalize (JLH) stages are shared."""
+    fname = spec.params.get("field")
+    if fname is None:
+        raise IllegalArgumentError(
+            f"aggregation [{spec.name}] requires a [field]")
+    n = ctx.segment.n_docs
+    live = np.zeros(n, bool)
+    live[: len(ctx.segment.live)] = ctx.segment.live
+    fg_total = int(np.count_nonzero(mask[:n]))
+    bg_total = int(np.count_nonzero(live))
+    buckets: Dict[str, Dict[str, Any]] = {}
+    pf = ctx.segment.postings.get(fname)
+    if pf is not None and fg_total:
+        for term in pf.terms:
+            docs, _tfs = pf.postings_for(term)
+            docs = docs[docs < n]
+            fg = int(np.count_nonzero(mask[docs]))
+            if not fg:
+                continue
+            subs: Dict[str, Any] = {}
+            if spec.subs:
+                bmask = np.zeros(n, bool)
+                bmask[docs] = True
+                subs = _collect_subs(spec, ctx, bmask & mask, scores)
+            buckets[str(term)] = {
+                "key": term, "doc_count": fg,
+                "bg_count": int(np.count_nonzero(live[docs])),
+                "subs": subs}
+    return {"buckets": buckets, "fg_total": fg_total, "bg_total": bg_total}
+
+
 BUCKET_COLLECT = {
     "terms": collect_terms,
     "range": collect_range,
@@ -971,6 +1008,7 @@ BUCKET_COLLECT = {
     "missing": collect_missing,
     "composite": collect_composite,
     "significant_terms": collect_significant_terms,
+    "significant_text": collect_significant_text,
 }
 BUCKET_MERGE = {
     "terms": merge_multi, "range": merge_multi, "date_range": merge_multi,
@@ -980,6 +1018,7 @@ BUCKET_MERGE = {
     "missing": merge_single,
     "composite": merge_multi,
     "significant_terms": merge_significant,
+    "significant_text": merge_significant,
 }
 BUCKET_FINALIZE = {
     "terms": finalize_terms,
@@ -990,4 +1029,5 @@ BUCKET_FINALIZE = {
     "filters": finalize_filters,
     "composite": finalize_composite,
     "significant_terms": finalize_significant,
+    "significant_text": finalize_significant,
 }

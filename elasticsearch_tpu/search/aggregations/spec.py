@@ -21,6 +21,7 @@ BUCKET_TYPES = {
     "terms", "range", "date_range", "histogram", "date_histogram",
     "filter", "filters", "global", "missing", "composite",
     "significant_terms",
+    "significant_text",
 }
 PIPELINE_TYPES = {
     "avg_bucket", "sum_bucket", "min_bucket", "max_bucket", "stats_bucket",

@@ -589,6 +589,11 @@ def parse_query(body: Any) -> Query:
     parser = _PARSERS.get(kind)
     if parser is None:
         raise QueryParsingError(f"unknown query type [{kind}]")
+    if isinstance(spec, dict) and "_name" in spec:
+        # clause-level _name (named queries) is metadata for the fetch
+        # phase's matched_queries, never part of the clause body — strip
+        # it HERE so single-field parsers don't count it as a field
+        spec = {k: v for k, v in spec.items() if k != "_name"}
     return parser(spec)
 
 
@@ -943,6 +948,37 @@ def _parse_geo_polygon(spec) -> GeoPolygon:
 def _field_value(spec, key):
     fname, opts = _field_spec(spec, key)
     return fname, str(opts.get(key, "")), float(opts.get("boost", 1.0))
+
+
+def collect_named_queries(body_query: Any
+                          ) -> List[Tuple[str, Dict[str, Any]]]:
+    """[(name, clause_json)] for every ``_name``-tagged clause in a raw
+    request query (search/fetch/subphase/MatchedQueriesPhase.java:43's
+    named-weight registry, gathered at the JSON level so every query type
+    participates without per-parser changes). The name may sit at the
+    clause level ({"bool": {..., "_name": n}}) or inside field options
+    ({"match": {"f": {"query": ..., "_name": n}}})."""
+    out: List[Tuple[str, Dict[str, Any]]] = []
+
+    def walk(node: Any) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k in _PARSERS and isinstance(v, dict):
+                    name = v.get("_name")
+                    if name is None:
+                        for fv in v.values():
+                            if isinstance(fv, dict) and "_name" in fv:
+                                name = fv["_name"]
+                                break
+                    if name is not None:
+                        out.append((str(name), {k: v}))
+                walk(v)
+        elif isinstance(node, list):
+            for item in node:
+                walk(item)
+
+    walk(body_query)
+    return out
 
 
 def disjunctive_clauses(q: Query

@@ -44,10 +44,100 @@ def build_document_ctx(documents: List[Dict[str, Any]], mappers):
     return SegmentContext(builder.build(), scratch)
 
 
+def required_terms(q, mappers=None) -> Optional[set]:
+    """A set of (field, term) pairs of which AT LEAST ONE must be present
+    in a document for ``q`` to match — or None when no such proof exists
+    (the query stays an always-candidate). The reference's QueryAnalyzer
+    term extraction (modules/percolator/.../QueryAnalyzer.java), reduced
+    to the any-of cover that candidate pruning needs:
+      - Match/MatchPhrase on TEXT fields: the tokens from the field's
+        SEARCH analyzer (matching execution's analysis exactly — a
+        mapper-blind STANDARD cover would prune stemming/case variants
+        execution would match);
+      - Match/Term/Terms on keyword fields: the literal string value(s)
+        (execution falls back to term equality there);
+      - Bool: a positive (must/filter) child's cover works for the whole
+        bool; with only should clauses (msm>=1) the union works iff EVERY
+        child is extractable;
+      - non-string values, unmapped/numeric fields, and everything else
+        (exists, ranges, wildcards, must_not-only, ...) -> None: those
+        match through doc values the token table never sees, so pruning
+        them would drop true matches."""
+    from elasticsearch_tpu.search import dsl
+
+    def field_kind(f: str) -> str:
+        if mappers is None:
+            return "unknown"
+        t = mappers.field_type(f)
+        if t in ("text", "search_as_you_type"):
+            return "text"
+        if t in ("keyword", "constant_keyword", "wildcard"):
+            return "keyword"
+        return "other"
+
+    def analyzer_for(f: str):
+        from elasticsearch_tpu.analysis import STANDARD
+        mapper = mappers.mapper(f) if mappers is not None else None
+        return getattr(mapper, "search_analyzer", None) or STANDARD
+
+    if isinstance(q, (dsl.Match, dsl.MatchPhrase)):
+        kind = field_kind(q.field)
+        if kind == "text":
+            toks = analyzer_for(q.field).terms(q.text)
+            return {(q.field, t) for t in toks} or None
+        if kind == "keyword":
+            return {(q.field, str(q.text))}
+        return None
+    if isinstance(q, (dsl.Term, dsl.Terms)):
+        if field_kind(q.field) not in ("keyword",):
+            return None   # numeric/date/text equality: doc-values matching
+        values = [q.value] if isinstance(q, dsl.Term) else list(q.values)
+        if not all(isinstance(v, str) for v in values):
+            return None
+        return {(q.field, v) for v in values} or None
+    if isinstance(q, dsl.ConstantScore):
+        return required_terms(q.filter, mappers)
+    if isinstance(q, dsl.Bool):
+        for child in list(q.must) + list(q.filter):
+            got = required_terms(child, mappers)
+            if got:
+                return got   # the bool REQUIRES this child to match
+        if q.should and not q.must and not q.filter:
+            union: set = set()
+            for child in q.should:
+                got = required_terms(child, mappers)
+                if not got:
+                    return None   # one unextractable OR arm spoils proof
+                union |= got
+            return union or None
+    return None
+
+
+def _document_tokens(doc_ctx) -> set:
+    """(field, term) pairs present in the candidate document(s): analyzed
+    postings plus keyword values — the vocabulary candidate pruning tests
+    required_terms against."""
+    seg = doc_ctx.segment
+    out: set = set()
+    for fname, pf in seg.postings.items():
+        out.update((fname, t) for t in pf.terms)
+    for fname, kf in seg.keywords.items():
+        out.update((fname, t) for t in kf.term_list)
+    return out
+
+
 def percolate_segment(ctx, field_name: str,
                       documents: List[Dict[str, Any]]) -> np.ndarray:
     """Mask over the percolator segment's docs: True where the stored
-    query under ``field_name`` matches ANY of the candidate documents."""
+    query under ``field_name`` matches ANY of the candidate documents.
+
+    Two phases like the reference: a TERM-SET PRE-FILTER selects
+    candidate queries (stored queries whose required-term cover misses
+    the document's vocabulary provably cannot match and are never
+    evaluated — the MemoryIndex candidate-selection phase), then full
+    evaluation verifies only the candidates. Extraction covers are cached
+    on the immutable segment, so a registry of 10k queries pays the parse
+    once and O(candidates) per percolation, not O(queries)."""
     from elasticsearch_tpu.search import dsl
     from elasticsearch_tpu.search.execute import execute
 
@@ -55,11 +145,35 @@ def percolate_segment(ctx, field_name: str,
     key = ("percolate", field_name,
            json.dumps(documents, sort_keys=True, default=str))
 
+    def covers():
+        out: List[Optional[set]] = []
+        for d in range(seg.n_docs):
+            src = seg.sources[d] or {}
+            body = src.get(field_name)
+            if body is None:
+                out.append(set())   # not a query doc: never a candidate
+                continue
+            try:
+                out.append(required_terms(dsl.parse_query(body),
+                                          ctx.mappers))
+            except Exception:  # noqa: BLE001 — unparseable: candidate
+                out.append(None)   # full evaluation decides (and fails)
+        return out
+
+    query_covers = seg.cached_filter(
+        ("percolate_covers", field_name), covers)
+
     def build():
         doc_ctx = build_document_ctx(documents, ctx.mappers)
+        doc_tokens = _document_tokens(doc_ctx)
         n_cand = len(documents)
         mask = np.zeros(seg.n_docs, bool)
         for d in range(seg.n_docs):
+            cover = query_covers[d]
+            if cover is not None and not cover:
+                continue   # not a query document
+            if cover is not None and not (cover & doc_tokens):
+                continue   # provably cannot match: pruned, never executed
             src = seg.sources[d] or {}
             body = src.get(field_name)
             if body is None:

@@ -66,6 +66,8 @@ class ShardQueryResult:
     # per-shard profile block when the request set "profile": true
     # (search/profile/query/QueryProfiler analog)
     profile: Optional[Dict[str, Any]] = None
+    # the shard stopped counting/collecting at terminate_after
+    terminated_early: bool = False
 
 
 def parse_sort(sort_body: Any) -> List[SortSpec]:
@@ -453,6 +455,7 @@ def query_shard(reader: Reader,
                 collapse: Optional[Dict[str, Any]] = None,
                 slice_spec: Optional[Dict[str, Any]] = None,
                 profile: bool = False,
+                terminate_after: Optional[int] = None,
                 cancel_check: Optional[Any] = None) -> ShardQueryResult:
     """Execute one query over all segments of a shard snapshot.
 
@@ -517,6 +520,10 @@ def query_shard(reader: Reader,
         # these phases need the full candidate set / extra doc context —
         # always the dense collector (the reference likewise disables
         # early termination when rescoring or collapsing)
+        collector = "dense"
+    if terminate_after:
+        # the terminate_after counting contract needs per-segment counts
+        # (QueryPhase.java:223's early-terminating collector)
         collector = "dense"
     if rescore is not None:
         if not (len(sort) == 1 and sort[0].field == "_score"):
@@ -587,7 +594,7 @@ def query_shard(reader: Reader,
             ctxs, reader, mappers, query, sort, size, from_, want,
             search_after, min_score, exact_total, track_limit, total_hits,
             score_sort, score_asc, collectors, cancel_check, doc_count, dfs,
-            candidates, rescore, collapse, slice_spec)
+            candidates, rescore, collapse, slice_spec, terminate_after)
         if profile:
             name = ("SimpleFieldCollector" if not score_sort
                     else "SimpleTopScoreDocCollector")
@@ -703,12 +710,26 @@ def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
                        search_after, min_score, exact_total, track_limit,
                        total_hits, score_sort, score_asc, collectors,
                        cancel_check, doc_count, dfs, candidates,
-                       rescore=None, collapse=None, slice_spec=None):
+                       rescore=None, collapse=None, slice_spec=None,
+                       terminate_after=None):
+    terminated = False
     for si, ctx in enumerate(ctxs):
         if cancel_check is not None:
             cancel_check()
         seg = ctx.segment
         scores, mask = execute(query, ctx)
+        if terminate_after:
+            # collect EXACTLY up to the cap: if this segment would push
+            # past it, keep only the first remaining matches in doc order
+            # (the reference's collector stops mid-segment the same way)
+            remaining = int(terminate_after) - total_hits
+            mask_host = np.asarray(mask)
+            if int(mask_host.sum()) > remaining:
+                order = np.nonzero(mask_host)[0][:remaining]
+                clipped = np.zeros(len(mask_host), bool)
+                clipped[order] = True
+                mask = mask & jnp.asarray(clipped)
+                terminated = True
         if slice_spec is not None:
             # sliced scroll: this slice only sees docs whose _id hashes
             # into its partition (SliceBuilder.java's _id slicing)
@@ -772,6 +793,13 @@ def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
         for collector in (collectors or []):
             collector.collect(ctx, si, scores, mask)
 
+        if terminate_after and total_hits >= int(terminate_after):
+            # stop visiting further segments; totals clamp at the cap
+            # (SearchService terminate_after contract: relation eq,
+            # terminated_early true)
+            terminated = True
+            break
+
     # order candidates by the sort spec, (segment, doc) as final tiebreak
     reverse = [s.order == "desc" for s in sort]
     if score_sort:
@@ -821,11 +849,14 @@ def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
         max_score = max(c.score for c in candidates)
 
     relation = "eq"
+    if terminate_after and total_hits > int(terminate_after):
+        total_hits = int(terminate_after)
     if exact_total and track_limit < (1 << 62) and total_hits > track_limit:
         relation = "gte"
         total_hits = track_limit
     return ShardQueryResult(window, total_hits, relation, max_score,
-                            doc_count=doc_count, dfs=dfs)
+                            doc_count=doc_count, dfs=dfs,
+                            terminated_early=terminated)
 
 
 def _topk(scores: jnp.ndarray, k: int):

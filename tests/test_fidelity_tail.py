@@ -1,0 +1,177 @@
+"""Round-4 fidelity tail: matched_queries, terminate_after,
+significant_text, percolator candidate pruning.
+
+Reference: search/fetch/subphase/MatchedQueriesPhase.java:43,
+search/query/QueryPhase.java:223 (terminate_after),
+bucket/terms/SignificantTextAggregationBuilder.java,
+modules/percolator/.../QueryAnalyzer.java (candidate extraction).
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=1, seed=43)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def test_matched_queries_named_clauses(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("docs", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {"body": {"type": "text"},
+                                    "tag": {"type": "keyword"}}}}, cb)))
+    cluster.ensure_green("docs")
+    corpus = [("a", "red fox", "hot"), ("b", "red wolf", "cold"),
+              ("c", "blue fox", "hot")]
+    for did, body, tag in corpus:
+        _ok(*cluster.call(lambda cb, d=did, b=body, t=tag:
+                          client.index_doc("docs", d,
+                                           {"body": b, "tag": t}, cb)))
+    cluster.call(lambda cb: client.refresh("docs", cb))
+
+    res = _ok(*cluster.call(lambda cb: client.search("docs", {
+        "query": {"bool": {"should": [
+            {"match": {"body": {"query": "red", "_name": "is_red"}}},
+            {"match": {"body": {"query": "fox", "_name": "is_fox"}}},
+            {"term": {"tag": {"value": "hot", "_name": "is_hot"}}},
+        ]}}, "size": 10}, cb)))
+    by_id = {h["_id"]: h for h in res["hits"]["hits"]}
+    assert sorted(by_id) == ["a", "b", "c"]
+    assert sorted(by_id["a"]["matched_queries"]) == \
+        ["is_fox", "is_hot", "is_red"]
+    assert sorted(by_id["b"]["matched_queries"]) == ["is_red"]
+    assert sorted(by_id["c"]["matched_queries"]) == ["is_fox", "is_hot"]
+
+    # unnamed queries add nothing
+    res = _ok(*cluster.call(lambda cb: client.search("docs", {
+        "query": {"match": {"body": "red"}}, "size": 10}, cb)))
+    assert all("matched_queries" not in h for h in res["hits"]["hits"])
+
+
+def test_terminate_after(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("big", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0}}, cb)))
+    cluster.ensure_green("big")
+    for i in range(20):
+        _ok(*cluster.call(lambda cb, i=i: client.index_doc(
+            "big", f"d{i}", {"body": "common"}, cb)))
+    cluster.call(lambda cb: client.refresh("big", cb))
+
+    res = _ok(*cluster.call(lambda cb: client.search("big", {
+        "query": {"match": {"body": "common"}}, "size": 3,
+        "terminate_after": 5, "track_total_hits": True}, cb)))
+    assert res["terminated_early"] is True
+    assert res["hits"]["total"]["value"] == 5
+    assert len(res["hits"]["hits"]) == 3
+
+    # above the match count: no early termination flag
+    res = _ok(*cluster.call(lambda cb: client.search("big", {
+        "query": {"match": {"body": "common"}}, "size": 3,
+        "terminate_after": 100, "track_total_hits": True}, cb)))
+    assert "terminated_early" not in res
+    assert res["hits"]["total"]["value"] == 20
+
+
+def test_significant_text(cluster):
+    client = cluster.client()
+    _ok(*cluster.call(lambda cb: client.create_index("news", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {"body": {"type": "text"}}}}, cb)))
+    cluster.ensure_green("news")
+    # "breach" is overrepresented in docs matching "bank"
+    rows = (["bank breach report today"] * 5 +
+            ["bank breach alert"] * 3 +
+            ["weather sunny today"] * 10 +
+            ["weather rainy report"] * 10)
+    for i, body in enumerate(rows):
+        _ok(*cluster.call(lambda cb, i=i, b=body: client.index_doc(
+            "news", f"n{i}", {"body": b}, cb)))
+    cluster.call(lambda cb: client.refresh("news", cb))
+
+    res = _ok(*cluster.call(lambda cb: client.search("news", {
+        "query": {"match": {"body": "bank"}}, "size": 0,
+        "aggs": {"sig": {"significant_text": {"field": "body"}}}}, cb)))
+    buckets = res["aggregations"]["sig"]["buckets"]
+    keys = [b["key"] for b in buckets]
+    assert "breach" in keys
+    # terms absent from the foreground never appear
+    assert "weather" not in keys and "sunny" not in keys \
+        and "rainy" not in keys
+    # foreground-exclusive terms outscore merely-present common ones
+    by_key = {b["key"]: b for b in buckets}
+    assert by_key["breach"]["doc_count"] == 8
+    assert by_key["breach"]["score"] > by_key.get(
+        "today", {"score": 0})["score"]
+    # bank/breach (fg-exclusive) dominate the ranking
+    assert set(keys[:2]) == {"bank", "breach"}
+
+
+def test_percolator_candidate_pruning():
+    """The pre-filter must cut evaluated queries to the candidate set
+    while matching exactly what full evaluation matches."""
+    from elasticsearch_tpu.index import InternalEngine
+    from elasticsearch_tpu.mapping import MapperService
+    from elasticsearch_tpu.search import percolate
+
+    mappers = MapperService({"properties": {
+        "q": {"type": "percolator"},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"}}})
+    eng = InternalEngine(mappers, shard_label="perc")
+    # 50 stored queries on disjoint terms + 1 unprunable (range)
+    for i in range(50):
+        eng.index(f"q{i}", {"q": {"match": {"body": f"term{i}"}}})
+    eng.index("qr", {"q": {"range": {"n": {"gte": 5}}}})
+    eng.index("qb", {"q": {"bool": {"must": [
+        {"match": {"body": "term7"}}],
+        "filter": [{"term": {"tag": "x"}}]}}})
+    eng.refresh()
+    reader = eng.acquire_reader()
+    seg = reader.segments[0]
+    from elasticsearch_tpu.search.execute import SegmentContext
+    ctx = SegmentContext(seg, mappers)
+
+    doc = {"body": "term7 only", "tag": "x", "n": 9}
+    mask = percolate.percolate_segment(ctx, "q", [doc])
+    matched = sorted(seg.ids[d] for d in np.nonzero(mask)[0])
+    assert matched == ["q7", "qb", "qr"]
+
+    # the cover cache proves pruning happened: all but q7/qb have
+    # non-overlapping covers, qr has none (always-candidate)
+    covers = seg.cached_filter(("percolate_covers", "q"), lambda: None)
+    assert covers is not None
+    prunable = [c for c in covers if c]
+    assert len(prunable) >= 50
+    # extraction semantics (mapper-aware: text analyzes, keyword literal,
+    # numeric/unmapped unprovable)
+    from elasticsearch_tpu.search import dsl
+    assert percolate.required_terms(
+        dsl.parse_query({"match": {"body": "a b"}}), mappers) == \
+        {("body", "a"), ("body", "b")}
+    assert percolate.required_terms(
+        dsl.parse_query({"term": {"tag": "Hot"}}), mappers) == \
+        {("tag", "Hot")}
+    assert percolate.required_terms(
+        dsl.parse_query({"range": {"n": {"gte": 1}}}), mappers) is None
+    # numeric term equality matches via doc values: unprovable
+    assert percolate.required_terms(
+        dsl.parse_query({"term": {"n": 5}}), mappers) is None
+    # unmapped field: unprovable (dynamic doc mapping decides later)
+    assert percolate.required_terms(
+        dsl.parse_query({"match": {"ghost": "x"}}), mappers) is None
+    assert percolate.required_terms(dsl.parse_query({"bool": {
+        "should": [{"match": {"body": "a"}},
+                   {"range": {"n": {"gte": 1}}}]}}), mappers) is None

@@ -33,9 +33,17 @@ def test_yaml_spec(cluster, name, steps):
     controller = build_controller(cluster.client())
 
     def do_request(method, path, body=None, query=None):
+        import json as _json
+        raw = b""
+        if isinstance(body, list):
+            # bulk/msearch NDJSON convention: a list body ships as raw
+            # newline-delimited JSON, exactly like the reference client
+            raw = ("\n".join(_json.dumps(x) for x in body) + "\n"
+                   ).encode("utf-8")
+            body = None
         req = RestRequest(method=method, path=path,
                           query=dict(query or {}), body=body,
-                          raw_body=b"")
+                          raw_body=raw)
         out = []
         controller.dispatch(req, lambda s, b: out.append((s, b)))
         cluster.run_until(lambda: bool(out), 120.0)

@@ -50,12 +50,32 @@ _API_TABLE = {
     "count": ("POST", "/{index}/_count"),
     "bulk": ("POST", "/_bulk"),
     "mget": ("POST", "/{index}/_mget"),
-    "cluster.health": ("GET", "/_cluster/health"),
+    "cluster.health": ("GET", "/_cluster/health/{index}"),
     "cluster.put_settings": ("PUT", "/_cluster/settings"),
     "cat.indices": ("GET", "/_cat/indices"),
     "cat.count": ("GET", "/_cat/count/{index}"),
     "ingest.put_pipeline": ("PUT", "/_ingest/pipeline/{id}"),
     "ingest.simulate": ("POST", "/_ingest/pipeline/_simulate"),
+    "indices.put_index_template": ("PUT", "/_index_template/{name}"),
+    "indices.rollover": ("POST", "/{alias}/_rollover"),
+    "indices.forcemerge": ("POST", "/{index}/_forcemerge"),
+    "indices.open": ("POST", "/{index}/_open"),
+    "indices.close": ("POST", "/{index}/_close"),
+    "indices.analyze": ("POST", "/{index}/_analyze"),
+    "indices.stats": ("GET", "/{index}/_stats"),
+    "indices.get_alias": ("GET", "/{index}/_alias"),
+    "field_caps": ("GET", "/{index}/_field_caps"),
+    "msearch": ("POST", "/{index}/_msearch"),
+    "delete_by_query": ("POST", "/{index}/_delete_by_query"),
+    "update_by_query": ("POST", "/{index}/_update_by_query"),
+    "reindex": ("POST", "/_reindex"),
+    "explain": ("GET", "/{index}/_explain/{id}"),
+    "termvectors": ("GET", "/{index}/_termvectors/{id}"),
+    "put_script": ("PUT", "/_scripts/{id}"),
+    "render_search_template": ("POST", "/_render/template"),
+    "security.put_user": ("PUT", "/_security/user/{username}"),
+    "security.put_role": ("PUT", "/_security/role/{name}"),
+    "security.get_user": ("GET", "/_security/user/{username}"),
 }
 
 
@@ -87,6 +107,18 @@ class YamlSpecRunner:
         """Dotted path into the last response; $stash refs resolve;
         escaped dots (a\\.b) address literal dotted keys; numeric parts
         index arrays."""
+        if path == "$body" or path.startswith("$body."):
+            # the reference's $body pseudo-stash: the raw last response
+            node = self.last_response
+            rest = path[len("$body."):] if path != "$body" else ""
+            for part in [p for p in rest.split(".") if p]:
+                try:
+                    node = node[int(part)] if isinstance(node, list) \
+                        else node[part]
+                except (KeyError, IndexError, TypeError, ValueError):
+                    raise YamlSpecFailure(
+                        f"path [{path}]: missing [{part}]")
+            return node
         if path.startswith("$"):
             return self.stash[path[1:]]
         node = self.last_response
@@ -212,7 +244,10 @@ class YamlSpecRunner:
             raise YamlSpecFailure(f"is_true [{path}]: {value!r}")
 
     def step_is_false(self, path: str) -> None:
-        value = self._lookup(path)
+        try:
+            value = self._lookup(path)
+        except YamlSpecFailure:
+            return   # a missing path IS false (the reference's semantics)
         if value:
             raise YamlSpecFailure(f"is_false [{path}]: {value!r}")
 
