@@ -47,6 +47,8 @@ DELETE_ILM_POLICY = "cluster:admin/ilm/delete"
 ROLLOVER = "indices:admin/rollover"
 CREATE_DATA_STREAM = "indices:admin/data_stream/create"
 DELETE_DATA_STREAM = "indices:admin/data_stream/delete"
+VOTING_EXCLUSIONS = "cluster:admin/voting_config/exclusions"
+PERSISTENT_UPDATE = "cluster:admin/persistent/update"
 PUT_SECURITY = "cluster:admin/xpack/security/put"
 DELETE_SECURITY = "cluster:admin/xpack/security/delete"
 PUT_CUSTOM = "cluster:admin/xpack/custom/put"
@@ -119,6 +121,8 @@ class MasterActions:
             (ROLLOVER, self._on_rollover),
             (CREATE_DATA_STREAM, self._on_create_data_stream),
             (DELETE_DATA_STREAM, self._on_delete_data_stream),
+            (VOTING_EXCLUSIONS, self._on_voting_exclusions),
+            (PERSISTENT_UPDATE, self._on_persistent_update),
             (PUT_SECURITY, self._on_put_security),
             (DELETE_SECURITY, self._on_delete_security),
             (PUT_CUSTOM, self._on_put_custom),
@@ -497,6 +501,84 @@ class MasterActions:
         return self._submit(f"delete-{section} [{name}]", update)
 
     # -- rollover (TransportRolloverAction's atomic state half) ----------
+
+    def _on_persistent_update(self, req: Dict[str, Any],
+                              sender: str) -> Deferred:
+        """Field-level merge into one persistent task's entry, applied
+        against the AUTHORITATIVE state inside the update closure — a
+        caller-side read-modify-write PUT would race concurrent
+        assignment/state writes and lose one of them
+        (PersistentTasksClusterService's versioned task updates)."""
+        task_id = req["task_id"]
+        fields = dict(req.get("set") or {})
+
+        def update(state: ClusterState) -> ClusterState:
+            entries = dict(state.metadata.custom.get(
+                "persistent_tasks", {}))
+            entry = entries.get(task_id)
+            if entry is None:
+                from elasticsearch_tpu.utils.errors import (
+                    ResourceNotFoundError,
+                )
+                raise ResourceNotFoundError(
+                    f"no persistent task [{task_id}]")
+            return state.next_version(
+                metadata=state.metadata.with_custom_entry(
+                    "persistent_tasks", task_id, {**entry, **fields}))
+        return self._submit(f"persistent-update [{task_id}]", update)
+
+    def _on_voting_exclusions(self, req: Dict[str, Any],
+                              sender: str) -> Deferred:
+        """Voting-config exclusions (AddVotingConfigExclusionsAction /
+        ClearVotingConfigExclusionsAction analog): excluded master-eligible
+        nodes leave the voting configuration so they can be decommissioned
+        without losing quorum math; clearing re-admits present members.
+
+        The exclusion list replicates in metadata
+        (custom["voting_exclusions"]) and the shrunken voting_config rides
+        the SAME committed state update, so every node's quorum arithmetic
+        flips atomically — the reference's CoordinationMetadata semantics."""
+        action = req.get("action", "add")
+        nodes = [str(n) for n in (req.get("node_names") or [])]
+
+        def update(state: ClusterState) -> ClusterState:
+            current = set(state.voting_config)
+            md = state.metadata
+            exclusions = dict(md.custom.get("voting_exclusions", {}))
+            if action == "add":
+                if not nodes:
+                    raise IllegalArgumentError(
+                        "add voting exclusions requires [node_names]")
+                # a typo'd name must fail loudly: silently recording a
+                # no-op exclusion would let an operator decommission a
+                # node the quorum still counts
+                unknown = [n for n in nodes
+                           if n not in current and n not in state.nodes]
+                if unknown:
+                    raise IllegalArgumentError(
+                        f"unknown voting node(s) {sorted(unknown)}")
+                remaining = current - set(nodes)
+                if not remaining:
+                    raise IllegalArgumentError(
+                        "cannot exclude every voting node: the cluster "
+                        "would lose its quorum")
+                for n in nodes:
+                    exclusions[n] = {"reason": "excluded"}
+                new_config = frozenset(remaining)
+            else:
+                # clear: re-admit PRESENT MASTER-ELIGIBLE members only —
+                # data-only nodes never vote, counting them in the config
+                # would create phantom voters quorum can never reach
+                exclusions = {}
+                members = set(state.master_eligible_nodes())
+                new_config = frozenset(current | members)
+            for name in list(md.custom.get("voting_exclusions", {})):
+                md = md.with_custom_entry("voting_exclusions", name, None)
+            for name, body in exclusions.items():
+                md = md.with_custom_entry("voting_exclusions", name, body)
+            return state.next_version(metadata=md,
+                                      voting_config=new_config)
+        return self._submit(f"voting-exclusions-{action}", update)
 
     def _on_create_data_stream(self, req: Dict[str, Any],
                                sender: str) -> Deferred:

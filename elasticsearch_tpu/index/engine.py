@@ -47,6 +47,17 @@ class EngineResult:
     result: str               # 'created' | 'updated' | 'deleted' | 'noop' | 'not_found'
 
 
+class _InvertedStr(str):
+    """A str whose ordering is reversed (desc index sorts on keywords)."""
+    __slots__ = ()
+
+    def __lt__(self, other):  # noqa: D105
+        return str.__gt__(self, other)
+
+    def __gt__(self, other):  # noqa: D105
+        return str.__lt__(self, other)
+
+
 class Reader:
     """An immutable point-in-time view of the searchable segments.
 
@@ -78,12 +89,17 @@ class InternalEngine:
                  store: Optional[Store] = None,
                  translog: Optional[Translog] = None,
                  primary_term: int = 1,
-                 shard_label: str = "shard0"):
+                 shard_label: str = "shard0",
+                 index_sort: Optional[Tuple[str, str]] = None):
         self.mappers = mapper_service
         self.store = store
         self.translog = translog
         self.primary_term = primary_term
         self.shard_label = shard_label
+        # (field, order) from index.sort.field/index.sort.order
+        # (index/IndexSortConfig.java:57): new segments store docs in
+        # sort order, so sort-matching scans read presorted data
+        self.index_sort = index_sort
         self.tracker = LocalCheckpointTracker()
 
         self._lock = threading.RLock()
@@ -257,7 +273,10 @@ class InternalEngine:
                 self._segment_counter += 1
                 builder = SegmentBuilder(
                     f"{self.shard_label}_seg{self._segment_counter}", self.mappers)
-                for doc_id in self._buffer_order:
+                order = list(self._buffer_order)
+                if self.index_sort is not None:
+                    order = self._sorted_buffer_order(order)
+                for doc_id in order:
                     parsed, seqno, version, term = self._buffer[doc_id]
                     builder.add(parsed, seqno, version, term)
                 self.segments.append(builder.build())
@@ -267,6 +286,29 @@ class InternalEngine:
         for fn in listeners:
             fn()
         return True
+
+    def _sorted_buffer_order(self, order):
+        """Buffer ids reordered by the index sort field (missing values
+        last, ties in arrival order — IndexSortConfig semantics)."""
+        fname, direction = self.index_sort
+
+        def key(doc_id):
+            parsed = self._buffer[doc_id][0]
+            value = parsed.source.get(fname)
+            if isinstance(value, list):
+                value = value[0] if value else None
+            if isinstance(value, bool):
+                value = int(value)
+            if isinstance(value, (int, float)):
+                v = float(value)
+                return (0, -v if direction == "desc" else v, "")
+            if isinstance(value, str):
+                # desc string ordering inverts via a sign marker handled
+                # by the tuple compare below
+                return (0, 0.0, value) if direction == "asc" else \
+                    (0, 0.0, _InvertedStr(value))
+            return (1, 0.0, "")   # missing: last
+        return sorted(order, key=key)
 
     def flush(self) -> None:
         """Commit: refresh, persist, roll translog. Reference: InternalEngine.flush:489."""
@@ -331,10 +373,51 @@ class InternalEngine:
 
     def _merge(self, to_merge: List[Segment]) -> bool:
         self._segment_counter += 1
-        merged = merge_segments(
-            f"{self.shard_label}_seg{self._segment_counter}", to_merge, self.mappers)
+        name = f"{self.shard_label}_seg{self._segment_counter}"
+        if self.index_sort is not None:
+            merged = self._merge_sorted(name, to_merge)
+        else:
+            merged = merge_segments(name, to_merge, self.mappers)
         self.segments = _insert_merged(merged, self.segments, to_merge)
         return True
+
+    def _merge_sorted(self, name: str, to_merge: List[Segment]) -> Segment:
+        """Merge live docs REBUILT in index-sort order: a plain
+        concatenating merge would violate the index.sort contract the
+        refresh path established (the reference re-sorts at merge when an
+        index sort is configured, IndexSortConfig + SortingLeafReader)."""
+        rows = []   # (sortable key via _sorted_buffer_order, doc data)
+        for seg in to_merge:
+            for d in range(seg.n_docs):
+                if not seg.live[d]:
+                    continue
+                rows.append((seg.ids[d], seg.sources[d] or {},
+                             seg.routings[d] if d < len(seg.routings)
+                             else None,
+                             seg.seqnos[d] if hasattr(seg, "seqnos") and
+                             d < len(seg.seqnos) else 0))
+        fname, direction = self.index_sort
+
+        def key(row):
+            value = row[1].get(fname)
+            if isinstance(value, list):
+                value = value[0] if value else None
+            if isinstance(value, bool):
+                value = int(value)
+            if isinstance(value, (int, float)):
+                v = float(value)
+                return (0, -v if direction == "desc" else v, "")
+            if isinstance(value, str):
+                return (0, 0.0, value) if direction == "asc" else \
+                    (0, 0.0, _InvertedStr(value))
+            return (1, 0.0, "")
+        rows.sort(key=key)
+        builder = SegmentBuilder(name, self.mappers)
+        for doc_id, source, routing, seqno in rows:
+            builder.add(self.mappers.parse_document(doc_id, source,
+                                                    routing=routing),
+                        seqno)
+        return builder.build()
 
     # ------------------------------------------------------------------
     # recovery

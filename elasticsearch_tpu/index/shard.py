@@ -51,7 +51,8 @@ class IndexShard:
                  primary: bool, primary_term: int = 1,
                  allocation_id: Optional[str] = None,
                  store: Optional[Store] = None,
-                 translog: Optional[Translog] = None):
+                 translog: Optional[Translog] = None,
+                 index_sort=None):
         self.shard_id = shard_id
         self.primary = primary
         self.primary_term = primary_term
@@ -59,7 +60,8 @@ class IndexShard:
         self.engine = InternalEngine(
             mapper_service, store=store, translog=translog,
             primary_term=primary_term,
-            shard_label=f"{shard_id.index}_{shard_id.shard}")
+            shard_label=f"{shard_id.index}_{shard_id.shard}",
+            index_sort=index_sort)
         self.search = SearchService(self.engine, index_name=shard_id.index)
         self.tracker: Optional[ReplicationTracker] = None
         if primary:

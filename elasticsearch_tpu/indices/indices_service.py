@@ -46,10 +46,21 @@ class IndexService:
             raise ValueError(f"shard [{self.metadata.name}][{shard}] "
                              f"already exists on this node")
         store, translog = self._shard_paths(shard)
+        settings = dict(self.metadata.settings or {})
+        index_sort = None
+        sort_field = settings.get("index.sort.field")
+        if sort_field:
+            if isinstance(sort_field, list):
+                sort_field = sort_field[0]   # one sort key supported
+            sort_order = settings.get("index.sort.order", "asc")
+            if isinstance(sort_order, list):
+                sort_order = sort_order[0]
+            index_sort = (str(sort_field), str(sort_order))
         index_shard = IndexShard(
             ShardId(self.metadata.name, shard), self.mapper_service,
             primary=primary, primary_term=primary_term,
-            allocation_id=allocation_id, store=store, translog=translog)
+            allocation_id=allocation_id, store=store, translog=translog,
+            index_sort=index_sort)
         self.shards[shard] = index_shard
         return index_shard
 

@@ -164,6 +164,8 @@ class Node:
         self.ilm_service = IndexLifecycleService(self)
         from elasticsearch_tpu.xpack.slm import SnapshotLifecycleService
         self.slm_service = SnapshotLifecycleService(self)
+        from elasticsearch_tpu.persistent import PersistentTasksService
+        self.persistent_tasks = PersistentTasksService(self)
 
         from elasticsearch_tpu.xpack.security import SecurityService
         self.security = SecurityService(self)
@@ -301,6 +303,7 @@ class Node:
         self.ilm_service.start()
         self.slm_service.start()
         self.resource_watcher.start()
+        self.persistent_tasks.start()
         self.transform_service.start()
         self.watcher_service.start()
         self.ccr_service.start()
@@ -318,6 +321,7 @@ class Node:
         self.ilm_service.stop()
         self.slm_service.stop()
         self.resource_watcher.stop()
+        self.persistent_tasks.stop()
         self.coordinator.stop()
         self.transport_service.close()
         self.indices_service.close()

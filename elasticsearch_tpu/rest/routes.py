@@ -1212,6 +1212,23 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/_cluster/health", health)
     r("GET", "/_cluster/health/{index}", health)
 
+    def voting_exclusions_add(req: RestRequest, done: DoneFn) -> None:
+        names = (req.query or {}).get("node_names", "")
+        from elasticsearch_tpu.action.admin import VOTING_EXCLUSIONS
+        client.node.master_client.execute(VOTING_EXCLUSIONS, {
+            "action": "add",
+            "node_names": [n for n in names.split(",") if n]},
+            wrap_client_cb(done))
+    r("POST", "/_cluster/voting_config_exclusions", voting_exclusions_add)
+
+    def voting_exclusions_clear(req: RestRequest, done: DoneFn) -> None:
+        from elasticsearch_tpu.action.admin import VOTING_EXCLUSIONS
+        client.node.master_client.execute(VOTING_EXCLUSIONS,
+                                          {"action": "clear"},
+                                          wrap_client_cb(done))
+    r("DELETE", "/_cluster/voting_config_exclusions",
+      voting_exclusions_clear)
+
     def remote_info(req: RestRequest, done: DoneFn) -> None:
         """Configured remote clusters (RestRemoteClusterInfoAction)."""
         svc = getattr(client.node, "remote_clusters", None)

@@ -175,3 +175,38 @@ def test_percolator_candidate_pruning():
     assert percolate.required_terms(dsl.parse_query({"bool": {
         "should": [{"match": {"body": "a"}},
                    {"range": {"n": {"gte": 1}}}]}}), mappers) is None
+
+
+def test_index_sorting_orders_segment_docs():
+    """index.sort.field/order (IndexSortConfig.java:57): new segments
+    store docs presorted, so sort-matching scans read ordered data and
+    the sorted order survives into search results."""
+    from elasticsearch_tpu.testing import InProcessCluster
+    c = InProcessCluster(n_nodes=1, seed=61)
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("sorted", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0,
+                         "index.sort.field": "rank",
+                         "index.sort.order": "desc"},
+            "mappings": {"properties": {
+                "rank": {"type": "integer"}}}}, cb)))
+        c.ensure_green("sorted")
+        for i, rank in enumerate([3, 9, 1, 7]):
+            _ok(*c.call(lambda cb, i=i, r=rank: client.index_doc(
+                "sorted", f"d{i}", {"rank": r}, cb)))
+        c.call(lambda cb: client.refresh("sorted", cb))
+
+        node = c.master()
+        shard = node.indices_service.shard("sorted", 0)
+        seg = shard.engine.acquire_reader().segments[0]
+        ranks = [seg.sources[d]["rank"] for d in range(seg.n_docs)]
+        assert ranks == [9, 7, 3, 1]   # stored in desc sort order
+
+        res = _ok(*c.call(lambda cb: client.search(
+            "sorted", {"query": {"match_all": {}},
+                       "sort": [{"rank": "desc"}]}, cb)))
+        assert [h["sort"][0] for h in res["hits"]["hits"]] == [9, 7, 3, 1]
+    finally:
+        c.stop()

@@ -235,3 +235,29 @@ def test_mesh_serves_text_expansion(cluster):
     expect = [t[1] for t in truth[:6]]
     got = [h["_id"] for h in mesh["hits"]["hits"]]
     assert set(got) == set(expect)
+
+
+def test_mesh_build_cost_is_observable(cluster):
+    """VERDICT r3 weak #8: refresh-heavy workloads rebuild the mesh copy;
+    the rebuild price must be measurable, not invisible."""
+    client = cluster.client()
+    _index_corpus(cluster, client, name="bt", n=30, shards=2)
+    body = {"query": {"match": {"body": "beta"}},
+            "track_total_hits": False, "size": 5}
+    r, err = cluster.call(lambda cb: client.search("bt", body, cb))
+    _ok(r, err)
+    stats = cluster.master().mesh_plane.stats
+    assert stats["mesh_builds"] >= 1
+    assert stats["last_build_seconds"] > 0
+    assert stats["last_build_docs"] == 30
+    assert stats["build_seconds_total"] >= stats["last_build_seconds"]
+    before = stats["mesh_builds"]
+    # a refresh-invalidating write triggers exactly one more build
+    r, err = cluster.call(lambda cb: client.index_doc(
+        "bt", "new", {"body": "beta fresh"}, cb))
+    _ok(r, err)
+    cluster.call(lambda cb: client.refresh("bt", cb))
+    r, err = cluster.call(lambda cb: client.search("bt", body, cb))
+    _ok(r, err)
+    assert stats["mesh_builds"] == before + 1
+    assert stats["last_build_docs"] == 31

