@@ -511,12 +511,21 @@ class MasterActions:
         (PersistentTasksClusterService's versioned task updates)."""
         task_id = req["task_id"]
         fields = dict(req.get("set") or {})
+        create = req.get("create")
 
         def update(state: ClusterState) -> ClusterState:
             entries = dict(state.metadata.custom.get(
                 "persistent_tasks", {}))
             entry = entries.get(task_id)
-            if entry is None:
+            if create is not None:
+                # create-only: the duplicate check runs HERE against the
+                # authoritative state, so a raced/retried submit can never
+                # blind-overwrite a live task's assignment and progress
+                if entry is not None:
+                    raise IllegalArgumentError(
+                        f"persistent task [{task_id}] already exists")
+                entry = dict(create)
+            elif entry is None:
                 from elasticsearch_tpu.utils.errors import (
                     ResourceNotFoundError,
                 )

@@ -693,8 +693,21 @@ class Coordinator:
         def add(state: ClusterState) -> ClusterState:
             if joining.node_id in state.nodes:
                 return state
-            return state.with_nodes({**state.nodes, joining.node_id: joining},
-                                    self.node.node_id)
+            state = state.with_nodes(
+                {**state.nodes, joining.node_id: joining},
+                self.node.node_id)
+            # Reconfigurator analog: a master-eligible joiner that is not
+            # voting-excluded re-enters the voting configuration —
+            # without this, a node absent while exclusions were cleared
+            # would be disenfranchised forever
+            excluded = state.metadata.custom.get("voting_exclusions", {})
+            if joining.is_master_eligible and \
+                    joining.node_id not in excluded and \
+                    joining.node_id not in state.voting_config:
+                from dataclasses import replace
+                state = replace(state, voting_config=frozenset(
+                    set(state.voting_config) | {joining.node_id}))
+            return state
         self.submit_state_update(f"node-join [{joining.node_id}]", add)
         return {}
 

@@ -58,6 +58,23 @@ class _InvertedStr(str):
         return str.__lt__(self, other)
 
 
+def _index_sort_key(value, direction: str):
+    """One sortable tuple per doc for index.sort ordering — shared by the
+    refresh-path builder sort and the merge-path re-sort so the two can
+    never diverge. Missing values last; ties keep arrival order."""
+    if isinstance(value, list):
+        value = value[0] if value else None
+    if isinstance(value, bool):
+        value = int(value)
+    if isinstance(value, (int, float)):
+        v = float(value)
+        return (0, -v if direction == "desc" else v, "")
+    if isinstance(value, str):
+        return (0, 0.0, value) if direction == "asc" else \
+            (0, 0.0, _InvertedStr(value))
+    return (1, 0.0, "")
+
+
 class Reader:
     """An immutable point-in-time view of the searchable segments.
 
@@ -291,24 +308,8 @@ class InternalEngine:
         """Buffer ids reordered by the index sort field (missing values
         last, ties in arrival order — IndexSortConfig semantics)."""
         fname, direction = self.index_sort
-
-        def key(doc_id):
-            parsed = self._buffer[doc_id][0]
-            value = parsed.source.get(fname)
-            if isinstance(value, list):
-                value = value[0] if value else None
-            if isinstance(value, bool):
-                value = int(value)
-            if isinstance(value, (int, float)):
-                v = float(value)
-                return (0, -v if direction == "desc" else v, "")
-            if isinstance(value, str):
-                # desc string ordering inverts via a sign marker handled
-                # by the tuple compare below
-                return (0, 0.0, value) if direction == "asc" else \
-                    (0, 0.0, _InvertedStr(value))
-            return (1, 0.0, "")   # missing: last
-        return sorted(order, key=key)
+        return sorted(order, key=lambda doc_id: _index_sort_key(
+            self._buffer[doc_id][0].source.get(fname), direction))
 
     def flush(self) -> None:
         """Commit: refresh, persist, roll translog. Reference: InternalEngine.flush:489."""
@@ -386,37 +387,29 @@ class InternalEngine:
         concatenating merge would violate the index.sort contract the
         refresh path established (the reference re-sorts at merge when an
         index sort is configured, IndexSortConfig + SortingLeafReader)."""
-        rows = []   # (sortable key via _sorted_buffer_order, doc data)
+        rows = []   # (id, source, routing, seqno, version, primary_term)
         for seg in to_merge:
             for d in range(seg.n_docs):
                 if not seg.live[d]:
                     continue
-                rows.append((seg.ids[d], seg.sources[d] or {},
-                             seg.routings[d] if d < len(seg.routings)
-                             else None,
-                             seg.seqnos[d] if hasattr(seg, "seqnos") and
-                             d < len(seg.seqnos) else 0))
+                rows.append((
+                    seg.ids[d], seg.sources[d] or {},
+                    seg.routings[d] if d < len(seg.routings) else None,
+                    int(seg.seqnos[d]) if d < len(seg.seqnos) else 0,
+                    int(seg.versions[d]) if d < len(seg.versions) else 1,
+                    int(seg.primary_terms[d])
+                    if d < len(seg.primary_terms) else 1))
         fname, direction = self.index_sort
-
-        def key(row):
-            value = row[1].get(fname)
-            if isinstance(value, list):
-                value = value[0] if value else None
-            if isinstance(value, bool):
-                value = int(value)
-            if isinstance(value, (int, float)):
-                v = float(value)
-                return (0, -v if direction == "desc" else v, "")
-            if isinstance(value, str):
-                return (0, 0.0, value) if direction == "asc" else \
-                    (0, 0.0, _InvertedStr(value))
-            return (1, 0.0, "")
-        rows.sort(key=key)
+        rows.sort(key=lambda row: _index_sort_key(row[1].get(fname),
+                                                  direction))
+        # re-parse is the price of the rebuild (merges are rare, heavy
+        # operations by contract); versions/terms/seqnos carry over so
+        # optimistic concurrency survives the merge
         builder = SegmentBuilder(name, self.mappers)
-        for doc_id, source, routing, seqno in rows:
+        for doc_id, source, routing, seqno, version, term in rows:
             builder.add(self.mappers.parse_document(doc_id, source,
                                                     routing=routing),
-                        seqno)
+                        seqno, version, term)
         return builder.build()
 
     # ------------------------------------------------------------------

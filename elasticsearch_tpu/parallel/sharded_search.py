@@ -548,7 +548,8 @@ class ShardedTextIndex:
         total = sum(p.n_blocks for per in plans for p in per)
         qb_max = max((p.n_blocks for per in plans for p in per), default=1)
         qb_pad = qb_bucket(max(qb_max, 1))
-        if not prune or qb_pad <= P1_BUCKET:
+        if not prune or qb_max <= P1_BUCKET:
+            # every plan fits phase 1 whole — pruning cannot pay
             self.last_prune_stats = (total, total)
             return self._run_batch(fn, plans, qb_pad)
         p1 = [[p.top_by_ub(P1_BUCKET) for p in per] for per in plans]
@@ -558,7 +559,7 @@ class ShardedTextIndex:
               for q, per in enumerate(plans)]
         scored = sum(p.n_blocks for per in p2 for p in per)
         p1_cost = sum(p.n_blocks for per in p1 for p in per)
-        self.last_prune_stats = (total, scored + p1_cost)
+        self.last_prune_stats = (total, min(scored + p1_cost, total))
         qb2_max = max((p.n_blocks for per in p2 for p in per), default=1)
         qb2 = qb_bucket(max(qb2_max, 1))
         return self._run_batch(fn, p2, qb2)

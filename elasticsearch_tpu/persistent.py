@@ -94,21 +94,19 @@ class PersistentTasksService:
 
     def submit(self, task_id: str, task_name: str,
                params: Optional[Dict[str, Any]], on_done) -> None:
-        """Register a task; the master assigns it on its next pass."""
-        from elasticsearch_tpu.action.admin import PUT_CUSTOM
+        """Register a task; the master assigns it on its next pass. The
+        duplicate check happens master-side against authoritative state
+        (create-only semantics), so retries cannot clobber a live task."""
+        from elasticsearch_tpu.action.admin import PERSISTENT_UPDATE
         if task_name not in self._executors:
             on_done(None, ValueError(
                 f"no executor registered for task type [{task_name}]"))
             return
-        if task_id in self.tasks():
-            on_done(None, ValueError(
-                f"persistent task [{task_id}] already exists"))
-            return
-        self.node.master_client.execute(PUT_CUSTOM, {
-            "section": SECTION, "name": task_id,
-            "body": {"task_name": task_name,
-                     "params": dict(params or {}),
-                     "assignment": None, "state": {}}}, on_done)
+        self.node.master_client.execute(PERSISTENT_UPDATE, {
+            "task_id": task_id,
+            "create": {"task_name": task_name,
+                       "params": dict(params or {}),
+                       "assignment": None, "state": {}}}, on_done)
 
     def complete(self, task_id: str, on_done) -> None:
         from elasticsearch_tpu.action.admin import DELETE_CUSTOM
@@ -187,6 +185,13 @@ class PersistentTasksService:
                     logger.exception("persistent task [%s] failed to "
                                      "start", task_id)
                     self.local_running.pop(task_id, None)
+                    # a node-local start failure pins nothing: hand the
+                    # assignment back like the missing-executor case so
+                    # the master tries a DIFFERENT node next pass
+                    blocked = sorted(set(entry.get("blocked_nodes")
+                                         or []) | {self.node.node_id})
+                    self._merge(task_id, {"assignment": None,
+                                          "blocked_nodes": blocked})
             elif running and not mine:
                 self._stop_local(task_id)
         for task_id in [t for t in self.local_running if t not in tasks]:
