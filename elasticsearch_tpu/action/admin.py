@@ -577,10 +577,16 @@ class MasterActions:
             else:
                 # clear: re-admit PRESENT MASTER-ELIGIBLE members only —
                 # data-only nodes never vote, counting them in the config
-                # would create phantom voters quorum can never reach
+                # would create phantom voters quorum can never reach.
+                # Excluded voters ABSENT right now become pending: they
+                # re-enter the config when they rejoin (and only then), so
+                # the config never grows by nodes that may never return
+                was_excluded = set(md.custom.get("voting_exclusions", {}))
                 exclusions = {}
                 members = set(state.master_eligible_nodes())
                 new_config = frozenset(current | members)
+                for name in was_excluded - members:
+                    md = md.with_custom_entry("voting_pending", name, {})
             for name in list(md.custom.get("voting_exclusions", {})):
                 md = md.with_custom_entry("voting_exclusions", name, None)
             for name, body in exclusions.items():

@@ -645,26 +645,27 @@ class TransportSearchAction:
                 if mappers.field_type(field) not in ("text",
                                                      "search_as_you_type"):
                     return False
-                hits = self.mesh_plane.search_text(
+                result = self.mesh_plane.search_text(
                     index, field, shards, body, mappers,
                     clauses=spec["clauses"])
             elif kind == "knn":
                 if mappers.field_type(field) != "dense_vector":
                     return False
-                hits = self.mesh_plane.search_knn(index, field, shards,
-                                                  body, spec["query"])
+                result = self.mesh_plane.search_knn(index, field, shards,
+                                                    body, spec["query"])
             elif kind == "sparse":
                 if mappers.field_type(field) not in ("rank_features",
                                                      "rank_feature"):
                     return False
-                hits = self.mesh_plane.search_sparse(index, field, shards,
-                                                     body, spec["query"])
+                result = self.mesh_plane.search_sparse(
+                    index, field, shards, body, spec["query"])
             else:
                 return False
         except Exception:  # noqa: BLE001 — RPC path reports real errors
             return False
-        if hits is None:
+        if result is None:
             return False
+        hits = result["hits"]
         phase_state["data_plane"] = "mesh"
         # synthesize per-shard query results so merge+fetch run unchanged
         # (the mesh program already IS the global merge; per-shard splits
@@ -674,17 +675,16 @@ class TransportSearchAction:
             by_shard.setdefault(h["shard"], []).append(
                 {"segment": h["segment"], "doc": h["doc"],
                  "score": h["score"], "sort": h["sort"]})
-        # totals: the text program observes only gathered blocks (lower
-        # bound, "gte" — eligibility requires totals disabled); knn/sparse
-        # are top-k-exact retrievals whose hit set IS the result ("eq")
-        relation = "gte" if kind == "text" else "eq"
+        # totals are GLOBAL (the mesh program is the merge): the whole
+        # request's count rides the first target; the others add zero
         results: List[Optional[Dict[str, Any]]] = []
-        for target in targets:
+        for i, target in enumerate(targets):
             target["node"] = self.node_id    # fetch runs locally
             docs = by_shard.get(target["shard"], [])
             results.append({
-                "context_id": None, "total": len(docs),
-                "relation": relation,
+                "context_id": None,
+                "total": result["total"] if i == 0 else 0,
+                "relation": result["relation"] if i == 0 else "eq",
                 "max_score": max((d["score"] for d in docs), default=None),
                 "docs": docs})
         self._merge_and_fetch(t0, targets, results, body, from_, size,

@@ -696,17 +696,21 @@ class Coordinator:
             state = state.with_nodes(
                 {**state.nodes, joining.node_id: joining},
                 self.node.node_id)
-            # Reconfigurator analog: a master-eligible joiner that is not
-            # voting-excluded re-enters the voting configuration —
-            # without this, a node absent while exclusions were cleared
-            # would be disenfranchised forever
-            excluded = state.metadata.custom.get("voting_exclusions", {})
+            # re-enfranchisement: ONLY a joiner whose exclusion was
+            # cleared while it was away (recorded as voting_pending)
+            # re-enters the voting configuration. Unconditional growth
+            # would let a transient joiner leave behind an even-sized
+            # config whose quorum a later departure can never reach.
+            pending = state.metadata.custom.get("voting_pending", {})
             if joining.is_master_eligible and \
-                    joining.node_id not in excluded and \
+                    joining.node_id in pending and \
                     joining.node_id not in state.voting_config:
                 from dataclasses import replace
                 state = replace(state, voting_config=frozenset(
                     set(state.voting_config) | {joining.node_id}))
+                state = state.next_version(
+                    metadata=state.metadata.with_custom_entry(
+                        "voting_pending", joining.node_id, None))
             return state
         self.submit_state_update(f"node-join [{joining.node_id}]", add)
         return {}

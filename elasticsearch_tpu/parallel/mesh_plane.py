@@ -42,10 +42,11 @@ def mesh_eligible(body: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     choose_collector_context plus mesh-specific ones (no phases needing
     per-shard readers during query).
 
-    Totals: text requires totals disabled (the one-program path has no
-    counts-then-skip kernel yet); knn/sparse are top-k-exact by
-    construction (total = k, relation eq), so any finite threshold is
-    servable."""
+    Totals: text serves totals-disabled AND finite-threshold requests
+    (counts-then-skip rides the sharded program's psum'd match counts);
+    only track_total_hits: true (unbounded exact) falls back to RPC.
+    knn/sparse are top-k-exact by construction (total = k, relation eq),
+    so any finite threshold is servable."""
     if body.get("aggs") or body.get("aggregations") or body.get("suggest"):
         return None
     if body.get("sort") is not None or body.get("search_after") is not None:
@@ -60,18 +61,12 @@ def mesh_eligible(body: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         q = dsl.parse_query(body.get("query"))
     except Exception:  # noqa: BLE001 — let the RPC path raise the real error
         return None
-    totals_off = (body.get("track_total_hits") is False
-                  or body.get("track_total_hits") == 0)
+    if body.get("track_total_hits") is True:
+        return None   # unbounded exact counting: host path
     if isinstance(q, dsl.Knn) and q.filter is None:
-        if body.get("track_total_hits") is True:
-            return None
         return {"kind": "knn", "field": q.field, "query": q}
     if isinstance(q, dsl.TextExpansion):
-        if body.get("track_total_hits") is True:
-            return None
         return {"kind": "sparse", "field": q.field, "query": q}
-    if not totals_off:
-        return None
     got = dsl.disjunctive_clauses(q)
     if got is None:
         return None
@@ -274,10 +269,17 @@ class MeshDataPlane:
 
     def search_text(self, index_name: str, field: str, shards,
                     body: Dict[str, Any], mappers,
-                    clauses=None) -> Optional[List[Dict[str, Any]]]:
-        """Run the one-program path; returns per-hit dicts
-        {shard, segment, doc, score} globally sorted, or None if the field
-        isn't analyzable here (caller falls back to RPC)."""
+                    clauses=None) -> Optional[Dict[str, Any]]:
+        """Run the one-program path; returns {"hits": [...], "total",
+        "relation"} with hits globally sorted, or None if the field isn't
+        analyzable here (caller falls back to RPC).
+
+        Totals (counts-then-skip over the mesh): with a finite
+        track_total_hits the counted program observes matches in the
+        blocks it gathers — observed >= threshold proves ("gte",
+        threshold); otherwise an unpruned counted pass gives the exact
+        count (skipped entirely when the df upper bound already fits
+        under the threshold)."""
         if not self.available:
             return None
         mapper = mappers.mapper(field)
@@ -291,21 +293,49 @@ class MeshDataPlane:
         for text, boost in clauses:
             terms.extend((t, float(boost)) for t in analyzer.terms(text))
         if not terms:
-            return []
+            return {"hits": [], "total": 0, "relation": "eq"}
+        tth = body.get("track_total_hits", 10_000)
+        limit = 0 if tth in (False, 0) else int(tth)
         readers = [(sid, shard.engine.acquire_reader())
                    for sid, shard in sorted(shards.items())]
         tindex, id_map = self._text_index(index_name, field, readers)
         k = self._want(body, tindex.n_docs)
-        scores, ids = tindex.search_batch([terms], k)
+        count = limit > 0
+        if count and tindex.hits_upper(terms) <= limit:
+            # few enough postings that pruning can't pay: one unpruned
+            # counted pass, exact totals for free
+            scores, ids, hits = tindex.search_batch(
+                [terms], k, prune=False, count_hits=True)
+            total, relation = int(hits[0]), "eq"
+        elif count:
+            scores, ids, hits = tindex.search_batch(
+                [terms], k, count_hits=True)
+            observed = int(hits[0])
+            if observed >= limit:
+                total, relation = limit, "gte"
+            elif tindex.last_hits_exact:
+                total, relation = observed, "eq"
+            else:
+                _s, _i, hits = tindex.search_batch(
+                    [terms], 1, prune=False, count_hits=True)
+                exact = int(hits[0])
+                total, relation = (limit, "gte") if exact > limit \
+                    else (exact, "eq")
+        else:
+            scores, ids = tindex.search_batch([terms], k)
+            total, relation = None, "gte"
         t, g = tindex.last_prune_stats
         self.stats["mesh_queries"] += 1
         self.stats["wand_blocks_total"] += t
         self.stats["wand_blocks_scored"] += g
-        return self._emit(scores[0], ids[0], id_map, 1.0)
+        out = self._emit(scores[0], ids[0], id_map, 1.0)
+        return {"hits": out,
+                "total": len(out) if total is None else total,
+                "relation": relation}
 
     def search_knn(self, index_name: str, field: str, shards,
                    body: Dict[str, Any], query: "dsl.Knn"
-                   ) -> Optional[List[Dict[str, Any]]]:
+                   ) -> Optional[Dict[str, Any]]:
         """Unfiltered exact kNN as one mesh program (the
         parallel/sharded_search.py kNN kernel behind the REST surface)."""
         if not self.available:
@@ -323,11 +353,12 @@ class MeshDataPlane:
         qv = np.asarray(query.query_vector, np.float32)[None, :]
         scores, ids = vindex.search(qv, k)
         self.stats["mesh_queries"] += 1
-        return self._emit(scores[0], ids[0], id_map, query.boost)
+        out = self._emit(scores[0], ids[0], id_map, query.boost)
+        return {"hits": out, "total": len(out), "relation": "eq"}
 
     def search_sparse(self, index_name: str, field: str, shards,
                       body: Dict[str, Any], query: "dsl.TextExpansion"
-                      ) -> Optional[List[Dict[str, Any]]]:
+                      ) -> Optional[Dict[str, Any]]:
         """text_expansion / learned-sparse retrieval as one mesh program:
         expansion tokens (from the on-device model when not precomputed)
         score linearly against the sharded rank-features blocks."""
@@ -338,14 +369,15 @@ class MeshDataPlane:
             from elasticsearch_tpu.ml import get_model
             tokens = get_model(query.model_id).expand(query.model_text or "")
         if not tokens:
-            return []
+            return {"hits": [], "total": 0, "relation": "eq"}
         readers = [(sid, shard.engine.acquire_reader())
                    for sid, shard in sorted(shards.items())]
         findex, id_map = self._features_index(index_name, field, readers)
         if findex is None or findex.n_docs == 0:
-            return []
+            return {"hits": [], "total": 0, "relation": "eq"}
         k = self._want(body, findex.n_docs)
         expansion = [(t, float(w) * query.boost) for t, w in tokens.items()]
         scores, ids = findex.search_batch([expansion], k)
         self.stats["mesh_queries"] += 1
-        return self._emit(scores[0], ids[0], id_map, 1.0)
+        out = self._emit(scores[0], ids[0], id_map, 1.0)
+        return {"hits": out, "total": len(out), "relation": "eq"}

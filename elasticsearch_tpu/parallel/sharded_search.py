@@ -251,7 +251,8 @@ def make_sharded_bm25(mesh: Mesh, n_per_shard: int, k: int,
 
 
 def make_sharded_bm25_batch(mesh: Mesh, n_per_shard: int, k: int,
-                            k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+                            k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+                            counted: bool = False):
     """Compile the BATCHED distributed BM25 program: Q queries per dispatch
     (the knn batched-program analog — BM25 was previously dispatch-bound at
     one compiled call per query).
@@ -291,6 +292,14 @@ def make_sharded_bm25_batch(mesh: Mesh, n_per_shard: int, k: int,
                                       num_keys=2)
         g_s = -srt_neg[:, :k]
         g_i = jnp.where(jnp.isfinite(g_s), srt_i[:, :k], -1)
+        if counted:
+            # matched docs across the mesh: local finite-score count,
+            # summed over the shard axis (counts-then-skip's observation
+            # — exact when every block was gathered, else a lower bound)
+            local_hits = jnp.sum(jnp.isfinite(scores), axis=1,
+                                 dtype=jnp.int32)               # [Q]
+            hits = jax.lax.psum(local_hits, "shard")
+            return g_s, g_i, hits
         return g_s, g_i
 
     fn = shard_map(
@@ -298,7 +307,7 @@ def make_sharded_bm25_batch(mesh: Mesh, n_per_shard: int, k: int,
         in_specs=(P("shard", None, None), P("shard", None, None),
                   P("shard", None), P(), P("shard", None, None),
                   P("shard", None, None)),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P()) if counted else (P(), P()),
         check_vma=False,
     )
     return jax.jit(fn)
@@ -515,12 +524,27 @@ class ShardedTextIndex:
                 self._impacts[s], cell_index=self._cell_indexes[s]))
         return out
 
-    def _batch_fn(self, k: int):
-        fn = self._compiled_batch.get(k)
+    def _batch_fn(self, k: int, counted: bool = False):
+        fn = self._compiled_batch.get((k, counted))
         if fn is None:
-            fn = make_sharded_bm25_batch(self.mesh, self.n_per_shard, k)
-            self._compiled_batch[k] = fn
+            fn = make_sharded_bm25_batch(self.mesh, self.n_per_shard, k,
+                                         counted=counted)
+            self._compiled_batch[(k, counted)] = fn
         return fn
+
+    def hits_upper(self, terms) -> int:
+        """df-based upper bound on matching docs (df per distinct term;
+        overlap only lowers the true union)."""
+        seen = set()
+        total = 0
+        for t in terms:
+            if isinstance(t, tuple):
+                t = t[0]
+            if t in seen:
+                continue
+            seen.add(t)
+            total += int(self.df.get(t, 0))
+        return total
 
     def _run_batch(self, fn, plans: List[List[QueryPlan]], qb_pad: int):
         """plans[q][s] -> one batched dispatch over all (query, shard)."""
@@ -537,29 +561,36 @@ class ShardedTextIndex:
                   jax.device_put(idx, sh), jax.device_put(w, sh))
 
     def search_batch(self, queries: Sequence[Sequence[str]], k: int,
-                     prune: bool = True):
+                     prune: bool = True, count_hits: bool = False):
         """Q queries -> (scores [Q,k], original corpus doc ids [Q,k]) in two
         device dispatches (phase-1 theta + phase-2 exact over survivors).
         See ops/bm25.py Bm25Executor.top_k_batch for the soundness
         argument; here phase-1 theta comes from the GLOBAL top-k across
-        shards, so pruning tightens with every shard's evidence."""
+        shards, so pruning tightens with every shard's evidence.
+
+        With ``count_hits`` a third return carries matched-doc counts
+        [Q] from the score plane; ``last_hits_exact`` records whether
+        every block was gathered (exact) or only survivors (lower
+        bound)."""
         plans = [self._plans(t) for t in queries]
-        fn = self._batch_fn(k)
+        fn = self._batch_fn(k, counted=count_hits)
         total = sum(p.n_blocks for per in plans for p in per)
         qb_max = max((p.n_blocks for per in plans for p in per), default=1)
         qb_pad = qb_bucket(max(qb_max, 1))
         if not prune or qb_max <= P1_BUCKET:
             # every plan fits phase 1 whole — pruning cannot pay
             self.last_prune_stats = (total, total)
+            self.last_hits_exact = True
             return self._run_batch(fn, plans, qb_pad)
         p1 = [[p.top_by_ub(P1_BUCKET) for p in per] for per in plans]
-        s1, _ = self._run_batch(fn, p1, P1_BUCKET)
+        s1 = self._run_batch(self._batch_fn(k), p1, P1_BUCKET)[0]
         theta = np.asarray(s1)[:, k - 1]
         p2 = [[p.survivors(float(theta[q])) for p in per]
               for q, per in enumerate(plans)]
         scored = sum(p.n_blocks for per in p2 for p in per)
         p1_cost = sum(p.n_blocks for per in p1 for p in per)
         self.last_prune_stats = (total, min(scored + p1_cost, total))
+        self.last_hits_exact = scored >= total
         qb2_max = max((p.n_blocks for per in p2 for p in per), default=1)
         qb2 = qb_bucket(max(qb2_max, 1))
         return self._run_batch(fn, p2, qb2)

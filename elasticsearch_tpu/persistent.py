@@ -139,7 +139,16 @@ class PersistentTasksService:
             blocked = set(entry.get("blocked_nodes") or [])
             eligible = [n for n in live if n not in blocked]
             if not eligible:
-                continue   # no capable node right now; retried next pass
+                # every live node has bounced this task: start-failures
+                # are often transient, so RESET the block list and retry
+                # the full rotation next pass instead of stranding the
+                # task forever
+                if blocked:
+                    logger.warning(
+                        "persistent task [%s]: all nodes blocked, "
+                        "resetting for retry", task_id)
+                    self._merge(task_id, {"blocked_nodes": []})
+                continue
             self._rr += 1
             node_id = eligible[self._rr % len(eligible)]
             logger.info("persistent task [%s] -> node [%s]", task_id,

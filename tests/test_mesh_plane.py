@@ -48,9 +48,23 @@ def test_mesh_path_serves_eligible_search(cluster):
     _index_corpus(cluster, client)
 
     q = {"query": {"match": {"body": "alpha gamma"}}, "size": 8}
-    rpc, err = cluster.call(lambda cb: client.search("mesh", q, cb))
+    # unbounded exact counting still demands the RPC path
+    rpc, err = cluster.call(lambda cb: client.search(
+        "mesh", {**q, "track_total_hits": True}, cb))
     _ok(rpc, err)
-    assert "_data_plane" not in rpc   # exact totals demanded -> RPC path
+    assert "_data_plane" not in rpc
+    # the DEFAULT totals threshold is mesh-served with EXACT counts
+    # (counts-then-skip over the sharded program)
+    default, err = cluster.call(lambda cb: client.search("mesh", q, cb))
+    _ok(default, err)
+    assert default.get("_data_plane") == "mesh"
+    assert default["hits"]["total"] == rpc["hits"]["total"]
+    # a tiny threshold clips with gte
+    clipped, err = cluster.call(lambda cb: client.search(
+        "mesh", {**q, "track_total_hits": 3}, cb))
+    _ok(clipped, err)
+    assert clipped.get("_data_plane") == "mesh"
+    assert clipped["hits"]["total"] == {"value": 3, "relation": "gte"}
 
     # the mesh program scores with exact GLOBAL idf, so the apples-to-apples
     # host-path comparison is dfs_query_then_fetch (which pre-shares global
@@ -72,7 +86,7 @@ def test_mesh_path_serves_eligible_search(cluster):
     assert all("_source" in h for h in mesh["hits"]["hits"])
 
     stats = cluster.master().mesh_plane.stats
-    assert stats["mesh_queries"] == 1 and stats["mesh_builds"] == 1
+    assert stats["mesh_queries"] >= 3 and stats["mesh_builds"] == 1
 
 
 def test_mesh_cache_invalidated_on_change(cluster):
@@ -132,7 +146,8 @@ def test_ineligible_queries_fall_back_to_rpc(cluster):
     for body in (
         {"query": {"bool": {"must": [{"match": {"body": "alpha"}}]}},
          "track_total_hits": False},
-        {"query": {"match": {"body": "alpha"}}},                # exact totals
+        {"query": {"match": {"body": "alpha"}},
+         "track_total_hits": True},                   # unbounded exact
         {"query": {"match": {"body": "alpha"}},
          "track_total_hits": False, "sort": [{"n": "asc"}]},
         {"query": {"match": {"body": "alpha"}},
