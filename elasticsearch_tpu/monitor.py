@@ -1,0 +1,188 @@
+"""Node monitor probes: os / process / fs / device.
+
+Reference: monitor/os/OsProbe.java, monitor/process/ProcessProbe.java,
+monitor/fs/FsProbe.java — memory, load, file descriptors, data-path disk
+usage — plus the accelerator dimension this build adds: device (HBM)
+memory from the JAX backend, the resource that actually bounds search
+working sets here.
+
+Bootstrap checks (bootstrap/BootstrapChecks.java analog): run at node
+start; failures log loudly and, when ``ESTPU_ENFORCE_BOOTSTRAP`` is
+truthy (the production-mode analog), abort startup. The JVM-centric
+checks (heap size, G1 settings) are moot in Python; the meaningful ones
+here are descriptor limits, a writable data path, and a sane device/HBM
+state.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _read_proc(path: str) -> Optional[str]:
+    try:
+        with open(path, encoding="ascii") as fh:
+            return fh.read()
+    except OSError:
+        return None
+
+
+def os_stats() -> Dict[str, Any]:
+    """Memory + load from /proc (OsProbe.java's cgroup-less core)."""
+    out: Dict[str, Any] = {"cpu": {"count": os.cpu_count() or 1}}
+    meminfo = _read_proc("/proc/meminfo")
+    if meminfo:
+        fields = {}
+        for line in meminfo.splitlines():
+            name, _, rest = line.partition(":")
+            parts = rest.split()
+            if parts:
+                fields[name] = int(parts[0]) * 1024   # kB -> bytes
+        total = fields.get("MemTotal", 0)
+        available = fields.get("MemAvailable", fields.get("MemFree", 0))
+        out["mem"] = {
+            "total_in_bytes": total,
+            "free_in_bytes": available,
+            "used_in_bytes": max(total - available, 0),
+            "used_percent": round(100.0 * (total - available)
+                                  / total, 1) if total else 0.0,
+        }
+    loadavg = _read_proc("/proc/loadavg")
+    if loadavg:
+        one, five, fifteen = loadavg.split()[:3]
+        out["cpu"]["load_average"] = {"1m": float(one), "5m": float(five),
+                                      "15m": float(fifteen)}
+    return out
+
+
+def process_stats() -> Dict[str, Any]:
+    """Open FDs + RSS + cpu time for THIS process (ProcessProbe)."""
+    out: Dict[str, Any] = {"id": os.getpid()}
+    try:
+        out["open_file_descriptors"] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        out["open_file_descriptors"] = -1
+    try:
+        import resource
+        soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        out["max_file_descriptors"] = soft
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        out["mem"] = {"resident_in_bytes": usage.ru_maxrss * 1024}
+        out["cpu"] = {"total_in_millis": int(
+            (usage.ru_utime + usage.ru_stime) * 1000)}
+    except (ImportError, ValueError):
+        pass
+    return out
+
+
+def fs_stats(data_path: Optional[str]) -> Dict[str, Any]:
+    """Disk totals for the data path (FsProbe)."""
+    path = data_path or "."
+    try:
+        st = os.statvfs(path)
+    except OSError:
+        return {"total": {}}
+    total = st.f_frsize * st.f_blocks
+    free = st.f_frsize * st.f_bavail
+    return {"total": {
+        "path": os.path.abspath(path),
+        "total_in_bytes": total,
+        "free_in_bytes": free,
+        "available_in_bytes": free,
+    }}
+
+
+def device_stats() -> Dict[str, Any]:
+    """Accelerator memory per device, when a backend is live — the HBM
+    counterpart of the reference's heap stats. Never initializes a
+    backend itself (stats observe, they must not pay first-init)."""
+    out: Dict[str, Any] = {"devices": []}
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return out
+    try:
+        devices = jax.devices()
+    except Exception:  # noqa: BLE001 — backend init failure: no devices
+        return out
+    for dev in devices:
+        entry: Dict[str, Any] = {
+            "id": getattr(dev, "id", -1),
+            "platform": getattr(dev, "platform", "unknown"),
+        }
+        stats = getattr(dev, "memory_stats", None)
+        if callable(stats):
+            try:
+                mem = stats() or {}
+                entry["bytes_in_use"] = int(mem.get("bytes_in_use", 0))
+                limit = int(mem.get("bytes_limit", 0))
+                if limit:
+                    entry["bytes_limit"] = limit
+            except Exception:  # noqa: BLE001 — cpu devices often lack it
+                pass
+        out["devices"].append(entry)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bootstrap checks
+# ---------------------------------------------------------------------------
+
+MIN_FDS = 1024
+# boot-time HBM occupancy above this fraction means another process (or a
+# leak) already owns the accelerator the node is about to serve from
+MAX_BOOT_HBM_FRACTION = 0.5
+
+
+def bootstrap_checks(data_path: Optional[str]) -> List[str]:
+    """Failure messages (empty = healthy). BootstrapChecks analog with a
+    device-HBM gate replacing the JVM heap checks."""
+    failures: List[str] = []
+    proc = process_stats()
+    max_fds = proc.get("max_file_descriptors", -1)
+    if 0 < max_fds < MIN_FDS:
+        failures.append(
+            f"max file descriptors [{max_fds}] is too low; raise the "
+            f"limit to at least [{MIN_FDS}]")
+    if data_path is not None:
+        probe = os.path.join(data_path, ".bootstrap_probe")
+        try:
+            os.makedirs(data_path, exist_ok=True)
+            with open(probe, "w", encoding="ascii") as fh:
+                fh.write("ok")
+            os.remove(probe)
+        except OSError as e:
+            failures.append(f"data path [{data_path}] is not writable: {e}")
+        else:
+            fs = fs_stats(data_path).get("total", {})
+            if fs.get("available_in_bytes", 1) == 0:
+                failures.append(
+                    f"data path [{data_path}] has no free space")
+    for dev in device_stats().get("devices", []):
+        limit = dev.get("bytes_limit")
+        in_use = dev.get("bytes_in_use")
+        if limit and in_use is not None and \
+                in_use > limit * MAX_BOOT_HBM_FRACTION:
+            failures.append(
+                f"device [{dev.get('id')}] ({dev.get('platform')}) "
+                f"already has {in_use} of {limit} HBM bytes in use at "
+                f"boot — another process owns the accelerator")
+    return failures
+
+
+def run_bootstrap_checks(data_path: Optional[str]) -> None:
+    """Log failures; raise when ESTPU_ENFORCE_BOOTSTRAP is truthy (the
+    reference enforces in production mode, warns in dev mode)."""
+    failures = bootstrap_checks(data_path)
+    if not failures:
+        return
+    for failure in failures:
+        logger.warning("bootstrap check failure: %s", failure)
+    if str(os.environ.get("ESTPU_ENFORCE_BOOTSTRAP", "")).lower() in (
+            "1", "true", "yes"):
+        raise RuntimeError(
+            "bootstrap checks failed: " + "; ".join(failures))

@@ -234,6 +234,7 @@ class Node:
 
     def local_node_stats(self) -> Dict[str, Any]:
         from elasticsearch_tpu.indices.breaker import BREAKERS
+        from elasticsearch_tpu import monitor
         return {
             "name": self.node_id,
             "indices": self.indices_service.stats(),
@@ -242,6 +243,12 @@ class Node:
             "thread_pool": self.thread_pool.stats(),
             "adaptive_selection":
                 self.search_action.response_collector.stats(),
+            # real probes (OsProbe/ProcessProbe/FsProbe analogs + the
+            # device/HBM dimension the reference lacks)
+            "os": monitor.os_stats(),
+            "process": monitor.process_stats(),
+            "fs": monitor.fs_stats(self.indices_service.data_path),
+            "device": monitor.device_stats(),
         }
 
     def _on_committed(self, state: ClusterState) -> None:
@@ -299,6 +306,10 @@ class Node:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
+        # bootstrap checks first (BootstrapChecks.check analog): dev mode
+        # warns, ESTPU_ENFORCE_BOOTSTRAP aborts startup on failure
+        from elasticsearch_tpu.monitor import run_bootstrap_checks
+        run_bootstrap_checks(self.indices_service.data_path)
         self.coordinator.start()
         self.ilm_service.start()
         self.slm_service.start()

@@ -1,0 +1,64 @@
+"""Monitor probes + bootstrap checks.
+
+Reference: monitor/os/OsProbe.java, ProcessProbe, FsProbe,
+bootstrap/BootstrapChecks.java — with the device/HBM dimension replacing
+the JVM heap checks (VERDICT r3 §2.1 'monitor'/'bootstrap' partials).
+"""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu import monitor
+
+
+def test_os_process_fs_probes_report_real_values(tmp_path):
+    o = monitor.os_stats()
+    assert o["cpu"]["count"] >= 1
+    assert o["mem"]["total_in_bytes"] > 0
+    assert 0 < o["mem"]["free_in_bytes"] <= o["mem"]["total_in_bytes"]
+    assert "load_average" in o["cpu"]
+
+    p = monitor.process_stats()
+    assert p["id"] == os.getpid()
+    assert p["open_file_descriptors"] > 0
+    assert p["max_file_descriptors"] >= p["open_file_descriptors"]
+    assert p["mem"]["resident_in_bytes"] > 0
+
+    f = monitor.fs_stats(str(tmp_path))
+    assert f["total"]["total_in_bytes"] > 0
+    assert f["total"]["available_in_bytes"] > 0
+
+    d = monitor.device_stats()
+    assert isinstance(d["devices"], list)   # populated iff jax imported
+
+
+def test_bootstrap_checks(tmp_path, monkeypatch):
+    # healthy: no failures on a writable dir
+    assert monitor.bootstrap_checks(str(tmp_path)) == []
+    # a data path that cannot be a directory fails (chmod tricks don't
+    # block root, so use a FILE standing where the dir must go)
+    blocked = tmp_path / "blocked"
+    blocked.write_text("i am a file")
+    failures = monitor.bootstrap_checks(str(blocked))
+    assert failures and "not writable" in failures[0]
+    # enforcement raises, dev mode only warns
+    monkeypatch.setenv("ESTPU_ENFORCE_BOOTSTRAP", "true")
+    with pytest.raises(RuntimeError):
+        monitor.run_bootstrap_checks(str(blocked))
+    monkeypatch.delenv("ESTPU_ENFORCE_BOOTSTRAP")
+    monitor.run_bootstrap_checks(str(blocked))   # warns, returns
+
+
+def test_node_stats_include_probes(tmp_path):
+    from elasticsearch_tpu.testing import InProcessCluster
+    c = InProcessCluster(n_nodes=1, seed=73, data_path=str(tmp_path))
+    c.start()
+    try:
+        stats = c.master().local_node_stats()
+        assert stats["os"]["mem"]["total_in_bytes"] > 0
+        assert stats["process"]["open_file_descriptors"] > 0
+        assert stats["fs"]["total"]["total_in_bytes"] > 0
+        assert "devices" in stats["device"]
+    finally:
+        c.stop()
