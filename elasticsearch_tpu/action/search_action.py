@@ -519,12 +519,6 @@ class TransportSearchAction:
         state = self.state()
         body = body or {}
 
-        if ":" in (index_expression or "") and \
-                self.remote_clusters is not None:
-            self._execute_ccs(t0, index_expression, body, on_done,
-                              search_type)
-            return
-
         task = None
         if self.task_manager is not None:
             task = self.task_manager.register(
@@ -535,6 +529,18 @@ class TransportSearchAction:
             def on_done(resp, err):   # noqa: F811 — task-scoped wrapper
                 self.task_manager.unregister(task)
                 inner(resp, err)
+
+        # composite paths AFTER task registration so CCS/RRF requests get
+        # the same parent cancellable task as every other search
+        if ":" in (index_expression or "") and \
+                self.remote_clusters is not None:
+            self._execute_ccs(t0, index_expression, body, on_done,
+                              search_type)
+            return
+        if (body.get("rank") or {}).get("rrf") is not None:
+            self._execute_rrf(t0, index_expression, body, on_done,
+                              search_type)
+            return
 
         try:
             max_concurrent = _parse_max_concurrent(
@@ -843,6 +849,118 @@ class TransportSearchAction:
                 done = len(targets) - pending["n"]
         phase_state["_dispatch_next"] = dispatch_next
         dispatch_next()
+
+    # -- reciprocal rank fusion (hybrid retrieval) -----------------------
+
+    def _execute_rrf(self, t0, expression: str, body: Dict[str, Any],
+                     on_done: DoneFn, search_type: str) -> None:
+        """rank: {rrf: {...}} — hybrid retrieval (RRFRankPlugin analog,
+        the BASELINE config-4 REST surface): each retriever (the query
+        clause, a top-level knn clause, and/or sub_searches entries) runs
+        as a full search over its own best data plane (mesh or RPC), and
+        the coordinator fuses the ranked lists with reciprocal-rank
+        scoring 1/(rank_constant + rank)."""
+        rrf = dict((body.get("rank") or {}).get("rrf") or {})
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        window = int(rrf.get("rank_window_size", max(size + from_, 10)))
+        rank_constant = int(rrf.get("rank_constant", 60))
+        if rank_constant < 1:
+            on_done(None, IllegalArgumentError(
+                f"[rank_constant] must be greater than or equal to [1], "
+                f"got [{rank_constant}]"))
+            return
+        if window < size + from_:
+            on_done(None, IllegalArgumentError(
+                f"[rank_window_size] ({window}) must be greater than or "
+                f"equal to [size] + [from] ({size + from_})"))
+            return
+        if body.get("sub_searches") and body.get("query") is not None:
+            on_done(None, IllegalArgumentError(
+                "cannot specify both [query] and [sub_searches]"))
+            return
+        retrievers: List[Dict[str, Any]] = []
+        for sub in body.get("sub_searches") or []:
+            if sub.get("query") is not None:
+                retrievers.append(sub["query"])
+        if body.get("query") is not None:
+            retrievers.append(body["query"])
+        knn = body.get("knn")
+        if knn is not None:
+            # the standard multi-knn form is a LIST: each clause fuses as
+            # its own retriever
+            for clause in (knn if isinstance(knn, list) else [knn]):
+                retrievers.append({"knn": clause})
+        if len(retrievers) < 2:
+            on_done(None, IllegalArgumentError(
+                "[rrf] requires at least two retrievers (query, knn, "
+                "or sub_searches)"))
+            return
+        for clause in ("aggs", "aggregations", "sort", "collapse",
+                       "rescore", "search_after", "suggest"):
+            if body.get(clause):
+                on_done(None, IllegalArgumentError(
+                    f"[rrf] cannot be combined with [{clause}]"))
+                return
+
+        results: List[Optional[Dict[str, Any]]] = [None] * len(retrievers)
+        errors: list = []
+        pending = {"n": len(retrievers)}
+        passthrough = {k: body[k] for k in
+                       ("_source", "docvalue_fields", "stored_fields",
+                        "highlight") if k in body}
+
+        def complete() -> None:
+            if errors:
+                on_done(None, errors[0])
+                return
+            # reciprocal-rank fusion over (index, _id) identities
+            fused: Dict[Tuple[str, str], Dict[str, Any]] = {}
+            for ranked in results:
+                hits = (ranked or {}).get("hits", {}).get("hits", [])
+                for rank, hit in enumerate(hits, start=1):
+                    key = (hit.get("_index"), hit.get("_id"))
+                    entry = fused.setdefault(key, {"hit": hit,
+                                                   "score": 0.0})
+                    entry["score"] += 1.0 / (rank_constant + rank)
+            ordered = sorted(fused.values(),
+                             key=lambda e: (-e["score"],
+                                            str(e["hit"].get("_id"))))
+            out_hits = []
+            for rank, entry in enumerate(
+                    ordered[from_: from_ + size], start=from_ + 1):
+                hit = dict(entry["hit"])
+                hit["_score"] = round(entry["score"], 6)
+                hit["_rank"] = rank
+                out_hits.append(hit)
+            on_done({
+                "took": int((time.monotonic() - t0) * 1000),
+                "timed_out": False,
+                "_shards": (results[0] or {}).get("_shards",
+                                                  {"total": 0}),
+                "hits": {"total": {"value": len(fused),
+                                   "relation": "eq"},
+                         "max_score": (out_hits[0]["_score"]
+                                       if out_hits else None),
+                         "hits": out_hits},
+            }, None)
+
+        def collect(i: int):
+            def cb(resp, err) -> None:
+                if err is not None:
+                    errors.append(err)
+                else:
+                    results[i] = resp
+                pending["n"] -= 1
+                if pending["n"] == 0:
+                    complete()
+            return cb
+
+        for i, query in enumerate(retrievers):
+            sub_body = {"query": query, "size": window,
+                        "track_total_hits": False, **passthrough}
+            self._execute_admitted(expression, sub_body, collect(i),
+                                   search_type)
 
     # -- cross-cluster search --------------------------------------------
 
