@@ -861,10 +861,16 @@ class TransportSearchAction:
         the coordinator fuses the ranked lists with reciprocal-rank
         scoring 1/(rank_constant + rank)."""
         rrf = dict((body.get("rank") or {}).get("rrf") or {})
-        size = int(body.get("size", 10))
-        from_ = int(body.get("from", 0))
-        window = int(rrf.get("rank_window_size", max(size + from_, 10)))
-        rank_constant = int(rrf.get("rank_constant", 60))
+        try:
+            size = int(body.get("size", 10))
+            from_ = int(body.get("from", 0))
+            window = int(rrf.get("rank_window_size",
+                                 max(size + from_, 10)))
+            rank_constant = int(rrf.get("rank_constant", 60))
+        except (TypeError, ValueError) as e:
+            on_done(None, IllegalArgumentError(
+                f"invalid [rrf] parameter: {e}"))
+            return
         if rank_constant < 1:
             on_done(None, IllegalArgumentError(
                 f"[rank_constant] must be greater than or equal to [1], "
@@ -881,8 +887,11 @@ class TransportSearchAction:
             return
         retrievers: List[Dict[str, Any]] = []
         for sub in body.get("sub_searches") or []:
-            if sub.get("query") is not None:
-                retrievers.append(sub["query"])
+            if sub.get("query") is None:
+                on_done(None, IllegalArgumentError(
+                    "[sub_searches] entries require a [query]"))
+                return
+            retrievers.append(sub["query"])
         if body.get("query") is not None:
             retrievers.append(body["query"])
         knn = body.get("knn")
@@ -897,8 +906,13 @@ class TransportSearchAction:
                 "or sub_searches)"))
             return
         for clause in ("aggs", "aggregations", "sort", "collapse",
-                       "rescore", "search_after", "suggest"):
+                       "rescore", "search_after", "suggest",
+                       "post_filter", "min_score", "indices_boost",
+                       "script_fields", "runtime_mappings", "fields",
+                       "terminate_after", "scroll"):
             if body.get(clause):
+                # silently dropping a result-shaping clause would return
+                # confidently-wrong hits; reject what fusion cannot honor
                 on_done(None, IllegalArgumentError(
                     f"[rrf] cannot be combined with [{clause}]"))
                 return
@@ -933,13 +947,23 @@ class TransportSearchAction:
                 hit["_score"] = round(entry["score"], 6)
                 hit["_rank"] = rank
                 out_hits.append(hit)
+            # shard accounting must reflect EVERY retriever's fan-out, or
+            # one retriever's partial failure hides behind another's
+            # clean run
+            shards = {"total": 0, "successful": 0, "skipped": 0,
+                      "failed": 0}
+            for ranked in results:
+                sub = (ranked or {}).get("_shards") or {}
+                for f in shards:
+                    shards[f] += int(sub.get(f, 0))
             on_done({
                 "took": int((time.monotonic() - t0) * 1000),
                 "timed_out": False,
-                "_shards": (results[0] or {}).get("_shards",
-                                                  {"total": 0}),
+                "_shards": shards,
+                # windows cap what fusion can observe: the unique-doc
+                # count is a LOWER bound on true matches
                 "hits": {"total": {"value": len(fused),
-                                   "relation": "eq"},
+                                   "relation": "gte"},
                          "max_score": (out_hits[0]["_score"]
                                        if out_hits else None),
                          "hits": out_hits},
@@ -994,7 +1018,7 @@ class TransportSearchAction:
         )
         local_parts, remote_groups = split_remote_expression(expression)
         for clause in ("aggs", "aggregations", "suggest", "collapse",
-                       "rescore"):
+                       "rescore", "rank"):
             if body.get(clause):
                 on_done(None, IllegalArgumentError(
                     f"[{clause}] is not supported with remote cluster "

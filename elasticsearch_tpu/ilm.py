@@ -46,6 +46,34 @@ DEFAULT_POLL_INTERVAL = 10.0
 PHASE_ORDER = ("hot", "warm", "cold", "delete")
 
 
+def compute_phase(settings, phases: Dict[str, Any],
+                  now_ms: float) -> Dict[str, Any]:
+    """{phase, age_ms, rolled_over} — ONE implementation of the age-origin
+    and phase-gate rules, shared by the advance loop and the explain API
+    so what explain reports is exactly what the machine will do."""
+    hot = (phases.get("hot") or {}).get("actions") or {}
+    rollover = hot.get("rollover")
+    rolled_ms = settings.get("index.rollover_date")
+    origin_ms: Optional[float] = None
+    if rolled_ms is not None:
+        origin_ms = int(rolled_ms)
+    elif rollover is None:
+        origin_ms = int(settings.get("index.creation_date", 0) or 0) or None
+    age_ms = max(now_ms - origin_ms, 0) if origin_ms is not None else 0
+    phase = "hot"
+    if origin_ms is not None:
+        for candidate in ("delete", "cold", "warm"):
+            spec = phases.get(candidate)
+            if spec is None:
+                continue
+            min_age_s = parse_time_to_seconds(spec.get("min_age", 0))
+            if age_ms >= min_age_s * 1000:
+                phase = candidate
+                break
+    return {"phase": phase, "age_ms": age_ms,
+            "rolled_over": rolled_ms is not None}
+
+
 class IndexLifecycleService:
     def __init__(self, node) -> None:
         self.node = node
@@ -124,29 +152,16 @@ class IndexLifecycleService:
         hot = (phases.get("hot") or {}).get("actions") or {}
         rollover = hot.get("rollover")
 
-        # age origin (delete/warm/cold phases): the rollover when one
-        # happened; for a policy WITHOUT a rollover action, the creation
-        # date — an index that is still this series' write target
-        # (rollover pending) is never advanced out from under the writers
-        rolled_ms = meta.settings.get("index.rollover_date")
-        origin_ms: Optional[float] = None
-        if rolled_ms is not None:
-            origin_ms = int(rolled_ms)
-        elif rollover is None:
-            origin_ms = int(meta.settings.get("index.creation_date", 0)
-                            or 0) or None
-
-        if origin_ms is not None:
-            age_ms = now_ms - origin_ms
-            for phase_name in ("delete", "cold", "warm"):
-                phase = phases.get(phase_name)
-                if phase is None:
-                    continue
-                min_age_s = parse_time_to_seconds(phase.get("min_age", 0))
-                if age_ms >= min_age_s * 1000:
-                    getattr(self, f"_run_{phase_name}")(
-                        meta, phase.get("actions") or {}, stream)
-                    return
+        # age origin + phase gates: ONE shared rule set (compute_phase) —
+        # an index still its series' write target (rollover pending) is
+        # never advanced out from under the writers
+        computed = compute_phase(meta.settings, phases, now_ms)
+        phase_name = computed["phase"]
+        if phase_name != "hot":
+            getattr(self, f"_run_{phase_name}")(
+                meta, (phases.get(phase_name) or {}).get("actions") or {},
+                stream)
+            return
 
         # hot: rollover the alias or data stream this index writes for
         alias = meta.settings.get("index.lifecycle.rollover_alias")

@@ -26,6 +26,14 @@ class RestRequest:
     body: Any = None                                       # parsed JSON
     raw_body: bytes = b""
     headers: Dict[str, str] = field(default_factory=dict)  # lowercased keys
+    # deprecation messages emitted while handling THIS request; the HTTP
+    # server surfaces them as Warning: 299 headers
+    # (DeprecationLogger/HeaderWarning analog)
+    warnings: List[str] = field(default_factory=list)
+
+    def deprecate(self, message: str) -> None:
+        if message not in self.warnings:
+            self.warnings.append(message)
 
     def param(self, name: str, default: Optional[str] = None) -> Optional[str]:
         return self.params.get(name, self.query.get(name, default))

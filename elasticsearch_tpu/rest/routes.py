@@ -186,6 +186,10 @@ def build_controller(client: NodeClient) -> RestController:
                  if ":" in part else part)
                 for part in req.query["sort"].split(",")]
         if "ignore_throttled" in req.query:
+            req.deprecate(
+                "[ignore_throttled] parameter is deprecated because "
+                "frozen indices have been deprecated. Consider cold or "
+                "frozen tiers in place of frozen indices.")
             body["ignore_throttled"] = \
                 req.query["ignore_throttled"] not in ("false", "0")
         if "max_concurrent_shard_requests" in req.query:
@@ -372,6 +376,56 @@ def build_controller(client: NodeClient) -> RestController:
         client.put_ilm_policy(req.params["name"], req.body or {},
                               wrap_client_cb(done))
     r("PUT", "/_ilm/policy/{name}", ilm_put)
+
+    def ilm_explain(req: RestRequest, done: DoneFn) -> None:
+        """GET /{index}/_ilm/explain (ExplainLifecycleAction): per-index
+        managed flag, policy, computed current phase, age, and the step
+        markers the phase machine left in settings."""
+        from elasticsearch_tpu.cluster.metadata import (
+            resolve_index_expression,
+        )
+        from elasticsearch_tpu.ilm import compute_phase
+        node = client.node
+        state = node._applied_state()
+        try:
+            names = resolve_index_expression(req.params.get("index"),
+                                             state.metadata)
+        except Exception as e:  # noqa: BLE001 — unknown index: 404
+            done(404, {"error": {"type": "index_not_found_exception",
+                                 "reason": str(e)}, "status": 404})
+            return
+        now_ms = node.scheduler.wall_now() * 1000
+        out: Dict[str, Any] = {}
+        for name in names:
+            meta = state.metadata.indices[name]
+            policy_name = meta.settings.get("index.lifecycle.name")
+            if not policy_name:
+                out[name] = {"index": name, "managed": False}
+                continue
+            policy = state.metadata.ilm_policies.get(policy_name)
+            if policy is None:
+                # the advance loop skips such indices; report the stall
+                # instead of inventing a phase it will never enter
+                out[name] = {"index": name, "managed": True,
+                             "policy": policy_name, "phase": None,
+                             "step_info": "policy not found"}
+                continue
+            computed = compute_phase(meta.settings,
+                                     policy.get("phases") or {}, now_ms)
+            entry = {
+                "index": name, "managed": True,
+                "policy": policy_name, "phase": computed["phase"],
+                "age": f"{int(computed['age_ms'] // 1000)}s",
+                "rolled_over": computed["rolled_over"],
+            }
+            for marker in ("forcemerged", "shrink_source",
+                           "snapshot_started"):
+                value = meta.settings.get(f"index.lifecycle.{marker}")
+                if value is not None:
+                    entry[marker] = value
+            out[name] = entry
+        done(200, {"indices": out})
+    r("GET", "/{index}/_ilm/explain", ilm_explain)
 
     def ilm_delete(req: RestRequest, done: DoneFn) -> None:
         client.delete_ilm_policy(req.params["name"], wrap_client_cb(done))
@@ -905,6 +959,10 @@ def build_controller(client: NodeClient) -> RestController:
     r("POST", "/_snapshot/{repo}/{snap}/_mount", mount_snapshot)
 
     def freeze_index(req: RestRequest, done: DoneFn) -> None:
+        req.deprecate(
+            "frozen indices are deprecated because they provide no "
+            "benefit given improvements in heap memory utilization. "
+            "They will be removed in a future release.")
         client.node.searchable_snapshots.set_frozen(
             req.params["index"], True, wrap_client_cb(done))
     r("POST", "/{index}/_freeze", freeze_index)

@@ -215,9 +215,15 @@ class HttpServer:
                   409: "Conflict", 429: "Too Many Requests",
                   500: "Internal Server Error",
                   503: "Service Unavailable"}.get(status, "OK")
+        warning_lines = ""
+        for message in getattr(request, "warnings", None) or []:
+            # the reference's HeaderWarning shape: 299 + agent + quoted
+            safe = message.replace('"', "'")
+            warning_lines += f'Warning: 299 elasticsearch-tpu "{safe}"\r\n'
         head_lines = (f"HTTP/1.1 {status} {reason}\r\n"
                       f"content-type: {ctype}\r\n"
                       f"content-length: {len(payload)}\r\n"
+                      f"{warning_lines}"
                       f"\r\n").encode("latin-1")
         writer.write(head_lines + (b"" if head else payload))
         await writer.drain()

@@ -62,3 +62,74 @@ def test_node_stats_include_probes(tmp_path):
         assert "devices" in stats["device"]
     finally:
         c.stop()
+
+
+def test_deprecation_warnings_and_ilm_explain(tmp_path):
+    """Deprecated usages surface as Warning: 299 response headers
+    (HeaderWarning analog), and /{index}/_ilm/explain reports the phase
+    machine's view."""
+    import json
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    s = socket.socket(); s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]; s.close()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "elasticsearch_tpu.rest.server", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def req(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method=method,
+            headers={"content-type": "application/json"})
+        resp = urllib.request.urlopen(r, timeout=30)
+        return resp, json.loads(resp.read() or b"{}")
+
+    try:
+        deadline = time.monotonic() + 120
+        while True:
+            try:
+                req("GET", "/_cluster/health"); break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.5)
+        req("PUT", "/_ilm/policy/aged", {"policy": {"phases": {
+            "hot": {"actions": {}},
+            "delete": {"min_age": "1d"}}}})
+        req("PUT", "/dep", {"settings": {
+            "number_of_replicas": 0, "index.lifecycle.name": "aged"}})
+        # deprecated param -> Warning header
+        resp, _b = req("POST",
+                       "/dep/_search?ignore_throttled=true",
+                       {"query": {"match_all": {}}})
+        warning = resp.headers.get("Warning", "")
+        assert warning.startswith('299 elasticsearch-tpu "'), warning
+        assert "deprecated" in warning
+        # undeprecated requests carry no Warning header
+        resp, _b = req("POST", "/dep/_search",
+                       {"query": {"match_all": {}}})
+        assert resp.headers.get("Warning") is None
+        # ilm explain
+        _resp, body = req("GET", "/dep/_ilm/explain")
+        entry = body["indices"]["dep"]
+        assert entry["managed"] is True
+        assert entry["policy"] == "aged"
+        assert entry["phase"] == "hot"
+        # unmanaged control index
+        req("PUT", "/plain", {"settings": {"number_of_replicas": 0}})
+        _resp, body = req("GET", "/plain/_ilm/explain")
+        assert body["indices"]["plain"] == {"index": "plain",
+                                            "managed": False}
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
