@@ -117,8 +117,25 @@ class ResizeActions:
         nor leave a half-index squatting on the target name."""
         from elasticsearch_tpu.action.scan_copy import stream_shard
         if sid >= src_meta.number_of_shards:
-            on_done({"acknowledged": True, "shards_acknowledged": True,
-                     "index": target, "copied_docs": copied}, None)
+            # completion marker: ILM's shrink step gates its alias swap +
+            # source delete on this setting — bare target existence only
+            # proves create_index ran, not that the async copy finished
+            # (swapping early is permanent data loss)
+            def marked(_r, err):
+                if err is not None:
+                    # a failed marker write must tear the target down
+                    # like every other failure (fail() below): a marker
+                    # -less target would wedge ILM — it never re-resizes
+                    # while the target exists, and never swaps without
+                    # the marker
+                    self.node.client.delete_index(
+                        target, lambda _r2, _e=None: on_done(None, err))
+                    return
+                on_done({"acknowledged": True,
+                         "shards_acknowledged": True,
+                         "index": target, "copied_docs": copied}, None)
+            self.node.client.update_settings(
+                target, {"index.resize.copy_complete": True}, marked)
             return
         state = self.node._applied_state()
 

@@ -208,7 +208,9 @@ class IndexLifecycleService:
                     meta.name, {"index.blocks.write": True}, _log_err)
                 return
             if state.metadata.has_index(target):
-                self._swap_references(meta, target, stream)
+                if self._copy_done(state, target,
+                                   "index.resize.copy_complete"):
+                    self._swap_references(meta, target, stream)
                 return
             n = int((actions.get("shrink") or {})
                     .get("number_of_shards", 1))
@@ -247,7 +249,10 @@ class IndexLifecycleService:
         target = f"restored-{meta.name}"
         state = self.node._applied_state()
         if state.metadata.has_index(target):
-            self._swap_references(meta, target, stream)
+            if self._copy_done(
+                    state, target,
+                    "index.store.snapshot.repository_name"):
+                self._swap_references(meta, target, stream)
             return
         if not meta.settings.get("index.lifecycle.snapshot_started"):
             def started(_r, err):
@@ -271,6 +276,32 @@ class IndexLifecycleService:
                 "index.rollover_date":
                     meta.settings.get("index.rollover_date"),
             }}, _log_err)
+
+    @staticmethod
+    def _copy_done(state, target: str, marker: str) -> bool:
+        """has_index(target) only proves the async shrink/mount STARTED
+        (create-then-copy): swapping references and deleting the source
+        before the copy finishes loses data permanently. The marker
+        settings key is written by the resize/mount completion callback,
+        and every target primary must be active — the
+        ShrunkenIndexCheckStep 'target is green' gate, re-expressed.
+
+        A marker-less target parks the policy rather than swapping: a
+        target persisted by pre-marker code is indistinguishable from a
+        mid-copy one, and a wrong swap deletes the source (operators
+        delete the stale target to let ILM re-run the resize)."""
+        try:
+            tmeta = state.metadata.index(target)
+        except Exception:  # noqa: BLE001 — racing a delete: not ready
+            return False
+        if not tmeta.settings.get(marker):
+            return False
+        try:
+            irt = state.routing_table.index(target)
+            return all(irt.primary(s).active
+                       for s in range(tmeta.number_of_shards))
+        except Exception:  # noqa: BLE001 — no routing yet: not ready
+            return False
 
     def _swap_references(self, old_meta, target: str, stream) -> None:
         """The transformed index replaces the original in its data stream

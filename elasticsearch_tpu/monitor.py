@@ -106,6 +106,14 @@ def device_stats() -> Dict[str, Any]:
     if jax is None:
         return out
     try:
+        # jax.devices() triggers FIRST-init of every registered platform
+        # when none is up yet — and the environment's TPU-tunnel plugin
+        # forces itself first in jax_platforms and can block indefinitely
+        # while claiming hardware (r3/r4 bench probes hung exactly here).
+        # Stats observe; they must never pay (or hang on) first-init.
+        from jax._src import xla_bridge as _xb
+        if not _xb.backends_are_initialized():
+            return out
         devices = jax.devices()
     except Exception:  # noqa: BLE001 — backend init failure: no devices
         return out

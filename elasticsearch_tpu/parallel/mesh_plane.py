@@ -108,9 +108,20 @@ class MeshDataPlane:
     @property
     def mesh(self):
         if self._mesh is None and not self._tried_default:
-            self._tried_default = True
             import jax
             from jax.sharding import Mesh
+            try:
+                from jax._src import xla_bridge as _xb
+                ready = _xb.backends_are_initialized()
+            except Exception:  # noqa: BLE001 — private API moved: the
+                ready = True   # pre-guard behavior (init here) applies
+            if not ready:
+                # first-init of the TPU-tunnel platform can block for
+                # minutes while claiming hardware; a SEARCH must not pay
+                # that. Stay on the RPC plane and re-check once compute
+                # elsewhere (ingest, ops) has brought the backend up.
+                return None
+            self._tried_default = True
             devices = jax.devices()
             if len(devices) >= self._min_devices:
                 self._mesh = Mesh(np.array(devices), ("shard",))
@@ -179,7 +190,7 @@ class MeshDataPlane:
         key = self._freshness_key(readers)
         got = self._vec.get((index_name, field))
         if got is not None and got[0] == key:
-            return got[1], got[2]
+            return got[1], got[2], got[3]
         t0 = time.perf_counter()
         from elasticsearch_tpu.parallel.sharded_search import (
             ShardedVectorIndex,
@@ -205,16 +216,21 @@ class MeshDataPlane:
                 id_segment.extend([si] * len(keep))
                 id_doc.extend(keep.tolist())
         if not rows:
-            return None, None
+            return None, None, None
         matrix = np.concatenate(rows).astype(np.float32)
         vindex = ShardedVectorIndex(self.mesh2d, matrix,
                                     similarity=similarity)
         id_map = (np.asarray(id_shard, np.int32),
                   np.asarray(id_segment, np.int32),
                   np.asarray(id_doc, np.int32))
-        self._vec[(index_name, field)] = (key, vindex, id_map)
+        # per-shard live-vector counts, computed ONCE per build: knn
+        # totals parity needs them every query and id_map scans are
+        # O(n_docs)
+        _, shard_counts = np.unique(id_map[0], return_counts=True)
+        self._vec[(index_name, field)] = (key, vindex, id_map,
+                                          shard_counts)
         self._record_build(t0, vindex.n_docs)
-        return vindex, id_map
+        return vindex, id_map, shard_counts
 
     def _features_index(self, index_name: str, field: str, readers):
         key = self._freshness_key(readers)
@@ -342,7 +358,8 @@ class MeshDataPlane:
             return None
         readers = [(sid, shard.engine.acquire_reader())
                    for sid, shard in sorted(shards.items())]
-        vindex, id_map = self._vector_index(index_name, field, readers)
+        vindex, id_map, shard_counts = self._vector_index(
+            index_name, field, readers)
         if vindex is None:
             return None
         # size+from bounds the result like the RPC path's shard collection
@@ -354,7 +371,15 @@ class MeshDataPlane:
         scores, ids = vindex.search(qv, k)
         self.stats["mesh_queries"] += 1
         out = self._emit(scores[0], ids[0], id_map, query.boost)
-        return {"hits": out, "total": len(out), "relation": "eq"}
+        # totals match the RPC plane's EXACT path: there each shard's Knn
+        # rewrites to a per-shard top-k doc set (KnnBound, <= query.k
+        # docs) and the coordinator sums per-shard collection counts.
+        # Documented divergence: the RPC ANN path (ivf opt-in or
+        # >=65536-doc segments) can post-filter to fewer than k live
+        # hits; the mesh plane is always exact, so it reports the exact
+        # path's total.
+        total = int(np.minimum(shard_counts, query.k).sum())
+        return {"hits": out, "total": total, "relation": "eq"}
 
     def search_sparse(self, index_name: str, field: str, shards,
                       body: Dict[str, Any], query: "dsl.TextExpansion"
@@ -380,4 +405,9 @@ class MeshDataPlane:
         scores, ids = findex.search_batch([expansion], k)
         self.stats["mesh_queries"] += 1
         out = self._emit(scores[0], ids[0], id_map, 1.0)
-        return {"hits": out, "total": len(out), "relation": "eq"}
+        # the sparse mesh program returns only the global top-k, so the
+        # matched-doc count is unobserved; len(out) is a LOWER bound —
+        # report "gte" rather than claiming the RPC plane's exact
+        # collected-count (documented divergence, vs search_text's
+        # counts-then-skip which does prove its totals)
+        return {"hits": out, "total": len(out), "relation": "gte"}

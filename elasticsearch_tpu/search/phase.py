@@ -718,10 +718,18 @@ def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
             cancel_check()
         seg = ctx.segment
         scores, mask = execute(query, ctx)
+        if slice_spec is not None:
+            # sliced scroll: this slice only sees docs whose _id hashes
+            # into its partition (SliceBuilder.java's _id slicing)
+            mask = mask & ctx.to_device_mask(_slice_mask(ctx, slice_spec))
+        if min_score is not None:
+            mask = mask & (scores >= min_score)
         if terminate_after:
             # collect EXACTLY up to the cap: if this segment would push
             # past it, keep only the first remaining matches in doc order
-            # (the reference's collector stops mid-segment the same way)
+            # (the reference's collector stops mid-segment the same way).
+            # Runs AFTER slice/min_score narrowing — the cap counts docs
+            # actually collected, not docs a later filter discards.
             remaining = int(terminate_after) - total_hits
             mask_host = np.asarray(mask)
             if int(mask_host.sum()) > remaining:
@@ -730,12 +738,6 @@ def _query_shard_dense(ctxs, reader, mappers, query, sort, size, from_, want,
                 clipped[order] = True
                 mask = mask & jnp.asarray(clipped)
                 terminated = True
-        if slice_spec is not None:
-            # sliced scroll: this slice only sees docs whose _id hashes
-            # into its partition (SliceBuilder.java's _id slicing)
-            mask = mask & ctx.to_device_mask(_slice_mask(ctx, slice_spec))
-        if min_score is not None:
-            mask = mask & (scores >= min_score)
         scores = jnp.where(mask, scores, -jnp.inf)
 
         total_hits += int(jnp.sum(mask))

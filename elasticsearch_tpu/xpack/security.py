@@ -470,11 +470,19 @@ class SecurityService:
         """Own keys for everyone; every key for manage_security holders.
         Secrets (hash/salt) never leave."""
         can_manage = self.authorize(user, "PUT", "/_security/user/x")
+        # an API-key credential without manage privileges sees only
+        # ITSELF: user["username"] is the creator, so a creator-equality
+        # check alone would let a minimally-scoped key enumerate all of
+        # its creator's other keys (r4 advisor; ref restricts such a
+        # caller to its own key)
+        own_id = (user.get("api_key") or {}).get("id")
         out = []
         for kid, entry in self._api_keys().items():
             if key_id is not None and kid != key_id:
                 continue
             if not can_manage and entry.get("creator") != user["username"]:
+                continue
+            if not can_manage and own_id is not None and kid != own_id:
                 continue
             out.append({"id": kid,
                         "name": entry.get("name"),
@@ -495,17 +503,29 @@ class SecurityService:
                                        else []))
         name = body.get("name")
         can_manage = self.authorize(user, "PUT", "/_security/user/x")
+        # see get_api_keys: an API-key caller without manage privileges
+        # may invalidate only itself, not its creator's sibling keys
+        own_id = (user.get("api_key") or {}).get("id")
         keys = self._api_keys()
         targets = []
+        skipped = 0   # matched the selector but caller may not touch it
         for kid, entry in keys.items():
             if (kid in ids) or (name and entry.get("name") == name):
                 if not can_manage and \
                         entry.get("creator") != user["username"]:
+                    skipped += 1
                     continue   # not yours, not an admin: skipped
+                if not can_manage and own_id is not None and \
+                        kid != own_id:
+                    skipped += 1
+                    continue   # key caller: self-invalidation only
                 targets.append((kid, entry))
+        # error_count must surface BOTH unknown ids and permission skips,
+        # or a partial skip hides behind a sibling's clean invalidation
+        unknown = sum(1 for i in ids if i not in keys)
         if not targets:
             on_done({"invalidated_api_keys": [],
-                     "error_count": len(ids)}, None)
+                     "error_count": skipped + unknown}, None)
             return
         pending = {"n": len(targets)}
         done_ids: List[str] = []
@@ -520,7 +540,7 @@ class SecurityService:
                 pending["n"] -= 1
                 if pending["n"] == 0:
                     on_done({"invalidated_api_keys": sorted(done_ids),
-                             "error_count": 0}, None)
+                             "error_count": skipped + unknown}, None)
             self.node.master_client.execute(PUT_SECURITY, {
                 "kind": "api_keys", "name": kid,
                 "body": {**entry, "invalidated": True}}, cb)
@@ -961,6 +981,8 @@ class SecurityService:
         # validates what the user asked to search, not the injected role
         # filter (which legitimately references restricted fields)
         user_query = body.get("query")
+        user_subs = body.get("sub_searches")
+        user_knn = body.get("knn")
         had_q_param = bool((request.query or {}).get("q"))
         if filt is not None:
             # a ?q= URI query must fold in BEFORE wrapping, or the
@@ -970,9 +992,53 @@ class SecurityService:
             if q_param:
                 from elasticsearch_tpu.rest.routes import _uri_query
                 body["query"] = _uri_query(q_param)
-            original = body.get("query", {"match_all": {}})
-            body["query"] = {"bool": {"must": [original],
-                                      "filter": [filt]}}
+            is_rrf = (body.get("rank") or {}).get("rrf") is not None
+            if body.get("query") is not None or not (
+                    is_rrf and (user_subs is not None
+                                or user_knn is not None)):
+                # wrap the query (or inject a wrapped match_all for a
+                # query-less plain search). ONLY a genuine retriever-only
+                # RRF request (rank:{rrf} + sub_searches/knn, no query)
+                # skips the injection: there it would 400 against
+                # sub_searches or add a phantom match_all retriever. A
+                # non-RRF body with stray sub_searches/knn keys still
+                # gets the wrapped match_all — the executor ignores
+                # those keys, so the injected filter is what protects it.
+                original = body.get("query", {"match_all": {}})
+                body["query"] = {"bool": {"must": [original],
+                                          "filter": [filt]}}
+            # RRF retrievers run as their OWN sub-searches
+            # (search_action._execute_rrf consumes top-level [knn] and
+            # [sub_searches] directly), so each must carry the role
+            # filter itself or a filtered user reads hidden docs through
+            # the fused list.
+            if user_subs is not None:
+                wrapped = []
+                for sub in (user_subs if isinstance(user_subs, list)
+                            else [user_subs]):
+                    sub = dict(sub or {})
+                    orig = sub.get("query", {"match_all": {}})
+                    sub["query"] = {"bool": {"must": [orig],
+                                             "filter": [filt]}}
+                    wrapped.append(sub)
+                body["sub_searches"] = wrapped
+            if user_knn is not None:
+                clauses = []
+                for clause in (user_knn if isinstance(user_knn, list)
+                               else [user_knn]):
+                    clause = dict(clause or {})
+                    prior = clause.get("filter")
+                    if prior is None:
+                        clause["filter"] = filt
+                    elif isinstance(prior, list):
+                        clause["filter"] = {"bool": {"filter":
+                                                     prior + [filt]}}
+                    else:
+                        clause["filter"] = {"bool": {"must": [prior],
+                                                     "filter": [filt]}}
+                    clauses.append(clause)
+                body["knn"] = (clauses if isinstance(user_knn, list)
+                               else clauses[0])
         if fields is not None:
             # aggs/sort/docvalue_fields surface raw values outside
             # _source: every referenced field must be granted
@@ -992,6 +1058,41 @@ class SecurityService:
                         "cannot verify query fields under this user's "
                         "field-level security")
                 refs = refs + qf
+            # RRF retriever clauses are full queries in their own right:
+            # a term filter inside a [knn] clause or a [sub_searches]
+            # query is a match oracle on ungranted fields (r4 advisor).
+            for sub in (user_subs if isinstance(user_subs, list)
+                        else [user_subs]) if user_subs is not None else []:
+                qf = self._query_fields((sub or {}).get("query"))
+                if qf is None:
+                    raise IllegalSecurityScope(
+                        "cannot verify [sub_searches] query fields under "
+                        "this user's field-level security")
+                refs = refs + qf
+            if user_knn is not None:
+                for clause in (user_knn if isinstance(user_knn, list)
+                               else [user_knn]):
+                    clause = clause if isinstance(clause, dict) else {}
+                    kfield = clause.get("field")
+                    if isinstance(kfield, str) and kfield:
+                        refs = refs + [kfield]
+                    kf = clause.get("filter")
+                    if kf is not None:
+                        if isinstance(kf, list):
+                            sub_refs = []
+                            for one in kf:
+                                r = self._query_fields(one)
+                                sub_refs = None if r is None \
+                                    else sub_refs + r
+                                if sub_refs is None:
+                                    break
+                        else:
+                            sub_refs = self._query_fields(kf)
+                        if sub_refs is None:
+                            raise IllegalSecurityScope(
+                                "cannot verify [knn] filter fields under "
+                                "this user's field-level security")
+                        refs = refs + sub_refs
             if had_q_param:
                 # ?q= lucene syntax may address any field — demand the
                 # catch-all grant

@@ -128,3 +128,35 @@ def test_resize_factor_validation(cluster, rest):
     s, body = rest("POST", "/src/_clone/bad", {
         "settings": {"index.number_of_shards": 2}})
     assert s == 400
+
+
+def test_r5_shrink_writes_copy_complete_marker_and_ilm_gates_on_it(
+        cluster, rest):
+    """r4 advisor (medium): ILM's warm-shrink swap used to treat bare
+    target existence as copy completion — the resize creates the target
+    FIRST and streams docs afterwards, so an early swap deletes the
+    source while the copy is unfinished (permanent loss). The resize now
+    writes index.resize.copy_complete at the end of the copy and ILM's
+    _copy_done gates the swap on marker + active primaries."""
+    _seed(cluster, rest)
+    s, _ = rest("PUT", "/src/_settings",
+                {"index.blocks.write": True})
+    assert s == 200
+    s, body = rest("POST", "/src/_shrink/dst",
+                   {"settings": {"index.number_of_shards": 2}})
+    assert s == 200
+    cluster.ensure_green("dst")
+    state = cluster.master()._applied_state()
+    meta = state.metadata.index("dst")
+    assert meta.settings.get("index.resize.copy_complete") is True
+
+    from elasticsearch_tpu.ilm import IndexLifecycleService
+    # with the marker + active primaries, the gate opens
+    assert IndexLifecycleService._copy_done(state, "dst",
+                                 "index.resize.copy_complete")
+    # an index that exists WITHOUT the marker (mid-copy) stays gated
+    assert not IndexLifecycleService._copy_done(state, "src",
+                                     "index.resize.copy_complete")
+    # unknown index: not ready
+    assert not IndexLifecycleService._copy_done(state, "nope",
+                                     "index.resize.copy_complete")

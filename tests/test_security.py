@@ -795,3 +795,174 @@ def test_data_stream_grants_match_stream_name(tmp_path):
         assert e is not None
     finally:
         c.stop()
+
+
+def test_r5_rrf_retrievers_carry_dls_and_fls():
+    """r4 advisor (high): rank:{rrf} retrievers — top-level [knn] clauses
+    and [sub_searches] queries — execute as their OWN sub-searches
+    (search_action._execute_rrf), so DLS must wrap every retriever and
+    FLS must validate every retriever's field references."""
+    c = InProcessCluster(n_nodes=1, seed=103)
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.create_index("docs", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {
+                "team": {"type": "keyword"},
+                "ssn": {"type": "keyword"},
+                "emb": {"type": "dense_vector", "dims": 4}}}}, cb))
+        assert e is None
+        c.ensure_green("docs")
+        r, e = c.call(lambda cb: client.put_security_role("dlsrole", {
+            "indices": [{"names": ["docs"], "privileges": ["read"],
+                         "query": {"term": {"team": "red"}}}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_role("flsrole", {
+            "indices": [{"names": ["docs"], "privileges": ["read"],
+                         "field_security": {
+                             "grant": ["team", "emb"]}}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("dlsu", {
+            "password": "dlspass", "roles": ["dlsrole"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("flsu", {
+            "password": "flspass", "roles": ["flsrole"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True}}, cb))
+        assert e is None
+
+        sec = c.master().security
+        from elasticsearch_tpu.rest.controller import RestRequest
+
+        def req_for(user, pw, body):
+            auth = {"authorization": "Basic " + base64.b64encode(
+                f"{user}:{pw}".encode()).decode()}
+            return RestRequest(method="POST", path="/docs/_search",
+                               query={}, body=body, raw_body=b"",
+                               headers=auth)
+
+        # DLS: every retriever gets the role filter
+        req = req_for("dlsu", "dlspass", {
+            "rank": {"rrf": {}},
+            "sub_searches": [{"query": {"match": {"team": "x"}}}],
+            "knn": [{"field": "emb", "query_vector": [0, 0, 0, 1],
+                     "k": 3, "filter": {"term": {"team": "x"}}},
+                    {"field": "emb", "query_vector": [1, 0, 0, 0],
+                     "k": 3}]})
+        assert sec.check(req) is None
+        # retriever-only body: no phantom match_all query injected (it
+        # would 400 against sub_searches and distort knn-only fusion)
+        assert "query" not in req.body
+        dls_filt = {"term": {"team": "red"}}
+        # but WITHOUT rank:{rrf} the executor ignores sub_searches/knn
+        # and runs the (absent) query as match_all — the injection must
+        # still happen or a stray retriever key strips DLS entirely
+        req_plain = req_for("dlsu", "dlspass", {
+            "sub_searches": [{"query": {"match_all": {}}}]})
+        assert sec.check(req_plain) is None
+        assert dls_filt in req_plain.body["query"]["bool"]["filter"]
+        sub_q = req.body["sub_searches"][0]["query"]
+        assert dls_filt in sub_q["bool"]["filter"]
+        # pre-existing knn filter folds with (not replaced by) the role's
+        knn0 = req.body["knn"][0]["filter"]
+        assert dls_filt in knn0["bool"]["filter"]
+        assert {"term": {"team": "x"}} in knn0["bool"]["must"]
+        assert req.body["knn"][1]["filter"] == dls_filt
+
+        # FLS: a knn clause on an ungranted vector field is denied
+        denied = sec.check(req_for("flsu", "flspass", {
+            "rank": {"rrf": {}},
+            "query": {"match": {"team": "x"}},
+            "knn": {"field": "secret_emb", "query_vector": [0, 0, 0, 1],
+                    "k": 3}}))
+        assert denied is not None and denied[0] == 403
+        # FLS: a sub_searches query probing an ungranted field is a
+        # match oracle -> denied
+        denied = sec.check(req_for("flsu", "flspass", {
+            "rank": {"rrf": {}},
+            "sub_searches": [{"query": {"term": {"ssn": "123"}}},
+                             {"query": {"match": {"team": "x"}}}]}))
+        assert denied is not None and denied[0] == 403
+        # FLS: a knn filter on an ungranted field is denied too
+        denied = sec.check(req_for("flsu", "flspass", {
+            "rank": {"rrf": {}},
+            "query": {"match": {"team": "x"}},
+            "knn": {"field": "emb", "query_vector": [0, 0, 0, 1],
+                    "k": 3, "filter": {"term": {"ssn": "123"}}}}))
+        assert denied is not None and denied[0] == 403
+        # granted retrievers pass
+        req = req_for("flsu", "flspass", {
+            "rank": {"rrf": {}},
+            "query": {"match": {"team": "x"}},
+            "knn": {"field": "emb", "query_vector": [0, 0, 0, 1],
+                    "k": 3, "filter": {"term": {"team": "red"}}}})
+        assert sec.check(req) is None
+    finally:
+        c.stop()
+
+
+def test_r5_api_key_caller_scoped_to_itself(tmp_path):
+    """r4 advisor (medium): an API-key credential WITHOUT manage
+    privileges must not enumerate or invalidate its creator's other keys
+    — it sees and can invalidate only itself."""
+    c = InProcessCluster(n_nodes=1, seed=107, data_path=str(tmp_path))
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.put_security_role("writer", {
+            "indices": [{"names": ["logs-*"],
+                         "privileges": ["read", "write"]}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("amy", {
+            "password": "amypw", "roles": ["writer"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True}}, cb))
+        assert e is None
+        sec = c.master().security
+        amy = {"username": "amy", "roles": ["writer"]}
+
+        keys = {}
+        for name in ("key-a", "key-b"):
+            out = {}
+            sec.create_api_key(amy, {"name": name, "role_descriptors": {}},
+                               lambda resp, err, o=out: o.update(
+                                   resp or {"err": err}))
+            c.run_until(lambda o=out: bool(o), 30.0)
+            assert "err" not in out
+            keys[name] = out
+
+        import base64 as b64
+        ka = keys["key-a"]
+        key_user = sec.authenticate({"authorization":
+            "ApiKey " + b64.b64encode(
+                f"{ka['id']}:{ka['api_key']}".encode()).decode()})
+        assert key_user is not None
+
+        # enumeration: the key sees ONLY itself, not its sibling
+        listing = sec.get_api_keys(key_user)
+        assert [k["id"] for k in listing["api_keys"]] == [ka["id"]]
+
+        # sibling invalidation is refused (skipped, nothing flipped)
+        inv = {}
+        sec.invalidate_api_keys(key_user, {"ids": [keys["key-b"]["id"]]},
+                                lambda resp, err: inv.update(resp or {}))
+        c.run_until(lambda: bool(inv), 30.0)
+        assert inv["invalidated_api_keys"] == []
+        assert inv["error_count"] == 1   # the skip is not silent
+        assert sec.get_api_keys(amy, keys["key-b"]["id"])[
+            "api_keys"][0]["invalidated"] is False
+
+        # self-invalidation still works
+        inv2 = {}
+        sec.invalidate_api_keys(key_user, {"ids": [ka["id"]]},
+                                lambda resp, err: inv2.update(resp or {}))
+        c.run_until(lambda: bool(inv2), 30.0)
+        assert inv2["invalidated_api_keys"] == [ka["id"]]
+        # the creator (a real user) still manages all their keys
+        assert {k["id"] for k in sec.get_api_keys(amy)["api_keys"]} == \
+            {ka["id"], keys["key-b"]["id"]}
+    finally:
+        c.stop()
