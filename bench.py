@@ -51,7 +51,14 @@ def scaled(n: int, factor: int = 8) -> int:
 
 
 def probe_backend(timeout: float = 240.0):
-    """Run a tiny jax computation in a subprocess. Returns (backend, error)."""
+    """Run a tiny jax computation in a subprocess. Returns (backend, error).
+
+    The environment's sitecustomize registers the TPU-tunnel ('axon')
+    platform and forces jax_platforms="axon,cpu" at interpreter start, so
+    this subprocess genuinely attempts TPU first-init. When the tunnel is
+    hung the init blocks with NO output (observed r3-r5: a 25-minute probe
+    produced nothing past the platform-registration warning), hence the
+    hard timeout + environment diagnostics below."""
     code = ("import jax, jax.numpy as jnp;"
             "x = jnp.ones(8).sum(); jax.block_until_ready(x);"
             "print('BACKEND=' + jax.default_backend())")
@@ -63,8 +70,37 @@ def probe_backend(timeout: float = 240.0):
             if line.startswith("BACKEND="):
                 return line.split("=", 1)[1], None
         return None, (p.stderr or "no backend line")[-400:]
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"").decode("utf-8", "replace")
+                if isinstance(e.stderr, bytes) else (e.stderr or ""))
+        return None, (f"TimeoutExpired({timeout:.0f}s); "
+                      f"stderr_tail={tail[-300:]!r}")
     except Exception as e:  # noqa: BLE001 — never let the probe kill the bench
         return None, f"{type(e).__name__}: {e}"
+
+
+def probe_diagnostics() -> dict:
+    """Environment facts that explain a hung/failed TPU probe: which
+    platform the env requests, whether the local tunnel relay port
+    accepts connections, and any device-holding processes."""
+    import socket
+    out = {"JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS"),
+           "PALLAS_AXON_POOL_IPS": os.environ.get("PALLAS_AXON_POOL_IPS")}
+    for port in (2024,):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2):
+                out[f"relay_port_{port}"] = "open"
+        except OSError as e:
+            out[f"relay_port_{port}"] = f"closed: {e}"
+    try:
+        holders = subprocess.run(
+            ["sh", "-c", "ps -eo pid,etime,comm | grep -E 'pyth' "
+                         "| grep -v grep | head -5"],
+            capture_output=True, text=True, timeout=5).stdout
+        out["python_procs"] = holders.strip().splitlines()[:5]
+    except Exception:  # noqa: BLE001
+        pass
+    return out
 
 
 def timed(fn, iters: int, block):
@@ -304,11 +340,47 @@ def cfg_ivf(np, jax, jnp, result):
         qps = 5 * n_q / t
         if recall >= 0.96:   # BASELINE bar is 0.95; take it with margin
             break
+    # the measured device numbers are recorded BEFORE the CPU reference
+    # runs: a reference failure (host OOM on the multi-GB list copy at
+    # full scale, say) must not discard them
     result["configs"]["ivf"] = {
         "qps": round(float(qps), 2),
         "recall_at_10": round(float(recall), 4),
         "nprobe": nprobe, "n_docs": n_docs, "dims": dims,
     }
+
+    # CPU reference: the SAME IVF plan (probe nprobe centroids, scan
+    # their packed lists with BLAS, top-k) on host numpy — the ANN
+    # counterpart of cpu_bm25_oracle, at the identical recall operating
+    # point so vs_5x_cpu compares equal-quality searches
+    try:
+        cents = np.asarray(index.centroids)
+        lists = np.asarray(index.lists)      # [nlist, L, D]
+        valid = np.asarray(index.valid)      # [nlist, L]
+        ids = np.asarray(index.ids)          # [nlist, L]
+        lnorms = np.asarray(index.norms) + 1e-30   # [nlist, L], prebuilt
+        nq_cpu = 16
+
+        def cpu_ivf(qs):
+            for q in qs:
+                cd = cents @ q
+                probes = np.argpartition(-cd, nprobe - 1)[:nprobe]
+                cand = lists[probes].reshape(-1, dims)      # [P*L, D]
+                s = (cand @ q) / (lnorms[probes].reshape(-1)
+                                  * np.linalg.norm(q) + 1e-30)
+                s[~valid[probes].reshape(-1)] = -np.inf
+                part = np.argpartition(-s, K)[:K]
+                _ = ids[probes].reshape(-1)[part[np.argsort(-s[part])]]
+
+        cpu_ivf(queries[:2])   # touch/warm caches
+        t0 = time.perf_counter()
+        cpu_ivf(queries[:nq_cpu])
+        cpu_qps = nq_cpu / (time.perf_counter() - t0)
+        result["configs"]["ivf"].update(
+            cpu_qps=round(float(cpu_qps), 2),
+            vs_5x_cpu=round(float(qps / (5 * cpu_qps)), 3))
+    except Exception as e:  # noqa: BLE001 — keep the device numbers
+        result["errors"]["ivf_cpu_ref"] = f"{type(e).__name__}: {e}"[:200]
 
 
 def cfg_hybrid(np, jax, jnp, result, knn_corpus, bm25_ctx):
@@ -354,10 +426,45 @@ def cfg_hybrid(np, jax, jnp, result, knn_corpus, bm25_ctx):
 
     block = jax.block_until_ready
     t = timed(run, 4, block)
+    hybrid_qps = 4 * batch / t
     result["configs"]["hybrid"] = {
-        "qps": round(4 * batch / t, 2),
+        "qps": round(hybrid_qps, 2),
         "window": window, "n_docs": n_docs,
     }
+
+    # CPU reference: host BM25 scatter-add + BLAS cosine + python RRF —
+    # the serving-equivalent hybrid pipeline without the device
+    try:
+        nq_cpu = 8
+        c_norms = np.linalg.norm(corpus, axis=1) + 1e-30
+        vq = np.asarray(vec_queries)[:nq_cpu]
+
+        def cpu_hybrid():
+            bm25_tops, _ = cpu_bm25_oracle(np, pf, text_queries[:nq_cpu],
+                                           window, timer_queries=0)
+            dots = vq @ corpus.T
+            s = dots / (c_norms[None, :]
+                        * (np.linalg.norm(vq, axis=1)[:, None] + 1e-30))
+            part = np.argpartition(-s, window, axis=1)[:, :window]
+            for i in range(nq_cpu):
+                knn_top = part[i][np.argsort(-s[i, part[i]])]
+                fused = {}
+                for lst in (bm25_tops[i], knn_top):
+                    for rank, d in enumerate(lst, start=1):
+                        fused[int(d)] = fused.get(int(d), 0.0) + \
+                            1.0 / (60 + rank)
+                sorted(fused.items(), key=lambda kv: -kv[1])[:K]
+
+        cpu_hybrid()   # warm pass: first-touch of the corpus + allocs
+        t0 = time.perf_counter()
+        cpu_hybrid()
+        cpu_qps = nq_cpu / (time.perf_counter() - t0)
+        result["configs"]["hybrid"].update(
+            cpu_qps=round(float(cpu_qps), 2),
+            vs_5x_cpu=round(float(hybrid_qps / (5 * cpu_qps)), 3))
+    except Exception as e:  # noqa: BLE001 — keep the device numbers
+        result["errors"]["hybrid_cpu_ref"] = \
+            f"{type(e).__name__}: {e}"[:200]
 
 
 def cfg_sparse(np, jax, jnp, result):
@@ -403,11 +510,62 @@ def cfg_sparse(np, jax, jnp, result):
         return ex.top_k_batch(expansions, live, K, function="saturation")
 
     t = timed(run, 4, block)
+    sparse_qps = 4 * len(texts) / t
     result["configs"]["sparse"] = {
-        "qps": round(4 * len(texts) / t, 2),
+        "qps": round(sparse_qps, 2),
         "expansion_qps": round(exp_qps, 2),
         "n_docs": n_docs, "expansion": "on-device model",
     }
+
+    # CPU reference: term-at-a-time scatter-add with the same saturation
+    # transform qw * w/(w+pivot) over the same feature blocks — the host
+    # counterpart of the serving kernel (cpu_bm25_oracle's shape). Both
+    # sides of vs_5x_cpu score PRECOMPUTED expansions (the CPU side has
+    # no host expansion model), so the device side is re-timed
+    # scoring-only for pipeline parity; the end-to-end number above
+    # stays the headline.
+    try:
+        expansions = [list(tok.items())
+                      for tok in model.expand_batch(texts)]
+        t_sc = timed(lambda: ex.top_k_batch(expansions, live, K,
+                                            function="saturation"),
+                     4, block)
+        scoring_qps = 4 * len(texts) / t_sc
+
+        blk = ff.block_docs.shape[1]
+        flat_docs = ff.block_docs.reshape(-1)
+        flat_w = np.asarray(weights).reshape(-1)
+        nq_cpu = 8
+
+        def cpu_sparse(qs):
+            for expansion in qs:
+                scores = np.zeros(n_docs, np.float32)
+                for feat, qw in expansion:
+                    fid = ff.features.get(feat)
+                    if fid is None:
+                        continue
+                    s0 = int(ff.feat_block_start[fid]) * blk
+                    cnt = int(ff.feat_block_count[fid]) * blk
+                    docs = flat_docs[s0: s0 + cnt]
+                    ws = flat_w[s0: s0 + cnt]
+                    m = docs >= 0
+                    d, wv = docs[m], ws[m]
+                    scores[d] += (qw * wv / (wv + 1.0)) \
+                        .astype(np.float32)
+                part = np.argpartition(-scores, K)[:K]
+                part[np.argsort(-scores[part])]
+
+        cpu_sparse(expansions[:2])
+        t0 = time.perf_counter()
+        cpu_sparse(expansions[:nq_cpu])
+        cpu_qps = nq_cpu / (time.perf_counter() - t0)
+        result["configs"]["sparse"].update(
+            qps_scoring=round(float(scoring_qps), 2),
+            cpu_qps=round(float(cpu_qps), 2),
+            vs_5x_cpu=round(float(scoring_qps / (5 * cpu_qps)), 3))
+    except Exception as e:  # noqa: BLE001 — keep the device numbers
+        result["errors"]["sparse_cpu_ref"] = \
+            f"{type(e).__name__}: {e}"[:200]
 
 
 # ---------------------------------------------------------------------------
@@ -417,14 +575,25 @@ def main() -> None:
               "vs_baseline": 0.0, "configs": {}, "errors": {}}
     t_start = time.perf_counter()
     try:
-        backend, err = probe_backend()
+        # escalating probe: a healthy tunnel answers in well under 240s
+        # (r1 did); a slow claim under pool pressure gets 600 more. A
+        # hung tunnel produces nothing forever, so after ~14min we take
+        # the CPU fallback and record the diagnostics for the judge.
+        # BENCH_PROBE_TIMEOUTS=a,b overrides (testing the fallback path
+        # without the 14-minute wait).
+        try:
+            t1, t2 = (float(x) for x in os.environ.get(
+                "BENCH_PROBE_TIMEOUTS", "240,600").split(","))
+        except ValueError:
+            t1, t2 = 240.0, 600.0
+        backend, err = probe_backend(timeout=t1)
         force_cpu = False
         if backend is None:
-            # one retry, then force CPU so the round still records numbers
             time.sleep(5)
-            backend, err2 = probe_backend()
+            backend, err2 = probe_backend(timeout=t2)
             if backend is None:
                 result["errors"]["backend"] = f"probe1: {err}; probe2: {err2}"
+                result["errors"]["backend_env"] = probe_diagnostics()
                 force_cpu = True
                 os.environ["JAX_PLATFORMS"] = "cpu"
                 global CPU_SCALED
