@@ -22,21 +22,28 @@ from elasticsearch_tpu.indices.indices_service import IndicesService
 from elasticsearch_tpu.transport.scheduler import Scheduler
 from elasticsearch_tpu.transport.transport import Deferred, TransportService
 from elasticsearch_tpu.utils.errors import (
-    SearchEngineError, UnavailableShardsError, VersionConflictError,
+    IndexNotFoundError, SearchEngineError, ShardNotFoundError,
+    UnavailableShardsError, VersionConflictError,
 )
+from elasticsearch_tpu.utils.retry import RetryableAction
 
 SHARD_BULK_PRIMARY = "indices:data/write/bulk[s][p]"
 SHARD_BULK_REPLICA = "indices:data/write/bulk[s][r]"
 
-RETRY_DELAY = 0.2
+# reroute backoff: first retry ~RETRY_INITIAL_DELAY, jittered-exponential
+# up to RETRY_MAX_DELAY (utils/retry.py), capped by REROUTE_TIMEOUT overall
+RETRY_INITIAL_DELAY = 0.2
+RETRY_MAX_DELAY = 5.0
 REROUTE_TIMEOUT = 30.0
 
 
 def _is_retryable(err: Any) -> bool:
     """True only when the op provably did not execute on a current primary:
-    connection refused before delivery, or stale-routing rejections."""
+    connection refused before delivery, stale-routing rejections, or
+    routing that hasn't (yet) resolved to an active primary."""
     from elasticsearch_tpu.transport.transport import NodeNotConnectedError
-    if isinstance(err, (NodeNotConnectedError, UnavailableShardsError)):
+    if isinstance(err, (NodeNotConnectedError, UnavailableShardsError,
+                        IndexNotFoundError, ShardNotFoundError)):
         return True
     text = str(err)
     return ("UnavailableShardsError" in text
@@ -55,6 +62,7 @@ class TransportShardBulkAction:
         self.ts = ts
         self.scheduler = scheduler
         self.state = state_supplier
+        self.last_reroute_retry: Optional[RetryableAction] = None
         ts.register_handler(SHARD_BULK_PRIMARY, self._on_primary)
         ts.register_handler(SHARD_BULK_REPLICA, self._on_replica)
 
@@ -65,46 +73,37 @@ class TransportShardBulkAction:
     def execute(self, index: str, shard_id: int, items: List[Dict[str, Any]],
                 on_done: Callable[[Optional[Dict[str, Any]],
                                    Optional[Exception]], None]) -> None:
-        deadline = self.scheduler.now() + REROUTE_TIMEOUT
+        """Reroute phase as a RetryableAction: each attempt re-resolves the
+        primary from CURRENT cluster state, so a retry after failover/heal
+        lands on the promoted copy. Retries are jittered-exponential
+        (utils/retry.py) — no fixed-delay spinning — and only fire for
+        errors proving the op never executed (timeouts/unknown remote
+        errors surface immediately: the primary may have applied the ops,
+        and re-sending would duplicate writes)."""
 
-        def attempt() -> None:
+        def attempt(cb) -> None:
             state = self.state()
             try:
                 primary = state.routing_table.index(index).primary(shard_id)
             except SearchEngineError as e:
-                retry_or_fail(e)
+                cb(None, e)
                 return
             if not primary.active or primary.node_id is None:
-                retry_or_fail(UnavailableShardsError(
+                cb(None, UnavailableShardsError(
                     f"primary shard [{index}][{shard_id}] is not active"))
                 return
             self.ts.send_request(
                 primary.node_id, SHARD_BULK_PRIMARY,
                 {"index": index, "shard": shard_id, "items": items},
-                on_response, timeout=REROUTE_TIMEOUT)
+                cb, timeout=REROUTE_TIMEOUT)
 
-        def on_response(resp, err) -> None:
-            if err is not None and _is_retryable(err):
-                # stale routing (shard moved / promoted elsewhere) or the
-                # request provably never reached the primary: safe to retry
-                retry_or_fail(err)
-                return
-            if err is not None:
-                # timeouts/unknown remote errors are NOT retried: the
-                # primary may have applied the ops, and re-sending would
-                # duplicate writes (the reference surfaces these too)
-                on_done(None, err)
-                return
-            on_done(resp, None)
-
-        def retry_or_fail(err) -> None:
-            if self.scheduler.now() >= deadline:
-                on_done(None, err if isinstance(err, Exception)
-                        else UnavailableShardsError(str(err)))
-            else:
-                self.scheduler.schedule(RETRY_DELAY, attempt)
-
-        attempt()
+        action = RetryableAction(
+            self.scheduler, attempt, on_done,
+            initial_delay=RETRY_INITIAL_DELAY, max_delay=RETRY_MAX_DELAY,
+            timeout=REROUTE_TIMEOUT, is_retryable=_is_retryable)
+        # observable for telemetry and the chaos suite (backoff shape)
+        self.last_reroute_retry = action
+        action.run()
 
     # ------------------------------------------------------------------
     # primary side

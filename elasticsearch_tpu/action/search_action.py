@@ -58,6 +58,37 @@ def _parse_max_concurrent(raw) -> Optional[int]:
         raise IllegalArgumentError(
             "[max_concurrent_shard_requests] must be >= 1")
     return value
+
+
+def _parse_allow_partial(raw) -> Optional[bool]:
+    """Request-level allow_partial_search_results; None = defer to the
+    search.default_allow_partial_results cluster setting."""
+    if raw is None:
+        return None
+    if isinstance(raw, bool):
+        return raw
+    text = str(raw).lower()
+    if text in ("true", "1", "yes"):
+        return True
+    if text in ("false", "0", "no"):
+        return False
+    raise IllegalArgumentError(
+        f"[allow_partial_search_results] must be a boolean, got [{raw!r}]")
+
+
+def _parse_timeout_seconds(raw) -> Optional[float]:
+    """Request time budget ('100ms', '2s', seconds-number); None = none."""
+    if raw is None:
+        return None
+    from elasticsearch_tpu.utils.settings import parse_time_to_seconds
+    try:
+        value = parse_time_to_seconds(raw)
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"[timeout] must be a time value, got [{raw!r}]")
+    if value <= 0:
+        raise IllegalArgumentError("[timeout] must be > 0")
+    return value
 SEARCH_FETCH = "indices:data/read/search[phase/fetch]"
 # cross-cluster search: a remote coordinator executes the whole search
 # for its clusters' indices and returns the final response
@@ -394,6 +425,21 @@ class TransportSearchAction:
         )
         self.response_collector = ResponseCollectorService()
 
+    def _default_allow_partial(self, state: ClusterState) -> bool:
+        """Cluster-wide default (search.default_allow_partial_results,
+        dynamic via _cluster/settings persistent updates)."""
+        from elasticsearch_tpu.utils.settings import (
+            SEARCH_DEFAULT_ALLOW_PARTIAL_RESULTS,
+        )
+        raw = state.metadata.persistent_settings.get(
+            SEARCH_DEFAULT_ALLOW_PARTIAL_RESULTS.key)
+        if raw is None:
+            return True
+        try:
+            return SEARCH_DEFAULT_ALLOW_PARTIAL_RESULTS.parse(raw)
+        except Exception:  # noqa: BLE001 — unparseable operator value:
+            return True    # fail toward availability, like the default
+
     # ------------------------------------------------------------------
     # index/shard resolution
     # ------------------------------------------------------------------
@@ -530,6 +576,20 @@ class TransportSearchAction:
                 self.task_manager.unregister(task)
                 inner(resp, err)
 
+        # malformed composite-clause SHAPES must 400 here, before any
+        # dispatch dereferences them (a "rank": "rrf" string would
+        # otherwise AttributeError into a 500 — ADVICE r5)
+        try:
+            _validate_composite_shapes(body)
+            allow_partial = _parse_allow_partial(
+                body.get("allow_partial_search_results"))
+            budget = _parse_timeout_seconds(body.get("timeout"))
+        except SearchEngineError as e:
+            on_done(None, e)
+            return
+        if allow_partial is None:
+            allow_partial = self._default_allow_partial(state)
+
         # composite paths AFTER task registration so CCS/RRF requests get
         # the same parent cancellable task as every other search
         if ":" in (index_expression or "") and \
@@ -588,11 +648,20 @@ class TransportSearchAction:
         from_ = int(body.get("from", 0))
         window = size + from_
 
+        scheduler = self.ts.transport.scheduler
         phase_state = {
             "skipped": 0, "failed": 0,
             "failures": [],
+            "task": task,
             "task_id": task.task_id if task is not None else None,
             "max_concurrent_shard_requests": max_concurrent,
+            # graceful degradation knobs: per-shard failures after replica
+            # failover either degrade the response (failures listed in
+            # _shards) or fail the whole request, and the time budget
+            # bounds how long the query fan-out may run
+            "allow_partial": allow_partial,
+            "deadline": (scheduler.now() + budget
+                         if budget is not None else None),
         }
 
         if self._try_mesh_path(t0, indices, targets, body, window, from_,
@@ -668,6 +737,9 @@ class TransportSearchAction:
             else:
                 return False
         except Exception:  # noqa: BLE001 — RPC path reports real errors
+            # graceful degradation: the broken mesh program escapes to the
+            # host-RPC scatter-gather, and the escape is observable
+            self.mesh_plane.stats["mesh_fallbacks"] += 1
             return False
         if result is None:
             return False
@@ -772,6 +844,7 @@ class TransportSearchAction:
                      phase_state, n_total_shards, on_done, dfs_overrides):
         results: List[Optional[Dict[str, Any]]] = [None] * len(targets)
         pending = {"n": len(targets)}
+        resolved = [False] * len(targets)
 
         def one(i: int, target, copy_idx: int = 0) -> None:
             shard_body = body
@@ -794,8 +867,9 @@ class TransportSearchAction:
             def cb(resp, err):
                 self.response_collector.on_response(
                     node, time.monotonic() - t_sent, failed=err is not None)
-                if phase_state.get("aborted"):
-                    return
+                if phase_state.get("aborted") or \
+                        phase_state.get("budget_expired"):
+                    return   # the phase already completed without us
                 if err is not None:
                     # a cancelled task must abort the whole search, not
                     # fail over to replicas (cancellation is not a fault)
@@ -803,6 +877,9 @@ class TransportSearchAction:
                             "TaskCancelledError" or \
                             type(err).__name__ == "TaskCancelledError":
                         phase_state["aborted"] = True
+                        timer = phase_state.pop("_budget_timer", None)
+                        if timer is not None:
+                            timer.cancel()
                         on_done(None, err)
                         return
                     if copy_idx + 1 < len(copies):
@@ -817,8 +894,12 @@ class TransportSearchAction:
                 else:
                     target["node"] = node   # fetch goes where query ran
                     results[i] = resp
+                resolved[i] = True
                 pending["n"] -= 1
                 if pending["n"] == 0:
+                    timer = phase_state.pop("_budget_timer", None)
+                    if timer is not None:
+                        timer.cancel()
                     self._merge_and_fetch(t0, targets, results, body, from_,
                                           size, phase_state, n_total_shards,
                                           on_done)
@@ -828,6 +909,37 @@ class TransportSearchAction:
                     if pump is not None:
                         pump()
             self.ts.send_request(node, SEARCH_QUERY, req, cb, timeout=60.0)
+
+        # time budget (request [timeout]): when it expires with shard
+        # responses still outstanding, the phase completes NOW with what
+        # has arrived — timed_out: true, the missing shards recorded in
+        # _shards.failures, and the fetch phase still materializing the
+        # surviving hits (partial results over nothing).
+        deadline = phase_state.get("deadline")
+        if deadline is not None:
+            scheduler = self.ts.transport.scheduler
+
+            def budget_expired() -> None:
+                if phase_state.get("aborted") or \
+                        phase_state.get("budget_expired") or pending["n"] == 0:
+                    return
+                phase_state["budget_expired"] = True
+                phase_state["timed_out"] = True
+                for j, target in enumerate(targets):
+                    if resolved[j]:
+                        continue
+                    phase_state["failed"] += 1
+                    phase_state["failures"].append({
+                        "shard": target["shard"], "index": target["index"],
+                        "reason": "search budget expired before the shard "
+                                  "responded",
+                        "status": 503})
+                self._merge_and_fetch(t0, targets, results, body, from_,
+                                      size, phase_state, n_total_shards,
+                                      on_done)
+
+            phase_state["_budget_timer"] = scheduler.schedule(
+                max(0.0, deadline - scheduler.now()), budget_expired)
 
         # bounded fan-out: at most max_concurrent_shard_requests shard
         # queries in flight per search; the next shard dispatches as each
@@ -840,6 +952,22 @@ class TransportSearchAction:
         cursor = {"i": 0}
 
         def dispatch_next() -> None:
+            # a cancelled parent task stops the fan-out at the next slot
+            # boundary: no further shard requests go out, and the search
+            # aborts instead of waiting on undispatched shards
+            task = phase_state.get("task")
+            if task is not None and task.cancelled and \
+                    not phase_state.get("aborted") and \
+                    not phase_state.get("budget_expired"):
+                phase_state["aborted"] = True
+                timer = phase_state.pop("_budget_timer", None)
+                if timer is not None:
+                    timer.cancel()
+                from elasticsearch_tpu.utils.errors import TaskCancelledError
+                on_done(None, TaskCancelledError(
+                    f"task [{task.task_id}] was cancelled: "
+                    f"{task.cancel_reason}"))
+                return
             done = len(targets) - pending["n"]
             while cursor["i"] < len(targets) and \
                     (cursor["i"] - done) < max_concurrent:
@@ -922,7 +1050,8 @@ class TransportSearchAction:
         pending = {"n": len(retrievers)}
         passthrough = {k: body[k] for k in
                        ("_source", "docvalue_fields", "stored_fields",
-                        "highlight") if k in body}
+                        "highlight", "timeout",
+                        "allow_partial_search_results") if k in body}
 
         def complete() -> None:
             if errors:
@@ -952,13 +1081,16 @@ class TransportSearchAction:
             # clean run
             shards = {"total": 0, "successful": 0, "skipped": 0,
                       "failed": 0}
+            timed_out = False
             for ranked in results:
                 sub = (ranked or {}).get("_shards") or {}
                 for f in shards:
                     shards[f] += int(sub.get(f, 0))
+                timed_out = timed_out or bool(
+                    (ranked or {}).get("timed_out"))
             on_done({
                 "took": int((time.monotonic() - t0) * 1000),
-                "timed_out": False,
+                "timed_out": timed_out,
                 "_shards": shards,
                 # windows cap what fusion can observe: the unique-doc
                 # count is a LOWER bound on true matches
@@ -1038,18 +1170,27 @@ class TransportSearchAction:
         keys = (["(local)"] if local_parts else []) + sorted(remote_groups)
         results: Dict[str, Dict[str, Any]] = {}
         errors: list = []
+        skipped: list = []
         pending = {"n": len(keys)}
 
         def complete() -> None:
             if errors:
                 on_done(None, errors[0][1])
                 return
-            on_done(self._merge_ccs(t0, body, results, from_, size), None)
+            on_done(self._merge_ccs(t0, body, results, from_, size,
+                                    skipped=skipped), None)
 
         def collect(key: str):
             def cb(resp, err) -> None:
                 if err is not None:
-                    errors.append((key, err))
+                    # cluster.remote.<alias>.skip_unavailable: a down or
+                    # failing remote degrades the federated search (the
+                    # cluster is reported skipped) instead of failing it
+                    if key != "(local)" and \
+                            self.remote_clusters.skip_unavailable(key):
+                        skipped.append(key)
+                    else:
+                        errors.append((key, err))
                 else:
                     results[key] = resp or {}
                 pending["n"] -= 1
@@ -1069,11 +1210,13 @@ class TransportSearchAction:
 
     def _merge_ccs(self, t0, body: Dict[str, Any],
                    results: Dict[str, Dict[str, Any]],
-                   from_: int, size: int) -> Dict[str, Any]:
+                   from_: int, size: int,
+                   skipped: Optional[list] = None) -> Dict[str, Any]:
         sort_specified = body.get("sort") is not None
         entries: list = []
         total = 0
         relation = "eq"
+        timed_out = False
         max_score: Optional[float] = None
         shards = {"total": 0, "successful": 0, "skipped": 0, "failed": 0}
         for key, resp in results.items():
@@ -1082,6 +1225,7 @@ class TransportSearchAction:
             total += int(tot.get("value", 0))
             if tot.get("relation") == "gte":
                 relation = "gte"
+            timed_out = timed_out or bool(resp.get("timed_out"))
             ms = h.get("max_score")
             if ms is not None:
                 max_score = ms if max_score is None else max(max_score, ms)
@@ -1115,12 +1259,14 @@ class TransportSearchAction:
             entries.sort(key=functools.cmp_to_key(cmp))
         else:
             entries.sort(key=lambda hh: -(hh.get("_score") or 0.0))
+        n_skipped = len(skipped or [])
         return {
             "took": int((time.monotonic() - t0) * 1000),
-            "timed_out": False,
+            "timed_out": timed_out,
             "_shards": shards,
-            "_clusters": {"total": len(results),
-                          "successful": len(results), "skipped": 0},
+            "_clusters": {"total": len(results) + n_skipped,
+                          "successful": len(results),
+                          "skipped": n_skipped},
             "hits": {"total": {"value": total, "relation": relation},
                      "max_score": max_score,
                      "hits": entries[from_: from_ + size]},
@@ -1192,7 +1338,7 @@ class TransportSearchAction:
             self._complete(self._finalize(t0, targets, body, phase_state,
                                           n_total_shards, total, relation,
                                           max_score, [], results=results),
-                           on_done)
+                           on_done, phase_state)
             return
 
         # group winners per shard for fetch
@@ -1230,7 +1376,7 @@ class TransportSearchAction:
                         self._finalize(t0, targets, body, phase_state,
                                        n_total_shards, total, relation,
                                        max_score, hits, results=results),
-                        on_done)
+                        on_done, phase_state)
             self.ts.send_request(target["node"], SEARCH_FETCH, req, cb,
                                  timeout=60.0)
         for tidx, docs in by_target.items():
@@ -1238,26 +1384,39 @@ class TransportSearchAction:
 
     # -- response --------------------------------------------------------
 
-    def _complete(self, resp: Dict[str, Any], on_done) -> None:
+    def _complete(self, resp: Dict[str, Any], on_done,
+                  phase_state: Optional[Dict[str, Any]] = None) -> None:
         """Deliver the merged response — unless EVERY shard failed, in
         which case the whole search fails with the dominant cause's status
         (SearchPhaseExecutionException.status() analog: an all-shards 429
-        is a request-wide 429, not a 200 with empty hits)."""
+        is a request-wide 429, not a 200 with empty hits). With
+        allow_partial_search_results=false, ANY shard failure or an
+        expired time budget fails the request the same way."""
         shards = resp["_shards"]
+        from elasticsearch_tpu.utils.errors import SearchPhaseExecutionError
+        failures = shards.get("failures") or []
         # skipped shards count as successful ops (the reference's skipShard
         # calls successfulShardExecution): only fail the request when every
         # NON-skipped shard failed and at least one did
         if shards["total"] > 0 and shards["successful"] == 0 \
                 and shards["skipped"] == 0 and shards["failed"] > 0:
-            from elasticsearch_tpu.utils.errors import (
-                SearchPhaseExecutionError,
-            )
-            failures = shards.get("failures") or []
             statuses = [f.get("status", 500) for f in failures]
             cause_status = max(statuses, default=503)
             reason = failures[0]["reason"] if failures else "all shards failed"
             on_done(None, SearchPhaseExecutionError(
                 f"all shards failed: {reason}", cause_status=cause_status))
+            return
+        if phase_state is not None and \
+                not phase_state.get("allow_partial", True) and \
+                (shards["failed"] > 0 or resp.get("timed_out")):
+            statuses = [f.get("status", 500) for f in failures]
+            reason = failures[0]["reason"] if failures \
+                else "search budget expired"
+            on_done(None, SearchPhaseExecutionError(
+                f"{shards['failed']} of {shards['total']} shards failed "
+                f"and partial results are disallowed "
+                f"(allow_partial_search_results=false): {reason}",
+                cause_status=max(statuses, default=503)))
             return
         on_done(resp, None)
 
@@ -1268,7 +1427,7 @@ class TransportSearchAction:
             - phase_state["skipped"]
         resp = {
             "took": int((time.monotonic() - t0) * 1000),
-            "timed_out": False,
+            "timed_out": bool(phase_state.get("timed_out")),
             "_shards": {"total": n_total_shards,
                         "successful": max(successful, 0),
                         "skipped": phase_state["skipped"],
@@ -1319,6 +1478,34 @@ class TransportSearchAction:
             "hits": {"total": {"value": 0, "relation": "eq"},
                      "max_score": None, "hits": []},
         }
+
+
+def _validate_composite_shapes(body: Dict[str, Any]) -> None:
+    """Malformed rank/sub_searches/knn container shapes 400 at entry
+    instead of AttributeError/TypeError-ing into 500s deeper in the
+    pipeline (and in the security DLS wrap — ADVICE r5 low)."""
+    rank = body.get("rank")
+    if rank is not None and not isinstance(rank, dict):
+        raise IllegalArgumentError(
+            f"[rank] must be an object, got [{type(rank).__name__}]")
+    if isinstance(rank, dict):
+        rrf = rank.get("rrf")
+        if rrf is not None and not isinstance(rrf, dict):
+            raise IllegalArgumentError(
+                f"[rank.rrf] must be an object, got "
+                f"[{type(rrf).__name__}]")
+    subs = body.get("sub_searches")
+    if subs is not None:
+        if not isinstance(subs, list) or \
+                not all(isinstance(s, dict) for s in subs):
+            raise IllegalArgumentError(
+                "[sub_searches] must be a list of objects")
+    knn = body.get("knn")
+    if knn is not None:
+        clauses = knn if isinstance(knn, list) else [knn]
+        if not all(isinstance(c, dict) for c in clauses):
+            raise IllegalArgumentError(
+                "[knn] must be an object or a list of objects")
 
 
 def _must_visit_all_shards(body: Dict[str, Any]) -> bool:

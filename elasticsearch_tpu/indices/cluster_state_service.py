@@ -174,10 +174,49 @@ class IndicesClusterStateService:
                 return
             self._shard_started(sr)
 
-        self.ts.send_request(primary.node_id, RECOVERY_START, {
-            "index": sr.index, "shard": sr.shard_id,
-            "allocation_id": sr.allocation_id,
-        }, on_response, timeout=60.0)
+        # the start request retries with jittered-exponential backoff
+        # through transient source-side failures (primary node briefly
+        # unreachable / partitioned) before the copy is failed to the
+        # master — RecoveryTarget's RetryableAction-driven retryRecovery
+        def attempt(cb) -> None:
+            from elasticsearch_tpu.transport.transport import (
+                NodeNotConnectedError,
+            )
+            state_now = self.last_applied
+            source = primary.node_id
+            if state_now is not None:
+                try:
+                    sr_now = state_now.routing_table.index(
+                        sr.index).primary(sr.shard_id)
+                    if sr_now.active and sr_now.node_id is not None:
+                        source = sr_now.node_id   # primary moved: follow it
+                except Exception:  # noqa: BLE001 — keep the last source
+                    pass
+            if source is None:
+                cb(None, NodeNotConnectedError(
+                    f"no active primary for [{sr.index}][{sr.shard_id}]"))
+                return
+            self.ts.send_request(source, RECOVERY_START, {
+                "index": sr.index, "shard": sr.shard_id,
+                "allocation_id": sr.allocation_id,
+            }, cb, timeout=60.0)
+
+        from elasticsearch_tpu.utils.errors import ReceiveTimeoutError
+        from elasticsearch_tpu.utils.retry import RetryableAction
+
+        def retryable(err) -> bool:
+            # the start request is idempotent on the source (snapshot +
+            # mark-in-sync), so lost requests AND lost replies both retry
+            from elasticsearch_tpu.transport.transport import (
+                ConnectTransportError,
+            )
+            return isinstance(err, (ConnectTransportError,
+                                    ReceiveTimeoutError))
+
+        RetryableAction(
+            self.ts.transport.scheduler, attempt, on_response,
+            initial_delay=0.5, max_delay=10.0, timeout=120.0,
+            is_retryable=retryable).run()
 
     def _on_recovery_start(self, req: Dict[str, Any], sender: str
                            ) -> Dict[str, Any]:

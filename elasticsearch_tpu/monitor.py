@@ -105,15 +105,25 @@ def device_stats() -> Dict[str, Any]:
     jax = sys.modules.get("jax")
     if jax is None:
         return out
+    # jax.devices() triggers FIRST-init of every registered platform
+    # when none is up yet — and the environment's TPU-tunnel plugin
+    # forces itself first in jax_platforms and can block indefinitely
+    # while claiming hardware (r3/r4 bench probes hung exactly here).
+    # Stats observe; they must never pay (or hang on) first-init.
     try:
-        # jax.devices() triggers FIRST-init of every registered platform
-        # when none is up yet — and the environment's TPU-tunnel plugin
-        # forces itself first in jax_platforms and can block indefinitely
-        # while claiming hardware (r3/r4 bench probes hung exactly here).
-        # Stats observe; they must never pay (or hang on) first-init.
         from jax._src import xla_bridge as _xb
-        if not _xb.backends_are_initialized():
-            return out
+        ready = _xb.backends_are_initialized()
+    except Exception:  # noqa: BLE001 — the PRIVATE api moved/renamed:
+        # fall through to jax.devices() ONLY when the configured platform
+        # set cannot hang on first-init (cpu-only) — on TPU-tunnel hosts
+        # the never-pay-first-init invariant above outranks reporting
+        platforms = str(getattr(jax.config, "jax_platforms", None)
+                        or os.environ.get("JAX_PLATFORMS", "") or "")
+        names = [p.strip() for p in platforms.split(",") if p.strip()]
+        ready = bool(names) and all(p == "cpu" for p in names)
+    if not ready:
+        return out
+    try:
         devices = jax.devices()
     except Exception:  # noqa: BLE001 — backend init failure: no devices
         return out

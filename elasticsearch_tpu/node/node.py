@@ -128,6 +128,10 @@ class Node:
             # pjit program, RPC scatter-gather stays the fallback
             from elasticsearch_tpu.parallel.mesh_plane import MeshDataPlane
             self.mesh_plane = MeshDataPlane()
+            # explicit mesh opt-in: bring the backend up at BOOT so the
+            # first search finds the mesh ready instead of silently
+            # serving the RPC fallback until other compute initializes it
+            self.mesh_plane.warmup()
         from elasticsearch_tpu.transport.remote import RemoteClusterService
         self.remote_clusters = RemoteClusterService(self)
         self.search_action = TransportSearchAction(

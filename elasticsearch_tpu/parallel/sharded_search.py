@@ -37,7 +37,18 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:   # pre-0.5 jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+    from functools import wraps as _wraps
+
+    @_wraps(_shard_map_legacy)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_legacy(*args, **kwargs)
 
 from elasticsearch_tpu.index.segment import BLOCK, next_pow2
 from elasticsearch_tpu.ops.bm25 import (
@@ -276,7 +287,9 @@ def make_sharded_bm25_batch(mesh: Mesh, n_per_shard: int, k: int,
         scores = jax.vmap(one)(block_idx[0], block_w[0])       # [Q, N]
         local_s, local_i = _topk_padded(scores, k)             # [Q, k]
         shard_idx = jax.lax.axis_index("shard")
-        n_shards = jax.lax.axis_size("shard")
+        # psum(1) == axis size on every jax vintage (lax.axis_size is
+        # newer than the floor this build supports)
+        n_shards = jax.lax.psum(1, "shard")
         # round-robin placement: original id = local * S + shard; empty
         # slots get an out-of-range id so the lexsort puts them last
         orig_i = jnp.where(jnp.isfinite(local_s),

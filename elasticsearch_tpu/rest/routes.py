@@ -196,6 +196,12 @@ def build_controller(client: NodeClient) -> RestController:
             # passed through raw; the action layer validates and 400s
             body["max_concurrent_shard_requests"] = \
                 req.query["max_concurrent_shard_requests"]
+        if "allow_partial_search_results" in req.query:
+            # passed through raw; the action layer validates and 400s
+            body["allow_partial_search_results"] = \
+                req.query["allow_partial_search_results"]
+        if "timeout" in req.query:
+            body["timeout"] = req.query["timeout"]
         search_type = req.query.get("search_type", "query_then_fetch")
         client.search(index, body, wrap_client_cb(done),
                       search_type=search_type)

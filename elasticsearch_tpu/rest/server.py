@@ -53,6 +53,11 @@ class HttpServer:
             ssl_ctx.load_cert_chain(self.ssl_certfile, self.ssl_keyfile)
         self._server = await asyncio.start_server(
             self._handle_connection, self.host, self.port, ssl=ssl_ctx)
+        if self.port == 0:
+            # ephemeral bind: report the kernel-assigned port so callers
+            # (and the startup banner) see the real address — test
+            # harnesses use this instead of the racy probe-close-rebind
+            self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -274,7 +279,8 @@ def run_single_node(host: str = "127.0.0.1", port: int = 9200,
 
     async def main() -> None:
         await server.start()
-        print(f"elasticsearch_tpu node listening on http://{host}:{port}")
+        print(f"elasticsearch_tpu node listening on "
+              f"http://{host}:{server.port}", flush=True)
         stop = asyncio.Event()
         try:
             asyncio.get_running_loop().add_signal_handler(
@@ -339,8 +345,8 @@ def run_tcp_node(node_id: str, http_port: int, tcp_port: int,
 
     async def main() -> None:
         await server.start()
-        print(f"elasticsearch_tpu node {node_id} http://{host}:{http_port} "
-              f"tcp:{tcp_port}", flush=True)
+        print(f"elasticsearch_tpu node {node_id} "
+              f"http://{host}:{server.port} tcp:{tcp_port}", flush=True)
         stop = asyncio.Event()
         try:
             asyncio.get_running_loop().add_signal_handler(

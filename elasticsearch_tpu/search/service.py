@@ -54,6 +54,24 @@ class SearchService:
                collectors: Optional[List] = None) -> Dict[str, Any]:
         body = body or {}
         t0 = time.monotonic()
+        # request [timeout] budget: validated at ENTRY (junk must 400
+        # before any query cost is paid, matching the coordinator path's
+        # _parse_timeout_seconds), checked at the collection boundary —
+        # coarser than the reference's in-collection checks: one shard's
+        # whole query either fits the budget or reports timed_out
+        budget = None
+        if body.get("timeout") is not None:
+            from elasticsearch_tpu.utils.settings import (
+                parse_time_to_seconds,
+            )
+            try:
+                budget = parse_time_to_seconds(body["timeout"])
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    f"[timeout] must be a time value, "
+                    f"got [{body['timeout']!r}]")
+            if budget <= 0:
+                raise IllegalArgumentError("[timeout] must be > 0")
         self.reap_scrolls()
         reader = reader or self.engine.acquire_reader()
         if "text_expansion" in str(body.get("query", "")):
@@ -111,9 +129,12 @@ class SearchService:
                 if d.ckey is not None:
                     hit.setdefault("fields", {})[cfield] = [d.ckey]
 
+        timed_out = budget is not None and \
+            (time.monotonic() - t0) >= budget
+
         response: Dict[str, Any] = {
             "took": int((time.monotonic() - t0) * 1000),
-            "timed_out": False,
+            "timed_out": timed_out,
             "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
             "hits": {
                 "total": {"value": result.total_hits, "relation": result.total_relation},

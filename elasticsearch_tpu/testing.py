@@ -134,8 +134,31 @@ class InProcessCluster:
         node = self.nodes.pop(node_id)
         node.stop()
 
-    def partition(self, side_a: List[str], side_b: List[str]) -> None:
-        self.transport.partition(side_a, side_b)
+    def crash_node(self, node_id: str) -> None:
+        """Crash without cleanup: the node drops off the wire (senders get
+        connection-refused) but keeps its in-memory state for
+        restart_node() — a process crash/restart or a long GC-style pause."""
+        self.transport.crash(node_id)
+
+    def restart_node(self, node_id: str) -> None:
+        self.transport.restore(node_id)
+
+    def partition(self, side_a: List[str], side_b: List[str],
+                  style: str = "blackhole") -> None:
+        self.transport.partition(side_a, side_b, style=style)
+
+    def partition_one_way(self, from_side: List[str], to_side: List[str],
+                          style: str = "blackhole") -> None:
+        """Asymmetric partition: from_side -> to_side traffic disrupted,
+        reverse direction intact."""
+        self.transport.partition_one_way(from_side, to_side, style=style)
+
+    def add_latency(self, sender: str, receiver: str, delay: float,
+                    jitter: float = 0.0) -> None:
+        """Inject fixed + jittered latency on one directed link (jitter
+        draws from the seeded scheduler RNG: reproducible chaos)."""
+        self.transport.add_rule(sender, receiver, delay=delay,
+                                jitter=jitter)
 
     def heal(self) -> None:
         self.transport.heal()

@@ -22,6 +22,15 @@ __all__ = ["RemoteClusterService", "split_remote_expression"]
 
 SEED_PREFIX = "cluster.remote."
 SEED_SUFFIX = ".seeds"
+SKIP_UNAVAILABLE_SUFFIX = ".skip_unavailable"
+
+# one shared declaration/parser for every alias's affix key (the registry
+# Setting discipline — no hand-rolled boolean parsing here)
+from elasticsearch_tpu.utils.settings import Property, Scope, Setting
+
+_SKIP_UNAVAILABLE_SETTING: Setting = Setting.bool_setting(
+    SEED_PREFIX + "*" + SKIP_UNAVAILABLE_SUFFIX, False,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
 
 def split_remote_expression(expression: str
@@ -77,13 +86,27 @@ class RemoteClusterService:
     def aliases(self) -> List[str]:
         return sorted(self.seeds())
 
+    def skip_unavailable(self, alias: str) -> bool:
+        """cluster.remote.<alias>.skip_unavailable (dynamic): when true, a
+        cross-cluster search treats this remote's failure as a SKIPPED
+        cluster — degraded federated results instead of a failed search
+        (RemoteClusterService.REMOTE_CLUSTER_SKIP_UNAVAILABLE analog)."""
+        raw = self.node._applied_state().metadata.persistent_settings.get(
+            f"{SEED_PREFIX}{alias}{SKIP_UNAVAILABLE_SUFFIX}")
+        if raw is None:
+            return False
+        try:
+            return _SKIP_UNAVAILABLE_SETTING.parse(raw)
+        except Exception:  # noqa: BLE001 — unparseable operator value:
+            return False   # fail toward strict (the setting's default)
+
     def info(self) -> Dict[str, Any]:
         """GET /_remote/info shape."""
         return {alias: {
             "seeds": [f"{h}:{p}" for h, p in addrs],
             "connected": True,     # lazy connections: reported configured
             "num_nodes_connected": len(addrs),
-            "skip_unavailable": False,
+            "skip_unavailable": self.skip_unavailable(alias),
         } for alias, addrs in self.seeds().items()}
 
     # -- sending -------------------------------------------------------

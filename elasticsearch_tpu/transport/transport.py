@@ -12,6 +12,7 @@ reference's Writeable DTOs are wire-shaped).
 from __future__ import annotations
 
 import copy
+import random as _random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -95,9 +96,18 @@ class Deferred:
 
 @dataclass
 class _Rule:
-    """Disruption rule for a directed link (or wildcard '*')."""
+    """Disruption rule for a directed link (or wildcard '*').
+
+    drop: blackhole — the message silently vanishes (packet loss; the
+    sender's timeout is the only signal). disconnect: the link refuses —
+    the sender fails fast with NodeNotConnectedError (connection refused),
+    the retryable flavor real networks produce when a process is down.
+    delay/jitter: fixed plus uniformly-random extra latency per message.
+    """
     drop: bool = False
+    disconnect: bool = False
     delay: float = 0.0
+    jitter: float = 0.0
 
 
 class InMemoryTransport:
@@ -105,7 +115,8 @@ class InMemoryTransport:
 
     One instance per simulated network. Per-link latency plus disruption
     rules; every delivery is a scheduled task, so under the deterministic
-    scheduler the full cluster interleaving is seed-reproducible.
+    scheduler the full cluster interleaving is seed-reproducible (jittered
+    latency draws from the scheduler's seeded RNG when it has one).
     """
 
     def __init__(self, scheduler: Scheduler, default_latency: float = 0.001):
@@ -113,11 +124,16 @@ class InMemoryTransport:
         self.default_latency = default_latency
         self._nodes: Dict[str, "TransportService"] = {}
         self._rules: Dict[Tuple[str, str], _Rule] = {}
+        # crashed nodes: detached but remembered, so restore() can bring
+        # the same service back (a process crash/restart with state kept)
+        self._crashed: Dict[str, "TransportService"] = {}
+        self.random = getattr(scheduler, "random", None) or _random
 
     # -- membership ----------------------------------------------------------
 
     def attach(self, service: "TransportService") -> None:
         self._nodes[service.node_id] = service
+        self._crashed.pop(service.node_id, None)
 
     def detach(self, node_id: str) -> None:
         self._nodes.pop(node_id, None)
@@ -128,21 +144,50 @@ class InMemoryTransport:
     # -- disruption (NetworkDisruption / MockTransportService analogs) -------
 
     def add_rule(self, sender: str, receiver: str,
-                 drop: bool = False, delay: float = 0.0) -> None:
-        self._rules[(sender, receiver)] = _Rule(drop=drop, delay=delay)
+                 drop: bool = False, delay: float = 0.0,
+                 jitter: float = 0.0, disconnect: bool = False) -> None:
+        self._rules[(sender, receiver)] = _Rule(
+            drop=drop, disconnect=disconnect, delay=delay, jitter=jitter)
 
     def clear_rules(self) -> None:
         self._rules.clear()
 
-    def partition(self, side_a, side_b) -> None:
-        """Two-way partition between node-id groups."""
-        for a in side_a:
-            for b in side_b:
-                self.add_rule(a, b, drop=True)
-                self.add_rule(b, a, drop=True)
+    def partition(self, side_a, side_b, style: str = "blackhole") -> None:
+        """Two-way partition between node-id groups. style='blackhole'
+        drops silently (timeouts resolve the senders); style='disconnect'
+        refuses fast (NodeNotConnectedError — the retryable flavor)."""
+        self.partition_one_way(side_a, side_b, style=style)
+        self.partition_one_way(side_b, side_a, style=style)
+
+    def partition_one_way(self, from_side, to_side,
+                          style: str = "blackhole") -> None:
+        """Asymmetric partition: messages from_side -> to_side are
+        disrupted; the reverse direction still delivers (the classic
+        one-sided network failure that splits request/response paths)."""
+        disconnect = style == "disconnect"
+        for a in from_side:
+            for b in to_side:
+                self.add_rule(a, b, drop=not disconnect,
+                              disconnect=disconnect)
 
     def heal(self) -> None:
         self.clear_rules()
+
+    # -- node crash / restart ------------------------------------------------
+
+    def crash(self, node_id: str) -> None:
+        """Simulate a process crash: the node vanishes from the wire
+        (senders get connection-refused) but its in-memory state is kept
+        for restore() — a crash/restart or a long SIGSTOP pause."""
+        service = self._nodes.pop(node_id, None)
+        if service is not None:
+            self._crashed[node_id] = service
+
+    def restore(self, node_id: str) -> None:
+        """Bring a crashed node back onto the wire."""
+        service = self._crashed.pop(node_id, None)
+        if service is not None:
+            self._nodes[node_id] = service
 
     def _rule(self, sender: str, receiver: str) -> Optional[_Rule]:
         for key in ((sender, receiver), (sender, "*"), ("*", receiver)):
@@ -158,7 +203,14 @@ class InMemoryTransport:
         rule = self._rule(sender, receiver)
         if rule is not None and rule.drop:
             return  # silently dropped: timeout handles it, like a real network
+        if rule is not None and rule.disconnect:
+            # connection refused: resolves the sender promptly (and off the
+            # current stack, preserving async callback discipline)
+            self.scheduler.schedule(0.0, on_undeliverable)
+            return
         latency = self.default_latency + (rule.delay if rule else 0.0)
+        if rule is not None and rule.jitter > 0.0:
+            latency += self.random.uniform(0.0, rule.jitter)
 
         def run() -> None:
             target = self._nodes.get(receiver)

@@ -1,0 +1,103 @@
+"""Unified retry: exponential backoff with equal jitter and a deadline cap.
+
+Reference analog: action/support/RetryableAction.java:43 — one retry
+discipline for every transient-failure loop (reroute-on-stale-routing,
+peer recovery, CCR follow), replacing per-call-site fixed-delay spinners.
+Backoff is *equal jitter*: the nth retry waits ``base/2 + U(0, base/2)``
+where ``base = initial * 2**n`` (capped at ``max_delay``) — delays are
+strictly increasing until the cap, and jitter decorrelates retry storms
+across concurrent actions.
+
+Driven entirely by the Scheduler seam, so the SAME code backs off in
+wall-clock production and in seeded virtual-time simulation (where the
+DeterministicScheduler's ``random`` makes the jitter reproducible).
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Callable, List, Optional
+
+from elasticsearch_tpu.transport.scheduler import Scheduler
+
+__all__ = ["RetryableAction"]
+
+AttemptFn = Callable[[Callable[[Optional[dict], Optional[Exception]], None]],
+                     None]
+DoneFn = Callable[[Optional[dict], Optional[Exception]], None]
+
+
+class RetryableAction:
+    """Run ``attempt(cb)`` until it succeeds, fails non-retryably, or the
+    deadline passes; then call ``on_done(resp, err)`` exactly once.
+
+    ``attempt`` is callback-style (fire an async op, call ``cb(resp, err)``
+    once) so replication/recovery code adopts it without restructuring.
+    ``is_retryable(err) -> bool`` classifies failures; None retries every
+    error. Each backoff delay is appended to ``self.delays`` — observable
+    telemetry, and what the chaos suite asserts strict increase on.
+    """
+
+    def __init__(self, scheduler: Scheduler, attempt: AttemptFn,
+                 on_done: DoneFn, *,
+                 initial_delay: float = 0.25,
+                 max_delay: float = 30.0,
+                 timeout: float = 60.0,
+                 is_retryable: Optional[Callable[[Any], bool]] = None):
+        if initial_delay <= 0:
+            raise ValueError("initial_delay must be positive")
+        self.scheduler = scheduler
+        self.attempt = attempt
+        self.on_done = on_done
+        self.initial_delay = initial_delay
+        self.max_delay = max_delay
+        self.deadline = scheduler.now() + timeout
+        self.is_retryable = is_retryable
+        # seeded under the deterministic scheduler, wall-random in prod
+        self.random = getattr(scheduler, "random", None) or _random
+        self.delays: List[float] = []
+        self._n = 0
+        self._done = False
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        self._attempt_once()
+
+    def _finish(self, resp: Optional[dict], err: Optional[Exception]) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.on_done(resp, err)
+
+    def _next_delay(self) -> float:
+        base = min(self.initial_delay * (2 ** self._n), self.max_delay)
+        return base / 2.0 + self.random.uniform(0.0, base / 2.0)
+
+    def _attempt_once(self) -> None:
+        fired = {"flag": False}
+
+        def cb(resp: Optional[dict], err: Optional[Exception] = None) -> None:
+            if fired["flag"] or self._done:
+                return
+            fired["flag"] = True
+            if err is None:
+                self._finish(resp, None)
+                return
+            if self.is_retryable is not None and not self.is_retryable(err):
+                self._finish(None, err)
+                return
+            delay = self._next_delay()
+            if self.scheduler.now() + delay > self.deadline:
+                # out of budget: surface the LAST error, like the
+                # reference's onFinalFailure
+                self._finish(None, err)
+                return
+            self._n += 1
+            self.delays.append(delay)
+            self.scheduler.schedule(delay, self._attempt_once)
+
+        try:
+            self.attempt(cb)
+        except Exception as e:  # noqa: BLE001 — sync throw = failed attempt
+            cb(None, e)

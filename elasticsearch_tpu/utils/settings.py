@@ -287,6 +287,19 @@ class SettingsRegistry:
         return new_settings
 
 
+# ---------------------------------------------------------------------------
+# declared cluster settings (the registry entries services read directly
+# from committed persistent settings; TransportSearchAction consumes this
+# one per request)
+# ---------------------------------------------------------------------------
+
+# SearchService.DEFAULT_ALLOW_PARTIAL_SEARCH_RESULTS analog: the cluster-wide
+# default for requests that don't set allow_partial_search_results themselves.
+SEARCH_DEFAULT_ALLOW_PARTIAL_RESULTS: Setting[bool] = Setting.bool_setting(
+    "search.default_allow_partial_results", True,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+
 def _closest(key: str, candidates: Iterable[str]) -> Optional[str]:
     """Cheap typo suggestion: smallest prefix-distance candidate."""
     best, best_score = None, 0

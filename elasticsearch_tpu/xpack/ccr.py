@@ -404,19 +404,49 @@ class CcrService:
             logger.warning("ccr bootstrap [%s] failed: %s", follower, reason)
             st["bootstrapping"] = False   # poll retries via gap detection
 
-        def refreshed(_resp, err=None):
+        # the refresh + checkpoint-capture prologue retries with
+        # jittered-exponential backoff (utils/retry.py) through transient
+        # leader unavailability — a partitioned leader primary delays the
+        # bootstrap instead of failing it back to the next poll tick
+        def prologue(cb) -> None:
+            if not self._following(follower):
+                cb({"maxes": None}, None)   # unfollowed mid-retry: stop
+                return
+
+            def refreshed(_resp, err=None):
+                if err is not None:
+                    cb(None, err if isinstance(err, Exception)
+                       else RuntimeError(str(err)))
+                    return
+                self._fetch_max_seqnos(leader, n_shards, captured)
+
+            def captured(maxes: Dict[int, int]) -> None:
+                if any(v is None for v in maxes.values()):
+                    from elasticsearch_tpu.utils.errors import (
+                        UnavailableShardsError,
+                    )
+                    cb(None, UnavailableShardsError(
+                        f"[{leader}] max seqno unavailable"))
+                    return
+                cb({"maxes": maxes}, None)
+
+            self.node.client.refresh(leader, refreshed)
+
+        def prologue_done(resp, err) -> None:
             if err is not None:
                 fail(err)
                 return
-            self._fetch_max_seqnos(leader, n_shards, with_maxes)
-
-        def with_maxes(maxes: Dict[int, int]) -> None:
-            if any(v is None for v in maxes.values()):
-                fail("max seqno unavailable")
+            maxes = (resp or {}).get("maxes")
+            if maxes is None:
+                st["bootstrapping"] = False   # unfollowed: quiet stop
                 return
             self._scan_shards(follower, leader, n_shards, 0, {}, maxes)
 
-        self.node.client.refresh(leader, refreshed)
+        from elasticsearch_tpu.utils.retry import RetryableAction
+        RetryableAction(
+            self.node.scheduler, prologue, prologue_done,
+            initial_delay=0.5, max_delay=4.0,
+            timeout=4 * POLL_INTERVAL).run()
 
     def _fetch_max_seqnos(self, leader: str, n_shards: int, cb) -> None:
         maxes: Dict[int, Optional[int]] = {}

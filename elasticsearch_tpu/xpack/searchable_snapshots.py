@@ -50,10 +50,18 @@ class SearchableSnapshotsService:
                         **(body.get("index_settings") or {})}
 
             def blocked(_r, err2):
+                if err2 is not None:
+                    # the restored target exists WITHOUT the snapshot
+                    # marker settings ILM's copy-completion gate requires:
+                    # left in place it parks the policy forever. Tear the
+                    # target down (resize.py's marker-failure teardown)
+                    # so the mount can simply be retried.
+                    self.node.client.delete_index(
+                        target, lambda _r2, _e2: on_done(None, err2))
+                    return
                 on_done({"snapshot": {"snapshot": snap,
                                       "indices": [target],
-                                      "shards": {"failed": 0}}}
-                        if err2 is None else None, err2)
+                                      "shards": {"failed": 0}}}, None)
             self.node.client.update_settings(target, settings, blocked)
 
         self.node.snapshot_actions.restore(

@@ -27,6 +27,8 @@ import hmac
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
+from elasticsearch_tpu.utils.errors import IllegalArgumentError
+
 PBKDF2_ITERATIONS = 120_000
 
 CLUSTER_PRIVILEGES = {"all", "monitor", "manage", "manage_security"}
@@ -977,6 +979,14 @@ class SecurityService:
                 f"[{api}] cannot apply this user's document/field-level "
                 f"security; use _search")
         body = dict(request.body or {})
+        # malformed rank/sub_searches/knn container shapes must 400 here,
+        # BEFORE the wrap dereferences them — a "rank": "rrf" string or a
+        # string sub_searches entry would otherwise AttributeError/
+        # TypeError into an opaque fail-closed 403 (ADVICE r5 low)
+        from elasticsearch_tpu.action.search_action import (
+            _validate_composite_shapes,
+        )
+        _validate_composite_shapes(body)
         # the user's ORIGINAL query, captured before any DLS wrap: FLS
         # validates what the user asked to search, not the injected role
         # filter (which legitimately references restricted fields)
@@ -1149,6 +1159,10 @@ class SecurityService:
             return 403, {"error": {
                 "type": "security_exception", "reason": str(e)},
                 "status": 403}
+        except IllegalArgumentError as e:
+            # malformed request shapes are the CLIENT's error: a clear
+            # 400, consistent with the unsecured path's validation
+            return 400, {"error": e.to_json(), "status": 400}
         except Exception:  # noqa: BLE001 — a DLS failure must fail CLOSED
             self.audit.log("access_denied", user["username"], realm,
                            request.method, request.path,

@@ -1,0 +1,487 @@
+"""Chaos suite: graceful degradation of search/indexing under injected
+faults — partitions (symmetric, one-sided, refused-vs-blackholed),
+jittered latency, node crash/restart — all on the deterministic
+virtual-time harness, so every interleaving is seed-reproducible.
+
+Reference analogs: NetworkDisruption/MockTransportService-based
+disruption ITs (e.g. SearchWithRandomExceptionsIT, the reference's
+allow_partial_search_results semantics in AbstractSearchAsyncAction) and
+RetryableAction.java's jittered-exponential backoff.
+"""
+
+from elasticsearch_tpu.testing import InProcessCluster
+from elasticsearch_tpu.transport.scheduler import DeterministicScheduler
+from elasticsearch_tpu.utils.retry import RetryableAction
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _owners(cluster, index):
+    """shard id -> primary node id from the master's committed routing."""
+    irt = cluster.master().coordinator.applied_state.routing_table.index(
+        index)
+    return {sid: irt.primary(sid).node_id for sid in irt.shards}
+
+
+def _spread_cluster(n_docs=30, index="logs", shards=3, seed=11):
+    c = InProcessCluster(n_nodes=3, seed=seed)
+    c.start()
+    client = c.client()
+    _ok(*c.call(lambda cb: client.create_index(index, {
+        "settings": {"number_of_shards": shards,
+                     "number_of_replicas": 0}}, cb)))
+    c.ensure_green(index)
+    for i in range(n_docs):
+        _ok(*c.call(lambda cb, i=i: client.index_doc(
+            index, f"d{i}", {"title": f"hello world {i}", "n": i}, cb)))
+    c.call(lambda cb: client.refresh(index, cb))
+    return c
+
+
+def _pick_victim_and_coordinator(cluster, index):
+    """victim: a NON-master node owning >= 1 shard; coordinator: the other
+    non-master node — so the disruption never touches master links and
+    cluster membership stays stable throughout."""
+    master_id = cluster.master().node_id
+    owners = _owners(cluster, index)
+    non_master = [n for n in cluster.nodes if n != master_id]
+    victims = [n for n in non_master if n in owners.values()]
+    assert victims, "allocator placed no shard off-master (change the seed)"
+    victim = victims[0]
+    coordinator = next(n for n in non_master if n != victim)
+    lost = sorted(s for s, n in owners.items() if n == victim)
+    return victim, coordinator, lost
+
+
+# ---------------------------------------------------------------------------
+# partial results under partitions
+# ---------------------------------------------------------------------------
+
+def test_one_sided_partition_partial_results_and_opt_out():
+    """A search during a one-sided partition: allow_partial (the default)
+    returns 200 with the lost shards in _shards.failures; with it false
+    the same scenario is a top-level error; the cluster-wide default
+    flips the unset behavior; heal() restores full results."""
+    c = _spread_cluster()
+    try:
+        victim, coord, lost = _pick_victim_and_coordinator(c, "logs")
+        client = c.client(coord)
+        query = {"query": {"match": {"title": "hello"}}, "size": 30}
+
+        # requests coord -> victim vanish; victim -> coord still delivers
+        c.partition_one_way([coord], [victim])
+
+        resp, err = c.call(lambda cb: client.search("logs", query, cb),
+                           max_time=600.0)
+        _ok(resp, err)
+        shards = resp["_shards"]
+        assert shards["failed"] == len(lost)
+        assert sorted(f["shard"] for f in shards["failures"]) == lost
+        assert all(f["index"] == "logs" for f in shards["failures"])
+        # surviving shards still contribute hits
+        assert 0 < len(resp["hits"]["hits"]) < 30
+        assert 0 < resp["hits"]["total"]["value"] < 30
+
+        # same scenario, partial results disallowed: top-level error
+        resp, err = c.call(lambda cb: client.search(
+            "logs", {**query, "allow_partial_search_results": False}, cb),
+            max_time=600.0)
+        assert err is not None
+        assert "allow_partial_search_results" in str(err)
+
+        # the DYNAMIC cluster default governs requests that don't say
+        _ok(*c.call(lambda cb: client.cluster_update_settings(
+            {"persistent":
+             {"search.default_allow_partial_results": False}}, cb)))
+        resp, err = c.call(lambda cb: client.search("logs", query, cb),
+                           max_time=600.0)
+        assert err is not None
+        # ... and the per-request param overrides the cluster default
+        resp, err = c.call(lambda cb: client.search(
+            "logs", {**query, "allow_partial_search_results": True}, cb),
+            max_time=600.0)
+        _ok(resp, err)
+        assert resp["_shards"]["failed"] == len(lost)
+
+        # heal: full results again
+        c.heal()
+        resp, err = c.call(lambda cb: client.search("logs", query, cb),
+                           max_time=600.0)
+        _ok(resp, err)
+        assert resp["_shards"]["failed"] == 0
+        assert resp["hits"]["total"]["value"] == 30
+    finally:
+        c.stop()
+
+
+def test_all_shards_partitioned_still_errors_even_with_partial():
+    """allow_partial degrades, it does not fabricate: when EVERY shard is
+    unreachable the search still fails with the all-shards-failed error."""
+    # 2 shards over 3 nodes leaves one node shard-free — the coordinator
+    c = _spread_cluster(seed=13, shards=2)
+    try:
+        master_id = c.master().node_id
+        owners = _owners(c, "logs")
+        coord = next(n for n in c.nodes
+                     if n != master_id and n not in owners.values())
+        client = c.client(coord)
+        c.partition_one_way([coord], [n for n in c.nodes if n != coord])
+        resp, err = c.call(lambda cb: client.search(
+            "logs", {"query": {"match_all": {}}}, cb), max_time=600.0)
+        assert err is not None
+        assert "all shards failed" in str(err)
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# time budgets
+# ---------------------------------------------------------------------------
+
+def test_search_budget_expiry_returns_timed_out_partial_hits():
+    """A search whose [timeout] budget expires returns timed_out: true
+    with the hits that DID arrive; the straggler shards are accounted in
+    _shards.failures; allow_partial=false turns the same expiry into a
+    top-level error."""
+    c = _spread_cluster(index="t", seed=17)
+    try:
+        victim, coord, lost = _pick_victim_and_coordinator(c, "t")
+        client = c.client(coord)
+        # the victim's shard responses arrive long after the budget
+        c.add_latency(coord, victim, delay=30.0)
+
+        body = {"query": {"match_all": {}}, "size": 30, "timeout": "5s"}
+        resp, err = c.call(lambda cb: client.search("t", body, cb),
+                           max_time=600.0)
+        _ok(resp, err)
+        assert resp["timed_out"] is True
+        assert resp["_shards"]["failed"] == len(lost)
+        assert sorted(f["shard"] for f in resp["_shards"]["failures"]) \
+            == lost
+        assert all("budget" in f["reason"]
+                   for f in resp["_shards"]["failures"])
+        assert 0 < len(resp["hits"]["hits"]) < 30   # partial, not empty
+
+        resp, err = c.call(lambda cb: client.search(
+            "t", {**body, "allow_partial_search_results": False}, cb),
+            max_time=600.0)
+        assert err is not None
+
+        # without the disruption the same budget is ample: no timeout
+        c.heal()
+        resp, err = c.call(lambda cb: client.search("t", body, cb),
+                           max_time=600.0)
+        _ok(resp, err)
+        assert resp["timed_out"] is False
+        assert len(resp["hits"]["hits"]) == 30
+    finally:
+        c.stop()
+
+
+def test_bad_timeout_and_allow_partial_values_400():
+    c = InProcessCluster(n_nodes=1, seed=5)
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index(
+            "v", {"settings": {"number_of_shards": 1,
+                               "number_of_replicas": 0}}, cb)))
+        c.ensure_green("v")
+        for body in ({"timeout": "nope"}, {"timeout": "-2s"},
+                     {"allow_partial_search_results": "maybe"},
+                     {"rank": "rrf"},
+                     {"rank": {"rrf": "yes"}},
+                     {"sub_searches": "broken"},
+                     {"sub_searches": ["broken"]},
+                     {"knn": ["broken"]}):
+            resp, err = c.call(lambda cb, b=body: client.search("v", b, cb))
+            assert err is not None, f"accepted {body}"
+            assert getattr(err, "status", None) == 400, f"{body}: {err}"
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# task cancellation stops the fan-out
+# ---------------------------------------------------------------------------
+
+def test_cancelled_search_stops_dispatching_shard_requests():
+    c = InProcessCluster(n_nodes=1, seed=19)
+    c.start()
+    try:
+        client = c.client()
+        node = c.nodes["node0"]
+        _ok(*c.call(lambda cb: client.create_index("c3", {
+            "settings": {"number_of_shards": 3,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("c3")
+        for i in range(9):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "c3", f"d{i}", {"n": i}, cb)))
+        c.call(lambda cb: client.refresh("c3", cb))
+
+        box = []
+        client.search("c3", {"query": {"match_all": {}}, "size": 5,
+                             "max_concurrent_shard_requests": 1},
+                      lambda r, e=None: box.append((r, e)))
+        tasks = node.task_manager.list("indices:data/read/search")
+        assert len(tasks) == 1
+        node.task_manager.cancel(tasks[0].task_id, "chaos")
+        c.run_until(lambda: bool(box), 120.0)
+        resp, err = box[0]
+        assert err is not None and "cancel" in str(err).lower()
+        # only the ONE already-in-flight shard query executed; the
+        # remaining two were never dispatched
+        executed = sum(
+            node.indices_service.shard("c3", sid).search_stats["query_total"]
+            for sid in range(3))
+        assert executed <= 1
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# unified retry/backoff
+# ---------------------------------------------------------------------------
+
+def test_replication_through_disconnect_partition_heals_with_backoff():
+    """A replication op issued during a (refused-connection) partition
+    succeeds after heal() via RetryableAction, and the observed retry
+    delays are strictly increasing (jittered-exponential)."""
+    c = InProcessCluster(n_nodes=3, seed=23)
+    c.start()
+    try:
+        client0 = c.client()
+        _ok(*c.call(lambda cb: client0.create_index("w", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("w")
+        master_id = c.master().node_id
+        owner = _owners(c, "w")[0]
+        coord = next(n for n in c.nodes
+                     if n != owner and n != master_id)
+        node = c.nodes[coord]
+
+        c.partition([coord], [owner], style="disconnect")
+        c.scheduler.schedule(2.0, c.heal)
+
+        box = []
+        node.shard_bulk.execute(
+            "w", 0, [{"action": "index", "id": "k1",
+                      "source": {"v": 1}}],
+            lambda r, e=None: box.append((r, e)))
+        c.run_until(lambda: bool(box), 300.0)
+        resp, err = box[0]
+        _ok(resp, err)
+        assert resp["items"][0]["result"] == "created"
+
+        delays = node.shard_bulk.last_reroute_retry.delays
+        assert len(delays) >= 2
+        assert all(a < b for a, b in zip(delays, delays[1:])), delays
+
+        # the write is durable and visible cluster-wide after heal
+        c.call(lambda cb: client0.refresh("w", cb))
+        resp, err = c.call(lambda cb: client0.search(
+            "w", {"query": {"match_all": {}}}, cb))
+        _ok(resp, err)
+        assert resp["hits"]["total"]["value"] == 1
+    finally:
+        c.stop()
+
+
+def test_retryable_action_backoff_shape_and_deadline():
+    sched = DeterministicScheduler(seed=42)
+    attempts = []
+
+    def always_fail(cb):
+        attempts.append(sched.now())
+        cb(None, ConnectionError("nope"))
+
+    box = []
+    action = RetryableAction(sched, always_fail,
+                             lambda r, e: box.append((r, e)),
+                             initial_delay=0.25, max_delay=60.0,
+                             timeout=20.0)
+    action.run()
+    sched.run_until_idle()
+    resp, err = box[0]
+    assert resp is None and isinstance(err, ConnectionError)
+    # equal jitter over doubling bases: delays strictly increase pre-cap
+    assert len(action.delays) >= 4
+    assert all(a < b for a, b in
+               zip(action.delays, action.delays[1:])), action.delays
+    # every retry respected the deadline
+    assert all(t <= 20.0 for t in attempts)
+    # nth delay lives in [base/2, base) for base = 0.25 * 2**n
+    for n, d in enumerate(action.delays):
+        base = 0.25 * (2 ** n)
+        assert base / 2 <= d < base, (n, d)
+
+
+def test_retryable_action_non_retryable_fails_fast_and_success_stops():
+    sched = DeterministicScheduler(seed=1)
+    box = []
+    RetryableAction(
+        sched, lambda cb: cb(None, ValueError("bad request")),
+        lambda r, e: box.append((r, e)),
+        is_retryable=lambda e: isinstance(e, ConnectionError)).run()
+    sched.run_until_idle()
+    assert isinstance(box[0][1], ValueError)
+
+    # success after two transient failures: exactly 3 attempts, then done
+    state = {"n": 0}
+
+    def flaky(cb):
+        state["n"] += 1
+        if state["n"] < 3:
+            cb(None, ConnectionError("transient"))
+        else:
+            cb({"ok": True}, None)
+
+    box2 = []
+    action = RetryableAction(sched, flaky,
+                             lambda r, e: box2.append((r, e)),
+                             is_retryable=lambda e:
+                             isinstance(e, ConnectionError))
+    action.run()
+    sched.run_until_idle()
+    assert box2[0] == ({"ok": True}, None)
+    assert state["n"] == 3 and len(action.delays) == 2
+
+
+def test_retryable_action_is_seed_deterministic():
+    def run(seed):
+        sched = DeterministicScheduler(seed=seed)
+        action = RetryableAction(sched, lambda cb: cb(None, OSError("x")),
+                                 lambda r, e: None, timeout=10.0)
+        action.run()
+        sched.run_until_idle()
+        return list(action.delays)
+    assert run(7) == run(7)
+    assert run(7) != run(8)   # jitter really draws from the seeded RNG
+
+
+# ---------------------------------------------------------------------------
+# crash / restart + jittered latency chaos
+# ---------------------------------------------------------------------------
+
+def test_search_survives_replica_crash_via_failover():
+    """Crash a node holding shard copies: searches fail over to the
+    surviving copies with NO failed shards reported (failover is
+    transparent degradation), and the node rejoins after restart."""
+    c = InProcessCluster(n_nodes=3, seed=29)
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("ha", {
+            "settings": {"number_of_shards": 2,
+                         "number_of_replicas": 1}}, cb)))
+        c.ensure_green("ha")
+        for i in range(20):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "ha", f"d{i}", {"n": i}, cb)))
+        c.call(lambda cb: client.refresh("ha", cb))
+
+        master_id = c.master().node_id
+        victim = next(n for n in c.nodes if n != master_id)
+        coord = next(n for n in c.nodes
+                     if n != master_id and n != victim)
+        c.crash_node(victim)
+
+        resp, err = c.call(lambda cb: c.client(coord).search(
+            "ha", {"query": {"match_all": {}}, "size": 25}, cb),
+            max_time=600.0)
+        _ok(resp, err)
+        assert resp["hits"]["total"]["value"] == 20
+        assert resp["_shards"]["failed"] == 0   # failover covered it
+
+        c.restart_node(victim)
+        c.await_node_count(3)
+        resp, err = c.call(lambda cb: c.client(coord).search(
+            "ha", {"query": {"match_all": {}}, "size": 25}, cb),
+            max_time=600.0)
+        _ok(resp, err)
+        assert resp["hits"]["total"]["value"] == 20
+    finally:
+        c.stop()
+
+
+def test_jittered_latency_is_seeded_and_search_correct():
+    """Jittered link latency perturbs the interleaving without breaking
+    results, and identical seeds reproduce identical virtual timings."""
+    def run(seed):
+        c = InProcessCluster(n_nodes=3, seed=seed)
+        c.start()
+        try:
+            client = c.client()
+            _ok(*c.call(lambda cb: client.create_index("j", {
+                "settings": {"number_of_shards": 3,
+                             "number_of_replicas": 0}}, cb)))
+            c.ensure_green("j")
+            for i in range(12):
+                _ok(*c.call(lambda cb, i=i: client.index_doc(
+                    "j", f"d{i}", {"n": i}, cb)))
+            c.call(lambda cb: client.refresh("j", cb))
+            for a in c.nodes:
+                for b in c.nodes:
+                    if a != b:
+                        c.add_latency(a, b, delay=0.05, jitter=0.2)
+            resp, err = c.call(lambda cb: client.search(
+                "j", {"query": {"match_all": {}}, "size": 12}, cb),
+                max_time=600.0)
+            _ok(resp, err)
+            assert resp["hits"]["total"]["value"] == 12
+            assert resp["_shards"]["failed"] == 0
+            return c.scheduler.now()
+        finally:
+            c.stop()
+
+    assert run(31) == run(31)   # same seed, same virtual trace
+
+
+# ---------------------------------------------------------------------------
+# CCS degradation: skip_unavailable
+# ---------------------------------------------------------------------------
+
+def test_ccs_skip_unavailable_degrades_instead_of_failing():
+    """With cluster.remote.<alias>.skip_unavailable=true an unreachable
+    remote is reported as a skipped cluster and the local results still
+    return; with it false (default) the federated search fails."""
+    c = InProcessCluster(n_nodes=1, seed=37)
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("local_idx", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("local_idx")
+        _ok(*c.call(lambda cb: client.index_doc(
+            "local_idx", "d1", {"v": 1}, cb)))
+        c.call(lambda cb: client.refresh("local_idx", cb))
+        # a configured-but-unreachable remote (no TCP transport here, so
+        # every send to it fails — the degradation path under test)
+        _ok(*c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {
+                "cluster.remote.far.seeds": "127.0.0.1:1"}}, cb)))
+
+        resp, err = c.call(lambda cb: client.search(
+            "local_idx,far:other", {"query": {"match_all": {}}}, cb))
+        assert err is not None   # default: the whole search fails
+
+        _ok(*c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {
+                "cluster.remote.far.skip_unavailable": True}}, cb)))
+        node = c.nodes["node0"]
+        assert node.remote_clusters.info()["far"]["skip_unavailable"] \
+            is True
+        resp, err = c.call(lambda cb: client.search(
+            "local_idx,far:other", {"query": {"match_all": {}}}, cb))
+        _ok(resp, err)
+        assert resp["_clusters"] == {"total": 2, "successful": 1,
+                                     "skipped": 1}
+        assert resp["hits"]["total"]["value"] == 1
+    finally:
+        c.stop()

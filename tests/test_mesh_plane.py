@@ -276,3 +276,42 @@ def test_mesh_build_cost_is_observable(cluster):
     _ok(r, err)
     assert stats["mesh_builds"] == before + 1
     assert stats["last_build_docs"] == 31
+
+
+def test_mesh_knn_total_clamped_to_hits_returned():
+    """ADVICE r5 medium: the kNN hit window (size+from) is not bounded by
+    query.k, so the reported total must clamp to at least the number of
+    hits actually returned — hits > total is an incoherent response no
+    other plane produces. Drives search_knn against a stub vector index
+    so the invariant is tested without mesh hardware."""
+    from types import SimpleNamespace
+
+    from elasticsearch_tpu.parallel.mesh_plane import MeshDataPlane
+    from elasticsearch_tpu.search import dsl
+
+    plane = MeshDataPlane(mesh=object())   # "available" without devices
+    n_docs = 1000
+
+    class StubVectorIndex:
+        n_docs = 1000
+
+        def search(self, qv, k):
+            scores = np.linspace(2.0, 1.0, k, dtype=np.float32)[None, :]
+            ids = np.arange(k, dtype=np.int32)[None, :]
+            return scores, ids
+
+    id_map = (np.zeros(n_docs, np.int32), np.zeros(n_docs, np.int32),
+              np.arange(n_docs, dtype=np.int32))
+    shard_counts = np.array([n_docs])
+    plane._vector_index = lambda *a: (StubVectorIndex(), id_map,
+                                      shard_counts)
+    shard = SimpleNamespace(engine=SimpleNamespace(
+        acquire_reader=lambda: None))
+    query = dsl.Knn(field="vec", query_vector=[0.0, 1.0], k=10)
+
+    result = plane.search_knn("idx", "vec", {0: shard},
+                              {"size": 100}, query)
+    assert len(result["hits"]) == 100
+    # pre-fix: total = min(1000, k=10) = 10 < 100 hits
+    assert result["total"] >= len(result["hits"])
+    assert result["relation"] == "eq"

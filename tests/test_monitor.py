@@ -50,6 +50,22 @@ def test_bootstrap_checks(tmp_path, monkeypatch):
     monitor.run_bootstrap_checks(str(blocked))   # warns, returns
 
 
+def test_device_stats_survive_private_api_removal(monkeypatch):
+    """ADVICE r5 low: the backends_are_initialized guard lives in
+    jax._src — private, free to move in any jax upgrade. When the lookup
+    breaks, device_stats must fall through to jax.devices() (mirroring
+    mesh_plane's ready=True fallback), not silently report no devices
+    forever while a backend is live."""
+    import jax
+
+    jax.devices()   # ensure the backend is LIVE (conftest pins cpu)
+    from jax._src import xla_bridge
+    # simulate the private API vanishing in a future jax
+    monkeypatch.delattr(xla_bridge, "backends_are_initialized")
+    d = monitor.device_stats()
+    assert len(d["devices"]) > 0   # pre-fix: always []
+
+
 def test_node_stats_include_probes(tmp_path):
     from elasticsearch_tpu.testing import InProcessCluster
     c = InProcessCluster(n_nodes=1, seed=73, data_path=str(tmp_path))
@@ -69,19 +85,20 @@ def test_deprecation_warnings_and_ilm_explain(tmp_path):
     (HeaderWarning analog), and /{index}/_ilm/explain reports the phase
     machine's view."""
     import json
+    import re
     import signal
-    import socket
     import subprocess
     import sys
     import time
     import urllib.request
 
-    s = socket.socket(); s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]; s.close()
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # port 0: the SERVER binds an ephemeral port and prints it — no
+    # probe-close-rebind race with concurrent suites (VERDICT Weak #9)
     proc = subprocess.Popen(
-        [sys.executable, "-m", "elasticsearch_tpu.rest.server", str(port)],
+        [sys.executable, "-m", "elasticsearch_tpu.rest.server", "0"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    port = None
 
     def req(method, path, body=None):
         data = json.dumps(body).encode() if body is not None else None
@@ -93,6 +110,13 @@ def test_deprecation_warnings_and_ilm_explain(tmp_path):
 
     try:
         deadline = time.monotonic() + 120
+        while port is None:
+            line = proc.stdout.readline().decode("utf-8", "replace")
+            m = re.search(r"http://127\.0\.0\.1:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+            elif proc.poll() is not None or time.monotonic() > deadline:
+                raise AssertionError(f"server did not report a port: {line}")
         while True:
             try:
                 req("GET", "/_cluster/health"); break

@@ -457,3 +457,19 @@ def test_filter_cache_is_bounded(svc):
         svc.search({"query": {"bool": {"filter": [
             {"term": {"tag": f"nonexistent-{i}"}}]}}})
     assert len(seg._filter_cache) <= Segment.FILTER_CACHE_CAP
+
+
+def test_timeout_budget_makes_timed_out_reachable(svc):
+    """The [timeout] request budget is honored at the collection
+    boundary: a vanishingly small budget reports timed_out true
+    (previously hardcoded false), an ample one reports false; junk and
+    non-positive values 400 at ENTRY, matching the coordinator path."""
+    r = svc.search({"query": {"match_all": {}}, "timeout": 1e-12})
+    assert r["timed_out"] is True
+    assert r["hits"]["total"]["value"] > 0   # partial-not-empty semantics
+    r = svc.search({"query": {"match_all": {}}, "timeout": "30s"})
+    assert r["timed_out"] is False
+    from elasticsearch_tpu.utils.errors import IllegalArgumentError
+    for bad in ("soon", "0ms", "-1s"):
+        with pytest.raises(IllegalArgumentError):
+            svc.search({"query": {"match_all": {}}, "timeout": bad})

@@ -112,3 +112,42 @@ def test_frozen_search_evicts_device_caches(cluster, tmp_path):
         for seg in reader.segments:
             assert not seg._device_cache
             assert not seg._filter_cache
+
+
+def test_mount_marker_write_failure_tears_down_target(cluster, tmp_path):
+    """ADVICE r5 low: if the post-restore settings write (the snapshot
+    marker ILM's copy-completion gate needs) fails, mount() must delete
+    the restored target — like resize.py's teardown — so the operation
+    can simply be retried instead of parking ILM forever behind a
+    half-mounted index."""
+    client = cluster.client()
+    _seed(cluster, client, tmp_path)
+    node = cluster.master()
+
+    from elasticsearch_tpu.utils.errors import SearchEngineError
+    real_update = node.client.update_settings
+
+    def failing_update(index, settings, on_done):
+        if index == "mounted2":
+            on_done(None, SearchEngineError("injected marker failure"))
+            return
+        real_update(index, settings, on_done)
+
+    node.client.update_settings = failing_update
+    try:
+        resp, err = cluster.call(lambda cb: node.searchable_snapshots.mount(
+            "repo1", "snap1", {"index": "src",
+                               "renamed_index": "mounted2"}, cb))
+        assert err is not None and "injected" in str(err)
+        # pre-fix: the half-mounted target lingered without its marker
+        state = cluster.master().coordinator.applied_state
+        assert not state.metadata.has_index("mounted2")
+    finally:
+        node.client.update_settings = real_update
+
+    # with the failure gone the SAME mount simply retries to success
+    resp, err = cluster.call(lambda cb: node.searchable_snapshots.mount(
+        "repo1", "snap1", {"index": "src",
+                           "renamed_index": "mounted2"}, cb))
+    assert err is None
+    assert resp["snapshot"]["indices"] == ["mounted2"]

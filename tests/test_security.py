@@ -966,3 +966,63 @@ def test_r5_api_key_caller_scoped_to_itself(tmp_path):
             {ka["id"], keys["key-b"]["id"]}
     finally:
         c.stop()
+
+
+def test_malformed_retriever_shapes_400_not_500_under_dls():
+    """ADVICE r5 low: malformed rank/sub_searches/knn container shapes in
+    a DLS-wrapped search must surface as a clear 400, not crash the wrap
+    into an opaque failure (pre-fix: AttributeError/TypeError inside
+    _apply_dls)."""
+    import base64
+
+    from elasticsearch_tpu.rest.controller import RestRequest
+
+    c = InProcessCluster(n_nodes=1, seed=59)
+    c.start()
+    try:
+        client = c.client()
+        r, e = c.call(lambda cb: client.create_index("secret", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {"team": {"type": "keyword"}}}},
+            cb))
+        assert e is None
+        c.ensure_green("secret")
+        r, e = c.call(lambda cb: client.put_security_role("filtered", {
+            "indices": [{"names": ["secret"], "privileges": ["read"],
+                         "query": {"term": {"team": "red"}}}]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.put_security_user("dee", {
+            "password": "deepass", "roles": ["filtered"]}, cb))
+        assert e is None
+        r, e = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"xpack.security.enabled": True}}, cb))
+        assert e is None
+
+        node = c.master()
+        auth = {"authorization": "Basic " + base64.b64encode(
+            b"dee:deepass").decode()}
+
+        def check(body):
+            return node.security.check(RestRequest(
+                method="POST", path="/secret/_search", query={},
+                body=body, raw_body=b"", headers=dict(auth)))
+
+        for body in ({"rank": "rrf"},
+                     {"rank": {"rrf": "on"}},
+                     {"rank": {"rrf": {}}, "sub_searches": "broken"},
+                     {"rank": {"rrf": {}}, "sub_searches": ["broken"]},
+                     {"rank": {"rrf": {}}, "knn": ["broken"]}):
+            denied = check(body)
+            assert denied is not None, f"accepted {body}"
+            status, payload = denied
+            assert status == 400, f"{body} -> {denied}"
+            assert payload["error"]["type"] == "illegal_argument_exception"
+
+        # well-formed requests still pass (and get wrapped)
+        req = RestRequest(method="POST", path="/secret/_search", query={},
+                          body={"query": {"match_all": {}}},
+                          raw_body=b"", headers=dict(auth))
+        assert node.security.check(req) is None
+        assert "filter" in req.body["query"]["bool"]
+    finally:
+        c.stop()
