@@ -31,6 +31,7 @@ from elasticsearch_tpu.transport.transport import Deferred, TransportService
 from elasticsearch_tpu.utils.errors import (
     IllegalArgumentError, IndexNotFoundError, NotMasterError,
 )
+from elasticsearch_tpu.utils.retry import RetryableAction
 
 CREATE_INDEX = "indices:admin/create"
 DELETE_INDEX = "indices:admin/delete"
@@ -912,59 +913,59 @@ class MasterActions:
 
     def _on_shard_failed(self, req: Dict[str, Any], sender: str) -> Deferred:
         sr = ShardRouting.from_dict(req["shard"])
+        reason = req.get("reason")
 
         def update(state: ClusterState) -> ClusterState:
-            return self.allocation.apply_failed_shard(state, sr)
+            return self.allocation.apply_failed_shard(state, sr,
+                                                      reason=reason)
         return self._submit(f"shard-failed {sr.index}[{sr.shard_id}]",
                             update)
 
 
 class MasterClient:
     """Coordinator-side: route a request to the elected master, retrying
-    through elections (TransportMasterNodeAction's retry-on-master-change)."""
+    through elections (TransportMasterNodeAction's retry-on-master-change).
+
+    Retries run through the unified RetryableAction (utils/retry.py):
+    jittered-exponential backoff decorrelates the no-master retry storm a
+    whole cluster produces during an election, instead of every caller
+    re-polling on the same fixed beat."""
 
     def __init__(self, ts: TransportService, coordinator: Coordinator):
         self.ts = ts
         self.coordinator = coordinator
+        # the most recent retry loop, observable for tests/telemetry
+        self.last_retry: Optional["RetryableAction"] = None
+
+    @staticmethod
+    def _is_retryable(err: Exception) -> bool:
+        # stale master pointer or mid-election: keep retrying until a new
+        # master commits (TransportMasterNodeAction retry). Timeouts are
+        # NOT retried — master actions include non-idempotent mutations.
+        from elasticsearch_tpu.utils.retry import transient_cluster_error
+        return transient_cluster_error(err)
 
     def execute(self, action: str, request: Dict[str, Any],
                 on_done: Callable[[Optional[Dict[str, Any]],
                                    Optional[Exception]], None],
                 timeout: float = MASTER_TIMEOUT) -> None:
         scheduler = self.coordinator.scheduler
-        deadline = scheduler.now() + timeout
 
-        def attempt() -> None:
+        def attempt(cb) -> None:
             master = self.coordinator.applied_state.master_node_id
             if self.coordinator.mode == "LEADER":
                 master = self.coordinator.node.node_id
             if master is None:
-                retry(NotMasterError("no elected master"))
+                cb(None, NotMasterError("no elected master"))
                 return
-            self.ts.send_request(master, action, request, on_response,
+            self.ts.send_request(master, action, request, cb,
                                  timeout=timeout)
 
-        def on_response(resp, err) -> None:
-            from elasticsearch_tpu.transport.transport import (
-                NodeNotConnectedError,
-            )
-            if err is not None and (
-                    "NotMasterError" in str(err)
-                    or isinstance(err, NodeNotConnectedError)):
-                # stale master pointer or mid-election: keep retrying until
-                # a new master commits (TransportMasterNodeAction retry)
-                retry(err)
-                return
-            on_done(resp, err)
-
-        def retry(err) -> None:
-            if scheduler.now() >= deadline:
-                on_done(None, err if isinstance(err, Exception)
-                        else NotMasterError(str(err)))
-            else:
-                scheduler.schedule(MASTER_RETRY_DELAY, attempt)
-
-        attempt()
+        self.last_retry = RetryableAction(
+            scheduler, attempt, on_done,
+            initial_delay=MASTER_RETRY_DELAY, max_delay=5.0,
+            timeout=timeout, is_retryable=self._is_retryable)
+        self.last_retry.run()
 
 
 class BroadcastActions:

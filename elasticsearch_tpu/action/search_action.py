@@ -245,6 +245,35 @@ class SearchTransportService:
                 f"shard query [{req['index']}][{req['shard']}]",
                 cancellable=True,
                 parent_task_id=req.get("task_id"))
+        # the request [timeout] budget binds SHARD-SIDE too: the budget
+        # REMAINING at dispatch rides the wire (a duration, not an
+        # absolute timestamp — monotonic clocks don't compare across OS
+        # processes) and the local deadline it implies is checked between
+        # segments exactly where cancellation is, so a slow shard stops
+        # collecting instead of only being abandoned by the coordinator's
+        # timer
+        checks = []
+        if shard_task is not None:
+            checks.append(shard_task.ensure_not_cancelled)
+        remaining = req.get("budget_remaining")
+        if remaining is not None:
+            scheduler = self.ts.transport.scheduler
+            shard_deadline = scheduler.now() + float(remaining)
+
+            def ensure_budget(deadline=shard_deadline,
+                              scheduler=scheduler):
+                if scheduler.now() >= deadline:
+                    from elasticsearch_tpu.utils.errors import (
+                        SearchBudgetExceededError,
+                    )
+                    raise SearchBudgetExceededError(
+                        f"search budget expired while querying "
+                        f"[{req['index']}][{req['shard']}]")
+            checks.append(ensure_budget)
+
+        def cancel_check() -> None:
+            for check in checks:
+                check()
         try:
             result = query_shard(
                 reader, shard.engine.mappers, query,
@@ -261,8 +290,7 @@ class SearchTransportService:
                 slice_spec=body.get("slice"),
                 profile=bool(body.get("profile")),
                 terminate_after=body.get("terminate_after"),
-                cancel_check=(shard_task.ensure_not_cancelled
-                              if shard_task else None))
+                cancel_check=cancel_check if checks else None)
         finally:
             if shard_task is not None:
                 self.task_manager.unregister(shard_task)
@@ -857,6 +885,13 @@ class TransportSearchAction:
                    "body": shard_body, "window": window}
             if phase_state.get("task_id"):
                 req["task_id"] = phase_state["task_id"]
+            if phase_state.get("deadline") is not None:
+                # shard-side budget enforcement: ship the time LEFT at
+                # dispatch (durations survive process boundaries;
+                # absolute monotonic timestamps don't)
+                req["budget_remaining"] = max(
+                    0.0, phase_state["deadline"] -
+                    self.ts.transport.scheduler.now())
             if dfs_overrides:
                 req.update(dfs_overrides)
             copies = target.get("copies", [target["node"]])

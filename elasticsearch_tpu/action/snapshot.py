@@ -21,7 +21,7 @@ from elasticsearch_tpu.repositories import (
 )
 from elasticsearch_tpu.transport.transport import TransportService
 from elasticsearch_tpu.utils.errors import (
-    IllegalArgumentError, SearchEngineError,
+    IllegalArgumentError, SearchEngineError, ShardCorruptedError,
 )
 
 SNAPSHOT_SHARD = "cluster:admin/snapshot/shard"
@@ -43,6 +43,14 @@ class SnapshotShardActions:
         shard = self.indices.shard(req["index"], req["shard"])
         repo = FsRepository(req["location"])
         engine = shard.engine
+        # never snapshot a copy whose storage is suspect — a backup of a
+        # corrupted shard poisons every later restore
+        if engine.failed:
+            raise ShardCorruptedError(
+                f"shard [{req['index']}][{req['shard']}] has a failed "
+                f"engine: {engine.failure_reason}")
+        if engine.store is not None:
+            engine.store.ensure_not_corrupted()
         engine.refresh()
         reader = engine.acquire_reader()
         blobs: List[str] = []

@@ -351,12 +351,15 @@ class AllocationService:
 
     def apply_failed_shard(self, state: ClusterState,
                            failed: ShardRouting,
-                           count_failure: bool = True) -> ClusterState:
+                           count_failure: bool = True,
+                           reason: Optional[str] = None) -> ClusterState:
         """Failed primary: promote an active replica, then schedule a new
         replica copy; failed replica: back to unassigned (reference:
         NodeRemovalClusterStateTaskExecutor → AllocationService.reroute).
         ``count_failure=False`` for operator-initiated cancels, which must
-        not consume the MaxRetryDecider budget."""
+        not consume the MaxRetryDecider budget. ``reason`` is recorded on
+        the unassigned copy (UnassignedInfo details) so allocation
+        explain can answer *why* — e.g. a corrupted store."""
         routing = state.routing_table
         irt = routing.index(failed.index)
         current = next((sr for sr in irt.shard_group(failed.shard_id)
@@ -364,7 +367,7 @@ class AllocationService:
                         sr.allocation_id is not None), None)
         if current is None:
             return state
-        dropped = current.fail()
+        dropped = current.fail(reason)
         if not count_failure:
             dropped = replace(dropped,
                               failed_attempts=current.failed_attempts)
@@ -398,5 +401,6 @@ class AllocationService:
         for nid in dead_set:
             for shard in list(out.routing_table.shards_on_node(nid)):
                 if shard.node_id in dead_set:
-                    out = self.apply_failed_shard(out, shard)
+                    out = self.apply_failed_shard(
+                        out, shard, reason=f"node [{nid}] left the cluster")
         return out

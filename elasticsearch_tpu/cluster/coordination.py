@@ -691,8 +691,25 @@ class Coordinator:
         joining = DiscoveryNode.from_dict(req["node"])
 
         def add(state: ClusterState) -> ClusterState:
-            if joining.node_id in state.nodes:
-                return state
+            existing = state.nodes.get(joining.node_id)
+            if existing is not None:
+                if existing.ephemeral_id == joining.ephemeral_id:
+                    # the same running process re-sent its join (e.g. a
+                    # one-way partition keeps triggering its pre-vote →
+                    # rejoin path): a pure duplicate, and it must stay a
+                    # NO-OP or one flapping node drives unbounded
+                    # publication churn
+                    return state
+                # a NEW ephemeral id = the process restarted: its
+                # in-memory state is whatever the gateway persisted (with
+                # routing reset). Replace the entry — the version bump
+                # makes the next publication re-deliver the full
+                # committed state; otherwise nothing publishes and the
+                # rebooted node serves stale state forever (the
+                # reference's JoinTaskExecutor + ephemeral-id semantics)
+                return state.with_nodes(
+                    {**state.nodes, joining.node_id: joining},
+                    state.master_node_id)
             state = state.with_nodes(
                 {**state.nodes, joining.node_id: joining},
                 self.node.node_id)

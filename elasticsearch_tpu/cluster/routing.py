@@ -37,6 +37,10 @@ class ShardRouting:
     # analog) — MaxRetryDecider stops retry storms; reset by an explicit
     # reroute with retry_failed
     failed_attempts: int = 0
+    # why the last copy failed (UnassignedInfo.getDetails analog) —
+    # surfaced by _cluster/allocation/explain so operators can see e.g.
+    # a corruption marker keeping a shard red
+    unassigned_reason: Optional[str] = None
 
     @property
     def active(self) -> bool:
@@ -56,17 +60,20 @@ class ShardRouting:
         # a successful start clears the failure streak: MaxRetryDecider
         # counts CONSECUTIVE failures (UnassignedInfo is discarded once a
         # shard starts in the reference)
-        return replace(self, state=ShardState.STARTED, failed_attempts=0)
+        return replace(self, state=ShardState.STARTED, failed_attempts=0,
+                       unassigned_reason=None)
 
     def relocate(self, target_node: str) -> "ShardRouting":
         assert self.state == ShardState.STARTED
         return replace(self, state=ShardState.RELOCATING,
                        relocating_node_id=target_node)
 
-    def fail(self) -> "ShardRouting":
+    def fail(self, reason: Optional[str] = None) -> "ShardRouting":
         return ShardRouting(index=self.index, shard_id=self.shard_id,
                             primary=self.primary,
-                            failed_attempts=self.failed_attempts + 1)
+                            failed_attempts=self.failed_attempts + 1,
+                            unassigned_reason=reason or
+                            self.unassigned_reason)
 
     def promote_to_primary(self) -> "ShardRouting":
         return replace(self, primary=True)
@@ -77,7 +84,8 @@ class ShardRouting:
                 "node": self.node_id,
                 "relocating_node": self.relocating_node_id,
                 "allocation_id": self.allocation_id,
-                "failed_attempts": self.failed_attempts}
+                "failed_attempts": self.failed_attempts,
+                "unassigned_reason": self.unassigned_reason}
 
     @staticmethod
     def from_dict(d: Mapping[str, Any]) -> "ShardRouting":
@@ -87,7 +95,8 @@ class ShardRouting:
                             node_id=d.get("node"),
                             relocating_node_id=d.get("relocating_node"),
                             allocation_id=d.get("allocation_id"),
-                            failed_attempts=d.get("failed_attempts", 0))
+                            failed_attempts=d.get("failed_attempts", 0),
+                            unassigned_reason=d.get("unassigned_reason"))
 
 
 @dataclass(frozen=True)

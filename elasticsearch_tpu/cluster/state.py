@@ -32,6 +32,11 @@ class DiscoveryNode:
     # node attributes for awareness/filter allocation (node.attr.* —
     # DiscoveryNode.getAttributes analog); frozen tuple of (key, value)
     attrs: Tuple[Tuple[str, str], ...] = ()
+    # per-boot identity (DiscoveryNode.getEphemeralId analog): a fresh
+    # value every process start, so a rejoin can distinguish "the same
+    # running process re-sent its join" (no-op) from "the process
+    # restarted" (replace the entry + republish the full state)
+    ephemeral_id: str = ""
 
     def attr(self, key: str) -> Optional[str]:
         for k, v in self.attrs:
@@ -52,6 +57,8 @@ class DiscoveryNode:
                "roles": sorted(self.roles), "address": self.address}
         if self.attrs:
             out["attributes"] = dict(self.attrs)
+        if self.ephemeral_id:
+            out["ephemeral_id"] = self.ephemeral_id
         return out
 
     @staticmethod
@@ -60,7 +67,8 @@ class DiscoveryNode:
                              roles=frozenset(d.get("roles", Roles.ALL)),
                              address=d.get("address", "local"),
                              attrs=tuple(sorted(
-                                 d.get("attributes", {}).items())))
+                                 d.get("attributes", {}).items())),
+                             ephemeral_id=d.get("ephemeral_id", ""))
 
 
 @dataclass(frozen=True)

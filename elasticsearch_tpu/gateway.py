@@ -90,11 +90,18 @@ def _reset_routing(state: ClusterState) -> ClusterState:
     from elasticsearch_tpu.cluster.routing import (
         IndexRoutingTable, RoutingTable,
     )
+    import uuid as uuid_mod
     fresh = {}
     for name in state.metadata.indices:
         im = state.metadata.index(name)
         fresh[name] = IndexRoutingTable.new(
             name, im.number_of_shards, im.number_of_replicas)
+    # a NEW state_uuid is essential: the content changed, and the diff
+    # publication protocol keys section reuse on uuid identity — keeping
+    # the old uuid would let a master's diff silently skip the routing
+    # section on a rebooted member, leaving it permanently diverged (the
+    # need_full fallback only triggers on uuid mismatch)
     return replace(state,
                    routing_table=RoutingTable(indices=fresh),
-                   nodes={}, master_node_id=None)
+                   nodes={}, master_node_id=None,
+                   state_uuid=uuid_mod.uuid4().hex)

@@ -36,6 +36,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Dict, Optional
 
+from elasticsearch_tpu.utils.retry import retry_transient
 from elasticsearch_tpu.utils.settings import parse_time_to_seconds
 
 logger = logging.getLogger(__name__)
@@ -79,6 +80,11 @@ class IndexLifecycleService:
         self.node = node
         self._running = False
         self._timer = None
+        # step keys with an in-flight retry loop: the poll tick must not
+        # stack a second loop for the same index/step while one is still
+        # backing off (non-idempotent steps like rollover would execute
+        # once per stacked loop when the control plane recovers)
+        self._inflight: set = set()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -147,6 +153,28 @@ class IndexLifecycleService:
 
     # -- per-index step machine ------------------------------------------
 
+    def _step(self, key: str, attempt, on_done=None) -> None:
+        """Run one lifecycle action through the unified RetryableAction:
+        transient control-plane failures (no master mid-election,
+        unreachable node) retry with jittered backoff inside the tick
+        instead of silently waiting out a whole poll interval
+        (IndexLifecycleRunner's step-retry discipline). ``attempt(cb)``
+        fires the async client call; non-transient errors surface to
+        ``on_done`` (default: logged). ``key`` (index:step) dedupes:
+        while one loop is still backing off, later poll ticks skip the
+        step rather than stacking a second loop that would re-execute a
+        non-idempotent action on recovery."""
+        if key in self._inflight:
+            return
+        self._inflight.add(key)
+        inner = on_done or _log_err
+
+        def finished(resp, err) -> None:
+            self._inflight.discard(key)
+            inner(resp, err)
+
+        retry_transient(self.node.scheduler, attempt, finished)
+
     def _advance(self, meta, phases: Dict[str, Any], now_ms: float,
                  stream: Optional[tuple]) -> None:
         hot = (phases.get("hot") or {}).get("actions") or {}
@@ -166,23 +194,29 @@ class IndexLifecycleService:
         # hot: rollover the alias or data stream this index writes for
         alias = meta.settings.get("index.lifecycle.rollover_alias")
         if rollover is not None and alias and alias in meta.aliases:
-            self.node.client.rollover(
-                alias, {"conditions": dict(rollover)}, _log_err)
+            self._step(f"{meta.name}:rollover",
+                       lambda cb: self.node.client.rollover(
+                           alias, {"conditions": dict(rollover)}, cb))
         elif rollover is not None and stream is not None and stream[1]:
-            self.node.client.rollover(
-                stream[0], {"conditions": dict(rollover)}, _log_err)
+            self._step(f"{meta.name}:rollover",
+                       lambda cb: self.node.client.rollover(
+                           stream[0], {"conditions": dict(rollover)},
+                           cb))
 
     def _run_delete(self, meta, _actions, _stream) -> None:
         logger.info("ilm: deleting [%s] (delete phase)", meta.name)
-        self.node.client.delete_index(meta.name, _log_err)
+        self._step(f"{meta.name}:delete",
+                   lambda cb: self.node.client.delete_index(meta.name,
+                                                            cb))
 
     def _run_warm(self, meta, actions: Dict[str, Any], stream) -> None:
         """One warm step per pass: readonly -> forcemerge -> shrink."""
         client = self.node.client
         if "readonly" in actions and \
                 not meta.settings.get("index.blocks.write"):
-            client.update_settings(meta.name,
-                                   {"index.blocks.write": True}, _log_err)
+            self._step(f"{meta.name}:readonly",
+                       lambda cb: client.update_settings(
+                           meta.name, {"index.blocks.write": True}, cb))
             return
         if "forcemerge" in actions and \
                 not meta.settings.get("index.lifecycle.forcemerged"):
@@ -191,12 +225,17 @@ class IndexLifecycleService:
 
             def mark(_r, err):
                 if err is None:
-                    client.update_settings(
-                        meta.name,
-                        {"index.lifecycle.forcemerged": True}, _log_err)
+                    self._step(f"{meta.name}:forcemerged-mark",
+                               lambda cb: client.update_settings(
+                                   meta.name,
+                                   {"index.lifecycle.forcemerged": True},
+                                   cb))
                 else:
                     _log_err(None, err)
-            client.force_merge(meta.name, mark, max_num_segments=segs)
+            self._step(f"{meta.name}:forcemerge",
+                       lambda cb: client.force_merge(
+                           meta.name, cb, max_num_segments=segs),
+                       on_done=mark)
             return
         if "shrink" in actions and \
                 not meta.settings.get("index.lifecycle.shrink_source"):
@@ -204,8 +243,10 @@ class IndexLifecycleService:
             state = self.node._applied_state()
             if not meta.settings.get("index.blocks.write"):
                 # shrink requires the write block even without readonly
-                client.update_settings(
-                    meta.name, {"index.blocks.write": True}, _log_err)
+                self._step(f"{meta.name}:shrink-block",
+                           lambda cb: client.update_settings(
+                               meta.name, {"index.blocks.write": True},
+                               cb))
                 return
             if state.metadata.has_index(target):
                 if self._copy_done(state, target,
@@ -236,8 +277,10 @@ class IndexLifecycleService:
         if spec is None:
             # cold without searchable_snapshot: just ensure read-only
             if not meta.settings.get("index.blocks.write"):
-                self.node.client.update_settings(
-                    meta.name, {"index.blocks.write": True}, _log_err)
+                self._step(f"{meta.name}:cold-readonly",
+                           lambda cb: self.node.client.update_settings(
+                               meta.name, {"index.blocks.write": True},
+                               cb))
             return
         if meta.settings.get("index.store.snapshot.repository_name"):
             return   # already mounted (this IS the restored index)
@@ -256,15 +299,22 @@ class IndexLifecycleService:
             return
         if not meta.settings.get("index.lifecycle.snapshot_started"):
             def started(_r, err):
-                if err is None:
-                    client.update_settings(
-                        meta.name,
-                        {"index.lifecycle.snapshot_started": snap},
-                        _log_err)
+                # "already exists" means a previous attempt succeeded but
+                # its ack was lost (e.g. a timed-out round-trip): the
+                # deterministic name makes the step idempotent, so adopt
+                # the existing snapshot instead of wedging the phase
+                if err is None or "already exists" in str(err):
+                    self._step(f"{meta.name}:snapshot-mark",
+                               lambda cb: client.update_settings(
+                                   meta.name,
+                                   {"index.lifecycle.snapshot_started":
+                                    snap}, cb))
                 else:
                     _log_err(None, err)
-            client.create_snapshot(repo, snap,
-                                   {"indices": meta.name}, started)
+            self._step(f"{meta.name}:snapshot",
+                       lambda cb: client.create_snapshot(
+                           repo, snap, {"indices": meta.name}, cb),
+                       on_done=started)
             return
         # snapshot taken: mount it back under the restored name, keeping
         # the policy so the delete phase still applies to the mount

@@ -1,0 +1,96 @@
+"""Checksummed disk IO: CRC32 footers + the fault-injection seam.
+
+Reference analog: Lucene's CodecUtil.writeFooter/checkFooter (every
+segment file ends in a magic + CRC32 trailer that readers verify) and the
+reference Store's verifying IndexInput. Every on-disk artifact of a shard
+(segment arrays, segment meta, live masks, commit points, corruption
+markers) is written as ``payload + footer`` through one ``DiskIO`` object,
+and read back through the same object with the footer verified — a
+mismatch raises :class:`ShardCorruptedError` instead of surfacing as an
+arbitrary parse error (or worse, silent wrong results).
+
+``DiskIO`` is also the chaos seam: the test harness subclasses it to
+inject seeded bit-flips, tail truncation, and ``EIO``/``ENOSPC`` write
+failures underneath ``Store``/``Translog`` without touching engine code
+(the MockDirectoryWrapper role of the reference test framework).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from pathlib import Path
+
+from elasticsearch_tpu.utils.errors import ShardCorruptedError
+
+# footer: 4-byte magic + little-endian CRC32 of everything before it
+FOOTER_MAGIC = b"ESCK"
+_FOOTER = struct.Struct("<4sI")
+FOOTER_SIZE = _FOOTER.size
+
+
+def pack_footer(payload: bytes) -> bytes:
+    """payload -> payload + (magic, crc32) trailer."""
+    return payload + _FOOTER.pack(FOOTER_MAGIC, zlib.crc32(payload))
+
+
+def unpack_footer(path: str | Path, data: bytes) -> bytes:
+    """Verify and strip the footer; raises ShardCorruptedError on a
+    missing magic or a CRC mismatch (naming the file, like the
+    reference's CorruptIndexException resource string)."""
+    if len(data) < FOOTER_SIZE:
+        raise ShardCorruptedError(
+            f"[{Path(path).name}] is truncated below the checksum footer "
+            f"({len(data)} bytes)")
+    magic, crc = _FOOTER.unpack_from(data, len(data) - FOOTER_SIZE)
+    payload = data[: len(data) - FOOTER_SIZE]
+    if magic != FOOTER_MAGIC:
+        raise ShardCorruptedError(
+            f"[{Path(path).name}] has no checksum footer "
+            f"(bad magic {magic!r})")
+    actual = zlib.crc32(payload)
+    if actual != crc:
+        raise ShardCorruptedError(
+            f"[{Path(path).name}] failed checksum verification "
+            f"(expected={crc:#010x} actual={actual:#010x})")
+    return payload
+
+
+class DiskIO:
+    """All Store/Translog bytes pass through here.
+
+    The base implementation is a plain atomic-write / read / append; the
+    chaos layer overrides :meth:`_fault` to perturb operations. ``op`` is
+    one of ``write`` / ``append`` / ``read``.
+    """
+
+    def _fault(self, op: str, path: Path, data: bytes) -> bytes:
+        """Hook: may raise OSError (EIO/ENOSPC) or return mutated bytes."""
+        return data
+
+    def write_bytes(self, path: str | Path, data: bytes) -> None:
+        """Write-once artifact: temp file + fsync + atomic rename."""
+        path = Path(path)
+        data = self._fault("write", path, data)
+        tmp = path.with_name("." + path.name + ".tmp")
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def append(self, f, path: str | Path, data: bytes) -> None:
+        """Append to an open log file (translog records)."""
+        data = self._fault("append", Path(path), data)
+        f.write(data)
+
+    def read_bytes(self, path: str | Path) -> bytes:
+        path = Path(path)
+        with open(path, "rb") as f:
+            data = f.read()
+        return self._fault("read", path, data)
+
+
+# shared default instance: stateless, safe to reuse process-wide
+DEFAULT_IO = DiskIO()

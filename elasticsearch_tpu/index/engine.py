@@ -18,6 +18,7 @@ indexing never blocks on device work.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -27,7 +28,11 @@ from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORM
 from elasticsearch_tpu.index.store import Store
 from elasticsearch_tpu.index.translog import Translog, TranslogOp
 from elasticsearch_tpu.mapping import MapperService, ParsedDocument
-from elasticsearch_tpu.utils.errors import VersionConflictError
+from elasticsearch_tpu.utils.errors import (
+    ShardCorruptedError, VersionConflictError,
+)
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -107,12 +112,24 @@ class InternalEngine:
                  translog: Optional[Translog] = None,
                  primary_term: int = 1,
                  shard_label: str = "shard0",
-                 index_sort: Optional[Tuple[str, str]] = None):
+                 index_sort: Optional[Tuple[str, str]] = None,
+                 check_on_startup: Any = False):
         self.mappers = mapper_service
         self.store = store
         self.translog = translog
         self.primary_term = primary_term
         self.shard_label = shard_label
+        # index.shard.check_on_startup: 'checksum' verifies every store
+        # artifact's CRC32 footer (and walks the translog) before the
+        # commit is opened (IndexShard.checkIndex analog)
+        self.check_on_startup = check_on_startup
+        # tragic-event state (Engine.failEngine): once an IO/corruption
+        # failure hits the storage path the engine is failed, the store is
+        # marked when the cause is corruption, and listeners (the shard /
+        # reconciler) turn the failure into a routing event
+        self.failed = False
+        self.failure_reason: Optional[str] = None
+        self.failure_listeners: List[Callable[[str, Exception], None]] = []
         # (field, order) from index.sort.field/index.sort.order
         # (index/IndexSortConfig.java:57): new segments store docs in
         # sort order, so sort-matching scans read presorted data
@@ -179,9 +196,9 @@ class InternalEngine:
             parsed = self.mappers.parse_document(doc_id, source, routing)
 
             if self.translog is not None:
-                self.translog.add(TranslogOp("index", seqno, primary_term,
-                                             doc_id=doc_id, source=source,
-                                             routing=routing, version=version))
+                self._translog_add(TranslogOp("index", seqno, primary_term,
+                                              doc_id=doc_id, source=source,
+                                              routing=routing, version=version))
 
             if doc_id not in self._buffer:
                 self._buffer_order.append(doc_id)
@@ -220,8 +237,8 @@ class InternalEngine:
 
             found = existing is not None and not existing.deleted
             if self.translog is not None:
-                self.translog.add(TranslogOp("delete", seqno, primary_term,
-                                             doc_id=doc_id, version=version))
+                self._translog_add(TranslogOp("delete", seqno, primary_term,
+                                              doc_id=doc_id, version=version))
             if doc_id in self._buffer:
                 del self._buffer[doc_id]
                 self._buffer_order.remove(doc_id)
@@ -236,8 +253,46 @@ class InternalEngine:
         """Fill a seqno hole (primary failover safety), reference: Engine.noOp."""
         with self._lock:
             if self.translog is not None:
-                self.translog.add(TranslogOp("noop", seqno, self.primary_term, reason=reason))
+                self._translog_add(TranslogOp("noop", seqno,
+                                              self.primary_term,
+                                              reason=reason))
             self.tracker.mark_processed(seqno)
+
+    # ------------------------------------------------------------------
+    # failure handling
+    # ------------------------------------------------------------------
+
+    def _translog_add(self, op: TranslogOp) -> None:
+        try:
+            self.translog.add(op)
+        except OSError as e:
+            # a failed WAL append is a tragic event: the op was NOT made
+            # durable, so the engine must stop acknowledging writes
+            self._fail_engine("translog append failed", e)
+            raise
+
+    def _fail_engine(self, reason: str, exc: Exception) -> None:
+        """Tragic-event handler (Engine.failEngine analog): mark the store
+        corrupted when the cause is corruption, then notify listeners so
+        the shard is failed to the master instead of limping along."""
+        with self._lock:
+            if self.failed:
+                return
+            self.failed = True
+            self.failure_reason = f"{reason}: {exc}"
+            listeners = list(self.failure_listeners)
+        if isinstance(exc, ShardCorruptedError) and self.store is not None:
+            try:
+                self.store.mark_corrupted(f"{reason}: {exc}")
+            except Exception:  # noqa: BLE001 — marking is best-effort
+                logger.exception("failed to write corruption marker")
+        logger.error("engine [%s] failed: %s: %s",
+                     self.shard_label, reason, exc)
+        for fn in listeners:
+            try:
+                fn(reason, exc)
+            except Exception:  # noqa: BLE001 — listeners must not mask
+                logger.exception("engine failure listener threw")
 
     # ------------------------------------------------------------------
     # read path
@@ -313,6 +368,15 @@ class InternalEngine:
 
     def flush(self) -> None:
         """Commit: refresh, persist, roll translog. Reference: InternalEngine.flush:489."""
+        try:
+            self._flush_inner()
+        except (ShardCorruptedError, OSError) as e:
+            # a failed commit (EIO/ENOSPC/corrupt read-back) is tragic:
+            # the on-disk state can no longer be trusted to match memory
+            self._fail_engine("flush failed", e)
+            raise
+
+    def _flush_inner(self) -> None:
         with self._lock:
             self.refresh()
             if self.store is None:
@@ -453,7 +517,27 @@ class InternalEngine:
         Reference analog: InternalEngine opening the last Lucene commit and
         replaying translog ops > local_checkpoint (crash recovery, §5.4).
         Returns the number of replayed ops.
+
+        Integrity gates (in order): a corruption-marked store refuses to
+        open at all; ``index.shard.check_on_startup: checksum`` verifies
+        every artifact's CRC32 footer up front; and any corruption found
+        while actually reading (segments, commit point, translog) marks
+        the store and fails the engine — recovery never half-opens over
+        bad bytes.
         """
+        try:
+            return self._recover_from_store_inner()
+        except ShardCorruptedError as e:
+            self._fail_engine("store recovery failed", e)
+            raise
+
+    def _recover_from_store_inner(self) -> int:
+        if self.store is not None:
+            self.store.ensure_not_corrupted()
+            if str(self.check_on_startup).lower() in ("checksum", "true"):
+                self.store.verify_integrity()
+                if self.translog is not None:
+                    self.translog.verify()
         with self._lock:
             commit = self.store.read_latest_commit() if self.store else None
             if commit:
@@ -567,7 +651,13 @@ class InternalEngine:
 
     def close(self) -> None:
         if self.translog is not None:
-            self.translog.close()
+            try:
+                self.translog.close()
+            except OSError:
+                # a dying disk must not keep a failed shard from being
+                # removed (close-on-failure path)
+                logger.warning("translog close failed for [%s]",
+                               self.shard_label)
 
 
 def _insert_merged(merged: Segment, original: List[Segment],

@@ -52,7 +52,8 @@ class IndexShard:
                  allocation_id: Optional[str] = None,
                  store: Optional[Store] = None,
                  translog: Optional[Translog] = None,
-                 index_sort=None):
+                 index_sort=None,
+                 check_on_startup=False):
         self.shard_id = shard_id
         self.primary = primary
         self.primary_term = primary_term
@@ -61,7 +62,8 @@ class IndexShard:
             mapper_service, store=store, translog=translog,
             primary_term=primary_term,
             shard_label=f"{shard_id.index}_{shard_id.shard}",
-            index_sort=index_sort)
+            index_sort=index_sort,
+            check_on_startup=check_on_startup)
         self.search = SearchService(self.engine, index_name=shard_id.index)
         self.tracker: Optional[ReplicationTracker] = None
         if primary:
@@ -92,15 +94,27 @@ class IndexShard:
         assert self.primary, f"{self.shard_id} is not a primary"
         return self.engine.delete(doc_id, **kw)
 
-    def apply_op_on_replica(self, op: Dict[str, Any]) -> EngineResult:
+    def apply_op_on_replica(self, op: Dict[str, Any],
+                            req_primary_term: Optional[int] = None
+                            ) -> EngineResult:
         """Apply a primary-assigned operation. op is the replicated wire
         form: {op_type, doc_id, source?, routing?, seqno, version,
-        primary_term}."""
-        if op["primary_term"] < self.primary_term:
+        primary_term}.
+
+        The stale-primary fence compares the SENDING primary's term
+        (``req_primary_term``, the request-level term of the reference's
+        TransportReplicationAction), not the op's own term: peer recovery
+        legitimately replays history written under OLDER terms after a
+        failover bumped the shard's term. Live replication passes no
+        ``req_primary_term`` and falls back to the op term (for live ops
+        the two are the same)."""
+        fence_term = req_primary_term if req_primary_term is not None \
+            else op["primary_term"]
+        if fence_term < self.primary_term:
             raise IllegalArgumentError(
-                f"op primary term [{op['primary_term']}] is below the shard's "
+                f"op primary term [{fence_term}] is below the shard's "
                 f"[{self.primary_term}]")
-        self.primary_term = max(self.primary_term, op["primary_term"])
+        self.primary_term = max(self.primary_term, fence_term)
         self.engine.primary_term = self.primary_term
         if op["op_type"] == "index":
             return self.engine.index(
@@ -168,6 +182,21 @@ class IndexShard:
         tracker = self.engine.tracker
         for seqno in range(tracker.checkpoint + 1, tracker.max_seqno + 1):
             self.engine.noop(seqno, reason="primary promotion hole fill")
+
+    # ------------------------------------------------------------------
+    # failure handling
+    # ------------------------------------------------------------------
+
+    def add_failure_listener(self, listener) -> None:
+        """Register ``fn(reason, exc)`` fired once if the engine hits a
+        tragic storage event (corruption, EIO) — the reconciler uses this
+        to report shard-failed to the master (IndexShard failure callback
+        analog)."""
+        self.engine.failure_listeners.append(listener)
+
+    @property
+    def failed(self) -> bool:
+        return self.engine.failed
 
     # ------------------------------------------------------------------
 

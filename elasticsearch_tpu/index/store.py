@@ -6,30 +6,46 @@ commit points (segments_N). Layout per shard directory:
     segments/<name>.npz        all numpy arrays, path-keyed
     segments/<name>.meta.json  dicts (term tables), ids, sources, field meta
     commit-<gen>.json          commit point: segment list, seqno watermarks
+    corrupted_<uuid>           corruption marker (store refuses to reopen)
     translog/                  WAL (translog.py)
 
 Arrays and metadata are written to temp files and atomically renamed; a
 commit point only references fully-written segments (write-once, like
 Lucene's flush-then-commit discipline).
+
+Integrity: every artifact carries a CRC32 footer (disk_io.py) written at
+write time and verified at read time; a mismatch raises
+``ShardCorruptedError``. Once a store is marked corrupted
+(``mark_corrupted``), it refuses to reopen until the marker is cleared —
+the reference's corruption-marker discipline that keeps a bad copy from
+ever being promoted (Store.markStoreCorrupted / failIfCorrupted).
 """
 
 from __future__ import annotations
 
+import io
 import json
-import os
+import uuid as uuid_mod
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from elasticsearch_tpu.index.disk_io import (
+    DEFAULT_IO, DiskIO, pack_footer, unpack_footer,
+)
 from elasticsearch_tpu.index.segment import (
     DocValuesField, FeaturesField, KeywordField, PostingsField, Segment, VectorField,
 )
+from elasticsearch_tpu.utils.errors import ShardCorruptedError
+
+CORRUPTED_MARKER_PREFIX = "corrupted_"
 
 
 class Store:
-    def __init__(self, path: str | Path):
+    def __init__(self, path: str | Path, disk_io: Optional[DiskIO] = None):
         self.path = Path(path)
+        self.io = disk_io or DEFAULT_IO
         (self.path / "segments").mkdir(parents=True, exist_ok=True)
 
     # -- segments --------------------------------------------------------
@@ -37,25 +53,27 @@ class Store:
     def write_segment(self, seg: Segment) -> None:
         arrays, meta = segment_payload(seg)
         seg_dir = self.path / "segments"
-        npz_tmp = seg_dir / f".{seg.name}.npz.tmp"
-        with open(npz_tmp, "wb") as f:
-            np.savez(f, **arrays)
-            f.flush()
-            os.fsync(f.fileno())
-        meta_tmp = seg_dir / f".{seg.name}.meta.json.tmp"
-        with open(meta_tmp, "w") as f:
-            json.dump(meta, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(npz_tmp, seg_dir / f"{seg.name}.npz")
-        os.replace(meta_tmp, seg_dir / f"{seg.name}.meta.json")
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        self.io.write_bytes(seg_dir / f"{seg.name}.npz",
+                            pack_footer(buf.getvalue()))
+        meta_bytes = json.dumps(meta).encode("utf-8")
+        self.io.write_bytes(seg_dir / f"{seg.name}.meta.json",
+                            pack_footer(meta_bytes))
 
     def read_segment(self, name: str) -> Segment:
         seg_dir = self.path / "segments"
-        with open(seg_dir / f"{name}.meta.json") as f:
-            meta = json.load(f)
-        with np.load(seg_dir / f"{name}.npz") as data:
+        meta = json.loads(self._read_verified(
+            seg_dir / f"{name}.meta.json").decode("utf-8"))
+        with np.load(io.BytesIO(self._read_verified(
+                seg_dir / f"{name}.npz"))) as data:
             return self._segment_from(meta, data)
+
+    def _read_verified(self, path: Path) -> bytes:
+        """Read + strip/verify the CRC32 footer (ShardCorruptedError on
+        mismatch). A missing file stays FileNotFoundError — absence is a
+        different failure than corruption."""
+        return unpack_footer(path, self.io.read_bytes(path))
 
     @staticmethod
     def _segment_from(meta: Dict[str, Any], data) -> Segment:
@@ -133,17 +151,15 @@ class Store:
 
     def write_live_mask(self, seg: Segment) -> None:
         """Persist only the live-docs mask (deletes), like Lucene .liv files."""
-        liv_tmp = self.path / "segments" / f".{seg.name}.liv.tmp"
-        with open(liv_tmp, "wb") as f:
-            np.save(f, seg.live)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(liv_tmp, self.path / "segments" / f"{seg.name}.liv.npy")
+        buf = io.BytesIO()
+        np.save(buf, seg.live)
+        self.io.write_bytes(self.path / "segments" / f"{seg.name}.liv.npy",
+                            pack_footer(buf.getvalue()))
 
     def read_live_mask(self, name: str) -> Optional[np.ndarray]:
         p = self.path / "segments" / f"{name}.liv.npy"
         if p.exists():
-            return np.load(p)
+            return np.load(io.BytesIO(self._read_verified(p)))
         return None
 
     # -- commit points ---------------------------------------------------
@@ -160,12 +176,8 @@ class Store:
             "translog_generation": translog_generation,
             "extra": extra or {},
         }
-        tmp = self.path / f".commit-{generation}.json.tmp"
-        with open(tmp, "w") as f:
-            json.dump(commit, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path / f"commit-{generation}.json")
+        self.io.write_bytes(self.path / f"commit-{generation}.json",
+                            pack_footer(json.dumps(commit).encode("utf-8")))
         # prune older commit points
         for p in self.path.glob("commit-*.json"):
             try:
@@ -185,11 +197,82 @@ class Store:
         if not commits:
             return None
         _, path = max(commits)
-        with open(path) as f:
-            return json.load(f)
+        return json.loads(self._read_verified(path).decode("utf-8"))
 
     def list_segment_files(self) -> List[str]:
         return sorted(p.stem for p in (self.path / "segments").glob("*.npz"))
+
+    # -- corruption markers ---------------------------------------------
+
+    def mark_corrupted(self, reason: str) -> None:
+        """Write a ``corrupted_<uuid>`` marker recording the first failure;
+        the store refuses to reopen while a marker exists. Idempotent: the
+        original cause is kept (Store.markStoreCorrupted)."""
+        if self.corruption_reason() is not None:
+            return
+        marker = self.path / f"{CORRUPTED_MARKER_PREFIX}{uuid_mod.uuid4().hex}"
+        try:
+            self.io.write_bytes(
+                marker, pack_footer(json.dumps({"reason": reason}).encode()))
+        except OSError:
+            # a dying disk may refuse the marker too; the shard still
+            # fails through the engine-failure path
+            pass
+
+    def corruption_reason(self) -> Optional[str]:
+        for p in sorted(self.path.glob(f"{CORRUPTED_MARKER_PREFIX}*")):
+            try:
+                payload = unpack_footer(p, self.io.read_bytes(p))
+                return json.loads(payload.decode("utf-8")).get(
+                    "reason", "unknown")
+            except (OSError, ValueError, ShardCorruptedError):
+                return f"unreadable corruption marker [{p.name}]"
+        return None
+
+    @property
+    def is_corrupted(self) -> bool:
+        return self.corruption_reason() is not None
+
+    def ensure_not_corrupted(self) -> None:
+        """Raise if a corruption marker exists (Store.failIfCorrupted) —
+        a marked copy must never be reopened, served, or used as a
+        recovery source."""
+        reason = self.corruption_reason()
+        if reason is not None:
+            raise ShardCorruptedError(
+                f"store at [{self.path}] is marked corrupted: {reason}")
+
+    def clear_corruption_markers(self) -> int:
+        """Operator/fresh-copy escape hatch; returns markers removed."""
+        removed = 0
+        for p in self.path.glob(f"{CORRUPTED_MARKER_PREFIX}*"):
+            p.unlink(missing_ok=True)
+            removed += 1
+        return removed
+
+    # -- verification ----------------------------------------------------
+
+    def verify_integrity(self) -> Dict[str, int]:
+        """Verify the CRC32 footer of every artifact the latest commit
+        references (``index.shard.check_on_startup: checksum``). Footer
+        checks only — no deserialization — so the cost is one sequential
+        read per file. Raises ShardCorruptedError on the first mismatch;
+        returns {files_verified: n} on success."""
+        verified = 0
+        commit = self.read_latest_commit()   # itself footer-verified
+        if commit is None:
+            return {"files_verified": 0}
+        verified += 1
+        seg_dir = self.path / "segments"
+        for name in commit["segments"]:
+            for suffix in (".npz", ".meta.json"):
+                self._read_verified(seg_dir / f"{name}{suffix}")
+                verified += 1
+            liv = seg_dir / f"{name}.liv.npy"
+            if liv.exists():
+                self._read_verified(liv)
+                verified += 1
+        return {"files_verified": verified}
 
 
 def segment_payload(seg: Segment):

@@ -8,6 +8,13 @@ commit makes their operations durable in segments.
 Format: one file per generation (``translog-<gen>.log``), length-prefixed
 JSON records with a per-record checksum. Binary framing keeps parsing simple
 and corruption detectable (CRC32 like the reference's translog checksums).
+
+Integrity discipline (TranslogReader analog): a *torn tail* — the last
+record of the newest generation cut short by a crash mid-append — is
+truncated at open and replay continues from the fully-synced prefix; an
+incomplete record anywhere else, or a CRC mismatch anywhere at all, is
+real corruption and raises ``TranslogCorruptedError`` (the shard fails
+instead of replaying garbage).
 """
 
 from __future__ import annotations
@@ -16,14 +23,17 @@ import json
 import os
 import struct
 import zlib
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Dict, Iterator, List, Optional
 
-from elasticsearch_tpu.utils.errors import SearchEngineError
+from elasticsearch_tpu.index.disk_io import (
+    DEFAULT_IO, DiskIO, pack_footer, unpack_footer,
+)
+from elasticsearch_tpu.utils.errors import ShardCorruptedError
 
 
-class TranslogCorruptedError(SearchEngineError):
+class TranslogCorruptedError(ShardCorruptedError):
     status = 500
 
 
@@ -72,14 +82,27 @@ class Translog:
     periodic flusher.
     """
 
-    def __init__(self, directory: str | Path, durability: str = "request"):
+    def __init__(self, directory: str | Path, durability: str = "request",
+                 disk_io: Optional[DiskIO] = None):
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.durability = durability
+        self.io = disk_io or DEFAULT_IO
         gens = self._list_generations()
+        # torn-tail recovery happens at open, before the new generation
+        # starts: the newest existing generation may end mid-record after
+        # a crash mid-append — drop the partial record so replay sees only
+        # fully-written ops (TranslogReader's tail handling). The
+        # checkpoint bounds it: bytes below the last SYNCED offset are
+        # acked history, never a truncatable tail.
+        self.truncated_tail_bytes = 0
+        if gens:
+            self.truncated_tail_bytes = self._recover_tail(
+                gens[-1], self._synced_offset(gens[-1]))
         self.generation = (gens[-1] + 1) if gens else 1
         self._file = open(self._gen_path(self.generation), "ab")
         self.total_ops = 0
+        self._write_checkpoint()
 
     def _gen_path(self, gen: int) -> Path:
         return self.dir / f"translog-{gen}.log"
@@ -93,10 +116,77 @@ class Translog:
                 continue
         return sorted(gens)
 
+    def _recover_tail(self, gen: int, synced_offset: int = 0) -> int:
+        """Truncate a genuinely torn final record in ``gen``.
+
+        A torn tail is ONE partial append at EOF (crash mid-write). The
+        record header (length prefix) is not covered by the payload CRC,
+        so a bit-flip in a length prefix also looks like "record runs
+        past EOF" — but truncating there would silently destroy every
+        acknowledged, fsynced op after the flipped byte. Two guards:
+
+        - the CHECKPOINT: an anomaly strictly below ``synced_offset``
+          sits inside fsynced (acked) history — corruption, never a tail;
+        - forward scan: a complete CRC-valid record anywhere after the
+          anomaly proves real history follows the bad bytes.
+
+        In either case the file is left intact and the read path raises
+        TranslogCorruptedError (the shard fails instead of silently
+        losing ops). Only an anomaly at/above the synced boundary with
+        nothing valid after it is a tail, and only a structurally-
+        incomplete one is truncated (a complete record with a bad CRC is
+        payload corruption, kept for the read path to report). Returns
+        the number of bytes dropped."""
+        path = self._gen_path(gen)
+        data = path.read_bytes()
+        offset = 0
+        while offset < len(data):
+            if offset + _HEADER.size > len(data):
+                break
+            length, crc = _HEADER.unpack_from(data, offset)
+            end = offset + _HEADER.size + length
+            if end > len(data):
+                break
+            if zlib.crc32(data[offset + _HEADER.size:end]) != crc:
+                break   # complete-but-corrupt: corruption, never a tail
+            offset = end
+        if offset == len(data):
+            return 0                          # clean file
+        if offset < synced_offset:
+            return 0                          # inside acked history
+        if self._has_valid_record_after(data, offset + 1):
+            return 0                          # history follows: corruption
+        if offset + _HEADER.size <= len(data):
+            length, _crc = _HEADER.unpack_from(data, offset)
+            if offset + _HEADER.size + length <= len(data):
+                return 0                      # complete record, bad CRC
+        torn = len(data) - offset
+        with open(path, "r+b") as f:
+            f.truncate(offset)
+            f.flush()
+            os.fsync(f.fileno())
+        return torn
+
+    @staticmethod
+    def _has_valid_record_after(data: bytes, start: int) -> bool:
+        """True if any complete record with a matching CRC begins at or
+        after ``start`` (a 32-bit CRC match at a random offset is a
+        ~2**-32 coincidence — strong evidence of real history)."""
+        for off in range(start, len(data) - _HEADER.size + 1):
+            length, crc = _HEADER.unpack_from(data, off)
+            if length == 0:
+                continue   # crc32(b"")==0: zero bytes would false-match
+            end = off + _HEADER.size + length
+            if end > len(data):
+                continue
+            if zlib.crc32(data[off + _HEADER.size:end]) == crc:
+                return True
+        return False
+
     def add(self, op: TranslogOp) -> None:
         payload = json.dumps(op.to_json(), separators=(",", ":")).encode("utf-8")
         rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
-        self._file.write(rec)
+        self.io.append(self._file, self._gen_path(self.generation), rec)
         self.total_ops += 1
         if self.durability == "request":
             self.sync()
@@ -104,6 +194,37 @@ class Translog:
     def sync(self) -> None:
         self._file.flush()
         os.fsync(self._file.fileno())
+        self._write_checkpoint()
+
+    # -- checkpoint (translog.ckp analog) -------------------------------
+    #
+    # Records {generation, offset} of the last fsync — the durable
+    # boundary of acknowledged history. Tail recovery may only truncate
+    # ABOVE it: an anomaly below the checkpointed offset is corruption of
+    # acked ops (the case a framing-only scan cannot distinguish from a
+    # torn write, e.g. a bit-flip in the FINAL record's length prefix).
+
+    def _ckp_path(self) -> Path:
+        return self.dir / "translog.ckp"
+
+    def _write_checkpoint(self) -> None:
+        payload = json.dumps({
+            "generation": self.generation,
+            "offset": self._file.tell(),
+        }).encode("utf-8")
+        self.io.write_bytes(self._ckp_path(), pack_footer(payload))
+
+    def _synced_offset(self, gen: int) -> int:
+        """The checkpointed synced byte count for ``gen`` (0 when the
+        checkpoint is absent, unreadable, or for another generation —
+        recovery then falls back to framing+CRC disambiguation only)."""
+        try:
+            payload = unpack_footer(self._ckp_path(),
+                                    self.io.read_bytes(self._ckp_path()))
+            ckp = json.loads(payload.decode("utf-8"))
+        except (OSError, ValueError, ShardCorruptedError):
+            return 0
+        return int(ckp["offset"]) if ckp.get("generation") == gen else 0
 
     def rollover(self) -> int:
         """Start a new generation (called at flush); returns the new generation."""
@@ -111,6 +232,7 @@ class Translog:
         self._file.close()
         self.generation += 1
         self._file = open(self._gen_path(self.generation), "ab")
+        self._write_checkpoint()
         return self.generation
 
     def trim_below(self, generation: int) -> None:
@@ -125,26 +247,46 @@ class Translog:
         for gen in self._list_generations():
             yield from self._read_gen(gen, min_seqno)
 
+    def verify(self) -> int:
+        """Walk every retained record, verifying framing + CRC; returns
+        the record count (check_on_startup's translog pass)."""
+        self._file.flush()
+        n = 0
+        for gen in self._list_generations():
+            for _ in self._read_gen(gen, min_seqno=0):
+                n += 1
+        return n
+
     def _read_gen(self, gen: int, min_seqno: int) -> Iterator[TranslogOp]:
         path = self._gen_path(gen)
-        with open(path, "rb") as f:
-            data = f.read()
+        data = self.io.read_bytes(path)
         offset = 0
         while offset < len(data):
             if offset + _HEADER.size > len(data):
-                # torn tail write (crash mid-append): stop replay here, like
-                # the reference tolerating a truncated last op
-                break
+                # tails were truncated at open — an incomplete record here
+                # is a torn write INSIDE retained history: corruption, not
+                # a tolerable tail (mid-generation torn writes can hide
+                # acknowledged ops)
+                raise TranslogCorruptedError(
+                    f"translog {path.name} has a truncated record header "
+                    f"at offset {offset}")
             length, crc = _HEADER.unpack_from(data, offset)
             start = offset + _HEADER.size
             end = start + length
             if end > len(data):
-                break
+                raise TranslogCorruptedError(
+                    f"translog {path.name} has a truncated record body "
+                    f"at offset {offset}")
             payload = data[start:end]
             if zlib.crc32(payload) != crc:
                 raise TranslogCorruptedError(
                     f"translog {path.name} corrupted at offset {offset}")
-            op = TranslogOp.from_json(json.loads(payload.decode("utf-8")))
+            try:
+                op = TranslogOp.from_json(json.loads(payload.decode("utf-8")))
+            except (ValueError, KeyError) as e:
+                raise TranslogCorruptedError(
+                    f"translog {path.name} has an unparseable record at "
+                    f"offset {offset}: {e}")
             if op.seqno >= min_seqno:
                 yield op
             offset = end

@@ -19,6 +19,7 @@ from elasticsearch_tpu.cluster.routing import ShardRouting, ShardState
 from elasticsearch_tpu.cluster.state import ClusterState
 from elasticsearch_tpu.indices.indices_service import IndicesService
 from elasticsearch_tpu.transport.transport import TransportService
+from elasticsearch_tpu.utils.errors import ShardCorruptedError
 
 logger = logging.getLogger(__name__)
 
@@ -36,6 +37,9 @@ class IndicesClusterStateService:
         self.last_applied: Optional[ClusterState] = None
         # shards this node is currently recovering (avoid double-starting)
         self._recovering: set = set()
+        # allocation ids with an in-flight shard-failed retry loop (the
+        # re-assert-on-every-state path must not stack duplicate loops)
+        self._failing: set = set()
         self.ts.register_handler(RECOVERY_START, self._on_recovery_start)
 
     # ------------------------------------------------------------------
@@ -114,6 +118,33 @@ class IndicesClusterStateService:
                     if sr.primary and not shard.primary:
                         # replica promoted on failover
                         shard.promote_to_primary(term)
+                elif sr.state == ShardState.STARTED and not local_exists:
+                    # routing says this node serves the copy but it is
+                    # gone locally — a tragic-event removal whose
+                    # shard-failed report was lost mid-election, or an
+                    # in-place process restart. A restarted PRIMARY whose
+                    # disk still holds a committed store recovers IN
+                    # PLACE: failing it would hand a possibly-sole copy
+                    # to the balance-only allocator, which has no
+                    # existing-copy awareness and could start an empty
+                    # primary on another node (green-but-empty data
+                    # loss). A corruption-marked store refuses to reopen
+                    # inside recover_from_store and falls through to the
+                    # failure report. Everything else re-asserts
+                    # shard-failed on EVERY state application until the
+                    # master reroutes — a lost report must not leave
+                    # routing diverged forever.
+                    if sr.primary and key not in self._recovering and \
+                            sr.allocation_id not in self._failing and \
+                            self.indices.has_on_disk_data(
+                                state.metadata.index(sr.index),
+                                sr.shard_id):
+                        self._recovering.add(key)
+                        self._recover_started_primary_in_place(state, sr)
+                    else:
+                        self._shard_failed(
+                            sr, "shard copy missing locally "
+                                "(failed or restarted; re-reporting)")
             except Exception as e:  # noqa: BLE001 — fail just this shard
                 self._shard_failed(sr, f"shard apply failed: {e}")
 
@@ -135,8 +166,12 @@ class IndicesClusterStateService:
                 if shard.engine.store is not None:
                     shard.engine.recover_from_store()
             except Exception as e:  # noqa: BLE001 — reported to master
+                # drop the half-opened copy so a later reassignment to
+                # this node starts clean instead of colliding with it
+                service.remove_shard(sr.shard_id)
                 self._shard_failed(sr, f"store recovery failed: {e}")
                 return
+            self._watch_engine(service, shard, sr)
             self._shard_started(sr)
             return
 
@@ -146,9 +181,13 @@ class IndicesClusterStateService:
         if not primary.active or primary.node_id is None:
             self._recovering.discard((sr.index, sr.shard_id))
             return   # retried on a later state where the primary is active
+        # fresh_store: this copy is rebuilt from the primary's ops, so any
+        # leftover on-disk state (incl. corruption markers from a failed
+        # previous copy on this node) is wiped first
         shard = service.create_shard(sr.shard_id, primary=False,
                                      primary_term=term,
-                                     allocation_id=sr.allocation_id)
+                                     allocation_id=sr.allocation_id,
+                                     fresh_store=True)
 
         def on_response(resp: Optional[Dict[str, Any]],
                         err: Optional[Exception]) -> None:
@@ -159,7 +198,10 @@ class IndicesClusterStateService:
                 return
             try:
                 for op in resp["ops"]:
-                    shard.apply_op_on_replica(op)
+                    # historical ops keep their original terms; the fence
+                    # term is the recovery source's CURRENT primary term
+                    shard.apply_op_on_replica(
+                        op, req_primary_term=resp.get("primary_term"))
                 # fill seqno holes (overwritten/deleted history not shipped)
                 for seqno in range(shard.engine.tracker.checkpoint + 1,
                                    resp["max_seqno"] + 1):
@@ -172,6 +214,7 @@ class IndicesClusterStateService:
                 self._recovering.discard((sr.index, sr.shard_id))
                 self._shard_failed(sr, f"recovery apply failed: {e}")
                 return
+            self._watch_engine(service, shard, sr)
             self._shard_started(sr)
 
         # the start request retries with jittered-exponential backoff
@@ -201,22 +244,56 @@ class IndicesClusterStateService:
                 "allocation_id": sr.allocation_id,
             }, cb, timeout=60.0)
 
-        from elasticsearch_tpu.utils.errors import ReceiveTimeoutError
-        from elasticsearch_tpu.utils.retry import RetryableAction
+        from elasticsearch_tpu.utils.retry import (
+            RetryableAction, transient_cluster_error,
+        )
 
         def retryable(err) -> bool:
             # the start request is idempotent on the source (snapshot +
             # mark-in-sync), so lost requests AND lost replies both retry
-            from elasticsearch_tpu.transport.transport import (
-                ConnectTransportError,
-            )
-            return isinstance(err, (ConnectTransportError,
-                                    ReceiveTimeoutError))
+            return transient_cluster_error(err, retry_timeouts=True)
 
         RetryableAction(
             self.ts.transport.scheduler, attempt, on_response,
             initial_delay=0.5, max_delay=10.0, timeout=120.0,
             is_retryable=retryable).run()
+
+    def _recover_started_primary_in_place(self, state: ClusterState,
+                                          sr: ShardRouting) -> None:
+        """Re-open a STARTED-routed primary from this node's own store
+        after a process restart. No routing change is needed (the master
+        already routes the copy here); success just restores service,
+        failure (incl. a corruption marker) reports shard-failed like any
+        other store-recovery failure."""
+        metadata = state.metadata.index(sr.index)
+        service = self.indices.create_index(metadata)
+        term = metadata.primary_term(sr.shard_id)
+        shard = service.create_shard(sr.shard_id, primary=True,
+                                     primary_term=term,
+                                     allocation_id=sr.allocation_id)
+        try:
+            if shard.engine.store is not None:
+                shard.engine.recover_from_store()
+        except Exception as e:  # noqa: BLE001 — reported to master
+            service.remove_shard(sr.shard_id)
+            self._shard_failed(sr, f"in-place store recovery failed: {e}")
+            return
+        self._watch_engine(service, shard, sr)
+        self._recovering.discard((sr.index, sr.shard_id))
+
+    def _watch_engine(self, service, shard, sr: ShardRouting) -> None:
+        """Turn a later tragic engine event (corruption, EIO at flush)
+        into a routing event: drop the local copy and report shard-failed
+        so the master promotes a clean replica and re-replicates."""
+        def on_engine_failure(reason: str, exc: Exception,
+                              sr=sr, service=service) -> None:
+            try:
+                service.remove_shard(sr.shard_id)
+            except Exception:  # noqa: BLE001 — removal is best-effort
+                logger.exception("failed to remove failed shard %s", sr)
+            self._shard_failed(
+                sr, f"engine failed, reason [{reason}]: {exc}")
+        shard.add_failure_listener(on_engine_failure)
 
     def _on_recovery_start(self, req: Dict[str, Any], sender: str
                            ) -> Dict[str, Any]:
@@ -228,6 +305,14 @@ class IndicesClusterStateService:
         (the retention-lease ops-based path of RecoverySourceHandler)."""
         shard = self.indices.shard(req["index"], req["shard"])
         assert shard.primary and shard.tracker is not None
+        # a corruption-marked (or failed) store must never be a recovery
+        # source: replicas built from it would replicate the damage
+        if shard.engine.failed:
+            raise ShardCorruptedError(
+                f"recovery source [{req['index']}][{req['shard']}] has a "
+                f"failed engine: {shard.engine.failure_reason}")
+        if shard.engine.store is not None:
+            shard.engine.store.ensure_not_corrupted()
         ops, max_seqno = shard.engine.snapshot_ops()
         shard.tracker.init_tracking(req["allocation_id"])
         shard.tracker.mark_in_sync(req["allocation_id"], max_seqno)
@@ -253,10 +338,41 @@ class IndicesClusterStateService:
                              lambda r, e: None, timeout=30.0)
 
     def _shard_failed(self, sr: ShardRouting, reason: str) -> None:
+        """Report a failed copy to the master. Reliable: retried with
+        jittered backoff through no-master windows and dropped messages
+        (SHARD_FAILED is idempotent on the master — apply_failed_shard
+        matches by allocation_id, so a duplicate is a no-op), because a
+        lost report would leave the master routing a STARTED shard this
+        node no longer has (ShardStateAction's own retry discipline)."""
         self._recovering.discard((sr.index, sr.shard_id))
-        master = self._master_id()
-        if master is None:
-            return
-        self.ts.send_request(master, SHARD_FAILED,
-                             {"shard": sr.to_dict(), "reason": reason},
-                             lambda r, e: None, timeout=30.0)
+        if sr.allocation_id is not None:
+            if sr.allocation_id in self._failing:
+                return   # a retry loop for this copy is already running
+            self._failing.add(sr.allocation_id)
+
+        def attempt(cb) -> None:
+            master = self._master_id()
+            if master is None:
+                from elasticsearch_tpu.utils.errors import NotMasterError
+                cb(None, NotMasterError("no master known to report "
+                                        "shard failure to"))
+                return
+            self.ts.send_request(master, SHARD_FAILED,
+                                 {"shard": sr.to_dict(), "reason": reason},
+                                 cb, timeout=30.0)
+
+        def retryable(err) -> bool:
+            from elasticsearch_tpu.utils.retry import (
+                transient_cluster_error,
+            )
+            # timeouts ARE retryable here: the report is idempotent
+            return transient_cluster_error(err, retry_timeouts=True)
+
+        def finished(_r, _e) -> None:
+            self._failing.discard(sr.allocation_id)
+
+        from elasticsearch_tpu.utils.retry import RetryableAction
+        RetryableAction(
+            self.ts.transport.scheduler, attempt, finished,
+            initial_delay=0.5, max_delay=10.0, timeout=120.0,
+            is_retryable=retryable).run()

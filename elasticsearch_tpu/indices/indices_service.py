@@ -26,26 +26,35 @@ class IndexService:
     """This node's view of one index: mapper service + local shard copies."""
 
     def __init__(self, metadata: IndexMetadata,
-                 data_path: Optional[str] = None):
+                 data_path: Optional[str] = None,
+                 disk_io=None):
         self.metadata = metadata
         self.mapper_service = MapperService(dict(metadata.mappings) or None)
         self.shards: Dict[int, IndexShard] = {}
         self.data_path = data_path
+        self.disk_io = disk_io
 
-    def _shard_paths(self, shard: int):
+    def _shard_paths(self, shard: int, fresh_store: bool = False):
         if self.data_path is None:
             return None, None
         base = os.path.join(self.data_path, self.metadata.uuid, str(shard))
+        if fresh_store:
+            # peer recovery builds this copy from scratch off the primary:
+            # whatever is on disk (including corruption markers from a
+            # previous failed copy) must not leak into the new one
+            shutil.rmtree(base, ignore_errors=True)
         os.makedirs(base, exist_ok=True)
-        return (Store(os.path.join(base, "index")),
-                Translog(os.path.join(base, "translog")))
+        return (Store(os.path.join(base, "index"), disk_io=self.disk_io),
+                Translog(os.path.join(base, "translog"),
+                         disk_io=self.disk_io))
 
     def create_shard(self, shard: int, primary: bool, primary_term: int = 1,
-                     allocation_id: Optional[str] = None) -> IndexShard:
+                     allocation_id: Optional[str] = None,
+                     fresh_store: bool = False) -> IndexShard:
         if shard in self.shards:
             raise ValueError(f"shard [{self.metadata.name}][{shard}] "
                              f"already exists on this node")
-        store, translog = self._shard_paths(shard)
+        store, translog = self._shard_paths(shard, fresh_store=fresh_store)
         settings = dict(self.metadata.settings or {})
         index_sort = None
         sort_field = settings.get("index.sort.field")
@@ -60,7 +69,9 @@ class IndexService:
             ShardId(self.metadata.name, shard), self.mapper_service,
             primary=primary, primary_term=primary_term,
             allocation_id=allocation_id, store=store, translog=translog,
-            index_sort=index_sort)
+            index_sort=index_sort,
+            check_on_startup=settings.get(
+                "index.shard.check_on_startup", False))
         self.shards[shard] = index_shard
         return index_shard
 
@@ -90,14 +101,19 @@ class IndexService:
 
 
 class IndicesService:
-    def __init__(self, data_path: Optional[str] = None):
+    def __init__(self, data_path: Optional[str] = None, disk_io=None):
         self.indices: Dict[str, IndexService] = {}
         self.data_path = data_path
+        # the DiskIO seam every shard Store/Translog writes through
+        # (None = the shared default); the chaos harness injects a faulty
+        # implementation here
+        self.disk_io = disk_io
 
     def create_index(self, metadata: IndexMetadata) -> IndexService:
         if metadata.name in self.indices:
             return self.indices[metadata.name]
-        service = IndexService(metadata, data_path=self.data_path)
+        service = IndexService(metadata, data_path=self.data_path,
+                               disk_io=self.disk_io)
         self.indices[metadata.name] = service
         return service
 
@@ -114,6 +130,17 @@ class IndicesService:
 
     def has_shard(self, index: str, shard: int) -> bool:
         return index in self.indices and shard in self.indices[index].shards
+
+    def has_on_disk_data(self, metadata: IndexMetadata, shard: int) -> bool:
+        """True if this node's data path holds a committed store for the
+        shard (a commit point exists). Used to prefer in-place store
+        recovery over failing a copy whose data is intact on disk."""
+        if self.data_path is None:
+            return False
+        import glob as _glob
+        return bool(_glob.glob(os.path.join(
+            self.data_path, metadata.uuid, str(shard), "index",
+            "commit-*.json")))
 
     def remove_index(self, name: str, delete_data: bool = False) -> None:
         service = self.indices.pop(name, None)

@@ -56,19 +56,23 @@ class Node:
                  initial_state: Optional[ClusterState] = None,
                  coordinator_settings: Optional[CoordinatorSettings] = None,
                  mesh_data_plane: bool = False,
-                 transport_service=None):
+                 transport_service=None,
+                 disk_io=None):
         self.node_id = node_id
         self.scheduler = scheduler
+        import uuid as _uuid
         self.discovery_node = DiscoveryNode(
             node_id=node_id, name=node_id,
-            roles=frozenset(roles) if roles else frozenset(Roles.ALL))
+            roles=frozenset(roles) if roles else frozenset(Roles.ALL),
+            ephemeral_id=_uuid.uuid4().hex)
 
         # the wire is pluggable: in-memory (simulation / single process) or
         # an injected TcpTransportService (transport/tcp.py) for clusters
         # spanning OS processes — both honor the same service contract
         self.transport_service = transport_service or \
             TransportService(node_id, transport)
-        self.indices_service = IndicesService(data_path=data_path)
+        self.indices_service = IndicesService(data_path=data_path,
+                                              disk_io=disk_io)
         self.allocation_service = AllocationService()
 
         initial_state = initial_state or ClusterState()

@@ -30,7 +30,7 @@ from elasticsearch_tpu.index.store import (
     segment_from_payload, segment_payload,
 )
 from elasticsearch_tpu.utils.errors import (
-    IllegalArgumentError, SearchEngineError,
+    IllegalArgumentError, SearchEngineError, ShardCorruptedError,
 )
 
 
@@ -72,12 +72,22 @@ class FsRepository:
 
     def get_segment(self, sha: str) -> Segment:
         try:
-            with open(self.root / "blobs" / f"{sha}.json") as f:
-                meta = json.load(f)
-            with np.load(self.root / "blobs" / f"{sha}.npz") as data:
-                return segment_from_payload(meta, data)
+            data = (self.root / "blobs" / f"{sha}.npz").read_bytes()
+            meta_bytes = (self.root / "blobs" / f"{sha}.json").read_bytes()
         except FileNotFoundError:
             raise RepositoryError(f"missing segment blob [{sha}]")
+        # content addressing doubles as end-to-end verification: the name
+        # IS the expected hash, so a restore can never deserialize a
+        # blob that rotted in the repository (BlobStoreIndexShardSnapshot
+        # file checksums analog)
+        actual = hashlib.sha256(data + meta_bytes).hexdigest()
+        if actual != sha:
+            raise ShardCorruptedError(
+                f"snapshot blob [{sha}] failed verification "
+                f"(content hash [{actual}])")
+        meta = json.loads(meta_bytes.decode("utf-8"))
+        with np.load(io.BytesIO(data)) as arrays:
+            return segment_from_payload(meta, arrays)
 
     # -- snapshot manifests ---------------------------------------------
 

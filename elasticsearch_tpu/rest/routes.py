@@ -26,6 +26,22 @@ from elasticsearch_tpu.version import __version__
 DoneFn = Callable[[int, Any], None]
 
 
+def _thread_search_params(query: Dict[str, Any], body: Dict[str, Any],
+                          keys=("allow_partial_search_results", "timeout"),
+                          override: bool = False) -> Dict[str, Any]:
+    """Request-level search params thread into the body; values pass
+    through raw — the action layer validates and 400s. Shared by
+    _search, _msearch (per line), and async-search submit so the three
+    surfaces can't drift. ``override=True`` makes the query param beat an
+    explicit body value (_search's long-standing precedence); the default
+    only fills in missing keys (msearch/async defaulting, where the more
+    specific per-line/body value wins)."""
+    for key in keys:
+        if key in query and (override or key not in body):
+            body[key] = query[key]
+    return body
+
+
 def build_controller(client: NodeClient) -> RestController:
     rc = RestController()
     r = rc.register
@@ -196,12 +212,7 @@ def build_controller(client: NodeClient) -> RestController:
             # passed through raw; the action layer validates and 400s
             body["max_concurrent_shard_requests"] = \
                 req.query["max_concurrent_shard_requests"]
-        if "allow_partial_search_results" in req.query:
-            # passed through raw; the action layer validates and 400s
-            body["allow_partial_search_results"] = \
-                req.query["allow_partial_search_results"]
-        if "timeout" in req.query:
-            body["timeout"] = req.query["timeout"]
+        _thread_search_params(req.query, body, override=True)
         search_type = req.query.get("search_type", "query_then_fetch")
         client.search(index, body, wrap_client_cb(done),
                       search_type=search_type)
@@ -229,6 +240,13 @@ def build_controller(client: NodeClient) -> RestController:
         i = 0
         while i + 1 <= len(lines) - 1:
             header, body = lines[i], lines[i + 1]
+            # request-level allow_partial_search_results threads into each
+            # line's body; a per-line header value overrides the query
+            # param, and an explicit per-line body value wins over both
+            merged = {**req.query,
+                      **{k: v for k, v in header.items() if k != "index"}}
+            body = _thread_search_params(
+                merged, dict(body), keys=("allow_partial_search_results",))
             pairs.append((header.get("index",
                                      req.params.get("index", "_all")), body))
             i += 2
@@ -624,8 +642,11 @@ def build_controller(client: NodeClient) -> RestController:
     # -- async search (x-pack/plugin/async-search REST surface) -----------
 
     def async_submit(req: RestRequest, done: DoneFn) -> None:
+        # submit params mirror _search: allow_partial_search_results (and
+        # the [timeout] budget) thread into the underlying search body
+        body = _thread_search_params(req.query, dict(req.body or {}))
         client.node.async_search.submit(
-            req.params["index"], req.body or {}, wrap_client_cb(done),
+            req.params["index"], body, wrap_client_cb(done),
             wait_for_completion=req.query.get(
                 "wait_for_completion_timeout"),
             keep_alive=req.query.get("keep_alive"),
@@ -1423,7 +1444,7 @@ def build_controller(client: NodeClient) -> RestController:
                 "deciders": [d for d in per_decider
                              if d["decision"] != Decision.YES] or
                             per_decider[:1]})
-        done(200, {
+        explanation = {
             "index": target.index, "shard": target.shard_id,
             "primary": target.primary,
             "current_state": target.state.value.lower(),
@@ -1432,7 +1453,15 @@ def build_controller(client: NodeClient) -> RestController:
             "can_allocate":
                 "yes" if any(d["node_decision"] == "yes"
                              for d in decisions) else "no",
-            "node_allocation_decisions": decisions})
+            "node_allocation_decisions": decisions}
+        if target.unassigned_reason or target.failed_attempts:
+            # why the last copy died (UnassignedInfo.getDetails): this is
+            # where a corruption-marked store becomes operator-visible
+            explanation["unassigned_info"] = {
+                "reason": target.unassigned_reason,
+                "failed_allocation_attempts": target.failed_attempts,
+            }
+        done(200, explanation)
     r("GET", "/_cluster/allocation/explain", allocation_explain)
     r("POST", "/_cluster/allocation/explain", allocation_explain)
 
@@ -1606,9 +1635,10 @@ def build_controller(client: NodeClient) -> RestController:
                 continue
             rows.append([sr.index, str(sr.shard_id),
                          "p" if sr.primary else "r",
-                         sr.state.value, sr.node_id or "-"])
-        done(200, _cat(req, ["index", "shard", "prirep", "state", "node"],
-                       rows))
+                         sr.state.value, sr.node_id or "-",
+                         sr.unassigned_reason or "-"])
+        done(200, _cat(req, ["index", "shard", "prirep", "state", "node",
+                             "unassigned.reason"], rows))
     r("GET", "/_cat/shards", cat_shards)
     r("GET", "/_cat/shards/{index}", cat_shards)
 

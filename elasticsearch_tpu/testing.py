@@ -9,13 +9,116 @@ disruption rules (NetworkDisruption analog).
 
 from __future__ import annotations
 
+import errno
+import os
+import random as _random
+from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.cluster.coordination import CoordinatorSettings, Mode
 from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.index.disk_io import FOOTER_SIZE, DiskIO
 from elasticsearch_tpu.node.node import Node, NodeClient
 from elasticsearch_tpu.transport.scheduler import DeterministicScheduler
 from elasticsearch_tpu.transport.transport import InMemoryTransport
+
+
+class FaultyDiskIO(DiskIO):
+    """The disk fault injector: a DiskIO whose operations can be armed to
+    fail or corrupt, plus at-rest corruption helpers for files already on
+    disk. All randomness draws from the injected (seeded) RNG, so every
+    fault interleaving is reproducible (MockDirectoryWrapper +
+    CorruptionUtils analog of the reference test framework).
+
+    Write-path faults (``arm``): 'eio' / 'enospc' raise OSError; 'bit_flip'
+    flips one random bit of the payload; 'truncate' drops a random tail.
+    Rules filter by path substring and operation (write/append/read), and
+    can be limited to a fault count.
+    """
+
+    def __init__(self, rng: Optional[_random.Random] = None):
+        self.random = rng or _random.Random(0)
+        self.rules: List[Dict[str, Any]] = []
+        self.stats = {"bit_flips": 0, "truncations": 0, "io_errors": 0}
+
+    # -- armed (in-flight) faults ---------------------------------------
+
+    def arm(self, kind: str, match: str = "", op: str = "*",
+            count: Optional[int] = None) -> Dict[str, Any]:
+        """Arm a fault rule; returns it (pass to disarm, or mutate
+        ``rule['remaining']``). kind: eio|enospc|bit_flip|truncate."""
+        assert kind in ("eio", "enospc", "bit_flip", "truncate"), kind
+        rule = {"kind": kind, "match": match, "op": op, "remaining": count}
+        self.rules.append(rule)
+        return rule
+
+    def disarm(self, rule: Optional[Dict[str, Any]] = None) -> None:
+        if rule is None:
+            self.rules.clear()
+        elif rule in self.rules:
+            self.rules.remove(rule)
+
+    def _fault(self, op: str, path: Path, data: bytes) -> bytes:
+        for rule in list(self.rules):
+            if rule["remaining"] is not None and rule["remaining"] <= 0:
+                continue
+            if rule["op"] not in ("*", op):
+                continue
+            if rule["match"] and rule["match"] not in str(path):
+                continue
+            if rule["remaining"] is not None:
+                rule["remaining"] -= 1
+            kind = rule["kind"]
+            if kind == "eio":
+                self.stats["io_errors"] += 1
+                raise OSError(errno.EIO,
+                              f"injected I/O error on [{path.name}]")
+            if kind == "enospc":
+                self.stats["io_errors"] += 1
+                raise OSError(errno.ENOSPC,
+                              f"injected disk-full on [{path.name}]")
+            if kind == "bit_flip" and data:
+                data = self._flip_one_bit(data)
+                self.stats["bit_flips"] += 1
+            elif kind == "truncate" and data:
+                data = data[: self.random.randrange(0, len(data))]
+                self.stats["truncations"] += 1
+        return data
+
+    def _flip_one_bit(self, data: bytes) -> bytes:
+        buf = bytearray(data)
+        i = self.random.randrange(len(buf))
+        buf[i] ^= 1 << self.random.randrange(8)
+        return bytes(buf)
+
+    # -- at-rest corruption ---------------------------------------------
+
+    def corrupt_file(self, path: str | Path, skip_footer: bool = False
+                     ) -> int:
+        """Flip one random bit of a file in place (a cosmic ray / rotting
+        sector). ``skip_footer=True`` keeps the flip inside the payload
+        region so the test exercises payload CRC, not footer damage.
+        Returns the flipped byte offset."""
+        path = Path(path)
+        data = bytearray(path.read_bytes())
+        limit = len(data) - (FOOTER_SIZE if skip_footer else 0)
+        i = self.random.randrange(limit)
+        data[i] ^= 1 << self.random.randrange(8)
+        path.write_bytes(bytes(data))
+        return i
+
+    def truncate_file(self, path: str | Path,
+                      drop_bytes: Optional[int] = None) -> int:
+        """Cut a random (or given) number of tail bytes off a file — a
+        torn write that never completed. Returns bytes dropped."""
+        path = Path(path)
+        size = path.stat().st_size
+        if drop_bytes is None:
+            drop_bytes = self.random.randrange(1, max(size, 2))
+        drop_bytes = min(drop_bytes, size)
+        with open(path, "r+b") as f:
+            f.truncate(size - drop_bytes)
+        return drop_bytes
 
 
 class InProcessCluster:
@@ -25,19 +128,30 @@ class InProcessCluster:
         self.scheduler = DeterministicScheduler(seed=seed)
         self.transport = InMemoryTransport(self.scheduler)
         self.data_path = data_path
+        # every shard Store/Translog on every node writes through this
+        # seeded injector; quiescent (no armed rules) it is a plain DiskIO
+        self.disk_io = FaultyDiskIO(_random.Random(seed ^ 0x5EED))
         node_ids = [f"node{i}" for i in range(n_nodes)]
+        self._node_ids = node_ids
+        self._mesh_data_plane = mesh_data_plane
         # bootstrap: the initial voting configuration is the full seed set
         # (ClusterBootstrapService analog)
         initial = ClusterState(voting_config=frozenset(node_ids))
+        self._initial_state = initial
         self.nodes: Dict[str, Node] = {}
         for nid in node_ids:
-            self.nodes[nid] = Node(
-                nid, self.transport, self.scheduler,
-                seed_peers=node_ids,
-                data_path=(f"{data_path}/{nid}" if data_path else None),
-                initial_state=initial,
-                coordinator_settings=CoordinatorSettings(),
-                mesh_data_plane=mesh_data_plane)
+            self.nodes[nid] = self._build_node(nid)
+
+    def _build_node(self, nid: str) -> Node:
+        return Node(
+            nid, self.transport, self.scheduler,
+            seed_peers=self._node_ids,
+            data_path=(f"{self.data_path}/{nid}" if self.data_path
+                       else None),
+            initial_state=self._initial_state,
+            coordinator_settings=CoordinatorSettings(),
+            mesh_data_plane=self._mesh_data_plane,
+            disk_io=self.disk_io)
 
     # ------------------------------------------------------------------
 
@@ -142,6 +256,30 @@ class InProcessCluster:
 
     def restart_node(self, node_id: str) -> None:
         self.transport.restore(node_id)
+
+    def reboot_node(self, node_id: str) -> None:
+        """Full process restart: stop the node (in-memory state lost) and
+        boot a fresh Node over the same data path — cluster metadata comes
+        back through the gateway, shard data through store/translog
+        recovery (where integrity checks run)."""
+        node = self.nodes.pop(node_id)
+        node.stop()
+        fresh = self._build_node(node_id)
+        self.nodes[node_id] = fresh
+        fresh.start()
+
+    def shard_store_path(self, node_id: str, index: str, shard: int
+                         ) -> Optional[str]:
+        """This node's on-disk store directory for one shard copy (the
+        chaos suite corrupts files under it)."""
+        if self.data_path is None:
+            return None
+        node = self.nodes[node_id]
+        service = node.indices_service.indices.get(index)
+        if service is None:
+            return None
+        return os.path.join(f"{self.data_path}/{node_id}",
+                            service.metadata.uuid, str(shard))
 
     def partition(self, side_a: List[str], side_b: List[str],
                   style: str = "blackhole") -> None:

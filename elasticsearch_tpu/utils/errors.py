@@ -147,6 +147,16 @@ class TaskCancelledError(SearchEngineError):
     status = 400
 
 
+class SearchBudgetExceededError(SearchEngineError):
+    """The per-request [timeout] budget expired while a shard was still
+    collecting: the shard stops work instead of computing results the
+    coordinator has already given up on (shard-side analog of the
+    coordinator's budget timer; the reference checks the timeout inside
+    collection via QueryPhase's timeout-checking collectors)."""
+
+    status = 503
+
+
 class TransportError(SearchEngineError):
     status = 500
 
@@ -164,6 +174,17 @@ class SettingsError(IllegalArgumentError):
 
 
 class SnapshotError(SearchEngineError):
+    status = 500
+
+
+class ShardCorruptedError(SearchEngineError):
+    """On-disk data failed checksum verification (or a corruption marker
+    is present). The shard must not serve from this store copy.
+
+    Reference analog: Lucene's CorruptIndexException surfaced through
+    Store.markStoreCorrupted / Store.failIfCorrupted.
+    """
+
     status = 500
 
 

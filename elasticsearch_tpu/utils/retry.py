@@ -20,7 +20,7 @@ from typing import Any, Callable, List, Optional
 
 from elasticsearch_tpu.transport.scheduler import Scheduler
 
-__all__ = ["RetryableAction"]
+__all__ = ["RetryableAction", "retry_transient", "transient_cluster_error"]
 
 AttemptFn = Callable[[Callable[[Optional[dict], Optional[Exception]], None]],
                      None]
@@ -101,3 +101,48 @@ class RetryableAction:
             self.attempt(cb)
         except Exception as e:  # noqa: BLE001 — sync throw = failed attempt
             cb(None, e)
+
+
+def transient_cluster_error(err: Any, retry_timeouts: bool = False) -> bool:
+    """THE transient-failure classifier for control-plane retries (master
+    round-trips, ILM/SLM steps, shard-state reports): no elected master
+    mid-election, an unreachable node, or a cluster block that a later
+    state may lift. Remote errors arrive as RemoteTransportError whose
+    message names the cause type, hence the string checks.
+
+    ``retry_timeouts`` gates ReceiveTimeoutError: a timed-out request has
+    an AMBIGUOUS outcome (the server may have executed it), so only
+    callers whose action is idempotent on the receiver (e.g. shard-failed
+    reports, recovery-start) may pass True. Non-idempotent mutations like
+    create_snapshot must leave it False — an automatic resend would trade
+    a lost ack for a spurious already-exists failure; their periodic
+    services re-drive on the next tick where actual state is observable."""
+    from elasticsearch_tpu.transport.transport import (
+        ConnectTransportError,
+    )
+    from elasticsearch_tpu.utils.errors import (
+        ClusterBlockError, NotMasterError, ReceiveTimeoutError,
+    )
+    if retry_timeouts and isinstance(err, ReceiveTimeoutError):
+        return True
+    if isinstance(err, (NotMasterError, ClusterBlockError,
+                        ConnectTransportError)):
+        return True
+    text = str(err)
+    return ("NotMasterError" in text or "ClusterBlockError" in text
+            or "not connected" in text)
+
+
+def retry_transient(scheduler: Scheduler, attempt: AttemptFn,
+                    on_done: DoneFn, *,
+                    initial_delay: float = 0.5,
+                    max_delay: float = 5.0,
+                    timeout: float = 30.0) -> RetryableAction:
+    """A RetryableAction preconfigured for transient control-plane
+    failures; returns the (already running) action."""
+    action = RetryableAction(scheduler, attempt, on_done,
+                             initial_delay=initial_delay,
+                             max_delay=max_delay, timeout=timeout,
+                             is_retryable=transient_cluster_error)
+    action.run()
+    return action

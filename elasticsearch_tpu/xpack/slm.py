@@ -22,6 +22,7 @@ import logging
 from typing import Any, Callable, Dict, Optional
 
 from elasticsearch_tpu.utils.errors import IllegalArgumentError
+from elasticsearch_tpu.utils.retry import retry_transient
 from elasticsearch_tpu.utils.settings import parse_time_to_seconds
 
 logger = logging.getLogger(__name__)
@@ -131,6 +132,11 @@ class SnapshotLifecycleService:
         config = dict(policy.get("config") or {})
 
         def taken(resp, err) -> None:
+            if err is not None and "already exists" in str(err):
+                # a previous attempt's ack was lost: the snapshot IS in
+                # the repo under this counter's name — record success so
+                # the counter advances instead of colliding forever
+                err = None
             if err is not None:
                 self.stats["failures"] += 1
                 logger.warning("slm snapshot failed for [%s]: %s",
@@ -155,8 +161,14 @@ class SnapshotLifecycleService:
             "section": SECTION, "name": policy_id,
             "body": {**policy, "_last_run_ms": now_ms}},
             lambda _r, _e: None)
-        self.node.client.create_snapshot(
-            policy["repository"], snap_name, config, taken)
+        # the snapshot step retries through transient control-plane
+        # failures (mid-election, unreachable node) with jittered backoff
+        # instead of burning the whole schedule interval on one blip
+        retry_transient(
+            self.node.scheduler,
+            lambda cb: self.node.client.create_snapshot(
+                policy["repository"], snap_name, config, cb),
+            taken)
 
     # -- retention -------------------------------------------------------
 

@@ -1,0 +1,697 @@
+"""Disk-fault chaos suite: end-to-end data integrity under seeded
+bit-flips, torn writes, and EIO/ENOSPC injection.
+
+The storage path must treat corruption as a routing event, not a crash:
+a corrupted copy fails with ShardCorruptedError, gets a corruption
+marker so it can never be reopened or promoted, the master promotes a
+clean replica and re-replicates to green, and a torn translog tail
+recovers by truncating the partial record while every fully-synced op
+replays.
+
+Reference analogs: Lucene CRC32 footers / CorruptIndexException,
+Store.markStoreCorrupted, TranslogReader's torn-tail handling, and the
+CorruptedFileIT / CorruptedTranslogIT disruption suites.
+"""
+
+import glob
+import os
+
+import pytest
+
+from elasticsearch_tpu.index import InternalEngine, Store, Translog
+from elasticsearch_tpu.index.translog import TranslogCorruptedError
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.testing import FaultyDiskIO, InProcessCluster
+from elasticsearch_tpu.utils.errors import ShardCorruptedError
+
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _primary_node(cluster, index, shard=0):
+    irt = cluster.master().coordinator.applied_state.routing_table.index(
+        index)
+    return irt.primary(shard).node_id
+
+
+def _primary_routing(cluster, index, shard=0):
+    irt = cluster.master().coordinator.applied_state.routing_table.index(
+        index)
+    return irt.primary(shard)
+
+
+def _store_dir(cluster, node_id, index, shard=0):
+    return os.path.join(cluster.shard_store_path(node_id, index, shard),
+                        "index")
+
+
+def _translog_dir(cluster, node_id, index, shard=0):
+    return os.path.join(cluster.shard_store_path(node_id, index, shard),
+                        "translog")
+
+
+# ---------------------------------------------------------------------------
+# unit level: every artifact carries + verifies a CRC32 footer
+# ---------------------------------------------------------------------------
+
+def _small_engine(tmp_path, name="u"):
+    svc = MapperService({"properties": {"t": {"type": "text"},
+                                        "n": {"type": "long"}}})
+    store = Store(tmp_path / name / "index")
+    tl = Translog(tmp_path / name / "translog")
+    eng = InternalEngine(svc, store=store, translog=tl, shard_label=name)
+    return svc, store, tl, eng
+
+
+def test_store_detects_bitflip_in_every_artifact(tmp_path):
+    io = FaultyDiskIO()
+    _svc, store, _tl, eng = _small_engine(tmp_path)
+    for i in range(4):
+        eng.index(f"d{i}", {"t": f"doc {i}", "n": i})
+    eng.refresh()
+    eng.delete("d0")
+    eng.flush()
+    seg_name = eng.segments[0].name
+    seg_dir = store.path / "segments"
+
+    # live-mask persistence: delete after the commit, flush only the mask
+    eng.delete("d1")
+    eng.flush()
+
+    cases = [
+        (seg_dir / f"{seg_name}.npz", lambda: store.read_segment(seg_name)),
+        (seg_dir / f"{seg_name}.meta.json",
+         lambda: store.read_segment(seg_name)),
+        (seg_dir / f"{seg_name}.liv.npy",
+         lambda: store.read_live_mask(seg_name)),
+        (next(store.path.glob("commit-*.json")),
+         store.read_latest_commit),
+    ]
+    for path, read_back in cases:
+        pristine = path.read_bytes()
+        read_back()                      # sanity: verifies clean
+        io.corrupt_file(path)
+        with pytest.raises(ShardCorruptedError):
+            read_back()
+        path.write_bytes(pristine)       # restore for the next case
+    eng.close()
+
+
+def test_store_detects_truncated_artifact(tmp_path):
+    io = FaultyDiskIO()
+    _svc, store, _tl, eng = _small_engine(tmp_path)
+    eng.index("a", {"t": "hello", "n": 1})
+    eng.flush()
+    npz = store.path / "segments" / f"{eng.segments[0].name}.npz"
+    io.truncate_file(npz, drop_bytes=5)
+    with pytest.raises(ShardCorruptedError):
+        store.read_segment(eng.segments[0].name)
+    eng.close()
+
+
+def test_corruption_marker_blocks_reopen_until_cleared(tmp_path):
+    store = Store(tmp_path / "m")
+    store.mark_corrupted("checksum mismatch in [seg.npz]")
+    assert store.is_corrupted
+    assert "checksum mismatch" in store.corruption_reason()
+    with pytest.raises(ShardCorruptedError):
+        store.ensure_not_corrupted()
+    # idempotent: the FIRST cause is kept
+    store.mark_corrupted("later, different failure")
+    assert "checksum mismatch" in store.corruption_reason()
+    assert len(list(store.path.glob("corrupted_*"))) == 1
+    assert store.clear_corruption_markers() == 1
+    store.ensure_not_corrupted()   # no marker, no raise
+
+
+def test_verify_integrity_walks_the_commit(tmp_path):
+    io = FaultyDiskIO()
+    _svc, store, _tl, eng = _small_engine(tmp_path)
+    for i in range(3):
+        eng.index(f"d{i}", {"t": f"text {i}", "n": i})
+    eng.flush()
+    assert store.verify_integrity()["files_verified"] >= 3
+    meta = store.path / "segments" / f"{eng.segments[0].name}.meta.json"
+    io.corrupt_file(meta)
+    with pytest.raises(ShardCorruptedError):
+        store.verify_integrity()
+    eng.close()
+
+
+def test_check_on_startup_checksum_gates_recovery(tmp_path):
+    io = FaultyDiskIO()
+    svc, store, tl, eng = _small_engine(tmp_path)
+    eng.index("a", {"t": "persisted", "n": 1})
+    eng.flush()
+    eng.close()
+    io.corrupt_file(store.path / "segments"
+                    / f"{eng.segments[0].name}.npz")
+    eng2 = InternalEngine(svc, store=Store(store.path),
+                          translog=Translog(tmp_path / "u" / "translog"),
+                          check_on_startup="checksum")
+    with pytest.raises(ShardCorruptedError):
+        eng2.recover_from_store()
+    assert eng2.failed
+    # the failure wrote a corruption marker: reopening now refuses fast
+    assert Store(store.path).is_corrupted
+    eng2.close()
+
+
+def test_engine_fails_and_marks_store_on_corrupt_recovery(tmp_path):
+    io = FaultyDiskIO()
+    svc, store, tl, eng = _small_engine(tmp_path)
+    eng.index("a", {"t": "x", "n": 1})
+    eng.flush()
+    eng.close()
+    io.corrupt_file(store.path / "segments"
+                    / f"{eng.segments[0].name}.meta.json")
+    failures = []
+    eng2 = InternalEngine(svc, store=Store(store.path),
+                          translog=Translog(tmp_path / "u" / "translog"))
+    eng2.failure_listeners.append(lambda r, e: failures.append((r, e)))
+    with pytest.raises(ShardCorruptedError):
+        eng2.recover_from_store()
+    assert len(failures) == 1
+    assert isinstance(failures[0][1], ShardCorruptedError)
+    assert Store(store.path).is_corrupted
+    eng2.close()
+
+
+def test_armed_eio_and_enospc_fail_the_engine(tmp_path):
+    io = FaultyDiskIO()
+    svc = MapperService({"properties": {"t": {"type": "text"}}})
+    store = Store(tmp_path / "e" / "index", disk_io=io)
+    tl = Translog(tmp_path / "e" / "translog", disk_io=io)
+    eng = InternalEngine(svc, store=store, translog=tl)
+    eng.index("a", {"t": "ok"})
+
+    rule = io.arm("eio", match="/index/", op="write")
+    with pytest.raises(OSError):
+        eng.flush()
+    assert eng.failed and "flush failed" in eng.failure_reason
+    io.disarm(rule)
+
+    # ENOSPC on the WAL: the write is NOT durable, so indexing must raise
+    io2 = FaultyDiskIO()
+    tl2 = Translog(tmp_path / "e2" / "translog", disk_io=io2)
+    eng2 = InternalEngine(svc, translog=tl2)
+    io2.arm("enospc", op="append")
+    with pytest.raises(OSError):
+        eng2.index("b", {"t": "lost"})
+    assert eng2.failed
+    eng2.close()
+
+
+def test_translog_mid_generation_corruption_vs_torn_tail(tmp_path):
+    io = FaultyDiskIO()
+    tl = Translog(tmp_path / "tl")
+    from elasticsearch_tpu.index.translog import TranslogOp
+    for i in range(4):
+        tl.add(TranslogOp("index", i, doc_id=f"d{i}", source={"v": i}))
+    path = tl._gen_path(tl.generation)
+    tl.close()
+
+    # torn tail: a partial record appended by a crash mid-write is
+    # truncated at reopen and the synced prefix replays in full
+    with open(path, "ab") as f:
+        f.write(b"\x99\x00\x00\x00\x01\x02")
+    tl2 = Translog(tmp_path / "tl")
+    assert tl2.truncated_tail_bytes == 6
+    assert [op.seqno for op in tl2.read_all()] == [0, 1, 2, 3]
+    tl2.close()
+
+    # mid-generation bit flip: NOT a tail — corruption, shard must fail
+    data = bytearray(path.read_bytes())
+    data[12] ^= 0x40
+    path.write_bytes(bytes(data))
+    tl3 = Translog(tmp_path / "tl")
+    with pytest.raises(TranslogCorruptedError):
+        list(tl3.read_all())
+    tl3.close()
+
+
+def test_translog_header_bitflip_is_corruption_not_truncation(tmp_path):
+    """A bit-flip in a record's LENGTH PREFIX (not covered by the payload
+    CRC) makes the record 'run past EOF' — exactly like a torn tail. But
+    fsynced history follows it, so tail recovery must NOT truncate (that
+    would silently destroy acknowledged ops); the read path must raise."""
+    from elasticsearch_tpu.index.translog import TranslogOp
+    tl = Translog(tmp_path / "hb")
+    for i in range(5):
+        tl.add(TranslogOp("index", i, doc_id=f"d{i}", source={"v": i}))
+    path = tl._gen_path(tl.generation)
+    tl.close()
+    data = bytearray(path.read_bytes())
+    data[1] ^= 0x40   # record 0's length prefix balloons past EOF
+    path.write_bytes(bytes(data))
+    size_before = path.stat().st_size
+
+    tl2 = Translog(tmp_path / "hb")
+    assert tl2.truncated_tail_bytes == 0          # nothing destroyed
+    assert path.stat().st_size == size_before     # file left intact
+    with pytest.raises(TranslogCorruptedError):
+        list(tl2.read_all())
+    tl2.close()
+
+    # the same flip on a SINGLE fsynced record: no later record proves
+    # history, but the CHECKPOINT does — the anomaly sits below the
+    # synced offset, so this is corruption too, never truncation
+    tl3 = Translog(tmp_path / "single")
+    tl3.add(TranslogOp("index", 0, doc_id="a", source={"v": 0}))
+    p3 = tl3._gen_path(tl3.generation)
+    tl3.close()
+    d3 = bytearray(p3.read_bytes())
+    d3[1] ^= 0x40
+    p3.write_bytes(bytes(d3))
+    tl4 = Translog(tmp_path / "single")
+    assert tl4.truncated_tail_bytes == 0      # acked op NOT dropped
+    with pytest.raises(TranslogCorruptedError):
+        list(tl4.read_all())
+    tl4.close()
+
+
+def test_snapshot_blob_hash_verification(tmp_path):
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.repositories import FsRepository
+    io = FaultyDiskIO()
+    svc = MapperService({"properties": {"t": {"type": "text"}}})
+    b = SegmentBuilder("snap_seg", svc)
+    b.add(svc.parse_document("1", {"t": "snapshot me"}), seqno=0)
+    repo = FsRepository(str(tmp_path / "repo"))
+    sha = repo.put_segment(b.build())
+    assert repo.get_segment(sha).ids == ["1"]
+    io.corrupt_file(tmp_path / "repo" / "blobs" / f"{sha}.npz")
+    with pytest.raises(ShardCorruptedError):
+        repo.get_segment(sha)
+
+
+# ---------------------------------------------------------------------------
+# cluster level: corruption-driven failover and re-replication
+# ---------------------------------------------------------------------------
+
+def _corruption_failover_scenario(tmp_path, seed):
+    """index → corrupt the primary's commit point at rest → flush trips
+    the checksum → ShardCorruptedError fails the shard → marker written →
+    replica promoted → re-replicated to green → zero wrong hits."""
+    c = InProcessCluster(n_nodes=3, seed=seed,
+                         data_path=str(tmp_path / f"data{seed}"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("di", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 1}}, cb)))
+        c.ensure_green("di")
+        for i in range(20):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "di", f"d{i}", {"title": f"integrity doc {i}", "n": i},
+                cb)))
+        _ok(*c.call(lambda cb: client.flush("di", cb)))
+
+        victim = _primary_node(c, "di")
+        old_primary = _primary_routing(c, "di")
+        store_dir = _store_dir(c, victim, "di")
+        commit = glob.glob(os.path.join(store_dir, "commit-*.json"))[0]
+        c.disk_io.corrupt_file(commit)
+
+        # one more doc so the next flush has work on both copies
+        _ok(*c.call(lambda cb: client.index_doc(
+            "di", "d20", {"title": "integrity doc 20", "n": 20}, cb)))
+        c.call(lambda cb: client.flush("di", cb))
+
+        # detection -> marker on the corrupted copy
+        c.run_until(lambda: glob.glob(
+            os.path.join(store_dir, "corrupted_*")) != [], 120.0)
+
+        # failover: a DIFFERENT allocation serves as primary
+        def promoted():
+            sr = _primary_routing(c, "di")
+            return sr.active and sr.allocation_id != \
+                old_primary.allocation_id
+        c.run_until(promoted, 300.0)
+        assert _primary_node(c, "di") != victim
+
+        # the bad disk recovers (transient fault model): re-replication
+        # may land the fresh replica back on the victim's (wiped) path
+        c.ensure_green("di", max_time=600.0)
+        c.call(lambda cb: client.refresh("di", cb))
+        coordinator = next(n for n in c.nodes if n != victim)
+        resp, err = c.call(lambda cb: c.client(coordinator).search(
+            "di", {"query": {"match": {"title": "integrity"}},
+                   "size": 30, "track_total_hits": True}, cb),
+            max_time=600.0)
+        _ok(resp, err)
+        assert resp["_shards"]["failed"] == 0
+        assert resp["hits"]["total"]["value"] == 21
+        ids = {h["_id"] for h in resp["hits"]["hits"]}
+        assert ids == {f"d{i}" for i in range(21)}   # zero wrong hits
+
+        # checksum re-verification: every surviving copy's store verifies
+        state = c.master().coordinator.applied_state
+        for sr in state.routing_table.index("di").all_shards():
+            if not sr.active:
+                continue
+            shard = c.nodes[sr.node_id].indices_service.shard(
+                "di", sr.shard_id)
+            shard.engine.flush()
+            assert shard.engine.store.verify_integrity()[
+                "files_verified"] > 0
+    finally:
+        c.stop()
+
+
+@pytest.mark.parametrize("seed", [41 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_corrupted_primary_fails_over_and_rereplicates_green(
+        tmp_path, seed):
+    _corruption_failover_scenario(tmp_path, seed)
+
+
+def test_eio_on_commit_fails_primary_over_to_replica(tmp_path):
+    """Write-path EIO (dying disk) during flush: the engine fails, the
+    shard is failed to the master, the replica takes over."""
+    c = InProcessCluster(n_nodes=3, seed=43,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("ei", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 1}}, cb)))
+        c.ensure_green("ei")
+        for i in range(10):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "ei", f"d{i}", {"n": i}, cb)))
+        victim = _primary_node(c, "ei")
+        old_primary = _primary_routing(c, "ei")
+        # EIO on every store write under the victim's copy of this shard
+        rule = c.disk_io.arm(
+            "eio", match=_store_dir(c, victim, "ei"), op="write")
+        c.call(lambda cb: client.flush("ei", cb))
+
+        def promoted():
+            sr = _primary_routing(c, "ei")
+            return sr.active and sr.allocation_id != \
+                old_primary.allocation_id
+        c.run_until(promoted, 300.0)
+        assert _primary_node(c, "ei") != victim
+        c.disk_io.disarm(rule)          # the disk got replaced
+
+        c.ensure_green("ei", max_time=600.0)
+        c.call(lambda cb: client.refresh("ei", cb))
+        resp, err = c.call(lambda cb: client.search(
+            "ei", {"query": {"match_all": {}}, "size": 20,
+                   "track_total_hits": True}, cb), max_time=600.0)
+        _ok(resp, err)
+        assert resp["hits"]["total"]["value"] == 10
+        assert resp["_shards"]["failed"] == 0
+    finally:
+        c.stop()
+
+
+def test_at_rest_bitflip_marks_store_red_with_reason(tmp_path):
+    """Single-copy index, at-rest segment bit-flip, process reboot: store
+    recovery fails with ShardCorruptedError, the marker keeps every retry
+    from reopening the store, the shard ends RED with the corruption
+    reason surfaced through routing (allocation explain), and the
+    corrupted copy is NEVER served."""
+    c = InProcessCluster(n_nodes=1, seed=47,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("ar", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("ar")
+        for i in range(10):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "ar", f"d{i}", {"n": i}, cb)))
+        _ok(*c.call(lambda cb: client.flush("ar", cb)))
+
+        store_dir = _store_dir(c, "node0", "ar")
+        npz = glob.glob(os.path.join(store_dir, "segments", "*.npz"))[0]
+        c.disk_io.corrupt_file(npz)
+        c.reboot_node("node0")
+
+        def exhausted():
+            master = c.master()
+            if master is None:
+                return False
+            state = master.coordinator.applied_state
+            if not state.routing_table.has_index("ar"):
+                return False
+            sr = state.routing_table.index("ar").primary(0)
+            return (not sr.assigned and sr.failed_attempts >= 5 and
+                    sr.unassigned_reason is not None)
+        c.run_until(exhausted, 600.0)
+
+        sr = _primary_routing(c, "ar")
+        reason = sr.unassigned_reason.lower()
+        assert "corrupt" in reason or "checksum" in reason
+        assert glob.glob(os.path.join(store_dir, "corrupted_*"))
+        health = c.client().cluster_health("ar")
+        assert health["status"] == "red"
+
+        # never served: the search errors out instead of returning bytes
+        # from the corrupted copy
+        resp, err = c.call(lambda cb: c.client().search(
+            "ar", {"query": {"match_all": {}}}, cb), max_time=600.0)
+        assert err is not None
+
+        # operator surface: allocation explain reports the reason
+        from elasticsearch_tpu.rest.controller import RestRequest
+        from elasticsearch_tpu.rest.routes import build_controller
+        controller = build_controller(c.client())
+        out = []
+        controller.dispatch(
+            RestRequest(method="GET", path="/_cluster/allocation/explain",
+                        query={}, body=None, raw_body=b""),
+            lambda s, b: out.append((s, b)))
+        c.run_until(lambda: bool(out), 120.0)
+        status, body = out[0]
+        assert status == 200
+        info = body["unassigned_info"]
+        assert info["failed_allocation_attempts"] >= 5
+        assert "corrupt" in info["reason"].lower() or \
+            "checksum" in info["reason"].lower()
+    finally:
+        c.stop()
+
+
+def test_torn_translog_tail_truncated_all_synced_ops_replayed(tmp_path):
+    """Crash mid-append: the torn partial record is truncated at reopen,
+    every fully-synced op replays, and the recovered store verifies."""
+    c = InProcessCluster(n_nodes=1, seed=53,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("tt", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("tt")
+        for i in range(5):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "tt", f"d{i}", {"n": i}, cb)))
+        # NO flush: the 5 ops live only in the fsynced translog. A 6th
+        # append is cut short by the crash (never acked).
+        tlog = glob.glob(os.path.join(
+            _translog_dir(c, "node0", "tt"), "translog-*.log"))[0]
+        with open(tlog, "ab") as f:
+            f.write(b"\x7f\x00\x00\x00\xde\xad")
+        c.reboot_node("node0")
+        c.ensure_green("tt", max_time=600.0)
+
+        shard = c.nodes["node0"].indices_service.shard("tt", 0)
+        assert shard.engine.translog.truncated_tail_bytes == 6
+        assert shard.engine.doc_count == 5
+
+        c.call(lambda cb: c.client().refresh("tt", cb))
+        resp, err = c.call(lambda cb: c.client().search(
+            "tt", {"query": {"match_all": {}}, "size": 10,
+                   "track_total_hits": True}, cb), max_time=600.0)
+        _ok(resp, err)
+        assert resp["hits"]["total"]["value"] == 5
+        assert {h["_id"] for h in resp["hits"]["hits"]} == \
+            {f"d{i}" for i in range(5)}
+
+        # checksum re-verification after recovery
+        assert shard.engine.store.verify_integrity()["files_verified"] > 0
+        assert shard.engine.translog.verify() >= 0
+    finally:
+        c.stop()
+
+
+def test_mid_translog_corruption_fails_shard_not_truncates(tmp_path):
+    """A bit-flip INSIDE retained translog history is not a tail: replay
+    must fail the shard (corruption marker + red), never silently drop
+    acknowledged operations."""
+    c = InProcessCluster(n_nodes=1, seed=59,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("mc", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("mc")
+        for i in range(5):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "mc", f"d{i}", {"n": i}, cb)))
+        tlog = glob.glob(os.path.join(
+            _translog_dir(c, "node0", "mc"), "translog-*.log"))[0]
+        # flip a payload bit of the FIRST record (offset 8 = header end)
+        data = bytearray(open(tlog, "rb").read())
+        data[10] ^= 0x10
+        open(tlog, "wb").write(bytes(data))
+        c.reboot_node("node0")
+
+        def failed():
+            master = c.master()
+            if master is None:
+                return False
+            state = master.coordinator.applied_state
+            if not state.routing_table.has_index("mc"):
+                return False
+            sr = state.routing_table.index("mc").primary(0)
+            return not sr.assigned and sr.failed_attempts >= 1 and \
+                sr.unassigned_reason is not None
+        c.run_until(failed, 600.0)
+        sr = _primary_routing(c, "mc")
+        assert "translog" in sr.unassigned_reason.lower() or \
+            "corrupt" in sr.unassigned_reason.lower()
+        assert glob.glob(os.path.join(
+            _store_dir(c, "node0", "mc"), "corrupted_*"))
+    finally:
+        c.stop()
+
+
+def test_corrupted_snapshot_blob_fails_restore_not_garbage(tmp_path):
+    """A rotted repository blob must fail the restore with a clear error,
+    never materialize a wrong index."""
+    c = InProcessCluster(n_nodes=1, seed=61,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("sb", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("sb")
+        for i in range(6):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "sb", f"d{i}", {"n": i}, cb)))
+        c.call(lambda cb: client.refresh("sb", cb))
+        _ok(*c.call(lambda cb: client.put_repository(
+            "cr", {"type": "fs",
+                   "settings": {"location": str(tmp_path / "repo")}}, cb)))
+        resp, err = c.call(lambda cb: client.create_snapshot(
+            "cr", "s1", {"indices": "sb"}, cb))
+        _ok(resp, err)
+        blob = glob.glob(str(tmp_path / "repo" / "blobs" / "*.npz"))[0]
+        c.disk_io.corrupt_file(blob)
+        resp, err = c.call(lambda cb: client.restore_snapshot(
+            "cr", "s1", {"rename_pattern": "sb",
+                         "rename_replacement": "rs"}, cb),
+            max_time=600.0)
+        assert err is not None
+        assert "verification" in str(err) or "corrupt" in str(err).lower()
+    finally:
+        c.stop()
+
+
+def test_data_node_reboot_reconverges_green(tmp_path):
+    """Reboot a non-master data node in a live cluster: the master still
+    routes STARTED copies to it that its fresh process no longer has.
+    The reconciler must re-assert shard-failed for the missing copies so
+    the master reallocates and the cluster converges green — a lost or
+    impossible failure report must not leave routing diverged forever."""
+    c = InProcessCluster(n_nodes=3, seed=67,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("rb", {
+            "settings": {"number_of_shards": 2,
+                         "number_of_replicas": 1}}, cb)))
+        c.ensure_green("rb")
+        for i in range(12):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "rb", f"d{i}", {"n": i}, cb)))
+        _ok(*c.call(lambda cb: client.flush("rb", cb)))
+
+        master_id = c.master().node_id
+        victim = next(
+            n for n in c.nodes if n != master_id and
+            c.master().coordinator.applied_state.routing_table
+            .shards_on_node(n))
+        c.reboot_node(victim)
+        c.await_node_count(3)
+        c.ensure_green("rb", max_time=600.0)
+        c.call(lambda cb: client.refresh("rb", cb))
+        resp, err = c.call(lambda cb: client.search(
+            "rb", {"query": {"match_all": {}}, "size": 20,
+                   "track_total_hits": True}, cb), max_time=600.0)
+        _ok(resp, err)
+        assert resp["hits"]["total"]["value"] == 12
+        assert resp["_shards"]["failed"] == 0
+    finally:
+        c.stop()
+
+
+def test_sole_copy_primary_reboot_recovers_in_place_no_data_loss(tmp_path):
+    """Reboot the node holding a replicas=0 primary while the master
+    stays up: the copy must recover IN PLACE from its own committed
+    store. Failing it instead would let the balance-only allocator start
+    an EMPTY primary on another node — green-but-empty silent data
+    loss."""
+    c = InProcessCluster(n_nodes=3, seed=71,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("sc", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("sc")
+        for i in range(9):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "sc", f"d{i}", {"n": i}, cb)))
+        _ok(*c.call(lambda cb: client.flush("sc", cb)))
+
+        owner = _primary_node(c, "sc")
+        if owner == c.master().node_id:
+            # reboot the master instead would change the scenario; this
+            # seed places the shard off-master (assert to catch drift)
+            raise AssertionError("seed 71 placed the shard on the master")
+        c.reboot_node(owner)
+        c.await_node_count(3)
+        # the rejoin publication re-delivers the committed routing; the
+        # owner then recovers its copy in place from its own store
+        c.run_until(lambda: c.nodes[owner].indices_service.has_shard(
+            "sc", 0), 300.0)
+        c.ensure_green("sc", max_time=600.0)
+        # the SAME node still serves the copy, with all data intact
+        assert _primary_node(c, "sc") == owner
+        assert c.nodes[owner].indices_service.shard(
+            "sc", 0).engine.doc_count == 9
+        c.call(lambda cb: client.refresh("sc", cb))
+        resp, err = c.call(lambda cb: client.search(
+            "sc", {"query": {"match_all": {}}, "size": 20,
+                   "track_total_hits": True}, cb), max_time=600.0)
+        _ok(resp, err)
+        assert resp["hits"]["total"]["value"] == 9
+    finally:
+        c.stop()
+
+
+@pytest.mark.slow
+def test_chaos_disk_seed_sweep(tmp_path):
+    """CI sweep: the corruption-failover scenario under >=5 seeded RNGs
+    (CHAOS_SEEDS widens it further)."""
+    for k in range(max(CHAOS_SEEDS, 5)):
+        _corruption_failover_scenario(tmp_path, seed=211 + 97 * k)

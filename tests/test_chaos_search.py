@@ -9,9 +9,17 @@ allow_partial_search_results semantics in AbstractSearchAsyncAction) and
 RetryableAction.java's jittered-exponential backoff.
 """
 
+import os
+
+import pytest
+
 from elasticsearch_tpu.testing import InProcessCluster
 from elasticsearch_tpu.transport.scheduler import DeterministicScheduler
 from elasticsearch_tpu.utils.retry import RetryableAction
+
+# CHAOS_SEEDS=N repeats the seeded scenarios under N derived RNG seeds
+# (default 1 locally; CI also runs the slow-marked >=5-seed sweep)
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
 
 
 def _ok(resp, err):
@@ -177,6 +185,49 @@ def test_search_budget_expiry_returns_timed_out_partial_hits():
         _ok(resp, err)
         assert resp["timed_out"] is False
         assert len(resp["hits"]["hits"]) == 30
+    finally:
+        c.stop()
+
+
+def test_budget_binds_shard_side_not_just_at_coordinator():
+    """The [timeout] budget remaining at dispatch rides the shard query
+    request (a duration — absolute monotonic timestamps don't compare
+    across processes): a shard whose local deadline has passed stops at
+    the between-segments check with SearchBudgetExceededError instead of
+    collecting results the coordinator already abandoned, and its
+    query_total never moves."""
+    import pytest as _pytest
+
+    from elasticsearch_tpu.utils.errors import SearchBudgetExceededError
+
+    c = InProcessCluster(n_nodes=1, seed=17)
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("bs", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("bs")
+        for i in range(6):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "bs", f"d{i}", {"title": f"hello {i}"}, cb)))
+        c.call(lambda cb: client.refresh("bs", cb))
+        node = c.nodes["node0"]
+        shard = node.indices_service.shard("bs", 0)
+        before = shard.search_stats["query_total"]
+        # an exhausted budget (e.g. the request sat queued behind the
+        # bounded fan-out past the deadline) refuses before collecting
+        req = {"index": "bs", "shard": 0, "window": 10,
+               "body": {"query": {"match_all": {}}},
+               "budget_remaining": 0.0}
+        with _pytest.raises(SearchBudgetExceededError):
+            node.search_transport._on_query(req, "node0")
+        assert shard.search_stats["query_total"] == before
+        # with budget left, the same request collects normally
+        req2 = {**req, "budget_remaining": 30.0}
+        resp = node.search_transport._on_query(req2, "node0")
+        assert resp["total"] == 6
+        assert shard.search_stats["query_total"] == before + 1
     finally:
         c.stop()
 
@@ -368,11 +419,11 @@ def test_retryable_action_is_seed_deterministic():
 # crash / restart + jittered latency chaos
 # ---------------------------------------------------------------------------
 
-def test_search_survives_replica_crash_via_failover():
+def _replica_crash_failover_scenario(seed):
     """Crash a node holding shard copies: searches fail over to the
     surviving copies with NO failed shards reported (failover is
     transparent degradation), and the node rejoins after restart."""
-    c = InProcessCluster(n_nodes=3, seed=29)
+    c = InProcessCluster(n_nodes=3, seed=seed)
     c.start()
     try:
         client = c.client()
@@ -407,6 +458,19 @@ def test_search_survives_replica_crash_via_failover():
         assert resp["hits"]["total"]["value"] == 20
     finally:
         c.stop()
+
+
+@pytest.mark.parametrize("seed", [29 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_search_survives_replica_crash_via_failover(seed):
+    _replica_crash_failover_scenario(seed)
+
+
+@pytest.mark.slow
+def test_chaos_search_seed_sweep():
+    """CI sweep: the crash-failover scenario under >=5 seeded RNGs
+    (CHAOS_SEEDS widens it further)."""
+    for k in range(max(CHAOS_SEEDS, 5)):
+        _replica_crash_failover_scenario(seed=131 + 97 * k)
 
 
 def test_jittered_latency_is_seeded_and_search_correct():

@@ -137,6 +137,61 @@ def test_msearch(rest):
     assert body["responses"][1]["hits"]["total"]["value"] == 0
 
 
+def test_msearch_threads_allow_partial_per_line(rest):
+    """allow_partial_search_results reaches every per-line body: from the
+    request query param, from the per-line header, with an explicit
+    per-line body value winning — and the action layer's validation
+    (junk -> 400) proves the value actually arrived."""
+    rest("PUT", "/mp", {"settings": {"number_of_replicas": 0}})
+    rest("PUT", "/mp/_doc/1", {"x": "a"}, query={"refresh": "true"})
+    q = json.dumps({"query": {"match_all": {}}})
+    # query param threads into both lines: junk fails BOTH per-line
+    raw = "\n".join([json.dumps({"index": "mp"}), q,
+                     json.dumps({"index": "mp"}), q]) + "\n"
+    status, body = rest("POST", "/_msearch", raw=raw,
+                        query={"allow_partial_search_results": "maybe"})
+    assert status == 200
+    for item in body["responses"]:
+        assert item["status"] == 400
+        assert "allow_partial_search_results" in \
+            item["error"]["reason"]
+    # header-level value overrides the query param per line...
+    raw = "\n".join([
+        json.dumps({"index": "mp",
+                    "allow_partial_search_results": True}), q,
+        json.dumps({"index": "mp"}), q]) + "\n"
+    status, body = rest("POST", "/_msearch", raw=raw,
+                        query={"allow_partial_search_results": "maybe"})
+    assert "hits" in body["responses"][0]          # valid override: ran
+    assert body["responses"][1]["status"] == 400   # junk param still 400
+    # ...and an explicit body value beats both
+    raw = "\n".join([
+        json.dumps({"index": "mp", "allow_partial_search_results": "maybe"}),
+        json.dumps({"query": {"match_all": {}},
+                    "allow_partial_search_results": False})]) + "\n"
+    status, body = rest("POST", "/_msearch", raw=raw)
+    assert "hits" in body["responses"][0]
+
+
+def test_async_search_submit_threads_allow_partial(rest, cluster):
+    rest("PUT", "/as", {"settings": {"number_of_replicas": 0}})
+    rest("PUT", "/as/_doc/1", {"x": "a"}, query={"refresh": "true"})
+    # junk value -> the underlying search fails, visible in the async
+    # response error (proof the submit param reached the search body)
+    status, body = rest("POST", "/as/_async_search", {},
+                        query={"allow_partial_search_results": "maybe",
+                               "wait_for_completion_timeout": "30s"})
+    assert status == 200
+    assert body["is_partial"] is True
+    assert "allow_partial_search_results" in body["error"]["reason"]
+    # valid value passes through and the search completes
+    status, body = rest("POST", "/as/_async_search", {},
+                        query={"allow_partial_search_results": "true",
+                               "wait_for_completion_timeout": "30s"})
+    assert status == 200
+    assert body["response"]["hits"]["total"]["value"] == 1
+
+
 def test_cluster_and_cat(rest, cluster):
     rest("PUT", "/cat1", {"settings": {"number_of_replicas": 0}})
     cluster.ensure_green("cat1")
