@@ -124,6 +124,13 @@ class SearchTransportService:
         # freshness key (any refresh/merge/delete changes it). LRU-bounded.
         self._request_cache: "OrderedDict[Tuple, Dict[str, Any]]" = \
             OrderedDict()
+        # adaptive cross-query micro-batcher (search/batch_executor.py):
+        # eligible shard queries coalesce into single batched device
+        # programs; search.batch.enabled=false restores the solo path
+        from elasticsearch_tpu.search.batch_executor import (
+            ShardQueryBatcher,
+        )
+        self.batcher = ShardQueryBatcher(self)
         ts.register_handler(SEARCH_CAN_MATCH, self._on_can_match)
         ts.register_handler(SEARCH_DFS, self._on_dfs)
         ts.register_handler(SEARCH_QUERY, self._on_query)
@@ -213,9 +220,19 @@ class SearchTransportService:
                     str(req.get("body", {}))[:512])
                 return
 
-    def _on_query(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
-        t_query = time.monotonic()
+    def _on_query(self, req: Dict[str, Any], sender: str):
         self._reap()
+        # micro-batching intake: eligible queries queue for a shared
+        # batched device dispatch and answer through a Deferred; anything
+        # the batcher cannot serve byte-identically falls through to the
+        # solo path below
+        deferred = self.batcher.try_enqueue(req)
+        if deferred is not None:
+            return deferred
+        return self._execute_query_solo(req)
+
+    def _execute_query_solo(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        t_query = time.monotonic()
         shard = self.indices.shard(req["index"], req["shard"])
         body = req.get("body", {})
         reader = shard.engine.acquire_reader()

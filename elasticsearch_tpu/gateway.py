@@ -20,6 +20,16 @@ from typing import Optional
 
 from elasticsearch_tpu.cluster.coordination import PersistedState
 from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.index.disk_io import pack_footer, unpack_footer
+from elasticsearch_tpu.utils.errors import ShardCorruptedError
+
+
+class CorruptedGatewayStateError(ShardCorruptedError):
+    """The node's persisted coordination state (_state/state.json) failed
+    its checksum or no longer parses: surfaced as a typed
+    ShardCorruptedError-family failure at boot instead of a bare JSON
+    parse error, so operators see WHAT is corrupted (the same discipline
+    every shard artifact already follows)."""
 
 
 class DurablePersistedState(PersistedState):
@@ -49,7 +59,9 @@ class DurablePersistedState(PersistedState):
         }).encode("utf-8")
         tmp = self._path.with_name("." + self._path.name + ".tmp")
         with open(tmp, "wb") as f:
-            f.write(payload)
+            # CRC32 footer like every shard artifact: a rotted/torn
+            # state file is detected at load, not trusted
+            f.write(pack_footer(payload))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path)
@@ -66,8 +78,18 @@ class GatewayMetaState:
     def load_or_create(self, initial_state: ClusterState
                        ) -> DurablePersistedState:
         if self.path.exists():
-            with open(self.path) as f:
-                d = json.load(f)
+            raw = self.path.read_bytes()
+            try:
+                payload = unpack_footer(self.path, raw)
+                d = json.loads(payload.decode("utf-8"))
+            except (ShardCorruptedError, ValueError) as e:
+                # checksum mismatch, missing footer, or (crc-valid but)
+                # unparseable JSON: refuse to boot from it, typed —
+                # corrupted coordination state must never be silently
+                # reinterpreted as an empty/partial cluster
+                raise CorruptedGatewayStateError(
+                    f"gateway state [{self.path}] is corrupted: {e}"
+                ) from e
             state = ClusterState.from_dict(d.get("accepted_state", {}))
             return DurablePersistedState(
                 self.path,

@@ -146,6 +146,23 @@ def device_stats() -> Dict[str, Any]:
     return out
 
 
+def search_batch_stats(batcher) -> Dict[str, Any]:
+    """Micro-batcher observability (search/batch_executor.py): dispatch /
+    occupancy / wait-time counters plus the derived means operators watch
+    to see whether cross-query batching is actually engaging. The raw
+    counters are cumulative since node start, like every other stat."""
+    if batcher is None:
+        return {}
+    out: Dict[str, Any] = dict(batcher.stats)
+    dispatches = out.get("batches_dispatched", 0)
+    queries = out.get("queries_dispatched", 0)
+    out["mean_occupancy"] = round(queries / dispatches, 3) \
+        if dispatches else 0.0
+    out["mean_wait_ms"] = round(out.get("wait_ms_total", 0.0) / queries, 3) \
+        if queries else 0.0
+    return out
+
+
 # ---------------------------------------------------------------------------
 # bootstrap checks
 # ---------------------------------------------------------------------------

@@ -257,6 +257,9 @@ class Node:
             "process": monitor.process_stats(),
             "fs": monitor.fs_stats(self.indices_service.data_path),
             "device": monitor.device_stats(),
+            # cross-query micro-batching occupancy/wait/dispatch counters
+            "search_batch": monitor.search_batch_stats(
+                self.search_transport.batcher),
         }
 
     def _on_committed(self, state: ClusterState) -> None:

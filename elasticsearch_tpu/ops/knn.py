@@ -20,6 +20,8 @@ from __future__ import annotations
 from functools import partial
 from typing import Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -99,6 +101,25 @@ class KnnExecutor:
         q = jnp.asarray(query, jnp.float32)
         return knn_topk(self.dev.matrix, self.dev.norms, self.dev.exists,
                         live, q, k, self.dev.similarity)
+
+    def top_k_batch(self, queries, live, k: int):
+        """Batched exact kNN over Q query vectors: ONE [Q, D] x [D, N] MXU
+        matmul instead of Q matvec dispatches (the serving-path counterpart
+        of the bench-only knn_topk_batch shape). The query dimension pads
+        to a pow2 bucket so the jit cache stays warm across batch sizes;
+        padded rows come back sliced off."""
+        q_host = np.asarray(queries, np.float32)
+        n_real = q_host.shape[0]
+        from elasticsearch_tpu.index.segment import next_pow2
+        n_pad = next_pow2(max(n_real, 1), minimum=1)
+        if n_pad != n_real:
+            q_host = np.concatenate(
+                [q_host, np.zeros((n_pad - n_real, q_host.shape[1]),
+                                  np.float32)])
+        s, d = knn_topk_batch(self.dev.matrix, self.dev.norms,
+                              self.dev.exists, live,
+                              jnp.asarray(q_host), k, self.dev.similarity)
+        return s[:n_real], d[:n_real]
 
     def scores(self, query, live) -> jnp.ndarray:
         q = jnp.asarray(query, jnp.float32)

@@ -64,22 +64,30 @@ def sparse_scores(block_docs,      # [NB, BLOCK] int32
     return scores.at[safe_docs.reshape(-1)].add(contrib.reshape(-1), mode="drop")
 
 
-@partial(jax.jit, static_argnames=("n_docs_pad", "k", "function"))
+@partial(jax.jit, static_argnames=("n_docs_pad", "k", "function", "counted"))
 def sparse_topk_batch(block_docs, block_weights,
                       block_idx,       # [Q, QB] int32
                       query_weight,    # [Q, QB] f32 (0 = padding)
                       pivot, exponent, live, n_docs_pad: int, k: int,
-                      function: str = "saturation"
-                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                      function: str = "saturation",
+                      counted: bool = False
+                      ) -> Tuple[jnp.ndarray, ...]:
     """Batched sparse retrieval: Q expanded queries in ONE dispatch (the
     bm25_topk_batch analog — the sparse path was dispatch-bound at one
-    compiled call per query)."""
+    compiled call per query). With ``counted`` also returns hits[Q] =
+    #live docs with score > 0 per query, the exact match count the
+    counts-then-skip totals contract needs (the dense path's mask sum,
+    read off the score vector already computed here)."""
 
     def one(bi, qw):
         s = sparse_scores(block_docs, block_weights, bi, qw, pivot,
                           exponent, n_docs_pad, function)
-        s = jnp.where(live & (s > 0.0), s, -jnp.inf)
-        return jax.lax.top_k(s, k)
+        matched = live & (s > 0.0)
+        s = jnp.where(matched, s, -jnp.inf)
+        ts, td = jax.lax.top_k(s, k)
+        if counted:
+            return ts, td, jnp.sum(matched, dtype=jnp.int32)
+        return ts, td
 
     return jax.vmap(one)(block_idx, query_weight)
 
@@ -129,22 +137,30 @@ class SparseExecutor:
 
     def top_k_batch(self, queries, live, k: int,
                     function: str = "linear", pivot: float = 1.0,
-                    exponent: float = 1.0):
+                    exponent: float = 1.0, count_hits: bool = False):
         """``queries``: list of [(feature, weight)] expansions; one device
         dispatch for the whole batch. Per-query gather lists are padded to
-        a shared bucket (block 0 / weight 0 pads contribute nothing)."""
+        a shared bucket (block 0 / weight 0 pads contribute nothing); the
+        query dimension pads to a pow2 bucket so the jit cache stays warm.
+        With ``count_hits`` also returns exact per-query match counts."""
         per = [gather_feature_blocks(self.host, q, bucket_min=1)
                for q in queries]
         qb_pad = next_pow2(max((len(i) for i, _ in per), default=1),
                            minimum=8)
-        q_n = len(per)
+        n_real = len(per)
+        q_n = next_pow2(max(n_real, 1), minimum=1)
         idx = np.zeros((q_n, qb_pad), np.int32)
         w = np.zeros((q_n, qb_pad), np.float32)
         for i, (bi, bw) in enumerate(per):
             idx[i, : len(bi)] = bi
             w[i, : len(bw)] = bw
-        return sparse_topk_batch(
+        got = sparse_topk_batch(
             self.dev.block_docs, self.dev.block_weights,
             jnp.asarray(idx), jnp.asarray(w),
             jnp.float32(pivot), jnp.float32(exponent),
-            live, self.dev.n_docs_pad, k, function)
+            live, self.dev.n_docs_pad, k, function, counted=count_hits)
+        if count_hits:
+            s, d, h = got
+            return s[:n_real], d[:n_real], np.asarray(h)[:n_real]
+        s, d = got
+        return s[:n_real], d[:n_real]

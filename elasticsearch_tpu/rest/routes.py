@@ -1481,6 +1481,31 @@ def build_controller(client: NodeClient) -> RestController:
         done(200, {"tasks": tasks})
     r("GET", "/_cluster/pending_tasks", pending_tasks)
 
+    def clear_corruption_markers(req: RestRequest, done: DoneFn) -> None:
+        """Operator escape hatch (the remove-corrupted-data tool analog):
+        remove corruption markers from this node's local shard stores so
+        a repaired/accepted-loss copy can reopen. Wired to the existing
+        Store.clear_corruption_markers(); reports per-shard removals so
+        the operator sees exactly which copies were unfenced."""
+        node = client.node
+        shards_out: List[Dict[str, Any]] = []
+        total = 0
+        for index_name, service in sorted(
+                node.indices_service.indices.items()):
+            for sid, shard in sorted(service.shards.items()):
+                store = shard.engine.store
+                if store is None:
+                    continue
+                removed = store.clear_corruption_markers()
+                if removed:
+                    total += removed
+                    shards_out.append({"index": index_name, "shard": sid,
+                                       "markers_removed": removed})
+        done(200, {"acknowledged": True, "markers_removed": total,
+                   "shards": shards_out})
+    r("POST", "/_internal/corruption_markers/_clear",
+      clear_corruption_markers)
+
     # -- cat (human tables) ----------------------------------------------
 
     def cat_indices(req: RestRequest, done: DoneFn) -> None:

@@ -1,0 +1,796 @@
+"""Adaptive cross-query micro-batching for the served shard search path.
+
+The batched device kernels (the flat-plan BM25 path of ops/bm25.py, the
+[Q, D] x [D, N] kNN matmul of ops/knn.py, the vmapped rank-features scorer
+of ops/sparse.py) were until now exercised only by bench.py; the serving
+path dispatched one query per device program, and per-query launch
+overhead — not kernel throughput — dominated (BENCH r05: bm25 at 0.129x
+the 5x-CPU target while exact kNN, the one config with real device batch
+width, sat at 2.94x).
+
+This module closes that gap the way inference-serving stacks do — dynamic
+micro-batching at the device boundary:
+
+- ``SearchTransportService._on_query`` offers every arriving shard query
+  to the :class:`ShardQueryBatcher`; *eligible* queries (pure
+  score-sorted top-k text / sparse / kNN — exactly the shapes
+  ``choose_collector_context`` routes to ``wand_topk`` today, plus their
+  kNN/sparse analogs) are queued per ``(index, shard, kind, field,
+  window, totals)`` key and the handler returns a transport ``Deferred``.
+  Ineligible queries (aggs, sorts, rescore, DFS overrides, frozen
+  indices, ...) fall through to the unchanged solo path.
+- The queue drains **adaptively**: immediately when the key is idle (no
+  recent dispatch — an isolated query pays only one scheduler hop), and
+  after up to ``search.batch.max_window_ms`` under load so concurrent
+  queries coalesce. ``search.batch.max_size`` caps the query dimension
+  of one dispatch. Both are dynamic cluster settings;
+  ``search.batch.enabled: false`` restores the solo path byte-for-byte.
+- One drain executes ONE batched device program per segment per phase
+  (the query dimension padded to a pow2 bucket inside the executors so
+  the jit cache stays warm), then demuxes per-query results — top-k
+  docs, totals with the counts-then-skip contract, per-query
+  ``theta``/prune stats — bit-compatible with the solo path.
+- Per-query deadlines and cancellation still bind: a query whose budget
+  expires (or whose task is cancelled) before its batch drains is failed
+  individually at drain entry; between device dispatches every member is
+  re-checked (the batch inherits the earliest member deadline in the
+  sense that expiry is detected at dispatch granularity), and a batch
+  whose members have ALL died aborts outright. ``_msearch`` lines land
+  in the same batch by construction — they arrive as independent shard
+  queries within the same scheduler tick.
+
+Any unexpected failure of the batched path (breaker trips, shapes the
+kernels reject) degrades to per-member solo execution — batching is an
+optimization, never a correctness gate.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid as uuid_mod
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1, P1_BUCKET
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.phase import ShardDoc, parse_sort, wand_clauses
+from elasticsearch_tpu.utils.errors import (
+    SearchBudgetExceededError, TaskCancelledError,
+)
+from elasticsearch_tpu.utils.settings import (
+    SEARCH_BATCH_ENABLED, SEARCH_BATCH_MAX_SIZE, SEARCH_BATCH_MAX_WINDOW_MS,
+)
+
+
+class _FallbackSolo(Exception):
+    """Internal: this batch cannot run batched (e.g. an IVF-sized kNN
+    segment); members re-execute through the solo path."""
+
+
+def _ann_min_docs() -> int:
+    from elasticsearch_tpu.search.execute import ANN_DEFAULT_MIN_DOCS
+    return ANN_DEFAULT_MIN_DOCS
+
+
+class _AllMembersDead(Exception):
+    """Internal: every member expired/cancelled mid-batch; stop paying
+    for device work nobody will read."""
+
+
+# body clauses whose presence routes a request to the solo path: they
+# either force the dense collector in query_shard or carry per-request
+# state the batched demux does not model
+_SOLO_CLAUSES = ("aggs", "aggregations", "suggest", "rescore", "collapse",
+                 "slice", "profile", "terminate_after")
+
+
+@dataclass
+class BatchSpec:
+    """Eligibility result: the batch key components plus this member's
+    private payload (clauses / query vector / expansion tokens)."""
+    kind: str                      # "text" | "knn" | "sparse"
+    field: str
+    window: int
+    # text: counts-then-skip limit (0 = totals disabled);
+    # knn/sparse: coordinator clip threshold (None = never clip)
+    track_limit: int = 0
+    clip_limit: Optional[int] = None
+    clauses: Optional[List[Tuple[str, float]]] = None
+    query_vector: Optional[List[float]] = None
+    k: int = 10
+    tokens: Optional[Dict[str, float]] = None
+    boost: float = 1.0
+    # the parsed + alias-resolved query tree (text class): classification
+    # already paid the parse, so the drain's term-stats pass reuses it
+    # instead of re-parsing the raw body on the hot path
+    query: Any = None
+
+    def key(self) -> Tuple:
+        if self.kind == "text":
+            return ("text", self.field, self.window, self.track_limit)
+        if self.kind == "knn":
+            return ("knn", self.field, self.window, self.clip_limit, self.k)
+        return ("sparse", self.field, self.window, self.clip_limit)
+
+
+@dataclass
+class _Member:
+    req: Dict[str, Any]
+    spec: BatchSpec
+    deferred: Any
+    enqueued_at: float
+    enqueued_wall: float
+    task: Any = None
+    deadline: Optional[float] = None
+    error: Optional[Exception] = None
+    result: Optional[Dict[str, Any]] = None
+
+
+def classify_request(req: Dict[str, Any], mappers) -> Optional[BatchSpec]:
+    """BatchSpec when the shard query is batch-eligible, else None.
+
+    Mirrors ``choose_collector_context``'s conditions for the text path
+    and the exact-kNN / resolved-expansion shapes for the others; anything
+    the batched demux cannot reproduce byte-for-byte stays solo."""
+    window = int(req.get("window", 0))
+    if window <= 0:
+        return None
+    # DFS overrides change idf/avgdl inputs per request: solo
+    if req.get("df_overrides") or req.get("doc_count_override") \
+            or req.get("field_stats_overrides"):
+        return None
+    body = req.get("body") or {}
+    for clause in _SOLO_CLAUSES:
+        if body.get(clause):
+            return None
+    if body.get("min_score") is not None or \
+            body.get("search_after") is not None:
+        return None
+    if body.get("sort") is not None:
+        sort = parse_sort(body.get("sort"))
+        if not (len(sort) == 1 and sort[0].field == "_score"
+                and sort[0].order == "desc"):
+            return None
+    track = body.get("track_total_hits", 10_000)
+    from elasticsearch_tpu.search.execute import resolve_aliases
+    query = resolve_aliases(dsl.parse_query(body.get("query")), mappers)
+
+    wc = wand_clauses(query, mappers)
+    if wc is not None:
+        if track is True:
+            return None      # unbounded exact counting: dense path
+        w_field, clauses = wc
+        return BatchSpec(kind="text", field=w_field, window=window,
+                         track_limit=int(track) if track else 0,
+                         clauses=clauses, query=query)
+
+    exact_total = track is True or (isinstance(track, int) and track > 0)
+    clip = int(track) if (exact_total and track is not True) else None
+    if isinstance(query, dsl.Knn) and query.filter is None:
+        mapper = mappers.mapper(query.field)
+        if mappers.field_type(query.field) != "dense_vector":
+            return None
+        opts = getattr(mapper, "index_options", None) or {}
+        if opts.get("type") is not None:
+            return None      # IVF-opted (or unknown) mapping: solo
+        return BatchSpec(kind="knn", field=query.field, window=window,
+                         clip_limit=clip, query_vector=query.query_vector,
+                         k=int(query.k), boost=float(query.boost))
+    if isinstance(query, dsl.TextExpansion) and query.tokens:
+        return BatchSpec(kind="sparse", field=query.field, window=window,
+                         clip_limit=clip, tokens=dict(query.tokens),
+                         boost=float(query.boost))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# batched shard execution (per query class)
+# ---------------------------------------------------------------------------
+
+def _build_ctxs(reader, mappers, doc_count: int,
+                dfs: Optional[Dict[str, Dict[str, int]]]):
+    """SegmentContexts over the reader snapshot, exactly as query_shard
+    builds them (point-in-time live masks, shard-level stat overrides)."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.index.segment import BLOCK, next_pow2
+    from elasticsearch_tpu.search.execute import SegmentContext
+    ctxs = []
+    for si, (seg, live_host) in enumerate(zip(reader.segments,
+                                              reader.live_masks)):
+        n_pad = next_pow2(max(seg.n_docs, 1), minimum=BLOCK)
+        snap = np.zeros(n_pad, bool)
+        snap[: len(live_host)] = live_host
+        ctxs.append(SegmentContext(seg, mappers, segment_idx=si,
+                                   doc_count_override=doc_count,
+                                   df_overrides=dfs,
+                                   live_override=jnp.asarray(snap),
+                                   reader=reader))
+    return ctxs
+
+
+def batched_wand_topk_shard(ctxs, field: str,
+                            clause_lists: List[List[Tuple[str, float]]],
+                            want: int, track_limit: int,
+                            check_members: Optional[Callable[[], None]]
+                            = None) -> List[Tuple]:
+    """Q queries through the pruned flat-plan BM25 path in shared device
+    dispatches — the Q-query generalization of phase._wand_topk_shard,
+    member-for-member identical in scores, candidates, totals semantics
+    and prune accounting (each member keeps its OWN shard-global theta,
+    derived from its own phase-1 partials).
+
+    Returns per member: (candidates, hits, relation, max_score,
+    (blocks_total, blocks_scored))."""
+    from elasticsearch_tpu.search.execute import _bm25_executor
+    count = track_limit > 0
+    n_q = len(clause_lists)
+    per_seg = []            # (ctx, ex, plans[n_q], k_seg, avgdl)
+    seen_terms: List[Dict[str, float]] = [{} for _ in range(n_q)]
+    has_terms = [False] * n_q
+    for ctx in ctxs:
+        analyzer = ctx.search_analyzer(field)
+        ex = _bm25_executor(ctx, field)
+        if ex is None:
+            continue        # field has no postings in this segment
+        df_map = ctx.df_for(field) or {}
+        member_terms: List[List[Tuple[str, float]]] = []
+        any_terms = False
+        for qi, clauses in enumerate(clause_lists):
+            terms: List[Tuple[str, float]] = []
+            for text, boost in clauses:
+                terms.extend((t, boost) for t in analyzer.terms(text))
+            member_terms.append(terms)
+            if terms:
+                any_terms = True
+                has_terms[qi] = True
+                for t, _b in terms:
+                    if t not in seen_terms[qi]:
+                        seen_terms[qi][t] = float(df_map.get(t, 0))
+        if not any_terms:
+            continue
+        k_seg = min(max(want, 1), ctx.n_docs_pad)
+        avgdl = ex._avgdl(ctx.avgdl_for(field))
+        plans = ex.build_plans(member_terms, df_override=df_map or None,
+                               avgdl=avgdl)
+        per_seg.append((ctx, ex, plans, k_seg, avgdl))
+
+    empty = ([], 0, "eq", None, (0, 0))
+    if not per_seg:
+        return [empty] * n_q
+
+    from elasticsearch_tpu.ops.bm25 import QueryPlan
+    empty_plan = QueryPlan([], [], [], [])
+
+    hits_upper = [int(sum(s.values())) for s in seen_terms]
+    exact_mode = [count and hits_upper[qi] <= track_limit
+                  for qi in range(n_q)]
+
+    # phase A, one dispatch per segment: exact-mode members score ALL
+    # their blocks (counted — their results are final); pruned members
+    # score their P1_BUCKET highest-upper-bound blocks to establish theta
+    counted_a = any(exact_mode)
+    res_a = []
+    for ctx, ex, plans, k_seg, avgdl in per_seg:
+        if check_members is not None:
+            check_members()
+        rows = [p if exact_mode[qi] else p.top_by_ub(P1_BUCKET)
+                for qi, p in enumerate(plans)]
+        res_a.append(ex._dispatch_flat(rows, ctx.live, k_seg, DEFAULT_K1,
+                                       DEFAULT_B, avgdl, counted=counted_a))
+
+    # per-member shard-global theta from that member's own partials
+    theta = np.full(n_q, -np.inf)
+    s_a = [np.asarray(r[0]) for r in res_a]
+    for qi in range(n_q):
+        if exact_mode[qi]:
+            continue
+        partials = np.concatenate([s[qi] for s in s_a])
+        finite = partials[np.isfinite(partials)]
+        if len(finite) >= want:
+            theta[qi] = float(np.sort(finite)[-want])
+
+    # phase B, one dispatch per segment: pruned members' WAND survivors,
+    # scored exactly (exact members ride along as empty rows so the row
+    # index stays the member index)
+    blocks_total = [0] * n_q
+    blocks_scored = [0] * n_q
+    hits_exact = [True] * n_q
+    res_b = []
+    need_b = not all(exact_mode)
+    for ctx, ex, plans, k_seg, avgdl in per_seg:
+        if check_members is not None:
+            check_members()
+        rows = []
+        for qi, p in enumerate(plans):
+            if exact_mode[qi]:
+                blocks_total[qi] += p.n_blocks
+                blocks_scored[qi] += p.n_blocks
+                rows.append(empty_plan)
+                continue
+            surv = p.survivors(float(theta[qi]))
+            p1_cost = min(p.n_blocks, P1_BUCKET)
+            blocks_total[qi] += p.n_blocks
+            blocks_scored[qi] += min(surv.n_blocks + p1_cost, p.n_blocks)
+            hits_exact[qi] = hits_exact[qi] and \
+                surv.n_blocks >= p.n_blocks
+            rows.append(surv)
+        if need_b:
+            res_b.append(ex._dispatch_flat(rows, ctx.live, k_seg,
+                                           DEFAULT_K1, DEFAULT_B, avgdl,
+                                           counted=count))
+
+    # demux: candidates (+ counts) per member
+    out: List[Tuple] = []
+    for qi in range(n_q):
+        if not has_terms[qi]:
+            out.append(empty)
+            continue
+        candidates: List[ShardDoc] = []
+        max_score: Optional[float] = None
+        hits_seen = 0
+        for si_idx, (ctx, ex, plans, k_seg, avgdl) in enumerate(per_seg):
+            got = res_a[si_idx] if exact_mode[qi] else res_b[si_idx]
+            if count:
+                s, d, h = got
+                hits_seen += int(np.asarray(h)[qi])
+            else:
+                s, d = got
+            s_row = np.asarray(s)[qi]
+            d_row = np.asarray(d)[qi]
+            for sc, doc in zip(s_row, d_row):
+                if sc == -np.inf:
+                    break
+                candidates.append(ShardDoc(ctx.segment_idx, int(doc),
+                                           float(sc), (float(sc),)))
+                if max_score is None or sc > max_score:
+                    max_score = float(sc)
+        candidates.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+        prune = (blocks_total[qi], blocks_scored[qi])
+        if not count:
+            out.append((candidates, len(candidates), "gte", max_score,
+                        prune))
+        elif hits_seen >= track_limit:
+            out.append((candidates, track_limit, "gte", max_score, prune))
+        elif hits_exact[qi] or exact_mode[qi]:
+            out.append((candidates, hits_seen, "eq", max_score, prune))
+        else:
+            out.append((candidates, None, None, max_score, prune))
+
+    # members whose pruned counts might hide hits: one exact unpruned
+    # counted pass (k=1, scores already final) — shared dispatches again
+    recount = [qi for qi in range(n_q) if count and out[qi][1] is None]
+    if recount:
+        exact_hits = {qi: 0 for qi in recount}
+        for ctx, ex, plans, k_seg, avgdl in per_seg:
+            if check_members is not None:
+                check_members()
+            rows = [plans[qi] if qi in exact_hits else empty_plan
+                    for qi in range(n_q)]
+            _s, _d, h = ex._dispatch_flat(rows, ctx.live, 1, DEFAULT_K1,
+                                          DEFAULT_B, avgdl, counted=True)
+            h = np.asarray(h)
+            for qi in exact_hits:
+                exact_hits[qi] += int(h[qi])
+        for qi in recount:
+            candidates, _, _, max_score, prune = out[qi]
+            if exact_hits[qi] > track_limit:
+                out[qi] = (candidates, track_limit, "gte", max_score,
+                           prune)
+            else:
+                out[qi] = (candidates, exact_hits[qi], "eq", max_score,
+                           prune)
+    return out
+
+
+def batched_knn_shard(ctxs, field: str, specs: List[BatchSpec],
+                      k: int, check_members: Optional[Callable[[], None]]
+                      = None) -> List[Tuple]:
+    """Q exact-kNN queries: one [Q, D] x [D, N] matmul per segment, then
+    the per-member shard-global merge Lucene's KnnVectorQuery rewrite
+    performs (execute.rewrite_knn), demuxed to the dense collector's
+    candidates/totals shape. Raises _FallbackSolo when a segment is
+    IVF-sized (the solo path would route it through the ANN index)."""
+    from elasticsearch_tpu.ops.device_segment import DeviceVectors
+    from elasticsearch_tpu.ops.knn import KnnExecutor
+    from elasticsearch_tpu.search.execute import ANN_DEFAULT_MIN_DOCS
+    n_q = len(specs)
+    vectors = np.asarray([s.query_vector for s in specs], np.float32)
+    per_member_hits: List[List[Tuple[int, int, float]]] = \
+        [[] for _ in range(n_q)]
+    for ctx in ctxs:
+        dev = DeviceVectors.for_segment(ctx.segment, field)
+        if dev is None:
+            continue
+        if ctx.segment.n_docs >= ANN_DEFAULT_MIN_DOCS:
+            raise _FallbackSolo(
+                f"segment [{ctx.segment.name}] takes the IVF path")
+        if check_members is not None:
+            check_members()
+        ex = KnnExecutor(dev)
+        k_seg = min(k, ctx.n_docs_pad)
+        s, d = ex.top_k_batch(vectors, ctx.live, k_seg)
+        s = np.asarray(s)
+        d = np.asarray(d)
+        for qi in range(n_q):
+            for sc, doc in zip(s[qi], d[qi]):
+                if sc > -np.inf:
+                    per_member_hits[qi].append(
+                        (ctx.segment_idx, int(doc), float(sc)))
+    out = []
+    for qi, spec in enumerate(specs):
+        hits = per_member_hits[qi]
+        hits.sort(key=lambda x: -x[2])     # rewrite_knn's merge order
+        winners = hits[: k]
+        boost = spec.boost
+        candidates = [ShardDoc(si, doc, sc * boost, (sc * boost,))
+                      for si, doc, sc in winners]
+        candidates.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+        max_score = max((c.score for c in candidates), default=None)
+        total = len(winners)
+        relation = "eq"
+        if spec.clip_limit is not None and total > spec.clip_limit:
+            total, relation = spec.clip_limit, "gte"
+        out.append((candidates, total, relation, max_score, None))
+    return out
+
+
+def batched_sparse_shard(ctxs, field: str, specs: List[BatchSpec],
+                         want: int,
+                         check_members: Optional[Callable[[], None]]
+                         = None) -> List[Tuple]:
+    """Q resolved text_expansion queries through the batched
+    rank-features scorer: one vmapped dispatch per segment, counts read
+    off the score plane (the dense path's mask sum), demuxed to the
+    dense collector's candidates/totals shape."""
+    from elasticsearch_tpu.ops.device_segment import DeviceFeatures
+    from elasticsearch_tpu.ops.sparse import SparseExecutor
+    n_q = len(specs)
+    expansions = [[(t, w * s.boost) for t, w in s.tokens.items()]
+                  for s in specs]
+    candidates: List[List[ShardDoc]] = [[] for _ in range(n_q)]
+    totals = [0] * n_q
+    for ctx in ctxs:
+        dev = DeviceFeatures.for_segment(ctx.segment, field)
+        if dev is None:
+            continue
+        if check_members is not None:
+            check_members()
+        ex = SparseExecutor(dev, ctx.segment.features[field])
+        k_seg = min(max(want, 1), ctx.n_docs_pad)
+        s, d, h = ex.top_k_batch(expansions, ctx.live, k_seg,
+                                 function="linear", count_hits=True)
+        s = np.asarray(s)
+        d = np.asarray(d)
+        for qi in range(n_q):
+            totals[qi] += int(h[qi])
+            for sc, doc in zip(s[qi], d[qi]):
+                if sc == -np.inf:
+                    break
+                candidates[qi].append(ShardDoc(ctx.segment_idx, int(doc),
+                                               float(sc), (float(sc),)))
+    out = []
+    for qi, spec in enumerate(specs):
+        cands = candidates[qi]
+        cands.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+        max_score = max((c.score for c in cands), default=None)
+        total, relation = totals[qi], "eq"
+        if spec.clip_limit is not None and total > spec.clip_limit:
+            total, relation = spec.clip_limit, "gte"
+        out.append((cands, total, relation, max_score, None))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the batcher
+# ---------------------------------------------------------------------------
+
+class ShardQueryBatcher:
+    """Per-data-node adaptive micro-batcher; owned by
+    SearchTransportService, driven entirely on the scheduler's dispatch
+    context (no locks — the same single-threaded discipline every handler
+    already runs under)."""
+
+    LAST_DISPATCH_CAP = 1024
+
+    def __init__(self, sts):
+        self.sts = sts
+        self._queues: Dict[Tuple, List[_Member]] = {}
+        self._timers: Dict[Tuple, Any] = {}
+        self._last_dispatch: Dict[Tuple, float] = {}
+        self.stats: Dict[str, float] = {
+            "batches_dispatched": 0,
+            "queries_dispatched": 0,
+            "max_occupancy": 0,
+            "wait_ms_total": 0.0,
+            "queries_expired": 0,
+            "queries_cancelled": 0,
+            "solo_fallbacks": 0,
+        }
+
+    # -- settings (dynamic, from committed cluster state) ---------------
+
+    def _setting(self, setting):
+        state = self.sts.state() if self.sts.state is not None else None
+        if state is None:
+            return setting.default(None)
+        raw = state.metadata.persistent_settings.get(setting.key)
+        if raw is None:
+            return setting.default(None)
+        try:
+            return setting.parse(raw)
+        except Exception:  # noqa: BLE001 — unparseable operator value:
+            return setting.default(None)   # fail toward the default
+
+    def enabled(self) -> bool:
+        return self._setting(SEARCH_BATCH_ENABLED)
+
+    def max_window_s(self) -> float:
+        return self._setting(SEARCH_BATCH_MAX_WINDOW_MS) / 1000.0
+
+    def max_size(self) -> int:
+        return self._setting(SEARCH_BATCH_MAX_SIZE)
+
+    def _scheduler(self):
+        return self.sts.ts.transport.scheduler
+
+    # -- intake ---------------------------------------------------------
+
+    def try_enqueue(self, req: Dict[str, Any]) -> Optional[Any]:
+        """Deferred when the request was queued for batched execution;
+        None routes the caller to the solo path. Never raises."""
+        try:
+            if not self.enabled():
+                return None
+            shard = self.sts.indices.shard(req["index"], req["shard"])
+            if self.sts.state is not None:
+                from elasticsearch_tpu.xpack.searchable_snapshots import (
+                    is_frozen,
+                )
+                if is_frozen(self.sts.state(), req["index"]):
+                    return None    # per-search device residency: solo
+            spec = classify_request(req, shard.engine.mappers)
+            if spec is not None and spec.kind == "knn" and any(
+                    spec.field in seg.vectors and
+                    seg.n_docs >= _ann_min_docs()
+                    for seg in shard.engine.segments):
+                # an IVF-sized segment routes the solo path through the
+                # ANN index; classifying it eligible would just cycle
+                # queue -> _FallbackSolo -> solo on every request
+                spec = None
+        except Exception:  # noqa: BLE001 — classification must never
+            return None    # fail a query; the solo path reports errors
+        if spec is None:
+            return None
+
+        from elasticsearch_tpu.transport.transport import Deferred
+        scheduler = self._scheduler()
+        member = _Member(req=req, spec=spec, deferred=Deferred(),
+                         enqueued_at=scheduler.now(),
+                         enqueued_wall=time.monotonic())
+        if self.sts.task_manager is not None:
+            member.task = self.sts.task_manager.register(
+                "indices:data/read/search[phase/query]",
+                f"shard query [{req['index']}][{req['shard']}]",
+                cancellable=True,
+                parent_task_id=req.get("task_id"))
+        remaining = req.get("budget_remaining")
+        if remaining is not None:
+            member.deadline = scheduler.now() + float(remaining)
+
+        key = (req["index"], req["shard"]) + spec.key()
+        queue = self._queues.setdefault(key, [])
+        queue.append(member)
+        if len(queue) >= self.max_size():
+            timer = self._timers.pop(key, None)
+            if timer is not None:
+                timer.cancel()
+            self._drain(key)
+        elif key not in self._timers:
+            # adaptive window: a key with recent traffic waits up to the
+            # window for batch-mates; an idle key drains on the next
+            # scheduler tick (which still coalesces every same-tick
+            # arrival already in the dispatch queue)
+            window = self.max_window_s()
+            recent = (scheduler.now() -
+                      self._last_dispatch.get(key, -float("inf"))) <= window
+            self._timers[key] = scheduler.schedule(
+                window if recent else 0.0, lambda: self._drain(key))
+        return member.deferred
+
+    # -- member lifecycle ----------------------------------------------
+
+    def _member_error(self, m: _Member) -> Optional[Exception]:
+        """This member's expiry/cancellation error, if it is dead."""
+        if m.task is not None:
+            try:
+                m.task.ensure_not_cancelled()
+            except TaskCancelledError as e:
+                self.stats["queries_cancelled"] += 1
+                return e
+        if m.deadline is not None and \
+                self._scheduler().now() >= m.deadline:
+            self.stats["queries_expired"] += 1
+            return SearchBudgetExceededError(
+                f"search budget expired while querying "
+                f"[{m.req['index']}][{m.req['shard']}]")
+        return None
+
+    def _finish(self, m: _Member) -> None:
+        if m.task is not None and self.sts.task_manager is not None:
+            self.sts.task_manager.unregister(m.task)
+            m.task = None
+        if m.error is not None:
+            m.deferred.reject(m.error)
+        else:
+            m.deferred.resolve(m.result)
+
+    # -- drain ----------------------------------------------------------
+
+    def _drain(self, key: Tuple) -> None:
+        timer = self._timers.pop(key, None)
+        if timer is not None:
+            timer.cancel()
+        members = self._queues.pop(key, [])
+        if not members:
+            return
+        scheduler = self._scheduler()
+        now = scheduler.now()
+        # recent-traffic tracking is FIFO-bounded: the key space includes
+        # client-controlled components (window, totals), so an unbounded
+        # dict would grow with request-shape variety for the process
+        # lifetime. Losing an old entry only costs one immediate drain.
+        self._last_dispatch.pop(key, None)
+        self._last_dispatch[key] = now
+        while len(self._last_dispatch) > self.LAST_DISPATCH_CAP:
+            self._last_dispatch.pop(next(iter(self._last_dispatch)))
+
+        # per-query deadline/cancellation binds at drain entry: a query
+        # whose budget expired while queued fails individually, exactly
+        # as the solo path's pre-collection check would fail it
+        live: List[_Member] = []
+        for m in members:
+            m.error = self._member_error(m)
+            if m.error is not None:
+                self._finish(m)
+            else:
+                live.append(m)
+        if not live:
+            return
+
+        self.stats["batches_dispatched"] += 1
+        self.stats["queries_dispatched"] += len(live)
+        self.stats["max_occupancy"] = max(self.stats["max_occupancy"],
+                                          len(live))
+        for m in live:
+            self.stats["wait_ms_total"] += (now - m.enqueued_at) * 1e3
+
+        try:
+            self._execute(key, live)
+        except _AllMembersDead:
+            pass   # every member already carries its own error
+        except Exception:  # noqa: BLE001 — the batched path must never
+            # lose queries: degrade to per-member solo execution
+            self.stats["solo_fallbacks"] += len(live)
+            for m in live:
+                if m.error is None and m.result is None:
+                    # the solo path re-derives its shard deadline from
+                    # budget_remaining: ship the budget LEFT now, not the
+                    # original — queue wait and the failed batch attempt
+                    # already spent part of it
+                    req = m.req
+                    if m.deadline is not None:
+                        req = {**m.req, "budget_remaining": max(
+                            0.0, m.deadline - scheduler.now())}
+                    try:
+                        m.result = self.sts._execute_query_solo(req)
+                    except Exception as e:  # noqa: BLE001
+                        m.error = e
+        for m in live:
+            self._finish(m)
+        # traffic may have queued behind a full-size drain
+        if self._queues.get(key) and key not in self._timers:
+            self._timers[key] = scheduler.schedule(
+                0.0, lambda: self._drain(key))
+
+    def _execute(self, key: Tuple, members: List[_Member]) -> None:
+        from elasticsearch_tpu.action.search_action import (
+            CONTEXT_KEEP_ALIVE,
+        )
+        from elasticsearch_tpu.search.phase import shard_term_stats
+        index, shard_id = key[0], key[1]
+        spec0 = members[0].spec
+        shard = self.sts.indices.shard(index, shard_id)
+        mappers = shard.engine.mappers
+        reader = shard.engine.acquire_reader()
+
+        def check_members() -> None:
+            """Between device dispatches: mark freshly-dead members (the
+            batch inherits the earliest member deadline — expiry is
+            detected here, at dispatch granularity) and abort when no
+            live member remains."""
+            alive = 0
+            for m in members:
+                if m.error is None:
+                    m.error = self._member_error(m)
+                if m.error is None:
+                    alive += 1
+            if alive == 0:
+                raise _AllMembersDead()
+
+        # shard-level term stats exactly as query_shard computes them;
+        # df per term is query-independent so the members' maps merge
+        doc_count = sum(seg.n_docs for seg in reader.segments)
+        dfs: Dict[str, Dict[str, int]] = {}
+        if spec0.kind == "text":
+            for m in members:
+                _dc, m_dfs = shard_term_stats(reader, mappers,
+                                              m.spec.query)
+                for fname, termmap in m_dfs.items():
+                    dfs.setdefault(fname, {}).update(termmap)
+        ctxs = _build_ctxs(reader, mappers, doc_count,
+                           dfs if spec0.kind == "text" else None)
+
+        from elasticsearch_tpu.index.segment import BLOCK
+        from elasticsearch_tpu.indices.breaker import BREAKERS
+        breaker = BREAKERS.breaker("request")
+        n_q = len(members)
+        want = spec0.window
+        if spec0.kind == "text":
+            transient = n_q * sum(
+                (P1_BUCKET * BLOCK * 8) + want * 8 for _ in ctxs)
+            label = "wand_topk_batch"
+        else:
+            transient = n_q * sum(8 * ctx.n_docs_pad for ctx in ctxs)
+            label = f"{spec0.kind}_batch"
+        with breaker.limit_scope(transient, label):
+            if spec0.kind == "text":
+                results = batched_wand_topk_shard(
+                    ctxs, spec0.field,
+                    [m.spec.clauses for m in members], want,
+                    spec0.track_limit, check_members)
+                collector = "wand_topk"
+            elif spec0.kind == "knn":
+                results = batched_knn_shard(
+                    ctxs, spec0.field, [m.spec for m in members],
+                    spec0.k, check_members)
+                collector = "dense"
+            else:
+                results = batched_sparse_shard(
+                    ctxs, spec0.field, [m.spec for m in members], want,
+                    check_members)
+                collector = "dense"
+
+        for m, (candidates, total, relation, max_score, prune) in \
+                zip(members, results):
+            if m.error is not None:
+                continue    # died mid-batch: fail, don't demux
+            docs = candidates[: want]
+            stats = shard.search_stats
+            stats["query_total"] += 1
+            if collector == "wand_topk" and prune:
+                stats["wand_queries"] += 1
+                stats["wand_blocks_total"] += prune[0]
+                stats["wand_blocks_scored"] += prune[1]
+            context_id = uuid_mod.uuid4().hex
+            self.sts._contexts[context_id] = (
+                reader, self.sts._now() + CONTEXT_KEEP_ALIVE)
+            m.result = {
+                "context_id": context_id,
+                "total": total,
+                "relation": relation,
+                "max_score": max_score,
+                "collector": collector,
+                "prune": list(prune) if prune else None,
+                "docs": [{"segment": d.segment_idx, "doc": d.doc,
+                          "score": d.score, "sort": list(d.sort_values)}
+                         for d in docs],
+                "terminated": False,
+                "aggs_partial": None,
+                "suggest_partial": None,
+                "profile": None,
+            }
+            self.sts._slow_log(m.req,
+                               time.monotonic() - m.enqueued_wall)

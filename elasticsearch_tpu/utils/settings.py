@@ -299,6 +299,25 @@ SEARCH_DEFAULT_ALLOW_PARTIAL_RESULTS: Setting[bool] = Setting.bool_setting(
     "search.default_allow_partial_results", True,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
+# Shard-level adaptive micro-batching (search/batch_executor.py): eligible
+# concurrent shard queries coalesce into single batched device programs.
+# enabled=false restores the one-query-per-dispatch path byte-for-byte.
+SEARCH_BATCH_ENABLED: Setting[bool] = Setting.bool_setting(
+    "search.batch.enabled", True,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# longest a queued shard query may wait for batch-mates under load; an idle
+# batcher drains immediately, so this bounds added latency, not typical
+SEARCH_BATCH_MAX_WINDOW_MS: Setting[float] = Setting.float_setting(
+    "search.batch.max_window_ms", 2.0, min_value=0.0,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# hard cap on queries per batched dispatch (the query dimension of the
+# score plane; kept modest so n_q * n_docs_pad stays inside HBM)
+SEARCH_BATCH_MAX_SIZE: Setting[int] = Setting.int_setting(
+    "search.batch.max_size", 64, min_value=1, max_value=1024,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
 
 def _closest(key: str, candidates: Iterable[str]) -> Optional[str]:
     """Cheap typo suggestion: smallest prefix-distance candidate."""

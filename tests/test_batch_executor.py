@@ -1,0 +1,465 @@
+"""Cross-query micro-batching: golden batch/solo parity + chaos cases.
+
+The batcher (search/batch_executor.py) must be invisible in results:
+batched top-k hits, scores, totals, and _shards stats identical to the
+solo path across seeds and query classes (text / kNN / sparse), while
+per-query deadlines and cancellation still bind inside a batch, and
+search.batch.enabled=false restores the solo path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index import InternalEngine
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.batch_executor import (
+    _build_ctxs, batched_knn_shard, batched_sparse_shard,
+    batched_wand_topk_shard, classify_request,
+)
+from elasticsearch_tpu.search.phase import (
+    parse_sort, query_shard, shard_term_stats, wand_clauses,
+)
+from elasticsearch_tpu.testing import InProcessCluster
+
+# CHAOS_SEEDS=N widens the seeded sweeps, like the other chaos suites
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# golden parity at the shard level: batched kernels vs query_shard, seeded
+# ---------------------------------------------------------------------------
+
+def _text_engine(seed: int, n_docs: int = 300):
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(50)]
+    weights = 1.0 / np.arange(1, len(vocab) + 1)
+    weights /= weights.sum()
+    eng = InternalEngine(
+        MapperService({"properties": {"body": {"type": "text"}}}),
+        shard_label=f"bx{seed}")
+    for i in range(n_docs):
+        n = int(rng.integers(4, 24))
+        eng.index(str(i), {"body": " ".join(
+            rng.choice(vocab, size=n, p=weights))})
+        if i in (n_docs // 3, 2 * n_docs // 3):
+            eng.refresh()   # multiple segments
+    eng.refresh()
+    return eng, rng, vocab
+
+
+@pytest.mark.parametrize("seed", [11 + 1000 * k for k in range(CHAOS_SEEDS)])
+@pytest.mark.parametrize("track", [10_000, 7, False])
+def test_golden_wand_batch_parity(seed, track):
+    """Batched flat-plan BM25 is member-for-member identical to the solo
+    pruned path: doc ids, scores, totals (counts-then-skip semantics
+    included), max_score, AND prune accounting."""
+    eng, rng, vocab = _text_engine(seed)
+    reader = eng.acquire_reader()
+    mappers = eng.mappers
+    texts = [" ".join(rng.choice(vocab, size=int(rng.integers(1, 4))))
+             for _ in range(6)]
+    queries = [dsl.parse_query({"match": {"body": t}}) for t in texts]
+
+    solos = [query_shard(reader, mappers, q, size=10,
+                         sort=parse_sort(None), track_total_hits=track)
+             for q in queries]
+    assert all(s.collector == "wand_topk" for s in solos)
+
+    doc_count = sum(s.n_docs for s in reader.segments)
+    dfs = {}
+    for q in queries:
+        _dc, d = shard_term_stats(reader, mappers, q)
+        for f, tm in d.items():
+            dfs.setdefault(f, {}).update(tm)
+    ctxs = _build_ctxs(reader, mappers, doc_count, dfs)
+    clause_lists = [wand_clauses(q, mappers)[1] for q in queries]
+    track_limit = int(track) if track else 0
+    batch = batched_wand_topk_shard(ctxs, "body", clause_lists, 10,
+                                    track_limit)
+
+    for solo, (cands, hits, rel, max_score, prune) in zip(solos, batch):
+        assert [(c.segment_idx, c.doc) for c in cands[:10]] == \
+            [(c.segment_idx, c.doc) for c in solo.docs]
+        np.testing.assert_allclose([c.score for c in cands[:10]],
+                                   [d.score for d in solo.docs],
+                                   rtol=1e-6, atol=1e-6)
+        assert hits == solo.total_hits
+        assert rel == solo.total_relation
+        assert prune == solo.prune_stats
+        if solo.max_score is None:
+            assert max_score is None
+        else:
+            np.testing.assert_allclose(max_score, solo.max_score,
+                                       rtol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [23 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_golden_knn_and_sparse_batch_parity(seed):
+    rng = np.random.default_rng(seed)
+    eng = InternalEngine(
+        MapperService({"properties": {
+            "vec": {"type": "dense_vector", "dims": 8},
+            "feats": {"type": "rank_features"}}}),
+        shard_label=f"kv{seed}")
+    for i in range(80):
+        eng.index(str(i), {
+            "vec": [float(x) for x in rng.standard_normal(8)],
+            "feats": {f"f{j}": float(rng.random() * 2 + 0.05)
+                      for j in rng.integers(0, 20, 4)}})
+        if i == 40:
+            eng.refresh()
+    eng.refresh()
+    reader = eng.acquire_reader()
+    mappers = eng.mappers
+    doc_count = sum(s.n_docs for s in reader.segments)
+    ctxs = _build_ctxs(reader, mappers, doc_count, None)
+
+    # kNN: 4 query vectors, batched matmul vs solo dense path
+    knn_bodies = [{"knn": {"field": "vec", "k": 6,
+                           "query_vector":
+                               [float(x) for x in rng.standard_normal(8)]}}
+                  for _ in range(4)]
+    specs = []
+    solos = []
+    for b in knn_bodies:
+        q = dsl.parse_query(b)
+        solos.append(query_shard(reader, mappers, q, size=5,
+                                 sort=parse_sort(None)))
+        spec = classify_request(
+            {"index": "i", "shard": 0, "window": 5, "body": {"query": b}},
+            mappers)
+        assert spec is not None and spec.kind == "knn"
+        specs.append(spec)
+    batch = batched_knn_shard(ctxs, "vec", specs, 6)
+    for solo, (cands, total, rel, max_score, _p) in zip(solos, batch):
+        assert [(c.segment_idx, c.doc) for c in cands[:5]] == \
+            [(c.segment_idx, c.doc) for c in solo.docs]
+        np.testing.assert_allclose([c.score for c in cands[:5]],
+                                   [d.score for d in solo.docs], rtol=1e-5)
+        assert total == solo.total_hits
+        assert rel == solo.total_relation
+
+    # sparse: resolved text_expansion, batched scorer vs solo dense path
+    sp_bodies = [{"text_expansion": {"feats": {"tokens": {
+        f"f{j}": float(rng.random() + 0.5) for j in rng.integers(0, 20, 3)
+    }}}} for _ in range(4)]
+    specs = []
+    solos = []
+    for b in sp_bodies:
+        q = dsl.parse_query(b)
+        solos.append(query_shard(reader, mappers, q, size=5,
+                                 sort=parse_sort(None)))
+        spec = classify_request(
+            {"index": "i", "shard": 0, "window": 5, "body": {"query": b}},
+            mappers)
+        assert spec is not None and spec.kind == "sparse"
+        specs.append(spec)
+    batch = batched_sparse_shard(ctxs, "feats", specs, 5)
+    for solo, (cands, total, rel, max_score, _p) in zip(solos, batch):
+        assert [(c.segment_idx, c.doc) for c in cands[:5]] == \
+            [(c.segment_idx, c.doc) for c in solo.docs]
+        np.testing.assert_allclose([c.score for c in cands[:5]],
+                                   [d.score for d in solo.docs], rtol=1e-5)
+        assert total == solo.total_hits
+        assert rel == solo.total_relation
+
+
+def test_classify_rejects_solo_only_shapes():
+    """Eligibility mirrors choose_collector_context: anything the batched
+    demux cannot reproduce byte-identically stays on the solo path."""
+    mappers = MapperService({"properties": {
+        "body": {"type": "text"},
+        "vec": {"type": "dense_vector", "dims": 4}}})
+    base = {"index": "i", "shard": 0, "window": 10,
+            "body": {"query": {"match": {"body": "hello world"}}}}
+    assert classify_request(base, mappers) is not None
+    bad = [
+        {**base, "window": 0},
+        {**base, "df_overrides": {"body": {"hello": 3}}},
+        {**base, "body": {**base["body"], "aggs": {"a": {"terms": {
+            "field": "body"}}}}},
+        {**base, "body": {**base["body"], "sort": [{"body": "asc"}]}},
+        {**base, "body": {**base["body"], "search_after": [1.5]}},
+        {**base, "body": {**base["body"], "min_score": 0.5}},
+        {**base, "body": {**base["body"], "rescore": {"window_size": 5}}},
+        {**base, "body": {**base["body"], "track_total_hits": True}},
+        {**base, "body": {**base["body"], "profile": True}},
+        {**base, "body": {"query": {"match": {"body": {
+            "query": "hello", "operator": "and"}}}}},
+        {**base, "body": {"query": {"knn": {
+            "field": "vec", "query_vector": [1, 0, 0, 0],
+            "filter": {"match": {"body": "x"}}}}}},
+    ]
+    for req in bad:
+        assert classify_request(req, mappers) is None, req
+    # explicit score-desc sort is still the default shape: eligible
+    assert classify_request(
+        {**base, "body": {**base["body"], "sort": ["_score"]}},
+        mappers) is not None
+    # pure exact-kNN is eligible
+    assert classify_request(
+        {**base, "body": {"query": {"knn": {
+            "field": "vec", "query_vector": [1, 0, 0, 0]}}}},
+        mappers).kind == "knn"
+
+
+# ---------------------------------------------------------------------------
+# end to end: concurrent searches coalesce; enabled=false restores solo
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = InProcessCluster(n_nodes=1, seed=31)
+    c.start()
+    client = c.client()
+    _ok(*c.call(lambda cb: client.create_index("bx", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "body": {"type": "text"},
+            "vec": {"type": "dense_vector", "dims": 8},
+            "feats": {"type": "rank_features"}}}}, cb)))
+    c.ensure_green("bx")
+    rng = np.random.default_rng(13)
+    vocab = [f"w{i}" for i in range(40)]
+    weights = 1.0 / np.arange(1, 41)
+    weights /= weights.sum()
+    for i in range(120):
+        doc = {"body": " ".join(rng.choice(
+                   vocab, size=int(rng.integers(4, 20)), p=weights)),
+               "vec": [float(x) for x in rng.standard_normal(8)],
+               "feats": {f"f{j}": float(rng.random() * 2 + 0.1)
+                         for j in rng.integers(0, 30, 5)}}
+        _ok(*c.call(lambda cb, i=i, doc=doc: client.index_doc(
+            "bx", f"d{i}", doc, cb)))
+    c.call(lambda cb: client.refresh("bx", cb))
+    yield c
+    c.stop()
+
+
+def _set_batch_enabled(c, value):
+    client = c.client()
+    _ok(*c.call(lambda cb: client.cluster_update_settings(
+        {"persistent": {"search.batch.enabled": value}}, cb)))
+
+
+def _concurrent_wave(c, bodies):
+    client = c.client()
+    boxes = []
+    for b in bodies:
+        box = []
+        client.search("bx", b,
+                      lambda resp, err=None, box=box: box.append(
+                          (resp, err)))
+        boxes.append(box)
+    c.run_until(lambda: all(boxes), 120.0)
+    return [box[0] for box in boxes]
+
+
+@pytest.mark.parametrize("bodies", [
+    [{"query": {"match": {"body": "w0 w3"}}, "size": 5},
+     {"query": {"match": {"body": "w0 w3"}}, "size": 5},
+     {"query": {"match": {"body": "w1 w7 w20"}}, "size": 5},
+     {"query": {"match": {"body": "w2"}}, "size": 5,
+      "track_total_hits": False}],
+    [{"query": {"knn": {"field": "vec", "k": 7, "query_vector":
+        [0.1 * j - 0.4 for j in range(8)]}}, "size": 5},
+     {"query": {"knn": {"field": "vec", "k": 7, "query_vector":
+         [0.3 - 0.1 * j for j in range(8)]}}, "size": 5},
+     {"query": {"knn": {"field": "vec", "k": 7, "query_vector":
+         [0.05 * j for j in range(8)]}}, "size": 5}],
+    [{"query": {"text_expansion": {"feats": {"tokens": {
+        f"f{j}": 1.0 + 0.1 * j for j in range(4)}}}}, "size": 5},
+     {"query": {"text_expansion": {"feats": {"tokens": {
+         f"f{j}": 2.0 - 0.2 * j for j in range(3)}}}}, "size": 5}],
+], ids=["text", "knn", "sparse"])
+def test_concurrent_wave_batches_and_matches_solo(cluster, bodies):
+    c = cluster
+    batcher = c.nodes["node0"].search_transport.batcher
+    before = dict(batcher.stats)
+    batched = _concurrent_wave(c, bodies)
+    for resp, err in batched:
+        assert err is None, err
+    # the wave coalesced: dispatches moved, occupancy >= 2
+    assert batcher.stats["batches_dispatched"] > \
+        before["batches_dispatched"]
+    assert batcher.stats["max_occupancy"] >= 2
+
+    # byte-identical to the solo path
+    _set_batch_enabled(c, "false")
+    try:
+        client = c.client()
+        for body, (resp, _err) in zip(bodies, batched):
+            solo = _ok(*c.call(lambda cb, b=body: client.search(
+                "bx", b, cb)))
+            assert solo["hits"]["hits"] == resp["hits"]["hits"]
+            assert solo["hits"]["total"] == resp["hits"]["total"]
+            assert solo["_shards"] == resp["_shards"]
+    finally:
+        _set_batch_enabled(c, None)
+
+
+def test_batch_disabled_keeps_batcher_idle(cluster):
+    c = cluster
+    batcher = c.nodes["node0"].search_transport.batcher
+    _set_batch_enabled(c, "false")
+    try:
+        before = dict(batcher.stats)
+        resps = _concurrent_wave(
+            c, [{"query": {"match": {"body": "w0 w1"}}, "size": 3}] * 3)
+        for resp, err in resps:
+            assert err is None
+            assert len(resp["hits"]["hits"]) == 3
+        assert batcher.stats == before   # nothing routed to the batcher
+    finally:
+        _set_batch_enabled(c, None)
+
+
+def test_msearch_lines_share_a_batch(cluster):
+    """_msearch fans its lines out as independent shard queries within
+    one scheduler tick — they land in the same batch by construction."""
+    import json as _json
+
+    from elasticsearch_tpu.rest.controller import RestRequest
+    from elasticsearch_tpu.rest.routes import build_controller
+    c = cluster
+    batcher = c.nodes["node0"].search_transport.batcher
+    before = dict(batcher.stats)
+    controller = build_controller(c.client())
+    lines = [
+        {"index": "bx"}, {"query": {"match": {"body": "w0 w2"}}, "size": 3},
+        {"index": "bx"}, {"query": {"match": {"body": "w1"}}, "size": 3},
+        {"index": "bx"}, {"query": {"match": {"body": "w3 w5"}}, "size": 3},
+    ]
+    raw = "\n".join(_json.dumps(ln) for ln in lines) + "\n"
+    out = []
+    controller.dispatch(
+        RestRequest(method="POST", path="/_msearch", query={}, body=None,
+                    raw_body=raw.encode()),
+        lambda s, b: out.append((s, b)))
+    c.run_until(lambda: bool(out), 120.0)
+    status, resp = out[0]
+    assert status == 200
+    assert len(resp["responses"]) == 3
+    for r in resp["responses"]:
+        assert "error" not in r
+    assert batcher.stats["queries_dispatched"] >= \
+        before["queries_dispatched"] + 3
+    assert batcher.stats["max_occupancy"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# chaos: deadline expiry + cancellation inside a batch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [47 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_deadline_expiry_and_cancel_mid_batch(cluster, seed):
+    """A member whose budget expired before the drain and a member whose
+    task was cancelled while queued both fail INDIVIDUALLY; their
+    batch-mates complete normally with correct results."""
+    from elasticsearch_tpu.utils.errors import (
+        SearchBudgetExceededError, TaskCancelledError,
+    )
+    c = cluster
+    rng = np.random.default_rng(seed)
+    sts = c.nodes["node0"].search_transport
+    batcher = sts.batcher
+    n = 5
+    reqs = [{"index": "bx", "shard": 0, "window": 5,
+             "body": {"query": {"match": {
+                 "body": f"w{int(rng.integers(0, 8))} w2"}}}}
+            for _ in range(n)]
+    expired_i = int(rng.integers(0, n))
+    cancelled_i = int((expired_i + 1 + rng.integers(0, n - 1)) % n)
+    reqs[expired_i]["budget_remaining"] = 0.0
+
+    deferreds = [batcher.try_enqueue(r) for r in reqs]
+    assert all(d is not None for d in deferreds)
+    key = next(iter(batcher._queues))
+    members = list(batcher._queues[key])
+    assert len(members) == n
+    members[cancelled_i].task.cancel("chaos cancel")
+
+    results = [None] * n
+    for i, d in enumerate(deferreds):
+        d._subscribe(lambda v, i=i: results.__setitem__(i, ("ok", v)),
+                     lambda e, i=i: results.__setitem__(i, ("err", e)))
+    batcher._drain(key)
+    assert all(r is not None for r in results)
+
+    for i, (kind, payload) in enumerate(results):
+        if i == expired_i:
+            assert kind == "err"
+            assert "budget expired" in str(payload)
+        elif i == cancelled_i:
+            assert kind == "err"
+            assert "cancelled" in str(payload)
+        else:
+            assert kind == "ok", payload
+            # survivors match the solo path exactly
+            solo = sts._execute_query_solo(dict(reqs[i]))
+            assert payload["docs"] == solo["docs"]
+            assert payload["total"] == solo["total"]
+            assert payload["relation"] == solo["relation"]
+    assert batcher.stats["queries_expired"] >= 1
+    assert batcher.stats["queries_cancelled"] >= 1
+    # raising classes are the solo path's own (typed end to end)
+    assert SearchBudgetExceededError is not None
+    assert TaskCancelledError is not None
+
+
+@pytest.mark.slow
+def test_chaos_sweep_mid_batch_failures():
+    """>=5-seed CI sweep of the mid-batch deadline/cancel case
+    (CHAOS_SEEDS widens it further)."""
+    for k in range(max(CHAOS_SEEDS, 5)):
+        c = InProcessCluster(n_nodes=1, seed=900 + k)
+        c.start()
+        try:
+            client = c.client()
+            _ok(*c.call(lambda cb: client.create_index("bx", {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": {"properties": {
+                    "body": {"type": "text"}}}}, cb)))
+            c.ensure_green("bx")
+            for i in range(30):
+                _ok(*c.call(lambda cb, i=i: client.index_doc(
+                    "bx", f"d{i}", {"body": f"w{i % 5} w0"}, cb)))
+            c.call(lambda cb: client.refresh("bx", cb))
+            sts = c.nodes["node0"].search_transport
+            reqs = [{"index": "bx", "shard": 0, "window": 3,
+                     "body": {"query": {"match": {"body": f"w{j % 5}"}}},
+                     **({"budget_remaining": 0.0} if j == 0 else {})}
+                    for j in range(4)]
+            deferreds = [sts.batcher.try_enqueue(r) for r in reqs]
+            key = next(iter(sts.batcher._queues))
+            results = [None] * len(deferreds)
+            for i, d in enumerate(deferreds):
+                d._subscribe(
+                    lambda v, i=i: results.__setitem__(i, ("ok", v)),
+                    lambda e, i=i: results.__setitem__(i, ("err", e)))
+            sts.batcher._drain(key)
+            assert results[0][0] == "err"
+            assert all(r[0] == "ok" for r in results[1:])
+        finally:
+            c.stop()
+
+
+def test_batch_stats_surface_in_node_stats(cluster):
+    c = cluster
+    _concurrent_wave(
+        c, [{"query": {"match": {"body": "w0"}}, "size": 3}] * 2)
+    stats = c.nodes["node0"].local_node_stats()
+    sb = stats["search_batch"]
+    assert sb["batches_dispatched"] >= 1
+    assert sb["queries_dispatched"] >= 2
+    assert sb["mean_occupancy"] >= 1.0
+    assert "mean_wait_ms" in sb

@@ -604,6 +604,41 @@ def test_corrupted_snapshot_blob_fails_restore_not_garbage(tmp_path):
         c.stop()
 
 
+def test_gateway_state_checksum_detected_at_boot(tmp_path):
+    """The node's persisted coordination state carries the same CRC32
+    footer as every shard artifact: a rotted/torn _state/state.json
+    surfaces at boot as a typed ShardCorruptedError-family error
+    (CorruptedGatewayStateError), never a bare JSON parse error — and
+    never a silent boot from garbage coordination state."""
+    from elasticsearch_tpu.cluster.state import ClusterState
+    from elasticsearch_tpu.gateway import (
+        CorruptedGatewayStateError, GatewayMetaState,
+    )
+    io = FaultyDiskIO()
+    gw = GatewayMetaState(str(tmp_path / "n0"))
+    persisted = gw.load_or_create(ClusterState())
+    persisted.current_term = 3          # write-through persist
+    # clean reload round-trips
+    reloaded = GatewayMetaState(str(tmp_path / "n0")).load_or_create(
+        ClusterState())
+    assert reloaded.current_term == 3
+
+    # payload bit-flip: checksum mismatch, typed at boot
+    io.corrupt_file(gw.path, skip_footer=True)
+    with pytest.raises(CorruptedGatewayStateError):
+        GatewayMetaState(str(tmp_path / "n0")).load_or_create(
+            ClusterState())
+    assert issubclass(CorruptedGatewayStateError, ShardCorruptedError)
+
+    # torn tail (footer gone): same typed failure
+    gw2 = GatewayMetaState(str(tmp_path / "n1"))
+    gw2.load_or_create(ClusterState())
+    io.truncate_file(gw2.path, drop_bytes=6)
+    with pytest.raises(CorruptedGatewayStateError):
+        GatewayMetaState(str(tmp_path / "n1")).load_or_create(
+            ClusterState())
+
+
 def test_data_node_reboot_reconverges_green(tmp_path):
     """Reboot a non-master data node in a live cluster: the master still
     routes STARTED copies to it that its fresh process no longer has.

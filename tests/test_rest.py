@@ -214,6 +214,51 @@ def test_cluster_and_cat(rest, cluster):
     assert body["persistent"]["my.flag"] == "on"
 
 
+def test_clear_corruption_markers_endpoint(tmp_path):
+    """POST /_internal/corruption_markers/_clear (remove-corrupted-data
+    tool analog): unfences this node's marked stores through the existing
+    Store.clear_corruption_markers(), reporting per-shard removals."""
+    c = InProcessCluster(n_nodes=1, seed=21,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        controller = build_controller(c.client())
+
+        def do(method, path):
+            out = []
+            controller.dispatch(
+                RestRequest(method=method, path=path, query={},
+                            body=None, raw_body=b""),
+                lambda s, b: out.append((s, b)))
+            c.run_until(lambda: bool(out), 60.0)
+            return out[0]
+
+        box = []
+        c.client().create_index("fence", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}},
+            lambda resp, err=None: box.append((resp, err)))
+        c.run_until(lambda: bool(box), 60.0)
+        c.ensure_green("fence")
+
+        # no markers anywhere: a clean no-op
+        status, body = do("POST", "/_internal/corruption_markers/_clear")
+        assert status == 200
+        assert body["markers_removed"] == 0 and body["shards"] == []
+
+        store = c.nodes["node0"].indices_service.shard(
+            "fence", 0).engine.store
+        store.mark_corrupted("chaos: injected checksum mismatch")
+        assert store.is_corrupted
+        status, body = do("POST", "/_internal/corruption_markers/_clear")
+        assert status == 200
+        assert body["markers_removed"] == 1
+        assert body["shards"] == [{"index": "fence", "shard": 0,
+                                   "markers_removed": 1}]
+        assert not store.is_corrupted
+    finally:
+        c.stop()
+
+
 def test_error_shapes(rest):
     status, body = rest("GET", "/nope/_doc/1")
     assert status == 404
