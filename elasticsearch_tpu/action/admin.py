@@ -816,6 +816,10 @@ class MasterActions:
             routing = state.routing_table
             if retry_failed:
                 from dataclasses import replace as _replace
+                # the operator may have cleared corruption markers or
+                # replaced disks: the gateway fetch cache is stale
+                if self.allocation.gateway_allocator is not None:
+                    self.allocation.gateway_allocator.invalidate_all()
                 for sr in list(routing.all_shards()):
                     if sr.failed_attempts and not sr.assigned:
                         irt0 = routing.index(sr.index)
@@ -905,6 +909,10 @@ class MasterActions:
 
     def _on_shard_started(self, req: Dict[str, Any], sender: str) -> Deferred:
         sr = ShardRouting.from_dict(req["shard"])
+        if self.allocation.gateway_allocator is not None:
+            # a started report from the host doubles as proof the copy is
+            # live again (clears the reboot-reconcile verification mark)
+            self.allocation.gateway_allocator.note_started(sr)
 
         def update(state: ClusterState) -> ClusterState:
             return self.allocation.apply_started_shards(state, [sr])
@@ -1071,17 +1079,28 @@ def _resize_replicas(irt: IndexRoutingTable, n_replicas: int
 
 
 def cluster_health(state: ClusterState,
-                   index: Optional[str] = None) -> Dict[str, Any]:
+                   index: Optional[str] = None,
+                   unverified: Optional[List[Dict[str, Any]]] = None
+                   ) -> Dict[str, Any]:
     """green: all copies active; yellow: all primaries active; red: some
-    primary inactive (ClusterHealthStatus semantics)."""
+    primary inactive (ClusterHealthStatus semantics).
+
+    ``unverified``: STARTED copies the master's gateway allocator has not
+    yet confirmed are actually hosted (the host process rebooted and the
+    reconcile fetch hasn't seen the shard live again). They count as
+    not-active — health must not report green while a STARTED-routed
+    shard has no live local copy."""
     routing = state.routing_table
     names = ([state.metadata.index(index).name] if index
              else list(routing.indices))
+    pending = {(u["index"], u["shard"], u["node"])
+               for u in (unverified or [])}
     active_primary = 0
     active_total = 0
     unassigned = 0
     initializing = 0
     relocating = 0
+    pending_verify = 0
     status = "green"
     for name in names:
         if not routing.has_index(name):
@@ -1095,13 +1114,20 @@ def cluster_health(state: ClusterState,
                 initializing += 1
                 status = "red" if sr.primary else (
                     "yellow" if status == "green" else status)
+            elif (sr.index, sr.shard_id, sr.node_id) in pending:
+                # routed STARTED, but its rebooted host hasn't proven it
+                # serves the copy: treat like an initializing shard
+                pending_verify += 1
+                initializing += 1
+                status = "red" if sr.primary else (
+                    "yellow" if status == "green" else status)
             else:
                 active_total += 1
                 if sr.primary:
                     active_primary += 1
                 if sr.state == ShardState.RELOCATING:
                     relocating += 1
-    return {
+    out = {
         "cluster_name": state.cluster_name,
         "status": status,
         "number_of_nodes": len(state.nodes),
@@ -1113,3 +1139,6 @@ def cluster_health(state: ClusterState,
         "unassigned_shards": unassigned,
         "timed_out": False,
     }
+    if pending_verify:
+        out["unverified_started_shards"] = pending_verify
+    return out

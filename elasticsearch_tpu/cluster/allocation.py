@@ -162,6 +162,11 @@ class AllocationService:
                  deciders: Optional[Sequence[AllocationDecider]] = None):
         self.deciders = list(deciders if deciders is not None
                              else default_deciders())
+        # GatewayAllocator (gateway.py), attached by the node: when set,
+        # unassigned shards with a prior identity are placed on the node
+        # holding the freshest non-corrupted on-disk copy instead of by
+        # balance alone. None (the default) keeps reroute pure balance.
+        self.gateway_allocator = None
 
     def disk_threshold(self) -> Optional["DiskThresholdDecider"]:
         """The service's disk decider, for cluster-info refreshes."""
@@ -214,6 +219,17 @@ class AllocationService:
         if not data_nodes:
             return state
         routing = state.routing_table
+        changed = False
+        gateway = self.gateway_allocator
+        if gateway is not None:
+            # ReplicaShardAllocator cancel pass: an in-flight empty-store
+            # replica build yields when a node holding the copy's real
+            # data rejoins (the cancelled entry re-enters the unassigned
+            # pool below and lands on the copy-holder)
+            routing, n_cancelled = gateway.cancel_replaceable_recoveries(
+                state, routing, self)
+            if n_cancelled:
+                changed = True
         loads: Dict[str, int] = {
             nid: len(routing.shards_on_node(nid)) for nid in data_nodes}
         index_loads: Dict[str, Dict[str, int]] = {
@@ -227,7 +243,6 @@ class AllocationService:
             if sr.assigned:
                 index_totals[sr.index] = index_totals.get(sr.index, 0) + 1
         n_nodes = len(data_nodes)
-        changed = False
 
         def place(shard: ShardRouting, target: str) -> None:
             nonlocal routing, changed
@@ -245,6 +260,10 @@ class AllocationService:
             (sr for sr in routing.all_shards()
              if sr.state == ShardState.UNASSIGNED),
             key=lambda sr: (not sr.primary, sr.index, sr.shard_id))
+        if gateway is not None:
+            # batch the shard-state fetches this pass will want into one
+            # request per node before walking the shards
+            gateway.prefetch(unassigned, state)
         for shard in unassigned:
             # replicas wait for an active primary to recover from
             if not shard.primary:
@@ -252,6 +271,27 @@ class AllocationService:
                 if not primary.active:
                     continue
             st = state.next_version(routing_table=routing) if changed else state
+            if gateway is not None and shard.last_allocation_id is not None:
+                # this copy existed before: consult the gateway fetch
+                # (Primary/ReplicaShardAllocator) before balance
+                action, detail = gateway.decide_unassigned(shard, st, self)
+                if action == "wait":
+                    continue   # fetch in flight / throttled: next reroute
+                if action == "allocate":
+                    place(shard, detail)
+                    continue
+                if action in ("refuse", "fallback") and detail and \
+                        shard.unassigned_reason != detail:
+                    # surface the fetch-derived reason on the routing
+                    # entry (health / _cat/shards / allocation explain)
+                    noted = replace(shard, unassigned_reason=detail)
+                    routing = routing.put_index(
+                        routing.index(shard.index).replace_shard(
+                            shard, noted))
+                    shard = noted
+                    changed = True
+                if action == "refuse":
+                    continue   # stays unassigned, loudly
             candidates = [
                 nid for nid, node in data_nodes.items()
                 if self.decide(shard, node, st) == Decision.YES]
@@ -367,6 +407,11 @@ class AllocationService:
                         sr.allocation_id is not None), None)
         if current is None:
             return state
+        if self.gateway_allocator is not None:
+            # whatever the fetch cache said about this node's copy is
+            # stale now (a corruption marker may have just appeared)
+            self.gateway_allocator.invalidate_node_entry(
+                failed.index, failed.shard_id, current.node_id)
         dropped = current.fail(reason)
         if not count_failure:
             dropped = replace(dropped,
@@ -386,10 +431,16 @@ class AllocationService:
                 irt = irt.replace_shard(promoted, promoted.promote_to_primary())
                 demoted = next(sr for sr in irt.shard_group(failed.shard_id)
                                if sr.primary and sr.state == ShardState.UNASSIGNED)
+                # the replacement replica slot keeps the failed copy's
+                # identity + reason: the gateway fetch can still match
+                # whatever data outlived the failure, and explain keeps
+                # answering WHY the copy died
                 irt = irt.replace_shard(
-                    demoted, ShardRouting(index=failed.index,
-                                          shard_id=failed.shard_id,
-                                          primary=False))
+                    demoted, ShardRouting(
+                        index=failed.index, shard_id=failed.shard_id,
+                        primary=False,
+                        unassigned_reason=demoted.unassigned_reason,
+                        last_allocation_id=demoted.last_allocation_id))
         routing = routing.put_index(irt)
         return self.reroute(state.next_version(routing_table=routing,
                                                metadata=metadata))

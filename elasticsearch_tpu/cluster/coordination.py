@@ -454,8 +454,15 @@ class Coordinator:
         if req["term"] > self.state.current_term:
             self.state.handle_start_join(sender, req["term"])
         self._become_follower(sender)
+        # the responder's identity rides along so the leader's failure
+        # detector doubles as a REBOOT detector: a restarted process
+        # answers checks with the SAME applied (term, version) — its
+        # gateway persisted them — but different content (routing reset),
+        # so version comparison alone can never notice it. The ephemeral
+        # id can (DiscoveryNode per-boot identity).
         return {"ok": True, "applied_term": self.applied_state.term,
-                "applied_version": self.applied_state.version}
+                "applied_version": self.applied_state.version,
+                "node": self.node.to_dict()}
 
     # -- publication ----------------------------------------------------------
 
@@ -619,6 +626,22 @@ class Coordinator:
                                 (self.applied_state.term,
                                  self.applied_state.version):
                             self._catch_up(p)
+                        elif r and r.get("node"):
+                            # same version but a NEW ephemeral id: the
+                            # process rebooted into gateway-reset state
+                            # that our version checks can't distinguish.
+                            # Re-admit it like a join — the entry replace
+                            # bumps the version, and the uuid mismatch
+                            # forces a full-state redelivery, which the
+                            # rebooted node's reconciler turns into
+                            # in-place store recovery.
+                            responder = DiscoveryNode.from_dict(r["node"])
+                            known = self.applied_state.nodes.get(p)
+                            if known is not None and \
+                                    responder.ephemeral_id and \
+                                    known.ephemeral_id != \
+                                    responder.ephemeral_id:
+                                self._readmit_rebooted(responder)
                     else:
                         missed[p] = missed.get(p, 0) + 1
                         if missed[p] >= 3:
@@ -633,6 +656,25 @@ class Coordinator:
 
         self._heartbeat_timer = self.scheduler.schedule(
             self.settings.heartbeat_interval, beat)
+
+    def _readmit_rebooted(self, joining: DiscoveryNode) -> None:
+        """Replace a member entry whose process restarted behind it (seen
+        via the heartbeat's ephemeral id). Same update as a NODE_JOIN from
+        a restarted process; idempotent — once the entry carries the new
+        ephemeral id the guard no-ops."""
+        if self.mode != Mode.LEADER:
+            return
+
+        def update(state: ClusterState) -> ClusterState:
+            existing = state.nodes.get(joining.node_id)
+            if existing is None or \
+                    existing.ephemeral_id == joining.ephemeral_id:
+                return state
+            return state.with_nodes(
+                {**state.nodes, joining.node_id: joining},
+                state.master_node_id)
+        self.submit_state_update(
+            f"node-rebooted [{joining.node_id}]", update)
 
     def _catch_up(self, peer: str) -> None:
         """Re-send the COMMITTED state to a lagging follower (a healed

@@ -41,6 +41,11 @@ class ShardRouting:
     # surfaced by _cluster/allocation/explain so operators can see e.g.
     # a corruption marker keeping a shard red
     unassigned_reason: Optional[str] = None
+    # the allocation id this copy held BEFORE it became unassigned
+    # (UnassignedInfo + in-sync-allocation-ids analog): the gateway
+    # allocator matches on-disk copies against it so a restarted shard
+    # goes back to the node actually holding its data
+    last_allocation_id: Optional[str] = None
 
     @property
     def active(self) -> bool:
@@ -61,7 +66,7 @@ class ShardRouting:
         # counts CONSECUTIVE failures (UnassignedInfo is discarded once a
         # shard starts in the reference)
         return replace(self, state=ShardState.STARTED, failed_attempts=0,
-                       unassigned_reason=None)
+                       unassigned_reason=None, last_allocation_id=None)
 
     def relocate(self, target_node: str) -> "ShardRouting":
         assert self.state == ShardState.STARTED
@@ -69,11 +74,18 @@ class ShardRouting:
                        relocating_node_id=target_node)
 
     def fail(self, reason: Optional[str] = None) -> "ShardRouting":
+        # an ACTIVE copy's identity is its own allocation id; a copy that
+        # never started (failed mid-recovery) keeps pointing at the prior
+        # on-disk identity, so the gateway fetch can still match the data
+        # that outlived the failed attempt
+        last = self.allocation_id if self.active else \
+            (self.last_allocation_id or self.allocation_id)
         return ShardRouting(index=self.index, shard_id=self.shard_id,
                             primary=self.primary,
                             failed_attempts=self.failed_attempts + 1,
                             unassigned_reason=reason or
-                            self.unassigned_reason)
+                            self.unassigned_reason,
+                            last_allocation_id=last)
 
     def promote_to_primary(self) -> "ShardRouting":
         return replace(self, primary=True)
@@ -85,7 +97,8 @@ class ShardRouting:
                 "relocating_node": self.relocating_node_id,
                 "allocation_id": self.allocation_id,
                 "failed_attempts": self.failed_attempts,
-                "unassigned_reason": self.unassigned_reason}
+                "unassigned_reason": self.unassigned_reason,
+                "last_allocation_id": self.last_allocation_id}
 
     @staticmethod
     def from_dict(d: Mapping[str, Any]) -> "ShardRouting":
@@ -96,7 +109,8 @@ class ShardRouting:
                             relocating_node_id=d.get("relocating_node"),
                             allocation_id=d.get("allocation_id"),
                             failed_attempts=d.get("failed_attempts", 0),
-                            unassigned_reason=d.get("unassigned_reason"))
+                            unassigned_reason=d.get("unassigned_reason"),
+                            last_allocation_id=d.get("last_allocation_id"))
 
 
 @dataclass(frozen=True)

@@ -134,6 +134,10 @@ class InternalEngine:
         # (index/IndexSortConfig.java:57): new segments store docs in
         # sort order, so sort-matching scans read presorted data
         self.index_sort = index_sort
+        # ride-along commit metadata (ShardStateMetadata analog): the
+        # shard stamps its allocation id here so the gateway fetch can
+        # match an on-disk copy to its last-known routing identity
+        self.commit_extra: Dict[str, Any] = {}
         self.tracker = LocalCheckpointTracker()
 
         self._lock = threading.RLock()
@@ -399,6 +403,12 @@ class InternalEngine:
                 self.tracker.max_seqno,
                 self.tracker.checkpoint,
                 translog_gen,
+                # the term stamps WHICH primacy's history this commit
+                # belongs to: recovery reuse must refuse a commit from an
+                # older term — the same seqno can name different ops
+                # across a failover
+                extra={**self.commit_extra,
+                       "primary_term": self.primary_term},
             )
             if self.translog is not None:
                 self.translog.trim_below(translog_gen)

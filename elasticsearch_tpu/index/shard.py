@@ -58,12 +58,20 @@ class IndexShard:
         self.primary = primary
         self.primary_term = primary_term
         self.allocation_id = allocation_id or uuid_mod.uuid4().hex
+        # how this copy's data came to be on this node ("existing_store",
+        # "empty_store", "peer", "peer_reuse", "in_place") — set by the
+        # reconciler; observable so tests/operators can assert a restart
+        # recovered in place instead of paying an avoidable copy
+        self.recovery_kind: Optional[str] = None
         self.engine = InternalEngine(
             mapper_service, store=store, translog=translog,
             primary_term=primary_term,
             shard_label=f"{shard_id.index}_{shard_id.shard}",
             index_sort=index_sort,
             check_on_startup=check_on_startup)
+        # every commit this copy writes records its identity, so a later
+        # gateway fetch can match the on-disk data to routing
+        self.engine.commit_extra["allocation_id"] = self.allocation_id
         self.search = SearchService(self.engine, index_name=shard_id.index)
         self.tracker: Optional[ReplicationTracker] = None
         if primary:
@@ -80,6 +88,15 @@ class IndexShard:
         self.primary = True
         self.tracker = ReplicationTracker(self.allocation_id,
                                           self.engine.tracker)
+
+    def rebind_tracker(self) -> None:
+        """Re-point the ReplicationTracker at the engine's (possibly
+        replaced) local checkpoint tracker. ``recover_from_store`` swaps
+        the engine's tracker for one seeded from the commit; without the
+        rebind a store-recovered primary computes its global checkpoint
+        from the abandoned pre-recovery tracker (stuck at -1 forever)."""
+        if self.tracker is not None:
+            self.tracker.local = self.engine.tracker
 
     # ------------------------------------------------------------------
     # write path

@@ -207,6 +207,44 @@ class Store:
     def list_segment_files(self) -> List[str]:
         return sorted(p.stem for p in (self.path / "segments").glob("*.npz"))
 
+    def local_shard_state(self) -> Dict[str, Any]:
+        """What this on-disk copy IS, without opening it: the last commit's
+        allocation id + seqno watermarks, whether the commit's checksum
+        verified, and any corruption marker. This is the per-shard answer
+        to the gateway's ``_list_gateway_started_shards`` fetch
+        (TransportNodesListGatewayStartedShards / ShardStateMetadata
+        analog), so the master can allocate restarted primaries to the
+        node holding the freshest non-corrupted copy."""
+        out: Dict[str, Any] = {
+            "has_data": False, "allocation_id": None, "generation": -1,
+            "max_seqno": -1, "local_checkpoint": -1,
+            "corrupted": self.corruption_reason(), "verified": False,
+        }
+        try:
+            commit = self.read_latest_commit()
+        except ShardCorruptedError as e:
+            # an unreadable commit point is data we must not trust — but
+            # it IS data: report the copy as present-and-corrupted so the
+            # allocator refuses it instead of calling the store empty
+            out["has_data"] = True
+            out["corrupted"] = out["corrupted"] or str(e)
+            return out
+        if commit is None:
+            return out
+        out.update(
+            has_data=True,
+            generation=commit["generation"],
+            max_seqno=commit["max_seqno"],
+            local_checkpoint=commit["local_checkpoint"],
+            allocation_id=(commit.get("extra") or {}).get("allocation_id"),
+            primary_term=(commit.get("extra") or {}).get(
+                "primary_term", -1),
+            # the commit footer just verified on read; segment payloads
+            # are NOT walked here (fetch must stay cheap) — full
+            # verification still happens at recovery open
+            verified=True)
+        return out
+
     # -- corruption markers ---------------------------------------------
 
     def mark_corrupted(self, reason: str) -> None:

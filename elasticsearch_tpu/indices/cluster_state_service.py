@@ -152,25 +152,30 @@ class IndicesClusterStateService:
     # recovery
     # ------------------------------------------------------------------
 
-    def _start_recovery(self, state: ClusterState, sr: ShardRouting) -> None:
+    def _start_recovery(self, state: ClusterState, sr: ShardRouting,
+                        allow_reuse: bool = True) -> None:
         metadata = state.metadata.index(sr.index)
         service = self.indices.create_index(metadata)
         term = metadata.primary_term(sr.shard_id)
 
         if sr.primary:
             # primary: recover from the local store (gateway allocation path)
+            had_data = self.indices.has_on_disk_data(metadata, sr.shard_id)
             shard = service.create_shard(sr.shard_id, primary=True,
                                          primary_term=term,
                                          allocation_id=sr.allocation_id)
             try:
                 if shard.engine.store is not None:
                     shard.engine.recover_from_store()
+                    shard.rebind_tracker()
             except Exception as e:  # noqa: BLE001 — reported to master
                 # drop the half-opened copy so a later reassignment to
                 # this node starts clean instead of colliding with it
                 service.remove_shard(sr.shard_id)
                 self._shard_failed(sr, f"store recovery failed: {e}")
                 return
+            shard.recovery_kind = "existing_store" if had_data \
+                else "empty_store"
             self._watch_engine(service, shard, sr)
             self._shard_started(sr)
             return
@@ -181,22 +186,72 @@ class IndicesClusterStateService:
         if not primary.active or primary.node_id is None:
             self._recovering.discard((sr.index, sr.shard_id))
             return   # retried on a later state where the primary is active
-        # fresh_store: this copy is rebuilt from the primary's ops, so any
-        # leftover on-disk state (incl. corruption markers from a failed
-        # previous copy on this node) is wiped first
-        shard = service.create_shard(sr.shard_id, primary=False,
-                                     primary_term=term,
-                                     allocation_id=sr.allocation_id,
-                                     fresh_store=True)
+
+        # local-reuse probe (ReplicaShardAllocator's file-reuse analog,
+        # collapsed to the safe ops-shaped gate): a fresh, non-corrupted
+        # local commit with no seqno holes is reopened NOW — the shard
+        # must exist before the recovery round-trip, or live replication
+        # fan-out (which already targets this INITIALIZING copy) would
+        # hit a missing shard for a full RTT — and the primary then
+        # confirms whether the reopened history may be kept (the source
+        # decides; a refusal wipes and pays the full copy)
+        local_commit = None
+        shard = None
+        if allow_reuse:
+            local = self.indices.local_shard_state(metadata.uuid,
+                                                   sr.shard_id)
+            if local and local.get("has_data") and local.get("verified") \
+                    and not local.get("corrupted") and \
+                    local.get("max_seqno", -1) >= 0 and \
+                    local.get("max_seqno") == local.get("local_checkpoint"):
+                try:
+                    shard = service.create_shard(
+                        sr.shard_id, primary=False, primary_term=term,
+                        allocation_id=sr.allocation_id, fresh_store=False)
+                    shard.engine.recover_from_store()
+                    if shard.engine.tracker.max_seqno != \
+                            local["max_seqno"]:
+                        # the local TRANSLOG replayed ops beyond the
+                        # probed commit (unacked writes the cluster never
+                        # kept): resurrecting them would diverge the copy
+                        raise ValueError(
+                            "local translog replayed past the commit")
+                    local_commit = {
+                        "max_seqno": local["max_seqno"],
+                        "local_checkpoint": local["local_checkpoint"],
+                        "primary_term": local.get("primary_term", -1)}
+                except Exception as e:  # noqa: BLE001 — fall back fresh
+                    logger.warning(
+                        "[%s] local reuse probe of [%s][%s] failed (%s); "
+                        "using full peer recovery",
+                        self.node_id, sr.index, sr.shard_id, e)
+                    service.remove_shard(sr.shard_id)
+                    shard = None
+        if shard is None:
+            # fresh_store: this copy is rebuilt from the primary's ops,
+            # so any leftover on-disk state (incl. corruption markers
+            # from a failed previous copy on this node) is wiped first
+            shard = service.create_shard(
+                sr.shard_id, primary=False, primary_term=term,
+                allocation_id=sr.allocation_id, fresh_store=True)
 
         def on_response(resp: Optional[Dict[str, Any]],
                         err: Optional[Exception]) -> None:
+            nonlocal shard
             if err is not None or resp is None:
                 service.remove_shard(sr.shard_id)
                 self._recovering.discard((sr.index, sr.shard_id))
                 self._shard_failed(sr, f"peer recovery failed: {err}")
                 return
+            reuse = bool(resp.get("reuse")) and local_commit is not None
             try:
+                if not reuse and local_commit is not None:
+                    # the source refused the reopened history (stale
+                    # term / not caught up): wipe it and copy in full
+                    service.remove_shard(sr.shard_id)
+                    shard = service.create_shard(
+                        sr.shard_id, primary=False, primary_term=term,
+                        allocation_id=sr.allocation_id, fresh_store=True)
                 for op in resp["ops"]:
                     # historical ops keep their original terms; the fence
                     # term is the recovery source's CURRENT primary term
@@ -214,6 +269,7 @@ class IndicesClusterStateService:
                 self._recovering.discard((sr.index, sr.shard_id))
                 self._shard_failed(sr, f"recovery apply failed: {e}")
                 return
+            shard.recovery_kind = "peer_reuse" if reuse else "peer"
             self._watch_engine(service, shard, sr)
             self._shard_started(sr)
 
@@ -239,10 +295,12 @@ class IndicesClusterStateService:
                 cb(None, NodeNotConnectedError(
                     f"no active primary for [{sr.index}][{sr.shard_id}]"))
                 return
-            self.ts.send_request(source, RECOVERY_START, {
-                "index": sr.index, "shard": sr.shard_id,
-                "allocation_id": sr.allocation_id,
-            }, cb, timeout=60.0)
+            request = {"index": sr.index, "shard": sr.shard_id,
+                       "allocation_id": sr.allocation_id}
+            if local_commit is not None:
+                request["local_commit"] = local_commit
+            self.ts.send_request(source, RECOVERY_START, request, cb,
+                                 timeout=60.0)
 
         from elasticsearch_tpu.utils.retry import (
             RetryableAction, transient_cluster_error,
@@ -274,12 +332,18 @@ class IndicesClusterStateService:
         try:
             if shard.engine.store is not None:
                 shard.engine.recover_from_store()
+                shard.rebind_tracker()
         except Exception as e:  # noqa: BLE001 — reported to master
             service.remove_shard(sr.shard_id)
             self._shard_failed(sr, f"in-place store recovery failed: {e}")
             return
+        shard.recovery_kind = "in_place"
         self._watch_engine(service, shard, sr)
         self._recovering.discard((sr.index, sr.shard_id))
+        # the master may be verifying this STARTED copy (gateway
+        # reconcile after our reboot): a started report is the fast-path
+        # proof the copy is live again — the verify poll is the fallback
+        self._shard_started(sr)
 
     def _watch_engine(self, service, shard, sr: ShardRouting) -> None:
         """Turn a later tragic engine event (corruption, EIO at flush)
@@ -314,9 +378,31 @@ class IndicesClusterStateService:
         if shard.engine.store is not None:
             shard.engine.store.ensure_not_corrupted()
         ops, max_seqno = shard.engine.snapshot_ops()
+        # local-reuse gate: the target may reopen its own commit (no
+        # wipe, no op copy) ONLY when that commit is provably identical
+        # to this primary's current state — hole-free (checkpoint ==
+        # max), fully caught up (same max_seqno), inside the global
+        # checkpoint (ops <= it are identical on every in-sync copy, so
+        # no divergent or missing-delete history can hide in the reused
+        # files), AND written under this primary's CURRENT term: equal
+        # seqno watermarks across different terms can name different ops
+        # (a dead primary's unreplicated write vs its successor's), and
+        # only the term identifies whose history the commit holds.
+        # Anything less pays the full copy.
+        reuse = False
+        local_commit = req.get("local_commit") or None
+        if local_commit is not None:
+            lcp = int(local_commit.get("local_checkpoint", -1))
+            lmax = int(local_commit.get("max_seqno", -1))
+            lterm = int(local_commit.get("primary_term", -1))
+            if lcp == lmax >= 0 and lmax == max_seqno and \
+                    lmax <= shard.global_checkpoint and \
+                    lterm == shard.primary_term:
+                reuse = True
+                ops = []
         shard.tracker.init_tracking(req["allocation_id"])
         shard.tracker.mark_in_sync(req["allocation_id"], max_seqno)
-        return {"ops": ops, "max_seqno": max_seqno,
+        return {"ops": ops, "max_seqno": max_seqno, "reuse": reuse,
                 "global_checkpoint": shard.global_checkpoint,
                 "primary_term": shard.primary_term}
 

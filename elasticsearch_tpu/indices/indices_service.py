@@ -142,6 +142,20 @@ class IndicesService:
             self.data_path, metadata.uuid, str(shard), "index",
             "commit-*.json")))
 
+    def local_shard_state(self, index_uuid: Optional[str],
+                          shard: int) -> Optional[Dict[str, object]]:
+        """On-disk metadata of this node's copy of one shard (commit
+        watermarks, recorded allocation id, corruption markers) WITHOUT
+        instantiating an IndexService — the gateway fetch must answer for
+        indices a freshly-rebooted process hasn't applied state for yet.
+        None when this node has no directory for the copy at all."""
+        if self.data_path is None or not index_uuid:
+            return None
+        base = os.path.join(self.data_path, index_uuid, str(shard), "index")
+        if not os.path.isdir(base):
+            return None
+        return Store(base, disk_io=self.disk_io).local_shard_state()
+
     def remove_index(self, name: str, delete_data: bool = False) -> None:
         service = self.indices.pop(name, None)
         if service is None:

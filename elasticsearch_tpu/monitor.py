@@ -163,6 +163,19 @@ def search_batch_stats(batcher) -> Dict[str, Any]:
     return out
 
 
+def gateway_stats(gateway_allocator) -> Dict[str, Any]:
+    """Gateway shard-state fetch observability (gateway.py
+    GatewayAllocator): how many fetches the master issued, how often the
+    cache answered, what the nodes reported (no copy / corruption-marked
+    / stale), plus reconcile failures and cancelled recoveries — so every
+    allocation decision the gateway makes is visible in _nodes/stats."""
+    if gateway_allocator is None:
+        return {}
+    # the allocator owns the race-safe snapshot (stats can be read from
+    # a REST thread while the dispatch thread mutates the fetch state)
+    return gateway_allocator.stats_snapshot()
+
+
 # ---------------------------------------------------------------------------
 # bootstrap checks
 # ---------------------------------------------------------------------------

@@ -75,6 +75,16 @@ class Node:
                                               disk_io=disk_io)
         self.allocation_service = AllocationService()
 
+        # gateway allocation (gateway.py GatewayAllocator): every node
+        # answers the _list_gateway_started_shards fetch from its local
+        # stores; the elected master uses the results to put restarted
+        # shards back on the nodes that actually hold their data
+        from elasticsearch_tpu.gateway import GatewayAllocator
+        self.gateway_allocator = GatewayAllocator(
+            node_id, self.transport_service, self.indices_service,
+            self._applied_state)
+        self.allocation_service.gateway_allocator = self.gateway_allocator
+
         initial_state = initial_state or ClusterState()
         persisted_state = None
         if data_path is not None:
@@ -90,6 +100,8 @@ class Node:
             initial_state, settings=coordinator_settings,
             seed_peers=seed_peers, on_committed=self._on_committed,
             persisted_state=persisted_state)
+        self.gateway_allocator.bind(self.coordinator,
+                                    self.allocation_service)
 
         self.reconciler = IndicesClusterStateService(
             node_id, self.indices_service, self.transport_service)
@@ -260,6 +272,9 @@ class Node:
             # cross-query micro-batching occupancy/wait/dispatch counters
             "search_batch": monitor.search_batch_stats(
                 self.search_transport.batcher),
+            # gateway shard-state fetch counters (fetches issued, cache
+            # hits, copies reported none/corrupted/stale, reconciles)
+            "gateway": monitor.gateway_stats(self.gateway_allocator),
         }
 
     def _on_committed(self, state: ClusterState) -> None:
@@ -280,7 +295,12 @@ class Node:
         (the reference couples this via NodeRemovalClusterStateTaskExecutor
         and reroute listeners)."""
         if self.coordinator.mode != Mode.LEADER:
+            # fetch/verify bookkeeping is master-only state
+            self.gateway_allocator.leader_stepdown()
             return
+        # keep the gateway fetch cache honest across membership changes,
+        # and start verifying STARTED copies on rebooted hosts
+        self.gateway_allocator.cluster_changed(state)
         dead = {sr.node_id for sr in state.routing_table.all_shards()
                 if sr.node_id is not None and sr.node_id not in state.nodes}
         dead |= {sr.relocating_node_id
@@ -1040,7 +1060,12 @@ class NodeClient:
     # -- cluster --------------------------------------------------------
 
     def cluster_health(self, index: Optional[str] = None) -> Dict[str, Any]:
-        return cluster_health(self.node._applied_state(), index)
+        # STARTED copies the (local, if master) gateway allocator hasn't
+        # confirmed are actually hosted count against green: a rebooted
+        # node's stale routing must not hide a missing shard
+        return cluster_health(
+            self.node._applied_state(), index,
+            unverified=self.node.gateway_allocator.health_unverified())
 
     def cluster_state(self) -> Dict[str, Any]:
         return self.node._applied_state().to_dict()

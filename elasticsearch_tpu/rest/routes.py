@@ -1461,6 +1461,17 @@ def build_controller(client: NodeClient) -> RestController:
                 "reason": target.unassigned_reason,
                 "failed_allocation_attempts": target.failed_attempts,
             }
+            if target.last_allocation_id:
+                explanation["unassigned_info"]["last_allocation_id"] = \
+                    target.last_allocation_id
+        # what the gateway shard-state fetch learned about this shard's
+        # on-disk copies (populated on the elected master): per-node
+        # has_data / freshness / corruption — the evidence behind a
+        # freshest-copy or refuse-corrupted decision
+        fetch = node.gateway_allocator.describe(target.index,
+                                                target.shard_id)
+        if fetch is not None:
+            explanation["gateway_fetch"] = fetch
         done(200, explanation)
     r("GET", "/_cluster/allocation/explain", allocation_explain)
     r("POST", "/_cluster/allocation/explain", allocation_explain)
@@ -1654,14 +1665,22 @@ def build_controller(client: NodeClient) -> RestController:
                     only, state.metadata))
             except Exception:  # noqa: BLE001 — unknown name: empty table
                 allowed = {only}
+        # STARTED copies awaiting gateway verification after their host
+        # rebooted (only the elected master tracks these)
+        unverified = {(u["index"], u["shard"], u["node"])
+                      for u in client.node.gateway_allocator
+                      .health_unverified()}
         rows = []
         for sr in state.routing_table.all_shards():
             if allowed is not None and sr.index not in allowed:
                 continue
+            reason = sr.unassigned_reason or "-"
+            if (sr.index, sr.shard_id, sr.node_id) in unverified:
+                reason = "pending_gateway_verify"
             rows.append([sr.index, str(sr.shard_id),
                          "p" if sr.primary else "r",
                          sr.state.value, sr.node_id or "-",
-                         sr.unassigned_reason or "-"])
+                         reason])
         done(200, _cat(req, ["index", "shard", "prirep", "state", "node",
                              "unassigned.reason"], rows))
     r("GET", "/_cat/shards", cat_shards)

@@ -268,6 +268,19 @@ class InProcessCluster:
         self.nodes[node_id] = fresh
         fresh.start()
 
+    def full_restart(self, run_for: float = 60.0) -> None:
+        """Stop EVERY node, then boot fresh processes over the same data
+        paths — the full-cluster-restart scenario the gateway allocator
+        exists for: metadata returns through each node's persisted state,
+        routing is re-derived by the shard-state fetch, and every copy
+        with fresh local data recovers in place."""
+        for node in self.nodes.values():
+            node.stop()
+        self.nodes.clear()
+        for nid in self._node_ids:
+            self.nodes[nid] = self._build_node(nid)
+        self.start(run_for=run_for)
+
     def shard_store_path(self, node_id: str, index: str, shard: int
                          ) -> Optional[str]:
         """This node's on-disk store directory for one shard copy (the

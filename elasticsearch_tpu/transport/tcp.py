@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import queue
+import random as _random
 import socket
 import struct
 import threading
@@ -34,11 +35,36 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from elasticsearch_tpu.transport.scheduler import Cancellable, Scheduler
 from elasticsearch_tpu.transport.transport import (
-    Deferred, NodeNotConnectedError, RemoteTransportError,
+    Deferred, DisruptionRules, NodeNotConnectedError, RemoteTransportError,
+    _Rule,
 )
 from elasticsearch_tpu.utils.errors import ReceiveTimeoutError
 
-__all__ = ["TcpTransport", "TcpTransportService"]
+__all__ = ["TcpDisruption", "TcpTransport", "TcpTransportService"]
+
+
+class TcpDisruption(DisruptionRules):
+    """Chaos rules for the REAL wire — drop / one-way partition /
+    disconnect / jittered latency with the exact rule book the in-memory
+    transport uses (transport.py ``DisruptionRules``), so every chaos
+    scenario written against the in-memory wire means the same thing
+    over real sockets.
+
+    One instance is shared by every TcpTransport in the disrupted cluster
+    (the test harness's network); rules are keyed by (sender, receiver)
+    node ids with '*' wildcards, checked at the service layer where both
+    endpoints' identities are known — requests on send, responses on
+    reply. Thread-safe enough: rule mutation races only ever see a rule
+    or no rule, never a torn one."""
+
+    def __init__(self, rng: Optional[_random.Random] = None):
+        super().__init__()
+        self.random = rng or _random.Random(0)
+
+    def latency(self, rule: _Rule) -> float:
+        extra = self.random.uniform(0.0, rule.jitter) \
+            if rule.jitter > 0.0 else 0.0
+        return rule.delay + extra
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 256 * 1024 * 1024
@@ -208,6 +234,9 @@ class TcpTransport:
         # thread; reply_conn (when not None) is the socket the request
         # arrived on — the reply channel
         self.on_message: Optional[Callable] = None
+        # chaos seam (TcpDisruption): when set, the service layer checks
+        # drop/disconnect/latency rules before frames touch a socket
+        self.disruption: Optional[TcpDisruption] = None
         # replies over inbound sockets drain through ONE writer queue PER
         # connection (created lazily): a stalled peer wedges only its own
         # channel, never the dispatch thread or other peers' replies
@@ -521,12 +550,33 @@ class TcpTransportService:
                      "body": payload}, local_finish=finish))
             return
 
-        self.transport.send(
-            node_id,
-            {"t": "req", "id": req_id, "action": action,
-             "sender": self.node_id, "body": request},
-            on_fail=lambda: finish(None, NodeNotConnectedError(
-                f"node [{node_id}] is not connected")))
+        def do_send() -> None:
+            self.transport.send(
+                node_id,
+                {"t": "req", "id": req_id, "action": action,
+                 "sender": self.node_id, "body": request},
+                on_fail=lambda: finish(None, NodeNotConnectedError(
+                    f"node [{node_id}] is not connected")))
+
+        # chaos rules (TcpDisruption parity with the in-memory wire):
+        # drop = blackhole (only the timeout resolves); disconnect =
+        # refused fast; delay/jitter = scheduled late send
+        disruption = self.transport.disruption
+        rule = disruption.rule(self.node_id, node_id) \
+            if disruption is not None else None
+        if rule is not None:
+            if rule.drop:
+                return
+            if rule.disconnect:
+                self.transport.scheduler.submit(
+                    lambda: finish(None, NodeNotConnectedError(
+                        f"node [{node_id}] is not connected")))
+                return
+            if rule.delay or rule.jitter:
+                self.transport.scheduler.schedule(
+                    disruption.latency(rule), do_send)
+                return
+        do_send()
 
     # -- receiving -----------------------------------------------------------
 
@@ -557,12 +607,29 @@ class TcpTransportService:
             # TcpTransportChannel): the ONLY route to cross-cluster
             # callers outside this cluster's address book, and a saved
             # reverse connection otherwise. Fallback: address-book send.
-            if reply_conn is not None:
-                self.transport.reply_via(
-                    reply_conn, payload,
-                    on_fail=lambda: self.transport.send(sender, payload))
-            else:
-                self.transport.send(sender, payload)
+            def deliver() -> None:
+                if reply_conn is not None:
+                    self.transport.reply_via(
+                        reply_conn, payload,
+                        on_fail=lambda: self.transport.send(sender,
+                                                            payload))
+                else:
+                    self.transport.send(sender, payload)
+
+            # the response direction has its OWN rule lookup, so a
+            # one-way partition severs exactly one direction — same
+            # semantics as InMemoryTransport.deliver
+            disruption = self.transport.disruption
+            rule = disruption.rule(self.node_id, sender) \
+                if disruption is not None else None
+            if rule is not None:
+                if rule.drop or rule.disconnect:
+                    return   # response lost: requester's timeout resolves
+                if rule.delay or rule.jitter:
+                    self.transport.scheduler.schedule(
+                        disruption.latency(rule), deliver)
+                    return
+            deliver()
 
         def reply_ok(body: Optional[Dict[str, Any]]) -> None:
             if local_finish is not None:

@@ -110,38 +110,14 @@ class _Rule:
     jitter: float = 0.0
 
 
-class InMemoryTransport:
-    """Delivers messages between TransportServices through the scheduler.
+class DisruptionRules:
+    """Directed-link disruption rule book, shared by every transport
+    flavor (in-memory AND TCP): one rule shape, one wildcard-lookup
+    semantic, so a chaos scenario means the same thing on either wire.
+    Subclasses/owners decide how a matched rule is APPLIED."""
 
-    One instance per simulated network. Per-link latency plus disruption
-    rules; every delivery is a scheduled task, so under the deterministic
-    scheduler the full cluster interleaving is seed-reproducible (jittered
-    latency draws from the scheduler's seeded RNG when it has one).
-    """
-
-    def __init__(self, scheduler: Scheduler, default_latency: float = 0.001):
-        self.scheduler = scheduler
-        self.default_latency = default_latency
-        self._nodes: Dict[str, "TransportService"] = {}
+    def __init__(self) -> None:
         self._rules: Dict[Tuple[str, str], _Rule] = {}
-        # crashed nodes: detached but remembered, so restore() can bring
-        # the same service back (a process crash/restart with state kept)
-        self._crashed: Dict[str, "TransportService"] = {}
-        self.random = getattr(scheduler, "random", None) or _random
-
-    # -- membership ----------------------------------------------------------
-
-    def attach(self, service: "TransportService") -> None:
-        self._nodes[service.node_id] = service
-        self._crashed.pop(service.node_id, None)
-
-    def detach(self, node_id: str) -> None:
-        self._nodes.pop(node_id, None)
-
-    def connected(self, node_id: str) -> bool:
-        return node_id in self._nodes
-
-    # -- disruption (NetworkDisruption / MockTransportService analogs) -------
 
     def add_rule(self, sender: str, receiver: str,
                  drop: bool = False, delay: float = 0.0,
@@ -151,6 +127,9 @@ class InMemoryTransport:
 
     def clear_rules(self) -> None:
         self._rules.clear()
+
+    def heal(self) -> None:
+        self.clear_rules()
 
     def partition(self, side_a, side_b, style: str = "blackhole") -> None:
         """Two-way partition between node-id groups. style='blackhole'
@@ -170,8 +149,44 @@ class InMemoryTransport:
                 self.add_rule(a, b, drop=not disconnect,
                               disconnect=disconnect)
 
-    def heal(self) -> None:
-        self.clear_rules()
+    def rule(self, sender: str, receiver: str) -> Optional[_Rule]:
+        for key in ((sender, receiver), (sender, "*"), ("*", receiver)):
+            rule = self._rules.get(key)
+            if rule is not None:
+                return rule
+        return None
+
+
+class InMemoryTransport(DisruptionRules):
+    """Delivers messages between TransportServices through the scheduler.
+
+    One instance per simulated network. Per-link latency plus disruption
+    rules; every delivery is a scheduled task, so under the deterministic
+    scheduler the full cluster interleaving is seed-reproducible (jittered
+    latency draws from the scheduler's seeded RNG when it has one).
+    """
+
+    def __init__(self, scheduler: Scheduler, default_latency: float = 0.001):
+        super().__init__()
+        self.scheduler = scheduler
+        self.default_latency = default_latency
+        self._nodes: Dict[str, "TransportService"] = {}
+        # crashed nodes: detached but remembered, so restore() can bring
+        # the same service back (a process crash/restart with state kept)
+        self._crashed: Dict[str, "TransportService"] = {}
+        self.random = getattr(scheduler, "random", None) or _random
+
+    # -- membership ----------------------------------------------------------
+
+    def attach(self, service: "TransportService") -> None:
+        self._nodes[service.node_id] = service
+        self._crashed.pop(service.node_id, None)
+
+    def detach(self, node_id: str) -> None:
+        self._nodes.pop(node_id, None)
+
+    def connected(self, node_id: str) -> bool:
+        return node_id in self._nodes
 
     # -- node crash / restart ------------------------------------------------
 
@@ -190,10 +205,7 @@ class InMemoryTransport:
             self._nodes[node_id] = service
 
     def _rule(self, sender: str, receiver: str) -> Optional[_Rule]:
-        for key in ((sender, receiver), (sender, "*"), ("*", receiver)):
-            if key in self._rules:
-                return self._rules[key]
-        return None
+        return self.rule(sender, receiver)
 
     # -- delivery ------------------------------------------------------------
 

@@ -414,10 +414,12 @@ def test_eio_on_commit_fails_primary_over_to_replica(tmp_path):
 
 def test_at_rest_bitflip_marks_store_red_with_reason(tmp_path):
     """Single-copy index, at-rest segment bit-flip, process reboot: store
-    recovery fails with ShardCorruptedError, the marker keeps every retry
-    from reopening the store, the shard ends RED with the corruption
-    reason surfaced through routing (allocation explain), and the
-    corrupted copy is NEVER served."""
+    recovery fails with ShardCorruptedError and writes the marker; the
+    gateway allocator's next fetch sees the marker and REFUSES to select
+    the copy (no futile retry storm — the pre-gateway behavior burned the
+    whole MaxRetry budget re-opening a known-bad store). The shard ends
+    RED with the corruption reason surfaced through routing (allocation
+    explain), and the corrupted copy is NEVER served."""
     c = InProcessCluster(n_nodes=1, seed=47,
                          data_path=str(tmp_path / "data"))
     c.start()
@@ -445,8 +447,11 @@ def test_at_rest_bitflip_marks_store_red_with_reason(tmp_path):
             if not state.routing_table.has_index("ar"):
                 return False
             sr = state.routing_table.index("ar").primary(0)
-            return (not sr.assigned and sr.failed_attempts >= 5 and
-                    sr.unassigned_reason is not None)
+            # one real attempt writes the marker; the gateway fetch then
+            # refuses the copy outright (reason mentions the marker)
+            return (not sr.assigned and sr.failed_attempts >= 1 and
+                    sr.unassigned_reason is not None and
+                    "corrupt" in sr.unassigned_reason.lower())
         c.run_until(exhausted, 600.0)
 
         sr = _primary_routing(c, "ar")
@@ -475,9 +480,15 @@ def test_at_rest_bitflip_marks_store_red_with_reason(tmp_path):
         status, body = out[0]
         assert status == 200
         info = body["unassigned_info"]
-        assert info["failed_allocation_attempts"] >= 5
+        assert info["failed_allocation_attempts"] >= 1
         assert "corrupt" in info["reason"].lower() or \
             "checksum" in info["reason"].lower()
+        # the gateway fetch evidence rides along: node0's copy is
+        # reported present-but-corruption-marked
+        fetch = body.get("gateway_fetch")
+        assert fetch is not None
+        node_info = fetch["nodes"]["node0"]
+        assert node_info["has_data"] and node_info["corrupted"]
     finally:
         c.stop()
 

@@ -1,0 +1,188 @@
+"""Chaos over the REAL wire: disruption rules on the TCP transport.
+
+The in-memory transport has carried every chaos scenario so far; this
+suite proves the SAME rule semantics (drop / one-way partition /
+disconnect / jittered latency) hold over actual sockets between
+TcpTransportService nodes — closing the ROADMAP open item ("only the
+in-memory wire has rules today"). One existing failover scenario (the
+one-sided-partition partial-results case of test_chaos_search) runs here
+end to end over TCP: a coordinator partitioned from a shard owner
+returns 200 with the lost shards in _shards.failures, and heal()
+restores the full hit set.
+
+Wall-clock, not virtual time: three Node objects in one process share a
+ThreadedScheduler but talk ONLY through real framed-JSON sockets on
+127.0.0.1.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.cluster.coordination import Mode
+from elasticsearch_tpu.node.node import Node
+from elasticsearch_tpu.transport.scheduler import ThreadedScheduler
+from elasticsearch_tpu.transport.tcp import (
+    TcpDisruption, TcpTransport, TcpTransportService,
+)
+from elasticsearch_tpu.utils.murmur3 import shard_id_for
+
+
+def _call(fn, timeout=60.0):
+    done = threading.Event()
+    box = []
+
+    def cb(resp, err=None):
+        box.append((resp, err))
+        done.set()
+    fn(cb)
+    assert done.wait(timeout), "callback not invoked in time"
+    return box[0]
+
+
+def _ok(t):
+    resp, err = t
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _wait(predicate, timeout, desc):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return
+        except Exception as e:  # noqa: BLE001 — keep polling
+            last = e
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {desc}: {last}")
+
+
+@pytest.fixture()
+def tcp_cluster(tmp_path):
+    scheduler = ThreadedScheduler()
+    disruption = TcpDisruption()
+    ids = ["node0", "node1", "node2"]
+    transports = {}
+    for nid in ids:
+        t = TcpTransport(scheduler, nid, ("127.0.0.1", 0), {})
+        t.disruption = disruption
+        t.start()
+        transports[nid] = t
+    book = {nid: t.bind_address for nid, t in transports.items()}
+    for t in transports.values():
+        t.address_book.update(book)
+    nodes = {}
+    for nid in ids:
+        nodes[nid] = Node(
+            nid, None, scheduler, seed_peers=ids,
+            data_path=str(tmp_path / nid),
+            initial_state=ClusterState(voting_config=frozenset(ids)),
+            transport_service=TcpTransportService(nid, transports[nid]))
+    for node in nodes.values():
+        node.start()
+    try:
+        yield nodes, disruption
+    finally:
+        disruption.heal()
+        for node in nodes.values():
+            try:
+                node.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        scheduler.close()
+
+
+def _master(nodes):
+    leaders = [n for n in nodes.values()
+               if n.coordinator.mode == Mode.LEADER]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+def test_failover_scenario_over_real_sockets(tcp_cluster):
+    nodes, disruption = tcp_cluster
+
+    _wait(lambda: _master(nodes) is not None and
+          len(_master(nodes).coordinator.applied_state.nodes) == 3,
+          90, "3-node TCP cluster formation")
+
+    client = nodes["node0"].client
+    _ok(_call(lambda cb: client.create_index("logs", {
+        "settings": {"number_of_shards": 3,
+                     "number_of_replicas": 0}}, cb)))
+    _wait(lambda: client.cluster_health("logs")["status"] == "green",
+          60, "index green")
+    for i in range(12):
+        _ok(_call(lambda cb, i=i: client.index_doc(
+            "logs", f"d{i}", {"title": f"hello world {i}", "n": i}, cb)))
+    _ok(_call(lambda cb: client.refresh("logs", cb)))
+
+    # victim: a NON-master shard owner; coordinator: the other non-master
+    # node — master links stay untouched so membership is stable
+    master_id = _master(nodes).node_id
+    state = _master(nodes).coordinator.applied_state
+    irt = state.routing_table.index("logs")
+    owners = {sid: irt.primary(sid).node_id for sid in irt.shards}
+    non_master = [nid for nid in nodes if nid != master_id]
+    victims = [nid for nid in non_master if nid in owners.values()]
+    assert victims, "allocator placed no shard off-master"
+    victim = victims[0]
+    coord = next(nid for nid in non_master if nid != victim)
+    lost = sorted(sid for sid, nid in owners.items() if nid == victim)
+    lost_docs = sum(1 for i in range(12)
+                    if shard_id_for(f"d{i}", 3) in lost)
+    assert lost_docs > 0
+
+    query = {"query": {"match": {"title": "hello"}}, "size": 30,
+             "track_total_hits": True}
+
+    # disconnect-style partition coord -> victim: requests refuse fast,
+    # the search degrades to partial results over real sockets
+    disruption.partition_one_way([coord], [victim], style="disconnect")
+    resp = _ok(_call(lambda cb: nodes[coord].client.search(
+        "logs", query, cb)))
+    shards = resp["_shards"]
+    assert shards["failed"] == len(lost)
+    assert sorted(f["shard"] for f in shards["failures"]) == lost
+    assert resp["hits"]["total"]["value"] == 12 - lost_docs
+
+    # blackhole drop parity: a dropped request leaves only the sender's
+    # timeout to resolve the callback (exactly the in-memory semantics).
+    # The partition is ONE-WAY: victim -> coord frames still DELIVER
+    # (coord's handler runs), but coord's response frame back to the
+    # victim dies — the classic split request/response path
+    disruption.heal()
+    disruption.partition_one_way([coord], [victim], style="blackhole")
+    from elasticsearch_tpu.action.admin import NODE_STATS_ACTION
+    from elasticsearch_tpu.utils.errors import ReceiveTimeoutError
+    resp, err = _call(lambda cb: nodes[coord].transport_service
+                      .send_request(victim, NODE_STATS_ACTION, {}, cb,
+                                    timeout=1.5))
+    assert isinstance(err, ReceiveTimeoutError)
+    received_before = nodes[coord].transport_service.stats["received"]
+    resp, err = _call(lambda cb: nodes[victim].transport_service
+                      .send_request(coord, NODE_STATS_ACTION, {}, cb,
+                                    timeout=1.5))
+    assert isinstance(err, ReceiveTimeoutError)   # reply was severed
+    assert nodes[coord].transport_service.stats["received"] > \
+        received_before                           # request was NOT
+
+    # jittered latency: slow link, complete and correct results
+    disruption.heal()
+    disruption.add_rule(coord, victim, delay=0.05, jitter=0.05)
+    resp = _ok(_call(lambda cb: nodes[coord].client.search(
+        "logs", query, cb)))
+    assert resp["_shards"]["failed"] == 0
+    assert resp["hits"]["total"]["value"] == 12
+
+    # heal: full results, no residue
+    disruption.heal()
+    resp = _ok(_call(lambda cb: nodes[coord].client.search(
+        "logs", query, cb)))
+    assert resp["_shards"]["failed"] == 0
+    assert resp["hits"]["total"]["value"] == 12
+    assert {h["_id"] for h in resp["hits"]["hits"]} == \
+        {f"d{i}" for i in range(12)}

@@ -1,0 +1,451 @@
+"""Gateway allocation suite: shard-state fetch + freshest-copy placement.
+
+The scenarios the GatewayAllocator exists for: rebooting EITHER node of a
+2-node replicas=0 cluster must bring every shard back from its own disk
+(the pre-gateway allocator could route a STARTED shard to a process that
+never re-created it — searches 404ed under green health); a full-cluster
+restart must recover every fresh local copy in place (no avoidable
+empty-store/peer copies); and a corruption-marked copy must never be
+selected as a primary when a clean copy exists.
+
+Reference analogs: gateway/GatewayAllocator.java, AsyncShardFetch.java,
+Primary/ReplicaShardAllocator.java and the reference's
+FullRollingRestartIT / RecoveryFromGatewayIT suites.
+"""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.index.store import Store
+from elasticsearch_tpu.testing import InProcessCluster
+from elasticsearch_tpu.utils.murmur3 import shard_id_for
+
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _routing(cluster, index):
+    return cluster.master().coordinator.applied_state.routing_table.index(
+        index)
+
+
+def _primary_node(cluster, index, shard=0):
+    return _routing(cluster, index).primary(shard).node_id
+
+
+# ---------------------------------------------------------------------------
+# unit level: on-disk shard state listing + routing reset identity
+# ---------------------------------------------------------------------------
+
+def test_store_local_shard_state_reports_identity_and_freshness(tmp_path):
+    store = Store(tmp_path / "s")
+    assert store.local_shard_state()["has_data"] is False
+
+    store.write_commit(3, ["seg1"], max_seqno=9, local_checkpoint=9,
+                       translog_generation=1,
+                       extra={"allocation_id": "aid-1", "primary_term": 4})
+    info = store.local_shard_state()
+    assert info["has_data"] and info["verified"]
+    assert info["allocation_id"] == "aid-1"
+    assert info["primary_term"] == 4
+    assert info["generation"] == 3
+    assert info["max_seqno"] == 9 and info["local_checkpoint"] == 9
+    assert info["corrupted"] is None
+
+    # a corruption marker is reported without opening anything
+    store.mark_corrupted("injected")
+    info = store.local_shard_state()
+    assert info["has_data"] and "injected" in info["corrupted"]
+
+    # a rotted commit point reads as present-but-corrupted, never empty
+    store2 = Store(tmp_path / "s2")
+    store2.write_commit(1, [], max_seqno=0, local_checkpoint=0,
+                        translog_generation=1)
+    commit = next(store2.path.glob("commit-*.json"))
+    data = bytearray(commit.read_bytes())
+    data[5] ^= 0x10
+    commit.write_bytes(bytes(data))
+    info = store2.local_shard_state()
+    assert info["has_data"] and info["corrupted"]
+
+
+def test_reset_routing_threads_identity_and_preserves_overrides():
+    from elasticsearch_tpu.cluster.metadata import IndexMetadata
+    from elasticsearch_tpu.cluster.routing import (
+        IndexRoutingTable, RoutingTable, ShardState,
+    )
+    from elasticsearch_tpu.cluster.state import ClusterState
+    from elasticsearch_tpu.gateway import _reset_routing
+
+    meta = IndexMetadata.create(
+        "idx", number_of_shards=2, number_of_replicas=2,
+        settings={"index.refresh_interval": "7s"})
+    irt = IndexRoutingTable.new("idx", 2, 2)
+    # assign + start every copy so each slot has a live allocation id
+    nodes = ["n0", "n1", "n2"]
+    for sid in (0, 1):
+        for i, sr in enumerate(irt.shard_group(sid)):
+            irt = irt.replace_shard(sr, sr.initialize(nodes[i]).start())
+    state = ClusterState(
+        metadata=__import__(
+            "elasticsearch_tpu.cluster.metadata",
+            fromlist=["Metadata"]).Metadata().put_index(meta),
+        routing_table=RoutingTable(indices={"idx": irt}))
+    prior_ids = {(sr.shard_id, sr.primary): sr.allocation_id
+                 for sr in irt.all_shards()}
+
+    reset = _reset_routing(state)
+    fresh = reset.routing_table.index("idx")
+    for sid in (0, 1):
+        group = fresh.shard_group(sid)
+        # replica override preserved verbatim: 1 primary + 2 replicas
+        assert len(group) == 3
+        assert [sr.primary for sr in group] == [True, False, False]
+        for sr in group:
+            assert sr.state == ShardState.UNASSIGNED
+            assert sr.last_allocation_id is not None
+        assert group[0].last_allocation_id == prior_ids[(sid, True)]
+    # settings metadata untouched, state identity re-keyed
+    assert reset.metadata.index("idx").settings[
+        "index.refresh_interval"] == "7s"
+    assert reset.metadata.index("idx").number_of_replicas == 2
+    assert reset.state_uuid != state.state_uuid
+
+
+def test_cancel_replaceable_recovery_moves_to_rejoined_copy_holder():
+    """ReplicaShardAllocator cancel pass: an INITIALIZING empty-store
+    replica yields when the fetch shows another node holds the copy's
+    actual data (matching allocation id, no marker)."""
+    from elasticsearch_tpu.cluster.allocation import AllocationService
+    from elasticsearch_tpu.cluster.metadata import IndexMetadata, Metadata
+    from elasticsearch_tpu.cluster.routing import (
+        IndexRoutingTable, RoutingTable, ShardState,
+    )
+    from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode
+    from elasticsearch_tpu.gateway import GatewayAllocator
+    from elasticsearch_tpu.indices.indices_service import IndicesService
+    from elasticsearch_tpu.transport.scheduler import DeterministicScheduler
+    from elasticsearch_tpu.transport.transport import (
+        InMemoryTransport, TransportService,
+    )
+
+    scheduler = DeterministicScheduler(seed=7)
+    transport = InMemoryTransport(scheduler)
+    ts = TransportService("master", transport)
+    ga = GatewayAllocator("master", ts, IndicesService(), ClusterState)
+    allocation = AllocationService()
+    allocation.gateway_allocator = ga
+
+    meta = IndexMetadata.create("i", number_of_shards=1,
+                                number_of_replicas=1)
+    irt = IndexRoutingTable.new("i", 1, 1)
+    primary, replica = irt.shard_group(0)
+    started_primary = primary.initialize("nodeA").start()
+    irt = irt.replace_shard(primary, started_primary)
+    # the replica's real data lived on nodeC (allocation id old-copy);
+    # balance sent the rebuild to empty nodeB while nodeC was away
+    from dataclasses import replace
+    noted = replace(replica, last_allocation_id="old-copy")
+    irt = irt.replace_shard(replica, noted.initialize("nodeB"))
+    initializing = next(sr for sr in irt.shard_group(0) if not sr.primary)
+    state = ClusterState(
+        nodes={n: DiscoveryNode(node_id=n) for n in
+               ("nodeA", "nodeB", "nodeC")},
+        metadata=Metadata().put_index(meta),
+        routing_table=RoutingTable(indices={"i": irt}))
+
+    ga._cache[("i", 0)] = {
+        "nodeA": {"node": "nodeA", "live": True, "has_data": True,
+                  "allocation_id": started_primary.allocation_id,
+                  "max_seqno": 10, "corrupted": None},
+        "nodeB": {"node": "nodeB", "live": False, "has_data": False,
+                  "allocation_id": None, "corrupted": None},
+        "nodeC": {"node": "nodeC", "live": False, "has_data": True,
+                  "allocation_id": "old-copy", "max_seqno": 10,
+                  "generation": 4, "corrupted": None},
+    }
+
+    out = allocation.reroute(state)
+    group = out.routing_table.index("i").shard_group(0)
+    new_replica = next(sr for sr in group if not sr.primary)
+    assert new_replica.state == ShardState.INITIALIZING
+    assert new_replica.node_id == "nodeC"
+    assert ga.stats["recoveries_cancelled"] == 1
+    # the cancel did not consume the MaxRetry budget
+    assert new_replica.failed_attempts == initializing.failed_attempts
+
+
+def test_replica_reuse_refused_for_stale_term_commit(tmp_path):
+    """The recovery source's reuse gate must refuse a commit written
+    under an OLDER primary term even when every seqno watermark matches:
+    across a failover the same seqno can name different operations, so
+    only a current-term commit provably shares this primary's history."""
+    from elasticsearch_tpu.cluster.metadata import IndexMetadata
+    from elasticsearch_tpu.indices.cluster_state_service import (
+        IndicesClusterStateService,
+    )
+    from elasticsearch_tpu.indices.indices_service import IndicesService
+    from elasticsearch_tpu.transport.scheduler import DeterministicScheduler
+    from elasticsearch_tpu.transport.transport import (
+        InMemoryTransport, TransportService,
+    )
+
+    svc = IndicesService(data_path=str(tmp_path))
+    isvc = svc.create_index(IndexMetadata.create(
+        "i", number_of_shards=1, number_of_replicas=1))
+    shard = isvc.create_shard(0, primary=True, primary_term=2)
+    for i in range(3):
+        shard.apply_index_on_primary(f"d{i}", {"n": i})
+    recon = IndicesClusterStateService(
+        "n", svc, TransportService(
+            "n", InMemoryTransport(DeterministicScheduler(seed=1))))
+
+    stale = {"index": "i", "shard": 0, "allocation_id": "r1",
+             "local_commit": {"max_seqno": shard.max_seqno,
+                              "local_checkpoint": shard.max_seqno,
+                              "primary_term": 1}}
+    resp = recon._on_recovery_start(stale, "peer1")
+    assert resp["reuse"] is False and len(resp["ops"]) == 3
+
+    current = {"index": "i", "shard": 0, "allocation_id": "r2",
+               "local_commit": {"max_seqno": shard.max_seqno,
+                                "local_checkpoint": shard.max_seqno,
+                                "primary_term": 2}}
+    resp = recon._on_recovery_start(current, "peer2")
+    assert resp["reuse"] is True and resp["ops"] == []
+
+
+# ---------------------------------------------------------------------------
+# cluster level: the 2-node replicas=0 reboot data-loss bug
+# ---------------------------------------------------------------------------
+
+def _two_node_reboot_scenario(tmp_path, seed, victim):
+    """Reboot one node of a 2-node replicas=0 cluster: the cluster must
+    return to green only once every shard is actually re-hosted, and a
+    search must return the full pre-reboot hit set with zero wrong
+    results — regardless of which node reboots or who wins the
+    post-reboot election."""
+    c = InProcessCluster(n_nodes=2, seed=seed,
+                         data_path=str(tmp_path / f"d{seed}-{victim}"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("tn", {
+            "settings": {"number_of_shards": 2,
+                         "number_of_replicas": 0}}, cb)))
+        c.ensure_green("tn")
+        for i in range(14):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "tn", f"d{i}", {"title": f"reboot doc {i}", "n": i}, cb)))
+        _ok(*c.call(lambda cb: client.flush("tn", cb)))
+
+        c.reboot_node(victim)
+        # drive until the cluster has actually OBSERVED the reboot: the
+        # victim's fresh process (new ephemeral id) is a committed member
+        # again — heartbeat reboot detection or the join path, whichever
+        # fires first (zero virtual time passes during reboot_node itself)
+        new_eph = c.nodes[victim].discovery_node.ephemeral_id
+
+        def rejoined():
+            master = c.master()
+            if master is None:
+                return False
+            dn = master.coordinator.applied_state.nodes.get(victim)
+            return dn is not None and dn.ephemeral_id == new_eph
+        c.run_until(rejoined, 600.0)
+        c.ensure_green("tn", max_time=900.0)
+
+        # green means HOSTED: every routed copy exists as a live local
+        # shard on its node — no STARTED-routed ghost
+        for sr in _routing(c, "tn").all_shards():
+            assert sr.active, sr
+            assert c.nodes[sr.node_id].indices_service.has_shard(
+                "tn", sr.shard_id), f"{sr} not hosted"
+
+        c.call(lambda cb: c.client().refresh("tn", cb))
+        resp, err = c.call(lambda cb: c.client().search(
+            "tn", {"query": {"match": {"title": "reboot"}}, "size": 30,
+                   "track_total_hits": True}, cb), max_time=600.0)
+        _ok(resp, err)
+        assert resp["_shards"]["failed"] == 0
+        assert resp["hits"]["total"]["value"] == 14
+        ids = {h["_id"] for h in resp["hits"]["hits"]}
+        assert ids == {f"d{i}" for i in range(14)}   # zero wrong results
+    finally:
+        c.stop()
+
+
+@pytest.mark.parametrize("victim", ["node0", "node1"])
+@pytest.mark.parametrize("seed",
+                         [73 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_two_node_replicas0_reboot_recovers_either_victim(
+        tmp_path, seed, victim):
+    _two_node_reboot_scenario(tmp_path, seed, victim)
+
+
+@pytest.mark.slow
+def test_two_node_reboot_seed_sweep(tmp_path):
+    """CI sweep: both victims under >=5 seeded RNGs (CHAOS_SEEDS widens)."""
+    for k in range(max(CHAOS_SEEDS, 5)):
+        for victim in ("node0", "node1"):
+            _two_node_reboot_scenario(tmp_path, 311 + 97 * k, victim)
+
+
+# ---------------------------------------------------------------------------
+# cluster level: full-cluster restart recovers in place
+# ---------------------------------------------------------------------------
+
+def test_full_cluster_restart_recovers_in_place_no_wipe(tmp_path):
+    """3-node replicas=1 full restart: every copy with a fresh local
+    commit recovers from its own disk — primaries via store recovery,
+    replicas via the reuse handshake (no empty-store build, no peer
+    wipe-and-copy), with doc counts intact."""
+    c = InProcessCluster(n_nodes=3, seed=79,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("fr", {
+            "settings": {"number_of_shards": 2,
+                         "number_of_replicas": 1}}, cb)))
+        c.ensure_green("fr")
+        for i in range(16):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "fr", f"d{i}", {"n": i}, cb)))
+        # flush EVERY copy so each holds a hole-free commit at max_seqno
+        _ok(*c.call(lambda cb: client.flush("fr", cb)))
+        before = {
+            (sr.index, sr.shard_id, sr.primary): sr.node_id
+            for sr in _routing(c, "fr").all_shards()}
+
+        c.full_restart()
+        c.ensure_green("fr", max_time=900.0)
+
+        kinds = []
+        for node in c.nodes.values():
+            for shard in node.indices_service.all_shards():
+                kinds.append((node.node_id, shard.shard_id.shard,
+                              shard.recovery_kind))
+        assert len(kinds) == 4   # 2 shards x (primary + replica)
+        # zero avoidable copies: no empty_store, no wipe-and-copy peer
+        assert all(k in ("existing_store", "peer_reuse")
+                   for (_n, _s, k) in kinds), kinds
+        assert sum(1 for (_n, _s, k) in kinds
+                   if k == "existing_store") == 2
+        assert sum(1 for (_n, _s, k) in kinds if k == "peer_reuse") == 2
+
+        # every copy went back to the node that already held its data
+        after = {
+            (sr.index, sr.shard_id, sr.primary): sr.node_id
+            for sr in _routing(c, "fr").all_shards()}
+        assert after == before
+
+        # doc counts intact on every copy
+        for sr in _routing(c, "fr").all_shards():
+            shard = c.nodes[sr.node_id].indices_service.shard(
+                "fr", sr.shard_id)
+            expected = sum(1 for i in range(16)
+                           if shard_id_for(f"d{i}", 2) == sr.shard_id)
+            assert shard.engine.doc_count == expected
+
+        c.call(lambda cb: c.client().refresh("fr", cb))
+        resp, err = c.call(lambda cb: c.client().search(
+            "fr", {"query": {"match_all": {}}, "size": 20,
+                   "track_total_hits": True}, cb), max_time=600.0)
+        _ok(resp, err)
+        assert resp["hits"]["total"]["value"] == 16
+        assert resp["_shards"]["failed"] == 0
+
+        # the allocation decisions are observable: gateway fetch counters
+        # ride _nodes/stats on the elected master
+        stats = c.master().local_node_stats()["gateway"]
+        assert stats["fetches_issued"] > 0
+        assert stats["responses_received"] > 0
+        assert stats["cache_hits"] > 0
+    finally:
+        c.stop()
+
+
+def test_corruption_marked_copy_never_selected_as_primary(tmp_path):
+    """2-node replicas=1, one copy corruption-marked, full restart: the
+    primary allocator must select the CLEAN copy's node; the marked copy
+    is rebuilt from the clean primary, and every original doc survives."""
+    c = InProcessCluster(n_nodes=2, seed=83,
+                         data_path=str(tmp_path / "data"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("cc", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 1}}, cb)))
+        c.ensure_green("cc")
+        for i in range(8):
+            _ok(*c.call(lambda cb, i=i: client.index_doc(
+                "cc", f"d{i}", {"n": i}, cb)))
+        _ok(*c.call(lambda cb: client.flush("cc", cb)))
+
+        old_primary_node = _primary_node(c, "cc")
+        store_dir = os.path.join(
+            c.shard_store_path(old_primary_node, "cc", 0), "index")
+        clean_node = next(n for n in c.nodes if n != old_primary_node)
+        Store(store_dir).mark_corrupted("injected at-rest damage")
+
+        c.full_restart()
+        c.ensure_green("cc", max_time=900.0)
+
+        # the marked copy was never selected: the clean node is primary
+        assert _primary_node(c, "cc") == clean_node
+        master = c.master()
+        assert master.gateway_allocator.stats["reported_corrupted"] >= 1 \
+            or master.local_node_stats()["gateway"][
+                "reported_corrupted"] >= 1
+
+        c.call(lambda cb: c.client().refresh("cc", cb))
+        resp, err = c.call(lambda cb: c.client().search(
+            "cc", {"query": {"match_all": {}}, "size": 20,
+                   "track_total_hits": True}, cb), max_time=600.0)
+        _ok(resp, err)
+        assert resp["hits"]["total"]["value"] == 8
+        assert {h["_id"] for h in resp["hits"]["hits"]} == \
+            {f"d{i}" for i in range(8)}
+    finally:
+        c.stop()
+
+
+@pytest.mark.slow
+def test_full_restart_seed_sweep(tmp_path):
+    """CI sweep: full-restart in-place recovery under >=5 seeds."""
+    for k in range(max(CHAOS_SEEDS, 5)):
+        seed = 419 + 97 * k
+        c = InProcessCluster(n_nodes=3, seed=seed,
+                             data_path=str(tmp_path / f"d{seed}"))
+        c.start()
+        try:
+            client = c.client()
+            _ok(*c.call(lambda cb: client.create_index("sw", {
+                "settings": {"number_of_shards": 2,
+                             "number_of_replicas": 1}}, cb)))
+            c.ensure_green("sw")
+            for i in range(10):
+                _ok(*c.call(lambda cb, i=i: client.index_doc(
+                    "sw", f"d{i}", {"n": i}, cb)))
+            _ok(*c.call(lambda cb: client.flush("sw", cb)))
+            c.full_restart()
+            c.ensure_green("sw", max_time=900.0)
+            kinds = [s.recovery_kind for node in c.nodes.values()
+                     for s in node.indices_service.all_shards()]
+            assert kinds and all(
+                k in ("existing_store", "peer_reuse") for k in kinds)
+            c.call(lambda cb: c.client().refresh("sw", cb))
+            resp, err = c.call(lambda cb: c.client().search(
+                "sw", {"query": {"match_all": {}},
+                       "track_total_hits": True}, cb), max_time=600.0)
+            _ok(resp, err)
+            assert resp["hits"]["total"]["value"] == 10
+        finally:
+            c.stop()
