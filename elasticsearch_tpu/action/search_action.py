@@ -102,6 +102,7 @@ DoneFn = Callable[[Optional[Dict[str, Any]], Optional[Exception]], None]
 
 import logging
 
+logger = logging.getLogger(__name__)
 _slowlog = logging.getLogger("index.search.slowlog")
 
 
@@ -439,6 +440,111 @@ class SearchTransportService:
                 hit["matched_queries"] = matched
 
 
+class RrfFusionBatcher:
+    """Coordinator-side hybrid-fusion coalescing: concurrent RRF
+    requests whose retriever legs complete in the same scheduler tick
+    fuse in ONE ``rrf_fuse_batch`` device program (ops/fusion.py) over
+    [B, R, K] ranked lists instead of B independent fusions.
+
+    Contract with the caller: ``submit`` hands over each retriever's
+    ranked list encoded into a request-local dense id space and a
+    ``done(candidate_ids)`` callback. The device program returns every
+    scored doc of every request (k covers the whole candidate pool, so
+    nothing is cut at a float32 boundary); the caller re-attaches its
+    exact host-precision scores to those candidates, which keeps the
+    response byte-identical to the host-only path. ``done(None)`` means
+    "fuse on the host yourself" (batching disabled, or a device
+    failure — fusion is an optimization, never a correctness gate)."""
+
+    def __init__(self, ts: TransportService, enabled_fn):
+        self.ts = ts
+        self.enabled = enabled_fn
+        self._queue: List[Dict[str, Any]] = []
+        self._timer = None
+        self.stats: Dict[str, float] = {
+            "rrf_fuse_batches": 0,
+            "rrf_fuse_requests": 0,
+            "rrf_fuse_max_occupancy": 0,
+            "rrf_fuse_fallbacks": 0,
+        }
+
+    def submit(self, doc_lists: List[List[int]], n_docs: int,
+               rank_constant: int, done) -> None:
+        try:
+            enabled = self.enabled()
+        except Exception:  # noqa: BLE001 — no committed state yet
+            enabled = True
+        if not enabled or n_docs <= 0:
+            done(None)
+            return
+        self._queue.append({"lists": doc_lists, "n_docs": n_docs,
+                            "rank_constant": rank_constant, "done": done})
+        if self._timer is None:
+            # same-tick completions coalesce; an isolated fusion pays
+            # one scheduler hop (the batcher's idle-drain discipline)
+            self._timer = self.ts.transport.scheduler.schedule(
+                0.0, self._drain)
+
+    def _drain(self) -> None:
+        self._timer = None
+        batch, self._queue = self._queue, []
+        if not batch:
+            return
+        by_rc: Dict[int, List[Dict[str, Any]]] = {}
+        for entry in batch:
+            by_rc.setdefault(int(entry["rank_constant"]), []).append(entry)
+        for rank_constant, entries in sorted(by_rc.items()):
+            self._fuse_group(rank_constant, entries)
+
+    def _fuse_group(self, rank_constant: int,
+                    entries: List[Dict[str, Any]]) -> None:
+        from elasticsearch_tpu.index.segment import next_pow2
+        try:
+            import jax.numpy as jnp
+
+            from elasticsearch_tpu.ops.fusion import rrf_fuse_batch
+            b = len(entries)
+            r = max(2, max(len(e["lists"]) for e in entries))
+            k_list = max([1] + [len(lst) for e in entries
+                                for lst in e["lists"]])
+            # pow2 pads on every varying axis so the jit cache stays warm
+            b_pad = next_pow2(b, minimum=1)
+            k_pad = next_pow2(k_list, minimum=8)
+            n_pad = next_pow2(max(e["n_docs"] for e in entries),
+                              minimum=8)
+            # k covers the whole candidate pool (<= r * k_pad list slots,
+            # clamped to the id space): every scored doc comes back, so
+            # device selection can never drop a host-boundary candidate
+            k_dev = min(n_pad, r * k_pad)
+            arr = np.full((b_pad, r, k_pad), -1, np.int32)
+            for bi, e in enumerate(entries):
+                for ri, lst in enumerate(e["lists"]):
+                    if lst:
+                        arr[bi, ri, : len(lst)] = lst
+            _scores, docs = rrf_fuse_batch(jnp.asarray(arr), n_pad,
+                                           k_dev, rank_constant)
+            docs = np.asarray(docs)
+            self.stats["rrf_fuse_batches"] += 1
+            self.stats["rrf_fuse_requests"] += b
+            self.stats["rrf_fuse_max_occupancy"] = max(
+                self.stats["rrf_fuse_max_occupancy"], b)
+            for bi, e in enumerate(entries):
+                row = [int(d) for d in docs[bi] if d >= 0]
+                try:
+                    e["done"](row)
+                except Exception:  # noqa: BLE001 — one request's
+                    # downstream failure must not strand its batch-mates
+                    logger.exception("rrf fusion completion failed")
+        except Exception:  # noqa: BLE001 — device fusion must never lose
+            # a response: every waiter falls back to host fusion
+            self.stats["rrf_fuse_fallbacks"] += len(entries)
+            for e in entries:
+                try:
+                    e["done"](None)
+                except Exception:  # noqa: BLE001
+                    logger.exception("rrf fusion fallback failed")
+
+
 class TransportSearchAction:
     """Coordinating-node side: resolve → (can_match) → (dfs) → query →
     merge → fetch → respond."""
@@ -469,6 +575,19 @@ class TransportSearchAction:
             ResponseCollectorService,
         )
         self.response_collector = ResponseCollectorService()
+        # hybrid RRF fusion batcher: concurrent requests' fusions
+        # coalesce into one rrf_fuse_batch device dispatch
+        self.rrf_fuser = RrfFusionBatcher(ts, self._batch_enabled)
+
+    def _batch_enabled(self) -> bool:
+        """Mirrors ShardQueryBatcher's read of search.batch.enabled from
+        committed cluster state (one toggle governs shard-level query
+        batching AND coordinator-level fusion batching)."""
+        from elasticsearch_tpu.utils.settings import (
+            SEARCH_BATCH_ENABLED, setting_from_state,
+        )
+        state = self.state() if self.state is not None else None
+        return setting_from_state(state, SEARCH_BATCH_ENABLED)
 
     def _default_allow_partial(self, state: ClusterState) -> bool:
         """Cluster-wide default (search.default_allow_partial_results,
@@ -1109,49 +1228,79 @@ class TransportSearchAction:
             if errors:
                 on_done(None, errors[0])
                 return
-            # reciprocal-rank fusion over (index, _id) identities
-            fused: Dict[Tuple[str, str], Dict[str, Any]] = {}
+            # encode (index, _id) identities into a request-local dense
+            # id space for the device fusion, keeping the exact host
+            # (float64) reciprocal-rank sums for the response scores
+            key_to_id: Dict[Tuple[str, str], int] = {}
+            first_hit: List[Dict[str, Any]] = []
+            scores64: List[float] = []
+            doc_lists: List[List[int]] = []
             for ranked in results:
                 hits = (ranked or {}).get("hits", {}).get("hits", [])
+                lst: List[int] = []
                 for rank, hit in enumerate(hits, start=1):
                     key = (hit.get("_index"), hit.get("_id"))
-                    entry = fused.setdefault(key, {"hit": hit,
-                                                   "score": 0.0})
-                    entry["score"] += 1.0 / (rank_constant + rank)
-            ordered = sorted(fused.values(),
-                             key=lambda e: (-e["score"],
-                                            str(e["hit"].get("_id"))))
-            out_hits = []
-            for rank, entry in enumerate(
-                    ordered[from_: from_ + size], start=from_ + 1):
-                hit = dict(entry["hit"])
-                hit["_score"] = round(entry["score"], 6)
-                hit["_rank"] = rank
-                out_hits.append(hit)
-            # shard accounting must reflect EVERY retriever's fan-out, or
-            # one retriever's partial failure hides behind another's
-            # clean run
-            shards = {"total": 0, "successful": 0, "skipped": 0,
-                      "failed": 0}
-            timed_out = False
-            for ranked in results:
-                sub = (ranked or {}).get("_shards") or {}
-                for f in shards:
-                    shards[f] += int(sub.get(f, 0))
-                timed_out = timed_out or bool(
-                    (ranked or {}).get("timed_out"))
-            on_done({
-                "took": int((time.monotonic() - t0) * 1000),
-                "timed_out": timed_out,
-                "_shards": shards,
-                # windows cap what fusion can observe: the unique-doc
-                # count is a LOWER bound on true matches
-                "hits": {"total": {"value": len(fused),
-                                   "relation": "gte"},
-                         "max_score": (out_hits[0]["_score"]
-                                       if out_hits else None),
-                         "hits": out_hits},
-            }, None)
+                    did = key_to_id.get(key)
+                    if did is None:
+                        did = len(first_hit)
+                        key_to_id[key] = did
+                        first_hit.append(hit)
+                        scores64.append(0.0)
+                    scores64[did] += 1.0 / (rank_constant + rank)
+                    lst.append(did)
+                doc_lists.append(lst)
+
+            def finalize(candidates: Optional[List[int]]) -> None:
+                # candidates: the device fusion's scored docs (covers the
+                # WHOLE candidate pool, so the set equals the host's),
+                # or None = fuse entirely on the host. Either way the
+                # output scores/order come from the f64 sums + the host
+                # comparator — byte-identical across both paths.
+                if candidates is None:
+                    candidates = range(len(first_hit))
+                # the dense id (first-seen order) is the FINAL tie-break:
+                # it reproduces the host sort's stable insertion-order
+                # behavior no matter which order the device returned the
+                # candidates in, so full ties (same score AND same _id
+                # across indices) order identically on both paths
+                ordered = sorted(
+                    ((scores64[did], did, first_hit[did])
+                     for did in candidates),
+                    key=lambda e: (-e[0], str(e[2].get("_id")), e[1]))
+                out_hits = []
+                for rank, (score, _did, hit0) in enumerate(
+                        ordered[from_: from_ + size], start=from_ + 1):
+                    hit = dict(hit0)
+                    hit["_score"] = round(score, 6)
+                    hit["_rank"] = rank
+                    out_hits.append(hit)
+                # shard accounting must reflect EVERY retriever's
+                # fan-out, or one retriever's partial failure hides
+                # behind another's clean run
+                shards = {"total": 0, "successful": 0, "skipped": 0,
+                          "failed": 0}
+                timed_out = False
+                for ranked in results:
+                    sub = (ranked or {}).get("_shards") or {}
+                    for f in shards:
+                        shards[f] += int(sub.get(f, 0))
+                    timed_out = timed_out or bool(
+                        (ranked or {}).get("timed_out"))
+                on_done({
+                    "took": int((time.monotonic() - t0) * 1000),
+                    "timed_out": timed_out,
+                    "_shards": shards,
+                    # windows cap what fusion can observe: the
+                    # unique-doc count is a LOWER bound on true matches
+                    "hits": {"total": {"value": len(first_hit),
+                                       "relation": "gte"},
+                             "max_score": (out_hits[0]["_score"]
+                                           if out_hits else None),
+                             "hits": out_hits},
+                }, None)
+
+            self.rrf_fuser.submit(doc_lists, len(first_hit),
+                                  rank_constant, finalize)
 
         def collect(i: int):
             def cb(resp, err) -> None:

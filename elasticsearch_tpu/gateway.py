@@ -260,6 +260,7 @@ class GatewayAllocator:
             "reported_stale": 0, "verify_fetches": 0,
             "reconcile_failures": 0, "recoveries_cancelled": 0,
             "fallback_empty_allocations": 0,
+            "grace_released_fleet_complete": 0,
         }
         self.ts.register_handler(GATEWAY_STARTED_SHARDS,
                                  self._on_list_started_shards)
@@ -465,10 +466,16 @@ class GatewayAllocator:
             if seen is None or seen != eph:
                 self._node_ephemeral[nid] = eph
                 # a new process behind a known name: its disks may say
-                # anything now — refetch, and verify its STARTED copies
+                # anything now — refetch, and verify its STARTED copies.
+                # seen None = THIS MASTER is fresh (no prior ephemeral
+                # observations), not evidence the node rebooted: mark
+                # SOFT — verified in the background, but health only
+                # loses green after a fetch response actually says
+                # not-live, so routine failovers don't flash yellow for
+                # a round trip. seen != eph = a real reboot: hard mark.
                 self._drop_node_entries(nid)
                 if dnode.is_data:
-                    self._mark_unverified(state, nid)
+                    self._mark_unverified(state, nid, soft=seen is None)
                     # shards still being decided must hear from the
                     # newcomer too: its disk may hold the copy an
                     # in-flight empty-store build should yield to
@@ -550,7 +557,13 @@ class GatewayAllocator:
                 return sr
         return None
 
-    def _mark_unverified(self, state: ClusterState, nid: str) -> None:
+    def _mark_unverified(self, state: ClusterState, nid: str,
+                         soft: bool = False) -> None:
+        """``soft``: the mark drives verification fetches but does NOT
+        veto cluster health until the first fetch response reports the
+        copy not-live (then it hardens). Used by a freshly-elected
+        master, which has no prior ephemeral observation to distinguish
+        a routine failover from a member reboot."""
         added = False
         for sr in state.routing_table.shards_on_node(nid):
             if sr.state != ShardState.STARTED or sr.node_id != nid:
@@ -559,7 +572,8 @@ class GatewayAllocator:
             if key3 in self._unverified:
                 continue
             self._unverified[key3] = {"primary": sr.primary,
-                                      "allocation_id": sr.allocation_id}
+                                      "allocation_id": sr.allocation_id,
+                                      "soft": soft}
             added = True
         if added and nid not in self._verifying_nodes:
             # ONE poll loop per node, covering all its marked shards in
@@ -615,7 +629,12 @@ class GatewayAllocator:
                     _shard_key_str(index, sid)) or {}
                 if info.get("live"):
                     del self._unverified[key3]   # verified: copy served
-                elif info.get("has_data") and not info.get("corrupted"):
+                    continue
+                # first not-live fetch RESPONSE: a soft (election-time)
+                # mark hardens — from here the copy vetoes health green
+                # exactly like a reboot-observed mark
+                entry["soft"] = False
+                if info.get("has_data") and not info.get("corrupted"):
                     # the host holds a commit but hasn't re-opened it
                     # yet (in-place recovery in progress): poll on
                     continue
@@ -670,13 +689,16 @@ class GatewayAllocator:
     def health_unverified(self) -> List[Dict[str, Any]]:
         """STARTED copies this master has not yet confirmed are actually
         hosted — cluster health treats them as not-active so a rebooted
-        host can't hide behind stale green routing."""
+        host can't hide behind stale green routing. Soft (election-time)
+        marks are excluded: they only veto health after a fetch response
+        has actually said not-live (at which point they harden)."""
         coord = self.coordinator
         if coord is None or coord.mode != Mode.LEADER:
             return []
         return [{"index": index, "shard": sid, "node": nid,
                  "primary": entry.get("primary", False)}
-                for (index, sid, nid), entry in self._unverified.items()]
+                for (index, sid, nid), entry in self._unverified.items()
+                if not entry.get("soft")]
 
     def stats_snapshot(self) -> Dict[str, Any]:
         """Counters + gauge snapshot, safe to call from any thread (the
@@ -689,6 +711,9 @@ class GatewayAllocator:
                     len(p) for p in list(self._pending.values()))
                 out["cached_shards"] = len(self._cache)
                 out["unverified_started_shards"] = len(self._unverified)
+                out["unverified_soft"] = sum(
+                    1 for e in list(self._unverified.values())
+                    if e.get("soft"))
                 return out
             except RuntimeError:   # dict changed size during iteration
                 continue
@@ -696,6 +721,7 @@ class GatewayAllocator:
         out["inflight_fetches"] = -1
         out["cached_shards"] = len(self._cache)
         out["unverified_started_shards"] = len(self._unverified)
+        out["unverified_soft"] = -1
         return out
 
     def describe(self, index: str, shard_id: int) -> Optional[Dict[str, Any]]:
@@ -773,7 +799,7 @@ class GatewayAllocator:
                         f"cannot allocate primary: all "
                         f"{len(corrupted)} on-disk copies are "
                         f"corruption-marked (gateway fetch)")
-            if not self._grace_elapsed(shard):
+            if not self._grace_elapsed(shard, state):
                 return ("wait", None)
             if not (shard.unassigned_reason or "").startswith(
                     "no on-disk copy"):
@@ -793,7 +819,7 @@ class GatewayAllocator:
             i.get("has_data") and i.get("allocation_id") is not None and
             i.get("allocation_id") == shard.last_allocation_id
             for i in data.values())
-        if not located and not self._grace_elapsed(shard):
+        if not located and not self._grace_elapsed(shard, state):
             return ("wait", None)
         return ("fallback", None)
 
@@ -801,14 +827,30 @@ class GatewayAllocator:
         return (shard.index, shard.shard_id, shard.primary,
                 shard.last_allocation_id)
 
-    def _grace_elapsed(self, shard: ShardRouting) -> bool:
+    def _grace_elapsed(self, shard: ShardRouting,
+                       state: Optional[ClusterState] = None) -> bool:
         """First fallback-eligible sighting starts the clock; the timer
         re-kicks a reroute when it runs out. The clock applies no matter
         what THIS node's storage looks like — a diskless dedicated
         master must still wait for disk-backed data nodes to finish
-        booting before it builds empty copies."""
+        booting before it builds empty copies.
+
+        ``gateway.expected_data_nodes`` (dynamic) short-circuits the
+        clock: reaching this decision point means every CURRENT data
+        node already answered the shard-state fetch, so once the
+        configured member count has reported in there is no absent
+        copy-holder left to wait for — allocation releases immediately
+        instead of sitting out the rest of the 30s window. 0 disables
+        the check; the grace clock stays the fallback."""
         scheduler = self.ts.transport.scheduler
         now = scheduler.now()
+        if state is not None:
+            expected = self._expected_data_nodes(state)
+            if expected > 0 and len(state.data_nodes()) >= expected:
+                if self._fallback_grace.pop(self._grace_key(shard),
+                                            None) is not None:
+                    self.stats["grace_released_fleet_complete"] += 1
+                return True
         key = self._grace_key(shard)
         deadline = self._fallback_grace.get(key)
         if deadline is None:
@@ -818,6 +860,14 @@ class GatewayAllocator:
                 lambda: self._request_reroute("copy grace elapsed"))
             return False
         return now >= deadline
+
+    @staticmethod
+    def _expected_data_nodes(state: ClusterState) -> int:
+        from elasticsearch_tpu.utils.settings import (
+            GATEWAY_EXPECTED_DATA_NODES, setting_from_state,
+        )
+        # default 0 = disabled: fail toward the grace-clock fallback
+        return setting_from_state(state, GATEWAY_EXPECTED_DATA_NODES)
 
     def cancel_replaceable_recoveries(self, state: ClusterState, routing,
                                       allocation):

@@ -146,11 +146,17 @@ def device_stats() -> Dict[str, Any]:
     return out
 
 
-def search_batch_stats(batcher) -> Dict[str, Any]:
+def search_batch_stats(batcher, rrf_fuser=None) -> Dict[str, Any]:
     """Micro-batcher observability (search/batch_executor.py): dispatch /
     occupancy / wait-time counters plus the derived means operators watch
     to see whether cross-query batching is actually engaging. The raw
-    counters are cumulative since node start, like every other stat."""
+    counters are cumulative since node start, like every other stat.
+
+    Also derives the per-drain-memo hit rate (what fraction of dispatched
+    queries were answered by a batch-mate's rows) and, when this node
+    coordinates hybrid searches, merges the RRF fusion batcher's
+    counters (rrf_fuse_batches / requests / max occupancy /
+    fallbacks)."""
     if batcher is None:
         return {}
     out: Dict[str, Any] = dict(batcher.stats)
@@ -160,6 +166,13 @@ def search_batch_stats(batcher) -> Dict[str, Any]:
         if dispatches else 0.0
     out["mean_wait_ms"] = round(out.get("wait_ms_total", 0.0) / queries, 3) \
         if queries else 0.0
+    out["memo_hit_rate"] = round(
+        out.get("memo_hits", 0) / queries, 4) if queries else 0.0
+    if rrf_fuser is not None:
+        out.update(rrf_fuser.stats)
+        fuses = out.get("rrf_fuse_batches", 0)
+        out["mean_rrf_fuse_occupancy"] = round(
+            out.get("rrf_fuse_requests", 0) / fuses, 3) if fuses else 0.0
     return out
 
 

@@ -269,9 +269,11 @@ class Node:
             "process": monitor.process_stats(),
             "fs": monitor.fs_stats(self.indices_service.data_path),
             "device": monitor.device_stats(),
-            # cross-query micro-batching occupancy/wait/dispatch counters
+            # cross-query micro-batching occupancy/wait/dispatch/memo/
+            # window-controller counters + coordinator RRF fusion batching
             "search_batch": monitor.search_batch_stats(
-                self.search_transport.batcher),
+                self.search_transport.batcher,
+                rrf_fuser=self.search_action.rrf_fuser),
             # gateway shard-state fetch counters (fetches issued, cache
             # hits, copies reported none/corrupted/stale, reconciles)
             "gateway": monitor.gateway_stats(self.gateway_allocator),

@@ -39,6 +39,27 @@ def rrf_fuse(doc_lists: jnp.ndarray,   # [R, K] int32 per-retriever ranked docs 
     return jax.lax.top_k(top, k)
 
 
+@partial(jax.jit, static_argnames=("n_docs_pad", "k", "rank_constant"))
+def rrf_fuse_batch(doc_lists: jnp.ndarray,   # [B, R, K] int32 (-1 pad)
+                   n_docs_pad: int, k: int,
+                   rank_constant: int = 60
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """B concurrent RRF fusions in ONE device program: each row carries
+    one hybrid query's R ranked lists over its own dense doc-id space
+    (ids local to the row; -1 pads both short lists and absent
+    retrievers). The serving-path counterpart of ``rrf_fuse`` — the
+    coordinator's fusion batcher coalesces concurrent hybrid requests
+    into this single dispatch instead of B scatter-add programs.
+    Returns (scores [B, k], docs [B, k]); doc -1 past each row's
+    matches."""
+    def one(row):
+        return rrf_fuse(row, n_docs_pad=n_docs_pad, k=k,
+                        rank_constant=rank_constant)
+    scores, docs = jax.vmap(one)(doc_lists)
+    docs = jnp.where(jnp.isfinite(scores), docs, -1)
+    return scores, docs
+
+
 @partial(jax.jit, static_argnames=("k", "normalize"))
 def linear_fuse(score_arrays: jnp.ndarray,   # [R, N_pad] dense scores per retriever
                 weights: jnp.ndarray,        # [R]

@@ -225,6 +225,33 @@ class IVFIndex:
                            self.ids, self.norms, k, nprobe,
                            self.similarity)
 
+    def probe_live(self, queries: np.ndarray, k: int, nprobe: int,
+                   rows: np.ndarray, live: np.ndarray, segment_idx: int,
+                   oversample: int) -> list:
+        """Batched nprobe-probe for the serving path: ONE device program
+        covers Q queries (centroid scoring + gathered-list scoring +
+        top-k), then the host-side demux the per-query ANN path performs —
+        list-row ids map back through ``rows`` (the segment's rows that
+        actually hold vectors), deleted docs drop out post-probe (the
+        Lucene-HNSW-style post-filter the oversample exists for), and each
+        query keeps its best ``k``. Returns one
+        [(segment_idx, doc, score)] list per query, in score order."""
+        scores, ids = self.search(np.asarray(queries, np.float32),
+                                  oversample, nprobe=nprobe)
+        out = []
+        for qi in range(scores.shape[0]):
+            hits = []
+            for s, i in zip(scores[qi], ids[qi]):
+                if i < 0:
+                    continue
+                doc = int(rows[i])
+                if doc < len(live) and live[doc]:
+                    hits.append((segment_idx, doc, float(s)))
+                if len(hits) >= k:
+                    break
+            out.append(hits)
+        return out
+
     def search(self, queries: np.ndarray, k: int, nprobe: int = 8
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Batched ANN: (scores [Q, k], ids [Q, k]); ids -1 past matches.

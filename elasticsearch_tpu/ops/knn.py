@@ -64,13 +64,10 @@ def knn_topk(matrix, norms, exists, live, query, k: int,
     return jax.lax.top_k(scores, k)
 
 
-@partial(jax.jit, static_argnames=("similarity", "k"))
-def knn_topk_batch(matrix, norms, exists, live, queries, k: int,
-                   similarity: str = "cosine") -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Batched kNN: queries [B, D] -> (scores [B, k], docs [B, k]).
-
-    One big [B, D] x [D, N] MXU matmul — the throughput shape for the
-    SIFT1M-style benchmark."""
+def _batch_scores(matrix, norms, queries, similarity: str) -> jnp.ndarray:
+    """[B, N_pad] similarity plane from one [B, D] x [D, N] MXU matmul
+    (bf16 multiply, f32 accumulate) — shared by the masked and unmasked
+    batch kernels so their per-row arithmetic cannot diverge."""
     q = queries.astype(jnp.bfloat16)
     m = matrix.astype(jnp.bfloat16)
     dots = jax.lax.dot_general(
@@ -79,15 +76,37 @@ def knn_topk_batch(matrix, norms, exists, live, queries, k: int,
         preferred_element_type=jnp.float32,
     )                                                          # [B, N_pad]
     if similarity == "dot_product":
-        scores = 0.5 + dots / 2.0
-    elif similarity == "cosine":
+        return 0.5 + dots / 2.0
+    if similarity == "cosine":
         qn = jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
-        scores = (1.0 + dots / (norms[None, :] * qn + 1e-30)) / 2.0
-    else:
-        q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
-        d2 = jnp.maximum(norms[None, :] ** 2 + q2 - 2.0 * dots, 0.0)
-        scores = 1.0 / (1.0 + jnp.sqrt(d2))
+        return (1.0 + dots / (norms[None, :] * qn + 1e-30)) / 2.0
+    q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
+    d2 = jnp.maximum(norms[None, :] ** 2 + q2 - 2.0 * dots, 0.0)
+    return 1.0 / (1.0 + jnp.sqrt(d2))
+
+
+@partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_topk_batch(matrix, norms, exists, live, queries, k: int,
+                   similarity: str = "cosine") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched kNN: queries [B, D] -> (scores [B, k], docs [B, k]).
+
+    One big [B, D] x [D, N] MXU matmul — the throughput shape for the
+    SIFT1M-style benchmark."""
+    scores = _batch_scores(matrix, norms, queries, similarity)
     scores = jnp.where((live & exists)[None, :], scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_topk_batch_masked(matrix, norms, exists, live, queries, masks,
+                          k: int, similarity: str = "cosine"
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Filtered batched kNN: per-query filter masks [B, N_pad] ride the
+    same [B, D] x [D, N] matmul — the filtered-kNN serving shape
+    (autocomplete / faceted nav), where Q concurrent queries each carry
+    their own filter-context mask but share the corpus scan."""
+    scores = _batch_scores(matrix, norms, queries, similarity)
+    scores = jnp.where((live & exists)[None, :] & masks, scores, -jnp.inf)
     return jax.lax.top_k(scores, k)
 
 
@@ -102,12 +121,18 @@ class KnnExecutor:
         return knn_topk(self.dev.matrix, self.dev.norms, self.dev.exists,
                         live, q, k, self.dev.similarity)
 
-    def top_k_batch(self, queries, live, k: int):
+    def top_k_batch(self, queries, live, k: int, masks=None):
         """Batched exact kNN over Q query vectors: ONE [Q, D] x [D, N] MXU
         matmul instead of Q matvec dispatches (the serving-path counterpart
         of the bench-only knn_topk_batch shape). The query dimension pads
         to a pow2 bucket so the jit cache stays warm across batch sizes;
-        padded rows come back sliced off."""
+        padded rows come back sliced off.
+
+        ``masks`` carries the filter-context of filtered kNN: a single
+        [N_pad] bool mask shared by every query (the autocomplete /
+        faceted-nav case — it simply folds into ``live``, exactly as the
+        solo path's ``live & fmask``), or a [Q, N_pad] stack of per-query
+        masks applied inside the one masked matmul dispatch."""
         q_host = np.asarray(queries, np.float32)
         n_real = q_host.shape[0]
         from elasticsearch_tpu.index.segment import next_pow2
@@ -116,6 +141,16 @@ class KnnExecutor:
             q_host = np.concatenate(
                 [q_host, np.zeros((n_pad - n_real, q_host.shape[1]),
                                   np.float32)])
+        if masks is not None and getattr(masks, "ndim", 1) == 2:
+            m_host = np.zeros((n_pad, np.asarray(masks).shape[1]), bool)
+            m_host[:n_real] = np.asarray(masks)   # padded rows stay False
+            s, d = knn_topk_batch_masked(
+                self.dev.matrix, self.dev.norms, self.dev.exists, live,
+                jnp.asarray(q_host), jnp.asarray(m_host), k,
+                self.dev.similarity)
+            return s[:n_real], d[:n_real]
+        if masks is not None:
+            live = live & masks                   # shared filter mask
         s, d = knn_topk_batch(self.dev.matrix, self.dev.norms,
                               self.dev.exists, live,
                               jnp.asarray(q_host), k, self.dev.similarity)

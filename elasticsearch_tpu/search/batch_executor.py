@@ -30,6 +30,26 @@ micro-batching at the device boundary:
   the jit cache stays warm), then demuxes per-query results — top-k
   docs, totals with the counts-then-skip contract, per-query
   ``theta``/prune stats — bit-compatible with the solo path.
+- **Filtered kNN** batches too: each member's filter-context mask (the
+  host-side mask builders of search/execute.py) is computed once per
+  distinct filter per segment and rides the same [Q, D] x [D, N] MXU
+  matmul — shared as one [N_pad] mask when every member carries the
+  same filter (the autocomplete / faceted-nav shape), stacked to
+  [Q, N_pad] otherwise. Unfiltered members on IVF-routed segments
+  (ivf-opted mapping, or ANN-sized corpora) go through ONE batched
+  nprobe-probe (ops/ivf.py ``probe_live``) instead of falling back solo,
+  provided the members agree on ``num_candidates``.
+- **Per-drain memo**: members of one drain with an identical
+  (plan, window, totals) execute once; the rows fan out to every
+  duplicate (its own context, stats, slow-log entry — the response
+  surface is indistinguishable from independent execution). A drain
+  holds ONE reader snapshot, so a memo hit can never cross a refresh.
+  Duplicate-heavy traffic (autocomplete storms) becomes nearly free.
+- **Occupancy feedback**: each key's collection window adapts — drains
+  carrying >= ``search.batch.target_occupancy`` live members grow the
+  window (x2, bounded by ``search.batch.max_window_ms``); drains that
+  come up thin (<= 1) shrink it back — so bursty keys coalesce harder
+  while idle keys never hold a lone query hostage.
 - Per-query deadlines and cancellation still bind: a query whose budget
   expires (or whose task is cancelled) before its batch drains is failed
   individually at drain entry; between device dispatches every member is
@@ -61,17 +81,13 @@ from elasticsearch_tpu.utils.errors import (
 )
 from elasticsearch_tpu.utils.settings import (
     SEARCH_BATCH_ENABLED, SEARCH_BATCH_MAX_SIZE, SEARCH_BATCH_MAX_WINDOW_MS,
+    SEARCH_BATCH_TARGET_OCCUPANCY,
 )
 
 
 class _FallbackSolo(Exception):
     """Internal: this batch cannot run batched (e.g. an IVF-sized kNN
     segment); members re-execute through the solo path."""
-
-
-def _ann_min_docs() -> int:
-    from elasticsearch_tpu.search.execute import ANN_DEFAULT_MIN_DOCS
-    return ANN_DEFAULT_MIN_DOCS
 
 
 class _AllMembersDead(Exception):
@@ -100,8 +116,14 @@ class BatchSpec:
     clauses: Optional[List[Tuple[str, float]]] = None
     query_vector: Optional[List[float]] = None
     k: int = 10
+    num_candidates: int = 100
     tokens: Optional[Dict[str, float]] = None
     boost: float = 1.0
+    # filtered kNN: the parsed filter tree plus a stable value key —
+    # members with DIFFERENT filters still share a batch (per-query mask
+    # stack); equal keys share one mask computation per segment
+    filter: Any = None
+    filter_key: Optional[str] = None
     # the parsed + alias-resolved query tree (text class): classification
     # already paid the parse, so the drain's term-stats pass reuses it
     # instead of re-parsing the raw body on the hot path
@@ -113,6 +135,18 @@ class BatchSpec:
         if self.kind == "knn":
             return ("knn", self.field, self.window, self.clip_limit, self.k)
         return ("sparse", self.field, self.window, self.clip_limit)
+
+    def memo_key(self) -> Tuple:
+        """Identity for the per-drain memo: two members whose memo keys
+        coincide are the SAME plan (kind/field/window/totals are already
+        fixed by the batch key, so only the private payload matters)."""
+        if self.kind == "text":
+            return ("text", tuple(self.clauses or ()))
+        if self.kind == "knn":
+            return ("knn", tuple(self.query_vector or ()), self.boost,
+                    self.num_candidates, self.filter_key)
+        return ("sparse", tuple(sorted((self.tokens or {}).items())),
+                self.boost)
 
 
 @dataclass
@@ -168,16 +202,23 @@ def classify_request(req: Dict[str, Any], mappers) -> Optional[BatchSpec]:
 
     exact_total = track is True or (isinstance(track, int) and track > 0)
     clip = int(track) if (exact_total and track is not True) else None
-    if isinstance(query, dsl.Knn) and query.filter is None:
+    if isinstance(query, dsl.Knn):
         mapper = mappers.mapper(query.field)
         if mappers.field_type(query.field) != "dense_vector":
             return None
         opts = getattr(mapper, "index_options", None) or {}
-        if opts.get("type") is not None:
-            return None      # IVF-opted (or unknown) mapping: solo
+        if opts.get("type") not in (None, "ivf"):
+            return None      # unknown index type: solo decides
+        # filtered kNN is batch-eligible: the filter becomes a per-query
+        # (or shared) mask inside the batched matmul, exactly the solo
+        # path's live & fmask; IVF-routed segments batch the probe
         return BatchSpec(kind="knn", field=query.field, window=window,
                          clip_limit=clip, query_vector=query.query_vector,
-                         k=int(query.k), boost=float(query.boost))
+                         k=int(query.k), boost=float(query.boost),
+                         num_candidates=int(query.num_candidates),
+                         filter=query.filter,
+                         filter_key=(repr(query.filter)
+                                     if query.filter is not None else None))
     if isinstance(query, dsl.TextExpansion) and query.tokens:
         return BatchSpec(kind="sparse", field=query.field, window=window,
                          clip_limit=clip, tokens=dict(query.tokens),
@@ -387,35 +428,100 @@ def batched_wand_topk_shard(ctxs, field: str,
 
 def batched_knn_shard(ctxs, field: str, specs: List[BatchSpec],
                       k: int, check_members: Optional[Callable[[], None]]
-                      = None) -> List[Tuple]:
-    """Q exact-kNN queries: one [Q, D] x [D, N] matmul per segment, then
-    the per-member shard-global merge Lucene's KnnVectorQuery rewrite
-    performs (execute.rewrite_knn), demuxed to the dense collector's
-    candidates/totals shape. Raises _FallbackSolo when a segment is
-    IVF-sized (the solo path would route it through the ANN index)."""
+                      = None, stats: Optional[Dict[str, float]] = None
+                      ) -> List[Tuple]:
+    """Q kNN queries — filtered or not: one [Q, D] x [D, N] (optionally
+    masked) matmul per exact segment, one batched nprobe-probe per
+    IVF-routed segment, then the per-member shard-global merge Lucene's
+    KnnVectorQuery rewrite performs (execute.rewrite_knn), demuxed to the
+    dense collector's candidates/totals shape.
+
+    Per segment and member, the route matches the solo rewrite exactly:
+    filtered members stay exact (masked) everywhere; unfiltered members
+    take the IVF probe where ``ann_segment_route`` says the solo path
+    would. Filter masks are computed ONCE per distinct filter per
+    segment — one shared [N_pad] mask when all members agree (the
+    autocomplete / faceted-nav case), a [Q, N_pad] stack otherwise.
+    Raises _FallbackSolo only when IVF-routed members disagree on
+    ``num_candidates`` (the probe width would differ per member)."""
     from elasticsearch_tpu.ops.device_segment import DeviceVectors
     from elasticsearch_tpu.ops.knn import KnnExecutor
-    from elasticsearch_tpu.search.execute import ANN_DEFAULT_MIN_DOCS
+    from elasticsearch_tpu.search.execute import (
+        ann_segment_route, execute as execute_query,
+    )
     n_q = len(specs)
     vectors = np.asarray([s.query_vector for s in specs], np.float32)
     per_member_hits: List[List[Tuple[int, int, float]]] = \
         [[] for _ in range(n_q)]
+    unfiltered = [qi for qi in range(n_q) if specs[qi].filter is None]
     for ctx in ctxs:
         dev = DeviceVectors.for_segment(ctx.segment, field)
         if dev is None:
             continue
-        if ctx.segment.n_docs >= ANN_DEFAULT_MIN_DOCS:
-            raise _FallbackSolo(
-                f"segment [{ctx.segment.name}] takes the IVF path")
         if check_members is not None:
             check_members()
+        route = None
+        if unfiltered:
+            route = ann_segment_route(
+                ctx, field, k, specs[unfiltered[0]].num_candidates,
+                filtered=False)
+        if route is not None:
+            # members may disagree on num_candidates; that only matters
+            # when it changes the derived probe width (a mapping-pinned
+            # nprobe makes it moot)
+            distinct_nc = {specs[qi].num_candidates for qi in unfiltered}
+            if len(distinct_nc) > 1 and len({
+                    ann_segment_route(ctx, field, k, nc,
+                                      filtered=False)[3]
+                    for nc in distinct_nc}) > 1:
+                raise _FallbackSolo(
+                    f"segment [{ctx.segment.name}] is IVF-routed and "
+                    f"members' num_candidates imply different nprobe")
+            index, rows, oversample, nprobe = route
+            if index is not None:
+                live_host = np.asarray(ctx.live)[: ctx.segment.n_docs]
+                probed = index.probe_live(
+                    vectors[unfiltered], k, nprobe, rows, live_host,
+                    ctx.segment_idx, oversample)
+                for qi, hits in zip(unfiltered, probed):
+                    per_member_hits[qi].extend(hits)
+            exact_idx = [qi for qi in range(n_q)
+                         if specs[qi].filter is not None]
+        else:
+            exact_idx = list(range(n_q))
+        if not exact_idx:
+            continue
+        # exact path: distinct filters resolve to masks once per segment
+        masks = None
+        fkeys = {specs[qi].filter_key for qi in exact_idx}
+        if fkeys != {None}:
+            by_key: Dict[Optional[str], Any] = {}
+            for qi in exact_idx:
+                s_qi = specs[qi]
+                if s_qi.filter is not None and \
+                        s_qi.filter_key not in by_key:
+                    _, fmask = execute_query(s_qi.filter, ctx)
+                    by_key[s_qi.filter_key] = fmask
+            if len(fkeys) == 1:
+                # every member carries the SAME filter: one shared mask
+                masks = by_key[next(iter(fkeys))]
+                if stats is not None:
+                    stats["knn_shared_mask_segments"] = \
+                        stats.get("knn_shared_mask_segments", 0) + 1
+            else:
+                rows_m = np.ones((len(exact_idx), ctx.n_docs_pad), bool)
+                for row, qi in enumerate(exact_idx):
+                    fk = specs[qi].filter_key
+                    if fk is not None:
+                        rows_m[row] = np.asarray(by_key[fk])
+                masks = rows_m
         ex = KnnExecutor(dev)
         k_seg = min(k, ctx.n_docs_pad)
-        s, d = ex.top_k_batch(vectors, ctx.live, k_seg)
+        s, d = ex.top_k_batch(vectors[exact_idx], ctx.live, k_seg, masks)
         s = np.asarray(s)
         d = np.asarray(d)
-        for qi in range(n_q):
-            for sc, doc in zip(s[qi], d[qi]):
+        for row, qi in enumerate(exact_idx):
+            for sc, doc in zip(s[row], d[row]):
                 if sc > -np.inf:
                     per_member_hits[qi].append(
                         (ctx.segment_idx, int(doc), float(sc)))
@@ -499,7 +605,10 @@ class ShardQueryBatcher:
         self.sts = sts
         self._queues: Dict[Tuple, List[_Member]] = {}
         self._timers: Dict[Tuple, Any] = {}
-        self._last_dispatch: Dict[Tuple, float] = {}
+        # per-key controller state: {"last": <dispatch time>, "window":
+        # <current adaptive collection window, seconds>} — the occupancy
+        # feedback loop's memory, FIFO-bounded like the old recency map
+        self._key_state: Dict[Tuple, Dict[str, float]] = {}
         self.stats: Dict[str, float] = {
             "batches_dispatched": 0,
             "queries_dispatched": 0,
@@ -508,21 +617,19 @@ class ShardQueryBatcher:
             "queries_expired": 0,
             "queries_cancelled": 0,
             "solo_fallbacks": 0,
+            # per-drain memo + occupancy-feedback controller
+            "memo_hits": 0,
+            "window_grows": 0,
+            "window_shrinks": 0,
+            "knn_shared_mask_segments": 0,
         }
 
     # -- settings (dynamic, from committed cluster state) ---------------
 
     def _setting(self, setting):
+        from elasticsearch_tpu.utils.settings import setting_from_state
         state = self.sts.state() if self.sts.state is not None else None
-        if state is None:
-            return setting.default(None)
-        raw = state.metadata.persistent_settings.get(setting.key)
-        if raw is None:
-            return setting.default(None)
-        try:
-            return setting.parse(raw)
-        except Exception:  # noqa: BLE001 — unparseable operator value:
-            return setting.default(None)   # fail toward the default
+        return setting_from_state(state, setting)
 
     def enabled(self) -> bool:
         return self._setting(SEARCH_BATCH_ENABLED)
@@ -532,6 +639,9 @@ class ShardQueryBatcher:
 
     def max_size(self) -> int:
         return self._setting(SEARCH_BATCH_MAX_SIZE)
+
+    def target_occupancy(self) -> int:
+        return self._setting(SEARCH_BATCH_TARGET_OCCUPANCY)
 
     def _scheduler(self):
         return self.sts.ts.transport.scheduler
@@ -552,14 +662,6 @@ class ShardQueryBatcher:
                 if is_frozen(self.sts.state(), req["index"]):
                     return None    # per-search device residency: solo
             spec = classify_request(req, shard.engine.mappers)
-            if spec is not None and spec.kind == "knn" and any(
-                    spec.field in seg.vectors and
-                    seg.n_docs >= _ann_min_docs()
-                    for seg in shard.engine.segments):
-                # an IVF-sized segment routes the solo path through the
-                # ANN index; classifying it eligible would just cycle
-                # queue -> _FallbackSolo -> solo on every request
-                spec = None
         except Exception:  # noqa: BLE001 — classification must never
             return None    # fail a query; the solo path reports errors
         if spec is None:
@@ -589,15 +691,18 @@ class ShardQueryBatcher:
                 timer.cancel()
             self._drain(key)
         elif key not in self._timers:
-            # adaptive window: a key with recent traffic waits up to the
-            # window for batch-mates; an idle key drains on the next
-            # scheduler tick (which still coalesces every same-tick
-            # arrival already in the dispatch queue)
-            window = self.max_window_s()
-            recent = (scheduler.now() -
-                      self._last_dispatch.get(key, -float("inf"))) <= window
+            # adaptive window: a key with recent traffic waits up to its
+            # occupancy-tuned window (never past max_window_ms) for
+            # batch-mates; an idle key drains on the next scheduler tick
+            # (which still coalesces every same-tick arrival already in
+            # the dispatch queue)
+            window_cap = self.max_window_s()
+            st = self._key_state.get(key)
+            recent = st is not None and \
+                (scheduler.now() - st["last"]) <= window_cap
+            wait = min(st["window"], window_cap) if recent else 0.0
             self._timers[key] = scheduler.schedule(
-                window if recent else 0.0, lambda: self._drain(key))
+                wait, lambda: self._drain(key))
         return member.deferred
 
     # -- member lifecycle ----------------------------------------------
@@ -638,14 +743,21 @@ class ShardQueryBatcher:
             return
         scheduler = self._scheduler()
         now = scheduler.now()
-        # recent-traffic tracking is FIFO-bounded: the key space includes
+        # per-key controller state is FIFO-bounded: the key space includes
         # client-controlled components (window, totals), so an unbounded
         # dict would grow with request-shape variety for the process
-        # lifetime. Losing an old entry only costs one immediate drain.
-        self._last_dispatch.pop(key, None)
-        self._last_dispatch[key] = now
-        while len(self._last_dispatch) > self.LAST_DISPATCH_CAP:
-            self._last_dispatch.pop(next(iter(self._last_dispatch)))
+        # lifetime. Losing an old entry only costs one immediate drain
+        # and a window reset.
+        window_cap = self.max_window_s()
+        st = self._key_state.pop(key, None)
+        if st is None:
+            # fresh key: start the adaptive window small; full drains
+            # grow it toward the cap
+            st = {"window": window_cap / 4.0}
+        st["last"] = now
+        self._key_state[key] = st
+        while len(self._key_state) > self.LAST_DISPATCH_CAP:
+            self._key_state.pop(next(iter(self._key_state)))
 
         # per-query deadline/cancellation binds at drain entry: a query
         # whose budget expired while queued fails individually, exactly
@@ -657,6 +769,23 @@ class ShardQueryBatcher:
                 self._finish(m)
             else:
                 live.append(m)
+
+        # occupancy feedback: a key whose drains keep running full earns
+        # a longer collection window (more coalescing under load); a key
+        # that drains thin gives the latency back. Bounded by
+        # max_window_ms above, max_window_ms/16 below so the window can
+        # always recover in a few drains.
+        if len(live) >= self.target_occupancy():
+            grown = min(window_cap,
+                        max(st["window"] * 2.0, window_cap / 16.0))
+            if grown > st["window"]:
+                self.stats["window_grows"] += 1
+            st["window"] = grown
+        elif len(live) <= 1:
+            shrunk = max(window_cap / 16.0, st["window"] / 2.0)
+            if shrunk < st["window"]:
+                self.stats["window_shrinks"] += 1
+            st["window"] = shrunk
         if not live:
             return
 
@@ -720,14 +849,32 @@ class ShardQueryBatcher:
             if alive == 0:
                 raise _AllMembersDead()
 
+        # per-drain memo: members with an identical (plan, window,
+        # totals) execute ONCE; their rows fan out below. The drain holds
+        # one reader snapshot, so a memo hit can never cross a refresh —
+        # unlike the request cache there is no freshness key to check.
+        memo_index: Dict[Tuple, int] = {}
+        uniques: List[_Member] = []
+        assign: List[int] = []
+        for m in members:
+            mk = m.spec.memo_key()
+            got = memo_index.get(mk)
+            if got is None:
+                got = len(uniques)
+                memo_index[mk] = got
+                uniques.append(m)
+            else:
+                self.stats["memo_hits"] += 1
+            assign.append(got)
+
         # shard-level term stats exactly as query_shard computes them;
         # df per term is query-independent so the members' maps merge
         doc_count = sum(seg.n_docs for seg in reader.segments)
         dfs: Dict[str, Dict[str, int]] = {}
         if spec0.kind == "text":
-            for m in members:
+            for u in uniques:
                 _dc, m_dfs = shard_term_stats(reader, mappers,
-                                              m.spec.query)
+                                              u.spec.query)
                 for fname, termmap in m_dfs.items():
                     dfs.setdefault(fname, {}).update(termmap)
         ctxs = _build_ctxs(reader, mappers, doc_count,
@@ -736,7 +883,7 @@ class ShardQueryBatcher:
         from elasticsearch_tpu.index.segment import BLOCK
         from elasticsearch_tpu.indices.breaker import BREAKERS
         breaker = BREAKERS.breaker("request")
-        n_q = len(members)
+        n_q = len(uniques)
         want = spec0.window
         if spec0.kind == "text":
             transient = n_q * sum(
@@ -749,22 +896,22 @@ class ShardQueryBatcher:
             if spec0.kind == "text":
                 results = batched_wand_topk_shard(
                     ctxs, spec0.field,
-                    [m.spec.clauses for m in members], want,
+                    [u.spec.clauses for u in uniques], want,
                     spec0.track_limit, check_members)
                 collector = "wand_topk"
             elif spec0.kind == "knn":
                 results = batched_knn_shard(
-                    ctxs, spec0.field, [m.spec for m in members],
-                    spec0.k, check_members)
+                    ctxs, spec0.field, [u.spec for u in uniques],
+                    spec0.k, check_members, stats=self.stats)
                 collector = "dense"
             else:
                 results = batched_sparse_shard(
-                    ctxs, spec0.field, [m.spec for m in members], want,
+                    ctxs, spec0.field, [u.spec for u in uniques], want,
                     check_members)
                 collector = "dense"
 
-        for m, (candidates, total, relation, max_score, prune) in \
-                zip(members, results):
+        for m, ui in zip(members, assign):
+            candidates, total, relation, max_score, prune = results[ui]
             if m.error is not None:
                 continue    # died mid-batch: fail, don't demux
             docs = candidates[: want]

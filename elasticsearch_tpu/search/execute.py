@@ -919,21 +919,23 @@ class KnnBound(dsl.Query):
 ANN_DEFAULT_MIN_DOCS = 65536
 
 
-def _ann_segment_topk(ctx: "SegmentContext", q: dsl.Knn
-                      ) -> Optional[List[Tuple[int, int, float]]]:
-    """IVF path for one segment, or None to fall back to exact.
+def ann_segment_route(ctx: "SegmentContext", field: str, k: int,
+                      num_candidates: int, filtered: bool = False
+                      ) -> Optional[Tuple]:
+    """IVF routing decision for one segment, shared by the solo kNN
+    rewrite and the batched executor so they cannot diverge.
 
-    Used when the mapping opts in (index_options {"type": "ivf"}) or the
-    segment is large enough that brute force wastes FLOPs. Deleted docs are
-    filtered after probing (the Lucene-HNSW-style post-filter), with
-    oversampling to keep k results available."""
-    if q.filter is not None:
+    None = take the exact brute-force path (small segment, filtered
+    query, unknown index type, or no vector column). Otherwise
+    (index, rows, oversample, nprobe) — with index None when the field
+    is mapped but this segment holds zero vectors (no hits)."""
+    if filtered:
         return None       # filtered kNN stays exact (correctness first)
     seg = ctx.segment
-    vf = seg.vectors.get(q.field)
+    vf = seg.vectors.get(field)
     if vf is None:
         return None
-    mapper = ctx.mappers.mapper(q.field)
+    mapper = ctx.mappers.mapper(field)
     opts = getattr(mapper, "index_options", None) or {}
     wants_ivf = opts.get("type") == "ivf"
     if not wants_ivf and seg.n_docs < ANN_DEFAULT_MIN_DOCS:
@@ -950,34 +952,48 @@ def _ann_segment_topk(ctx: "SegmentContext", q: dsl.Knn
                                nlist=opts.get("nlist"),
                                similarity=vf.similarity)
         return index, rows.astype(np.int64)
-    index, rows = seg.device(("ivf", q.field), build)
+    index, rows = seg.device(("ivf", field), build)
+    if index is None:
+        return (None, rows, 0, 0)   # mapped, but no vectors here
+
+    oversample = min(max(2 * k, k + 16), len(rows))
+    nprobe = opts.get("nprobe") or max(
+        1, int(np.ceil(num_candidates / max(index.list_len, 1))))
+    return (index, rows, oversample, nprobe)
+
+
+def _ann_segment_topk(ctx: "SegmentContext", q: dsl.Knn
+                      ) -> Optional[List[Tuple[int, int, float]]]:
+    """IVF path for one segment, or None to fall back to exact.
+
+    Used when the mapping opts in (index_options {"type": "ivf"}) or the
+    segment is large enough that brute force wastes FLOPs. Deleted docs are
+    filtered after probing (the Lucene-HNSW-style post-filter), with
+    oversampling to keep k results available."""
+    route = ann_segment_route(ctx, q.field, q.k, q.num_candidates,
+                              filtered=q.filter is not None)
+    if route is None:
+        return None
+    index, rows, oversample, nprobe = route
     if index is None:
         return []         # field present but no vectors in this segment
-
-    oversample = min(max(2 * q.k, q.k + 16), len(rows))
-    nprobe = opts.get("nprobe") or max(
-        1, int(np.ceil(q.num_candidates / max(index.list_len, 1))))
-    scores, ids = index.search(np.asarray(q.query_vector, np.float32),
-                               oversample, nprobe=nprobe)
-    live = np.asarray(ctx.live)[: seg.n_docs]
-    out: List[Tuple[int, int, float]] = []
-    for s, i in zip(scores[0], ids[0]):
-        if i < 0:
-            continue
-        doc = int(rows[i])
-        if doc < len(live) and live[doc]:
-            out.append((ctx.segment_idx, doc, float(s)))
-        if len(out) >= q.k:
-            break
-    return out
+    live = np.asarray(ctx.live)[: ctx.segment.n_docs]
+    return index.probe_live(
+        np.asarray(q.query_vector, np.float32)[None, :], q.k, nprobe,
+        rows, live, ctx.segment_idx, oversample)[0]
 
 
-def rewrite_knn(q: dsl.Query, segment_ctxs: List["SegmentContext"]) -> dsl.Query:
+def rewrite_knn(q: dsl.Query, segment_ctxs: List["SegmentContext"],
+                cancel_check=None) -> dsl.Query:
     """Replace every Knn node with a KnnBound node holding the shard-global
-    top-k (merged across segments)."""
+    top-k (merged across segments). ``cancel_check`` (zero-arg, raising)
+    runs between per-segment device dispatches so a cancelled or
+    budget-expired task stops paying for vector scans."""
     if isinstance(q, dsl.Knn):
         per_seg_hits: List[Tuple[int, int, float]] = []
         for ctx in segment_ctxs:
+            if cancel_check is not None:
+                cancel_check()
             ann = _ann_segment_topk(ctx, q)
             if ann is not None:
                 per_seg_hits.extend(ann)
@@ -1009,28 +1025,37 @@ def rewrite_knn(q: dsl.Query, segment_ctxs: List["SegmentContext"]) -> dsl.Query
         return KnnBound(per_segment=per_segment, boost=q.boost)
     # recurse into compound nodes
     if isinstance(q, dsl.Bool):
-        return dsl.Bool(must=[rewrite_knn(c, segment_ctxs) for c in q.must],
-                        should=[rewrite_knn(c, segment_ctxs) for c in q.should],
-                        must_not=[rewrite_knn(c, segment_ctxs) for c in q.must_not],
-                        filter=[rewrite_knn(c, segment_ctxs) for c in q.filter],
+        return dsl.Bool(must=[rewrite_knn(c, segment_ctxs, cancel_check)
+                              for c in q.must],
+                        should=[rewrite_knn(c, segment_ctxs, cancel_check)
+                                for c in q.should],
+                        must_not=[rewrite_knn(c, segment_ctxs, cancel_check)
+                                  for c in q.must_not],
+                        filter=[rewrite_knn(c, segment_ctxs, cancel_check)
+                                for c in q.filter],
                         minimum_should_match=q.minimum_should_match, boost=q.boost)
     if isinstance(q, dsl.DisMax):
-        return dsl.DisMax(queries=[rewrite_knn(c, segment_ctxs) for c in q.queries],
+        return dsl.DisMax(queries=[rewrite_knn(c, segment_ctxs, cancel_check)
+                                   for c in q.queries],
                           tie_breaker=q.tie_breaker, boost=q.boost)
     if isinstance(q, dsl.ConstantScore) and q.filter is not None:
-        return dsl.ConstantScore(filter=rewrite_knn(q.filter, segment_ctxs),
-                                 boost=q.boost)
+        return dsl.ConstantScore(
+            filter=rewrite_knn(q.filter, segment_ctxs, cancel_check),
+            boost=q.boost)
     if isinstance(q, dsl.Boosting):
-        return dsl.Boosting(positive=rewrite_knn(q.positive, segment_ctxs),
-                            negative=rewrite_knn(q.negative, segment_ctxs),
-                            negative_boost=q.negative_boost, boost=q.boost)
+        return dsl.Boosting(
+            positive=rewrite_knn(q.positive, segment_ctxs, cancel_check),
+            negative=rewrite_knn(q.negative, segment_ctxs, cancel_check),
+            negative_boost=q.negative_boost, boost=q.boost)
     if isinstance(q, dsl.ScriptScore) and q.query is not None:
-        return dsl.ScriptScore(query=rewrite_knn(q.query, segment_ctxs),
-                               source=q.source, params=q.params, boost=q.boost)
+        return dsl.ScriptScore(
+            query=rewrite_knn(q.query, segment_ctxs, cancel_check),
+            source=q.source, params=q.params, boost=q.boost)
     if isinstance(q, dsl.FunctionScore) and q.query is not None:
-        return dsl.FunctionScore(query=rewrite_knn(q.query, segment_ctxs),
-                                 functions=q.functions, boost_mode=q.boost_mode,
-                                 score_mode=q.score_mode, boost=q.boost)
+        return dsl.FunctionScore(
+            query=rewrite_knn(q.query, segment_ctxs, cancel_check),
+            functions=q.functions, boost_mode=q.boost_mode,
+            score_mode=q.score_mode, boost=q.boost)
     return q
 
 

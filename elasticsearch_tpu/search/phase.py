@@ -579,9 +579,11 @@ def query_shard(reader: Reader,
                 "WandTopKCollector", "search_top_hits (block-max pruned)")
                 if profile else None))
 
-    # Lucene-style kNN rewrite: per-segment top-k merged to shard-global k
+    # Lucene-style kNN rewrite: per-segment top-k merged to shard-global
+    # k; the rewrite pays one device dispatch per segment, so the shard's
+    # cancel/deadline check binds between them like everywhere else
     from elasticsearch_tpu.search.execute import rewrite_knn
-    query = rewrite_knn(query, ctxs)
+    query = rewrite_knn(query, ctxs, cancel_check)
 
     # transient HBM estimate for the dense path: one f32 score vector plus
     # mask/where temporaries per segment (HierarchyCircuitBreakerService

@@ -318,6 +318,40 @@ SEARCH_BATCH_MAX_SIZE: Setting[int] = Setting.int_setting(
     "search.batch.max_size", 64, min_value=1, max_value=1024,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
+# occupancy-feedback window controller (search/batch_executor.py): a key
+# whose drains carry at least this many live members keeps growing its
+# collection window (toward max_window_ms); drains that come up thin
+# (<= 1 member) shrink it back so an isolated query never waits for
+# batch-mates that aren't coming
+SEARCH_BATCH_TARGET_OCCUPANCY: Setting[int] = Setting.int_setting(
+    "search.batch.target_occupancy", 4, min_value=2,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# gateway.recover_after_data_nodes-style fleet-completeness release: when
+# this many data nodes have joined AND answered the shard-state fetch,
+# allocation stops waiting out EXISTING_COPY_GRACE for absent copy-holders
+# (0 = disabled; the grace clock stays the fallback)
+GATEWAY_EXPECTED_DATA_NODES: Setting[int] = Setting.int_setting(
+    "gateway.expected_data_nodes", 0, min_value=0,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+
+def setting_from_state(state, setting: Setting[T]) -> T:
+    """Read a dynamic cluster setting off a committed cluster state's
+    persistent settings. Missing values — and unparseable operator
+    values — fall back to the setting's default, so a bad update can
+    never wedge a hot path. The one read-side idiom every service that
+    consumes dynamic settings directly from state shares."""
+    raw = None
+    if state is not None:
+        raw = state.metadata.persistent_settings.get(setting.key)
+    if raw is None:
+        return setting.default(None)
+    try:
+        return setting.parse(raw)
+    except Exception:  # noqa: BLE001 — fail toward the default
+        return setting.default(None)
+
 
 def _closest(key: str, candidates: Iterable[str]) -> Optional[str]:
     """Cheap typo suggestion: smallest prefix-distance candidate."""

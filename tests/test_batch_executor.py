@@ -172,6 +172,143 @@ def test_golden_knn_and_sparse_batch_parity(seed):
         assert rel == solo.total_relation
 
 
+def _vec_tag_engine(seed: int, n_docs: int = 90, ivf: bool = False):
+    """dense_vector + keyword corpus over multiple segments; ivf=True
+    opts the mapping into the IVF ANN path (batched nprobe probing)."""
+    rng = np.random.default_rng(seed)
+    vec_mapping = {"type": "dense_vector", "dims": 8,
+                   "similarity": "cosine"}
+    if ivf:
+        vec_mapping["index_options"] = {"type": "ivf", "nlist": 8,
+                                        "nprobe": 8}
+    eng = InternalEngine(
+        MapperService({"properties": {"vec": vec_mapping,
+                                      "tag": {"type": "keyword"}}}),
+        shard_label=f"fk{seed}{'i' if ivf else ''}")
+    for i in range(n_docs):
+        eng.index(str(i), {"vec": [float(x) for x in
+                                   rng.standard_normal(8)],
+                           "tag": f"t{i % 3}"})
+        if i == n_docs // 2:
+            eng.refresh()
+    eng.refresh()
+    return eng, rng
+
+
+def _knn_parity(eng, rng, bodies, k: int, stats=None):
+    """Run each body solo through query_shard AND all of them through
+    batched_knn_shard; assert ids/scores/totals identical."""
+    reader = eng.acquire_reader()
+    mappers = eng.mappers
+    ctxs = _build_ctxs(reader, mappers,
+                       sum(s.n_docs for s in reader.segments), None)
+    specs = []
+    solos = []
+    for b in bodies:
+        q = dsl.parse_query(b)
+        solos.append(query_shard(reader, mappers, q, size=5,
+                                 sort=parse_sort(None)))
+        spec = classify_request(
+            {"index": "i", "shard": 0, "window": 5, "body": {"query": b}},
+            mappers)
+        assert spec is not None and spec.kind == "knn"
+        specs.append(spec)
+    batch = batched_knn_shard(ctxs, "vec", specs, k, stats=stats)
+    for solo, (cands, total, rel, max_score, _p) in zip(solos, batch):
+        assert [(c.segment_idx, c.doc) for c in cands[:5]] == \
+            [(c.segment_idx, c.doc) for c in solo.docs]
+        np.testing.assert_allclose([c.score for c in cands[:5]],
+                                   [d.score for d in solo.docs],
+                                   rtol=1e-5)
+        assert total == solo.total_hits
+        assert rel == solo.total_relation
+
+
+@pytest.mark.parametrize("seed", [61 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_golden_filtered_knn_batch_parity(seed):
+    """Members with DIFFERENT filters (plus an unfiltered ride-along)
+    share one [Q, N_pad]-masked matmul; every member's hits, scores and
+    totals match its solo execution."""
+    eng, rng = _vec_tag_engine(seed)
+    bodies = [{"knn": {"field": "vec", "k": 6,
+                       "query_vector":
+                           [float(x) for x in rng.standard_normal(8)],
+                       "filter": {"term": {"tag": f"t{i % 3}"}}}}
+              for i in range(3)]
+    bodies.append({"knn": {"field": "vec", "k": 6, "query_vector":
+                           [float(x) for x in rng.standard_normal(8)]}})
+    # a compound filter exercises the mask composition path too
+    bodies.append({"knn": {"field": "vec", "k": 6,
+                           "query_vector":
+                               [float(x) for x in rng.standard_normal(8)],
+                           "filter": {"bool": {"must_not": [
+                               {"term": {"tag": "t1"}}]}}}})
+    _knn_parity(eng, rng, bodies, 6)
+
+
+@pytest.mark.parametrize("seed", [67 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_golden_shared_mask_knn_batch_parity(seed):
+    """When every member carries the SAME filter (the autocomplete /
+    faceted-nav shape) the mask is computed once and shared [N_pad]."""
+    eng, rng = _vec_tag_engine(seed)
+    bodies = [{"knn": {"field": "vec", "k": 7,
+                       "query_vector":
+                           [float(x) for x in rng.standard_normal(8)],
+                       "filter": {"term": {"tag": "t0"}}}}
+              for _ in range(4)]
+    stats = {"knn_shared_mask_segments": 0}
+    _knn_parity(eng, rng, bodies, 7, stats=stats)
+    # one shared-mask dispatch per segment with postings for the field
+    assert stats["knn_shared_mask_segments"] >= 1
+
+
+@pytest.mark.parametrize("seed", [73 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_golden_ivf_batch_parity(seed):
+    """IVF-opted mappings batch through ONE nprobe-probe device program
+    (ops/ivf.py probe_live) instead of falling back solo; results match
+    the solo ANN path member-for-member."""
+    eng, rng = _vec_tag_engine(seed, n_docs=240, ivf=True)
+    bodies = [{"knn": {"field": "vec", "k": 5,
+                       "query_vector":
+                           [float(x) for x in rng.standard_normal(8)]}}
+              for _ in range(4)]
+    _knn_parity(eng, rng, bodies, 5)
+
+
+def test_ivf_num_candidates_disagreement_falls_back_solo():
+    """IVF-routed members whose num_candidates imply different probe
+    widths cannot share one dispatch: the batch must raise _FallbackSolo
+    (the batcher then re-runs every member solo) rather than probe
+    wrongly. Only reachable when the mapping does not pin nprobe."""
+    from elasticsearch_tpu.search.batch_executor import _FallbackSolo
+    rng = np.random.default_rng(11)
+    eng = InternalEngine(
+        MapperService({"properties": {"vec": {
+            "type": "dense_vector", "dims": 8, "similarity": "cosine",
+            "index_options": {"type": "ivf", "nlist": 8}}}}),
+        shard_label="fknc")
+    for i in range(120):
+        eng.index(str(i), {"vec": [float(x) for x in
+                                   rng.standard_normal(8)]})
+    eng.refresh()
+    reader = eng.acquire_reader()
+    ctxs = _build_ctxs(reader, eng.mappers,
+                       sum(s.n_docs for s in reader.segments), None)
+    specs = []
+    for nc in (50, 100):
+        spec = classify_request(
+            {"index": "i", "shard": 0, "window": 5,
+             "body": {"query": {"knn": {
+                 "field": "vec", "k": 5, "num_candidates": nc,
+                 "query_vector":
+                     [float(x) for x in rng.standard_normal(8)]}}}},
+            eng.mappers)
+        assert spec is not None
+        specs.append(spec)
+    with pytest.raises(_FallbackSolo):
+        batched_knn_shard(ctxs, "vec", specs, 5)
+
+
 def test_classify_rejects_solo_only_shapes():
     """Eligibility mirrors choose_collector_context: anything the batched
     demux cannot reproduce byte-identically stays on the solo path."""
@@ -194,9 +331,6 @@ def test_classify_rejects_solo_only_shapes():
         {**base, "body": {**base["body"], "profile": True}},
         {**base, "body": {"query": {"match": {"body": {
             "query": "hello", "operator": "and"}}}}},
-        {**base, "body": {"query": {"knn": {
-            "field": "vec", "query_vector": [1, 0, 0, 0],
-            "filter": {"match": {"body": "x"}}}}}},
     ]
     for req in bad:
         assert classify_request(req, mappers) is None, req
@@ -209,6 +343,32 @@ def test_classify_rejects_solo_only_shapes():
         {**base, "body": {"query": {"knn": {
             "field": "vec", "query_vector": [1, 0, 0, 0]}}}},
         mappers).kind == "knn"
+    # filtered kNN is now batch-eligible: the filter becomes a mask
+    # inside the batched matmul; equal filters share one filter_key
+    spec_a = classify_request(
+        {**base, "body": {"query": {"knn": {
+            "field": "vec", "query_vector": [1, 0, 0, 0],
+            "filter": {"match": {"body": "x"}}}}}}, mappers)
+    spec_b = classify_request(
+        {**base, "body": {"query": {"knn": {
+            "field": "vec", "query_vector": [0, 1, 0, 0],
+            "filter": {"match": {"body": "x"}}}}}}, mappers)
+    assert spec_a is not None and spec_a.kind == "knn"
+    assert spec_a.filter is not None
+    assert spec_a.filter_key == spec_b.filter_key
+    # same batch key with or without a filter (they share the matmul)
+    assert spec_a.key() == classify_request(
+        {**base, "body": {"query": {"knn": {
+            "field": "vec", "query_vector": [1, 0, 0, 0]}}}},
+        mappers).key()
+    # unknown vector index types stay solo
+    unknown = MapperService({"properties": {"vec": {
+        "type": "dense_vector", "dims": 4,
+        "index_options": {"type": "hnsw"}}}})
+    assert classify_request(
+        {**base, "body": {"query": {"knn": {
+            "field": "vec", "query_vector": [1, 0, 0, 0]}}}},
+        unknown) is None
 
 
 # ---------------------------------------------------------------------------
@@ -275,11 +435,19 @@ def _concurrent_wave(c, bodies):
          [0.3 - 0.1 * j for j in range(8)]}}, "size": 5},
      {"query": {"knn": {"field": "vec", "k": 7, "query_vector":
          [0.05 * j for j in range(8)]}}, "size": 5}],
+    [{"query": {"knn": {"field": "vec", "k": 7, "query_vector":
+        [0.1 * j - 0.4 for j in range(8)],
+        "filter": {"match": {"body": "w0"}}}}, "size": 5},
+     {"query": {"knn": {"field": "vec", "k": 7, "query_vector":
+         [0.3 - 0.1 * j for j in range(8)],
+         "filter": {"match": {"body": "w1"}}}}, "size": 5},
+     {"query": {"knn": {"field": "vec", "k": 7, "query_vector":
+         [0.05 * j for j in range(8)]}}, "size": 5}],
     [{"query": {"text_expansion": {"feats": {"tokens": {
         f"f{j}": 1.0 + 0.1 * j for j in range(4)}}}}, "size": 5},
      {"query": {"text_expansion": {"feats": {"tokens": {
          f"f{j}": 2.0 - 0.2 * j for j in range(3)}}}}, "size": 5}],
-], ids=["text", "knn", "sparse"])
+], ids=["text", "knn", "knn_filtered", "sparse"])
 def test_concurrent_wave_batches_and_matches_solo(cluster, bodies):
     c = cluster
     batcher = c.nodes["node0"].search_transport.batcher
@@ -355,6 +523,147 @@ def test_msearch_lines_share_a_batch(cluster):
     assert batcher.stats["max_occupancy"] >= 3
 
 
+def test_memo_hits_fan_out_identical_plans(cluster):
+    """Members of one drain with an identical plan execute once; every
+    duplicate still gets its OWN context and a solo-identical response
+    (the per-drain memo is invisible outside the device)."""
+    c = cluster
+    sts = c.nodes["node0"].search_transport
+    batcher = sts.batcher
+    before = dict(batcher.stats)
+    reqs = [{"index": "bx", "shard": 0, "window": 5,
+             "body": {"query": {"match": {"body": "w0 w2"}}}}
+            for _ in range(4)]
+    reqs.append({"index": "bx", "shard": 0, "window": 5,
+                 "body": {"query": {"match": {"body": "w1"}}}})
+    deferreds = [batcher.try_enqueue(r) for r in reqs]
+    assert all(d is not None for d in deferreds)
+    key = next(iter(batcher._queues))
+    results = [None] * len(reqs)
+    for i, d in enumerate(deferreds):
+        d._subscribe(lambda v, i=i: results.__setitem__(i, ("ok", v)),
+                     lambda e, i=i: results.__setitem__(i, ("err", e)))
+    batcher._drain(key)
+    assert all(r is not None for r in results)
+    # 4 identical plans -> 1 execution + 3 memo hits
+    assert batcher.stats["memo_hits"] == before["memo_hits"] + 3
+    context_ids = set()
+    for i, (kind, payload) in enumerate(results):
+        assert kind == "ok", payload
+        context_ids.add(payload["context_id"])
+        solo = sts._execute_query_solo(dict(reqs[i]))
+        assert payload["docs"] == solo["docs"]
+        assert payload["total"] == solo["total"]
+        assert payload["relation"] == solo["relation"]
+        assert payload["prune"] == solo["prune"]
+    # every member pins its own reader context (fetch pops it)
+    assert len(context_ids) == len(reqs)
+
+
+def test_occupancy_feedback_grows_and_shrinks_window(cluster):
+    """Full drains (>= search.batch.target_occupancy live members) grow
+    the key's collection window toward max_window_ms; thin drains shrink
+    it back. The controller state lives in the per-key stats."""
+    c = cluster
+    batcher = c.nodes["node0"].search_transport.batcher
+    before = dict(batcher.stats)
+    cap = batcher.max_window_s()
+    target = batcher.target_occupancy()
+
+    def drain_wave(n):
+        reqs = [{"index": "bx", "shard": 0, "window": 9,
+                 "body": {"query": {"match": {"body": f"w{i} w0"}}}}
+                for i in range(n)]
+        deferreds = [batcher.try_enqueue(r) for r in reqs]
+        assert all(d is not None for d in deferreds)
+        key = next(k for k, q in batcher._queues.items() if q)
+        batcher._drain(key)
+        return key
+
+    key = drain_wave(target)
+    w_full = batcher._key_state[key]["window"]
+    assert batcher.stats["window_grows"] == before["window_grows"] + 1
+    assert cap / 4.0 < w_full <= cap
+    key2 = drain_wave(target)
+    assert key2 == key
+    w_full2 = batcher._key_state[key]["window"]
+    assert w_full2 >= w_full
+    key3 = drain_wave(1)
+    assert key3 == key
+    w_thin = batcher._key_state[key]["window"]
+    assert w_thin < w_full2
+    assert batcher.stats["window_shrinks"] > before["window_shrinks"]
+    assert w_thin >= cap / 16.0
+
+
+def test_rrf_fuser_coalesces_same_tick_submissions(cluster):
+    """Concurrent hybrid fusions submitted in the same scheduler tick
+    fuse in ONE rrf_fuse_batch device dispatch."""
+    c = cluster
+    fuser = c.nodes["node0"].search_action.rrf_fuser
+    before = dict(fuser.stats)
+    got = []
+    fuser.submit([[0, 1], [1, 0]], 2, 60, got.append)
+    fuser.submit([[0, 1, 2], [2, 1, 0]], 3, 60, got.append)
+    c.run_until(lambda: len(got) == 2, 30.0)
+    assert fuser.stats["rrf_fuse_batches"] == \
+        before["rrf_fuse_batches"] + 1
+    assert fuser.stats["rrf_fuse_requests"] == \
+        before["rrf_fuse_requests"] + 2
+    assert fuser.stats["rrf_fuse_max_occupancy"] >= 2
+    # the device program returned every scored doc of each request
+    assert sorted(got[0]) == [0, 1]
+    assert sorted(got[1]) == [0, 1, 2]
+
+
+def test_concurrent_hybrid_rrf_waves_match_solo(cluster):
+    """RRF retriever legs dispatch THROUGH the batcher (legs of
+    concurrent hybrid requests coalesce per kind) and the fused response
+    is byte-identical to the batching-disabled path."""
+    c = cluster
+    batcher = c.nodes["node0"].search_transport.batcher
+    fuser = c.nodes["node0"].search_action.rrf_fuser
+    before = dict(batcher.stats)
+    fbefore = dict(fuser.stats)
+    bodies = [
+        {"size": 5, "query": {"match": {"body": "w0 w3"}},
+         "knn": {"field": "vec", "k": 9,
+                 "query_vector": [0.1 * j - 0.3 for j in range(8)]},
+         "rank": {"rrf": {"rank_window_size": 15}}},
+        {"size": 5, "query": {"match": {"body": "w1 w2"}},
+         "knn": {"field": "vec", "k": 9,
+                 "query_vector": [0.2 - 0.05 * j for j in range(8)]},
+         "rank": {"rrf": {"rank_window_size": 15}}},
+    ]
+    batched = _concurrent_wave(c, bodies)
+    for resp, err in batched:
+        assert err is None, err
+        assert resp["hits"]["hits"]
+    # the requests' legs coalesced per kind on the data node
+    assert batcher.stats["batches_dispatched"] > \
+        before["batches_dispatched"]
+    assert batcher.stats["max_occupancy"] >= 2
+    # fusion went through the device batcher
+    assert fuser.stats["rrf_fuse_requests"] >= \
+        fbefore["rrf_fuse_requests"] + 2
+    assert fuser.stats["rrf_fuse_batches"] > fbefore["rrf_fuse_batches"]
+
+    _set_batch_enabled(c, "false")
+    try:
+        client = c.client()
+        fdisabled = dict(fuser.stats)
+        for body, (resp, _err) in zip(bodies, batched):
+            solo = _ok(*c.call(lambda cb, b=body: client.search(
+                "bx", b, cb)))
+            assert solo["hits"] == resp["hits"]
+            assert solo["_shards"] == resp["_shards"]
+        # disabled = the host fused alone, no device dispatches
+        assert fuser.stats["rrf_fuse_batches"] == \
+            fdisabled["rrf_fuse_batches"]
+    finally:
+        _set_batch_enabled(c, None)
+
+
 # ---------------------------------------------------------------------------
 # chaos: deadline expiry + cancellation inside a batch
 # ---------------------------------------------------------------------------
@@ -415,6 +724,54 @@ def test_deadline_expiry_and_cancel_mid_batch(cluster, seed):
     assert TaskCancelledError is not None
 
 
+@pytest.mark.parametrize("seed", [83 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_deadline_and_cancel_mid_filtered_knn_batch(cluster, seed):
+    """The new filtered-kNN batch path honors per-member deadline and
+    cancellation semantics exactly like the text path: dead members fail
+    individually, survivors match solo byte-for-byte."""
+    c = cluster
+    rng = np.random.default_rng(seed)
+    sts = c.nodes["node0"].search_transport
+    batcher = sts.batcher
+    n = 4
+    reqs = [{"index": "bx", "shard": 0, "window": 5,
+             "body": {"query": {"knn": {
+                 "field": "vec", "k": 6,
+                 "query_vector": [float(x) for x in
+                                  rng.standard_normal(8)],
+                 "filter": {"match": {"body": f"w{int(rng.integers(0, 5))}"
+                                      }}}}}}
+            for _ in range(n)]
+    expired_i = int(rng.integers(0, n))
+    cancelled_i = int((expired_i + 1 + rng.integers(0, n - 1)) % n)
+    reqs[expired_i]["budget_remaining"] = 0.0
+
+    deferreds = [batcher.try_enqueue(r) for r in reqs]
+    assert all(d is not None for d in deferreds)
+    key = next(iter(batcher._queues))
+    members = list(batcher._queues[key])
+    assert len(members) == n
+    members[cancelled_i].task.cancel("chaos cancel")
+
+    results = [None] * n
+    for i, d in enumerate(deferreds):
+        d._subscribe(lambda v, i=i: results.__setitem__(i, ("ok", v)),
+                     lambda e, i=i: results.__setitem__(i, ("err", e)))
+    batcher._drain(key)
+    assert all(r is not None for r in results)
+    for i, (kind, payload) in enumerate(results):
+        if i == expired_i:
+            assert kind == "err" and "budget expired" in str(payload)
+        elif i == cancelled_i:
+            assert kind == "err" and "cancelled" in str(payload)
+        else:
+            assert kind == "ok", payload
+            solo = sts._execute_query_solo(dict(reqs[i]))
+            assert payload["docs"] == solo["docs"]
+            assert payload["total"] == solo["total"]
+            assert payload["relation"] == solo["relation"]
+
+
 @pytest.mark.slow
 def test_chaos_sweep_mid_batch_failures():
     """>=5-seed CI sweep of the mid-batch deadline/cancel case
@@ -463,3 +820,13 @@ def test_batch_stats_surface_in_node_stats(cluster):
     assert sb["queries_dispatched"] >= 2
     assert sb["mean_occupancy"] >= 1.0
     assert "mean_wait_ms" in sb
+    # per-drain memo: the identical wave above dedups to one execution
+    assert sb["memo_hits"] >= 1
+    assert sb["memo_hit_rate"] > 0.0
+    # occupancy-feedback controller counters
+    assert "window_grows" in sb and "window_shrinks" in sb
+    assert "knn_shared_mask_segments" in sb
+    # coordinator-side RRF fusion batching counters ride the same block
+    assert "rrf_fuse_batches" in sb
+    assert "rrf_fuse_fallbacks" in sb
+    assert "mean_rrf_fuse_occupancy" in sb

@@ -180,6 +180,150 @@ def test_cancel_replaceable_recovery_moves_to_rejoined_copy_holder():
     assert new_replica.failed_attempts == initializing.failed_attempts
 
 
+def test_expected_data_nodes_releases_grace_immediately():
+    """gateway.expected_data_nodes (dynamic): once the configured member
+    count has joined AND reported in, a no-copy-anywhere shard falls
+    back to an empty allocation immediately instead of waiting out the
+    30s EXISTING_COPY_GRACE clock. Below the count (or with the setting
+    unset / 0) the clock stays authoritative."""
+    from dataclasses import replace
+
+    from elasticsearch_tpu.cluster.allocation import AllocationService
+    from elasticsearch_tpu.cluster.metadata import IndexMetadata, Metadata
+    from elasticsearch_tpu.cluster.routing import (
+        IndexRoutingTable, RoutingTable,
+    )
+    from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode
+    from elasticsearch_tpu.gateway import GatewayAllocator
+    from elasticsearch_tpu.indices.indices_service import IndicesService
+    from elasticsearch_tpu.transport.scheduler import DeterministicScheduler
+    from elasticsearch_tpu.transport.transport import (
+        InMemoryTransport, TransportService,
+    )
+
+    scheduler = DeterministicScheduler(seed=3)
+    ts = TransportService("master", InMemoryTransport(scheduler))
+    ga = GatewayAllocator("master", ts, IndicesService(), ClusterState)
+    allocation = AllocationService()
+
+    meta = IndexMetadata.create("i", number_of_shards=1,
+                                number_of_replicas=0)
+    irt = IndexRoutingTable.new("i", 1, 0)
+    (primary,) = irt.shard_group(0)
+    shard = replace(primary, last_allocation_id="lost-copy")
+
+    def make_state(expected=None):
+        md = Metadata().put_index(meta)
+        if expected is not None:
+            md = md.with_persistent_settings(
+                {"gateway.expected_data_nodes": expected})
+        return ClusterState(
+            nodes={n: DiscoveryNode(node_id=n) for n in ("n1", "n2")},
+            metadata=md,
+            routing_table=RoutingTable(indices={"i": irt}))
+
+    # every data node has reported in: no copy anywhere
+    ga._cache[("i", 0)] = {
+        n: {"node": n, "live": False, "has_data": False,
+            "allocation_id": None, "corrupted": None}
+        for n in ("n1", "n2")}
+
+    # setting unset: the grace clock holds the shard back
+    verdict, _ = ga.decide_unassigned(shard, make_state(), allocation)
+    assert verdict == "wait"
+
+    # fleet complete (2 expected, 2 reported): release immediately
+    verdict, reason = ga.decide_unassigned(shard, make_state(2),
+                                           allocation)
+    assert verdict == "fallback"
+    assert "no on-disk copy" in (reason or "")
+    assert ga.stats["grace_released_fleet_complete"] == 1
+
+    # fleet NOT complete (3 expected, 2 in): the clock applies again
+    verdict, _ = ga.decide_unassigned(shard, make_state(3), allocation)
+    assert verdict == "wait"
+
+
+def test_fresh_master_soft_marks_do_not_blip_health():
+    """A freshly-elected master has no prior ephemeral observations, so
+    it marks every STARTED copy unverified — but SOFTLY: verification
+    fetches run in the background and cluster health keeps green until
+    a fetch response actually reports the copy not-live (the mark then
+    hardens). A reboot observed by a sitting master stays a hard mark
+    (the reboot window is not reopened)."""
+    from types import SimpleNamespace
+
+    from elasticsearch_tpu.action.admin import cluster_health
+    from elasticsearch_tpu.cluster.coordination import Mode
+    from elasticsearch_tpu.cluster.metadata import IndexMetadata, Metadata
+    from elasticsearch_tpu.cluster.routing import (
+        IndexRoutingTable, RoutingTable,
+    )
+    from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode
+    from elasticsearch_tpu.gateway import (
+        GATEWAY_STARTED_SHARDS, GatewayAllocator,
+    )
+    from elasticsearch_tpu.indices.indices_service import IndicesService
+    from elasticsearch_tpu.transport.scheduler import DeterministicScheduler
+    from elasticsearch_tpu.transport.transport import (
+        InMemoryTransport, TransportService,
+    )
+
+    scheduler = DeterministicScheduler(seed=5)
+    transport = InMemoryTransport(scheduler)
+    ts = TransportService("master", transport)
+    data_ts = TransportService("n1", transport)
+    # the data node's answer: holds a commit, not re-opened yet
+    # (in-place recovery in progress) — a NOT-LIVE response
+    def on_list(req, sender):
+        return {"shards": {f"{s['index']}:{s['shard']}": {
+            "node": "n1", "live": False, "has_data": True,
+            "allocation_id": "aid", "corrupted": None,
+            "verified": False} for s in req["shards"]}}
+    data_ts.register_handler(GATEWAY_STARTED_SHARDS, on_list)
+
+    meta = IndexMetadata.create("i", number_of_shards=1,
+                                number_of_replicas=0)
+    irt = IndexRoutingTable.new("i", 1, 0)
+    (primary,) = irt.shard_group(0)
+    irt = irt.replace_shard(primary, primary.initialize("n1").start())
+    state = ClusterState(
+        nodes={"n1": DiscoveryNode(node_id="n1", ephemeral_id="e1")},
+        metadata=Metadata().put_index(meta),
+        routing_table=RoutingTable(indices={"i": irt}))
+
+    ga = GatewayAllocator("master", ts, IndicesService(), lambda: state)
+    ga.coordinator = SimpleNamespace(mode=Mode.LEADER)
+
+    # fresh master: first committed state → SOFT marks, health green
+    ga.cluster_changed(state)
+    assert ga._unverified
+    assert all(e.get("soft") for e in ga._unverified.values())
+    assert ga.health_unverified() == []
+    assert cluster_health(
+        state, unverified=ga.health_unverified())["status"] == "green"
+    assert ga.stats_snapshot()["unverified_soft"] == 1
+
+    # first not-live fetch RESPONSE lands: the mark hardens and now
+    # vetoes health exactly like a reboot-observed mark
+    scheduler.run_for(1.0)
+    assert ga._unverified
+    assert not any(e.get("soft") for e in ga._unverified.values())
+    assert len(ga.health_unverified()) == 1
+    assert cluster_health(
+        state, unverified=ga.health_unverified())["status"] != "green"
+
+    # a reboot observed by this (now sitting) master: hard immediately
+    ga._unverified.clear()
+    state2 = ClusterState(
+        nodes={"n1": DiscoveryNode(node_id="n1", ephemeral_id="e2")},
+        metadata=state.metadata, routing_table=state.routing_table)
+    ga.cluster_changed(state2)
+    assert ga._unverified
+    assert not any(e.get("soft") for e in ga._unverified.values())
+    assert len(ga.health_unverified()) == 1
+
+
 def test_replica_reuse_refused_for_stale_term_commit(tmp_path):
     """The recovery source's reuse gate must refuse a commit written
     under an OLDER primary term even when every seqno watermark matches:
