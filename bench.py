@@ -384,8 +384,10 @@ def cfg_ivf(np, jax, jnp, result):
     from elasticsearch_tpu.ops.ivf import IVFIndex
 
     # full scale = the GIST1M envelope (1M x 960 f32 = 3.7GB, HBM-resident
-    # on one chip); CPU fallback shrinks 32x to keep the oracle tractable
-    n_docs, dims, n_q = scaled(1 << 20, factor=32), 960, 128
+    # on one chip); CPU fallback shrinks 16x — past the old 32768-doc
+    # single-segment corpus, so the fallback measures a multi-list-probe
+    # regime instead of a toy
+    n_docs, dims, n_q = scaled(1 << 20, factor=16), 960, 128
     n_clusters = 1024
     rng = np.random.default_rng(SEED)
     means = rng.standard_normal((n_clusters, dims)).astype(np.float32)
@@ -691,6 +693,232 @@ def cfg_sparse(np, jax, jnp, result):
             f"{type(e).__name__}: {e}"[:200]
 
 
+def cfg_segmented(np, jax, jnp, result):
+    """Segmented-corpus scenario: the SAME corpus packed as 1/4/16/32
+    segments, per-segment dispatch loop vs the packed multi-segment plane
+    (ops/device_segment.py) for bm25 / ivf / sparse — the launch-count
+    win measured directly. Reports device_dispatches_per_query for both
+    paths; the plane's dispatches are independent of segment count, so
+    its QPS at 16+ segments should stay within 1.25x of 1 segment."""
+    from elasticsearch_tpu.index.segment import (
+        FeaturesField, Segment, postings_from_token_matrix,
+    )
+    from elasticsearch_tpu.ops.bm25 import (
+        Bm25Executor, QueryPlan, dispatch_flat, idf,
+    )
+    from elasticsearch_tpu.ops.device_segment import (
+        PLANES, DeviceFeatures, DevicePostings,
+    )
+    from elasticsearch_tpu.ops.ivf import IVFIndex
+    from elasticsearch_tpu.ops.sparse import SparseExecutor, sparse_topk_batch
+
+    n_docs, vocab, dims = scaled(1 << 18, factor=4), 2000, 128
+    n_q, iters = 32, 4
+    rng = np.random.default_rng(SEED + 9)
+    lens = rng.integers(12, 32, n_docs)
+    toks = (rng.zipf(1.35, size=(n_docs, 32)) - 1)
+    toks = np.where(toks < vocab, toks, toks % vocab).astype(np.int32)
+    toks[np.arange(32)[None, :] >= lens[:, None]] = -1
+    corpus = rng.standard_normal((n_docs, dims)).astype(np.float32)
+    text_queries = zipf_queries(np, n_q, vocab)
+    vec_queries = rng.standard_normal((n_q, dims)).astype(np.float32)
+    block = jax.block_until_ready
+
+    old_min = PLANES.min_segments
+    PLANES.min_segments = 1          # a 1-segment plane is the baseline
+    out = {}
+    try:
+        for n_seg in (1, 4, 16, 32):
+            bounds = np.linspace(0, n_docs, n_seg + 1).astype(int)
+            segs = []
+            for si in range(n_seg):
+                lo, hi = int(bounds[si]), int(bounds[si + 1])
+                seg = Segment(f"bench{n_seg}_{si}", hi - lo)
+                pf = postings_from_token_matrix(toks[lo:hi])
+                seg.postings["body"] = pf
+                w = np.where(pf.block_docs >= 0,
+                             rng.random(pf.block_tfs.shape,
+                                        np.float32) * 3.0, 0.0)
+                seg.features["feats"] = FeaturesField(
+                    features={f"t{i}": i for i in range(len(pf.doc_freq))},
+                    block_docs=pf.block_docs,
+                    block_weights=w.astype(np.float32),
+                    block_max_weight=w.max(axis=1).astype(np.float32),
+                    feat_block_start=pf.term_block_start,
+                    feat_block_count=pf.term_block_count,
+                    doc_freq=pf.doc_freq)
+                segs.append(seg)
+            entry = {}
+
+            # ---- bm25 (unpruned single-phase, clean dispatch counting)
+            per_ex = [Bm25Executor(DevicePostings(s.postings["body"],
+                                                  s.n_docs),
+                                   s.postings["body"], n_docs)
+                      for s in segs]
+            lives = [jnp.ones((e.dev.n_docs_pad,), bool) for e in per_ex]
+
+            def bm25_per_seg():
+                outs = [e.top_k_batch(text_queries, lv, K, prune=False)
+                        for e, lv in zip(per_ex, lives)]
+                block(outs[-1][0])
+                return outs
+
+            part = PLANES.get(segs, "postings", "body")
+            plane_live = part.live_mask([np.ones(s.n_docs, bool)
+                                         for s in segs])
+            plans = []
+            for terms in text_queries:
+                seg_plans = []
+                for (pos, pf, bb, _avg) in part.refs:
+                    idxs, ws = [], []
+                    for t, qtf in _counts(terms).items():
+                        ti = pf.term_block_idx(t)
+                        if not len(ti):
+                            continue
+                        df = int(pf.doc_freq[pf.terms[t]])
+                        idxs.append(ti)
+                        ws.append(np.full(len(ti),
+                                          idf(n_docs, df) * qtf,
+                                          np.float32))
+                    i = np.concatenate(idxs) if idxs else \
+                        np.zeros(0, np.int32)
+                    w = np.concatenate(ws) if ws else \
+                        np.zeros(0, np.float32)
+                    z = np.zeros(len(i))
+                    seg_plans.append(QueryPlan(i, w, z, z))
+                plans.append(QueryPlan.concat(
+                    seg_plans, idx_offsets=[bb for _p, _f, bb, _a
+                                            in part.refs]))
+
+            def bm25_plane(counter=None):
+                got = dispatch_flat(part.block_docs, part.block_tfs,
+                                    part.doc_lens, part.n_docs_pad,
+                                    plans, plane_live, K, 1.2, 0.75,
+                                    block_avgdl=part.block_avgdl,
+                                    counter=counter)
+                block(got[0])
+                return got
+
+            # MEASURED dispatch count (dispatch_flat may chunk on
+            # MAX_BATCH_CELLS / MAX_CHUNK_Q), not an asserted constant
+            plane_counter: list = []
+            bm25_plane(counter=plane_counter)
+            t_seg = timed(bm25_per_seg, iters, lambda _x: None)
+            t_pl = timed(bm25_plane, iters, lambda _x: None)
+            entry["bm25"] = {
+                "qps_per_segment": round(iters * n_q / t_seg, 2),
+                "qps_plane": round(iters * n_q / t_pl, 2),
+                "device_dispatches_per_query_per_segment": n_seg,
+                "device_dispatches_per_query_plane": len(plane_counter),
+            }
+
+            # ---- ivf (per-segment indexes+probes vs one shard index)
+            seg_ivf = [IVFIndex.build(corpus[int(bounds[i]):
+                                             int(bounds[i + 1])],
+                                      similarity="cosine", seed=7)
+                       for i in range(n_seg)]
+            plane_ivf = seg_ivf[0] if n_seg == 1 else \
+                IVFIndex.build(corpus, similarity="cosine", seed=7)
+            q_dev = jnp.asarray(vec_queries)
+            nprobe = 16
+
+            def ivf_per_seg():
+                outs = [ix.search_device(q_dev, K, nprobe=nprobe)
+                        for ix in seg_ivf]
+                block(outs[-1][0])
+                return outs
+
+            def ivf_plane():
+                got = plane_ivf.search_device(q_dev, K, nprobe=nprobe)
+                block(got[0])
+                return got
+
+            t_seg = timed(ivf_per_seg, iters, lambda _x: None)
+            t_pl = timed(ivf_plane, iters, lambda _x: None)
+            entry["ivf"] = {
+                "qps_per_segment": round(iters * n_q / t_seg, 2),
+                "qps_plane": round(iters * n_q / t_pl, 2),
+                "device_dispatches_per_query_per_segment": n_seg,
+                "device_dispatches_per_query_plane": 1,
+            }
+
+            # ---- sparse (per-segment batched scorer vs feature plane)
+            expansions = [[(f"t{i}", float(rng.random() + 0.5))
+                           for i in np.minimum(
+                               rng.zipf(1.35, size=4) - 1, vocab - 1)]
+                          for _ in range(n_q)]
+            per_sp = [SparseExecutor(DeviceFeatures(s.features["feats"],
+                                                    s.n_docs),
+                                     s.features["feats"]) for s in segs]
+            sp_lives = [jnp.ones((e.dev.n_docs_pad,), bool)
+                        for e in per_sp]
+
+            def sparse_per_seg():
+                outs = [e.top_k_batch(expansions, lv, K,
+                                      function="linear")
+                        for e, lv in zip(per_sp, sp_lives)]
+                block(outs[-1][0])
+                return outs
+
+            fpart = PLANES.get(segs, "features", "feats")
+            f_live = fpart.live_mask([np.ones(s.n_docs, bool)
+                                      for s in segs])
+            from elasticsearch_tpu.index.segment import next_pow2
+            per = []
+            for expansion in expansions:
+                ip, wp = [], []
+                for (_pos, ff, bb) in fpart.refs:
+                    for name, weight in expansion:
+                        ti = ff.feature_block_idx(name)
+                        if len(ti):
+                            ip.append(ti + np.int32(bb))
+                            wp.append(np.full(len(ti), weight,
+                                              np.float32))
+                per.append((np.concatenate(ip) if ip else
+                            np.zeros(0, np.int32),
+                            np.concatenate(wp) if wp else
+                            np.zeros(0, np.float32)))
+            qb_pad = next_pow2(max((len(i) for i, _ in per), default=1),
+                               minimum=8)
+            qn = next_pow2(n_q, minimum=1)
+            sp_idx = np.zeros((qn, qb_pad), np.int32)
+            sp_w = np.zeros((qn, qb_pad), np.float32)
+            for i, (bi, bw) in enumerate(per):
+                sp_idx[i, : len(bi)] = bi
+                sp_w[i, : len(bw)] = bw
+            sp_idx_dev, sp_w_dev = jnp.asarray(sp_idx), jnp.asarray(sp_w)
+
+            def sparse_plane():
+                got = sparse_topk_batch(
+                    fpart.block_docs, fpart.block_weights, sp_idx_dev,
+                    sp_w_dev, jnp.float32(1.0), jnp.float32(1.0),
+                    f_live, fpart.n_docs_pad, K, "linear")
+                block(got[0])
+                return got
+
+            t_seg = timed(sparse_per_seg, iters, lambda _x: None)
+            t_pl = timed(sparse_plane, iters, lambda _x: None)
+            entry["sparse"] = {
+                "qps_per_segment": round(iters * n_q / t_seg, 2),
+                "qps_plane": round(iters * n_q / t_pl, 2),
+                "device_dispatches_per_query_per_segment": n_seg,
+                "device_dispatches_per_query_plane": 1,
+            }
+            out[str(n_seg)] = entry
+    finally:
+        PLANES.min_segments = old_min
+        PLANES.clear()
+
+    # segment-count invariance: plane QPS at n segments vs 1 segment
+    for klass in ("bm25", "ivf", "sparse"):
+        base = out.get("1", {}).get(klass, {}).get("qps_plane", 0.0)
+        for n_seg, entry in out.items():
+            if base and klass in entry:
+                entry[klass]["plane_vs_1seg"] = round(
+                    entry[klass]["qps_plane"] / base, 3)
+    result["configs"]["segmented"] = {"n_docs": n_docs, "per_count": out}
+
+
 # ---------------------------------------------------------------------------
 
 def main() -> None:
@@ -741,7 +969,8 @@ def main() -> None:
         bm25_ctx = None
         for name, fn in (("knn", cfg_knn), ("bm25", cfg_bm25),
                          ("ivf", cfg_ivf), ("hybrid", cfg_hybrid),
-                         ("sparse", cfg_sparse)):
+                         ("sparse", cfg_sparse),
+                         ("segmented", cfg_segmented)):
             try:
                 if name == "hybrid":
                     fn(np, jax, jnp, result, knn_corpus, bm25_ctx)

@@ -57,6 +57,10 @@ DELETE_CUSTOM = "cluster:admin/xpack/custom/delete"
 REROUTE = "cluster:admin/reroute"
 REFRESH_SHARD = "indices:admin/refresh[s]"
 NODE_STATS_ACTION = "cluster:monitor/nodes/stats[n]"
+# master-routed cluster health: the unverified-STARTED gate lives on the
+# elected master only, so non-master health requests forward here (the
+# reference's TransportClusterHealthAction is a master-node action)
+CLUSTER_HEALTH_ACTION = "cluster:monitor/health[m]"
 FLUSH_SHARD = "indices:admin/flush[s]"
 FORCEMERGE_SHARD = "indices:admin/forcemerge[s]"
 STATS_SHARD = "indices:monitor/stats[s]"

@@ -223,6 +223,12 @@ class SearchTransportService:
 
     def _on_query(self, req: Dict[str, Any], sender: str):
         self._reap()
+        # refresh the plane registry's dynamic config from committed
+        # cluster settings (search.plane.*) — cheap reads, and the solo
+        # and batched paths below both consult the registry
+        if self.state is not None:
+            from elasticsearch_tpu.ops.device_segment import PLANES
+            PLANES.configure_from_state(self.state())
         # micro-batching intake: eligible queries queue for a shared
         # batched device dispatch and answer through a Deferred; anything
         # the batcher cannot serve byte-identically falls through to the

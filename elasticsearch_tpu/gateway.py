@@ -205,11 +205,12 @@ class GatewayAllocator:
         the copy so it reallocates; until verified, cluster health must
         not claim green (health_unverified).
 
-    Scope notes: the unverified-copy health gate is authoritative on the
-    ELECTED MASTER only (like the reference, where _cluster/health is a
-    master-node action) — a non-master node's locally-computed health
-    cannot see the marks and may still say green during the verify
-    window. And a freshly-elected master marks every STARTED copy
+    Scope notes: the unverified-copy marks live on the ELECTED MASTER
+    only, so ``_cluster/health`` is a master-routed action
+    (Client.cluster_health_async forwards non-master requests over
+    transport, like the reference's TransportClusterHealthAction) — the
+    gate is authoritative cluster-wide; a node's locally-computed sync
+    health remains a local view. And a freshly-elected master marks every STARTED copy
     unverified on its first committed state (it has no prior ephemeral
     observations), so routine failovers flash health not-green for about
     one fetch round trip until the live answers land — conservative by

@@ -454,6 +454,19 @@ class InternalEngine:
         else:
             merged = merge_segments(name, to_merge, self.mappers)
         self.segments = _insert_merged(merged, self.segments, to_merge)
+        # merged-away segments are dead to every FUTURE reader (the plane
+        # registry keys on segment uids): free their device planes now
+        # instead of leaving the HBM to LRU pressure. A still-open scroll
+        # over the pre-merge snapshot will transparently re-pack its
+        # plane on its next query — rare, correct, and cheaper than
+        # pinning a superseded plane for every merge
+        import sys
+        mod = sys.modules.get("elasticsearch_tpu.ops.device_segment")
+        if mod is not None:
+            try:
+                mod.PLANES.drop_segments(seg.uid for seg in to_merge)
+            except Exception:  # noqa: BLE001 — cleanup must not fail merge
+                logger.exception("plane invalidation after merge failed")
         return True
 
     def _merge_sorted(self, name: str, to_merge: List[Segment]) -> Segment:

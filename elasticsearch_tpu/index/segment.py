@@ -80,6 +80,15 @@ class PostingsField:
     # (FIFO) to stop unbounded growth on long-lived segments.
     _impact_cache: Dict[Tuple[float, float, float], np.ndarray] = field(
         default_factory=dict, repr=False, compare=False)
+    # term -> int32 gather indices of the term's posting blocks. The block
+    # layout is immutable, so the lists change only when the segment is
+    # replaced (a refresh/merge publishes a NEW PostingsField) — caching
+    # here is exactly "per (reader generation, field, term)". FIFO-bounded:
+    # high-cardinality query streams must not grow it without limit.
+    _term_idx_cache: Dict[str, np.ndarray] = field(
+        default_factory=dict, repr=False, compare=False)
+
+    TERM_IDX_CACHE_CAP = 4096
 
     @property
     def n_terms(self) -> int:
@@ -97,6 +106,19 @@ class PostingsField:
         if tid is None:
             return (0, 0)
         return int(self.term_block_start[tid]), int(self.term_block_count[tid])
+
+    def term_block_idx(self, term: str) -> np.ndarray:
+        """int32 gather indices of the term's posting blocks, cached on the
+        immutable field so per-query host prep (gather_query_blocks, plane
+        gathers) stops rebuilding the same lists between refreshes."""
+        got = self._term_idx_cache.get(term)
+        if got is None:
+            start, count = self.term_blocks(term)
+            got = np.arange(start, start + count, dtype=np.int32)
+            while len(self._term_idx_cache) >= self.TERM_IDX_CACHE_CAP:
+                self._term_idx_cache.pop(next(iter(self._term_idx_cache)))
+            self._term_idx_cache[term] = got
+        return got
 
     def postings_for(self, term: str) -> Tuple[np.ndarray, np.ndarray]:
         """(doc_ids, tfs) for a term, unpadded, host-side."""
@@ -199,12 +221,30 @@ class FeaturesField:
     feat_block_start: np.ndarray
     feat_block_count: np.ndarray
     doc_freq: np.ndarray
+    # feature -> int32 gather indices of its blocks, FIFO-bounded — the
+    # same immutable-layout cache as PostingsField._term_idx_cache
+    _feat_idx_cache: Dict[str, np.ndarray] = field(
+        default_factory=dict, repr=False, compare=False)
+
+    FEAT_IDX_CACHE_CAP = 4096
 
     def feature_blocks(self, name: str) -> Tuple[int, int]:
         fid = self.features.get(name)
         if fid is None:
             return (0, 0)
         return int(self.feat_block_start[fid]), int(self.feat_block_count[fid])
+
+    def feature_block_idx(self, name: str) -> np.ndarray:
+        """int32 gather indices of the feature's blocks (cached; the block
+        layout is immutable — same contract as PostingsField.term_block_idx)."""
+        got = self._feat_idx_cache.get(name)
+        if got is None:
+            start, count = self.feature_blocks(name)
+            got = np.arange(start, start + count, dtype=np.int32)
+            while len(self._feat_idx_cache) >= self.FEAT_IDX_CACHE_CAP:
+                self._feat_idx_cache.pop(next(iter(self._feat_idx_cache)))
+            self._feat_idx_cache[name] = got
+        return got
 
 
 _SEGMENT_UID = itertools.count(1)

@@ -83,6 +83,20 @@ class IndexShard:
             "query_total": 0, "wand_queries": 0,
             "wand_blocks_total": 0, "wand_blocks_scored": 0,
             "request_cache_hits": 0, "request_cache_misses": 0}
+        # refresh publishes into the packed device plane: an append-only
+        # refresh re-packs any resident plane incrementally so the NEXT
+        # query doesn't pay the upload (ops/device_segment.PlaneRegistry)
+        self.engine.refresh_listeners.append(self._publish_plane)
+
+    def _publish_plane(self) -> None:
+        import sys
+        if "elasticsearch_tpu.ops.device_segment" not in sys.modules:
+            return      # no device work yet in this process
+        try:
+            from elasticsearch_tpu.ops.device_segment import PLANES
+            PLANES.on_refresh(self.engine.segments)
+        except Exception:  # noqa: BLE001 — publication is an optimization
+            pass
 
     def _enter_primary_mode(self) -> None:
         self.primary = True

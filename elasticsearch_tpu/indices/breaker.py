@@ -29,8 +29,8 @@ from typing import Any, Dict, Optional
 
 from elasticsearch_tpu.utils.errors import CircuitBreakingError
 
-__all__ = ["ChildBreaker", "HierarchyCircuitBreakerService", "BREAKERS",
-           "account_device_arrays", "charge_device"]
+__all__ = ["ChildBreaker", "DeviceCharge", "HierarchyCircuitBreakerService",
+           "BREAKERS", "account_device_arrays", "charge_device"]
 
 GB = 1 << 30
 
@@ -155,24 +155,51 @@ class HierarchyCircuitBreakerService:
 BREAKERS = HierarchyCircuitBreakerService()
 
 
+class DeviceCharge:
+    """One accounted device allocation with an idempotent early release.
+
+    GC-driven release (the weakref finalizer charge_device installs)
+    remains the backstop, but an evicting cache (the plane registry's
+    breaker-pressure path) must be able to hand the budget back BEFORE
+    the last in-flight query drops its reference — otherwise the
+    evict-and-retry loop can never free enough to admit the new resident.
+    The transient undercount while an evicted-but-referenced array drains
+    is the point of eviction, not a leak: the finalizer then no-ops."""
+
+    __slots__ = ("_breaker", "n_bytes", "_released")
+
+    def __init__(self, breaker: ChildBreaker, n_bytes: int):
+        self._breaker = breaker
+        self.n_bytes = int(n_bytes)
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._breaker.release(self.n_bytes)
+
+
 def charge_device(owner: Any, n_bytes: int, label: str,
                   service: Optional[HierarchyCircuitBreakerService]
-                  = None) -> int:
+                  = None, return_charge: bool = False):
     """Charge the ``device`` breaker for ``n_bytes`` about to go resident
     on device, tying the release to ``owner``'s lifetime via a weakref
     finalizer. Call BEFORE the upload (sizes are computable from the host
     arrays) — charging after the jnp.asarray would let the very allocation
-    that trips the breaker OOM the chip first."""
+    that trips the breaker OOM the chip first. ``return_charge=True``
+    returns the DeviceCharge handle for callers (eviction-driven caches)
+    that need to release ahead of GC."""
     svc = service or BREAKERS
     breaker = svc.breaker("device")
     breaker.add_estimate(int(n_bytes), label)
-    weakref.finalize(owner, breaker.release, int(n_bytes))
-    return int(n_bytes)
+    charge = DeviceCharge(breaker, n_bytes)
+    weakref.finalize(owner, charge.release)
+    return charge if return_charge else int(n_bytes)
 
 
 def account_device_arrays(owner: Any, arrays, label: str,
                           service: Optional[HierarchyCircuitBreakerService]
-                          = None) -> int:
+                          = None, return_charge: bool = False):
     """charge_device() with the byte count summed from host-side arrays
     (numpy ``nbytes``). Pass the HOST arrays before converting."""
     n_bytes = 0
@@ -181,4 +208,5 @@ def account_device_arrays(owner: Any, arrays, label: str,
         if nb is None and hasattr(a, "size") and hasattr(a, "dtype"):
             nb = a.size * a.dtype.itemsize
         n_bytes += int(nb or 0)
-    return charge_device(owner, n_bytes, label, service)
+    return charge_device(owner, n_bytes, label, service,
+                         return_charge=return_charge)

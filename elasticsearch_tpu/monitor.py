@@ -146,6 +146,20 @@ def device_stats() -> Dict[str, Any]:
     return out
 
 
+def device_plane_stats() -> Dict[str, Any]:
+    """Packed multi-segment plane observability (ops/device_segment.py
+    PlaneRegistry): full rebuilds vs incremental appends, evictions,
+    resident bytes per kind, the quantized coarse pass's re-rank depth,
+    and how often a missing/refused plane forced the per-segment
+    fallback. Never initializes the device layer itself — a node that
+    has served no device work reports an empty section."""
+    import sys
+    mod = sys.modules.get("elasticsearch_tpu.ops.device_segment")
+    if mod is None:
+        return {}
+    return mod.PLANES.stats_snapshot()
+
+
 def search_batch_stats(batcher, rrf_fuser=None) -> Dict[str, Any]:
     """Micro-batcher observability (search/batch_executor.py): dispatch /
     occupancy / wait-time counters plus the derived means operators watch

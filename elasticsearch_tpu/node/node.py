@@ -12,7 +12,8 @@ import logging
 from typing import Any, Callable, Dict, List, Optional
 
 from elasticsearch_tpu.action.admin import (
-    BroadcastActions, CLUSTER_UPDATE_SETTINGS, CREATE_INDEX, DELETE_INDEX,
+    BroadcastActions, CLUSTER_HEALTH_ACTION, CLUSTER_UPDATE_SETTINGS,
+    CREATE_INDEX, DELETE_INDEX,
     FLUSH_SHARD, FORCEMERGE_SHARD, MasterActions, MasterClient,
     NODE_STATS_ACTION, PUT_MAPPING,
     REFRESH_SHARD, STATS_SHARD, UPDATE_ALIASES, UPDATE_SETTINGS,
@@ -246,6 +247,24 @@ class Node:
         # handler): the coordinating node fans `_nodes/stats` out here
         self.transport_service.register_handler(
             NODE_STATS_ACTION, lambda req, sender: self.local_node_stats())
+        # master-routed health (TransportClusterHealthAction analog): the
+        # unverified-STARTED gate is master-only state, so every node
+        # answers health FROM the master's view, not its own
+        self.transport_service.register_handler(
+            CLUSTER_HEALTH_ACTION, self._on_cluster_health)
+
+    def _on_cluster_health(self, req: Dict[str, Any],
+                           sender: str) -> Dict[str, Any]:
+        """Answer ONLY while actually the elected master: a node a caller
+        still believes is master (stale applied state mid-election) must
+        error — the caller then takes its flagged local-view fallback —
+        rather than return a stale view dressed up as authoritative."""
+        if self.coordinator.mode != Mode.LEADER:
+            raise RuntimeError(
+                f"[{self.node_id}] is not the elected master")
+        return cluster_health(
+            self._applied_state(), req.get("index"),
+            unverified=self.gateway_allocator.health_unverified())
 
     # ------------------------------------------------------------------
 
@@ -269,6 +288,9 @@ class Node:
             "process": monitor.process_stats(),
             "fs": monitor.fs_stats(self.indices_service.data_path),
             "device": monitor.device_stats(),
+            # packed multi-segment plane residency/rebuild/eviction
+            # counters (ops/device_segment.py PlaneRegistry)
+            "device_plane": monitor.device_plane_stats(),
             # cross-query micro-batching occupancy/wait/dispatch/memo/
             # window-controller counters + coordinator RRF fusion batching
             "search_batch": monitor.search_batch_stats(
@@ -1064,10 +1086,53 @@ class NodeClient:
     def cluster_health(self, index: Optional[str] = None) -> Dict[str, Any]:
         # STARTED copies the (local, if master) gateway allocator hasn't
         # confirmed are actually hosted count against green: a rebooted
-        # node's stale routing must not hide a missing shard
+        # node's stale routing must not hide a missing shard. NOTE: the
+        # unverified marks live on the elected master only — REST health
+        # goes through cluster_health_async, which routes non-master
+        # requests to the master so the gate is authoritative
+        # cluster-wide; this sync form reports the LOCAL view.
         return cluster_health(
             self.node._applied_state(), index,
             unverified=self.node.gateway_allocator.health_unverified())
+
+    def cluster_health_async(self, index: Optional[str],
+                             on_done) -> None:
+        """Authoritative cluster health: computed on the ELECTED MASTER
+        (whose gateway allocator owns the unverified-STARTED marks), like
+        the reference's master-node health action — a non-master node can
+        no longer report green during the post-reboot verify window. Falls
+        back to the local view (flagged) only when no master is known or
+        the master doesn't answer."""
+        state = self.node._applied_state()
+        master = state.master_node_id
+
+        def local_flagged() -> None:
+            local = self.cluster_health(index)
+            local["master_routed"] = False
+            on_done(local, None)
+
+        if master == self.node.node_id:
+            # answer directly ONLY while actually leading: a deposed
+            # master whose applied state still names itself must not
+            # serve its stale view as authoritative
+            if self.node.coordinator.mode == Mode.LEADER:
+                on_done(self.cluster_health(index), None)
+            else:
+                local_flagged()
+            return
+        if master is None:
+            local_flagged()
+            return
+
+        def cb(resp, err):
+            if err is not None or resp is None:
+                local_flagged()
+            else:
+                on_done(resp, None)
+
+        self.node.transport_service.send_request(
+            master, CLUSTER_HEALTH_ACTION, {"index": index}, cb,
+            timeout=10.0)
 
     def cluster_state(self) -> Dict[str, Any]:
         return self.node._applied_state().to_dict()

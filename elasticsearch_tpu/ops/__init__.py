@@ -1,8 +1,10 @@
 from elasticsearch_tpu.ops.bm25 import Bm25Executor, bm25_block_scores, bm25_topk, idf
 from elasticsearch_tpu.ops.device_segment import (
+    PLANES,
     DeviceFeatures,
     DevicePostings,
     DeviceVectors,
+    PlaneRegistry,
     device_live_mask,
     gather_query_blocks,
 )
@@ -15,6 +17,8 @@ __all__ = [
     "DeviceFeatures",
     "DevicePostings",
     "DeviceVectors",
+    "PLANES",
+    "PlaneRegistry",
     "KnnExecutor",
     "SparseExecutor",
     "bm25_block_scores",

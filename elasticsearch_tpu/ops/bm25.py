@@ -96,7 +96,7 @@ def _bm25_flat_kernel(block_docs, block_tfs,
                       flat_idx,    # [FB] int32 block gather ids (0 pad)
                       flat_w,      # [FB] f32 idf*boost (0 pad)
                       flat_q,      # [FB] int32 query id (0 pad)
-                      doc_lens, avgdl, live,
+                      doc_lens, flat_avgdl, live,
                       n_docs_pad: int, n_q: int, k: int,
                       k1: float = DEFAULT_K1, b: float = DEFAULT_B,
                       counted: bool = False):
@@ -116,13 +116,18 @@ def _bm25_flat_kernel(block_docs, block_tfs,
     pruned dispatches yield a LOWER bound (dropped blocks aren't
     observed) — the counts-then-skip collector
     (TopDocsCollectorContext.java:215) uses it to prove
-    'total >= track_total_hits' without a dense pass."""
+    'total >= track_total_hits' without a dense pass.
+
+    ``flat_avgdl`` [FB] carries each gathered block's avgdl: one scalar
+    broadcast for a single-segment dispatch, the owning segment's value
+    per block when the gather spans a multi-segment plane — so plane
+    scores use exactly the per-segment length norm the solo path does."""
     docs = block_docs[flat_idx]             # [FB, BLOCK]
     tfs = block_tfs[flat_idx]               # [FB, BLOCK]
     valid = docs >= 0
     safe = jnp.where(valid, docs, 0)
     dl = doc_lens[safe]
-    norm = k1 * (1.0 - b + b * dl / avgdl)
+    norm = k1 * (1.0 - b + b * dl / flat_avgdl[:, None])
     contrib = flat_w[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
     contrib = jnp.where(valid, contrib, 0.0)
     # scatter into a [n_q, n_docs_pad] score plane via flattened targets
@@ -219,6 +224,23 @@ class QueryPlan:
         order = np.argsort(-self.ub, kind="stable")[:m]
         return QueryPlan(self.idx[order], self.w[order], self.ub[order],
                          self.other_ub[order])
+
+    @staticmethod
+    def concat(plans: "list[QueryPlan]",
+               idx_offsets=None) -> "QueryPlan":
+        """One plan from many (the plane path: per-segment plans joined
+        with each segment's block base added to its gather indices).
+        Per-block bounds are segment-local and stay valid unchanged."""
+        if not plans:
+            return QueryPlan([], [], [], [])
+        idx_parts = []
+        for i, p in enumerate(plans):
+            off = 0 if idx_offsets is None else int(idx_offsets[i])
+            idx_parts.append(p.idx + np.int32(off))
+        return QueryPlan(np.concatenate(idx_parts),
+                         np.concatenate([p.w for p in plans]),
+                         np.concatenate([p.ub for p in plans]),
+                         np.concatenate([p.other_ub for p in plans]))
 
 
 # doc-space granularity of the range-partitioned WAND bound: other-term
@@ -561,51 +583,77 @@ class Bm25Executor:
     MAX_CHUNK_Q = 64
 
     def _dispatch_flat(self, plans, live, k, k1, b, avgdl, counted=False):
-        """Flat-dispatch the batch: device work scales with the ACTUAL
-        total block count (one pow-ladder bucket of padding), never with
-        Q x max-plan as the padded layout did. Chunks bound both the
-        gather temp (MAX_BATCH_CELLS) and the score plane (MAX_CHUNK_Q);
-        n_q pads to a pow2 bucket so shapes stay bucketed."""
-        args = (self.dev.block_docs, self.dev.block_tfs)
-        chunks: list = []
-        cur: list = []
-        cells = 0
-        for p in plans:
-            nb = max(p.n_blocks, 1)
-            if cur and (len(cur) >= self.MAX_CHUNK_Q
-                        or cells + nb > MAX_BATCH_CELLS):
-                chunks.append(cur)
-                cur, cells = [], 0
-            cur.append(p)
-            cells += nb
-        if cur:
+        return dispatch_flat(self.dev.block_docs, self.dev.block_tfs,
+                             self.dev.doc_lens, self.dev.n_docs_pad,
+                             plans, live, k, k1, b, avgdl=avgdl,
+                             counted=counted)
+
+
+MAX_CHUNK_Q = Bm25Executor.MAX_CHUNK_Q
+
+
+def dispatch_flat(block_docs, block_tfs, doc_lens, n_docs_pad: int,
+                  plans, live, k: int, k1: float, b: float,
+                  avgdl: Optional[float] = None,
+                  block_avgdl: Optional[np.ndarray] = None,
+                  counted: bool = False, counter: Optional[list] = None):
+    """Flat-dispatch a batch of plans over one block store: device work
+    scales with the ACTUAL total block count (one pow-ladder bucket of
+    padding), never with Q x max-plan as the padded layout did. Chunks
+    bound both the gather temp (MAX_BATCH_CELLS) and the score plane
+    (MAX_CHUNK_Q); n_q pads to a pow2 bucket so shapes stay bucketed.
+
+    The block store is either one segment's (scalar ``avgdl``) or a whole
+    shard plane's (``block_avgdl`` [NB] host array, gathered per plan so
+    every block keeps its owning segment's norm). ``counter``, when given,
+    accumulates the number of device programs launched (bench/stats
+    observability for dispatches-per-query)."""
+    chunks: list = []
+    cur: list = []
+    cells = 0
+    for p in plans:
+        nb = max(p.n_blocks, 1)
+        if cur and (len(cur) >= MAX_CHUNK_Q
+                    or cells + nb > MAX_BATCH_CELLS):
             chunks.append(cur)
-        kern = bm25_topk_flat_counted if counted else bm25_topk_flat
-        out_s, out_d, out_h = [], [], []
-        for chunk in chunks:
-            n_real = len(chunk)
-            n_q = next_pow2(n_real, minimum=1)
-            fb = qb_bucket(max(sum(p.n_blocks for p in chunk), 1))
-            idx, w, qid = flatten_plans(chunk, fb)
-            got = kern(
-                *args, jnp.asarray(idx), jnp.asarray(w), jnp.asarray(qid),
-                self.dev.doc_lens, jnp.float32(avgdl), live,
-                self.dev.n_docs_pad, n_q, k, k1=k1, b=b)
-            if len(chunks) == 1:
-                if counted:
-                    s, d, h = got
-                    return s[:n_real], d[:n_real], np.asarray(h)[:n_real]
-                s, d = got
-                return s[:n_real], d[:n_real]
+            cur, cells = [], 0
+        cur.append(p)
+        cells += nb
+    if cur:
+        chunks.append(cur)
+    kern = bm25_topk_flat_counted if counted else bm25_topk_flat
+    out_s, out_d, out_h = [], [], []
+    for chunk in chunks:
+        n_real = len(chunk)
+        n_q = next_pow2(n_real, minimum=1)
+        fb = qb_bucket(max(sum(p.n_blocks for p in chunk), 1))
+        idx, w, qid = flatten_plans(chunk, fb)
+        if block_avgdl is not None:
+            flat_avg = block_avgdl[idx].astype(np.float32)
+        else:
+            flat_avg = np.full(fb, avgdl, np.float32)
+        if counter is not None:
+            counter.append(1)
+        got = kern(
+            block_docs, block_tfs,
+            jnp.asarray(idx), jnp.asarray(w), jnp.asarray(qid),
+            doc_lens, jnp.asarray(flat_avg), live,
+            n_docs_pad, n_q, k, k1=k1, b=b)
+        if len(chunks) == 1:
             if counted:
                 s, d, h = got
-                out_h.append(np.asarray(h)[:n_real])
-            else:
-                s, d = got
-            out_s.append(np.asarray(s)[:n_real])
-            out_d.append(np.asarray(d)[:n_real])
-        s = jnp.asarray(np.concatenate(out_s))
-        d = jnp.asarray(np.concatenate(out_d))
+                return s[:n_real], d[:n_real], np.asarray(h)[:n_real]
+            s, d = got
+            return s[:n_real], d[:n_real]
         if counted:
-            return s, d, np.concatenate(out_h)
-        return s, d
+            s, d, h = got
+            out_h.append(np.asarray(h)[:n_real])
+        else:
+            s, d = got
+        out_s.append(np.asarray(s)[:n_real])
+        out_d.append(np.asarray(d)[:n_real])
+    s = jnp.asarray(np.concatenate(out_s))
+    d = jnp.asarray(np.concatenate(out_d))
+    if counted:
+        return s, d, np.concatenate(out_h)
+    return s, d

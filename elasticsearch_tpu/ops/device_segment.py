@@ -1,14 +1,31 @@
-"""Device mirrors of segment data.
+"""Device mirrors of segment data, and the packed multi-segment plane.
 
 Each searchable segment gets lazily-built, cached device arrays with
 power-of-two padded shapes (bucketing keeps the jit cache warm across
 segment growth/merge — SURVEY.md §7 hard part #3). The host Segment stays
 the source of truth; device mirrors are pure caches.
+
+The second half of this module is the **shard plane** (ROADMAP item 1):
+a shard's live segments concatenated along the docs axis into ONE
+device-resident padded plane per (kind, field) — postings blocks,
+dense-vector matrices and rank_features blocks with per-segment base
+offsets — so a whole shard's kNN / IVF probe / sparse scoring / WAND
+recount is one device program regardless of segment count. The
+per-segment boundary is an indexing artifact, not a scoring one (the
+reference's shard-level reader over per-segment Lucene leaves); here it
+survives only as a host-side offset translation (``PlanePart.demux``).
+Planes rebuild incrementally on refresh: per-segment rebased arrays are
+cached by segment uid, so an append-only refresh recomputes just the new
+segment and re-packs; a merge (prefix change) pays a full rebuild.
+Residency is budgeted: every plane charges the ``device`` breaker before
+upload and the registry LRU-evicts cold planes, so a shard whose plane
+cannot fit degrades to the per-segment path instead of OOMing.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -18,6 +35,7 @@ import jax.numpy as jnp
 from elasticsearch_tpu.index.segment import (
     BLOCK, FeaturesField, PostingsField, Segment, VectorField, next_pow2,
 )
+from elasticsearch_tpu.utils.errors import CircuitBreakingError
 
 
 class DevicePostings:
@@ -127,18 +145,540 @@ def gather_query_blocks(pf: PostingsField, terms_with_weights, n_blocks_bucket_m
     term, with its per-block weight (e.g. idf). Returns (block_indices int32
     [QB_pad], block_weights float32 [QB_pad]) padded to a pow2 bucket so the
     device gather has a bucketed static shape. Padding uses block 0 with
-    weight 0 (contributes nothing)."""
-    idx: list = []
-    w: list = []
+    weight 0 (contributes nothing). Per-term block lists come from the
+    field's immutable cache (PostingsField.term_block_idx), so repeat terms
+    across the query stream pay the list construction once per refresh."""
+    idx_parts: list = []
+    w_parts: list = []
     for term, weight in terms_with_weights:
-        start, count = pf.term_blocks(term)
-        for b in range(start, start + count):
-            idx.append(b)
-            w.append(weight)
-    qb = max(len(idx), 1)
-    qb_pad = next_pow2(qb, minimum=n_blocks_bucket_min)
+        t_idx = pf.term_block_idx(term)
+        if not len(t_idx):
+            continue
+        idx_parts.append(t_idx)
+        w_parts.append(np.full(len(t_idx), weight, np.float32))
+    n = sum(len(p) for p in idx_parts)
+    qb_pad = next_pow2(max(n, 1), minimum=n_blocks_bucket_min)
     out_idx = np.zeros(qb_pad, np.int32)
     out_w = np.zeros(qb_pad, np.float32)
-    out_idx[: len(idx)] = idx
-    out_w[: len(w)] = w
+    if idx_parts:
+        out_idx[:n] = np.concatenate(idx_parts)
+        out_w[:n] = np.concatenate(w_parts)
     return out_idx, out_w
+
+
+# ---------------------------------------------------------------------------
+# packed multi-segment device plane
+# ---------------------------------------------------------------------------
+
+class PlaneUnavailable(Exception):
+    """The field has no data in any of the shard's segments — there is
+    nothing to plane; callers take the per-segment path."""
+
+
+class PlanePart:
+    """Base of one (kind, field) plane over one ordered segment set.
+
+    ``doc_base[i]`` is the plane doc offset of segment i (reader order,
+    ALL segments, field-less ones included), so plane doc ids are stable
+    across kinds and map 1:1 onto (segment_idx, local_doc)."""
+
+    kind = "?"
+
+    def __init__(self, field: str, segments: List[Segment]):
+        self.field = field
+        self.segments = list(segments)
+        self.uids = tuple(s.uid for s in segments)
+        counts = np.asarray([s.n_docs for s in segments], np.int64)
+        self.doc_base = np.zeros(max(len(segments), 1), np.int64)
+        if len(counts) > 1:
+            self.doc_base[1: len(counts)] = np.cumsum(counts)[:-1]
+        self.n_docs_total = int(counts.sum()) if len(counts) else 0
+        self.n_docs_pad = next_pow2(max(self.n_docs_total, 1), minimum=BLOCK)
+        # per-segment rebased host arrays keyed by uid: the incremental
+        # refresh path copies matching entries from the previous
+        # generation and recomputes only appended segments
+        self._seg_cache: Dict[int, Any] = {}
+        self.nbytes = 0
+        # DeviceCharge handles for everything this part pinned on device;
+        # eviction releases them ahead of GC so the breaker-pressure
+        # retry can actually free budget
+        self._charges: List[Any] = []
+
+    def release(self) -> None:
+        for charge in self._charges:
+            charge.release()
+
+    def demux(self, plane_docs: np.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """plane doc ids -> (segment positions, local doc ids)."""
+        docs = np.asarray(plane_docs, np.int64)
+        si = np.searchsorted(self.doc_base[: len(self.segments)], docs,
+                             side="right") - 1
+        si = np.maximum(si, 0)
+        return si, docs - self.doc_base[si]
+
+    def live_mask(self, live_masks) -> jnp.ndarray:
+        """Reader-snapshot live masks stacked into plane doc space (padding
+        False). Built per query, like the per-segment snapshot uploads —
+        deletes therefore never invalidate the plane itself."""
+        out = np.zeros(self.n_docs_pad, bool)
+        off = 0
+        for m in live_masks:
+            out[off: off + len(m)] = m
+            off += len(m)
+        return jnp.asarray(out)
+
+    # subclasses: build(prev) -> host arrays tuple (breaker-checked by the
+    # registry BEFORE upload), then upload(host) pins them on device.
+
+
+class PlanePostings(PlanePart):
+    """All segments' posting blocks for one text field, doc ids rebased.
+
+    ``block_avgdl`` (host) carries each block's OWNING SEGMENT avgdl, so
+    the flat BM25 kernel computes the exact per-segment length norm the
+    solo path uses — plane scores match per-segment scores, not a blended
+    shard-wide normalization."""
+
+    kind = "postings"
+
+    def build(self, prev: Optional["PlanePostings"]):
+        refs = []           # (seg_pos, PostingsField, block_base, avgdl)
+        blocks_docs, blocks_tfs, block_avg = [], [], []
+        doc_lens_parts = []
+        nb = 0
+        for pos, seg in enumerate(self.segments):
+            pf = seg.postings.get(self.field)
+            n = seg.n_docs
+            if pf is None:
+                doc_lens_parts.append(np.zeros(n, np.float32))
+                continue
+            cached = prev._seg_cache.get(seg.uid) if prev is not None \
+                else None
+            if cached is None:
+                base = int(self.doc_base[pos])
+                r_docs = np.where(pf.block_docs >= 0,
+                                  pf.block_docs + base, -1).astype(np.int32)
+                avgdl = float(pf.sum_doc_len
+                              / max(1, (pf.doc_lens > 0).sum()))
+                dl = np.zeros(n, np.float32)
+                dl[: min(n, len(pf.doc_lens))] = pf.doc_lens[:n]
+                cached = (r_docs, pf.block_tfs, dl, avgdl)
+            self._seg_cache[seg.uid] = cached
+            r_docs, r_tfs, dl, avgdl = cached
+            refs.append((pos, pf, nb, avgdl))
+            blocks_docs.append(r_docs)
+            blocks_tfs.append(r_tfs)
+            block_avg.append(np.full(r_docs.shape[0], avgdl, np.float32))
+            doc_lens_parts.append(dl)
+            nb += r_docs.shape[0]
+        if not refs:
+            raise PlaneUnavailable(self.field)
+        self.refs = refs
+        self.n_blocks = nb
+        nb_pad = next_pow2(max(nb, 1))
+        bd = np.full((nb_pad, BLOCK), -1, np.int32)
+        bt = np.zeros((nb_pad, BLOCK), np.float32)
+        ba = np.ones(nb_pad, np.float32)
+        bd[:nb] = np.concatenate(blocks_docs)
+        bt[:nb] = np.concatenate(blocks_tfs)
+        ba[:nb] = np.concatenate(block_avg)
+        dl_all = np.zeros(self.n_docs_pad, np.float32)
+        off = 0
+        for p in doc_lens_parts:
+            dl_all[off: off + len(p)] = p
+            off += len(p)
+        # block_avgdl stays HOST-side: the flat dispatch gathers it per
+        # plan into the [FB] kernel argument
+        self.block_avgdl = ba
+        return (bd, bt, dl_all)
+
+    def upload(self, host) -> None:
+        bd, bt, dl = host
+        self.block_docs = jnp.asarray(bd)
+        self.block_tfs = jnp.asarray(bt)
+        self.doc_lens = jnp.asarray(dl)
+
+
+class PlaneVectors(PlanePart):
+    """All segments' dense-vector rows for one field, stacked [N_pad, D],
+    plus an int8 symmetric-quantized mirror (built host-side at pack time,
+    uploaded lazily) for the coarse scoring pass."""
+
+    kind = "vectors"
+
+    def build(self, prev: Optional["PlaneVectors"]):
+        dims, similarity = None, "cosine"
+        for seg in self.segments:
+            vf = seg.vectors.get(self.field)
+            if vf is not None:
+                dims, similarity = vf.dims, vf.similarity
+                break
+        if dims is None:
+            raise PlaneUnavailable(self.field)
+        self.dims, self.similarity = dims, similarity
+        matrix = np.zeros((self.n_docs_pad, dims), np.float32)
+        norms = np.zeros(self.n_docs_pad, np.float32)
+        exists = np.zeros(self.n_docs_pad, bool)
+        for pos, seg in enumerate(self.segments):
+            vf = seg.vectors.get(self.field)
+            if vf is None:
+                continue
+            cached = prev._seg_cache.get(seg.uid) if prev is not None \
+                else None
+            if cached is None:
+                n = seg.n_docs
+                ex = np.zeros(n, bool)
+                ex[: min(n, len(vf.exists))] = vf.exists[:n]
+                cached = (vf.matrix, vf.norms, ex)
+            self._seg_cache[seg.uid] = cached
+            m, nr, ex = cached
+            base = int(self.doc_base[pos])
+            matrix[base: base + len(ex)] = m[: len(ex)]
+            norms[base: base + len(ex)] = nr[: len(ex)]
+            exists[base: base + len(ex)] = ex
+        self._q_dev: Optional[Tuple] = None
+        self._q_failed = False
+        self._ivf = None
+        self.rows = np.nonzero(exists[: self.n_docs_total])[0] \
+            .astype(np.int64)
+        return (matrix, norms, exists)
+
+    def upload(self, host) -> None:
+        matrix, norms, exists = host
+        self.matrix = jnp.asarray(matrix)
+        self.norms = jnp.asarray(norms)
+        self.exists = jnp.asarray(exists)
+
+    def quantized_mirror(self) -> Optional[Tuple]:
+        """(q8 [N_pad, D] int8 device, scales [N_pad] f32 device) — int8
+        symmetric per-row quantization, built lazily on the FIRST
+        quantized query (planes served exact/IVF-only never pay the
+        quantization or its residency) and cached per plane generation.
+        None when the upload would trip the device breaker — the exact
+        plane path still serves, and the refusal is remembered so a
+        budget-starved node doesn't re-quantize per query."""
+        if self._q_dev is not None:
+            return self._q_dev
+        if self._q_failed:
+            return None
+        matrix = np.asarray(self.matrix)   # one D2H per plane generation
+        amax = np.abs(matrix).max(axis=1)
+        scales = np.maximum(amax / 127.0, 1e-30).astype(np.float32)
+        q8 = np.clip(np.round(matrix / scales[:, None]),
+                     -127, 127).astype(np.int8)
+        from elasticsearch_tpu.indices.breaker import account_device_arrays
+        try:
+            charge = account_device_arrays(
+                self, (q8, scales), f"plane_vectors_q:{self.field}",
+                return_charge=True)
+        except CircuitBreakingError:
+            self._q_failed = True
+            return None
+        self._charges.append(charge)
+        self.nbytes += charge.n_bytes   # residency stats see the mirror
+        self._q_dev = (jnp.asarray(q8), jnp.asarray(scales))
+        return self._q_dev
+
+    def ivf_index(self, nlist: Optional[int]):
+        """Shard-level IVF over the plane's vectors (rows = plane doc ids
+        holding a vector), built once per plane generation and shared by
+        the solo rewrite and the batched executor so their ANN results
+        cannot diverge. A breaker-refused build is memoized for the
+        plane's lifetime (a new generation retries) — re-running the full
+        k-means per query just to trip the breaker again would be the
+        worst possible degradation."""
+        if self._ivf is None:
+            if getattr(self, "_ivf_failed", False):
+                raise CircuitBreakingError(
+                    f"[device] ivf index for [{self.field}] was refused "
+                    f"by the HBM budget")
+            if not len(self.rows):
+                self._ivf = (None, self.rows)
+            else:
+                from elasticsearch_tpu.ops.ivf import IVFIndex
+                host = np.asarray(self.matrix)[self.rows]
+                try:
+                    index = IVFIndex.build(host, nlist=nlist,
+                                           similarity=self.similarity)
+                except CircuitBreakingError:
+                    self._ivf_failed = True
+                    raise
+                # the index's HBM is part of this plane's residency:
+                # eviction must release its charge early too, and stats
+                # must count it
+                charge = getattr(index, "_charge", None)
+                if charge is not None:
+                    self._charges.append(charge)
+                    self.nbytes += charge.n_bytes
+                self._ivf = (index, self.rows)
+        return self._ivf
+
+
+class PlaneFeatures(PlanePart):
+    """All segments' rank_features blocks for one field, doc ids rebased."""
+
+    kind = "features"
+
+    def build(self, prev: Optional["PlaneFeatures"]):
+        refs = []           # (seg_pos, FeaturesField, block_base)
+        blocks_docs, blocks_w = [], []
+        nb = 0
+        for pos, seg in enumerate(self.segments):
+            ff = seg.features.get(self.field)
+            if ff is None:
+                continue
+            cached = prev._seg_cache.get(seg.uid) if prev is not None \
+                else None
+            if cached is None:
+                base = int(self.doc_base[pos])
+                r_docs = np.where(ff.block_docs >= 0,
+                                  ff.block_docs + base, -1).astype(np.int32)
+                cached = (r_docs, ff.block_weights)
+            self._seg_cache[seg.uid] = cached
+            r_docs, r_w = cached
+            refs.append((pos, ff, nb))
+            blocks_docs.append(r_docs)
+            blocks_w.append(r_w)
+            nb += r_docs.shape[0]
+        if not refs:
+            raise PlaneUnavailable(self.field)
+        self.refs = refs
+        self.n_blocks = nb
+        nb_pad = next_pow2(max(nb, 1))
+        bd = np.full((nb_pad, BLOCK), -1, np.int32)
+        bw = np.zeros((nb_pad, BLOCK), np.float32)
+        bd[:nb] = np.concatenate(blocks_docs)
+        bw[:nb] = np.concatenate(blocks_w)
+        return (bd, bw)
+
+    def upload(self, host) -> None:
+        bd, bw = host
+        self.block_docs = jnp.asarray(bd)
+        self.block_weights = jnp.asarray(bw)
+
+
+_PART_CLASSES = {"postings": PlanePostings, "vectors": PlaneVectors,
+                 "features": PlaneFeatures}
+
+
+class PlaneRegistry:
+    """Process-global plane residency manager: build-on-demand keyed by
+    (kind, field, segment uid tuple), incremental append across refresh
+    generations, LRU + breaker-aware eviction. ``get`` returning None
+    means "serve this query per-segment" — the plane is an optimization,
+    never a correctness gate."""
+
+    MAX_PARTS = 64
+    MAX_REFUSALS = 128
+
+    def __init__(self):
+        self._parts: "OrderedDict[Tuple, PlanePart]" = OrderedDict()
+        # keys refused by the budget, with the budget "token" they were
+        # refused under: an over-budget shard must fast-miss (no per-query
+        # host re-pack, no shedding every other shard's hot planes) until
+        # either a refresh changes its key or the budget itself changes
+        self._refused: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+        # dynamic config (search.plane.* cluster settings; applied via
+        # configure_from_state on nodes, directly in unit tests/bench)
+        self.enabled = True
+        self.min_segments = 2
+        self.rerank_depth = 128
+        self.quantized = True
+        self.max_bytes = 0          # 0 = breaker-only budgeting
+        self.stats: Dict[str, int] = {
+            "plane_builds": 0,
+            "plane_full_rebuilds": 0,
+            "plane_incremental_appends": 0,
+            "plane_evictions": 0,
+            "plane_miss_fallbacks": 0,
+            "quantized_queries": 0,
+        }
+
+    # -- config ---------------------------------------------------------
+
+    def configure_from_state(self, state) -> None:
+        """Refresh config from committed cluster settings. Re-parsing per
+        query would tax the very hot path this module shrinks, so the
+        parse is memoized on the state version (settings only change
+        through a committed state)."""
+        version = getattr(state, "version", None)
+        if version is not None and \
+                version == getattr(self, "_cfg_version", None):
+            return
+        self._cfg_version = version
+        from elasticsearch_tpu.utils.settings import (
+            SEARCH_PLANE_ENABLED, SEARCH_PLANE_MAX_BYTES,
+            SEARCH_PLANE_MIN_SEGMENTS, SEARCH_PLANE_QUANTIZED,
+            SEARCH_PLANE_RERANK_DEPTH, setting_from_state,
+        )
+        self.enabled = setting_from_state(state, SEARCH_PLANE_ENABLED)
+        self.min_segments = setting_from_state(state,
+                                               SEARCH_PLANE_MIN_SEGMENTS)
+        self.rerank_depth = setting_from_state(state,
+                                               SEARCH_PLANE_RERANK_DEPTH)
+        self.quantized = setting_from_state(state, SEARCH_PLANE_QUANTIZED)
+        self.max_bytes = setting_from_state(state, SEARCH_PLANE_MAX_BYTES)
+
+    # -- lookup / build -------------------------------------------------
+
+    def _budget_token(self) -> Tuple:
+        from elasticsearch_tpu.indices.breaker import BREAKERS
+        return (int(self.max_bytes), int(BREAKERS.breaker("device").limit))
+
+    def _refuse(self, key: Tuple) -> None:
+        self.stats["plane_miss_fallbacks"] += 1
+        self._refused[key] = self._budget_token()
+        while len(self._refused) > self.MAX_REFUSALS:
+            self._refused.popitem(last=False)
+
+    def get(self, segments, kind: str, field: str) -> Optional[PlanePart]:
+        if not self.enabled:
+            return None
+        segments = list(segments)
+        if len(segments) < max(1, self.min_segments):
+            return None
+        key = (kind, field) + tuple(s.uid for s in segments)
+        part = self._parts.get(key)
+        if part is not None:
+            self._parts.move_to_end(key)
+            return part
+        refused_under = self._refused.get(key)
+        if refused_under is not None:
+            if refused_under == self._budget_token():
+                self.stats["plane_miss_fallbacks"] += 1
+                return None
+            self._refused.pop(key, None)   # budget changed: try again
+        return self._build(segments, kind, field, key)
+
+    def _build(self, segments, kind: str, field: str, key: Tuple
+               ) -> Optional[PlanePart]:
+        uids = tuple(s.uid for s in segments)
+        prev = None
+        for k2, p2 in reversed(self._parts.items()):
+            if k2[0] == kind and k2[1] == field and \
+                    len(p2.uids) < len(uids) and \
+                    uids[: len(p2.uids)] == p2.uids:
+                prev = p2
+                break
+        part = _PART_CLASSES[kind](field, segments)
+        try:
+            host = part.build(prev)
+        except PlaneUnavailable:
+            return None
+        part.nbytes = sum(int(a.nbytes) for a in host)
+        if self.max_bytes and part.nbytes > int(self.max_bytes):
+            self._refuse(key)
+            return None
+        from elasticsearch_tpu.indices.breaker import (
+            BREAKERS, account_device_arrays,
+        )
+        label = f"plane_{kind}:{field}"
+        charge = None
+        try:
+            charge = account_device_arrays(part, host, label,
+                                           return_charge=True)
+        except CircuitBreakingError:
+            device_limit = BREAKERS.breaker("device").limit
+            if 0 < device_limit < part.nbytes:
+                # can NEVER fit: don't shed anyone's planes for it
+                self._refuse(key)
+                return None
+            # evict in LRU order, ONE plane at a time, releasing each
+            # charge immediately (not at GC) and retrying — so a budget
+            # that fits both hot shards after dropping one cold plane
+            # keeps the other hot plane resident instead of ping-ponging
+            while self._parts:
+                self._drop(next(iter(self._parts)))
+                try:
+                    charge = account_device_arrays(part, host, label,
+                                                   return_charge=True)
+                    break
+                except CircuitBreakingError:
+                    continue
+            if charge is None:
+                self._refuse(key)
+                return None
+        part._charges.append(charge)
+        part.upload(host)
+        self.stats["plane_builds"] += 1
+        if prev is not None:
+            self.stats["plane_incremental_appends"] += 1
+            # the superseded generation is NOT dropped eagerly: a
+            # point-in-time reader (scroll) acquired before the refresh
+            # still queries the old segment set, and dropping it here
+            # would force a full re-pack on its next query. It ages out
+            # via LRU, merge invalidation, or the breaker-pressure shed.
+        else:
+            self.stats["plane_full_rebuilds"] += 1
+        self._parts[key] = part
+        while len(self._parts) > self.MAX_PARTS:
+            self._drop(next(iter(self._parts)))
+        return part
+
+    # -- eviction / lifecycle -------------------------------------------
+
+    def _drop(self, key: Tuple, count_eviction: bool = True) -> None:
+        part = self._parts.pop(key, None)
+        if part is None:
+            return
+        part.release()      # budget back NOW; GC finalizers then no-op
+        if count_eviction:
+            self.stats["plane_evictions"] += 1
+
+    def evict_cold(self) -> int:
+        """Drop every resident plane (LRU pressure valve for a breaker
+        trip), releasing their breaker charges immediately. In-flight
+        queries keep their part's ARRAYS alive through their own
+        references until they finish — the transient undercount is the
+        eviction working as intended."""
+        n = len(self._parts)
+        for key in list(self._parts):
+            self._drop(key)
+        return n
+
+    def drop_segments(self, uids) -> None:
+        """Invalidate every plane touching any of these segment uids —
+        the merge path: merged-away segments are dead weight on device
+        and their planes can never be requested again (a merge changes
+        the uid tuple), so free them eagerly instead of waiting for LRU."""
+        uids = set(uids)
+        for key in [k for k, p in self._parts.items()
+                    if uids.intersection(p.uids)]:
+            self._drop(key, count_eviction=False)
+
+    def clear(self) -> None:
+        for key in list(self._parts):
+            self._drop(key, count_eviction=False)
+        self._refused.clear()
+
+    def on_refresh(self, segments) -> None:
+        """Refresh publication: eagerly re-pack any resident plane whose
+        segment set is a strict prefix of the new set (the append-only
+        refresh case), so the refresh pays the upload instead of the next
+        query. Merges (prefix broken) rebuild lazily on demand."""
+        if not self.enabled:
+            return
+        uids = tuple(s.uid for s in segments)
+        todo = set()
+        for key, part in list(self._parts.items()):
+            if part.uids != uids and len(part.uids) < len(uids) and \
+                    uids[: len(part.uids)] == part.uids:
+                todo.add((key[0], key[1]))
+        for kind, field in todo:
+            self.get(segments, kind, field)
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        by_kind = {"postings": 0, "vectors": 0, "features": 0}
+        for p in self._parts.values():
+            by_kind[p.kind] = by_kind.get(p.kind, 0) + p.nbytes
+        return {**self.stats,
+                "planes_resident": len(self._parts),
+                "resident_bytes": by_kind,
+                "rerank_depth": int(self.rerank_depth),
+                "quantized": bool(self.quantized)}
+
+
+# one accelerator per process -> one plane residency manager per process
+# (the same reasoning as indices/breaker.py's BREAKERS)
+PLANES = PlaneRegistry()

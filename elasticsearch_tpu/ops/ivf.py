@@ -195,9 +195,23 @@ class IVFIndex:
                 valid[ci, :m] = True
                 ids[ci, :m] = rows
         norms = np.linalg.norm(lists, axis=2).astype(np.float32)
-        return IVFIndex(jnp.asarray(cents), jnp.asarray(lists),
-                        jnp.asarray(valid), jnp.asarray(ids),
-                        similarity, jnp.asarray(norms))
+        # budget-gate the HBM residency BEFORE the upload, like every
+        # other device-resident structure (indices/breaker.py): an
+        # over-budget index build trips the breaker instead of OOMing;
+        # the shard-plane route catches the trip and serves exact
+        index = IVFIndex(cents, lists, valid, ids, similarity, norms)
+        from elasticsearch_tpu.indices.breaker import account_device_arrays
+        # the charge handle rides on the index so owners that evict
+        # early (the plane registry) can release ahead of GC
+        index._charge = account_device_arrays(
+            index, (cents, lists, valid, ids, norms), "ivf",
+            return_charge=True)
+        index.centroids = jnp.asarray(cents)
+        index.lists = jnp.asarray(lists)
+        index.valid = jnp.asarray(valid)
+        index.ids = jnp.asarray(ids)
+        index.norms = jnp.asarray(norms)
+        return index
 
     # -- search ----------------------------------------------------------
 

@@ -67,7 +67,9 @@ def knn_topk(matrix, norms, exists, live, query, k: int,
 def _batch_scores(matrix, norms, queries, similarity: str) -> jnp.ndarray:
     """[B, N_pad] similarity plane from one [B, D] x [D, N] MXU matmul
     (bf16 multiply, f32 accumulate) — shared by the masked and unmasked
-    batch kernels so their per-row arithmetic cannot diverge."""
+    batch kernels so their per-row arithmetic cannot diverge. The
+    positive-score transform is _coarse_similarity, the same one the
+    quantized coarse pass applies to its rescaled int8 dots."""
     q = queries.astype(jnp.bfloat16)
     m = matrix.astype(jnp.bfloat16)
     dots = jax.lax.dot_general(
@@ -75,14 +77,7 @@ def _batch_scores(matrix, norms, queries, similarity: str) -> jnp.ndarray:
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                                          # [B, N_pad]
-    if similarity == "dot_product":
-        return 0.5 + dots / 2.0
-    if similarity == "cosine":
-        qn = jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
-        return (1.0 + dots / (norms[None, :] * qn + 1e-30)) / 2.0
-    q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
-    d2 = jnp.maximum(norms[None, :] ** 2 + q2 - 2.0 * dots, 0.0)
-    return 1.0 / (1.0 + jnp.sqrt(d2))
+    return _coarse_similarity(dots, norms, queries, similarity)
 
 
 @partial(jax.jit, static_argnames=("similarity", "k"))
@@ -110,6 +105,142 @@ def knn_topk_batch_masked(matrix, norms, exists, live, queries, masks,
     return jax.lax.top_k(scores, k)
 
 
+def pad_queries_pow2(queries) -> Tuple[np.ndarray, int]:
+    """Pad the query batch to a pow2 row count (zeros) so the jit cache
+    stays warm across batch sizes; returns (padded, n_real). One
+    implementation shared by the exact executor and the quantized plane
+    pass — their pads must stay in lockstep."""
+    from elasticsearch_tpu.index.segment import next_pow2
+    q_host = np.asarray(queries, np.float32)
+    n_real = q_host.shape[0]
+    n_pad = next_pow2(max(n_real, 1), minimum=1)
+    if n_pad != n_real:
+        q_host = np.concatenate(
+            [q_host, np.zeros((n_pad - n_real, q_host.shape[1]),
+                              np.float32)])
+    return q_host, n_real
+
+
+def pad_mask_rows_pow2(masks, n_pad: int) -> np.ndarray:
+    """Stacked per-query filter masks padded to the query batch's pow2
+    row count; padded rows stay False (they match nothing)."""
+    m = np.asarray(masks)
+    out = np.zeros((n_pad, m.shape[1]), bool)
+    out[: m.shape[0]] = m
+    return out
+
+
+def _quantize_queries(queries: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-row int8 quantization of the query batch (the doc
+    side is quantized once at plane pack time)."""
+    qmax = jnp.max(jnp.abs(queries), axis=1, keepdims=True)
+    qscale = jnp.maximum(qmax / 127.0, 1e-30)
+    qq = jnp.clip(jnp.round(queries / qscale), -127, 127).astype(jnp.int8)
+    return qq, qscale
+
+
+def _coarse_similarity(dots, norms, queries, similarity: str) -> jnp.ndarray:
+    if similarity == "dot_product":
+        return 0.5 + dots / 2.0
+    if similarity == "cosine":
+        qn = jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
+        return (1.0 + dots / (norms[None, :] * qn + 1e-30)) / 2.0
+    q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
+    d2 = jnp.maximum(norms[None, :] ** 2 + q2 - 2.0 * dots, 0.0)
+    return 1.0 / (1.0 + jnp.sqrt(d2))
+
+
+def _coarse_plane(q8, scales, norms, queries, similarity: str
+                  ) -> jnp.ndarray:
+    """[B, N_pad] coarse similarity: int8 x int8 MXU matmul (int32
+    accumulate, rescaled to f32) + the positive-score transform. Shared
+    by the masked and unmasked coarse kernels."""
+    qq, qscale = _quantize_queries(queries)
+    dots = jax.lax.dot_general(
+        qq, q8,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32) * (qscale * scales[None, :])     # [B, N_pad]
+    return _coarse_similarity(dots, norms, queries, similarity)
+
+
+@partial(jax.jit, static_argnames=("similarity", "kprime"))
+def knn_coarse_candidates(q8, scales, norms, allowed, queries,
+                          kprime: int, similarity: str = "cosine"
+                          ) -> jnp.ndarray:
+    """Quantized coarse pass over the FULL plane: top-k' candidate doc
+    ids per query. Ranking-only — the exact f32 re-rank
+    (knn_rerank_exact) restores golden scores for the survivors."""
+    s = _coarse_plane(q8, scales, norms, queries, similarity)
+    s = jnp.where(allowed[None, :], s, -jnp.inf)
+    _, cand = jax.lax.top_k(s, kprime)
+    return cand
+
+
+@partial(jax.jit, static_argnames=("similarity", "kprime"))
+def knn_coarse_candidates_masked(q8, scales, norms, allowed, queries,
+                                 masks, kprime: int,
+                                 similarity: str = "cosine") -> jnp.ndarray:
+    """Coarse pass with per-query filter masks [B, N_pad] (filtered kNN)."""
+    s = _coarse_plane(q8, scales, norms, queries, similarity)
+    s = jnp.where(allowed[None, :] & masks, s, -jnp.inf)
+    _, cand = jax.lax.top_k(s, kprime)
+    return cand
+
+
+def _rerank_scores(matrix, norms, queries, cand, similarity: str
+                   ) -> jnp.ndarray:
+    """Exact f32 scores [B, K'] of the gathered candidate rows, with the
+    SAME bf16-multiply/f32-accumulate arithmetic and positive-score
+    transforms as _batch_scores — one implementation, so a scoring fix
+    cannot diverge between the masked and unmasked re-rank kernels."""
+    rows = matrix[cand]                                    # [B, K', D]
+    dots = jnp.einsum("bd,bkd->bk", queries.astype(jnp.bfloat16),
+                      rows.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+    bnorms = norms[cand]                                   # [B, K']
+    if similarity == "dot_product":
+        return 0.5 + dots / 2.0
+    if similarity == "cosine":
+        qn = jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-30
+        return (1.0 + dots / (bnorms * qn + 1e-30)) / 2.0
+    q2 = jnp.sum(queries * queries, axis=1, keepdims=True)
+    d2 = jnp.maximum(bnorms * bnorms + q2 - 2.0 * dots, 0.0)
+    return 1.0 / (1.0 + jnp.sqrt(d2))
+
+
+def _rerank_topk(s, cand, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    ts, pos = jax.lax.top_k(s, k)
+    td = jnp.take_along_axis(cand, pos, axis=1)
+    td = jnp.where(jnp.isfinite(ts), td, -1)
+    return ts, td
+
+
+@partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_rerank_exact(matrix, norms, allowed, queries, cand, k: int,
+                     similarity: str = "cosine"
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact f32 re-rank of the coarse candidates: identical top-k to the
+    exact path whenever the true top-k survives the coarse pass (the
+    re-rank depth's contract)."""
+    s = _rerank_scores(matrix, norms, queries, cand, similarity)
+    s = jnp.where(allowed[cand], s, -jnp.inf)
+    return _rerank_topk(s, cand, k)
+
+
+@partial(jax.jit, static_argnames=("similarity", "k"))
+def knn_rerank_exact_masked(matrix, norms, allowed, queries, cand, masks,
+                            k: int, similarity: str = "cosine"
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """knn_rerank_exact with per-query filter masks re-applied to the
+    gathered candidates (a masked-out doc must stay out even if the
+    coarse pass leaked it in)."""
+    s = _rerank_scores(matrix, norms, queries, cand, similarity)
+    ok = allowed[cand] & jnp.take_along_axis(masks, cand, axis=1)
+    s = jnp.where(ok, s, -jnp.inf)
+    return _rerank_topk(s, cand, k)
+
+
 class KnnExecutor:
     """Per-(segment, field) exact kNN executor."""
 
@@ -133,17 +264,9 @@ class KnnExecutor:
         faceted-nav case — it simply folds into ``live``, exactly as the
         solo path's ``live & fmask``), or a [Q, N_pad] stack of per-query
         masks applied inside the one masked matmul dispatch."""
-        q_host = np.asarray(queries, np.float32)
-        n_real = q_host.shape[0]
-        from elasticsearch_tpu.index.segment import next_pow2
-        n_pad = next_pow2(max(n_real, 1), minimum=1)
-        if n_pad != n_real:
-            q_host = np.concatenate(
-                [q_host, np.zeros((n_pad - n_real, q_host.shape[1]),
-                                  np.float32)])
+        q_host, n_real = pad_queries_pow2(queries)
         if masks is not None and getattr(masks, "ndim", 1) == 2:
-            m_host = np.zeros((n_pad, np.asarray(masks).shape[1]), bool)
-            m_host[:n_real] = np.asarray(masks)   # padded rows stay False
+            m_host = pad_mask_rows_pow2(masks, q_host.shape[0])
             s, d = knn_topk_batch_masked(
                 self.dev.matrix, self.dev.norms, self.dev.exists, live,
                 jnp.asarray(q_host), jnp.asarray(m_host), k,

@@ -94,18 +94,25 @@ def sparse_topk_batch(block_docs, block_weights,
 
 def gather_feature_blocks(ff: FeaturesField, features_with_weights,
                           bucket_min: int = 8) -> Tuple[np.ndarray, np.ndarray]:
-    """Host prep: (block_indices, query_weights) padded to a pow2 bucket."""
-    idx, w = [], []
+    """Host prep: (block_indices, query_weights) padded to a pow2 bucket.
+    Per-feature block lists come from the immutable field's cache
+    (FeaturesField.feature_block_idx) — they only change when a refresh
+    publishes a new field, so ELSER-style repeat expansions stop paying
+    per-query list construction."""
+    idx_parts, w_parts = [], []
     for name, weight in features_with_weights:
-        start, count = ff.feature_blocks(name)
-        for bidx in range(start, start + count):
-            idx.append(bidx)
-            w.append(weight)
-    qb_pad = next_pow2(max(len(idx), 1), minimum=bucket_min)
+        f_idx = ff.feature_block_idx(name)
+        if not len(f_idx):
+            continue
+        idx_parts.append(f_idx)
+        w_parts.append(np.full(len(f_idx), weight, np.float32))
+    n = sum(len(p) for p in idx_parts)
+    qb_pad = next_pow2(max(n, 1), minimum=bucket_min)
     out_idx = np.zeros(qb_pad, np.int32)
     out_w = np.zeros(qb_pad, np.float32)
-    out_idx[: len(idx)] = idx
-    out_w[: len(w)] = w
+    if idx_parts:
+        out_idx[:n] = np.concatenate(idx_parts)
+        out_w[:n] = np.concatenate(w_parts)
     return out_idx, out_w
 
 

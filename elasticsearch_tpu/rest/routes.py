@@ -1264,11 +1264,14 @@ def build_controller(client: NodeClient) -> RestController:
     def health(req: RestRequest, done: DoneFn) -> None:
         """?wait_for_status=yellow|green polls until the status is at
         least that good or the timeout lapses, reporting timed_out like
-        the reference (ClusterHealthRequest.waitForStatus)."""
+        the reference (ClusterHealthRequest.waitForStatus). Health is
+        computed on the ELECTED MASTER (cluster_health_async routes
+        there), so the unverified-STARTED gate holds on every node."""
         index = req.params.get("index")
         want = req.query.get("wait_for_status")
         if want not in ("yellow", "green"):
-            done(200, client.cluster_health(index))
+            client.cluster_health_async(
+                index, lambda h, _err: done(200, h))
             return
         rank = {"red": 0, "yellow": 1, "green": 2}
 
@@ -1286,13 +1289,14 @@ def build_controller(client: NodeClient) -> RestController:
             req.query.get("timeout", "30s"))
 
         def poll() -> None:
-            h = client.cluster_health(index)
-            if rank.get(h["status"], 0) >= rank[want]:
-                done(200, {**h, "timed_out": False})
-            elif client.node.scheduler.now() >= deadline:
-                done(200, {**h, "timed_out": True})
-            else:
-                client.node.scheduler.schedule(0.1, poll)
+            def on_health(h, _err) -> None:
+                if rank.get(h["status"], 0) >= rank[want]:
+                    done(200, {**h, "timed_out": False})
+                elif client.node.scheduler.now() >= deadline:
+                    done(200, {**h, "timed_out": True})
+                else:
+                    client.node.scheduler.schedule(0.1, poll)
+            client.cluster_health_async(index, on_health)
         poll()
     r("GET", "/_cluster/health", health)
     r("GET", "/_cluster/health/{index}", health)

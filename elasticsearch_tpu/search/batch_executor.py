@@ -266,6 +266,16 @@ def batched_wand_topk_shard(ctxs, field: str,
     Returns per member: (candidates, hits, relation, max_score,
     (blocks_total, blocks_scored))."""
     from elasticsearch_tpu.search.execute import _bm25_executor
+    if ctxs:
+        from elasticsearch_tpu.ops.device_segment import PLANES
+        part = PLANES.get([c.segment for c in ctxs], "postings", field)
+        if part is not None:
+            from elasticsearch_tpu.search.plane_exec import plane_wand_topk
+            got = plane_wand_topk(ctxs, part, field, clause_lists, want,
+                                  track_limit,
+                                  check_members=check_members)
+            if got is not None:
+                return got
     count = track_limit > 0
     n_q = len(clause_lists)
     per_seg = []            # (ctx, ex, plans[n_q], k_seg, avgdl)
@@ -450,6 +460,22 @@ def batched_knn_shard(ctxs, field: str, specs: List[BatchSpec],
         ann_segment_route, execute as execute_query,
     )
     n_q = len(specs)
+    if ctxs:
+        from elasticsearch_tpu.ops.device_segment import PLANES
+        part = PLANES.get([c.segment for c in ctxs], "vectors", field)
+        if part is not None:
+            # whole-shard plane: one (optionally quantized+re-ranked)
+            # matmul or one shard-IVF probe — the same executor the solo
+            # rewrite uses, so batch and solo kNN cannot diverge
+            from elasticsearch_tpu.search.plane_exec import (
+                PlaneFallback, plane_knn_winners,
+            )
+            try:
+                per_member_hits = plane_knn_winners(
+                    ctxs, part, field, specs, k, check_members, stats)
+            except PlaneFallback as e:
+                raise _FallbackSolo(str(e))
+            return _knn_demux(specs, per_member_hits, k)
     vectors = np.asarray([s.query_vector for s in specs], np.float32)
     per_member_hits: List[List[Tuple[int, int, float]]] = \
         [[] for _ in range(n_q)]
@@ -525,6 +551,14 @@ def batched_knn_shard(ctxs, field: str, specs: List[BatchSpec],
                 if sc > -np.inf:
                     per_member_hits[qi].append(
                         (ctx.segment_idx, int(doc), float(sc)))
+    return _knn_demux(specs, per_member_hits, k)
+
+
+def _knn_demux(specs: List[BatchSpec],
+               per_member_hits: List[List[Tuple[int, int, float]]],
+               k: int) -> List[Tuple]:
+    """Per-member shard-global merge (rewrite_knn's semantics) shared by
+    the plane and per-segment batch paths."""
     out = []
     for qi, spec in enumerate(specs):
         hits = per_member_hits[qi]
@@ -556,6 +590,23 @@ def batched_sparse_shard(ctxs, field: str, specs: List[BatchSpec],
     n_q = len(specs)
     expansions = [[(t, w * s.boost) for t, w in s.tokens.items()]
                   for s in specs]
+    if ctxs:
+        from elasticsearch_tpu.ops.device_segment import PLANES
+        part = PLANES.get([c.segment for c in ctxs], "features", field)
+        if part is not None:
+            from elasticsearch_tpu.search.plane_exec import (
+                plane_sparse_topk,
+            )
+            got = plane_sparse_topk(ctxs, part, field, expansions, want,
+                                    check_members=check_members)
+            out = []
+            for (cands, total, max_score), spec in zip(got, specs):
+                relation = "eq"
+                if spec.clip_limit is not None and \
+                        total > spec.clip_limit:
+                    total, relation = spec.clip_limit, "gte"
+                out.append((cands, total, relation, max_score, None))
+            return out
     candidates: List[List[ShardDoc]] = [[] for _ in range(n_q)]
     totals = [0] * n_q
     for ctx in ctxs:

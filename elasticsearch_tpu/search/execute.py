@@ -414,6 +414,29 @@ def _bm25_executor(ctx: SegmentContext, field_name: str) -> Optional[Bm25Executo
     return ex
 
 
+def _bm25_planner(ctx: SegmentContext, field_name: str
+                  ) -> Optional[Bm25Executor]:
+    """Host-side executor for PLAN BUILDING only (the plane path): no
+    per-segment device mirror is uploaded or breaker-charged — the plane
+    already holds the shard's postings on device, and doubling residency
+    with mirrors the plane never dispatches would tighten the very budget
+    the registry manages. Reuses a full executor when one is already
+    cached (its host planning tables are identical)."""
+    cached = ctx.segment._device_cache.get(("bm25_exec", field_name))
+    if cached is not None:
+        cached.doc_count = ctx.doc_count_for_idf()
+        return cached
+    pf = ctx.segment.postings.get(field_name)
+    if pf is None:
+        return None
+    ex = ctx.segment.device(
+        ("bm25_plan", field_name),
+        lambda: Bm25Executor(None, pf,
+                             total_doc_count=max(ctx.segment.n_docs, 1)))
+    ex.doc_count = ctx.doc_count_for_idf()
+    return ex
+
+
 def _h_match(q: dsl.Match, ctx: SegmentContext) -> Result:
     analyzer = ctx.search_analyzer(q.field)
     terms = analyzer.terms(q.text)
@@ -952,7 +975,19 @@ def ann_segment_route(ctx: "SegmentContext", field: str, k: int,
                                nlist=opts.get("nlist"),
                                similarity=vf.similarity)
         return index, rows.astype(np.int64)
-    index, rows = seg.device(("ivf", field), build)
+    from elasticsearch_tpu.indices.breaker import BREAKERS
+    from elasticsearch_tpu.utils.errors import CircuitBreakingError
+    # budget refusals memoize under the breaker limit they were refused
+    # at (the plane registry's budget-token pattern): no re-paying the
+    # full k-means per query just to re-trip, but a raised limit retries
+    budget_token = BREAKERS.breaker("device").limit
+    if seg._device_cache.get(("ivf_refused", field)) == budget_token:
+        return None
+    try:
+        index, rows = seg.device(("ivf", field), build)
+    except CircuitBreakingError:
+        seg._device_cache[("ivf_refused", field)] = budget_token
+        return None       # index over HBM budget: exact brute force serves
     if index is None:
         return (None, rows, 0, 0)   # mapped, but no vectors here
 
@@ -983,37 +1018,79 @@ def _ann_segment_topk(ctx: "SegmentContext", q: dsl.Knn
         rows, live, ctx.segment_idx, oversample)[0]
 
 
+def _plane_knn_winners_solo(q: dsl.Knn, segment_ctxs, cancel_check):
+    """One-dispatch kNN over the shard plane when it is resident; None
+    routes the caller to the per-segment loop. The plane executor is the
+    SAME code the batched path runs, so solo and batched kNN cannot
+    diverge."""
+    if not segment_ctxs:
+        return None
+    reader = segment_ctxs[0].reader
+    if reader is None or len(reader.segments) != len(segment_ctxs):
+        return None
+    for ctx, seg in zip(segment_ctxs, reader.segments):
+        if ctx.segment is not seg:
+            return None
+    from elasticsearch_tpu.ops.device_segment import PLANES
+    part = PLANES.get([c.segment for c in segment_ctxs], "vectors",
+                      q.field)
+    if part is None:
+        return None
+    from types import SimpleNamespace
+
+    from elasticsearch_tpu.search.plane_exec import (
+        PlaneFallback, plane_knn_winners,
+    )
+    spec = SimpleNamespace(
+        query_vector=q.query_vector, filter=q.filter,
+        filter_key=repr(q.filter) if q.filter is not None else None,
+        num_candidates=q.num_candidates)
+    try:
+        return plane_knn_winners(segment_ctxs, part, q.field, [spec],
+                                 q.k, check_members=cancel_check)[0]
+    except PlaneFallback:
+        return None
+
+
 def rewrite_knn(q: dsl.Query, segment_ctxs: List["SegmentContext"],
                 cancel_check=None) -> dsl.Query:
     """Replace every Knn node with a KnnBound node holding the shard-global
     top-k (merged across segments). ``cancel_check`` (zero-arg, raising)
     runs between per-segment device dispatches so a cancelled or
-    budget-expired task stops paying for vector scans."""
+    budget-expired task stops paying for vector scans.
+
+    When the shard's vector plane is resident the whole rewrite is ONE
+    device program (plane_exec.plane_knn_winners) and the per-segment
+    loop below never runs — it remains as the degraded path for shards
+    whose plane was refused by the HBM budget."""
     if isinstance(q, dsl.Knn):
-        per_seg_hits: List[Tuple[int, int, float]] = []
-        for ctx in segment_ctxs:
-            if cancel_check is not None:
-                cancel_check()
-            ann = _ann_segment_topk(ctx, q)
-            if ann is not None:
-                per_seg_hits.extend(ann)
-                continue
-            dev = DeviceVectors.for_segment(ctx.segment, q.field)
-            if dev is None:
-                continue
-            live = ctx.live
-            if q.filter is not None:
-                _, fmask = execute(q.filter, ctx)
-                live = live & fmask
-            ex = KnnExecutor(dev)
-            k = min(q.k, ctx.n_docs_pad)
-            ts, td = ex.top_k(q.query_vector, live, k)
-            ts, td = np.asarray(ts), np.asarray(td)
-            for s, d in zip(ts, td):
-                if s > -np.inf:
-                    per_seg_hits.append((ctx.segment_idx, int(d), float(s)))
-        per_seg_hits.sort(key=lambda x: -x[2])
-        winners = per_seg_hits[: q.k]
+        winners = _plane_knn_winners_solo(q, segment_ctxs, cancel_check)
+        if winners is None:
+            per_seg_hits: List[Tuple[int, int, float]] = []
+            for ctx in segment_ctxs:
+                if cancel_check is not None:
+                    cancel_check()
+                ann = _ann_segment_topk(ctx, q)
+                if ann is not None:
+                    per_seg_hits.extend(ann)
+                    continue
+                dev = DeviceVectors.for_segment(ctx.segment, q.field)
+                if dev is None:
+                    continue
+                live = ctx.live
+                if q.filter is not None:
+                    _, fmask = execute(q.filter, ctx)
+                    live = live & fmask
+                ex = KnnExecutor(dev)
+                k = min(q.k, ctx.n_docs_pad)
+                ts, td = ex.top_k(q.query_vector, live, k)
+                ts, td = np.asarray(ts), np.asarray(td)
+                for s, d in zip(ts, td):
+                    if s > -np.inf:
+                        per_seg_hits.append(
+                            (ctx.segment_idx, int(d), float(s)))
+            per_seg_hits.sort(key=lambda x: -x[2])
+            winners = per_seg_hits[: q.k]
         per_segment: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         for si, d, s in winners:
             docs, scores = per_segment.setdefault(

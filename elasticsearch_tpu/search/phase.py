@@ -300,9 +300,19 @@ def choose_collector_context(query: dsl.Query,
         return "dense"
     if track_total_hits is True:
         return "dense"
-    if wand_clauses(query, mappers) is None:
-        return "dense"
-    return "wand_topk"
+    if wand_clauses(query, mappers) is not None:
+        return "wand_topk"
+    # pure top-k kNN / resolved-expansion shapes skip the dense score
+    # vector when the shard plane is resident (query_shard falls back to
+    # "dense" when it is not). The COLLECTOR choice itself never changes
+    # results; the quantized plane kNN pass is exact up to its re-rank
+    # depth by contract (search.plane.rerank_depth / quantized settings)
+    if isinstance(query, dsl.Knn) and \
+            mappers.field_type(query.field) == "dense_vector":
+        return "knn_topk"
+    if isinstance(query, dsl.TextExpansion) and query.tokens:
+        return "sparse_topk"
+    return "dense"
 
 
 def _wand_topk_shard(ctxs: List[SegmentContext], field: str,
@@ -328,8 +338,22 @@ def _wand_topk_shard(ctxs: List[SegmentContext], field: str,
     ("gte", limit). Otherwise the shard re-scores unpruned for an exact
     count — but when the df upper bound already shows total <= limit the
     first pass runs unpruned+counted directly and no second pass exists.
-    track_limit 0 = totals disabled (report candidates found, "gte")."""
+    track_limit 0 = totals disabled (report candidates found, "gte").
+
+    With the shard's postings plane resident the whole thing collapses to
+    the plane executor (2 dispatches total, segment count irrelevant);
+    this per-segment body is the degraded path for plane-refused shards."""
     from elasticsearch_tpu.search.execute import _bm25_executor
+    if ctxs:
+        from elasticsearch_tpu.ops.device_segment import PLANES
+        part = PLANES.get([c.segment for c in ctxs], "postings", field)
+        if part is not None:
+            from elasticsearch_tpu.search.plane_exec import plane_wand_topk
+            got = plane_wand_topk(ctxs, part, field, [clauses], want,
+                                  track_limit,
+                                  check_members=cancel_check)
+            if got is not None:
+                return got[0]
     count = track_limit > 0
     per_seg = []          # (ctx, ex, plans, k_seg, avgdl)
     seen_terms: Dict[str, float] = {}
@@ -525,6 +549,9 @@ def query_shard(reader: Reader,
         # the terminate_after counting contract needs per-segment counts
         # (QueryPhase.java:223's early-terminating collector)
         collector = "dense"
+    if collector in ("knn_topk", "sparse_topk") and profile:
+        # the profile block names the dense collectors; keep it truthful
+        collector = "dense"
     if rescore is not None:
         if not (len(sort) == 1 and sort[0].field == "_score"):
             # the reference rejects rescore+sort explicitly; silently
@@ -579,11 +606,68 @@ def query_shard(reader: Reader,
                 "WandTopKCollector", "search_top_hits (block-max pruned)")
                 if profile else None))
 
+    if collector == "sparse_topk":
+        # resolved text_expansion over the rank_features plane: one
+        # device program for the whole shard, exact counts off the score
+        # plane — byte-identical to the dense per-segment path it
+        # replaces (falls back to it when the plane is not resident)
+        from elasticsearch_tpu.ops.device_segment import PLANES
+        part = PLANES.get(reader.segments, "features", query.field)
+        if part is None:
+            collector = "dense"
+        else:
+            from elasticsearch_tpu.search.plane_exec import (
+                plane_sparse_topk,
+            )
+            expansion = [(t, w * query.boost)
+                         for t, w in query.tokens.items()]
+            # plane_sparse_topk charges the request breaker for its own
+            # score plane at dispatch time
+            (cands, total, max_score), = plane_sparse_topk(
+                ctxs, part, query.field, [expansion], want,
+                check_members=cancel_check)
+            relation = "eq"
+            if exact_total and track_limit < (1 << 62) \
+                    and total > track_limit:
+                total, relation = track_limit, "gte"
+            result = ShardQueryResult(
+                cands[from_: from_ + size], total, relation, max_score,
+                doc_count=doc_count, dfs=dfs)
+            if profile:
+                result.profile = _profile_block(
+                    "SimpleTopScoreDocCollector", "search_top_hits")
+            return result
+
     # Lucene-style kNN rewrite: per-segment top-k merged to shard-global
-    # k; the rewrite pays one device dispatch per segment, so the shard's
-    # cancel/deadline check binds between them like everywhere else
-    from elasticsearch_tpu.search.execute import rewrite_knn
+    # k; with the vector plane resident the rewrite itself is ONE device
+    # program (execute._plane_knn_winners_solo), otherwise it pays one
+    # dispatch per segment with the cancel/deadline check between them
+    from elasticsearch_tpu.search.execute import KnnBound, rewrite_knn
     query = rewrite_knn(query, ctxs, cancel_check)
+
+    if collector == "knn_topk" and isinstance(query, KnnBound):
+        # the rewrite already holds the shard-global winners; reading
+        # them off the bound node reproduces the dense path's per-segment
+        # collection byte-for-byte without its per-segment dispatches
+        entries: List[ShardDoc] = []
+        for si, (docs, doc_scores) in (query.per_segment or {}).items():
+            for d, s in zip(docs, doc_scores):
+                entries.append(ShardDoc(int(si), int(d), float(s),
+                                        (float(s),)))
+        entries.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+        total = len(entries)
+        relation = "eq"
+        if exact_total and track_limit < (1 << 62) \
+                and total > track_limit:
+            total, relation = track_limit, "gte"
+        max_score = entries[0].score if entries else None
+        result = ShardQueryResult(
+            entries[from_: from_ + size], total, relation, max_score,
+            doc_count=doc_count, dfs=dfs)
+        if profile:
+            result.profile = _profile_block(
+                "SimpleTopScoreDocCollector", "search_top_hits")
+        return result
 
     # transient HBM estimate for the dense path: one f32 score vector plus
     # mask/where temporaries per segment (HierarchyCircuitBreakerService

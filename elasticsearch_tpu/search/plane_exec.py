@@ -1,0 +1,541 @@
+"""Shard-plane execution: whole-shard device programs over the packed
+multi-segment plane (ops/device_segment.py PlanePart family).
+
+One function per query class, each the fused counterpart of the
+per-segment loops in search/phase.py (solo) and search/batch_executor.py
+(batched) — BOTH paths call into here when a plane is resident, so solo
+and batched serving share one implementation. Exact classes (text,
+exact/filtered kNN, sparse) reproduce the per-segment results
+identically; the quantized kNN coarse pass is exact up to its re-rank
+depth by contract (search.plane.quantized: false forces full exactness),
+and ANN routing decisions are made to agree with the per-segment
+fallback so plane residency never flips an exact result approximate:
+
+- ``plane_wand_topk``: Q text queries through the block-max-pruned BM25
+  path in TWO device dispatches for the whole shard (phase 1 theta, phase
+  2 survivors) instead of two per segment; per-block avgdl keeps the
+  per-segment length norms exact.
+- ``plane_knn_winners``: Q kNN queries (filtered or not) in ONE matmul
+  over the stacked vector plane — optionally int8-coarse + exact-f32
+  re-rank (the quantized scoring pass) — or ONE shard-level IVF probe,
+  with the per-segment demux reduced to a host-side offset translation.
+- ``plane_sparse_topk``: Q resolved expansions in ONE gather/scatter over
+  the stacked rank_features blocks, exact counts off the score plane.
+
+Every function degrades by construction: callers treat a None plane (or
+``PlaneFallback``) as "run the existing per-segment path".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.index.segment import next_pow2
+from elasticsearch_tpu.ops.bm25 import (
+    DEFAULT_B, DEFAULT_K1, P1_BUCKET, QueryPlan, dispatch_flat,
+)
+from elasticsearch_tpu.ops.device_segment import PLANES, PlaneVectors
+from elasticsearch_tpu.search.phase import ShardDoc
+
+
+class PlaneFallback(Exception):
+    """This batch cannot run on the plane (e.g. IVF-routed members whose
+    num_candidates imply different probe widths); members take the
+    per-segment path."""
+
+
+def _reader_of(ctxs):
+    return ctxs[0].reader
+
+
+def _live_host(reader) -> np.ndarray:
+    return np.concatenate([np.asarray(m) for m in reader.live_masks]) \
+        if reader.live_masks else np.zeros(0, bool)
+
+
+# ---------------------------------------------------------------------------
+# text: block-max pruned BM25 over the postings plane
+# ---------------------------------------------------------------------------
+
+def plane_wand_topk(ctxs, part, field: str,
+                    clause_lists: List[List[Tuple[str, float]]],
+                    want: int, track_limit: int,
+                    check_members: Optional[Callable[[], None]] = None,
+                    counter: Optional[list] = None) -> Optional[List[Tuple]]:
+    """Q queries through the pruned BM25 path with the whole shard's
+    postings in one block store. Member-for-member identical semantics to
+    the per-segment loops (scores, candidates, counts-then-skip totals);
+    per-member theta comes from that member's own phase-1 partials over
+    ALL segments at once, so segments prune each other exactly as the
+    shard-global theta barrier did — without the per-segment dispatches.
+
+    Returns per member (candidates, hits, relation, max_score,
+    (blocks_total, blocks_scored)), or None when the request cannot run
+    on the plane — a DFS avgdl override makes the baked per-block norms
+    wrong, and totals-disabled requests report "candidates found" with
+    PER-SEGMENT truncation (sum of min(matches, want) per segment), a
+    number a fused top-k cannot reproduce — the caller then runs the
+    per-segment path."""
+    from elasticsearch_tpu.search.execute import _bm25_planner
+    if track_limit <= 0:
+        return None
+    # past this point totals are ALWAYS tracked (the counts-then-skip
+    # contract); totals-disabled requests just bailed to the per-segment
+    # path above
+    n_q = len(clause_lists)
+    reader = _reader_of(ctxs)
+
+    per_seg = []        # (ctx, plans[n_q], block_base)
+    seen_terms: List[Dict[str, float]] = [{} for _ in range(n_q)]
+    has_terms = [False] * n_q
+    for pos, pf, block_base, avgdl in part.refs:
+        ctx = ctxs[pos]
+        if ctx.avgdl_for(field) is not None:
+            return None     # DFS-normed request: plane norms don't apply
+        analyzer = ctx.search_analyzer(field)
+        ex = _bm25_planner(ctx, field)
+        if ex is None:
+            continue
+        df_map = ctx.df_for(field) or {}
+        member_terms: List[List[Tuple[str, float]]] = []
+        any_terms = False
+        for qi, clauses in enumerate(clause_lists):
+            terms: List[Tuple[str, float]] = []
+            for text, boost in clauses:
+                terms.extend((t, boost) for t in analyzer.terms(text))
+            member_terms.append(terms)
+            if terms:
+                any_terms = True
+                has_terms[qi] = True
+                for t, _b in terms:
+                    if t not in seen_terms[qi]:
+                        seen_terms[qi][t] = float(df_map.get(t, 0))
+        if not any_terms:
+            continue
+        plans = ex.build_plans(member_terms, df_override=df_map or None,
+                               avgdl=avgdl)
+        per_seg.append((ctx, plans, block_base))
+
+    empty = ([], 0, "eq", None, (0, 0))
+    if not per_seg:
+        return [empty] * n_q
+
+    live = part.live_mask(reader.live_masks)
+    k_plane = min(max(want, 1), part.n_docs_pad)
+    empty_plan = QueryPlan([], [], [], [])
+
+    hits_upper = [int(sum(s.values())) for s in seen_terms]
+    exact_mode = [hits_upper[qi] <= track_limit for qi in range(n_q)]
+
+    def _dispatch(rows, k, counted):
+        if check_members is not None:
+            check_members()
+        # the scatter materializes a [chunk_q, n_docs_pad] f32 score
+        # plane sized to the WHOLE shard — charge the request breaker for
+        # it (score plane + top-k temporaries) so an over-budget plane
+        # dispatch 429s instead of OOMing the chip
+        from elasticsearch_tpu.indices.breaker import BREAKERS
+        from elasticsearch_tpu.ops.bm25 import MAX_CHUNK_Q
+        transient = 8 * part.n_docs_pad * min(max(len(rows), 1),
+                                              MAX_CHUNK_Q)
+        with BREAKERS.breaker("request").limit_scope(
+                transient, "plane_wand_topk"):
+            return dispatch_flat(part.block_docs, part.block_tfs,
+                                 part.doc_lens, part.n_docs_pad, rows,
+                                 live, k, DEFAULT_K1, DEFAULT_B,
+                                 block_avgdl=part.block_avgdl,
+                                 counted=counted, counter=counter)
+
+    # phase A — ONE dispatch for the whole shard: exact-mode members score
+    # every block (counted; final), pruned members their per-segment
+    # P1_BUCKET highest-upper-bound blocks (the same block set the
+    # per-segment path's phase 1 gathers)
+    rows_a = []
+    for qi in range(n_q):
+        segs = [p[qi] if exact_mode[qi] else p[qi].top_by_ub(P1_BUCKET)
+                for _ctx, p, _bb in per_seg]
+        rows_a.append(QueryPlan.concat(
+            segs, idx_offsets=[bb for _c, _p, bb in per_seg]))
+    counted_a = any(exact_mode)
+    got_a = _dispatch(rows_a, k_plane, counted_a)
+    if counted_a:
+        s_a, d_a, h_a = got_a
+    else:
+        s_a, d_a = got_a
+        h_a = None
+    s_a_host = np.asarray(s_a)
+
+    theta = np.full(n_q, -np.inf)
+    for qi in range(n_q):
+        if exact_mode[qi]:
+            continue
+        finite = s_a_host[qi][np.isfinite(s_a_host[qi])]
+        if len(finite) >= want:
+            theta[qi] = float(np.sort(finite)[-want])
+
+    # phase B — ONE dispatch: pruned members' WAND survivors scored
+    # exactly (+ counted); exact members ride as empty rows
+    blocks_total = [0] * n_q
+    blocks_scored = [0] * n_q
+    hits_exact = [True] * n_q
+    need_b = not all(exact_mode)
+    rows_b = []
+    for qi in range(n_q):
+        segs = []
+        for _ctx, plans, _bb in per_seg:
+            p = plans[qi]
+            if exact_mode[qi]:
+                blocks_total[qi] += p.n_blocks
+                blocks_scored[qi] += p.n_blocks
+                segs.append(empty_plan)
+                continue
+            surv = p.survivors(float(theta[qi]))
+            p1_cost = min(p.n_blocks, P1_BUCKET)
+            blocks_total[qi] += p.n_blocks
+            blocks_scored[qi] += min(surv.n_blocks + p1_cost, p.n_blocks)
+            hits_exact[qi] = hits_exact[qi] and surv.n_blocks >= p.n_blocks
+            segs.append(surv)
+        rows_b.append(QueryPlan.concat(
+            segs, idx_offsets=[bb for _c, _p, bb in per_seg]))
+    if need_b:
+        s_b, d_b, h_b = _dispatch(rows_b, k_plane, True)
+    else:
+        s_b = d_b = h_b = None
+
+    out: List[Tuple] = []
+    for qi in range(n_q):
+        if not has_terms[qi]:
+            out.append(empty)
+            continue
+        if exact_mode[qi]:
+            s_row, d_row = np.asarray(s_a)[qi], np.asarray(d_a)[qi]
+            hits_seen = int(np.asarray(h_a)[qi]) if h_a is not None else 0
+        else:
+            s_row, d_row = np.asarray(s_b)[qi], np.asarray(d_b)[qi]
+            hits_seen = int(np.asarray(h_b)[qi]) if h_b is not None else 0
+        finite = s_row != -np.inf
+        si, local = part.demux(d_row[finite])
+        candidates = [ShardDoc(int(s_i), int(d_i), float(sc), (float(sc),))
+                      for s_i, d_i, sc in zip(si, local, s_row[finite])]
+        candidates.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+        max_score = max((c.score for c in candidates), default=None)
+        prune = (blocks_total[qi], blocks_scored[qi])
+        if hits_seen >= track_limit:
+            out.append((candidates, track_limit, "gte", max_score, prune))
+        elif hits_exact[qi] or exact_mode[qi]:
+            out.append((candidates, hits_seen, "eq", max_score, prune))
+        else:
+            out.append((candidates, None, None, max_score, prune))
+
+    # members whose pruned counts might hide hits: one exact unpruned
+    # counted pass (k=1; scores already final) — still ONE dispatch
+    recount = [qi for qi in range(n_q) if out[qi][1] is None]
+    if recount:
+        rows_r = []
+        for qi in range(n_q):
+            if qi in recount:
+                rows_r.append(QueryPlan.concat(
+                    [p[qi] for _c, p, _bb in per_seg],
+                    idx_offsets=[bb for _c, _p, bb in per_seg]))
+            else:
+                rows_r.append(empty_plan)
+        _s, _d, h_r = _dispatch(rows_r, 1, True)
+        h_r = np.asarray(h_r)
+        for qi in recount:
+            candidates, _, _, max_score, prune = out[qi]
+            exact_hits = int(h_r[qi])
+            if exact_hits > track_limit:
+                out[qi] = (candidates, track_limit, "gte", max_score,
+                           prune)
+            else:
+                out[qi] = (candidates, exact_hits, "eq", max_score, prune)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# kNN: one matmul (or one shard-level IVF probe) over the vector plane
+# ---------------------------------------------------------------------------
+
+def plane_ann_route(ctx0, part: PlaneVectors, field: str, k: int,
+                    num_candidates: int) -> Optional[Tuple]:
+    """Shard-level IVF routing over the plane — the plane analog of
+    execute.ann_segment_route, shared by the solo kNN rewrite and the
+    batched executor so their ANN results are identical by construction.
+    None = exact plane path; else (index, rows, oversample, nprobe) with
+    index None when the field holds no vectors at all."""
+    from elasticsearch_tpu.search.execute import ANN_DEFAULT_MIN_DOCS
+    mapper = ctx0.mappers.mapper(field)
+    opts = getattr(mapper, "index_options", None) or {}
+    wants_ivf = opts.get("type") == "ivf"
+    if opts.get("type") not in (None, "ivf"):
+        return None
+    if not wants_ivf:
+        # auto-sizing must agree with the per-segment fallback's routing
+        # decision, or plane residency would silently flip EXACT results
+        # to approximate ones: take the shard-level IVF only when every
+        # vector-bearing segment would take the per-segment IVF anyway
+        sizes = [s.n_docs for s in part.segments
+                 if s.vectors.get(field) is not None]
+        if not sizes or min(sizes) < ANN_DEFAULT_MIN_DOCS:
+            return None
+    from elasticsearch_tpu.utils.errors import CircuitBreakingError
+    try:
+        index, rows = part.ivf_index(opts.get("nlist"))
+    except CircuitBreakingError:
+        return None         # over budget: the exact plane path serves
+    if index is None:
+        return (None, rows, 0, 0)
+    oversample = min(max(2 * k, k + 16), len(rows))
+    nprobe = opts.get("nprobe") or max(
+        1, int(np.ceil(num_candidates / max(index.list_len, 1))))
+    return (index, rows, oversample, nprobe)
+
+
+def _probe_plane(index, queries: np.ndarray, k: int, nprobe: int,
+                 rows: np.ndarray, live_host: np.ndarray, part,
+                 oversample: int) -> List[List[Tuple[int, int, float]]]:
+    """Batched shard-IVF probe + the host-side demux: list-row ids map to
+    plane docs through ``rows``, deleted docs drop out post-probe, plane
+    docs translate to (segment_idx, local_doc) through the base offsets."""
+    scores, ids = index.search(np.asarray(queries, np.float32),
+                               oversample, nprobe=nprobe)
+    out = []
+    for qi in range(scores.shape[0]):
+        valid = ids[qi] >= 0
+        docs = rows[ids[qi][valid]]
+        alive = (docs < len(live_host)) & live_host[
+            np.minimum(docs, max(len(live_host) - 1, 0))]
+        docs, kept = docs[alive], scores[qi][valid][alive]
+        si, local = part.demux(docs)       # one vectorized demux per query
+        hits = [(int(a), int(b), float(s))
+                for a, b, s in zip(si[:k], local[:k], kept[:k])]
+        out.append(hits)
+    return out
+
+
+def _filter_mask_rows(ctxs, part, specs, exact_idx) -> Tuple[Any, bool]:
+    """Per-member plane filter masks: each DISTINCT filter executes once
+    per segment (the solo path's filter-context mask builders) and its
+    per-segment masks stack into plane doc space. Returns (masks, shared):
+    masks None (no filters), a [N_pad] jnp mask (every member agrees — the
+    autocomplete / faceted-nav shape), or a [B, N_pad] np stack."""
+    from elasticsearch_tpu.search.execute import execute as execute_query
+    fkeys = {specs[qi].filter_key for qi in exact_idx}
+    if fkeys == {None}:
+        return None, False
+    by_key: Dict[Optional[str], np.ndarray] = {}
+    for qi in exact_idx:
+        s_qi = specs[qi]
+        if s_qi.filter is None or s_qi.filter_key in by_key:
+            continue
+        row = np.zeros(part.n_docs_pad, bool)
+        for pos, ctx in enumerate(ctxs):
+            _, fmask = execute_query(s_qi.filter, ctx)
+            base = int(part.doc_base[pos])
+            n = ctx.segment.n_docs
+            row[base: base + n] = np.asarray(fmask)[:n]
+        by_key[s_qi.filter_key] = row
+    if len(fkeys) == 1:
+        return jnp.asarray(by_key[next(iter(fkeys))]), True
+    rows = np.ones((len(exact_idx), part.n_docs_pad), bool)
+    for r, qi in enumerate(exact_idx):
+        fk = specs[qi].filter_key
+        if fk is not None:
+            rows[r] = by_key[fk]
+    return rows, False
+
+
+def _quantized_topk(part: PlaneVectors, vectors: np.ndarray, live,
+                    masks, k: int, counter: Optional[list] = None):
+    """int8 coarse pass over the full plane + exact f32 re-rank of the
+    top-k' candidates. Returns (scores [B, k], plane docs [B, k]) or None
+    when the quantized mirror is unavailable (breaker) or the corpus is
+    too small for the coarse pass to pay."""
+    mirror = part.quantized_mirror()
+    if mirror is None:
+        return None
+    kprime = min(max(int(PLANES.rerank_depth), k), part.n_docs_pad)
+    if part.n_docs_total <= 4 * kprime:
+        return None         # coarse+rerank would cost more than exact
+    q8, scales = mirror
+    from elasticsearch_tpu.ops.knn import (
+        knn_coarse_candidates, knn_coarse_candidates_masked,
+        knn_rerank_exact, knn_rerank_exact_masked, pad_mask_rows_pow2,
+        pad_queries_pow2,
+    )
+    q_host, n_real = pad_queries_pow2(vectors)
+    allowed = live & part.exists
+    queries = jnp.asarray(q_host)
+    if counter is not None:
+        counter.append(1)
+    if masks is not None and getattr(masks, "ndim", 1) == 2:
+        m_dev = jnp.asarray(pad_mask_rows_pow2(masks, q_host.shape[0]))
+        cand = knn_coarse_candidates_masked(
+            q8, scales, part.norms, allowed, queries, m_dev, kprime,
+            part.similarity)
+        s, d = knn_rerank_exact_masked(
+            part.matrix, part.norms, allowed, queries, cand, m_dev, k,
+            part.similarity)
+    else:
+        if masks is not None:
+            allowed = allowed & masks       # shared filter mask
+        cand = knn_coarse_candidates(q8, scales, part.norms, allowed,
+                                     queries, kprime, part.similarity)
+        s, d = knn_rerank_exact(part.matrix, part.norms, allowed,
+                                queries, cand, k, part.similarity)
+    PLANES.stats["quantized_queries"] += n_real
+    return s[:n_real], d[:n_real]
+
+
+def plane_knn_winners(ctxs, part: PlaneVectors, field: str, specs,
+                      k: int,
+                      check_members: Optional[Callable[[], None]] = None,
+                      stats: Optional[Dict[str, float]] = None,
+                      counter: Optional[list] = None
+                      ) -> List[List[Tuple[int, int, float]]]:
+    """Q kNN queries over the vector plane. ``specs`` need query_vector /
+    filter / filter_key / num_candidates attributes (the batch executor's
+    BatchSpec, or the solo rewrite's one-element shim). Returns one
+    [(segment_idx, local_doc, raw_score)] winner list (len <= k, score
+    order) per member — exactly what the per-segment merge produces.
+
+    Raises PlaneFallback when IVF-routed members disagree on the implied
+    probe width (mirrors the per-segment batch rule)."""
+    reader = _reader_of(ctxs)
+    n_q = len(specs)
+    vectors = np.asarray([s.query_vector for s in specs], np.float32)
+    winners: List[List[Tuple[int, int, float]]] = [[] for _ in range(n_q)]
+    unfiltered = [qi for qi in range(n_q) if specs[qi].filter is None]
+
+    route = None
+    if unfiltered:
+        route = plane_ann_route(ctxs[0], part, field, k,
+                                specs[unfiltered[0]].num_candidates)
+    if route is not None:
+        index, rows, oversample, nprobe = route
+        distinct_nc = {specs[qi].num_candidates for qi in unfiltered}
+        if index is not None and len(distinct_nc) > 1:
+            widths = {plane_ann_route(ctxs[0], part, field, k, nc)[3]
+                      for nc in distinct_nc}
+            if len(widths) > 1:
+                raise PlaneFallback(
+                    "IVF-routed members' num_candidates imply different "
+                    "nprobe")
+        if index is not None:
+            if check_members is not None:
+                check_members()
+            if counter is not None:
+                counter.append(1)
+            probed = _probe_plane(index, vectors[unfiltered], k, nprobe,
+                                  rows, _live_host(reader), part,
+                                  oversample)
+            for qi, hits in zip(unfiltered, probed):
+                winners[qi] = hits
+        exact_idx = [qi for qi in range(n_q)
+                     if specs[qi].filter is not None]
+    else:
+        exact_idx = list(range(n_q))
+
+    if exact_idx:
+        if check_members is not None:
+            check_members()
+        live = part.live_mask(reader.live_masks)
+        masks, shared = _filter_mask_rows(ctxs, part, specs, exact_idx)
+        if shared and stats is not None:
+            stats["knn_shared_mask_segments"] = \
+                stats.get("knn_shared_mask_segments", 0) + 1
+        k_plane = min(k, part.n_docs_pad)
+        # the matmul materializes a [B, n_docs_pad] f32 score plane over
+        # the whole shard: charge the request breaker before dispatch
+        from elasticsearch_tpu.indices.breaker import BREAKERS
+        transient = 8 * part.n_docs_pad * len(exact_idx)
+        with BREAKERS.breaker("request").limit_scope(
+                transient, "plane_knn"):
+            got = None
+            if PLANES.quantized:
+                got = _quantized_topk(part, vectors[exact_idx], live,
+                                      masks, k_plane, counter=counter)
+            if got is None:
+                from elasticsearch_tpu.ops.knn import KnnExecutor
+                if counter is not None:
+                    counter.append(1)
+                got = KnnExecutor(part).top_k_batch(
+                    vectors[exact_idx], live, k_plane, masks)
+        s, d = np.asarray(got[0]), np.asarray(got[1])
+        for row, qi in enumerate(exact_idx):
+            finite = (s[row] > -np.inf) & (d[row] >= 0)
+            si, local = part.demux(d[row][finite])
+            winners[qi] = [(int(a), int(b), float(sc)) for a, b, sc in
+                           zip(si, local, s[row][finite])]
+    for qi in range(n_q):
+        winners[qi].sort(key=lambda x: -x[2])
+        winners[qi] = winners[qi][:k]
+    return winners
+
+
+# ---------------------------------------------------------------------------
+# sparse: one gather/scatter over the rank_features plane
+# ---------------------------------------------------------------------------
+
+def plane_sparse_topk(ctxs, part, field: str,
+                      expansions: List[List[Tuple[str, float]]],
+                      want: int,
+                      check_members: Optional[Callable[[], None]] = None,
+                      counter: Optional[list] = None) -> List[Tuple]:
+    """Q resolved expansions scored over the stacked feature blocks in
+    ONE device dispatch, exact per-member match counts off the score
+    plane. Returns per member (candidates, total, max_score)."""
+    from elasticsearch_tpu.ops.sparse import sparse_topk_batch
+    reader = _reader_of(ctxs)
+    live = part.live_mask(reader.live_masks)
+    per = []
+    for expansion in expansions:
+        idx_parts, w_parts = [], []
+        for _pos, ff, block_base in part.refs:
+            for name, weight in expansion:
+                t_idx = ff.feature_block_idx(name)
+                if len(t_idx):
+                    idx_parts.append(t_idx + np.int32(block_base))
+                    w_parts.append(np.full(len(t_idx), weight,
+                                           np.float32))
+        if idx_parts:
+            per.append((np.concatenate(idx_parts),
+                        np.concatenate(w_parts)))
+        else:
+            per.append((np.zeros(0, np.int32), np.zeros(0, np.float32)))
+    qb_pad = next_pow2(max((len(i) for i, _ in per), default=1),
+                       minimum=8)
+    n_real = len(per)
+    q_n = next_pow2(max(n_real, 1), minimum=1)
+    idx = np.zeros((q_n, qb_pad), np.int32)
+    w = np.zeros((q_n, qb_pad), np.float32)
+    for i, (bi, bw) in enumerate(per):
+        idx[i, : len(bi)] = bi
+        w[i, : len(bw)] = bw
+    if check_members is not None:
+        check_members()
+    if counter is not None:
+        counter.append(1)
+    k_plane = min(max(want, 1), part.n_docs_pad)
+    from elasticsearch_tpu.indices.breaker import BREAKERS
+    with BREAKERS.breaker("request").limit_scope(
+            8 * part.n_docs_pad * q_n, "plane_sparse"):
+        s, d, h = sparse_topk_batch(
+            part.block_docs, part.block_weights, jnp.asarray(idx),
+            jnp.asarray(w), jnp.float32(1.0), jnp.float32(1.0), live,
+            part.n_docs_pad, k_plane, "linear", counted=True)
+    s, d, h = np.asarray(s), np.asarray(d), np.asarray(h)
+    out = []
+    for qi in range(n_real):
+        finite = s[qi] != -np.inf
+        si, local = part.demux(d[qi][finite])
+        cands = [ShardDoc(int(a), int(b), float(sc), (float(sc),))
+                 for a, b, sc in zip(si, local, s[qi][finite])]
+        cands.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+        max_score = max((c.score for c in cands), default=None)
+        out.append((cands, int(h[qi]), max_score))
+    return out

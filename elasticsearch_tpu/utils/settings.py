@@ -327,6 +327,43 @@ SEARCH_BATCH_TARGET_OCCUPANCY: Setting[int] = Setting.int_setting(
     "search.batch.target_occupancy", 4, min_value=2,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
+# Packed multi-segment device plane (ops/device_segment.py PlaneRegistry):
+# a shard's live segments concatenated into one device-resident plane per
+# (kind, field) so scoring is one program regardless of segment count.
+# enabled=false restores the per-segment dispatch path byte-for-byte.
+SEARCH_PLANE_ENABLED: Setting[bool] = Setting.bool_setting(
+    "search.plane.enabled", True,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# shards below this segment count serve per-segment (a one-segment plane
+# would only double HBM residency for zero dispatch savings)
+SEARCH_PLANE_MIN_SEGMENTS: Setting[int] = Setting.int_setting(
+    "search.plane.min_segments", 2, min_value=1,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# quantized coarse-pass re-rank depth: the int8 coarse pass keeps this
+# many candidates per query for the exact f32 re-rank — top-k is
+# identical to the exact path as long as the true top-k survives the
+# coarse pass, which this depth controls
+SEARCH_PLANE_RERANK_DEPTH: Setting[int] = Setting.int_setting(
+    "search.plane.rerank_depth", 128, min_value=1, max_value=65536,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# int8 coarse pass + exact f32 re-rank for plane kNN; false = every plane
+# kNN query runs fully exact
+SEARCH_PLANE_QUANTIZED: Setting[bool] = Setting.bool_setting(
+    "search.plane.quantized", True,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# per-plane residency ceiling in bytes (0 = breaker-only budgeting); a
+# plane over the cap is refused AT ADMISSION and the shard serves
+# per-segment. Lazily-added components (quantized mirror, shard IVF)
+# are charged to the device breaker and counted in residency stats but
+# not re-checked against this cap
+SEARCH_PLANE_MAX_BYTES: Setting[int] = Setting.int_setting(
+    "search.plane.max_bytes", 0, min_value=0,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
 # gateway.recover_after_data_nodes-style fleet-completeness release: when
 # this many data nodes have joined AND answered the shard-state fetch,
 # allocation stops waiting out EXISTING_COPY_GRACE for absent copy-holders

@@ -114,3 +114,8 @@ def evict_device_caches(reader) -> None:
         # filter-cache entries hold device masks too
         if hasattr(seg, "_filter_cache"):
             seg._filter_cache.clear()
+    # a packed multi-segment plane over these segments is residency too
+    import sys
+    mod = sys.modules.get("elasticsearch_tpu.ops.device_segment")
+    if mod is not None:
+        mod.PLANES.drop_segments(seg.uid for seg in reader.segments)

@@ -1,0 +1,374 @@
+"""Packed multi-segment device plane: golden parity + chaos cases.
+
+The plane (ops/device_segment.py PlaneRegistry + search/plane_exec.py)
+must be invisible in results: with the plane resident, hits, scores,
+totals and relations are identical to the per-segment path for every
+query class (bm25 / exact kNN / filtered kNN / sparse), the quantized
+coarse pass + exact f32 re-rank returns the identical top-k at the
+configured depth, and a refused/evicted plane (HBM budget, breaker trip)
+degrades to per-segment scoring with correct results — never an OOM,
+never a wrong hit.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index import InternalEngine
+from elasticsearch_tpu.indices.breaker import BREAKERS
+from elasticsearch_tpu.mapping import MapperService
+from elasticsearch_tpu.ops.device_segment import PLANES
+from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search.phase import parse_sort, query_shard
+
+# CHAOS_SEEDS=N widens the seeded sweeps, like the other chaos suites
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+pytestmark = pytest.mark.plane
+
+
+@pytest.fixture(autouse=True)
+def _plane_defaults():
+    """Every test starts from default plane config and an empty registry
+    (the registry is process-global, like the breaker service)."""
+    PLANES.clear()
+    PLANES.enabled = True
+    PLANES.min_segments = 2
+    PLANES.rerank_depth = 128
+    PLANES.quantized = True
+    PLANES.max_bytes = 0
+    yield
+    PLANES.clear()
+    PLANES.enabled = True
+    PLANES.quantized = True
+    PLANES.rerank_depth = 128
+    PLANES.max_bytes = 0
+
+
+def _engine(seed: int, n_docs: int = 240, cuts=(80, 160), ivf: bool = False):
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(40)]
+    vec_mapping = {"type": "dense_vector", "dims": 8,
+                   "similarity": "cosine"}
+    if ivf:
+        vec_mapping["index_options"] = {"type": "ivf", "nlist": 8,
+                                        "nprobe": 8}
+    eng = InternalEngine(
+        MapperService({"properties": {
+            "body": {"type": "text"},
+            "vec": vec_mapping,
+            "feats": {"type": "rank_features"},
+            "tag": {"type": "keyword"}}}),
+        shard_label=f"pl{seed}{'i' if ivf else ''}")
+    for i in range(n_docs):
+        eng.index(str(i), {
+            "body": " ".join(rng.choice(
+                vocab, size=int(rng.integers(4, 18)))),
+            "vec": [float(x) for x in rng.standard_normal(8)],
+            "feats": {f"f{j}": float(rng.random() + 0.1)
+                      for j in rng.integers(0, 15, 3)},
+            "tag": f"t{i % 3}"})
+        if i in cuts:
+            eng.refresh()
+    eng.refresh()
+    return eng, rng
+
+
+def _bodies(rng):
+    qv = [float(x) for x in rng.standard_normal(8)]
+    return [
+        {"match": {"body": "w1 w3 w7"}},
+        {"knn": {"field": "vec", "k": 7, "query_vector": qv}},
+        {"knn": {"field": "vec", "k": 7, "query_vector": qv,
+                 "filter": {"term": {"tag": "t1"}}}},
+        {"text_expansion": {"feats": {"tokens": {
+            "f1": 1.2, "f4": 0.7, "f9": 0.4}}}},
+    ]
+
+
+def _run(eng, reader, body, track=10_000, size=10):
+    return query_shard(reader, eng.mappers, dsl.parse_query(body),
+                       size=size, sort=parse_sort(None),
+                       track_total_hits=track)
+
+
+def _assert_same(r_a, r_b):
+    assert [(d.segment_idx, d.doc) for d in r_a.docs] == \
+        [(d.segment_idx, d.doc) for d in r_b.docs]
+    np.testing.assert_allclose([d.score for d in r_a.docs],
+                               [d.score for d in r_b.docs],
+                               rtol=1e-6, atol=1e-7)
+    assert r_a.total_hits == r_b.total_hits
+    assert r_a.total_relation == r_b.total_relation
+    if r_a.max_score is None:
+        assert r_b.max_score is None
+    else:
+        np.testing.assert_allclose(r_a.max_score, r_b.max_score,
+                                   rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# golden parity: plane path vs solo per-segment path, all query classes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [31 + 1000 * k for k in range(CHAOS_SEEDS)])
+@pytest.mark.parametrize("track", [10_000, 5, False])
+def test_golden_plane_vs_per_segment_parity(seed, track):
+    eng, rng = _engine(seed)
+    reader = eng.acquire_reader()
+    for body in _bodies(rng):
+        PLANES.enabled = False
+        solo = _run(eng, reader, body, track=track)
+        PLANES.enabled = True
+        plane = _run(eng, reader, body, track=track)
+        _assert_same(solo, plane)
+    assert PLANES.stats["plane_builds"] >= 3
+
+
+@pytest.mark.parametrize("seed", [37 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_golden_plane_parity_with_deletes(seed):
+    """Plane live masks come from the reader snapshot: deleted docs stay
+    out of plane results without invalidating the plane itself."""
+    eng, rng = _engine(seed)
+    for i in range(0, 240, 7):
+        eng.delete(str(i))
+    eng.refresh()
+    reader = eng.acquire_reader()
+    for body in _bodies(rng):
+        PLANES.enabled = False
+        solo = _run(eng, reader, body)
+        PLANES.enabled = True
+        plane = _run(eng, reader, body)
+        _assert_same(solo, plane)
+        deleted = {str(i) for i in range(0, 240, 7)}
+        for d in plane.docs:
+            doc_id = reader.segments[d.segment_idx].ids[d.doc]
+            assert doc_id not in deleted
+
+
+@pytest.mark.parametrize("seed", [41 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_quantized_coarse_pass_identical_topk(seed):
+    """int8 coarse pass + exact f32 re-rank: identical top-k docs AND
+    scores at the default re-rank depth (re-ranking runs the exact
+    kernels' arithmetic), for plain and filtered kNN."""
+    eng, rng = _engine(seed, n_docs=400, cuts=(130, 260))
+    reader = eng.acquire_reader()
+    PLANES.rerank_depth = 32      # engage the coarse pass on this corpus
+    for body in _bodies(rng)[1:3]:
+        PLANES.quantized = False
+        exact = _run(eng, reader, body)
+        PLANES.quantized = True
+        quant = _run(eng, reader, body)
+        _assert_same(exact, quant)
+    assert PLANES.stats["quantized_queries"] >= 1
+
+
+@pytest.mark.parametrize("seed", [47 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_ivf_shard_plane_solo_batch_identical(seed):
+    """IVF-opted mapping: solo rewrite and batched executor share ONE
+    shard-level IVF index over the plane — identical hits — and every
+    returned score is the true (exactly recomputed) similarity of that
+    doc: approximate recall, never wrong scores."""
+    from elasticsearch_tpu.search.batch_executor import (
+        _build_ctxs, batched_knn_shard, classify_request,
+    )
+    eng, rng = _engine(seed, ivf=True)
+    reader = eng.acquire_reader()
+    mappers = eng.mappers
+    bodies = [{"knn": {"field": "vec", "k": 6, "query_vector":
+                       [float(x) for x in rng.standard_normal(8)]}}
+              for _ in range(3)]
+    solos = [_run(eng, reader, b, size=5) for b in bodies]
+    ctxs = _build_ctxs(reader, mappers,
+                       sum(s.n_docs for s in reader.segments), None)
+    specs = []
+    for b in bodies:
+        spec = classify_request(
+            {"index": "i", "shard": 0, "window": 5,
+             "body": {"query": b}}, mappers)
+        assert spec is not None and spec.kind == "knn"
+        specs.append(spec)
+    batch = batched_knn_shard(ctxs, "vec", specs, 6)
+    for body, solo, (cands, total, rel, _ms, _p) in zip(bodies, solos,
+                                                        batch):
+        assert [(c.segment_idx, c.doc) for c in cands[:5]] == \
+            [(c.segment_idx, c.doc) for c in solo.docs]
+        np.testing.assert_allclose([c.score for c in cands[:5]],
+                                   [d.score for d in solo.docs],
+                                   rtol=1e-5)
+        assert total == solo.total_hits
+        # wrong-hit check: recompute each returned score exactly
+        qv = np.asarray(body["knn"]["query_vector"], np.float32)
+        for c in cands[:5]:
+            seg = reader.segments[c.segment_idx]
+            row = seg.vectors["vec"].matrix[c.doc]
+            cos = float(row @ qv) / (
+                (np.linalg.norm(row) * np.linalg.norm(qv)) + 1e-30)
+            np.testing.assert_allclose(c.score, (1.0 + cos) / 2.0,
+                                       rtol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# chaos: refresh-during-query, breaker/budget eviction mid-query
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [53 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_refresh_during_query_incremental_append(seed):
+    """A refresh between queries appends to the plane incrementally; a
+    reader acquired BEFORE the refresh still answers from its own segment
+    set (point-in-time), parity intact on both sides."""
+    eng, rng = _engine(seed)
+    old_reader = eng.acquire_reader()
+    bodies = _bodies(rng)
+    before = [_run(eng, old_reader, b) for b in bodies]
+    appends0 = PLANES.stats["plane_incremental_appends"]
+
+    for i in range(240, 300):
+        eng.index(str(i), {
+            "body": "w1 w3 fresh",
+            "vec": [float(x) for x in rng.standard_normal(8)],
+            "feats": {"f1": 2.0},
+            "tag": "t0"})
+    eng.refresh()
+    # the shard-level hook calls this on refresh; the bare engine has no
+    # IndexShard, so publish the same way it would
+    PLANES.on_refresh(eng.segments)
+    assert PLANES.stats["plane_incremental_appends"] > appends0
+
+    new_reader = eng.acquire_reader()
+    for body, old in zip(bodies, before):
+        # the old reader's view is unchanged (point-in-time)
+        again = _run(eng, old_reader, body)
+        _assert_same(old, again)
+        # the new reader sees the appended docs, plane vs per-segment
+        PLANES.enabled = False
+        solo = _run(eng, new_reader, body)
+        PLANES.enabled = True
+        plane = _run(eng, new_reader, body)
+        _assert_same(solo, plane)
+    match_new = _run(eng, new_reader, {"match": {"body": "fresh"}})
+    assert match_new.total_hits == 60
+
+
+@pytest.mark.parametrize("seed", [59 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_breaker_eviction_degrades_to_per_segment(seed):
+    """Forced low HBM budget: the plane is refused (device breaker) or
+    capped (search.plane.max_bytes); queries degrade to per-segment
+    scoring with identical results — no OOM, no wrong hits, no error."""
+    eng, rng = _engine(seed)
+    reader = eng.acquire_reader()
+    bodies = _bodies(rng)
+    golden = [_run(eng, reader, b) for b in bodies]      # plane path
+    assert PLANES.stats["plane_builds"] >= 3
+
+    # budget cap: every plane refused up front
+    PLANES.clear()
+    PLANES.max_bytes = 1
+    misses0 = PLANES.stats["plane_miss_fallbacks"]
+    for body, want in zip(bodies, golden):
+        _assert_same(want, _run(eng, reader, body))
+    assert PLANES.stats["plane_miss_fallbacks"] > misses0
+    PLANES.max_bytes = 0
+
+    # breaker trip mid-stream: leave room for the per-segment mirrors
+    # (already resident) but not for any plane rebuild
+    PLANES.clear()
+    device = BREAKERS.breaker("device")
+    old_limit = device.limit
+    try:
+        device.limit = device.used + 64
+        misses1 = PLANES.stats["plane_miss_fallbacks"]
+        for body, want in zip(bodies, golden):
+            _assert_same(want, _run(eng, reader, body))
+        assert PLANES.stats["plane_miss_fallbacks"] > misses1
+    finally:
+        device.limit = old_limit
+
+
+@pytest.mark.parametrize("seed", [67 + 1000 * k for k in range(CHAOS_SEEDS)])
+def test_eviction_between_queries_then_rebuild(seed):
+    """evict_cold() between queries (LRU pressure): the in-flight results
+    already served stay valid, the next query transparently rebuilds."""
+    eng, rng = _engine(seed)
+    reader = eng.acquire_reader()
+    body = _bodies(rng)[0]
+    first = _run(eng, reader, body)
+    evictions0 = PLANES.stats["plane_evictions"]
+    PLANES.evict_cold()
+    assert PLANES.stats["plane_evictions"] > evictions0
+    second = _run(eng, reader, body)
+    _assert_same(first, second)
+    assert PLANES.stats_snapshot()["planes_resident"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# observability + master-routed health satellite
+# ---------------------------------------------------------------------------
+
+def test_device_plane_stats_surface():
+    from elasticsearch_tpu import monitor
+    eng, rng = _engine(71)
+    reader = eng.acquire_reader()
+    _run(eng, reader, _bodies(rng)[0])
+    st = monitor.device_plane_stats()
+    for key in ("plane_builds", "plane_full_rebuilds",
+                "plane_incremental_appends", "plane_evictions",
+                "plane_miss_fallbacks", "resident_bytes",
+                "planes_resident", "rerank_depth", "quantized"):
+        assert key in st, key
+    assert st["resident_bytes"]["postings"] > 0
+
+
+def test_cluster_health_routed_through_master(tmp_path):
+    """Non-master `_cluster/health` answers from the elected master's
+    view, so the unverified-STARTED gate holds cluster-wide: when the
+    master marks a STARTED copy unverified, a non-master node's health
+    must not say green during the verify window."""
+    from elasticsearch_tpu.testing import InProcessCluster
+    c = InProcessCluster(n_nodes=2, seed=7, data_path=str(tmp_path))
+    c.start()
+    try:
+        client = c.client()
+        resp, err = c.call(lambda cb: client.create_index("h", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb))
+        assert err is None, err
+        c.ensure_green("h")
+        master = c.master()
+        non_master = next(n for n in c.nodes.values()
+                          if n.node_id != master.node_id)
+
+        # both nodes agree on green through the routed path
+        h, err = c.call(lambda cb: non_master.client.cluster_health_async(
+            None, cb))
+        assert err is None and h["status"] == "green"
+
+        # master marks a STARTED copy unverified (a reboot under verify):
+        # the non-master's ROUTED health must drop out of green even
+        # though its local routing still says STARTED everywhere
+        sr = next(s for s in master.coordinator.applied_state
+                  .routing_table.index("h").all_shards())
+        master.gateway_allocator._unverified[
+            (sr.index, sr.shard_id, sr.node_id)] = {"hard": True}
+        try:
+            local = non_master.client.cluster_health()
+            assert local["status"] == "green"      # the old blind spot
+            routed, err = c.call(
+                lambda cb: non_master.client.cluster_health_async(
+                    None, cb))
+            assert err is None
+            assert routed["status"] != "green"
+        finally:
+            master.gateway_allocator._unverified.clear()
+    finally:
+        c.stop()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [83 + 1000 * k for k in range(max(5, CHAOS_SEEDS))])
+def test_plane_parity_sweep_slow(seed):
+    """CI sweep: the golden parity suite across a wider seed band."""
+    test_golden_plane_vs_per_segment_parity(seed, 10_000)
+    test_refresh_during_query_incremental_append(seed + 1)
+    test_breaker_eviction_degrades_to_per_segment(seed + 2)
