@@ -921,6 +921,223 @@ def cfg_segmented(np, jax, jnp, result):
 
 # ---------------------------------------------------------------------------
 
+def multichip_scaling(per_shard_docs: int = 0, q_batch: int = 8,
+                      iters: int = 3) -> dict:
+    """Mesh-sharded plane capacity scaling (ROADMAP item 2's target):
+    fixed docs per shard, shards mapped 1:1 onto mesh slots/devices —
+    each added device adds corpus at CONSTANT device dispatches per
+    query (text: 2 phases, kNN: 1 matmul, independent of shard count),
+    vs the per-shard plane fan-out whose dispatches grow linearly.
+
+    Runs on whatever devices the process sees (the tests' 8 virtual CPU
+    devices, a real TPU slice, or 1 device — the single-device mesh is
+    the golden-parity baseline). Returns the MULTICHIP dict; also used
+    by __graft_entry__.dryrun_multichip so the driver's MULTICHIP_r0*
+    tail finally records the scaling it was named for."""
+    import jax
+    import numpy as np
+
+    from elasticsearch_tpu.index import InternalEngine
+    from elasticsearch_tpu.mapping import MapperService
+    from elasticsearch_tpu.ops.device_segment import MESH_PLANES, PLANES
+    from elasticsearch_tpu.search.batch_executor import (
+        BatchSpec, _build_ctxs,
+    )
+    from elasticsearch_tpu.search.plane_exec import (
+        mesh_knn_winners, mesh_wand_topk, plane_knn_winners,
+        plane_wand_topk,
+    )
+
+    n_devices = len(jax.devices())
+    if not per_shard_docs:
+        per_shard_docs = 2048 if jax.default_backend() != "tpu" \
+            else 1 << 16
+    counts = sorted({c for c in (1, 2, 4, 8, n_devices)
+                     if 1 <= c <= n_devices})
+    out = {"n_devices": n_devices, "per_shard_docs": per_shard_docs,
+           "backend": jax.default_backend(), "per_count": {}}
+    rng = np.random.default_rng(SEED)
+    vocab = [f"w{i}" for i in range(200)]
+    dims = 16
+
+    def build_engine(s: int) -> InternalEngine:
+        eng = InternalEngine(MapperService({"properties": {
+            "body": {"type": "text"},
+            "vec": {"type": "dense_vector", "dims": dims,
+                    "similarity": "cosine"}}}), shard_label=f"mc{s}")
+        r = np.random.default_rng(SEED + s)
+        for i in range(per_shard_docs):
+            eng.index(str(i), {
+                "body": " ".join(r.choice(
+                    vocab, size=int(r.integers(4, 12)),
+                    p=_zipf_p(len(vocab)))),
+                "vec": [float(x) for x in r.standard_normal(dims)]})
+            if i == per_shard_docs // 2:
+                eng.refresh()
+        eng.refresh()
+        return eng
+
+    def _zipf_p(n: int):
+        w = 1.0 / np.arange(1, n + 1)
+        return w / w.sum()
+
+    engines = [build_engine(s) for s in range(max(counts))]
+    mappers = engines[0].mappers
+    clause_lists = [[(f"w{3 + 2 * qi} w{7 + qi} w{11 + qi}", 1.0)]
+                    for qi in range(q_batch)]
+    specs = [BatchSpec(kind="knn", field="vec", window=K,
+                       clip_limit=None, k=K, num_candidates=100,
+                       boost=1.0,
+                       query_vector=[float(x)
+                                     for x in rng.standard_normal(dims)])
+             for _ in range(q_batch)]
+
+    old = (MESH_PLANES.enabled, MESH_PLANES.min_shards,
+           PLANES.enabled, PLANES.min_segments)
+    MESH_PLANES.enabled = True
+    MESH_PLANES.min_shards = 1      # measure the 1-slot baseline too
+    PLANES.enabled = True
+    PLANES.min_segments = 2
+    try:
+        for n_sh in counts:
+            readers = [engines[s].acquire_reader() for s in range(n_sh)]
+            shard_segments = [(("bench", s), list(r.segments))
+                              for s, r in enumerate(readers)]
+            shard_ctxs = []
+            for r in readers:
+                from elasticsearch_tpu.search.phase import (
+                    shard_term_stats,
+                )
+                doc_count = sum(seg.n_docs for seg in r.segments)
+                dfs = {}
+                for cl in clause_lists:
+                    from elasticsearch_tpu.search import dsl
+                    _dc, m_dfs = shard_term_stats(
+                        r, mappers,
+                        dsl.Match(field="body", text=cl[0][0]))
+                    for fname, termmap in m_dfs.items():
+                        dfs.setdefault(fname, {}).update(termmap)
+                shard_ctxs.append(_build_ctxs(r, mappers, doc_count,
+                                              dfs))
+            mp = MESH_PLANES.get(shard_segments, "postings", "body")
+            mv = MESH_PLANES.get(shard_segments, "vectors", "vec")
+            parts = [PLANES.get(list(r.segments), "postings", "body")
+                     for r in readers]
+            vparts = [PLANES.get(list(r.segments), "vectors", "vec")
+                      for r in readers]
+            if mp is None or mv is None or None in parts or \
+                    None in vparts:
+                out["per_count"][str(n_sh)] = {"error": "plane missing"}
+                continue
+
+            entry = {"docs_total": n_sh * per_shard_docs}
+
+            def mesh_text():
+                return mesh_wand_topk(shard_ctxs, mp, "body",
+                                      clause_lists, K, 10_000)
+
+            def fan_text():
+                return [plane_wand_topk(shard_ctxs[s], parts[s], "body",
+                                        clause_lists, K, 10_000)
+                        for s in range(n_sh)]
+
+            def mesh_knn():
+                return mesh_knn_winners(shard_ctxs, mv, "vec", specs, K)
+
+            def fan_knn():
+                return [plane_knn_winners(shard_ctxs[s], vparts[s],
+                                          "vec", specs, K)
+                        for s in range(n_sh)]
+
+            for name, mesh_fn, fan_fn in (
+                    ("bm25", mesh_text, fan_text),
+                    ("knn", mesh_knn, fan_knn)):
+                c_mesh, c_fan = [], []
+                if name == "bm25":
+                    mesh_wand_topk(shard_ctxs, mp, "body", clause_lists,
+                                   K, 10_000, counter=c_mesh)
+                    for s in range(n_sh):
+                        plane_wand_topk(shard_ctxs[s], parts[s], "body",
+                                        clause_lists, K, 10_000,
+                                        counter=c_fan)
+                else:
+                    mesh_knn_winners(shard_ctxs, mv, "vec", specs, K,
+                                     counter=c_mesh)
+                    for s in range(n_sh):
+                        plane_knn_winners(shard_ctxs[s], vparts[s],
+                                          "vec", specs, K,
+                                          counter=c_fan)
+                t_mesh = timed(mesh_fn, iters, lambda _x: None)
+                t_fan = timed(fan_fn, iters, lambda _x: None)
+                entry[name] = {
+                    "qps_mesh": round(iters * q_batch / t_mesh, 2),
+                    "qps_fanout": round(iters * q_batch / t_fan, 2),
+                    "device_dispatches_per_query_mesh": len(c_mesh),
+                    "device_dispatches_per_query_fanout": len(c_fan),
+                }
+            out["per_count"][str(n_sh)] = entry
+
+        # capacity-scaling verdict: dispatches/query stay flat on the
+        # mesh while the corpus grows with the slot count
+        base = out["per_count"].get(str(counts[0]), {})
+        top = out["per_count"].get(str(counts[-1]), {})
+        if "bm25" in base and "bm25" in top:
+            out["constant_dispatches"] = all(
+                top[k]["device_dispatches_per_query_mesh"] ==
+                base[k]["device_dispatches_per_query_mesh"]
+                for k in ("bm25", "knn"))
+            out["capacity_ratio"] = counts[-1] / counts[0]
+    finally:
+        (MESH_PLANES.enabled, MESH_PLANES.min_shards,
+         PLANES.enabled, PLANES.min_segments) = old
+        MESH_PLANES.clear()
+        PLANES.clear()
+    return out
+
+
+def cfg_multichip(np, jax, jnp, result):
+    """MULTICHIP scenario: runs inline when this process already sees
+    >= 2 devices (a TPU slice), else re-execs itself over 8 virtual CPU
+    devices (the XLA host-platform mechanism the test suite uses) so
+    the scaling is still measured on CPU-fallback boxes."""
+    if len(jax.devices()) >= 2:
+        result["configs"]["multichip"] = multichip_scaling()
+        return
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--multichip-child"],
+        env=env, capture_output=True, text=True, timeout=1200)
+    parsed = None
+    for line in reversed((p.stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            parsed = json.loads(line)
+            break
+    if parsed is None:
+        raise RuntimeError(
+            f"multichip child produced no JSON (rc={p.returncode}): "
+            f"{(p.stderr or '')[-300:]!r}")
+    parsed["virtual_devices"] = True
+    result["configs"]["multichip"] = parsed
+
+
+def _multichip_child() -> None:
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already up: use as-is
+        pass
+    print(json.dumps(multichip_scaling()))
+
+
+# ---------------------------------------------------------------------------
+
 def main() -> None:
     result = {"metric": "knn_qps", "value": 0.0, "unit": "qps",
               "vs_baseline": 0.0, "configs": {}, "errors": {}}
@@ -970,7 +1187,8 @@ def main() -> None:
         for name, fn in (("knn", cfg_knn), ("bm25", cfg_bm25),
                          ("ivf", cfg_ivf), ("hybrid", cfg_hybrid),
                          ("sparse", cfg_sparse),
-                         ("segmented", cfg_segmented)):
+                         ("segmented", cfg_segmented),
+                         ("multichip", cfg_multichip)):
             try:
                 if name == "hybrid":
                     fn(np, jax, jnp, result, knn_corpus, bm25_ctx)
@@ -989,4 +1207,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--multichip-child" in sys.argv:
+        _multichip_child()
+    else:
+        main()

@@ -132,6 +132,14 @@ class SearchTransportService:
             ShardQueryBatcher,
         )
         self.batcher = ShardQueryBatcher(self)
+        # mesh-sharded SPMD fan-out executor (search/mesh_executor.py):
+        # a co-located fan-out whose shards' planes are resident on the
+        # local device mesh runs as ONE compiled program per phase;
+        # search.mesh.enabled=false restores the RPC scatter-gather
+        from elasticsearch_tpu.search.mesh_executor import (
+            MeshSearchExecutor,
+        )
+        self.mesh_executor = MeshSearchExecutor(self)
         ts.register_handler(SEARCH_CAN_MATCH, self._on_can_match)
         ts.register_handler(SEARCH_DFS, self._on_dfs)
         ts.register_handler(SEARCH_QUERY, self._on_query)
@@ -558,12 +566,16 @@ class TransportSearchAction:
     def __init__(self, node_id: str, ts: TransportService,
                  state_supplier: Callable[[], ClusterState],
                  task_manager=None, indices: Optional[IndicesService] = None,
-                 mesh_plane=None, thread_pool=None, remote_clusters=None):
+                 mesh_plane=None, thread_pool=None, remote_clusters=None,
+                 search_transport=None):
         self.node_id = node_id
         self.ts = ts
         self.state = state_supplier
         self.task_manager = task_manager
         self.remote_clusters = remote_clusters
+        # the local data-node side (reader contexts + the mesh-sharded
+        # fan-out executor); None in coordinator-only unit tests
+        self.search_transport = search_transport
         if remote_clusters is not None:
             # serve CCS requests arriving FROM other clusters
             ts.register_handler(SEARCH_CCS, self._on_ccs)
@@ -851,12 +863,66 @@ class TransportSearchAction:
                                     t0, live_targets, body, window, from_,
                                     size, phase_state, len(targets), on_done,
                                     overrides))
-            else:
+                return
+
+            def run_query() -> None:
                 self._query_phase(t0, live_targets, body, window, from_,
                                   size, phase_state, len(targets), on_done,
                                   None)
 
+            # mesh-sharded SPMD path: a co-located fan-out (every target
+            # shard's plane resident on this node's device mesh) scores as
+            # ONE compiled program per phase; any miss falls back to the
+            # per-shard scatter-gather, exactly like a plane miss. Runs
+            # AFTER can-match so _shards.skipped is identical to the RPC
+            # fan-out's and the mesh only scores surviving shards.
+            if search_type == "query_then_fetch" and \
+                    self._try_mesh_sharded_path(t0, live_targets, body,
+                                                window, from_, size,
+                                                phase_state, len(targets),
+                                                on_done, run_query):
+                return
+            run_query()
+
         self._can_match_phase(targets, body, phase_state, after_can_match)
+
+    # -- mesh-sharded plane path (SPMD over co-located shards) -----------
+
+    def _try_mesh_sharded_path(self, t0, targets, body, window, from_,
+                               size, phase_state, n_total_shards, on_done,
+                               fallback) -> bool:
+        """Submit the fan-out to the mesh executor; True = submitted (it
+        answers through ``on_done`` or re-enters ``fallback`` on a mesh
+        miss). ``targets`` are the can-match survivors;
+        ``n_total_shards`` the pre-can-match shard count for _shards
+        accounting. Conditions beyond the executor's own eligibility: one
+        concrete index, no per-shard alias filters, no time budget (the
+        RPC path's shard-side deadline enforcement has no mesh analog
+        yet), and >= 2 targets (a single shard's plane already serves in
+        one program)."""
+        if self.search_transport is None or len(targets) < 2:
+            return False
+        if phase_state.get("deadline") is not None:
+            return False
+        index = targets[0]["index"]
+        if any(t["index"] != index or t.get("alias_filter") is not None
+               for t in targets):
+            return False
+
+        def on_results(results) -> None:
+            if results is None:
+                fallback()
+                return
+            phase_state["data_plane"] = "mesh_plane"
+            for target in targets:
+                target["node"] = self.node_id    # fetch runs locally
+            self._merge_and_fetch(t0, targets, results, body, from_,
+                                  size, phase_state, n_total_shards,
+                                  on_done)
+
+        return self.search_transport.mesh_executor.try_submit(
+            index, targets, body, window, phase_state.get("task"),
+            on_results)
 
     # -- mesh one-program path ------------------------------------------
 

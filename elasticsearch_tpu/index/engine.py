@@ -465,6 +465,8 @@ class InternalEngine:
         if mod is not None:
             try:
                 mod.PLANES.drop_segments(seg.uid for seg in to_merge)
+                mod.MESH_PLANES.drop_segments(
+                    seg.uid for seg in to_merge)
             except Exception:  # noqa: BLE001 — cleanup must not fail merge
                 logger.exception("plane invalidation after merge failed")
         return True

@@ -93,8 +93,16 @@ class IndexShard:
         if "elasticsearch_tpu.ops.device_segment" not in sys.modules:
             return      # no device work yet in this process
         try:
-            from elasticsearch_tpu.ops.device_segment import PLANES
+            from elasticsearch_tpu.ops.device_segment import (
+                MESH_PLANES, PLANES,
+            )
             PLANES.on_refresh(self.engine.segments)
+            # mesh-sharded planes this shard participates in re-pack
+            # incrementally too (the other member shards keep their
+            # last-published segment sets)
+            MESH_PLANES.on_refresh(
+                (self.shard_id.index, self.shard_id.shard),
+                self.engine.segments)
         except Exception:  # noqa: BLE001 — publication is an optimization
             pass
 

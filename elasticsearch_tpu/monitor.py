@@ -160,6 +160,22 @@ def device_plane_stats() -> Dict[str, Any]:
     return mod.PLANES.stats_snapshot()
 
 
+def mesh_plane_stats(mesh_executor=None) -> Dict[str, Any]:
+    """Mesh-sharded plane observability (ops/device_segment.py
+    MeshPlaneRegistry + search/mesh_executor.py): builds vs incremental
+    appends, evictions, miss fallbacks, resident bytes (total and per
+    device), plus the fan-out executor's served/fallback/dispatch
+    counters. Never initializes the device layer itself."""
+    import sys
+    mod = sys.modules.get("elasticsearch_tpu.ops.device_segment")
+    if mod is None:
+        return {}
+    out = mod.MESH_PLANES.stats_snapshot()
+    if mesh_executor is not None:
+        out.update(mesh_executor.stats)
+    return out
+
+
 def search_batch_stats(batcher, rrf_fuser=None) -> Dict[str, Any]:
     """Micro-batcher observability (search/batch_executor.py): dispatch /
     occupancy / wait-time counters plus the derived means operators watch

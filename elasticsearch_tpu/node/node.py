@@ -155,7 +155,8 @@ class Node:
             node_id, self.transport_service, self._applied_state,
             task_manager=self.task_manager, indices=self.indices_service,
             mesh_plane=self.mesh_plane, thread_pool=self.thread_pool,
-            remote_clusters=self.remote_clusters)
+            remote_clusters=self.remote_clusters,
+            search_transport=self.search_transport)
         self.broadcast_actions = BroadcastActions(
             node_id, self.indices_service, self.transport_service,
             self._applied_state)
@@ -291,6 +292,10 @@ class Node:
             # packed multi-segment plane residency/rebuild/eviction
             # counters (ops/device_segment.py PlaneRegistry)
             "device_plane": monitor.device_plane_stats(),
+            # mesh-sharded plane residency + SPMD fan-out executor
+            # counters (MeshPlaneRegistry + search/mesh_executor.py)
+            "mesh_plane": monitor.mesh_plane_stats(
+                self.search_transport.mesh_executor),
             # cross-query micro-batching occupancy/wait/dispatch/memo/
             # window-controller counters + coordinator RRF fusion batching
             "search_batch": monitor.search_batch_stats(

@@ -152,6 +152,42 @@ def bm25_topk_flat_counted(*args, **kw):
     return _bm25_flat_kernel(*args, **kw, counted=True)
 
 
+@partial(jax.jit,
+         static_argnames=("n_docs_pad", "n_q", "k", "k1", "b", "n_segs"))
+def _bm25_flat_kernel_seg(block_docs, block_tfs, flat_idx, flat_w, flat_q,
+                          doc_lens, flat_avgdl, live, seg_ids,
+                          n_docs_pad: int, n_q: int, k: int,
+                          k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+                          n_segs: int = 1):
+    """_bm25_flat_kernel with PER-SEGMENT match counts.
+
+    ``seg_ids`` [n_docs_pad] maps each plane doc to its owning segment's
+    position; hits come back [n_q, n_segs]. This is the plane analog of
+    the totals-disabled per-segment contract: each segment reports
+    "candidates found" truncated to the collection window (sum of
+    min(matches, want) per segment), a number the fused whole-plane count
+    cannot reproduce — so the kernel counts where the segments are."""
+    docs = block_docs[flat_idx]
+    tfs = block_tfs[flat_idx]
+    valid = docs >= 0
+    safe = jnp.where(valid, docs, 0)
+    dl = doc_lens[safe]
+    norm = k1 * (1.0 - b + b * dl / flat_avgdl[:, None])
+    contrib = flat_w[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+    contrib = jnp.where(valid, contrib, 0.0)
+    tgt = flat_q[:, None] * n_docs_pad + safe
+    scores = jnp.zeros((n_q * n_docs_pad,), jnp.float32)
+    scores = scores.at[tgt.reshape(-1)].add(contrib.reshape(-1),
+                                            mode="drop")
+    scores = scores.reshape(n_q, n_docs_pad)
+    matched = live[None, :] & (scores > 0.0)
+    scores = jnp.where(matched, scores, -jnp.inf)
+    s, d = jax.lax.top_k(scores, k)
+    onehot = jax.nn.one_hot(seg_ids, n_segs, dtype=jnp.int32)
+    hits = matched.astype(jnp.int32) @ onehot       # [n_q, n_segs]
+    return s, d, hits
+
+
 def flatten_plans(plans, fb_pad: int):
     """Concatenate per-query plans into flat (idx, w, qid) arrays of
     length fb_pad (block 0 / weight 0 / query 0 as padding)."""
@@ -596,7 +632,8 @@ def dispatch_flat(block_docs, block_tfs, doc_lens, n_docs_pad: int,
                   plans, live, k: int, k1: float, b: float,
                   avgdl: Optional[float] = None,
                   block_avgdl: Optional[np.ndarray] = None,
-                  counted: bool = False, counter: Optional[list] = None):
+                  counted: bool = False, counter: Optional[list] = None,
+                  count_segments: Optional[Tuple] = None):
     """Flat-dispatch a batch of plans over one block store: device work
     scales with the ACTUAL total block count (one pow-ladder bucket of
     padding), never with Q x max-plan as the padded layout did. Chunks
@@ -607,7 +644,11 @@ def dispatch_flat(block_docs, block_tfs, doc_lens, n_docs_pad: int,
     shard plane's (``block_avgdl`` [NB] host array, gathered per plan so
     every block keeps its owning segment's norm). ``counter``, when given,
     accumulates the number of device programs launched (bench/stats
-    observability for dispatches-per-query)."""
+    observability for dispatches-per-query).
+
+    ``count_segments``: (seg_ids device [n_docs_pad] int32, n_segs) —
+    hits come back PER SEGMENT [n_q, n_segs] instead of [n_q] (the
+    totals-disabled plane contract); overrides ``counted``."""
     chunks: list = []
     cur: list = []
     cells = 0
@@ -621,6 +662,8 @@ def dispatch_flat(block_docs, block_tfs, doc_lens, n_docs_pad: int,
         cells += nb
     if cur:
         chunks.append(cur)
+    if count_segments is not None:
+        counted = True
     kern = bm25_topk_flat_counted if counted else bm25_topk_flat
     out_s, out_d, out_h = [], [], []
     for chunk in chunks:
@@ -634,11 +677,19 @@ def dispatch_flat(block_docs, block_tfs, doc_lens, n_docs_pad: int,
             flat_avg = np.full(fb, avgdl, np.float32)
         if counter is not None:
             counter.append(1)
-        got = kern(
-            block_docs, block_tfs,
-            jnp.asarray(idx), jnp.asarray(w), jnp.asarray(qid),
-            doc_lens, jnp.asarray(flat_avg), live,
-            n_docs_pad, n_q, k, k1=k1, b=b)
+        if count_segments is not None:
+            seg_ids, n_segs = count_segments
+            got = _bm25_flat_kernel_seg(
+                block_docs, block_tfs,
+                jnp.asarray(idx), jnp.asarray(w), jnp.asarray(qid),
+                doc_lens, jnp.asarray(flat_avg), live, seg_ids,
+                n_docs_pad, n_q, k, k1=k1, b=b, n_segs=n_segs)
+        else:
+            got = kern(
+                block_docs, block_tfs,
+                jnp.asarray(idx), jnp.asarray(w), jnp.asarray(qid),
+                doc_lens, jnp.asarray(flat_avg), live,
+                n_docs_pad, n_q, k, k1=k1, b=b)
         if len(chunks) == 1:
             if counted:
                 s, d, h = got

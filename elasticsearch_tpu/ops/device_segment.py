@@ -232,6 +232,18 @@ class PlanePart:
     # registry BEFORE upload), then upload(host) pins them on device.
 
 
+def _seg_ids_host(doc_base, n_segs: int, length: int) -> np.ndarray:
+    """[length] int32: owning segment POSITION per plane doc (searchsorted
+    right - 1 over the first n_segs doc bases, clamped at 0). Docs past
+    the packed corpus clamp to the last segment — they are padding, never
+    live. The one attribution rule shared by the single-shard plane's
+    counting channel and the mesh stacking pass."""
+    ids = np.searchsorted(np.asarray(doc_base[:n_segs]),
+                          np.arange(length, dtype=np.int64),
+                          side="right") - 1
+    return np.maximum(ids, 0).astype(np.int32)
+
+
 class PlanePostings(PlanePart):
     """All segments' posting blocks for one text field, doc ids rebased.
 
@@ -299,6 +311,19 @@ class PlanePostings(PlanePart):
         self.block_tfs = jnp.asarray(bt)
         self.doc_lens = jnp.asarray(dl)
 
+    def seg_ids(self) -> jnp.ndarray:
+        """[n_docs_pad] int32: each plane doc's owning segment POSITION
+        (reader order) — the per-segment counting channel of the
+        totals-disabled plane path. Padding docs never match (live is
+        False there), so their attribution is irrelevant; they clamp to
+        the last segment."""
+        cached = getattr(self, "_seg_ids_dev", None)
+        if cached is None:
+            cached = jnp.asarray(_seg_ids_host(
+                self.doc_base, len(self.segments), self.n_docs_pad))
+            self._seg_ids_dev = cached
+        return cached
+
 
 class PlaneVectors(PlanePart):
     """All segments' dense-vector rows for one field, stacked [N_pad, D],
@@ -340,6 +365,16 @@ class PlaneVectors(PlanePart):
         self._q_dev: Optional[Tuple] = None
         self._q_failed = False
         self._ivf = None
+        # warm-start seed for this generation's k-means: the previous
+        # generation's trained centroids (an append-only refresh barely
+        # moves them, so Lloyd's converges in a fraction of the cold
+        # iterations instead of retraining from scratch)
+        self._ivf_seed = None
+        if prev is not None:
+            prev_ivf = getattr(prev, "_ivf", None)
+            if prev_ivf is not None and prev_ivf[0] is not None:
+                self._ivf_seed = np.asarray(prev_ivf[0].centroids,
+                                            np.float32)
         self.rows = np.nonzero(exists[: self.n_docs_total])[0] \
             .astype(np.int64)
         return (matrix, norms, exists)
@@ -399,11 +434,14 @@ class PlaneVectors(PlanePart):
                 from elasticsearch_tpu.ops.ivf import IVFIndex
                 host = np.asarray(self.matrix)[self.rows]
                 try:
-                    index = IVFIndex.build(host, nlist=nlist,
-                                           similarity=self.similarity)
+                    index = IVFIndex.build(
+                        host, nlist=nlist, similarity=self.similarity,
+                        init_centroids=getattr(self, "_ivf_seed", None))
                 except CircuitBreakingError:
                     self._ivf_failed = True
                     raise
+                if getattr(index, "warm_started", False):
+                    PLANES.stats["ivf_warm_starts"] += 1
                 # the index's HBM is part of this plane's residency:
                 # eviction must release its charge early too, and stats
                 # must count it
@@ -493,6 +531,7 @@ class PlaneRegistry:
             "plane_evictions": 0,
             "plane_miss_fallbacks": 0,
             "quantized_queries": 0,
+            "ivf_warm_starts": 0,
         }
 
     # -- config ---------------------------------------------------------
@@ -682,3 +721,378 @@ class PlaneRegistry:
 # one accelerator per process -> one plane residency manager per process
 # (the same reasoning as indices/breaker.py's BREAKERS)
 PLANES = PlaneRegistry()
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded device plane: co-located shards stacked over a device mesh
+# ---------------------------------------------------------------------------
+
+class MeshPlanePart:
+    """One (kind, field) plane over a SET of co-located shards, laid out
+    for SPMD scoring: each shard's packed plane occupies one slot of a
+    ``[S, ...]`` stack device_put with ``NamedSharding`` over the
+    ``shard`` mesh axis (parallel/mesh.py mesh_layout), so one compiled
+    program scores every (shard, query) pair and the per-shard RPC
+    fan-out of TransportSearchAction collapses to ONE dispatch per phase.
+
+    ``subs[i]`` is shard i's host-level PlanePart (refs / doc_base /
+    block_avgdl / demux — the same per-shard planning surfaces the
+    single-shard plane executors use), or None when the field has no
+    data in that shard (its slot scores nothing and the executors emit
+    the per-segment path's empty result for it)."""
+
+    def __init__(self, kind: str, field: str, shard_keys: Tuple,
+                 subs: List[Optional[PlanePart]], segments_by_shard,
+                 mesh, n_slots: int):
+        self.kind = kind
+        self.field = field
+        self.shard_keys = shard_keys          # ordered (index, shard_id)
+        self.subs = subs
+        self.segments_by_shard = segments_by_shard
+        self.mesh = mesh
+        self.n_slots = n_slots
+        self.n_shards = len(shard_keys)
+        self.nbytes = 0
+        self.per_device_bytes = 0
+        self._charges: List[Any] = []
+        # filled by the registry's stacking pass
+        self.n_docs_pad = BLOCK
+        self.n_segs_max = 1
+
+    def release(self) -> None:
+        for charge in self._charges:
+            charge.release()
+
+    def uids_of(self, shard_key) -> Tuple:
+        i = self.shard_keys.index(shard_key)
+        return tuple(s.uid for s in self.segments_by_shard[i])
+
+
+class MeshPlaneRegistry:
+    """Process-global residency manager for mesh-sharded planes, keyed by
+    (kind, field, ((index, shard), segment-uid tuple) ...). Same contract
+    as PlaneRegistry: ``get`` returning None means "serve this fan-out
+    per shard" — the mesh is an optimization, never a correctness gate.
+    Planes charge the ``device`` breaker PER DEVICE (each mesh slot's
+    share of the stacked arrays actually lives on one chip), LRU-evict
+    under pressure, and re-pack incrementally when a member shard's
+    refresh appends segments."""
+
+    MAX_PARTS = 16
+    MAX_REFUSALS = 64
+
+    def __init__(self):
+        self._parts: "OrderedDict[Tuple, MeshPlanePart]" = OrderedDict()
+        self._refused: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+        # dynamic config (search.mesh.* cluster settings)
+        self.enabled = True
+        self.min_shards = 2
+        self.dp = 1
+        # test/bench knob (not a cluster setting): bound the device
+        # subset — max_devices=1 is the byte-identity baseline layout
+        self.max_devices = 0
+        self.stats: Dict[str, int] = {
+            "mesh_plane_builds": 0,
+            "mesh_plane_full_rebuilds": 0,
+            "mesh_plane_incremental_appends": 0,
+            "mesh_plane_evictions": 0,
+            "mesh_plane_miss_fallbacks": 0,
+        }
+
+    # -- config ---------------------------------------------------------
+
+    def configure_from_state(self, state) -> None:
+        version = getattr(state, "version", None)
+        if version is not None and \
+                version == getattr(self, "_cfg_version", None):
+            return
+        self._cfg_version = version
+        from elasticsearch_tpu.utils.settings import (
+            SEARCH_MESH_DP, SEARCH_MESH_ENABLED, SEARCH_MESH_MIN_SHARDS,
+            setting_from_state,
+        )
+        self.enabled = setting_from_state(state, SEARCH_MESH_ENABLED)
+        self.min_shards = setting_from_state(state,
+                                             SEARCH_MESH_MIN_SHARDS)
+        self.dp = setting_from_state(state, SEARCH_MESH_DP)
+
+    def available(self, n_shards: int) -> bool:
+        if not self.enabled or n_shards < max(1, self.min_shards):
+            return False
+        from elasticsearch_tpu.parallel.mesh import mesh_ready
+        return mesh_ready()
+
+    # -- lookup / build -------------------------------------------------
+
+    def _budget_token(self) -> Tuple:
+        from elasticsearch_tpu.indices.breaker import BREAKERS
+        return (int(BREAKERS.breaker("device").limit), self.dp,
+                self.max_devices)
+
+    def _refuse(self, key: Tuple) -> None:
+        self.stats["mesh_plane_miss_fallbacks"] += 1
+        self._refused[key] = self._budget_token()
+        while len(self._refused) > self.MAX_REFUSALS:
+            self._refused.popitem(last=False)
+
+    @staticmethod
+    def _key(shard_segments, kind: str, field: str) -> Tuple:
+        return (kind, field) + tuple(
+            (skey, tuple(s.uid for s in segments))
+            for skey, segments in shard_segments)
+
+    def get(self, shard_segments, kind: str,
+            field: str) -> Optional[MeshPlanePart]:
+        """``shard_segments``: ordered [((index, shard_id), [segments])]
+        — one entry per co-located target shard, reader order inside."""
+        if not self.available(len(shard_segments)):
+            return None
+        shard_segments = sorted(
+            ((skey, list(segments)) for skey, segments in shard_segments),
+            key=lambda e: e[0])
+        key = self._key(shard_segments, kind, field)
+        part = self._parts.get(key)
+        if part is not None:
+            self._parts.move_to_end(key)
+            return part
+        refused_under = self._refused.get(key)
+        if refused_under is not None:
+            if refused_under == self._budget_token():
+                self.stats["mesh_plane_miss_fallbacks"] += 1
+                return None
+            self._refused.pop(key, None)
+        return self._build(shard_segments, kind, field, key)
+
+    def _find_prev(self, shard_segments, kind, field
+                   ) -> Optional[MeshPlanePart]:
+        """Most recent resident part over the SAME shard set whose every
+        shard's segment-uid tuple is a prefix of (or equal to) the new
+        one — the append-only refresh case; its subs' per-segment caches
+        seed the incremental rebuild."""
+        keys = tuple(skey for skey, _ in shard_segments)
+        for _k, part in reversed(self._parts.items()):
+            if part.kind != kind or part.field != field or \
+                    part.shard_keys != keys:
+                continue
+            ok = True
+            for i, (_skey, segments) in enumerate(shard_segments):
+                uids = tuple(s.uid for s in segments)
+                prev_uids = tuple(
+                    s.uid for s in part.segments_by_shard[i])
+                if uids[: len(prev_uids)] != prev_uids:
+                    ok = False
+                    break
+            if ok:
+                return part
+        return None
+
+    def _build(self, shard_segments, kind: str, field: str,
+               key: Tuple) -> Optional[MeshPlanePart]:
+        from elasticsearch_tpu.parallel.mesh import mesh_layout
+        mesh, n_slots, _spd = mesh_layout(
+            len(shard_segments), dp=self.dp, max_devices=self.max_devices)
+        prev = self._find_prev(shard_segments, kind, field)
+        subs: List[Optional[PlanePart]] = []
+        hosts: List[Optional[Tuple]] = []
+        for i, (skey, segments) in enumerate(shard_segments):
+            sub = _PART_CLASSES[kind](field, segments)
+            prev_sub = prev.subs[i] if prev is not None else None
+            try:
+                hosts.append(sub.build(prev_sub))
+                subs.append(sub)
+            except PlaneUnavailable:
+                hosts.append(None)
+                subs.append(None)
+        if all(s is None for s in subs):
+            return None
+        part = MeshPlanePart(
+            kind, field, tuple(skey for skey, _ in shard_segments),
+            subs, [segments for _skey, segments in shard_segments],
+            mesh, n_slots)
+        stacked = self._stack(part, hosts)
+        part.nbytes = sum(int(a.nbytes) for a in stacked.values())
+        d_used = int(mesh.shape["shard"])
+        part.per_device_bytes = -(-part.nbytes // d_used)
+        from elasticsearch_tpu.indices.breaker import (
+            BREAKERS, charge_device,
+        )
+        from elasticsearch_tpu.utils.errors import CircuitBreakingError
+        label = f"mesh_plane_{kind}:{field}"
+        charge = None
+        try:
+            charge = charge_device(part, part.per_device_bytes, label,
+                                   return_charge=True)
+        except CircuitBreakingError:
+            device_limit = BREAKERS.breaker("device").limit
+            if 0 < device_limit < part.per_device_bytes:
+                self._refuse(key)
+                return None
+            while self._parts:
+                self._drop(next(iter(self._parts)))
+                try:
+                    charge = charge_device(part, part.per_device_bytes,
+                                           label, return_charge=True)
+                    break
+                except CircuitBreakingError:
+                    continue
+            if charge is None:
+                self._refuse(key)
+                return None
+        part._charges.append(charge)
+        self._upload(part, stacked)
+        self.stats["mesh_plane_builds"] += 1
+        if prev is not None:
+            self.stats["mesh_plane_incremental_appends"] += 1
+        else:
+            self.stats["mesh_plane_full_rebuilds"] += 1
+        self._parts[key] = part
+        while len(self._parts) > self.MAX_PARTS:
+            self._drop(next(iter(self._parts)))
+        return part
+
+    # -- stacking -------------------------------------------------------
+
+    def _stack(self, part: MeshPlanePart, hosts) -> Dict[str, np.ndarray]:
+        """Stack per-shard host planes into common-shaped [n_slots, ...]
+        arrays (empty/padding slots score nothing: -1 block docs, zero
+        lengths/weights, exists False)."""
+        subs = part.subs
+        n_slots = part.n_slots
+        n_max = max((s.n_docs_pad for s in subs if s is not None),
+                    default=BLOCK)
+        part.n_docs_pad = n_max
+        part.n_segs_max = max(
+            (len(s.segments) for s in subs if s is not None), default=1)
+        part.n_segs_max = max(part.n_segs_max, 1)
+        out: Dict[str, np.ndarray] = {}
+        if part.kind == "postings":
+            nb_max = max(h[0].shape[0] for h in hosts if h is not None)
+            nb_max = next_pow2(max(nb_max, 1))
+            bd = np.full((n_slots, nb_max, BLOCK), -1, np.int32)
+            bt = np.zeros((n_slots, nb_max, BLOCK), np.float32)
+            dl = np.zeros((n_slots, n_max), np.float32)
+            si = np.zeros((n_slots, n_max), np.int32)
+            for i, h in enumerate(hosts):
+                if h is None:
+                    continue
+                hbd, hbt, hdl = h
+                bd[i, : hbd.shape[0]] = hbd
+                bt[i, : hbt.shape[0]] = hbt
+                dl[i, : len(hdl)] = hdl
+                sub = subs[i]
+                si[i] = _seg_ids_host(sub.doc_base, len(sub.segments),
+                                      n_max)
+            out = {"block_docs": bd, "block_tfs": bt, "doc_lens": dl,
+                   "seg_ids": si}
+        elif part.kind == "vectors":
+            dims = {s.dims for s in subs if s is not None}
+            sims = {s.similarity for s in subs if s is not None}
+            if len(dims) != 1 or len(sims) != 1:
+                raise PlaneUnavailable(part.field)
+            part.dims = dims.pop()
+            part.similarity = sims.pop()
+            matrix = np.zeros((n_slots, n_max, part.dims), np.float32)
+            norms = np.zeros((n_slots, n_max), np.float32)
+            exists = np.zeros((n_slots, n_max), bool)
+            for i, h in enumerate(hosts):
+                if h is None:
+                    continue
+                hm, hn, he = h
+                matrix[i, : hm.shape[0]] = hm
+                norms[i, : len(hn)] = hn
+                exists[i, : len(he)] = he
+            out = {"matrix": matrix, "norms": norms, "exists": exists}
+        else:   # features
+            nb_max = max(h[0].shape[0] for h in hosts if h is not None)
+            nb_max = next_pow2(max(nb_max, 1))
+            bd = np.full((n_slots, nb_max, BLOCK), -1, np.int32)
+            bw = np.zeros((n_slots, nb_max, BLOCK), np.float32)
+            for i, h in enumerate(hosts):
+                if h is None:
+                    continue
+                hbd, hbw = h
+                bd[i, : hbd.shape[0]] = hbd
+                bw[i, : hbw.shape[0]] = hbw
+            out = {"block_docs": bd, "block_weights": bw}
+        return out
+
+    def _upload(self, part: MeshPlanePart, stacked) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        for name, arr in stacked.items():
+            spec = P(*(["shard"] + [None] * (arr.ndim - 1)))
+            setattr(part, name, jax.device_put(
+                arr, NamedSharding(part.mesh, spec)))
+
+    # -- eviction / lifecycle -------------------------------------------
+
+    def _drop(self, key: Tuple, count_eviction: bool = True) -> None:
+        part = self._parts.pop(key, None)
+        if part is None:
+            return
+        part.release()
+        if count_eviction:
+            self.stats["mesh_plane_evictions"] += 1
+
+    def drop_segments(self, uids) -> None:
+        """Merge invalidation: any mesh plane touching a merged-away
+        segment can never be requested again (the uid tuple changed)."""
+        uids = set(uids)
+        for key in [k for k, p in self._parts.items()
+                    if any(uids.intersection(
+                        s.uid for s in segs)
+                        for segs in p.segments_by_shard)]:
+            self._drop(key, count_eviction=False)
+
+    def clear(self) -> None:
+        for key in list(self._parts):
+            self._drop(key, count_eviction=False)
+        self._refused.clear()
+        self._cfg_version = object()   # force a settings re-read
+
+    def on_refresh(self, shard_key, segments) -> None:
+        """Refresh publication for one member shard: eagerly re-pack any
+        resident mesh plane containing it whose recorded uid tuple for
+        that shard is a strict prefix of the new one (the append-only
+        case), so the refresh pays the upload instead of the next
+        fan-out. Other member shards keep their last-published sets —
+        their own refreshes publish independently."""
+        if not self.enabled:
+            return
+        uids = tuple(s.uid for s in segments)
+        todo = []
+        for part in list(self._parts.values()):
+            if shard_key not in part.shard_keys:
+                continue
+            prev_uids = part.uids_of(shard_key)
+            if prev_uids != uids and \
+                    uids[: len(prev_uids)] == prev_uids:
+                todo.append(part)
+        for part in todo:
+            shard_segments = []
+            for i, skey in enumerate(part.shard_keys):
+                segs = list(segments) if skey == shard_key \
+                    else list(part.segments_by_shard[i])
+                shard_segments.append((skey, segs))
+            self.get(shard_segments, part.kind, part.field)
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        by_kind = {"postings": 0, "vectors": 0, "features": 0}
+        per_device = 0
+        for p in self._parts.values():
+            by_kind[p.kind] = by_kind.get(p.kind, 0) + p.nbytes
+            per_device += p.per_device_bytes
+        out = {**self.stats,
+               "mesh_planes_resident": len(self._parts),
+               "resident_bytes": by_kind,
+               "resident_bytes_per_device": per_device,
+               "dp": int(self.dp)}
+        from elasticsearch_tpu.parallel.mesh import mesh_ready
+        if mesh_ready():
+            import jax
+            out["n_devices"] = len(jax.devices())
+        return out
+
+
+# the mesh plane shares the process-global residency reasoning of PLANES
+MESH_PLANES = MeshPlaneRegistry()

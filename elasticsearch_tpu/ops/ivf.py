@@ -101,14 +101,27 @@ def _farthest_point_init(x: jnp.ndarray, first: jnp.ndarray,
 
 
 def kmeans(vectors: np.ndarray, nlist: int, iters: int = 10,
-           seed: int = 17) -> np.ndarray:
-    """Farthest-point init + Lloyd's on device; [nlist, D] f32 centroids."""
+           seed: int = 17,
+           init_centroids: Optional[np.ndarray] = None) -> np.ndarray:
+    """Farthest-point init + Lloyd's on device; [nlist, D] f32 centroids.
+
+    ``init_centroids`` [nlist, D] warm-starts Lloyd's from a previous
+    generation's solution (the plane registry's incremental-refresh
+    case): an append-only refresh barely moves the optimal centroids, so
+    seeding from them converges in a fraction of the cold iterations."""
     n, d = vectors.shape
     rng = np.random.default_rng(seed)
     x = jnp.asarray(vectors, jnp.float32)
     if n <= nlist:
         reps = np.resize(vectors.astype(np.float32), (nlist, d))
         return reps
+    if init_centroids is not None and \
+            init_centroids.shape == (nlist, d):
+        c = jnp.asarray(init_centroids, jnp.float32)
+        warm_iters = max(2, iters // 3)
+        for _ in range(warm_iters):
+            c = _update(x, assign_chunked(x, c, nlist), c, nlist)
+        return np.asarray(c)
     # seed on a subsample to bound init cost at ~25*nlist points
     cap = min(n, max(25 * nlist, 2 * nlist))
     sample = (np.arange(n) if n <= cap
@@ -147,7 +160,8 @@ class IVFIndex:
     @staticmethod
     def build(vectors: np.ndarray, nlist: Optional[int] = None,
               similarity: str = "cosine", iters: int = 10,
-              slack: float = 1.5, seed: int = 17) -> "IVFIndex":
+              slack: float = 1.5, seed: int = 17,
+              init_centroids: Optional[np.ndarray] = None) -> "IVFIndex":
         n, d = vectors.shape
         if n == 0:
             raise ValueError("cannot build an IVF index over zero vectors")
@@ -155,7 +169,12 @@ class IVFIndex:
             nlist = max(1, min(n, int(4 * np.sqrt(n))))
         nlist = max(1, min(nlist, n))
         vectors = np.asarray(vectors, np.float32)
-        cents = kmeans(vectors, nlist, iters=iters, seed=seed)
+        warm = init_centroids is not None and \
+            np.asarray(init_centroids).shape == (nlist, d) and n > nlist
+        cents = kmeans(vectors, nlist, iters=iters, seed=seed,
+                       init_centroids=(np.asarray(init_centroids,
+                                                  np.float32)
+                                       if warm else None))
         assign = np.asarray(assign_chunked(jnp.asarray(vectors),
                                            jnp.asarray(cents), nlist))
         cap = max(1, int(np.ceil(n / nlist * slack)))
@@ -203,6 +222,7 @@ class IVFIndex:
         from elasticsearch_tpu.indices.breaker import account_device_arrays
         # the charge handle rides on the index so owners that evict
         # early (the plane registry) can release ahead of GC
+        index.warm_started = warm
         index._charge = account_device_arrays(
             index, (cents, lists, valid, ids, norms), "ivf",
             return_charge=True)

@@ -1,4 +1,4 @@
-"""Device mesh construction.
+"""Device mesh construction + the mesh-sharded plane's SPMD kernels.
 
 The data-plane analog of the reference's node topology: an index's shards map
 onto the ``shard`` mesh axis (each device slice holds a doc partition, like
@@ -6,15 +6,28 @@ an ES shard on a data node), while the ``dp`` axis replicates the corpus for
 query-batch throughput (like ES replicas serving reads,
 README.asciidoc:13). Collectives ride ICI inside the mesh — the data-plane
 half of the two-plane split (SURVEY.md §5.8 TPU-native equivalent).
+
+The second half of this module is the serving tier's kernel factories
+(ROADMAP item 2): shard_map programs over the **mesh-sharded plane**
+(ops/device_segment.py MeshPlaneRegistry) — each co-located ES shard's
+packed plane occupies one slot of a ``[S, ...]`` stack laid out with
+``NamedSharding`` over the ``shard`` mesh axis (model parallel), the
+micro-batched query stack rides ``dp``, and ONE compiled program scores
+every (shard, query) pair with each slot's arithmetic identical to the
+single-shard plane kernels (ops/bm25.py `_bm25_flat_kernel`,
+ops/knn.py `_batch_scores`, ops/sparse.py) so mesh residency can never
+change a served result. Per-shard top-k comes back stitched along the
+shard axis; the host-side demux and coordinator merge stay unchanged.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -37,3 +50,222 @@ def shard_spec(mesh: Mesh, *axes: Optional[str]) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# serving-tier mesh layout (mesh-sharded plane)
+# ---------------------------------------------------------------------------
+
+try:
+    from jax import shard_map
+except ImportError:   # pre-0.5 jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+    from functools import wraps as _wraps
+
+    @_wraps(_shard_map_legacy)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_legacy(*args, **kwargs)
+
+
+_MESH_CACHE: Dict[Tuple, Mesh] = {}
+
+
+def mesh_ready() -> bool:
+    """True when a jax backend is ALREADY initialized — mesh layout must
+    observe devices, never pay (or hang on) first-init inside a search
+    (the same never-pay guard as parallel/mesh_plane.py and monitor)."""
+    import sys
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return False
+    try:
+        from jax._src import xla_bridge as _xb
+        return bool(_xb.backends_are_initialized())
+    except Exception:  # noqa: BLE001 — private API moved: assume the
+        return True    # pre-guard behavior (devices() below inits)
+
+
+def mesh_layout(n_shards: int, dp: int = 1,
+                max_devices: int = 0) -> Tuple[Mesh, int, int]:
+    """(mesh, n_slots, slots_per_device) for ``n_shards`` co-located
+    shards over the local devices.
+
+    One shard = one slot of the stacked plane; slots map onto a
+    ``(dp, shard)`` mesh over a device SUBSET sized to the shard count
+    (2 shards on an 8-chip host use 2 chips — the other 6 stay free for
+    other planes), padding the slot count up to a multiple of the used
+    devices when shards outnumber chips. ``max_devices`` (0 = all)
+    bounds the subset — the single-device layout is the byte-identity
+    baseline the golden tests pin."""
+    devices = jax.devices()
+    total = len(devices)
+    if max_devices > 0:
+        total = min(total, max_devices)
+    dp = max(1, min(int(dp), total))
+    d_used = max(1, min(total // dp, n_shards))
+    n_slots = -(-n_shards // d_used) * d_used
+    key = (dp, d_used)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        arr = np.asarray(devices[: dp * d_used]).reshape(dp, d_used)
+        mesh = Mesh(arr, ("dp", "shard"))
+        _MESH_CACHE[key] = mesh
+    return mesh, n_slots, n_slots // d_used
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded plane kernels (one slot = one ES shard's packed plane)
+# ---------------------------------------------------------------------------
+
+_COMPILED: Dict[Tuple, object] = {}
+
+
+def mesh_bm25_flat(mesh: Mesh, n_docs_pad: int, n_q: int, k: int,
+                   n_segs: int, k1: float, b: float):
+    """One SPMD program over the stacked postings planes.
+
+    fn(block_docs [S,NB,B], block_tfs [S,NB,B], doc_lens [S,N],
+       flat_idx [S,FB], flat_w [S,FB], flat_q [S,FB], flat_avgdl [S,FB],
+       live [S,N], seg_ids [S,N])
+      -> (scores [S,n_q,k], plane docs [S,n_q,k], hits [S,n_q,n_segs])
+
+    Each slot runs exactly ops/bm25.py `_bm25_flat_kernel_seg`'s body over
+    its own block store (same gather/scatter order, same f32 adds), so a
+    slot's row is bit-compatible with that shard's single-plane dispatch.
+    Per-segment hit counts serve BOTH totals contracts host-side: summed
+    for counts-then-skip, clipped per segment for totals-disabled."""
+    key = ("bm25", id(mesh), n_docs_pad, n_q, k, n_segs, k1, b)
+    fn = _COMPILED.get(key)
+    if fn is not None:
+        return fn
+
+    def one_slot(bd, bt, dl, fi, fw, fq, fa, lv, si):
+        docs = bd[fi]
+        tfs = bt[fi]
+        valid = docs >= 0
+        safe = jnp.where(valid, docs, 0)
+        dln = dl[safe]
+        norm = k1 * (1.0 - b + b * dln / fa[:, None])
+        contrib = fw[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+        contrib = jnp.where(valid, contrib, 0.0)
+        tgt = fq[:, None] * n_docs_pad + safe
+        scores = jnp.zeros((n_q * n_docs_pad,), jnp.float32)
+        scores = scores.at[tgt.reshape(-1)].add(contrib.reshape(-1),
+                                                mode="drop")
+        scores = scores.reshape(n_q, n_docs_pad)
+        matched = lv[None, :] & (scores > 0.0)
+        scores = jnp.where(matched, scores, -jnp.inf)
+        s, d = jax.lax.top_k(scores, k)
+        onehot = jax.nn.one_hot(si, n_segs, dtype=jnp.int32)
+        hits = matched.astype(jnp.int32) @ onehot
+        return s, d, hits
+
+    def local(bd, bt, dl, fi, fw, fq, fa, lv, si):
+        return jax.vmap(one_slot)(bd, bt, dl, fi, fw, fq, fa, lv, si)
+
+    p3 = P("shard", None, None)
+    p2 = P("shard", None)
+    fn = jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(p3, p3, p2, p2, p2, p2, p2, p2, p2),
+        out_specs=(p3, p3, p3), check_vma=False))
+    _COMPILED[key] = fn
+    return fn
+
+
+def mesh_sparse_topk(mesh: Mesh, n_docs_pad: int, k: int):
+    """One SPMD program over the stacked rank_features planes.
+
+    fn(block_docs [S,NB,B], block_weights [S,NB,B], idx [S,Q,QB],
+       qw [S,Q,QB], live [S,N])
+      -> (scores [S,Q,k], plane docs [S,Q,k], hits [S,Q])
+
+    Per (slot, query) the body is ops/sparse.py's linear scorer — same
+    gather, same scatter-add, exact whole-shard counts off the score
+    plane."""
+    key = ("sparse", id(mesh), n_docs_pad, k)
+    fn = _COMPILED.get(key)
+    if fn is not None:
+        return fn
+
+    def one_slot(bd, bw, bi, qw, lv):
+        def one_q(bi_q, qw_q):
+            docs = bd[bi_q]
+            w = bw[bi_q]
+            valid = docs >= 0
+            safe = jnp.where(valid, docs, 0)
+            contrib = jnp.where(valid, qw_q[:, None] * w, 0.0)
+            scores = jnp.zeros((n_docs_pad,), jnp.float32)
+            scores = scores.at[safe.reshape(-1)].add(
+                contrib.reshape(-1), mode="drop")
+            matched = lv & (scores > 0.0)
+            s = jnp.where(matched, scores, -jnp.inf)
+            ts, td = jax.lax.top_k(s, k)
+            return ts, td, jnp.sum(matched, dtype=jnp.int32)
+        return jax.vmap(one_q)(bi, qw)
+
+    def local(bd, bw, bi, qw, lv):
+        return jax.vmap(one_slot)(bd, bw, bi, qw, lv)
+
+    p3 = P("shard", None, None)
+    p2 = P("shard", None)
+    fn = jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(p3, p3, p3, p3, p2),
+        out_specs=(p3, p3, p2), check_vma=False))
+    _COMPILED[key] = fn
+    return fn
+
+
+def mesh_knn_topk(mesh: Mesh, k: int, similarity: str, masked: bool):
+    """One SPMD program over the stacked vector planes: the query stack
+    rides the ``dp`` mesh axis, the corpus the ``shard`` axis.
+
+    fn(matrix [S,N,D], norms [S,N], allowed [S,N], queries [Q,D]
+       [, masks [S,Q,N]]) -> (scores [S,Q,k], plane docs [S,Q,k])
+
+    Scoring is ops/knn.py's `_batch_scores` arithmetic per slot (bf16
+    multiply, f32 accumulate, `_coarse_similarity` transform), so each
+    slot's row matches that shard's exact plane matmul. ``allowed``
+    already folds live & exists (& a shared filter mask when every batch
+    member carries the same filter); ``masks`` is the per-member stack
+    for heterogeneous filters."""
+    from elasticsearch_tpu.ops.knn import _coarse_similarity
+    key = ("knn", id(mesh), k, similarity, masked)
+    fn = _COMPILED.get(key)
+    if fn is not None:
+        return fn
+
+    def local(m, nr, al, q, mk=None):
+        def one_slot(m_s, nr_s, al_s, mk_s=None):
+            dots = jax.lax.dot_general(
+                q.astype(jnp.bfloat16), m_s.astype(jnp.bfloat16),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [Q, N]
+            scores = _coarse_similarity(dots, nr_s, q, similarity)
+            ok = al_s[None, :] if mk_s is None else (al_s[None, :] & mk_s)
+            scores = jnp.where(ok, scores, -jnp.inf)
+            ts, td = jax.lax.top_k(scores, k)
+            return ts, td
+        if mk is not None:
+            return jax.vmap(one_slot)(m, nr, al, mk)
+        return jax.vmap(lambda a, c, d: one_slot(a, c, d))(m, nr, al)
+
+    p3 = P("shard", None, None)
+    p2 = P("shard", None)
+    pq = P("dp", None)
+    pout = P("shard", "dp", None)
+    if masked:
+        fn = jax.jit(shard_map(
+            local, mesh=mesh,
+            in_specs=(p3, p2, p2, pq, P("shard", "dp", None)),
+            out_specs=(pout, pout), check_vma=False))
+    else:
+        fn = jax.jit(shard_map(
+            lambda m, nr, al, q: local(m, nr, al, q), mesh=mesh,
+            in_specs=(p3, p2, p2, pq),
+            out_specs=(pout, pout), check_vma=False))
+    _COMPILED[key] = fn
+    return fn

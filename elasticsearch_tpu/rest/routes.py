@@ -1348,29 +1348,42 @@ def build_controller(client: NodeClient) -> RestController:
             docs = ((resp.get("_all") or {}).get("primaries") or {}) \
                 .get("docs", {}).get("count", 0)
             shard_stats = resp.get("_shards", {})
-            h = client.cluster_health()
-            done(200, {
-                "cluster_name": state.cluster_name,
-                "status": h["status"],
-                # partial stat collection must be VISIBLE: failed > 0
-                # means docs.count undercounts
-                "_shards": {"total": shard_stats.get("total", 0),
-                            "successful": shard_stats.get("successful", 0),
-                            "failed": shard_stats.get("failed", 0)},
-                "indices": {
-                    "count": n_indices,
-                    "shards": {"total": total_active,
-                               "primaries": primaries,
-                               "replication":
-                                   ((total_active - primaries) /
-                                    primaries) if primaries else 0.0},
-                    "docs": {"count": docs},
-                },
-                "nodes": {
-                    "count": {"total": len(state.nodes), **role_counts},
-                    "versions": [__version__],
-                },
-            })
+
+            def emit(h) -> None:
+                done(200, {
+                    "cluster_name": state.cluster_name,
+                    "status": h["status"],
+                    # partial stat collection must be VISIBLE: failed > 0
+                    # means docs.count undercounts
+                    "_shards": {
+                        "total": shard_stats.get("total", 0),
+                        "successful": shard_stats.get("successful", 0),
+                        "failed": shard_stats.get("failed", 0)},
+                    "indices": {
+                        "count": n_indices,
+                        "shards": {"total": total_active,
+                                   "primaries": primaries,
+                                   "replication":
+                                       ((total_active - primaries) /
+                                        primaries) if primaries else 0.0},
+                        "docs": {"count": docs},
+                    },
+                    "nodes": {
+                        "count": {"total": len(state.nodes),
+                                  **role_counts},
+                        "versions": [__version__],
+                    },
+                })
+
+            # status through the master-routed health path (the
+            # unverified-STARTED gate lives on the elected master only; a
+            # non-master's local view must not report green during a
+            # post-reboot verify window) — the same route _cluster/health
+            # takes, with the same flagged local fallback
+            # cluster_health_async always delivers a health dict (master's
+            # answer or the FLAGGED local fallback) — no unflagged local
+            # re-read here, which would undo the master routing
+            client.cluster_health_async(None, lambda h, _e: emit(h))
         if n_indices:
             # one aggregation path: index_stats already sums primary
             # docs and carries the _shards success/failure counts
@@ -1524,26 +1537,55 @@ def build_controller(client: NodeClient) -> RestController:
     # -- cat (human tables) ----------------------------------------------
 
     def cat_indices(req: RestRequest, done: DoneFn) -> None:
+        """Per-index status through the master-routed health path (the
+        unverified-STARTED gate is master-only state): one chained async
+        health per index, flagged-local fallback when no master answers
+        — the _cluster/health discipline applied to the cat surface."""
         state = client.node._applied_state()
-        rows = []
-        for meta in state.metadata.indices.values():
-            h = client.cluster_health(meta.name)
-            rows.append([h["status"], "open", meta.name, meta.uuid,
-                         str(meta.number_of_shards),
-                         str(meta.number_of_replicas)])
-        done(200, _cat(req, ["health", "status", "index", "uuid",
-                             "pri", "rep"], rows))
+        metas = list(state.metadata.indices.values())
+        rows: List[List[str]] = []
+
+        def run(i: int) -> None:
+            # trampoline, not recursion: cluster_health_async completes
+            # synchronously on the master and in the no-master fallback,
+            # so a chained next_one(i + 1) inside cb would grow the stack
+            # by ~4 frames per index and overflow on a few hundred
+            # indices. The loop advances in place on a synchronous
+            # completion; only a genuinely async one re-enters run().
+            while i < len(metas):
+                meta = metas[i]
+                st = {"sync": None}
+
+                def cb(h, _err=None, meta=meta, st=st, nxt=i + 1):
+                    rows.append([h["status"], "open", meta.name, meta.uuid,
+                                 str(meta.number_of_shards),
+                                 str(meta.number_of_replicas)])
+                    if st["sync"] is None:   # fired inside the async call
+                        st["sync"] = True
+                    else:                    # fired later: resume the pump
+                        run(nxt)
+                client.cluster_health_async(meta.name, cb)
+                if st["sync"]:
+                    i += 1
+                    continue
+                st["sync"] = False
+                return
+            done(200, _cat(req, ["health", "status", "index", "uuid",
+                                 "pri", "rep"], rows))
+        run(0)
     r("GET", "/_cat/indices", cat_indices)
 
     def cat_health(req: RestRequest, done: DoneFn) -> None:
-        h = client.cluster_health()
-        done(200, _cat(req, ["cluster", "status", "node.total",
-                             "shards", "pri", "unassign"],
-                       [[h["cluster_name"], h["status"],
-                         str(h["number_of_nodes"]),
-                         str(h["active_shards"]),
-                         str(h["active_primary_shards"]),
-                         str(h["unassigned_shards"])]]))
+        def cb(h, _err=None) -> None:
+            done(200, _cat(req, ["cluster", "status", "node.total",
+                                 "shards", "pri", "unassign"],
+                           [[h["cluster_name"], h["status"],
+                             str(h["number_of_nodes"]),
+                             str(h["active_shards"]),
+                             str(h["active_primary_shards"]),
+                             str(h["unassigned_shards"])]]))
+        # master-routed, like _cluster/health (flagged local fallback)
+        client.cluster_health_async(None, cb)
     r("GET", "/_cat/health", cat_health)
 
     def cat_allocation(req: RestRequest, done: DoneFn) -> None:

@@ -62,6 +62,14 @@ micro-batching at the device boundary:
 Any unexpected failure of the batched path (breaker trips, shapes the
 kernels reject) degrades to per-member solo execution — batching is an
 optimization, never a correctness gate.
+
+The mesh-sharded fan-out executor (search/mesh_executor.py) shares this
+module's eligibility and demux seams — ``classify_request`` (so a query
+is mesh-eligible iff it is batch-eligible), ``_build_ctxs`` (reader
+snapshots become SegmentContexts identically) and ``_knn_demux`` (the
+per-shard merge semantics) — which is what keeps a fan-out served from
+the mesh byte-compatible with the same fan-out served shard-by-shard
+through this batcher.
 """
 
 from __future__ import annotations

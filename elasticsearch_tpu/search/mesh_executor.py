@@ -1,0 +1,296 @@
+"""Mesh-sharded SPMD scoring for whole co-located fan-outs.
+
+``TransportSearchAction`` fans an eligible query out to every target
+shard over transport — one shard query dispatch per shard even when all
+the shards live on this very node's device mesh. This module collapses
+that fan-out: when every target shard is local and the mesh-sharded
+plane (ops/device_segment.py ``MESH_PLANES``) holds the query's (kind,
+field), the WHOLE scatter-gather runs as ONE SPMD program per phase
+(search/plane_exec.py ``mesh_wand_topk`` / ``mesh_knn_winners`` /
+``mesh_sparse_topk`` over parallel/mesh.py shard_map kernels) and the
+results demux back into ordinary per-shard query-phase responses — the
+coordinator merge, fetch phase, and response shape stay byte-compatible
+with the RPC fan-out.
+
+Batching: like the RRF fusion batcher, concurrent eligible searches
+submitted in the same scheduler tick coalesce — their query stacks ride
+the mesh's ``dp`` axis / the kernels' query dimension, so a wave of
+searches pays one device program, not one per search per shard.
+
+Degradation: ANY miss (mesh disabled, plane refused by the HBM budget,
+IVF-routed shards, classification edge) hands the member back to the
+unchanged per-shard fan-out — the mesh is an optimization, never a
+correctness gate.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid as uuid_mod
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.search.batch_executor import (
+    BatchSpec, _build_ctxs, _knn_demux, classify_request,
+)
+from elasticsearch_tpu.utils.settings import SEARCH_MESH_ENABLED
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _Member:
+    spec: BatchSpec
+    body: Dict[str, Any]
+    window: int
+    shard_ids: List[int]
+    task: Any
+    on_results: Callable[[Optional[List[Dict[str, Any]]]], None]
+    enqueued_wall: float = dc_field(default_factory=time.monotonic)
+
+
+class MeshSearchExecutor:
+    """Per-node mesh fan-out executor; owned by SearchTransportService
+    (which also owns the shard-level micro-batcher), driven on the
+    scheduler's dispatch context like every other handler."""
+
+    _KIND_OF = {"text": "postings", "knn": "vectors", "sparse": "features"}
+
+    def __init__(self, sts):
+        self.sts = sts
+        self._queues: Dict[Tuple, List[_Member]] = {}
+        self._scheduled: set = set()
+        self.stats: Dict[str, float] = {
+            "mesh_searches": 0,        # searches served from the mesh
+            "mesh_batches": 0,         # mesh drains dispatched
+            "mesh_fallbacks": 0,       # members handed back to the RPC path
+            "mesh_shard_results": 0,   # per-shard responses synthesized
+            "device_dispatches": 0,    # compiled mesh programs launched
+            "max_occupancy": 0,
+        }
+
+    # -- intake ---------------------------------------------------------
+
+    def _scheduler(self):
+        return self.sts.ts.transport.scheduler
+
+    def try_submit(self, index: str, targets: List[Dict[str, Any]],
+                   body: Dict[str, Any], window: int, task,
+                   on_results: Callable[[Optional[List[Dict[str, Any]]]],
+                                        None]) -> bool:
+        """True = queued for a mesh drain (``on_results`` fires with the
+        per-shard query results in target order, or None = run the RPC
+        fan-out). False = not mesh-eligible; caller proceeds normally.
+        Never raises."""
+        try:
+            from elasticsearch_tpu.ops.device_segment import MESH_PLANES
+            from elasticsearch_tpu.utils.settings import setting_from_state
+            state = self.sts.state() if self.sts.state is not None else None
+            if not setting_from_state(state, SEARCH_MESH_ENABLED):
+                return False
+            MESH_PLANES.configure_from_state(state)
+            if not MESH_PLANES.available(len(targets)):
+                return False
+            if state is not None:
+                from elasticsearch_tpu.xpack.searchable_snapshots import (
+                    is_frozen,
+                )
+                if is_frozen(state, index):
+                    return False    # per-search device residency: RPC path
+            # co-location: every target shard must have an ACTIVE local
+            # copy. Membership in t["copies"] (the routing table's active
+            # copies) is required — a locally registered shard instance
+            # alone may be an initializing replica mid peer-recovery, and
+            # scoring its half-copied engine would return silently
+            # incomplete hits while the RPC path queries a complete copy.
+            for t in targets:
+                if t["index"] != index or \
+                        self.sts.node_id not in t.get("copies", ()) or \
+                        not self.sts.indices.has_shard(index, t["shard"]):
+                    return False
+            shard0 = self.sts.indices.shard(index, targets[0]["shard"])
+            spec = classify_request(
+                {"index": index, "shard": targets[0]["shard"],
+                 "body": body, "window": window},
+                shard0.engine.mappers)
+        except Exception:  # noqa: BLE001 — eligibility must never fail
+            return False   # a query; the RPC path reports real errors
+        if spec is None:
+            return False
+        shard_ids = sorted(t["shard"] for t in targets)
+        member = _Member(spec=spec, body=body, window=window,
+                         shard_ids=shard_ids, task=task,
+                         on_results=on_results)
+        key = (index, tuple(shard_ids)) + spec.key()
+        self._queues.setdefault(key, []).append(member)
+        if key not in self._scheduled:
+            # same-tick coalescing (the RRF fusion batcher's discipline):
+            # every member submitted in this dispatch round lands in one
+            # mesh program; an isolated search pays one scheduler hop
+            self._scheduled.add(key)
+            self._scheduler().schedule(0.0, lambda: self._drain(key))
+        return True
+
+    # -- drain ----------------------------------------------------------
+
+    def _drain(self, key: Tuple) -> None:
+        self._scheduled.discard(key)
+        members = self._queues.pop(key, [])
+        if not members:
+            return
+        self.stats["mesh_batches"] += 1
+        self.stats["max_occupancy"] = max(self.stats["max_occupancy"],
+                                          len(members))
+        try:
+            results = self._execute(key, members)
+        except Exception:  # noqa: BLE001 — the mesh must never lose
+            logger.debug("mesh drain failed; falling back per shard",
+                         exc_info=True)
+            results = None
+        if results is None:
+            self.stats["mesh_fallbacks"] += len(members)
+            for m in members:
+                self._deliver(m, None)
+            return
+        self.stats["mesh_searches"] += len(members)
+        for m, res in zip(members, results):
+            self._deliver(m, res)
+
+    def _deliver(self, member: _Member, res) -> None:
+        try:
+            member.on_results(res)
+        except Exception:  # noqa: BLE001 — one callback must not eat
+            logger.exception("mesh result delivery failed")
+
+    def _execute(self, key: Tuple, members: List[_Member]
+                 ) -> Optional[List[List[Dict[str, Any]]]]:
+        from elasticsearch_tpu.action.search_action import (
+            CONTEXT_KEEP_ALIVE,
+        )
+        from elasticsearch_tpu.ops.device_segment import MESH_PLANES
+        from elasticsearch_tpu.search.phase import shard_term_stats
+        index = key[0]
+        shard_ids = list(key[1])
+        spec0 = members[0].spec
+        for m in members:
+            if m.task is not None and getattr(m.task, "cancelled", False):
+                return None     # the RPC fan-out aborts it properly
+
+        shards = [self.sts.indices.shard(index, sid) for sid in shard_ids]
+        readers = [sh.engine.acquire_reader() for sh in shards]
+        shard_segments = [((index, sid), list(r.segments))
+                          for sid, r in zip(shard_ids, readers)]
+        mpart = MESH_PLANES.get(shard_segments,
+                                self._KIND_OF[spec0.kind], spec0.field)
+        if mpart is None:
+            return None
+        mappers = shards[0].engine.mappers
+
+        # per-shard contexts + (text) term stats, exactly as query_shard
+        # / the shard batcher build them — one reader snapshot per shard
+        # per drain, so results cannot cross a refresh
+        shard_ctxs = []
+        for r in readers:
+            doc_count = sum(seg.n_docs for seg in r.segments)
+            dfs: Dict[str, Dict[str, int]] = {}
+            if spec0.kind == "text":
+                for m in members:
+                    _dc, m_dfs = shard_term_stats(r, mappers,
+                                                  m.spec.query)
+                    for fname, termmap in m_dfs.items():
+                        dfs.setdefault(fname, {}).update(termmap)
+            shard_ctxs.append(_build_ctxs(
+                r, mappers, doc_count,
+                dfs if spec0.kind == "text" else None))
+
+        counter: list = []
+        want = spec0.window
+        from elasticsearch_tpu.search.plane_exec import (
+            MeshFallback, mesh_knn_winners, mesh_sparse_topk,
+            mesh_wand_topk,
+        )
+        try:
+            if spec0.kind == "text":
+                got = mesh_wand_topk(
+                    shard_ctxs, mpart, spec0.field,
+                    [m.spec.clauses for m in members], want,
+                    spec0.track_limit, counter=counter)
+                if got is None:
+                    return None
+                collector = "wand_topk"
+                per_shard_member = got
+            elif spec0.kind == "knn":
+                raw = mesh_knn_winners(
+                    shard_ctxs, mpart, spec0.field,
+                    [m.spec for m in members], spec0.k, counter=counter)
+                collector = "dense"
+                per_shard_member = [
+                    _knn_demux([m.spec for m in members], row, spec0.k)
+                    for row in raw]
+            else:
+                expansions = [[(t, w * m.spec.boost)
+                               for t, w in m.spec.tokens.items()]
+                              for m in members]
+                raw = mesh_sparse_topk(shard_ctxs, mpart, spec0.field,
+                                       expansions, want, counter=counter)
+                collector = "dense"
+                per_shard_member = []
+                for row in raw:
+                    member_rows = []
+                    for (cands, total, max_score), m in zip(row, members):
+                        relation = "eq"
+                        clip = m.spec.clip_limit
+                        if clip is not None and total > clip:
+                            total, relation = clip, "gte"
+                        member_rows.append((cands, total, relation,
+                                            max_score, None))
+                    per_shard_member.append(member_rows)
+        except MeshFallback:
+            return None
+        self.stats["device_dispatches"] += len(counter)
+
+        # synthesize per-member, per-shard query-phase responses — the
+        # exact dicts _execute_query_solo / the shard batcher produce,
+        # with a pinned reader context per (member, shard) so the fetch
+        # phase reads the same point-in-time snapshot
+        now = self.sts._now()
+        out: List[List[Dict[str, Any]]] = []
+        for mi, m in enumerate(members):
+            member_results: List[Dict[str, Any]] = []
+            for pos, sid in enumerate(shard_ids):
+                candidates, total, relation, max_score, prune = \
+                    per_shard_member[pos][mi]
+                docs = candidates[: want]
+                shard = shards[pos]
+                stats = shard.search_stats
+                stats["query_total"] += 1
+                if collector == "wand_topk" and prune:
+                    stats["wand_queries"] += 1
+                    stats["wand_blocks_total"] += prune[0]
+                    stats["wand_blocks_scored"] += prune[1]
+                context_id = uuid_mod.uuid4().hex
+                self.sts._contexts[context_id] = (
+                    readers[pos], now + CONTEXT_KEEP_ALIVE)
+                member_results.append({
+                    "context_id": context_id,
+                    "total": total,
+                    "relation": relation,
+                    "max_score": max_score,
+                    "collector": collector,
+                    "prune": list(prune) if prune else None,
+                    "docs": [{"segment": d.segment_idx, "doc": d.doc,
+                              "score": d.score,
+                              "sort": list(d.sort_values)}
+                             for d in docs],
+                    "terminated": False,
+                    "aggs_partial": None,
+                    "suggest_partial": None,
+                    "profile": None,
+                })
+                self.sts._slow_log(
+                    {"index": index, "shard": sid, "body": m.body},
+                    time.monotonic() - m.enqueued_wall)
+                self.stats["mesh_shard_results"] += 1
+            out.append(member_results)
+        return out

@@ -74,28 +74,37 @@ def plane_wand_topk(ctxs, part, field: str,
     shard-global theta barrier did — without the per-segment dispatches.
 
     Returns per member (candidates, hits, relation, max_score,
-    (blocks_total, blocks_scored)), or None when the request cannot run
-    on the plane — a DFS avgdl override makes the baked per-block norms
-    wrong, and totals-disabled requests report "candidates found" with
-    PER-SEGMENT truncation (sum of min(matches, want) per segment), a
-    number a fused top-k cannot reproduce — the caller then runs the
-    per-segment path."""
+    (blocks_total, blocks_scored)).
+
+    Totals-disabled requests (track_limit <= 0) are served too: the
+    per-segment contract reports "candidates found" with PER-SEGMENT
+    truncation (sum of min(matches, want) per segment), which the fused
+    top-k cannot reproduce from a whole-plane count — so the final
+    scoring dispatch counts per segment (``part.seg_ids`` channel) and
+    the host clips each segment's count at the collection window.
+
+    DFS-normed requests (a corpus-wide avgdl override) are served by the
+    second normalization channel: per-doc lengths live on the plane, the
+    per-block avgdl rides the dispatch as a kernel argument — an
+    override simply replaces the baked per-segment values with the
+    corpus-wide one, for plan upper bounds AND the length norm alike."""
     from elasticsearch_tpu.search.execute import _bm25_planner
-    if track_limit <= 0:
-        return None
-    # past this point totals are ALWAYS tracked (the counts-then-skip
-    # contract); totals-disabled requests just bailed to the per-segment
-    # path above
+    counts_on = track_limit > 0
     n_q = len(clause_lists)
     reader = _reader_of(ctxs)
 
+    avgdl_override = None
     per_seg = []        # (ctx, plans[n_q], block_base)
     seen_terms: List[Dict[str, float]] = [{} for _ in range(n_q)]
     has_terms = [False] * n_q
     for pos, pf, block_base, avgdl in part.refs:
         ctx = ctxs[pos]
-        if ctx.avgdl_for(field) is not None:
-            return None     # DFS-normed request: plane norms don't apply
+        override = ctx.avgdl_for(field)
+        if override is not None:
+            # DFS-normed: every segment norms against the corpus-wide
+            # avgdl (it is per-request per-field, so one value for all)
+            avgdl_override = float(override)
+            avgdl = avgdl_override
         analyzer = ctx.search_analyzer(field)
         ex = _bm25_planner(ctx, field)
         if ex is None:
@@ -129,9 +138,14 @@ def plane_wand_topk(ctxs, part, field: str,
     empty_plan = QueryPlan([], [], [], [])
 
     hits_upper = [int(sum(s.values())) for s in seen_terms]
-    exact_mode = [hits_upper[qi] <= track_limit for qi in range(n_q)]
+    exact_mode = [counts_on and hits_upper[qi] <= track_limit
+                  for qi in range(n_q)]
+    # the second normalization channel: per-block avgdl is a DISPATCH
+    # argument, so a DFS override replaces the baked per-segment values
+    eff_block_avgdl = part.block_avgdl if avgdl_override is None else \
+        np.full_like(part.block_avgdl, avgdl_override)
 
-    def _dispatch(rows, k, counted):
+    def _dispatch(rows, k, counted, count_segments=None):
         if check_members is not None:
             check_members()
         # the scatter materializes a [chunk_q, n_docs_pad] f32 score
@@ -147,8 +161,9 @@ def plane_wand_topk(ctxs, part, field: str,
             return dispatch_flat(part.block_docs, part.block_tfs,
                                  part.doc_lens, part.n_docs_pad, rows,
                                  live, k, DEFAULT_K1, DEFAULT_B,
-                                 block_avgdl=part.block_avgdl,
-                                 counted=counted, counter=counter)
+                                 block_avgdl=eff_block_avgdl,
+                                 counted=counted, counter=counter,
+                                 count_segments=count_segments)
 
     # phase A — ONE dispatch for the whole shard: exact-mode members score
     # every block (counted; final), pruned members their per-segment
@@ -178,7 +193,9 @@ def plane_wand_topk(ctxs, part, field: str,
             theta[qi] = float(np.sort(finite)[-want])
 
     # phase B — ONE dispatch: pruned members' WAND survivors scored
-    # exactly (+ counted); exact members ride as empty rows
+    # exactly (+ counted); exact members ride as empty rows. In
+    # totals-disabled mode the dispatch counts PER SEGMENT so the host
+    # can reproduce the per-segment truncated "candidates found" totals.
     blocks_total = [0] * n_q
     blocks_scored = [0] * n_q
     hits_exact = [True] * n_q
@@ -202,7 +219,12 @@ def plane_wand_topk(ctxs, part, field: str,
         rows_b.append(QueryPlan.concat(
             segs, idx_offsets=[bb for _c, _p, bb in per_seg]))
     if need_b:
-        s_b, d_b, h_b = _dispatch(rows_b, k_plane, True)
+        if counts_on:
+            s_b, d_b, h_b = _dispatch(rows_b, k_plane, True)
+        else:
+            s_b, d_b, h_b = _dispatch(
+                rows_b, k_plane, False,
+                count_segments=(part.seg_ids(), len(part.segments)))
     else:
         s_b = d_b = h_b = None
 
@@ -216,7 +238,8 @@ def plane_wand_topk(ctxs, part, field: str,
             hits_seen = int(np.asarray(h_a)[qi]) if h_a is not None else 0
         else:
             s_row, d_row = np.asarray(s_b)[qi], np.asarray(d_b)[qi]
-            hits_seen = int(np.asarray(h_b)[qi]) if h_b is not None else 0
+            hits_seen = (int(np.asarray(h_b)[qi].sum())
+                         if h_b is not None else 0)
         finite = s_row != -np.inf
         si, local = part.demux(d_row[finite])
         candidates = [ShardDoc(int(s_i), int(d_i), float(sc), (float(sc),))
@@ -224,7 +247,15 @@ def plane_wand_topk(ctxs, part, field: str,
         candidates.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
         max_score = max((c.score for c in candidates), default=None)
         prune = (blocks_total[qi], blocks_scored[qi])
-        if hits_seen >= track_limit:
+        if not counts_on:
+            # totals disabled: per-segment "candidates found", each
+            # segment's observed matches truncated at the collection
+            # window — sum of min(matches, want) per segment, exactly
+            # the per-segment path's len(candidates)
+            h_row = np.asarray(h_b)[qi]
+            total = int(np.minimum(h_row, want).sum())
+            out.append((candidates, total, "gte", max_score, prune))
+        elif hits_seen >= track_limit:
             out.append((candidates, track_limit, "gte", max_score, prune))
         elif hits_exact[qi] or exact_mode[qi]:
             out.append((candidates, hits_seen, "eq", max_score, prune))
@@ -233,7 +264,7 @@ def plane_wand_topk(ctxs, part, field: str,
 
     # members whose pruned counts might hide hits: one exact unpruned
     # counted pass (k=1; scores already final) — still ONE dispatch
-    recount = [qi for qi in range(n_q) if out[qi][1] is None]
+    recount = [qi for qi in range(n_q) if counts_on and out[qi][1] is None]
     if recount:
         rows_r = []
         for qi in range(n_q):
@@ -538,4 +569,476 @@ def plane_sparse_topk(ctxs, part, field: str,
         cands.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
         max_score = max((c.score for c in cands), default=None)
         out.append((cands, int(h[qi]), max_score))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded plane executors: ONE SPMD program for a whole co-located
+# fan-out (ops/device_segment.py MeshPlanePart over a (dp, shard) mesh)
+# ---------------------------------------------------------------------------
+
+class MeshFallback(Exception):
+    """This fan-out cannot run on the mesh (e.g. an IVF-routed shard);
+    the caller runs the per-shard RPC fan-out."""
+
+
+def _shard_readers(shard_ctxs):
+    return [ctxs[0].reader if ctxs else None for ctxs in shard_ctxs]
+
+
+def _mesh_live(mpart, shard_ctxs) -> np.ndarray:
+    """Reader-snapshot live masks per slot, in each sub's plane doc
+    layout (padding slots and padding docs stay False) — built per
+    dispatch, like the single-shard plane's ``live_mask``, so deletes
+    never invalidate the mesh plane itself."""
+    out = np.zeros((mpart.n_slots, mpart.n_docs_pad), bool)
+    for i, reader in enumerate(_shard_readers(shard_ctxs)):
+        if reader is None:
+            continue
+        off = 0
+        for m in reader.live_masks:
+            out[i, off: off + len(m)] = np.asarray(m)
+            off += len(m)
+    return out
+
+
+def mesh_wand_topk(shard_ctxs, mpart, field: str,
+                   clause_lists: List[List[Tuple[str, float]]],
+                   want: int, track_limit: int,
+                   check_members: Optional[Callable[[], None]] = None,
+                   counter: Optional[list] = None
+                   ) -> Optional[List[List[Tuple]]]:
+    """Q text queries against S co-located shards' postings planes in
+    TWO mesh dispatches (phase-A theta, phase-B survivors) plus at most
+    one recount — per SHARD semantics identical to plane_wand_topk /
+    the per-segment loops, so the coordinator merge over the synthesized
+    per-shard results is byte-compatible with the RPC fan-out.
+
+    Returns [shard][member] (candidates, hits, relation, max_score,
+    (blocks_total, blocks_scored)), or None when the request must take
+    the per-shard path (DFS overrides)."""
+    from elasticsearch_tpu.ops.bm25 import flatten_plans, qb_bucket
+    from elasticsearch_tpu.parallel.mesh import mesh_bm25_flat
+    from elasticsearch_tpu.search.execute import _bm25_planner
+
+    counts_on = track_limit > 0
+    n_q = len(clause_lists)
+    n_sh = mpart.n_shards
+    n_q_pad = next_pow2(max(n_q, 1), minimum=1)
+    empty = ([], 0, "eq", None, (0, 0))
+    empty_plan = QueryPlan([], [], [], [])
+
+    prepped: List[Optional[Dict]] = []
+    for si in range(n_sh):
+        sub = mpart.subs[si]
+        if sub is None:
+            prepped.append(None)
+            continue
+        ctxs = shard_ctxs[si]
+        per_seg = []        # (plans[n_q], block_base)
+        seen: List[Dict[str, float]] = [{} for _ in range(n_q)]
+        has = [False] * n_q
+        for pos, _pf, block_base, avgdl in sub.refs:
+            ctx = ctxs[pos]
+            if ctx.avgdl_for(field) is not None:
+                return None     # DFS fan-outs keep the RPC path
+            analyzer = ctx.search_analyzer(field)
+            ex = _bm25_planner(ctx, field)
+            if ex is None:
+                continue
+            df_map = ctx.df_for(field) or {}
+            member_terms: List[List[Tuple[str, float]]] = []
+            any_terms = False
+            for qi, clauses in enumerate(clause_lists):
+                terms: List[Tuple[str, float]] = []
+                for text, boost in clauses:
+                    terms.extend((t, boost)
+                                 for t in analyzer.terms(text))
+                member_terms.append(terms)
+                if terms:
+                    any_terms = True
+                    has[qi] = True
+                    for t, _b in terms:
+                        if t not in seen[qi]:
+                            seen[qi][t] = float(df_map.get(t, 0))
+            if not any_terms:
+                continue
+            plans = ex.build_plans(member_terms,
+                                   df_override=df_map or None,
+                                   avgdl=avgdl)
+            per_seg.append((plans, block_base))
+        prepped.append({"per_seg": per_seg, "seen": seen, "has": has}
+                       if per_seg else None)
+
+    if all(p is None for p in prepped):
+        return [[empty] * n_q for _ in range(n_sh)]
+
+    exact_mode = np.zeros((n_sh, n_q), bool)
+    for si, p in enumerate(prepped):
+        if p is None:
+            continue
+        for qi in range(n_q):
+            upper = int(sum(p["seen"][qi].values()))
+            exact_mode[si, qi] = counts_on and upper <= track_limit
+
+    k_mesh = min(max(want, 1), mpart.n_docs_pad)
+    live_host = _mesh_live(mpart, shard_ctxs)
+
+    def _dispatch(rows_by_shard, k):
+        if check_members is not None:
+            check_members()
+        fb = qb_bucket(max(
+            [sum(p.n_blocks for p in rows)
+             for rows in rows_by_shard if rows] + [1]))
+        idx = np.zeros((mpart.n_slots, fb), np.int32)
+        w = np.zeros((mpart.n_slots, fb), np.float32)
+        qid = np.zeros((mpart.n_slots, fb), np.int32)
+        favg = np.ones((mpart.n_slots, fb), np.float32)
+        for si, rows in enumerate(rows_by_shard):
+            if not rows:
+                continue
+            i_s, w_s, q_s = flatten_plans(rows, fb)
+            idx[si], w[si], qid[si] = i_s, w_s, q_s
+            favg[si] = mpart.subs[si].block_avgdl[i_s]
+        fn = mesh_bm25_flat(mpart.mesh, mpart.n_docs_pad, n_q_pad, k,
+                            mpart.n_segs_max, DEFAULT_K1, DEFAULT_B)
+        from elasticsearch_tpu.indices.breaker import BREAKERS
+        transient = 8 * mpart.n_docs_pad * n_q_pad * mpart.n_slots
+        with BREAKERS.breaker("request").limit_scope(
+                transient, "mesh_wand_topk"):
+            if counter is not None:
+                counter.append(1)
+            s, d, h = fn(mpart.block_docs, mpart.block_tfs,
+                         mpart.doc_lens, jnp.asarray(idx),
+                         jnp.asarray(w), jnp.asarray(qid),
+                         jnp.asarray(favg), jnp.asarray(live_host),
+                         mpart.seg_ids)
+        return np.asarray(s), np.asarray(d), np.asarray(h)
+
+    def _rows(select):
+        """[slot][n_q_pad] plan rows; ``select(si, qi, plans)`` -> plan
+        for that (shard, member, segment) or empty_plan."""
+        out = []
+        for si in range(mpart.n_slots):
+            p = prepped[si] if si < n_sh else None
+            if p is None:
+                out.append(None)
+                continue
+            rows = []
+            for qi in range(n_q):
+                segs = [select(si, qi, plans[qi])
+                        for plans, _bb in p["per_seg"]]
+                rows.append(QueryPlan.concat(
+                    segs,
+                    idx_offsets=[bb for _pl, bb in p["per_seg"]]))
+            rows.extend([empty_plan] * (n_q_pad - n_q))
+            out.append(rows)
+        return out
+
+    # phase A — one mesh dispatch: exact-mode (shard, member) pairs score
+    # all their blocks (their counts are final), pruned pairs their
+    # per-segment P1_BUCKET highest-upper-bound blocks
+    rows_a = _rows(lambda si, qi, p:
+                   p if exact_mode[si, qi] else p.top_by_ub(P1_BUCKET))
+    s_a, d_a, h_a = _dispatch(rows_a, k_mesh)
+
+    theta = np.full((n_sh, n_q), -np.inf)
+    for si, p in enumerate(prepped):
+        if p is None:
+            continue
+        for qi in range(n_q):
+            if exact_mode[si, qi]:
+                continue
+            finite = s_a[si, qi][np.isfinite(s_a[si, qi])]
+            if len(finite) >= want:
+                theta[si, qi] = float(np.sort(finite)[-want])
+
+    # phase B — one mesh dispatch: per-(shard, member) WAND survivors
+    blocks_total = np.zeros((n_sh, n_q), np.int64)
+    blocks_scored = np.zeros((n_sh, n_q), np.int64)
+    hits_exact = np.ones((n_sh, n_q), bool)
+
+    def _survivors(si, qi, p):
+        if exact_mode[si, qi]:
+            blocks_total[si, qi] += p.n_blocks
+            blocks_scored[si, qi] += p.n_blocks
+            return empty_plan
+        surv = p.survivors(float(theta[si, qi]))
+        p1_cost = min(p.n_blocks, P1_BUCKET)
+        blocks_total[si, qi] += p.n_blocks
+        blocks_scored[si, qi] += min(surv.n_blocks + p1_cost, p.n_blocks)
+        if surv.n_blocks < p.n_blocks:
+            hits_exact[si, qi] = False
+        return surv
+
+    rows_b = _rows(_survivors)
+    need_b = any(
+        not exact_mode[si, qi]
+        for si in range(n_sh) if prepped[si] is not None
+        for qi in range(n_q))
+    if need_b:
+        s_b, d_b, h_b = _dispatch(rows_b, k_mesh)
+    else:
+        s_b = d_b = h_b = None
+
+    out: List[List[Tuple]] = []
+    for si in range(n_sh):
+        p = prepped[si]
+        row_out: List[Tuple] = []
+        if p is None:
+            out.append([empty] * n_q)
+            continue
+        sub = mpart.subs[si]
+        for qi in range(n_q):
+            if not p["has"][qi]:
+                row_out.append(empty)
+                continue
+            if exact_mode[si, qi]:
+                s_row, d_row = s_a[si, qi], d_a[si, qi]
+                h_row = h_a[si, qi]
+            else:
+                s_row, d_row = s_b[si, qi], d_b[si, qi]
+                h_row = h_b[si, qi]
+            finite = s_row != -np.inf
+            seg, local = sub.demux(d_row[finite])
+            cands = [ShardDoc(int(a), int(b), float(sc), (float(sc),))
+                     for a, b, sc in zip(seg, local, s_row[finite])]
+            cands.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+            max_score = max((c.score for c in cands), default=None)
+            prune = (int(blocks_total[si, qi]),
+                     int(blocks_scored[si, qi]))
+            if not counts_on:
+                total = int(np.minimum(h_row, want).sum())
+                row_out.append((cands, total, "gte", max_score, prune))
+                continue
+            hits_seen = int(h_row.sum())
+            if hits_seen >= track_limit:
+                row_out.append((cands, track_limit, "gte", max_score,
+                                prune))
+            elif hits_exact[si, qi] or exact_mode[si, qi]:
+                row_out.append((cands, hits_seen, "eq", max_score,
+                                prune))
+            else:
+                row_out.append((cands, None, None, max_score, prune))
+        out.append(row_out)
+
+    # (shard, member) pairs whose pruned counts might hide hits: one
+    # exact unpruned counted mesh pass (k=1; scores already final)
+    recount = {(si, qi)
+               for si in range(n_sh) for qi in range(n_q)
+               if counts_on and prepped[si] is not None
+               and out[si][qi][1] is None}
+    if recount:
+        rows_r = _rows(lambda si, qi, p:
+                       p if (si, qi) in recount else empty_plan)
+        _s, _d, h_r = _dispatch(rows_r, 1)
+        for si, qi in recount:
+            cands, _, _, max_score, prune = out[si][qi]
+            exact_hits = int(h_r[si, qi].sum())
+            if exact_hits > track_limit:
+                out[si][qi] = (cands, track_limit, "gte", max_score,
+                               prune)
+            else:
+                out[si][qi] = (cands, exact_hits, "eq", max_score,
+                               prune)
+    return out
+
+
+def mesh_knn_winners(shard_ctxs, mpart, field: str, specs, k: int,
+                     check_members: Optional[Callable[[], None]] = None,
+                     counter: Optional[list] = None
+                     ) -> List[List[List[Tuple[int, int, float]]]]:
+    """Q kNN queries against S co-located shards' vector planes in ONE
+    mesh dispatch: the query stack rides the dp axis, the corpus the
+    shard axis, and each slot's row reproduces that shard's exact plane
+    matmul (plane_knn_winners' exact path). Mesh kNN always serves EXACT
+    scores — a strict superset of the quantized coarse pass's
+    exact-up-to-rerank-depth contract.
+
+    Returns [shard][member] winner lists [(segment_idx, local_doc,
+    raw_score)]. Raises MeshFallback for IVF-routed shards (mapping
+    opt-in or ANN-sized corpora) — those keep the per-shard fan-out,
+    whose probe path already serves them."""
+    from elasticsearch_tpu.parallel.mesh import mesh_knn_topk
+    from elasticsearch_tpu.search.execute import (
+        ANN_DEFAULT_MIN_DOCS, execute as execute_query,
+    )
+    n_q = len(specs)
+    n_sh = mpart.n_shards
+
+    ctx0 = next((ctxs[0] for ctxs in shard_ctxs if ctxs), None)
+    if ctx0 is not None:
+        mapper = ctx0.mappers.mapper(field)
+        opts = getattr(mapper, "index_options", None) or {}
+        if opts.get("type") == "ivf":
+            raise MeshFallback(
+                f"[{field}] is IVF-mapped: the per-shard probe serves")
+    for sub in mpart.subs:
+        if sub is None:
+            continue
+        sizes = [s.n_docs for s in sub.segments
+                 if s.vectors.get(field) is not None]
+        if sizes and min(sizes) >= ANN_DEFAULT_MIN_DOCS:
+            raise MeshFallback(
+                "ANN-sized shard would take the per-segment IVF route")
+
+    if check_members is not None:
+        check_members()
+    vectors = np.asarray([s.query_vector for s in specs], np.float32)
+    dp = max(1, int(mpart.mesh.shape["dp"]))
+    n_q_pad = next_pow2(max(n_q, 1), minimum=1)
+    n_q_pad = -(-n_q_pad // dp) * dp
+    q_host = np.zeros((n_q_pad, vectors.shape[1]), np.float32)
+    q_host[:n_q] = vectors
+
+    live_host = _mesh_live(mpart, shard_ctxs)
+    # distinct filters resolve to masks once per (filter, shard) — the
+    # batched executor's sharing rule, stacked into mesh slot space
+    fkeys = {s.filter_key for s in specs}
+    masks_host = None
+    if fkeys != {None}:
+        by_key: Dict[Optional[str], np.ndarray] = {}
+        for fk in fkeys:
+            if fk is None:
+                continue
+            spec = next(s for s in specs if s.filter_key == fk)
+            rows = np.zeros((mpart.n_slots, mpart.n_docs_pad), bool)
+            for si in range(n_sh):
+                sub = mpart.subs[si]
+                if sub is None:
+                    continue
+                for pos, ctx in enumerate(shard_ctxs[si]):
+                    _, fmask = execute_query(spec.filter, ctx)
+                    base = int(sub.doc_base[pos])
+                    n = ctx.segment.n_docs
+                    rows[si, base: base + n] = np.asarray(fmask)[:n]
+            by_key[fk] = rows
+        if len(fkeys) == 1:
+            # every member carries the SAME filter: fold it into the
+            # allowed mask (one unmasked dispatch)
+            live_host = live_host & by_key[next(iter(fkeys))]
+        else:
+            masks_host = np.ones(
+                (mpart.n_slots, n_q_pad, mpart.n_docs_pad), bool)
+            for qi, spec in enumerate(specs):
+                if spec.filter_key is not None:
+                    masks_host[:, qi, :] = by_key[spec.filter_key]
+
+    k_mesh = min(max(k, 1), mpart.n_docs_pad)
+    allowed = jnp.logical_and(jnp.asarray(live_host), mpart.exists)
+    fn = mesh_knn_topk(mpart.mesh, k_mesh, mpart.similarity,
+                       masked=masks_host is not None)
+    from elasticsearch_tpu.indices.breaker import BREAKERS
+    transient = 8 * mpart.n_docs_pad * n_q_pad * mpart.n_slots
+    with BREAKERS.breaker("request").limit_scope(transient, "mesh_knn"):
+        if counter is not None:
+            counter.append(1)
+        if masks_host is not None:
+            s, d = fn(mpart.matrix, mpart.norms, allowed,
+                      jnp.asarray(q_host), jnp.asarray(masks_host))
+        else:
+            s, d = fn(mpart.matrix, mpart.norms, allowed,
+                      jnp.asarray(q_host))
+    s, d = np.asarray(s), np.asarray(d)
+
+    winners: List[List[List[Tuple[int, int, float]]]] = []
+    for si in range(n_sh):
+        sub = mpart.subs[si]
+        row: List[List[Tuple[int, int, float]]] = []
+        for qi in range(n_q):
+            if sub is None:
+                row.append([])
+                continue
+            finite = s[si, qi] > -np.inf
+            seg, local = sub.demux(d[si, qi][finite])
+            row.append([(int(a), int(b), float(sc)) for a, b, sc in
+                        zip(seg, local, s[si, qi][finite])])
+        winners.append(row)
+    return winners
+
+
+def mesh_sparse_topk(shard_ctxs, mpart, field: str,
+                     expansions: List[List[Tuple[str, float]]],
+                     want: int,
+                     check_members: Optional[Callable[[], None]] = None,
+                     counter: Optional[list] = None) -> List[List[Tuple]]:
+    """Q resolved expansions against S co-located shards' rank_features
+    planes in ONE mesh dispatch, exact per-shard match counts off the
+    score plane. Returns [shard][member] (candidates, total,
+    max_score) — plane_sparse_topk's shape per shard."""
+    from elasticsearch_tpu.parallel.mesh import (
+        mesh_sparse_topk as _mesh_sparse_kernel,
+    )
+    n_q = len(expansions)
+    n_sh = mpart.n_shards
+    n_q_pad = next_pow2(max(n_q, 1), minimum=1)
+
+    per_shard: List[Optional[List[Tuple[np.ndarray, np.ndarray]]]] = []
+    qb_max = 1
+    for si in range(n_sh):
+        sub = mpart.subs[si]
+        if sub is None:
+            per_shard.append(None)
+            continue
+        per = []
+        for expansion in expansions:
+            idx_parts, w_parts = [], []
+            for _pos, ff, block_base in sub.refs:
+                for name, weight in expansion:
+                    t_idx = ff.feature_block_idx(name)
+                    if len(t_idx):
+                        idx_parts.append(t_idx + np.int32(block_base))
+                        w_parts.append(np.full(len(t_idx), weight,
+                                               np.float32))
+            if idx_parts:
+                per.append((np.concatenate(idx_parts),
+                            np.concatenate(w_parts)))
+                qb_max = max(qb_max, len(per[-1][0]))
+            else:
+                per.append((np.zeros(0, np.int32),
+                            np.zeros(0, np.float32)))
+        per_shard.append(per)
+
+    qb_pad = next_pow2(qb_max, minimum=8)
+    idx = np.zeros((mpart.n_slots, n_q_pad, qb_pad), np.int32)
+    w = np.zeros((mpart.n_slots, n_q_pad, qb_pad), np.float32)
+    for si, per in enumerate(per_shard):
+        if per is None:
+            continue
+        for qi, (bi, bw) in enumerate(per):
+            idx[si, qi, : len(bi)] = bi
+            w[si, qi, : len(bw)] = bw
+
+    if check_members is not None:
+        check_members()
+    live_host = _mesh_live(mpart, shard_ctxs)
+    k_mesh = min(max(want, 1), mpart.n_docs_pad)
+    fn = _mesh_sparse_kernel(mpart.mesh, mpart.n_docs_pad, k_mesh)
+    from elasticsearch_tpu.indices.breaker import BREAKERS
+    transient = 8 * mpart.n_docs_pad * n_q_pad * mpart.n_slots
+    with BREAKERS.breaker("request").limit_scope(
+            transient, "mesh_sparse"):
+        if counter is not None:
+            counter.append(1)
+        s, d, h = fn(mpart.block_docs, mpart.block_weights,
+                     jnp.asarray(idx), jnp.asarray(w),
+                     jnp.asarray(live_host))
+    s, d, h = np.asarray(s), np.asarray(d), np.asarray(h)
+
+    out: List[List[Tuple]] = []
+    for si in range(n_sh):
+        sub = mpart.subs[si]
+        row: List[Tuple] = []
+        for qi in range(n_q):
+            if sub is None:
+                row.append(([], 0, None))
+                continue
+            finite = s[si, qi] != -np.inf
+            seg, local = sub.demux(d[si, qi][finite])
+            cands = [ShardDoc(int(a), int(b), float(sc), (float(sc),))
+                     for a, b, sc in zip(seg, local, s[si, qi][finite])]
+            cands.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+            max_score = max((c.score for c in cands), default=None)
+            row.append((cands, int(h[si, qi]), max_score))
+        out.append(row)
     return out

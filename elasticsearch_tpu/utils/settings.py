@@ -364,6 +364,29 @@ SEARCH_PLANE_MAX_BYTES: Setting[int] = Setting.int_setting(
     "search.plane.max_bytes", 0, min_value=0,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
+# Mesh-sharded device planes (ops/device_segment.py MeshPlaneRegistry +
+# search/mesh_executor.py): a co-located fan-out — every target shard's
+# plane resident on this node's device mesh — runs as ONE SPMD program
+# instead of per-shard dispatches. enabled=false restores the RPC
+# scatter-gather byte-for-byte.
+SEARCH_MESH_ENABLED: Setting[bool] = Setting.bool_setting(
+    "search.mesh.enabled", True,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# fan-outs below this co-located shard count keep the per-shard path (a
+# one-shard mesh adds residency for zero dispatch savings — the per-shard
+# plane already serves it in one program)
+SEARCH_MESH_MIN_SHARDS: Setting[int] = Setting.int_setting(
+    "search.mesh.min_shards", 2, min_value=2,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# data-parallel degree of the (dp, shard) mesh: the micro-batched query
+# stack splits over this many replicas of the corpus stack (HBM cost:
+# dp copies); 1 = pure model parallelism over the corpus axis
+SEARCH_MESH_DP: Setting[int] = Setting.int_setting(
+    "search.mesh.dp", 1, min_value=1, max_value=64,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
 # gateway.recover_after_data_nodes-style fleet-completeness release: when
 # this many data nodes have joined AND answered the shard-state fetch,
 # allocation stops waiting out EXISTING_COPY_GRACE for absent copy-holders

@@ -119,3 +119,4 @@ def evict_device_caches(reader) -> None:
     mod = sys.modules.get("elasticsearch_tpu.ops.device_segment")
     if mod is not None:
         mod.PLANES.drop_segments(seg.uid for seg in reader.segments)
+        mod.MESH_PLANES.drop_segments(seg.uid for seg in reader.segments)

@@ -147,6 +147,73 @@ def test_golden_plane_parity_with_deletes(seed):
             assert doc_id not in deleted
 
 
+def test_totals_disabled_served_on_plane():
+    """PR 7 satellite: track_total_hits=false text queries no longer
+    fall back per segment — the plane's final dispatch counts PER
+    SEGMENT and the host clips at the collection window, reproducing
+    the per-segment 'candidates found' total exactly."""
+    eng, _rng = _engine(53)
+    reader = eng.acquire_reader()
+    body = {"match": {"body": "w1 w3 w7"}}
+    plane = _run(eng, reader, body, track=False)
+    assert PLANES.stats_snapshot()["planes_resident"] >= 1
+    assert PLANES.stats["plane_miss_fallbacks"] == 0
+    PLANES.clear()
+    PLANES.enabled = False
+    solo = _run(eng, reader, body, track=False)
+    PLANES.enabled = True
+    _assert_same(solo, plane)
+    assert plane.total_relation == "gte"
+
+
+def test_dfs_avgdl_override_served_on_plane():
+    """PR 7 satellite: DFS-normed requests (corpus-wide avgdl override)
+    ride the plane's second normalization channel — per-doc lengths on
+    device, per-block avgdl as a dispatch argument the override simply
+    replaces — instead of bypassing the plane."""
+    eng, _rng = _engine(59)
+    reader = eng.acquire_reader()
+    body = {"match": {"body": "w1 w3 w7"}}
+    fso = {"body": (54321.0, 240)}     # corpus-wide avgdl ~226
+    plane = query_shard(reader, eng.mappers, dsl.parse_query(body),
+                        size=10, sort=parse_sort(None),
+                        field_stats_overrides=fso)
+    assert PLANES.stats_snapshot()["planes_resident"] >= 1
+    PLANES.clear()
+    PLANES.enabled = False
+    solo = query_shard(reader, eng.mappers, dsl.parse_query(body),
+                       size=10, sort=parse_sort(None),
+                       field_stats_overrides=fso)
+    PLANES.enabled = True
+    _assert_same(solo, plane)
+    # and the override actually changed the norms vs the baked avgdl
+    plain = _run(eng, reader, body)
+    assert [d.score for d in plain.docs] != [d.score for d in plane.docs]
+
+
+def test_plane_ivf_warm_start_across_generations():
+    """PR 7 satellite: a new plane generation's IVF k-means seeds from
+    the previous generation's centroids (counted in ivf_warm_starts)
+    instead of retraining from scratch."""
+    eng, rng = _engine(61, ivf=True)
+    reader = eng.acquire_reader()
+    body = {"knn": {"field": "vec", "k": 5, "query_vector":
+                    [float(x) for x in rng.standard_normal(8)]}}
+    r1 = _run(eng, reader, body, size=5)
+    assert len(r1.docs) == 5
+    warm0 = PLANES.stats["ivf_warm_starts"]
+    for i in range(400, 430):
+        eng.index(str(i), {"body": "w1",
+                           "vec": [float(x)
+                                   for x in rng.standard_normal(8)],
+                           "feats": {"f1": 1.0}, "tag": "t0"})
+    eng.refresh()     # publishes the appended generation eagerly
+    reader2 = eng.acquire_reader()
+    r2 = _run(eng, reader2, body, size=5)
+    assert PLANES.stats["ivf_warm_starts"] > warm0
+    assert len(r2.docs) == 5
+
+
 @pytest.mark.parametrize("seed", [41 + 1000 * k for k in range(CHAOS_SEEDS)])
 def test_quantized_coarse_pass_identical_topk(seed):
     """int8 coarse pass + exact f32 re-rank: identical top-k docs AND
