@@ -154,6 +154,80 @@ def concurrent_mode(result, name: str, run_single, run_batched,
             f"{type(e).__name__}: {e}"[:200]
 
 
+def telemetry_probe(jax, result, name: str, query_class: str,
+                    data_plane: str, per_query_fn, n: int = 48,
+                    occupancy: int = 1) -> None:
+    """Feed the serving path's latency histograms from a bench config:
+    each probe call runs under an activated SearchTrace exactly like a
+    served query (ops-layer record_dispatch attributes device programs
+    to it), so the emitted ``telemetry`` block carries the same
+    per-(query class x data plane) span breakdown ``_nodes/stats``'s
+    "search_latency" section serves — and the next perf PR picks its
+    target from a measurement instead of a guess. ``occupancy`` > 1
+    marks one call as a coalesced batch drain of that width."""
+    try:
+        from elasticsearch_tpu.search.telemetry import (
+            TELEMETRY, SearchTrace, activate,
+        )
+        block = jax.block_until_ready
+        block(per_query_fn(0))   # warm: compile outside the histogram
+        for i in range(n):
+            trace = SearchTrace(query_class, data_plane)
+            t0 = time.monotonic_ns()
+            with activate(trace):
+                block(per_query_fn(i))
+            meta = {}
+            if trace.dispatches:
+                meta["dispatches"] = trace.dispatches
+            if occupancy > 1:
+                meta["occupancy"] = occupancy
+            trace.add_span("device_dispatch", time.monotonic_ns() - t0,
+                           meta or None)
+            trace.finish()
+            TELEMETRY.observe(trace)
+    except Exception as e:  # noqa: BLE001 — telemetry must never cost
+        # a config its headline numbers
+        result["errors"][f"{name}_telemetry"] = \
+            f"{type(e).__name__}: {e}"[:200]
+
+
+def recorded_probe(fn, n: int = 1):
+    """Wrap a telemetry_probe lambda that calls a jitted free kernel
+    directly (knn_topk_batch, the hybrid fuse): jit'd functions cannot
+    self-report through record_dispatch, so the launch count is recorded
+    at the call site — keeping the knn/hybrid histogram entries' dispatch
+    counts honest next to the self-reporting bm25/sparse/ivf paths."""
+    def run(i):
+        from elasticsearch_tpu.search.telemetry import record_dispatch
+        record_dispatch(n)
+        return fn(i)
+    return run
+
+
+def telemetry_report(result) -> None:
+    """--telemetry: the histogram breakdown per (query class x data
+    plane), human-readable, on stderr (stdout stays the one JSON
+    line)."""
+    tel = result.get("telemetry") or {}
+    lines = ["search_latency (bench probes):"]
+    for key, entry in sorted((tel.get("classes") or {}).items()):
+        lat = entry.get("latency", {})
+        lines.append(
+            f"  {key:<16} n={entry.get('queries', 0):<5}"
+            f" p50={lat.get('p50_ms', 0):>9.4f}ms"
+            f" p95={lat.get('p95_ms', 0):>9.4f}ms"
+            f" p99={lat.get('p99_ms', 0):>9.4f}ms"
+            f" dispatches={entry.get('device_dispatches', 0)}")
+        for span, hist in sorted((entry.get("spans") or {}).items()):
+            lines.append(
+                f"    {span:<22} p50={hist.get('p50_ms', 0):>9.4f}ms"
+                f" p99={hist.get('p99_ms', 0):>9.4f}ms")
+    falls = tel.get("fallback_reasons") or {}
+    lines.append(f"fallback_reasons: {falls if falls else '{}'} "
+                 f"(unknown={falls.get('unknown', 0)})")
+    print("\n".join(lines), file=sys.stderr)
+
+
 # ---------------------------------------------------------------------------
 # corpus builders (host-side, numpy)
 # ---------------------------------------------------------------------------
@@ -294,6 +368,11 @@ def cfg_bm25(np, jax, jnp, result):
         result, "bm25",
         lambda: [block(run_batch([q], True)) for q in conc_q],
         lambda: block(run_batch(conc_q, True)), clients)
+    telemetry_probe(jax, result, "bm25", "bm25", "solo",
+                    lambda i: run_batch([queries[64 + i % 128]], True))
+    telemetry_probe(jax, result, "bm25", "bm25", "batch",
+                    lambda i: run_batch(conc_q, True), n=8,
+                    occupancy=clients)
     return pf, dev, ex, live  # reused by cfg_hybrid (same corpus class)
 
 
@@ -377,6 +456,14 @@ def cfg_knn(np, jax, jnp, result):
             "cosine")),
         clients, occupancy=uniq,
         extras={"memo_hit_rate": round(1 - uniq / clients, 3)})
+    telemetry_probe(jax, result, "knn", "knn", "solo",
+                    recorded_probe(lambda i: knn_topk_batch(
+                        matrix, norms, ones, ones,
+                        q_dev[i % n_q: i % n_q + 1], K, "cosine")))
+    telemetry_probe(jax, result, "knn", "knn", "batch",
+                    recorded_probe(lambda i: knn_topk_batch(
+                        matrix, norms, ones, ones, q_dev[:clients], K,
+                        "cosine")), n=8, occupancy=clients)
     return corpus  # reused by cfg_hybrid
 
 
@@ -438,6 +525,9 @@ def cfg_ivf(np, jax, jnp, result):
         lambda: block(index.search_device(q_dev[:clients], K,
                                           nprobe=nprobe)),
         clients)
+    telemetry_probe(jax, result, "ivf", "knn", "solo",
+                    lambda i: index.search_device(
+                        q_dev[i % n_q: i % n_q + 1], K, nprobe=nprobe))
 
     # CPU reference: the SAME IVF plan (probe nprobe centroids, scan
     # their packed lists with BLAS, top-k) on host numpy — the ANN
@@ -545,6 +635,16 @@ def cfg_hybrid(np, jax, jnp, result, knn_corpus, bm25_ctx):
                                  vec_queries[:uniq])),
         clients, occupancy=uniq,
         extras={"memo_hit_rate": round(1 - uniq / clients, 3)})
+    # the bm25 leg self-reports through dispatch_flat; the direct-kernel
+    # knn leg + the fuse are the 2 recorded here
+    telemetry_probe(jax, result, "hybrid", "hybrid", "solo",
+                    recorded_probe(
+                        lambda i: hybrid_run(text_queries[i % batch:
+                                                          i % batch + 1],
+                                             vec_queries[i % batch:
+                                                         i % batch + 1]),
+                        n=2),
+                    n=16)
 
     # CPU reference: host BM25 scatter-add + BLAS cosine + python RRF —
     # the serving-equivalent hybrid pipeline without the device
@@ -641,6 +741,14 @@ def cfg_sparse(np, jax, jnp, result):
                  for i in range(clients)],
         lambda: block(ex.top_k_batch(conc_exp, live, K,
                                      function="saturation")), clients)
+    telemetry_probe(jax, result, "sparse", "sparse", "solo",
+                    lambda i: ex.top_k_batch(
+                        conc_exp[i % clients: i % clients + 1], live, K,
+                        function="saturation"))
+    telemetry_probe(jax, result, "sparse", "sparse", "batch",
+                    lambda i: ex.top_k_batch(conc_exp, live, K,
+                                             function="saturation"),
+                    n=8, occupancy=clients)
 
     # CPU reference: term-at-a-time scatter-add with the same saturation
     # transform qw * w/(w+pivot) over the same feature blocks — the host
@@ -1202,8 +1310,19 @@ def main() -> None:
                 result["errors"][name] = f"{type(e).__name__}: {e}"[:300]
     except Exception as e:  # noqa: BLE001 — the line must still print
         result["errors"]["fatal"] = f"{type(e).__name__}: {e}"[:300]
+    # the latency-histogram block rides every bench line (span-level
+    # breakdown per query class x data plane + the typed fallback-reason
+    # taxonomy — "unknown" must stay 0), so BENCH_r0N files carry the
+    # measurement the next perf PR targets
+    try:
+        from elasticsearch_tpu.search.telemetry import TELEMETRY
+        result["telemetry"] = TELEMETRY.snapshot()
+    except Exception as e:  # noqa: BLE001 — the line must still print
+        result["errors"]["telemetry"] = f"{type(e).__name__}: {e}"[:200]
     result["wall_s"] = round(time.perf_counter() - t_start, 1)
     print(json.dumps(result))
+    if "--telemetry" in sys.argv:
+        telemetry_report(result)
 
 
 if __name__ == "__main__":

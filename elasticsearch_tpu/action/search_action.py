@@ -25,10 +25,12 @@ from elasticsearch_tpu.index.engine import Reader
 from elasticsearch_tpu.indices.indices_service import IndicesService
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.fetch import fetch_hits
+from elasticsearch_tpu.search import telemetry
 from elasticsearch_tpu.search.phase import (
     ShardDoc, collect_query_terms, parse_sort, query_shard,
     shard_field_stats, shard_term_stats,
 )
+from elasticsearch_tpu.search.telemetry import TELEMETRY, SearchTrace
 from elasticsearch_tpu.transport.transport import TransportService
 from elasticsearch_tpu.utils.errors import (
     IllegalArgumentError, IndexNotFoundError, SearchEngineError,
@@ -98,6 +100,22 @@ SEARCH_CCS = "indices:data/read/search[ccs]"
 CONTEXT_KEEP_ALIVE = 60.0
 
 DoneFn = Callable[[Optional[Dict[str, Any]], Optional[Exception]], None]
+
+
+def _task_phase(phase_state: Dict[str, Any], phase: str,
+                plane: Optional[str] = None) -> None:
+    """Live phase visibility: in-flight searches show their current
+    phase + chosen data plane in ``GET /_tasks`` (the reference's task
+    status payloads). A dict assignment per transition — no allocation
+    beyond the payload, no locking (status is a read-mostly snapshot).
+    ``plane`` overrides for in-flight routing verdicts the response
+    must not yet carry (a mesh-queued fan-out can still fall back)."""
+    task = phase_state.get("task")
+    if task is not None:
+        task.status = {
+            "phase": phase,
+            "data_plane": plane or phase_state.get("data_plane")
+            or "fanout"}
 
 
 import logging
@@ -208,9 +226,13 @@ class SearchTransportService:
                 _json.dumps(req.get("df_overrides"), sort_keys=True),
                 req.get("doc_count_override"))
 
-    def _slow_log(self, req: Dict[str, Any], took_s: float) -> None:
+    def _slow_log(self, req: Dict[str, Any], took_s: float,
+                  trace: Optional[SearchTrace] = None) -> None:
         """Per-index search slow log (index/SearchSlowLog.java:43 analog):
-        thresholds come from dynamic index settings."""
+        thresholds come from dynamic index settings. When the shard's
+        telemetry trace is available the line carries the full phase
+        breakdown and chosen data plane, so a slow query explains itself
+        without a re-run under profile."""
         try:
             settings = self.indices.index_service(
                 req["index"]).metadata.settings
@@ -224,12 +246,14 @@ class SearchTransportService:
                 continue
             if took_s >= parse_time_to_seconds(raw):
                 getattr(_slowlog, "warning" if level == "warn" else "info")(
-                    "[%s][%s] took[%.1fms], source[%s]",
+                    "[%s][%s] took[%.1fms], %s source[%s]",
                     req["index"], req["shard"], took_s * 1e3,
+                    (trace.summary() + "," if trace is not None else ""),
                     str(req.get("body", {}))[:512])
                 return
 
     def _on_query(self, req: Dict[str, Any], sender: str):
+        arrival_ns = time.monotonic_ns()
         self._reap()
         # refresh the plane registry's dynamic config from committed
         # cluster settings (search.plane.*) — cheap reads, and the solo
@@ -241,13 +265,16 @@ class SearchTransportService:
         # batched device dispatch and answer through a Deferred; anything
         # the batcher cannot serve byte-identically falls through to the
         # solo path below
-        deferred = self.batcher.try_enqueue(req)
+        deferred = self.batcher.try_enqueue(req, arrival_ns=arrival_ns)
         if deferred is not None:
             return deferred
-        return self._execute_query_solo(req)
+        return self._execute_query_solo(req, arrival_ns=arrival_ns)
 
-    def _execute_query_solo(self, req: Dict[str, Any]) -> Dict[str, Any]:
+    def _execute_query_solo(self, req: Dict[str, Any],
+                            arrival_ns: Optional[int] = None
+                            ) -> Dict[str, Any]:
         t_query = time.monotonic()
+        entry_ns = time.monotonic_ns()
         shard = self.indices.shard(req["index"], req["shard"])
         body = req.get("body", {})
         reader = shard.engine.acquire_reader()
@@ -257,10 +284,32 @@ class SearchTransportService:
             if cached is not None:
                 self._request_cache.move_to_end(cache_key)
                 shard.search_stats["request_cache_hits"] += 1
+                # cache hits are served traffic too: without this the
+                # cheapest executions vanish from the rings and the
+                # histogram p50/p95 skew upward. Classed pre-parse (the
+                # body-shape classifier), no device_dispatch span — the
+                # hit's own span name keeps it out of dispatch percentiles
+                trace = SearchTrace(telemetry.classify_body(body), "solo")
+                trace.t0_ns = arrival_ns or entry_ns
+                trace.add_span("queue_wait",
+                               entry_ns - (arrival_ns or entry_ns))
+                trace.add_span("request_cache_hit",
+                               time.monotonic_ns() - entry_ns)
+                trace.finish()
+                TELEMETRY.observe(trace)
                 return cached
             shard.search_stats["request_cache_misses"] += 1
         query = dsl.parse_query(body.get("query"))
         sort = parse_sort(body.get("sort"))
+        # per-request telemetry (always on, monotonic stamps + counters
+        # only): queue wait covers handler arrival -> execution (the solo
+        # analog of the batcher's collection-window wait), rewrite the
+        # parse/classify work above
+        trace = SearchTrace(telemetry.classify_query_class(query), "solo")
+        trace.t0_ns = arrival_ns or entry_ns
+        trace.add_span("queue_wait",
+                       entry_ns - (arrival_ns or entry_ns))
+        trace.add_span("rewrite", time.monotonic_ns() - entry_ns)
 
         aggregator = None
         agg_body = body.get("aggs", body.get("aggregations"))
@@ -277,6 +326,8 @@ class SearchTransportService:
                 f"shard query [{req['index']}][{req['shard']}]",
                 cancellable=True,
                 parent_task_id=req.get("task_id"))
+            shard_task.status = {"phase": "query",
+                                 "data_plane": trace.data_plane}
         # the request [timeout] budget binds SHARD-SIDE too: the budget
         # REMAINING at dispatch rides the wire (a duration, not an
         # absolute timestamp — monotonic clocks don't compare across OS
@@ -307,25 +358,27 @@ class SearchTransportService:
             for check in checks:
                 check()
         try:
-            result = query_shard(
-                reader, shard.engine.mappers, query,
-                size=req["window"], from_=0, sort=sort,
-                search_after=body.get("search_after"),
-                track_total_hits=body.get("track_total_hits", 10_000),
-                min_score=body.get("min_score"),
-                doc_count_override=req.get("doc_count_override"),
-                df_overrides=req.get("df_overrides"),
-                field_stats_overrides=req.get("field_stats_overrides"),
-                collectors=[aggregator] if aggregator else None,
-                rescore=body.get("rescore"),
-                collapse=body.get("collapse"),
-                slice_spec=body.get("slice"),
-                profile=bool(body.get("profile")),
-                terminate_after=body.get("terminate_after"),
-                cancel_check=cancel_check if checks else None)
+            with telemetry.activate(trace), trace.span("device_dispatch"):
+                result = query_shard(
+                    reader, shard.engine.mappers, query,
+                    size=req["window"], from_=0, sort=sort,
+                    search_after=body.get("search_after"),
+                    track_total_hits=body.get("track_total_hits", 10_000),
+                    min_score=body.get("min_score"),
+                    doc_count_override=req.get("doc_count_override"),
+                    df_overrides=req.get("df_overrides"),
+                    field_stats_overrides=req.get("field_stats_overrides"),
+                    collectors=[aggregator] if aggregator else None,
+                    rescore=body.get("rescore"),
+                    collapse=body.get("collapse"),
+                    slice_spec=body.get("slice"),
+                    profile=bool(body.get("profile")),
+                    terminate_after=body.get("terminate_after"),
+                    cancel_check=cancel_check if checks else None)
         finally:
             if shard_task is not None:
                 self.task_manager.unregister(shard_task)
+        t_demux = time.monotonic_ns()
         stats = shard.search_stats
         stats["query_total"] += 1
         if result.collector == "wand_topk" and result.prune_stats:
@@ -360,7 +413,15 @@ class SearchTransportService:
             while len(self._request_cache) >= self.REQUEST_CACHE_CAP:
                 self._request_cache.popitem(last=False)
             self._request_cache[cache_key] = response
-        self._slow_log(req, time.monotonic() - t_query)
+        trace.add_span("demux", time.monotonic_ns() - t_demux)
+        trace.finish()
+        TELEMETRY.observe(trace)
+        if result.profile is not None:
+            # full span detail rides the profile block ONLY (the
+            # byte-invisibility contract: profile-off responses carry no
+            # telemetry keys on any path)
+            result.profile["telemetry"] = trace.tree()
+        self._slow_log(req, time.monotonic() - t_query, trace=trace)
         # frozen index: device/HBM residency lasts one search — evict the
         # segment caches rebuilt during this query (FrozenEngine's
         # per-search reader analog)
@@ -535,9 +596,15 @@ class RrfFusionBatcher:
                 for ri, lst in enumerate(e["lists"]):
                     if lst:
                         arr[bi, ri, : len(lst)] = lst
+            t_dev = time.monotonic_ns()
             _scores, docs = rrf_fuse_batch(jnp.asarray(arr), n_pad,
                                            k_dev, rank_constant)
             docs = np.asarray(docs)
+            # the fusion drain runs on a scheduler tick outside any one
+            # request's context: its device time lands in the shared
+            # histogram directly (one coalesced dispatch for B requests)
+            TELEMETRY.observe_span("hybrid", "fanout", "rrf_fuse_device",
+                                   time.monotonic_ns() - t_dev)
             self.stats["rrf_fuse_batches"] += 1
             self.stats["rrf_fuse_requests"] += b
             self.stats["rrf_fuse_max_occupancy"] = max(
@@ -744,6 +811,7 @@ class TransportSearchAction:
                           body: Dict[str, Any], on_done: DoneFn,
                           search_type: str = "query_then_fetch") -> None:
         t0 = time.monotonic()
+        entry_ns = time.monotonic_ns()
         state = self.state()
         body = body or {}
 
@@ -784,6 +852,16 @@ class TransportSearchAction:
                               search_type)
             return
 
+        # coordinator telemetry: request-level phase spans (rewrite /
+        # can-match / query fan-out / merge / fetch), classed by body
+        # shape (identical pre/post expansion rewrite), labeled by the
+        # routing decision at finalize. Anchored at handler entry and
+        # built BEFORE the rewrite work so the rewrite span measures
+        # validation/resolve/alias/expansion time and the expansion's
+        # device dispatch is attributed to the request
+        ctrace = SearchTrace(telemetry.classify_body(body), "fanout")
+        ctrace.t0_ns = entry_ns
+
         try:
             max_concurrent = _parse_max_concurrent(
                 body.get("max_concurrent_shard_requests"))
@@ -818,7 +896,8 @@ class TransportSearchAction:
             from elasticsearch_tpu.ml.text_expansion import (
                 rewrite_body_expansions,
             )
-            body = rewrite_body_expansions(body)
+            with telemetry.activate(ctrace):
+                body = rewrite_body_expansions(body)
         except SearchEngineError as e:
             on_done(None, e)
             return
@@ -831,12 +910,14 @@ class TransportSearchAction:
         window = size + from_
 
         scheduler = self.ts.transport.scheduler
+        ctrace.add_span("rewrite", time.monotonic_ns() - entry_ns)
         phase_state = {
             "skipped": 0, "failed": 0,
             "failures": [],
             "task": task,
             "task_id": task.task_id if task is not None else None,
             "max_concurrent_shard_requests": max_concurrent,
+            "trace": ctrace,
             # graceful degradation knobs: per-shard failures after replica
             # failover either degrade the response (failures listed in
             # _shards) or fail the whole request, and the time budget
@@ -845,12 +926,17 @@ class TransportSearchAction:
             "deadline": (scheduler.now() + budget
                          if budget is not None else None),
         }
+        _task_phase(phase_state, "can_match")
 
         if self._try_mesh_path(t0, indices, targets, body, window, from_,
                                size, phase_state, on_done):
             return
 
+        t_can_match = time.monotonic_ns()
+
         def after_can_match(live_targets: List[Dict[str, Any]]) -> None:
+            ctrace.add_span("can_match",
+                            time.monotonic_ns() - t_can_match)
             if not live_targets:
                 on_done(self._finalize(t0, [], body, phase_state,
                                        len(targets), total=0,
@@ -858,6 +944,10 @@ class TransportSearchAction:
                                        hits=[]), None)
                 return
             if search_type == "dfs_query_then_fetch":
+                if len(live_targets) >= 2:
+                    # DFS fan-outs skip the mesh (the per-shard plane
+                    # serves them via the dual normalization channel)
+                    TELEMETRY.count_fallback(telemetry.MESH_DFS_OVERRIDE)
                 self._dfs_phase(live_targets, body,
                                 lambda overrides: self._query_phase(
                                     t0, live_targets, body, window, from_,
@@ -876,12 +966,12 @@ class TransportSearchAction:
             # per-shard scatter-gather, exactly like a plane miss. Runs
             # AFTER can-match so _shards.skipped is identical to the RPC
             # fan-out's and the mesh only scores surviving shards.
-            if search_type == "query_then_fetch" and \
-                    self._try_mesh_sharded_path(t0, live_targets, body,
-                                                window, from_, size,
-                                                phase_state, len(targets),
-                                                on_done, run_query):
-                return
+            if search_type == "query_then_fetch":
+                if self._try_mesh_sharded_path(t0, live_targets, body,
+                                               window, from_, size,
+                                               phase_state, len(targets),
+                                               on_done, run_query):
+                    return
             run_query()
 
         self._can_match_phase(targets, body, phase_state, after_can_match)
@@ -896,17 +986,23 @@ class TransportSearchAction:
         miss). ``targets`` are the can-match survivors;
         ``n_total_shards`` the pre-can-match shard count for _shards
         accounting. Conditions beyond the executor's own eligibility: one
-        concrete index, no per-shard alias filters, no time budget (the
-        RPC path's shard-side deadline enforcement has no mesh analog
-        yet), and >= 2 targets (a single shard's plane already serves in
-        one program)."""
-        if self.search_transport is None or len(targets) < 2:
+        concrete index, no per-shard alias filters, and >= 2 targets (a
+        single shard's plane already serves in one program). Requests
+        with a [timeout] budget ARE mesh-eligible: the coordinator
+        deadline rides into the executor, whose check_members seam
+        re-checks it between mesh dispatches (the shard-side
+        between-segments discipline) and hands expired fan-outs back to
+        the RPC path, where the budget machinery produces the partial
+        response."""
+        if self.search_transport is None:
             return False
-        if phase_state.get("deadline") is not None:
+        if len(targets) < 2:
+            TELEMETRY.count_fallback(telemetry.MESH_TOO_FEW_SHARDS)
             return False
         index = targets[0]["index"]
         if any(t["index"] != index or t.get("alias_filter") is not None
                for t in targets):
+            TELEMETRY.count_fallback(telemetry.MESH_ALIAS_OR_MULTI_INDEX)
             return False
 
         def on_results(results) -> None:
@@ -920,9 +1016,13 @@ class TransportSearchAction:
                                   size, phase_state, n_total_shards,
                                   on_done)
 
-        return self.search_transport.mesh_executor.try_submit(
+        submitted = self.search_transport.mesh_executor.try_submit(
             index, targets, body, window, phase_state.get("task"),
-            on_results)
+            on_results, deadline=phase_state.get("deadline"))
+        if submitted:
+            phase_state["_t_query_ns"] = time.monotonic_ns()
+            _task_phase(phase_state, "query", plane="mesh")
+        return submitted
 
     # -- mesh one-program path ------------------------------------------
 
@@ -976,6 +1076,7 @@ class TransportSearchAction:
             # graceful degradation: the broken mesh program escapes to the
             # host-RPC scatter-gather, and the escape is observable
             self.mesh_plane.stats["mesh_fallbacks"] += 1
+            TELEMETRY.count_fallback(telemetry.LEGACY_MESH_ERROR)
             return False
         if result is None:
             return False
@@ -1078,6 +1179,8 @@ class TransportSearchAction:
 
     def _query_phase(self, t0, targets, body, window, from_, size,
                      phase_state, n_total_shards, on_done, dfs_overrides):
+        phase_state.setdefault("_t_query_ns", time.monotonic_ns())
+        _task_phase(phase_state, "query")
         results: List[Optional[Dict[str, Any]]] = [None] * len(targets)
         pending = {"n": len(targets)}
         resolved = [False] * len(targets)
@@ -1295,8 +1398,15 @@ class TransportSearchAction:
                        ("_source", "docvalue_fields", "stored_fields",
                         "highlight", "timeout",
                         "allow_partial_search_results") if k in body}
+        # hybrid coordinator telemetry: the legs record their own
+        # (bm25/knn-classed) traces through _execute_admitted; this trace
+        # attributes the request-level split between retriever fan-out
+        # and fusion
+        htrace = SearchTrace("hybrid", "fanout")
+        t_legs = time.monotonic_ns()
 
         def complete() -> None:
+            htrace.add_span("legs", time.monotonic_ns() - t_legs)
             if errors:
                 on_done(None, errors[0])
                 return
@@ -1322,7 +1432,12 @@ class TransportSearchAction:
                     lst.append(did)
                 doc_lists.append(lst)
 
+            t_fuse = time.monotonic_ns()
+
             def finalize(candidates: Optional[List[int]]) -> None:
+                htrace.add_span("fuse", time.monotonic_ns() - t_fuse)
+                htrace.finish()
+                TELEMETRY.observe(htrace)
                 # candidates: the device fusion's scored docs (covers the
                 # WHOLE candidate pool, so the set equals the host's),
                 # or None = fuse entirely on the host. Either way the
@@ -1549,6 +1664,12 @@ class TransportSearchAction:
 
     def _merge_and_fetch(self, t0, targets, results, body, from_, size,
                          phase_state, n_total_shards, on_done):
+        trace = phase_state.get("trace")
+        t_merge = time.monotonic_ns()
+        if trace is not None and phase_state.get("_t_query_ns"):
+            trace.add_span("query_phase",
+                           t_merge - phase_state.pop("_t_query_ns"))
+        _task_phase(phase_state, "fetch")
         sort_specified = body.get("sort") is not None
         total = 0
         relation = "eq"
@@ -1607,6 +1728,8 @@ class TransportSearchAction:
             entries = deduped
 
         winners = entries[from_:from_ + size]
+        if trace is not None:
+            trace.add_span("merge", time.monotonic_ns() - t_merge)
         if not winners:
             self._complete(self._finalize(t0, targets, body, phase_state,
                                           n_total_shards, total, relation,
@@ -1621,6 +1744,7 @@ class TransportSearchAction:
 
         hits_out: List[Optional[Dict[str, Any]]] = [None] * len(winners)
         pending = {"n": len(by_target)}
+        t_fetch = time.monotonic_ns()
 
         def one(tidx: int, docs: List[Tuple[int, Dict[str, Any]]]) -> None:
             target = targets[tidx]
@@ -1644,6 +1768,9 @@ class TransportSearchAction:
                         "status": getattr(err, "status", 500)})
                 pending["n"] -= 1
                 if pending["n"] == 0:
+                    if trace is not None:
+                        trace.add_span("fetch",
+                                       time.monotonic_ns() - t_fetch)
                     hits = [h for h in hits_out if h is not None]
                     self._complete(
                         self._finalize(t0, targets, body, phase_state,
@@ -1730,6 +1857,14 @@ class TransportSearchAction:
             resp["_shards"]["failures"] = phase_state["failures"]
         if phase_state.get("data_plane"):
             resp["_data_plane"] = phase_state["data_plane"]
+        trace = phase_state.get("trace")
+        if trace is not None:
+            # the routing verdict labels the coordinator histogram entry:
+            # "mesh"/"mesh_plane" when a mesh program served, "fanout"
+            # for the RPC scatter-gather
+            trace.data_plane = phase_state.get("data_plane") or "fanout"
+            trace.finish()
+            TELEMETRY.observe(trace)
         if body.get("profile"):
             shards_profile = []
             for target, r in zip(targets, results or []):
@@ -1740,6 +1875,8 @@ class TransportSearchAction:
                           f"[{target['shard']}]",
                     "searches": [r["profile"]]})
             resp["profile"] = {"shards": shards_profile}
+            if trace is not None:
+                resp["profile"]["coordinator"] = trace.tree()
         return resp
 
     def _empty_response(self, t0, n_shards) -> Dict[str, Any]:

@@ -206,6 +206,21 @@ def search_batch_stats(batcher, rrf_fuser=None) -> Dict[str, Any]:
     return out
 
 
+def search_latency_stats() -> Dict[str, Any]:
+    """Search telemetry plane observability (search/telemetry.py
+    TELEMETRY): ring-buffer latency histograms (p50/p95/p99 + span-level
+    breakdown) per (query class x data plane), device-dispatch counts,
+    and the complete fallback-reason taxonomy — every mesh -> RPC,
+    plane -> per-segment, and batch -> solo event under a typed reason.
+    Never imports the search package before it has served (a node that
+    has run no searches reports an empty section)."""
+    import sys
+    mod = sys.modules.get("elasticsearch_tpu.search.telemetry")
+    if mod is None:
+        return {}
+    return mod.TELEMETRY.snapshot()
+
+
 def gateway_stats(gateway_allocator) -> Dict[str, Any]:
     """Gateway shard-state fetch observability (gateway.py
     GatewayAllocator): how many fetches the master issued, how often the

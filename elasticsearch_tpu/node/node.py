@@ -149,6 +149,13 @@ class Node:
             # first search finds the mesh ready instead of silently
             # serving the RPC fallback until other compute initializes it
             self.mesh_plane.warmup()
+        # search.mesh.warmup_at_boot: pay device first-init NOW (the
+        # mesh-sharded plane's mesh_ready() refuses to inside a search,
+        # so the first eligible search per process otherwise takes the
+        # RPC detour). Checked once more when the setting appears in a
+        # later committed state (dynamic settings land after boot).
+        self._mesh_warmed = False
+        self._maybe_mesh_warmup(self._applied_state())
         from elasticsearch_tpu.transport.remote import RemoteClusterService
         self.remote_clusters = RemoteClusterService(self)
         self.search_action = TransportSearchAction(
@@ -263,9 +270,17 @@ class Node:
         if self.coordinator.mode != Mode.LEADER:
             raise RuntimeError(
                 f"[{self.node_id}] is not the elected master")
-        return cluster_health(
-            self._applied_state(), req.get("index"),
-            unverified=self.gateway_allocator.health_unverified())
+        state = self._applied_state()
+        unverified = self.gateway_allocator.health_unverified()
+        if req.get("indices") is not None:
+            # bulk form (_cat/indices): every requested index's health in
+            # ONE master round trip instead of one RPC per index
+            return {"indices": {
+                name: cluster_health(state, name, unverified=unverified)
+                for name in req["indices"]
+                if state.metadata.has_index(name)}}
+        return cluster_health(state, req.get("index"),
+                              unverified=unverified)
 
     # ------------------------------------------------------------------
 
@@ -301,6 +316,9 @@ class Node:
             "search_batch": monitor.search_batch_stats(
                 self.search_transport.batcher,
                 rrf_fuser=self.search_action.rrf_fuser),
+            # per-(query class x data plane) latency histograms + the
+            # typed fallback-reason taxonomy (search/telemetry.py)
+            "search_latency": monitor.search_latency_stats(),
             # gateway shard-state fetch counters (fetches issued, cache
             # hits, copies reported none/corrupted/stale, reconciles)
             "gateway": monitor.gateway_stats(self.gateway_allocator),
@@ -311,13 +329,34 @@ class Node:
         # shard that can't initialize) must not skip master housekeeping, and
         # vice versa (ClusterApplierService catches per-applier the same way)
         for applier in (self.reconciler.apply_cluster_state,
-                        self._master_housekeeping):
+                        self._master_housekeeping,
+                        self._maybe_mesh_warmup):
             try:
                 applier(state)
             except Exception:  # noqa: BLE001
                 logger.exception("applier %s failed for state v%s on %s",
                                  getattr(applier, "__name__", applier),
                                  state.version, self.node_id)
+
+    def _maybe_mesh_warmup(self, state: ClusterState) -> None:
+        """search.mesh.warmup_at_boot applier: the first state (boot or
+        committed) that carries the setting pays backend first-init via
+        MESH_PLANES.warmup() — once per process, counted in the
+        mesh_plane_warmups stat. Off by default: warmup blocks on device
+        init, which only a node explicitly opted into mesh serving
+        should pay at boot."""
+        # getattr: a committed-state applier can fire before __init__
+        # reaches the flag assignment
+        if getattr(self, "_mesh_warmed", False):
+            return
+        from elasticsearch_tpu.utils.settings import (
+            SEARCH_MESH_WARMUP_AT_BOOT, setting_from_state,
+        )
+        if not setting_from_state(state, SEARCH_MESH_WARMUP_AT_BOOT):
+            return
+        self._mesh_warmed = True
+        from elasticsearch_tpu.ops.device_segment import MESH_PLANES
+        MESH_PLANES.warmup()
 
     def _master_housekeeping(self, state: ClusterState) -> None:
         """On the elected master: clean up routing after membership changes
@@ -1100,28 +1139,19 @@ class NodeClient:
             self.node._applied_state(), index,
             unverified=self.node.gateway_allocator.health_unverified())
 
-    def cluster_health_async(self, index: Optional[str],
-                             on_done) -> None:
-        """Authoritative cluster health: computed on the ELECTED MASTER
-        (whose gateway allocator owns the unverified-STARTED marks), like
-        the reference's master-node health action — a non-master node can
-        no longer report green during the post-reboot verify window. Falls
-        back to the local view (flagged) only when no master is known or
-        the master doesn't answer."""
-        state = self.node._applied_state()
-        master = state.master_node_id
-
-        def local_flagged() -> None:
-            local = self.cluster_health(index)
-            local["master_routed"] = False
-            on_done(local, None)
-
+    def _route_health_to_master(self, payload: Dict[str, Any],
+                                leader_answer, local_flagged,
+                                on_done) -> None:
+        """Shared master-routing ladder for the health surfaces: answer
+        on the ELECTED MASTER (whose gateway allocator owns the
+        unverified-STARTED marks), refuse to serve a deposed master's
+        stale view as authoritative, and fall back to the FLAGGED local
+        view only when no master is known or the master doesn't
+        answer."""
+        master = self.node._applied_state().master_node_id
         if master == self.node.node_id:
-            # answer directly ONLY while actually leading: a deposed
-            # master whose applied state still names itself must not
-            # serve its stale view as authoritative
             if self.node.coordinator.mode == Mode.LEADER:
-                on_done(self.cluster_health(index), None)
+                leader_answer()
             else:
                 local_flagged()
             return
@@ -1136,8 +1166,46 @@ class NodeClient:
                 on_done(resp, None)
 
         self.node.transport_service.send_request(
-            master, CLUSTER_HEALTH_ACTION, {"index": index}, cb,
-            timeout=10.0)
+            master, CLUSTER_HEALTH_ACTION, payload, cb, timeout=10.0)
+
+    def cluster_health_async(self, index: Optional[str],
+                             on_done) -> None:
+        """Authoritative cluster health: computed on the elected master,
+        like the reference's master-node health action — a non-master
+        node can no longer report green during the post-reboot verify
+        window."""
+
+        def local_flagged() -> None:
+            local = self.cluster_health(index)
+            local["master_routed"] = False
+            on_done(local, None)
+
+        self._route_health_to_master(
+            {"index": index},
+            lambda: on_done(self.cluster_health(index), None),
+            local_flagged, on_done)
+
+    def cluster_healths_async(self, indices: List[str], on_done) -> None:
+        """Bulk master-routed health: every index's status resolved in
+        ONE round trip to the elected master (the _cat/indices surface —
+        the chained per-index form paid O(n_indices) sequential RPCs).
+        ``on_done({"indices": {name: health_dict}}, None)``; the
+        flagged local-view fallback applies exactly as in
+        cluster_health_async."""
+
+        def local_flagged() -> None:
+            state = self.node._applied_state()
+            out = {"indices": {
+                name: self.cluster_health(name) for name in indices
+                if state.metadata.has_index(name)},
+                "master_routed": False}
+            on_done(out, None)
+
+        self._route_health_to_master(
+            {"indices": indices},
+            lambda: on_done(self.node._on_cluster_health(
+                {"indices": indices}, self.node.node_id), None),
+            local_flagged, on_done)
 
     def cluster_state(self) -> Dict[str, Any]:
         return self.node._applied_state().to_dict()

@@ -677,6 +677,8 @@ def dispatch_flat(block_docs, block_tfs, doc_lens, n_docs_pad: int,
             flat_avg = np.full(fb, avgdl, np.float32)
         if counter is not None:
             counter.append(1)
+        from elasticsearch_tpu.search.telemetry import record_dispatch
+        record_dispatch()
         if count_segments is not None:
             seg_ids, n_segs = count_segments
             got = _bm25_flat_kernel_seg(

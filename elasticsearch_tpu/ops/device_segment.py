@@ -500,6 +500,15 @@ _PART_CLASSES = {"postings": PlanePostings, "vectors": PlaneVectors,
                  "features": PlaneFeatures}
 
 
+def _count_reason(reason: str) -> None:
+    """Typed data-plane routing record, shared by both plane registries
+    (search/telemetry.py taxonomy — the telemetry suite pins the
+    "unknown" bucket at zero, so a drifted literal here fails CI); lazy
+    import: ops must not import the search package at load time."""
+    from elasticsearch_tpu.search.telemetry import TELEMETRY
+    TELEMETRY.count_fallback(reason)
+
+
 class PlaneRegistry:
     """Process-global plane residency manager: build-on-demand keyed by
     (kind, field, segment uid tuple), incremental append across refresh
@@ -567,15 +576,18 @@ class PlaneRegistry:
 
     def _refuse(self, key: Tuple) -> None:
         self.stats["plane_miss_fallbacks"] += 1
+        _count_reason("plane_budget_refused")
         self._refused[key] = self._budget_token()
         while len(self._refused) > self.MAX_REFUSALS:
             self._refused.popitem(last=False)
 
     def get(self, segments, kind: str, field: str) -> Optional[PlanePart]:
         if not self.enabled:
+            _count_reason("plane_disabled")
             return None
         segments = list(segments)
         if len(segments) < max(1, self.min_segments):
+            _count_reason("plane_too_few_segments")
             return None
         key = (kind, field) + tuple(s.uid for s in segments)
         part = self._parts.get(key)
@@ -586,6 +598,7 @@ class PlaneRegistry:
         if refused_under is not None:
             if refused_under == self._budget_token():
                 self.stats["plane_miss_fallbacks"] += 1
+                _count_reason("plane_budget_refused")
                 return None
             self._refused.pop(key, None)   # budget changed: try again
         return self._build(segments, kind, field, key)
@@ -604,6 +617,7 @@ class PlaneRegistry:
         try:
             host = part.build(prev)
         except PlaneUnavailable:
+            _count_reason("plane_field_absent")
             return None
         part.nbytes = sum(int(a.nbytes) for a in host)
         if self.max_bytes and part.nbytes > int(self.max_bytes):
@@ -797,6 +811,7 @@ class MeshPlaneRegistry:
             "mesh_plane_incremental_appends": 0,
             "mesh_plane_evictions": 0,
             "mesh_plane_miss_fallbacks": 0,
+            "mesh_plane_warmups": 0,
         }
 
     # -- config ---------------------------------------------------------
@@ -822,6 +837,25 @@ class MeshPlaneRegistry:
         from elasticsearch_tpu.parallel.mesh import mesh_ready
         return mesh_ready()
 
+    def warmup(self) -> bool:
+        """Pay backend first-init NOW (the ``search.mesh.warmup_at_boot``
+        setting, the legacy mesh plane's boot-time warmup): ``mesh_ready``
+        refuses to pay first-init inside a search, so without this the
+        FIRST eligible search per process always takes the RPC detour.
+        True (and counted) when this call actually initialized the
+        backend; False when it was already up or no backend exists."""
+        from elasticsearch_tpu.parallel.mesh import mesh_ready
+        import sys
+        if sys.modules.get("jax") is not None and mesh_ready():
+            return False
+        try:
+            import jax
+            jax.devices()
+        except Exception:  # noqa: BLE001 — no backend: stay on RPC
+            return False
+        self.stats["mesh_plane_warmups"] += 1
+        return True
+
     # -- lookup / build -------------------------------------------------
 
     def _budget_token(self) -> Tuple:
@@ -831,6 +865,7 @@ class MeshPlaneRegistry:
 
     def _refuse(self, key: Tuple) -> None:
         self.stats["mesh_plane_miss_fallbacks"] += 1
+        _count_reason("mesh_plane_budget_refused")
         self._refused[key] = self._budget_token()
         while len(self._refused) > self.MAX_REFUSALS:
             self._refused.popitem(last=False)
@@ -859,6 +894,7 @@ class MeshPlaneRegistry:
         if refused_under is not None:
             if refused_under == self._budget_token():
                 self.stats["mesh_plane_miss_fallbacks"] += 1
+                _count_reason("mesh_plane_budget_refused")
                 return None
             self._refused.pop(key, None)
         return self._build(shard_segments, kind, field, key)

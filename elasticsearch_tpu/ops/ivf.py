@@ -253,6 +253,8 @@ class IVFIndex:
         """Device-in/device-out single-kernel search (no host sync): the
         serving path — callers pipeline batches without paying a dispatch
         round-trip per batch."""
+        from elasticsearch_tpu.search.telemetry import record_dispatch
+        record_dispatch()
         nprobe = max(1, min(int(nprobe), self.nlist))
         k = max(1, min(int(k), nprobe * self.list_len))
         return _ivf_search(q_dev, self.centroids, self.lists, self.valid,
@@ -290,6 +292,8 @@ class IVFIndex:
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Batched ANN: (scores [Q, k], ids [Q, k]); ids -1 past matches.
         Scores use the same positive transforms as ops/knn.py."""
+        from elasticsearch_tpu.search.telemetry import record_dispatch
+        record_dispatch()
         q = np.asarray(queries, np.float32)
         if q.ndim == 1:
             q = q[None, :]

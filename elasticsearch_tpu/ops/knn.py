@@ -248,6 +248,8 @@ class KnnExecutor:
         self.dev = device_vectors
 
     def top_k(self, query, live, k: int):
+        from elasticsearch_tpu.search.telemetry import record_dispatch
+        record_dispatch()
         q = jnp.asarray(query, jnp.float32)
         return knn_topk(self.dev.matrix, self.dev.norms, self.dev.exists,
                         live, q, k, self.dev.similarity)
@@ -264,6 +266,8 @@ class KnnExecutor:
         faceted-nav case — it simply folds into ``live``, exactly as the
         solo path's ``live & fmask``), or a [Q, N_pad] stack of per-query
         masks applied inside the one masked matmul dispatch."""
+        from elasticsearch_tpu.search.telemetry import record_dispatch
+        record_dispatch()
         q_host, n_real = pad_queries_pow2(queries)
         if masks is not None and getattr(masks, "ndim", 1) == 2:
             m_host = pad_mask_rows_pow2(masks, q_host.shape[0])

@@ -136,6 +136,8 @@ class SparseExecutor:
     def top_k(self, features_with_weights, live, k: int,
               function: str = "linear", pivot: float = 1.0,
               exponent: float = 1.0):
+        from elasticsearch_tpu.search.telemetry import record_dispatch
+        record_dispatch()
         block_idx, qw = gather_feature_blocks(self.host, features_with_weights)
         return sparse_topk(self.dev.block_docs, self.dev.block_weights,
                            jnp.asarray(block_idx), jnp.asarray(qw),
@@ -150,6 +152,8 @@ class SparseExecutor:
         a shared bucket (block 0 / weight 0 pads contribute nothing); the
         query dimension pads to a pow2 bucket so the jit cache stays warm.
         With ``count_hits`` also returns exact per-query match counts."""
+        from elasticsearch_tpu.search.telemetry import record_dispatch
+        record_dispatch()
         per = [gather_feature_blocks(self.host, q, bucket_min=1)
                for q in queries]
         qb_pad = next_pow2(max((len(i) for i, _ in per), default=1),

@@ -1538,41 +1538,24 @@ def build_controller(client: NodeClient) -> RestController:
 
     def cat_indices(req: RestRequest, done: DoneFn) -> None:
         """Per-index status through the master-routed health path (the
-        unverified-STARTED gate is master-only state): one chained async
-        health per index, flagged-local fallback when no master answers
-        — the _cluster/health discipline applied to the cat surface."""
+        unverified-STARTED gate is master-only state): ONE bulk master
+        request resolves every index's status in a single round trip —
+        the chained per-index form paid O(n_indices) sequential RPCs on
+        a non-master node. The flagged-local fallback (no master / no
+        answer) rides inside cluster_healths_async."""
         state = client.node._applied_state()
         metas = list(state.metadata.indices.values())
-        rows: List[List[str]] = []
 
-        def run(i: int) -> None:
-            # trampoline, not recursion: cluster_health_async completes
-            # synchronously on the master and in the no-master fallback,
-            # so a chained next_one(i + 1) inside cb would grow the stack
-            # by ~4 frames per index and overflow on a few hundred
-            # indices. The loop advances in place on a synchronous
-            # completion; only a genuinely async one re-enters run().
-            while i < len(metas):
-                meta = metas[i]
-                st = {"sync": None}
-
-                def cb(h, _err=None, meta=meta, st=st, nxt=i + 1):
-                    rows.append([h["status"], "open", meta.name, meta.uuid,
-                                 str(meta.number_of_shards),
-                                 str(meta.number_of_replicas)])
-                    if st["sync"] is None:   # fired inside the async call
-                        st["sync"] = True
-                    else:                    # fired later: resume the pump
-                        run(nxt)
-                client.cluster_health_async(meta.name, cb)
-                if st["sync"]:
-                    i += 1
-                    continue
-                st["sync"] = False
-                return
+        def cb(resp, _err=None) -> None:
+            healths = (resp or {}).get("indices", {})
+            rows = [[healths.get(meta.name, {}).get("status", "red"),
+                     "open", meta.name, meta.uuid,
+                     str(meta.number_of_shards),
+                     str(meta.number_of_replicas)]
+                    for meta in metas]
             done(200, _cat(req, ["health", "status", "index", "uuid",
                                  "pri", "rep"], rows))
-        run(0)
+        client.cluster_healths_async([m.name for m in metas], cb)
     r("GET", "/_cat/indices", cat_indices)
 
     def cat_health(req: RestRequest, done: DoneFn) -> None:

@@ -82,8 +82,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1, P1_BUCKET
-from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search import dsl, telemetry
 from elasticsearch_tpu.search.phase import ShardDoc, parse_sort, wand_clauses
+from elasticsearch_tpu.search.telemetry import TELEMETRY, SearchTrace
 from elasticsearch_tpu.utils.errors import (
     SearchBudgetExceededError, TaskCancelledError,
 )
@@ -168,6 +169,12 @@ class _Member:
     deadline: Optional[float] = None
     error: Optional[Exception] = None
     result: Optional[Dict[str, Any]] = None
+    trace: Any = None
+    enqueued_ns: int = 0
+
+
+# histogram class per batch kind (search/telemetry.py labels)
+_CLASS_OF_KIND = {"text": "bm25", "knn": "knn", "sparse": "sparse"}
 
 
 def classify_request(req: Dict[str, Any], mappers) -> Optional[BatchSpec]:
@@ -707,7 +714,8 @@ class ShardQueryBatcher:
 
     # -- intake ---------------------------------------------------------
 
-    def try_enqueue(self, req: Dict[str, Any]) -> Optional[Any]:
+    def try_enqueue(self, req: Dict[str, Any],
+                    arrival_ns: Optional[int] = None) -> Optional[Any]:
         """Deferred when the request was queued for batched execution;
         None routes the caller to the solo path. Never raises."""
         try:
@@ -731,12 +739,19 @@ class ShardQueryBatcher:
         member = _Member(req=req, spec=spec, deferred=Deferred(),
                          enqueued_at=scheduler.now(),
                          enqueued_wall=time.monotonic())
+        # queue-wait telemetry runs arrival -> drain (the collection
+        # window IS the wait the trace must attribute)
+        member.enqueued_ns = arrival_ns or time.monotonic_ns()
+        member.trace = SearchTrace(
+            _CLASS_OF_KIND.get(spec.kind, "other"), "batch")
+        member.trace.t0_ns = member.enqueued_ns
         if self.sts.task_manager is not None:
             member.task = self.sts.task_manager.register(
                 "indices:data/read/search[phase/query]",
                 f"shard query [{req['index']}][{req['shard']}]",
                 cancellable=True,
                 parent_task_id=req.get("task_id"))
+            member.task.status = {"phase": "queued", "data_plane": "batch"}
         remaining = req.get("budget_remaining")
         if remaining is not None:
             member.deadline = scheduler.now() + float(remaining)
@@ -852,15 +867,35 @@ class ShardQueryBatcher:
         self.stats["queries_dispatched"] += len(live)
         self.stats["max_occupancy"] = max(self.stats["max_occupancy"],
                                           len(live))
+        now_ns = time.monotonic_ns()
         for m in live:
             self.stats["wait_ms_total"] += (now - m.enqueued_at) * 1e3
+            m.trace.add_span("queue_wait", now_ns - m.enqueued_ns)
+            if m.task is not None:
+                m.task.status = {"phase": "query", "data_plane": "batch"}
 
+        # one drain = one execution: device work is shared, so every
+        # member's trace carries the SAME device_dispatch span (annotated
+        # with the drain occupancy) — that is the honest attribution of a
+        # coalesced dispatch
+        drain_trace = SearchTrace(
+            _CLASS_OF_KIND.get(live[0].spec.kind, "other"), "batch")
+        fell_back = False
         try:
-            self._execute(key, live)
+            with telemetry.activate(drain_trace):
+                self._execute(key, live)
         except _AllMembersDead:
             pass   # every member already carries its own error
-        except Exception:  # noqa: BLE001 — the batched path must never
-            # lose queries: degrade to per-member solo execution
+        except Exception as e:  # noqa: BLE001 — the batched path must
+            # never lose queries: degrade to per-member solo execution
+            fell_back = True
+            from elasticsearch_tpu.utils.errors import CircuitBreakingError
+            TELEMETRY.count_fallback(
+                telemetry.BATCH_IVF_NPROBE_DISAGREEMENT
+                if isinstance(e, _FallbackSolo) else
+                telemetry.BATCH_BREAKER_REFUSED
+                if isinstance(e, CircuitBreakingError) else
+                telemetry.BATCH_EXEC_ERROR, len(live))
             self.stats["solo_fallbacks"] += len(live)
             for m in live:
                 if m.error is None and m.result is None:
@@ -874,8 +909,22 @@ class ShardQueryBatcher:
                             0.0, m.deadline - scheduler.now())}
                     try:
                         m.result = self.sts._execute_query_solo(req)
-                    except Exception as e:  # noqa: BLE001
-                        m.error = e
+                    except Exception as e2:  # noqa: BLE001
+                        m.error = e2
+        if not fell_back:
+            exec_ns = time.monotonic_ns() - now_ns
+            meta = {"occupancy": len(live)}
+            if drain_trace.dispatches:
+                meta["dispatches"] = drain_trace.dispatches
+            for m in live:
+                if m.error is not None or m.result is None:
+                    continue    # died mid-batch / delivered elsewhere
+                t = m.trace
+                t.dispatches = drain_trace.dispatches
+                t.plane_backed = drain_trace.plane_backed
+                t.add_span("device_dispatch", exec_ns, dict(meta))
+                t.finish()
+                TELEMETRY.observe(t)
         for m in live:
             self._finish(m)
         # traffic may have queued behind a full-size drain
@@ -999,4 +1048,5 @@ class ShardQueryBatcher:
                 "profile": None,
             }
             self.sts._slow_log(m.req,
-                               time.monotonic() - m.enqueued_wall)
+                               time.monotonic() - m.enqueued_wall,
+                               trace=m.trace)

@@ -31,12 +31,24 @@ import uuid as uuid_mod
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from elasticsearch_tpu.search import telemetry
 from elasticsearch_tpu.search.batch_executor import (
-    BatchSpec, _build_ctxs, _knn_demux, classify_request,
+    BatchSpec, _CLASS_OF_KIND, _build_ctxs, _knn_demux, classify_request,
 )
+from elasticsearch_tpu.search.telemetry import TELEMETRY, SearchTrace
 from elasticsearch_tpu.utils.settings import SEARCH_MESH_ENABLED
 
 logger = logging.getLogger(__name__)
+
+
+class _MeshMiss(Exception):
+    """Internal: this drain cannot serve from the mesh; members return
+    to the per-shard RPC fan-out. ``reason`` is a telemetry taxonomy
+    constant — every miss is typed, never a bare count."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
 
 
 @dataclass
@@ -48,6 +60,11 @@ class _Member:
     task: Any
     on_results: Callable[[Optional[List[Dict[str, Any]]]], None]
     enqueued_wall: float = dc_field(default_factory=time.monotonic)
+    # coordinator [timeout] deadline in scheduler time (the mesh is
+    # local, so the absolute deadline crosses no process boundary)
+    deadline: Optional[float] = None
+    enqueued_ns: int = dc_field(default_factory=time.monotonic_ns)
+    trace: Any = None
 
 
 class MeshSearchExecutor:
@@ -78,26 +95,41 @@ class MeshSearchExecutor:
     def try_submit(self, index: str, targets: List[Dict[str, Any]],
                    body: Dict[str, Any], window: int, task,
                    on_results: Callable[[Optional[List[Dict[str, Any]]]],
-                                        None]) -> bool:
+                                        None],
+                   deadline: Optional[float] = None) -> bool:
         """True = queued for a mesh drain (``on_results`` fires with the
         per-shard query results in target order, or None = run the RPC
         fan-out). False = not mesh-eligible; caller proceeds normally.
-        Never raises."""
+        Never raises. Every False carries a typed routing-decision
+        reason in the telemetry fallback taxonomy.
+
+        ``deadline`` (scheduler time): the coordinator's [timeout]
+        budget. The drain checks it at entry and between mesh dispatches
+        (the shard-side between-segments discipline); an expired fan-out
+        hands back to the RPC path, whose budget machinery produces the
+        timed-out partial response."""
         try:
             from elasticsearch_tpu.ops.device_segment import MESH_PLANES
             from elasticsearch_tpu.utils.settings import setting_from_state
             state = self.sts.state() if self.sts.state is not None else None
             if not setting_from_state(state, SEARCH_MESH_ENABLED):
+                TELEMETRY.count_fallback(telemetry.MESH_DISABLED)
                 return False
             MESH_PLANES.configure_from_state(state)
             if not MESH_PLANES.available(len(targets)):
+                TELEMETRY.count_fallback(
+                    telemetry.MESH_TOO_FEW_SHARDS
+                    if len(targets) < max(1, MESH_PLANES.min_shards)
+                    else telemetry.MESH_BACKEND_NOT_READY)
                 return False
             if state is not None:
                 from elasticsearch_tpu.xpack.searchable_snapshots import (
                     is_frozen,
                 )
                 if is_frozen(state, index):
-                    return False    # per-search device residency: RPC path
+                    # per-search device residency: RPC path
+                    TELEMETRY.count_fallback(telemetry.MESH_FROZEN_INDEX)
+                    return False
             # co-location: every target shard must have an ACTIVE local
             # copy. Membership in t["copies"] (the routing table's active
             # copies) is required — a locally registered shard instance
@@ -108,6 +140,7 @@ class MeshSearchExecutor:
                 if t["index"] != index or \
                         self.sts.node_id not in t.get("copies", ()) or \
                         not self.sts.indices.has_shard(index, t["shard"]):
+                    TELEMETRY.count_fallback(telemetry.MESH_NOT_COLOCATED)
                     return False
             shard0 = self.sts.indices.shard(index, targets[0]["shard"])
             spec = classify_request(
@@ -115,13 +148,19 @@ class MeshSearchExecutor:
                  "body": body, "window": window},
                 shard0.engine.mappers)
         except Exception:  # noqa: BLE001 — eligibility must never fail
-            return False   # a query; the RPC path reports real errors
+            # a query; the RPC path reports real errors
+            TELEMETRY.count_fallback(telemetry.MESH_ELIGIBILITY_ERROR)
+            return False
         if spec is None:
+            TELEMETRY.count_fallback(telemetry.MESH_INELIGIBLE_QUERY)
             return False
         shard_ids = sorted(t["shard"] for t in targets)
         member = _Member(spec=spec, body=body, window=window,
                          shard_ids=shard_ids, task=task,
-                         on_results=on_results)
+                         on_results=on_results, deadline=deadline)
+        member.trace = SearchTrace(
+            _CLASS_OF_KIND.get(spec.kind, "other"), "mesh")
+        member.trace.t0_ns = member.enqueued_ns
         key = (index, tuple(shard_ids)) + spec.key()
         self._queues.setdefault(key, []).append(member)
         if key not in self._scheduled:
@@ -139,14 +178,43 @@ class MeshSearchExecutor:
         members = self._queues.pop(key, [])
         if not members:
             return
+        # deadline/cancellation binds per member at drain entry (the
+        # shard batcher's discipline): an expired or cancelled member is
+        # handed back to the RPC path individually — drain-mates still
+        # score on the mesh
+        now = self._scheduler().now()
+        live: List[_Member] = []
+        for m in members:
+            if m.task is not None and getattr(m.task, "cancelled", False):
+                TELEMETRY.count_fallback(telemetry.MESH_MEMBER_CANCELLED)
+                self.stats["mesh_fallbacks"] += 1
+                self._deliver(m, None)
+            elif m.deadline is not None and now >= m.deadline:
+                TELEMETRY.count_fallback(telemetry.MESH_DEADLINE_EXPIRED)
+                self.stats["mesh_fallbacks"] += 1
+                self._deliver(m, None)
+            else:
+                live.append(m)
+        members = live
+        if not members:
+            return
         self.stats["mesh_batches"] += 1
         self.stats["max_occupancy"] = max(self.stats["max_occupancy"],
                                           len(members))
+        t_exec = time.monotonic_ns()
+        drain_trace = SearchTrace(
+            _CLASS_OF_KIND.get(members[0].spec.kind, "other"), "mesh")
         try:
-            results = self._execute(key, members)
+            with telemetry.activate(drain_trace):
+                results = self._execute(key, members)
+        except _MeshMiss as miss:
+            TELEMETRY.count_fallback(miss.reason, len(members))
+            results = None
         except Exception:  # noqa: BLE001 — the mesh must never lose
             logger.debug("mesh drain failed; falling back per shard",
                          exc_info=True)
+            TELEMETRY.count_fallback(telemetry.MESH_DRAIN_ERROR,
+                                     len(members))
             results = None
         if results is None:
             self.stats["mesh_fallbacks"] += len(members)
@@ -154,7 +222,17 @@ class MeshSearchExecutor:
                 self._deliver(m, None)
             return
         self.stats["mesh_searches"] += len(members)
+        exec_ns = time.monotonic_ns() - t_exec
+        meta = {"occupancy": len(members)}
+        if drain_trace.dispatches:
+            meta["dispatches"] = drain_trace.dispatches
         for m, res in zip(members, results):
+            t = m.trace
+            t.add_span("queue_wait", t_exec - m.enqueued_ns)
+            t.dispatches = drain_trace.dispatches
+            t.add_span("device_dispatch", exec_ns, dict(meta))
+            t.finish()
+            TELEMETRY.observe(t)
             self._deliver(m, res)
 
     def _deliver(self, member: _Member, res) -> None:
@@ -173,9 +251,23 @@ class MeshSearchExecutor:
         index = key[0]
         shard_ids = list(key[1])
         spec0 = members[0].spec
-        for m in members:
-            if m.task is not None and getattr(m.task, "cancelled", False):
-                return None     # the RPC fan-out aborts it properly
+
+        # [timeout] budgets are mesh-eligible: entry-expired (and
+        # cancelled) members were peeled off individually in _drain; this
+        # seam re-checks BETWEEN mesh dispatches — a deadline lapsing
+        # during device execution abandons the mesh program and the whole
+        # drain goes back to the RPC path, whose budget timer produces
+        # the timed-out partial response
+        scheduler = self._scheduler()
+
+        def check_members() -> None:
+            now = scheduler.now()
+            for m in members:
+                if m.task is not None and \
+                        getattr(m.task, "cancelled", False):
+                    raise _MeshMiss(telemetry.MESH_MEMBER_CANCELLED)
+                if m.deadline is not None and now >= m.deadline:
+                    raise _MeshMiss(telemetry.MESH_DEADLINE_EXPIRED)
 
         shards = [self.sts.indices.shard(index, sid) for sid in shard_ids]
         readers = [sh.engine.acquire_reader() for sh in shards]
@@ -184,7 +276,7 @@ class MeshSearchExecutor:
         mpart = MESH_PLANES.get(shard_segments,
                                 self._KIND_OF[spec0.kind], spec0.field)
         if mpart is None:
-            return None
+            raise _MeshMiss(telemetry.MESH_PLANE_MISSING)
         mappers = shards[0].engine.mappers
 
         # per-shard contexts + (text) term stats, exactly as query_shard
@@ -215,15 +307,17 @@ class MeshSearchExecutor:
                 got = mesh_wand_topk(
                     shard_ctxs, mpart, spec0.field,
                     [m.spec.clauses for m in members], want,
-                    spec0.track_limit, counter=counter)
+                    spec0.track_limit, check_members=check_members,
+                    counter=counter)
                 if got is None:
-                    return None
+                    raise _MeshMiss(telemetry.MESH_DFS_OVERRIDE)
                 collector = "wand_topk"
                 per_shard_member = got
             elif spec0.kind == "knn":
                 raw = mesh_knn_winners(
                     shard_ctxs, mpart, spec0.field,
-                    [m.spec for m in members], spec0.k, counter=counter)
+                    [m.spec for m in members], spec0.k,
+                    check_members=check_members, counter=counter)
                 collector = "dense"
                 per_shard_member = [
                     _knn_demux([m.spec for m in members], row, spec0.k)
@@ -233,7 +327,9 @@ class MeshSearchExecutor:
                                for t, w in m.spec.tokens.items()]
                               for m in members]
                 raw = mesh_sparse_topk(shard_ctxs, mpart, spec0.field,
-                                       expansions, want, counter=counter)
+                                       expansions, want,
+                                       check_members=check_members,
+                                       counter=counter)
                 collector = "dense"
                 per_shard_member = []
                 for row in raw:
@@ -247,7 +343,7 @@ class MeshSearchExecutor:
                                             max_score, None))
                     per_shard_member.append(member_rows)
         except MeshFallback:
-            return None
+            raise _MeshMiss(telemetry.MESH_IVF_ROUTED)
         self.stats["device_dispatches"] += len(counter)
 
         # synthesize per-member, per-shard query-phase responses — the

@@ -39,6 +39,7 @@ from elasticsearch_tpu.ops.bm25 import (
     DEFAULT_B, DEFAULT_K1, P1_BUCKET, QueryPlan, dispatch_flat,
 )
 from elasticsearch_tpu.ops.device_segment import PLANES, PlaneVectors
+from elasticsearch_tpu.search import telemetry
 from elasticsearch_tpu.search.phase import ShardDoc
 
 
@@ -89,6 +90,7 @@ def plane_wand_topk(ctxs, part, field: str,
     override simply replaces the baked per-segment values with the
     corpus-wide one, for plan upper bounds AND the length norm alike."""
     from elasticsearch_tpu.search.execute import _bm25_planner
+    telemetry.mark_plane_served()
     counts_on = track_limit > 0
     n_q = len(clause_lists)
     reader = _reader_of(ctxs)
@@ -317,7 +319,10 @@ def plane_ann_route(ctx0, part: PlaneVectors, field: str, k: int,
     try:
         index, rows = part.ivf_index(opts.get("nlist"))
     except CircuitBreakingError:
-        return None         # over budget: the exact plane path serves
+        # over budget: the exact plane path serves
+        telemetry.TELEMETRY.count_fallback(
+            telemetry.PLANE_IVF_BREAKER_REFUSED)
+        return None
     if index is None:
         return (None, rows, 0, 0)
     oversample = min(max(2 * k, k + 16), len(rows))
@@ -403,6 +408,7 @@ def _quantized_topk(part: PlaneVectors, vectors: np.ndarray, live,
     queries = jnp.asarray(q_host)
     if counter is not None:
         counter.append(1)
+    telemetry.record_dispatch(2)      # coarse pass + exact re-rank
     if masks is not None and getattr(masks, "ndim", 1) == 2:
         m_dev = jnp.asarray(pad_mask_rows_pow2(masks, q_host.shape[0]))
         cand = knn_coarse_candidates_masked(
@@ -436,6 +442,7 @@ def plane_knn_winners(ctxs, part: PlaneVectors, field: str, specs,
 
     Raises PlaneFallback when IVF-routed members disagree on the implied
     probe width (mirrors the per-segment batch rule)."""
+    telemetry.mark_plane_served()
     reader = _reader_of(ctxs)
     n_q = len(specs)
     vectors = np.asarray([s.query_vector for s in specs], np.float32)
@@ -453,6 +460,8 @@ def plane_knn_winners(ctxs, part: PlaneVectors, field: str, specs,
             widths = {plane_ann_route(ctxs[0], part, field, k, nc)[3]
                       for nc in distinct_nc}
             if len(widths) > 1:
+                telemetry.TELEMETRY.count_fallback(
+                    telemetry.PLANE_IVF_NPROBE_DISAGREEMENT)
                 raise PlaneFallback(
                     "IVF-routed members' num_candidates imply different "
                     "nprobe")
@@ -521,6 +530,7 @@ def plane_sparse_topk(ctxs, part, field: str,
     ONE device dispatch, exact per-member match counts off the score
     plane. Returns per member (candidates, total, max_score)."""
     from elasticsearch_tpu.ops.sparse import sparse_topk_batch
+    telemetry.mark_plane_served()
     reader = _reader_of(ctxs)
     live = part.live_mask(reader.live_masks)
     per = []
@@ -551,6 +561,7 @@ def plane_sparse_topk(ctxs, part, field: str,
         check_members()
     if counter is not None:
         counter.append(1)
+    telemetry.record_dispatch()
     k_plane = min(max(want, 1), part.n_docs_pad)
     from elasticsearch_tpu.indices.breaker import BREAKERS
     with BREAKERS.breaker("request").limit_scope(
@@ -708,6 +719,7 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
                 transient, "mesh_wand_topk"):
             if counter is not None:
                 counter.append(1)
+            telemetry.record_dispatch()
             s, d, h = fn(mpart.block_docs, mpart.block_tfs,
                          mpart.doc_lens, jnp.asarray(idx),
                          jnp.asarray(w), jnp.asarray(qid),
@@ -933,6 +945,7 @@ def mesh_knn_winners(shard_ctxs, mpart, field: str, specs, k: int,
     with BREAKERS.breaker("request").limit_scope(transient, "mesh_knn"):
         if counter is not None:
             counter.append(1)
+        telemetry.record_dispatch()
         if masks_host is not None:
             s, d = fn(mpart.matrix, mpart.norms, allowed,
                       jnp.asarray(q_host), jnp.asarray(masks_host))
@@ -1020,6 +1033,7 @@ def mesh_sparse_topk(shard_ctxs, mpart, field: str,
             transient, "mesh_sparse"):
         if counter is not None:
             counter.append(1)
+        telemetry.record_dispatch()
         s, d, h = fn(mpart.block_docs, mpart.block_weights,
                      jnp.asarray(idx), jnp.asarray(w),
                      jnp.asarray(live_host))

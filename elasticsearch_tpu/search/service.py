@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.index.engine import InternalEngine, Reader
 from elasticsearch_tpu.mapping import MapperService
-from elasticsearch_tpu.search import dsl
+from elasticsearch_tpu.search import dsl, telemetry
 from elasticsearch_tpu.search.fetch import fetch_hits
 from elasticsearch_tpu.search.phase import (
     ShardQueryResult, SortSpec, parse_sort, query_shard,
@@ -54,6 +54,7 @@ class SearchService:
                collectors: Optional[List] = None) -> Dict[str, Any]:
         body = body or {}
         t0 = time.monotonic()
+        entry_ns = time.monotonic_ns()
         # request [timeout] budget: validated at ENTRY (junk must 400
         # before any query cost is paid, matching the coordinator path's
         # _parse_timeout_seconds), checked at the collection boundary —
@@ -97,21 +98,33 @@ class SearchService:
         search_after = body.get("search_after")
         track = body.get("track_total_hits", 10_000)
 
-        result = query_shard(
-            reader, self.engine.mappers, query,
-            size=size, from_=from_, sort=sort,
-            search_after=search_after,
-            track_total_hits=track,
-            min_score=body.get("min_score"),
-            doc_count_override=doc_count_override,
-            df_overrides=df_overrides,
-            collectors=collectors,
-            rescore=body.get("rescore"),
-            collapse=body.get("collapse"),
-            slice_spec=body.get("slice"),
-            profile=bool(body.get("profile")),
-        )
+        # per-request telemetry (monotonic stamps + counters only; span
+        # detail surfaces solely inside the profile block): the
+        # single-shard service is the smallest serving path, so its
+        # trace carries rewrite / device_dispatch / fetch. The rewrite
+        # span runs from ENTRY — expansion rewrite, parse, agg/sort
+        # setup above are the work it attributes
+        trace = telemetry.SearchTrace(
+            telemetry.classify_query_class(query), "solo")
+        trace.t0_ns = entry_ns
+        trace.add_span("rewrite", time.monotonic_ns() - entry_ns)
+        with telemetry.activate(trace), trace.span("device_dispatch"):
+            result = query_shard(
+                reader, self.engine.mappers, query,
+                size=size, from_=from_, sort=sort,
+                search_after=search_after,
+                track_total_hits=track,
+                min_score=body.get("min_score"),
+                doc_count_override=doc_count_override,
+                df_overrides=df_overrides,
+                collectors=collectors,
+                rescore=body.get("rescore"),
+                collapse=body.get("collapse"),
+                slice_spec=body.get("slice"),
+                profile=bool(body.get("profile")),
+            )
 
+        t_fetch = time.monotonic_ns()
         include_sort = body.get("sort") is not None or search_after is not None
         hits = fetch_hits(
             reader, self.engine.mappers, result.docs, self.index_name,
@@ -155,7 +168,13 @@ class SearchService:
             response["suggest"] = merge_suggestions([build_suggestions(
                 reader, self.engine.mappers, body["suggest"])])
 
+        trace.add_span("fetch", time.monotonic_ns() - t_fetch)
+        trace.finish()
+        telemetry.TELEMETRY.observe(trace)
         if result.profile is not None:
+            # full span tree per shard rides the profile block ONLY —
+            # with profile off the response carries no telemetry keys
+            result.profile["telemetry"] = trace.tree()
             response["profile"] = {"shards": [{
                 "id": f"[_local][{self.index_name}][0]",
                 "searches": [result.profile]}]}

@@ -1,0 +1,385 @@
+"""Search telemetry plane: per-request phase traces, data-plane decision
+records, and latency histograms.
+
+BENCH r05 showed four of five query classes far under the 5x-CPU target
+with nothing in the system able to say WHERE a query's time goes across
+the coordinator -> batcher / mesh / plane / solo routing maze. This
+module is the reference blueprint's introspection triad (Lucene's
+profile API, the task management API's live phase view, and the index
+slow logs) rebuilt around this build's data planes:
+
+- :class:`SearchTrace` — a per-request span record (queue wait, rewrite,
+  device dispatch with dispatch count, demux, fetch, merge) populated by
+  every serving path. Always-on-cheap by construction: spans are
+  ``time.monotonic_ns()`` deltas plus counter increments — never a
+  device sync, never an allocation beyond one small list per request.
+  Full span detail is surfaced only under ``"profile": true`` and in
+  slow-log lines past their thresholds.
+- :class:`SearchTelemetry` (process-global ``TELEMETRY``, the PLANES /
+  BREAKERS residency precedent) — ring-buffer latency histograms per
+  (query class x data plane) with per-span breakdowns, served as the
+  ``_nodes/stats`` ``"search_latency"`` section, plus the complete
+  **fallback-reason taxonomy**: every data-plane routing decision and
+  every fallback between planes (mesh -> RPC, plane -> per-segment,
+  batch -> solo, IVF ``MeshFallback``, breaker refusals) counts under a
+  typed reason constant below — no bare counts, no "unknown"s.
+- the ``_current`` context — the active trace rides a contextvar so the
+  ops-layer dispatch sites (``ops/bm25.py dispatch_flat``, the kNN /
+  sparse kernels, the IVF probe) can attribute device programs to the
+  request that launched them without threading a parameter through
+  every executor signature.
+
+Byte-invisibility contract: nothing in this module ever mutates a
+response. Surfaces that DO show telemetry (profile blocks, slow logs,
+``_tasks`` status, ``_nodes/stats``) are additive and gated; with
+``profile`` off, responses on every path are byte-identical to a build
+without telemetry.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# fallback / routing-decision reason taxonomy
+# ---------------------------------------------------------------------------
+# Every counter increment names one of these constants. Adding a site
+# means adding a constant here — count_fallback() maps anything else to
+# UNKNOWN, and the telemetry test suite pins UNKNOWN at zero, so an
+# untyped fallback fails CI instead of hiding in a bare count.
+
+# mesh-sharded SPMD path: routing decisions (why a fan-out kept the RPC
+# scatter-gather) and drain-time fallbacks (why a submitted fan-out was
+# handed back)
+MESH_DISABLED = "mesh_disabled"
+MESH_BACKEND_NOT_READY = "mesh_backend_not_ready"
+MESH_TOO_FEW_SHARDS = "mesh_too_few_shards"
+MESH_FROZEN_INDEX = "mesh_frozen_index"
+MESH_NOT_COLOCATED = "mesh_not_colocated"
+MESH_INELIGIBLE_QUERY = "mesh_ineligible_query"
+MESH_ELIGIBILITY_ERROR = "mesh_eligibility_error"
+MESH_PLANE_MISSING = "mesh_plane_missing"
+MESH_PLANE_BUDGET_REFUSED = "mesh_plane_budget_refused"
+MESH_IVF_ROUTED = "mesh_ivf_routed"
+MESH_DFS_OVERRIDE = "mesh_dfs_override"
+MESH_ALIAS_OR_MULTI_INDEX = "mesh_alias_or_multi_index"
+MESH_MEMBER_CANCELLED = "mesh_member_cancelled"
+MESH_DEADLINE_EXPIRED = "mesh_deadline_expired"
+MESH_DRAIN_ERROR = "mesh_drain_error"
+LEGACY_MESH_ERROR = "legacy_mesh_error"
+
+# packed single-shard plane: why a shard served per-segment instead
+PLANE_DISABLED = "plane_disabled"
+PLANE_TOO_FEW_SEGMENTS = "plane_too_few_segments"
+PLANE_BUDGET_REFUSED = "plane_budget_refused"
+PLANE_FIELD_ABSENT = "plane_field_absent"
+PLANE_IVF_NPROBE_DISAGREEMENT = "plane_ivf_nprobe_disagreement"
+PLANE_IVF_BREAKER_REFUSED = "plane_ivf_breaker_refused"
+
+# shard micro-batcher: why a drained batch re-executed member-by-member
+BATCH_IVF_NPROBE_DISAGREEMENT = "batch_ivf_nprobe_disagreement"
+BATCH_BREAKER_REFUSED = "batch_breaker_refused"
+BATCH_EXEC_ERROR = "batch_exec_error"
+
+UNKNOWN = "unknown"
+
+KNOWN_REASONS = frozenset(
+    v for k, v in list(globals().items())
+    if k.isupper() and isinstance(v, str) and k != "UNKNOWN")
+
+
+# ---------------------------------------------------------------------------
+# the per-request trace
+# ---------------------------------------------------------------------------
+
+class SearchTrace:
+    """One request's (or one shard request's) phase record.
+
+    ``query_class``: bm25 | knn | sparse | hybrid | other.
+    ``data_plane``: solo | plane | batch | mesh | coordinator-side labels
+    ("fanout", "mesh_plane", ...). ``spans`` is a flat ordered list of
+    (name, duration_ns, meta) — phases here are sequential per request,
+    so a flat list IS the tree."""
+
+    __slots__ = ("query_class", "data_plane", "spans", "dispatches",
+                 "t0_ns", "total_ns", "plane_backed")
+
+    def __init__(self, query_class: str = "other",
+                 data_plane: str = "solo"):
+        self.query_class = query_class
+        self.data_plane = data_plane
+        self.spans: List[tuple] = []
+        self.dispatches = 0
+        self.t0_ns = time.monotonic_ns()
+        self.total_ns = 0
+        self.plane_backed = False
+
+    # -- span recording --------------------------------------------------
+
+    def add_span(self, name: str, dur_ns: int,
+                 meta: Optional[Dict[str, Any]] = None) -> None:
+        # clamp at 1ns so a "did happen" phase can never read as absent
+        self.spans.append((name, max(int(dur_ns), 1), meta))
+
+    @contextmanager
+    def span(self, name: str):
+        """Time a phase; device programs launched inside (counted by
+        record_dispatch through the active-trace contextvar) annotate
+        the span with their dispatch count."""
+        d0 = self.dispatches
+        t0 = time.monotonic_ns()
+        try:
+            yield self
+        finally:
+            meta = None
+            if self.dispatches > d0:
+                meta = {"dispatches": self.dispatches - d0}
+            self.add_span(name, time.monotonic_ns() - t0, meta)
+
+    def mark_plane(self) -> None:
+        """A plane executor served this request: a solo request's data
+        plane upgrades to "plane"; batch/mesh keep their label (the
+        plane backing is recorded on the flag either way)."""
+        self.plane_backed = True
+        if self.data_plane == "solo":
+            self.data_plane = "plane"
+
+    def finish(self) -> None:
+        self.total_ns = max(time.monotonic_ns() - self.t0_ns, 1)
+
+    # -- surfaces ---------------------------------------------------------
+
+    def span_ns(self, name: str) -> int:
+        return sum(d for n, d, _m in self.spans if n == name)
+
+    def tree(self) -> Dict[str, Any]:
+        """Profile-block shape: the span list plus the routing verdict —
+        what ``"profile": true`` responses and slow-log lines show."""
+        out: Dict[str, Any] = {
+            "query_class": self.query_class,
+            "data_plane": self.data_plane,
+            "device_dispatches": self.dispatches,
+            "time_in_nanos": self.total_ns or
+            (time.monotonic_ns() - self.t0_ns),
+            "phases": [
+                {"name": n, "time_in_nanos": d, **(m or {})}
+                for n, d, m in self.spans],
+        }
+        if self.plane_backed:
+            out["plane_backed"] = True
+        return out
+
+    def summary(self) -> str:
+        """One-line phase breakdown for slow-log lines."""
+        parts = [f"{n}={d / 1e6:.2f}ms" for n, d, _m in self.spans]
+        return (f"data_plane[{self.data_plane}], "
+                f"dispatches[{self.dispatches}], "
+                f"phases[{' '.join(parts)}]")
+
+
+# the active trace: set by the serving paths around execution so the
+# ops-layer dispatch sites can attribute device programs to the request
+_current: contextvars.ContextVar[Optional[SearchTrace]] = \
+    contextvars.ContextVar("search_trace", default=None)
+
+
+def current() -> Optional[SearchTrace]:
+    return _current.get()
+
+
+@contextmanager
+def activate(trace: Optional[SearchTrace]):
+    token = _current.set(trace)
+    try:
+        yield trace
+    finally:
+        _current.reset(token)
+
+
+def record_dispatch(n: int = 1) -> None:
+    """Called at every device-program launch site (ops/bm25.py
+    dispatch_flat, the kNN/sparse kernels, the IVF probe, the mesh
+    kernels). One contextvar read when no trace is active — cheap enough
+    for the hot path, and never a device sync."""
+    t = _current.get()
+    if t is not None:
+        t.dispatches += n
+
+
+def mark_plane_served() -> None:
+    """Called by the plane executors: the active request was served off
+    the packed plane (solo traces relabel to the "plane" data plane)."""
+    t = _current.get()
+    if t is not None:
+        t.mark_plane()
+
+
+def classify_query_class(query) -> str:
+    """Histogram class of a parsed dsl query tree (duck-typed on the
+    node class name so this module imports nothing from search.dsl):
+    text scoring = bm25, dense vectors = knn, rank-features = sparse."""
+    if query is None:
+        return "other"
+    name = type(query).__name__
+    if name in ("Knn", "KnnBound"):
+        return "knn"
+    if name == "TextExpansion":
+        return "sparse"
+    return "bm25"
+
+
+def classify_body(body: Optional[Dict[str, Any]]) -> str:
+    """Coordinator-side class of a raw request body (pre-parse, so it
+    must never raise): rank.rrf = hybrid, knn clause = knn,
+    text_expansion = sparse, any other query = bm25."""
+    body = body or {}
+    try:
+        if (body.get("rank") or {}).get("rrf") is not None:
+            return "hybrid"
+        if body.get("knn") is not None:
+            return "knn"
+        query = body.get("query")
+        if query is None:
+            return "other"
+        if isinstance(query, dict):
+            if "knn" in query:
+                return "knn"
+            if "text_expansion" in query:
+                return "sparse"
+        return "bm25"
+    except Exception:  # noqa: BLE001 — classification must never fail
+        return "other"
+
+
+# ---------------------------------------------------------------------------
+# histograms + the process-global registry
+# ---------------------------------------------------------------------------
+
+RING_SIZE = 512
+
+
+class _Hist:
+    """Ring buffer of recent durations (ns) + a lifetime count. The ring
+    bounds memory for the process lifetime; percentiles reflect recent
+    traffic, the count reflects everything."""
+
+    __slots__ = ("ring", "count", "sum_ns")
+
+    def __init__(self):
+        self.ring: deque = deque(maxlen=RING_SIZE)
+        self.count = 0
+        self.sum_ns = 0
+
+    def observe(self, dur_ns: int) -> None:
+        self.ring.append(dur_ns)
+        self.count += 1
+        self.sum_ns += dur_ns
+
+    def snapshot(self) -> Dict[str, Any]:
+        data = sorted(self.ring)
+        n = len(data)
+
+        def pct(p: float) -> float:
+            if not n:
+                return 0.0
+            return round(data[min(n - 1, int(p * n))] / 1e6, 4)
+
+        return {
+            "count": self.count,
+            "p50_ms": pct(0.50),
+            "p95_ms": pct(0.95),
+            "p99_ms": pct(0.99),
+            "mean_ms": round(self.sum_ns / self.count / 1e6, 4)
+            if self.count else 0.0,
+        }
+
+
+class SearchTelemetry:
+    """Process-global search-latency + fallback-reason registry (the
+    PLANES / BREAKERS one-accelerator-per-process precedent). Surfaced
+    as ``_nodes/stats`` ``"search_latency"`` and bench.py's telemetry
+    block."""
+
+    def __init__(self):
+        # (query_class, data_plane) -> {"total": _Hist,
+        #                               "spans": {name: _Hist},
+        #                               "dispatches": int, "queries": int}
+        self._planes: Dict[tuple, Dict[str, Any]] = {}
+        self.fallbacks: Dict[str, int] = {}
+
+    # -- recording --------------------------------------------------------
+
+    def _entry(self, query_class: str, data_plane: str) -> Dict[str, Any]:
+        key = (query_class, data_plane)
+        entry = self._planes.get(key)
+        if entry is None:
+            entry = self._planes[key] = {
+                "total": _Hist(), "spans": {}, "dispatches": 0,
+                "queries": 0}
+        return entry
+
+    def observe(self, trace: SearchTrace) -> None:
+        if not trace.total_ns:
+            trace.finish()
+        entry = self._entry(trace.query_class, trace.data_plane)
+        entry["total"].observe(trace.total_ns)
+        entry["queries"] += 1
+        entry["dispatches"] += trace.dispatches
+        spans = entry["spans"]
+        for name, dur_ns, _meta in trace.spans:
+            hist = spans.get(name)
+            if hist is None:
+                hist = spans[name] = _Hist()
+            hist.observe(dur_ns)
+
+    def observe_span(self, query_class: str, data_plane: str, name: str,
+                     dur_ns: int) -> None:
+        """Direct span observation (bench.py's per-config latency loops
+        feed the same histograms the serving path does)."""
+        entry = self._entry(query_class, data_plane)
+        if name == "total":
+            entry["total"].observe(max(int(dur_ns), 1))
+            entry["queries"] += 1
+            return
+        hist = entry["spans"].get(name)
+        if hist is None:
+            hist = entry["spans"][name] = _Hist()
+        hist.observe(max(int(dur_ns), 1))
+
+    def count_fallback(self, reason: str, n: int = 1) -> None:
+        """Typed routing-decision / fallback counter. An unrecognized
+        reason counts under "unknown" — which the test suite pins at
+        zero, so untyped call sites fail loudly instead of silently."""
+        if reason not in KNOWN_REASONS:
+            reason = UNKNOWN
+        self.fallbacks[reason] = self.fallbacks.get(reason, 0) + n
+
+    # -- surfaces ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        classes: Dict[str, Any] = {}
+        for (cls, plane), entry in sorted(self._planes.items()):
+            classes[f"{cls}|{plane}"] = {
+                "queries": entry["queries"],
+                "device_dispatches": entry["dispatches"],
+                "latency": entry["total"].snapshot(),
+                "spans": {name: hist.snapshot()
+                          for name, hist in sorted(
+                              entry["spans"].items())},
+            }
+        return {
+            "classes": classes,
+            "fallback_reasons": dict(sorted(self.fallbacks.items())),
+        }
+
+    def reset(self) -> None:
+        self._planes.clear()
+        self.fallbacks.clear()
+
+
+TELEMETRY = SearchTelemetry()

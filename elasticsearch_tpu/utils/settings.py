@@ -387,6 +387,16 @@ SEARCH_MESH_DP: Setting[int] = Setting.int_setting(
     "search.mesh.dp", 1, min_value=1, max_value=64,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
+# pre-init the device backend when a node boots (the legacy mesh
+# plane's boot-time warmup): mesh_ready() refuses to pay first-init
+# inside a search, so without this the FIRST mesh-eligible search per
+# process always takes the RPC detour. Applied at boot from the node's
+# initial committed state and re-checked (once) when the setting later
+# appears in a committed state; counted as mesh_plane_warmups
+SEARCH_MESH_WARMUP_AT_BOOT: Setting[bool] = Setting.bool_setting(
+    "search.mesh.warmup_at_boot", False,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
 # gateway.recover_after_data_nodes-style fleet-completeness release: when
 # this many data nodes have joined AND answered the shard-state fetch,
 # allocation stops waiting out EXISTING_COPY_GRACE for absent copy-holders

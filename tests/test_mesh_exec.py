@@ -530,14 +530,22 @@ def test_cat_health_routes_through_master(monkeypatch):
         state = next(iter(cluster.nodes.values()))._applied_state()
         non_master = next(n for n in cluster.nodes.values()
                           if n.node_id != state.master_node_id)
-        routed = {"n": 0}
+        routed = {"n": 0, "bulk": 0}
         orig = type(non_master.client).cluster_health_async
+        orig_bulk = type(non_master.client).cluster_healths_async
 
         def spy(self, index, on_done):
             routed["n"] += 1
             return orig(self, index, on_done)
+
+        def spy_bulk(self, indices, on_done):
+            routed["n"] += 1
+            routed["bulk"] += 1
+            return orig_bulk(self, indices, on_done)
         monkeypatch.setattr(type(non_master.client),
                             "cluster_health_async", spy)
+        monkeypatch.setattr(type(non_master.client),
+                            "cluster_healths_async", spy_bulk)
         controller = build_controller(non_master.client)
 
         def do(path):
@@ -556,5 +564,8 @@ def test_cat_health_routes_through_master(monkeypatch):
         status, body = do("/_cluster/stats")
         assert status == 200 and body["status"] in ("green", "yellow")
         assert routed["n"] >= 3
+        # _cat/indices resolves every index's status in ONE bulk master
+        # request, not one chained RPC per index
+        assert routed["bulk"] == 1
     finally:
         cluster.stop()
