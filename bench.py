@@ -801,6 +801,171 @@ def cfg_sparse(np, jax, jnp, result):
             f"{type(e).__name__}: {e}"[:200]
 
 
+def cfg_aggs(np, jax, jnp, result):
+    """Aggregations concurrent config — a shape the classifier could
+    never device-batch, newly served as a ``dense`` batch member: device
+    work stays per member, but a drain shares ONE reader acquisition and
+    the per-drain memo executes each distinct plan once (duplicates fan
+    out copy-on-write). A duplicate-heavy aggs wave therefore collapses
+    to its unique plans — the win this config measures. Also emits the
+    window-controller sweep: a staggered arrival stream at several
+    ``search.batch.max_window_ms`` caps through a real in-process node,
+    reporting the coalescing the occupancy-feedback controller earns."""
+    from elasticsearch_tpu.index import InternalEngine
+    from elasticsearch_tpu.mapping import MapperService
+    from elasticsearch_tpu.search import dsl
+    from elasticsearch_tpu.search.aggregations import (
+        ShardAggregator, parse_aggs,
+    )
+    from elasticsearch_tpu.search.phase import parse_sort, query_shard
+
+    n_docs = scaled(1 << 15, factor=8)
+    rng = np.random.default_rng(SEED)
+    vocab = [f"w{i}" for i in range(200)]
+    eng = InternalEngine(
+        MapperService({"properties": {
+            "body": {"type": "text"},
+            "brand": {"type": "keyword"},
+            "price": {"type": "integer"}}}),
+        shard_label="bench_aggs")
+    for i in range(n_docs):
+        eng.index(str(i), {
+            "body": " ".join(rng.choice(vocab, size=8)),
+            "brand": f"b{i % 16}",
+            "price": int(rng.integers(1, 500))})
+        if i in (n_docs // 3, 2 * n_docs // 3):
+            eng.refresh()
+    eng.refresh()
+    mappers = eng.mappers
+
+    plans = [
+        {"query": {"match": {"body": "w1 w7"}},
+         "aggs": {"brands": {"terms": {"field": "brand"}},
+                  "p": {"avg": {"field": "price"}}}},
+        {"query": {"match": {"body": "w2 w5 w11"}},
+         "aggs": {"hist": {"histogram": {"field": "price",
+                                         "interval": 100}}}},
+    ]
+    clients = 8
+    # duplicate-heavy, the autocomplete/dashboard-refresh shape: 8
+    # clients carry 2 distinct plans
+    bodies = [plans[i % len(plans)] for i in range(clients)]
+
+    def execute_member(body, reader):
+        # exactly the drain's per-member body: parse -> query_shard with
+        # the aggregator collector over the given reader snapshot
+        query = dsl.parse_query(body["query"])
+        aggregator = ShardAggregator(parse_aggs(body["aggs"]))
+        query_shard(reader, mappers, query, size=10,
+                    sort=parse_sort(None), collectors=[aggregator])
+        return aggregator.partial()
+
+    def run_single():
+        # the pre-unification solo path: one reader acquisition + one
+        # full execution per client
+        return [execute_member(b, eng.acquire_reader()) for b in bodies]
+
+    def run_batched():
+        # ONE drain: a shared reader snapshot; identical plans execute
+        # once and their rows fan out (the per-drain memo)
+        reader = eng.acquire_reader()
+        memo = {}
+        out = []
+        for b in bodies:
+            key = json.dumps(b, sort_keys=True)
+            if key not in memo:
+                memo[key] = execute_member(b, reader)
+            out.append(memo[key])
+        return out
+
+    concurrent_mode(result, "aggs", run_single, run_batched, clients,
+                    occupancy=len(plans),
+                    extras={"memo_hit_rate": round(
+                        1 - len(plans) / clients, 3)})
+    try:
+        _window_controller_sweep(np, result)
+    except Exception as e:  # noqa: BLE001 — keep the concurrent numbers
+        result["errors"]["aggs_window_sweep"] = \
+            f"{type(e).__name__}: {e}"[:200]
+
+
+def _window_controller_sweep(np, result) -> None:
+    """Drive a real in-process node with a staggered arrival stream
+    (0.5ms virtual gaps) at several ``search.batch.max_window_ms`` caps:
+    window 0 drains every arrival alone; a grown window coalesces the
+    stream — mean drain occupancy is the controller's earned win."""
+    from elasticsearch_tpu.testing import InProcessCluster
+    c = InProcessCluster(n_nodes=1, seed=5)
+    c.start()
+    try:
+        client = c.client()
+        done = []
+        client.create_index("wb", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0},
+            "mappings": {"properties": {"body": {"type": "text"}}}},
+            lambda resp, err=None: done.append((resp, err)))
+        c.run_until(lambda: bool(done), 120.0)
+        c.ensure_green("wb")
+        rng = np.random.default_rng(SEED)
+        for i in range(256):
+            box = []
+            client.index_doc("wb", f"d{i}", {
+                "body": " ".join(f"w{int(x)}" for x in
+                                 rng.integers(0, 12, 8))},
+                lambda resp, err=None, box=box: box.append(1))
+            c.run_until(lambda: bool(box), 120.0)
+        box = []
+        client.refresh("wb", lambda resp, err=None, box=box:
+                       box.append(1))
+        c.run_until(lambda: bool(box), 120.0)
+
+        node = c.nodes["node0"]
+        batcher = node.search_transport.batcher
+        sweep = []
+        n_q, gap = 48, 0.00025
+        for window_ms in (0.0, 0.5, 2.0, 4.0):
+            box = []
+            client.cluster_update_settings(
+                {"persistent": {"search.batch.max_window_ms":
+                                window_ms}},
+                lambda resp, err=None, box=box: box.append(1))
+            c.run_until(lambda: bool(box), 120.0)
+            # each cap measures from fresh controller state (the
+            # adaptive window starts at cap/4 and feeds back from there)
+            batcher._key_state.clear()
+            before = dict(batcher.stats)
+            boxes = []
+
+            def submit(i):
+                b = []
+                client.search(
+                    "wb", {"query": {"match": {"body": f"w{i % 7} w0"}},
+                           "size": 5},
+                    lambda resp, err=None, b=b: b.append((resp, err)))
+                boxes.append(b)
+            for i in range(n_q):
+                node.scheduler.schedule(i * gap, lambda i=i: submit(i))
+            c.run_until(lambda: len(boxes) == n_q and all(boxes), 600.0)
+            d_b = batcher.stats["batches_dispatched"] - \
+                before["batches_dispatched"]
+            d_q = batcher.stats["queries_dispatched"] - \
+                before["queries_dispatched"]
+            sweep.append({
+                "max_window_ms": window_ms,
+                "mean_occupancy": round(d_q / max(d_b, 1), 2),
+                "drains": d_b,
+                "window_grows": batcher.stats["window_grows"]
+                - before["window_grows"],
+                "window_shrinks": batcher.stats["window_shrinks"]
+                - before["window_shrinks"],
+            })
+        result["configs"].setdefault("aggs", {})[
+            "window_controller_sweep"] = sweep
+    finally:
+        c.stop()
+
+
 def cfg_segmented(np, jax, jnp, result):
     """Segmented-corpus scenario: the SAME corpus packed as 1/4/16/32
     segments, per-segment dispatch loop vs the packed multi-segment plane
@@ -1294,7 +1459,7 @@ def main() -> None:
         bm25_ctx = None
         for name, fn in (("knn", cfg_knn), ("bm25", cfg_bm25),
                          ("ivf", cfg_ivf), ("hybrid", cfg_hybrid),
-                         ("sparse", cfg_sparse),
+                         ("sparse", cfg_sparse), ("aggs", cfg_aggs),
                          ("segmented", cfg_segmented),
                          ("multichip", cfg_multichip)):
             try:

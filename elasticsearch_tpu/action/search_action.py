@@ -208,23 +208,66 @@ class SearchTransportService:
 
     REQUEST_CACHE_CAP = 256
 
-    def _request_cache_key(self, req: Dict[str, Any], reader) -> Optional[Tuple]:
+    def _cache_key_from(self, req: Dict[str, Any],
+                        freshness: Tuple) -> Optional[Tuple]:
         """Cacheable iff the request cannot pin per-request state: size=0
-        (no fetch context) and no slice. The reader freshness component
-        (segment identity + live counts) makes every refresh/delete a
-        natural invalidation, like the cache's reader-close listener."""
+        (no fetch context) and no slice. The freshness component
+        (segment identity + live counts, O(segments) off the segments'
+        cached live counts — never an O(docs) mask sum) makes every
+        refresh/delete a natural invalidation, like the cache's
+        reader-close listener."""
         body = req.get("body", {})
         if req.get("window", 0) > 0 or body.get("slice") or \
                 body.get("profile"):
             return None
         import json as _json
-        freshness = tuple(
-            (seg.uid, int(np.asarray(m).sum()))
-            for seg, m in zip(reader.segments, reader.live_masks))
         return (req["index"], req["shard"], freshness,
                 _json.dumps(body, sort_keys=True, default=str),
                 _json.dumps(req.get("df_overrides"), sort_keys=True),
                 req.get("doc_count_override"))
+
+    def _request_cache_key(self, req: Dict[str, Any], reader
+                           ) -> Optional[Tuple]:
+        return self._cache_key_from(req, reader.freshness)
+
+    def request_cache_lookup(self, req: Dict[str, Any],
+                             arrival_ns: Optional[int] = None
+                             ) -> Optional[Dict[str, Any]]:
+        """Intake-time request-cache consult (the batcher calls this
+        BEFORE queuing): a cacheable duplicate over an unchanged reader
+        answers immediately instead of waiting out a collection window.
+        None = miss (or not cacheable); the drain fills the cache.
+        Uses ``engine.freshness()`` — no reader is built, so the lookup
+        copies no live masks."""
+        entry_ns = time.monotonic_ns()
+        body = req.get("body", {})
+        if req.get("window", 0) > 0 or body.get("slice") or \
+                body.get("profile"):
+            return None
+        shard = self.indices.shard(req["index"], req["shard"])
+        cache_key = self._cache_key_from(req, shard.engine.freshness())
+        if cache_key is None:
+            return None
+        cached = self._request_cache.get(cache_key)
+        if cached is None:
+            return None
+        self._request_cache.move_to_end(cache_key)
+        shard.search_stats["request_cache_hits"] += 1
+        # cache hits are served traffic too: without this the cheapest
+        # executions vanish from the rings and the histogram p50/p95
+        # skew upward. Classed pre-parse (the body-shape classifier), no
+        # device_dispatch span — the hit's own span name keeps it out of
+        # dispatch percentiles. Labeled "batch" like every other query
+        # on the unified path, so one cache-hit class never splits
+        # across histogram keys by where the hit landed
+        trace = SearchTrace(telemetry.classify_body(body), "batch")
+        trace.t0_ns = arrival_ns or entry_ns
+        trace.add_span("queue_wait", entry_ns - (arrival_ns or entry_ns))
+        trace.add_span("request_cache_hit",
+                       time.monotonic_ns() - entry_ns)
+        trace.finish()
+        TELEMETRY.observe(trace)
+        return cached
 
     def _slow_log(self, req: Dict[str, Any], took_s: float,
                   trace: Optional[SearchTrace] = None) -> None:
@@ -256,59 +299,59 @@ class SearchTransportService:
         arrival_ns = time.monotonic_ns()
         self._reap()
         # refresh the plane registry's dynamic config from committed
-        # cluster settings (search.plane.*) — cheap reads, and the solo
-        # and batched paths below both consult the registry
+        # cluster settings (search.plane.*) — cheap reads; every
+        # execution kind below consults the registry
         if self.state is not None:
             from elasticsearch_tpu.ops.device_segment import PLANES
             PLANES.configure_from_state(self.state())
-        # micro-batching intake: eligible queries queue for a shared
-        # batched device dispatch and answer through a Deferred; anything
-        # the batcher cannot serve byte-identically falls through to the
-        # solo path below
-        deferred = self.batcher.try_enqueue(req, arrival_ns=arrival_ns)
-        if deferred is not None:
-            return deferred
-        return self._execute_query_solo(req, arrival_ns=arrival_ns)
+        # THE shard execution path: every query is a batch member
+        # (occupancy-1 keys drain on the next tick, so an isolated query
+        # pays one scheduler hop; `search.batch.enabled: false` forces
+        # window 0 through the same path). There is no solo handler.
+        return self.batcher.enqueue(req, arrival_ns=arrival_ns)
 
-    def _execute_query_solo(self, req: Dict[str, Any],
-                            arrival_ns: Optional[int] = None
-                            ) -> Dict[str, Any]:
-        t_query = time.monotonic()
+    def execute_query_member(self, req: Dict[str, Any], reader, *,
+                             cancel_check=None, trace=None,
+                             started_wall: Optional[float] = None,
+                             meta_out: Optional[Dict[str, Any]] = None
+                             ) -> Dict[str, Any]:
+        """Execute ONE shard query over a provided reader snapshot — the
+        per-member body of the batcher's ``dense`` kind (and the only
+        way a shard query executes outside the shared device kernels).
+        The caller (the drain) owns the reader acquisition, the member's
+        task registration, queue-wait attribution, and error delivery;
+        this method owns parse -> query_shard -> response shape, the
+        request-cache fill, stats, telemetry spans, the slow log and
+        frozen-index eviction."""
+        t_query = started_wall if started_wall is not None \
+            else time.monotonic()
         entry_ns = time.monotonic_ns()
         shard = self.indices.shard(req["index"], req["shard"])
         body = req.get("body", {})
-        reader = shard.engine.acquire_reader()
         cache_key = self._request_cache_key(req, reader)
         if cache_key is not None:
             cached = self._request_cache.get(cache_key)
             if cached is not None:
+                # filled between this member's intake miss and its drain
                 self._request_cache.move_to_end(cache_key)
                 shard.search_stats["request_cache_hits"] += 1
-                # cache hits are served traffic too: without this the
-                # cheapest executions vanish from the rings and the
-                # histogram p50/p95 skew upward. Classed pre-parse (the
-                # body-shape classifier), no device_dispatch span — the
-                # hit's own span name keeps it out of dispatch percentiles
-                trace = SearchTrace(telemetry.classify_body(body), "solo")
-                trace.t0_ns = arrival_ns or entry_ns
-                trace.add_span("queue_wait",
-                               entry_ns - (arrival_ns or entry_ns))
-                trace.add_span("request_cache_hit",
-                               time.monotonic_ns() - entry_ns)
-                trace.finish()
-                TELEMETRY.observe(trace)
+                if meta_out is not None:
+                    # the drain's memo fan-out mirrors this branch's
+                    # accounting for the row's duplicates
+                    meta_out["cache_hit"] = True
+                if trace is not None:
+                    trace.add_span("request_cache_hit",
+                                   time.monotonic_ns() - entry_ns)
+                    trace.finish()
+                    TELEMETRY.observe(trace)
                 return cached
             shard.search_stats["request_cache_misses"] += 1
         query = dsl.parse_query(body.get("query"))
         sort = parse_sort(body.get("sort"))
-        # per-request telemetry (always on, monotonic stamps + counters
-        # only): queue wait covers handler arrival -> execution (the solo
-        # analog of the batcher's collection-window wait), rewrite the
-        # parse/classify work above
-        trace = SearchTrace(telemetry.classify_query_class(query), "solo")
-        trace.t0_ns = arrival_ns or entry_ns
-        trace.add_span("queue_wait",
-                       entry_ns - (arrival_ns or entry_ns))
+        if trace is None:
+            trace = SearchTrace(telemetry.classify_query_class(query),
+                                "solo")
+            trace.t0_ns = entry_ns
         trace.add_span("rewrite", time.monotonic_ns() - entry_ns)
 
         aggregator = None
@@ -319,65 +362,23 @@ class SearchTransportService:
             )
             aggregator = ShardAggregator(parse_aggs(agg_body))
 
-        shard_task = None
-        if self.task_manager is not None:
-            shard_task = self.task_manager.register(
-                "indices:data/read/search[phase/query]",
-                f"shard query [{req['index']}][{req['shard']}]",
-                cancellable=True,
-                parent_task_id=req.get("task_id"))
-            shard_task.status = {"phase": "query",
-                                 "data_plane": trace.data_plane}
-        # the request [timeout] budget binds SHARD-SIDE too: the budget
-        # REMAINING at dispatch rides the wire (a duration, not an
-        # absolute timestamp — monotonic clocks don't compare across OS
-        # processes) and the local deadline it implies is checked between
-        # segments exactly where cancellation is, so a slow shard stops
-        # collecting instead of only being abandoned by the coordinator's
-        # timer
-        checks = []
-        if shard_task is not None:
-            checks.append(shard_task.ensure_not_cancelled)
-        remaining = req.get("budget_remaining")
-        if remaining is not None:
-            scheduler = self.ts.transport.scheduler
-            shard_deadline = scheduler.now() + float(remaining)
-
-            def ensure_budget(deadline=shard_deadline,
-                              scheduler=scheduler):
-                if scheduler.now() >= deadline:
-                    from elasticsearch_tpu.utils.errors import (
-                        SearchBudgetExceededError,
-                    )
-                    raise SearchBudgetExceededError(
-                        f"search budget expired while querying "
-                        f"[{req['index']}][{req['shard']}]")
-            checks.append(ensure_budget)
-
-        def cancel_check() -> None:
-            for check in checks:
-                check()
-        try:
-            with telemetry.activate(trace), trace.span("device_dispatch"):
-                result = query_shard(
-                    reader, shard.engine.mappers, query,
-                    size=req["window"], from_=0, sort=sort,
-                    search_after=body.get("search_after"),
-                    track_total_hits=body.get("track_total_hits", 10_000),
-                    min_score=body.get("min_score"),
-                    doc_count_override=req.get("doc_count_override"),
-                    df_overrides=req.get("df_overrides"),
-                    field_stats_overrides=req.get("field_stats_overrides"),
-                    collectors=[aggregator] if aggregator else None,
-                    rescore=body.get("rescore"),
-                    collapse=body.get("collapse"),
-                    slice_spec=body.get("slice"),
-                    profile=bool(body.get("profile")),
-                    terminate_after=body.get("terminate_after"),
-                    cancel_check=cancel_check if checks else None)
-        finally:
-            if shard_task is not None:
-                self.task_manager.unregister(shard_task)
+        with telemetry.activate(trace), trace.span("device_dispatch"):
+            result = query_shard(
+                reader, shard.engine.mappers, query,
+                size=req["window"], from_=0, sort=sort,
+                search_after=body.get("search_after"),
+                track_total_hits=body.get("track_total_hits", 10_000),
+                min_score=body.get("min_score"),
+                doc_count_override=req.get("doc_count_override"),
+                df_overrides=req.get("df_overrides"),
+                field_stats_overrides=req.get("field_stats_overrides"),
+                collectors=[aggregator] if aggregator else None,
+                rescore=body.get("rescore"),
+                collapse=body.get("collapse"),
+                slice_spec=body.get("slice"),
+                profile=bool(body.get("profile")),
+                terminate_after=body.get("terminate_after"),
+                cancel_check=cancel_check)
         t_demux = time.monotonic_ns()
         stats = shard.search_stats
         stats["query_total"] += 1
@@ -531,11 +532,23 @@ class RrfFusionBatcher:
     "fuse on the host yourself" (batching disabled, or a device
     failure — fusion is an optimization, never a correctness gate)."""
 
+    # sub-ms collection window: retriever legs of concurrent hybrid
+    # requests finish a few scheduler ticks apart (their shard fan-outs
+    # resolve independently), so a same-tick-only drain misses most of
+    # the coalescing win. Half a millisecond is invisible next to a
+    # fan-out round trip and catches the whole completion cluster. The
+    # window only opens while fusion traffic is RECENT (the shard
+    # batcher's idle-drain discipline) — an isolated hybrid search
+    # still fuses on the next tick.
+    FUSE_WINDOW_S = 0.0005
+    FUSE_RECENCY_S = 0.004
+
     def __init__(self, ts: TransportService, enabled_fn):
         self.ts = ts
         self.enabled = enabled_fn
         self._queue: List[Dict[str, Any]] = []
         self._timer = None
+        self._last_drain: Optional[float] = None
         self.stats: Dict[str, float] = {
             "rrf_fuse_batches": 0,
             "rrf_fuse_requests": 0,
@@ -555,13 +568,20 @@ class RrfFusionBatcher:
         self._queue.append({"lists": doc_lists, "n_docs": n_docs,
                             "rank_constant": rank_constant, "done": done})
         if self._timer is None:
-            # same-tick completions coalesce; an isolated fusion pays
-            # one scheduler hop (the batcher's idle-drain discipline)
-            self._timer = self.ts.transport.scheduler.schedule(
-                0.0, self._drain)
+            # recent fusion traffic opens the sub-ms window (everything
+            # completing inside it fuses in one device program); an idle
+            # fuser drains on the next tick — which still coalesces
+            # same-tick submissions already in the dispatch queue
+            scheduler = self.ts.transport.scheduler
+            recent = self._last_drain is not None and \
+                (scheduler.now() - self._last_drain) <= \
+                self.FUSE_RECENCY_S
+            self._timer = scheduler.schedule(
+                self.FUSE_WINDOW_S if recent else 0.0, self._drain)
 
     def _drain(self) -> None:
         self._timer = None
+        self._last_drain = self.ts.transport.scheduler.now()
         batch, self._queue = self._queue, []
         if not batch:
             return

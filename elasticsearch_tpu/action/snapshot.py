@@ -61,6 +61,7 @@ class SnapshotShardActions:
             # snapshot's live mask without mutating the shared segment
             view = copy.copy(seg)
             view.live = live.copy()
+            view.invalidate_live_count()
             blobs.append(repo.put_segment(view))
             docs += int(live.sum())
         return {"blobs": blobs, "docs": docs}

@@ -91,6 +91,14 @@ class Reader:
     def __init__(self, segments: List[Segment]):
         self.segments = list(segments)
         self.live_masks = [seg.live.copy() for seg in segments]
+        # freshness key for the shard request cache: (segment identity,
+        # live count) per segment, so any refresh/merge/delete naturally
+        # invalidates cached entries. Computed EAGERLY (acquire_reader
+        # holds the engine lock, so the counts match the snapshot masks
+        # above) from the segments' cached live counts — O(segments), not
+        # O(docs) mask sums per cache lookup.
+        self.freshness: Tuple = tuple(
+            (seg.uid, seg.live_count) for seg in segments)
 
     @property
     def doc_count(self) -> int:
@@ -326,6 +334,14 @@ class InternalEngine:
     def acquire_reader(self) -> Reader:
         with self._lock:
             return Reader(self.segments)
+
+    def freshness(self) -> Tuple:
+        """The reader freshness key WITHOUT building a reader: no live
+        masks are copied, so a cache lookup at batcher intake stays
+        O(segments) on a shard of any size."""
+        with self._lock:
+            return tuple((seg.uid, seg.live_count)
+                         for seg in self.segments)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -571,6 +587,7 @@ class InternalEngine:
                     liv = self.store.read_live_mask(name)
                     if liv is not None:
                         seg.live = liv
+                        seg.invalidate_live_count()
                     self.segments.append(seg)
                     num = int(name.rsplit("_seg", 1)[1]) if "_seg" in name else 0
                     self._segment_counter = max(self._segment_counter, num)

@@ -278,13 +278,25 @@ class Segment:
         self.live: np.ndarray = np.ones(n_docs, bool)
         self._device_cache: Dict[Any, Any] = {}
         self._filter_cache: "OrderedDict[Any, Any]" = OrderedDict()
+        # cached live.sum(): consulted on every reader acquisition (the
+        # request-cache freshness key) — recomputing the mask sum per
+        # lookup was a measured hot-path cost. Invalidated wherever the
+        # mask mutates (delete_doc; recovery reassigns call
+        # invalidate_live_count explicitly).
+        self._live_count: int = n_docs
 
     @property
     def live_count(self) -> int:
-        return int(self.live.sum())
+        if self._live_count is None:
+            self._live_count = int(self.live.sum())
+        return self._live_count
+
+    def invalidate_live_count(self) -> None:
+        self._live_count = None
 
     def delete_doc(self, local_doc: int) -> None:
         self.live[local_doc] = False
+        self._live_count = None
         self._device_cache.pop("live", None)  # invalidate device mirror
 
     def doc_for_id(self, doc_id: str) -> Optional[int]:

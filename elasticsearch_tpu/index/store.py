@@ -88,6 +88,7 @@ class Store:
         seg.sources = meta["sources"]
         seg.id_to_doc = {doc_id: i for i, doc_id in enumerate(seg.ids)}
         seg.live = data["live"]
+        seg.invalidate_live_count()
         seg.seqnos = data["seqnos"]
         seg.versions = data["versions"] if "versions" in data else np.ones(seg.n_docs, np.int64)
         seg.primary_terms = (data["primary_terms"] if "primary_terms" in data

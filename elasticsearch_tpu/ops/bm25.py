@@ -90,38 +90,30 @@ P1_BUCKET = 64
 MAX_BATCH_CELLS = 4_000_000
 
 
-@partial(jax.jit,
-         static_argnames=("n_docs_pad", "n_q", "k", "k1", "b", "counted"))
-def _bm25_flat_kernel(block_docs, block_tfs,
-                      flat_idx,    # [FB] int32 block gather ids (0 pad)
-                      flat_w,      # [FB] f32 idf*boost (0 pad)
-                      flat_q,      # [FB] int32 query id (0 pad)
-                      doc_lens, flat_avgdl, live,
-                      n_docs_pad: int, n_q: int, k: int,
-                      k1: float = DEFAULT_K1, b: float = DEFAULT_B,
-                      counted: bool = False):
-    """Flat batched BM25 + top-k: the whole batch's blocks in ONE gather +
-    scatter-add, each block tagged with its query id.
+def bm25_flat_body(block_docs, block_tfs,
+                   flat_idx,    # [FB] int32 block gather ids (0 pad)
+                   flat_w,      # [FB] f32 idf*boost (0 pad)
+                   flat_q,      # [FB] int32 query id (0 pad)
+                   doc_lens, flat_avgdl, live,
+                   n_docs_pad: int, n_q: int,
+                   k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+    """The ONE traced flat-BM25 body: gather the batch's blocks, compute
+    per-entry contributions, scatter-add into a [n_q, n_docs_pad] score
+    plane, mask to live matches. Returns (scores, matched) with dead
+    slots already at -inf.
 
-    This replaces the padded [Q, QB] layout whose per-query gather lists
-    all padded to the LARGEST plan in the batch — on zipfian query mixes
-    that wasted >10x the gather/scatter work (r3 bench: 1,048,576 padded
-    cells for 79,743 real survivor blocks). Here device work is
-    proportional to the batch's ACTUAL block count, padded only up to one
-    pow-ladder bucket.
-
-    With ``counted`` the kernel also returns hits[n_q] = #docs with
-    score > 0, read off the score plane it already computed. The count is
-    EXACT for the blocks gathered: unpruned dispatches count all hits;
-    pruned dispatches yield a LOWER bound (dropped blocks aren't
-    observed) — the counts-then-skip collector
-    (TopDocsCollectorContext.java:215) uses it to prove
-    'total >= track_total_hits' without a dense pass.
+    Shared verbatim by ``_bm25_flat_kernel`` (single plane / segment),
+    ``_bm25_flat_kernel_seg`` (per-segment counted channel) and the mesh
+    kernel's per-slot body (parallel/mesh.py ``mesh_bm25_flat``) — same
+    gather order, same f32 scatter-adds — so their scores are
+    bit-compatible BY CONSTRUCTION, not by a golden suite catching drift
+    after the fact.
 
     ``flat_avgdl`` [FB] carries each gathered block's avgdl: one scalar
     broadcast for a single-segment dispatch, the owning segment's value
     per block when the gather spans a multi-segment plane — so plane
-    scores use exactly the per-segment length norm the solo path does."""
+    scores use exactly the per-segment length norm the per-segment path
+    does."""
     docs = block_docs[flat_idx]             # [FB, BLOCK]
     tfs = block_tfs[flat_idx]               # [FB, BLOCK]
     valid = docs >= 0
@@ -138,6 +130,36 @@ def _bm25_flat_kernel(block_docs, block_tfs,
     scores = scores.reshape(n_q, n_docs_pad)
     matched = live[None, :] & (scores > 0.0)
     scores = jnp.where(matched, scores, -jnp.inf)
+    return scores, matched
+
+
+@partial(jax.jit,
+         static_argnames=("n_docs_pad", "n_q", "k", "k1", "b", "counted"))
+def _bm25_flat_kernel(block_docs, block_tfs, flat_idx, flat_w, flat_q,
+                      doc_lens, flat_avgdl, live,
+                      n_docs_pad: int, n_q: int, k: int,
+                      k1: float = DEFAULT_K1, b: float = DEFAULT_B,
+                      counted: bool = False):
+    """Flat batched BM25 + top-k: the whole batch's blocks in ONE gather +
+    scatter-add (``bm25_flat_body``), each block tagged with its query id.
+
+    This replaces the padded [Q, QB] layout whose per-query gather lists
+    all padded to the LARGEST plan in the batch — on zipfian query mixes
+    that wasted >10x the gather/scatter work (r3 bench: 1,048,576 padded
+    cells for 79,743 real survivor blocks). Here device work is
+    proportional to the batch's ACTUAL block count, padded only up to one
+    pow-ladder bucket.
+
+    With ``counted`` the kernel also returns hits[n_q] = #docs with
+    score > 0, read off the score plane it already computed. The count is
+    EXACT for the blocks gathered: unpruned dispatches count all hits;
+    pruned dispatches yield a LOWER bound (dropped blocks aren't
+    observed) — the counts-then-skip collector
+    (TopDocsCollectorContext.java:215) uses it to prove
+    'total >= track_total_hits' without a dense pass."""
+    scores, matched = bm25_flat_body(block_docs, block_tfs, flat_idx,
+                                     flat_w, flat_q, doc_lens, flat_avgdl,
+                                     live, n_docs_pad, n_q, k1=k1, b=b)
     s, d = jax.lax.top_k(scores, k)
     if counted:
         return s, d, jnp.sum(matched, axis=1, dtype=jnp.int32)
@@ -167,21 +189,9 @@ def _bm25_flat_kernel_seg(block_docs, block_tfs, flat_idx, flat_w, flat_q,
     "candidates found" truncated to the collection window (sum of
     min(matches, want) per segment), a number the fused whole-plane count
     cannot reproduce — so the kernel counts where the segments are."""
-    docs = block_docs[flat_idx]
-    tfs = block_tfs[flat_idx]
-    valid = docs >= 0
-    safe = jnp.where(valid, docs, 0)
-    dl = doc_lens[safe]
-    norm = k1 * (1.0 - b + b * dl / flat_avgdl[:, None])
-    contrib = flat_w[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
-    contrib = jnp.where(valid, contrib, 0.0)
-    tgt = flat_q[:, None] * n_docs_pad + safe
-    scores = jnp.zeros((n_q * n_docs_pad,), jnp.float32)
-    scores = scores.at[tgt.reshape(-1)].add(contrib.reshape(-1),
-                                            mode="drop")
-    scores = scores.reshape(n_q, n_docs_pad)
-    matched = live[None, :] & (scores > 0.0)
-    scores = jnp.where(matched, scores, -jnp.inf)
+    scores, matched = bm25_flat_body(block_docs, block_tfs, flat_idx,
+                                     flat_w, flat_q, doc_lens, flat_avgdl,
+                                     live, n_docs_pad, n_q, k1=k1, b=b)
     s, d = jax.lax.top_k(scores, k)
     onehot = jax.nn.one_hot(seg_ids, n_segs, dtype=jnp.int32)
     hits = matched.astype(jnp.int32) @ onehot       # [n_q, n_segs]

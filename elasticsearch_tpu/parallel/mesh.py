@@ -131,32 +131,22 @@ def mesh_bm25_flat(mesh: Mesh, n_docs_pad: int, n_q: int, k: int,
        live [S,N], seg_ids [S,N])
       -> (scores [S,n_q,k], plane docs [S,n_q,k], hits [S,n_q,n_segs])
 
-    Each slot runs exactly ops/bm25.py `_bm25_flat_kernel_seg`'s body over
-    its own block store (same gather/scatter order, same f32 adds), so a
-    slot's row is bit-compatible with that shard's single-plane dispatch.
-    Per-segment hit counts serve BOTH totals contracts host-side: summed
-    for counts-then-skip, clipped per segment for totals-disabled."""
+    Each slot runs exactly ops/bm25.py ``bm25_flat_body`` — the SAME
+    traced function `_bm25_flat_kernel` / `_bm25_flat_kernel_seg` call
+    (same gather/scatter order, same f32 adds), so a slot's row is
+    bit-compatible with that shard's single-plane dispatch BY
+    CONSTRUCTION. Per-segment hit counts serve BOTH totals contracts
+    host-side: summed for counts-then-skip, clipped per segment for
+    totals-disabled."""
+    from elasticsearch_tpu.ops.bm25 import bm25_flat_body
     key = ("bm25", id(mesh), n_docs_pad, n_q, k, n_segs, k1, b)
     fn = _COMPILED.get(key)
     if fn is not None:
         return fn
 
     def one_slot(bd, bt, dl, fi, fw, fq, fa, lv, si):
-        docs = bd[fi]
-        tfs = bt[fi]
-        valid = docs >= 0
-        safe = jnp.where(valid, docs, 0)
-        dln = dl[safe]
-        norm = k1 * (1.0 - b + b * dln / fa[:, None])
-        contrib = fw[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
-        contrib = jnp.where(valid, contrib, 0.0)
-        tgt = fq[:, None] * n_docs_pad + safe
-        scores = jnp.zeros((n_q * n_docs_pad,), jnp.float32)
-        scores = scores.at[tgt.reshape(-1)].add(contrib.reshape(-1),
-                                                mode="drop")
-        scores = scores.reshape(n_q, n_docs_pad)
-        matched = lv[None, :] & (scores > 0.0)
-        scores = jnp.where(matched, scores, -jnp.inf)
+        scores, matched = bm25_flat_body(bd, bt, fi, fw, fq, dl, fa, lv,
+                                         n_docs_pad, n_q, k1=k1, b=b)
         s, d = jax.lax.top_k(scores, k)
         onehot = jax.nn.one_hot(si, n_segs, dtype=jnp.int32)
         hits = matched.astype(jnp.int32) @ onehot

@@ -1,30 +1,33 @@
-"""Adaptive cross-query micro-batching for the served shard search path.
+"""THE shard execution path: every shard query is a batch member.
 
-The batched device kernels (the flat-plan BM25 path of ops/bm25.py, the
-[Q, D] x [D, N] kNN matmul of ops/knn.py, the vmapped rank-features scorer
-of ops/sparse.py) were until now exercised only by bench.py; the serving
-path dispatched one query per device program, and per-query launch
-overhead — not kernel throughput — dominated (BENCH r05: bm25 at 0.129x
-the 5x-CPU target while exact kNN, the one config with real device batch
-width, sat at 2.94x).
+Solo is a batch of one. ``SearchTransportService._on_query`` enqueues
+EVERY arriving shard query here — there is no separate solo execution
+path, no parity-locked duplicate kernels held byte-identical by golden
+tests. The reference has exactly one ``SearchService.executeQueryPhase``
+entry regardless of concurrency; this module is that entry for the
+device-batched build:
 
-This module closes that gap the way inference-serving stacks do — dynamic
-micro-batching at the device boundary:
-
-- ``SearchTransportService._on_query`` offers every arriving shard query
-  to the :class:`ShardQueryBatcher`; *eligible* queries (pure
-  score-sorted top-k text / sparse / kNN — exactly the shapes
-  ``choose_collector_context`` routes to ``wand_topk`` today, plus their
-  kNN/sparse analogs) are queued per ``(index, shard, kind, field,
-  window, totals)`` key and the handler returns a transport ``Deferred``.
-  Ineligible queries (aggs, sorts, rescore, DFS overrides, frozen
-  indices, ...) fall through to the unchanged solo path.
+- Queries classify into four kinds. ``text`` / ``knn`` / ``sparse`` are
+  the *device-batchable* shapes (pure score-sorted top-k — exactly what
+  ``choose_collector_context`` routes to the top-k collectors): Q
+  members share ONE batched device program per segment per phase.
+  Everything else — aggregations, suggest, nested, spans, rescore,
+  collapse, profile, sorts, DFS overrides, sliced scrolls, frozen
+  indices — classifies as ``dense``: device work stays per member
+  (``query_shard`` over the drain's shared reader snapshot), but the
+  members still share the drain's reader acquisition, the per-drain
+  memo (identical plans execute once, rows fan out copy-on-write), the
+  segments' filter-context caches, and the adaptive collection window.
 - The queue drains **adaptively**: immediately when the key is idle (no
-  recent dispatch — an isolated query pays only one scheduler hop), and
-  after up to ``search.batch.max_window_ms`` under load so concurrent
-  queries coalesce. ``search.batch.max_size`` caps the query dimension
-  of one dispatch. Both are dynamic cluster settings;
-  ``search.batch.enabled: false`` restores the solo path byte-for-byte.
+  recent dispatch — an isolated query pays only one scheduler hop; an
+  occupancy-1 key drains on the same tick, so latency is unchanged vs
+  the old solo path), and after up to ``search.batch.max_window_ms``
+  under load so concurrent queries coalesce. ``search.batch.max_size``
+  caps the query dimension of one dispatch — and adapts DOWN per key
+  under HBM pressure (a breaker trip halves the key's effective cap;
+  successful full drains regrow it toward the setting). All dynamic
+  cluster settings; ``search.batch.enabled: false`` forces window 0
+  through the SAME path (no second code path to hold in parity).
 - One drain executes ONE batched device program per segment per phase
   (the query dimension padded to a pow2 bucket inside the executors so
   the jit cache stays warm), then demuxes per-query results — top-k
@@ -59,9 +62,14 @@ micro-batching at the device boundary:
   in the same batch by construction — they arrive as independent shard
   queries within the same scheduler tick.
 
-Any unexpected failure of the batched path (breaker trips, shapes the
-kernels reject) degrades to per-member solo execution — batching is an
-optimization, never a correctness gate.
+There is ONE degrade lane: a drain whose shared execution fails
+(breaker trip, plane nprobe disagreement, kernel error) re-drains each
+surviving member as a batch of one through the SAME ``_execute`` — at
+occupancy 1 a breaker transient is minimal and plane members cannot
+disagree with themselves, so the re-drain resolves every recoverable
+cause; an error that persists at occupancy 1 is the query's own error
+and fails that member individually. Batching is an optimization, never
+a correctness gate — but there is no second execution path to fall to.
 
 The mesh-sharded fan-out executor (search/mesh_executor.py) shares this
 module's eligibility and demux seams — ``classify_request`` (so a query
@@ -94,28 +102,25 @@ from elasticsearch_tpu.utils.settings import (
 )
 
 
-class _FallbackSolo(Exception):
-    """Internal: this batch cannot run batched (e.g. an IVF-sized kNN
-    segment); members re-execute through the solo path."""
-
-
 class _AllMembersDead(Exception):
     """Internal: every member expired/cancelled mid-batch; stop paying
     for device work nobody will read."""
 
 
-# body clauses whose presence routes a request to the solo path: they
-# either force the dense collector in query_shard or carry per-request
-# state the batched demux does not model
-_SOLO_CLAUSES = ("aggs", "aggregations", "suggest", "rescore", "collapse",
-                 "slice", "profile", "terminate_after")
+# body clauses whose presence routes a request to the per-member dense
+# kind: they either force the dense collector in query_shard or carry
+# per-request state the shared device demux does not model
+_DENSE_CLAUSES = ("aggs", "aggregations", "suggest", "rescore", "collapse",
+                  "slice", "profile", "terminate_after")
 
 
 @dataclass
 class BatchSpec:
-    """Eligibility result: the batch key components plus this member's
-    private payload (clauses / query vector / expansion tokens)."""
-    kind: str                      # "text" | "knn" | "sparse"
+    """Classification result: the batch key components plus this member's
+    private payload (clauses / query vector / expansion tokens — or, for
+    the ``dense`` kind, the canonical request identity the per-drain
+    memo dedups on)."""
+    kind: str                      # "text" | "knn" | "sparse" | "dense"
     field: str
     window: int
     # text: counts-then-skip limit (0 = totals disabled);
@@ -137,13 +142,20 @@ class BatchSpec:
     # already paid the parse, so the drain's term-stats pass reuses it
     # instead of re-parsing the raw body on the hot path
     query: Any = None
+    # dense kind: the canonical request identity (body + window + stat
+    # overrides, JSON-normalized) — the per-drain memo key
+    dense_key: Optional[str] = None
 
     def key(self) -> Tuple:
         if self.kind == "text":
             return ("text", self.field, self.window, self.track_limit)
         if self.kind == "knn":
             return ("knn", self.field, self.window, self.clip_limit, self.k)
-        return ("sparse", self.field, self.window, self.clip_limit)
+        if self.kind == "sparse":
+            return ("sparse", self.field, self.window, self.clip_limit)
+        # every dense member of a shard shares one queue (the shared
+        # reader acquisition IS the win; execution is per member anyway)
+        return ("dense",)
 
     def memo_key(self) -> Tuple:
         """Identity for the per-drain memo: two members whose memo keys
@@ -154,8 +166,10 @@ class BatchSpec:
         if self.kind == "knn":
             return ("knn", tuple(self.query_vector or ()), self.boost,
                     self.num_candidates, self.filter_key)
-        return ("sparse", tuple(sorted((self.tokens or {}).items())),
-                self.boost)
+        if self.kind == "sparse":
+            return ("sparse", tuple(sorted((self.tokens or {}).items())),
+                    self.boost)
+        return ("dense", self.dense_key)
 
 
 @dataclass
@@ -173,35 +187,64 @@ class _Member:
     enqueued_ns: int = 0
 
 
-# histogram class per batch kind (search/telemetry.py labels)
+# histogram class per batch kind (search/telemetry.py labels); dense
+# members classify from their body shape at enqueue
 _CLASS_OF_KIND = {"text": "bm25", "knn": "knn", "sparse": "sparse"}
 
 
-def classify_request(req: Dict[str, Any], mappers) -> Optional[BatchSpec]:
-    """BatchSpec when the shard query is batch-eligible, else None.
+def dense_spec(req: Dict[str, Any]) -> BatchSpec:
+    """The per-member execution kind: a canonical request identity for
+    the per-drain memo (identical dense members execute once per drain,
+    rows fanned out copy-on-write), no device-batch payload."""
+    import json as _json
+    body = req.get("body") or {}
+    try:
+        key = _json.dumps(
+            [body, req.get("window", 0), req.get("df_overrides"),
+             req.get("doc_count_override"),
+             req.get("field_stats_overrides")],
+            sort_keys=True, default=str)
+    except Exception:  # noqa: BLE001 — unserializable body: never memo
+        key = uuid_mod.uuid4().hex
+    return BatchSpec(kind="dense", field="", window=int(
+        req.get("window", 0) or 0), dense_key=key)
 
-    Mirrors ``choose_collector_context``'s conditions for the text path
-    and the exact-kNN / resolved-expansion shapes for the others; anything
-    the batched demux cannot reproduce byte-for-byte stays solo."""
+
+def classify_request(req: Dict[str, Any], mappers) -> BatchSpec:
+    """The kind of batch member this shard query becomes. Never None and
+    never raises: ``text`` / ``knn`` / ``sparse`` when the shared device
+    demux can reproduce the response byte-for-byte (the conditions
+    mirror ``choose_collector_context``), the per-member ``dense`` kind
+    for everything else — aggregations, suggest, nested, spans, rescore,
+    collapse, profile, non-score sorts, DFS overrides, size-0 counts.
+    A query whose body cannot even classify still executes (as dense);
+    its real error surfaces from execution, not from routing."""
+    try:
+        return _classify(req, mappers)
+    except Exception:  # noqa: BLE001 — classification must never fail a
+        return dense_spec(req)  # query; execution reports the error
+
+
+def _classify(req: Dict[str, Any], mappers) -> BatchSpec:
     window = int(req.get("window", 0))
     if window <= 0:
-        return None
-    # DFS overrides change idf/avgdl inputs per request: solo
+        return dense_spec(req)   # size-0 counts: no top-k to share
+    # DFS overrides change idf/avgdl inputs per request: per-member
     if req.get("df_overrides") or req.get("doc_count_override") \
             or req.get("field_stats_overrides"):
-        return None
+        return dense_spec(req)
     body = req.get("body") or {}
-    for clause in _SOLO_CLAUSES:
+    for clause in _DENSE_CLAUSES:
         if body.get(clause):
-            return None
+            return dense_spec(req)
     if body.get("min_score") is not None or \
             body.get("search_after") is not None:
-        return None
+        return dense_spec(req)
     if body.get("sort") is not None:
         sort = parse_sort(body.get("sort"))
         if not (len(sort) == 1 and sort[0].field == "_score"
                 and sort[0].order == "desc"):
-            return None
+            return dense_spec(req)
     track = body.get("track_total_hits", 10_000)
     from elasticsearch_tpu.search.execute import resolve_aliases
     query = resolve_aliases(dsl.parse_query(body.get("query")), mappers)
@@ -209,7 +252,7 @@ def classify_request(req: Dict[str, Any], mappers) -> Optional[BatchSpec]:
     wc = wand_clauses(query, mappers)
     if wc is not None:
         if track is True:
-            return None      # unbounded exact counting: dense path
+            return dense_spec(req)   # unbounded exact counting
         w_field, clauses = wc
         return BatchSpec(kind="text", field=w_field, window=window,
                          track_limit=int(track) if track else 0,
@@ -220,12 +263,12 @@ def classify_request(req: Dict[str, Any], mappers) -> Optional[BatchSpec]:
     if isinstance(query, dsl.Knn):
         mapper = mappers.mapper(query.field)
         if mappers.field_type(query.field) != "dense_vector":
-            return None
+            return dense_spec(req)
         opts = getattr(mapper, "index_options", None) or {}
         if opts.get("type") not in (None, "ivf"):
-            return None      # unknown index type: solo decides
-        # filtered kNN is batch-eligible: the filter becomes a per-query
-        # (or shared) mask inside the batched matmul, exactly the solo
+            return dense_spec(req)   # unknown index type
+        # filtered kNN batches: the filter becomes a per-query (or
+        # shared) mask inside the batched matmul, exactly the dense
         # path's live & fmask; IVF-routed segments batch the probe
         return BatchSpec(kind="knn", field=query.field, window=window,
                          clip_limit=clip, query_vector=query.query_vector,
@@ -238,7 +281,7 @@ def classify_request(req: Dict[str, Any], mappers) -> Optional[BatchSpec]:
         return BatchSpec(kind="sparse", field=query.field, window=window,
                          clip_limit=clip, tokens=dict(query.tokens),
                          boost=float(query.boost))
-    return None
+    return dense_spec(req)
 
 
 # ---------------------------------------------------------------------------
@@ -272,11 +315,12 @@ def batched_wand_topk_shard(ctxs, field: str,
                             want: int, track_limit: int,
                             check_members: Optional[Callable[[], None]]
                             = None) -> List[Tuple]:
-    """Q queries through the pruned flat-plan BM25 path in shared device
-    dispatches — the Q-query generalization of phase._wand_topk_shard,
-    member-for-member identical in scores, candidates, totals semantics
-    and prune accounting (each member keeps its OWN shard-global theta,
-    derived from its own phase-1 partials).
+    """THE pruned text top-k executor for the served path — Q queries
+    in shared device dispatches, solo being simply Q=1 (query_shard
+    calls this directly). Member-for-member exact in scores,
+    candidates, totals semantics and prune accounting (each member
+    keeps its OWN shard-global theta, derived from its own phase-1
+    partials).
 
     Returns per member: (candidates, hits, relation, max_score,
     (blocks_total, blocks_scored))."""
@@ -455,117 +499,16 @@ def batched_knn_shard(ctxs, field: str, specs: List[BatchSpec],
                       k: int, check_members: Optional[Callable[[], None]]
                       = None, stats: Optional[Dict[str, float]] = None
                       ) -> List[Tuple]:
-    """Q kNN queries — filtered or not: one [Q, D] x [D, N] (optionally
-    masked) matmul per exact segment, one batched nprobe-probe per
-    IVF-routed segment, then the per-member shard-global merge Lucene's
-    KnnVectorQuery rewrite performs (execute.rewrite_knn), demuxed to the
-    dense collector's candidates/totals shape.
-
-    Per segment and member, the route matches the solo rewrite exactly:
-    filtered members stay exact (masked) everywhere; unfiltered members
-    take the IVF probe where ``ann_segment_route`` says the solo path
-    would. Filter masks are computed ONCE per distinct filter per
-    segment — one shared [N_pad] mask when all members agree (the
-    autocomplete / faceted-nav case), a [Q, N_pad] stack otherwise.
-    Raises _FallbackSolo only when IVF-routed members disagree on
-    ``num_candidates`` (the probe width would differ per member)."""
-    from elasticsearch_tpu.ops.device_segment import DeviceVectors
-    from elasticsearch_tpu.ops.knn import KnnExecutor
-    from elasticsearch_tpu.search.execute import (
-        ann_segment_route, execute as execute_query,
-    )
-    n_q = len(specs)
-    if ctxs:
-        from elasticsearch_tpu.ops.device_segment import PLANES
-        part = PLANES.get([c.segment for c in ctxs], "vectors", field)
-        if part is not None:
-            # whole-shard plane: one (optionally quantized+re-ranked)
-            # matmul or one shard-IVF probe — the same executor the solo
-            # rewrite uses, so batch and solo kNN cannot diverge
-            from elasticsearch_tpu.search.plane_exec import (
-                PlaneFallback, plane_knn_winners,
-            )
-            try:
-                per_member_hits = plane_knn_winners(
-                    ctxs, part, field, specs, k, check_members, stats)
-            except PlaneFallback as e:
-                raise _FallbackSolo(str(e))
-            return _knn_demux(specs, per_member_hits, k)
-    vectors = np.asarray([s.query_vector for s in specs], np.float32)
-    per_member_hits: List[List[Tuple[int, int, float]]] = \
-        [[] for _ in range(n_q)]
-    unfiltered = [qi for qi in range(n_q) if specs[qi].filter is None]
-    for ctx in ctxs:
-        dev = DeviceVectors.for_segment(ctx.segment, field)
-        if dev is None:
-            continue
-        if check_members is not None:
-            check_members()
-        route = None
-        if unfiltered:
-            route = ann_segment_route(
-                ctx, field, k, specs[unfiltered[0]].num_candidates,
-                filtered=False)
-        if route is not None:
-            # members may disagree on num_candidates; that only matters
-            # when it changes the derived probe width (a mapping-pinned
-            # nprobe makes it moot)
-            distinct_nc = {specs[qi].num_candidates for qi in unfiltered}
-            if len(distinct_nc) > 1 and len({
-                    ann_segment_route(ctx, field, k, nc,
-                                      filtered=False)[3]
-                    for nc in distinct_nc}) > 1:
-                raise _FallbackSolo(
-                    f"segment [{ctx.segment.name}] is IVF-routed and "
-                    f"members' num_candidates imply different nprobe")
-            index, rows, oversample, nprobe = route
-            if index is not None:
-                live_host = np.asarray(ctx.live)[: ctx.segment.n_docs]
-                probed = index.probe_live(
-                    vectors[unfiltered], k, nprobe, rows, live_host,
-                    ctx.segment_idx, oversample)
-                for qi, hits in zip(unfiltered, probed):
-                    per_member_hits[qi].extend(hits)
-            exact_idx = [qi for qi in range(n_q)
-                         if specs[qi].filter is not None]
-        else:
-            exact_idx = list(range(n_q))
-        if not exact_idx:
-            continue
-        # exact path: distinct filters resolve to masks once per segment
-        masks = None
-        fkeys = {specs[qi].filter_key for qi in exact_idx}
-        if fkeys != {None}:
-            by_key: Dict[Optional[str], Any] = {}
-            for qi in exact_idx:
-                s_qi = specs[qi]
-                if s_qi.filter is not None and \
-                        s_qi.filter_key not in by_key:
-                    _, fmask = execute_query(s_qi.filter, ctx)
-                    by_key[s_qi.filter_key] = fmask
-            if len(fkeys) == 1:
-                # every member carries the SAME filter: one shared mask
-                masks = by_key[next(iter(fkeys))]
-                if stats is not None:
-                    stats["knn_shared_mask_segments"] = \
-                        stats.get("knn_shared_mask_segments", 0) + 1
-            else:
-                rows_m = np.ones((len(exact_idx), ctx.n_docs_pad), bool)
-                for row, qi in enumerate(exact_idx):
-                    fk = specs[qi].filter_key
-                    if fk is not None:
-                        rows_m[row] = np.asarray(by_key[fk])
-                masks = rows_m
-        ex = KnnExecutor(dev)
-        k_seg = min(k, ctx.n_docs_pad)
-        s, d = ex.top_k_batch(vectors[exact_idx], ctx.live, k_seg, masks)
-        s = np.asarray(s)
-        d = np.asarray(d)
-        for row, qi in enumerate(exact_idx):
-            for sc, doc in zip(s[row], d[row]):
-                if sc > -np.inf:
-                    per_member_hits[qi].append(
-                        (ctx.segment_idx, int(doc), float(sc)))
+    """Q kNN queries through THE kNN executor (execute.knn_shard_winners
+    — the same call-site the solo rewrite is, with Q>1), demuxed to the
+    dense collector's candidates/totals shape. A resident plane may
+    raise PlaneFallback (IVF-routed members whose num_candidates imply
+    different probe widths); the drain's occupancy-1 re-drain resolves
+    it — per-segment routing batches the probe per derived width and
+    never falls back."""
+    from elasticsearch_tpu.search.execute import knn_shard_winners
+    per_member_hits = knn_shard_winners(ctxs, field, specs, k,
+                                        check_members, stats)
     return _knn_demux(specs, per_member_hits, k)
 
 
@@ -592,19 +535,19 @@ def _knn_demux(specs: List[BatchSpec],
     return out
 
 
-def batched_sparse_shard(ctxs, field: str, specs: List[BatchSpec],
-                         want: int,
-                         check_members: Optional[Callable[[], None]]
-                         = None) -> List[Tuple]:
-    """Q resolved text_expansion queries through the batched
-    rank-features scorer: one vmapped dispatch per segment, counts read
-    off the score plane (the dense path's mask sum), demuxed to the
-    dense collector's candidates/totals shape."""
+def sparse_topk_shard(ctxs, field: str,
+                      expansions: List[List[Tuple[str, float]]],
+                      want: int,
+                      check_members: Optional[Callable[[], None]] = None
+                      ) -> List[Tuple]:
+    """THE resolved-expansion top-k executor for the served path — Q
+    expansions (solo being simply Q=1) through the rank-features plane
+    when resident, else one vmapped per-segment dispatch; exact counts
+    read off the score plane (the dense path's mask sum). Returns
+    (candidates, total, max_score) per member."""
     from elasticsearch_tpu.ops.device_segment import DeviceFeatures
     from elasticsearch_tpu.ops.sparse import SparseExecutor
-    n_q = len(specs)
-    expansions = [[(t, w * s.boost) for t, w in s.tokens.items()]
-                  for s in specs]
+    n_q = len(expansions)
     if ctxs:
         from elasticsearch_tpu.ops.device_segment import PLANES
         part = PLANES.get([c.segment for c in ctxs], "features", field)
@@ -612,16 +555,9 @@ def batched_sparse_shard(ctxs, field: str, specs: List[BatchSpec],
             from elasticsearch_tpu.search.plane_exec import (
                 plane_sparse_topk,
             )
-            got = plane_sparse_topk(ctxs, part, field, expansions, want,
-                                    check_members=check_members)
-            out = []
-            for (cands, total, max_score), spec in zip(got, specs):
-                relation = "eq"
-                if spec.clip_limit is not None and \
-                        total > spec.clip_limit:
-                    total, relation = spec.clip_limit, "gte"
-                out.append((cands, total, relation, max_score, None))
-            return out
+            return plane_sparse_topk(ctxs, part, field, expansions, want,
+                                     check_members=check_members)
+    from elasticsearch_tpu.indices.breaker import BREAKERS
     candidates: List[List[ShardDoc]] = [[] for _ in range(n_q)]
     totals = [0] * n_q
     for ctx in ctxs:
@@ -632,8 +568,13 @@ def batched_sparse_shard(ctxs, field: str, specs: List[BatchSpec],
             check_members()
         ex = SparseExecutor(dev, ctx.segment.features[field])
         k_seg = min(max(want, 1), ctx.n_docs_pad)
-        s, d, h = ex.top_k_batch(expansions, ctx.live, k_seg,
-                                 function="linear", count_hits=True)
+        # the ONE charge site for per-segment sparse scoring (the plane
+        # branch above charges inside plane_sparse_topk): one transient
+        # score plane per segment dispatch
+        with BREAKERS.breaker("request").limit_scope(
+                8 * ctx.n_docs_pad * n_q, "sparse_topk"):
+            s, d, h = ex.top_k_batch(expansions, ctx.live, k_seg,
+                                     function="linear", count_hits=True)
         s = np.asarray(s)
         d = np.asarray(d)
         for qi in range(n_q):
@@ -644,11 +585,27 @@ def batched_sparse_shard(ctxs, field: str, specs: List[BatchSpec],
                 candidates[qi].append(ShardDoc(ctx.segment_idx, int(doc),
                                                float(sc), (float(sc),)))
     out = []
-    for qi, spec in enumerate(specs):
+    for qi in range(n_q):
         cands = candidates[qi]
         cands.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
-        max_score = max((c.score for c in cands), default=None)
-        total, relation = totals[qi], "eq"
+        out.append((cands, totals[qi],
+                    max((c.score for c in cands), default=None)))
+    return out
+
+
+def batched_sparse_shard(ctxs, field: str, specs: List[BatchSpec],
+                         want: int,
+                         check_members: Optional[Callable[[], None]]
+                         = None) -> List[Tuple]:
+    """Q resolved text_expansion members through ``sparse_topk_shard``,
+    demuxed to the dense collector's candidates/totals shape (per-member
+    coordinator clip applied)."""
+    expansions = [[(t, w * s.boost) for t, w in s.tokens.items()]
+                  for s in specs]
+    got = sparse_topk_shard(ctxs, field, expansions, want, check_members)
+    out = []
+    for (cands, total, max_score), spec in zip(got, specs):
+        relation = "eq"
         if spec.clip_limit is not None and total > spec.clip_limit:
             total, relation = spec.clip_limit, "gte"
         out.append((cands, total, relation, max_score, None))
@@ -672,8 +629,10 @@ class ShardQueryBatcher:
         self._queues: Dict[Tuple, List[_Member]] = {}
         self._timers: Dict[Tuple, Any] = {}
         # per-key controller state: {"last": <dispatch time>, "window":
-        # <current adaptive collection window, seconds>} — the occupancy
-        # feedback loop's memory, FIFO-bounded like the old recency map
+        # <current adaptive collection window, seconds>, "max_size":
+        # <HBM-pressure-adapted cap, None = the setting>} — the
+        # occupancy/pressure feedback loops' memory, FIFO-bounded like
+        # the old recency map
         self._key_state: Dict[Tuple, Dict[str, float]] = {}
         self.stats: Dict[str, float] = {
             "batches_dispatched": 0,
@@ -682,12 +641,20 @@ class ShardQueryBatcher:
             "wait_ms_total": 0.0,
             "queries_expired": 0,
             "queries_cancelled": 0,
-            "solo_fallbacks": 0,
+            # the one degrade lane: members re-drained at occupancy 1
+            # after a shared-execution failure
+            "member_redrains": 0,
             # per-drain memo + occupancy-feedback controller
             "memo_hits": 0,
             "window_grows": 0,
             "window_shrinks": 0,
             "knn_shared_mask_segments": 0,
+            "filter_mask_reuses": 0,
+            # adaptive per-key max_size under HBM pressure
+            "max_size_shrinks": 0,
+            "max_size_grows": 0,
+            # request-cache hits answered AT INTAKE (no collection wait)
+            "request_cache_intake_hits": 0,
         }
 
     # -- settings (dynamic, from committed cluster state) ---------------
@@ -712,38 +679,65 @@ class ShardQueryBatcher:
     def _scheduler(self):
         return self.sts.ts.transport.scheduler
 
+    def _key_max_size(self, key: Tuple) -> int:
+        """Effective per-key drain cap: the setting, shrunk while the
+        key is under HBM pressure (breaker trips halve it; successful
+        full drains regrow it)."""
+        cap = self.max_size()
+        st = self._key_state.get(key)
+        if st is not None and st.get("max_size"):
+            return min(cap, int(st["max_size"]))
+        return cap
+
     # -- intake ---------------------------------------------------------
 
-    def try_enqueue(self, req: Dict[str, Any],
-                    arrival_ns: Optional[int] = None) -> Optional[Any]:
-        """Deferred when the request was queued for batched execution;
-        None routes the caller to the solo path. Never raises."""
+    def enqueue(self, req: Dict[str, Any],
+                arrival_ns: Optional[int] = None) -> Any:
+        """THE shard query entry point: every query becomes a batch
+        member (occupancy-1 keys drain on the next scheduler tick, so an
+        isolated query pays one hop — latency unchanged vs a dedicated
+        solo path). Returns the transport Deferred the drain answers —
+        or the response dict directly for a request-cache hit at intake
+        (a cacheable duplicate never waits out a collection window).
+        ``search.batch.enabled: false`` forces window 0 through this
+        same path."""
+        scheduler = self._scheduler()
         try:
-            if not self.enabled():
-                return None
             shard = self.sts.indices.shard(req["index"], req["shard"])
+            frozen = False
             if self.sts.state is not None:
                 from elasticsearch_tpu.xpack.searchable_snapshots import (
                     is_frozen,
                 )
-                if is_frozen(self.sts.state(), req["index"]):
-                    return None    # per-search device residency: solo
+                frozen = is_frozen(self.sts.state(), req["index"])
             spec = classify_request(req, shard.engine.mappers)
-        except Exception:  # noqa: BLE001 — classification must never
-            return None    # fail a query; the solo path reports errors
-        if spec is None:
-            return None
+            if frozen and spec.kind != "dense":
+                # frozen index: per-search device residency — the dense
+                # member path evicts rebuilt caches after the drain
+                spec = dense_spec(req)
+            if spec.kind == "dense":
+                # request-cache intake consult: a cacheable duplicate
+                # (size-0 count over an unchanged reader) answers NOW
+                cached = self.sts.request_cache_lookup(req, arrival_ns)
+                if cached is not None:
+                    self.stats["request_cache_intake_hits"] += 1
+                    return cached
+        except Exception:  # noqa: BLE001 — intake must never fail a
+            # query before execution can report its real error
+            spec = dense_spec(req)
 
         from elasticsearch_tpu.transport.transport import Deferred
-        scheduler = self._scheduler()
         member = _Member(req=req, spec=spec, deferred=Deferred(),
                          enqueued_at=scheduler.now(),
                          enqueued_wall=time.monotonic())
         # queue-wait telemetry runs arrival -> drain (the collection
         # window IS the wait the trace must attribute)
         member.enqueued_ns = arrival_ns or time.monotonic_ns()
-        member.trace = SearchTrace(
-            _CLASS_OF_KIND.get(spec.kind, "other"), "batch")
+        if spec.kind == "dense":
+            trace_class = telemetry.classify_body(req.get("body") or {})
+        else:
+            trace_class = _CLASS_OF_KIND.get(spec.kind, "other")
+        member.trace = SearchTrace(trace_class, "batch")
         member.trace.t0_ns = member.enqueued_ns
         if self.sts.task_manager is not None:
             member.task = self.sts.task_manager.register(
@@ -759,7 +753,7 @@ class ShardQueryBatcher:
         key = (req["index"], req["shard"]) + spec.key()
         queue = self._queues.setdefault(key, [])
         queue.append(member)
-        if len(queue) >= self.max_size():
+        if len(queue) >= self._key_max_size(key):
             timer = self._timers.pop(key, None)
             if timer is not None:
                 timer.cancel()
@@ -767,14 +761,17 @@ class ShardQueryBatcher:
         elif key not in self._timers:
             # adaptive window: a key with recent traffic waits up to its
             # occupancy-tuned window (never past max_window_ms) for
-            # batch-mates; an idle key drains on the next scheduler tick
+            # batch-mates; an idle key — or a disabled batcher (window
+            # 0, the same path) — drains on the next scheduler tick
             # (which still coalesces every same-tick arrival already in
             # the dispatch queue)
-            window_cap = self.max_window_s()
-            st = self._key_state.get(key)
-            recent = st is not None and \
-                (scheduler.now() - st["last"]) <= window_cap
-            wait = min(st["window"], window_cap) if recent else 0.0
+            wait = 0.0
+            if self.enabled():
+                window_cap = self.max_window_s()
+                st = self._key_state.get(key)
+                recent = st is not None and \
+                    (scheduler.now() - st["last"]) <= window_cap
+                wait = min(st["window"], window_cap) if recent else 0.0
             self._timers[key] = scheduler.schedule(
                 wait, lambda: self._drain(key))
         return member.deferred
@@ -827,7 +824,7 @@ class ShardQueryBatcher:
         if st is None:
             # fresh key: start the adaptive window small; full drains
             # grow it toward the cap
-            st = {"window": window_cap / 4.0}
+            st = {"window": window_cap / 4.0, "max_size": None}
         st["last"] = now
         self._key_state[key] = st
         while len(self._key_state) > self.LAST_DISPATCH_CAP:
@@ -877,7 +874,9 @@ class ShardQueryBatcher:
         # one drain = one execution: device work is shared, so every
         # member's trace carries the SAME device_dispatch span (annotated
         # with the drain occupancy) — that is the honest attribution of a
-        # coalesced dispatch
+        # coalesced dispatch. Dense members execute (and trace) per
+        # member inside _execute instead.
+        dense = live[0].spec.kind == "dense"
         drain_trace = SearchTrace(
             _CLASS_OF_KIND.get(live[0].spec.kind, "other"), "batch")
         fell_back = False
@@ -886,32 +885,61 @@ class ShardQueryBatcher:
                 self._execute(key, live)
         except _AllMembersDead:
             pass   # every member already carries its own error
-        except Exception as e:  # noqa: BLE001 — the batched path must
-            # never lose queries: degrade to per-member solo execution
+        except Exception as e:  # noqa: BLE001 — the shared execution
+            # must never lose queries: the ONE degrade lane re-drains
+            # each surviving member as a batch of one through the same
+            # _execute (minimal breaker transient, no plane member
+            # disagreement possible at occupancy 1)
             fell_back = True
+            from elasticsearch_tpu.search.plane_exec import PlaneFallback
             from elasticsearch_tpu.utils.errors import CircuitBreakingError
             TELEMETRY.count_fallback(
                 telemetry.BATCH_IVF_NPROBE_DISAGREEMENT
-                if isinstance(e, _FallbackSolo) else
+                if isinstance(e, PlaneFallback) else
                 telemetry.BATCH_BREAKER_REFUSED
                 if isinstance(e, CircuitBreakingError) else
                 telemetry.BATCH_EXEC_ERROR, len(live))
-            self.stats["solo_fallbacks"] += len(live)
+            if isinstance(e, CircuitBreakingError):
+                # HBM pressure: halve this key's effective drain cap so
+                # the next drains fit the budget; regrown by successful
+                # full drains below
+                shrunk = max(1, len(live) // 2)
+                if shrunk < (st.get("max_size") or self.max_size()):
+                    st["max_size"] = shrunk
+                    self.stats["max_size_shrinks"] += 1
+            self.stats["member_redrains"] += len(live)
             for m in live:
-                if m.error is None and m.result is None:
-                    # the solo path re-derives its shard deadline from
-                    # budget_remaining: ship the budget LEFT now, not the
-                    # original — queue wait and the failed batch attempt
-                    # already spent part of it
-                    req = m.req
-                    if m.deadline is not None:
-                        req = {**m.req, "budget_remaining": max(
-                            0.0, m.deadline - scheduler.now())}
-                    try:
-                        m.result = self.sts._execute_query_solo(req)
-                    except Exception as e2:  # noqa: BLE001
-                        m.error = e2
-        if not fell_back:
+                if m.error is not None or m.result is not None:
+                    continue
+                t_re = time.monotonic_ns()
+                sub = SearchTrace(m.trace.query_class, "batch")
+                try:
+                    with telemetry.activate(sub):
+                        self._execute(key, [m])
+                except _AllMembersDead:
+                    continue   # m.error already set
+                except Exception as e2:  # noqa: BLE001 — at occupancy 1
+                    m.error = e2   # this is the query's own error
+                    continue
+                if not dense and m.result is not None:
+                    m.trace.dispatches = sub.dispatches
+                    m.trace.plane_backed = sub.plane_backed
+                    m.trace.add_span(
+                        "device_dispatch", time.monotonic_ns() - t_re,
+                        {"occupancy": 1, "redrain": 1})
+                    m.trace.finish()
+                    TELEMETRY.observe(m.trace)
+        else:
+            # successful shared drain at the full (shrunk) cap: regrow
+            # the key's max_size toward the setting — headroom proved
+            eff = st.get("max_size")
+            if eff and len(live) >= eff:
+                grown = min(self.max_size(), int(eff) * 2)
+                if grown > eff:
+                    st["max_size"] = None if grown >= self.max_size() \
+                        else grown
+                    self.stats["max_size_grows"] += 1
+        if not fell_back and not dense:
             exec_ns = time.monotonic_ns() - now_ns
             meta = {"occupancy": len(live)}
             if drain_trace.dispatches:
@@ -931,6 +959,14 @@ class ShardQueryBatcher:
         if self._queues.get(key) and key not in self._timers:
             self._timers[key] = scheduler.schedule(
                 0.0, lambda: self._drain(key))
+
+    def _set_phase(self, members: List[_Member], phase: str) -> None:
+        """_tasks phase fidelity: a shard task shows its current
+        sub-phase (queued -> query -> dispatch -> demux) instead of
+        "query" for its whole life — occupancy-1 members included."""
+        for m in members:
+            if m.task is not None and m.error is None:
+                m.task.status = {"phase": phase, "data_plane": "batch"}
 
     def _execute(self, key: Tuple, members: List[_Member]) -> None:
         from elasticsearch_tpu.action.search_action import (
@@ -975,6 +1011,10 @@ class ShardQueryBatcher:
                 self.stats["memo_hits"] += 1
             assign.append(got)
 
+        if spec0.kind == "dense":
+            self._execute_dense(shard, reader, members, uniques, assign)
+            return
+
         # shard-level term stats exactly as query_shard computes them;
         # df per term is query-independent so the members' maps merge
         doc_count = sum(seg.n_docs for seg in reader.segments)
@@ -993,36 +1033,63 @@ class ShardQueryBatcher:
         breaker = BREAKERS.breaker("request")
         n_q = len(uniques)
         want = spec0.window
+        self._set_phase(members, "dispatch")
         if spec0.kind == "text":
             transient = n_q * sum(
                 (P1_BUCKET * BLOCK * 8) + want * 8 for _ in ctxs)
-            label = "wand_topk_batch"
-        else:
-            transient = n_q * sum(8 * ctx.n_docs_pad for ctx in ctxs)
-            label = f"{spec0.kind}_batch"
-        with breaker.limit_scope(transient, label):
-            if spec0.kind == "text":
+            with breaker.limit_scope(transient, "wand_topk_batch"):
                 results = batched_wand_topk_shard(
                     ctxs, spec0.field,
                     [u.spec.clauses for u in uniques], want,
                     spec0.track_limit, check_members)
-                collector = "wand_topk"
-            elif spec0.kind == "knn":
+            collector = "wand_topk"
+        elif spec0.kind == "knn":
+            transient = n_q * sum(8 * ctx.n_docs_pad for ctx in ctxs)
+            with breaker.limit_scope(transient, "knn_batch"):
                 results = batched_knn_shard(
                     ctxs, spec0.field, [u.spec for u in uniques],
                     spec0.k, check_members, stats=self.stats)
-                collector = "dense"
-            else:
-                results = batched_sparse_shard(
-                    ctxs, spec0.field, [u.spec for u in uniques], want,
-                    check_members)
-                collector = "dense"
+            collector = "dense"
+        else:
+            # sparse charges at its dispatch sites (the plane executor's
+            # internal scope, or one score plane per segment) — an outer
+            # scope here would double-charge the plane path
+            results = batched_sparse_shard(
+                ctxs, spec0.field, [u.spec for u in uniques], want,
+                check_members)
+            collector = "dense"
 
+        self._set_phase(members, "demux")
+        # response rows are copy-on-write: the docs payload of a memo'd
+        # plan is built ONCE for its unique and shared by every
+        # duplicate (responses are serialized downstream, never
+        # mutated); only the context_id differs per member
+        rows: List[Optional[Dict[str, Any]]] = [None] * len(uniques)
         for m, ui in zip(members, assign):
-            candidates, total, relation, max_score, prune = results[ui]
             if m.error is not None:
                 continue    # died mid-batch: fail, don't demux
-            docs = candidates[: want]
+            row = rows[ui]
+            if row is None:
+                candidates, total, relation, max_score, prune = \
+                    results[ui]
+                docs = candidates[: want]
+                row = rows[ui] = {
+                    "context_id": None,
+                    "total": total,
+                    "relation": relation,
+                    "max_score": max_score,
+                    "collector": collector,
+                    "prune": list(prune) if prune else None,
+                    "docs": [{"segment": d.segment_idx, "doc": d.doc,
+                              "score": d.score,
+                              "sort": list(d.sort_values)}
+                             for d in docs],
+                    "terminated": False,
+                    "aggs_partial": None,
+                    "suggest_partial": None,
+                    "profile": None,
+                }
+            prune = row["prune"]
             stats = shard.search_stats
             stats["query_total"] += 1
             if collector == "wand_topk" and prune:
@@ -1032,21 +1099,150 @@ class ShardQueryBatcher:
             context_id = uuid_mod.uuid4().hex
             self.sts._contexts[context_id] = (
                 reader, self.sts._now() + CONTEXT_KEEP_ALIVE)
-            m.result = {
-                "context_id": context_id,
-                "total": total,
-                "relation": relation,
-                "max_score": max_score,
-                "collector": collector,
-                "prune": list(prune) if prune else None,
-                "docs": [{"segment": d.segment_idx, "doc": d.doc,
-                          "score": d.score, "sort": list(d.sort_values)}
-                         for d in docs],
-                "terminated": False,
-                "aggs_partial": None,
-                "suggest_partial": None,
-                "profile": None,
-            }
+            m.result = {**row, "context_id": context_id}
             self.sts._slow_log(m.req,
                                time.monotonic() - m.enqueued_wall,
                                trace=m.trace)
+
+    def _execute_dense(self, shard, reader, members: List[_Member],
+                       uniques: List[_Member], assign: List[int]) -> None:
+        """The per-member kind: each unique plan runs ``query_shard``
+        over the DRAIN's shared reader snapshot (one acquisition per
+        drain, not per query) through the full response pipeline
+        (aggregations, suggest, rescore, collapse, profile, request
+        cache, slow log); duplicates fan out copy-on-write with their
+        own pinned contexts. Deadline/cancellation bind per member: a
+        unique executes under its OWN checks, and its own failure never
+        touches drain-mates."""
+        from elasticsearch_tpu.action.search_action import (
+            CONTEXT_KEEP_ALIVE,
+        )
+        exec_ns: Dict[int, int] = {}
+        cache_hit: Dict[int, bool] = {}
+        for ui, u in enumerate(uniques):
+            if u.error is not None:
+                continue
+            self._set_phase([u], "dispatch")
+            t0 = time.monotonic_ns()
+            meta: Dict[str, Any] = {}
+            try:
+                u.result = self.sts.execute_query_member(
+                    u.req, reader,
+                    cancel_check=self._member_cancel_check(u),
+                    trace=u.trace, started_wall=u.enqueued_wall,
+                    meta_out=meta)
+            except (TaskCancelledError, SearchBudgetExceededError) as e:
+                if isinstance(e, TaskCancelledError):
+                    self.stats["queries_cancelled"] += 1
+                else:
+                    self.stats["queries_expired"] += 1
+                u.error = e
+            except Exception as e:  # noqa: BLE001 — the member's own
+                u.error = e         # error (parse, breaker, ...)
+            exec_ns[ui] = time.monotonic_ns() - t0
+            cache_hit[ui] = bool(meta.get("cache_hit"))
+        self._set_phase(members, "demux")
+        for m, ui in zip(members, assign):
+            if m is uniques[ui] or m.error is not None:
+                continue
+            # the duplicate's own death binds here (the shared kinds
+            # observe it via check_members between dispatches): a
+            # cancelled or budget-expired duplicate rejects instead of
+            # resolving with a result its caller already abandoned
+            m.error = self._member_error(m)
+            if m.error is not None:
+                continue
+            u = uniques[ui]
+            if u.error is not None:
+                if isinstance(u.error, (TaskCancelledError,
+                                        SearchBudgetExceededError)):
+                    # the unique's cancellation/budget is its OWN, not
+                    # the plan's: re-execute this duplicate under its
+                    # own checks and promote it as the memo source for
+                    # the remaining duplicates
+                    self._set_phase([m], "dispatch")
+                    t0 = time.monotonic_ns()
+                    meta = {}
+                    try:
+                        m.result = self.sts.execute_query_member(
+                            m.req, reader,
+                            cancel_check=self._member_cancel_check(m),
+                            trace=m.trace, started_wall=m.enqueued_wall,
+                            meta_out=meta)
+                    except (TaskCancelledError,
+                            SearchBudgetExceededError) as e:
+                        if isinstance(e, TaskCancelledError):
+                            self.stats["queries_cancelled"] += 1
+                        else:
+                            self.stats["queries_expired"] += 1
+                        m.error = e
+                        continue
+                    except Exception as e:  # noqa: BLE001
+                        m.error = e
+                        continue
+                    exec_ns[ui] = time.monotonic_ns() - t0
+                    cache_hit[ui] = bool(meta.get("cache_hit"))
+                    uniques[ui] = m
+                    self._set_phase([m], "demux")
+                    continue
+                # an identical plan fails identically; sharing the
+                # error object is safe (raised to distinct deferreds)
+                m.error = u.error
+                continue
+            row = u.result
+            context_id = None
+            if row.get("context_id") is not None:
+                # the duplicate pins its OWN context over the same
+                # drain reader (fetch pops contexts individually)
+                context_id = uuid_mod.uuid4().hex
+                self.sts._contexts[context_id] = (
+                    reader, self.sts._now() + CONTEXT_KEEP_ALIVE)
+            # duplicates are served traffic: they count in the shard
+            # search stats exactly as independent executions would,
+            # mirroring the branch the unique took inside
+            # execute_query_member (cache hit vs executed query)
+            stats = shard.search_stats
+            if cache_hit.get(ui):
+                stats["request_cache_hits"] += 1
+            else:
+                stats["query_total"] += 1
+                if row.get("collector") == "wand_topk" \
+                        and row.get("prune"):
+                    stats["wand_queries"] += 1
+                    stats["wand_blocks_total"] += row["prune"][0]
+                    stats["wand_blocks_scored"] += row["prune"][1]
+            m.result = {**row, "context_id": context_id}
+            # the duplicate's honest attribution is the unique's
+            # execution it shared (the drain-span discipline)
+            m.trace.add_span("device_dispatch", exec_ns.get(ui, 1),
+                             {"memo": 1})
+            m.trace.finish()
+            TELEMETRY.observe(m.trace)
+            self.sts._slow_log(m.req,
+                               time.monotonic() - m.enqueued_wall,
+                               trace=m.trace)
+
+    def _member_cancel_check(self, m: _Member):
+        """The member's own between-segments check (the old solo path's
+        cancel_check): raises the member's typed error without touching
+        drain-mates or double-counting stats."""
+        checks = []
+        if m.task is not None:
+            checks.append(m.task.ensure_not_cancelled)
+        if m.deadline is not None:
+            scheduler = self._scheduler()
+
+            def ensure_budget(deadline=m.deadline, scheduler=scheduler,
+                              req=m.req):
+                if scheduler.now() >= deadline:
+                    raise SearchBudgetExceededError(
+                        f"search budget expired while querying "
+                        f"[{req['index']}][{req['shard']}]")
+            checks.append(ensure_budget)
+        if not checks:
+            return None
+
+        def cancel_check() -> None:
+            for check in checks:
+                check()
+        return cancel_check

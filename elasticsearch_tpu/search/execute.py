@@ -997,59 +997,135 @@ def ann_segment_route(ctx: "SegmentContext", field: str, k: int,
     return (index, rows, oversample, nprobe)
 
 
-def _ann_segment_topk(ctx: "SegmentContext", q: dsl.Knn
-                      ) -> Optional[List[Tuple[int, int, float]]]:
-    """IVF path for one segment, or None to fall back to exact.
+def filter_context_mask(ctx: "SegmentContext", filt, filter_key,
+                        stats: Optional[Dict[str, float]] = None
+                        ) -> np.ndarray:
+    """Host-side filter-context mask [n_docs_pad] for one segment,
+    cached ACROSS drains on the immutable segment keyed by the filter's
+    value key (the reader-generation component of the cache key IS the
+    segment identity: a refresh produces new segments, deletes ride the
+    live mask — never the match mask). The batched kNN paths used to
+    re-execute every distinct filter tree per drain before stacking the
+    [Q, N_pad] masks; now only the stack itself rebuilds."""
+    def build():
+        _, fmask = execute(filt, ctx)
+        return np.asarray(fmask)
+    if filter_key is None:
+        return build()
+    key = ("filter_ctx_mask", filter_key)
+    hit = key in ctx.segment._filter_cache
+    mask = _cached_filter(ctx, key, build)
+    if stats is not None and hit:
+        stats["filter_mask_reuses"] = stats.get("filter_mask_reuses",
+                                                0) + 1
+    return mask
 
-    Used when the mapping opts in (index_options {"type": "ivf"}) or the
-    segment is large enough that brute force wastes FLOPs. Deleted docs are
-    filtered after probing (the Lucene-HNSW-style post-filter), with
-    oversampling to keep k results available."""
-    route = ann_segment_route(ctx, q.field, q.k, q.num_candidates,
-                              filtered=q.filter is not None)
-    if route is None:
-        return None
-    index, rows, oversample, nprobe = route
-    if index is None:
-        return []         # field present but no vectors in this segment
-    live = np.asarray(ctx.live)[: ctx.segment.n_docs]
-    return index.probe_live(
-        np.asarray(q.query_vector, np.float32)[None, :], q.k, nprobe,
-        rows, live, ctx.segment_idx, oversample)[0]
 
+def knn_shard_winners(ctxs: List["SegmentContext"], field: str, specs,
+                      k: int, check_members=None,
+                      stats: Optional[Dict[str, float]] = None
+                      ) -> List[List[Tuple[int, int, float]]]:
+    """THE kNN top-k executor for the served path — Q queries (each a
+    spec with query_vector / filter / filter_key / num_candidates),
+    solo being simply Q=1. Returns one sorted ``[(segment_idx,
+    local_doc, raw_score)]`` winner list (len <= k) per member, the
+    merge Lucene's KnnVectorQuery rewrite performs.
 
-def _plane_knn_winners_solo(q: dsl.Knn, segment_ctxs, cancel_check):
-    """One-dispatch kNN over the shard plane when it is resident; None
-    routes the caller to the per-segment loop. The plane executor is the
-    SAME code the batched path runs, so solo and batched kNN cannot
-    diverge."""
-    if not segment_ctxs:
-        return None
-    reader = segment_ctxs[0].reader
-    if reader is None or len(reader.segments) != len(segment_ctxs):
-        return None
-    for ctx, seg in zip(segment_ctxs, reader.segments):
-        if ctx.segment is not seg:
-            return None
-    from elasticsearch_tpu.ops.device_segment import PLANES
-    part = PLANES.get([c.segment for c in segment_ctxs], "vectors",
-                      q.field)
-    if part is None:
-        return None
-    from types import SimpleNamespace
-
-    from elasticsearch_tpu.search.plane_exec import (
-        PlaneFallback, plane_knn_winners,
-    )
-    spec = SimpleNamespace(
-        query_vector=q.query_vector, filter=q.filter,
-        filter_key=repr(q.filter) if q.filter is not None else None,
-        num_candidates=q.num_candidates)
-    try:
-        return plane_knn_winners(segment_ctxs, part, q.field, [spec],
-                                 q.k, check_members=cancel_check)[0]
-    except PlaneFallback:
-        return None
+    Route per segment and member, one shared dispatch per class:
+    the resident whole-shard plane (one matmul / one shard-IVF probe via
+    plane_exec.plane_knn_winners), else per segment: unfiltered members
+    on IVF-routed segments share one batched nprobe probe per DERIVED
+    PROBE WIDTH (members whose num_candidates imply different nprobe
+    probe in separate groups — each exactly as it would alone — instead
+    of falling anywhere back), filtered members one (optionally masked)
+    [Q, D] x [D, N] matmul with filter-context masks computed once per
+    distinct filter (cached across drains per segment)."""
+    n_q = len(specs)
+    per_member_hits: List[List[Tuple[int, int, float]]] = \
+        [[] for _ in range(n_q)]
+    if ctxs:
+        from elasticsearch_tpu.ops.device_segment import PLANES
+        part = PLANES.get([c.segment for c in ctxs], "vectors", field)
+        if part is not None:
+            from elasticsearch_tpu.search.plane_exec import (
+                plane_knn_winners,
+            )
+            return plane_knn_winners(ctxs, part, field, specs, k,
+                                     check_members, stats)
+    vectors = np.asarray([s.query_vector for s in specs], np.float32)
+    unfiltered = [qi for qi in range(n_q) if specs[qi].filter is None]
+    for ctx in ctxs:
+        dev = DeviceVectors.for_segment(ctx.segment, field)
+        if dev is None:
+            continue
+        if check_members is not None:
+            check_members()
+        exact_idx = list(range(n_q))
+        if unfiltered and ann_segment_route(
+                ctx, field, k, specs[unfiltered[0]].num_candidates,
+                filtered=False) is not None:
+            # IVF-routed segment: group unfiltered members by the probe
+            # width their num_candidates implies; each group probes in
+            # one batched dispatch, exactly as its members would solo
+            groups: Dict[int, Tuple[Tuple, List[int]]] = {}
+            for qi in unfiltered:
+                route = ann_segment_route(
+                    ctx, field, k, specs[qi].num_candidates,
+                    filtered=False)
+                groups.setdefault(route[3], (route, []))[1].append(qi)
+            live_host = np.asarray(ctx.live)[: ctx.segment.n_docs]
+            for nprobe, (route, members) in sorted(groups.items()):
+                index, rows, oversample, _n = route
+                if index is None:
+                    continue     # mapped, but no vectors here
+                probed = index.probe_live(
+                    vectors[members], k, nprobe, rows, live_host,
+                    ctx.segment_idx, oversample)
+                for qi, hits in zip(members, probed):
+                    per_member_hits[qi].extend(hits)
+            exact_idx = [qi for qi in range(n_q)
+                         if specs[qi].filter is not None]
+        if not exact_idx:
+            continue
+        # exact path: distinct filters resolve to masks once per segment
+        # (cached across drains on the segment itself)
+        masks = None
+        fkeys = {specs[qi].filter_key for qi in exact_idx}
+        if fkeys != {None}:
+            by_key: Dict[Optional[str], Any] = {}
+            for qi in exact_idx:
+                s_qi = specs[qi]
+                if s_qi.filter is not None and \
+                        s_qi.filter_key not in by_key:
+                    by_key[s_qi.filter_key] = filter_context_mask(
+                        ctx, s_qi.filter, s_qi.filter_key, stats)
+            if len(fkeys) == 1:
+                # every member carries the SAME filter: one shared mask
+                masks = jnp.asarray(by_key[next(iter(fkeys))])
+                if stats is not None:
+                    stats["knn_shared_mask_segments"] = \
+                        stats.get("knn_shared_mask_segments", 0) + 1
+            else:
+                rows_m = np.ones((len(exact_idx), ctx.n_docs_pad), bool)
+                for row, qi in enumerate(exact_idx):
+                    fk = specs[qi].filter_key
+                    if fk is not None:
+                        rows_m[row] = by_key[fk]
+                masks = rows_m
+        ex = KnnExecutor(dev)
+        k_seg = min(k, ctx.n_docs_pad)
+        s, d = ex.top_k_batch(vectors[exact_idx], ctx.live, k_seg, masks)
+        s = np.asarray(s)
+        d = np.asarray(d)
+        for row, qi in enumerate(exact_idx):
+            for sc, doc in zip(s[row], d[row]):
+                if sc > -np.inf:
+                    per_member_hits[qi].append(
+                        (ctx.segment_idx, int(doc), float(sc)))
+    for qi in range(n_q):
+        per_member_hits[qi].sort(key=lambda x: -x[2])
+        per_member_hits[qi] = per_member_hits[qi][:k]
+    return per_member_hits
 
 
 def rewrite_knn(q: dsl.Query, segment_ctxs: List["SegmentContext"],
@@ -1059,38 +1135,19 @@ def rewrite_knn(q: dsl.Query, segment_ctxs: List["SegmentContext"],
     runs between per-segment device dispatches so a cancelled or
     budget-expired task stops paying for vector scans.
 
-    When the shard's vector plane is resident the whole rewrite is ONE
-    device program (plane_exec.plane_knn_winners) and the per-segment
-    loop below never runs — it remains as the degraded path for shards
-    whose plane was refused by the HBM budget."""
+    The rewrite IS a batch of one: it calls ``knn_shard_winners`` — the
+    same executor the micro-batcher's kNN drains run — with a single
+    spec, so solo and batched kNN cannot diverge (one kernel call-site
+    per route: plane matmul / shard-IVF probe / per-segment matmul /
+    per-segment grouped probe)."""
     if isinstance(q, dsl.Knn):
-        winners = _plane_knn_winners_solo(q, segment_ctxs, cancel_check)
-        if winners is None:
-            per_seg_hits: List[Tuple[int, int, float]] = []
-            for ctx in segment_ctxs:
-                if cancel_check is not None:
-                    cancel_check()
-                ann = _ann_segment_topk(ctx, q)
-                if ann is not None:
-                    per_seg_hits.extend(ann)
-                    continue
-                dev = DeviceVectors.for_segment(ctx.segment, q.field)
-                if dev is None:
-                    continue
-                live = ctx.live
-                if q.filter is not None:
-                    _, fmask = execute(q.filter, ctx)
-                    live = live & fmask
-                ex = KnnExecutor(dev)
-                k = min(q.k, ctx.n_docs_pad)
-                ts, td = ex.top_k(q.query_vector, live, k)
-                ts, td = np.asarray(ts), np.asarray(td)
-                for s, d in zip(ts, td):
-                    if s > -np.inf:
-                        per_seg_hits.append(
-                            (ctx.segment_idx, int(d), float(s)))
-            per_seg_hits.sort(key=lambda x: -x[2])
-            winners = per_seg_hits[: q.k]
+        from types import SimpleNamespace
+        spec = SimpleNamespace(
+            query_vector=q.query_vector, filter=q.filter,
+            filter_key=repr(q.filter) if q.filter is not None else None,
+            num_candidates=q.num_candidates)
+        winners = knn_shard_winners(segment_ctxs, q.field, [spec], q.k,
+                                    check_members=cancel_check)[0]
         per_segment: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         for si, d, s in winners:
             docs, scores = per_segment.setdefault(

@@ -85,6 +85,10 @@ class MeshSearchExecutor:
             "mesh_shard_results": 0,   # per-shard responses synthesized
             "device_dispatches": 0,    # compiled mesh programs launched
             "max_occupancy": 0,
+            # per-drain memo (the shard batcher's discipline): identical
+            # same-tick members pay one term-stats pass and one
+            # query-stack row, rows fanned out per duplicate
+            "memo_hits": 0,
         }
 
     # -- intake ---------------------------------------------------------
@@ -151,7 +155,9 @@ class MeshSearchExecutor:
             # a query; the RPC path reports real errors
             TELEMETRY.count_fallback(telemetry.MESH_ELIGIBILITY_ERROR)
             return False
-        if spec is None:
+        if spec is None or spec.kind == "dense":
+            # per-member shapes (aggs, suggest, rescore, sorts, ...) ride
+            # the shard batcher's dense kind through the RPC fan-out
             TELEMETRY.count_fallback(telemetry.MESH_INELIGIBLE_QUERY)
             return False
         shard_ids = sorted(t["shard"] for t in targets)
@@ -279,6 +285,25 @@ class MeshSearchExecutor:
             raise _MeshMiss(telemetry.MESH_PLANE_MISSING)
         mappers = shards[0].engine.mappers
 
+        # per-drain memo (the shard batcher's discipline): identical
+        # same-tick members pay ONE term-stats pass and ONE query-stack
+        # row; their per-shard response rows fan out below with their
+        # own pinned contexts. The drain holds one reader snapshot per
+        # shard, so a memo hit can never cross a refresh.
+        memo_index: Dict[Tuple, int] = {}
+        uniques: List[_Member] = []
+        assign: List[int] = []
+        for m in members:
+            mk = m.spec.memo_key()
+            got = memo_index.get(mk)
+            if got is None:
+                got = len(uniques)
+                memo_index[mk] = got
+                uniques.append(m)
+            else:
+                self.stats["memo_hits"] += 1
+            assign.append(got)
+
         # per-shard contexts + (text) term stats, exactly as query_shard
         # / the shard batcher build them — one reader snapshot per shard
         # per drain, so results cannot cross a refresh
@@ -287,7 +312,7 @@ class MeshSearchExecutor:
             doc_count = sum(seg.n_docs for seg in r.segments)
             dfs: Dict[str, Dict[str, int]] = {}
             if spec0.kind == "text":
-                for m in members:
+                for m in uniques:
                     _dc, m_dfs = shard_term_stats(r, mappers,
                                                   m.spec.query)
                     for fname, termmap in m_dfs.items():
@@ -306,7 +331,7 @@ class MeshSearchExecutor:
             if spec0.kind == "text":
                 got = mesh_wand_topk(
                     shard_ctxs, mpart, spec0.field,
-                    [m.spec.clauses for m in members], want,
+                    [m.spec.clauses for m in uniques], want,
                     spec0.track_limit, check_members=check_members,
                     counter=counter)
                 if got is None:
@@ -316,16 +341,16 @@ class MeshSearchExecutor:
             elif spec0.kind == "knn":
                 raw = mesh_knn_winners(
                     shard_ctxs, mpart, spec0.field,
-                    [m.spec for m in members], spec0.k,
+                    [m.spec for m in uniques], spec0.k,
                     check_members=check_members, counter=counter)
                 collector = "dense"
                 per_shard_member = [
-                    _knn_demux([m.spec for m in members], row, spec0.k)
+                    _knn_demux([m.spec for m in uniques], row, spec0.k)
                     for row in raw]
             else:
                 expansions = [[(t, w * m.spec.boost)
                                for t, w in m.spec.tokens.items()]
-                              for m in members]
+                              for m in uniques]
                 raw = mesh_sparse_topk(shard_ctxs, mpart, spec0.field,
                                        expansions, want,
                                        check_members=check_members,
@@ -334,7 +359,7 @@ class MeshSearchExecutor:
                 per_shard_member = []
                 for row in raw:
                     member_rows = []
-                    for (cands, total, max_score), m in zip(row, members):
+                    for (cands, total, max_score), m in zip(row, uniques):
                         relation = "eq"
                         clip = m.spec.clip_limit
                         if clip is not None and total > clip:
@@ -347,7 +372,7 @@ class MeshSearchExecutor:
         self.stats["device_dispatches"] += len(counter)
 
         # synthesize per-member, per-shard query-phase responses — the
-        # exact dicts _execute_query_solo / the shard batcher produce,
+        # exact dicts the shard batcher's drains produce,
         # with a pinned reader context per (member, shard) so the fetch
         # phase reads the same point-in-time snapshot
         now = self.sts._now()
@@ -356,7 +381,7 @@ class MeshSearchExecutor:
             member_results: List[Dict[str, Any]] = []
             for pos, sid in enumerate(shard_ids):
                 candidates, total, relation, max_score, prune = \
-                    per_shard_member[pos][mi]
+                    per_shard_member[pos][assign[mi]]
                 docs = candidates[: want]
                 shard = shards[pos]
                 stats = shard.search_stats

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from elasticsearch_tpu.index.engine import Reader
 from elasticsearch_tpu.index.segment import BLOCK, next_pow2
-from elasticsearch_tpu.ops.bm25 import DEFAULT_B, DEFAULT_K1, P1_BUCKET
+from elasticsearch_tpu.ops.bm25 import P1_BUCKET
 from elasticsearch_tpu.mapping import MapperService
 from elasticsearch_tpu.search import dsl
 from elasticsearch_tpu.search.execute import SegmentContext, execute
@@ -315,152 +315,6 @@ def choose_collector_context(query: dsl.Query,
     return "dense"
 
 
-def _wand_topk_shard(ctxs: List[SegmentContext], field: str,
-                     clauses: List[Tuple[str, float]],
-                     want: int, cancel_check,
-                     track_limit: int) -> Tuple[
-                         List[ShardDoc], int, str, Optional[float],
-                         Tuple[int, int]]:
-    """Pruned top-k over the shard's segments with ONE shard-global theta.
-
-    Three-stage shape (2 host sync barriers per shard, not per segment —
-    the r3 build dispatched batch-of-one per segment with a theta sync
-    each):
-      1. launch every segment's phase 1 (top-upper-bound blocks), then
-         block ONCE for all partial scores; the want-th best across ALL
-         segments is the shard-global theta — tighter than any per-segment
-         floor, so segments prune each other;
-      2. launch every segment's phase 2 with that theta; block once.
-
-    Totals (counts-then-skip, TopDocsCollectorContext.java:215): with a
-    finite ``track_limit`` the phase-2 kernel counts matching docs from
-    the score plane it already computed. Observed >= limit proves
-    ("gte", limit). Otherwise the shard re-scores unpruned for an exact
-    count — but when the df upper bound already shows total <= limit the
-    first pass runs unpruned+counted directly and no second pass exists.
-    track_limit 0 = totals disabled (report candidates found, "gte").
-
-    With the shard's postings plane resident the whole thing collapses to
-    the plane executor (2 dispatches total, segment count irrelevant);
-    this per-segment body is the degraded path for plane-refused shards."""
-    from elasticsearch_tpu.search.execute import _bm25_executor
-    if ctxs:
-        from elasticsearch_tpu.ops.device_segment import PLANES
-        part = PLANES.get([c.segment for c in ctxs], "postings", field)
-        if part is not None:
-            from elasticsearch_tpu.search.plane_exec import plane_wand_topk
-            got = plane_wand_topk(ctxs, part, field, [clauses], want,
-                                  track_limit,
-                                  check_members=cancel_check)
-            if got is not None:
-                return got[0]
-    count = track_limit > 0
-    per_seg = []          # (ctx, ex, plans, k_seg, avgdl)
-    seen_terms: Dict[str, float] = {}
-    for ctx in ctxs:
-        if cancel_check is not None:
-            cancel_check()
-        analyzer = ctx.search_analyzer(field)
-        terms: List[Tuple[str, float]] = []
-        for text, boost in clauses:
-            terms.extend((t, boost) for t in analyzer.terms(text))
-        if not terms:
-            continue
-        ex = _bm25_executor(ctx, field)
-        if ex is None:
-            continue   # field has no postings in this segment
-        df_map = ctx.df_for(field) or {}
-        for t, _b in terms:
-            if t not in seen_terms:
-                seen_terms[t] = float(df_map.get(t, 0))
-        k_seg = min(max(want, 1), ctx.n_docs_pad)
-        avgdl = ex._avgdl(ctx.avgdl_for(field))
-        plans = ex.build_plans([terms], df_override=df_map or None,
-                               avgdl=avgdl)
-        per_seg.append((ctx, ex, plans, k_seg, avgdl))
-    if not per_seg:
-        return [], 0, "eq", None, (0, 0)
-
-    # df-based upper bound on shard hits (shard-level df includes deletes,
-    # so it only overcounts — safe as an upper bound)
-    hits_upper = int(sum(seen_terms.values()))
-    exact_mode = count and hits_upper <= track_limit
-    blocks_total = 0
-    blocks_scored = 0
-    results = []
-    if exact_mode:
-        # few enough postings that pruning cannot pay: one unpruned
-        # counted pass, exact totals for free
-        for ctx, ex, plans, k_seg, avgdl in per_seg:
-            total = sum(p.n_blocks for p in plans)
-            blocks_total += total
-            blocks_scored += total
-            results.append(ex._dispatch_flat(plans, ctx.live, k_seg,
-                                             DEFAULT_K1, DEFAULT_B, avgdl,
-                                             counted=True))
-        hits_exact = True
-    else:
-        # barrier 1: all segments' phase-1 partials -> shard-global theta
-        s1_dev = [ex.phase1(plans, ctx.live, k_seg, avgdl=avgdl)
-                  for ctx, ex, plans, k_seg, avgdl in per_seg]
-        all_s1 = np.concatenate([np.asarray(s)[0] for s in s1_dev])
-        finite = all_s1[np.isfinite(all_s1)]
-        if len(finite) >= want:
-            theta = float(np.sort(finite)[-want])
-        else:
-            theta = -np.inf
-        hits_exact = True
-        for ctx, ex, plans, k_seg, avgdl in per_seg:
-            if cancel_check is not None:
-                cancel_check()
-            results.append(ex.finish_pruned(plans, [theta], ctx.live,
-                                            k_seg, avgdl=avgdl,
-                                            count_hits=count))
-            t, g = ex.last_prune_stats
-            blocks_total += t
-            blocks_scored += g
-            hits_exact = hits_exact and ex.last_hits_exact
-
-    # barrier 2: collect candidates (+ counts)
-    candidates: List[ShardDoc] = []
-    max_score: Optional[float] = None
-    hits_seen = 0
-    for (ctx, ex, plans, k_seg, avgdl), got in zip(per_seg, results):
-        if count:
-            s, d, h = got
-            hits_seen += int(h[0])
-        else:
-            s, d = got
-        s0 = np.asarray(s[0])
-        d0 = np.asarray(d[0])
-        for sc, doc in zip(s0, d0):
-            if sc == -np.inf:
-                break
-            candidates.append(
-                ShardDoc(ctx.segment_idx, int(doc), float(sc), (float(sc),)))
-            if max_score is None or sc > max_score:
-                max_score = float(sc)
-    candidates.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
-    prune = (blocks_total, blocks_scored)
-
-    if not count:
-        return candidates, len(candidates), "gte", max_score, prune
-    if hits_seen >= track_limit:
-        return candidates, track_limit, "gte", max_score, prune
-    if hits_exact:
-        return candidates, hits_seen, "eq", max_score, prune
-    # observed < limit but pruning may have hidden hits: one exact
-    # unpruned counted pass for the true total (scores already final)
-    exact_hits = 0
-    for ctx, ex, plans, k_seg, avgdl in per_seg:
-        _s, _d, h = ex._dispatch_flat(plans, ctx.live, 1, DEFAULT_K1,
-                                      DEFAULT_B, avgdl, counted=True)
-        exact_hits += int(h[0])
-    if exact_hits > track_limit:
-        return candidates, track_limit, "gte", max_score, prune
-    return candidates, exact_hits, "eq", max_score, prune
-
-
 def query_shard(reader: Reader,
                 mappers: MapperService,
                 query: dsl.Query,
@@ -590,14 +444,21 @@ def query_shard(reader: Reader,
         wc = wand_clauses(query, mappers)
         assert wc is not None   # choose_collector_context guarantees it
         w_field, w_clauses = wc
+        # THE pruned text executor — the same Q-query function the
+        # micro-batcher's drains run, with Q=1 (solo is a batch of one:
+        # one kernel call-site per query class on the served path)
+        from elasticsearch_tpu.search.batch_executor import (
+            batched_wand_topk_shard,
+        )
         # transient: per-segment phase gathers + top-k outputs, NOT a dense
         # score vector — pruning is precisely what keeps this small
         transient = sum(
             (P1_BUCKET * BLOCK * 8) + want * 8 for _ in ctxs)
         with request_breaker.limit_scope(transient, "wand_topk"):
-            candidates, hits, relation, max_score, prune = _wand_topk_shard(
-                ctxs, w_field, w_clauses, want, cancel_check,
-                track_limit if exact_total else 0)
+            candidates, hits, relation, max_score, prune = \
+                batched_wand_topk_shard(
+                    ctxs, w_field, [w_clauses], want,
+                    track_limit if exact_total else 0, cancel_check)[0]
         return ShardQueryResult(
             candidates[from_: from_ + size], hits, relation, max_score,
             doc_count=doc_count, dfs=dfs,
@@ -607,41 +468,36 @@ def query_shard(reader: Reader,
                 if profile else None))
 
     if collector == "sparse_topk":
-        # resolved text_expansion over the rank_features plane: one
-        # device program for the whole shard, exact counts off the score
-        # plane — byte-identical to the dense per-segment path it
-        # replaces (falls back to it when the plane is not resident)
-        from elasticsearch_tpu.ops.device_segment import PLANES
-        part = PLANES.get(reader.segments, "features", query.field)
-        if part is None:
-            collector = "dense"
-        else:
-            from elasticsearch_tpu.search.plane_exec import (
-                plane_sparse_topk,
-            )
-            expansion = [(t, w * query.boost)
-                         for t, w in query.tokens.items()]
-            # plane_sparse_topk charges the request breaker for its own
-            # score plane at dispatch time
-            (cands, total, max_score), = plane_sparse_topk(
-                ctxs, part, query.field, [expansion], want,
-                check_members=cancel_check)
-            relation = "eq"
-            if exact_total and track_limit < (1 << 62) \
-                    and total > track_limit:
-                total, relation = track_limit, "gte"
-            result = ShardQueryResult(
-                cands[from_: from_ + size], total, relation, max_score,
-                doc_count=doc_count, dfs=dfs)
-            if profile:
-                result.profile = _profile_block(
-                    "SimpleTopScoreDocCollector", "search_top_hits")
-            return result
+        # resolved text_expansion through THE sparse executor (the
+        # batcher's drains run the same function): one device program
+        # over the rank_features plane when resident, one vmapped
+        # dispatch per segment otherwise — counts read off the score
+        # plane either way (the dense path's mask sum)
+        from elasticsearch_tpu.search.batch_executor import (
+            sparse_topk_shard,
+        )
+        expansion = [(t, w * query.boost)
+                     for t, w in query.tokens.items()]
+        # the executor charges the request breaker at its dispatch
+        # sites (plane scope, or one score plane per segment)
+        (cands, total, max_score), = sparse_topk_shard(
+            ctxs, query.field, [expansion], want,
+            check_members=cancel_check)
+        relation = "eq"
+        if exact_total and track_limit < (1 << 62) \
+                and total > track_limit:
+            total, relation = track_limit, "gte"
+        result = ShardQueryResult(
+            cands[from_: from_ + size], total, relation, max_score,
+            doc_count=doc_count, dfs=dfs)
+        if profile:
+            result.profile = _profile_block(
+                "SimpleTopScoreDocCollector", "search_top_hits")
+        return result
 
     # Lucene-style kNN rewrite: per-segment top-k merged to shard-global
-    # k; with the vector plane resident the rewrite itself is ONE device
-    # program (execute._plane_knn_winners_solo), otherwise it pays one
-    # dispatch per segment with the cancel/deadline check between them
+    # k through execute.knn_shard_winners — the same executor the
+    # batcher's kNN drains run, with Q=1
     from elasticsearch_tpu.search.execute import KnnBound, rewrite_knn
     query = rewrite_knn(query, ctxs, cancel_check)
 

@@ -1,10 +1,11 @@
-"""Cross-query micro-batching: golden batch/solo parity + chaos cases.
+"""THE shard execution path: batching invariants + chaos cases.
 
-The batcher (search/batch_executor.py) must be invisible in results:
-batched top-k hits, scores, totals, and _shards stats identical to the
-solo path across seeds and query classes (text / kNN / sparse), while
+Every shard query rides the batcher (search/batch_executor.py) — solo
+is a batch of one. Batching must be invisible in results: batched top-k
+hits, scores, totals, and _shards stats identical at any occupancy
+across seeds and query classes (text / kNN / sparse / dense), while
 per-query deadlines and cancellation still bind inside a batch, and
-search.batch.enabled=false restores the solo path.
+search.batch.enabled=false forces window 0 through the same path.
 """
 
 import os
@@ -31,6 +32,15 @@ CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
 def _ok(resp, err):
     assert err is None, f"unexpected error: {err}"
     return resp
+
+
+def _member_reference(sts, req):
+    """Reference execution for parity checks: the SAME per-member body
+    the drain runs (execute_query_member over a fresh reader snapshot),
+    without queueing — what a batch of one produces."""
+    shard = sts.indices.shard(req["index"], req["shard"])
+    return sts.execute_query_member(dict(req),
+                                    shard.engine.acquire_reader())
 
 
 # ---------------------------------------------------------------------------
@@ -275,12 +285,12 @@ def test_golden_ivf_batch_parity(seed):
     _knn_parity(eng, rng, bodies, 5)
 
 
-def test_ivf_num_candidates_disagreement_falls_back_solo():
+def test_ivf_num_candidates_disagreement_probes_per_width():
     """IVF-routed members whose num_candidates imply different probe
-    widths cannot share one dispatch: the batch must raise _FallbackSolo
-    (the batcher then re-runs every member solo) rather than probe
-    wrongly. Only reachable when the mapping does not pin nprobe."""
-    from elasticsearch_tpu.search.batch_executor import _FallbackSolo
+    widths cannot share one dispatch — but there is no solo path to fall
+    back to anymore: the per-segment route groups members by derived
+    probe width and each group probes exactly as its members would at
+    occupancy 1. Only reachable when the mapping does not pin nprobe."""
     rng = np.random.default_rng(11)
     eng = InternalEngine(
         MapperService({"properties": {"vec": {
@@ -303,22 +313,30 @@ def test_ivf_num_candidates_disagreement_falls_back_solo():
                  "query_vector":
                      [float(x) for x in rng.standard_normal(8)]}}}},
             eng.mappers)
-        assert spec is not None
+        assert spec.kind == "knn"
         specs.append(spec)
-    with pytest.raises(_FallbackSolo):
-        batched_knn_shard(ctxs, "vec", specs, 5)
+    batch = batched_knn_shard(ctxs, "vec", specs, 5)
+    assert len(batch) == 2
+    for spec, got in zip(specs, batch):
+        alone, = batched_knn_shard(ctxs, "vec", [spec], 5)
+        assert [(c.segment_idx, c.doc, c.score) for c in got[0]] == \
+            [(c.segment_idx, c.doc, c.score) for c in alone[0]]
+        assert got[1:] == alone[1:]
 
 
-def test_classify_rejects_solo_only_shapes():
-    """Eligibility mirrors choose_collector_context: anything the batched
-    demux cannot reproduce byte-identically stays on the solo path."""
+def test_classify_routes_per_member_shapes_to_dense():
+    """Device-batch eligibility mirrors choose_collector_context:
+    anything the shared demux cannot reproduce byte-identically becomes
+    a ``dense`` member — still batched (shared reader acquisition,
+    per-drain memo, collection window), device work per member. Nothing
+    classifies to a second execution path."""
     mappers = MapperService({"properties": {
         "body": {"type": "text"},
         "vec": {"type": "dense_vector", "dims": 4}}})
     base = {"index": "i", "shard": 0, "window": 10,
             "body": {"query": {"match": {"body": "hello world"}}}}
-    assert classify_request(base, mappers) is not None
-    bad = [
+    assert classify_request(base, mappers).kind == "text"
+    per_member = [
         {**base, "window": 0},
         {**base, "df_overrides": {"body": {"hello": 3}}},
         {**base, "body": {**base["body"], "aggs": {"a": {"terms": {
@@ -329,15 +347,27 @@ def test_classify_rejects_solo_only_shapes():
         {**base, "body": {**base["body"], "rescore": {"window_size": 5}}},
         {**base, "body": {**base["body"], "track_total_hits": True}},
         {**base, "body": {**base["body"], "profile": True}},
+        {**base, "body": {**base["body"], "suggest": {"s": {
+            "text": "helo", "term": {"field": "body"}}}}},
+        {**base, "body": {**base["body"], "collapse": {
+            "field": "body"}}},
         {**base, "body": {"query": {"match": {"body": {
             "query": "hello", "operator": "and"}}}}},
     ]
-    for req in bad:
-        assert classify_request(req, mappers) is None, req
+    for req in per_member:
+        spec = classify_request(req, mappers)
+        assert spec.kind == "dense", req
+        assert spec.dense_key is not None
+    # identical dense bodies share a memo key; distinct ones do not
+    a = classify_request(per_member[2], mappers)
+    b = classify_request(dict(per_member[2]), mappers)
+    assert a.memo_key() == b.memo_key()
+    assert a.memo_key() != classify_request(per_member[3],
+                                            mappers).memo_key()
     # explicit score-desc sort is still the default shape: eligible
     assert classify_request(
         {**base, "body": {**base["body"], "sort": ["_score"]}},
-        mappers) is not None
+        mappers).kind == "text"
     # pure exact-kNN is eligible
     assert classify_request(
         {**base, "body": {"query": {"knn": {
@@ -361,14 +391,14 @@ def test_classify_rejects_solo_only_shapes():
         {**base, "body": {"query": {"knn": {
             "field": "vec", "query_vector": [1, 0, 0, 0]}}}},
         mappers).key()
-    # unknown vector index types stay solo
+    # unknown vector index types execute per member
     unknown = MapperService({"properties": {"vec": {
         "type": "dense_vector", "dims": 4,
         "index_options": {"type": "hnsw"}}}})
     assert classify_request(
         {**base, "body": {"query": {"knn": {
             "field": "vec", "query_vector": [1, 0, 0, 0]}}}},
-        unknown) is None
+        unknown).kind == "dense"
 
 
 # ---------------------------------------------------------------------------
@@ -474,7 +504,11 @@ def test_concurrent_wave_batches_and_matches_solo(cluster, bodies):
         _set_batch_enabled(c, None)
 
 
-def test_batch_disabled_keeps_batcher_idle(cluster):
+def test_batch_disabled_forces_window_zero_same_path(cluster):
+    """``search.batch.enabled: false`` is NOT a second execution path:
+    every query still rides the batcher with collection window 0 (a
+    next-tick drain, which still coalesces same-tick arrivals), so the
+    stats keep moving and responses stay identical."""
     c = cluster
     batcher = c.nodes["node0"].search_transport.batcher
     _set_batch_enabled(c, "false")
@@ -485,7 +519,11 @@ def test_batch_disabled_keeps_batcher_idle(cluster):
         for resp, err in resps:
             assert err is None
             assert len(resp["hits"]["hits"]) == 3
-        assert batcher.stats == before   # nothing routed to the batcher
+        # disabled still routes through THE path — drains happened
+        assert batcher.stats["batches_dispatched"] > \
+            before["batches_dispatched"]
+        assert batcher.stats["queries_dispatched"] >= \
+            before["queries_dispatched"] + 3
     finally:
         _set_batch_enabled(c, None)
 
@@ -536,7 +574,7 @@ def test_memo_hits_fan_out_identical_plans(cluster):
             for _ in range(4)]
     reqs.append({"index": "bx", "shard": 0, "window": 5,
                  "body": {"query": {"match": {"body": "w1"}}}})
-    deferreds = [batcher.try_enqueue(r) for r in reqs]
+    deferreds = [batcher.enqueue(r) for r in reqs]
     assert all(d is not None for d in deferreds)
     key = next(iter(batcher._queues))
     results = [None] * len(reqs)
@@ -551,7 +589,7 @@ def test_memo_hits_fan_out_identical_plans(cluster):
     for i, (kind, payload) in enumerate(results):
         assert kind == "ok", payload
         context_ids.add(payload["context_id"])
-        solo = sts._execute_query_solo(dict(reqs[i]))
+        solo = _member_reference(sts, reqs[i])
         assert payload["docs"] == solo["docs"]
         assert payload["total"] == solo["total"]
         assert payload["relation"] == solo["relation"]
@@ -574,7 +612,7 @@ def test_occupancy_feedback_grows_and_shrinks_window(cluster):
         reqs = [{"index": "bx", "shard": 0, "window": 9,
                  "body": {"query": {"match": {"body": f"w{i} w0"}}}}
                 for i in range(n)]
-        deferreds = [batcher.try_enqueue(r) for r in reqs]
+        deferreds = [batcher.enqueue(r) for r in reqs]
         assert all(d is not None for d in deferreds)
         key = next(k for k, q in batcher._queues.items() if q)
         batcher._drain(key)
@@ -689,7 +727,7 @@ def test_deadline_expiry_and_cancel_mid_batch(cluster, seed):
     cancelled_i = int((expired_i + 1 + rng.integers(0, n - 1)) % n)
     reqs[expired_i]["budget_remaining"] = 0.0
 
-    deferreds = [batcher.try_enqueue(r) for r in reqs]
+    deferreds = [batcher.enqueue(r) for r in reqs]
     assert all(d is not None for d in deferreds)
     key = next(iter(batcher._queues))
     members = list(batcher._queues[key])
@@ -713,7 +751,7 @@ def test_deadline_expiry_and_cancel_mid_batch(cluster, seed):
         else:
             assert kind == "ok", payload
             # survivors match the solo path exactly
-            solo = sts._execute_query_solo(dict(reqs[i]))
+            solo = _member_reference(sts, reqs[i])
             assert payload["docs"] == solo["docs"]
             assert payload["total"] == solo["total"]
             assert payload["relation"] == solo["relation"]
@@ -746,7 +784,7 @@ def test_deadline_and_cancel_mid_filtered_knn_batch(cluster, seed):
     cancelled_i = int((expired_i + 1 + rng.integers(0, n - 1)) % n)
     reqs[expired_i]["budget_remaining"] = 0.0
 
-    deferreds = [batcher.try_enqueue(r) for r in reqs]
+    deferreds = [batcher.enqueue(r) for r in reqs]
     assert all(d is not None for d in deferreds)
     key = next(iter(batcher._queues))
     members = list(batcher._queues[key])
@@ -766,7 +804,7 @@ def test_deadline_and_cancel_mid_filtered_knn_batch(cluster, seed):
             assert kind == "err" and "cancelled" in str(payload)
         else:
             assert kind == "ok", payload
-            solo = sts._execute_query_solo(dict(reqs[i]))
+            solo = _member_reference(sts, reqs[i])
             assert payload["docs"] == solo["docs"]
             assert payload["total"] == solo["total"]
             assert payload["relation"] == solo["relation"]
@@ -796,7 +834,7 @@ def test_chaos_sweep_mid_batch_failures():
                      "body": {"query": {"match": {"body": f"w{j % 5}"}}},
                      **({"budget_remaining": 0.0} if j == 0 else {})}
                     for j in range(4)]
-            deferreds = [sts.batcher.try_enqueue(r) for r in reqs]
+            deferreds = [sts.batcher.enqueue(r) for r in reqs]
             key = next(iter(sts.batcher._queues))
             results = [None] * len(deferreds)
             for i, d in enumerate(deferreds):

@@ -196,8 +196,6 @@ def test_budget_binds_shard_side_not_just_at_coordinator():
     the between-segments check with SearchBudgetExceededError instead of
     collecting results the coordinator already abandoned, and its
     query_total never moves."""
-    import pytest as _pytest
-
     from elasticsearch_tpu.utils.errors import SearchBudgetExceededError
 
     c = InProcessCluster(n_nodes=1, seed=17)
@@ -216,17 +214,30 @@ def test_budget_binds_shard_side_not_just_at_coordinator():
         shard = node.indices_service.shard("bs", 0)
         before = shard.search_stats["query_total"]
         # an exhausted budget (e.g. the request sat queued behind the
-        # bounded fan-out past the deadline) refuses before collecting
+        # bounded fan-out past the deadline) refuses at drain entry,
+        # before collecting (every shard query is a batch member now —
+        # _on_query answers through the batcher's Deferred)
         req = {"index": "bs", "shard": 0, "window": 10,
                "body": {"query": {"match_all": {}}},
                "budget_remaining": 0.0}
-        with _pytest.raises(SearchBudgetExceededError):
-            node.search_transport._on_query(req, "node0")
+        got = []
+        node.search_transport._on_query(req, "node0")._subscribe(
+            lambda v: got.append(("ok", v)),
+            lambda e: got.append(("err", e)))
+        c.run_until(lambda: bool(got), 60.0)
+        assert got[0][0] == "err"
+        assert SearchBudgetExceededError.__name__ in str(got[0][1])
+        assert "budget expired" in str(got[0][1])
         assert shard.search_stats["query_total"] == before
         # with budget left, the same request collects normally
         req2 = {**req, "budget_remaining": 30.0}
-        resp = node.search_transport._on_query(req2, "node0")
-        assert resp["total"] == 6
+        got2 = []
+        node.search_transport._on_query(req2, "node0")._subscribe(
+            lambda v: got2.append(("ok", v)),
+            lambda e: got2.append(("err", e)))
+        c.run_until(lambda: bool(got2), 60.0)
+        assert got2[0][0] == "ok", got2
+        assert got2[0][1]["total"] == 6
         assert shard.search_stats["query_total"] == before + 1
     finally:
         c.stop()

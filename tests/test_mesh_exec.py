@@ -398,6 +398,46 @@ def test_e2e_mesh_vs_fanout_byte_parity(seed):
         cluster.stop()
 
 
+def test_mesh_drain_memo_dedups_identical_members():
+    """Identical same-tick mesh members pay ONE term-stats pass and one
+    query-stack row (the shard batcher's per-drain memo discipline);
+    every duplicate still gets its own pinned contexts and a response
+    identical to a distinct member's."""
+    cluster, client, rng = _e2e_cluster(41)
+    try:
+        node = next(iter(cluster.nodes.values()))
+        ex = node.search_transport.mesh_executor
+        body = {"query": {"match": {"body": "w1 w3"}}, "size": 6}
+        boxes = []
+        for _ in range(4):
+            box = []
+            client.search("m", copy.deepcopy(body),
+                          lambda resp, err=None, box=box: box.append(
+                              (resp, err)))
+            boxes.append(box)
+        cluster.run_until(lambda: all(boxes), 120.0)
+        resps = []
+        for box in boxes:
+            resp, err = box[0]
+            assert err is None, err
+            assert resp.get("_data_plane") == "mesh_plane"
+            resps.append(resp)
+        # 4 identical members in one drain -> 1 execution + 3 memo hits
+        assert ex.stats["memo_hits"] >= 3
+        ref = {k: v for k, v in resps[0].items() if k != "took"}
+        for resp in resps[1:]:
+            got = {k: v for k, v in resp.items() if k != "took"}
+            assert json.dumps(got, sort_keys=True) == \
+                json.dumps(ref, sort_keys=True)
+        # a duplicate's hits match a fresh solo mesh search exactly
+        solo, err = cluster.call(
+            lambda cb: client.search("m", copy.deepcopy(body), cb))
+        assert err is None, err
+        assert solo["hits"] == resps[0]["hits"]
+    finally:
+        cluster.stop()
+
+
 def test_mesh_budget_refusal_counts_and_serves_none():
     """An over-budget mesh plane is refused AT ADMISSION (charged before
     upload), memoized, and reported as a miss — callers then keep the

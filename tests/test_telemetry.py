@@ -183,7 +183,9 @@ def test_profile_span_tree_shape(cluster):
     assert shards, "profile block lost its shard entries"
     tel = shards[0]["searches"][0]["telemetry"]
     assert tel["query_class"] == "bm25"
-    assert tel["data_plane"] in ("solo", "plane")
+    # every shard query is a batch member now (profile rides the
+    # per-member dense kind, occupancy 1 — still the batch plane)
+    assert tel["data_plane"] == "batch"
     names = [p["name"] for p in tel["phases"]]
     for phase in ("queue_wait", "rewrite", "device_dispatch", "demux"):
         assert phase in names, names
@@ -198,8 +200,8 @@ def test_profile_span_tree_shape(cluster):
     assert coord["data_plane"] == "fanout"
 
     # the mesh-served fan-out keeps the existing per-shard profile
-    # surface (profile is mesh/batch-ineligible: it routes solo, so the
-    # span tree is the solo path's — data plane label included)
+    # surface (profile is mesh-ineligible: each shard query rides the
+    # batcher's dense kind, so the span tree is the member's)
     resp = _search(c, "tm", {"query": {"match": {"body": "w1"}},
                              "size": 5, "profile": True})
     assert len(resp["profile"]["shards"]) == 3
@@ -224,16 +226,24 @@ def test_every_class_every_plane_produces_traces(cluster):
     _wave(c, "tm", bodies)         # mesh
     _wave(c, "ts", bodies)         # batch (concurrent wave coalesces)
     _wave(c, "ts", [hybrid])       # hybrid coordinator trace
-    _set(c, {"search.batch.enabled": False})
+    # the shard batcher is THE transport execution path now; the
+    # embedded single-shard SearchService keeps the solo label (and the
+    # plane relabel when the shard's plane is resident), so drive it
+    # directly for those planes
+    from elasticsearch_tpu.search.service import SearchService
+    engine = c.nodes["node0"].search_transport.indices.shard(
+        "ts", 0).engine
+    svc = SearchService(engine, "ts")
+    for b in bodies:
+        svc.search(copy.deepcopy(b))   # plane (>= 2 segments, plane on)
+    _set(c, {"search.plane.enabled": False})
     try:
+        _search(c, "ts", bodies[0])    # applies plane config process-wide
         for b in bodies:
-            _search(c, "ts", b)    # plane (>= 2 segments, plane on)
-        _set(c, {"search.plane.enabled": False})
-        for b in bodies:
-            _search(c, "ts", b)    # solo (plane off too)
+            svc.search(copy.deepcopy(b))   # solo (plane off)
     finally:
-        _set(c, {"search.batch.enabled": None,
-                 "search.plane.enabled": None})
+        _set(c, {"search.plane.enabled": None})
+        _search(c, "ts", bodies[0])
 
     snap = TELEMETRY.snapshot()
     classes = snap["classes"]
@@ -244,10 +254,12 @@ def test_every_class_every_plane_produces_traces(cluster):
             entry = classes[key]
             assert entry["queries"] >= 1
             assert entry["latency"]["count"] >= 1
-            for span in ("queue_wait", "device_dispatch"):
+            spans = ("device_dispatch",) if plane == "solo" \
+                else ("queue_wait", "device_dispatch")
+            for span in spans:
                 assert span in entry["spans"], (key, entry["spans"])
                 assert entry["spans"][span]["count"] >= 1
-    # the plane-backed solo path relabels to the "plane" data plane
+    # the plane-backed embedded path relabels to the "plane" data plane
     assert any(k.endswith("|plane") for k in classes), sorted(classes)
     # mesh/batch traces carry real device-dispatch counts
     assert classes["bm25|mesh"]["device_dispatches"] >= 1
@@ -304,20 +316,24 @@ def test_typed_fallback_reasons_for_routing_decisions(cluster):
 
 
 def test_batch_drain_failure_counts_typed_reason(cluster, monkeypatch):
-    """A batch-path failure degrades to per-member solo execution AND
-    counts under a typed reason — never a bare or unknown count."""
+    """A shared-drain failure degrades to the occupancy-1 re-drain lane
+    AND counts under a typed reason — never a bare or unknown count."""
     c = cluster
     sts = c.nodes["node0"].search_transport
     batcher = sts.batcher
     before = TELEMETRY.fallbacks.get("batch_exec_error", 0)
 
+    orig = batcher._execute
+
     def boom(key, live):
-        raise RuntimeError("injected batch failure")
+        if len(live) > 1:        # the shared drain fails; the
+            raise RuntimeError("injected batch failure")
+        return orig(key, live)   # occupancy-1 re-drain succeeds
     monkeypatch.setattr(batcher, "_execute", boom)
     reqs = [{"index": "ts", "shard": 0, "window": 5,
              "body": {"query": {"match": {"body": f"w{i}"}}}}
             for i in range(3)]
-    deferreds = [batcher.try_enqueue(r) for r in reqs]
+    deferreds = [batcher.enqueue(r) for r in reqs]
     assert all(d is not None for d in deferreds)
     results = [None] * len(reqs)
     for i, d in enumerate(deferreds):
@@ -365,7 +381,7 @@ def test_tasks_show_phase_and_data_plane_in_flight(cluster):
     batcher = sts.batcher
     req = {"index": "ts", "shard": 0, "window": 5,
            "body": {"query": {"match": {"body": "w1 w2"}}}}
-    deferred = batcher.try_enqueue(dict(req))
+    deferred = batcher.enqueue(dict(req))
     assert deferred is not None
     member = next(m for q in batcher._queues.values() for m in q)
     # queued members are visible as such before the drain
