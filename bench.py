@@ -1194,6 +1194,119 @@ def cfg_segmented(np, jax, jnp, result):
 
 # ---------------------------------------------------------------------------
 
+def cfg_overload(np, jax, jnp, result):
+    """Overload scenario (ROADMAP item 3): offered load >> capacity
+    against a real in-process node. Capacity is pinned tiny (2 slots, a
+    6-deep queue, 25ms simulated drain service via the chaos seam) so
+    saturation is reached at bench scale; the emitted block carries the
+    acceptance contract directly:
+      - ``p99_bounded``: p99 of ADMITTED searches stays within a bounded
+        factor of the unloaded p99 (the queue bounds latency)
+      - ``zero_unhandled_errors``: every rejected request is a clean 429
+        RejectedExecutionError with a computed Retry-After
+      - ``bg_retains_goodput``: a background tenant keeps nonzero
+        goodput while a hot tenant floods (weighted-fair shedding)
+    All timing is virtual (deterministic scheduler): seed-reproducible
+    and wall-cheap."""
+    from elasticsearch_tpu.testing import InProcessCluster
+    from elasticsearch_tpu.utils.errors import RejectedExecutionError
+    c = InProcessCluster(n_nodes=1, seed=6)
+    c.start()
+    try:
+        client = c.client()
+        node = c.nodes["node0"]
+        rng = np.random.default_rng(SEED + 11)
+        for index in ("hot", "bg"):
+            done = []
+            client.create_index(index, {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": {"properties": {"body": {"type": "text"}}}},
+                lambda r, e=None, d=done: d.append(1))
+            c.run_until(lambda: bool(done), 120.0)
+            c.ensure_green(index)
+            for i in range(32):
+                box = []
+                client.index_doc(index, f"d{i}", {
+                    "body": " ".join(f"w{int(x)}" for x in
+                                     rng.integers(0, 16, 6))},
+                    lambda r, e=None, b=box: b.append(1))
+                c.run_until(lambda: bool(box), 120.0)
+            box = []
+            client.refresh(index, lambda r, e=None, b=box: b.append(1))
+            c.run_until(lambda: bool(box), 120.0)
+
+        service_s = 0.025
+        c.constrain_search_admission(size=2, queue=6)
+        c.slow_node_drains("node0", service_s)
+        sched = c.scheduler
+
+        def run_search(index, sink):
+            t0 = sched.now()
+
+            def cb(resp, err=None):
+                sink.append((index, sched.now() - t0, err))
+            client.search(index, {"query": {"match": {"body": "w1 w2"}},
+                                  "size": 5}, cb)
+
+        def p99_of(lats):
+            data = sorted(lats)
+            return data[int(0.99 * (len(data) - 1))] if data else 0.0
+
+        # unloaded p99: sequential traffic, same capacity + service time
+        seq = []
+        for _ in range(24):
+            before = len(seq)
+            run_search("hot", seq)
+            c.run_until(lambda: len(seq) > before, 120.0)
+        unloaded_p99 = p99_of(
+            [lat for _i, lat, err in seq if err is None])
+
+        # overload: a 120-search hot flood inside 24ms of virtual time,
+        # a 12-search background tenant staggered through it
+        out = []
+        for i in range(120):
+            sched.schedule(i * 0.0002, lambda: run_search("hot", out))
+        for i in range(12):
+            sched.schedule(0.001 + i * 0.003,
+                           lambda: run_search("bg", out))
+        c.run_until(lambda: len(out) == 132, 600.0)
+
+        admitted = [(idx, lat) for idx, lat, err in out if err is None]
+        rejected = [err for _idx, _lat, err in out if err is not None]
+        clean = [e for e in rejected
+                 if isinstance(e, RejectedExecutionError)
+                 and getattr(e, "status", None) == 429
+                 and int((getattr(e, "metadata", None) or {})
+                         .get("retry_after", 0)) >= 1]
+        admitted_p99 = p99_of([lat for _idx, lat in admitted])
+        factor = admitted_p99 / max(unloaded_p99, 1e-6)
+        bg_ok = sum(1 for idx, _lat in admitted if idx == "bg")
+        pool = node.thread_pool.pool("search")
+        result["configs"]["overload"] = {
+            "offered": len(out),
+            "capacity_slots": 2,
+            "queue_limit": 6,
+            "service_ms": service_s * 1000.0,
+            "unloaded_p99_ms": round(unloaded_p99 * 1000.0, 3),
+            "admitted": len(admitted),
+            "admitted_p99_ms": round(admitted_p99 * 1000.0, 3),
+            "p99_factor_vs_unloaded": round(factor, 2),
+            "p99_bounded": bool(factor <= 8.0),
+            "rejected": len(rejected),
+            "rejected_clean_429_retry_after": len(clean),
+            "zero_unhandled_errors": len(clean) == len(rejected),
+            "bg_goodput": bg_ok,
+            "hot_goodput": sum(1 for idx, _lat in admitted
+                               if idx == "hot"),
+            "bg_retains_goodput": bg_ok > 0,
+            "rejections_by_tenant": dict(pool.rejected_by_tenant),
+            "retry_after_last_s": pool.last_retry_after_s,
+        }
+    finally:
+        c.stop()
+
+
 def multichip_scaling(per_shard_docs: int = 0, q_batch: int = 8,
                       iters: int = 3) -> dict:
     """Mesh-sharded plane capacity scaling (ROADMAP item 2's target):
@@ -1461,6 +1574,7 @@ def main() -> None:
                          ("ivf", cfg_ivf), ("hybrid", cfg_hybrid),
                          ("sparse", cfg_sparse), ("aggs", cfg_aggs),
                          ("segmented", cfg_segmented),
+                         ("overload", cfg_overload),
                          ("multichip", cfg_multichip)):
             try:
                 if name == "hybrid":

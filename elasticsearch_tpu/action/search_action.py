@@ -768,6 +768,11 @@ class TransportSearchAction:
         """One target per shard with an ordered list of copies to try —
         the shard iterator (GroupShardsIterator): a failed copy fails over
         to the next (AbstractSearchAsyncAction.onShardFailure)."""
+        from elasticsearch_tpu.utils.settings import (
+            CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION, setting_from_state,
+        )
+        use_ars = setting_from_state(
+            state, CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION)
         targets = []
         for index in indices:
             if not state.routing_table.has_index(index):
@@ -781,29 +786,89 @@ class TransportSearchAction:
                         f"no active copy for [{index}][{sid}]")
                 # round-robin rotation first (fairness among equals), then
                 # the adaptive rank reorders once real observations exist
+                # (cluster.routing.use_adaptive_replica_selection=false
+                # keeps pure rotation — the chaos baseline)
                 self._rr += 1
                 rot = self._rr % len(copies)
                 copies = copies[rot:] + copies[:rot]
-                copies = self.response_collector.order_copies(copies)
+                if use_ars:
+                    copies = self.response_collector.order_copies(copies)
                 targets.append({"index": index, "shard": sid,
                                 "node": copies[0], "copies": copies})
+        if use_ars and targets:
+            # recovery decay, once per SEARCH (not per shard): nodes
+            # that held copies but won no shard drift back into
+            # contention so a healed node isn't starved forever
+            winners = {t["node"] for t in targets}
+            losers = {c for t in targets
+                      for c in t["copies"]} - winners
+            if losers:
+                self.response_collector.decay_unselected(winners, losers)
         return targets
 
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
 
+    def _refresh_admission(self) -> None:
+        """Apply the dynamic search.admission.* settings to the search
+        pool when the cluster state has CHANGED since the last search
+        (version-keyed — the hot admission path pays one attribute
+        compare, not a settings scan + four parses per request). Pools
+        left untouched when the operator has set NONE of the keys, so
+        test harnesses that size pools directly keep their
+        configuration."""
+        try:
+            state = self.state() if self.state is not None else None
+            if state is None:
+                return
+            version = getattr(state, "version", None)
+            if version is not None and \
+                    version == getattr(self, "_admission_version", None):
+                return
+            self._admission_version = version
+            settings = state.metadata.persistent_settings
+            present = any(str(k).startswith("search.admission.")
+                          for k in settings)
+            if not present:
+                if not getattr(self, "_admission_applied", False):
+                    # never configured through settings: keep hands off
+                    # pools sized directly (test harnesses)
+                    return
+                # the operator REMOVED the keys: fall through once so
+                # setting_from_state re-applies the documented defaults
+            self._admission_applied = present
+            from elasticsearch_tpu.utils.settings import (
+                SEARCH_ADMISSION_FRAME, SEARCH_ADMISSION_QUEUE_MAX,
+                SEARCH_ADMISSION_QUEUE_MIN,
+                SEARCH_ADMISSION_TARGET_LATENCY, setting_from_state,
+            )
+            self.thread_pool.configure_search_admission(
+                target_latency_s=setting_from_state(
+                    state, SEARCH_ADMISSION_TARGET_LATENCY),
+                min_queue=setting_from_state(
+                    state, SEARCH_ADMISSION_QUEUE_MIN),
+                max_queue=setting_from_state(
+                    state, SEARCH_ADMISSION_QUEUE_MAX),
+                frame_size=setting_from_state(
+                    state, SEARCH_ADMISSION_FRAME))
+        except Exception:  # noqa: BLE001 — a bad admission setting must
+            pass           # never fail (or wedge) the serving path
+
     def execute(self, index_expression: str, body: Dict[str, Any],
                 on_done: DoneFn, search_type: str = "query_then_fetch"
                 ) -> None:
         # coordinator-side admission: the whole async search occupies one
         # "search" pool slot — runs inline when a slot is free, queues
-        # within bounds, 429s beyond them (ThreadPool search-pool
-        # rejection analog)
+        # within per-tenant-fair bounds, 429s (with a computed
+        # Retry-After) beyond them. Shedding binds HERE, at fan-out
+        # entry: a saturated node refuses NEW searches while every
+        # already-admitted fan-out runs to completion undisturbed.
         if self.thread_pool is None:
             self._execute_admitted(index_expression, body, on_done,
                                    search_type)
             return
+        self._refresh_admission()
         released = {"done": False}
         inner_admit = on_done
 
@@ -823,7 +888,13 @@ class TransportSearchAction:
                 releasing_done(None, e)
 
         try:
-            self.thread_pool.submit("search", admitted_task)
+            # the tenant key is the index expression: one hot index's
+            # flood fills only its fair share of the queue, and a queued
+            # hot-tenant search can be DISPLACED (on_reject fires) to
+            # admit a starved background tenant
+            self.thread_pool.submit(
+                "search", admitted_task, tenant=index_expression or "_all",
+                on_reject=lambda e: inner_admit(None, e))
         except Exception as e:  # noqa: BLE001 — backpressure
             inner_admit(None, e)
 
@@ -1227,12 +1298,41 @@ class TransportSearchAction:
                 req.update(dfs_overrides)
             copies = target.get("copies", [target["node"]])
             node = copies[copy_idx]
-            t_sent = time.monotonic()
+            scheduler = self.ts.transport.scheduler
+            # scheduler time, not wall: the round trip then includes the
+            # transport's (possibly simulated) latency, so replica
+            # ranking — and the wire/service split below — behaves
+            # identically under the deterministic harness and production
+            t_sent = scheduler.now()
             self.response_collector.on_send(node)
 
             def cb(resp, err):
+                rtt_s = scheduler.now() - t_sent
+                # C3 feedback: the shard response piggybacks the node's
+                # self-reported queue depth and service-time EWMA — feed
+                # them to the collector so order_copies can route around
+                # a SATURATED node, not just a slow wire
+                pressure = resp.get("pressure") \
+                    if err is None and isinstance(resp, dict) else None
                 self.response_collector.on_response(
-                    node, time.monotonic() - t_sent, failed=err is not None)
+                    node, rtt_s, failed=err is not None,
+                    service_ms=(pressure or {}).get("service_ewma_ms"),
+                    queue_depth=(pressure or {}).get("queue"))
+                if err is None and isinstance(resp, dict) and \
+                        resp.get("took_ms") is not None and \
+                        phase_state.get("trace") is not None:
+                    # wire vs service split: the shard reports its own
+                    # took (arrival -> delivery), the coordinator
+                    # subtracts it from the round trip — shown per shard
+                    # in the profile:true coordinator tree
+                    took_ms = float(resp["took_ms"])
+                    wire_ms = max(rtt_s * 1000.0 - took_ms, 0.0)
+                    phase_state["trace"].add_span(
+                        "shard_query", max(int(rtt_s * 1e9), 1),
+                        {"index": target["index"],
+                         "shard": target["shard"], "node": node,
+                         "service_ms": round(took_ms, 3),
+                         "wire_ms": round(wire_ms, 3)})
                 if phase_state.get("aborted") or \
                         phase_state.get("budget_expired"):
                     return   # the phase already completed without us

@@ -48,6 +48,9 @@ class ChildBreaker:
         self.trip_count = 0
         self._parent = parent
         self._lock = threading.Lock()
+        # observe() scopes currently watching this breaker's high-water
+        # mark (normally empty — one list check on the charge path)
+        self._observers: list = []
 
     def add_estimate(self, n_bytes: int, label: str = "<unknown>") -> None:
         n_bytes = int(n_bytes)
@@ -60,6 +63,9 @@ class ChildBreaker:
                     f"[{new_used}/{_h(new_used)}] which is larger than the "
                     f"limit of [{self.limit}/{_h(self.limit)}]")
             self.used = new_used
+            for obs in self._observers:
+                if new_used > obs.peak:
+                    obs.peak = new_used
         if self._parent is not None:
             try:
                 self._parent.check_parent(n_bytes, label)
@@ -81,11 +87,38 @@ class ChildBreaker:
         finally:
             self.release(n_bytes)
 
+    @contextmanager
+    def observe(self):
+        """Watch the breaker's high-water mark for the duration of one
+        operation: ``obs.peak - obs.base`` after the scope is the charge
+        the operation actually added (outer transients plus everything
+        charged inside them). Pure observation — never refuses work —
+        so callers can feed MEASURED costs back into their own
+        admission estimates (the shard batcher's per-key cap)."""
+        obs = _ChargeObservation(self.used)
+        with self._lock:
+            self._observers.append(obs)
+        try:
+            yield obs
+        finally:
+            with self._lock:
+                self._observers.remove(obs)
+
     def stats(self) -> Dict[str, Any]:
         return {"limit_size_in_bytes": self.limit,
                 "estimated_size_in_bytes": self.used,
                 "overhead": self.overhead,
                 "tripped": self.trip_count}
+
+
+class _ChargeObservation:
+    """One observe() scope's view: ``base`` at entry, ``peak`` high-water."""
+
+    __slots__ = ("base", "peak")
+
+    def __init__(self, base: int):
+        self.base = base
+        self.peak = base
 
 
 def _h(n: int) -> str:

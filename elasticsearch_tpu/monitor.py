@@ -206,6 +206,34 @@ def search_batch_stats(batcher, rrf_fuser=None) -> Dict[str, Any]:
     return out
 
 
+def search_admission_stats(thread_pool, response_collector=None,
+                           batcher=None,
+                           ars_stats=None) -> Dict[str, Any]:
+    """Overload-control observability (utils/threadpool.py +
+    action/response_collector.py + the shard batcher's pressure
+    tracker): the search pool's live queue bounds and adaptive-resize
+    state, rejections by tenant key, the Retry-After values issued, the
+    node's own self-reported pressure, and the C3 rank inputs per node —
+    everything an operator needs to explain WHY a request was shed or a
+    replica skipped, from the stats surface alone."""
+    if thread_pool is None:
+        return {}
+    pool = thread_pool.pools.get("search")
+    if pool is None:
+        return {}
+    out: Dict[str, Any] = pool.admission_stats()
+    if batcher is not None:
+        out["node_pressure"] = batcher.node_pressure.snapshot(
+            batcher.queue_depth())
+    # the caller may pass the already-built rank-input map (node stats
+    # serves it under adaptive_selection too — compute once per call)
+    if ars_stats is None and response_collector is not None:
+        ars_stats = response_collector.stats()
+    if ars_stats is not None:
+        out["ars"] = ars_stats
+    return out
+
+
 def search_latency_stats() -> Dict[str, Any]:
     """Search telemetry plane observability (search/telemetry.py
     TELEMETRY): ring-buffer latency histograms (p50/p95/p99 + span-level

@@ -120,7 +120,10 @@ class Node:
         self.task_results: Dict[str, Any] = {}
 
         from elasticsearch_tpu.utils.threadpool import ThreadPoolService
-        self.thread_pool = ThreadPoolService()
+        # scheduler-clocked: the Little's-law frame measurement (and the
+        # Retry-After rates derived from it) then work identically under
+        # the deterministic virtual-time harness and production
+        self.thread_pool = ThreadPoolService(now_fn=scheduler.now)
 
         self.shard_bulk = TransportShardBulkAction(
             node_id, self.indices_service, self.transport_service, scheduler,
@@ -254,7 +257,9 @@ class Node:
         # per-node stats endpoint (TransportNodesStatsAction node-level
         # handler): the coordinating node fans `_nodes/stats` out here
         self.transport_service.register_handler(
-            NODE_STATS_ACTION, lambda req, sender: self.local_node_stats())
+            NODE_STATS_ACTION,
+            lambda req, sender: self.local_node_stats(
+                sections=(req or {}).get("sections")))
         # master-routed health (TransportClusterHealthAction analog): the
         # unverified-STARTED gate is master-only state, so every node
         # answers health FROM the master's view, not its own
@@ -287,42 +292,69 @@ class Node:
     def _applied_state(self) -> ClusterState:
         return self.coordinator.applied_state
 
-    def local_node_stats(self) -> Dict[str, Any]:
+    def local_node_stats(self, sections=None) -> Dict[str, Any]:
+        """All stats sections, or — when ``sections`` names some — only
+        those, built lazily: a caller merging one section across the
+        fleet (``_cluster/stats``'s search_latency view) must not make
+        every node walk /proc, the device backend and every shard."""
         from elasticsearch_tpu.indices.breaker import BREAKERS
         from elasticsearch_tpu import monitor
-        return {
-            "name": self.node_id,
-            "indices": self.indices_service.stats(),
-            "transport": dict(self.transport_service.stats),
-            "breakers": BREAKERS.stats(),
-            "thread_pool": self.thread_pool.stats(),
-            "adaptive_selection":
-                self.search_action.response_collector.stats(),
+
+        # the C3 rank inputs serve two sections (adaptive_selection and
+        # search_admission.ars) — build them at most once per call
+        ars_cache: Dict[str, Any] = {}
+
+        def ars_stats():
+            if "v" not in ars_cache:
+                ars_cache["v"] = \
+                    self.search_action.response_collector.stats()
+            return ars_cache["v"]
+
+        builders = {
+            "indices": lambda: self.indices_service.stats(),
+            "transport": lambda: dict(self.transport_service.stats),
+            "breakers": BREAKERS.stats,
+            "thread_pool": self.thread_pool.stats,
+            "adaptive_selection": ars_stats,
+            # overload control plane: adaptive queue bounds, per-tenant
+            # rejections, Retry-After values, node pressure + ARS rank
+            # inputs (utils/threadpool.py + response_collector.py)
+            "search_admission": lambda: monitor.search_admission_stats(
+                self.thread_pool,
+                batcher=self.search_transport.batcher,
+                ars_stats=ars_stats()),
             # real probes (OsProbe/ProcessProbe/FsProbe analogs + the
             # device/HBM dimension the reference lacks)
-            "os": monitor.os_stats(),
-            "process": monitor.process_stats(),
-            "fs": monitor.fs_stats(self.indices_service.data_path),
-            "device": monitor.device_stats(),
+            "os": monitor.os_stats,
+            "process": monitor.process_stats,
+            "fs": lambda: monitor.fs_stats(self.indices_service.data_path),
+            "device": monitor.device_stats,
             # packed multi-segment plane residency/rebuild/eviction
             # counters (ops/device_segment.py PlaneRegistry)
-            "device_plane": monitor.device_plane_stats(),
+            "device_plane": monitor.device_plane_stats,
             # mesh-sharded plane residency + SPMD fan-out executor
             # counters (MeshPlaneRegistry + search/mesh_executor.py)
-            "mesh_plane": monitor.mesh_plane_stats(
+            "mesh_plane": lambda: monitor.mesh_plane_stats(
                 self.search_transport.mesh_executor),
             # cross-query micro-batching occupancy/wait/dispatch/memo/
             # window-controller counters + coordinator RRF fusion batching
-            "search_batch": monitor.search_batch_stats(
+            "search_batch": lambda: monitor.search_batch_stats(
                 self.search_transport.batcher,
                 rrf_fuser=self.search_action.rrf_fuser),
             # per-(query class x data plane) latency histograms + the
             # typed fallback-reason taxonomy (search/telemetry.py)
-            "search_latency": monitor.search_latency_stats(),
+            "search_latency": monitor.search_latency_stats,
             # gateway shard-state fetch counters (fetches issued, cache
             # hits, copies reported none/corrupted/stale, reconciles)
-            "gateway": monitor.gateway_stats(self.gateway_allocator),
+            "gateway": lambda: monitor.gateway_stats(
+                self.gateway_allocator),
         }
+        want = None if sections is None else set(sections)
+        out: Dict[str, Any] = {"name": self.node_id}
+        for name, build in builders.items():
+            if want is None or name in want:
+                out[name] = build()
+        return out
 
     def _on_committed(self, state: ClusterState) -> None:
         # appliers are isolated from each other: a reconciler failure (e.g. a
@@ -1214,9 +1246,14 @@ class NodeClient:
         """Local node's stats only (the historical sync form)."""
         return {"nodes": {self.node.node_id: self.node.local_node_stats()}}
 
-    def nodes_stats_all(self, on_done) -> None:
+    def nodes_stats_all(self, on_done, sections=None,
+                        timeout: float = 30.0) -> None:
         """Every cluster node's stats, gathered over transport
-        (TransportNodesStatsAction fan-out)."""
+        (TransportNodesStatsAction fan-out). ``sections`` narrows the
+        request so single-section consumers (the _cluster/stats
+        search_latency merge) don't make every node build its full
+        stats payload; they also pass a short ``timeout`` so one dead
+        node can't stall the endpoint for the full 30s."""
         state = self.node._applied_state()
         node_ids = sorted(state.nodes)
         out: Dict[str, Any] = {}
@@ -1224,6 +1261,7 @@ class NodeClient:
         if not node_ids:
             on_done({"nodes": {}}, None)
             return
+        req = {"sections": list(sections)} if sections else {}
         for nid in node_ids:
             def cb(resp, err, nid=nid):
                 if err is None and resp is not None:
@@ -1236,10 +1274,10 @@ class NodeClient:
                                             len(node_ids) - len(out)},
                              "nodes": out}, None)
             if nid == self.node.node_id:
-                cb(self.node.local_node_stats(), None)
+                cb(self.node.local_node_stats(sections=sections), None)
             else:
                 self.node.transport_service.send_request(
-                    nid, NODE_STATS_ACTION, {}, cb, timeout=30.0)
+                    nid, NODE_STATS_ACTION, req, cb, timeout=timeout)
 
 
 def _shards_only(r: Dict[str, Any]) -> Dict[str, Any]:

@@ -127,7 +127,8 @@ class RestController:
         try:
             handler(request, on_done)
         except SearchEngineError as e:
-            on_done(e.status, _error_body(_error_type(e), str(e), e.status))
+            on_done(e.status, _error_body(_error_type(e), str(e), e.status,
+                                          retry_after=_retry_after_of(e)))
         except Exception as e:  # noqa: BLE001 — uniform 500 mapping
             traceback.print_exc()
             on_done(500, _error_body(type(e).__name__, str(e), 500))
@@ -138,24 +139,43 @@ def _error_type(e: Exception) -> str:
     return exception_type_name(type(e).__name__)
 
 
-def _error_body(err_type: str, reason: str, status: int) -> Dict[str, Any]:
-    return {"error": {"type": err_type, "reason": reason,
-                      "root_cause": [{"type": err_type, "reason": reason}]},
-            "status": status}
+def _retry_after_of(err: Exception) -> Optional[int]:
+    """The computed Retry-After a rejection carries in its metadata
+    (admission pool rejections set it — metadata also survives the
+    transport's to_json relay); None for every other error."""
+    value = (getattr(err, "metadata", None) or {}).get("retry_after")
+    try:
+        return int(value) if value is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _error_body(err_type: str, reason: str, status: int,
+                retry_after: Optional[int] = None) -> Dict[str, Any]:
+    error: Dict[str, Any] = {
+        "type": err_type, "reason": reason,
+        "root_cause": [{"type": err_type, "reason": reason}]}
+    if retry_after is not None:
+        # mirrored into the HTTP Retry-After header by the server
+        error["retry_after"] = retry_after
+    return {"error": error, "status": status}
 
 
 def respond_error(on_done: Callable[[int, Any], None],
                   err: Exception) -> None:
     status = getattr(err, "status", 500)
+    retry_after = _retry_after_of(err)
     # surface the ORIGINAL error type for errors relayed across transport
     cause_type = getattr(err, "cause_type", "")
     if cause_type:
         from elasticsearch_tpu.utils.errors import exception_type_name
         reason = getattr(err, "cause_reason", str(err))
         on_done(status, _error_body(exception_type_name(cause_type),
-                                    reason, status))
+                                    reason, status,
+                                    retry_after=retry_after))
         return
-    on_done(status, _error_body(_error_type(err), str(err), status))
+    on_done(status, _error_body(_error_type(err), str(err), status,
+                                retry_after=retry_after))
 
 
 def wrap_client_cb(on_done: Callable[[int, Any], None],

@@ -1350,30 +1350,59 @@ def build_controller(client: NodeClient) -> RestController:
             shard_stats = resp.get("_shards", {})
 
             def emit(h) -> None:
-                done(200, {
-                    "cluster_name": state.cluster_name,
-                    "status": h["status"],
-                    # partial stat collection must be VISIBLE: failed > 0
-                    # means docs.count undercounts
-                    "_shards": {
-                        "total": shard_stats.get("total", 0),
-                        "successful": shard_stats.get("successful", 0),
-                        "failed": shard_stats.get("failed", 0)},
-                    "indices": {
-                        "count": n_indices,
-                        "shards": {"total": total_active,
-                                   "primaries": primaries,
-                                   "replication":
-                                       ((total_active - primaries) /
-                                        primaries) if primaries else 0.0},
-                        "docs": {"count": docs},
-                    },
-                    "nodes": {
-                        "count": {"total": len(state.nodes),
-                                  **role_counts},
-                        "versions": [__version__],
-                    },
-                })
+                def finish(ns_resp, _err=None) -> None:
+                    # fleet view of the per-node latency histograms:
+                    # raw exponential buckets merged across every
+                    # node's search_latency section, percentiles
+                    # recomputed from the merged distribution (the
+                    # nodes-stats aggregation leg — PR 8 follow-up)
+                    merged: Dict[str, Any] = {}
+                    try:
+                        from elasticsearch_tpu.search.telemetry import (
+                            merge_latency_sections,
+                        )
+                        merged = merge_latency_sections(
+                            [n.get("search_latency") or {}
+                             for n in (ns_resp or {}).get(
+                                 "nodes", {}).values()])
+                    except Exception:  # noqa: BLE001 — stats must serve
+                        merged = {}
+                    done(200, {
+                        "cluster_name": state.cluster_name,
+                        "status": h["status"],
+                        # partial stat collection must be VISIBLE:
+                        # failed > 0 means docs.count undercounts
+                        "_shards": {
+                            "total": shard_stats.get("total", 0),
+                            "successful": shard_stats.get(
+                                "successful", 0),
+                            "failed": shard_stats.get("failed", 0)},
+                        "indices": {
+                            "count": n_indices,
+                            "shards": {"total": total_active,
+                                       "primaries": primaries,
+                                       "replication":
+                                           ((total_active - primaries) /
+                                            primaries)
+                                           if primaries else 0.0},
+                            "docs": {"count": docs},
+                        },
+                        "nodes": {
+                            "count": {"total": len(state.nodes),
+                                      **role_counts},
+                            "versions": [__version__],
+                        },
+                        "search_latency": merged,
+                    })
+                # section-filtered fan-out: every node builds ONLY its
+                # search_latency section for this merge, not the full
+                # probe walk (/proc, device backend, every shard) — and
+                # a short timeout so a dead-but-still-in-state node
+                # can't stall a polled monitoring endpoint for 30s (the
+                # merge tolerates missing nodes)
+                client.nodes_stats_all(finish,
+                                       sections=("search_latency",),
+                                       timeout=5.0)
 
             # status through the master-routed health path (the
             # unverified-STARTED gate lives on the elected master only; a

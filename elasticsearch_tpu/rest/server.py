@@ -30,6 +30,21 @@ class _BadRequest(Exception):
     closes (the HTTP pipeline can't resync after a framing error)."""
 
 
+def retry_after_of(status: int, body: Any) -> Optional[int]:
+    """Seconds for the HTTP Retry-After header of a 429 response whose
+    error body carries the admission layer's computed value; None
+    otherwise (no header). Pure so the header contract is unit-testable
+    without a socket."""
+    if status != 429 or not isinstance(body, dict):
+        return None
+    value = (body.get("error") or {}).get("retry_after") \
+        if isinstance(body.get("error"), dict) else None
+    try:
+        return max(0, int(value)) if value is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
 class HttpServer:
     def __init__(self, client: NodeClient, host: str = "127.0.0.1",
                  port: int = 9200,
@@ -225,6 +240,11 @@ class HttpServer:
             # the reference's HeaderWarning shape: 299 + agent + quoted
             safe = message.replace('"', "'")
             warning_lines += f'Warning: 299 elasticsearch-tpu "{safe}"\r\n'
+        retry_after = retry_after_of(status, body)
+        if retry_after is not None:
+            # load-shed responses tell clients HOW LONG to back off (the
+            # admission pool computes it from its measured drain rate)
+            warning_lines += f"Retry-After: {retry_after}\r\n"
         head_lines = (f"HTTP/1.1 {status} {reason}\r\n"
                       f"content-type: {ctype}\r\n"
                       f"content-length: {len(payload)}\r\n"

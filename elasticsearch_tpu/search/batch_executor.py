@@ -628,6 +628,16 @@ class ShardQueryBatcher:
         self.sts = sts
         self._queues: Dict[Tuple, List[_Member]] = {}
         self._timers: Dict[Tuple, Any] = {}
+        # self-reported pressure (search/service.py NodePressure):
+        # queue depth + in-flight + service-time EWMA, piggybacked on
+        # every shard query response for C3 replica selection
+        from elasticsearch_tpu.search.service import NodePressure
+        self.node_pressure = NodePressure()
+        # chaos seam: > 0 delays every drain's DELIVERY by this many
+        # scheduler seconds and counts itself into the observed service
+        # time — a saturated/slow data node without touching the wire
+        # (the overload chaos suite's slow-node-reroute scenario)
+        self.fault_drain_delay_s = 0.0
         # per-key controller state: {"last": <dispatch time>, "window":
         # <current adaptive collection window, seconds>, "max_size":
         # <HBM-pressure-adapted cap, None = the setting>} — the
@@ -653,6 +663,9 @@ class ShardQueryBatcher:
             # adaptive per-key max_size under HBM pressure
             "max_size_shrinks": 0,
             "max_size_grows": 0,
+            # breaker-charge feedback: caps shrunk from the OBSERVED
+            # per-drain charge before any trip (PR 9 follow-up)
+            "max_size_preshrinks": 0,
             # request-cache hits answered AT INTAKE (no collection wait)
             "request_cache_intake_hits": 0,
         }
@@ -682,12 +695,33 @@ class ShardQueryBatcher:
     def _key_max_size(self, key: Tuple) -> int:
         """Effective per-key drain cap: the setting, shrunk while the
         key is under HBM pressure (breaker trips halve it; successful
-        full drains regrow it)."""
+        full drains regrow it) — and PRE-shrunk from the breaker's
+        OBSERVED per-drain charge: once a drain has measured what one
+        member actually costs, the cap stops growing past what the
+        current breaker headroom can admit, so the adaptive max_size
+        reacts before the first trip instead of after."""
         cap = self.max_size()
         st = self._key_state.get(key)
         if st is not None and st.get("max_size"):
-            return min(cap, int(st["max_size"]))
+            cap = min(cap, int(st["max_size"]))
+        per = st.get("charge_per_member") if st is not None else None
+        if per:
+            from elasticsearch_tpu.indices.breaker import BREAKERS
+            breaker = BREAKERS.breaker("request")
+            if breaker.limit > 0:
+                # 0.8: leave slack for drain-mates' transients so the
+                # estimate errs toward shrinking, never toward a trip
+                headroom = max(breaker.limit - breaker.used, 0) * 0.8
+                fit = max(1, int(headroom // per))
+                if fit < cap:
+                    cap = fit
+                    self.stats["max_size_preshrinks"] += 1
         return cap
+
+    def queue_depth(self) -> int:
+        """Queued (not yet drained) members across every key — the
+        node's search-queue depth in the pressure piggyback."""
+        return sum(len(q) for q in self._queues.values())
 
     # -- intake ---------------------------------------------------------
 
@@ -864,6 +898,7 @@ class ShardQueryBatcher:
         self.stats["queries_dispatched"] += len(live)
         self.stats["max_occupancy"] = max(self.stats["max_occupancy"],
                                           len(live))
+        self.node_pressure.in_flight += len(live)
         now_ns = time.monotonic_ns()
         for m in live:
             self.stats["wait_ms_total"] += (now - m.enqueued_at) * 1e3
@@ -953,12 +988,46 @@ class ShardQueryBatcher:
                 t.add_span("device_dispatch", exec_ns, dict(meta))
                 t.finish()
                 TELEMETRY.observe(t)
-        for m in live:
-            self._finish(m)
+        # pressure observation + delivery: every surviving member's
+        # response carries the node's self-reported pressure (queue
+        # depth, in-flight, service-time EWMA) and its own shard took —
+        # the C3 feedback channel replica selection consumes. The chaos
+        # seam (fault_drain_delay_s) delays DELIVERY in scheduler time
+        # and counts itself into the observed service time.
+        service_ms = (time.monotonic_ns() - now_ns) / 1e6
+        delay = float(self.fault_drain_delay_s or 0.0)
+        if delay > 0.0:
+            service_ms += delay * 1000.0
+        self.node_pressure.observe(service_ms)
+        if delay > 0.0:
+            scheduler.schedule(delay, lambda: self._deliver(live))
+        else:
+            self._deliver(live)
         # traffic may have queued behind a full-size drain
         if self._queues.get(key) and key not in self._timers:
             self._timers[key] = scheduler.schedule(
                 0.0, lambda: self._drain(key))
+
+    def _deliver(self, members: List[_Member]) -> None:
+        """Resolve every drained member, stamping successful responses
+        with the shard ``took_ms`` (arrival -> delivery in scheduler
+        time — what the coordinator subtracts from its round trip to
+        split wire from service) and the node pressure snapshot."""
+        pressure = self.node_pressure
+        now = self._scheduler().now()
+        # ONE drain-consistent snapshot (taken while the drain's members
+        # still count as in flight) shared copy-on-write by every member
+        # — decrementing per member would make the last member report
+        # in_flight=0 from a fully busy node
+        snap = pressure.snapshot(self.queue_depth())
+        for m in members:
+            if m.error is None and isinstance(m.result, dict):
+                took_ms = max((now - m.enqueued_at) * 1e3, 0.0)
+                m.result = {**m.result, "took_ms": round(took_ms, 3),
+                            "pressure": snap}
+        pressure.in_flight = max(0, pressure.in_flight - len(members))
+        for m in members:
+            self._finish(m)
 
     def _set_phase(self, members: List[_Member], phase: str) -> None:
         """_tasks phase fidelity: a shard task shows its current
@@ -1034,30 +1103,43 @@ class ShardQueryBatcher:
         n_q = len(uniques)
         want = spec0.window
         self._set_phase(members, "dispatch")
-        if spec0.kind == "text":
-            transient = n_q * sum(
-                (P1_BUCKET * BLOCK * 8) + want * 8 for _ in ctxs)
-            with breaker.limit_scope(transient, "wand_topk_batch"):
-                results = batched_wand_topk_shard(
-                    ctxs, spec0.field,
-                    [u.spec.clauses for u in uniques], want,
-                    spec0.track_limit, check_members)
-            collector = "wand_topk"
-        elif spec0.kind == "knn":
-            transient = n_q * sum(8 * ctx.n_docs_pad for ctx in ctxs)
-            with breaker.limit_scope(transient, "knn_batch"):
-                results = batched_knn_shard(
-                    ctxs, spec0.field, [u.spec for u in uniques],
-                    spec0.k, check_members, stats=self.stats)
-            collector = "dense"
-        else:
-            # sparse charges at its dispatch sites (the plane executor's
-            # internal scope, or one score plane per segment) — an outer
-            # scope here would double-charge the plane path
-            results = batched_sparse_shard(
-                ctxs, spec0.field, [u.spec for u in uniques], want,
-                check_members)
-            collector = "dense"
+        # observe what the drain ACTUALLY charges (outer transient scope
+        # plus everything the executors charge inside it) so the per-key
+        # cap can pre-shrink from measurement instead of waiting for the
+        # first trip (_key_max_size consults charge_per_member)
+        with breaker.observe() as charge_obs:
+            if spec0.kind == "text":
+                transient = n_q * sum(
+                    (P1_BUCKET * BLOCK * 8) + want * 8 for _ in ctxs)
+                with breaker.limit_scope(transient, "wand_topk_batch"):
+                    results = batched_wand_topk_shard(
+                        ctxs, spec0.field,
+                        [u.spec.clauses for u in uniques], want,
+                        spec0.track_limit, check_members)
+                collector = "wand_topk"
+            elif spec0.kind == "knn":
+                transient = n_q * sum(8 * ctx.n_docs_pad for ctx in ctxs)
+                with breaker.limit_scope(transient, "knn_batch"):
+                    results = batched_knn_shard(
+                        ctxs, spec0.field, [u.spec for u in uniques],
+                        spec0.k, check_members, stats=self.stats)
+                collector = "dense"
+            else:
+                # sparse charges at its dispatch sites (the plane
+                # executor's internal scope, or one score plane per
+                # segment) — an outer scope here would double-charge the
+                # plane path
+                results = batched_sparse_shard(
+                    ctxs, spec0.field, [u.spec for u in uniques], want,
+                    check_members)
+                collector = "dense"
+        observed = max(charge_obs.peak - charge_obs.base, 0)
+        st = self._key_state.get(key)
+        if observed > 0 and st is not None:
+            per = observed / max(n_q, 1)
+            prev = st.get("charge_per_member")
+            st["charge_per_member"] = per if not prev else \
+                0.3 * per + 0.7 * prev
 
         self._set_phase(members, "demux")
         # response rows are copy-on-write: the docs payload of a memo'd

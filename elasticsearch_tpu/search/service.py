@@ -26,6 +26,39 @@ class SearchContextMissingError(SearchEngineError):
     status = 404
 
 
+class NodePressure:
+    """A data node's self-reported search pressure: in-flight member
+    count and a service-time EWMA measured inside the shard batcher's
+    drains. Snapshots piggyback on every shard query response (the C3
+    server-side feedback loop — ResponseCollectorService consumes them
+    on the coordinator), so replica selection sees a node SATURATING one
+    response before its round trips degrade, and can tell a slow wire
+    (service time small, response time large) from a slow node."""
+
+    ALPHA = 0.3
+
+    __slots__ = ("in_flight", "service_ewma_ms", "observations")
+
+    def __init__(self) -> None:
+        self.in_flight = 0
+        self.service_ewma_ms: Optional[float] = None
+        self.observations = 0
+
+    def observe(self, service_ms: float) -> None:
+        s = max(float(service_ms), 0.0)
+        self.service_ewma_ms = s if self.service_ewma_ms is None else \
+            self.ALPHA * s + (1 - self.ALPHA) * self.service_ewma_ms
+        self.observations += 1
+
+    def snapshot(self, queue_depth: int) -> Dict[str, Any]:
+        """The piggyback payload: current queue depth is the caller's
+        (the batcher knows its queued members); EWMA and in-flight are
+        this tracker's."""
+        return {"queue": int(queue_depth),
+                "in_flight": int(self.in_flight),
+                "service_ewma_ms": round(self.service_ewma_ms or 0.0, 3)}
+
+
 @dataclass
 class ScrollContext:
     scroll_id: str

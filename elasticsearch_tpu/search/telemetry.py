@@ -39,8 +39,8 @@ without telemetry.
 from __future__ import annotations
 
 import contextvars
+import math
 import time
-from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
@@ -260,43 +260,89 @@ def classify_body(body: Optional[Dict[str, Any]]) -> str:
 # histograms + the process-global registry
 # ---------------------------------------------------------------------------
 
-RING_SIZE = 512
+# exponential (HDR-style) bucket layout: HIST_SUB sub-buckets per power
+# of two, from HIST_MIN_NS (1µs) up — ~19% value resolution across
+# 1µs..~4.5min in ~112 ints. Same memory as the old 512-sample ring but
+# the percentiles now reflect the WHOLE process history, which is what
+# an overload scenario's p99 needs (a ring forgets the tail as soon as
+# the flood of fast rejections rolls it over).
+HIST_SUB = 4
+HIST_MIN_NS = 1_000
+HIST_BUCKETS = 28 * HIST_SUB
+
+
+def _bucket_of(ns: int) -> int:
+    if ns < HIST_MIN_NS:
+        return 0
+    idx = int(HIST_SUB * math.log2(ns / HIST_MIN_NS)) + 1
+    return min(idx, HIST_BUCKETS - 1)
+
+
+def _bucket_value_ns(idx: int) -> float:
+    """Representative duration of one bucket (geometric midpoint)."""
+    if idx <= 0:
+        return float(HIST_MIN_NS)
+    lo = HIST_MIN_NS * 2.0 ** ((idx - 1) / HIST_SUB)
+    hi = HIST_MIN_NS * 2.0 ** (idx / HIST_SUB)
+    return (lo * hi) ** 0.5
 
 
 class _Hist:
-    """Ring buffer of recent durations (ns) + a lifetime count. The ring
-    bounds memory for the process lifetime; percentiles reflect recent
-    traffic, the count reflects everything."""
+    """Exponential-bucket histogram of durations (ns) + exact count and
+    sum. Fixed memory for the process lifetime; the raw (sparse) bucket
+    counts ride every snapshot so the coordinator can merge per-node
+    sections into a fleet view and recompute honest percentiles."""
 
-    __slots__ = ("ring", "count", "sum_ns")
+    __slots__ = ("buckets", "count", "sum_ns")
 
     def __init__(self):
-        self.ring: deque = deque(maxlen=RING_SIZE)
+        self.buckets = [0] * HIST_BUCKETS
         self.count = 0
         self.sum_ns = 0
 
     def observe(self, dur_ns: int) -> None:
-        self.ring.append(dur_ns)
+        self.buckets[_bucket_of(dur_ns)] += 1
         self.count += 1
         self.sum_ns += dur_ns
 
+    def _pct_ns(self, p: float) -> float:
+        if not self.count:
+            return 0.0
+        target = max(1, math.ceil(p * self.count))
+        seen = 0
+        for idx, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target:
+                return _bucket_value_ns(idx)
+        return _bucket_value_ns(HIST_BUCKETS - 1)
+
+    def absorb_snapshot(self, snap: Dict[str, Any]) -> None:
+        """Merge a (possibly remote) snapshot's raw buckets into this
+        histogram — the fleet-merge path. Bucket keys arrive as strings
+        after a JSON round trip."""
+        count = int(snap.get("count") or 0)
+        if not count:
+            return
+        self.count += count
+        self.sum_ns += int(round(
+            float(snap.get("mean_ms") or 0.0) * 1e6 * count))
+        for key, n in (snap.get("buckets") or {}).items():
+            idx = min(max(int(key), 0), HIST_BUCKETS - 1)
+            self.buckets[idx] += int(n)
+
     def snapshot(self) -> Dict[str, Any]:
-        data = sorted(self.ring)
-        n = len(data)
-
-        def pct(p: float) -> float:
-            if not n:
-                return 0.0
-            return round(data[min(n - 1, int(p * n))] / 1e6, 4)
-
-        return {
+        out = {
             "count": self.count,
-            "p50_ms": pct(0.50),
-            "p95_ms": pct(0.95),
-            "p99_ms": pct(0.99),
+            "p50_ms": round(self._pct_ns(0.50) / 1e6, 4),
+            "p95_ms": round(self._pct_ns(0.95) / 1e6, 4),
+            "p99_ms": round(self._pct_ns(0.99) / 1e6, 4),
             "mean_ms": round(self.sum_ns / self.count / 1e6, 4)
             if self.count else 0.0,
         }
+        if self.count:
+            out["buckets"] = {idx: n for idx, n
+                              in enumerate(self.buckets) if n}
+        return out
 
 
 class SearchTelemetry:
@@ -383,3 +429,42 @@ class SearchTelemetry:
 
 
 TELEMETRY = SearchTelemetry()
+
+
+def merge_latency_sections(sections: List[Dict[str, Any]]
+                           ) -> Dict[str, Any]:
+    """Coordinator-side fleet merge of per-node ``search_latency``
+    sections (the ``_nodes/stats`` aggregation leg): raw exponential
+    buckets sum across nodes and percentiles are recomputed from the
+    merged distribution — never averaged from per-node percentiles,
+    which would understate every fleet tail. ``_cluster/stats`` serves
+    the result."""
+    classes: Dict[str, Dict[str, Any]] = {}
+    fallbacks: Dict[str, int] = {}
+    for section in sections:
+        for key, entry in (section.get("classes") or {}).items():
+            agg = classes.get(key)
+            if agg is None:
+                agg = classes[key] = {"queries": 0, "dispatches": 0,
+                                      "total": _Hist(), "spans": {}}
+            agg["queries"] += int(entry.get("queries") or 0)
+            agg["dispatches"] += int(entry.get("device_dispatches") or 0)
+            agg["total"].absorb_snapshot(entry.get("latency") or {})
+            for span, snap in (entry.get("spans") or {}).items():
+                hist = agg["spans"].get(span)
+                if hist is None:
+                    hist = agg["spans"][span] = _Hist()
+                hist.absorb_snapshot(snap or {})
+        for reason, n in (section.get("fallback_reasons") or {}).items():
+            fallbacks[reason] = fallbacks.get(reason, 0) + int(n)
+    out_classes: Dict[str, Any] = {}
+    for key, agg in sorted(classes.items()):
+        out_classes[key] = {
+            "queries": agg["queries"],
+            "device_dispatches": agg["dispatches"],
+            "latency": agg["total"].snapshot(),
+            "spans": {span: hist.snapshot()
+                      for span, hist in sorted(agg["spans"].items())},
+        }
+    return {"classes": out_classes,
+            "fallback_reasons": dict(sorted(fallbacks.items()))}

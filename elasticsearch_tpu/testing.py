@@ -311,5 +311,28 @@ class InProcessCluster:
         self.transport.add_rule(sender, receiver, delay=delay,
                                 jitter=jitter)
 
+    def slow_node_drains(self, node_id: str, delay_s: float) -> None:
+        """Overload chaos seam: every shard-query drain on ``node_id``
+        delivers ``delay_s`` later in virtual time AND reports the delay
+        in its self-reported service time — a saturated/slow data node
+        (GC pauses, noisy neighbor, thermal throttling) that a wire-level
+        latency rule cannot model, because the node itself knows it is
+        slow and says so in its pressure piggyback. 0 heals."""
+        batcher = self.nodes[node_id].search_transport.batcher
+        batcher.fault_drain_delay_s = float(delay_s)
+
+    def constrain_search_admission(self, size: int, queue: int) -> None:
+        """Shrink every node's search admission pool (slots + a FIXED
+        queue bound) so overload scenarios reach saturation at test
+        scale. Direct pool mutation — the dynamic search.admission.*
+        settings are deliberately not written, so the admission
+        refresh leaves these values alone."""
+        for node in self.nodes.values():
+            pool = node.thread_pool.pool("search")
+            pool.size = int(size)
+            pool.queue_size = int(queue)
+            pool.min_queue = int(queue)
+            pool.max_queue = int(queue)
+
     def heal(self) -> None:
         self.transport.heal()

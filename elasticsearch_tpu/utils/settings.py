@@ -397,6 +397,42 @@ SEARCH_MESH_WARMUP_AT_BOOT: Setting[bool] = Setting.bool_setting(
     "search.mesh.warmup_at_boot", False,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
+# ---------------------------------------------------------------------------
+# overload control plane (utils/threadpool.py + action/response_collector.py)
+# ---------------------------------------------------------------------------
+
+# Little's-law queue resizing for the search admission pool
+# (QueueResizingEsThreadPoolExecutor analog): the pool moves its queue
+# bound toward completion_rate * target_latency, so past saturation the
+# queue bounds the LATENCY of admitted work. Resizing engages only when
+# min != max (the reference's gate — the defaults keep the static 1000).
+SEARCH_ADMISSION_TARGET_LATENCY: Setting[float] = Setting.time_setting(
+    "search.admission.target_latency", "1s",
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+SEARCH_ADMISSION_QUEUE_MIN: Setting[int] = Setting.int_setting(
+    "search.admission.queue.min", 1000, min_value=1,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+SEARCH_ADMISSION_QUEUE_MAX: Setting[int] = Setting.int_setting(
+    "search.admission.queue.max", 1000, min_value=1,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# completions per measurement frame (the reference's
+# queue_resizing frame): rate = frame / elapsed drives the resize
+SEARCH_ADMISSION_FRAME: Setting[int] = Setting.int_setting(
+    "search.admission.frame", 100, min_value=1,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# C3 adaptive replica selection (OperationRouting.USE_ADAPTIVE_REPLICA_
+# SELECTION_SETTING analog): false restores pure round-robin rotation
+# of shard copies — the chaos suite's baseline for the reroute proof.
+CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION: Setting[bool] = \
+    Setting.bool_setting(
+        "cluster.routing.use_adaptive_replica_selection", True,
+        scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+
 # gateway.recover_after_data_nodes-style fleet-completeness release: when
 # this many data nodes have joined AND answered the shard-state fetch,
 # allocation stops waiting out EXISTING_COPY_GRACE for absent copy-holders

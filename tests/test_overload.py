@@ -1,0 +1,643 @@
+"""Overload control plane: adaptive admission, fair shedding, C3 ARS.
+
+Reference analogs: QueueResizingEsThreadPoolExecutor (Little's-law queue
+bounds), EsRejectedExecutionException -> HTTP 429 (+ the Retry-After
+computation this build adds), and ResponseCollectorService's C3 ranking
+(Suresh et al., NSDI '15) fed by the shard-side pressure piggyback.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster
+from elasticsearch_tpu.utils.errors import RejectedExecutionError
+from elasticsearch_tpu.utils.threadpool import Pool
+
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _text_cluster(indices, seed, n_nodes=1, docs=24, replicas=0):
+    c = InProcessCluster(n_nodes=n_nodes, seed=seed)
+    c.start()
+    client = c.client()
+    rng = np.random.default_rng(seed)
+    for index in indices:
+        _ok(*c.call(lambda cb, i=index: client.create_index(i, {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": replicas},
+            "mappings": {"properties": {"body": {"type": "text"}}}}, cb)))
+        c.ensure_green(index)
+        for i in range(docs):
+            _ok(*c.call(lambda cb, i=i, idx=index: client.index_doc(
+                idx, f"d{i}",
+                {"body": " ".join(f"w{int(x)}"
+                                  for x in rng.integers(0, 16, 6))}, cb)))
+        c.call(lambda cb, i=index: client.refresh(i, cb))
+    return c
+
+
+# ---------------------------------------------------------------------------
+# Little's-law queue resizing (unit level)
+# ---------------------------------------------------------------------------
+
+def test_littles_law_queue_resizing_tracks_rate():
+    clock = {"t": 0.0}
+    pool = Pool("search", 2, 100, now_fn=lambda: clock["t"])
+    pool.min_queue, pool.max_queue = 10, 200
+    pool.target_latency_s = 0.5
+    pool.frame_size = 10
+
+    def frame(per_task_s):
+        for _ in range(10):
+            pool.submit(lambda: None)
+            clock["t"] += per_task_s
+            pool.release()
+
+    # 10 completions/busy-second -> ideal queue 5, clamped to min 10;
+    # the bound moves by at most QUEUE_ADJUSTMENT per frame:
+    # 100 -> 50 -> 10
+    frame(0.1)
+    assert pool.task_rate == pytest.approx(10.0)
+    assert pool.queue_size == 50 and pool.resizes == 1
+    frame(0.1)
+    assert pool.queue_size == 10 and pool.resizes == 2
+    # the rate recovering grows the bound back toward rate * target
+    frame(0.01)
+    assert pool.task_rate == pytest.approx(100.0)
+    assert pool.queue_size == 50
+    # with a measured rate, Retry-After is the queue drain estimate
+    assert pool.retry_after_s() == 1
+    pool.queued_total = 250
+    assert pool.retry_after_s() == 3   # ceil(251 / 100/s)
+
+
+def test_frame_rate_counts_busy_time_only():
+    """The rate is completions per BUSY second: idle time — an hour
+    before traffic OR a lull in the middle of a frame — never reads as
+    a slow pool (a stale rate would tell clients to back off 60s from
+    a pool that drains in milliseconds, and shrink a healthy queue)."""
+    clock = {"t": 0.0}
+    pool = Pool("search", 2, 100, now_fn=lambda: clock["t"])
+    pool.frame_size = 10
+
+    def one(per_task_s):
+        pool.submit(lambda: None)
+        clock["t"] += per_task_s
+        pool.release()
+
+    clock["t"] += 3600.0          # boot / idle gap before the frame
+    for _ in range(5):
+        one(0.1)
+    clock["t"] += 600.0           # idle lull MID-frame (pool empty)
+    for _ in range(5):
+        one(0.1)
+    assert pool.task_rate == pytest.approx(10.0)
+    assert pool.retry_after_s() == 1
+
+
+def test_frame_size_one_measures_service_time():
+    """frame_size=1 is legal (SEARCH_ADMISSION_FRAME min is 1): each
+    completion closes a frame whose busy time is that task's own
+    service time — no zero-elapsed degenerate rate."""
+    clock = {"t": 0.0}
+    pool = Pool("search", 2, 100, now_fn=lambda: clock["t"])
+    pool.frame_size = 1
+    pool.submit(lambda: None)
+    clock["t"] += 0.5
+    pool.release()
+    assert pool.task_rate == pytest.approx(2.0)
+
+
+def test_release_drains_deep_backlog_iteratively():
+    """A backlog of synchronously-completing tasks drains in a loop,
+    not by recursion — 1200 queued fast-failers must not blow the
+    stack or corrupt the accounting."""
+    pool = Pool("p", 1, 1500)
+    ran = []
+
+    def sync_task():
+        ran.append(1)
+        pool.release()            # completes synchronously
+
+    pool.active = 1
+    for _ in range(1200):
+        pool.submit(sync_task, tenant="t")
+    pool.release()
+    assert len(ran) == 1200
+    assert pool.active == 0 and pool.queued_total == 0
+    assert pool.completed == 1201
+
+
+def test_rejection_tenant_map_is_bounded():
+    """Tenant keys are client-supplied index expressions: hostile
+    expression churn pools into "_other" past TENANT_CAP instead of
+    growing node memory (and the stats payload) forever."""
+    pool = Pool("p", 1, 1)
+    pool.active = 1
+    pool.submit(lambda: None, tenant="q0")    # fills the queue
+    for i in range(Pool.TENANT_CAP + 200):
+        with pytest.raises(RejectedExecutionError):
+            pool.submit(lambda: None, tenant=f"t{i}")
+    assert len(pool.rejected_by_tenant) <= Pool.TENANT_CAP + 1
+    assert sum(pool.rejected_by_tenant.values()) == Pool.TENANT_CAP + 200
+    assert pool.rejected_by_tenant["_other"] == 200
+
+
+def test_fixed_bounds_disable_resizing():
+    clock = {"t": 0.0}
+    pool = Pool("search", 2, 40, now_fn=lambda: clock["t"])
+    pool.min_queue = pool.max_queue = 40
+    pool.target_latency_s = None
+    pool.frame_size = 5
+    for _ in range(5):
+        pool.submit(lambda: None)
+        clock["t"] += 0.001
+        pool.release()
+    assert pool.queue_size == 40 and pool.resizes == 0
+
+
+def test_unselected_node_stats_decay_back_into_contention():
+    """A node whose EWMAs froze at saturated values decays toward the
+    winner's with each selection it loses, so a HEALED node converges
+    back into contention and gets re-probed — stats only update from
+    being selected, so without decay it would be starved forever."""
+    from elasticsearch_tpu.action.response_collector import (
+        ResponseCollectorService,
+    )
+    rc = ResponseCollectorService()
+    rc.on_send("fast")
+    rc.on_response("fast", 0.004, service_ms=3.0, queue_depth=0)
+    rc.on_send("slow")
+    rc.on_response("slow", 2.0, service_ms=1900.0, queue_depth=40)
+    r0 = rc.rank("slow")
+    for _ in range(60):   # one selection + decay per SEARCH
+        ordered = rc.order_copies(["slow", "fast"])
+        assert ordered[0] == "fast"
+        rc.decay_unselected({"fast"}, {"slow"})
+    r1 = rc.rank("slow")
+    assert r1 < r0 * 0.1, (r0, r1)
+    # converging toward the winner's rank, not to zero — and the
+    # node-reported service EWMA is preserved until the next contact
+    assert r1 > rc.rank("fast")
+    assert rc.stats()["slow"]["service_ewma_ms"] > 1000
+    # an unknown winner (fresh node, rank 0 — it gets probed) must not
+    # drag known nodes' response history toward zero
+    before = rc.stats()["slow"]["ewma_ms"]
+    rc.decay_unselected({"brand_new"}, {"slow"})
+    assert rc.stats()["slow"]["ewma_ms"] == pytest.approx(before)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant fair admission + displacement shedding (unit level)
+# ---------------------------------------------------------------------------
+
+def test_fair_shedding_displaces_fattest_tenant():
+    pool = Pool("p", 1, 4)
+    ran = []
+    rejections = []
+    pool.active = 1     # saturate the slot so everything queues
+    for i in range(4):
+        pool.submit(lambda i=i: ran.append(("hot", i)), tenant="hot",
+                    on_reject=lambda e, i=i: rejections.append(("hot", i, e)))
+    # queue full of hot; a bg arrival displaces hot's NEWEST entry
+    pool.submit(lambda: ran.append(("bg", 0)), tenant="bg",
+                on_reject=lambda e: rejections.append(("bg", 0, e)))
+    assert rejections == [("hot", 3, rejections[0][2])]
+    err = rejections[0][2]
+    assert isinstance(err, RejectedExecutionError)
+    assert err.status == 429
+    assert err.metadata.get("retry_after", 0) >= 1
+    # a second hot arrival is NOT below bg's share: rejected itself
+    with pytest.raises(RejectedExecutionError):
+        pool.submit(lambda: ran.append(("hot", 9)), tenant="hot")
+    assert pool.rejected_by_tenant == {"hot": 2}
+    # round-robin drain alternates tenants instead of FIFO-flushing hot
+    pool.release()
+    assert ran[0][0] == "hot"
+    pool.release()
+    assert ran[1][0] == "bg"
+    pool.release()
+    pool.release()
+    pool.release()
+    assert [t for t, _i in ran] == ["hot", "bg", "hot", "hot"]
+    assert pool.queued_total == 0
+
+
+# ---------------------------------------------------------------------------
+# hot-tenant starvation chaos scenario
+# ---------------------------------------------------------------------------
+
+def _hot_tenant_scenario(seed):
+    """A hot index floods a saturated coordinator; the background index
+    keeps goodput, and every shed request is a clean 429 carrying a
+    computed Retry-After."""
+    c = _text_cluster(("hot", "bg"), seed=seed)
+    try:
+        client = c.client()
+        node = c.nodes["node0"]
+        c.constrain_search_admission(size=2, queue=6)
+        c.slow_node_drains("node0", 0.02)
+        sched = c.scheduler
+        out = []
+
+        def run_search(index):
+            client.search(index, {"query": {"match": {"body": "w1"}},
+                                  "size": 3},
+                          lambda resp, err=None, i=index:
+                          out.append((i, resp, err)))
+
+        for i in range(40):
+            sched.schedule(i * 0.0002, lambda: run_search("hot"))
+        for i in range(5):
+            sched.schedule(0.001 + i * 0.002, lambda: run_search("bg"))
+        c.run_until(lambda: len(out) == 45, 600.0)
+
+        rejected = [(i, e) for i, _r, e in out if e is not None]
+        assert rejected, "flood never saturated the pool"
+        for _i, err in rejected:
+            assert isinstance(err, RejectedExecutionError), err
+            assert err.status == 429
+            assert int(err.metadata.get("retry_after", 0)) >= 1
+        # fairness converges to an equal queue split, not bg priority:
+        # bg holds ~half the queue (displacing hot's newest) and keeps
+        # real goodput while 40 hot searches flood 5 bg ones
+        bg_ok = sum(1 for i, _r, e in out if i == "bg" and e is None)
+        assert bg_ok >= 2, f"background tenant starved: {bg_ok}/5"
+        # the hot tenant bore the shedding
+        pool = node.thread_pool.pool("search")
+        assert pool.rejected_by_tenant.get("hot", 0) > \
+            pool.rejected_by_tenant.get("bg", 0)
+        assert pool.retry_after_issued == len(rejected)
+        # in-flight fan-outs were never shed: every admitted search
+        # completed (shedding binds to NEW arrivals only)
+        assert pool.active == 0 and pool.queued_total == 0
+    finally:
+        c.stop()
+
+
+@pytest.mark.parametrize("seed", [43 + 701 * k for k in range(CHAOS_SEEDS)])
+def test_hot_tenant_cannot_starve_background(seed):
+    _hot_tenant_scenario(seed)
+
+
+# ---------------------------------------------------------------------------
+# slow-node reroute chaos scenario (C3 ARS vs round-robin)
+# ---------------------------------------------------------------------------
+
+def _slow_node_scenario(seed):
+    """One data node's drains are slowed by fault injection; C3 replica
+    selection (fed by the pressure piggyback) shifts replica-eligible
+    traffic off it and beats the round-robin baseline's p99 in the SAME
+    scenario. Rank inputs stay visible in _nodes/stats."""
+    c = _text_cluster(("r",), seed=seed, n_nodes=3, replicas=2)
+    try:
+        coord = "node0"
+        victim = "node2"
+        client = c.client(coord)
+        c.slow_node_drains(victim, 0.25)
+        sched = c.scheduler
+        body = {"query": {"match": {"body": "w1 w2"}}, "size": 3}
+
+        def victim_queries():
+            return c.nodes[victim].indices_service.shard(
+                "r", 0).search_stats["query_total"]
+
+        def measure(n):
+            lats = []
+            for _ in range(n):
+                t0 = sched.now()
+                _ok(*c.call(lambda cb: client.search("r", dict(body), cb),
+                            max_time=600.0))
+                lats.append(sched.now() - t0)
+            lats.sort()
+            return lats[int(0.99 * (n - 1))]
+
+        # ARS (default on): warm-up lets the ranking observe the victim
+        # once, then measured traffic routes around it
+        measure(6)
+        before = victim_queries()
+        ars_p99 = measure(24)
+        ars_victim_hits = victim_queries() - before
+
+        # round-robin baseline in the same scenario
+        _ok(*c.call(lambda cb: client.cluster_update_settings(
+            {"persistent":
+             {"cluster.routing.use_adaptive_replica_selection": False}},
+            cb)))
+        before = victim_queries()
+        rr_p99 = measure(24)
+        rr_victim_hits = victim_queries() - before
+
+        assert rr_victim_hits >= 6, \
+            f"round-robin never visited the slow node: {rr_victim_hits}"
+        assert ars_victim_hits < rr_victim_hits, \
+            (ars_victim_hits, rr_victim_hits)
+        assert ars_p99 < rr_p99 * 0.5, (ars_p99, rr_p99)
+
+        # rank inputs are operator-visible: the victim's piggybacked
+        # service EWMA and C3 rank dwarf its healthy peers'
+        ars = c.nodes[coord].local_node_stats()["search_admission"]["ars"]
+        assert victim in ars and "rank" in ars[victim] \
+            and "queue_ewma" in ars[victim]
+        assert ars[victim]["service_ewma_ms"] >= 200.0
+        healthy = [nid for nid in ars if nid != victim]
+        assert healthy and all(
+            ars[victim]["rank"] > ars[nid]["rank"] for nid in healthy)
+    finally:
+        c.stop()
+
+
+@pytest.mark.parametrize("seed", [61 + 503 * k for k in range(CHAOS_SEEDS)])
+def test_slow_node_reroute_via_ars(seed):
+    _slow_node_scenario(seed)
+
+
+@pytest.mark.slow
+def test_overload_chaos_seed_sweep():
+    """CI sweep: both overload chaos scenarios under >= 5 seeded RNGs
+    (CHAOS_SEEDS widens it further)."""
+    for k in range(max(CHAOS_SEEDS, 5)):
+        _hot_tenant_scenario(seed=211 + 97 * k)
+        _slow_node_scenario(seed=307 + 89 * k)
+
+
+# ---------------------------------------------------------------------------
+# shard-side pressure piggyback + wire/service trace split
+# ---------------------------------------------------------------------------
+
+def test_pressure_piggyback_feeds_collector_and_traces():
+    c = _text_cluster(("pp",), seed=9)
+    try:
+        client = c.client()
+        node = c.nodes["node0"]
+        resp = _ok(*c.call(lambda cb: client.search(
+            "pp", {"query": {"match": {"body": "w1"}}, "size": 3,
+                   "profile": True}, cb)))
+        # the batcher observed its drain service time...
+        pressure = node.search_transport.batcher.node_pressure
+        assert pressure.observations >= 1
+        assert pressure.in_flight == 0
+        # ...and the coordinator consumed the piggyback into C3 stats
+        sel = node.search_action.response_collector.stats()
+        assert sel["node0"]["observations"] >= 1
+        assert "service_ewma_ms" in sel["node0"]
+        # profile:true shows the per-shard wire/service split
+        phases = resp["profile"]["coordinator"]["phases"]
+        shard_spans = [p for p in phases if p["name"] == "shard_query"]
+        assert shard_spans, [p["name"] for p in phases]
+        assert "service_ms" in shard_spans[0]
+        assert "wire_ms" in shard_spans[0]
+    finally:
+        c.stop()
+
+
+def test_user_responses_carry_no_pressure_keys():
+    """The piggyback rides SHARD responses only: serialized user
+    responses stay free of pressure/took_ms/retry_after keys and repeat
+    byte-identically (the byte-parity acceptance leg)."""
+    c = _text_cluster(("bp",), seed=15)
+    try:
+        client = c.client()
+        body = {"query": {"match": {"body": "w1 w3"}}, "size": 5}
+        first = _ok(*c.call(lambda cb: client.search(
+            "bp", json.loads(json.dumps(body)), cb)))
+        second = _ok(*c.call(lambda cb: client.search(
+            "bp", json.loads(json.dumps(body)), cb)))
+        raw = json.dumps(first, sort_keys=True)
+        for key in ('"pressure"', '"took_ms"', '"retry_after"',
+                    '"service_ewma_ms"'):
+            assert key not in raw, key
+        strip = lambda r: {k: v for k, v in r.items() if k != "took"}  # noqa: E731
+        assert json.dumps(strip(first), sort_keys=True) == \
+            json.dumps(strip(second), sort_keys=True)
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# breaker-charge feedback into the batcher's per-key cap
+# ---------------------------------------------------------------------------
+
+def test_drains_record_observed_breaker_charge():
+    c = _text_cluster(("bc",), seed=21)
+    try:
+        client = c.client()
+        node = c.nodes["node0"]
+        batcher = node.search_transport.batcher
+        out = []
+        for _ in range(4):   # same tick -> one coalesced text drain
+            client.search("bc", {"query": {"match": {"body": "w2"}},
+                                 "size": 4},
+                          lambda resp, err=None: out.append((resp, err)))
+        c.run_until(lambda: len(out) == 4, 120.0)
+        assert all(e is None for _r, e in out)
+        charges = [st.get("charge_per_member")
+                   for st in batcher._key_state.values()]
+        assert any(ch for ch in charges if ch), charges
+    finally:
+        c.stop()
+
+
+def test_observed_charge_preshrinks_cap_before_any_trip():
+    from elasticsearch_tpu.indices.breaker import BREAKERS
+    c = _text_cluster(("pc",), seed=23)
+    try:
+        node = c.nodes["node0"]
+        batcher = node.search_transport.batcher
+        key = ("pc", 0, "text", "body", 4, 10_000)
+        batcher._key_state[key] = {
+            "window": 0.001, "max_size": None, "last": 0.0,
+            "charge_per_member": 10 * (1 << 20)}
+        breaker = BREAKERS.breaker("request")
+        old_limit = breaker.limit
+        trips_before = breaker.trip_count
+        # headroom for ~32MB -> *0.8 -> fits 2 members of 10MB
+        breaker.limit = breaker.used + 32 * (1 << 20)
+        try:
+            assert batcher._key_max_size(key) == 2
+            assert batcher.stats["max_size_preshrinks"] >= 1
+            assert breaker.trip_count == trips_before   # BEFORE any trip
+        finally:
+            breaker.limit = old_limit
+    finally:
+        c.stop()
+
+
+def test_breaker_observe_scope_sees_nested_charges():
+    from elasticsearch_tpu.indices.breaker import ChildBreaker
+    b = ChildBreaker("t", 10_000)
+    with b.observe() as obs:
+        with b.limit_scope(100):
+            with b.limit_scope(250):
+                pass
+        with b.limit_scope(50):
+            pass
+    assert obs.base == 0 and obs.peak == 350
+    assert b.used == 0          # observation never holds budget
+
+
+# ---------------------------------------------------------------------------
+# _nodes/stats search_admission surface + Retry-After REST contract
+# ---------------------------------------------------------------------------
+
+def test_search_admission_stats_surface():
+    c = _text_cluster(("sa", "sb"), seed=27)
+    try:
+        client = c.client()
+        node = c.nodes["node0"]
+        c.constrain_search_admission(size=1, queue=1)
+        c.slow_node_drains("node0", 0.01)
+        out = []
+        for index in ("sa", "sa", "sa", "sb"):
+            client.search(index, {"query": {"match": {"body": "w1"}},
+                                  "size": 2},
+                          lambda resp, err=None: out.append((resp, err)))
+        c.run_until(lambda: len(out) == 4, 120.0)
+        stats = node.local_node_stats()["search_admission"]
+        assert stats["queue"]["limit"] == 1
+        assert stats["queue"]["current"] == 0     # drained by now
+        assert stats["slots"] == 1
+        assert stats["rejected_total"] >= 1
+        assert "sa" in stats["rejections_by_tenant"]
+        assert stats["retry_after"]["issued"] >= 1
+        assert stats["retry_after"]["last_s"] >= 1
+        assert "node_pressure" in stats
+        assert "service_ewma_ms" in stats["node_pressure"]
+        assert "ars" in stats and "node0" in stats["ars"]
+    finally:
+        c.stop()
+
+
+def test_rejection_surfaces_retry_after_on_rest():
+    from elasticsearch_tpu.rest.controller import respond_error
+    from elasticsearch_tpu.rest.server import retry_after_of
+    err = RejectedExecutionError("rejected execution on [search]",
+                                 retry_after=7)
+    box = []
+    respond_error(lambda status, body: box.append((status, body)), err)
+    status, body = box[0]
+    assert status == 429
+    assert body["error"]["retry_after"] == 7
+    assert body["error"]["type"] == "rejected_execution_exception"
+    # the HTTP server mirrors the computed value into the header
+    assert retry_after_of(status, body) == 7
+    assert retry_after_of(200, {"error": {"retry_after": 7}}) is None
+    assert retry_after_of(429, {"error": {}}) is None
+
+
+def test_rest_429_body_end_to_end():
+    """Through the REST controller: a saturated search pool answers 429
+    with the retry_after field the Retry-After header is minted from."""
+    from elasticsearch_tpu.rest.controller import RestRequest
+    from elasticsearch_tpu.rest.routes import build_controller
+    c = _text_cluster(("re",), seed=31)
+    try:
+        node = c.nodes["node0"]
+        c.constrain_search_admission(size=1, queue=1)
+        c.slow_node_drains("node0", 0.05)
+        rc = build_controller(c.client())
+        box = []
+
+        def search_once():
+            rc.dispatch(RestRequest(
+                method="POST", path="/re/_search",
+                body={"query": {"match": {"body": "w1"}}, "size": 2}),
+                lambda status, body: box.append((status, body)))
+        for _ in range(6):
+            search_once()
+        c.run_until(lambda: len(box) == 6, 300.0)
+        rejected = [(s, b) for s, b in box if s != 200]
+        assert rejected, "pool never saturated"
+        for status, body in rejected:
+            assert status == 429
+            assert body["error"]["type"] == "rejected_execution_exception"
+            assert body["error"]["retry_after"] >= 1
+        assert any(s == 200 for s, _b in box)
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# exponential histograms + the fleet merge
+# ---------------------------------------------------------------------------
+
+def test_exponential_histogram_holds_lifetime_history():
+    from elasticsearch_tpu.search import telemetry as t
+    hist = t._Hist()
+    # a rare early 100ms tail then a long flood of 1ms samples: a
+    # 512-sample ring would have forgotten the tail entirely
+    for _ in range(10):
+        hist.observe(100_000_000)
+    for _ in range(890):
+        hist.observe(1_000_000)
+    snap = hist.snapshot()
+    assert snap["count"] == 900
+    assert snap["p99_ms"] >= 80.0, snap
+    assert 0.5 <= snap["p50_ms"] <= 2.0, snap
+    assert snap["buckets"]
+    # fixed memory regardless of sample count
+    assert len(hist.buckets) == t.HIST_BUCKETS
+
+
+def test_merge_latency_sections_recomputes_fleet_percentiles():
+    from elasticsearch_tpu.search import telemetry as t
+
+    def section(dur_ns, n, plane="batch"):
+        reg = t.SearchTelemetry()
+        for _ in range(n):
+            trace = t.SearchTrace("bm25", plane)
+            trace.total_ns = dur_ns
+            trace.add_span("device_dispatch", dur_ns)
+            reg.observe(trace)
+        reg.count_fallback(t.MESH_DISABLED)
+        return reg.snapshot()
+
+    fast = section(1_000_000, 95)     # one node all ~1ms
+    slow = section(200_000_000, 5)    # one node all ~200ms
+    merged = t.merge_latency_sections([fast, slow])
+    entry = merged["classes"]["bm25|batch"]
+    assert entry["queries"] == 100
+    lat = entry["latency"]
+    assert lat["count"] == 100
+    # the fleet p99 reflects the slow node's tail; a percentile AVERAGE
+    # would have reported ~11ms
+    assert lat["p99_ms"] >= 100.0, lat
+    assert lat["p50_ms"] <= 2.0, lat
+    assert entry["spans"]["device_dispatch"]["count"] == 100
+    assert merged["fallback_reasons"]["mesh_disabled"] == 2
+
+
+def test_cluster_stats_serves_merged_search_latency():
+    from elasticsearch_tpu.rest.controller import RestRequest
+    from elasticsearch_tpu.rest.routes import build_controller
+    c = _text_cluster(("cs",), seed=35)
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.search(
+            "cs", {"query": {"match": {"body": "w1"}}, "size": 3}, cb)))
+        rc = build_controller(client)
+        box = []
+        rc.dispatch(RestRequest(method="GET", path="/_cluster/stats"),
+                    lambda status, body: box.append((status, body)))
+        c.run_until(lambda: bool(box), 120.0)
+        status, body = box[0]
+        assert status == 200
+        assert body["search_latency"]["classes"], body.get("search_latency")
+        entry = next(iter(body["search_latency"]["classes"].values()))
+        for field in ("queries", "latency", "spans"):
+            assert field in entry
+        # the merge's fan-out is section-filtered: a node asked for one
+        # section builds ONLY it (no /proc walk, no per-shard stats)
+        node = c.nodes["node0"]
+        narrow = node.local_node_stats(sections=["search_latency"])
+        assert set(narrow) == {"name", "search_latency"}
+    finally:
+        c.stop()

@@ -548,7 +548,7 @@ def test_trace_span_clamps_and_dispatch_attribution():
     assert tree["time_in_nanos"] >= 1
 
 
-def test_histogram_percentiles_and_ring_bound():
+def test_histogram_percentiles_and_lifetime_history():
     reg = telemetry.SearchTelemetry()
     for i in range(1000):
         t = SearchTrace("knn", "batch")
@@ -559,8 +559,9 @@ def test_histogram_percentiles_and_ring_bound():
     assert snap["queries"] == 1000
     lat = snap["latency"]
     assert lat["count"] == 1000
-    # ring keeps the most recent RING_SIZE samples: percentiles reflect
-    # recent traffic, count reflects the lifetime
+    # exponential buckets hold the WHOLE process history in fixed
+    # memory: percentiles AND count are lifetime (the overload p99
+    # contract — a flood of fast samples can't roll out a slow tail)
     assert lat["p50_ms"] > 0
     assert lat["p99_ms"] >= lat["p95_ms"] >= lat["p50_ms"]
 
