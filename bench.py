@@ -801,6 +801,181 @@ def cfg_sparse(np, jax, jnp, result):
             f"{type(e).__name__}: {e}"[:200]
 
 
+def cfg_device_profile(np, jax, jnp, result):
+    """--device-profile gate: the steady-state ZERO-RECOMPILE contract
+    behind every pow2 shape-bucketing invariant in ops/ (qb_bucket's x8
+    ladder, the kNN/sparse query-dim pow2 pads, the IVF probe's ~9-entry
+    cache), measured through the device observatory
+    (search/device_profile.py). Per query class: warm the serving
+    kernels on a fixed query stream, then re-run the SAME stream and
+    assert the observatory counts zero additional compiles — a padding
+    regression fails here as a named number instead of surfacing as an
+    unexplained p99 cliff. Small corpora on purpose: this config
+    measures compile-cache behavior, not throughput."""
+    from elasticsearch_tpu.search.device_profile import DEVICE_PROFILE
+    block = jax.block_until_ready
+    rng = np.random.default_rng(SEED + 13)
+    n_docs, vocab, dims = 1 << 14, 500, 64
+
+    # bm25 through the served pruned flat-dispatch path
+    from elasticsearch_tpu.ops.bm25 import Bm25Executor
+    from elasticsearch_tpu.ops.device_segment import (
+        DeviceFeatures, DevicePostings,
+    )
+    pf = build_zipf_postings(np, n_docs, vocab, max_len=24)
+    b_dev = DevicePostings(pf, n_docs)
+    b_ex = Bm25Executor(b_dev, pf)
+    b_live = jnp.ones((b_dev.n_docs_pad,), bool)
+    text_queries = zipf_queries(np, 48, vocab)
+
+    def run_bm25():
+        got = None
+        for lo in range(0, 48, 16):
+            got = b_ex.top_k_batch(text_queries[lo: lo + 16], b_live, K)
+        block(got[0])
+
+    # kNN through the batched executor kernel at two batch widths (both
+    # land in the pow2 bucket space warmup visits)
+    from elasticsearch_tpu.ops.knn import knn_topk_batch
+    matrix = jnp.asarray(rng.standard_normal((n_docs, dims))
+                         .astype(np.float32))
+    norms = jnp.linalg.norm(matrix, axis=1)
+    ones = jnp.ones((n_docs,), bool)
+    q_dev = jnp.asarray(rng.standard_normal((16, dims))
+                        .astype(np.float32))
+
+    def run_knn():
+        block(knn_topk_batch(matrix, norms, ones, ones, q_dev[:1], K,
+                             "cosine"))
+        block(knn_topk_batch(matrix, norms, ones, ones, q_dev, K,
+                             "cosine"))
+
+    # sparse through the batched executor with fixed expansions
+    from elasticsearch_tpu.index.segment import FeaturesField
+    from elasticsearch_tpu.ops.sparse import SparseExecutor
+    weights = np.where(pf.block_docs >= 0,
+                       rng.random(pf.block_tfs.shape, np.float32) * 3.0,
+                       0.0)
+    ff = FeaturesField(
+        features={f"t{i}": i for i in range(len(pf.doc_freq))},
+        block_docs=pf.block_docs,
+        block_weights=weights.astype(np.float32),
+        block_max_weight=weights.max(axis=1).astype(np.float32),
+        feat_block_start=pf.term_block_start,
+        feat_block_count=pf.term_block_count,
+        doc_freq=pf.doc_freq)
+    s_ex = SparseExecutor(DeviceFeatures(ff, n_docs), ff)
+    s_live = jnp.ones((s_ex.dev.n_docs_pad,), bool)
+    expansions = [[(f"t{int(t)}", float(w) + 0.5)
+                   for t, w in zip(np.minimum(rng.zipf(1.35, size=4) - 1,
+                                              vocab - 1),
+                                   rng.random(4))]
+                  for _ in range(16)]
+
+    def run_sparse():
+        got = s_ex.top_k_batch(expansions, s_live, K, function="linear")
+        block(got[0])
+
+    out = {"warm_iters": 2, "steady_iters": 3}
+    ok_all = True
+    for name, fn in (("bm25", run_bm25), ("knn", run_knn),
+                     ("sparse", run_sparse)):
+        before_warm = DEVICE_PROFILE.total_compiles()
+        for _ in range(2):
+            fn()
+        after_warm = DEVICE_PROFILE.total_compiles()
+        for _ in range(3):
+            fn()
+        recompiles = DEVICE_PROFILE.total_compiles() - after_warm
+        entry = {"warmup_compiles": after_warm - before_warm,
+                 "steady_recompiles": recompiles,
+                 "ok": recompiles == 0}
+        ok_all = ok_all and entry["ok"]
+        out[name] = entry
+    snap = DEVICE_PROFILE.snapshot()
+    out["families"] = {
+        name: {"compiles": fam["compiles"],
+               "cache_hits": fam["cache_hits"],
+               "shape_buckets": fam["shape_buckets"],
+               "recompile_storms": fam["recompile_storms"]}
+        for name, fam in snap["families"].items()}
+    out["recompile_storms"] = snap["recompile_storms"]
+    out["zero_steady_state_recompiles"] = ok_all
+    result["configs"]["device_profile"] = out
+    return ok_all
+
+
+def _latest_bench_snapshot():
+    """(tag, parsed tail) of the HIGHEST-numbered BENCH_rNN.json next to
+    this script — the prior recorded snapshot a fresh run compares
+    against — or (None, None). Not hardcoded: the next recording
+    automatically diffs against this one."""
+    import glob
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    best, best_n = None, -1
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(path))
+        if m and int(m.group(1)) > best_n:
+            best, best_n = path, int(m.group(1))
+    if best is None:
+        return None, None
+    try:
+        with open(best, encoding="utf-8") as fh:
+            wrapped = json.load(fh)
+        return f"r{best_n:02d}", json.loads(wrapped.get("tail") or "null")
+    except Exception:  # noqa: BLE001 — unparseable snapshot: skip
+        return None, None
+
+
+def _bench_deltas(prev: dict, result: dict) -> dict:
+    """Per-class qps deltas vs a prior snapshot — the bench output
+    carries its own trajectory so a regression (or a win) is visible in
+    the recorded line itself, not only by diffing files."""
+    out = {}
+    prev_cfg = (prev or {}).get("configs") or {}
+    for name, entry in (result.get("configs") or {}).items():
+        old = prev_cfg.get(name) or {}
+        if not isinstance(entry, dict):
+            continue
+        new_qps, old_qps = entry.get("qps"), old.get("qps")
+        if not new_qps or not old_qps:
+            continue
+        line = {"qps_prev": old_qps, "qps": new_qps,
+                "ratio": round(new_qps / old_qps, 3)}
+        if entry.get("vs_5x_cpu") is not None and \
+                old.get("vs_5x_cpu") is not None:
+            line["vs_5x_cpu_prev"] = old["vs_5x_cpu"]
+            line["vs_5x_cpu"] = entry["vs_5x_cpu"]
+        out[name] = line
+    return out
+
+
+def device_profile_main() -> int:
+    """``bench.py --device-profile``: the CI smoke mode — run ONLY the
+    zero-steady-state-recompiles gate on the CPU backend, print the one
+    JSON line, exit nonzero when any class recompiled in steady state
+    (the slow-marked suite runs this; a bucketing regression fails CI)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    result = {"metric": "device_profile", "configs": {}, "errors": {}}
+    ok = False
+    try:
+        import jax
+        try:
+            jax.config.update("jax_platforms",
+                              os.environ["JAX_PLATFORMS"])
+        except Exception:  # noqa: BLE001 — backend already up
+            pass
+        import jax.numpy as jnp
+        import numpy as np
+        result["backend"] = jax.default_backend()
+        ok = bool(cfg_device_profile(np, jax, jnp, result))
+    except Exception as e:  # noqa: BLE001 — the line must still print
+        result["errors"]["fatal"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
 def cfg_aggs(np, jax, jnp, result):
     """Aggregations concurrent config — a shape the classifier could
     never device-batch, newly served as a ``dense`` batch member: device
@@ -1572,7 +1747,9 @@ def main() -> None:
         bm25_ctx = None
         for name, fn in (("knn", cfg_knn), ("bm25", cfg_bm25),
                          ("ivf", cfg_ivf), ("hybrid", cfg_hybrid),
-                         ("sparse", cfg_sparse), ("aggs", cfg_aggs),
+                         ("sparse", cfg_sparse),
+                         ("device_profile", cfg_device_profile),
+                         ("aggs", cfg_aggs),
                          ("segmented", cfg_segmented),
                          ("overload", cfg_overload),
                          ("multichip", cfg_multichip)):
@@ -1598,6 +1775,15 @@ def main() -> None:
         result["telemetry"] = TELEMETRY.snapshot()
     except Exception as e:  # noqa: BLE001 — the line must still print
         result["errors"]["telemetry"] = f"{type(e).__name__}: {e}"[:200]
+    # per-class trajectory vs the last recorded snapshot: the five
+    # perf PRs since BENCH_r05 finally get a measured delta, and every
+    # later snapshot carries its own comparison automatically
+    try:
+        tag, prev = _latest_bench_snapshot()
+        if prev:
+            result[f"deltas_vs_{tag}"] = _bench_deltas(prev, result)
+    except Exception as e:  # noqa: BLE001 — the line must still print
+        result["errors"]["deltas"] = f"{type(e).__name__}: {e}"[:200]
     result["wall_s"] = round(time.perf_counter() - t_start, 1)
     print(json.dumps(result))
     if "--telemetry" in sys.argv:
@@ -1607,5 +1793,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--multichip-child" in sys.argv:
         _multichip_child()
+    elif "--device-profile" in sys.argv:
+        sys.exit(device_profile_main())
     else:
         main()

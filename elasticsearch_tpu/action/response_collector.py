@@ -49,6 +49,19 @@ class ResponseCollectorService:
     def __init__(self) -> None:
         self._nodes: Dict[str, NodeStatistics] = {}
         self._lock = threading.Lock()
+        # C3's `clients` term: the DATA-NODE count from cluster state
+        # (the reference reads it off ClusterState), fed by the
+        # coordinator per search. 0 = no state seen yet — fall back to
+        # the tracked-node count, which undercounts early (only nodes
+        # this coordinator has already contacted are tracked, so the
+        # concurrency compensation starts too weak on a fresh node).
+        self._data_node_count = 0
+
+    def set_data_node_count(self, n: int) -> None:
+        self._data_node_count = max(int(n), 0)
+
+    def _clients_locked(self) -> int:
+        return self._data_node_count or len(self._nodes)
 
     def _stats(self, node_id: str) -> NodeStatistics:
         stats = self._nodes.get(node_id)
@@ -104,7 +117,7 @@ class ResponseCollectorService:
             stats = self._nodes.get(node_id)
             if stats is None or stats.ewma_ms is None:
                 return 0.0
-            return self._rank_locked(stats, len(self._nodes))
+            return self._rank_locked(stats, self._clients_locked())
 
     @staticmethod
     def _rank_locked(stats: NodeStatistics, n_clients: int) -> float:
@@ -169,7 +182,7 @@ class ResponseCollectorService:
         ``adaptive_selection`` (and ``search_admission.ars``) so a
         routing decision is explainable from the stats surface alone."""
         with self._lock:
-            n_clients = len(self._nodes)
+            n_clients = self._clients_locked()
             out: Dict[str, Dict[str, float]] = {}
             for nid, stats in self._nodes.items():
                 entry = {"ewma_ms": round(stats.ewma_ms or 0.0, 3),

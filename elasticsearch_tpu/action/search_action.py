@@ -773,6 +773,12 @@ class TransportSearchAction:
         )
         use_ars = setting_from_state(
             state, CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION)
+        # C3's `clients` term reads the DATA-NODE count off cluster
+        # state (the reference's ResponseCollectorService contract) —
+        # not this coordinator's tracked-node count, which undercounts
+        # until every data node has answered at least one query
+        self.response_collector.set_data_node_count(
+            sum(1 for n in state.nodes.values() if n.is_data))
         targets = []
         for index in indices:
             if not state.routing_table.has_index(index):

@@ -249,6 +249,80 @@ def search_latency_stats() -> Dict[str, Any]:
     return mod.TELEMETRY.snapshot()
 
 
+def device_profile_stats() -> Dict[str, Any]:
+    """Device observatory observability (search/device_profile.py
+    DEVICE_PROFILE + the plane registries' residency timelines): per
+    kernel-family compile counts vs cache hits, compile wall-time,
+    live shape-bucket cardinality, the recompile-storm counter, the
+    measured execute-time EWMA per (family, shape bucket) and guarded
+    FLOPs/bytes estimates — plus WHERE the plane HBM went (bytes by
+    generation age, high-water marks) and WHY it left (eviction
+    causes). Never initializes the device layer itself — a node that
+    has dispatched no kernels reports an empty section."""
+    import sys
+    out: Dict[str, Any] = {}
+    dp = sys.modules.get("elasticsearch_tpu.search.device_profile")
+    if dp is not None:
+        out = dp.DEVICE_PROFILE.snapshot()
+    seg = sys.modules.get("elasticsearch_tpu.ops.device_segment")
+    if seg is not None:
+        out["plane_residency"] = seg.PLANES.residency_snapshot()
+        out["mesh_plane_residency"] = \
+            seg.MESH_PLANES.residency_snapshot()
+    return out
+
+
+def hot_spans_report(node, limit: int = 16) -> Dict[str, Any]:
+    """GET /_nodes/hot_spans — the reference hot-threads analog over the
+    data planes: sample every in-flight search task (the serving paths
+    maintain phase / data plane / drain occupancy on the task status)
+    and render the longest-running first, plus the shard batcher's
+    queued members per batch key and the node's own pressure snapshot.
+    Pure observation: nothing here touches a queue or a task."""
+    spans: List[Dict[str, Any]] = []
+    tm = getattr(node, "task_manager", None)
+    if tm is not None:
+        now_ms = tm.now_ms()
+        for task in tm.list():
+            if not str(task.action).startswith("indices:data/read/search"):
+                continue
+            status = task.status or {}
+            entry: Dict[str, Any] = {
+                "task": task.task_id,
+                "action": task.action,
+                "description": task.description,
+                "phase": status.get("phase", "running"),
+                "elapsed_ms": round(
+                    max(now_ms - task.start_time_ms, 0.0), 3),
+            }
+            if status.get("data_plane") is not None:
+                entry["data_plane"] = status["data_plane"]
+            if status.get("occupancy") is not None:
+                entry["occupancy"] = status["occupancy"]
+            spans.append(entry)
+    spans.sort(key=lambda s: (-s["elapsed_ms"], s["task"]))
+    out: Dict[str, Any] = {
+        "in_flight_total": len(spans),
+        "spans": spans[: max(int(limit), 1)],
+    }
+    batcher = getattr(getattr(node, "search_transport", None),
+                      "batcher", None)
+    if batcher is not None:
+        # batch keys are (index, shard, kind, ...bucketing components) —
+        # never request payloads — but the rendering is still truncated
+        # so no future key component can balloon a monitoring response;
+        # colliding truncations SUM rather than shadow each other
+        queued: Dict[str, int] = {}
+        for key, queue in batcher._queues.items():
+            if queue:
+                label = "/".join(str(part) for part in key)[:128]
+                queued[label] = queued.get(label, 0) + len(queue)
+        out["queued_members"] = queued
+        out["node_pressure"] = batcher.node_pressure.snapshot(
+            batcher.queue_depth())
+    return out
+
+
 def gateway_stats(gateway_allocator) -> Dict[str, Any]:
     """Gateway shard-state fetch observability (gateway.py
     GatewayAllocator): how many fetches the master issued, how often the

@@ -344,6 +344,10 @@ class Node:
             # per-(query class x data plane) latency histograms + the
             # typed fallback-reason taxonomy (search/telemetry.py)
             "search_latency": monitor.search_latency_stats,
+            # device observatory: per-family compile/recompile counters,
+            # execute EWMAs, FLOPs estimates + plane-HBM residency
+            # timelines (search/device_profile.py + the plane registries)
+            "device_profile": monitor.device_profile_stats,
             # gateway shard-state fetch counters (fetches issued, cache
             # hits, copies reported none/corrupted/stale, reconciles)
             "gateway": lambda: monitor.gateway_stats(

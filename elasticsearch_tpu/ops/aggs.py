@@ -15,16 +15,17 @@ so the only host↔device traffic per (segment, agg) is the final
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
+from elasticsearch_tpu.search.device_profile import profiled_jit
+
 __all__ = ["ordinal_counts", "histogram_partials"]
 
 
-@partial(jax.jit, static_argnames=("n_buckets",))
+@profiled_jit("aggs_ordinal_counts", static_argnames=("n_buckets",))
 def ordinal_counts(ords: jnp.ndarray,     # [E] int32 bucket ids (-1 pad)
                    owner_ok: jnp.ndarray,  # [E] bool: owner doc matched
                    n_buckets: int) -> jnp.ndarray:
@@ -36,7 +37,7 @@ def ordinal_counts(ords: jnp.ndarray,     # [E] int32 bucket ids (-1 pad)
         valid.astype(jnp.int32), mode="drop")
 
 
-@partial(jax.jit, static_argnames=("n_buckets",))
+@profiled_jit("aggs_histogram", static_argnames=("n_buckets",))
 def histogram_partials(values: jnp.ndarray,   # [N_pad] int32 column
                        exists: jnp.ndarray,   # [N_pad] bool
                        mask: jnp.ndarray,     # [N_pad] bool query matches

@@ -19,7 +19,6 @@ idf follows the reference's BM25: ln(1 + (N - df + 0.5) / (df + 0.5)).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
 import numpy as np
@@ -29,6 +28,8 @@ import jax.numpy as jnp
 
 from elasticsearch_tpu.index.segment import PostingsField, next_pow2
 from elasticsearch_tpu.ops.device_segment import DevicePostings, gather_query_blocks
+from elasticsearch_tpu.search.device_profile import profiled_jit
+from elasticsearch_tpu.search.telemetry import record_dispatch
 
 DEFAULT_K1 = 1.2
 DEFAULT_B = 0.75
@@ -39,7 +40,8 @@ def idf(doc_count: int, doc_freq: int) -> float:
     return float(np.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5)))
 
 
-@partial(jax.jit, static_argnames=("n_docs_pad", "k1", "b"))
+@profiled_jit("bm25_block_scores",
+              static_argnames=("n_docs_pad", "k1", "b"))
 def bm25_block_scores(block_docs: jnp.ndarray,     # [NB, BLOCK] int32, -1 pad
                       block_tfs: jnp.ndarray,      # [NB, BLOCK] f32
                       block_idx: jnp.ndarray,      # [QB] int32 gather indices
@@ -64,7 +66,8 @@ def bm25_block_scores(block_docs: jnp.ndarray,     # [NB, BLOCK] int32, -1 pad
     return scores
 
 
-@partial(jax.jit, static_argnames=("n_docs_pad", "k1", "b", "k"))
+@profiled_jit("bm25_topk",
+              static_argnames=("n_docs_pad", "k1", "b", "k"))
 def bm25_topk(block_docs, block_tfs, block_idx, block_weight, doc_lens, avgdl,
               live, n_docs_pad: int, k: int,
               k1: float = DEFAULT_K1, b: float = DEFAULT_B
@@ -133,8 +136,9 @@ def bm25_flat_body(block_docs, block_tfs,
     return scores, matched
 
 
-@partial(jax.jit,
-         static_argnames=("n_docs_pad", "n_q", "k", "k1", "b", "counted"))
+@profiled_jit("bm25_flat",
+              static_argnames=("n_docs_pad", "n_q", "k", "k1", "b",
+                               "counted"))
 def _bm25_flat_kernel(block_docs, block_tfs, flat_idx, flat_w, flat_q,
                       doc_lens, flat_avgdl, live,
                       n_docs_pad: int, n_q: int, k: int,
@@ -174,8 +178,9 @@ def bm25_topk_flat_counted(*args, **kw):
     return _bm25_flat_kernel(*args, **kw, counted=True)
 
 
-@partial(jax.jit,
-         static_argnames=("n_docs_pad", "n_q", "k", "k1", "b", "n_segs"))
+@profiled_jit("bm25_flat_seg",
+              static_argnames=("n_docs_pad", "n_q", "k", "k1", "b",
+                               "n_segs"))
 def _bm25_flat_kernel_seg(block_docs, block_tfs, flat_idx, flat_w, flat_q,
                           doc_lens, flat_avgdl, live, seg_ids,
                           n_docs_pad: int, n_q: int, k: int,
@@ -687,7 +692,6 @@ def dispatch_flat(block_docs, block_tfs, doc_lens, n_docs_pad: int,
             flat_avg = np.full(fb, avgdl, np.float32)
         if counter is not None:
             counter.append(1)
-        from elasticsearch_tpu.search.telemetry import record_dispatch
         record_dispatch()
         if count_segments is not None:
             seg_ids, n_segs = count_segments

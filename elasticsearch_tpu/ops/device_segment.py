@@ -24,6 +24,7 @@ cannot fit degrades to the per-segment path instead of OOMing.
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -542,6 +543,12 @@ class PlaneRegistry:
             "quantized_queries": 0,
             "ivf_warm_starts": 0,
         }
+        # device-observatory residency record: monotonically stamped
+        # generations, the resident-bytes high-water mark, and WHY each
+        # plane left HBM (the "device_profile" stats section)
+        self._gen = 0
+        self.hbm_high_water = 0
+        self.evictions_by_cause: Dict[str, int] = {}
 
     # -- config ---------------------------------------------------------
 
@@ -642,7 +649,8 @@ class PlaneRegistry:
             # that fits both hot shards after dropping one cold plane
             # keeps the other hot plane resident instead of ping-ponging
             while self._parts:
-                self._drop(next(iter(self._parts)))
+                self._drop(next(iter(self._parts)),
+                           cause="breaker_pressure")
                 try:
                     charge = account_device_arrays(part, host, label,
                                                    return_charge=True)
@@ -655,6 +663,9 @@ class PlaneRegistry:
         part._charges.append(charge)
         part.upload(host)
         self.stats["plane_builds"] += 1
+        part.generation = self._gen
+        part.built_at = time.monotonic()
+        self._gen += 1
         if prev is not None:
             self.stats["plane_incremental_appends"] += 1
             # the superseded generation is NOT dropped eagerly: a
@@ -665,17 +676,23 @@ class PlaneRegistry:
         else:
             self.stats["plane_full_rebuilds"] += 1
         self._parts[key] = part
+        self.hbm_high_water = max(
+            self.hbm_high_water,
+            sum(p.nbytes for p in self._parts.values()))
         while len(self._parts) > self.MAX_PARTS:
-            self._drop(next(iter(self._parts)))
+            self._drop(next(iter(self._parts)), cause="lru")
         return part
 
     # -- eviction / lifecycle -------------------------------------------
 
-    def _drop(self, key: Tuple, count_eviction: bool = True) -> None:
+    def _drop(self, key: Tuple, count_eviction: bool = True,
+              cause: str = "lru") -> None:
         part = self._parts.pop(key, None)
         if part is None:
             return
         part.release()      # budget back NOW; GC finalizers then no-op
+        self.evictions_by_cause[cause] = \
+            self.evictions_by_cause.get(cause, 0) + 1
         if count_eviction:
             self.stats["plane_evictions"] += 1
 
@@ -687,7 +704,7 @@ class PlaneRegistry:
         eviction working as intended."""
         n = len(self._parts)
         for key in list(self._parts):
-            self._drop(key)
+            self._drop(key, cause="breaker_pressure")
         return n
 
     def drop_segments(self, uids) -> None:
@@ -698,11 +715,12 @@ class PlaneRegistry:
         uids = set(uids)
         for key in [k for k, p in self._parts.items()
                     if uids.intersection(p.uids)]:
-            self._drop(key, count_eviction=False)
+            self._drop(key, count_eviction=False,
+                       cause="merge_invalidated")
 
     def clear(self) -> None:
         for key in list(self._parts):
-            self._drop(key, count_eviction=False)
+            self._drop(key, count_eviction=False, cause="clear")
         self._refused.clear()
 
     def on_refresh(self, segments) -> None:
@@ -730,6 +748,33 @@ class PlaneRegistry:
                 "resident_bytes": by_kind,
                 "rerank_depth": int(self.rerank_depth),
                 "quantized": bool(self.quantized)}
+
+    def residency_snapshot(self) -> Dict[str, Any]:
+        """The device observatory's HBM residency timeline: every
+        resident plane with its bytes, generation stamp and age, plus
+        the high-water mark and the eviction-cause breakdown — WHERE the
+        HBM went and WHY it left, from the stats surface alone."""
+        now = time.monotonic()
+        total = 0
+        planes = []
+        for p in self._parts.values():
+            total += p.nbytes
+            planes.append({
+                "kind": p.kind, "field": p.field,
+                "bytes": int(p.nbytes),
+                "generation": int(getattr(p, "generation", 0)),
+                "age_s": round(now - getattr(p, "built_at", now), 3),
+            })
+        planes.sort(key=lambda e: -e["age_s"])
+        self.hbm_high_water = max(self.hbm_high_water, total)
+        return {
+            "resident_bytes_total": total,
+            "high_water_bytes": int(self.hbm_high_water),
+            "generations_built": int(self._gen),
+            "planes": planes,
+            "evictions_by_cause": dict(
+                sorted(self.evictions_by_cause.items())),
+        }
 
 
 # one accelerator per process -> one plane residency manager per process
@@ -813,6 +858,10 @@ class MeshPlaneRegistry:
             "mesh_plane_miss_fallbacks": 0,
             "mesh_plane_warmups": 0,
         }
+        # device-observatory residency record (the PlaneRegistry shape)
+        self._gen = 0
+        self.hbm_high_water = 0
+        self.evictions_by_cause: Dict[str, int] = {}
 
     # -- config ---------------------------------------------------------
 
@@ -964,7 +1013,8 @@ class MeshPlaneRegistry:
                 self._refuse(key)
                 return None
             while self._parts:
-                self._drop(next(iter(self._parts)))
+                self._drop(next(iter(self._parts)),
+                           cause="breaker_pressure")
                 try:
                     charge = charge_device(part, part.per_device_bytes,
                                            label, return_charge=True)
@@ -977,13 +1027,19 @@ class MeshPlaneRegistry:
         part._charges.append(charge)
         self._upload(part, stacked)
         self.stats["mesh_plane_builds"] += 1
+        part.generation = self._gen
+        part.built_at = time.monotonic()
+        self._gen += 1
         if prev is not None:
             self.stats["mesh_plane_incremental_appends"] += 1
         else:
             self.stats["mesh_plane_full_rebuilds"] += 1
         self._parts[key] = part
+        self.hbm_high_water = max(
+            self.hbm_high_water,
+            sum(p.nbytes for p in self._parts.values()))
         while len(self._parts) > self.MAX_PARTS:
-            self._drop(next(iter(self._parts)))
+            self._drop(next(iter(self._parts)), cause="lru")
         return part
 
     # -- stacking -------------------------------------------------------
@@ -1062,11 +1118,14 @@ class MeshPlaneRegistry:
 
     # -- eviction / lifecycle -------------------------------------------
 
-    def _drop(self, key: Tuple, count_eviction: bool = True) -> None:
+    def _drop(self, key: Tuple, count_eviction: bool = True,
+              cause: str = "lru") -> None:
         part = self._parts.pop(key, None)
         if part is None:
             return
         part.release()
+        self.evictions_by_cause[cause] = \
+            self.evictions_by_cause.get(cause, 0) + 1
         if count_eviction:
             self.stats["mesh_plane_evictions"] += 1
 
@@ -1078,11 +1137,12 @@ class MeshPlaneRegistry:
                     if any(uids.intersection(
                         s.uid for s in segs)
                         for segs in p.segments_by_shard)]:
-            self._drop(key, count_eviction=False)
+            self._drop(key, count_eviction=False,
+                       cause="merge_invalidated")
 
     def clear(self) -> None:
         for key in list(self._parts):
-            self._drop(key, count_eviction=False)
+            self._drop(key, count_eviction=False, cause="clear")
         self._refused.clear()
         self._cfg_version = object()   # force a settings re-read
 
@@ -1128,6 +1188,34 @@ class MeshPlaneRegistry:
             import jax
             out["n_devices"] = len(jax.devices())
         return out
+
+    def residency_snapshot(self) -> Dict[str, Any]:
+        """PlaneRegistry.residency_snapshot's mesh counterpart; entries
+        carry the slot count and per-device share too (each slot's stack
+        share lives on one chip)."""
+        now = time.monotonic()
+        total = 0
+        planes = []
+        for p in self._parts.values():
+            total += p.nbytes
+            planes.append({
+                "kind": p.kind, "field": p.field,
+                "bytes": int(p.nbytes),
+                "bytes_per_device": int(p.per_device_bytes),
+                "n_shards": int(p.n_shards),
+                "generation": int(getattr(p, "generation", 0)),
+                "age_s": round(now - getattr(p, "built_at", now), 3),
+            })
+        planes.sort(key=lambda e: -e["age_s"])
+        self.hbm_high_water = max(self.hbm_high_water, total)
+        return {
+            "resident_bytes_total": total,
+            "high_water_bytes": int(self.hbm_high_water),
+            "generations_built": int(self._gen),
+            "planes": planes,
+            "evictions_by_cause": dict(
+                sorted(self.evictions_by_cause.items())),
+        }
 
 
 # the mesh plane shares the process-global residency reasoning of PLANES

@@ -13,14 +13,16 @@ common hybrid.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
+from elasticsearch_tpu.search.device_profile import profiled_jit
 
-@partial(jax.jit, static_argnames=("n_docs_pad", "k", "rank_constant"))
+
+@profiled_jit("rrf_fuse",
+              static_argnames=("n_docs_pad", "k", "rank_constant"))
 def rrf_fuse(doc_lists: jnp.ndarray,   # [R, K] int32 per-retriever ranked docs (-1 pad)
              n_docs_pad: int, k: int,
              rank_constant: int = 60) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -39,7 +41,8 @@ def rrf_fuse(doc_lists: jnp.ndarray,   # [R, K] int32 per-retriever ranked docs 
     return jax.lax.top_k(top, k)
 
 
-@partial(jax.jit, static_argnames=("n_docs_pad", "k", "rank_constant"))
+@profiled_jit("rrf_fuse_batch",
+              static_argnames=("n_docs_pad", "k", "rank_constant"))
 def rrf_fuse_batch(doc_lists: jnp.ndarray,   # [B, R, K] int32 (-1 pad)
                    n_docs_pad: int, k: int,
                    rank_constant: int = 60
@@ -60,7 +63,7 @@ def rrf_fuse_batch(doc_lists: jnp.ndarray,   # [B, R, K] int32 (-1 pad)
     return scores, docs
 
 
-@partial(jax.jit, static_argnames=("k", "normalize"))
+@profiled_jit("linear_fuse", static_argnames=("k", "normalize"))
 def linear_fuse(score_arrays: jnp.ndarray,   # [R, N_pad] dense scores per retriever
                 weights: jnp.ndarray,        # [R]
                 live: jnp.ndarray,           # [N_pad] bool

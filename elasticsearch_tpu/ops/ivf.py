@@ -18,7 +18,6 @@ distance matmul + argmin + segment-sum.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
 import numpy as np
@@ -26,12 +25,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from elasticsearch_tpu.search.device_profile import profiled_jit
+from elasticsearch_tpu.search.telemetry import record_dispatch
+
 
 # ---------------------------------------------------------------------------
 # k-means (device)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("nlist",))
+@profiled_jit("ivf_assign", static_argnames=("nlist",))
 def _assign(x: jnp.ndarray, centroids: jnp.ndarray, nlist: int
             ) -> jnp.ndarray:
     # ||x-c||^2 = ||x||^2 - 2 x.c + ||c||^2 ; ||x||^2 constant per row
@@ -68,7 +70,7 @@ def assign_chunked(x: jnp.ndarray, centroids: jnp.ndarray, nlist: int
     return jnp.concatenate(outs)
 
 
-@partial(jax.jit, static_argnames=("nlist",))
+@profiled_jit("ivf_update", static_argnames=("nlist",))
 def _update(x: jnp.ndarray, assign: jnp.ndarray, centroids: jnp.ndarray,
             nlist: int) -> jnp.ndarray:
     sums = jax.ops.segment_sum(x, assign, num_segments=nlist)
@@ -79,7 +81,7 @@ def _update(x: jnp.ndarray, assign: jnp.ndarray, centroids: jnp.ndarray,
     return jnp.where((counts > 0)[:, None], fresh, centroids)
 
 
-@partial(jax.jit, static_argnames=("nlist",))
+@profiled_jit("ivf_init", static_argnames=("nlist",))
 def _farthest_point_init(x: jnp.ndarray, first: jnp.ndarray,
                          nlist: int) -> jnp.ndarray:
     """Deterministic k-center seeding: repeatedly take the point farthest
@@ -253,7 +255,6 @@ class IVFIndex:
         """Device-in/device-out single-kernel search (no host sync): the
         serving path — callers pipeline batches without paying a dispatch
         round-trip per batch."""
-        from elasticsearch_tpu.search.telemetry import record_dispatch
         record_dispatch()
         nprobe = max(1, min(int(nprobe), self.nlist))
         k = max(1, min(int(k), nprobe * self.list_len))
@@ -292,7 +293,6 @@ class IVFIndex:
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Batched ANN: (scores [Q, k], ids [Q, k]); ids -1 past matches.
         Scores use the same positive transforms as ops/knn.py."""
-        from elasticsearch_tpu.search.telemetry import record_dispatch
         record_dispatch()
         q = np.asarray(queries, np.float32)
         if q.ndim == 1:
@@ -323,7 +323,8 @@ class IVFIndex:
         return out_s, out_i
 
 
-@partial(jax.jit, static_argnames=("k", "nprobe", "similarity"))
+@profiled_jit("ivf_search",
+              static_argnames=("k", "nprobe", "similarity"))
 def _ivf_search(q, centroids, lists, valid, ids, norms, k: int,
                 nprobe: int, similarity: str):
     qb = q.astype(jnp.bfloat16)

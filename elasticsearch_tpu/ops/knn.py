@@ -17,7 +17,6 @@ bf16 is used for the multiply (MXU native) with f32 accumulation.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import numpy as np
@@ -26,9 +25,11 @@ import jax
 import jax.numpy as jnp
 
 from elasticsearch_tpu.ops.device_segment import DeviceVectors
+from elasticsearch_tpu.search.device_profile import profiled_jit
+from elasticsearch_tpu.search.telemetry import record_dispatch
 
 
-@partial(jax.jit, static_argnames=("similarity",))
+@profiled_jit("knn_vector_scores", static_argnames=("similarity",))
 def vector_scores(matrix: jnp.ndarray,     # [N_pad, D] f32
                   norms: jnp.ndarray,      # [N_pad] f32
                   exists: jnp.ndarray,     # [N_pad] bool
@@ -56,7 +57,7 @@ def vector_scores(matrix: jnp.ndarray,     # [N_pad, D] f32
     return jnp.where(exists, scores, 0.0)
 
 
-@partial(jax.jit, static_argnames=("similarity", "k"))
+@profiled_jit("knn_topk", static_argnames=("similarity", "k"))
 def knn_topk(matrix, norms, exists, live, query, k: int,
              similarity: str = "cosine") -> Tuple[jnp.ndarray, jnp.ndarray]:
     scores = vector_scores(matrix, norms, exists, query, similarity)
@@ -80,7 +81,7 @@ def _batch_scores(matrix, norms, queries, similarity: str) -> jnp.ndarray:
     return _coarse_similarity(dots, norms, queries, similarity)
 
 
-@partial(jax.jit, static_argnames=("similarity", "k"))
+@profiled_jit("knn_topk_batch", static_argnames=("similarity", "k"))
 def knn_topk_batch(matrix, norms, exists, live, queries, k: int,
                    similarity: str = "cosine") -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Batched kNN: queries [B, D] -> (scores [B, k], docs [B, k]).
@@ -92,7 +93,8 @@ def knn_topk_batch(matrix, norms, exists, live, queries, k: int,
     return jax.lax.top_k(scores, k)
 
 
-@partial(jax.jit, static_argnames=("similarity", "k"))
+@profiled_jit("knn_topk_batch_masked",
+              static_argnames=("similarity", "k"))
 def knn_topk_batch_masked(matrix, norms, exists, live, queries, masks,
                           k: int, similarity: str = "cosine"
                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -164,7 +166,7 @@ def _coarse_plane(q8, scales, norms, queries, similarity: str
     return _coarse_similarity(dots, norms, queries, similarity)
 
 
-@partial(jax.jit, static_argnames=("similarity", "kprime"))
+@profiled_jit("knn_coarse", static_argnames=("similarity", "kprime"))
 def knn_coarse_candidates(q8, scales, norms, allowed, queries,
                           kprime: int, similarity: str = "cosine"
                           ) -> jnp.ndarray:
@@ -177,7 +179,8 @@ def knn_coarse_candidates(q8, scales, norms, allowed, queries,
     return cand
 
 
-@partial(jax.jit, static_argnames=("similarity", "kprime"))
+@profiled_jit("knn_coarse_masked",
+              static_argnames=("similarity", "kprime"))
 def knn_coarse_candidates_masked(q8, scales, norms, allowed, queries,
                                  masks, kprime: int,
                                  similarity: str = "cosine") -> jnp.ndarray:
@@ -216,7 +219,7 @@ def _rerank_topk(s, cand, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return ts, td
 
 
-@partial(jax.jit, static_argnames=("similarity", "k"))
+@profiled_jit("knn_rerank", static_argnames=("similarity", "k"))
 def knn_rerank_exact(matrix, norms, allowed, queries, cand, k: int,
                      similarity: str = "cosine"
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -228,7 +231,8 @@ def knn_rerank_exact(matrix, norms, allowed, queries, cand, k: int,
     return _rerank_topk(s, cand, k)
 
 
-@partial(jax.jit, static_argnames=("similarity", "k"))
+@profiled_jit("knn_rerank_masked",
+              static_argnames=("similarity", "k"))
 def knn_rerank_exact_masked(matrix, norms, allowed, queries, cand, masks,
                             k: int, similarity: str = "cosine"
                             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -248,7 +252,6 @@ class KnnExecutor:
         self.dev = device_vectors
 
     def top_k(self, query, live, k: int):
-        from elasticsearch_tpu.search.telemetry import record_dispatch
         record_dispatch()
         q = jnp.asarray(query, jnp.float32)
         return knn_topk(self.dev.matrix, self.dev.norms, self.dev.exists,
@@ -266,7 +269,6 @@ class KnnExecutor:
         faceted-nav case — it simply folds into ``live``, exactly as the
         solo path's ``live & fmask``), or a [Q, N_pad] stack of per-query
         masks applied inside the one masked matmul dispatch."""
-        from elasticsearch_tpu.search.telemetry import record_dispatch
         record_dispatch()
         q_host, n_real = pad_queries_pow2(queries)
         if masks is not None and getattr(masks, "ndim", 1) == 2:

@@ -13,7 +13,6 @@ transform selected statically.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
 import numpy as np
@@ -23,9 +22,12 @@ import jax.numpy as jnp
 
 from elasticsearch_tpu.index.segment import FeaturesField, next_pow2
 from elasticsearch_tpu.ops.device_segment import DeviceFeatures
+from elasticsearch_tpu.search.device_profile import profiled_jit
+from elasticsearch_tpu.search.telemetry import record_dispatch
 
 
-@partial(jax.jit, static_argnames=("n_docs_pad", "function", "k"))
+@profiled_jit("sparse_topk",
+              static_argnames=("n_docs_pad", "function", "k"))
 def sparse_topk(block_docs, block_weights, block_idx, query_weight,
                 pivot, exponent, live, n_docs_pad: int, k: int,
                 function: str = "saturation") -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -35,7 +37,8 @@ def sparse_topk(block_docs, block_weights, block_idx, query_weight,
     return jax.lax.top_k(scores, k)
 
 
-@partial(jax.jit, static_argnames=("n_docs_pad", "function"))
+@profiled_jit("sparse_scores",
+              static_argnames=("n_docs_pad", "function"))
 def sparse_scores(block_docs,      # [NB, BLOCK] int32
                   block_weights,   # [NB, BLOCK] f32
                   block_idx,       # [QB] int32
@@ -64,7 +67,8 @@ def sparse_scores(block_docs,      # [NB, BLOCK] int32
     return scores.at[safe_docs.reshape(-1)].add(contrib.reshape(-1), mode="drop")
 
 
-@partial(jax.jit, static_argnames=("n_docs_pad", "k", "function", "counted"))
+@profiled_jit("sparse_topk_batch",
+              static_argnames=("n_docs_pad", "k", "function", "counted"))
 def sparse_topk_batch(block_docs, block_weights,
                       block_idx,       # [Q, QB] int32
                       query_weight,    # [Q, QB] f32 (0 = padding)
@@ -136,7 +140,6 @@ class SparseExecutor:
     def top_k(self, features_with_weights, live, k: int,
               function: str = "linear", pivot: float = 1.0,
               exponent: float = 1.0):
-        from elasticsearch_tpu.search.telemetry import record_dispatch
         record_dispatch()
         block_idx, qw = gather_feature_blocks(self.host, features_with_weights)
         return sparse_topk(self.dev.block_docs, self.dev.block_weights,
@@ -152,7 +155,6 @@ class SparseExecutor:
         a shared bucket (block 0 / weight 0 pads contribute nothing); the
         query dimension pads to a pow2 bucket so the jit cache stays warm.
         With ``count_hits`` also returns exact per-query match counts."""
-        from elasticsearch_tpu.search.telemetry import record_dispatch
         record_dispatch()
         per = [gather_feature_blocks(self.host, q, bucket_min=1)
                for q in queries]

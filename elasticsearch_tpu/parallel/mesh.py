@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elasticsearch_tpu.search.device_profile import profiled_callable
+
 
 def make_mesh(n_shards: Optional[int] = None, n_dp: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
@@ -157,7 +159,7 @@ def mesh_bm25_flat(mesh: Mesh, n_docs_pad: int, n_q: int, k: int,
 
     p3 = P("shard", None, None)
     p2 = P("shard", None)
-    fn = jax.jit(shard_map(
+    fn = profiled_callable("mesh_bm25_flat", shard_map(
         local, mesh=mesh,
         in_specs=(p3, p3, p2, p2, p2, p2, p2, p2, p2),
         out_specs=(p3, p3, p3), check_vma=False))
@@ -201,7 +203,7 @@ def mesh_sparse_topk(mesh: Mesh, n_docs_pad: int, k: int):
 
     p3 = P("shard", None, None)
     p2 = P("shard", None)
-    fn = jax.jit(shard_map(
+    fn = profiled_callable("mesh_sparse_topk", shard_map(
         local, mesh=mesh,
         in_specs=(p3, p3, p3, p3, p2),
         out_specs=(p3, p3, p2), check_vma=False))
@@ -248,12 +250,12 @@ def mesh_knn_topk(mesh: Mesh, k: int, similarity: str, masked: bool):
     pq = P("dp", None)
     pout = P("shard", "dp", None)
     if masked:
-        fn = jax.jit(shard_map(
+        fn = profiled_callable("mesh_knn_topk", shard_map(
             local, mesh=mesh,
             in_specs=(p3, p2, p2, pq, P("shard", "dp", None)),
             out_specs=(pout, pout), check_vma=False))
     else:
-        fn = jax.jit(shard_map(
+        fn = profiled_callable("mesh_knn_topk", shard_map(
             lambda m, nr, al, q: local(m, nr, al, q), mesh=mesh,
             in_specs=(p3, p2, p2, pq),
             out_specs=(pout, pout), check_vma=False))

@@ -631,6 +631,19 @@ def build_controller(client: NodeClient) -> RestController:
         done(200, "\n".join(lines) + "\n")
     r("GET", "/_nodes/hot_threads", hot_threads)
 
+    def hot_spans(req: RestRequest, done: DoneFn) -> None:
+        """The hot-threads analog over the data planes: the top in-flight
+        search spans with their phase, data plane, drain occupancy and
+        elapsed time, plus the shard batcher's queued members."""
+        from elasticsearch_tpu import monitor
+        try:
+            limit = int(req.query.get("size", 16) or 16)
+        except (TypeError, ValueError):
+            limit = 16
+        done(200, {client.node.node_id:
+                   monitor.hot_spans_report(client.node, limit=limit)})
+    r("GET", "/_nodes/hot_spans", hot_spans)
+
     def reroute_post(req: RestRequest, done: DoneFn) -> None:
         from elasticsearch_tpu.action.admin import REROUTE
         client.node.master_client.execute(
@@ -1357,16 +1370,26 @@ def build_controller(client: NodeClient) -> RestController:
                     # recomputed from the merged distribution (the
                     # nodes-stats aggregation leg — PR 8 follow-up)
                     merged: Dict[str, Any] = {}
+                    merged_dp: Dict[str, Any] = {}
+                    node_sections = list(
+                        (ns_resp or {}).get("nodes", {}).values())
                     try:
                         from elasticsearch_tpu.search.telemetry import (
                             merge_latency_sections,
                         )
                         merged = merge_latency_sections(
                             [n.get("search_latency") or {}
-                             for n in (ns_resp or {}).get(
-                                 "nodes", {}).values()])
+                             for n in node_sections])
                     except Exception:  # noqa: BLE001 — stats must serve
                         merged = {}
+                    try:
+                        from elasticsearch_tpu.search.device_profile \
+                            import merge_device_profile_sections
+                        merged_dp = merge_device_profile_sections(
+                            [n.get("device_profile") or {}
+                             for n in node_sections])
+                    except Exception:  # noqa: BLE001 — stats must serve
+                        merged_dp = {}
                     done(200, {
                         "cluster_name": state.cluster_name,
                         "status": h["status"],
@@ -1393,6 +1416,10 @@ def build_controller(client: NodeClient) -> RestController:
                             "versions": [__version__],
                         },
                         "search_latency": merged,
+                        # fleet-merged device observatory (per-family
+                        # compile/recompile counters summed, compile-ms
+                        # maxima kept as maxima)
+                        "device_profile": merged_dp,
                     })
                 # section-filtered fan-out: every node builds ONLY its
                 # search_latency section for this merge, not the full
@@ -1400,9 +1427,10 @@ def build_controller(client: NodeClient) -> RestController:
                 # a short timeout so a dead-but-still-in-state node
                 # can't stall a polled monitoring endpoint for 30s (the
                 # merge tolerates missing nodes)
-                client.nodes_stats_all(finish,
-                                       sections=("search_latency",),
-                                       timeout=5.0)
+                client.nodes_stats_all(
+                    finish,
+                    sections=("search_latency", "device_profile"),
+                    timeout=5.0)
 
             # status through the master-routed health path (the
             # unverified-STARTED gate lives on the elected master only; a

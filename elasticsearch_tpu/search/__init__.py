@@ -1,14 +1,27 @@
-from elasticsearch_tpu.search import dsl
-from elasticsearch_tpu.search.execute import SegmentContext, execute
-from elasticsearch_tpu.search.fetch import fetch_hits, filter_source
-from elasticsearch_tpu.search.phase import (
-    ShardDoc,
-    ShardQueryResult,
-    SortSpec,
-    parse_sort,
-    query_shard,
-)
-from elasticsearch_tpu.search.service import SearchService
+"""Public search surface, resolved lazily (PEP 562).
+
+The ops modules import ``search.device_profile`` / ``search.telemetry``
+at module load (every jit entry point routes through the profiled-jit
+wrapper), and the serving stack under this package imports ops — an
+eager ``__init__`` would close that cycle mid-import. Importing this
+package therefore has no side effects; the exported names resolve on
+first attribute access and then stay bound.
+"""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "SearchService": "elasticsearch_tpu.search.service",
+    "SegmentContext": "elasticsearch_tpu.search.execute",
+    "execute": "elasticsearch_tpu.search.execute",
+    "fetch_hits": "elasticsearch_tpu.search.fetch",
+    "filter_source": "elasticsearch_tpu.search.fetch",
+    "ShardDoc": "elasticsearch_tpu.search.phase",
+    "ShardQueryResult": "elasticsearch_tpu.search.phase",
+    "SortSpec": "elasticsearch_tpu.search.phase",
+    "parse_sort": "elasticsearch_tpu.search.phase",
+    "query_shard": "elasticsearch_tpu.search.phase",
+}
 
 __all__ = [
     "SearchService",
@@ -23,3 +36,39 @@ __all__ = [
     "parse_sort",
     "query_shard",
 ]
+
+if TYPE_CHECKING:  # pragma: no cover — static analysis only
+    from elasticsearch_tpu.search import dsl  # noqa: F401
+    from elasticsearch_tpu.search.execute import (  # noqa: F401
+        SegmentContext, execute,
+    )
+    from elasticsearch_tpu.search.fetch import (  # noqa: F401
+        fetch_hits, filter_source,
+    )
+    from elasticsearch_tpu.search.phase import (  # noqa: F401
+        ShardDoc, ShardQueryResult, SortSpec, parse_sort, query_shard,
+    )
+    from elasticsearch_tpu.search.service import SearchService  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+    module = _EXPORTS.get(name)
+    if module is not None:
+        value = getattr(importlib.import_module(module), name)
+    else:
+        qualified = f"elasticsearch_tpu.search.{name}"
+        try:
+            value = importlib.import_module(qualified)
+        except ModuleNotFoundError as e:
+            if e.name != qualified:
+                raise   # a submodule's own missing dependency: surface it
+            raise AttributeError(
+                f"module 'elasticsearch_tpu.search' has no attribute "
+                f"{name!r}") from None
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(__all__)

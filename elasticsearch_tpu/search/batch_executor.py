@@ -192,6 +192,19 @@ class _Member:
 _CLASS_OF_KIND = {"text": "bm25", "knn": "knn", "sparse": "sparse"}
 
 
+def _copy_compiles(source: SearchTrace, dest: SearchTrace) -> None:
+    """A drain's XLA compiles (device observatory attribution) belong to
+    every member that shared the dispatch: the slow-log first-compile
+    flag and the profile compile spans must land on the member traces,
+    not die with the drain-scoped trace."""
+    if not source.compiles:
+        return
+    dest.compiles += source.compiles
+    for name, dur_ns, meta in source.spans:
+        if name == "compile":
+            dest.add_span(name, dur_ns, dict(meta) if meta else None)
+
+
 def dense_spec(req: Dict[str, Any]) -> BatchSpec:
     """The per-member execution kind: a canonical request identity for
     the per-drain memo (identical dense members execute once per drain,
@@ -959,6 +972,7 @@ class ShardQueryBatcher:
                 if not dense and m.result is not None:
                     m.trace.dispatches = sub.dispatches
                     m.trace.plane_backed = sub.plane_backed
+                    _copy_compiles(sub, m.trace)
                     m.trace.add_span(
                         "device_dispatch", time.monotonic_ns() - t_re,
                         {"occupancy": 1, "redrain": 1})
@@ -985,6 +999,7 @@ class ShardQueryBatcher:
                 t = m.trace
                 t.dispatches = drain_trace.dispatches
                 t.plane_backed = drain_trace.plane_backed
+                _copy_compiles(drain_trace, t)
                 t.add_span("device_dispatch", exec_ns, dict(meta))
                 t.finish()
                 TELEMETRY.observe(t)
@@ -1029,13 +1044,20 @@ class ShardQueryBatcher:
         for m in members:
             self._finish(m)
 
-    def _set_phase(self, members: List[_Member], phase: str) -> None:
+    def _set_phase(self, members: List[_Member], phase: str,
+                   occupancy: Optional[int] = None) -> None:
         """_tasks phase fidelity: a shard task shows its current
         sub-phase (queued -> query -> dispatch -> demux) instead of
-        "query" for its whole life — occupancy-1 members included."""
+        "query" for its whole life — occupancy-1 members included.
+        ``occupancy`` (drain width) rides the status so the hot-spans
+        sampler (GET /_nodes/hot_spans) can show which in-flight spans
+        share one device dispatch."""
         for m in members:
             if m.task is not None and m.error is None:
-                m.task.status = {"phase": phase, "data_plane": "batch"}
+                status = {"phase": phase, "data_plane": "batch"}
+                if occupancy:
+                    status["occupancy"] = occupancy
+                m.task.status = status
 
     def _execute(self, key: Tuple, members: List[_Member]) -> None:
         from elasticsearch_tpu.action.search_action import (
@@ -1102,7 +1124,7 @@ class ShardQueryBatcher:
         breaker = BREAKERS.breaker("request")
         n_q = len(uniques)
         want = spec0.window
-        self._set_phase(members, "dispatch")
+        self._set_phase(members, "dispatch", occupancy=len(members))
         # observe what the drain ACTUALLY charges (outer transient scope
         # plus everything the executors charge inside it) so the per-key
         # cap can pre-shrink from measurement instead of waiting for the
@@ -1141,7 +1163,7 @@ class ShardQueryBatcher:
             st["charge_per_member"] = per if not prev else \
                 0.3 * per + 0.7 * prev
 
-        self._set_phase(members, "demux")
+        self._set_phase(members, "demux", occupancy=len(members))
         # response rows are copy-on-write: the docs payload of a memo'd
         # plan is built ONCE for its unique and shared by every
         # duplicate (responses are serialized downstream, never

@@ -33,7 +33,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.search import telemetry
 from elasticsearch_tpu.search.batch_executor import (
-    BatchSpec, _CLASS_OF_KIND, _build_ctxs, _knn_demux, classify_request,
+    BatchSpec, _CLASS_OF_KIND, _build_ctxs, _copy_compiles, _knn_demux,
+    classify_request,
 )
 from elasticsearch_tpu.search.telemetry import TELEMETRY, SearchTrace
 from elasticsearch_tpu.utils.settings import SEARCH_MESH_ENABLED
@@ -236,6 +237,7 @@ class MeshSearchExecutor:
             t = m.trace
             t.add_span("queue_wait", t_exec - m.enqueued_ns)
             t.dispatches = drain_trace.dispatches
+            _copy_compiles(drain_trace, t)
             t.add_span("device_dispatch", exec_ns, dict(meta))
             t.finish()
             TELEMETRY.observe(t)

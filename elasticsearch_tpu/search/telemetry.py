@@ -107,7 +107,7 @@ class SearchTrace:
     so a flat list IS the tree."""
 
     __slots__ = ("query_class", "data_plane", "spans", "dispatches",
-                 "t0_ns", "total_ns", "plane_backed")
+                 "t0_ns", "total_ns", "plane_backed", "compiles")
 
     def __init__(self, query_class: str = "other",
                  data_plane: str = "solo"):
@@ -118,6 +118,10 @@ class SearchTrace:
         self.t0_ns = time.monotonic_ns()
         self.total_ns = 0
         self.plane_backed = False
+        # XLA compiles attributed to this request (the device observatory
+        # records them through record_compile — a first-compile request
+        # pays seconds of latency the phase spans alone can't explain)
+        self.compiles = 0
 
     # -- span recording --------------------------------------------------
 
@@ -175,11 +179,14 @@ class SearchTrace:
         return out
 
     def summary(self) -> str:
-        """One-line phase breakdown for slow-log lines."""
+        """One-line phase breakdown for slow-log lines. A request that
+        paid XLA compiles is flagged — a first-compile p99 outlier then
+        explains itself without a profile re-run."""
         parts = [f"{n}={d / 1e6:.2f}ms" for n, d, _m in self.spans]
+        compiled = f"compiles[{self.compiles}], " if self.compiles else ""
         return (f"data_plane[{self.data_plane}], "
                 f"dispatches[{self.dispatches}], "
-                f"phases[{' '.join(parts)}]")
+                f"{compiled}phases[{' '.join(parts)}]")
 
 
 # the active trace: set by the serving paths around execution so the
@@ -209,6 +216,20 @@ def record_dispatch(n: int = 1) -> None:
     t = _current.get()
     if t is not None:
         t.dispatches += n
+
+
+def record_compile(family: str, dur_ns: int) -> None:
+    """Called by the device observatory (search/device_profile.py) when
+    a profiled kernel call compiled: the active request's trace gains a
+    ``compile`` span — ``profile: true`` responses show the compile_ms,
+    slow logs flag the request — without the jitted function itself
+    having to know about requests."""
+    t = _current.get()
+    if t is not None:
+        t.compiles += 1
+        t.add_span("compile", dur_ns,
+                   {"family": family,
+                    "compile_ms": round(dur_ns / 1e6, 3)})
 
 
 def mark_plane_served() -> None:

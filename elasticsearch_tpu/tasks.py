@@ -78,6 +78,11 @@ class TaskManager:
         import time
         self._now_ms = now_ms or (lambda: time.time() * 1000)
 
+    def now_ms(self) -> float:
+        """This registry's clock (scheduler time on a node) — hot-spans
+        elapsed times must read the SAME clock start_time_ms uses."""
+        return self._now_ms()
+
     def register(self, action: str, description: str = "",
                  cancellable: bool = False,
                  parent_task_id: Optional[str] = None) -> Task:

@@ -164,7 +164,7 @@ class Pool:
             len(self.rejected_by_tenant) < self.TENANT_CAP else "_other"
         self.rejected_by_tenant[key] = \
             self.rejected_by_tenant.get(key, 0) + 1
-        retry_after = self.retry_after_s()
+        retry_after = self.retry_after_s(tenant)
         self.retry_after_issued += 1
         self.last_retry_after_s = retry_after
         # ONE carrier for the computed backoff: the error metadata (it
@@ -175,15 +175,28 @@ class Pool:
             f"[{self.queue_size}] reached", retry_after=retry_after,
             tenant=tenant)
 
-    def retry_after_s(self) -> int:
+    def retry_after_s(self, tenant: Optional[str] = None) -> int:
         """Seconds until a new request is expected to be admitted: the
-        queue ahead of it drained at the measured completion rate. With
-        no rate measured yet (cold pool), a 1s floor — honest enough for
-        a client's first backoff."""
+        backlog ahead of it drained at the measured completion rate.
+        With no rate measured yet (cold pool), a 1s floor — honest
+        enough for a client's first backoff.
+
+        With multiple tenants queued, a rejected ``tenant``'s estimate
+        uses its OWN backlog at its FAIR SHARE of the pool rate (the
+        queues drain round-robin, so a displacement-shed hot tenant's
+        backlog drains at rate/n_tenants — quoting the whole-pool rate
+        told exactly the tenants being shed to come back soonest). With
+        one (or no) tenant queued, the fair share IS the pool rate and
+        the estimate reduces to the whole-queue drain time."""
         if self.task_rate <= 0.0:
             est = 1.0
         else:
-            est = (self.queued_total + 1) / self.task_rate
+            n_tenants = len(self.queues)
+            if tenant is not None and n_tenants > 1:
+                depth = len(self.queues.get(tenant, ())) + 1
+                est = depth * n_tenants / self.task_rate
+            else:
+                est = (self.queued_total + 1) / self.task_rate
         return max(1, min(self.RETRY_AFTER_MAX_S, int(math.ceil(est))))
 
     # -- completion + Little's-law resizing -------------------------------

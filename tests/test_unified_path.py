@@ -361,12 +361,12 @@ def test_tasks_phase_fidelity_occupancy_one(cluster, monkeypatch):
     seen = []
     orig = batcher._set_phase
 
-    def spy(members, phase):
+    def spy(members, phase, occupancy=None):
         for m in members:
             if m.task is not None:
                 seen.append(phase)
                 break
-        orig(members, phase)
+        orig(members, phase, occupancy=occupancy)
     monkeypatch.setattr(batcher, "_set_phase", spy)
 
     for body in ({"query": {"match": {"body": "w1 w2"}}},   # text kind
