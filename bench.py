@@ -876,10 +876,87 @@ def cfg_device_profile(np, jax, jnp, result):
         got = s_ex.top_k_batch(expansions, s_live, K, function="linear")
         block(got[0])
 
+    # the quantized coarse tier's kernel families (bm25/sparse bf16
+    # coarse + exact re-rank, kNN int8 coarse + exact re-rank): the
+    # two-tier serving path must hold the same zero-steady-state-
+    # recompile contract as the exact kernels it shadows
+    from elasticsearch_tpu.index.segment import next_pow2
+    from elasticsearch_tpu.ops.bm25 import (
+        _bm25_coarse_kernel, _bm25_rerank_kernel, flatten_plans,
+        qb_bucket,
+    )
+    kprime = 128
+    plans16 = b_ex.build_plans(text_queries[:16])
+    fb = qb_bucket(max(sum(p.n_blocks for p in plans16), 1))
+    bidx, bw, bqid = flatten_plans(plans16, fb)
+    bfavg = np.full(fb, float(b_dev.avgdl), np.float32)
+    bidx_d, bw_d = jnp.asarray(bidx), jnp.asarray(bw)
+    bqid_d, bfavg_d = jnp.asarray(bqid), jnp.asarray(bfavg)
+    tf16 = jnp.asarray(np.asarray(b_dev.block_tfs)
+                       .astype(jnp.bfloat16))
+    dl16 = jnp.asarray(np.asarray(b_dev.doc_lens)
+                       .astype(jnp.bfloat16))
+    seg_ids = jnp.zeros((b_dev.n_docs_pad,), jnp.int32)
+
+    def run_bm25_coarse():
+        cs, cand, _hits = _bm25_coarse_kernel(
+            b_dev.block_docs, tf16, bidx_d, bw_d, bqid_d, dl16, bfavg_d,
+            b_live, seg_ids, b_dev.n_docs_pad, 16, 1, kprime)
+        s, _d, _eps = _bm25_rerank_kernel(
+            b_dev.block_docs, b_dev.block_tfs, bidx_d, bw_d, bqid_d,
+            b_dev.doc_lens, bfavg_d, b_live, cand, cs,
+            b_dev.n_docs_pad, 16, kprime, K)
+        block(s)
+
+    from elasticsearch_tpu.ops.knn import (
+        knn_coarse_candidates, knn_rerank_exact,
+    )
+    m_host = np.asarray(matrix)
+    amax = np.abs(m_host).max(axis=1)
+    scales8 = np.maximum(amax / 127.0, 1e-30).astype(np.float32)
+    q8 = jnp.asarray(np.clip(np.round(m_host / scales8[:, None]),
+                             -127, 127).astype(np.int8))
+    scales8 = jnp.asarray(scales8)
+
+    def run_knn_coarse():
+        cs, cand = knn_coarse_candidates(q8, scales8, norms, ones,
+                                         q_dev, kprime, "cosine")
+        s, _d, _eps = knn_rerank_exact(matrix, norms, ones, q_dev,
+                                       cand, cs, K, "cosine")
+        block(s)
+
+    from elasticsearch_tpu.ops.sparse import (
+        gather_feature_blocks, sparse_coarse_kernel, sparse_rerank_kernel,
+    )
+    sp_per = [gather_feature_blocks(ff, e, bucket_min=1)
+              for e in expansions]
+    sp_qb = next_pow2(max((len(i) for i, _ in sp_per), default=1),
+                      minimum=8)
+    sp_idx = np.zeros((16, sp_qb), np.int32)
+    sp_w = np.zeros((16, sp_qb), np.float32)
+    for i, (bi, bw_row) in enumerate(sp_per):
+        sp_idx[i, : len(bi)] = bi
+        sp_w[i, : len(bw_row)] = bw_row
+    sp_idx_d, sp_w_d = jnp.asarray(sp_idx), jnp.asarray(sp_w)
+    w16 = jnp.asarray(np.asarray(s_ex.dev.block_weights)
+                      .astype(jnp.bfloat16))
+
+    def run_sparse_coarse():
+        cs, cand, _hits = sparse_coarse_kernel(
+            s_ex.dev.block_docs, w16, sp_idx_d, sp_w_d, s_live,
+            s_ex.dev.n_docs_pad, kprime)
+        s, _d, _eps = sparse_rerank_kernel(
+            s_ex.dev.block_docs, s_ex.dev.block_weights, sp_idx_d,
+            sp_w_d, s_live, cand, cs, s_ex.dev.n_docs_pad, kprime, K)
+        block(s)
+
     out = {"warm_iters": 2, "steady_iters": 3}
     ok_all = True
     for name, fn in (("bm25", run_bm25), ("knn", run_knn),
-                     ("sparse", run_sparse)):
+                     ("sparse", run_sparse),
+                     ("bm25_coarse", run_bm25_coarse),
+                     ("knn_coarse", run_knn_coarse),
+                     ("sparse_coarse", run_sparse_coarse)):
         before_warm = DEVICE_PROFILE.total_compiles()
         for _ in range(2):
             fn()
@@ -1260,6 +1337,56 @@ def cfg_segmented(np, jax, jnp, result):
                 "device_dispatches_per_query_plane": len(plane_counter),
             }
 
+            # ---- bm25 quantized two-tier leg (bf16 coarse over the
+            # full plans + exact f32 re-rank of the top 128): the
+            # serving path's coarse tier measured at the kernel level,
+            # with top-k overlap vs the exact plane leg recorded
+            from elasticsearch_tpu.index.segment import next_pow2
+            from elasticsearch_tpu.ops.bm25 import (
+                _bm25_coarse_kernel, _bm25_rerank_kernel, flatten_plans,
+                qb_bucket,
+            )
+            mirror = part.quantized_mirror()
+            if mirror is not None:
+                tf16, dl16 = mirror
+                kprime = min(128, part.n_docs_pad)
+                n_qp = next_pow2(n_q, minimum=1)
+                fbq = qb_bucket(max(sum(p.n_blocks for p in plans), 1))
+                qidx, qw, qqid = flatten_plans(plans, fbq)
+                qfavg = part.block_avgdl[qidx].astype(np.float32)
+                qidx_d, qw_d = jnp.asarray(qidx), jnp.asarray(qw)
+                qqid_d, qfavg_d = jnp.asarray(qqid), jnp.asarray(qfavg)
+                seg_ids_d = part.seg_ids()
+
+                def bm25_plane_q():
+                    cs, cand, _h = _bm25_coarse_kernel(
+                        part.block_docs, tf16, qidx_d, qw_d, qqid_d,
+                        dl16, qfavg_d, plane_live, seg_ids_d,
+                        part.n_docs_pad, n_qp, len(part.segments),
+                        kprime)
+                    s, d, _eps = _bm25_rerank_kernel(
+                        part.block_docs, part.block_tfs, qidx_d, qw_d,
+                        qqid_d, part.doc_lens, qfavg_d, plane_live,
+                        cand, cs, part.n_docs_pad, n_qp, kprime, K)
+                    block(s)
+                    return s, d
+
+                sq, dq = bm25_plane_q()
+                se, de = bm25_plane()
+                overlap = np.mean([
+                    len(set(np.asarray(dq)[i][np.asarray(sq)[i]
+                                              != -np.inf])
+                        & set(np.asarray(de)[i][np.asarray(se)[i]
+                                                != -np.inf]))
+                    / max(len(set(np.asarray(de)[i][
+                        np.asarray(se)[i] != -np.inf])), 1)
+                    for i in range(n_q)])
+                t_q = timed(bm25_plane_q, iters, lambda _x: None)
+                entry["bm25"]["qps_plane_quantized"] = round(
+                    iters * n_q / t_q, 2)
+                entry["bm25"]["quantized_topk_overlap"] = round(
+                    float(overlap), 4)
+
             # ---- ivf (per-segment indexes+probes vs one shard index)
             seg_ivf = [IVFIndex.build(corpus[int(bounds[i]):
                                              int(bounds[i + 1])],
@@ -1352,6 +1479,42 @@ def cfg_segmented(np, jax, jnp, result):
                 "device_dispatches_per_query_per_segment": n_seg,
                 "device_dispatches_per_query_plane": 1,
             }
+
+            # ---- sparse quantized two-tier leg (bf16 coarse + exact
+            # f32 re-rank over the feature plane's weight mirror)
+            from elasticsearch_tpu.ops.sparse import (
+                sparse_coarse_kernel, sparse_rerank_kernel,
+            )
+            f_mirror = fpart.quantized_mirror()
+            if f_mirror is not None:
+                kprime = min(128, fpart.n_docs_pad)
+
+                def sparse_plane_q():
+                    cs, cand, _h = sparse_coarse_kernel(
+                        fpart.block_docs, f_mirror, sp_idx_dev,
+                        sp_w_dev, f_live, fpart.n_docs_pad, kprime)
+                    s, d, _eps = sparse_rerank_kernel(
+                        fpart.block_docs, fpart.block_weights,
+                        sp_idx_dev, sp_w_dev, f_live, cand, cs,
+                        fpart.n_docs_pad, kprime, K)
+                    block(s)
+                    return s, d
+
+                sq, dq = sparse_plane_q()
+                se, de = sparse_plane()
+                overlap = np.mean([
+                    len(set(np.asarray(dq)[i][np.asarray(sq)[i]
+                                              != -np.inf])
+                        & set(np.asarray(de)[i][np.asarray(se)[i]
+                                                != -np.inf]))
+                    / max(len(set(np.asarray(de)[i][
+                        np.asarray(se)[i] != -np.inf])), 1)
+                    for i in range(n_q)])
+                t_q = timed(sparse_plane_q, iters, lambda _x: None)
+                entry["sparse"]["qps_plane_quantized"] = round(
+                    iters * n_q / t_q, 2)
+                entry["sparse"]["quantized_topk_overlap"] = round(
+                    float(overlap), 4)
             out[str(n_seg)] = entry
     finally:
         PLANES.min_segments = old_min

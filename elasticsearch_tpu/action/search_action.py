@@ -298,12 +298,18 @@ class SearchTransportService:
     def _on_query(self, req: Dict[str, Any], sender: str):
         arrival_ns = time.monotonic_ns()
         self._reap()
-        # refresh the plane registry's dynamic config from committed
-        # cluster settings (search.plane.*) — cheap reads; every
-        # execution kind below consults the registry
+        # refresh the plane registry's and device observatory's dynamic
+        # config from committed cluster settings (search.plane.* /
+        # search.device_profile.storm_*) — cheap version-memoized reads;
+        # every execution kind below consults the registry
         if self.state is not None:
             from elasticsearch_tpu.ops.device_segment import PLANES
-            PLANES.configure_from_state(self.state())
+            from elasticsearch_tpu.search.device_profile import (
+                DEVICE_PROFILE,
+            )
+            state = self.state()
+            PLANES.configure_from_state(state)
+            DEVICE_PROFILE.configure_from_state(state)
         # THE shard execution path: every query is a batch member
         # (occupancy-1 keys drain on the next tick, so an isolated query
         # pays one scheduler hop; `search.batch.enabled: false` forces

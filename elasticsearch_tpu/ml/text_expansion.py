@@ -102,7 +102,15 @@ class TextExpansionModel:
         def topm(weights: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
             return jax.lax.top_k(weights, self.top_m)
 
-        self._forward = jax.jit(lambda ids, direct: topm(forward(ids, direct)))
+        # staged through the device observatory like every serving
+        # kernel: expansion encode compiles/recompiles are visible per
+        # family instead of hiding behind a raw jit
+        from elasticsearch_tpu.search.device_profile import (
+            profiled_callable,
+        )
+        self._forward = profiled_callable(
+            "text_expansion_forward",
+            lambda ids, direct: topm(forward(ids, direct)))
 
     # -- host-side featurization --------------------------------------------
 

@@ -149,10 +149,13 @@ def device_stats() -> Dict[str, Any]:
 def device_plane_stats() -> Dict[str, Any]:
     """Packed multi-segment plane observability (ops/device_segment.py
     PlaneRegistry): full rebuilds vs incremental appends, evictions,
-    resident bytes per kind, the quantized coarse pass's re-rank depth,
-    and how often a missing/refused plane forced the per-segment
-    fallback. Never initializes the device layer itself — a node that
-    has served no device work reports an empty section."""
+    resident bytes per kind, the quantized coarse tier's configured and
+    SERVED re-rank depths (rerank_depth / rerank_depth_max /
+    rerank_depth_histogram, with quantized_queries, rerank_escalations
+    and quantized_exact_fallbacks), and how often a missing/refused
+    plane forced the per-segment fallback. Never initializes the device
+    layer itself — a node that has served no device work reports an
+    empty section."""
     import sys
     mod = sys.modules.get("elasticsearch_tpu.ops.device_segment")
     if mod is None:

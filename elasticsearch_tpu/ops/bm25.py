@@ -136,6 +136,125 @@ def bm25_flat_body(block_docs, block_tfs,
     return scores, matched
 
 
+def bm25_coarse_body(block_docs, block_tfs_q, flat_idx, flat_w, flat_q,
+                     doc_lens_q, flat_avgdl, live, seg_ids,
+                     n_docs_pad: int, n_q: int, n_segs: int, kprime: int,
+                     k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+    """The quantized COARSE tier of the two-tier text path: the same
+    gather/scatter shape as ``bm25_flat_body`` but over the plane's bf16
+    mirrors (``block_tfs_q`` / ``doc_lens_q`` — half the HBM gather
+    traffic, the scatter-bound classes' dominant cost), contributions
+    computed in bf16 and accumulated in f32.
+
+    Returns (coarse scores [n_q, kprime], candidate plane docs
+    [n_q, kprime], per-segment match counts [n_q, n_segs]). The counts
+    are EXACT despite the reduced precision: contributions are strictly
+    positive wherever the f32 kernel's are (bf16 rounds positive
+    products to positive values), so ``score > 0`` flags the same doc
+    set — totals never depend on the re-rank. Candidate RANKING is
+    coarse; the exact re-rank (``bm25_rerank_body``) restores golden
+    scores, and the k'-th coarse score bounds what any excluded doc
+    could have scored (the adaptive-depth margin input)."""
+    docs = block_docs[flat_idx]             # [FB, BLOCK]
+    tfs = block_tfs_q[flat_idx]             # [FB, BLOCK] bf16
+    valid = docs >= 0
+    safe = jnp.where(valid, docs, 0)
+    dl = doc_lens_q[safe]                   # bf16
+    h = jnp.bfloat16
+    norm = h(k1) * (h(1.0 - b) + h(b) * dl
+                    / flat_avgdl.astype(h)[:, None])
+    contrib = flat_w.astype(h)[:, None] * tfs * h(k1 + 1.0) \
+        / (tfs + norm)
+    contrib = jnp.where(valid, contrib.astype(jnp.float32), 0.0)
+    tgt = flat_q[:, None] * n_docs_pad + safe
+    scores = jnp.zeros((n_q * n_docs_pad,), jnp.float32)
+    scores = scores.at[tgt.reshape(-1)].add(contrib.reshape(-1),
+                                            mode="drop")
+    scores = scores.reshape(n_q, n_docs_pad)
+    matched = live[None, :] & (scores > 0.0)
+    scores = jnp.where(matched, scores, -jnp.inf)
+    cs, cand = jax.lax.top_k(scores, kprime)
+    onehot = jax.nn.one_hot(seg_ids, n_segs, dtype=jnp.int32)
+    hits = matched.astype(jnp.int32) @ onehot       # [n_q, n_segs]
+    return cs, cand, hits
+
+
+def bm25_rerank_body(block_docs, block_tfs, flat_idx, flat_w, flat_q,
+                     doc_lens, flat_avgdl, live, cand, coarse_s,
+                     n_docs_pad: int, n_q: int, kprime: int, k: int,
+                     k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+    """The EXACT tier: re-score only the coarse candidates with the f32
+    arithmetic of ``bm25_flat_body`` — same gather order, same f32
+    contribution formula, same linear scatter-add order — but scattered
+    into a compact [n_q, kprime] candidate plane instead of the dense
+    [n_q, n_docs_pad] one, so the top-k runs over k' slots.
+
+    Candidates are sorted ascending by doc id first, making score-tie
+    breaks agree with the dense kernel's lower-index-wins order. Returns
+    (scores [n_q, k], plane docs [n_q, k], eps [n_q]) with ``eps`` the
+    max observed |exact - coarse| among matched candidates — the
+    adaptive margin's empirical error estimate."""
+    order = jnp.argsort(cand, axis=1)
+    cand_s = jnp.take_along_axis(cand, order, axis=1)
+    cs_s = jnp.take_along_axis(coarse_s, order, axis=1)
+    rows = jnp.arange(n_q, dtype=jnp.int32)[:, None]
+    slot_flat = jnp.full((n_q * n_docs_pad,), -1, jnp.int32)
+    slot_flat = slot_flat.at[
+        (rows * n_docs_pad + cand_s).reshape(-1)].set(
+        jnp.broadcast_to(jnp.arange(kprime, dtype=jnp.int32),
+                         (n_q, kprime)).reshape(-1))
+    docs = block_docs[flat_idx]
+    tfs = block_tfs[flat_idx]
+    valid = docs >= 0
+    safe = jnp.where(valid, docs, 0)
+    dl = doc_lens[safe]
+    norm = k1 * (1.0 - b + b * dl / flat_avgdl[:, None])
+    contrib = flat_w[:, None] * tfs * (k1 + 1.0) / (tfs + norm)
+    contrib = jnp.where(valid, contrib, 0.0)
+    slot = slot_flat[
+        (flat_q[:, None] * n_docs_pad + safe).reshape(-1)
+    ].reshape(safe.shape)
+    tgt = jnp.where(slot >= 0, flat_q[:, None] * kprime + slot,
+                    n_q * kprime)       # non-candidates: out of bounds
+    cscores = jnp.zeros((n_q * kprime,), jnp.float32)
+    cscores = cscores.at[tgt.reshape(-1)].add(contrib.reshape(-1),
+                                              mode="drop")
+    cscores = cscores.reshape(n_q, kprime)
+    ok = live[cand_s] & (cscores > 0.0)
+    masked = jnp.where(ok, cscores, -jnp.inf)
+    s, pos = jax.lax.top_k(masked, k)
+    d = jnp.take_along_axis(cand_s, pos, axis=1)
+    both = ok & jnp.isfinite(cs_s)
+    eps = jnp.max(jnp.where(both, jnp.abs(cscores - cs_s), 0.0), axis=1)
+    return s, d, eps
+
+
+@profiled_jit("bm25_coarse",
+              static_argnames=("n_docs_pad", "n_q", "n_segs", "kprime",
+                               "k1", "b"))
+def _bm25_coarse_kernel(block_docs, block_tfs_q, flat_idx, flat_w, flat_q,
+                        doc_lens_q, flat_avgdl, live, seg_ids,
+                        n_docs_pad: int, n_q: int, n_segs: int,
+                        kprime: int, k1: float = DEFAULT_K1,
+                        b: float = DEFAULT_B):
+    return bm25_coarse_body(block_docs, block_tfs_q, flat_idx, flat_w,
+                            flat_q, doc_lens_q, flat_avgdl, live, seg_ids,
+                            n_docs_pad, n_q, n_segs, kprime, k1=k1, b=b)
+
+
+@profiled_jit("bm25_rerank",
+              static_argnames=("n_docs_pad", "n_q", "kprime", "k",
+                               "k1", "b"))
+def _bm25_rerank_kernel(block_docs, block_tfs, flat_idx, flat_w, flat_q,
+                        doc_lens, flat_avgdl, live, cand, coarse_s,
+                        n_docs_pad: int, n_q: int, kprime: int, k: int,
+                        k1: float = DEFAULT_K1, b: float = DEFAULT_B):
+    return bm25_rerank_body(block_docs, block_tfs, flat_idx, flat_w,
+                            flat_q, doc_lens, flat_avgdl, live, cand,
+                            coarse_s, n_docs_pad, n_q, kprime, k,
+                            k1=k1, b=b)
+
+
 @profiled_jit("bm25_flat",
               static_argnames=("n_docs_pad", "n_q", "k", "k1", "b",
                                "counted"))
@@ -168,10 +287,6 @@ def _bm25_flat_kernel(block_docs, block_tfs, flat_idx, flat_w, flat_q,
     if counted:
         return s, d, jnp.sum(matched, axis=1, dtype=jnp.int32)
     return s, d
-
-
-def bm25_topk_flat(*args, **kw):
-    return _bm25_flat_kernel(*args, **kw, counted=False)
 
 
 def bm25_topk_flat_counted(*args, **kw):
@@ -226,7 +341,16 @@ def qb_bucket(n: int, minimum: int = 32) -> int:
     buckets churn with each query batch. The x8 ladder wastes at most 8x
     gather padding (device cost: <1ms) to cap the shape space at ~4
     compiles; above 16K blocks the padding waste dominates compile
-    amortization, so the ladder tightens to x2."""
+    amortization, so the ladder tightens to x2. (BENCH_r06's bm25_flat
+    bucket blow-up — 20 live shapes, 2 warmup recompile storms — was
+    investigated as a ladder problem, but widening the x2 region to x4
+    measurably HALVED CPU-fallback batch throughput while merging
+    almost nothing: the hot sizes sit on shared rung boundaries, and
+    the cardinality is really the (FB, n_q, k) cross-product of the
+    bench's many traffic patterns. The ladder stays; the per-request
+    program-variant churn — the ``counted`` flag flipping with batch
+    composition — was removed in ``dispatch_flat`` instead, which is
+    what keeps one serving pattern in single-digit buckets.)"""
     b = max(minimum, 1)
     while b < n:
         b *= 8 if b < 16384 else 2
@@ -679,7 +803,6 @@ def dispatch_flat(block_docs, block_tfs, doc_lens, n_docs_pad: int,
         chunks.append(cur)
     if count_segments is not None:
         counted = True
-    kern = bm25_topk_flat_counted if counted else bm25_topk_flat
     out_s, out_d, out_h = [], [], []
     for chunk in chunks:
         n_real = len(chunk)
@@ -701,22 +824,26 @@ def dispatch_flat(block_docs, block_tfs, doc_lens, n_docs_pad: int,
                 doc_lens, jnp.asarray(flat_avg), live, seg_ids,
                 n_docs_pad, n_q, k, k1=k1, b=b, n_segs=n_segs)
         else:
-            got = kern(
+            # ALWAYS the counted program: hits are one cheap reduction
+            # off the score plane the kernel materializes anyway. The
+            # counted flag used to flip with BATCH COMPOSITION (phase A
+            # counts only when an exact-mode member rides along), so
+            # real serving compiled both variants of each (FB, n_q, k)
+            # — half of them pure compile-cache waste. One variant
+            # keeps a warm serving pattern in single-digit buckets.
+            got = bm25_topk_flat_counted(
                 block_docs, block_tfs,
                 jnp.asarray(idx), jnp.asarray(w), jnp.asarray(qid),
                 doc_lens, jnp.asarray(flat_avg), live,
                 n_docs_pad, n_q, k, k1=k1, b=b)
         if len(chunks) == 1:
-            if counted:
-                s, d, h = got
-                return s[:n_real], d[:n_real], np.asarray(h)[:n_real]
-            s, d = got
-            return s[:n_real], d[:n_real]
-        if counted:
             s, d, h = got
+            if counted:
+                return s[:n_real], d[:n_real], np.asarray(h)[:n_real]
+            return s[:n_real], d[:n_real]
+        s, d, h = got
+        if counted:
             out_h.append(np.asarray(h)[:n_real])
-        else:
-            s, d = got
         out_s.append(np.asarray(s)[:n_real])
         out_d.append(np.asarray(d)[:n_real])
     s = jnp.asarray(np.concatenate(out_s))

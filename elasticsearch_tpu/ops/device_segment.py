@@ -304,6 +304,8 @@ class PlanePostings(PlanePart):
         # block_avgdl stays HOST-side: the flat dispatch gathers it per
         # plan into the [FB] kernel argument
         self.block_avgdl = ba
+        self._q_dev: Optional[Tuple] = None
+        self._q_failed = False
         return (bd, bt, dl_all)
 
     def upload(self, host) -> None:
@@ -311,6 +313,33 @@ class PlanePostings(PlanePart):
         self.block_docs = jnp.asarray(bd)
         self.block_tfs = jnp.asarray(bt)
         self.doc_lens = jnp.asarray(dl)
+
+    def quantized_mirror(self) -> Optional[Tuple]:
+        """(block_tfs bf16 [NB, BLOCK] device, doc_lens bf16 [N_pad]
+        device) — the coarse tier's reduced-precision gather operands,
+        following the PlaneVectors.quantized_mirror precedent: built
+        lazily on the FIRST coarse query, cached per plane generation,
+        separately breaker-charged, and a budget refusal is remembered so
+        a starved node never re-quantizes per query. Doc ids stay int32
+        (they are gather indices, shared with the exact arrays)."""
+        if self._q_dev is not None:
+            return self._q_dev
+        if self._q_failed:
+            return None
+        tf16 = np.asarray(self.block_tfs).astype(jnp.bfloat16)
+        dl16 = np.asarray(self.doc_lens).astype(jnp.bfloat16)
+        from elasticsearch_tpu.indices.breaker import account_device_arrays
+        try:
+            charge = account_device_arrays(
+                self, (tf16, dl16), f"plane_postings_q:{self.field}",
+                return_charge=True)
+        except CircuitBreakingError:
+            self._q_failed = True
+            return None
+        self._charges.append(charge)
+        self.nbytes += charge.n_bytes
+        self._q_dev = (jnp.asarray(tf16), jnp.asarray(dl16))
+        return self._q_dev
 
     def seg_ids(self) -> jnp.ndarray:
         """[n_docs_pad] int32: each plane doc's owning segment POSITION
@@ -489,12 +518,37 @@ class PlaneFeatures(PlanePart):
         bw = np.zeros((nb_pad, BLOCK), np.float32)
         bd[:nb] = np.concatenate(blocks_docs)
         bw[:nb] = np.concatenate(blocks_w)
+        self._q_dev: Optional[Any] = None
+        self._q_failed = False
         return (bd, bw)
 
     def upload(self, host) -> None:
         bd, bw = host
         self.block_docs = jnp.asarray(bd)
         self.block_weights = jnp.asarray(bw)
+
+    def quantized_mirror(self) -> Optional[Any]:
+        """block_weights bf16 [NB, BLOCK] device — the sparse coarse
+        tier's reduced-precision gather operand; same lazy-build /
+        per-generation cache / refusal-memo contract as the postings and
+        vector mirrors."""
+        if self._q_dev is not None:
+            return self._q_dev
+        if self._q_failed:
+            return None
+        w16 = np.asarray(self.block_weights).astype(jnp.bfloat16)
+        from elasticsearch_tpu.indices.breaker import account_device_arrays
+        try:
+            charge = account_device_arrays(
+                self, (w16,), f"plane_features_q:{self.field}",
+                return_charge=True)
+        except CircuitBreakingError:
+            self._q_failed = True
+            return None
+        self._charges.append(charge)
+        self.nbytes += charge.n_bytes
+        self._q_dev = jnp.asarray(w16)
+        return self._q_dev
 
 
 _PART_CLASSES = {"postings": PlanePostings, "vectors": PlaneVectors,
@@ -532,6 +586,7 @@ class PlaneRegistry:
         self.enabled = True
         self.min_segments = 2
         self.rerank_depth = 128
+        self.rerank_depth_max = 1024
         self.quantized = True
         self.max_bytes = 0          # 0 = breaker-only budgeting
         self.stats: Dict[str, int] = {
@@ -541,8 +596,14 @@ class PlaneRegistry:
             "plane_evictions": 0,
             "plane_miss_fallbacks": 0,
             "quantized_queries": 0,
+            "rerank_escalations": 0,
+            "quantized_exact_fallbacks": 0,
             "ivf_warm_starts": 0,
         }
+        # adaptive re-rank depth histogram: served depth -> query count
+        # (the k' each query's margin actually settled at — the coarse
+        # tier's observability surface, next to quantized_queries)
+        self.rerank_depth_hist: Dict[int, int] = {}
         # device-observatory residency record: monotonically stamped
         # generations, the resident-bytes high-water mark, and WHY each
         # plane left HBM (the "device_profile" stats section)
@@ -565,15 +626,31 @@ class PlaneRegistry:
         from elasticsearch_tpu.utils.settings import (
             SEARCH_PLANE_ENABLED, SEARCH_PLANE_MAX_BYTES,
             SEARCH_PLANE_MIN_SEGMENTS, SEARCH_PLANE_QUANTIZED,
-            SEARCH_PLANE_RERANK_DEPTH, setting_from_state,
+            SEARCH_PLANE_RERANK_DEPTH, SEARCH_PLANE_RERANK_DEPTH_MAX,
+            setting_from_state,
         )
         self.enabled = setting_from_state(state, SEARCH_PLANE_ENABLED)
         self.min_segments = setting_from_state(state,
                                                SEARCH_PLANE_MIN_SEGMENTS)
         self.rerank_depth = setting_from_state(state,
                                                SEARCH_PLANE_RERANK_DEPTH)
+        self.rerank_depth_max = setting_from_state(
+            state, SEARCH_PLANE_RERANK_DEPTH_MAX)
         self.quantized = setting_from_state(state, SEARCH_PLANE_QUANTIZED)
         self.max_bytes = setting_from_state(state, SEARCH_PLANE_MAX_BYTES)
+
+    def note_quantized(self, depth: int, n_queries: int,
+                       mesh: bool = False) -> None:
+        """A coarse+re-rank pass SERVED ``n_queries`` at re-rank depth
+        ``depth`` (post-escalation). The adaptive-depth histogram covers
+        every coarse tier; ``quantized_queries`` counts only the
+        single-shard plane's serves — mesh serves have their own
+        ``mesh_quantized_queries`` in the mesh section, and one query
+        must not appear under both."""
+        if not mesh:
+            self.stats["quantized_queries"] += int(n_queries)
+        self.rerank_depth_hist[int(depth)] = \
+            self.rerank_depth_hist.get(int(depth), 0) + int(n_queries)
 
     # -- lookup / build -------------------------------------------------
 
@@ -747,6 +824,10 @@ class PlaneRegistry:
                 "planes_resident": len(self._parts),
                 "resident_bytes": by_kind,
                 "rerank_depth": int(self.rerank_depth),
+                "rerank_depth_max": int(self.rerank_depth_max),
+                "rerank_depth_histogram": {
+                    str(d): n for d, n
+                    in sorted(self.rerank_depth_hist.items())},
                 "quantized": bool(self.quantized)}
 
     def residency_snapshot(self) -> Dict[str, Any]:
@@ -817,6 +898,11 @@ class MeshPlanePart:
         # filled by the registry's stacking pass
         self.n_docs_pad = BLOCK
         self.n_segs_max = 1
+        # lazily-built per-slot quantized mirrors (the PlaneVectors
+        # precedent, stacked): built on the first quantized mesh query,
+        # cached for the part's lifetime, refusal memoized
+        self._q_dev: Optional[Tuple] = None
+        self._q_failed = False
 
     def release(self) -> None:
         for charge in self._charges:
@@ -825,6 +911,60 @@ class MeshPlanePart:
     def uids_of(self, shard_key) -> Tuple:
         i = self.shard_keys.index(shard_key)
         return tuple(s.uid for s in self.segments_by_shard[i])
+
+    def quantized_mirror(self) -> Optional[Tuple]:
+        """Per-slot quantized mirrors of this mesh plane's scoring
+        arrays, device_put with the SAME shard sharding as the exact
+        stacks (each slot's mirror lives on that slot's chip):
+
+        - postings: (block_tfs bf16 [S, NB, B], doc_lens bf16 [S, N])
+        - vectors:  (q8 int8 [S, N, D], scales f32 [S, N]) — per-row
+          symmetric, so each row quantizes exactly as it would in that
+          shard's single-plane mirror
+        - features: (block_weights bf16 [S, NB, B],)
+
+        Breaker-charged PER DEVICE like the exact stacks; a refused
+        upload is memoized so a starved node serves the exact mesh path
+        without re-quantizing per fan-out. None = serve exact."""
+        if self._q_dev is not None:
+            return self._q_dev
+        if self._q_failed:
+            return None
+        if self.kind == "postings":
+            host = (np.asarray(self.block_tfs).astype(jnp.bfloat16),
+                    np.asarray(self.doc_lens).astype(jnp.bfloat16))
+        elif self.kind == "vectors":
+            matrix = np.asarray(self.matrix)
+            amax = np.abs(matrix).max(axis=2)
+            scales = np.maximum(amax / 127.0, 1e-30).astype(np.float32)
+            q8 = np.clip(np.round(matrix / scales[:, :, None]),
+                         -127, 127).astype(np.int8)
+            host = (q8, scales)
+        else:   # features
+            host = (np.asarray(self.block_weights).astype(jnp.bfloat16),)
+        n_bytes = sum(int(a.nbytes) for a in host)
+        d_used = max(1, int(self.mesh.shape["shard"]))
+        from elasticsearch_tpu.indices.breaker import charge_device
+        try:
+            charge = charge_device(
+                self, -(-n_bytes // d_used),
+                f"mesh_plane_{self.kind}_q:{self.field}",
+                return_charge=True)
+        except CircuitBreakingError:
+            self._q_failed = True
+            return None
+        self._charges.append(charge)
+        self.nbytes += n_bytes
+        self.per_device_bytes += -(-n_bytes // d_used)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        out = tuple(
+            jax.device_put(a, NamedSharding(
+                self.mesh, P(*(["shard"] + [None] * (a.ndim - 1)))))
+            for a in host)
+        self._q_dev = out
+        MESH_PLANES.stats["mesh_quantized_mirror_builds"] += 1
+        return out
 
 
 class MeshPlaneRegistry:
@@ -857,6 +997,9 @@ class MeshPlaneRegistry:
             "mesh_plane_evictions": 0,
             "mesh_plane_miss_fallbacks": 0,
             "mesh_plane_warmups": 0,
+            "mesh_quantized_queries": 0,
+            "mesh_quantized_mirror_builds": 0,
+            "mesh_quantized_fallbacks": 0,
         }
         # device-observatory residency record (the PlaneRegistry shape)
         self._gen = 0

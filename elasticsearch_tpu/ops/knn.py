@@ -169,26 +169,27 @@ def _coarse_plane(q8, scales, norms, queries, similarity: str
 @profiled_jit("knn_coarse", static_argnames=("similarity", "kprime"))
 def knn_coarse_candidates(q8, scales, norms, allowed, queries,
                           kprime: int, similarity: str = "cosine"
-                          ) -> jnp.ndarray:
-    """Quantized coarse pass over the FULL plane: top-k' candidate doc
-    ids per query. Ranking-only — the exact f32 re-rank
-    (knn_rerank_exact) restores golden scores for the survivors."""
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantized coarse pass over the FULL plane: (coarse scores [B, k'],
+    candidate doc ids [B, k']) per query. Ranking-only — the exact f32
+    re-rank (knn_rerank_exact) restores golden scores for the survivors;
+    the coarse scores feed the adaptive-depth margin check (the k'-th
+    coarse score bounds what any EXCLUDED doc could have scored)."""
     s = _coarse_plane(q8, scales, norms, queries, similarity)
     s = jnp.where(allowed[None, :], s, -jnp.inf)
-    _, cand = jax.lax.top_k(s, kprime)
-    return cand
+    return jax.lax.top_k(s, kprime)
 
 
 @profiled_jit("knn_coarse_masked",
               static_argnames=("similarity", "kprime"))
 def knn_coarse_candidates_masked(q8, scales, norms, allowed, queries,
                                  masks, kprime: int,
-                                 similarity: str = "cosine") -> jnp.ndarray:
+                                 similarity: str = "cosine"
+                                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Coarse pass with per-query filter masks [B, N_pad] (filtered kNN)."""
     s = _coarse_plane(q8, scales, norms, queries, similarity)
     s = jnp.where(allowed[None, :] & masks, s, -jnp.inf)
-    _, cand = jax.lax.top_k(s, kprime)
-    return cand
+    return jax.lax.top_k(s, kprime)
 
 
 def _rerank_scores(matrix, norms, queries, cand, similarity: str
@@ -212,37 +213,60 @@ def _rerank_scores(matrix, norms, queries, cand, similarity: str
     return 1.0 / (1.0 + jnp.sqrt(d2))
 
 
-def _rerank_topk(s, cand, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    ts, pos = jax.lax.top_k(s, k)
-    td = jnp.take_along_axis(cand, pos, axis=1)
+def knn_rerank_body(matrix, norms, allowed, queries, cand, coarse_s,
+                    masks, k: int, similarity: str
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The ONE exact-re-rank body, shared by the single-shard profiled
+    kernels below and the mesh per-slot variant (parallel/mesh.py
+    ``mesh_knn_rerank``) so their scores cannot diverge.
+
+    Candidates are sorted ascending by doc id first: ``lax.top_k`` breaks
+    score ties by LOWER index, so sorting makes re-rank tie-breaks agree
+    with the dense exact kernel's lower-doc-id-wins order — quantization
+    must not reorder equal-scored docs. Returns (scores [B, k], doc ids
+    [B, k], eps [B]) where ``eps`` is the max observed |exact - coarse|
+    deviation among the re-ranked candidates — the empirical error
+    estimate the adaptive-depth margin check scales from."""
+    order = jnp.argsort(cand, axis=1)
+    cand_s = jnp.take_along_axis(cand, order, axis=1)
+    cs_s = jnp.take_along_axis(coarse_s, order, axis=1)
+    s = _rerank_scores(matrix, norms, queries, cand_s, similarity)
+    ok = allowed[cand_s]
+    if masks is not None:
+        ok = ok & jnp.take_along_axis(masks, cand_s, axis=1)
+    sm = jnp.where(ok, s, -jnp.inf)
+    ts, pos = jax.lax.top_k(sm, k)
+    td = jnp.take_along_axis(cand_s, pos, axis=1)
     td = jnp.where(jnp.isfinite(ts), td, -1)
-    return ts, td
+    both = ok & jnp.isfinite(cs_s)
+    eps = jnp.max(jnp.where(both, jnp.abs(s - cs_s), 0.0), axis=1)
+    return ts, td, eps
 
 
 @profiled_jit("knn_rerank", static_argnames=("similarity", "k"))
-def knn_rerank_exact(matrix, norms, allowed, queries, cand, k: int,
-                     similarity: str = "cosine"
-                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def knn_rerank_exact(matrix, norms, allowed, queries, cand, coarse_s,
+                     k: int, similarity: str = "cosine"
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Exact f32 re-rank of the coarse candidates: identical top-k to the
-    exact path whenever the true top-k survives the coarse pass (the
-    re-rank depth's contract)."""
-    s = _rerank_scores(matrix, norms, queries, cand, similarity)
-    s = jnp.where(allowed[cand], s, -jnp.inf)
-    return _rerank_topk(s, cand, k)
+    exact path whenever the true top-k survives the coarse pass — which
+    the adaptive-depth margin check (plane_exec) proves per query from
+    the returned eps, deepening and re-dispatching when it cannot."""
+    return knn_rerank_body(matrix, norms, allowed, queries, cand,
+                           coarse_s, None, k, similarity)
 
 
 @profiled_jit("knn_rerank_masked",
               static_argnames=("similarity", "k"))
-def knn_rerank_exact_masked(matrix, norms, allowed, queries, cand, masks,
-                            k: int, similarity: str = "cosine"
-                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def knn_rerank_exact_masked(matrix, norms, allowed, queries, cand,
+                            coarse_s, masks, k: int,
+                            similarity: str = "cosine"
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                       jnp.ndarray]:
     """knn_rerank_exact with per-query filter masks re-applied to the
     gathered candidates (a masked-out doc must stay out even if the
     coarse pass leaked it in)."""
-    s = _rerank_scores(matrix, norms, queries, cand, similarity)
-    ok = allowed[cand] & jnp.take_along_axis(masks, cand, axis=1)
-    s = jnp.where(ok, s, -jnp.inf)
-    return _rerank_topk(s, cand, k)
+    return knn_rerank_body(matrix, norms, allowed, queries, cand,
+                           coarse_s, masks, k, similarity)
 
 
 class KnnExecutor:

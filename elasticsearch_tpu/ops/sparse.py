@@ -96,6 +96,92 @@ def sparse_topk_batch(block_docs, block_weights,
     return jax.vmap(one)(block_idx, query_weight)
 
 
+def sparse_coarse_body(block_docs, block_weights_q, block_idx,
+                       query_weight, live, n_docs_pad: int, kprime: int):
+    """Quantized COARSE tier of the two-tier sparse path (linear scoring,
+    the plane path's function): gather the bf16 weight mirror, compute
+    contributions in bf16, accumulate in f32 — the ``bm25_coarse_body``
+    shape for rank_features. Per query: (coarse scores [kprime],
+    candidate plane docs [kprime], exact match count). Counts stay exact
+    under reduced precision: positive contributions stay positive in
+    bf16, so ``score > 0`` flags the same doc set as the f32 kernel."""
+
+    def one(bi, qw):
+        docs = block_docs[bi]
+        w = block_weights_q[bi]                 # [QB, BLOCK] bf16
+        valid = docs >= 0
+        safe = jnp.where(valid, docs, 0)
+        contrib = qw.astype(jnp.bfloat16)[:, None] * w
+        contrib = jnp.where(valid, contrib.astype(jnp.float32), 0.0)
+        scores = jnp.zeros((n_docs_pad,), jnp.float32)
+        scores = scores.at[safe.reshape(-1)].add(contrib.reshape(-1),
+                                                 mode="drop")
+        matched = live & (scores > 0.0)
+        s = jnp.where(matched, scores, -jnp.inf)
+        cs, cand = jax.lax.top_k(s, kprime)
+        return cs, cand, jnp.sum(matched, dtype=jnp.int32)
+
+    return jax.vmap(one)(block_idx, query_weight)
+
+
+def sparse_rerank_body(block_docs, block_weights, block_idx, query_weight,
+                       live, cand, coarse_s, n_docs_pad: int, kprime: int,
+                       k: int):
+    """EXACT tier: re-score only the coarse candidates with the f32
+    linear arithmetic of ``sparse_scores`` — same gather, same
+    contribution formula, same linear scatter-add order — into a compact
+    [kprime] candidate vector. Candidates sorted ascending by doc id so
+    score-tie breaks match the dense kernel's lower-index-wins order.
+    Per query: (scores [k], plane docs [k], eps) with ``eps`` the max
+    observed |exact - coarse| among matched candidates."""
+
+    def one(bi, qw, cd, cs):
+        order = jnp.argsort(cd)
+        cd_s = cd[order]
+        cs_s = cs[order]
+        slot_of = jnp.full((n_docs_pad,), -1, jnp.int32)
+        slot_of = slot_of.at[cd_s].set(
+            jnp.arange(kprime, dtype=jnp.int32))
+        docs = block_docs[bi]
+        w = block_weights[bi]
+        valid = docs >= 0
+        safe = jnp.where(valid, docs, 0)
+        contrib = jnp.where(valid, qw[:, None] * w, 0.0)
+        slot = slot_of[safe]
+        tgt = jnp.where(slot >= 0, slot, kprime)    # non-candidate: drop
+        cscores = jnp.zeros((kprime,), jnp.float32)
+        cscores = cscores.at[tgt.reshape(-1)].add(contrib.reshape(-1),
+                                                  mode="drop")
+        ok = live[cd_s] & (cscores > 0.0)
+        masked = jnp.where(ok, cscores, -jnp.inf)
+        s, pos = jax.lax.top_k(masked, k)
+        d = cd_s[pos]
+        both = ok & jnp.isfinite(cs_s)
+        eps = jnp.max(jnp.where(both, jnp.abs(cscores - cs_s), 0.0))
+        return s, d, eps
+
+    return jax.vmap(one)(block_idx, query_weight, cand, coarse_s)
+
+
+@profiled_jit("sparse_coarse",
+              static_argnames=("n_docs_pad", "kprime"))
+def sparse_coarse_kernel(block_docs, block_weights_q, block_idx,
+                         query_weight, live, n_docs_pad: int,
+                         kprime: int):
+    return sparse_coarse_body(block_docs, block_weights_q, block_idx,
+                              query_weight, live, n_docs_pad, kprime)
+
+
+@profiled_jit("sparse_rerank",
+              static_argnames=("n_docs_pad", "kprime", "k"))
+def sparse_rerank_kernel(block_docs, block_weights, block_idx,
+                         query_weight, live, cand, coarse_s,
+                         n_docs_pad: int, kprime: int, k: int):
+    return sparse_rerank_body(block_docs, block_weights, block_idx,
+                              query_weight, live, cand, coarse_s,
+                              n_docs_pad, kprime, k)
+
+
 def gather_feature_blocks(ff: FeaturesField, features_with_weights,
                           bucket_min: int = 8) -> Tuple[np.ndarray, np.ndarray]:
     """Host prep: (block_indices, query_weights) padded to a pow2 bucket.

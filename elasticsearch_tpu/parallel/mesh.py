@@ -167,6 +167,232 @@ def mesh_bm25_flat(mesh: Mesh, n_docs_pad: int, n_q: int, k: int,
     return fn
 
 
+def mesh_bm25_coarse(mesh: Mesh, n_docs_pad: int, n_q: int, kprime: int,
+                     n_segs: int, k1: float, b: float):
+    """Quantized coarse tier over the stacked postings planes: one SPMD
+    program whose per-slot body is EXACTLY ops/bm25.py
+    ``bm25_coarse_body`` (bf16 mirror gathers, f32 accumulation), so a
+    slot's coarse candidates match that shard's single-plane coarse
+    dispatch by construction.
+
+    fn(block_docs [S,NB,B], block_tfs_q [S,NB,B] bf16, doc_lens_q [S,N]
+       bf16, flat_idx [S,FB], flat_w [S,FB], flat_q [S,FB],
+       flat_avgdl [S,FB], live [S,N], seg_ids [S,N])
+      -> (coarse scores [S,n_q,k'], cand [S,n_q,k'],
+          hits [S,n_q,n_segs])"""
+    from elasticsearch_tpu.ops.bm25 import bm25_coarse_body
+    key = ("bm25_coarse", id(mesh), n_docs_pad, n_q, kprime, n_segs,
+           k1, b)
+    fn = _COMPILED.get(key)
+    if fn is not None:
+        return fn
+
+    def one_slot(bd, btq, dlq, fi, fw, fq, fa, lv, si):
+        return bm25_coarse_body(bd, btq, fi, fw, fq, dlq, fa, lv, si,
+                                n_docs_pad, n_q, n_segs, kprime,
+                                k1=k1, b=b)
+
+    def local(bd, btq, dlq, fi, fw, fq, fa, lv, si):
+        return jax.vmap(one_slot)(bd, btq, dlq, fi, fw, fq, fa, lv, si)
+
+    p3 = P("shard", None, None)
+    p2 = P("shard", None)
+    fn = profiled_callable("mesh_bm25_coarse", shard_map(
+        local, mesh=mesh,
+        in_specs=(p3, p3, p2, p2, p2, p2, p2, p2, p2),
+        out_specs=(p3, p3, p3), check_vma=False))
+    _COMPILED[key] = fn
+    return fn
+
+
+def mesh_bm25_rerank(mesh: Mesh, n_docs_pad: int, n_q: int, kprime: int,
+                     k: int, n_segs: int, k1: float, b: float):
+    """Exact re-rank tier over the stacked postings planes: per-slot
+    body is ops/bm25.py ``bm25_rerank_body`` — the same f32 contribution
+    arithmetic and linear scatter order as the exact flat kernel, into
+    the compact candidate plane — so re-ranked scores are bit-compatible
+    with the per-shard quantized path AND the exact path.
+
+    fn(block_docs, block_tfs [S,NB,B] f32, flat_idx, flat_w, flat_q,
+       flat_avgdl, doc_lens [S,N] f32, live [S,N], cand [S,n_q,k'],
+       coarse_s [S,n_q,k'])
+      -> (scores [S,n_q,k], plane docs [S,n_q,k], eps [S,n_q])"""
+    from elasticsearch_tpu.ops.bm25 import bm25_rerank_body
+    key = ("bm25_rerank", id(mesh), n_docs_pad, n_q, kprime, k, n_segs,
+           k1, b)
+    fn = _COMPILED.get(key)
+    if fn is not None:
+        return fn
+
+    def one_slot(bd, bt, fi, fw, fq, fa, dl, lv, cand, cs):
+        return bm25_rerank_body(bd, bt, fi, fw, fq, dl, fa, lv, cand,
+                                cs, n_docs_pad, n_q, kprime, k,
+                                k1=k1, b=b)
+
+    def local(bd, bt, fi, fw, fq, fa, dl, lv, cand, cs):
+        return jax.vmap(one_slot)(bd, bt, fi, fw, fq, fa, dl, lv, cand,
+                                  cs)
+
+    p3 = P("shard", None, None)
+    p2 = P("shard", None)
+    fn = profiled_callable("mesh_bm25_rerank", shard_map(
+        local, mesh=mesh,
+        in_specs=(p3, p3, p2, p2, p2, p2, p2, p2, p3, p3),
+        out_specs=(p3, p3, p2), check_vma=False))
+    _COMPILED[key] = fn
+    return fn
+
+
+def mesh_sparse_coarse(mesh: Mesh, n_docs_pad: int, kprime: int):
+    """Quantized coarse tier over the stacked rank_features planes;
+    per-slot body is ops/sparse.py ``sparse_coarse_body``.
+
+    fn(block_docs [S,NB,B], block_weights_q [S,NB,B] bf16, idx [S,Q,QB],
+       qw [S,Q,QB], live [S,N])
+      -> (coarse scores [S,Q,k'], cand [S,Q,k'], hits [S,Q])"""
+    from elasticsearch_tpu.ops.sparse import sparse_coarse_body
+    key = ("sparse_coarse", id(mesh), n_docs_pad, kprime)
+    fn = _COMPILED.get(key)
+    if fn is not None:
+        return fn
+
+    def one_slot(bd, bwq, bi, qw, lv):
+        return sparse_coarse_body(bd, bwq, bi, qw, lv, n_docs_pad,
+                                  kprime)
+
+    def local(bd, bwq, bi, qw, lv):
+        return jax.vmap(one_slot)(bd, bwq, bi, qw, lv)
+
+    p3 = P("shard", None, None)
+    p2 = P("shard", None)
+    fn = profiled_callable("mesh_sparse_coarse", shard_map(
+        local, mesh=mesh,
+        in_specs=(p3, p3, p3, p3, p2),
+        out_specs=(p3, p3, p2), check_vma=False))
+    _COMPILED[key] = fn
+    return fn
+
+
+def mesh_sparse_rerank(mesh: Mesh, n_docs_pad: int, kprime: int, k: int):
+    """Exact re-rank tier over the stacked rank_features planes;
+    per-slot body is ops/sparse.py ``sparse_rerank_body``.
+
+    fn(block_docs, block_weights [S,NB,B] f32, idx [S,Q,QB],
+       qw [S,Q,QB], live [S,N], cand [S,Q,k'], coarse_s [S,Q,k'])
+      -> (scores [S,Q,k], plane docs [S,Q,k], eps [S,Q])"""
+    from elasticsearch_tpu.ops.sparse import sparse_rerank_body
+    key = ("sparse_rerank", id(mesh), n_docs_pad, kprime, k)
+    fn = _COMPILED.get(key)
+    if fn is not None:
+        return fn
+
+    def one_slot(bd, bw, bi, qw, lv, cand, cs):
+        return sparse_rerank_body(bd, bw, bi, qw, lv, cand, cs,
+                                  n_docs_pad, kprime, k)
+
+    def local(bd, bw, bi, qw, lv, cand, cs):
+        return jax.vmap(one_slot)(bd, bw, bi, qw, lv, cand, cs)
+
+    p3 = P("shard", None, None)
+    p2 = P("shard", None)
+    fn = profiled_callable("mesh_sparse_rerank", shard_map(
+        local, mesh=mesh,
+        in_specs=(p3, p3, p3, p3, p2, p3, p3),
+        out_specs=(p3, p3, p2), check_vma=False))
+    _COMPILED[key] = fn
+    return fn
+
+
+def mesh_knn_coarse(mesh: Mesh, kprime: int, similarity: str,
+                    masked: bool):
+    """Quantized int8 coarse tier over the stacked vector planes: the
+    query stack rides ``dp``, the corpus the ``shard`` axis, and each
+    slot runs ops/knn.py's ``_coarse_plane`` arithmetic (int8 x int8
+    MXU matmul, int32 accumulate, rescale + positive-score transform).
+
+    fn(q8 [S,N,D] int8, scales [S,N], norms [S,N], allowed [S,N],
+       queries [Q,D] [, masks [S,Q,N]])
+      -> (coarse scores [S,Q,k'], cand [S,Q,k'])"""
+    from elasticsearch_tpu.ops.knn import _coarse_plane
+    key = ("knn_coarse", id(mesh), kprime, similarity, masked)
+    fn = _COMPILED.get(key)
+    if fn is not None:
+        return fn
+
+    def local(q8, sc, nr, al, q, mk=None):
+        def one_slot(q8_s, sc_s, nr_s, al_s, mk_s=None):
+            s = _coarse_plane(q8_s, sc_s, nr_s, q, similarity)
+            ok = al_s[None, :] if mk_s is None else (al_s[None, :] & mk_s)
+            s = jnp.where(ok, s, -jnp.inf)
+            cs, cand = jax.lax.top_k(s, kprime)
+            return cs, cand
+        if mk is not None:
+            return jax.vmap(one_slot)(q8, sc, nr, al, mk)
+        return jax.vmap(lambda a, b_, c, d: one_slot(a, b_, c, d))(
+            q8, sc, nr, al)
+
+    p3 = P("shard", None, None)
+    p2 = P("shard", None)
+    pq = P("dp", None)
+    pout = P("shard", "dp", None)
+    if masked:
+        fn = profiled_callable("mesh_knn_coarse", shard_map(
+            local, mesh=mesh,
+            in_specs=(p3, p2, p2, p2, pq, P("shard", "dp", None)),
+            out_specs=(pout, pout), check_vma=False))
+    else:
+        fn = profiled_callable("mesh_knn_coarse", shard_map(
+            lambda q8, sc, nr, al, q: local(q8, sc, nr, al, q),
+            mesh=mesh, in_specs=(p3, p2, p2, p2, pq),
+            out_specs=(pout, pout), check_vma=False))
+    _COMPILED[key] = fn
+    return fn
+
+
+def mesh_knn_rerank(mesh: Mesh, k: int, similarity: str, masked: bool):
+    """Exact re-rank tier over the stacked vector planes; per-slot body
+    is ops/knn.py ``knn_rerank_body`` (candidate sort, exact einsum
+    scores, observed-deviation eps), so re-ranked scores match the
+    per-shard quantized path bit-for-bit.
+
+    fn(matrix [S,N,D] f32, norms [S,N], allowed [S,N], queries [Q,D],
+       cand [S,Q,k'], coarse_s [S,Q,k'] [, masks [S,Q,N]])
+      -> (scores [S,Q,k], plane docs [S,Q,k], eps [S,Q])"""
+    from elasticsearch_tpu.ops.knn import knn_rerank_body
+    key = ("knn_rerank", id(mesh), k, similarity, masked)
+    fn = _COMPILED.get(key)
+    if fn is not None:
+        return fn
+
+    def local(m, nr, al, q, cand, cs, mk=None):
+        def one_slot(m_s, nr_s, al_s, cand_s, cs_s, mk_s=None):
+            return knn_rerank_body(m_s, nr_s, al_s, q, cand_s, cs_s,
+                                   mk_s, k, similarity)
+        if mk is not None:
+            return jax.vmap(one_slot)(m, nr, al, cand, cs, mk)
+        return jax.vmap(
+            lambda a, b_, c, d, e: one_slot(a, b_, c, d, e))(
+            m, nr, al, cand, cs)
+
+    p3 = P("shard", None, None)
+    p2 = P("shard", None)
+    pq = P("dp", None)
+    pc = P("shard", "dp", None)
+    pout = P("shard", "dp", None)
+    if masked:
+        fn = profiled_callable("mesh_knn_rerank", shard_map(
+            local, mesh=mesh,
+            in_specs=(p3, p2, p2, pq, pc, pc, P("shard", "dp", None)),
+            out_specs=(pout, pout, P("shard", "dp")), check_vma=False))
+    else:
+        fn = profiled_callable("mesh_knn_rerank", shard_map(
+            lambda m, nr, al, q, cand, cs: local(m, nr, al, q, cand, cs),
+            mesh=mesh, in_specs=(p3, p2, p2, pq, pc, pc),
+            out_specs=(pout, pout, P("shard", "dp")), check_vma=False))
+    _COMPILED[key] = fn
+    return fn
+
+
 def mesh_sparse_topk(mesh: Mesh, n_docs_pad: int, k: int):
     """One SPMD program over the stacked rank_features planes.
 

@@ -51,6 +51,7 @@ except ImportError:   # pre-0.5 jax: experimental module, check_rep kwarg
         return _shard_map_legacy(*args, **kwargs)
 
 from elasticsearch_tpu.index.segment import BLOCK, next_pow2
+from elasticsearch_tpu.search.device_profile import profiled_callable
 from elasticsearch_tpu.ops.bm25 import (
     DEFAULT_B, DEFAULT_K1, P1_BUCKET, QueryPlan, TermCellIndex,
     build_query_plan, idf as idf_fn, qb_bucket,
@@ -181,7 +182,7 @@ def make_sharded_knn(mesh: Mesh, n_per_shard: int, dims: int, k: int,
         out_specs=(P("dp", None), P("dp", None)),
         check_vma=False,
     )
-    return jax.jit(fn)
+    return profiled_callable("sharded_knn", fn)
 
 
 class ShardedVectorIndex:
@@ -258,7 +259,7 @@ def make_sharded_bm25(mesh: Mesh, n_per_shard: int, k: int,
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(fn)
+    return profiled_callable("sharded_bm25", fn)
 
 
 def make_sharded_bm25_batch(mesh: Mesh, n_per_shard: int, k: int,
@@ -323,7 +324,7 @@ def make_sharded_bm25_batch(mesh: Mesh, n_per_shard: int, k: int,
         out_specs=(P(), P(), P()) if counted else (P(), P()),
         check_vma=False,
     )
-    return jax.jit(fn)
+    return profiled_callable("sharded_bm25_batch", fn)
 
 
 class ShardedTextIndex:
@@ -647,7 +648,7 @@ def make_sharded_sparse(mesh: Mesh, n_per_shard: int, k: int):
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(fn)
+    return profiled_callable("sharded_sparse", fn)
 
 
 class ShardedFeaturesIndex:
@@ -816,4 +817,4 @@ def make_sharded_hybrid(mesh: Mesh, n_per_shard: int, k: int,
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(fn)
+    return profiled_callable("sharded_hybrid", fn)

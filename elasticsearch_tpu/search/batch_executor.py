@@ -499,7 +499,10 @@ def batched_wand_topk_shard(ctxs, field: str,
                 exact_hits[qi] += int(h[qi])
         for qi in recount:
             candidates, _, _, max_score, prune = out[qi]
-            if exact_hits[qi] > track_limit:
+            # >= : relation at count == track_limit is "gte" on every
+            # path — matches exact-mode/observed-full members and the
+            # quantized coarse tier (see plane_exec's recount)
+            if exact_hits[qi] >= track_limit:
                 out[qi] = (candidates, track_limit, "gte", max_score,
                            prune)
             else:

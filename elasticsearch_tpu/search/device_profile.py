@@ -155,6 +155,29 @@ class DeviceProfile:
         if slow_compile_ms is not None:
             self.slow_compile_ms = float(slow_compile_ms)
 
+    def configure_from_state(self, state) -> None:
+        """Refresh the storm/slow-compile knobs from committed cluster
+        settings (``search.device_profile.storm_*``), memoized on the
+        state version like the plane registries — the parse must not tax
+        the per-search hot path it observes."""
+        version = getattr(state, "version", None)
+        if version is not None and \
+                version == getattr(self, "_cfg_version", None):
+            return
+        self._cfg_version = version
+        from elasticsearch_tpu.utils.settings import (
+            SEARCH_DEVICE_PROFILE_SLOW_COMPILE,
+            SEARCH_DEVICE_PROFILE_STORM_THRESHOLD,
+            SEARCH_DEVICE_PROFILE_STORM_WINDOW, setting_from_state,
+        )
+        self.configure(
+            storm_threshold=setting_from_state(
+                state, SEARCH_DEVICE_PROFILE_STORM_THRESHOLD),
+            storm_window_s=setting_from_state(
+                state, SEARCH_DEVICE_PROFILE_STORM_WINDOW),
+            slow_compile_ms=1000.0 * setting_from_state(
+                state, SEARCH_DEVICE_PROFILE_SLOW_COMPILE))
+
     def family(self, name: str) -> FamilyProfile:
         fam = self._families.get(name)
         if fam is None:

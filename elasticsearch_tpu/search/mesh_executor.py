@@ -369,8 +369,9 @@ class MeshSearchExecutor:
                         member_rows.append((cands, total, relation,
                                             max_score, None))
                     per_shard_member.append(member_rows)
-        except MeshFallback:
-            raise _MeshMiss(telemetry.MESH_IVF_ROUTED)
+        except MeshFallback as mf:
+            raise _MeshMiss(getattr(mf, "reason",
+                                    telemetry.MESH_IVF_ROUTED))
         self.stats["device_dispatches"] += len(counter)
 
         # synthesize per-member, per-shard query-phase responses — the

@@ -38,7 +38,9 @@ from elasticsearch_tpu.index.segment import next_pow2
 from elasticsearch_tpu.ops.bm25 import (
     DEFAULT_B, DEFAULT_K1, P1_BUCKET, QueryPlan, dispatch_flat,
 )
-from elasticsearch_tpu.ops.device_segment import PLANES, PlaneVectors
+from elasticsearch_tpu.ops.device_segment import (
+    MESH_PLANES, PLANES, PlaneVectors,
+)
 from elasticsearch_tpu.search import telemetry
 from elasticsearch_tpu.search.phase import ShardDoc
 
@@ -47,6 +49,70 @@ class PlaneFallback(Exception):
     """This batch cannot run on the plane (e.g. IVF-routed members whose
     num_candidates imply different probe widths); members take the
     per-segment path."""
+
+
+# ---------------------------------------------------------------------------
+# adaptive re-rank depth: the margin rule shared by every coarse tier
+# ---------------------------------------------------------------------------
+
+# a-priori per-doc coarse error, relative to the coarse score. bf16
+# classes: every contribution is a product/quotient chain of
+# bf16-rounded operands (<= ~8 ulps ~ 0.031 relative) summed with f32
+# accumulation over strictly positive terms, so 0.04 bounds ANY doc's
+# deviation — included or excluded — and the margin below is a real
+# proof for bm25/sparse. int8 kNN has no usable closed-form bound (it
+# loosens with D and amax/rms), so 0.02 hardens the empirical estimate
+# and the escalate-then-serve-exact backstop owns the tail.
+REL_BF16 = 0.04
+REL_INT8 = 0.02
+
+
+def _margin_ok(s_k: float, c_cut: float, eps: float, rel: float) -> bool:
+    """True when the coarse pass provably kept the true top-k.
+
+    Any EXCLUDED doc's coarse score is <= ``c_cut`` (the k'-th coarse
+    score), so its exact score is <= c_cut plus its coarse error. The
+    error is bounded two ways at once: ``eps`` is the max observed
+    |exact - coarse| among the re-ranked candidates (doubled for
+    safety), and ``rel`` is the class's a-priori relative bound
+    (REL_BF16 / REL_INT8). When the exact k-th score clears both, no
+    excluded doc can enter the served top-k; when it cannot — including
+    exact-score ties straddling the cut — the caller deepens k' and
+    re-dispatches, bounded by ``search.plane.rerank_depth_max``, past
+    which the EXACT path serves (typed fallback): golden parity is an
+    invariant, not a tuning goal."""
+    if not np.isfinite(c_cut):
+        return True     # fewer matches than k': nothing was excluded
+    if not np.isfinite(s_k):
+        return False
+    return (s_k - c_cut) > (2.0 * eps + rel * abs(c_cut) + 1e-6)
+
+
+def _coarse_depth0(k: int, n_docs_pad: int) -> int:
+    return min(max(int(PLANES.rerank_depth), k), n_docs_pad)
+
+
+def _adaptive_depths(k: int, n_docs_pad: int):
+    """Yield (kprime, is_last) re-rank depths for the adaptive loop:
+    the configured starting depth, doubling per escalation up to
+    ``search.plane.rerank_depth_max`` (or full plane coverage, where
+    nothing can be excluded). Resuming the generator IS the escalation
+    — it counts ``rerank_escalations`` — so every coarse tier shares
+    one depth/bookkeeping discipline; the caller serves and breaks on
+    a clean margin, and falls back to exact when ``is_last`` still
+    cannot prove parity."""
+    depth = _coarse_depth0(k, n_docs_pad)
+    max_depth = max(int(PLANES.rerank_depth_max), depth)
+    while True:
+        kprime = min(depth, n_docs_pad)
+        yield kprime, (kprime >= n_docs_pad or depth >= max_depth)
+        PLANES.stats["rerank_escalations"] += 1
+        depth = min(depth * 2, max_depth)
+
+
+def _count_plane_quantized_fallback() -> None:
+    PLANES.stats["quantized_exact_fallbacks"] += 1
+    telemetry.TELEMETRY.count_fallback(telemetry.PLANE_QUANTIZED_FALLBACK)
 
 
 def _reader_of(ctxs):
@@ -61,6 +127,119 @@ def _live_host(reader) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # text: block-max pruned BM25 over the postings plane
 # ---------------------------------------------------------------------------
+
+def _coarse_wand_topk(part, per_seg, has_terms, n_q: int, live,
+                      eff_block_avgdl, k_plane: int, want: int,
+                      track_limit: int, counts_on: bool,
+                      check_members: Optional[Callable[[], None]],
+                      counter: Optional[list]) -> Optional[List[Tuple]]:
+    """The quantized two-tier text path: ONE bf16 coarse dispatch over
+    the FULL (unpruned) plans — no WAND host planning, no theta sync, no
+    recount — plus ONE exact f32 re-rank of the top-k' candidates, with
+    the adaptive-depth margin loop. Totals come EXACT off the coarse
+    pass's per-segment counts (both the counts-then-skip and the
+    totals-disabled contracts), so no mode needs a second counting
+    dispatch. Returns plane_wand_topk's per-member tuples, or None when
+    the exact phases must serve (corpus below the engage threshold,
+    batch too large for one dispatch, mirror refused, margin exhausted
+    — the latter two typed plane_quantized_fallback)."""
+    from elasticsearch_tpu.ops.bm25 import (
+        MAX_BATCH_CELLS, MAX_CHUNK_Q, _bm25_coarse_kernel,
+        _bm25_rerank_kernel, flatten_plans, qb_bucket,
+    )
+    depth0 = _coarse_depth0(k_plane, part.n_docs_pad)
+    if part.n_docs_total <= 4 * depth0:
+        return None
+    offsets = [bb for _c, _p, bb in per_seg]
+    rows = [QueryPlan.concat([p[qi] for _c, p, _bb in per_seg],
+                             idx_offsets=offsets) for qi in range(n_q)]
+    cells = sum(p.n_blocks for p in rows)
+    if n_q > MAX_CHUNK_Q or cells > MAX_BATCH_CELLS:
+        return None     # chunked batches keep the exact phased path
+    mirror = part.quantized_mirror()
+    if mirror is None:
+        _count_plane_quantized_fallback()
+        return None
+    tf16, dl16 = mirror
+    n_q_pad = next_pow2(max(n_q, 1), minimum=1)
+    fb = qb_bucket(max(cells, 1))
+    idx, w, qid = flatten_plans(rows, fb)
+    flat_avg = eff_block_avgdl[idx].astype(np.float32)
+    idx_dev = jnp.asarray(idx)
+    w_dev = jnp.asarray(w)
+    qid_dev = jnp.asarray(qid)
+    favg_dev = jnp.asarray(flat_avg)
+    seg_ids = part.seg_ids()
+    n_segs = len(part.segments)
+    blocks_total = [rows[qi].n_blocks for qi in range(n_q)]
+
+    from elasticsearch_tpu.indices.breaker import BREAKERS
+    for kprime, last in _adaptive_depths(k_plane, part.n_docs_pad):
+        if check_members is not None:
+            check_members()
+        if counter is not None:
+            counter.extend((1, 1))
+        telemetry.record_dispatch(2)
+        # coarse plane (f32 accumulator) + candidate plane temporaries
+        transient = 8 * part.n_docs_pad * n_q_pad
+        with BREAKERS.breaker("request").limit_scope(
+                transient, "plane_coarse_wand"):
+            cs, cand, hits = _bm25_coarse_kernel(
+                part.block_docs, tf16, idx_dev, w_dev, qid_dev, dl16,
+                favg_dev, live, seg_ids, part.n_docs_pad, n_q_pad,
+                n_segs, kprime, k1=DEFAULT_K1, b=DEFAULT_B)
+            s, d, eps = _bm25_rerank_kernel(
+                part.block_docs, part.block_tfs, idx_dev, w_dev,
+                qid_dev, part.doc_lens, favg_dev, live, cand, cs,
+                part.n_docs_pad, n_q_pad, kprime, k_plane,
+                k1=DEFAULT_K1, b=DEFAULT_B)
+        cs_h = np.asarray(cs)
+        s_h = np.asarray(s)
+        eps_h = np.asarray(eps)
+        k_last = min(k_plane, s_h.shape[1]) - 1
+        if all(_margin_ok(float(s_h[qi, k_last]),
+                          float(cs_h[qi, kprime - 1]),
+                          float(eps_h[qi]), REL_BF16)
+               for qi in range(n_q) if has_terms[qi]):
+            break
+        if last:
+            _count_plane_quantized_fallback()
+            return None
+
+    hits_h = np.asarray(hits)
+    d_h = np.asarray(d)
+    PLANES.note_quantized(kprime, sum(1 for qi in range(n_q)
+                                      if has_terms[qi]))
+    empty = ([], 0, "eq", None, (0, 0))
+    out: List[Tuple] = []
+    for qi in range(n_q):
+        if not has_terms[qi]:
+            out.append(empty)
+            continue
+        s_row, d_row = s_h[qi], d_h[qi]
+        finite = s_row != -np.inf
+        si, local = part.demux(d_row[finite])
+        candidates = [ShardDoc(int(a), int(b), float(sc), (float(sc),))
+                      for a, b, sc in zip(si, local, s_row[finite])]
+        candidates.sort(key=lambda c: (-c.score, c.segment_idx, c.doc))
+        max_score = max((c.score for c in candidates), default=None)
+        # every block was gathered (twice — once per tier): no pruning
+        prune = (blocks_total[qi], blocks_total[qi])
+        h_row = hits_h[qi]
+        if not counts_on:
+            # totals disabled: the per-segment clipped contract, read
+            # off the coarse pass's exact per-segment counts
+            total = int(np.minimum(h_row, want).sum())
+            out.append((candidates, total, "gte", max_score, prune))
+            continue
+        hits_all = int(h_row.sum())
+        if hits_all >= track_limit:
+            out.append((candidates, track_limit, "gte", max_score,
+                        prune))
+        else:
+            out.append((candidates, hits_all, "eq", max_score, prune))
+    return out
+
 
 def plane_wand_topk(ctxs, part, field: str,
                     clause_lists: List[List[Tuple[str, float]]],
@@ -146,6 +325,19 @@ def plane_wand_topk(ctxs, part, field: str,
     # argument, so a DFS override replaces the baked per-segment values
     eff_block_avgdl = part.block_avgdl if avgdl_override is None else \
         np.full_like(part.block_avgdl, avgdl_override)
+
+    # quantized coarse tier (search.plane.quantized): bf16 coarse pass
+    # over the full plans + exact f32 re-rank with adaptive depth — the
+    # kNN two-tier pattern generalized to the scatter-bound text class;
+    # None = serve the exact phased path below (typed when it is a
+    # fallback rather than a sizing decision)
+    if PLANES.quantized:
+        got = _coarse_wand_topk(part, per_seg, has_terms, n_q, live,
+                                eff_block_avgdl, k_plane, want,
+                                track_limit, counts_on, check_members,
+                                counter)
+        if got is not None:
+            return got
 
     def _dispatch(rows, k, counted, count_segments=None):
         if check_members is not None:
@@ -281,7 +473,12 @@ def plane_wand_topk(ctxs, part, field: str,
         for qi in recount:
             candidates, _, _, max_score, prune = out[qi]
             exact_hits = int(h_r[qi])
-            if exact_hits > track_limit:
+            # >= so the relation at count == track_limit is "gte" on
+            # EVERY path — exact-mode and observed-full members already
+            # report "gte" there, and the quantized coarse tier (exact
+            # counts, no pruning visibility) must be byte-identical to
+            # whichever branch the exact path would have taken
+            if exact_hits >= track_limit:
                 out[qi] = (candidates, track_limit, "gte", max_score,
                            prune)
             else:
@@ -388,15 +585,20 @@ def _filter_mask_rows(ctxs, part, specs, exact_idx) -> Tuple[Any, bool]:
 def _quantized_topk(part: PlaneVectors, vectors: np.ndarray, live,
                     masks, k: int, counter: Optional[list] = None):
     """int8 coarse pass over the full plane + exact f32 re-rank of the
-    top-k' candidates. Returns (scores [B, k], plane docs [B, k]) or None
+    top-k' candidates, with ADAPTIVE depth: the margin at position k'
+    (via the re-rank's observed coarse error) must prove the true top-k
+    survived, else the pass deepens x2 and re-dispatches up to
+    ``search.plane.rerank_depth_max`` — past which None is returned and
+    the exact path serves (typed plane_quantized_fallback). Also None
     when the quantized mirror is unavailable (breaker) or the corpus is
     too small for the coarse pass to pay."""
+    depth0 = _coarse_depth0(k, part.n_docs_pad)
+    if part.n_docs_total <= 4 * depth0:
+        return None         # coarse+rerank would cost more than exact
     mirror = part.quantized_mirror()
     if mirror is None:
+        _count_plane_quantized_fallback()
         return None
-    kprime = min(max(int(PLANES.rerank_depth), k), part.n_docs_pad)
-    if part.n_docs_total <= 4 * kprime:
-        return None         # coarse+rerank would cost more than exact
     q8, scales = mirror
     from elasticsearch_tpu.ops.knn import (
         knn_coarse_candidates, knn_coarse_candidates_masked,
@@ -406,26 +608,42 @@ def _quantized_topk(part: PlaneVectors, vectors: np.ndarray, live,
     q_host, n_real = pad_queries_pow2(vectors)
     allowed = live & part.exists
     queries = jnp.asarray(q_host)
-    if counter is not None:
-        counter.append(1)
-    telemetry.record_dispatch(2)      # coarse pass + exact re-rank
+    m_dev = None
     if masks is not None and getattr(masks, "ndim", 1) == 2:
         m_dev = jnp.asarray(pad_mask_rows_pow2(masks, q_host.shape[0]))
-        cand = knn_coarse_candidates_masked(
-            q8, scales, part.norms, allowed, queries, m_dev, kprime,
-            part.similarity)
-        s, d = knn_rerank_exact_masked(
-            part.matrix, part.norms, allowed, queries, cand, m_dev, k,
-            part.similarity)
-    else:
-        if masks is not None:
-            allowed = allowed & masks       # shared filter mask
-        cand = knn_coarse_candidates(q8, scales, part.norms, allowed,
-                                     queries, kprime, part.similarity)
-        s, d = knn_rerank_exact(part.matrix, part.norms, allowed,
-                                queries, cand, k, part.similarity)
-    PLANES.stats["quantized_queries"] += n_real
-    return s[:n_real], d[:n_real]
+    elif masks is not None:
+        allowed = allowed & masks       # shared filter mask
+    for kprime, last in _adaptive_depths(k, part.n_docs_pad):
+        if counter is not None:
+            counter.extend((1, 1))
+        telemetry.record_dispatch(2)      # coarse pass + exact re-rank
+        if m_dev is not None:
+            cs, cand = knn_coarse_candidates_masked(
+                q8, scales, part.norms, allowed, queries, m_dev, kprime,
+                part.similarity)
+            s, d, eps = knn_rerank_exact_masked(
+                part.matrix, part.norms, allowed, queries, cand, cs,
+                m_dev, k, part.similarity)
+        else:
+            cs, cand = knn_coarse_candidates(q8, scales, part.norms,
+                                             allowed, queries, kprime,
+                                             part.similarity)
+            s, d, eps = knn_rerank_exact(part.matrix, part.norms,
+                                         allowed, queries, cand, cs, k,
+                                         part.similarity)
+        cs_h = np.asarray(cs)
+        s_h = np.asarray(s)
+        eps_h = np.asarray(eps)
+        k_last = min(k, s_h.shape[1]) - 1
+        if all(_margin_ok(float(s_h[row, k_last]),
+                          float(cs_h[row, kprime - 1]),
+                          float(eps_h[row]), REL_INT8)
+               for row in range(n_real)):
+            PLANES.note_quantized(kprime, n_real)
+            return s[:n_real], d[:n_real]
+        if last:
+            _count_plane_quantized_fallback()
+            return None
 
 
 def plane_knn_winners(ctxs, part: PlaneVectors, field: str, specs,
@@ -557,12 +775,23 @@ def plane_sparse_topk(ctxs, part, field: str,
     for i, (bi, bw) in enumerate(per):
         idx[i, : len(bi)] = bi
         w[i, : len(bw)] = bw
+    k_plane = min(max(want, 1), part.n_docs_pad)
+
+    # quantized coarse tier: bf16 coarse gather/scatter + exact f32
+    # re-rank with adaptive depth (the text/kNN pattern on the
+    # rank_features class); None = exact single-dispatch path below
+    if PLANES.quantized:
+        got = _coarse_sparse_topk(part, idx, w, live, k_plane, n_real,
+                                  check_members, counter)
+        if got is not None:
+            s, d, h = got
+            return _sparse_demux(part, s, d, h, n_real)
+
     if check_members is not None:
         check_members()
     if counter is not None:
         counter.append(1)
     telemetry.record_dispatch()
-    k_plane = min(max(want, 1), part.n_docs_pad)
     from elasticsearch_tpu.indices.breaker import BREAKERS
     with BREAKERS.breaker("request").limit_scope(
             8 * part.n_docs_pad * q_n, "plane_sparse"):
@@ -571,7 +800,15 @@ def plane_sparse_topk(ctxs, part, field: str,
             jnp.asarray(w), jnp.float32(1.0), jnp.float32(1.0), live,
             part.n_docs_pad, k_plane, "linear", counted=True)
     s, d, h = np.asarray(s), np.asarray(d), np.asarray(h)
-    out = []
+    return _sparse_demux(part, s, d, h, n_real)
+
+
+def _sparse_demux(part, s: np.ndarray, d: np.ndarray, h: np.ndarray,
+                  n_real: int) -> List[Tuple]:
+    """(candidates, total, max_score) per member from the score/doc/hit
+    planes — shared by the exact and coarse-tier sparse paths so the
+    result shape cannot diverge."""
+    out: List[Tuple] = []
     for qi in range(n_real):
         finite = s[qi] != -np.inf
         si, local = part.demux(d[qi][finite])
@@ -583,14 +820,93 @@ def plane_sparse_topk(ctxs, part, field: str,
     return out
 
 
+def _coarse_sparse_topk(part, idx: np.ndarray, w: np.ndarray, live,
+                        k_plane: int, n_real: int,
+                        check_members: Optional[Callable[[], None]],
+                        counter: Optional[list]):
+    """Adaptive coarse+re-rank for the sparse plane: returns host
+    (scores, docs, hits) arrays shaped like the exact dispatch (hits
+    EXACT off the coarse pass), or None when the exact path must serve
+    (engage threshold, mirror refused, margin exhausted)."""
+    from elasticsearch_tpu.ops.sparse import (
+        sparse_coarse_kernel, sparse_rerank_kernel,
+    )
+    depth0 = _coarse_depth0(k_plane, part.n_docs_pad)
+    if part.n_docs_total <= 4 * depth0:
+        return None
+    mirror = part.quantized_mirror()
+    if mirror is None:
+        _count_plane_quantized_fallback()
+        return None
+    idx_dev = jnp.asarray(idx)
+    w_dev = jnp.asarray(w)
+    q_n = idx.shape[0]
+    from elasticsearch_tpu.indices.breaker import BREAKERS
+    for kprime, last in _adaptive_depths(k_plane, part.n_docs_pad):
+        if check_members is not None:
+            check_members()
+        if counter is not None:
+            counter.extend((1, 1))
+        telemetry.record_dispatch(2)
+        with BREAKERS.breaker("request").limit_scope(
+                8 * part.n_docs_pad * q_n, "plane_coarse_sparse"):
+            cs, cand, hits = sparse_coarse_kernel(
+                part.block_docs, mirror, idx_dev, w_dev, live,
+                part.n_docs_pad, kprime)
+            s, d, eps = sparse_rerank_kernel(
+                part.block_docs, part.block_weights, idx_dev, w_dev,
+                live, cand, cs, part.n_docs_pad, kprime, k_plane)
+        cs_h = np.asarray(cs)
+        s_h = np.asarray(s)
+        eps_h = np.asarray(eps)
+        k_last = min(k_plane, s_h.shape[1]) - 1
+        if all(_margin_ok(float(s_h[qi, k_last]),
+                          float(cs_h[qi, kprime - 1]),
+                          float(eps_h[qi]), REL_BF16)
+               for qi in range(n_real)):
+            PLANES.note_quantized(kprime, n_real)
+            return s_h, np.asarray(d), np.asarray(hits)
+        if last:
+            _count_plane_quantized_fallback()
+            return None
+
+
 # ---------------------------------------------------------------------------
 # mesh-sharded plane executors: ONE SPMD program for a whole co-located
 # fan-out (ops/device_segment.py MeshPlanePart over a (dp, shard) mesh)
 # ---------------------------------------------------------------------------
 
 class MeshFallback(Exception):
-    """This fan-out cannot run on the mesh (e.g. an IVF-routed shard);
-    the caller runs the per-shard RPC fan-out."""
+    """This fan-out cannot run on the mesh (e.g. an IVF-routed shard, or
+    mixed per-shard quantized engagement that only the per-shard path
+    can serve byte-identically); the caller runs the per-shard RPC
+    fan-out. ``reason`` is the telemetry taxonomy constant the executor
+    counts."""
+
+    def __init__(self, msg: str, reason: Optional[str] = None):
+        super().__init__(msg)
+        self.reason = reason or telemetry.MESH_IVF_ROUTED
+
+
+def _count_mesh_quantized_fallback() -> None:
+    MESH_PLANES.stats["mesh_quantized_fallbacks"] += 1
+    telemetry.TELEMETRY.count_fallback(telemetry.MESH_QUANTIZED_FALLBACK)
+
+
+def _mesh_engages(subs, k: int) -> Optional[bool]:
+    """Whether the quantized coarse tier engages for a mesh fan-out:
+    True only when EVERY populated slot clears the per-shard engage
+    threshold — the same sizing rule the per-shard plane applies — so
+    the mesh and the RPC fan-out pick the same tier shard-for-shard.
+    None = slots disagree (only the per-shard path can serve each shard
+    its own tier; the kNN caller raises MeshFallback for this)."""
+    votes = [s.n_docs_total > 4 * _coarse_depth0(k, s.n_docs_pad)
+             for s in subs if s is not None]
+    if not votes or not any(votes):
+        return False
+    if all(votes):
+        return True
+    return None
 
 
 def _shard_readers(shard_ctxs):
@@ -747,6 +1063,141 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
             out.append(rows)
         return out
 
+    def _try_coarse() -> Optional[List[List[Tuple]]]:
+        """Quantized two-tier mesh text path: one bf16 coarse mesh
+        dispatch over the full plans + one exact f32 re-rank mesh
+        dispatch, adaptive depth deepening GLOBALLY (any (shard, member)
+        with a tight margin re-dispatches the whole program). Per-slot
+        bodies are the single-shard coarse/re-rank bodies, so re-ranked
+        scores are bit-compatible with the per-shard quantized path —
+        and counts come exact off the coarse pass. None = the exact mesh
+        phases below serve (typed when it is a fallback)."""
+        from elasticsearch_tpu.ops.bm25 import flatten_plans, qb_bucket
+        from elasticsearch_tpu.parallel.mesh import (
+            mesh_bm25_coarse, mesh_bm25_rerank,
+        )
+        if _mesh_engages(mpart.subs, k_mesh) is not True:
+            return None
+        mirror = mpart.quantized_mirror()
+        if mirror is None:
+            _count_mesh_quantized_fallback()
+            return None
+        tf16, dl16 = mirror
+        rows_full = _rows(lambda si, qi, p: p)
+        fb = qb_bucket(max(
+            [sum(p.n_blocks for p in rows)
+             for rows in rows_full if rows] + [1]))
+        idx = np.zeros((mpart.n_slots, fb), np.int32)
+        w = np.zeros((mpart.n_slots, fb), np.float32)
+        qid = np.zeros((mpart.n_slots, fb), np.int32)
+        favg = np.ones((mpart.n_slots, fb), np.float32)
+        for si, rows in enumerate(rows_full):
+            if not rows:
+                continue
+            i_s, w_s, q_s = flatten_plans(rows, fb)
+            idx[si], w[si], qid[si] = i_s, w_s, q_s
+            favg[si] = mpart.subs[si].block_avgdl[i_s]
+        idx_dev, w_dev = jnp.asarray(idx), jnp.asarray(w)
+        qid_dev, favg_dev = jnp.asarray(qid), jnp.asarray(favg)
+        live_dev = jnp.asarray(live_host)
+        blocks_full = np.zeros((n_sh, n_q), np.int64)
+        for si, rows in enumerate(rows_full):
+            if si < n_sh and rows:
+                for qi in range(n_q):
+                    blocks_full[si, qi] = rows[qi].n_blocks
+
+        from elasticsearch_tpu.indices.breaker import BREAKERS
+        for kprime, last in _adaptive_depths(k_mesh, mpart.n_docs_pad):
+            if check_members is not None:
+                check_members()
+            c_fn = mesh_bm25_coarse(mpart.mesh, mpart.n_docs_pad,
+                                    n_q_pad, kprime, mpart.n_segs_max,
+                                    DEFAULT_K1, DEFAULT_B)
+            r_fn = mesh_bm25_rerank(mpart.mesh, mpart.n_docs_pad,
+                                    n_q_pad, kprime, k_mesh,
+                                    mpart.n_segs_max, DEFAULT_K1,
+                                    DEFAULT_B)
+            transient = 8 * mpart.n_docs_pad * n_q_pad * mpart.n_slots
+            with BREAKERS.breaker("request").limit_scope(
+                    transient, "mesh_coarse_wand"):
+                if counter is not None:
+                    counter.extend((1, 1))
+                telemetry.record_dispatch(2)
+                cs, cand, hits = c_fn(mpart.block_docs, tf16, dl16,
+                                      idx_dev, w_dev, qid_dev, favg_dev,
+                                      live_dev, mpart.seg_ids)
+                s, d, eps = r_fn(mpart.block_docs, mpart.block_tfs,
+                                 idx_dev, w_dev, qid_dev, favg_dev,
+                                 mpart.doc_lens, live_dev, cand, cs)
+            cs_h, s_h = np.asarray(cs), np.asarray(s)
+            eps_h = np.asarray(eps)
+            k_last = min(k_mesh, s_h.shape[2]) - 1
+            ok = all(
+                _margin_ok(float(s_h[si, qi, k_last]),
+                           float(cs_h[si, qi, kprime - 1]),
+                           float(eps_h[si, qi]), REL_BF16)
+                for si in range(n_sh) if prepped[si] is not None
+                for qi in range(n_q) if prepped[si]["has"][qi])
+            if ok:
+                break
+            if last:
+                _count_mesh_quantized_fallback()
+                return None
+
+        hits_h = np.asarray(hits)
+        d_h = np.asarray(d)
+        # members with terms in ANY slot — the same members the
+        # per-shard path would have counted as coarse-tier-served
+        n_served = sum(
+            1 for qi in range(n_q)
+            if any(p is not None and p["has"][qi] for p in prepped))
+        MESH_PLANES.stats["mesh_quantized_queries"] += n_served
+        PLANES.note_quantized(kprime, n_served, mesh=True)
+        out: List[List[Tuple]] = []
+        for si in range(n_sh):
+            p = prepped[si]
+            if p is None:
+                out.append([empty] * n_q)
+                continue
+            sub = mpart.subs[si]
+            n_segs_here = len(sub.segments)
+            row_out: List[Tuple] = []
+            for qi in range(n_q):
+                if not p["has"][qi]:
+                    row_out.append(empty)
+                    continue
+                s_row, d_row = s_h[si, qi], d_h[si, qi]
+                finite = s_row != -np.inf
+                seg, local = sub.demux(d_row[finite])
+                cands = [ShardDoc(int(a), int(b), float(sc),
+                                  (float(sc),))
+                         for a, b, sc in zip(seg, local, s_row[finite])]
+                cands.sort(key=lambda c: (-c.score, c.segment_idx,
+                                          c.doc))
+                max_score = max((c.score for c in cands), default=None)
+                prune = (int(blocks_full[si, qi]),
+                         int(blocks_full[si, qi]))
+                h_row = hits_h[si, qi][:n_segs_here]
+                if not counts_on:
+                    total = int(np.minimum(h_row, want).sum())
+                    row_out.append((cands, total, "gte", max_score,
+                                    prune))
+                    continue
+                hits_seen = int(h_row.sum())
+                if hits_seen >= track_limit:
+                    row_out.append((cands, track_limit, "gte",
+                                    max_score, prune))
+                else:
+                    row_out.append((cands, hits_seen, "eq", max_score,
+                                    prune))
+            out.append(row_out)
+        return out
+
+    if PLANES.quantized:
+        got_coarse = _try_coarse()
+        if got_coarse is not None:
+            return got_coarse
+
     # phase A — one mesh dispatch: exact-mode (shard, member) pairs score
     # all their blocks (their counts are final), pruned pairs their
     # per-segment P1_BUCKET highest-upper-bound blocks
@@ -847,7 +1298,9 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
         for si, qi in recount:
             cands, _, _, max_score, prune = out[si][qi]
             exact_hits = int(h_r[si, qi].sum())
-            if exact_hits > track_limit:
+            # >= : relation at count == track_limit is "gte" on every
+            # path (the plane recount's boundary rule)
+            if exact_hits >= track_limit:
                 out[si][qi] = (cands, track_limit, "gte", max_score,
                                prune)
             else:
@@ -863,14 +1316,18 @@ def mesh_knn_winners(shard_ctxs, mpart, field: str, specs, k: int,
     """Q kNN queries against S co-located shards' vector planes in ONE
     mesh dispatch: the query stack rides the dp axis, the corpus the
     shard axis, and each slot's row reproduces that shard's exact plane
-    matmul (plane_knn_winners' exact path). Mesh kNN always serves EXACT
-    scores — a strict superset of the quantized coarse pass's
-    exact-up-to-rerank-depth contract.
+    matmul (plane_knn_winners' exact path). When EVERY populated slot
+    clears the quantized engage threshold, the int8 mirrors stacked per
+    mesh slot serve the coarse pass and the exact re-rank restores
+    golden scores (each slot running the per-shard two-tier arithmetic,
+    adaptive depth deepening globally) — the mesh no longer serves
+    exact-only.
 
     Returns [shard][member] winner lists [(segment_idx, local_doc,
     raw_score)]. Raises MeshFallback for IVF-routed shards (mapping
-    opt-in or ANN-sized corpora) — those keep the per-shard fan-out,
-    whose probe path already serves them."""
+    opt-in or ANN-sized corpora) and for MIXED per-shard quantized
+    engagement (only the per-shard fan-out serves each shard its own
+    tier byte-identically) — those keep the per-shard fan-out."""
     from elasticsearch_tpu.parallel.mesh import mesh_knn_topk
     from elasticsearch_tpu.search.execute import (
         ANN_DEFAULT_MIN_DOCS, execute as execute_query,
@@ -938,21 +1395,92 @@ def mesh_knn_winners(shard_ctxs, mpart, field: str, specs, k: int,
 
     k_mesh = min(max(k, 1), mpart.n_docs_pad)
     allowed = jnp.logical_and(jnp.asarray(live_host), mpart.exists)
-    fn = mesh_knn_topk(mpart.mesh, k_mesh, mpart.similarity,
-                       masked=masks_host is not None)
+    q_dev = jnp.asarray(q_host)
+    masks_dev = jnp.asarray(masks_host) if masks_host is not None \
+        else None
     from elasticsearch_tpu.indices.breaker import BREAKERS
     transient = 8 * mpart.n_docs_pad * n_q_pad * mpart.n_slots
-    with BREAKERS.breaker("request").limit_scope(transient, "mesh_knn"):
-        if counter is not None:
-            counter.append(1)
-        telemetry.record_dispatch()
-        if masks_host is not None:
-            s, d = fn(mpart.matrix, mpart.norms, allowed,
-                      jnp.asarray(q_host), jnp.asarray(masks_host))
-        else:
-            s, d = fn(mpart.matrix, mpart.norms, allowed,
-                      jnp.asarray(q_host))
-    s, d = np.asarray(s), np.asarray(d)
+
+    def _try_quantized():
+        """int8 coarse + exact re-rank over the stacked mirrors, the
+        adaptive-depth loop deepening globally. None = exact mesh
+        kernel serves (mirror refused / margin exhausted, typed)."""
+        from elasticsearch_tpu.parallel.mesh import (
+            mesh_knn_coarse, mesh_knn_rerank,
+        )
+        mirror = mpart.quantized_mirror()
+        if mirror is None:
+            _count_mesh_quantized_fallback()
+            return None
+        q8, scales = mirror
+        for kprime, last in _adaptive_depths(k_mesh, mpart.n_docs_pad):
+            if check_members is not None:
+                check_members()
+            c_fn = mesh_knn_coarse(mpart.mesh, kprime, mpart.similarity,
+                                   masked=masks_dev is not None)
+            r_fn = mesh_knn_rerank(mpart.mesh, k_mesh, mpart.similarity,
+                                   masked=masks_dev is not None)
+            with BREAKERS.breaker("request").limit_scope(
+                    transient, "mesh_coarse_knn"):
+                if counter is not None:
+                    counter.extend((1, 1))
+                telemetry.record_dispatch(2)
+                if masks_dev is not None:
+                    cs, cand = c_fn(q8, scales, mpart.norms, allowed,
+                                    q_dev, masks_dev)
+                    s_q, d_q, eps = r_fn(mpart.matrix, mpart.norms,
+                                         allowed, q_dev, cand, cs,
+                                         masks_dev)
+                else:
+                    cs, cand = c_fn(q8, scales, mpart.norms, allowed,
+                                    q_dev)
+                    s_q, d_q, eps = r_fn(mpart.matrix, mpart.norms,
+                                         allowed, q_dev, cand, cs)
+            cs_h, s_h = np.asarray(cs), np.asarray(s_q)
+            eps_h = np.asarray(eps)
+            k_last = min(k_mesh, s_h.shape[2]) - 1
+            if all(_margin_ok(float(s_h[si, qi, k_last]),
+                              float(cs_h[si, qi, kprime - 1]),
+                              float(eps_h[si, qi]), REL_INT8)
+                   for si in range(n_sh)
+                   if mpart.subs[si] is not None
+                   for qi in range(n_q)):
+                MESH_PLANES.stats["mesh_quantized_queries"] += n_q
+                PLANES.note_quantized(kprime, n_q, mesh=True)
+                return s_h, np.asarray(d_q)
+            if last:
+                _count_mesh_quantized_fallback()
+                return None
+
+    got_q = None
+    if PLANES.quantized:
+        engages = _mesh_engages(mpart.subs, k_mesh)
+        if engages is None:
+            # counted on the stats surface here (the executor counts the
+            # telemetry reason when it converts this to a mesh miss)
+            MESH_PLANES.stats["mesh_quantized_fallbacks"] += 1
+            raise MeshFallback(
+                "per-shard quantized engagement is mixed: the per-shard "
+                "fan-out serves each shard its own tier",
+                reason=telemetry.MESH_QUANTIZED_FALLBACK)
+        if engages:
+            got_q = _try_quantized()
+    if got_q is not None:
+        s, d = got_q
+    else:
+        fn = mesh_knn_topk(mpart.mesh, k_mesh, mpart.similarity,
+                           masked=masks_host is not None)
+        with BREAKERS.breaker("request").limit_scope(transient,
+                                                     "mesh_knn"):
+            if counter is not None:
+                counter.append(1)
+            telemetry.record_dispatch()
+            if masks_dev is not None:
+                s, d = fn(mpart.matrix, mpart.norms, allowed, q_dev,
+                          masks_dev)
+            else:
+                s, d = fn(mpart.matrix, mpart.norms, allowed, q_dev)
+        s, d = np.asarray(s), np.asarray(d)
 
     winners: List[List[List[Tuple[int, int, float]]]] = []
     for si in range(n_sh):
@@ -1026,18 +1554,72 @@ def mesh_sparse_topk(shard_ctxs, mpart, field: str,
         check_members()
     live_host = _mesh_live(mpart, shard_ctxs)
     k_mesh = min(max(want, 1), mpart.n_docs_pad)
-    fn = _mesh_sparse_kernel(mpart.mesh, mpart.n_docs_pad, k_mesh)
+    idx_dev, w_dev = jnp.asarray(idx), jnp.asarray(w)
+    live_dev = jnp.asarray(live_host)
     from elasticsearch_tpu.indices.breaker import BREAKERS
     transient = 8 * mpart.n_docs_pad * n_q_pad * mpart.n_slots
-    with BREAKERS.breaker("request").limit_scope(
-            transient, "mesh_sparse"):
-        if counter is not None:
-            counter.append(1)
-        telemetry.record_dispatch()
-        s, d, h = fn(mpart.block_docs, mpart.block_weights,
-                     jnp.asarray(idx), jnp.asarray(w),
-                     jnp.asarray(live_host))
-    s, d, h = np.asarray(s), np.asarray(d), np.asarray(h)
+
+    def _try_quantized():
+        """bf16 coarse + exact f32 re-rank over the stacked weight
+        mirrors, adaptive depth deepening globally; counts come exact
+        off the coarse pass. None = exact mesh kernel serves (typed
+        when it is a fallback)."""
+        from elasticsearch_tpu.parallel.mesh import (
+            mesh_sparse_coarse, mesh_sparse_rerank,
+        )
+        if _mesh_engages(mpart.subs, k_mesh) is not True:
+            return None
+        mirror = mpart.quantized_mirror()
+        if mirror is None:
+            _count_mesh_quantized_fallback()
+            return None
+        (w16,) = mirror
+        for kprime, last in _adaptive_depths(k_mesh, mpart.n_docs_pad):
+            if check_members is not None:
+                check_members()
+            c_fn = mesh_sparse_coarse(mpart.mesh, mpart.n_docs_pad,
+                                      kprime)
+            r_fn = mesh_sparse_rerank(mpart.mesh, mpart.n_docs_pad,
+                                      kprime, k_mesh)
+            with BREAKERS.breaker("request").limit_scope(
+                    transient, "mesh_coarse_sparse"):
+                if counter is not None:
+                    counter.extend((1, 1))
+                telemetry.record_dispatch(2)
+                cs, cand, hits = c_fn(mpart.block_docs, w16, idx_dev,
+                                      w_dev, live_dev)
+                s_q, d_q, eps = r_fn(mpart.block_docs,
+                                     mpart.block_weights, idx_dev,
+                                     w_dev, live_dev, cand, cs)
+            cs_h, s_h = np.asarray(cs), np.asarray(s_q)
+            eps_h = np.asarray(eps)
+            k_last = min(k_mesh, s_h.shape[2]) - 1
+            if all(_margin_ok(float(s_h[si, qi, k_last]),
+                              float(cs_h[si, qi, kprime - 1]),
+                              float(eps_h[si, qi]), REL_BF16)
+                   for si in range(n_sh)
+                   if per_shard[si] is not None
+                   for qi in range(n_q)):
+                MESH_PLANES.stats["mesh_quantized_queries"] += n_q
+                PLANES.note_quantized(kprime, n_q, mesh=True)
+                return s_h, np.asarray(d_q), np.asarray(hits)
+            if last:
+                _count_mesh_quantized_fallback()
+                return None
+
+    got_q = _try_quantized() if PLANES.quantized else None
+    if got_q is not None:
+        s, d, h = got_q
+    else:
+        fn = _mesh_sparse_kernel(mpart.mesh, mpart.n_docs_pad, k_mesh)
+        with BREAKERS.breaker("request").limit_scope(
+                transient, "mesh_sparse"):
+            if counter is not None:
+                counter.append(1)
+            telemetry.record_dispatch()
+            s, d, h = fn(mpart.block_docs, mpart.block_weights,
+                         idx_dev, w_dev, live_dev)
+        s, d, h = np.asarray(s), np.asarray(d), np.asarray(h)
 
     out: List[List[Tuple]] = []
     for si in range(n_sh):

@@ -81,6 +81,13 @@ PLANE_FIELD_ABSENT = "plane_field_absent"
 PLANE_IVF_NPROBE_DISAGREEMENT = "plane_ivf_nprobe_disagreement"
 PLANE_IVF_BREAKER_REFUSED = "plane_ivf_breaker_refused"
 
+# quantized coarse tier: why a coarse-eligible query served EXACT
+# instead (mirror refused by the HBM budget, or the adaptive re-rank
+# depth hit its bound without the margin proving top-k parity); results
+# are identical either way — this is a perf-tier routing record
+PLANE_QUANTIZED_FALLBACK = "plane_quantized_fallback"
+MESH_QUANTIZED_FALLBACK = "mesh_quantized_fallback"
+
 # shard micro-batcher: why a drained batch re-executed member-by-member
 BATCH_IVF_NPROBE_DISAGREEMENT = "batch_ivf_nprobe_disagreement"
 BATCH_BREAKER_REFUSED = "batch_breaker_refused"

@@ -341,16 +341,30 @@ SEARCH_PLANE_MIN_SEGMENTS: Setting[int] = Setting.int_setting(
     "search.plane.min_segments", 2, min_value=1,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
-# quantized coarse-pass re-rank depth: the int8 coarse pass keeps this
-# many candidates per query for the exact f32 re-rank — top-k is
-# identical to the exact path as long as the true top-k survives the
-# coarse pass, which this depth controls
+# quantized coarse-pass re-rank depth (ALL coarse-tier classes: int8
+# kNN, bf16 bm25/sparse): the coarse pass keeps this many candidates
+# per query for the exact f32 re-rank — the STARTING depth; the margin
+# check at position k' deepens adaptively (x2 per escalation) whenever
+# it cannot prove the true top-k survived the coarse pass
 SEARCH_PLANE_RERANK_DEPTH: Setting[int] = Setting.int_setting(
     "search.plane.rerank_depth", 128, min_value=1, max_value=65536,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
-# int8 coarse pass + exact f32 re-rank for plane kNN; false = every plane
-# kNN query runs fully exact
+# adaptive-depth ceiling: a query whose coarse margin still cannot
+# clear the error bound at this depth serves EXACT instead (typed
+# plane_quantized_fallback). For the bf16 classes the margin is a real
+# proof (the a-priori bound exceeds the worst-case bf16 contribution
+# error); for int8 kNN it hardens an empirical estimate — no usable
+# closed-form bound exists — with the escalate-then-exact backstop and
+# the CHAOS-swept golden suites owning the tail
+SEARCH_PLANE_RERANK_DEPTH_MAX: Setting[int] = Setting.int_setting(
+    "search.plane.rerank_depth_max", 1024, min_value=1,
+    max_value=1 << 20, scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# quantized coarse pass + exact f32 re-rank for the plane's
+# scatter-bound classes (int8 mirrors for kNN, bf16 term-frequency /
+# norm / weight mirrors for bm25 and sparse); false = every plane query
+# runs fully exact
 SEARCH_PLANE_QUANTIZED: Setting[bool] = Setting.bool_setting(
     "search.plane.quantized", True,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
@@ -395,6 +409,26 @@ SEARCH_MESH_DP: Setting[int] = Setting.int_setting(
 # appears in a committed state; counted as mesh_plane_warmups
 SEARCH_MESH_WARMUP_AT_BOOT: Setting[bool] = Setting.bool_setting(
     "search.mesh.warmup_at_boot", False,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# Device observatory (search/device_profile.py) recompile-storm
+# detector, promoted from DEVICE_PROFILE.configure() module config to
+# dynamic cluster settings (the search.plane.* application pattern):
+# more than storm_threshold distinct compiles of one kernel family
+# inside storm_window is a recompile storm — a broken shape-bucketing
+# invariant burning seconds of serving capacity per compile
+SEARCH_DEVICE_PROFILE_STORM_THRESHOLD: Setting[int] = Setting.int_setting(
+    "search.device_profile.storm_threshold", 8, min_value=1,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+SEARCH_DEVICE_PROFILE_STORM_WINDOW: Setting[float] = Setting.time_setting(
+    "search.device_profile.storm_window", "60s",
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# individual compiles slower than this log a slow-compile line even
+# without a storm (the storm family's sibling knob, applied together)
+SEARCH_DEVICE_PROFILE_SLOW_COMPILE: Setting[float] = Setting.time_setting(
+    "search.device_profile.slow_compile_threshold", "1s",
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
 # ---------------------------------------------------------------------------

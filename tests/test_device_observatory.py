@@ -62,8 +62,10 @@ def test_no_raw_jit_call_sites_under_ops_and_search():
     """An uninstrumented kernel is invisible to the observatory — the
     zero-steady-state-recompiles gate and the per-family attribution
     both silently lose coverage. Pin raw jit call sites at ZERO under
-    ops/, search/ and the mesh kernel factory module; the one allowed
-    speller is the wrapper itself."""
+    ops/, search/, the mesh kernel factory module, the legacy sharded
+    search factories and the text-expansion model (the last two were
+    outside the guard until their kernels joined the observatory); the
+    one allowed speller is the wrapper itself."""
     raw_jit = re.compile(r"\bjax\s*\.\s*jit\b|\bfrom\s+jax\s+import\s+jit\b")
     pkg = os.path.join(REPO, "elasticsearch_tpu")
     targets = []
@@ -73,6 +75,8 @@ def test_no_raw_jit_call_sites_under_ops_and_search():
             targets.extend(os.path.join(dirpath, f)
                            for f in files if f.endswith(".py"))
     targets.append(os.path.join(pkg, "parallel", "mesh.py"))
+    targets.append(os.path.join(pkg, "parallel", "sharded_search.py"))
+    targets.append(os.path.join(pkg, "ml", "text_expansion.py"))
     offenders = []
     for path in targets:
         if path.endswith(os.path.join("search", "device_profile.py")):
