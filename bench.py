@@ -1641,8 +1641,61 @@ def cfg_overload(np, jax, jnp, result):
             "rejections_by_tenant": dict(pool.rejected_by_tenant),
             "retry_after_last_s": pool.last_retry_after_s,
         }
+
+        # resolve-before-admission cost (the PR 10 follow-up's open
+        # question): the fair-admission tenant key now resolves the
+        # index expression to concrete indices, so measure what one
+        # admission pays — cold (first expression at a state version)
+        # and warm (the version-keyed memo every later request hits)
+        sa = node.search_action
+        t0 = time.perf_counter()
+        for _ in range(200):
+            sa._tenant_cache_version = None     # force the resolve
+            sa._admission_tenant("h*,bg")
+        cold_us = (time.perf_counter() - t0) / 200 * 1e6
+        sa._tenant_cache_version = None
+        sa._admission_tenant("h*,bg")
+        t0 = time.perf_counter()
+        for _ in range(2000):
+            sa._admission_tenant("h*,bg")
+        warm_us = (time.perf_counter() - t0) / 2000 * 1e6
+        result["configs"]["overload"]["tenant_resolve_cold_us"] = \
+            round(cold_us, 2)
+        result["configs"]["overload"]["tenant_resolve_warm_us"] = \
+            round(warm_us, 3)
+        result["configs"]["overload"]["tenant_key_normalized"] = \
+            sa._admission_tenant("h*,bg")
     finally:
         c.stop()
+
+
+def cfg_fleet(np, jax, jnp, result):
+    """Fleet-wide overload scenario (ROADMAP item 6): the million-user
+    chaos harness — 3 coordinators x 4 zipfian tenants on a diurnal
+    curve, a 10:1 hot flood mid-peak, one slow data node, a
+    noisy-neighbor wave over the hot tenant's sibling copy, and a
+    rolling restart mid-peak — against the TWO-SIDED shed contract
+    (coordinator admission + per-tenant fair shedding, shard-side
+    search.shard.max_queued_members bound with typed shard_busy
+    rejections, coordinator busy-failover to the next C3-ranked copy).
+    The emitted block carries the acceptance contract directly:
+    bounded admitted p99, every rejection a clean Retry-After 429,
+    zero starved tenants, zero wrong hits, the shed -> failover loop
+    ENGAGED, and zero requests lost to a shed that had a live sibling
+    copy with headroom. All timing virtual: seed-reproducible."""
+    from elasticsearch_tpu.testing import fleet_overload_scenario
+    s = fleet_overload_scenario(seed=SEED + 13)
+    s["p99_bounded"] = bool(s["p99_factor_vs_unloaded"] <= 4.0)
+    s["zero_unhandled_errors"] = s["unclean_rejections"] == 0
+    s["zero_starved_tenants"] = not s["starved_tenants"]
+    s["zero_wrong_hits"] = s["wrong_hits"] == 0
+    s["shed_loop_engaged"] = bool(
+        s["shard_busy_sheds"] > 0 and s["failover"]["failovers"] > 0)
+    s["zero_lost_with_live_sibling"] = (
+        s["request_busy_failures"] == s["failover"]["all_copies_shed"])
+    s["ars_routed_around_slow_node"] = bool(
+        s["victim_copy_hits"] < s["sibling_copy_hits"])
+    result["configs"]["fleet"] = s
 
 
 def multichip_scaling(per_shard_docs: int = 0, q_batch: int = 8,
@@ -1915,6 +1968,7 @@ def main() -> None:
                          ("aggs", cfg_aggs),
                          ("segmented", cfg_segmented),
                          ("overload", cfg_overload),
+                         ("fleet", cfg_fleet),
                          ("multichip", cfg_multichip)):
             try:
                 if name == "hybrid":

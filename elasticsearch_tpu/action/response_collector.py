@@ -108,20 +108,54 @@ class ResponseCollectorService:
                     ALPHA * q + (1 - ALPHA) * stats.queue_ewma
             stats.observations += 1
 
+    def on_rejection(self, node_id: str,
+                     queue_depth: Optional[float] = None,
+                     retry_after_s: Optional[int] = None) -> None:
+        """A shard_busy shed: the node answered FAST (the rejection cost
+        no drain), so feeding it through on_response would IMPROVE its
+        response-time EWMA while it is refusing work. Instead the
+        reported member backlog lands straight on the queue EWMA — the
+        cubed C3 queue term then sinks the node's rank immediately, one
+        shed ahead of any latency signal — and the round trip is not
+        counted as a response time at all. The rejection's Retry-After
+        is the node's own (backlog+1)/drain_rate estimate, so
+        retry_after/(backlog+1) recovers a per-member service-time seed
+        — a node whose FIRST contact is a shed still ranks WORSE than
+        its healthy siblings, never as an optimistic unknown."""
+        with self._lock:
+            stats = self._stats(node_id)
+            stats.outstanding = max(0, stats.outstanding - 1)
+            if queue_depth is not None:
+                q = float(queue_depth)
+                # jump up instantly (a busy node must stop winning NOW),
+                # decay back through the normal EWMA/decay machinery
+                stats.queue_ewma = q if stats.queue_ewma is None \
+                    else max(q, ALPHA * q + (1 - ALPHA) * stats.queue_ewma)
+            if retry_after_s and queue_depth:
+                s = retry_after_s * 1000.0 / (float(queue_depth) + 1.0)
+                stats.service_ewma_ms = s \
+                    if stats.service_ewma_ms is None else \
+                    ALPHA * s + (1 - ALPHA) * stats.service_ewma_ms
+            stats.observations += 1
+
     # -- ranking ----------------------------------------------------------
 
     def rank(self, node_id: str) -> float:
         """Lower is better. Unknown nodes rank best (0) so new/idle nodes
-        get probed, like the reference's optimistic default."""
+        get probed, like the reference's optimistic default — but a node
+        whose only history is shed rejections (queue_ewma set, no
+        response EWMA yet) is NOT unknown: it ranks by its reported
+        backlog."""
         with self._lock:
             stats = self._nodes.get(node_id)
-            if stats is None or stats.ewma_ms is None:
+            if stats is None or (stats.ewma_ms is None and
+                                 stats.queue_ewma is None):
                 return 0.0
             return self._rank_locked(stats, self._clients_locked())
 
     @staticmethod
     def _rank_locked(stats: NodeStatistics, n_clients: int) -> float:
-        r = stats.ewma_ms
+        r = stats.ewma_ms if stats.ewma_ms is not None else 0.0
         # the piggybacked service-time EWMA s (= 1/mu, mu the service
         # rate); no report yet (failure-only history, or a pre-upgrade
         # node): the response time is the best service proxy. `is not
@@ -170,10 +204,14 @@ class ResponseCollectorService:
             d = self.UNSELECTED_DECAY
             for nid in losers:
                 stats = self._nodes.get(nid)
-                if stats is None or stats.ewma_ms is None:
+                if stats is None:
                     continue
-                if floor is not None and stats.ewma_ms > floor:
+                if stats.ewma_ms is not None and floor is not None \
+                        and stats.ewma_ms > floor:
                     stats.ewma_ms = stats.ewma_ms * (1 - d) + floor * d
+                # rejection-only nodes decay too: a once-busy node whose
+                # every contact was a shed must drift back into
+                # contention once the backlog report ages
                 if stats.queue_ewma:
                     stats.queue_ewma *= (1 - d)
 
@@ -191,7 +229,8 @@ class ResponseCollectorService:
                          "queue_ewma": round(stats.queue_ewma or 0.0, 3),
                          "rank": (round(self._rank_locked(
                              stats, n_clients), 3)
-                             if stats.ewma_ms is not None else 0.0)}
+                             if stats.ewma_ms is not None or
+                             stats.queue_ewma is not None else 0.0)}
                 if stats.service_ewma_ms is not None:
                     entry["service_ewma_ms"] = \
                         round(stats.service_ewma_ms, 3)

@@ -34,7 +34,25 @@ from elasticsearch_tpu.search.telemetry import TELEMETRY, SearchTrace
 from elasticsearch_tpu.transport.transport import TransportService
 from elasticsearch_tpu.utils.errors import (
     IllegalArgumentError, IndexNotFoundError, SearchEngineError,
+    shard_busy_info,
 )
+from elasticsearch_tpu.utils.retry import RetryableAction
+
+
+class _AllCopiesShed(Exception):
+    """Internal: every copy of one shard shed ``shard_busy`` inside one
+    failover round — the only outcome that surfaces the busy signal to
+    the caller (as a 429-status shard failure / request). ``retry_after``
+    is the LEAST-LOADED copy's estimate: the minimum across the round's
+    sheds, i.e. the soonest ANY copy's measured drain rate expects
+    headroom."""
+
+    def __init__(self, n_copies: int, retry_after: int):
+        super().__init__(
+            f"all {n_copies} copies shed the query (shard_busy); "
+            f"retry_after={retry_after}s")
+        self.n_copies = n_copies
+        self.retry_after = retry_after
 
 SEARCH_CAN_MATCH = "indices:data/read/search[can_match]"
 SEARCH_DFS = "indices:data/read/search[phase/dfs]"
@@ -689,6 +707,69 @@ class TransportSearchAction:
         # hybrid RRF fusion batcher: concurrent requests' fusions
         # coalesce into one rrf_fuse_batch device dispatch
         self.rrf_fuser = RrfFusionBatcher(ts, self._batch_enabled)
+        # shard_busy failover observability — the coordinator half of
+        # the two-sided shed contract, surfaced under
+        # search_admission.shard_busy_failover in _nodes/stats
+        self.shard_busy_stats: Dict[str, int] = {
+            "sheds_seen": 0,       # shard_busy rejections received
+            "failovers": 0,        # sheds routed to the next ranked copy
+            "retry_rounds": 0,     # backed-off re-walks of a copy list
+            "all_copies_shed": 0,  # shards surfaced as 429 failures
+        }
+        # admission tenant resolution memo (one cluster-state version's
+        # expression -> concrete-indices mappings; rebuilt on version
+        # change so index creation/deletion re-keys tenants immediately)
+        self._tenant_cache: Dict[str, str] = {}
+        self._tenant_cache_version: Optional[int] = None
+
+    # shard_busy failover policy: within a round, a shed fails over to
+    # the next C3-ranked copy immediately (a sibling may have headroom
+    # RIGHT NOW); a round where EVERY copy shed backs off with equal
+    # jitter (RetryableAction) and re-walks the re-ranked list — bounded
+    # by rounds and by the request's own time budget
+    SHARD_BUSY_MAX_ROUNDS = 3
+    SHARD_BUSY_RETRY_INITIAL_S = 0.05
+    SHARD_BUSY_RETRY_MAX_S = 0.5
+    SHARD_BUSY_RETRY_TIMEOUT_S = 10.0
+
+    def _admission_tenant(self, index_expression: str) -> str:
+        """The fair-admission tenant key: the index expression RESOLVED
+        to its concrete indices (sorted, comma-joined) so ``logs*`` and
+        ``logs-1,logs-2`` count as ONE tenant and neither can dodge fair
+        shedding by rephrasing the same target set. Falls back to the
+        raw expression when no cluster state is available (early boot,
+        coordinator-only tests) or the expression names unknown/remote
+        indices — admission must never fail on the tenant key. Memoized
+        per cluster-state version (the resolve cost is measured in the
+        overload bench line)."""
+        raw = index_expression or "_all"
+        try:
+            state = self.state() if self.state is not None else None
+            if state is None:
+                return raw
+            version = getattr(state, "version", None)
+            if version != self._tenant_cache_version:
+                self._tenant_cache = {}
+                self._tenant_cache_version = version
+            got = self._tenant_cache.get(raw)
+            if got is None:
+                try:
+                    from elasticsearch_tpu.cluster.metadata import (
+                        resolve_index_expression,
+                    )
+                    names = resolve_index_expression(index_expression,
+                                                     state.metadata)
+                    got = ",".join(names) if names else raw
+                except Exception:  # noqa: BLE001 — unknown/remote/
+                    got = raw      # expression quirk: raw still buckets
+                # the FALLBACK memoizes too: a flood of requests for a
+                # deleted index must not pay an uncached resolve+raise
+                # per admission at the coordinator's hottest chokepoint
+                if len(self._tenant_cache) < 512:
+                    self._tenant_cache[raw] = got
+            return got
+        except Exception:  # noqa: BLE001 — no readable state
+            return raw
 
     def _batch_enabled(self) -> bool:
         """Mirrors ShardQueryBatcher's read of search.batch.enabled from
@@ -900,12 +981,14 @@ class TransportSearchAction:
                 releasing_done(None, e)
 
         try:
-            # the tenant key is the index expression: one hot index's
-            # flood fills only its fair share of the queue, and a queued
+            # the tenant key is the RESOLVED index expression: one hot
+            # index's flood fills only its fair share of the queue
+            # however the client spells the target set, and a queued
             # hot-tenant search can be DISPLACED (on_reject fires) to
             # admit a starved background tenant
             self.thread_pool.submit(
-                "search", admitted_task, tenant=index_expression or "_all",
+                "search", admitted_task,
+                tenant=self._admission_tenant(index_expression),
                 on_reject=lambda e: inner_admit(None, e))
         except Exception as e:  # noqa: BLE001 — backpressure
             inner_admit(None, e)
@@ -1107,11 +1190,21 @@ class TransportSearchAction:
                for t in targets):
             TELEMETRY.count_fallback(telemetry.MESH_ALIAS_OR_MULTI_INDEX)
             return False
+        scheduler = self.ts.transport.scheduler
+        t_sent = scheduler.now()
 
         def on_results(results) -> None:
             if results is None:
                 fallback()
                 return
+            # mesh-served fan-outs are VISIBLE to ARS (PR 10 follow-up):
+            # synthesize the per-shard observations the RPC path would
+            # have produced — one on_send/on_response pair per target,
+            # carrying the serving node's own pressure as the piggyback
+            # — so a mesh-serving node's saturation is never invisible
+            # to replica selection the moment a mesh spans nodes
+            self._observe_mesh_serving(targets,
+                                       scheduler.now() - t_sent)
             phase_state["data_plane"] = "mesh_plane"
             for target in targets:
                 target["node"] = self.node_id    # fetch runs locally
@@ -1126,6 +1219,27 @@ class TransportSearchAction:
             phase_state["_t_query_ns"] = time.monotonic_ns()
             _task_phase(phase_state, "query", plane="mesh")
         return submitted
+
+    def _observe_mesh_serving(self, targets, rtt_s: float) -> None:
+        """Feed ARS one synthesized per-shard observation per mesh-served
+        target: the serving node (this one) gets on_send/on_response
+        pairs whose service/queue figures come straight from its own
+        batcher pressure tracker (the mesh drain observes itself into
+        NodePressure), exactly the piggyback an RPC shard response would
+        have carried."""
+        if self.search_transport is None:
+            return
+        try:
+            batcher = self.search_transport.batcher
+            snap = batcher.node_pressure.snapshot(batcher.queue_depth())
+            for _t in targets:
+                self.response_collector.on_send(self.node_id)
+                self.response_collector.on_response(
+                    self.node_id, rtt_s,
+                    service_ms=snap.get("service_ewma_ms"),
+                    queue_depth=snap.get("queue"))
+        except Exception:  # noqa: BLE001 — observability must never
+            pass           # fail a served search
 
     # -- mesh one-program path ------------------------------------------
 
@@ -1145,6 +1259,16 @@ class TransportSearchAction:
             return False
         field = spec["field"]
         index = indices[0]
+        # the shard-side member bound governs this mesh path too (the
+        # mesh executor's try_submit discipline): a node over its bound
+        # refuses the fast path so the RPC fan-out's shed + failover
+        # machinery applies — the bound cannot be dodged by being
+        # mesh-served on EITHER mesh path
+        batcher = self.search_transport.batcher \
+            if self.search_transport is not None else None
+        if batcher is not None and batcher.at_member_bound():
+            TELEMETRY.count_fallback(telemetry.MESH_NODE_BUSY)
+            return False
         shards: Dict[int, Any] = {}
         for target in targets:
             if target["index"] != index or \
@@ -1152,37 +1276,54 @@ class TransportSearchAction:
                 return False
             shards[target["shard"]] = self.indices.shard(
                 index, target["shard"])
+        t_sent = self.ts.transport.scheduler.now()
+        t_wall = time.monotonic_ns()
+        # mesh-plane work counts into the node's pressure tracker like
+        # every other serving path, so the piggybacks, the member bound
+        # and the drain-rate estimates see it
+        if batcher is not None:
+            batcher.node_pressure.in_flight += 1
         try:
-            mappers = self.indices.index_service(index).mapper_service
-            kind = spec["kind"]
-            if kind == "text":
-                if mappers.field_type(field) not in ("text",
-                                                     "search_as_you_type"):
+            try:
+                mappers = self.indices.index_service(index).mapper_service
+                kind = spec["kind"]
+                if kind == "text":
+                    if mappers.field_type(field) not in (
+                            "text", "search_as_you_type"):
+                        return False
+                    result = self.mesh_plane.search_text(
+                        index, field, shards, body, mappers,
+                        clauses=spec["clauses"])
+                elif kind == "knn":
+                    if mappers.field_type(field) != "dense_vector":
+                        return False
+                    result = self.mesh_plane.search_knn(
+                        index, field, shards, body, spec["query"])
+                elif kind == "sparse":
+                    if mappers.field_type(field) not in ("rank_features",
+                                                         "rank_feature"):
+                        return False
+                    result = self.mesh_plane.search_sparse(
+                        index, field, shards, body, spec["query"])
+                else:
                     return False
-                result = self.mesh_plane.search_text(
-                    index, field, shards, body, mappers,
-                    clauses=spec["clauses"])
-            elif kind == "knn":
-                if mappers.field_type(field) != "dense_vector":
-                    return False
-                result = self.mesh_plane.search_knn(index, field, shards,
-                                                    body, spec["query"])
-            elif kind == "sparse":
-                if mappers.field_type(field) not in ("rank_features",
-                                                     "rank_feature"):
-                    return False
-                result = self.mesh_plane.search_sparse(
-                    index, field, shards, body, spec["query"])
-            else:
+            except Exception:  # noqa: BLE001 — RPC reports real errors
+                # graceful degradation: the broken mesh program escapes
+                # to the host-RPC scatter-gather, observably
+                self.mesh_plane.stats["mesh_fallbacks"] += 1
+                TELEMETRY.count_fallback(telemetry.LEGACY_MESH_ERROR)
                 return False
-        except Exception:  # noqa: BLE001 — RPC path reports real errors
-            # graceful degradation: the broken mesh program escapes to the
-            # host-RPC scatter-gather, and the escape is observable
-            self.mesh_plane.stats["mesh_fallbacks"] += 1
-            TELEMETRY.count_fallback(telemetry.LEGACY_MESH_ERROR)
-            return False
+        finally:
+            if batcher is not None:
+                batcher.node_pressure.observe(
+                    (time.monotonic_ns() - t_wall) / 1e6, members=1)
+                batcher.node_pressure.in_flight = max(
+                    0, batcher.node_pressure.in_flight - 1)
         if result is None:
             return False
+        # mesh-served traffic is ARS-visible on this path too
+        self._observe_mesh_serving(
+            targets, self.ts.transport.scheduler.now() - t_sent)
         hits = result["hits"]
         phase_state["data_plane"] = "mesh"
         # synthesize per-shard query results so merge+fetch run unchanged
@@ -1287,106 +1428,216 @@ class TransportSearchAction:
         results: List[Optional[Dict[str, Any]]] = [None] * len(targets)
         pending = {"n": len(targets)}
         resolved = [False] * len(targets)
+        from elasticsearch_tpu.utils.settings import (
+            CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION, setting_from_state,
+        )
+        use_ars = setting_from_state(
+            self.state() if self.state is not None else None,
+            CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION)
 
-        def one(i: int, target, copy_idx: int = 0) -> None:
-            shard_body = body
-            if target.get("alias_filter") is not None:
-                # filtered alias: wrap for THIS shard's index only
-                shard_body = {**body, "query": {"bool": {
-                    "must": [body.get("query", {"match_all": {}})],
-                    "filter": [target["alias_filter"]]}}}
-            req = {"index": target["index"], "shard": target["shard"],
-                   "body": shard_body, "window": window}
-            if phase_state.get("task_id"):
-                req["task_id"] = phase_state["task_id"]
-            if phase_state.get("deadline") is not None:
-                # shard-side budget enforcement: ship the time LEFT at
-                # dispatch (durations survive process boundaries;
-                # absolute monotonic timestamps don't)
-                req["budget_remaining"] = max(
-                    0.0, phase_state["deadline"] -
-                    self.ts.transport.scheduler.now())
-            if dfs_overrides:
-                req.update(dfs_overrides)
-            copies = target.get("copies", [target["node"]])
-            node = copies[copy_idx]
+        def one(i: int, target) -> None:
+            """Dispatch one shard: walk its (C3-ranked) copy list, treat
+            ``shard_busy`` sheds as ROUTING signals (fail over to the
+            next copy inside the round), and when a whole round sheds,
+            back off with equal jitter (RetryableAction) and re-walk the
+            re-ranked list — only a shard whose EVERY copy shed in its
+            final round surfaces a (429-status) failure. Replica
+            failovers and retry rounds re-use the shard's fan-out
+            slot."""
+            copies_all = target.get("copies", [target["node"]])
             scheduler = self.ts.transport.scheduler
-            # scheduler time, not wall: the round trip then includes the
-            # transport's (possibly simulated) latency, so replica
-            # ranking — and the wire/service split below — behaves
-            # identically under the deterministic harness and production
-            t_sent = scheduler.now()
-            self.response_collector.on_send(node)
+            rounds = {"n": 0}
 
-            def cb(resp, err):
-                rtt_s = scheduler.now() - t_sent
-                # C3 feedback: the shard response piggybacks the node's
-                # self-reported queue depth and service-time EWMA — feed
-                # them to the collector so order_copies can route around
-                # a SATURATED node, not just a slow wire
-                pressure = resp.get("pressure") \
-                    if err is None and isinstance(resp, dict) else None
-                self.response_collector.on_response(
-                    node, rtt_s, failed=err is not None,
-                    service_ms=(pressure or {}).get("service_ewma_ms"),
-                    queue_depth=(pressure or {}).get("queue"))
-                if err is None and isinstance(resp, dict) and \
-                        resp.get("took_ms") is not None and \
-                        phase_state.get("trace") is not None:
-                    # wire vs service split: the shard reports its own
-                    # took (arrival -> delivery), the coordinator
-                    # subtracts it from the round trip — shown per shard
-                    # in the profile:true coordinator tree
-                    took_ms = float(resp["took_ms"])
-                    wire_ms = max(rtt_s * 1000.0 - took_ms, 0.0)
-                    phase_state["trace"].add_span(
-                        "shard_query", max(int(rtt_s * 1e9), 1),
-                        {"index": target["index"],
-                         "shard": target["shard"], "node": node,
-                         "service_ms": round(took_ms, 3),
-                         "wire_ms": round(wire_ms, 3)})
+            def round_attempt(round_cb) -> None:
+                rounds["n"] += 1
+                copies = list(copies_all)
+                if rounds["n"] > 1:
+                    self.shard_busy_stats["retry_rounds"] += 1
+                    # a RETRY round re-ranks: the sheds that triggered
+                    # the backoff fed the busy nodes' backlogs into ARS,
+                    # so the re-walk starts at the copy now expected
+                    # least loaded. The FIRST round keeps the order
+                    # _shard_targets computed (rotation fairness, plus
+                    # the adaptive rank when ARS is on) — and with ARS
+                    # off, retries keep pure rotation: the chaos
+                    # baseline stays rank-free on every round.
+                    if use_ars and len(copies) > 1:
+                        copies = self.response_collector.order_copies(
+                            copies)
+                busy_ras: List[int] = []
+                real_errs: List[Exception] = []
+
+                def try_copy(copy_idx: int) -> None:
+                    shard_body = body
+                    if target.get("alias_filter") is not None:
+                        # filtered alias: wrap for THIS shard's index only
+                        shard_body = {**body, "query": {"bool": {
+                            "must": [body.get("query", {"match_all": {}})],
+                            "filter": [target["alias_filter"]]}}}
+                    req = {"index": target["index"],
+                           "shard": target["shard"],
+                           "body": shard_body, "window": window}
+                    if phase_state.get("task_id"):
+                        req["task_id"] = phase_state["task_id"]
+                    if phase_state.get("deadline") is not None:
+                        # shard-side budget enforcement: ship the time
+                        # LEFT at dispatch (durations survive process
+                        # boundaries; absolute timestamps don't)
+                        req["budget_remaining"] = max(
+                            0.0, phase_state["deadline"] -
+                            scheduler.now())
+                    if dfs_overrides:
+                        req.update(dfs_overrides)
+                    node = copies[copy_idx]
+                    # scheduler time, not wall: the round trip then
+                    # includes the transport's (possibly simulated)
+                    # latency, so replica ranking — and the wire/service
+                    # split below — behaves identically under the
+                    # deterministic harness and production
+                    t_sent = scheduler.now()
+                    self.response_collector.on_send(node)
+
+                    def cb(resp, err):
+                        rtt_s = scheduler.now() - t_sent
+                        busy = shard_busy_info(err)
+                        if busy is not None:
+                            # a shed is NOT a response time (the node
+                            # answered fast precisely because it did no
+                            # work): its reported backlog lands on the
+                            # queue EWMA so the cubed C3 term sinks the
+                            # node's rank immediately
+                            self.shard_busy_stats["sheds_seen"] += 1
+                            self.response_collector.on_rejection(
+                                node, busy["queued"] or None,
+                                busy["retry_after"])
+                        else:
+                            # C3 feedback: the shard response piggybacks
+                            # the node's self-reported queue depth and
+                            # service-time EWMA — feed them to the
+                            # collector so order_copies can route around
+                            # a SATURATED node, not just a slow wire
+                            pressure = resp.get("pressure") \
+                                if err is None and isinstance(resp, dict) \
+                                else None
+                            self.response_collector.on_response(
+                                node, rtt_s, failed=err is not None,
+                                service_ms=(pressure or {})
+                                .get("service_ewma_ms"),
+                                queue_depth=(pressure or {}).get("queue"))
+                        if err is None and isinstance(resp, dict) and \
+                                resp.get("took_ms") is not None and \
+                                phase_state.get("trace") is not None:
+                            # wire vs service split: the shard reports
+                            # its own took (arrival -> delivery), the
+                            # coordinator subtracts it from the round
+                            # trip — shown per shard in the profile:true
+                            # coordinator tree
+                            took_ms = float(resp["took_ms"])
+                            wire_ms = max(rtt_s * 1000.0 - took_ms, 0.0)
+                            phase_state["trace"].add_span(
+                                "shard_query", max(int(rtt_s * 1e9), 1),
+                                {"index": target["index"],
+                                 "shard": target["shard"], "node": node,
+                                 "service_ms": round(took_ms, 3),
+                                 "wire_ms": round(wire_ms, 3)})
+                        if phase_state.get("aborted") or \
+                                phase_state.get("budget_expired"):
+                            return   # the phase completed without us
+                        if err is None:
+                            target["node"] = node  # fetch follows query
+                            round_cb({"resp": resp}, None)
+                            return
+                        # a cancelled task must abort the whole search,
+                        # not fail over (cancellation is not a fault)
+                        if getattr(err, "cause_type", "") == \
+                                "TaskCancelledError" or \
+                                type(err).__name__ == "TaskCancelledError":
+                            phase_state["aborted"] = True
+                            timer = phase_state.pop("_budget_timer", None)
+                            if timer is not None:
+                                timer.cancel()
+                            on_done(None, err)
+                            return
+                        if busy is not None:
+                            busy_ras.append(busy["retry_after"])
+                            if copy_idx + 1 < len(copies):
+                                # routing signal, not a failure: the
+                                # next ranked copy may have headroom NOW
+                                self.shard_busy_stats["failovers"] += 1
+                                TELEMETRY.count_fallback(
+                                    telemetry.SHARD_BUSY_FAILOVER)
+                                try_copy(copy_idx + 1)
+                                return
+                            if len(busy_ras) == len(copies):
+                                round_cb(None, _AllCopiesShed(
+                                    len(copies), min(busy_ras)))
+                            else:
+                                # MIXED round: some copies failed for
+                                # real — the shard's true cause is the
+                                # fault, not overload; retrying/429ing
+                                # would misreport a broken copy as busy
+                                round_cb(None, real_errs[-1])
+                            return
+                        real_errs.append(err)
+                        if copy_idx + 1 < len(copies):
+                            # fail over to the next copy of this shard
+                            try_copy(copy_idx + 1)
+                            return
+                        round_cb(None, err)
+                    self.ts.send_request(node, SEARCH_QUERY, req, cb,
+                                         timeout=60.0)
+                try_copy(0)
+
+            def shard_done(wrapped, err) -> None:
                 if phase_state.get("aborted") or \
                         phase_state.get("budget_expired"):
-                    return   # the phase already completed without us
-                if err is not None:
-                    # a cancelled task must abort the whole search, not
-                    # fail over to replicas (cancellation is not a fault)
-                    if getattr(err, "cause_type", "") == \
-                            "TaskCancelledError" or \
-                            type(err).__name__ == "TaskCancelledError":
-                        phase_state["aborted"] = True
-                        timer = phase_state.pop("_budget_timer", None)
-                        if timer is not None:
-                            timer.cancel()
-                        on_done(None, err)
-                        return
-                    if copy_idx + 1 < len(copies):
-                        # fail over to the next copy of this shard
-                        one(i, target, copy_idx + 1)
-                        return
-                    phase_state["failed"] += 1
-                    phase_state["failures"].append({
-                        "shard": target["shard"], "index": target["index"],
-                        "reason": str(err),
-                        "status": getattr(err, "status", 500)})
+                    return
+                if err is None:
+                    results[i] = wrapped["resp"]
                 else:
-                    target["node"] = node   # fetch goes where query ran
-                    results[i] = resp
+                    entry = {"shard": target["shard"],
+                             "index": target["index"],
+                             "reason": str(err),
+                             "status": getattr(err, "status", 500)}
+                    if isinstance(err, _AllCopiesShed):
+                        # only now — every copy at its bound through the
+                        # final round — does shard_busy surface; the
+                        # Retry-After is the least-loaded copy's own
+                        # drain-rate estimate
+                        self.shard_busy_stats["all_copies_shed"] += 1
+                        entry["status"] = 429
+                        entry["retry_after"] = err.retry_after
+                        entry["copies"] = err.n_copies
+                    phase_state["failed"] += 1
+                    phase_state["failures"].append(entry)
                 resolved[i] = True
                 pending["n"] -= 1
                 if pending["n"] == 0:
                     timer = phase_state.pop("_budget_timer", None)
                     if timer is not None:
                         timer.cancel()
-                    self._merge_and_fetch(t0, targets, results, body, from_,
-                                          size, phase_state, n_total_shards,
-                                          on_done)
+                    self._merge_and_fetch(t0, targets, results, body,
+                                          from_, size, phase_state,
+                                          n_total_shards, on_done)
                 else:
                     # a completion frees a fan-out slot
                     pump = phase_state.get("_dispatch_next")
                     if pump is not None:
                         pump()
-            self.ts.send_request(node, SEARCH_QUERY, req, cb, timeout=60.0)
+
+            deadline = phase_state.get("deadline")
+            budget_left = None if deadline is None else \
+                max(deadline - scheduler.now(), 0.0)
+            timeout = self.SHARD_BUSY_RETRY_TIMEOUT_S \
+                if budget_left is None else \
+                min(budget_left, self.SHARD_BUSY_RETRY_TIMEOUT_S)
+            RetryableAction(
+                scheduler, round_attempt, shard_done,
+                initial_delay=self.SHARD_BUSY_RETRY_INITIAL_S,
+                max_delay=self.SHARD_BUSY_RETRY_MAX_S,
+                timeout=max(timeout, 1e-3),
+                is_retryable=lambda e: isinstance(e, _AllCopiesShed)
+                and rounds["n"] < self.SHARD_BUSY_MAX_ROUNDS).run()
 
         # time budget (request [timeout]): when it expires with shard
         # responses still outstanding, the phase completes NOW with what
@@ -1930,13 +2181,23 @@ class TransportSearchAction:
         # skipped shards count as successful ops (the reference's skipShard
         # calls successfulShardExecution): only fail the request when every
         # NON-skipped shard failed and at least one did
+        # an all-copies-shed 429 carries an HONEST Retry-After: each
+        # failed shard's value is its least-loaded copy's drain-rate
+        # estimate; the request can only be admitted once its slowest
+        # such shard has headroom, hence the max across shards (the REST
+        # layer mints the Retry-After header off the error metadata)
+        busy_meta = {}
+        ras = [f["retry_after"] for f in failures if f.get("retry_after")]
+        if ras:
+            busy_meta["retry_after"] = max(ras)
         if shards["total"] > 0 and shards["successful"] == 0 \
                 and shards["skipped"] == 0 and shards["failed"] > 0:
             statuses = [f.get("status", 500) for f in failures]
             cause_status = max(statuses, default=503)
             reason = failures[0]["reason"] if failures else "all shards failed"
             on_done(None, SearchPhaseExecutionError(
-                f"all shards failed: {reason}", cause_status=cause_status))
+                f"all shards failed: {reason}", cause_status=cause_status,
+                **(busy_meta if cause_status == 429 else {})))
             return
         if phase_state is not None and \
                 not phase_state.get("allow_partial", True) and \
@@ -1944,11 +2205,13 @@ class TransportSearchAction:
             statuses = [f.get("status", 500) for f in failures]
             reason = failures[0]["reason"] if failures \
                 else "search budget expired"
+            cause_status = max(statuses, default=503)
             on_done(None, SearchPhaseExecutionError(
                 f"{shards['failed']} of {shards['total']} shards failed "
                 f"and partial results are disallowed "
                 f"(allow_partial_search_results=false): {reason}",
-                cause_status=max(statuses, default=503)))
+                cause_status=cause_status,
+                **(busy_meta if cause_status == 429 else {})))
             return
         on_done(resp, None)
 

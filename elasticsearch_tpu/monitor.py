@@ -211,14 +211,21 @@ def search_batch_stats(batcher, rrf_fuser=None) -> Dict[str, Any]:
 
 def search_admission_stats(thread_pool, response_collector=None,
                            batcher=None,
-                           ars_stats=None) -> Dict[str, Any]:
+                           ars_stats=None,
+                           failover_stats=None) -> Dict[str, Any]:
     """Overload-control observability (utils/threadpool.py +
     action/response_collector.py + the shard batcher's pressure
     tracker): the search pool's live queue bounds and adaptive-resize
     state, rejections by tenant key, the Retry-After values issued, the
-    node's own self-reported pressure, and the C3 rank inputs per node —
-    everything an operator needs to explain WHY a request was shed or a
-    replica skipped, from the stats surface alone."""
+    node's own self-reported pressure, the shard-side shed point
+    (``shard_queue``: configured + effective member bounds, occupancy,
+    shard_busy sheds, the drain-rate estimate behind Retry-After) and
+    the coordinator's busy-failover counters
+    (``shard_busy_failover``: sheds seen, copy failovers, backed-off
+    retry rounds, all-copies-shed surfaces), and the C3 rank inputs per
+    node — everything an operator needs to explain WHY a request was
+    shed, rerouted, or a replica skipped, from the stats surface
+    alone."""
     if thread_pool is None:
         return {}
     pool = thread_pool.pools.get("search")
@@ -228,6 +235,9 @@ def search_admission_stats(thread_pool, response_collector=None,
     if batcher is not None:
         out["node_pressure"] = batcher.node_pressure.snapshot(
             batcher.queue_depth())
+        out["shard_queue"] = batcher.shard_queue_stats()
+    if failover_stats is not None:
+        out["shard_busy_failover"] = dict(failover_stats)
     # the caller may pass the already-built rank-input map (node stats
     # serves it under adaptive_selection too — compute once per call)
     if ars_stats is None and response_collector is not None:

@@ -322,7 +322,8 @@ class Node:
             "search_admission": lambda: monitor.search_admission_stats(
                 self.thread_pool,
                 batcher=self.search_transport.batcher,
-                ars_stats=ars_stats()),
+                ars_stats=ars_stats(),
+                failover_stats=self.search_action.shard_busy_stats),
             # real probes (OsProbe/ProcessProbe/FsProbe analogs + the
             # device/HBM dimension the reference lacks)
             "os": monitor.os_stats,

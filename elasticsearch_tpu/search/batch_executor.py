@@ -94,11 +94,12 @@ from elasticsearch_tpu.search import dsl, telemetry
 from elasticsearch_tpu.search.phase import ShardDoc, parse_sort, wand_clauses
 from elasticsearch_tpu.search.telemetry import TELEMETRY, SearchTrace
 from elasticsearch_tpu.utils.errors import (
-    SearchBudgetExceededError, TaskCancelledError,
+    SearchBudgetExceededError, ShardBusyError, TaskCancelledError,
 )
 from elasticsearch_tpu.utils.settings import (
     SEARCH_BATCH_ENABLED, SEARCH_BATCH_MAX_SIZE, SEARCH_BATCH_MAX_WINDOW_MS,
-    SEARCH_BATCH_TARGET_OCCUPANCY,
+    SEARCH_BATCH_TARGET_OCCUPANCY, SEARCH_SHARD_MAX_QUEUED_MEMBERS,
+    SEARCH_SHARD_QUEUE_TARGET_LATENCY,
 )
 
 
@@ -684,7 +685,18 @@ class ShardQueryBatcher:
             "max_size_preshrinks": 0,
             # request-cache hits answered AT INTAKE (no collection wait)
             "request_cache_intake_hits": 0,
+            # shard-side shed point (search.shard.max_queued_members):
+            # members rejected AT INTAKE with a typed shard_busy error —
+            # each shed counts here exactly once (and once in the
+            # telemetry fallback taxonomy, never anywhere else)
+            "shard_busy_sheds": 0,
+            # high-water mark of QUEUED (not yet drained) members — the
+            # shed-point correctness witness: with the bound set, no
+            # drain may ever observe more queued members than the bound
+            "queued_members_hwm": 0,
         }
+        # last Retry-After issued by a shard_busy shed (stats surface)
+        self.last_shard_retry_after_s = 0
 
     # -- settings (dynamic, from committed cluster state) ---------------
 
@@ -739,6 +751,85 @@ class ShardQueryBatcher:
         node's search-queue depth in the pressure piggyback."""
         return sum(len(q) for q in self._queues.values())
 
+    # -- shard-side shed point ------------------------------------------
+
+    def shard_queue_limit(self) -> int:
+        """Effective per-node member bound: ``search.shard.max_queued_
+        members`` (0 = unbounded, today's behavior byte-for-byte),
+        SHRUNK by the same Little's-law controller the coordinator pool
+        uses — once NodePressure has a drain-measured service EWMA, the
+        bound that holds admitted shard work to ``search.shard.queue_
+        target_latency`` is drain_rate * target; a node may never hold
+        more members than it can serve inside the latency target."""
+        cap = self._setting(SEARCH_SHARD_MAX_QUEUED_MEMBERS)
+        if cap <= 0:
+            return 0
+        target = self._setting(SEARCH_SHARD_QUEUE_TARGET_LATENCY)
+        rate = self.node_pressure.drain_rate_per_s()
+        if target > 0 and rate > 0:
+            ideal = int(rate * float(target))
+            if ideal < cap:
+                cap = max(1, ideal)
+        return cap
+
+    def member_occupancy(self) -> int:
+        """Queued + in-flight members — what the member bound governs."""
+        return self.queue_depth() + self.node_pressure.in_flight
+
+    def at_member_bound(self) -> bool:
+        """THE one definition of 'this node is over its member bound' —
+        shared by the intake shed point below and the mesh executors'
+        fast-path refusals, so the bound cannot silently diverge between
+        the RPC and mesh serving paths."""
+        limit = self.shard_queue_limit()
+        return limit > 0 and self.member_occupancy() >= limit
+
+    def _shed_check(self, req: Dict[str, Any]) -> None:
+        """THE shard-side shed point: with the member bound set, an
+        arrival that would push queued + in-flight members past it is
+        rejected NOW, with a typed, Retry-After-carrying shard_busy
+        error — it never touches a drain, never registers a task, never
+        acquires a reader. The coordinator fails it over to the next
+        ranked copy (the reference's retry-on-next-replica contract).
+        Limit and occupancy are computed ONCE (at_member_bound's
+        definition inlined) so the shed message reports the exact
+        occupancy that triggered it."""
+        limit = self.shard_queue_limit()
+        if limit <= 0:
+            return
+        occupied = self.member_occupancy()
+        if occupied < limit:
+            return
+        self.stats["shard_busy_sheds"] += 1
+        retry_after = self.node_pressure.retry_after_s(occupied)
+        self.last_shard_retry_after_s = retry_after
+        TELEMETRY.count_fallback(telemetry.SHARD_BUSY)
+        # retry_after=/queued= ride the MESSAGE: transport errors are
+        # stringified on the wire, so the payload must survive in text
+        # (utils/errors.shard_busy_info is the decoder)
+        raise ShardBusyError(
+            f"shard [{req.get('index')}][{req.get('shard')}] busy: "
+            f"{occupied} members in flight (limit {limit}); "
+            f"retry_after={retry_after}s queued={occupied}",
+            retry_after=retry_after, queued=occupied)
+
+    def shard_queue_stats(self) -> Dict[str, Any]:
+        """The ``search_admission.shard_queue`` stats block: the
+        configured and effective member bounds, live occupancy, shed
+        count, the drain-rate estimate Retry-After is computed from, and
+        the queued-members high-water mark."""
+        return {
+            "limit": self._setting(SEARCH_SHARD_MAX_QUEUED_MEMBERS),
+            "effective_limit": self.shard_queue_limit(),
+            "queued": self.queue_depth(),
+            "in_flight": self.node_pressure.in_flight,
+            "sheds": self.stats["shard_busy_sheds"],
+            "queued_members_hwm": self.stats["queued_members_hwm"],
+            "last_retry_after_s": self.last_shard_retry_after_s,
+            "drain_rate_per_s": round(
+                self.node_pressure.drain_rate_per_s(), 3),
+        }
+
     # -- intake ---------------------------------------------------------
 
     def enqueue(self, req: Dict[str, Any],
@@ -750,7 +841,13 @@ class ShardQueryBatcher:
         or the response dict directly for a request-cache hit at intake
         (a cacheable duplicate never waits out a collection window).
         ``search.batch.enabled: false`` forces window 0 through this
-        same path."""
+        same path.
+
+        Raises ShardBusyError when the node is at its member bound
+        (search.shard.max_queued_members): the shed binds BEFORE
+        classification, the request cache, task registration — an
+        overloaded node spends nothing on work it cannot admit."""
+        self._shed_check(req)
         scheduler = self._scheduler()
         try:
             shard = self.sts.indices.shard(req["index"], req["shard"])
@@ -803,6 +900,8 @@ class ShardQueryBatcher:
         key = (req["index"], req["shard"]) + spec.key()
         queue = self._queues.setdefault(key, [])
         queue.append(member)
+        self.stats["queued_members_hwm"] = max(
+            self.stats["queued_members_hwm"], self.queue_depth())
         if len(queue) >= self._key_max_size(key):
             timer = self._timers.pop(key, None)
             if timer is not None:
@@ -1016,7 +1115,7 @@ class ShardQueryBatcher:
         delay = float(self.fault_drain_delay_s or 0.0)
         if delay > 0.0:
             service_ms += delay * 1000.0
-        self.node_pressure.observe(service_ms)
+        self.node_pressure.observe(service_ms, members=len(live))
         if delay > 0.0:
             scheduler.schedule(delay, lambda: self._deliver(live))
         else:

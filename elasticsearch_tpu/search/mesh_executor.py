@@ -120,6 +120,13 @@ class MeshSearchExecutor:
             if not setting_from_state(state, SEARCH_MESH_ENABLED):
                 TELEMETRY.count_fallback(telemetry.MESH_DISABLED)
                 return False
+            # shard-side shed discipline covers the mesh path too: a
+            # node over its member bound refuses the mesh fast path so
+            # the RPC fan-out's enqueue shed + busy-failover machinery
+            # governs — the bound cannot be dodged by being mesh-served
+            if self.sts.batcher.at_member_bound():
+                TELEMETRY.count_fallback(telemetry.MESH_NODE_BUSY)
+                return False
             MESH_PLANES.configure_from_state(state)
             if not MESH_PLANES.available(len(targets)):
                 TELEMETRY.count_fallback(
@@ -211,6 +218,13 @@ class MeshSearchExecutor:
         t_exec = time.monotonic_ns()
         drain_trace = SearchTrace(
             _CLASS_OF_KIND.get(members[0].spec.kind, "other"), "mesh")
+        # mesh drains count into the node's pressure tracker exactly
+        # like batcher drains: in-flight while executing, an observed
+        # (service, occupancy) sample after — so a mesh-serving node's
+        # load is visible in its piggybacks, its shard-queue bound, and
+        # the ARS observations the coordinator synthesizes per target
+        pressure = self.sts.batcher.node_pressure
+        pressure.in_flight += len(members)
         try:
             with telemetry.activate(drain_trace):
                 results = self._execute(key, members)
@@ -223,6 +237,11 @@ class MeshSearchExecutor:
             TELEMETRY.count_fallback(telemetry.MESH_DRAIN_ERROR,
                                      len(members))
             results = None
+        finally:
+            pressure.observe((time.monotonic_ns() - t_exec) / 1e6,
+                             members=len(members))
+            pressure.in_flight = max(0,
+                                     pressure.in_flight - len(members))
         if results is None:
             self.stats["mesh_fallbacks"] += len(members)
             for m in members:

@@ -37,18 +37,45 @@ class NodePressure:
 
     ALPHA = 0.3
 
-    __slots__ = ("in_flight", "service_ewma_ms", "observations")
+    __slots__ = ("in_flight", "service_ewma_ms", "observations",
+                 "occupancy_ewma")
 
     def __init__(self) -> None:
         self.in_flight = 0
         self.service_ewma_ms: Optional[float] = None
         self.observations = 0
+        # members per drain (EWMA): with the service EWMA this yields the
+        # node's drain RATE in members/second — what the shard-side shed
+        # point's Little's-law bound and its Retry-After estimates run on
+        self.occupancy_ewma: Optional[float] = None
 
-    def observe(self, service_ms: float) -> None:
+    def observe(self, service_ms: float, members: int = 1) -> None:
         s = max(float(service_ms), 0.0)
         self.service_ewma_ms = s if self.service_ewma_ms is None else \
             self.ALPHA * s + (1 - self.ALPHA) * self.service_ewma_ms
+        m = max(float(members), 1.0)
+        self.occupancy_ewma = m if self.occupancy_ewma is None else \
+            self.ALPHA * m + (1 - self.ALPHA) * self.occupancy_ewma
         self.observations += 1
+
+    def drain_rate_per_s(self) -> float:
+        """Drain-measured throughput estimate: members served per second
+        (occupancy EWMA over service-time EWMA). 0.0 until the first
+        drain has been observed."""
+        if self.service_ewma_ms is None:
+            return 0.0
+        return (self.occupancy_ewma or 1.0) / \
+            (max(self.service_ewma_ms, 1e-3) / 1000.0)
+
+    def retry_after_s(self, backlog: int) -> int:
+        """Honest shed backoff: seconds until ``backlog`` members ahead
+        of a retry would drain at the measured rate (1s floor, 60s cap —
+        the coordinator pool's Retry-After clamp). Cold node: 1s."""
+        import math
+        rate = self.drain_rate_per_s()
+        if rate <= 0.0:
+            return 1
+        return max(1, min(60, int(math.ceil((backlog + 1) / rate))))
 
     def snapshot(self, queue_depth: int) -> Dict[str, Any]:
         """The piggyback payload: current queue depth is the caller's

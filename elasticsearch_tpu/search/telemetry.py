@@ -93,6 +93,17 @@ BATCH_IVF_NPROBE_DISAGREEMENT = "batch_ivf_nprobe_disagreement"
 BATCH_BREAKER_REFUSED = "batch_breaker_refused"
 BATCH_EXEC_ERROR = "batch_exec_error"
 
+# shard-side shed point + coordinator busy-failover loop: a data node at
+# its search.shard.max_queued_members bound sheds a query AT INTAKE
+# (shard_busy, counted on the shedding node); the coordinator treats the
+# typed rejection as a ROUTING signal and fails over to the next
+# C3-ranked copy (shard_busy_failover, counted on the coordinator); a
+# node over its member bound refuses the mesh fast path so the RPC
+# fan-out's shed + failover machinery governs (mesh_node_busy)
+SHARD_BUSY = "shard_busy"
+SHARD_BUSY_FAILOVER = "shard_busy_failover"
+MESH_NODE_BUSY = "mesh_node_busy"
+
 UNKNOWN = "unknown"
 
 KNOWN_REASONS = frozenset(

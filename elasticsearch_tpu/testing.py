@@ -336,3 +336,399 @@ class InProcessCluster:
 
     def heal(self) -> None:
         self.transport.heal()
+
+
+# ---------------------------------------------------------------------------
+# fleet-scale overload traffic harness (ROADMAP item 6)
+# ---------------------------------------------------------------------------
+
+def _p99(lats: List[float]) -> float:
+    """Nearest-rank p99 — the one percentile formula the harness summary
+    and the scenario's unloaded baseline share."""
+    if not lats:
+        return 0.0
+    data = sorted(lats)
+    return data[int(0.99 * (len(data) - 1))]
+
+
+class FleetTrafficHarness:
+    """Multi-coordinator, multi-tenant traffic over an InProcessCluster —
+    the closest thing to "millions of users" a test process can express,
+    in fully deterministic virtual time:
+
+    - **diurnal load curve**: arrivals follow a seeded nonhomogeneous
+      Poisson process (Lewis-Shedler thinning) whose rate traces
+      ``0.35 + 0.65·sin²(π·t/period)`` — two troughs, two peaks per run;
+    - **zipfian tenants**: each arrival picks its tenant (index) with
+      1/rank weights, so a hot head and a long tail coexist — and a
+      configured hot tenant gets a 10:1 flood multiplier inside the peak
+      window (the overload plane's canonical adversary);
+    - **multi-coordinator**: each arrival enters through a seeded choice
+      of coordinator node, so every coordinator's admission pool, ARS
+      view, and busy-failover loop is exercised against the SAME data
+      nodes — the N-coordinators × M-tenants fan-in no single
+      coordinator-side bound can see;
+    - **chaos events**: arbitrary ``(t, fn)`` callbacks scheduled into
+      the run (rolling restarts via crash/restart, slow nodes via
+      ``slow_node_drains``, bounds via settings, ...).
+
+    Every request is recorded (tenant, coordinator, latency, outcome);
+    ``summary()`` reduces the record stream to the fleet invariants the
+    chaos suite and bench assert: bounded admitted p99, clean 429s with
+    honest Retry-After, zero starved tenants, shed/failover accounting.
+    """
+
+    def __init__(self, cluster: InProcessCluster,
+                 tenants: List[str], coordinators: List[str],
+                 seed: int = 0):
+        self.c = cluster
+        self.tenants = list(tenants)
+        self.coordinators = list(coordinators)
+        self.random = _random.Random(seed ^ 0xF1EE7)
+        self.records: List[Dict[str, Any]] = []
+        self._expected = {"n": 0}
+
+    # -- traffic ---------------------------------------------------------
+
+    def _arrivals(self, duration_s: float, total: int,
+                  hot_tenant: Optional[str], hot_window: Tuple[float, float],
+                  hot_factor: float) -> List[Tuple[float, str, str]]:
+        """The seeded arrival plan: (t, tenant, coordinator) tuples.
+        Lewis-Shedler thinning against the diurnal shape; zipfian tenant
+        choice with the hot multiplier inside the window; plus a floor
+        of three scheduled arrivals per tenant so starvation is always
+        measurable (a tenant that never arrived cannot be starved)."""
+        import math
+        period = duration_s / 2.0
+
+        def shape(t: float) -> float:
+            return 0.35 + 0.65 * math.sin(math.pi * t / period) ** 2
+
+        # mean of shape over the run is 0.675: pick λ_max to land near
+        # `total` accepted arrivals
+        lam_max = total / (0.675 * duration_s)
+        weights = [1.0 / (rank + 1) for rank in range(len(self.tenants))]
+        plan: List[Tuple[float, str, str]] = []
+        t = 0.0
+        while len(plan) < total:
+            t += self.random.expovariate(lam_max)
+            if t >= duration_s:
+                break
+            if self.random.random() > shape(t):
+                continue
+            w = list(weights)
+            if hot_tenant in self.tenants and \
+                    hot_window[0] <= t <= hot_window[1]:
+                w[self.tenants.index(hot_tenant)] *= hot_factor
+            tenant = self.random.choices(self.tenants, weights=w)[0]
+            coord = self.random.choice(self.coordinators)
+            plan.append((t, tenant, coord))
+        # starvation floor: every tenant arrives at least 3 times, spread
+        # through the run (outside nothing — they compete like anyone)
+        for tenant in self.tenants:
+            for frac in (0.2, 0.55, 0.85):
+                coord = self.random.choice(self.coordinators)
+                plan.append((duration_s * frac, tenant, coord))
+        plan.sort(key=lambda e: e[0])
+        return plan
+
+    def submit_one(self, tenant: str, coord: str, body: Dict[str, Any]
+                   ) -> None:
+        sched = self.c.scheduler
+        record = {"tenant": tenant, "coord": coord, "t0": sched.now(),
+                  "t1": None, "err": None}
+        self.records.append(record)
+
+        def done(resp, err=None):
+            record["t1"] = sched.now()
+            record["err"] = err
+            record["resp"] = resp
+        self.c.nodes[coord].client.search(tenant, body, done)
+
+    def run(self, duration_s: float, total: int, *,
+            hot_tenant: Optional[str] = None,
+            hot_window: Optional[Tuple[float, float]] = None,
+            hot_factor: float = 10.0,
+            events: Optional[List[Tuple[float, Callable[[], None]]]] = None,
+            body_fn: Optional[Callable[[str], Dict[str, Any]]] = None,
+            max_time: float = 3600.0) -> None:
+        """Schedule the whole plan plus chaos events, then drive virtual
+        time until every submitted search has resolved."""
+        sched = self.c.scheduler
+        hot_window = hot_window or (0.45 * duration_s, 0.7 * duration_s)
+        plan = self._arrivals(duration_s, total, hot_tenant, hot_window,
+                              hot_factor)
+        self._expected["n"] += len(plan)
+
+        def make_body(tenant: str) -> Dict[str, Any]:
+            if body_fn is not None:
+                return body_fn(tenant)
+            return {"query": {"match": {
+                "body": f"common w{self.random.randrange(8)}"}},
+                "size": 5}
+
+        for t, tenant, coord in plan:
+            sched.schedule(t, lambda tn=tenant, co=coord:
+                           self.submit_one(tn, co, make_body(tn)))
+        for t, fn in (events or []):
+            sched.schedule(t, fn)
+        self.c.run_until(
+            lambda: len(self.records) >= self._expected["n"] and
+            all(r["t1"] is not None for r in self.records), max_time)
+
+    # -- reduction -------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        from elasticsearch_tpu.utils.errors import shard_busy_info
+
+        admitted = [r for r in self.records if r["err"] is None]
+        rejected = [r for r in self.records if r["err"] is not None]
+        clean = []
+        busy_failures = 0
+        for r in rejected:
+            err = r["err"]
+            status = getattr(err, "status", 500)
+            meta = getattr(err, "metadata", None) or {}
+            if status == 429 and int(meta.get("retry_after", 0)) >= 1:
+                clean.append(r)
+            if shard_busy_info(err) is not None or \
+                    "shard_busy" in str(err):
+                busy_failures += 1
+        goodput: Dict[str, int] = {t: 0 for t in self.tenants}
+        for r in admitted:
+            goodput[r["tenant"]] = goodput.get(r["tenant"], 0) + 1
+        partial = sum(
+            1 for r in admitted
+            if (r.get("resp") or {}).get("_shards", {}).get("failed", 0))
+        return {
+            "offered": len(self.records),
+            "admitted": len(admitted),
+            "admitted_p99_s": _p99([r["t1"] - r["t0"]
+                                    for r in admitted]),
+            "rejected": len(rejected),
+            "rejected_clean_429": len(clean),
+            "unclean_rejections": len(rejected) - len(clean),
+            "request_busy_failures": busy_failures,
+            "partial_responses": partial,
+            "goodput_by_tenant": goodput,
+            "starved_tenants": [t for t, n in goodput.items() if n == 0],
+        }
+
+
+def fleet_overload_scenario(seed: int, *, n_tenants: int = 4,
+                            n_nodes: int = 6, docs: int = 10,
+                            total_searches: int = 260,
+                            duration_s: float = 1.2,
+                            shard_bound: int = 2,
+                            slow_delay_s: float = 0.08,
+                            admission: Tuple[int, int] = (3, 10)
+                            ) -> Dict[str, Any]:
+    """THE million-user chaos scenario (ROADMAP item 6), one seed: a
+    10:1 hot-tenant flood across 3 coordinators and ``n_tenants``
+    zipfian tenants on a diurnal curve, with one slow data node from
+    before the flood and a rolling restart mid-peak — against the full
+    two-sided overload plane (coordinator admission + per-tenant fair
+    shedding, shard-side ``search.shard.max_queued_members`` shed point,
+    typed shard_busy failover, C3 ARS fed by pressure piggybacks AND
+    busy rejections).
+
+    Returns the measured invariants; the chaos suite asserts them green
+    on every seed, bench.py emits them as the ``fleet`` config line."""
+    from elasticsearch_tpu.search.telemetry import TELEMETRY
+
+    c = InProcessCluster(n_nodes=n_nodes, seed=seed)
+    c.start()
+    try:
+        import numpy as np
+        tenants = [f"t{i}" for i in range(n_tenants)]
+        coordinators = [f"node{i}" for i in range(min(3, n_nodes))]
+        client = c.client()
+        rng = np.random.default_rng(seed)
+        box: List[Any] = []
+
+        def wait(n: int) -> None:
+            c.run_until(lambda: len(box) >= n, 300.0)
+
+        expected: Dict[str, int] = {}
+        for tenant in tenants:
+            n0 = len(box)
+            client.create_index(tenant, {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 1},
+                "mappings": {"properties": {"body": {"type": "text"}}}},
+                lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+            c.ensure_green(tenant)
+            for i in range(docs):
+                n0 = len(box)
+                client.index_doc(
+                    tenant, f"d{i}",
+                    {"body": "common " + " ".join(
+                        f"w{int(x)}" for x in rng.integers(0, 8, 4))},
+                    lambda r, e=None: box.append(1))
+                wait(n0 + 1)
+            n0 = len(box)
+            client.refresh(tenant, lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+            expected[tenant] = docs     # every doc carries "common"
+
+        # the two-sided overload plane: coordinator admission pinned
+        # tiny (saturation at test scale) + the shard-side member bound
+        c.constrain_search_admission(*admission)
+        n0 = len(box)
+        client.cluster_update_settings(
+            {"persistent":
+             {"search.shard.max_queued_members": shard_bound}},
+            lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+
+        # victim: a holder of the HOT tenant's shard copies — slow for
+        # the whole run (the ARS routing-around story). Its sibling
+        # copy-holder gets slowed too for the first half of the hot
+        # window (a noisy-neighbor wave): with BOTH copies slow under a
+        # 10:1 flood, the shard-side member bound genuinely engages and
+        # the shed -> failover -> backoff-retry loop is exercised, not
+        # just reachable. Slowing drains is data-plane only, so master
+        # or coordinator victims are fine; CRASH targets must be
+        # non-master (membership stays stable) and non-coordinator (a
+        # crashed coordinator strands its own in-flight responses on the
+        # 60s transport timeout — a different scenario's problem).
+        master_id = c.master().node_id
+        state = c.nodes[coordinators[0]].coordinator.applied_state
+        holders = [sr.node_id for sr in
+                   state.routing_table.index(tenants[0]).shard_group(0)
+                   if sr.node_id is not None]
+        victim = holders[-1]
+        hot_sibling = next((h for h in holders if h != victim), None)
+        restartable = [nid for nid in c.nodes
+                       if nid != master_id and nid != victim and
+                       nid not in coordinators][:2]
+        c.slow_node_drains(victim, slow_delay_s)
+
+        harness = FleetTrafficHarness(c, tenants, coordinators, seed)
+
+        # unloaded p99: sequential traffic against the SAME cluster,
+        # slow node already slow — the bound the flood is judged by
+        for k in range(3 * n_tenants):
+            harness.submit_one(tenants[k % n_tenants],
+                               coordinators[k % len(coordinators)],
+                               {"query": {"match": {"body": "common"}},
+                                "size": 5})
+            c.run_until(
+                lambda: all(r["t1"] is not None for r in harness.records),
+                300.0)
+        unloaded_p99 = _p99([r["t1"] - r["t0"] for r in harness.records
+                             if r["err"] is None])
+        harness.records.clear()
+        harness._expected["n"] = 0
+
+        # per-(node, shard-copy) query counts before the flood: the ARS
+        # routing-verdict baseline
+        def copy_hits() -> Dict[Tuple[str, str], int]:
+            out: Dict[Tuple[str, str], int] = {}
+            for nid, node in c.nodes.items():
+                for tenant in tenants:
+                    if node.indices_service.has_shard(tenant, 0):
+                        out[(nid, tenant)] = node.indices_service.shard(
+                            tenant, 0).search_stats["query_total"]
+            return out
+
+        hits_before = copy_hits()
+        fallbacks_before = dict(TELEMETRY.fallbacks)
+
+        # rolling restart mid-peak: each restartable node vanishes from
+        # the wire for a slice of the hot window, one after another —
+        # and the hot tenant's SIBLING copy is slow for the window's
+        # first half, so the flood meets two saturated copies at once
+        # the hot window sits ON the second diurnal peak (shape() peaks
+        # at 3·duration/4): the 10:1 flood, the rolling restart and the
+        # noisy-neighbor wave all land where traffic is already densest
+        events: List[Tuple[float, Callable[[], None]]] = []
+        win0, win1 = 0.62 * duration_s, 0.9 * duration_s
+        if hot_sibling is not None:
+            events.append((win0, lambda: c.slow_node_drains(
+                hot_sibling, slow_delay_s * 0.6)))
+            events.append((win0 + 0.5 * (win1 - win0),
+                           lambda: c.slow_node_drains(hot_sibling, 0.0)))
+        slot = (win1 - win0) / max(len(restartable), 1) / 2.0
+        for k, nid in enumerate(restartable):
+            t_down = win0 + (2 * k) * slot
+            t_up = t_down + slot
+            events.append((t_down, lambda n=nid: c.crash_node(n)))
+            events.append((t_up, lambda n=nid: c.restart_node(n)))
+
+        harness.run(duration_s, total_searches, hot_tenant=tenants[0],
+                    hot_window=(win0, win1), hot_factor=10.0,
+                    events=events)
+        summary = harness.summary()
+        c.heal()
+        c.slow_node_drains(victim, 0.0)
+
+        # correctness probes (zero wrong hits): after the storm, every
+        # tenant still answers the known-answer query exactly
+        wrong_hits = 0
+        for tenant in tenants:
+            probe: List[Any] = []
+            client.search(tenant, {
+                "query": {"match": {"body": "common"}},
+                "size": docs, "track_total_hits": True},
+                lambda r, e=None: probe.append((r, e)))
+            c.run_until(lambda: bool(probe), 300.0)
+            resp, err = probe[0]
+            if err is not None:
+                wrong_hits += 1
+                continue
+            got = {h["_id"] for h in resp["hits"]["hits"]}
+            want = {f"d{i}" for i in range(docs)}
+            if got != want or \
+                    resp["hits"]["total"]["value"] != expected[tenant]:
+                wrong_hits += 1
+
+        # shed / failover / routing accounting across the fleet
+        hits_after = copy_hits()
+        victim_hits = sum(n - hits_before.get(k, 0)
+                          for k, n in hits_after.items()
+                          if k[0] == victim)
+        sibling_hits = sum(n - hits_before.get(k, 0)
+                           for k, n in hits_after.items()
+                           if k[0] != victim and
+                           (victim, k[1]) in hits_after)
+        sheds = sum(n.search_transport.batcher.stats["shard_busy_sheds"]
+                    for n in c.nodes.values())
+        hwm_over_bound = [
+            (nid, n.search_transport.batcher.stats["queued_members_hwm"])
+            for nid, n in c.nodes.items()
+            if n.search_transport.batcher.stats["queued_members_hwm"]
+            > shard_bound]
+        failover = {k: sum(n.search_action.shard_busy_stats[k]
+                           for n in c.nodes.values())
+                    for k in ("sheds_seen", "failovers", "retry_rounds",
+                              "all_copies_shed")}
+        fallbacks_after = dict(TELEMETRY.fallbacks)
+        fallback_deltas = {
+            k: fallbacks_after.get(k, 0) - fallbacks_before.get(k, 0)
+            for k in set(fallbacks_after) | set(fallbacks_before)
+            if fallbacks_after.get(k, 0) != fallbacks_before.get(k, 0)}
+
+        summary.update({
+            "seed": seed,
+            "tenants": n_tenants,
+            "coordinators": len(coordinators),
+            "victim": victim,
+            "shard_bound": shard_bound,
+            "unloaded_p99_s": unloaded_p99,
+            "p99_factor_vs_unloaded": round(
+                summary["admitted_p99_s"] / max(unloaded_p99, 1e-9), 2),
+            "wrong_hits": wrong_hits,
+            "shard_busy_sheds": sheds,
+            "queued_hwm_over_bound": hwm_over_bound,
+            "failover": failover,
+            "victim_copy_hits": victim_hits,
+            "sibling_copy_hits": sibling_hits,
+            "fallback_deltas": fallback_deltas,
+            "unknown_fallbacks": fallbacks_after.get("unknown", 0)
+            - fallbacks_before.get("unknown", 0),
+        })
+        return summary
+    finally:
+        c.stop()

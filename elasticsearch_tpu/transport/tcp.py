@@ -450,10 +450,24 @@ class TcpTransport:
         if cb is not None:
             cb(msg, conn)
 
+    def _peer_for(self, node_id: str) -> Optional[_Peer]:
+        addr = self.address_book.get(node_id)
+        if addr is None:
+            return None
+        with self._lock:
+            if self._closed:
+                return None
+            peer = self._peers.get(node_id)
+            if peer is None:
+                peer = self._peers[node_id] = _Peer(
+                    self.node_id, tuple(addr), self.scheduler.submit,
+                    ssl_context=self._client_ssl_context(),
+                    on_message=self._peer_message)
+        return peer
+
     def send(self, node_id: str, msg: Dict[str, Any],
              on_fail: Optional[Callable[[], None]] = None) -> None:
-        addr = self.address_book.get(node_id)
-        if addr is None or self._closed:
+        if self._closed:
             if on_fail is not None:
                 self.scheduler.submit(on_fail)
             return
@@ -463,21 +477,31 @@ class TcpTransport:
             if on_fail is not None:
                 self.scheduler.submit(on_fail)
             return
-        with self._lock:
-            if self._closed:
-                peer = None
-            else:
-                peer = self._peers.get(node_id)
-                if peer is None:
-                    peer = self._peers[node_id] = _Peer(
-                        self.node_id, tuple(addr), self.scheduler.submit,
-                        ssl_context=self._client_ssl_context(),
-                        on_message=self._peer_message)
+        peer = self._peer_for(node_id)
         if peer is None:
             if on_fail is not None:
                 self.scheduler.submit(on_fail)
             return
         peer.send(frame, on_fail)
+
+    def send_truncated(self, node_id: str, msg: Dict[str, Any]) -> None:
+        """Chaos (TcpDisruption ``partial_frame``): write the length
+        header and roughly half the body onto the REAL socket, then
+        stall — the peer's reader blocks mid-frame in _recv_exact, and
+        any later bytes on this connection are consumed as the missing
+        body, desyncing the framing until the connection resets. The
+        closest a test harness gets to a wedged middlebox / a sender
+        that died mid-write."""
+        try:
+            frame = _encode_frame(msg)
+        except Exception:  # noqa: BLE001 — unserializable payload: the
+            return         # fault already "ate" the message
+        body_len = len(frame) - _LEN.size
+        cut = _LEN.size + max(1, body_len // 2) if body_len > 1 \
+            else _LEN.size
+        peer = self._peer_for(node_id)
+        if peer is not None:
+            peer.send(frame[:cut], None)
 
 
 class TcpTransportService:
@@ -560,12 +584,22 @@ class TcpTransportService:
 
         # chaos rules (TcpDisruption parity with the in-memory wire):
         # drop = blackhole (only the timeout resolves); disconnect =
-        # refused fast; delay/jitter = scheduled late send
+        # refused fast; delay/jitter = scheduled late send. Below the
+        # framed seam: half_open frames really cross the socket but the
+        # peer never reads them (the receive side swallows unprocessed);
+        # partial_frame writes a TRUNCATED frame that wedges the peer's
+        # reader mid-frame and desyncs the connection's framing
         disruption = self.transport.disruption
         rule = disruption.rule(self.node_id, node_id) \
             if disruption is not None else None
         if rule is not None:
             if rule.drop:
+                return
+            if rule.partial_frame:
+                self.transport.send_truncated(
+                    node_id,
+                    {"t": "req", "id": req_id, "action": action,
+                     "sender": self.node_id, "body": request})
                 return
             if rule.disconnect:
                 self.transport.scheduler.submit(
@@ -597,6 +631,16 @@ class TcpTransportService:
 
     def _handle_request(self, msg: Dict[str, Any],
                         local_finish=None, reply_conn=None) -> None:
+        # half-open chaos (TcpDisruption): the sender's frame genuinely
+        # crossed the socket, but this endpoint "stopped reading" — the
+        # request is swallowed unprocessed, no reply, no FIN; only the
+        # sender's timeout resolves. Local short-circuits are exempt
+        # (loopback has no connection to half-open).
+        if local_finish is None and self.transport.disruption is not None:
+            rule = self.transport.disruption.rule(
+                msg.get("sender", "?"), self.node_id)
+            if rule is not None and rule.half_open:
+                return
         self.stats["received"] += 1
         req_id = msg["id"]
         action = msg["action"]
@@ -623,8 +667,13 @@ class TcpTransportService:
             rule = disruption.rule(self.node_id, sender) \
                 if disruption is not None else None
             if rule is not None:
-                if rule.drop or rule.disconnect:
+                if rule.drop or rule.disconnect or rule.half_open:
                     return   # response lost: requester's timeout resolves
+                if rule.partial_frame:
+                    # header + half the body, then silence: the
+                    # requester's reader wedges mid-frame
+                    self.transport.send_truncated(sender, payload)
+                    return
                 if rule.delay or rule.jitter:
                     self.transport.scheduler.schedule(
                         disruption.latency(rule), deliver)

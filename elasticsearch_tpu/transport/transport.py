@@ -103,11 +103,24 @@ class _Rule:
     the sender fails fast with NodeNotConnectedError (connection refused),
     the retryable flavor real networks produce when a process is down.
     delay/jitter: fixed plus uniformly-random extra latency per message.
+
+    Below the framed-request seam (TCP semantics; in-memory parity rule:
+    both behave as drop — the send SUCCEEDS, nothing is processed, no
+    error ever arrives, only the sender's timeout resolves):
+
+    half_open: the peer stops reading but never FINs — frames vanish
+    into its never-drained socket buffer. partial_frame: the length
+    header (and part of the body) is delivered, then the body stalls
+    mid-frame — over TCP the receiver's reader genuinely blocks inside
+    one frame and later bytes on that connection desync the protocol
+    until the connection resets.
     """
     drop: bool = False
     disconnect: bool = False
     delay: float = 0.0
     jitter: float = 0.0
+    half_open: bool = False
+    partial_frame: bool = False
 
 
 class DisruptionRules:
@@ -121,9 +134,12 @@ class DisruptionRules:
 
     def add_rule(self, sender: str, receiver: str,
                  drop: bool = False, delay: float = 0.0,
-                 jitter: float = 0.0, disconnect: bool = False) -> None:
+                 jitter: float = 0.0, disconnect: bool = False,
+                 half_open: bool = False,
+                 partial_frame: bool = False) -> None:
         self._rules[(sender, receiver)] = _Rule(
-            drop=drop, disconnect=disconnect, delay=delay, jitter=jitter)
+            drop=drop, disconnect=disconnect, delay=delay, jitter=jitter,
+            half_open=half_open, partial_frame=partial_frame)
 
     def clear_rules(self) -> None:
         self._rules.clear()
@@ -213,8 +229,12 @@ class InMemoryTransport(DisruptionRules):
                 fn: Callable[["TransportService"], None],
                 on_undeliverable: Callable[[], None]) -> None:
         rule = self._rule(sender, receiver)
-        if rule is not None and rule.drop:
-            return  # silently dropped: timeout handles it, like a real network
+        if rule is not None and (rule.drop or rule.half_open or
+                                 rule.partial_frame):
+            # drop, AND the in-memory parity of the below-the-seam TCP
+            # faults: the send succeeded as far as the sender can tell,
+            # nothing is ever processed, only the timeout resolves
+            return
         if rule is not None and rule.disconnect:
             # connection refused: resolves the sender promptly (and off the
             # current stack, preserving async callback discipline)

@@ -113,6 +113,46 @@ class RejectedExecutionError(SearchEngineError):
     status = 429
 
 
+class ShardBusyError(SearchEngineError):
+    """Data-node shard query queue at its member bound: the query was shed
+    AT INTAKE (it never touched a drain). The coordinator treats this as a
+    ROUTING signal — fail over to the next ranked copy — not a failure;
+    only an all-copies-shed shard surfaces it to the caller.
+
+    Reference analog: es_rejected_execution_exception from the SEARCH
+    threadpool's bounded queue, which the coordinator retries on the next
+    replica (AbstractSearchAsyncAction.onShardFailure + the reference's
+    "reads go to any replica, all APIs reroute" contract).
+
+    The message carries machine-parseable ``retry_after=<s>s`` and
+    ``queued=<n>`` suffixes because transport Deferred rejections and
+    remote-handler errors are STRINGIFIED on the wire (PR 9 invariant) —
+    metadata does not survive; the coordinator re-parses it with
+    ``shard_busy_info``."""
+
+    status = 429
+
+
+def shard_busy_info(err: Any) -> Optional[Dict[str, int]]:
+    """Parse a (possibly wire-stringified) shard_busy rejection out of any
+    error: returns {"retry_after": s, "queued": n} or None. Works on a
+    local ShardBusyError, a RemoteTransportError wrapping one, and the
+    bare cause string — the one decoder every failover site shares."""
+    if err is None:
+        return None
+    name = type(err).__name__
+    text = str(err)
+    if name != "ShardBusyError" and \
+            getattr(err, "cause_type", "") != "ShardBusyError" and \
+            "ShardBusyError" not in text:
+        return None
+    import re
+    ra = re.search(r"retry_after=(\d+)s", text)
+    q = re.search(r"queued=(\d+)", text)
+    return {"retry_after": int(ra.group(1)) if ra else 1,
+            "queued": int(q.group(1)) if q else 0}
+
+
 class SearchPhaseExecutionError(SearchEngineError):
     """Every shard of a search failed — the whole request fails with the
     underlying cause's status (a request-wide 429 when breakers tripped

@@ -458,6 +458,27 @@ SEARCH_ADMISSION_FRAME: Setting[int] = Setting.int_setting(
     "search.admission.frame", 100, min_value=1,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
+# Shard-side shed point (search/batch_executor.py ShardQueryBatcher):
+# a data node receiving fan-outs from MANY coordinators bounds its own
+# queued + in-flight member count and sheds the overflow AT INTAKE with
+# a typed, Retry-After-carrying shard_busy rejection the coordinator
+# fails over to the next ranked copy. 0 = unbounded — today's behavior,
+# byte-for-byte (the reference's SEARCH threadpool queue bound ->
+# es_rejected_execution_exception -> retry-on-next-replica contract).
+SEARCH_SHARD_MAX_QUEUED_MEMBERS: Setting[int] = Setting.int_setting(
+    "search.shard.max_queued_members", 0, min_value=0,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# Little's-law sizing for the shard shed point (the coordinator pool's
+# queue-resizing controller applied node-side): the EFFECTIVE bound is
+# min(max_queued_members, drain_rate * target_latency) once NodePressure
+# has a drain-measured service EWMA — so past saturation the member
+# queue bounds the LATENCY of admitted shard work, not an arbitrary
+# count. 0 disables the shrink (the static bound alone applies).
+SEARCH_SHARD_QUEUE_TARGET_LATENCY: Setting[float] = Setting.time_setting(
+    "search.shard.queue_target_latency", "1s",
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
 # C3 adaptive replica selection (OperationRouting.USE_ADAPTIVE_REPLICA_
 # SELECTION_SETTING analog): false restores pure round-robin rotation
 # of shard copies — the chaos suite's baseline for the reroute proof.

@@ -186,3 +186,98 @@ def test_failover_scenario_over_real_sockets(tcp_cluster):
     assert resp["hits"]["total"]["value"] == 12
     assert {h["_id"] for h in resp["hits"]["hits"]} == \
         {f"d{i}" for i in range(12)}
+
+
+def test_below_seam_faults_and_shard_busy_failover_over_tcp(tcp_cluster):
+    """Below the framed-request seam, over REAL sockets: a half-open
+    connection (the peer stops reading — frames genuinely cross the
+    socket and rot in its buffer, no FIN) and a partial frame (length
+    header + half the body, then silence — the receiver's reader
+    blocks MID-FRAME and later bytes desync the framing until the
+    connection resets). The [timeout] budget machinery bounds both, and
+    the shard_busy failover machinery (member bound + typed shed +
+    next-copy retry) survives them and loses nothing with a live
+    sibling copy."""
+    nodes, disruption = tcp_cluster
+    _wait(lambda: _master(nodes) is not None and
+          len(_master(nodes).coordinator.applied_state.nodes) == 3,
+          90, "3-node TCP cluster formation")
+
+    client = nodes["node0"].client
+    _ok(_call(lambda cb: client.create_index("r", {
+        "settings": {"number_of_shards": 1,
+                     "number_of_replicas": 2}}, cb)))
+    _wait(lambda: client.cluster_health("r")["status"] == "green",
+          60, "index green")
+    for i in range(10):
+        _ok(_call(lambda cb, i=i: client.index_doc(
+            "r", f"d{i}", {"title": f"hello world {i}"}, cb)))
+    _ok(_call(lambda cb: client.refresh("r", cb)))
+
+    master_id = _master(nodes).node_id
+    coord, victim = [nid for nid in nodes if nid != master_id][:2]
+    body = {"query": {"match": {"title": "hello"}}, "size": 20,
+            "timeout": "2s", "track_total_hits": True}
+
+    def bounded_search():
+        t0 = time.monotonic()
+        resp, err = _call(lambda cb: nodes[coord].client.search(
+            "r", dict(body), cb), timeout=30.0)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 15.0, elapsed   # budget-bounded, never the
+        return resp, err                 # 60s transport timeout
+
+    def assert_recovered():
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            resp, err = _call(lambda cb: nodes[coord].client.search(
+                "r", dict(body), cb), timeout=30.0)
+            if err is None and resp["_shards"]["failed"] == 0 and \
+                    resp["hits"]["total"]["value"] == 10:
+                return
+            time.sleep(0.2)
+        raise AssertionError("never recovered full results after heal")
+
+    for fault in ("half_open", "partial_frame"):
+        disruption.clear_rules()
+        disruption.add_rule(coord, victim, **{fault: True})
+        resp, err = bounded_search()
+        # by copy rotation: full results off a healthy copy, a typed
+        # partial, or a typed budget failure — never a hang, never an
+        # unframed crash
+        if err is not None:
+            assert "budget expired" in str(err) or \
+                "not connected" in str(err), (fault, err)
+        elif resp["_shards"]["failed"]:
+            assert resp["timed_out"] is True, fault
+        else:
+            assert resp["hits"]["total"]["value"] == 10, fault
+        disruption.clear_rules()
+        assert_recovered()
+
+    # shard_busy failover over the real wire: the victim at its member
+    # bound sheds typed; every search still succeeds off a sibling copy
+    _ok(_call(lambda cb: nodes[coord].client.cluster_update_settings(
+        {"persistent": {"search.shard.max_queued_members": 1}}, cb)))
+    victim_batcher = nodes[victim].search_transport.batcher
+    _wait(lambda: victim_batcher.shard_queue_limit() == 1,
+          30, "member bound applied on the victim")
+    # forget the fault phases' EWMAs: rotation must be able to rank the
+    # victim first again so the shed path is actually exercised
+    nodes[coord].search_action.response_collector._nodes.clear()
+    victim_batcher.node_pressure.in_flight = 3    # a flood's busy state
+    try:
+        for _ in range(6):
+            resp = _ok(_call(lambda cb: nodes[coord].client.search(
+                "r", {"query": {"match": {"title": "hello"}},
+                      "size": 20, "track_total_hits": True}, cb),
+                timeout=30.0))
+            assert resp["_shards"]["failed"] == 0
+            assert resp["hits"]["total"]["value"] == 10
+        # rotation put the busy copy first at least once: it shed, the
+        # failover found a live sibling, nothing was lost
+        assert victim_batcher.stats["shard_busy_sheds"] >= 1
+        assert nodes[coord].search_action \
+            .shard_busy_stats["failovers"] >= 1
+    finally:
+        victim_batcher.node_pressure.in_flight = 0

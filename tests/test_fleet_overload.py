@@ -1,0 +1,506 @@
+"""Fleet-wide overload loop: shard-side shed points, typed busy-failover,
+and the million-user chaos harness (ROADMAP item 6).
+
+Two-sided shed contract under test:
+
+- **shard side** (search/batch_executor.py): ``search.shard.max_queued_
+  members`` bounds a data node's queued + in-flight member count;
+  overflow is shed AT INTAKE with a typed, Retry-After-carrying
+  ``shard_busy`` error that never touches a drain. Unset = today's
+  unbounded behavior, byte-for-byte.
+- **coordinator side** (action/search_action.py): a ``shard_busy``
+  rejection is a ROUTING signal — fail over to the next C3-ranked copy,
+  feed the busy node's backlog into ARS so its rank sinks immediately,
+  back off with equal jitter (RetryableAction) when a whole round shed —
+  and only an all-copies-shed shard surfaces a 429 whose Retry-After is
+  the least-loaded copy's own drain-rate estimate.
+- **the fleet scenario** (elasticsearch_tpu/testing.py
+  fleet_overload_scenario): 3 coordinators x zipfian tenants on a
+  diurnal curve, a 10:1 hot flood, a slow node, a noisy-neighbor wave
+  and a rolling restart mid-peak — asserting the system-level
+  invariants on every chaos seed.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster, fleet_overload_scenario
+from elasticsearch_tpu.utils.errors import ShardBusyError, shard_busy_info
+
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+pytestmark = pytest.mark.fleet
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _text_cluster(indices, seed, n_nodes=1, docs=16, replicas=0):
+    c = InProcessCluster(n_nodes=n_nodes, seed=seed)
+    c.start()
+    client = c.client()
+    rng = np.random.default_rng(seed)
+    for index in indices:
+        _ok(*c.call(lambda cb, i=index: client.create_index(i, {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": replicas},
+            "mappings": {"properties": {"body": {"type": "text"}}}}, cb)))
+        c.ensure_green(index)
+        for i in range(docs):
+            _ok(*c.call(lambda cb, i=i, idx=index: client.index_doc(
+                idx, f"d{i}",
+                {"body": "common " + " ".join(
+                    f"w{int(x)}" for x in rng.integers(0, 8, 4))}, cb)))
+        c.call(lambda cb, i=index: client.refresh(i, cb))
+    return c
+
+
+def _set_cluster(c, settings):
+    _ok(*c.call(lambda cb: c.client().cluster_update_settings(
+        {"persistent": settings}, cb)))
+
+
+# ---------------------------------------------------------------------------
+# the million-user chaos scenario
+# ---------------------------------------------------------------------------
+
+def _assert_fleet_invariants(s):
+    """The acceptance contract, one seed: bounded admitted p99, clean
+    429s with honest Retry-After, zero starved tenants, zero wrong
+    hits, the shed -> failover loop ENGAGED, zero requests lost to a
+    shed that had a live sibling with headroom (every busy-derived loss
+    is an all-copies-shed surface), ARS routing around the slow node,
+    and the shed-point bound + typed taxonomy intact fleet-wide."""
+    assert s["admitted"] + s["rejected"] == s["offered"]
+    assert s["p99_factor_vs_unloaded"] <= 4.0, s
+    assert s["unclean_rejections"] == 0, s
+    assert s["starved_tenants"] == [], s
+    assert s["wrong_hits"] == 0, s
+    # the shard-side loop genuinely engaged under the flood...
+    assert s["shard_busy_sheds"] > 0, s
+    assert s["failover"]["failovers"] > 0, s
+    assert s["failover"]["sheds_seen"] == s["shard_busy_sheds"], s
+    # ...and the ONLY busy-derived request losses are shards whose
+    # EVERY copy shed through the final backoff round — a shed with a
+    # live sibling that had headroom always found it
+    assert s["request_busy_failures"] == \
+        s["failover"]["all_copies_shed"], s
+    # ARS routed around the slow node: its copies served a fraction of
+    # what their healthy siblings did
+    assert s["victim_copy_hits"] < s["sibling_copy_hits"], s
+    # shed-point correctness fleet-wide: no node's queued members ever
+    # exceeded the bound
+    assert s["queued_hwm_over_bound"] == [], s
+    # taxonomy stays complete under the storm
+    assert s["unknown_fallbacks"] == 0, s
+    assert s["fallback_deltas"].get("shard_busy", 0) == \
+        s["shard_busy_sheds"], s
+
+
+@pytest.mark.parametrize("seed", [131 + 977 * k for k in range(CHAOS_SEEDS)])
+def test_fleet_overload_scenario(seed):
+    _assert_fleet_invariants(fleet_overload_scenario(seed))
+
+
+@pytest.mark.slow
+def test_fleet_chaos_seed_sweep():
+    """CI sweep: the million-user scenario green under >= 5 seeded RNGs
+    (CHAOS_SEEDS widens it further)."""
+    for k in range(max(CHAOS_SEEDS, 5)):
+        _assert_fleet_invariants(
+            fleet_overload_scenario(seed=131 + 977 * k))
+
+
+# ---------------------------------------------------------------------------
+# shed-point correctness (unit + small cluster)
+# ---------------------------------------------------------------------------
+
+def test_shard_shed_point_bounds_queue_and_carries_retry_after():
+    c = _text_cluster(("sp",), seed=11)
+    try:
+        batcher = c.nodes["node0"].search_transport.batcher
+        _set_cluster(c, {"search.shard.max_queued_members": 3})
+        # saturate: pin in-flight members (a drain mid-delivery) and a
+        # measured drain rate, then enqueue must shed typed + Retry-After
+        bp = batcher.node_pressure
+        bp.in_flight = 5
+        bp.service_ewma_ms = 1000.0
+        bp.occupancy_ewma = 2.0     # 2 members/s drain rate
+        with pytest.raises(ShardBusyError) as exc:
+            batcher.enqueue({"index": "sp", "shard": 0,
+                             "body": {"query": {"match_all": {}}},
+                             "window": 5})
+        info = shard_busy_info(exc.value)
+        # ceil((5 queued+in-flight + 1) / 2 per s) = 3s — the honest
+        # drain-rate estimate, not a constant
+        assert info == {"retry_after": 3, "queued": 5}
+        assert exc.value.status == 429
+        assert exc.value.metadata["retry_after"] == 3
+        assert batcher.stats["shard_busy_sheds"] == 1
+        assert batcher.last_shard_retry_after_s == 3
+        # the shed never queued anything: the bound is never exceeded
+        assert batcher.queue_depth() == 0
+        bp.in_flight = 0
+
+        # stats surface: the shed appears EXACTLY once, with the bound
+        stats = c.nodes["node0"].local_node_stats()["search_admission"]
+        sq = stats["shard_queue"]
+        assert sq["limit"] == 3 and sq["sheds"] == 1
+        assert sq["last_retry_after_s"] == 3
+        assert sq["drain_rate_per_s"] == 2.0
+        assert "shard_busy_failover" in stats
+    finally:
+        c.stop()
+
+
+def test_littles_law_shrinks_effective_shard_bound():
+    """The effective bound is min(setting, drain_rate * target_latency)
+    — the coordinator pool's Little's-law controller applied node-side,
+    off NodePressure's drain-measured service EWMA."""
+    c = _text_cluster(("ll",), seed=13)
+    try:
+        batcher = c.nodes["node0"].search_transport.batcher
+        _set_cluster(c, {"search.shard.max_queued_members": 64})
+        bp = batcher.node_pressure
+        assert batcher.shard_queue_limit() == 64   # no rate yet: setting
+        bp.service_ewma_ms = 500.0
+        bp.occupancy_ewma = 4.0        # 8 members/s * 1s target = 8
+        assert batcher.shard_queue_limit() == 8
+        _set_cluster(c, {"search.shard.queue_target_latency": "250ms"})
+        assert batcher.shard_queue_limit() == 2
+        # the shrink never exceeds the operator's cap, floors at 1
+        bp.service_ewma_ms = 10_000.0
+        assert batcher.shard_queue_limit() == 1
+        bp.service_ewma_ms = 0.1
+        assert batcher.shard_queue_limit() == 64
+    finally:
+        c.stop()
+
+
+def test_unset_bound_restores_unbounded_behavior_byte_for_byte():
+    """Without search.shard.max_queued_members, enqueue never sheds no
+    matter the occupancy, and responses are byte-identical to a
+    bound-set-but-idle run (the shed point is invisible until it
+    fires)."""
+    c = _text_cluster(("ub",), seed=17)
+    try:
+        client = c.client()
+        batcher = c.nodes["node0"].search_transport.batcher
+        body = {"query": {"match": {"body": "common w1"}}, "size": 4}
+        # unset: even an absurd pinned occupancy sheds nothing
+        batcher.node_pressure.in_flight = 10_000
+        assert batcher.shard_queue_limit() == 0
+        first = _ok(*c.call(lambda cb: client.search(
+            "ub", json.loads(json.dumps(body)), cb)))
+        batcher.node_pressure.in_flight = 0
+        assert batcher.stats["shard_busy_sheds"] == 0
+        # bound set (not saturated): the same search answers the same
+        _set_cluster(c, {"search.shard.max_queued_members": 32})
+        second = _ok(*c.call(lambda cb: client.search(
+            "ub", json.loads(json.dumps(body)), cb)))
+        strip = lambda r: {k: v for k, v in r.items() if k != "took"}  # noqa: E731
+        assert json.dumps(strip(first), sort_keys=True) == \
+            json.dumps(strip(second), sort_keys=True)
+        # and unsetting again restores the unbounded path
+        _set_cluster(c, {"search.shard.max_queued_members": None})
+        assert batcher.shard_queue_limit() == 0
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# typed busy-failover: routing, accounting, honest Retry-After
+# ---------------------------------------------------------------------------
+
+def test_shard_busy_fails_over_to_sibling_copy():
+    """One copy at its bound, its sibling with headroom: the query is
+    NEVER lost — the coordinator fails over on the typed signal, the
+    busy node's rank sinks immediately, and the failover is typed in
+    the fallback taxonomy."""
+    from elasticsearch_tpu.search.telemetry import TELEMETRY
+    c = _text_cluster(("fo",), seed=23, n_nodes=2, replicas=1)
+    try:
+        client = c.client("node0")
+        _set_cluster(c, {"search.shard.max_queued_members": 1})
+        c.ensure_green("fo")
+        # node1 is saturated; node0 has headroom
+        busy = c.nodes["node1"].search_transport.batcher
+        busy.node_pressure.in_flight = 4
+        busy.node_pressure.service_ewma_ms = 200.0
+        before = dict(TELEMETRY.fallbacks)
+        ok = 0
+        for _ in range(6):
+            resp = _ok(*c.call(lambda cb: client.search(
+                "fo", {"query": {"match": {"body": "common"}},
+                       "size": 3}, cb)))
+            assert resp["_shards"]["failed"] == 0
+            ok += 1
+        assert ok == 6
+        sa = c.nodes["node0"].search_action
+        sheds = busy.stats["shard_busy_sheds"]
+        assert sheds >= 1                       # rotation hit the busy copy
+        assert sa.shard_busy_stats["sheds_seen"] >= sheds
+        assert sa.shard_busy_stats["failovers"] >= 1
+        assert sa.shard_busy_stats["all_copies_shed"] == 0
+        after = TELEMETRY.fallbacks
+        assert after.get("shard_busy", 0) - before.get("shard_busy", 0) \
+            == sheds
+        assert after.get("shard_busy_failover", 0) - \
+            before.get("shard_busy_failover", 0) >= 1
+        assert after.get("unknown", 0) == before.get("unknown", 0)
+        # the busy node's backlog landed on its rank inputs (decayed
+        # once per later search that routed around it, but still
+        # dominant over the healthy node's rank)
+        ars = sa.response_collector.stats()
+        assert ars["node1"]["queue_ewma"] >= 1
+        assert ars["node1"]["rank"] > ars["node0"]["rank"]
+    finally:
+        busy.node_pressure.in_flight = 0
+        c.stop()
+
+
+def test_all_copies_shed_surfaces_429_with_least_loaded_retry_after():
+    """Every copy at its bound through every backoff round: the request
+    fails as a clean 429 whose Retry-After is the LEAST-LOADED copy's
+    drain-rate estimate — and every shed is accounted exactly once."""
+    c = _text_cluster(("ac",), seed=29, n_nodes=2, replicas=1)
+    try:
+        client = c.client("node0")
+        _set_cluster(c, {"search.shard.max_queued_members": 1})
+        c.ensure_green("ac")
+        # both copies saturated, at DIFFERENT drain rates: node0 drains
+        # 1 member/s (retry_after ceil(6/1)=6), node1 drains 2/s
+        # (retry_after ceil(6/2)=3) — node1 is the least-loaded copy
+        for nid, (svc, occ) in (("node0", (1000.0, 1.0)),
+                                ("node1", (500.0, 1.0))):
+            bp = c.nodes[nid].search_transport.batcher.node_pressure
+            bp.in_flight = 5
+            bp.service_ewma_ms = svc
+            bp.occupancy_ewma = occ
+        resp, err = c.call(lambda cb: client.search(
+            "ac", {"query": {"match": {"body": "common"}}, "size": 3},
+            cb), max_time=600.0)
+        assert resp is None and err is not None
+        assert getattr(err, "status", None) == 429
+        assert "shard_busy" in str(err)
+        assert err.metadata["retry_after"] == 3    # least-loaded copy
+        sa = c.nodes["node0"].search_action
+        assert sa.shard_busy_stats["all_copies_shed"] == 1
+        # bounded retries: 3 rounds x 2 copies = 6 sheds, 2 extra rounds
+        assert sa.shard_busy_stats["retry_rounds"] == 2
+        total_sheds = sum(
+            c.nodes[n].search_transport.batcher.stats["shard_busy_sheds"]
+            for n in ("node0", "node1"))
+        assert total_sheds == 6
+        assert sa.shard_busy_stats["sheds_seen"] == 6
+        # failovers: one per round (first copy busy -> try second)
+        assert sa.shard_busy_stats["failovers"] == 3
+    finally:
+        for nid in ("node0", "node1"):
+            c.nodes[nid].search_transport.batcher \
+                .node_pressure.in_flight = 0
+        c.stop()
+
+
+def test_mixed_round_surfaces_real_error_not_overload():
+    """One copy genuinely broken (unreachable), the other at its member
+    bound: the shard's true cause is the FAULT — the search must not be
+    misreported as pure overload (no all-copies-shed 429, no Retry-After
+    inviting a retry that will keep failing, no backoff rounds burned
+    re-hitting the broken copy)."""
+    c = _text_cluster(("mx",), seed=59, n_nodes=2, replicas=1)
+    try:
+        client = c.client("node0")
+        _set_cluster(c, {"search.shard.max_queued_members": 1})
+        c.ensure_green("mx")
+        # node0's copy: busy (local shed); node1's copy: unreachable
+        busy = c.nodes["node0"].search_transport.batcher
+        busy.node_pressure.in_flight = 4
+        c.transport.add_rule("node0", "node1", disconnect=True)
+        resp, err = c.call(lambda cb: client.search(
+            "mx", {"query": {"match": {"body": "common"}}, "size": 3},
+            cb), max_time=600.0)
+        assert err is not None
+        assert getattr(err, "status", None) != 429, err
+        assert "not connected" in str(err), err
+        sa = c.nodes["node0"].search_action
+        assert sa.shard_busy_stats["all_copies_shed"] == 0
+        assert sa.shard_busy_stats["retry_rounds"] == 0
+    finally:
+        busy.node_pressure.in_flight = 0
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission tenant-key normalization (PR 10 follow-up)
+# ---------------------------------------------------------------------------
+
+def test_admission_tenant_resolves_expression_to_concrete_indices():
+    c = _text_cluster(("logs-1", "logs-2"), seed=31)
+    try:
+        sa = c.nodes["node0"].search_action
+        assert sa._admission_tenant("logs*") == "logs-1,logs-2"
+        assert sa._admission_tenant("logs-2,logs-1") == "logs-1,logs-2"
+        assert sa._admission_tenant("logs-1") == "logs-1"
+        # unknown names / unmatched wildcards keep the raw-expression
+        # fallback (admission must never fail on the tenant key)
+        assert sa._admission_tenant("nope*") == "nope*"
+        assert sa._admission_tenant("missing") == "missing"
+        # no cluster state: raw fallback
+        old_state = sa.state
+        sa.state = None
+        try:
+            assert sa._admission_tenant("logs*") == "logs*"
+        finally:
+            sa.state = old_state
+    finally:
+        c.stop()
+
+
+def test_rejections_bucket_under_resolved_tenant_key():
+    """'logs*' and 'logs-1,logs-2' can no longer dodge fair shedding by
+    spelling the same target set differently: both bucket (and shed)
+    under one resolved tenant key."""
+    c = _text_cluster(("logs-1", "logs-2", "bg"), seed=37)
+    try:
+        client = c.client()
+        node = c.nodes["node0"]
+        c.constrain_search_admission(size=1, queue=2)
+        c.slow_node_drains("node0", 0.02)
+        out = []
+        for expr in ("logs*", "logs-1,logs-2", "logs*",
+                     "logs-2,logs-1", "logs*", "logs*"):
+            client.search(expr, {"query": {"match": {"body": "common"}},
+                                 "size": 2},
+                          lambda resp, err=None: out.append((resp, err)))
+        c.run_until(lambda: len(out) == 6, 300.0)
+        pool = node.thread_pool.pool("search")
+        assert pool.rejected_by_tenant, "flood never saturated"
+        assert set(pool.rejected_by_tenant) == {"logs-1,logs-2"}
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# mesh traffic is ARS-visible (PR 10 follow-up)
+# ---------------------------------------------------------------------------
+
+def test_mesh_served_fanout_feeds_ars_observations():
+    c = InProcessCluster(n_nodes=1, seed=43)
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("m", {
+            "settings": {"number_of_shards": 3,
+                         "number_of_replicas": 0},
+            "mappings": {"properties": {"body": {"type": "text"}}}}, cb)))
+        c.ensure_green("m")
+        rng = np.random.default_rng(43)
+        for d in range(36):
+            _ok(*c.call(lambda cb, d=d: client.index_doc(
+                "m", f"d{d}", {"body": " ".join(
+                    f"w{int(x)}" for x in rng.integers(0, 8, 6))}, cb)))
+        _ok(*c.call(lambda cb: client.refresh("m", cb)))
+        # first-init on the RPC path, then the mesh serves
+        _ok(*c.call(lambda cb: client.search(
+            "m", {"query": {"match": {"body": "w0"}}, "size": 1}, cb)))
+        node = c.nodes["node0"]
+        rc = node.search_action.response_collector
+        before = rc.stats().get("node0", {}).get("observations", 0)
+        pressure_before = \
+            node.search_transport.batcher.node_pressure.observations
+        resp = _ok(*c.call(lambda cb: client.search(
+            "m", {"query": {"match": {"body": "w1 w3"}}, "size": 5}, cb)))
+        assert resp.get("_data_plane") == "mesh_plane"
+        after = rc.stats()["node0"]["observations"]
+        # one synthesized per-shard observation per mesh-served target
+        assert after - before >= 3, (before, after)
+        # the mesh drain observed itself into NodePressure (so the
+        # node's piggybacks and shard-queue bound see mesh load too)
+        assert node.search_transport.batcher.node_pressure.observations \
+            > pressure_before
+    finally:
+        c.stop()
+
+
+def test_mesh_refuses_fast_path_when_node_over_member_bound():
+    from elasticsearch_tpu.search.telemetry import TELEMETRY
+    c = InProcessCluster(n_nodes=1, seed=47)
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("mb", {
+            "settings": {"number_of_shards": 2,
+                         "number_of_replicas": 0},
+            "mappings": {"properties": {"body": {"type": "text"}}}}, cb)))
+        c.ensure_green("mb")
+        for d in range(12):
+            _ok(*c.call(lambda cb, d=d: client.index_doc(
+                "mb", f"d{d}", {"body": f"common w{d % 4}"}, cb)))
+        _ok(*c.call(lambda cb: client.refresh("mb", cb)))
+        _set_cluster(c, {"search.shard.max_queued_members": 2})
+        batcher = c.nodes["node0"].search_transport.batcher
+        batcher.node_pressure.in_flight = 2
+        before = TELEMETRY.fallbacks.get("mesh_node_busy", 0)
+        # over the bound: the mesh fast path refuses (typed) and the RPC
+        # fan-out's shed + failover machinery governs — with a 1-copy
+        # shard everywhere busy this surfaces the all-copies-shed 429
+        resp, err = c.call(lambda cb: client.search(
+            "mb", {"query": {"match": {"body": "common"}}, "size": 3},
+            cb), max_time=600.0)
+        assert TELEMETRY.fallbacks.get("mesh_node_busy", 0) == before + 1
+        assert err is not None and getattr(err, "status", None) == 429
+        assert err.metadata.get("retry_after", 0) >= 1
+    finally:
+        batcher.node_pressure.in_flight = 0
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# below-the-seam TCP faults: in-memory parity rules
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fault", ["half_open", "partial_frame"])
+def test_budget_machinery_survives_below_seam_faults_in_memory(fault):
+    """In-memory parity of the TcpDisruption below-the-seam faults: a
+    half-open connection (peer stops reading, never FINs) and a partial
+    frame (header delivered, body stalls) both read as 'send succeeded,
+    nothing ever arrives' — the [timeout] budget machinery still
+    produces a bounded partial response, and heal restores full
+    results."""
+    c = _text_cluster(("bs",), seed=53, n_nodes=2, replicas=1)
+    try:
+        client = c.client("node0")
+        c.ensure_green("bs")
+        body = {"query": {"match": {"body": "common"}}, "size": 4,
+                "timeout": "500ms", "track_total_hits": True}
+        c.transport.add_rule("node0", "node1", **{fault: True})
+        t0 = c.scheduler.now()
+        resp, err = c.call(lambda cb: client.search(
+            "bs", json.loads(json.dumps(body)), cb), max_time=600.0)
+        elapsed = c.scheduler.now() - t0
+        # bounded by the budget, not the 60s transport timeout. Three
+        # legitimate outcomes by copy rotation: the first-ranked copy
+        # was healthy (full results), or the stalled copy timed the
+        # budget out — surfacing the one-shard search as a typed
+        # budget-expired failure, never a hang or an untyped error
+        assert elapsed <= 2.0, elapsed
+        if err is not None:
+            assert "budget expired" in str(err), err
+        elif resp["_shards"]["failed"]:
+            assert resp["timed_out"] is True
+            assert resp["_shards"]["failures"]
+        else:
+            assert resp["hits"]["total"]["value"] == 16
+        c.heal()
+        resp = _ok(*c.call(lambda cb: client.search(
+            "bs", json.loads(json.dumps(body)), cb)))
+        assert resp["_shards"]["failed"] == 0
+        assert resp["hits"]["total"]["value"] == 16
+    finally:
+        c.stop()
