@@ -1698,6 +1698,146 @@ def cfg_fleet(np, jax, jnp, result):
     result["configs"]["fleet"] = s
 
 
+def cfg_zipf_cache(np, jax, jnp, result):
+    """Duplicate-heavy zipfian stream against the two-tier request cache
+    (ROADMAP item 3): a real in-process node serves a zipf-drawn query
+    stream over a small set of distinct plans — the hot head of the
+    distribution repeats constantly, exactly the autocomplete /
+    dashboard-refresh shape the memo_hit_rate 0.5-0.75 measurements
+    promised. With ``search.request_cache.topk`` on, every duplicate is
+    served from the coordinator fused-result cache (or the shard tier)
+    in sub-millisecond HOST time with ZERO device dispatches; the block
+    reports cache-served p50/p99 wall latency, the hot head's device
+    dispatch count (must be zero), hit rate, and a golden
+    cached-vs-uncached identity check per distinct plan."""
+    from elasticsearch_tpu.search.telemetry import TELEMETRY
+    from elasticsearch_tpu.testing import InProcessCluster
+
+    c = InProcessCluster(n_nodes=1, seed=SEED + 9)
+    c.start()
+    try:
+        client = c.client()
+        box = []
+        client.create_index("zc", {
+            "settings": {"number_of_shards": 2,
+                         "number_of_replicas": 0},
+            "mappings": {"properties": {
+                "body": {"type": "text"},
+                "brand": {"type": "keyword"}}}},
+            lambda resp, err=None: box.append(1))
+        c.run_until(lambda: bool(box), 120.0)
+        c.ensure_green("zc")
+        rng = np.random.default_rng(SEED)
+        n_docs = scaled(2048, factor=8)
+        for i in range(n_docs):
+            b = []
+            client.index_doc("zc", f"d{i}", {
+                "body": " ".join(f"w{int(x)}"
+                                 for x in rng.integers(0, 32, 8)),
+                "brand": f"b{i % 8}"},
+                lambda resp, err=None, b=b: b.append(1))
+            c.run_until(lambda: bool(b), 120.0)
+            if i == n_docs // 2:
+                b2 = []
+                client.refresh("zc", lambda resp, err=None, b2=b2:
+                               b2.append(1))
+                c.run_until(lambda: bool(b2), 120.0)
+        box = []
+        client.refresh("zc", lambda resp, err=None, box=box:
+                       box.append(1))
+        c.run_until(lambda: bool(box), 120.0)
+        box = []
+        client.cluster_update_settings(
+            {"persistent": {"search.request_cache.topk": True}},
+            lambda resp, err=None, box=box: box.append(1))
+        c.run_until(lambda: bool(box), 120.0)
+
+        # distinct plans: top-k text fan-outs (the mesh/plane-served
+        # class) plus size-0 aggregation dashboards (the batch path)
+        plans = [{"query": {"match": {
+            "body": f"w{i % 24} w{(i * 7 + 3) % 24}"}}, "size": 10,
+            "track_total_hits": True} for i in range(24)]
+        plans += [{"size": 0, "query": {"match": {"body": f"w{i}"}},
+                   "aggs": {"b": {"terms": {"field": "brand"}}}}
+                  for i in range(8)]
+        weights = 1.0 / np.arange(1, len(plans) + 1) ** 1.1
+        weights /= weights.sum()
+        draws = rng.choice(len(plans), size=256, p=weights)
+
+        node = c.nodes["node0"]
+        fused = node.search_action.fused_cache
+        batcher = node.search_transport.batcher
+
+        def cache_hits() -> int:
+            return fused.stats["hits"] + \
+                batcher.stats["request_cache_intake_hits"]
+
+        def dispatches() -> int:
+            return sum(e["dispatches"]
+                       for e in TELEMETRY._planes.values())
+
+        def run_one(body):
+            b = []
+            client.search("zc", json.loads(json.dumps(body)),
+                          lambda resp, err=None, b=b: b.append(
+                              (resp, err)))
+            t0 = time.perf_counter()
+            c.run_until(lambda: bool(b), 300.0)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            resp, err = b[0]
+            assert err is None, err
+            return resp, wall_ms
+
+        hit_walls, miss_walls = [], []
+        hit_dispatches = 0
+        for pi in draws:
+            h0, d0 = cache_hits(), dispatches()
+            _resp, wall_ms = run_one(plans[int(pi)])
+            if cache_hits() > h0:
+                hit_walls.append(wall_ms)
+                hit_dispatches += dispatches() - d0
+            else:
+                miss_walls.append(wall_ms)
+
+        # golden identity per distinct plan: the (now hot) cached answer
+        # equals a per-request-opted-out uncached execution, modulo took
+        strip = lambda r: {k: v for k, v in r.items()  # noqa: E731
+                           if k not in ("took", "_data_plane")}
+        mismatches = 0
+        for body in plans:
+            cached, _ = run_one(body)
+            uncached, _ = run_one({**body, "request_cache": False})
+            if strip(cached) != strip(uncached):
+                mismatches += 1
+
+        hit_walls.sort()
+        pct = lambda arr, p: round(  # noqa: E731
+            arr[min(int(p * len(arr)), len(arr) - 1)], 3) if arr else None
+        rc_section = node.local_node_stats(
+            sections=["request_cache"])["request_cache"]
+        result["configs"]["zipf_cache"] = {
+            "distinct_plans": len(plans),
+            "requests": int(len(draws)),
+            "hit_rate": round(len(hit_walls) / len(draws), 3),
+            "cache_served_p50_ms": pct(hit_walls, 0.50),
+            "cache_served_p99_ms": pct(hit_walls, 0.99),
+            "miss_p50_ms": pct(sorted(miss_walls), 0.50),
+            "hit_device_dispatches": hit_dispatches,
+            "zero_dispatch_hot_head": hit_dispatches == 0,
+            "cache_served_p50_under_1ms": bool(
+                hit_walls and pct(hit_walls, 0.50) < 1.0),
+            "golden_mismatches": mismatches,
+            "coordinator_hits": fused.stats["hits"],
+            "shard_intake_hits":
+                batcher.stats["request_cache_intake_hits"],
+            "invalidations_by_cause":
+                rc_section["invalidations_by_cause"],
+            "resident_bytes": rc_section["resident_bytes"],
+        }
+    finally:
+        c.stop()
+
+
 def multichip_scaling(per_shard_docs: int = 0, q_batch: int = 8,
                       iters: int = 3) -> dict:
     """Mesh-sharded plane capacity scaling (ROADMAP item 2's target):
@@ -1969,6 +2109,7 @@ def main() -> None:
                          ("segmented", cfg_segmented),
                          ("overload", cfg_overload),
                          ("fleet", cfg_fleet),
+                         ("zipf_cache", cfg_zipf_cache),
                          ("multichip", cfg_multichip)):
             try:
                 if name == "hybrid":

@@ -138,6 +138,16 @@ class ResponseCollectorService:
                     ALPHA * s + (1 - ALPHA) * stats.service_ewma_ms
             stats.observations += 1
 
+    def response_ewma_s(self, node_id: str) -> Optional[float]:
+        """The node's response-time EWMA in SECONDS, or None before any
+        round trip has been observed — the adaptive per-copy shard-query
+        transport timeout runs off this (TransportSearchAction)."""
+        with self._lock:
+            stats = self._nodes.get(node_id)
+            if stats is None or stats.ewma_ms is None:
+                return None
+            return stats.ewma_ms / 1000.0
+
     # -- ranking ----------------------------------------------------------
 
     def rank(self, node_id: str) -> float:

@@ -12,10 +12,11 @@ point-in-time view between query and fetch (SearchService contexts :203).
 
 from __future__ import annotations
 
+import copy
 import functools
+import json
 import time
 import uuid as uuid_mod
-from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -156,11 +157,16 @@ class SearchTransportService:
         # None in unit tests driving the shard phases directly
         self.state = state_supplier
         self._contexts: Dict[str, Tuple[Reader, float]] = {}
-        # shard request cache (indices/IndicesRequestCache.java:69):
-        # request-bytes-keyed size=0 results, invalidated by the reader's
-        # freshness key (any refresh/merge/delete changes it). LRU-bounded.
-        self._request_cache: "OrderedDict[Tuple, Dict[str, Any]]" = \
-            OrderedDict()
+        # shard request cache (indices/request_cache.py — the reference's
+        # IndicesRequestCache rebuilt on generation stamps): response
+        # rows keyed by (shard, engine search generation, normalized
+        # plan), charged to the request_cache breaker child, LRU-bounded
+        # by search.request_cache.max_bytes, invalidation typed by the
+        # engine-recorded cause of every generation move
+        from elasticsearch_tpu.indices.request_cache import (
+            ShardRequestCache,
+        )
+        self.request_cache = ShardRequestCache()
         # adaptive cross-query micro-batcher (search/batch_executor.py):
         # eligible shard queries coalesce into single batched device
         # programs; search.batch.enabled=false restores the solo path
@@ -224,52 +230,69 @@ class SearchTransportService:
         return {"doc_count": doc_count, "dfs": dfs,
                 "field_stats": field_stats}
 
-    REQUEST_CACHE_CAP = 256
+    def _cache_coverage(self, body: Dict[str, Any], window: int) -> bool:
+        """Delegates to THE shared cacheability predicate
+        (``_CacheTier.covers`` — one rule set for both tiers, so
+        coverage can never drift between the shard and coordinator
+        caches)."""
+        return self.request_cache.covers(body, window)
 
-    def _cache_key_from(self, req: Dict[str, Any],
-                        freshness: Tuple) -> Optional[Tuple]:
-        """Cacheable iff the request cannot pin per-request state: size=0
-        (no fetch context) and no slice. The freshness component
-        (segment identity + live counts, O(segments) off the segments'
-        cached live counts — never an O(docs) mask sum) makes every
-        refresh/delete a natural invalidation, like the cache's
-        reader-close listener."""
-        body = req.get("body", {})
-        if req.get("window", 0) > 0 or body.get("slice") or \
-                body.get("profile"):
-            return None
-        import json as _json
-        return (req["index"], req["shard"], freshness,
-                _json.dumps(body, sort_keys=True, default=str),
-                _json.dumps(req.get("df_overrides"), sort_keys=True),
-                req.get("doc_count_override"))
-
-    def _request_cache_key(self, req: Dict[str, Any], reader
-                           ) -> Optional[Tuple]:
-        return self._cache_key_from(req, reader.freshness)
+    def _cache_norm_key(self, req: Dict[str, Any]) -> str:
+        """The normalized plan: body (minus the cache directive itself)
+        plus everything else that changes what the shard computes —
+        window and the DFS stat overrides."""
+        body = req.get("body") or {}
+        if "request_cache" in body:
+            body = {k: v for k, v in body.items()
+                    if k != "request_cache"}
+        return json.dumps(
+            [body, req.get("window", 0), req.get("df_overrides"),
+             req.get("doc_count_override"),
+             req.get("field_stats_overrides")],
+            sort_keys=True, default=str)
 
     def request_cache_lookup(self, req: Dict[str, Any],
                              arrival_ns: Optional[int] = None
                              ) -> Optional[Dict[str, Any]]:
-        """Intake-time request-cache consult (the batcher calls this
-        BEFORE queuing): a cacheable duplicate over an unchanged reader
-        answers immediately instead of waiting out a collection window.
-        None = miss (or not cacheable); the drain fills the cache.
-        Uses ``engine.freshness()`` — no reader is built, so the lookup
-        copies no live masks."""
+        """Intake-time request-cache consult (the batcher calls this for
+        EVERY arriving query, before classification): a cacheable
+        duplicate over an unmoved generation answers immediately —
+        no collection window, no reader probe, no device dispatch. The
+        generation stamp makes the freshness check ONE attribute read
+        (``engine.search_generation``); only a window>0 hit pays a
+        reader acquisition, to pin the fetch-phase context. None = miss
+        (or not cacheable); the drain fills the cache."""
         entry_ns = time.monotonic_ns()
-        body = req.get("body", {})
-        if req.get("window", 0) > 0 or body.get("slice") or \
-                body.get("profile"):
+        body = req.get("body") or {}
+        window = int(req.get("window", 0) or 0)
+        if not self._cache_coverage(body, window):
             return None
         shard = self.indices.shard(req["index"], req["shard"])
-        cache_key = self._cache_key_from(req, shard.engine.freshness())
-        if cache_key is None:
-            return None
-        cached = self._request_cache.get(cache_key)
+        engine = shard.engine
+        generation = engine.search_generation
+        cached = self.request_cache.get(
+            (req["index"], req["shard"]), generation,
+            self._cache_norm_key(req),
+            cause=lambda: engine.search_generation_cause)
         if cached is None:
             return None
-        self._request_cache.move_to_end(cache_key)
+        context_id = None
+        if window > 0:
+            # the fetch phase needs a pinned point-in-time reader; the
+            # acquisition must still see the generation the entry was
+            # filled at (a racing refresh degrades to a miss — and
+            # un-counts the tier hit the probe already recorded, so
+            # hit_rate reflects requests actually SERVED from cache)
+            reader = engine.acquire_reader()
+            if reader.generation != generation:
+                rc = self.request_cache
+                rc.stats["hits"] = max(rc.stats["hits"] - 1, 0)
+                rc.stats["misses"] += 1
+                return None
+            context_id = uuid_mod.uuid4().hex
+            self._contexts[context_id] = (
+                reader, self._now() + CONTEXT_KEEP_ALIVE)
+        cached = {**cached, "context_id": context_id}
         shard.search_stats["request_cache_hits"] += 1
         # cache hits are served traffic too: without this the cheapest
         # executions vanish from the rings and the histogram p50/p95
@@ -286,6 +309,28 @@ class SearchTransportService:
         trace.finish()
         TELEMETRY.observe(trace)
         return cached
+
+    def request_cache_fill(self, req: Dict[str, Any],
+                           row: Dict[str, Any], reader) -> None:
+        """Fill one executed response row (the batcher's shared-kind
+        demux calls this per unique plan): the entry is stamped with the
+        generation of the READER that computed it, so a later hit can
+        only serve the exact searchable state the probe's generation
+        names. The stored row never carries a context — a hit pins its
+        own fresh reader."""
+        body = req.get("body") or {}
+        window = int(req.get("window", 0) or 0)
+        if not self._cache_coverage(body, window):
+            return
+        generation = getattr(reader, "generation", None)
+        if generation is None:
+            return
+        shard = self.indices.shard(req["index"], req["shard"])
+        shard.search_stats["request_cache_misses"] += 1
+        self.request_cache.put(
+            (req["index"], req["shard"]), generation,
+            self._cache_norm_key(req), {**row, "context_id": None},
+            cause=lambda: shard.engine.search_generation_cause)
 
     def _slow_log(self, req: Dict[str, Any], took_s: float,
                   trace: Optional[SearchTrace] = None) -> None:
@@ -328,6 +373,7 @@ class SearchTransportService:
             state = self.state()
             PLANES.configure_from_state(state)
             DEVICE_PROFILE.configure_from_state(state)
+            self.request_cache.configure_from_state(state)
         # THE shard execution path: every query is a batch member
         # (occupancy-1 keys drain on the next tick, so an isolated query
         # pays one scheduler hop; `search.batch.enabled: false` forces
@@ -352,13 +398,26 @@ class SearchTransportService:
         entry_ns = time.monotonic_ns()
         shard = self.indices.shard(req["index"], req["shard"])
         body = req.get("body", {})
-        cache_key = self._request_cache_key(req, reader)
-        if cache_key is not None:
-            cached = self._request_cache.get(cache_key)
+        window = int(req.get("window", 0) or 0)
+        generation = getattr(reader, "generation", None)
+        cache_state = None
+        if generation is not None and self._cache_coverage(body, window):
+            shard_key = (req["index"], req["shard"])
+            norm_key = self._cache_norm_key(req)
+            cached = self.request_cache.get(
+                shard_key, generation, norm_key,
+                cause=lambda: shard.engine.search_generation_cause)
             if cached is not None:
                 # filled between this member's intake miss and its drain
-                self._request_cache.move_to_end(cache_key)
                 shard.search_stats["request_cache_hits"] += 1
+                context_id = None
+                if window > 0:
+                    # the hit pins its own context over the DRAIN's
+                    # reader — the same generation the entry names
+                    context_id = uuid_mod.uuid4().hex
+                    self._contexts[context_id] = (
+                        reader, self._now() + CONTEXT_KEEP_ALIVE)
+                cached = {**cached, "context_id": context_id}
                 if meta_out is not None:
                     # the drain's memo fan-out mirrors this branch's
                     # accounting for the row's duplicates
@@ -370,6 +429,7 @@ class SearchTransportService:
                     TELEMETRY.observe(trace)
                 return cached
             shard.search_stats["request_cache_misses"] += 1
+            cache_state = (shard_key, generation, norm_key)
         query = dsl.parse_query(body.get("query"))
         sort = parse_sort(body.get("sort"))
         if trace is None:
@@ -434,10 +494,10 @@ class SearchTransportService:
                 if body.get("suggest") else None),
             "profile": result.profile,
         }
-        if cache_key is not None:
-            while len(self._request_cache) >= self.REQUEST_CACHE_CAP:
-                self._request_cache.popitem(last=False)
-            self._request_cache[cache_key] = response
+        if cache_state is not None:
+            self.request_cache.put(
+                *cache_state, {**response, "context_id": None},
+                cause=lambda: shard.engine.search_generation_cause)
         trace.add_span("demux", time.monotonic_ns() - t_demux)
         trace.finish()
         TELEMETRY.observe(trace)
@@ -707,6 +767,14 @@ class TransportSearchAction:
         # hybrid RRF fusion batcher: concurrent requests' fusions
         # coalesce into one rrf_fuse_batch device dispatch
         self.rrf_fuser = RrfFusionBatcher(ts, self._batch_enabled)
+        # coordinator fused-result cache (indices/request_cache.py): an
+        # identical co-located fan-out answers from its fused response
+        # with ZERO shard dispatches, stamped with the participating
+        # shards' generation vector so any member moving invalidates it
+        from elasticsearch_tpu.indices.request_cache import (
+            FusedResultCache,
+        )
+        self.fused_cache = FusedResultCache()
         # shard_busy failover observability — the coordinator half of
         # the two-sided shed contract, surfaced under
         # search_admission.shard_busy_failover in _nodes/stats
@@ -780,6 +848,108 @@ class TransportSearchAction:
         )
         state = self.state() if self.state is not None else None
         return setting_from_state(state, SEARCH_BATCH_ENABLED)
+
+    def _fused_cache_probe(self, expression: str, body: Dict[str, Any],
+                           targets, search_type: str
+                           ) -> Optional[Dict[str, Any]]:
+        """Probe the coordinator fused-result cache for this fan-out.
+        Returns None when the request is not coordinator-cacheable, else
+        {"key", "vector", "hit"}: the cache key (concrete-indices tenant
+        key + normalized request), the participating shards' CURRENT
+        generation vector — readable without an RPC only because every
+        target shard is locally present (the mesh co-location shape;
+        anything else counts ``not_colocated`` and serves uncached) —
+        and the cached fused response, if the vector still matches.
+        Coverage mirrors the shard tier (size=0 by default, top-k behind
+        the ``topk`` gate / per-request opt-in); requests carrying a
+        [timeout] budget stay uncached — their responses are
+        legitimately nondeterministic."""
+        try:
+            if self.indices is None or not targets:
+                return None
+            cache = self.fused_cache
+            cache.configure_from_state(
+                self.state() if self.state is not None else None)
+            window = int(body.get("size", 10)) + int(body.get("from", 0))
+            # the shared coverage predicate; this tier additionally
+            # refuses [timeout]-carrying bodies (EXCLUDE_BUDGETED)
+            if not cache.covers(body, window):
+                return None
+            vector = []
+            for target in targets:
+                if target.get("alias_filter") is not None:
+                    return None
+                if not self.indices.has_shard(target["index"],
+                                              target["shard"]):
+                    cache.stats["not_colocated"] += 1
+                    return None
+                vector.append((
+                    target["index"], target["shard"],
+                    self.indices.shard(target["index"],
+                                       target["shard"]).search_generation))
+            key_body = {k: v for k, v in body.items()
+                        if k != "request_cache"}
+            key = (self._admission_tenant(expression),
+                   json.dumps([key_body, search_type], sort_keys=True,
+                              default=str))
+            vector = tuple(vector)
+            return {"key": key, "vector": vector,
+                    "hit": cache.get(key, vector,
+                                     self._generation_cause_of)}
+        except Exception:  # noqa: BLE001 — the cache probe must never
+            return None    # fail (or mis-route) a search
+
+    def _generation_cause_of(self, shard_key) -> str:
+        """Typed invalidation attribution: the cause the MOVED shard's
+        engine recorded for its latest generation move."""
+        try:
+            return self.indices.shard(
+                shard_key[0], shard_key[1]).engine.search_generation_cause
+        except Exception:  # noqa: BLE001 — shard gone mid-probe
+            return "restore"
+
+    def _fused_cache_fill(self, ctx: Dict[str, Any],
+                          resp: Dict[str, Any]) -> None:
+        """Fill with a CLEAN fused response only (no shard failures, no
+        expired budget — a degraded response must never become the
+        cached answer), stamped with the generation vector read at
+        probe time: a shard that moved mid-fan-out leaves an entry no
+        future vector can match, never a stale hit."""
+        shards = resp.get("_shards") or {}
+        if shards.get("failed") or resp.get("timed_out"):
+            return
+        stored = {k: v for k, v in resp.items()
+                  if k not in ("took", "_data_plane")}
+        self.fused_cache.put(ctx["key"], ctx["vector"],
+                             copy.deepcopy(stored))
+
+    # adaptive per-copy shard-query transport timeout: a copy with an
+    # ARS response EWMA times out at 30x that EWMA (clamped to the
+    # floor/ceiling settings) — a stalled copy fails over in RTT-scale
+    # time; an unknown copy keeps the ceiling (the old flat 60s)
+    SHARD_TIMEOUT_EWMA_MULTIPLE = 30.0
+
+    def _shard_query_timeout(self, node: str, floor_s: float,
+                             ceiling_s: float,
+                             budget_left_s: Optional[float],
+                             has_failover: bool = True) -> float:
+        # the adaptive timeout exists to FAIL OVER in RTT-scale time;
+        # with no sibling copy left to try, abandoning a slow-but-alive
+        # copy early (a first-dispatch compile can legitimately run
+        # multi-second) only converts success into a shard failure —
+        # the last copy keeps the ceiling
+        ewma_s = self.response_collector.response_ewma_s(node)
+        timeout = ceiling_s if ewma_s is None or not has_failover else \
+            min(ceiling_s,
+                max(floor_s, ewma_s * self.SHARD_TIMEOUT_EWMA_MULTIPLE))
+        if budget_left_s is not None:
+            # the budget timer OWNS deadline semantics: the transport
+            # timeout lands strictly after it (+50ms), so an expiry
+            # surfaces as the guaranteed timed_out:true partial, never
+            # a same-instant copy-timeout race that reads as a shard
+            # failure
+            timeout = min(timeout, max(budget_left_s, 0.0) + 0.05)
+        return max(timeout, 1e-3)
 
     def _default_allow_partial(self, state: ClusterState) -> bool:
         """Cluster-wide default (search.default_allow_partial_results,
@@ -1076,6 +1246,42 @@ class TransportSearchAction:
                         if len(filters) == 1 else \
                         {"bool": {"should": filters,
                                   "minimum_should_match": 1}}
+            # coordinator fused-result cache: a duplicate co-located
+            # fan-out answers NOW — no expansion rewrite, no can-match,
+            # no shard dispatch; a miss arms the fill so THIS fan-out's
+            # clean fused response becomes the next duplicate's answer
+            fused_ctx = self._fused_cache_probe(index_expression, body,
+                                                targets, search_type)
+            if fused_ctx is not None:
+                hit = fused_ctx.pop("hit", None)
+                if hit is not None:
+                    resp = {**copy.deepcopy(hit),
+                            "took": int((time.monotonic() - t0) * 1000)}
+                    # observable end-to-end: the histogram entry lands
+                    # under (class x "cached"). The response itself is
+                    # byte-identical to the RPC fan-out's, modulo took —
+                    # and modulo the _data_plane marker a mesh-served
+                    # original would carry (stripped at fill; the
+                    # established mesh golden contract is "modulo
+                    # took/_data_plane")
+                    ctrace.data_plane = "cached"
+                    ctrace.add_span("request_cache_hit",
+                                    time.monotonic_ns() - entry_ns)
+                    ctrace.finish()
+                    TELEMETRY.observe(ctrace)
+                    on_done(resp, None)
+                    return
+                inner_done = on_done
+
+                def caching_done(resp, err, _ctx=fused_ctx,
+                                 _inner=inner_done):
+                    if err is None and isinstance(resp, dict):
+                        try:
+                            self._fused_cache_fill(_ctx, resp)
+                        except Exception:  # noqa: BLE001 — the fill
+                            pass           # must never fail a response
+                    _inner(resp, err)
+                on_done = caching_done
             # coordinator-side inference rewrite: text_expansion model_text
             # becomes tokens ONCE per request (one batched device dispatch),
             # never per shard/segment — TextExpansionQueryBuilder.doRewrite
@@ -1429,11 +1635,17 @@ class TransportSearchAction:
         pending = {"n": len(targets)}
         resolved = [False] * len(targets)
         from elasticsearch_tpu.utils.settings import (
-            CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION, setting_from_state,
+            CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION,
+            SEARCH_SHARD_QUERY_TIMEOUT_CEILING,
+            SEARCH_SHARD_QUERY_TIMEOUT_FLOOR, setting_from_state,
         )
+        qp_state = self.state() if self.state is not None else None
         use_ars = setting_from_state(
-            self.state() if self.state is not None else None,
-            CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION)
+            qp_state, CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION)
+        timeout_floor = setting_from_state(
+            qp_state, SEARCH_SHARD_QUERY_TIMEOUT_FLOOR)
+        timeout_ceiling = setting_from_state(
+            qp_state, SEARCH_SHARD_QUERY_TIMEOUT_CEILING)
 
         def one(i: int, target) -> None:
             """Dispatch one shard: walk its (C3-ranked) copy list, treat
@@ -1584,8 +1796,21 @@ class TransportSearchAction:
                             try_copy(copy_idx + 1)
                             return
                         round_cb(None, err)
-                    self.ts.send_request(node, SEARCH_QUERY, req, cb,
-                                         timeout=60.0)
+                    # adaptive per-copy timeout off the copy's own ARS
+                    # response EWMA (PR 13's recorded leg): a stalled
+                    # known-fast copy fails over in RTT-scale time; the
+                    # timeout error then reads as a slow response, so
+                    # the node's widened EWMA self-corrects the bound
+                    budget_left = None \
+                        if phase_state.get("deadline") is None else \
+                        max(phase_state["deadline"] - scheduler.now(),
+                            0.0)
+                    self.ts.send_request(
+                        node, SEARCH_QUERY, req, cb,
+                        timeout=self._shard_query_timeout(
+                            node, timeout_floor, timeout_ceiling,
+                            budget_left,
+                            has_failover=copy_idx + 1 < len(copies)))
                 try_copy(0)
 
             def shard_done(wrapped, err) -> None:

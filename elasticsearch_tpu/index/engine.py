@@ -18,6 +18,7 @@ indexing never blocks on device work.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 from dataclasses import dataclass
@@ -33,6 +34,12 @@ from elasticsearch_tpu.utils.errors import (
 )
 
 logger = logging.getLogger(__name__)
+
+# search generation values are drawn from ONE process-global counter
+# (atomic in CPython): monotonic per engine AND unique across engine
+# incarnations, so a shard torn down and re-created on the same node
+# can never reuse a stamp a stale cache entry still carries
+_SEARCH_GENERATIONS = itertools.count(1)
 
 
 @dataclass
@@ -88,9 +95,14 @@ class Reader:
     deletes don't shift results mid-search (scroll contexts hold Readers).
     """
 
-    def __init__(self, segments: List[Segment]):
+    def __init__(self, segments: List[Segment], generation: int = 0):
         self.segments = list(segments)
         self.live_masks = [seg.live.copy() for seg in segments]
+        # the engine's search generation at acquisition: request-cache
+        # entries filled from this reader are stamped with it, so a hit
+        # can only serve data from the exact searchable state the
+        # current generation names
+        self.generation = int(generation)
         # freshness key for the shard request cache: (segment identity,
         # live count) per segment, so any refresh/merge/delete naturally
         # invalidates cached entries. Computed EAGERLY (acquire_reader
@@ -159,6 +171,13 @@ class InternalEngine:
         self._commit_generation = 0
         self._dirty_live: set = set()   # segments whose live mask changed since last flush
         self.refresh_listeners: List[Callable[[], None]] = []
+        # search generation stamp (the request-cache freshness key):
+        # moved — with a typed cause — at every transition that changes
+        # what a NEW reader would see (refresh, delete visibility,
+        # merge, restore). One int read replaces the O(segments)
+        # freshness-tuple probe on the cache hot path.
+        self.search_generation = next(_SEARCH_GENERATIONS)
+        self.search_generation_cause = "refresh"
 
     # ------------------------------------------------------------------
     # write path
@@ -333,7 +352,15 @@ class InternalEngine:
 
     def acquire_reader(self) -> Reader:
         with self._lock:
-            return Reader(self.segments)
+            return Reader(self.segments,
+                          generation=self.search_generation)
+
+    def _bump_search_generation(self, cause: str) -> None:
+        """Called under the engine lock at every searchable-state
+        transition: the stamp moves and records WHY, so the request
+        cache's invalidation counters are typed at the source."""
+        self.search_generation = next(_SEARCH_GENERATIONS)
+        self.search_generation_cause = cause
 
     def freshness(self) -> Tuple:
         """The reader freshness key WITHOUT building a reader: no live
@@ -352,6 +379,11 @@ class InternalEngine:
         with self._lock:
             if not self._buffer and not self._pending_tombstones:
                 return False
+            # tombstones becoming VISIBLE is the delete cause; a pure
+            # new-segment publish is the refresh cause (an update — new
+            # copy + tombstone on the old — attributes to delete, the
+            # mutation that can shrink a cached result)
+            deletes_visible = bool(self._pending_tombstones)
             # apply tombstones to existing segments (newest copy wins search)
             for doc_id in self._pending_tombstones:
                 for seg in self.segments:
@@ -374,6 +406,8 @@ class InternalEngine:
                 self.segments.append(builder.build())
                 self._buffer.clear()
                 self._buffer_order.clear()
+            self._bump_search_generation(
+                "delete" if deletes_visible else "refresh")
             listeners = list(self.refresh_listeners)
         for fn in listeners:
             fn()
@@ -470,6 +504,7 @@ class InternalEngine:
         else:
             merged = merge_segments(name, to_merge, self.mappers)
         self.segments = _insert_merged(merged, self.segments, to_merge)
+        self._bump_search_generation("merge")
         # merged-away segments are dead to every FUTURE reader (the plane
         # registry keys on segment uids): free their device planes now
         # instead of leaving the HBM to LRU pressure. A still-open scroll
@@ -528,7 +563,8 @@ class InternalEngine:
         fill). Atomic under the engine lock."""
         with self._lock:
             ops: List[Dict[str, Any]] = []
-            reader = Reader(self.segments)
+            reader = Reader(self.segments,
+                            generation=self.search_generation)
             for seg, mask in zip(reader.segments, reader.live_masks):
                 for doc_id, d in seg.id_to_doc.items():
                     if mask[d]:
@@ -631,6 +667,7 @@ class InternalEngine:
         source the same way)."""
         with self._lock:
             self.segments = list(segments)
+            self._bump_search_generation("restore")
             self._buffer.clear()
             self._buffer_order.clear()
             self._pending_tombstones.clear()

@@ -190,6 +190,14 @@ class IndexShard:
     # ------------------------------------------------------------------
 
     @property
+    def search_generation(self) -> int:
+        """The engine's search generation stamp — THE freshness key both
+        request-cache tiers use (the shard tier per entry, the
+        coordinator tier as one component of a fan-out's generation
+        vector). One attribute read; never walks segments."""
+        return self.engine.search_generation
+
+    @property
     def local_checkpoint(self) -> int:
         return self.engine.tracker.checkpoint
 

@@ -135,7 +135,8 @@ class HierarchyCircuitBreakerService:
     def __init__(self, total_limit: int = 12 * GB,
                  request_limit: int = 6 * GB,
                  fielddata_limit: int = 4 * GB,
-                 device_limit: int = 12 * GB):
+                 device_limit: int = 12 * GB,
+                 request_cache_limit: int = 1 * GB):
         self.parent_limit = int(total_limit)
         self.parent_trip_count = 0
         self._lock = threading.Lock()
@@ -144,6 +145,14 @@ class HierarchyCircuitBreakerService:
             "fielddata": ChildBreaker("fielddata", fielddata_limit,
                                       parent=self),
             "device": ChildBreaker("device", device_limit, parent=self),
+            # resident request-cache entries (indices/request_cache.py):
+            # the cache's own max_bytes LRU budget evicts cold entries
+            # first; this child is the hard backstop that makes cache
+            # memory visible to the parent and lets a starved node
+            # refuse NEW entries (typed) while serving uncached
+            "request_cache": ChildBreaker("request_cache",
+                                          request_cache_limit,
+                                          parent=self),
         }
 
     def breaker(self, name: str) -> ChildBreaker:

@@ -247,6 +247,31 @@ def search_admission_stats(thread_pool, response_collector=None,
     return out
 
 
+def request_cache_stats(search_transport, search_action=None
+                        ) -> Dict[str, Any]:
+    """Two-tier request-cache observability (indices/request_cache.py):
+    the shard tier's hits / misses / evictions / typed
+    invalidations_by_cause / resident bytes plus the batcher's
+    intake-hit and pressure-observation counters, and the coordinator
+    fused-result tier's figures under ``coordinator_*`` — so the
+    duplicate-traffic win (and every entry the breaker refused) is
+    explainable from the stats surface alone."""
+    if search_transport is None:
+        return {}
+    out: Dict[str, Any] = search_transport.request_cache.snapshot()
+    batcher = getattr(search_transport, "batcher", None)
+    if batcher is not None:
+        out["intake_hits"] = batcher.stats.get(
+            "request_cache_intake_hits", 0)
+        out["cached_pressure_observations"] = \
+            batcher.node_pressure.cached_served
+    if search_action is not None and \
+            getattr(search_action, "fused_cache", None) is not None:
+        out.update(search_action.fused_cache.snapshot(
+            prefix="coordinator_"))
+    return out
+
+
 def search_latency_stats() -> Dict[str, Any]:
     """Search telemetry plane observability (search/telemetry.py
     TELEMETRY): ring-buffer latency histograms (p50/p95/p99 + span-level

@@ -342,6 +342,11 @@ class Node:
             "search_batch": lambda: monitor.search_batch_stats(
                 self.search_transport.batcher,
                 rrf_fuser=self.search_action.rrf_fuser),
+            # two-tier request cache: shard-tier hits/misses/evictions +
+            # typed invalidation causes + the coordinator fused-result
+            # tier (indices/request_cache.py)
+            "request_cache": lambda: monitor.request_cache_stats(
+                self.search_transport, self.search_action),
             # per-(query class x data plane) latency histograms + the
             # typed fallback-reason taxonomy (search/telemetry.py)
             "search_latency": monitor.search_latency_stats,

@@ -1371,6 +1371,7 @@ def build_controller(client: NodeClient) -> RestController:
                     # nodes-stats aggregation leg — PR 8 follow-up)
                     merged: Dict[str, Any] = {}
                     merged_dp: Dict[str, Any] = {}
+                    merged_rc: Dict[str, Any] = {}
                     node_sections = list(
                         (ns_resp or {}).get("nodes", {}).values())
                     try:
@@ -1390,6 +1391,14 @@ def build_controller(client: NodeClient) -> RestController:
                              for n in node_sections])
                     except Exception:  # noqa: BLE001 — stats must serve
                         merged_dp = {}
+                    try:
+                        from elasticsearch_tpu.indices.request_cache \
+                            import merge_request_cache_sections
+                        merged_rc = merge_request_cache_sections(
+                            [n.get("request_cache") or {}
+                             for n in node_sections])
+                    except Exception:  # noqa: BLE001 — stats must serve
+                        merged_rc = {}
                     done(200, {
                         "cluster_name": state.cluster_name,
                         "status": h["status"],
@@ -1420,6 +1429,10 @@ def build_controller(client: NodeClient) -> RestController:
                         # compile/recompile counters summed, compile-ms
                         # maxima kept as maxima)
                         "device_profile": merged_dp,
+                        # fleet-merged two-tier request cache (counters
+                        # summed, typed invalidation causes summed per
+                        # cause)
+                        "request_cache": merged_rc,
                     })
                 # section-filtered fan-out: every node builds ONLY its
                 # search_latency section for this merge, not the full
@@ -1429,7 +1442,8 @@ def build_controller(client: NodeClient) -> RestController:
                 # merge tolerates missing nodes)
                 client.nodes_stats_all(
                     finish,
-                    sections=("search_latency", "device_profile"),
+                    sections=("search_latency", "device_profile",
+                              "request_cache"),
                     timeout=5.0)
 
             # status through the master-routed health path (the

@@ -845,10 +845,36 @@ class ShardQueryBatcher:
 
         Raises ShardBusyError when the node is at its member bound
         (search.shard.max_queued_members): the shed binds BEFORE
-        classification, the request cache, task registration — an
-        overloaded node spends nothing on work it cannot admit."""
-        self._shed_check(req)
+        classification and task registration — an overloaded node
+        spends nothing on work it cannot admit. The request-cache
+        consult runs BEFORE the shed: a hit consumes no queued-member
+        slot and costs sub-millisecond host time, so the cache is the
+        member bound's pressure-relief valve — the hot head of a
+        duplicate flood is served for free at the exact moment the
+        node is shedding, instead of being 429'd into a coordinator
+        failover round for work that costs nothing."""
         scheduler = self._scheduler()
+        # request-cache intake consult for EVERY kind, before the shed
+        # point and before classification: a cacheable duplicate over an
+        # unmoved generation answers NOW — no parse, no collection
+        # window, no device dispatch. The hit is served traffic: it
+        # counts into the NodePressure observation windows (without
+        # consuming a queued-member slot) and carries the same
+        # took/pressure piggyback a drained response would, so ARS
+        # never goes blind on cache-served duplicates.
+        try:
+            cached = self.sts.request_cache_lookup(req, arrival_ns)
+        except Exception:  # noqa: BLE001 — a broken lookup serves
+            cached = None  # uncached, never fails the query
+        if cached is not None:
+            self.stats["request_cache_intake_hits"] += 1
+            self.node_pressure.observe_cached()
+            now_ns = time.monotonic_ns()
+            took_ms = max((now_ns - (arrival_ns or now_ns)) / 1e6, 0.0)
+            return {**cached, "took_ms": round(took_ms, 3),
+                    "pressure": self.node_pressure.snapshot(
+                        self.queue_depth())}
+        self._shed_check(req)
         try:
             shard = self.sts.indices.shard(req["index"], req["shard"])
             frozen = False
@@ -862,13 +888,6 @@ class ShardQueryBatcher:
                 # frozen index: per-search device residency — the dense
                 # member path evicts rebuilt caches after the drain
                 spec = dense_spec(req)
-            if spec.kind == "dense":
-                # request-cache intake consult: a cacheable duplicate
-                # (size-0 count over an unchanged reader) answers NOW
-                cached = self.sts.request_cache_lookup(req, arrival_ns)
-                if cached is not None:
-                    self.stats["request_cache_intake_hits"] += 1
-                    return cached
         except Exception:  # noqa: BLE001 — intake must never fail a
             # query before execution can report its real error
             spec = dense_spec(req)
@@ -1295,6 +1314,14 @@ class ShardQueryBatcher:
                     "suggest_partial": None,
                     "profile": None,
                 }
+                # request-cache fill, once per unique plan: stamped with
+                # the DRAIN reader's generation, so a duplicate arriving
+                # after this drain hits at intake (the shapes the topk
+                # gate / per-request opt-in covers)
+                try:
+                    self.sts.request_cache_fill(m.req, row, reader)
+                except Exception:  # noqa: BLE001 — the fill must never
+                    pass           # fail a served response
             prune = row["prune"]
             stats = shard.search_stats
             stats["query_total"] += 1

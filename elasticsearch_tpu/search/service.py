@@ -38,7 +38,7 @@ class NodePressure:
     ALPHA = 0.3
 
     __slots__ = ("in_flight", "service_ewma_ms", "observations",
-                 "occupancy_ewma")
+                 "occupancy_ewma", "cached_served")
 
     def __init__(self) -> None:
         self.in_flight = 0
@@ -48,6 +48,9 @@ class NodePressure:
         # node's drain RATE in members/second — what the shard-side shed
         # point's Little's-law bound and its Retry-After estimates run on
         self.occupancy_ewma: Optional[float] = None
+        # request-cache hits answered at intake: served traffic counted
+        # into the observation windows (see observe_cached)
+        self.cached_served = 0
 
     def observe(self, service_ms: float, members: int = 1) -> None:
         s = max(float(service_ms), 0.0)
@@ -57,6 +60,18 @@ class NodePressure:
         self.occupancy_ewma = m if self.occupancy_ewma is None else \
             self.ALPHA * m + (1 - self.ALPHA) * self.occupancy_ewma
         self.observations += 1
+
+    def observe_cached(self) -> None:
+        """A request-cache hit served at intake IS served traffic: it
+        counts into the pressure tracker's observation windows — without
+        consuming a queued-member slot, and without folding its near-zero
+        host time into the DRAIN-measured service/occupancy EWMAs. Those
+        EWMAs size the member bound (drain_rate x target latency) for
+        work that actually queues; letting sub-millisecond hits inflate
+        the drain rate would over-admit the very members a hot duplicate
+        flood arrives alongside."""
+        self.observations += 1
+        self.cached_served += 1
 
     def drain_rate_per_s(self) -> float:
         """Drain-measured throughput estimate: members served per second

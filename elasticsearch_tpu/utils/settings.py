@@ -479,6 +479,59 @@ SEARCH_SHARD_QUEUE_TARGET_LATENCY: Setting[float] = Setting.time_setting(
     "search.shard.queue_target_latency", "1s",
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
+# ---------------------------------------------------------------------------
+# request cache (indices/request_cache.py — IndicesRequestCache analog)
+# ---------------------------------------------------------------------------
+
+# master switch over BOTH tiers (the shard result cache and the
+# coordinator fused-result cache); false restores uncached serving
+# byte-for-byte and clears resident entries (typed "disabled")
+SEARCH_REQUEST_CACHE_ENABLED: Setting[bool] = Setting.bool_setting(
+    "search.request_cache.enabled", True,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# coverage gate for the top-k shapes (text/kNN/sparse hits+totals with
+# size>0): size=0 bodies — counts, aggregation dashboards — always
+# cache while the tier is enabled (the reference's default coverage);
+# size>0 caches fleet-wide when this is true, or per request via
+# ``"request_cache": true`` in the body (the reference's
+# ``?request_cache=true`` opt-in)
+SEARCH_REQUEST_CACHE_TOPK: Setting[bool] = Setting.bool_setting(
+    "search.request_cache.topk", False,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# coordinator fused-result tier toggle: identical co-located fan-outs
+# answered from the coordinator without any shard dispatch; false keeps
+# the shard tier alone (duplicates still skip device work per shard)
+SEARCH_REQUEST_CACHE_COORDINATOR: Setting[bool] = Setting.bool_setting(
+    "search.request_cache.coordinator", True,
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# LRU eviction budget per tier: resident entries above this evict
+# coldest-first BEFORE the request_cache breaker child can trip
+SEARCH_REQUEST_CACHE_MAX_BYTES: Setting[int] = Setting.bytes_setting(
+    "search.request_cache.max_bytes", "32mb",
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# per-entry cap: one pathological response (deep aggs over a huge key
+# space) must not evict the whole hot set to cache itself
+SEARCH_REQUEST_CACHE_MAX_ENTRY_BYTES: Setting[int] = Setting.bytes_setting(
+    "search.request_cache.max_entry_bytes", "1mb",
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+# Adaptive per-copy shard-query transport timeout (the PR 13 recorded
+# leg): the flat 60s becomes min(ceiling, max(floor, 30x the copy's ARS
+# response EWMA)), further bounded by the request's own [timeout]
+# budget — a stalled copy fails over in RTT-scale time instead of
+# waiting out a minute. Unknown copies (no EWMA yet) keep the ceiling.
+SEARCH_SHARD_QUERY_TIMEOUT_FLOOR: Setting[float] = Setting.time_setting(
+    "search.shard.query_timeout.floor", "2s",
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+SEARCH_SHARD_QUERY_TIMEOUT_CEILING: Setting[float] = Setting.time_setting(
+    "search.shard.query_timeout.ceiling", "60s",
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
 # C3 adaptive replica selection (OperationRouting.USE_ADAPTIVE_REPLICA_
 # SELECTION_SETTING analog): false restores pure round-robin rotation
 # of shard copies — the chaos suite's baseline for the reroute proof.

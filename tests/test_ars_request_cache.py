@@ -64,6 +64,12 @@ def _ok(resp, err):
 
 def test_request_cache_hits_and_invalidates(cluster):
     client = cluster.client()
+    # this test pins the SHARD tier's stat semantics; the coordinator
+    # fused-result tier (enabled by default, tested in
+    # test_coordinator_cache.py) would otherwise answer the duplicate
+    # before it ever reaches the shard
+    _ok(*cluster.call(lambda cb: client.cluster_update_settings(
+        {"persistent": {"search.request_cache.coordinator": False}}, cb)))
     _ok(*cluster.call(lambda cb: client.create_index("rc", {
         "settings": {"number_of_shards": 1, "number_of_replicas": 0},
         "mappings": {"properties": {"body": {"type": "text"},

@@ -1,0 +1,463 @@
+"""Shard-tier request cache: generation stamps, golden parity, typed
+invalidation, breaker budget (indices/request_cache.py ShardRequestCache).
+
+Contracts under test:
+
+- every query class (text top-k, kNN, sparse, aggregations/dense)
+  serves CACHED responses byte-identical (modulo took) to uncached
+  execution, across refresh / delete / update / merge generations,
+  CHAOS_SEEDS-swept;
+- coverage follows the reference: size=0 always (while enabled), the
+  top-k shapes behind ``search.request_cache.topk`` or the per-request
+  ``"request_cache": true`` opt-in; ``false`` opts out;
+- invalidation is TYPED at the engine source (refresh / delete / merge
+  / restore) and the "unknown" cause stays pinned at zero;
+- entries are charged to the ``request_cache`` breaker child with LRU
+  eviction under ``search.request_cache.max_bytes``; a starved breaker
+  refuses NEW entries (typed) while serving uncached-identically;
+- an intake hit is served traffic: it counts into the NodePressure
+  observation windows and carries the took/pressure piggyback, without
+  consuming a queued-member slot.
+
+The coordinator fused-result tier is disabled here (its own contracts
+live in test_coordinator_cache.py) so duplicates genuinely reach the
+shard tier.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.indices.breaker import BREAKERS
+from elasticsearch_tpu.testing import InProcessCluster
+
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+pytestmark = pytest.mark.cache
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _strip(resp):
+    return {k: v for k, v in resp.items()
+            if k not in ("took", "_data_plane")}
+
+
+def _settings(c, values):
+    _ok(*c.call(lambda cb: c.client().cluster_update_settings(
+        {"persistent": values}, cb)))
+
+
+def _search(c, index, body):
+    return _ok(*c.call(lambda cb: c.client().search(
+        index, json.loads(json.dumps(body)), cb)))
+
+
+def _cached_vs_uncached(c, index, body):
+    """The golden contract: the (potentially cached) response equals the
+    per-request-opted-out uncached execution, modulo took."""
+    got = _strip(_search(c, index, body))
+    uncached = _strip(_search(c, index, {**body, "request_cache": False}))
+    assert got == uncached, (got, uncached)
+    return got
+
+
+def _build_cluster(seed, docs=60):
+    c = InProcessCluster(n_nodes=1, seed=seed)
+    c.start()
+    client = c.client()
+    _ok(*c.call(lambda cb: client.create_index("rcx", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "body": {"type": "text"},
+            "brand": {"type": "keyword"},
+            "price": {"type": "integer"},
+            "vec": {"type": "dense_vector", "dims": 8},
+            "feats": {"type": "rank_features"}}}}, cb)))
+    c.ensure_green("rcx")
+    rng = np.random.default_rng(seed)
+    for i in range(docs):
+        doc = {"body": " ".join(f"w{int(x)}"
+                                for x in rng.integers(0, 24, 8)),
+               "brand": f"b{i % 4}", "price": int(rng.integers(1, 90)),
+               "vec": [float(x) for x in rng.standard_normal(8)],
+               "feats": {f"f{int(x)}": float(rng.uniform(0.1, 2.0))
+                         for x in rng.integers(0, 12, 4)}}
+        _ok(*c.call(lambda cb, i=i, d=doc: client.index_doc(
+            "rcx", f"d{i}", d, cb)))
+        if i in (docs // 3, 2 * docs // 3):
+            c.call(lambda cb: client.refresh("rcx", cb))
+    c.call(lambda cb: client.refresh("rcx", cb))
+    # shard tier under test: full coverage on, coordinator tier off
+    _settings(c, {"search.request_cache.topk": True,
+                  "search.request_cache.coordinator": False})
+    return c
+
+
+def _class_bodies(rng):
+    w = lambda: f"w{int(rng.integers(0, 24))}"  # noqa: E731
+    return {
+        "text": {"query": {"match": {"body": f"{w()} {w()}"}}, "size": 6,
+                 "track_total_hits": True},
+        "knn": {"query": {"knn": {
+            "field": "vec", "k": 5, "num_candidates": 40,
+            "query_vector": [float(x)
+                             for x in rng.standard_normal(8)]}},
+            "size": 5},
+        "sparse": {"query": {"text_expansion": {"feats": {"tokens": {
+            f"f{int(rng.integers(0, 12))}": 1.0,
+            f"f{int(rng.integers(0, 12))}": 0.5}}}}, "size": 5},
+        "aggs": {"size": 0, "query": {"match": {"body": w()}},
+                 "aggs": {"brands": {"terms": {"field": "brand"}},
+                          "p": {"avg": {"field": "price"}}}},
+    }
+
+
+# ---------------------------------------------------------------------------
+# golden parity across generations, every query class
+# ---------------------------------------------------------------------------
+
+def _generation_sweep(seed):
+    c = _build_cluster(seed)
+    try:
+        client = c.client()
+        rc = c.nodes["node0"].search_transport.request_cache
+        bodies = _class_bodies(np.random.default_rng(seed + 1))
+
+        # generation 1: fill, then hit — byte-identical to uncached
+        first = {n: _cached_vs_uncached(c, "rcx", b)
+                 for n, b in bodies.items()}
+        hits0 = rc.stats["hits"]
+        for name, body in bodies.items():
+            assert _cached_vs_uncached(c, "rcx", body) == first[name]
+        assert rc.stats["hits"] > hits0
+
+        # refresh generation: new doc becomes visible to every class
+        _ok(*c.call(lambda cb: client.index_doc("rcx", "fresh", {
+            "body": "w1 w2 w3", "brand": "b0", "price": 7,
+            "vec": [0.5] * 8, "feats": {"f1": 1.5}}, cb)))
+        c.call(lambda cb: client.refresh("rcx", cb))
+        for body in bodies.values():
+            _cached_vs_uncached(c, "rcx", body)
+        assert rc.invalidations_by_cause.get("refresh", 0) > 0
+
+        # delete generation: the doc disappears again — the fresh-doc
+        # hit must not survive in any class's cached response
+        _ok(*c.call(lambda cb: client.delete_doc("rcx", "fresh", cb)))
+        c.call(lambda cb: client.refresh("rcx", cb))
+        for name, body in bodies.items():
+            got = _cached_vs_uncached(c, "rcx", body)
+            assert "fresh" not in {h["_id"] for h in
+                                   got["hits"]["hits"]}, name
+        assert rc.invalidations_by_cause.get("delete", 0) > 0
+
+        # update generation (tombstone + new copy -> the delete cause)
+        _ok(*c.call(lambda cb: client.index_doc("rcx", "d0", {
+            "body": "w1 w1 w1", "brand": "b3", "price": 1,
+            "vec": [1.0] * 8, "feats": {"f2": 2.0}}, cb)))
+        c.call(lambda cb: client.refresh("rcx", cb))
+        for body in bodies.values():
+            _cached_vs_uncached(c, "rcx", body)
+
+        # merge generation: force_merge purges deletes, docs unchanged
+        _ok(*c.call(lambda cb: client.force_merge("rcx", cb)))
+        merged = {n: _cached_vs_uncached(c, "rcx", b)
+                  for n, b in bodies.items()}
+        assert rc.invalidations_by_cause.get("merge", 0) > 0
+        # and a duplicate after the merge serves the same bytes again
+        for name, body in bodies.items():
+            assert _cached_vs_uncached(c, "rcx", body) == merged[name]
+
+        # the typed taxonomy is complete: no unknown causes, ever
+        assert rc.invalidations_by_cause.get("unknown", 0) == 0
+    finally:
+        c.stop()
+
+
+@pytest.mark.parametrize("seed", [211 + 709 * k for k in range(CHAOS_SEEDS)])
+def test_golden_parity_across_generations(seed):
+    _generation_sweep(seed)
+
+
+@pytest.mark.slow
+def test_generation_parity_seed_sweep():
+    for k in range(max(CHAOS_SEEDS, 5)):
+        _generation_sweep(211 + 709 * k)
+
+
+# ---------------------------------------------------------------------------
+# coverage gates
+# ---------------------------------------------------------------------------
+
+def test_topk_gate_and_per_request_optin():
+    c = _build_cluster(331)
+    try:
+        rc = c.nodes["node0"].search_transport.request_cache
+        body = {"query": {"match": {"body": "w3 w4"}}, "size": 5}
+        _settings(c, {"search.request_cache.topk": False})
+        puts0 = rc.stats["puts"]
+        _search(c, "rcx", body)
+        _search(c, "rcx", body)
+        assert rc.stats["puts"] == puts0      # size>0 not covered
+        # per-request opt-in covers THIS request without the fleet gate
+        first = _strip(_search(c, "rcx", {**body, "request_cache": True}))
+        assert rc.stats["puts"] == puts0 + 1
+        hits0 = rc.stats["hits"]
+        again = _strip(_search(c, "rcx", {**body, "request_cache": True}))
+        assert rc.stats["hits"] == hits0 + 1
+        assert {k: v for k, v in again.items() if k != "took"} == \
+            {k: v for k, v in first.items() if k != "took"}
+        # size=0 is default coverage; request_cache:false opts out
+        zero = {"size": 0, "query": {"match": {"body": "w3"}}}
+        puts1 = rc.stats["puts"]
+        _search(c, "rcx", zero)
+        assert rc.stats["puts"] == puts1 + 1
+        hits1 = rc.stats["hits"]
+        _search(c, "rcx", {**zero, "request_cache": False})
+        assert rc.stats["hits"] == hits1
+        # master switch: disabled clears resident entries, typed
+        _settings(c, {"search.request_cache.enabled": False})
+        _search(c, "rcx", zero)   # applies the setting on the shard path
+        assert rc.stats["puts"] == puts1 + 1
+        assert len(rc._entries) == 0
+        assert rc.invalidations_by_cause.get("disabled", 0) > 0
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# breaker budget: starved cache refuses entries, serves identically
+# ---------------------------------------------------------------------------
+
+def test_breaker_starved_cache_serves_uncached_identically():
+    c = _build_cluster(433)
+    try:
+        rc = c.nodes["node0"].search_transport.request_cache
+        breaker = BREAKERS.breaker("request_cache")
+        old_limit = breaker.limit
+        BREAKERS.configure(request_cache=1)   # nothing fits
+        try:
+            body = {"size": 0, "query": {"match": {"body": "w5"}},
+                    "aggs": {"b": {"terms": {"field": "brand"}}}}
+            refused0 = rc.stats["entries_refused"]
+            r1 = _strip(_search(c, "rcx", body))
+            r2 = _strip(_search(c, "rcx", body))
+            assert r1 == r2
+            assert rc.stats["entries_refused"] > refused0
+            assert len(rc._entries) == 0
+        finally:
+            BREAKERS.configure(request_cache=old_limit)
+        # budget restored: caching resumes
+        body2 = {"size": 0, "query": {"match": {"body": "w6"}}}
+        hits0 = rc.stats["hits"]
+        _search(c, "rcx", body2)
+        _search(c, "rcx", body2)
+        assert rc.stats["hits"] == hits0 + 1
+    finally:
+        c.stop()
+
+
+def test_lru_eviction_under_max_bytes():
+    c = _build_cluster(541)
+    try:
+        rc = c.nodes["node0"].search_transport.request_cache
+        _settings(c, {"search.request_cache.max_bytes": 600})
+        for i in range(8):
+            _search(c, "rcx", {"size": 0,
+                               "query": {"match": {"body": f"w{i}"}}})
+        assert rc.stats["evictions"] > 0
+        assert rc._resident["bytes"] <= 600
+        # the breaker charge tracks residency, not history
+        assert rc._resident["bytes"] >= 0
+    finally:
+        c.stop()
+
+
+def test_oversize_entry_refused():
+    c = _build_cluster(547)
+    try:
+        rc = c.nodes["node0"].search_transport.request_cache
+        _settings(c, {"search.request_cache.max_entry_bytes": 16})
+        before = rc.stats["oversize_refused"]
+        _search(c, "rcx", {"size": 0,
+                           "query": {"match": {"body": "w1"}},
+                           "aggs": {"b": {"terms": {"field": "brand"}}}})
+        assert rc.stats["oversize_refused"] > before
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# intake hits are served traffic (the shed-point accounting fix)
+# ---------------------------------------------------------------------------
+
+def test_intake_hit_counts_into_pressure_and_carries_piggyback():
+    c = _build_cluster(641)
+    try:
+        batcher = c.nodes["node0"].search_transport.batcher
+        req = {"index": "rcx", "shard": 0, "window": 0,
+               "body": {"query": {"match": {"body": "w2"}}}}
+        first = batcher.enqueue(dict(req))
+        assert not isinstance(first, dict)
+        got = []
+        first._subscribe(lambda v: got.append(v), lambda e: got.append(e))
+        key = next(k for k, q in batcher._queues.items() if q)
+        batcher._drain(key)
+        assert got and isinstance(got[0], dict)
+
+        obs0 = batcher.node_pressure.observations
+        cached0 = batcher.node_pressure.cached_served
+        in_flight0 = batcher.node_pressure.in_flight
+        hit = batcher.enqueue(dict(req))
+        assert isinstance(hit, dict)
+        # served traffic: observation windows move, the response carries
+        # the same took/pressure piggyback a drained member's would —
+        # but no queued-member slot was consumed
+        assert batcher.node_pressure.observations == obs0 + 1
+        assert batcher.node_pressure.cached_served == cached0 + 1
+        assert batcher.node_pressure.in_flight == in_flight0
+        assert "pressure" in hit and "took_ms" in hit
+        assert not any(batcher._queues.values())
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# window>0 hits still fetch correctly (fresh pinned context per hit)
+# ---------------------------------------------------------------------------
+
+def test_topk_hit_fetch_phase_pins_fresh_context():
+    c = _build_cluster(733)
+    try:
+        sts = c.nodes["node0"].search_transport
+        body = {"query": {"match": {"body": "w1 w7"}}, "size": 4}
+        r1 = _strip(_search(c, "rcx", body))
+        n_ctx = len(sts._contexts)
+        r2 = _strip(_search(c, "rcx", body))
+        assert r2 == r1
+        # the hit minted (and fetch released) its own context — the
+        # stored row never carries one
+        assert len(sts._contexts) <= n_ctx + 1
+        for entry in sts.request_cache._entries.values():
+            assert entry["row"].get("context_id") is None
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# stats surface
+# ---------------------------------------------------------------------------
+
+def test_nodes_stats_request_cache_section():
+    c = _build_cluster(839)
+    try:
+        body = {"size": 0, "query": {"match": {"body": "w1"}}}
+        _search(c, "rcx", body)
+        _search(c, "rcx", body)
+        section = c.nodes["node0"].local_node_stats(
+            sections=["request_cache"])["request_cache"]
+        for field in ("hits", "misses", "evictions",
+                      "invalidations_by_cause", "resident_bytes",
+                      "entries", "entries_refused", "intake_hits",
+                      "coordinator_hits", "coordinator_misses"):
+            assert field in section, field
+        assert section["hits"] >= 1
+        assert section["invalidations_by_cause"].get("unknown", 0) == 0
+    finally:
+        c.stop()
+
+
+def test_string_request_cache_directive_normalized():
+    """The reference's ``?request_cache=false`` STRING form must read as
+    an opt-out, never as a truthy opt-in (review-hardened)."""
+    from elasticsearch_tpu.indices.request_cache import ShardRequestCache
+    rc = ShardRequestCache()
+    assert rc.covers({"request_cache": False}, 10) is False
+    assert rc.covers({"request_cache": "false"}, 10) is False
+    assert rc.covers({"request_cache": "false"}, 0) is False
+    assert rc.covers({"request_cache": "true"}, 10) is True
+    assert rc.covers({"request_cache": True}, 10) is True
+    # an unrecognized string neither opts in nor out
+    assert rc.covers({"request_cache": "maybe"}, 10) is False
+    assert rc.covers({"request_cache": "maybe"}, 0) is True
+
+
+def test_cache_hit_served_even_at_member_bound():
+    """The cache consult runs BEFORE the shard shed point: a hit
+    consumes no queued-member slot, so an overloaded node serves the
+    hot head of a duplicate flood for free instead of 429ing it into a
+    coordinator failover round."""
+    c = _build_cluster(941, docs=12)
+    try:
+        batcher = c.nodes["node0"].search_transport.batcher
+        req = {"index": "rcx", "shard": 0, "window": 0,
+               "body": {"query": {"match": {"body": "w1"}}}}
+        first = batcher.enqueue(dict(req))
+        got = []
+        first._subscribe(lambda v: got.append(v), lambda e: got.append(e))
+        key = next(k for k, q in batcher._queues.items() if q)
+        batcher._drain(key)
+        assert got and isinstance(got[0], dict)
+        # saturate the member bound artificially
+        _settings(c, {"search.shard.max_queued_members": 1})
+        batcher.node_pressure.in_flight = 5
+        try:
+            from elasticsearch_tpu.utils.errors import ShardBusyError
+            import pytest as _pytest
+            # an uncacheable arrival sheds...
+            with _pytest.raises(ShardBusyError):
+                batcher.enqueue({"index": "rcx", "shard": 0, "window": 3,
+                                 "body": {"query": {"match": {
+                                     "body": "w9"}}}})
+            # ...the cached duplicate is served
+            hit = batcher.enqueue(dict(req))
+            assert isinstance(hit, dict)
+        finally:
+            batcher.node_pressure.in_flight = 0
+    finally:
+        c.stop()
+
+
+def test_straggler_fill_never_purges_newer_generation():
+    """Generations are globally monotonic: a drain whose reader lags the
+    engine (a refresh landed between its acquisition and its fill) must
+    neither purge forward-generation entries, regress the recorded
+    generation, nor insert a dead entry — and a stale PROBE misses
+    without dropping the newer entry (review-hardened regression)."""
+    from elasticsearch_tpu.indices.request_cache import ShardRequestCache
+    rc = ShardRequestCache()
+    sk = ("i", 0)
+    rc.put(sk, 6, "k2", {"total": 1}, cause="refresh")
+    rc.put(sk, 5, "k3", {"total": 0}, cause="refresh")   # straggler fill
+    assert rc.get(sk, 6, "k2", cause="refresh") == {"total": 1}
+    assert rc.invalidations_by_cause == {}
+    assert ((sk, "k3")) not in rc._entries   # the stale row never lands
+    # a stale probe (drain reader pre-dating a refresh) misses without
+    # touching the newer entry
+    assert rc.get(sk, 5, "k2", cause="refresh") is None
+    assert rc.get(sk, 6, "k2", cause="refresh") == {"total": 1}
+    # a genuinely NEWER generation still purges, typed
+    rc.note_generation(sk, 7, "delete")
+    assert (sk, "k2") not in rc._entries
+    assert rc.invalidations_by_cause == {"delete": 1}
+
+
+def test_merge_request_cache_sections():
+    from elasticsearch_tpu.indices.request_cache import (
+        merge_request_cache_sections,
+    )
+    merged = merge_request_cache_sections([
+        {"hits": 2, "invalidations_by_cause": {"refresh": 1},
+         "coordinator_hits": 1},
+        {"hits": 3, "invalidations_by_cause": {"refresh": 2,
+                                               "delete": 1}},
+        {},
+    ])
+    assert merged["hits"] == 5
+    assert merged["coordinator_hits"] == 1
+    assert merged["invalidations_by_cause"] == {"delete": 1, "refresh": 3}

@@ -169,18 +169,23 @@ def test_enabled_false_is_window_zero_same_path(cluster):
     _ok(*c.call(lambda cb: client.cluster_update_settings(
         {"persistent": {"search.batch.enabled": False}}, cb)))
     try:
+        fused = c.nodes["node0"].search_action.fused_cache
         before = dict(batcher.stats)
+        fused_before = fused.stats["hits"]
         for name, body in shapes.items():
             got = _strip(_ok(*c.call(
                 lambda cb, b=body: client.search(
                     "ux", json.loads(json.dumps(b)), cb))))
             assert got == enabled[name], name
         # every shape still rode the batcher (the size-0 suggest shape
-        # may answer from the request cache at intake instead)
+        # may answer from a request-cache tier instead: the batcher's
+        # intake consult, or the coordinator fused-result cache before
+        # the shard is even dispatched)
         served = (batcher.stats["queries_dispatched"]
                   - before["queries_dispatched"]) + \
                  (batcher.stats["request_cache_intake_hits"]
-                  - before["request_cache_intake_hits"])
+                  - before["request_cache_intake_hits"]) + \
+                 (fused.stats["hits"] - fused_before)
         assert served >= len(shapes)
     finally:
         _ok(*c.call(lambda cb: client.cluster_update_settings(
