@@ -2012,6 +2012,32 @@ def multichip_scaling(per_shard_docs: int = 0, q_batch: int = 8,
     return out
 
 
+def cfg_recovery(np, jax, jnp, result):
+    """Recovery-under-load scenario (the ops-based catch-up contract):
+    a rolling restart of replica-holding nodes mid-traffic — writes and
+    searches keep flowing while each victim reboots over its own data
+    path. The acceptance contract rides the block: every lease-covered
+    restarted copy recovers OPS-BASED (zero wipe-and-copy), zero acked
+    writes lost, zero wrong hits, and the typed file-fallback taxonomy's
+    "unknown" bucket pinned at zero. All timing virtual except the
+    restart wall clock: seed-reproducible."""
+    import shutil
+    import tempfile
+
+    from elasticsearch_tpu.testing import rolling_restart_recovery_scenario
+    path = tempfile.mkdtemp(prefix="bench_recovery_")
+    try:
+        s = rolling_restart_recovery_scenario(SEED + 17, path)
+    finally:
+        shutil.rmtree(path, ignore_errors=True)
+    s["zero_wipe_recoveries"] = s["wipe_recoveries_on_restarted"] == 0
+    s["zero_lost_acked"] = s["lost_acked_docs"] == 0
+    s["zero_wrong_hits"] = s["wrong_hits"] == 0
+    s["zero_unknown_fallbacks"] = s["unknown_fallbacks"] == 0
+    s["ops_based_engaged"] = bool(s["ops_based_recoveries"] >= 1)
+    result["configs"]["recovery"] = s
+
+
 def cfg_multichip(np, jax, jnp, result):
     """MULTICHIP scenario: runs inline when this process already sees
     >= 2 devices (a TPU slice), else re-execs itself over 8 virtual CPU
@@ -2110,6 +2136,7 @@ def main() -> None:
                          ("overload", cfg_overload),
                          ("fleet", cfg_fleet),
                          ("zipf_cache", cfg_zipf_cache),
+                         ("recovery", cfg_recovery),
                          ("multichip", cfg_multichip)):
             try:
                 if name == "hybrid":

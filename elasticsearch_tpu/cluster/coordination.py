@@ -485,20 +485,28 @@ class Coordinator:
             lambda: self._publication_failed(term, state.version, committed))
 
         def on_ack(resp, err, target: str) -> None:
-            if err is not None or resp is None or committed["done"]:
-                if isinstance(resp, dict) and resp.get("need_full"):
-                    # retry that node with the full state
-                    self.ts.send_request(
-                        target, PUBLISH,
-                        {"term": term, "state": state.to_dict()},
-                        lambda r, e, t=target: on_ack(r, e, t),
-                        timeout=self.settings.publish_timeout)
-                return
-            if resp.get("need_full"):
+            if isinstance(resp, dict) and resp.get("need_full"):
+                # retry that node with the full state
                 self.ts.send_request(
-                    target, PUBLISH, {"term": term, "state": state.to_dict()},
+                    target, PUBLISH,
+                    {"term": term, "state": state.to_dict()},
                     lambda r, e, t=target: on_ack(r, e, t),
                     timeout=self.settings.publish_timeout)
+                return
+            if err is not None or resp is None:
+                return
+            if committed["done"]:
+                # late ack after the quorum commit fan-out already went
+                # out — typical for a rebooted follower whose diff came
+                # back need_full and whose full-state retry cost an
+                # extra round-trip. Without a commit of its own, that
+                # follower is left accepted-but-never-applied, and
+                # catch-up can't heal it (its re-publish of the same
+                # version is rejected as not-newer-than-accepted).
+                self.ts.send_request(target, COMMIT,
+                                     {"term": term,
+                                      "version": state.version},
+                                     lambda r, e: None, timeout=30.0)
                 return
             if self.state.handle_publish_response(resp):
                 committed["done"] = True
@@ -692,11 +700,20 @@ class Coordinator:
             return  # our first publication hasn't committed yet
 
         def on_ack(r, e) -> None:
-            if e is None and r is not None and not r.get("need_full"):
-                self.ts.send_request(peer, COMMIT,
-                                     {"term": state.term,
-                                      "version": state.version},
-                                     lambda r2, e2: None, timeout=30.0)
+            if e is None and r is not None and r.get("need_full"):
+                return
+            # send the commit even when the publish was REJECTED: a
+            # follower that already ACCEPTED this exact (term, version)
+            # but missed only the commit round (reboot raced a
+            # diff->need_full->full retry against the commit fan-out)
+            # rejects the re-publish as not-newer-than-accepted — the
+            # commit is precisely what it is missing. handle_commit
+            # validates the (term, version) match, so an unconditional
+            # send is safe; a genuine mismatch just errors out remotely.
+            self.ts.send_request(peer, COMMIT,
+                                 {"term": state.term,
+                                  "version": state.version},
+                                 lambda r2, e2: None, timeout=30.0)
         self.ts.send_request(peer, PUBLISH,
                              {"term": self.state.current_term,
                               "state": state.to_dict()},

@@ -262,6 +262,7 @@ class GatewayAllocator:
             "reconcile_failures": 0, "recoveries_cancelled": 0,
             "fallback_empty_allocations": 0,
             "grace_released_fleet_complete": 0,
+            "lease_covered_allocations": 0,
         }
         self.ts.register_handler(GATEWAY_STARTED_SHARDS,
                                  self._on_list_started_shards)
@@ -299,6 +300,18 @@ class GatewayAllocator:
                     max_seqno=shard.engine.tracker.max_seqno,
                     local_checkpoint=shard.engine.tracker.checkpoint,
                     verified=True)
+                if shard.primary and shard.tracker is not None:
+                    # lease/history watermarks ride the fetch: the
+                    # allocator can prefer replica nodes this primary
+                    # still retains ops-based catch-up history for
+                    info.update(
+                        primary=True,
+                        lease_nodes=sorted(
+                            lease.id.split("/", 1)[1]
+                            for lease in shard.tracker.leases()
+                            if lease.id.startswith("peer_recovery/")),
+                        history_floor=shard.engine.history_stats()[
+                            "history_min_seqno"])
                 return info
         disk = self.indices.local_shard_state(index_uuid, sid)
         if disk is not None:
@@ -759,28 +772,50 @@ class GatewayAllocator:
         data_nodes = state.data_nodes()
         corrupted = [i for i in data.values()
                      if i.get("has_data") and i.get("corrupted")]
-        viable: List[Tuple[bool, int, int, str]] = []
+        # for a REPLICA, the live primary's fetched entry carries its
+        # lease/history watermarks: a candidate node whose copy is still
+        # lease-covered (checkpoint+1 inside the primary's retained
+        # history) recovers ops-based — prefer it over a fresher-looking
+        # copy that would pay the wipe (ReplicaShardAllocator's
+        # matching-files preference, op-shaped)
+        lease_nodes: Set[str] = set()
+        history_floor: Optional[int] = None
+        if not shard.primary:
+            for info in data.values():
+                if info.get("live") and info.get("primary"):
+                    lease_nodes = set(info.get("lease_nodes") or [])
+                    history_floor = info.get("history_floor")
+                    break
+        viable: List[Tuple[bool, bool, int, int, str]] = []
         for nid in sorted(data):
             info = data[nid]
             if nid not in data_nodes or not info.get("has_data") or \
                     info.get("corrupted"):
                 continue
+            lease_covered = nid in lease_nodes and (
+                history_floor is None or
+                int(info.get("local_checkpoint", -1) or -1) + 1 >=
+                int(history_floor))
             viable.append((
                 info.get("allocation_id") is not None and
                 info.get("allocation_id") == shard.last_allocation_id,
+                lease_covered,
                 int(info.get("max_seqno", -1) or -1),
                 int(info.get("generation", -1) or -1),
                 nid))
-        # freshest first: identity match, then seqno, then commit
-        # generation; node id breaks ties deterministically
-        viable.sort(key=lambda t: (not t[0], -t[1], -t[2], t[3]))
+        # freshest first: identity match, then lease coverage, then
+        # seqno, then commit generation; node id breaks ties
+        # deterministically
+        viable.sort(key=lambda t: (not t[0], not t[1], -t[2], -t[3], t[4]))
 
         throttled = False
-        for rank, (match, seqno, gen, nid) in enumerate(viable):
+        for rank, (match, covered, seqno, gen, nid) in enumerate(viable):
             from elasticsearch_tpu.cluster.allocation import Decision
             verdict = allocation.decide(shard, data_nodes[nid], state)
             if verdict == Decision.YES:
                 self.stats["reported_stale"] += len(viable) - rank - 1
+                if covered:
+                    self.stats["lease_covered_allocations"] += 1
                 self._fallback_grace.pop(self._grace_key(shard), None)
                 return ("allocate", nid)
             if verdict == Decision.THROTTLE:

@@ -159,6 +159,25 @@ class InternalEngine:
         # match an on-disk copy to its last-known routing identity
         self.commit_extra: Dict[str, Any] = {}
         self.tracker = LocalCheckpointTracker()
+        # soft-deletes analog: a bounded seqno-indexed history of EVERY
+        # operation — index, delete tombstone, noop — so a recovery
+        # source can replay exactly the ops a returning copy missed
+        # instead of shipping the whole store
+        # (index.soft_deletes.retention.ops). Kept on replicas too: a
+        # promoted replica must be able to serve ops-based recovery.
+        self.history_retention_ops = 1024
+        self._op_history: Dict[int, Dict[str, Any]] = {}
+        self._history_min = 0        # lowest seqno possibly retained
+        # primary mode installs a supplier that folds retention leases
+        # into the prune floor (shard._retention_floor); None = the
+        # retention.ops bound alone
+        self.retention_floor_supplier: Optional[Callable[[], int]] = None
+        # primary mode installs a supplier persisting the tracker's
+        # leases into every commit; recover_from_store surfaces what the
+        # opened commit carried so the shard can restore them
+        self.commit_leases_supplier: \
+            Optional[Callable[[], List[Dict[str, Any]]]] = None
+        self.recovered_commit_extra: Dict[str, Any] = {}
 
         self._lock = threading.RLock()
         self.segments: List[Segment] = []
@@ -239,6 +258,10 @@ class InternalEngine:
             self._buffer[doc_id] = (parsed, seqno, version, primary_term)
             self._version_map[doc_id] = VersionEntry(seqno, primary_term, version)
             self.tracker.mark_processed(seqno)
+            self._history_add({"op_type": "index", "doc_id": doc_id,
+                               "source": source, "routing": routing,
+                               "seqno": seqno, "version": version,
+                               "primary_term": primary_term})
             return EngineResult(doc_id, seqno, primary_term, version,
                                 "created" if created else "updated")
 
@@ -277,6 +300,12 @@ class InternalEngine:
                 self._pending_tombstones.append(doc_id)
             self._version_map[doc_id] = VersionEntry(seqno, primary_term, version, deleted=True)
             self.tracker.mark_processed(seqno)
+            # the delete TOMBSTONE is what soft-deletes exist for: a
+            # file-less catch-up must be able to replay "doc X died at
+            # seqno N" — live-doc snapshots can't express that
+            self._history_add({"op_type": "delete", "doc_id": doc_id,
+                               "seqno": seqno, "version": version,
+                               "primary_term": primary_term})
             return EngineResult(doc_id, seqno, primary_term, version,
                                 "deleted" if found else "not_found")
 
@@ -288,6 +317,57 @@ class InternalEngine:
                                               self.primary_term,
                                               reason=reason))
             self.tracker.mark_processed(seqno)
+            self._history_add({"op_type": "noop", "seqno": seqno,
+                               "primary_term": self.primary_term,
+                               "reason": reason})
+
+    # ------------------------------------------------------------------
+    # operation history (soft-deletes analog)
+    # ------------------------------------------------------------------
+
+    def _history_floor(self) -> int:
+        """Lowest seqno the history must retain. The retention.ops bound
+        keeps the last N ops; on a primary, the retention leases fold in
+        (Engine.getMinRetainedSeqNo analog) so a tracked-but-departed
+        copy's tail outlives the count bound until its lease expires."""
+        floor = self.tracker.max_seqno - self.history_retention_ops + 1
+        if self.retention_floor_supplier is not None:
+            floor = min(floor, self.retention_floor_supplier())
+        return floor
+
+    def _history_add(self, op: Dict[str, Any]) -> None:
+        """Record a wire-form op; amortized prune below the floor (each
+        seqno is pushed and popped at most once, so the while loop is
+        O(1) amortized however far the floor jumped)."""
+        self._op_history[op["seqno"]] = op
+        floor = self._history_floor()
+        while self._history_min < floor:
+            self._op_history.pop(self._history_min, None)
+            self._history_min += 1
+
+    def ops_history_snapshot(self, from_seqno: int
+                             ) -> Tuple[List[Dict[str, Any]], bool]:
+        """(retained ops with seqno >= from_seqno in order, complete).
+        ``complete`` means every seqno in [from_seqno, max_seqno] is
+        present — the recovery source's gate for the ops-based path; any
+        hole or pruned prefix forces the file-based fallback."""
+        with self._lock:
+            max_s = self.tracker.max_seqno
+            ops: List[Dict[str, Any]] = []
+            complete = True
+            for s in range(max(0, from_seqno), max_s + 1):
+                op = self._op_history.get(s)
+                if op is None:
+                    complete = False
+                else:
+                    ops.append(op)
+            return ops, complete
+
+    def history_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"retained_ops": len(self._op_history),
+                    "history_min_seqno": self._history_min,
+                    "retention_ops_setting": self.history_retention_ops}
 
     # ------------------------------------------------------------------
     # failure handling
@@ -447,21 +527,31 @@ class InternalEngine:
             self._dirty_live.clear()
             translog_gen = self.translog.rollover() if self.translog is not None else 0
             self._commit_generation += 1
+            # the term stamps WHICH primacy's history this commit
+            # belongs to: recovery reuse must refuse a commit from an
+            # older term — the same seqno can name different ops
+            # across a failover
+            extra = {**self.commit_extra, "primary_term": self.primary_term}
+            if self.commit_leases_supplier is not None:
+                # leases ride every commit (ReplicationTracker persists
+                # them in the Lucene commit user data) so a restarted
+                # primary keeps honoring history it promised to departed
+                # copies
+                extra["retention_leases"] = self.commit_leases_supplier()
             self.store.write_commit(
                 self._commit_generation,
                 [seg.name for seg in self.segments],
                 self.tracker.max_seqno,
                 self.tracker.checkpoint,
                 translog_gen,
-                # the term stamps WHICH primacy's history this commit
-                # belongs to: recovery reuse must refuse a commit from an
-                # older term — the same seqno can name different ops
-                # across a failover
-                extra={**self.commit_extra,
-                       "primary_term": self.primary_term},
+                extra=extra,
             )
             if self.translog is not None:
-                self.translog.trim_below(translog_gen)
+                # retention-aware trim: generations still backing the op
+                # history floor survive the commit, so the history can be
+                # rebuilt after a restart
+                self.translog.trim_below(
+                    translog_gen, keep_from_seqno=self._history_floor())
             # remove orphaned segment files from superseded merges
             on_disk = set(self.store.list_segment_files())
             current = {seg.name for seg in self.segments}
@@ -630,6 +720,10 @@ class InternalEngine:
                 self._commit_generation = commit["generation"]
                 self.tracker = LocalCheckpointTracker(
                     commit["max_seqno"], commit["local_checkpoint"])
+                # surface what the opened commit carried (primary term,
+                # allocation id, persisted retention leases) so the shard
+                # layer can restore leases / report watermarks
+                self.recovered_commit_extra = dict(commit.get("extra") or {})
                 # mark seqnos persisted in segments as processed
                 for seg in self.segments:
                     for s in seg.seqnos:
@@ -648,10 +742,21 @@ class InternalEngine:
                 start = self.tracker.checkpoint + 1
                 # snapshot before replaying: _replay re-logs each op into the
                 # current generation, which read_all would otherwise also see
-                ops = list(self.translog.read_all(min_seqno=start))
+                ops = list(self.translog.read_all(min_seqno=0))
+                # ops at/below the checkpoint are already durable in
+                # segments — they only REPOPULATE the soft-delete history
+                # (retained generations survive trim for exactly this);
+                # ops above it are replayed normally (and land in the
+                # history via the write path)
                 for op in ops:
-                    self._replay(op)
-                    replayed += 1
+                    if op.seqno < start:
+                        self._history_add(_op_to_wire(op))
+                for op in ops:
+                    if op.seqno >= start:
+                        self._replay(op)
+                        replayed += 1
+                if self._op_history:
+                    self._history_min = min(self._op_history)
             # commit the replayed state so the translog is trimmed; otherwise
             # every crash/recover cycle doubles the translog (replayed ops are
             # re-logged into the new generation)
@@ -707,7 +812,7 @@ class InternalEngine:
             self.delete(op.doc_id, seqno=op.seqno, version=op.version,
                         primary_term=op.primary_term)
         elif op.op_type == "noop":
-            self.tracker.mark_processed(op.seqno)
+            self.noop(op.seqno, reason=op.reason or "")
 
     # ------------------------------------------------------------------
 
@@ -737,6 +842,21 @@ class InternalEngine:
                 # removed (close-on-failure path)
                 logger.warning("translog close failed for [%s]",
                                self.shard_label)
+
+
+def _op_to_wire(op: TranslogOp) -> Dict[str, Any]:
+    """TranslogOp -> the wire-form dict the recovery protocol replays
+    (the same shape snapshot_ops and the history emit)."""
+    d: Dict[str, Any] = {"op_type": op.op_type, "seqno": op.seqno,
+                         "primary_term": op.primary_term}
+    if op.op_type == "index":
+        d.update(doc_id=op.doc_id, source=op.source, routing=op.routing,
+                 version=op.version)
+    elif op.op_type == "delete":
+        d.update(doc_id=op.doc_id, version=op.version)
+    else:
+        d["reason"] = op.reason or ""
+    return d
 
 
 def _insert_merged(merged: Segment, original: List[Segment],

@@ -67,6 +67,25 @@ class RetentionLease:
     timestamp: float
     source: str
 
+    def to_dict(self) -> Dict[str, object]:
+        """Commit-persistable form. The monotonic timestamp is NOT
+        portable across process restarts, so it is deliberately dropped;
+        a restored lease gets a fresh clock (restart leniency — the
+        reference re-syncs lease timestamps from the primary too)."""
+        return {"id": self.id, "retaining_seqno": self.retaining_seqno,
+                "source": self.source}
+
+
+PEER_RECOVERY_LEASE_SOURCE = "peer_recovery"
+
+
+def peer_lease_id(node_id: str) -> str:
+    """Retention leases are NODE-keyed (ReplicationTracker.
+    getPeerRecoveryRetentionLeaseId): allocation ids change on every
+    recovery, but the history a returning copy needs lives with the
+    node that holds its disk."""
+    return f"{PEER_RECOVERY_LEASE_SOURCE}/{node_id}"
+
 
 class ReplicationTracker:
     """Primary-side replication group bookkeeping.
@@ -87,13 +106,39 @@ class ReplicationTracker:
         self._leases: Dict[str, RetentionLease] = {}
         self._lease_retention = lease_retention_seconds
         self.primary_mode = True
+        # allocation id -> lease id: the renewal hook. When a tracked
+        # copy's local checkpoint advances (replica acks riding back
+        # through action/replication.py), its lease is renewed to
+        # checkpoint+1 — the next op that copy still needs.
+        self._lease_of_alloc: Dict[str, str] = {}
+        self.leases_expired_total = 0
+        # the primary retains its own history too (its lease never
+        # expires while it IS the primary — see expire_leases)
+        self._own_lease_id = peer_lease_id(shard_allocation_id)
+        self._lease_of_alloc[shard_allocation_id] = self._own_lease_id
+        self.add_lease(self._own_lease_id, local_tracker.checkpoint + 1,
+                       PEER_RECOVERY_LEASE_SOURCE)
 
     # -- membership ------------------------------------------------------
 
-    def init_tracking(self, allocation_id: str) -> None:
-        """A new copy starts recovery: track it, not yet in-sync."""
+    def init_tracking(self, allocation_id: str,
+                      lease_id: Optional[str] = None,
+                      retaining_seqno: Optional[int] = None) -> None:
+        """A new copy starts recovery: track it, not yet in-sync. When a
+        lease id is given (the peer-recovery source handler passes the
+        target NODE's lease id), a retention lease is created — or an
+        existing one renewed — retaining from ``retaining_seqno``, and
+        the copy's checkpoint advances keep renewing it from then on."""
         self._tracked.add(allocation_id)
         self._checkpoints.setdefault(allocation_id, NO_OPS_PERFORMED)
+        if lease_id is not None:
+            retaining = max(0, retaining_seqno or 0)
+            self._lease_of_alloc[allocation_id] = lease_id
+            if lease_id in self._leases:
+                self.renew_lease(lease_id, retaining)
+            else:
+                self.add_lease(lease_id, retaining,
+                               PEER_RECOVERY_LEASE_SOURCE)
 
     def mark_in_sync(self, allocation_id: str, local_checkpoint: int) -> None:
         """Promote a tracked copy to in-sync. The copy must have caught up to
@@ -108,6 +153,7 @@ class ReplicationTracker:
         self._checkpoints[allocation_id] = local_checkpoint
         self._tracked.add(allocation_id)
         self._in_sync.add(allocation_id)
+        self._renew_for_alloc(allocation_id, local_checkpoint + 1)
         self._recompute_global()
 
     def remove_copy(self, allocation_id: str) -> None:
@@ -116,6 +162,10 @@ class ReplicationTracker:
         self._in_sync.discard(allocation_id)
         self._tracked.discard(allocation_id)
         self._checkpoints.pop(allocation_id, None)
+        # the LEASE deliberately survives the copy's removal: that is the
+        # entire point of retention leases — history for a departed copy
+        # is held until the lease expires, so its return can be ops-based
+        self._lease_of_alloc.pop(allocation_id, None)
         self._recompute_global()
 
     @property
@@ -128,10 +178,20 @@ class ReplicationTracker:
         prev = self._checkpoints.get(allocation_id, NO_OPS_PERFORMED)
         if checkpoint > prev:
             self._checkpoints[allocation_id] = checkpoint
+            # renewal rides the checkpoint advance (the replica's ack on
+            # every replicated write): the copy provably holds everything
+            # up to `checkpoint`, so its lease only needs checkpoint+1 on
+            self._renew_for_alloc(allocation_id, checkpoint + 1)
             self._recompute_global()
+
+    def _renew_for_alloc(self, allocation_id: str, retaining: int) -> None:
+        lid = self._lease_of_alloc.get(allocation_id)
+        if lid is not None and lid in self._leases:
+            self.renew_lease(lid, retaining)
 
     def _recompute_global(self) -> None:
         self._checkpoints[self.allocation_id] = self.local.checkpoint
+        self._renew_for_alloc(self.allocation_id, self.local.checkpoint + 1)
         if not self._in_sync:
             return
         new_global = min(self._checkpoints.get(a, NO_OPS_PERFORMED) for a in self._in_sync)
@@ -165,18 +225,57 @@ class ReplicationTracker:
         self._leases.pop(lease_id, None)
 
     def expire_leases(self, now: Optional[float] = None) -> List[str]:
+        """Drop leases idle longer than the retention period. The
+        primary's OWN lease never expires here — while this copy is the
+        primary its history is the source everyone else recovers from."""
         now = time.monotonic() if now is None else now
         expired = [lid for lid, l in self._leases.items()
-                   if now - l.timestamp > self._lease_retention]
+                   if lid != self._own_lease_id and
+                   now - l.timestamp > self._lease_retention]
         for lid in expired:
             del self._leases[lid]
+        self.leases_expired_total += len(expired)
         return expired
+
+    def has_lease(self, lease_id: str) -> bool:
+        return lease_id in self._leases
+
+    def get_lease(self, lease_id: str) -> Optional[RetentionLease]:
+        return self._leases.get(lease_id)
+
+    def restore_leases(self, leases: List[Dict[str, object]]) -> int:
+        """Re-install commit-persisted leases after a store recovery.
+        Timestamps restart fresh (monotonic clocks don't survive the
+        process); retaining seqnos are authoritative. Returns how many
+        were restored."""
+        n = 0
+        for entry in leases or []:
+            try:
+                lid = str(entry["id"])
+                retaining = int(entry["retaining_seqno"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if lid == self._own_lease_id:
+                continue   # own lease already exists, tracks our checkpoint
+            existing = self._leases.get(lid)
+            if existing is None or existing.retaining_seqno < retaining:
+                self.add_lease(lid, retaining,
+                               str(entry.get("source",
+                                             PEER_RECOVERY_LEASE_SOURCE)))
+                n += 1
+        return n
 
     def min_retained_seqno(self) -> int:
         """History below this may be discarded (translog trim / merge purge)."""
+        self._recompute_global()   # own lease tracks the live checkpoint
         if self._leases:
             return min(l.retaining_seqno for l in self._leases.values())
         return self.global_checkpoint + 1
 
     def leases(self) -> List[RetentionLease]:
         return list(self._leases.values())
+
+    def lease_stats(self) -> Dict[str, int]:
+        return {"active": len(self._leases),
+                "expired_total": self.leases_expired_total,
+                "min_retained_seqno": self.min_retained_seqno()}

@@ -53,11 +53,17 @@ class IndexShard:
                  store: Optional[Store] = None,
                  translog: Optional[Translog] = None,
                  index_sort=None,
-                 check_on_startup=False):
+                 check_on_startup=False,
+                 soft_deletes_retention_ops: int = 1024,
+                 retention_lease_period_s: float = 12 * 3600):
         self.shard_id = shard_id
         self.primary = primary
         self.primary_term = primary_term
         self.allocation_id = allocation_id or uuid_mod.uuid4().hex
+        # soft-deletes knobs (index.soft_deletes.retention.ops /
+        # .retention_lease.period) — dynamic via update_retention_settings
+        self.soft_deletes_retention_ops = soft_deletes_retention_ops
+        self.retention_lease_period_s = retention_lease_period_s
         # how this copy's data came to be on this node ("existing_store",
         # "empty_store", "peer", "peer_reuse", "in_place") — set by the
         # reconciler; observable so tests/operators can assert a restart
@@ -69,6 +75,7 @@ class IndexShard:
             shard_label=f"{shard_id.index}_{shard_id.shard}",
             index_sort=index_sort,
             check_on_startup=check_on_startup)
+        self.engine.history_retention_ops = soft_deletes_retention_ops
         # every commit this copy writes records its identity, so a later
         # gateway fetch can match the on-disk data to routing
         self.engine.commit_extra["allocation_id"] = self.allocation_id
@@ -108,17 +115,48 @@ class IndexShard:
 
     def _enter_primary_mode(self) -> None:
         self.primary = True
-        self.tracker = ReplicationTracker(self.allocation_id,
-                                          self.engine.tracker)
+        self.tracker = ReplicationTracker(
+            self.allocation_id, self.engine.tracker,
+            lease_retention_seconds=self.retention_lease_period_s)
+        # primary mode owns history retention: the engine's prune floor
+        # folds in the tracker's leases, and every commit persists them
+        self.engine.retention_floor_supplier = self._retention_floor
+        self.engine.commit_leases_supplier = lambda: [
+            lease.to_dict() for lease in self.tracker.leases()]
+
+    def _retention_floor(self) -> int:
+        """Expire overdue leases, then return the minimum seqno any
+        surviving lease still retains (Engine.getMinRetainedSeqNo)."""
+        self.tracker.expire_leases()
+        return self.tracker.min_retained_seqno()
+
+    def update_retention_settings(self, retention_ops: Optional[int] = None,
+                                  lease_period_s: Optional[float] = None
+                                  ) -> None:
+        """Apply a dynamic settings update to the live shard."""
+        if retention_ops is not None:
+            self.soft_deletes_retention_ops = int(retention_ops)
+            self.engine.history_retention_ops = int(retention_ops)
+        if lease_period_s is not None:
+            self.retention_lease_period_s = float(lease_period_s)
+            if self.tracker is not None:
+                self.tracker._lease_retention = float(lease_period_s)
 
     def rebind_tracker(self) -> None:
         """Re-point the ReplicationTracker at the engine's (possibly
         replaced) local checkpoint tracker. ``recover_from_store`` swaps
         the engine's tracker for one seeded from the commit; without the
         rebind a store-recovered primary computes its global checkpoint
-        from the abandoned pre-recovery tracker (stuck at -1 forever)."""
+        from the abandoned pre-recovery tracker (stuck at -1 forever).
+        Also the seam where commit-persisted retention leases come back:
+        a restarted primary keeps honoring history it promised to
+        departed copies before the restart."""
         if self.tracker is not None:
             self.tracker.local = self.engine.tracker
+            persisted = self.engine.recovered_commit_extra.get(
+                "retention_leases")
+            if persisted:
+                self.tracker.restore_leases(persisted)
 
     # ------------------------------------------------------------------
     # write path

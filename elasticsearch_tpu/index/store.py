@@ -240,6 +240,10 @@ class Store:
             allocation_id=(commit.get("extra") or {}).get("allocation_id"),
             primary_term=(commit.get("extra") or {}).get(
                 "primary_term", -1),
+            # lease watermarks ride the fetch so the allocator can
+            # prefer copies a live primary still retains history for
+            retention_leases=(commit.get("extra") or {}).get(
+                "retention_leases", []),
             # the commit footer just verified on read; segment payloads
             # are NOT walked here (fetch must stay cheap) — full
             # verification still happens at recovery open

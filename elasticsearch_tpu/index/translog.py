@@ -102,6 +102,11 @@ class Translog:
         self.generation = (gens[-1] + 1) if gens else 1
         self._file = open(self._gen_path(self.generation), "ab")
         self.total_ops = 0
+        # per-generation max seqno, maintained live for generations this
+        # process writes and lazily scanned for pre-existing ones — the
+        # retention-aware trim's "does this gen still back retained
+        # history?" probe without rereading files on every flush
+        self._gen_max_seqno: Dict[int, int] = {}
         self._write_checkpoint()
 
     def _gen_path(self, gen: int) -> Path:
@@ -188,6 +193,8 @@ class Translog:
         rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         self.io.append(self._file, self._gen_path(self.generation), rec)
         self.total_ops += 1
+        if op.seqno > self._gen_max_seqno.get(self.generation, -1):
+            self._gen_max_seqno[self.generation] = op.seqno
         if self.durability == "request":
             self.sync()
 
@@ -235,11 +242,34 @@ class Translog:
         self._write_checkpoint()
         return self.generation
 
-    def trim_below(self, generation: int) -> None:
-        """Delete generations older than ``generation`` (their ops are committed)."""
+    def trim_below(self, generation: int,
+                   keep_from_seqno: Optional[int] = None) -> None:
+        """Delete generations older than ``generation`` (their ops are
+        committed) — EXCEPT, when ``keep_from_seqno`` is given, any
+        generation still holding an op with seqno >= it. Those back the
+        soft-delete operation history across restarts (the reference
+        keeps translog/soft-deleted docs up to the retention floor even
+        after the commit makes them redundant for crash recovery)."""
         for gen in self._list_generations():
-            if gen < generation:
-                self._gen_path(gen).unlink(missing_ok=True)
+            if gen >= generation:
+                continue
+            if keep_from_seqno is not None and \
+                    self._max_seqno_in(gen) >= keep_from_seqno:
+                continue
+            self._gen_path(gen).unlink(missing_ok=True)
+            self._gen_max_seqno.pop(gen, None)
+
+    def _max_seqno_in(self, gen: int) -> int:
+        if gen not in self._gen_max_seqno:
+            mx = -1
+            try:
+                for op in self._read_gen(gen, min_seqno=0):
+                    if op.seqno > mx:
+                        mx = op.seqno
+            except ShardCorruptedError:
+                mx = -1   # unreadable: committed anyway, eligible to trim
+            self._gen_max_seqno[gen] = mx
+        return self._gen_max_seqno[gen]
 
     def read_all(self, min_seqno: int = 0) -> Iterator[TranslogOp]:
         """Replay ops with seqno >= min_seqno across all retained generations."""

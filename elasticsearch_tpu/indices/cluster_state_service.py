@@ -12,11 +12,14 @@ here are already op-shaped), then mark-in-sync on the source.
 
 from __future__ import annotations
 
+import json
 import logging
-from typing import Any, Callable, Dict, Optional
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
 
 from elasticsearch_tpu.cluster.routing import ShardRouting, ShardState
 from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.index.seqno import peer_lease_id
 from elasticsearch_tpu.indices.indices_service import IndicesService
 from elasticsearch_tpu.transport.transport import TransportService
 from elasticsearch_tpu.utils.errors import ShardCorruptedError
@@ -26,6 +29,49 @@ logger = logging.getLogger(__name__)
 SHARD_STARTED = "cluster/shard_started"
 SHARD_FAILED = "cluster/shard_failed"
 RECOVERY_START = "indices/recovery/start"
+
+# why an ops-based catch-up was refused and the copy paid the file path
+# (typed; anything else lands in "unknown", which tests pin at zero)
+FILE_FALLBACK_REASONS = (
+    "stale_commit",             # local commit had seqno holes / no data
+    "term_mismatch",            # commit written under a different primacy
+    "beyond_global_checkpoint",  # local history includes unacked ops
+    "lease_expired",            # no retention lease for the node anymore
+    "lease_not_covering",       # lease exists but starts past lcp+1
+    "history_pruned",           # lease held, but the history has a hole
+)
+
+
+def new_recovery_stats() -> Dict[str, Any]:
+    return {
+        "kinds": {},             # recovery_kind -> count
+        "ops_replayed": 0,       # ops applied by ops-based catch-ups
+        "bytes_copied": 0,       # wire payload actually shipped
+        "bytes_avoided": 0,      # full-snapshot bytes NOT shipped
+        "file_fallback_reasons": {"unknown": 0},
+    }
+
+
+def merge_recovery_sections(sections: List[Dict[str, Any]]
+                            ) -> Dict[str, Any]:
+    """Fleet-wide merge of per-node "recovery" stats sections
+    (_cluster/stats fan-out)."""
+    out = new_recovery_stats()
+    out.update(active_leases=0, leases_expired_total=0,
+               history_retained_ops=0)
+    for sec in sections:
+        if not isinstance(sec, dict):
+            continue
+        for kind, n in (sec.get("kinds") or {}).items():
+            out["kinds"][kind] = out["kinds"].get(kind, 0) + int(n)
+        for reason, n in (sec.get("file_fallback_reasons") or {}).items():
+            out["file_fallback_reasons"][reason] = \
+                out["file_fallback_reasons"].get(reason, 0) + int(n)
+        for key in ("ops_replayed", "bytes_copied", "bytes_avoided",
+                    "active_leases", "leases_expired_total",
+                    "history_retained_ops"):
+            out[key] = out.get(key, 0) + int(sec.get(key, 0) or 0)
+    return out
 
 
 class IndicesClusterStateService:
@@ -40,7 +86,36 @@ class IndicesClusterStateService:
         # allocation ids with an in-flight shard-failed retry loop (the
         # re-assert-on-every-state path must not stack duplicate loops)
         self._failing: set = set()
+        # every completed recovery on this node, by kind, plus a bounded
+        # per-recovery log for _cat/recovery (RecoveryState analog)
+        self.recovery_stats = new_recovery_stats()
+        self._recovery_log: deque = deque(maxlen=128)
         self.ts.register_handler(RECOVERY_START, self._on_recovery_start)
+
+    def _record_recovery(self, sr: ShardRouting, kind: str,
+                         ops_replayed: int = 0, bytes_copied: int = 0,
+                         bytes_avoided: int = 0,
+                         file_reason: Optional[str] = None,
+                         source_node: Optional[str] = None) -> None:
+        stats = self.recovery_stats
+        stats["kinds"][kind] = stats["kinds"].get(kind, 0) + 1
+        stats["ops_replayed"] += ops_replayed
+        stats["bytes_copied"] += bytes_copied
+        stats["bytes_avoided"] += bytes_avoided
+        if file_reason is not None:
+            reason = file_reason if file_reason in FILE_FALLBACK_REASONS \
+                else "unknown"
+            stats["file_fallback_reasons"][reason] = \
+                stats["file_fallback_reasons"].get(reason, 0) + 1
+        self._recovery_log.append({
+            "index": sr.index, "shard": sr.shard_id, "kind": kind,
+            "primary": sr.primary, "node": self.node_id,
+            "source_node": source_node, "ops_replayed": ops_replayed,
+            "bytes_copied": bytes_copied, "bytes_avoided": bytes_avoided,
+            "file_reason": file_reason})
+
+    def recovery_log(self) -> List[Dict[str, Any]]:
+        return list(self._recovery_log)
 
     # ------------------------------------------------------------------
     # apply
@@ -176,6 +251,7 @@ class IndicesClusterStateService:
                 return
             shard.recovery_kind = "existing_store" if had_data \
                 else "empty_store"
+            self._record_recovery(sr, shard.recovery_kind)
             self._watch_engine(service, shard, sr)
             self._shard_started(sr)
             return
@@ -202,23 +278,31 @@ class IndicesClusterStateService:
                                                    sr.shard_id)
             if local and local.get("has_data") and local.get("verified") \
                     and not local.get("corrupted") and \
-                    local.get("max_seqno", -1) >= 0 and \
-                    local.get("max_seqno") == local.get("local_checkpoint"):
+                    local.get("max_seqno", -1) >= 0:
                 try:
                     shard = service.create_shard(
                         sr.shard_id, primary=False, primary_term=term,
                         allocation_id=sr.allocation_id, fresh_store=False)
                     shard.engine.recover_from_store()
-                    if shard.engine.tracker.max_seqno != \
-                            local["max_seqno"]:
-                        # the local TRANSLOG replayed ops beyond the
-                        # probed commit (unacked writes the cluster never
-                        # kept): resurrecting them would diverge the copy
+                    tracker = shard.engine.tracker
+                    if tracker.checkpoint != tracker.max_seqno:
+                        # seqno holes survived commit + translog replay:
+                        # the local history is not contiguous — the ops
+                        # path can't extend it, so don't offer it
                         raise ValueError(
-                            "local translog replayed past the commit")
+                            "recovered local copy has seqno holes")
+                    # report the RECOVERED engine's watermarks (commit
+                    # plus replayed translog tail) and let the SOURCE
+                    # decide: identical → reuse as-is; behind but lease-
+                    # covered → ops-based catch-up from checkpoint+1;
+                    # anything else → wipe and file-copy. Acked ops in
+                    # the replayed tail are exactly what ops-based
+                    # catch-up preserves; UNacked ones are fenced by the
+                    # source's global-checkpoint and term gates, which
+                    # force the wipe instead of resurrecting them.
                     local_commit = {
-                        "max_seqno": local["max_seqno"],
-                        "local_checkpoint": local["local_checkpoint"],
+                        "max_seqno": tracker.max_seqno,
+                        "local_checkpoint": tracker.checkpoint,
                         "primary_term": local.get("primary_term", -1)}
                 except Exception as e:  # noqa: BLE001 — fall back fresh
                     logger.warning(
@@ -243,18 +327,26 @@ class IndicesClusterStateService:
                 self._recovering.discard((sr.index, sr.shard_id))
                 self._shard_failed(sr, f"peer recovery failed: {err}")
                 return
-            reuse = bool(resp.get("reuse")) and local_commit is not None
+            mode = resp.get("mode") or \
+                ("reuse" if resp.get("reuse") else "file")
+            if local_commit is None:
+                mode = "file"   # nothing local to reuse or catch up
+            reuse = mode == "reuse"
+            ops_based = mode == "ops"
             try:
-                if not reuse and local_commit is not None:
-                    # the source refused the reopened history (stale
-                    # term / not caught up): wipe it and copy in full
+                if mode == "file" and local_commit is not None:
+                    # the source refused the reopened history (typed
+                    # reason in the response): wipe it and copy in full
                     service.remove_shard(sr.shard_id)
                     shard = service.create_shard(
                         sr.shard_id, primary=False, primary_term=term,
                         allocation_id=sr.allocation_id, fresh_store=True)
                 for op in resp["ops"]:
                     # historical ops keep their original terms; the fence
-                    # term is the recovery source's CURRENT primary term
+                    # term is the recovery source's CURRENT primary term.
+                    # In ops mode this replays ONLY the missed tail —
+                    # including delete tombstones and noops — on top of
+                    # the reopened store: no wipe, no segment copy.
                     shard.apply_op_on_replica(
                         op, req_primary_term=resp.get("primary_term"))
                 # fill seqno holes (overwritten/deleted history not shipped)
@@ -269,7 +361,18 @@ class IndicesClusterStateService:
                 self._recovering.discard((sr.index, sr.shard_id))
                 self._shard_failed(sr, f"recovery apply failed: {e}")
                 return
-            shard.recovery_kind = "peer_reuse" if reuse else "peer"
+            shard.recovery_kind = "peer_reuse" if reuse else (
+                "ops_based" if ops_based else "peer")
+            self._record_recovery(
+                sr, shard.recovery_kind,
+                ops_replayed=len(resp["ops"]) if ops_based else 0,
+                bytes_copied=int(resp.get("bytes_copied", 0) or 0),
+                bytes_avoided=int(resp.get("bytes_avoided", 0) or 0),
+                # a typed reason is only meaningful when a local copy
+                # EXISTED and was refused — a fresh copy isn't a fallback
+                file_reason=(resp.get("file_reason") or "unknown")
+                if mode == "file" and local_commit is not None else None,
+                source_node=resp.get("source_node"))
             self._watch_engine(service, shard, sr)
             self._shard_started(sr)
 
@@ -338,6 +441,7 @@ class IndicesClusterStateService:
             self._shard_failed(sr, f"in-place store recovery failed: {e}")
             return
         shard.recovery_kind = "in_place"
+        self._record_recovery(sr, "in_place")
         self._watch_engine(service, shard, sr)
         self._recovering.discard((sr.index, sr.shard_id))
         # the master may be verifying this STARTED copy (gateway
@@ -378,31 +482,74 @@ class IndicesClusterStateService:
         if shard.engine.store is not None:
             shard.engine.store.ensure_not_corrupted()
         ops, max_seqno = shard.engine.snapshot_ops()
-        # local-reuse gate: the target may reopen its own commit (no
-        # wipe, no op copy) ONLY when that commit is provably identical
-        # to this primary's current state — hole-free (checkpoint ==
-        # max), fully caught up (same max_seqno), inside the global
-        # checkpoint (ops <= it are identical on every in-sync copy, so
-        # no divergent or missing-delete history can hide in the reused
-        # files), AND written under this primary's CURRENT term: equal
-        # seqno watermarks across different terms can name different ops
-        # (a dead primary's unreplicated write vs its successor's), and
-        # only the term identifies whose history the commit holds.
-        # Anything less pays the full copy.
-        reuse = False
+        # mode decision (RecoverySourceHandler's shape): the target's
+        # recovered local copy may be kept as-is ("reuse"), caught up by
+        # replaying only its missed ops ("ops"), or must be wiped and
+        # copied in full ("file", with a typed reason). Shared safety
+        # gates for keeping ANY local history: hole-free (checkpoint ==
+        # max), inside the global checkpoint (ops <= it are identical on
+        # every in-sync copy, so no divergent or missing-delete history
+        # can hide in the reused files), AND written under this
+        # primary's CURRENT term: equal seqno watermarks across
+        # different terms can name different ops (a dead primary's
+        # unreplicated write vs its successor's), and only the term
+        # identifies whose history the commit holds.
+        mode = "file"
+        file_reason: Optional[str] = None
+        send_ops = ops
         local_commit = req.get("local_commit") or None
         if local_commit is not None:
             lcp = int(local_commit.get("local_checkpoint", -1))
             lmax = int(local_commit.get("max_seqno", -1))
             lterm = int(local_commit.get("primary_term", -1))
-            if lcp == lmax >= 0 and lmax == max_seqno and \
-                    lmax <= shard.global_checkpoint and \
-                    lterm == shard.primary_term:
-                reuse = True
-                ops = []
-        shard.tracker.init_tracking(req["allocation_id"])
+            if not (lcp == lmax >= 0):
+                file_reason = "stale_commit"
+            elif lterm != shard.primary_term:
+                file_reason = "term_mismatch"
+            elif lmax > shard.global_checkpoint:
+                file_reason = "beyond_global_checkpoint"
+            elif lmax == max_seqno:
+                mode = "reuse"
+                send_ops = []
+            else:
+                # ops-based catch-up: only when this NODE's retention
+                # lease still covers everything past the target's
+                # checkpoint AND the soft-delete history actually has it
+                # (the lease is the promise; the history is the proof)
+                shard.tracker.expire_leases()
+                lease = shard.tracker.get_lease(peer_lease_id(sender))
+                if lease is None:
+                    file_reason = "lease_expired"
+                elif lease.retaining_seqno > lmax + 1:
+                    file_reason = "lease_not_covering"
+                else:
+                    hist_ops, complete = \
+                        shard.engine.ops_history_snapshot(lmax + 1)
+                    if not complete:
+                        file_reason = "history_pruned"
+                    else:
+                        mode = "ops"
+                        send_ops = hist_ops
+        # payload accounting: what actually ships vs the full snapshot
+        # the file path would have shipped (the cost ops-based avoids)
+        bytes_full = len(json.dumps(ops))
+        bytes_sent = bytes_full if mode == "file" \
+            else len(json.dumps(send_ops))
+        # the new copy gets a NODE-keyed retention lease immediately
+        # (createMissingPeerRecoveryRetentionLeases analog), renewed from
+        # here on by its checkpoint advances riding replication acks —
+        # so its NEXT restart within the retention window is ops-based
+        shard.tracker.init_tracking(
+            req["allocation_id"], lease_id=peer_lease_id(sender),
+            retaining_seqno=(lmax + 1 if mode in ("reuse", "ops")
+                             else max_seqno + 1))
         shard.tracker.mark_in_sync(req["allocation_id"], max_seqno)
-        return {"ops": ops, "max_seqno": max_seqno, "reuse": reuse,
+        return {"mode": mode, "ops": send_ops, "max_seqno": max_seqno,
+                "reuse": mode == "reuse",
+                "file_reason": file_reason,
+                "bytes_copied": bytes_sent,
+                "bytes_avoided": max(0, bytes_full - bytes_sent),
+                "source_node": self.node_id,
                 "global_checkpoint": shard.global_checkpoint,
                 "primary_term": shard.primary_term}
 

@@ -20,6 +20,18 @@ from elasticsearch_tpu.mapping import MapperService
 from elasticsearch_tpu.utils.errors import (
     IndexNotFoundError, ShardNotFoundError,
 )
+from elasticsearch_tpu.utils.settings import parse_time_to_seconds
+
+
+def _retention_settings(settings: Dict) -> tuple:
+    """(retention ops, lease period seconds) from an index settings dict
+    (index.soft_deletes.retention.ops / .retention_lease.period)."""
+    raw_ops = settings.get("index.soft_deletes.retention.ops")
+    ops = int(raw_ops) if raw_ops is not None else 1024
+    raw_period = settings.get("index.soft_deletes.retention_lease.period")
+    period = (parse_time_to_seconds(raw_period)
+              if raw_period is not None else 12 * 3600.0)
+    return max(0, ops), period
 
 
 class IndexService:
@@ -65,13 +77,16 @@ class IndexService:
             if isinstance(sort_order, list):
                 sort_order = sort_order[0]
             index_sort = (str(sort_field), str(sort_order))
+        retention_ops, lease_period = _retention_settings(settings)
         index_shard = IndexShard(
             ShardId(self.metadata.name, shard), self.mapper_service,
             primary=primary, primary_term=primary_term,
             allocation_id=allocation_id, store=store, translog=translog,
             index_sort=index_sort,
             check_on_startup=settings.get(
-                "index.shard.check_on_startup", False))
+                "index.shard.check_on_startup", False),
+            soft_deletes_retention_ops=retention_ops,
+            retention_lease_period_s=lease_period)
         self.shards[shard] = index_shard
         return index_shard
 
@@ -92,7 +107,16 @@ class IndexService:
     def update_metadata(self, metadata: IndexMetadata) -> None:
         if metadata.mappings and metadata.version > self.metadata.version:
             self.mapper_service.merge(dict(metadata.mappings))
+        old_retention = _retention_settings(dict(self.metadata.settings or {}))
         self.metadata = metadata
+        new_retention = _retention_settings(dict(metadata.settings or {}))
+        if new_retention != old_retention:
+            # dynamic soft-deletes settings reach live shards immediately
+            retention_ops, lease_period = new_retention
+            for index_shard in self.shards.values():
+                index_shard.update_retention_settings(
+                    retention_ops=retention_ops,
+                    lease_period_s=lease_period)
 
     def close(self) -> None:
         for shard in self.shards.values():

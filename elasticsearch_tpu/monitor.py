@@ -361,6 +361,41 @@ def hot_spans_report(node, limit: int = 16) -> Dict[str, Any]:
     return out
 
 
+def recovery_stats(reconciler, indices_service=None) -> Dict[str, Any]:
+    """Recovery & retention observability (indices/cluster_state_service
+    + index/seqno): recoveries by kind (ops_based / peer_reuse / peer /
+    in_place / existing_store / empty_store), ops replayed by catch-ups,
+    bytes copied vs avoided, the typed file-fallback reason taxonomy,
+    plus live lease counts and soft-delete history size across this
+    node's primaries — the whole "did that restart pay a wipe?" question
+    answerable from _nodes/stats alone."""
+    if reconciler is None:
+        return {}
+    out: Dict[str, Any] = {
+        "kinds": dict(reconciler.recovery_stats["kinds"]),
+        "ops_replayed": reconciler.recovery_stats["ops_replayed"],
+        "bytes_copied": reconciler.recovery_stats["bytes_copied"],
+        "bytes_avoided": reconciler.recovery_stats["bytes_avoided"],
+        "file_fallback_reasons": dict(
+            reconciler.recovery_stats["file_fallback_reasons"]),
+        "active_leases": 0, "leases_expired_total": 0,
+        "history_retained_ops": 0,
+    }
+    if indices_service is not None:
+        for shard in list(indices_service.all_shards()):
+            try:
+                out["history_retained_ops"] += \
+                    shard.engine.history_stats()["retained_ops"]
+                if shard.tracker is not None:
+                    lease_stats = shard.tracker.lease_stats()
+                    out["active_leases"] += lease_stats["active"]
+                    out["leases_expired_total"] += \
+                        lease_stats["expired_total"]
+            except Exception:  # noqa: BLE001 — a closing shard is fine
+                continue
+    return out
+
+
 def gateway_stats(gateway_allocator) -> Dict[str, Any]:
     """Gateway shard-state fetch observability (gateway.py
     GatewayAllocator): how many fetches the master issued, how often the

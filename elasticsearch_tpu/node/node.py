@@ -358,6 +358,11 @@ class Node:
             # hits, copies reported none/corrupted/stale, reconciles)
             "gateway": lambda: monitor.gateway_stats(
                 self.gateway_allocator),
+            # recovery kinds (ops_based vs wipe-and-copy), replayed-op /
+            # byte accounting, typed file-fallback reasons + lease and
+            # soft-delete history gauges (cluster_state_service.py)
+            "recovery": lambda: monitor.recovery_stats(
+                self.reconciler, self.indices_service),
         }
         want = None if sections is None else set(sections)
         out: Dict[str, Any] = {"name": self.node_id}

@@ -1399,6 +1399,16 @@ def build_controller(client: NodeClient) -> RestController:
                              for n in node_sections])
                     except Exception:  # noqa: BLE001 — stats must serve
                         merged_rc = {}
+                    try:
+                        from elasticsearch_tpu.indices. \
+                            cluster_state_service import (
+                                merge_recovery_sections,
+                            )
+                        merged_rec = merge_recovery_sections(
+                            [n.get("recovery") or {}
+                             for n in node_sections])
+                    except Exception:  # noqa: BLE001 — stats must serve
+                        merged_rec = {}
                     done(200, {
                         "cluster_name": state.cluster_name,
                         "status": h["status"],
@@ -1433,6 +1443,11 @@ def build_controller(client: NodeClient) -> RestController:
                         # summed, typed invalidation causes summed per
                         # cause)
                         "request_cache": merged_rc,
+                        # fleet-merged recovery accounting: kinds
+                        # (ops_based vs wipe-and-copy), ops replayed,
+                        # bytes copied vs avoided, typed file-fallback
+                        # reasons, lease/history gauges
+                        "recovery": merged_rec,
                     })
                 # section-filtered fan-out: every node builds ONLY its
                 # search_latency section for this merge, not the full
@@ -1443,7 +1458,7 @@ def build_controller(client: NodeClient) -> RestController:
                 client.nodes_stats_all(
                     finish,
                     sections=("search_latency", "device_profile",
-                              "request_cache"),
+                              "request_cache", "recovery"),
                     timeout=5.0)
 
             # status through the master-routed health path (the
@@ -1718,17 +1733,41 @@ def build_controller(client: NodeClient) -> RestController:
     r("GET", "/_cat/plugins", cat_plugins)
 
     def cat_recovery(req: RestRequest, done: DoneFn) -> None:
+        """RecoveryState view: completed recoveries from this node's
+        reconciler log carry the ACTUAL kind (ops_based / peer_reuse /
+        peer / in_place / ...) plus op/byte accounting; in-flight
+        INITIALIZING copies from routing show as stage=init."""
         state = client.node._applied_state()
         rows = []
+        logged = set()
+        for entry in reversed(client.node.reconciler.recovery_log()):
+            key = (entry["index"], entry["shard"], entry["node"])
+            if key in logged:
+                continue   # newest recovery per copy wins
+            logged.add(key)
+            rows.append([entry["index"], str(entry["shard"]),
+                         entry["kind"], "done", entry["node"] or "-",
+                         entry.get("source_node") or "-",
+                         str(entry.get("ops_replayed", 0)),
+                         str(entry.get("bytes_copied", 0)),
+                         str(entry.get("bytes_avoided", 0)),
+                         entry.get("file_reason") or "-"])
+        rows.reverse()
+        covered = {(r[0], r[1], r[4]) for r in rows}
         for sr in state.routing_table.all_shards():
             if sr.state == ShardState.INITIALIZING:
-                rows.append([sr.index, str(sr.shard_id), "peer",
-                             "init", sr.node_id or "-"])
-            elif sr.active:
+                rows.append([sr.index, str(sr.shard_id), "peer", "init",
+                             sr.node_id or "-", "-", "0", "0", "0", "-"])
+            elif sr.active and (sr.index, str(sr.shard_id),
+                                sr.node_id) not in covered:
+                # copies recovered on OTHER nodes (the log is node-local):
+                # routing-derived placeholder row, like before
                 rows.append([sr.index, str(sr.shard_id), "existing_store",
-                             "done", sr.node_id or "-"])
-        done(200, _cat(req, ["index", "shard", "type", "stage", "node"],
-                       rows))
+                             "done", sr.node_id or "-", "-", "0", "0",
+                             "0", "-"])
+        done(200, _cat(req, ["index", "shard", "type", "stage", "node",
+                             "source_node", "ops", "bytes",
+                             "bytes_avoided", "fallback_reason"], rows))
     r("GET", "/_cat/recovery", cat_recovery)
 
     def cat_pending_tasks(req: RestRequest, done: DoneFn) -> None:

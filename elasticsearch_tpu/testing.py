@@ -732,3 +732,529 @@ def fleet_overload_scenario(seed: int, *, n_tenants: int = 4,
         return summary
     finally:
         c.stop()
+
+
+# ---------------------------------------------------------------------------
+# recovery-under-load chaos scenarios (ROADMAP: ops-based catch-up)
+# ---------------------------------------------------------------------------
+
+def _merged_recovery_stats(c: "InProcessCluster") -> Dict[str, Any]:
+    """Fleet view of the reconcilers' recovery accounting — the same
+    merge the ``_cluster/stats`` recovery section performs, fed straight
+    from the node objects (no REST round-trip in a chaos assert path)."""
+    from elasticsearch_tpu import monitor
+    from elasticsearch_tpu.indices.cluster_state_service import (
+        merge_recovery_sections)
+    sections = []
+    for node in c.nodes.values():
+        try:
+            sections.append(monitor.recovery_stats(
+                node.reconciler, node.indices_service))
+        except Exception:
+            continue
+    return merge_recovery_sections(sections)
+
+
+def rolling_restart_recovery_scenario(seed: int, data_path: str, *,
+                                      n_tenants: int = 3,
+                                      n_nodes: int = 5, docs: int = 6,
+                                      writes: int = 18,
+                                      total_searches: int = 120,
+                                      duration_s: float = 1.2
+                                      ) -> Dict[str, Any]:
+    """THE recovery tentpole scenario, one seed: a rolling restart of
+    replica-holding nodes under live search + write traffic. Every
+    restarted copy comes back with a fresh commit, a retained node-keyed
+    retention lease on its primary, and complete op history from its
+    local checkpoint — so every one of them must recover **ops-based**
+    (replay the missed tail) or by segment reuse, never wipe-and-copy.
+
+    Asserts per seed: zero ``peer`` (wipe) recoveries on restarted
+    nodes, at least one ``ops_based`` catch-up, the typed file-fallback
+    ``unknown`` bucket pinned at zero, no acked write lost, and the
+    known-answer query exact after the storm. Returns the measured
+    invariants; bench.py emits them as the ``recovery`` config line."""
+    c = InProcessCluster(n_nodes=n_nodes, seed=seed, data_path=data_path)
+    c.start()
+    try:
+        import numpy as np
+        tenants = [f"t{i}" for i in range(n_tenants)]
+        client = c.client()
+        rng = np.random.default_rng(seed)
+        box: List[Any] = []
+
+        def wait(n: int) -> None:
+            c.run_until(lambda: len(box) >= n, 300.0)
+
+        for tenant in tenants:
+            n0 = len(box)
+            client.create_index(tenant, {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 1},
+                "mappings": {"properties": {"body": {"type": "text"}}}},
+                lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+            c.ensure_green(tenant)
+            for i in range(docs):
+                n0 = len(box)
+                client.index_doc(
+                    tenant, f"d{i}",
+                    {"body": "common " + " ".join(
+                        f"w{int(x)}" for x in rng.integers(0, 8, 4))},
+                    lambda r, e=None: box.append(1))
+                wait(n0 + 1)
+            n0 = len(box)
+            client.refresh(tenant, lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+        # flush everywhere: every copy gets a hole-free commit carrying
+        # the primary's retention leases — the restart's starting point
+        n0 = len(box)
+        client.flush("t*", lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+
+        # reboot targets: nodes holding ONLY replica copies. Rebooting a
+        # primary holder forces a term bump, and a copy committed under
+        # the old term is *correctly* refused ops-based catch-up
+        # (term_mismatch) — a different scenario. Master stays up so
+        # membership churn doesn't stack on top of recovery.
+        master_id = c.master().node_id
+        state = c.master().coordinator.applied_state
+        primary_nodes, copy_nodes = set(), set()
+        for tenant in tenants:
+            for sr in state.routing_table.index(tenant).shard_group(0):
+                if sr.node_id is None:
+                    continue
+                copy_nodes.add(sr.node_id)
+                if sr.primary:
+                    primary_nodes.add(sr.node_id)
+        reboot_targets = [nid for nid in c._node_ids
+                          if nid in copy_nodes and
+                          nid not in primary_nodes and
+                          nid != master_id][:2]
+        coordinators = [nid for nid in c._node_ids
+                        if nid not in reboot_targets][:3]
+
+        harness = FleetTrafficHarness(c, tenants, coordinators, seed)
+
+        # live writes across the whole window: each reboot's downtime
+        # overlaps acked writes, so returning replicas are genuinely
+        # behind and must replay the tail (not just reuse segments)
+        acked: Dict[str, set] = {t: set() for t in tenants}
+        attempted: Dict[str, set] = {t: set() for t in tenants}
+        writes_done = {"n": 0}
+        writer = c.nodes[coordinators[0]].client
+
+        def submit_write(k: int) -> None:
+            tenant = tenants[k % n_tenants]
+            doc_id = f"w{k}"
+            attempted[tenant].add(doc_id)
+
+            def on_write(r, e=None, t=tenant, d=doc_id) -> None:
+                writes_done["n"] += 1
+                if e is None:
+                    acked[t].add(d)
+            writer.index_doc(tenant, doc_id,
+                             {"body": f"common live{k}"}, on_write)
+
+        events: List[Tuple[float, Callable[[], None]]] = []
+        for k in range(writes):
+            events.append((duration_s * (0.05 + 0.9 * k / max(writes, 1)),
+                           lambda kk=k: submit_write(kk)))
+        # the rolling restart itself: full process reboots (in-memory
+        # state gone, same data path), one node after another
+        win0, win1 = 0.3 * duration_s, 0.85 * duration_s
+        slot = (win1 - win0) / max(len(reboot_targets), 1)
+        for k, nid in enumerate(reboot_targets):
+            events.append((win0 + k * slot,
+                           lambda n=nid: c.reboot_node(n)))
+
+        harness.run(duration_s, total_searches, events=events)
+        summary = harness.summary()
+        restart_p99 = summary["admitted_p99_s"]
+
+        # every write must RESOLVE before the post-run refresh, or the
+        # last acks race the refresh broadcast and an acked-but-not-yet-
+        # segmented doc reads as a false loss
+        c.run_until(lambda: writes_done["n"] >= writes, 300.0)
+
+        # let every recovery land, then judge. Routing-green is not
+        # enough: after a fast reboot the master can still route a copy
+        # STARTED at a node that hasn't rebuilt it locally (the
+        # re-asserted shard-failed -> reassign -> recover cycle takes
+        # failure-detection rounds of virtual time) — wait until every
+        # STARTED copy really exists where it is routed.
+        from elasticsearch_tpu.cluster.routing import ShardState
+
+        def settled() -> bool:
+            master = c.master()
+            if master is None:
+                return False
+            st = master.coordinator.applied_state
+            for tenant in tenants:
+                for sr in st.routing_table.index(tenant).shard_group(0):
+                    if sr.state != ShardState.STARTED or \
+                            sr.node_id not in c.nodes:
+                        return False
+                    if not c.nodes[sr.node_id].indices_service.has_shard(
+                            tenant, 0):
+                        return False
+            return True
+        c.run_until(settled, 900.0)
+        for tenant in tenants:
+            c.ensure_green(tenant, max_time=600.0)
+        n0 = len(box)
+        client.refresh("t*", lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+
+        # per-restarted-node recovery kinds, from the fresh reconcilers
+        restarted_kinds: Dict[str, List[str]] = {}
+        wipe_recoveries = 0
+        ops_based = 0
+        ops_replayed = 0
+        for nid in reboot_targets:
+            log = c.nodes[nid].reconciler.recovery_log()
+            kinds = [e["kind"] for e in log if e["index"] in tenants]
+            restarted_kinds[nid] = kinds
+            wipe_recoveries += sum(1 for k in kinds if k == "peer")
+            ops_based += sum(1 for k in kinds if k == "ops_based")
+            ops_replayed += sum(e.get("ops_replayed", 0) for e in log
+                                if e["index"] in tenants)
+
+        # zero lost acked docs + known-answer exactness per tenant
+        lost_acked = 0
+        wrong_hits = 0
+        for tenant in tenants:
+            probe: List[Any] = []
+            client.search(tenant, {
+                "query": {"match": {"body": "common"}},
+                "size": docs + writes + 8, "track_total_hits": True},
+                lambda r, e=None: probe.append((r, e)))
+            c.run_until(lambda: bool(probe), 300.0)
+            resp, err = probe[0]
+            if err is not None:
+                wrong_hits += 1
+                continue
+            got = {h["_id"] for h in resp["hits"]["hits"]}
+            must = {f"d{i}" for i in range(docs)} | acked[tenant]
+            may = must | attempted[tenant]
+            lost_acked += len(must - got)
+            if not got <= may:
+                wrong_hits += 1
+
+        fleet = _merged_recovery_stats(c)
+        master_node = c.master()
+        lease_covered = (master_node.gateway_allocator.stats.get(
+            "lease_covered_allocations", 0) if master_node else 0)
+
+        summary.update({
+            "seed": seed,
+            "rebooted": reboot_targets,
+            "restarted_replica_kinds": restarted_kinds,
+            "wipe_recoveries_on_restarted": wipe_recoveries,
+            "ops_based_recoveries": ops_based,
+            "ops_replayed_on_restarted": ops_replayed,
+            "acked_writes": sum(len(s) for s in acked.values()),
+            "lost_acked_docs": lost_acked,
+            "wrong_hits": wrong_hits,
+            "restart_p99_s": restart_p99,
+            "fleet_recovery": fleet,
+            "unknown_fallbacks": (fleet.get("file_fallback_reasons") or
+                                  {}).get("unknown", 0),
+            "lease_covered_allocations": lease_covered,
+        })
+        return summary
+    finally:
+        c.stop()
+
+
+def duplicate_flood_cache_shed_scenario(seed: int, *, n_tenants: int = 3,
+                                        n_nodes: int = 5, docs: int = 8,
+                                        hot_searches: int = 90,
+                                        distinct_searches: int = 240,
+                                        duration_s: float = 1.0,
+                                        shard_bound: int = 2,
+                                        slow_delay_s: float = 0.08,
+                                        admission: Tuple[int, int] = (3, 10)
+                                        ) -> Dict[str, Any]:
+    """Shed plane × request cache composition, one seed: a zipf-style
+    duplicate flood (one EXACT body repeated from every coordinator)
+    must be absorbed by the two-tier request cache — hot head served
+    from cache with ZERO sheds — while a second flood of all-distinct
+    bodies (cache-proof) overflows the same constrained admission plane
+    and is shed CLEANLY (429 + Retry-After, typed busy failover, no
+    unclean rejection). The two planes must compose: caching absorbs
+    duplicates without disabling shedding for the traffic it cannot
+    absorb."""
+    c = InProcessCluster(n_nodes=n_nodes, seed=seed)
+    c.start()
+    try:
+        import numpy as np
+        tenants = [f"t{i}" for i in range(n_tenants)]
+        coordinators = [f"node{i}" for i in range(min(3, n_nodes))]
+        client = c.client()
+        rng = np.random.default_rng(seed)
+        box: List[Any] = []
+
+        def wait(n: int) -> None:
+            c.run_until(lambda: len(box) >= n, 300.0)
+
+        for tenant in tenants:
+            n0 = len(box)
+            client.create_index(tenant, {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 1},
+                "mappings": {"properties": {"body": {"type": "text"}}}},
+                lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+            c.ensure_green(tenant)
+            for i in range(docs):
+                n0 = len(box)
+                client.index_doc(
+                    tenant, f"d{i}",
+                    {"body": "common " + " ".join(
+                        f"w{int(x)}" for x in rng.integers(0, 8, 4))},
+                    lambda r, e=None: box.append(1))
+                wait(n0 + 1)
+            n0 = len(box)
+            client.refresh(tenant, lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+
+        c.constrain_search_admission(*admission)
+        n0 = len(box)
+        client.cluster_update_settings(
+            {"persistent":
+             {"search.shard.max_queued_members": shard_bound,
+              "search.request_cache.topk": True}},
+            lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+
+        # a slow holder of the hot tenant's shard, slow for BOTH phases:
+        # the same saturated plane absorbs the duplicate flood through
+        # the cache (zero sheds) and sheds the distinct flood cleanly —
+        # the composition claim, not two unrelated configurations
+        state = c.nodes[coordinators[0]].coordinator.applied_state
+        holders = [sr.node_id for sr in
+                   state.routing_table.index(tenants[0]).shard_group(0)
+                   if sr.node_id is not None]
+        victim = holders[-1]
+        c.slow_node_drains(victim, slow_delay_s)
+
+        def cache_counters() -> Dict[str, int]:
+            shard_hits = intake_hits = fused_hits = sheds = 0
+            for node in c.nodes.values():
+                shard_hits += node.search_transport.request_cache.stats[
+                    "hits"]
+                intake_hits += node.search_transport.batcher.stats.get(
+                    "request_cache_intake_hits", 0)
+                fused = getattr(node.search_action, "fused_cache", None)
+                if fused is not None:
+                    fused_hits += fused.stats.get("hits", 0)
+                sheds += node.search_transport.batcher.stats[
+                    "shard_busy_sheds"]
+            return {"shard_hits": shard_hits, "intake_hits": intake_hits,
+                    "fused_hits": fused_hits, "sheds": sheds}
+
+        hot_body = {"query": {"match": {"body": "common"}}, "size": 5,
+                    "request_cache": True, "track_total_hits": True}
+
+        # phase A — the duplicate flood: the same body, hammered from
+        # every coordinator at a rate the constrained admission plane
+        # could not possibly serve uncached
+        before_a = cache_counters()
+        harness = FleetTrafficHarness(c, tenants, coordinators, seed)
+        harness.run(duration_s, hot_searches, hot_tenant=tenants[0],
+                    hot_window=(0.2 * duration_s, 0.9 * duration_s),
+                    hot_factor=10.0, body_fn=lambda t: dict(hot_body))
+        summary_a = harness.summary()
+        after_a = cache_counters()
+
+        # phase B — the cache-proof flood: every body distinct (a unique
+        # marker term defeats both cache tiers), same admission plane
+        marker = {"n": 0}
+
+        def distinct_body(tenant: str) -> Dict[str, Any]:
+            marker["n"] += 1
+            return {"query": {"match": {
+                "body": f"common u{marker['n']}x{seed}"}},
+                "size": 5, "request_cache": True}
+
+        failover_before = {
+            k: sum(n.search_action.shard_busy_stats[k]
+                   for n in c.nodes.values())
+            for k in ("sheds_seen", "failovers", "all_copies_shed")}
+        harness_b = FleetTrafficHarness(c, tenants, coordinators,
+                                        seed + 1)
+        harness_b.run(duration_s, distinct_searches,
+                      hot_tenant=tenants[0],
+                      hot_window=(0.2 * duration_s, 0.9 * duration_s),
+                      hot_factor=10.0, body_fn=distinct_body)
+        summary_b = harness_b.summary()
+        after_b = cache_counters()
+        failover = {
+            k: sum(n.search_action.shard_busy_stats[k]
+                   for n in c.nodes.values()) - failover_before[k]
+            for k in failover_before}
+        c.slow_node_drains(victim, 0.0)
+
+        # post-storm exactness
+        wrong_hits = 0
+        for tenant in tenants:
+            probe: List[Any] = []
+            client.search(tenant, {
+                "query": {"match": {"body": "common"}},
+                "size": docs, "track_total_hits": True},
+                lambda r, e=None: probe.append((r, e)))
+            c.run_until(lambda: bool(probe), 300.0)
+            resp, err = probe[0]
+            if err is not None or \
+                    {h["_id"] for h in resp["hits"]["hits"]} != \
+                    {f"d{i}" for i in range(docs)}:
+                wrong_hits += 1
+
+        return {
+            "seed": seed,
+            "victim": victim,
+            "hot": summary_a,
+            "distinct": summary_b,
+            "distinct_failover": failover,
+            "hot_cache_hits": (after_a["shard_hits"]
+                               - before_a["shard_hits"]
+                               + after_a["intake_hits"]
+                               - before_a["intake_hits"]
+                               + after_a["fused_hits"]
+                               - before_a["fused_hits"]),
+            "hot_sheds": after_a["sheds"] - before_a["sheds"],
+            "distinct_sheds": after_b["sheds"] - after_a["sheds"],
+            "distinct_clean_429": summary_b["rejected_clean_429"],
+            "distinct_unclean": summary_b["unclean_rejections"],
+            "wrong_hits": wrong_hits,
+        }
+    finally:
+        c.stop()
+
+
+def disk_full_mid_flush_scenario(seed: int, data_path: str, *,
+                                 n_nodes: int = 5, docs: int = 8,
+                                 total_searches: int = 100,
+                                 duration_s: float = 1.0
+                                 ) -> Dict[str, Any]:
+    """Disk-full mid-flush under live traffic, one seed: ENOSPC is armed
+    on the primary holder's data path in the middle of the run, then a
+    flush lands on it — the commit write faults, the engine fails
+    tragically with a typed reason, the shard is failed to the master,
+    and the surviving replica is promoted and keeps serving. Asserts:
+    the failure reason is typed (flush + disk-full), at least one
+    injected I/O error actually fired, searches stay exact (zero wrong
+    hits), and the cluster returns to green once the disk 'recovers'
+    (fault disarmed)."""
+    c = InProcessCluster(n_nodes=n_nodes, seed=seed, data_path=data_path)
+    c.start()
+    try:
+        import numpy as np
+        tenant = "t0"
+        client = c.client()
+        rng = np.random.default_rng(seed)
+        box: List[Any] = []
+
+        def wait(n: int) -> None:
+            c.run_until(lambda: len(box) >= n, 300.0)
+
+        n0 = len(box)
+        client.create_index(tenant, {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 1},
+            "mappings": {"properties": {"body": {"type": "text"}}}},
+            lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+        c.ensure_green(tenant)
+        for i in range(docs):
+            n0 = len(box)
+            client.index_doc(
+                tenant, f"d{i}",
+                {"body": "common " + " ".join(
+                    f"w{int(x)}" for x in rng.integers(0, 8, 4))},
+                lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+        n0 = len(box)
+        client.refresh(tenant, lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+
+        master_id = c.master().node_id
+        state = c.master().coordinator.applied_state
+        group = state.routing_table.index(tenant).shard_group(0)
+        victim = next(sr.node_id for sr in group if sr.primary)
+        survivor = next(sr.node_id for sr in group
+                        if not sr.primary and sr.node_id is not None)
+        coordinators = [nid for nid in c._node_ids
+                        if nid not in (victim,)][:3]
+        victim_shard = c.nodes[victim].indices_service.shard(tenant, 0)
+        victim_engine = victim_shard.engine
+
+        io_before = c.disk_io.stats["io_errors"]
+        captured: Dict[str, Any] = {"reason": None, "rule": None}
+
+        def arm_and_flush() -> None:
+            # the disk fills exactly as the commit write starts: armed
+            # write-path ENOSPC on the victim's data path only (translog
+            # appends keep succeeding — acks don't fault, the commit does)
+            captured["rule"] = c.disk_io.arm(
+                "enospc", match=f"/{victim}/", op="write")
+            client.flush(tenant, lambda r, e=None: None)
+
+        def capture_and_heal() -> None:
+            captured["reason"] = victim_engine.failure_reason
+            c.disk_io.disarm(captured["rule"])
+
+        events: List[Tuple[float, Callable[[], None]]] = [
+            (0.4 * duration_s, arm_and_flush),
+            (0.85 * duration_s, capture_and_heal),
+        ]
+
+        harness = FleetTrafficHarness(c, [tenant], coordinators, seed)
+        harness.run(duration_s, total_searches, events=events)
+        summary = harness.summary()
+        if captured["reason"] is None:     # flush landed after the probe
+            captured["reason"] = victim_engine.failure_reason
+        c.disk_io.disarm()
+
+        # the failed primary's copy is gone from the group; the survivor
+        # must now hold the primary and the answer must be exact
+        c.ensure_yellow(tenant, max_time=600.0)
+        probe: List[Any] = []
+        client.search(tenant, {
+            "query": {"match": {"body": "common"}},
+            "size": docs, "track_total_hits": True},
+            lambda r, e=None: probe.append((r, e)))
+        c.run_until(lambda: bool(probe), 300.0)
+        resp, err = probe[0]
+        wrong_hits = 0
+        if err is not None or \
+                {h["_id"] for h in resp["hits"]["hits"]} != \
+                {f"d{i}" for i in range(docs)} or \
+                resp["hits"]["total"]["value"] != docs:
+            wrong_hits += 1
+
+        # disk 'replaced': the copy comes back and the index goes green
+        c.ensure_green(tenant, max_time=600.0)
+        promoted = next(
+            sr.node_id for sr in c.master().coordinator.applied_state
+            .routing_table.index(tenant).shard_group(0) if sr.primary)
+
+        summary.update({
+            "seed": seed,
+            "victim": victim,
+            "survivor": survivor,
+            "master": master_id,
+            "promoted_primary": promoted,
+            "failure_reason": captured["reason"],
+            "typed_failure": bool(
+                captured["reason"] and
+                "flush failed" in captured["reason"] and
+                "disk-full" in captured["reason"]),
+            "injected_io_errors": c.disk_io.stats["io_errors"]
+            - io_before,
+            "wrong_hits": wrong_hits,
+        })
+        return summary
+    finally:
+        c.stop()

@@ -550,6 +550,28 @@ GATEWAY_EXPECTED_DATA_NODES: Setting[int] = Setting.int_setting(
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
 
+# soft-deletes analog (IndexSettings.INDEX_SOFT_DELETES_RETENTION_
+# OPERATIONS_SETTING): every engine retains at least this many of its
+# newest operations — INCLUDING delete tombstones and noops — as a
+# seqno-indexed history, so a briefly-departed copy can catch up by
+# replaying only the ops it missed instead of paying a full store copy.
+# Retention leases can extend the retained range further; this is the
+# floor. Dynamic: a settings update reaches live engines through the
+# reconciler's metadata apply.
+INDEX_SOFT_DELETES_RETENTION_OPS: Setting[int] = Setting.int_setting(
+    "index.soft_deletes.retention.ops", 1024, min_value=0,
+    scope=Scope.INDEX, properties=Property.DYNAMIC)
+
+# peer-recovery retention lease expiry (IndexSettings.INDEX_SOFT_DELETES_
+# RETENTION_LEASE_PERIOD_SETTING): a tracked copy's lease is renewed every
+# time its local checkpoint advances; once a departed copy has been gone
+# longer than this, its lease expires and the history it was holding may
+# be pruned — the copy then pays the file-based path on return.
+INDEX_RETENTION_LEASE_PERIOD: Setting[float] = Setting.time_setting(
+    "index.soft_deletes.retention_lease.period", "12h",
+    scope=Scope.INDEX, properties=Property.DYNAMIC)
+
+
 def setting_from_state(state, setting: Setting[T]) -> T:
     """Read a dynamic cluster setting off a committed cluster state's
     persistent settings. Missing values — and unparseable operator

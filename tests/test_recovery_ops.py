@@ -1,0 +1,479 @@
+"""Ops-based replica catch-up: retention leases, soft-delete history,
+typed file-fallback reasons, and the recovery-under-load fleet scenarios.
+
+A replica that departs and returns inside its retention window must be
+caught up by replaying ONLY the ops it missed — no store wipe, no full
+segment copy. Every refusal of a local copy must carry a typed reason
+(lease_expired / history_pruned / ...), and the "unknown" bucket stays
+pinned at zero. Under live traffic (rolling restarts, duplicate floods,
+a disk filling up mid-flush) the cluster keeps serving with zero wrong
+and zero lost acked hits.
+
+Reference analogs: index/seqno/ReplicationTracker.java (retention
+leases), indices/recovery/RecoverySourceHandler.java (ops-based vs
+file-based decision), FullRollingRestartIT / RecoveryIT.
+"""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.index.seqno import (
+    LocalCheckpointTracker,
+    ReplicationTracker,
+    peer_lease_id,
+)
+from elasticsearch_tpu.testing import (
+    InProcessCluster,
+    disk_full_mid_flush_scenario,
+    duplicate_flood_cache_shed_scenario,
+    rolling_restart_recovery_scenario,
+)
+
+pytestmark = pytest.mark.recovery
+
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _routing(cluster, index):
+    return cluster.master().coordinator.applied_state.routing_table.index(
+        index)
+
+
+# ---------------------------------------------------------------------------
+# unit level: retention-lease lifecycle on the ReplicationTracker
+# ---------------------------------------------------------------------------
+
+def test_retention_lease_lifecycle_unit():
+    """Born with its own lease; tracking a copy creates a node-keyed
+    lease; checkpoint advances renew it; expiry drops idle leases but
+    never the primary's own; commit-persisted leases restore."""
+    local = LocalCheckpointTracker()
+    tracker = ReplicationTracker("alloc_p", local,
+                                 lease_retention_seconds=1e-9)
+    own = peer_lease_id("alloc_p")
+    assert tracker.has_lease(own)
+
+    replica_lease = peer_lease_id("nodeR")
+    tracker.init_tracking("alloc_r", lease_id=replica_lease,
+                          retaining_seqno=0)
+    assert tracker.get_lease(replica_lease).retaining_seqno == 0
+    for s in range(5):
+        local.mark_processed(s)
+    tracker.mark_in_sync("alloc_r", 4)
+    # the ack-riding renewal: the copy provably holds [0..4], so its
+    # lease only needs to retain from 5 on
+    assert tracker.get_lease(replica_lease).retaining_seqno == 5
+    tracker.update_local_checkpoint("alloc_r", 4)   # idempotent renewal
+    assert tracker.get_lease(replica_lease).retaining_seqno == 5
+
+    # the lease survives the copy's removal — that is its entire point
+    tracker.remove_copy("alloc_r")
+    assert tracker.has_lease(replica_lease)
+    assert tracker.min_retained_seqno() == 5
+
+    # expiry (retention ~0): the replica lease goes, the own lease stays
+    expired = tracker.expire_leases(now=1e9)
+    assert expired == [replica_lease]
+    assert tracker.has_lease(own)
+    assert tracker.leases_expired_total == 1
+    assert tracker.lease_stats()["active"] == 1
+
+    # commit-persisted restore: retaining seqnos are authoritative,
+    # the own lease is never clobbered by a stale persisted twin
+    n = tracker.restore_leases([
+        {"id": replica_lease, "retaining_seqno": 3,
+         "source": "peer_recovery"},
+        {"id": own, "retaining_seqno": 0, "source": "peer_recovery"},
+        {"bad": "entry"},
+    ])
+    assert n == 1
+    assert tracker.get_lease(replica_lease).retaining_seqno == 3
+    assert tracker.min_retained_seqno() == 3
+
+
+# ---------------------------------------------------------------------------
+# unit level: engine soft-delete history — tombstones retained, count bound
+# ---------------------------------------------------------------------------
+
+def test_engine_history_retains_tombstones_and_prunes(tmp_path):
+    from elasticsearch_tpu.cluster.metadata import IndexMetadata
+    from elasticsearch_tpu.indices.indices_service import IndicesService
+
+    svc = IndicesService(data_path=str(tmp_path))
+    isvc = svc.create_index(IndexMetadata.create(
+        "i", number_of_shards=1, number_of_replicas=0))
+    shard = isvc.create_shard(0, primary=True, primary_term=1)
+    for i in range(6):
+        shard.apply_index_on_primary(f"d{i}", {"n": i})
+    shard.apply_delete_on_primary("d2")
+
+    ops, complete = shard.engine.ops_history_snapshot(0)
+    assert complete and len(ops) == 7
+    deletes = [op for op in ops if op["op_type"] == "delete"]
+    assert len(deletes) == 1 and deletes[0]["doc_id"] == "d2"
+    assert [op["seqno"] for op in ops] == list(range(7))
+    assert shard.engine.history_stats()["retained_ops"] == 7
+
+    # shrink the retention bound: new ops prune the oldest history
+    shard.update_retention_settings(retention_ops=3)
+    for i in range(6, 9):
+        shard.apply_index_on_primary(f"d{i}", {"n": i})
+    stats = shard.engine.history_stats()
+    assert stats["retention_ops_setting"] == 3
+    assert stats["retained_ops"] == 3
+    # a catch-up from seqno 0 is now impossible — and says so
+    _, complete = shard.engine.ops_history_snapshot(0)
+    assert not complete
+    # but from within the retained window it still works
+    tail, complete = shard.engine.ops_history_snapshot(
+        stats["history_min_seqno"])
+    assert complete and len(tail) == 3
+
+
+# ---------------------------------------------------------------------------
+# cluster level: crash/restore replica cycles through the recovery seam
+# ---------------------------------------------------------------------------
+
+def _crash_cycle(tmp_path, seed, *, tag, index_settings=None, docs=6,
+                 during=None, pre_restore=None):
+    """Flush, crash the replica holder, run ``during`` writes, optionally
+    poke the primary (``pre_restore``), restore, and wait until the copy
+    is re-hosted. Returns (cluster, primary_node, replica_node, the
+    recovery-log entries the cycle produced on the replica node)."""
+    c = InProcessCluster(n_nodes=3, seed=seed,
+                         data_path=str(tmp_path / f"{tag}{seed}"))
+    c.start()
+    client = c.client()
+    settings = {"number_of_shards": 1, "number_of_replicas": 1}
+    settings.update(index_settings or {})
+    _ok(*c.call(lambda cb: client.create_index(
+        "i", {"settings": settings}, cb)))
+    c.ensure_green("i")
+    for k in range(docs):
+        _ok(*c.call(lambda cb, k=k: client.index_doc(
+            "i", f"d{k}", {"title": f"base doc {k}", "n": k}, cb)))
+    _ok(*c.call(lambda cb: client.refresh("i", cb)))
+    # the commit is the returning copy's ticket: its local watermarks
+    # come from disk, so everything before the crash must be flushed
+    _ok(*c.call(lambda cb: client.flush("i", cb)))
+
+    irt = _routing(c, "i")
+    pid = irt.primary(0).node_id
+    rid = [sr.node_id for sr in irt.shard_group(0)
+           if sr.node_id != pid][0]
+    log_before = len(c.nodes[rid].reconciler.recovery_log())
+
+    c.crash_node(rid)
+    c.await_node_count(2)
+    if during is not None:
+        during(c, client)
+    if pre_restore is not None:
+        pre_restore(c, pid)
+    c.restart_node(rid)
+    c.await_node_count(3)
+    c.ensure_green("i", max_time=900.0)
+
+    def hosted():
+        return all(
+            c.nodes[sr.node_id].indices_service.has_shard("i", 0)
+            for sr in _routing(c, "i").shard_group(0) if sr.active)
+    c.run_until(hosted, 900.0)
+    _ok(*c.call(lambda cb: client.refresh("i", cb)))
+    entries = c.nodes[rid].reconciler.recovery_log()[log_before:]
+    return c, pid, rid, entries
+
+
+def _copy_states(c, index, doc_ids):
+    """Per-active-copy realtime-get view: {node: {doc_id: _source|None}}."""
+    out = {}
+    for sr in _routing(c, index).shard_group(0):
+        if not sr.active:
+            continue
+        eng = c.nodes[sr.node_id].indices_service.shard(index, 0).engine
+        out[sr.node_id] = {
+            d: (lambda hit: hit and hit["_source"])(eng.get(d))
+            for d in doc_ids}
+    return out
+
+
+def _search_ids(c, query_word="doc", size=40):
+    resp, err = c.call(lambda cb: c.client().search(
+        "i", {"query": {"match": {"title": query_word}}, "size": size,
+              "track_total_hits": True}, cb), max_time=600.0)
+    _ok(resp, err)
+    assert resp["_shards"]["failed"] == 0
+    return {h["_id"] for h in resp["hits"]["hits"]}
+
+
+def test_crashed_replica_catches_up_ops_based(tmp_path):
+    """The tentpole happy path: a lease-covered returning replica
+    replays exactly its missed ops — zero wipe-and-copy."""
+    def more_writes(c, client):
+        for k in range(6, 10):
+            _ok(*c.call(lambda cb, k=k: client.index_doc(
+                "i", f"d{k}", {"title": f"missed doc {k}", "n": k}, cb)))
+
+    c, pid, rid, entries = _crash_cycle(
+        tmp_path, seed=11, tag="ops", during=more_writes)
+    try:
+        kinds = [e["kind"] for e in entries]
+        assert "ops_based" in kinds, entries
+        assert "peer" not in kinds, f"wipe-and-copy happened: {entries}"
+        ops_entry = next(e for e in entries if e["kind"] == "ops_based")
+        # exactly the 4 missed writes replayed, nothing recopied
+        assert ops_entry["ops_replayed"] == 4
+        assert ops_entry["file_reason"] is None
+        assert ops_entry["bytes_avoided"] > 0
+        assert ops_entry["source_node"] == pid
+
+        all_ids = {f"d{k}" for k in range(10)}
+        assert _search_ids(c, "doc") == all_ids
+        views = _copy_states(c, "i", sorted(all_ids))
+        assert len(views) == 2
+        (a, b) = views.values()
+        assert a == b, "copies diverged after ops-based catch-up"
+        assert all(v is not None for v in a.values())
+        # the returning node's lease was re-established for NEXT time
+        primary_shard = c.nodes[pid].indices_service.shard("i", 0)
+        assert primary_shard.tracker.has_lease(peer_lease_id(rid))
+        # typed-reason ledger: nothing fell into the unknown bucket
+        rec = c.nodes[rid].reconciler.recovery_stats
+        assert rec["file_fallback_reasons"].get("unknown", 0) == 0
+    finally:
+        c.stop()
+
+
+def test_expired_lease_falls_back_to_file_with_identical_results(tmp_path):
+    """index.soft_deletes.retention_lease.period: 0s — the source has
+    already dropped the returning node's lease, so the catch-up must be
+    refused with the TYPED reason and the copy rebuilt file-based; the
+    rebuilt copy is indistinguishable from the primary."""
+    def more_writes(c, client):
+        for k in range(6, 9):
+            _ok(*c.call(lambda cb, k=k: client.index_doc(
+                "i", f"d{k}", {"title": f"missed doc {k}", "n": k}, cb)))
+
+    c, pid, rid, entries = _crash_cycle(
+        tmp_path, seed=13, tag="exp",
+        index_settings={
+            "index.soft_deletes.retention_lease.period": "0s"},
+        during=more_writes)
+    try:
+        kinds = [e["kind"] for e in entries]
+        assert "ops_based" not in kinds, entries
+        wipe = next(e for e in entries if e["kind"] == "peer")
+        assert wipe["file_reason"] == "lease_expired"
+
+        all_ids = {f"d{k}" for k in range(9)}
+        assert _search_ids(c, "doc") == all_ids
+        views = _copy_states(c, "i", sorted(all_ids))
+        (a, b) = views.values()
+        assert a == b, "file-rebuilt copy diverged from the primary"
+        rec = c.nodes[rid].reconciler.recovery_stats
+        assert rec["file_fallback_reasons"].get("lease_expired", 0) >= 1
+        assert rec["file_fallback_reasons"].get("unknown", 0) == 0
+    finally:
+        c.stop()
+
+
+def test_pruned_history_falls_back_typed(tmp_path):
+    """Defense in depth: a live lease whose promised history is GONE
+    (simulated floor disagreement) must refuse the catch-up with
+    history_pruned — never replay around a hole."""
+    def more_writes(c, client):
+        for k in range(6, 9):
+            _ok(*c.call(lambda cb, k=k: client.index_doc(
+                "i", f"d{k}", {"title": f"missed doc {k}", "n": k}, cb)))
+
+    def punch_hole(c, pid):
+        # white-box: the lease floor normally pins these entries, so a
+        # hole can only come from the floors disagreeing — simulate it
+        eng = c.nodes[pid].indices_service.shard("i", 0).engine
+        assert eng._op_history.pop(7, None) is not None
+
+    c, pid, rid, entries = _crash_cycle(
+        tmp_path, seed=17, tag="prn",
+        during=more_writes, pre_restore=punch_hole)
+    try:
+        kinds = [e["kind"] for e in entries]
+        assert "ops_based" not in kinds, entries
+        wipe = next(e for e in entries if e["kind"] == "peer")
+        assert wipe["file_reason"] == "history_pruned"
+        assert _search_ids(c, "doc") == {f"d{k}" for k in range(9)}
+        rec = c.nodes[rid].reconciler.recovery_stats
+        assert rec["file_fallback_reasons"].get("history_pruned", 0) >= 1
+        assert rec["file_fallback_reasons"].get("unknown", 0) == 0
+    finally:
+        c.stop()
+
+
+def test_tombstone_heavy_catch_up_replays_deletes(tmp_path):
+    """Deletes issued while the replica was away ride the history as
+    tombstones; the catch-up replays them, so the returning copy drops
+    the docs it still holds instead of resurrecting them."""
+    def delete_half(c, client):
+        for k in range(0, 6, 2):
+            _ok(*c.call(lambda cb, k=k: client.delete_doc(
+                "i", f"d{k}", cb)))
+
+    c, pid, rid, entries = _crash_cycle(
+        tmp_path, seed=19, tag="tmb", during=delete_half)
+    try:
+        ops_entry = next(e for e in entries if e["kind"] == "ops_based")
+        assert ops_entry["ops_replayed"] == 3
+        survivors = {f"d{k}" for k in (1, 3, 5)}
+        assert _search_ids(c, "doc") == survivors
+        views = _copy_states(c, "i", [f"d{k}" for k in range(6)])
+        assert len(views) == 2
+        for nid, view in views.items():
+            for k in (0, 2, 4):
+                assert view[f"d{k}"] is None, \
+                    f"deleted d{k} resurrected on {nid}"
+            for k in (1, 3, 5):
+                assert view[f"d{k}"] is not None
+    finally:
+        c.stop()
+
+
+def test_dynamic_retention_ops_setting_applies_live(tmp_path):
+    """index.soft_deletes.retention.ops is dynamic: an update lands on
+    the live engines without a shard cycle."""
+    c = InProcessCluster(n_nodes=2, seed=23,
+                         data_path=str(tmp_path / "dyn"))
+    c.start()
+    try:
+        client = c.client()
+        _ok(*c.call(lambda cb: client.create_index("i", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 1}}, cb)))
+        c.ensure_green("i")
+        _ok(*c.call(lambda cb: client.update_settings(
+            "i", {"index.soft_deletes.retention.ops": 7}, cb)))
+
+        def applied():
+            return all(
+                c.nodes[sr.node_id].indices_service.shard("i", 0)
+                .engine.history_retention_ops == 7
+                for sr in _routing(c, "i").shard_group(0) if sr.active)
+        c.run_until(applied, 120.0)
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# REST surfaces: _nodes/stats recovery section, _cat/recovery, _cluster/stats
+# ---------------------------------------------------------------------------
+
+def test_recovery_stats_rest_surfaces(tmp_path):
+    from elasticsearch_tpu.rest.controller import RestRequest
+    from elasticsearch_tpu.rest.routes import build_controller
+
+    def more_writes(c, client):
+        for k in range(6, 9):
+            _ok(*c.call(lambda cb, k=k: client.index_doc(
+                "i", f"d{k}", {"title": f"missed doc {k}", "n": k}, cb)))
+
+    c, pid, rid, entries = _crash_cycle(
+        tmp_path, seed=29, tag="rest", during=more_writes)
+    try:
+        assert any(e["kind"] == "ops_based" for e in entries)
+        # _cat/recovery reads the serving node's own recovery log — ask
+        # the node that actually did the ops-based catch-up
+        controller = build_controller(c.client(rid))
+
+        def do(method, path, body=None, query=None):
+            req = RestRequest(method=method, path=path,
+                              query=dict(query or {}), body=body,
+                              raw_body=b"")
+            out = []
+            controller.dispatch(req, lambda s, b: out.append((s, b)))
+            c.run_until(lambda: bool(out), 120.0)
+            return out[0]
+
+        s, body = do("GET", "/_nodes/stats")
+        assert s == 200
+        sections = [n.get("recovery") for n in body["nodes"].values()]
+        assert all(sec is not None for sec in sections)
+        assert any(sec["kinds"].get("ops_based", 0) >= 1
+                   for sec in sections)
+        for sec in sections:
+            assert sec["file_fallback_reasons"].get("unknown", 0) == 0
+            assert "active_leases" in sec and "ops_replayed" in sec
+
+        s, text = do("GET", "/_cat/recovery", query={"v": "true"})
+        assert s == 200
+        assert "ops_based" in text and "fallback_reason" in text
+
+        s, body = do("GET", "/_cluster/stats")
+        assert s == 200
+        merged = body["recovery"]
+        assert merged["kinds"].get("ops_based", 0) >= 1
+        assert merged["ops_replayed"] >= 3
+        assert merged["file_fallback_reasons"].get("unknown", 0) == 0
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet scenarios: recovery under live traffic
+# ---------------------------------------------------------------------------
+
+def _assert_rolling_restart_invariants(s):
+    assert s["lost_acked_docs"] == 0, s
+    assert s["wrong_hits"] == 0, s
+    # the tentpole acceptance bar: zero wipe-and-copy for lease-covered
+    # restarted replicas, at least one genuinely ops-based catch-up
+    assert s["wipe_recoveries_on_restarted"] == 0, s
+    assert s["ops_based_recoveries"] >= 1, s
+    assert s["ops_replayed_on_restarted"] >= 1, s
+    assert s["unknown_fallbacks"] == 0, s
+    assert s["acked_writes"] > 0
+    assert s["fleet_recovery"]["kinds"].get("ops_based", 0) >= 1
+
+
+@pytest.mark.parametrize("seed",
+                         [131 + 977 * k for k in range(CHAOS_SEEDS)])
+def test_rolling_restart_under_load(tmp_path, seed):
+    s = rolling_restart_recovery_scenario(seed, str(tmp_path / "rr"))
+    _assert_rolling_restart_invariants(s)
+
+
+@pytest.mark.slow
+def test_rolling_restart_seed_sweep(tmp_path):
+    for k in range(max(CHAOS_SEEDS, 5)):
+        seed = 131 + 977 * k
+        s = rolling_restart_recovery_scenario(
+            seed, str(tmp_path / f"rr{seed}"))
+        _assert_rolling_restart_invariants(s)
+
+
+@pytest.mark.parametrize("seed", [131 + 977 * k
+                                  for k in range(max(CHAOS_SEEDS, 2))])
+def test_duplicate_flood_cache_and_shed_compose(seed):
+    """The shed plane and the request cache COMPOSE: a duplicate-heavy
+    hot head is answered from cache (zero sheds), while a distinct-body
+    overflow on the same slowed fleet sheds cleanly with failovers."""
+    s = duplicate_flood_cache_shed_scenario(seed)
+    assert s["wrong_hits"] == 0, s
+    assert s["hot_cache_hits"] > 0, s
+    assert s["hot_sheds"] == 0, s
+    assert s["distinct_sheds"] > 0, s
+    assert s["distinct_failover"]["sheds_seen"] == s["distinct_sheds"]
+    assert s["distinct_failover"]["failovers"] > 0
+    assert s["distinct_unclean"] == 0, s
+
+
+@pytest.mark.parametrize("seed",
+                         [131 + 977 * k for k in range(CHAOS_SEEDS)])
+def test_disk_full_mid_flush_fails_typed_and_keeps_serving(tmp_path, seed):
+    s = disk_full_mid_flush_scenario(seed, str(tmp_path / "df"))
+    assert s["typed_failure"], s
+    assert s["injected_io_errors"] >= 1, s
+    assert s["wrong_hits"] == 0, s
+    assert s["promoted_primary"], s
