@@ -2037,6 +2037,25 @@ def cfg_recovery(np, jax, jnp, result):
     s["ops_based_engaged"] = bool(s["ops_based_recoveries"] >= 1)
     result["configs"]["recovery"] = s
 
+    # FAILOVER leg (the cross-term contract): kill the primary-holding
+    # node mid-writes — a replica is PROMOTED (term bump), resyncs its
+    # above-checkpoint tail to the surviving copies, and the deposed
+    # primary later rejoins through the cross-term ops path (rollback
+    # to the canonical bound + replay) instead of a store wipe.
+    from elasticsearch_tpu.testing import failover_under_live_writes_scenario
+    path = tempfile.mkdtemp(prefix="bench_failover_")
+    try:
+        f = failover_under_live_writes_scenario(SEED + 29, path)
+    finally:
+        shutil.rmtree(path, ignore_errors=True)
+    f["zero_deposed_wipes"] = f["deposed_wipe_recoveries"] == 0
+    f["zero_lost_acked"] = f["lost_acked_docs"] == 0
+    f["zero_wrong_hits"] = f["wrong_hits"] == 0
+    f["zero_unknown_fallbacks"] = f["unknown_fallbacks"] == 0
+    f["resync_engaged"] = bool(
+        f["resync"]["resyncs_started"] + f["resync"]["resyncs_noop"] >= 1)
+    result["configs"]["failover"] = f
+
 
 def cfg_multichip(np, jax, jnp, result):
     """MULTICHIP scenario: runs inline when this process already sees

@@ -136,7 +136,13 @@ class TransportShardBulkAction:
 
         payload = {"index": index, "shard": shard_id, "ops": ops,
                    "global_checkpoint": shard.global_checkpoint,
-                   "primary_term": shard.primary_term}
+                   "primary_term": shard.primary_term,
+                   # the lease set rides every fan-out (RetentionLease
+                   # sync analog): replicas persist it, so a promotion
+                   # inherits the fleet's retention promises
+                   "retention_leases": [
+                       lease.to_dict()
+                       for lease in shard.tracker.leases()]}
 
         def one_done() -> None:
             pending["n"] -= 1
@@ -261,8 +267,146 @@ class TransportShardBulkAction:
     def _on_replica(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
         shard = self.indices.shard(req["index"], req["shard"])
         for op in req["ops"]:
-            shard.apply_op_on_replica(op)
+            # the REQUEST term is the fence (ops keep their original
+            # terms: a resync re-sends deposed-term ops under the new
+            # primacy); the request's global checkpoint rides along so a
+            # term bump rolls back to the newest checkpoint known anywhere
+            shard.apply_op_on_replica(
+                op, req_primary_term=req["primary_term"],
+                req_global_checkpoint=req["global_checkpoint"])
         shard.update_global_checkpoint_on_replica(req["global_checkpoint"])
+        shard.learn_retention_leases(req.get("retention_leases"))
+        return {"local_checkpoint": shard.local_checkpoint}
+
+
+SHARD_RESYNC = "indices:admin/seq_no/resync[r]"
+
+
+class PrimaryReplicaSyncer:
+    """Post-promotion primary–replica resync (PrimaryReplicaSyncer.java):
+    every op above the global checkpoint the new primary knew at
+    promotion is re-replicated — with its ORIGINAL primary term, under
+    the NEW request term — to every in-sync copy, so replicas converge
+    on the new primacy without paying a recovery. Redelivery is safe:
+    the request-term bump makes each replica roll back its deposed-term
+    tail to the global checkpoint first, and the engine's per-doc seqno
+    guard turns ops a copy already holds into acks.
+
+    The resync also rebuilds the promoted primary's replication
+    tracker: each ack re-registers the copy (init_tracking + lease +
+    mark_in_sync), so the global checkpoint and lease renewal resume
+    exactly where the deposed primary left them."""
+
+    def __init__(self, node_id: str, indices: IndicesService,
+                 ts: TransportService,
+                 state_supplier: Callable[[], Optional[ClusterState]]):
+        self.node_id = node_id
+        self.indices = indices
+        self.ts = ts
+        self.state = state_supplier
+        self.stats: Dict[str, int] = {
+            "resyncs_started": 0, "resyncs_completed": 0,
+            "resyncs_noop": 0, "resync_ops_sent": 0,
+            "resync_targets": 0, "resync_failures": 0,
+            "resync_ops_applied": 0}
+        ts.register_handler(SHARD_RESYNC, self._on_resync_replica)
+
+    def resync(self, index: str, shard_id: int,
+               on_done: Optional[Callable[[], None]] = None) -> None:
+        shard = self.indices.shard(index, shard_id)
+        from_seqno = shard.resync_from if shard.resync_from is not None \
+            else shard.global_checkpoint + 1
+        ops, complete = shard.engine.ops_history_snapshot(from_seqno)
+        state = self.state()
+        replicas = []
+        if state is not None:
+            replicas = [
+                sr for sr in
+                state.routing_table.index(index).shard_group(shard_id)
+                if not sr.primary and sr.assigned
+                and sr.node_id != self.node_id
+                and sr.state in (ShardState.INITIALIZING,
+                                 ShardState.STARTED, ShardState.RELOCATING)]
+        if not complete:
+            # promotion hole-fill noops make the above-checkpoint window
+            # contiguous, so this means the history floor overtook the
+            # window — replicas will converge through recovery instead
+            self.stats["resync_failures"] += 1
+            if on_done is not None:
+                on_done()
+            return
+        if not replicas or not ops:
+            self.stats["resyncs_noop"] += 1
+            if on_done is not None:
+                on_done()
+            return
+        self.stats["resyncs_started"] += 1
+        self.stats["resync_targets"] += len(replicas)
+        self.stats["resync_ops_sent"] += len(ops) * len(replicas)
+        payload = {"index": index, "shard": shard_id, "ops": ops,
+                   "global_checkpoint": shard.global_checkpoint,
+                   "primary_term": shard.primary_term,
+                   "retention_leases": [
+                       lease.to_dict()
+                       for lease in shard.tracker.leases()]}
+        pending = {"n": len(replicas)}
+
+        def one_done() -> None:
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                self.stats["resyncs_completed"] += 1
+                if on_done is not None:
+                    on_done()
+
+        for replica in replicas:
+            def on_ack(resp, err, sr: ShardRouting = replica) -> None:
+                if err is None and shard.tracker is not None \
+                        and sr.allocation_id:
+                    try:
+                        from elasticsearch_tpu.index.seqno import (
+                            peer_lease_id,
+                        )
+                        ckpt = resp.get("local_checkpoint", -1)
+                        shard.tracker.init_tracking(
+                            sr.allocation_id,
+                            lease_id=peer_lease_id(sr.node_id),
+                            retaining_seqno=ckpt + 1)
+                        shard.tracker.mark_in_sync(sr.allocation_id, ckpt)
+                    except ValueError as e:
+                        err = e
+                if err is not None:
+                    # a copy that cannot converge on the new primacy must
+                    # leave the in-sync set (the reference fails the shard
+                    # from the resync proxy the same way)
+                    self.stats["resync_failures"] += 1
+                    self._fail_replica(sr, str(err), one_done)
+                    return
+                one_done()
+            self.ts.send_request(replica.node_id, SHARD_RESYNC, payload,
+                                 on_ack, timeout=30.0)
+
+    def _fail_replica(self, sr: ShardRouting, reason: str,
+                      done: Callable[[], None]) -> None:
+        state = self.state()
+        master = state.master_node_id if state is not None else None
+        if master is None:
+            done()
+            return
+        self.ts.send_request(master, SHARD_FAILED,
+                             {"shard": sr.to_dict(),
+                              "reason": f"resync failed: {reason}"},
+                             lambda r, e: done(), timeout=30.0)
+
+    def _on_resync_replica(self, req: Dict[str, Any],
+                           sender: str) -> Dict[str, Any]:
+        shard = self.indices.shard(req["index"], req["shard"])
+        for op in req["ops"]:
+            shard.apply_op_on_replica(
+                op, req_primary_term=req["primary_term"],
+                req_global_checkpoint=req["global_checkpoint"])
+        shard.update_global_checkpoint_on_replica(req["global_checkpoint"])
+        shard.learn_retention_leases(req.get("retention_leases"))
+        self.stats["resync_ops_applied"] += len(req["ops"])
         return {"local_checkpoint": shard.local_checkpoint}
 
 

@@ -48,6 +48,16 @@ class VersionEntry:
     primary_term: int
     version: int
     deleted: bool = False
+    # earliest op seqno THIS engine incarnation observed for the doc
+    # (-1 = unknown, e.g. rebuilt from a commit): the rollback path's
+    # proof that a doc was created entirely above the rollback target
+    first_seqno: int = -1
+
+
+class RollbackInfeasibleError(RuntimeError):
+    """The engine cannot prove what a doc's state was at the rollback
+    target (history pruned + segment copy gone) — the caller falls back
+    to wipe-and-copy with a typed reason instead of guessing."""
 
 
 @dataclass
@@ -177,7 +187,21 @@ class InternalEngine:
         # opened commit carried so the shard can restore them
         self.commit_leases_supplier: \
             Optional[Callable[[], List[Dict[str, Any]]]] = None
+        # installed by the shard (primary AND replica): the last global
+        # checkpoint this copy knows, persisted into every commit — a
+        # returning copy's proof of how much of its commit is canonical
+        # (ops at/below a copy's own persisted gcp can never be rolled
+        # back, whatever term they carry)
+        self.global_checkpoint_supplier: Optional[Callable[[], int]] = None
         self.recovered_commit_extra: Dict[str, Any] = {}
+        # rollback feasibility guard: the max_seqno at the most recent
+        # merge (persisted across restarts). A merge purges dead docs;
+        # if one ran while above-target ops were already searchable it
+        # may have destroyed the pre-rollback copy of a doc — absence
+        # of a segment entry then proves nothing
+        self._max_seqno_at_last_merge = -1
+        self.rollbacks_total = 0
+        self.ops_rolled_back_total = 0
 
         self._lock = threading.RLock()
         self.segments: List[Segment] = []
@@ -241,6 +265,28 @@ class InternalEngine:
             else:
                 primary_term = primary_term or self.primary_term
                 version = version or 1
+                if existing is not None and existing.seqno >= seqno:
+                    # redelivery (a resync re-replicates every op above
+                    # the global checkpoint, including ones this copy
+                    # already applied live): per-doc seqnos are
+                    # monotonic, so an op at/below what we hold is the
+                    # same op or one it superseded — record it for
+                    # translog/history completeness (crash replay is
+                    # order-insensitive per doc thanks to this guard)
+                    # without touching the doc's newer state
+                    if self.translog is not None:
+                        self._translog_add(TranslogOp(
+                            "index", seqno, primary_term, doc_id=doc_id,
+                            source=source, routing=routing,
+                            version=version))
+                    self.tracker.mark_processed(seqno)
+                    self._history_add({"op_type": "index",
+                                       "doc_id": doc_id, "source": source,
+                                       "routing": routing, "seqno": seqno,
+                                       "version": version,
+                                       "primary_term": primary_term})
+                    return EngineResult(doc_id, seqno, primary_term,
+                                        version, "noop")
 
             created = existing is None or existing.deleted
             parsed = self.mappers.parse_document(doc_id, source, routing)
@@ -256,7 +302,10 @@ class InternalEngine:
                     # live copy exists in a searchable segment: tombstone at refresh
                     self._pending_tombstones.append(doc_id)
             self._buffer[doc_id] = (parsed, seqno, version, primary_term)
-            self._version_map[doc_id] = VersionEntry(seqno, primary_term, version)
+            self._version_map[doc_id] = VersionEntry(
+                seqno, primary_term, version,
+                first_seqno=(existing.first_seqno if existing is not None
+                             else seqno))
             self.tracker.mark_processed(seqno)
             self._history_add({"op_type": "index", "doc_id": doc_id,
                                "source": source, "routing": routing,
@@ -288,6 +337,19 @@ class InternalEngine:
             else:
                 primary_term = primary_term or self.primary_term
                 version = version or 1
+                if existing is not None and existing.seqno >= seqno:
+                    # redelivered delete (see the index() replica guard)
+                    if self.translog is not None:
+                        self._translog_add(TranslogOp(
+                            "delete", seqno, primary_term, doc_id=doc_id,
+                            version=version))
+                    self.tracker.mark_processed(seqno)
+                    self._history_add({"op_type": "delete",
+                                       "doc_id": doc_id, "seqno": seqno,
+                                       "version": version,
+                                       "primary_term": primary_term})
+                    return EngineResult(doc_id, seqno, primary_term,
+                                        version, "noop")
 
             found = existing is not None and not existing.deleted
             if self.translog is not None:
@@ -298,7 +360,10 @@ class InternalEngine:
                 self._buffer_order.remove(doc_id)
             if found:
                 self._pending_tombstones.append(doc_id)
-            self._version_map[doc_id] = VersionEntry(seqno, primary_term, version, deleted=True)
+            self._version_map[doc_id] = VersionEntry(
+                seqno, primary_term, version, deleted=True,
+                first_seqno=(existing.first_seqno if existing is not None
+                             else seqno))
             self.tracker.mark_processed(seqno)
             # the delete TOMBSTONE is what soft-deletes exist for: a
             # file-less catch-up must be able to replay "doc X died at
@@ -309,16 +374,19 @@ class InternalEngine:
             return EngineResult(doc_id, seqno, primary_term, version,
                                 "deleted" if found else "not_found")
 
-    def noop(self, seqno: int, reason: str = "") -> None:
-        """Fill a seqno hole (primary failover safety), reference: Engine.noOp."""
+    def noop(self, seqno: int, reason: str = "",
+             primary_term: Optional[int] = None) -> None:
+        """Fill a seqno hole (primary failover safety), reference: Engine.noOp.
+        A replica replaying a noop passes the op's ORIGINAL term so the
+        history/translog record keeps the primacy it was minted under."""
+        term = primary_term if primary_term is not None else self.primary_term
         with self._lock:
             if self.translog is not None:
-                self._translog_add(TranslogOp("noop", seqno,
-                                              self.primary_term,
+                self._translog_add(TranslogOp("noop", seqno, term,
                                               reason=reason))
             self.tracker.mark_processed(seqno)
             self._history_add({"op_type": "noop", "seqno": seqno,
-                               "primary_term": self.primary_term,
+                               "primary_term": term,
                                "reason": reason})
 
     # ------------------------------------------------------------------
@@ -531,7 +599,15 @@ class InternalEngine:
             # belongs to: recovery reuse must refuse a commit from an
             # older term — the same seqno can name different ops
             # across a failover
-            extra = {**self.commit_extra, "primary_term": self.primary_term}
+            extra = {**self.commit_extra, "primary_term": self.primary_term,
+                     "max_seqno_at_last_merge": self._max_seqno_at_last_merge}
+            if self.global_checkpoint_supplier is not None:
+                # the copy's own durable knowledge of the global
+                # checkpoint: after a failover, everything at/below it
+                # in this commit is canonical history no new primary
+                # can have diverged from
+                extra["global_checkpoint"] = \
+                    int(self.global_checkpoint_supplier())
             if self.commit_leases_supplier is not None:
                 # leases ride every commit (ReplicationTracker persists
                 # them in the Lucene commit user data) so a restarted
@@ -594,6 +670,7 @@ class InternalEngine:
         else:
             merged = merge_segments(name, to_merge, self.mappers)
         self.segments = _insert_merged(merged, self.segments, to_merge)
+        self._max_seqno_at_last_merge = self.tracker.max_seqno
         self._bump_search_generation("merge")
         # merged-away segments are dead to every FUTURE reader (the plane
         # registry keys on segment uids): free their device planes now
@@ -724,10 +801,19 @@ class InternalEngine:
                 # allocation id, persisted retention leases) so the shard
                 # layer can restore leases / report watermarks
                 self.recovered_commit_extra = dict(commit.get("extra") or {})
-                # mark seqnos persisted in segments as processed
+                self._max_seqno_at_last_merge = int(
+                    self.recovered_commit_extra.get(
+                        "max_seqno_at_last_merge", -1))
+                # mark seqnos persisted in segments as processed —
+                # CLAMPED to the commit's recorded max: a rolled-back
+                # commit can still carry dead docs stamped with
+                # discarded seqnos, and resurrecting those watermarks
+                # would undo the rollback on the next reopen
+                commit_max = int(commit["max_seqno"])
                 for seg in self.segments:
                     for s in seg.seqnos:
-                        self.tracker.mark_processed(int(s))
+                        if int(s) <= commit_max:
+                            self.tracker.mark_processed(int(s))
             # rebuild version map from segments (newest segment wins)
             for seg in self.segments:
                 for doc_id, d in seg.id_to_doc.items():
@@ -765,6 +851,154 @@ class InternalEngine:
             else:
                 self.refresh()
             return replayed
+
+    def rollback_above(self, target: int) -> int:
+        """Discard every op with seqno > ``target`` in place (the engine
+        half of the reference's resetEngineToGlobalCheckpoint): a copy
+        that learns of a new primacy drops its deposed-term tail and
+        replays the new primary's history instead of wiping its store.
+
+        Feasibility is proven per touched doc, never guessed: the doc's
+        state at ``target`` must be reconstructible from the retained op
+        history, from a segment copy whose successor ops are provably
+        all above the target, or — for docs created entirely above the
+        target — from the version map's first-seqno record (backed by
+        the persisted merge watermark when the first write predates this
+        incarnation). Anything unprovable raises
+        RollbackInfeasibleError BEFORE any state changes, and the caller
+        falls back to the typed wipe path. The rollback ends with a
+        flush plus a translog trim so a crash immediately after cannot
+        replay the discarded tail back in. Returns the number of seqnos
+        discarded."""
+        with self._lock:
+            old_max = self.tracker.max_seqno
+            if old_max <= target:
+                return 0
+            if self.tracker.checkpoint < target:
+                raise RollbackInfeasibleError(
+                    f"local checkpoint {self.tracker.checkpoint} leaves "
+                    f"holes below rollback target {target}")
+            touched = [doc_id for doc_id, e in self._version_map.items()
+                       if e.seqno > target]
+            # plan first — a raise here leaves the engine untouched
+            plans = {doc_id: self._rollback_authority(doc_id, target)
+                     for doc_id in touched}
+            # kill every searchable copy of a discarded op
+            for seg in self.segments:
+                for d in range(seg.n_docs):
+                    if seg.live[d] and int(seg.seqnos[d]) > target:
+                        seg.delete_doc(d)
+                        self._dirty_live.add(seg.name)
+            for doc_id, plan in plans.items():
+                self._apply_rollback_plan(doc_id, plan)
+            for s in [s for s in self._op_history if s > target]:
+                del self._op_history[s]
+            self.tracker = LocalCheckpointTracker(target, target)
+            self.rollbacks_total += 1
+            self.ops_rolled_back_total += old_max - target
+            self._bump_search_generation("rollback")
+            if self.translog is not None:
+                self.translog.trim_ops_above(target)
+            if self.store is not None:
+                self.flush()
+            else:
+                self.refresh()
+            return old_max - target
+
+    def _history_covers(self, lo: int, hi: int) -> bool:
+        return all(s in self._op_history for s in range(lo, hi + 1))
+
+    def _rollback_authority(self, doc_id: str,
+                            target: int) -> Dict[str, Any]:
+        """What was this doc at seqno ``target``? Returns a restore plan
+        or raises RollbackInfeasibleError. An authority is only accepted
+        with PROOF it is the doc's newest op at/below the target —
+        retained history covering every seqno between it and the target
+        with no later op for this doc in between."""
+        h_op = None
+        for op in self._op_history.values():
+            if op.get("doc_id") == doc_id and op["seqno"] <= target:
+                if h_op is None or op["seqno"] > h_op["seqno"]:
+                    h_op = op
+        if h_op is not None and self._history_covers(h_op["seqno"] + 1,
+                                                     target):
+            return {"kind": h_op["op_type"], "op": h_op}
+        best = None   # (seqno, seg, docnum): newest committed copy
+        for seg in self.segments:
+            d = seg.id_to_doc.get(doc_id)
+            if d is None:
+                continue
+            s = int(seg.seqnos[d])
+            if s <= target and (best is None or s > best[0]):
+                best = (s, seg, d)
+        if best is not None and self._history_covers(best[0] + 1, target):
+            return {"kind": "segment", "seg": best[1], "d": best[2],
+                    "seqno": best[0]}
+        entry = self._version_map[doc_id]
+        if h_op is None and best is None and (
+                (entry.first_seqno != -1 and entry.first_seqno > target)
+                or self._max_seqno_at_last_merge <= target):
+            # created entirely above the target: either this incarnation
+            # watched its first write land above it, or no merge since
+            # the target could have purged a pre-existing copy
+            return {"kind": "absent"}
+        raise RollbackInfeasibleError(
+            f"cannot prove state of doc [{doc_id}] at seqno {target}: "
+            f"history pruned and no committed copy at/below the target")
+
+    def _apply_rollback_plan(self, doc_id: str,
+                             plan: Dict[str, Any]) -> None:
+        if doc_id in self._buffer:
+            del self._buffer[doc_id]
+            self._buffer_order.remove(doc_id)
+        if doc_id in self._pending_tombstones:
+            self._pending_tombstones = [
+                t for t in self._pending_tombstones if t != doc_id]
+        prev = self._version_map.get(doc_id)
+        first = prev.first_seqno if prev is not None else -1
+        kind = plan["kind"]
+        if kind == "absent":
+            self._version_map.pop(doc_id, None)
+            return
+        if kind == "delete":
+            op = plan["op"]
+            self._version_map[doc_id] = VersionEntry(
+                op["seqno"], op["primary_term"], op.get("version", 1),
+                deleted=True, first_seqno=first)
+            return
+        if kind == "segment":
+            seg, d, seqno = plan["seg"], plan["d"], plan["seqno"]
+            source = seg.sources[d] or {}
+            routing = seg.routings[d] if d < len(seg.routings) else None
+            version = int(seg.versions[d]) if d < len(seg.versions) else 1
+            term = (int(seg.primary_terms[d])
+                    if d < len(seg.primary_terms) else 1)
+        else:   # "index" — wire-form history op
+            op = plan["op"]
+            seqno, version = op["seqno"], op.get("version", 1)
+            term = op["primary_term"]
+            source, routing = op.get("source") or {}, op.get("routing")
+        live_at_auth = False
+        live_elsewhere = False
+        for seg in self.segments:
+            d = seg.id_to_doc.get(doc_id)
+            if d is not None and seg.live[d]:
+                if int(seg.seqnos[d]) == seqno:
+                    live_at_auth = True
+                else:
+                    live_elsewhere = True
+        self._version_map[doc_id] = VersionEntry(seqno, term, version,
+                                                 first_seqno=first)
+        if live_at_auth:
+            return   # the committed copy is still searchable as-is
+        # re-surface the restored state through the buffer (the uniform
+        # path: the closing flush rebuilds the searchable copy); a stale
+        # older live copy is tombstoned first, exactly as index() would
+        if live_elsewhere:
+            self._pending_tombstones.append(doc_id)
+        parsed = self.mappers.parse_document(doc_id, source, routing)
+        self._buffer_order.append(doc_id)
+        self._buffer[doc_id] = (parsed, seqno, version, term)
 
     def restore_segments(self, segments: List[Segment]) -> None:
         """Replace ALL engine state with the given segments (snapshot
@@ -812,7 +1046,8 @@ class InternalEngine:
             self.delete(op.doc_id, seqno=op.seqno, version=op.version,
                         primary_term=op.primary_term)
         elif op.op_type == "noop":
-            self.noop(op.seqno, reason=op.reason or "")
+            self.noop(op.seqno, reason=op.reason or "",
+                      primary_term=op.primary_term)
 
     # ------------------------------------------------------------------
 

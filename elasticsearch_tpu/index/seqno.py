@@ -96,7 +96,8 @@ class ReplicationTracker:
     """
 
     def __init__(self, shard_allocation_id: str, local_tracker: LocalCheckpointTracker,
-                 lease_retention_seconds: float = 12 * 3600):
+                 lease_retention_seconds: float = 12 * 3600,
+                 node_id: Optional[str] = None):
         self.allocation_id = shard_allocation_id
         self.local = local_tracker
         self._in_sync: Set[str] = {shard_allocation_id}
@@ -112,9 +113,12 @@ class ReplicationTracker:
         # checkpoint+1 — the next op that copy still needs.
         self._lease_of_alloc: Dict[str, str] = {}
         self.leases_expired_total = 0
+        self.leases_released_node_left = 0
         # the primary retains its own history too (its lease never
-        # expires while it IS the primary — see expire_leases)
-        self._own_lease_id = peer_lease_id(shard_allocation_id)
+        # expires while it IS the primary — see expire_leases). Keyed by
+        # NODE when known: a successor primary that inherited the lease
+        # set can then recognize this node's returning copy by sender
+        self._own_lease_id = peer_lease_id(node_id or shard_allocation_id)
         self._lease_of_alloc[shard_allocation_id] = self._own_lease_id
         self.add_lease(self._own_lease_id, local_tracker.checkpoint + 1,
                        PEER_RECOVERY_LEASE_SOURCE)
@@ -139,6 +143,24 @@ class ReplicationTracker:
             else:
                 self.add_lease(lease_id, retaining,
                                PEER_RECOVERY_LEASE_SOURCE)
+
+    def activate_promoted(self, known_global_checkpoint: int,
+                          in_sync_allocation_ids: List[str]) -> None:
+        """Seed a freshly promoted primary's tracker (the reference's
+        activatePrimaryMode under a new term): the global checkpoint
+        starts from what this copy learned as a replica — never from its
+        own local checkpoint, which may run ahead of copies that haven't
+        acked — and the routing table's other in-sync copies are
+        registered with unknown checkpoints so they hold the minimum
+        down until their resync acks report real ones."""
+        if known_global_checkpoint > self._global_checkpoint:
+            self._global_checkpoint = known_global_checkpoint
+        for aid in in_sync_allocation_ids:
+            if aid == self.allocation_id:
+                continue
+            self._tracked.add(aid)
+            self._in_sync.add(aid)
+            self._checkpoints.setdefault(aid, NO_OPS_PERFORMED)
 
     def mark_in_sync(self, allocation_id: str, local_checkpoint: int) -> None:
         """Promote a tracked copy to in-sync. The copy must have caught up to
@@ -237,6 +259,19 @@ class ReplicationTracker:
         self.leases_expired_total += len(expired)
         return expired
 
+    def release_node_lease(self, node_id: str) -> bool:
+        """Drop a departed node's peer-recovery lease EARLY: the node
+        has permanently left the cluster and its copy was rebuilt
+        elsewhere, so holding 12h of history for a disk that is never
+        coming back only bloats every other copy's retention. Returns
+        True if a lease was actually released."""
+        lid = peer_lease_id(node_id)
+        if lid == self._own_lease_id or lid not in self._leases:
+            return False
+        del self._leases[lid]
+        self.leases_released_node_left += 1
+        return True
+
     def has_lease(self, lease_id: str) -> bool:
         return lease_id in self._leases
 
@@ -278,4 +313,5 @@ class ReplicationTracker:
     def lease_stats(self) -> Dict[str, int]:
         return {"active": len(self._leases),
                 "expired_total": self.leases_expired_total,
+                "released_node_left": self.leases_released_node_left,
                 "min_retained_seqno": self.min_retained_seqno()}

@@ -55,11 +55,20 @@ class IndexShard:
                  index_sort=None,
                  check_on_startup=False,
                  soft_deletes_retention_ops: int = 1024,
-                 retention_lease_period_s: float = 12 * 3600):
+                 retention_lease_period_s: float = 12 * 3600,
+                 node_id: Optional[str] = None):
         self.shard_id = shard_id
         self.primary = primary
         self.primary_term = primary_term
         self.allocation_id = allocation_id or uuid_mod.uuid4().hex
+        # which node hosts this copy: keys the primary's OWN retention
+        # lease (node-keyed like every peer lease, so a successor primary
+        # that inherited the lease set can serve this node's return)
+        self.node_id = node_id
+        # the primary's lease set, learned via replication piggyback and
+        # persisted in THIS copy's commits too — the seed a promotion
+        # restores so history promised to departed copies stays promised
+        self._replica_leases: List[Dict[str, Any]] = []
         # soft-deletes knobs (index.soft_deletes.retention.ops /
         # .retention_lease.period) — dynamic via update_retention_settings
         self.soft_deletes_retention_ops = soft_deletes_retention_ops
@@ -83,7 +92,23 @@ class IndexShard:
         self.tracker: Optional[ReplicationTracker] = None
         if primary:
             self._enter_primary_mode()
+        else:
+            # replicas persist the LEARNED lease set: a promotion (or a
+            # primary restarted over this copy's disk) restores it, so
+            # the fleet's retention promises survive the failover
+            self.engine.commit_leases_supplier = \
+                lambda: list(self._replica_leases)
         self._global_checkpoint_replica = -1
+        # [resync_from, max_seqno] at promotion — the ops the new primary
+        # must re-replicate under its new term (PrimaryReplicaSyncer's
+        # window); None until this copy is actually promoted
+        self.resync_from: Optional[int] = None
+        # EVERY copy persists its learned global checkpoint into commits:
+        # after a failover, ops at/below a copy's own persisted gcp are
+        # canonical history no new primary can have diverged from — the
+        # cross-term recovery gate keys on this
+        self.engine.global_checkpoint_supplier = \
+            lambda: self.global_checkpoint
         # shard-level search stats (index/search/stats/SearchStats analog);
         # wand_* track the pruned collector's block-skipping effectiveness
         self.search_stats: Dict[str, int] = {
@@ -117,7 +142,8 @@ class IndexShard:
         self.primary = True
         self.tracker = ReplicationTracker(
             self.allocation_id, self.engine.tracker,
-            lease_retention_seconds=self.retention_lease_period_s)
+            lease_retention_seconds=self.retention_lease_period_s,
+            node_id=self.node_id)
         # primary mode owns history retention: the engine's prune floor
         # folds in the tracker's leases, and every commit persists them
         self.engine.retention_floor_supplier = self._retention_floor
@@ -172,7 +198,8 @@ class IndexShard:
         return self.engine.delete(doc_id, **kw)
 
     def apply_op_on_replica(self, op: Dict[str, Any],
-                            req_primary_term: Optional[int] = None
+                            req_primary_term: Optional[int] = None,
+                            req_global_checkpoint: Optional[int] = None
                             ) -> EngineResult:
         """Apply a primary-assigned operation. op is the replicated wire
         form: {op_type, doc_id, source?, routing?, seqno, version,
@@ -181,16 +208,29 @@ class IndexShard:
         The stale-primary fence compares the SENDING primary's term
         (``req_primary_term``, the request-level term of the reference's
         TransportReplicationAction), not the op's own term: peer recovery
-        legitimately replays history written under OLDER terms after a
-        failover bumped the shard's term. Live replication passes no
-        ``req_primary_term`` and falls back to the op term (for live ops
-        the two are the same)."""
+        and the post-promotion resync legitimately replay history written
+        under OLDER terms after a failover bumped the shard's term. Live
+        replication passes no ``req_primary_term`` and falls back to the
+        op term (for live ops the two are the same).
+
+        A term BUMP is this copy's first contact with a new primacy: the
+        request's global checkpoint is folded in first, then the engine
+        rolls back to the global checkpoint — uncommitted ops from the
+        deposed term are discarded in place (resetEngineToGlobalCheckpoint
+        analog) and the new primary's resync/replication replays forward.
+        A rollback the engine cannot prove safe raises, failing the
+        shard, which routes it to the typed wipe-recovery path."""
         fence_term = req_primary_term if req_primary_term is not None \
             else op["primary_term"]
         if fence_term < self.primary_term:
             raise IllegalArgumentError(
                 f"op primary term [{fence_term}] is below the shard's "
                 f"[{self.primary_term}]")
+        if fence_term > self.primary_term:
+            if req_global_checkpoint is not None:
+                self.update_global_checkpoint_on_replica(
+                    req_global_checkpoint)
+            self.engine.rollback_above(self._global_checkpoint_replica)
         self.primary_term = max(self.primary_term, fence_term)
         self.engine.primary_term = self.primary_term
         if op["op_type"] == "index":
@@ -203,7 +243,8 @@ class IndexShard:
                 op["doc_id"], seqno=op["seqno"], version=op["version"],
                 primary_term=op["primary_term"])
         if op["op_type"] == "noop":
-            self.engine.noop(op["seqno"])
+            self.engine.noop(op["seqno"], reason=op.get("reason") or "",
+                             primary_term=op["primary_term"])
             return EngineResult(op.get("doc_id", ""), op["seqno"],
                                 op["primary_term"], 0, "noop")
         raise IllegalArgumentError(f"unknown op_type [{op['op_type']}]")
@@ -253,20 +294,53 @@ class IndexShard:
         if checkpoint > self._global_checkpoint_replica:
             self._global_checkpoint_replica = checkpoint
 
+    def learn_retention_leases(self, leases) -> None:
+        """Replica learns the primary's lease set (RetentionLeaseSync
+        analog, piggybacked on replication): remembered in memory and
+        persisted into this copy's commits, it is what a promotion
+        restores so history promised to departed copies stays retained
+        under the new primacy."""
+        if leases:
+            self._replica_leases = list(leases)
+
     # ------------------------------------------------------------------
     # failover
     # ------------------------------------------------------------------
 
-    def promote_to_primary(self, new_primary_term: int) -> None:
-        """Replica → primary on failover. Bumps the primary term and fills
-        seqno holes with no-ops so the checkpoint can advance
-        (IndexShard's primary-replica resync analog)."""
+    def promote_to_primary(self, new_primary_term: int,
+                           in_sync_allocations=None) -> int:
+        """Replica → primary on failover. Bumps the primary term, fills
+        seqno holes with no-ops so the checkpoint can advance, and
+        captures the resync window: every op above the global checkpoint
+        this copy knew as a replica must be re-replicated to the other
+        in-sync copies under the NEW term (PrimaryReplicaSyncer analog).
+        Returns the first seqno of that window.
+
+        ``in_sync_allocations`` (the routing table's in-sync set) seeds
+        the fresh tracker: the other copies hold the global checkpoint
+        down at the last replica-learned value until their resync acks
+        report real checkpoints — a freshly promoted primary must not
+        let its own checkpoint masquerade as the fleet's."""
+        resync_from = self._global_checkpoint_replica + 1
         self.primary_term = new_primary_term
         self.engine.primary_term = new_primary_term
         self._enter_primary_mode()
+        # inherit the deposed primary's lease set (learned live, or from
+        # this copy's own commit after a restart): the deposed NODE's own
+        # node-keyed lease is in there, so its return stays ops-based
+        inherited = self._replica_leases or \
+            self.engine.recovered_commit_extra.get("retention_leases")
+        if inherited:
+            self.tracker.restore_leases(inherited)
+        self.tracker.activate_promoted(
+            self._global_checkpoint_replica,
+            [a for a in (in_sync_allocations or [])
+             if a != self.allocation_id])
         tracker = self.engine.tracker
         for seqno in range(tracker.checkpoint + 1, tracker.max_seqno + 1):
             self.engine.noop(seqno, reason="primary promotion hole fill")
+        self.resync_from = resync_from
+        return resync_from
 
     # ------------------------------------------------------------------
     # failure handling

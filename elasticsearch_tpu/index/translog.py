@@ -96,6 +96,10 @@ class Translog:
         # checkpoint bounds it: bytes below the last SYNCED offset are
         # acked history, never a truncatable tail.
         self.truncated_tail_bytes = 0
+        # op-granular trim counters: ops dropped below the retention
+        # floor (history-unified trim) and above a rollback target
+        self.ops_trimmed_below_total = 0
+        self.ops_trimmed_above_total = 0
         if gens:
             self.truncated_tail_bytes = self._recover_tail(
                 gens[-1], self._synced_offset(gens[-1]))
@@ -245,19 +249,66 @@ class Translog:
     def trim_below(self, generation: int,
                    keep_from_seqno: Optional[int] = None) -> None:
         """Delete generations older than ``generation`` (their ops are
-        committed) — EXCEPT, when ``keep_from_seqno`` is given, any
-        generation still holding an op with seqno >= it. Those back the
-        soft-delete operation history across restarts (the reference
-        keeps translog/soft-deleted docs up to the retention floor even
-        after the commit makes them redundant for crash recovery)."""
+        committed) — EXCEPT, when ``keep_from_seqno`` is given, ops with
+        seqno >= it. Those back the soft-delete operation history across
+        restarts (the reference keeps translog/soft-deleted docs up to
+        the retention floor even after the commit makes them redundant
+        for crash recovery). A generation straddling the floor is
+        rewritten op-granular — only ops at/above the floor survive —
+        so translog retention tracks history retention exactly instead
+        of rounding up to whole generations."""
         for gen in self._list_generations():
             if gen >= generation:
                 continue
             if keep_from_seqno is not None and \
                     self._max_seqno_in(gen) >= keep_from_seqno:
+                self.ops_trimmed_below_total += self._rewrite_gen(
+                    gen, lambda op: op.seqno >= keep_from_seqno)
                 continue
             self._gen_path(gen).unlink(missing_ok=True)
             self._gen_max_seqno.pop(gen, None)
+
+    def trim_ops_above(self, seqno: int) -> int:
+        """Drop every retained op with seqno > ``seqno`` across all
+        generations (Translog.trimOperations analog, used by the
+        post-term-bump engine rollback): ops discarded by a rollback
+        must not replay on the next crash recovery. Returns the number
+        of ops dropped."""
+        self._file.flush()
+        dropped = 0
+        for gen in self._list_generations():
+            if self._max_seqno_in(gen) <= seqno:
+                continue
+            dropped += self._rewrite_gen(gen, lambda op: op.seqno <= seqno)
+        self.ops_trimmed_above_total += dropped
+        return dropped
+
+    def _rewrite_gen(self, gen: int, keep) -> int:
+        """Rewrite generation ``gen`` keeping only ops for which
+        ``keep(op)`` is true; returns the number of ops dropped. When
+        ``gen`` is the live generation its append handle (and the
+        checkpoint) are reopened over the rewritten file."""
+        try:
+            ops = list(self._read_gen(gen, min_seqno=0))
+        except ShardCorruptedError:
+            return 0   # unreadable: leave it for the read path to report
+        kept = [op for op in ops if keep(op)]
+        if len(kept) == len(ops):
+            return 0
+        is_current = (gen == self.generation)
+        if is_current:
+            self._file.close()
+        buf = bytearray()
+        for op in kept:
+            payload = json.dumps(op.to_json(),
+                                 separators=(",", ":")).encode("utf-8")
+            buf += _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        self.io.write_bytes(self._gen_path(gen), bytes(buf))
+        self._gen_max_seqno[gen] = max((op.seqno for op in kept), default=-1)
+        if is_current:
+            self._file = open(self._gen_path(gen), "ab")
+            self._write_checkpoint()
+        return len(ops) - len(kept)
 
     def _max_seqno_in(self, gen: int) -> int:
         if gen not in self._gen_max_seqno:

@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from elasticsearch_tpu.cluster.routing import ShardRouting, ShardState
 from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.index.engine import RollbackInfeasibleError
 from elasticsearch_tpu.index.seqno import peer_lease_id
 from elasticsearch_tpu.indices.indices_service import IndicesService
 from elasticsearch_tpu.transport.transport import TransportService
@@ -39,6 +40,7 @@ FILE_FALLBACK_REASONS = (
     "lease_expired",            # no retention lease for the node anymore
     "lease_not_covering",       # lease exists but starts past lcp+1
     "history_pruned",           # lease held, but the history has a hole
+    "rollback_infeasible",      # cross-term tail could not be unwound
 )
 
 
@@ -49,6 +51,14 @@ def new_recovery_stats() -> Dict[str, Any]:
         "bytes_copied": 0,       # wire payload actually shipped
         "bytes_avoided": 0,      # full-snapshot bytes NOT shipped
         "file_fallback_reasons": {"unknown": 0},
+        # failover machinery: post-promotion primary->replica resyncs and
+        # cross-term engine rollbacks (PrimaryReplicaSyncer analog)
+        "resync": {"resyncs_started": 0, "resyncs_completed": 0,
+                   "resyncs_noop": 0, "resync_failures": 0,
+                   "resync_targets": 0, "resync_ops_sent": 0,
+                   "resync_ops_applied": 0},
+        "rollbacks": 0,
+        "ops_rolled_back": 0,
     }
 
 
@@ -58,7 +68,7 @@ def merge_recovery_sections(sections: List[Dict[str, Any]]
     (_cluster/stats fan-out)."""
     out = new_recovery_stats()
     out.update(active_leases=0, leases_expired_total=0,
-               history_retained_ops=0)
+               history_retained_ops=0, leases_released_node_left=0)
     for sec in sections:
         if not isinstance(sec, dict):
             continue
@@ -67,9 +77,12 @@ def merge_recovery_sections(sections: List[Dict[str, Any]]
         for reason, n in (sec.get("file_fallback_reasons") or {}).items():
             out["file_fallback_reasons"][reason] = \
                 out["file_fallback_reasons"].get(reason, 0) + int(n)
+        for key, n in (sec.get("resync") or {}).items():
+            out["resync"][key] = out["resync"].get(key, 0) + int(n)
         for key in ("ops_replayed", "bytes_copied", "bytes_avoided",
                     "active_leases", "leases_expired_total",
-                    "history_retained_ops"):
+                    "history_retained_ops", "leases_released_node_left",
+                    "rollbacks", "ops_rolled_back"):
             out[key] = out.get(key, 0) + int(sec.get(key, 0) or 0)
     return out
 
@@ -91,6 +104,14 @@ class IndicesClusterStateService:
         self.recovery_stats = new_recovery_stats()
         self._recovery_log: deque = deque(maxlen=128)
         self.ts.register_handler(RECOVERY_START, self._on_recovery_start)
+        # post-promotion primary–replica resync (PrimaryReplicaSyncer):
+        # late import — action/replication imports SHARD_FAILED from here
+        from elasticsearch_tpu.action.replication import (
+            PrimaryReplicaSyncer,
+        )
+        self.resyncer = PrimaryReplicaSyncer(
+            node_id, indices_service, transport_service,
+            lambda: self.last_applied)
 
     def _record_recovery(self, sr: ShardRouting, kind: str,
                          ops_replayed: int = 0, bytes_copied: int = 0,
@@ -126,6 +147,33 @@ class IndicesClusterStateService:
         self._remove_stale_local_shards(state)
         self._update_index_metadata(state)
         self._create_or_recover_shards(state)
+        self._release_departed_node_leases(state)
+
+    def _release_departed_node_leases(self, state: ClusterState) -> None:
+        """Early-expire `peer_recovery/<node>` leases for nodes that left
+        the cluster AND whose copy was reallocated: once every copy of the
+        group is active on live nodes, nothing is waiting for the departed
+        disk to return, so pinning history for it only bloats retention.
+        A lease for a node still in the cluster — or one whose group still
+        has an unassigned/initializing copy that may come back to it —
+        keeps aging out on the normal clock instead."""
+        live = set(state.nodes)
+        for shard in self.indices.all_shards():
+            if not shard.primary or shard.tracker is None:
+                continue
+            try:
+                irt = state.routing_table.index(shard.shard_id.index)
+                group = irt.shard_group(shard.shard_id.shard)
+            except Exception:  # noqa: BLE001 — routing gone; normal expiry
+                continue
+            if not all(r.active and r.node_id in live for r in group):
+                continue   # a copy may still return to the departed node
+            for lease in shard.tracker.leases():
+                if not lease.id.startswith("peer_recovery/"):
+                    continue
+                node = lease.id.split("/", 1)[1]
+                if node not in live:
+                    shard.tracker.release_node_lease(node)
 
     def _remove_stale_local_shards(self, state: ClusterState) -> None:
         for index_name in list(self.indices.indices):
@@ -191,8 +239,19 @@ class IndicesClusterStateService:
                     term = state.metadata.index(sr.index).primary_term(
                         sr.shard_id)
                     if sr.primary and not shard.primary:
-                        # replica promoted on failover
-                        shard.promote_to_primary(term)
+                        # replica promoted on failover: seed the tracker
+                        # with every other ACTIVE copy so the global
+                        # checkpoint stays pinned until resync acks prove
+                        # where each one actually is, then re-replicate
+                        # the above-checkpoint tail under the new term
+                        irt = state.routing_table.index(sr.index)
+                        in_sync = [
+                            r.allocation_id
+                            for r in irt.shard_group(sr.shard_id)
+                            if r.active and r.allocation_id is not None]
+                        shard.promote_to_primary(
+                            term, in_sync_allocations=in_sync)
+                        self.resyncer.resync(sr.index, sr.shard_id)
                 elif sr.state == ShardState.STARTED and not local_exists:
                     # routing says this node serves the copy but it is
                     # gone locally — a tragic-event removal whose
@@ -228,7 +287,8 @@ class IndicesClusterStateService:
     # ------------------------------------------------------------------
 
     def _start_recovery(self, state: ClusterState, sr: ShardRouting,
-                        allow_reuse: bool = True) -> None:
+                        allow_reuse: bool = True,
+                        forced_file_reason: Optional[str] = None) -> None:
         metadata = state.metadata.index(sr.index)
         service = self.indices.create_index(metadata)
         term = metadata.primary_term(sr.shard_id)
@@ -300,10 +360,17 @@ class IndicesClusterStateService:
                     # catch-up preserves; UNacked ones are fenced by the
                     # source's global-checkpoint and term gates, which
                     # force the wipe instead of resurrecting them.
+                    # the copy's own persisted global checkpoint rides
+                    # along: cross-term commits whose history fits at or
+                    # under it are still reconcilable by rollback+replay
+                    pgcp = int((shard.engine.recovered_commit_extra or {})
+                               .get("global_checkpoint", -1))
+                    shard.update_global_checkpoint_on_replica(pgcp)
                     local_commit = {
                         "max_seqno": tracker.max_seqno,
                         "local_checkpoint": tracker.checkpoint,
-                        "primary_term": local.get("primary_term", -1)}
+                        "primary_term": local.get("primary_term", -1),
+                        "global_checkpoint": pgcp}
                 except Exception as e:  # noqa: BLE001 — fall back fresh
                     logger.warning(
                         "[%s] local reuse probe of [%s][%s] failed (%s); "
@@ -341,6 +408,30 @@ class IndicesClusterStateService:
                     shard = service.create_shard(
                         sr.shard_id, primary=False, primary_term=term,
                         allocation_id=sr.allocation_id, fresh_store=True)
+                if ops_based and resp.get("rollback_to") is not None:
+                    # cross-term reconciliation: the source vouched only
+                    # for history at/under rollback_to — unwind this
+                    # copy's possibly-divergent tail first, then the
+                    # replay below extends pure canonical history
+                    try:
+                        shard.engine.rollback_above(
+                            int(resp["rollback_to"]))
+                    except RollbackInfeasibleError as e:
+                        # typed refusal: the tail cannot be PROVEN
+                        # unwindable (history pruned past it and the
+                        # segment copy merged away) — wipe and pay the
+                        # full copy rather than serve a maybe-divergent
+                        # doc, keeping "unknown" pinned at zero
+                        logger.warning(
+                            "[%s] cross-term rollback of [%s][%s] "
+                            "infeasible (%s); wiping for full copy",
+                            self.node_id, sr.index, sr.shard_id, e)
+                        service.remove_shard(sr.shard_id)
+                        self._start_recovery(
+                            self.last_applied or state, sr,
+                            allow_reuse=False,
+                            forced_file_reason="rollback_infeasible")
+                        return
                 for op in resp["ops"]:
                     # historical ops keep their original terms; the fence
                     # term is the recovery source's CURRENT primary term.
@@ -355,6 +446,7 @@ class IndicesClusterStateService:
                     shard.engine.noop(seqno, reason="recovery hole fill")
                 shard.update_global_checkpoint_on_replica(
                     resp["global_checkpoint"])
+                shard.learn_retention_leases(resp.get("retention_leases"))
                 shard.engine.refresh()
             except Exception as e:  # noqa: BLE001 — reported to master
                 service.remove_shard(sr.shard_id)
@@ -369,9 +461,12 @@ class IndicesClusterStateService:
                 bytes_copied=int(resp.get("bytes_copied", 0) or 0),
                 bytes_avoided=int(resp.get("bytes_avoided", 0) or 0),
                 # a typed reason is only meaningful when a local copy
-                # EXISTED and was refused — a fresh copy isn't a fallback
+                # EXISTED and was refused — a fresh copy isn't a
+                # fallback, EXCEPT when this recovery is itself the wipe
+                # restart of a refused rollback (the forced reason)
                 file_reason=(resp.get("file_reason") or "unknown")
-                if mode == "file" and local_commit is not None else None,
+                if mode == "file" and local_commit is not None
+                else (forced_file_reason if mode == "file" else None),
                 source_node=resp.get("source_node"))
             self._watch_engine(service, shard, sr)
             self._shard_started(sr)
@@ -497,39 +592,81 @@ class IndicesClusterStateService:
         mode = "file"
         file_reason: Optional[str] = None
         send_ops = ops
+        rollback_to: Optional[int] = None
         local_commit = req.get("local_commit") or None
+
+        def ops_if_covered(replay_from: int,
+                           check_covering: bool = True) -> None:
+            # ops-based catch-up: only when this NODE's retention lease
+            # still covers everything the target must replay AND the
+            # soft-delete history actually has it (the lease is the
+            # promise; the history is the proof). A rollback-directed
+            # catch-up skips the covering check: a deposed primary's own
+            # lease retains from ITS high checkpoint, above the bound it
+            # is told to roll back to — there the history completeness
+            # check below is the entire (and sufficient) proof.
+            nonlocal mode, file_reason, send_ops
+            shard.tracker.expire_leases()
+            lease = shard.tracker.get_lease(peer_lease_id(sender))
+            if lease is None:
+                file_reason = "lease_expired"
+            elif check_covering and lease.retaining_seqno > replay_from:
+                file_reason = "lease_not_covering"
+            else:
+                hist_ops, complete = \
+                    shard.engine.ops_history_snapshot(replay_from)
+                if not complete:
+                    file_reason = "history_pruned"
+                else:
+                    mode = "ops"
+                    send_ops = hist_ops
+
         if local_commit is not None:
             lcp = int(local_commit.get("local_checkpoint", -1))
             lmax = int(local_commit.get("max_seqno", -1))
             lterm = int(local_commit.get("primary_term", -1))
             if not (lcp == lmax >= 0):
                 file_reason = "stale_commit"
-            elif lterm != shard.primary_term:
-                file_reason = "term_mismatch"
-            elif lmax > shard.global_checkpoint:
-                file_reason = "beyond_global_checkpoint"
-            elif lmax == max_seqno:
-                mode = "reuse"
-                send_ops = []
-            else:
-                # ops-based catch-up: only when this NODE's retention
-                # lease still covers everything past the target's
-                # checkpoint AND the soft-delete history actually has it
-                # (the lease is the promise; the history is the proof)
-                shard.tracker.expire_leases()
-                lease = shard.tracker.get_lease(peer_lease_id(sender))
-                if lease is None:
-                    file_reason = "lease_expired"
-                elif lease.retaining_seqno > lmax + 1:
-                    file_reason = "lease_not_covering"
+            elif lterm == shard.primary_term:
+                # same-primacy commit: the original three-way decision
+                if lmax > shard.global_checkpoint:
+                    file_reason = "beyond_global_checkpoint"
+                elif lmax == max_seqno:
+                    mode = "reuse"
+                    send_ops = []
                 else:
-                    hist_ops, complete = \
-                        shard.engine.ops_history_snapshot(lmax + 1)
-                    if not complete:
-                        file_reason = "history_pruned"
+                    ops_if_covered(lmax + 1)
+            else:
+                # CROSS-TERM commit. The target's own persisted global
+                # checkpoint bounds its canonical prefix: every op it
+                # holds at/under that gcp was in-sync-everywhere when it
+                # learned the value, so no primacy since can have
+                # rewritten those seqnos. Ops ABOVE it may be a deposed
+                # primary's unacked tail — reconcilable by directing the
+                # target to roll back to the bound and replaying forward
+                # from retained history. Only a commit with NO persisted
+                # gcp is genuinely unverifiable cross-term.
+                pgcp = int(local_commit.get("global_checkpoint", -1))
+                # defensive floor: never trust a persisted gcp past what
+                # this primary itself knows to be globally acked
+                canon = min(pgcp, shard.global_checkpoint)
+                if pgcp < 0:
+                    file_reason = "term_mismatch"
+                elif lmax <= canon:
+                    # fully canonical cross-term history: as good as a
+                    # same-term commit — reuse or plain ops catch-up
+                    if lmax == max_seqno:
+                        mode = "reuse"
+                        send_ops = []
                     else:
-                        mode = "ops"
-                        send_ops = hist_ops
+                        ops_if_covered(lmax + 1)
+                else:
+                    # divergent-possible tail above the canonical bound:
+                    # rollback+replay from there, lease permitting
+                    rollback_to = min(lmax, canon)
+                    ops_if_covered(rollback_to + 1, check_covering=False)
+                    if mode != "ops":
+                        rollback_to = None
         # payload accounting: what actually ships vs the full snapshot
         # the file path would have shipped (the cost ops-based avoids)
         bytes_full = len(json.dumps(ops))
@@ -539,19 +676,30 @@ class IndicesClusterStateService:
         # (createMissingPeerRecoveryRetentionLeases analog), renewed from
         # here on by its checkpoint advances riding replication acks —
         # so its NEXT restart within the retention window is ops-based
+        if mode == "reuse":
+            retaining = lmax + 1
+        elif mode == "ops":
+            # with a rollback directive the copy's guaranteed floor is
+            # the rollback bound, not its (about-to-be-unwound) lmax
+            retaining = (rollback_to if rollback_to is not None
+                         else lmax) + 1
+        else:
+            retaining = max_seqno + 1
         shard.tracker.init_tracking(
             req["allocation_id"], lease_id=peer_lease_id(sender),
-            retaining_seqno=(lmax + 1 if mode in ("reuse", "ops")
-                             else max_seqno + 1))
+            retaining_seqno=retaining)
         shard.tracker.mark_in_sync(req["allocation_id"], max_seqno)
         return {"mode": mode, "ops": send_ops, "max_seqno": max_seqno,
                 "reuse": mode == "reuse",
+                "rollback_to": rollback_to,
                 "file_reason": file_reason,
                 "bytes_copied": bytes_sent,
                 "bytes_avoided": max(0, bytes_full - bytes_sent),
                 "source_node": self.node_id,
                 "global_checkpoint": shard.global_checkpoint,
-                "primary_term": shard.primary_term}
+                "primary_term": shard.primary_term,
+                "retention_leases": [
+                    lease.to_dict() for lease in shard.tracker.leases()]}
 
     # ------------------------------------------------------------------
     # master notifications

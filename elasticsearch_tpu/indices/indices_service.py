@@ -39,12 +39,13 @@ class IndexService:
 
     def __init__(self, metadata: IndexMetadata,
                  data_path: Optional[str] = None,
-                 disk_io=None):
+                 disk_io=None, node_id: Optional[str] = None):
         self.metadata = metadata
         self.mapper_service = MapperService(dict(metadata.mappings) or None)
         self.shards: Dict[int, IndexShard] = {}
         self.data_path = data_path
         self.disk_io = disk_io
+        self.node_id = node_id
 
     def _shard_paths(self, shard: int, fresh_store: bool = False):
         if self.data_path is None:
@@ -86,7 +87,8 @@ class IndexService:
             check_on_startup=settings.get(
                 "index.shard.check_on_startup", False),
             soft_deletes_retention_ops=retention_ops,
-            retention_lease_period_s=lease_period)
+            retention_lease_period_s=lease_period,
+            node_id=self.node_id)
         self.shards[shard] = index_shard
         return index_shard
 
@@ -125,9 +127,11 @@ class IndexService:
 
 
 class IndicesService:
-    def __init__(self, data_path: Optional[str] = None, disk_io=None):
+    def __init__(self, data_path: Optional[str] = None, disk_io=None,
+                 node_id: Optional[str] = None):
         self.indices: Dict[str, IndexService] = {}
         self.data_path = data_path
+        self.node_id = node_id
         # the DiskIO seam every shard Store/Translog writes through
         # (None = the shared default); the chaos harness injects a faulty
         # implementation here
@@ -137,7 +141,7 @@ class IndicesService:
         if metadata.name in self.indices:
             return self.indices[metadata.name]
         service = IndexService(metadata, data_path=self.data_path,
-                               disk_io=self.disk_io)
+                               disk_io=self.disk_io, node_id=self.node_id)
         self.indices[metadata.name] = service
         return service
 

@@ -54,7 +54,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 # unrecognized cause maps to "unknown", which tests pin at zero (the
 # search-telemetry fallback-taxonomy precedent)
 INVALIDATION_CAUSES = ("refresh", "delete", "merge", "restore", "clear",
-                       "disabled", "unknown")
+                       "disabled", "rollback", "unknown")
 
 
 def _typed_cause(raw: Any) -> str:

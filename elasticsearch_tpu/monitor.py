@@ -379,18 +379,34 @@ def recovery_stats(reconciler, indices_service=None) -> Dict[str, Any]:
         "file_fallback_reasons": dict(
             reconciler.recovery_stats["file_fallback_reasons"]),
         "active_leases": 0, "leases_expired_total": 0,
+        "leases_released_node_left": 0,
         "history_retained_ops": 0,
+        # failover machinery: post-promotion resyncs this node ran as a
+        # new primary, and cross-term rollbacks its engines performed
+        "resync": dict(resyncer.stats) if (
+            resyncer := getattr(reconciler, "resyncer", None)) is not None
+        else {},
+        "rollbacks": 0, "ops_rolled_back": 0,
+        "translog_ops_trimmed": 0,
     }
     if indices_service is not None:
         for shard in list(indices_service.all_shards()):
             try:
                 out["history_retained_ops"] += \
                     shard.engine.history_stats()["retained_ops"]
+                out["rollbacks"] += shard.engine.rollbacks_total
+                out["ops_rolled_back"] += shard.engine.ops_rolled_back_total
+                if shard.engine.translog is not None:
+                    out["translog_ops_trimmed"] += \
+                        shard.engine.translog.ops_trimmed_below_total + \
+                        shard.engine.translog.ops_trimmed_above_total
                 if shard.tracker is not None:
                     lease_stats = shard.tracker.lease_stats()
                     out["active_leases"] += lease_stats["active"]
                     out["leases_expired_total"] += \
                         lease_stats["expired_total"]
+                    out["leases_released_node_left"] += \
+                        lease_stats["released_node_left"]
             except Exception:  # noqa: BLE001 — a closing shard is fine
                 continue
     return out

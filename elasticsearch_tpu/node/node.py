@@ -73,7 +73,8 @@ class Node:
         self.transport_service = transport_service or \
             TransportService(node_id, transport)
         self.indices_service = IndicesService(data_path=data_path,
-                                              disk_io=disk_io)
+                                              disk_io=disk_io,
+                                              node_id=node_id)
         self.allocation_service = AllocationService()
 
         # gateway allocation (gateway.py GatewayAllocator): every node

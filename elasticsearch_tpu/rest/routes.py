@@ -1765,6 +1765,22 @@ def build_controller(client: NodeClient) -> RestController:
                 rows.append([sr.index, str(sr.shard_id), "existing_store",
                              "done", sr.node_id or "-", "-", "0", "0",
                              "0", "-"])
+        # post-promotion resync summary (PrimaryReplicaSyncer): one row
+        # when this node has ever run one, so a failover's re-replication
+        # is visible next to the recoveries it avoided
+        resyncer = getattr(client.node.reconciler, "resyncer", None)
+        if resyncer is not None and (
+                resyncer.stats["resyncs_started"] or
+                resyncer.stats["resyncs_noop"] or
+                resyncer.stats["resync_failures"]):
+            rs = resyncer.stats
+            rows.append([
+                "-", "-", "resync", "done", client.node.node_id, "-",
+                str(rs["resync_ops_sent"]), "0", "0",
+                f"started={rs['resyncs_started']}"
+                f",completed={rs['resyncs_completed']}"
+                f",noop={rs['resyncs_noop']}"
+                f",failed={rs['resync_failures']}"])
         done(200, _cat(req, ["index", "shard", "type", "stage", "node",
                              "source_node", "ops", "bytes",
                              "bytes_avoided", "fallback_reason"], rows))

@@ -967,6 +967,241 @@ def rolling_restart_recovery_scenario(seed: int, data_path: str, *,
         c.stop()
 
 
+def failover_under_live_writes_scenario(seed: int, data_path: str, *,
+                                        n_tenants: int = 3,
+                                        n_nodes: int = 3, docs: int = 6,
+                                        writes: int = 24,
+                                        total_searches: int = 100,
+                                        duration_s: float = 1.4
+                                        ) -> Dict[str, Any]:
+    """THE failover tentpole scenario, one seed: kill the node holding
+    primaries mid-flood, with 2 replicas per shard so every node holds
+    every copy. The master detects the death, promotes a surviving
+    replica (term bump + tracker seeding + post-promotion resync), the
+    other survivor rolls its deposed-term tail back to the global
+    checkpoint and replays the new primacy's history, and the DEPOSED
+    primary later reboots into a cross-term commit whose tail the new
+    primary reconciles by rollback+replay — the ops path, not a wipe.
+
+    Asserts per seed: zero lost acked docs, zero wrong hits, at least
+    one resync ran (started or noop), the deposed copy rejoined without
+    a ``peer`` wipe, and the typed fallback ``unknown`` bucket stays
+    pinned at zero. Returns the measured invariants; bench.py emits
+    them as the ``recovery`` config's failover line."""
+    c = InProcessCluster(n_nodes=n_nodes, seed=seed, data_path=data_path)
+    c.start()
+    try:
+        import numpy as np
+        tenants = [f"t{i}" for i in range(n_tenants)]
+        client = c.client()
+        rng = np.random.default_rng(seed)
+        box: List[Any] = []
+
+        def wait(n: int) -> None:
+            c.run_until(lambda: len(box) >= n, 300.0)
+
+        for tenant in tenants:
+            n0 = len(box)
+            client.create_index(tenant, {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": n_nodes - 1},
+                "mappings": {"properties": {"body": {"type": "text"}}}},
+                lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+            c.ensure_green(tenant)
+            for i in range(docs):
+                n0 = len(box)
+                client.index_doc(
+                    tenant, f"d{i}",
+                    {"body": "common " + " ".join(
+                        f"w{int(x)}" for x in rng.integers(0, 8, 4))},
+                    lambda r, e=None: box.append(1))
+                wait(n0 + 1)
+            n0 = len(box)
+            client.refresh(tenant, lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+        # flush everywhere so every copy — including the one about to be
+        # deposed — holds a commit with its learned global checkpoint:
+        # the cross-term recovery gate keys on that persisted value
+        n0 = len(box)
+        client.flush("t*", lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+
+        # the victim: a PRIMARY-holding node (prefer a non-master one so
+        # the failover is a clean promotion, not promotion + election)
+        master_id = c.master().node_id
+        state = c.master().coordinator.applied_state
+        primaries_on: Dict[str, int] = {}
+        for tenant in tenants:
+            for sr in state.routing_table.index(tenant).shard_group(0):
+                if sr.primary and sr.node_id is not None:
+                    primaries_on[sr.node_id] = \
+                        primaries_on.get(sr.node_id, 0) + 1
+        candidates = sorted(
+            primaries_on, key=lambda n: (n == master_id,
+                                         -primaries_on[n], n))
+        victim = candidates[0]
+        affected = [t for t in tenants
+                    if state.routing_table.index(t).primary(0).node_id
+                    == victim]
+
+        coordinators = [nid for nid in c._node_ids if nid != victim][:2]
+        harness = FleetTrafficHarness(c, tenants, coordinators, seed)
+
+        # live writes across the whole window: some land before the
+        # kill (and may sit unacked on the doomed primary), some hit
+        # the promotion gap, some land on the new primacy
+        acked: Dict[str, set] = {t: set() for t in tenants}
+        attempted: Dict[str, set] = {t: set() for t in tenants}
+        writes_done = {"n": 0}
+        writer = c.nodes[coordinators[0]].client
+
+        def submit_write(k: int) -> None:
+            tenant = tenants[k % n_tenants]
+            doc_id = f"w{k}"
+            attempted[tenant].add(doc_id)
+
+            def on_write(r, e=None, t=tenant, d=doc_id) -> None:
+                writes_done["n"] += 1
+                if e is None:
+                    acked[t].add(d)
+            writer.index_doc(tenant, doc_id,
+                             {"body": f"common live{k}"}, on_write)
+
+        events: List[Tuple[float, Callable[[], None]]] = []
+        for k in range(writes):
+            events.append((duration_s * (0.05 + 0.9 * k / max(writes, 1)),
+                           lambda kk=k: submit_write(kk)))
+        events.append((0.35 * duration_s, lambda: c.kill_node(victim)))
+
+        harness.run(duration_s, total_searches, events=events)
+        summary = harness.summary()
+
+        c.run_until(lambda: writes_done["n"] >= writes, 300.0)
+
+        # wait for the failover to actually land: every affected tenant
+        # must have a STARTED primary on a SURVIVING node (the master's
+        # failure detection + promotion takes fault-detection rounds of
+        # virtual time — the victim stays down until this is proven)
+        def promoted() -> bool:
+            master = c.master()
+            if master is None:
+                return False
+            st = master.coordinator.applied_state
+            for tenant in affected:
+                sr = st.routing_table.index(tenant).primary(0)
+                sr_ok = sr.node_id is not None and sr.node_id != victim \
+                    and sr.node_id in c.nodes and \
+                    sr.state == ShardState.STARTED
+                if not sr_ok:
+                    return False
+            return True
+        from elasticsearch_tpu.cluster.routing import ShardState
+        c.run_until(promoted, 900.0)
+
+        # writes into the NEW primacy: the deposed copy's commit is now
+        # genuinely behind a different term's history, so its rejoin
+        # must take the cross-term rollback+replay path, not reuse
+        post_writes = max(4, writes // 4)
+        for k in range(post_writes):
+            submit_write(writes + k)
+        c.run_until(lambda: writes_done["n"] >= writes + post_writes,
+                    300.0)
+
+        # the deposed primary reboots over its old data path
+        fresh = c._build_node(victim)
+        c.nodes[victim] = fresh
+        fresh.start()
+
+        # settle: every STARTED copy must really exist where routed —
+        # including the deposed primary's rebuilt replica copy
+        from elasticsearch_tpu.cluster.routing import ShardState
+
+        def settled() -> bool:
+            master = c.master()
+            if master is None:
+                return False
+            st = master.coordinator.applied_state
+            for tenant in tenants:
+                for sr in st.routing_table.index(tenant).shard_group(0):
+                    if sr.state != ShardState.STARTED or \
+                            sr.node_id not in c.nodes:
+                        return False
+                    if not c.nodes[sr.node_id].indices_service.has_shard(
+                            tenant, 0):
+                        return False
+            return True
+        c.run_until(settled, 900.0)
+        for tenant in tenants:
+            c.ensure_green(tenant, max_time=600.0)
+        n0 = len(box)
+        client.refresh("t*", lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+
+        # how the deposed primary's copies came back (its fresh
+        # reconciler's log): the cross-term gate must have reconciled
+        # them ops-based (rollback+replay) or reused them — never wiped
+        deposed_log = c.nodes[victim].reconciler.recovery_log()
+        deposed_kinds = [e["kind"] for e in deposed_log
+                         if e["index"] in tenants]
+        deposed_wipes = sum(1 for k in deposed_kinds if k == "peer")
+        deposed_ops_based = sum(1 for k in deposed_kinds
+                                if k == "ops_based")
+
+        # fleet resync + rollback accounting (riding _nodes/stats paths)
+        resync = {"resyncs_started": 0, "resyncs_completed": 0,
+                  "resyncs_noop": 0, "resync_failures": 0,
+                  "resync_ops_sent": 0, "resync_ops_applied": 0,
+                  "resync_targets": 0}
+        for node in c.nodes.values():
+            for key, n in node.reconciler.resyncer.stats.items():
+                resync[key] = resync.get(key, 0) + n
+
+        # zero lost acked docs + known-answer exactness per tenant
+        lost_acked = 0
+        wrong_hits = 0
+        for tenant in tenants:
+            probe: List[Any] = []
+            client.search(tenant, {
+                "query": {"match": {"body": "common"}},
+                "size": docs + writes + 8, "track_total_hits": True},
+                lambda r, e=None: probe.append((r, e)))
+            c.run_until(lambda: bool(probe), 300.0)
+            resp, err = probe[0]
+            if err is not None:
+                wrong_hits += 1
+                continue
+            got = {h["_id"] for h in resp["hits"]["hits"]}
+            must = {f"d{i}" for i in range(docs)} | acked[tenant]
+            may = must | attempted[tenant]
+            lost_acked += len(must - got)
+            if not got <= may:
+                wrong_hits += 1
+
+        fleet = _merged_recovery_stats(c)
+        summary.update({
+            "seed": seed,
+            "victim": victim,
+            "victim_was_master": victim == master_id,
+            "affected_tenants": affected,
+            "deposed_recovery_kinds": deposed_kinds,
+            "deposed_wipe_recoveries": deposed_wipes,
+            "deposed_ops_based": deposed_ops_based,
+            "resync": resync,
+            "rollbacks": fleet.get("rollbacks", 0),
+            "ops_rolled_back": fleet.get("ops_rolled_back", 0),
+            "acked_writes": sum(len(s) for s in acked.values()),
+            "lost_acked_docs": lost_acked,
+            "wrong_hits": wrong_hits,
+            "fleet_recovery": fleet,
+            "unknown_fallbacks": (fleet.get("file_fallback_reasons") or
+                                  {}).get("unknown", 0),
+        })
+        return summary
+    finally:
+        c.stop()
+
+
 def duplicate_flood_cache_shed_scenario(seed: int, *, n_tenants: int = 3,
                                         n_nodes: int = 5, docs: int = 8,
                                         hot_searches: int = 90,

@@ -1,0 +1,333 @@
+"""Failover-safe replication: post-promotion rollback, primary–replica
+resync, and cross-term ops-based recovery.
+
+A killed primary must not cost a single acked doc nor force a single
+store wipe: a surviving replica is promoted (term bump + tracker
+seeding + inherited lease set), it re-replicates its above-checkpoint
+tail to the other in-sync copies (PrimaryReplicaSyncer analog), each
+of those rolls its deposed-term tail back to the global checkpoint and
+replays forward (resetEngineToGlobalCheckpoint analog), and the deposed
+primary itself later rejoins through the CROSS-TERM recovery gate —
+its commit's persisted global checkpoint bounds the canonical prefix,
+the divergent-possible tail is unwound by a rollback directive, and
+the replay extends pure canonical history. Every refusal stays typed;
+"unknown" stays pinned at zero.
+
+Reference analogs: index/shard/PrimaryReplicaSyncer.java,
+IndexShard#resetEngineToGlobalCheckpoint,
+RecoverySourceHandler's ops-vs-file decision, RetentionLeases
+replication (RetentionLeaseSyncAction).
+"""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.cluster.metadata import IndexMetadata
+from elasticsearch_tpu.index.engine import RollbackInfeasibleError
+from elasticsearch_tpu.index.seqno import (
+    LocalCheckpointTracker,
+    ReplicationTracker,
+    peer_lease_id,
+)
+from elasticsearch_tpu.indices.indices_service import IndicesService
+from elasticsearch_tpu.testing import (
+    InProcessCluster,
+    failover_under_live_writes_scenario,
+)
+
+pytestmark = pytest.mark.recovery
+
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+
+def _ok(resp, err):
+    assert err is None, f"unexpected error: {err}"
+    return resp
+
+
+def _mk_shard(tmp_path, name="i", node_id="nodeA"):
+    svc = IndicesService(data_path=str(tmp_path), node_id=node_id)
+    isvc = svc.create_index(IndexMetadata.create(
+        name, number_of_shards=1, number_of_replicas=0))
+    return svc, isvc, isvc.create_shard(0, primary=True, primary_term=1)
+
+
+# ---------------------------------------------------------------------------
+# unit level: engine rollback (resetEngineToGlobalCheckpoint analog)
+# ---------------------------------------------------------------------------
+
+def test_rollback_above_discards_tail_and_restores_prior_state(tmp_path):
+    """Rollback to a target below refreshed ops: the overwrite reverts,
+    the delete un-deletes, the new doc vanishes, watermarks and history
+    shrink to the target, and the translog tail is trimmed — all in
+    place, no wipe."""
+    svc, isvc, shard = _mk_shard(tmp_path / "rb")
+    eng = shard.engine
+    for i in range(5):
+        shard.apply_index_on_primary(f"d{i}", {"n": i})        # seqno 0-4
+    eng.refresh()
+    shard.apply_index_on_primary("d1", {"n": 101})             # seqno 5
+    shard.apply_delete_on_primary("d2")                        # seqno 6
+    shard.apply_index_on_primary("d9", {"n": 9})               # seqno 7
+    eng.refresh()
+    assert eng.get("d1")["_source"] == {"n": 101}
+
+    dropped = eng.rollback_above(4)
+    assert dropped == 3
+    assert eng.tracker.max_seqno == 4 and eng.tracker.checkpoint == 4
+    assert eng.get("d1")["_source"] == {"n": 1}, "overwrite must revert"
+    assert eng.get("d2")["_source"] == {"n": 2}, "delete must un-delete"
+    assert eng.get("d9") is None, "new doc must vanish"
+    assert eng.rollbacks_total == 1 and eng.ops_rolled_back_total == 3
+    ops, complete = eng.ops_history_snapshot(0)
+    assert complete and [op["seqno"] for op in ops] == list(range(5))
+    assert eng.translog.ops_trimmed_above_total >= 3
+    # rolling back to (or above) the max is a no-op, not an error
+    assert eng.rollback_above(4) == 0
+    assert eng.rollbacks_total == 1
+
+
+def test_rollback_survives_crash_reopen(tmp_path):
+    """The rollback flushes: a crash right after reopens into the
+    rolled-back state, not the discarded tail (no zombie resurrection
+    through commit or translog replay)."""
+    path = tmp_path / "crash"
+    svc, isvc, shard = _mk_shard(path)
+    for i in range(4):
+        shard.apply_index_on_primary(f"d{i}", {"n": i})        # 0-3
+    shard.engine.flush()
+    shard.apply_index_on_primary("d0", {"n": 100})             # 4
+    shard.apply_index_on_primary("d8", {"n": 8})               # 5
+    shard.engine.refresh()
+    shard.engine.rollback_above(3)
+
+    # "crash": reopen fresh services over the same data path
+    meta = isvc.metadata
+    svc2 = IndicesService(data_path=str(path), node_id="nodeA")
+    isvc2 = svc2.create_index(meta)
+    shard2 = isvc2.create_shard(0, primary=True, primary_term=1,
+                                fresh_store=False)
+    shard2.engine.recover_from_store()
+    assert shard2.engine.tracker.max_seqno == 3
+    assert shard2.engine.get("d0")["_source"] == {"n": 0}
+    assert shard2.engine.get("d8") is None
+
+
+def test_rollback_infeasible_is_typed_and_mutation_free(tmp_path):
+    """A tail that cannot be PROVEN unwindable (history pruned past the
+    target AND the prior copy merged away) raises the typed error and
+    leaves the engine untouched — never a silent half-rollback."""
+    svc, isvc, shard = _mk_shard(tmp_path / "inf")
+    eng = shard.engine
+    for i in range(4):
+        shard.apply_index_on_primary(f"d{i}", {"n": i})        # 0-3
+    eng.refresh()
+    shard.apply_index_on_primary("d1", {"n": 101})             # 4
+    eng.refresh()
+    eng.force_merge(1)      # purges d1's seqno-1 incarnation from segments
+    # white-box: prune retained history past the target, so neither
+    # rule (history op / segment copy / provable absence) can decide d1
+    for s in (0, 1, 2, 3):
+        eng._op_history.pop(s, None)
+    eng._history_min = 4
+    before = (eng.tracker.max_seqno, eng.get("d1")["_source"])
+    with pytest.raises(RollbackInfeasibleError):
+        eng.rollback_above(3)
+    assert (eng.tracker.max_seqno, eng.get("d1")["_source"]) == before
+    assert eng.rollbacks_total == 0
+
+
+# ---------------------------------------------------------------------------
+# unit level: promoted-tracker seeding + node-left lease release
+# ---------------------------------------------------------------------------
+
+def test_activate_promoted_pins_global_checkpoint():
+    """A freshly promoted primary's global checkpoint must start from
+    the replica-learned value and stay pinned there while other in-sync
+    copies have unknown checkpoints — never jump to its own."""
+    local = LocalCheckpointTracker()
+    for s in range(8):
+        local.mark_processed(s)          # own checkpoint: 7
+    tracker = ReplicationTracker("alloc_new", local, node_id="nodeN")
+    tracker.activate_promoted(4, ["alloc_other"])
+    assert tracker.global_checkpoint == 4, \
+        "promotion must not let the promoted copy's own checkpoint " \
+        "masquerade as the fleet's"
+    # the resync ack reports where the other copy really is → advance
+    tracker.mark_in_sync("alloc_other", 7)
+    assert tracker.global_checkpoint == 7
+
+
+def test_release_node_lease_drops_only_departed_peers():
+    local = LocalCheckpointTracker()
+    tracker = ReplicationTracker("alloc_p", local, node_id="nodeP")
+    tracker.init_tracking("alloc_r", lease_id=peer_lease_id("nodeR"),
+                          retaining_seqno=0)
+    assert tracker.release_node_lease("nodeP") is False, \
+        "the primary's own lease must never be released"
+    assert tracker.release_node_lease("ghost") is False
+    assert tracker.release_node_lease("nodeR") is True
+    assert not tracker.has_lease(peer_lease_id("nodeR"))
+    assert tracker.lease_stats()["released_node_left"] == 1
+
+
+# ---------------------------------------------------------------------------
+# source-side cross-term recovery gate (white-box on a live primary)
+# ---------------------------------------------------------------------------
+
+def _gate_fixture(tmp_path, seed=41):
+    """A 2-node cluster with one replicated index and 6 acked docs: the
+    primary's recovery-start handler is then probed directly with
+    crafted cross-term local commits."""
+    c = InProcessCluster(n_nodes=2, seed=seed,
+                         data_path=str(tmp_path / f"gate{seed}"))
+    c.start()
+    client = c.client()
+    _ok(*c.call(lambda cb: client.create_index("i", {
+        "settings": {"number_of_shards": 1,
+                     "number_of_replicas": 1}}, cb)))
+    c.ensure_green("i")
+    for k in range(6):
+        _ok(*c.call(lambda cb, k=k: client.index_doc(
+            "i", f"d{k}", {"n": k}, cb)))
+    _ok(*c.call(lambda cb: client.flush("i", cb)))
+    state = c.master().coordinator.applied_state
+    pid = state.routing_table.index("i").primary(0).node_id
+    node = c.nodes[pid]
+    shard = node.indices_service.shard("i", 0)
+    # a ghost node's lease, covering from 0 — the crafted commits below
+    # pretend to be that node's returning copy
+    shard.tracker.add_lease(peer_lease_id("ghost"), 0, "peer_recovery")
+    return c, node, shard
+
+
+def test_cross_term_gate_decisions(tmp_path):
+    c, node, shard = _gate_fixture(tmp_path)
+    try:
+        gcp = shard.global_checkpoint
+        mx = shard.engine.tracker.max_seqno
+        assert gcp == mx == 5
+        term = shard.primary_term
+
+        def probe(commit, alloc):
+            # each probe registers "ghost" anew and advances its lease;
+            # reset to full coverage so probes stay independent
+            shard.tracker.add_lease(
+                peer_lease_id("ghost"), 0, "peer_recovery")
+            return node.reconciler._on_recovery_start(
+                {"index": "i", "shard": 0, "allocation_id": alloc,
+                 "local_commit": commit}, "ghost")
+
+        # 1. cross-term commit, fully canonical, identical → REUSE
+        resp = probe({"max_seqno": mx, "local_checkpoint": mx,
+                      "primary_term": term - 1,
+                      "global_checkpoint": mx}, "x1")
+        assert resp["mode"] == "reuse" and resp["rollback_to"] is None
+
+        # 2. cross-term, canonical but behind → plain ops catch-up
+        resp = probe({"max_seqno": 3, "local_checkpoint": 3,
+                      "primary_term": term - 1,
+                      "global_checkpoint": 3}, "x2")
+        assert resp["mode"] == "ops" and resp["rollback_to"] is None
+        assert [op["seqno"] for op in resp["ops"]] == [4, 5]
+
+        # 3. cross-term, tail above its own persisted gcp → ops with a
+        #    rollback directive at the canonical bound
+        resp = probe({"max_seqno": 4, "local_checkpoint": 4,
+                      "primary_term": term - 1,
+                      "global_checkpoint": 2}, "x3")
+        assert resp["mode"] == "ops" and resp["rollback_to"] == 2
+        assert [op["seqno"] for op in resp["ops"]] == [3, 4, 5]
+
+        # 4. cross-term, NO persisted gcp → genuinely unverifiable:
+        #    typed term_mismatch wipe
+        resp = probe({"max_seqno": 4, "local_checkpoint": 4,
+                      "primary_term": term - 1}, "x4")
+        assert resp["mode"] == "file"
+        assert resp["file_reason"] == "term_mismatch"
+
+        # 5. same-term behind stays the plain ops path (unchanged)
+        resp = probe({"max_seqno": 4, "local_checkpoint": 4,
+                      "primary_term": term,
+                      "global_checkpoint": 4}, "x5")
+        assert resp["mode"] == "ops" and resp["rollback_to"] is None
+
+        # 6. a persisted gcp NEVER outranks what the primary itself
+        #    knows to be acked: claims above it are clamped, not trusted
+        resp = probe({"max_seqno": mx, "local_checkpoint": mx,
+                      "primary_term": term - 1,
+                      "global_checkpoint": mx + 50}, "x6")
+        assert resp["mode"] == "reuse"   # canon = min(claim, source gcp)
+
+        # the response always carries the lease set for the target
+        assert any(lease["id"] == peer_lease_id("ghost")
+                   for lease in resp["retention_leases"])
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# cluster level: promotion inherits leases, resync converges the fleet
+# ---------------------------------------------------------------------------
+
+def test_promotion_resync_converges_and_deposed_rejoins_ops_based(tmp_path):
+    """Kill the primary-holding node: the promoted replica resyncs the
+    survivor, the survivor's rollback/redelivery leaves copies
+    identical, and the deposed node's own return is reconciled through
+    the cross-term ops path — zero wipes anywhere after the failover."""
+    s = failover_under_live_writes_scenario(211, str(tmp_path / "fo"))
+    assert s["lost_acked_docs"] == 0, s
+    assert s["wrong_hits"] == 0, s
+    assert s["deposed_wipe_recoveries"] == 0, s
+    assert s["deposed_ops_based"] >= 1, s
+    resync = s["resync"]
+    assert resync["resyncs_started"] + resync["resyncs_noop"] >= 1, s
+    assert s["unknown_fallbacks"] == 0, s
+
+
+def _assert_failover_invariants(s):
+    assert s["lost_acked_docs"] == 0, s
+    assert s["wrong_hits"] == 0, s
+    assert s["acked_writes"] > 0, s
+    # the tentpole acceptance bar: the deposed primary rejoins through
+    # the cross-term ops path — never a wipe — and at least one
+    # post-promotion resync ran (or was provably unnecessary)
+    assert s["deposed_wipe_recoveries"] == 0, s
+    assert len(s["deposed_recovery_kinds"]) >= 1, s
+    resync = s["resync"]
+    assert resync["resyncs_started"] + resync["resyncs_noop"] >= 1, s
+    assert s["unknown_fallbacks"] == 0, s
+
+
+@pytest.mark.parametrize("seed",
+                         [131 + 977 * k for k in range(CHAOS_SEEDS)])
+def test_failover_under_live_writes(tmp_path, seed):
+    s = failover_under_live_writes_scenario(seed, str(tmp_path / "fo"))
+    _assert_failover_invariants(s)
+
+
+@pytest.mark.slow
+def test_failover_seed_sweep(tmp_path):
+    for k in range(max(CHAOS_SEEDS, 5)):
+        seed = 131 + 977 * k
+        s = failover_under_live_writes_scenario(
+            seed, str(tmp_path / f"fo{seed}"))
+        _assert_failover_invariants(s)
+
+
+# ---------------------------------------------------------------------------
+# op-granular translog trimming (satellite: unified with retained history)
+# ---------------------------------------------------------------------------
+
+def test_translog_trim_ops_above_and_below(tmp_path):
+    svc, isvc, shard = _mk_shard(tmp_path / "tl")
+    eng = shard.engine
+    for i in range(8):
+        shard.apply_index_on_primary(f"d{i}", {"n": i})        # 0-7
+    tl = eng.translog
+    dropped = tl.trim_ops_above(5)
+    assert dropped == 2
+    assert tl.ops_trimmed_above_total == 2
+    ops, complete = eng.ops_history_snapshot(0)
+    assert [op["seqno"] for op in ops][:6] == list(range(6))
