@@ -950,13 +950,46 @@ def cfg_device_profile(np, jax, jnp, result):
             sp_w_d, s_live, cand, cs, s_ex.dev.n_docs_pad, kprime, K)
         block(s)
 
+    # the columns-plane aggregation kernels (ops/aggs.py): one
+    # scatter-add dispatch per (shard, agg family) for a whole drain's
+    # plans. Occupancy rides as the pow2-padded leading mask dim and
+    # per-plan base/interval as traced [P] vectors, so plan-count and
+    # interval changes stay inside the warmed buckets
+    from elasticsearch_tpu.ops.aggs import (
+        histogram_partials_plane, ordinal_counts_plane,
+    )
+    ag_n, ag_e, ag_b = 1 << 13, 1 << 14, 64
+    ag_ords = jnp.asarray(np.where(rng.random(ag_e) < 0.9,
+                                   rng.integers(0, ag_b, ag_e), -1)
+                          .astype(np.int32))
+    ag_owners = jnp.asarray(rng.integers(0, ag_n, ag_e)
+                            .astype(np.int32))
+    ag_vals = jnp.asarray(rng.integers(0, 500, ag_n).astype(np.int32))
+    ag_exists = jnp.asarray(rng.random(ag_n) < 0.9)
+    ag_masks = {p: jnp.asarray(rng.random((p, ag_n)) < 0.5)
+                for p in (1, 4)}
+    ag_bi = {p: (jnp.zeros((p,), jnp.int32),
+                 jnp.asarray(((np.arange(p) % 3 + 1) * 25)
+                             .astype(np.int32)))
+             for p in (1, 4)}
+
+    def run_aggs_plane():
+        for p in (1, 4):
+            block(ordinal_counts_plane(ag_ords, ag_owners,
+                                       ag_masks[p], ag_b))
+            bases, intervals = ag_bi[p]
+            block(histogram_partials_plane(ag_vals, ag_exists,
+                                           ag_masks[p], bases,
+                                           intervals, ag_b)[0])
+
     out = {"warm_iters": 2, "steady_iters": 3}
     ok_all = True
     for name, fn in (("bm25", run_bm25), ("knn", run_knn),
                      ("sparse", run_sparse),
                      ("bm25_coarse", run_bm25_coarse),
                      ("knn_coarse", run_knn_coarse),
-                     ("sparse_coarse", run_sparse_coarse)):
+                     ("sparse_coarse", run_sparse_coarse),
+                     ("aggs_plane", run_aggs_plane)):
         before_warm = DEVICE_PROFILE.total_compiles()
         for _ in range(2):
             fn()
@@ -1135,10 +1168,178 @@ def cfg_aggs(np, jax, jnp, result):
                     extras={"memo_hit_rate": round(
                         1 - len(plans) / clients, 3)})
     try:
+        result["configs"]["aggs"]["device_plane"] = \
+            _device_aggs_compare(np, eng, mappers)
+    except Exception as e:  # noqa: BLE001 — keep the concurrent numbers
+        result["errors"]["aggs_device_plane"] = \
+            f"{type(e).__name__}: {e}"[:200]
+    try:
         _window_controller_sweep(np, result)
     except Exception as e:  # noqa: BLE001 — keep the concurrent numbers
         result["errors"]["aggs_window_sweep"] = \
             f"{type(e).__name__}: {e}"[:200]
+
+
+def _device_aggs_compare(np, eng, mappers):
+    """Device-vs-host aggregation collection over the SAME drain: the
+    columns plane (search/plane_aggs.py) serves each (shard, agg
+    family) in ONE scatter-add dispatch covering every plan in the
+    drain, while the host collectors walk every (segment, plan) pair.
+    Emits per-query p50/p99 for both modes, golden parity, and the
+    dispatch-independence proof: device dispatches per drain per family
+    stay at 1 whether the drain carries 1 plan or 4, and whether the
+    shard holds 3 segments or 6."""
+    from types import SimpleNamespace
+
+    from elasticsearch_tpu.index import InternalEngine
+    from elasticsearch_tpu.mapping import MapperService
+    from elasticsearch_tpu.ops.device_segment import PLANES
+    from elasticsearch_tpu.search import dsl
+    from elasticsearch_tpu.search.aggregations import (
+        ShardAggregator, parse_aggs,
+    )
+    from elasticsearch_tpu.search.device_profile import DEVICE_PROFILE
+    from elasticsearch_tpu.search.phase import parse_sort, query_shard
+    from elasticsearch_tpu.search.plane_aggs import plan_drain_aggs
+
+    # four distinct plane-eligible plans: terms, two histogram
+    # intervals (one with a same-field sub-metric), and a mixed-family
+    # member — the drain shapes the planner batches onto the plane
+    dev_plans = [
+        {"query": {"match": {"body": "w1 w7"}},
+         "aggs": {"brands": {"terms": {"field": "brand",
+                                       "size": 16}}}},
+        {"query": {"match": {"body": "w2 w5"}},
+         "aggs": {"hist": {"histogram": {"field": "price",
+                                         "interval": 100}}}},
+        {"query": {"match": {"body": "w3"}},
+         "aggs": {"fine": {"histogram": {"field": "price",
+                                         "interval": 50},
+                           "aggs": {"p": {"avg": {
+                               "field": "price"}}}}}},
+        {"query": {"match_all": {}},
+         "aggs": {"brands": {"terms": {"field": "brand",
+                                       "size": 16}},
+                  "hist": {"histogram": {"field": "price",
+                                         "interval": 25}}}},
+    ]
+
+    def member(body):
+        return SimpleNamespace(
+            req={"index": "bench_aggs", "shard": 0, "window": 10,
+                 "body": body},
+            trace=None, error=None)
+
+    shard = SimpleNamespace(engine=eng)
+    reader = eng.acquire_reader()
+
+    def host_one(body):
+        agg = ShardAggregator(parse_aggs(body["aggs"]))
+        query_shard(reader, mappers, dsl.parse_query(body["query"]),
+                    size=10, sort=parse_sort(None), collectors=[agg])
+        return agg.partial()
+
+    def device_drain(bodies, use_shard=shard, use_reader=reader):
+        preset = plan_drain_aggs(use_shard, use_reader,
+                                 [member(b) for b in bodies])
+        out = []
+        for ui, b in enumerate(bodies):
+            agg = ShardAggregator(parse_aggs(b["aggs"]),
+                                  preset=preset.get(ui))
+            query_shard(use_reader, mappers,
+                        dsl.parse_query(b["query"]), size=10,
+                        sort=parse_sort(None), collectors=[agg])
+            out.append(agg.partial())
+        return preset, out
+
+    # warm both modes (plane pack + kernel compiles happen here) and
+    # take the golden-parity check off the warmed state
+    queries_before = PLANES.stats["plane_aggs_queries"]
+    host_ref = [host_one(b) for b in dev_plans]
+    preset, dev_ref = device_drain(dev_plans)
+    served = sum(len(v) for v in preset.values())
+    parity = all(
+        json.dumps(h, sort_keys=True, default=str) ==
+        json.dumps(d, sort_keys=True, default=str)
+        for h, d in zip(host_ref, dev_ref))
+
+    iters = 10
+    host_lat, dev_lat = [], []
+    for _ in range(iters):
+        for b in dev_plans:
+            t0 = time.perf_counter()
+            host_one(b)
+            host_lat.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        device_drain(dev_plans)
+        dev_lat.append((time.perf_counter() - t0) / len(dev_plans))
+
+    def family_calls():
+        return sum(
+            DEVICE_PROFILE.family(f).compiles +
+            DEVICE_PROFILE.family(f).cache_hits
+            for f in ("aggs_ordinal_counts_plane",
+                      "aggs_histogram_plane"))
+
+    def drain_calls(bodies, use_shard=shard, use_reader=reader):
+        before = family_calls()
+        plan_drain_aggs(use_shard, use_reader,
+                        [member(b) for b in bodies])
+        return family_calls() - before
+
+    # plan-count independence: a 1-plan drain and a 4-plan drain both
+    # cost exactly one dispatch per family present (terms + histogram)
+    calls_occ1 = drain_calls([dev_plans[3]])
+    calls_occ4 = drain_calls(dev_plans)
+
+    # segment-count independence: the same drain over a SIX-segment
+    # shard still costs one dispatch per family — the plane packs the
+    # segments away before the kernel ever sees them
+    rng = np.random.default_rng(SEED + 7)
+    vocab = [f"w{i}" for i in range(50)]
+    eng6 = InternalEngine(
+        MapperService({"properties": {
+            "body": {"type": "text"},
+            "brand": {"type": "keyword"},
+            "price": {"type": "integer"}}}),
+        shard_label="bench_aggs6")
+    n6 = 1 << 12
+    for i in range(n6):
+        eng6.index(str(i), {
+            "body": " ".join(rng.choice(vocab, size=6)),
+            "brand": f"b{i % 16}",
+            "price": int(rng.integers(1, 500))})
+        if i and i % (n6 // 6) == 0:
+            eng6.refresh()
+    eng6.refresh()
+    shard6 = SimpleNamespace(engine=eng6)
+    reader6 = eng6.acquire_reader()
+    device_drain(dev_plans, shard6, reader6)      # pack + warm eng6
+    calls_seg6 = drain_calls(dev_plans, shard6, reader6)
+
+    def pq(xs, q):
+        return round(float(np.percentile(np.asarray(xs) * 1e3, q)), 3)
+
+    host_p99, dev_p99 = pq(host_lat, 99), pq(dev_lat, 99)
+    return {
+        "plans": len(dev_plans),
+        "specs_served": served,
+        "parity": parity,
+        "plane_aggs_queries_delta":
+            PLANES.stats["plane_aggs_queries"] - queries_before,
+        "host_agg_p50_ms": pq(host_lat, 50),
+        "host_agg_p99_ms": host_p99,
+        "device_agg_p50_ms": pq(dev_lat, 50),
+        "device_agg_p99_ms": dev_p99,
+        "speedup_p99": round(host_p99 / max(dev_p99, 1e-9), 3),
+        "dispatches_per_drain": {
+            "occupancy_1": calls_occ1,
+            "occupancy_4": calls_occ4,
+            "segments_3": calls_occ4,
+            "segments_6": calls_seg6},
+        "independent_of_plan_count": calls_occ1 == calls_occ4 == 2,
+        "independent_of_segment_count": calls_seg6 == calls_occ4 == 2,
+    }
 
 
 def _window_controller_sweep(np, result) -> None:

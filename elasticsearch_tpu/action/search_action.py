@@ -383,7 +383,8 @@ class SearchTransportService:
     def execute_query_member(self, req: Dict[str, Any], reader, *,
                              cancel_check=None, trace=None,
                              started_wall: Optional[float] = None,
-                             meta_out: Optional[Dict[str, Any]] = None
+                             meta_out: Optional[Dict[str, Any]] = None,
+                             preset_aggs: Optional[Dict[str, Any]] = None
                              ) -> Dict[str, Any]:
         """Execute ONE shard query over a provided reader snapshot — the
         per-member body of the batcher's ``dense`` kind (and the only
@@ -444,7 +445,15 @@ class SearchTransportService:
             from elasticsearch_tpu.search.aggregations import (
                 ShardAggregator, parse_aggs,
             )
-            aggregator = ShardAggregator(parse_aggs(agg_body))
+            aggregator = ShardAggregator(parse_aggs(agg_body),
+                                         preset=preset_aggs)
+            if aggregator.preset_served and trace is not None:
+                # >=1 spec rides the drain-wide columns-plane partials
+                # (search/plane_aggs.py): this member served on the
+                # dense_device data plane — the label shows on the
+                # trace, the slow log, _tasks and the latency
+                # histograms, NEVER in the response body
+                trace.data_plane = "dense_device"
 
         with telemetry.activate(trace), trace.span("device_dispatch"):
             result = query_shard(

@@ -149,13 +149,17 @@ def device_stats() -> Dict[str, Any]:
 def device_plane_stats() -> Dict[str, Any]:
     """Packed multi-segment plane observability (ops/device_segment.py
     PlaneRegistry): full rebuilds vs incremental appends, evictions,
-    resident bytes per kind, the quantized coarse tier's configured and
-    SERVED re-rank depths (rerank_depth / rerank_depth_max /
-    rerank_depth_histogram, with quantized_queries, rerank_escalations
-    and quantized_exact_fallbacks), and how often a missing/refused
-    plane forced the per-segment fallback. Never initializes the device
-    layer itself — a node that has served no device work reports an
-    empty section."""
+    resident bytes per kind (the ``columns`` doc-values plane
+    included), the quantized coarse tier's configured and SERVED
+    re-rank depths (rerank_depth / rerank_depth_max /
+    rerank_depth_histogram, with quantized_queries, rerank_escalations,
+    quantized_exact_fallbacks and the measured-latency engage rule's
+    quantized_disengaged_slow), the drain-wide aggregation counters
+    (plane_aggs_queries = specs served from a device partial,
+    plane_aggs_fallbacks), and how often a missing/refused plane forced
+    the per-segment fallback. Never initializes the device layer
+    itself — a node that has served no device work reports an empty
+    section."""
     import sys
     mod = sys.modules.get("elasticsearch_tpu.ops.device_segment")
     if mod is None:

@@ -22,7 +22,8 @@ import jax.numpy as jnp
 
 from elasticsearch_tpu.search.device_profile import profiled_jit
 
-__all__ = ["ordinal_counts", "histogram_partials"]
+__all__ = ["ordinal_counts", "histogram_partials",
+           "ordinal_counts_plane", "histogram_partials_plane"]
 
 
 @profiled_jit("aggs_ordinal_counts", static_argnames=("n_buckets",))
@@ -68,3 +69,76 @@ def histogram_partials(values: jnp.ndarray,   # [N_pad] int32 column
     maxs = jnp.full((n_buckets,), -jnp.inf, jnp.float32).at[safe].max(
         jnp.where(ok, vf, -jnp.inf), mode="drop")
     return counts, sums, mins, maxs
+
+
+# ---------------------------------------------------------------------------
+# plane-wide batched kernels (PlaneColumns)
+#
+# The per-segment kernels above take one segment's column and one plan's
+# mask; a drain with S segments and P distinct plans pays S*P dispatches.
+# The plane variants take the CONCATENATED multi-segment column (a
+# PlaneColumns part) and a [P, N_pad] stack of query masks, so one
+# dispatch serves P plans x all segments for an agg family. The host
+# merge for terms/histogram partials is commutative, so the whole-plane
+# scatter IS the merged per-segment result — no per-segment demux is
+# needed for these families. When a future family does need per-segment
+# attribution, the part's doc_base searchsorted (PlanePart.demux) splits
+# plane doc ids back into (segment, local doc) pairs.
+
+
+@profiled_jit("aggs_ordinal_counts_plane", static_argnames=("n_buckets",))
+def ordinal_counts_plane(ords: jnp.ndarray,    # [E_pad] int32 global ords
+                         owners: jnp.ndarray,  # [E_pad] int32 plane doc ids
+                         masks: jnp.ndarray,   # [P, N_pad] bool query masks
+                         n_buckets: int) -> jnp.ndarray:
+    """[P, n_buckets] counts: the terms-agg device half for a whole
+    shard's plane and a batch of plans in one scatter-add dispatch.
+
+    `ords` carry GLOBAL ordinals (remapped at pack time), -1 padded;
+    `owners` index into the plane doc space so each plan's [N_pad] mask
+    gathers straight into owner_ok."""
+    valid_base = ords >= 0
+    safe = jnp.where(valid_base, ords, 0)
+
+    def one(mask):
+        valid = mask[owners] & valid_base
+        return jnp.zeros((n_buckets,), jnp.int32).at[safe].add(
+            valid.astype(jnp.int32), mode="drop")
+
+    return jax.vmap(one)(masks)
+
+
+@profiled_jit("aggs_histogram_plane", static_argnames=("n_buckets",))
+def histogram_partials_plane(values: jnp.ndarray,     # [N_pad] int32 column
+                             exists: jnp.ndarray,     # [N_pad] bool
+                             masks: jnp.ndarray,      # [P, N_pad] bool
+                             bases: jnp.ndarray,      # [P] int32
+                             intervals: jnp.ndarray,  # [P] int32
+                             n_buckets: int
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                        jnp.ndarray, jnp.ndarray]:
+    """[P, n_buckets] (counts, sums, mins, maxs) in one dispatch.
+
+    Per-plan base/interval ride along as traced [P] vectors, so plans
+    over the same field with DIFFERENT intervals still share the single
+    dispatch; n_buckets is the pow2-padded max over the batch and each
+    plan reads back only its own prefix. Same exactness contract as
+    histogram_partials: integral values/intervals, |v| < 2^24."""
+    vf = values.astype(jnp.float32)
+
+    def one(mask, base, interval):
+        ok = exists & mask
+        ids = jnp.floor_divide(values, interval) - base
+        ok = ok & (ids >= 0) & (ids < n_buckets)
+        safe = jnp.where(ok, ids, 0)
+        counts = jnp.zeros((n_buckets,), jnp.int32).at[safe].add(
+            ok.astype(jnp.int32), mode="drop")
+        sums = jnp.zeros((n_buckets,), jnp.float32).at[safe].add(
+            jnp.where(ok, vf, 0.0), mode="drop")
+        mins = jnp.full((n_buckets,), jnp.inf, jnp.float32).at[safe].min(
+            jnp.where(ok, vf, jnp.inf), mode="drop")
+        maxs = jnp.full((n_buckets,), -jnp.inf, jnp.float32).at[safe].max(
+            jnp.where(ok, vf, -jnp.inf), mode="drop")
+        return counts, sums, mins, maxs
+
+    return jax.vmap(one)(masks, bases, intervals)

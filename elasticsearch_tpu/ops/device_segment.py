@@ -551,8 +551,149 @@ class PlaneFeatures(PlanePart):
         return self._q_dev
 
 
+class PlaneColumns(PlanePart):
+    """All segments' aggregation columns for one field, concatenated into
+    plane doc space: the numeric/date doc-values column (int32 + exists)
+    and/or the keyword ordinal occurrence table with ordinals remapped to
+    GLOBAL (plane-wide, sorted term union) space at pack time. One field
+    commonly has only one side; the other uploads as zero-length arrays
+    and costs nothing.
+
+    The numeric side keeps the per-segment device collector's exactness
+    gates (single-valued, integral dtype, |v| < 2^24 so the fused f32
+    sum/min/max stay exact) — a segment that fails them poisons only the
+    numeric side, with the TYPED reason kept on the part so the agg
+    planner can record why it fell back to the host collector while the
+    keyword side keeps serving."""
+
+    kind = "columns"
+
+    def _pack_segment(self, seg: Segment):
+        """(numeric_entry, keyword_entry) host cache for one segment,
+        keyed by uid so incremental append reuses it verbatim. Keyword
+        ordinals stay LOCAL here — the global remap depends on the whole
+        segment set and is recomputed per pack (cheap host work)."""
+        num = None
+        dv = seg.doc_values.get(self.field)
+        if dv is not None:
+            if dv.multi:
+                num = ("ineligible", "multi_valued")
+            elif dv.values.dtype.kind != "i":
+                num = ("ineligible", "non_integer")
+            else:
+                docs = np.nonzero(dv.exists)[0]
+                vmin = int(dv.values[docs].min()) if len(docs) else None
+                vmax = int(dv.values[docs].max()) if len(docs) else None
+                if vmax is not None and \
+                        max(abs(vmin), abs(vmax)) >= 2 ** 24:
+                    # same gate as the per-segment device histogram:
+                    # int32-safe AND f32-exact (epoch-millis dates land
+                    # here and keep the host path)
+                    num = ("ineligible", "magnitude")
+                else:
+                    n = seg.n_docs
+                    ex = np.zeros(n, bool)
+                    ex[: min(n, len(dv.exists))] = dv.exists[:n]
+                    vals = np.zeros(n, np.int32)
+                    m = min(n, len(dv.values))
+                    vals[:m] = np.where(ex[:m], dv.values[:m],
+                                        0).astype(np.int32)
+                    num = ("ok", vals, ex, vmin, vmax)
+        kw = None
+        kf = seg.keywords.get(self.field) if \
+            hasattr(seg, "keywords") else None
+        if kf is not None:
+            counts = np.diff(kf.ord_offsets)
+            owners = np.repeat(np.arange(len(counts), dtype=np.int32),
+                               counts)
+            ords = kf.ord_values.astype(np.int32)
+            if len(owners):
+                # dedup (doc, ord) at pack time — same rule as the
+                # per-segment occurrence table (_dedup_doc_ord): a doc
+                # counts once per term even when the stored array
+                # repeats a value
+                pair = owners.astype(np.int64) \
+                    * max(len(kf.term_list), 1) + ords
+                _, first = np.unique(pair, return_index=True)
+                owners, ords = owners[first], ords[first]
+            kw = (owners, ords, list(kf.term_list))
+        return (num, kw)
+
+    def build(self, prev: Optional["PlaneColumns"]):
+        values = np.zeros(self.n_docs_pad, np.int32)
+        exists = np.zeros(self.n_docs_pad, bool)
+        have_num, num_reason = False, None
+        vmin, vmax = None, None
+        kw_parts = []     # (base, owners_local, ords_local, term_list)
+        for pos, seg in enumerate(self.segments):
+            cached = prev._seg_cache.get(seg.uid) if prev is not None \
+                else None
+            if cached is None:
+                cached = self._pack_segment(seg)
+            self._seg_cache[seg.uid] = cached
+            num, kw = cached
+            base = int(self.doc_base[pos])
+            if num is not None:
+                have_num = True
+                if num[0] == "ineligible":
+                    num_reason = num_reason or num[1]
+                else:
+                    _, vals, ex, s_min, s_max = num
+                    values[base: base + len(ex)] = vals[: len(ex)]
+                    exists[base: base + len(ex)] = ex
+                    if s_min is not None:
+                        vmin = s_min if vmin is None else min(vmin, s_min)
+                        vmax = s_max if vmax is None else max(vmax, s_max)
+            if kw is not None:
+                kw_parts.append((base,) + kw)
+        if not have_num and not kw_parts:
+            raise PlaneUnavailable(self.field)
+        self.has_numeric = have_num and num_reason is None
+        self.num_reason = num_reason
+        self.vmin, self.vmax = vmin, vmax
+        # global-ordinal remap: plane term space is the SORTED union of
+        # the segment term lists, so bucket keys come straight off the
+        # global ordinal and per-segment ords never leak upward
+        term_list: List = sorted({t for p in kw_parts for t in p[3]})
+        gid = {t: i for i, t in enumerate(term_list)}
+        self.has_keyword = bool(kw_parts)
+        self.term_list = term_list
+        self.n_terms = len(term_list)
+        own_parts, ord_parts = [], []
+        for base, owners, ords, terms in kw_parts:
+            if not len(owners):
+                continue
+            lookup = np.asarray([gid[t] for t in terms], np.int32) \
+                if terms else np.empty(0, np.int32)
+            own_parts.append(owners.astype(np.int64) + base)
+            ord_parts.append(lookup[ords])
+        n_occ = sum(len(p) for p in own_parts)
+        self.n_occurrences = n_occ
+        if kw_parts:
+            e_pad = next_pow2(max(n_occ, 1), minimum=8)
+            kw_owners = np.zeros(e_pad, np.int32)
+            kw_ords = np.full(e_pad, -1, np.int32)
+            if n_occ:
+                kw_owners[:n_occ] = np.concatenate(own_parts)
+                kw_ords[:n_occ] = np.concatenate(ord_parts)
+        else:
+            kw_owners = np.empty(0, np.int32)
+            kw_ords = np.empty(0, np.int32)
+        if not self.has_numeric:
+            values = np.empty(0, np.int32)
+            exists = np.empty(0, bool)
+        return (values, exists, kw_owners, kw_ords)
+
+    def upload(self, host) -> None:
+        values, exists, kw_owners, kw_ords = host
+        self.values = jnp.asarray(values)
+        self.exists = jnp.asarray(exists)
+        self.kw_owners = jnp.asarray(kw_owners)
+        self.kw_ords = jnp.asarray(kw_ords)
+
+
 _PART_CLASSES = {"postings": PlanePostings, "vectors": PlaneVectors,
-                 "features": PlaneFeatures}
+                 "features": PlaneFeatures, "columns": PlaneColumns}
 
 
 def _count_reason(reason: str) -> None:
@@ -598,8 +739,21 @@ class PlaneRegistry:
             "quantized_queries": 0,
             "rerank_escalations": 0,
             "quantized_exact_fallbacks": 0,
+            "quantized_disengaged_slow": 0,
             "ivf_warm_starts": 0,
+            "plane_aggs_queries": 0,
+            "plane_aggs_fallbacks": 0,
         }
+        # measured-latency engage rule for the quantized coarse tier:
+        # per-(query class, tier) EWMAs of observed per-query serve
+        # latency. On backends where bf16 is emulated (the CPU-fallback
+        # box) the coarse pass can measure SLOWER than exact — the
+        # corpus-size gate alone cannot see that, so the registry
+        # compares what it actually measured and disengages the tier,
+        # still probing occasionally so a backend change can re-engage.
+        self._lat_ewma: Dict[Tuple[str, str], float] = {}
+        self._lat_n: Dict[Tuple[str, str], int] = {}
+        self._probe_counter: Dict[str, int] = {}
         # adaptive re-rank depth histogram: served depth -> query count
         # (the k' each query's margin actually settled at — the coarse
         # tier's observability surface, next to quantized_queries)
@@ -651,6 +805,48 @@ class PlaneRegistry:
             self.stats["quantized_queries"] += int(n_queries)
         self.rerank_depth_hist[int(depth)] = \
             self.rerank_depth_hist.get(int(depth), 0) + int(n_queries)
+
+    # -- measured-latency engage rule (quantized coarse tier) -----------
+
+    LAT_ALPHA = 0.3          # EWMA smoothing
+    LAT_MIN_SAMPLES = 5      # per tier before the comparison may fire
+    LAT_SLOW_MARGIN = 1.25   # coarse must be this much slower to lose
+    LAT_PROBE_EVERY = 32     # disengaged tier still probes occasionally
+
+    def note_tier_latency(self, cls: str, tier: str,
+                          seconds: float) -> None:
+        """Record one observed per-query serve latency for a (query
+        class, tier) pair; tier is "coarse" or "exact"."""
+        key = (cls, tier)
+        prev = self._lat_ewma.get(key)
+        self._lat_ewma[key] = float(seconds) if prev is None else \
+            prev + self.LAT_ALPHA * (float(seconds) - prev)
+        self._lat_n[key] = self._lat_n.get(key, 0) + 1
+
+    def quantized_slow(self, cls: str) -> bool:
+        """True when the measured coarse EWMA for this class is decisively
+        slower than the exact EWMA (both with enough samples)."""
+        if self._lat_n.get((cls, "coarse"), 0) < self.LAT_MIN_SAMPLES or \
+                self._lat_n.get((cls, "exact"), 0) < self.LAT_MIN_SAMPLES:
+            return False
+        return self._lat_ewma[(cls, "coarse")] > \
+            self._lat_ewma[(cls, "exact")] * self.LAT_SLOW_MARGIN
+
+    def quantized_engaged(self, cls: str) -> bool:
+        """Should this query attempt the coarse tier? The corpus-size
+        gate still applies downstream; this adds the observed-latency
+        comparison. A disengaged class lets every LAT_PROBE_EVERY-th
+        query through so the coarse EWMA keeps tracking the backend —
+        without the probe a one-time slow measurement would disengage
+        the tier forever."""
+        if not self.quantized_slow(cls):
+            return True
+        n = self._probe_counter.get(cls, 0) + 1
+        self._probe_counter[cls] = n
+        if n % self.LAT_PROBE_EVERY == 0:
+            return True
+        self.stats["quantized_disengaged_slow"] += 1
+        return False
 
     # -- lookup / build -------------------------------------------------
 
@@ -799,6 +995,9 @@ class PlaneRegistry:
         for key in list(self._parts):
             self._drop(key, count_eviction=False, cause="clear")
         self._refused.clear()
+        self._lat_ewma.clear()
+        self._lat_n.clear()
+        self._probe_counter.clear()
 
     def on_refresh(self, segments) -> None:
         """Refresh publication: eagerly re-pack any resident plane whose
@@ -817,7 +1016,8 @@ class PlaneRegistry:
             self.get(segments, kind, field)
 
     def stats_snapshot(self) -> Dict[str, Any]:
-        by_kind = {"postings": 0, "vectors": 0, "features": 0}
+        by_kind = {"postings": 0, "vectors": 0, "features": 0,
+                   "columns": 0}
         for p in self._parts.values():
             by_kind[p.kind] = by_kind.get(p.kind, 0) + p.nbytes
         return {**self.stats,
@@ -1316,7 +1516,8 @@ class MeshPlaneRegistry:
             self.get(shard_segments, part.kind, part.field)
 
     def stats_snapshot(self) -> Dict[str, Any]:
-        by_kind = {"postings": 0, "vectors": 0, "features": 0}
+        by_kind = {"postings": 0, "vectors": 0, "features": 0,
+                   "columns": 0}
         per_device = 0
         for p in self._parts.values():
             by_kind[p.kind] = by_kind.get(p.kind, 0) + p.nbytes

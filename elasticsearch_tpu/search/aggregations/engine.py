@@ -84,10 +84,23 @@ class ShardAggregator:
     ``collect(ctx, segment_idx, scores, mask)`` with device arrays.
     """
 
-    def __init__(self, specs: List[AggSpec]):
+    def __init__(self, specs: List[AggSpec],
+                 preset: Optional[Dict[str, Any]] = None):
         self.specs = [s for s in specs if not s.is_pipeline]
         self.pipeline_specs = [s for s in specs if s.is_pipeline]
         self.state: Dict[str, Any] = {}
+        # drain-wide device aggregation (batch_executor plane_aggs): a
+        # preset entry IS the whole-shard partial for that spec — the
+        # plane kernel already folded every segment, so the per-segment
+        # collect skips those specs and ``partial()`` ships the preset
+        # through the unchanged merge/finalize
+        names = {s.name for s in self.specs}
+        self._preset = {k: v for k, v in (preset or {}).items()
+                        if k in names}
+        self.state.update(self._preset)
+        self._collect_specs = [s for s in self.specs
+                               if s.name not in self._preset]
+        self.preset_served = bool(self._preset)
 
     def collect(self, ctx, segment_idx: int, scores, mask) -> None:
         n = ctx.segment.n_docs
@@ -102,7 +115,7 @@ class ShardAggregator:
         mask_host = np.asarray(mask)[:n].astype(bool)
         ctx._agg_top_host_mask = mask_host
         scores_host = np.asarray(scores)[:n]
-        for spec in self.specs:
+        for spec in self._collect_specs:
             partial = collect_one(spec, ctx, mask_host, scores_host)
             if spec.name in self.state:
                 self.state[spec.name] = merge_one(

@@ -1166,16 +1166,18 @@ class ShardQueryBatcher:
             self._finish(m)
 
     def _set_phase(self, members: List[_Member], phase: str,
-                   occupancy: Optional[int] = None) -> None:
+                   occupancy: Optional[int] = None,
+                   data_plane: str = "batch") -> None:
         """_tasks phase fidelity: a shard task shows its current
         sub-phase (queued -> query -> dispatch -> demux) instead of
         "query" for its whole life — occupancy-1 members included.
         ``occupancy`` (drain width) rides the status so the hot-spans
         sampler (GET /_nodes/hot_spans) can show which in-flight spans
-        share one device dispatch."""
+        share one device dispatch; ``data_plane`` is "dense_device" for
+        members whose aggs ride the drain-wide columns plane."""
         for m in members:
             if m.task is not None and m.error is None:
-                status = {"phase": phase, "data_plane": "batch"}
+                status = {"phase": phase, "data_plane": data_plane}
                 if occupancy:
                     status["occupancy"] = occupancy
                 m.task.status = status
@@ -1352,10 +1354,26 @@ class ShardQueryBatcher:
         )
         exec_ns: Dict[int, int] = {}
         cache_hit: Dict[int, bool] = {}
+        # drain-wide agg planning (search/plane_aggs.py): the drain's
+        # agg-bearing members are planned together — one columns-plane
+        # dispatch per (shard, agg family) serves every eligible spec of
+        # every distinct plan, and each member's ShardAggregator consumes
+        # the whole-shard partial as a preset. plan_drain_aggs never
+        # raises; {} keeps the pure host path.
+        preset_by_ui: Dict[int, Dict[str, Any]] = {}
+        if any(u.error is None and (
+                (u.req.get("body") or {}).get("aggs") or
+                (u.req.get("body") or {}).get("aggregations"))
+               for u in uniques):
+            from elasticsearch_tpu.search.plane_aggs import plan_drain_aggs
+            preset_by_ui = plan_drain_aggs(shard, reader, uniques,
+                                           batch_stats=self.stats)
         for ui, u in enumerate(uniques):
             if u.error is not None:
                 continue
-            self._set_phase([u], "dispatch")
+            self._set_phase([u], "dispatch",
+                            data_plane="dense_device"
+                            if ui in preset_by_ui else "batch")
             t0 = time.monotonic_ns()
             meta: Dict[str, Any] = {}
             try:
@@ -1363,7 +1381,8 @@ class ShardQueryBatcher:
                     u.req, reader,
                     cancel_check=self._member_cancel_check(u),
                     trace=u.trace, started_wall=u.enqueued_wall,
-                    meta_out=meta)
+                    meta_out=meta,
+                    preset_aggs=preset_by_ui.get(ui))
             except (TaskCancelledError, SearchBudgetExceededError) as e:
                 if isinstance(e, TaskCancelledError):
                     self.stats["queries_cancelled"] += 1
@@ -1401,7 +1420,8 @@ class ShardQueryBatcher:
                             m.req, reader,
                             cancel_check=self._member_cancel_check(m),
                             trace=m.trace, started_wall=m.enqueued_wall,
-                            meta_out=meta)
+                            meta_out=meta,
+                            preset_aggs=preset_by_ui.get(ui))
                     except (TaskCancelledError,
                             SearchBudgetExceededError) as e:
                         if isinstance(e, TaskCancelledError):
@@ -1446,7 +1466,12 @@ class ShardQueryBatcher:
                     stats["wand_blocks_scored"] += row["prune"][1]
             m.result = {**row, "context_id": context_id}
             # the duplicate's honest attribution is the unique's
-            # execution it shared (the drain-span discipline)
+            # execution it shared (the drain-span discipline) — the
+            # dense_device label included: the row it serves WAS
+            # collected on the columns plane
+            if u.trace is not None and m.trace is not None and \
+                    u.trace.data_plane == "dense_device":
+                m.trace.data_plane = "dense_device"
             m.trace.add_span("device_dispatch", exec_ns.get(ui, 1),
                              {"memo": 1})
             m.trace.finish()

@@ -28,6 +28,7 @@ Every function degrades by construction: callers treat a None plane (or
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -49,6 +50,33 @@ class PlaneFallback(Exception):
     """This batch cannot run on the plane (e.g. IVF-routed members whose
     num_candidates imply different probe widths); members take the
     per-segment path."""
+
+
+def _coarse_attempt(cls: str, n_q: int, attempt: Callable[[], Any]):
+    """Measured-latency engage rule shared by every coarse-tier class:
+    the corpus-size gate decides whether coarse CAN engage; this decides
+    whether it SHOULD, from the observed per-query serve latencies. When
+    the coarse EWMA for this class runs decisively slower than the exact
+    EWMA (re-rank escalations eating the bf16/int8 savings), the class
+    disengages — counted ``quantized_disengaged_slow`` — and every 32nd
+    query probes coarse again so a shifted workload can re-engage."""
+    if not PLANES.quantized_engaged(cls):
+        return None
+    t0 = time.monotonic()
+    got = attempt()
+    if got is not None:
+        PLANES.note_tier_latency(cls, "coarse",
+                                 (time.monotonic() - t0) / max(n_q, 1))
+    return got
+
+
+def _note_exact(cls: str, n_q: int, t0: float) -> None:
+    """The exact tier's side of the same comparison — recorded only while
+    the quantized tier is configured on (with it off there is nothing to
+    disengage), so both EWMAs describe the same workload."""
+    if PLANES.quantized:
+        PLANES.note_tier_latency(cls, "exact",
+                                 (time.monotonic() - t0) / max(n_q, 1))
 
 
 # ---------------------------------------------------------------------------
@@ -332,12 +360,13 @@ def plane_wand_topk(ctxs, part, field: str,
     # None = serve the exact phased path below (typed when it is a
     # fallback rather than a sizing decision)
     if PLANES.quantized:
-        got = _coarse_wand_topk(part, per_seg, has_terms, n_q, live,
-                                eff_block_avgdl, k_plane, want,
-                                track_limit, counts_on, check_members,
-                                counter)
+        got = _coarse_attempt("bm25", n_q, lambda: _coarse_wand_topk(
+            part, per_seg, has_terms, n_q, live, eff_block_avgdl,
+            k_plane, want, track_limit, counts_on, check_members,
+            counter))
         if got is not None:
             return got
+    t_exact = time.monotonic()
 
     def _dispatch(rows, k, counted, count_segments=None):
         if check_members is not None:
@@ -483,6 +512,7 @@ def plane_wand_topk(ctxs, part, field: str,
                            prune)
             else:
                 out[qi] = (candidates, exact_hits, "eq", max_score, prune)
+    _note_exact("bm25", n_q, t_exact)
     return out
 
 
@@ -715,14 +745,19 @@ def plane_knn_winners(ctxs, part: PlaneVectors, field: str, specs,
                 transient, "plane_knn"):
             got = None
             if PLANES.quantized:
-                got = _quantized_topk(part, vectors[exact_idx], live,
-                                      masks, k_plane, counter=counter)
+                got = _coarse_attempt(
+                    "knn", len(exact_idx),
+                    lambda: _quantized_topk(part, vectors[exact_idx],
+                                            live, masks, k_plane,
+                                            counter=counter))
             if got is None:
                 from elasticsearch_tpu.ops.knn import KnnExecutor
                 if counter is not None:
                     counter.append(1)
+                t_exact = time.monotonic()
                 got = KnnExecutor(part).top_k_batch(
                     vectors[exact_idx], live, k_plane, masks)
+                _note_exact("knn", len(exact_idx), t_exact)
         s, d = np.asarray(got[0]), np.asarray(got[1])
         for row, qi in enumerate(exact_idx):
             finite = (s[row] > -np.inf) & (d[row] >= 0)
@@ -781,8 +816,8 @@ def plane_sparse_topk(ctxs, part, field: str,
     # re-rank with adaptive depth (the text/kNN pattern on the
     # rank_features class); None = exact single-dispatch path below
     if PLANES.quantized:
-        got = _coarse_sparse_topk(part, idx, w, live, k_plane, n_real,
-                                  check_members, counter)
+        got = _coarse_attempt("sparse", n_real, lambda: _coarse_sparse_topk(
+            part, idx, w, live, k_plane, n_real, check_members, counter))
         if got is not None:
             s, d, h = got
             return _sparse_demux(part, s, d, h, n_real)
@@ -792,6 +827,7 @@ def plane_sparse_topk(ctxs, part, field: str,
     if counter is not None:
         counter.append(1)
     telemetry.record_dispatch()
+    t_exact = time.monotonic()
     from elasticsearch_tpu.indices.breaker import BREAKERS
     with BREAKERS.breaker("request").limit_scope(
             8 * part.n_docs_pad * q_n, "plane_sparse"):
@@ -800,6 +836,7 @@ def plane_sparse_topk(ctxs, part, field: str,
             jnp.asarray(w), jnp.float32(1.0), jnp.float32(1.0), live,
             part.n_docs_pad, k_plane, "linear", counted=True)
     s, d, h = np.asarray(s), np.asarray(d), np.asarray(h)
+    _note_exact("sparse", n_real, t_exact)
     return _sparse_demux(part, s, d, h, n_real)
 
 

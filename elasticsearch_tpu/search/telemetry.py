@@ -87,6 +87,19 @@ PLANE_IVF_BREAKER_REFUSED = "plane_ivf_breaker_refused"
 # are identical either way — this is a perf-tier routing record
 PLANE_QUANTIZED_FALLBACK = "plane_quantized_fallback"
 MESH_QUANTIZED_FALLBACK = "mesh_quantized_fallback"
+# measured-latency engage rule: the coarse tier measured SLOWER than
+# exact for this query class (CPU-fallback boxes emulating bf16) and
+# was disengaged by the observed-latency EWMA comparison
+QUANTIZED_DISENGAGED_SLOW = "quantized_disengaged_slow"
+
+# columns plane / drain-wide device aggregation (dense_device data
+# plane): why an agg-bearing dense member's spec kept the host
+# collector. Results are identical either way — a perf-tier routing
+# record, like the quantized tier's
+PLANE_AGGS_INELIGIBLE_SHAPE = "plane_aggs_ineligible_shape"
+PLANE_AGGS_COLUMN_UNAVAILABLE = "plane_aggs_column_unavailable"
+PLANE_AGGS_BREAKER_REFUSED = "plane_aggs_breaker_refused"
+PLANE_AGGS_EXEC_ERROR = "plane_aggs_exec_error"
 
 # shard micro-batcher: why a drained batch re-executed member-by-member
 BATCH_IVF_NPROBE_DISAGREEMENT = "batch_ivf_nprobe_disagreement"

@@ -515,13 +515,28 @@ class FleetTrafficHarness:
         }
 
 
+def _fleet_cache_hits(c: "InProcessCluster") -> int:
+    """Fleet-wide request-cache hit total across every tier (shard
+    request cache, batcher intake, coordinator fused cache)."""
+    hits = 0
+    for node in c.nodes.values():
+        hits += node.search_transport.request_cache.stats["hits"]
+        hits += node.search_transport.batcher.stats.get(
+            "request_cache_intake_hits", 0)
+        fused = getattr(node.search_action, "fused_cache", None)
+        if fused is not None:
+            hits += fused.stats.get("hits", 0)
+    return hits
+
+
 def fleet_overload_scenario(seed: int, *, n_tenants: int = 4,
                             n_nodes: int = 6, docs: int = 10,
                             total_searches: int = 260,
                             duration_s: float = 1.2,
                             shard_bound: int = 2,
                             slow_delay_s: float = 0.08,
-                            admission: Tuple[int, int] = (3, 10)
+                            admission: Tuple[int, int] = (3, 10),
+                            dup_head_fraction: float = 0.0
                             ) -> Dict[str, Any]:
     """THE million-user chaos scenario (ROADMAP item 6), one seed: a
     10:1 hot-tenant flood across 3 coordinators and ``n_tenants``
@@ -622,6 +637,50 @@ def fleet_overload_scenario(seed: int, *, n_tenants: int = 4,
         harness.records.clear()
         harness._expected["n"] = 0
 
+        # zipf-head duplicate flood (dup_head_fraction > 0): that share
+        # of the HOT tenant's arrivals repeat one exact cached body —
+        # primed through every coordinator ahead of the storm, so the
+        # head rides the request-cache tiers (fused / intake / shard)
+        # and never reaches the shard shed point, while the distinct
+        # tail still overflows the same constrained admission plane
+        body_fn = None
+        head_flags: List[bool] = []
+        cache_hits_before = 0
+        if dup_head_fraction > 0:
+            n0 = len(box)
+            client.cluster_update_settings(
+                {"persistent": {"search.request_cache.topk": True}},
+                lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+            hot_body = {"query": {"match": {"body": "common"}},
+                        "size": 5, "request_cache": True,
+                        "track_total_hits": True}
+            for coord in coordinators:
+                primed: List[Any] = []
+                c.nodes[coord].client.search(
+                    tenants[0], dict(hot_body),
+                    lambda r, e=None: primed.append(1))
+                c.run_until(lambda: bool(primed), 300.0)
+            dup_rng = _random.Random(seed ^ 0xD0B)
+
+            marker = {"n": 0}
+
+            def body_fn(tenant: str) -> Dict[str, Any]:
+                if tenant == tenants[0] and \
+                        dup_rng.random() < dup_head_fraction:
+                    head_flags.append(True)
+                    return dict(hot_body)
+                head_flags.append(False)
+                # the tail is CACHE-PROOF (a unique marker term defeats
+                # every cache tier): with topk caching on fleet-wide,
+                # repeated tail bodies would otherwise be absorbed too
+                # and the shed point would never be reached
+                marker["n"] += 1
+                return {"query": {"match": {
+                    "body": f"common u{marker['n']}x{seed}"}},
+                    "size": 5}
+            cache_hits_before = _fleet_cache_hits(c)
+
         # per-(node, shard-copy) query counts before the flood: the ARS
         # routing-verdict baseline
         def copy_hits() -> Dict[Tuple[str, str], int]:
@@ -659,8 +718,24 @@ def fleet_overload_scenario(seed: int, *, n_tenants: int = 4,
 
         harness.run(duration_s, total_searches, hot_tenant=tenants[0],
                     hot_window=(win0, win1), hot_factor=10.0,
-                    events=events)
+                    events=events, body_fn=body_fn)
         summary = harness.summary()
+        if dup_head_fraction > 0:
+            # submit order == body_fn call order under the deterministic
+            # scheduler, so head_flags aligns with harness.records
+            from elasticsearch_tpu.utils.errors import shard_busy_info
+            head = [r for i, r in enumerate(harness.records)
+                    if i < len(head_flags) and head_flags[i]]
+            summary["dup_head"] = {
+                "fraction": dup_head_fraction,
+                "requests": len(head),
+                "admitted": sum(1 for r in head if r["err"] is None),
+                "shard_busy_failures": sum(
+                    1 for r in head if r["err"] is not None and
+                    (shard_busy_info(r["err"]) is not None or
+                     "shard_busy" in str(r["err"]))),
+                "cache_hits": _fleet_cache_hits(c) - cache_hits_before,
+            }
         c.heal()
         c.slow_node_drains(victim, 0.0)
 

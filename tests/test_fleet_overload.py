@@ -116,6 +116,32 @@ def test_fleet_chaos_seed_sweep():
             fleet_overload_scenario(seed=131 + 977 * k))
 
 
+@pytest.mark.parametrize("seed", [131 + 977 * k for k in range(CHAOS_SEEDS)])
+def test_fleet_overload_with_zipf_head_duplicate_flood(seed):
+    """The storm with a zipf-head duplicate component: 70% of the hot
+    tenant's flood repeats one exact cached body. Cache-served head
+    requests must bypass the shard shed point entirely (zero typed
+    shard_busy outcomes on the head) while the distinct-body overflow
+    still sheds with clean 429s — caching absorbs duplicates WITHOUT
+    disabling shedding for the traffic it cannot absorb."""
+    # more offered load than the base storm: the cache absorbs the head,
+    # so saturating the shed point takes a denser distinct tail
+    s = fleet_overload_scenario(seed, dup_head_fraction=0.7,
+                                total_searches=420)
+    dup = s["dup_head"]
+    assert dup["requests"] > 0, s
+    # the head rode the cache tiers (fused / intake / shard), ...
+    assert dup["cache_hits"] > 0, s
+    # ...so not one head request reached a shed point it could trip
+    assert dup["shard_busy_failures"] == 0, s
+    # the distinct tail still overflowed the same admission plane,
+    # cleanly — the two planes compose instead of masking each other
+    assert s["shard_busy_sheds"] > 0, s
+    assert s["unclean_rejections"] == 0, s
+    assert s["wrong_hits"] == 0, s
+    assert s["unknown_fallbacks"] == 0, s
+
+
 # ---------------------------------------------------------------------------
 # shed-point correctness (unit + small cluster)
 # ---------------------------------------------------------------------------

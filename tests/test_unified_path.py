@@ -366,12 +366,12 @@ def test_tasks_phase_fidelity_occupancy_one(cluster, monkeypatch):
     seen = []
     orig = batcher._set_phase
 
-    def spy(members, phase, occupancy=None):
+    def spy(members, phase, occupancy=None, **kw):
         for m in members:
             if m.task is not None:
                 seen.append(phase)
                 break
-        orig(members, phase, occupancy=occupancy)
+        orig(members, phase, occupancy=occupancy, **kw)
     monkeypatch.setattr(batcher, "_set_phase", spy)
 
     for body in ({"query": {"match": {"body": "w1 w2"}}},   # text kind
