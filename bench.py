@@ -2258,6 +2258,37 @@ def cfg_recovery(np, jax, jnp, result):
     result["configs"]["failover"] = f
 
 
+def cfg_mixed_rw(np, jax, jnp, result):
+    """MIXED READ/WRITE scenario under chaos (the write-path pressure
+    plane contract): a live bulk flood ~10:1 over the node's
+    indexing-pressure capacity with concurrent search traffic, a
+    slow-disk victim, and a rolling restart mid-ingest. The acceptance
+    contract rides the block: every write shed is a clean typed 429
+    carrying Retry-After, zero acked docs lost, zero wrong hits,
+    search p99 bounded vs its unloaded baseline, and the per-stage
+    rejection taxonomy's "unknown" bucket pinned at zero. All timing
+    virtual: seed-reproducible."""
+    import shutil
+    import tempfile
+
+    from elasticsearch_tpu.testing import mixed_read_write_scenario
+    path = tempfile.mkdtemp(prefix="bench_mixed_rw_")
+    try:
+        s = mixed_read_write_scenario(SEED + 37, path)
+    finally:
+        shutil.rmtree(path, ignore_errors=True)
+    s["zero_lost_acked"] = s["lost_acked_docs"] == 0
+    s["zero_wrong_hits"] = s["wrong_hits"] == 0
+    s["sheds_all_clean"] = bool(
+        s["write_sheds"] > 0 and s["unclean_write_sheds"] == 0)
+    s["p99_bounded"] = bool(s["p99_factor_vs_unloaded"] <= 4.0)
+    s["zero_unknown_stage_rejections"] = \
+        s["unknown_stage_rejections"] == 0
+    s["replica_retries_never_exhausted"] = \
+        s["replica_retries"]["replica_pressure_exhausted"] == 0
+    result["configs"]["mixed_rw"] = s
+
+
 def cfg_multichip(np, jax, jnp, result):
     """MULTICHIP scenario: runs inline when this process already sees
     >= 2 devices (a TPU slice), else re-execs itself over 8 virtual CPU
@@ -2357,6 +2388,7 @@ def main() -> None:
                          ("fleet", cfg_fleet),
                          ("zipf_cache", cfg_zipf_cache),
                          ("recovery", cfg_recovery),
+                         ("mixed_rw", cfg_mixed_rw),
                          ("multichip", cfg_multichip)):
             try:
                 if name == "hybrid":

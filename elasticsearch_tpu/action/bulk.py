@@ -33,22 +33,40 @@ class TransportBulkAction:
         self.thread_pool = thread_pool
 
     def execute(self, items: List[Dict[str, Any]],
-                on_done: Callable[[Dict[str, Any]], None]) -> None:
+                on_done: Callable[[Dict[str, Any]], None],
+                payload_bytes: Optional[int] = None) -> None:
         """items: [{action, index, id, source?, routing?, pipeline?,
-        if_seq_no?, ...}]"""
+        if_seq_no?, ...}]. ``payload_bytes`` is the raw NDJSON request
+        length when the caller has it (the REST _bulk route) — the
+        reference accounts REQUEST bytes, and charging the wire length
+        avoids re-serializing every source on the hot path."""
+        state = self.state()
         if self.thread_pool is not None:
-            import json as _json
-            est_bytes = sum(
-                len(_json.dumps(item.get("source") or {}, default=str))
-                + 64 for item in items)
+            ip = getattr(self.thread_pool, "indexing_pressure", None)
+            if ip is not None:
+                ip.configure_from_state(state)
+            est_bytes = payload_bytes if payload_bytes is not None else \
+                estimate_items_bytes(items)
             try:
-                self.thread_pool.acquire_write_bytes(est_bytes)
+                if ip is not None:
+                    ip.acquire("coordinating", est_bytes)
+                else:
+                    self.thread_pool.acquire_write_bytes(est_bytes)
             except Exception as e:  # noqa: BLE001 — backpressure, not fault
-                # per-item rejection entries so single-doc callers
-                # (NodeClient._single_item_bulk reads items[0]) surface
-                # the 429 instead of crashing on an empty list
+                retry_after = int((getattr(e, "metadata", None) or {})
+                                  .get("retry_after", 1))
+                # top-level error carries retry_after so the REST
+                # layer's retry_after_of finds it and emits the
+                # Retry-After header on the 429; per-item rejection
+                # entries so single-doc callers (NodeClient.
+                # _single_item_bulk reads items[0]) surface the 429
+                # instead of crashing on an empty list
                 on_done({"errors": True, "rejected": True,
                          "status": 429,
+                         "error": {
+                             "type": "es_rejected_execution_exception",
+                             "reason": str(e),
+                             "retry_after": retry_after},
                          "items": [{item.get("action", "index"): {
                              "id": item.get("id"),
                              "_index": item.get("index"),
@@ -56,15 +74,18 @@ class TransportBulkAction:
                              "error": {
                                  "type":
                                      "es_rejected_execution_exception",
-                                 "reason": str(e)}}}
+                                 "reason": str(e),
+                                 "retry_after": retry_after}}}
                              for item in items]})
                 return
             inner = on_done
 
             def on_done(resp):  # noqa: F811 — release wraps completion
-                self.thread_pool.release_write_bytes(est_bytes)
+                if ip is not None:
+                    ip.release("coordinating", est_bytes)
+                else:
+                    self.thread_pool.release_write_bytes(est_bytes)
                 inner(resp)
-        state = self.state()
         # fresh list: positional edits below must not mutate the caller's
         # (ingest-less _run_pipelines returns its input unchanged)
         items = list(self._run_pipelines(state, items))
@@ -229,12 +250,30 @@ class TransportBulkAction:
                                     group_done(key, positions))
 
 
+def estimate_items_bytes(items: List[Dict[str, Any]]) -> int:
+    """Cheap per-item byte estimate for internal (non-REST) bulk callers
+    that never had a wire payload: repr of the source plus a fixed
+    header allowance. The REST path never takes this — it charges the
+    raw NDJSON length it already holds."""
+    return sum(len(repr(item.get("source") or "")) + 64 for item in items)
+
+
 def _item_error(item: Dict[str, Any], err: Exception) -> Dict[str, Any]:
+    from elasticsearch_tpu.utils.errors import write_pressure_info
     status = getattr(err, "status", 500)
-    return {"action": item.get("action", "index"), "id": item.get("id"),
-            "_index": item.get("index"),
-            "error": {"type": type(err).__name__, "reason": str(err)},
-            "status": status}
+    entry = {"action": item.get("action", "index"), "id": item.get("id"),
+             "_index": item.get("index"),
+             "error": {"type": type(err).__name__, "reason": str(err)},
+             "status": status}
+    # a primary-stage indexing-pressure rejection crosses the transport
+    # stringified: re-type it to the ES wire name and recover its
+    # Retry-After so the item entry is a CLEAN typed 429
+    info = write_pressure_info(err)
+    if info is not None:
+        entry["error"]["type"] = "es_rejected_execution_exception"
+        entry["error"]["retry_after"] = info["retry_after"]
+        entry["status"] = 429
+    return entry
 
 
 def _bulk_response(responses: List[Optional[Dict[str, Any]]]

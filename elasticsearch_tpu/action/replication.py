@@ -23,7 +23,7 @@ from elasticsearch_tpu.transport.scheduler import Scheduler
 from elasticsearch_tpu.transport.transport import Deferred, TransportService
 from elasticsearch_tpu.utils.errors import (
     IndexNotFoundError, SearchEngineError, ShardNotFoundError,
-    UnavailableShardsError, VersionConflictError,
+    UnavailableShardsError, VersionConflictError, write_pressure_info,
 )
 from elasticsearch_tpu.utils.retry import RetryableAction
 
@@ -35,6 +35,17 @@ SHARD_BULK_REPLICA = "indices:data/write/bulk[s][r]"
 RETRY_INITIAL_DELAY = 0.2
 RETRY_MAX_DELAY = 5.0
 REROUTE_TIMEOUT = 30.0
+# replica-stage indexing-pressure rejections are retried under the same
+# backoff shape before the copy is failed out of the in-sync set: a
+# transiently-starved replica converges, only a stuck one is removed
+REPLICA_RETRY_TIMEOUT = 30.0
+
+
+def _ops_bytes(ops: List[Dict[str, Any]]) -> int:
+    """Byte estimate for a replicated-op batch (the replica-stage
+    indexing-pressure charge): source payloads plus a fixed per-op
+    allowance, no serialization on the hot path."""
+    return sum(len(repr(op.get("source") or "")) + 64 for op in ops)
 
 
 def _is_retryable(err: Any) -> bool:
@@ -56,15 +67,69 @@ class TransportShardBulkAction:
 
     def __init__(self, node_id: str, indices: IndicesService,
                  ts: TransportService, scheduler: Scheduler,
-                 state_supplier: Callable[[], ClusterState]):
+                 state_supplier: Callable[[], ClusterState],
+                 thread_pool=None, node_pressure=None,
+                 response_collector=None):
         self.node_id = node_id
         self.indices = indices
         self.ts = ts
         self.scheduler = scheduler
         self.state = state_supplier
+        # write-path pressure plane wiring (all optional — unit tests
+        # exercise the replication protocol without it): thread_pool
+        # carries the three-stage IndexingPressure; node_pressure /
+        # response_collector are LAZY accessors (the owning Node
+        # constructs those services after this action)
+        self.thread_pool = thread_pool
+        self.node_pressure = node_pressure
+        self.response_collector = response_collector
         self.last_reroute_retry: Optional[RetryableAction] = None
+        self.last_replica_retry: Optional[RetryableAction] = None
+        self.write_pressure_stats: Dict[str, int] = {
+            "replica_pressure_rejections": 0,
+            "replica_pressure_recoveries": 0,
+            "replica_pressure_exhausted": 0}
         ts.register_handler(SHARD_BULK_PRIMARY, self._on_primary)
         ts.register_handler(SHARD_BULK_REPLICA, self._on_replica)
+
+    # -- pressure-plane helpers ----------------------------------------
+
+    def _pressure(self):
+        if self.thread_pool is None:
+            return None
+        return getattr(self.thread_pool, "indexing_pressure", None)
+
+    def _observe_write(self) -> None:
+        """Fold this node's in-flight write bytes into its own
+        NodePressure tracker — the same snapshot the shard batcher
+        piggybacks on every search response, so ARS and the shard shed
+        point see an ingest-hot node before read latency degrades."""
+        ip = self._pressure()
+        if ip is None or self.node_pressure is None:
+            return
+        try:
+            tracker = self.node_pressure()
+        except Exception:  # noqa: BLE001 — observability must not fail writes
+            return
+        if tracker is not None:
+            tracker.observe_write(sum(ip.current.values()), ip.limit)
+
+    def _ingest_remote_pressure(self, node_id: str,
+                                snapshot: Optional[Dict[str, Any]]
+                                ) -> None:
+        """A peer's write-pressure snapshot rode back on a bulk /
+        replication response: feed it to the local ResponseCollector so
+        replica selection ranks the ingest-hot node down."""
+        if snapshot is None or self.response_collector is None:
+            return
+        try:
+            collector = self.response_collector()
+        except Exception:  # noqa: BLE001 — observability must not fail writes
+            return
+        if collector is not None:
+            collector.on_write_pressure(
+                node_id, snapshot.get("current_bytes", 0),
+                snapshot.get("limit_bytes", 0))
 
     # ------------------------------------------------------------------
     # coordinator side: route to the primary, retrying on stale routing
@@ -92,10 +157,19 @@ class TransportShardBulkAction:
                 cb(None, UnavailableShardsError(
                     f"primary shard [{index}][{shard_id}] is not active"))
                 return
+
+            def relay(resp, err, nid=primary.node_id) -> None:
+                # the primary's write-pressure snapshot piggybacks on
+                # every bulk response — feed it to this coordinator's
+                # ARS view before completing the caller
+                if err is None and isinstance(resp, dict):
+                    self._ingest_remote_pressure(
+                        nid, resp.get("write_pressure"))
+                cb(resp, err)
             self.ts.send_request(
                 primary.node_id, SHARD_BULK_PRIMARY,
                 {"index": index, "shard": shard_id, "items": items},
-                cb, timeout=REROUTE_TIMEOUT)
+                relay, timeout=REROUTE_TIMEOUT)
 
         action = RetryableAction(
             self.scheduler, attempt, on_done,
@@ -116,12 +190,37 @@ class TransportShardBulkAction:
             raise UnavailableShardsError(
                 f"shard [{index}][{shard_id}] on [{self.node_id}] "
                 f"is not the primary")
+        # primary-stage charge (IndexingPressure.markPrimaryOperationStarted
+        # analog): held until the response is built, covering replica
+        # fan-out. A rejection here surfaces to the coordinator as a
+        # typed per-item 429 (NOT reroute-retried — the reference's
+        # contract is that primary pressure sheds back to the client).
+        ip = self._pressure()
+        est = 0
+        if ip is not None:
+            ip.configure_from_state(self.state())
+            # lazy import: bulk.py imports this module at its top
+            from elasticsearch_tpu.action.bulk import estimate_items_bytes
+            est = estimate_items_bytes(req["items"])
+            ip.acquire("primary", est)
+            self._observe_write()
         results: List[Dict[str, Any]] = []
         ops: List[Dict[str, Any]] = []
         for item in req["items"]:
             results.append(self._execute_item(shard, item, ops))
 
         deferred = Deferred()
+
+        def finish() -> None:
+            # build the response (with the pressure snapshot) BEFORE
+            # releasing, so the coordinator sees the load this request
+            # contributed; then release and refresh the local tracker
+            resp = self._primary_response(shard, results)
+            if ip is not None:
+                ip.release("primary", est)
+                self._observe_write()
+            deferred.resolve(resp)
+
         state = self.state()
         replicas = [
             sr for sr in
@@ -131,7 +230,7 @@ class TransportShardBulkAction:
                              ShardState.RELOCATING)]
         pending = {"n": len(replicas)}
         if not ops or not replicas:
-            deferred.resolve(self._primary_response(shard, results))
+            finish()
             return deferred
 
         payload = {"index": index, "shard": shard_id, "ops": ops,
@@ -147,22 +246,64 @@ class TransportShardBulkAction:
         def one_done() -> None:
             pending["n"] -= 1
             if pending["n"] == 0:
-                deferred.resolve(self._primary_response(shard, results))
+                finish()
 
         for replica in replicas:
-            def on_ack(resp, err, sr: ShardRouting = replica) -> None:
-                if err is not None:
-                    # replica could not apply acknowledged writes: it must
-                    # leave the in-sync set before we ack the client
-                    self._fail_replica(sr, str(err), one_done)
-                    return
-                if shard.tracker is not None and sr.allocation_id:
-                    shard.tracker.update_local_checkpoint(
-                        sr.allocation_id, resp.get("local_checkpoint", -1))
-                one_done()
-            self.ts.send_request(replica.node_id, SHARD_BULK_REPLICA,
-                                 payload, on_ack, timeout=30.0)
+            self._replicate_to(replica, payload, shard, one_done)
         return deferred
+
+    def _replicate_to(self, sr: ShardRouting, payload: Dict[str, Any],
+                      shard: IndexShard, one_done: Callable[[], None]
+                      ) -> None:
+        """Send one replica its op batch, retrying REPLICA-STAGE pressure
+        rejections with jittered-exponential backoff before giving up.
+        A transiently-starved replica (its 1.5×-headroom budget full of
+        other primaries' fan-out) converges once it drains — acked docs
+        are never lost to a momentary spike — while a replica still
+        rejecting at REPLICA_RETRY_TIMEOUT is failed from the in-sync
+        set like any other replication failure. Redelivery is safe: a
+        rejected batch applied ZERO ops (the replica charges before
+        applying), and the engine's per-doc seqno guard makes any
+        re-send idempotent anyway."""
+        saw_rejection = {"n": 0}
+
+        def attempt(cb) -> None:
+            self.ts.send_request(sr.node_id, SHARD_BULK_REPLICA, payload,
+                                 cb, timeout=30.0)
+
+        def is_pressure(err: Any) -> bool:
+            if write_pressure_info(err) is None:
+                return False
+            saw_rejection["n"] += 1
+            self.write_pressure_stats["replica_pressure_rejections"] += 1
+            return True
+
+        def on_ack(resp, err) -> None:
+            if err is not None:
+                if write_pressure_info(err) is not None:
+                    self.write_pressure_stats[
+                        "replica_pressure_exhausted"] += 1
+                # replica could not apply acknowledged writes: it must
+                # leave the in-sync set before we ack the client
+                self._fail_replica(sr, str(err), one_done)
+                return
+            if saw_rejection["n"]:
+                self.write_pressure_stats[
+                    "replica_pressure_recoveries"] += 1
+            if isinstance(resp, dict):
+                self._ingest_remote_pressure(
+                    sr.node_id, resp.get("write_pressure"))
+            if shard.tracker is not None and sr.allocation_id:
+                shard.tracker.update_local_checkpoint(
+                    sr.allocation_id, resp.get("local_checkpoint", -1))
+            one_done()
+
+        action = RetryableAction(
+            self.scheduler, attempt, on_ack,
+            initial_delay=RETRY_INITIAL_DELAY, max_delay=RETRY_MAX_DELAY,
+            timeout=REPLICA_RETRY_TIMEOUT, is_retryable=is_pressure)
+        self.last_replica_retry = action
+        action.run()
 
     def _execute_item(self, shard: IndexShard, item: Dict[str, Any],
                       ops: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -241,12 +382,20 @@ class TransportShardBulkAction:
                 "_version": result.version,
                 "status": 201 if result.result == "created" else 200}
 
-    @staticmethod
-    def _primary_response(shard: IndexShard,
+    def _primary_response(self, shard: IndexShard,
                           results: List[Dict[str, Any]]) -> Dict[str, Any]:
-        return {"items": results,
+        resp = {"items": results,
                 "global_checkpoint": shard.global_checkpoint,
                 "local_checkpoint": shard.local_checkpoint}
+        ip = self._pressure()
+        if ip is not None:
+            # write-pressure snapshot piggybacks on the response so the
+            # coordinator's ARS view learns this node is ingest-hot
+            # without a stats poll (response piggyback, PR 11 pattern)
+            resp["write_pressure"] = {
+                "current_bytes": sum(ip.current.values()),
+                "limit_bytes": ip.limit}
+        return resp
 
     def _fail_replica(self, sr: ShardRouting, reason: str,
                       done: Callable[[], None]) -> None:
@@ -266,17 +415,42 @@ class TransportShardBulkAction:
 
     def _on_replica(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
         shard = self.indices.shard(req["index"], req["shard"])
-        for op in req["ops"]:
-            # the REQUEST term is the fence (ops keep their original
-            # terms: a resync re-sends deposed-term ops under the new
-            # primacy); the request's global checkpoint rides along so a
-            # term bump rolls back to the newest checkpoint known anywhere
-            shard.apply_op_on_replica(
-                op, req_primary_term=req["primary_term"],
-                req_global_checkpoint=req["global_checkpoint"])
-        shard.update_global_checkpoint_on_replica(req["global_checkpoint"])
-        shard.learn_retention_leases(req.get("retention_leases"))
-        return {"local_checkpoint": shard.local_checkpoint}
+        # replica-stage charge at 1.5× headroom, BEFORE any op applies:
+        # a rejection means zero ops landed, so the primary's retry loop
+        # can safely redeliver the whole batch. The extra headroom means
+        # a node whose coordinating admission is saturated still accepts
+        # replication fan-out from its peers — without it, two mutually
+        # replicating nodes at their coordinating limits deadlock.
+        ip = self._pressure()
+        est = 0
+        if ip is not None:
+            ip.configure_from_state(self.state())
+            est = _ops_bytes(req["ops"])
+            ip.acquire("replica", est)
+            self._observe_write()
+        try:
+            for op in req["ops"]:
+                # the REQUEST term is the fence (ops keep their original
+                # terms: a resync re-sends deposed-term ops under the new
+                # primacy); the request's global checkpoint rides along
+                # so a term bump rolls back to the newest checkpoint
+                # known anywhere
+                shard.apply_op_on_replica(
+                    op, req_primary_term=req["primary_term"],
+                    req_global_checkpoint=req["global_checkpoint"])
+            shard.update_global_checkpoint_on_replica(
+                req["global_checkpoint"])
+            shard.learn_retention_leases(req.get("retention_leases"))
+        finally:
+            if ip is not None:
+                ip.release("replica", est)
+                self._observe_write()
+        resp = {"local_checkpoint": shard.local_checkpoint}
+        if ip is not None:
+            resp["write_pressure"] = {
+                "current_bytes": sum(ip.current.values()),
+                "limit_bytes": ip.limit}
+        return resp
 
 
 SHARD_RESYNC = "indices:admin/seq_no/resync[r]"

@@ -35,7 +35,7 @@ QUEUE_ADJUSTMENT_EXP = 3.0   # C3's cubic queue penalty
 
 class NodeStatistics:
     __slots__ = ("ewma_ms", "service_ewma_ms", "queue_ewma",
-                 "outstanding", "observations")
+                 "outstanding", "observations", "write_ewma")
 
     def __init__(self) -> None:
         self.ewma_ms: Optional[float] = None          # response time
@@ -43,6 +43,13 @@ class NodeStatistics:
         self.queue_ewma: Optional[float] = None       # node-reported
         self.outstanding = 0
         self.observations = 0
+        # indexing-pressure utilization (in-flight write bytes / limit),
+        # piggybacked on bulk/replication responses and on shard query
+        # responses. OBSERVABLE ONLY: not folded into the C3 rank — the
+        # write plane sheds through its own 429s; this lets operators
+        # (and the stats surface) see the ingest-hot node the search
+        # queue signal will shortly reflect
+        self.write_ewma: Optional[float] = None
 
 
 class ResponseCollectorService:
@@ -137,6 +144,20 @@ class ResponseCollectorService:
                     if stats.service_ewma_ms is None else \
                     ALPHA * s + (1 - ALPHA) * stats.service_ewma_ms
             stats.observations += 1
+
+    def on_write_pressure(self, node_id: str, current_bytes: int,
+                          limit_bytes: int) -> None:
+        """A peer's write-pressure snapshot (piggybacked on a bulk or
+        replication response): EWMA its utilization. Does NOT touch
+        outstanding/response EWMAs — write traffic is not a search round
+        trip — and does not affect the C3 rank (see NodeStatistics)."""
+        if limit_bytes is None or limit_bytes <= 0:
+            return
+        u = max(0.0, float(current_bytes) / float(limit_bytes))
+        with self._lock:
+            stats = self._stats(node_id)
+            stats.write_ewma = u if stats.write_ewma is None else \
+                ALPHA * u + (1 - ALPHA) * stats.write_ewma
 
     def response_ewma_s(self, node_id: str) -> Optional[float]:
         """The node's response-time EWMA in SECONDS, or None before any
@@ -244,5 +265,8 @@ class ResponseCollectorService:
                 if stats.service_ewma_ms is not None:
                     entry["service_ewma_ms"] = \
                         round(stats.service_ewma_ms, 3)
+                if stats.write_ewma is not None:
+                    entry["write_pressure_ewma"] = \
+                        round(stats.write_ewma, 4)
                 out[nid] = entry
             return out

@@ -1745,6 +1745,13 @@ class TransportSearchAction:
                                 service_ms=(pressure or {})
                                 .get("service_ewma_ms"),
                                 queue_depth=(pressure or {}).get("queue"))
+                            wp = (pressure or {}).get("write_pressure")
+                            if wp:
+                                # ingest-hot signal rides the same
+                                # snapshot: utilization in [0,1], scale
+                                # to a synthetic bytes/limit pair
+                                self.response_collector.on_write_pressure(
+                                    node, int(wp * 1_000_000), 1_000_000)
                         if err is None and isinstance(resp, dict) and \
                                 resp.get("took_ms") is not None and \
                                 phase_state.get("trace") is not None:

@@ -251,6 +251,29 @@ def search_admission_stats(thread_pool, response_collector=None,
     return out
 
 
+def indexing_pressure_stats(thread_pool, shard_bulk=None) -> Dict[str, Any]:
+    """Write-path pressure-plane observability (utils/threadpool.py
+    IndexingPressure + action/replication.py): per-stage in-flight /
+    lifetime byte accounting under the coordinating / primary / replica
+    split, the per-stage rejection buckets (with the pinned-zero
+    ``unknown`` bucket — every rejection must be attributable to a
+    stage), the measured release rate behind the computed Retry-After
+    values, and the primary's replica-pressure retry counters
+    (rejections seen, batches that converged on retry, copies failed
+    after the retry budget) — so a shed write, a slow ack, or a dropped
+    replica is explainable from the stats surface alone."""
+    if thread_pool is None:
+        return {}
+    ip = getattr(thread_pool, "indexing_pressure", None)
+    if ip is None:
+        return {}
+    out: Dict[str, Any] = ip.stats()
+    if shard_bulk is not None:
+        out["replica_retries"] = dict(
+            getattr(shard_bulk, "write_pressure_stats", {}) or {})
+    return out
+
+
 def request_cache_stats(search_transport, search_action=None
                         ) -> Dict[str, Any]:
     """Two-tier request-cache observability (indices/request_cache.py):

@@ -128,7 +128,10 @@ class Node:
 
         self.shard_bulk = TransportShardBulkAction(
             node_id, self.indices_service, self.transport_service, scheduler,
-            self._applied_state)
+            self._applied_state, thread_pool=self.thread_pool,
+            # lazy: both services are constructed below, after this action
+            node_pressure=lambda: self.search_transport.batcher.node_pressure,
+            response_collector=lambda: self.search_action.response_collector)
         self.bulk_action = TransportBulkAction(
             self.shard_bulk, self._applied_state, self._auto_create_index,
             ingest_service=self.ingest_service,
@@ -325,6 +328,12 @@ class Node:
                 batcher=self.search_transport.batcher,
                 ars_stats=ars_stats(),
                 failover_stats=self.search_action.shard_busy_stats),
+            # write-path pressure plane: three-stage in-flight byte
+            # accounting, per-stage rejection buckets, Retry-After rates
+            # + the primary's replica-retry counters (threadpool.py
+            # IndexingPressure + action/replication.py)
+            "indexing_pressure": lambda: monitor.indexing_pressure_stats(
+                self.thread_pool, shard_bulk=self.shard_bulk),
             # real probes (OsProbe/ProcessProbe/FsProbe analogs + the
             # device/HBM dimension the reference lacks)
             "os": monitor.os_stats,
@@ -835,6 +844,12 @@ class NodeClient:
                 status = result.get("status", 500)
                 err = SearchEngineError(result["error"]["reason"])
                 err.status = status
+                # an indexing-pressure 429 carries a computed Retry-After:
+                # keep it on the error so the REST controller's
+                # _retry_after_of emits the header for single-doc writes
+                ra = result["error"].get("retry_after")
+                if ra is not None:
+                    err.metadata["retry_after"] = ra
                 on_done(result, err)
             else:
                 # keep the CONCRETE index the bulk path resolved (an
@@ -844,9 +859,11 @@ class NodeClient:
                 on_done(result, None)
         self.node.bulk_action.execute([item], cb)
 
-    def bulk(self, items: List[Dict[str, Any]], on_done) -> None:
+    def bulk(self, items: List[Dict[str, Any]], on_done,
+             payload_bytes: Optional[int] = None) -> None:
         self.node.bulk_action.execute(
-            items, lambda resp: on_done(resp, None))
+            items, lambda resp: on_done(resp, None),
+            payload_bytes=payload_bytes)
 
     def get(self, index: str, doc_id: str, on_done,
             routing: Optional[str] = None, realtime: bool = True) -> None:

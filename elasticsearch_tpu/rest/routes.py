@@ -179,7 +179,10 @@ def build_controller(client: NodeClient) -> RestController:
                                lambda _r, _e=None: done(200, resp))
             else:
                 done(200, resp)
-        client.bulk(items, cb)
+        # charge the RAW NDJSON length at the coordinating stage — the
+        # wire payload is already in hand, so admission costs zero
+        # re-serialization (IndexingPressure charges request bytes)
+        client.bulk(items, cb, payload_bytes=len(req.raw_body))
     r("POST", "/_bulk", bulk)
     r("PUT", "/_bulk", bulk)
     r("POST", "/{index}/_bulk", bulk)
@@ -1409,6 +1412,15 @@ def build_controller(client: NodeClient) -> RestController:
                              for n in node_sections])
                     except Exception:  # noqa: BLE001 — stats must serve
                         merged_rec = {}
+                    try:
+                        from elasticsearch_tpu.utils.threadpool import (
+                            merge_indexing_pressure_sections,
+                        )
+                        merged_ip = merge_indexing_pressure_sections(
+                            [n.get("indexing_pressure") or {}
+                             for n in node_sections])
+                    except Exception:  # noqa: BLE001 — stats must serve
+                        merged_ip = {}
                     done(200, {
                         "cluster_name": state.cluster_name,
                         "status": h["status"],
@@ -1448,6 +1460,10 @@ def build_controller(client: NodeClient) -> RestController:
                         # bytes copied vs avoided, typed file-fallback
                         # reasons, lease/history gauges
                         "recovery": merged_rec,
+                        # fleet-merged write-path pressure plane: byte
+                        # gauges and per-stage rejection buckets summed,
+                        # the worst node's last Retry-After kept as max
+                        "indexing_pressure": merged_ip,
                     })
                 # section-filtered fan-out: every node builds ONLY its
                 # search_latency section for this merge, not the full
@@ -1458,7 +1474,8 @@ def build_controller(client: NodeClient) -> RestController:
                 client.nodes_stats_all(
                     finish,
                     sections=("search_latency", "device_profile",
-                              "request_cache", "recovery"),
+                              "request_cache", "recovery",
+                              "indexing_pressure"),
                     timeout=5.0)
 
             # status through the master-routed health path (the

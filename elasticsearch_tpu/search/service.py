@@ -38,7 +38,8 @@ class NodePressure:
     ALPHA = 0.3
 
     __slots__ = ("in_flight", "service_ewma_ms", "observations",
-                 "occupancy_ewma", "cached_served")
+                 "occupancy_ewma", "cached_served", "write_ewma",
+                 "write_observations")
 
     def __init__(self) -> None:
         self.in_flight = 0
@@ -51,6 +52,12 @@ class NodePressure:
         # request-cache hits answered at intake: served traffic counted
         # into the observation windows (see observe_cached)
         self.cached_served = 0
+        # write-pressure utilization EWMA (in-flight indexing bytes over
+        # the indexing_pressure.memory.limit): fed by the shard bulk
+        # action on every charge/release, piggybacked on search responses
+        # so ARS and the shed point see an INGEST-hot node too
+        self.write_ewma: Optional[float] = None
+        self.write_observations = 0
 
     def observe(self, service_ms: float, members: int = 1) -> None:
         s = max(float(service_ms), 0.0)
@@ -92,13 +99,25 @@ class NodePressure:
             return 1
         return max(1, min(60, int(math.ceil((backlog + 1) / rate))))
 
+    def observe_write(self, current_bytes: int, limit_bytes: int) -> None:
+        """Fold one write-pressure reading (in-flight bytes / limit) into
+        the utilization EWMA. Called by TransportShardBulkAction at every
+        stage charge/release on this node."""
+        if limit_bytes <= 0:
+            return
+        u = max(0.0, float(current_bytes) / float(limit_bytes))
+        self.write_ewma = u if self.write_ewma is None else \
+            self.ALPHA * u + (1 - self.ALPHA) * self.write_ewma
+        self.write_observations += 1
+
     def snapshot(self, queue_depth: int) -> Dict[str, Any]:
         """The piggyback payload: current queue depth is the caller's
         (the batcher knows its queued members); EWMA and in-flight are
         this tracker's."""
         return {"queue": int(queue_depth),
                 "in_flight": int(self.in_flight),
-                "service_ewma_ms": round(self.service_ewma_ms or 0.0, 3)}
+                "service_ewma_ms": round(self.service_ewma_ms or 0.0, 3),
+                "write_pressure": round(self.write_ewma or 0.0, 4)}
 
 
 @dataclass

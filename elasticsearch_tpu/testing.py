@@ -31,24 +31,37 @@ class FaultyDiskIO(DiskIO):
     CorruptionUtils analog of the reference test framework).
 
     Write-path faults (``arm``): 'eio' / 'enospc' raise OSError; 'bit_flip'
-    flips one random bit of the payload; 'truncate' drops a random tail.
-    Rules filter by path substring and operation (write/append/read), and
-    can be limited to a fault count.
+    flips one random bit of the payload; 'truncate' drops a random tail;
+    'slow' charges ``delay_s`` of virtual time per matched operation (a
+    degraded disk: the op still succeeds, it just takes forever — the
+    brownout that backpressure exists for, not the crash that recovery
+    exists for). Rules filter by path substring and operation
+    (write/append/read), and can be limited to a fault count.
     """
 
     def __init__(self, rng: Optional[_random.Random] = None):
         self.random = rng or _random.Random(0)
         self.rules: List[Dict[str, Any]] = []
-        self.stats = {"bit_flips": 0, "truncations": 0, "io_errors": 0}
+        self.stats = {"bit_flips": 0, "truncations": 0, "io_errors": 0,
+                      "slow_ops": 0}
+        # virtual-clock seam for 'slow' rules: InProcessCluster wires
+        # this to advance the deterministic scheduler's clock, so disk
+        # latency is charged INSIDE synchronous write handlers (there is
+        # no real sleeping under virtual time)
+        self.clock_advance: Optional[Callable[[float], None]] = None
 
     # -- armed (in-flight) faults ---------------------------------------
 
     def arm(self, kind: str, match: str = "", op: str = "*",
-            count: Optional[int] = None) -> Dict[str, Any]:
+            count: Optional[int] = None,
+            delay_s: float = 0.05) -> Dict[str, Any]:
         """Arm a fault rule; returns it (pass to disarm, or mutate
-        ``rule['remaining']``). kind: eio|enospc|bit_flip|truncate."""
-        assert kind in ("eio", "enospc", "bit_flip", "truncate"), kind
-        rule = {"kind": kind, "match": match, "op": op, "remaining": count}
+        ``rule['remaining']``). kind: eio|enospc|bit_flip|truncate|slow;
+        ``delay_s`` is the per-operation latency charge for 'slow'."""
+        assert kind in ("eio", "enospc", "bit_flip", "truncate",
+                        "slow"), kind
+        rule = {"kind": kind, "match": match, "op": op, "remaining": count,
+                "delay_s": delay_s}
         self.rules.append(rule)
         return rule
 
@@ -77,7 +90,11 @@ class FaultyDiskIO(DiskIO):
                 self.stats["io_errors"] += 1
                 raise OSError(errno.ENOSPC,
                               f"injected disk-full on [{path.name}]")
-            if kind == "bit_flip" and data:
+            if kind == "slow":
+                self.stats["slow_ops"] += 1
+                if self.clock_advance is not None:
+                    self.clock_advance(rule["delay_s"])
+            elif kind == "bit_flip" and data:
                 data = self._flip_one_bit(data)
                 self.stats["bit_flips"] += 1
             elif kind == "truncate" and data:
@@ -131,6 +148,13 @@ class InProcessCluster:
         # every shard Store/Translog on every node writes through this
         # seeded injector; quiescent (no armed rules) it is a plain DiskIO
         self.disk_io = FaultyDiskIO(_random.Random(seed ^ 0x5EED))
+
+        def _advance(d: float) -> None:
+            # safe mid-task: run_one resumes from max(self._time, t), so
+            # a synchronous advance just means everything already queued
+            # before now+d fires "immediately" after the slow op returns
+            self.scheduler._time += d
+        self.disk_io.clock_advance = _advance
         node_ids = [f"node{i}" for i in range(n_nodes)]
         self._node_ids = node_ids
         self._mesh_data_plane = mesh_data_plane
@@ -1564,6 +1588,301 @@ def disk_full_mid_flush_scenario(seed: int, data_path: str, *,
             "injected_io_errors": c.disk_io.stats["io_errors"]
             - io_before,
             "wrong_hits": wrong_hits,
+        })
+        return summary
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# mixed read/write workload under chaos (write-path pressure plane)
+# ---------------------------------------------------------------------------
+
+def _merged_indexing_pressure(c: "InProcessCluster") -> Dict[str, Any]:
+    """Fleet view of the three-stage write-pressure accounting — the
+    same merge the ``_cluster/stats`` indexing_pressure section performs,
+    fed straight from the node objects (no REST round-trip in a chaos
+    assert path)."""
+    from elasticsearch_tpu.utils.threadpool import (
+        merge_indexing_pressure_sections)
+    return merge_indexing_pressure_sections(
+        [n.thread_pool.indexing_pressure.stats()
+         for n in c.nodes.values()])
+
+
+def mixed_read_write_scenario(seed: int, data_path: str, *,
+                              n_tenants: int = 3, n_nodes: int = 5,
+                              docs: int = 6,
+                              write_bursts: int = 8,
+                              bulks_per_burst: int = 10,
+                              items_per_bulk: int = 3,
+                              pressure_limit: int = 700,
+                              total_searches: int = 100,
+                              duration_s: float = 1.4,
+                              slow_delay_s: float = 0.004
+                              ) -> Dict[str, Any]:
+    """THE write-path pressure tentpole scenario, one seed: a live bulk
+    flood offered ~10:1 over the shrunken ``indexing_pressure.memory.
+    limit`` (each burst's bytes are ~10x what admission can hold in
+    flight), concurrent multi-coordinator search traffic, a slow-disk
+    victim whose translog appends charge real virtual-time latency
+    (FaultyDiskIO 'slow'), and a rolling restart of a replica-holding
+    node mid-ingest.
+
+    Asserts per seed (the chaos suite and bench judge these): zero
+    acked docs lost, zero wrong hits, every write shed a CLEAN typed
+    ``es_rejected_execution_exception`` 429 with a computed Retry-After,
+    the per-stage rejection ``unknown`` bucket pinned at zero, admitted
+    search p99 bounded vs the unloaded baseline, and ingest goodput
+    preserved (accepted bulks keep landing through the whole storm).
+    Returns the measured invariants; bench.py emits them as the
+    ``mixed_rw`` config line."""
+    c = InProcessCluster(n_nodes=n_nodes, seed=seed, data_path=data_path)
+    c.start()
+    try:
+        import numpy as np
+        tenants = [f"t{i}" for i in range(n_tenants)]
+        client = c.client()
+        rng = np.random.default_rng(seed)
+        box: List[Any] = []
+
+        def wait(n: int) -> None:
+            c.run_until(lambda: len(box) >= n, 300.0)
+
+        for tenant in tenants:
+            n0 = len(box)
+            client.create_index(tenant, {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 1},
+                "mappings": {"properties": {"body": {"type": "text"}}}},
+                lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+            c.ensure_green(tenant)
+            for i in range(docs):
+                n0 = len(box)
+                client.index_doc(
+                    tenant, f"d{i}",
+                    {"body": "common " + " ".join(
+                        f"w{int(x)}" for x in rng.integers(0, 8, 4))},
+                    lambda r, e=None: box.append(1))
+                wait(n0 + 1)
+            n0 = len(box)
+            client.refresh(tenant, lambda r, e=None: box.append(1))
+            wait(n0 + 1)
+
+        # chaos cast: the slow-disk victim holds a replica (so fan-out
+        # crosses its degraded translog); the reboot target is another
+        # replica-only holder; master and both stay out of the
+        # coordinator set so in-flight searches aren't stranded
+        master_id = c.master().node_id
+        state = c.master().coordinator.applied_state
+        primary_nodes, copy_nodes = set(), set()
+        for tenant in tenants:
+            for sr in state.routing_table.index(tenant).shard_group(0):
+                if sr.node_id is None:
+                    continue
+                copy_nodes.add(sr.node_id)
+                if sr.primary:
+                    primary_nodes.add(sr.node_id)
+        replica_only = [nid for nid in c._node_ids
+                        if nid in copy_nodes and
+                        nid not in primary_nodes and nid != master_id]
+        slow_victim = replica_only[0] if replica_only else None
+        reboot_target = replica_only[1] if len(replica_only) > 1 else None
+        coordinators = [nid for nid in c._node_ids
+                        if nid != reboot_target][:3]
+        writer = c.nodes[coordinators[0]].client
+
+        # slow disk armed for the WHOLE run, baseline included — the
+        # bound the flood is judged by already contains the brownout
+        # (the fleet_overload_scenario precedent)
+        slow_rule = None
+        if slow_victim is not None:
+            slow_rule = c.disk_io.arm("slow", match=f"/{slow_victim}/",
+                                      op="append", delay_s=slow_delay_s)
+        slow_before = c.disk_io.stats["slow_ops"]
+
+        # the write-pressure plane shrunk to test scale through the
+        # DYNAMIC setting (the satellite under test): one burst offers
+        # bulks_per_burst x ~bulk_bytes against this in-flight budget
+        n0 = len(box)
+        client.cluster_update_settings(
+            {"persistent":
+             {"indexing_pressure.memory.limit": str(pressure_limit)}},
+            lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+
+        harness = FleetTrafficHarness(c, tenants, coordinators, seed)
+
+        # unloaded p99: sequential searches against the same (already
+        # slow-disked) cluster, each alongside one small live write so
+        # the baseline absorbs representative disk-latency charges
+        for k in range(3 * n_tenants):
+            writer.bulk([{"action": "index",
+                          "index": tenants[k % n_tenants],
+                          "id": f"base{k}",
+                          "source": {"body": f"common base{k}"}}],
+                        lambda r, e=None: None)
+            harness.submit_one(tenants[k % n_tenants],
+                               coordinators[k % len(coordinators)],
+                               {"query": {"match": {"body": "common"}},
+                                "size": 5})
+            c.run_until(
+                lambda: all(r["t1"] is not None for r in harness.records),
+                300.0)
+        unloaded_p99 = _p99([r["t1"] - r["t0"] for r in harness.records
+                             if r["err"] is None])
+        harness.records.clear()
+        harness._expected["n"] = 0
+
+        # the live bulk flood: each burst submits its bulks back-to-back
+        # (their coordinating charges overlap by construction), offered
+        # bytes per burst ~= bulks_per_burst x bulk_bytes >> limit
+        acked: Dict[str, set] = {t: set() for t in tenants}
+        attempted: Dict[str, set] = {t: set() for t in tenants}
+        writes_done = {"n": 0}
+        shed_records: List[Dict[str, Any]] = []
+        total_bulks = write_bursts * bulks_per_burst
+
+        def classify(resp: Dict[str, Any], tenant: str) -> None:
+            writes_done["n"] += 1
+            for wrapped in resp.get("items", []):
+                result = next(iter(wrapped.values()))
+                doc_id = result.get("id") or result.get("_id")
+                if "error" not in result:
+                    if doc_id is not None:
+                        acked[tenant].add(doc_id)
+                    continue
+                if result.get("status") == 429:
+                    err = result["error"]
+                    shed_records.append({
+                        "type": err.get("type"),
+                        "retry_after": err.get("retry_after"),
+                        "clean": bool(
+                            err.get("type") ==
+                            "es_rejected_execution_exception" and
+                            int(err.get("retry_after") or 0) >= 1)})
+
+        def submit_bulk(burst: int, b: int) -> None:
+            tenant = tenants[(burst * bulks_per_burst + b) % n_tenants]
+            items = []
+            for i in range(items_per_bulk):
+                doc_id = f"w{burst}_{b}_{i}"
+                attempted[tenant].add(doc_id)
+                items.append({"action": "index", "index": tenant,
+                              "id": doc_id,
+                              "source": {"body": f"common live{burst}"}})
+            writer.bulk(items,
+                        lambda r, e=None, t=tenant: classify(r or {}, t))
+
+        events: List[Tuple[float, Callable[[], None]]] = []
+        for burst in range(write_bursts):
+            t = duration_s * (0.15 + 0.75 * burst / max(write_bursts, 1))
+            events.append((t, lambda bb=burst: [
+                submit_bulk(bb, b) for b in range(bulks_per_burst)]))
+        # rolling restart mid-ingest: a replica holder reboots while
+        # acked writes are still landing — returning copies must catch
+        # up (and replica-stage pressure retries must never turn a
+        # transient reject into a lost ack)
+        if reboot_target is not None:
+            events.append((0.55 * duration_s,
+                           lambda: c.reboot_node(reboot_target)))
+
+        harness.run(duration_s, total_searches, hot_tenant=tenants[0],
+                    hot_window=(0.3 * duration_s, 0.8 * duration_s),
+                    hot_factor=4.0, events=events)
+        summary = harness.summary()
+
+        # every bulk must resolve (replica-pressure retries can run past
+        # the traffic window) before the flood is judged
+        c.run_until(lambda: writes_done["n"] >= total_bulks, 900.0)
+        if slow_rule is not None:
+            c.disk_io.disarm(slow_rule)
+
+        # let the rebooted copy land where it is routed, then refresh
+        from elasticsearch_tpu.cluster.routing import ShardState
+
+        def settled() -> bool:
+            master = c.master()
+            if master is None:
+                return False
+            st = master.coordinator.applied_state
+            for tenant in tenants:
+                for sr in st.routing_table.index(tenant).shard_group(0):
+                    if sr.state != ShardState.STARTED or \
+                            sr.node_id not in c.nodes:
+                        return False
+                    if not c.nodes[sr.node_id].indices_service.has_shard(
+                            tenant, 0):
+                        return False
+            return True
+        c.run_until(settled, 900.0)
+        for tenant in tenants:
+            c.ensure_green(tenant, max_time=600.0)
+        n0 = len(box)
+        client.refresh("t*", lambda r, e=None: box.append(1))
+        wait(n0 + 1)
+
+        # zero lost acked docs + zero wrong hits, per tenant: everything
+        # acked (plus the seed docs and baseline writes) must be found,
+        # nothing outside attempted∪acked may appear
+        lost_acked = 0
+        wrong_hits = 0
+        size = docs + 3 * n_tenants + \
+            write_bursts * bulks_per_burst * items_per_bulk + 8
+        for tenant in tenants:
+            probe: List[Any] = []
+            client.search(tenant, {
+                "query": {"match": {"body": "common"}},
+                "size": size, "track_total_hits": True},
+                lambda r, e=None: probe.append((r, e)))
+            c.run_until(lambda: bool(probe), 300.0)
+            resp, err = probe[0]
+            if err is not None:
+                wrong_hits += 1
+                continue
+            got = {h["_id"] for h in resp["hits"]["hits"]}
+            must = {f"d{i}" for i in range(docs)} | acked[tenant]
+            may = must | attempted[tenant] | \
+                {f"base{k}" for k in range(3 * n_tenants)}
+            lost_acked += len(must - got)
+            if not got <= may:
+                wrong_hits += 1
+
+        ip = _merged_indexing_pressure(c)
+        replica_retries = {
+            k: sum(n.shard_bulk.write_pressure_stats.get(k, 0)
+                   for n in c.nodes.values())
+            for k in ("replica_pressure_rejections",
+                      "replica_pressure_recoveries",
+                      "replica_pressure_exhausted")}
+        acked_docs = sum(len(s) for s in acked.values())
+        attempted_docs = sum(len(s) for s in attempted.values())
+
+        summary.update({
+            "seed": seed,
+            "slow_victim": slow_victim,
+            "reboot_target": reboot_target,
+            "pressure_limit": pressure_limit,
+            "unloaded_p99_s": unloaded_p99,
+            "p99_factor_vs_unloaded": round(
+                summary["admitted_p99_s"] / max(unloaded_p99, 1e-9), 2),
+            "wrong_hits": wrong_hits,
+            "lost_acked_docs": lost_acked,
+            "acked_docs": acked_docs,
+            "attempted_docs": attempted_docs,
+            "write_goodput_fraction": round(
+                acked_docs / max(attempted_docs, 1), 3),
+            "write_sheds": len(shed_records),
+            "clean_write_sheds": sum(
+                1 for s in shed_records if s["clean"]),
+            "unclean_write_sheds": sum(
+                1 for s in shed_records if not s["clean"]),
+            "slow_ops": c.disk_io.stats["slow_ops"] - slow_before,
+            "indexing_pressure": ip,
+            "unknown_stage_rejections":
+                (ip.get("rejections") or {}).get("unknown", 0),
+            "replica_retries": replica_retries,
         })
         return summary
     finally:

@@ -113,6 +113,26 @@ class RejectedExecutionError(SearchEngineError):
     status = 429
 
 
+class EsRejectedExecutionError(RejectedExecutionError):
+    """Write-path indexing-pressure rejection: in-flight write bytes at
+    one of the three stages (coordinating / primary / replica) would
+    exceed the node's ``indexing_pressure.memory.limit`` budget.
+
+    Wire name is ``es_rejected_execution_exception`` — the exact type
+    the reference's IndexingPressure rejections carry, which client
+    bulk-backoff logic keys on.
+
+    Like ShardBusyError, the message carries machine-parseable
+    ``stage=<stage>`` and ``retry_after=<s>s`` suffixes because replica
+    rejections travel back to the primary through transport error
+    STRINGIFICATION (metadata does not survive); the primary re-parses
+    them with ``write_pressure_info`` to tell a transiently-starved
+    replica (retry, converge) from a broken one (fail from the in-sync
+    set)."""
+
+    status = 429
+
+
 class ShardBusyError(SearchEngineError):
     """Data-node shard query queue at its member bound: the query was shed
     AT INTAKE (it never touched a drain). The coordinator treats this as a
@@ -151,6 +171,27 @@ def shard_busy_info(err: Any) -> Optional[Dict[str, int]]:
     q = re.search(r"queued=(\d+)", text)
     return {"retry_after": int(ra.group(1)) if ra else 1,
             "queued": int(q.group(1)) if q else 0}
+
+
+def write_pressure_info(err: Any) -> Optional[Dict[str, Any]]:
+    """Parse a (possibly wire-stringified) indexing-pressure rejection
+    out of any error: returns {"stage": str, "retry_after": s} or None.
+    Works on a local EsRejectedExecutionError, a RemoteTransportError
+    wrapping one, and the bare cause string — the one decoder the
+    primary's replica-retry loop and the bulk item mapper share."""
+    if err is None:
+        return None
+    name = type(err).__name__
+    text = str(err)
+    if name != "EsRejectedExecutionError" and \
+            getattr(err, "cause_type", "") != "EsRejectedExecutionError" \
+            and "EsRejectedExecutionError" not in text:
+        return None
+    import re
+    stage = re.search(r"stage=(\w+)", text)
+    ra = re.search(r"retry_after=(\d+)s", text)
+    return {"stage": stage.group(1) if stage else "unknown",
+            "retry_after": int(ra.group(1)) if ra else 1}
 
 
 class SearchPhaseExecutionError(SearchEngineError):

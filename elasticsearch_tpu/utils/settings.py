@@ -541,6 +541,18 @@ CLUSTER_USE_ADAPTIVE_REPLICA_SELECTION: Setting[bool] = \
         scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
 
+# write-path admission budget (IndexingPressure.MAX_INDEXING_BYTES
+# analog — 10% of heap there, a fixed default here): the node-wide cap
+# on in-flight indexing bytes. Coordinating and primary admission share
+# the limit; the replica stage is granted 1.5x headroom (see
+# utils/threadpool.py IndexingPressure) so replication fan-out can never
+# deadlock behind coordinating admission on the same node. Removing the
+# setting restores the documented 64mb default.
+INDEXING_PRESSURE_MEMORY_LIMIT: Setting[int] = Setting.bytes_setting(
+    "indexing_pressure.memory.limit", "64mb",
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
+
 # gateway.recover_after_data_nodes-style fleet-completeness release: when
 # this many data nodes have joined AND answered the shard-state fetch,
 # allocation stops waiting out EXISTING_COPY_GRACE for absent copy-holders

@@ -311,22 +311,240 @@ DEFAULT_POOLS = {
     "generic": (32, 500),
 }
 
-# indexing-pressure byte limit for in-flight write payloads
-# (IndexingPressure MAX_INDEXING_BYTES analog: 10% of heap; fixed here)
+# indexing-pressure byte limit for in-flight write payloads — the
+# documented default the ``indexing_pressure.memory.limit`` dynamic
+# cluster setting overrides (IndexingPressure MAX_INDEXING_BYTES analog:
+# 10% of heap there; a fixed 64mb default here)
 WRITE_BYTES_LIMIT = 64 * 1024 * 1024
 
 
+class IndexingPressure:
+    """Three-stage in-flight write-byte accounting (IndexingPressure.java
+    analog): every write payload is charged at the stage it occupies —
+    **coordinating** (the node that parsed the bulk request),
+    **primary** (the node executing the shard-level operations), and
+    **replica** (a node applying replicated ops) — and released when
+    that stage's work completes.
+
+    Coordinating and primary admission share ``limit``: together they
+    bound what THIS node has accepted responsibility for. The replica
+    stage is checked separately against ``limit * REPLICA_HEADROOM``
+    (1.5x) — replica work is downstream of a DIFFERENT node's primary
+    having already accepted the bytes, so rejecting it at the shared
+    limit would let a node's own coordinating admission starve the
+    replication fan-out landing on it (the cross-node deadlock the
+    reference's headroom rule exists to break).
+
+    Rejections are typed ``es_rejected_execution_exception`` 429s
+    carrying a computed Retry-After: released bytes are frame-measured
+    into a drain rate (the Pool completion-rate pattern, on bytes), and
+    the rejection's backoff is the time the current in-flight backlog
+    needs to drain at that rate (1s floor, 60s cap — the coordinator
+    pool's clamp). Rejection counts are per stage; the ``unknown``
+    bucket exists so its pinned-at-zero value PROVES every rejection
+    was stage-typed."""
+
+    STAGES = ("coordinating", "primary", "replica")
+    REPLICA_HEADROOM = 1.5
+    # releases per drain-rate measurement frame (Pool.frame_size analog)
+    FRAME_RELEASES = 16
+    RETRY_AFTER_MAX_S = 60
+    ALPHA = 0.3
+
+    def __init__(self, limit: int = WRITE_BYTES_LIMIT,
+                 now_fn: Optional[Callable[[], float]] = None):
+        self.now = now_fn or time.monotonic
+        self.limit = int(limit)
+        self.current: Dict[str, int] = {s: 0 for s in self.STAGES}
+        self.total: Dict[str, int] = {s: 0 for s in self.STAGES}
+        self.rejections: Dict[str, int] = {s: 0 for s in self.STAGES}
+        self.rejections["unknown"] = 0
+        # byte drain-rate measurement (released bytes per second, EWMA
+        # over frames of FRAME_RELEASES releases)
+        self._frame_bytes = 0
+        self._frame_releases = 0
+        self._frame_t0: Optional[float] = None
+        self.release_rate_bps = 0.0
+        self.retry_after_issued = 0
+        self.last_retry_after_s = 0
+        # version-memoized dynamic-settings apply (the search.plane.*
+        # configure_from_state pattern); _settings_applied tracks whether
+        # the CURRENT limit came from cluster settings, so removal
+        # restores the default exactly once without clobbering a limit
+        # set directly (tests/operators poke write_bytes_limit)
+        self._settings_version: Optional[int] = None
+        self._settings_applied = False
+
+    # -- admission --------------------------------------------------------
+
+    def stage_limit(self, stage: str) -> int:
+        if stage == "replica":
+            return int(self.limit * self.REPLICA_HEADROOM)
+        return self.limit
+
+    def _stage_occupancy(self, stage: str) -> int:
+        """The byte total ``stage`` admission is judged against:
+        coordinating+primary share the limit; replica stands alone
+        under its headroom."""
+        if stage == "replica":
+            return self.current["replica"]
+        return self.current["coordinating"] + self.current["primary"]
+
+    def acquire(self, stage: str, n: int) -> None:
+        if stage not in self.STAGES:
+            raise ValueError(f"unknown indexing-pressure stage [{stage}]")
+        n = max(int(n), 0)
+        would = self._stage_occupancy(stage) + n
+        cap = self.stage_limit(stage)
+        if would > cap:
+            self.rejections[stage] += 1
+            retry_after = self.retry_after_s()
+            self.retry_after_issued += 1
+            self.last_retry_after_s = retry_after
+            from elasticsearch_tpu.utils.errors import (
+                EsRejectedExecutionError,
+            )
+            # stage= and retry_after= ride IN the message: replica
+            # rejections cross the wire stringified (PR 9 invariant)
+            # and the primary re-parses them with write_pressure_info
+            raise EsRejectedExecutionError(
+                f"rejected execution of {stage} operation: in-flight "
+                f"indexing bytes [{would}] would exceed [{cap}] "
+                f"stage={stage} retry_after={retry_after}s",
+                retry_after=retry_after, stage=stage)
+        self.current[stage] += n
+        self.total[stage] += n
+
+    def release(self, stage: str, n: int) -> None:
+        n = max(int(n), 0)
+        self.current[stage] = max(0, self.current[stage] - n)
+        now = self.now()
+        if self._frame_t0 is None:
+            self._frame_t0 = now
+        self._frame_bytes += n
+        self._frame_releases += 1
+        if self._frame_releases >= self.FRAME_RELEASES:
+            elapsed = max(now - self._frame_t0, 1e-3)
+            rate = self._frame_bytes / elapsed
+            self.release_rate_bps = rate if self.release_rate_bps == 0.0 \
+                else self.ALPHA * rate + \
+                (1 - self.ALPHA) * self.release_rate_bps
+            self._frame_bytes = 0
+            self._frame_releases = 0
+            self._frame_t0 = now
+
+    def retry_after_s(self) -> int:
+        """Honest write backoff: seconds until the CURRENT in-flight
+        backlog drains at the measured release rate (1s floor, 60s
+        cap). Cold node (no frame yet): 1s."""
+        backlog = sum(self.current.values())
+        rate = self.release_rate_bps
+        if rate <= 0.0:
+            return 1
+        return max(1, min(self.RETRY_AFTER_MAX_S,
+                          int(math.ceil((backlog + 1) / rate))))
+
+    # -- dynamic settings -------------------------------------------------
+
+    def configure_from_state(self, state) -> None:
+        """Apply ``indexing_pressure.memory.limit`` from committed
+        cluster state — version-memoized, so per-request refresh costs
+        one integer compare; settings-removal falls back to the
+        documented WRITE_BYTES_LIMIT default through the setting's own
+        default machinery."""
+        version = getattr(state, "version", None)
+        if version is None or version == self._settings_version:
+            return
+        self._settings_version = version
+        from elasticsearch_tpu.utils.settings import (
+            INDEXING_PRESSURE_MEMORY_LIMIT, setting_from_state,
+        )
+        raw = state.metadata.persistent_settings.get(
+            INDEXING_PRESSURE_MEMORY_LIMIT.key)
+        if raw is None:
+            if self._settings_applied:
+                # setting removed: restore the documented default
+                self.limit = int(
+                    INDEXING_PRESSURE_MEMORY_LIMIT.default(None))
+                self._settings_applied = False
+            return
+        self.limit = int(setting_from_state(
+            state, INDEXING_PRESSURE_MEMORY_LIMIT))
+        self._settings_applied = True
+
+    # -- observability ----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """The first-class ``_nodes/stats`` indexing_pressure section:
+        per-stage current/total/limit/rejections plus the Retry-After
+        drain-rate machinery's live values."""
+        return {
+            "limit_bytes": self.limit,
+            "current_bytes": sum(self.current.values()),
+            "stages": {
+                s: {"current_bytes": self.current[s],
+                    "total_bytes": self.total[s],
+                    "limit_bytes": self.stage_limit(s),
+                    "rejections": self.rejections[s]}
+                for s in self.STAGES},
+            "rejections": dict(self.rejections),
+            "rejections_total": sum(
+                self.rejections[s] for s in self.STAGES),
+            "retry_after": {
+                "issued": self.retry_after_issued,
+                "last_s": self.last_retry_after_s,
+                "release_rate_bytes_per_s": round(
+                    self.release_rate_bps, 1)},
+        }
+
+
+def merge_indexing_pressure_sections(sections) -> Dict[str, Any]:
+    """Fleet merge for ``_cluster/stats``: counters and byte gauges
+    summed across nodes, per-stage rejection buckets summed per bucket,
+    the last Retry-After kept as a maximum (the most-loaded node's
+    honest backoff). Tolerates missing/empty sections from nodes that
+    failed the fan-out."""
+    out: Dict[str, Any] = {
+        "limit_bytes": 0, "current_bytes": 0,
+        "stages": {s: {"current_bytes": 0, "total_bytes": 0,
+                       "rejections": 0}
+                   for s in IndexingPressure.STAGES},
+        "rejections": {s: 0 for s in
+                       (*IndexingPressure.STAGES, "unknown")},
+        "rejections_total": 0,
+        "retry_after": {"issued": 0, "max_last_s": 0},
+    }
+    for sec in sections:
+        if not sec:
+            continue
+        out["limit_bytes"] += sec.get("limit_bytes", 0)
+        out["current_bytes"] += sec.get("current_bytes", 0)
+        for s, stage in (sec.get("stages") or {}).items():
+            agg = out["stages"].setdefault(
+                s, {"current_bytes": 0, "total_bytes": 0,
+                    "rejections": 0})
+            for k in agg:
+                agg[k] += stage.get(k, 0)
+        for reason, n in (sec.get("rejections") or {}).items():
+            out["rejections"][reason] = \
+                out["rejections"].get(reason, 0) + n
+        out["rejections_total"] += sec.get("rejections_total", 0)
+        ra = sec.get("retry_after") or {}
+        out["retry_after"]["issued"] += ra.get("issued", 0)
+        out["retry_after"]["max_last_s"] = max(
+            out["retry_after"]["max_last_s"], ra.get("last_s", 0))
+    return out
+
+
 class ThreadPoolService:
-    """Per-node admission pools + write-bytes accounting."""
+    """Per-node admission pools + three-stage write-bytes accounting."""
 
     def __init__(self, pools: Optional[Dict[str, tuple]] = None,
                  now_fn: Optional[Callable[[], float]] = None):
         self.pools: Dict[str, Pool] = {
             name: Pool(name, size, queue, now_fn=now_fn)
             for name, (size, queue) in (pools or DEFAULT_POOLS).items()}
-        self.write_bytes_in_flight = 0
-        self.write_bytes_limit = WRITE_BYTES_LIMIT
-        self.write_bytes_rejections = 0
+        self.indexing_pressure = IndexingPressure(now_fn=now_fn)
 
     def pool(self, name: str) -> Pool:
         return self.pools[name]
@@ -368,21 +586,37 @@ class ThreadPoolService:
         pool.queue_size = min(max_queue, max(min_queue, pool.queue_size))
 
     # -- write-bytes accounting (indexing pressure) -----------------------
+    # legacy single-gate surface: delegates to the coordinating stage of
+    # the three-stage IndexingPressure (autoscaling reads the aggregate
+    # attributes; older tests drive acquire/release directly)
+
+    @property
+    def write_bytes_in_flight(self) -> int:
+        return sum(self.indexing_pressure.current.values())
+
+    @property
+    def write_bytes_limit(self) -> int:
+        return self.indexing_pressure.limit
+
+    @write_bytes_limit.setter
+    def write_bytes_limit(self, v: int) -> None:
+        self.indexing_pressure.limit = int(v)
+
+    @property
+    def write_bytes_rejections(self) -> int:
+        return sum(self.indexing_pressure.rejections[s]
+                   for s in IndexingPressure.STAGES)
 
     def acquire_write_bytes(self, n: int) -> None:
-        if self.write_bytes_in_flight + n > self.write_bytes_limit:
-            self.write_bytes_rejections += 1
-            raise RejectedExecutionError(
-                f"rejected execution: in-flight indexing bytes "
-                f"[{self.write_bytes_in_flight + n}] would exceed "
-                f"[{self.write_bytes_limit}]")
-        self.write_bytes_in_flight += n
+        self.indexing_pressure.acquire("coordinating", n)
 
     def release_write_bytes(self, n: int) -> None:
-        self.write_bytes_in_flight = max(0, self.write_bytes_in_flight - n)
+        self.indexing_pressure.release("coordinating", n)
 
     def stats(self) -> Dict[str, Any]:
         out = {name: pool.stats() for name, pool in self.pools.items()}
+        # back-compat blob inside thread_pool; the full per-stage view
+        # is the first-class _nodes/stats "indexing_pressure" section
         out["indexing_pressure"] = {
             "current_bytes": self.write_bytes_in_flight,
             "limit_bytes": self.write_bytes_limit,

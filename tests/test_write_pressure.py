@@ -1,0 +1,369 @@
+"""Write-path pressure plane: three-stage indexing pressure, replication
+backpressure, and the mixed read/write workload under chaos.
+
+Reference: index/IndexingPressure.java (coordinating/primary/replica
+in-flight byte accounting -> es_rejected_execution_exception 429s, the
+replica stage's 1.5x headroom), TransportShardBulkAction +
+TransportReplicationAction (per-stage charges around primary execution
+and replica application), and the retry-replica-before-failing-it
+convergence contract acked docs depend on.
+"""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.testing import InProcessCluster, mixed_read_write_scenario
+from elasticsearch_tpu.utils.errors import (
+    EsRejectedExecutionError, write_pressure_info,
+)
+from elasticsearch_tpu.utils.threadpool import (
+    IndexingPressure, merge_indexing_pressure_sections,
+)
+
+CHAOS_SEEDS = int(os.environ.get("CHAOS_SEEDS", "1") or "1")
+
+pytestmark = pytest.mark.write_pressure
+
+
+# ---------------------------------------------------------------------------
+# IndexingPressure units: per-stage accounting, headroom, Retry-After
+# ---------------------------------------------------------------------------
+
+def test_three_stage_accounting_and_typed_rejection():
+    ip = IndexingPressure(limit=1000)
+    ip.acquire("coordinating", 400)
+    ip.acquire("primary", 300)
+    assert ip.current == {"coordinating": 400, "primary": 300, "replica": 0}
+    # coordinating and primary SHARE the limit: 400+300+400 > 1000
+    with pytest.raises(EsRejectedExecutionError) as e:
+        ip.acquire("coordinating", 400)
+    assert e.value.status == 429
+    info = write_pressure_info(e.value)
+    assert info == {"stage": "coordinating", "retry_after": 1}
+    # the decoder also survives wire stringification (PR 9 invariant):
+    # a bare cause string still yields the same stage/retry_after
+    class _Stringified:
+        cause_type = "EsRejectedExecutionError"
+
+        def __str__(self):
+            return str(e.value)
+    assert write_pressure_info(_Stringified()) == info
+    assert write_pressure_info(ValueError("boom")) is None
+    assert ip.rejections == {"coordinating": 1, "primary": 0,
+                             "replica": 0, "unknown": 0}
+    ip.release("coordinating", 400)
+    ip.release("primary", 300)
+    assert sum(ip.current.values()) == 0
+    assert ip.total["coordinating"] == 400 and ip.total["primary"] == 300
+
+
+def test_replica_headroom_breaks_cross_node_deadlock():
+    """A node whose coordinating admission is SATURATED must still accept
+    replication fan-out from its peers — the replica stage is judged
+    alone against limit*1.5, not against the shared budget."""
+    ip = IndexingPressure(limit=1000)
+    ip.acquire("coordinating", 1000)          # own admission full
+    ip.acquire("replica", 1400)               # peers' fan-out still lands
+    assert ip.stage_limit("replica") == 1500
+    with pytest.raises(EsRejectedExecutionError) as e:
+        ip.acquire("replica", 200)            # 1600 > 1500
+    assert write_pressure_info(e.value)["stage"] == "replica"
+    assert ip.rejections["replica"] == 1
+    assert ip.rejections["unknown"] == 0
+
+
+def test_retry_after_tracks_measured_release_rate():
+    t = {"now": 0.0}
+    ip = IndexingPressure(limit=1000, now_fn=lambda: t["now"])
+    assert ip.retry_after_s() == 1            # cold: no frame yet
+    ip.acquire("coordinating", 960)
+    # one full frame of releases over 1.6s: 16 x 10 bytes -> 100 B/s
+    for _ in range(16):
+        t["now"] += 0.1
+        ip.release("coordinating", 10)
+    # frame t0 pins to the first release: 160 bytes over 1.5s
+    assert ip.release_rate_bps == pytest.approx(160.0 / 1.5, rel=0.01)
+    import math
+    expect = max(1, min(60, math.ceil(801 / ip.release_rate_bps)))
+    assert 1 < expect < 60                    # honest mid-range backoff
+    assert ip.retry_after_s() == expect
+    with pytest.raises(EsRejectedExecutionError) as e:
+        ip.acquire("primary", 500)
+    assert e.value.metadata["retry_after"] == expect
+    assert f"retry_after={expect}s" in str(e.value)
+    assert ip.last_retry_after_s == expect and ip.retry_after_issued == 1
+
+
+def test_merge_indexing_pressure_sections():
+    a = IndexingPressure(limit=1000)
+    b = IndexingPressure(limit=2000)
+    a.acquire("coordinating", 100)
+    b.acquire("replica", 200)
+    try:
+        a.acquire("primary", 2000)
+    except EsRejectedExecutionError:
+        pass
+    merged = merge_indexing_pressure_sections(
+        [a.stats(), b.stats(), {}])          # empty section tolerated
+    assert merged["limit_bytes"] == 3000
+    assert merged["current_bytes"] == 300
+    assert merged["stages"]["replica"]["current_bytes"] == 200
+    assert merged["rejections"] == {"coordinating": 0, "primary": 1,
+                                    "replica": 0, "unknown": 0}
+    assert merged["rejections_total"] == 1
+    assert merged["retry_after"]["issued"] == 1
+
+
+def test_dynamic_limit_setting_applies_and_removal_restores_default():
+    from elasticsearch_tpu.utils.threadpool import WRITE_BYTES_LIMIT
+    c = InProcessCluster(n_nodes=1, seed=5)
+    c.start()
+    try:
+        client = c.client()
+        node = c.master()
+        ip = node.thread_pool.indexing_pressure
+        assert ip.limit == WRITE_BYTES_LIMIT
+        resp, err = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"indexing_pressure.memory.limit": "1kb"}}, cb))
+        assert err is None
+        ip.configure_from_state(node.coordinator.applied_state)
+        assert ip.limit == 1024
+        # settings-removal restores the documented 64mb default
+        resp, err = c.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"indexing_pressure.memory.limit": None}}, cb))
+        assert err is None
+        ip.configure_from_state(node.coordinator.applied_state)
+        assert ip.limit == WRITE_BYTES_LIMIT
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: typed 429s across the wire + the Retry-After REST header
+# ---------------------------------------------------------------------------
+
+def test_remote_primary_rejection_is_typed_429_item():
+    """Shrink the pressure budget on the PRIMARY holder only: a bulk
+    through another coordinator comes back with per-item typed 429s
+    (the rejection crossed the transport stringified and was re-typed),
+    each carrying a Retry-After."""
+    c = InProcessCluster(n_nodes=3, seed=11)
+    c.start()
+    try:
+        client = c.client()
+        resp, err = c.call(lambda cb: client.create_index("t", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 0}}, cb))
+        assert err is None
+        c.ensure_green("t")
+        state = c.master().coordinator.applied_state
+        primary_node = next(
+            sr.node_id for sr in
+            state.routing_table.index("t").shard_group(0) if sr.primary)
+        coordinator = next(nid for nid in c.nodes if nid != primary_node)
+        # primary-stage budget too small for the batch, on that node only
+        c.nodes[primary_node].thread_pool.write_bytes_limit = 50
+        items = [{"action": "index", "index": "t", "id": f"d{i}",
+                  "source": {"pad": "x" * 100}} for i in range(3)]
+        resp, err = c.call(lambda cb: c.nodes[coordinator].client.bulk(
+            items, cb))
+        assert err is None and resp["errors"]
+        for wrapped in resp["items"]:
+            result = next(iter(wrapped.values()))
+            assert result["status"] == 429
+            assert result["error"]["type"] == \
+                "es_rejected_execution_exception"
+            assert result["error"]["retry_after"] >= 1
+        stats = c.nodes[primary_node].local_node_stats()
+        assert stats["indexing_pressure"]["rejections"]["primary"] >= 1
+        assert stats["indexing_pressure"]["rejections"]["unknown"] == 0
+    finally:
+        c.stop()
+
+
+def test_rest_bulk_429_surfaces_retry_after_header():
+    from elasticsearch_tpu.rest.controller import RestRequest
+    from elasticsearch_tpu.rest.routes import build_controller
+    from elasticsearch_tpu.rest.server import retry_after_of
+    c = InProcessCluster(n_nodes=1, seed=13)
+    c.start()
+    try:
+        c.master().thread_pool.write_bytes_limit = 40
+        rc = build_controller(c.client())
+        ndjson = b"""{"index": {"_index": "t", "_id": "d0"}}
+{"body": "xxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxxx"}
+"""
+        box = []
+        rc.dispatch(RestRequest(method="POST", path="/_bulk",
+                                raw_body=ndjson),
+                    lambda status, body: box.append((status, body)))
+        c.run_until(lambda: bool(box), 120.0)
+        status, body = box[0]
+        assert status == 429
+        assert body["error"]["type"] == "es_rejected_execution_exception"
+        # the HTTP server mints the Retry-After header from exactly this
+        assert retry_after_of(status, body) >= 1
+        # raw NDJSON length was the coordinating charge (no re-serialize)
+        ip = c.master().thread_pool.indexing_pressure
+        assert ip.rejections["coordinating"] == 1
+    finally:
+        c.stop()
+
+
+def test_single_doc_429_keeps_retry_after_metadata():
+    c = InProcessCluster(n_nodes=1, seed=7)
+    c.start()
+    try:
+        client = c.client()
+        c.master().thread_pool.write_bytes_limit = 40
+        resp, err = c.call(lambda cb: client.index_doc(
+            "t", "d0", {"pad": "x" * 100}, cb))
+        assert err is not None and err.status == 429
+        assert int(err.metadata.get("retry_after", 0)) >= 1
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# replication backpressure: replica-stage rejections retry and converge
+# ---------------------------------------------------------------------------
+
+def test_replica_rejection_retries_and_converges_zero_lost():
+    """Pre-charge the replica stage on the replica holder so incoming
+    fan-out rejects; release mid-retry. The primary's RetryableAction
+    must redeliver (the rejected batch applied ZERO ops), the write
+    acks, the replica converges — and is NOT failed from the group."""
+    c = InProcessCluster(n_nodes=2, seed=19)
+    c.start()
+    try:
+        client = c.client()
+        resp, err = c.call(lambda cb: client.create_index("t", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 1}}, cb))
+        assert err is None
+        c.ensure_green("t")
+        state = c.master().coordinator.applied_state
+        group = list(state.routing_table.index("t").shard_group(0))
+        primary_node = next(sr.node_id for sr in group if sr.primary)
+        replica_node = next(sr.node_id for sr in group if not sr.primary)
+        rip = c.nodes[replica_node].thread_pool.indexing_pressure
+        # fill the replica stage to its headroom cap: the next batch
+        # rejects until the synthetic charge is released
+        synthetic = rip.stage_limit("replica")
+        rip.acquire("replica", synthetic)
+        c.scheduler.schedule(1.0, lambda: rip.release("replica", synthetic))
+        resp, err = c.call(lambda cb: client.index_doc(
+            "t", "doc1", {"v": 1}, cb), max_time=120.0)
+        assert err is None and resp["result"] == "created"
+        stats = c.nodes[primary_node].shard_bulk.write_pressure_stats
+        assert stats["replica_pressure_rejections"] >= 1
+        assert stats["replica_pressure_recoveries"] >= 1
+        assert stats["replica_pressure_exhausted"] == 0
+        # the transiently-starved replica stayed in the group and holds
+        # the doc (acked docs never lost)
+        c.ensure_green("t")
+        resp, err = c.call(lambda cb: client.refresh("t", cb))
+        replica_shard = c.nodes[replica_node].indices_service.shard("t", 0)
+        assert replica_shard.engine.get("doc1", realtime=True) is not None
+        assert rip.rejections["replica"] >= 1
+        assert rip.rejections["unknown"] == 0
+    finally:
+        c.stop()
+
+
+def test_write_pressure_snapshot_reaches_ars_view():
+    """The primary's write-pressure snapshot piggybacks on the bulk
+    response; the coordinator folds it into its ResponseCollector as
+    the observable-only write_pressure_ewma."""
+    c = InProcessCluster(n_nodes=2, seed=23)
+    c.start()
+    try:
+        client = c.client()
+        resp, err = c.call(lambda cb: client.create_index("t", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 1}}, cb))
+        assert err is None
+        c.ensure_green("t")
+        for i in range(4):
+            resp, err = c.call(lambda cb, i=i: client.index_doc(
+                "t", f"d{i}", {"v": i}, cb))
+            assert err is None
+        seen = 0
+        for node in c.nodes.values():
+            for entry in \
+                    node.search_action.response_collector.stats().values():
+                if "write_pressure_ewma" in entry:
+                    seen += 1
+        assert seen >= 1
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# stats surfaces
+# ---------------------------------------------------------------------------
+
+def test_cluster_stats_merges_indexing_pressure():
+    from elasticsearch_tpu.rest.controller import RestRequest
+    from elasticsearch_tpu.rest.routes import build_controller
+    c = InProcessCluster(n_nodes=2, seed=29)
+    c.start()
+    try:
+        client = c.client()
+        resp, err = c.call(lambda cb: client.create_index("t", {
+            "settings": {"number_of_shards": 1,
+                         "number_of_replicas": 1}}, cb))
+        assert err is None
+        c.ensure_green("t")
+        c.master().thread_pool.write_bytes_limit = 40
+        resp, err = c.call(lambda cb: c.master().client.index_doc(
+            "t", "big", {"pad": "x" * 100}, cb))
+        assert err is not None and err.status == 429
+        rc = build_controller(c.client())
+        box = []
+        rc.dispatch(RestRequest(method="GET", path="/_cluster/stats"),
+                    lambda status, body: box.append((status, body)))
+        c.run_until(lambda: bool(box), 300.0)
+        status, body = box[0]
+        assert status == 200
+        ip = body["indexing_pressure"]
+        assert ip["rejections_total"] >= 1
+        assert ip["rejections"]["unknown"] == 0
+        # both nodes' limits summed: the fleet view, not one node's
+        assert ip["limit_bytes"] > c.master().thread_pool.write_bytes_limit
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------------------
+# the mixed read/write workload under chaos
+# ---------------------------------------------------------------------------
+
+def _assert_mixed_rw_invariants(s):
+    assert s["lost_acked_docs"] == 0, s
+    assert s["wrong_hits"] == 0, s
+    assert s["write_sheds"] > 0 and s["unclean_write_sheds"] == 0, s
+    assert s["unknown_stage_rejections"] == 0, s
+    # ingest goodput preserved: accepted bulks kept landing through the
+    # storm (well past a single burst's worth)
+    assert s["acked_docs"] >= 2 * 3, s
+    assert s["p99_factor_vs_unloaded"] <= 4.0, s
+    assert s["replica_retries"]["replica_pressure_exhausted"] == 0, s
+    assert s["slow_ops"] >= 1, s          # the slow disk really engaged
+    assert s["starved_tenants"] == [], s
+
+
+@pytest.mark.parametrize("seed", [67 + 907 * k for k in range(CHAOS_SEEDS)])
+def test_mixed_read_write_scenario_invariants(seed, tmp_path):
+    s = mixed_read_write_scenario(seed, str(tmp_path))
+    _assert_mixed_rw_invariants(s)
+
+
+@pytest.mark.slow
+def test_mixed_read_write_seed_sweep(tmp_path):
+    """Five-plus seed sweep of the mixed workload (CHAOS_SEEDS widens)."""
+    for k in range(max(CHAOS_SEEDS, 5)):
+        seed = 101 + 613 * k
+        s = mixed_read_write_scenario(seed, str(tmp_path / str(seed)))
+        _assert_mixed_rw_invariants(s)
