@@ -982,6 +982,80 @@ def cfg_device_profile(np, jax, jnp, result):
                                            ag_masks[p], bases,
                                            intervals, ag_b)[0])
 
+    # the multi-host mesh kernel families (parallel/mesh.py
+    # mesh_bm25_* / mesh_knn_*): one fleet-spanning program per phase
+    # under a DECLARED host topology must hold the same zero
+    # steady-state recompile contract as the single-host families —
+    # growing the fleet must never become a per-query compile storm
+    from elasticsearch_tpu.index import InternalEngine
+    from elasticsearch_tpu.mapping import MapperService
+    from elasticsearch_tpu.ops.device_segment import MESH_PLANES
+    from elasticsearch_tpu.parallel.mesh import parse_host_topology
+    from elasticsearch_tpu.search import dsl as _dsl
+    from elasticsearch_tpu.search.batch_executor import (
+        BatchSpec as _MBatchSpec, _build_ctxs as _m_build_ctxs,
+    )
+    from elasticsearch_tpu.search.phase import shard_term_stats
+    from elasticsearch_tpu.search.plane_exec import (
+        mesh_knn_winners, mesh_wand_topk,
+    )
+    m_dims = 8
+    m_vocab = [f"w{i}" for i in range(40)]
+    m_engines = []
+    for s in range(3):
+        eng = InternalEngine(MapperService({"properties": {
+            "body": {"type": "text"},
+            "vec": {"type": "dense_vector", "dims": m_dims,
+                    "similarity": "cosine"}}}), shard_label=f"dpm{s}")
+        r = np.random.default_rng(SEED + 31 + s)
+        for i in range(256):
+            eng.index(str(i), {
+                "body": " ".join(r.choice(
+                    m_vocab, size=int(r.integers(4, 10)))),
+                "vec": [float(x) for x in r.standard_normal(m_dims)]})
+            if i == 128:
+                eng.refresh()
+        eng.refresh()
+        m_engines.append(eng)
+    m_mappers = m_engines[0].mappers
+    m_readers = [e.acquire_reader() for e in m_engines]
+    m_segments = [(("dp", s), list(r.segments))
+                  for s, r in enumerate(m_readers)]
+    m_old = (MESH_PLANES.enabled, MESH_PLANES.min_shards,
+             MESH_PLANES.hosts)
+    MESH_PLANES.clear()
+    MESH_PLANES.enabled = True
+    MESH_PLANES.min_shards = 1
+    n_dev = len(jax.devices())
+    MESH_PLANES.hosts = parse_host_topology(
+        f"2x{n_dev // 2}" if n_dev >= 2 else "1")
+    m_clauses = [[("w1 w3 w5", 1.0)], [("w2 w7", 1.0)]]
+    m_ctxs = []
+    for r in m_readers:
+        m_dfs_all = {}
+        for cl in m_clauses:
+            _dc, m_dfs = shard_term_stats(
+                r, m_mappers, _dsl.Match(field="body", text=cl[0][0]))
+            for fname, termmap in m_dfs.items():
+                m_dfs_all.setdefault(fname, {}).update(termmap)
+        m_ctxs.append(_m_build_ctxs(
+            r, m_mappers, sum(s.n_docs for s in r.segments),
+            m_dfs_all))
+    m_specs = [_MBatchSpec(kind="knn", field="vec", window=K,
+                           clip_limit=None, k=K, num_candidates=64,
+                           boost=1.0,
+                           query_vector=[float(x) for x in
+                                         rng.standard_normal(m_dims)])
+               for _ in range(2)]
+    m_mp = MESH_PLANES.get(m_segments, "postings", "body")
+    m_mv = MESH_PLANES.get(m_segments, "vectors", "vec")
+
+    def run_mesh_multihost():
+        if m_mp is not None:
+            mesh_wand_topk(m_ctxs, m_mp, "body", m_clauses, K, 10_000)
+        if m_mv is not None:
+            mesh_knn_winners(m_ctxs, m_mv, "vec", m_specs, K)
+
     out = {"warm_iters": 2, "steady_iters": 3}
     ok_all = True
     for name, fn in (("bm25", run_bm25), ("knn", run_knn),
@@ -989,7 +1063,8 @@ def cfg_device_profile(np, jax, jnp, result):
                      ("bm25_coarse", run_bm25_coarse),
                      ("knn_coarse", run_knn_coarse),
                      ("sparse_coarse", run_sparse_coarse),
-                     ("aggs_plane", run_aggs_plane)):
+                     ("aggs_plane", run_aggs_plane),
+                     ("mesh_multihost", run_mesh_multihost)):
         before_warm = DEVICE_PROFILE.total_compiles()
         for _ in range(2):
             fn()
@@ -1002,6 +1077,9 @@ def cfg_device_profile(np, jax, jnp, result):
                  "ok": recompiles == 0}
         ok_all = ok_all and entry["ok"]
         out[name] = entry
+    (MESH_PLANES.enabled, MESH_PLANES.min_shards,
+     MESH_PLANES.hosts) = m_old
+    MESH_PLANES.clear()
     snap = DEVICE_PROFILE.snapshot()
     out["families"] = {
         name: {"compiles": fam["compiles"],
@@ -2110,6 +2188,24 @@ def multichip_scaling(per_shard_docs: int = 0, q_batch: int = 8,
                                      for x in rng.standard_normal(dims)])
              for _ in range(q_batch)]
 
+    def shard_inputs(n_sh: int):
+        from elasticsearch_tpu.search import dsl
+        from elasticsearch_tpu.search.phase import shard_term_stats
+        readers = [engines[s].acquire_reader() for s in range(n_sh)]
+        shard_segments = [(("bench", s), list(r.segments))
+                          for s, r in enumerate(readers)]
+        shard_ctxs = []
+        for r in readers:
+            doc_count = sum(seg.n_docs for seg in r.segments)
+            dfs = {}
+            for cl in clause_lists:
+                _dc, m_dfs = shard_term_stats(
+                    r, mappers, dsl.Match(field="body", text=cl[0][0]))
+                for fname, termmap in m_dfs.items():
+                    dfs.setdefault(fname, {}).update(termmap)
+            shard_ctxs.append(_build_ctxs(r, mappers, doc_count, dfs))
+        return readers, shard_segments, shard_ctxs
+
     old = (MESH_PLANES.enabled, MESH_PLANES.min_shards,
            PLANES.enabled, PLANES.min_segments)
     MESH_PLANES.enabled = True
@@ -2118,25 +2214,7 @@ def multichip_scaling(per_shard_docs: int = 0, q_batch: int = 8,
     PLANES.min_segments = 2
     try:
         for n_sh in counts:
-            readers = [engines[s].acquire_reader() for s in range(n_sh)]
-            shard_segments = [(("bench", s), list(r.segments))
-                              for s, r in enumerate(readers)]
-            shard_ctxs = []
-            for r in readers:
-                from elasticsearch_tpu.search.phase import (
-                    shard_term_stats,
-                )
-                doc_count = sum(seg.n_docs for seg in r.segments)
-                dfs = {}
-                for cl in clause_lists:
-                    from elasticsearch_tpu.search import dsl
-                    _dc, m_dfs = shard_term_stats(
-                        r, mappers,
-                        dsl.Match(field="body", text=cl[0][0]))
-                    for fname, termmap in m_dfs.items():
-                        dfs.setdefault(fname, {}).update(termmap)
-                shard_ctxs.append(_build_ctxs(r, mappers, doc_count,
-                                              dfs))
+            readers, shard_segments, shard_ctxs = shard_inputs(n_sh)
             mp = MESH_PLANES.get(shard_segments, "postings", "body")
             mv = MESH_PLANES.get(shard_segments, "vectors", "vec")
             parts = [PLANES.get(list(r.segments), "postings", "body")
@@ -2205,9 +2283,103 @@ def multichip_scaling(per_shard_docs: int = 0, q_batch: int = 8,
                 base[k]["device_dispatches_per_query_mesh"]
                 for k in ("bm25", "knn"))
             out["capacity_ratio"] = counts[-1] / counts[0]
+
+        # per-HOST scaling (the cross-host mesh acceptance contract):
+        # fixed devices per virtual host, the fleet grown 1 -> 2 -> 4
+        # hosts with shards mapped 1:1 onto the fleet's devices. Each
+        # added HOST adds corpus at CONSTANT mesh dispatches/query (one
+        # program per phase regardless of fleet size) while the
+        # per-shard fan-out's dispatch count grows with the shard count.
+        from elasticsearch_tpu.parallel.mesh import parse_host_topology
+        per_host = max(1, n_devices // 4)
+        hs = {"devices_per_host": per_host, "per_hosts": {}}
+        for n_hosts in (1, 2, 4):
+            n_sh = n_hosts * per_host
+            if n_sh > n_devices or n_sh > len(engines):
+                continue
+            MESH_PLANES.clear()
+            PLANES.clear()
+            MESH_PLANES.enabled = True
+            MESH_PLANES.min_shards = 1
+            PLANES.enabled = True
+            PLANES.min_segments = 2
+            MESH_PLANES.hosts = parse_host_topology(
+                f"{n_hosts}x{per_host}")
+            readers, shard_segments, shard_ctxs = shard_inputs(n_sh)
+            mp = MESH_PLANES.get(shard_segments, "postings", "body")
+            mv = MESH_PLANES.get(shard_segments, "vectors", "vec")
+            parts = [PLANES.get(list(r.segments), "postings", "body")
+                     for r in readers]
+            vparts = [PLANES.get(list(r.segments), "vectors", "vec")
+                      for r in readers]
+            if mp is None or mv is None or None in parts or \
+                    None in vparts:
+                hs["per_hosts"][str(n_hosts)] = {
+                    "error": "plane missing"}
+                continue
+            entry = {"shards": n_sh,
+                     "docs_total": n_sh * per_shard_docs}
+            for name in ("bm25", "knn"):
+                c_mesh, c_fan = [], []
+                if name == "bm25":
+                    def mesh_fn():
+                        return mesh_wand_topk(shard_ctxs, mp, "body",
+                                              clause_lists, K, 10_000)
+
+                    def fan_fn():
+                        return [plane_wand_topk(
+                            shard_ctxs[s], parts[s], "body",
+                            clause_lists, K, 10_000)
+                            for s in range(n_sh)]
+                    mesh_wand_topk(shard_ctxs, mp, "body",
+                                   clause_lists, K, 10_000,
+                                   counter=c_mesh)
+                    for s in range(n_sh):
+                        plane_wand_topk(shard_ctxs[s], parts[s],
+                                        "body", clause_lists, K,
+                                        10_000, counter=c_fan)
+                else:
+                    def mesh_fn():
+                        return mesh_knn_winners(shard_ctxs, mv, "vec",
+                                                specs, K)
+
+                    def fan_fn():
+                        return [plane_knn_winners(
+                            shard_ctxs[s], vparts[s], "vec", specs, K)
+                            for s in range(n_sh)]
+                    mesh_knn_winners(shard_ctxs, mv, "vec", specs, K,
+                                     counter=c_mesh)
+                    for s in range(n_sh):
+                        plane_knn_winners(shard_ctxs[s], vparts[s],
+                                          "vec", specs, K,
+                                          counter=c_fan)
+                t_mesh = timed(mesh_fn, iters, lambda _x: None)
+                t_fan = timed(fan_fn, iters, lambda _x: None)
+                entry[name] = {
+                    "qps_mesh": round(iters * q_batch / t_mesh, 2),
+                    "qps_fanout": round(iters * q_batch / t_fan, 2),
+                    "device_dispatches_per_query_mesh": len(c_mesh),
+                    "device_dispatches_per_query_fanout": len(c_fan),
+                }
+            hs["per_hosts"][str(n_hosts)] = entry
+        hkeys = sorted((k for k in hs["per_hosts"]
+                        if "bm25" in hs["per_hosts"][k]), key=int)
+        if len(hkeys) >= 2:
+            lo = hs["per_hosts"][hkeys[0]]
+            hi = hs["per_hosts"][hkeys[-1]]
+            hs["constant_dispatches_across_hosts"] = all(
+                hi[k]["device_dispatches_per_query_mesh"] ==
+                lo[k]["device_dispatches_per_query_mesh"]
+                for k in ("bm25", "knn"))
+            hs["fanout_dispatch_growth"] = round(
+                hi["bm25"]["device_dispatches_per_query_fanout"] /
+                max(1, lo["bm25"][
+                    "device_dispatches_per_query_fanout"]), 2)
+        out["host_scaling"] = hs
     finally:
         (MESH_PLANES.enabled, MESH_PLANES.min_shards,
          PLANES.enabled, PLANES.min_segments) = old
+        MESH_PLANES.hosts = None
         MESH_PLANES.clear()
         PLANES.clear()
     return out

@@ -527,6 +527,23 @@ class SearchTransportService:
             evict_device_caches(reader)
         return response
 
+    def _fetch_shard(self, req: Dict[str, Any]):
+        """The shard instance a fetch context was pinned on. Mesh-served
+        fan-outs pin coordinator-local contexts on mesh-MEMBER copies
+        (the request's ``served_by``); the host backend reaches that
+        copy exactly as the mesh executor did at query time. Plain RPC
+        fetches carry no ``served_by`` and stay strictly local."""
+        served_by = req.get("served_by")
+        if served_by and served_by != self.node_id:
+            from elasticsearch_tpu.parallel.mesh import host_backend
+            backend = host_backend()
+            if backend is not None:
+                svc = backend.indices_of(served_by)
+                if svc is not None and svc.has_shard(req["index"],
+                                                     req["shard"]):
+                    return svc.shard(req["index"], req["shard"])
+        return self.indices.shard(req["index"], req["shard"])
+
     def _on_fetch(self, req: Dict[str, Any], sender: str) -> Dict[str, Any]:
         self._reap()
         # fetch is the context's last use: release it (the reference frees
@@ -537,9 +554,9 @@ class SearchTransportService:
         else:
             # context expired: re-acquire (results may shift post-merge;
             # the reference fails the request — we degrade gracefully)
-            shard_obj = self.indices.shard(req["index"], req["shard"])
+            shard_obj = self._fetch_shard(req)
             reader = shard_obj.engine.acquire_reader()
-        shard = self.indices.shard(req["index"], req["shard"])
+        shard = self._fetch_shard(req)
         body = req.get("body", {})
         docs = [ShardDoc(d["segment"], d["doc"], d["score"],
                          tuple(d.get("sort", ())))
@@ -1345,15 +1362,23 @@ class TransportSearchAction:
                                        hits=[]), None)
                 return
             if search_type == "dfs_query_then_fetch":
-                if len(live_targets) >= 2:
-                    # DFS fan-outs skip the mesh (the per-shard plane
-                    # serves them via the dual normalization channel)
-                    TELEMETRY.count_fallback(telemetry.MESH_DFS_OVERRIDE)
-                self._dfs_phase(live_targets, body,
-                                lambda overrides: self._query_phase(
-                                    t0, live_targets, body, window, from_,
-                                    size, phase_state, len(targets), on_done,
-                                    overrides))
+                def run_dfs(overrides: Dict[str, Any]) -> None:
+                    def run_query() -> None:
+                        self._query_phase(t0, live_targets, body, window,
+                                          from_, size, phase_state,
+                                          len(targets), on_done, overrides)
+                    # DFS fan-outs ride the mesh too: the coordinator's
+                    # global df/avgdl land in every shard context of ONE
+                    # mesh program per phase instead of a per-shard RPC
+                    # fan-out; any miss re-enters the RPC query phase
+                    # with the same overrides
+                    if self._try_mesh_sharded_path(
+                            t0, live_targets, body, window, from_, size,
+                            phase_state, len(targets), on_done, run_query,
+                            dfs_overrides=overrides):
+                        return
+                    run_query()
+                self._dfs_phase(live_targets, body, run_dfs)
                 return
 
             def run_query() -> None:
@@ -1381,7 +1406,7 @@ class TransportSearchAction:
 
     def _try_mesh_sharded_path(self, t0, targets, body, window, from_,
                                size, phase_state, n_total_shards, on_done,
-                               fallback) -> bool:
+                               fallback, dfs_overrides=None) -> bool:
         """Submit the fan-out to the mesh executor; True = submitted (it
         answers through ``on_done`` or re-enters ``fallback`` on a mesh
         miss). ``targets`` are the can-match survivors;
@@ -1419,7 +1444,7 @@ class TransportSearchAction:
             # — so a mesh-serving node's saturation is never invisible
             # to replica selection the moment a mesh spans nodes
             self._observe_mesh_serving(targets,
-                                       scheduler.now() - t_sent)
+                                       scheduler.now() - t_sent, results)
             phase_state["data_plane"] = "mesh_plane"
             for target in targets:
                 target["node"] = self.node_id    # fetch runs locally
@@ -1429,28 +1454,45 @@ class TransportSearchAction:
 
         submitted = self.search_transport.mesh_executor.try_submit(
             index, targets, body, window, phase_state.get("task"),
-            on_results, deadline=phase_state.get("deadline"))
+            on_results, deadline=phase_state.get("deadline"),
+            dfs_overrides=dfs_overrides)
         if submitted:
             phase_state["_t_query_ns"] = time.monotonic_ns()
             _task_phase(phase_state, "query", plane="mesh")
         return submitted
 
-    def _observe_mesh_serving(self, targets, rtt_s: float) -> None:
+    def _observe_mesh_serving(self, targets, rtt_s: float,
+                              results=None) -> None:
         """Feed ARS one synthesized per-shard observation per mesh-served
-        target: the serving node (this one) gets on_send/on_response
-        pairs whose service/queue figures come straight from its own
-        batcher pressure tracker (the mesh drain observes itself into
-        NodePressure), exactly the piggyback an RPC shard response would
-        have carried."""
+        target, attributed per serving HOST: each observation lands on
+        the node whose copy the mesh actually scored (the synthesized
+        response's ``served_by``), carrying THAT node's pressure
+        snapshot — local from this batcher's tracker, remote via the
+        host backend — exactly the piggyback an RPC shard response from
+        that node would have carried. So on a multi-host mesh a
+        saturated member host is visible to replica selection per host,
+        not smeared into the coordinator's figures."""
         if self.search_transport is None:
             return
         try:
+            from elasticsearch_tpu.parallel.mesh import host_backend
+            backend = host_backend()
             batcher = self.search_transport.batcher
-            snap = batcher.node_pressure.snapshot(batcher.queue_depth())
-            for _t in targets:
-                self.response_collector.on_send(self.node_id)
+            local_snap = batcher.node_pressure.snapshot(
+                batcher.queue_depth())
+            snaps: Dict[str, Any] = {self.node_id: local_snap}
+            for i, _t in enumerate(targets):
+                node = self.node_id
+                if results is not None and i < len(results):
+                    node = results[i].get("served_by") or self.node_id
+                snap = snaps.get(node)
+                if snap is None:
+                    remote = backend.pressure_snapshot(node) \
+                        if backend is not None else None
+                    snap = snaps[node] = remote or local_snap
+                self.response_collector.on_send(node)
                 self.response_collector.on_response(
-                    self.node_id, rtt_s,
+                    node, rtt_s,
                     service_ms=snap.get("service_ewma_ms"),
                     queue_depth=snap.get("queue"))
         except Exception:  # noqa: BLE001 — observability must never
@@ -2375,6 +2417,9 @@ class TransportSearchAction:
             req = {"index": target["index"], "shard": target["shard"],
                    "context_id": results[tidx]["context_id"],
                    "docs": [d for _, d in docs], "body": body}
+            served_by = results[tidx].get("served_by")
+            if served_by:
+                req["served_by"] = served_by
 
             def cb(resp, err):
                 if err is None and resp is not None:

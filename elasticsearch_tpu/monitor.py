@@ -172,7 +172,13 @@ def mesh_plane_stats(mesh_executor=None) -> Dict[str, Any]:
     MeshPlaneRegistry + search/mesh_executor.py): builds vs incremental
     appends, evictions, miss fallbacks, resident bytes (total and per
     device), plus the fan-out executor's served/fallback/dispatch
-    counters. Never initializes the device layer itself."""
+    counters. On a multi-host mesh (search.mesh.hosts) the section also
+    carries the configured topology (``hosts``: n_hosts /
+    devices_per_host / spec) and ``per_host`` serving counters — per
+    host label, shard results scored off that host's copies and typed
+    mesh_host_lost losses — so _nodes/stats shows WHERE the one-program
+    fan-out's work actually lands as the mesh grows past one node.
+    Never initializes the device layer itself."""
     import sys
     mod = sys.modules.get("elasticsearch_tpu.ops.device_segment")
     if mod is None:
@@ -180,6 +186,9 @@ def mesh_plane_stats(mesh_executor=None) -> Dict[str, Any]:
     out = mod.MESH_PLANES.stats_snapshot()
     if mesh_executor is not None:
         out.update(mesh_executor.stats)
+        per_host = getattr(mesh_executor, "per_host_stats", None)
+        if per_host:
+            out["per_host"] = {h: dict(c) for h, c in per_host.items()}
     return out
 
 

@@ -1187,6 +1187,9 @@ class MeshPlaneRegistry:
         self.enabled = True
         self.min_shards = 2
         self.dp = 1
+        # multi-host fleet topology (search.mesh.hosts, parsed to a
+        # parallel.mesh.HostTopology); None = single-host
+        self.hosts = None
         # test/bench knob (not a cluster setting): bound the device
         # subset — max_devices=1 is the byte-identity baseline layout
         self.max_devices = 0
@@ -1215,13 +1218,25 @@ class MeshPlaneRegistry:
             return
         self._cfg_version = version
         from elasticsearch_tpu.utils.settings import (
-            SEARCH_MESH_DP, SEARCH_MESH_ENABLED, SEARCH_MESH_MIN_SHARDS,
-            setting_from_state,
+            SEARCH_MESH_DP, SEARCH_MESH_ENABLED, SEARCH_MESH_HOSTS,
+            SEARCH_MESH_MIN_SHARDS, setting_from_state,
         )
         self.enabled = setting_from_state(state, SEARCH_MESH_ENABLED)
         self.min_shards = setting_from_state(state,
                                              SEARCH_MESH_MIN_SHARDS)
         self.dp = setting_from_state(state, SEARCH_MESH_DP)
+        spec = setting_from_state(state, SEARCH_MESH_HOSTS)
+        try:
+            from elasticsearch_tpu.parallel.mesh import (
+                mesh_ready, parse_host_topology,
+            )
+            # never pay backend first-init here (the topology parse
+            # needs the device count): an uninitialized backend keeps
+            # the prior (None) topology until the mesh is warm
+            self.hosts = (parse_host_topology(spec)
+                          if spec and mesh_ready() else None)
+        except Exception:     # noqa: BLE001 — a bad spec disables the
+            self.hosts = None  # hosts layer, never serving
 
     def available(self, n_shards: int) -> bool:
         if not self.enabled or n_shards < max(1, self.min_shards):
@@ -1253,7 +1268,7 @@ class MeshPlaneRegistry:
     def _budget_token(self) -> Tuple:
         from elasticsearch_tpu.indices.breaker import BREAKERS
         return (int(BREAKERS.breaker("device").limit), self.dp,
-                self.max_devices)
+                self.max_devices, self.hosts)
 
     def _refuse(self, key: Tuple) -> None:
         self.stats["mesh_plane_miss_fallbacks"] += 1
@@ -1318,7 +1333,8 @@ class MeshPlaneRegistry:
                key: Tuple) -> Optional[MeshPlanePart]:
         from elasticsearch_tpu.parallel.mesh import mesh_layout
         mesh, n_slots, _spd = mesh_layout(
-            len(shard_segments), dp=self.dp, max_devices=self.max_devices)
+            len(shard_segments), dp=self.dp, max_devices=self.max_devices,
+            hosts=self.hosts)
         prev = self._find_prev(shard_segments, kind, field)
         subs: List[Optional[PlanePart]] = []
         hosts: List[Optional[Tuple]] = []
@@ -1527,6 +1543,11 @@ class MeshPlaneRegistry:
                "resident_bytes": by_kind,
                "resident_bytes_per_device": per_device,
                "dp": int(self.dp)}
+        if self.hosts is not None:
+            out["hosts"] = {"n_hosts": int(self.hosts.n_hosts),
+                            "devices_per_host":
+                                int(self.hosts.devices_per_host),
+                            "spec": self.hosts.spec}
         from elasticsearch_tpu.parallel.mesh import mesh_ready
         if mesh_ready():
             import jax

@@ -81,6 +81,22 @@ def _batch_scores(matrix, norms, queries, similarity: str) -> jnp.ndarray:
     return _coarse_similarity(dots, norms, queries, similarity)
 
 
+def knn_topk_body(matrix, norms, allowed, queries, masks, k: int,
+                  similarity: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """EXACT batched top-k over one vector plane: the ``_batch_scores``
+    matmul + eligibility mask + top_k, shared VERBATIM by the
+    single-shard batch kernels and the mesh slot kernel
+    (parallel/mesh.py ``mesh_knn_topk``) — the ``bm25_flat_body``
+    precedent, so mesh==fanout parity is structural. ``allowed`` [N] is
+    the per-plane eligibility row (live & exists, plus a shared filter
+    when every member carries the same one); ``masks`` [B, N] is the
+    per-query filter stack for heterogeneous filters, or None."""
+    scores = _batch_scores(matrix, norms, queries, similarity)
+    ok = allowed[None, :] if masks is None else (allowed[None, :] & masks)
+    ts, td = jax.lax.top_k(jnp.where(ok, scores, -jnp.inf), k)
+    return ts, td
+
+
 @profiled_jit("knn_topk_batch", static_argnames=("similarity", "k"))
 def knn_topk_batch(matrix, norms, exists, live, queries, k: int,
                    similarity: str = "cosine") -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -88,9 +104,8 @@ def knn_topk_batch(matrix, norms, exists, live, queries, k: int,
 
     One big [B, D] x [D, N] MXU matmul — the throughput shape for the
     SIFT1M-style benchmark."""
-    scores = _batch_scores(matrix, norms, queries, similarity)
-    scores = jnp.where((live & exists)[None, :], scores, -jnp.inf)
-    return jax.lax.top_k(scores, k)
+    return knn_topk_body(matrix, norms, live & exists, queries, None, k,
+                         similarity)
 
 
 @profiled_jit("knn_topk_batch_masked",
@@ -102,9 +117,8 @@ def knn_topk_batch_masked(matrix, norms, exists, live, queries, masks,
     same [B, D] x [D, N] matmul — the filtered-kNN serving shape
     (autocomplete / faceted nav), where Q concurrent queries each carry
     their own filter-context mask but share the corpus scan."""
-    scores = _batch_scores(matrix, norms, queries, similarity)
-    scores = jnp.where((live & exists)[None, :] & masks, scores, -jnp.inf)
-    return jax.lax.top_k(scores, k)
+    return knn_topk_body(matrix, norms, live & exists, queries, masks, k,
+                         similarity)
 
 
 def pad_queries_pow2(queries) -> Tuple[np.ndarray, int]:

@@ -84,16 +84,32 @@ def sparse_topk_batch(block_docs, block_weights,
     read off the score vector already computed here)."""
 
     def one(bi, qw):
-        s = sparse_scores(block_docs, block_weights, bi, qw, pivot,
-                          exponent, n_docs_pad, function)
-        matched = live & (s > 0.0)
-        s = jnp.where(matched, s, -jnp.inf)
-        ts, td = jax.lax.top_k(s, k)
+        ts, td, hits = sparse_topk_body(block_docs, block_weights, bi, qw,
+                                        pivot, exponent, live, n_docs_pad,
+                                        k, function)
         if counted:
-            return ts, td, jnp.sum(matched, dtype=jnp.int32)
+            return ts, td, hits
         return ts, td
 
     return jax.vmap(one)(block_idx, query_weight)
+
+
+def sparse_topk_body(block_docs, block_weights, block_idx, query_weight,
+                     pivot, exponent, live, n_docs_pad: int, k: int,
+                     function: str = "saturation"):
+    """Per-query EXACT top-k + live match count over one rank_features
+    plane — the traced body shared VERBATIM by ``sparse_topk_batch``
+    and the mesh slot kernel (parallel/mesh.py ``mesh_sparse_topk``),
+    the ``bm25_flat_body`` precedent: one trace means a mesh slot's row
+    cannot diverge from the single-shard dispatch. Returns
+    (scores [k], plane docs [k], hits) — callers that don't need counts
+    drop the third element (XLA dead-code-eliminates the sum)."""
+    s = sparse_scores(block_docs, block_weights, block_idx, query_weight,
+                      pivot, exponent, n_docs_pad, function)
+    matched = live & (s > 0.0)
+    s = jnp.where(matched, s, -jnp.inf)
+    ts, td = jax.lax.top_k(s, k)
+    return ts, td, jnp.sum(matched, dtype=jnp.int32)
 
 
 def sparse_coarse_body(block_docs, block_weights_q, block_idx,

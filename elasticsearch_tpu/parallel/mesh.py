@@ -74,6 +74,118 @@ except ImportError:   # pre-0.5 jax: experimental module, check_rep kwarg
 _MESH_CACHE: Dict[Tuple, Mesh] = {}
 
 
+# ---------------------------------------------------------------------------
+# multi-host topology (search.mesh.hosts)
+# ---------------------------------------------------------------------------
+
+class HostTopology:
+    """N hosts x M devices per host — the ``num_nodes`` /
+    ``gpus_per_node`` shape multi-process SPMD deployments pin
+    explicitly. Hosts partition the device axis CONTIGUOUSLY (device d
+    lives on host d // devices_per_host), the standard process-major
+    device order of multi-process jax, so a ``(dp, shard)`` mesh over
+    the first ``dp*d_used`` devices spans hosts 0..ceil(dp*d_used/M)-1
+    and each plane slot has a well-defined serving host."""
+
+    __slots__ = ("n_hosts", "devices_per_host", "spec")
+
+    def __init__(self, n_hosts: int, devices_per_host: int,
+                 spec: str = ""):
+        self.n_hosts = int(n_hosts)
+        self.devices_per_host = int(devices_per_host)
+        self.spec = spec or f"{n_hosts}x{devices_per_host}"
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_hosts * self.devices_per_host
+
+    def host_of_device(self, device_index: int) -> int:
+        return min(device_index // self.devices_per_host,
+                   self.n_hosts - 1)
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, HostTopology)
+                and self.n_hosts == other.n_hosts
+                and self.devices_per_host == other.devices_per_host)
+
+    def __hash__(self) -> int:
+        return hash((self.n_hosts, self.devices_per_host))
+
+    def __repr__(self) -> str:
+        return (f"HostTopology({self.n_hosts}x{self.devices_per_host},"
+                f" spec={self.spec!r})")
+
+
+def parse_host_topology(spec: str, total: Optional[int] = None
+                        ) -> Optional[HostTopology]:
+    """``search.mesh.hosts`` -> HostTopology. "" = single-host (None);
+    "N" = N equal hosts over the visible devices; "NxM" = N hosts x M
+    devices per host. Raises ValueError when the spec asks for more
+    devices than the backend exposes — a misdeclared fleet must fail
+    loudly at configure time, not mis-shard at dispatch time."""
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    if total is None:
+        total = len(jax.devices())
+    if "x" in spec:
+        hosts_s, _, per_s = spec.partition("x")
+        n_hosts, per = int(hosts_s), int(per_s)
+    else:
+        n_hosts, per = int(spec), 0
+    if n_hosts < 1:
+        raise ValueError(
+            f"search.mesh.hosts [{spec}]: host count must be >= 1")
+    if per == 0:
+        if n_hosts > total:
+            raise ValueError(
+                f"search.mesh.hosts [{spec}]: {n_hosts} hosts over "
+                f"{total} visible devices")
+        per = total // n_hosts
+    if per < 1 or n_hosts * per > total:
+        raise ValueError(
+            f"search.mesh.hosts [{spec}]: {n_hosts}x{per} devices "
+            f"exceed the {total} visible")
+    return HostTopology(n_hosts, per, spec)
+
+
+def mesh_member_hosts(topo: HostTopology, dp: int, d_used: int
+                      ) -> Tuple[int, ...]:
+    """Hosts whose devices participate in a (dp, d_used) mesh — the
+    membership the executor's liveness checks (and the typed
+    ``mesh_host_lost`` fallback) are defined over."""
+    return tuple(sorted({topo.host_of_device(d)
+                         for d in range(dp * d_used)}))
+
+
+def slot_host(topo: HostTopology, slot: int, slots_per_device: int,
+              ) -> int:
+    """Primary host serving a plane slot: slots partition contiguously
+    over the shard-axis device columns, and dp row 0 of column j is
+    global device j."""
+    return topo.host_of_device(slot // max(1, slots_per_device))
+
+
+# The process's host-partition backend: maps cluster nodes onto virtual
+# (or real) mesh hosts and answers liveness. Duck-typed protocol —
+# ``topology`` (HostTopology), ``host_of_node(node_id)``,
+# ``host_alive(host)``, ``nodes_on_host(host)``, ``indices_of(node_id)``
+# (the member's IndicesService, for the single-process stand-in where
+# one process holds every host's devices), ``pressure_snapshot(node_id)``.
+# testing.VirtualHostBackend registers here; a real multi-process
+# runtime would install its own.
+_HOST_BACKEND = None
+
+
+def set_host_backend(backend) -> None:
+    global _HOST_BACKEND
+    _HOST_BACKEND = backend
+
+
+def host_backend():
+    return _HOST_BACKEND
+
+
 def mesh_ready() -> bool:
     """True when a jax backend is ALREADY initialized — mesh layout must
     observe devices, never pay (or hang on) first-init inside a search
@@ -89,10 +201,11 @@ def mesh_ready() -> bool:
         return True    # pre-guard behavior (devices() below inits)
 
 
-def mesh_layout(n_shards: int, dp: int = 1,
-                max_devices: int = 0) -> Tuple[Mesh, int, int]:
-    """(mesh, n_slots, slots_per_device) for ``n_shards`` co-located
-    shards over the local devices.
+def mesh_layout(n_shards: int, dp: int = 1, max_devices: int = 0,
+                hosts: Optional[HostTopology] = None
+                ) -> Tuple[Mesh, int, int]:
+    """(mesh, n_slots, slots_per_device) for ``n_shards`` mesh-served
+    shards over the fleet's devices.
 
     One shard = one slot of the stacked plane; slots map onto a
     ``(dp, shard)`` mesh over a device SUBSET sized to the shard count
@@ -100,9 +213,14 @@ def mesh_layout(n_shards: int, dp: int = 1,
     other planes), padding the slot count up to a multiple of the used
     devices when shards outnumber chips. ``max_devices`` (0 = all)
     bounds the subset — the single-device layout is the byte-identity
-    baseline the golden tests pin."""
+    baseline the golden tests pin. ``hosts`` (search.mesh.hosts) caps
+    the subset at the declared fleet and makes the device order
+    host-contiguous by construction, so growing the shard count walks
+    the program onto additional HOSTS, not just additional chips."""
     devices = jax.devices()
     total = len(devices)
+    if hosts is not None:
+        total = min(total, hosts.n_devices)
     if max_devices > 0:
         total = min(total, max_devices)
     dp = max(1, min(int(dp), total))
@@ -129,17 +247,22 @@ def mesh_bm25_flat(mesh: Mesh, n_docs_pad: int, n_q: int, k: int,
     """One SPMD program over the stacked postings planes.
 
     fn(block_docs [S,NB,B], block_tfs [S,NB,B], doc_lens [S,N],
-       flat_idx [S,FB], flat_w [S,FB], flat_q [S,FB], flat_avgdl [S,FB],
-       live [S,N], seg_ids [S,N])
-      -> (scores [S,n_q,k], plane docs [S,n_q,k], hits [S,n_q,n_segs])
+       flat_idx [S,DP,FB], flat_w [S,DP,FB], flat_q [S,DP,FB],
+       flat_avgdl [S,DP,FB], live [S,N], seg_ids [S,N])
+      -> (scores [S,DP,n_q,k], plane docs [S,DP,n_q,k],
+          hits [S,DP,n_q,n_segs])
 
-    Each slot runs exactly ops/bm25.py ``bm25_flat_body`` — the SAME
+    The flat gather stacks SPLIT over the dp axis: each dp row holds
+    its own ``n_q``-query slice of the fan-out's micro-batch (the
+    corpus stack stays replicated per row), so added dp rows buy query
+    throughput instead of re-scoring the identical stack. Each
+    (slot, row) runs exactly ops/bm25.py ``bm25_flat_body`` — the SAME
     traced function `_bm25_flat_kernel` / `_bm25_flat_kernel_seg` call
-    (same gather/scatter order, same f32 adds), so a slot's row is
+    (same gather/scatter order, same f32 adds), so every query's row is
     bit-compatible with that shard's single-plane dispatch BY
-    CONSTRUCTION. Per-segment hit counts serve BOTH totals contracts
-    host-side: summed for counts-then-skip, clipped per segment for
-    totals-disabled."""
+    CONSTRUCTION, at any dp. Per-segment hit counts serve BOTH totals
+    contracts host-side: summed for counts-then-skip, clipped per
+    segment for totals-disabled."""
     from elasticsearch_tpu.ops.bm25 import bm25_flat_body
     key = ("bm25", id(mesh), n_docs_pad, n_q, k, n_segs, k1, b)
     fn = _COMPILED.get(key)
@@ -147,22 +270,26 @@ def mesh_bm25_flat(mesh: Mesh, n_docs_pad: int, n_q: int, k: int,
         return fn
 
     def one_slot(bd, bt, dl, fi, fw, fq, fa, lv, si):
-        scores, matched = bm25_flat_body(bd, bt, fi, fw, fq, dl, fa, lv,
-                                         n_docs_pad, n_q, k1=k1, b=b)
+        # fi/fw/fq/fa: [1, FB] — this device's dp row of the stack
+        scores, matched = bm25_flat_body(bd, bt, fi[0], fw[0], fq[0],
+                                         dl, fa[0], lv, n_docs_pad,
+                                         n_q, k1=k1, b=b)
         s, d = jax.lax.top_k(scores, k)
         onehot = jax.nn.one_hot(si, n_segs, dtype=jnp.int32)
         hits = matched.astype(jnp.int32) @ onehot
-        return s, d, hits
+        return s[None], d[None], hits[None]
 
     def local(bd, bt, dl, fi, fw, fq, fa, lv, si):
         return jax.vmap(one_slot)(bd, bt, dl, fi, fw, fq, fa, lv, si)
 
     p3 = P("shard", None, None)
     p2 = P("shard", None)
+    pq = P("shard", "dp", None)
+    pout = P("shard", "dp", None, None)
     fn = profiled_callable("mesh_bm25_flat", shard_map(
         local, mesh=mesh,
-        in_specs=(p3, p3, p2, p2, p2, p2, p2, p2, p2),
-        out_specs=(p3, p3, p3), check_vma=False))
+        in_specs=(p3, p3, p2, pq, pq, pq, pq, p2, p2),
+        out_specs=(pout, pout, pout), check_vma=False))
     _COMPILED[key] = fn
     return fn
 
@@ -396,13 +523,18 @@ def mesh_knn_rerank(mesh: Mesh, k: int, similarity: str, masked: bool):
 def mesh_sparse_topk(mesh: Mesh, n_docs_pad: int, k: int):
     """One SPMD program over the stacked rank_features planes.
 
-    fn(block_docs [S,NB,B], block_weights [S,NB,B], idx [S,Q,QB],
-       qw [S,Q,QB], live [S,N])
-      -> (scores [S,Q,k], plane docs [S,Q,k], hits [S,Q])
+    fn(block_docs [S,NB,B], block_weights [S,NB,B], idx [S,DP,Q,QB],
+       qw [S,DP,Q,QB], live [S,N])
+      -> (scores [S,DP,Q,k], plane docs [S,DP,Q,k], hits [S,DP,Q])
 
-    Per (slot, query) the body is ops/sparse.py's linear scorer — same
-    gather, same scatter-add, exact whole-shard counts off the score
-    plane."""
+    The query stack SPLITS over the dp axis (each row scores its own
+    Q-query slice against its corpus replica). Per (slot, row, query)
+    the body is ops/sparse.py ``sparse_topk_body`` with linear scoring
+    — the SAME traced function ``sparse_topk_batch`` vmaps, so a mesh
+    row is bit-compatible with the single-shard batch dispatch by
+    construction: same gather, same scatter-add, exact whole-shard
+    counts off the score plane."""
+    from elasticsearch_tpu.ops.sparse import sparse_topk_body
     key = ("sparse", id(mesh), n_docs_pad, k)
     fn = _COMPILED.get(key)
     if fn is not None:
@@ -410,29 +542,21 @@ def mesh_sparse_topk(mesh: Mesh, n_docs_pad: int, k: int):
 
     def one_slot(bd, bw, bi, qw, lv):
         def one_q(bi_q, qw_q):
-            docs = bd[bi_q]
-            w = bw[bi_q]
-            valid = docs >= 0
-            safe = jnp.where(valid, docs, 0)
-            contrib = jnp.where(valid, qw_q[:, None] * w, 0.0)
-            scores = jnp.zeros((n_docs_pad,), jnp.float32)
-            scores = scores.at[safe.reshape(-1)].add(
-                contrib.reshape(-1), mode="drop")
-            matched = lv & (scores > 0.0)
-            s = jnp.where(matched, scores, -jnp.inf)
-            ts, td = jax.lax.top_k(s, k)
-            return ts, td, jnp.sum(matched, dtype=jnp.int32)
-        return jax.vmap(one_q)(bi, qw)
+            return sparse_topk_body(bd, bw, bi_q, qw_q, 1.0, 1.0, lv,
+                                    n_docs_pad, k, "linear")
+        ts, td, hits = jax.vmap(one_q)(bi[0], qw[0])
+        return ts[None], td[None], hits[None]
 
     def local(bd, bw, bi, qw, lv):
         return jax.vmap(one_slot)(bd, bw, bi, qw, lv)
 
     p3 = P("shard", None, None)
     p2 = P("shard", None)
+    pq = P("shard", "dp", None, None)
     fn = profiled_callable("mesh_sparse_topk", shard_map(
         local, mesh=mesh,
-        in_specs=(p3, p3, p3, p3, p2),
-        out_specs=(p3, p3, p2), check_vma=False))
+        in_specs=(p3, p3, pq, pq, p2),
+        out_specs=(pq, pq, P("shard", "dp", None)), check_vma=False))
     _COMPILED[key] = fn
     return fn
 
@@ -444,13 +568,14 @@ def mesh_knn_topk(mesh: Mesh, k: int, similarity: str, masked: bool):
     fn(matrix [S,N,D], norms [S,N], allowed [S,N], queries [Q,D]
        [, masks [S,Q,N]]) -> (scores [S,Q,k], plane docs [S,Q,k])
 
-    Scoring is ops/knn.py's `_batch_scores` arithmetic per slot (bf16
+    Scoring is ops/knn.py ``knn_topk_body`` per slot — the SAME traced
+    function `knn_topk_batch` / `knn_topk_batch_masked` call (bf16
     multiply, f32 accumulate, `_coarse_similarity` transform), so each
-    slot's row matches that shard's exact plane matmul. ``allowed``
-    already folds live & exists (& a shared filter mask when every batch
-    member carries the same filter); ``masks`` is the per-member stack
-    for heterogeneous filters."""
-    from elasticsearch_tpu.ops.knn import _coarse_similarity
+    slot's row matches that shard's exact plane matmul by construction.
+    ``allowed`` already folds live & exists (& a shared filter mask when
+    every batch member carries the same filter); ``masks`` is the
+    per-member stack for heterogeneous filters."""
+    from elasticsearch_tpu.ops.knn import knn_topk_body
     key = ("knn", id(mesh), k, similarity, masked)
     fn = _COMPILED.get(key)
     if fn is not None:
@@ -458,15 +583,7 @@ def mesh_knn_topk(mesh: Mesh, k: int, similarity: str, masked: bool):
 
     def local(m, nr, al, q, mk=None):
         def one_slot(m_s, nr_s, al_s, mk_s=None):
-            dots = jax.lax.dot_general(
-                q.astype(jnp.bfloat16), m_s.astype(jnp.bfloat16),
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)        # [Q, N]
-            scores = _coarse_similarity(dots, nr_s, q, similarity)
-            ok = al_s[None, :] if mk_s is None else (al_s[None, :] & mk_s)
-            scores = jnp.where(ok, scores, -jnp.inf)
-            ts, td = jax.lax.top_k(scores, k)
-            return ts, td
+            return knn_topk_body(m_s, nr_s, al_s, q, mk_s, k, similarity)
         if mk is not None:
             return jax.vmap(one_slot)(m, nr, al, mk)
         return jax.vmap(lambda a, c, d: one_slot(a, c, d))(m, nr, al)

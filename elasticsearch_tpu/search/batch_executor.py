@@ -303,9 +303,12 @@ def _classify(req: Dict[str, Any], mappers) -> BatchSpec:
 # ---------------------------------------------------------------------------
 
 def _build_ctxs(reader, mappers, doc_count: int,
-                dfs: Optional[Dict[str, Dict[str, int]]]):
+                dfs: Optional[Dict[str, Dict[str, int]]],
+                field_stats: Optional[Dict[str, Tuple[float, int]]] = None):
     """SegmentContexts over the reader snapshot, exactly as query_shard
-    builds them (point-in-time live masks, shard-level stat overrides)."""
+    builds them (point-in-time live masks, shard-level stat overrides).
+    ``field_stats`` carries coordinator DFS avgdl overrides
+    (field -> (sum_len, n)) for mesh-served dfs_query_then_fetch."""
     import jax.numpy as jnp
 
     from elasticsearch_tpu.index.segment import BLOCK, next_pow2
@@ -319,6 +322,7 @@ def _build_ctxs(reader, mappers, doc_count: int,
         ctxs.append(SegmentContext(seg, mappers, segment_idx=si,
                                    doc_count_override=doc_count,
                                    df_overrides=dfs,
+                                   field_stats_overrides=field_stats,
                                    live_override=jnp.asarray(snap),
                                    reader=reader))
     return ctxs

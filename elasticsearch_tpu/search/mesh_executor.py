@@ -25,6 +25,7 @@ correctness gate.
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 import uuid as uuid_mod
@@ -66,6 +67,12 @@ class _Member:
     deadline: Optional[float] = None
     enqueued_ns: int = dc_field(default_factory=time.monotonic_ns)
     trace: Any = None
+    # shard id -> node whose copy the mesh scores (multi-host meshes can
+    # serve shards held by OTHER nodes on live mesh-member hosts)
+    serving: Dict[int, str] = dc_field(default_factory=dict)
+    # coordinator dfs_query_then_fetch stats (doc_count_override /
+    # df_overrides / field_stats_overrides), applied to every shard ctx
+    dfs: Optional[Dict[str, Any]] = None
 
 
 class MeshSearchExecutor:
@@ -91,17 +98,92 @@ class MeshSearchExecutor:
             # query-stack row, rows fanned out per duplicate
             "memo_hits": 0,
         }
+        # per-HOST serving counters on multi-host meshes — host label ->
+        # {"shard_results", "host_losses"}; monitor.mesh_plane_stats
+        # surfaces them as "per_host" under _nodes/stats mesh_plane
+        self.per_host_stats: Dict[str, Dict[str, int]] = {}
 
     # -- intake ---------------------------------------------------------
 
     def _scheduler(self):
         return self.sts.ts.transport.scheduler
 
+    # -- multi-host topology --------------------------------------------
+
+    def _host_backend(self):
+        """The registered host backend, but only when a host topology is
+        actually configured (search.mesh.hosts) — a single-host mesh
+        never consults it, preserving the strict-local gate."""
+        from elasticsearch_tpu.ops.device_segment import MESH_PLANES
+        if MESH_PLANES.hosts is None:
+            return None
+        from elasticsearch_tpu.parallel.mesh import host_backend
+        return host_backend()
+
+    def _host_label(self, node_id: str) -> str:
+        backend = self._host_backend()
+        if backend is not None:
+            host = backend.host_of_node(node_id)
+            if host is not None:
+                return "host_%d" % host
+        return "host_0"
+
+    def _host_count(self, label: str, counter: str) -> None:
+        h = self.per_host_stats.setdefault(
+            label, {"shard_results": 0, "host_losses": 0})
+        h[counter] = h.get(counter, 0) + 1
+
+    def _indices_of(self, node_id: str):
+        """IndicesService holding ``node_id``'s shards, or None when the
+        node's host is gone. The virtual host backend reaches every
+        member host in-process — the stand-in for one multi-host SPMD
+        program whose every participant addresses its own shards."""
+        if node_id == self.sts.node_id:
+            return self.sts.indices
+        backend = self._host_backend()
+        return backend.indices_of(node_id) if backend is not None else None
+
+    def _serving_for(self, index: str, targets
+                     ) -> Optional[Dict[int, str]]:
+        """Map each target shard to the node whose copy the mesh will
+        score: the local ACTIVE copy when present, else an ACTIVE copy on
+        a live mesh-member host. None = some target has neither, the
+        fan-out is not mesh-servable. Membership in t["copies"] (the
+        routing table's active copies) is required either way — a
+        locally registered shard instance alone may be an initializing
+        replica mid peer-recovery, and scoring its half-copied engine
+        would return silently incomplete hits."""
+        serving: Dict[int, str] = {}
+        backend = self._host_backend()
+        for t in targets:
+            if t["index"] != index:
+                return None
+            if self.sts.node_id in t.get("copies", ()) and \
+                    self.sts.indices.has_shard(index, t["shard"]):
+                serving[t["shard"]] = self.sts.node_id
+                continue
+            found = None
+            if backend is not None:
+                for node in t.get("copies", ()):
+                    host = backend.host_of_node(node)
+                    if host is None or not backend.host_alive(host):
+                        continue
+                    svc = backend.indices_of(node)
+                    if svc is not None and \
+                            svc.has_shard(index, t["shard"]):
+                        found = node
+                        break
+            if found is None:
+                return None
+            serving[t["shard"]] = found
+        return serving
+
     def try_submit(self, index: str, targets: List[Dict[str, Any]],
                    body: Dict[str, Any], window: int, task,
                    on_results: Callable[[Optional[List[Dict[str, Any]]]],
                                         None],
-                   deadline: Optional[float] = None) -> bool:
+                   deadline: Optional[float] = None,
+                   dfs_overrides: Optional[Dict[str, Any]] = None) -> bool:
         """True = queued for a mesh drain (``on_results`` fires with the
         per-shard query results in target order, or None = run the RPC
         fan-out). False = not mesh-eligible; caller proceeds normally.
@@ -112,7 +194,12 @@ class MeshSearchExecutor:
         budget. The drain checks it at entry and between mesh dispatches
         (the shard-side between-segments discipline); an expired fan-out
         hands back to the RPC path, whose budget machinery produces the
-        timed-out partial response."""
+        timed-out partial response.
+
+        ``dfs_overrides``: coordinator dfs_query_then_fetch global term
+        statistics; when present the drain skips local term-stats and
+        builds every shard context from the overrides, so a DFS-normed
+        fan-out costs the same 2-3 mesh dispatches as a plain one."""
         try:
             from elasticsearch_tpu.ops.device_segment import MESH_PLANES
             from elasticsearch_tpu.utils.settings import setting_from_state
@@ -142,19 +229,15 @@ class MeshSearchExecutor:
                     # per-search device residency: RPC path
                     TELEMETRY.count_fallback(telemetry.MESH_FROZEN_INDEX)
                     return False
-            # co-location: every target shard must have an ACTIVE local
-            # copy. Membership in t["copies"] (the routing table's active
-            # copies) is required — a locally registered shard instance
-            # alone may be an initializing replica mid peer-recovery, and
-            # scoring its half-copied engine would return silently
-            # incomplete hits while the RPC path queries a complete copy.
-            for t in targets:
-                if t["index"] != index or \
-                        self.sts.node_id not in t.get("copies", ()) or \
-                        not self.sts.indices.has_shard(index, t["shard"]):
-                    TELEMETRY.count_fallback(telemetry.MESH_NOT_COLOCATED)
-                    return False
-            shard0 = self.sts.indices.shard(index, targets[0]["shard"])
+            # co-location, fleet edition: every target shard must have
+            # an ACTIVE copy on this node or on a live mesh-member host
+            # (strictly local when no search.mesh.hosts topology is set)
+            serving = self._serving_for(index, targets)
+            if serving is None:
+                TELEMETRY.count_fallback(telemetry.MESH_NOT_COLOCATED)
+                return False
+            svc0 = self._indices_of(serving[targets[0]["shard"]])
+            shard0 = svc0.shard(index, targets[0]["shard"])
             spec = classify_request(
                 {"index": index, "shard": targets[0]["shard"],
                  "body": body, "window": window},
@@ -168,14 +251,22 @@ class MeshSearchExecutor:
             # the shard batcher's dense kind through the RPC fan-out
             TELEMETRY.count_fallback(telemetry.MESH_INELIGIBLE_QUERY)
             return False
+        if dfs_overrides is not None and spec.kind != "text":
+            # coordinator df/avgdl normalization only shapes text
+            # scoring; other kinds take the per-shard path unchanged
+            TELEMETRY.count_fallback(telemetry.MESH_DFS_OVERRIDE)
+            return False
         shard_ids = sorted(t["shard"] for t in targets)
         member = _Member(spec=spec, body=body, window=window,
                          shard_ids=shard_ids, task=task,
-                         on_results=on_results, deadline=deadline)
+                         on_results=on_results, deadline=deadline,
+                         serving=serving, dfs=dfs_overrides)
         member.trace = SearchTrace(
             _CLASS_OF_KIND.get(spec.kind, "other"), "mesh")
         member.trace.t0_ns = member.enqueued_ns
-        key = (index, tuple(shard_ids)) + spec.key()
+        dfs_token = None if dfs_overrides is None else \
+            json.dumps(dfs_overrides, sort_keys=True, default=list)
+        key = (index, tuple(shard_ids), dfs_token) + spec.key()
         self._queues.setdefault(key, []).append(member)
         if key not in self._scheduled:
             # same-tick coalescing (the RRF fusion batcher's discipline):
@@ -286,6 +377,10 @@ class MeshSearchExecutor:
         # drain goes back to the RPC path, whose budget timer produces
         # the timed-out partial response
         scheduler = self._scheduler()
+        serving = members[0].serving
+        remote = sorted({n for n in serving.values()
+                         if n != self.sts.node_id})
+        backend = self._host_backend()
 
         def check_members() -> None:
             now = scheduler.now()
@@ -295,9 +390,34 @@ class MeshSearchExecutor:
                     raise _MeshMiss(telemetry.MESH_MEMBER_CANCELLED)
                 if m.deadline is not None and now >= m.deadline:
                     raise _MeshMiss(telemetry.MESH_DEADLINE_EXPIRED)
+            # a mesh-member host dropping mid-query abandons the mesh
+            # program with a TYPED reason; the RPC fan-out's reroute
+            # contract (any replica, automatic failover) then serves the
+            # query off a surviving copy
+            for node in remote:
+                host = backend.host_of_node(node) \
+                    if backend is not None else None
+                if host is None or not backend.host_alive(host):
+                    self._host_count(
+                        "host_%d" % host if host is not None
+                        else "host_unmapped", "host_losses")
+                    raise _MeshMiss(telemetry.MESH_HOST_LOST)
 
-        shards = [self.sts.indices.shard(index, sid) for sid in shard_ids]
-        readers = [sh.engine.acquire_reader() for sh in shards]
+        shards, readers = [], []
+        for sid in shard_ids:
+            node = serving.get(sid, self.sts.node_id)
+            try:
+                svc = self._indices_of(node)
+                sh = svc.shard(index, sid)
+                readers.append(sh.engine.acquire_reader())
+                shards.append(sh)
+            except Exception:
+                if node != self.sts.node_id:
+                    # serving host vanished between submit and drain
+                    self._host_count(self._host_label(node),
+                                     "host_losses")
+                    raise _MeshMiss(telemetry.MESH_HOST_LOST)
+                raise
         shard_segments = [((index, sid), list(r.segments))
                           for sid, r in zip(shard_ids, readers)]
         mpart = MESH_PLANES.get(shard_segments,
@@ -327,9 +447,19 @@ class MeshSearchExecutor:
 
         # per-shard contexts + (text) term stats, exactly as query_shard
         # / the shard batcher build them — one reader snapshot per shard
-        # per drain, so results cannot cross a refresh
+        # per drain, so results cannot cross a refresh. DFS-normed
+        # drains skip local term stats entirely: every shard context
+        # carries the coordinator's global doc_count/df/avgdl, the same
+        # overrides the per-shard RPC query phase would apply.
+        dfs_over = members[0].dfs
         shard_ctxs = []
         for r in readers:
+            if dfs_over is not None:
+                shard_ctxs.append(_build_ctxs(
+                    r, mappers, dfs_over.get("doc_count_override"),
+                    dfs_over.get("df_overrides"),
+                    field_stats=dfs_over.get("field_stats_overrides")))
+                continue
             doc_count = sum(seg.n_docs for seg in r.segments)
             dfs: Dict[str, Dict[str, int]] = {}
             if spec0.kind == "text":
@@ -412,11 +542,15 @@ class MeshSearchExecutor:
                     stats["wand_queries"] += 1
                     stats["wand_blocks_total"] += prune[0]
                     stats["wand_blocks_scored"] += prune[1]
+                served_by = serving.get(sid, self.sts.node_id)
                 context_id = uuid_mod.uuid4().hex
                 self.sts._contexts[context_id] = (
                     readers[pos], now + CONTEXT_KEEP_ALIVE)
                 member_results.append({
                     "context_id": context_id,
+                    # node whose copy the mesh scored — the coordinator
+                    # attributes its ARS observation per serving HOST
+                    "served_by": served_by,
                     "total": total,
                     "relation": relation,
                     "max_score": max_score,
@@ -435,5 +569,7 @@ class MeshSearchExecutor:
                     {"index": index, "shard": sid, "body": m.body},
                     time.monotonic() - m.enqueued_wall)
                 self.stats["mesh_shard_results"] += 1
+                self._host_count(self._host_label(served_by),
+                                 "shard_results")
             out.append(member_results)
         return out

@@ -979,8 +979,14 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
     per-shard results is byte-compatible with the RPC fan-out.
 
     Returns [shard][member] (candidates, hits, relation, max_score,
-    (blocks_total, blocks_scored)), or None when the request must take
-    the per-shard path (DFS overrides)."""
+    (blocks_total, blocks_scored)).
+
+    DFS-normed fan-outs (coordinator df/avgdl overrides) are served on
+    the mesh too, the plane_wand_topk discipline: df overrides flow
+    through each segment's planner, the corpus-wide avgdl replaces the
+    baked per-block values in the flat dispatch argument — so a DFS
+    query costs the same 2-3 mesh dispatches as a plain one instead of
+    a per-shard RPC fan-out."""
     from elasticsearch_tpu.ops.bm25 import flatten_plans, qb_bucket
     from elasticsearch_tpu.parallel.mesh import mesh_bm25_flat
     from elasticsearch_tpu.search.execute import _bm25_planner
@@ -988,10 +994,17 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
     counts_on = track_limit > 0
     n_q = len(clause_lists)
     n_sh = mpart.n_shards
+    # the flat gather stacks split over dp (each row scores its own
+    # contiguous slice of the micro-batch), so the padded count must
+    # fill the rows evenly — the kNN query-stack rule
+    dp = max(1, int(mpart.mesh.shape["dp"]))
     n_q_pad = next_pow2(max(n_q, 1), minimum=1)
+    n_q_pad = -(-n_q_pad // dp) * dp
+    n_q_row = n_q_pad // dp
     empty = ([], 0, "eq", None, (0, 0))
     empty_plan = QueryPlan([], [], [], [])
 
+    avgdl_override = None
     prepped: List[Optional[Dict]] = []
     for si in range(n_sh):
         sub = mpart.subs[si]
@@ -1004,8 +1017,12 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
         has = [False] * n_q
         for pos, _pf, block_base, avgdl in sub.refs:
             ctx = ctxs[pos]
-            if ctx.avgdl_for(field) is not None:
-                return None     # DFS fan-outs keep the RPC path
+            override = ctx.avgdl_for(field)
+            if override is not None:
+                # DFS-normed: one corpus-wide value for every segment
+                # of every member shard (it is per-request per-field)
+                avgdl_override = float(override)
+                avgdl = avgdl_override
             analyzer = ctx.search_analyzer(field)
             ex = _bm25_planner(ctx, field)
             if ex is None:
@@ -1051,20 +1068,28 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
     def _dispatch(rows_by_shard, k):
         if check_members is not None:
             check_members()
+        # one flat-bucket for every (slot, dp row) group: per-row flats
+        # keep each row's qids local (0..n_q_row-1), so the kernel's
+        # scatter per row is exactly the single-shard flat kernel's
         fb = qb_bucket(max(
-            [sum(p.n_blocks for p in rows)
-             for rows in rows_by_shard if rows] + [1]))
-        idx = np.zeros((mpart.n_slots, fb), np.int32)
-        w = np.zeros((mpart.n_slots, fb), np.float32)
-        qid = np.zeros((mpart.n_slots, fb), np.int32)
-        favg = np.ones((mpart.n_slots, fb), np.float32)
+            [sum(p.n_blocks
+                 for p in rows[r * n_q_row: (r + 1) * n_q_row])
+             for rows in rows_by_shard if rows for r in range(dp)]
+            + [1]))
+        idx = np.zeros((mpart.n_slots, dp, fb), np.int32)
+        w = np.zeros((mpart.n_slots, dp, fb), np.float32)
+        qid = np.zeros((mpart.n_slots, dp, fb), np.int32)
+        favg = np.ones((mpart.n_slots, dp, fb), np.float32)
         for si, rows in enumerate(rows_by_shard):
             if not rows:
                 continue
-            i_s, w_s, q_s = flatten_plans(rows, fb)
-            idx[si], w[si], qid[si] = i_s, w_s, q_s
-            favg[si] = mpart.subs[si].block_avgdl[i_s]
-        fn = mesh_bm25_flat(mpart.mesh, mpart.n_docs_pad, n_q_pad, k,
+            for r in range(dp):
+                i_s, w_s, q_s = flatten_plans(
+                    rows[r * n_q_row: (r + 1) * n_q_row], fb)
+                idx[si, r], w[si, r], qid[si, r] = i_s, w_s, q_s
+                favg[si, r] = avgdl_override if avgdl_override \
+                    is not None else mpart.subs[si].block_avgdl[i_s]
+        fn = mesh_bm25_flat(mpart.mesh, mpart.n_docs_pad, n_q_row, k,
                             mpart.n_segs_max, DEFAULT_K1, DEFAULT_B)
         from elasticsearch_tpu.indices.breaker import BREAKERS
         transient = 8 * mpart.n_docs_pad * n_q_pad * mpart.n_slots
@@ -1078,7 +1103,12 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
                          jnp.asarray(w), jnp.asarray(qid),
                          jnp.asarray(favg), jnp.asarray(live_host),
                          mpart.seg_ids)
-        return np.asarray(s), np.asarray(d), np.asarray(h)
+        # [S, dp, n_q_row, ...] -> [S, n_q_pad, ...]: contiguous row
+        # assignment makes the flatten restore micro-batch order
+        s = np.asarray(s).reshape(mpart.n_slots, n_q_pad, -1)
+        d = np.asarray(d).reshape(mpart.n_slots, n_q_pad, -1)
+        h = np.asarray(h).reshape(mpart.n_slots, n_q_pad, -1)
+        return s, d, h
 
     def _rows(select):
         """[slot][n_q_pad] plan rows; ``select(si, qi, plans)`` -> plan
@@ -1133,7 +1163,8 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
                 continue
             i_s, w_s, q_s = flatten_plans(rows, fb)
             idx[si], w[si], qid[si] = i_s, w_s, q_s
-            favg[si] = mpart.subs[si].block_avgdl[i_s]
+            favg[si] = avgdl_override if avgdl_override is not None \
+                else mpart.subs[si].block_avgdl[i_s]
         idx_dev, w_dev = jnp.asarray(idx), jnp.asarray(w)
         qid_dev, favg_dev = jnp.asarray(qid), jnp.asarray(favg)
         live_dev = jnp.asarray(live_host)
@@ -1231,9 +1262,13 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
         return out
 
     if PLANES.quantized:
-        got_coarse = _try_coarse()
+        # the measured-latency engage rule, per MESH class: the mesh
+        # coarse tier pays 2 dispatches over n_slots stacks, so it gets
+        # its own EWMAs rather than inheriting the single-shard ones
+        got_coarse = _coarse_attempt("mesh_bm25", n_q, _try_coarse)
         if got_coarse is not None:
             return got_coarse
+    t_exact = time.monotonic()
 
     # phase A — one mesh dispatch: exact-mode (shard, member) pairs score
     # all their blocks (their counts are final), pruned pairs their
@@ -1343,6 +1378,7 @@ def mesh_wand_topk(shard_ctxs, mpart, field: str,
             else:
                 out[si][qi] = (cands, exact_hits, "eq", max_score,
                                prune)
+    _note_exact("mesh_bm25", n_q, t_exact)
     return out
 
 
@@ -1501,10 +1537,11 @@ def mesh_knn_winners(shard_ctxs, mpart, field: str, specs, k: int,
                 "fan-out serves each shard its own tier",
                 reason=telemetry.MESH_QUANTIZED_FALLBACK)
         if engages:
-            got_q = _try_quantized()
+            got_q = _coarse_attempt("mesh_knn", n_q, _try_quantized)
     if got_q is not None:
         s, d = got_q
     else:
+        t_exact = time.monotonic()
         fn = mesh_knn_topk(mpart.mesh, k_mesh, mpart.similarity,
                            masked=masks_host is not None)
         with BREAKERS.breaker("request").limit_scope(transient,
@@ -1518,6 +1555,7 @@ def mesh_knn_winners(shard_ctxs, mpart, field: str, specs, k: int,
             else:
                 s, d = fn(mpart.matrix, mpart.norms, allowed, q_dev)
         s, d = np.asarray(s), np.asarray(d)
+        _note_exact("mesh_knn", n_q, t_exact)
 
     winners: List[List[List[Tuple[int, int, float]]]] = []
     for si in range(n_sh):
@@ -1549,7 +1587,12 @@ def mesh_sparse_topk(shard_ctxs, mpart, field: str,
     )
     n_q = len(expansions)
     n_sh = mpart.n_shards
+    # the exact kernel splits the query stack over dp rows (contiguous
+    # slices), so the padded count must fill the rows evenly
+    dp = max(1, int(mpart.mesh.shape["dp"]))
     n_q_pad = next_pow2(max(n_q, 1), minimum=1)
+    n_q_pad = -(-n_q_pad // dp) * dp
+    n_q_row = n_q_pad // dp
 
     per_shard: List[Optional[List[Tuple[np.ndarray, np.ndarray]]]] = []
     qb_max = 1
@@ -1644,19 +1687,30 @@ def mesh_sparse_topk(shard_ctxs, mpart, field: str,
                 _count_mesh_quantized_fallback()
                 return None
 
-    got_q = _try_quantized() if PLANES.quantized else None
+    got_q = _coarse_attempt("mesh_sparse", n_q, _try_quantized) \
+        if PLANES.quantized else None
     if got_q is not None:
         s, d, h = got_q
     else:
+        t_exact = time.monotonic()
         fn = _mesh_sparse_kernel(mpart.mesh, mpart.n_docs_pad, k_mesh)
         with BREAKERS.breaker("request").limit_scope(
                 transient, "mesh_sparse"):
             if counter is not None:
                 counter.append(1)
             telemetry.record_dispatch()
+            # the dp-split exact kernel: [S, dp, n_q_row, QB] rows in,
+            # [S, dp, n_q_row, ...] out, restitched to batch order
             s, d, h = fn(mpart.block_docs, mpart.block_weights,
-                         idx_dev, w_dev, live_dev)
-        s, d, h = np.asarray(s), np.asarray(d), np.asarray(h)
+                         jnp.asarray(idx.reshape(
+                             mpart.n_slots, dp, n_q_row, -1)),
+                         jnp.asarray(w.reshape(
+                             mpart.n_slots, dp, n_q_row, -1)),
+                         live_dev)
+        s = np.asarray(s).reshape(mpart.n_slots, n_q_pad, -1)
+        d = np.asarray(d).reshape(mpart.n_slots, n_q_pad, -1)
+        h = np.asarray(h).reshape(mpart.n_slots, n_q_pad)
+        _note_exact("mesh_sparse", n_q, t_exact)
 
     out: List[List[Tuple]] = []
     for si in range(n_sh):

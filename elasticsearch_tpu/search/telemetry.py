@@ -61,6 +61,7 @@ MESH_BACKEND_NOT_READY = "mesh_backend_not_ready"
 MESH_TOO_FEW_SHARDS = "mesh_too_few_shards"
 MESH_FROZEN_INDEX = "mesh_frozen_index"
 MESH_NOT_COLOCATED = "mesh_not_colocated"
+MESH_HOST_LOST = "mesh_host_lost"
 MESH_INELIGIBLE_QUERY = "mesh_ineligible_query"
 MESH_ELIGIBILITY_ERROR = "mesh_eligibility_error"
 MESH_PLANE_MISSING = "mesh_plane_missing"

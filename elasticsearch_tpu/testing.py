@@ -138,10 +138,57 @@ class FaultyDiskIO(DiskIO):
         return drop_bytes
 
 
+class VirtualHostBackend:
+    """Host-partitioned stand-in for real multi-process JAX: the test
+    process's devices split into virtual HOSTS (parallel/mesh.py
+    ``HostTopology``), cluster nodes map onto those hosts round-robin,
+    and the mesh executor reaches a member host's shards through this
+    backend exactly where a real multi-host SPMD program's participant
+    would address its own. Liveness is derived, not declared: a node is
+    alive while it is in ``cluster.nodes`` and on the wire, and a
+    virtual host is alive while EVERY node mapped to it is — so
+    ``crash_node``/``kill_node`` take the victim's host down and
+    ``restart_node``/``reboot_node`` bring it back, with no extra
+    bookkeeping for tests to forget."""
+
+    def __init__(self, cluster: "InProcessCluster", topology):
+        self.cluster = cluster
+        self.topology = topology
+        self._hosts: Dict[str, int] = {
+            nid: i % topology.n_hosts
+            for i, nid in enumerate(cluster._node_ids)}
+
+    def _node_alive(self, node_id: str) -> bool:
+        return node_id in self.cluster.nodes and \
+            node_id not in self.cluster.transport._crashed
+
+    def host_of_node(self, node_id: str) -> Optional[int]:
+        return self._hosts.get(node_id)
+
+    def host_alive(self, host: int) -> bool:
+        nodes = [nid for nid, h in self._hosts.items() if h == host]
+        return bool(nodes) and all(self._node_alive(n) for n in nodes)
+
+    def nodes_on_host(self, host: int) -> List[str]:
+        return [nid for nid, h in self._hosts.items() if h == host]
+
+    def indices_of(self, node_id: str):
+        if not self._node_alive(node_id):
+            return None
+        return self.cluster.nodes[node_id].indices_service
+
+    def pressure_snapshot(self, node_id: str):
+        if not self._node_alive(node_id):
+            return None
+        batcher = self.cluster.nodes[node_id].search_transport.batcher
+        return batcher.node_pressure.snapshot(batcher.queue_depth())
+
+
 class InProcessCluster:
     def __init__(self, n_nodes: int = 3, seed: int = 0,
                  data_path: Optional[str] = None,
-                 mesh_data_plane: bool = False):
+                 mesh_data_plane: bool = False,
+                 mesh_hosts: Optional[str] = None):
         self.scheduler = DeterministicScheduler(seed=seed)
         self.transport = InMemoryTransport(self.scheduler)
         self.data_path = data_path
@@ -165,6 +212,18 @@ class InProcessCluster:
         self.nodes: Dict[str, Node] = {}
         for nid in node_ids:
             self.nodes[nid] = self._build_node(nid)
+        # virtual multi-host mesh: partition this process's devices into
+        # ``mesh_hosts`` hosts ("N" or "NxM") and register the backend
+        # the mesh executor routes cross-host fan-outs through
+        self.host_backend: Optional[VirtualHostBackend] = None
+        if mesh_hosts:
+            from elasticsearch_tpu.parallel.mesh import (
+                parse_host_topology, set_host_backend,
+            )
+            topo = parse_host_topology(mesh_hosts)
+            if topo is not None:
+                self.host_backend = VirtualHostBackend(self, topo)
+                set_host_backend(self.host_backend)
 
     def _build_node(self, nid: str) -> Node:
         return Node(
@@ -193,6 +252,13 @@ class InProcessCluster:
     def stop(self) -> None:
         for node in self.nodes.values():
             node.stop()
+        if self.host_backend is not None:
+            from elasticsearch_tpu.parallel.mesh import (
+                host_backend, set_host_backend,
+            )
+            if host_backend() is self.host_backend:
+                set_host_backend(None)
+            self.host_backend = None
 
     def master(self) -> Optional[Node]:
         leaders = [n for n in self.nodes.values()

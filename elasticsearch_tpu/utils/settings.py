@@ -401,6 +401,17 @@ SEARCH_MESH_DP: Setting[int] = Setting.int_setting(
     "search.mesh.dp", 1, min_value=1, max_value=64,
     scope=Scope.CLUSTER, properties=Property.DYNAMIC)
 
+# multi-host mesh topology: "" = single-host (all local devices, the
+# pre-fleet behaviour), "N" = N equal hosts over the visible devices,
+# "NxM" = N hosts x M devices per host (the num_nodes/gpus_per_node
+# shape real multi-process deployments pin explicitly). Hosts partition
+# the device axis contiguously; fan-outs whose target shards all have
+# an active copy on a mesh-member host run as ONE program spanning the
+# hosts instead of per-shard RPCs
+SEARCH_MESH_HOSTS: Setting[str] = Setting.str_setting(
+    "search.mesh.hosts", "",
+    scope=Scope.CLUSTER, properties=Property.DYNAMIC)
+
 # pre-init the device backend when a node boots (the legacy mesh
 # plane's boot-time warmup): mesh_ready() refuses to pay first-init
 # inside a search, so without this the FIRST mesh-eligible search per

@@ -46,6 +46,7 @@ def _mesh_defaults():
     MESH_PLANES.min_shards = 2
     MESH_PLANES.dp = 1
     MESH_PLANES.max_devices = 0
+    MESH_PLANES.hosts = None
     PLANES.enabled = True
     PLANES.min_segments = 2
     yield
@@ -55,6 +56,7 @@ def _mesh_defaults():
     MESH_PLANES.min_shards = 2
     MESH_PLANES.dp = 1
     MESH_PLANES.max_devices = 0
+    MESH_PLANES.hosts = None
     PLANES.enabled = True
 
 
@@ -242,6 +244,60 @@ def test_dp_axis_golden_parity():
         ref = batched_wand_topk_shard(_ctxs(r, mappers, q), "body",
                                       [[("w1 w3", 1.0)]], 10, 10_000)
         _assert_rows_same(got[si][0], ref[0])
+
+
+def test_dp_axis_query_split_text_sparse_parity():
+    """search.mesh.dp > 1 splits the TEXT and SPARSE flat query stacks
+    over the dp axis too (each row scores its own contiguous slice of
+    the micro-batch, the kNN rule) — including a query count that pads
+    unevenly into the rows. Results identical to the per-shard path."""
+    MESH_PLANES.dp = 2
+    engines, readers, shard_segments = _shards(83)
+    mappers = engines[0].mappers
+
+    q = dsl.parse_query({"match": {"body": "w1 w3 w7 w2 w9 w5"}})
+    clauses = [[("w1 w3 w7", 1.0)], [("w2 w9", 1.0)], [("w5", 1.0)]]
+    text_ctxs = [_ctxs(r, mappers, q) for r in readers]
+    mp = MESH_PLANES.get(shard_segments, "postings", "body")
+    assert mp is not None and int(mp.mesh.shape["dp"]) == 2
+    for track in (10_000, 0):
+        got = mesh_wand_topk(text_ctxs, mp, "body", clauses, 10, track)
+        for si, r in enumerate(readers):
+            ref = batched_wand_topk_shard(
+                _ctxs(r, mappers, q), "body", clauses, 10, track)
+            for qi in range(len(clauses)):
+                _assert_rows_same(got[si][qi], ref[qi])
+
+    tok_sets = [{"f1": 1.2, "f4": 0.7}, {"f2": 0.9, "f9": 0.4},
+                {"f5": 1.0}]
+    specs = [BatchSpec(kind="sparse", field="feats", window=10,
+                       tokens=t, boost=1.0) for t in tok_sets]
+    expansions = [[(t, w) for t, w in toks.items()] for toks in tok_sets]
+    shard_ctxs = [_ctxs(r, mappers) for r in readers]
+    mf = MESH_PLANES.get(shard_segments, "features", "feats")
+    assert mf is not None and int(mf.mesh.shape["dp"]) == 2
+    raw = mesh_sparse_topk(shard_ctxs, mf, "feats", expansions, 10)
+    for si, r in enumerate(readers):
+        ref = batched_sparse_shard(_ctxs(r, mappers), "feats", specs, 10)
+        for qi in range(len(specs)):
+            cands, total, _mx = raw[si][qi]
+            assert [(c.segment_idx, c.doc) for c in cands] == \
+                [(c.segment_idx, c.doc) for c in ref[qi][0]]
+            assert total == ref[qi][1]
+
+
+def test_host_capped_layout_golden_parity():
+    """A declared host topology caps the mesh at the fleet's devices and
+    makes the device order host-contiguous — a 2x2 virtual fleet (4 of
+    the 8 test devices) must stay result-identical for every class."""
+    from elasticsearch_tpu.parallel.mesh import (
+        mesh_layout, parse_host_topology,
+    )
+    topo = parse_host_topology("2x2")
+    MESH_PLANES.hosts = topo
+    _golden_all_classes(53)
+    mesh, _n_slots, _ = mesh_layout(3, dp=1, hosts=topo)
+    assert int(mesh.shape["shard"]) <= topo.n_devices
 
 
 def test_mesh_ivf_shard_falls_back():
@@ -607,5 +663,203 @@ def test_cat_health_routes_through_master(monkeypatch):
         # _cat/indices resolves every index's status in ONE bulk master
         # request, not one chained RPC per index
         assert routed["bulk"] == 1
+    finally:
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-host mesh: host-partitioned virtual fleet through the node layer
+# ---------------------------------------------------------------------------
+
+def _multihost_cluster(seed: int, n_nodes: int = 2, hosts_spec: str = "2",
+                       replicas: int = 0):
+    """`_e2e_cluster` grown to a virtual fleet: ``n_nodes`` cluster nodes
+    partitioned onto ``hosts_spec`` virtual hosts (testing.py
+    VirtualHostBackend), shards spread across them. The topology is
+    DECLARED (cluster setting) only after the priming RPC search — the
+    mesh never pays backend first-init, so ``search.mesh.hosts`` parses
+    against an already-initialized device layer."""
+    from elasticsearch_tpu.testing import InProcessCluster
+    cluster = InProcessCluster(n_nodes=n_nodes, seed=seed,
+                               mesh_hosts=hosts_spec)
+    cluster.start()
+    client = cluster.client("node0")
+    cluster.call(lambda cb: client.create_index(
+        "m", {"settings": {"number_of_shards": 3,
+                           "number_of_replicas": replicas},
+              "mappings": {"properties": {
+                  "body": {"type": "text"},
+                  "vec": {"type": "dense_vector", "dims": 8,
+                          "similarity": "cosine"},
+                  "feats": {"type": "rank_features"},
+                  "tag": {"type": "keyword"}}}}, cb))
+    cluster.ensure_green("m")
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(30)]
+    for d in range(120):
+        cluster.call(lambda cb, d=d: client.index_doc(
+            "m", f"d{d}", {
+                "body": " ".join(rng.choice(
+                    vocab, size=int(rng.integers(4, 12)))),
+                "vec": [float(x) for x in rng.standard_normal(8)],
+                "feats": {f"f{j}": float(rng.random() + 0.1)
+                          for j in rng.integers(0, 12, 3)},
+                "tag": f"t{d % 3}"}, cb))
+    for d in range(0, 120, 17):
+        cluster.call(lambda cb, d=d: client.delete_doc("m", f"d{d}", cb))
+    cluster.call(lambda cb: client.refresh("m", cb))
+    cluster.call(lambda cb: client.search(
+        "m", {"query": {"match": {"body": "w0"}}, "size": 1}, cb))
+    cluster.call(lambda cb: client.cluster_update_settings(
+        {"persistent": {"search.mesh.hosts": hosts_spec}}, cb))
+    return cluster, client, rng
+
+
+@pytest.mark.parametrize("seed", [5 + 389 * k for k in range(CHAOS_SEEDS)])
+def test_e2e_multihost_mesh_vs_fanout_byte_parity(seed):
+    """Targets spanning mesh-member HOSTS serve through ONE mesh program
+    per phase with responses byte-identical to the cross-node RPC
+    fan-out — deletes, filtered kNN, and every track_total_hits mode
+    included — and the per-host serving counters show work landing on
+    BOTH virtual hosts. Zero untyped fallbacks throughout."""
+    from elasticsearch_tpu.search.telemetry import TELEMETRY
+    cluster, client, rng = _multihost_cluster(seed)
+    try:
+        before_unknown = TELEMETRY.fallbacks.get("unknown", 0)
+        bodies = _e2e_bodies(rng)
+        mesh_resps = []
+        for body in bodies:
+            resp, err = cluster.call(
+                lambda cb, b=body: client.search("m", copy.deepcopy(b),
+                                                 cb))
+            assert err is None, (body, err)
+            assert resp.get("_data_plane") == "mesh_plane", \
+                (body, resp.get("_data_plane"))
+            mesh_resps.append(resp)
+        ex = cluster.nodes["node0"].search_transport.mesh_executor
+        hot = {h for h, c in ex.per_host_stats.items()
+               if c.get("shard_results", 0) > 0}
+        assert len(hot) >= 2, ex.per_host_stats
+        stats = cluster.nodes["node0"].local_node_stats()["mesh_plane"]
+        assert stats["hosts"]["n_hosts"] == 2, stats.get("hosts")
+        assert stats.get("per_host"), stats
+        cluster.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"search.mesh.enabled": False}}, cb))
+        for body, mesh_resp in zip(bodies, mesh_resps):
+            resp, err = cluster.call(
+                lambda cb, b=body: client.search("m", copy.deepcopy(b),
+                                                 cb))
+            assert err is None, (body, err)
+            assert resp.get("_data_plane") is None
+            a = {k: v for k, v in mesh_resp.items()
+                 if k not in ("took", "_data_plane")}
+            b = {k: v for k, v in resp.items() if k != "took"}
+            assert json.dumps(a, sort_keys=True) == \
+                json.dumps(b, sort_keys=True), body
+        assert TELEMETRY.fallbacks.get("unknown", 0) == before_unknown
+    finally:
+        cluster.stop()
+
+
+def test_multihost_host_loss_typed_fallback():
+    """A mesh-member host dropping mid-query degrades through the TYPED
+    mesh_host_lost fallback to the RPC path, whose reroute machinery
+    finds the surviving replica — identical results, zero untyped
+    ("unknown") fallbacks, never an error."""
+    from elasticsearch_tpu.search.telemetry import TELEMETRY
+    cluster, client, rng = _multihost_cluster(
+        29, n_nodes=3, hosts_spec="3x2", replicas=1)
+    try:
+        coord = cluster.nodes["node0"]
+        ex = coord.search_transport.mesh_executor
+        body = {"query": {"match": {"body": "w1 w3"}}, "size": 8}
+        resp, err = cluster.call(
+            lambda cb: client.search("m", copy.deepcopy(body), cb))
+        assert err is None and resp.get("_data_plane") == "mesh_plane"
+        remote_hot = {h for h, c in ex.per_host_stats.items()
+                      if h != "host_0" and c.get("shard_results", 0) > 0}
+        assert remote_hot, ex.per_host_stats
+
+        before = dict(TELEMETRY.fallbacks)
+        orig_execute = ex._execute
+
+        def sabotage(key, members):
+            remote = sorted({n for n in members[0].serving.values()
+                             if n != coord.node_id})
+            assert remote, "expected a remote-served shard"
+            for n in remote:
+                cluster.crash_node(n)
+            return orig_execute(key, members)
+        ex._execute = sabotage
+        try:
+            body2 = {"query": {"match": {"body": "w2 w5"}}, "size": 8}
+            resp2, err = cluster.call(
+                lambda cb: client.search("m", copy.deepcopy(body2), cb),
+                max_time=180.0)
+        finally:
+            ex._execute = orig_execute
+        assert err is None, err
+        assert resp2.get("_data_plane") is None
+        lost = TELEMETRY.fallbacks.get("mesh_host_lost", 0) - \
+            before.get("mesh_host_lost", 0)
+        assert lost >= 1, TELEMETRY.fallbacks
+        assert TELEMETRY.fallbacks.get("unknown", 0) == \
+            before.get("unknown", 0)
+        host_losses = sum(c.get("host_losses", 0)
+                          for c in ex.per_host_stats.values())
+        assert host_losses >= 1, ex.per_host_stats
+        # identical results off the surviving replicas: the explicit RPC
+        # fan-out (mesh disabled) agrees with what the typed fallback
+        # already served mid-crash
+        cluster.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"search.mesh.enabled": False}}, cb))
+        resp3, err = cluster.call(
+            lambda cb: client.search("m", copy.deepcopy(body2), cb),
+            max_time=180.0)
+        assert err is None, err
+        assert resp3.get("_data_plane") is None
+        assert resp2["hits"] == resp3["hits"]
+    finally:
+        cluster.stop()
+
+
+@pytest.mark.parametrize("seed", [23 + 449 * k for k in range(CHAOS_SEEDS)])
+def test_e2e_dfs_mesh_parity(seed):
+    """dfs_query_then_fetch rides the mesh: the coordinator's gathered
+    global df / avgdl overrides thread into the mesh BM25 kernel, and
+    responses are byte-identical to the DFS RPC fan-out."""
+    cluster, client, rng = _e2e_cluster(seed)
+    try:
+        bodies = [
+            {"query": {"match": {"body": "w1 w3 w7"}}, "size": 8},
+            {"query": {"match": {"body": "w2 w4"}}, "size": 5,
+             "track_total_hits": False},
+            {"query": {"match": {"body": "w5 w9"}}, "size": 6,
+             "track_total_hits": 7},
+        ]
+        mesh_resps = []
+        for body in bodies:
+            resp, err = cluster.call(
+                lambda cb, b=body: client.search(
+                    "m", copy.deepcopy(b), cb,
+                    search_type="dfs_query_then_fetch"))
+            assert err is None, (body, err)
+            assert resp.get("_data_plane") == "mesh_plane", \
+                (body, resp.get("_data_plane"))
+            mesh_resps.append(resp)
+        cluster.call(lambda cb: client.cluster_update_settings(
+            {"persistent": {"search.mesh.enabled": False}}, cb))
+        for body, mesh_resp in zip(bodies, mesh_resps):
+            resp, err = cluster.call(
+                lambda cb, b=body: client.search(
+                    "m", copy.deepcopy(b), cb,
+                    search_type="dfs_query_then_fetch"))
+            assert err is None, (body, err)
+            assert resp.get("_data_plane") is None
+            a = {k: v for k, v in mesh_resp.items()
+                 if k not in ("took", "_data_plane")}
+            b = {k: v for k, v in resp.items() if k != "took"}
+            assert json.dumps(a, sort_keys=True) == \
+                json.dumps(b, sort_keys=True), body
     finally:
         cluster.stop()
